@@ -1,0 +1,97 @@
+"""paddle_tpu: a TPU-native deep-learning framework with the capability
+surface of PaddlePaddle (reference mounted at /root/reference), built on
+JAX/XLA/Pallas. See SURVEY.md for the blueprint.
+
+Top-level namespace mirrors ``paddle.*`` (reference:
+python/paddle/__init__.py): tensor creation/math/manipulation ops, dtypes,
+autograd controls, plus the ``nn`` / ``optimizer`` / ``io`` / ``distributed``
+subpackages.
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from .core.dtype import (  # noqa: F401
+    bfloat16,
+    bool_,
+    complex128,
+    complex64,
+    dtype,
+    float16,
+    float32,
+    float64,
+    int16,
+    int32,
+    int64,
+    int8,
+    uint8,
+)
+
+# paddle spells bool dtype "paddle.bool"
+bool = bool_  # noqa: A001
+
+from .core.tensor import Tensor, is_tensor, to_tensor  # noqa: F401,E402
+from .core.autograd import (  # noqa: F401,E402
+    enable_grad,
+    is_grad_enabled,
+    no_grad,
+    set_grad_enabled,
+)
+from .core.random import get_rng_state, seed, set_rng_state  # noqa: F401,E402
+from .core import random as _random_core  # noqa: F401,E402
+
+from .ops import *  # noqa: F401,F403,E402
+from . import ops as _ops  # noqa: E402
+
+from .core import tensor_methods as _tm  # noqa: E402
+
+_tm.install()
+
+from . import nn  # noqa: F401,E402
+from . import optimizer  # noqa: F401,E402
+from . import autograd  # noqa: F401,E402
+from . import io  # noqa: F401,E402
+from . import amp  # noqa: F401,E402
+from . import jit  # noqa: F401,E402
+from . import vision  # noqa: F401,E402
+from . import distributed  # noqa: F401,E402
+from . import incubate  # noqa: F401,E402
+from . import metric  # noqa: F401,E402
+from . import device  # noqa: F401,E402
+from .framework.io_utils import load, save  # noqa: F401,E402
+from .framework import (  # noqa: F401,E402
+    get_default_dtype,
+    set_default_dtype,
+    get_flags,
+    set_flags,
+)
+from .device import get_device, set_device  # noqa: F401,E402
+
+# functional conveniences at top level, paddle-style
+from .nn.functional import one_hot  # noqa: F401,E402  (paddle.nn.functional too)
+
+CPUPlace = object
+TPUPlace = object
+
+
+def disable_static(place=None):
+    """Eager mode is the default and only stateful mode; no-op for parity."""
+
+
+def enable_static():
+    raise NotImplementedError(
+        "paddle_tpu is eager+jit native; use paddle_tpu.jit.to_static for "
+        "compiled-program execution"
+    )
+
+
+def in_dynamic_mode() -> bool:
+    return True
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=False,
+         create_graph=False, allow_unused=True, no_grad_vars=None):
+    from .core.autograd import grad as _grad
+
+    return _grad(outputs, inputs, grad_outputs, retain_graph, create_graph,
+                 allow_unused)

@@ -1,0 +1,137 @@
+"""AMP: auto_cast + GradScaler (reference: python/paddle/amp/).
+
+On TPU, bf16 is the native mixed-precision dtype: no loss scaling is needed
+for bf16 (same exponent range as fp32), matching the reference's bf16 path.
+GradScaler therefore defaults to a no-op passthrough for bf16 and implements
+dynamic loss scaling for fp16 parity.
+"""
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+
+import jax.numpy as jnp
+
+from ..core.dtype import to_jax_dtype
+from ..core.tensor import Tensor
+from .grad_scaler import AmpScaler, GradScaler  # noqa: F401
+
+__all__ = ["auto_cast", "autocast", "decorate", "GradScaler", "AmpScaler",
+           "is_auto_cast_enabled", "get_amp_dtype",
+           "white_list", "black_list", "debugging"]
+
+# O1 op lists (reference: python/paddle/amp/amp_lists.py:20-40)
+WHITE_LIST = {"matmul", "bmm", "mm", "conv1d", "conv2d", "conv3d", "linear",
+              "einsum", "flash_attention", "mha"}
+BLACK_LIST = {"exp", "log", "mean", "sum", "softmax", "cross_entropy",
+              "layer_norm", "batch_norm", "rms_norm", "logsumexp",
+              "log_softmax", "norm", "cumsum"}
+
+
+def white_list():
+    return {"float16": {"O1": WHITE_LIST, "O2": WHITE_LIST},
+            "bfloat16": {"O1": WHITE_LIST, "O2": WHITE_LIST}}
+
+
+def black_list():
+    return {"float16": {"O1": BLACK_LIST, "O2": BLACK_LIST},
+            "bfloat16": {"O1": BLACK_LIST, "O2": BLACK_LIST}}
+
+
+class _AmpState(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.dtype = "bfloat16"
+        self.level = "O1"
+        self.custom_white = set()
+        self.custom_black = set()
+
+
+_state = _AmpState()
+
+
+def is_auto_cast_enabled() -> bool:
+    return _state.enabled
+
+
+def get_amp_dtype():
+    return _state.dtype if _state.enabled else "float32"
+
+
+def amp_cast_inputs(op_name: str, arrays):
+    """Called by the op layer under auto_cast: cast inputs per white/black
+    list (the analog of the reference's AmpAutoCasts in generated AD funcs,
+    fluid/eager/amp_auto_cast.h)."""
+    if not _state.enabled:
+        return arrays
+    wl = WHITE_LIST | _state.custom_white
+    bl = (BLACK_LIST | _state.custom_black) - _state.custom_white
+    target = None
+    if op_name in wl:
+        target = to_jax_dtype(_state.dtype)
+    elif op_name in bl:
+        target = jnp.float32
+    elif _state.level == "O2":
+        target = to_jax_dtype(_state.dtype)
+    if target is None:
+        return arrays
+    out = []
+    for a in arrays:
+        if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating):
+            out.append(a.astype(target))
+        else:
+            out.append(a)
+    return out
+
+
+@contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16", use_promote=True):
+    prev = (_state.enabled, _state.dtype, _state.level, _state.custom_white,
+            _state.custom_black)
+    _state.enabled = enable
+    _state.dtype = dtype
+    _state.level = level
+    _state.custom_white = set(custom_white_list or ())
+    _state.custom_black = set(custom_black_list or ())
+    try:
+        yield
+    finally:
+        (_state.enabled, _state.dtype, _state.level, _state.custom_white,
+         _state.custom_black) = prev
+
+
+autocast = auto_cast
+
+
+def decorate(models, optimizers=None, level="O1", dtype="bfloat16",
+             master_weight=None, save_dtype=None, master_grad=False,
+             excluded_layers=None):
+    """O2 decoration: cast model params to the amp dtype (reference:
+    python/paddle/amp/auto_cast.py decorate)."""
+    single = not isinstance(models, (list, tuple))
+    model_list = [models] if single else list(models)
+    if level == "O2":
+        from ..nn.layer.norm import LayerNorm, _BatchNormBase, _InstanceNormBase
+
+        jdt = to_jax_dtype(dtype)
+        norm_types = (LayerNorm, _BatchNormBase, _InstanceNormBase)
+        excluded = tuple(excluded_layers) if excluded_layers else ()
+        for m in model_list:
+            skip_ids = set()
+            for sub in m.sublayers(include_self=True):
+                if isinstance(sub, norm_types) or (
+                        excluded and isinstance(sub, excluded)):
+                    for p in sub._parameters.values():
+                        if p is not None:
+                            skip_ids.add(id(p))
+            for p in m.parameters():
+                if p.dtype.is_floating_point and id(p) not in skip_ids:
+                    p._data = p._data.astype(jdt)
+            m._casted_by_pure_fp16 = True
+    if optimizers is None:
+        return models
+    return models, optimizers
+
+
+from . import debugging  # noqa: F401,E402

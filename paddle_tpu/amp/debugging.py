@@ -1,0 +1,105 @@
+"""Numerical debugging: NaN/Inf checking (reference:
+python/paddle/amp/debugging.py — TensorCheckerConfig:173, op stats :481).
+
+The reference hooks NaN/Inf checks into every generated AD func gated by
+FLAGS_check_nan_inf; here the tape's run_op consults
+:func:`check_numerics_enabled`."""
+from __future__ import annotations
+
+import contextlib
+import threading
+from enum import Enum
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["enable_tensor_checker", "disable_tensor_checker",
+           "TensorCheckerConfig", "DebugMode", "check_numerics",
+           "enable_operator_stats_collection",
+           "disable_operator_stats_collection", "collect_operator_stats"]
+
+
+class DebugMode(Enum):
+    CHECK_NAN_INF_AND_ABORT = 0
+    CHECK_NAN_INF = 1
+    CHECK_ALL = 2
+
+
+class TensorCheckerConfig:
+    def __init__(self, enable=False, debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT,
+                 output_dir=None, checked_op_list=None, skipped_op_list=None,
+                 debug_step=None, stack_height_limit=1):
+        self.enable = enable
+        self.debug_mode = debug_mode
+        self.output_dir = output_dir
+        self.checked_op_list = checked_op_list
+        self.skipped_op_list = skipped_op_list
+
+
+class _State(threading.local):
+    def __init__(self):
+        self.check = False
+        self.config = None
+        self.op_stats = None
+
+
+_state = _State()
+
+
+def enable_tensor_checker(config: TensorCheckerConfig):
+    _state.check = config.enable
+    _state.config = config
+
+
+def disable_tensor_checker():
+    _state.check = False
+
+
+def check_numerics_enabled():
+    return _state.check
+
+
+def check_numerics(tensor, op_name="op"):
+    arr = tensor._data if isinstance(tensor, Tensor) else tensor
+    if not jnp.issubdtype(arr.dtype, jnp.floating):
+        return
+    a = np.asarray(arr)
+    n_nan = int(np.isnan(a).sum())
+    n_inf = int(np.isinf(a).sum())
+    if n_nan or n_inf:
+        msg = f"[check_nan_inf] op={op_name} num_nan={n_nan} num_inf={n_inf}"
+        cfg = _state.config
+        if cfg is None or cfg.debug_mode == DebugMode.CHECK_NAN_INF_AND_ABORT:
+            raise FloatingPointError(msg)
+        print(msg)
+
+
+def enable_operator_stats_collection():
+    _state.op_stats = {}
+
+
+def disable_operator_stats_collection():
+    stats = _state.op_stats or {}
+    _state.op_stats = None
+    if stats:
+        print("<------------------------------ op list ------------------------------>")
+        for op, counts in sorted(stats.items()):
+            print(f"  {op}: {counts}")
+    return stats
+
+
+@contextlib.contextmanager
+def collect_operator_stats():
+    enable_operator_stats_collection()
+    try:
+        yield
+    finally:
+        disable_operator_stats_collection()
+
+
+def record_op(op_name: str, dtype_name: str):
+    if _state.op_stats is not None:
+        slot = _state.op_stats.setdefault(op_name, {})
+        slot[dtype_name] = slot.get(dtype_name, 0) + 1

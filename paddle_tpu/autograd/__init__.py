@@ -1,0 +1,10 @@
+"""paddle_tpu.autograd (reference: python/paddle/autograd/)."""
+from __future__ import annotations
+
+from ..core.autograd import backward, grad, is_grad_enabled, no_grad, set_grad_enabled  # noqa: F401
+from .py_layer import PyLayer, PyLayerContext  # noqa: F401
+from .functional import hessian, jacobian, jvp, vjp  # noqa: F401
+
+__all__ = ["backward", "grad", "no_grad", "is_grad_enabled",
+           "set_grad_enabled", "PyLayer", "PyLayerContext", "jacobian",
+           "hessian", "jvp", "vjp"]

@@ -1,0 +1,78 @@
+"""Functional autodiff: jacobian/hessian/jvp/vjp over jax transforms
+(reference: python/paddle/autograd/functional.py — but here jax.jacobian &
+co. do the work natively)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.autograd import no_grad
+from ..core.tensor import Tensor
+
+
+def _wrap_fn(func):
+    """Wrap a user fn taking/returning Tensors into one over arrays."""
+
+    def inner(*arrays):
+        with no_grad():
+            outs = func(*[Tensor(a) for a in arrays])
+        if isinstance(outs, (tuple, list)):
+            return tuple(o._data for o in outs)
+        return outs._data
+
+    return inner
+
+
+def jacobian(func, xs, create_graph=False, allow_unused=False):
+    single = isinstance(xs, Tensor)
+    arrays = [xs._data] if single else [x._data for x in xs]
+    jac = jax.jacobian(_wrap_fn(func), argnums=tuple(range(len(arrays))))(
+        *arrays)
+    if single:
+        j = jac[0] if isinstance(jac, tuple) else jac
+        return Tensor(j)
+    return jax.tree_util.tree_map(Tensor, jac)
+
+
+def hessian(func, xs, create_graph=False, allow_unused=False):
+    single = isinstance(xs, Tensor)
+    arrays = [xs._data] if single else [x._data for x in xs]
+    hess = jax.hessian(_wrap_fn(func), argnums=tuple(range(len(arrays))))(
+        *arrays)
+    if single:
+        h = hess
+        while isinstance(h, tuple):
+            h = h[0]
+        return Tensor(h)
+    return jax.tree_util.tree_map(Tensor, hess)
+
+
+def jvp(func, xs, v=None):
+    single = isinstance(xs, Tensor)
+    arrays = (xs._data,) if single else tuple(x._data for x in xs)
+    if v is None:
+        tangents = tuple(jnp.ones_like(a) for a in arrays)
+    else:
+        vs = (v,) if isinstance(v, Tensor) else tuple(v)
+        tangents = tuple(t._data for t in vs)
+    out, tangent_out = jax.jvp(_wrap_fn(func), arrays, tangents)
+    return jax.tree_util.tree_map(Tensor, out), \
+        jax.tree_util.tree_map(Tensor, tangent_out)
+
+
+def vjp(func, xs, v=None):
+    single = isinstance(xs, Tensor)
+    arrays = (xs._data,) if single else tuple(x._data for x in xs)
+    out, vjp_fn = jax.vjp(_wrap_fn(func), *arrays)
+    if v is None:
+        cot = jax.tree_util.tree_map(jnp.ones_like, out)
+    else:
+        vs = v if not isinstance(v, Tensor) else v
+        cot = jax.tree_util.tree_map(
+            lambda t: t._data if isinstance(t, Tensor) else t, vs)
+    grads = vjp_fn(cot)
+    grads_t = jax.tree_util.tree_map(Tensor, grads)
+    out_t = jax.tree_util.tree_map(Tensor, out)
+    if single:
+        return out_t, grads_t[0]
+    return out_t, list(grads_t)

@@ -1,0 +1,111 @@
+"""PyLayer: user-defined autograd ops (reference:
+python/paddle/autograd/py_layer.py).
+
+The user's ``backward`` staticmethod is wired straight into the tape as a
+custom GradNode — no jax.vjp involved, mirroring the reference's
+PyLayer GradNode (fluid/eager/pylayer/py_layer_node.h)."""
+from __future__ import annotations
+
+from typing import Any
+
+from ..core.autograd import GradNode, is_grad_enabled
+from ..core.tensor import Tensor
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = []
+        self.container = None
+
+    def save_for_backward(self, *tensors):
+        self._saved = [t.detach() if isinstance(t, Tensor) else t
+                       for t in tensors]
+
+    def saved_tensor(self):
+        return list(self._saved)
+
+    # paddle also exposes mark_not_inplace etc.; no-ops here
+    def mark_not_inplace(self, *args):
+        pass
+
+    def mark_non_differentiable(self, *args):
+        self._non_diff = args
+
+    def set_materialize_grads(self, value: bool):
+        self._materialize = value
+
+
+class PyLayerMeta(type):
+    def __call__(cls, *args, **kwargs):
+        raise RuntimeError(
+            "PyLayer subclasses are not instantiated; call .apply(...)")
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+        need_grad = is_grad_enabled() and any(
+            not t.stop_gradient for t in tensor_inputs)
+
+        outputs = cls.forward(ctx, *args, **kwargs)
+        single = not isinstance(outputs, (tuple, list))
+        outs = (outputs,) if single else tuple(outputs)
+
+        if not need_grad:
+            return outputs
+
+        diff_inputs = [t for t in tensor_inputs if not t.stop_gradient]
+
+        def vjp_fn(cotangents):
+            if not isinstance(cotangents, (tuple, list)):
+                cotangents = (cotangents,)
+            grads = cls.backward(
+                ctx, *[Tensor(c) if c is not None else None
+                       for c in cotangents])
+            if not isinstance(grads, (tuple, list)):
+                grads = (grads,)
+            garrs = [g._data if isinstance(g, Tensor) else g for g in grads]
+            # align with diff inputs: the user returns one grad per
+            # *tensor input* in order; keep only the differentiable ones
+            aligned = []
+            gi = 0
+            for t in tensor_inputs:
+                g = garrs[gi] if gi < len(garrs) else None
+                gi += 1
+                if not t.stop_gradient:
+                    aligned.append(g)
+            return tuple(aligned)
+
+        node = GradNode(
+            vjp_fn=vjp_fn,
+            inputs=diff_inputs,
+            out_meta=[(tuple(o.shape), o._data.dtype) for o in outs
+                      if isinstance(o, Tensor)],
+            name=cls.__name__,
+        )
+        wrapped = []
+        idx = 0
+        for o in outs:
+            if isinstance(o, Tensor):
+                w = Tensor(o._data, stop_gradient=False, grad_node=node,
+                           out_index=idx)
+                idx += 1
+                wrapped.append(w)
+            else:
+                wrapped.append(o)
+        return wrapped[0] if single else tuple(wrapped)
+
+
+# vjp_fn signature note: core.autograd.backward calls node.vjp_fn(cotangent)
+# (single output) or node.vjp_fn(tuple) (multi) — PyLayer's vjp_fn above
+# normalizes both.

@@ -1,0 +1,155 @@
+"""Data types for paddle_tpu.
+
+TPU-native dtype system: thin named wrappers over numpy/jax dtypes so user code
+can say ``paddle_tpu.float32`` / ``'float32'`` interchangeably, the way the
+reference exposes ``phi::DataType`` through Python (reference:
+paddle/phi/common/data_type.h, python/paddle/framework/dtype.py).
+
+bfloat16 is first-class here (it is the MXU-native matmul dtype).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "DType",
+    "dtype",
+    "bool_",
+    "uint8",
+    "int8",
+    "int16",
+    "int32",
+    "int64",
+    "float16",
+    "bfloat16",
+    "float32",
+    "float64",
+    "complex64",
+    "complex128",
+    "convert_dtype",
+    "to_jax_dtype",
+]
+
+
+class DType:
+    """A named dtype. Compares equal to its string name and numpy/jax dtype."""
+
+    __slots__ = ("name", "np_dtype")
+
+    def __init__(self, name: str, np_dtype):
+        self.name = name
+        self.np_dtype = np.dtype(np_dtype)
+
+    def __repr__(self):
+        return f"paddle_tpu.{self.name}"
+
+    def __str__(self):
+        return self.name
+
+    def __hash__(self):
+        return hash(self.name)
+
+    def __eq__(self, other):
+        if isinstance(other, DType):
+            return self.name == other.name
+        if isinstance(other, str):
+            return self.name == other or other.endswith("." + self.name)
+        try:
+            return self.np_dtype == np.dtype(other)
+        except TypeError:
+            return NotImplemented
+
+    @property
+    def is_floating_point(self) -> bool:
+        return self.name in ("float16", "bfloat16", "float32", "float64")
+
+    @property
+    def is_integer(self) -> bool:
+        return self.name in ("uint8", "int8", "int16", "int32", "int64")
+
+    @property
+    def is_complex(self) -> bool:
+        return self.name in ("complex64", "complex128")
+
+    @property
+    def itemsize(self) -> int:
+        return self.np_dtype.itemsize
+
+
+bool_ = DType("bool", np.bool_)
+uint8 = DType("uint8", np.uint8)
+int8 = DType("int8", np.int8)
+int16 = DType("int16", np.int16)
+int32 = DType("int32", np.int32)
+int64 = DType("int64", np.int64)
+float16 = DType("float16", np.float16)
+bfloat16 = DType("bfloat16", jnp.bfloat16)
+float32 = DType("float32", np.float32)
+float64 = DType("float64", np.float64)
+complex64 = DType("complex64", np.complex64)
+complex128 = DType("complex128", np.complex128)
+
+_ALL = {
+    d.name: d
+    for d in (
+        bool_,
+        uint8,
+        int8,
+        int16,
+        int32,
+        int64,
+        float16,
+        bfloat16,
+        float32,
+        float64,
+        complex64,
+        complex128,
+    )
+}
+_ALL["bool"] = bool_
+
+
+def convert_dtype(d) -> DType:
+    """Normalize anything dtype-like to a DType."""
+    if d is None:
+        return None
+    if isinstance(d, DType):
+        return d
+    if isinstance(d, str):
+        name = d.split(".")[-1]
+        if name in _ALL:
+            return _ALL[name]
+        raise ValueError(f"unknown dtype string: {d!r}")
+    if d is bool:
+        return bool_
+    if d is int:
+        return int64
+    if d is float:
+        return float32
+    npd = np.dtype(d)
+    name = npd.name
+    if name in _ALL:
+        return _ALL[name]
+    raise ValueError(f"unsupported dtype: {d!r}")
+
+
+def to_jax_dtype(d):
+    """DType (or anything dtype-like) -> jnp dtype object."""
+    dt = convert_dtype(d)
+    if dt is None:
+        return None
+    if dt.name == "bfloat16":
+        return jnp.bfloat16
+    return dt.np_dtype
+
+
+def dtype(d) -> DType:  # paddle.dtype-like callable
+    return convert_dtype(d)
+
+
+def from_jax_dtype(jd) -> DType:
+    name = np.dtype(jd).name
+    if name == "bfloat16" or str(jd) == "bfloat16":
+        return bfloat16
+    return _ALL[name]

@@ -1,0 +1,447 @@
+"""Eager Tensor: a thin autograd-aware wrapper over ``jax.Array``.
+
+TPU-native analog of the reference eager Tensor
+(reference: paddle/phi/api/include/tensor.h:82 plus the pybind eager Tensor at
+paddle/fluid/pybind/eager_method.cc). Instead of a C++ DenseTensor holding
+device memory, the payload here is a ``jax.Array`` (PJRT buffer on TPU) or a
+jax tracer (so the same Tensor code path works under ``jax.jit`` tracing —
+that is what makes ``paddle_tpu.jit.to_static`` a zero-copy re-trace rather
+than a separate graph frontend).
+
+Autograd metadata (``_grad_node``, ``_out_index``, ``_grad``) mirrors the
+reference ``AutogradMeta`` (fluid/eager/autograd_meta.h:61).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import autograd as _ag
+from .dtype import DType, convert_dtype, from_jax_dtype, to_jax_dtype
+
+__all__ = ["Tensor", "to_tensor", "is_tensor"]
+
+
+def _unwrap(x):
+    return x._data if isinstance(x, Tensor) else x
+
+
+class Tensor:
+    __slots__ = (
+        "_data",
+        "_stop_gradient",
+        "_grad",
+        "_grad_node",
+        "_out_index",
+        "_grad_hooks",
+        "name",
+        "persistable",
+        "_dist_attr",
+        "__weakref__",
+    )
+
+    _counter = 0
+
+    def __init__(self, data, stop_gradient: bool = True, grad_node=None, out_index=0,
+                 dtype=None, name: Optional[str] = None):
+        if isinstance(data, Tensor):
+            data = data._data
+        if not isinstance(data, (jax.Array, jax.core.Tracer)):
+            jdt = to_jax_dtype(dtype) if dtype is not None else None
+            if isinstance(data, (bool, int, float, complex)) and jdt is None:
+                # follow paddle/np semantics: python float -> float32
+                if isinstance(data, bool):
+                    jdt = jnp.bool_
+                elif isinstance(data, int):
+                    jdt = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+                elif isinstance(data, float):
+                    jdt = jnp.float32
+            data = jnp.asarray(data, dtype=jdt)
+        elif dtype is not None:
+            jdt = to_jax_dtype(dtype)
+            if data.dtype != jdt:
+                data = data.astype(jdt)
+        self._data = data
+        self._stop_gradient = bool(stop_gradient)
+        self._grad = None
+        self._grad_node = grad_node
+        self._out_index = out_index
+        self._grad_hooks = []
+        if name is None:
+            Tensor._counter += 1
+            name = f"generated_tensor_{Tensor._counter}"
+        self.name = name
+        self.persistable = False
+        self._dist_attr = None  # set by distributed.shard_tensor (DistTensor)
+
+    # ------------------------------------------------------------- metadata
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def ndim(self) -> int:
+        return self._data.ndim
+
+    @property
+    def dim(self):
+        return self._data.ndim
+
+    @property
+    def rank(self):
+        return self._data.ndim
+
+    @property
+    def size(self) -> int:
+        return int(np.prod(self._data.shape)) if self._data.shape else 1
+
+    @property
+    def dtype(self) -> DType:
+        return from_jax_dtype(self._data.dtype)
+
+    @property
+    def place(self):
+        try:
+            devs = self._data.devices()
+            return next(iter(devs))
+        except Exception:
+            return None
+
+    @property
+    def is_leaf(self) -> bool:
+        return self._grad_node is None
+
+    # ------------------------------------------------------------- autograd
+    @property
+    def stop_gradient(self) -> bool:
+        return self._stop_gradient
+
+    @stop_gradient.setter
+    def stop_gradient(self, v: bool):
+        self._stop_gradient = bool(v)
+
+    @property
+    def grad(self):
+        return self._grad
+
+    @grad.setter
+    def grad(self, g):
+        self._grad = g if (g is None or isinstance(g, Tensor)) else Tensor(g)
+
+    def backward(self, grad_tensor=None, retain_graph: bool = False):
+        _ag.backward(self, grad_tensor, retain_graph=retain_graph)
+
+    def clear_grad(self):
+        self._grad = None
+
+    def clear_gradient(self, set_to_zero: bool = False):
+        if set_to_zero and self._grad is not None:
+            self._grad = Tensor(jnp.zeros_like(self._grad._data))
+        else:
+            self._grad = None
+
+    def register_hook(self, hook):
+        """Hook fires on the leaf grad after backward (or grad-ready for DP)."""
+        self._grad_hooks.append(hook)
+
+        class _Handle:
+            def remove(_self):
+                try:
+                    self._grad_hooks.remove(hook)
+                except ValueError:
+                    pass
+
+        return _Handle()
+
+    def detach(self) -> "Tensor":
+        t = Tensor(self._data, stop_gradient=True)
+        return t
+
+    def detach_(self) -> "Tensor":
+        self._grad_node = None
+        self._stop_gradient = True
+        return self
+
+    def clone(self) -> "Tensor":
+        return _ag.run_op(lambda x: x + 0, [self], name="clone")
+
+    # ------------------------------------------------------------- host I/O
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._data)
+
+    def item(self, *args):
+        if args:
+            return self.numpy().item(*args)
+        return self.numpy().item()
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._data)
+        return a.astype(dtype) if dtype is not None else a
+
+    def __float__(self):
+        return float(self.item())
+
+    def __int__(self):
+        return int(self.item())
+
+    def __bool__(self):
+        return bool(self.numpy().all()) if self.size == 1 else self._raise_bool()
+
+    def _raise_bool(self):
+        raise ValueError(
+            "The truth value of a multi-element Tensor is ambiguous; use .any()/.all()"
+        )
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._data.shape[0]
+
+    def __repr__(self):
+        try:
+            val = np.asarray(self._data)
+            body = np.array2string(val, precision=6, separator=", ", threshold=64)
+        except Exception:
+            body = f"<traced {self._data}>"
+        return (
+            f"Tensor(shape={self.shape}, dtype={self.dtype.name}, "
+            f"stop_gradient={self._stop_gradient},\n       {body})"
+        )
+
+    # ------------------------------------------------------------- casting
+    def astype(self, dtype) -> "Tensor":
+        jdt = to_jax_dtype(dtype)
+        return _ag.run_op(lambda x: x.astype(jdt), [self], name="cast")
+
+    def cast(self, dtype) -> "Tensor":
+        return self.astype(dtype)
+
+    def cast_(self, dtype) -> "Tensor":
+        self._data = self._data.astype(to_jax_dtype(dtype))
+        return self
+
+    # ------------------------------------------------------------- indexing
+    def __getitem__(self, idx):
+        idx = _unwrap_index(idx)
+        return _ag.run_op(lambda x: x[idx], [self], name="getitem")
+
+    def __setitem__(self, idx, value):
+        idx = _unwrap_index(idx)
+        v = _unwrap(value)
+        if isinstance(v, (int, float, bool)):
+            self._data = self._data.at[idx].set(v)
+        else:
+            self._data = self._data.at[idx].set(jnp.asarray(v))
+        # setitem on a tracked tensor breaks the tape for prior reads; eager
+        # in-place semantics match the reference's inplace ops (version bump).
+        self._grad_node = None
+
+    # ------------------------------------------------------------- iteration
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    # ------------------------------------------------------------- operators
+    # (binary ops defined via ops module to get broadcasting + tape; lazy
+    # import keeps module load order simple)
+    def _binop(self, other, fn, name):
+        if isinstance(other, Tensor):
+            return _ag.run_op(fn, [self, other], name=name)
+        other_arr = jnp.asarray(other, dtype=None)
+        return _ag.run_op(lambda x: fn(x, other_arr), [self], name=name)
+
+    def _rbinop(self, other, fn, name):
+        other_arr = jnp.asarray(other)
+        return _ag.run_op(lambda x: fn(other_arr, x), [self], name=name)
+
+    def __add__(self, o):
+        return self._binop(o, jnp.add, "add")
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binop(o, jnp.subtract, "subtract")
+
+    def __rsub__(self, o):
+        return self._rbinop(o, jnp.subtract, "subtract")
+
+    def __mul__(self, o):
+        return self._binop(o, jnp.multiply, "multiply")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._binop(o, jnp.true_divide, "divide")
+
+    def __rtruediv__(self, o):
+        return self._rbinop(o, jnp.true_divide, "divide")
+
+    def __floordiv__(self, o):
+        return self._binop(o, jnp.floor_divide, "floor_divide")
+
+    def __rfloordiv__(self, o):
+        return self._rbinop(o, jnp.floor_divide, "floor_divide")
+
+    def __mod__(self, o):
+        return self._binop(o, jnp.mod, "mod")
+
+    def __rmod__(self, o):
+        return self._rbinop(o, jnp.mod, "mod")
+
+    def __pow__(self, o):
+        return self._binop(o, jnp.power, "pow")
+
+    def __rpow__(self, o):
+        return self._rbinop(o, jnp.power, "pow")
+
+    def __matmul__(self, o):
+        return self._binop(o, jnp.matmul, "matmul")
+
+    def __rmatmul__(self, o):
+        return self._rbinop(o, jnp.matmul, "matmul")
+
+    def __neg__(self):
+        return _ag.run_op(jnp.negative, [self], name="neg")
+
+    def __abs__(self):
+        return _ag.run_op(jnp.abs, [self], name="abs")
+
+    def __invert__(self):
+        return _ag.run_op(jnp.logical_not, [self], name="logical_not")
+
+    # comparisons -> bool tensors (no grad)
+    def _cmp(self, other, fn):
+        o = _unwrap(other)
+        return Tensor(fn(self._data, o))
+
+    def __eq__(self, o):
+        return self._cmp(o, jnp.equal)
+
+    def __ne__(self, o):
+        return self._cmp(o, jnp.not_equal)
+
+    def __lt__(self, o):
+        return self._cmp(o, jnp.less)
+
+    def __le__(self, o):
+        return self._cmp(o, jnp.less_equal)
+
+    def __gt__(self, o):
+        return self._cmp(o, jnp.greater)
+
+    def __ge__(self, o):
+        return self._cmp(o, jnp.greater_equal)
+
+    def __hash__(self):
+        return id(self)
+
+    # in-place arithmetic (tape-breaking, like reference inplace version bump)
+    def _iop(self, other, fn):
+        o = _unwrap(other)
+        self._data = fn(self._data, o)
+        self._grad_node = None
+        return self
+
+    def add_(self, o):
+        return self._iop(o, jnp.add)
+
+    def subtract_(self, o):
+        return self._iop(o, jnp.subtract)
+
+    def multiply_(self, o):
+        return self._iop(o, jnp.multiply)
+
+    def divide_(self, o):
+        return self._iop(o, jnp.true_divide)
+
+    def scale_(self, scale=1.0, bias=0.0):
+        self._data = self._data * scale + bias
+        self._grad_node = None
+        return self
+
+    def zero_(self):
+        self._data = jnp.zeros_like(self._data)
+        self._grad_node = None
+        return self
+
+    def fill_(self, value):
+        self._data = jnp.full_like(self._data, value)
+        self._grad_node = None
+        return self
+
+    def copy_(self, other):
+        self._data = _unwrap(other)
+        self._grad_node = None
+        return self
+
+    def set_value(self, value):
+        if isinstance(value, Tensor):
+            self._data = value._data
+        else:
+            self._data = jnp.asarray(value, dtype=self._data.dtype)
+        return self
+
+    def get_tensor(self):
+        return self
+
+    @property
+    def T(self):
+        return _ag.run_op(lambda x: x.T, [self], name="transpose")
+
+    # pytree-friendly value access
+    @property
+    def value(self):
+        return self._data
+
+    def _to_jax(self):
+        return self._data
+
+
+def _unwrap_index(idx):
+    if isinstance(idx, Tensor):
+        return idx._data
+    if isinstance(idx, tuple):
+        return tuple(_unwrap_index(i) for i in idx)
+    if isinstance(idx, list):
+        return jnp.asarray(idx)
+    if isinstance(idx, slice):
+        return slice(
+            _unwrap_index(idx.start) if isinstance(idx.start, Tensor) else idx.start,
+            _unwrap_index(idx.stop) if isinstance(idx.stop, Tensor) else idx.stop,
+            _unwrap_index(idx.step) if isinstance(idx.step, Tensor) else idx.step,
+        )
+    return idx
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient: bool = True) -> Tensor:
+    """paddle.to_tensor equivalent (reference: python/paddle/tensor/creation.py)."""
+    if isinstance(data, Tensor):
+        t = Tensor(data._data, stop_gradient=stop_gradient, dtype=dtype)
+        return t
+    if isinstance(data, np.ndarray) and data.dtype == np.float64 and dtype is None:
+        dtype = "float32"  # paddle default: float64 numpy -> keep; but fp32 default here
+        data = data.astype(np.float32)
+    return Tensor(data, stop_gradient=stop_gradient, dtype=dtype)
+
+
+def is_tensor(x) -> bool:
+    return isinstance(x, Tensor)
+
+
+# Register Tensor as a jax pytree so jitted functions can take/return Tensors.
+def _tensor_flatten(t: Tensor):
+    return (t._data,), (t._stop_gradient,)
+
+
+def _tensor_unflatten(aux, children):
+    (data,) = children
+    return Tensor(data, stop_gradient=aux[0])
+
+
+jax.tree_util.register_pytree_node(Tensor, _tensor_flatten, _tensor_unflatten)

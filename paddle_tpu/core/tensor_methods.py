@@ -1,0 +1,77 @@
+"""Install the functional op surface as Tensor methods (the analog of the
+reference's generated eager_method.cc tensor methods + monkey-patched
+python/paddle/tensor/__init__.py method registration)."""
+from __future__ import annotations
+
+import functools
+
+from .tensor import Tensor
+
+
+def install():
+    from .. import ops
+
+    method_names = [
+        # math
+        "abs", "sign", "sqrt", "rsqrt", "square", "exp", "expm1", "log",
+        "log2", "log10", "log1p", "reciprocal", "floor", "ceil", "round",
+        "trunc", "sin", "cos", "tan", "asin", "acos", "atan", "sinh", "cosh",
+        "tanh", "erf", "erfinv", "neg", "digamma", "lgamma", "conj", "real",
+        "imag", "add", "subtract", "multiply", "divide", "floor_divide",
+        "mod", "remainder", "pow", "maximum", "minimum", "fmax", "fmin",
+        "atan2", "clip", "lerp", "scale", "nan_to_num",
+        "sum", "mean", "max", "min", "prod", "std", "var", "median",
+        "nansum", "nanmean", "amax", "amin", "logsumexp", "all", "any",
+        "count_nonzero", "cumsum", "cumprod", "cummax", "cummin", "diff",
+        "isnan", "isinf", "isfinite", "inner", "outer", "trace", "kron",
+        # manipulation
+        "reshape", "reshape_", "transpose", "split", "chunk", "squeeze",
+        "unsqueeze", "flatten", "flatten_", "flip", "roll", "tile", "expand",
+        "expand_as", "broadcast_to", "gather", "gather_nd", "scatter",
+        "scatter_nd_add", "index_select", "index_sample", "index_add",
+        "index_put", "masked_select", "masked_fill", "where",
+        "take_along_axis", "put_along_axis", "unbind", "repeat_interleave",
+        "topk", "sort", "argsort", "argmax", "argmin", "unique", "nonzero",
+        "cast", "moveaxis", "swapaxes", "view", "view_as", "searchsorted",
+        "bucketize", "one_hot", "bincount", "histogram", "unstack",
+        # linalg
+        "matmul", "bmm", "mm", "mv", "dot", "norm", "dist", "cross",
+        "cholesky", "qr", "svd", "pinv", "inv", "solve", "det", "slogdet",
+        "matrix_power", "lu", "eig", "eigvals",
+        # logic
+        "logical_and", "logical_or", "logical_not", "logical_xor",
+        "bitwise_and", "bitwise_or", "bitwise_not", "bitwise_xor",
+        "equal", "not_equal", "greater_than", "greater_equal", "less_than",
+        "less_equal", "equal_all", "allclose", "isclose",
+        # random inplace
+        "uniform_", "normal_", "exponential_",
+    ]
+    for name in method_names:
+        fn = getattr(ops, name, None)
+        if fn is None:
+            continue
+        if hasattr(Tensor, name) and name not in ("where",):
+            continue
+
+        def make(f):
+            @functools.wraps(f)
+            def method(self, *args, **kwargs):
+                return f(self, *args, **kwargs)
+
+            return method
+
+        setattr(Tensor, name, make(fn))
+
+    # aliases with paddle names
+    Tensor.add_n = lambda self, others: functools.reduce(
+        lambda a, b: a + b, [self] + list(others)
+    )
+    Tensor.numel = lambda self: self.size
+    Tensor.element_size = lambda self: self.dtype.itemsize
+    Tensor.dim = lambda self: self.ndim
+    Tensor.ndimension = lambda self: self.ndim
+    Tensor.cpu = lambda self: self
+    Tensor.cuda = lambda self, *a, **k: self
+    Tensor.pin_memory = lambda self: self
+    Tensor.contiguous = lambda self: self
+    Tensor.is_contiguous = lambda self: True

@@ -1,0 +1,106 @@
+"""Device management (reference: python/paddle/device/).
+
+TPU-native: devices are jax devices; "gpu"-spelled APIs alias onto the
+accelerator so reference-style scripts run unchanged."""
+from __future__ import annotations
+
+import jax
+
+__all__ = ["set_device", "get_device", "get_all_devices", "device_count",
+           "is_compiled_with_cuda", "is_compiled_with_xpu",
+           "is_compiled_with_tpu", "synchronize", "cuda", "get_available_device"]
+
+_current = None
+
+
+def _accel_devices():
+    try:
+        devs = jax.devices()
+    except Exception:
+        return []
+    return devs
+
+
+def set_device(device):
+    global _current
+    _current = device
+    return device
+
+
+def get_device():
+    if _current is not None:
+        return _current
+    devs = _accel_devices()
+    if devs and devs[0].platform == "tpu":
+        return "tpu:0"
+    if devs and devs[0].platform == "gpu":
+        return "gpu:0"
+    return "cpu"
+
+
+def get_available_device():
+    return [f"{d.platform}:{d.id}" for d in _accel_devices()]
+
+
+def get_all_devices():
+    return get_available_device()
+
+
+def device_count():
+    return len(_accel_devices())
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_tpu() -> bool:
+    return any(d.platform == "tpu" for d in _accel_devices())
+
+
+def synchronize(device=None):
+    # jax dispatch is async; block on a trivial transfer
+    import jax.numpy as jnp
+
+    jnp.zeros(()).block_until_ready()
+
+
+class _CudaNamespace:
+    """paddle.device.cuda parity shims (map onto the accelerator)."""
+
+    @staticmethod
+    def device_count():
+        return device_count()
+
+    @staticmethod
+    def synchronize(device=None):
+        synchronize(device)
+
+    @staticmethod
+    def max_memory_allocated(device=None):
+        devs = _accel_devices()
+        try:
+            stats = devs[0].memory_stats()
+            return stats.get("peak_bytes_in_use", 0)
+        except Exception:
+            return 0
+
+    @staticmethod
+    def memory_allocated(device=None):
+        devs = _accel_devices()
+        try:
+            stats = devs[0].memory_stats()
+            return stats.get("bytes_in_use", 0)
+        except Exception:
+            return 0
+
+    @staticmethod
+    def empty_cache():
+        pass
+
+
+cuda = _CudaNamespace()

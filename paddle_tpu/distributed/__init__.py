@@ -1,0 +1,71 @@
+"""paddle_tpu.distributed (reference: python/paddle/distributed/).
+
+TPU-native distributed stack:
+- environment / rank info over jax.distributed + jax process indices
+- collective API operating on DistTensors / sharded arrays (compiled XLA
+  collectives over ICI/DCN — the ProcessGroupXLA concept from SURVEY §5)
+- Fleet hybrid parallel (topology/HCG, TP/PP/sharding wrappers)
+- semi-auto parallel (ProcessMesh, shard_tensor, reshard, DistTensor)
+"""
+from __future__ import annotations
+
+from .parallel_env import (  # noqa: F401
+    ParallelEnv,
+    get_rank,
+    get_world_size,
+    init_parallel_env,
+    is_initialized,
+)
+from .collective import (  # noqa: F401
+    Group,
+    P2POp,
+    all_gather,
+    all_gather_object,
+    all_reduce,
+    batch_isend_irecv,
+    broadcast_object_list,
+    gather,
+    get_backend,
+    scatter_object_list,
+    stream,
+    all_to_all,
+    alltoall,
+    barrier,
+    broadcast,
+    destroy_process_group,
+    get_group,
+    irecv,
+    isend,
+    new_group,
+    recv,
+    reduce,
+    reduce_scatter,
+    scatter,
+    send,
+    split_group,
+    wait,
+    ReduceOp,
+)
+from .auto_parallel.api import (  # noqa: F401
+    DistAttr,
+    dtensor_from_fn,
+    dtensor_from_local,
+    reshard,
+    shard_layer,
+    shard_optimizer,
+    shard_tensor,
+    unshard_dtensor,
+)
+from .auto_parallel.process_mesh import ProcessMesh  # noqa: F401
+from .auto_parallel.placement_type import (  # noqa: F401
+    Partial,
+    Placement,
+    Replicate,
+    Shard,
+)
+from . import fleet  # noqa: F401
+from . import checkpoint  # noqa: F401
+from .parallel import DataParallel  # noqa: F401
+from .sharding import group_sharded_parallel, save_group_sharded_model  # noqa: F401
+from . import launch  # noqa: F401
+from .spawn import spawn  # noqa: F401

@@ -1,0 +1,110 @@
+"""ProcessMesh over jax.sharding.Mesh
+(reference: python/paddle/distributed/auto_parallel/process_mesh.py, C++
+phi/core/distributed/auto_parallel/process_mesh.h:34)."""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["ProcessMesh", "get_mesh", "set_mesh"]
+
+_global_mesh: Optional["ProcessMesh"] = None
+
+
+class ProcessMesh:
+    """N-D logical mesh of processes/devices. ``dim_names`` name the axes
+    (e.g. ["dp", "mp"] or ["pp", "dp", "mp"]); the jax Mesh is built lazily
+    from the flat device list so the same object works before jax device
+    init."""
+
+    def __init__(self, mesh, dim_names: Optional[Sequence[str]] = None,
+                 shape=None, process_ids=None):
+        arr = np.asarray(mesh)
+        self._mesh = arr
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(arr.ndim)]
+        self._dim_names = list(dim_names)
+        self._jax_mesh = None
+
+    @property
+    def shape(self) -> List[int]:
+        return list(self._mesh.shape)
+
+    @property
+    def ndim(self) -> int:
+        return self._mesh.ndim
+
+    @property
+    def dim_names(self) -> List[str]:
+        return list(self._dim_names)
+
+    @property
+    def mesh(self):
+        return self._mesh
+
+    @property
+    def process_ids(self) -> List[int]:
+        return self._mesh.reshape(-1).tolist()
+
+    @property
+    def size(self):
+        return int(self._mesh.size)
+
+    def get_dim_size(self, dim_name: str) -> int:
+        return self.shape[self._dim_names.index(dim_name)]
+
+    def get_rank_by_dim_and_process_id(self, dim_name, pid):
+        axis = self._dim_names.index(dim_name)
+        loc = np.argwhere(self._mesh == pid)
+        if loc.size == 0:
+            return -1
+        return int(loc[0][axis])
+
+    def get_jax_mesh(self):
+        """Materialize as jax.sharding.Mesh, mapping process ids onto jax
+        devices. With N processes × D local devices we map process id ->
+        one device per id when ids index devices directly (single-host
+        multi-device emulation) or one device per process (multi-host)."""
+        if self._jax_mesh is not None:
+            return self._jax_mesh
+        import jax
+
+        devices = jax.devices()
+        ids = self._mesh.reshape(-1)
+        if len(devices) >= ids.size and ids.max() < len(devices):
+            devs = np.array([devices[i] for i in ids]).reshape(
+                self._mesh.shape)
+        else:
+            raise RuntimeError(
+                f"mesh needs {ids.size} devices; only {len(devices)} visible")
+        self._jax_mesh = jax.sharding.Mesh(devs, axis_names=tuple(
+            self._dim_names))
+        return self._jax_mesh
+
+    def __eq__(self, other):
+        return isinstance(other, ProcessMesh) and \
+            np.array_equal(self._mesh, other._mesh) and \
+            self._dim_names == other._dim_names
+
+    def __hash__(self):
+        return hash((self._mesh.tobytes(), tuple(self._dim_names)))
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={self.shape}, dim_names={self._dim_names})"
+
+    def __getitem__(self, index):
+        """Sub-mesh selection along the first axis."""
+        sub = self._mesh[index]
+        if sub.ndim == self._mesh.ndim:
+            return ProcessMesh(sub, self._dim_names)
+        return ProcessMesh(sub, self._dim_names[1:])
+
+
+def set_mesh(mesh: ProcessMesh):
+    global _global_mesh
+    _global_mesh = mesh
+
+
+def get_mesh() -> Optional[ProcessMesh]:
+    return _global_mesh

@@ -1,0 +1,127 @@
+"""Distributed checkpoint: sharded save/load with dedup + load-time reshard
+(reference: python/paddle/distributed/checkpoint/save_state_dict.py:145,
+load_state_dict.py, metadata.py)."""
+from __future__ import annotations
+
+import os
+import pickle
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ...core.tensor import Tensor
+
+__all__ = ["save_state_dict", "load_state_dict", "LocalTensorMetadata",
+           "Metadata"]
+
+
+@dataclass
+class LocalTensorMetadata:
+    """reference: checkpoint/metadata.py."""
+
+    global_offset: Tuple[int, ...]
+    local_shape: Tuple[int, ...]
+    dtype: str
+
+
+@dataclass
+class Metadata:
+    state_dict_metadata: Dict[str, List[LocalTensorMetadata]] = field(
+        default_factory=dict)
+    storage_metadata: Dict[str, str] = field(default_factory=dict)
+    flat_mapping: Dict[str, str] = field(default_factory=dict)
+
+
+def _local_view(t: Tensor):
+    """Return (local numpy array, global_offset, global_shape) for a
+    possibly-sharded tensor."""
+    import jax
+
+    data = t._data
+    if isinstance(data, jax.Array) and len(data.devices()) > 1:
+        # take this process's addressable shards
+        shards = [s for s in data.addressable_shards]
+        # single-controller: serialize shard 0's slice per device, dedup later
+        arrs = []
+        for s in shards:
+            idx = s.index
+            offset = tuple(sl.start or 0 for sl in idx)
+            arrs.append((np.asarray(s.data), offset))
+        return arrs, tuple(data.shape)
+    return [(np.asarray(data), (0,) * data.ndim)], tuple(data.shape)
+
+
+def save_state_dict(state_dict, path, process_group=None,
+                    coordinator_rank=0, unique_id=None, async_save=False):
+    """reference: save_state_dict.py:145 (dedup_tensor :117 — only the
+    owner rank writes each shard)."""
+    from ..parallel_env import get_rank
+
+    os.makedirs(path, exist_ok=True)
+    rank = get_rank()
+    meta = Metadata()
+    shards_payload = {}
+    for key, val in state_dict.items():
+        if not isinstance(val, Tensor):
+            shards_payload.setdefault("_objects", {})[key] = val
+            continue
+        locals_, gshape = _local_view(val)
+        metas = []
+        seen_offsets = set()
+        for arr, offset in locals_:
+            if offset in seen_offsets:
+                continue  # dedup replicated shards
+            seen_offsets.add(offset)
+            metas.append(LocalTensorMetadata(offset, tuple(arr.shape),
+                                             str(arr.dtype)))
+            shards_payload[f"{key}|{offset}"] = arr
+        meta.state_dict_metadata[key] = metas
+        meta.storage_metadata[key] = f"{rank}_0.distcp"
+    fname = os.path.join(path, f"{rank}_0.distcp")
+    with open(fname, "wb") as f:
+        pickle.dump(shards_payload, f, protocol=4)
+    if rank == coordinator_rank:
+        with open(os.path.join(path, "0.metadata"), "wb") as f:
+            pickle.dump(meta, f, protocol=4)
+
+
+def load_state_dict(state_dict, path, process_group=None,
+                    coordinator_rank=0, unique_id=None,
+                    offload=False):
+    """reference: load_state_dict.py — reads all shard files, reassembles
+    each tensor, reshards onto the target tensor's current sharding."""
+    import jax
+    import jax.numpy as jnp
+
+    files = [f for f in os.listdir(path) if f.endswith(".distcp")]
+    all_shards: Dict[str, list] = {}
+    objects = {}
+    for fn in files:
+        with open(os.path.join(path, fn), "rb") as f:
+            payload = pickle.load(f)
+        for k, v in payload.items():
+            if k == "_objects":
+                objects.update(v)
+                continue
+            name, offset = k.rsplit("|", 1)
+            all_shards.setdefault(name, []).append((eval(offset), v))
+    for key, t in state_dict.items():
+        if not isinstance(t, Tensor):
+            if key in objects:
+                state_dict[key] = objects[key]
+            continue
+        if key not in all_shards:
+            continue
+        shards = all_shards[key]
+        gshape = tuple(t.shape)
+        full = np.zeros(gshape, dtype=shards[0][1].dtype)
+        for offset, arr in shards:
+            slices = tuple(slice(o, o + s)
+                           for o, s in zip(offset, arr.shape))
+            full[slices] = arr
+        new = jnp.asarray(full).astype(t._data.dtype)
+        if isinstance(t._data, jax.Array) and hasattr(t._data, "sharding") \
+                and len(t._data.devices()) > 1:
+            new = jax.device_put(new, t._data.sharding)
+        t._data = new

@@ -1,0 +1,366 @@
+"""Python collective API (reference: python/paddle/distributed/communication/
++ collective.py — Group at communication/group.py:29, new_group at
+collective.py:194)."""
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+from ..core.tensor import Tensor
+from .process_group import ProcessGroup, ProcessGroupSingle, ReduceOp
+
+__all__ = ["Group", "ReduceOp", "new_group", "get_group", "all_reduce",
+           "all_gather", "all_gather_object", "all_to_all", "alltoall",
+           "broadcast", "broadcast_object_list", "reduce", "reduce_scatter",
+           "scatter", "scatter_object_list", "gather", "send", "recv",
+           "isend", "irecv", "barrier", "wait", "split_group",
+           "destroy_process_group", "batch_isend_irecv", "P2POp",
+           "get_backend", "stream"]
+
+_group_map = {}
+_next_gid = 1
+_default_group: Optional["Group"] = None
+
+
+class Group:
+    """reference: python/paddle/distributed/communication/group.py:29."""
+
+    def __init__(self, rank_in_group: int, gid: int, ranks: List[int],
+                 pg: Optional[ProcessGroup] = None, name=None):
+        self.rank = rank_in_group
+        self.id = gid
+        self.ranks = ranks
+        self.process_group = pg
+        self._name = name or f"group_{gid}"
+
+    @property
+    def nranks(self):
+        return len(self.ranks)
+
+    @property
+    def world_size(self):
+        return len(self.ranks)
+
+    @property
+    def name(self):
+        return self._name
+
+    def is_member(self) -> bool:
+        return self.rank >= 0
+
+    def get_group_rank(self, global_rank: int) -> int:
+        try:
+            return self.ranks.index(global_rank)
+        except ValueError:
+            return -1
+
+    def __repr__(self):
+        return f"Group(id={self.id}, ranks={self.ranks}, rank={self.rank})"
+
+
+def _register_default_group(pg: ProcessGroup, env) -> Group:
+    global _default_group
+    g = Group(env.rank, 0, list(range(env.world_size)), pg)
+    _default_group = g
+    _group_map[0] = g
+    return g
+
+
+def _get_default_group() -> Group:
+    global _default_group
+    if _default_group is None:
+        # lazy single-process default
+        from .parallel_env import ParallelEnv, init_parallel_env
+
+        env = ParallelEnv()
+        if env.world_size > 1:
+            init_parallel_env()
+        else:
+            _register_default_group(ProcessGroupSingle(0), env)
+    return _default_group
+
+
+def get_group(gid: int = 0) -> Optional[Group]:
+    if gid == 0:
+        return _get_default_group()
+    return _group_map.get(gid)
+
+
+def get_backend(group=None) -> str:
+    g = group or _get_default_group()
+    return type(g.process_group).__name__
+
+
+def new_group(ranks=None, backend=None, timeout=900) -> Group:
+    """reference: python/paddle/distributed/collective.py:194."""
+    global _next_gid
+    default = _get_default_group()
+    from .parallel_env import ParallelEnv
+
+    env = ParallelEnv()
+    if ranks is None:
+        ranks = list(range(env.world_size))
+    ranks = sorted(ranks)
+    gid = _next_gid
+    _next_gid += 1
+    my_rank = env.rank
+    if my_rank in ranks:
+        group_rank = ranks.index(my_rank)
+        if len(ranks) <= 1:
+            pg = ProcessGroupSingle(gid)
+        else:
+            from .process_group import new_process_group_impl
+            from .store import create_or_get_global_tcp_store
+
+            be = backend or os.environ.get("PADDLE_DIST_BACKEND", "cpu")
+            import jax
+
+            if not backend and jax.default_backend() == "tpu":
+                be = "xla"
+            store = create_or_get_global_tcp_store()
+            pg = new_process_group_impl(be, store, group_rank, len(ranks),
+                                        gid=gid, group_ranks=ranks)
+        g = Group(group_rank, gid, ranks, pg)
+    else:
+        g = Group(-1, gid, ranks, None)
+    _group_map[gid] = g
+    return g
+
+
+def split_group(parent=None, split_sizes=None, backend=None):
+    parent = parent or _get_default_group()
+    out = []
+    off = 0
+    for sz in split_sizes:
+        out.append(new_group(parent.ranks[off:off + sz], backend))
+        off += sz
+    return out
+
+
+def destroy_process_group(group=None):
+    global _default_group
+    if group is None:
+        _group_map.clear()
+        _default_group = None
+        import paddle_tpu.distributed.parallel_env as pe
+
+        pe._initialized = False
+        pe._default_group = None
+    else:
+        _group_map.pop(group.id, None)
+
+
+def _pg(group) -> ProcessGroup:
+    g = group or _get_default_group()
+    if g.process_group is None:
+        raise RuntimeError(f"rank is not a member of group {g.id}")
+    return g.process_group
+
+
+def _as_tensor(t):
+    return t if isinstance(t, Tensor) else Tensor(t)
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    return _pg(group).all_reduce(_as_tensor(tensor), op, sync_op)
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True):
+    return _pg(group).all_gather(tensor_list, _as_tensor(tensor), sync_op)
+
+
+def all_gather_object(object_list, obj, group=None):
+    import pickle
+
+    import numpy as np
+
+    payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+    g = group or _get_default_group()
+    # variable length: publish sizes first
+    size = Tensor(np.asarray([payload.size], dtype=np.int64))
+    sizes: List[Tensor] = []
+    _pg(group).all_gather(sizes, size)
+    maxlen = max(int(s.numpy()[0]) for s in sizes)
+    padded = np.zeros(maxlen, dtype=np.uint8)
+    padded[:payload.size] = payload
+    outs: List[Tensor] = []
+    _pg(group).all_gather(outs, Tensor(padded))
+    object_list.clear()
+    for s, o in zip(sizes, outs):
+        n = int(s.numpy()[0])
+        object_list.append(pickle.loads(o.numpy()[:n].tobytes()))
+
+
+def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    return _pg(group).all_to_all(out_tensor_list,
+                                 [_as_tensor(t) for t in in_tensor_list],
+                                 sync_op)
+
+
+alltoall = all_to_all
+
+
+def broadcast(tensor, src, group=None, sync_op=True):
+    return _pg(group).broadcast(_as_tensor(tensor), src, sync_op)
+
+
+def broadcast_object_list(object_list, src, group=None):
+    import pickle
+
+    import numpy as np
+
+    g = group or _get_default_group()
+    me = g.rank if g.ranks else 0
+    src_group_rank = g.get_group_rank(src) if src in g.ranks else src
+    if g.rank == src_group_rank:
+        payload = pickle.dumps(object_list)
+        size = Tensor(np.asarray([len(payload)], dtype=np.int64))
+    else:
+        size = Tensor(np.asarray([0], dtype=np.int64))
+    _pg(group).broadcast(size, src)
+    n = int(size.numpy()[0])
+    if g.rank == src_group_rank:
+        buf = Tensor(np.frombuffer(pickle.dumps(object_list), dtype=np.uint8))
+    else:
+        buf = Tensor(np.zeros(n, dtype=np.uint8))
+    _pg(group).broadcast(buf, src)
+    if g.rank != src_group_rank:
+        loaded = pickle.loads(buf.numpy().tobytes())
+        object_list.clear()
+        object_list.extend(loaded)
+
+
+def reduce(tensor, dst, op=ReduceOp.SUM, group=None, sync_op=True):
+    return _pg(group).reduce(_as_tensor(tensor), dst, op, sync_op)
+
+
+def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None,
+                   sync_op=True):
+    return _pg(group).reduce_scatter(_as_tensor(tensor),
+                                     [_as_tensor(t) for t in tensor_list],
+                                     op, sync_op)
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    return _pg(group).scatter(_as_tensor(tensor),
+                              [_as_tensor(t) for t in (tensor_list or [])],
+                              src, sync_op)
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src=0,
+                        group=None):
+    objs = [None]
+    if get_group_rank_safe(group) == src:
+        objs = list(in_object_list)
+    bc = [objs]
+    broadcast_object_list(bc, src, group)
+    g = group or _get_default_group()
+    out_object_list.clear()
+    out_object_list.append(bc[0][g.rank])
+
+
+def get_group_rank_safe(group):
+    g = group or _get_default_group()
+    return g.rank
+
+
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    return _pg(group).gather(_as_tensor(tensor), gather_list, dst, sync_op)
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    return _pg(group).send(_as_tensor(tensor), dst, sync_op)
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    return _pg(group).recv(_as_tensor(tensor), src, sync_op)
+
+
+def isend(tensor, dst, group=None):
+    return send(tensor, dst, group, sync_op=False)
+
+
+def irecv(tensor, src=None, group=None):
+    return recv(tensor, src, group, sync_op=False)
+
+
+class P2POp:
+    """reference: python/paddle/distributed/communication/batch_isend_irecv.py."""
+
+    def __init__(self, op, tensor, peer, group=None):
+        self.op = op
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+
+
+def batch_isend_irecv(p2p_op_list):
+    tasks = []
+    for op in p2p_op_list:
+        tasks.append(op.op(op.tensor, op.peer, op.group))
+    return tasks
+
+
+def barrier(group=None):
+    return _pg(group).barrier()
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    import jax
+
+    if isinstance(tensor, Tensor) and isinstance(tensor._data, jax.Array):
+        tensor._data.block_until_ready()
+
+
+class _StreamNamespace:
+    """paddle.distributed.stream.* parity (use_calc_stream variants map to
+    the same issue-ordered XLA stream)."""
+
+    @staticmethod
+    def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True,
+                   use_calc_stream=False):
+        return all_reduce(tensor, op, group, sync_op)
+
+    @staticmethod
+    def all_gather(tensor_or_list, tensor, group=None, sync_op=True,
+                   use_calc_stream=False):
+        if isinstance(tensor_or_list, list):
+            return all_gather(tensor_or_list, tensor, group, sync_op)
+        # tensor output variant: gather into one stacked tensor
+        outs: List[Tensor] = []
+        t = all_gather(outs, tensor, group, sync_op)
+        import jax.numpy as jnp
+
+        tensor_or_list._data = jnp.concatenate([o._data for o in outs], axis=0)
+        return t
+
+    @staticmethod
+    def reduce_scatter(tensor, tensor_or_list, op=ReduceOp.SUM, group=None,
+                       sync_op=True, use_calc_stream=False):
+        if isinstance(tensor_or_list, Tensor):
+            g = group or _get_default_group()
+            from ..ops.manipulation import split
+
+            tensor_or_list = split(tensor_or_list, g.nranks, axis=0)
+        return reduce_scatter(tensor, tensor_or_list, op, group, sync_op)
+
+    @staticmethod
+    def broadcast(tensor, src, group=None, sync_op=True,
+                  use_calc_stream=False):
+        return broadcast(tensor, src, group, sync_op)
+
+    @staticmethod
+    def send(tensor, dst=0, group=None, sync_op=True, use_calc_stream=False):
+        return send(tensor, dst, group, sync_op)
+
+    @staticmethod
+    def recv(tensor, src=0, group=None, sync_op=True, use_calc_stream=False):
+        return recv(tensor, src, group, sync_op)
+
+    @staticmethod
+    def alltoall(out_list, in_list, group=None, sync_op=True,
+                 use_calc_stream=False):
+        return all_to_all(out_list, in_list, group, sync_op)
+
+
+stream = _StreamNamespace()

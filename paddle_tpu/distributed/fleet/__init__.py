@@ -1,0 +1,62 @@
+"""paddle_tpu.distributed.fleet (reference: python/paddle/distributed/fleet/)."""
+from .distributed_strategy import DistributedStrategy  # noqa: F401
+from .fleet import (  # noqa: F401
+    Fleet,
+    barrier_worker,
+    distributed_model,
+    distributed_optimizer,
+    get_hybrid_communicate_group,
+    init,
+    is_first_worker,
+    worker_index,
+    worker_num,
+)
+from .topology import CommunicateTopology, HybridCommunicateGroup, ParallelMode  # noqa: F401
+from . import meta_parallel  # noqa: F401
+from . import recompute as _recompute_mod  # noqa: F401
+from .recompute import recompute, recompute_sequential  # noqa: F401
+from .mp_layers import (  # noqa: F401
+    ColumnParallelLinear,
+    ParallelCrossEntropy,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from .meta_parallel import (  # noqa: F401
+    LayerDesc,
+    PipelineLayer,
+    PipelineParallel,
+    SharedLayerDesc,
+    TensorParallel,
+)
+from .sharding_optimizer import (  # noqa: F401
+    DygraphShardingOptimizer,
+    GroupShardedOptimizerStage2,
+    GroupShardedStage2,
+    GroupShardedStage3,
+)
+from . import hybrid_parallel_util  # noqa: F401
+from .hybrid_parallel_optimizer import HybridParallelOptimizer  # noqa: F401
+
+# namespace parity: fleet.utils / fleet.layers.mpu / fleet.base
+from . import mp_layers as _mpu  # noqa: F401
+
+
+class _Utils:
+    hybrid_parallel_util = hybrid_parallel_util
+
+
+utils = _Utils()
+
+
+class _MPU:
+    VocabParallelEmbedding = VocabParallelEmbedding
+    ColumnParallelLinear = ColumnParallelLinear
+    RowParallelLinear = RowParallelLinear
+    ParallelCrossEntropy = ParallelCrossEntropy
+
+
+class _Layers:
+    mpu = _MPU()
+
+
+layers = _Layers()

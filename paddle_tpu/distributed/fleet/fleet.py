@@ -1,0 +1,176 @@
+"""Fleet facade (reference: python/paddle/distributed/fleet/fleet.py —
+init:218, _init_hybrid_parallel_env:674, distributed_model in fleet/model.py:32,
+distributed_optimizer in fleet/optimizer.py:68)."""
+from __future__ import annotations
+
+from typing import Optional
+
+from .distributed_strategy import DistributedStrategy
+from .topology import CommunicateTopology, HybridCommunicateGroup
+
+__all__ = ["init", "Fleet", "get_hybrid_communicate_group",
+           "distributed_model", "distributed_optimizer", "worker_num",
+           "worker_index", "is_first_worker", "barrier_worker"]
+
+_fleet: Optional["Fleet"] = None
+_hcg: Optional[HybridCommunicateGroup] = None
+
+
+def get_hybrid_communicate_group() -> Optional[HybridCommunicateGroup]:
+    return _hcg
+
+
+class Fleet:
+    def __init__(self):
+        self._is_collective = True
+        self._strategy: Optional[DistributedStrategy] = None
+        self._hcg: Optional[HybridCommunicateGroup] = None
+
+    def init(self, role_maker=None, is_collective=True, strategy=None,
+             log_level="INFO"):
+        global _hcg
+        from ..parallel_env import ParallelEnv, init_parallel_env
+
+        self._is_collective = is_collective
+        self._strategy = strategy or DistributedStrategy()
+        env = ParallelEnv()
+        if env.world_size > 1:
+            init_parallel_env()
+        self._init_hybrid_parallel_env()
+        _hcg = self._hcg
+        return self
+
+    def _init_hybrid_parallel_env(self):
+        """reference: fleet.py:674-737."""
+        hc = self._strategy.hybrid_configs
+        self.dp_degree = max(hc.get("dp_degree", 1), 1)
+        self.mp_degree = max(hc.get("mp_degree", 1), 1)
+        self.pp_degree = max(hc.get("pp_degree", 1), 1)
+        self.sharding_degree = max(hc.get("sharding_degree", 1), 1)
+        self.sep_degree = max(hc.get("sep_degree", 1), 1)
+        order = hc.get("order", ["dp", "pp", "sharding", "sep", "mp"])
+        name_map = {"dp": "data", "pp": "pipe", "sharding": "sharding",
+                    "sep": "sep", "mp": "model"}
+        degree_map = {"data": self.dp_degree, "pipe": self.pp_degree,
+                      "sharding": self.sharding_degree, "sep": self.sep_degree,
+                      "model": self.mp_degree}
+        names = [name_map[o] for o in order]
+        dims = [degree_map[n] for n in names]
+
+        from ..parallel_env import ParallelEnv
+
+        world = ParallelEnv().world_size
+        prod = 1
+        for d in dims:
+            prod *= d
+        if prod != world:
+            # auto-fill dp like the reference when degrees don't multiply out
+            rest = world // max(prod // max(self.dp_degree, 1), 1)
+            if "data" in names and prod != world and world % (
+                    prod // self.dp_degree) == 0:
+                self.dp_degree = world // (prod // self.dp_degree)
+                dims[names.index("data")] = self.dp_degree
+        self._topology = CommunicateTopology(names, dims)
+        self._hcg = HybridCommunicateGroup(self._topology)
+
+    @property
+    def strategy(self):
+        return self._strategy
+
+    def get_hybrid_communicate_group(self):
+        return self._hcg
+
+    def worker_num(self):
+        from ..parallel_env import ParallelEnv
+
+        return ParallelEnv().world_size
+
+    def worker_index(self):
+        from ..parallel_env import ParallelEnv
+
+        return ParallelEnv().rank
+
+    def is_first_worker(self):
+        return self.worker_index() == 0
+
+    def barrier_worker(self):
+        from ..collective import barrier
+
+        barrier()
+
+    def distributed_model(self, model):
+        """reference: fleet/model.py:32 — wrap by parallel mode."""
+        from .meta_parallel import (PipelineParallel, ShardingParallel,
+                                    TensorParallel)
+        from .topology import ParallelMode
+        from ..parallel import DataParallel
+
+        if self._hcg is None:
+            return model
+        mode = self._hcg.get_parallel_mode()
+        if mode == ParallelMode.PIPELINE_PARALLEL:
+            return PipelineParallel(model, self._hcg,
+                                    strategy=self._strategy)
+        if mode == ParallelMode.TENSOR_PARALLEL:
+            return TensorParallel(model, self._hcg, strategy=self._strategy)
+        if mode == ParallelMode.SHARDING_PARALLEL:
+            return ShardingParallel(model, self._hcg,
+                                    strategy=self._strategy)
+        if self._hcg.get_data_parallel_world_size() > 1:
+            return DataParallel(
+                model, group=self._hcg.get_data_parallel_group())
+        return model
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        """reference: fleet/optimizer.py:68."""
+        from .hybrid_parallel_optimizer import HybridParallelOptimizer
+
+        if self._hcg is None:
+            return optimizer
+        return HybridParallelOptimizer(optimizer, self._hcg,
+                                       self._strategy)
+
+    # state io passthroughs
+    def save(self, *args, **kwargs):
+        from ...framework.io_utils import save as _save
+
+        return _save(*args, **kwargs)
+
+
+def init(role_maker=None, is_collective=True, strategy=None,
+         log_level="INFO"):
+    global _fleet
+    if _fleet is None:
+        _fleet = Fleet()
+    return _fleet.init(role_maker, is_collective, strategy, log_level)
+
+
+def _get_fleet() -> Fleet:
+    global _fleet
+    if _fleet is None:
+        _fleet = Fleet()
+    return _fleet
+
+
+def distributed_model(model):
+    return _get_fleet().distributed_model(model)
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    return _get_fleet().distributed_optimizer(optimizer, strategy)
+
+
+def worker_num():
+    return _get_fleet().worker_num()
+
+
+def worker_index():
+    return _get_fleet().worker_index()
+
+
+def is_first_worker():
+    return _get_fleet().is_first_worker()
+
+
+def barrier_worker():
+    return _get_fleet().barrier_worker()

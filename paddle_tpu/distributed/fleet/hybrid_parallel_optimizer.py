@@ -1,0 +1,101 @@
+"""HybridParallelOptimizer (reference:
+fleet/meta_optimizers/dygraph_optimizer/hybrid_parallel_optimizer.py —
+hybrid-aware global-norm clip :103, _insert_sync :373, step :525)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...nn.clip import ClipGradByGlobalNorm
+from .. import collective as dist
+
+__all__ = ["HybridParallelOptimizer", "HybridParallelGradScaler"]
+
+
+class HybridParallelOptimizer:
+    def __init__(self, optimizer, hcg, strategy=None):
+        self._inner_opt = optimizer
+        self._hcg = hcg
+        self._strategy = strategy
+        self._sharding_enable = hcg.get_sharding_parallel_world_size() > 1
+        # wrap global-norm clip with the cross-group norm reduction
+        clip = getattr(optimizer, "_grad_clip", None)
+        if isinstance(clip, ClipGradByGlobalNorm):
+            optimizer._grad_clip = _HybridClip(clip, hcg)
+
+    def __getattr__(self, item):
+        return getattr(self._inner_opt, item)
+
+    def step(self):
+        if self._sharding_enable:
+            from .sharding_optimizer import DygraphShardingOptimizer
+
+            if not isinstance(self._inner_opt, DygraphShardingOptimizer):
+                # shard on first use
+                self._inner_opt = DygraphShardingOptimizer(
+                    self._inner_opt, self._hcg)
+        # mp: sync params that are replicated across mp (non-distributed)
+        self._inner_opt.step()
+
+    def clear_grad(self, set_to_zero=False):
+        self._inner_opt.clear_grad(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, *args, **kwargs):
+        loss.backward()
+        self.step()
+        return None, None
+
+
+class _HybridClip:
+    """Global-norm clip whose squared-norm is all-reduced across mp/pp/
+    sharding groups so every rank clips by the TRUE global norm
+    (reference: hybrid_parallel_optimizer.py:103 _dygraph_clip)."""
+
+    def __init__(self, clip: ClipGradByGlobalNorm, hcg):
+        self._clip = clip
+        self._hcg = hcg
+
+    def __call__(self, params_grads):
+        # local sq-norm of distributed (mp-sharded) params needs reduction
+        # across mp; non-distributed params are identical on mp ranks.
+        dist_sq = jnp.zeros((), jnp.float32)
+        rep_sq = jnp.zeros((), jnp.float32)
+        for p, g in params_grads:
+            if g is None:
+                continue
+            s = jnp.sum(g._data.astype(jnp.float32) ** 2)
+            if getattr(p, "is_distributed", False):
+                dist_sq = dist_sq + s
+            else:
+                rep_sq = rep_sq + s
+        hcg = self._hcg
+        total_dist = Tensor(dist_sq)
+        if hcg.get_model_parallel_world_size() > 1:
+            dist.all_reduce(total_dist, group=hcg.get_model_parallel_group())
+        total = Tensor(total_dist._data + rep_sq)
+        if hcg.get_pipe_parallel_world_size() > 1:
+            dist.all_reduce(total, group=hcg.get_pipe_parallel_group())
+        if hcg.get_sharding_parallel_world_size() > 1:
+            dist.all_reduce(total, group=hcg.get_sharding_parallel_group())
+        gnorm = jnp.sqrt(total._data)
+        scale = jnp.minimum(self._clip.clip_norm / jnp.maximum(gnorm, 1e-12),
+                            1.0)
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+            else:
+                out.append((p, Tensor((g._data * scale).astype(
+                    g._data.dtype))))
+        return out
+
+
+class HybridParallelGradScaler:
+    def __init__(self, scaler, hcg):
+        self._scaler = scaler
+        self._hcg = hcg
+
+    def __getattr__(self, item):
+        return getattr(self._scaler, item)

@@ -1,0 +1,94 @@
+"""Gradient sync helpers (reference:
+python/paddle/distributed/fleet/utils/hybrid_parallel_util.py —
+fused_allreduce_gradients:249, param broadcast :287)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ...core.tensor import Tensor
+from .. import collective as dist
+
+__all__ = ["fused_allreduce_gradients", "broadcast_dp_parameters",
+           "broadcast_mp_parameters", "broadcast_sharding_parameters",
+           "fused_allreduce_gradients_with_group"]
+
+_FUSE_BYTES = 128 * 1024 * 1024  # bucket size for fused all-reduce
+
+
+def fused_allreduce_gradients_with_group(params, group, scale=None,
+                                         bucket_bytes=_FUSE_BYTES):
+    """Bucketed gradient all-reduce: flatten grads into contiguous buffers
+    per dtype up to bucket_bytes, one all-reduce per bucket (the eager
+    reducer algorithm, reference: collective/reducer.cc FusedAllReduce)."""
+    import jax.numpy as jnp
+
+    nranks = group.nranks if group is not None else 1
+    if nranks <= 1:
+        return
+    grads = [(p, p._grad) for p in params
+             if p._grad is not None and not getattr(p, "is_distributed",
+                                                    False)]
+    buckets = {}
+    for p, g in grads:
+        key = str(g._data.dtype)
+        buckets.setdefault(key, []).append((p, g))
+    for key, items in buckets.items():
+        cur, cur_bytes = [], 0
+        flush_list = []
+        for p, g in items:
+            nbytes = g.size * g.dtype.itemsize
+            cur.append((p, g))
+            cur_bytes += nbytes
+            if cur_bytes >= bucket_bytes:
+                flush_list.append(cur)
+                cur, cur_bytes = [], 0
+        if cur:
+            flush_list.append(cur)
+        for bucket in flush_list:
+            flat = jnp.concatenate(
+                [b[1]._data.reshape(-1) for b in bucket])
+            t = Tensor(flat)
+            dist.all_reduce(t, group=group)
+            inv = 1.0 / nranks
+            out = t._data * inv
+            off = 0
+            for p, g in bucket:
+                n = g.size
+                g._data = out[off:off + n].reshape(g._data.shape).astype(
+                    g._data.dtype)
+                off += n
+
+
+def fused_allreduce_gradients(parameter_list, hcg):
+    """reference: hybrid_parallel_util.py:249 — all-reduce over dp (or fused
+    dp×sep) group."""
+    group = None
+    if hcg is not None:
+        if hcg.get_sep_parallel_world_size() > 1:
+            group = hcg.get_dp_sep_parallel_group()
+        elif hcg.get_data_parallel_world_size() > 1:
+            group = hcg.get_data_parallel_group()
+    if group is None:
+        return
+    fused_allreduce_gradients_with_group(parameter_list, group)
+
+
+def broadcast_dp_parameters(model, hcg):
+    from .meta_parallel import _broadcast_parameters
+
+    _broadcast_parameters(model, hcg.get_data_parallel_group(),
+                          hcg.get_data_parallel_group_src_rank())
+
+
+def broadcast_mp_parameters(model, hcg):
+    from .meta_parallel import _broadcast_parameters
+
+    _broadcast_parameters(model, hcg.get_model_parallel_group(),
+                          hcg.get_model_parallel_group_src_rank())
+
+
+def broadcast_sharding_parameters(model, hcg):
+    from .meta_parallel import _broadcast_parameters
+
+    _broadcast_parameters(model, hcg.get_sharding_parallel_group(),
+                          hcg.get_sharding_parallel_group_src_rank())

@@ -1,0 +1,348 @@
+"""Meta-parallel model wrappers + pipeline engine (reference:
+python/paddle/distributed/fleet/meta_parallel/ — TensorParallel
+tensor_parallel.py, PipelineLayer parallel_layers/pp_layers.py:258,
+PipelineParallel pipeline_parallel.py:255, 1F1B at :575).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ... import nn
+from ...core.tensor import Tensor
+from .. import collective as dist
+
+__all__ = ["TensorParallel", "ShardingParallel", "SegmentParallel",
+           "LayerDesc", "SharedLayerDesc", "PipelineLayer",
+           "PipelineParallel"]
+
+
+def _broadcast_parameters(model, group, src_rank):
+    for p in model.parameters():
+        if getattr(p, "is_distributed", False):
+            continue
+        dist.broadcast(p, src_rank, group=group)
+
+
+class _MetaParallelBase(nn.Layer):
+    def __init__(self, layers, hcg, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+        self._strategy = strategy
+        self._prepare_for_model()
+
+    def _prepare_for_model(self):
+        pass
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)
+
+
+class TensorParallel(_MetaParallelBase):
+    """Broadcast non-distributed params across mp group (reference:
+    meta_parallel/tensor_parallel.py)."""
+
+    def _prepare_for_model(self):
+        hcg = self._hcg
+        if hcg.get_model_parallel_world_size() > 1:
+            _broadcast_parameters(
+                self._layers, hcg.get_model_parallel_group(),
+                hcg.get_model_parallel_group_src_rank())
+        if hcg.get_data_parallel_world_size() > 1:
+            _broadcast_parameters(
+                self._layers, hcg.get_data_parallel_group(),
+                hcg.get_data_parallel_group_src_rank())
+
+
+class ShardingParallel(_MetaParallelBase):
+    def _prepare_for_model(self):
+        hcg = self._hcg
+        if hcg.get_sharding_parallel_world_size() > 1:
+            _broadcast_parameters(
+                self._layers, hcg.get_sharding_parallel_group(),
+                hcg.get_sharding_parallel_group_src_rank())
+
+
+class SegmentParallel(_MetaParallelBase):
+    """sep wrapper (reference: meta_parallel/segment_parallel.py:26):
+    param broadcast across sep; attention-side seq exchange is done by the
+    model via the provided all_to_all primitives."""
+
+    def _prepare_for_model(self):
+        hcg = self._hcg
+        if hcg.get_sep_parallel_world_size() > 1:
+            _broadcast_parameters(
+                self._layers, hcg.get_sep_parallel_group(),
+                hcg._sep_group[0])
+
+
+class LayerDesc:
+    """reference: parallel_layers/pp_layers.py LayerDesc."""
+
+    def __init__(self, layer_cls, *inputs, **kwargs):
+        self.layer_cls = layer_cls
+        self.inputs = inputs
+        self.kwargs = kwargs
+
+    def build_layer(self):
+        return self.layer_cls(*self.inputs, **self.kwargs)
+
+
+class SharedLayerDesc(LayerDesc):
+    def __init__(self, key, layer_cls, forward_func=None,
+                 shared_weight_attr="weight", *inputs, **kwargs):
+        super().__init__(layer_cls, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class PipelineLayer(nn.Layer):
+    """Stage-partitioned sequential model (reference: pp_layers.py:258).
+
+    Build with a list of LayerDesc (or Layers); segmentation assigns a
+    contiguous slice of layers per pp stage (uniform by count, like the
+    reference's default seg_method="uniform")."""
+
+    def __init__(self, layers, num_stages=None, topology=None,
+                 loss_fn=None, seg_method="uniform", recompute_interval=0,
+                 recompute_ctx=None, num_virtual_pipeline_stages=None):
+        super().__init__()
+        from .fleet import get_hybrid_communicate_group
+
+        self._hcg = get_hybrid_communicate_group()
+        self._loss_fn = loss_fn
+        self._num_stages = num_stages or (
+            self._hcg.get_pipe_parallel_world_size() if self._hcg else 1)
+        self._stage_id = (self._hcg.get_stage_id() if self._hcg else 0)
+        self._recompute_interval = recompute_interval
+        self.descs = list(layers)
+
+        n = len(self.descs)
+        per = [n // self._num_stages] * self._num_stages
+        for i in range(n % self._num_stages):
+            per[i] += 1
+        starts = np.cumsum([0] + per)
+        self.segment_parts = starts.tolist()
+        self._start = int(starts[self._stage_id])
+        self._end = int(starts[self._stage_id + 1])
+
+        built = []
+        for i in range(self._start, self._end):
+            d = self.descs[i]
+            built.append(d.build_layer() if isinstance(d, LayerDesc) else d)
+        self.run_function = nn.LayerList(built)
+
+    def get_stage_from_index(self, layer_idx):
+        for s in range(self._num_stages):
+            if self.segment_parts[s] <= layer_idx < self.segment_parts[s + 1]:
+                return s
+        return self._num_stages - 1
+
+    def forward(self, x):
+        for i, layer in enumerate(self.run_function):
+            if self._recompute_interval > 0 and \
+                    i % self._recompute_interval == 0 and self.training:
+                from .recompute import recompute
+
+                x = recompute(layer, x)
+            else:
+                x = layer(x)
+        return x
+
+
+class PipelineParallel(_MetaParallelBase):
+    """1F1B micro-batch schedule over p2p send/recv
+    (reference: pipeline_parallel.py:255; forward_backward_pipeline:575 —
+    startup/steady/cooldown phases; p2p via SendRecvMeta handshake,
+    pp_utils/p2p_communication.py:52)."""
+
+    def __init__(self, layers, hcg, strategy=None):
+        if not isinstance(layers, PipelineLayer):
+            raise TypeError("PipelineParallel expects a PipelineLayer")
+        super().__init__(layers, hcg, strategy)
+        self.num_stages = hcg.get_pipe_parallel_world_size()
+        self.stage_id = hcg.get_stage_id()
+        self.pp_group = hcg.get_pipe_parallel_group()
+        self.prev_rank = hcg.get_p2p_prev_rank()
+        self.next_rank = hcg.get_p2p_next_rank()
+        self.is_first = hcg.is_first_stage()
+        self.is_last = hcg.is_last_stage()
+        cfg = (strategy.pipeline_configs if strategy is not None else
+               {"accumulate_steps": 1})
+        self.accumulate_steps = cfg.get("accumulate_steps", 1)
+        self._send_meta_known = False
+        self._recv_shape = None
+        self._recv_dtype = None
+
+    def _prepare_for_model(self):
+        hcg = self._hcg
+        if hcg.get_data_parallel_world_size() > 1:
+            _broadcast_parameters(
+                self._layers, hcg.get_data_parallel_group(),
+                hcg.get_data_parallel_group_src_rank())
+
+    # ---------------------------------------------------------------- p2p
+    def _send_tensor(self, t: Tensor, dst):
+        import pickle
+
+        if not self._send_meta_known:
+            # SendRecvMeta handshake: ship (shape, dtype) once, then cache
+            meta = pickle.dumps((tuple(t.shape), str(t._data.dtype)))
+            meta_arr = np.frombuffer(meta, dtype=np.uint8)
+            # fixed-size header
+            hdr = np.zeros(8, dtype=np.int64)
+            hdr[0] = meta_arr.size
+            dist.send(Tensor(hdr), dst, group=self.pp_group)
+            pad = np.zeros(4096, dtype=np.uint8)
+            pad[:meta_arr.size] = meta_arr
+            dist.send(Tensor(pad), dst, group=self.pp_group)
+            self._send_meta_known = True
+        dist.send(t, dst, group=self.pp_group)
+
+    def _recv_tensor(self, src) -> Tensor:
+        import pickle
+
+        if self._recv_shape is None:
+            hdr = Tensor(np.zeros(8, dtype=np.int64))
+            dist.recv(hdr, src, group=self.pp_group)
+            n = int(hdr.numpy()[0])
+            pad = Tensor(np.zeros(4096, dtype=np.uint8))
+            dist.recv(pad, src, group=self.pp_group)
+            shape, dtype = pickle.loads(pad.numpy()[:n].tobytes())
+            self._recv_shape, self._recv_dtype = shape, dtype
+        buf = Tensor(np.zeros(self._recv_shape,
+                              dtype=np.dtype(self._recv_dtype)
+                              if self._recv_dtype != "bfloat16"
+                              else np.float32))
+        dist.recv(buf, src, group=self.pp_group)
+        buf.stop_gradient = False
+        return buf
+
+    # ---------------------------------------------------------- schedule
+    def forward_backward_pipeline(self, data, scaler=None):
+        """1F1B (reference: pipeline_parallel.py:575)."""
+        num_micro = self.accumulate_steps
+        num_warmup = min(self.num_stages - self.stage_id - 1, num_micro)
+        num_steady = num_micro - num_warmup
+
+        micro_inputs = self._split_micro(data, num_micro)
+        input_buffers: List[Optional[Tensor]] = []
+        output_buffers: List[Optional[Tensor]] = []
+        losses = []
+
+        def fwd_step(i):
+            if self.is_first:
+                x = micro_inputs[i][0] if micro_inputs else None
+            else:
+                x = self._recv_tensor(self.prev_rank)
+            out = self._layers.forward(x)
+            if self.is_last:
+                loss_fn = self._layers._loss_fn
+                if loss_fn is not None and micro_inputs:
+                    label = micro_inputs[i][1]
+                    loss = loss_fn(out, label)
+                else:
+                    loss = out
+                if scaler is not None:
+                    loss = scaler.scale(loss)
+                loss = loss / num_micro
+                losses.append(loss)
+                output_buffers.append(loss)
+            else:
+                self._send_tensor(out.detach(), self.next_rank)
+                output_buffers.append(out)
+            input_buffers.append(x)
+
+        def bwd_step(i):
+            out = output_buffers[i]
+            if self.is_last:
+                out.backward()
+            else:
+                grad = self._recv_tensor(self.next_rank)
+                out.backward(grad)
+            x = input_buffers[i]
+            if not self.is_first and x is not None and x.grad is not None:
+                self._send_tensor(x.grad, self.prev_rank)
+
+        fwd_i = 0
+        bwd_i = 0
+        for _ in range(num_warmup):
+            fwd_step(fwd_i)
+            fwd_i += 1
+        for _ in range(num_steady):
+            fwd_step(fwd_i)
+            fwd_i += 1
+            bwd_step(bwd_i)
+            bwd_i += 1
+        while bwd_i < num_micro:
+            bwd_step(bwd_i)
+            bwd_i += 1
+
+        if self.is_last and losses:
+            total = losses[0]
+            for l in losses[1:]:
+                total = total + l
+            return total.detach()
+        return None
+
+    def _split_micro(self, data, num_micro):
+        if data is None:
+            return []
+        from ...ops.manipulation import split as top_split
+
+        if isinstance(data, (tuple, list)):
+            xs = top_split(data[0], num_micro, axis=0) \
+                if data[0] is not None else [None] * num_micro
+            ys = top_split(data[1], num_micro, axis=0) \
+                if len(data) > 1 and data[1] is not None \
+                else [None] * num_micro
+            return list(zip(xs, ys))
+        xs = top_split(data, num_micro, axis=0)
+        return [(x, None) for x in xs]
+
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+        """reference: pipeline_parallel.py:820."""
+        self._layers.train()
+        loss = self.forward_backward_pipeline(data, scaler)
+        # dp gradient sync
+        hcg = self._hcg
+        if hcg.get_data_parallel_world_size() > 1:
+            from .hybrid_parallel_util import fused_allreduce_gradients
+
+            fused_allreduce_gradients(
+                list(self._layers.parameters()),
+                hcg)
+        if scaler is not None:
+            scaler.step(optimizer)
+            scaler.update()
+        else:
+            optimizer.step()
+        optimizer.clear_grad()
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        return loss
+
+    def eval_batch(self, data, compute_loss=True):
+        self._layers.eval()
+        from ...core.autograd import no_grad
+
+        with no_grad():
+            return self.forward_backward_pipeline(data)
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)

@@ -1,0 +1,242 @@
+"""Megatron-style tensor-parallel layers (reference:
+python/paddle/distributed/fleet/layers/mpu/mp_layers.py —
+VocabParallelEmbedding:49, ColumnParallelLinear:336, RowParallelLinear:543,
+ParallelCrossEntropy:744; identity/allreduce PyLayers in mpu/mp_ops.py).
+
+Eager backend-agnostic implementation over the collective API; the jitted
+SPMD path (models/gpt.py) expresses the same math with shardings and lets
+GSPMD place the collectives on ICI.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ... import nn
+from ...autograd import PyLayer
+from ...core.tensor import Tensor
+from ...nn import functional as F
+from .. import collective as dist
+
+__all__ = ["VocabParallelEmbedding", "ColumnParallelLinear",
+           "RowParallelLinear", "ParallelCrossEntropy"]
+
+
+class _IdentityInBackwardAllReduce(PyLayer):
+    """f: identity fwd, all-reduce bwd (mp_ops.py _c_identity)."""
+
+    @staticmethod
+    def forward(ctx, x, group):
+        ctx.group = group
+        return Tensor(x._data)
+
+    @staticmethod
+    def backward(ctx, dy):
+        g = Tensor(dy._data)
+        dist.all_reduce(g, group=ctx.group)
+        return g
+
+
+class _AllReduceInForward(PyLayer):
+    """g: all-reduce fwd, identity bwd (mp_ops.py _mp_allreduce)."""
+
+    @staticmethod
+    def forward(ctx, x, group):
+        out = Tensor(x._data)
+        dist.all_reduce(out, group=group)
+        ctx.group = group
+        return out
+
+    @staticmethod
+    def backward(ctx, dy):
+        return Tensor(dy._data)
+
+
+class _GatherConcat(PyLayer):
+    """all-gather + concat fwd; take-own-slice bwd (Megatron gather;
+    mp_ops.py _c_concat semantics)."""
+
+    @staticmethod
+    def forward(ctx, x, group):
+        outs = []
+        dist.all_gather(outs, Tensor(x._data), group=group)
+        ctx.rank = group.rank
+        ctx.nranks = group.nranks
+        return Tensor(jnp.concatenate([o._data for o in outs], axis=-1))
+
+    @staticmethod
+    def backward(ctx, dy):
+        parts = jnp.split(dy._data, ctx.nranks, axis=-1)
+        return Tensor(parts[ctx.rank])
+
+
+class VocabParallelEmbedding(nn.Layer):
+    """Embedding with the vocab dim split over the mp group."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 mp_group=None, name=None):
+        super().__init__()
+        from .fleet import get_hybrid_communicate_group
+
+        hcg = get_hybrid_communicate_group()
+        self.group = mp_group if mp_group is not None else \
+            (hcg.get_model_parallel_group() if hcg else None)
+        self.world_size = self.group.nranks if self.group else 1
+        self.rank = self.group.rank if self.group else 0
+        self.origin_num_embeddings = num_embeddings
+        assert num_embeddings % self.world_size == 0
+        self.per_part_size = num_embeddings // self.world_size
+        self.vocab_start_index = self.rank * self.per_part_size
+        self.weight = self.create_parameter(
+            [self.per_part_size, embedding_dim], attr=weight_attr,
+            default_initializer=nn.initializer.XavierNormal())
+        self.weight.is_distributed = self.world_size > 1
+
+    def forward(self, x):
+        if self.world_size <= 1:
+            return F.embedding(x, self.weight)
+        start = self.vocab_start_index
+        end = start + self.per_part_size
+        from ...ops._helpers import as_tensor, run_op, unwrap
+
+        idx = unwrap(as_tensor(x))
+        mask = (idx >= start) & (idx < end)
+        local_idx = jnp.where(mask, idx - start, 0)
+
+        def fn(w):
+            out = jnp.take(w, local_idx, axis=0)
+            return jnp.where(mask[..., None], out, 0.0)
+
+        out = run_op(fn, [self.weight], name="vocab_parallel_embedding")
+        out = _AllReduceInForward.apply(out, self.group)
+        return out
+
+
+class ColumnParallelLinear(nn.Layer):
+    """W [in, out/mp]; optional gather of outputs (mp_layers.py:336)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=None, gather_output=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        from .fleet import get_hybrid_communicate_group
+
+        hcg = get_hybrid_communicate_group()
+        self.group = mp_group if mp_group is not None else \
+            (hcg.get_model_parallel_group() if hcg else None)
+        self.world_size = self.group.nranks if self.group else 1
+        self.gather_output = gather_output
+        assert out_features % self.world_size == 0
+        self.out_per_part = out_features // self.world_size
+        self.weight = self.create_parameter(
+            [in_features, self.out_per_part], attr=weight_attr,
+            default_initializer=nn.initializer.XavierNormal())
+        self.weight.is_distributed = self.world_size > 1
+        if has_bias:
+            self.bias = self.create_parameter(
+                [self.out_per_part], is_bias=True)
+            self.bias.is_distributed = self.world_size > 1
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        if self.world_size > 1:
+            x = _IdentityInBackwardAllReduce.apply(x, self.group)
+        out = F.linear(x, self.weight, self.bias)
+        if self.gather_output and self.world_size > 1:
+            out = _GatherConcat.apply(out, self.group)
+        return out
+
+
+class RowParallelLinear(nn.Layer):
+    """W [in/mp, out]; input either already split or split here
+    (mp_layers.py:543)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=False,
+                 fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        from .fleet import get_hybrid_communicate_group
+
+        hcg = get_hybrid_communicate_group()
+        self.group = mp_group if mp_group is not None else \
+            (hcg.get_model_parallel_group() if hcg else None)
+        self.world_size = self.group.nranks if self.group else 1
+        self.rank = self.group.rank if self.group else 0
+        self.input_is_parallel = input_is_parallel
+        assert in_features % self.world_size == 0
+        self.in_per_part = in_features // self.world_size
+        self.weight = self.create_parameter(
+            [self.in_per_part, out_features], attr=weight_attr,
+            default_initializer=nn.initializer.XavierNormal())
+        self.weight.is_distributed = self.world_size > 1
+        if has_bias:
+            self.bias = self.create_parameter([out_features], is_bias=True)
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        if self.world_size <= 1:
+            return F.linear(x, self.weight, self.bias)
+        if not self.input_is_parallel:
+            from ...ops.manipulation import split
+
+            x = split(x, self.world_size, axis=-1)[self.rank]
+        out = F.linear(x, self.weight, None)
+        out = _AllReduceInForward.apply(out, self.group)
+        if self.bias is not None:
+            out = out + self.bias
+        return out
+
+
+class ParallelCrossEntropy(nn.Layer):
+    """CE over vocab-split logits (mp_layers.py:744): max/subtract, local
+    exp-sum, all-reduce sums, local pick of target logit."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        from .fleet import get_hybrid_communicate_group
+
+        hcg = get_hybrid_communicate_group()
+        self.group = mp_group if mp_group is not None else \
+            (hcg.get_model_parallel_group() if hcg else None)
+        self.world_size = self.group.nranks if self.group else 1
+        self.rank = self.group.rank if self.group else 0
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):
+        if self.world_size <= 1:
+            return F.cross_entropy(input, label, reduction="none",
+                                   ignore_index=self.ignore_index)
+        # local stats
+        from ...ops._helpers import as_tensor, run_op, unwrap
+
+        x = as_tensor(input)
+        lab = unwrap(as_tensor(label))
+        vocab_per = x.shape[-1]
+        start = self.rank * vocab_per
+
+        local_max = Tensor(jnp.max(x._data, axis=-1))
+        dist.all_reduce(local_max, op=dist.ReduceOp.MAX, group=self.group)
+        gmax = local_max._data
+
+        def sumexp_fn(a):
+            return jnp.sum(jnp.exp(a - gmax[..., None]), axis=-1)
+
+        sumexp = run_op(sumexp_fn, [x], name="pce_sumexp")
+        sumexp = _AllReduceInForward.apply(sumexp, self.group)
+
+        def pick_fn(a):
+            li = lab
+            if li.ndim == a.ndim:
+                li = jnp.squeeze(li, -1)
+            inrange = (li >= start) & (li < start + vocab_per)
+            safe = jnp.where(inrange, li - start, 0)
+            picked = jnp.take_along_axis(
+                a, safe[..., None], axis=-1)[..., 0]
+            return jnp.where(inrange, picked - gmax, 0.0)
+
+        picked = run_op(pick_fn, [x], name="pce_pick")
+        picked = _AllReduceInForward.apply(picked, self.group)
+        loss = run_op(lambda s, p: jnp.log(s) - p,
+                      [sumexp, picked], name="pce_loss")
+        return loss

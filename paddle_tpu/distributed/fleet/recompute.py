@@ -1,0 +1,89 @@
+"""Activation recompute (reference:
+python/paddle/distributed/fleet/recompute/recompute.py:124 RecomputeFunction).
+
+TPU-native: jax.checkpoint (rematerialization) IS this feature inside jit;
+the eager path re-runs the function under the saved RNG state in backward —
+same contract as the reference PyLayer."""
+from __future__ import annotations
+
+from ...autograd.py_layer import PyLayer
+from ...core import random as _rng
+from ...core.autograd import no_grad
+from ...core.tensor import Tensor
+
+__all__ = ["recompute", "recompute_sequential"]
+
+
+class _RecomputeFunction(PyLayer):
+    @staticmethod
+    def forward(ctx, run_function, preserve_rng_state, *args):
+        ctx.run_function = run_function
+        ctx.preserve_rng = preserve_rng_state
+        ctx.rng_state = _rng.get_rng_state()
+        ctx.inputs = [a.detach() if isinstance(a, Tensor) else a
+                      for a in args]
+        for orig, det in zip(args, ctx.inputs):
+            if isinstance(orig, Tensor):
+                det.stop_gradient = orig.stop_gradient
+        with no_grad():
+            out = run_function(*ctx.inputs)
+        return out
+
+    @staticmethod
+    def backward(ctx, *grads):
+        from ...core.autograd import backward as run_backward
+
+        saved_state = _rng.get_rng_state()
+        if ctx.preserve_rng:
+            _rng.set_rng_state(ctx.rng_state)
+        try:
+            inputs = [Tensor(a._data, stop_gradient=a.stop_gradient)
+                      if isinstance(a, Tensor) else a for a in ctx.inputs]
+            # re-run forward WITH grad to rebuild the local tape
+            out = ctx.run_function(*inputs)
+        finally:
+            if ctx.preserve_rng:
+                _rng.set_rng_state(saved_state)
+        outs = out if isinstance(out, (tuple, list)) else (out,)
+        run_backward(list(outs), list(grads))
+        # PyLayer contract: one grad per *tensor* input, in order
+        return tuple(t._grad if t._grad is not None else None
+                     for t in inputs if isinstance(t, Tensor))
+
+
+def recompute(function, *args, **kwargs):
+    """reference: recompute.py:124. kwargs: preserve_rng_state, use_reentrant."""
+    preserve = kwargs.pop("preserve_rng_state", True)
+    kwargs.pop("use_reentrant", None)
+    if kwargs:
+        fn = lambda *a: function(*a, **kwargs)  # noqa: E731
+    else:
+        fn = function
+    return _RecomputeFunction.apply(fn, preserve, *args)
+
+
+def recompute_sequential(ctx, functions, *args):
+    segments = ctx.get("segments", 1) if isinstance(ctx, dict) else 1
+    if isinstance(functions, (list, tuple)):
+        layers = list(functions)
+    else:
+        layers = list(functions)
+    n = len(layers)
+    per = max(n // segments, 1)
+    x = args[0] if len(args) == 1 else args
+
+    def run_segment(start, end):
+        def seg_fn(inp):
+            out = inp
+            for l in layers[start:end]:
+                out = l(out)
+            return out
+
+        return seg_fn
+
+    i = 0
+    while i < n:
+        end = min(i + per, n)
+        x = recompute(run_segment(i, end), x)
+        i = end
+    return x

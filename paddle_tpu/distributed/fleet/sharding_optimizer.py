@@ -1,0 +1,271 @@
+"""ZeRO sharding (reference:
+fleet/meta_optimizers/dygraph_optimizer/dygraph_sharding_optimizer.py:54
+stage-1; fleet/meta_parallel/sharding/group_sharded_optimizer_stage2.py:53 and
+group_sharded_stage3.py:85 stages 2/3).
+
+Stage 1 (optimizer-state sharding): each sharding rank owns a subset of
+params; grads are reduced (reduce or reduce-scatter) to the owner, only the
+owner runs the update, updated shards are broadcast back
+(reduce_gradients:320, _sharding_sync_parameters:378).
+
+Stage 2 adds gradient sharding (grads released on non-owners after reduce).
+Stage 3 adds parameter sharding between steps (params gathered on use).
+All three run on the public collective API only — so they work unmodified
+over ProcessGroupCPU (tests) and ProcessGroupXLA (TPU pods), the property
+SURVEY §2.2 calls out.
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+from ...core.tensor import Tensor
+from .. import collective as dist
+
+__all__ = ["DygraphShardingOptimizer", "GroupShardedOptimizerStage2",
+           "GroupShardedStage2", "GroupShardedStage3"]
+
+
+def _partition_params(params, nranks):
+    """Greedy size-balanced partition (reference:
+    dygraph_sharding_optimizer.py _partition_parameters)."""
+    buckets: List[List] = [[] for _ in range(nranks)]
+    sizes = [0] * nranks
+    for p in sorted(params, key=lambda p: -p.size):
+        i = int(np.argmin(sizes))
+        buckets[i].append(p)
+        sizes[i] += p.size
+    return buckets
+
+
+class DygraphShardingOptimizer:
+    """Stage-1 (reference: dygraph_sharding_optimizer.py:54)."""
+
+    def __init__(self, optimizer, hcg=None, group=None):
+        self._inner_opt = optimizer
+        if group is None:
+            from .fleet import get_hybrid_communicate_group
+
+            hcg = hcg or get_hybrid_communicate_group()
+            group = hcg.get_sharding_parallel_group()
+        self._group = group
+        self._nranks = group.nranks
+        self._rank = group.rank
+        all_params = list(optimizer._parameter_list)
+        self._all_params = all_params
+        self._buckets = _partition_params(all_params, self._nranks)
+        self._local_params = self._buckets[self._rank]
+        self._param_owner: Dict[int, int] = {}
+        for r, bucket in enumerate(self._buckets):
+            for p in bucket:
+                self._param_owner[id(p)] = r
+        # the inner optimizer only updates the local shard
+        optimizer._parameter_list = self._local_params
+
+    def __getattr__(self, item):
+        return getattr(self._inner_opt, item)
+
+    def reduce_gradients(self):
+        """reference: :320 — reduce each grad to its owner, average."""
+        for r, bucket in enumerate(self._buckets):
+            for p in bucket:
+                if p._grad is None:
+                    continue
+                dist.reduce(p._grad, self._group.ranks[r], group=self._group)
+                if r == self._rank:
+                    p._grad._data = p._grad._data / self._nranks
+                else:
+                    p._grad = None  # free non-owned grads
+
+    def _sharding_sync_parameters(self):
+        """reference: :378 — broadcast updated shards from owners."""
+        for r, bucket in enumerate(self._buckets):
+            for p in bucket:
+                dist.broadcast(p, self._group.ranks[r], group=self._group)
+
+    def step(self):
+        self.reduce_gradients()
+        self._inner_opt.step()
+        self._sharding_sync_parameters()
+
+    def clear_grad(self, set_to_zero=False):
+        for p in self._all_params:
+            p.clear_gradient(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def state_dict(self):
+        return self._inner_opt.state_dict()
+
+    def set_state_dict(self, sd):
+        return self._inner_opt.set_state_dict(sd)
+
+    def minimize(self, loss, *a, **k):
+        loss.backward()
+        self.step()
+        return None, None
+
+
+class GroupShardedOptimizerStage2(DygraphShardingOptimizer):
+    """Stage-2 (reference: group_sharded_optimizer_stage2.py:53): grads
+    reduce-scattered to owners as they become ready via grad hooks."""
+
+    def __init__(self, params, optim, group=None, offload=False,
+                 device="tpu", **kw):
+        optim._parameter_list = list(params)
+        super().__init__(optim, group=group)
+        self._offload = offload
+        self._register_hooks()
+
+    def _register_hooks(self):
+        for p in self._all_params:
+            owner = self._param_owner[id(p)]
+
+            def hook(grad, p=p, owner=owner):
+                dist.reduce(grad, self._group.ranks[owner],
+                            group=self._group)
+                if owner == self._rank:
+                    grad._data = grad._data / self._nranks
+                    return grad
+                return Tensor(np.zeros((1,), np.float32))  # freed
+
+            p.register_hook(hook)
+
+    def reduce_gradients(self):
+        # grads already reduced by hooks
+        for r, bucket in enumerate(self._buckets):
+            if r == self._rank:
+                continue
+            for p in bucket:
+                p._grad = None
+
+
+class GroupShardedStage2:
+    """Model wrapper for stage-2 (reference: group_sharded_stage2.py)."""
+
+    def __init__(self, layer, sharding_optimizer, group=None, sync_buffers=False,
+                 buffer_max_size=2 ** 23, auto_refresh_trainable=True,
+                 device="tpu", **kw):
+        self._layer = layer
+        self._sharding_optimizers = [sharding_optimizer] if not isinstance(
+            sharding_optimizer, list) else sharding_optimizer
+
+    def __call__(self, *args, **kwargs):
+        return self._layer(*args, **kwargs)
+
+    def __getattr__(self, item):
+        return getattr(self._layer, item)
+
+
+class GroupShardedStage3:
+    """Stage-3: parameter sharding (reference: group_sharded_stage3.py:85).
+
+    Params are split 1/N per rank between steps (_segment_rank_params:422);
+    forward pre-hooks all-gather the full param, post-hooks release
+    (:557)."""
+
+    def __init__(self, layer, optimizer, group=None, sync_buffers=False,
+                 device="tpu", segment_size=2 ** 15, pertrain_sync_models=True,
+                 offload=False, sync_comm=False, **kw):
+        import jax.numpy as jnp
+
+        self._layer = layer
+        self._optimizer = optimizer
+        if group is None:
+            from .fleet import get_hybrid_communicate_group
+
+            hcg = get_hybrid_communicate_group()
+            group = hcg.get_sharding_parallel_group() if hcg else None
+        self._group = group
+        self._nranks = group.nranks if group else 1
+        self._rank = group.rank if group else 0
+        self._params = [p for p in layer.parameters() if not p.stop_gradient]
+        if pertrain_sync_models and self._nranks > 1:
+            for p in self._params:
+                dist.broadcast(p, self._group.ranks[0], group=self._group)
+        self._full_shapes = {id(p): tuple(p.shape) for p in self._params}
+        self._sharded = False
+        if self._nranks > 1:
+            self._shard_all()
+            self._register_hooks()
+
+    # -- param shard/unshard ------------------------------------------------
+    def _shard_param(self, p):
+        import jax.numpy as jnp
+
+        flat = p._data.reshape(-1)
+        n = flat.shape[0]
+        per = -(-n // self._nranks)
+        pad = per * self._nranks - n
+        if pad:
+            flat = jnp.pad(flat, (0, pad))
+        p._data = flat[self._rank * per:(self._rank + 1) * per]
+
+    def _unshard_param(self, p):
+        import jax.numpy as jnp
+
+        outs: List[Tensor] = []
+        dist.all_gather(outs, Tensor(p._data), group=self._group)
+        full = jnp.concatenate([o._data for o in outs])
+        shape = self._full_shapes[id(p)]
+        n = int(np.prod(shape))
+        p._data = full[:n].reshape(shape)
+
+    def _shard_all(self):
+        for p in self._params:
+            self._shard_param(p)
+        self._sharded = True
+
+    def _unshard_all(self):
+        for p in self._params:
+            self._unshard_param(p)
+        self._sharded = False
+
+    def _register_hooks(self):
+        layers_with_params = [l for l in self._layer.sublayers(
+            include_self=True) if l._parameters]
+
+        def pre_hook(layer, inputs):
+            for p in layer._parameters.values():
+                if p is not None and id(p) in self._full_shapes and \
+                        p._data.ndim == 1 and tuple(p.shape) != \
+                        self._full_shapes[id(p)]:
+                    self._unshard_param(p)
+            return None
+
+        for l in layers_with_params:
+            l.register_forward_pre_hook(pre_hook)
+
+    def __call__(self, *args, **kwargs):
+        out = self._layer(*args, **kwargs)
+        return out
+
+    def __getattr__(self, item):
+        return getattr(self._layer, item)
+
+    def step(self):
+        """Reduce grads to shards, update local shard, keep params sharded."""
+        if self._nranks <= 1:
+            self._optimizer.step()
+            return
+        import jax.numpy as jnp
+
+        # params are currently full (post-forward/backward); reduce grads
+        for p in self._params:
+            if p._grad is None:
+                continue
+            dist.all_reduce(p._grad, group=self._group)
+            p._grad._data = p._grad._data / self._nranks
+        self._optimizer.step()
+        self._optimizer.clear_grad()
+        self._shard_all()
+
+    def state_dict(self, *a, **k):
+        was_sharded = self._sharded
+        if was_sharded:
+            self._unshard_all()
+        sd = self._layer.state_dict(*a, **k)
+        if was_sharded:
+            self._shard_all()
+        return sd

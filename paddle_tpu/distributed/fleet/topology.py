@@ -1,0 +1,270 @@
+"""Hybrid-parallel topology (reference:
+python/paddle/distributed/fleet/base/topology.py — CommunicateTopology:70,
+HybridCommunicateGroup:189).
+
+Pure rank arithmetic + group creation; backend-agnostic (works over
+ProcessGroupCPU for tests and ProcessGroupXLA on TPU pods).
+"""
+from __future__ import annotations
+
+import collections
+import itertools
+from functools import reduce
+from typing import Dict, List
+
+import numpy as np
+
+__all__ = ["ParallelMode", "CommunicateTopology", "HybridCommunicateGroup"]
+
+
+class ParallelMode:
+    """reference: topology.py:42."""
+
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+    SEGMENT_PARALLEL = 4
+
+
+class CommunicateTopology:
+    """reference: topology.py:70."""
+
+    def __init__(self,
+                 hybrid_group_names=("data", "pipe", "sharding", "sep",
+                                     "model"),
+                 dims=(1, 1, 1, 1, 1)):
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(dims)
+        self.coordinate = collections.namedtuple(
+            "Coordinate", self._parallel_names)
+        self._world_size = reduce(lambda x, y: x * y, self._dims, 1)
+        ranges = [range(d) for d in self._dims]
+        all_coords = [self.coordinate(*c) for c in itertools.product(*ranges)]
+        self._coord2rank = {c: i for i, c in enumerate(all_coords)}
+        self._rank2coord = {i: c for c, i in self._coord2rank.items()}
+
+    def get_hybrid_group_names(self):
+        return self._parallel_names
+
+    def get_dim(self, axis_name):
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self):
+        return self._world_size
+
+    def get_rank(self, **args):
+        return self._coord2rank[self.coordinate(**args)]
+
+    def get_coord(self, rank):
+        return self._rank2coord[rank]
+
+    def get_axis_list(self, axis_name, index):
+        axis = self._parallel_names.index(axis_name)
+        ranks = [self._coord2rank[c] for c in self._coord2rank
+                 if c[axis] == index]
+        return sorted(ranks)
+
+    def get_comm_list(self, axis_name):
+        """All groups along `axis_name`: list of rank-lists."""
+        axis = self._parallel_names.index(axis_name)
+        other_axes = [i for i in range(len(self._dims)) if i != axis]
+        comm_list = []
+        for other in itertools.product(
+                *[range(self._dims[i]) for i in other_axes]):
+            ranks = []
+            for v in range(self._dims[axis]):
+                coord = [0] * len(self._dims)
+                for i, o in zip(other_axes, other):
+                    coord[i] = o
+                coord[axis] = v
+                ranks.append(self._coord2rank[self.coordinate(*coord)])
+            comm_list.append(ranks)
+        return comm_list
+
+    def get_fused_ranks(self, fused_axes):
+        """Groups over the cartesian product of several axes (e.g. dp×sep
+        gradient group, reference topology.py get_fused_ranks)."""
+        non_fused = [n for n in self._parallel_names if n not in fused_axes]
+        comm_list = []
+        for other in itertools.product(
+                *[range(self.get_dim(n)) for n in non_fused]):
+            ranks = []
+            for fused in itertools.product(
+                    *[range(self.get_dim(n)) for n in fused_axes]):
+                kw = dict(zip(non_fused, other))
+                kw.update(dict(zip(fused_axes, fused)))
+                ranks.append(self.get_rank(**kw))
+            comm_list.append(sorted(ranks))
+        return comm_list
+
+
+class HybridCommunicateGroup:
+    """reference: topology.py:189. Creates one comm group per axis (and the
+    fused dp×sep gradient group and pp p2p neighbors)."""
+
+    def __init__(self, topology: CommunicateTopology):
+        from ..collective import new_group
+        from ..parallel_env import ParallelEnv
+
+        self._topo = topology
+        self.global_rank = ParallelEnv().rank
+        self._dp_degree = self._topo.get_dim("data")
+        self._mp_degree = self._topo.get_dim("model")
+        self._pp_degree = self._topo.get_dim("pipe")
+        self._sharding_degree = self._topo.get_dim("sharding")
+        self._sep_degree = self._topo.get_dim("sep")
+        self.nranks = topology.world_size()
+
+        # per-axis groups
+        self._dp_group, self._dp_comm_group = self._set_comm_group("data")
+        self._mp_group, self._mp_comm_group = self._set_comm_group("model")
+        self._pp_group, self._pp_comm_group = self._set_comm_group("pipe")
+        self._sharding_group, self._sharding_comm_group = \
+            self._set_comm_group("sharding")
+        self._sep_group, self._sep_comm_group = self._set_comm_group("sep")
+
+        # fused dp×sep group for gradient all-reduce (topology.py:551)
+        if self._sep_degree > 1:
+            self._dp_sep_comm_group = self._set_fused_group(["data", "sep"])
+        else:
+            self._dp_sep_comm_group = self._dp_comm_group
+
+        # pp p2p neighbors
+        self._pp_prev_rank = None
+        self._pp_next_rank = None
+        if self._pp_degree > 1:
+            self._set_p2p_neighbors()
+
+        # pp position
+        coord = self._topo.get_coord(self.global_rank)
+        self.stage_id = coord.pipe
+        self._is_first_stage = self.stage_id == 0
+        self._is_last_stage = self.stage_id == (self._pp_degree - 1)
+
+    def _set_comm_group(self, axis_name):
+        from ..collective import new_group
+
+        comm_lists = self._topo.get_comm_list(axis_name)
+        my_group_ranks = None
+        my_group = None
+        for ranks in comm_lists:
+            grp = new_group(ranks)
+            if self.global_rank in ranks:
+                my_group_ranks = ranks
+                my_group = grp
+        return my_group_ranks, my_group
+
+    def _set_fused_group(self, axes):
+        from ..collective import new_group
+
+        my_group = None
+        for ranks in self._topo.get_fused_ranks(axes):
+            grp = new_group(ranks)
+            if self.global_rank in ranks:
+                my_group = grp
+        return my_group
+
+    def _set_p2p_neighbors(self):
+        ranks = self._pp_group
+        idx = ranks.index(self.global_rank)
+        self._pp_next_rank = ranks[(idx + 1) % len(ranks)]
+        self._pp_prev_rank = ranks[(idx - 1) % len(ranks)]
+
+    # ------------------------------------------------------------ queries
+    def get_parallel_mode(self):
+        if self._mp_degree == 1 and self._pp_degree == 1 and \
+                self._sharding_degree == 1 and self._dp_degree > 1:
+            return ParallelMode.DATA_PARALLEL
+        if self._mp_degree > 1 and self._pp_degree == 1:
+            return ParallelMode.TENSOR_PARALLEL
+        if self._pp_degree > 1:
+            return ParallelMode.PIPELINE_PARALLEL
+        if self._sharding_degree > 1:
+            return ParallelMode.SHARDING_PARALLEL
+        return ParallelMode.DATA_PARALLEL
+
+    def topology(self):
+        return self._topo
+
+    def get_global_rank(self):
+        return self.global_rank
+
+    # data parallel
+    def get_data_parallel_rank(self):
+        return self._topo.get_coord(self.global_rank).data
+
+    def get_data_parallel_world_size(self):
+        return self._dp_degree
+
+    def get_data_parallel_group(self):
+        return self._dp_comm_group
+
+    def get_data_parallel_group_src_rank(self):
+        return self._dp_group[0]
+
+    # model (tensor) parallel
+    def get_model_parallel_rank(self):
+        return self._topo.get_coord(self.global_rank).model
+
+    def get_model_parallel_world_size(self):
+        return self._mp_degree
+
+    def get_model_parallel_group(self):
+        return self._mp_comm_group
+
+    def get_model_parallel_group_src_rank(self):
+        return self._mp_group[0]
+
+    # pipe parallel
+    def get_stage_id(self):
+        return self.stage_id
+
+    def get_pipe_parallel_rank(self):
+        return self._topo.get_coord(self.global_rank).pipe
+
+    def get_pipe_parallel_world_size(self):
+        return self._pp_degree
+
+    def get_pipe_parallel_group(self):
+        return self._pp_comm_group
+
+    def is_first_stage(self):
+        return self._is_first_stage
+
+    def is_last_stage(self):
+        return self._is_last_stage
+
+    def get_p2p_next_rank(self):
+        return self._pp_next_rank
+
+    def get_p2p_prev_rank(self):
+        return self._pp_prev_rank
+
+    # sharding
+    def get_sharding_parallel_rank(self):
+        return self._topo.get_coord(self.global_rank).sharding
+
+    def get_sharding_parallel_world_size(self):
+        return self._sharding_degree
+
+    def get_sharding_parallel_group(self):
+        return self._sharding_comm_group
+
+    def get_sharding_parallel_group_src_rank(self):
+        return self._sharding_group[0]
+
+    # sep
+    def get_sep_parallel_rank(self):
+        return self._topo.get_coord(self.global_rank).sep
+
+    def get_sep_parallel_world_size(self):
+        return self._sep_degree
+
+    def get_sep_parallel_group(self):
+        return self._sep_comm_group
+
+    def get_dp_sep_parallel_group(self):
+        return self._dp_sep_comm_group

@@ -1,0 +1,121 @@
+"""python -m paddle_tpu.distributed.launch (reference:
+python/paddle/distributed/launch/main.py:23; CollectiveController.build_pod
+launch/controllers/collective.py:37).
+
+TPU-native process model: ONE process per host (jax owns all local chips);
+--nproc_per_node>1 supported for the CPU-backend test mode (each proc gets
+PADDLE_TRAINER_ID). Env contract matches the reference (PADDLE_TRAINER_ID,
+PADDLE_TRAINERS_NUM, PADDLE_TRAINER_ENDPOINTS, PADDLE_MASTER).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+__all__ = ["launch", "main"]
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def launch(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="launch distributed training (reference: "
+                    "python -m paddle.distributed.launch)")
+    parser.add_argument("--nnodes", type=str, default="1")
+    parser.add_argument("--nproc_per_node", type=int, default=None)
+    parser.add_argument("--master", type=str, default=None)
+    parser.add_argument("--rank", type=int, default=-1)
+    parser.add_argument("--run_mode", type=str, default="collective")
+    parser.add_argument("--job_id", type=str, default="default")
+    parser.add_argument("--devices", "--gpus", type=str, default=None)
+    parser.add_argument("--log_dir", type=str, default="log")
+    parser.add_argument("--log_level", type=str, default="INFO")
+    parser.add_argument("--max_restart", type=int, default=3)
+    parser.add_argument("--backend", type=str, default=None)
+    parser.add_argument("training_script")
+    parser.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    args = parser.parse_args(argv)
+
+    nnodes = int(str(args.nnodes).split(":")[0])
+    nproc = args.nproc_per_node or 1
+    world = nnodes * nproc
+
+    master = args.master or f"127.0.0.1:{_free_port()}"
+    node_rank = args.rank if args.rank >= 0 else 0
+
+    os.makedirs(args.log_dir, exist_ok=True)
+    procs = []
+    endpoints = ",".join(
+        f"127.0.0.1:{_free_port()}" for _ in range(world))
+
+    for local_rank in range(nproc):
+        rank = node_rank * nproc + local_rank
+        env = dict(os.environ)
+        env.update({
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(world),
+            "PADDLE_MASTER": master,
+            "PADDLE_TRAINER_ENDPOINTS": endpoints,
+            "PADDLE_LOCAL_RANK": str(local_rank),
+            "FLAGS_selected_devices": str(local_rank),
+        })
+        if args.backend:
+            env["PADDLE_DIST_BACKEND"] = args.backend
+        log_file = os.path.join(args.log_dir,
+                                f"workerlog.{rank}")
+        with open(log_file, "ab") as lf:
+            p = subprocess.Popen(
+                [sys.executable, args.training_script]
+                + args.training_script_args,
+                env=env, stdout=lf if world > 1 else None,
+                stderr=subprocess.STDOUT if world > 1 else None)
+        procs.append(p)
+
+    def _terminate(*_):
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        sys.exit(1)
+
+    signal.signal(signal.SIGINT, _terminate)
+    signal.signal(signal.SIGTERM, _terminate)
+
+    exit_code = 0
+    try:
+        while True:
+            alive = False
+            for p in procs:
+                ret = p.poll()
+                if ret is None:
+                    alive = True
+                elif ret != 0:
+                    exit_code = ret
+                    _terminate()
+            if not alive:
+                break
+            time.sleep(0.2)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+    sys.exit(exit_code)
+
+
+def main():
+    launch()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,153 @@
+"""DataParallel (reference: python/paddle/distributed/parallel.py:219 +
+the C++ EagerReducer, fluid/distributed/collective/reducer.h:88).
+
+Eager DP: broadcast params at wrap time; bucketed gradient all-reduce after
+backward (grad-ready hooks fire on leaf accumulation like the reference's
+MarkVarReady; buckets flush when full, tail flushes on sync)."""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from .. import nn
+from ..core.tensor import Tensor
+from . import collective as dist
+
+__all__ = ["DataParallel"]
+
+
+class _Reducer:
+    """Python port of the EagerReducer algorithm (reducer.h:88):
+    group_size-bounded buckets in reverse registration order, fused
+    all-reduce per bucket when all its grads are ready."""
+
+    def __init__(self, params, group, group_size_limits=128 * 1024 * 1024):
+        self._params = [p for p in params if not p.stop_gradient]
+        self._group = group
+        self._nranks = group.nranks if group else 1
+        # bucket assignment (reverse order ≈ backward completion order)
+        self._buckets: List[List] = []
+        cur, cur_bytes = [], 0
+        for p in reversed(self._params):
+            nbytes = p.size * p.dtype.itemsize
+            cur.append(p)
+            cur_bytes += nbytes
+            if cur_bytes >= group_size_limits:
+                self._buckets.append(cur)
+                cur, cur_bytes = [], 0
+        if cur:
+            self._buckets.append(cur)
+        self._bucket_of = {}
+        for bi, b in enumerate(self._buckets):
+            for p in b:
+                self._bucket_of[id(p)] = bi
+        self._pending = [set(id(p) for p in b) for b in self._buckets]
+        self._install_hooks()
+
+    def _install_hooks(self):
+        for p in self._params:
+            p.register_hook(self._make_hook(p))
+
+    def _make_hook(self, p):
+        def hook(grad):
+            bi = self._bucket_of.get(id(p))
+            if bi is None:
+                return None
+            self._pending[bi].discard(id(p))
+            if not self._pending[bi]:
+                self._flush(bi)
+            return None
+
+        return hook
+
+    def _flush(self, bi):
+        import jax.numpy as jnp
+
+        if self._nranks <= 1:
+            return
+        bucket = [p for p in self._buckets[bi] if p._grad is not None]
+        if not bucket:
+            return
+        flat = jnp.concatenate([p._grad._data.reshape(-1).astype(jnp.float32)
+                                for p in bucket])
+        t = Tensor(flat)
+        dist.all_reduce(t, group=self._group)
+        out = t._data / self._nranks
+        off = 0
+        for p in bucket:
+            n = p._grad.size
+            p._grad._data = out[off:off + n].reshape(
+                p._grad._data.shape).astype(p._grad._data.dtype)
+            off += n
+
+    def prepare_for_backward(self):
+        self._pending = [set(id(p) for p in b) for b in self._buckets]
+
+    def sync(self):
+        """Flush any bucket with pending members whose grads exist (tail /
+        unused-parameter case, reference find_unused_parameters)."""
+        for bi, pending in enumerate(self._pending):
+            if pending:
+                self._flush(bi)
+                self._pending[bi] = set()
+
+
+class DataParallel(nn.Layer):
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group: Optional[dist.Group] = None):
+        super().__init__()
+        self._layers = layers
+        self._group = group if group is not None else dist.get_group(0)
+        self.find_unused_parameters = find_unused_parameters
+        nranks = self._group.nranks if self._group else 1
+        if nranks > 1:
+            # sync initial params (reference: parallel.py sync_params_buffers)
+            src = self._group.ranks[0]
+            for p in layers.parameters():
+                dist.broadcast(p, src, group=self._group)
+            self._reducer = _Reducer(
+                layers.parameters(), self._group,
+                group_size_limits=comm_buffer_size * 1024 * 1024)
+            self._hook_installed = True
+        else:
+            self._reducer = None
+
+    def forward(self, *inputs, **kwargs):
+        if self._reducer is not None and self.training:
+            self._reducer.prepare_for_backward()
+        out = self._layers(*inputs, **kwargs)
+        if self._reducer is not None and self.training:
+            # grads sync lazily via hooks; tail flush happens when the user
+            # calls opt.step() -> we expose sync via a post-backward hook on
+            # the loss; simplest correct point: flush in step via scale —
+            # here we piggyback on the first hook-driven flush plus explicit
+            # sync() in sync_gradients.
+            pass
+        return out
+
+    def sync_gradients(self):
+        if self._reducer is not None:
+            self._reducer.sync()
+
+    # paddle API parity
+    def scale_loss(self, loss):
+        return loss
+
+    def apply_collective_grads(self):
+        self.sync_gradients()
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, sd, *a, **k):
+        return self._layers.set_state_dict(sd, *a, **k)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)
+
+    @property
+    def _inner_layers(self):
+        return self._layers

@@ -1,0 +1,118 @@
+"""Parallel environment bootstrap
+(reference: python/paddle/distributed/parallel.py:978 init_parallel_env).
+
+Env contract (same var names as the reference launch):
+  PADDLE_TRAINER_ID      process rank
+  PADDLE_TRAINERS_NUM    world size (process count)
+  PADDLE_MASTER          host:port of the TCPStore master
+  PADDLE_DIST_BACKEND    cpu | xla (default: cpu off-TPU, xla on TPU multi-host)
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+__all__ = ["ParallelEnv", "init_parallel_env", "get_rank", "get_world_size",
+           "is_initialized"]
+
+_initialized = False
+_default_group = None
+
+
+class ParallelEnv:
+    def __init__(self):
+        self._rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        self._world_size = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+        self._device_id = int(os.environ.get("FLAGS_selected_devices",
+                                             os.environ.get(
+                                                 "PADDLE_LOCAL_RANK", "0")))
+
+    @property
+    def rank(self):
+        return self._rank
+
+    @property
+    def world_size(self):
+        return self._world_size
+
+    @property
+    def local_rank(self):
+        return self._device_id
+
+    @property
+    def device_id(self):
+        return self._device_id
+
+    @property
+    def dev_id(self):
+        return self._device_id
+
+    @property
+    def nranks(self):
+        return self._world_size
+
+    @property
+    def current_endpoint(self):
+        eps = self.trainer_endpoints
+        return eps[self._rank] if self._rank < len(eps) else ""
+
+    @property
+    def trainer_endpoints(self):
+        return os.environ.get("PADDLE_TRAINER_ENDPOINTS", "").split(",")
+
+
+def get_rank(group=None) -> int:
+    if group is not None:
+        return group.get_group_rank(ParallelEnv().rank)
+    return ParallelEnv().rank
+
+
+def get_world_size(group=None) -> int:
+    if group is not None:
+        return group.world_size
+    return ParallelEnv().world_size
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def init_parallel_env(backend: Optional[str] = None):
+    """reference: distributed/parallel.py:978 — global TCPStore, default
+    process group, (on TPU multi-host) jax.distributed.initialize."""
+    global _initialized, _default_group
+    if _initialized:
+        return _default_group
+    env = ParallelEnv()
+
+    import jax
+
+    if backend is None:
+        backend = os.environ.get("PADDLE_DIST_BACKEND", "")
+    if not backend:
+        backend = "xla" if jax.default_backend() == "tpu" and \
+            env.world_size > 1 else "cpu"
+
+    if backend == "xla" and env.world_size > 1:
+        master = os.environ.get("PADDLE_MASTER", "127.0.0.1:8476")
+        try:
+            jax.distributed.initialize(
+                coordinator_address=master,
+                num_processes=env.world_size,
+                process_id=env.rank)
+        except Exception:
+            pass  # already initialized or single-host emulation
+
+    from . import collective as coll
+    from .store import create_or_get_global_tcp_store
+    from .process_group import new_process_group_impl
+
+    if env.world_size > 1:
+        store = create_or_get_global_tcp_store()
+    else:
+        store = None
+    pg = new_process_group_impl(backend, store, env.rank, env.world_size,
+                                gid=0)
+    _default_group = coll._register_default_group(pg, env)
+    _initialized = True
+    return _default_group

@@ -1,0 +1,346 @@
+"""ProcessGroup: the virtual collective API + backends
+(reference: paddle/phi/core/distributed/collective/process_group.h:48-520;
+NCCL impl fluid/distributed/collective/process_group_nccl.cc).
+
+Backends:
+- ProcessGroupSingle: world_size==1 fast path (identity collectives).
+- ProcessGroupCPU: multi-process on one or more hosts over the TCPStore
+  (the Gloo-analog for hardware-free distributed tests — SURVEY §4 test
+  strategy). Data moves as numpy buffers through the store; algorithms are
+  gather-to-root + broadcast (correctness-first; bandwidth is irrelevant for
+  its test role).
+- ProcessGroupXLA: multi-host TPU — collectives execute as compiled
+  one-collective XLA programs over ICI/DCN via jax global arrays; requires
+  jax.distributed.initialize (one process per host).
+
+Every collective returns a Task with wait()/synchronize() like the
+reference's ProcessGroup::Task.
+"""
+from __future__ import annotations
+
+import pickle
+from typing import List, Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from .store import TCPStore
+
+__all__ = ["ReduceOp", "ProcessGroup", "ProcessGroupSingle",
+           "ProcessGroupCPU", "Task", "new_process_group_impl"]
+
+
+class ReduceOp:
+    SUM = 0
+    MAX = 1
+    MIN = 2
+    PROD = 3
+    AVG = 4
+
+
+_NP_REDUCE = {
+    ReduceOp.SUM: lambda a, b: a + b,
+    ReduceOp.MAX: np.maximum,
+    ReduceOp.MIN: np.minimum,
+    ReduceOp.PROD: lambda a, b: a * b,
+    ReduceOp.AVG: lambda a, b: a + b,  # divided at the end
+}
+
+
+class Task:
+    def __init__(self, fn=None):
+        self._fn = fn
+        self._done = fn is None
+
+    def wait(self, timeout=None):
+        if not self._done:
+            self._fn()
+            self._done = True
+        return True
+
+    def synchronize(self):
+        self.wait()
+
+    def is_completed(self):
+        return self._done
+
+
+class ProcessGroup:
+    """Virtual base (reference: process_group.h:48)."""
+
+    def __init__(self, rank: int, world_size: int, gid: int = 0):
+        self._rank = rank
+        self._world_size = world_size
+        self._gid = gid
+
+    def rank(self) -> int:
+        return self._rank
+
+    def size(self) -> int:
+        return self._world_size
+
+    def name(self) -> str:
+        return f"pg_{self._gid}"
+
+    # -- collective API: subclasses implement the _impl methods on numpy ----
+    def all_reduce(self, tensor: Tensor, op=ReduceOp.SUM, sync_op=True):
+        out = self._all_reduce_impl(tensor.numpy(), op)
+        tensor._data = _to_jax(out, tensor)
+        return Task()
+
+    def broadcast(self, tensor: Tensor, src: int, sync_op=True):
+        out = self._broadcast_impl(tensor.numpy(), src)
+        tensor._data = _to_jax(out, tensor)
+        return Task()
+
+    def all_gather(self, tensor_list: List[Tensor], tensor: Tensor,
+                   sync_op=True):
+        outs = self._all_gather_impl(tensor.numpy())
+        if tensor_list is not None:
+            if len(tensor_list) == 0:
+                tensor_list.extend(Tensor(o) for o in outs)
+            else:
+                for t, o in zip(tensor_list, outs):
+                    t._data = _to_jax(o, t)
+        return Task()
+
+    def reduce(self, tensor: Tensor, dst: int, op=ReduceOp.SUM, sync_op=True):
+        out = self._reduce_impl(tensor.numpy(), dst, op)
+        if self._rank == dst:
+            tensor._data = _to_jax(out, tensor)
+        return Task()
+
+    def reduce_scatter(self, tensor: Tensor, tensor_list: List[Tensor],
+                       op=ReduceOp.SUM, sync_op=True):
+        ins = [t.numpy() for t in tensor_list]
+        out = self._reduce_scatter_impl(ins, op)
+        tensor._data = _to_jax(out, tensor)
+        return Task()
+
+    def scatter(self, tensor: Tensor, tensor_list: List[Tensor], src: int,
+                sync_op=True):
+        ins = [t.numpy() for t in tensor_list] if self._rank == src else None
+        out = self._scatter_impl(ins, src,
+                                 shape=tensor.numpy().shape,
+                                 dtype=tensor.numpy().dtype)
+        tensor._data = _to_jax(out, tensor)
+        return Task()
+
+    def gather(self, tensor: Tensor, gather_list: Optional[List[Tensor]],
+               dst: int, sync_op=True):
+        outs = self._gather_impl(tensor.numpy(), dst)
+        if self._rank == dst and gather_list is not None:
+            if len(gather_list) == 0:
+                gather_list.extend(Tensor(o) for o in outs)
+            else:
+                for t, o in zip(gather_list, outs):
+                    t._data = _to_jax(o, t)
+        return Task()
+
+    def all_to_all(self, out_tensor_list: List[Tensor],
+                   in_tensor_list: List[Tensor], sync_op=True):
+        outs = self._all_to_all_impl([t.numpy() for t in in_tensor_list])
+        if len(out_tensor_list) == 0:
+            out_tensor_list.extend(Tensor(o) for o in outs)
+        else:
+            for t, o in zip(out_tensor_list, outs):
+                t._data = _to_jax(o, t)
+        return Task()
+
+    def send(self, tensor: Tensor, dst: int, sync_op=True):
+        self._send_impl(tensor.numpy(), dst)
+        return Task()
+
+    def recv(self, tensor: Tensor, src: int, sync_op=True):
+        out = self._recv_impl(src, tensor.numpy().shape, tensor.numpy().dtype)
+        tensor._data = _to_jax(out, tensor)
+        return Task()
+
+    def barrier(self, device_id: Optional[int] = None):
+        self._barrier_impl()
+        return Task()
+
+    # -- coalescing (reference: process_group.h:119-121) --------------------
+    def start_coalescing(self):
+        pass
+
+    def end_coalescing(self):
+        pass
+
+
+def _to_jax(arr: np.ndarray, like: Tensor):
+    import jax.numpy as jnp
+
+    return jnp.asarray(arr).astype(like._data.dtype)
+
+
+class ProcessGroupSingle(ProcessGroup):
+    """world_size == 1: all collectives are local identities."""
+
+    def __init__(self, gid=0):
+        super().__init__(0, 1, gid)
+
+    def _all_reduce_impl(self, arr, op):
+        return arr
+
+    def _broadcast_impl(self, arr, src):
+        return arr
+
+    def _all_gather_impl(self, arr):
+        return [arr]
+
+    def _reduce_impl(self, arr, dst, op):
+        return arr
+
+    def _reduce_scatter_impl(self, arrs, op):
+        return arrs[0]
+
+    def _scatter_impl(self, arrs, src, shape, dtype):
+        return arrs[0]
+
+    def _gather_impl(self, arr, dst):
+        return [arr]
+
+    def _all_to_all_impl(self, arrs):
+        return arrs
+
+    def _send_impl(self, arr, dst):
+        raise RuntimeError("send/recv undefined for world_size==1")
+
+    def _recv_impl(self, src, shape, dtype):
+        raise RuntimeError("send/recv undefined for world_size==1")
+
+    def _barrier_impl(self):
+        pass
+
+
+class ProcessGroupCPU(ProcessGroup):
+    """TCPStore-backed collectives: the Gloo analog for multi-process tests
+    (reference role: fluid/distributed/collective/process_group_gloo.cc)."""
+
+    def __init__(self, store: TCPStore, rank: int, world_size: int,
+                 gid: int = 0, group_ranks: Optional[List[int]] = None):
+        super().__init__(rank, world_size, gid)
+        self._store = store
+        self._seq = 0
+        self._ranks = group_ranks or list(range(world_size))
+
+    def _key(self, tag, rank=None):
+        self._seq += 1
+        base = f"pg{self._gid}/{tag}/{self._seq}"
+        return base if rank is None else f"{base}/r{rank}"
+
+    def _publish(self, key, arr):
+        self._store.set(key, pickle.dumps(np.asarray(arr), protocol=4))
+
+    def _fetch(self, key):
+        return pickle.loads(self._store.get(key))
+
+    # Collectives: root = group rank 0 gathers, computes, broadcasts back.
+    def _gather_all(self, tag, arr):
+        """Every rank publishes; every rank reads all -> list by group rank."""
+        self._seq += 1
+        base = f"pg{self._gid}/{tag}/{self._seq}"
+        self._store.set(f"{base}/r{self._rank}",
+                        pickle.dumps(np.asarray(arr), protocol=4))
+        outs = []
+        for r in range(self._world_size):
+            outs.append(pickle.loads(self._store.get(f"{base}/r{r}")))
+        return outs
+
+    def _all_reduce_impl(self, arr, op):
+        outs = self._gather_all("ar", arr)
+        acc = outs[0].astype(np.float64 if np.issubdtype(
+            outs[0].dtype, np.floating) else outs[0].dtype)
+        for o in outs[1:]:
+            acc = _NP_REDUCE[op](acc, o)
+        if op == ReduceOp.AVG:
+            acc = acc / self._world_size
+        return acc.astype(arr.dtype)
+
+    def _broadcast_impl(self, arr, src):
+        self._seq += 1
+        base = f"pg{self._gid}/bc/{self._seq}"
+        src_group_rank = self._ranks.index(src) if src in self._ranks else src
+        if self._rank == src_group_rank:
+            self._store.set(f"{base}", pickle.dumps(np.asarray(arr),
+                                                    protocol=4))
+            return arr
+        return pickle.loads(self._store.get(f"{base}"))
+
+    def _all_gather_impl(self, arr):
+        return self._gather_all("ag", arr)
+
+    def _reduce_impl(self, arr, dst, op):
+        outs = self._gather_all("rd", arr)
+        if self._rank != dst:
+            return arr
+        acc = outs[0]
+        for o in outs[1:]:
+            acc = _NP_REDUCE[op](acc, o)
+        if op == ReduceOp.AVG:
+            acc = acc / self._world_size
+        return acc.astype(arr.dtype)
+
+    def _reduce_scatter_impl(self, arrs, op):
+        outs = self._gather_all("rs", np.stack(arrs))
+        acc = outs[0]
+        for o in outs[1:]:
+            acc = _NP_REDUCE[op](acc, o)
+        if op == ReduceOp.AVG:
+            acc = acc / self._world_size
+        return acc[self._rank].astype(arrs[0].dtype)
+
+    def _scatter_impl(self, arrs, src, shape, dtype):
+        self._seq += 1
+        base = f"pg{self._gid}/sc/{self._seq}"
+        if self._rank == src:
+            for r in range(self._world_size):
+                self._store.set(f"{base}/r{r}",
+                                pickle.dumps(np.asarray(arrs[r]), protocol=4))
+        return pickle.loads(self._store.get(f"{base}/r{self._rank}"))
+
+    def _gather_impl(self, arr, dst):
+        outs = self._gather_all("ga", arr)
+        return outs if self._rank == dst else []
+
+    def _all_to_all_impl(self, arrs):
+        outs = self._gather_all("a2a", np.stack(arrs))
+        return [outs[r][self._rank] for r in range(self._world_size)]
+
+    def _p2p_key(self, src, dst):
+        # per-edge sequence counters so send/recv order pairs up even when
+        # ranks interleave other collectives differently (1F1B does this)
+        if not hasattr(self, "_p2p_seq"):
+            self._p2p_seq = {}
+        k = (src, dst)
+        self._p2p_seq[k] = self._p2p_seq.get(k, 0) + 1
+        return f"pg{self._gid}/p2p/{src}->{dst}/{self._p2p_seq[k]}"
+
+    def _send_impl(self, arr, dst):
+        key = self._p2p_key(self._rank, dst)
+        self._store.set(key, pickle.dumps(np.asarray(arr), protocol=4))
+
+    def _recv_impl(self, src, shape, dtype):
+        key = self._p2p_key(src, self._rank)
+        return pickle.loads(self._store.get(key))
+
+    def _barrier_impl(self):
+        self._seq += 1
+        self._store.barrier(f"pg{self._gid}/b{self._seq}", self._world_size,
+                            self._rank)
+
+
+def new_process_group_impl(backend: str, store, rank: int, world_size: int,
+                           gid: int = 0, group_ranks=None) -> ProcessGroup:
+    """reference: python/paddle/distributed/collective.py:150
+    _new_process_group_impl."""
+    if world_size <= 1:
+        return ProcessGroupSingle(gid)
+    if backend in ("cpu", "gloo", "tcp"):
+        return ProcessGroupCPU(store, rank, world_size, gid, group_ranks)
+    if backend in ("xla", "tpu", "nccl", "xccl"):
+        from .process_group_xla import ProcessGroupXLA
+
+        return ProcessGroupXLA(store, rank, world_size, gid, group_ranks)
+    raise ValueError(f"unknown backend {backend}")

@@ -1,0 +1,215 @@
+"""ProcessGroupXLA: collectives as compiled XLA programs over ICI/DCN
+(the single most important native component per SURVEY §2.2 — the TPU
+equivalent of fluid/distributed/collective/process_group_nccl.cc).
+
+Design: each collective compiles (and caches, keyed by
+(op, shape, dtype, group)) a one-collective jitted program over the global
+device mesh spanning the group's processes, using shard_map + lax collective
+primitives. Requires jax.distributed.initialize() (one process per host) —
+done by init_parallel_env when launched multi-process.
+
+Ordering: XLA programs on a TPU stream execute in issue order per device, so
+the reference's comm-stream event chaining (process_group_nccl.cc:902-991)
+maps to plain issue order here; Task.wait() is a no-op barrier on the jax
+async dispatch (block_until_ready).
+"""
+from __future__ import annotations
+
+import functools
+from typing import List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .process_group import ProcessGroup, ReduceOp, Task
+
+__all__ = ["ProcessGroupXLA"]
+
+_LAX_REDUCE = {
+    ReduceOp.SUM: jax.lax.psum,
+    ReduceOp.MAX: jax.lax.pmax,
+    ReduceOp.MIN: jax.lax.pmin,
+}
+
+
+class ProcessGroupXLA(ProcessGroup):
+    def __init__(self, store, rank: int, world_size: int, gid: int = 0,
+                 group_ranks: Optional[List[int]] = None):
+        super().__init__(rank, world_size, gid)
+        self._store = store
+        self._ranks = group_ranks or list(range(world_size))
+        # one process per host: the group's devices = all local devices of
+        # the member processes
+        self._mesh_cache = {}
+        self._fn_cache = {}
+
+    def _global_mesh(self):
+        """1-D mesh over one device per member process (cross-host axis)."""
+        key = tuple(self._ranks)
+        if key not in self._mesh_cache:
+            devs = []
+            all_devices = jax.devices()
+            for r in self._ranks:
+                cand = [d for d in all_devices if d.process_index == r]
+                if not cand:
+                    raise RuntimeError(
+                        f"no devices for process {r}; is jax.distributed "
+                        "initialized with one process per host?")
+                devs.append(cand[0])
+            self._mesh_cache[key] = jax.sharding.Mesh(
+                np.array(devs), axis_names=("x",))
+        return self._mesh_cache[key]
+
+    def _run_collective(self, tag, arr, fn_builder):
+        """Execute fn over the group mesh with the local array as this
+        process's shard."""
+        from jax.experimental import multihost_utils
+
+        mesh = self._global_mesh()
+        cache_key = (tag, arr.shape, str(arr.dtype), tuple(self._ranks))
+        if cache_key not in self._fn_cache:
+            self._fn_cache[cache_key] = fn_builder(mesh)
+        fn = self._fn_cache[cache_key]
+        global_arr = multihost_utils.host_local_array_to_global_array(
+            arr, mesh, jax.sharding.PartitionSpec("x"))
+        out = fn(global_arr)
+        local = multihost_utils.global_array_to_host_local_array(
+            out, mesh, jax.sharding.PartitionSpec("x"))
+        return np.asarray(local)
+
+    def _all_reduce_impl(self, arr, op):
+        import jax.sharding as shd
+        from jax.experimental.shard_map import shard_map
+
+        a = np.asarray(arr)[None]  # stack axis for the mesh dim
+
+        def builder(mesh):
+            red = _LAX_REDUCE.get(op, jax.lax.psum)
+
+            @jax.jit
+            @functools.partial(
+                shard_map, mesh=mesh,
+                in_specs=shd.PartitionSpec("x"),
+                out_specs=shd.PartitionSpec("x"))
+            def f(x):
+                r = red(x, "x")
+                if op == ReduceOp.AVG:
+                    r = r / len(self._ranks)
+                return r
+
+            return f
+
+        return self._run_collective("allreduce", a, builder)[0]
+
+    def _broadcast_impl(self, arr, src):
+        src_idx = self._ranks.index(src) if src in self._ranks else src
+        a = np.asarray(arr)[None]
+        import jax.sharding as shd
+        from jax.experimental.shard_map import shard_map
+
+        def builder(mesh):
+            @jax.jit
+            @functools.partial(
+                shard_map, mesh=mesh,
+                in_specs=shd.PartitionSpec("x"),
+                out_specs=shd.PartitionSpec("x"))
+            def f(x):
+                full = jax.lax.all_gather(x, "x", axis=0, tiled=True)
+                return full[src_idx][None]
+
+            return f
+
+        return self._run_collective("broadcast", a, builder)[0]
+
+    def _all_gather_impl(self, arr):
+        a = np.asarray(arr)[None]
+        import jax.sharding as shd
+        from jax.experimental.shard_map import shard_map
+
+        n = len(self._ranks)
+
+        def builder(mesh):
+            @jax.jit
+            @functools.partial(
+                shard_map, mesh=mesh,
+                in_specs=shd.PartitionSpec("x"),
+                out_specs=shd.PartitionSpec("x"))
+            def f(x):
+                full = jax.lax.all_gather(x, "x", axis=0, tiled=True)
+                return full[None]  # replicated result, shard dim 1
+
+            return f
+
+        out = self._run_collective("allgather", a, builder)
+        return [out[0][i] for i in range(n)]
+
+    def _reduce_impl(self, arr, dst, op):
+        out = self._all_reduce_impl(arr, op)
+        return out if self._rank == dst else arr
+
+    def _reduce_scatter_impl(self, arrs, op):
+        stacked = np.stack(arrs)  # [n, ...] local contributions
+        summed = self._all_reduce_impl(stacked, op)
+        return summed[self._rank]
+
+    def _scatter_impl(self, arrs, src, shape, dtype):
+        if self._rank == src:
+            stacked = np.stack(arrs)
+        else:
+            stacked = np.zeros((len(self._ranks),) + tuple(shape),
+                               dtype=dtype)
+        out = self._broadcast_impl(stacked, src)
+        return out[self._rank]
+
+    def _gather_impl(self, arr, dst):
+        outs = self._all_gather_impl(arr)
+        return outs if self._rank == dst else []
+
+    def _all_to_all_impl(self, arrs):
+        a = np.stack(arrs)[None]  # [1, n, ...]
+        import jax.sharding as shd
+        from jax.experimental.shard_map import shard_map
+
+        def builder(mesh):
+            @jax.jit
+            @functools.partial(
+                shard_map, mesh=mesh,
+                in_specs=shd.PartitionSpec("x"),
+                out_specs=shd.PartitionSpec("x"))
+            def f(x):
+                # x: [1, n, ...] per member; all_to_all over axis 1
+                return jax.lax.all_to_all(x, "x", split_axis=1,
+                                          concat_axis=1, tiled=False)
+
+            return f
+
+        out = self._run_collective("alltoall", a, builder)
+        return [out[0][i] for i in range(len(self._ranks))]
+
+    def _send_impl(self, arr, dst):
+        # p2p over the store (control path); steady-state PP on TPU should
+        # use the compiled collective_permute path in parallel/pipeline
+        import pickle
+
+        key = self._p2p_key_xla(self._rank, dst)
+        self._store.set(key, pickle.dumps(np.asarray(arr), protocol=4))
+
+    def _recv_impl(self, src, shape, dtype):
+        import pickle
+
+        key = self._p2p_key_xla(src, self._rank)
+        return pickle.loads(self._store.get(key))
+
+    def _p2p_key_xla(self, src, dst):
+        if not hasattr(self, "_p2p_seq"):
+            self._p2p_seq = {}
+        k = (src, dst)
+        self._p2p_seq[k] = self._p2p_seq.get(k, 0) + 1
+        return f"pgx{self._gid}/p2p/{src}->{dst}/{self._p2p_seq[k]}"
+
+    def _barrier_impl(self):
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(f"pg{self._gid}_barrier")

@@ -1,0 +1,53 @@
+"""group_sharded_parallel entry (reference:
+python/paddle/distributed/sharding/group_sharded.py)."""
+from __future__ import annotations
+
+from ..fleet.sharding_optimizer import (
+    GroupShardedOptimizerStage2,
+    GroupShardedStage2,
+    GroupShardedStage3,
+)
+
+__all__ = ["group_sharded_parallel", "save_group_sharded_model"]
+
+
+def group_sharded_parallel(model, optimizer, level, scaler=None, group=None,
+                           offload=False, sync_buffers=False,
+                           buffer_max_size=2 ** 23, segment_size=2 ** 20,
+                           sync_comm=False, dp_group=None,
+                           exclude_layer=None):
+    """reference: distributed/sharding/group_sharded.py
+    group_sharded_parallel. level: os | os_g | p_g_os."""
+    if group is None:
+        from ..collective import get_group
+
+        group = get_group(0)
+    if level == "os":
+        from ..fleet.sharding_optimizer import DygraphShardingOptimizer
+
+        opt = DygraphShardingOptimizer(optimizer, group=group)
+        return model, opt, scaler
+    if level == "os_g":
+        opt = GroupShardedOptimizerStage2(
+            list(model.parameters()), optimizer, group=group, offload=offload)
+        wrapped = GroupShardedStage2(model, opt, group=group,
+                                     sync_buffers=sync_buffers,
+                                     buffer_max_size=buffer_max_size)
+        return wrapped, opt, scaler
+    if level == "p_g_os":
+        wrapped = GroupShardedStage3(model, optimizer, group=group,
+                                     sync_buffers=sync_buffers,
+                                     segment_size=segment_size,
+                                     sync_comm=sync_comm)
+        return wrapped, optimizer, scaler
+    raise ValueError(f"unknown sharding level {level!r}; use os | os_g | p_g_os")
+
+
+def save_group_sharded_model(model, output, optimizer=None):
+    from ...framework.io_utils import save
+
+    sd = model.state_dict()
+    save(sd, output + ".pdmodel" if not output.endswith(".pdmodel")
+         else output)
+    if optimizer is not None:
+        save(optimizer.state_dict(), output + ".pdopt")

@@ -1,0 +1,216 @@
+"""TCPStore: rendezvous + KV for process-group bootstrap
+(reference: paddle/phi/core/distributed/store/tcp_store.h:45 MasterDaemon,
+TCPServer:84; kept as a pure-socket component exactly as SURVEY §2.4.10
+recommends).
+
+Protocol: length-prefixed msgpack-free binary frames:
+  [1B op][4B key_len][key][8B value_len][value]
+ops: SET=0 GET=1 ADD=2 WAIT=3 CHECK=4
+"""
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import threading
+import time
+from typing import Dict, Optional
+
+__all__ = ["TCPStore", "MasterDaemon", "create_or_get_global_tcp_store"]
+
+_OP_SET, _OP_GET, _OP_ADD, _OP_WAIT, _OP_CHECK = 0, 1, 2, 3, 4
+
+
+def _recv_exact(sock, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("store connection closed")
+        buf += chunk
+    return buf
+
+
+def _send_frame(sock, op: int, key: bytes, value: bytes):
+    sock.sendall(struct.pack(">BI", op, len(key)) + key
+                 + struct.pack(">Q", len(value)) + value)
+
+
+def _recv_frame(sock):
+    hdr = _recv_exact(sock, 5)
+    op, klen = struct.unpack(">BI", hdr)
+    key = _recv_exact(sock, klen) if klen else b""
+    (vlen,) = struct.unpack(">Q", _recv_exact(sock, 8))
+    value = _recv_exact(sock, vlen) if vlen else b""
+    return op, key, value
+
+
+class MasterDaemon(threading.Thread):
+    """KV server run by rank 0 (reference: tcp_store.h:45)."""
+
+    def __init__(self, port: int, world_size: int = 1):
+        super().__init__(daemon=True)
+        self._kv: Dict[bytes, bytes] = {}
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(("0.0.0.0", port))
+        self._port = self._sock.getsockname()[1]
+        self._sock.listen(128)
+        self._stop = False
+        self.start()
+
+    @property
+    def port(self):
+        return self._port
+
+    def run(self):
+        while not self._stop:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                break
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn):
+        try:
+            while True:
+                op, key, value = _recv_frame(conn)
+                if op == _OP_SET:
+                    with self._cond:
+                        self._kv[key] = value
+                        self._cond.notify_all()
+                    _send_frame(conn, op, b"", b"ok")
+                elif op == _OP_GET:
+                    with self._lock:
+                        v = self._kv.get(key, b"")
+                    _send_frame(conn, op, b"", v)
+                elif op == _OP_ADD:
+                    (delta,) = struct.unpack(">q", value)
+                    with self._cond:
+                        cur = int(self._kv.get(key, b"0"))
+                        cur += delta
+                        self._kv[key] = str(cur).encode()
+                        self._cond.notify_all()
+                    _send_frame(conn, op, b"", struct.pack(">q", cur))
+                elif op == _OP_WAIT:
+                    (timeout_ms,) = struct.unpack(">q", value)
+                    deadline = time.time() + timeout_ms / 1000.0
+                    ok = True
+                    with self._cond:
+                        while key not in self._kv:
+                            remaining = deadline - time.time()
+                            if remaining <= 0:
+                                ok = False
+                                break
+                            self._cond.wait(min(remaining, 1.0))
+                    _send_frame(conn, op, b"", b"1" if ok else b"0")
+                elif op == _OP_CHECK:
+                    with self._lock:
+                        ok = key in self._kv
+                    _send_frame(conn, op, b"", b"1" if ok else b"0")
+        except (ConnectionError, OSError):
+            pass
+
+    def stop(self):
+        self._stop = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class TCPStore:
+    """Client (rank 0 also hosts the daemon).
+    (reference: phi/core/distributed/store/tcp_store.h TCPStore)"""
+
+    def __init__(self, host: str, port: int, is_master: bool = False,
+                 world_size: int = 1, timeout: float = 900.0):
+        self._daemon = None
+        if is_master:
+            self._daemon = MasterDaemon(port, world_size)
+            port = self._daemon.port
+        self._host = host
+        self._port = port
+        self._timeout = timeout
+        deadline = time.time() + timeout
+        last_err = None
+        while time.time() < deadline:
+            try:
+                self._sock = socket.create_connection((host, port), timeout=5)
+                self._sock.settimeout(timeout)
+                break
+            except OSError as e:
+                last_err = e
+                time.sleep(0.05)
+        else:
+            raise ConnectionError(
+                f"cannot connect to TCPStore {host}:{port}: {last_err}")
+        self._lock = threading.Lock()
+
+    @property
+    def port(self):
+        return self._port
+
+    def set(self, key: str, value) -> None:
+        if isinstance(value, str):
+            value = value.encode()
+        with self._lock:
+            _send_frame(self._sock, _OP_SET, key.encode(), bytes(value))
+            _recv_frame(self._sock)
+
+    def get(self, key: str) -> bytes:
+        self.wait([key])
+        with self._lock:
+            _send_frame(self._sock, _OP_GET, key.encode(), b"")
+            _, _, v = _recv_frame(self._sock)
+        return v
+
+    def add(self, key: str, delta: int) -> int:
+        with self._lock:
+            _send_frame(self._sock, _OP_ADD, key.encode(),
+                        struct.pack(">q", delta))
+            _, _, v = _recv_frame(self._sock)
+        return struct.unpack(">q", v)[0]
+
+    def wait(self, keys, timeout: Optional[float] = None) -> None:
+        timeout = timeout if timeout is not None else self._timeout
+        for key in keys:
+            with self._lock:
+                _send_frame(self._sock, _OP_WAIT, key.encode(),
+                            struct.pack(">q", int(timeout * 1000)))
+                _, _, v = _recv_frame(self._sock)
+            if v != b"1":
+                raise TimeoutError(f"TCPStore wait timed out on key {key!r}")
+
+    def check(self, key: str) -> bool:
+        with self._lock:
+            _send_frame(self._sock, _OP_CHECK, key.encode(), b"")
+            _, _, v = _recv_frame(self._sock)
+        return v == b"1"
+
+    def barrier(self, prefix: str, world_size: int, rank: int):
+        n = self.add(f"{prefix}/barrier", 1)
+        if n == world_size:
+            self.set(f"{prefix}/barrier_done", b"1")
+        self.wait([f"{prefix}/barrier_done"])
+
+
+_global_store: Optional[TCPStore] = None
+
+
+def create_or_get_global_tcp_store() -> TCPStore:
+    """reference: phi/core/distributed/store/store_utils.h:33."""
+    global _global_store
+    if _global_store is not None:
+        return _global_store
+    ep = os.environ.get("PADDLE_MASTER",
+                        os.environ.get("MASTER_ENDPOINT", "127.0.0.1:0"))
+    host, port = ep.rsplit(":", 1)
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    world = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    _global_store = TCPStore(host, int(port), is_master=(rank == 0),
+                             world_size=world)
+    return _global_store

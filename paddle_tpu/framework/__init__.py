@@ -1,0 +1,83 @@
+"""Framework-level utilities: default dtype, flags, ParamAttr, random
+(reference: python/paddle/framework/, python/paddle/base/framework.py)."""
+from __future__ import annotations
+
+import threading
+
+from ..core.dtype import convert_dtype
+from .param_attr import ParamAttr  # noqa: F401
+
+__all__ = ["set_default_dtype", "get_default_dtype", "set_flags", "get_flags",
+           "ParamAttr", "seed"]
+
+
+class _Defaults(threading.local):
+    def __init__(self):
+        self.dtype = convert_dtype("float32")
+
+
+_defaults = _Defaults()
+
+
+def set_default_dtype(d):
+    _defaults.dtype = convert_dtype(d)
+
+
+def get_default_dtype():
+    return _defaults.dtype.name
+
+
+# ------------------------------------------------------------------- flags
+# The reference exposes ~185 runtime flags (paddle/common/flags.cc) settable
+# via paddle.set_flags / env FLAGS_*. We keep the same surface with a simple
+# registry; flags that map to JAX/XLA configs apply them on set.
+import os
+
+_FLAGS = {
+    "FLAGS_check_nan_inf": False,
+    "FLAGS_check_nan_inf_level": 0,
+    "FLAGS_cudnn_deterministic": False,
+    "FLAGS_embedding_deterministic": 0,
+    "FLAGS_use_stride_kernel": True,
+    "FLAGS_allocator_strategy": "auto_growth",
+    "FLAGS_fraction_of_gpu_memory_to_use": 0.92,
+    "FLAGS_max_inplace_grad_add": 0,
+    "FLAGS_log_memory_stats": False,
+    "FLAGS_benchmark": False,
+    "FLAGS_eager_delete_tensor_gb": 0.0,
+}
+for _k in list(_FLAGS):
+    if _k in os.environ:
+        _FLAGS[_k] = os.environ[_k]
+
+
+def set_flags(flags: dict):
+    from ..amp import debugging as dbg
+
+    for k, v in flags.items():
+        _FLAGS[k] = v
+        if k == "FLAGS_check_nan_inf":
+            cfg = dbg.TensorCheckerConfig(enable=bool(v))
+            if v:
+                dbg.enable_tensor_checker(cfg)
+            else:
+                dbg.disable_tensor_checker()
+
+
+def get_flags(flags):
+    if isinstance(flags, str):
+        flags = [flags]
+    return {k: _FLAGS.get(k) for k in flags}
+
+
+def seed(s):
+    from ..core.random import seed as _seed
+
+    _seed(s)
+    import numpy as np
+
+    np.random.seed(s % (2 ** 32))
+    return s
+
+
+from .io_utils import load, save  # noqa: F401,E402

@@ -1,0 +1,65 @@
+"""paddle.save / paddle.load (reference: python/paddle/framework/io.py:773,1020).
+
+Serialization: pickle container with tensors stored as numpy arrays
+(bfloat16 saved as uint16 view + dtype tag so numpy-only readers work).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["save", "load"]
+
+_BF16_TAG = "__bf16__"
+
+
+def _pack(obj):
+    if isinstance(obj, Tensor):
+        arr = np.asarray(obj._data)
+        if str(obj._data.dtype) == "bfloat16":
+            return {_BF16_TAG: True,
+                    "data": np.asarray(obj._data.view(np.uint16))
+                    if hasattr(obj._data, "view") else arr.astype(np.float32)}
+        return {"__tensor__": True, "data": arr,
+                "stop_gradient": obj.stop_gradient}
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_pack(v) for v in obj)
+    return obj
+
+
+def _unpack(obj):
+    import jax.numpy as jnp
+
+    if isinstance(obj, dict):
+        if obj.get("__tensor__"):
+            return Tensor(jnp.asarray(obj["data"]),
+                          stop_gradient=obj.get("stop_gradient", True))
+        if obj.get(_BF16_TAG):
+            d = obj["data"]
+            if d.dtype == np.uint16:
+                return Tensor(jnp.asarray(d).view(jnp.bfloat16))
+            return Tensor(jnp.asarray(d, dtype=jnp.bfloat16))
+        return {k: _unpack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_unpack(v) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_pack(obj), f, protocol=protocol)
+
+
+def load(path, **configs):
+    with open(path, "rb") as f:
+        return _unpack(pickle.load(f))

@@ -1,0 +1,20 @@
+"""Fused-op functional APIs (reference: python/paddle/incubate/nn/functional/).
+
+These are the TPU fused tier: Pallas kernels where profitable, XLA-fused
+compositions otherwise (XLA already fuses most of what the reference needed
+hand-written CUDA for)."""
+from .flash_attention import (  # noqa: F401
+    flash_attention,
+    flash_attn_unpadded,
+    scaled_dot_product_attention,
+)
+from .fused_ops import (  # noqa: F401
+    fused_bias_dropout_residual_layer_norm,
+    fused_dropout_add,
+    fused_layer_norm,
+    fused_linear,
+    fused_linear_activation,
+    fused_rms_norm,
+    fused_rotary_position_embedding,
+    swiglu,
+)

@@ -1,0 +1,124 @@
+"""Flash attention (reference: paddle/phi/kernels/gpu/flash_attn_kernel.cu,
+python/paddle/nn/functional/flash_attention.py).
+
+Layout: [batch, seq, num_heads, head_dim] (paddle convention).
+
+On TPU this dispatches to the Pallas flash-attention kernel
+(:mod:`paddle_tpu.incubate.nn.pallas.flash_attn`) when the shapes tile onto
+the MXU (seq % block == 0, head_dim in {64,128,256}); otherwise it falls back
+to an XLA softmax composition, which XLA still fuses well.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ....core import random as _rng
+from ....ops._helpers import as_tensor, run_op, unwrap
+
+__all__ = ["flash_attention", "flash_attn_unpadded", "scaled_dot_product_attention"]
+
+
+def _use_pallas(q_shape, head_dim):
+    try:
+        from ..pallas import flash_attn  # noqa: F401
+    except Exception:
+        return False
+    if jax.default_backend() != "tpu":
+        return False
+    seq = q_shape[1]
+    return head_dim in (64, 128, 256) and seq % 128 == 0
+
+
+def _xla_attention(q, k, v, causal, scale=None):
+    """Reference composition: XLA fuses this into a reasonable kernel chain."""
+    qh = jnp.swapaxes(q, 1, 2)  # [b, h, s, d]
+    kh = jnp.swapaxes(k, 1, 2)
+    vh = jnp.swapaxes(v, 1, 2)
+    s = scale if scale is not None else qh.shape[-1] ** -0.5
+    logits = jnp.einsum("bhqd,bhkd->bhqk", qh, kh,
+                        preferred_element_type=jnp.float32) * s
+    if causal:
+        qlen, klen = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((qlen, klen), dtype=bool), klen - qlen)
+        logits = jnp.where(mask, logits, -1e30)
+    w = jax.nn.softmax(logits, axis=-1).astype(vh.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", w, vh)
+    return jnp.swapaxes(out, 1, 2)
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, fixed_seed_offset=None, rng_name="",
+                    training=True, name=None):
+    q, k, v = as_tensor(query), as_tensor(key), as_tensor(value)
+    head_dim = q.shape[-1]
+
+    if _use_pallas(tuple(q.shape), head_dim) and not return_softmax:
+        from ..pallas.flash_attn import flash_attention as pallas_fa
+
+        out = run_op(
+            functools.partial(pallas_fa, causal=causal),
+            [q, k, v], name="flash_attention",
+        )
+    else:
+        out = run_op(
+            lambda qa, ka, va: _xla_attention(qa, ka, va, causal),
+            [q, k, v], name="flash_attention",
+        )
+
+    if dropout > 0.0 and training:
+        key_ = _rng.next_key()
+        out = run_op(
+            lambda o: jnp.where(
+                jax.random.bernoulli(key_, 1.0 - dropout, o.shape),
+                o / (1.0 - dropout), 0.0).astype(o.dtype),
+            [out], name="attn_dropout",
+        )
+    if return_softmax:
+        return out, None
+    return out, None
+
+
+def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
+                        max_seqlen_q, max_seqlen_k, scale=None, dropout=0.0,
+                        causal=False, return_softmax=False, name=None):
+    """Varlen flash attention: segment-masked single-sequence attention.
+
+    q/k/v: [total_tokens, num_heads, head_dim]; cu_seqlens: [batch+1].
+    """
+    q, k, v = as_tensor(query), as_tensor(key), as_tensor(value)
+    cq = unwrap(as_tensor(cu_seqlens_q)).astype(jnp.int32)
+    ck = unwrap(as_tensor(cu_seqlens_k)).astype(jnp.int32)
+
+    def fn(qa, ka, va):
+        tq = qa.shape[0]
+        tk = ka.shape[0]
+        # segment id per token
+        seg_q = jnp.cumsum(
+            jnp.zeros(tq, jnp.int32).at[cq[1:-1]].add(1))
+        seg_k = jnp.cumsum(
+            jnp.zeros(tk, jnp.int32).at[ck[1:-1]].add(1))
+        s = scale if scale is not None else qa.shape[-1] ** -0.5
+        logits = jnp.einsum("qhd,khd->hqk", qa, ka,
+                            preferred_element_type=jnp.float32) * s
+        mask = seg_q[:, None] == seg_k[None, :]
+        if causal:
+            pos_q = jnp.arange(tq) - jnp.take(cq, seg_q)
+            pos_k = jnp.arange(tk) - jnp.take(ck, seg_k)
+            mask = mask & (pos_q[:, None] >= pos_k[None, :])
+        logits = jnp.where(mask[None], logits, -1e30)
+        w = jax.nn.softmax(logits, axis=-1).astype(va.dtype)
+        return jnp.einsum("hqk,khd->qhd", w, va)
+
+    out = run_op(fn, [q, k, v], name="flash_attn_unpadded")
+    return out, None
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False, training=True,
+                                 name=None):
+    from ....nn.functional.common import scaled_dot_product_attention as sdpa
+
+    return sdpa(query, key, value, attn_mask, dropout_p, is_causal, training)

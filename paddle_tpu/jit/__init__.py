@@ -1,0 +1,272 @@
+"""paddle_tpu.jit: to_static program capture + compiled execution
+(reference: python/paddle/jit/ — @to_static at jit/api.py:196, SOT/AST
+frontends under jit/sot/ and dy2static/).
+
+TPU-native design: instead of a CPython frame hook + bytecode tracer, the
+eager Tensor works transparently over jax tracers, so "to_static" is simply
+re-tracing the same Python under ``jax.jit``:
+
+  1. functionalize: parameters/buffers/RNG key become explicit inputs, buffer
+     mutations become explicit outputs (pure function);
+  2. compile: jax.jit caches per (shapes, dtypes) — the analog of the
+     reference's program cache (jit/dy2static/program_translator.py:150);
+  3. tape splice: the jitted forward is recorded on the eager tape via
+     jax.vjp, so ``loss.backward()`` runs the *compiled* backward program.
+
+Graph breaks don't exist: any Python control flow is evaluated at trace time
+(static), matching jax semantics; data-dependent branches should use
+paddle_tpu.ops.where / lax.cond-style ops.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core import random as _rng
+from ..core.autograd import no_grad, run_op
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+
+__all__ = ["to_static", "not_to_static", "save", "load", "ignore_module",
+           "enable_to_static", "TranslatedLayer", "InputSpec"]
+
+_to_static_enabled = True
+
+
+def enable_to_static(flag: bool):
+    global _to_static_enabled
+    _to_static_enabled = bool(flag)
+
+
+class InputSpec:
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.name = name
+
+
+def not_to_static(fn):
+    fn._not_to_static = True
+    return fn
+
+
+def ignore_module(modules):
+    pass
+
+
+class StaticFunction:
+    """Compiled wrapper (reference: jit/dy2static/program_translator.py:377).
+
+    Collects the owning Layer's parameters/buffers, builds a pure function,
+    and executes it under jax.jit with tape splicing for backward.
+    """
+
+    def __init__(self, fn, input_spec=None, build_strategy=None,
+                 full_graph=True):
+        self._fn = fn
+        self._input_spec = input_spec
+        self._layer: Optional[Layer] = None
+        if isinstance(fn, Layer):
+            self._layer = fn
+            self._fn = fn.forward
+        self._pure_cache = {}
+        functools.update_wrapper(self, self._fn)
+
+    def __get__(self, instance, owner):
+        if instance is None:
+            return self
+        bound = StaticFunction(self._fn.__get__(instance, owner),
+                               self._input_spec)
+        if isinstance(instance, Layer):
+            bound._layer = instance
+        setattr(instance, self._fn.__name__, bound)
+        return bound
+
+    def _collect_state(self):
+        layer = self._layer
+        if layer is None and hasattr(self._fn, "__self__") and isinstance(
+                self._fn.__self__, Layer):
+            layer = self._fn.__self__
+        if layer is None:
+            return [], []
+        params = [p for _, p in layer.named_parameters()]
+        buffers = [b for _, b in layer.named_buffers() if b is not None]
+        return params, buffers
+
+    def _make_pure(self, n_params, n_buffers, n_inputs, in_treedef,
+                   static_kwargs, training):
+        fn = self._fn
+        cell = {}
+
+        @jax.jit
+        def pure(key, *arrays):
+            params_a = arrays[:n_params]
+            buffers_a = arrays[n_params:n_params + n_buffers]
+            inputs_a = arrays[n_params + n_buffers:]
+            params, buffers = self._collect_state()
+            saved_p = [p._data for p in params]
+            saved_b = [b._data for b in buffers]
+            for p, a in zip(params, params_a):
+                p._data = a
+            for b, a in zip(buffers, buffers_a):
+                b._data = a
+            try:
+                with _rng.rng_guard(key):
+                    in_tensors = jax.tree_util.tree_unflatten(
+                        in_treedef, [Tensor(a) for a in inputs_a])
+                    out = fn(*in_tensors, **static_kwargs)
+                out_leaves, out_treedef = jax.tree_util.tree_flatten(
+                    out, is_leaf=lambda x: isinstance(x, Tensor))
+                out_arrays = tuple(
+                    o._data if isinstance(o, Tensor) else jnp.asarray(o)
+                    for o in out_leaves)
+                new_buffers = tuple(b._data for b in buffers)
+            finally:
+                for p, a in zip(params, saved_p):
+                    p._data = a
+                for b, a in zip(buffers, saved_b):
+                    b._data = a
+            cell["treedef"] = out_treedef
+            return out_arrays + new_buffers
+
+        pure._cell = cell
+        return pure
+
+    def __call__(self, *args, **kwargs):
+        if not _to_static_enabled:
+            return self._fn(*args, **kwargs)
+        params, buffers = self._collect_state()
+        in_leaves, in_treedef = jax.tree_util.tree_flatten(
+            args, is_leaf=lambda x: isinstance(x, Tensor))
+        tensor_inputs = [x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+                         for x in in_leaves]
+        static_kwargs = kwargs
+        training = self._layer.training if self._layer is not None else True
+
+        cache_key = (len(params), len(buffers), len(tensor_inputs),
+                     in_treedef, tuple(sorted(static_kwargs.items(),
+                                              key=lambda kv: kv[0])), training)
+        try:
+            pure = self._pure_cache[cache_key]
+        except (KeyError, TypeError):
+            pure = self._make_pure(len(params), len(buffers),
+                                   len(tensor_inputs), in_treedef,
+                                   static_kwargs, training)
+            try:
+                self._pure_cache[cache_key] = pure
+            except TypeError:
+                pass
+
+        key = _rng.next_key()
+        n_out_buffers = len(buffers)
+
+        all_inputs = list(params) + list(buffers) + tensor_inputs
+        outs = run_op(lambda *arrays: pure(key, *arrays), all_inputs,
+                      name="static_fn")
+        if not isinstance(outs, tuple):
+            outs = (outs,)
+        if n_out_buffers:
+            out_main = outs[:-n_out_buffers]
+            new_buffers = outs[-n_out_buffers:]
+            with no_grad():
+                for b, nb in zip(buffers, new_buffers):
+                    b._data = nb._data
+        else:
+            out_main = outs
+        out_treedef = pure._cell.get("treedef")
+        if out_treedef is not None:
+            try:
+                return jax.tree_util.tree_unflatten(out_treedef,
+                                                    list(out_main))
+            except Exception:
+                pass
+        return out_main[0] if len(out_main) == 1 else out_main
+
+    @property
+    def code(self):
+        import inspect
+
+        return inspect.getsource(self._fn)
+
+    def concrete_program(self):
+        return None
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, full_graph=True, **kwargs):
+    """Decorator / wrapper (reference: python/paddle/jit/api.py:196)."""
+
+    def decorate(fn):
+        if isinstance(fn, Layer):
+            sf = StaticFunction(fn, input_spec)
+            fn.forward = sf
+            return fn
+        return StaticFunction(fn, input_spec)
+
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+class TranslatedLayer(Layer):
+    """Loaded inference program (reference:
+    python/paddle/jit/translated_layer.py)."""
+
+    def __init__(self, state_dict, config, forward_fn=None):
+        super().__init__()
+        self._loaded_state = state_dict
+        self._config = config
+        self._forward_fn = forward_fn
+
+    def forward(self, *args):
+        if self._forward_fn is None:
+            raise RuntimeError("this TranslatedLayer has no executable program")
+        return self._forward_fn(*args)
+
+    def state_dict(self, *a, **k):
+        return dict(self._loaded_state)
+
+
+def save(layer, path, input_spec=None, **configs):
+    """jit.save (reference: python/paddle/jit/api.py save): persist params +
+    a serialized StableHLO program for the forward when input_spec known."""
+    from ..framework.io_utils import save as _save
+
+    state = layer.state_dict() if isinstance(layer, Layer) else {}
+    payload = {"state_dict": state, "config": {"class": type(layer).__name__}}
+    if input_spec:
+        try:
+            import jax.export as jexport
+
+            params, buffers = [], []
+            if isinstance(layer, Layer):
+                params = [p._data for p in layer.parameters()]
+
+            def infer_fn(*inputs):
+                with no_grad():
+                    out = layer(*[Tensor(i) for i in inputs])
+                leaves, _ = jax.tree_util.tree_flatten(
+                    out, is_leaf=lambda x: isinstance(x, Tensor))
+                return tuple(l._data if isinstance(l, Tensor) else l
+                             for l in leaves)
+
+            shapes = [jax.ShapeDtypeStruct(tuple(s.shape),
+                                           jnp.dtype(str(s.dtype)))
+                      for s in input_spec]
+            exported = jexport.export(jax.jit(infer_fn))(*shapes)
+            payload["stablehlo"] = exported.mlir_module()
+        except Exception:
+            pass
+    _save(payload, path + ".pdmodel" if not path.endswith(".pdmodel") else path)
+
+
+def load(path, **configs):
+    from ..framework.io_utils import load as _load
+
+    p = path if path.endswith(".pdmodel") else path + ".pdmodel"
+    payload = _load(p)
+    return TranslatedLayer(payload.get("state_dict", {}),
+                           payload.get("config", {}))

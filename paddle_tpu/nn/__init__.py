@@ -1,0 +1,40 @@
+"""paddle_tpu.nn (reference: python/paddle/nn/__init__.py)."""
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+from .layer.layers import Layer, Parameter  # noqa: F401
+from .layer.container import (  # noqa: F401
+    LayerDict,
+    LayerList,
+    ParameterList,
+    Sequential,
+)
+from .layer.common import *  # noqa: F401,F403
+from .layer.conv import *  # noqa: F401,F403
+from .layer.pooling import *  # noqa: F401,F403
+from .layer.norm import *  # noqa: F401,F403
+from .layer.activation import *  # noqa: F401,F403
+from .layer.loss import *  # noqa: F401,F403
+from .layer.transformer import *  # noqa: F401,F403
+from .layer.rnn import *  # noqa: F401,F403
+
+from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401
+
+from .layer import (  # noqa: F401
+    activation,
+    common,
+    container,
+    conv,
+    layers,
+    loss,
+    norm,
+    pooling,
+    rnn,
+    transformer,
+)
+
+
+def utils_clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                          error_if_nonfinite=False):
+    from .utils.clip_grad import clip_grad_norm_
+
+    return clip_grad_norm_(parameters, max_norm, norm_type, error_if_nonfinite)

@@ -1,0 +1,24 @@
+"""paddle_tpu.nn.functional (reference: python/paddle/nn/functional/)."""
+from .activation import *  # noqa: F401,F403
+from .common import *  # noqa: F401,F403
+from .conv import *  # noqa: F401,F403
+from .pooling import *  # noqa: F401,F403
+from .norm import *  # noqa: F401,F403
+from .loss import *  # noqa: F401,F403
+
+from . import activation, common, conv, pooling, norm, loss  # noqa: F401
+
+from ...incubate.nn.functional.flash_attention import (  # noqa: F401
+    flash_attention,
+    flash_attn_unpadded,
+)
+
+__all__ = (
+    activation.__all__
+    + common.__all__
+    + conv.__all__
+    + pooling.__all__
+    + norm.__all__
+    + loss.__all__
+    + ["flash_attention", "flash_attn_unpadded"]
+)

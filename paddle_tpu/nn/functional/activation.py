@@ -1,0 +1,227 @@
+"""Activation functionals (reference: python/paddle/nn/functional/activation.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...ops._helpers import as_tensor, run_op, unary, unwrap
+
+__all__ = [
+    "relu", "relu_", "relu6", "elu", "selu", "celu", "gelu", "silu", "swish",
+    "sigmoid", "hardsigmoid", "hardswish", "hardtanh", "hardshrink",
+    "softshrink", "tanhshrink", "leaky_relu", "prelu", "rrelu", "log_sigmoid",
+    "maxout", "softplus", "softsign", "tanh", "mish", "softmax", "log_softmax",
+    "gumbel_softmax", "glu", "swiglu", "thresholded_relu",
+]
+
+
+def relu(x, name=None):
+    return unary(jax.nn.relu, x, "relu")
+
+
+def relu_(x, name=None):
+    x._data = jax.nn.relu(x._data)
+    x._grad_node = None
+    return x
+
+
+def relu6(x, name=None):
+    return unary(jax.nn.relu6, x, "relu6")
+
+
+def elu(x, alpha=1.0, name=None):
+    return unary(lambda a: jax.nn.elu(a, alpha), x, "elu")
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return unary(lambda a: scale * jnp.where(a > 0, a, alpha * jnp.expm1(a)),
+                 x, "selu")
+
+
+def celu(x, alpha=1.0, name=None):
+    return unary(lambda a: jax.nn.celu(a, alpha), x, "celu")
+
+
+def gelu(x, approximate=False, name=None):
+    return unary(lambda a: jax.nn.gelu(a, approximate=approximate), x, "gelu")
+
+
+def silu(x, name=None):
+    return unary(jax.nn.silu, x, "silu")
+
+
+def swish(x, name=None):
+    return unary(jax.nn.silu, x, "swish")
+
+
+def sigmoid(x, name=None):
+    return unary(jax.nn.sigmoid, x, "sigmoid")
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return unary(lambda a: jnp.clip(slope * a + offset, 0.0, 1.0), x,
+                 "hardsigmoid")
+
+
+def hardswish(x, name=None):
+    return unary(lambda a: a * jnp.clip(a + 3.0, 0.0, 6.0) / 6.0, x, "hardswish")
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return unary(lambda a: jnp.clip(a, min, max), x, "hardtanh")
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return unary(lambda a: jnp.where(jnp.abs(a) > threshold, a, 0.0), x,
+                 "hardshrink")
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return unary(
+        lambda a: jnp.where(a > threshold, a - threshold,
+                            jnp.where(a < -threshold, a + threshold, 0.0)),
+        x, "softshrink")
+
+
+def tanhshrink(x, name=None):
+    return unary(lambda a: a - jnp.tanh(a), x, "tanhshrink")
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return unary(lambda a: jax.nn.leaky_relu(a, negative_slope), x, "leaky_relu")
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    w = as_tensor(weight)
+
+    def fn(a, wa):
+        if wa.size == 1:
+            return jnp.where(a > 0, a, wa.reshape(()) * a)
+        if data_format == "NCHW":
+            shape = (1, -1) + (1,) * (a.ndim - 2)
+        else:
+            shape = (1,) * (a.ndim - 1) + (-1,)
+        return jnp.where(a > 0, a, wa.reshape(shape) * a)
+
+    return run_op(fn, [as_tensor(x), w], name="prelu")
+
+
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=True, name=None):
+    from ...core import random as _rng
+
+    if training:
+        def fn(a):
+            r = jax.random.uniform(_rng.next_key(), a.shape, minval=lower,
+                                   maxval=upper)
+            return jnp.where(a >= 0, a, r * a)
+    else:
+        mid = (lower + upper) / 2.0
+
+        def fn(a):
+            return jnp.where(a >= 0, a, mid * a)
+
+    return unary(fn, x, "rrelu")
+
+
+def log_sigmoid(x, name=None):
+    return unary(jax.nn.log_sigmoid, x, "log_sigmoid")
+
+
+def maxout(x, groups, axis=1, name=None):
+    def fn(a):
+        ax = axis % a.ndim
+        c = a.shape[ax]
+        new_shape = a.shape[:ax] + (c // groups, groups) + a.shape[ax + 1:]
+        return jnp.max(a.reshape(new_shape), axis=ax + 1)
+
+    return unary(fn, x, "maxout")
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return unary(
+        lambda a: jnp.where(beta * a > threshold, a,
+                            jnp.log1p(jnp.exp(beta * a)) / beta),
+        x, "softplus")
+
+
+def softsign(x, name=None):
+    return unary(jax.nn.soft_sign, x, "softsign")
+
+
+def tanh(x, name=None):
+    return unary(jnp.tanh, x, "tanh")
+
+
+def mish(x, name=None):
+    return unary(lambda a: a * jnp.tanh(jax.nn.softplus(a)), x, "mish")
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    from ...core.dtype import to_jax_dtype
+
+    jdt = to_jax_dtype(dtype)
+
+    def fn(a):
+        if jdt is not None:
+            a = a.astype(jdt)
+        return jax.nn.softmax(a, axis=axis)
+
+    return unary(fn, x, "softmax")
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    from ...core.dtype import to_jax_dtype
+
+    jdt = to_jax_dtype(dtype)
+
+    def fn(a):
+        if jdt is not None:
+            a = a.astype(jdt)
+        return jax.nn.log_softmax(a, axis=axis)
+
+    return unary(fn, x, "log_softmax")
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...core import random as _rng
+
+    key = _rng.next_key()
+
+    def fn(a):
+        g = jax.random.gumbel(key, a.shape, dtype=a.dtype)
+        y = jax.nn.softmax((a + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            y_hard = jnp.zeros_like(y)
+            y_hard = jnp.put_along_axis(y_hard, idx, 1.0, axis=axis,
+                                        inplace=False)
+            y = y_hard - jax.lax.stop_gradient(y) + y
+        return y
+
+    return unary(fn, x, "gumbel_softmax")
+
+
+def glu(x, axis=-1, name=None):
+    def fn(a):
+        a1, a2 = jnp.split(a, 2, axis=axis)
+        return a1 * jax.nn.sigmoid(a2)
+
+    return unary(fn, x, "glu")
+
+
+def swiglu(x, y=None, name=None):
+    """SwiGLU (reference: python/paddle/incubate/nn/functional/swiglu.py):
+    silu(x) * y; single-arg form splits last dim in half."""
+    if y is None:
+        def fn(a):
+            a1, a2 = jnp.split(a, 2, axis=-1)
+            return jax.nn.silu(a1) * a2
+
+        return unary(fn, x, "swiglu")
+    return run_op(lambda a, b: jax.nn.silu(a) * b,
+                  [as_tensor(x), as_tensor(y)], name="swiglu")
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return unary(lambda a: jnp.where(a > threshold, a, value), x,
+                 "thresholded_relu")

@@ -1,0 +1,334 @@
+"""Common functionals: linear, dropout, embedding, interpolate, attention
+(reference: python/paddle/nn/functional/common.py, input.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core import random as _rng
+from ...core.tensor import Tensor
+from ...ops._helpers import as_tensor, run_op, unary, unwrap
+
+__all__ = [
+    "linear", "dropout", "dropout2d", "dropout3d", "alpha_dropout",
+    "embedding", "one_hot", "label_smooth", "pad", "interpolate", "upsample",
+    "bilinear", "cosine_similarity", "pixel_shuffle", "pixel_unshuffle",
+    "channel_shuffle", "unfold", "fold", "scaled_dot_product_attention",
+    "pairwise_distance", "normalize",
+]
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W (+ b). Weight layout [in, out] (paddle convention,
+    reference: python/paddle/nn/functional/common.py linear)."""
+    ts = [as_tensor(x), as_tensor(weight)]
+    if bias is not None:
+        ts.append(as_tensor(bias))
+        return run_op(lambda a, w, b: jnp.matmul(a, w) + b, ts, name="linear")
+    return run_op(lambda a, w: jnp.matmul(a, w), ts, name="linear")
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None):
+    if not training or p == 0.0:
+        return as_tensor(x).clone()
+    key = _rng.next_key()
+
+    def fn(a):
+        if axis is None:
+            shape = a.shape
+        else:
+            axes = (axis,) if isinstance(axis, int) else tuple(axis)
+            shape = tuple(s if i in axes else 1 for i, s in enumerate(a.shape))
+        keep = jax.random.bernoulli(key, 1.0 - p, shape)
+        if mode == "upscale_in_train":
+            return jnp.where(keep, a / (1.0 - p), 0.0).astype(a.dtype)
+        return jnp.where(keep, a, 0.0).astype(a.dtype)
+
+    return unary(fn, x, "dropout")
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    ax = (0, 1) if data_format == "NCHW" else (0, 3)
+    return dropout(x, p=p, axis=list(ax), training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    ax = (0, 1) if data_format == "NCDHW" else (0, 4)
+    return dropout(x, p=p, axis=list(ax), training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return as_tensor(x).clone()
+    key = _rng.next_key()
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+
+    def fn(a):
+        keep = jax.random.bernoulli(key, 1.0 - p, a.shape)
+        aa = 1.0 / jnp.sqrt((1.0 - p) * (1.0 + p * alpha_p ** 2))
+        bb = -aa * alpha_p * p
+        return (aa * jnp.where(keep, a, alpha_p) + bb).astype(a.dtype)
+
+    return unary(fn, x, "alpha_dropout")
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    idx = unwrap(as_tensor(x))
+
+    def fn(w):
+        out = jnp.take(w, idx, axis=0)
+        if padding_idx is not None:
+            mask = (idx == padding_idx)[..., None]
+            out = jnp.where(mask, 0.0, out)
+        return out
+
+    return unary(fn, as_tensor(weight), "embedding")
+
+
+def one_hot(x, num_classes, name=None):
+    return unary(lambda a: jax.nn.one_hot(a, num_classes, dtype=jnp.float32),
+                 as_tensor(x), "one_hot")
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def fn(a):
+        k = a.shape[-1]
+        if prior_dist is not None:
+            pd = unwrap(as_tensor(prior_dist))
+            return (1 - epsilon) * a + epsilon * pd
+        return (1 - epsilon) * a + epsilon / k
+
+    return unary(fn, as_tensor(label), "label_smooth")
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    from ...ops.manipulation import pad as _pad
+
+    return _pad(x, pad, mode=mode, value=value, data_format=data_format)
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW",
+                name=None):
+    x = as_tensor(x)
+    nd = x.ndim
+    channel_last = data_format in ("NHWC", "NWC", "NDHWC")
+    spatial = nd - 2
+
+    def get_out_size(in_shape):
+        if size is not None:
+            sz = [int(unwrap(s)) for s in (size if isinstance(size, (list, tuple))
+                                           else [size])]
+            return tuple(sz)
+        sf = scale_factor if isinstance(scale_factor, (list, tuple)) \
+            else [scale_factor] * spatial
+        return tuple(int(s * f) for s, f in zip(in_shape, sf))
+
+    def fn(a):
+        if channel_last:
+            a_ = jnp.moveaxis(a, -1, 1)
+        else:
+            a_ = a
+        in_spatial = a_.shape[2:]
+        out_spatial = get_out_size(in_spatial)
+        jmode = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
+                 "trilinear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
+        out = jax.image.resize(a_, a_.shape[:2] + out_spatial, method=jmode)
+        if channel_last:
+            out = jnp.moveaxis(out, 1, -1)
+        return out.astype(a.dtype)
+
+    return unary(fn, x, "interpolate")
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode,
+                       data_format)
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    ts = [as_tensor(x1), as_tensor(x2), as_tensor(weight)]
+    if bias is not None:
+        ts.append(as_tensor(bias))
+
+        def fn(a, b, w, bi):
+            return jnp.einsum("bi,oij,bj->bo", a, w, b) + bi
+    else:
+        def fn(a, b, w):
+            return jnp.einsum("bi,oij,bj->bo", a, w, b)
+
+    return run_op(fn, ts, name="bilinear")
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    def fn(a, b):
+        num = jnp.sum(a * b, axis=axis)
+        den = jnp.linalg.norm(a, axis=axis) * jnp.linalg.norm(b, axis=axis)
+        return num / jnp.maximum(den, eps)
+
+    return run_op(fn, [as_tensor(x1), as_tensor(x2)], name="cosine_similarity")
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    def fn(a, b):
+        d = a - b + epsilon
+        return jnp.sum(jnp.abs(d) ** p, axis=-1, keepdims=keepdim) ** (1.0 / p)
+
+    return run_op(fn, [as_tensor(x), as_tensor(y)], name="pairwise_distance")
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def fn(a):
+        nrm = jnp.sum(jnp.abs(a) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+        return a / jnp.maximum(nrm, epsilon)
+
+    return unary(fn, x, "normalize")
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+
+    def fn(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a = a.reshape(n, c // (r * r), r, r, h, w)
+            a = a.transpose(0, 1, 4, 2, 5, 3)
+            return a.reshape(n, c // (r * r), h * r, w * r)
+        n, h, w, c = a.shape
+        a = a.reshape(n, h, w, r, r, c // (r * r))
+        a = a.transpose(0, 1, 3, 2, 4, 5)
+        return a.reshape(n, h * r, w * r, c // (r * r))
+
+    return unary(fn, as_tensor(x), "pixel_shuffle")
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = downscale_factor
+
+    def fn(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a = a.reshape(n, c, h // r, r, w // r, r)
+            a = a.transpose(0, 1, 3, 5, 2, 4)
+            return a.reshape(n, c * r * r, h // r, w // r)
+        n, h, w, c = a.shape
+        a = a.reshape(n, h // r, r, w // r, r, c)
+        a = a.transpose(0, 1, 3, 2, 4, 5)
+        return a.reshape(n, h // r, w // r, c * r * r)
+
+    return unary(fn, as_tensor(x), "pixel_unshuffle")
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    def fn(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a = a.reshape(n, groups, c // groups, h, w)
+            a = a.transpose(0, 2, 1, 3, 4)
+            return a.reshape(n, c, h, w)
+        n, h, w, c = a.shape
+        a = a.reshape(n, h, w, groups, c // groups)
+        a = a.transpose(0, 1, 2, 4, 3)
+        return a.reshape(n, h, w, c)
+
+    return unary(fn, as_tensor(x), "channel_shuffle")
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    def tolist2(v):
+        return [v, v] if isinstance(v, int) else list(v)
+
+    k = tolist2(kernel_sizes)
+    s = tolist2(strides)
+    p = tolist2(paddings)
+    d = tolist2(dilations)
+    if len(p) == 2:
+        p = [p[0], p[0], p[1], p[1]]
+
+    def fn(a):
+        n, c, h, w = a.shape
+        a = jnp.pad(a, ((0, 0), (0, 0), (p[0], p[1]), (p[2], p[3])))
+        oh = (a.shape[2] - (d[0] * (k[0] - 1) + 1)) // s[0] + 1
+        ow = (a.shape[3] - (d[1] * (k[1] - 1) + 1)) // s[1] + 1
+        patches = []
+        for i in range(k[0]):
+            for j in range(k[1]):
+                sl = a[:, :, i * d[0]: i * d[0] + oh * s[0]: s[0],
+                       j * d[1]: j * d[1] + ow * s[1]: s[1]]
+                patches.append(sl)
+        # [N, C*kh*kw, oh*ow]
+        out = jnp.stack(patches, axis=2).reshape(n, c * k[0] * k[1], oh * ow)
+        return out
+
+    return unary(fn, as_tensor(x), "unfold")
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    def tolist2(v):
+        return [v, v] if isinstance(v, int) else list(v)
+
+    osz = tolist2(output_sizes)
+    k = tolist2(kernel_sizes)
+    s = tolist2(strides)
+    p = tolist2(paddings)
+    d = tolist2(dilations)
+    if len(p) == 2:
+        p = [p[0], p[0], p[1], p[1]]
+
+    def fn(a):
+        n, ckk, L = a.shape
+        c = ckk // (k[0] * k[1])
+        ph, pw = osz[0] + p[0] + p[1], osz[1] + p[2] + p[3]
+        oh = (ph - (d[0] * (k[0] - 1) + 1)) // s[0] + 1
+        ow = (pw - (d[1] * (k[1] - 1) + 1)) // s[1] + 1
+        a = a.reshape(n, c, k[0], k[1], oh, ow)
+        out = jnp.zeros((n, c, ph, pw), dtype=a.dtype)
+        for i in range(k[0]):
+            for j in range(k[1]):
+                out = out.at[:, :, i * d[0]: i * d[0] + oh * s[0]: s[0],
+                             j * d[1]: j * d[1] + ow * s[1]: s[1]].add(
+                    a[:, :, i, j])
+        return out[:, :, p[0]: ph - p[1], p[2]: pw - p[3]]
+
+    return unary(fn, as_tensor(x), "fold")
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, name=None):
+    """SDPA with [batch, seq, heads, head_dim] layout (paddle convention,
+    reference: python/paddle/nn/functional/flash_attention.py).
+
+    Dispatches to the Pallas flash-attention kernel on TPU when shapes allow;
+    falls back to the XLA softmax composition otherwise."""
+    from ...incubate.nn.functional import flash_attention as _fa_mod
+    from ...incubate.nn.functional.flash_attention import flash_attention as _fa
+
+    out, _ = _fa(query, key, value, dropout=dropout_p,
+                 causal=is_causal, training=training)
+    if attn_mask is not None:
+        # masked path: use the reference composition
+        q, k, v = as_tensor(query), as_tensor(key), as_tensor(value)
+        m = unwrap(as_tensor(attn_mask))
+
+        def fn(qa, ka, va):
+            qh = jnp.swapaxes(qa, 1, 2)  # [b, h, s, d]
+            kh = jnp.swapaxes(ka, 1, 2)
+            vh = jnp.swapaxes(va, 1, 2)
+            scale = qh.shape[-1] ** -0.5
+            logits = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * scale
+            if m.dtype == jnp.bool_:
+                logits = jnp.where(m, logits, -1e9)
+            else:
+                logits = logits + m
+            w = jax.nn.softmax(logits, axis=-1)
+            out = jnp.einsum("bhqk,bhkd->bhqd", w, vh)
+            return jnp.swapaxes(out, 1, 2)
+
+        return run_op(fn, [q, k, v], name="sdpa")
+    return out

@@ -1,0 +1,159 @@
+"""Convolution functionals over lax.conv_general_dilated (reference:
+python/paddle/nn/functional/conv.py). XLA maps these onto the MXU directly."""
+from __future__ import annotations
+
+import jax.lax as lax
+import jax.numpy as jnp
+
+from ...ops._helpers import as_tensor, run_op
+
+__all__ = ["conv1d", "conv2d", "conv3d", "conv1d_transpose", "conv2d_transpose",
+           "conv3d_transpose"]
+
+
+def _norm_tuple(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(int(x) for x in v)
+
+
+def _norm_padding(padding, n):
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, int) for p in padding):
+        return [(p, p) for p in padding]
+    if len(padding) == 2 * n:
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(n)]
+    return [tuple(p) for p in padding]
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, n,
+          channel_last, name):
+    stride = _norm_tuple(stride, n)
+    dilation = _norm_tuple(dilation, n)
+    pad = _norm_padding(padding, n)
+    if channel_last:
+        lhs_spec = "N" + "DHW"[3 - n:] + "C"
+    else:
+        lhs_spec = "NC" + "DHW"[3 - n:]
+    rhs_spec = "OI" + "DHW"[3 - n:]
+    out_spec = lhs_spec
+    dn = lax.conv_dimension_numbers((1,) * (n + 2), (1,) * (n + 2),
+                                    (lhs_spec, rhs_spec, out_spec))
+
+    ts = [as_tensor(x), as_tensor(weight)]
+    has_bias = bias is not None
+    if has_bias:
+        ts.append(as_tensor(bias))
+
+    def fn(a, w, *b):
+        out = lax.conv_general_dilated(
+            a, w, window_strides=stride, padding=pad,
+            rhs_dilation=dilation, dimension_numbers=dn,
+            feature_group_count=groups,
+            preferred_element_type=jnp.float32 if a.dtype == jnp.float32 else None,
+        )
+        if b:
+            bias_shape = (1, -1) + (1,) * n if not channel_last \
+                else (1,) * (n + 1) + (-1,)
+            out = out + b[0].reshape(bias_shape)
+        return out.astype(a.dtype)
+
+    return run_op(fn, ts, name=name)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 1,
+                 data_format == "NLC", "conv1d")
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 2,
+                 data_format == "NHWC", "conv2d")
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 3,
+                 data_format == "NDHWC", "conv3d")
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
+                    groups, n, channel_last, output_size, name):
+    stride = _norm_tuple(stride, n)
+    dilation = _norm_tuple(dilation, n)
+    pad = _norm_padding(padding, n)
+    opad = _norm_tuple(output_padding, n)
+    if channel_last:
+        lhs_spec = "N" + "DHW"[3 - n:] + "C"
+    else:
+        lhs_spec = "NC" + "DHW"[3 - n:]
+    rhs_spec = "IO" + "DHW"[3 - n:]  # paddle transpose-conv weight: [in, out/g, *k]
+    out_spec = lhs_spec
+    dn = lax.conv_dimension_numbers((1,) * (n + 2), (1,) * (n + 2),
+                                    (lhs_spec, rhs_spec, out_spec))
+
+    ts = [as_tensor(x), as_tensor(weight)]
+    has_bias = bias is not None
+    if has_bias:
+        ts.append(as_tensor(bias))
+
+    def fn(a, w, *b):
+        if isinstance(pad, str):
+            padding_cfg = pad
+        else:
+            # conv_transpose padding semantics: output cropped by `pad`
+            k = [(w.shape[2 + i] - 1) * dilation[i] + 1 for i in range(n)]
+            padding_cfg = [
+                (k[i] - 1 - pad[i][0], k[i] - 1 - pad[i][1] + opad[i])
+                for i in range(n)
+            ]
+        out = lax.conv_general_dilated(
+            a, w, window_strides=(1,) * n, padding=padding_cfg,
+            lhs_dilation=stride, rhs_dilation=dilation,
+            dimension_numbers=dn, feature_group_count=groups,
+            transpose_kernel=True,
+        )
+        if b:
+            bias_shape = (1, -1) + (1,) * n if not channel_last \
+                else (1,) * (n + 1) + (-1,)
+            out = out + b[0].reshape(bias_shape)
+        return out.astype(a.dtype)
+
+    out = run_op(fn, ts, name=name)
+    if output_size is not None:
+        osz = _norm_tuple(output_size, n)
+        sl = [slice(None), slice(None)] + [slice(0, s) for s in osz]
+        if channel_last:
+            sl = [slice(None)] + [slice(0, s) for s in osz] + [slice(None)]
+        out = out[tuple(sl)]
+    return out
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCL", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 1, data_format == "NLC",
+                           output_size, "conv1d_transpose")
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 2, data_format == "NHWC",
+                           output_size, "conv2d_transpose")
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCDHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 3, data_format == "NDHWC",
+                           output_size, "conv3d_transpose")

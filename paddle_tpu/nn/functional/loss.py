@@ -1,0 +1,402 @@
+"""Loss functionals (reference: python/paddle/nn/functional/loss.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...ops._helpers import as_tensor, run_op, unary, unwrap
+
+__all__ = [
+    "cross_entropy", "softmax_with_cross_entropy", "nll_loss", "mse_loss",
+    "l1_loss", "smooth_l1_loss", "binary_cross_entropy",
+    "binary_cross_entropy_with_logits", "kl_div", "margin_ranking_loss",
+    "hinge_embedding_loss", "cosine_embedding_loss", "triplet_margin_loss",
+    "log_loss", "square_error_cost", "sigmoid_focal_loss", "dice_loss",
+    "ctc_loss", "poisson_nll_loss", "gaussian_nll_loss", "multi_label_soft_margin_loss",
+    "soft_margin_loss",
+]
+
+
+def _reduce(out, reduction):
+    if reduction == "mean":
+        return jnp.mean(out)
+    if reduction == "sum":
+        return jnp.sum(out)
+    return out
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, label_smoothing=0.0, name=None):
+    x = as_tensor(input)
+    lab = unwrap(as_tensor(label))
+    ts = [x]
+    has_w = weight is not None
+    if has_w:
+        ts.append(as_tensor(weight))
+
+    def fn(a, *w):
+        logp = jax.nn.log_softmax(a, axis=axis) if use_softmax else jnp.log(
+            jnp.maximum(a, 1e-30))
+        nc = a.shape[axis]
+        if soft_label or (lab.ndim == a.ndim and lab.shape == a.shape):
+            soft = lab.astype(logp.dtype)
+            if label_smoothing > 0:
+                soft = soft * (1 - label_smoothing) + label_smoothing / nc
+            out = -jnp.sum(soft * logp, axis=axis)
+        else:
+            li = lab
+            if li.ndim == a.ndim and li.shape[axis] == 1:
+                li = jnp.squeeze(li, axis=axis)
+            li = li.astype(jnp.int32)
+            valid = li != ignore_index
+            li_safe = jnp.where(valid, li, 0)
+            picked = jnp.take_along_axis(
+                logp, jnp.expand_dims(li_safe, axis), axis=axis
+            ).squeeze(axis)
+            if label_smoothing > 0:
+                smooth = jnp.mean(logp, axis=axis)
+                picked = (1 - label_smoothing) * picked + label_smoothing * smooth
+            out = -jnp.where(valid, picked, 0.0)
+            if has_w:
+                wv = jnp.take(w[0], li_safe, axis=0)
+                out = out * jnp.where(valid, wv, 0.0)
+                if reduction == "mean":
+                    denom = jnp.sum(jnp.where(valid, wv, 0.0))
+                    return jnp.sum(out) / jnp.maximum(denom, 1e-12)
+            if reduction == "mean":
+                denom = jnp.sum(valid.astype(out.dtype))
+                return jnp.sum(out) / jnp.maximum(denom, 1.0)
+        return _reduce(out, reduction)
+
+    return run_op(fn, ts, name="cross_entropy")
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1, name=None):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none", axis=axis)
+    lab = unwrap(as_tensor(label))
+    # hard label carrying the class axis ([N, 1]): cross_entropy squeezed it,
+    # restore so loss shape matches the paddle contract ([N, 1])
+    if not soft_label and lab.ndim == as_tensor(logits).ndim:
+        from ...ops.manipulation import unsqueeze
+
+        loss = unsqueeze(loss, axis)
+    if return_softmax:
+        from .activation import softmax
+
+        return loss, softmax(logits, axis=axis)
+    return loss
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
+             name=None):
+    lab = unwrap(as_tensor(label)).astype(jnp.int32)
+    ts = [as_tensor(input)]
+    has_w = weight is not None
+    if has_w:
+        ts.append(as_tensor(weight))
+
+    def fn(a, *w):
+        valid = lab != ignore_index
+        safe = jnp.where(valid, lab, 0)
+        picked = jnp.take_along_axis(a, jnp.expand_dims(safe, 1), axis=1
+                                     ).squeeze(1)
+        out = -jnp.where(valid, picked, 0.0)
+        if has_w:
+            wv = jnp.take(w[0], safe, axis=0) * valid
+            out = out * wv
+            if reduction == "mean":
+                return jnp.sum(out) / jnp.maximum(jnp.sum(wv), 1e-12)
+        elif reduction == "mean":
+            return jnp.sum(out) / jnp.maximum(jnp.sum(valid), 1)
+        return _reduce(out, reduction)
+
+    return run_op(fn, ts, name="nll_loss")
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return run_op(lambda a, b: _reduce((a - b) ** 2, reduction),
+                  [as_tensor(input), as_tensor(label)], name="mse_loss")
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return run_op(lambda a, b: _reduce(jnp.abs(a - b), reduction),
+                  [as_tensor(input), as_tensor(label)], name="l1_loss")
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def fn(a, b):
+        d = a - b
+        ad = jnp.abs(d)
+        out = jnp.where(ad < delta, 0.5 * d * d / delta, ad - 0.5 * delta)
+        # paddle multiplies by delta (huber): loss = delta * huber_delta
+        return _reduce(out * delta, reduction)
+
+    return run_op(fn, [as_tensor(input), as_tensor(label)],
+                  name="smooth_l1_loss")
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean",
+                         name=None):
+    ts = [as_tensor(input), as_tensor(label)]
+    has_w = weight is not None
+    if has_w:
+        ts.append(as_tensor(weight))
+
+    def fn(a, b, *w):
+        a = jnp.clip(a, 1e-12, 1.0 - 1e-12)
+        out = -(b * jnp.log(a) + (1 - b) * jnp.log(1 - a))
+        if has_w:
+            out = out * w[0]
+        return _reduce(out, reduction)
+
+    return run_op(fn, ts, name="binary_cross_entropy")
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None,
+                                     name=None):
+    ts = [as_tensor(logit), as_tensor(label)]
+    has_w = weight is not None
+    has_pw = pos_weight is not None
+    if has_w:
+        ts.append(as_tensor(weight))
+    if has_pw:
+        ts.append(as_tensor(pos_weight))
+
+    def fn(a, b, *rest):
+        i = 0
+        w = rest[i] if has_w else None
+        if has_w:
+            i += 1
+        pw = rest[i] if has_pw else None
+        max_val = jnp.maximum(-a, 0)
+        if pw is not None:
+            log_w = (pw - 1) * b + 1
+            out = (1 - b) * a + log_w * (
+                jnp.log1p(jnp.exp(-jnp.abs(a))) + max_val)
+        else:
+            out = (1 - b) * a + jnp.log1p(jnp.exp(-jnp.abs(a))) + max_val
+        if w is not None:
+            out = out * w
+        return _reduce(out, reduction)
+
+    return run_op(fn, ts, name="bce_with_logits")
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):
+    def fn(a, b):
+        if log_target:
+            out = jnp.exp(b) * (b - a)
+        else:
+            out = b * (jnp.log(jnp.maximum(b, 1e-30)) - a)
+        if reduction == "batchmean":
+            return jnp.sum(out) / a.shape[0]
+        return _reduce(out, reduction)
+
+    return run_op(fn, [as_tensor(input), as_tensor(label)], name="kl_div")
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",
+                        name=None):
+    def fn(a, b, y):
+        out = jnp.maximum(-y * (a - b) + margin, 0.0)
+        return _reduce(out, reduction)
+
+    return run_op(fn, [as_tensor(input), as_tensor(other), as_tensor(label)],
+                  name="margin_ranking_loss")
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    def fn(a, y):
+        out = jnp.where(y == 1, a, jnp.maximum(margin - a, 0.0))
+        return _reduce(out, reduction)
+
+    return run_op(fn, [as_tensor(input), as_tensor(label)],
+                  name="hinge_embedding_loss")
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean",
+                          name=None):
+    def fn(a, b, y):
+        cos = jnp.sum(a * b, axis=-1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12)
+        out = jnp.where(y == 1, 1 - cos, jnp.maximum(cos - margin, 0.0))
+        return _reduce(out, reduction)
+
+    return run_op(fn, [as_tensor(input1), as_tensor(input2), as_tensor(label)],
+                  name="cosine_embedding_loss")
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean", name=None):
+    def fn(a, pos, neg):
+        def dist(u, v):
+            return jnp.sum(jnp.abs(u - v + epsilon) ** p, axis=-1) ** (1.0 / p)
+
+        dp = dist(a, pos)
+        dn = dist(a, neg)
+        if swap:
+            dn = jnp.minimum(dn, dist(pos, neg))
+        out = jnp.maximum(dp - dn + margin, 0.0)
+        return _reduce(out, reduction)
+
+    return run_op(fn, [as_tensor(input), as_tensor(positive),
+                       as_tensor(negative)], name="triplet_margin_loss")
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    def fn(a, b):
+        return -b * jnp.log(a + epsilon) - (1 - b) * jnp.log(1 - a + epsilon)
+
+    return run_op(fn, [as_tensor(input), as_tensor(label)], name="log_loss")
+
+
+def square_error_cost(input, label, name=None):
+    return run_op(lambda a, b: (a - b) ** 2,
+                  [as_tensor(input), as_tensor(label)],
+                  name="square_error_cost")
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    ts = [as_tensor(logit), as_tensor(label)]
+    has_n = normalizer is not None
+    if has_n:
+        ts.append(as_tensor(normalizer))
+
+    def fn(a, b, *n):
+        p = jax.nn.sigmoid(a)
+        ce = jnp.maximum(a, 0) - a * b + jnp.log1p(jnp.exp(-jnp.abs(a)))
+        p_t = p * b + (1 - p) * (1 - b)
+        a_t = alpha * b + (1 - alpha) * (1 - b)
+        out = a_t * ((1 - p_t) ** gamma) * ce
+        if has_n:
+            out = out / n[0]
+        return _reduce(out, reduction)
+
+    return run_op(fn, ts, name="sigmoid_focal_loss")
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    def fn(a, b):
+        lab = jax.nn.one_hot(jnp.squeeze(b, -1), a.shape[-1], dtype=a.dtype)
+        red = tuple(range(1, a.ndim))
+        inter = jnp.sum(a * lab, axis=red)
+        union = jnp.sum(a, axis=red) + jnp.sum(lab, axis=red)
+        return jnp.mean(1 - (2 * inter + epsilon) / (union + epsilon))
+
+    return run_op(fn, [as_tensor(input), as_tensor(as_tensor(label))],
+                  name="dice_loss")
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,
+                     reduction="mean", name=None):
+    def fn(a, b):
+        if log_input:
+            out = jnp.exp(a) - b * a
+        else:
+            out = a - b * jnp.log(a + epsilon)
+        if full:
+            stirling = b * jnp.log(jnp.maximum(b, 1.0)) - b + 0.5 * jnp.log(
+                2 * jnp.pi * jnp.maximum(b, 1.0))
+            out = out + jnp.where(b > 1, stirling, 0.0)
+        return _reduce(out, reduction)
+
+    return run_op(fn, [as_tensor(input), as_tensor(label)],
+                  name="poisson_nll_loss")
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    def fn(a, b, v):
+        v = jnp.maximum(v, epsilon)
+        out = 0.5 * (jnp.log(v) + (a - b) ** 2 / v)
+        if full:
+            out = out + 0.5 * jnp.log(2 * jnp.pi)
+        return _reduce(out, reduction)
+
+    return run_op(fn, [as_tensor(input), as_tensor(label),
+                       as_tensor(variance)], name="gaussian_nll_loss")
+
+
+def multi_label_soft_margin_loss(input, label, weight=None, reduction="mean",
+                                 name=None):
+    ts = [as_tensor(input), as_tensor(label)]
+    has_w = weight is not None
+    if has_w:
+        ts.append(as_tensor(weight))
+
+    def fn(a, b, *w):
+        out = -(b * jax.nn.log_sigmoid(a) + (1 - b) * jax.nn.log_sigmoid(-a))
+        if has_w:
+            out = out * w[0]
+        out = jnp.mean(out, axis=-1)
+        return _reduce(out, reduction)
+
+    return run_op(fn, ts, name="multi_label_soft_margin_loss")
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    def fn(a, b):
+        return _reduce(jnp.log1p(jnp.exp(-b * a)), reduction)
+
+    return run_op(fn, [as_tensor(input), as_tensor(label)],
+                  name="soft_margin_loss")
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False, name=None):
+    """CTC via the standard forward algorithm in log space (lax.scan over
+    time — compiler-friendly sequential structure)."""
+    lp = as_tensor(log_probs)  # [T, B, C] paddle layout
+    lab = unwrap(as_tensor(labels)).astype(jnp.int32)  # [B, L]
+    il = unwrap(as_tensor(input_lengths)).astype(jnp.int32)
+    ll = unwrap(as_tensor(label_lengths)).astype(jnp.int32)
+
+    def fn(a):
+        a = jax.nn.log_softmax(a, axis=-1)
+        T, B, C = a.shape
+        L = lab.shape[1]
+        S = 2 * L + 1
+        # extended label seq: blank, l1, blank, l2, ... blank
+        ext = jnp.full((B, S), blank, dtype=jnp.int32)
+        ext = ext.at[:, 1::2].set(lab)
+        neg_inf = -1e30
+        alpha0 = jnp.full((B, S), neg_inf)
+        alpha0 = alpha0.at[:, 0].set(a[0, jnp.arange(B), blank])
+        alpha0 = alpha0.at[:, 1].set(a[0, jnp.arange(B), ext[:, 1]])
+
+        same_as_prev2 = jnp.concatenate(
+            [jnp.ones((B, 2), dtype=bool),
+             ext[:, 2:] == ext[:, :-2]], axis=1)
+
+        def step(alpha, at):
+            # at: [B, C] log-probs at time t
+            prev1 = jnp.concatenate(
+                [jnp.full((B, 1), neg_inf), alpha[:, :-1]], axis=1)
+            prev2 = jnp.concatenate(
+                [jnp.full((B, 2), neg_inf), alpha[:, :-2]], axis=1)
+            prev2 = jnp.where(same_as_prev2, neg_inf, prev2)
+            merged = jnp.logaddexp(alpha, jnp.logaddexp(prev1, prev2))
+            emit = jnp.take_along_axis(at, ext, axis=1)
+            return merged + emit, None
+
+        def scan_fn(carry, t):
+            alpha, = carry
+            new_alpha, _ = step(alpha, a[t])
+            new_alpha = jnp.where((t < il)[:, None], new_alpha, alpha)
+            return (new_alpha,), None
+
+        (alpha,), _ = jax.lax.scan(scan_fn, (alpha0,), jnp.arange(1, T))
+        end1 = jnp.take_along_axis(alpha, (2 * ll)[:, None], axis=1)[:, 0]
+        end2 = jnp.take_along_axis(alpha, (2 * ll - 1)[:, None], axis=1)[:, 0]
+        nll = -jnp.logaddexp(end1, end2)
+        if reduction == "mean":
+            return jnp.mean(nll / jnp.maximum(ll, 1))
+        return _reduce(nll, reduction)
+
+    return run_op(fn, [lp], name="ctc_loss")

@@ -1,0 +1,226 @@
+"""Normalization functionals (reference: python/paddle/nn/functional/norm.py).
+
+rms_norm / fused paths live in incubate (Pallas); these are the XLA-fused
+compositions — XLA fuses mean/var/scale into one kernel on TPU.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...ops._helpers import as_tensor, run_op, unary, unwrap
+
+__all__ = ["batch_norm", "layer_norm", "instance_norm", "group_norm",
+           "local_response_norm", "rms_norm"]
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-5,
+               data_format="NCHW", use_global_stats=None, name=None):
+    x = as_tensor(x)
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+    ax = -1 if channel_last else 1
+    nd = x.ndim
+    reduce_axes = tuple(i for i in range(nd) if i != (ax % nd))
+    bshape = tuple(-1 if i == (ax % nd) else 1 for i in range(nd))
+
+    use_batch_stats = training and not use_global_stats
+    if use_batch_stats:
+        mean = jnp.mean(x._data, axis=reduce_axes)
+        var = jnp.var(x._data, axis=reduce_axes)
+        # update running stats in place (stateful, like the reference kernel)
+        if running_mean is not None:
+            rm = as_tensor(running_mean)
+            rm._data = momentum * rm._data + (1 - momentum) * mean.astype(
+                rm._data.dtype)
+        if running_var is not None:
+            n = x.size // mean.size
+            unbiased = var * (n / max(n - 1, 1))
+            rv = as_tensor(running_var)
+            rv._data = momentum * rv._data + (1 - momentum) * unbiased.astype(
+                rv._data.dtype)
+    else:
+        mean = unwrap(as_tensor(running_mean))
+        var = unwrap(as_tensor(running_var))
+
+    ts = [x]
+    has_w = weight is not None
+    has_b = bias is not None
+    if has_w:
+        ts.append(as_tensor(weight))
+    if has_b:
+        ts.append(as_tensor(bias))
+
+    def fn(a, *wb):
+        af = a.astype(jnp.float32)
+        if use_batch_stats:
+            # recompute inside the traced fn so grads flow through the
+            # batch statistics (the running-stat update above is detached)
+            m = jnp.mean(af, axis=reduce_axes)
+            v = jnp.var(af, axis=reduce_axes)
+        else:
+            m, v = mean.astype(jnp.float32), var.astype(jnp.float32)
+        inv = 1.0 / jnp.sqrt(v + epsilon)
+        out = (af - m.reshape(bshape)) * inv.reshape(bshape)
+        i = 0
+        if has_w:
+            out = out * wb[i].reshape(bshape)
+            i += 1
+        if has_b:
+            out = out + wb[i].reshape(bshape)
+        return out.astype(a.dtype)
+
+    return run_op(fn, ts, name="batch_norm")
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
+               name=None):
+    x = as_tensor(x)
+    if isinstance(normalized_shape, int):
+        normalized_shape = (normalized_shape,)
+    n_axes = len(tuple(normalized_shape))
+    axes = tuple(range(x.ndim - n_axes, x.ndim))
+
+    ts = [x]
+    has_w = weight is not None
+    has_b = bias is not None
+    if has_w:
+        ts.append(as_tensor(weight))
+    if has_b:
+        ts.append(as_tensor(bias))
+
+    def fn(a, *wb):
+        af = a.astype(jnp.float32)
+        mean = jnp.mean(af, axis=axes, keepdims=True)
+        var = jnp.var(af, axis=axes, keepdims=True)
+        out = (af - mean) / jnp.sqrt(var + epsilon)
+        i = 0
+        if has_w:
+            out = out * wb[i].astype(jnp.float32)
+            i += 1
+        if has_b:
+            out = out + wb[i].astype(jnp.float32)
+        return out.astype(a.dtype)
+
+    return run_op(fn, ts, name="layer_norm")
+
+
+def rms_norm(x, weight=None, bias=None, epsilon=1e-6, begin_norm_axis=-1,
+             name=None):
+    """RMSNorm (reference: python/paddle/incubate/nn/functional/fused_rms_norm.py)."""
+    x = as_tensor(x)
+    ax = begin_norm_axis if begin_norm_axis >= 0 else x.ndim + begin_norm_axis
+    axes = tuple(range(ax, x.ndim))
+    ts = [x]
+    has_w = weight is not None
+    has_b = bias is not None
+    if has_w:
+        ts.append(as_tensor(weight))
+    if has_b:
+        ts.append(as_tensor(bias))
+
+    def fn(a, *wb):
+        af = a.astype(jnp.float32)
+        ms = jnp.mean(af * af, axis=axes, keepdims=True)
+        out = af * (1.0 / jnp.sqrt(ms + epsilon))
+        i = 0
+        if has_w:
+            out = out * wb[i].astype(jnp.float32)
+            i += 1
+        if has_b:
+            out = out + wb[i].astype(jnp.float32)
+        return out.astype(a.dtype)
+
+    return run_op(fn, ts, name="rms_norm")
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9, eps=1e-5,
+                  data_format="NCHW", name=None):
+    x = as_tensor(x)
+    nd = x.ndim
+    axes = tuple(range(2, nd))  # per (N, C)
+    bshape = (1, -1) + (1,) * (nd - 2)
+    ts = [x]
+    has_w = weight is not None
+    has_b = bias is not None
+    if has_w:
+        ts.append(as_tensor(weight))
+    if has_b:
+        ts.append(as_tensor(bias))
+
+    def fn(a, *wb):
+        af = a.astype(jnp.float32)
+        mean = jnp.mean(af, axis=axes, keepdims=True)
+        var = jnp.var(af, axis=axes, keepdims=True)
+        out = (af - mean) / jnp.sqrt(var + eps)
+        i = 0
+        if has_w:
+            out = out * wb[i].reshape(bshape)
+            i += 1
+        if has_b:
+            out = out + wb[i].reshape(bshape)
+        return out.astype(a.dtype)
+
+    return run_op(fn, ts, name="instance_norm")
+
+
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    x = as_tensor(x)
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+    ts = [x]
+    has_w = weight is not None
+    has_b = bias is not None
+    if has_w:
+        ts.append(as_tensor(weight))
+    if has_b:
+        ts.append(as_tensor(bias))
+
+    def fn(a, *wb):
+        af = a.astype(jnp.float32)
+        if channel_last:
+            af = jnp.moveaxis(af, -1, 1)
+        n, c = af.shape[:2]
+        spatial = af.shape[2:]
+        g = af.reshape(n, num_groups, c // num_groups, *spatial)
+        axes = tuple(range(2, g.ndim))
+        mean = jnp.mean(g, axis=axes, keepdims=True)
+        var = jnp.var(g, axis=axes, keepdims=True)
+        out = ((g - mean) / jnp.sqrt(var + epsilon)).reshape(n, c, *spatial)
+        bshape = (1, -1) + (1,) * len(spatial)
+        i = 0
+        if has_w:
+            out = out * wb[i].reshape(bshape)
+            i += 1
+        if has_b:
+            out = out + wb[i].reshape(bshape)
+        if channel_last:
+            out = jnp.moveaxis(out, 1, -1)
+        return out.astype(a.dtype)
+
+    return run_op(fn, ts, name="group_norm")
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    def fn(a):
+        channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+        af = a.astype(jnp.float32)
+        if channel_last:
+            af = jnp.moveaxis(af, -1, 1)
+        sq = af * af
+        c = af.shape[1]
+        half = size // 2
+        pad_width = [(0, 0)] * af.ndim
+        pad_width[1] = (half, size - half - 1)
+        padded = jnp.pad(sq, pad_width)
+        acc = jnp.zeros_like(af)
+        for i in range(size):
+            acc = acc + padded[:, i: i + c]
+        out = af / jnp.power(k + alpha * acc, beta)
+        if channel_last:
+            out = jnp.moveaxis(out, 1, -1)
+        return out.astype(a.dtype)
+
+    return unary(fn, as_tensor(x), "local_response_norm")

@@ -1,0 +1,203 @@
+"""Pooling functionals over lax.reduce_window (reference:
+python/paddle/nn/functional/pooling.py)."""
+from __future__ import annotations
+
+import jax.lax as lax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...ops._helpers import as_tensor, run_op, unary
+
+__all__ = [
+    "max_pool1d", "max_pool2d", "max_pool3d", "avg_pool1d", "avg_pool2d",
+    "avg_pool3d", "adaptive_avg_pool1d", "adaptive_avg_pool2d",
+    "adaptive_avg_pool3d", "adaptive_max_pool1d", "adaptive_max_pool2d",
+    "adaptive_max_pool3d",
+]
+
+
+def _norm(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(int(x) for x in v)
+
+
+def _pool(x, kernel, stride, padding, n, channel_last, reducer, init, name,
+          ceil_mode=False, average=False, exclusive=True):
+    kernel = _norm(kernel, n)
+    stride = _norm(stride if stride is not None else kernel, n)
+    if isinstance(padding, str):
+        pad_same = padding.upper() == "SAME"
+        padding = (0,) * n if not pad_same else None
+    else:
+        pad_same = False
+        padding = _norm(padding, n)
+
+    def fn(a):
+        if channel_last:
+            dims = (1,) + kernel + (1,)
+            strides = (1,) + stride + (1,)
+            if pad_same:
+                pads = "SAME"
+            else:
+                pads = ((0, 0),) + tuple((p, p) for p in padding) + ((0, 0),)
+        else:
+            dims = (1, 1) + kernel
+            strides = (1, 1) + stride
+            if pad_same:
+                pads = "SAME"
+            else:
+                pads = ((0, 0), (0, 0)) + tuple((p, p) for p in padding)
+        if ceil_mode and not pad_same:
+            # extend right/bottom padding so ragged windows are kept
+            spatial = a.shape[2:] if not channel_last else a.shape[1:-1]
+            extra = []
+            for s, k, st, p in zip(spatial, kernel, stride, padding):
+                out = -(-(s + 2 * p - k) // st) + 1  # ceil
+                need = (out - 1) * st + k - (s + 2 * p)
+                extra.append(max(0, need))
+            if not channel_last:
+                pads = ((0, 0), (0, 0)) + tuple(
+                    (p, p + e) for p, e in zip(padding, extra))
+            else:
+                pads = ((0, 0),) + tuple(
+                    (p, p + e) for p, e in zip(padding, extra)) + ((0, 0),)
+        out = lax.reduce_window(a, init, reducer, dims, strides, pads)
+        if average:
+            if exclusive and (pad_same or any(padding) or ceil_mode):
+                ones = jnp.ones_like(a)
+                counts = lax.reduce_window(ones, 0.0, lax.add, dims, strides,
+                                           pads)
+                out = out / counts
+            else:
+                out = out / float(jnp.prod(jnp.asarray(kernel)))
+        return out
+
+    return unary(fn, as_tensor(x), name)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _pool(x, kernel_size, stride, padding, 1, data_format == "NLC",
+                 lax.max, -jnp.inf, "max_pool1d", ceil_mode)
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    out = _pool(x, kernel_size, stride, padding, 2, data_format == "NHWC",
+                lax.max, -jnp.inf, "max_pool2d", ceil_mode)
+    if return_mask:
+        # indices within each window (flattened HxW index), computed on host path
+        return out, _argmax_pool_mask(x, kernel_size, stride, padding,
+                                      data_format)
+    return out
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 3, data_format == "NDHWC",
+                 lax.max, -jnp.inf, "max_pool3d", ceil_mode)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _pool(x, kernel_size, stride, padding, 1, data_format == "NLC",
+                 lax.add, 0.0, "avg_pool1d", ceil_mode, average=True,
+                 exclusive=exclusive)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    return _pool(x, kernel_size, stride, padding, 2, data_format == "NHWC",
+                 lax.add, 0.0, "avg_pool2d", ceil_mode, average=True,
+                 exclusive=exclusive)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    return _pool(x, kernel_size, stride, padding, 3, data_format == "NDHWC",
+                 lax.add, 0.0, "avg_pool3d", ceil_mode, average=True,
+                 exclusive=exclusive)
+
+
+def _argmax_pool_mask(x, kernel, stride, padding, data_format):
+    import numpy as np
+
+    a = np.asarray(as_tensor(x)._data)
+    k = _norm(kernel, 2)
+    s = _norm(stride if stride is not None else kernel, 2)
+    p = _norm(padding, 2)
+    n, c, h, w = a.shape
+    oh = (h + 2 * p[0] - k[0]) // s[0] + 1
+    ow = (w + 2 * p[1] - k[1]) // s[1] + 1
+    ap = np.pad(a, ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])),
+                constant_values=-np.inf)
+    mask = np.zeros((n, c, oh, ow), dtype=np.int64)
+    for i in range(oh):
+        for j in range(ow):
+            win = ap[:, :, i * s[0]: i * s[0] + k[0], j * s[1]: j * s[1] + k[1]]
+            flat = win.reshape(n, c, -1)
+            idx = flat.argmax(-1)
+            hi = idx // k[1] + i * s[0] - p[0]
+            wi = idx % k[1] + j * s[1] - p[1]
+            mask[:, :, i, j] = hi * w + wi
+    return Tensor(jnp.asarray(mask))
+
+
+def _adaptive(x, output_size, n, channel_last, is_max, name):
+    osz = _norm(output_size, n)
+
+    def fn(a):
+        if channel_last:
+            a_ = jnp.moveaxis(a, -1, 1)
+        else:
+            a_ = a
+        spatial = a_.shape[2:]
+        out = a_
+        for d in range(n):
+            in_s, out_s = spatial[d], osz[d]
+            # split into out_s regions with start/end like the reference
+            starts = [(i * in_s) // out_s for i in range(out_s)]
+            ends = [-(-((i + 1) * in_s) // out_s) for i in range(out_s)]
+            pieces = []
+            for st, en in zip(starts, ends):
+                sl = [slice(None)] * out.ndim
+                sl[2 + d] = slice(st, en)
+                seg = out[tuple(sl)]
+                red = jnp.max(seg, axis=2 + d, keepdims=True) if is_max \
+                    else jnp.mean(seg, axis=2 + d, keepdims=True)
+                pieces.append(red)
+            out = jnp.concatenate(pieces, axis=2 + d)
+        if channel_last:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+
+    return unary(fn, as_tensor(x), name)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive(x, output_size, 1, False, False, "adaptive_avg_pool1d")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive(x, output_size, 2, data_format == "NHWC", False,
+                     "adaptive_avg_pool2d")
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive(x, output_size, 3, data_format == "NDHWC", False,
+                     "adaptive_avg_pool3d")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, output_size, 1, False, True, "adaptive_max_pool1d")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, output_size, 2, False, True, "adaptive_max_pool2d")
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, output_size, 3, False, True, "adaptive_max_pool3d")

@@ -1,0 +1,373 @@
+"""Layer base class + Parameter (reference: python/paddle/nn/layer/layers.py:354
+``Layer``; parameter semantics from python/paddle/base/framework.py
+``EagerParamBase``).
+
+A Layer owns named Parameters / buffers / sublayers, supports forward
+pre/post hooks, train/eval mode, ``state_dict``/``set_state_dict``, dtype
+moves — and is jit-traceable: calling it on traced Tensors inside
+``paddle_tpu.jit`` just works because parameters are Tensors over jax arrays.
+"""
+from __future__ import annotations
+
+import collections
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.dtype import convert_dtype, to_jax_dtype
+from ...core.tensor import Tensor
+
+__all__ = ["Layer", "Parameter"]
+
+
+class Parameter(Tensor):
+    """Trainable tensor: ``stop_gradient=False`` by default."""
+
+    __slots__ = ("trainable", "optimize_attr", "regularizer", "is_distributed")
+
+    def __init__(self, data, trainable: bool = True, name: Optional[str] = None):
+        super().__init__(data, stop_gradient=not trainable, name=name)
+        self.trainable = trainable
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.is_distributed = False
+        self.persistable = True
+
+    def __repr__(self):
+        return "Parameter containing:\n" + super().__repr__()
+
+
+# pytree registration for Parameter (flatten like Tensor)
+import jax
+
+
+def _param_flatten(p: Parameter):
+    return (p._data,), (p.trainable,)
+
+
+def _param_unflatten(aux, children):
+    return Parameter(children[0], trainable=aux[0])
+
+
+jax.tree_util.register_pytree_node(Parameter, _param_flatten, _param_unflatten)
+
+
+class HookRemoveHelper:
+    def __init__(self, hooks: dict, hook_id: int):
+        self._hooks = hooks
+        self._hook_id = hook_id
+
+    def remove(self):
+        self._hooks.pop(self._hook_id, None)
+
+
+class Layer:
+    def __init__(self, name_scope: Optional[str] = None, dtype="float32"):
+        self.training = True
+        self._dtype = convert_dtype(dtype)
+        self._parameters: Dict[str, Parameter] = collections.OrderedDict()
+        self._sub_layers: Dict[str, "Layer"] = collections.OrderedDict()
+        self._buffers: Dict[str, Tensor] = collections.OrderedDict()
+        self._non_persistable_buffer_names = set()
+        self._forward_pre_hooks: Dict[int, Callable] = collections.OrderedDict()
+        self._forward_post_hooks: Dict[int, Callable] = collections.OrderedDict()
+        self._hook_id = 0
+        self._name_scope = name_scope or self.__class__.__name__.lower()
+        self._casted_by_pure_fp16 = False
+
+    # ------------------------------------------------------------ attribute magic
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        layers = self.__dict__.get("_sub_layers")
+        buffers = self.__dict__.get("_buffers")
+        if isinstance(value, Parameter):
+            if params is None:
+                raise RuntimeError("call Layer.__init__ before assigning parameters")
+            params[name] = value
+            for d in (layers, buffers):
+                if d is not None:
+                    d.pop(name, None)
+            object.__setattr__(self, name, value)
+        elif isinstance(value, Layer):
+            layers[name] = value
+            for d in (params, buffers):
+                if d is not None:
+                    d.pop(name, None)
+            object.__setattr__(self, name, value)
+        else:
+            if buffers is not None and name in buffers:
+                if isinstance(value, Tensor):
+                    buffers[name] = value
+                elif value is None:
+                    buffers.pop(name)
+            if params is not None and name in params and value is None:
+                params.pop(name)
+            if layers is not None and name in layers and value is None:
+                layers.pop(name)
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        # only called when normal lookup fails
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(
+            f"'{type(self).__name__}' object has no attribute '{name}'"
+        )
+
+    def __delattr__(self, name):
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                del d[name]
+                if name in self.__dict__:
+                    object.__delattr__(self, name)
+                return
+        object.__delattr__(self, name)
+
+    # ------------------------------------------------------------ construction
+    def create_parameter(self, shape, attr=None, dtype=None, is_bias=False,
+                         default_initializer=None):
+        from ..initializer import Constant, XavierNormal
+
+        dtype = dtype or self._dtype
+        if default_initializer is None:
+            init = Constant(0.0) if is_bias else XavierNormal()
+        else:
+            init = default_initializer
+        # ParamAttr support
+        lr = 1.0
+        name = None
+        trainable = True
+        if attr is not None and attr is not False:
+            init = getattr(attr, "initializer", None) or init
+            lr = getattr(attr, "learning_rate", 1.0)
+            name = getattr(attr, "name", None)
+            trainable = getattr(attr, "trainable", True)
+        data = init(shape, to_jax_dtype(dtype))
+        p = Parameter(data, trainable=trainable, name=name)
+        p.optimize_attr["learning_rate"] = lr
+        return p
+
+    def add_parameter(self, name: str, parameter: Optional[Parameter]):
+        if parameter is None:
+            self._parameters[name] = None
+        else:
+            setattr(self, name, parameter)
+        return parameter
+
+    def add_sublayer(self, name: str, sublayer: "Layer"):
+        setattr(self, name, sublayer)
+        return sublayer
+
+    def register_buffer(self, name: str, tensor: Optional[Tensor],
+                        persistable: bool = True):
+        self._buffers[name] = tensor
+        if not persistable:
+            self._non_persistable_buffer_names.add(name)
+        object.__setattr__(self, name, tensor)
+
+    # ------------------------------------------------------------ iteration
+    def parameters(self, include_sublayers: bool = True) -> List[Parameter]:
+        return [p for _, p in self.named_parameters(
+            include_sublayers=include_sublayers)]
+
+    def named_parameters(self, prefix: str = "", include_sublayers: bool = True
+                         ) -> Iterator[Tuple[str, Parameter]]:
+        seen = set()
+        for name, layer in [("", self)] + (
+            list(self.named_sublayers(prefix="", include_self=False))
+            if include_sublayers else []
+        ):
+            for pname, p in layer._parameters.items():
+                if p is None or id(p) in seen:
+                    continue
+                seen.add(id(p))
+                full = (prefix + "." if prefix else "") + (
+                    name + "." if name else "") + pname
+                yield full, p
+
+    def buffers(self, include_sublayers: bool = True) -> List[Tensor]:
+        return [b for _, b in self.named_buffers(
+            include_sublayers=include_sublayers)]
+
+    def named_buffers(self, prefix: str = "", include_sublayers: bool = True
+                      ) -> Iterator[Tuple[str, Tensor]]:
+        seen = set()
+        for name, layer in [("", self)] + (
+            list(self.named_sublayers(prefix="", include_self=False))
+            if include_sublayers else []
+        ):
+            for bname, b in layer._buffers.items():
+                if b is None or id(b) in seen:
+                    continue
+                seen.add(id(b))
+                full = (prefix + "." if prefix else "") + (
+                    name + "." if name else "") + bname
+                yield full, b
+
+    def children(self) -> Iterator["Layer"]:
+        for _, l in self.named_children():
+            yield l
+
+    def named_children(self) -> Iterator[Tuple[str, "Layer"]]:
+        seen = set()
+        for name, l in self._sub_layers.items():
+            if l is not None and id(l) not in seen:
+                seen.add(id(l))
+                yield name, l
+
+    def sublayers(self, include_self: bool = False) -> List["Layer"]:
+        return [l for _, l in self.named_sublayers(include_self=include_self)]
+
+    def named_sublayers(self, prefix: str = "", include_self: bool = False
+                        ) -> Iterator[Tuple[str, "Layer"]]:
+        if include_self:
+            yield prefix, self
+        for name, l in self._sub_layers.items():
+            if l is None:
+                continue
+            sub_prefix = (prefix + "." if prefix else "") + name
+            yield from l.named_sublayers(prefix=sub_prefix, include_self=True)
+
+    def apply(self, fn: Callable[["Layer"], None]) -> "Layer":
+        for l in self.children():
+            l.apply(fn)
+        fn(self)
+        return self
+
+    # ------------------------------------------------------------ mode
+    def train(self):
+        self.training = True
+        for l in self.sublayers():
+            l.training = True
+        return self
+
+    def eval(self):
+        self.training = False
+        for l in self.sublayers():
+            l.training = False
+        return self
+
+    # ------------------------------------------------------------ hooks
+    def register_forward_pre_hook(self, hook) -> HookRemoveHelper:
+        self._hook_id += 1
+        self._forward_pre_hooks[self._hook_id] = hook
+        return HookRemoveHelper(self._forward_pre_hooks, self._hook_id)
+
+    def register_forward_post_hook(self, hook) -> HookRemoveHelper:
+        self._hook_id += 1
+        self._forward_post_hooks[self._hook_id] = hook
+        return HookRemoveHelper(self._forward_post_hooks, self._hook_id)
+
+    # ------------------------------------------------------------ call
+    def __call__(self, *inputs, **kwargs):
+        for hook in list(self._forward_pre_hooks.values()):
+            result = hook(self, inputs)
+            if result is not None:
+                inputs = result if isinstance(result, tuple) else (result,)
+        outputs = self.forward(*inputs, **kwargs)
+        for hook in list(self._forward_post_hooks.values()):
+            result = hook(self, inputs, outputs)
+            if result is not None:
+                outputs = result
+        return outputs
+
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    # ------------------------------------------------------------ state dict
+    def state_dict(self, destination=None, include_sublayers: bool = True,
+                   structured_name_prefix: str = "", use_hook: bool = True):
+        out = collections.OrderedDict() if destination is None else destination
+        for name, p in self.named_parameters(prefix=structured_name_prefix):
+            out[name] = p
+        # filter non-persistable buffers against each OWNING layer's set
+        # (a root-level set would leak sublayer transients / collide on names)
+        seen = set()
+        for lname, layer in [("", self)] + list(
+                self.named_sublayers(prefix="", include_self=False)):
+            for bname, b in layer._buffers.items():
+                if b is None or id(b) in seen:
+                    continue
+                seen.add(id(b))
+                if bname in layer._non_persistable_buffer_names:
+                    continue
+                full = (structured_name_prefix + "."
+                        if structured_name_prefix else "") + (
+                    lname + "." if lname else "") + bname
+                out[full] = b
+        return out
+
+    def set_state_dict(self, state_dict, use_structured_name: bool = True):
+        own = self.state_dict()
+        missing, unexpected = [], []
+        for name, t in own.items():
+            if name in state_dict:
+                src = state_dict[name]
+                arr = src._data if isinstance(src, Tensor) else jnp.asarray(
+                    np.asarray(src))
+                if tuple(arr.shape) != tuple(t.shape):
+                    raise ValueError(
+                        f"shape mismatch for {name}: {arr.shape} vs {t.shape}")
+                t._data = arr.astype(t._data.dtype)
+            else:
+                missing.append(name)
+        for name in state_dict:
+            if name not in own:
+                unexpected.append(name)
+        return missing, unexpected
+
+    load_dict = set_state_dict
+
+    # ------------------------------------------------------------ dtype moves
+    def to(self, device=None, dtype=None, blocking=None):
+        if dtype is not None:
+            jdt = to_jax_dtype(dtype)
+            for p in self.parameters():
+                if p.dtype.is_floating_point:
+                    p._data = p._data.astype(jdt)
+            for b in self.buffers():
+                if b is not None and b.dtype.is_floating_point:
+                    b._data = b._data.astype(jdt)
+            self._dtype = convert_dtype(dtype)
+            for l in self.sublayers():
+                l._dtype = convert_dtype(dtype)
+        return self
+
+    def astype(self, dtype):
+        return self.to(dtype=dtype)
+
+    def float(self):
+        return self.to(dtype="float32")
+
+    def half(self):
+        return self.to(dtype="float16")
+
+    def bfloat16(self):
+        return self.to(dtype="bfloat16")
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_grad()
+
+    def full_name(self):
+        return self._name_scope
+
+    def extra_repr(self) -> str:
+        return ""
+
+    def __repr__(self):
+        extra = self.extra_repr()
+        lines = [extra] if extra else []
+        for name, l in self._sub_layers.items():
+            mod_str = repr(l)
+            mod_str = "\n  ".join(mod_str.split("\n"))
+            lines.append(f"({name}): {mod_str}")
+        main = self.__class__.__name__
+        if not lines:
+            return f"{main}({extra})"
+        body = "\n  ".join(lines)
+        return f"{main}(\n  {body}\n)"

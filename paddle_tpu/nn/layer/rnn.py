@@ -1,0 +1,392 @@
+"""Recurrent layers over lax.scan (reference: python/paddle/nn/layer/rnn.py).
+
+lax.scan gives XLA the whole unrolled loop as one compiled region — the
+TPU-idiomatic replacement for the reference's cuDNN RNN kernels.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.lax as lax
+import jax.numpy as jnp
+
+from ...ops._helpers import as_tensor, run_op
+from ..initializer import Uniform
+from .layers import Layer
+
+__all__ = ["SimpleRNNCell", "LSTMCell", "GRUCell", "RNN", "SimpleRNN", "LSTM",
+           "GRU"]
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_size, hidden_size):
+        from ...ops.creation import zeros
+
+        return zeros([batch_size, hidden_size])
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            [hidden_size, input_size], weight_ih_attr,
+            default_initializer=init)
+        self.weight_hh = self.create_parameter(
+            [hidden_size, hidden_size], weight_hh_attr,
+            default_initializer=init)
+        self.bias_ih = self.create_parameter(
+            [hidden_size], bias_ih_attr, is_bias=True,
+            default_initializer=init)
+        self.bias_hh = self.create_parameter(
+            [hidden_size], bias_hh_attr, is_bias=True,
+            default_initializer=init)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs.shape[0], self.hidden_size)
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+
+        def fn(x, h, wi, wh, bi, bh):
+            return act(x @ wi.T + bi + h @ wh.T + bh)
+
+        h = run_op(fn, [inputs, states, self.weight_ih, self.weight_hh,
+                        self.bias_ih, self.bias_hh], name="rnn_cell")
+        return h, h
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 proj_size=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            [4 * hidden_size, input_size], weight_ih_attr,
+            default_initializer=init)
+        self.weight_hh = self.create_parameter(
+            [4 * hidden_size, hidden_size], weight_hh_attr,
+            default_initializer=init)
+        self.bias_ih = self.create_parameter(
+            [4 * hidden_size], bias_ih_attr, is_bias=True,
+            default_initializer=init)
+        self.bias_hh = self.create_parameter(
+            [4 * hidden_size], bias_hh_attr, is_bias=True,
+            default_initializer=init)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            h = self.get_initial_states(inputs.shape[0], self.hidden_size)
+            c = self.get_initial_states(inputs.shape[0], self.hidden_size)
+        else:
+            h, c = states
+
+        def fn(x, hh, cc, wi, wh, bi, bh):
+            gates = x @ wi.T + bi + hh @ wh.T + bh
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            nc = f * cc + i * g
+            nh = o * jnp.tanh(nc)
+            return nh, nc
+
+        nh, nc = run_op(fn, [inputs, h, c, self.weight_ih, self.weight_hh,
+                             self.bias_ih, self.bias_hh], name="lstm_cell")
+        return nh, (nh, nc)
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            [3 * hidden_size, input_size], weight_ih_attr,
+            default_initializer=init)
+        self.weight_hh = self.create_parameter(
+            [3 * hidden_size, hidden_size], weight_hh_attr,
+            default_initializer=init)
+        self.bias_ih = self.create_parameter(
+            [3 * hidden_size], bias_ih_attr, is_bias=True,
+            default_initializer=init)
+        self.bias_hh = self.create_parameter(
+            [3 * hidden_size], bias_hh_attr, is_bias=True,
+            default_initializer=init)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs.shape[0], self.hidden_size)
+
+        def fn(x, h, wi, wh, bi, bh):
+            gi = x @ wi.T + bi
+            gh = h @ wh.T + bh
+            ir, iz, in_ = jnp.split(gi, 3, axis=-1)
+            hr, hz, hn = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(ir + hr)
+            z = jax.nn.sigmoid(iz + hz)
+            n = jnp.tanh(in_ + r * hn)
+            return (1 - z) * n + z * h
+
+        nh = run_op(fn, [inputs, states, self.weight_ih, self.weight_hh,
+                         self.bias_ih, self.bias_hh], name="gru_cell")
+        return nh, nh
+
+
+class RNN(Layer):
+    """Generic RNN wrapper running a cell over time (reference:
+    python/paddle/nn/layer/rnn.py RNN)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...ops.manipulation import stack
+
+        x = inputs
+        if not self.time_major:
+            from ...ops.manipulation import transpose
+
+            x = transpose(x, [1, 0, 2])
+        steps = x.shape[0]
+        if self.is_reverse:
+            from ...ops.manipulation import flip
+
+            x = flip(x, 0)
+        states = initial_states
+        outs = []
+        for t in range(steps):
+            out, states = self.cell(x[t], states)
+            outs.append(out)
+        if self.is_reverse:
+            outs = outs[::-1]
+        outputs = stack(outs, 0)
+        if not self.time_major:
+            from ...ops.manipulation import transpose
+
+            outputs = transpose(outputs, [1, 0, 2])
+        return outputs, states
+
+
+class _MultiLayerRNN(Layer):
+    MODE = "RNN"
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.bidirect = direction in ("bidirect", "bidirectional")
+        ndir = 2 if self.bidirect else 1
+        self.num_directions = ndir
+        from .container import LayerList
+
+        cells = []
+        for layer in range(num_layers):
+            in_sz = input_size if layer == 0 else hidden_size * ndir
+            for _ in range(ndir):
+                cells.append(self._make_cell(in_sz, hidden_size, activation,
+                                             weight_ih_attr, weight_hh_attr,
+                                             bias_ih_attr, bias_hh_attr))
+        self.cells = LayerList(cells)
+
+    def _make_cell(self, in_sz, hid, activation, *attrs):
+        raise NotImplementedError
+
+    def _cell_fn(self, cell):
+        """Return (params_list, pure_step_fn(x, state, *params) -> (out, state))."""
+        raise NotImplementedError
+
+    def _zero_state(self, cell, batch):
+        raise NotImplementedError
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        x = as_tensor(inputs)
+        ndir = self.num_directions
+
+        if not self.time_major:
+            from ...ops.manipulation import transpose
+
+            x = transpose(x, [1, 0, 2])  # [T, B, C]
+        batch = x.shape[1]
+
+        all_final = []
+        cur = x
+        ci = 0
+        for layer in range(self.num_layers):
+            dir_outs = []
+            for d in range(ndir):
+                cell = self.cells[ci]
+                ci += 1
+                params, step = self._cell_fn(cell)
+                if initial_states is None:
+                    st = self._zero_state(cell, batch)
+                else:
+                    st = jax.tree_util.tree_map(
+                        lambda s: s[layer * ndir + d], initial_states)
+
+                def scan_wrap(xa, st_a, *ps):
+                    def body(carry, xt):
+                        out, ncarry = step(xt, carry, *ps)
+                        return ncarry, out
+
+                    xx = jnp.flip(xa, 0) if d == 1 else xa
+                    final, outs = lax.scan(body, st_a, xx)
+                    if d == 1:
+                        outs = jnp.flip(outs, 0)
+                    return outs, final
+
+                res = run_op(
+                    lambda xa, *rest, _step=step, _d=d: _scan_impl(
+                        _step, xa, rest, _d, self._state_arity()),
+                    [cur] + _flatten_state(st) + params,
+                    name=f"{self.MODE.lower()}_scan",
+                )
+                outs = res[0]
+                final = res[1:]
+                dir_outs.append(outs)
+                all_final.append(final)
+            if ndir == 2:
+                from ...ops.manipulation import concat
+
+                cur = concat(dir_outs, axis=-1)
+            else:
+                cur = dir_outs[0]
+            if self.dropout > 0 and layer < self.num_layers - 1:
+                from .. import functional as F
+
+                cur = F.dropout(cur, self.dropout, training=self.training)
+
+        if not self.time_major:
+            from ...ops.manipulation import transpose
+
+            cur = transpose(cur, [1, 0, 2])
+        from ...ops.manipulation import stack
+
+        if self._state_arity() == 1:
+            final_states = stack([f[0] for f in all_final], 0)
+        else:
+            h = stack([f[0] for f in all_final], 0)
+            c = stack([f[1] for f in all_final], 0)
+            final_states = (h, c)
+        return cur, final_states
+
+    def _state_arity(self):
+        return 1
+
+
+def _flatten_state(st):
+    if isinstance(st, (tuple, list)):
+        return list(st)
+    return [st]
+
+
+def _scan_impl(step, xa, rest, d, arity):
+    st = tuple(rest[:arity])
+    ps = rest[arity:]
+    if arity == 1:
+        st = st[0]
+
+    def body(carry, xt):
+        out, ncarry = step(xt, carry, *ps)
+        return ncarry, out
+
+    xx = jnp.flip(xa, 0) if d == 1 else xa
+    final, outs = lax.scan(body, st, xx)
+    if d == 1:
+        outs = jnp.flip(outs, 0)
+    if arity == 1:
+        return (outs, final)
+    return (outs,) + tuple(final)
+
+
+class SimpleRNN(_MultiLayerRNN):
+    MODE = "RNN"
+
+    def _make_cell(self, in_sz, hid, activation, wi, wh, bi, bh):
+        return SimpleRNNCell(in_sz, hid, activation, wi, wh, bi, bh)
+
+    def _cell_fn(self, cell):
+        act = jnp.tanh if cell.activation == "tanh" else jax.nn.relu
+
+        def step(x, h, wi, wh, bi, bh):
+            nh = act(x @ wi.T + bi + h @ wh.T + bh)
+            return nh, nh
+
+        return [cell.weight_ih, cell.weight_hh, cell.bias_ih, cell.bias_hh], step
+
+    def _zero_state(self, cell, batch):
+        return cell.get_initial_states(batch, cell.hidden_size)
+
+
+class LSTM(_MultiLayerRNN):
+    MODE = "LSTM"
+
+    def _make_cell(self, in_sz, hid, activation, wi, wh, bi, bh):
+        return LSTMCell(in_sz, hid, wi, wh, bi, bh)
+
+    def _cell_fn(self, cell):
+        def step(x, state, wi, wh, bi, bh):
+            h, c = state
+            gates = x @ wi.T + bi + h @ wh.T + bh
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            nc = f * c + i * g
+            nh = o * jnp.tanh(nc)
+            return nh, (nh, nc)
+
+        return [cell.weight_ih, cell.weight_hh, cell.bias_ih, cell.bias_hh], step
+
+    def _zero_state(self, cell, batch):
+        z = cell.get_initial_states(batch, cell.hidden_size)
+        z2 = cell.get_initial_states(batch, cell.hidden_size)
+        return (z, z2)
+
+    def _state_arity(self):
+        return 2
+
+
+class GRU(_MultiLayerRNN):
+    MODE = "GRU"
+
+    def _make_cell(self, in_sz, hid, activation, wi, wh, bi, bh):
+        return GRUCell(in_sz, hid, wi, wh, bi, bh)
+
+    def _cell_fn(self, cell):
+        def step(x, h, wi, wh, bi, bh):
+            gi = x @ wi.T + bi
+            gh = h @ wh.T + bh
+            ir, iz, in_ = jnp.split(gi, 3, axis=-1)
+            hr, hz, hn = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(ir + hr)
+            z = jax.nn.sigmoid(iz + hz)
+            n = jnp.tanh(in_ + r * hn)
+            nh = (1 - z) * n + z * h
+            return nh, nh
+
+        return [cell.weight_ih, cell.weight_hh, cell.bias_ih, cell.bias_hh], step
+
+    def _zero_state(self, cell, batch):
+        return cell.get_initial_states(batch, cell.hidden_size)

@@ -1,0 +1,25 @@
+"""Functional op surface (the analog of the reference's generated
+``paddle._C_ops`` + ``python/paddle/tensor/*`` layers, reference:
+paddle/phi/ops/yaml/ops.yaml — 470 forward ops — and python/paddle/tensor/).
+
+Every op is a thin differentiable wrapper over a pure jax function, recorded
+on the eager tape by :func:`paddle_tpu.core.autograd.run_op`. The same ops work
+unchanged under jit tracing (inputs are tracers), which is how to_static works.
+"""
+from .creation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .random_ops import *  # noqa: F401,F403
+
+from . import creation, math, manipulation, linalg, logic, random_ops  # noqa: F401
+
+__all__ = (
+    creation.__all__
+    + math.__all__
+    + manipulation.__all__
+    + linalg.__all__
+    + logic.__all__
+    + random_ops.__all__
+)

@@ -1,0 +1,50 @@
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.autograd import run_op
+from ..core.dtype import to_jax_dtype
+from ..core.tensor import Tensor
+
+
+def as_tensor(x) -> Tensor:
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+def unwrap(x):
+    if isinstance(x, Tensor):
+        return x._data
+    return x
+
+
+def axis_arg(axis):
+    """Normalize paddle-style axis arg (None | int | list | Tensor)."""
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        a = axis.tolist()
+        return tuple(a) if isinstance(a, list) else int(a)
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def shape_arg(shape):
+    """Normalize paddle-style shape arg (list of ints, possibly Tensors)."""
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.tolist())
+    if isinstance(shape, (list, tuple)):
+        return tuple(int(s.item()) if isinstance(s, Tensor) else int(s) for s in shape)
+    return (int(shape),)
+
+
+def unary(fn, x, name):
+    return run_op(fn, [as_tensor(x)], name=name)
+
+
+def binary(fn, x, y, name):
+    return run_op(fn, [as_tensor(x), as_tensor(y)], name=name)
+
+
+__all__ = ["as_tensor", "unwrap", "axis_arg", "shape_arg", "unary", "binary",
+           "run_op", "to_jax_dtype", "Tensor", "jnp"]

@@ -1,0 +1,164 @@
+"""Tensor creation ops (reference: python/paddle/tensor/creation.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dtype import to_jax_dtype
+from ..core.tensor import Tensor, to_tensor
+from ._helpers import as_tensor, shape_arg, unwrap
+
+__all__ = [
+    "to_tensor",
+    "zeros",
+    "ones",
+    "full",
+    "empty",
+    "zeros_like",
+    "ones_like",
+    "full_like",
+    "empty_like",
+    "arange",
+    "linspace",
+    "logspace",
+    "eye",
+    "diag",
+    "diagflat",
+    "tril",
+    "triu",
+    "meshgrid",
+    "assign",
+    "clone",
+    "numel",
+    "tolist",
+]
+
+
+def _dt(dtype, default="float32"):
+    return to_jax_dtype(dtype if dtype is not None else default)
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(shape_arg(shape), _dt(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(shape_arg(shape), _dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    fv = unwrap(fill_value)
+    if dtype is None:
+        return Tensor(jnp.full(shape_arg(shape), fv))
+    return Tensor(jnp.full(shape_arg(shape), fv, _dt(dtype)))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None, name=None):
+    x = as_tensor(x)
+    return Tensor(jnp.zeros_like(x._data, dtype=to_jax_dtype(dtype)))
+
+
+def ones_like(x, dtype=None, name=None):
+    x = as_tensor(x)
+    return Tensor(jnp.ones_like(x._data, dtype=to_jax_dtype(dtype)))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    x = as_tensor(x)
+    return Tensor(jnp.full_like(x._data, unwrap(fill_value), dtype=to_jax_dtype(dtype)))
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    start = unwrap(start)
+    end = unwrap(end)
+    step = unwrap(step)
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        dtype = "int64" if all(
+            isinstance(v, (int, jnp.integer)) for v in (start, end, step)
+        ) else "float32"
+    return Tensor(jnp.arange(start, end, step, dtype=_dt(dtype)))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    return Tensor(jnp.linspace(unwrap(start), unwrap(stop), int(unwrap(num)),
+                               dtype=_dt(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return Tensor(jnp.logspace(unwrap(start), unwrap(stop), int(unwrap(num)),
+                               base=base, dtype=_dt(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(int(num_rows),
+                          int(num_columns) if num_columns is not None else None,
+                          dtype=_dt(dtype)))
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    x = as_tensor(x)
+    from ..core.autograd import run_op
+
+    if x.ndim == 1 and padding_value != 0:
+        def fn(a):
+            d = jnp.diag(a, k=offset)
+            mask = jnp.eye(d.shape[0], d.shape[1], k=offset, dtype=bool)
+            return jnp.where(mask, d, padding_value)
+
+        return run_op(fn, [x], name="diag")
+    return run_op(lambda a: jnp.diag(a, k=offset), [x], name="diag")
+
+
+def diagflat(x, offset=0, name=None):
+    from ..core.autograd import run_op
+
+    return run_op(lambda a: jnp.diagflat(a, k=offset), [as_tensor(x)], name="diagflat")
+
+
+def tril(x, diagonal=0, name=None):
+    from ..core.autograd import run_op
+
+    return run_op(lambda a: jnp.tril(a, k=diagonal), [as_tensor(x)], name="tril")
+
+
+def triu(x, diagonal=0, name=None):
+    from ..core.autograd import run_op
+
+    return run_op(lambda a: jnp.triu(a, k=diagonal), [as_tensor(x)], name="triu")
+
+
+def meshgrid(*args, **kwargs):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = args[0]
+    arrays = [unwrap(as_tensor(a)) for a in args]
+    outs = jnp.meshgrid(*arrays, indexing="ij")
+    return [Tensor(o) for o in outs]
+
+
+def assign(x, output=None):
+    x = as_tensor(x)
+    if output is not None:
+        output._data = x._data
+        return output
+    return Tensor(x._data)
+
+
+def clone(x, name=None):
+    return as_tensor(x).clone()
+
+
+def numel(x, name=None):
+    return Tensor(jnp.asarray(as_tensor(x).size, dtype=jnp.int64))
+
+
+def tolist(x):
+    return as_tensor(x).tolist()

@@ -1,0 +1,300 @@
+"""Linear algebra ops (reference: python/paddle/tensor/linalg.py).
+
+matmul is THE op on TPU — it is the one that lands on the MXU. Everything here
+lowers to jnp/lax dot_general so XLA can tile it onto the systolic array.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ._helpers import as_tensor, axis_arg, binary, run_op, unary, unwrap
+
+__all__ = [
+    "matmul", "bmm", "mm", "mv", "dot", "t", "norm", "dist", "cross",
+    "histogramdd", "einsum", "multi_dot", "matrix_power", "cov", "corrcoef",
+    "cholesky", "qr", "svd", "pinv", "inv", "solve", "triangular_solve",
+    "lstsq", "eig", "eigh", "eigvals", "eigvalsh", "det", "slogdet",
+    "matrix_rank", "lu", "cholesky_solve", "matrix_transpose", "cdist",
+    "householder_product", "pca_lowrank", "vander",
+]
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def fn(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+
+    return binary(fn, x, y, "matmul")
+
+
+def bmm(x, y, name=None):
+    return binary(jnp.matmul, x, y, "bmm")
+
+
+mm = matmul
+
+
+def mv(x, vec, name=None):
+    return binary(jnp.matmul, x, vec, "mv")
+
+
+def dot(x, y, name=None):
+    def fn(a, b):
+        if a.ndim == 2:
+            return jnp.sum(a * b, axis=-1)
+        return jnp.dot(a, b)
+
+    return binary(fn, x, y, "dot")
+
+
+def t(input, name=None):
+    return unary(lambda a: a.T, input, "t")
+
+
+def matrix_transpose(x, name=None):
+    return unary(lambda a: jnp.swapaxes(a, -1, -2), x, "matrix_transpose")
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    ax = axis_arg(axis)
+
+    def fn(a):
+        if p is None or p == "fro":
+            if ax is None:
+                return jnp.sqrt(jnp.sum(a * a))
+            return jnp.sqrt(jnp.sum(a * a, axis=ax, keepdims=keepdim))
+        if p == "nuc":
+            return jnp.sum(jnp.linalg.svd(a, compute_uv=False), axis=-1)
+        if p == float("inf"):
+            return jnp.max(jnp.abs(a), axis=ax, keepdims=keepdim)
+        if p == float("-inf"):
+            return jnp.min(jnp.abs(a), axis=ax, keepdims=keepdim)
+        if p == 0:
+            return jnp.sum((a != 0).astype(a.dtype), axis=ax, keepdims=keepdim)
+        return jnp.sum(jnp.abs(a) ** p, axis=ax, keepdims=keepdim) ** (1.0 / p)
+
+    return unary(fn, x, "norm")
+
+
+def dist(x, y, p=2, name=None):
+    return run_op(lambda a, b: _pnorm(a - b, p), [as_tensor(x), as_tensor(y)],
+                  name="dist")
+
+
+def _pnorm(d, p):
+    if p == float("inf"):
+        return jnp.max(jnp.abs(d))
+    if p == float("-inf"):
+        return jnp.min(jnp.abs(d))
+    if p == 0:
+        return jnp.sum((d != 0).astype(d.dtype))
+    return jnp.sum(jnp.abs(d) ** p) ** (1.0 / p)
+
+
+def cross(x, y, axis=9, name=None):
+    ax = axis if axis != 9 else None
+
+    def fn(a, b):
+        if ax is None:
+            # first axis with dim 3 (paddle semantics)
+            axis_ = next(i for i, s in enumerate(a.shape) if s == 3)
+        else:
+            axis_ = ax
+        return jnp.cross(a, b, axis=axis_)
+
+    return binary(fn, x, y, "cross")
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None, name=None):
+    import numpy as np
+
+    a = np.asarray(unwrap(as_tensor(x)))
+    w = np.asarray(unwrap(as_tensor(weights))) if weights is not None else None
+    hist, edges = np.histogramdd(a, bins=bins, range=ranges, density=density,
+                                 weights=w)
+    return Tensor(jnp.asarray(hist)), [Tensor(jnp.asarray(e)) for e in edges]
+
+
+def einsum(equation, *operands):
+    ts = [as_tensor(o) for o in operands]
+    return run_op(lambda *arrs: jnp.einsum(equation, *arrs), ts, name="einsum")
+
+
+def multi_dot(x, name=None):
+    ts = [as_tensor(o) for o in x]
+    return run_op(lambda *arrs: jnp.linalg.multi_dot(arrs), ts, name="multi_dot")
+
+
+def matrix_power(x, n, name=None):
+    return unary(lambda a: jnp.linalg.matrix_power(a, n), x, "matrix_power")
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    fw = unwrap(as_tensor(fweights)) if fweights is not None else None
+    aw = unwrap(as_tensor(aweights)) if aweights is not None else None
+    return unary(lambda a: jnp.cov(a, rowvar=rowvar, ddof=1 if ddof else 0,
+                                   fweights=fw, aweights=aw), x, "cov")
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return unary(lambda a: jnp.corrcoef(a, rowvar=rowvar), x, "corrcoef")
+
+
+def cholesky(x, upper=False, name=None):
+    def fn(a):
+        l = jnp.linalg.cholesky(a)
+        return jnp.swapaxes(l, -1, -2) if upper else l
+
+    return unary(fn, x, "cholesky")
+
+
+def qr(x, mode="reduced", name=None):
+    x = as_tensor(x)
+    outs = run_op(lambda a: tuple(jnp.linalg.qr(a, mode=mode)), [x], name="qr")
+    return outs
+
+
+def svd(x, full_matrices=False, name=None):
+    x = as_tensor(x)
+    return run_op(
+        lambda a: tuple(jnp.linalg.svd(a, full_matrices=full_matrices)),
+        [x], name="svd",
+    )
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return unary(lambda a: jnp.linalg.pinv(a, rtol=rcond, hermitian=hermitian),
+                 x, "pinv")
+
+
+def inv(x, name=None):
+    return unary(jnp.linalg.inv, x, "inv")
+
+
+def solve(x, y, name=None):
+    return binary(jnp.linalg.solve, x, y, "solve")
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False,
+                     name=None):
+    import jax.scipy.linalg as jsl
+
+    def fn(a, b):
+        return jsl.solve_triangular(a, b, lower=not upper, trans=1 if transpose else 0,
+                                    unit_diagonal=unitriangular)
+
+    return binary(fn, x, y, "triangular_solve")
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    import jax.scipy.linalg as jsl
+
+    def fn(b, l):
+        return jsl.cho_solve((l, not upper), b)
+
+    return binary(fn, x, y, "cholesky_solve")
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    x, y = as_tensor(x), as_tensor(y)
+    sol, res, rank, sv = jnp.linalg.lstsq(x._data, y._data, rcond=rcond)
+    return (Tensor(sol), Tensor(res), Tensor(rank), Tensor(sv))
+
+
+def eig(x, name=None):
+    import numpy as np
+
+    a = np.asarray(unwrap(as_tensor(x)))
+    w, v = np.linalg.eig(a)
+    return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(v))
+
+
+def eigh(x, UPLO="L", name=None):
+    x = as_tensor(x)
+    return run_op(lambda a: tuple(jnp.linalg.eigh(a, UPLO=UPLO)), [x], name="eigh")
+
+
+def eigvals(x, name=None):
+    import numpy as np
+
+    a = np.asarray(unwrap(as_tensor(x)))
+    return Tensor(jnp.asarray(np.linalg.eigvals(a)))
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return unary(lambda a: jnp.linalg.eigvalsh(a, UPLO=UPLO), x, "eigvalsh")
+
+
+def det(x, name=None):
+    return unary(jnp.linalg.det, x, "det")
+
+
+def slogdet(x, name=None):
+    x = as_tensor(x)
+    return run_op(lambda a: tuple(jnp.linalg.slogdet(a)), [x], name="slogdet")
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    x = as_tensor(x)
+    return Tensor(jnp.linalg.matrix_rank(x._data, rtol=tol))
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    import jax.scipy.linalg as jsl
+
+    x = as_tensor(x)
+    lu_, piv = jsl.lu_factor(x._data)
+    if get_infos:
+        info = jnp.zeros((), dtype=jnp.int32)
+        return Tensor(lu_), Tensor(piv.astype(jnp.int32) + 1), Tensor(info)
+    return Tensor(lu_), Tensor(piv.astype(jnp.int32) + 1)
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary",
+          name=None):
+    def fn(a, b):
+        d = a[..., :, None, :] - b[..., None, :, :]
+        if p == 2.0:
+            return jnp.sqrt(jnp.maximum(jnp.sum(d * d, axis=-1), 0))
+        return jnp.sum(jnp.abs(d) ** p, axis=-1) ** (1.0 / p)
+
+    return binary(fn, x, y, "cdist")
+
+
+def householder_product(x, tau, name=None):
+    def fn(a, t_):
+        m, n = a.shape[-2], a.shape[-1]
+        q = jnp.eye(m, dtype=a.dtype)
+        q = jnp.broadcast_to(q, a.shape[:-2] + (m, m)).copy() \
+            if a.ndim > 2 else q
+        for i in range(t_.shape[-1]):
+            v = a[..., :, i]
+            v = jnp.where(jnp.arange(m) < i, 0.0, v)
+            v = v.at[..., i].set(1.0)
+            ti = t_[..., i]
+            h = jnp.eye(m, dtype=a.dtype) - ti[..., None, None] * (
+                v[..., :, None] * v[..., None, :]
+            )
+            q = q @ h
+        return q[..., :, :n]
+
+    return run_op(fn, [as_tensor(x), as_tensor(tau)], name="householder_product")
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    x = as_tensor(x)
+    a = x._data
+    qq = q if q is not None else min(6, a.shape[-2], a.shape[-1])
+    if center:
+        a = a - a.mean(axis=-2, keepdims=True)
+    u, s, vt = jnp.linalg.svd(a, full_matrices=False)
+    return Tensor(u[..., :qq]), Tensor(s[..., :qq]), \
+        Tensor(jnp.swapaxes(vt, -1, -2)[..., :qq])
+
+
+def vander(x, n=None, increasing=False, name=None):
+    return unary(lambda a: jnp.vander(a, N=n, increasing=increasing), x, "vander")

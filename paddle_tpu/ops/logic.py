@@ -1,0 +1,70 @@
+"""Logical / comparison ops (reference: python/paddle/tensor/logic.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ._helpers import as_tensor, unwrap
+
+__all__ = [
+    "logical_and", "logical_or", "logical_not", "logical_xor",
+    "bitwise_and", "bitwise_or", "bitwise_not", "bitwise_xor",
+    "bitwise_left_shift", "bitwise_right_shift",
+    "equal", "not_equal", "greater_than", "greater_equal", "less_than",
+    "less_equal", "equal_all", "allclose", "isclose", "is_empty",
+]
+
+
+def _b(fn, name):
+    def op(x, y, out=None, name=None):
+        return Tensor(fn(unwrap(as_tensor(x)), unwrap(as_tensor(y))))
+
+    op.__name__ = name
+    return op
+
+
+def _u(fn, name):
+    def op(x, out=None, name=None):
+        return Tensor(fn(unwrap(as_tensor(x))))
+
+    op.__name__ = name
+    return op
+
+
+logical_and = _b(jnp.logical_and, "logical_and")
+logical_or = _b(jnp.logical_or, "logical_or")
+logical_xor = _b(jnp.logical_xor, "logical_xor")
+logical_not = _u(jnp.logical_not, "logical_not")
+bitwise_and = _b(jnp.bitwise_and, "bitwise_and")
+bitwise_or = _b(jnp.bitwise_or, "bitwise_or")
+bitwise_xor = _b(jnp.bitwise_xor, "bitwise_xor")
+bitwise_not = _u(jnp.bitwise_not, "bitwise_not")
+bitwise_left_shift = _b(jnp.left_shift, "bitwise_left_shift")
+bitwise_right_shift = _b(jnp.right_shift, "bitwise_right_shift")
+equal = _b(jnp.equal, "equal")
+not_equal = _b(jnp.not_equal, "not_equal")
+greater_than = _b(jnp.greater, "greater_than")
+greater_equal = _b(jnp.greater_equal, "greater_equal")
+less_than = _b(jnp.less, "less_than")
+less_equal = _b(jnp.less_equal, "less_equal")
+
+
+def equal_all(x, y, name=None):
+    a, b = unwrap(as_tensor(x)), unwrap(as_tensor(y))
+    if a.shape != b.shape:
+        return Tensor(jnp.asarray(False))
+    return Tensor(jnp.all(a == b))
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return Tensor(jnp.allclose(unwrap(as_tensor(x)), unwrap(as_tensor(y)),
+                               rtol=rtol, atol=atol, equal_nan=equal_nan))
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return Tensor(jnp.isclose(unwrap(as_tensor(x)), unwrap(as_tensor(y)),
+                              rtol=rtol, atol=atol, equal_nan=equal_nan))
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(as_tensor(x).size == 0))

@@ -1,0 +1,612 @@
+"""Shape / layout / indexing ops (reference: python/paddle/tensor/manipulation.py,
+search.py)."""
+from __future__ import annotations
+
+import builtins
+
+import jax.numpy as jnp
+
+from ..core.dtype import to_jax_dtype
+from ..core.tensor import Tensor
+from ._helpers import as_tensor, axis_arg, run_op, shape_arg, unary, unwrap
+
+__all__ = [
+    "reshape", "reshape_", "transpose", "concat", "split", "chunk", "stack",
+    "unstack", "squeeze", "unsqueeze", "flatten", "flip", "roll", "rot90",
+    "tile", "expand", "expand_as", "broadcast_to", "broadcast_tensors",
+    "gather", "gather_nd", "scatter", "scatter_nd", "scatter_nd_add",
+    "index_select", "index_sample", "index_add", "index_put",
+    "masked_select", "masked_fill", "where", "take_along_axis",
+    "put_along_axis", "slice", "strided_slice", "unbind", "repeat_interleave",
+    "topk", "sort", "argsort", "argmax", "argmin", "unique",
+    "unique_consecutive", "nonzero", "cast", "shape", "shard_index",
+    "moveaxis", "swapaxes", "as_strided", "view", "view_as", "tensordot",
+    "searchsorted", "bucketize", "pad", "one_hot", "crop", "tril_indices",
+    "triu_indices", "bincount", "histogram", "flatten_",
+]
+
+
+def reshape(x, shape, name=None):
+    shp = shape_arg(shape) if not isinstance(shape, (list, tuple)) or not any(
+        isinstance(s, Tensor) for s in shape
+    ) else tuple(int(s.item()) if isinstance(s, Tensor) else int(s) for s in shape)
+    return unary(lambda a: a.reshape(shp), x, "reshape")
+
+
+def reshape_(x, shape, name=None):
+    x._data = x._data.reshape(shape_arg(shape))
+    x._grad_node = None
+    return x
+
+
+def transpose(x, perm=None, name=None):
+    if perm is not None:
+        perm = tuple(int(p) for p in perm)
+    return unary(lambda a: jnp.transpose(a, perm), x, "transpose")
+
+
+def moveaxis(x, source, destination, name=None):
+    return unary(lambda a: jnp.moveaxis(a, source, destination), x, "moveaxis")
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    return unary(lambda a: jnp.swapaxes(a, axis0, axis1), x, "swapaxes")
+
+
+def concat(x, axis=0, name=None):
+    ts = [as_tensor(t) for t in x]
+    ax = int(unwrap(axis)) if isinstance(axis, Tensor) else int(axis)
+    return run_op(lambda *arrs: jnp.concatenate(arrs, axis=ax), ts, name="concat")
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    x = as_tensor(x)
+    ax = int(unwrap(axis)) if isinstance(axis, Tensor) else int(axis)
+    dim = x.shape[ax]
+    if isinstance(num_or_sections, int):
+        if dim % num_or_sections != 0:
+            raise ValueError(
+                f"split: axis {ax} size {dim} is not divisible by "
+                f"num_or_sections={num_or_sections}; pass explicit section "
+                "sizes instead")
+        sizes = [dim // num_or_sections] * num_or_sections
+    else:
+        sizes = [int(s) if not isinstance(s, Tensor) else int(s.item())
+                 for s in num_or_sections]
+        n_neg = builtins.sum(1 for s in sizes if s < 0)
+        if n_neg:
+            rem = dim - builtins.sum(s for s in sizes if s >= 0)
+            sizes = [rem if s < 0 else s for s in sizes]
+    offsets = []
+    acc = 0
+    for s in sizes[:-1]:
+        acc += s
+        offsets.append(acc)
+    outs = run_op(
+        lambda a: tuple(jnp.split(a, offsets, axis=ax)), [x], name="split"
+    )
+    return list(outs) if isinstance(outs, tuple) else [outs]
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def stack(x, axis=0, name=None):
+    ts = [as_tensor(t) for t in x]
+    return run_op(lambda *arrs: jnp.stack(arrs, axis=axis), ts, name="stack")
+
+
+def unstack(x, axis=0, num=None, name=None):
+    x = as_tensor(x)
+    n = num if num is not None else x.shape[axis]
+    outs = run_op(
+        lambda a: tuple(jnp.squeeze(s, axis=axis)
+                        for s in jnp.split(a, n, axis=axis)),
+        [x], name="unstack",
+    )
+    return list(outs) if isinstance(outs, tuple) else [outs]
+
+
+def squeeze(x, axis=None, name=None):
+    ax = axis_arg(axis)
+    if isinstance(ax, int):
+        ax = (ax,)
+
+    def fn(a):
+        if ax is None:
+            return jnp.squeeze(a)
+        real_ax = tuple(i for i in ax if a.shape[i if i >= 0 else a.ndim + i] == 1)
+        return jnp.squeeze(a, axis=real_ax) if real_ax else a
+
+    return unary(fn, x, "squeeze")
+
+
+def unsqueeze(x, axis, name=None):
+    ax = axis_arg(axis)
+    if isinstance(ax, int):
+        ax = (ax,)
+    return unary(lambda a: jnp.expand_dims(a, ax), x, "unsqueeze")
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    x = as_tensor(x)
+    nd = x.ndim
+    s = start_axis if start_axis >= 0 else nd + start_axis
+    e = stop_axis if stop_axis >= 0 else nd + stop_axis
+
+    def fn(a):
+        shp = a.shape[:s] + (-1,) + a.shape[e + 1:]
+        return a.reshape(shp)
+
+    return unary(fn, x, "flatten")
+
+
+def flatten_(x, start_axis=0, stop_axis=-1, name=None):
+    out = flatten(x, start_axis, stop_axis)
+    x._data = out._data
+    x._grad_node = None
+    return x
+
+
+def flip(x, axis, name=None):
+    ax = axis_arg(axis)
+    return unary(lambda a: jnp.flip(a, axis=ax), x, "flip")
+
+
+def roll(x, shifts, axis=None, name=None):
+    ax = axis_arg(axis)
+    sh = shifts if not isinstance(shifts, Tensor) else tuple(shifts.tolist())
+    return unary(lambda a: jnp.roll(a, sh, axis=ax), x, "roll")
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return unary(lambda a: jnp.rot90(a, k=k, axes=tuple(axes)), x, "rot90")
+
+
+def tile(x, repeat_times, name=None):
+    rt = shape_arg(repeat_times)
+    return unary(lambda a: jnp.tile(a, rt), x, "tile")
+
+
+def expand(x, shape, name=None):
+    shp = shape_arg(shape)
+    x = as_tensor(x)
+
+    def fn(a):
+        tgt = list(shp)
+        nd = len(tgt)
+        src = (1,) * (nd - a.ndim) + a.shape
+        for i, s in enumerate(tgt):
+            if s == -1:
+                tgt[i] = src[i]
+        return jnp.broadcast_to(a.reshape(src), tuple(tgt))
+
+    return unary(fn, x, "expand")
+
+
+def expand_as(x, y, name=None):
+    return expand(x, as_tensor(y).shape)
+
+
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+def broadcast_tensors(inputs, name=None):
+    ts = [as_tensor(t) for t in inputs]
+    shp = jnp.broadcast_shapes(*[tuple(t.shape) for t in ts])
+    return [expand(t, shp) for t in ts]
+
+
+def gather(x, index, axis=0, name=None):
+    idx = unwrap(as_tensor(index)).reshape(-1)
+    ax = int(unwrap(axis)) if isinstance(axis, Tensor) else int(axis)
+    return unary(lambda a: jnp.take(a, idx, axis=ax), x, "gather")
+
+
+def gather_nd(x, index, name=None):
+    idx = unwrap(as_tensor(index))
+
+    def fn(a):
+        last = idx.shape[-1]
+        ii = tuple(idx[..., i] for i in range(last))
+        return a[ii]
+
+    return unary(fn, x, "gather_nd")
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    idx = unwrap(as_tensor(index)).reshape(-1)
+
+    def fn(a, u):
+        if overwrite:
+            return a.at[idx].set(u)
+        # paddle scatter(overwrite=False): zero the rows then add
+        zeroed = a.at[idx].set(jnp.zeros_like(u))
+        return zeroed.at[idx].add(u)
+
+    return run_op(fn, [as_tensor(x), as_tensor(updates)], name="scatter")
+
+
+def scatter_nd(index, updates, shape, name=None):
+    idx = unwrap(as_tensor(index))
+    shp = shape_arg(shape)
+
+    def fn(u):
+        z = jnp.zeros(shp, dtype=u.dtype)
+        last = idx.shape[-1]
+        ii = tuple(idx[..., i] for i in range(last))
+        return z.at[ii].add(u)
+
+    return unary(fn, as_tensor(updates), "scatter_nd")
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    idx = unwrap(as_tensor(index))
+
+    def fn(a, u):
+        last = idx.shape[-1]
+        ii = tuple(idx[..., i] for i in range(last))
+        return a.at[ii].add(u)
+
+    return run_op(fn, [as_tensor(x), as_tensor(updates)], name="scatter_nd_add")
+
+
+def index_select(x, index, axis=0, name=None):
+    idx = unwrap(as_tensor(index)).reshape(-1)
+    return unary(lambda a: jnp.take(a, idx, axis=axis), x, "index_select")
+
+
+def index_sample(x, index, name=None):
+    idx = unwrap(as_tensor(index))
+    return unary(
+        lambda a: jnp.take_along_axis(a, idx, axis=1), x, "index_sample"
+    )
+
+
+def index_add(x, index, axis, value, name=None):
+    idx = unwrap(as_tensor(index)).reshape(-1)
+
+    def fn(a, v):
+        am = jnp.moveaxis(a, axis, 0)
+        vm = jnp.moveaxis(v, axis, 0)
+        return jnp.moveaxis(am.at[idx].add(vm), 0, axis)
+
+    return run_op(fn, [as_tensor(x), as_tensor(value)], name="index_add")
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    ii = tuple(unwrap(as_tensor(i)) for i in indices)
+
+    def fn(a, v):
+        return a.at[ii].add(v) if accumulate else a.at[ii].set(v)
+
+    return run_op(fn, [as_tensor(x), as_tensor(value)], name="index_put")
+
+
+def masked_select(x, mask, name=None):
+    # dynamic output shape: host-sync (eager only, like reference CPU sync)
+    x, m = as_tensor(x), unwrap(as_tensor(mask))
+    import numpy as np
+
+    data = np.asarray(x._data)[np.asarray(m)]
+    return Tensor(jnp.asarray(data))
+
+
+def masked_fill(x, mask, value, name=None):
+    m = unwrap(as_tensor(mask))
+    v = unwrap(value)
+    return unary(lambda a: jnp.where(m, jnp.asarray(v, dtype=a.dtype), a),
+                 x, "masked_fill")
+
+
+def where(condition, x=None, y=None, name=None):
+    cond = unwrap(as_tensor(condition))
+    if x is None and y is None:
+        return nonzero(Tensor(cond), as_tuple=True)
+    return run_op(lambda a, b: jnp.where(cond, a, b),
+                  [as_tensor(x), as_tensor(y)], name="where")
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    idx = unwrap(as_tensor(indices))
+    return unary(lambda a: jnp.take_along_axis(a, idx, axis=axis), arr,
+                 "take_along_axis")
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", name=None):
+    idx = unwrap(as_tensor(indices))
+
+    def fn(a, v):
+        vb = jnp.broadcast_to(v, idx.shape)
+        if reduce == "assign":
+            return jnp.put_along_axis(a, idx, vb, axis=axis, inplace=False)
+        ax = axis % a.ndim
+        grids = jnp.meshgrid(*[jnp.arange(s) for s in idx.shape], indexing="ij")
+        sel = tuple(idx if d == ax else grids[d] for d in range(a.ndim))
+        if reduce == "add":
+            return a.at[sel].add(vb)
+        if reduce in ("mul", "multiply"):
+            return a.at[sel].multiply(vb)
+        raise ValueError(f"unsupported reduce {reduce}")
+
+    return run_op(fn, [as_tensor(arr), as_tensor(values)], name="put_along_axis")
+
+
+def slice(input, axes, starts, ends, name=None):
+    x = as_tensor(input)
+    starts = [int(s.item()) if isinstance(s, Tensor) else int(s) for s in starts]
+    ends = [int(e.item()) if isinstance(e, Tensor) else int(e) for e in ends]
+
+    def fn(a):
+        sl = [builtins.slice(None)] * a.ndim
+        for ax, s, e in zip(axes, starts, ends):
+            sl[ax] = builtins.slice(s, e)
+        return a[tuple(sl)]
+
+    return unary(fn, x, "slice")
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    def fn(a):
+        sl = [builtins.slice(None)] * a.ndim
+        for ax, s, e, st in zip(axes, starts, ends, strides):
+            sl[ax] = builtins.slice(s, e, st)
+        return a[tuple(sl)]
+
+    return unary(fn, as_tensor(x), "strided_slice")
+
+
+def unbind(input, axis=0, name=None):
+    return unstack(input, axis)
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    r = unwrap(repeats) if isinstance(repeats, Tensor) else repeats
+    return unary(lambda a: jnp.repeat(a, r, axis=axis), x, "repeat_interleave")
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
+    x = as_tensor(x)
+    kk = int(unwrap(k)) if isinstance(k, Tensor) else int(k)
+
+    def fn(a):
+        src = a if largest else -a
+        idx = jnp.argsort(-src, axis=axis)
+        idx = jnp.take(idx, jnp.arange(kk), axis=axis)
+        vals = jnp.take_along_axis(a, idx, axis=axis)
+        return vals, idx.astype(jnp.int64)
+
+    vals = run_op(lambda a: fn(a)[0], [x], name="topk")
+    idx = Tensor(fn(x._data)[1])
+    return vals, idx
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    def fn(a):
+        s = jnp.sort(a, axis=axis, stable=stable)
+        return jnp.flip(s, axis=axis) if descending else s
+
+    return unary(fn, x, "sort")
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    x = as_tensor(x)
+    s = jnp.argsort(x._data, axis=axis, stable=stable)
+    if descending:
+        s = jnp.flip(s, axis=axis)
+    return Tensor(s.astype(jnp.int64))
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    x = as_tensor(x)
+    ax = axis_arg(axis)
+    out = jnp.argmax(x._data, axis=ax, keepdims=keepdim if ax is not None else False)
+    return Tensor(out.astype(to_jax_dtype(dtype)))
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    x = as_tensor(x)
+    ax = axis_arg(axis)
+    out = jnp.argmin(x._data, axis=ax, keepdims=keepdim if ax is not None else False)
+    return Tensor(out.astype(to_jax_dtype(dtype)))
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    # dynamic shape -> host computation (eager only)
+    import numpy as np
+
+    a = np.asarray(as_tensor(x)._data)
+    res = np.unique(a, return_index=return_index, return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor(jnp.asarray(res))
+    return tuple(Tensor(jnp.asarray(r)) for r in res)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
+                       dtype="int64", name=None):
+    import numpy as np
+
+    a = np.asarray(as_tensor(x)._data)
+    if axis is None:
+        a = a.reshape(-1)
+        ax = 0
+    else:
+        ax = axis
+    n = a.shape[ax]
+    if n == 0:
+        outs = (Tensor(jnp.asarray(a)),)
+    else:
+        am = np.moveaxis(a, ax, 0).reshape(n, -1)
+        neq = (am[1:] != am[:-1]).any(axis=1)
+        keep = np.concatenate([[True], neq])
+        out = np.compress(keep, a, axis=ax)
+        outs = (Tensor(jnp.asarray(out)),)
+        if return_inverse:
+            inv = np.cumsum(keep) - 1
+            outs += (Tensor(jnp.asarray(inv.astype(np.int64))),)
+        if return_counts:
+            idx = np.flatnonzero(keep)
+            counts = np.diff(np.append(idx, n))
+            outs += (Tensor(jnp.asarray(counts.astype(np.int64))),)
+    return outs if len(outs) > 1 else outs[0]
+
+
+def nonzero(x, as_tuple=False, name=None):
+    import numpy as np
+
+    a = np.asarray(as_tensor(x)._data)
+    nz = np.nonzero(a)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(i.astype(np.int64))) for i in nz)
+    return Tensor(jnp.asarray(np.stack(nz, axis=1).astype(np.int64)))
+
+
+def cast(x, dtype):
+    return as_tensor(x).astype(dtype)
+
+
+def shape(input):
+    return Tensor(jnp.asarray(as_tensor(input).shape, dtype=jnp.int32))
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    shard_size = (index_num + nshards - 1) // nshards
+
+    def fn(a):
+        sid = a // shard_size
+        local = a % shard_size
+        return jnp.where(sid == shard_id, local, ignore_value)
+
+    return unary(fn, as_tensor(input), "shard_index")
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    import numpy as np
+
+    a = np.asarray(as_tensor(x)._data).reshape(-1)
+    itemsize = a.itemsize
+    out = np.lib.stride_tricks.as_strided(
+        a[offset:], shape=tuple(shape), strides=tuple(s * itemsize for s in stride)
+    )
+    return Tensor(jnp.asarray(out.copy()))
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    return as_tensor(x).astype(shape_or_dtype)
+
+
+def view_as(x, other, name=None):
+    return reshape(x, as_tensor(other).shape)
+
+
+def tensordot(x, y, axes=2, name=None):
+    ax = axes
+    if isinstance(ax, Tensor):
+        ax = ax.tolist()
+    return run_op(lambda a, b: jnp.tensordot(a, b, axes=ax),
+                  [as_tensor(x), as_tensor(y)], name="tensordot")
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    ss = unwrap(as_tensor(sorted_sequence))
+    v = unwrap(as_tensor(values))
+    side = "right" if right else "left"
+    if ss.ndim == 1:
+        out = jnp.searchsorted(ss, v, side=side)
+    else:
+        import jax
+
+        out = jax.vmap(lambda s, q: jnp.searchsorted(s, q, side=side))(
+            ss.reshape(-1, ss.shape[-1]), v.reshape(-1, v.shape[-1])
+        ).reshape(v.shape)
+    return Tensor(out.astype(jnp.int32 if out_int32 else jnp.int64))
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32=out_int32, right=right)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCDHW", name=None):
+    x = as_tensor(x)
+    p = shape_arg(pad) if not isinstance(pad, (list, tuple)) else [
+        int(unwrap(v)) for v in pad
+    ]
+
+    def fn(a):
+        nd = a.ndim
+        if len(p) == 2 * nd:
+            width = [(p[2 * i], p[2 * i + 1]) for i in range(nd)]
+        else:
+            # paddle NCHW-style: pad applies to trailing spatial dims, given
+            # as [left, right, top, bottom, ...] over last len(p)//2 dims
+            # (reversed order: last dim first)
+            k = len(p) // 2
+            width = [(0, 0)] * (nd - k) + [
+                (p[2 * (k - 1 - i)], p[2 * (k - 1 - i) + 1]) for i in range(k)
+            ]
+        jmode = {"constant": "constant", "reflect": "reflect",
+                 "replicate": "edge", "circular": "wrap"}[mode]
+        if jmode == "constant":
+            return jnp.pad(a, width, mode=jmode, constant_values=value)
+        return jnp.pad(a, width, mode=jmode)
+
+    return unary(fn, x, "pad")
+
+
+def one_hot(x, num_classes, name=None):
+    import jax.nn
+
+    return unary(lambda a: jax.nn.one_hot(a, num_classes, dtype=jnp.float32),
+                 as_tensor(x), "one_hot")
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    x = as_tensor(x)
+    shp = shape_arg(shape)
+    offs = [0] * x.ndim if offsets is None else [int(unwrap(o)) for o in offsets]
+
+    def fn(a):
+        sl = tuple(builtins.slice(o, o + (s if s != -1 else a.shape[i] - o))
+                   for i, (o, s) in enumerate(zip(offs, shp)))
+        return a[sl]
+
+    return unary(fn, x, "crop")
+
+
+def tril_indices(row, col=None, offset=0, dtype="int64"):
+    col = col if col is not None else row
+    r, c = jnp.tril_indices(row, k=offset, m=col)
+    return Tensor(jnp.stack([r, c]).astype(to_jax_dtype(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    col = col if col is not None else row
+    r, c = jnp.triu_indices(row, k=offset, m=col)
+    return Tensor(jnp.stack([r, c]).astype(to_jax_dtype(dtype)))
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    w = unwrap(as_tensor(weights)) if weights is not None else None
+    a = unwrap(as_tensor(x))
+    import numpy as np
+
+    out = np.bincount(np.asarray(a), weights=np.asarray(w) if w is not None else None,
+                      minlength=minlength)
+    return Tensor(jnp.asarray(out))
+
+
+def histogram(input, bins=100, min=0, max=0, weight=None, density=False, name=None):
+    import numpy as np
+
+    a = np.asarray(unwrap(as_tensor(input)))
+    rng = None if (min == 0 and max == 0) else (min, max)
+    hist, _ = np.histogram(a, bins=bins, range=rng,
+                           weights=np.asarray(unwrap(as_tensor(weight)))
+                           if weight is not None else None, density=density)
+    return Tensor(jnp.asarray(hist))

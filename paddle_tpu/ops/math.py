@@ -1,0 +1,313 @@
+"""Math + reduction ops (reference: python/paddle/tensor/math.py, stat.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import jax.scipy.special as jsp
+
+from ._helpers import as_tensor, axis_arg, binary, run_op, unary, unwrap
+
+__all__ = [
+    # elementwise unary
+    "abs", "sign", "sqrt", "rsqrt", "square", "exp", "expm1", "log", "log2",
+    "log10", "log1p", "reciprocal", "floor", "ceil", "round", "trunc", "frac",
+    "sin", "cos", "tan", "asin", "acos", "atan", "sinh", "cosh", "tanh",
+    "asinh", "acosh", "atanh", "erf", "erfinv", "neg", "digamma", "lgamma",
+    "angle", "conj", "real", "imag",
+    # elementwise binary
+    "add", "subtract", "multiply", "divide", "floor_divide", "mod", "remainder",
+    "pow", "maximum", "minimum", "fmax", "fmin", "atan2", "hypot",
+    "logaddexp", "heaviside", "nextafter", "copysign", "gcd", "lcm",
+    # ternary / other
+    "clip", "lerp", "addmm", "scale", "stanh", "multiplex", "nan_to_num",
+    # reductions
+    "sum", "mean", "max", "min", "prod", "std", "var", "median", "nanmedian",
+    "nansum", "nanmean", "amax", "amin", "logsumexp", "all", "any", "count_nonzero",
+    # cumulative
+    "cumsum", "cumprod", "cummax", "cummin", "diff",
+    # misc
+    "isnan", "isinf", "isfinite", "inner", "outer", "trace", "kron",
+    "increment", "accuracy",
+]
+
+
+def _u(fn, op_name):
+    def op(x, name=None):
+        return unary(fn, x, op_name)
+
+    op.__name__ = op_name
+    return op
+
+
+abs = _u(jnp.abs, "abs")
+sign = _u(jnp.sign, "sign")
+sqrt = _u(jnp.sqrt, "sqrt")
+rsqrt = _u(lambda x: 1.0 / jnp.sqrt(x), "rsqrt")
+square = _u(jnp.square, "square")
+exp = _u(jnp.exp, "exp")
+expm1 = _u(jnp.expm1, "expm1")
+log = _u(jnp.log, "log")
+log2 = _u(jnp.log2, "log2")
+log10 = _u(jnp.log10, "log10")
+log1p = _u(jnp.log1p, "log1p")
+reciprocal = _u(jnp.reciprocal, "reciprocal")
+floor = _u(jnp.floor, "floor")
+ceil = _u(jnp.ceil, "ceil")
+round = _u(jnp.round, "round")
+trunc = _u(jnp.trunc, "trunc")
+frac = _u(lambda x: x - jnp.trunc(x), "frac")
+sin = _u(jnp.sin, "sin")
+cos = _u(jnp.cos, "cos")
+tan = _u(jnp.tan, "tan")
+asin = _u(jnp.arcsin, "asin")
+acos = _u(jnp.arccos, "acos")
+atan = _u(jnp.arctan, "atan")
+sinh = _u(jnp.sinh, "sinh")
+cosh = _u(jnp.cosh, "cosh")
+tanh = _u(jnp.tanh, "tanh")
+asinh = _u(jnp.arcsinh, "asinh")
+acosh = _u(jnp.arccosh, "acosh")
+atanh = _u(jnp.arctanh, "atanh")
+erf = _u(jsp.erf, "erf")
+erfinv = _u(jsp.erfinv, "erfinv")
+neg = _u(jnp.negative, "neg")
+digamma = _u(jsp.digamma, "digamma")
+lgamma = _u(jsp.gammaln, "lgamma")
+angle = _u(jnp.angle, "angle")
+conj = _u(jnp.conj, "conj")
+real = _u(jnp.real, "real")
+imag = _u(jnp.imag, "imag")
+
+
+def _b(fn, op_name):
+    def op(x, y, name=None):
+        return binary(fn, x, y, op_name)
+
+    op.__name__ = op_name
+    return op
+
+
+add = _b(jnp.add, "add")
+subtract = _b(jnp.subtract, "subtract")
+multiply = _b(jnp.multiply, "multiply")
+divide = _b(jnp.true_divide, "divide")
+floor_divide = _b(jnp.floor_divide, "floor_divide")
+mod = _b(jnp.mod, "mod")
+remainder = mod
+pow = _b(jnp.power, "pow")
+maximum = _b(jnp.maximum, "maximum")
+minimum = _b(jnp.minimum, "minimum")
+fmax = _b(jnp.fmax, "fmax")
+fmin = _b(jnp.fmin, "fmin")
+atan2 = _b(jnp.arctan2, "atan2")
+hypot = _b(jnp.hypot, "hypot")
+logaddexp = _b(jnp.logaddexp, "logaddexp")
+heaviside = _b(jnp.heaviside, "heaviside")
+nextafter = _b(jnp.nextafter, "nextafter")
+copysign = _b(jnp.copysign, "copysign")
+gcd = _b(jnp.gcd, "gcd")
+lcm = _b(jnp.lcm, "lcm")
+
+
+def clip(x, min=None, max=None, name=None):
+    mn = unwrap(min) if min is not None else None
+    mx = unwrap(max) if max is not None else None
+    return unary(lambda a: jnp.clip(a, mn, mx), x, "clip")
+
+
+def lerp(x, y, weight, name=None):
+    w = unwrap(weight)
+    return run_op(lambda a, b: a + w * (b - a), [as_tensor(x), as_tensor(y)], name="lerp")
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return run_op(
+        lambda i, a, b: beta * i + alpha * (a @ b),
+        [as_tensor(input), as_tensor(x), as_tensor(y)],
+        name="addmm",
+    )
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    s, b = unwrap(scale), unwrap(bias)
+    if bias_after_scale:
+        out = unary(lambda a: a * s + b, x, "scale")
+    else:
+        out = unary(lambda a: (a + b) * s, x, "scale")
+    return out
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return unary(lambda a: scale_b * jnp.tanh(scale_a * a), x, "stanh")
+
+
+def multiplex(inputs, index, name=None):
+    idx = unwrap(as_tensor(index)).reshape(-1)
+    ts = [as_tensor(t) for t in inputs]
+    return run_op(
+        lambda *arrs: jnp.stack(arrs, 0)[idx, jnp.arange(arrs[0].shape[0])],
+        ts,
+        name="multiplex",
+    )
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return unary(lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf, neginf=neginf),
+                 x, "nan_to_num")
+
+
+# ------------------------------------------------------------------ reductions
+def _red(fn, op_name, bool_out=False):
+    def op(x, axis=None, keepdim=False, name=None):
+        ax = axis_arg(axis)
+        return unary(lambda a: fn(a, axis=ax, keepdims=keepdim), x, op_name)
+
+    op.__name__ = op_name
+    return op
+
+
+sum = _red(jnp.sum, "sum")
+mean = _red(jnp.mean, "mean")
+prod = _red(jnp.prod, "prod")
+amax = _red(jnp.max, "amax")
+amin = _red(jnp.min, "amin")
+nansum = _red(jnp.nansum, "nansum")
+nanmean = _red(jnp.nanmean, "nanmean")
+all = _red(jnp.all, "all")
+any = _red(jnp.any, "any")
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    return unary(lambda a: jnp.max(a, axis=axis_arg(axis), keepdims=keepdim), x, "max")
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    return unary(lambda a: jnp.min(a, axis=axis_arg(axis), keepdims=keepdim), x, "min")
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ddof = 1 if unbiased else 0
+    return unary(lambda a: jnp.std(a, axis=axis_arg(axis), ddof=ddof,
+                                   keepdims=keepdim), x, "std")
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ddof = 1 if unbiased else 0
+    return unary(lambda a: jnp.var(a, axis=axis_arg(axis), ddof=ddof,
+                                   keepdims=keepdim), x, "var")
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    return unary(lambda a: jnp.median(a, axis=axis_arg(axis), keepdims=keepdim),
+                 x, "median")
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    return unary(lambda a: jnp.nanmedian(a, axis=axis_arg(axis), keepdims=keepdim),
+                 x, "nanmedian")
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    return unary(lambda a: jsp.logsumexp(a, axis=axis_arg(axis), keepdims=keepdim),
+                 x, "logsumexp")
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return unary(lambda a: jnp.count_nonzero(a, axis=axis_arg(axis),
+                                             keepdims=keepdim), x, "count_nonzero")
+
+
+# ------------------------------------------------------------------ cumulative
+def cumsum(x, axis=None, dtype=None, name=None):
+    ax = axis_arg(axis)
+    return unary(lambda a: jnp.cumsum(a.reshape(-1) if ax is None else a,
+                                      axis=0 if ax is None else ax), x, "cumsum")
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    ax = axis_arg(dim)
+    return unary(lambda a: jnp.cumprod(a.reshape(-1) if ax is None else a,
+                                       axis=0 if ax is None else ax), x, "cumprod")
+
+
+def _cum_extreme(x, axis, is_max, name):
+    """Cumulative max/min returning (values, running argindex), via ONE pair
+    associative scan — O(log n) depth, TPU-friendly (no serial loop)."""
+    from ..core.tensor import Tensor
+    import jax.lax as lax
+
+    x = as_tensor(x)
+    ax = axis_arg(axis)
+    xx = x if ax is not None else x.reshape([-1])
+    ax0 = ax if ax is not None else 0
+    n = xx._data.shape[ax0]
+    idx_shape = [1] * xx._data.ndim
+    idx_shape[ax0] = n
+
+    def combine(l, r):
+        lv, li = l
+        rv, ri = r
+        keep_l = lv > rv if is_max else lv < rv
+        return jnp.where(keep_l, lv, rv), jnp.where(keep_l, li, ri)
+
+    def fn(a):
+        idx0 = jnp.broadcast_to(
+            jnp.arange(n, dtype=jnp.int32).reshape(idx_shape), a.shape)
+        vals, idx = lax.associative_scan(combine, (a, idx0), axis=ax0)
+        return vals, idx.astype(jnp.int64)
+
+    out, idx = run_op(fn, [xx], name=name)
+    return out, idx.detach()
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    return _cum_extreme(x, axis, True, "cummax")
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    return _cum_extreme(x, axis, False, "cummin")
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    pre = unwrap(prepend) if prepend is not None else None
+    app = unwrap(append) if append is not None else None
+    return unary(lambda a: jnp.diff(a, n=n, axis=axis, prepend=pre, append=app),
+                 x, "diff")
+
+
+# ------------------------------------------------------------------ predicates
+isnan = _u(jnp.isnan, "isnan")
+isinf = _u(jnp.isinf, "isinf")
+isfinite = _u(jnp.isfinite, "isfinite")
+
+
+def inner(x, y, name=None):
+    return binary(jnp.inner, x, y, "inner")
+
+
+def outer(x, y, name=None):
+    return binary(lambda a, b: jnp.outer(a, b), x, y, "outer")
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return unary(lambda a: jnp.trace(a, offset=offset, axis1=axis1, axis2=axis2),
+                 x, "trace")
+
+
+def kron(x, y, name=None):
+    return binary(jnp.kron, x, y, "kron")
+
+
+def increment(x, value=1.0, name=None):
+    x._data = x._data + value
+    return x
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    """Top-k accuracy metric (reference: python/paddle/static/nn/metric.py)."""
+    from ..core.tensor import Tensor
+
+    inp = unwrap(as_tensor(input))
+    lab = unwrap(as_tensor(label)).reshape(-1)
+    topk_idx = jnp.argsort(-inp, axis=-1)[:, :k]
+    correct_mask = (topk_idx == lab[:, None]).any(axis=-1)
+    return Tensor(correct_mask.mean(dtype=jnp.float32))

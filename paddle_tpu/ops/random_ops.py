@@ -1,0 +1,151 @@
+"""Random sampling ops (reference: python/paddle/tensor/random.py).
+
+Functional JAX RNG under a stateful facade: every call splits the global key
+(:mod:`paddle_tpu.core.random`), or folds a traced key inside jit.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import random as _rng
+from ..core.dtype import to_jax_dtype
+from ..core.tensor import Tensor
+from ._helpers import as_tensor, shape_arg, unwrap
+
+__all__ = [
+    "rand", "randn", "randint", "randint_like", "randperm", "uniform",
+    "uniform_", "normal", "normal_", "standard_normal", "poisson",
+    "bernoulli", "multinomial", "exponential_", "rand_like", "randn_like",
+    "binomial", "log_normal", "cauchy_",
+]
+
+
+def _dt(dtype, default="float32"):
+    return to_jax_dtype(dtype if dtype is not None else default)
+
+
+def rand(shape, dtype=None, name=None):
+    return Tensor(jax.random.uniform(_rng.next_key(), shape_arg(shape),
+                                     dtype=_dt(dtype)))
+
+
+def randn(shape, dtype=None, name=None):
+    return Tensor(jax.random.normal(_rng.next_key(), shape_arg(shape),
+                                    dtype=_dt(dtype)))
+
+
+standard_normal = randn
+
+
+def randint(low=0, high=None, shape=(1,), dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    return Tensor(jax.random.randint(_rng.next_key(), shape_arg(shape),
+                                     int(low), int(high),
+                                     dtype=_dt(dtype, "int64")))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    x = as_tensor(x)
+    if high is None:
+        low, high = 0, low
+    dt = _dt(dtype, None) or x._data.dtype
+    return Tensor(jax.random.randint(_rng.next_key(), tuple(x.shape),
+                                     int(low), int(high)).astype(dt))
+
+
+def randperm(n, dtype="int64", name=None):
+    return Tensor(jax.random.permutation(_rng.next_key(), int(n))
+                  .astype(_dt(dtype, "int64")))
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    key = jax.random.PRNGKey(seed) if seed else _rng.next_key()
+    return Tensor(jax.random.uniform(key, shape_arg(shape), dtype=_dt(dtype),
+                                     minval=float(unwrap(min)),
+                                     maxval=float(unwrap(max))))
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    out = uniform(x.shape, dtype=x.dtype, min=min, max=max, seed=seed)
+    x._data = out._data
+    x._grad_node = None
+    return x
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = unwrap(as_tensor(mean))
+        s = unwrap(as_tensor(std))
+        shp = jnp.broadcast_shapes(jnp.shape(m), jnp.shape(s))
+        return Tensor(m + s * jax.random.normal(_rng.next_key(), shp))
+    shp = shape_arg(shape) if shape is not None else ()
+    return Tensor(mean + std * jax.random.normal(_rng.next_key(), shp))
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    x._data = (mean + std * jax.random.normal(_rng.next_key(), tuple(x.shape))
+               ).astype(x._data.dtype)
+    x._grad_node = None
+    return x
+
+
+def poisson(x, name=None):
+    lam = unwrap(as_tensor(x))
+    return Tensor(jax.random.poisson(_rng.next_key(), lam).astype(lam.dtype))
+
+
+def bernoulli(x, name=None):
+    p = unwrap(as_tensor(x))
+    return Tensor(jax.random.bernoulli(_rng.next_key(), p).astype(p.dtype))
+
+
+def binomial(count, prob, name=None):
+    n = unwrap(as_tensor(count))
+    p = unwrap(as_tensor(prob))
+    return Tensor(jax.random.binomial(_rng.next_key(), n, p).astype(jnp.int64))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    probs = unwrap(as_tensor(x))
+    key = _rng.next_key()
+    logits = jnp.log(jnp.maximum(probs, 1e-30))
+    if replacement:
+        out = jax.random.categorical(key, logits, axis=-1,
+                                     shape=(num_samples,) + logits.shape[:-1])
+        out = jnp.moveaxis(out, 0, -1) if logits.ndim > 1 else out
+    else:
+        # Gumbel top-k trick for sampling without replacement
+        g = jax.random.gumbel(key, logits.shape)
+        out = jnp.argsort(-(logits + g), axis=-1)
+        out = out[..., :num_samples]
+    return Tensor(out.astype(jnp.int64))
+
+
+def exponential_(x, lam=1.0, name=None):
+    x._data = (jax.random.exponential(_rng.next_key(), tuple(x.shape)) / lam
+               ).astype(x._data.dtype)
+    x._grad_node = None
+    return x
+
+
+def rand_like(x, dtype=None, name=None):
+    x = as_tensor(x)
+    return rand(x.shape, dtype=dtype or x.dtype)
+
+
+def randn_like(x, dtype=None, name=None):
+    x = as_tensor(x)
+    return randn(x.shape, dtype=dtype or x.dtype)
+
+
+def log_normal(mean=1.0, std=2.0, shape=None, name=None):
+    return Tensor(jnp.exp(unwrap(normal(mean, std, shape))))
+
+
+def cauchy_(x, loc=0, scale=1, name=None):
+    u = jax.random.uniform(_rng.next_key(), tuple(x.shape))
+    x._data = (loc + scale * jnp.tan(jnp.pi * (u - 0.5))).astype(x._data.dtype)
+    x._grad_node = None
+    return x

@@ -1,0 +1,155 @@
+"""Optimizer base (reference: python/paddle/optimizer/optimizer.py:127).
+
+Stateful eager optimizers over jax arrays. Each optimizer also exposes a pure
+functional ``update(params, grads, state) -> (new_params, new_state)`` used by
+the jit/train-step path (and by sharded optimizers), so the same math runs
+inside compiled programs.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Parameter
+from .lr import LRScheduler
+
+__all__ = ["Optimizer"]
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        self._learning_rate = learning_rate
+        self._parameter_list = list(parameters) if parameters is not None else None
+        self._grad_clip = grad_clip
+        if isinstance(weight_decay, (int, float)):
+            self._weight_decay = float(weight_decay)
+        elif weight_decay is None:
+            self._weight_decay = None
+        else:  # L2Decay-like object with a coeff
+            self._weight_decay = float(getattr(weight_decay, "_coeff",
+                                               getattr(weight_decay, "coeff", 0.0)))
+        self._accumulators: Dict[str, Dict[int, jnp.ndarray]] = {}
+        self._global_step = 0
+        # support param_groups: list of dicts with 'params' and overrides
+        self._param_groups = None
+        if self._parameter_list and isinstance(self._parameter_list[0], dict):
+            self._param_groups = self._parameter_list
+            flat = []
+            for grp in self._param_groups:
+                flat.extend(grp["params"])
+            self._parameter_list = flat
+
+    # ----------------------------------------------------------- lr plumbing
+    def get_lr(self) -> float:
+        if isinstance(self._learning_rate, LRScheduler):
+            return float(self._learning_rate())
+        return float(self._learning_rate)
+
+    def set_lr(self, value):
+        if isinstance(self._learning_rate, LRScheduler):
+            raise RuntimeError(
+                "cannot set_lr when the lr is an LRScheduler; call "
+                "scheduler.step() instead")
+        self._learning_rate = value
+
+    def _param_lr(self, p) -> float:
+        base = self.get_lr()
+        scale = getattr(p, "optimize_attr", {}).get("learning_rate", 1.0) \
+            if hasattr(p, "optimize_attr") else 1.0
+        return base * scale
+
+    # ----------------------------------------------------------- accumulators
+    def _get_accumulator(self, name: str, p: Tensor, init=None):
+        slot = self._accumulators.setdefault(name, {})
+        key = id(p)
+        if key not in slot:
+            slot[key] = jnp.zeros_like(p._data) if init is None else init
+        return slot[key]
+
+    def _set_accumulator(self, name: str, p: Tensor, value):
+        self._accumulators[name][id(p)] = value
+
+    # ----------------------------------------------------------- step
+    def step(self):
+        params = self._parameter_list
+        if params is None:
+            raise ValueError("optimizer constructed without parameters")
+        params_grads = [(p, p._grad) for p in params
+                        if not p.stop_gradient and p._grad is not None]
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        self._global_step += 1
+        for p, g in params_grads:
+            if g is None:
+                continue
+            self._append_optimize_op(p, g._data if isinstance(g, Tensor) else g)
+
+    def _append_optimize_op(self, p, grad):
+        raise NotImplementedError
+
+    def clear_grad(self, set_to_zero: bool = False):
+        if self._parameter_list:
+            for p in self._parameter_list:
+                p.clear_gradient(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, None
+
+    # ----------------------------------------------------------- state dict
+    def state_dict(self):
+        out = {}
+        id2name = {}
+        if self._parameter_list:
+            for i, p in enumerate(self._parameter_list):
+                id2name[id(p)] = p.name or f"param_{i}"
+        for accname, slot in self._accumulators.items():
+            for pid, arr in slot.items():
+                pname = id2name.get(pid, str(pid))
+                out[f"{pname}.{accname}"] = Tensor(arr)
+        out["global_step"] = self._global_step
+        if isinstance(self._learning_rate, LRScheduler):
+            out["LR_Scheduler"] = self._learning_rate.state_dict()
+        return out
+
+    def set_state_dict(self, state_dict):
+        id2name = {}
+        if self._parameter_list:
+            for i, p in enumerate(self._parameter_list):
+                id2name[f"{p.name or f'param_{i}'}"] = p
+        self._global_step = int(state_dict.get("global_step", 0))
+        if "LR_Scheduler" in state_dict and isinstance(self._learning_rate,
+                                                       LRScheduler):
+            self._learning_rate.set_state_dict(state_dict["LR_Scheduler"])
+        for key, val in state_dict.items():
+            if key in ("global_step", "LR_Scheduler"):
+                continue
+            pname, accname = key.rsplit(".", 1)
+            p = id2name.get(pname)
+            if p is None:
+                continue
+            arr = val._data if isinstance(val, Tensor) else jnp.asarray(val)
+            self._accumulators.setdefault(accname, {})[id(p)] = arr
+
+    # ----------------------------------------------------------- functional
+    def init_state(self, params: List[jnp.ndarray]):
+        """Pure functional state init for the jit path."""
+        raise NotImplementedError
+
+    def update(self, params, grads, state, lr=None):
+        """Pure functional update for the jit path."""
+        raise NotImplementedError
+
+    def _decayed(self, p, grad):
+        """Apply decoupled L2 weight decay is optimizer-specific; helper for
+        coupled L2 (adds wd*param to grad)."""
+        if self._weight_decay:
+            return grad + self._weight_decay * p._data
+        return grad

@@ -1,0 +1,449 @@
+"""Concrete optimizers (reference: python/paddle/optimizer/{sgd,momentum,adam,
+adamw,...}.py). Stateful eager step + pure functional update for jit."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .optimizer import Optimizer
+
+__all__ = ["SGD", "Momentum", "Adam", "AdamW", "Adagrad", "Adadelta",
+           "Adamax", "RMSProp", "Lamb", "NAdam", "RAdam", "ASGD", "Rprop",
+           "LBFGS"]
+
+
+class SGD(Optimizer):
+    def _append_optimize_op(self, p, grad):
+        grad = self._decayed(p, grad)
+        p._data = (p._data - self._param_lr(p) * grad).astype(p._data.dtype)
+
+    def init_state(self, params):
+        return {}
+
+    def update(self, params, grads, state, lr=None):
+        lr = lr if lr is not None else self.get_lr()
+        wd = self._weight_decay or 0.0
+        new = [p - lr * (g + wd * p) for p, g in zip(params, grads)]
+        return new, state
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _append_optimize_op(self, p, grad):
+        grad = self._decayed(p, grad)
+        v = self._get_accumulator("velocity", p)
+        v = self._momentum * v + grad
+        if self._use_nesterov:
+            upd = grad + self._momentum * v
+        else:
+            upd = v
+        self._set_accumulator("velocity", p, v)
+        p._data = (p._data - self._param_lr(p) * upd).astype(p._data.dtype)
+
+    def init_state(self, params):
+        return {"velocity": [jnp.zeros_like(p) for p in params]}
+
+    def update(self, params, grads, state, lr=None):
+        lr = lr if lr is not None else self.get_lr()
+        wd = self._weight_decay or 0.0
+        newv, newp = [], []
+        for p, g, v in zip(params, grads, state["velocity"]):
+            g = g + wd * p
+            v = self._momentum * v + g
+            upd = g + self._momentum * v if self._use_nesterov else v
+            newv.append(v)
+            newp.append(p - lr * upd)
+        return newp, {"velocity": newv}
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 use_multi_tensor=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _append_optimize_op(self, p, grad):
+        grad = self._decayed(p, grad)
+        self._adam_update(p, grad)
+
+    def _adam_update(self, p, grad, decoupled_wd=0.0):
+        f32 = jnp.float32
+        m = self._get_accumulator("moment1", p,
+                                  jnp.zeros_like(p._data, dtype=f32))
+        v = self._get_accumulator("moment2", p,
+                                  jnp.zeros_like(p._data, dtype=f32))
+        t = self._get_accumulator("step", p, jnp.zeros((), f32)) + 1
+        g32 = grad.astype(f32)
+        m = self._beta1 * m + (1 - self._beta1) * g32
+        v = self._beta2 * v + (1 - self._beta2) * (g32 * g32)
+        mhat = m / (1 - self._beta1 ** t)
+        vhat = v / (1 - self._beta2 ** t)
+        lr = self._param_lr(p)
+        p32 = p._data.astype(f32)
+        if decoupled_wd:
+            p32 = p32 * (1 - lr * decoupled_wd)
+        p32 = p32 - lr * mhat / (jnp.sqrt(vhat) + self._epsilon)
+        self._set_accumulator("moment1", p, m)
+        self._set_accumulator("moment2", p, v)
+        self._set_accumulator("step", p, t)
+        p._data = p32.astype(p._data.dtype)
+
+    def init_state(self, params):
+        f32 = jnp.float32
+        return {
+            "m": [jnp.zeros_like(p, dtype=f32) for p in params],
+            "v": [jnp.zeros_like(p, dtype=f32) for p in params],
+            "t": jnp.zeros((), f32),
+        }
+
+    def update(self, params, grads, state, lr=None):
+        lr = lr if lr is not None else self.get_lr()
+        wd = self._weight_decay or 0.0
+        f32 = jnp.float32
+        t = state["t"] + 1
+        nm, nv, np_ = [], [], []
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        for p, g, m, v in zip(params, grads, state["m"], state["v"]):
+            g32 = g.astype(f32) + wd * p.astype(f32)
+            m = b1 * m + (1 - b1) * g32
+            v = b2 * v + (1 - b2) * g32 * g32
+            mhat = m / (1 - b1 ** t)
+            vhat = v / (1 - b2 ** t)
+            out = p.astype(f32) - lr * mhat / (jnp.sqrt(vhat) + eps)
+            nm.append(m)
+            nv.append(v)
+            np_.append(out.astype(p.dtype))
+        return np_, {"m": nm, "v": nv, "t": t}
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (reference: python/paddle/optimizer/adamw.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip, lazy_mode, multi_precision)
+        self._coeff = float(weight_decay) if not hasattr(weight_decay, "_coeff") \
+            else float(weight_decay._coeff)
+        self._apply_decay_param_fun = apply_decay_param_fun
+        self._lr_ratio = lr_ratio
+
+    def _append_optimize_op(self, p, grad):
+        wd = self._coeff
+        if self._apply_decay_param_fun is not None and \
+                not self._apply_decay_param_fun(p.name):
+            wd = 0.0
+        self._adam_update(p, grad, decoupled_wd=wd)
+
+    def update(self, params, grads, state, lr=None):
+        lr = lr if lr is not None else self.get_lr()
+        f32 = jnp.float32
+        t = state["t"] + 1
+        nm, nv, np_ = [], [], []
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        for p, g, m, v in zip(params, grads, state["m"], state["v"]):
+            g32 = g.astype(f32)
+            m = b1 * m + (1 - b1) * g32
+            v = b2 * v + (1 - b2) * g32 * g32
+            mhat = m / (1 - b1 ** t)
+            vhat = v / (1 - b2 ** t)
+            p32 = p.astype(f32) * (1 - lr * self._coeff)
+            out = p32 - lr * mhat / (jnp.sqrt(vhat) + eps)
+            nm.append(m)
+            nv.append(v)
+            np_.append(out.astype(p.dtype))
+        return np_, {"m": nm, "v": nv, "t": t}
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None,
+                 initial_accumulator_value=0.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _append_optimize_op(self, p, grad):
+        grad = self._decayed(p, grad)
+        acc = self._get_accumulator(
+            "moment", p, jnp.full_like(p._data, self._init_acc))
+        acc = acc + grad * grad
+        self._set_accumulator("moment", p, acc)
+        p._data = (p._data - self._param_lr(p) * grad
+                   / (jnp.sqrt(acc) + self._epsilon)).astype(p._data.dtype)
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._epsilon = epsilon
+        self._rho = rho
+
+    def _append_optimize_op(self, p, grad):
+        grad = self._decayed(p, grad)
+        avg_sq = self._get_accumulator("avg_squared_grad", p)
+        avg_upd = self._get_accumulator("avg_squared_update", p)
+        avg_sq = self._rho * avg_sq + (1 - self._rho) * grad * grad
+        upd = (jnp.sqrt(avg_upd + self._epsilon)
+               / jnp.sqrt(avg_sq + self._epsilon)) * grad
+        avg_upd = self._rho * avg_upd + (1 - self._rho) * upd * upd
+        self._set_accumulator("avg_squared_grad", p, avg_sq)
+        self._set_accumulator("avg_squared_update", p, avg_upd)
+        p._data = (p._data - self._param_lr(p) * upd).astype(p._data.dtype)
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _append_optimize_op(self, p, grad):
+        grad = self._decayed(p, grad)
+        m = self._get_accumulator("moment", p)
+        u = self._get_accumulator("inf_norm", p)
+        t = self._get_accumulator("step", p, jnp.zeros((), jnp.float32)) + 1
+        m = self._beta1 * m + (1 - self._beta1) * grad
+        u = jnp.maximum(self._beta2 * u, jnp.abs(grad))
+        lr = self._param_lr(p) / (1 - self._beta1 ** t)
+        self._set_accumulator("moment", p, m)
+        self._set_accumulator("inf_norm", p, u)
+        self._set_accumulator("step", p, t)
+        p._data = (p._data - lr * m / (u + self._epsilon)).astype(p._data.dtype)
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._rho = rho
+        self._epsilon = epsilon
+        self._momentum = momentum
+        self._centered = centered
+
+    def _append_optimize_op(self, p, grad):
+        grad = self._decayed(p, grad)
+        ms = self._get_accumulator("mean_square", p)
+        mom = self._get_accumulator("momentum", p)
+        ms = self._rho * ms + (1 - self._rho) * grad * grad
+        if self._centered:
+            mg = self._get_accumulator("mean_grad", p)
+            mg = self._rho * mg + (1 - self._rho) * grad
+            denom = jnp.sqrt(ms - mg * mg + self._epsilon)
+            self._set_accumulator("mean_grad", p, mg)
+        else:
+            denom = jnp.sqrt(ms + self._epsilon)
+        mom = self._momentum * mom + self._param_lr(p) * grad / denom
+        self._set_accumulator("mean_square", p, ms)
+        self._set_accumulator("momentum", p, mom)
+        p._data = (p._data - mom).astype(p._data.dtype)
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip)
+        self._wd = lamb_weight_decay
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _append_optimize_op(self, p, grad):
+        f32 = jnp.float32
+        m = self._get_accumulator("moment1", p,
+                                  jnp.zeros_like(p._data, dtype=f32))
+        v = self._get_accumulator("moment2", p,
+                                  jnp.zeros_like(p._data, dtype=f32))
+        t = self._get_accumulator("step", p, jnp.zeros((), f32)) + 1
+        g32 = grad.astype(f32)
+        m = self._beta1 * m + (1 - self._beta1) * g32
+        v = self._beta2 * v + (1 - self._beta2) * g32 * g32
+        mhat = m / (1 - self._beta1 ** t)
+        vhat = v / (1 - self._beta2 ** t)
+        wd = self._wd
+        if self._exclude_fn is not None and self._exclude_fn(p):
+            wd = 0.0
+        r = mhat / (jnp.sqrt(vhat) + self._epsilon) + wd * p._data.astype(f32)
+        w_norm = jnp.linalg.norm(p._data.astype(f32))
+        r_norm = jnp.linalg.norm(r)
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        self._set_accumulator("moment1", p, m)
+        self._set_accumulator("moment2", p, v)
+        self._set_accumulator("step", p, t)
+        p._data = (p._data.astype(f32) - self._param_lr(p) * trust * r
+                   ).astype(p._data.dtype)
+
+
+class NAdam(Adam):
+    def _append_optimize_op(self, p, grad):
+        f32 = jnp.float32
+        grad = self._decayed(p, grad)
+        m = self._get_accumulator("moment1", p,
+                                  jnp.zeros_like(p._data, dtype=f32))
+        v = self._get_accumulator("moment2", p,
+                                  jnp.zeros_like(p._data, dtype=f32))
+        t = self._get_accumulator("step", p, jnp.zeros((), f32)) + 1
+        g32 = grad.astype(f32)
+        m = self._beta1 * m + (1 - self._beta1) * g32
+        v = self._beta2 * v + (1 - self._beta2) * g32 * g32
+        mhat = (self._beta1 * m / (1 - self._beta1 ** (t + 1))
+                + (1 - self._beta1) * g32 / (1 - self._beta1 ** t))
+        vhat = v / (1 - self._beta2 ** t)
+        self._set_accumulator("moment1", p, m)
+        self._set_accumulator("moment2", p, v)
+        self._set_accumulator("step", p, t)
+        p._data = (p._data.astype(f32) - self._param_lr(p) * mhat
+                   / (jnp.sqrt(vhat) + self._epsilon)).astype(p._data.dtype)
+
+
+class RAdam(Adam):
+    def _append_optimize_op(self, p, grad):
+        f32 = jnp.float32
+        grad = self._decayed(p, grad)
+        m = self._get_accumulator("moment1", p,
+                                  jnp.zeros_like(p._data, dtype=f32))
+        v = self._get_accumulator("moment2", p,
+                                  jnp.zeros_like(p._data, dtype=f32))
+        t = self._get_accumulator("step", p, jnp.zeros((), f32)) + 1
+        g32 = grad.astype(f32)
+        b1, b2 = self._beta1, self._beta2
+        m = b1 * m + (1 - b1) * g32
+        v = b2 * v + (1 - b2) * g32 * g32
+        mhat = m / (1 - b1 ** t)
+        rho_inf = 2.0 / (1 - b2) - 1
+        rho_t = rho_inf - 2 * t * b2 ** t / (1 - b2 ** t)
+        lr = self._param_lr(p)
+
+        def rectified():
+            r = jnp.sqrt(((rho_t - 4) * (rho_t - 2) * rho_inf)
+                         / ((rho_inf - 4) * (rho_inf - 2) * rho_t))
+            vhat = jnp.sqrt(v / (1 - b2 ** t))
+            return lr * r * mhat / (vhat + self._epsilon)
+
+        upd = jnp.where(rho_t > 5.0, rectified(), lr * mhat)
+        self._set_accumulator("moment1", p, m)
+        self._set_accumulator("moment2", p, v)
+        self._set_accumulator("step", p, t)
+        p._data = (p._data.astype(f32) - upd).astype(p._data.dtype)
+
+
+class ASGD(SGD):
+    pass
+
+
+class Rprop(Optimizer):
+    def __init__(self, learning_rate=0.001, learning_rate_range=(1e-5, 50),
+                 parameters=None, etas=(0.5, 1.2), grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip)
+        self._lr_range = learning_rate_range
+        self._etas = etas
+
+    def _append_optimize_op(self, p, grad):
+        prev = self._get_accumulator("prev_grad", p)
+        lr = self._get_accumulator("lr", p,
+                                   jnp.full_like(p._data, self.get_lr()))
+        sign = jnp.sign(grad * prev)
+        lr = jnp.where(sign > 0, jnp.minimum(lr * self._etas[1],
+                                             self._lr_range[1]),
+                       jnp.where(sign < 0,
+                                 jnp.maximum(lr * self._etas[0],
+                                             self._lr_range[0]), lr))
+        g = jnp.where(sign < 0, 0.0, grad)
+        self._set_accumulator("prev_grad", p, g)
+        self._set_accumulator("lr", p, lr)
+        p._data = (p._data - lr * jnp.sign(g)).astype(p._data.dtype)
+
+
+class LBFGS(Optimizer):
+    """L-BFGS with strong-wolfe-free backtracking (reference:
+    python/paddle/optimizer/lbfgs.py). Requires a closure."""
+
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9, history_size=100,
+                 line_search_fn=None, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._max_iter = max_iter
+        self._history_size = history_size
+        self._tol_grad = tolerance_grad
+        self._tol_change = tolerance_change
+        self._s_list = []
+        self._y_list = []
+        self._prev_flat_grad = None
+
+    def _flat_grad(self):
+        return jnp.concatenate(
+            [(p._grad._data if p._grad is not None
+              else jnp.zeros_like(p._data)).reshape(-1)
+             for p in self._parameter_list])
+
+    def _apply_flat(self, upd):
+        off = 0
+        for p in self._parameter_list:
+            n = p.size
+            p._data = (p._data + upd[off:off + n].reshape(p._data.shape)
+                       ).astype(p._data.dtype)
+            off += n
+
+    def step(self, closure=None):
+        if closure is None:
+            raise ValueError("LBFGS.step requires a closure")
+        loss = closure()
+        for _ in range(self._max_iter):
+            g = self._flat_grad()
+            if jnp.max(jnp.abs(g)) < self._tol_grad:
+                break
+            # two-loop recursion
+            q = g
+            alphas = []
+            for s, y in zip(reversed(self._s_list), reversed(self._y_list)):
+                rho = 1.0 / jnp.maximum(jnp.dot(y, s), 1e-10)
+                a = rho * jnp.dot(s, q)
+                q = q - a * y
+                alphas.append((a, rho, s, y))
+            if self._y_list:
+                y_last, s_last = self._y_list[-1], self._s_list[-1]
+                gamma = jnp.dot(s_last, y_last) / jnp.maximum(
+                    jnp.dot(y_last, y_last), 1e-10)
+                q = q * gamma
+            for a, rho, s, y in reversed(alphas):
+                b = rho * jnp.dot(y, q)
+                q = q + (a - b) * s
+            d = -q
+            lr = self.get_lr()
+            upd = lr * d
+            self._apply_flat(upd)
+            for p in self._parameter_list:
+                p.clear_grad()
+            new_loss = closure()
+            new_g = self._flat_grad()
+            s = upd
+            y = new_g - g
+            if jnp.dot(s, y) > 1e-10:
+                self._s_list.append(s)
+                self._y_list.append(y)
+                if len(self._s_list) > self._history_size:
+                    self._s_list.pop(0)
+                    self._y_list.pop(0)
+            if jnp.abs(new_loss._data - loss._data) < self._tol_change:
+                loss = new_loss
+                break
+            loss = new_loss
+        return loss

@@ -1,0 +1,262 @@
+"""Vision transforms (reference: python/paddle/vision/transforms/).
+
+Numpy/host-side preprocessing; outputs feed the DataLoader collate."""
+from __future__ import annotations
+
+import numbers
+import random as pyrandom
+
+import numpy as np
+
+from ...core.tensor import Tensor
+
+__all__ = ["Compose", "ToTensor", "Normalize", "Resize", "RandomCrop",
+           "CenterCrop", "RandomHorizontalFlip", "RandomVerticalFlip",
+           "Transpose", "Pad", "RandomResizedCrop", "BrightnessTransform",
+           "to_tensor", "normalize", "resize", "hflip", "vflip", "crop",
+           "center_crop"]
+
+
+def _to_numpy(img):
+    if isinstance(img, Tensor):
+        return np.asarray(img._data)
+    return np.asarray(img)
+
+
+def to_tensor(pic, data_format="CHW"):
+    arr = _to_numpy(pic)
+    if arr.dtype == np.uint8:
+        arr = arr.astype(np.float32) / 255.0
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    if data_format == "CHW" and arr.ndim == 3:
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(arr.astype(np.float32))
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    arr = _to_numpy(img).astype(np.float32)
+    mean = np.asarray(mean, dtype=np.float32)
+    std = np.asarray(std, dtype=np.float32)
+    if data_format == "CHW":
+        shape = (-1, 1, 1)
+    else:
+        shape = (1, 1, -1)
+    out = (arr - mean.reshape(shape)) / std.reshape(shape)
+    return Tensor(out) if isinstance(img, Tensor) else out
+
+
+def resize(img, size, interpolation="bilinear"):
+    arr = _to_numpy(img)
+    if isinstance(size, int):
+        h, w = arr.shape[:2]
+        if h < w:
+            size = (size, int(size * w / h))
+        else:
+            size = (int(size * h / w), size)
+    oh, ow = size
+    h, w = arr.shape[:2]
+    # simple nearest/bilinear resize on host
+    yi = np.linspace(0, h - 1, oh)
+    xi = np.linspace(0, w - 1, ow)
+    if interpolation == "nearest":
+        out = arr[np.round(yi).astype(int)][:, np.round(xi).astype(int)]
+    else:
+        y0 = np.floor(yi).astype(int)
+        x0 = np.floor(xi).astype(int)
+        y1 = np.minimum(y0 + 1, h - 1)
+        x1 = np.minimum(x0 + 1, w - 1)
+        wy = (yi - y0)[:, None]
+        wx = (xi - x0)[None, :]
+        if arr.ndim == 3:
+            wy = wy[..., None]
+            wx = wx[..., None]
+        a = arr[y0][:, x0]
+        b = arr[y0][:, x1]
+        c = arr[y1][:, x0]
+        d = arr[y1][:, x1]
+        out = (a * (1 - wy) * (1 - wx) + b * (1 - wy) * wx
+               + c * wy * (1 - wx) + d * wy * wx)
+        if arr.dtype == np.uint8:
+            out = np.clip(out, 0, 255).astype(np.uint8)
+    return out
+
+
+def hflip(img):
+    return _to_numpy(img)[:, ::-1].copy()
+
+
+def vflip(img):
+    return _to_numpy(img)[::-1].copy()
+
+
+def crop(img, top, left, height, width):
+    return _to_numpy(img)[top:top + height, left:left + width].copy()
+
+
+def center_crop(img, output_size):
+    if isinstance(output_size, numbers.Number):
+        output_size = (int(output_size), int(output_size))
+    arr = _to_numpy(img)
+    h, w = arr.shape[:2]
+    th, tw = output_size
+    top = (h - th) // 2
+    left = (w - tw) // 2
+    return crop(arr, top, left, th, tw)
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = transforms
+
+    def __call__(self, data):
+        for t in self.transforms:
+            data = t(data)
+        return data
+
+
+class ToTensor:
+    def __init__(self, data_format="CHW", keys=None):
+        self.data_format = data_format
+
+    def __call__(self, img):
+        return to_tensor(img, self.data_format)
+
+
+class Normalize:
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False,
+                 keys=None):
+        if isinstance(mean, numbers.Number):
+            mean = [mean, mean, mean]
+        if isinstance(std, numbers.Number):
+            std = [std, std, std]
+        self.mean = mean
+        self.std = std
+        self.data_format = data_format
+
+    def __call__(self, img):
+        return normalize(img, self.mean, self.std, self.data_format)
+
+
+class Resize:
+    def __init__(self, size, interpolation="bilinear", keys=None):
+        self.size = size
+        self.interpolation = interpolation
+
+    def __call__(self, img):
+        return resize(img, self.size, self.interpolation)
+
+
+class RandomCrop:
+    def __init__(self, size, padding=None, pad_if_needed=False, fill=0,
+                 padding_mode="constant", keys=None):
+        if isinstance(size, numbers.Number):
+            size = (int(size), int(size))
+        self.size = size
+        self.padding = padding
+
+    def __call__(self, img):
+        arr = _to_numpy(img)
+        if self.padding:
+            p = self.padding
+            if isinstance(p, numbers.Number):
+                p = (p, p, p, p)
+            pads = [(p[1], p[3]), (p[0], p[2])] + [(0, 0)] * (arr.ndim - 2)
+            arr = np.pad(arr, pads)
+        h, w = arr.shape[:2]
+        th, tw = self.size
+        top = pyrandom.randint(0, max(h - th, 0))
+        left = pyrandom.randint(0, max(w - tw, 0))
+        return crop(arr, top, left, th, tw)
+
+
+class CenterCrop:
+    def __init__(self, size, keys=None):
+        self.size = size
+
+    def __call__(self, img):
+        return center_crop(img, self.size)
+
+
+class RandomHorizontalFlip:
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def __call__(self, img):
+        if pyrandom.random() < self.prob:
+            return hflip(img)
+        return _to_numpy(img)
+
+
+class RandomVerticalFlip:
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def __call__(self, img):
+        if pyrandom.random() < self.prob:
+            return vflip(img)
+        return _to_numpy(img)
+
+
+class Transpose:
+    def __init__(self, order=(2, 0, 1), keys=None):
+        self.order = order
+
+    def __call__(self, img):
+        arr = _to_numpy(img)
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        return arr.transpose(self.order)
+
+
+class Pad:
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        if isinstance(padding, numbers.Number):
+            padding = (padding, padding, padding, padding)
+        self.padding = padding
+        self.fill = fill
+
+    def __call__(self, img):
+        arr = _to_numpy(img)
+        p = self.padding
+        pads = [(p[1], p[3]), (p[0], p[2])] + [(0, 0)] * (arr.ndim - 2)
+        return np.pad(arr, pads, constant_values=self.fill)
+
+
+class RandomResizedCrop:
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear", keys=None):
+        if isinstance(size, numbers.Number):
+            size = (int(size), int(size))
+        self.size = size
+        self.scale = scale
+        self.ratio = ratio
+        self.interpolation = interpolation
+
+    def __call__(self, img):
+        arr = _to_numpy(img)
+        h, w = arr.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target_area = area * pyrandom.uniform(*self.scale)
+            aspect = pyrandom.uniform(*self.ratio)
+            tw = int(round(np.sqrt(target_area * aspect)))
+            th = int(round(np.sqrt(target_area / aspect)))
+            if 0 < tw <= w and 0 < th <= h:
+                top = pyrandom.randint(0, h - th)
+                left = pyrandom.randint(0, w - tw)
+                patch = crop(arr, top, left, th, tw)
+                return resize(patch, self.size, self.interpolation)
+        return resize(center_crop(arr, min(h, w)), self.size,
+                      self.interpolation)
+
+
+class BrightnessTransform:
+    def __init__(self, value, keys=None):
+        self.value = value
+
+    def __call__(self, img):
+        arr = _to_numpy(img).astype(np.float32)
+        factor = 1 + pyrandom.uniform(-self.value, self.value)
+        out = np.clip(arr * factor, 0, 255)
+        return out.astype(np.uint8) if _to_numpy(img).dtype == np.uint8 else out

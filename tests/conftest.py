@@ -1,0 +1,27 @@
+"""Test config: force CPU backend with 8 virtual devices so sharding /
+multi-chip tests run hermetically (SURVEY §4: the fake-device strategy —
+reference analog test/custom_runtime/test_custom_cpu_plugin.py:23)."""
+import os
+
+# the axon TPU plugin overrides JAX_PLATFORMS; jax_platforms config wins
+os.environ["JAX_PLATFORM_NAME"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    import paddle_tpu
+
+    paddle_tpu.seed(1234)
+    np.random.seed(1234)
+    yield

@@ -1,0 +1,114 @@
+"""OpTest harness (reference: test/legacy_test/op_test.py:418).
+
+check_output: run the op eagerly and under jit, compare both against a numpy
+reference. check_grad: compare analytic grads (tape) against numeric
+finite-difference grads (reference: get_numeric_gradient :148).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+
+
+def _to_np(x):
+    if isinstance(x, Tensor):
+        return np.asarray(x.numpy(), dtype=np.float64)
+    return np.asarray(x, dtype=np.float64)
+
+
+class OpTest:
+    """Subclass and set: self.op (callable over Tensors), self.inputs
+    (list of np arrays), self.ref (numpy fn over the same arrays)."""
+
+    atol = 1e-5
+    rtol = 1e-5
+
+    def run_op(self, *tensors):
+        raise NotImplementedError
+
+    def numpy_ref(self, *arrays):
+        raise NotImplementedError
+
+    def make_inputs(self):
+        raise NotImplementedError
+
+    def check_output(self):
+        arrays = self.make_inputs()
+        tensors = [paddle.to_tensor(a) for a in arrays]
+        out_eager = self.run_op(*tensors)
+        expected = self.numpy_ref(*arrays)
+        self._compare(out_eager, expected, "eager")
+
+        # jit path: same op traced/compiled
+        import jax
+
+        def jit_fn(*arrs):
+            ts = [Tensor(a) for a in arrs]
+            out = self.run_op(*ts)
+            if isinstance(out, (tuple, list)):
+                return tuple(o._data for o in out)
+            return out._data
+
+        with paddle.no_grad():
+            out_jit = jax.jit(jit_fn)(*[t._data for t in tensors])
+        self._compare(out_jit, expected, "jit")
+
+    def _compare(self, got, expected, tag):
+        if isinstance(expected, (tuple, list)):
+            for g, e in zip(got, expected):
+                np.testing.assert_allclose(
+                    _to_np(g), np.asarray(e, dtype=np.float64),
+                    atol=self.atol, rtol=self.rtol,
+                    err_msg=f"[{tag}] mismatch")
+        else:
+            g = got[0] if isinstance(got, (tuple, list)) and not isinstance(
+                expected, (tuple, list)) else got
+            np.testing.assert_allclose(
+                _to_np(g), np.asarray(expected, dtype=np.float64),
+                atol=self.atol, rtol=self.rtol, err_msg=f"[{tag}] mismatch")
+
+    def check_grad(self, input_index=0, eps=1e-3, atol=1e-2, rtol=1e-2):
+        arrays = [a.astype(np.float64) if np.issubdtype(
+            np.asarray(a).dtype, np.floating) else a
+            for a in self.make_inputs()]
+        # float32 for the framework side
+        tensors = [paddle.to_tensor(np.asarray(a, dtype=np.float32)
+                                    if np.issubdtype(np.asarray(a).dtype,
+                                                     np.floating) else a)
+                   for a in arrays]
+        for t in tensors:
+            if t.dtype.is_floating_point:
+                t.stop_gradient = False
+        out = self.run_op(*tensors)
+        if isinstance(out, (tuple, list)):
+            out = out[0]
+        loss = out.sum() if out.size > 1 else out
+        loss.backward()
+        analytic = tensors[input_index].grad.numpy().astype(np.float64)
+
+        # numeric gradient (reference: op_test.py get_numeric_gradient)
+        base = np.asarray(arrays[input_index], dtype=np.float64)
+        numeric = np.zeros_like(base).reshape(-1)
+        flat = base.reshape(-1)
+
+        def eval_sum(arr):
+            mod = [np.asarray(a, dtype=np.float32) if np.issubdtype(
+                np.asarray(a).dtype, np.floating) else a for a in arrays]
+            mod[input_index] = arr.reshape(base.shape).astype(np.float32)
+            with paddle.no_grad():
+                o = self.run_op(*[paddle.to_tensor(m) for m in mod])
+            if isinstance(o, (tuple, list)):
+                o = o[0]
+            return float(_to_np(o).sum())
+
+        for i in range(flat.size):
+            plus = flat.copy()
+            plus[i] += eps
+            minus = flat.copy()
+            minus[i] -= eps
+            numeric[i] = (eval_sum(plus) - eval_sum(minus)) / (2 * eps)
+        numeric = numeric.reshape(base.shape)
+        np.testing.assert_allclose(analytic, numeric, atol=atol, rtol=rtol,
+                                   err_msg="analytic vs numeric grad")

@@ -1,0 +1,120 @@
+"""Autograd engine tests (reference analog: test/legacy_test backward tests,
+test PyLayer suites)."""
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+def test_basic_backward():
+    x = paddle.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 4.0, 6.0])
+
+
+def test_grad_accumulation():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    (x * 2).sum().backward()
+    (x * 3).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0])
+
+
+def test_shared_input_fanout():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    a = x * 3
+    b = x * 4
+    (a + b).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [7.0])
+
+
+def test_deep_chain():
+    x = paddle.to_tensor([1.5], stop_gradient=False)
+    y = x
+    for _ in range(20):
+        y = y * 1.1
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [1.1 ** 20], rtol=1e-5)
+
+
+def test_no_grad():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 2
+    assert y.stop_gradient
+    assert y._grad_node is None
+
+
+def test_stop_gradient_barrier():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = x * 2
+    z = y.detach() * 3
+    z.sum().backward()
+    assert x.grad is None
+
+
+def test_grad_api():
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    y = x * x
+    (g,) = paddle.grad(y, x)
+    np.testing.assert_allclose(g.numpy(), [6.0])
+    assert x.grad is None  # .grad untouched
+
+
+def test_backward_with_grad_tensor():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * 2
+    y.backward(paddle.to_tensor([1.0, 0.5]))
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 1.0])
+
+
+def test_multi_output_op():
+    x = paddle.to_tensor(np.arange(6, dtype=np.float32), stop_gradient=False)
+    parts = paddle.split(x, 2)
+    loss = parts[0].sum() * 2 + parts[1].sum() * 3
+    loss.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2, 2, 2, 3, 3, 3])
+
+
+def test_pylayer():
+    class Double(paddle.autograd.PyLayer):
+        @staticmethod
+        def forward(ctx, a, b):
+            ctx.save_for_backward(a)
+            return a * b, a + b
+
+        @staticmethod
+        def backward(ctx, da, db):
+            (a,) = ctx.saved_tensor()
+            return da * 2 + db, da * 3 + db
+
+    a = paddle.to_tensor([1.0], stop_gradient=False)
+    b = paddle.to_tensor([2.0], stop_gradient=False)
+    o1, o2 = Double.apply(a, b)
+    (o1.sum() + o2.sum()).backward()
+    np.testing.assert_allclose(a.grad.numpy(), [2.0 * 1 + 1])
+    np.testing.assert_allclose(b.grad.numpy(), [3.0 * 1 + 1])
+
+
+def test_register_hook():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    seen = []
+    x.register_hook(lambda g: seen.append(g.numpy().copy()))
+    (x * 5).sum().backward()
+    assert len(seen) == 1
+    np.testing.assert_allclose(seen[0], [5.0])
+
+
+def test_jacobian_hessian():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    jac = paddle.autograd.jacobian(lambda t: (t * t).sum(), x)
+    np.testing.assert_allclose(jac.numpy(), [2.0, 4.0])
+    hess = paddle.autograd.hessian(lambda t: (t * t).sum(), x)
+    np.testing.assert_allclose(hess.numpy(), 2 * np.eye(2), atol=1e-6)
+
+
+def test_retain_graph():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x * x
+    y.backward(retain_graph=True)
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [8.0])

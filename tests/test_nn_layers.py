@@ -1,0 +1,173 @@
+"""nn.Layer machinery + layer forward shapes/values vs torch-free refs."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+def test_layer_registration():
+    class M(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(3, 4)
+            self.w = self.create_parameter([2, 2])
+            self.register_buffer("buf", paddle.zeros([3]))
+
+        def forward(self, x):
+            return self.fc(x)
+
+    m = M()
+    names = [n for n, _ in m.named_parameters()]
+    assert set(names) == {"w", "fc.weight", "fc.bias"}
+    assert len(m.buffers()) == 1
+    sd = m.state_dict()
+    assert "buf" in sd and "fc.weight" in sd
+
+
+def test_state_dict_roundtrip():
+    m1 = nn.Linear(4, 4)
+    m2 = nn.Linear(4, 4)
+    m2.set_state_dict(m1.state_dict())
+    x = paddle.randn([2, 4])
+    np.testing.assert_allclose(m1(x).numpy(), m2(x).numpy(), rtol=1e-6)
+
+
+def test_train_eval_mode():
+    d = nn.Dropout(0.5)
+    x = paddle.ones([100])
+    d.eval()
+    np.testing.assert_array_equal(d(x).numpy(), x.numpy())
+    d.train()
+    out = d(x).numpy()
+    assert (out == 0).any()
+    assert np.isclose(out[out != 0][0], 2.0)
+
+
+def test_hooks():
+    m = nn.Linear(2, 2)
+    calls = []
+    h1 = m.register_forward_pre_hook(lambda l, inp: calls.append("pre"))
+    h2 = m.register_forward_post_hook(lambda l, inp, out: calls.append("post"))
+    m(paddle.randn([1, 2]))
+    assert calls == ["pre", "post"]
+    h1.remove()
+    h2.remove()
+    calls.clear()
+    m(paddle.randn([1, 2]))
+    assert calls == []
+
+
+def test_conv2d_vs_naive():
+    rng = np.random.RandomState(0)
+    x = rng.randn(1, 2, 5, 5).astype(np.float32)
+    w = rng.randn(3, 2, 3, 3).astype(np.float32)
+    out = nn.functional.conv2d(paddle.to_tensor(x), paddle.to_tensor(w),
+                               stride=1, padding=1)
+    # naive conv
+    xp = np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)))
+    expect = np.zeros((1, 3, 5, 5), np.float32)
+    for oc in range(3):
+        for i in range(5):
+            for j in range(5):
+                expect[0, oc, i, j] = (
+                    xp[0, :, i:i + 3, j:j + 3] * w[oc]).sum()
+    np.testing.assert_allclose(out.numpy(), expect, atol=1e-4)
+
+
+def test_pool():
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    mp = nn.functional.max_pool2d(paddle.to_tensor(x), 2, 2)
+    np.testing.assert_array_equal(mp.numpy().reshape(2, 2),
+                                  [[5, 7], [13, 15]])
+    ap = nn.functional.avg_pool2d(paddle.to_tensor(x), 2, 2)
+    np.testing.assert_allclose(ap.numpy().reshape(2, 2),
+                               [[2.5, 4.5], [10.5, 12.5]])
+    aap = nn.functional.adaptive_avg_pool2d(paddle.to_tensor(x), 1)
+    np.testing.assert_allclose(float(aap.sum()), x.mean())
+
+
+def test_batchnorm_running_stats():
+    bn = nn.BatchNorm2D(3, momentum=0.9)
+    x = paddle.randn([4, 3, 8, 8]) * 2 + 1
+    bn.train()
+    out = bn(x)
+    # output normalized per channel
+    o = out.numpy()
+    assert abs(o.mean()) < 1e-4
+    assert abs(o.std() - 1) < 1e-2
+    # running stats moved toward batch stats
+    assert not np.allclose(bn._mean.numpy(), 0)
+    bn.eval()
+    out2 = bn(x)
+    assert out2.shape == [4, 3, 8, 8]
+
+
+def test_embedding_padding_idx():
+    emb = nn.Embedding(10, 4, padding_idx=0)
+    out = emb(paddle.to_tensor([[0, 1], [2, 0]]))
+    o = out.numpy()
+    assert np.allclose(o[0, 0], 0)
+    assert np.allclose(o[1, 1], 0)
+    assert not np.allclose(o[0, 1], 0)
+
+
+def test_multihead_attention():
+    mha = nn.MultiHeadAttention(16, 4)
+    x = paddle.randn([2, 6, 16])
+    out = mha(x)
+    assert out.shape == [2, 6, 16]
+    out.sum().backward()
+    assert mha.q_proj.weight.grad is not None
+
+
+def test_transformer_encoder():
+    layer = nn.TransformerEncoderLayer(16, 4, 32, dropout=0.0)
+    enc = nn.TransformerEncoder(layer, 2)
+    x = paddle.randn([2, 5, 16])
+    out = enc(x)
+    assert out.shape == [2, 5, 16]
+
+
+def test_lstm():
+    lstm = nn.LSTM(8, 16, num_layers=2)
+    x = paddle.randn([4, 10, 8])
+    out, (h, c) = lstm(x)
+    assert out.shape == [4, 10, 16]
+    assert h.shape == [2, 4, 16]
+    out.sum().backward()
+
+
+def test_gru_bidirect():
+    gru = nn.GRU(8, 16, direction="bidirect")
+    x = paddle.randn([2, 5, 8])
+    out, h = gru(x)
+    assert out.shape == [2, 5, 32]
+
+
+def test_sequential_containers():
+    seq = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    assert len(seq) == 3
+    out = seq(paddle.randn([3, 4]))
+    assert out.shape == [3, 2]
+    ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+    assert len(list(ll.parameters())) == 6
+
+
+def test_clip_grad_by_global_norm():
+    lin = nn.Linear(4, 4)
+    x = paddle.randn([8, 4]) * 100
+    loss = lin(x).sum()
+    loss.backward()
+    clip = nn.ClipGradByGlobalNorm(1.0)
+    pg = clip([(p, p.grad) for p in lin.parameters()])
+    total = np.sqrt(sum(float((g.numpy() ** 2).sum()) for _, g in pg))
+    assert total <= 1.0 + 1e-4
+
+
+def test_rms_norm():
+    x = np.random.RandomState(1).randn(2, 6).astype(np.float32)
+    w = np.ones(6, np.float32) * 2
+    out = nn.functional.rms_norm(paddle.to_tensor(x), paddle.to_tensor(w))
+    expect = x / np.sqrt((x ** 2).mean(-1, keepdims=True) + 1e-6) * 2
+    np.testing.assert_allclose(out.numpy(), expect, rtol=1e-5)

@@ -1,0 +1,209 @@
+"""Op unit tests vs numpy (reference pattern: test/legacy_test/ per-op
+OpTest subclasses)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+from op_test import OpTest
+
+
+class TestMatmul(OpTest):
+    def make_inputs(self):
+        rng = np.random.RandomState(0)
+        return [rng.randn(4, 5).astype(np.float32),
+                rng.randn(5, 3).astype(np.float32)]
+
+    def run_op(self, x, y):
+        return paddle.matmul(x, y)
+
+    def numpy_ref(self, x, y):
+        return x @ y
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(0)
+        self.check_grad(1)
+
+
+class TestSoftmax(OpTest):
+    def make_inputs(self):
+        return [np.random.RandomState(1).randn(3, 7).astype(np.float32)]
+
+    def run_op(self, x):
+        return paddle.nn.functional.softmax(x, axis=-1)
+
+    def numpy_ref(self, x):
+        e = np.exp(x - x.max(-1, keepdims=True))
+        return e / e.sum(-1, keepdims=True)
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(0)
+
+
+class TestLayerNorm(OpTest):
+    atol = 1e-4
+
+    def make_inputs(self):
+        rng = np.random.RandomState(2)
+        return [rng.randn(4, 8).astype(np.float32),
+                rng.randn(8).astype(np.float32),
+                rng.randn(8).astype(np.float32)]
+
+    def run_op(self, x, w, b):
+        return paddle.nn.functional.layer_norm(x, 8, w, b)
+
+    def numpy_ref(self, x, w, b):
+        mu = x.mean(-1, keepdims=True)
+        var = x.var(-1, keepdims=True)
+        return (x - mu) / np.sqrt(var + 1e-5) * w + b
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(0)
+
+
+class TestReductions:
+    def test_sum_mean_max(self):
+        x = np.random.RandomState(3).randn(3, 4, 5).astype(np.float32)
+        t = paddle.to_tensor(x)
+        np.testing.assert_allclose(paddle.sum(t, axis=1).numpy(),
+                                   x.sum(1), rtol=1e-5)
+        np.testing.assert_allclose(paddle.mean(t, axis=[0, 2]).numpy(),
+                                   x.mean((0, 2)), rtol=1e-5)
+        np.testing.assert_allclose(paddle.max(t, axis=-1).numpy(),
+                                   x.max(-1), rtol=1e-5)
+        np.testing.assert_allclose(paddle.logsumexp(t, axis=1).numpy(),
+                                   np.log(np.exp(x).sum(1)), rtol=1e-4)
+
+    def test_cumsum_cumprod(self):
+        x = np.random.RandomState(4).rand(3, 4).astype(np.float32) + 0.5
+        t = paddle.to_tensor(x)
+        np.testing.assert_allclose(paddle.cumsum(t, axis=1).numpy(),
+                                   x.cumsum(1), rtol=1e-5)
+        np.testing.assert_allclose(paddle.cumprod(t, dim=0).numpy(),
+                                   x.cumprod(0), rtol=1e-5)
+
+    def test_cummax(self):
+        x = np.random.RandomState(5).randn(10).astype(np.float32)
+        vals, idx = paddle.cummax(paddle.to_tensor(x), axis=0)
+        np.testing.assert_allclose(vals.numpy(), np.maximum.accumulate(x))
+        expect_idx = [int(np.argmax(x[:i + 1])) for i in range(10)]
+        np.testing.assert_array_equal(idx.numpy(), expect_idx)
+
+
+class TestManipulation:
+    def test_reshape_transpose_concat(self):
+        x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+        t = paddle.to_tensor(x)
+        assert paddle.reshape(t, [4, 6]).shape == [4, 6]
+        np.testing.assert_array_equal(
+            paddle.transpose(t, [2, 0, 1]).numpy(), x.transpose(2, 0, 1))
+        c = paddle.concat([t, t], axis=1)
+        assert c.shape == [2, 6, 4]
+        s = paddle.split(c, 2, axis=1)
+        np.testing.assert_array_equal(s[0].numpy(), x)
+
+    def test_gather_scatter(self):
+        x = np.arange(20, dtype=np.float32).reshape(4, 5)
+        t = paddle.to_tensor(x)
+        g = paddle.gather(t, paddle.to_tensor([0, 2]), axis=0)
+        np.testing.assert_array_equal(g.numpy(), x[[0, 2]])
+        idx = paddle.to_tensor([1, 3])
+        upd = paddle.ones([2, 5])
+        out = paddle.scatter(t, idx, upd)
+        expect = x.copy()
+        expect[[1, 3]] = 1.0
+        np.testing.assert_array_equal(out.numpy(), expect)
+
+    def test_topk_sort(self):
+        x = np.random.RandomState(6).randn(5, 8).astype(np.float32)
+        vals, idx = paddle.topk(paddle.to_tensor(x), k=3, axis=-1)
+        expect = np.sort(x, axis=-1)[:, ::-1][:, :3]
+        np.testing.assert_allclose(vals.numpy(), expect, rtol=1e-6)
+        s = paddle.sort(paddle.to_tensor(x), axis=-1, descending=True)
+        np.testing.assert_allclose(s.numpy(), np.sort(x, -1)[:, ::-1])
+
+    def test_where_masked(self):
+        x = np.random.RandomState(7).randn(4, 4).astype(np.float32)
+        t = paddle.to_tensor(x)
+        out = paddle.where(t > 0, t, paddle.zeros_like(t))
+        np.testing.assert_array_equal(out.numpy(), np.where(x > 0, x, 0))
+        mf = paddle.masked_fill(t, t < 0, -1.0)
+        np.testing.assert_array_equal(mf.numpy(), np.where(x < 0, -1.0, x))
+
+    def test_pad_tile(self):
+        x = np.ones((2, 3), np.float32)
+        # len(pad) == 2*ndim: padded first-dim-to-last (paddle semantics)
+        p = paddle.nn.functional.pad(paddle.to_tensor(x), [1, 1, 2, 2],
+                                     value=5.0)
+        assert p.shape == [4, 7]
+        assert p.numpy()[0, 0] == 5.0
+        tl = paddle.tile(paddle.to_tensor(x), [2, 2])
+        assert tl.shape == [4, 6]
+
+
+class TestLinalg:
+    def test_einsum_norm_inv(self):
+        rng = np.random.RandomState(8)
+        a = rng.randn(3, 4).astype(np.float32)
+        b = rng.randn(4, 5).astype(np.float32)
+        out = paddle.einsum("ij,jk->ik", paddle.to_tensor(a),
+                            paddle.to_tensor(b))
+        np.testing.assert_allclose(out.numpy(), a @ b, rtol=1e-5)
+        n = paddle.norm(paddle.to_tensor(a))
+        np.testing.assert_allclose(float(n), np.linalg.norm(a), rtol=1e-5)
+        m = rng.randn(4, 4).astype(np.float32) + 4 * np.eye(4, dtype=np.float32)
+        inv = paddle.inv(paddle.to_tensor(m))
+        np.testing.assert_allclose(inv.numpy(), np.linalg.inv(m),
+                                   rtol=1e-3, atol=1e-4)
+
+    def test_svd_qr(self):
+        rng = np.random.RandomState(9)
+        a = rng.randn(5, 3).astype(np.float32)
+        u, s, vh = paddle.svd(paddle.to_tensor(a))
+        recon = u.numpy() @ np.diag(s.numpy()) @ vh.numpy()
+        np.testing.assert_allclose(recon, a, atol=1e-4)
+        q, r = paddle.qr(paddle.to_tensor(a))
+        np.testing.assert_allclose(q.numpy() @ r.numpy(), a, atol=1e-4)
+
+
+class TestLoss:
+    def test_cross_entropy(self):
+        rng = np.random.RandomState(10)
+        logits = rng.randn(6, 5).astype(np.float32)
+        labels = rng.randint(0, 5, (6,))
+        loss = paddle.nn.functional.cross_entropy(
+            paddle.to_tensor(logits), paddle.to_tensor(labels))
+        # numpy ref
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        expect = -np.log(p[np.arange(6), labels]).mean()
+        np.testing.assert_allclose(float(loss), expect, rtol=1e-5)
+
+    def test_cross_entropy_ignore_index(self):
+        logits = np.random.RandomState(11).randn(4, 3).astype(np.float32)
+        labels = np.array([0, -100, 2, -100])
+        loss = paddle.nn.functional.cross_entropy(
+            paddle.to_tensor(logits), paddle.to_tensor(labels),
+            ignore_index=-100)
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        expect = -np.log(p[[0, 2], [0, 2]]).mean()
+        np.testing.assert_allclose(float(loss), expect, rtol=1e-5)
+
+    def test_bce_kl(self):
+        rng = np.random.RandomState(12)
+        p = rng.rand(8).astype(np.float32) * 0.9 + 0.05
+        y = (rng.rand(8) > 0.5).astype(np.float32)
+        loss = paddle.nn.functional.binary_cross_entropy(
+            paddle.to_tensor(p), paddle.to_tensor(y))
+        expect = -(y * np.log(p) + (1 - y) * np.log(1 - p)).mean()
+        np.testing.assert_allclose(float(loss), expect, rtol=1e-5)
