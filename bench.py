@@ -1,0 +1,102 @@
+#!/usr/bin/env python
+"""Flagship benchmark: GPT-3-125M full training step throughput on one chip.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": tokens/sec/chip, "unit": "tokens/s",
+   "vs_baseline": MFU / 0.45}
+
+vs_baseline is measured MFU over the north-star target (BASELINE.json:
+>=45% MFU); >1.0 beats the target. The reference publishes no in-tree
+numbers (BASELINE.md), so MFU-vs-north-star is the comparable scalar.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def _peak_flops(device) -> float:
+    """Per-chip peak bf16 FLOP/s by TPU generation (public specs)."""
+    kind = getattr(device, "device_kind", "").lower()
+    table = {
+        "v5 lite": 197e12,   # v5e
+        "v5litepod": 197e12,
+        "v5e": 197e12,
+        "v5p": 459e12,
+        "v4": 275e12,
+        "v6e": 918e12,
+        "v6 lite": 918e12,
+    }
+    for k, v in table.items():
+        if k in kind:
+            return v
+    if device.platform == "tpu":
+        return 197e12
+    return 0.0  # CPU: MFU not meaningful
+
+
+def main():
+    import jax
+
+    import paddle_tpu as pt
+    from paddle_tpu.jit import TrainStep
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+
+    cfg = pt.models.gpt3_125M(dropout=0.0, attention_dropout=0.0)
+    batch, seq = (8, 1024) if on_tpu else (2, 128)
+
+    pt.set_default_dtype("bfloat16" if on_tpu else "float32")
+    try:
+        model = pt.models.GPTForCausalLM(cfg)
+    finally:
+        pt.set_default_dtype("float32")
+    opt = pt.optimizer.AdamW(learning_rate=1e-4, weight_decay=0.01,
+                             parameters=model.parameters())
+    step = TrainStep(model, opt, grad_clip_norm=1.0)
+
+    rng = np.random.default_rng(0)
+    ids = pt.to_tensor(rng.integers(0, cfg.vocab_size, (batch, seq)),
+                       dtype="int64")
+    labels = pt.to_tensor(rng.integers(0, cfg.vocab_size, (batch, seq)),
+                          dtype="int64")
+
+    # warmup / compile
+    for _ in range(3):
+        loss = step(ids, labels)
+    jax.block_until_ready(loss._data)
+
+    iters = 20 if on_tpu else 5
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = step(ids, labels)
+    jax.block_until_ready(loss._data)
+    el = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seq * iters / el
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    # training FLOPs/token: 6N for the matmuls + causal attention term
+    attn_flops = 6 * cfg.num_layers * cfg.hidden_size * seq  # fwd+bwd, causal
+    flops_per_token = 6 * n_params + attn_flops
+    peak = _peak_flops(dev)
+    mfu = tokens_per_sec * flops_per_token / peak if peak else 0.0
+
+    print(json.dumps({
+        "metric": "gpt3_125m_train_tokens_per_sec_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu / 0.45, 4) if peak else 0.0,
+        "extra": {
+            "device": getattr(dev, "device_kind", str(dev)),
+            "batch": batch, "seq": seq, "params": n_params,
+            "mfu": round(mfu, 4), "loss": round(float(loss), 4),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -58,6 +58,7 @@ from . import distributed  # noqa: F401,E402
 from . import incubate  # noqa: F401,E402
 from . import metric  # noqa: F401,E402
 from . import device  # noqa: F401,E402
+from . import models  # noqa: F401,E402
 from .framework.io_utils import load, save  # noqa: F401,E402
 from .framework import (  # noqa: F401,E402
     get_default_dtype,
@@ -66,6 +67,7 @@ from .framework import (  # noqa: F401,E402
     set_flags,
 )
 from .device import get_device, set_device  # noqa: F401,E402
+from .distributed.parallel import DataParallel  # noqa: F401,E402  (paddle.DataParallel)
 
 # functional conveniences at top level, paddle-style
 from .nn.functional import one_hot  # noqa: F401,E402  (paddle.nn.functional too)

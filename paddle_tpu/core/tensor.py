@@ -40,6 +40,7 @@ class Tensor:
         "name",
         "persistable",
         "_dist_attr",
+        "dist_spec",
         "__weakref__",
     )
 
@@ -76,6 +77,7 @@ class Tensor:
         self.name = name
         self.persistable = False
         self._dist_attr = None  # set by distributed.shard_tensor (DistTensor)
+        self.dist_spec = None  # mesh-axis annotation (auto_parallel.constraint)
 
     # ------------------------------------------------------------- metadata
     @property

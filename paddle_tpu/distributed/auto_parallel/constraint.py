@@ -1,0 +1,98 @@
+"""Activation/parameter sharding annotations for the jit (GSPMD) path.
+
+TPU-native realization of the reference's SPMD-rule propagation
+(reference: paddle/phi/infermeta/spmd_rules/ — 57 per-op rule files,
+registered via the ``spmd_rule:`` key in phi/ops/yaml/ops.yaml): instead of
+running C++ rules per op, models annotate parameters and a few activation
+cut-points with mesh-axis names, and XLA's GSPMD propagates shardings
+through every op and inserts the collectives (all-reduce/all-gather/
+reduce-scatter over ICI) — the same job the reference's reshard engine
+(phi/core/distributed/auto_parallel/reshard/) does explicitly.
+
+Conventions used by ``paddle_tpu.models``:
+  - mesh axes: "dp" (data), "mp" (tensor/model), "sp" (sequence),
+    "pp" (pipeline stages), "ep" (experts). Any subset may be present.
+  - ``annotate_param(p, axes)``: tuple of mesh-axis-name-or-None per dim.
+  - ``shard_activation(x, axes)``: with_sharding_constraint when a global
+    mesh (distributed.auto_parallel.set_mesh) is active; no-op otherwise.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ...core.tensor import Tensor
+from .process_mesh import get_mesh
+
+__all__ = ["annotate_param", "param_spec", "shard_activation",
+           "filtered_spec", "mesh_axis_size"]
+
+
+def _active_jax_mesh():
+    pm = get_mesh()
+    if pm is None:
+        return None
+    try:
+        return pm.get_jax_mesh()
+    except Exception:
+        return None
+
+
+def mesh_axis_size(name: str) -> int:
+    """Size of a mesh axis in the active global mesh (1 if absent)."""
+    pm = get_mesh()
+    if pm is None or name not in pm.dim_names:
+        return 1
+    return pm.get_dim_size(name)
+
+
+def filtered_spec(axes: Sequence, mesh) -> PartitionSpec:
+    """Drop axis names not present in ``mesh`` (so the same model code runs
+    on a pure-dp mesh, a dp×mp mesh, etc.)."""
+    names = set(mesh.axis_names)
+
+    def keep(a):
+        if a is None:
+            return None
+        if isinstance(a, (tuple, list)):
+            kept = tuple(x for x in a if x in names)
+            return kept if kept else None
+        return a if a in names else None
+
+    return PartitionSpec(*[keep(a) for a in axes])
+
+
+def annotate_param(p: Tensor, axes: Sequence) -> Tensor:
+    """Attach a sharding annotation (mesh-axis name per tensor dim) to a
+    parameter; consumed by the jit train-step builder and dryrun paths."""
+    p.dist_spec = tuple(axes)
+    return p
+
+
+def param_spec(p: Tensor, mesh) -> PartitionSpec:
+    axes = getattr(p, "dist_spec", None)
+    if axes is None:
+        return PartitionSpec()
+    return filtered_spec(axes, mesh)
+
+
+def shard_activation(x, axes: Sequence):
+    """Constrain an activation's sharding under the active global mesh.
+
+    Differentiable (with_sharding_constraint has a trivial vjp); outside a
+    mesh or outside tracing this is the identity.
+    """
+    mesh = _active_jax_mesh()
+    if mesh is None:
+        return x
+    spec = filtered_spec(axes, mesh)
+    from ...core.autograd import run_op
+
+    def fn(a):
+        return jax.lax.with_sharding_constraint(a, NamedSharding(mesh, spec))
+
+    if isinstance(x, Tensor):
+        return run_op(fn, [x], name="shard_constraint")
+    return fn(x)

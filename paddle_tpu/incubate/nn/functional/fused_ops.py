@@ -50,14 +50,28 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
     if sin is None or cos is None:
         inv = 1.0 / (rotary_emb_base ** (
             jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
-        t = jnp.arange(seq_len, dtype=jnp.float32)
-        freqs = jnp.outer(t, inv)  # [seq, head_dim/2]
-        if use_neox_rotary_style:
-            emb = jnp.concatenate([freqs, freqs], axis=-1)
+        if position_ids is not None:
+            from ....ops._helpers import unwrap
+
+            # frequencies straight from the (possibly offset) positions —
+            # no table, so decode positions beyond seq_len stay exact
+            pid = unwrap(as_tensor(position_ids)).astype(jnp.float32)
+            freqs = pid[..., None] * inv  # [batch, seq, head_dim/2]
+            if use_neox_rotary_style:
+                emb = jnp.concatenate([freqs, freqs], axis=-1)
+            else:
+                emb = jnp.repeat(freqs, 2, axis=-1)
+            cos_arr = jnp.cos(emb)[:, :, None, :]
+            sin_arr = jnp.sin(emb)[:, :, None, :]
         else:
-            emb = jnp.repeat(freqs, 2, axis=-1)
-        cos_arr = jnp.cos(emb)[None, :, None, :]
-        sin_arr = jnp.sin(emb)[None, :, None, :]
+            t = jnp.arange(seq_len, dtype=jnp.float32)
+            freqs = jnp.outer(t, inv)  # [seq, head_dim/2]
+            if use_neox_rotary_style:
+                emb = jnp.concatenate([freqs, freqs], axis=-1)
+            else:
+                emb = jnp.repeat(freqs, 2, axis=-1)
+            cos_arr = jnp.cos(emb)[None, :, None, :]
+            sin_arr = jnp.sin(emb)[None, :, None, :]
     else:
         from ....ops._helpers import unwrap
 
@@ -66,13 +80,10 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
         if cos_arr.ndim == 2:
             cos_arr = cos_arr[None, :, None, :]
             sin_arr = sin_arr[None, :, None, :]
-
-    if position_ids is not None:
-        from ....ops._helpers import unwrap
-
-        pid = unwrap(as_tensor(position_ids))  # [batch, seq]
-        cos_arr = jnp.squeeze(cos_arr, (0, 2))[pid][:, :, None, :]
-        sin_arr = jnp.squeeze(sin_arr, (0, 2))[pid][:, :, None, :]
+        if position_ids is not None:
+            pid = unwrap(as_tensor(position_ids))  # [batch, seq]
+            cos_arr = jnp.squeeze(cos_arr, (0, 2))[pid][:, :, None, :]
+            sin_arr = jnp.squeeze(sin_arr, (0, 2))[pid][:, :, None, :]
 
     rotate = _rope_rotate_half if use_neox_rotary_style \
         else _rope_rotate_pairwise

@@ -31,7 +31,7 @@ from ..core.tensor import Tensor
 from ..nn.layer.layers import Layer
 
 __all__ = ["to_static", "not_to_static", "save", "load", "ignore_module",
-           "enable_to_static", "TranslatedLayer", "InputSpec"]
+           "enable_to_static", "TranslatedLayer", "InputSpec", "TrainStep"]
 
 _to_static_enabled = True
 
@@ -270,3 +270,4 @@ def load(path, **configs):
     payload = _load(p)
     return TranslatedLayer(payload.get("state_dict", {}),
                            payload.get("config", {}))
+from .train_step import TrainStep  # noqa: F401,E402

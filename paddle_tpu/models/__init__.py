@@ -1,0 +1,28 @@
+"""Flagship model families (reference analogs: GPT-3/Llama configs used by
+the reference's hybrid-parallel and semi-auto tests —
+test/auto_parallel/hybrid_strategy/semi_auto_parallel_llama_model.py and the
+PaddleNLP GPT models the Fleet pipeline tests exercise).
+
+All models are built from ``paddle_tpu.nn`` layers and carry mesh-axis
+sharding annotations (dp/mp/sp) consumed by the jit train-step builder, so
+the same model runs single-chip eager, jit single-chip, and jit SPMD over a
+multi-chip mesh.
+"""
+from .gpt import (  # noqa: F401
+    GPTConfig,
+    GPTForCausalLM,
+    GPTModel,
+    GPTPretrainingCriterion,
+    gpt3_13B,
+    gpt3_125M,
+    gpt3_1p3B,
+    gpt3_6p7B,
+    gpt_tiny,
+)
+from .llama import (  # noqa: F401
+    LlamaConfig,
+    LlamaForCausalLM,
+    LlamaModel,
+    llama2_7B,
+    llama_tiny,
+)

@@ -1,0 +1,262 @@
+"""Llama-2 family decoder-only LM (GQA + RoPE + SwiGLU + RMSNorm), TPU-first.
+
+Reference analog: the semi-auto Llama model the reference tests end-to-end
+(test/auto_parallel/hybrid_strategy/semi_auto_parallel_llama_model.py) and
+BASELINE config #5 (Llama-2 7B, semi-auto parallel + recompute).
+
+Same sharding-annotation scheme as models/gpt.py: Megatron column/row splits
+on "mp", data on "dp", sequence on "sp"; GSPMD places the collectives.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Optional
+
+import jax.numpy as jnp
+
+from .. import nn
+from ..core.tensor import Tensor
+from ..distributed.auto_parallel.constraint import annotate_param, shard_activation
+from ..incubate.nn.functional import fused_rotary_position_embedding
+from ..nn import functional as F
+from ..ops._helpers import run_op
+
+__all__ = ["LlamaConfig", "LlamaModel", "LlamaForCausalLM", "llama_tiny",
+           "llama2_7B"]
+
+
+@dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: Optional[int] = None  # None -> MHA
+    intermediate_size: Optional[int] = None  # None -> llama 8/3 rule
+    max_position_embeddings: int = 4096
+    rope_base: float = 10000.0
+    rms_norm_eps: float = 1e-5
+    initializer_range: float = 0.02
+    tie_word_embeddings: bool = False
+    recompute: bool = False
+
+    def __post_init__(self):
+        if self.num_kv_heads is None:
+            self.num_kv_heads = self.num_heads
+        if self.intermediate_size is None:
+            m = int(8 * self.hidden_size / 3)
+            self.intermediate_size = 256 * ((m + 255) // 256)
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+
+def llama_tiny(**kw) -> LlamaConfig:
+    return LlamaConfig(vocab_size=1024, hidden_size=128, num_layers=2,
+                       num_heads=4, num_kv_heads=2,
+                       max_position_embeddings=256, **kw)
+
+
+def llama2_7B(**kw) -> LlamaConfig:
+    return LlamaConfig(hidden_size=4096, num_layers=32, num_heads=32,
+                       intermediate_size=11008, **kw)
+
+
+class LlamaAttention(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        h, hd = config.hidden_size, config.head_dim
+        init = nn.initializer.Normal(0.0, config.initializer_range)
+        self.q_proj = nn.Linear(h, config.num_heads * hd, weight_attr=init,
+                                bias_attr=False)
+        self.k_proj = nn.Linear(h, config.num_kv_heads * hd, weight_attr=init,
+                                bias_attr=False)
+        self.v_proj = nn.Linear(h, config.num_kv_heads * hd, weight_attr=init,
+                                bias_attr=False)
+        self.o_proj = nn.Linear(
+            config.num_heads * hd, h, weight_attr=nn.initializer.Normal(
+                0.0, config.initializer_range / math.sqrt(2 * config.num_layers)),
+            bias_attr=False)
+        for p in (self.q_proj.weight, self.k_proj.weight, self.v_proj.weight):
+            annotate_param(p, (None, "mp"))
+        annotate_param(self.o_proj.weight, ("mp", None))
+
+    def forward(self, x, position_ids=None, cache=None):
+        cfg = self.config
+        b, s = x.shape[0], x.shape[1]
+        q = self.q_proj(x).reshape([b, s, cfg.num_heads, cfg.head_dim])
+        k = self.k_proj(x).reshape([b, s, cfg.num_kv_heads, cfg.head_dim])
+        v = self.v_proj(x).reshape([b, s, cfg.num_kv_heads, cfg.head_dim])
+        past = cache[0].shape[1] if cache is not None else 0
+        if position_ids is None and past:
+            # incremental decode: rotate by absolute position, not 0
+            position_ids = Tensor(jnp.arange(past, past + s,
+                                             dtype=jnp.int32)[None, :]
+                                  + jnp.zeros((b, 1), dtype=jnp.int32))
+        q, k, _ = fused_rotary_position_embedding(
+            q, k, None, position_ids=position_ids,
+            rotary_emb_base=cfg.rope_base)
+        if cache is not None:
+            from ..ops.manipulation import concat
+
+            k = concat([cache[0], k], axis=1)
+            v = concat([cache[1], v], axis=1)
+            cache = (k, v)
+        if cfg.num_kv_heads != cfg.num_heads:
+            rep = cfg.num_heads // cfg.num_kv_heads
+            k = run_op(lambda a: jnp.repeat(a, rep, axis=2), [k], name="gqa_rep")
+            v = run_op(lambda a: jnp.repeat(a, rep, axis=2), [v], name="gqa_rep")
+        q = shard_activation(q, ("dp", "sp", "mp", None))
+        from .gpt import _offset_causal_mask
+
+        out = F.scaled_dot_product_attention(
+            q, k, v, is_causal=s > 1 and past == 0,
+            attn_mask=_offset_causal_mask(s, past), training=self.training)
+        out = out.reshape([b, s, cfg.num_heads * cfg.head_dim])
+        out = self.o_proj(out)
+        if cache is not None:
+            return out, cache
+        return out
+
+
+class LlamaMLP(nn.Layer):
+    """SwiGLU (reference analog: incubate/nn/functional/swiglu.py)."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        h, ffn = config.hidden_size, config.intermediate_size
+        init = nn.initializer.Normal(0.0, config.initializer_range)
+        self.gate_proj = nn.Linear(h, ffn, weight_attr=init, bias_attr=False)
+        self.up_proj = nn.Linear(h, ffn, weight_attr=init, bias_attr=False)
+        self.down_proj = nn.Linear(
+            ffn, h, weight_attr=nn.initializer.Normal(
+                0.0, config.initializer_range / math.sqrt(2 * config.num_layers)),
+            bias_attr=False)
+        annotate_param(self.gate_proj.weight, (None, "mp"))
+        annotate_param(self.up_proj.weight, (None, "mp"))
+        annotate_param(self.down_proj.weight, ("mp", None))
+
+    def forward(self, x):
+        g = self.gate_proj(x)
+        u = self.up_proj(x)
+        g = shard_activation(g, ("dp", "sp", "mp"))
+        return self.down_proj(F.silu(g) * u)
+
+
+class LlamaBlock(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.input_layernorm = nn.RMSNorm(config.hidden_size,
+                                          config.rms_norm_eps)
+        self.self_attn = LlamaAttention(config)
+        self.post_attention_layernorm = nn.RMSNorm(config.hidden_size,
+                                                   config.rms_norm_eps)
+        self.mlp = LlamaMLP(config)
+        self._recompute = config.recompute
+
+    def _body(self, x, position_ids=None, cache=None):
+        if cache is None:
+            x = x + self.self_attn(self.input_layernorm(x),
+                                   position_ids=position_ids)
+        else:
+            a, cache = self.self_attn(self.input_layernorm(x),
+                                      position_ids=position_ids, cache=cache)
+            x = x + a
+        x = x + self.mlp(self.post_attention_layernorm(x))
+        x = shard_activation(x, ("dp", "sp", None))
+        return x if cache is None else (x, cache)
+
+    def forward(self, x, position_ids=None, cache=None):
+        if self._recompute and self.training and cache is None:
+            import jax
+
+            params = [p for _, p in self.named_parameters()]
+
+            def fn(xa, *pa):
+                saved = [p._data for p in params]
+                for p, a in zip(params, pa):
+                    p._data = a
+                try:
+                    out = self._body(Tensor(xa, stop_gradient=False),
+                                     position_ids=position_ids)
+                finally:
+                    for p, a in zip(params, saved):
+                        p._data = a
+                return out._data
+
+            return run_op(jax.checkpoint(fn), [x] + params,
+                          name="llama_block_rc")
+        return self._body(x, position_ids=position_ids, cache=cache)
+
+
+class LlamaModel(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        init = nn.initializer.Normal(0.0, config.initializer_range)
+        self.embed_tokens = nn.Embedding(config.vocab_size,
+                                         config.hidden_size, weight_attr=init)
+        annotate_param(self.embed_tokens.weight, ("mp", None))
+        self.layers = nn.LayerList([LlamaBlock(config)
+                                    for _ in range(config.num_layers)])
+        self.norm = nn.RMSNorm(config.hidden_size, config.rms_norm_eps)
+
+    def forward(self, input_ids, position_ids=None, caches=None):
+        x = self.embed_tokens(input_ids)
+        x = shard_activation(x, ("dp", "sp", None))
+        new_caches = [] if caches is not None else None
+        for i, block in enumerate(self.layers):
+            if caches is not None:
+                x, c = block(x, position_ids=position_ids, cache=caches[i])
+                new_caches.append(c)
+            else:
+                x = block(x, position_ids=position_ids)
+        x = self.norm(x)
+        if caches is not None:
+            return x, new_caches
+        return x
+
+
+class LlamaForCausalLM(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.llama = LlamaModel(config)
+        if config.tie_word_embeddings:
+            self.lm_head = None
+        else:
+            self.lm_head = nn.Linear(config.hidden_size, config.vocab_size,
+                                     bias_attr=False)
+            annotate_param(self.lm_head.weight, (None, "mp"))
+
+    def forward(self, input_ids, position_ids=None, labels=None, caches=None):
+        if caches is not None:
+            x, new_caches = self.llama(input_ids, position_ids, caches=caches)
+        else:
+            x = self.llama(input_ids, position_ids)
+        if self.lm_head is not None:
+            logits = self.lm_head(x)
+        else:
+            logits = run_op(lambda a, w: jnp.matmul(a, w.T),
+                            [x, self.llama.embed_tokens.weight],
+                            name="lm_head_tied")
+        logits = shard_activation(logits, ("dp", "sp", "mp"))
+        if labels is not None:
+            loss = F.cross_entropy(
+                logits.reshape([-1, logits.shape[-1]]),
+                labels.reshape([-1]), reduction="mean")
+            return loss
+        if caches is not None:
+            return logits, new_caches
+        return logits
+
+    def init_caches(self, batch_size: int):
+        from ..ops.creation import zeros
+
+        cfg = self.config
+        return [(zeros([batch_size, 0, cfg.num_kv_heads, cfg.head_dim]),
+                 zeros([batch_size, 0, cfg.num_kv_heads, cfg.head_dim]))
+                for _ in range(cfg.num_layers)]

@@ -306,11 +306,11 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
 
     Dispatches to the Pallas flash-attention kernel on TPU when shapes allow;
     falls back to the XLA softmax composition otherwise."""
-    from ...incubate.nn.functional import flash_attention as _fa_mod
     from ...incubate.nn.functional.flash_attention import flash_attention as _fa
 
-    out, _ = _fa(query, key, value, dropout=dropout_p,
-                 causal=is_causal, training=training)
+    if attn_mask is None:
+        out, _ = _fa(query, key, value, dropout=dropout_p,
+                     causal=is_causal, training=training)
     if attn_mask is not None:
         # masked path: use the reference composition
         q, k, v = as_tensor(query), as_tensor(key), as_tensor(value)

@@ -63,8 +63,12 @@ class HookRemoveHelper:
 
 
 class Layer:
-    def __init__(self, name_scope: Optional[str] = None, dtype="float32"):
+    def __init__(self, name_scope: Optional[str] = None, dtype=None):
         self.training = True
+        if dtype is None:
+            from ...framework import get_default_dtype
+
+            dtype = get_default_dtype()
         self._dtype = convert_dtype(dtype)
         self._parameters: Dict[str, Parameter] = collections.OrderedDict()
         self._sub_layers: Dict[str, "Layer"] = collections.OrderedDict()
@@ -142,10 +146,16 @@ class Layer:
         name = None
         trainable = True
         if attr is not None and attr is not False:
-            init = getattr(attr, "initializer", None) or init
-            lr = getattr(attr, "learning_rate", 1.0)
-            name = getattr(attr, "name", None)
-            trainable = getattr(attr, "trainable", True)
+            from ..initializer import Initializer
+
+            if isinstance(attr, Initializer):
+                # paddle accepts a bare Initializer as weight_attr
+                init = attr
+            else:
+                init = getattr(attr, "initializer", None) or init
+                lr = getattr(attr, "learning_rate", 1.0)
+                name = getattr(attr, "name", None)
+                trainable = getattr(attr, "trainable", True)
         data = init(shape, to_jax_dtype(dtype))
         p = Parameter(data, trainable=trainable, name=name)
         p.optimize_attr["learning_rate"] = lr
