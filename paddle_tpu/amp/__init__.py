@@ -24,8 +24,9 @@ __all__ = ["auto_cast", "autocast", "decorate", "GradScaler", "AmpScaler",
 WHITE_LIST = {"matmul", "bmm", "mm", "conv1d", "conv2d", "conv3d", "linear",
               "einsum", "flash_attention", "mha"}
 BLACK_LIST = {"exp", "log", "mean", "sum", "softmax", "cross_entropy",
-              "layer_norm", "batch_norm", "rms_norm", "logsumexp",
-              "log_softmax", "norm", "cumsum"}
+              "layer_norm", "batch_norm", "rms_norm", "fused_rms_norm",
+              "fused_layer_norm", "logsumexp", "log_softmax", "norm",
+              "cumsum"}
 
 
 def white_list():

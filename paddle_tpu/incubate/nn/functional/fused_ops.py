@@ -100,17 +100,51 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
     return outs
 
 
+def _last_axis_norm(begin_norm_axis, x):
+    return begin_norm_axis in (-1, x.ndim - 1)
+
+
+def _pallas_norm_ok(x):
+    """Gate like flash_attention._use_pallas: TPU backend + importable pallas
+    + non-degenerate shape; otherwise the XLA composition path."""
+    try:
+        from ..pallas import norms  # noqa: F401
+    except Exception:
+        return False
+    if jax.default_backend() != "tpu":
+        return False
+    return x.size > 0
+
+
 def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
                    begin_norm_axis=-1, bias=None, residual=None,
                    quant_scale=-1, name=None):
-    """reference: incubate/nn/functional/fused_rms_norm.py."""
+    """reference: incubate/nn/functional/fused_rms_norm.py.
+
+    Last-axis case dispatches to the Pallas fused kernel
+    (:mod:`paddle_tpu.incubate.nn.pallas.norms`)."""
     if bias is not None:
         x = as_tensor(x) + as_tensor(bias)
+    xt = as_tensor(x)
     if residual is not None:
-        x = as_tensor(x) + as_tensor(residual)
-        out = _rms_norm(x, norm_weight, norm_bias, epsilon, begin_norm_axis)
-        return out, x
-    return _rms_norm(x, norm_weight, norm_bias, epsilon, begin_norm_axis)
+        xt = xt + as_tensor(residual)
+    if norm_weight is not None and _last_axis_norm(begin_norm_axis, xt) \
+            and _pallas_norm_ok(xt):
+        from ..pallas.norms import rms_norm as pallas_rms
+
+        w = as_tensor(norm_weight)
+        ts = [xt, w]
+        if norm_bias is not None:
+            ts.append(as_tensor(norm_bias))
+            fn = lambda a, wa, ba: pallas_rms(a, wa, ba, eps=epsilon)
+        else:
+            fn = lambda a, wa: pallas_rms(a, wa, eps=epsilon)
+        out = run_op(fn, ts, name="fused_rms_norm")
+    else:
+        out = _rms_norm(xt, norm_weight, norm_bias, epsilon, begin_norm_axis)
+    if residual is not None:
+        return out, xt
+    return out
 
 
 def fused_layer_norm(x, norm_weight, norm_bias=None, epsilon=1e-5,

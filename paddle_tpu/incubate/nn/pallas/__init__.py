@@ -1,0 +1,13 @@
+"""Pallas TPU kernel tier — the analog of the reference's fused CUDA
+kernels (paddle/phi/kernels/fusion/gpu/) and KPS primitive layer
+(paddle/phi/kernels/primitive/kernel_primitives.h).
+
+Kernels here are hand-tiled for the MXU/VPU and run under the Pallas
+interpreter on non-TPU backends so tests stay hermetic.
+"""
+from . import flash_attn, norms
+from .flash_attn import flash_attention
+from .norms import layer_norm, rms_norm
+
+__all__ = ["flash_attn", "norms", "flash_attention", "layer_norm",
+           "rms_norm"]
