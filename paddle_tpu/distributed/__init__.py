@@ -65,6 +65,13 @@ from .auto_parallel.placement_type import (  # noqa: F401
 )
 from . import fleet  # noqa: F401
 from . import checkpoint  # noqa: F401
+from . import sequence_parallel  # noqa: F401
+from .sequence_parallel import (  # noqa: F401
+    ring_attention,
+    ring_attention_sharded,
+    ulysses_attention,
+    ulysses_attention_sharded,
+)
 from .parallel import DataParallel  # noqa: F401
 from .sharding import group_sharded_parallel, save_group_sharded_model  # noqa: F401
 from . import launch  # noqa: F401

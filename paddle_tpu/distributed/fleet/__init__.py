@@ -35,6 +35,7 @@ from .sharding_optimizer import (  # noqa: F401
     GroupShardedStage3,
 )
 from . import hybrid_parallel_util  # noqa: F401
+from . import sequence_parallel_utils  # noqa: F401
 from .hybrid_parallel_optimizer import HybridParallelOptimizer  # noqa: F401
 
 # namespace parity: fleet.utils / fleet.layers.mpu / fleet.base

@@ -1,0 +1,261 @@
+"""Megatron-style sequence-parallel utilities for the eager Fleet path
+(reference: python/paddle/distributed/fleet/utils/sequence_parallel_utils.py
+— ScatterOp/GatherOp:25-85, allreduce hooks for SP params :192,
+ColumnSequenceParallelLinear :429, RowSequenceParallelLinear :564).
+
+Activations are sequence-sharded across the mp group between transformer
+blocks; Column linear all-gathers the sequence before its matmul and Row
+linear reduce-scatters after, so the matmuls see the full hidden dim while
+norm/dropout work on 1/mp of the tokens. The SPMD/jit path expresses the
+same thing with shardings (models/*.py); this module is the imperative
+collective-API formulation.
+
+Layout convention follows the reference: the SEQUENCE dim is axis 0
+([s, b, h]) for the split/gather ops.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ... import nn
+from ...autograd import PyLayer
+from ...core.tensor import Tensor
+from ...nn import functional as F
+from .. import collective as dist
+
+__all__ = [
+    "ScatterOp", "GatherOp", "AllGatherOp", "ReduceScatterOp",
+    "scatter", "all_gather", "reduce_scatter",
+    "mark_as_sequence_parallel_parameter",
+    "is_sequence_parallel_parameter",
+    "register_sequence_parallel_allreduce_hooks",
+    "ColumnSequenceParallelLinear", "RowSequenceParallelLinear",
+]
+
+
+def _mp_group(group=None):
+    if group is not None:
+        return group
+    from .fleet import get_hybrid_communicate_group
+
+    hcg = get_hybrid_communicate_group()
+    return hcg.get_model_parallel_group() if hcg else None
+
+
+def _split_local(arr, nranks, rank, axis=0):
+    parts = jnp.split(arr, nranks, axis=axis)
+    return parts[rank]
+
+
+class ScatterOp(PyLayer):
+    """fwd: take own seq slice; bwd: all-gather (reference :25)."""
+
+    @staticmethod
+    def forward(ctx, x, axis=0, group=None):
+        g = _mp_group(group)
+        ctx.group, ctx.axis = g, axis
+        if g is None or g.nranks <= 1:
+            return Tensor(x._data)
+        return Tensor(_split_local(x._data, g.nranks, g.rank, axis))
+
+    @staticmethod
+    def backward(ctx, dy):
+        g = ctx.group
+        if g is None or g.nranks <= 1:
+            return Tensor(dy._data)
+        outs = []
+        dist.all_gather(outs, Tensor(dy._data), group=g)
+        return Tensor(jnp.concatenate([o._data for o in outs],
+                                      axis=ctx.axis))
+
+
+class GatherOp(PyLayer):
+    """fwd: all-gather along seq; bwd: take own slice (reference :52)."""
+
+    @staticmethod
+    def forward(ctx, x, axis=0, group=None):
+        g = _mp_group(group)
+        ctx.group, ctx.axis = g, axis
+        if g is None or g.nranks <= 1:
+            return Tensor(x._data)
+        outs = []
+        dist.all_gather(outs, Tensor(x._data), group=g)
+        return Tensor(jnp.concatenate([o._data for o in outs], axis=axis))
+
+    @staticmethod
+    def backward(ctx, dy):
+        g = ctx.group
+        if g is None or g.nranks <= 1:
+            return Tensor(dy._data)
+        return Tensor(_split_local(dy._data, g.nranks, g.rank, ctx.axis))
+
+
+class AllGatherOp(PyLayer):
+    """fwd: all-gather; bwd: reduce-scatter (reference :85 — the pair that
+    makes W-grads exact when activations are seq-sharded)."""
+
+    @staticmethod
+    def forward(ctx, x, group=None):
+        g = _mp_group(group)
+        ctx.group = g
+        if g is None or g.nranks <= 1:
+            return Tensor(x._data)
+        outs = []
+        dist.all_gather(outs, Tensor(x._data), group=g)
+        return Tensor(jnp.concatenate([o._data for o in outs], axis=0))
+
+    @staticmethod
+    def backward(ctx, dy):
+        g = ctx.group
+        if g is None or g.nranks <= 1:
+            return Tensor(dy._data)
+        parts = jnp.split(dy._data, g.nranks, axis=0)
+        out = Tensor(jnp.zeros_like(parts[0]))
+        dist.reduce_scatter(out, [Tensor(p) for p in parts], group=g)
+        return out
+
+
+class ReduceScatterOp(PyLayer):
+    """fwd: reduce-scatter along seq; bwd: all-gather (reference :130)."""
+
+    @staticmethod
+    def forward(ctx, x, group=None):
+        g = _mp_group(group)
+        ctx.group = g
+        if g is None or g.nranks <= 1:
+            return Tensor(x._data)
+        parts = jnp.split(x._data, g.nranks, axis=0)
+        out = Tensor(jnp.zeros_like(parts[0]))
+        dist.reduce_scatter(out, [Tensor(p) for p in parts], group=g)
+        return out
+
+    @staticmethod
+    def backward(ctx, dy):
+        g = ctx.group
+        if g is None or g.nranks <= 1:
+            return Tensor(dy._data)
+        outs = []
+        dist.all_gather(outs, Tensor(dy._data), group=g)
+        return Tensor(jnp.concatenate([o._data for o in outs], axis=0))
+
+
+def scatter(x, group=None, axis=0):
+    return ScatterOp.apply(x, axis=axis, group=group)
+
+
+def all_gather(x, group=None):
+    return AllGatherOp.apply(x, group=group)
+
+
+def reduce_scatter(x, group=None):
+    return ReduceScatterOp.apply(x, group=group)
+
+
+# --------------------------------------------------------------- SP params
+def mark_as_sequence_parallel_parameter(parameter):
+    """Norm/bias params that act on seq-sharded activations produce
+    partial grads; mark them so the hook all-reduces (reference :175)."""
+    parameter.sequence_parallel = True
+
+
+def is_sequence_parallel_parameter(parameter):
+    return getattr(parameter, "sequence_parallel", False)
+
+
+def register_sequence_parallel_allreduce_hooks(layer, accumulation_steps=1,
+                                               fuse_sequence_parallel_allreduce=False):
+    """All-reduce grads of marked params across the mp group after backward
+    (reference :192). With accumulation, fires every Nth backward."""
+    group = _mp_group(None)
+    if group is None or group.nranks <= 1:
+        return
+
+    params = [p for p in layer.parameters()
+              if is_sequence_parallel_parameter(p)]
+    counters = {}
+
+    def make_hook(p):
+        def hook(grad):
+            c = counters.get(id(p), 0) + 1
+            counters[id(p)] = c
+            if c % accumulation_steps == 0:
+                g = Tensor(grad._data) if isinstance(grad, Tensor) \
+                    else Tensor(grad)
+                dist.all_reduce(g, group=group)
+                return g
+            return grad
+
+        return hook
+
+    for p in params:
+        p.register_hook(make_hook(p))
+
+
+# ------------------------------------------------------------ SP linears
+class ColumnSequenceParallelLinear(nn.Layer):
+    """All-gather seq -> matmul with column-split W [in, out/mp]
+    (reference :429). Input [s/mp, b, in]; output [s, b, out/mp]."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=None, gather_output=False, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.group = _mp_group(mp_group)
+        self.world_size = self.group.nranks if self.group else 1
+        assert gather_output is False, (
+            "ColumnSequenceParallelLinear feeds RowSequenceParallelLinear; "
+            "gather_output is not supported (matches reference assert :478)")
+        assert out_features % self.world_size == 0
+        self.out_per_part = out_features // self.world_size
+        self.weight = self.create_parameter(
+            [in_features, self.out_per_part], attr=weight_attr,
+            default_initializer=nn.initializer.XavierNormal())
+        self.weight.is_distributed = self.world_size > 1
+        if has_bias:
+            self.bias = self.create_parameter(
+                [self.out_per_part], is_bias=True)
+            self.bias.is_distributed = self.world_size > 1
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        if self.world_size > 1:
+            x = AllGatherOp.apply(x, group=self.group)
+        return F.linear(x, self.weight, self.bias)
+
+
+class RowSequenceParallelLinear(nn.Layer):
+    """Matmul with row-split W [in/mp, out] -> reduce-scatter seq
+    (reference :564). Input [s, b, in/mp]; output [s/mp, b, out]."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=True,
+                 fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        self.group = _mp_group(mp_group)
+        self.world_size = self.group.nranks if self.group else 1
+        assert input_is_parallel, (
+            "RowSequenceParallelLinear expects column-parallel input "
+            "(matches reference assert :597)")
+        assert in_features % self.world_size == 0
+        self.in_per_part = in_features // self.world_size
+        self.weight = self.create_parameter(
+            [self.in_per_part, out_features], attr=weight_attr,
+            default_initializer=nn.initializer.XavierNormal())
+        self.weight.is_distributed = self.world_size > 1
+        if has_bias:
+            self.bias = self.create_parameter([out_features], is_bias=True)
+            # bias applies after reduce-scatter on seq-sharded activations:
+            # its grad is partial across mp -> needs the SP allreduce hook
+            mark_as_sequence_parallel_parameter(self.bias)
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        if self.world_size <= 1:
+            return F.linear(x, self.weight, self.bias)
+        out = F.linear(x, self.weight, None)
+        out = ReduceScatterOp.apply(out, group=self.group)
+        if self.bias is not None:
+            out = out + self.bias
+        return out

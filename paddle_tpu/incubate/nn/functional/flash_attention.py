@@ -21,7 +21,7 @@ from ....ops._helpers import as_tensor, run_op, unwrap
 __all__ = ["flash_attention", "flash_attn_unpadded", "scaled_dot_product_attention"]
 
 
-def _use_pallas(q_shape, head_dim):
+def _use_pallas(q_shape, kv_seq, head_dim):
     try:
         from ..pallas import flash_attn  # noqa: F401
     except Exception:
@@ -29,7 +29,8 @@ def _use_pallas(q_shape, head_dim):
     if jax.default_backend() != "tpu":
         return False
     seq = q_shape[1]
-    return head_dim in (64, 128, 256) and seq % 128 == 0
+    return (head_dim in (64, 128, 256) and seq % 128 == 0
+            and kv_seq % 128 == 0)
 
 
 def _xla_attention(q, k, v, causal, scale=None):
@@ -55,7 +56,8 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
     q, k, v = as_tensor(query), as_tensor(key), as_tensor(value)
     head_dim = q.shape[-1]
 
-    if _use_pallas(tuple(q.shape), head_dim) and not return_softmax:
+    if _use_pallas(tuple(q.shape), k.shape[1], head_dim) \
+            and not return_softmax:
         from ..pallas.flash_attn import flash_attention as pallas_fa
 
         out = run_op(

@@ -104,6 +104,11 @@ def _last_axis_norm(begin_norm_axis, x):
     return begin_norm_axis in (-1, x.ndim - 1)
 
 
+# test hook: force the Pallas dispatch branch on non-TPU backends (the
+# kernels run under the interpreter there)
+_FORCE_PALLAS = False
+
+
 def _pallas_norm_ok(x):
     """Gate like flash_attention._use_pallas: TPU backend + importable pallas
     + non-degenerate shape; otherwise the XLA composition path."""
@@ -111,7 +116,7 @@ def _pallas_norm_ok(x):
         from ..pallas import norms  # noqa: F401
     except Exception:
         return False
-    if jax.default_backend() != "tpu":
+    if jax.default_backend() != "tpu" and not _FORCE_PALLAS:
         return False
     return x.size > 0
 

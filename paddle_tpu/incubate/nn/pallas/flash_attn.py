@@ -24,10 +24,9 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-try:  # pltpu is importable on CPU too (used for interpret-mode runs)
-    from jax.experimental.pallas import tpu as pltpu
-except ImportError:  # pragma: no cover
-    pltpu = None
+# pltpu imports fine without TPU hardware (interpret mode uses its
+# scratch-shape constructors too)
+from jax.experimental.pallas import tpu as pltpu
 
 DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
@@ -44,7 +43,9 @@ def _interpret_default() -> bool:
 # ---------------------------------------------------------------------------
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
                 m_scr, l_scr, acc_scr, *, scale, causal, block_q, block_k,
-                num_k_blocks):
+                num_k_blocks, offset):
+    # offset = sk - sq: bottom-right-aligned causal mask (query i attends
+    # keys <= i + offset), matching the XLA fallback's tril(..., sk - sq)
     i = pl.program_id(1)
     j = pl.program_id(2)
 
@@ -63,7 +64,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
             preferred_element_type=jnp.float32) * scale  # [bq, bk]
 
         if causal:
-            q_pos = i * block_q + jax.lax.broadcasted_iota(
+            q_pos = i * block_q + offset + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             k_pos = j * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
@@ -84,8 +85,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
 
     if causal:
-        # skip fully-masked blocks above the diagonal
-        @pl.when(j * block_k < (i + 1) * block_q)
+        # skip fully-masked blocks above the (offset) diagonal
+        @pl.when(j * block_k < (i + 1) * block_q + offset)
         def _run():
             _body()
     else:
@@ -113,10 +114,10 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
     grid = (bh, nq, nk)
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal,
-        block_q=block_q, block_k=block_k, num_k_blocks=nk)
+        block_q=block_q, block_k=block_k, num_k_blocks=nk, offset=sk - sq)
 
     compiler_params = None
-    if pltpu is not None and not interpret:
+    if not interpret:
         compiler_params = pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"))
 
@@ -140,7 +141,7 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
             pltpu.VMEM((block_q, _LANES), jnp.float32),
             pltpu.VMEM((block_q, _LANES), jnp.float32),
             pltpu.VMEM((block_q, d), jnp.float32),
-        ] if pltpu is not None else [],
+        ],
         compiler_params=compiler_params,
         interpret=interpret,
         cost_estimate=pl.CostEstimate(
@@ -158,7 +159,7 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
 # ---------------------------------------------------------------------------
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_scr, dv_scr, *, scale, causal,
-                    block_q, block_k, num_q_blocks):
+                    block_q, block_k, num_q_blocks, offset):
     j = pl.program_id(1)   # k block
     i = pl.program_id(2)   # q block (sequential)
 
@@ -178,13 +179,15 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # [bq, bk]
+        p = jnp.exp(s - lse)                         # [bq, bk]
         if causal:
-            q_pos = i * block_q + jax.lax.broadcasted_iota(
+            q_pos = i * block_q + offset + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             k_pos = j * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
-        p = jnp.exp(s - lse)                         # [bq, bk]
+            # explicit zero (not exp underflow): fully-masked rows carry
+            # lse = -NEG_INF and would otherwise give exp(0) = 1
+            p = jnp.where(q_pos >= k_pos, p, 0.0)
 
         # dv += p^T @ do
         dv_scr[...] += jax.lax.dot_general(
@@ -201,7 +204,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             preferred_element_type=jnp.float32)
 
     if causal:
-        @pl.when((i + 1) * block_q > j * block_k)
+        @pl.when((i + 1) * block_q + offset > j * block_k)
         def _run():
             _body()
     else:
@@ -218,7 +221,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 # ---------------------------------------------------------------------------
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                    dq_ref, dq_scr, *, scale, causal, block_q, block_k,
-                   num_k_blocks):
+                   num_k_blocks, offset):
     i = pl.program_id(1)   # q block
     j = pl.program_id(2)   # k block (sequential)
 
@@ -237,13 +240,13 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
+        p = jnp.exp(s - lse)
         if causal:
-            q_pos = i * block_q + jax.lax.broadcasted_iota(
+            q_pos = i * block_q + offset + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             k_pos = j * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
-        p = jnp.exp(s - lse)
+            p = jnp.where(q_pos >= k_pos, p, 0.0)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -254,7 +257,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             preferred_element_type=jnp.float32)
 
     if causal:
-        @pl.when(j * block_k < (i + 1) * block_q)
+        @pl.when(j * block_k < (i + 1) * block_q + offset)
         def _run():
             _body()
     else:
@@ -290,14 +293,14 @@ def _flash_bwd(q, k, v, out, lse, do, causal, scale, block_q, block_k,
         return [pl.BlockSpec(s, m) for s, m in zip(block_shapes, maps)]
 
     compiler_params = None
-    if pltpu is not None and not interpret:
+    if not interpret:
         compiler_params = pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"))
 
     # ---- dk, dv: grid (bh, nk, nq), q-dim sequential
     dkv_kernel = functools.partial(
         _bwd_dkv_kernel, scale=scale, causal=causal,
-        block_q=block_q, block_k=block_k, num_q_blocks=nq)
+        block_q=block_q, block_k=block_k, num_q_blocks=nq, offset=sk - sq)
     dk, dv = pl.pallas_call(
         dkv_kernel,
         grid=(bh, nk, nq),
@@ -320,7 +323,7 @@ def _flash_bwd(q, k, v, out, lse, do, causal, scale, block_q, block_k,
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),
             pltpu.VMEM((block_k, d), jnp.float32),
-        ] if pltpu is not None else [],
+        ],
         compiler_params=compiler_params,
         interpret=interpret,
     )(q, k, v, do, lse, delta)
@@ -328,7 +331,7 @@ def _flash_bwd(q, k, v, out, lse, do, causal, scale, block_q, block_k,
     # ---- dq: grid (bh, nq, nk), k-dim sequential
     dq_kernel = functools.partial(
         _bwd_dq_kernel, scale=scale, causal=causal,
-        block_q=block_q, block_k=block_k, num_k_blocks=nk)
+        block_q=block_q, block_k=block_k, num_k_blocks=nk, offset=sk - sq)
     dq = pl.pallas_call(
         dq_kernel,
         grid=(bh, nq, nk),
@@ -344,7 +347,7 @@ def _flash_bwd(q, k, v, out, lse, do, causal, scale, block_q, block_k,
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
-        ] if pltpu is not None else [],
+        ],
         compiler_params=compiler_params,
         interpret=interpret,
     )(q, k, v, do, lse, delta)

@@ -29,17 +29,12 @@ def _interpret_default() -> bool:
     return jax.default_backend() != "tpu"
 
 
-def _rms_kernel(x_ref, w_ref, o_ref, *, eps, has_bias):
-    def body(x, w, b):
-        xf = x.astype(jnp.float32)
-        ms = jnp.mean(xf * xf, axis=-1, keepdims=True)
-        inv = jax.lax.rsqrt(ms + eps)
-        out = xf * inv * w.astype(jnp.float32)
-        if b is not None:
-            out = out + b.astype(jnp.float32)
-        return out.astype(x.dtype)
-
-    o_ref[...] = body(x_ref[...], w_ref[...], None)
+def _rms_kernel(x_ref, w_ref, o_ref, *, eps):
+    xf = x_ref[...].astype(jnp.float32)
+    ms = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    inv = jax.lax.rsqrt(ms + eps)
+    o_ref[...] = (xf * inv * w_ref[...].astype(jnp.float32)) \
+        .astype(x_ref.dtype)
 
 
 def _rms_kernel_bias(x_ref, w_ref, b_ref, o_ref, *, eps):
@@ -64,11 +59,11 @@ def _ln_kernel(x_ref, w_ref, b_ref, o_ref, *, eps):
 
 def _rowwise_call(kernel, x2d, params, interpret, block_rows=_DEF_BLOCK_ROWS):
     n, d = x2d.shape
-    block_rows = min(block_rows, n)
-    if n % block_rows != 0:
-        # fall back to one big block (XLA pads); correctness first
-        block_rows = n
-    grid = (n // block_rows,)
+    # rows are independent: a cdiv grid lets Pallas pad the trailing block
+    # (padded rows compute garbage that is clipped on write) and keeps the
+    # block row count 8-aligned regardless of n
+    block_rows = n if n < block_rows else block_rows
+    grid = (pl.cdiv(n, block_rows),)
     in_specs = [pl.BlockSpec((block_rows, d), lambda i: (i, 0))]
     for p in params:
         in_specs.append(pl.BlockSpec((d,), lambda i: (0,)))
@@ -88,8 +83,7 @@ def _rms_norm(x2d, w, b, eps):
     interpret = _interpret_default()
     if b is None:
         return _rowwise_call(
-            functools.partial(_rms_kernel, eps=eps, has_bias=False),
-            x2d, [w], interpret)
+            functools.partial(_rms_kernel, eps=eps), x2d, [w], interpret)
     return _rowwise_call(
         functools.partial(_rms_kernel_bias, eps=eps), x2d, [w, b], interpret)
 

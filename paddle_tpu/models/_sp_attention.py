@@ -1,0 +1,63 @@
+"""Sequence-parallel attention dispatch for the flagship models.
+
+``sequence_parallel_mode`` on the model configs selects how attention
+handles a seq-sharded ("sp") activation layout under jit:
+
+- "gspmd" (default): leave it to GSPMD — the sharding annotations make
+  XLA all-gather K/V over the sp axis.
+- "ring": explicit ring attention (distributed.sequence_parallel) — K/V
+  chunks rotate via collective-permute on ICI, O(S/P) memory.
+- "ulysses": all-to-all head<->seq exchange, full-seq flash attention on
+  heads/P heads per chip.
+
+Falls back to the caller's default attention when no mesh with a
+non-trivial "sp" axis is active (eager mode, single chip, decode).
+"""
+from __future__ import annotations
+
+import functools
+
+from ..core.tensor import Tensor
+from ..ops._helpers import run_op
+
+SP_AXIS = "sp"
+
+
+def _active_sp_mesh():
+    from ..distributed.auto_parallel.process_mesh import get_mesh
+
+    pm = get_mesh()
+    if pm is None:
+        return None
+    jmesh = pm.get_jax_mesh() if hasattr(pm, "get_jax_mesh") else pm
+    if SP_AXIS not in jmesh.axis_names or jmesh.shape[SP_AXIS] <= 1:
+        return None
+    return jmesh
+
+
+def sp_attention(q: Tensor, k: Tensor, v: Tensor, mode: str,
+                 causal: bool) -> Tensor | None:
+    """Ring/Ulysses attention over the active mesh's sp axis, or None if
+    not applicable (caller then uses its default sdpa path)."""
+    if mode not in ("ring", "ulysses") or not causal:
+        return None
+    jmesh = _active_sp_mesh()
+    if jmesh is None:
+        return None
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from ..distributed.sequence_parallel import (ring_attention,
+                                                 ulysses_attention)
+
+    names = jmesh.axis_names
+    dp_ax = "dp" if "dp" in names else None
+    mp_ax = "mp" if "mp" in names else None
+    spec = P(dp_ax, SP_AXIS, mp_ax, None)
+    inner = ring_attention if mode == "ring" else ulysses_attention
+    fn = jax.shard_map(
+        functools.partial(inner, axis_name=SP_AXIS, causal=True),
+        mesh=jmesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    return run_op(lambda qa, ka, va: fn(qa, ka, va), [q, k, v],
+                  name=f"{mode}_attention")

@@ -51,6 +51,9 @@ class GPTConfig:
     use_bias: bool = True
     # recompute (reference: fleet/recompute) — rematerialize each block
     recompute: bool = False
+    # "gspmd" | "ring" | "ulysses" — how attention handles a seq-sharded
+    # layout over the "sp" mesh axis (see models/_sp_attention.py)
+    sequence_parallel_mode: str = "gspmd"
 
     def __post_init__(self):
         if self.intermediate_size is None:
@@ -130,11 +133,21 @@ class GPTAttention(nn.Layer):
             v = concat([cache[1], v], axis=1)
             cache = (k, v)
         q = shard_activation(q, ("dp", "sp", "mp", None))
-        out = F.scaled_dot_product_attention(
-            q, k, v, is_causal=s > 1 and past == 0,
-            attn_mask=_offset_causal_mask(s, past),
-            dropout_p=cfg.attention_dropout if self.training else 0.0,
-            training=self.training)  # [b, s, heads, head_dim]
+        out = None
+        dropout_p = cfg.attention_dropout if self.training else 0.0
+        if cache is None and s > 1 and dropout_p == 0.0:
+            # ring/ulysses paths carry no dropout; keep gspmd semantics
+            # when attention dropout is active
+            from ._sp_attention import sp_attention
+
+            out = sp_attention(q, k, v, cfg.sequence_parallel_mode,
+                               causal=True)
+        if out is None:
+            out = F.scaled_dot_product_attention(
+                q, k, v, is_causal=s > 1 and past == 0,
+                attn_mask=_offset_causal_mask(s, past),
+                dropout_p=cfg.attention_dropout if self.training else 0.0,
+                training=self.training)  # [b, s, heads, head_dim]
         out = out.reshape([b, s, cfg.num_heads * cfg.head_dim])
         out = self.out_proj(out)
         if cache is not None:

@@ -40,6 +40,8 @@ class LlamaConfig:
     initializer_range: float = 0.02
     tie_word_embeddings: bool = False
     recompute: bool = False
+    # "gspmd" | "ring" | "ulysses" (see models/_sp_attention.py)
+    sequence_parallel_mode: str = "gspmd"
 
     def __post_init__(self):
         if self.num_kv_heads is None:
@@ -112,9 +114,17 @@ class LlamaAttention(nn.Layer):
         q = shard_activation(q, ("dp", "sp", "mp", None))
         from .gpt import _offset_causal_mask
 
-        out = F.scaled_dot_product_attention(
-            q, k, v, is_causal=s > 1 and past == 0,
-            attn_mask=_offset_causal_mask(s, past), training=self.training)
+        out = None
+        if cache is None and s > 1:
+            from ._sp_attention import sp_attention
+
+            out = sp_attention(q, k, v, cfg.sequence_parallel_mode,
+                               causal=True)
+        if out is None:
+            out = F.scaled_dot_product_attention(
+                q, k, v, is_causal=s > 1 and past == 0,
+                attn_mask=_offset_causal_mask(s, past),
+                training=self.training)
         out = out.reshape([b, s, cfg.num_heads * cfg.head_dim])
         out = self.o_proj(out)
         if cache is not None:

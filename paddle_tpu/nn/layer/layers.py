@@ -24,7 +24,8 @@ __all__ = ["Layer", "Parameter"]
 class Parameter(Tensor):
     """Trainable tensor: ``stop_gradient=False`` by default."""
 
-    __slots__ = ("trainable", "optimize_attr", "regularizer", "is_distributed")
+    __slots__ = ("trainable", "optimize_attr", "regularizer",
+                 "is_distributed", "sequence_parallel")
 
     def __init__(self, data, trainable: bool = True, name: Optional[str] = None):
         super().__init__(data, stop_gradient=not trainable, name=name)
@@ -32,6 +33,7 @@ class Parameter(Tensor):
         self.optimize_attr = {"learning_rate": 1.0}
         self.regularizer = None
         self.is_distributed = False
+        self.sequence_parallel = False
         self.persistable = True
 
     def __repr__(self):

@@ -69,6 +69,30 @@ class TestFlashAttention:
         ref = _ref_attention(q, kr, vr, True)
         np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
 
+    def test_kv_longer_than_q(self):
+        """Bottom-right-aligned causal mask (chunked prefill): must match
+        the XLA fallback's tril(..., sk - sq) alignment."""
+        rng = np.random.RandomState(4)
+        b, h, d = 1, 2, 64
+        sq, sk = 128, 256
+        q = jnp.asarray(rng.randn(b, sq, h, d), jnp.float32)
+        k = jnp.asarray(rng.randn(b, sk, h, d), jnp.float32)
+        v = jnp.asarray(rng.randn(b, sk, h, d), jnp.float32)
+        out = pfa.flash_attention(q, k, v, causal=True)
+        qh = jnp.swapaxes(q, 1, 2)
+        kh = jnp.swapaxes(k, 1, 2)
+        vh = jnp.swapaxes(v, 1, 2)
+        logits = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * d ** -0.5
+        mask = jnp.tril(jnp.ones((sq, sk), bool), sk - sq)
+        logits = jnp.where(mask, logits, -1e30)
+        w = jax.nn.softmax(logits, axis=-1)
+        ref = jnp.swapaxes(jnp.einsum("bhqk,bhkd->bhqd", w, vh), 1, 2)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+        # grads flow through the offset mask too
+        g = jax.grad(lambda q, k, v: (pfa.flash_attention(
+            q, k, v, causal=True) ** 2).sum(), argnums=(0, 1, 2))(q, k, v)
+        assert all(np.isfinite(np.asarray(x)).all() for x in g)
+
     def test_bf16(self):
         rng = np.random.RandomState(3)
         b, s, h, d = 1, 128, 2, 128
@@ -120,15 +144,25 @@ class TestPallasNorms:
 class TestFusedOpsDispatch:
     def test_fused_rms_norm_pallas_path(self):
         import paddle_tpu as pt
+        from paddle_tpu.incubate.nn.functional import fused_ops
         from paddle_tpu.incubate.nn.functional import fused_rms_norm
 
         x = pt.to_tensor(np.random.RandomState(0).randn(2, 8, 256)
                          .astype(np.float32))
         w = pt.to_tensor(np.ones(256, np.float32))
-        out = fused_rms_norm(x, w)
         xn = x.numpy()
         ref = xn / np.sqrt((xn * xn).mean(-1, keepdims=True) + 1e-6)
-        np.testing.assert_allclose(out.numpy(), ref, atol=1e-5, rtol=1e-5)
+        # exercise BOTH branches: forced Pallas dispatch and XLA fallback
+        fused_ops._FORCE_PALLAS = True
+        try:
+            out_pallas = fused_rms_norm(x, w)
+        finally:
+            fused_ops._FORCE_PALLAS = False
+        out_xla = fused_rms_norm(x, w)
+        np.testing.assert_allclose(out_pallas.numpy(), ref, atol=1e-5,
+                                   rtol=1e-5)
+        np.testing.assert_allclose(out_xla.numpy(), ref, atol=1e-5,
+                                   rtol=1e-5)
 
     def test_fused_rms_norm_residual(self):
         import paddle_tpu as pt
