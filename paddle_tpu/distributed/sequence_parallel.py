@@ -127,17 +127,13 @@ def ulysses_attention(q, k, v, axis_name, causal=True, scale=None,
     qg, kg, vg = a2a_fwd(q), a2a_fwd(k), a2a_fwd(v)
     if attention_fn is None:
         def attention_fn(q_, k_, v_):
+            from ..incubate.nn.functional.flash_attention import (
+                _use_pallas, _xla_attention)
             from ..incubate.nn.pallas.flash_attn import flash_attention
 
-            seq = q_.shape[1]
-            if (jax.default_backend() == "tpu" and seq % 128 == 0
-                    and q_.shape[-1] in (64, 128, 256)):
+            if _use_pallas(tuple(q_.shape), k_.shape[1], q_.shape[-1]):
                 return flash_attention(q_, k_, v_, causal=causal, scale=scale)
-            s = scale if scale is not None else q_.shape[-1] ** -0.5
-            pos = jnp.arange(seq)
-            acc, m, l = _chunk_attention(q_, k_, v_, s, pos, pos, causal)
-            return jnp.swapaxes((acc / jnp.where(l == 0, 1, l)), 1, 2) \
-                .astype(q_.dtype)
+            return _xla_attention(q_, k_, v_, causal, scale)
 
     out = attention_fn(qg, kg, vg)
     return a2a_bwd(out)

@@ -1,2 +1,16 @@
 """paddle_tpu.incubate (reference: python/paddle/incubate/)."""
 from . import nn  # noqa: F401
+
+_LAZY = ("distributed",)
+
+
+def __getattr__(name):
+    # lazy: incubate.nn is imported during paddle_tpu.nn's own init, so
+    # eagerly importing incubate.distributed here would cycle back into nn
+    if name in _LAZY:
+        import importlib
+
+        mod = importlib.import_module(f".{name}", __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(name)

@@ -1,0 +1,6 @@
+from .gate import BaseGate, GShardGate, NaiveGate, SwitchGate
+from .grad_clip import ClipGradForMOEByGlobalNorm
+from .moe_layer import MoELayer
+
+__all__ = ["MoELayer", "BaseGate", "NaiveGate", "GShardGate", "SwitchGate",
+           "ClipGradForMOEByGlobalNorm"]

@@ -1,0 +1,53 @@
+"""MoE-aware global-norm gradient clipping (reference:
+python/paddle/incubate/distributed/models/moe/grad_clip.py
+ClipGradForMOEByGlobalNorm).
+
+Expert parameters live only on their EP rank, so the global norm must sum
+the *local* expert-grad norm-squares across the MoE group before combining
+with the (replicated) dense-param norm.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = ["ClipGradForMOEByGlobalNorm"]
+
+
+class ClipGradForMOEByGlobalNorm:
+    def __init__(self, clip_norm: float, is_expert_param_func=None,
+                 moe_group=None):
+        self.clip_norm = float(clip_norm)
+        self.moe_group = moe_group
+        self.is_expert_param = is_expert_param_func or (
+            lambda p: getattr(p, "no_sync", False))
+
+    def __call__(self, params_grads):
+        from paddle_tpu.distributed import collective as dist
+
+        normal_sq = 0.0
+        expert_sq = 0.0
+        for p, g in params_grads:
+            if g is None:
+                continue
+            s = float(jnp.sum(jnp.square(g._data.astype(jnp.float32))))
+            if self.is_expert_param(p):
+                expert_sq += s
+            else:
+                normal_sq += s
+        if self.moe_group is not None and self.moe_group.nranks > 1:
+            t = Tensor(jnp.asarray([expert_sq], dtype=jnp.float32))
+            dist.all_reduce(t, group=self.moe_group)
+            expert_sq = float(t._data[0])
+        global_norm = (normal_sq + expert_sq) ** 0.5
+        if global_norm <= self.clip_norm:
+            return params_grads
+        scale = self.clip_norm / (global_norm + 1e-6)
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+            else:
+                out.append((p, Tensor(g._data * scale)))
+        return out

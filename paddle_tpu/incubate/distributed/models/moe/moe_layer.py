@@ -1,0 +1,187 @@
+"""MoE layer with expert parallelism (reference surface:
+python/paddle/incubate/distributed/models/moe/moe_layer.py:263 MoELayer,
+MoEScatter:99 / MoEGather:149 over global_scatter/global_gather all-to-all).
+
+TPU-first design
+----------------
+The reference dispatches tokens with dynamic scatter positions and a
+variable-size ncclAllToAll. Dynamic shapes are hostile to XLA, so this
+implementation uses GShard fixed-capacity dispatch:
+
+  combine_weights [S, E, C] = gate output (S tokens, E global experts,
+  C capacity slots)
+  dispatch:  x_e[E, C, M] = einsum('sec,sm->ecm', dispatch_mask, x)
+  exchange:  all_to_all over the expert-parallel group on the E axis
+             (E = world_size * num_local_expert), so each rank holds
+             [world, local_E, C, M] -> its local experts' tokens
+  experts:   per-local-expert FFN (batched, MXU-friendly)
+  exchange back + combine: y = einsum('sec,ecm->sm', combine_weights, y_e)
+
+Everything is static-shape; under jit the all_to_all lowers to a single
+XLA AllToAll on the ICI mesh.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax.numpy as jnp
+
+import jax
+
+from paddle_tpu import nn
+from paddle_tpu.autograd import PyLayer
+from paddle_tpu.core.autograd import run_op
+from paddle_tpu.core.tensor import Tensor
+from .gate import BaseGate, GShardGate, NaiveGate, SwitchGate
+
+__all__ = ["MoELayer"]
+
+
+class _AllToAllOnAxis(PyLayer):
+    """Differentiable all_to_all on axis 0 over an EP group; the backward is
+    the inverse all_to_all (reference MoEScatter/MoEGather backward)."""
+
+    @staticmethod
+    def forward(ctx, x, group):
+        from paddle_tpu.distributed import collective as dist
+
+        ctx.group = group
+        world = group.nranks if group is not None else 1
+        if world <= 1:
+            return Tensor(x._data)
+        parts = [Tensor(p) for p in jnp.split(x._data, world, axis=0)]
+        outs: List[Tensor] = [Tensor(jnp.zeros_like(p._data)) for p in parts]
+        dist.all_to_all(outs, parts, group=group)
+        return Tensor(jnp.concatenate([o._data for o in outs], axis=0))
+
+    @staticmethod
+    def backward(ctx, dy):
+        from paddle_tpu.distributed import collective as dist
+
+        group = ctx.group
+        world = group.nranks if group is not None else 1
+        if world <= 1:
+            return Tensor(dy._data)
+        parts = [Tensor(p) for p in jnp.split(dy._data, world, axis=0)]
+        outs: List[Tensor] = [Tensor(jnp.zeros_like(p._data)) for p in parts]
+        dist.all_to_all(outs, parts, group=group)
+        return Tensor(jnp.concatenate([o._data for o in outs], axis=0))
+
+
+def _make_gate(gate, d_model, num_expert, world_size, top_k, group):
+    if isinstance(gate, BaseGate):
+        return gate
+    name = gate or "gshard"
+    if name == "naive":
+        return NaiveGate(d_model, num_expert, world_size, topk=top_k)
+    if name == "gshard":
+        return GShardGate(d_model, num_expert, world_size, topk=2, group=group)
+    if name == "switch":
+        return SwitchGate(d_model, num_expert, world_size, topk=1, group=group)
+    raise ValueError(f"unknown gate type {gate!r}")
+
+
+class MoELayer(nn.Layer):
+    """Mixture-of-experts layer (reference: moe_layer.py:263).
+
+    Args:
+        d_model: hidden size of tokens.
+        experts: list of expert Layers held on this rank (local experts).
+        gate: "gshard" | "switch" | "naive" | a BaseGate instance.
+        moe_group: expert-parallel communication group (tokens exchanged).
+        mp_group: tensor-parallel group experts are sharded over (optional;
+            grads of non-expert params are synced by the caller as usual).
+        top_k: number of experts per token (naive gate only; gshard=2,
+            switch=1).
+    """
+
+    def __init__(self, d_model: int, experts: List[nn.Layer],
+                 gate: str | BaseGate = "gshard", moe_group=None,
+                 mp_group=None, top_k: int = 2, **kwargs):
+        super().__init__()
+        self.d_model = d_model
+        self.group = moe_group
+        self.mp_group = mp_group
+        self.world_size = moe_group.nranks if moe_group is not None else 1
+        self.num_expert = len(experts)
+        self.experts = nn.LayerList(experts)
+        self.top_k = top_k
+        self.gate = _make_gate(gate, d_model, self.num_expert,
+                               self.world_size, top_k, moe_group)
+        # expert params must not be synced by DP/sharding wrappers
+        for e in self.experts:
+            for p in e.parameters():
+                p.no_sync = True
+
+    # ------------------------------------------------------------------
+    def _experts_fwd(self, xe: Tensor) -> Tensor:
+        """xe: [world*local_E, C, M] -> same shape; slot i%local_E runs
+        local expert i (each rank sees every peer's slice for its experts)."""
+        world, local_e = self.world_size, self.num_expert
+        outs = []
+        # reshape to [world, local_E, C, M]: per local expert, batch all
+        # ranks' capacity slots into one matmul (MXU-friendly)
+        c, m = xe.shape[1], xe.shape[2]
+        xr = run_op(lambda a: a.reshape(world, local_e, c, m), [xe],
+                    name="moe_reshape")
+        for ei in range(local_e):
+            xi = run_op(lambda a, ei=ei: a[:, ei].reshape(world * c, m), [xr],
+                        name="moe_slice")
+            yi = self.experts[ei](xi)
+            outs.append(run_op(lambda a: a.reshape(world, 1, c, m), [yi],
+                               name="moe_unslice"))
+        y = outs[0]
+        if local_e > 1:
+            y = run_op(lambda *parts: jnp.concatenate(parts, axis=1), outs,
+                       name="moe_concat")
+        return run_op(lambda a: a.reshape(world * local_e, c, m), [y],
+                      name="moe_flatten")
+
+    def forward(self, inp: Tensor) -> Tensor:
+        orig_shape = inp.shape
+        d = orig_shape[-1]
+        assert d == self.d_model
+        x = run_op(lambda a: a.reshape(-1, d), [inp], name="moe_flatten_in")
+
+        if isinstance(self.gate, NaiveGate):
+            return self._forward_naive(x, orig_shape)
+
+        cw, dm = self.gate(x, training=self.training)  # [S, E, C] each
+        # dispatch: [E, C, M]
+        xe = run_op(lambda m_, a: jnp.einsum("sec,sm->ecm", m_, a), [dm, x],
+                    name="moe_dispatch")
+        xe = _AllToAllOnAxis.apply(xe, self.group)
+        ye = self._experts_fwd(xe)
+        ye = _AllToAllOnAxis.apply(ye, self.group)
+        y = run_op(lambda w, a: jnp.einsum("sec,ecm->sm", w, a), [cw, ye],
+                   name="moe_combine")
+        return run_op(lambda a: a.reshape(orig_shape), [y],
+                      name="moe_reshape_out")
+
+    # ------------------------------------------------------------------
+    def _forward_naive(self, x: Tensor, orig_shape) -> Tensor:
+        """Naive top-k gate: soft-combine all experts' outputs with gate
+        weights built as dense one-hots (no capacity). Single-process only
+        (the reference NaiveGate path is likewise the no-EP debug path)."""
+        if self.world_size > 1:
+            raise NotImplementedError(
+                "gate='naive' does not support expert parallelism "
+                "(moe_group.nranks>1); use 'gshard' or 'switch'")
+        idx, val = self.gate(x)
+        probs = run_op(lambda v: jax.nn.softmax(v, axis=-1), [val],
+                       name="moe_naive_softmax")
+        E = self.world_size * self.num_expert
+        outs = [self.experts[e](x) for e in range(self.num_expert)]
+        stacked = run_op(lambda *o: jnp.stack(o, axis=1), outs,
+                         name="moe_naive_stack")  # [S, E, M]
+
+        def combine(p_, st, id_):
+            onehot = jnp.take_along_axis(
+                jnp.eye(E, dtype=st.dtype)[None], id_[..., None], axis=1)
+            w = jnp.einsum("sk,ske->se", p_, onehot)
+            return jnp.einsum("se,sem->sm", w, st)
+
+        y = run_op(lambda p_, st: combine(p_, st, idx._data), [probs, stacked],
+                   name="moe_naive_combine")
+        return run_op(lambda a: a.reshape(orig_shape), [y],
+                      name="moe_reshape_out")

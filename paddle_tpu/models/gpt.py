@@ -146,7 +146,7 @@ class GPTAttention(nn.Layer):
             out = F.scaled_dot_product_attention(
                 q, k, v, is_causal=s > 1 and past == 0,
                 attn_mask=_offset_causal_mask(s, past),
-                dropout_p=cfg.attention_dropout if self.training else 0.0,
+                dropout_p=dropout_p,
                 training=self.training)  # [b, s, heads, head_dim]
         out = out.reshape([b, s, cfg.num_heads * cfg.head_dim])
         out = self.out_proj(out)

@@ -25,7 +25,7 @@ class Parameter(Tensor):
     """Trainable tensor: ``stop_gradient=False`` by default."""
 
     __slots__ = ("trainable", "optimize_attr", "regularizer",
-                 "is_distributed", "sequence_parallel")
+                 "is_distributed", "sequence_parallel", "no_sync")
 
     def __init__(self, data, trainable: bool = True, name: Optional[str] = None):
         super().__init__(data, stop_gradient=not trainable, name=name)
@@ -34,6 +34,8 @@ class Parameter(Tensor):
         self.regularizer = None
         self.is_distributed = False
         self.sequence_parallel = False
+        # expert-parallel params are excluded from DP/sharding grad sync
+        self.no_sync = False
         self.persistable = True
 
     def __repr__(self):
