@@ -1,0 +1,53 @@
+// Allocator statistics: atomic per-device counters.
+// TPU-native equivalent of the reference memory stats layer
+// (paddle/phi/core/memory/stats.h — HostMemoryStat*/DeviceMemoryStat*).
+// Actual allocation is delegated to PJRT/XLA (SURVEY §2.4.3); this keeps
+// the stats/peak-tracking surface the Python `paddle_tpu.device` API reads.
+#include <atomic>
+#include <cstdint>
+
+namespace {
+
+constexpr int kMaxDevices = 64;
+
+struct DeviceStats {
+  std::atomic<int64_t> allocated{0};
+  std::atomic<int64_t> peak{0};
+  std::atomic<int64_t> alloc_count{0};
+};
+
+DeviceStats& stats(int dev) {
+  static DeviceStats s[kMaxDevices];
+  if (dev < 0 || dev >= kMaxDevices) dev = 0;
+  return s[dev];
+}
+
+}  // namespace
+
+extern "C" {
+
+void pt_stats_alloc(int dev, int64_t bytes) {
+  auto& s = stats(dev);
+  int64_t cur = s.allocated.fetch_add(bytes) + bytes;
+  s.alloc_count.fetch_add(1);
+  int64_t peak = s.peak.load();
+  while (cur > peak && !s.peak.compare_exchange_weak(peak, cur)) {
+  }
+}
+
+void pt_stats_free(int dev, int64_t bytes) {
+  stats(dev).allocated.fetch_sub(bytes);
+}
+
+int64_t pt_stats_allocated(int dev) { return stats(dev).allocated.load(); }
+int64_t pt_stats_peak(int dev) { return stats(dev).peak.load(); }
+int64_t pt_stats_alloc_count(int dev) {
+  return stats(dev).alloc_count.load();
+}
+
+void pt_stats_reset_peak(int dev) {
+  auto& s = stats(dev);
+  s.peak.store(s.allocated.load());
+}
+
+}  // extern "C"

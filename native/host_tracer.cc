@@ -1,0 +1,118 @@
+// Host tracer: native event collection + chrome-trace export.
+// TPU-native equivalent of the reference HostTracer/ChromeTracingLogger
+// (paddle/fluid/platform/profiler/host_tracer.cc,
+//  chrometracing_logger.cc). The Python profiler records RecordEvent spans
+// through this; device (TPU) spans from jax.profiler are merged Python-side.
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Event {
+  std::string name;
+  std::string cat;
+  int64_t start_ns;
+  int64_t dur_ns;
+  int64_t tid;
+};
+
+struct Tracer {
+  std::mutex mu;
+  std::vector<Event> events;
+  bool enabled = false;
+};
+
+Tracer& tracer() {
+  static Tracer t;
+  return t;
+}
+
+void JsonEscape(const std::string& in, std::string* out) {
+  for (char c : in) {
+    switch (c) {
+      case '"':
+        *out += "\\\"";
+        break;
+      case '\\':
+        *out += "\\\\";
+        break;
+      case '\n':
+        *out += "\\n";
+        break;
+      default:
+        if (static_cast<unsigned char>(c) >= 0x20) *out += c;
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void pt_trace_enable(int on) {
+  auto& t = tracer();
+  std::lock_guard<std::mutex> lk(t.mu);
+  t.enabled = on != 0;
+}
+
+int pt_trace_enabled() {
+  auto& t = tracer();
+  std::lock_guard<std::mutex> lk(t.mu);
+  return t.enabled ? 1 : 0;
+}
+
+void pt_trace_event(const char* name, const char* cat, int64_t start_ns,
+                    int64_t dur_ns, int64_t tid) {
+  auto& t = tracer();
+  std::lock_guard<std::mutex> lk(t.mu);
+  if (!t.enabled) return;
+  t.events.push_back(Event{name, cat ? cat : "op", start_ns, dur_ns, tid});
+}
+
+int64_t pt_trace_count() {
+  auto& t = tracer();
+  std::lock_guard<std::mutex> lk(t.mu);
+  return static_cast<int64_t>(t.events.size());
+}
+
+void pt_trace_clear() {
+  auto& t = tracer();
+  std::lock_guard<std::mutex> lk(t.mu);
+  t.events.clear();
+}
+
+// Chrome trace "X" (complete) events; timestamps in microseconds.
+int pt_trace_dump_json(const char* path, int pid) {
+  auto& t = tracer();
+  std::vector<Event> snapshot;
+  {
+    std::lock_guard<std::mutex> lk(t.mu);
+    snapshot = t.events;
+  }
+  FILE* f = std::fopen(path, "w");
+  if (!f) return -1;
+  std::fputs("{\"traceEvents\":[\n", f);
+  bool first = true;
+  for (const auto& e : snapshot) {
+    std::string name, cat;
+    JsonEscape(e.name, &name);
+    JsonEscape(e.cat, &cat);
+    if (!first) std::fputs(",\n", f);
+    first = false;
+    std::fprintf(f,
+                 "{\"ph\":\"X\",\"name\":\"%s\",\"cat\":\"%s\",\"pid\":%d,"
+                 "\"tid\":%lld,\"ts\":%.3f,\"dur\":%.3f}",
+                 name.c_str(), cat.c_str(), pid,
+                 static_cast<long long>(e.tid), e.start_ns / 1e3,
+                 e.dur_ns / 1e3);
+  }
+  std::fputs("\n]}\n", f);
+  std::fclose(f);
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,225 @@
+// Shared-memory ring buffer: worker->parent sample transport for the
+// multi-process DataLoader. TPU-native equivalent of the reference's
+// shared-memory DataLoader path (python/paddle/io/dataloader/worker.py
+// _worker_loop + paddle/fluid/memory/allocation/mmap_allocator.cc): numpy
+// batches move as raw bytes through POSIX shm instead of being pickled
+// through a multiprocessing.Queue pipe.
+//
+// Layout: [Header | data region]; single-producer/single-consumer per ring
+// (the DataLoader opens one ring per worker). Process-shared mutex+condvar
+// live in the header. Messages are length-prefixed and may wrap.
+#include <fcntl.h>
+#include <pthread.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <ctime>
+#include <new>
+#include <string>
+
+namespace {
+
+struct Header {
+  pthread_mutex_t mu;
+  pthread_cond_t not_empty;
+  pthread_cond_t not_full;
+  uint64_t capacity;  // data region size
+  uint64_t head;      // read offset
+  uint64_t tail;      // write offset
+  uint64_t used;      // bytes in ring
+  int32_t closed;
+};
+
+struct Ring {
+  Header* hdr;
+  char* data;
+  uint64_t map_size;
+  std::string name;
+  bool owner;
+};
+
+void CopyIn(Ring* r, const char* src, uint64_t len) {
+  uint64_t cap = r->hdr->capacity;
+  uint64_t tail = r->hdr->tail;
+  uint64_t first = len < cap - tail ? len : cap - tail;
+  std::memcpy(r->data + tail, src, first);
+  if (len > first) std::memcpy(r->data, src + first, len - first);
+  r->hdr->tail = (tail + len) % cap;
+  r->hdr->used += len;
+}
+
+void CopyOut(Ring* r, char* dst, uint64_t len) {
+  uint64_t cap = r->hdr->capacity;
+  uint64_t head = r->hdr->head;
+  uint64_t first = len < cap - head ? len : cap - head;
+  std::memcpy(dst, r->data + head, first);
+  if (len > first) std::memcpy(dst + first, r->data, len - first);
+  r->hdr->head = (head + len) % cap;
+  r->hdr->used -= len;
+}
+
+timespec DeadlineFromMs(int64_t timeout_ms) {
+  timespec ts;
+  clock_gettime(CLOCK_REALTIME, &ts);
+  ts.tv_sec += timeout_ms / 1000;
+  ts.tv_nsec += (timeout_ms % 1000) * 1000000L;
+  if (ts.tv_nsec >= 1000000000L) {
+    ts.tv_sec += 1;
+    ts.tv_nsec -= 1000000000L;
+  }
+  return ts;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* pt_ring_create(const char* name, uint64_t capacity) {
+  ::shm_unlink(name);
+  int fd = ::shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  uint64_t map_size = sizeof(Header) + capacity;
+  if (::ftruncate(fd, static_cast<off_t>(map_size)) != 0) {
+    ::close(fd);
+    ::shm_unlink(name);
+    return nullptr;
+  }
+  void* mem =
+      ::mmap(nullptr, map_size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  ::close(fd);
+  if (mem == MAP_FAILED) {
+    ::shm_unlink(name);
+    return nullptr;
+  }
+  auto* hdr = new (mem) Header();
+  pthread_mutexattr_t ma;
+  pthread_mutexattr_init(&ma);
+  pthread_mutexattr_setpshared(&ma, PTHREAD_PROCESS_SHARED);
+  pthread_mutexattr_setrobust(&ma, PTHREAD_MUTEX_ROBUST);
+  pthread_mutex_init(&hdr->mu, &ma);
+  pthread_condattr_t ca;
+  pthread_condattr_init(&ca);
+  pthread_condattr_setpshared(&ca, PTHREAD_PROCESS_SHARED);
+  pthread_cond_init(&hdr->not_empty, &ca);
+  pthread_cond_init(&hdr->not_full, &ca);
+  hdr->capacity = capacity;
+  hdr->head = hdr->tail = hdr->used = 0;
+  hdr->closed = 0;
+  auto* r = new Ring{hdr, static_cast<char*>(mem) + sizeof(Header), map_size,
+                     name, true};
+  return r;
+}
+
+void* pt_ring_open(const char* name) {
+  int fd = ::shm_open(name, O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (::fstat(fd, &st) != 0) {
+    ::close(fd);
+    return nullptr;
+  }
+  void* mem = ::mmap(nullptr, static_cast<uint64_t>(st.st_size),
+                     PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  ::close(fd);
+  if (mem == MAP_FAILED) return nullptr;
+  auto* hdr = static_cast<Header*>(mem);
+  auto* r = new Ring{hdr, static_cast<char*>(mem) + sizeof(Header),
+                     static_cast<uint64_t>(st.st_size), name, false};
+  return r;
+}
+
+static int LockRobust(Header* hdr) {
+  int rc = pthread_mutex_lock(&hdr->mu);
+  if (rc == EOWNERDEAD) {
+    pthread_mutex_consistent(&hdr->mu);
+    rc = 0;
+  }
+  return rc;
+}
+
+// 0 ok, -1 timeout, -2 closed, -3 message larger than capacity
+int pt_ring_push(void* h, const char* buf, uint64_t len, int64_t timeout_ms) {
+  auto* r = static_cast<Ring*>(h);
+  Header* hdr = r->hdr;
+  uint64_t need = len + 8;
+  if (need > hdr->capacity) return -3;
+  timespec deadline = DeadlineFromMs(timeout_ms);
+  if (LockRobust(hdr) != 0) return -2;
+  while (hdr->capacity - hdr->used < need && !hdr->closed) {
+    if (pthread_cond_timedwait(&hdr->not_full, &hdr->mu, &deadline) ==
+        ETIMEDOUT) {
+      pthread_mutex_unlock(&hdr->mu);
+      return -1;
+    }
+  }
+  if (hdr->closed) {
+    pthread_mutex_unlock(&hdr->mu);
+    return -2;
+  }
+  uint64_t lenle = len;
+  CopyIn(r, reinterpret_cast<const char*>(&lenle), 8);
+  CopyIn(r, buf, len);
+  pthread_cond_signal(&hdr->not_empty);
+  pthread_mutex_unlock(&hdr->mu);
+  return 0;
+}
+
+// Returns size, or -1 timeout, -2 closed+empty. Two-phase: peek size with
+// *buf=null (ring unchanged), then call again with a buffer >= size.
+int64_t pt_ring_pop(void* h, char* buf, uint64_t buf_len, int64_t timeout_ms) {
+  auto* r = static_cast<Ring*>(h);
+  Header* hdr = r->hdr;
+  timespec deadline = DeadlineFromMs(timeout_ms);
+  if (LockRobust(hdr) != 0) return -2;
+  while (hdr->used < 8 && !hdr->closed) {
+    if (pthread_cond_timedwait(&hdr->not_empty, &hdr->mu, &deadline) ==
+        ETIMEDOUT) {
+      pthread_mutex_unlock(&hdr->mu);
+      return -1;
+    }
+  }
+  if (hdr->used < 8) {  // closed and drained
+    pthread_mutex_unlock(&hdr->mu);
+    return -2;
+  }
+  // peek length without consuming
+  uint64_t cap = hdr->capacity, head = hdr->head;
+  uint64_t msg_len;
+  char lenbuf[8];
+  uint64_t first = 8 < cap - head ? 8 : cap - head;
+  std::memcpy(lenbuf, r->data + head, first);
+  if (8 > first) std::memcpy(lenbuf + first, r->data, 8 - first);
+  std::memcpy(&msg_len, lenbuf, 8);
+  if (buf == nullptr || buf_len < msg_len) {
+    pthread_mutex_unlock(&hdr->mu);
+    return static_cast<int64_t>(msg_len);
+  }
+  char discard[8];
+  CopyOut(r, discard, 8);
+  CopyOut(r, buf, msg_len);
+  pthread_cond_signal(&hdr->not_full);
+  pthread_mutex_unlock(&hdr->mu);
+  return static_cast<int64_t>(msg_len);
+}
+
+void pt_ring_close(void* h) {
+  auto* r = static_cast<Ring*>(h);
+  LockRobust(r->hdr);
+  r->hdr->closed = 1;
+  pthread_cond_broadcast(&r->hdr->not_empty);
+  pthread_cond_broadcast(&r->hdr->not_full);
+  pthread_mutex_unlock(&r->hdr->mu);
+}
+
+void pt_ring_free(void* h) {
+  auto* r = static_cast<Ring*>(h);
+  ::munmap(r->hdr, r->map_size);
+  if (r->owner) ::shm_unlink(r->name.c_str());
+  delete r;
+}
+
+}  // extern "C"

@@ -1,0 +1,373 @@
+// TCPStore: native rendezvous KV daemon + client.
+// TPU-native equivalent of the reference MasterDaemon/TCPStore
+// (paddle/phi/core/distributed/store/tcp_store.h:45, tcp_store.cc) — kept as
+// a pure-socket component (SURVEY §2.4.10). Wire protocol matches
+// paddle_tpu/distributed/store.py exactly, so C++ daemon <-> Python client
+// (and vice versa) interoperate:
+//   [1B op][4B key_len BE][key][8B value_len BE][value]
+//   ops: SET=0 GET=1 ADD=2 WAIT=3 CHECK=4
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+enum Op : uint8_t { kSet = 0, kGet = 1, kAdd = 2, kWait = 3, kCheck = 4 };
+
+uint64_t ntoh64(uint64_t v) {
+  uint32_t hi = ntohl(static_cast<uint32_t>(v & 0xffffffffULL));
+  uint32_t lo = ntohl(static_cast<uint32_t>(v >> 32));
+  return (static_cast<uint64_t>(hi) << 32) | lo;
+}
+uint64_t hton64(uint64_t v) { return ntoh64(v); }
+
+bool RecvExact(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool SendAll(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool SendFrame(int fd, uint8_t op, const std::string& key,
+               const std::string& value) {
+  std::vector<char> hdr(5);
+  hdr[0] = static_cast<char>(op);
+  uint32_t klen = htonl(static_cast<uint32_t>(key.size()));
+  std::memcpy(hdr.data() + 1, &klen, 4);
+  if (!SendAll(fd, hdr.data(), 5)) return false;
+  if (!key.empty() && !SendAll(fd, key.data(), key.size())) return false;
+  uint64_t vlen = hton64(value.size());
+  if (!SendAll(fd, &vlen, 8)) return false;
+  if (!value.empty() && !SendAll(fd, value.data(), value.size())) return false;
+  return true;
+}
+
+bool RecvFrame(int fd, uint8_t* op, std::string* key, std::string* value) {
+  char hdr[5];
+  if (!RecvExact(fd, hdr, 5)) return false;
+  *op = static_cast<uint8_t>(hdr[0]);
+  uint32_t klen;
+  std::memcpy(&klen, hdr + 1, 4);
+  klen = ntohl(klen);
+  key->resize(klen);
+  if (klen && !RecvExact(fd, key->data(), klen)) return false;
+  uint64_t vlen;
+  if (!RecvExact(fd, &vlen, 8)) return false;
+  vlen = ntoh64(vlen);
+  value->resize(vlen);
+  if (vlen && !RecvExact(fd, value->data(), vlen)) return false;
+  return true;
+}
+
+class MasterDaemon {
+ public:
+  explicit MasterDaemon(int port) {
+    listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    int one = 1;
+    ::setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = INADDR_ANY;
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (::bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) !=
+        0) {
+      ::close(listen_fd_);
+      listen_fd_ = -1;
+      return;
+    }
+    socklen_t len = sizeof(addr);
+    ::getsockname(listen_fd_, reinterpret_cast<sockaddr*>(&addr), &len);
+    port_ = ntohs(addr.sin_port);
+    ::listen(listen_fd_, 128);
+    accept_thread_ = std::thread([this] { AcceptLoop(); });
+  }
+
+  ~MasterDaemon() { Stop(); }
+
+  void Stop() {
+    bool expected = false;
+    if (!stopped_.compare_exchange_strong(expected, true)) return;
+    ::shutdown(listen_fd_, SHUT_RDWR);
+    ::close(listen_fd_);
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+    }
+    cv_.notify_all();  // release WAIT handlers
+    if (accept_thread_.joinable()) accept_thread_.join();
+    std::lock_guard<std::mutex> lk(threads_mu_);
+    // unblock workers sitting in recv() on live client connections
+    for (int fd : client_fds_) ::shutdown(fd, SHUT_RDWR);
+    for (auto& t : workers_)
+      if (t.joinable()) t.join();
+  }
+
+  int port() const { return port_; }
+  bool ok() const { return listen_fd_ >= 0; }
+
+ private:
+  void AcceptLoop() {
+    while (!stopped_.load()) {
+      int fd = ::accept(listen_fd_, nullptr, nullptr);
+      if (fd < 0) break;
+      int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      std::lock_guard<std::mutex> lk(threads_mu_);
+      client_fds_.push_back(fd);
+      workers_.emplace_back([this, fd] { Serve(fd); });
+    }
+  }
+
+  void Serve(int fd) {
+    uint8_t op;
+    std::string key, value;
+    while (!stopped_.load() && RecvFrame(fd, &op, &key, &value)) {
+      switch (op) {
+        case kSet: {
+          {
+            std::lock_guard<std::mutex> lk(mu_);
+            kv_[key] = value;
+          }
+          cv_.notify_all();
+          SendFrame(fd, op, "", "ok");
+          break;
+        }
+        case kGet: {
+          std::string v;
+          {
+            std::lock_guard<std::mutex> lk(mu_);
+            auto it = kv_.find(key);
+            if (it != kv_.end()) v = it->second;
+          }
+          SendFrame(fd, op, "", v);
+          break;
+        }
+        case kAdd: {
+          int64_t delta = 0;
+          uint64_t be;
+          std::memcpy(&be, value.data(), 8);
+          delta = static_cast<int64_t>(ntoh64(be));
+          int64_t cur;
+          {
+            std::lock_guard<std::mutex> lk(mu_);
+            auto it = kv_.find(key);
+            cur = it == kv_.end() ? 0 : std::stoll(it->second);
+            cur += delta;
+            kv_[key] = std::to_string(cur);
+          }
+          cv_.notify_all();
+          uint64_t out = hton64(static_cast<uint64_t>(cur));
+          SendFrame(fd, op, "", std::string(reinterpret_cast<char*>(&out), 8));
+          break;
+        }
+        case kWait: {
+          uint64_t be;
+          std::memcpy(&be, value.data(), 8);
+          int64_t timeout_ms = static_cast<int64_t>(ntoh64(be));
+          auto deadline = std::chrono::steady_clock::now() +
+                          std::chrono::milliseconds(timeout_ms);
+          bool ok;
+          {
+            std::unique_lock<std::mutex> lk(mu_);
+            ok = cv_.wait_until(lk, deadline, [this, &key] {
+              return kv_.count(key) > 0 || stopped_.load();
+            });
+            ok = ok && kv_.count(key) > 0;
+          }
+          SendFrame(fd, op, "", ok ? "1" : "0");
+          break;
+        }
+        case kCheck: {
+          bool ok;
+          {
+            std::lock_guard<std::mutex> lk(mu_);
+            ok = kv_.count(key) > 0;
+          }
+          SendFrame(fd, op, "", ok ? "1" : "0");
+          break;
+        }
+        default:
+          ::close(fd);
+          return;
+      }
+    }
+    ::close(fd);
+  }
+
+  int listen_fd_ = -1;
+  int port_ = 0;
+  std::atomic<bool> stopped_{false};
+  std::thread accept_thread_;
+  std::mutex threads_mu_;
+  std::vector<std::thread> workers_;
+  std::vector<int> client_fds_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::map<std::string, std::string> kv_;
+};
+
+class StoreClient {
+ public:
+  StoreClient(const char* host, int port, int timeout_ms) {
+    auto deadline = std::chrono::steady_clock::now() +
+                    std::chrono::milliseconds(timeout_ms);
+    while (std::chrono::steady_clock::now() < deadline) {
+      fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+      sockaddr_in addr{};
+      addr.sin_family = AF_INET;
+      addr.sin_port = htons(static_cast<uint16_t>(port));
+      ::inet_pton(AF_INET, host, &addr.sin_addr);
+      if (::connect(fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) ==
+          0) {
+        int one = 1;
+        ::setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        return;
+      }
+      ::close(fd_);
+      fd_ = -1;
+      std::this_thread::sleep_for(std::chrono::milliseconds(50));
+    }
+  }
+  ~StoreClient() {
+    if (fd_ >= 0) ::close(fd_);
+  }
+  bool ok() const { return fd_ >= 0; }
+
+  bool Set(const std::string& key, const std::string& value) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (!SendFrame(fd_, kSet, key, value)) return false;
+    uint8_t op;
+    std::string k, v;
+    return RecvFrame(fd_, &op, &k, &v);
+  }
+  bool Get(const std::string& key, std::string* out) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (!SendFrame(fd_, kGet, key, "")) return false;
+    uint8_t op;
+    std::string k;
+    return RecvFrame(fd_, &op, &k, out);
+  }
+  bool Add(const std::string& key, int64_t delta, int64_t* out) {
+    std::lock_guard<std::mutex> lk(mu_);
+    uint64_t be = hton64(static_cast<uint64_t>(delta));
+    if (!SendFrame(fd_, kAdd, key,
+                   std::string(reinterpret_cast<char*>(&be), 8)))
+      return false;
+    uint8_t op;
+    std::string k, v;
+    if (!RecvFrame(fd_, &op, &k, &v) || v.size() != 8) return false;
+    uint64_t rbe;
+    std::memcpy(&rbe, v.data(), 8);
+    *out = static_cast<int64_t>(ntoh64(rbe));
+    return true;
+  }
+  // 1 = key present, 0 = timeout, -1 = connection error
+  int Wait(const std::string& key, int64_t timeout_ms) {
+    std::lock_guard<std::mutex> lk(mu_);
+    uint64_t be = hton64(static_cast<uint64_t>(timeout_ms));
+    if (!SendFrame(fd_, kWait, key,
+                   std::string(reinterpret_cast<char*>(&be), 8)))
+      return -1;
+    uint8_t op;
+    std::string k, v;
+    if (!RecvFrame(fd_, &op, &k, &v)) return -1;
+    return v == "1" ? 1 : 0;
+  }
+  int Check(const std::string& key) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (!SendFrame(fd_, kCheck, key, "")) return -1;
+    uint8_t op;
+    std::string k, v;
+    if (!RecvFrame(fd_, &op, &k, &v)) return -1;
+    return v == "1" ? 1 : 0;
+  }
+
+ private:
+  int fd_ = -1;
+  std::mutex mu_;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* pt_store_server_start(int port) {
+  auto* d = new MasterDaemon(port);
+  if (!d->ok()) {
+    delete d;
+    return nullptr;
+  }
+  return d;
+}
+int pt_store_server_port(void* h) {
+  return static_cast<MasterDaemon*>(h)->port();
+}
+void pt_store_server_stop(void* h) {
+  auto* d = static_cast<MasterDaemon*>(h);
+  d->Stop();
+  delete d;
+}
+
+void* pt_store_client_connect(const char* host, int port, int timeout_ms) {
+  auto* c = new StoreClient(host, port, timeout_ms);
+  if (!c->ok()) {
+    delete c;
+    return nullptr;
+  }
+  return c;
+}
+void pt_store_client_close(void* h) { delete static_cast<StoreClient*>(h); }
+
+int pt_store_set(void* h, const char* key, const char* val, int64_t vlen) {
+  return static_cast<StoreClient*>(h)->Set(key, std::string(val, vlen)) ? 0
+                                                                        : -1;
+}
+// Returns malloc'd buffer in *out (caller frees with pt_free); len in
+// *out_len.
+int pt_store_get(void* h, const char* key, char** out, int64_t* out_len) {
+  std::string v;
+  if (!static_cast<StoreClient*>(h)->Get(key, &v)) return -1;
+  *out = static_cast<char*>(::malloc(v.size()));
+  std::memcpy(*out, v.data(), v.size());
+  *out_len = static_cast<int64_t>(v.size());
+  return 0;
+}
+int pt_store_add(void* h, const char* key, int64_t delta, int64_t* out) {
+  return static_cast<StoreClient*>(h)->Add(key, delta, out) ? 0 : -1;
+}
+int pt_store_wait(void* h, const char* key, int64_t timeout_ms) {
+  return static_cast<StoreClient*>(h)->Wait(key, timeout_ms);
+}
+int pt_store_check(void* h, const char* key) {
+  return static_cast<StoreClient*>(h)->Check(key);
+}
+void pt_free(void* p) { ::free(p); }
+
+}  // extern "C"

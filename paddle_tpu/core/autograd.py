@@ -120,13 +120,37 @@ class GradNode:
         return f"<GradNode {self.name} n_in={len(self.inputs)} n_out={len(self.out_meta)}>"
 
 
+_profiler_mod = None  # bound on first run_op call (avoids init-order cycle)
+
+
 def run_op(fn: Callable, tensors: Sequence, name: str = "op", n_outputs: Optional[int] = None):
     """Execute pure jax function ``fn`` over Tensor inputs, recording the tape.
 
     ``fn(*arrays) -> array | tuple[array]``. Returns Tensor or tuple of Tensors.
     Inputs with ``stop_gradient=True`` are treated as constants.
     """
-    from .tensor import Tensor  # late import, avoids cycle
+    # host-tracer span per op when a profiler window is recording (analog of
+    # the RecordEvent emitted by every generated AD func, eager_gen.py:1312);
+    # the hot no-profiler path costs one global read + None check
+    global _profiler_mod
+    if _profiler_mod is None:
+        import paddle_tpu.profiler
+
+        _profiler_mod = paddle_tpu.profiler
+    _col = _profiler_mod._active_collector
+    if _col is not None:
+        import time as _time
+
+        _t0 = _time.perf_counter_ns()
+        try:
+            return _run_op_impl(fn, tensors, name)
+        finally:
+            _col.record(name, "op", _t0, _time.perf_counter_ns() - _t0)
+    return _run_op_impl(fn, tensors, name)
+
+
+def _run_op_impl(fn: Callable, tensors: Sequence, name: str = "op"):
+    from .tensor import Tensor
 
     arrays = [t._data if isinstance(t, Tensor) else t for t in tensors]
 

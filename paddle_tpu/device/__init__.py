@@ -87,7 +87,11 @@ class _CudaNamespace:
             stats = devs[0].memory_stats()
             return stats.get("peak_bytes_in_use", 0)
         except Exception:
-            return 0
+            # CPU / backends without PJRT memory_stats: native counters
+            # (native/alloc_stats.cc, analog of phi/core/memory/stats.h)
+            from ..core import native as _native
+
+            return _native.stats_peak(0)
 
     @staticmethod
     def memory_allocated(device=None):
@@ -96,7 +100,9 @@ class _CudaNamespace:
             stats = devs[0].memory_stats()
             return stats.get("bytes_in_use", 0)
         except Exception:
-            return 0
+            from ..core import native as _native
+
+            return _native.stats_allocated(0)
 
     @staticmethod
     def empty_cache():

@@ -124,17 +124,33 @@ class MasterDaemon(threading.Thread):
 
 class TCPStore:
     """Client (rank 0 also hosts the daemon).
-    (reference: phi/core/distributed/store/tcp_store.h TCPStore)"""
+    (reference: phi/core/distributed/store/tcp_store.h TCPStore)
+
+    Uses the native C++ daemon/client (native/tcp_store.cc via
+    core.native) when the shared library is available; the wire protocol
+    is identical, so native and Python endpoints interoperate. Set
+    PADDLE_TPU_PURE_PY_STORE=1 to force the Python implementation."""
 
     def __init__(self, host: str, port: int, is_master: bool = False,
                  world_size: int = 1, timeout: float = 900.0):
+        from ..core import native as _native
+
+        self._native = (_native.available()
+                        and not os.environ.get("PADDLE_TPU_PURE_PY_STORE"))
         self._daemon = None
         if is_master:
-            self._daemon = MasterDaemon(port, world_size)
+            if self._native:
+                self._daemon = _native.NativeStoreServer(port)
+            else:
+                self._daemon = MasterDaemon(port, world_size)
             port = self._daemon.port
         self._host = host
         self._port = port
         self._timeout = timeout
+        if self._native:
+            # thread safety lives in the C++ StoreClient's own mutex
+            self._client = _native.NativeStoreClient(host, port, timeout)
+            return
         deadline = time.time() + timeout
         last_err = None
         while time.time() < deadline:
@@ -157,18 +173,25 @@ class TCPStore:
     def set(self, key: str, value) -> None:
         if isinstance(value, str):
             value = value.encode()
+        if self._native:
+            self._client.set(key.encode(), bytes(value))
+            return
         with self._lock:
             _send_frame(self._sock, _OP_SET, key.encode(), bytes(value))
             _recv_frame(self._sock)
 
     def get(self, key: str) -> bytes:
         self.wait([key])
+        if self._native:
+            return self._client.get(key.encode())
         with self._lock:
             _send_frame(self._sock, _OP_GET, key.encode(), b"")
             _, _, v = _recv_frame(self._sock)
         return v
 
     def add(self, key: str, delta: int) -> int:
+        if self._native:
+            return self._client.add(key.encode(), delta)
         with self._lock:
             _send_frame(self._sock, _OP_ADD, key.encode(),
                         struct.pack(">q", delta))
@@ -178,6 +201,11 @@ class TCPStore:
     def wait(self, keys, timeout: Optional[float] = None) -> None:
         timeout = timeout if timeout is not None else self._timeout
         for key in keys:
+            if self._native:
+                if not self._client.wait(key.encode(), int(timeout * 1000)):
+                    raise TimeoutError(
+                        f"TCPStore wait timed out on key {key!r}")
+                continue
             with self._lock:
                 _send_frame(self._sock, _OP_WAIT, key.encode(),
                             struct.pack(">q", int(timeout * 1000)))
@@ -186,6 +214,8 @@ class TCPStore:
                 raise TimeoutError(f"TCPStore wait timed out on key {key!r}")
 
     def check(self, key: str) -> bool:
+        if self._native:
+            return self._client.check(key.encode())
         with self._lock:
             _send_frame(self._sock, _OP_CHECK, key.encode(), b"")
             _, _, v = _recv_frame(self._sock)

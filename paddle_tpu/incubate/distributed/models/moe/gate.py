@@ -114,10 +114,10 @@ class GShardGate(BaseGate):
             g2 = jnp.sum(gates_wo1 * mask2, axis=-1)
 
             if rand_route:
-                # reference gshard_gate.py random routing: keep the second
-                # expert only with probability g2/(2*g1-ish) — tokens whose
+                # reference gshard_gate.py _random_routing: keep the second
+                # expert with probability min(1, 2*g2) — tokens whose
                 # second-choice weight is small skip the extra dispatch
-                keep = jax.random.uniform(key, (S,)) * g1 * 2.0 < g2
+                keep = jax.random.uniform(key, (S,)) < 2.0 * g2
                 mask2 = mask2 * keep[:, None].astype(mask2.dtype)
 
             aux = _load_balance_loss(gates, mask1)

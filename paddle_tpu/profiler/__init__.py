@@ -1,0 +1,302 @@
+"""Profiler (reference: python/paddle/profiler/profiler.py:89 Profiler with
+CLOSED/READY/RECORD/RECORD_AND_RETURN states, scheduler windows,
+export_chrome_tracing:227; C++ host tracer fluid/platform/profiler/).
+
+Host spans are collected by the native tracer (native/host_tracer.cc) when
+available (pure-Python ring otherwise) and exported as chrome://tracing
+JSON. On TPU, ``ProfilerTarget.TPU`` additionally drives
+``jax.profiler.start_trace`` so XLA/device (xplane) traces land next to the
+host trace — the TPU analog of the reference's CUPTI tracer merge.
+"""
+from __future__ import annotations
+
+import enum
+import os
+import threading
+import time
+from typing import Callable, Iterable, Optional
+
+from .timer import benchmark  # noqa: F401
+from .utils import RecordEvent, load_profiler_result  # noqa: F401
+
+__all__ = ["Profiler", "ProfilerState", "ProfilerTarget", "make_scheduler",
+           "export_chrome_tracing", "RecordEvent", "benchmark",
+           "load_profiler_result"]
+
+
+class ProfilerState(enum.Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3  # record + hand result to on_trace_ready
+
+
+class ProfilerTarget(enum.Enum):
+    CPU = 0
+    GPU = 1   # accepted for API parity; maps to the accelerator
+    TPU = 2
+    CUSTOM_DEVICE = 3
+
+
+def make_scheduler(*, closed: int, ready: int, record: int, repeat: int = 0,
+                   skip_first: int = 0) -> Callable[[int], ProfilerState]:
+    """Window scheduler (reference: profiler.py make_scheduler): per cycle
+    `closed` steps CLOSED, `ready` READY, `record` RECORD (last one
+    RECORD_AND_RETURN); `repeat` cycles (0 = forever) after `skip_first`."""
+    cycle = closed + ready + record
+
+    def fn(step: int) -> ProfilerState:
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        if repeat and s >= repeat * cycle:
+            return ProfilerState.CLOSED
+        pos = s % cycle
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == cycle - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return fn
+
+
+def _default_state_fn(step: int) -> ProfilerState:
+    return ProfilerState.RECORD  # profile everything between start and stop
+
+
+def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
+    """on_trace_ready factory (reference: profiler.py:227)."""
+    os.makedirs(dir_name, exist_ok=True)
+
+    def handler(prof: "Profiler"):
+        name = worker_name or f"host_{os.getpid()}"
+        path = os.path.join(
+            dir_name, f"{name}_time_{int(time.time() * 1000)}"
+            ".paddle_trace.json")
+        prof._export(path)
+        prof._last_export_path = path
+
+    return handler
+
+
+def export_protobuf(dir_name: str, worker_name: Optional[str] = None):
+    # chrome-trace JSON is the interchange format here; protobuf alias kept
+    # for reference API parity
+    return export_chrome_tracing(dir_name, worker_name)
+
+
+class _HostEventCollector:
+    """Sink for RecordEvent spans; prefers the native tracer."""
+
+    def __init__(self):
+        from ..core import native
+
+        self._native = native.available()
+        self._py_events = []
+        self._lock = threading.Lock()
+
+    def start(self):
+        from ..core import native
+
+        if self._native:
+            native.trace_clear()
+            native.trace_enable(True)
+        self._py_events = []
+        _set_active_collector(self)
+
+    def stop(self):
+        from ..core import native
+
+        if self._native:
+            native.trace_enable(False)
+        _set_active_collector(None)
+
+    def record(self, name: str, cat: str, start_ns: int, dur_ns: int):
+        from ..core import native
+
+        if self._native:
+            native.trace_event(name, cat, start_ns, dur_ns,
+                               threading.get_ident() % (1 << 31))
+        else:
+            with self._lock:
+                self._py_events.append(
+                    (name, cat, start_ns, dur_ns,
+                     threading.get_ident() % (1 << 31)))
+
+    def events(self):
+        from ..core import native
+
+        if self._native:
+            return None  # native side holds them; use dump
+        return list(self._py_events)
+
+    def dump(self, path: str):
+        from ..core import native
+
+        if self._native:
+            return native.trace_dump_json(path, os.getpid())
+        import json
+
+        evs = [{"ph": "X", "name": n, "cat": c, "pid": os.getpid(),
+                "tid": t, "ts": s / 1e3, "dur": d / 1e3}
+               for (n, c, s, d, t) in self._py_events]
+        with open(path, "w") as f:
+            json.dump({"traceEvents": evs}, f)
+        return True
+
+
+_active_collector: Optional[_HostEventCollector] = None
+
+
+def _set_active_collector(c):
+    global _active_collector
+    _active_collector = c
+
+
+def get_active_collector():
+    return _active_collector
+
+
+class Profiler:
+    """reference: python/paddle/profiler/profiler.py:89."""
+
+    def __init__(self, *, targets: Optional[Iterable[ProfilerTarget]] = None,
+                 scheduler=None, on_trace_ready=None, timer_only: bool = False,
+                 record_shapes: bool = False, profile_memory: bool = False,
+                 with_flops: bool = False):
+        self.targets = list(targets) if targets else [ProfilerTarget.CPU]
+        if isinstance(scheduler, tuple):
+            start, end = scheduler
+            scheduler = make_scheduler(closed=start, ready=0,
+                                       record=end - start, repeat=1)
+        self.scheduler = scheduler or _default_state_fn
+        self.on_trace_ready = on_trace_ready
+        self.timer_only = timer_only
+        self.record_shapes = record_shapes
+        self.profile_memory = profile_memory
+        self.step_num = 0
+        self.current_state = ProfilerState.CLOSED
+        self._collector = _HostEventCollector()
+        self._device_tracing = False
+        self._last_export_path = None
+        self._summary_records = []
+
+    # ------------------------------------------------------------ lifecycle
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    def start(self):
+        self.current_state = self.scheduler(self.step_num)
+        if self.timer_only:
+            benchmark().begin()
+            return
+        if self.current_state in (ProfilerState.RECORD,
+                                  ProfilerState.RECORD_AND_RETURN):
+            self._start_record()
+        benchmark().begin()
+
+    def stop(self):
+        benchmark().end()
+        if self.timer_only:
+            return
+        if self.current_state in (ProfilerState.RECORD,
+                                  ProfilerState.RECORD_AND_RETURN):
+            self._stop_record()
+            if self.on_trace_ready:
+                self.on_trace_ready(self)
+
+    def step(self, num_samples: Optional[int] = None):
+        benchmark().step(num_samples)
+        if self.timer_only:
+            self.step_num += 1
+            return
+        prev = self.current_state
+        self.step_num += 1
+        self.current_state = self.scheduler(self.step_num)
+        recording = (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN)
+        if prev in recording and self.current_state not in recording:
+            self._stop_record()
+            if self.on_trace_ready:
+                self.on_trace_ready(self)
+        elif prev not in recording and self.current_state in recording:
+            self._start_record()
+        if prev == ProfilerState.RECORD_AND_RETURN \
+                and self.current_state in recording:
+            # new cycle: flush previous window
+            self._stop_record()
+            if self.on_trace_ready:
+                self.on_trace_ready(self)
+            self._start_record()
+
+    def step_info(self, unit=None):
+        return benchmark().step_info(unit)
+
+    # ------------------------------------------------------------ internals
+    def _start_record(self):
+        self._collector.start()
+        if ProfilerTarget.TPU in self.targets or \
+                ProfilerTarget.GPU in self.targets:
+            try:
+                import jax
+
+                if jax.default_backend() == "tpu":
+                    logdir = os.environ.get("PADDLE_TPU_PROFILE_DIR",
+                                            "/tmp/paddle_tpu_profile")
+                    os.makedirs(logdir, exist_ok=True)
+                    jax.profiler.start_trace(logdir)
+                    self._device_tracing = True
+            except Exception:
+                self._device_tracing = False
+
+    def _stop_record(self):
+        self._collector.stop()
+        if self._device_tracing:
+            try:
+                import jax
+
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            self._device_tracing = False
+
+    def _export(self, path: str):
+        self._collector.dump(path)
+
+    def export(self, path: str, format: str = "json"):
+        self._export(path)
+
+    def summary(self, sorted_by=None, op_detail: bool = True,
+                thread_sep: bool = False, time_unit: str = "ms"):
+        """Aggregate per-op-name totals from the last trace window."""
+        import json
+
+        path = self._last_export_path
+        if path is None:
+            import tempfile
+
+            path = os.path.join(tempfile.gettempdir(),
+                                f"pt_prof_{os.getpid()}.json")
+            self._export(path)
+        try:
+            events = json.load(open(path))["traceEvents"]
+        except Exception:
+            return "no profiling data"
+        agg = {}
+        for e in events:
+            name = e.get("name", "?")
+            rec = agg.setdefault(name, [0, 0.0])
+            rec[0] += 1
+            rec[1] += e.get("dur", 0.0)
+        rows = sorted(agg.items(), key=lambda kv: -kv[1][1])
+        lines = [f"{'Name':<40}{'Calls':>8}{'Total(us)':>14}{'Avg(us)':>12}"]
+        for name, (calls, total) in rows[:60]:
+            lines.append(f"{name[:39]:<40}{calls:>8}{total:>14.1f}"
+                         f"{total / max(calls, 1):>12.1f}")
+        return "\n".join(lines)

@@ -1,0 +1,73 @@
+"""Benchmark timer: step timing + ips (reference:
+python/paddle/profiler/timer.py — Benchmark with reader/step cost and ips,
+`benchmark()` singleton)."""
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+__all__ = ["Benchmark", "benchmark"]
+
+
+class _Stat:
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self.count = 0
+        self.total = 0.0
+        self.last = 0.0
+
+    def update(self, v: float):
+        self.count += 1
+        self.total += v
+        self.last = v
+
+    @property
+    def avg(self) -> float:
+        return self.total / self.count if self.count else 0.0
+
+
+class Benchmark:
+    def __init__(self):
+        self.step_cost = _Stat()
+        self.ips_stat = _Stat()
+        self._step_start: Optional[float] = None
+        self._running = False
+
+    def begin(self):
+        self._running = True
+        self._step_start = time.perf_counter()
+
+    def step(self, num_samples: Optional[int] = None):
+        if not self._running or self._step_start is None:
+            return
+        now = time.perf_counter()
+        dt = now - self._step_start
+        self.step_cost.update(dt)
+        if num_samples is not None and dt > 0:
+            self.ips_stat.update(num_samples / dt)
+        self._step_start = now
+
+    def end(self):
+        self._running = False
+
+    def step_info(self, unit=None) -> str:
+        msg = (f"avg_step_cost: {self.step_cost.avg * 1000:.2f} ms, "
+               f"last_step_cost: {self.step_cost.last * 1000:.2f} ms")
+        if self.ips_stat.count:
+            u = unit or "samples/s"
+            msg += f", ips: {self.ips_stat.last:.2f} {u}"
+        return msg
+
+    def reset(self):
+        self.step_cost.reset()
+        self.ips_stat.reset()
+        self._step_start = None
+
+
+_benchmark = Benchmark()
+
+
+def benchmark() -> Benchmark:
+    return _benchmark

@@ -1,0 +1,51 @@
+"""RecordEvent user annotations + trace loading (reference:
+python/paddle/profiler/utils.py RecordEvent, profiler.py
+load_profiler_result)."""
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+__all__ = ["RecordEvent", "load_profiler_result"]
+
+
+class RecordEvent:
+    """Context manager / begin-end span recorded into the active profiler
+    window (reference: profiler/utils.py:33). No-op when no profiler is
+    recording, so library code can instrument unconditionally."""
+
+    def __init__(self, name: str, event_type: str = "UserDefined"):
+        self.name = name
+        self.event_type = event_type
+        self._start_ns: Optional[int] = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+
+    def begin(self):
+        from . import get_active_collector
+
+        if get_active_collector() is not None:
+            self._start_ns = time.perf_counter_ns()
+
+    def end(self):
+        from . import get_active_collector
+
+        col = get_active_collector()
+        if col is not None and self._start_ns is not None:
+            now = time.perf_counter_ns()
+            col.record(self.name, self.event_type, self._start_ns,
+                       now - self._start_ns)
+            self._start_ns = None
+
+
+def load_profiler_result(filename: str):
+    """Load an exported chrome trace back as a dict."""
+    import json
+
+    with open(filename) as f:
+        return json.load(f)

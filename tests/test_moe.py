@@ -9,11 +9,9 @@ from paddle_tpu.core.tensor import Tensor
 from paddle_tpu.incubate.distributed.models.moe import (
     ClipGradForMOEByGlobalNorm, GShardGate, MoELayer, NaiveGate, SwitchGate)
 
-
 def _expert(d_model, d_hidden):
     return nn.Sequential(
         nn.Linear(d_model, d_hidden), nn.GELU(), nn.Linear(d_hidden, d_model))
-
 
 class TestGates:
     def test_gshard_shapes_and_loss(self):
@@ -43,7 +41,6 @@ class TestGates:
         idx, val = g(x)
         assert idx.shape == [8, 2]
         assert val.shape == [8, 2]
-
 
 class TestMoELayer:
     def test_forward_backward_gshard(self):
@@ -75,7 +72,6 @@ class TestMoELayer:
         y = layer(x).numpy()
         # manual: softmax over top-2 of gate logits weights both experts
         logits = layer.gate.gate(x).numpy()
-        import scipy.special as sp  # noqa: F401
 
         e_out = np.stack([e(x).numpy() for e in experts], axis=1)
         top2 = np.argsort(-logits, axis=-1)[:, :2]
@@ -97,7 +93,6 @@ class TestMoELayer:
         x = pt.randn([8, d])
         y = layer(x)
         assert np.isfinite(y.numpy()).all()
-
 
 class TestMoEGradClip:
     def test_clip(self):

@@ -1,0 +1,143 @@
+"""Native C++ runtime tier (native/*.cc via core.native ctypes binding):
+TCPStore daemon/client interop, host tracer chrome export, alloc stats,
+shm ring buffer. Reference analogs: phi/core/distributed/store/tcp_store.h,
+fluid/platform/profiler, phi/core/memory/stats.h."""
+import json
+import multiprocessing as mp
+import os
+
+import pytest
+
+from paddle_tpu.core import native
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native lib unavailable")
+
+
+class TestNativeStore:
+    def test_native_server_native_client(self):
+        srv = native.NativeStoreServer(0)
+        cli = native.NativeStoreClient("127.0.0.1", srv.port, 5.0)
+        cli.set(b"k", b"hello")
+        assert cli.get(b"k") == b"hello"
+        assert cli.add(b"ctr", 3) == 3
+        assert cli.add(b"ctr", 2) == 5
+        assert cli.check(b"k") is True
+        assert cli.check(b"nope") is False
+        assert cli.wait(b"k", 1000) is True
+        assert cli.wait(b"missing", 100) is False
+        cli.close()
+        srv.stop()
+
+    def test_python_client_native_server(self):
+        # wire-protocol interop: Python TCPStore client against C++ daemon
+        from paddle_tpu.distributed.store import TCPStore
+
+        os.environ["PADDLE_TPU_PURE_PY_STORE"] = ""
+        srv = native.NativeStoreServer(0)
+        os.environ["PADDLE_TPU_PURE_PY_STORE"] = "1"
+        try:
+            cli = TCPStore("127.0.0.1", srv.port, is_master=False)
+            cli.set("x", b"42")
+            assert cli.get("x") == b"42"
+            assert cli.add("n", 7) == 7
+        finally:
+            del os.environ["PADDLE_TPU_PURE_PY_STORE"]
+            srv.stop()
+
+    def test_tcpstore_wrapper_uses_native(self):
+        from paddle_tpu.distributed.store import TCPStore
+
+        master = TCPStore("127.0.0.1", 0, is_master=True)
+        assert master._native
+        master.set("a", b"1")
+        assert master.get("a") == b"1"
+        master.barrier("t", 1, 0)
+
+
+class TestTracer:
+    def test_trace_and_dump(self, tmp_path):
+        native.trace_clear()
+        native.trace_enable(True)
+        native.trace_event("matmul", "op", 1000, 500, 1)
+        native.trace_event("all_reduce", "comm", 2000, 300, 2)
+        native.trace_enable(False)
+        assert native.trace_count() == 2
+        p = str(tmp_path / "trace.json")
+        assert native.trace_dump_json(p, 42)
+        data = json.load(open(p))
+        evs = data["traceEvents"]
+        assert len(evs) == 2
+        assert evs[0]["name"] == "matmul"
+        assert evs[0]["ph"] == "X"
+        assert evs[0]["ts"] == 1.0 and evs[0]["dur"] == 0.5
+        native.trace_clear()
+        assert native.trace_count() == 0
+
+    def test_disabled_drops_events(self):
+        native.trace_clear()
+        native.trace_enable(False)
+        native.trace_event("x", "op", 0, 1, 0)
+        assert native.trace_count() == 0
+
+
+class TestAllocStats:
+    def test_counters(self):
+        dev = 7
+        base = native.stats_allocated(dev)
+        native.stats_alloc(dev, 1024)
+        assert native.stats_allocated(dev) == base + 1024
+        assert native.stats_peak(dev) >= base + 1024
+        native.stats_free(dev, 1024)
+        assert native.stats_allocated(dev) == base
+        native.stats_reset_peak(dev)
+        assert native.stats_peak(dev) == base
+
+
+def _ring_producer(name):
+    from paddle_tpu.core import native as n
+
+    ring = n.ShmRing(name)
+    for i in range(50):
+        ring.push(bytes([i % 251]) * (1000 + i))
+    ring.close()
+
+
+class TestShmRing:
+    def test_same_process_roundtrip(self):
+        ring = native.ShmRing("/pt_test_ring1", capacity=1 << 16, create=True)
+        ring.push(b"hello world")
+        assert ring.pop() == b"hello world"
+        ring.free()
+
+    def test_wraparound(self):
+        ring = native.ShmRing("/pt_test_ring2", capacity=4096, create=True)
+        for i in range(20):
+            msg = bytes([i]) * 1500
+            ring.push(msg, timeout=5)
+            assert ring.pop(timeout=5) == msg
+        ring.free()
+
+    def test_cross_process(self):
+        name = "/pt_test_ring3"
+        ring = native.ShmRing(name, capacity=1 << 14, create=True)
+        ctx = mp.get_context("spawn")
+        p = ctx.Process(target=_ring_producer, args=(name,))
+        p.start()
+        got = 0
+        try:
+            while got < 50:
+                msg = ring.pop(timeout=30)
+                assert len(msg) == 1000 + got
+                assert msg[0] == got % 251
+                got += 1
+        finally:
+            p.join(timeout=30)
+            ring.free()
+        assert got == 50
+
+    def test_oversized_message_rejected(self):
+        ring = native.ShmRing("/pt_test_ring4", capacity=128, create=True)
+        with pytest.raises(ValueError):
+            ring.push(b"x" * 1024)
+        ring.free()

@@ -1,0 +1,107 @@
+"""Profiler: scheduler states, RecordEvent spans, chrome export, op
+instrumentation, benchmark timer (reference analog: test/legacy_test/
+test_profiler.py, test_newprofiler.py)."""
+import json
+import os
+
+import paddle_tpu as pt
+import paddle_tpu.profiler as profiler
+from paddle_tpu.profiler import (ProfilerState, RecordEvent, benchmark,
+                                 make_scheduler)
+
+
+class TestScheduler:
+    def test_windows(self):
+        fn = make_scheduler(closed=1, ready=1, record=2, repeat=2,
+                            skip_first=1)
+        states = [fn(i) for i in range(10)]
+        assert states[0] == ProfilerState.CLOSED          # skip_first
+        assert states[1] == ProfilerState.CLOSED
+        assert states[2] == ProfilerState.READY
+        assert states[3] == ProfilerState.RECORD
+        assert states[4] == ProfilerState.RECORD_AND_RETURN
+        assert states[5] == ProfilerState.CLOSED          # cycle 2
+        assert states[8] == ProfilerState.RECORD_AND_RETURN
+        assert states[9] == ProfilerState.CLOSED          # past repeat
+
+    def test_default_records(self):
+        p = profiler.Profiler()
+        assert p.scheduler(0) == ProfilerState.RECORD
+
+
+class TestProfilerTrace:
+    def test_record_and_export(self, tmp_path):
+        out = {}
+
+        def on_ready(prof):
+            path = str(tmp_path / "trace.json")
+            prof._export(path)
+            out["path"] = path
+
+        p = profiler.Profiler(on_trace_ready=on_ready)
+        p.start()
+        with RecordEvent("user_span"):
+            x = pt.randn([8, 8])
+            y = x @ x
+            _ = y.numpy()
+        p.stop()
+        assert "path" in out
+        data = json.load(open(out["path"]))
+        names = {e["name"] for e in data["traceEvents"]}
+        assert "user_span" in names
+        assert "matmul" in names  # run_op instrumentation
+
+    def test_export_chrome_tracing_handler(self, tmp_path):
+        d = str(tmp_path / "prof")
+        p = profiler.Profiler(
+            on_trace_ready=profiler.export_chrome_tracing(d))
+        p.start()
+        _ = (pt.ones([4, 4]) + 1).numpy()
+        p.stop()
+        files = os.listdir(d)
+        assert len(files) == 1
+        assert files[0].endswith(".paddle_trace.json")
+
+    def test_summary(self, tmp_path):
+        p = profiler.Profiler(
+            on_trace_ready=profiler.export_chrome_tracing(str(tmp_path)))
+        p.start()
+        for _ in range(3):
+            _ = (pt.ones([4, 4]) @ pt.ones([4, 4])).numpy()
+        p.stop()
+        s = p.summary()
+        assert "matmul" in s
+        assert "Calls" in s
+
+    def test_no_overhead_when_closed(self):
+        # no active collector: RecordEvent must be a no-op
+        ev = RecordEvent("x")
+        ev.begin()
+        ev.end()
+        assert profiler.get_active_collector() is None
+
+    def test_step_scheduling(self, tmp_path):
+        calls = []
+        p = profiler.Profiler(
+            scheduler=make_scheduler(closed=1, ready=0, record=1, repeat=1),
+            on_trace_ready=lambda prof: calls.append(prof.step_num))
+        p.start()           # step 0: CLOSED
+        _ = pt.ones([2]).numpy()
+        p.step()            # -> step 1: RECORD_AND_RETURN window opens
+        _ = (pt.ones([2]) + 1).numpy()
+        p.step()            # window closes -> on_trace_ready fires
+        p.stop()
+        assert calls
+
+
+class TestBenchmarkTimer:
+    def test_ips(self):
+        b = benchmark()
+        b.reset()
+        b.begin()
+        for _ in range(3):
+            b.step(num_samples=32)
+        b.end()
+        info = b.step_info()
+        assert "avg_step_cost" in info and "ips" in info
+        assert b.step_cost.count == 3
