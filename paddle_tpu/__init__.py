@@ -58,6 +58,8 @@ from . import distributed  # noqa: F401,E402
 from . import incubate  # noqa: F401,E402
 from . import metric  # noqa: F401,E402
 from . import profiler  # noqa: F401,E402
+from . import hapi  # noqa: F401,E402
+from .hapi import Model, summary  # noqa: F401,E402
 from . import device  # noqa: F401,E402
 from . import models  # noqa: F401,E402
 from .framework.io_utils import load, save  # noqa: F401,E402

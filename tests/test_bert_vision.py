@@ -1,0 +1,87 @@
+"""BERT model family + new vision models (reference analogs:
+PaddleNLP BERT; python/paddle/vision/models/{vgg,mobilenetv2}.py)."""
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu.models import (BertForPreTraining,
+                               BertForSequenceClassification,
+                               BertPretrainingCriterion, BertModel, bert_tiny)
+from paddle_tpu.vision.models import MobileNetV2, mobilenet_v2, vgg11
+
+
+def _ids(b, s, vocab):
+    return pt.to_tensor(np.random.randint(0, vocab, (b, s)).astype(np.int32))
+
+
+class TestBert:
+    def test_encoder_shapes(self):
+        cfg = bert_tiny()
+        model = BertModel(cfg)
+        seq, pooled = model(_ids(2, 16, cfg.vocab_size))
+        assert seq.shape == [2, 16, cfg.hidden_size]
+        assert pooled.shape == [2, cfg.hidden_size]
+
+    def test_attention_mask(self):
+        cfg = bert_tiny(hidden_dropout=0.0, attention_dropout=0.0)
+        model = BertModel(cfg)
+        model.eval()
+        ids = _ids(1, 8, cfg.vocab_size)
+        mask = np.ones((1, 8), np.float32)
+        mask[0, 6:] = 0.0  # pad the tail
+        seq_masked, _ = model(ids, attention_mask=pt.to_tensor(mask))
+        # changing a PADDED token must not change unpadded outputs
+        ids2 = ids.numpy().copy()
+        ids2[0, 7] = (ids2[0, 7] + 1) % cfg.vocab_size
+        seq_masked2, _ = model(pt.to_tensor(ids2),
+                               attention_mask=pt.to_tensor(mask))
+        np.testing.assert_allclose(seq_masked.numpy()[0, :6],
+                                   seq_masked2.numpy()[0, :6],
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_pretraining_loss_and_backward(self):
+        cfg = bert_tiny()
+        model = BertForPreTraining(cfg)
+        crit = BertPretrainingCriterion(cfg.vocab_size)
+        b, s = 2, 16
+        ids = _ids(b, s, cfg.vocab_size)
+        mlm_labels = np.full((b, s), -100, np.int64)
+        mlm_labels[:, :3] = np.random.randint(0, cfg.vocab_size, (b, 3))
+        nsp_labels = pt.to_tensor(np.random.randint(0, 2, (b,)).astype(np.int32))
+        scores, rel = model(ids)
+        assert scores.shape == [b, s, cfg.vocab_size]
+        loss = crit(scores, rel, pt.to_tensor(mlm_labels), nsp_labels)
+        loss.backward()
+        g = model.bert.embeddings.word_embeddings.weight.grad
+        assert g is not None and np.isfinite(g.numpy()).all()
+
+    def test_mlm_head_tied_to_embeddings(self):
+        cfg = bert_tiny()
+        model = BertForPreTraining(cfg)
+        assert model.cls.decoder_weight is \
+            model.bert.embeddings.word_embeddings.weight
+
+    def test_sequence_classification(self):
+        cfg = bert_tiny()
+        model = BertForSequenceClassification(cfg, num_classes=3)
+        logits = model(_ids(2, 8, cfg.vocab_size))
+        assert logits.shape == [2, 3]
+
+
+class TestVisionModels:
+    def test_vgg11_forward(self):
+        m = vgg11(num_classes=10)
+        x = pt.randn([1, 3, 224, 224])
+        assert m(x).shape == [1, 10]
+
+    def test_mobilenet_v2_forward_backward(self):
+        m = mobilenet_v2(num_classes=10)
+        x = pt.randn([2, 3, 64, 64])
+        y = m(x)
+        assert y.shape == [2, 10]
+        y.sum().backward()
+        first_conv = m.features[0][0]
+        assert first_conv.weight.grad is not None
+
+    def test_mobilenet_scale(self):
+        m = MobileNetV2(scale=0.5, num_classes=4)
+        assert m(pt.randn([1, 3, 32, 32])).shape == [1, 4]

@@ -1,0 +1,106 @@
+"""hapi Model.fit/evaluate/predict + callbacks (reference analog:
+test/legacy_test/test_model.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import nn
+from paddle_tpu.hapi import EarlyStopping, Model
+from paddle_tpu.io import Dataset
+from paddle_tpu.metric import Accuracy
+
+
+class _SynthDataset(Dataset):
+    """Linearly separable 2-class problem."""
+
+    def __init__(self, n=128):
+        rng = np.random.RandomState(0)
+        self.x = rng.randn(n, 8).astype(np.float32)
+        w = rng.randn(8)
+        self.y = (self.x @ w > 0).astype(np.int64)
+
+    def __getitem__(self, i):
+        return self.x[i], np.array([self.y[i]])
+
+    def __len__(self):
+        return len(self.x)
+
+
+def _model():
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 2))
+    m = Model(net)
+    m.prepare(
+        optimizer=pt.optimizer.Adam(parameters=net.parameters(),
+                                    learning_rate=1e-2),
+        loss=nn.CrossEntropyLoss(),
+        metrics=Accuracy())
+    return m
+
+
+class TestModelFit:
+    def test_fit_improves_accuracy(self, capsys):
+        m = _model()
+        ds = _SynthDataset()
+        m.fit(ds, epochs=10, batch_size=32, verbose=0)
+        logs = m.evaluate(ds, batch_size=32, verbose=0)
+        acc = logs["acc"]
+        assert acc > 0.9, f"accuracy after fit: {acc}"
+
+    def test_train_eval_batch(self):
+        m = _model()
+        x = np.random.randn(16, 8).astype(np.float32)
+        y = np.random.randint(0, 2, (16, 1))
+        loss1, _ = m.train_batch([x], [y])
+        assert isinstance(loss1[0], float)
+        lossE, accE = m.eval_batch([x], [y])
+        assert 0.0 <= accE[0] <= 1.0
+
+    def test_predict(self):
+        m = _model()
+        ds = _SynthDataset(32)
+        out = m.predict(ds, batch_size=8, stack_outputs=True, verbose=0)
+        assert out[0].shape == (32, 2)
+
+    def test_save_load_roundtrip(self, tmp_path):
+        m = _model()
+        ds = _SynthDataset(32)
+        m.fit(ds, epochs=1, batch_size=16, verbose=0)
+        path = str(tmp_path / "ckpt" / "model")
+        m.save(path)
+        m2 = _model()
+        m2.load(path)
+        x = np.random.randn(4, 8).astype(np.float32)
+        np.testing.assert_allclose(m.predict_batch([x])[0],
+                                   m2.predict_batch([x])[0], rtol=1e-6)
+
+    def test_early_stopping(self):
+        # lr=0 -> loss never improves, so patience=0 stops at the 2nd eval
+        net = nn.Linear(8, 2)
+        m = Model(net)
+        m.prepare(optimizer=pt.optimizer.SGD(parameters=net.parameters(),
+                                             learning_rate=0.0),
+                  loss=nn.CrossEntropyLoss())
+        ds = _SynthDataset(64)
+        es = EarlyStopping(monitor="loss", mode="min", patience=0,
+                           verbose=0, save_best_model=False)
+        m.fit(ds, eval_data=ds, epochs=10, batch_size=32, verbose=0,
+              callbacks=[es], eval_freq=1)
+        assert m.stop_training
+
+    def test_num_iters_cap(self):
+        m = _model()
+        seen = []
+
+        class Counter(pt.hapi.Callback):
+            def on_train_batch_end(self, step, logs=None):
+                seen.append(step)
+
+        m.fit(_SynthDataset(128), epochs=10, batch_size=16, verbose=0,
+              num_iters=3, callbacks=[Counter()])
+        assert len(seen) == 3
+
+    def test_summary(self, capsys):
+        m = _model()
+        info = m.summary()
+        assert info["total_params"] == 8 * 16 + 16 + 16 * 2 + 2
+        assert "Total params" in capsys.readouterr().out
