@@ -60,6 +60,7 @@ from . import metric  # noqa: F401,E402
 from . import profiler  # noqa: F401,E402
 from . import hapi  # noqa: F401,E402
 from .hapi import Model, summary  # noqa: F401,E402
+from . import static  # noqa: F401,E402
 from . import device  # noqa: F401,E402
 from . import models  # noqa: F401,E402
 from .framework.io_utils import load, save  # noqa: F401,E402
@@ -80,18 +81,21 @@ TPUPlace = object
 
 
 def disable_static(place=None):
-    """Eager mode is the default and only stateful mode; no-op for parity."""
+    from . import static as _static
+
+    _static.disable_static(place)
 
 
 def enable_static():
-    raise NotImplementedError(
-        "paddle_tpu is eager+jit native; use paddle_tpu.jit.to_static for "
-        "compiled-program execution"
-    )
+    from . import static as _static
+
+    _static.enable_static()
 
 
 def in_dynamic_mode() -> bool:
-    return True
+    from .core import static_flags
+
+    return not static_flags.enabled
 
 
 def grad(outputs, inputs, grad_outputs=None, retain_graph=False,

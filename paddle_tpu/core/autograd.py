@@ -27,6 +27,8 @@ from typing import Callable, List, Optional, Sequence
 import jax
 import jax.numpy as jnp
 
+from . import static_flags
+
 __all__ = [
     "GradNode",
     "no_grad",
@@ -151,6 +153,12 @@ def run_op(fn: Callable, tensors: Sequence, name: str = "op", n_outputs: Optiona
 
 def _run_op_impl(fn: Callable, tensors: Sequence, name: str = "op"):
     from .tensor import Tensor
+
+    if static_flags.enabled:
+        from ..static import graph as _graph
+
+        if any(_graph.is_symbolic(t) for t in tensors):
+            return _graph.record_op(fn, tensors, name)
 
     arrays = [t._data if isinstance(t, Tensor) else t for t in tensors]
 

@@ -1,0 +1,4 @@
+"""Single cheap flag read by the run_op hot path: is static-graph capture
+active? Lives in its own tiny module so core.autograd and paddle_tpu.static
+can both import it without cycles."""
+enabled = False

@@ -41,6 +41,7 @@ class Tensor:
         "persistable",
         "_dist_attr",
         "dist_spec",
+        "_sym_node",
         "__weakref__",
     )
 
@@ -78,6 +79,7 @@ class Tensor:
         self.persistable = False
         self._dist_attr = None  # set by distributed.shard_tensor (DistTensor)
         self.dist_spec = None  # mesh-axis annotation (auto_parallel.constraint)
+        self._sym_node = None  # static-graph capture node (static/graph.py)
 
     # ------------------------------------------------------------- metadata
     @property

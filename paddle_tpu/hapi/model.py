@@ -10,7 +10,6 @@ outer `paddle_tpu.distributed.launch` like the reference.
 from __future__ import annotations
 
 import os
-import pickle
 from typing import List, Optional
 
 import numpy as np
@@ -61,8 +60,10 @@ class Model:
         return self
 
     # ----------------------------------------------------------- batches
-    def train_batch(self, inputs, labels=None, update: bool = True):
-        """reference: model.py:371 (dygraph train_batch)."""
+    def train_batch(self, inputs, labels=None, update: bool = True,
+                    loss_scale: float = 1.0):
+        """reference: model.py:371 (dygraph train_batch). ``loss_scale``
+        normalizes accumulated gradients (1/accumulate_grad_batches)."""
         self.network.train()
         inputs = _batch_tensors(inputs)
         labels = _batch_tensors(labels)
@@ -72,7 +73,7 @@ class Model:
         total = losses[0]
         for extra in losses[1:]:
             total = total + extra
-        total.backward()
+        (total * loss_scale if loss_scale != 1.0 else total).backward()
         if update and self._optimizer is not None:
             self._optimizer.step()
             self._optimizer.clear_grad()
@@ -158,7 +159,9 @@ class Model:
                 is_last = steps is not None and step == steps - 1
                 update = ((step + 1) % accumulate_grad_batches == 0
                           or is_last)
-                result = self.train_batch(ins, labs, update=update)
+                result = self.train_batch(
+                    ins, labs, update=update,
+                    loss_scale=1.0 / accumulate_grad_batches)
                 pending_update = not update
                 if isinstance(result, tuple):
                     losses, metrics = result

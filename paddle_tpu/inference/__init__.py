@@ -1,0 +1,113 @@
+"""Inference API (reference: paddle/fluid/inference/api/analysis_predictor.h:105
+AnalysisPredictor, paddle_inference_api.h — Config/create_predictor/
+zero-copy handles).
+
+TPU-native: the saved program is a serialized jax.export artifact
+(StableHLO); the predictor deserializes once and calls the XLA executable —
+the reference's IR pass pipeline is subsumed by XLA compilation.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["Config", "Predictor", "create_predictor", "Tensor"]
+
+
+class Config:
+    """reference: paddle_infer.Config."""
+
+    def __init__(self, model_path: Optional[str] = None,
+                 params_path: Optional[str] = None):
+        # paddle convention: prefix OR (model_file, params_file)
+        if model_path and model_path.endswith(".pdmodel"):
+            model_path = model_path[:-len(".pdmodel")]
+        self.model_prefix = model_path
+        self._use_tpu = True
+        self._memory_pool_mb = 0
+
+    def set_model(self, model_path, params_path=None):
+        if model_path.endswith(".pdmodel"):
+            model_path = model_path[:-len(".pdmodel")]
+        self.model_prefix = model_path
+
+    def enable_use_gpu(self, memory_pool_mb=100, device_id=0):
+        self._memory_pool_mb = memory_pool_mb  # accelerator is implicit
+
+    def disable_gpu(self):
+        self._use_tpu = False
+
+    def enable_memory_optim(self):
+        pass
+
+    def switch_ir_optim(self, flag=True):
+        pass
+
+
+class Tensor:
+    """Zero-copy-style IO handle (reference: paddle_infer.Tensor)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value: Optional[np.ndarray] = None
+
+    def copy_from_cpu(self, arr: np.ndarray):
+        self._value = np.asarray(arr)
+
+    def copy_to_cpu(self) -> np.ndarray:
+        return np.asarray(self._value)
+
+    def reshape(self, shape):
+        if self._value is not None:
+            self._value = self._value.reshape(shape)
+
+    def shape(self):
+        return list(self._value.shape) if self._value is not None else []
+
+
+class Predictor:
+    """reference: AnalysisPredictor (analysis_predictor.h:105)."""
+
+    def __init__(self, config: Config):
+        from ..static import load_inference_model
+
+        if not config.model_prefix:
+            raise ValueError("Config has no model path")
+        prog, feed_names, fetches = load_inference_model(config.model_prefix)
+        self._prog = prog
+        self._inputs = {n: Tensor(n) for n in feed_names}
+        self._output_vals: List[np.ndarray] = []
+        self._output_handles: Dict[str, Tensor] = {}
+
+    def get_input_names(self) -> List[str]:
+        return list(self._inputs.keys())
+
+    def get_input_handle(self, name: str) -> Tensor:
+        return self._inputs[name]
+
+    def run(self, inputs: Optional[List[np.ndarray]] = None):
+        if inputs is not None:
+            for h, arr in zip(self._inputs.values(), inputs):
+                h.copy_from_cpu(arr)
+        feed = {n: h._value for n, h in self._inputs.items()}
+        self._output_vals = [np.asarray(v) for v in self._prog.run(feed)]
+        self._output_handles = {}
+        for i, v in enumerate(self._output_vals):
+            h = Tensor(f"fetch_{i}")
+            h.copy_from_cpu(v)
+            self._output_handles[h.name] = h
+        if inputs is not None:
+            return self._output_vals
+        return True
+
+    def get_output_names(self) -> List[str]:
+        return list(self._output_handles.keys())
+
+    def get_output_handle(self, name: str) -> Tensor:
+        return self._output_handles[name]
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

@@ -258,6 +258,8 @@ def save(layer, path, input_spec=None, **configs):
                       for s in input_spec]
             exported = jexport.export(jax.jit(infer_fn))(*shapes)
             payload["stablehlo"] = exported.mlir_module()
+            # round-trippable executable (jax.export.deserialize in load)
+            payload["jax_export"] = bytes(exported.serialize())
         except Exception:
             pass
     _save(payload, path + ".pdmodel" if not path.endswith(".pdmodel") else path)
@@ -268,6 +270,19 @@ def load(path, **configs):
 
     p = path if path.endswith(".pdmodel") else path + ".pdmodel"
     payload = _load(p)
+    forward_fn = None
+    if payload.get("jax_export"):
+        from jax import export as jexport
+
+        exported = jexport.deserialize(bytearray(payload["jax_export"]))
+
+        def forward_fn(*inputs):
+            arrays = [i._data if isinstance(i, Tensor) else jnp.asarray(i)
+                      for i in inputs]
+            outs = exported.call(*arrays)
+            outs = [Tensor(o) for o in outs]
+            return outs[0] if len(outs) == 1 else tuple(outs)
+
     return TranslatedLayer(payload.get("state_dict", {}),
-                           payload.get("config", {}))
+                           payload.get("config", {}), forward_fn=forward_fn)
 from .train_step import TrainStep  # noqa: F401,E402

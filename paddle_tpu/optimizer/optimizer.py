@@ -99,6 +99,15 @@ class Optimizer:
 
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):
+        from ..core import static_flags
+
+        if static_flags.enabled:
+            # static capture: register a train op; the Executor
+            # differentiates the captured program and applies `update`
+            from .. import static as _static
+
+            _static.append_train_op(loss, self)
+            return None, None
         loss.backward()
         self.step()
         return None, None

@@ -1,0 +1,358 @@
+"""Static-graph API: Program / Executor / data / save+load_inference_model
+(reference: python/paddle/static/ — Executor at base/executor.py:1234,
+program capture via PIR; SURVEY §3.4).
+
+TPU-native design: ops recorded into a closure DAG (static/graph.py), the
+Executor composes fetches into ONE pure function and jax.jit-compiles it —
+XLA plays the role of the reference's PirInterpreter + CINN. Training works
+through ``optimizer.minimize(loss)``: the Executor differentiates the whole
+captured program with jax.grad and applies the optimizer's functional
+`update` rule, donating parameter buffers — the idiomatic-XLA equivalent of
+the reference's append_backward + optimizer ops.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import static_flags
+from ..core.dtype import to_jax_dtype
+from ..core.tensor import Tensor
+from . import graph as _g
+
+__all__ = ["Program", "default_main_program", "default_startup_program",
+           "program_guard", "data", "InputSpec", "Executor",
+           "CompiledProgram", "save_inference_model", "load_inference_model",
+           "enable_static", "disable_static", "in_static_mode", "nn"]
+
+
+class Program:
+    """reference: python/paddle/base/framework.py Program (PIR program)."""
+
+    def __init__(self):
+        self.random_seed = 0
+        self._feed_leaves: Dict[str, Tensor] = {}
+        self._train_ops = []  # [(loss_tensor, optimizer)]
+        self._fetch_cache = {}
+
+    def global_block(self):
+        return self
+
+    def clone(self, for_test: bool = False):
+        import copy
+
+        p = Program()
+        p.random_seed = self.random_seed
+        p._feed_leaves = dict(self._feed_leaves)
+        if not for_test:
+            p._train_ops = list(self._train_ops)
+        return p
+
+    def list_vars(self):
+        return list(self._feed_leaves.values())
+
+
+_default_main = Program()
+_default_startup = Program()
+
+
+def default_main_program() -> Program:
+    return _default_main
+
+
+def default_startup_program() -> Program:
+    return _default_startup
+
+
+class program_guard:
+    """reference: paddle.static.program_guard."""
+
+    def __init__(self, main_program, startup_program=None):
+        self.main = main_program
+        self.startup = startup_program
+
+    def __enter__(self):
+        global _default_main, _default_startup
+        self._saved = (_default_main, _default_startup)
+        _default_main = self.main
+        if self.startup is not None:
+            _default_startup = self.startup
+        return self
+
+    def __exit__(self, *exc):
+        global _default_main, _default_startup
+        _default_main, _default_startup = self._saved
+
+
+def enable_static():
+    static_flags.enabled = True
+
+
+def disable_static(place=None):
+    static_flags.enabled = False
+
+
+def in_static_mode() -> bool:
+    return static_flags.enabled
+
+
+def data(name: str, shape, dtype="float32", lod_level=0) -> Tensor:
+    """Declare a graph input (reference: paddle.static.data).
+
+    Unlike the reference, dynamic dims (None / -1) are rejected: capture
+    bakes shapes into the recorded program exactly as XLA compilation
+    does. Declare the program per batch size (the Executor caches one
+    compiled program per feed shape)."""
+    if any(s is None or s < 0 for s in shape):
+        raise ValueError(
+            f"static.data({name!r}, shape={list(shape)}): dynamic dims "
+            "(None/-1) are not supported on the TPU build — shapes are "
+            "compiled into the XLA program. Use a concrete batch size; "
+            "different sizes each get their own cached executable.")
+    shape = tuple(int(s) for s in shape)
+    aval = jax.ShapeDtypeStruct(shape, to_jax_dtype(dtype))
+    leaf = _g.FeedLeaf(name, aval)
+    t = _g.make_symbolic(leaf, 0, name=name)
+    default_main_program()._feed_leaves[name] = t
+    return t
+
+
+class InputSpec:
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.name = name
+
+
+class CompiledProgram:
+    """reference: paddle.static.CompiledProgram (pass-through: jit caching
+    happens inside the Executor)."""
+
+    def __init__(self, program, build_strategy=None):
+        self.program = program
+        self.build_strategy = build_strategy
+
+
+class Executor:
+    """reference: python/paddle/base/executor.py:1234 Executor +
+    _ExecutorCache:871 — run() compiles (program, fetch, feed-shapes) once
+    and reuses the XLA executable."""
+
+    def __init__(self, place=None):
+        self.place = place
+        self._cache = {}
+
+    def run(self, program=None, feed=None, fetch_list=None,
+            return_numpy: bool = True, scope=None):
+        if isinstance(program, CompiledProgram):
+            program = program.program
+        program = program or default_main_program()
+        feed = feed or {}
+        fetch_list = list(fetch_list or [])
+
+        if program._train_ops:
+            outs = self._run_train(program, feed, fetch_list)
+        else:
+            outs = self._run_infer(program, feed, fetch_list)
+        if return_numpy:
+            return [np.asarray(o) for o in outs]
+        return [Tensor(o) for o in outs]
+
+    # ------------------------------------------------------------ infer
+    def _key(self, program, feed, fetch_list, tag):
+        shapes = tuple((k, tuple(np.shape(v)), str(np.asarray(v).dtype))
+                       for k, v in sorted(feed.items()))
+        return (id(program), tag,
+                tuple(id(t._sym_node[0]) if _g.is_symbolic(t) else id(t)
+                      for t in fetch_list), shapes)
+
+    def _run_infer(self, program, feed, fetch_list):
+        key = self._key(program, feed, fetch_list, "infer")
+        entry = self._cache.get(key)
+        if entry is None:
+            sym_nodes = [t._sym_node for t in fetch_list
+                         if _g.is_symbolic(t)]
+            run, feed_names, param_list = _g.trace(sym_nodes)
+            jitted = jax.jit(lambda feeds, params: run(feeds, params))
+            entry = (jitted, feed_names, param_list)
+            self._cache[key] = entry
+        jitted, feed_names, param_list = entry
+        feed_arrays = {k: jnp.asarray(v) for k, v in feed.items()}
+        vals = jitted(feed_arrays, [p._data for p in param_list])
+        out, i = [], 0
+        for t in fetch_list:
+            if _g.is_symbolic(t):
+                out.append(vals[i])
+                i += 1
+            else:
+                out.append(t._data)
+        return out
+
+    # ------------------------------------------------------------ train
+    def _run_train(self, program, feed, fetch_list):
+        # prefer the train op whose loss is being fetched (programs with
+        # several minimize() calls train the op the caller is driving)
+        loss_t, opt = program._train_ops[0]
+        for lt, o in program._train_ops:
+            if any(t is lt for t in fetch_list):
+                loss_t, opt = lt, o
+                break
+        key = self._key(program, feed, fetch_list + [loss_t], "train")
+        entry = self._cache.get(key)
+        if entry is None:
+            fetches = [t for t in fetch_list if _g.is_symbolic(t)]
+            sym_nodes = [t._sym_node for t in [loss_t] + fetches]
+            run, feed_names, param_list = _g.trace(sym_nodes)
+            trainable_idx = [
+                i for i, p in enumerate(param_list)
+                if getattr(p, "trainable", False) and not p.stop_gradient]
+            if opt._parameter_list:
+                # optimizer bound to explicit params: train only those;
+                # a bare optimizer (canonical static idiom) trains all
+                opt_params = {id(p) for p in opt._parameter_list}
+                trainable_idx = [i for i in trainable_idx
+                                 if id(param_list[i]) in opt_params]
+
+            def loss_from(feeds, params):
+                return run(feeds, params)[0]
+
+            def step(feeds, params, opt_state, lr):
+                def f(train_vals):
+                    full = list(params)
+                    for i, v in zip(trainable_idx, train_vals):
+                        full[i] = v
+                    vals = run(feeds, full)
+                    return jnp.sum(vals[0].astype(jnp.float32)), vals
+
+                train_vals = [params[i] for i in trainable_idx]
+                (_, vals), grads = jax.value_and_grad(f, has_aux=True)(
+                    train_vals)
+                new_train, new_state = opt.update(train_vals, grads,
+                                                  opt_state, lr=lr)
+                new_params = list(params)
+                for i, v in zip(trainable_idx, new_train):
+                    new_params[i] = v.astype(params[i].dtype)
+                return vals, new_params, new_state
+
+            jitted = jax.jit(step, donate_argnums=(1, 2))
+            opt_state = opt.init_state(
+                [p._data for p in [param_list[i] for i in trainable_idx]])
+            entry = [jitted, feed_names, param_list, trainable_idx,
+                     opt_state]
+            self._cache[key] = entry
+        jitted, feed_names, param_list, trainable_idx, opt_state = entry
+        feed_arrays = {k: jnp.asarray(v) for k, v in feed.items()}
+        vals, new_params, new_state = jitted(
+            feed_arrays, [p._data for p in param_list], opt_state,
+            jnp.asarray(opt.get_lr(), jnp.float32))
+        entry[4] = new_state
+        for p, v in zip(param_list, new_params):
+            p._data = v
+        # vals[0] is the internal loss slot; vals[1:] line up with the
+        # symbolic fetches in order (including the loss if it was fetched)
+        out, i = [], 1
+        for t in fetch_list:
+            if _g.is_symbolic(t):
+                out.append(vals[i])
+                i += 1
+            else:
+                out.append(t._data)
+        return out
+
+    def close(self):
+        self._cache.clear()
+
+
+def append_train_op(loss, optimizer):
+    """Registered by Optimizer.minimize under static mode."""
+    default_main_program()._train_ops.append((loss, optimizer))
+
+
+# ------------------------------------------------------------------ io
+def save_inference_model(path_prefix: str, feed_vars, fetch_vars, executor,
+                         program=None):
+    """reference: python/paddle/static/io.py save_inference_model.
+    Serializes the traced program via jax.export (StableHLO) + params."""
+    feed_vars = list(feed_vars) if isinstance(feed_vars, (list, tuple)) \
+        else [feed_vars]
+    fetch_vars = list(fetch_vars) if isinstance(fetch_vars, (list, tuple)) \
+        else [fetch_vars]
+    sym_nodes = [t._sym_node for t in fetch_vars]
+    run, feed_names, param_list = _g.trace(sym_nodes)
+    # order feeds as given
+    names = [t.name for t in feed_vars]
+    param_vals = [p._data for p in param_list]
+
+    def infer(*feed_arrays):
+        feeds = dict(zip(names, feed_arrays))
+        return tuple(run(feeds, param_vals))
+
+    shapes = [jax.ShapeDtypeStruct(tuple(t.shape), t._data.dtype)
+              for t in feed_vars]
+    from jax import export as jexport
+
+    exported = jexport.export(jax.jit(infer))(*shapes)
+    blob = exported.serialize()
+    d = os.path.dirname(path_prefix)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path_prefix + ".pdmodel", "wb") as f:
+        f.write(bytes(blob))
+    meta = {"feed_names": names,
+            "fetch_count": len(fetch_vars)}
+    import pickle
+
+    with open(path_prefix + ".pdiparams", "wb") as f:
+        pickle.dump(meta, f)
+
+
+class _LoadedProgram:
+    def __init__(self, exported, feed_names, fetch_count):
+        self._exported = exported
+        self.feed_names = feed_names
+        self.fetch_count = fetch_count
+
+    def run(self, feed: Dict[str, np.ndarray]):
+        args = [jnp.asarray(feed[n]) for n in self.feed_names]
+        return list(self._exported.call(*args))
+
+
+def load_inference_model(path_prefix: str, executor=None):
+    """reference: python/paddle/static/io.py load_inference_model.
+    Returns (program, feed_names, fetch_targets_placeholder)."""
+    from jax import export as jexport
+    import pickle
+
+    with open(path_prefix + ".pdmodel", "rb") as f:
+        blob = f.read()
+    with open(path_prefix + ".pdiparams", "rb") as f:
+        meta = pickle.load(f)
+    exported = jexport.deserialize(bytearray(blob))
+    prog = _LoadedProgram(exported, meta["feed_names"], meta["fetch_count"])
+    return prog, meta["feed_names"], list(range(meta["fetch_count"]))
+
+
+class _StaticNN:
+    """paddle.static.nn minimal surface (fc/batch_norm map onto nn.* )."""
+
+    @staticmethod
+    def fc(x, size, num_flatten_dims=1, activation=None, name=None):
+        from .. import nn as _nn
+        from ..nn import functional as F
+
+        layer = _nn.Linear(x.shape[-1], size)
+        out = layer(x)
+        if activation == "relu":
+            out = F.relu(out)
+        elif activation == "softmax":
+            out = F.softmax(out)
+        elif activation == "tanh":
+            out = F.tanh(out)
+        return out
+
+
+nn = _StaticNN()

@@ -1,0 +1,195 @@
+"""Static-graph capture: symbolic tensors + lazy op DAG.
+
+TPU-native analog of the reference's PIR program capture
+(paddle/pir/ Program/Operation/Value + fluid/pir operator dialect,
+SURVEY §2.1 "PIR"). Instead of building an MLIR-like IR and writing a
+lowering, ops are recorded as a DAG of pure jax closures (each node is the
+same pure fn the eager path would have executed); the Executor composes the
+DAG into one python callable and hands it to jax.jit, so XLA sees the whole
+program — the role the reference splits between PirInterpreter and CINN is
+played entirely by XLA (SURVEY §2.4.9).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import jax
+import numpy as np
+
+from ..core import static_flags
+from ..core.dtype import to_jax_dtype
+from ..core.tensor import Tensor
+
+__all__ = ["OpNode", "FeedLeaf", "make_symbolic", "record_op", "is_symbolic",
+           "evaluate"]
+
+
+class FeedLeaf:
+    """A named graph input (static.data)."""
+
+    def __init__(self, name: str, aval: jax.ShapeDtypeStruct):
+        self.name = name
+        self.aval = aval
+
+
+class OpNode:
+    """One recorded op: pure fn over parent values.
+
+    parents: list of entries, each either
+      - (OpNode, out_idx)      symbolic input
+      - FeedLeaf               feed input
+      - Tensor                 concrete tensor (parameter/buffer constant)
+      - raw array/scalar       literal constant
+    """
+
+    def __init__(self, fn, parents, out_avals, name: str, single: bool):
+        self.fn = fn
+        self.parents = parents
+        self.out_avals = out_avals
+        self.name = name
+        self.single = single
+
+
+def is_symbolic(t) -> bool:
+    return isinstance(t, Tensor) and getattr(t, "_sym_node", None) is not None
+
+
+def make_symbolic(aval_or_node, out_index: int = 0,
+                  name: Optional[str] = None) -> Tensor:
+    """Build a Tensor whose payload is a ShapeDtypeStruct (no data)."""
+    t = Tensor.__new__(Tensor)
+    if isinstance(aval_or_node, (OpNode, FeedLeaf)):
+        node = aval_or_node
+        aval = (node.aval if isinstance(node, FeedLeaf)
+                else node.out_avals[out_index])
+    else:
+        node = None
+        aval = aval_or_node
+    t._data = aval  # ShapeDtypeStruct: .shape/.dtype metadata work
+    t._stop_gradient = True
+    t._grad = None
+    t._grad_node = None
+    t._out_index = out_index
+    t._grad_hooks = []
+    t.name = name or f"sym_{id(t)}"
+    t.persistable = False
+    t._dist_attr = None
+    t.dist_spec = None
+    t._sym_node = (node, out_index)
+    return t
+
+
+def record_op(fn, tensors, name: str):
+    """Called from run_op when static capture is on and an input is
+    symbolic: infer shapes with jax.eval_shape, return symbolic outputs."""
+    parents: List[Any] = []
+    avals_in = []
+    for t in tensors:
+        if is_symbolic(t):
+            node, idx = t._sym_node
+            parents.append((node, idx) if isinstance(node, OpNode) else node)
+            avals_in.append(t._data)
+        elif isinstance(t, Tensor):
+            parents.append(t)
+            avals_in.append(jax.ShapeDtypeStruct(tuple(t._data.shape),
+                                                 t._data.dtype))
+        else:
+            arr = t
+            parents.append(arr)
+            avals_in.append(arr)
+    out = jax.eval_shape(fn, *avals_in)
+    single = not isinstance(out, (tuple, list))
+    outs = (out,) if single else tuple(out)
+    node = OpNode(fn, parents, list(outs), name, single)
+    wrapped = tuple(make_symbolic(node, i) for i in range(len(outs)))
+    return wrapped[0] if single else wrapped
+
+
+def _collect(node, feeds: Dict[str, int], params: Dict[int, Tensor],
+             seen: set):
+    """DFS over the DAG collecting feed leaves + concrete tensor inputs."""
+    if id(node) in seen:
+        return
+    seen.add(id(node))
+    for p in node.parents:
+        if isinstance(p, tuple):
+            _collect(p[0], feeds, params, seen)
+        elif isinstance(p, FeedLeaf):
+            feeds.setdefault(p.name, len(feeds))
+        elif isinstance(p, Tensor):
+            params.setdefault(id(p), p)
+
+
+def trace(fetch_nodes):
+    """Return (callable, feed_names, param_tensors).
+
+    callable(feed_values_by_name: dict, param_values: list) -> list of
+    fetch values; pure, jit-friendly.
+    """
+    feeds: Dict[str, int] = {}
+    params: Dict[int, Tensor] = {}
+    seen: set = set()
+    for node, _ in fetch_nodes:
+        if isinstance(node, OpNode):
+            _collect(node, feeds, params, seen)
+        elif isinstance(node, FeedLeaf):
+            feeds.setdefault(node.name, len(feeds))
+    param_list = list(params.values())
+    param_pos = {pid: i for i, pid in enumerate(params.keys())}
+
+    def run(feed_values: Dict[str, Any], param_values: List[Any]):
+        memo: Dict[int, Any] = {}
+
+        def eval_node(node):
+            key = id(node)
+            if key in memo:
+                return memo[key]
+            vals = []
+            for p in node.parents:
+                if isinstance(p, tuple):
+                    parent_out = eval_node(p[0])
+                    vals.append(parent_out[p[1]] if not p[0].single
+                                else parent_out)
+                elif isinstance(p, FeedLeaf):
+                    vals.append(feed_values[p.name])
+                elif isinstance(p, Tensor):
+                    vals.append(param_values[param_pos[id(p)]])
+                else:
+                    vals.append(p)
+            out = node.fn(*vals)
+            memo[key] = out
+            return out
+
+        results = []
+        for node, idx in fetch_nodes:
+            if isinstance(node, FeedLeaf):
+                results.append(feed_values[node.name])
+                continue
+            out = eval_node(node)
+            results.append(out if node.single else out[idx])
+        return results
+
+    return run, list(feeds.keys()), param_list
+
+
+def evaluate(fetch_tensors, feed: Dict[str, Any]):
+    """Eagerly evaluate symbolic fetches (used by Executor; jitted there)."""
+    fetch_nodes = []
+    for t in fetch_tensors:
+        if not is_symbolic(t):
+            fetch_nodes.append(None)
+        else:
+            fetch_nodes.append(t._sym_node)
+    syms = [fn for fn in fetch_nodes if fn is not None]
+    run, feed_names, param_list = trace(syms)
+    feed_arr = {k: np.asarray(v) for k, v in feed.items()}
+    vals = run(feed_arr, [p._data for p in param_list])
+    out = []
+    i = 0
+    for t, fn in zip(fetch_tensors, fetch_nodes):
+        if fn is None:
+            out.append(t._data)
+        else:
+            out.append(vals[i])
+            i += 1
+    return out

@@ -1,0 +1,158 @@
+"""Static graph: program capture, Executor, training via minimize,
+save/load_inference_model, inference Predictor, jit.save/load roundtrip
+(reference analogs: test/legacy_test/test_executor*, static save/load
+tests; SURVEY §3.4)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import nn, static
+
+
+@pytest.fixture
+def static_mode():
+    pt.enable_static()
+    yield
+    pt.disable_static()
+
+
+class TestProgramCapture:
+    def test_infer_run(self, static_mode):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [4, 3])
+            w = pt.to_tensor(np.eye(3, dtype=np.float32) * 2.0)
+            y = (x @ w) + 1.0
+        exe = static.Executor()
+        arr = np.random.randn(4, 3).astype(np.float32)
+        (out,) = exe.run(main, feed={"x": arr}, fetch_list=[y])
+        np.testing.assert_allclose(out, arr * 2.0 + 1.0, rtol=1e-6)
+
+    def test_layers_under_static(self, static_mode):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [2, 8])
+            net = nn.Sequential(nn.Linear(8, 4), nn.ReLU())
+            y = net(x)
+        exe = static.Executor()
+        arr = np.random.randn(2, 8).astype(np.float32)
+        (out,) = exe.run(main, feed={"x": arr}, fetch_list=[y])
+        assert out.shape == (2, 4)
+        # matches eager execution with the same params
+        pt.disable_static()
+        ref = net(pt.to_tensor(arr)).numpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+    def test_executor_cache_reuse(self, static_mode):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [2, 2])
+            y = x * 3.0
+        exe = static.Executor()
+        a = np.ones((2, 2), np.float32)
+        exe.run(main, feed={"x": a}, fetch_list=[y])
+        n_entries = len(exe._cache)
+        exe.run(main, feed={"x": a + 1}, fetch_list=[y])
+        assert len(exe._cache) == n_entries  # same compiled entry reused
+
+
+class TestStaticTraining:
+    def test_minimize_reduces_loss(self, static_mode):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [16, 4])
+            label = static.data("label", [16, 1])
+            net = nn.Linear(4, 1)
+            pred = net(x)
+            loss = ((pred - label) ** 2).mean()
+            opt = pt.optimizer.SGD(parameters=net.parameters(),
+                                   learning_rate=0.1)
+            opt.minimize(loss)
+        exe = static.Executor()
+        rng = np.random.RandomState(0)
+        X = rng.randn(16, 4).astype(np.float32)
+        Yt = (X @ np.array([[1.], [2.], [-1.], [0.5]], np.float32))
+        losses = []
+        for _ in range(30):
+            (lv,) = exe.run(main, feed={"x": X, "label": Yt},
+                            fetch_list=[loss])
+            losses.append(float(lv))
+        assert losses[-1] < losses[0] * 0.1, losses[:3] + losses[-3:]
+
+    def test_adam_static(self, static_mode):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [8, 4])
+            label = static.data("label", [8, 1])
+            net = nn.Linear(4, 1)
+            loss = ((net(x) - label) ** 2).mean()
+            pt.optimizer.Adam(parameters=net.parameters(),
+                              learning_rate=0.05).minimize(loss)
+        exe = static.Executor()
+        X = np.random.randn(8, 4).astype(np.float32)
+        Y = np.random.randn(8, 1).astype(np.float32)
+        first = last = None
+        for _ in range(40):
+            (lv,) = exe.run(main, feed={"x": X, "label": Y},
+                            fetch_list=[loss])
+            first = first if first is not None else float(lv)
+            last = float(lv)
+        assert last < first
+
+
+class TestInference:
+    def test_save_load_inference_model(self, static_mode, tmp_path):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [2, 4])
+            net = nn.Linear(4, 3)
+            y = net(x)
+        exe = static.Executor()
+        prefix = str(tmp_path / "model" / "net")
+        static.save_inference_model(prefix, [x], [y], exe)
+
+        prog, feed_names, fetches = static.load_inference_model(prefix)
+        arr = np.random.randn(2, 4).astype(np.float32)
+        out = prog.run({"x": arr})[0]
+        pt.disable_static()
+        ref = net(pt.to_tensor(arr)).numpy()
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5)
+
+    def test_predictor(self, static_mode, tmp_path):
+        from paddle_tpu import inference
+
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [1, 4])
+            net = nn.Linear(4, 2)
+            y = net(x)
+        prefix = str(tmp_path / "pred" / "net")
+        static.save_inference_model(prefix, [x], [y], static.Executor())
+        pt.disable_static()
+
+        cfg = inference.Config(prefix)
+        pred = inference.create_predictor(cfg)
+        names = pred.get_input_names()
+        assert names == ["x"]
+        h = pred.get_input_handle("x")
+        arr = np.random.randn(1, 4).astype(np.float32)
+        h.copy_from_cpu(arr)
+        pred.run()
+        out = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+        ref = net(pt.to_tensor(arr)).numpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+
+class TestJitSaveLoad:
+    def test_roundtrip_executable(self, tmp_path):
+        from paddle_tpu.jit import InputSpec
+
+        net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        net.eval()
+        path = str(tmp_path / "jit" / "net")
+        pt.jit.save(net, path, input_spec=[InputSpec([3, 4])])
+        loaded = pt.jit.load(path)
+        arr = np.random.randn(3, 4).astype(np.float32)
+        out = loaded(pt.to_tensor(arr))
+        ref = net(pt.to_tensor(arr))
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-5)
