@@ -50,7 +50,9 @@ from .auto_parallel.api import (  # noqa: F401
     DistAttr,
     dtensor_from_fn,
     dtensor_from_local,
+    local_value,
     reshard,
+    shard_dataloader,
     shard_layer,
     shard_optimizer,
     shard_tensor,

@@ -29,7 +29,8 @@ from .process_mesh import ProcessMesh
 
 __all__ = ["DistAttr", "shard_tensor", "dtensor_from_fn", "dtensor_from_local",
            "reshard", "shard_layer", "shard_optimizer", "unshard_dtensor",
-           "ShardingStage1", "ShardingStage2", "ShardingStage3", "to_static"]
+           "ShardingStage1", "ShardingStage2", "ShardingStage3", "to_static",
+           "local_value", "shard_dataloader"]
 
 
 class DistAttr:
@@ -109,17 +110,8 @@ def dtensor_from_local(local_tensor, mesh: ProcessMesh, placements) -> Tensor:
         n = mesh.shape[axis]
         stacked = jnp.broadcast_to(t._data[None] / n,
                                    (n,) + tuple(t.shape))
-        eff_placements = [Shard(0) if i == axis else
-                          (Replicate() if isinstance(p, Partial) else
-                           _shift_shard(p, 1))
-                          for i, p in enumerate(placements)]
-        jmesh = mesh.get_jax_mesh()
-        spec = _spec_for(eff_placements, mesh, t.ndim + 1)
-        out = Tensor(jax.device_put(stacked, NamedSharding(jmesh, spec)),
-                     stop_gradient=t.stop_gradient)
-        out._dist_attr = DistAttr(mesh, placements)
-        out._dist_attr._partial_hidden = True
-        return out
+        return _place_partial_hidden(stacked, mesh, placements,
+                                     t.stop_gradient)
     jmesh = mesh.get_jax_mesh()
     spec = _spec_for(placements, mesh, t.ndim)
     # local -> global: in single-process mode the "local" value is the shard
@@ -137,6 +129,24 @@ def _shift_shard(p, by):
     return p
 
 
+def _place_partial_hidden(stacked, mesh, placements, stop_gradient):
+    """Shared hidden-pending-sum construction: ``stacked`` is
+    [n, *shape] where slot values sum to the logical tensor; Shard(0) over
+    the (first) partial mesh axis, other placements shifted by one dim."""
+    axis = next(i for i, p in enumerate(placements)
+                if isinstance(p, Partial))
+    eff = [Shard(0) if i == axis else
+           (Replicate() if isinstance(p, Partial) else _shift_shard(p, 1))
+           for i, p in enumerate(placements)]
+    jmesh = mesh.get_jax_mesh()
+    spec = _spec_for(eff, mesh, stacked.ndim)
+    out = Tensor(jax.device_put(stacked, NamedSharding(jmesh, spec)),
+                 stop_gradient=stop_gradient)
+    out._dist_attr = DistAttr(mesh, placements)
+    out._dist_attr._partial_hidden = True
+    return out
+
+
 def reshard(dist_tensor: Tensor, mesh: ProcessMesh, placements) -> Tensor:
     """reference: auto_parallel/api.py:717 + the 30 reshard functions under
     phi/core/distributed/auto_parallel/reshard/. XLA emits the transfer."""
@@ -148,7 +158,17 @@ def reshard(dist_tensor: Tensor, mesh: ProcessMesh, placements) -> Tensor:
         # ReduceScatter, reference p_to_r_reshard_function.cc)
         data = jnp.sum(data, axis=0)
     if any(isinstance(p, Partial) for p in placements):
-        raise NotImplementedError("reshard TO Partial is not supported")
+        # r->p (reference r_to_p_reshard_function.cc): the value lives on
+        # one rank of the partial axis, zeros elsewhere — hidden-axis form:
+        # slot 0 = value, other slots = 0, Shard(0) over the partial axis
+        axis = next(i for i, p in enumerate(placements)
+                    if isinstance(p, Partial))
+        n = mesh.shape[axis]
+        stacked = jnp.concatenate(
+            [data[None], jnp.zeros((n - 1,) + tuple(data.shape),
+                                   data.dtype)], axis=0)
+        return _place_partial_hidden(stacked, mesh, placements,
+                                     t.stop_gradient)
     jmesh = mesh.get_jax_mesh()
     spec = _spec_for(placements, mesh, data.ndim)
     from ...core.autograd import run_op
@@ -254,6 +274,76 @@ class _ShardOptimizer:
 def shard_optimizer(optimizer, shard_fn=None):
     """reference: auto_parallel/api.py:1660."""
     return _ShardOptimizer(optimizer, shard_fn)
+
+
+def local_value(dist_tensor: Tensor) -> Tensor:
+    """This process's local shard (reference: DistTensor._local_value;
+    single-controller: the first addressable shard). For a Partial tensor
+    this is the rank's unreduced partial contribution."""
+    data = dist_tensor._data
+    attr = dist_tensor._dist_attr
+    if attr is not None and getattr(attr, "_partial_hidden", False):
+        # hidden axis: each slot is one rank's pending-sum contribution
+        return Tensor(jnp.asarray(data[0]))
+    try:
+        shard = data.addressable_shards[0]
+        return Tensor(jnp.asarray(shard.data))
+    except Exception:
+        return Tensor(data)
+
+
+class _ShardDataLoader:
+    """Iterates an inner DataLoader, placing each batch as a DistTensor
+    sharded over ``shard_dims`` (batch axis on dp) — reference:
+    auto_parallel/api.py:3313 shard_dataloader."""
+
+    def __init__(self, dataloader, meshes, input_keys=None, shard_dims=None,
+                 is_dataset_splitted=False):
+        self._loader = dataloader
+        self._mesh = meshes[0] if isinstance(meshes, (list, tuple)) \
+            else meshes
+        self._shard_dims = shard_dims
+        self._input_keys = set(input_keys) if input_keys else None
+        axis = None
+        if isinstance(shard_dims, str):
+            axis = shard_dims
+        elif shard_dims is None and "dp" in self._mesh.dim_names:
+            axis = "dp"
+        # dataset already split per dp rank: batches are local, do not
+        # re-shard the batch dim (reference is_dataset_splitted semantics)
+        self._axis = None if is_dataset_splitted else axis
+
+    def __len__(self):
+        return len(self._loader)
+
+    def _place(self, t):
+        if not isinstance(t, Tensor):
+            t = Tensor(jnp.asarray(np.asarray(t)))
+        placements = [Replicate()] * self._mesh.ndim
+        if self._axis is not None and self._axis in self._mesh.dim_names:
+            i = self._mesh.dim_names.index(self._axis)
+            if t.ndim and t.shape[0] % self._mesh.shape[i] == 0:
+                placements[i] = Shard(0)
+        return shard_tensor(t, self._mesh, placements)
+
+    def __iter__(self):
+        for batch in self._loader:
+            if isinstance(batch, (list, tuple)):
+                yield type(batch)(self._place(b) for b in batch)
+            elif isinstance(batch, dict):
+                yield {k: self._place(v)
+                       if self._input_keys is None or k in self._input_keys
+                       else v
+                       for k, v in batch.items()}
+            else:
+                yield self._place(batch)
+
+
+def shard_dataloader(dataloader, meshes, input_keys=None, shard_dims=None,
+                     is_dataset_splitted=False):
+    """reference: auto_parallel/api.py:3313."""
+    return _ShardDataLoader(dataloader, meshes, input_keys, shard_dims,
+                            is_dataset_splitted)
 
 
 def to_static(layer, loader=None, loss=None, optimizer=None, strategy=None):

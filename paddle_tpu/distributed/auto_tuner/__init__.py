@@ -1,0 +1,3 @@
+from .tuner import AutoTuner, Config, default_candidates, prune_by_memory
+
+__all__ = ["AutoTuner", "Config", "default_candidates", "prune_by_memory"]

@@ -1,0 +1,130 @@
+"""Parallelism auto-tuner: grid + prune search over dp/mp/pp/sharding/
+micro-batch configs (reference: python/paddle/distributed/auto_tuner/ —
+tuner.py:21 AutoTuner, search.py GridSearch, prune.py rules).
+
+The reference launches a trial job per candidate; here each trial runs a
+user-supplied ``run_fn(cfg) -> metric`` (typically wrapping a jit-compiled
+few-step benchmark on the target mesh), which maps better onto the
+single-controller TPU model — trials reuse the warm process instead of
+re-spawning a cluster.
+"""
+from __future__ import annotations
+
+import itertools
+import json
+import time
+from dataclasses import asdict, dataclass, field
+from typing import Callable, Dict, List, Optional
+
+__all__ = ["Config", "AutoTuner", "default_candidates", "prune_by_memory"]
+
+
+@dataclass
+class Config:
+    dp_degree: int = 1
+    mp_degree: int = 1
+    pp_degree: int = 1
+    sharding_degree: int = 1
+    sharding_stage: int = 1
+    micro_batch_size: int = 1
+    use_recompute: bool = False
+    extra: Dict = field(default_factory=dict)
+
+    def degree_product(self) -> int:
+        return self.dp_degree * self.mp_degree * self.pp_degree \
+            * self.sharding_degree
+
+    def to_dict(self):
+        return asdict(self)
+
+
+def default_candidates(num_devices: int, global_batch_size: int,
+                       num_layers: Optional[int] = None,
+                       vocab_divisor: int = 1) -> List[Config]:
+    """Grid generation + hard pruning (reference: search.py GridSearch +
+    prune.py _prune_by_* rules)."""
+    out = []
+
+    def divisors(n):
+        return [d for d in range(1, n + 1) if n % d == 0]
+
+    for dp, mp, pp in itertools.product(divisors(num_devices), repeat=3):
+        for shard in divisors(num_devices):
+            base = dp * mp * pp * shard
+            if base != num_devices:
+                continue
+            # prune: pp must divide layer count (reference prune.py)
+            if num_layers is not None and pp > 1 and num_layers % pp:
+                continue
+            # prune: dp*shard must divide global batch
+            if global_batch_size % (dp * shard):
+                continue
+            local_batch = global_batch_size // (dp * shard)
+            for mbs in divisors(local_batch):
+                for rc in (False, True):
+                    out.append(Config(
+                        dp_degree=dp, mp_degree=mp, pp_degree=pp,
+                        sharding_degree=shard, micro_batch_size=mbs,
+                        use_recompute=rc))
+    return out
+
+
+def prune_by_memory(candidates: List[Config], model_bytes: int,
+                    hbm_bytes: int, optimizer_multiplier: float = 3.0
+                    ) -> List[Config]:
+    """Drop configs whose estimated per-chip weight+state footprint
+    exceeds HBM (reference: prune.py memory rules; estimate only — real
+    activation memory is measured by the trial itself)."""
+    keep = []
+    for c in candidates:
+        shards = c.mp_degree * c.pp_degree * (
+            c.sharding_degree if c.sharding_stage >= 1 else 1)
+        est = model_bytes * (1 + optimizer_multiplier) / max(shards, 1)
+        if c.use_recompute:
+            est *= 0.9
+        if est <= hbm_bytes:
+            keep.append(c)
+    return keep
+
+
+class AutoTuner:
+    """reference: auto_tuner/tuner.py:21."""
+
+    def __init__(self, candidates: List[Config],
+                 run_fn: Callable[[Config], float],
+                 mode: str = "max", max_trials: Optional[int] = None,
+                 log_path: Optional[str] = None):
+        self.candidates = list(candidates)
+        self.run_fn = run_fn
+        self.mode = mode
+        self.max_trials = max_trials
+        self.log_path = log_path
+        self.history: List[Dict] = []
+
+    def search(self) -> Optional[Config]:
+        best_cfg = None
+        best_metric = None
+        trials = self.candidates if self.max_trials is None \
+            else self.candidates[: self.max_trials]
+        for cfg in trials:
+            t0 = time.time()
+            try:
+                metric = self.run_fn(cfg)
+                err = None
+            except Exception as e:  # OOM / invalid config: record + skip
+                metric = None
+                err = str(e)
+            rec = {"config": cfg.to_dict(), "metric": metric,
+                   "error": err, "time": time.time() - t0}
+            self.history.append(rec)
+            if self.log_path:
+                with open(self.log_path, "a") as f:
+                    f.write(json.dumps(rec) + "\n")
+            if metric is None:
+                continue
+            better = (best_metric is None
+                      or (self.mode == "max" and metric > best_metric)
+                      or (self.mode == "min" and metric < best_metric))
+            if better:
+                best_metric, best_cfg = metric, cfg
+        return best_cfg

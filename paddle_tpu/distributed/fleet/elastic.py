@@ -1,0 +1,76 @@
+"""Elastic training manager-lite (reference:
+python/paddle/distributed/fleet/elastic/manager.py:125 ElasticManager —
+etcd node registry + heartbeat lease :254, fault watch :457).
+
+TPU-native: the registry lives in the job's TCPStore (no etcd dependency);
+each node heartbeats a lease key, the master watches for missing beats and
+invokes the fault callback (restart is the launcher's job, as in the
+reference --max_restart policy).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+__all__ = ["ElasticManager"]
+
+
+class ElasticManager:
+    ELASTIC_TIMEOUT = 10.0
+
+    def __init__(self, store, node_id: str, num_nodes: int,
+                 heartbeat_interval: float = 2.0,
+                 timeout: Optional[float] = None,
+                 on_fault: Optional[Callable[[List[str]], None]] = None):
+        self._store = store
+        self.node_id = node_id
+        self.num_nodes = num_nodes
+        self.interval = heartbeat_interval
+        self.timeout = timeout or self.ELASTIC_TIMEOUT
+        self.on_fault = on_fault
+        self._stop = False
+        self._threads: List[threading.Thread] = []
+
+    # ------------------------------------------------------------ lease
+    def register(self):
+        """Join the registry and start the heartbeat lease thread
+        (reference: manager.py:254)."""
+        self._store.set(f"elastic/nodes/{self.node_id}", b"1")
+        t = threading.Thread(target=self._beat_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def _beat_loop(self):
+        while not self._stop:
+            self._store.set(f"elastic/beat/{self.node_id}",
+                            str(time.time()).encode())
+            time.sleep(self.interval)
+
+    # ------------------------------------------------------------ watch
+    def watch(self, node_ids: List[str]):
+        """Master-side fault watch (reference: _update_fault_tolerance
+        manager.py:457)."""
+        t = threading.Thread(target=self._watch_loop, args=(node_ids,),
+                             daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def _watch_loop(self, node_ids):
+        while not self._stop:
+            time.sleep(self.interval)
+            now = time.time()
+            dead = []
+            for nid in node_ids:
+                try:
+                    raw = self._store.get(f"elastic/beat/{nid}")
+                    last = float(raw.decode())
+                except Exception:
+                    continue
+                if now - last > self.timeout:
+                    dead.append(nid)
+            if dead and self.on_fault is not None:
+                self.on_fault(dead)
+
+    def stop(self):
+        self._stop = True

@@ -15,7 +15,7 @@ from .. import collective as dist
 
 __all__ = ["TensorParallel", "ShardingParallel", "SegmentParallel",
            "LayerDesc", "SharedLayerDesc", "PipelineLayer",
-           "PipelineParallel"]
+           "PipelineParallel", "PipelineParallelWithInterleave"]
 
 
 def _broadcast_parameters(model, group, src_rank):
@@ -130,30 +130,55 @@ class PipelineLayer(nn.Layer):
             self._hcg.get_pipe_parallel_world_size() if self._hcg else 1)
         self._stage_id = (self._hcg.get_stage_id() if self._hcg else 0)
         self._recompute_interval = recompute_interval
+        self._num_virtual = num_virtual_pipeline_stages or 1
         self.descs = list(layers)
 
         n = len(self.descs)
-        per = [n // self._num_stages] * self._num_stages
-        for i in range(n % self._num_stages):
+        total_virtual = self._num_stages * self._num_virtual
+        per = [n // total_virtual] * total_virtual
+        for i in range(n % total_virtual):
             per[i] += 1
         starts = np.cumsum([0] + per)
         self.segment_parts = starts.tolist()
-        self._start = int(starts[self._stage_id])
-        self._end = int(starts[self._stage_id + 1])
+        # virtual stage vs holds layers [starts[vs], starts[vs+1]); this
+        # rank owns virtual stages stage_id + k*num_stages (interleaved
+        # assignment, reference pp_layers.py _interleave)
+        self._chunks: List[nn.LayerList] = []
+        for k in range(self._num_virtual):
+            vs = self._stage_id + k * self._num_stages
+            built = []
+            for i in range(int(starts[vs]), int(starts[vs + 1])):
+                d = self.descs[i]
+                built.append(d.build_layer() if isinstance(d, LayerDesc)
+                             else d)
+            self._chunks.append(nn.LayerList(built))
+        # flat view for the plain (non-interleaved) path + parameters()
+        self.run_function = nn.LayerList(
+            [l for c in self._chunks for l in c])
 
-        built = []
-        for i in range(self._start, self._end):
-            d = self.descs[i]
-            built.append(d.build_layer() if isinstance(d, LayerDesc) else d)
-        self.run_function = nn.LayerList(built)
+    def get_num_virtual_stages(self):
+        return self._num_virtual
+
+    def forward_chunk(self, x, chunk_id: int):
+        for layer in self._chunks[chunk_id]:
+            x = layer(x)
+        return x
 
     def get_stage_from_index(self, layer_idx):
-        for s in range(self._num_stages):
-            if self.segment_parts[s] <= layer_idx < self.segment_parts[s + 1]:
-                return s
+        total_virtual = self._num_stages * self._num_virtual
+        for vs in range(total_virtual):
+            if self.segment_parts[vs] <= layer_idx \
+                    < self.segment_parts[vs + 1]:
+                return vs % self._num_stages
         return self._num_stages - 1
 
     def forward(self, x):
+        if self._num_virtual > 1:
+            raise RuntimeError(
+                "PipelineLayer with num_virtual_pipeline_stages>1 holds "
+                "non-contiguous chunks; drive it with "
+                "PipelineParallelWithInterleave (forward_chunk), not the "
+                "flat forward")
         for i, layer in enumerate(self.run_function):
             if self._recompute_interval > 0 and \
                     i % self._recompute_interval == 0 and self.training:
@@ -174,6 +199,11 @@ class PipelineParallel(_MetaParallelBase):
     def __init__(self, layers, hcg, strategy=None):
         if not isinstance(layers, PipelineLayer):
             raise TypeError("PipelineParallel expects a PipelineLayer")
+        if type(self) is PipelineParallel \
+                and layers.get_num_virtual_stages() > 1:
+            raise ValueError(
+                "layers were built with num_virtual_pipeline_stages>1; "
+                "use PipelineParallelWithInterleave")
         super().__init__(layers, hcg, strategy)
         self.num_stages = hcg.get_pipe_parallel_world_size()
         self.stage_id = hcg.get_stage_id()
@@ -185,9 +215,10 @@ class PipelineParallel(_MetaParallelBase):
         cfg = (strategy.pipeline_configs if strategy is not None else
                {"accumulate_steps": 1})
         self.accumulate_steps = cfg.get("accumulate_steps", 1)
-        self._send_meta_known = False
-        self._recv_shape = None
-        self._recv_dtype = None
+        # SendRecvMeta caches keyed by (peer, tag): fwd activations and bwd
+        # grads are distinct channels (reference pp_utils SendRecvMeta)
+        self._send_meta_known = set()
+        self._recv_meta = {}
 
     def _prepare_for_model(self):
         hcg = self._hcg
@@ -197,10 +228,10 @@ class PipelineParallel(_MetaParallelBase):
                 hcg.get_data_parallel_group_src_rank())
 
     # ---------------------------------------------------------------- p2p
-    def _send_tensor(self, t: Tensor, dst):
+    def _send_tensor(self, t: Tensor, dst, tag: str = "fwd"):
         import pickle
 
-        if not self._send_meta_known:
+        if (dst, tag) not in self._send_meta_known:
             # SendRecvMeta handshake: ship (shape, dtype) once, then cache
             meta = pickle.dumps((tuple(t.shape), str(t._data.dtype)))
             meta_arr = np.frombuffer(meta, dtype=np.uint8)
@@ -211,24 +242,23 @@ class PipelineParallel(_MetaParallelBase):
             pad = np.zeros(4096, dtype=np.uint8)
             pad[:meta_arr.size] = meta_arr
             dist.send(Tensor(pad), dst, group=self.pp_group)
-            self._send_meta_known = True
+            self._send_meta_known.add((dst, tag))
         dist.send(t, dst, group=self.pp_group)
 
-    def _recv_tensor(self, src) -> Tensor:
+    def _recv_tensor(self, src, tag: str = "fwd") -> Tensor:
         import pickle
 
-        if self._recv_shape is None:
+        if (src, tag) not in self._recv_meta:
             hdr = Tensor(np.zeros(8, dtype=np.int64))
             dist.recv(hdr, src, group=self.pp_group)
             n = int(hdr.numpy()[0])
             pad = Tensor(np.zeros(4096, dtype=np.uint8))
             dist.recv(pad, src, group=self.pp_group)
             shape, dtype = pickle.loads(pad.numpy()[:n].tobytes())
-            self._recv_shape, self._recv_dtype = shape, dtype
-        buf = Tensor(np.zeros(self._recv_shape,
-                              dtype=np.dtype(self._recv_dtype)
-                              if self._recv_dtype != "bfloat16"
-                              else np.float32))
+            self._recv_meta[(src, tag)] = (shape, dtype)
+        shape, dtype = self._recv_meta[(src, tag)]
+        buf = Tensor(np.zeros(shape, dtype=np.dtype(dtype)
+                              if dtype != "bfloat16" else np.float32))
         dist.recv(buf, src, group=self.pp_group)
         buf.stop_gradient = False
         return buf
@@ -346,3 +376,117 @@ class PipelineParallel(_MetaParallelBase):
 
     def forward(self, *inputs, **kwargs):
         return self._layers(*inputs, **kwargs)
+
+
+class PipelineParallelWithInterleave(PipelineParallel):
+    """Interleaved 1F1B (virtual pipeline / VPP, reference:
+    pipeline_parallel.py:1174 PipelineParallelWithInterleave).
+
+    Each rank holds ``v = num_virtual_pipeline_stages`` model chunks; virtual
+    stage ``vs = chunk*p + stage``. Forward activations flow rank r -> r+1
+    (wrapping p-1 -> 0 between chunks); grads flow the reverse ring. The
+    Megatron iteration order is identical on every rank, and the CPU/XLA
+    ProcessGroup's buffered FIFO p2p makes the schedule deadlock-free.
+
+    The reference's zero-bubble schedule (pipeline_zero_bubble.py:62) splits
+    backward into B (input-grad) and W (weight-grad) passes; jax.vjp yields
+    both grads in one pass, so ZB's W-fill is not expressible without double
+    backward cost — on TPU the XLA-compiled 1F1B step is the supported
+    optimum (see SURVEY §7 hard parts).
+    """
+
+    def __init__(self, layers, hcg, strategy=None):
+        super().__init__(layers, hcg, strategy)
+        self.num_chunks = layers.get_num_virtual_stages()
+        if self.accumulate_steps % self.num_stages != 0:
+            raise ValueError(
+                "interleaved schedule needs accumulate_steps divisible by "
+                f"pp degree ({self.accumulate_steps} % {self.num_stages})")
+
+    # ring peers (wrapping, unlike plain PP)
+    def _ring_next(self):
+        ranks = self.pp_group.ranks
+        return ranks[(self.stage_id + 1) % self.num_stages]
+
+    def _ring_prev(self):
+        ranks = self.pp_group.ranks
+        return ranks[(self.stage_id - 1) % self.num_stages]
+
+    def _virt(self, k):
+        """iteration index -> (chunk_id, microbatch_id); Megatron order."""
+        p, v = self.num_stages, self.num_chunks
+        chunk = (k // p) % v
+        micro = (k // (p * v)) * p + k % p
+        return chunk, micro
+
+    def forward_backward_pipeline(self, data, scaler=None):
+        p, v = self.num_stages, self.num_chunks
+        num_micro = self.accumulate_steps
+        total = num_micro * v
+        micro_inputs = self._split_micro(data, num_micro)
+        # buffers[chunk][micro] = (input, output)
+        inputs = [[None] * num_micro for _ in range(v)]
+        outputs = [[None] * num_micro for _ in range(v)]
+        losses = []
+
+        def is_first_vs(chunk):
+            return chunk == 0 and self.stage_id == 0
+
+        def is_last_vs(chunk):
+            return chunk == v - 1 and self.stage_id == p - 1
+
+        def fwd_step(k):
+            chunk, micro = self._virt(k)
+            if is_first_vs(chunk):
+                x = micro_inputs[micro][0] if micro_inputs else None
+            else:
+                x = self._recv_tensor(self._ring_prev(), tag="fwd")
+            out = self._layers.forward_chunk(x, chunk)
+            if is_last_vs(chunk):
+                loss_fn = self._layers._loss_fn
+                if loss_fn is not None and micro_inputs:
+                    out = loss_fn(out, micro_inputs[micro][1])
+                if scaler is not None:
+                    out = scaler.scale(out)
+                out = out / num_micro
+                losses.append(out)
+            else:
+                self._send_tensor(out.detach(), self._ring_next(), tag="fwd")
+            inputs[chunk][micro] = x
+            outputs[chunk][micro] = out
+
+        def bwd_step(k):
+            # backward visits virtual stages in reverse chunk order
+            chunk, micro = self._virt(k)
+            chunk = v - 1 - chunk
+            out = outputs[chunk][micro]
+            if is_last_vs(chunk):
+                out.backward()
+            else:
+                grad = self._recv_tensor(self._ring_next(), tag="bwd")
+                out.backward(grad)
+            x = inputs[chunk][micro]
+            if not is_first_vs(chunk) and x is not None \
+                    and x.grad is not None:
+                self._send_tensor(x.grad, self._ring_prev(), tag="bwd")
+
+        warmup = min((p - self.stage_id - 1) * 2 + (v - 1) * p, total)
+        fwd_k = bwd_k = 0
+        for _ in range(warmup):
+            fwd_step(fwd_k)
+            fwd_k += 1
+        for _ in range(total - warmup):
+            fwd_step(fwd_k)
+            fwd_k += 1
+            bwd_step(bwd_k)
+            bwd_k += 1
+        while bwd_k < total:
+            bwd_step(bwd_k)
+            bwd_k += 1
+
+        if losses:
+            totl = losses[0]
+            for l in losses[1:]:
+                totl = totl + l
+            return totl.detach()
+        return None

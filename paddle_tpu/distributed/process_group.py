@@ -82,20 +82,29 @@ class ProcessGroup:
     def name(self) -> str:
         return f"pg_{self._gid}"
 
+    def _watched(self, op_name: str):
+        # comm watchdog span (reference: CommTaskManager watchdog)
+        from . import watchdog
+
+        return watchdog.watch(op_name, self._gid)
+
     # -- collective API: subclasses implement the _impl methods on numpy ----
     def all_reduce(self, tensor: Tensor, op=ReduceOp.SUM, sync_op=True):
-        out = self._all_reduce_impl(tensor.numpy(), op)
+        with self._watched("all_reduce"):
+            out = self._all_reduce_impl(tensor.numpy(), op)
         tensor._data = _to_jax(out, tensor)
         return Task()
 
     def broadcast(self, tensor: Tensor, src: int, sync_op=True):
-        out = self._broadcast_impl(tensor.numpy(), src)
+        with self._watched("broadcast"):
+            out = self._broadcast_impl(tensor.numpy(), src)
         tensor._data = _to_jax(out, tensor)
         return Task()
 
     def all_gather(self, tensor_list: List[Tensor], tensor: Tensor,
                    sync_op=True):
-        outs = self._all_gather_impl(tensor.numpy())
+        with self._watched("all_gather"):
+            outs = self._all_gather_impl(tensor.numpy())
         if tensor_list is not None:
             if len(tensor_list) == 0:
                 tensor_list.extend(Tensor(o) for o in outs)
@@ -105,7 +114,8 @@ class ProcessGroup:
         return Task()
 
     def reduce(self, tensor: Tensor, dst: int, op=ReduceOp.SUM, sync_op=True):
-        out = self._reduce_impl(tensor.numpy(), dst, op)
+        with self._watched("reduce"):
+            out = self._reduce_impl(tensor.numpy(), dst, op)
         if self._rank == dst:
             tensor._data = _to_jax(out, tensor)
         return Task()
@@ -113,22 +123,25 @@ class ProcessGroup:
     def reduce_scatter(self, tensor: Tensor, tensor_list: List[Tensor],
                        op=ReduceOp.SUM, sync_op=True):
         ins = [t.numpy() for t in tensor_list]
-        out = self._reduce_scatter_impl(ins, op)
+        with self._watched("reduce_scatter"):
+            out = self._reduce_scatter_impl(ins, op)
         tensor._data = _to_jax(out, tensor)
         return Task()
 
     def scatter(self, tensor: Tensor, tensor_list: List[Tensor], src: int,
                 sync_op=True):
         ins = [t.numpy() for t in tensor_list] if self._rank == src else None
-        out = self._scatter_impl(ins, src,
-                                 shape=tensor.numpy().shape,
-                                 dtype=tensor.numpy().dtype)
+        with self._watched("scatter"):
+            out = self._scatter_impl(ins, src,
+                                     shape=tensor.numpy().shape,
+                                     dtype=tensor.numpy().dtype)
         tensor._data = _to_jax(out, tensor)
         return Task()
 
     def gather(self, tensor: Tensor, gather_list: Optional[List[Tensor]],
                dst: int, sync_op=True):
-        outs = self._gather_impl(tensor.numpy(), dst)
+        with self._watched("gather"):
+            outs = self._gather_impl(tensor.numpy(), dst)
         if self._rank == dst and gather_list is not None:
             if len(gather_list) == 0:
                 gather_list.extend(Tensor(o) for o in outs)
@@ -139,7 +152,9 @@ class ProcessGroup:
 
     def all_to_all(self, out_tensor_list: List[Tensor],
                    in_tensor_list: List[Tensor], sync_op=True):
-        outs = self._all_to_all_impl([t.numpy() for t in in_tensor_list])
+        with self._watched("all_to_all"):
+            outs = self._all_to_all_impl(
+                [t.numpy() for t in in_tensor_list])
         if len(out_tensor_list) == 0:
             out_tensor_list.extend(Tensor(o) for o in outs)
         else:
@@ -148,16 +163,20 @@ class ProcessGroup:
         return Task()
 
     def send(self, tensor: Tensor, dst: int, sync_op=True):
-        self._send_impl(tensor.numpy(), dst)
+        with self._watched("send"):
+            self._send_impl(tensor.numpy(), dst)
         return Task()
 
     def recv(self, tensor: Tensor, src: int, sync_op=True):
-        out = self._recv_impl(src, tensor.numpy().shape, tensor.numpy().dtype)
+        with self._watched("recv"):
+            out = self._recv_impl(src, tensor.numpy().shape,
+                                  tensor.numpy().dtype)
         tensor._data = _to_jax(out, tensor)
         return Task()
 
     def barrier(self, device_id: Optional[int] = None):
-        self._barrier_impl()
+        with self._watched("barrier"):
+            self._barrier_impl()
         return Task()
 
     # -- coalescing (reference: process_group.h:119-121) --------------------

@@ -1,0 +1,145 @@
+"""Collective watchdog: hang/timeout detection for comm ops
+(reference: phi/core/distributed/comm_task_manager.h:37 CommTaskManager,
+NCCLCommTask::IsTimeout nccl_comm_task.cc:234, AbortComm :240).
+
+Enable with ``PADDLE_TPU_COMM_TIMEOUT=<seconds>`` or ``enable(timeout)``:
+every ProcessGroup collective is registered as a CommTask; a daemon thread
+flags tasks that exceed the timeout, dumps the in-flight trace (op name,
+group, start time — the FLAGS_enable_async_trace analog) and calls the
+abort callback (default: os._exit, like the reference's AbortComm
+process teardown so a hung ring cannot wedge the job silently).
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+__all__ = ["CommTask", "CommTaskManager", "enable", "disable", "watch"]
+
+
+class CommTask:
+    def __init__(self, op_name: str, group_id: int, timeout: float):
+        self.op_name = op_name
+        self.group_id = group_id
+        self.start = time.monotonic()
+        self.timeout = timeout
+        self.done = False
+
+    def is_timeout(self) -> bool:
+        return not self.done and \
+            (time.monotonic() - self.start) > self.timeout
+
+    def __repr__(self):
+        age = time.monotonic() - self.start
+        return (f"CommTask(op={self.op_name}, group={self.group_id}, "
+                f"age={age:.1f}s, timeout={self.timeout}s)")
+
+
+class CommTaskManager:
+    """reference: comm_task_manager.h:37 — polls async comm tasks."""
+
+    _instance: Optional["CommTaskManager"] = None
+
+    def __init__(self, poll_interval: float = 1.0):
+        self._tasks: Dict[int, CommTask] = {}
+        self._lock = threading.Lock()
+        self._next_id = 0
+        self._poll = poll_interval
+        self._stop = False
+        self.on_timeout: Callable[[CommTask], None] = self._default_abort
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    @classmethod
+    def instance(cls) -> "CommTaskManager":
+        if cls._instance is None:
+            cls._instance = CommTaskManager()
+        return cls._instance
+
+    def register(self, op_name: str, group_id: int, timeout: float) -> int:
+        with self._lock:
+            tid = self._next_id
+            self._next_id += 1
+            self._tasks[tid] = CommTask(op_name, group_id, timeout)
+            return tid
+
+    def complete(self, tid: int):
+        with self._lock:
+            t = self._tasks.pop(tid, None)
+            if t is not None:
+                t.done = True
+
+    def in_flight(self):
+        with self._lock:
+            return list(self._tasks.values())
+
+    def _loop(self):
+        while not self._stop:
+            time.sleep(self._poll)
+            with self._lock:
+                expired = [t for t in self._tasks.values() if t.is_timeout()]
+            for t in expired:
+                self._dump_trace(t)
+                self.on_timeout(t)
+
+    def _dump_trace(self, task: CommTask):
+        import sys
+
+        print(f"[comm-watchdog] TIMEOUT: {task}", file=sys.stderr)
+        for t in self.in_flight():
+            print(f"[comm-watchdog]   in-flight: {t}", file=sys.stderr)
+
+    def _default_abort(self, task: CommTask):
+        # reference AbortComm: tear the process down so the launcher's
+        # restart policy can recover the job
+        os._exit(124)
+
+    def shutdown(self):
+        self._stop = True
+
+
+_timeout: Optional[float] = None
+
+
+def _env_timeout() -> Optional[float]:
+    v = os.environ.get("PADDLE_TPU_COMM_TIMEOUT")
+    return float(v) if v else None
+
+
+def enable(timeout: float, on_timeout=None):
+    global _timeout
+    _timeout = timeout
+    mgr = CommTaskManager.instance()
+    if on_timeout is not None:
+        mgr.on_timeout = on_timeout
+
+
+def disable():
+    global _timeout
+    _timeout = None
+
+
+def get_timeout() -> Optional[float]:
+    return _timeout if _timeout is not None else _env_timeout()
+
+
+class watch:
+    """Context manager wrapping one collective invocation."""
+
+    def __init__(self, op_name: str, group_id: int = 0):
+        self.op_name = op_name
+        self.group_id = group_id
+        self._tid = None
+
+    def __enter__(self):
+        t = get_timeout()
+        if t is not None:
+            self._tid = CommTaskManager.instance().register(
+                self.op_name, self.group_id, t)
+        return self
+
+    def __exit__(self, *exc):
+        if self._tid is not None:
+            CommTaskManager.instance().complete(self._tid)

@@ -1,0 +1,86 @@
+"""Pipeline parallelism: 2-rank 1F1B + interleaved VPP, multi-process over
+the CPU backend (reference analog: test/collective/fleet/
+hybrid_parallel_pp_layer.py, hybrid_parallel_pp_interleave.py)."""
+import os
+
+import numpy as np
+import pytest
+
+
+def _pp_worker(mode):
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu as pt
+    from paddle_tpu import nn
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.fleet.meta_parallel import (
+        PipelineLayer, PipelineParallel, PipelineParallelWithInterleave)
+
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1,
+                               "pp_degree": 2}
+    strategy.pipeline_configs = {"accumulate_steps": 4, "micro_batch_size": 2}
+    fleet.init(is_collective=True, strategy=strategy)
+    hcg = fleet.get_hybrid_communicate_group()
+
+    pt.seed(42)
+    n_layers = 4 if mode == "1f1b" else 8
+    vpp = None if mode == "1f1b" else 2
+    layers = [nn.Linear(8, 8) for _ in range(n_layers)]
+
+    def loss_fn(out, label):
+        return ((out - label) ** 2).mean()
+
+    pipe = PipelineLayer(layers, loss_fn=loss_fn,
+                         num_virtual_pipeline_stages=vpp)
+    cls = PipelineParallel if mode == "1f1b" \
+        else PipelineParallelWithInterleave
+    model = cls(pipe, hcg, strategy)
+    opt = pt.optimizer.SGD(parameters=pipe.parameters(), learning_rate=0.01)
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(8, 8).astype(np.float32)
+    Y = rng.randn(8, 8).astype(np.float32) * 0.1
+
+    losses = []
+    for step in range(8):
+        loss = model.train_batch((pt.to_tensor(X), pt.to_tensor(Y)), opt)
+        if loss is not None:
+            losses.append(float(loss))
+    if hcg.is_last_stage():
+        assert losses[-1] < losses[0], losses
+        # single-process reference: same layers sequentially
+        pt.seed(42)
+        ref_layers = [nn.Linear(8, 8) for _ in range(n_layers)]
+        ref_opt = pt.optimizer.SGD(
+            parameters=[p for l in ref_layers for p in l.parameters()],
+            learning_rate=0.01)
+        ref_losses = []
+        for step in range(8):
+            x = pt.to_tensor(X)
+            for l in ref_layers:
+                x = l(x)
+            loss = ((x - pt.to_tensor(Y)) ** 2).mean()
+            loss.backward()
+            ref_opt.step()
+            ref_opt.clear_grad()
+            ref_losses.append(float(loss))
+        np.testing.assert_allclose(losses, ref_losses, rtol=2e-4, atol=1e-5)
+
+
+def _run(mode):
+    # spawn must import this module; guard against jax platform leakage
+    os.environ.setdefault("XLA_FLAGS",
+                          "--xla_force_host_platform_device_count=8")
+    from paddle_tpu.distributed.spawn import spawn
+
+    spawn(_pp_worker, args=(mode,), nprocs=2)
+
+
+def test_pipeline_1f1b_matches_single_process():
+    _run("1f1b")
+
+
+def test_pipeline_interleave_matches_single_process():
+    _run("interleave")

@@ -1,0 +1,105 @@
+"""Comm watchdog, auto-tuner, elastic manager (reference analogs:
+comm_task_manager tests, test/auto_tuner/, fleet/elastic tests)."""
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed import watchdog
+from paddle_tpu.distributed.auto_tuner import (AutoTuner, Config,
+                                               default_candidates,
+                                               prune_by_memory)
+
+
+class TestWatchdog:
+    def test_task_timeout_detection(self):
+        fired = []
+        mgr = watchdog.CommTaskManager.instance()
+        mgr.on_timeout = lambda t: fired.append(t)
+        watchdog.enable(0.2)
+        try:
+            tid = mgr.register("all_reduce_test", 0, 0.2)
+            deadline = time.time() + 5
+            while not fired and time.time() < deadline:
+                time.sleep(0.1)
+            assert fired and fired[0].op_name == "all_reduce_test"
+            mgr.complete(tid)
+        finally:
+            watchdog.disable()
+            mgr.on_timeout = mgr._default_abort
+
+    def test_completed_task_does_not_fire(self):
+        fired = []
+        mgr = watchdog.CommTaskManager.instance()
+        mgr.on_timeout = lambda t: fired.append(t)
+        watchdog.enable(0.2)
+        try:
+            with watchdog.watch("quick_op"):
+                pass
+            time.sleep(0.5)
+            assert not fired
+        finally:
+            watchdog.disable()
+            mgr.on_timeout = mgr._default_abort
+
+    def test_disabled_no_registration(self):
+        watchdog.disable()
+        mgr = watchdog.CommTaskManager.instance()
+        before = len(mgr.in_flight())
+        with watchdog.watch("noop"):
+            assert len(mgr.in_flight()) == before
+
+
+class TestAutoTuner:
+    def test_candidates_valid(self):
+        cands = default_candidates(num_devices=8, global_batch_size=16,
+                                   num_layers=12)
+        assert cands
+        for c in cands:
+            assert c.degree_product() == 8
+            assert 16 % (c.dp_degree * c.sharding_degree) == 0
+            if c.pp_degree > 1:
+                assert 12 % c.pp_degree == 0
+
+    def test_memory_prune(self):
+        cands = [Config(mp_degree=1), Config(mp_degree=8)]
+        kept = prune_by_memory(cands, model_bytes=10 << 30,
+                               hbm_bytes=16 << 30)
+        assert all(c.mp_degree == 8 for c in kept)
+
+    def test_search_picks_best(self, tmp_path):
+        cands = [Config(dp_degree=d) for d in (1, 2, 4)]
+
+        def run_fn(cfg):
+            if cfg.dp_degree == 4:
+                raise MemoryError("oom")  # recorded, skipped
+            return float(cfg.dp_degree * 100)
+
+        tuner = AutoTuner(cands, run_fn, mode="max",
+                          log_path=str(tmp_path / "log.jsonl"))
+        best = tuner.search()
+        assert best.dp_degree == 2
+        assert len(tuner.history) == 3
+        assert tuner.history[-1]["error"] is not None
+
+
+class TestElastic:
+    def test_heartbeat_and_fault_detect(self):
+        from paddle_tpu.distributed.fleet.elastic import ElasticManager
+        from paddle_tpu.distributed.store import TCPStore
+
+        store = TCPStore("127.0.0.1", 0, is_master=True)
+        dead = []
+        alive = ElasticManager(store, "node0", 2, heartbeat_interval=0.1,
+                               timeout=0.5,
+                               on_fault=lambda d: dead.extend(d))
+        alive.register()
+        # node1 heartbeats once, then "dies"
+        store.set("elastic/beat/node1", str(time.time()).encode())
+        alive.watch(["node0", "node1"])
+        deadline = time.time() + 5
+        while "node1" not in dead and time.time() < deadline:
+            time.sleep(0.1)
+        assert "node1" in dead
+        assert "node0" not in dead
+        alive.stop()
