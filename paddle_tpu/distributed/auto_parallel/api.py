@@ -300,10 +300,22 @@ class _ShardDataLoader:
     def __init__(self, dataloader, meshes, input_keys=None, shard_dims=None,
                  is_dataset_splitted=False):
         self._loader = dataloader
-        self._mesh = meshes[0] if isinstance(meshes, (list, tuple)) \
-            else meshes
+        if isinstance(meshes, (list, tuple)):
+            if len(meshes) > 1:
+                raise NotImplementedError(
+                    "shard_dataloader with multiple meshes (per-pipeline-"
+                    "stage inputs) is not supported yet; pass one mesh")
+            self._mesh = meshes[0]
+        else:
+            self._mesh = meshes
         self._shard_dims = shard_dims
         self._input_keys = set(input_keys) if input_keys else None
+        if isinstance(shard_dims, int):
+            shard_dims = self._mesh.dim_names[shard_dims]
+        if shard_dims is not None and not isinstance(shard_dims, str):
+            raise NotImplementedError(
+                f"shard_dims={shard_dims!r}: only a mesh-dim name or index "
+                "is supported")
         axis = None
         if isinstance(shard_dims, str):
             axis = shard_dims

@@ -56,6 +56,9 @@ def default_candidates(num_devices: int, global_batch_size: int,
             # prune: pp must divide layer count (reference prune.py)
             if num_layers is not None and pp > 1 and num_layers % pp:
                 continue
+            # prune: mp must divide the vocab/hidden divisor
+            if vocab_divisor > 1 and vocab_divisor % mp:
+                continue
             # prune: dp*shard must divide global batch
             if global_batch_size % (dp * shard):
                 continue

@@ -57,20 +57,32 @@ class ElasticManager:
         self._threads.append(t)
 
     def _watch_loop(self, node_ids):
+        watch_start = time.time()
+        reported = set()
         while not self._stop:
             time.sleep(self.interval)
             now = time.time()
             dead = []
             for nid in node_ids:
                 try:
-                    raw = self._store.get(f"elastic/beat/{nid}")
-                    last = float(raw.decode())
+                    # check() first — get() would block on a missing key
+                    if self._store.check(f"elastic/beat/{nid}"):
+                        raw = self._store.get(f"elastic/beat/{nid}")
+                        last = float(raw.decode())
+                    else:
+                        # never heartbeat at all: dead once the grace
+                        # period from watch start has passed
+                        last = watch_start
                 except Exception:
-                    continue
+                    last = watch_start
                 if now - last > self.timeout:
                     dead.append(nid)
-            if dead and self.on_fault is not None:
-                self.on_fault(dead)
+                elif nid in reported:
+                    reported.discard(nid)  # recovered: re-arm reporting
+            fresh = [n for n in dead if n not in reported]
+            reported.update(fresh)
+            if fresh and self.on_fault is not None:
+                self.on_fault(fresh)
 
     def stop(self):
         self._stop = True

@@ -217,7 +217,7 @@ class PipelineParallel(_MetaParallelBase):
         self.accumulate_steps = cfg.get("accumulate_steps", 1)
         # SendRecvMeta caches keyed by (peer, tag): fwd activations and bwd
         # grads are distinct channels (reference pp_utils SendRecvMeta)
-        self._send_meta_known = set()
+        self._send_meta_known = {}
         self._recv_meta = {}
 
     def _prepare_for_model(self):
@@ -229,33 +229,39 @@ class PipelineParallel(_MetaParallelBase):
 
     # ---------------------------------------------------------------- p2p
     def _send_tensor(self, t: Tensor, dst, tag: str = "fwd"):
+        """SendRecvMeta protocol (reference pp_utils SendRecvMeta): every
+        tensor is preceded by an 8-int64 header; header[0] > 0 means a
+        meta blob follows (shape/dtype changed on this channel, e.g. VPP
+        chunks with different boundary shapes), 0 means reuse cached."""
         import pickle
 
-        if (dst, tag) not in self._send_meta_known:
-            # SendRecvMeta handshake: ship (shape, dtype) once, then cache
-            meta = pickle.dumps((tuple(t.shape), str(t._data.dtype)))
+        cur = (tuple(t.shape), str(t._data.dtype))
+        if self._send_meta_known.get((dst, tag)) != cur:
+            meta = pickle.dumps(cur)
             meta_arr = np.frombuffer(meta, dtype=np.uint8)
-            # fixed-size header
             hdr = np.zeros(8, dtype=np.int64)
             hdr[0] = meta_arr.size
             dist.send(Tensor(hdr), dst, group=self.pp_group)
             pad = np.zeros(4096, dtype=np.uint8)
             pad[:meta_arr.size] = meta_arr
             dist.send(Tensor(pad), dst, group=self.pp_group)
-            self._send_meta_known.add((dst, tag))
+            self._send_meta_known[(dst, tag)] = cur
+        else:
+            dist.send(Tensor(np.zeros(8, dtype=np.int64)), dst,
+                      group=self.pp_group)
         dist.send(t, dst, group=self.pp_group)
 
     def _recv_tensor(self, src, tag: str = "fwd") -> Tensor:
         import pickle
 
-        if (src, tag) not in self._recv_meta:
-            hdr = Tensor(np.zeros(8, dtype=np.int64))
-            dist.recv(hdr, src, group=self.pp_group)
-            n = int(hdr.numpy()[0])
+        hdr = Tensor(np.zeros(8, dtype=np.int64))
+        dist.recv(hdr, src, group=self.pp_group)
+        n = int(hdr.numpy()[0])
+        if n > 0:
             pad = Tensor(np.zeros(4096, dtype=np.uint8))
             dist.recv(pad, src, group=self.pp_group)
-            shape, dtype = pickle.loads(pad.numpy()[:n].tobytes())
-            self._recv_meta[(src, tag)] = (shape, dtype)
+            self._recv_meta[(src, tag)] = pickle.loads(
+                pad.numpy()[:n].tobytes())
         shape, dtype = self._recv_meta[(src, tag)]
         buf = Tensor(np.zeros(shape, dtype=np.dtype(dtype)
                               if dtype != "bfloat16" else np.float32))

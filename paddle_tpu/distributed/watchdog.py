@@ -79,8 +79,12 @@ class CommTaskManager:
         while not self._stop:
             time.sleep(self._poll)
             with self._lock:
-                expired = [t for t in self._tasks.values() if t.is_timeout()]
-            for t in expired:
+                expired = [(tid, t) for tid, t in self._tasks.items()
+                           if t.is_timeout()]
+                # fire once per task: drop before invoking the handler
+                for tid, _ in expired:
+                    self._tasks.pop(tid, None)
+            for _, t in expired:
                 self._dump_trace(t)
                 self.on_timeout(t)
 
@@ -100,7 +104,8 @@ class CommTaskManager:
         self._stop = True
 
 
-_timeout: Optional[float] = None
+_UNSET = object()
+_timeout = _UNSET  # _UNSET: follow env var; None: explicitly disabled
 
 
 def _env_timeout() -> Optional[float]:
@@ -117,12 +122,16 @@ def enable(timeout: float, on_timeout=None):
 
 
 def disable():
+    """Explicitly off — overrides PADDLE_TPU_COMM_TIMEOUT (e.g. around a
+    first-compile collective that legitimately exceeds the deadline)."""
     global _timeout
     _timeout = None
 
 
 def get_timeout() -> Optional[float]:
-    return _timeout if _timeout is not None else _env_timeout()
+    if _timeout is _UNSET:
+        return _env_timeout()
+    return _timeout
 
 
 class watch:

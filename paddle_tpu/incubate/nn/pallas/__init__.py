@@ -5,9 +5,10 @@ kernels (paddle/phi/kernels/fusion/gpu/) and KPS primitive layer
 Kernels here are hand-tiled for the MXU/VPU and run under the Pallas
 interpreter on non-TPU backends so tests stay hermetic.
 """
-from . import flash_attn, norms
+from . import flash_attn, norms, paged_attention as paged
 from .flash_attn import flash_attention
 from .norms import layer_norm, rms_norm
+from .paged_attention import paged_attention, paged_kv_write
 
-__all__ = ["flash_attn", "norms", "flash_attention", "layer_norm",
-           "rms_norm"]
+__all__ = ["flash_attn", "norms", "paged", "flash_attention", "layer_norm",
+           "rms_norm", "paged_attention", "paged_kv_write"]

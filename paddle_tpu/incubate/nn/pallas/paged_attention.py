@@ -1,0 +1,196 @@
+"""Pallas TPU paged-attention decode kernel (block KV cache).
+
+TPU-native analog of the reference paged/blocked-KV fused kernels
+(reference: phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu and
+masked_multihead_attention_kernel.cu; python surface
+incubate/nn/functional/block_multihead_attention.py).
+
+Single-token decode: each (batch, kv_head) program walks that sequence's
+pages via a scalar-prefetched block table — the page indirection happens in
+the BlockSpec index_map, so only the pages actually referenced are DMA'd
+into VMEM (the point of paged attention). Online-softmax accumulation in
+f32 VMEM scratch across the page grid dimension.
+
+Layouts:
+  q:            [batch, num_heads, head_dim]   (one decode step)
+  k/v_pages:    [num_kv_heads, total_pages, page_size, head_dim]
+  block_tables: [batch, pages_per_seq] int32 (page id per slot)
+  context_lens: [batch] int32
+Grouped-query attention: num_heads % num_kv_heads == 0; the group of query
+heads sharing a kv head is processed together (one MXU matmul per page).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+__all__ = ["paged_attention", "paged_kv_write"]
+
+
+def _interpret_default() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _decode_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                   m_s, l_s, acc_s, *, scale, page_size):
+    b = pl.program_id(0)
+    p = pl.program_id(2)
+    n_pages = pl.num_programs(2)
+
+    @pl.when(p == 0)
+    def _init():
+        m_s[...] = jnp.full_like(m_s, -jnp.inf)
+        l_s[...] = jnp.zeros_like(l_s)
+        acc_s[...] = jnp.zeros_like(acc_s)
+
+    q = q_ref[0, 0].astype(jnp.float32)         # [group, d]
+    k = k_ref[0, 0].astype(jnp.float32)         # [page, d]
+    v = v_ref[0, 0].astype(jnp.float32)         # [page, d]
+
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    # mask tokens beyond this sequence's length
+    token_idx = p * page_size + jax.lax.broadcasted_iota(
+        jnp.int32, s.shape, 1)
+    s = jnp.where(token_idx < len_ref[b], s, -jnp.inf)
+
+    m_prev = m_s[...]                           # [group, 1]
+    m_cur = jnp.max(s, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    # guard fully-masked pages (m_new = -inf): exp(-inf - -inf) -> use 0
+    safe_m = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    alpha = jnp.where(jnp.isfinite(m_prev), jnp.exp(m_prev - safe_m), 0.0)
+    pexp = jnp.where(jnp.isfinite(s), jnp.exp(s - safe_m), 0.0)
+
+    l_s[...] = l_s[...] * alpha + jnp.sum(pexp, axis=-1, keepdims=True)
+    acc_s[...] = acc_s[...] * alpha + jax.lax.dot_general(
+        pexp, v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    m_s[...] = m_new
+
+    @pl.when(p == n_pages - 1)
+    def _flush():
+        l = l_s[...]
+        l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_s[...] / l).astype(o_ref.dtype)
+
+
+def _xla_paged_attention(q, k_pages, v_pages, block_tables, context_lens,
+                         scale):
+    """Reference composition: gather pages then masked attention."""
+    bsz, n_heads, d = q.shape
+    n_kv, total_pages, page, _ = k_pages.shape
+    group = n_heads // n_kv
+    pages_per_seq = block_tables.shape[1]
+    max_len = pages_per_seq * page
+
+    # [b, n_kv, pages_per_seq, page, d]
+    kg = jnp.take(k_pages, block_tables, axis=1)   # [n_kv, b, pp, page, d]
+    vg = jnp.take(v_pages, block_tables, axis=1)
+    kg = jnp.moveaxis(kg, 1, 0).reshape(bsz, n_kv, max_len, d)
+    vg = jnp.moveaxis(vg, 1, 0).reshape(bsz, n_kv, max_len, d)
+    qg = q.reshape(bsz, n_kv, group, d).astype(jnp.float32)
+    s = jnp.einsum("bkgd,bktd->bkgt", qg, kg.astype(jnp.float32)) * scale
+    mask = jnp.arange(max_len)[None, None, None, :] \
+        < context_lens[:, None, None, None]
+    s = jnp.where(mask, s, -jnp.inf)
+    w = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bkgt,bktd->bkgd", w, vg.astype(jnp.float32))
+    return out.reshape(bsz, n_heads, d).astype(q.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "interpret",
+                                             "use_kernel"))
+def paged_attention(q, k_pages, v_pages, block_tables, context_lens,
+                    scale=None, interpret=None, use_kernel=None):
+    """Decode-step attention over a paged KV cache. See module docstring."""
+    bsz, n_heads, d = q.shape
+    n_kv, total_pages, page, _ = k_pages.shape
+    assert n_heads % n_kv == 0
+    group = n_heads // n_kv
+    pages_per_seq = block_tables.shape[1]
+    if scale is None:
+        scale = d ** -0.5
+    if interpret is None:
+        interpret = _interpret_default()
+    if use_kernel is None:
+        # kernel path needs TPU-friendly tiles; group dim feeds the MXU
+        use_kernel = (d in (64, 128, 256) and page % 128 == 0) \
+            or interpret
+    if not use_kernel:
+        return _xla_paged_attention(q, k_pages, v_pages, block_tables,
+                                    context_lens, scale)
+
+    qg = q.reshape(bsz, n_kv, group, d)
+    grid = (bsz, n_kv, pages_per_seq)
+
+    kernel = functools.partial(_decode_kernel, scale=scale, page_size=page)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,          # block_tables, context_lens
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, group, d),
+                         lambda b, h, p, bt, cl: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, page, d),
+                         lambda b, h, p, bt, cl: (h, bt[b, p], 0, 0)),
+            pl.BlockSpec((1, 1, page, d),
+                         lambda b, h, p, bt, cl: (h, bt[b, p], 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, group, d),
+                               lambda b, h, p, bt, cl: (b, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((group, 1), jnp.float32),
+            pltpu.VMEM((group, 1), jnp.float32),
+            pltpu.VMEM((group, d), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((bsz, n_kv, group, d), q.dtype),
+        interpret=interpret,
+    )(block_tables, context_lens,
+      qg.reshape(bsz, n_kv, group, d),
+      k_pages.reshape(n_kv, total_pages, page, d),
+      v_pages)
+    return out.reshape(bsz, n_heads, d)
+
+
+@jax.jit
+def paged_kv_write(k_pages, v_pages, k_new, v_new, block_tables,
+                   context_lens):
+    """Append one decode step's k/v ([batch, n_kv, d]) into the paged cache
+    at position ``context_lens`` (the slot the new token occupies).
+    Returns (k_pages, v_pages) updated — functional, donatable under jit.
+    Reference analog: the cache-write half of
+    block_multi_head_attention_kernel.cu."""
+    n_kv, total_pages, page, d = k_pages.shape
+    bsz = k_new.shape[0]
+    pages_per_seq = block_tables.shape[1]
+    pos = context_lens                     # [b], slot of the new token
+    # sequences whose pages are already full have no slot: no-op write
+    # (otherwise the clamped index would corrupt the last page's slot 0)
+    valid = pos < page * pages_per_seq
+    page_slot = jnp.minimum(pos // page, pages_per_seq - 1)
+    page_idx = jnp.take_along_axis(
+        block_tables, page_slot[:, None], axis=1)[:, 0]       # [b]
+    slot = pos % page                      # [b]
+
+    def write(pages, new):
+        # scatter [b, n_kv, d] into [n_kv, total_pages, page, d]
+        def one(pages, b):
+            cur = pages[:, page_idx[b], slot[b], :]
+            val = jnp.where(valid[b], new[b].astype(pages.dtype), cur)
+            return pages.at[:, page_idx[b], slot[b], :].set(val)
+
+        return jax.lax.fori_loop(0, bsz, lambda b, p: one(p, b), pages)
+
+    return write(k_pages, k_new), write(v_pages, v_new)
