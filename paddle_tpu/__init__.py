@@ -61,6 +61,11 @@ from . import profiler  # noqa: F401,E402
 from . import hapi  # noqa: F401,E402
 from .hapi import Model, summary  # noqa: F401,E402
 from . import static  # noqa: F401,E402
+from . import distribution  # noqa: F401,E402
+from . import sparse  # noqa: F401,E402
+from . import quantization  # noqa: F401,E402
+from . import text  # noqa: F401,E402
+from . import inference  # noqa: F401,E402
 from . import device  # noqa: F401,E402
 from . import models  # noqa: F401,E402
 from .framework.io_utils import load, save  # noqa: F401,E402

@@ -59,6 +59,7 @@ class ElasticManager:
     def _watch_loop(self, node_ids):
         watch_start = time.time()
         reported = set()
+        last_beats: Dict[str, float] = {}
         while not self._stop:
             time.sleep(self.interval)
             now = time.time()
@@ -69,12 +70,15 @@ class ElasticManager:
                     if self._store.check(f"elastic/beat/{nid}"):
                         raw = self._store.get(f"elastic/beat/{nid}")
                         last = float(raw.decode())
+                        last_beats[nid] = last
                     else:
                         # never heartbeat at all: dead once the grace
                         # period from watch start has passed
-                        last = watch_start
+                        last = last_beats.get(nid, watch_start)
                 except Exception:
-                    last = watch_start
+                    # transient store error: keep the last-known beat so a
+                    # healthy node is not declared dead by a blip
+                    last = last_beats.get(nid, now)
                 if now - last > self.timeout:
                     dead.append(nid)
                 elif nid in reported:

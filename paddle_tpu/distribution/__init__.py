@@ -1,0 +1,27 @@
+"""Probability distributions (reference: python/paddle/distribution/ —
+Distribution base distribution.py, Normal, Uniform, Categorical, Bernoulli,
+Beta, Dirichlet, Exponential, Gamma, Laplace, Multinomial, LogNormal,
+kl_divergence kl.py, transforms transform.py, TransformedDistribution,
+Independent)."""
+from .distribution import Distribution  # noqa: F401
+from .normal import LogNormal, Normal  # noqa: F401
+from .uniform import Uniform  # noqa: F401
+from .categorical import Categorical  # noqa: F401
+from .bernoulli import Bernoulli  # noqa: F401
+from .exponential import Exponential  # noqa: F401
+from .laplace import Laplace  # noqa: F401
+from .beta import Beta, Dirichlet, Gamma  # noqa: F401
+from .multinomial import Multinomial  # noqa: F401
+from .kl import kl_divergence, register_kl  # noqa: F401
+from .transform import (AbsTransform, AffineTransform,  # noqa: F401
+                        ChainTransform, ExpTransform, SigmoidTransform,
+                        Transform)
+from .transformed_distribution import (  # noqa: F401
+    Independent, TransformedDistribution)
+
+__all__ = ["Distribution", "Normal", "LogNormal", "Uniform", "Categorical",
+           "Bernoulli", "Exponential", "Laplace", "Beta", "Dirichlet",
+           "Gamma", "Multinomial", "kl_divergence", "register_kl",
+           "Transform", "AffineTransform", "ExpTransform",
+           "SigmoidTransform", "AbsTransform", "ChainTransform",
+           "TransformedDistribution", "Independent"]

@@ -1,0 +1,47 @@
+"""Bernoulli (reference: python/paddle/distribution/bernoulli.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from .distribution import Distribution, _as_t, _op
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs_t = _as_t(probs)
+        super().__init__(batch_shape=tuple(self.probs_t.shape))
+
+    # raw array view used by the kl registry
+    @property
+    def probs_(self):
+        return self.probs_t._data
+
+    @property
+    def mean(self):
+        return _op(lambda p: p, [self.probs_t], "mean")
+
+    @property
+    def variance(self):
+        return _op(lambda p: p * (1 - p), [self.probs_t], "variance")
+
+    def sample(self, shape=()):
+        out_shape = tuple(shape) + self.batch_shape
+        return Tensor(jax.random.bernoulli(
+            self._key(), self.probs_t._data, out_shape)
+            .astype(jnp.float32))
+
+    def log_prob(self, value):
+        return _op(
+            lambda p, v: v * jnp.log(jnp.clip(p, 1e-7, 1 - 1e-7))
+            + (1 - v) * jnp.log1p(-jnp.clip(p, 1e-7, 1 - 1e-7)),
+            [self.probs_t, _as_t(value)], "bernoulli_log_prob")
+
+    def entropy(self):
+        return _op(
+            lambda p: -(jnp.clip(p, 1e-7, 1 - 1e-7)
+                        * jnp.log(jnp.clip(p, 1e-7, 1 - 1e-7))
+                        + (1 - jnp.clip(p, 1e-7, 1 - 1e-7))
+                        * jnp.log1p(-jnp.clip(p, 1e-7, 1 - 1e-7))),
+            [self.probs_t], "bernoulli_entropy")

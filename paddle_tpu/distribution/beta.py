@@ -1,0 +1,118 @@
+"""Beta / Dirichlet / Gamma (reference: python/paddle/distribution/
+{beta,dirichlet,gamma}.py). log_prob/entropy run through run_op so
+Tensor/Parameter concentrations receive gradients."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.special import digamma, gammaln
+
+from ..core.tensor import Tensor
+from .distribution import Distribution, _as_t, _op
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate):
+        self.concentration = _as_t(concentration)
+        self.rate = _as_t(rate)
+        shape = jnp.broadcast_shapes(tuple(self.concentration.shape),
+                                     tuple(self.rate.shape))
+        super().__init__(batch_shape=shape)
+
+    @property
+    def mean(self):
+        return _op(lambda a, b: a / b, [self.concentration, self.rate],
+                   "mean")
+
+    @property
+    def variance(self):
+        return _op(lambda a, b: a / b ** 2,
+                   [self.concentration, self.rate], "variance")
+
+    def sample(self, shape=()):
+        out_shape = tuple(shape) + self.batch_shape
+        g = jax.random.gamma(self._key(), self.concentration._data,
+                             shape=out_shape)
+        return Tensor(g / self.rate._data)
+
+    def log_prob(self, value):
+        return _op(
+            lambda a, b, v: a * jnp.log(b) + (a - 1) * jnp.log(v)
+            - b * v - gammaln(a),
+            [self.concentration, self.rate, _as_t(value)],
+            "gamma_log_prob")
+
+    def entropy(self):
+        return _op(
+            lambda a, b: a - jnp.log(b) + gammaln(a)
+            + (1 - a) * digamma(a),
+            [self.concentration, self.rate], "gamma_entropy")
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta):
+        self.alpha = _as_t(alpha)
+        self.beta = _as_t(beta)
+        shape = jnp.broadcast_shapes(tuple(self.alpha.shape),
+                                     tuple(self.beta.shape))
+        super().__init__(batch_shape=shape)
+
+    @property
+    def mean(self):
+        return _op(lambda a, b: a / (a + b), [self.alpha, self.beta],
+                   "mean")
+
+    @property
+    def variance(self):
+        return _op(lambda a, b: a * b / ((a + b) ** 2 * (a + b + 1)),
+                   [self.alpha, self.beta], "variance")
+
+    def sample(self, shape=()):
+        out_shape = tuple(shape) + self.batch_shape
+        return Tensor(jax.random.beta(
+            self._key(), self.alpha._data, self.beta._data,
+            shape=out_shape))
+
+    def log_prob(self, value):
+        return _op(
+            lambda a, b, v: (a - 1) * jnp.log(v) + (b - 1) * jnp.log1p(-v)
+            - (gammaln(a) + gammaln(b) - gammaln(a + b)),
+            [self.alpha, self.beta, _as_t(value)], "beta_log_prob")
+
+    def entropy(self):
+        return _op(
+            lambda a, b: (gammaln(a) + gammaln(b) - gammaln(a + b))
+            - (a - 1) * digamma(a) - (b - 1) * digamma(b)
+            + (a + b - 2) * digamma(a + b),
+            [self.alpha, self.beta], "beta_entropy")
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration):
+        self.concentration = _as_t(concentration)
+        shape = tuple(self.concentration.shape)
+        super().__init__(batch_shape=shape[:-1], event_shape=shape[-1:])
+
+    @property
+    def mean(self):
+        return _op(lambda a: a / jnp.sum(a, -1, keepdims=True),
+                   [self.concentration], "mean")
+
+    def sample(self, shape=()):
+        out_shape = tuple(shape) + self.batch_shape
+        return Tensor(jax.random.dirichlet(
+            self._key(), self.concentration._data, shape=out_shape))
+
+    def log_prob(self, value):
+        return _op(
+            lambda a, v: jnp.sum((a - 1) * jnp.log(v), -1)
+            - (jnp.sum(gammaln(a), -1) - gammaln(jnp.sum(a, -1))),
+            [self.concentration, _as_t(value)], "dirichlet_log_prob")
+
+    def entropy(self):
+        k = self.concentration.shape[-1]
+        return _op(
+            lambda a: (jnp.sum(gammaln(a), -1) - gammaln(jnp.sum(a, -1)))
+            + (jnp.sum(a, -1) - k) * digamma(jnp.sum(a, -1))
+            - jnp.sum((a - 1) * digamma(a), -1),
+            [self.concentration], "dirichlet_entropy")

@@ -1,0 +1,56 @@
+"""Categorical (reference: python/paddle/distribution/categorical.py).
+Parameterized by unnormalized weights or logits; log_prob/entropy are
+differentiable w.r.t. the input Tensor."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from .distribution import Distribution, _as_t, _op
+
+
+class Categorical(Distribution):
+    def __init__(self, logits, name=None):
+        t = _as_t(logits)
+        arr = t._data
+        # paddle semantics: non-negative weights OR logits; normalize as
+        # weights if all non-negative else softmax over logits
+        if bool(jnp.all(arr >= 0)) and bool(jnp.any(arr != 0)):
+            self.logits_t = _op(
+                lambda a: jnp.log(a / jnp.sum(a, -1, keepdims=True)), [t],
+                "categorical_norm")
+        else:
+            self.logits_t = _op(lambda a: jax.nn.log_softmax(a, -1), [t],
+                                "categorical_norm")
+        super().__init__(batch_shape=tuple(self.logits_t.shape[:-1]))
+
+    @property
+    def logits(self):
+        return self.logits_t._data
+
+    @property
+    def probs_array(self):
+        return jnp.exp(self.logits_t._data)
+
+    @property
+    def num_events(self):
+        return self.logits_t.shape[-1]
+
+    def sample(self, shape=()):
+        out_shape = tuple(shape) + self.batch_shape
+        return Tensor(jax.random.categorical(
+            self._key(), self.logits_t._data, shape=out_shape))
+
+    def log_prob(self, value):
+        idx = (_as_t(value)._data).astype(jnp.int32)
+        return _op(lambda lg: jnp.take_along_axis(
+            lg, idx[..., None], axis=-1)[..., 0],
+            [self.logits_t], "categorical_log_prob")
+
+    def probs(self, value):
+        return _op(jnp.exp, [self.log_prob(value)], "exp")
+
+    def entropy(self):
+        return _op(lambda lg: -jnp.sum(jnp.exp(lg) * lg, axis=-1),
+                   [self.logits_t], "categorical_entropy")

@@ -1,0 +1,74 @@
+"""Distribution base (reference: python/paddle/distribution/distribution.py)."""
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+
+from ..core import random as _rng
+from ..core.autograd import run_op
+from ..core.tensor import Tensor
+
+
+def _as_arr(x):
+    if isinstance(x, Tensor):
+        return x._data
+    return jnp.asarray(x, dtype=jnp.float32)
+
+
+def _as_t(x) -> Tensor:
+    """Keep Tensors (preserving their tape linkage) — parameters given as
+    Tensors/Parameters stay differentiable through log_prob/rsample."""
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(
+        x, dtype=jnp.float32))
+
+
+def _op(fn, args, name):
+    """run_op wrapper: args may mix Tensors and raw values."""
+    return run_op(fn, [a if isinstance(a, Tensor) else jnp.asarray(a)
+                       for a in args], name=name)
+
+
+class Distribution:
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    def sample(self, shape: Sequence[int] = ()):
+        raise NotImplementedError
+
+    def rsample(self, shape: Sequence[int] = ()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return run_op(jnp.exp, [self.log_prob(value)], name="exp")
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        from .kl import kl_divergence
+
+        return kl_divergence(self, other)
+
+    def _key(self):
+        return _rng.next_key()

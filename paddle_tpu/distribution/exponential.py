@@ -1,0 +1,36 @@
+"""Exponential (reference: python/paddle/distribution/exponential.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _as_t, _op
+
+
+class Exponential(Distribution):
+    def __init__(self, rate):
+        self.rate = _as_t(rate)
+        super().__init__(batch_shape=tuple(self.rate.shape))
+
+    @property
+    def mean(self):
+        return _op(lambda r: 1.0 / r, [self.rate], "mean")
+
+    @property
+    def variance(self):
+        return _op(lambda r: 1.0 / r ** 2, [self.rate], "variance")
+
+    def sample(self, shape=()):
+        return self.rsample(shape).detach()
+
+    def rsample(self, shape=()):
+        out_shape = tuple(shape) + self.batch_shape
+        e = jax.random.exponential(self._key(), out_shape)
+        return _op(lambda r: e / r, [self.rate], "exponential_rsample")
+
+    def log_prob(self, value):
+        return _op(lambda r, v: jnp.log(r) - r * v,
+                   [self.rate, _as_t(value)], "exponential_log_prob")
+
+    def entropy(self):
+        return _op(lambda r: 1.0 - jnp.log(r), [self.rate], "entropy")

@@ -1,0 +1,122 @@
+"""KL divergence registry (reference: python/paddle/distribution/kl.py).
+Registered rules run through run_op, so Tensor/Parameter distribution
+parameters receive gradients from KL losses (e.g. the VAE ELBO)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax.scipy.special import digamma, gammaln
+
+from ..core.tensor import Tensor
+from .bernoulli import Bernoulli
+from .beta import Beta, Dirichlet, Gamma
+from .categorical import Categorical
+from .distribution import Distribution, _op
+from .exponential import Exponential
+from .laplace import Laplace
+from .normal import Normal
+from .uniform import Uniform
+
+_REGISTRY = {}
+
+
+def register_kl(p_cls, q_cls):
+    def deco(fn):
+        _REGISTRY[(p_cls, q_cls)] = fn
+        return fn
+
+    return deco
+
+
+def kl_divergence(p: Distribution, q: Distribution) -> Tensor:
+    for (pc, qc), fn in _REGISTRY.items():
+        if isinstance(p, pc) and isinstance(q, qc):
+            return fn(p, q)
+    raise NotImplementedError(
+        f"kl_divergence not registered for ({type(p).__name__}, "
+        f"{type(q).__name__})")
+
+
+@register_kl(Normal, Normal)
+def _kl_normal(p, q):
+    return _op(
+        lambda pl, ps, ql, qs: 0.5 * ((ps / qs) ** 2
+                                      + ((pl - ql) / qs) ** 2 - 1
+                                      - 2 * jnp.log(ps / qs)),
+        [p.loc, p.scale, q.loc, q.scale], "kl_normal")
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical(p, q):
+    return _op(lambda pl, ql: jnp.sum(jnp.exp(pl) * (pl - ql), axis=-1),
+               [p.logits_t, q.logits_t], "kl_categorical")
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform(p, q):
+    return _op(
+        lambda plo, phi, qlo, qhi: jnp.where(
+            (qlo <= plo) & (phi <= qhi),
+            jnp.log((qhi - qlo) / (phi - plo)), jnp.inf),
+        [p.low, p.high, q.low, q.high], "kl_uniform")
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli(p, q):
+    return _op(
+        lambda pp, qp: jnp.clip(pp, 1e-7, 1 - 1e-7)
+        * jnp.log(jnp.clip(pp, 1e-7, 1 - 1e-7)
+                  / jnp.clip(qp, 1e-7, 1 - 1e-7))
+        + (1 - jnp.clip(pp, 1e-7, 1 - 1e-7))
+        * jnp.log((1 - jnp.clip(pp, 1e-7, 1 - 1e-7))
+                  / (1 - jnp.clip(qp, 1e-7, 1 - 1e-7))),
+        [p.probs_t, q.probs_t], "kl_bernoulli")
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exponential(p, q):
+    return _op(lambda pr, qr: jnp.log(pr / qr) + qr / pr - 1.0,
+               [p.rate, q.rate], "kl_exponential")
+
+
+@register_kl(Laplace, Laplace)
+def _kl_laplace(p, q):
+    # log(s_q/s_p) + |mu_p-mu_q|/s_q + (s_p/s_q) exp(-|mu_p-mu_q|/s_p) - 1
+    return _op(
+        lambda pl, ps, ql, qs: jnp.log(qs / ps)
+        + jnp.abs(pl - ql) / qs
+        + (ps / qs) * jnp.exp(-jnp.abs(pl - ql) / ps) - 1.0,
+        [p.loc, p.scale, q.loc, q.scale], "kl_laplace")
+
+
+@register_kl(Beta, Beta)
+def _kl_beta(p, q):
+    def fn(pa, pb, qa, qb):
+        def lbeta(a, b):
+            return gammaln(a) + gammaln(b) - gammaln(a + b)
+
+        return (lbeta(qa, qb) - lbeta(pa, pb)
+                + (pa - qa) * digamma(pa) + (pb - qb) * digamma(pb)
+                + (qa - pa + qb - pb) * digamma(pa + pb))
+
+    return _op(fn, [p.alpha, p.beta, q.alpha, q.beta], "kl_beta")
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dirichlet(p, q):
+    def fn(pa, qa):
+        p0 = jnp.sum(pa, -1)
+        return (gammaln(p0) - jnp.sum(gammaln(pa), -1)
+                - gammaln(jnp.sum(qa, -1)) + jnp.sum(gammaln(qa), -1)
+                + jnp.sum((pa - qa) * (digamma(pa)
+                                       - digamma(p0[..., None])), -1))
+
+    return _op(fn, [p.concentration, q.concentration], "kl_dirichlet")
+
+
+@register_kl(Gamma, Gamma)
+def _kl_gamma(p, q):
+    return _op(
+        lambda pc, pr, qc, qr: (pc - qc) * digamma(pc)
+        - gammaln(pc) + gammaln(qc)
+        + qc * (jnp.log(pr) - jnp.log(qr)) + pc * (qr / pr - 1.0),
+        [p.concentration, p.rate, q.concentration, q.rate], "kl_gamma")

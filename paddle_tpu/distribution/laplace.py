@@ -1,0 +1,51 @@
+"""Laplace (reference: python/paddle/distribution/laplace.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _as_t, _op
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = _as_t(loc)
+        self.scale = _as_t(scale)
+        shape = jnp.broadcast_shapes(tuple(self.loc.shape),
+                                     tuple(self.scale.shape))
+        super().__init__(batch_shape=shape)
+
+    @property
+    def mean(self):
+        bs = self.batch_shape
+        return _op(lambda l: jnp.broadcast_to(l, bs), [self.loc], "mean")
+
+    @property
+    def variance(self):
+        bs = self.batch_shape
+        return _op(lambda s: jnp.broadcast_to(2 * s ** 2, bs),
+                   [self.scale], "variance")
+
+    def sample(self, shape=()):
+        return self.rsample(shape).detach()
+
+    def rsample(self, shape=()):
+        out_shape = tuple(shape) + self.batch_shape
+        eps = jnp.finfo(jnp.float32).eps
+        # keep u strictly inside (-0.5, 0.5): u = -0.5 would hit log(0)
+        u = jnp.clip(jax.random.uniform(self._key(), out_shape,
+                                        minval=-0.5, maxval=0.5),
+                     -0.5 + eps, 0.5 - eps)
+        return _op(lambda l, s: l - s * jnp.sign(u)
+                   * jnp.log1p(-2 * jnp.abs(u)),
+                   [self.loc, self.scale], "laplace_rsample")
+
+    def log_prob(self, value):
+        return _op(lambda l, s, v: -jnp.log(2 * s) - jnp.abs(v - l) / s,
+                   [self.loc, self.scale, _as_t(value)],
+                   "laplace_log_prob")
+
+    def entropy(self):
+        bs = self.batch_shape
+        return _op(lambda s: jnp.broadcast_to(1 + jnp.log(2 * s), bs),
+                   [self.scale], "entropy")

@@ -1,0 +1,49 @@
+"""Multinomial (reference: python/paddle/distribution/multinomial.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.special import gammaln
+
+from ..core.tensor import Tensor
+from .distribution import Distribution, _as_t, _op
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count: int, probs):
+        self.total_count = int(total_count)
+        t = _as_t(probs)
+        self.probs_t = _op(lambda p: p / jnp.sum(p, -1, keepdims=True),
+                           [t], "multinomial_norm")
+        shape = tuple(self.probs_t.shape)
+        super().__init__(batch_shape=shape[:-1], event_shape=shape[-1:])
+
+    @property
+    def probs_(self):
+        return self.probs_t._data
+
+    @property
+    def mean(self):
+        n = self.total_count
+        return _op(lambda p: n * p, [self.probs_t], "mean")
+
+    @property
+    def variance(self):
+        n = self.total_count
+        return _op(lambda p: n * p * (1 - p), [self.probs_t], "variance")
+
+    def sample(self, shape=()):
+        out_shape = tuple(shape) + self.batch_shape
+        logits = jnp.log(self.probs_t._data)
+        draws = jax.random.categorical(
+            self._key(), logits, shape=(self.total_count,) + out_shape)
+        k = self.probs_t.shape[-1]
+        counts = jax.nn.one_hot(draws, k).sum(axis=0)
+        return Tensor(counts)
+
+    def log_prob(self, value):
+        n = self.total_count
+        return _op(
+            lambda p, v: gammaln(n + 1.0) - jnp.sum(gammaln(v + 1.0), -1)
+            + jnp.sum(v * jnp.log(p), -1),
+            [self.probs_t, _as_t(value)], "multinomial_log_prob")

@@ -1,0 +1,99 @@
+"""Normal / LogNormal (reference: python/paddle/distribution/normal.py,
+lognormal.py). All math runs through run_op, so Tensor/Parameter
+loc/scale receive gradients via log_prob / rsample / entropy / kl
+(reparameterization for VAE-style training)."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from .distribution import Distribution, _as_t, _op
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _as_t(loc)
+        self.scale = _as_t(scale)
+        shape = jnp.broadcast_shapes(tuple(self.loc.shape),
+                                     tuple(self.scale.shape))
+        super().__init__(batch_shape=shape)
+
+    @property
+    def mean(self):
+        bs = self.batch_shape
+        return _op(lambda l: jnp.broadcast_to(l, bs), [self.loc], "mean")
+
+    @property
+    def variance(self):
+        bs = self.batch_shape
+        return _op(lambda s: jnp.broadcast_to(s ** 2, bs), [self.scale],
+                   "variance")
+
+    @property
+    def stddev(self):
+        bs = self.batch_shape
+        return _op(lambda s: jnp.broadcast_to(s, bs), [self.scale], "stddev")
+
+    def sample(self, shape=()):
+        return self.rsample(shape).detach()
+
+    def rsample(self, shape=()):
+        out_shape = tuple(shape) + self.batch_shape
+        eps = jax.random.normal(self._key(), out_shape)
+        return _op(lambda l, s: l + eps * s, [self.loc, self.scale],
+                   "normal_rsample")
+
+    def log_prob(self, value):
+        return _op(
+            lambda l, s, v: -((v - l) ** 2) / (2 * s ** 2) - jnp.log(s)
+            - 0.5 * math.log(2 * math.pi),
+            [self.loc, self.scale, _as_t(value)], "normal_log_prob")
+
+    def entropy(self):
+        bs = self.batch_shape
+        return _op(lambda s: jnp.broadcast_to(
+            0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(s), bs),
+            [self.scale], "normal_entropy")
+
+    def cdf(self, value):
+        return _op(lambda l, s, v: 0.5 * (1 + jax.lax.erf(
+            (v - l) / (s * math.sqrt(2.0)))),
+            [self.loc, self.scale, _as_t(value)], "normal_cdf")
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = _as_t(loc)
+        self.scale = _as_t(scale)
+        self._base = Normal(self.loc, self.scale)
+        super().__init__(batch_shape=self._base.batch_shape)
+
+    @property
+    def mean(self):
+        return _op(lambda l, s: jnp.exp(l + s ** 2 / 2),
+                   [self.loc, self.scale], "mean")
+
+    @property
+    def variance(self):
+        return _op(lambda l, s: (jnp.exp(s ** 2) - 1)
+                   * jnp.exp(2 * l + s ** 2),
+                   [self.loc, self.scale], "variance")
+
+    def sample(self, shape=()):
+        return self.rsample(shape).detach()
+
+    def rsample(self, shape=()):
+        return _op(jnp.exp, [self._base.rsample(shape)], "exp")
+
+    def log_prob(self, value):
+        v = _as_t(value)
+        base_lp = self._base.log_prob(_op(jnp.log, [v], "log"))
+        return _op(lambda lp, vv: lp - jnp.log(vv), [base_lp, v],
+                   "lognormal_log_prob")
+
+    def entropy(self):
+        return _op(lambda e, l: e + l, [self._base.entropy(), self.loc],
+                   "entropy")

@@ -1,0 +1,104 @@
+"""Transforms (reference: python/paddle/distribution/transform.py —
+Transform base, AffineTransform, ExpTransform, SigmoidTransform,
+AbsTransform, ChainTransform). Differentiable through run_op."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from .distribution import _as_t, _op
+
+
+class Transform:
+    def forward(self, x):
+        raise NotImplementedError
+
+    def inverse(self, y):
+        raise NotImplementedError
+
+    def forward_log_det_jacobian(self, x):
+        raise NotImplementedError
+
+    def inverse_log_det_jacobian(self, y):
+        return _op(jnp.negative,
+                   [self.forward_log_det_jacobian(self.inverse(y))], "neg")
+
+    def __call__(self, x):
+        return self.forward(x)
+
+
+class AffineTransform(Transform):
+    def __init__(self, loc, scale):
+        self.loc = _as_t(loc)
+        self.scale = _as_t(scale)
+
+    def forward(self, x):
+        return _op(lambda l, s, v: l + s * v,
+                   [self.loc, self.scale, _as_t(x)], "affine_fwd")
+
+    def inverse(self, y):
+        return _op(lambda l, s, v: (v - l) / s,
+                   [self.loc, self.scale, _as_t(y)], "affine_inv")
+
+    def forward_log_det_jacobian(self, x):
+        xv = _as_t(x)
+        shape = tuple(xv.shape)
+        return _op(lambda s: jnp.broadcast_to(jnp.log(jnp.abs(s)), shape),
+                   [self.scale], "affine_ldj")
+
+
+class ExpTransform(Transform):
+    def forward(self, x):
+        return _op(jnp.exp, [_as_t(x)], "exp")
+
+    def inverse(self, y):
+        return _op(jnp.log, [_as_t(y)], "log")
+
+    def forward_log_det_jacobian(self, x):
+        return _as_t(x)
+
+
+class SigmoidTransform(Transform):
+    def forward(self, x):
+        return _op(jax.nn.sigmoid, [_as_t(x)], "sigmoid")
+
+    def inverse(self, y):
+        return _op(lambda v: jnp.log(v) - jnp.log1p(-v), [_as_t(y)],
+                   "logit")
+
+    def forward_log_det_jacobian(self, x):
+        return _op(lambda v: -jax.nn.softplus(-v) - jax.nn.softplus(v),
+                   [_as_t(x)], "sigmoid_ldj")
+
+
+class AbsTransform(Transform):
+    def forward(self, x):
+        return _op(jnp.abs, [_as_t(x)], "abs")
+
+    def inverse(self, y):
+        return _as_t(y)  # principal branch
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def forward(self, x):
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t.inverse(y)
+        return y
+
+    def forward_log_det_jacobian(self, x):
+        total = None
+        for t in self.transforms:
+            j = t.forward_log_det_jacobian(x)
+            total = j if total is None else _op(
+                lambda a, b: a + b, [total, j], "add")
+            x = t.forward(x)
+        return total

@@ -1,0 +1,59 @@
+"""TransformedDistribution + Independent (reference:
+python/paddle/distribution/{transformed_distribution,independent}.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .distribution import Distribution, _op
+from .transform import ChainTransform, Transform
+
+
+class TransformedDistribution(Distribution):
+    def __init__(self, base: Distribution, transforms):
+        if isinstance(transforms, Transform):
+            transforms = [transforms]
+        self.base = base
+        self.transform = ChainTransform(list(transforms))
+        super().__init__(batch_shape=base.batch_shape,
+                         event_shape=base.event_shape)
+
+    def sample(self, shape=()):
+        return self.transform.forward(self.base.sample(shape))
+
+    def rsample(self, shape=()):
+        return self.transform.forward(self.base.rsample(shape))
+
+    def log_prob(self, value):
+        x = self.transform.inverse(value)
+        base_lp = self.base.log_prob(x)
+        jac = self.transform.forward_log_det_jacobian(x)
+        return _op(lambda a, b: a - b, [base_lp, jac], "td_log_prob")
+
+
+class Independent(Distribution):
+    """Reinterpret batch dims as event dims (reference: independent.py)."""
+
+    def __init__(self, base: Distribution,
+                 reinterpreted_batch_rank: int = 1):
+        self.base = base
+        self.rank = reinterpreted_batch_rank
+        bs = base.batch_shape
+        super().__init__(batch_shape=bs[: len(bs) - self.rank],
+                         event_shape=bs[len(bs) - self.rank:]
+                         + base.event_shape)
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def rsample(self, shape=()):
+        return self.base.rsample(shape)
+
+    def log_prob(self, value):
+        rank = self.rank
+        return _op(lambda lp: jnp.sum(lp, axis=tuple(range(-rank, 0))),
+                   [self.base.log_prob(value)], "independent_log_prob")
+
+    def entropy(self):
+        rank = self.rank
+        return _op(lambda e: jnp.sum(e, axis=tuple(range(-rank, 0))),
+                   [self.base.entropy()], "independent_entropy")

@@ -1,0 +1,48 @@
+"""Uniform (reference: python/paddle/distribution/uniform.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _as_t, _op
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _as_t(low)
+        self.high = _as_t(high)
+        shape = jnp.broadcast_shapes(tuple(self.low.shape),
+                                     tuple(self.high.shape))
+        super().__init__(batch_shape=shape)
+
+    @property
+    def mean(self):
+        bs = self.batch_shape
+        return _op(lambda l, h: jnp.broadcast_to((l + h) / 2, bs),
+                   [self.low, self.high], "mean")
+
+    @property
+    def variance(self):
+        bs = self.batch_shape
+        return _op(lambda l, h: jnp.broadcast_to((h - l) ** 2 / 12, bs),
+                   [self.low, self.high], "variance")
+
+    def sample(self, shape=()):
+        return self.rsample(shape).detach()
+
+    def rsample(self, shape=()):
+        out_shape = tuple(shape) + self.batch_shape
+        u = jax.random.uniform(self._key(), out_shape)
+        return _op(lambda l, h: l + u * (h - l), [self.low, self.high],
+                   "uniform_rsample")
+
+    def log_prob(self, value):
+        return _op(
+            lambda l, h, v: jnp.where((v >= l) & (v < h),
+                                      -jnp.log(h - l), -jnp.inf),
+            [self.low, self.high, _as_t(value)], "uniform_log_prob")
+
+    def entropy(self):
+        bs = self.batch_shape
+        return _op(lambda l, h: jnp.broadcast_to(jnp.log(h - l), bs),
+                   [self.low, self.high], "entropy")

@@ -1,0 +1,13 @@
+"""Quantization: QAT (fake-quant) + PTQ (observer/calibrate/convert)
+(reference: python/paddle/quantization/ — QuantConfig config.py, QAT
+qat.py, PTQ ptq.py, quanters/ fake quanters, observers/ absmax)."""
+from .config import QuantConfig  # noqa: F401
+from .layers import FakeQuantLinear, QuantedLinear  # noqa: F401
+from .observers import AbsmaxObserver, MovingAverageAbsmaxObserver  # noqa: F401
+from .qat import QAT  # noqa: F401
+from .ptq import PTQ  # noqa: F401
+from .functional import fake_quant_dequant, quantize, dequantize  # noqa: F401
+
+__all__ = ["QuantConfig", "QAT", "PTQ", "AbsmaxObserver",
+           "MovingAverageAbsmaxObserver", "FakeQuantLinear", "QuantedLinear",
+           "fake_quant_dequant", "quantize", "dequantize"]

@@ -1,0 +1,37 @@
+"""QuantConfig (reference: python/paddle/quantization/config.py)."""
+from __future__ import annotations
+
+from typing import Dict, Optional, Type
+
+from .. import nn
+
+__all__ = ["QuantConfig"]
+
+
+class QuantConfig:
+    def __init__(self, activation=None, weight=None):
+        self.activation = activation
+        self.weight = weight
+        self._layer_configs: Dict = {}
+        self._type_configs: Dict[Type, Dict] = {}
+
+    def add_layer_config(self, layer, activation=None, weight=None):
+        for l in (layer if isinstance(layer, (list, tuple)) else [layer]):
+            self._layer_configs[id(l)] = {"activation": activation,
+                                          "weight": weight}
+        return self
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        for t in (layer_type if isinstance(layer_type, (list, tuple))
+                  else [layer_type]):
+            self._type_configs[t] = {"activation": activation,
+                                     "weight": weight}
+        return self
+
+    def needs_quant(self, layer) -> bool:
+        if id(layer) in self._layer_configs:
+            return True
+        if type(layer) in self._type_configs:
+            return True
+        return isinstance(layer, nn.Linear) and (
+            self.activation is not None or self.weight is not None)

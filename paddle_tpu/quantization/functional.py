@@ -1,0 +1,58 @@
+"""Quantize / dequantize / fake-quant primitives.
+
+TPU-first: int8 symmetric per-tensor/per-channel; the fake-quant fwd uses a
+straight-through estimator (round has zero grad; STE passes the cotangent
+through unchanged), which is the same scheme the reference's
+FakeQuanterWithAbsMax implements in CUDA."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.autograd import run_op
+from ..core.tensor import Tensor
+
+__all__ = ["quantize", "dequantize", "fake_quant_dequant"]
+
+
+def _scale_of(arr, axis=None):
+    amax = jnp.max(jnp.abs(arr)) if axis is None else \
+        jnp.max(jnp.abs(arr), axis=axis, keepdims=True)
+    return jnp.maximum(amax, 1e-8) / 127.0
+
+
+def quantize(x: Tensor, scale=None, axis=None):
+    arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    s = _scale_of(arr, axis) if scale is None else scale
+    q = jnp.clip(jnp.round(arr / s), -128, 127).astype(jnp.int8)
+    return Tensor(q), Tensor(jnp.asarray(s))
+
+
+def dequantize(q: Tensor, scale: Tensor):
+    return Tensor(q._data.astype(jnp.float32) * scale._data)
+
+
+@jax.custom_vjp
+def _fqd(arr, scale):
+    return jnp.clip(jnp.round(arr / scale), -128, 127) * scale
+
+
+def _fqd_fwd(arr, scale):
+    return _fqd(arr, scale), None
+
+
+def _fqd_bwd(res, g):
+    return g, None  # straight-through estimator
+
+
+_fqd.defvjp(_fqd_fwd, _fqd_bwd)
+
+
+def fake_quant_dequant(x: Tensor, scale=None, axis=None) -> Tensor:
+    """Simulated int8 round-trip with STE gradient."""
+    t = x if isinstance(x, Tensor) else Tensor(x)
+    if scale is None:
+        scale = _scale_of(t._data, axis)
+    elif isinstance(scale, Tensor):
+        scale = scale._data
+    return run_op(lambda a: _fqd(a, scale), [t], name="fake_quant")

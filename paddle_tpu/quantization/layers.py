@@ -1,0 +1,65 @@
+"""Quantized layer wrappers (reference: python/paddle/nn/quant/ and
+quantization/imperative qat layers)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .. import nn
+from ..core.tensor import Tensor
+from .functional import dequantize, fake_quant_dequant, quantize
+from .observers import MovingAverageAbsmaxObserver
+
+__all__ = ["FakeQuantLinear", "QuantedLinear"]
+
+
+class FakeQuantLinear(nn.Layer):
+    """QAT wrapper: fake-quant activations (moving-average scale) and
+    weights (per-channel absmax) around the wrapped Linear."""
+
+    def __init__(self, linear: nn.Layer, quant_bits: int = 8):
+        super().__init__()
+        self.inner = linear
+        self.act_observer = MovingAverageAbsmaxObserver(quant_bits)
+
+    def forward(self, x):
+        if self.training:
+            self.act_observer.observe(x)
+        if self.act_observer._absmax > 0:
+            x = fake_quant_dequant(x, scale=self.act_observer.scale())
+        # else: observer never ran (pre-calibration eval) — quantizing
+        # against the 1e-8 floor would zero every activation
+        w = self.inner.weight
+        # weight [in, out]: reduce axis 0 -> per-output-channel scales
+        wq = fake_quant_dequant(w, axis=0)
+        out = x @ wq
+        if getattr(self.inner, "bias", None) is not None:
+            out = out + self.inner.bias
+        return out
+
+
+class QuantedLinear(nn.Layer):
+    """Converted inference layer: int8 weights + f32 scale; the matmul
+    itself runs in the compute dtype after dequant (XLA folds the dequant
+    into the matmul epilogue on TPU)."""
+
+    def __init__(self, fq: FakeQuantLinear):
+        super().__init__()
+        w = fq.inner.weight
+        # same per-output-channel scheme the QAT pass trained against
+        qw, scale = quantize(w, axis=0)
+        self.qweight = Tensor(qw._data)
+        self.wscale = Tensor(scale._data)
+        self.bias = getattr(fq.inner, "bias", None)
+        # 0.0 = observer never calibrated -> activations stay float
+        self.act_scale = fq.act_observer.scale() \
+            if fq.act_observer._absmax > 0 else 0.0
+
+    def forward(self, x):
+        if self.act_scale:
+            # simulate the int8 activation path the calibration fixed
+            x = fake_quant_dequant(x, scale=self.act_scale)
+        w = dequantize(self.qweight, self.wscale)
+        out = x @ w
+        if self.bias is not None:
+            out = out + self.bias
+        return out

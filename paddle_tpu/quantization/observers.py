@@ -1,0 +1,43 @@
+"""Observers (reference: python/paddle/quantization/observers/abs_max.py
+and quanters moving-average absmax)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["AbsmaxObserver", "MovingAverageAbsmaxObserver"]
+
+
+class AbsmaxObserver:
+    def __init__(self, quant_bits: int = 8):
+        self.quant_bits = quant_bits
+        self._absmax = 0.0
+
+    def observe(self, x: Tensor):
+        cur = float(jnp.max(jnp.abs(x._data)))
+        self._absmax = max(self._absmax, cur)
+
+    __call__ = observe
+
+    def scale(self) -> float:
+        qmax = 2 ** (self.quant_bits - 1) - 1
+        return max(self._absmax, 1e-8) / qmax
+
+
+class MovingAverageAbsmaxObserver(AbsmaxObserver):
+    def __init__(self, quant_bits: int = 8, moving_rate: float = 0.9):
+        super().__init__(quant_bits)
+        self.moving_rate = moving_rate
+        self._initialized = False
+
+    def observe(self, x: Tensor):
+        cur = float(jnp.max(jnp.abs(x._data)))
+        if not self._initialized:
+            self._absmax = cur
+            self._initialized = True
+        else:
+            self._absmax = (self.moving_rate * self._absmax
+                            + (1 - self.moving_rate) * cur)
+
+    __call__ = observe

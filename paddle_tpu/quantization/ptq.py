@@ -1,0 +1,52 @@
+"""PTQ driver (reference: python/paddle/quantization/ptq.py): observe
+activations on calibration data, then convert."""
+from __future__ import annotations
+
+from .. import nn
+from .config import QuantConfig
+from .layers import FakeQuantLinear, QuantedLinear
+from .qat import _replace_linears
+
+__all__ = ["PTQ"]
+
+
+class PTQ:
+    def __init__(self, config: QuantConfig):
+        self.config = config
+
+    def quantize(self, model: nn.Layer, inplace: bool = True) -> nn.Layer:
+        """Insert observers (fake-quant layers in eval mode observe via
+        explicit calibrate())."""
+        _replace_linears(model, self.config, FakeQuantLinear)
+        return model
+
+    def calibrate(self, model: nn.Layer, dataloader, max_batches=None):
+        model.eval()
+        fq_layers = [l for l in _walk(model)
+                     if isinstance(l, FakeQuantLinear)]
+        for l in fq_layers:
+            l.train()  # enable observation
+        for i, batch in enumerate(dataloader):
+            if max_batches is not None and i >= max_batches:
+                break
+            x = batch[0] if isinstance(batch, (list, tuple)) else batch
+            model(x)
+        for l in fq_layers:
+            l.eval()
+
+    def convert(self, model: nn.Layer, inplace: bool = True) -> nn.Layer:
+        def walk(layer):
+            for name, sub in list(layer._sub_layers.items()):
+                if isinstance(sub, FakeQuantLinear):
+                    setattr(layer, name, QuantedLinear(sub))
+                else:
+                    walk(sub)
+
+        walk(model)
+        return model
+
+
+def _walk(layer):
+    yield layer
+    for sub in layer._sub_layers.values():
+        yield from _walk(sub)

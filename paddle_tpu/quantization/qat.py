@@ -1,0 +1,43 @@
+"""QAT driver (reference: python/paddle/quantization/qat.py)."""
+from __future__ import annotations
+
+from .. import nn
+from .config import QuantConfig
+from .layers import FakeQuantLinear, QuantedLinear
+
+__all__ = ["QAT"]
+
+
+def _replace_linears(layer: nn.Layer, config: QuantConfig, wrap):
+    for name, sub in list(layer._sub_layers.items()):
+        if isinstance(sub, nn.Linear) and config.needs_quant(sub):
+            # setattr, not _sub_layers[name]=: Layer.__setattr__ keeps both
+            # the registry and the attribute in sync (a stale __dict__
+            # entry would silently bypass quantization in forward)
+            setattr(layer, name, wrap(sub))
+        else:
+            _replace_linears(sub, config, wrap)
+
+
+class QAT:
+    def __init__(self, config: QuantConfig):
+        self.config = config
+
+    def quantize(self, model: nn.Layer, inplace: bool = True) -> nn.Layer:
+        """Insert fake-quant wrappers around quantizable layers."""
+        _replace_linears(model, self.config, FakeQuantLinear)
+        return model
+
+    def convert(self, model: nn.Layer, inplace: bool = True) -> nn.Layer:
+        """Swap trained fake-quant layers for int8-weight inference
+        layers."""
+
+        def walk(layer):
+            for name, sub in list(layer._sub_layers.items()):
+                if isinstance(sub, FakeQuantLinear):
+                    setattr(layer, name, QuantedLinear(sub))
+                else:
+                    walk(sub)
+
+        walk(model)
+        return model

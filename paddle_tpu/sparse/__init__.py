@@ -1,0 +1,235 @@
+"""Sparse tensors (reference: python/paddle/sparse/ over C++
+phi/core/sparse_coo_tensor.h, sparse_csr_tensor.h and the
+phi/kernels/sparse/ op set).
+
+TPU-native: COO is jax.experimental.sparse.BCOO — XLA's batched-COO format
+with jit/grad support — wrapped in the eager Tensor-like SparseCooTensor.
+CSR keeps (crows, cols, values) metadata and converts through BCOO for
+compute; on TPU, XLA lowers sparse matmuls to gather/segment-sum, which is
+the supported execution path (no cuSPARSE analog needed).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+from ..core.tensor import Tensor
+
+__all__ = ["sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
+           "SparseCsrTensor", "is_same_shape", "add", "matmul", "masked_matmul",
+           "relu", "abs", "neg", "sin", "tanh", "sqrt", "pow", "multiply",
+           "transpose"]
+
+
+class SparseCooTensor:
+    """COO sparse tensor backed by BCOO (reference:
+    phi/core/sparse_coo_tensor.h:37)."""
+
+    def __init__(self, bcoo: jsparse.BCOO):
+        self._bcoo = bcoo
+
+    # ---------------------------------------------------------- metadata
+    @property
+    def shape(self):
+        return list(self._bcoo.shape)
+
+    @property
+    def dtype(self):
+        from ..core.dtype import from_jax_dtype
+
+        return from_jax_dtype(self._bcoo.dtype)
+
+    @property
+    def nnz(self) -> int:
+        return int(self._bcoo.nse)
+
+    def indices(self) -> Tensor:
+        return Tensor(self._bcoo.indices.T)  # [sparse_ndim, nnz] (paddle)
+
+    def values(self) -> Tensor:
+        return Tensor(self._bcoo.data)
+
+    def to_dense(self) -> Tensor:
+        return Tensor(self._bcoo.todense())
+
+    def to_sparse_csr(self) -> "SparseCsrTensor":
+        return _dense_to_csr(self.to_dense())
+
+    def coalesce(self) -> "SparseCooTensor":
+        return SparseCooTensor(self._bcoo.sum_duplicates())
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz}, "
+                f"dtype={self.dtype.name})")
+
+
+class SparseCsrTensor:
+    """CSR sparse tensor (reference: phi/core/sparse_csr_tensor.h)."""
+
+    def __init__(self, crows, cols, values, shape):
+        self._crows = jnp.asarray(crows, dtype=jnp.int32)
+        self._cols = jnp.asarray(cols, dtype=jnp.int32)
+        self._values = jnp.asarray(values)
+        self._shape = tuple(int(s) for s in shape)
+
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def nnz(self) -> int:
+        return int(self._values.shape[0])
+
+    def crows(self) -> Tensor:
+        return Tensor(self._crows)
+
+    def cols(self) -> Tensor:
+        return Tensor(self._cols)
+
+    def values(self) -> Tensor:
+        return Tensor(self._values)
+
+    def to_dense(self) -> Tensor:
+        n_rows = self._shape[0]
+        counts = self._crows[1:] - self._crows[:-1]
+        rows = jnp.repeat(jnp.arange(n_rows), counts,
+                          total_repeat_length=self.nnz)
+        dense = jnp.zeros(self._shape, self._values.dtype)
+        return Tensor(dense.at[rows, self._cols].set(self._values))
+
+    def to_sparse_coo(self, sparse_dim: int = 2) -> SparseCooTensor:
+        n_rows = self._shape[0]
+        counts = self._crows[1:] - self._crows[:-1]
+        rows = jnp.repeat(jnp.arange(n_rows), counts,
+                          total_repeat_length=self.nnz)
+        idx = jnp.stack([rows, self._cols], axis=1)
+        return SparseCooTensor(jsparse.BCOO((self._values, idx),
+                                            shape=self._shape))
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz})")
+
+
+# ------------------------------------------------------------- creation
+def sparse_coo_tensor(indices, values, shape=None, dtype=None,
+                      place=None, stop_gradient=True) -> SparseCooTensor:
+    """reference: python/paddle/sparse/creation.py sparse_coo_tensor;
+    indices [sparse_ndim, nnz] (paddle layout)."""
+    idx = np.asarray(indices if not isinstance(indices, Tensor)
+                     else indices.numpy())
+    vals = jnp.asarray(values if not isinstance(values, Tensor)
+                       else values._data)
+    if dtype is not None:
+        from ..core.dtype import to_jax_dtype
+
+        vals = vals.astype(to_jax_dtype(dtype))
+    if shape is None:
+        shape = tuple(int(m) + 1 for m in idx.max(axis=1))
+        shape = shape + vals.shape[1:]
+    return SparseCooTensor(
+        jsparse.BCOO((vals, jnp.asarray(idx.T, dtype=jnp.int32)),
+                     shape=tuple(shape)))
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
+                      place=None, stop_gradient=True) -> SparseCsrTensor:
+    """reference: python/paddle/sparse/creation.py sparse_csr_tensor."""
+    return SparseCsrTensor(
+        crows if not isinstance(crows, Tensor) else crows.numpy(),
+        cols if not isinstance(cols, Tensor) else cols.numpy(),
+        values if not isinstance(values, Tensor) else values._data, shape)
+
+
+def _dense_to_csr(t: Tensor) -> SparseCsrTensor:
+    arr = np.asarray(t._data)
+    assert arr.ndim == 2
+    mask = arr != 0
+    counts = mask.sum(axis=1)
+    crows = np.concatenate([[0], np.cumsum(counts)])
+    rows, cols = np.nonzero(mask)
+    return SparseCsrTensor(crows, cols, arr[rows, cols], arr.shape)
+
+
+def _as_bcoo(x):
+    if isinstance(x, SparseCooTensor):
+        return x._bcoo
+    if isinstance(x, SparseCsrTensor):
+        return x.to_sparse_coo()._bcoo
+    raise TypeError(f"expected sparse tensor, got {type(x)}")
+
+
+# ------------------------------------------------------------- ops
+def is_same_shape(x, y) -> bool:
+    return list(x.shape) == list(y.shape)
+
+
+def add(x, y):
+    """sparse+sparse or sparse+dense (reference: sparse/binary.py add)."""
+    if isinstance(y, Tensor):
+        return Tensor(_as_bcoo(x).todense() + y._data)
+    out = jsparse.bcoo_sum_duplicates(_bcoo_add(_as_bcoo(x), _as_bcoo(y)))
+    return SparseCooTensor(out)
+
+
+def _bcoo_add(a, b):
+    data = jnp.concatenate([a.data, b.data])
+    idx = jnp.concatenate([a.indices, b.indices])
+    return jsparse.BCOO((data, idx), shape=a.shape)
+
+
+def multiply(x, y):
+    """elementwise multiply sparse*dense or sparse*sparse-same-pattern."""
+    if isinstance(y, Tensor):
+        bc = _as_bcoo(x)
+        gathered = y._data[tuple(bc.indices[:, i]
+                                 for i in range(bc.indices.shape[1]))]
+        return SparseCooTensor(jsparse.BCOO((bc.data * gathered,
+                                             bc.indices), shape=bc.shape))
+    return SparseCooTensor(_as_bcoo(x) * _as_bcoo(y))
+
+
+def matmul(x, y):
+    """sparse @ dense -> dense (reference: sparse/matmul.py)."""
+    if isinstance(x, (SparseCooTensor, SparseCsrTensor)):
+        out = _as_bcoo(x) @ (y._data if isinstance(y, Tensor) else y)
+        return Tensor(out)
+    return Tensor((x._data if isinstance(x, Tensor) else x) @ _as_bcoo(y))
+
+
+def masked_matmul(x: Tensor, y: Tensor, mask):
+    """dense@dense sampled at mask's sparsity (reference: SDDMM)."""
+    bc = _as_bcoo(mask)
+    rows = bc.indices[:, 0]
+    cols = bc.indices[:, 1]
+    vals = jnp.einsum("nd,nd->n", x._data[rows], y._data.T[cols])
+    return SparseCooTensor(jsparse.BCOO((vals, bc.indices), shape=bc.shape))
+
+
+def _unary(fn):
+    def op(x):
+        bc = _as_bcoo(x)
+        return SparseCooTensor(jsparse.BCOO((fn(bc.data), bc.indices),
+                                            shape=bc.shape))
+
+    return op
+
+
+relu = _unary(lambda d: jnp.maximum(d, 0))
+abs = _unary(jnp.abs)
+neg = _unary(jnp.negative)
+sin = _unary(jnp.sin)
+tanh = _unary(jnp.tanh)
+sqrt = _unary(jnp.sqrt)
+
+
+def pow(x, factor):
+    return _unary(lambda d: jnp.power(d, factor))(x)
+
+
+def transpose(x, perm):
+    return SparseCooTensor(jsparse.bcoo_transpose(
+        _as_bcoo(x), permutation=tuple(perm)))

@@ -1,0 +1,145 @@
+"""Text utilities (reference: python/paddle/text/ — viterbi_decode.py
+ViterbiDecoder/viterbi_decode, datasets/).
+
+Datasets load from local files (this build has no network egress; pass
+``data_file``); the decode op is a lax.scan dynamic program — static
+shapes, TPU-friendly.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..io import Dataset
+
+__all__ = ["viterbi_decode", "ViterbiDecoder", "UCIHousing", "Imdb"]
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag: bool = True, name=None):
+    """CRF Viterbi decode (reference: python/paddle/text/viterbi_decode.py).
+
+    potentials: [batch, seq, num_tags] unary scores;
+    transition_params: [num_tags, num_tags];
+    lengths: [batch] int. Returns (scores [batch], paths [batch, seq]).
+    With ``include_bos_eos_tag`` the last two tags are BOS/EOS (reference
+    semantics): BOS transitions start the sequence, EOS transitions end it.
+    """
+    pot = potentials._data if isinstance(potentials, Tensor) \
+        else jnp.asarray(potentials)
+    trans = transition_params._data \
+        if isinstance(transition_params, Tensor) \
+        else jnp.asarray(transition_params)
+    lens = lengths._data if isinstance(lengths, Tensor) \
+        else jnp.asarray(lengths)
+
+    b, seq_len, n_tags = pot.shape
+
+    if include_bos_eos_tag:
+        bos, eos = n_tags - 2, n_tags - 1
+        init = pot[:, 0] + trans[bos][None, :]
+    else:
+        init = pot[:, 0]
+
+    def step(carry, t):
+        alpha, history = carry
+        # alpha: [b, n]; scores via max over previous tag
+        scores = alpha[:, :, None] + trans[None, :, :]  # [b, prev, cur]
+        best_prev = jnp.argmax(scores, axis=1)          # [b, cur]
+        alpha_new = jnp.max(scores, axis=1) + pot[:, t]
+        # sequences already past their length keep old alpha
+        active = (t < lens)[:, None]
+        alpha_new = jnp.where(active, alpha_new, alpha)
+        return (alpha_new, best_prev), best_prev
+
+    (alpha, _), history = jax.lax.scan(
+        step, (init, jnp.zeros((b, n_tags), jnp.int32)),
+        jnp.arange(1, seq_len))
+    # history: [seq-1, b, n_tags]
+
+    if include_bos_eos_tag:
+        alpha = alpha + trans[:, eos][None, :]
+
+    best_last = jnp.argmax(alpha, axis=-1)              # [b]
+    scores = jnp.max(alpha, axis=-1)
+
+    # backtrack with scan in reverse
+    def back(carry, hist_t_and_t):
+        tag = carry
+        hist_t, t = hist_t_and_t
+        prev = jnp.take_along_axis(hist_t, tag[:, None], axis=1)[:, 0]
+        # positions beyond a sequence's length keep the same tag
+        prev = jnp.where(t < lens, prev, tag)
+        return prev, prev
+
+    ts = jnp.arange(1, seq_len)[::-1]
+    _, rev_path = jax.lax.scan(back, best_last, (history[::-1], ts))
+    paths = jnp.concatenate(
+        [jnp.flip(rev_path, 0), best_last[None, :]], axis=0).T
+    return Tensor(scores), Tensor(paths.astype(jnp.int64))
+
+
+class ViterbiDecoder:
+    """Layer-style wrapper (reference: text/viterbi_decode.py
+    ViterbiDecoder)."""
+
+    def __init__(self, transitions, include_bos_eos_tag: bool = True,
+                 name=None):
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def __call__(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)
+
+
+class UCIHousing(Dataset):
+    """reference: text/datasets/uci_housing.py — loads from a local file
+    (whitespace-separated, 14 columns) since this build has no egress."""
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train"):
+        if data_file is None:
+            raise ValueError(
+                "UCIHousing needs data_file= (no network egress; download "
+                "housing.data manually)")
+        raw = np.loadtxt(data_file).astype(np.float32)
+        feats, labels = raw[:, :-1], raw[:, -1:]
+        # normalize per reference
+        mx, mn = feats.max(0), feats.min(0)
+        feats = (feats - feats.mean(0)) / np.maximum(mx - mn, 1e-6)
+        n = len(feats)
+        split = int(n * 0.8)
+        if mode == "train":
+            self.x, self.y = feats[:split], labels[:split]
+        else:
+            self.x, self.y = feats[split:], labels[split:]
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+    def __len__(self):
+        return len(self.x)
+
+
+class Imdb(Dataset):
+    """reference: text/datasets/imdb.py — local tar/dir based; accepts a
+    pre-tokenized .npz with arrays `x` (object array of int lists) and
+    `y`."""
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 cutoff: int = 150):
+        if data_file is None:
+            raise ValueError("Imdb needs data_file= (no network egress)")
+        blob = np.load(data_file, allow_pickle=True)
+        self.docs = blob["x"]
+        self.labels = blob["y"].astype(np.int64)
+
+    def __getitem__(self, i):
+        return np.asarray(self.docs[i], dtype=np.int64), self.labels[i]
+
+    def __len__(self):
+        return len(self.labels)

@@ -1,0 +1,253 @@
+"""distribution / sparse / quantization / text subpackages (reference
+analogs: test/distribution/, test/legacy_test sparse tests,
+test/quantization/, paddle.text viterbi tests)."""
+import numpy as np
+import pytest
+import scipy.stats
+
+import paddle_tpu as pt
+from paddle_tpu import nn
+from paddle_tpu.core.tensor import Tensor
+
+
+class TestDistributions:
+    def test_normal_moments_logprob(self):
+        from paddle_tpu.distribution import Normal
+
+        d = Normal(1.0, 2.0)
+        s = d.sample([20000])
+        assert abs(float(s.numpy().mean()) - 1.0) < 0.1
+        assert abs(float(s.numpy().std()) - 2.0) < 0.1
+        lp = d.log_prob(pt.to_tensor(0.5)).numpy()
+        np.testing.assert_allclose(lp, scipy.stats.norm(1, 2).logpdf(0.5),
+                                   rtol=1e-5)
+        ent = d.entropy().numpy()
+        np.testing.assert_allclose(ent, scipy.stats.norm(1, 2).entropy(),
+                                   rtol=1e-5)
+
+    def test_kl_normal(self):
+        from paddle_tpu.distribution import Normal, kl_divergence
+
+        p, q = Normal(0.0, 1.0), Normal(1.0, 2.0)
+        kl = float(kl_divergence(p, q).numpy())
+        # closed form
+        expect = np.log(2.0) + (1 + 1) / (2 * 4) - 0.5
+        np.testing.assert_allclose(kl, expect, rtol=1e-5)
+
+    def test_categorical(self):
+        from paddle_tpu.distribution import Categorical
+
+        d = Categorical(pt.to_tensor([0.1, 0.3, 0.6]))
+        s = d.sample([5000]).numpy()
+        freq = np.bincount(s, minlength=3) / 5000
+        np.testing.assert_allclose(freq, [0.1, 0.3, 0.6], atol=0.05)
+        lp = float(d.log_prob(pt.to_tensor(2)).numpy())
+        np.testing.assert_allclose(lp, np.log(0.6), rtol=1e-4)
+
+    def test_beta_gamma_dirichlet_logprob(self):
+        from paddle_tpu.distribution import Beta, Dirichlet, Gamma
+
+        np.testing.assert_allclose(
+            Beta(2.0, 3.0).log_prob(pt.to_tensor(0.4)).numpy(),
+            scipy.stats.beta(2, 3).logpdf(0.4), rtol=1e-5)
+        np.testing.assert_allclose(
+            Gamma(2.0, 3.0).log_prob(pt.to_tensor(0.7)).numpy(),
+            scipy.stats.gamma(2, scale=1 / 3).logpdf(0.7), rtol=1e-5)
+        np.testing.assert_allclose(
+            Dirichlet(np.array([1.0, 2.0, 3.0], np.float32))
+            .log_prob(pt.to_tensor([0.2, 0.3, 0.5])).numpy(),
+            scipy.stats.dirichlet([1, 2, 3]).logpdf([0.2, 0.3, 0.5]),
+            rtol=1e-4)
+
+    def test_transformed_distribution(self):
+        from paddle_tpu.distribution import (ExpTransform, LogNormal,
+                                             Normal, TransformedDistribution)
+
+        base = Normal(0.0, 1.0)
+        td = TransformedDistribution(base, [ExpTransform()])
+        ln = LogNormal(0.0, 1.0)
+        v = pt.to_tensor(1.7)
+        np.testing.assert_allclose(td.log_prob(v).numpy(),
+                                   ln.log_prob(v).numpy(), rtol=1e-5)
+
+    def test_independent(self):
+        from paddle_tpu.distribution import Independent, Normal
+
+        d = Independent(Normal(np.zeros(3, np.float32),
+                               np.ones(3, np.float32)), 1)
+        lp = d.log_prob(pt.to_tensor([0.0, 0.0, 0.0])).numpy()
+        assert lp.shape == ()
+        np.testing.assert_allclose(
+            lp, 3 * scipy.stats.norm(0, 1).logpdf(0.0), rtol=1e-5)
+
+
+class TestSparse:
+    def test_coo_roundtrip_and_matmul(self):
+        import paddle_tpu.sparse as sp
+
+        dense = np.array([[0, 1, 0], [2, 0, 3]], np.float32)
+        idx = np.array([[0, 1, 1], [1, 0, 2]])
+        st = sp.sparse_coo_tensor(idx, np.array([1, 2, 3], np.float32),
+                                  shape=[2, 3])
+        np.testing.assert_array_equal(st.to_dense().numpy(), dense)
+        y = np.random.randn(3, 4).astype(np.float32)
+        out = sp.matmul(st, pt.to_tensor(y))
+        np.testing.assert_allclose(out.numpy(), dense @ y, rtol=1e-5)
+
+    def test_csr_conversions(self):
+        import paddle_tpu.sparse as sp
+
+        st = sp.sparse_csr_tensor([0, 2, 3], [0, 2, 1],
+                                  [1.0, 2.0, 3.0], [2, 3])
+        dense = np.array([[1, 0, 2], [0, 3, 0]], np.float32)
+        np.testing.assert_array_equal(st.to_dense().numpy(), dense)
+        coo = st.to_sparse_coo()
+        np.testing.assert_array_equal(coo.to_dense().numpy(), dense)
+        back = coo.to_sparse_csr()
+        np.testing.assert_array_equal(back.to_dense().numpy(), dense)
+
+    def test_sparse_add_unary(self):
+        import paddle_tpu.sparse as sp
+
+        a = sp.sparse_coo_tensor([[0, 1], [0, 1]], [-1.0, 2.0], [2, 2])
+        b = sp.sparse_coo_tensor([[0, 1], [0, 0]], [5.0, 1.0], [2, 2])
+        s = sp.add(a, b)
+        np.testing.assert_array_equal(
+            s.to_dense().numpy(), [[4, 0], [1, 2]])
+        r = sp.relu(a)
+        np.testing.assert_array_equal(r.to_dense().numpy(),
+                                      [[0, 0], [0, 2]])
+
+    def test_masked_matmul(self):
+        import paddle_tpu.sparse as sp
+
+        x = np.random.randn(3, 5).astype(np.float32)
+        y = np.random.randn(5, 3).astype(np.float32)
+        mask = sp.sparse_coo_tensor([[0, 2], [1, 0]], [1.0, 1.0], [3, 3])
+        out = sp.masked_matmul(pt.to_tensor(x), pt.to_tensor(y), mask)
+        full = x @ y
+        d = out.to_dense().numpy()
+        np.testing.assert_allclose(d[0, 1], full[0, 1], rtol=1e-5)
+        np.testing.assert_allclose(d[2, 0], full[2, 0], rtol=1e-5)
+        assert d[1, 1] == 0
+
+
+class TestQuantization:
+    def test_fake_quant_ste_grad(self):
+        from paddle_tpu.quantization import fake_quant_dequant
+
+        x = pt.randn([8, 8])
+        x.stop_gradient = False
+        y = fake_quant_dequant(x)
+        # int8 roundtrip error bounded by scale/2
+        scale = np.abs(x.numpy()).max() / 127
+        assert np.abs(y.numpy() - x.numpy()).max() <= scale / 2 + 1e-6
+        y.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), np.ones((8, 8)),
+                                   rtol=1e-6)
+
+    def test_qat_flow(self):
+        from paddle_tpu.quantization import QAT, QuantConfig
+
+        model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(),
+                              nn.Linear(16, 4))
+        q = QAT(QuantConfig(activation="fake", weight="fake"))
+        model = q.quantize(model)
+        from paddle_tpu.quantization import FakeQuantLinear
+
+        assert isinstance(model[0], FakeQuantLinear)
+        x = pt.randn([4, 8])
+        out = model(x)
+        assert out.shape == [4, 4]
+        model = q.convert(model)
+        from paddle_tpu.quantization import QuantedLinear
+
+        assert isinstance(model[0], QuantedLinear)
+        out2 = model(x)
+        # int8 model close to fake-quant model
+        np.testing.assert_allclose(out.numpy(), out2.numpy(), atol=0.2)
+
+    def test_ptq_calibration(self):
+        from paddle_tpu.quantization import PTQ, QuantConfig
+
+        model = nn.Sequential(nn.Linear(8, 8))
+        ptq = PTQ(QuantConfig(activation="observer", weight="absmax"))
+        model = ptq.quantize(model)
+        data = [(pt.randn([4, 8]),) for _ in range(3)]
+        ptq.calibrate(model, data)
+        assert model[0].act_observer._absmax > 0
+        model = ptq.convert(model)
+        assert model(pt.randn([2, 8])).shape == [2, 8]
+
+
+class TestText:
+    def test_viterbi_matches_bruteforce(self):
+        from paddle_tpu.text import viterbi_decode
+
+        rng = np.random.RandomState(0)
+        b, s, n = 2, 5, 4  # last 2 tags are bos/eos
+        pot = rng.randn(b, s, n).astype(np.float32)
+        trans = rng.randn(n, n).astype(np.float32)
+        lens = np.array([5, 3], np.int32)
+        scores, paths = viterbi_decode(pt.to_tensor(pot),
+                                       pt.to_tensor(trans),
+                                       pt.to_tensor(lens))
+        # brute force over all paths
+        import itertools
+
+        bos, eos = n - 2, n - 1
+        for bi in range(b):
+            L = lens[bi]
+            best, best_path = -1e30, None
+            for path in itertools.product(range(n), repeat=int(L)):
+                sc = trans[bos, path[0]] + pot[bi, 0, path[0]]
+                for t in range(1, L):
+                    sc += trans[path[t - 1], path[t]] + pot[bi, t, path[t]]
+                sc += trans[path[L - 1], eos]
+                if sc > best:
+                    best, best_path = sc, path
+            np.testing.assert_allclose(float(scores.numpy()[bi]), best,
+                                       rtol=1e-4)
+            np.testing.assert_array_equal(
+                paths.numpy()[bi, :L], np.array(best_path))
+
+
+class TestDistributionAutograd:
+    def test_normal_logprob_grads_to_params(self):
+        from paddle_tpu.distribution import Normal
+        from paddle_tpu.nn.layer.layers import Parameter
+
+        loc = Parameter(pt.to_tensor(0.5))
+        scale = Parameter(pt.to_tensor(1.5))
+        d = Normal(loc, scale)
+        lp = d.log_prob(pt.to_tensor([0.0, 1.0, 2.0]))
+        lp.sum().backward()
+        assert loc.grad is not None and scale.grad is not None
+        # d/dloc sum log N(v; loc, s) = sum (v - loc)/s^2
+        expect = sum((v - 0.5) / 1.5 ** 2 for v in [0.0, 1.0, 2.0])
+        np.testing.assert_allclose(float(loc.grad.numpy()), expect,
+                                   rtol=1e-5)
+
+    def test_rsample_reparameterized_grad(self):
+        from paddle_tpu.distribution import Normal
+        from paddle_tpu.nn.layer.layers import Parameter
+
+        pt.seed(3)
+        loc = Parameter(pt.to_tensor(0.0))
+        scale = Parameter(pt.to_tensor(1.0))
+        d = Normal(loc, scale)
+        s = d.rsample([1000])
+        s.mean().backward()
+        # d mean(loc + eps*scale) / d loc = 1
+        np.testing.assert_allclose(float(loc.grad.numpy()), 1.0, rtol=1e-5)
+
+    def test_kl_grads(self):
+        from paddle_tpu.distribution import Normal, kl_divergence
+        from paddle_tpu.nn.layer.layers import Parameter
+
+        mu = Parameter(pt.to_tensor(0.3))
+        sig = Parameter(pt.to_tensor(0.8))
+        kl = kl_divergence(Normal(mu, sig), Normal(0.0, 1.0))
+        kl.backward()
+        # dKL/dmu = mu
+        np.testing.assert_allclose(float(mu.grad.numpy()), 0.3, rtol=1e-5)
