@@ -195,17 +195,14 @@ class TrainStep:
                 kwargs["in_shardings"] = (repl, repl, pspecs, state_specs,
                                           *in_batch)
             kwargs["out_shardings"] = (repl, pspecs, state_specs)
+        self._pure_step = pure_step
+        self._jit_kwargs = dict(kwargs)
+        self._multi_jitted = {}
         return jax.jit(pure_step, **kwargs)
 
     # ------------------------------------------------------------------- run
     def __call__(self, *batch):
-        arrays = tuple(b._data if isinstance(b, Tensor) else jnp.asarray(b)
-                       for b in batch)
-        if self._mesh is not None and self._batch_specs is not None:
-            arrays = tuple(
-                jax.device_put(a, NamedSharding(
-                    self._mesh, filtered_spec(s, self._mesh)))
-                for a, s in zip(arrays, self._batch_specs))
+        arrays = self._prepare_batch(batch)
         key = _rng.next_key()
         lr = jnp.asarray(self.optimizer.get_lr(), dtype=jnp.float32)
         loss, self.param_arrays, self.opt_state = self._jitted(
@@ -214,6 +211,57 @@ class TrainStep:
         # rebind model params to the fresh arrays: the old ones were donated
         # to XLA (deleted on TPU), and eager use of the model must keep
         # working between steps. This is a pointer swap, not a copy.
+        self.sync_params_to_model()
+        return Tensor(loss)
+
+    def _prepare_batch(self, batch):
+        arrays = tuple(b._data if isinstance(b, Tensor) else jnp.asarray(b)
+                       for b in batch)
+        if self._mesh is not None and self._batch_specs is not None:
+            arrays = tuple(
+                jax.device_put(a, NamedSharding(
+                    self._mesh, filtered_spec(s, self._mesh)))
+                for a, s in zip(arrays, self._batch_specs))
+        return arrays
+
+    def run_steps(self, n: int, *batch):
+        """Run ``n`` chained optimizer steps in ONE compiled program /
+        device dispatch (same batch each step). Amortizes the host->device
+        round-trip — essential when the chip sits behind a high-latency
+        link, and the standard pattern for TPU training loops driven from
+        a single controller. Returns the last step's loss.
+
+        The learning rate is read once and held constant for the whole
+        chunk: an LRScheduler advances on host-side ``scheduler.step()``
+        calls, which cannot happen inside the compiled chunk. Call
+        run_steps with chunks no longer than your LR update granularity.
+        """
+        if n == 1:
+            return self(*batch)
+        if n <= 0:
+            raise ValueError(f"run_steps needs n >= 1, got {n}")
+        if n not in self._multi_jitted:
+            pure = self._pure_step
+
+            def multi(keys, lr, params, state, *arrays):
+                # lax.scan: one compiled step body regardless of n
+                def body(carry, key):
+                    params, state = carry
+                    loss, params, state = pure(key, lr, params, state,
+                                               *arrays)
+                    return (params, state), loss
+
+                (params, state), losses = jax.lax.scan(
+                    body, (params, state), keys)
+                return losses[-1], params, state
+
+            self._multi_jitted[n] = jax.jit(multi, **self._jit_kwargs)
+        arrays = self._prepare_batch(batch)
+        keys = jnp.stack([_rng.next_key() for _ in range(n)])
+        lr = jnp.asarray(self.optimizer.get_lr(), dtype=jnp.float32)
+        loss, self.param_arrays, self.opt_state = self._multi_jitted[n](
+            keys, lr, tuple(self.param_arrays), self.opt_state, *arrays)
+        self._step_count += n
         self.sync_params_to_model()
         return Tensor(loss)
 
