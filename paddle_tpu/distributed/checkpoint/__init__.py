@@ -5,6 +5,7 @@ from __future__ import annotations
 
 import os
 import pickle
+import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
@@ -12,8 +13,8 @@ import numpy as np
 
 from ...core.tensor import Tensor
 
-__all__ = ["save_state_dict", "load_state_dict", "LocalTensorMetadata",
-           "Metadata"]
+__all__ = ["save_state_dict", "load_state_dict", "wait_async_save",
+           "LocalTensorMetadata", "Metadata"]
 
 
 @dataclass
@@ -52,10 +53,34 @@ def _local_view(t: Tensor):
     return [(np.asarray(data), (0,) * data.ndim)], tuple(data.shape)
 
 
+_async_lock = threading.Lock()
+_async_threads: List[threading.Thread] = []
+
+
+def _flush_payload(path, fname, shards_payload, meta, is_coordinator):
+    with open(fname, "wb") as f:
+        pickle.dump(shards_payload, f, protocol=4)
+    if is_coordinator:
+        with open(os.path.join(path, "0.metadata"), "wb") as f:
+            pickle.dump(meta, f, protocol=4)
+
+
+def wait_async_save():
+    """Join all pending async checkpoint writes (reference analog: the
+    async save queue drain in save_state_dict.py:46)."""
+    with _async_lock:
+        pending = list(_async_threads)
+        _async_threads.clear()
+    for t in pending:
+        t.join()
+
+
 def save_state_dict(state_dict, path, process_group=None,
                     coordinator_rank=0, unique_id=None, async_save=False):
     """reference: save_state_dict.py:145 (dedup_tensor :117 — only the
-    owner rank writes each shard)."""
+    owner rank writes each shard; async queue :46 — ``async_save=True``
+    snapshots to host then writes on a background thread; call
+    ``wait_async_save()`` before exiting)."""
     from ..parallel_env import get_rank
 
     os.makedirs(path, exist_ok=True)
@@ -75,15 +100,29 @@ def save_state_dict(state_dict, path, process_group=None,
             seen_offsets.add(offset)
             metas.append(LocalTensorMetadata(offset, tuple(arr.shape),
                                              str(arr.dtype)))
-            shards_payload[f"{key}|{offset}"] = arr
+            shards_payload[("shard", key, offset)] = arr
         meta.state_dict_metadata[key] = metas
         meta.storage_metadata[key] = f"{rank}_0.distcp"
     fname = os.path.join(path, f"{rank}_0.distcp")
-    with open(fname, "wb") as f:
-        pickle.dump(shards_payload, f, protocol=4)
-    if rank == coordinator_rank:
-        with open(os.path.join(path, "0.metadata"), "wb") as f:
-            pickle.dump(meta, f, protocol=4)
+    is_coord = rank == coordinator_rank
+    if async_save:
+        # tensor shards are already host numpy snapshots (_local_view);
+        # deep-copy objects/metadata so caller mutations after return
+        # cannot tear the checkpoint
+        import copy
+
+        if "_objects" in shards_payload:
+            shards_payload["_objects"] = copy.deepcopy(
+                shards_payload["_objects"])
+        meta = copy.deepcopy(meta)
+        t = threading.Thread(target=_flush_payload,
+                             args=(path, fname, shards_payload, meta,
+                                   is_coord), daemon=True)
+        t.start()
+        with _async_lock:
+            _async_threads.append(t)
+        return
+    _flush_payload(path, fname, shards_payload, meta, is_coord)
 
 
 def load_state_dict(state_dict, path, process_group=None,
@@ -104,8 +143,14 @@ def load_state_dict(state_dict, path, process_group=None,
             if k == "_objects":
                 objects.update(v)
                 continue
-            name, offset = k.rsplit("|", 1)
-            all_shards.setdefault(name, []).append((eval(offset), v))
+            if isinstance(k, tuple):
+                _, name, offset = k  # ("shard", key, offset-tuple)
+            else:
+                # legacy "key|(off, ...)" string layout
+                name, off_s = k.rsplit("|", 1)
+                offset = tuple(
+                    int(x) for x in off_s.strip("()").split(",") if x.strip())
+            all_shards.setdefault(name, []).append((offset, v))
     for key, t in state_dict.items():
         if not isinstance(t, Tensor):
             if key in objects:

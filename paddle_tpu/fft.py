@@ -1,0 +1,74 @@
+"""FFT API (reference: python/paddle/fft.py — fft/ifft/rfft/irfft +
+2d/nd variants, hfft/ihfft, fftshift, fftfreq). Lowered to XLA's FFT HLO
+via jnp.fft; differentiable through run_op."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core.tensor import Tensor
+from .ops._helpers import as_tensor, run_op
+
+__all__ = ["fft", "ifft", "rfft", "irfft", "hfft", "ihfft", "fft2", "ifft2",
+           "rfft2", "irfft2", "fftn", "ifftn", "rfftn", "irfftn",
+           "fftshift", "ifftshift", "fftfreq", "rfftfreq"]
+
+
+def _wrap1(jfn, op_name):
+    def op(x, n=None, axis=-1, norm="backward", name=None):
+        return run_op(lambda a: jfn(a, n=n, axis=axis, norm=norm),
+                      [as_tensor(x)], name=op_name)
+
+    op.__name__ = op_name
+    return op
+
+
+def _wrap2(jfn, op_name):
+    def op(x, s=None, axes=(-2, -1), norm="backward", name=None):
+        return run_op(lambda a: jfn(a, s=s, axes=axes, norm=norm),
+                      [as_tensor(x)], name=op_name)
+
+    op.__name__ = op_name
+    return op
+
+
+def _wrapn(jfn, op_name):
+    def op(x, s=None, axes=None, norm="backward", name=None):
+        return run_op(lambda a: jfn(a, s=s, axes=axes, norm=norm),
+                      [as_tensor(x)], name=op_name)
+
+    op.__name__ = op_name
+    return op
+
+
+fft = _wrap1(jnp.fft.fft, "fft")
+ifft = _wrap1(jnp.fft.ifft, "ifft")
+rfft = _wrap1(jnp.fft.rfft, "rfft")
+irfft = _wrap1(jnp.fft.irfft, "irfft")
+hfft = _wrap1(jnp.fft.hfft, "hfft")
+ihfft = _wrap1(jnp.fft.ihfft, "ihfft")
+fft2 = _wrap2(jnp.fft.fft2, "fft2")
+ifft2 = _wrap2(jnp.fft.ifft2, "ifft2")
+rfft2 = _wrap2(jnp.fft.rfft2, "rfft2")
+irfft2 = _wrap2(jnp.fft.irfft2, "irfft2")
+fftn = _wrapn(jnp.fft.fftn, "fftn")
+ifftn = _wrapn(jnp.fft.ifftn, "ifftn")
+rfftn = _wrapn(jnp.fft.rfftn, "rfftn")
+irfftn = _wrapn(jnp.fft.irfftn, "irfftn")
+
+
+def fftshift(x, axes=None, name=None):
+    return run_op(lambda a: jnp.fft.fftshift(a, axes=axes), [as_tensor(x)],
+                  name="fftshift")
+
+
+def ifftshift(x, axes=None, name=None):
+    return run_op(lambda a: jnp.fft.ifftshift(a, axes=axes), [as_tensor(x)],
+                  name="ifftshift")
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    return Tensor(jnp.fft.fftfreq(n, d))
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    return Tensor(jnp.fft.rfftfreq(n, d))

@@ -1,0 +1,6 @@
+"""paddle.linalg namespace (reference: python/paddle/linalg.py — re-exports
+the tensor linalg surface)."""
+from .ops.linalg import *  # noqa: F401,F403
+from .ops.linalg import __all__ as _linalg_all
+
+__all__ = list(_linalg_all)

@@ -12,8 +12,10 @@ from .manipulation import *  # noqa: F401,F403
 from .linalg import *  # noqa: F401,F403
 from .logic import *  # noqa: F401,F403
 from .random_ops import *  # noqa: F401,F403
+from .extras import *  # noqa: F401,F403
 
-from . import creation, math, manipulation, linalg, logic, random_ops  # noqa: F401
+from . import (creation, extras, linalg, logic, manipulation,  # noqa: F401
+               math, random_ops)
 
 __all__ = (
     creation.__all__
@@ -22,4 +24,5 @@ __all__ = (
     + linalg.__all__
     + logic.__all__
     + random_ops.__all__
+    + extras.__all__
 )

@@ -1,0 +1,281 @@
+"""Long-tail tensor ops (reference: the remainder of the
+python/paddle/tensor/ surface — math.py/manipulation.py entries not covered
+by the core modules: diagonal, logcumsumexp, quantile, mode, trapezoid,
+renorm, frexp/ldexp, complex helpers, special functions, isin, vdot,
+baddbmm, masked_scatter, unfold)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.scipy import special as jsp
+
+from ._helpers import as_tensor, binary, run_op, unary, unwrap
+
+__all__ = ["diagonal", "logcumsumexp", "quantile", "nanquantile", "mode",
+           "trapezoid", "cumulative_trapezoid", "renorm", "frexp", "ldexp",
+           "polar", "as_complex", "as_real", "gammaln", "gammainc",
+           "gammaincc", "i0", "i0e", "i1", "i1e", "sinc", "isin", "vdot",
+           "baddbmm", "masked_scatter", "unfold", "logit", "polygamma"]
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return run_op(lambda a: jnp.diagonal(a, offset, axis1, axis2),
+                  [as_tensor(x)], name="diagonal")
+
+
+def logcumsumexp(x, axis=None, dtype=None, name=None):
+    def fn(a):
+        if axis is None:
+            a = a.reshape(-1)
+            ax = 0
+        else:
+            ax = axis
+        return jax.lax.associative_scan(jnp.logaddexp, a, axis=ax)
+
+    return run_op(fn, [as_tensor(x)], name="logcumsumexp")
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear",
+             name=None):
+    return run_op(lambda a: jnp.quantile(
+        a, jnp.asarray(q), axis=axis, keepdims=keepdim,
+        method=interpolation), [as_tensor(x)], name="quantile")
+
+
+def nanquantile(x, q, axis=None, keepdim=False, name=None):
+    return run_op(lambda a: jnp.nanquantile(
+        a, jnp.asarray(q), axis=axis, keepdims=keepdim),
+        [as_tensor(x)], name="nanquantile")
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    """Most frequent value along axis; returns (values, indices)."""
+    t = as_tensor(x)
+
+    def fn(a):
+        sorted_a = jnp.sort(a, axis=axis)
+        ax = axis if axis >= 0 else a.ndim + axis
+        n = a.shape[ax]
+        shape = [1] * a.ndim
+        shape[ax] = n
+        arange = jnp.arange(n).reshape(shape)
+        # run-length on sorted values: position minus run-start index
+        same = jnp.concatenate(
+            [jnp.zeros_like(jnp.take(sorted_a, jnp.array([0]), axis=ax),
+                            dtype=jnp.int32),
+             (jnp.diff(sorted_a, axis=ax) == 0).astype(jnp.int32)],
+            axis=ax)
+        start_marker = jnp.where(same == 1, 0, arange)
+        run_start = jax.lax.cummax(start_marker, axis=ax)
+        run_len = arange - run_start + 1
+        best = jnp.argmax(run_len, axis=ax, keepdims=True)
+        vals = jnp.take_along_axis(sorted_a, best, axis=ax)
+        if not keepdim:
+            vals = jnp.squeeze(vals, axis=ax)
+        return vals
+
+    vals = run_op(fn, [t], name="mode")
+    # indices: first occurrence of the modal value in the original order
+    import numpy as _np
+
+    idx = run_op(lambda a, v: jnp.argmax(
+        a == (v if keepdim else jnp.expand_dims(v, axis)), axis=axis),
+        [t, vals], name="mode_idx")
+    return vals, idx
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    ts = [as_tensor(y)]
+    if x is not None:
+        ts.append(as_tensor(x))
+
+        def fn(ya, xa):
+            return jax.scipy.integrate.trapezoid(ya, xa, axis=axis)
+    else:
+        step = 1.0 if dx is None else dx
+
+        def fn(ya):
+            return jax.scipy.integrate.trapezoid(ya, dx=step, axis=axis)
+
+    return run_op(fn, ts, name="trapezoid")
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    ts = [as_tensor(y)]
+    step = 1.0 if dx is None else dx
+
+    def fn(ya, *rest):
+        ya_m = jnp.moveaxis(ya, axis, -1)
+        if rest:
+            xa = jnp.moveaxis(rest[0], axis, -1)
+            d = jnp.diff(xa, axis=-1)
+        else:
+            d = step
+        avg = (ya_m[..., 1:] + ya_m[..., :-1]) / 2.0
+        out = jnp.cumsum(avg * d, axis=-1)
+        return jnp.moveaxis(out, -1, axis)
+
+    if x is not None:
+        ts.append(as_tensor(x))
+    return run_op(fn, ts, name="cumulative_trapezoid")
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    def fn(a):
+        moved = jnp.moveaxis(a, axis, 0)
+        flat = moved.reshape(moved.shape[0], -1)
+        norms = jnp.linalg.norm(flat, ord=p, axis=1)
+        scale = jnp.where(norms > max_norm,
+                          max_norm / jnp.maximum(norms, 1e-12), 1.0)
+        out = flat * scale[:, None]
+        return jnp.moveaxis(out.reshape(moved.shape), 0, axis)
+
+    return run_op(fn, [as_tensor(x)], name="renorm")
+
+
+def frexp(x, name=None):
+    t = as_tensor(x)
+    # mantissa differentiable; exponent is integer (non-diff output is
+    # fine: run_op only differentiates float cotangents of float outputs)
+    m = run_op(lambda a: jnp.frexp(a)[0], [t], name="frexp")
+    from ..core.tensor import Tensor
+
+    e = Tensor(jnp.frexp(unwrap(t))[1])
+    return m, e
+
+
+def ldexp(x, y, name=None):
+    return binary(lambda a, b: jnp.ldexp(a, b.astype(jnp.int32)), x, y,
+                  "ldexp")
+
+
+def polar(abs, angle, name=None):
+    return binary(lambda r, t: (r * jnp.cos(t)).astype(jnp.complex64)
+                  + 1j * (r * jnp.sin(t)).astype(jnp.complex64),
+                  abs, angle, "polar")
+
+
+def as_complex(x, name=None):
+    return run_op(lambda a: jax.lax.complex(a[..., 0], a[..., 1]),
+                  [as_tensor(x)], name="as_complex")
+
+
+def as_real(x, name=None):
+    return run_op(lambda a: jnp.stack([jnp.real(a), jnp.imag(a)], axis=-1),
+                  [as_tensor(x)], name="as_real")
+
+
+def gammaln(x, name=None):
+    return unary(jsp.gammaln, x, "gammaln")
+
+
+def gammainc(x, y, name=None):
+    return binary(jsp.gammainc, x, y, "gammainc")
+
+
+def gammaincc(x, y, name=None):
+    return binary(jsp.gammaincc, x, y, "gammaincc")
+
+
+def i0(x, name=None):
+    return unary(jsp.i0, x, "i0")
+
+
+def i0e(x, name=None):
+    return unary(jsp.i0e, x, "i0e")
+
+
+def i1(x, name=None):
+    return unary(jsp.i1, x, "i1")
+
+
+def i1e(x, name=None):
+    return unary(jsp.i1e, x, "i1e")
+
+
+def sinc(x, name=None):
+    return unary(jnp.sinc, x, "sinc")
+
+
+def logit(x, eps=None, name=None):
+    def fn(a):
+        if eps is not None:
+            a = jnp.clip(a, eps, 1 - eps)
+        return jnp.log(a) - jnp.log1p(-a)
+
+    return run_op(fn, [as_tensor(x)], name="logit")
+
+
+def polygamma(x, n, name=None):
+    return run_op(lambda a: jsp.polygamma(n, a), [as_tensor(x)],
+                  name="polygamma")
+
+
+def isin(x, test_x, assume_unique=False, invert=False, name=None):
+    from ..core.tensor import Tensor
+
+    a = unwrap(as_tensor(x))
+    b = unwrap(as_tensor(test_x))
+    return Tensor(jnp.isin(a, b, invert=invert))
+
+
+def vdot(x, y, name=None):
+    return binary(lambda a, b: jnp.vdot(a, b), x, y, "vdot")
+
+
+def baddbmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return run_op(lambda i, a, b: beta * i + alpha * jnp.matmul(a, b),
+                  [as_tensor(input), as_tensor(x), as_tensor(y)],
+                  name="baddbmm")
+
+
+def masked_scatter(x, mask, value, name=None):
+    mask_t = as_tensor(mask)
+    value_t = as_tensor(value)
+    if not isinstance(unwrap(mask_t), jax.core.Tracer):
+        needed = int(jnp.sum(unwrap(mask_t).astype(jnp.int32)))
+        if value_t.size < needed:
+            raise ValueError(
+                f"masked_scatter: value has {value_t.size} elements but "
+                f"mask selects {needed}")
+
+    def fn(a, m, v):
+        flat_v = v.reshape(-1)
+        m_b = m.astype(bool)
+        # position of each True among the mask order
+        pos = jnp.cumsum(m_b.reshape(-1)) - 1
+        take = jnp.clip(pos, 0, flat_v.shape[0] - 1)
+        cand = flat_v[take].reshape(a.shape)
+        return jnp.where(m_b, cand, a)
+
+    return run_op(fn, [as_tensor(x), mask_t, value_t],
+                  name="masked_scatter")
+
+
+def unfold(x, axis, size, step, name=None):
+    """Sliding windows along ``axis`` (paddle.Tensor.unfold semantics):
+    output adds a trailing window dim of length ``size``."""
+    def _reorder(win, ndim, ax):
+        # win: [n, size, rest...] where rest = dims except `ax`;
+        # target: n back at position ax, window size last
+        perm = []
+        rest = list(range(2, win.ndim))
+        ri = 0
+        for d in range(ndim):
+            if d == ax:
+                perm.append(0)
+            else:
+                perm.append(rest[ri])
+                ri += 1
+        perm.append(1)
+        return jnp.transpose(win, perm)
+
+    def fn(a):
+        ax = axis if axis >= 0 else a.ndim + axis
+        n = (a.shape[ax] - size) // step + 1
+        idx = jnp.arange(n)[:, None] * step + jnp.arange(size)[None, :]
+        moved = jnp.moveaxis(a, ax, 0)
+        win = moved[idx]                 # [n, size, rest...]
+        return _reorder(win, a.ndim, ax)
+
+    return run_op(fn, [as_tensor(x)], name="unfold")

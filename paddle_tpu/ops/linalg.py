@@ -16,7 +16,7 @@ __all__ = [
     "cholesky", "qr", "svd", "pinv", "inv", "solve", "triangular_solve",
     "lstsq", "eig", "eigh", "eigvals", "eigvalsh", "det", "slogdet",
     "matrix_rank", "lu", "cholesky_solve", "matrix_transpose", "cdist",
-    "householder_product", "pca_lowrank", "vander",
+    "householder_product", "pca_lowrank", "vander", "cond",
 ]
 
 
@@ -298,3 +298,15 @@ def pca_lowrank(x, q=None, center=True, niter=2, name=None):
 
 def vander(x, n=None, increasing=False, name=None):
     return unary(lambda a: jnp.vander(a, N=n, increasing=increasing), x, "vander")
+
+
+def cond(x, p=None, name=None):
+    """Condition number (reference: paddle.linalg.cond)."""
+    def fn(a):
+        if p is None or p == 2:
+            s = jnp.linalg.svd(a, compute_uv=False)
+            return s[..., 0] / s[..., -1]
+        return jnp.linalg.norm(a, ord=p, axis=(-2, -1)) * \
+            jnp.linalg.norm(jnp.linalg.inv(a), ord=p, axis=(-2, -1))
+
+    return run_op(fn, [as_tensor(x)], name="cond")

@@ -1,0 +1,157 @@
+"""Long-tail ops, fft, linalg namespace (reference analogs:
+test/legacy_test per-op tests; OpTest numeric-reference strategy)."""
+import numpy as np
+import pytest
+import scipy.special
+
+import paddle_tpu as pt
+
+
+def t(a):
+    return pt.to_tensor(np.asarray(a, dtype=np.float32))
+
+
+class TestExtras:
+    def test_diagonal(self):
+        a = np.arange(12, dtype=np.float32).reshape(3, 4)
+        np.testing.assert_array_equal(pt.diagonal(t(a)).numpy(),
+                                      np.diagonal(a))
+        np.testing.assert_array_equal(pt.diagonal(t(a), offset=1).numpy(),
+                                      np.diagonal(a, 1))
+
+    def test_logcumsumexp(self):
+        a = np.random.randn(8).astype(np.float32)
+        out = pt.logcumsumexp(t(a), axis=0).numpy()
+        ref = np.logaddexp.accumulate(a)
+        np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+    def test_quantile(self):
+        a = np.random.randn(40).astype(np.float32)
+        np.testing.assert_allclose(pt.quantile(t(a), 0.3).numpy(),
+                                   np.quantile(a, 0.3), rtol=1e-5)
+
+    def test_mode(self):
+        a = np.array([[1., 2., 2., 3.], [5., 5., 5., 1.]], np.float32)
+        vals, idx = pt.mode(t(a))
+        np.testing.assert_array_equal(vals.numpy(), [2.0, 5.0])
+
+    def test_trapezoid(self):
+        y = np.array([1., 2., 3.], np.float32)
+        x = np.array([0., 1., 3.], np.float32)
+        np.testing.assert_allclose(pt.trapezoid(t(y), t(x)).numpy(),
+                                   np.trapezoid(y, x), rtol=1e-6)
+
+    def test_renorm(self):
+        a = np.random.randn(3, 4).astype(np.float32) * 10
+        out = pt.renorm(t(a), p=2, axis=0, max_norm=1.0).numpy()
+        norms = np.linalg.norm(out.reshape(3, -1), axis=1)
+        assert (norms <= 1.0 + 1e-5).all()
+
+    def test_frexp_ldexp(self):
+        a = np.array([1.5, -3.0, 0.25], np.float32)
+        m, e = pt.frexp(t(a))
+        np.testing.assert_allclose(m.numpy() * 2.0 ** e.numpy(), a,
+                                   rtol=1e-6)
+        out = pt.ldexp(t(np.array([1.0, 1.0])), t(np.array([3, -1])))
+        np.testing.assert_allclose(out.numpy(), [8.0, 0.5])
+
+    def test_complex_helpers(self):
+        r = np.array([[1., 2.]], np.float32)
+        c = pt.as_complex(t(r))
+        assert c.numpy().dtype == np.complex64
+        back = pt.as_real(c)
+        np.testing.assert_allclose(back.numpy(), r)
+        p = pt.polar(t([2.0]), t([np.pi / 2]))
+        np.testing.assert_allclose(p.numpy(), [2j], atol=1e-6)
+
+    def test_special_functions(self):
+        x = np.array([0.5, 1.5, 3.0], np.float32)
+        np.testing.assert_allclose(pt.gammaln(t(x)).numpy(),
+                                   scipy.special.gammaln(x), rtol=1e-5)
+        np.testing.assert_allclose(pt.i0(t(x)).numpy(),
+                                   scipy.special.i0(x), rtol=1e-5)
+        np.testing.assert_allclose(pt.sinc(t(x)).numpy(),
+                                   np.sinc(x), rtol=1e-5)
+        np.testing.assert_allclose(
+            pt.erfinv(t(np.array([0.5], np.float32))).numpy(),
+            scipy.special.erfinv(0.5), rtol=1e-5)
+
+    def test_isin(self):
+        a = np.array([1, 2, 3, 4])
+        out = pt.isin(pt.to_tensor(a), pt.to_tensor(np.array([2, 4])))
+        np.testing.assert_array_equal(out.numpy(), [False, True, False, True])
+
+    def test_vdot_baddbmm(self):
+        a = np.random.randn(4).astype(np.float32)
+        b = np.random.randn(4).astype(np.float32)
+        np.testing.assert_allclose(pt.vdot(t(a), t(b)).numpy(),
+                                   np.vdot(a, b), rtol=1e-5)
+        i = np.random.randn(2, 3, 5).astype(np.float32)
+        x = np.random.randn(2, 3, 4).astype(np.float32)
+        y = np.random.randn(2, 4, 5).astype(np.float32)
+        out = pt.baddbmm(t(i), t(x), t(y), beta=0.5, alpha=2.0).numpy()
+        np.testing.assert_allclose(out, 0.5 * i + 2.0 * (x @ y), rtol=1e-4)
+
+    def test_masked_scatter(self):
+        a = np.zeros((2, 3), np.float32)
+        mask = np.array([[1, 0, 1], [0, 1, 0]], bool)
+        vals = np.array([10., 20., 30.], np.float32)
+        out = pt.masked_scatter(t(a), pt.to_tensor(mask), t(vals)).numpy()
+        np.testing.assert_array_equal(out, [[10, 0, 20], [0, 30, 0]])
+
+    def test_unfold(self):
+        a = np.arange(10, dtype=np.float32)
+        out = pt.unfold(t(a), axis=0, size=4, step=2).numpy()
+        assert out.shape == (4, 4)
+        np.testing.assert_array_equal(out[1], [2, 3, 4, 5])
+        b = np.arange(24, dtype=np.float32).reshape(2, 12)
+        out2 = pt.unfold(t(b), axis=1, size=6, step=3).numpy()
+        assert out2.shape == (2, 3, 6)
+        np.testing.assert_array_equal(out2[0, 1], b[0, 3:9])
+
+
+class TestFFT:
+    def test_fft_roundtrip(self):
+        a = np.random.randn(16).astype(np.float32)
+        f = pt.fft.fft(t(a))
+        back = pt.fft.ifft(f).numpy()
+        np.testing.assert_allclose(back.real, a, atol=1e-5)
+
+    def test_rfft_matches_numpy(self):
+        a = np.random.randn(16).astype(np.float32)
+        np.testing.assert_allclose(pt.fft.rfft(t(a)).numpy(),
+                                   np.fft.rfft(a), atol=1e-4)
+
+    def test_fft2_and_shift(self):
+        a = np.random.randn(4, 4).astype(np.float32)
+        np.testing.assert_allclose(pt.fft.fft2(t(a)).numpy(),
+                                   np.fft.fft2(a), atol=1e-4)
+        np.testing.assert_allclose(
+            pt.fft.fftshift(t(np.arange(4, dtype=np.float32))).numpy(),
+            np.fft.fftshift(np.arange(4.0)))
+
+    def test_fftfreq(self):
+        np.testing.assert_allclose(pt.fft.fftfreq(8, 0.5).numpy(),
+                                   np.fft.fftfreq(8, 0.5))
+
+    def test_rfft_grad(self):
+        a = t(np.random.randn(8).astype(np.float32))
+        a.stop_gradient = False
+        out = pt.fft.rfft(a)
+        # abs^2 spectrum sum -> real loss
+        loss = pt.as_real(out).pow(2).sum()
+        loss.backward()
+        assert a.grad is not None
+        assert np.isfinite(a.grad.numpy()).all()
+
+
+class TestLinalgNamespace:
+    def test_cond(self):
+        a = np.diag([1.0, 10.0]).astype(np.float32)
+        np.testing.assert_allclose(pt.linalg.cond(t(a)).numpy(), 10.0,
+                                   rtol=1e-5)
+
+    def test_namespace_complete(self):
+        for fn in ("svd", "qr", "cholesky", "solve", "inv", "det", "norm",
+                   "eig", "eigh", "lstsq", "pinv", "matrix_power"):
+            assert hasattr(pt.linalg, fn), fn
