@@ -68,6 +68,7 @@ from . import text  # noqa: F401,E402
 from . import inference  # noqa: F401,E402
 from . import fft  # noqa: F401,E402
 from . import linalg  # noqa: F401,E402
+from . import audio  # noqa: F401,E402
 from . import device  # noqa: F401,E402
 from . import models  # noqa: F401,E402
 from .framework.io_utils import load, save  # noqa: F401,E402

@@ -1,0 +1,7 @@
+"""Audio features (reference: python/paddle/audio/)."""
+from . import features, functional  # noqa: F401
+from .features import (LogMelSpectrogram, MFCC, MelSpectrogram,  # noqa: F401
+                       Spectrogram)
+
+__all__ = ["functional", "features", "Spectrogram", "MelSpectrogram",
+           "LogMelSpectrogram", "MFCC"]

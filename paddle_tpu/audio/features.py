@@ -1,0 +1,141 @@
+"""Audio feature layers (reference: python/paddle/audio/features/layers.py —
+Spectrogram, MelSpectrogram, LogMelSpectrogram, MFCC)."""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from .. import nn
+from ..core.tensor import Tensor
+from ..ops._helpers import as_tensor, run_op
+from .functional import (compute_fbank_matrix, create_dct, get_window,
+                         power_to_db)
+
+__all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]
+
+
+def _frame_signal(x, n_fft, hop_length, center, pad_mode="reflect"):
+    """x: [..., time] -> frames [..., n_frames, n_fft]."""
+    if center:
+        pad = [(0, 0)] * (x.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+        x = jnp.pad(x, pad, mode=pad_mode)
+    n = x.shape[-1]
+    n_frames = 1 + (n - n_fft) // hop_length
+    idx = (jnp.arange(n_frames)[:, None] * hop_length
+           + jnp.arange(n_fft)[None, :])
+    return x[..., idx]
+
+
+class Spectrogram(nn.Layer):
+    """STFT magnitude/power spectrogram (reference: layers.py Spectrogram).
+    Output: [..., n_fft//2 + 1, n_frames]."""
+
+    def __init__(self, n_fft: int = 512, hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", dtype: str = "float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        w = get_window(window, self.win_length)._data
+        if self.win_length < n_fft:
+            lpad = (n_fft - self.win_length) // 2
+            w = jnp.pad(w, (lpad, n_fft - self.win_length - lpad))
+        self._window = w
+
+    def forward(self, x):
+        t = as_tensor(x)
+        n_fft, hop, win, power, center, pad_mode = (
+            self.n_fft, self.hop_length, self._window, self.power,
+            self.center, self.pad_mode)
+
+        def fn(a):
+            frames = _frame_signal(a, n_fft, hop, center, pad_mode)
+            spec = jnp.fft.rfft(frames * win, axis=-1)
+            mag = jnp.abs(spec)
+            if power != 1.0:
+                mag = mag ** power
+            # [..., n_frames, bins] -> [..., bins, n_frames]
+            return jnp.swapaxes(mag, -1, -2)
+
+        return run_op(fn, [t], name="spectrogram")
+
+
+class MelSpectrogram(nn.Layer):
+    """reference: layers.py MelSpectrogram."""
+
+    def __init__(self, sr: int = 22050, n_fft: int = 512,
+                 hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 2.0, center: bool = True,
+                 n_mels: int = 64, f_min: float = 50.0,
+                 f_max: Optional[float] = None, htk: bool = False,
+                 norm: str = "slaney", dtype: str = "float32"):
+        super().__init__()
+        self.spectrogram = Spectrogram(n_fft, hop_length, win_length,
+                                       window, power, center)
+        self._fbank = compute_fbank_matrix(
+            sr, n_fft, n_mels, f_min, f_max, htk, norm)._data
+
+    def forward(self, x):
+        spec = self.spectrogram(x)
+        fb = self._fbank
+
+        def fn(s):
+            return jnp.einsum("mf,...ft->...mt", fb, s)
+
+        return run_op(fn, [spec], name="mel_spectrogram")
+
+
+class LogMelSpectrogram(nn.Layer):
+    """reference: layers.py LogMelSpectrogram."""
+
+    def __init__(self, sr: int = 22050, n_fft: int = 512,
+                 hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 2.0, center: bool = True,
+                 n_mels: int = 64, f_min: float = 50.0,
+                 f_max: Optional[float] = None, htk: bool = False,
+                 norm: str = "slaney", ref_value: float = 1.0,
+                 amin: float = 1e-10, top_db: Optional[float] = None,
+                 dtype: str = "float32"):
+        super().__init__()
+        self.mel = MelSpectrogram(sr, n_fft, hop_length, win_length, window,
+                                  power, center, n_mels, f_min, f_max, htk,
+                                  norm)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        return power_to_db(self.mel(x), self.ref_value, self.amin,
+                           self.top_db)
+
+
+class MFCC(nn.Layer):
+    """reference: layers.py MFCC."""
+
+    def __init__(self, sr: int = 22050, n_mfcc: int = 40, n_fft: int = 512,
+                 hop_length: Optional[int] = None, n_mels: int = 64,
+                 f_min: float = 50.0, f_max: Optional[float] = None,
+                 top_db: Optional[float] = None, dtype: str = "float32",
+                 **mel_kwargs):
+        super().__init__()
+        self.log_mel = LogMelSpectrogram(
+            sr=sr, n_fft=n_fft, hop_length=hop_length, n_mels=n_mels,
+            f_min=f_min, f_max=f_max, top_db=top_db, **mel_kwargs)
+        self._dct = create_dct(n_mfcc, n_mels)._data
+
+    def forward(self, x):
+        logmel = self.log_mel(x)
+        dct = self._dct
+
+        def fn(lm):
+            return jnp.einsum("mk,...mt->...kt", dct, lm)
+
+        return run_op(fn, [logmel], name="mfcc")

@@ -1,0 +1,144 @@
+"""Audio functional ops (reference: python/paddle/audio/functional/ —
+window.py get_window, functional.py hz_to_mel/mel_to_hz/mel_frequencies/
+fft_frequencies/compute_fourier_basis equivalents, create_dct).
+
+All transforms compose jnp ops (FFT lowers to XLA's FFT HLO), so they run
+on TPU and are differentiable through run_op.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..ops._helpers import as_tensor, run_op
+
+__all__ = ["get_window", "hz_to_mel", "mel_to_hz", "mel_frequencies",
+           "fft_frequencies", "compute_fbank_matrix", "create_dct",
+           "power_to_db"]
+
+
+def get_window(window: str, win_length: int, fftbins: bool = True,
+               dtype: str = "float64") -> Tensor:
+    """reference: audio/functional/window.py get_window."""
+    n = win_length
+    sym = not fftbins
+    denom = (n - 1) if sym else n
+    k = np.arange(n)
+    if window in ("hann", "hanning"):
+        w = 0.5 - 0.5 * np.cos(2 * np.pi * k / denom)
+    elif window == "hamming":
+        w = 0.54 - 0.46 * np.cos(2 * np.pi * k / denom)
+    elif window == "blackman":
+        w = (0.42 - 0.5 * np.cos(2 * np.pi * k / denom)
+             + 0.08 * np.cos(4 * np.pi * k / denom))
+    elif window in ("rect", "boxcar", "ones"):
+        w = np.ones(n)
+    elif window == "bartlett":
+        w = 1.0 - np.abs(2.0 * k / denom - 1.0)
+    else:
+        raise ValueError(f"unsupported window {window!r}")
+    # float64 requires jax_enable_x64; degrade gracefully to float32
+    import jax
+
+    jdt = jnp.float64 if (dtype == "float64"
+                          and jax.config.jax_enable_x64) else jnp.float32
+    return Tensor(jnp.asarray(w, dtype=jdt))
+
+
+def hz_to_mel(freq, htk: bool = False):
+    """reference: audio/functional/functional.py hz_to_mel."""
+    f = np.asarray(freq, dtype=np.float64)
+    if htk:
+        out = 2595.0 * np.log10(1.0 + f / 700.0)
+    else:
+        # Slaney
+        f_min, f_sp = 0.0, 200.0 / 3
+        mels = (f - f_min) / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        mels = np.where(f >= min_log_hz,
+                        min_log_mel + np.log(np.maximum(f, 1e-10)
+                                             / min_log_hz) / logstep, mels)
+        out = mels
+    return float(out) if np.isscalar(freq) else out
+
+
+def mel_to_hz(mel, htk: bool = False):
+    m = np.asarray(mel, dtype=np.float64)
+    if htk:
+        out = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        freqs = f_min + f_sp * m
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        freqs = np.where(m >= min_log_mel,
+                         min_log_hz * np.exp(logstep * (m - min_log_mel)),
+                         freqs)
+        out = freqs
+    return float(out) if np.isscalar(mel) else out
+
+
+def mel_frequencies(n_mels: int = 64, f_min: float = 0.0,
+                    f_max: float = 11025.0, htk: bool = False):
+    mels = np.linspace(hz_to_mel(f_min, htk), hz_to_mel(f_max, htk), n_mels)
+    return mel_to_hz(mels, htk)
+
+
+def fft_frequencies(sr: int, n_fft: int):
+    return np.linspace(0, sr / 2, 1 + n_fft // 2)
+
+
+def compute_fbank_matrix(sr: int, n_fft: int, n_mels: int = 64,
+                         f_min: float = 0.0, f_max: Optional[float] = None,
+                         htk: bool = False, norm: str = "slaney") -> Tensor:
+    """Mel filterbank [n_mels, 1 + n_fft//2] (reference:
+    compute_fbank_matrix)."""
+    if f_max is None:
+        f_max = sr / 2.0
+    fftfreqs = fft_frequencies(sr, n_fft)
+    mel_f = mel_frequencies(n_mels + 2, f_min, f_max, htk)
+    fdiff = np.diff(mel_f)
+    ramps = mel_f[:, None] - fftfreqs[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    weights = np.maximum(0, np.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (mel_f[2: n_mels + 2] - mel_f[:n_mels])
+        weights *= enorm[:, None]
+    return Tensor(jnp.asarray(weights, dtype=jnp.float32))
+
+
+def create_dct(n_mfcc: int, n_mels: int, norm: Optional[str] = "ortho"
+               ) -> Tensor:
+    """DCT-II matrix [n_mels, n_mfcc] (reference: create_dct)."""
+    n = np.arange(n_mels)
+    k = np.arange(n_mfcc)[:, None]
+    dct = np.cos(np.pi / n_mels * (n + 0.5) * k)
+    if norm == "ortho":
+        dct[0] *= 1.0 / math.sqrt(2.0)
+        dct *= math.sqrt(2.0 / n_mels)
+    else:
+        dct *= 2.0
+    return Tensor(jnp.asarray(dct.T, dtype=jnp.float32))
+
+
+def power_to_db(spect, ref_value: float = 1.0, amin: float = 1e-10,
+                top_db: Optional[float] = 80.0):
+    """reference: audio/functional power_to_db."""
+    t = as_tensor(spect)
+
+    def fn(s):
+        log_spec = 10.0 * jnp.log10(jnp.maximum(amin, s))
+        log_spec = log_spec - 10.0 * math.log10(max(amin, ref_value))
+        if top_db is not None:
+            log_spec = jnp.maximum(log_spec, jnp.max(log_spec) - top_db)
+        return log_spec
+
+    return run_op(fn, [t], name="power_to_db")

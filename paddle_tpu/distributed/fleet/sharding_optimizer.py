@@ -189,11 +189,21 @@ class GroupShardedStage3:
         if self._nranks > 1:
             self._shard_all()
             self._register_hooks()
+        # reference _redefine_opt_step (group_sharded_stage3.py): the user
+        # keeps calling optimizer.step(); route it through stage-3's
+        # reduce+update+reshard step
+        self._opt_step_orig = optimizer.step
+        optimizer.step = self.step
 
     # -- param shard/unshard ------------------------------------------------
+    def _is_full(self, p) -> bool:
+        return tuple(p.shape) == self._full_shapes[id(p)]
+
     def _shard_param(self, p):
         import jax.numpy as jnp
 
+        if not self._is_full(p):
+            return  # already a shard (layer skipped this forward)
         flat = p._data.reshape(-1)
         n = flat.shape[0]
         per = -(-n // self._nranks)
@@ -205,6 +215,8 @@ class GroupShardedStage3:
     def _unshard_param(self, p):
         import jax.numpy as jnp
 
+        if self._is_full(p):
+            return  # pre-hook already materialized it this step
         outs: List[Tensor] = []
         dist.all_gather(outs, Tensor(p._data), group=self._group)
         full = jnp.concatenate([o._data for o in outs])
@@ -247,17 +259,15 @@ class GroupShardedStage3:
     def step(self):
         """Reduce grads to shards, update local shard, keep params sharded."""
         if self._nranks <= 1:
-            self._optimizer.step()
+            self._opt_step_orig()
             return
-        import jax.numpy as jnp
-
         # params are currently full (post-forward/backward); reduce grads
         for p in self._params:
             if p._grad is None:
                 continue
             dist.all_reduce(p._grad, group=self._group)
             p._grad._data = p._grad._data / self._nranks
-        self._optimizer.step()
+        self._opt_step_orig()
         self._optimizer.clear_grad()
         self._shard_all()
 
@@ -265,7 +275,10 @@ class GroupShardedStage3:
         was_sharded = self._sharded
         if was_sharded:
             self._unshard_all()
-        sd = self._layer.state_dict(*a, **k)
+        # snapshot values: the layer's state_dict holds live Parameter
+        # references whose payload is about to be re-sharded
+        sd = {key: Tensor(v._data) if isinstance(v, Tensor) else v
+              for key, v in self._layer.state_dict(*a, **k).items()}
         if was_sharded:
             self._shard_all()
         return sd

@@ -68,10 +68,20 @@ class Task:
 class ProcessGroup:
     """Virtual base (reference: process_group.h:48)."""
 
-    def __init__(self, rank: int, world_size: int, gid: int = 0):
+    def __init__(self, rank: int, world_size: int, gid: int = 0,
+                 group_ranks: Optional[List[int]] = None):
         self._rank = rank
         self._world_size = world_size
         self._gid = gid
+        self._group_ranks = group_ranks or list(range(world_size))
+
+    def _g2l(self, r: int) -> int:
+        """Translate a GLOBAL peer rank (the public-API convention,
+        reference process_group.h) to this group's local rank."""
+        try:
+            return self._group_ranks.index(r)
+        except ValueError:
+            return r
 
     def rank(self) -> int:
         return self._rank
@@ -96,6 +106,7 @@ class ProcessGroup:
         return Task()
 
     def broadcast(self, tensor: Tensor, src: int, sync_op=True):
+        src = self._g2l(src)
         with self._watched("broadcast"):
             out = self._broadcast_impl(tensor.numpy(), src)
         tensor._data = _to_jax(out, tensor)
@@ -114,6 +125,7 @@ class ProcessGroup:
         return Task()
 
     def reduce(self, tensor: Tensor, dst: int, op=ReduceOp.SUM, sync_op=True):
+        dst = self._g2l(dst)
         with self._watched("reduce"):
             out = self._reduce_impl(tensor.numpy(), dst, op)
         if self._rank == dst:
@@ -130,6 +142,7 @@ class ProcessGroup:
 
     def scatter(self, tensor: Tensor, tensor_list: List[Tensor], src: int,
                 sync_op=True):
+        src = self._g2l(src)
         ins = [t.numpy() for t in tensor_list] if self._rank == src else None
         with self._watched("scatter"):
             out = self._scatter_impl(ins, src,
@@ -140,6 +153,7 @@ class ProcessGroup:
 
     def gather(self, tensor: Tensor, gather_list: Optional[List[Tensor]],
                dst: int, sync_op=True):
+        dst = self._g2l(dst)
         with self._watched("gather"):
             outs = self._gather_impl(tensor.numpy(), dst)
         if self._rank == dst and gather_list is not None:
@@ -163,11 +177,13 @@ class ProcessGroup:
         return Task()
 
     def send(self, tensor: Tensor, dst: int, sync_op=True):
+        dst = self._g2l(dst)
         with self._watched("send"):
             self._send_impl(tensor.numpy(), dst)
         return Task()
 
     def recv(self, tensor: Tensor, src: int, sync_op=True):
+        src = self._g2l(src)
         with self._watched("recv"):
             out = self._recv_impl(src, tensor.numpy().shape,
                                   tensor.numpy().dtype)
@@ -239,10 +255,10 @@ class ProcessGroupCPU(ProcessGroup):
 
     def __init__(self, store: TCPStore, rank: int, world_size: int,
                  gid: int = 0, group_ranks: Optional[List[int]] = None):
-        super().__init__(rank, world_size, gid)
+        super().__init__(rank, world_size, gid, group_ranks)
         self._store = store
         self._seq = 0
-        self._ranks = group_ranks or list(range(world_size))
+        self._ranks = self._group_ranks
 
     def _key(self, tag, rank=None):
         self._seq += 1
@@ -280,8 +296,7 @@ class ProcessGroupCPU(ProcessGroup):
     def _broadcast_impl(self, arr, src):
         self._seq += 1
         base = f"pg{self._gid}/bc/{self._seq}"
-        src_group_rank = self._ranks.index(src) if src in self._ranks else src
-        if self._rank == src_group_rank:
+        if self._rank == src:
             self._store.set(f"{base}", pickle.dumps(np.asarray(arr),
                                                     protocol=4))
             return arr

@@ -37,9 +37,9 @@ _LAX_REDUCE = {
 class ProcessGroupXLA(ProcessGroup):
     def __init__(self, store, rank: int, world_size: int, gid: int = 0,
                  group_ranks: Optional[List[int]] = None):
-        super().__init__(rank, world_size, gid)
+        super().__init__(rank, world_size, gid, group_ranks)
         self._store = store
-        self._ranks = group_ranks or list(range(world_size))
+        self._ranks = self._group_ranks
         # one process per host: the group's devices = all local devices of
         # the member processes
         self._mesh_cache = {}
@@ -104,7 +104,8 @@ class ProcessGroupXLA(ProcessGroup):
         return self._run_collective("allreduce", a, builder)[0]
 
     def _broadcast_impl(self, arr, src):
-        src_idx = self._ranks.index(src) if src in self._ranks else src
+        # src already translated to group-local by the base class
+        src_idx = src
         a = np.asarray(arr)[None]
         import jax.sharding as shd
         from jax.experimental.shard_map import shard_map

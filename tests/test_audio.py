@@ -1,0 +1,81 @@
+"""Audio features vs scipy reference (reference analog:
+test/legacy_test/test_audio_functions.py)."""
+import numpy as np
+import pytest
+import scipy.signal
+
+import paddle_tpu as pt
+from paddle_tpu.audio import (MFCC, LogMelSpectrogram, MelSpectrogram,
+                              Spectrogram)
+from paddle_tpu.audio.functional import (compute_fbank_matrix, get_window,
+                                         hz_to_mel, mel_to_hz, power_to_db)
+
+
+class TestFunctional:
+    def test_windows_match_scipy(self):
+        for name in ("hann", "hamming", "blackman", "bartlett"):
+            w = get_window(name, 64).numpy()
+            ref = scipy.signal.get_window(name, 64, fftbins=True)
+            np.testing.assert_allclose(w, ref, atol=1e-6)
+
+    def test_mel_roundtrip(self):
+        f = np.array([100.0, 440.0, 4000.0])
+        np.testing.assert_allclose(mel_to_hz(hz_to_mel(f)), f, rtol=1e-6)
+        np.testing.assert_allclose(mel_to_hz(hz_to_mel(f, htk=True),
+                                             htk=True), f, rtol=1e-6)
+
+    def test_fbank_shape_and_partition(self):
+        fb = compute_fbank_matrix(16000, 512, n_mels=40).numpy()
+        assert fb.shape == (40, 257)
+        assert (fb >= 0).all()
+
+    def test_power_to_db(self):
+        s = pt.to_tensor(np.array([1.0, 10.0, 100.0], np.float32))
+        db = power_to_db(s, top_db=None).numpy()
+        np.testing.assert_allclose(db, [0.0, 10.0, 20.0], atol=1e-5)
+
+
+class TestFeatures:
+    def _sig(self, sr=16000, f=440.0, dur=0.5):
+        t = np.arange(int(sr * dur)) / sr
+        return np.sin(2 * np.pi * f * t).astype(np.float32)
+
+    def test_spectrogram_peak_at_tone(self):
+        sr, f = 16000, 1000.0
+        x = pt.to_tensor(self._sig(sr, f)[None])
+        spec = Spectrogram(n_fft=512, hop_length=256)(x).numpy()[0]
+        assert spec.shape[0] == 257
+        peak_bin = spec.mean(axis=1).argmax()
+        expect_bin = round(f * 512 / sr)
+        assert abs(int(peak_bin) - expect_bin) <= 1
+
+    def test_spectrogram_matches_scipy_stft(self):
+        x = np.random.randn(1024).astype(np.float32)
+        spec = Spectrogram(n_fft=256, hop_length=128, power=2.0,
+                           center=True)(pt.to_tensor(x[None])).numpy()[0]
+        freqs, times, Z = scipy.signal.stft(
+            x, nperseg=256, noverlap=128, window="hann", padded=False,
+            boundary="even", return_onesided=True)
+        # scipy scales by window.sum(); undo for comparison
+        wsum = scipy.signal.get_window("hann", 256).sum()
+        ref = np.abs(Z * wsum) ** 2
+        n = min(spec.shape[1], ref.shape[1])
+        np.testing.assert_allclose(spec[:, 1:n-1], ref[:, 1:n-1],
+                                   rtol=2e-3, atol=2e-3)
+
+    def test_mel_and_mfcc_shapes(self):
+        x = pt.to_tensor(self._sig()[None])
+        mel = MelSpectrogram(sr=16000, n_fft=512, n_mels=40)(x)
+        assert mel.shape[1] == 40
+        logmel = LogMelSpectrogram(sr=16000, n_fft=512, n_mels=40)(x)
+        assert logmel.shape == mel.shape
+        mfcc = MFCC(sr=16000, n_mfcc=13, n_fft=512, n_mels=40)(x)
+        assert mfcc.shape[1] == 13
+        assert np.isfinite(mfcc.numpy()).all()
+
+    def test_differentiable(self):
+        x = pt.to_tensor(self._sig(dur=0.1)[None])
+        x.stop_gradient = False
+        out = MelSpectrogram(sr=16000, n_fft=256, n_mels=20)(x)
+        out.sum().backward()
+        assert x.grad is not None and np.isfinite(x.grad.numpy()).all()
