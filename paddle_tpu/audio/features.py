@@ -46,12 +46,12 @@ class Spectrogram(nn.Layer):
         if self.win_length < n_fft:
             lpad = (n_fft - self.win_length) // 2
             w = jnp.pad(w, (lpad, n_fft - self.win_length - lpad))
-        self._window = w
+        self.register_buffer("fft_window", Tensor(w))
 
     def forward(self, x):
         t = as_tensor(x)
         n_fft, hop, win, power, center, pad_mode = (
-            self.n_fft, self.hop_length, self._window, self.power,
+            self.n_fft, self.hop_length, self.fft_window._data, self.power,
             self.center, self.pad_mode)
 
         def fn(a):
@@ -79,12 +79,12 @@ class MelSpectrogram(nn.Layer):
         super().__init__()
         self.spectrogram = Spectrogram(n_fft, hop_length, win_length,
                                        window, power, center)
-        self._fbank = compute_fbank_matrix(
-            sr, n_fft, n_mels, f_min, f_max, htk, norm)._data
+        self.register_buffer("fbank_matrix", compute_fbank_matrix(
+            sr, n_fft, n_mels, f_min, f_max, htk, norm))
 
     def forward(self, x):
         spec = self.spectrogram(x)
-        fb = self._fbank
+        fb = self.fbank_matrix._data
 
         def fn(s):
             return jnp.einsum("mf,...ft->...mt", fb, s)
@@ -129,11 +129,11 @@ class MFCC(nn.Layer):
         self.log_mel = LogMelSpectrogram(
             sr=sr, n_fft=n_fft, hop_length=hop_length, n_mels=n_mels,
             f_min=f_min, f_max=f_max, top_db=top_db, **mel_kwargs)
-        self._dct = create_dct(n_mfcc, n_mels)._data
+        self.register_buffer("dct_matrix", create_dct(n_mfcc, n_mels))
 
     def forward(self, x):
         logmel = self.log_mel(x)
-        dct = self._dct
+        dct = self.dct_matrix._data
 
         def fn(lm):
             return jnp.einsum("mk,...mt->...kt", dct, lm)

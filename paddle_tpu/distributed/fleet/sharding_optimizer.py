@@ -185,6 +185,7 @@ class GroupShardedStage3:
             for p in self._params:
                 dist.broadcast(p, self._group.ranks[0], group=self._group)
         self._full_shapes = {id(p): tuple(p.shape) for p in self._params}
+        self._sharded_ids: set = set()
         self._sharded = False
         if self._nranks > 1:
             self._shard_all()
@@ -196,13 +197,12 @@ class GroupShardedStage3:
         optimizer.step = self.step
 
     # -- param shard/unshard ------------------------------------------------
-    def _is_full(self, p) -> bool:
-        return tuple(p.shape) == self._full_shapes[id(p)]
-
     def _shard_param(self, p):
         import jax.numpy as jnp
 
-        if not self._is_full(p):
+        # explicit shard-state tracking: shape inference misclassifies
+        # 1-element params whose shard shape equals the full shape
+        if id(p) in self._sharded_ids:
             return  # already a shard (layer skipped this forward)
         flat = p._data.reshape(-1)
         n = flat.shape[0]
@@ -211,11 +211,12 @@ class GroupShardedStage3:
         if pad:
             flat = jnp.pad(flat, (0, pad))
         p._data = flat[self._rank * per:(self._rank + 1) * per]
+        self._sharded_ids.add(id(p))
 
     def _unshard_param(self, p):
         import jax.numpy as jnp
 
-        if self._is_full(p):
+        if id(p) not in self._sharded_ids:
             return  # pre-hook already materialized it this step
         outs: List[Tensor] = []
         dist.all_gather(outs, Tensor(p._data), group=self._group)
@@ -223,6 +224,7 @@ class GroupShardedStage3:
         shape = self._full_shapes[id(p)]
         n = int(np.prod(shape))
         p._data = full[:n].reshape(shape)
+        self._sharded_ids.discard(id(p))
 
     def _shard_all(self):
         for p in self._params:
@@ -240,9 +242,7 @@ class GroupShardedStage3:
 
         def pre_hook(layer, inputs):
             for p in layer._parameters.values():
-                if p is not None and id(p) in self._full_shapes and \
-                        p._data.ndim == 1 and tuple(p.shape) != \
-                        self._full_shapes[id(p)]:
+                if p is not None and id(p) in self._sharded_ids:
                     self._unshard_param(p)
             return None
 

@@ -81,7 +81,9 @@ class ProcessGroup:
         try:
             return self._group_ranks.index(r)
         except ValueError:
-            return r
+            raise ValueError(
+                f"rank {r} is not a member of group "
+                f"{self._gid} (ranks={self._group_ranks})") from None
 
     def rank(self) -> int:
         return self._rank

@@ -1,0 +1,212 @@
+"""RPC API (reference: python/paddle/distributed/rpc/rpc.py — init_rpc:85
+with TCPStore barrier, rpc_sync:160, rpc_async:206, WorkerInfo,
+get_worker_info, shutdown; C++ brpc agent fluid/distributed/rpc/).
+
+TPU-native-lite: the transport is the job's TCPStore (the brpc agent's
+role); each worker runs a dispatcher thread polling its mailbox, executing
+pickled (fn, args, kwargs) requests and posting pickled results. Suited to
+control-plane RPC (the reference's primary use); bulk tensors should ride
+the collective path.
+"""
+from __future__ import annotations
+
+import pickle
+import threading
+import time
+import traceback
+from concurrent.futures import Future
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+__all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown",
+           "get_worker_info", "get_all_worker_infos", "WorkerInfo"]
+
+
+@dataclass(frozen=True)
+class WorkerInfo:
+    name: str
+    rank: int
+    ip: str = "127.0.0.1"
+    port: int = 0
+
+
+class _RpcAgent:
+    def __init__(self, name: str, rank: int, world_size: int, store,
+                 generation: int):
+        self.name = name
+        self.rank = rank
+        self.world_size = world_size
+        self.store = store
+        # generation namespace: a fresh init_rpc on the same store must not
+        # replay a previous agent's mailboxes or stale replies
+        self._ns = f"rpc{generation}"
+        self._send_seq: Dict[str, int] = {}
+        self._futures: Dict[str, Future] = {}
+        self._lock = threading.Lock()
+        self._stop = False
+        # registry: name -> rank
+        store.set(f"{self._ns}/worker/{rank}", name.encode())
+        self.workers: Dict[str, WorkerInfo] = {}
+        for r in range(world_size):
+            wname = store.get(f"{self._ns}/worker/{r}").decode()
+            self.workers[wname] = WorkerInfo(wname, r)
+        self._dispatcher = threading.Thread(target=self._serve, daemon=True)
+        self._dispatcher.start()
+        self._replies = threading.Thread(target=self._collect, daemon=True)
+        self._replies.start()
+
+    # ------------------------------------------------------------ transport
+    def _post(self, to_rank: int, payload: dict):
+        key = f"{self._ns}/mbox/{to_rank}"
+        with self._lock:
+            seq = self._send_seq.get(key, 0)
+            self._send_seq[key] = seq + 1
+        self.store.set(f"{key}/{self.rank}/{seq}",
+                       pickle.dumps(payload, protocol=4))
+
+    def _serve(self):
+        """Execute incoming requests."""
+        seqs = {r: 0 for r in range(self.world_size)}
+        while not self._stop:
+            progressed = False
+            for r in range(self.world_size):
+                key = f"{self._ns}/mbox/{self.rank}"
+                try:
+                    if not self.store.check(f"{key}/{r}/{seqs[r]}"):
+                        continue
+                    raw = self.store.get(f"{key}/{r}/{seqs[r]}")
+                except Exception:
+                    if self._stop:
+                        return
+                    continue
+                seqs[r] += 1
+                progressed = True
+                msg = pickle.loads(raw)
+                if msg.get("kind") != "call":
+                    continue
+                try:
+                    fn = pickle.loads(msg["fn"])
+                    result = fn(*msg.get("args", ()),
+                                **msg.get("kwargs", {}))
+                    reply = {"ok": True, "value": result}
+                except Exception as e:  # ship the error back
+                    reply = {"ok": False,
+                             "error": f"{e}\n{traceback.format_exc()}"}
+                self.store.set(
+                    f"{self._ns}/reply/{r}/{msg['call_id']}",
+                    pickle.dumps(reply, protocol=4))
+            if not progressed:
+                time.sleep(0.01)
+
+    def _collect(self):
+        """Resolve futures as replies land."""
+        while not self._stop:
+            done = []
+            with self._lock:
+                items = list(self._futures.items())
+            for call_id, fut in items:
+                try:
+                    if self.store.check(f"{self._ns}/reply/{self.rank}/{call_id}"):
+                        raw = self.store.get(
+                            f"{self._ns}/reply/{self.rank}/{call_id}")
+                        reply = pickle.loads(raw)
+                        if reply["ok"]:
+                            fut.set_result(reply["value"])
+                        else:
+                            fut.set_exception(RuntimeError(reply["error"]))
+                        done.append(call_id)
+                except Exception:
+                    if self._stop:
+                        return
+            with self._lock:
+                for c in done:
+                    self._futures.pop(c, None)
+            if not done:
+                time.sleep(0.01)
+
+    # ------------------------------------------------------------ calls
+    _call_counter = 0
+
+    def call(self, to: str, fn, args, kwargs) -> Future:
+        info = self.workers[to]
+        with self._lock:
+            _RpcAgent._call_counter += 1
+            call_id = f"{self.rank}_{_RpcAgent._call_counter}"
+            fut: Future = Future()
+            self._futures[call_id] = fut
+        self._post(info.rank, {
+            "kind": "call", "call_id": call_id,
+            "fn": pickle.dumps(fn, protocol=4),
+            "args": args, "kwargs": kwargs,
+        })
+        return fut
+
+    def stop(self):
+        self._stop = True
+
+
+_agent: Optional[_RpcAgent] = None
+
+
+def init_rpc(name: str, rank: Optional[int] = None,
+             world_size: Optional[int] = None, master_endpoint=None):
+    """reference: rpc.py:85 — registers this worker and barriers until the
+    full world joined."""
+    global _agent
+    import os
+
+    from .store import create_or_get_global_tcp_store
+
+    rank = rank if rank is not None else int(
+        os.environ.get("PADDLE_TRAINER_ID", "0"))
+    world_size = world_size if world_size is not None else int(
+        os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    store = create_or_get_global_tcp_store()
+    # generation-consistent rendezvous: the n-th init across the job maps
+    # to generation (n-1)//world_size + 1; wait until the whole world has
+    # joined this generation (reference: init_rpc's TCPStore barrier)
+    n = store.add("rpc/init_count", 1)
+    gen = (n - 1) // world_size + 1
+    while store.add("rpc/init_count", 0) < gen * world_size:
+        time.sleep(0.02)
+    _agent = _RpcAgent(name, rank, world_size, store, gen)
+    store.barrier(f"rpc{gen}_ready", world_size, rank)
+    return _agent
+
+
+def _require_agent() -> _RpcAgent:
+    if _agent is None:
+        raise RuntimeError("call paddle.distributed.rpc.init_rpc first")
+    return _agent
+
+
+def rpc_sync(to: str, fn, args=(), kwargs=None, timeout: float = 60.0):
+    """reference: rpc.py:160."""
+    return rpc_async(to, fn, args, kwargs).result(timeout=timeout)
+
+
+def rpc_async(to: str, fn, args=(), kwargs=None) -> Future:
+    """reference: rpc.py:206. Returns a concurrent.futures.Future with
+    .result()/.wait() semantics (the reference FutureWrapper analog)."""
+    return _require_agent().call(to, fn, args, kwargs or {})
+
+
+def get_worker_info(name: Optional[str] = None) -> WorkerInfo:
+    agent = _require_agent()
+    if name is None:
+        return agent.workers[agent.name]
+    return agent.workers[name]
+
+
+def get_all_worker_infos():
+    return list(_require_agent().workers.values())
+
+
+def shutdown(graceful: bool = True):
+    global _agent
+    if _agent is not None:
+        if graceful:
+            _agent.store.barrier(f"{_agent._ns}_shutdown",
+                                 _agent.world_size, _agent.rank)
+        _agent.stop()
+        _agent = None

@@ -1,0 +1,54 @@
+"""RPC over TCPStore (reference analog: test/rpc/test_rpc*.py)."""
+import numpy as np
+
+
+def _sq(x):
+    return x * x
+
+
+def _add(a, b=0):
+    return a + b
+
+
+def _boom():
+    raise ValueError("intentional")
+
+
+def _rpc_worker():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import os
+
+    from paddle_tpu.distributed import rpc
+
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    rpc.init_rpc(f"worker{rank}")
+    infos = rpc.get_all_worker_infos()
+    assert {w.name for w in infos} == {"worker0", "worker1"}
+
+    peer = f"worker{1 - rank}"
+    # sync call
+    assert rpc.rpc_sync(peer, _sq, args=(7,)) == 49
+    # async + kwargs
+    fut = rpc.rpc_async(peer, _add, args=(1,), kwargs={"b": 41})
+    assert fut.result(timeout=30) == 42
+    # numpy payload
+    arr = np.arange(6.0)
+    out = rpc.rpc_sync(peer, _sq, args=(arr,))
+    np.testing.assert_array_equal(out, arr * arr)
+    # remote exception propagates
+    try:
+        rpc.rpc_sync(peer, _boom)
+        raise AssertionError("expected remote error")
+    except RuntimeError as e:
+        assert "intentional" in str(e)
+    # self-call
+    assert rpc.rpc_sync(f"worker{rank}", _sq, args=(3,)) == 9
+    rpc.shutdown()
+
+
+def test_rpc_two_workers():
+    from paddle_tpu.distributed.spawn import spawn
+
+    spawn(_rpc_worker, nprocs=2)
