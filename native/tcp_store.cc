@@ -5,7 +5,7 @@
 // paddle_tpu/distributed/store.py exactly, so C++ daemon <-> Python client
 // (and vice versa) interoperate:
 //   [1B op][4B key_len BE][key][8B value_len BE][value]
-//   ops: SET=0 GET=1 ADD=2 WAIT=3 CHECK=4
+//   ops: SET=0 GET=1 ADD=2 WAIT=3 CHECK=4 DEL=5
 #include <arpa/inet.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
@@ -25,7 +25,7 @@
 
 namespace {
 
-enum Op : uint8_t { kSet = 0, kGet = 1, kAdd = 2, kWait = 3, kCheck = 4 };
+enum Op : uint8_t { kSet = 0, kGet = 1, kAdd = 2, kWait = 3, kCheck = 4, kDel = 5 };
 
 uint64_t ntoh64(uint64_t v) {
   uint32_t hi = ntohl(static_cast<uint32_t>(v & 0xffffffffULL));
@@ -213,6 +213,15 @@ class MasterDaemon {
           SendFrame(fd, op, "", ok ? "1" : "0");
           break;
         }
+        case kDel: {
+          bool existed;
+          {
+            std::lock_guard<std::mutex> lk(mu_);
+            existed = kv_.erase(key) > 0;
+          }
+          SendFrame(fd, op, "", existed ? "1" : "0");
+          break;
+        }
         default:
           ::close(fd);
           return;
@@ -308,6 +317,14 @@ class StoreClient {
     if (!RecvFrame(fd_, &op, &k, &v)) return -1;
     return v == "1" ? 1 : 0;
   }
+  int Del(const std::string& key) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (!SendFrame(fd_, kDel, key, "")) return -1;
+    uint8_t op;
+    std::string k, v;
+    if (!RecvFrame(fd_, &op, &k, &v)) return -1;
+    return v == "1" ? 1 : 0;
+  }
 
  private:
   int fd_ = -1;
@@ -367,6 +384,9 @@ int pt_store_wait(void* h, const char* key, int64_t timeout_ms) {
 }
 int pt_store_check(void* h, const char* key) {
   return static_cast<StoreClient*>(h)->Check(key);
+}
+int pt_store_delete(void* h, const char* key) {
+  return static_cast<StoreClient*>(h)->Del(key);
 }
 void pt_free(void* p) { ::free(p); }
 

@@ -46,6 +46,8 @@ def _declare(lib):
     lib.pt_store_wait.argtypes = [c.c_void_p, c.c_char_p, c.c_int64]
     lib.pt_store_check.restype = c.c_int
     lib.pt_store_check.argtypes = [c.c_void_p, c.c_char_p]
+    lib.pt_store_delete.restype = c.c_int
+    lib.pt_store_delete.argtypes = [c.c_void_p, c.c_char_p]
     lib.pt_free.argtypes = [c.c_void_p]
 
     lib.pt_trace_enable.argtypes = [c.c_int]
@@ -175,6 +177,12 @@ class NativeStoreClient:
         r = self._lib.pt_store_check(self._h, key)
         if r < 0:
             raise ConnectionError("store check failed")
+        return r == 1
+
+    def delete(self, key: bytes) -> bool:
+        r = self._lib.pt_store_delete(self._h, key)
+        if r < 0:
+            raise ConnectionError("store delete failed")
         return r == 1
 
     def close(self):

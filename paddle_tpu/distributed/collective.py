@@ -210,8 +210,11 @@ def broadcast_object_list(object_list, src, group=None):
     import numpy as np
 
     g = group or _get_default_group()
-    me = g.rank if g.ranks else 0
-    src_group_rank = g.get_group_rank(src) if src in g.ranks else src
+    if src not in g.ranks:
+        raise ValueError(
+            f"broadcast_object_list: src={src} (global rank) is not a "
+            f"member of the group (ranks={g.ranks})")
+    src_group_rank = g.get_group_rank(src)
     if g.rank == src_group_rank:
         payload = pickle.dumps(object_list)
         size = Tensor(np.asarray([len(payload)], dtype=np.int64))
@@ -249,8 +252,10 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
 
 def scatter_object_list(out_object_list, in_object_list=None, src=0,
                         group=None):
+    # src is a GLOBAL rank (paddle convention)
+    g0 = group or _get_default_group()
     objs = [None]
-    if get_group_rank_safe(group) == src:
+    if g0.rank == g0.get_group_rank(src):
         objs = list(in_object_list)
     bc = [objs]
     broadcast_object_list(bc, src, group)

@@ -79,22 +79,40 @@ class _RpcAgent:
                     if self._stop:
                         return
                     continue
+                consumed_key = f"{key}/{r}/{seqs[r]}"
                 seqs[r] += 1
                 progressed = True
-                msg = pickle.loads(raw)
-                if msg.get("kind") != "call":
-                    continue
+                # guard the WHOLE message path: a poison message must not
+                # kill the dispatcher thread
                 try:
-                    fn = pickle.loads(msg["fn"])
-                    result = fn(*msg.get("args", ()),
-                                **msg.get("kwargs", {}))
-                    reply = {"ok": True, "value": result}
-                except Exception as e:  # ship the error back
-                    reply = {"ok": False,
-                             "error": f"{e}\n{traceback.format_exc()}"}
-                self.store.set(
-                    f"{self._ns}/reply/{r}/{msg['call_id']}",
-                    pickle.dumps(reply, protocol=4))
+                    msg = pickle.loads(raw)
+                    if msg.get("kind") != "call":
+                        continue
+                    try:
+                        fn = pickle.loads(msg["fn"])
+                        result = fn(*msg.get("args", ()),
+                                    **msg.get("kwargs", {}))
+                        reply = {"ok": True, "value": result}
+                    except Exception as e:  # ship the error back
+                        reply = {"ok": False,
+                                 "error": f"{e}\n{traceback.format_exc()}"}
+                    try:
+                        blob = pickle.dumps(reply, protocol=4)
+                    except Exception as e:  # unpicklable result
+                        blob = pickle.dumps(
+                            {"ok": False,
+                             "error": f"result not picklable: {e}"},
+                            protocol=4)
+                    self.store.set(
+                        f"{self._ns}/reply/{r}/{msg['call_id']}", blob)
+                except Exception:
+                    traceback.print_exc()
+                finally:
+                    # reclaim the consumed mailbox key (store op DEL)
+                    try:
+                        self.store.delete(consumed_key)
+                    except Exception:
+                        pass
             if not progressed:
                 time.sleep(0.01)
 
@@ -115,6 +133,11 @@ class _RpcAgent:
                         else:
                             fut.set_exception(RuntimeError(reply["error"]))
                         done.append(call_id)
+                        try:
+                            self.store.delete(
+                                f"{self._ns}/reply/{self.rank}/{call_id}")
+                        except Exception:
+                            pass
                 except Exception:
                     if self._stop:
                         return
@@ -161,7 +184,15 @@ def init_rpc(name: str, rank: Optional[int] = None,
         os.environ.get("PADDLE_TRAINER_ID", "0"))
     world_size = world_size if world_size is not None else int(
         os.environ.get("PADDLE_TRAINERS_NUM", "1"))
-    store = create_or_get_global_tcp_store()
+    if master_endpoint:
+        # dedicated store on the requested endpoint (rank 0 hosts)
+        from .store import TCPStore
+
+        host, port = master_endpoint.rsplit(":", 1)
+        store = TCPStore(host, int(port), is_master=(rank == 0),
+                         world_size=world_size)
+    else:
+        store = create_or_get_global_tcp_store()
     # generation-consistent rendezvous: the n-th init across the job maps
     # to generation (n-1)//world_size + 1; wait until the whole world has
     # joined this generation (reference: init_rpc's TCPStore barrier)
@@ -182,7 +213,17 @@ def _require_agent() -> _RpcAgent:
 
 def rpc_sync(to: str, fn, args=(), kwargs=None, timeout: float = 60.0):
     """reference: rpc.py:160."""
-    return rpc_async(to, fn, args, kwargs).result(timeout=timeout)
+    fut = rpc_async(to, fn, args, kwargs)
+    try:
+        return fut.result(timeout=timeout)
+    except Exception:
+        # drop the orphaned future so _collect stops polling its call_id
+        agent = _require_agent()
+        with agent._lock:
+            for cid, f in list(agent._futures.items()):
+                if f is fut:
+                    agent._futures.pop(cid, None)
+        raise
 
 
 def rpc_async(to: str, fn, args=(), kwargs=None) -> Future:

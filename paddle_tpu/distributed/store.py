@@ -5,7 +5,7 @@ recommends).
 
 Protocol: length-prefixed msgpack-free binary frames:
   [1B op][4B key_len][key][8B value_len][value]
-ops: SET=0 GET=1 ADD=2 WAIT=3 CHECK=4
+ops: SET=0 GET=1 ADD=2 WAIT=3 CHECK=4 DEL=5
 """
 from __future__ import annotations
 
@@ -18,7 +18,7 @@ from typing import Dict, Optional
 
 __all__ = ["TCPStore", "MasterDaemon", "create_or_get_global_tcp_store"]
 
-_OP_SET, _OP_GET, _OP_ADD, _OP_WAIT, _OP_CHECK = 0, 1, 2, 3, 4
+_OP_SET, _OP_GET, _OP_ADD, _OP_WAIT, _OP_CHECK, _OP_DEL = 0, 1, 2, 3, 4, 5
 
 
 def _recv_exact(sock, n):
@@ -111,6 +111,10 @@ class MasterDaemon(threading.Thread):
                     with self._lock:
                         ok = key in self._kv
                     _send_frame(conn, op, b"", b"1" if ok else b"0")
+                elif op == _OP_DEL:
+                    with self._lock:
+                        existed = self._kv.pop(key, None) is not None
+                    _send_frame(conn, op, b"", b"1" if existed else b"0")
         except (ConnectionError, OSError):
             pass
 
@@ -212,6 +216,16 @@ class TCPStore:
                 _, _, v = _recv_frame(self._sock)
             if v != b"1":
                 raise TimeoutError(f"TCPStore wait timed out on key {key!r}")
+
+    def delete(self, key: str) -> bool:
+        """Remove a key (protocol op 5); True if it existed. Long-lived
+        control planes (rpc) use this to reclaim consumed mailbox keys."""
+        if self._native:
+            return self._client.delete(key.encode())
+        with self._lock:
+            _send_frame(self._sock, _OP_DEL, key.encode(), b"")
+            _, _, v = _recv_frame(self._sock)
+        return v == b"1"
 
     def check(self, key: str) -> bool:
         if self._native:

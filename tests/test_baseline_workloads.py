@@ -1,0 +1,228 @@
+"""Miniature versions of the five BASELINE.json workloads (BASELINE.md):
+ #1 MNIST+LeNet single device, #2 ResNet DP, #3 BERT sharding stage-2,
+ #4 GPT hybrid 1F1B pipeline, #5 Llama semi-auto (dp x mp mesh + recompute).
+Each trains for a few steps and the loss must fall."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+# --------------------------------------------------------------- #1 MNIST
+def test_baseline1_mnist_lenet():
+    os.environ["PADDLE_TPU_SYNTH_SAMPLES"] = "256"
+    try:
+        from paddle_tpu.vision.datasets import MNIST
+        from paddle_tpu.vision.models import LeNet
+
+        ds = MNIST(mode="train", download=False)
+        loader = pt.io.DataLoader(ds, batch_size=64, shuffle=True)
+        model = LeNet()
+        opt = pt.optimizer.Adam(parameters=model.parameters(),
+                                learning_rate=1e-3)
+        loss_fn = pt.nn.CrossEntropyLoss()
+        first = last = None
+        for epoch in range(4):
+            for x, y in loader:
+                logits = model(x)
+                loss = loss_fn(logits, y)
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+                first = first if first is not None else float(loss)
+                last = float(loss)
+        assert last < first, (first, last)
+    finally:
+        del os.environ["PADDLE_TPU_SYNTH_SAMPLES"]
+
+
+# --------------------------------------------------------------- #2 ResNet DP
+def _resnet_dp_worker():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu as pt
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.vision.models import resnet18
+
+    dist.init_parallel_env(backend="cpu")
+    r = dist.get_rank()
+    pt.seed(0)
+    model = pt.DataParallel(resnet18(num_classes=4))
+    opt = pt.optimizer.SGD(parameters=model.parameters(),
+                           learning_rate=0.01)
+    rng = np.random.RandomState(r)
+    loss_fn = pt.nn.CrossEntropyLoss()
+    first = last = None
+    for _ in range(3):
+        x = pt.to_tensor(rng.randn(2, 3, 32, 32).astype(np.float32))
+        y = pt.to_tensor(rng.randint(0, 4, (2,)).astype(np.int32))
+        loss = loss_fn(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        first = first if first is not None else float(loss)
+        last = float(loss)
+    # ranks hold identical params after synced updates
+    import hashlib
+
+    h = hashlib.sha1(b"".join(
+        p.numpy().tobytes() for p in model.parameters())).hexdigest()
+    from paddle_tpu.distributed.store import create_or_get_global_tcp_store
+
+    store = create_or_get_global_tcp_store()
+    store.set(f"resnet_hash_{r}", h)
+    assert store.get("resnet_hash_0").decode() == h
+
+
+def test_baseline2_resnet_dp():
+    from paddle_tpu.distributed.spawn import spawn
+
+    spawn(_resnet_dp_worker, nprocs=2)
+
+
+# --------------------------------------------------------------- #3 BERT s2
+def _bert_sharding_worker():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu as pt
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed.sharding import group_sharded_parallel
+    from paddle_tpu.models import (BertForPreTraining,
+                                   BertPretrainingCriterion, bert_tiny)
+
+    dist.init_parallel_env(backend="cpu")
+    pt.seed(5)
+    cfg = bert_tiny(hidden_dropout=0.0, attention_dropout=0.0)
+    model = BertForPreTraining(cfg)
+    opt = pt.optimizer.AdamW(learning_rate=5e-3,
+                             parameters=model.parameters())
+    model_w, opt, _ = group_sharded_parallel(model, opt, "os_g")
+    crit = BertPretrainingCriterion(cfg.vocab_size)
+    rng = np.random.RandomState(0)  # same data both ranks (sync check)
+    ids = pt.to_tensor(rng.randint(0, cfg.vocab_size, (2, 16))
+                       .astype(np.int32))
+    mlm = np.full((2, 16), -100, np.int64)
+    mlm[:, :4] = rng.randint(0, cfg.vocab_size, (2, 4))
+    nsp = pt.to_tensor(rng.randint(0, 2, (2,)).astype(np.int32))
+    first = last = None
+    for _ in range(4):
+        scores, rel = model_w(ids)
+        loss = crit(scores, rel, pt.to_tensor(mlm), nsp)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        first = first if first is not None else float(loss)
+        last = float(loss)
+    assert last < first, (first, last)
+
+
+def test_baseline3_bert_sharding_stage2():
+    from paddle_tpu.distributed.spawn import spawn
+
+    spawn(_bert_sharding_worker, nprocs=2)
+
+
+# --------------------------------------------------------------- #4 GPT PP
+def _gpt_pp_worker():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu as pt
+    from paddle_tpu import nn
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.fleet.meta_parallel import (PipelineLayer,
+                                                            PipelineParallel)
+    from paddle_tpu.models.gpt import GPTConfig, GPTBlock
+
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1,
+                               "pp_degree": 2}
+    strategy.pipeline_configs = {"accumulate_steps": 2}
+    fleet.init(is_collective=True, strategy=strategy)
+    hcg = fleet.get_hybrid_communicate_group()
+
+    pt.seed(3)
+    cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=4,
+                    num_heads=4, max_position_embeddings=32, dropout=0.0,
+                    attention_dropout=0.0)
+
+    class EmbedIn(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.emb = nn.Embedding(cfg.vocab_size, cfg.hidden_size)
+
+        def forward(self, ids):
+            return self.emb(ids)
+
+    class Head(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.proj = nn.Linear(cfg.hidden_size, cfg.vocab_size)
+
+        def forward(self, h):
+            return self.proj(h)
+
+    layers = ([EmbedIn()] + [GPTBlock(cfg) for _ in range(4)] + [Head()])
+
+    def loss_fn(logits, labels):
+        return pt.nn.functional.cross_entropy(
+            logits.reshape([-1, cfg.vocab_size]),
+            labels.reshape([-1])).mean()
+
+    pipe = PipelineLayer(layers, loss_fn=loss_fn)
+    model = PipelineParallel(pipe, hcg, strategy)
+    opt = pt.optimizer.AdamW(learning_rate=3e-3,
+                             parameters=pipe.parameters())
+    rng = np.random.RandomState(0)
+    ids = pt.to_tensor(rng.randint(0, cfg.vocab_size, (4, 16))
+                       .astype(np.int32))
+    labels = pt.to_tensor(rng.randint(0, cfg.vocab_size, (4, 16))
+                          .astype(np.int32))
+    losses = []
+    for _ in range(6):
+        l = model.train_batch((ids, labels), opt)
+        if l is not None:
+            losses.append(float(l))
+    if hcg.is_last_stage():
+        assert losses[-1] < losses[0], losses
+
+
+def test_baseline4_gpt_pipeline_1f1b():
+    os.environ.setdefault("XLA_FLAGS",
+                          "--xla_force_host_platform_device_count=8")
+    from paddle_tpu.distributed.spawn import spawn
+
+    spawn(_gpt_pp_worker, nprocs=2)
+
+
+# --------------------------------------------------------------- #5 Llama
+def test_baseline5_llama_semi_auto_recompute():
+    import jax
+
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    from paddle_tpu.distributed import ProcessMesh
+
+    mesh = ProcessMesh(np.arange(8).reshape(2, 2, 2),
+                       dim_names=["dp", "sp", "mp"])
+    pt.seed(9)
+    cfg = llama_tiny(recompute=True)
+    model = LlamaForCausalLM(cfg)
+    opt = pt.optimizer.AdamW(learning_rate=3e-3,
+                             parameters=model.parameters())
+    step = TrainStep(model, opt, mesh=mesh, grad_clip_norm=1.0,
+                     batch_specs=[("dp", "sp"), ("dp", "sp")])
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (4, 32)).astype(np.int32)
+    labels = rng.randint(0, cfg.vocab_size, (4, 32)).astype(np.int32)
+    first = float(step(ids, labels))
+    for _ in range(5):
+        last = float(step(ids, labels))
+    assert last < first, (first, last)
