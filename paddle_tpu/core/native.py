@@ -109,6 +109,21 @@ def get_lib(allow_build: bool = True):
                 return None
         try:
             _LIB = _declare(ctypes.CDLL(_SO_PATH))
+        except AttributeError:
+            # stale prebuilt .so missing a newer symbol: rebuild once
+            # (unlink first so make relinks and dlopen loads fresh)
+            try:
+                os.unlink(_SO_PATH)
+            except OSError:
+                pass
+            if allow_build and _build():
+                try:
+                    _LIB = _declare(ctypes.CDLL(_SO_PATH))
+                    return _LIB
+                except (OSError, AttributeError):
+                    pass
+            _LIB = False
+            return None
         except OSError:
             _LIB = False
             return None

@@ -42,6 +42,7 @@ class _RpcAgent:
         self._ns = f"rpc{generation}"
         self._send_seq: Dict[str, int] = {}
         self._futures: Dict[str, Future] = {}
+        self._orphans: set = set()  # timed-out call_ids: reply -> delete
         self._lock = threading.Lock()
         self._stop = False
         # registry: name -> rank
@@ -122,6 +123,17 @@ class _RpcAgent:
             done = []
             with self._lock:
                 items = list(self._futures.items())
+                orphans = list(self._orphans)
+            # late replies for timed-out calls: delete, don't resolve
+            for cid in orphans:
+                try:
+                    k = f"{self._ns}/reply/{self.rank}/{cid}"
+                    if self.store.check(k):
+                        self.store.delete(k)
+                        with self._lock:
+                            self._orphans.discard(cid)
+                except Exception:
+                    pass
             for call_id, fut in items:
                 try:
                     if self.store.check(f"{self._ns}/reply/{self.rank}/{call_id}"):
@@ -169,6 +181,7 @@ class _RpcAgent:
 
 
 _agent: Optional[_RpcAgent] = None
+_endpoint_stores: Dict[str, object] = {}
 
 
 def init_rpc(name: str, rank: Optional[int] = None,
@@ -185,12 +198,17 @@ def init_rpc(name: str, rank: Optional[int] = None,
     world_size = world_size if world_size is not None else int(
         os.environ.get("PADDLE_TRAINERS_NUM", "1"))
     if master_endpoint:
-        # dedicated store on the requested endpoint (rank 0 hosts)
+        # dedicated store on the requested endpoint (rank 0 hosts);
+        # cached so re-init after shutdown reuses the live daemon instead
+        # of re-binding the port
         from .store import TCPStore
 
-        host, port = master_endpoint.rsplit(":", 1)
-        store = TCPStore(host, int(port), is_master=(rank == 0),
-                         world_size=world_size)
+        store = _endpoint_stores.get(master_endpoint)
+        if store is None:
+            host, port = master_endpoint.rsplit(":", 1)
+            store = TCPStore(host, int(port), is_master=(rank == 0),
+                             world_size=world_size)
+            _endpoint_stores[master_endpoint] = store
     else:
         store = create_or_get_global_tcp_store()
     # generation-consistent rendezvous: the n-th init across the job maps
@@ -217,12 +235,14 @@ def rpc_sync(to: str, fn, args=(), kwargs=None, timeout: float = 60.0):
     try:
         return fut.result(timeout=timeout)
     except Exception:
-        # drop the orphaned future so _collect stops polling its call_id
+        # drop the orphaned future; remember the call_id so _collect
+        # deletes the late reply instead of leaking it in the store
         agent = _require_agent()
         with agent._lock:
             for cid, f in list(agent._futures.items()):
                 if f is fut:
                     agent._futures.pop(cid, None)
+                    agent._orphans.add(cid)
         raise
 
 

@@ -271,7 +271,9 @@ def default_collate_fn(batch):
         return Tensor(np.stack([np.asarray(s._data) for s in batch]))
     if isinstance(sample, np.ndarray):
         return Tensor(np.stack(batch))
-    if isinstance(sample, (int, float)):
+    if isinstance(sample, (int, float, np.generic)):
+        # np.generic: numpy scalars (np.int64 etc.) — not python-int
+        # subclasses under numpy>=2
         return Tensor(np.asarray(batch))
     if isinstance(sample, (list, tuple)):
         transposed = list(zip(*batch))
@@ -297,6 +299,10 @@ class DataLoader:
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
         self.prefetch_factor = prefetch_factor
+        self.use_shared_memory = use_shared_memory
+        self.persistent_workers = persistent_workers
+        self._pool = None
+        self._procs_ok = None  # cached picklability probe
         self._iterable_ds = isinstance(dataset, IterableDataset)
         if batch_sampler is not None:
             self.batch_sampler = batch_sampler
@@ -313,6 +319,14 @@ class DataLoader:
             self.batch_size = batch_size
             self.batch_sampler = None
         self.drop_last = drop_last
+
+    def __del__(self):
+        pool, self._pool = self._pool, None
+        if pool is not None:
+            try:
+                pool.shutdown()
+            except Exception:
+                pass
 
     def __len__(self):
         if self._iterable_ds:
@@ -387,7 +401,67 @@ class DataLoader:
                 yield results.pop(next_put)
                 next_put += 1
 
+    def _iter_processes(self):
+        """True multi-process workers over the native shm ring (reference:
+        dataloader_iter.py:368 multi-process path). Requires the native
+        library and a picklable dataset/collate_fn; falls back to the
+        thread pool otherwise."""
+        from .worker import ShmWorkerPool
+
+        batches = list(self.batch_sampler) if self.batch_sampler else None
+        if batches is None:
+            yield from self._iter_single()
+            return
+        import random as _pyrandom
+
+        pool = self._pool
+        if pool is None:
+            # fresh seed per pool so dataset-side augmentation differs
+            # across epochs (workers reseed np.random from it)
+            pool = ShmWorkerPool(self.dataset, self.collate_fn,
+                                 self.num_workers,
+                                 seed=_pyrandom.randrange(2 ** 31))
+            if self.persistent_workers:
+                self._pool = pool
+        try:
+            n = len(batches)
+            inflight = self.num_workers * self.prefetch_factor
+            sent = 0
+            for sent in range(min(inflight, n)):
+                pool.dispatch(sent, batches[sent])
+            sent = min(inflight, n)
+            for i in range(n):
+                data = pool.collect(i)
+                if sent < n:
+                    pool.dispatch(sent, batches[sent])
+                    sent += 1
+                yield data
+        finally:
+            if not self.persistent_workers:
+                pool.shutdown()
+
+    def _use_processes(self) -> bool:
+        if self._procs_ok is not None:
+            return self._procs_ok
+        ok = bool(self.num_workers and self.use_shared_memory)
+        if ok:
+            from ..core import native
+
+            ok = native.available()
+        if ok:
+            try:
+                import pickle
+
+                pickle.dumps(self.dataset)
+                pickle.dumps(self.collate_fn)
+            except Exception:
+                ok = False
+        self._procs_ok = ok
+        return ok
+
     def __iter__(self):
         if self.num_workers and self.num_workers > 0:
+            if self._use_processes():
+                return self._iter_processes()
             return self._iter_workers()
         return self._iter_single()

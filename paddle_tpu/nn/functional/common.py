@@ -58,7 +58,10 @@ def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
     return dropout(x, p=p, axis=list(ax), training=training)
 
 
-def alpha_dropout(x, p=0.5, training=True, name=None):
+def alpha_dropout(x, p=0.5, training=True, name=None, mask_ndim=None):
+    """``mask_ndim``: if set, the drop mask covers only the leading
+    mask_ndim dims and broadcasts over the rest (whole-feature alpha
+    dropout, used by nn.FeatureAlphaDropout)."""
     if not training or p == 0.0:
         return as_tensor(x).clone()
     key = _rng.next_key()
@@ -67,7 +70,9 @@ def alpha_dropout(x, p=0.5, training=True, name=None):
     alpha_p = -alpha * scale
 
     def fn(a):
-        keep = jax.random.bernoulli(key, 1.0 - p, a.shape)
+        mshape = a.shape if mask_ndim is None else \
+            a.shape[:mask_ndim] + (1,) * (a.ndim - mask_ndim)
+        keep = jax.random.bernoulli(key, 1.0 - p, mshape)
         aa = 1.0 / jnp.sqrt((1.0 - p) * (1.0 + p * alpha_p ** 2))
         bb = -aa * alpha_p * p
         return (aa * jnp.where(keep, a, alpha_p) + bb).astype(a.dtype)

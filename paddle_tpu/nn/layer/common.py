@@ -9,7 +9,8 @@ __all__ = ["Linear", "Embedding", "Dropout", "Dropout2D", "Dropout3D",
            "AlphaDropout", "Flatten", "Upsample", "UpsamplingBilinear2D",
            "UpsamplingNearest2D", "Pad1D", "Pad2D", "Pad3D", "ZeroPad2D",
            "CosineSimilarity", "Bilinear", "Identity", "Unfold", "Fold",
-           "PixelShuffle", "PixelUnshuffle", "ChannelShuffle"]
+           "PixelShuffle", "PixelUnshuffle", "ChannelShuffle", "Unflatten",
+           "PairwiseDistance", "FeatureAlphaDropout"]
 
 
 class Identity(Layer):
@@ -272,3 +273,56 @@ class ChannelShuffle(Layer):
 
     def forward(self, x):
         return F.channel_shuffle(x, self.groups, self.data_format)
+
+
+class Unflatten(Layer):
+    """reference: nn/layer/common.py Unflatten."""
+
+    def __init__(self, axis, shape, name=None):
+        super().__init__()
+        self.axis = axis
+        self.shape = list(shape)
+
+    def forward(self, x):
+        from ...ops._helpers import run_op
+
+        import jax.numpy as jnp
+
+        ax = self.axis if self.axis >= 0 else x.ndim + self.axis
+        tgt = list(x.shape[:ax]) + self.shape + list(x.shape[ax + 1:])
+        return run_op(lambda a: jnp.reshape(a, tgt), [x], name="unflatten")
+
+
+class PairwiseDistance(Layer):
+    """reference: nn/layer/distance.py PairwiseDistance."""
+
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p = p
+        self.epsilon = epsilon
+        self.keepdim = keepdim
+
+    def forward(self, x, y):
+        from ...ops._helpers import run_op
+
+        import jax.numpy as jnp
+
+        p, eps, keep = self.p, self.epsilon, self.keepdim
+        return run_op(
+            lambda a, b: jnp.linalg.norm(a - b + eps, ord=p, axis=-1,
+                                         keepdims=keep),
+            [x, y], name="pairwise_distance")
+
+
+class FeatureAlphaDropout(Layer):
+    """Whole-channel alpha dropout (reference: nn FeatureAlphaDropout):
+    one keep/drop decision per (sample, channel), broadcast over the
+    spatial dims; same math as F.alpha_dropout."""
+
+    def __init__(self, p=0.5, name=None):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        return F.alpha_dropout(x, p=self.p, training=self.training,
+                               mask_ndim=2)

@@ -260,3 +260,95 @@ class BrightnessTransform:
         factor = 1 + pyrandom.uniform(-self.value, self.value)
         out = np.clip(arr * factor, 0, 255)
         return out.astype(np.uint8) if _to_numpy(img).dtype == np.uint8 else out
+
+
+class Grayscale:
+    """reference: transforms.Grayscale (ITU-R 601-2 luma)."""
+
+    def __init__(self, num_output_channels=1, keys=None):
+        self.num_output_channels = num_output_channels
+
+    def __call__(self, img):
+        arr = np.asarray(img, dtype=np.float32)
+        if arr.ndim == 3 and arr.shape[-1] == 3:      # HWC
+            g = (0.299 * arr[..., 0] + 0.587 * arr[..., 1]
+                 + 0.114 * arr[..., 2])
+            g = g[..., None]
+            if self.num_output_channels == 3:
+                g = np.repeat(g, 3, axis=-1)
+            return g.astype(np.asarray(img).dtype)
+        if arr.ndim == 3 and arr.shape[0] == 3:        # CHW
+            g = (0.299 * arr[0] + 0.587 * arr[1] + 0.114 * arr[2])[None]
+            if self.num_output_channels == 3:
+                g = np.repeat(g, 3, axis=0)
+            return g.astype(np.asarray(img).dtype)
+        return img
+
+
+class RandomRotation:
+    """reference: transforms.RandomRotation (nearest-neighbor resample)."""
+
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0, keys=None):
+        if isinstance(degrees, numbers.Number):
+            degrees = (-abs(degrees), abs(degrees))
+        self.degrees = degrees
+        self.fill = fill
+
+    def __call__(self, img):
+        import math
+
+        arr = np.asarray(img)
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3)
+        a = arr if not chw else np.moveaxis(arr, 0, -1)
+        angle = np.random.uniform(*self.degrees) * math.pi / 180.0
+        h, w = a.shape[:2]
+        cy, cx = (h - 1) / 2.0, (w - 1) / 2.0
+        yy, xx = np.mgrid[0:h, 0:w]
+        ys = (yy - cy) * math.cos(angle) - (xx - cx) * math.sin(angle) + cy
+        xs = (yy - cy) * math.sin(angle) + (xx - cx) * math.cos(angle) + cx
+        yi = np.clip(np.round(ys).astype(int), 0, h - 1)
+        xi = np.clip(np.round(xs).astype(int), 0, w - 1)
+        valid = (ys >= 0) & (ys <= h - 1) & (xs >= 0) & (xs <= w - 1)
+        out = a[yi, xi]
+        out = np.where(valid[..., None] if out.ndim == 3 else valid,
+                       out, self.fill).astype(arr.dtype)
+        return np.moveaxis(out, -1, 0) if chw else out
+
+
+class ColorJitter:
+    """reference: transforms.ColorJitter (brightness/contrast/saturation)."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0,
+                 keys=None):
+        self.brightness = brightness
+        self.contrast = contrast
+        self.saturation = saturation
+        if hue:
+            raise NotImplementedError(
+                "ColorJitter hue shifts are not implemented; pass hue=0")
+        self.hue = hue
+
+    def _factor(self, amount):
+        return np.random.uniform(max(0, 1 - amount), 1 + amount)
+
+    def __call__(self, img):
+        arr = np.asarray(img).astype(np.float32)
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3)
+        a = arr if not chw else np.moveaxis(arr, 0, -1)
+        hi = 255.0 if a.max() > 1.5 else 1.0
+        if self.brightness:
+            a = a * self._factor(self.brightness)
+        if self.contrast:
+            mean = a.mean()
+            a = (a - mean) * self._factor(self.contrast) + mean
+        if self.saturation and a.ndim == 3 and a.shape[-1] == 3:
+            gray = (0.299 * a[..., 0] + 0.587 * a[..., 1]
+                    + 0.114 * a[..., 2])[..., None]
+            a = (a - gray) * self._factor(self.saturation) + gray
+        a = np.clip(a, 0, hi)
+        out = np.moveaxis(a, -1, 0) if chw else a
+        in_dtype = np.asarray(img).dtype
+        if np.issubdtype(in_dtype, np.integer):
+            out = np.round(out)
+        return out.astype(in_dtype)

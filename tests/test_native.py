@@ -141,3 +141,55 @@ class TestShmRing:
         with pytest.raises(ValueError):
             ring.push(b"x" * 1024)
         ring.free()
+
+
+class _PickleDataset:
+    def __init__(self, n=64):
+        self.n = n
+
+    def __getitem__(self, i):
+        import numpy as np
+
+        return (np.full((4, 4), i, np.float32), np.int64(i % 10))
+
+    def __len__(self):
+        return self.n
+
+
+class _BadDataset(_PickleDataset):
+    def __getitem__(self, i):
+        if i == 5:
+            raise ValueError("bad sample")
+        return super().__getitem__(i)
+
+
+class TestShmDataLoader:
+    def test_multiprocess_loader_matches_single(self):
+        from paddle_tpu.io import DataLoader
+
+        ds = _PickleDataset(48)
+        single = list(DataLoader(ds, batch_size=8, shuffle=False,
+                                 num_workers=0))
+        multi_loader = DataLoader(ds, batch_size=8, shuffle=False,
+                                  num_workers=2, use_shared_memory=True)
+        assert multi_loader._use_processes()
+        multi = list(multi_loader)
+        assert len(multi) == len(single) == 6
+        import numpy as np
+
+        for (xs, ys), (xm, ym) in zip(single, multi):
+            np.testing.assert_array_equal(np.asarray(xs._data),
+                                          np.asarray(xm._data))
+            np.testing.assert_array_equal(np.asarray(ys._data),
+                                          np.asarray(ym._data))
+
+    def test_worker_error_propagates(self):
+        from paddle_tpu.io import DataLoader
+
+        loader = DataLoader(_BadDataset(16), batch_size=4, num_workers=2,
+                            use_shared_memory=True)
+        assert loader._use_processes()
+        import pytest as _pytest
+
+        with _pytest.raises(RuntimeError, match="bad sample"):
+            list(loader)
