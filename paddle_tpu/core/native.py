@@ -112,10 +112,11 @@ def get_lib(allow_build: bool = True):
         except AttributeError:
             # stale prebuilt .so missing a newer symbol: rebuild once
             # (unlink first so make relinks and dlopen loads fresh)
-            try:
-                os.unlink(_SO_PATH)
-            except OSError:
-                pass
+            if allow_build:
+                try:
+                    os.unlink(_SO_PATH)
+                except OSError:
+                    pass
             if allow_build and _build():
                 try:
                     _LIB = _declare(ctypes.CDLL(_SO_PATH))

@@ -42,7 +42,7 @@ class _RpcAgent:
         self._ns = f"rpc{generation}"
         self._send_seq: Dict[str, int] = {}
         self._futures: Dict[str, Future] = {}
-        self._orphans: set = set()  # timed-out call_ids: reply -> delete
+        self._orphans: Dict[str, float] = {}  # call_id -> give-up deadline
         self._lock = threading.Lock()
         self._stop = False
         # registry: name -> rank
@@ -123,6 +123,12 @@ class _RpcAgent:
             done = []
             with self._lock:
                 items = list(self._futures.items())
+                now = time.monotonic()
+                # bounded: give up deleting a late reply after its TTL
+                # (dead peer will never write it)
+                for cid, dl in list(self._orphans.items()):
+                    if now > dl:
+                        self._orphans.pop(cid, None)
                 orphans = list(self._orphans)
             # late replies for timed-out calls: delete, don't resolve
             for cid in orphans:
@@ -131,7 +137,7 @@ class _RpcAgent:
                     if self.store.check(k):
                         self.store.delete(k)
                         with self._lock:
-                            self._orphans.discard(cid)
+                            self._orphans.pop(cid, None)
                 except Exception:
                     pass
             for call_id, fut in items:
@@ -242,7 +248,8 @@ def rpc_sync(to: str, fn, args=(), kwargs=None, timeout: float = 60.0):
             for cid, f in list(agent._futures.items()):
                 if f is fut:
                     agent._futures.pop(cid, None)
-                    agent._orphans.add(cid)
+                    # watch for the late reply for 10 min, then give up
+                    agent._orphans[cid] = time.monotonic() + 600.0
         raise
 
 

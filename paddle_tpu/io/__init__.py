@@ -303,6 +303,8 @@ class DataLoader:
         self.persistent_workers = persistent_workers
         self._pool = None
         self._procs_ok = None  # cached picklability probe
+        self._ds_blob = None
+        self._co_blob = None
         self._iterable_ds = isinstance(dataset, IterableDataset)
         if batch_sampler is not None:
             self.batch_sampler = batch_sampler
@@ -418,7 +420,7 @@ class DataLoader:
         if pool is None:
             # fresh seed per pool so dataset-side augmentation differs
             # across epochs (workers reseed np.random from it)
-            pool = ShmWorkerPool(self.dataset, self.collate_fn,
+            pool = ShmWorkerPool(self._ds_blob, self._co_blob,
                                  self.num_workers,
                                  seed=_pyrandom.randrange(2 ** 31))
             if self.persistent_workers:
@@ -452,8 +454,8 @@ class DataLoader:
             try:
                 import pickle
 
-                pickle.dumps(self.dataset)
-                pickle.dumps(self.collate_fn)
+                self._ds_blob = pickle.dumps(self.dataset, protocol=4)
+                self._co_blob = pickle.dumps(self.collate_fn, protocol=4)
             except Exception:
                 ok = False
         self._procs_ok = ok

@@ -22,9 +22,15 @@ _IDX_CAP = 1 << 20
 
 def worker_entry(dataset_blob: bytes, collate_blob: bytes, idx_ring_name: str,
                  out_ring_name: str, worker_id: int, seed: int):
-    """Runs in the worker process."""
-    # workers never touch the accelerator
-    os.environ["JAX_PLATFORMS"] = "cpu"
+    """Runs in the worker process. The parent sets JAX_PLATFORMS=cpu in
+    the environment BEFORE spawning (env is read when the child imports
+    jax during unpickling); the config update here is belt-and-braces."""
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
     import numpy as np
 
     from ..core import native
@@ -45,7 +51,16 @@ def worker_entry(dataset_blob: bytes, collate_blob: bytes, idx_ring_name: str,
                 payload = (batch_id, collate(samples), None)
             except Exception as e:  # ship the error to the parent
                 payload = (batch_id, None, repr(e))
-            out_ring.push(pickle.dumps(payload, protocol=4), timeout=3600)
+            try:
+                out_ring.push(pickle.dumps(payload, protocol=4),
+                              timeout=3600)
+            except ValueError:
+                # batch larger than the ring: ship a small error instead
+                out_ring.push(pickle.dumps(
+                    (batch_id, None,
+                     f"collated batch exceeds the {_RING_CAP >> 20} MB "
+                     "shm ring; lower batch_size or use num_workers=0"),
+                    protocol=4), timeout=3600)
     except BrokenPipeError:
         pass
 
@@ -64,8 +79,14 @@ class ShmWorkerPool:
         self._out_rings = []
         self._procs = []
         ctx = mp.get_context("spawn")
-        ds_blob = pickle.dumps(dataset, protocol=4)
-        co_blob = pickle.dumps(collate_fn, protocol=4)
+        ds_blob = dataset if isinstance(dataset, bytes) \
+            else pickle.dumps(dataset, protocol=4)
+        co_blob = collate_fn if isinstance(collate_fn, bytes) \
+            else pickle.dumps(collate_fn, protocol=4)
+        # children read JAX_PLATFORMS when they import jax during spawn
+        # bootstrap — set it in the inherited env, restore after start
+        prev_plat = os.environ.get("JAX_PLATFORMS")
+        os.environ["JAX_PLATFORMS"] = "cpu" 
         for w in range(num_workers):
             iname = f"/pt_dl_{uid}_i{w}"
             oname = f"/pt_dl_{uid}_o{w}"
@@ -78,6 +99,10 @@ class ShmWorkerPool:
                             daemon=True)
             p.start()
             self._procs.append(p)
+        if prev_plat is None:
+            os.environ.pop("JAX_PLATFORMS", None)
+        else:
+            os.environ["JAX_PLATFORMS"] = prev_plat
         self.num_workers = num_workers
 
     def dispatch(self, batch_id: int, indices: List[int]):
@@ -88,8 +113,24 @@ class ShmWorkerPool:
     def collect(self, batch_id: int, timeout: float = 300.0):
         """Pop the next result from the worker that owns batch_id (SPSC +
         in-order dispatch per worker means results arrive in order)."""
+        import time as _time
+
         w = batch_id % self.num_workers
-        bid, data, err = pickle.loads(self._out_rings[w].pop(timeout=timeout))
+        deadline = _time.monotonic() + timeout
+        while True:
+            # short poll so a dead worker surfaces as a clear error
+            # instead of a silent multi-minute hang
+            try:
+                raw = self._out_rings[w].pop(timeout=2.0)
+                break
+            except TimeoutError:
+                if not self._procs[w].is_alive():
+                    raise RuntimeError(
+                        f"DataLoader worker {w} died (exitcode "
+                        f"{self._procs[w].exitcode})") from None
+                if _time.monotonic() > deadline:
+                    raise
+        bid, data, err = pickle.loads(raw)
         if err is not None:
             raise RuntimeError(f"DataLoader worker error: {err}")
         assert bid == batch_id, (bid, batch_id)

@@ -22,6 +22,7 @@ import math
 from dataclasses import dataclass, field
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
 
 from .. import nn
@@ -54,6 +55,13 @@ class GPTConfig:
     # "gspmd" | "ring" | "ulysses" — how attention handles a seq-sharded
     # layout over the "sp" mesh axis (see models/_sp_attention.py)
     sequence_parallel_mode: str = "gspmd"
+    # MoE: >0 replaces every block's MLP with a top-2 GShard mixture of
+    # this many experts (expert weights sharded over the "ep" mesh axis;
+    # GSPMD places the dispatch/combine all-to-alls — the jit analog of
+    # incubate/distributed/models/moe, reference moe_layer.py:263)
+    moe_num_experts: int = 0
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
 
     def __post_init__(self):
         if self.intermediate_size is None:
@@ -179,13 +187,97 @@ class GPTMLP(nn.Layer):
         return self.fc2(x)
 
 
+class GPTMoEMLP(nn.Layer):
+    """jit/SPMD mixture-of-experts FFN: stacked expert weights [E, ...]
+    sharded over the "ep" mesh axis; top-2 GShard capacity routing with
+    one-hot einsum dispatch/combine (static shapes — GSPMD emits the
+    expert all-to-alls on the mesh). Aux load-balance loss is exposed via
+    ``last_aux_loss`` and summed into the LM loss by GPTForCausalLM.
+    Reference analog: incubate/distributed/models/moe/moe_layer.py:263 +
+    phi spmd rules moe_gate_dispatch.cc (here: GSPMD)."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        h, ffn = config.hidden_size, config.intermediate_size
+        E = config.moe_num_experts
+        self.config = config
+        self.num_experts = E
+        init = nn.initializer.Normal(0.0, config.initializer_range)
+        self.gate_weight = self.create_parameter(
+            [h, E], default_initializer=init)
+        self.w1 = self.create_parameter([E, h, ffn],
+                                        default_initializer=init)
+        self.b1 = self.create_parameter([E, ffn], is_bias=True)
+        self.w2 = self.create_parameter(
+            [E, ffn, h], default_initializer=nn.initializer.Normal(
+                0.0, config.initializer_range
+                / math.sqrt(2 * config.num_layers)))
+        self.b2 = self.create_parameter([E, h], is_bias=True)
+        annotate_param(self.w1, ("ep", None, "mp"))
+        annotate_param(self.b1, ("ep", "mp"))
+        annotate_param(self.w2, ("ep", "mp", None))
+        annotate_param(self.b2, ("ep", None))
+        self.last_aux_loss = None
+
+    def forward(self, x):
+        cfg = self.config
+        b, s, d = x.shape[0], x.shape[1], x.shape[2]
+        E = self.num_experts
+        cap = max(4, int(cfg.moe_capacity_factor * b * s * 2 / E))
+
+        def fn(xa, gw, w1, b1, w2, b2):
+            S = b * s
+            xf = xa.reshape(S, d)
+            gates = jax.nn.softmax(
+                (xf @ gw).astype(jnp.float32), axis=-1)
+            idx1 = jnp.argmax(gates, -1)
+            m1 = jax.nn.one_hot(idx1, E, dtype=jnp.float32)
+            g1 = jnp.sum(gates * m1, -1)
+            gates2 = gates * (1.0 - m1)
+            idx2 = jnp.argmax(gates2, -1)
+            m2 = jax.nn.one_hot(idx2, E, dtype=jnp.float32)
+            g2 = jnp.sum(gates2 * m2, -1)
+            aux = jnp.sum(jnp.mean(m1, 0) * jnp.mean(gates, 0)) * E
+
+            pos1 = jnp.cumsum(m1, 0) * m1 - m1
+            pos2 = (jnp.cumsum(m2, 0) - 1.0 + jnp.sum(m1, 0)[None]) * m2
+            m1 = m1 * (pos1 < cap)
+            m2 = m2 * (pos2 < cap)
+            p1 = jnp.sum(pos1, -1).astype(jnp.int32)
+            p2 = jnp.sum(pos2, -1).astype(jnp.int32)
+            g1 = g1 * jnp.sum(m1, -1)
+            g2 = g2 * jnp.sum(m2, -1)
+            denom = jnp.where(g1 + g2 > 0, g1 + g2, 1.0)
+            g1, g2 = g1 / denom, g2 / denom
+            oh1 = jax.nn.one_hot(p1, cap, dtype=jnp.float32)
+            oh2 = jax.nn.one_hot(p2, cap, dtype=jnp.float32)
+            cw = (g1[:, None, None] * m1[:, :, None] * oh1[:, None, :]
+                  + g2[:, None, None] * m2[:, :, None] * oh2[:, None, :])
+            dm = (cw > 0).astype(xf.dtype)
+            cw = cw.astype(xf.dtype)
+
+            xe = jnp.einsum("sec,sm->ecm", dm, xf)
+            h1 = jax.nn.gelu(
+                jnp.einsum("ecm,emh->ech", xe, w1) + b1[:, None, :],
+                approximate=True)
+            ye = jnp.einsum("ech,ehm->ecm", h1, w2) + b2[:, None, :]
+            y = jnp.einsum("sec,ecm->sm", cw, ye)
+            return y.reshape(b, s, d), aux.astype(jnp.float32)
+
+        y, aux = run_op(fn, [x, self.gate_weight, self.w1, self.b1,
+                             self.w2, self.b2], name="moe_mlp")
+        self.last_aux_loss = aux
+        return y
+
+
 class GPTBlock(nn.Layer):
     def __init__(self, config: GPTConfig):
         super().__init__()
         self.ln_1 = nn.LayerNorm(config.hidden_size, config.layer_norm_eps)
         self.attn = GPTAttention(config)
         self.ln_2 = nn.LayerNorm(config.hidden_size, config.layer_norm_eps)
-        self.mlp = GPTMLP(config)
+        self.mlp = (GPTMoEMLP(config) if config.moe_num_experts
+                    else GPTMLP(config))
         self.dropout = nn.Dropout(config.dropout)
         self._recompute = config.recompute
 
@@ -206,6 +298,8 @@ class GPTBlock(nn.Layer):
 
             params = [p for _, p in self.named_parameters()]
 
+            is_moe = isinstance(self.mlp, GPTMoEMLP)
+
             def fn(xa, *pa):
                 saved = [p._data for p in params]
                 for p, a in zip(params, pa):
@@ -215,9 +309,19 @@ class GPTBlock(nn.Layer):
                 finally:
                     for p, a in zip(params, saved):
                         p._data = a
+                if is_moe:
+                    # thread the aux loss out of the checkpointed graph —
+                    # the inner-trace Tensor on last_aux_loss must not leak
+                    return out._data, self.mlp.last_aux_loss._data
                 return out._data
 
-            return run_op(jax.checkpoint(fn), [x] + params, name="gpt_block_rc")
+            outs = run_op(jax.checkpoint(fn), [x] + params,
+                          name="gpt_block_rc")
+            if is_moe:
+                out, aux = outs
+                self.mlp.last_aux_loss = aux
+                return out
+            return outs
         return self._body(x, cache=cache)
 
 
@@ -287,6 +391,11 @@ class GPTForCausalLM(nn.Layer):
         logits = shard_activation(logits, ("dp", "sp", "mp"))
         if labels is not None:
             loss = GPTPretrainingCriterion()(logits, labels)
+            if self.config.moe_num_experts:
+                for blk in self.gpt.h:
+                    aux = getattr(blk.mlp, "last_aux_loss", None)
+                    if aux is not None:
+                        loss = loss + aux * self.config.moe_aux_weight
             return loss
         if caches is not None:
             return logits, new_caches

@@ -330,7 +330,11 @@ class ColorJitter:
         self.hue = hue
 
     def _factor(self, amount):
-        return np.random.uniform(max(0, 1 - amount), 1 + amount)
+        if isinstance(amount, (tuple, list)):
+            lo, hi = amount
+        else:
+            lo, hi = max(0, 1 - amount), 1 + amount
+        return np.random.uniform(lo, hi)
 
     def __call__(self, img):
         arr = np.asarray(img).astype(np.float32)

@@ -127,7 +127,9 @@ class TestShmRing:
         got = 0
         try:
             while got < 50:
-                msg = ring.pop(timeout=30)
+                # generous: spawn + jax import in the producer can take
+                # >30s when the machine is loaded
+                msg = ring.pop(timeout=120)
                 assert len(msg) == 1000 + got
                 assert msg[0] == got % 251
                 got += 1
