@@ -106,7 +106,8 @@ class GradNode:
     missing cotangents can be materialized as zeros.
     """
 
-    __slots__ = ("vjp_fn", "inputs", "out_meta", "name", "single", "_pending")
+    __slots__ = ("vjp_fn", "inputs", "out_meta", "name", "single",
+                 "fn_closed", "_pending")
 
     def __init__(self, vjp_fn, inputs, out_meta, name="op", single=None):
         self.vjp_fn = vjp_fn
@@ -116,6 +117,7 @@ class GradNode:
         # whether the differentiated fn returned a bare array (vjp_fn then
         # expects a bare cotangent, not a 1-tuple)
         self.single = single if single is not None else len(out_meta) == 1
+        self.fn_closed = None  # set by run_op; enables create_graph replay
         self._pending = None  # populated during backward
 
     def __repr__(self):
@@ -206,6 +208,10 @@ def _run_op_impl(fn: Callable, tensors: Sequence, name: str = "op"):
         name=name,
         single=single,
     )
+    # re-derivable closure: create_graph replays jax.vjp through run_op so
+    # the backward itself lands on the tape (double grad, reference analog:
+    # the generated double-grad nodes, eager_gen higher-order AD)
+    node.fn_closed = closed
     wrapped = tuple(
         Tensor(o, stop_gradient=False, grad_node=node, out_index=i)
         for i, o in enumerate(outs)
@@ -246,7 +252,8 @@ def _toposort(roots: List[GradNode]) -> List[GradNode]:
     return order
 
 
-def _run_backward(tensors, grad_tensors, retain_graph, capture=None):
+def _run_backward(tensors, grad_tensors, retain_graph, capture=None,
+                  create_graph=False):
     """Core reverse walk. Returns (leaf_grads: id->array, leaves: id->Tensor)
     WITHOUT writing any .grad — callers decide (backward writes .grad;
     grad() reads only the requested inputs, matching the reference's
@@ -277,6 +284,10 @@ def _run_backward(tensors, grad_tensors, retain_graph, capture=None):
             ga = jnp.ones_like(t._data)
         else:
             ga = g._data if isinstance(g, Tensor) else jnp.asarray(g)
+        if create_graph:
+            # cotangents flow as Tensors so every backward op is taped
+            ga = Tensor(ga, stop_gradient=True) if not isinstance(g, Tensor) \
+                else g
         node = t._grad_node
         if node is None:
             leaf_grads[id(t)] = leaf_grads.get(id(t), 0) + ga
@@ -294,7 +305,8 @@ def _run_backward(tensors, grad_tensors, retain_graph, capture=None):
         if grads_map is None:
             continue
         cotangents = tuple(
-            grads_map.get(i, jnp.zeros(shape, dtype))
+            grads_map.get(i, Tensor(jnp.zeros(shape, dtype))
+                          if create_graph else jnp.zeros(shape, dtype))
             for i, (shape, dtype) in enumerate(node.out_meta)
         )
         if node.vjp_fn is None:
@@ -302,7 +314,27 @@ def _run_backward(tensors, grad_tensors, retain_graph, capture=None):
                 f"trying to backward through op '{node.name}' a second time "
                 "after its graph was freed; call backward(retain_graph=True) "
                 "the first time if you need this")
-        if node.single:
+        if create_graph:
+            if node.fn_closed is None:
+                raise NotImplementedError(
+                    f"create_graph through '{node.name}' (a custom "
+                    "PyLayer) is not supported; its backward strips the "
+                    "tape")
+            closed = node.fn_closed
+            n_in = len(node.inputs)
+            sgl = node.single
+
+            def replay(*flat, _closed=closed, _n=n_in, _sgl=sgl):
+                ins, cots = flat[:_n], flat[_n:]
+                _, vjp = jax.vjp(_closed, *ins)
+                out = vjp(cots[0] if _sgl else tuple(cots))
+                return tuple(out)
+
+            replayed = run_op(replay, list(node.inputs) + list(cotangents),
+                              name=f"{node.name}_grad")
+            in_grads = replayed if isinstance(replayed, tuple) \
+                else (replayed,)
+        elif node.single:
             in_grads = node.vjp_fn(cotangents[0])
         else:
             in_grads = node.vjp_fn(cotangents)
@@ -328,6 +360,7 @@ def _run_backward(tensors, grad_tensors, retain_graph, capture=None):
     if not retain_graph:
         for node in order:
             node.vjp_fn = None
+            node.fn_closed = None  # frees the closed-over input arrays
             node.inputs = []
     return leaf_grads, leaves
 
@@ -355,23 +388,20 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=False, create_graph=Fa
          allow_unused=True):
     """Functional gradient: d(outputs)/d(inputs) without touching .grad.
 
-    Higher-order (``create_graph=True``) is not supported on the eager tape;
-    use the jit/functional path (``paddle_tpu.jit``/jax.grad) for that.
-    """
+    ``create_graph=True`` replays each op's jax.vjp THROUGH the tape, so
+    the returned grads are themselves differentiable (double grad —
+    reference analog: the generated higher-order grad nodes,
+    fluid/eager double-grad)."""
     from .tensor import Tensor
 
     if isinstance(outputs, Tensor):
         outputs = [outputs]
     if isinstance(inputs, Tensor):
         inputs = [inputs]
-    if create_graph:
-        raise NotImplementedError(
-            "create_graph=True: use the functional/jit autodiff path"
-        )
-    from .tensor import Tensor
-
-    leaf_grads, _ = _run_backward(outputs, grad_outputs, retain_graph,
-                                 capture={id(t) for t in inputs})
+    leaf_grads, _ = _run_backward(outputs, grad_outputs,
+                                 retain_graph or create_graph,
+                                 capture={id(t) for t in inputs},
+                                 create_graph=create_graph)
     results = []
     for t in inputs:
         if id(t) not in leaf_grads:
@@ -379,5 +409,9 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=False, create_graph=Fa
                 raise RuntimeError("an input tensor is unused in the graph")
             results.append(None)
         else:
-            results.append(Tensor(leaf_grads[id(t)], stop_gradient=True))
+            g = leaf_grads[id(t)]
+            if isinstance(g, Tensor):
+                results.append(g)
+            else:
+                results.append(Tensor(g, stop_gradient=True))
     return results

@@ -110,14 +110,17 @@ def get_lib(allow_build: bool = True):
         try:
             _LIB = _declare(ctypes.CDLL(_SO_PATH))
         except AttributeError:
+            if not allow_build:
+                # stale .so, not allowed to rebuild here: do NOT poison the
+                # cache — a later allow_build=True caller should rebuild
+                return None
             # stale prebuilt .so missing a newer symbol: rebuild once
             # (unlink first so make relinks and dlopen loads fresh)
-            if allow_build:
-                try:
-                    os.unlink(_SO_PATH)
-                except OSError:
-                    pass
-            if allow_build and _build():
+            try:
+                os.unlink(_SO_PATH)
+            except OSError:
+                pass
+            if _build():
                 try:
                     _LIB = _declare(ctypes.CDLL(_SO_PATH))
                     return _LIB

@@ -418,10 +418,18 @@ class DataLoader:
 
         pool = self._pool
         if pool is None:
-            # fresh seed per pool so dataset-side augmentation differs
-            # across epochs (workers reseed np.random from it)
-            pool = ShmWorkerPool(self._ds_blob, self._co_blob,
-                                 self.num_workers,
+            # refresh the dataset snapshot unless the probe just made it
+            # (datasets may mutate between epochs); fresh seed per pool so
+            # augmentation differs across epochs
+            import pickle as _pickle
+
+            ds_blob = self._ds_blob or _pickle.dumps(self.dataset,
+                                                     protocol=4)
+            co_blob = self._co_blob or _pickle.dumps(self.collate_fn,
+                                                     protocol=4)
+            self._ds_blob = None  # consume: next epoch re-snapshots
+            self._co_blob = None
+            pool = ShmWorkerPool(ds_blob, co_blob, self.num_workers,
                                  seed=_pyrandom.randrange(2 ** 31))
             if self.persistent_workers:
                 self._pool = pool
@@ -454,6 +462,9 @@ class DataLoader:
             try:
                 import pickle
 
+                # re-pickled per pool build (not cached) so datasets that
+                # mutate between epochs reach fresh workers; cost is one
+                # serialization per pool, same as before the probe
                 self._ds_blob = pickle.dumps(self.dataset, protocol=4)
                 self._co_blob = pickle.dumps(self.collate_fn, protocol=4)
             except Exception:

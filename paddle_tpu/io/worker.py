@@ -86,23 +86,26 @@ class ShmWorkerPool:
         # children read JAX_PLATFORMS when they import jax during spawn
         # bootstrap — set it in the inherited env, restore after start
         prev_plat = os.environ.get("JAX_PLATFORMS")
-        os.environ["JAX_PLATFORMS"] = "cpu" 
-        for w in range(num_workers):
-            iname = f"/pt_dl_{uid}_i{w}"
-            oname = f"/pt_dl_{uid}_o{w}"
-            self._idx_rings.append(
-                native.ShmRing(iname, capacity=_IDX_CAP, create=True))
-            self._out_rings.append(
-                native.ShmRing(oname, capacity=_RING_CAP, create=True))
-            p = ctx.Process(target=worker_entry,
-                            args=(ds_blob, co_blob, iname, oname, w, seed),
-                            daemon=True)
-            p.start()
-            self._procs.append(p)
-        if prev_plat is None:
-            os.environ.pop("JAX_PLATFORMS", None)
-        else:
-            os.environ["JAX_PLATFORMS"] = prev_plat
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        try:
+            for w in range(num_workers):
+                iname = f"/pt_dl_{uid}_i{w}"
+                oname = f"/pt_dl_{uid}_o{w}"
+                self._idx_rings.append(
+                    native.ShmRing(iname, capacity=_IDX_CAP, create=True))
+                self._out_rings.append(
+                    native.ShmRing(oname, capacity=_RING_CAP, create=True))
+                p = ctx.Process(
+                    target=worker_entry,
+                    args=(ds_blob, co_blob, iname, oname, w, seed),
+                    daemon=True)
+                p.start()
+                self._procs.append(p)
+        finally:
+            if prev_plat is None:
+                os.environ.pop("JAX_PLATFORMS", None)
+            else:
+                os.environ["JAX_PLATFORMS"] = prev_plat
         self.num_workers = num_workers
 
     def dispatch(self, batch_id: int, indices: List[int]):

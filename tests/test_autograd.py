@@ -118,3 +118,45 @@ def test_retain_graph():
     y.backward(retain_graph=True)
     y.backward()
     np.testing.assert_allclose(x.grad.numpy(), [8.0])
+
+
+class TestDoubleGrad:
+    def test_scalar_double_grad(self):
+        x = paddle.to_tensor(2.0)
+        x.stop_gradient = False
+        y = x * x * x
+        (g,) = paddle.grad([y], [x], create_graph=True)
+        np.testing.assert_allclose(float(g), 12.0)
+        (g2,) = paddle.grad([g], [x])
+        np.testing.assert_allclose(float(g2), 12.0)  # 6x
+
+    def test_gradient_penalty_pattern(self):
+        w = paddle.to_tensor([1.0, 2.0])
+        w.stop_gradient = False
+        out = (w * w).sum()
+        (gw,) = paddle.grad([out], [w], create_graph=True)
+        gp = (gw * gw).sum()  # ||2w||^2 -> d/dw = 8w
+        gp.backward()
+        np.testing.assert_allclose(w.grad.numpy(), [8.0, 16.0])
+
+    def test_triple_grad(self):
+        x = paddle.to_tensor(1.5)
+        x.stop_gradient = False
+        y = x ** 4
+        (g1,) = paddle.grad([y], [x], create_graph=True)   # 4x^3
+        (g2,) = paddle.grad([g1], [x], create_graph=True)  # 12x^2
+        (g3,) = paddle.grad([g2], [x])                     # 24x
+        np.testing.assert_allclose(float(g3), 36.0, rtol=1e-6)
+
+    def test_through_nn_layer(self):
+        from paddle_tpu import nn
+
+        lin = nn.Linear(3, 1)
+        x = paddle.to_tensor([[1.0, 2.0, 3.0]])
+        x.stop_gradient = False
+        y = nn.functional.tanh(lin(x)).sum()
+        (gx,) = paddle.grad([y], [x], create_graph=True)
+        loss = (gx * gx).sum()
+        loss.backward()
+        assert lin.weight.grad is not None
+        assert np.isfinite(lin.weight.grad.numpy()).all()
