@@ -60,9 +60,18 @@ def launch(argv=None):
     endpoints = ",".join(
         f"127.0.0.1:{_free_port()}" for _ in range(world))
 
+    # make paddle_tpu importable in workers regardless of their cwd
+    # (`python script.py` puts the script dir, not the launcher cwd, on
+    # sys.path)
+    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))))
+    extra_path = pkg_root + (os.pathsep + os.environ["PYTHONPATH"]
+                             if os.environ.get("PYTHONPATH") else "")
+
     for local_rank in range(nproc):
         rank = node_rank * nproc + local_rank
         env = dict(os.environ)
+        env["PYTHONPATH"] = extra_path
         env.update({
             "PADDLE_TRAINER_ID": str(rank),
             "PADDLE_TRAINERS_NUM": str(world),
@@ -83,11 +92,11 @@ def launch(argv=None):
                 stderr=subprocess.STDOUT if world > 1 else None)
         procs.append(p)
 
-    def _terminate(*_):
+    def _terminate(code=1, *_):
         for p in procs:
             if p.poll() is None:
                 p.terminate()
-        sys.exit(1)
+        sys.exit(code if isinstance(code, int) and code else 1)
 
     signal.signal(signal.SIGINT, _terminate)
     signal.signal(signal.SIGTERM, _terminate)
@@ -102,7 +111,7 @@ def launch(argv=None):
                     alive = True
                 elif ret != 0:
                     exit_code = ret
-                    _terminate()
+                    _terminate(ret)  # propagate the worker's exit code
             if not alive:
                 break
             time.sleep(0.2)
