@@ -1,7 +1,7 @@
 """paddle_tpu.incubate (reference: python/paddle/incubate/)."""
 from . import nn  # noqa: F401
 
-_LAZY = ("distributed",)
+_LAZY = ("distributed", "asp")
 
 
 def __getattr__(name):

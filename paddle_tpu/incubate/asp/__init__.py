@@ -1,0 +1,159 @@
+"""ASP: automatic structured (n:m) sparsity
+(reference: python/paddle/incubate/asp/ — asp.py decorate/prune_model,
+utils.py calculate_density/create_mask/check_sparsity, supported_layer_list).
+
+TPU-first: the n:m masks are plain multiplicative tensors; the decorated
+optimizer re-applies them after each step, so masked weights stay zero
+through training. XLA folds the mask multiply into adjacent ops; on
+hardware with sparsity support the mask layout is the standard 2:4 pattern.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ... import nn
+from ...core.tensor import Tensor
+
+__all__ = ["calculate_density", "create_mask", "check_sparsity",
+           "prune_model", "decorate", "set_excluded_layers",
+           "reset_excluded_layers"]
+
+_masks: Dict[int, jnp.ndarray] = {}       # id(param) -> mask array
+_excluded: set = set()                    # excluded layer names
+
+
+def calculate_density(x) -> float:
+    """Fraction of non-zeros (reference: utils.py calculate_density)."""
+    arr = np.asarray(x._data if isinstance(x, Tensor) else x)
+    return float(np.count_nonzero(arr)) / max(arr.size, 1)
+
+
+def _mask_1d(arr: np.ndarray, n: int, m: int) -> np.ndarray:
+    """Keep the n largest-|w| in every m consecutive weights along the
+    last axis (reference: utils.py get_mask_1d)."""
+    shape = arr.shape
+    flat = arr.reshape(-1, m)
+    order = np.argsort(-np.abs(flat), axis=1)
+    mask = np.zeros_like(flat)
+    rows = np.arange(flat.shape[0])[:, None]
+    mask[rows, order[:, :n]] = 1.0
+    return mask.reshape(shape)
+
+
+def _mask_2d_greedy(arr: np.ndarray, n: int, m: int) -> np.ndarray:
+    """Greedy m×m block mask with n:m on rows AND columns (reference:
+    utils.py get_mask_2d_greedy). Falls back to 1d when shapes don't tile."""
+    h, w = arr.shape
+    if h % m or w % m:
+        return _mask_1d(arr, n, m)
+    mask = np.zeros_like(arr)
+    for bi in range(0, h, m):
+        for bj in range(0, w, m):
+            block = np.abs(arr[bi:bi + m, bj:bj + m])
+            bmask = np.zeros((m, m))
+            order = np.argsort(-block, axis=None)
+            row_cnt = np.zeros(m, dtype=int)
+            col_cnt = np.zeros(m, dtype=int)
+            for flat_idx in order:
+                r, c = divmod(int(flat_idx), m)
+                if row_cnt[r] < n and col_cnt[c] < n:
+                    bmask[r, c] = 1.0
+                    row_cnt[r] += 1
+                    col_cnt[c] += 1
+            mask[bi:bi + m, bj:bj + m] = bmask
+    return mask
+
+
+_MASK_ALGOS = {"mask_1d": _mask_1d, "mask_2d_greedy": _mask_2d_greedy,
+               "mask_2d_best": _mask_2d_greedy}
+
+
+def create_mask(tensor, func_name: str = "mask_1d", n: int = 2, m: int = 4):
+    """reference: utils.py create_mask."""
+    arr = np.asarray(tensor._data if isinstance(tensor, Tensor) else tensor)
+    if arr.ndim == 1 or arr.size % m:
+        return Tensor(jnp.ones(arr.shape, dtype=jnp.float32))
+    algo = _MASK_ALGOS[func_name]
+    if arr.ndim != 2:
+        flat = arr.reshape(arr.shape[0], -1)
+        mask = _mask_1d(flat, n, m).reshape(arr.shape)
+    else:
+        mask = algo(arr, n, m)
+    return Tensor(jnp.asarray(mask, dtype=jnp.float32))
+
+
+def check_sparsity(tensor, n: int = 2, m: int = 4,
+                   func_name: str = "check_mask_1d") -> bool:
+    """Every m-block along the last axis has at most n non-zeros
+    (reference: utils.py check_sparsity)."""
+    arr = np.asarray(tensor._data if isinstance(tensor, Tensor) else tensor)
+    if arr.size % m:
+        return False
+    flat = (arr.reshape(-1, m) != 0).sum(axis=1)
+    return bool((flat <= n).all())
+
+
+def set_excluded_layers(param_names: List[str], main_program=None):
+    _excluded.update(param_names)
+
+
+def reset_excluded_layers(main_program=None):
+    _excluded.clear()
+
+
+def _prunable_params(model: nn.Layer):
+    for name, sub in model.named_sublayers(include_self=True):
+        if not isinstance(sub, nn.Linear):
+            continue
+        w = getattr(sub, "weight", None)
+        if w is None:
+            continue
+        if any(ex in name or ex in (w.name or "") for ex in _excluded):
+            continue
+        if w.ndim == 2 and w.shape[1] % 4 == 0:
+            yield name, w
+
+
+def prune_model(model: nn.Layer, n: int = 2, m: int = 4,
+                mask_algo: str = "mask_1d", with_mask: bool = True):
+    """Apply n:m masks to every supported layer's weight (reference:
+    asp.py prune_model). Returns {param_name: mask Tensor}."""
+    out = {}
+    for name, w in _prunable_params(model):
+        mask = create_mask(w, mask_algo, n, m)
+        w._data = w._data * mask._data.astype(w._data.dtype)
+        if with_mask:
+            _masks[id(w)] = mask._data
+        out[name] = mask
+    return out
+
+
+class _ASPOptimizer:
+    """Optimizer wrapper re-applying masks after each update (reference:
+    asp.py OptimizerWithSparsityGuarantee)."""
+
+    def __init__(self, optimizer):
+        self._inner = optimizer
+
+    def __getattr__(self, item):
+        return getattr(self._inner, item)
+
+    def step(self):
+        self._inner.step()
+        for p in self._inner._parameter_list or []:
+            mask = _masks.get(id(p))
+            if mask is not None:
+                p._data = p._data * mask.astype(p._data.dtype)
+
+    def minimize(self, loss, *a, **k):
+        loss.backward()
+        self.step()
+        return None, None
+
+
+def decorate(optimizer):
+    """reference: asp.py decorate."""
+    return _ASPOptimizer(optimizer)

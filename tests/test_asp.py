@@ -1,0 +1,64 @@
+"""ASP n:m structured sparsity (reference analog: test/asp/)."""
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import nn
+from paddle_tpu.incubate import asp
+
+
+class TestMasks:
+    def test_mask_1d_2_4(self):
+        w = pt.randn([8, 16])
+        mask = asp.create_mask(w, "mask_1d", 2, 4)
+        m = mask.numpy().reshape(-1, 4)
+        assert (m.sum(axis=1) == 2).all()
+        # keeps the largest-|w| entries
+        flat = np.abs(w.numpy()).reshape(-1, 4)
+        kept = np.take_along_axis(flat, np.argsort(-flat, 1)[:, :2], 1).sum()
+        assert abs((flat * m).sum() - kept) < 1e-4
+
+    def test_mask_2d_greedy(self):
+        w = pt.randn([8, 8])
+        mask = asp.create_mask(w, "mask_2d_greedy", 2, 4).numpy()
+        # rows AND cols of each 4x4 block have <=2 nonzeros
+        for bi in range(0, 8, 4):
+            for bj in range(0, 8, 4):
+                b = mask[bi:bi+4, bj:bj+4]
+                assert (b.sum(axis=0) <= 2).all()
+                assert (b.sum(axis=1) <= 2).all()
+
+    def test_density_and_check(self):
+        w = pt.randn([4, 8])
+        assert asp.calculate_density(w) == 1.0
+        masked = pt.to_tensor(w.numpy() * asp.create_mask(w).numpy())
+        assert abs(asp.calculate_density(masked) - 0.5) < 1e-6
+        assert asp.check_sparsity(masked, 2, 4)
+        assert not asp.check_sparsity(w, 2, 4)
+
+
+class TestPruneTrain:
+    def test_prune_and_train_keeps_sparsity(self):
+        pt.seed(0)
+        model = nn.Sequential(nn.Linear(16, 32), nn.ReLU(),
+                              nn.Linear(32, 4))
+        opt = asp.decorate(pt.optimizer.Adam(
+            parameters=model.parameters(), learning_rate=1e-2))
+        masks = asp.prune_model(model)
+        assert masks  # both linears pruned
+        for _ in range(5):
+            x = pt.randn([8, 16])
+            loss = (model(x) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        for layer in (model[0], model[2]):
+            assert asp.check_sparsity(layer.weight, 2, 4)
+            assert abs(asp.calculate_density(layer.weight) - 0.5) < 0.02
+
+    def test_excluded_layers(self):
+        asp.reset_excluded_layers()
+        model = nn.Sequential(nn.Linear(8, 8))
+        asp.set_excluded_layers([model[0].weight.name])
+        masks = asp.prune_model(model)
+        assert not masks
+        asp.reset_excluded_layers()
