@@ -9,7 +9,7 @@ subpackages.
 """
 from __future__ import annotations
 
-__version__ = "0.1.0"
+from .version import full_version as __version__  # noqa: E402
 
 from .core.dtype import (  # noqa: F401
     bfloat16,
@@ -69,6 +69,7 @@ from . import inference  # noqa: F401,E402
 from . import fft  # noqa: F401,E402
 from . import linalg  # noqa: F401,E402
 from . import audio  # noqa: F401,E402
+from . import version  # noqa: F401,E402
 from . import device  # noqa: F401,E402
 from . import models  # noqa: F401,E402
 from .framework.io_utils import load, save  # noqa: F401,E402
@@ -78,7 +79,12 @@ from .framework import (  # noqa: F401,E402
     get_flags,
     set_flags,
 )
-from .device import get_device, set_device  # noqa: F401,E402
+from .device import (  # noqa: F401,E402
+    get_cudnn_version,
+    get_device,
+    is_compiled_with_cinn,
+    set_device,
+)
 from .distributed.parallel import DataParallel  # noqa: F401,E402  (paddle.DataParallel)
 
 # functional conveniences at top level, paddle-style
@@ -98,6 +104,22 @@ def enable_static():
     from . import static as _static
 
     _static.enable_static()
+
+
+def iinfo(dtype):
+    import jax.numpy as jnp
+
+    from .core.dtype import to_jax_dtype
+
+    return jnp.iinfo(to_jax_dtype(dtype))
+
+
+def finfo(dtype):
+    import jax.numpy as jnp
+
+    from .core.dtype import to_jax_dtype
+
+    return jnp.finfo(to_jax_dtype(dtype))
 
 
 def in_dynamic_mode() -> bool:

@@ -110,3 +110,11 @@ class _CudaNamespace:
 
 
 cuda = _CudaNamespace()
+
+
+def get_cudnn_version():
+    return None  # TPU build: no cuDNN
+
+
+def is_compiled_with_cinn() -> bool:
+    return False  # XLA plays CINN's role (SURVEY §2.4.9)

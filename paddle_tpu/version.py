@@ -1,0 +1,23 @@
+"""Version info (reference: python/paddle/version.py, generated)."""
+full_version = "0.1.0"
+major = "0"
+minor = "1"
+patch = "0"
+rc = "0"
+cuda_version = "False"     # no CUDA in the TPU build
+cudnn_version = "False"
+tpu = True
+commit = "unknown"
+with_pip_cuda_libraries = "OFF"
+
+
+def show():
+    print(f"paddle_tpu {full_version} (TPU-native; cuda: {cuda_version})")
+
+
+def cuda():
+    return cuda_version
+
+
+def cudnn():
+    return cudnn_version
