@@ -15,7 +15,8 @@ from .. import collective as dist
 
 __all__ = ["TensorParallel", "ShardingParallel", "SegmentParallel",
            "LayerDesc", "SharedLayerDesc", "PipelineLayer",
-           "PipelineParallel", "PipelineParallelWithInterleave"]
+           "PipelineParallel", "PipelineParallelWithInterleave",
+           "PipelineParallelZeroBubble"]
 
 
 def _broadcast_parameters(model, group, src_rank):
@@ -270,6 +271,34 @@ class PipelineParallel(_MetaParallelBase):
         return buf
 
     # ---------------------------------------------------------- schedule
+    def _forward_micro(self, i, micro_inputs, losses, scaler, num_micro):
+        """Shared fwd step: recv -> forward -> (loss|send). Returns
+        (stage_input, stage_output)."""
+        if self.is_first:
+            x = micro_inputs[i][0] if micro_inputs else None
+        else:
+            x = self._recv_tensor(self.prev_rank)
+        out = self._layers.forward(x)
+        if self.is_last:
+            loss_fn = self._layers._loss_fn
+            if loss_fn is not None and micro_inputs:
+                out = loss_fn(out, micro_inputs[i][1])
+            if scaler is not None:
+                out = scaler.scale(out)
+            out = out / num_micro
+            losses.append(out)
+        else:
+            self._send_tensor(out.detach(), self.next_rank)
+        return x, out
+
+    def _sum_losses(self, losses):
+        if self.is_last and losses:
+            total = losses[0]
+            for l in losses[1:]:
+                total = total + l
+            return total.detach()
+        return None
+
     def forward_backward_pipeline(self, data, scaler=None):
         """1F1B (reference: pipeline_parallel.py:575)."""
         num_micro = self.accumulate_steps
@@ -282,26 +311,9 @@ class PipelineParallel(_MetaParallelBase):
         losses = []
 
         def fwd_step(i):
-            if self.is_first:
-                x = micro_inputs[i][0] if micro_inputs else None
-            else:
-                x = self._recv_tensor(self.prev_rank)
-            out = self._layers.forward(x)
-            if self.is_last:
-                loss_fn = self._layers._loss_fn
-                if loss_fn is not None and micro_inputs:
-                    label = micro_inputs[i][1]
-                    loss = loss_fn(out, label)
-                else:
-                    loss = out
-                if scaler is not None:
-                    loss = scaler.scale(loss)
-                loss = loss / num_micro
-                losses.append(loss)
-                output_buffers.append(loss)
-            else:
-                self._send_tensor(out.detach(), self.next_rank)
-                output_buffers.append(out)
+            x, out = self._forward_micro(i, micro_inputs, losses, scaler,
+                                         num_micro)
+            output_buffers.append(out)
             input_buffers.append(x)
 
         def bwd_step(i):
@@ -329,12 +341,7 @@ class PipelineParallel(_MetaParallelBase):
             bwd_step(bwd_i)
             bwd_i += 1
 
-        if self.is_last and losses:
-            total = losses[0]
-            for l in losses[1:]:
-                total = total + l
-            return total.detach()
-        return None
+        return self._sum_losses(losses)
 
     def _split_micro(self, data, num_micro):
         if data is None:
@@ -394,11 +401,7 @@ class PipelineParallelWithInterleave(PipelineParallel):
     Megatron iteration order is identical on every rank, and the CPU/XLA
     ProcessGroup's buffered FIFO p2p makes the schedule deadlock-free.
 
-    The reference's zero-bubble schedule (pipeline_zero_bubble.py:62) splits
-    backward into B (input-grad) and W (weight-grad) passes; jax.vjp yields
-    both grads in one pass, so ZB's W-fill is not expressible without double
-    backward cost — on TPU the XLA-compiled 1F1B step is the supported
-    optimum (see SURVEY §7 hard parts).
+    For the zero-bubble B/W-split schedule see PipelineParallelZeroBubble.
     """
 
     def __init__(self, layers, hcg, strategy=None):
@@ -496,3 +499,100 @@ class PipelineParallelWithInterleave(PipelineParallel):
                 totl = totl + l
             return totl.detach()
         return None
+
+
+class PipelineParallelZeroBubble(PipelineParallel):
+    """Zero-bubble 1F1B (ZB-H1, reference:
+    distributed/passes/pipeline_scheduler_pass/pipeline_zero_bubble.py:62).
+
+    Backward is split per micro-batch into B (input-gradient only — the
+    part downstream stages wait on, sent upstream immediately) and W
+    (weight gradients — no inter-stage dependency, deferred into the
+    cooldown bubble). The eager tape realizes the split with two targeted
+    ``grad()`` walks over a retained graph: B = d loss/d stage-input,
+    W = d loss/d stage-params accumulated into ``.grad``. On TPU the
+    compiled TrainStep path subsumes the bubble win; this schedule provides
+    the reference capability for the host-driven pipeline engine.
+    """
+
+    def forward_backward_pipeline(self, data, scaler=None):
+        from ...core.autograd import grad as _tape_grad
+
+        num_micro = self.accumulate_steps
+        num_warmup = min(self.num_stages - self.stage_id - 1, num_micro)
+        num_steady = num_micro - num_warmup
+
+        micro_inputs = self._split_micro(data, num_micro)
+        inputs: List[Optional[Tensor]] = []
+        outputs: List[Optional[Tensor]] = []
+        pending_w: List[Optional[list]] = []   # per-micro stashed w-grads
+        losses = []
+        params = [p for p in self._layers.parameters()
+                  if not p.stop_gradient]
+
+        def fwd_step(i):
+            x, out = self._forward_micro(i, micro_inputs, losses, scaler,
+                                         num_micro)
+            inputs.append(x)
+            outputs.append(out)
+
+        def b_step(i):
+            """One backward walk; the INPUT grad is shipped upstream
+            immediately (the inter-stage dependency), the weight grads are
+            stashed for the deferred W slot (accumulation + hooks)."""
+            out = outputs[i]
+            if self.is_last:
+                g_out = None
+            else:
+                g_out = self._recv_tensor(self.next_rank)
+            x = inputs[i]
+            targets = ([x] if not self.is_first and x is not None
+                       else []) + params
+            grads = _tape_grad([out], targets, grad_outputs=g_out,
+                               retain_graph=False, allow_unused=True)
+            if not self.is_first and x is not None:
+                gx, grads = grads[0], grads[1:]
+                if gx is not None:
+                    self._send_tensor(gx, self.prev_rank)
+            pending_w.append(list(grads))
+            outputs[i] = None  # graph freed by the walk
+
+        def w_step(i):
+            """Deferred weight-grad accumulation for micro i; fires grad
+            hooks exactly like core backward() so DP/sharding/SP hook-based
+            sync composes (autograd.py backward())."""
+            grads = pending_w[i]
+            for p, g in zip(params, grads):
+                if g is None:
+                    continue
+                if p._grad is None:
+                    p._grad = g if isinstance(g, Tensor) else Tensor(g)
+                else:
+                    p._grad = Tensor(p._grad._data + g._data)
+                for hook in p._grad_hooks:
+                    res = hook(p._grad)
+                    if res is not None:
+                        p._grad = res
+            pending_w[i] = None
+
+        fwd_i = b_i = w_i = 0
+        for _ in range(num_warmup):
+            fwd_step(fwd_i)
+            fwd_i += 1
+        for _ in range(num_steady):
+            fwd_step(fwd_i)
+            fwd_i += 1
+            b_step(b_i)
+            b_i += 1
+            # ZB-H1: one deferred W per steady slot keeps memory flat
+            if b_i - w_i > self.num_stages - self.stage_id:
+                w_step(w_i)
+                w_i += 1
+        while b_i < num_micro:
+            b_step(b_i)
+            b_i += 1
+        while w_i < num_micro:  # W fills the cooldown bubble
+            w_step(w_i)
+            w_i += 1
+
+        return self._sum_losses(losses)

@@ -15,7 +15,8 @@ def _pp_worker(mode):
     from paddle_tpu import nn
     from paddle_tpu.distributed import fleet
     from paddle_tpu.distributed.fleet.meta_parallel import (
-        PipelineLayer, PipelineParallel, PipelineParallelWithInterleave)
+        PipelineLayer, PipelineParallel, PipelineParallelWithInterleave,
+        PipelineParallelZeroBubble)
 
     strategy = fleet.DistributedStrategy()
     strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1,
@@ -25,8 +26,8 @@ def _pp_worker(mode):
     hcg = fleet.get_hybrid_communicate_group()
 
     pt.seed(42)
-    n_layers = 4 if mode == "1f1b" else 8
-    vpp = None if mode == "1f1b" else 2
+    n_layers = 8 if mode == "interleave" else 4
+    vpp = 2 if mode == "interleave" else None
     layers = [nn.Linear(8, 8) for _ in range(n_layers)]
 
     def loss_fn(out, label):
@@ -34,8 +35,9 @@ def _pp_worker(mode):
 
     pipe = PipelineLayer(layers, loss_fn=loss_fn,
                          num_virtual_pipeline_stages=vpp)
-    cls = PipelineParallel if mode == "1f1b" \
-        else PipelineParallelWithInterleave
+    cls = {"1f1b": PipelineParallel,
+           "interleave": PipelineParallelWithInterleave,
+           "zb": PipelineParallelZeroBubble}[mode]
     model = cls(pipe, hcg, strategy)
     opt = pt.optimizer.SGD(parameters=pipe.parameters(), learning_rate=0.01)
 
@@ -84,3 +86,7 @@ def test_pipeline_1f1b_matches_single_process():
 
 def test_pipeline_interleave_matches_single_process():
     _run("interleave")
+
+
+def test_pipeline_zero_bubble_matches_single_process():
+    _run("zb")
