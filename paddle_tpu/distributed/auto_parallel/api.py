@@ -336,6 +336,15 @@ class _ShardDataLoader:
             i = self._mesh.dim_names.index(self._axis)
             if t.ndim and t.shape[0] % self._mesh.shape[i] == 0:
                 placements[i] = Shard(0)
+            elif t.ndim:
+                import warnings
+
+                warnings.warn(
+                    f"shard_dataloader: batch dim {t.shape[0]} is not "
+                    f"divisible by mesh axis '{self._axis}' "
+                    f"(size {self._mesh.shape[i]}); replicating this batch — "
+                    "data parallelism is lost for it. Use drop_last=True or "
+                    "pad the batch.", stacklevel=3)
         return shard_tensor(t, self._mesh, placements)
 
     def __iter__(self):

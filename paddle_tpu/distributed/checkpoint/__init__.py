@@ -56,13 +56,24 @@ def _local_view(t: Tensor):
 _async_lock = threading.Lock()
 _async_threads: List[threading.Thread] = []
 
+import atexit as _atexit
+
+_atexit.register(lambda: wait_async_save())
+
+
+def _atomic_dump(obj, fname):
+    # write-to-temp + rename so a crash/exit mid-write never leaves a
+    # truncated file visible under the final name
+    tmp = fname + ".tmp"
+    with open(tmp, "wb") as f:
+        pickle.dump(obj, f, protocol=4)
+    os.replace(tmp, fname)
+
 
 def _flush_payload(path, fname, shards_payload, meta, is_coordinator):
-    with open(fname, "wb") as f:
-        pickle.dump(shards_payload, f, protocol=4)
+    _atomic_dump(shards_payload, fname)
     if is_coordinator:
-        with open(os.path.join(path, "0.metadata"), "wb") as f:
-            pickle.dump(meta, f, protocol=4)
+        _atomic_dump(meta, os.path.join(path, "0.metadata"))
 
 
 def wait_async_save():

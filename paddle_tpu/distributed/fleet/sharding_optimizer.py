@@ -170,6 +170,14 @@ class GroupShardedStage3:
                  offload=False, sync_comm=False, **kw):
         import jax.numpy as jnp
 
+        if getattr(optimizer, "_stage3_wrapped_by", None) is not None:
+            # must precede any param mutation: raising after _shard_all would
+            # leave the layer destructively sharded with no recovery path
+            raise RuntimeError(
+                "optimizer.step is already routed through a GroupShardedStage3 "
+                "wrapper; sharing one optimizer across stage-3 wrappers would "
+                "chain duplicate grad reduce + reshard passes. Use a separate "
+                "optimizer per wrapped layer.")
         self._layer = layer
         self._optimizer = optimizer
         if group is None:
@@ -195,6 +203,7 @@ class GroupShardedStage3:
         # reduce+update+reshard step
         self._opt_step_orig = optimizer.step
         optimizer.step = self.step
+        optimizer._stage3_wrapped_by = self
 
     # -- param shard/unshard ------------------------------------------------
     def _shard_param(self, p):

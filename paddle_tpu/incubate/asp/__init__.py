@@ -33,13 +33,24 @@ def calculate_density(x) -> float:
 
 def _mask_1d(arr: np.ndarray, n: int, m: int) -> np.ndarray:
     """Keep the n largest-|w| in every m consecutive weights along the
-    last axis (reference: utils.py get_mask_1d)."""
+    last axis (reference: utils.py get_mask_1d). Rows are padded to a
+    multiple of m (as the reference pads the second dimension) so m-blocks
+    never span row boundaries; the pad is cropped from the result."""
     shape = arr.shape
-    flat = arr.reshape(-1, m)
+    last = shape[-1] if arr.ndim else arr.size
+    rows2d = arr.reshape(-1, last)
+    pad = (-last) % m
+    if pad:
+        rows2d = np.concatenate(
+            [rows2d, np.zeros((rows2d.shape[0], pad), rows2d.dtype)], axis=1)
+    flat = rows2d.reshape(-1, m)
     order = np.argsort(-np.abs(flat), axis=1)
     mask = np.zeros_like(flat)
     rows = np.arange(flat.shape[0])[:, None]
     mask[rows, order[:, :n]] = 1.0
+    mask = mask.reshape(rows2d.shape)
+    if pad:
+        mask = mask[:, :last]
     return mask.reshape(shape)
 
 
@@ -74,8 +85,8 @@ _MASK_ALGOS = {"mask_1d": _mask_1d, "mask_2d_greedy": _mask_2d_greedy,
 def create_mask(tensor, func_name: str = "mask_1d", n: int = 2, m: int = 4):
     """reference: utils.py create_mask."""
     arr = np.asarray(tensor._data if isinstance(tensor, Tensor) else tensor)
-    if arr.ndim == 1 or arr.size % m:
-        return Tensor(jnp.ones(arr.shape, dtype=jnp.float32))
+    if arr.ndim <= 1:
+        return Tensor(jnp.asarray(_mask_1d(arr, n, m), dtype=jnp.float32))
     algo = _MASK_ALGOS[func_name]
     if arr.ndim != 2:
         flat = arr.reshape(arr.shape[0], -1)
@@ -90,9 +101,15 @@ def check_sparsity(tensor, n: int = 2, m: int = 4,
     """Every m-block along the last axis has at most n non-zeros
     (reference: utils.py check_sparsity)."""
     arr = np.asarray(tensor._data if isinstance(tensor, Tensor) else tensor)
-    if arr.size % m:
-        return False
-    flat = (arr.reshape(-1, m) != 0).sum(axis=1)
+    # flatten exactly as create_mask does (ndim>2 → (shape[0], -1)) so
+    # block boundaries agree with the masks this module produces
+    rows2d = arr.reshape(arr.shape[0], -1) if arr.ndim >= 2 \
+        else arr.reshape(1, -1)
+    pad = (-rows2d.shape[1]) % m
+    if pad:
+        rows2d = np.concatenate(
+            [rows2d, np.zeros((rows2d.shape[0], pad), rows2d.dtype)], axis=1)
+    flat = (rows2d.reshape(-1, m) != 0).sum(axis=1)
     return bool((flat <= n).all())
 
 
@@ -113,7 +130,7 @@ def _prunable_params(model: nn.Layer):
             continue
         if any(ex in name or ex in (w.name or "") for ex in _excluded):
             continue
-        if w.ndim == 2 and w.shape[1] % 4 == 0:
+        if w.ndim == 2:
             yield name, w
 
 

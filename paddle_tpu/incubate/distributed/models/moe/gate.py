@@ -72,8 +72,15 @@ class NaiveGate(BaseGate):
         return gate_idx, gate_val
 
 
-def _capacity(num_tokens: int, num_experts: int, cap_factor: float) -> int:
-    cap = int(cap_factor * num_tokens / num_experts)
+def _capacity(num_tokens: int, num_experts: int, cap_factor: float,
+              topk: int = 1) -> int:
+    # total slots must cover topk dispatches per token (matches GPTMoEMLP's
+    # b*s*topk/E and the reference's per-expert ceil(cap_rate*S) semantics);
+    # without the topk multiplier, balanced top-2 routing at factor 1.2 would
+    # silently drop ~40% of second-choice dispatches.
+    import math
+
+    cap = math.ceil(cap_factor * topk * num_tokens / num_experts)
     return max(cap, 4)
 
 
@@ -101,7 +108,7 @@ class GShardGate(BaseGate):
 
         def route(lg):
             S = lg.shape[0]
-            C = _capacity(S, E, cap_f)
+            C = _capacity(S, E, cap_f, topk=2)
             gates = jax.nn.softmax(lg, axis=-1)
             # top-1
             idx1 = jnp.argmax(gates, axis=-1)

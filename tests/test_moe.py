@@ -26,6 +26,27 @@ class TestGates:
         assert (tot <= 1.0 + 1e-5).all()
         assert g.get_loss() is not None
 
+    def test_gshard_balanced_no_second_choice_drop(self):
+        # capacity must include the top-k multiplier (ADVICE r1): with
+        # perfectly balanced routing, every first AND second choice fits.
+        import jax.numpy as jnp
+
+        E, S, d = 4, 32, 16
+        g = GShardGate(d, num_expert=E, world_size=1, random_routing=False)
+        # rig logits so token i's top-2 experts are (i%E, (i+1)%E) — balanced
+        logits = np.full((S, E), -10.0, np.float32)
+        for i in range(S):
+            logits[i, i % E] = 5.0
+            logits[i, (i + 1) % E] = 4.0
+        # drive the gate with exact logits via an identity weight
+        g.gate.bias._data = jnp.zeros_like(g.gate.bias._data)
+        g.gate.weight._data = jnp.eye(d, E, dtype=g.gate.weight._data.dtype)
+        x = Tensor(jnp.pad(logits, ((0, 0), (0, d - E))))
+        cw, dm = g(x, training=True)
+        # every token keeps exactly 2 dispatch slots (no capacity drops)
+        per_token = (dm.numpy() > 0).sum(axis=(1, 2))
+        assert (per_token == 2).all(), per_token
+
     def test_switch_top1(self):
         g = SwitchGate(16, num_expert=4, world_size=1, topk=1)
         x = pt.randn([32, 16])
