@@ -88,5 +88,36 @@ class ElasticManager:
             if fresh and self.on_fault is not None:
                 self.on_fault(fresh)
 
+    # ------------------------------------------------------- relaunch
+    def enable_relaunch(self, job_id: str = "default"):
+        """Wire fault detection to the launcher's restart channel: a dead
+        node bumps ``launch/{job}/restart`` in the store, which every
+        ``paddle_tpu.distributed.launch`` process polls — they kill their
+        pods and re-rendezvous under the new generation (reference:
+        manager.py:457-530 scale-in/relaunch; here the launcher owns the
+        process lifecycle, the manager owns detection)."""
+        prev = self.on_fault
+
+        def _fault(dead):
+            if prev is not None:
+                prev(dead)
+            self.request_relaunch(job_id)
+
+        self.on_fault = _fault
+
+    def request_relaunch(self, job_id: str = "default") -> int:
+        """Bump the restart generation all launchers poll. Returns the new
+        generation."""
+        return self._store.add(f"launch/{job_id}/restart", 1)
+
+    def scale(self, num_nodes: int, job_id: str = "default") -> int:
+        """Record a scale-in/out target (reference manager.py:484,507) and
+        trigger a relaunch so the next generation sees it. Launchers read
+        ``elastic/num_nodes`` when they re-rendezvous. Returns the new
+        restart generation."""
+        self.num_nodes = num_nodes
+        self._store.set("elastic/num_nodes", str(num_nodes).encode())
+        return self.request_relaunch(job_id)
+
     def stop(self):
         self._stop = True

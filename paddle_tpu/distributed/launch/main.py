@@ -1,15 +1,28 @@
 """python -m paddle_tpu.distributed.launch (reference:
 python/paddle/distributed/launch/main.py:23; CollectiveController.build_pod
-launch/controllers/collective.py:37).
+launch/controllers/collective.py:37,262; restart policy --max_restart;
+elastic relaunch fleet/elastic/manager.py:457-530).
 
 TPU-native process model: ONE process per host (jax owns all local chips);
---nproc_per_node>1 supported for the CPU-backend test mode (each proc gets
-PADDLE_TRAINER_ID). Env contract matches the reference (PADDLE_TRAINER_ID,
-PADDLE_TRAINERS_NUM, PADDLE_TRAINER_ENDPOINTS, PADDLE_MASTER).
+--nproc_per_node>1 supported for the CPU-backend test mode. Env contract
+matches the reference (PADDLE_TRAINER_ID, PADDLE_TRAINERS_NUM,
+PADDLE_TRAINER_ENDPOINTS, PADDLE_MASTER).
+
+Multi-node rendezvous (real, not fabricated): the launcher whose bind on
+the --master port wins hosts the TCPStore master daemon; every node
+(auto-)assigns its node rank via store ADD, publishes its *real* worker
+endpoints under ``launch/{job}/g{gen}/node/{rank}``, barriers on all
+nodes, and builds the global rank/endpoint table from what was published —
+the reference's master-KV build_pod flow over our own store.
+
+Restart: a non-zero worker exit bumps the shared restart generation
+(store ADD); every launcher polls the generation, kills its pod, and
+re-runs rendezvous under the new generation, up to --max_restart times.
 """
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import signal
 import socket
@@ -20,12 +33,156 @@ import time
 __all__ = ["launch", "main"]
 
 
-def _free_port():
+def _free_port(host="127.0.0.1"):
     s = socket.socket()
-    s.bind(("127.0.0.1", 0))
+    s.bind((host, 0))
     port = s.getsockname()[1]
     s.close()
     return port
+
+
+def _advertise_ip(master_host: str) -> str:
+    """The IP peers can reach us on: the one routing toward the master."""
+    if master_host in ("127.0.0.1", "localhost"):
+        return "127.0.0.1"
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.connect((master_host, 9))
+        ip = s.getsockname()[0]
+        s.close()
+        return ip
+    except OSError:
+        return socket.gethostbyname(socket.gethostname())
+
+
+class _Rendezvous:
+    """Store-backed node rendezvous + restart-generation channel."""
+
+    def __init__(self, master: str, nnodes: int, job_id: str,
+                 node_rank: int, timeout: float = 900.0):
+        from ..store import TCPStore
+
+        host, port = master.rsplit(":", 1)
+        self.job = job_id
+        self.nnodes = nnodes
+        self.timeout = timeout
+        # the rank-0 contender hosts the daemon; everyone else connects.
+        # With an explicit --rank we know who we are; with auto-assign the
+        # machine that can bind the master address decides (binding the
+        # master's concrete IP fails with EADDRNOTAVAIL on other hosts)
+        is_master = node_rank == 0
+        if node_rank < 0:
+            try:
+                probe = socket.socket()
+                probe.bind((host if host != "localhost" else "127.0.0.1",
+                            int(port)))
+                probe.close()
+                is_master = True
+            except OSError:
+                is_master = False
+        try:
+            self.store = TCPStore(host, int(port), is_master=is_master,
+                                  world_size=nnodes, timeout=timeout)
+        except OSError:
+            # lost the probe->bind race to a same-host peer: be a client
+            self.store = TCPStore(host, int(port), is_master=False,
+                                  world_size=nnodes, timeout=timeout)
+        if node_rank < 0:
+            node_rank = self.store.add(f"launch/{self.job}/nodes", 1) - 1
+        self.node_rank = node_rank
+
+    def exchange_endpoints(self, gen: int, endpoints: list[str]) -> dict:
+        """Publish our endpoints, wait for all nodes, return
+        {node_rank: [endpoints]} (reference: build_pod master-KV sync)."""
+        key = f"launch/{self.job}/g{gen}/node/{self.node_rank}"
+        self.store.set(key, json.dumps(endpoints).encode())
+        peers = {}
+        for r in range(self.nnodes):
+            k = f"launch/{self.job}/g{gen}/node/{r}"
+            self.store.wait([k], timeout=self.timeout)
+            peers[r] = json.loads(self.store.get(k).decode())
+        return peers
+
+    def finish_barrier(self, nnodes: int):
+        """Hold the store host alive until every node's workers are done —
+        exiting early would tear the daemon out from under peers mid-
+        collective."""
+        self.store.add(f"launch/{self.job}/done", 1)
+        deadline = time.time() + self.timeout
+        while time.time() < deadline:
+            if self.store.add(f"launch/{self.job}/done", 0) >= nnodes:
+                return
+            time.sleep(0.2)
+
+    def restart_gen(self) -> int:
+        return self.store.add(f"launch/{self.job}/restart", 0)
+
+    def bump_restart(self) -> int:
+        return self.store.add(f"launch/{self.job}/restart", 1)
+
+    def regenerate(self, gen: int):
+        """Re-register for a restart generation: fresh contiguous node
+        ranks (a dead node leaves no hole) and a possibly-scaled node
+        count (reference: elastic manager scale-in/out :484-530). Returns
+        (gen_rank, gen_nnodes); gen_rank >= gen_nnodes means this node
+        was scaled in and should exit."""
+        nnodes = self.nnodes
+        if self.store.check("elastic/num_nodes"):
+            nnodes = int(self.store.get("elastic/num_nodes").decode())
+        rank = self.store.add(f"launch/{self.job}/g{gen}/nodes", 1) - 1
+        self.node_rank = rank
+        self.nnodes = nnodes
+        return rank, nnodes
+
+
+def _spawn_pod(args, node_rank, nproc, world, rank_base, master, endpoints,
+               gen):
+    """Start this node's worker processes with the launch env contract."""
+    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))))
+    extra_path = pkg_root + (os.pathsep + os.environ["PYTHONPATH"]
+                             if os.environ.get("PYTHONPATH") else "")
+    procs = []
+    os.makedirs(args.log_dir, exist_ok=True)
+    for local_rank in range(nproc):
+        rank = rank_base + local_rank
+        env = dict(os.environ)
+        env["PYTHONPATH"] = extra_path
+        env.update({
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(world),
+            "PADDLE_MASTER": master,
+            "PADDLE_TRAINER_ENDPOINTS": endpoints,
+            "PADDLE_LOCAL_RANK": str(local_rank),
+            "PADDLE_NODE_RANK": str(node_rank),
+            "PADDLE_RESTART_GEN": str(gen),
+            "FLAGS_selected_devices": str(local_rank),
+        })
+        if args.store_hosted:
+            env["PADDLE_STORE_HOSTED"] = "1"
+        if args.backend:
+            env["PADDLE_DIST_BACKEND"] = args.backend
+        log_file = os.path.join(args.log_dir, f"workerlog.{rank}")
+        with open(log_file, "ab") as lf:
+            p = subprocess.Popen(
+                [sys.executable, args.training_script]
+                + args.training_script_args,
+                env=env, stdout=lf if world > 1 else None,
+                stderr=subprocess.STDOUT if world > 1 else None)
+        procs.append(p)
+    return procs
+
+
+def _kill_pod(procs):
+    for p in procs:
+        if p.poll() is None:
+            p.terminate()
+    deadline = time.time() + 10
+    for p in procs:
+        while p.poll() is None and time.time() < deadline:
+            time.sleep(0.1)
+        if p.poll() is None:
+            p.kill()
 
 
 def launch(argv=None):
@@ -43,6 +200,8 @@ def launch(argv=None):
     parser.add_argument("--log_dir", type=str, default="log")
     parser.add_argument("--log_level", type=str, default="INFO")
     parser.add_argument("--max_restart", type=int, default=3)
+    parser.add_argument("--rdv_timeout", type=float, default=900.0,
+                        help="rendezvous/finish barrier wait (seconds)")
     parser.add_argument("--backend", type=str, default=None)
     parser.add_argument("training_script")
     parser.add_argument("training_script_args", nargs=argparse.REMAINDER)
@@ -50,75 +209,100 @@ def launch(argv=None):
 
     nnodes = int(str(args.nnodes).split(":")[0])
     nproc = args.nproc_per_node or 1
+
+    multi_node = nnodes > 1 or args.master is not None
+    args.store_hosted = multi_node
+    rdv = None
+    if multi_node:
+        master = args.master or f"127.0.0.1:{_free_port()}"
+        rdv = _Rendezvous(master, nnodes, args.job_id, args.rank,
+                          timeout=args.rdv_timeout)
+        node_rank = rdv.node_rank
+    else:
+        master = args.master or f"127.0.0.1:{_free_port()}"
+        node_rank = max(args.rank, 0)
+
     world = nnodes * nproc
+    procs: list = []
+    current_gen = rdv.restart_gen() if rdv else 0
+    restarts_used = 0
 
-    master = args.master or f"127.0.0.1:{_free_port()}"
-    node_rank = args.rank if args.rank >= 0 else 0
+    def _build_and_spawn(gen):
+        if rdv is not None:
+            if gen > 0:
+                # restart generation: re-register for fresh contiguous
+                # node ranks + possibly-scaled node count (dead/scaled-in
+                # nodes leave no hole in the new rendezvous)
+                gen_rank, gen_nnodes = rdv.regenerate(gen)
+                if gen_rank >= gen_nnodes:
+                    _kill_pod(procs)
+                    sys.exit(0)  # scaled in
+            ip = _advertise_ip(master.rsplit(":", 1)[0])
+            mine = [f"{ip}:{_free_port()}" for _ in range(nproc)]
+            peers = rdv.exchange_endpoints(gen, mine)
+            ordered = [ep for r in sorted(peers) for ep in peers[r]]
+            endpoints = ",".join(ordered)
+            gen_world = len(ordered)
+            rank_base = sum(len(peers[r]) for r in sorted(peers)
+                            if r < rdv.node_rank)
+            return _spawn_pod(args, rdv.node_rank, nproc, gen_world,
+                              rank_base, master, endpoints, gen)
+        endpoints = ",".join(
+            f"127.0.0.1:{_free_port()}" for _ in range(world))
+        rank_base = node_rank * nproc
+        return _spawn_pod(args, node_rank, nproc, world, rank_base, master,
+                          endpoints, gen)
 
-    os.makedirs(args.log_dir, exist_ok=True)
-    procs = []
-    endpoints = ",".join(
-        f"127.0.0.1:{_free_port()}" for _ in range(world))
-
-    # make paddle_tpu importable in workers regardless of their cwd
-    # (`python script.py` puts the script dir, not the launcher cwd, on
-    # sys.path)
-    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
-        os.path.dirname(os.path.abspath(__file__)))))
-    extra_path = pkg_root + (os.pathsep + os.environ["PYTHONPATH"]
-                             if os.environ.get("PYTHONPATH") else "")
-
-    for local_rank in range(nproc):
-        rank = node_rank * nproc + local_rank
-        env = dict(os.environ)
-        env["PYTHONPATH"] = extra_path
-        env.update({
-            "PADDLE_TRAINER_ID": str(rank),
-            "PADDLE_TRAINERS_NUM": str(world),
-            "PADDLE_MASTER": master,
-            "PADDLE_TRAINER_ENDPOINTS": endpoints,
-            "PADDLE_LOCAL_RANK": str(local_rank),
-            "FLAGS_selected_devices": str(local_rank),
-        })
-        if args.backend:
-            env["PADDLE_DIST_BACKEND"] = args.backend
-        log_file = os.path.join(args.log_dir,
-                                f"workerlog.{rank}")
-        with open(log_file, "ab") as lf:
-            p = subprocess.Popen(
-                [sys.executable, args.training_script]
-                + args.training_script_args,
-                env=env, stdout=lf if world > 1 else None,
-                stderr=subprocess.STDOUT if world > 1 else None)
-        procs.append(p)
+    procs = _build_and_spawn(current_gen)
 
     def _terminate(code=1, *_):
-        for p in procs:
-            if p.poll() is None:
-                p.terminate()
+        _kill_pod(procs)
         sys.exit(code if isinstance(code, int) and code else 1)
 
     signal.signal(signal.SIGINT, _terminate)
     signal.signal(signal.SIGTERM, _terminate)
 
     exit_code = 0
-    try:
-        while True:
-            alive = False
-            for p in procs:
-                ret = p.poll()
-                if ret is None:
-                    alive = True
-                elif ret != 0:
-                    exit_code = ret
-                    _terminate(ret)  # propagate the worker's exit code
-            if not alive:
-                break
-            time.sleep(0.2)
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                p.terminate()
+    while True:
+        time.sleep(0.2)
+        # cross-node restart signal (another node's worker died / elastic
+        # manager bumped the generation): kill + re-rendezvous
+        if rdv is not None:
+            gen = rdv.restart_gen()
+            if gen > current_gen:
+                if restarts_used >= args.max_restart:
+                    _kill_pod(procs)
+                    sys.exit(1)
+                restarts_used += 1
+                current_gen = gen
+                _kill_pod(procs)
+                procs = _build_and_spawn(current_gen)
+                continue
+
+        statuses = [p.poll() for p in procs]
+        failed = [r for r in statuses if r not in (None, 0)]
+        if failed:
+            if restarts_used < args.max_restart:
+                restarts_used += 1
+                _kill_pod(procs)
+                if rdv is not None:
+                    # take the max of our bump and the live counter so a
+                    # concurrent peer failure doesn't look like a *new*
+                    # generation next poll (one logical fault, one restart)
+                    current_gen = max(rdv.bump_restart(), rdv.restart_gen())
+                procs = _build_and_spawn(current_gen)
+                continue
+            exit_code = failed[0]
+            _kill_pod(procs)
+            break
+        if all(r == 0 for r in statuses):
+            break
+    if exit_code == 0 and rdv is not None:
+        # hold the (possibly hosted) store alive until all nodes finish
+        try:
+            rdv.finish_barrier(rdv.nnodes)
+        except Exception:
+            pass
     sys.exit(exit_code)
 
 

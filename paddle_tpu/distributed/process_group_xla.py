@@ -86,14 +86,17 @@ class ProcessGroupXLA(ProcessGroup):
         a = np.asarray(arr)[None]  # stack axis for the mesh dim
 
         def builder(mesh):
-            red = _LAX_REDUCE.get(op, jax.lax.psum)
-
             @jax.jit
             @functools.partial(
                 shard_map, mesh=mesh,
                 in_specs=shd.PartitionSpec("x"),
                 out_specs=shd.PartitionSpec("x"))
             def f(x):
+                if op == ReduceOp.PROD:
+                    # no pprod primitive: gather contributions, reduce local
+                    full = jax.lax.all_gather(x, "x", axis=0, tiled=True)
+                    return jnp.prod(full, axis=0, keepdims=True)
+                red = _LAX_REDUCE.get(op, jax.lax.psum)
                 r = red(x, "x")
                 if op == ReduceOp.AVG:
                     r = r / len(self._ranks)
@@ -101,7 +104,7 @@ class ProcessGroupXLA(ProcessGroup):
 
             return f
 
-        return self._run_collective("allreduce", a, builder)[0]
+        return self._run_collective(f"allreduce{int(op)}", a, builder)[0]
 
     def _broadcast_impl(self, arr, src):
         # src already translated to group-local by the base class
@@ -122,7 +125,7 @@ class ProcessGroupXLA(ProcessGroup):
 
             return f
 
-        return self._run_collective("broadcast", a, builder)[0]
+        return self._run_collective(f"broadcast{src_idx}", a, builder)[0]
 
     def _all_gather_impl(self, arr):
         a = np.asarray(arr)[None]

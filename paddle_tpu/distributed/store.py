@@ -16,7 +16,8 @@ import threading
 import time
 from typing import Dict, Optional
 
-__all__ = ["TCPStore", "MasterDaemon", "create_or_get_global_tcp_store"]
+__all__ = ["TCPStore", "MasterDaemon", "PrefixStore",
+           "create_or_get_global_tcp_store"]
 
 _OP_SET, _OP_GET, _OP_ADD, _OP_WAIT, _OP_CHECK, _OP_DEL = 0, 1, 2, 3, 4, 5
 
@@ -242,6 +243,42 @@ class TCPStore:
         self.wait([f"{prefix}/barrier_done"])
 
 
+class PrefixStore:
+    """Key-namespacing wrapper (reference: phi/core/distributed/store/
+    prefix_store). Used to scope worker keys by restart generation when the
+    store daemon outlives worker generations (multi-node launch): without
+    it, a restarted rank would consume the dead generation's barrier and
+    gather values."""
+
+    def __init__(self, prefix: str, store):
+        self._p = prefix
+        self._s = store
+
+    def _k(self, key: str) -> str:
+        return f"{self._p}{key}"
+
+    def set(self, key, value):
+        return self._s.set(self._k(key), value)
+
+    def get(self, key):
+        return self._s.get(self._k(key))
+
+    def add(self, key, delta):
+        return self._s.add(self._k(key), delta)
+
+    def wait(self, keys, timeout=None):
+        return self._s.wait([self._k(k) for k in keys], timeout)
+
+    def delete(self, key):
+        return self._s.delete(self._k(key))
+
+    def check(self, key):
+        return self._s.check(self._k(key))
+
+    def barrier(self, prefix, world_size, rank):
+        return self._s.barrier(self._k(prefix), world_size, rank)
+
+
 _global_store: Optional[TCPStore] = None
 
 
@@ -255,6 +292,16 @@ def create_or_get_global_tcp_store() -> TCPStore:
     host, port = ep.rsplit(":", 1)
     rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
     world = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
-    _global_store = TCPStore(host, int(port), is_master=(rank == 0),
+    # multi-node launch: the launcher already hosts the master daemon at
+    # PADDLE_MASTER (it needed it for rendezvous before any worker ran) —
+    # every worker, including global rank 0, connects as a client
+    hosted = os.environ.get("PADDLE_STORE_HOSTED") == "1"
+    _global_store = TCPStore(host, int(port),
+                             is_master=(rank == 0 and not hosted),
                              world_size=world)
+    if hosted:
+        # the launcher-hosted daemon outlives restart generations: scope
+        # every worker key by the generation so stale values are invisible
+        gen = os.environ.get("PADDLE_RESTART_GEN", "0")
+        _global_store = PrefixStore(f"wg{gen}/", _global_store)
     return _global_store

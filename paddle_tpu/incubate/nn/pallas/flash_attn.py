@@ -28,8 +28,8 @@ from jax.experimental import pallas as pl
 # scratch-shape constructors too)
 from jax.experimental.pallas import tpu as pltpu
 
-DEFAULT_BLOCK_Q = 128
-DEFAULT_BLOCK_K = 128
+DEFAULT_BLOCK_Q = 1024  # measured on v5e (tools/tune_flash_attn.py):
+DEFAULT_BLOCK_K = 1024  # 1024-blocks beat 128 by ~2.5x fwd+bwd
 _NEG_INF = -1e30
 _LANES = 128  # scratch minor dim: one full lane register row
 

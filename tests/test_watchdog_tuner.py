@@ -103,3 +103,23 @@ class TestElastic:
         assert "node1" in dead
         assert "node0" not in dead
         alive.stop()
+
+    def test_fault_triggers_relaunch_generation(self):
+        """enable_relaunch: a detected fault bumps the launcher restart
+        generation in the store (reference: manager.py:457-530)."""
+        from paddle_tpu.distributed.fleet.elastic import ElasticManager
+        from paddle_tpu.distributed.store import TCPStore
+
+        store = TCPStore("127.0.0.1", 0, is_master=True)
+        mgr = ElasticManager(store, "node0", 2, heartbeat_interval=0.1,
+                             timeout=0.4)
+        mgr.enable_relaunch(job_id="jobx")
+        mgr.register()
+        gen0 = store.add("launch/jobx/restart", 0)
+        mgr.watch(["node0", "nodeDEAD"])
+        deadline = time.time() + 5
+        while store.add("launch/jobx/restart", 0) == gen0 and \
+                time.time() < deadline:
+            time.sleep(0.1)
+        assert store.add("launch/jobx/restart", 0) > gen0
+        mgr.stop()
