@@ -304,9 +304,19 @@ def _run_backward(tensors, grad_tensors, retain_graph, capture=None,
         grads_map = out_grads.get(id(node))
         if grads_map is None:
             continue
+        def _match(ct, dtype):
+            # accumulated cotangents can arrive in a promoted dtype (e.g.
+            # f32 summed into a bf16 output under amp autocast): the vjp
+            # contract requires the exact output dtype
+            if isinstance(ct, Tensor):
+                return ct.astype(str(dtype)) if ct._data.dtype != dtype \
+                    else ct
+            return ct.astype(dtype) if ct.dtype != dtype else ct
+
         cotangents = tuple(
-            grads_map.get(i, Tensor(jnp.zeros(shape, dtype))
-                          if create_graph else jnp.zeros(shape, dtype))
+            _match(grads_map[i], dtype) if i in grads_map
+            else (Tensor(jnp.zeros(shape, dtype)) if create_graph
+                  else jnp.zeros(shape, dtype))
             for i, (shape, dtype) in enumerate(node.out_meta)
         )
         if node.vjp_fn is None:

@@ -58,6 +58,8 @@ from .auto_parallel.api import (  # noqa: F401
     shard_tensor,
     unshard_dtensor,
 )
+from .auto_parallel.api import to_static  # noqa: F401
+from .auto_parallel.engine import DistModel, Engine, Strategy  # noqa: F401
 from .auto_parallel.process_mesh import ProcessMesh  # noqa: F401
 from .auto_parallel.placement_type import (  # noqa: F401
     Partial,

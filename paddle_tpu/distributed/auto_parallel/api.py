@@ -368,9 +368,11 @@ def shard_dataloader(dataloader, meshes, input_keys=None, shard_dims=None,
 
 
 def to_static(layer, loader=None, loss=None, optimizer=None, strategy=None):
-    """DistModel bridge (reference: auto_parallel/api.py:2179) — round-1:
-    returns the layer wrapped by jit.to_static; full DistModel program
-    pipeline lands with the static engine."""
-    from ... import jit as pjit
+    """DistModel bridge (reference: auto_parallel/api.py:2179): returns a
+    DistModel whose __call__ runs the pass-composed (amp/recompute/
+    sharding/gradient-merge), mesh-partitioned compiled train step
+    (engine.py). With no optimizer it is a compiled predictor."""
+    from .engine import DistModel
 
-    return pjit.to_static(layer)
+    return DistModel(layer, loader=loader, loss=loss, optimizer=optimizer,
+                     strategy=strategy)

@@ -20,6 +20,51 @@ def get_hybrid_communicate_group() -> Optional[HybridCommunicateGroup]:
     return _hcg
 
 
+def _apply_strategy_to_model(model, strategy):
+    """Materialize DistributedStrategy model-side knobs (reference:
+    distributed_strategy.py:284 — amp/recompute configs that the static
+    engine applies as passes; here they transform the dygraph model).
+
+    - ``strategy.recompute``: each sublayer named in
+      ``recompute_configs["checkpoints"]`` gets its forward routed through
+      the recompute engine (rematerialized in backward).
+    - ``strategy.amp``: the model forward runs under ``amp.auto_cast`` at
+      O2 when pure fp16/bf16 is configured, else O1.
+    """
+    if strategy is None:
+        return model
+    if getattr(strategy, "recompute", False):
+        from .recompute import recompute as _rc
+
+        ckpts = set(strategy.recompute_configs.get("checkpoints") or [])
+        for name, sub in model.named_sublayers():
+            if name in ckpts and not getattr(sub, "_fleet_recompute", False):
+                orig = sub.forward
+
+                def wrapped(*a, __orig=orig, **kw):
+                    return _rc(__orig, *a, **kw)
+
+                sub.forward = wrapped
+                sub._fleet_recompute = True
+    if getattr(strategy, "amp", False):
+        from ...amp import auto_cast
+
+        cfg = strategy.amp_configs or {}
+        pure = cfg.get("use_pure_fp16") or cfg.get("use_pure_bf16")
+        dtype = "bfloat16" if cfg.get("use_pure_bf16") else "float16"
+        level = "O2" if pure else "O1"
+        if not getattr(model, "_fleet_amp", False):
+            orig_fwd = model.forward
+
+            def amp_fwd(*a, __orig=orig_fwd, **kw):
+                with auto_cast(True, level=level, dtype=dtype):
+                    return __orig(*a, **kw)
+
+            model.forward = amp_fwd
+            model._fleet_amp = True
+    return model
+
+
 class Fleet:
     def __init__(self):
         self._is_collective = True
@@ -31,6 +76,18 @@ class Fleet:
         global _hcg
         from ..parallel_env import ParallelEnv, init_parallel_env
 
+        self._role_maker = role_maker
+        self._ps_runtime = None
+        if role_maker is not None and not is_collective:
+            # PS mode: accept the role maker so PS-style scripts role-detect
+            # and reach the runtime boundary, where they fail with guidance
+            # (collective-first design, SURVEY §2.4.17; ps/__init__.py)
+            from ..ps import TheOnePSRuntime
+
+            self._is_collective = False
+            self._strategy = strategy or DistributedStrategy()
+            self._ps_runtime = TheOnePSRuntime(role_maker)
+            return self
         self._is_collective = is_collective
         self._strategy = strategy or DistributedStrategy()
         env = ParallelEnv()
@@ -39,6 +96,36 @@ class Fleet:
         self._init_hybrid_parallel_env()
         _hcg = self._hcg
         return self
+
+    # ---- PS-mode surface (stubs with guidance; reference fleet.py
+    # is_server/init_server/run_server/init_worker/stop_worker) ----
+    def is_server(self) -> bool:
+        rm = getattr(self, "_role_maker", None)
+        return bool(rm and rm.is_server())
+
+    def is_worker(self) -> bool:
+        rm = getattr(self, "_role_maker", None)
+        return rm.is_worker() if rm else True
+
+    def _ps(self):
+        from ..ps import PSGuidanceError, TheOnePSRuntime
+
+        rt = getattr(self, "_ps_runtime", None)
+        if rt is None:
+            raise PSGuidanceError("PS runtime (fleet.init was collective)")
+        return rt
+
+    def init_server(self, *a, **k):
+        return self._ps().init_server(*a, **k)
+
+    def run_server(self, *a, **k):
+        return self._ps().run_server(*a, **k)
+
+    def init_worker(self, *a, **k):
+        return self._ps().init_worker(*a, **k)
+
+    def stop_worker(self, *a, **k):
+        return self._ps().stop_worker(*a, **k)
 
     def _init_hybrid_parallel_env(self):
         """reference: fleet.py:674-737."""
@@ -99,12 +186,15 @@ class Fleet:
         barrier()
 
     def distributed_model(self, model):
-        """reference: fleet/model.py:32 — wrap by parallel mode."""
+        """reference: fleet/model.py:32 — wrap by parallel mode; strategy
+        transforms (recompute/amp per DistributedStrategy, reference
+        distributed_strategy.py:284) apply first."""
         from .meta_parallel import (PipelineParallel, ShardingParallel,
                                     TensorParallel)
         from .topology import ParallelMode
         from ..parallel import DataParallel
 
+        model = _apply_strategy_to_model(model, self._strategy)
         if self._hcg is None:
             return model
         mode = self._hcg.get_parallel_mode()
@@ -122,13 +212,39 @@ class Fleet:
         return model
 
     def distributed_optimizer(self, optimizer, strategy=None):
-        """reference: fleet/optimizer.py:68."""
+        """reference: fleet/optimizer.py:68. ``strategy.sharding`` with
+        ``sharding_configs={"stage": 2}`` wraps the optimizer with the
+        GroupSharded stage-2 optimizer over the sharding group (stage 3
+        also reshards parameters — use
+        ``paddle.distributed.sharding.group_sharded_parallel``, which
+        needs the model)."""
         from .hybrid_parallel_optimizer import HybridParallelOptimizer
 
+        strategy = strategy or self._strategy
         if self._hcg is None:
             return optimizer
-        return HybridParallelOptimizer(optimizer, self._hcg,
-                                       self._strategy)
+        if strategy is not None and getattr(strategy, "sharding", False):
+            stage = int(strategy.sharding_configs.get("stage", 1))
+            if stage == 2 and getattr(strategy, "gradient_merge", False):
+                # stage-2 reduces grads via per-backward hooks; with
+                # clear_grad deferred mid-merge each micro-step would
+                # re-reduce (and re-average) the accumulated grad —
+                # silently wrong. Use stage 1 or TrainStep accumulate_steps.
+                raise ValueError(
+                    "gradient_merge cannot compose with sharding stage 2 "
+                    "(hook-based reduction re-reduces accumulated grads); "
+                    "use sharding stage 1 or the compiled "
+                    "TrainStep(accumulate_steps=k) path")
+            if stage == 2 and \
+                    self._hcg.get_sharding_parallel_world_size() > 1:
+                from .sharding_optimizer import GroupShardedOptimizerStage2
+
+                optimizer = GroupShardedOptimizerStage2(
+                    list(optimizer._parameter_list), optimizer,
+                    group=self._hcg.get_sharding_parallel_group())
+                return HybridParallelOptimizer(optimizer, self._hcg,
+                                               strategy)
+        return HybridParallelOptimizer(optimizer, self._hcg, strategy)
 
     # state io passthroughs
     def save(self, *args, **kwargs):

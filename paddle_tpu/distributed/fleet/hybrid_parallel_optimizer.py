@@ -18,6 +18,24 @@ class HybridParallelOptimizer:
         self._hcg = hcg
         self._strategy = strategy
         self._sharding_enable = hcg.get_sharding_parallel_world_size() > 1
+        # gradient merge (reference: distributed_strategy.py gradient_merge
+        # configs): apply the update every k_steps; in-between steps keep
+        # accumulating grads (clear_grad is deferred to the apply step)
+        self._gm_k = 1
+        self._gm_avg = True
+        if strategy is not None and getattr(strategy, "gradient_merge",
+                                            False):
+            self._gm_k = int(
+                strategy.gradient_merge_configs.get("k_steps", 1))
+            self._gm_avg = bool(
+                strategy.gradient_merge_configs.get("avg", True))
+        self._gm_count = 0
+        # snapshot the FULL param list now: a sharding wrapper later
+        # replaces _parameter_list with the local shard, but the merge
+        # average must scale every param's grad on every rank (peer
+        # contributions are reduced to owners before the local update)
+        self._gm_params = list(getattr(optimizer, "_parameter_list",
+                                       None) or [])
         # wrap global-norm clip with the cross-group norm reduction
         clip = getattr(optimizer, "_grad_clip", None)
         if isinstance(clip, ClipGradByGlobalNorm):
@@ -27,6 +45,16 @@ class HybridParallelOptimizer:
         return getattr(self._inner_opt, item)
 
     def step(self):
+        if self._gm_k > 1:
+            self._gm_count += 1
+            if self._gm_count % self._gm_k:
+                return  # accumulate; user's clear_grad is deferred too
+            if self._gm_avg:
+                # reference gradient_merge avg=True (default): the applied
+                # gradient is the microbatch MEAN, not the k-step sum
+                for p in self._gm_params:
+                    if p.grad is not None:
+                        p.grad.scale_(1.0 / self._gm_k)
         if self._sharding_enable:
             from .sharding_optimizer import DygraphShardingOptimizer
 
@@ -38,6 +66,8 @@ class HybridParallelOptimizer:
         self._inner_opt.step()
 
     def clear_grad(self, set_to_zero=False):
+        if self._gm_k > 1 and self._gm_count % self._gm_k:
+            return  # mid-merge: keep accumulated grads
         self._inner_opt.clear_grad(set_to_zero)
 
     clear_gradients = clear_grad

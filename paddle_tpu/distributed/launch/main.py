@@ -55,6 +55,14 @@ def _advertise_ip(master_host: str) -> str:
         return socket.gethostbyname(socket.gethostname())
 
 
+class GenerationChanged(Exception):
+    """A newer restart generation superseded the one being rendezvoused."""
+
+    def __init__(self, gen: int):
+        super().__init__(f"superseded by generation {gen}")
+        self.gen = gen
+
+
 class _Rendezvous:
     """Store-backed node rendezvous + restart-generation channel."""
 
@@ -93,26 +101,38 @@ class _Rendezvous:
 
     def exchange_endpoints(self, gen: int, endpoints: list[str]) -> dict:
         """Publish our endpoints, wait for all nodes, return
-        {node_rank: [endpoints]} (reference: build_pod master-KV sync)."""
+        {node_rank: [endpoints]} (reference: build_pod master-KV sync).
+
+        Waits in short slices and aborts with :class:`GenerationChanged`
+        if the restart counter moves past ``gen`` — two nodes failing
+        concurrently would otherwise rendezvous under different
+        generations and deadlock until the full timeout."""
         key = f"launch/{self.job}/g{gen}/node/{self.node_rank}"
         self.store.set(key, json.dumps(endpoints).encode())
         peers = {}
+        deadline = time.time() + self.timeout
         for r in range(self.nnodes):
             k = f"launch/{self.job}/g{gen}/node/{r}"
-            self.store.wait([k], timeout=self.timeout)
+            while True:
+                if self.store.check(k):
+                    break
+                cur = self.restart_gen()
+                if cur > gen:
+                    raise GenerationChanged(cur)
+                if time.time() > deadline:
+                    raise TimeoutError(
+                        f"rendezvous g{gen}: node {r} never published")
+                time.sleep(0.2)
             peers[r] = json.loads(self.store.get(k).decode())
         return peers
 
-    def finish_barrier(self, nnodes: int):
-        """Hold the store host alive until every node's workers are done —
-        exiting early would tear the daemon out from under peers mid-
-        collective."""
-        self.store.add(f"launch/{self.job}/done", 1)
-        deadline = time.time() + self.timeout
-        while time.time() < deadline:
-            if self.store.add(f"launch/{self.job}/done", 0) >= nnodes:
-                return
-            time.sleep(0.2)
+    def mark_done(self, gen: int) -> int:
+        """Count this node's workers as finished for generation ``gen``
+        (generation-scoped so a restart starts the count afresh)."""
+        return self.store.add(f"launch/{self.job}/g{gen}/done", 1)
+
+    def finish_done_count(self, gen: int) -> int:
+        return self.store.add(f"launch/{self.job}/g{gen}/done", 0)
 
     def restart_gen(self) -> int:
         return self.store.add(f"launch/{self.job}/restart", 0)
@@ -253,7 +273,16 @@ def launch(argv=None):
         return _spawn_pod(args, node_rank, nproc, world, rank_base, master,
                           endpoints, gen)
 
-    procs = _build_and_spawn(current_gen)
+    def _spawn_gen(gen):
+        """Rendezvous+spawn, following generation bumps that land while we
+        wait (one logical fault = one restart, however many nodes bump)."""
+        while True:
+            try:
+                return gen, _build_and_spawn(gen)
+            except GenerationChanged as e:
+                gen = e.gen
+
+    current_gen, procs = _spawn_gen(current_gen)
 
     def _terminate(code=1, *_):
         _kill_pod(procs)
@@ -263,46 +292,81 @@ def launch(argv=None):
     signal.signal(signal.SIGTERM, _terminate)
 
     exit_code = 0
-    while True:
-        time.sleep(0.2)
-        # cross-node restart signal (another node's worker died / elastic
-        # manager bumped the generation): kill + re-rendezvous
-        if rdv is not None:
-            gen = rdv.restart_gen()
-            if gen > current_gen:
-                if restarts_used >= args.max_restart:
+    local_done = False
+    done_marked = False
+    done_deadline = None
+    try:
+        while True:
+            time.sleep(0.2)
+            # cross-node restart signal (another node's worker died /
+            # elastic manager bumped the generation): kill + re-rendezvous.
+            # A node whose own workers already finished STAYS in this loop
+            # until every node is done, so it rejoins a restart generation
+            # instead of deadlocking peers (pod-restart semantics: the
+            # whole job re-runs, as in the reference --max_restart policy).
+            if rdv is not None:
+                gen = rdv.restart_gen()
+                if gen > current_gen:
+                    if restarts_used >= args.max_restart:
+                        sys.exit(1)
+                    restarts_used += 1
                     _kill_pod(procs)
-                    sys.exit(1)
-                restarts_used += 1
-                current_gen = gen
-                _kill_pod(procs)
-                procs = _build_and_spawn(current_gen)
+                    current_gen, procs = _spawn_gen(gen)
+                    local_done = done_marked = False
+                    continue
+
+            if local_done:
+                if rdv.finish_done_count(current_gen) >= rdv.nnodes:
+                    break
+                if time.time() > done_deadline:
+                    # a peer died without marking done: our work succeeded,
+                    # don't hang forever (bounded by --rdv_timeout)
+                    break
                 continue
 
-        statuses = [p.poll() for p in procs]
-        failed = [r for r in statuses if r not in (None, 0)]
-        if failed:
-            if restarts_used < args.max_restart:
-                restarts_used += 1
-                _kill_pod(procs)
+            statuses = [p.poll() for p in procs]
+            failed = [r for r in statuses if r not in (None, 0)]
+            if failed:
+                if restarts_used < args.max_restart:
+                    restarts_used += 1
+                    _kill_pod(procs)
+                    if rdv is not None:
+                        # take the max of our bump and the live counter so
+                        # a concurrent peer failure doesn't look like a
+                        # *new* generation next poll (one fault, one
+                        # restart)
+                        current_gen = max(rdv.bump_restart(),
+                                          rdv.restart_gen())
+                        current_gen, procs = _spawn_gen(current_gen)
+                    else:
+                        procs = _build_and_spawn(current_gen)
+                    continue
+                exit_code = failed[0]
                 if rdv is not None:
-                    # take the max of our bump and the live counter so a
-                    # concurrent peer failure doesn't look like a *new*
-                    # generation next poll (one logical fault, one restart)
-                    current_gen = max(rdv.bump_restart(), rdv.restart_gen())
-                procs = _build_and_spawn(current_gen)
-                continue
-            exit_code = failed[0]
-            _kill_pod(procs)
-            break
-        if all(r == 0 for r in statuses):
-            break
-    if exit_code == 0 and rdv is not None:
-        # hold the (possibly hosted) store alive until all nodes finish
-        try:
-            rdv.finish_barrier(rdv.nnodes)
-        except Exception:
-            pass
+                    # signal peers: their pods must not wait forever on a
+                    # dead member — the bump makes them restart and, once
+                    # their own budget is exhausted, exit too
+                    try:
+                        rdv.bump_restart()
+                    except Exception:
+                        pass
+                break
+            if all(r == 0 for r in statuses):
+                if rdv is None:
+                    break
+                if not done_marked:
+                    rdv.mark_done(current_gen)
+                    done_marked = True
+                local_done = True
+                done_deadline = time.time() + rdv.timeout
+    except SystemExit:
+        raise
+    except Exception:
+        # a dead store / broken rendezvous must not orphan the pod
+        exit_code = exit_code or 1
+        raise
+    finally:
+        _kill_pod(procs)
     sys.exit(exit_code)
 
 

@@ -1,0 +1,171 @@
+"""Parameter-Server API surface — collective-first stubs (SURVEY §2.4.17;
+reference: python/paddle/distributed/ps/the_one_ps.py, fleet role makers
+python/paddle/distributed/fleet/base/role_maker.py).
+
+Design decision (SURVEY-sanctioned): this TPU-native framework is
+collective-first — dense training scales via GSPMD/ICI collectives, and
+the brpc/rocksdb PS transport is intentionally not ported. This package
+keeps the reference's PS-mode *API shape* so PS-style user code imports,
+role-detects, and fails at the server boundary with actionable guidance
+instead of AttributeError.
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+__all__ = ["Role", "RoleMakerBase", "PaddleCloudRoleMaker",
+           "UserDefinedRoleMaker", "TheOnePSRuntime", "Table", "Accessor",
+           "PSGuidanceError"]
+
+_GUIDE = (
+    "parameter-server mode is not supported by this TPU-native framework: "
+    "the PS transport (brpc/rocksdb tables, reference "
+    "fluid/distributed/ps/) is replaced by the collective-first design — "
+    "dense parameters scale with sharding/GSPMD over ICI (see "
+    "paddle_tpu.distributed.fleet and paddle_tpu.distributed.sharding). "
+    "Migrate: fleet.init(is_collective=True); for huge embeddings use "
+    "sharded embedding tables over the 'mp' mesh axis."
+)
+
+
+class PSGuidanceError(NotImplementedError):
+    """Raised by every PS-runtime entry point, with migration guidance."""
+
+    def __init__(self, what: str):
+        super().__init__(f"{what}: {_GUIDE}")
+
+
+class Role:
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
+
+
+class RoleMakerBase:
+    """reference: fleet/base/role_maker.py RoleMakerBase."""
+
+    def __init__(self):
+        self._role = Role.WORKER
+        self._current_id = 0
+        self._worker_num = 1
+        self._server_num = 0
+
+    def is_worker(self) -> bool:
+        return self._role == Role.WORKER
+
+    def is_server(self) -> bool:
+        return self._role == Role.SERVER
+
+    def is_first_worker(self) -> bool:
+        return self.is_worker() and self._current_id == 0
+
+    def worker_index(self) -> int:
+        return self._current_id
+
+    def server_index(self) -> int:
+        return self._current_id
+
+    def worker_num(self) -> int:
+        return self._worker_num
+
+    def server_num(self) -> int:
+        return self._server_num
+
+    def get_trainer_endpoints(self) -> List[str]:
+        return os.environ.get("PADDLE_TRAINER_ENDPOINTS", "").split(",")
+
+    def get_pserver_endpoints(self) -> List[str]:
+        return os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST", "").split(",")
+
+
+class PaddleCloudRoleMaker(RoleMakerBase):
+    """Role detection from the reference's env contract
+    (TRAINING_ROLE / PADDLE_PORT / PADDLE_TRAINERS_NUM...)."""
+
+    def __init__(self, is_collective: bool = False, **kwargs):
+        super().__init__()
+        self._is_collective = is_collective
+        role = os.environ.get("TRAINING_ROLE", "TRAINER").upper()
+        self._role = Role.SERVER if role == "PSERVER" else Role.WORKER
+        self._current_id = int(os.environ.get(
+            "PADDLE_TRAINER_ID" if self._role == Role.WORKER
+            else "PADDLE_PSERVER_ID", "0"))
+        self._worker_num = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+        eps = os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST", "")
+        self._server_num = len([e for e in eps.split(",") if e])
+
+
+class UserDefinedRoleMaker(RoleMakerBase):
+    def __init__(self, current_id=0, role=Role.WORKER, worker_num=1,
+                 server_endpoints: Optional[List[str]] = None, **kwargs):
+        super().__init__()
+        self._current_id = current_id
+        self._role = role
+        self._worker_num = worker_num
+        self._server_eps = server_endpoints or []
+        self._server_num = len(self._server_eps)
+
+    def get_pserver_endpoints(self):
+        return self._server_eps
+
+
+class Accessor:
+    """Table accessor schema stub (reference: the_one_ps.py Accessor)."""
+
+    def __init__(self):
+        self.accessor_class = ""
+        self.optimizer = None
+        self.feature_dim = 0
+        self.embedding_dim = 0
+
+
+class Table:
+    """PS table stub (reference: the_one_ps.py Table): holds schema only;
+    any data-plane call raises with guidance."""
+
+    def __init__(self):
+        self.id = -1
+        self.table_class = ""
+        self.shard_num = -1
+        self.accessor = Accessor()
+
+    def pull(self, *a, **k):
+        raise PSGuidanceError("Table.pull")
+
+    def push(self, *a, **k):
+        raise PSGuidanceError("Table.push")
+
+
+class TheOnePSRuntime:
+    """reference: the_one_ps.py TheOnePSRuntime — every runtime entry
+    raises PSGuidanceError so PS training scripts fail fast with a
+    migration path rather than deep in missing attributes."""
+
+    def __init__(self, role_maker=None):
+        self.role_maker = role_maker or PaddleCloudRoleMaker()
+        self.tables: List[Table] = []
+
+    def _init_server(self, *a, **k):
+        raise PSGuidanceError("init_server")
+
+    init_server = _init_server
+
+    def _run_server(self, *a, **k):
+        raise PSGuidanceError("run_server")
+
+    run_server = _run_server
+
+    def _init_worker(self, *a, **k):
+        raise PSGuidanceError("init_worker")
+
+    init_worker = _init_worker
+
+    def _stop_worker(self, *a, **k):
+        raise PSGuidanceError("stop_worker")
+
+    stop_worker = _stop_worker
+
+    def save_persistables(self, *a, **k):
+        raise PSGuidanceError("save_persistables (PS mode)")

@@ -38,6 +38,15 @@ def _interpret_default() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def _fit_block(block: int, seq: int) -> int:
+    """Largest block <= requested that divides seq (callers guarantee
+    seq % 128 == 0, so halving from 1024 always terminates >= 128)."""
+    block = min(block, seq)
+    while seq % block:
+        block //= 2
+    return max(block, 1)
+
+
 # ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
@@ -106,9 +115,8 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
     """q/k/v: [bh, s, d] -> (out [bh, s, d], lse [bh, s] f32)."""
     bh, sq, d = q.shape
     sk = k.shape[1]
-    block_q = min(block_q, sq)
-    block_k = min(block_k, sk)
-    assert sq % block_q == 0 and sk % block_k == 0, (sq, sk, block_q, block_k)
+    block_q = _fit_block(block_q, sq)
+    block_k = _fit_block(block_k, sk)
     nq, nk = sq // block_q, sk // block_k
 
     grid = (bh, nq, nk)
@@ -272,8 +280,8 @@ def _flash_bwd(q, k, v, out, lse, do, causal, scale, block_q, block_k,
                interpret):
     bh, sq, d = q.shape
     sk = k.shape[1]
-    block_q = min(block_q, sq)
-    block_k = min(block_k, sk)
+    block_q = _fit_block(block_q, sq)
+    block_k = _fit_block(block_k, sk)
     nq, nk = sq // block_q, sk // block_k
 
     # delta = rowsum(do * o): cheap XLA reduction, feeds both kernels

@@ -73,6 +73,10 @@ class StaticFunction:
             self._layer = fn
             self._fn = fn.forward
         self._pure_cache = {}
+        # graph-break state (SOT-equivalent fallback, reference jit/sot/:
+        # bytecode-level breaks; here the whole call degrades to eager)
+        self._fallback_eager = False
+        self._fallback_reason: Optional[str] = None
         functools.update_wrapper(self, self._fn)
 
     def __get__(self, instance, owner):
@@ -136,8 +140,33 @@ class StaticFunction:
         return pure
 
     def __call__(self, *args, **kwargs):
-        if not _to_static_enabled:
+        if not _to_static_enabled or self._fallback_eager:
             return self._fn(*args, **kwargs)
+        try:
+            return self._traced_call(*args, **kwargs)
+        except (jax.errors.ConcretizationTypeError,
+                jax.errors.TracerArrayConversionError,
+                jax.errors.TracerIntegerConversionError) as e:
+            # graph break: the function inspected a traced value from
+            # Python (data-dependent `if`/`int()`/`.numpy()`), which the
+            # trace cannot capture (reference: SOT's graph-break-and-
+            # fallback, jit/sot/opcode_translator). Degrade this
+            # StaticFunction to eager permanently — correct, just uncompiled
+            # — and tell the user how to keep it compiled.
+            import warnings
+
+            self._fallback_eager = True
+            self._fallback_reason = str(e).split("\n", 1)[0]
+            warnings.warn(
+                "paddle.jit.to_static: graph break — falling back to eager "
+                f"for {getattr(self._fn, '__qualname__', self._fn)}: "
+                f"{self._fallback_reason}. Use paddle_tpu.static.nn.cond "
+                "(differentiable lax control flow; while_loop for "
+                "non-differentiated loops) to keep data-dependent branches "
+                "inside the compiled program.", stacklevel=2)
+            return self._fn(*args, **kwargs)
+
+    def _traced_call(self, *args, **kwargs):
         params, buffers = self._collect_state()
         in_leaves, in_treedef = jax.tree_util.tree_flatten(
             args, is_leaf=lambda x: isinstance(x, Tensor))

@@ -61,11 +61,16 @@ class TrainStep:
                  batch_specs: Optional[Sequence] = None,
                  grad_clip_norm: Optional[float] = None,
                  fsdp_axis: Optional[str] = None,
+                 accumulate_steps: int = 1,
                  donate: bool = True):
         self.model = model
         self.optimizer = optimizer
         self.loss_fn = loss_fn
         self.grad_clip_norm = grad_clip_norm
+        # gradient merge (reference: auto_parallel gradient_merge pass /
+        # fleet accumulate_steps): micro-batches scan INSIDE the compiled
+        # step, grads average, one optimizer update
+        self.accumulate_steps = max(int(accumulate_steps), 1)
         self._names = [n for n, _ in model.named_parameters()]
         self._params = [p for _, p in model.named_parameters()]
         self._trainable = [not p.stop_gradient for p in self._params]
@@ -134,7 +139,9 @@ class TrainStep:
 
         process_mesh = self._process_mesh
 
-        def pure_step(key, lr, param_arrays, opt_state, *batch):
+        accumulate = self.accumulate_steps
+
+        def fwd_bwd(key, param_arrays, *batch):
             from ..distributed.auto_parallel.process_mesh import get_mesh, set_mesh
 
             saved = [p._data for p in params]
@@ -165,6 +172,35 @@ class TrainStep:
             grad_arrays = [
                 g._data if g is not None else jnp.zeros_like(a)
                 for g, a in zip(grads, param_arrays)]
+            return loss._data, grad_arrays
+
+        def pure_step(key, lr, param_arrays, opt_state, *batch):
+            if accumulate > 1:
+                # gradient-merge pass: scan micro-batch slices, average
+                keys = jax.random.split(key, accumulate)
+                chunks = tuple(
+                    b.reshape((accumulate, b.shape[0] // accumulate)
+                              + b.shape[1:]) for b in batch)
+
+                def micro(carry, xs):
+                    g_acc, l_acc = carry
+                    k_i = xs[0]
+                    mb = xs[1:]
+                    l, gs = fwd_bwd(k_i, param_arrays, *mb)
+                    return ([a + g for a, g in zip(g_acc, gs)],
+                            l_acc + l), None
+
+                # fp32 accumulators: k successive bf16 adds would round
+                # away low-order gradient bits before the /k average
+                init = ([jnp.zeros_like(a, dtype=jnp.float32)
+                         for a in param_arrays],
+                        jnp.zeros((), jnp.float32))
+                (g_sum, l_sum), _ = jax.lax.scan(
+                    micro, init, (keys,) + chunks)
+                grad_arrays = [g / accumulate for g in g_sum]
+                loss_val = (l_sum / accumulate).astype(jnp.float32)
+            else:
+                loss_val, grad_arrays = fwd_bwd(key, param_arrays, *batch)
             if clip is not None:
                 gnorm = jnp.sqrt(sum(jnp.sum(
                     jnp.square(g.astype(jnp.float32))) for g in grad_arrays))
@@ -175,7 +211,7 @@ class TrainStep:
             # frozen params pass through unchanged
             new_params = [np_ if t else a for np_, a, t in
                           zip(new_params, param_arrays, trainable)]
-            return loss._data, tuple(new_params), new_state
+            return loss_val, tuple(new_params), new_state
 
         kwargs = {}
         if donate:
@@ -217,6 +253,13 @@ class TrainStep:
     def _prepare_batch(self, batch):
         arrays = tuple(b._data if isinstance(b, Tensor) else jnp.asarray(b)
                        for b in batch)
+        if self.accumulate_steps > 1:
+            for a in arrays:
+                if a.ndim and a.shape[0] % self.accumulate_steps:
+                    raise ValueError(
+                        f"gradient merge: batch dim {a.shape[0]} is not "
+                        f"divisible by accumulate_steps="
+                        f"{self.accumulate_steps}")
         if self._mesh is not None and self._batch_specs is not None:
             arrays = tuple(
                 jax.device_put(a, NamedSharding(

@@ -301,11 +301,15 @@ class GPTBlock(nn.Layer):
             is_moe = isinstance(self.mlp, GPTMoEMLP)
 
             def fn(xa, *pa):
+                from ..incubate.nn.functional.flash_attention import (
+                    _entering_recompute)
+
                 saved = [p._data for p in params]
                 for p, a in zip(params, pa):
                     p._data = a
                 try:
-                    out = self._body(Tensor(xa, stop_gradient=False))
+                    with _entering_recompute():
+                        out = self._body(Tensor(xa, stop_gradient=False))
                 finally:
                     for p, a in zip(params, saved):
                         p._data = a

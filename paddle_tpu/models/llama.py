@@ -186,12 +186,16 @@ class LlamaBlock(nn.Layer):
             params = [p for _, p in self.named_parameters()]
 
             def fn(xa, *pa):
+                from ..incubate.nn.functional.flash_attention import (
+                    _entering_recompute)
+
                 saved = [p._data for p in params]
                 for p, a in zip(params, pa):
                     p._data = a
                 try:
-                    out = self._body(Tensor(xa, stop_gradient=False),
-                                     position_ids=position_ids)
+                    with _entering_recompute():
+                        out = self._body(Tensor(xa, stop_gradient=False),
+                                         position_ids=position_ids)
                 finally:
                     for p, a in zip(params, saved):
                         p._data = a

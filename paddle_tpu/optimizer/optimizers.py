@@ -66,12 +66,17 @@ class Adam(Optimizer):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, parameters=None, weight_decay=None,
                  grad_clip=None, lazy_mode=False, multi_precision=False,
-                 use_multi_tensor=False, name=None):
+                 use_multi_tensor=False, moment_dtype="float32", name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip,
                          name)
         self._beta1 = beta1
         self._beta2 = beta2
         self._epsilon = epsilon
+        # moment storage dtype applies to the FIRST moment only: bf16's
+        # ~0.4% ulp cannot represent a beta2=0.999 decay step (0.1%), so a
+        # bf16 second moment would ratchet up after gradient spikes and
+        # never decay — v always stays fp32; update math runs in fp32
+        self._moment_dtype = jnp.dtype(moment_dtype)
 
     def _append_optimize_op(self, p, grad):
         grad = self._decayed(p, grad)
@@ -100,28 +105,29 @@ class Adam(Optimizer):
         p._data = p32.astype(p._data.dtype)
 
     def init_state(self, params):
-        f32 = jnp.float32
+        md = getattr(self, "_moment_dtype", jnp.float32)
         return {
-            "m": [jnp.zeros_like(p, dtype=f32) for p in params],
-            "v": [jnp.zeros_like(p, dtype=f32) for p in params],
-            "t": jnp.zeros((), f32),
+            "m": [jnp.zeros_like(p, dtype=md) for p in params],
+            "v": [jnp.zeros_like(p, dtype=jnp.float32) for p in params],
+            "t": jnp.zeros((), jnp.float32),
         }
 
     def update(self, params, grads, state, lr=None):
         lr = lr if lr is not None else self.get_lr()
         wd = self._weight_decay or 0.0
         f32 = jnp.float32
+        md = getattr(self, "_moment_dtype", jnp.float32)
         t = state["t"] + 1
         nm, nv, np_ = [], [], []
         b1, b2, eps = self._beta1, self._beta2, self._epsilon
         for p, g, m, v in zip(params, grads, state["m"], state["v"]):
             g32 = g.astype(f32) + wd * p.astype(f32)
-            m = b1 * m + (1 - b1) * g32
-            v = b2 * v + (1 - b2) * g32 * g32
+            m = b1 * m.astype(f32) + (1 - b1) * g32
+            v = b2 * v.astype(f32) + (1 - b2) * g32 * g32
             mhat = m / (1 - b1 ** t)
             vhat = v / (1 - b2 ** t)
             out = p.astype(f32) - lr * mhat / (jnp.sqrt(vhat) + eps)
-            nm.append(m)
+            nm.append(m.astype(md))
             nv.append(v)
             np_.append(out.astype(p.dtype))
         return np_, {"m": nm, "v": nv, "t": t}
@@ -133,9 +139,11 @@ class AdamW(Adam):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, parameters=None, weight_decay=0.01,
                  lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
-                 lazy_mode=False, multi_precision=False, name=None):
+                 lazy_mode=False, multi_precision=False,
+                 moment_dtype="float32", name=None):
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
-                         None, grad_clip, lazy_mode, multi_precision)
+                         None, grad_clip, lazy_mode, multi_precision,
+                         moment_dtype=moment_dtype)
         self._coeff = float(weight_decay) if not hasattr(weight_decay, "_coeff") \
             else float(weight_decay._coeff)
         self._apply_decay_param_fun = apply_decay_param_fun
@@ -151,18 +159,19 @@ class AdamW(Adam):
     def update(self, params, grads, state, lr=None):
         lr = lr if lr is not None else self.get_lr()
         f32 = jnp.float32
+        md = getattr(self, "_moment_dtype", jnp.float32)
         t = state["t"] + 1
         nm, nv, np_ = [], [], []
         b1, b2, eps = self._beta1, self._beta2, self._epsilon
         for p, g, m, v in zip(params, grads, state["m"], state["v"]):
             g32 = g.astype(f32)
-            m = b1 * m + (1 - b1) * g32
-            v = b2 * v + (1 - b2) * g32 * g32
+            m = b1 * m.astype(f32) + (1 - b1) * g32
+            v = b2 * v.astype(f32) + (1 - b2) * g32 * g32
             mhat = m / (1 - b1 ** t)
             vhat = v / (1 - b2 ** t)
             p32 = p.astype(f32) * (1 - lr * self._coeff)
             out = p32 - lr * mhat / (jnp.sqrt(vhat) + eps)
-            nm.append(m)
+            nm.append(m.astype(md))
             nv.append(v)
             np_.append(out.astype(p.dtype))
         return np_, {"m": nm, "v": nv, "t": t}

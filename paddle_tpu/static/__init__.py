@@ -337,7 +337,16 @@ def load_inference_model(path_prefix: str, executor=None):
 
 
 class _StaticNN:
-    """paddle.static.nn minimal surface (fc/batch_norm map onto nn.* )."""
+    """paddle.static.nn minimal surface (fc/batch_norm map onto nn.*;
+    control flow — cond/while_loop/case/switch_case — lowers to lax,
+    static/nn.py)."""
+
+    from .nn import case, cond, switch_case, while_loop
+
+    cond = staticmethod(cond)
+    while_loop = staticmethod(while_loop)
+    case = staticmethod(case)
+    switch_case = staticmethod(switch_case)
 
     @staticmethod
     def fc(x, size, num_flatten_dims=1, activation=None, name=None):

@@ -1,0 +1,183 @@
+"""Static-graph control flow (reference: python/paddle/static/nn/
+control_flow.py — cond, while_loop, case, switch_case).
+
+TPU-native: these lower to ``lax.cond`` / ``lax.while_loop`` so
+data-dependent control flow stays INSIDE the compiled program (the jit
+analog of the reference's conditional_block / while ops). Under eager they
+still work — lax primitives execute immediately on concrete arrays.
+Differentiable through the tape via ``run_op`` (jax.vjp supplies the
+cond/scan transpose rules).
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..ops._helpers import as_tensor, run_op, unwrap
+
+__all__ = ["cond", "while_loop", "case", "switch_case"]
+
+
+def _tensorize(xs):
+    return [x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+            for x in xs]
+
+
+def _is_tracing(*tensors) -> bool:
+    import jax.core as jcore
+
+    return any(isinstance(unwrap(as_tensor(t)), jcore.Tracer)
+               for t in tensors if t is not None)
+
+
+def cond(pred, true_fn: Callable, false_fn: Callable, operands=None,
+         name=None):
+    """reference: static/nn/control_flow.py cond. Both branches must
+    return structures of matching shapes/dtypes (lax.cond contract —
+    same as the reference's requirement that both branches produce
+    matching out vars).
+
+    Eager (dygraph) semantics match the reference: the predicate is
+    concrete, so the chosen branch simply executes on the tape
+    (differentiable through the taken branch). Under tracing (to_static /
+    TrainStep) it lowers to ``lax.cond`` so the branch stays inside the
+    compiled program."""
+    operands = _tensorize(operands or [])
+    p = as_tensor(pred)
+    if not _is_tracing(p, *operands):
+        taken = true_fn if bool(unwrap(p).reshape(())) else false_fn
+        return taken(*operands) if operands else taken()
+
+    def fn(pa, *ops):
+        def wrap(branch):
+            def inner(arrs):
+                outs = branch(*[Tensor(a) for a in arrs]) if arrs else \
+                    branch()
+                leaves, treedef = jax.tree_util.tree_flatten(
+                    outs, is_leaf=lambda x: isinstance(x, Tensor))
+                fn._treedef = treedef
+                return tuple(o._data if isinstance(o, Tensor)
+                             else jnp.asarray(o) for o in leaves)
+            return inner
+
+        flag = jnp.reshape(pa.astype(jnp.bool_), ())
+        return jax.lax.cond(flag, wrap(true_fn), wrap(false_fn),
+                            tuple(ops))
+
+    outs = run_op(fn, [p] + operands, name="cond")
+    if not isinstance(outs, (list, tuple)):
+        outs = (outs,)
+    treedef = getattr(fn, "_treedef", None)
+    if treedef is not None:
+        return jax.tree_util.tree_unflatten(treedef, list(outs))
+    return outs[0] if len(outs) == 1 else outs
+
+
+def while_loop(cond_fn: Callable, body_fn: Callable, loop_vars: Sequence,
+               is_test=False, name=None):
+    """reference: static/nn/control_flow.py while_loop. Shapes must be
+    loop-invariant (lax.while_loop contract; the reference requires the
+    same of its while op's block outputs).
+
+    NOT reverse-differentiable under tracing: lax.while_loop has no
+    transpose rule, so traced outputs are detached (eager python-loop mode
+    stays fully on the tape). Use ``cond``/``lax.scan``-style ops when the
+    loop must carry gradients through a compiled program."""
+    loop_vars = _tensorize(list(loop_vars))
+    if not _is_tracing(*loop_vars):
+        # dygraph semantics (reference: while_loop under dynamic mode is a
+        # plain python loop — fully on the eager tape)
+        vals = list(loop_vars)
+        while bool(unwrap(as_tensor(cond_fn(*vals))).reshape(())):
+            outs = body_fn(*vals)
+            vals = list(outs) if isinstance(outs, (list, tuple)) else [outs]
+            vals = _tensorize(vals)
+        return vals
+
+    def fn(*arrs):
+        def c(vals):
+            out = cond_fn(*[Tensor(v) for v in vals])
+            return jnp.reshape(unwrap(as_tensor(out)).astype(jnp.bool_), ())
+
+        def b(vals):
+            outs = body_fn(*[Tensor(v) for v in vals])
+            if not isinstance(outs, (list, tuple)):
+                outs = (outs,)
+            return tuple(unwrap(as_tensor(o)) for o in outs)
+
+        return jax.lax.while_loop(c, b, tuple(arrs))
+
+    # detach: no vjp is recorded (while_loop is not reverse-differentiable)
+    detached = []
+    for t in loop_vars:
+        d = Tensor(t._data)
+        d.stop_gradient = True
+        detached.append(d)
+    outs = run_op(fn, detached, name="while_loop")
+    if not isinstance(outs, (list, tuple)):
+        outs = (outs,)
+    return list(outs)
+
+
+def case(pred_fn_pairs: List, default: Callable = None, name=None):
+    """reference: control_flow.py case — first true pred wins."""
+    if not pred_fn_pairs:
+        raise ValueError("case needs at least one (pred, fn) pair")
+    pred, fn = pred_fn_pairs[0]
+    rest = pred_fn_pairs[1:]
+    if not rest:
+        if default is None:
+            return cond(pred, fn, fn)
+        return cond(pred, fn, default)
+    return cond(pred, fn, lambda: case(rest, default))
+
+
+def switch_case(branch_index, branch_fns, default: Callable = None,
+                name=None):
+    """reference: control_flow.py switch_case."""
+    if isinstance(branch_fns, dict):
+        items = sorted(branch_fns.items())
+    else:
+        items = list(enumerate(branch_fns))
+    idx = as_tensor(branch_index)
+    fns = [fn for _, fn in items]
+    keys = [k for k, _ in items]
+    if not _is_tracing(idx):
+        iv = int(unwrap(idx).reshape(()))
+        for k, f in items:
+            if iv == k:
+                return f()
+        if default is not None:
+            return default()
+        return fns[-1]()
+
+    def fn(ia):
+        def _run_branch(f):
+            outs = f()
+            leaves, treedef = jax.tree_util.tree_flatten(
+                outs, is_leaf=lambda x: isinstance(x, Tensor))
+            fn._treedef = treedef
+            return tuple(o._data if isinstance(o, Tensor)
+                         else jnp.asarray(o) for o in leaves)
+
+        branches = [lambda _, f=f: _run_branch(f) for f in fns]
+        if default is not None:
+            branches.append(lambda _, f=default: _run_branch(f))
+        # map branch_index -> position (unknown index = last branch when a
+        # default exists, else clamp to the last listed branch)
+        pos = jnp.full((), len(branches) - 1, jnp.int32)
+        iv = jnp.reshape(ia.astype(jnp.int32), ())
+        for j, k in enumerate(keys):
+            pos = jnp.where(iv == k, j, pos)
+        return jax.lax.switch(pos, branches, None)
+
+    outs = run_op(fn, [idx], name="switch_case")
+    if not isinstance(outs, (list, tuple)):
+        outs = (outs,)
+    treedef = getattr(fn, "_treedef", None)
+    if treedef is not None:
+        return jax.tree_util.tree_unflatten(treedef, list(outs))
+    return outs[0] if len(outs) == 1 else outs

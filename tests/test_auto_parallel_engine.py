@@ -1,0 +1,134 @@
+"""Static auto-parallel Engine: pass-composed distributed training
+(VERDICT r1 next #3; reference: auto_parallel/static/engine.py:98,
+DistModel api.py:2179, passes distributed/passes/auto_parallel_*)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import nn
+
+
+def _llama_bits():
+    from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+
+    return LlamaForCausalLM, llama_tiny
+
+
+def _batches(vocab, n=6, b=4, s=32, seed=0):
+    rng = np.random.RandomState(seed)
+    return [(rng.randint(0, vocab, (b, s)).astype(np.int32),
+             rng.randint(0, vocab, (b, s)).astype(np.int32))
+            for _ in range(n)]
+
+
+def test_engine_fit_llama_matches_dygraph_trainstep():
+    """Llama-tiny via Engine.fit on the 8-dev mesh == plain TrainStep
+    (same seed/data): the pass pipeline must not change the math when no
+    pass is enabled."""
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    from paddle_tpu.distributed import Engine, ProcessMesh
+    from paddle_tpu.jit import TrainStep
+
+    LlamaForCausalLM, llama_tiny = _llama_bits()
+    mesh = ProcessMesh(np.arange(8).reshape(2, 2, 2),
+                       dim_names=["dp", "sp", "mp"])
+    data = _batches(1024)
+
+    # dygraph-style compiled baseline
+    pt.seed(77)
+    m1 = LlamaForCausalLM(llama_tiny())
+    o1 = pt.optimizer.AdamW(learning_rate=3e-3, parameters=m1.parameters())
+    step = TrainStep(m1, o1, mesh=mesh)
+    base_losses = [float(step(ids, lab)) for ids, lab in data]
+
+    # engine path (no passes enabled -> identical math)
+    pt.seed(77)
+    m2 = LlamaForCausalLM(llama_tiny())
+    o2 = pt.optimizer.AdamW(learning_rate=3e-3, parameters=m2.parameters())
+    eng = Engine(model=m2, optimizer=o2, mesh=mesh)
+    hist = eng.fit(data, epochs=1)
+    np.testing.assert_allclose(hist["loss"], base_losses, rtol=2e-2,
+                               atol=2e-2)
+    # loss falls
+    assert hist["loss"][-1] < hist["loss"][0]
+
+
+def test_engine_passes_compose():
+    """amp + recompute + sharding + gradient-merge enabled together: the
+    engine still trains (loss falls) on the 8-dev mesh and the merge pass
+    changes step granularity."""
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    from paddle_tpu.distributed import Engine, ProcessMesh, Strategy
+
+    LlamaForCausalLM, llama_tiny = _llama_bits()
+    mesh = ProcessMesh(np.arange(8).reshape(2, 2, 2),
+                       dim_names=["dp", "sp", "mp"])
+    st = Strategy()
+    st.amp.enable = True
+    st.amp.dtype = "bfloat16"
+    st.recompute.enable = True
+    st.sharding.enable = True
+    st.sharding.stage = 3
+    st.gradient_merge.enable = True
+    st.gradient_merge.k_steps = 2
+
+    pt.seed(5)
+    cfg = llama_tiny()
+    model = LlamaForCausalLM(cfg)
+    opt = pt.optimizer.AdamW(learning_rate=3e-3,
+                             parameters=model.parameters())
+    eng = Engine(model=model, optimizer=opt, strategy=st, mesh=mesh)
+    data = _batches(1024, n=6, seed=3)
+    hist = eng.fit(data, epochs=1)
+    assert len(hist["loss"]) == 6
+    assert hist["loss"][-1] < hist["loss"][0], hist["loss"]
+    # recompute pass actually flipped the model config
+    assert cfg.recompute is True
+    # sharding stage-3: params sharded over dp (fsdp axis applied)
+    assert eng._step._fsdp_axis == "dp"
+    # gradient-merge pass: micro-batch scan inside the compiled step
+    assert eng._step.accumulate_steps == 2
+
+
+def test_dist_model_to_static_bridge():
+    """paddle.distributed.to_static returns a DistModel that trains in
+    'train' mode and predicts without grads in 'predict' mode."""
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed import ProcessMesh
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.lin = nn.Linear(16, 16)
+            self.head = nn.Linear(16, 4)
+
+        def forward(self, x, labels=None):
+            from paddle_tpu.nn import functional as F
+            out = self.head(F.relu(self.lin(x)))
+            if labels is not None:
+                return ((out - labels) ** 2).mean()
+            return out
+
+    pt.seed(3)
+    net = Net()
+    opt = pt.optimizer.SGD(parameters=net.parameters(), learning_rate=0.1)
+    dm = dist.to_static(net, optimizer=opt)
+    dm.train()
+    rng = np.random.RandomState(0)
+    x = rng.randn(8, 16).astype(np.float32)
+    y = rng.randn(8, 4).astype(np.float32)
+    losses = [float(np.asarray(dm(x, y)._data)) for _ in range(5)]
+    assert losses[-1] < losses[0], losses
+    dm.predict()
+    out = dm(x)
+    assert tuple(out.shape) == (8, 4)

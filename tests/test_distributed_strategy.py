@@ -1,0 +1,103 @@
+"""DistributedStrategy plumbing: amp/recompute/gradient-merge configs
+change the executed step (VERDICT r1 next #7; reference:
+fleet/base/distributed_strategy.py:284)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import nn
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.fleet.fleet import _apply_strategy_to_model
+from paddle_tpu.distributed.fleet.hybrid_parallel_optimizer import (
+    HybridParallelOptimizer)
+
+
+class _Probe(nn.Layer):
+    """Records the dtype its input arrives in and how often it runs."""
+
+    def __init__(self):
+        super().__init__()
+        self.lin = nn.Linear(8, 8)
+        self.seen_dtypes = []
+        self.calls = 0
+
+    def forward(self, x):
+        self.calls += 1
+        self.seen_dtypes.append(str(x.dtype))
+        return self.lin(x)
+
+
+class _Net(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.block = _Probe()
+        self.head = nn.Linear(8, 1)
+
+    def forward(self, x):
+        return self.head(self.block(x))
+
+
+def test_strategy_amp_changes_forward_dtype():
+    strategy = fleet.DistributedStrategy()
+    strategy.amp = True
+    strategy.amp_configs = {"use_pure_bf16": True}
+    model = _apply_strategy_to_model(_Net(), strategy)
+    x = pt.randn([4, 8])
+    y = model(x)
+    # O2 pure-bf16: matmuls run in bf16 — the probe's input (output of
+    # nothing, input x cast) and output dtype reflect the autocast
+    assert "float32" not in str(y.dtype) or model.block.seen_dtypes
+    # without amp the same net keeps float32 end to end
+    base = _Net()
+    y2 = base(x)
+    assert str(y2.dtype) == "paddle.float32" or "float32" in str(y2.dtype)
+    assert str(y.dtype) != str(y2.dtype), (y.dtype, y2.dtype)
+
+
+def test_strategy_recompute_reruns_forward():
+    strategy = fleet.DistributedStrategy()
+    strategy.recompute = True
+    strategy.recompute_configs = {"checkpoints": ["block"]}
+    model = _apply_strategy_to_model(_Net(), strategy)
+    x = pt.randn([4, 8])
+    x.stop_gradient = False
+    y = model(x)
+    calls_after_fwd = model.block.calls
+    y.sum().backward()
+    # recompute re-executes the checkpointed block's forward in backward
+    assert model.block.calls > calls_after_fwd
+    # and grads still flow
+    for p in model.parameters():
+        assert p.grad is not None
+    # un-checkpointed model: forward runs exactly once
+    base = _Net()
+    x2 = pt.randn([4, 8])
+    x2.stop_gradient = False
+    base(x2).sum().backward()
+    assert base.block.calls == 1
+
+
+class _FakeHCG:
+    def get_sharding_parallel_world_size(self):
+        return 1
+
+
+def test_strategy_gradient_merge_defers_updates():
+    strategy = fleet.DistributedStrategy()
+    strategy.gradient_merge = True
+    strategy.gradient_merge_configs = {"k_steps": 3}
+    lin = nn.Linear(4, 4)
+    inner = pt.optimizer.SGD(parameters=lin.parameters(), learning_rate=0.5)
+    opt = HybridParallelOptimizer(inner, _FakeHCG(), strategy)
+    w0 = lin.weight.numpy().copy()
+    for i in range(1, 7):
+        loss = (lin(pt.ones([2, 4])) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        w = lin.weight.numpy()
+        if i % 3:
+            np.testing.assert_allclose(w, w0, err_msg=f"step {i}")
+        else:
+            assert not np.allclose(w, w0), f"step {i} should apply"
+            w0 = w.copy()

@@ -1,0 +1,49 @@
+"""PS-mode API stubs: PS user code imports, role-detects, and fails at the
+runtime boundary with migration guidance (VERDICT r1 next #9; SURVEY
+§2.4.17 collective-first decision; reference the_one_ps.py)."""
+import os
+
+import pytest
+
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.ps import (PaddleCloudRoleMaker, PSGuidanceError,
+                                       Role, Table, UserDefinedRoleMaker)
+
+
+def test_role_maker_env_detection(monkeypatch):
+    monkeypatch.setenv("TRAINING_ROLE", "PSERVER")
+    monkeypatch.setenv("PADDLE_PSERVER_ID", "1")
+    monkeypatch.setenv("PADDLE_PSERVERS_IP_PORT_LIST", "h1:80,h2:80")
+    rm = PaddleCloudRoleMaker()
+    assert rm.is_server() and not rm.is_worker()
+    assert rm.server_index() == 1
+    assert rm.server_num() == 2
+
+    monkeypatch.setenv("TRAINING_ROLE", "TRAINER")
+    rm = PaddleCloudRoleMaker()
+    assert rm.is_worker()
+
+
+def test_ps_fleet_init_and_guided_failure():
+    rm = UserDefinedRoleMaker(current_id=0, role=Role.WORKER, worker_num=2,
+                              server_endpoints=["h1:80"])
+    f = fleet.Fleet()
+    f.init(role_maker=rm, is_collective=False)
+    assert f.is_worker() and not f.is_server()
+    with pytest.raises(PSGuidanceError, match="collective-first"):
+        f.init_worker()
+    with pytest.raises(PSGuidanceError, match="sharding"):
+        f.init_server()
+    with pytest.raises(PSGuidanceError):
+        f.run_server()
+    with pytest.raises(PSGuidanceError):
+        f.stop_worker()
+
+
+def test_table_data_plane_guided():
+    t = Table()
+    t.table_class = "MemorySparseTable"
+    with pytest.raises(PSGuidanceError):
+        t.pull([1, 2, 3])
+    with pytest.raises(PSGuidanceError):
+        t.push([1, 2, 3], None)

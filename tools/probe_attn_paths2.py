@@ -1,0 +1,110 @@
+#!/usr/bin/env python
+"""Round 2: qkv-fused attention layout variants, fwd+bwd, bench shapes."""
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    b, s, nh, hd = 64, 512, 12, 64
+    hsz = nh * hd
+    dt = jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
+    rng = np.random.default_rng(0)
+    wqkv = jnp.asarray(rng.standard_normal((hsz, 3 * hsz)) * 0.02, dt)
+    x0 = jnp.asarray(rng.standard_normal((b, s, hsz)), dt)
+    iters = 8
+    mask = None
+
+    def bench(fn, tag):
+        g = jax.grad(fn, argnums=(0, 1))
+
+        def step(carry):
+            x, acc = carry
+            gx, gw = g(x, wqkv)
+            return x - 0.0 * gx, acc + gw.astype(jnp.float32).sum()
+
+        def multi(carry):
+            def body(c, _):
+                return step(c), None
+            out, _ = jax.lax.scan(body, carry, None, length=iters)
+            return out
+
+        f = jax.jit(multi, donate_argnums=0)
+        try:
+            out = f((x0 + 0, jnp.float32(0)))
+            float(np.asarray(out[1]))
+            t0 = time.perf_counter()
+            out = f(out)
+            float(np.asarray(out[1]))
+            ms = (time.perf_counter() - t0) / iters * 1000
+            print(json.dumps({"config": tag, "ms": round(ms, 2)}), flush=True)
+        except Exception as e:
+            print(json.dumps({"config": tag, "error": str(e)[:160]}),
+                  flush=True)
+
+    def causal_mask():
+        return jnp.tril(jnp.ones((s, s), bool))
+
+    # A. current: slice axis2 + swapaxes + f32 logits
+    def variant_a(x, w):
+        qkv = jnp.matmul(x, w).reshape(b, s, 3, nh, hd)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        qh = jnp.swapaxes(q, 1, 2)
+        kh = jnp.swapaxes(k, 1, 2)
+        vh = jnp.swapaxes(v, 1, 2)
+        logits = jnp.einsum("bhqd,bhkd->bhqk", qh, kh,
+                            preferred_element_type=jnp.float32) * (hd ** -0.5)
+        logits = jnp.where(causal_mask(), logits, -1e30)
+        wts = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
+        out = jnp.einsum("bhqk,bhkd->bhqd", wts, vh)
+        return jnp.swapaxes(out, 1, 2).astype(jnp.float32).sum()
+
+    bench(variant_a, "A_slice_swap_f32logits")
+
+    # B. no swapaxes: einsum folds layout; bf16 logits
+    def variant_b(x, w):
+        qkv = jnp.matmul(x, w).reshape(b, s, 3, nh, hd)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * (hd ** -0.5)
+        logits = jnp.where(causal_mask(), logits, jnp.asarray(-1e9, dt))
+        wts = jax.nn.softmax(logits, axis=-1)
+        out = jnp.einsum("bhqk,bkhd->bqhd", wts, v)
+        return out.astype(jnp.float32).sum()
+
+    bench(variant_b, "B_noswap_bf16logits")
+
+    # C. no swapaxes, f32 logits
+    def variant_c(x, w):
+        qkv = jnp.matmul(x, w).reshape(b, s, 3, nh, hd)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                            preferred_element_type=jnp.float32) * (hd ** -0.5)
+        logits = jnp.where(causal_mask(), logits, -1e30)
+        wts = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
+        out = jnp.einsum("bhqk,bkhd->bqhd", wts, v)
+        return out.astype(jnp.float32).sum()
+
+    bench(variant_c, "C_noswap_f32logits")
+
+    # D. split(-1) instead of middle-axis slice, no swap, bf16
+    def variant_d(x, w):
+        qkv = jnp.matmul(x, w)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, s, nh, hd)
+        k = k.reshape(b, s, nh, hd)
+        v = v.reshape(b, s, nh, hd)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * (hd ** -0.5)
+        logits = jnp.where(causal_mask(), logits, jnp.asarray(-1e9, dt))
+        wts = jax.nn.softmax(logits, axis=-1)
+        out = jnp.einsum("bhqk,bkhd->bqhd", wts, v)
+        return out.astype(jnp.float32).sum()
+
+    bench(variant_d, "D_split_noswap_bf16")
+
+
+if __name__ == "__main__":
+    main()
