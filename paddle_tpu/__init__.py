@@ -72,6 +72,7 @@ from . import audio  # noqa: F401,E402
 from . import version  # noqa: F401,E402
 from . import device  # noqa: F401,E402
 from . import models  # noqa: F401,E402
+from . import utils  # noqa: F401,E402
 from .framework.io_utils import load, save  # noqa: F401,E402
 from .framework import (  # noqa: F401,E402
     get_default_dtype,

@@ -46,9 +46,94 @@ _FLAGS = {
     "FLAGS_benchmark": False,
     "FLAGS_eager_delete_tensor_gb": 0.0,
 }
+
+# The remainder of the reference's exported-flag surface
+# (paddle/common/flags.cc, ~185 PHI_DEFINE_EXPORTED_*). Grouped by
+# relevance on TPU: "active" flags are read by this codebase; the rest are
+# accepted (set_flags/get_flags/FLAGS_* env) so reference scripts that
+# tune them keep running, and their values are visible to tooling.
+_FLAGS.update({
+    # numerics / debugging
+    "FLAGS_low_precision_op_list": 0,
+    "FLAGS_use_autotune": False,
+    "FLAGS_use_fast_math": False,
+    "FLAGS_sort_sum_gradient": False,
+    "FLAGS_accuracy_check_atol_fp32": 1e-6,
+    "FLAGS_accuracy_check_rtol_fp32": 1e-6,
+    "FLAGS_accuracy_check_atol_fp16": 1e-3,
+    "FLAGS_accuracy_check_rtol_fp16": 1e-3,
+    "FLAGS_accuracy_check_atol_bf16": 1e-2,
+    "FLAGS_accuracy_check_rtol_bf16": 1e-2,
+    # executor / compiler (CINN role is played by XLA)
+    "FLAGS_use_cinn": False,
+    "FLAGS_allow_cinn_ops": "",
+    "FLAGS_deny_cinn_ops": "",
+    "FLAGS_enable_pir_api": True,
+    "FLAGS_enable_pir_in_executor": True,
+    "FLAGS_pir_apply_inplace_pass": 1,
+    "FLAGS_jit_engine_type": "xla",
+    "FLAGS_print_ir": False,
+    "FLAGS_enable_cse_in_dy2st": False,
+    # memory
+    "FLAGS_fraction_of_cpu_memory_to_use": 1.0,
+    "FLAGS_initial_cpu_memory_in_mb": 500,
+    "FLAGS_alloc_fill_value": -1,
+    "FLAGS_enable_record_memory": False,
+    "FLAGS_use_shm_cache": False,
+    "FLAGS_dataloader_use_file_descriptor": False,
+    # distributed / comm
+    "FLAGS_nccl_blocking_wait": False,
+    "FLAGS_benchmark_nccl": False,
+    "FLAGS_enable_async_trace": False,
+    "FLAGS_async_trace_count": 5,
+    "FLAGS_dynamic_static_unified_comm": True,
+    "FLAGS_eager_communication_connection": False,
+    "FLAGS_dist_threadpool_size": 0,
+    "FLAGS_communicator_max_merge_var_num": 20,
+    "FLAGS_communicator_send_queue_size": 20,
+    "FLAGS_enable_auto_parallel_align_mode": False,
+    # profiling / tracing
+    "FLAGS_host_trace_level": 1,
+    # threading
+    "FLAGS_inner_op_parallelism": 0,
+    "FLAGS_paddle_num_threads": 1,
+    # conv/cudnn-era knobs accepted for script compat (no-op on TPU)
+    "FLAGS_conv_workspace_size_limit": 512,
+    "FLAGS_cudnn_exhaustive_search": False,
+    "FLAGS_cudnn_exhaustive_search_times": -1,
+    "FLAGS_cudnn_batchnorm_spatial_persistent": False,
+    "FLAGS_conv2d_disable_cudnn": False,
+    "FLAGS_enable_cudnn_frontend": False,
+    "FLAGS_gemm_use_half_precision_compute_type": False,
+    # dataloader / misc
+    "FLAGS_set_to_1d": True,
+    "FLAGS_search_cache_max_number": 1000000,
+    "FLAGS_tensor_operants_mode": "eager",
+    "FLAGS_use_mkldnn": False,
+    "FLAGS_fused_multi_transformer_op_use_mbfmha": False,
+    "FLAGS_multi_block_attention_min_partition_size": 512,
+})
+def _coerce_flag(default, raw: str):
+    """Env values arrive as strings: coerce by the default's type so
+    FLAGS_use_fast_math=0 means False, not the truthy string '0'."""
+    if isinstance(default, bool):
+        return raw.strip().lower() not in ("0", "false", "off", "")
+    if isinstance(default, int) and not isinstance(default, bool):
+        try:
+            return int(raw)
+        except ValueError:
+            return default
+    if isinstance(default, float):
+        try:
+            return float(raw)
+        except ValueError:
+            return default
+    return raw
+
+
 for _k in list(_FLAGS):
     if _k in os.environ:
-        _FLAGS[_k] = os.environ[_k]
+        _FLAGS[_k] = _coerce_flag(_FLAGS[_k], os.environ[_k])
 
 
 def set_flags(flags: dict):

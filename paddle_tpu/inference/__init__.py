@@ -70,16 +70,41 @@ class Tensor:
 class Predictor:
     """reference: AnalysisPredictor (analysis_predictor.h:105)."""
 
-    def __init__(self, config: Config):
+    def __init__(self, config: Optional[Config] = None, _model=None):
+        self._model = _model
+        self._output_vals: List[np.ndarray] = []
+        self._output_handles: Dict[str, Tensor] = {}
+        if _model is not None:
+            self._prog = None
+            self._inputs = {}
+            return
         from ..static import load_inference_model
 
-        if not config.model_prefix:
+        if not config or not config.model_prefix:
             raise ValueError("Config has no model path")
         prog, feed_names, fetches = load_inference_model(config.model_prefix)
         self._prog = prog
         self._inputs = {n: Tensor(n) for n in feed_names}
-        self._output_vals: List[np.ndarray] = []
-        self._output_handles: Dict[str, Tensor] = {}
+
+    @classmethod
+    def from_model(cls, model) -> "Predictor":
+        """Serving predictor over a live CausalLM: run() does a compiled
+        forward; generate() runs the fused decode path (the
+        fused_multi_transformer-class serving story, models/generation.py)."""
+        return cls(_model=model)
+
+    def generate(self, input_ids, max_new_tokens=32, temperature=0.0,
+                 top_p=None, eos_token_id=None) -> np.ndarray:
+        if self._model is None:
+            raise RuntimeError(
+                "generate() needs a model-backed predictor: use "
+                "Predictor.from_model(model); saved-program predictors "
+                "expose run() only")
+        out = self._model.generate(
+            input_ids, max_new_tokens=max_new_tokens,
+            temperature=temperature, top_p=top_p,
+            eos_token_id=eos_token_id)
+        return np.asarray(out.numpy())
 
     def get_input_names(self) -> List[str]:
         return list(self._inputs.keys())
@@ -88,6 +113,24 @@ class Predictor:
         return self._inputs[name]
 
     def run(self, inputs: Optional[List[np.ndarray]] = None):
+        if self._model is not None:
+            if inputs is None:
+                raise RuntimeError(
+                    "model-backed predictors take run(inputs=[...]) — the "
+                    "named-handle API needs a saved program's feed names")
+            from ..core.autograd import no_grad
+            from ..core.tensor import Tensor as _T
+
+            with no_grad():
+                out = self._model(*[_T(jnp.asarray(a)) for a in inputs])
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            self._output_vals = [np.asarray(o.numpy()) for o in outs]
+            self._output_handles = {}
+            for i, v in enumerate(self._output_vals):
+                h = Tensor(f"fetch_{i}")
+                h.copy_from_cpu(v)
+                self._output_handles[h.name] = h
+            return self._output_vals
         if inputs is not None:
             for h, arr in zip(self._inputs.values(), inputs):
                 h.copy_from_cpu(arr)

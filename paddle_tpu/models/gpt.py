@@ -405,6 +405,17 @@ class GPTForCausalLM(nn.Layer):
             return logits, new_caches
         return logits
 
+    def generate(self, input_ids, max_new_tokens=32, temperature=0.0,
+                 top_p=None, eos_token_id=None):
+        """Fully-compiled autoregressive decoding (fused decode path,
+        models/generation.py — the fused_multi_transformer/masked-MHA
+        serving analog). Returns new token ids [b, max_new_tokens]."""
+        from .generation import generate as _gen
+
+        return _gen(self, input_ids, max_new_tokens=max_new_tokens,
+                    temperature=temperature, top_p=top_p,
+                    eos_token_id=eos_token_id)
+
     def init_caches(self, batch_size: int):
         from ..ops.creation import zeros
 

@@ -137,14 +137,38 @@ class Optimizer:
         if "LR_Scheduler" in state_dict and isinstance(self._learning_rate,
                                                        LRScheduler):
             self._learning_rate.set_state_dict(state_dict["LR_Scheduler"])
+        # saved names may not match this process's auto-generated param
+        # names (a fresh model continues the global name counter). Only
+        # when name matching fails WHOLESALE fall back to positional
+        # mapping (state-dict key order preserves the saving optimizer's
+        # parameter order); mixing the two could cross-load moments on a
+        # partial name overlap.
+        saved_order: List[str] = []
+        for key in state_dict:
+            if key in ("global_step", "LR_Scheduler"):
+                continue
+            pname = key.rsplit(".", 1)[0]
+            if pname not in saved_order:
+                saved_order.append(pname)
+        any_name_match = any(n in id2name for n in saved_order)
+        by_pos = {}
+        if not any_name_match and self._parameter_list and \
+                len(saved_order) == len(self._parameter_list):
+            by_pos = dict(zip(saved_order, self._parameter_list))
         for key, val in state_dict.items():
             if key in ("global_step", "LR_Scheduler"):
                 continue
             pname, accname = key.rsplit(".", 1)
             p = id2name.get(pname)
             if p is None:
+                p = by_pos.get(pname)
+            if p is None:
                 continue
             arr = val._data if isinstance(val, Tensor) else jnp.asarray(val)
+            if tuple(arr.shape) not in ((), tuple(p.shape)):
+                raise ValueError(
+                    f"optimizer state '{key}' shape {tuple(arr.shape)} "
+                    f"does not match parameter shape {tuple(p.shape)}")
             self._accumulators.setdefault(accname, {})[id(p)] = arr
 
     # ----------------------------------------------------------- functional

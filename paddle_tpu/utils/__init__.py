@@ -1,0 +1,22 @@
+"""paddle.utils (reference: python/paddle/utils/ — cpp_extension custom-op
+loading, deprecated-decorator, install checks)."""
+from __future__ import annotations
+
+from . import cpp_extension  # noqa: F401
+from .custom_op import register_op  # noqa: F401
+
+__all__ = ["register_op", "cpp_extension", "run_check"]
+
+
+def run_check():
+    """reference: paddle.utils.run_check — sanity-check the install."""
+    import jax
+    import numpy as np
+
+    import paddle_tpu as pt
+
+    x = pt.to_tensor(np.ones((2, 2), np.float32))
+    y = (x @ x).sum()
+    assert float(y.numpy()) == 8.0
+    dev = jax.devices()[0]
+    print(f"paddle_tpu is installed successfully! device: {dev}")

@@ -1,0 +1,43 @@
+"""paddle.utils.cpp_extension surface (reference:
+python/paddle/utils/cpp_extension/ — setup/load/CppExtension/
+CUDAExtension building custom C++/CUDA operators).
+
+TPU-native guidance: CUDA sources cannot target TPUs. Out-of-tree ops are
+registered as jax/Pallas functions via
+:func:`paddle_tpu.utils.register_op` (same capability as PD_BUILD_OP:
+custom forward + custom backward, eager + jit + grad); host-side native
+code plugs in through the ctypes tier (paddle_tpu/core/native.py, see
+native/ for the in-tree examples).
+"""
+from __future__ import annotations
+
+__all__ = ["load", "setup", "CppExtension", "CUDAExtension"]
+
+_MSG = (
+    "is not supported on the TPU backend: CUDA/C++ kernel sources cannot "
+    "target TPUs. Register custom ops as jax/Pallas functions with "
+    "paddle_tpu.utils.register_op(name, fn, backward=...) — they run "
+    "eager, under jit, and differentiate; for host-side native code use "
+    "the ctypes tier (paddle_tpu/core/native.py)."
+)
+
+
+class _Unsupported(NotImplementedError):
+    def __init__(self, what):
+        super().__init__(f"{what} {_MSG}")
+
+
+def load(name, sources, *a, **kw):
+    raise _Unsupported("cpp_extension.load")
+
+
+def setup(**kw):
+    raise _Unsupported("cpp_extension.setup")
+
+
+def CppExtension(sources, *a, **kw):
+    raise _Unsupported("CppExtension")
+
+
+def CUDAExtension(sources, *a, **kw):
+    raise _Unsupported("CUDAExtension")

@@ -1,0 +1,57 @@
+"""MobileNetV1 (reference: python/paddle/vision/models/mobilenetv1.py)."""
+from __future__ import annotations
+
+from ... import nn
+
+__all__ = ["MobileNetV1", "mobilenet_v1"]
+
+
+class _DWSep(nn.Layer):
+    def __init__(self, inp, oup, stride):
+        super().__init__()
+        self.dw = nn.Sequential(
+            nn.Conv2D(inp, inp, 3, stride=stride, padding=1, groups=inp,
+                      bias_attr=False),
+            nn.BatchNorm2D(inp), nn.ReLU())
+        self.pw = nn.Sequential(
+            nn.Conv2D(inp, oup, 1, bias_attr=False),
+            nn.BatchNorm2D(oup), nn.ReLU())
+
+    def forward(self, x):
+        return self.pw(self.dw(x))
+
+
+class MobileNetV1(nn.Layer):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        def c(ch):
+            return max(int(ch * scale), 8)
+
+        cfg = [(c(32), c(64), 1), (c(64), c(128), 2), (c(128), c(128), 1),
+               (c(128), c(256), 2), (c(256), c(256), 1),
+               (c(256), c(512), 2)] + [(c(512), c(512), 1)] * 5 + \
+              [(c(512), c(1024), 2), (c(1024), c(1024), 1)]
+        feats = [nn.Conv2D(3, c(32), 3, stride=2, padding=1,
+                           bias_attr=False),
+                 nn.BatchNorm2D(c(32)), nn.ReLU()]
+        feats += [_DWSep(i, o, s) for i, o, s in cfg]
+        self.features = nn.Sequential(*feats)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(c(1024), num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(x.flatten(1))
+        return x
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV1(scale=scale, **kwargs)

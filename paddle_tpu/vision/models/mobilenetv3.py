@@ -1,0 +1,129 @@
+"""MobileNetV3 small/large (reference:
+python/paddle/vision/models/mobilenetv3.py)."""
+from __future__ import annotations
+
+from ... import nn
+
+__all__ = ["MobileNetV3Small", "MobileNetV3Large", "mobilenet_v3_small",
+           "mobilenet_v3_large"]
+
+
+def _divisible(v, d=8):
+    new = max(d, int(v + d / 2) // d * d)
+    if new < 0.9 * v:
+        new += d
+    return new
+
+
+class _SE(nn.Layer):
+    def __init__(self, ch):
+        super().__init__()
+        sq = _divisible(ch // 4)
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.fc1 = nn.Conv2D(ch, sq, 1)
+        self.fc2 = nn.Conv2D(sq, ch, 1)
+        self.relu = nn.ReLU()
+        self.hsig = nn.Hardsigmoid()
+
+    def forward(self, x):
+        s = self.hsig(self.fc2(self.relu(self.fc1(self.pool(x)))))
+        return x * s
+
+
+class _InvRes(nn.Layer):
+    def __init__(self, inp, exp, out, k, stride, se, act):
+        super().__init__()
+        self.use_res = stride == 1 and inp == out
+        Act = nn.Hardswish if act == "HS" else nn.ReLU
+        layers = []
+        if exp != inp:
+            layers += [nn.Conv2D(inp, exp, 1, bias_attr=False),
+                       nn.BatchNorm2D(exp), Act()]
+        layers += [nn.Conv2D(exp, exp, k, stride=stride,
+                             padding=(k - 1) // 2, groups=exp,
+                             bias_attr=False),
+                   nn.BatchNorm2D(exp), Act()]
+        if se:
+            layers.append(_SE(exp))
+        layers += [nn.Conv2D(exp, out, 1, bias_attr=False),
+                   nn.BatchNorm2D(out)]
+        self.block = nn.Sequential(*layers)
+
+    def forward(self, x):
+        out = self.block(x)
+        return x + out if self.use_res else out
+
+
+_SMALL = [
+    # k, exp, out, se, act, stride
+    (3, 16, 16, True, "RE", 2), (3, 72, 24, False, "RE", 2),
+    (3, 88, 24, False, "RE", 1), (5, 96, 40, True, "HS", 2),
+    (5, 240, 40, True, "HS", 1), (5, 240, 40, True, "HS", 1),
+    (5, 120, 48, True, "HS", 1), (5, 144, 48, True, "HS", 1),
+    (5, 288, 96, True, "HS", 2), (5, 576, 96, True, "HS", 1),
+    (5, 576, 96, True, "HS", 1),
+]
+_LARGE = [
+    (3, 16, 16, False, "RE", 1), (3, 64, 24, False, "RE", 2),
+    (3, 72, 24, False, "RE", 1), (5, 72, 40, True, "RE", 2),
+    (5, 120, 40, True, "RE", 1), (5, 120, 40, True, "RE", 1),
+    (3, 240, 80, False, "HS", 2), (3, 200, 80, False, "HS", 1),
+    (3, 184, 80, False, "HS", 1), (3, 184, 80, False, "HS", 1),
+    (3, 480, 112, True, "HS", 1), (3, 672, 112, True, "HS", 1),
+    (5, 672, 160, True, "HS", 2), (5, 960, 160, True, "HS", 1),
+    (5, 960, 160, True, "HS", 1),
+]
+
+
+class _MobileNetV3(nn.Layer):
+    def __init__(self, cfg, last_exp, scale=1.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        inp = _divisible(16 * scale)
+        feats = [nn.Conv2D(3, inp, 3, stride=2, padding=1,
+                           bias_attr=False),
+                 nn.BatchNorm2D(inp), nn.Hardswish()]
+        for k, exp, out, se, act, s in cfg:
+            exp_c = _divisible(exp * scale)
+            out_c = _divisible(out * scale)
+            feats.append(_InvRes(inp, exp_c, out_c, k, s, se, act))
+            inp = out_c
+        last = _divisible(last_exp * scale)
+        feats += [nn.Conv2D(inp, last, 1, bias_attr=False),
+                  nn.BatchNorm2D(last), nn.Hardswish()]
+        self.features = nn.Sequential(*feats)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            head = 1024 if last_exp == 576 else 1280
+            self.classifier = nn.Sequential(
+                nn.Linear(last, head), nn.Hardswish(), nn.Dropout(0.2),
+                nn.Linear(head, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.classifier(x.flatten(1))
+        return x
+
+
+class MobileNetV3Small(_MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_SMALL, 576, scale, num_classes, with_pool)
+
+
+class MobileNetV3Large(_MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_LARGE, 960, scale, num_classes, with_pool)
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, **kw):
+    return MobileNetV3Small(scale=scale, **kw)
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, **kw):
+    return MobileNetV3Large(scale=scale, **kw)

@@ -1,0 +1,121 @@
+"""ShuffleNetV2 (reference: python/paddle/vision/models/shufflenetv2.py)."""
+from __future__ import annotations
+
+from ... import nn
+
+__all__ = ["ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x0_5",
+           "shufflenet_v2_x1_0", "shufflenet_v2_x1_5",
+           "shufflenet_v2_x2_0"]
+
+_OUT = {
+    0.25: (24, 24, 48, 96, 512),
+    0.5: (24, 48, 96, 192, 1024),
+    1.0: (24, 116, 232, 464, 1024),
+    1.5: (24, 176, 352, 704, 1024),
+    2.0: (24, 244, 488, 976, 2048),
+}
+
+
+def _channel_shuffle(x, groups):
+    from ...ops.manipulation import reshape, transpose
+
+    b, c, h, w = x.shape
+    x = reshape(x, [b, groups, c // groups, h, w])
+    x = transpose(x, [0, 2, 1, 3, 4])
+    return reshape(x, [b, c, h, w])
+
+
+class _ShuffleUnit(nn.Layer):
+    def __init__(self, inp, out, stride):
+        super().__init__()
+        self.stride = stride
+        branch = out // 2
+        if stride == 2:
+            self.branch1 = nn.Sequential(
+                nn.Conv2D(inp, inp, 3, stride=2, padding=1, groups=inp,
+                          bias_attr=False),
+                nn.BatchNorm2D(inp),
+                nn.Conv2D(inp, branch, 1, bias_attr=False),
+                nn.BatchNorm2D(branch), nn.ReLU())
+            in2 = inp
+        else:
+            self.branch1 = None
+            in2 = inp // 2
+        self.branch2 = nn.Sequential(
+            nn.Conv2D(in2, branch, 1, bias_attr=False),
+            nn.BatchNorm2D(branch), nn.ReLU(),
+            nn.Conv2D(branch, branch, 3, stride=stride, padding=1,
+                      groups=branch, bias_attr=False),
+            nn.BatchNorm2D(branch),
+            nn.Conv2D(branch, branch, 1, bias_attr=False),
+            nn.BatchNorm2D(branch), nn.ReLU())
+
+    def forward(self, x):
+        from ...ops.manipulation import concat, split
+
+        if self.stride == 2:
+            out = concat([self.branch1(x), self.branch2(x)], axis=1)
+        else:
+            x1, x2 = split(x, 2, axis=1)
+            out = concat([x1, self.branch2(x2)], axis=1)
+        return _channel_shuffle(out, 2)
+
+
+class ShuffleNetV2(nn.Layer):
+    def __init__(self, scale=1.0, act="relu", num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        c0, c1, c2, c3, c_last = _OUT[scale]
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.conv1 = nn.Sequential(
+            nn.Conv2D(3, c0, 3, stride=2, padding=1, bias_attr=False),
+            nn.BatchNorm2D(c0), nn.ReLU())
+        self.maxpool = nn.MaxPool2D(3, stride=2, padding=1)
+        stages = []
+        inp = c0
+        for out, reps in ((c1, 4), (c2, 8), (c3, 4)):
+            units = [_ShuffleUnit(inp, out, 2)]
+            units += [_ShuffleUnit(out, out, 1) for _ in range(reps - 1)]
+            stages.append(nn.Sequential(*units))
+            inp = out
+        self.stages = nn.Sequential(*stages)
+        self.conv_last = nn.Sequential(
+            nn.Conv2D(c3, c_last, 1, bias_attr=False),
+            nn.BatchNorm2D(c_last), nn.ReLU())
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(c_last, num_classes)
+
+    def forward(self, x):
+        x = self.conv_last(self.stages(self.maxpool(self.conv1(x))))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(x.flatten(1))
+        return x
+
+
+def _sn(scale, **kw):
+    return ShuffleNetV2(scale=scale, **kw)
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kw):
+    return _sn(0.25, **kw)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kw):
+    return _sn(0.5, **kw)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kw):
+    return _sn(1.0, **kw)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kw):
+    return _sn(1.5, **kw)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kw):
+    return _sn(2.0, **kw)

@@ -317,17 +317,50 @@ class RandomRotation:
 
 
 class ColorJitter:
-    """reference: transforms.ColorJitter (brightness/contrast/saturation)."""
+    """reference: transforms.ColorJitter
+    (brightness/contrast/saturation/hue)."""
 
     def __init__(self, brightness=0, contrast=0, saturation=0, hue=0,
                  keys=None):
         self.brightness = brightness
         self.contrast = contrast
         self.saturation = saturation
-        if hue:
-            raise NotImplementedError(
-                "ColorJitter hue shifts are not implemented; pass hue=0")
         self.hue = hue
+
+    @staticmethod
+    def _shift_hue(a, shift, hi):
+        """HSV hue rotation by ``shift`` (fraction of a full turn),
+        channels-last RGB in [0, hi]."""
+        import colorsys  # noqa: F401  (documents the HSV convention)
+
+        x = a / hi
+        r, g, b = x[..., 0], x[..., 1], x[..., 2]
+        maxc = np.max(x, axis=-1)
+        minc = np.min(x, axis=-1)
+        v = maxc
+        delta = maxc - minc
+        s = np.where(maxc > 0, delta / np.where(maxc == 0, 1, maxc), 0)
+        dz = np.where(delta == 0, 1, delta)
+        rc = (maxc - r) / dz
+        gc = (maxc - g) / dz
+        bc = (maxc - b) / dz
+        h = np.where(r == maxc, bc - gc,
+                     np.where(g == maxc, 2.0 + rc - bc, 4.0 + gc - rc))
+        h = (h / 6.0) % 1.0
+        h = np.where(delta == 0, 0.0, h)
+        h = (h + shift) % 1.0
+        # hsv -> rgb
+        i = np.floor(h * 6.0)
+        f = h * 6.0 - i
+        p = v * (1.0 - s)
+        q = v * (1.0 - s * f)
+        t = v * (1.0 - s * (1.0 - f))
+        i = i.astype(int) % 6
+        conds = [i == k for k in range(6)]
+        r2 = np.select(conds, [v, q, p, p, t, v])
+        g2 = np.select(conds, [t, v, v, q, p, p])
+        b2 = np.select(conds, [p, p, t, v, v, q])
+        return np.stack([r2, g2, b2], axis=-1) * hi
 
     def _factor(self, amount):
         if isinstance(amount, (tuple, list)):
@@ -350,6 +383,11 @@ class ColorJitter:
             gray = (0.299 * a[..., 0] + 0.587 * a[..., 1]
                     + 0.114 * a[..., 2])[..., None]
             a = (a - gray) * self._factor(self.saturation) + gray
+        if self.hue and a.ndim == 3 and a.shape[-1] == 3:
+            amt = self.hue if isinstance(self.hue, (tuple, list)) \
+                else (-abs(self.hue), abs(self.hue))
+            shift = np.random.uniform(*amt)
+            a = self._shift_hue(np.clip(a, 0, hi), shift, hi)
         a = np.clip(a, 0, hi)
         out = np.moveaxis(a, -1, 0) if chw else a
         in_dtype = np.asarray(img).dtype

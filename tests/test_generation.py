@@ -1,0 +1,79 @@
+"""Fused compiled decode path (VERDICT r1 next #8; reference analogs:
+fused_multi_transformer / masked_multihead_attention serving kernels +
+PaddleNLP generate)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+def _model(seed=11):
+    pt.seed(seed)
+    cfg = pt.models.gpt_tiny(dropout=0.0, attention_dropout=0.0)
+    m = pt.models.GPTForCausalLM(cfg)
+    m.eval()
+    return m, cfg
+
+
+def test_generate_matches_eager_cached_decode():
+    """Greedy fused generate == step-by-step eager decode with the
+    concat-cache path (same weights, same prompt)."""
+    m, cfg = _model()
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (2, 7)).astype(np.int32)
+    n_new = 6
+
+    got = m.generate(pt.to_tensor(ids), max_new_tokens=n_new).numpy()
+
+    # eager reference: argmax over logits, concat-cache path
+    with pt.no_grad():
+        caches = m.init_caches(2)
+        logits, caches = m(pt.to_tensor(ids), caches=caches)
+        ref = []
+        tok = logits.numpy()[:, -1].argmax(-1).astype(np.int32)
+        ref.append(tok)
+        for _ in range(n_new - 1):
+            logits, caches = m(pt.to_tensor(tok[:, None]), caches=caches)
+            tok = logits.numpy()[:, -1].argmax(-1).astype(np.int32)
+            ref.append(tok)
+    ref = np.stack(ref, axis=1)
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_generate_eos_clamps():
+    m, cfg = _model()
+    rng = np.random.RandomState(1)
+    ids = rng.randint(0, cfg.vocab_size, (1, 5)).astype(np.int32)
+    out = m.generate(pt.to_tensor(ids), max_new_tokens=8).numpy()
+    eos = int(out[0, 2])  # force the 3rd generated token to be "eos"
+    out2 = m.generate(pt.to_tensor(ids), max_new_tokens=8,
+                      eos_token_id=eos).numpy()
+    seen = False
+    for t in out2[0]:
+        if seen:
+            assert t == eos  # everything after eos is clamped
+        if t == eos:
+            seen = True
+
+
+def test_generate_top_p_valid_tokens():
+    m, cfg = _model()
+    rng = np.random.RandomState(2)
+    ids = rng.randint(0, cfg.vocab_size, (2, 4)).astype(np.int32)
+    out = m.generate(pt.to_tensor(ids), max_new_tokens=5,
+                     temperature=0.8, top_p=0.9).numpy()
+    assert out.shape == (2, 5)
+    assert (out >= 0).all() and (out < cfg.vocab_size).all()
+
+
+def test_predictor_from_model_generate():
+    from paddle_tpu import inference
+
+    m, cfg = _model()
+    pred = inference.Predictor.from_model(m)
+    rng = np.random.RandomState(3)
+    ids = rng.randint(0, cfg.vocab_size, (1, 6)).astype(np.int32)
+    out = pred.generate(ids, max_new_tokens=4)
+    assert out.shape == (1, 4)
+    ref = m.generate(pt.to_tensor(ids), max_new_tokens=4).numpy()
+    np.testing.assert_array_equal(out, ref)

