@@ -9,10 +9,10 @@ vs_baseline is measured MFU over the north-star target (BASELINE.json:
 >=45% MFU); >1.0 beats the target. The reference publishes no in-tree
 numbers (BASELINE.md), so MFU-vs-north-star is the comparable scalar.
 
-Headline config: GPT-3-1.3B, batch 8 x seq 1024, bf16 params, bf16 AdamW
-moments (fp32 update math), per-block rematerialization — the >=1B-param
-single-chip configuration (VERDICT r1 next #1). Set PADDLE_TPU_BENCH=125m
-for the round-1 small config (batch 64 x seq 512, no recompute).
+Headline config: GPT-3-1.3B, batch 16 x seq 1024, bf16 params, bf16 AdamW
+first moments (fp32 update math), per-block rematerialization — the
+>=1B-param single-chip configuration (VERDICT r1 next #1). Set
+PADDLE_TPU_BENCH=125m for the round-1 small config (batch 64 x seq 512).
 
 Context (tools/profile_bench.py, committed breakdown in STATUS.md): a bare
 bf16 matmul chain measures 0.574 MFU-equivalent through the axon tunnel on
@@ -76,7 +76,7 @@ def main():
     else:
         cfg = pt.models.gpt3_1p3B(dropout=0.0, attention_dropout=0.0,
                                   recompute=True)
-        batch, seq = (8, 1024)
+        batch, seq = (16, 1024)
         metric = "gpt3_1p3b_train_tokens_per_sec_chip"
         moment_dtype = "bfloat16"
         iters = 4

@@ -16,7 +16,8 @@ import time
 from dataclasses import asdict, dataclass, field
 from typing import Callable, Dict, List, Optional
 
-__all__ = ["Config", "AutoTuner", "default_candidates", "prune_by_memory"]
+__all__ = ["Config", "AutoTuner", "default_candidates", "prune_by_memory",
+           "estimate_memory_bytes", "launch_trial_run_fn"]
 
 
 @dataclass
@@ -88,6 +89,63 @@ def prune_by_memory(candidates: List[Config], model_bytes: int,
         if est <= hbm_bytes:
             keep.append(c)
     return keep
+
+
+def estimate_memory_bytes(cfg: Config, *, num_layers: int, hidden: int,
+                          vocab: int, seq_len: int,
+                          ffn_mult: int = 4, param_bytes: int = 2,
+                          moment_bytes: int = 6, grad_bytes: int = 2
+                          ) -> int:
+    """Per-chip memory cost model (reference: auto_tuner cost models,
+    prune.py memory rules): weights + optimizer states sharded by
+    mp*pp*sharding, plus activation stash for the 1F1B steady state
+    (pp in-flight micro-batches; remat reduces the stash to block
+    boundaries)."""
+    per_layer = (4 + 2 * ffn_mult) * hidden * hidden
+    n_params = num_layers * per_layer + vocab * hidden
+    shards = cfg.mp_degree * cfg.pp_degree * max(cfg.sharding_degree, 1)
+    state = n_params * (param_bytes + moment_bytes + grad_bytes) / shards
+    # activations: per-microbatch per-layer stash, pp micro-batches deep
+    act_per_tok = hidden * (2 if cfg.use_recompute else (10 + 2 * ffn_mult))
+    layers_here = num_layers / max(cfg.pp_degree, 1)
+    act = (cfg.micro_batch_size * seq_len * act_per_tok * layers_here
+           * max(cfg.pp_degree, 1) * param_bytes / max(cfg.mp_degree, 1))
+    return int(state + act)
+
+
+def launch_trial_run_fn(script: str, nproc_per_node: int = 1,
+                        timeout: float = 600.0, log_dir: str = "tuner_log",
+                        metric_key: str = "metric"):
+    """Trial-JOB mode (reference: the auto-tuner launching one
+    distributed job per candidate via paddle.distributed.launch): returns
+    a ``run_fn(cfg) -> float`` that launches ``script`` through the
+    launch CLI with the candidate exported as ``AUTO_TUNER_CONFIG``
+    (json) and reads the metric the trial writes to
+    ``$AUTO_TUNER_METRIC_FILE`` (json: {"metric": <float>})."""
+    import os
+    import subprocess
+    import sys
+    import tempfile
+
+    def run_fn(cfg: Config) -> float:
+        with tempfile.TemporaryDirectory() as td:
+            metric_file = os.path.join(td, "metric.json")
+            env = dict(os.environ)
+            env["AUTO_TUNER_CONFIG"] = json.dumps(cfg.to_dict())
+            env["AUTO_TUNER_METRIC_FILE"] = metric_file
+            out = subprocess.run(
+                [sys.executable, "-m", "paddle_tpu.distributed.launch",
+                 "--nproc_per_node", str(nproc_per_node),
+                 "--max_restart", "0", "--log_dir", log_dir, script],
+                env=env, capture_output=True, text=True, timeout=timeout)
+            if out.returncode != 0:
+                raise RuntimeError(
+                    f"trial failed rc={out.returncode}: "
+                    f"{(out.stdout + out.stderr)[-400:]}")
+            with open(metric_file) as f:
+                return float(json.load(f)[metric_key])
+
+    return run_fn
 
 
 class AutoTuner:

@@ -5,6 +5,7 @@ from .conv import *  # noqa: F401,F403
 from .pooling import *  # noqa: F401,F403
 from .norm import *  # noqa: F401,F403
 from .loss import *  # noqa: F401,F403
+from .extend import *  # noqa: F401,F403
 
 from . import activation, common, conv, pooling, norm, loss  # noqa: F401
 

@@ -1,0 +1,147 @@
+"""Round-2 layer-class wrappers over nn/functional/extend.py
+(reference: python/paddle/nn/layer/{pooling,loss,distance}.py)."""
+from __future__ import annotations
+
+from .. import functional as F
+from .layers import Layer
+
+__all__ = ["MaxUnPool1D", "MaxUnPool2D", "MaxUnPool3D", "LPPool1D",
+           "LPPool2D", "MultiMarginLoss", "TripletMarginWithDistanceLoss",
+           "HSigmoidLoss", "AdaptiveLogSoftmaxWithLoss"]
+
+
+class _MaxUnPool(Layer):
+    _fn = None
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format=None, output_size=None, name=None):
+        super().__init__()
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.output_size = output_size
+
+    def forward(self, x, indices):
+        return type(self)._fn(x, indices, self.kernel_size,
+                              stride=self.stride, padding=self.padding,
+                              output_size=self.output_size)
+
+
+class MaxUnPool1D(_MaxUnPool):
+    _fn = staticmethod(F.max_unpool1d)
+
+
+class MaxUnPool2D(_MaxUnPool):
+    _fn = staticmethod(F.max_unpool2d)
+
+
+class MaxUnPool3D(_MaxUnPool):
+    _fn = staticmethod(F.max_unpool3d)
+
+
+class _LPPool(Layer):
+    _fn = None
+
+    def __init__(self, norm_type, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, data_format=None, name=None):
+        super().__init__()
+        self.norm_type = norm_type
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.ceil_mode = ceil_mode
+
+    def forward(self, x):
+        return type(self)._fn(x, self.norm_type, self.kernel_size,
+                              stride=self.stride, padding=self.padding,
+                              ceil_mode=self.ceil_mode)
+
+
+class LPPool1D(_LPPool):
+    _fn = staticmethod(F.lp_pool1d)
+
+
+class LPPool2D(_LPPool):
+    _fn = staticmethod(F.lp_pool2d)
+
+
+class MultiMarginLoss(Layer):
+    def __init__(self, p=1, margin=1.0, weight=None, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.p = p
+        self.margin = margin
+        self.weight = weight
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return F.multi_margin_loss(input, label, p=self.p,
+                                   margin=self.margin, weight=self.weight,
+                                   reduction=self.reduction)
+
+
+class TripletMarginWithDistanceLoss(Layer):
+    def __init__(self, distance_function=None, margin=1.0, swap=False,
+                 reduction="mean", name=None):
+        super().__init__()
+        self.distance_function = distance_function
+        self.margin = margin
+        self.swap = swap
+        self.reduction = reduction
+
+    def forward(self, input, positive, negative):
+        return F.triplet_margin_with_distance_loss(
+            input, positive, negative,
+            distance_function=self.distance_function, margin=self.margin,
+            swap=self.swap, reduction=self.reduction)
+
+
+class HSigmoidLoss(Layer):
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        self.num_classes = num_classes
+        self.weight = self.create_parameter(
+            [num_classes - 1, feature_size], attr=weight_attr)
+        self.bias = None
+        if bias_attr is not False:
+            self.bias = self.create_parameter(
+                [num_classes - 1], attr=bias_attr, is_bias=True)
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        return F.hsigmoid_loss(input, label, self.num_classes,
+                               self.weight, bias=self.bias,
+                               path_table=path_table,
+                               path_code=path_code)
+
+
+class AdaptiveLogSoftmaxWithLoss(Layer):
+    """reference: nn/layer/loss.py AdaptiveLogSoftmaxWithLoss."""
+
+    def __init__(self, in_features, n_classes, cutoffs, div_value=4.0,
+                 head_bias=False, name=None):
+        super().__init__()
+        self.cutoffs = list(cutoffs)
+        self.n_classes = n_classes
+        shortlist = self.cutoffs[0]
+        n_clusters = len(self.cutoffs)
+        self.head_weight = self.create_parameter(
+            [in_features, shortlist + n_clusters])
+        self.head_bias = self.create_parameter(
+            [shortlist + n_clusters], is_bias=True) if head_bias else None
+        self.tail_weights = []
+        full = self.cutoffs + [n_classes]
+        for i in range(n_clusters):
+            hsz = max(int(in_features / (div_value ** (i + 1))), 1)
+            osz = full[i + 1] - full[i]
+            w1 = self.create_parameter([in_features, hsz])
+            w2 = self.create_parameter([hsz, osz])
+            setattr(self, f"tail_{i}_proj", w1)
+            setattr(self, f"tail_{i}_out", w2)
+            self.tail_weights.append([w1, w2])
+
+    def forward(self, input, label):
+        return F.adaptive_log_softmax_with_loss(
+            input, label, self.head_weight, self.tail_weights,
+            self.cutoffs, head_bias=self.head_bias)

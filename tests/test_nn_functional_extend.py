@@ -1,0 +1,193 @@
+"""nn.functional round-2 expansion (reference: python/paddle/nn/functional/
+vision.py, extension.py, loss.py long tail)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.nn import functional as F
+
+
+def test_sequence_mask():
+    out = F.sequence_mask(pt.to_tensor(np.array([1, 3, 2], np.int32)),
+                          maxlen=4)
+    np.testing.assert_array_equal(
+        out.numpy(), [[1, 0, 0, 0], [1, 1, 1, 0], [1, 1, 0, 0]])
+
+
+def test_zeropad2d():
+    x = pt.ones([1, 1, 2, 2])
+    out = F.zeropad2d(x, [1, 2, 0, 1])
+    assert out.shape == [1, 1, 3, 5]
+    assert float(out.numpy().sum()) == 4.0
+
+
+def test_pdist():
+    a = np.array([[0., 0.], [3., 4.], [0., 1.]], np.float32)
+    out = F.pdist(pt.to_tensor(a)).numpy()
+    np.testing.assert_allclose(out, [5.0, 1.0, np.sqrt(18)], rtol=1e-5)
+
+
+def test_metric_losses():
+    rng = np.random.RandomState(0)
+    a = pt.to_tensor(rng.randn(4, 8).astype(np.float32))
+    p = pt.to_tensor(rng.randn(4, 8).astype(np.float32))
+    n = pt.to_tensor(rng.randn(4, 8).astype(np.float32))
+    lab = pt.to_tensor(np.array([0, 1, 0, 1], np.int32))
+
+    loss = F.npair_loss(a, p, lab)
+    assert np.isfinite(float(loss.numpy()))
+
+    logits = pt.to_tensor(rng.randn(4, 5).astype(np.float32))
+    loss = F.multi_margin_loss(logits, lab)
+    assert float(loss.numpy()) >= 0
+
+    loss = F.triplet_margin_with_distance_loss(a, p, n)
+    assert float(loss.numpy()) >= 0
+    # custom distance fn routes through
+    loss2 = F.triplet_margin_with_distance_loss(
+        a, p, n, distance_function=lambda x, y: ((x - y) ** 2).sum(-1))
+    assert np.isfinite(float(loss2.numpy()))
+
+    # hsigmoid: finite and differentiable
+    x = pt.to_tensor(rng.randn(4, 6).astype(np.float32))
+    x.stop_gradient = False
+    w = pt.to_tensor(rng.randn(7, 6).astype(np.float32) * 0.1)
+    loss = F.hsigmoid_loss(x, lab, 8, w)
+    loss.backward()
+    assert x.grad is not None
+
+
+def test_edit_distance():
+    inp = pt.to_tensor(np.array([[1, 2, 3, 4]], np.int32))
+    lab = pt.to_tensor(np.array([[1, 3, 3]], np.int32))
+    dist, count = F.edit_distance(inp, lab, normalized=False)
+    assert float(dist.numpy()) == 2.0   # substitute 2->3, delete 4
+    dist_n, _ = F.edit_distance(inp, lab, normalized=True)
+    np.testing.assert_allclose(dist_n.numpy(), [[2.0 / 3]], rtol=1e-6)
+
+
+def test_gather_tree():
+    # reference docstring example
+    ids = pt.to_tensor(np.array(
+        [[[2, 2], [6, 1]], [[3, 9], [6, 1]], [[0, 1], [9, 0]]], np.int32))
+    parents = pt.to_tensor(np.array(
+        [[[0, 0], [1, 1]], [[1, 0], [1, 0]], [[0, 0], [0, 1]]], np.int32))
+    out = F.gather_tree(ids, parents).numpy()
+    expect = np.array([[[2, 2], [1, 6]], [[3, 3], [6, 1]],
+                       [[0, 1], [9, 0]]], np.int32)
+    np.testing.assert_array_equal(out, expect)
+
+
+def test_temporal_shift():
+    x = pt.to_tensor(np.arange(2 * 4 * 2 * 2, dtype=np.float32)
+                     .reshape(2, 4, 2, 2))
+    out = F.temporal_shift(x, seg_num=2, shift_ratio=0.25)
+    assert out.shape == [2, 4, 2, 2]
+    a = out.numpy()
+    # first fold of frame 0 holds frame 1's values (shift left)
+    np.testing.assert_allclose(a[0, 0], x.numpy()[1, 0])
+    # first fold of the last frame is zero-padded
+    np.testing.assert_allclose(a[1, 0], 0)
+
+
+def test_max_unpool2d_roundtrip():
+    x = pt.to_tensor(np.array([[[[1., 2.], [3., 4.]]]], np.float32))
+    # maxpool with indices then unpool restores the maxima positions
+    pooled, idx = F.max_pool2d(pt.to_tensor(
+        np.array([[[[1., 2., 0, 0], [3., 4., 0, 0],
+                    [0, 0, 0, 0], [0, 0, 0, 0]]]], np.float32)),
+        kernel_size=2, return_mask=True)
+    out = F.max_unpool2d(pooled, idx, kernel_size=2)
+    assert out.shape == [1, 1, 4, 4]
+    got = out.numpy()[0, 0]
+    assert got[1, 1] == 4.0 and got.sum() == pooled.numpy().sum()
+
+
+def test_lp_pool():
+    x = pt.to_tensor(np.ones((1, 1, 4, 4), np.float32) * 2)
+    out = F.lp_pool2d(x, norm_type=2, kernel_size=2)
+    # ||(2,2,2,2)||_2 = sqrt(16) = 4
+    np.testing.assert_allclose(out.numpy(), np.full((1, 1, 2, 2), 4.0),
+                               rtol=1e-5)
+
+
+def test_affine_grid_and_grid_sample_identity():
+    n, c, h, w = 1, 1, 4, 4
+    theta = pt.to_tensor(np.array(
+        [[[1., 0., 0.], [0., 1., 0.]]], np.float32))
+    grid = F.affine_grid(theta, [n, c, h, w])
+    assert grid.shape == [1, 4, 4, 2]
+    rng = np.random.RandomState(0)
+    img = pt.to_tensor(rng.randn(n, c, h, w).astype(np.float32))
+    out = F.grid_sample(img, grid)
+    np.testing.assert_allclose(out.numpy(), img.numpy(), atol=1e-5)
+    # nearest mode identity too
+    out2 = F.grid_sample(img, grid, mode="nearest")
+    np.testing.assert_allclose(out2.numpy(), img.numpy(), atol=1e-5)
+
+
+def test_margin_cross_entropy_and_class_center_sample():
+    rng = np.random.RandomState(1)
+    feat = rng.randn(4, 6).astype(np.float32)
+    feat /= np.linalg.norm(feat, axis=1, keepdims=True)
+    lab = np.array([0, 2, 1, 5], np.int32)
+    loss = F.margin_cross_entropy(pt.to_tensor(feat), pt.to_tensor(lab))
+    assert np.isfinite(float(loss.numpy()))
+    # margins make the loss HARDER than plain softmax-CE
+    plain = F.margin_cross_entropy(pt.to_tensor(feat), pt.to_tensor(lab),
+                                   margin1=1.0, margin2=0.0, margin3=0.0)
+    assert float(loss.numpy()) >= float(plain.numpy())
+
+    remapped, sampled = F.class_center_sample(pt.to_tensor(lab), 10, 6)
+    s = sampled.numpy()
+    assert set(np.unique(lab)).issubset(set(s.tolist()))
+    np.testing.assert_array_equal(s[remapped.numpy()], lab)
+
+
+def test_adaptive_log_softmax_with_loss():
+    rng = np.random.RandomState(0)
+    x = pt.to_tensor(rng.randn(6, 8).astype(np.float32))
+    lab = pt.to_tensor(np.array([0, 1, 4, 5, 8, 9], np.int32))
+    # 10 classes: shortlist 4 + 2 clusters ([4,8), [8,10))
+    head_w = pt.to_tensor(rng.randn(8, 6).astype(np.float32) * .1)
+    tails = [[pt.to_tensor(rng.randn(8, 4).astype(np.float32) * .1),
+              pt.to_tensor(rng.randn(4, 4).astype(np.float32) * .1)],
+             [pt.to_tensor(rng.randn(8, 2).astype(np.float32) * .1),
+              pt.to_tensor(rng.randn(2, 2).astype(np.float32) * .1)]]
+    logp, loss = F.adaptive_log_softmax_with_loss(
+        x, lab, head_w, tails, cutoffs=[4, 8])
+    assert logp.shape == [6]
+    assert (logp.numpy() <= 0).all()
+    assert np.isfinite(float(loss.numpy()))
+
+
+def test_inplace_activations():
+    a = np.array([-1.0, 0.5], np.float32)
+    x = pt.to_tensor(a.copy())
+    F.leaky_relu_(x)
+    np.testing.assert_allclose(x.numpy(), np.where(a > 0, a, a * 0.01),
+                               rtol=1e-6)
+    x = pt.to_tensor(a.copy())
+    x2 = F.softmax_(x)
+    assert x2 is x
+    np.testing.assert_allclose(x.numpy().sum(), 1.0, rtol=1e-5)
+
+
+def test_flash_attn_qkvpacked():
+    rng = np.random.RandomState(0)
+    qkv = rng.randn(2, 8, 3, 2, 4).astype(np.float32)
+    out, _ = F.flash_attn_qkvpacked(pt.to_tensor(qkv), causal=True)
+    assert out.shape == [2, 8, 2, 4]
+
+
+def test_feature_alpha_dropout():
+    pt.seed(0)
+    x = pt.ones([4, 8, 3, 3])
+    out = F.feature_alpha_dropout(x, p=0.5, training=True)
+    a = out.numpy()
+    # whole feature maps share the dropout decision
+    per_map = a.reshape(4, 8, -1)
+    assert all(len(np.unique(per_map[i, j])) == 1
+               for i in range(4) for j in range(8))
+    out_eval = F.feature_alpha_dropout(x, p=0.5, training=False)
+    np.testing.assert_allclose(out_eval.numpy(), x.numpy())
