@@ -100,7 +100,7 @@ def _logits(cfg, weights, x):
 
 
 def _sample(logits, key, temperature, top_p):
-    if temperature == 0.0 or (top_p is None and temperature == 1.0):
+    if temperature == 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     lg = logits.astype(jnp.float32) / max(temperature, 1e-6)
     if top_p is not None:
@@ -132,6 +132,12 @@ def generate(model, input_ids, max_new_tokens: int = 32,
     ids = ids.astype(jnp.int32)
     b, plen = ids.shape
     total = plen + max_new_tokens
+    max_pos = getattr(cfg, "max_position_embeddings", None)
+    if max_pos is not None and total > max_pos:
+        raise ValueError(
+            f"prompt length {plen} + max_new_tokens {max_new_tokens} = "
+            f"{total} exceeds max_position_embeddings {max_pos}; XLA would "
+            "silently clamp position-embedding gathers past the window")
     weights = _gpt_weights(model)
     L = cfg.num_layers
     nh, hd = cfg.num_heads, cfg.head_dim

@@ -6,6 +6,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.scipy import special as jsp
 
 from ..core import random as _rng
@@ -202,8 +203,20 @@ def fill_diagonal_tensor(x, y, offset=0, dim1=0, dim2=1, name=None):
 
 
 def take(x, index, mode="raise", name=None):
-    """reference: math.py take — flat-index gather with wrap/clip modes."""
+    """reference: math.py take — flat-index gather with wrap/clip modes.
+
+    mode="raise" validates indices eagerly (out-of-range raises); under
+    tracing, where raising is impossible, it degrades to clamp.
+    """
     idx = unwrap(as_tensor(index)).astype(jnp.int32)
+    xt = as_tensor(x)
+    if mode == "raise" and not isinstance(idx, jax.core.Tracer):
+        n = int(np.prod(xt.shape)) if xt.shape else 1
+        # reduce on device; only one boolean scalar crosses to host
+        if bool(((idx < -n) | (idx >= n)).any()):
+            raise IndexError(
+                f"take(mode='raise'): index out of range for input with "
+                f"{n} elements")
 
     def fn(a):
         flat = a.reshape(-1)
@@ -417,14 +430,21 @@ def lu_unpack(lu_data, lu_pivots, unpack_ludata=True, unpack_pivots=True,
         k = min(m, n)
         L = jnp.tril(a[..., :, :k], -1) + jnp.eye(m, k, dtype=a.dtype)
         U = jnp.triu(a[..., :k, :])
-        # pivots -> permutation matrix
-        perm = jnp.arange(m)
-        for i in range(piv.shape[-1]):
-            j = piv[..., i] - 1
-            pi = perm[i]
-            perm = perm.at[i].set(perm[j])
-            perm = perm.at[j].set(pi)
-        P = jnp.eye(m, dtype=a.dtype)[perm].T
+
+        def perm_from_piv(p1):
+            perm = jnp.arange(m)
+            for i in range(p1.shape[0]):
+                j = p1[i] - 1
+                pi = perm[i]
+                perm = perm.at[i].set(perm[j])
+                perm = perm.at[j].set(pi)
+            return perm
+
+        # batched pivot→permutation reconstruction over leading dims
+        pv = piv.reshape((-1, piv.shape[-1]))
+        perms = jax.vmap(perm_from_piv)(pv)
+        P = jnp.swapaxes(jnp.eye(m, dtype=a.dtype)[perms], -1, -2)
+        P = P.reshape(a.shape[:-2] + (m, m))
         return P, L, U
 
     return run_op(fn, [as_tensor(lu_data)], name="lu_unpack")

@@ -7,9 +7,11 @@ inside compiled programs.
 """
 from __future__ import annotations
 
+import warnings
 from typing import Dict, List, Optional
 
 import jax.numpy as jnp
+import numpy as np
 
 from ..core.tensor import Tensor
 from ..nn.layer.layers import Parameter
@@ -154,7 +156,30 @@ class Optimizer:
         by_pos = {}
         if not any_name_match and self._parameter_list and \
                 len(saved_order) == len(self._parameter_list):
-            by_pos = dict(zip(saved_order, self._parameter_list))
+            # Positional fallback is only safe if EVERY saved slot agrees
+            # in shape with its positional parameter — a key-order-
+            # perturbing serializer would otherwise cross-load moments
+            # between same-shaped params silently.
+            candidate = dict(zip(saved_order, self._parameter_list))
+            for key, val in state_dict.items():
+                if key in ("global_step", "LR_Scheduler"):
+                    continue
+                pname = key.rsplit(".", 1)[0]
+                p = candidate.get(pname)
+                shp = tuple(val.shape) if hasattr(val, "shape") else \
+                    np.shape(val)
+                if p is not None and shp not in ((), tuple(p.shape)):
+                    raise ValueError(
+                        f"optimizer.set_state_dict: positional fallback "
+                        f"rejected — saved state '{key}' shape "
+                        f"{shp} does not match positional "
+                        f"parameter shape {tuple(p.shape)}")
+            by_pos = candidate
+            warnings.warn(
+                    "optimizer.set_state_dict: no saved state name matched "
+                    "any parameter; falling back to POSITIONAL mapping "
+                    "(saved key order -> parameter order). Verify the "
+                    "checkpoint came from an identically-ordered model.")
         for key, val in state_dict.items():
             if key in ("global_step", "LR_Scheduler"):
                 continue

@@ -77,3 +77,25 @@ def test_predictor_from_model_generate():
     assert out.shape == (1, 4)
     ref = m.generate(pt.to_tensor(ids), max_new_tokens=4).numpy()
     np.testing.assert_array_equal(out, ref)
+
+
+def test_generate_temperature_one_samples():
+    """T=1.0 with top_p=None must SAMPLE (advisor r2 medium #1), not
+    silently argmax."""
+    m, cfg = _model()
+    rng = np.random.RandomState(5)
+    ids = rng.randint(0, cfg.vocab_size, (4, 6)).astype(np.int32)
+    greedy = m.generate(pt.to_tensor(ids), max_new_tokens=8,
+                        temperature=0.0).numpy()
+    sampled = m.generate(pt.to_tensor(ids), max_new_tokens=8,
+                         temperature=1.0).numpy()
+    # With an untrained model the logit distribution is near-uniform over
+    # the vocab; 32 sampled tokens matching argmax exactly is ~impossible.
+    assert not np.array_equal(greedy, sampled)
+
+
+def test_generate_rejects_overlong():
+    m, cfg = _model()
+    ids = np.zeros((1, cfg.max_position_embeddings - 2), np.int32)
+    with pytest.raises(ValueError):
+        m.generate(pt.to_tensor(ids), max_new_tokens=8)

@@ -295,3 +295,29 @@ class TestInplaceFamily:
         x = pt.to_tensor(np.array([True, False]))
         pt.logical_not_(x)
         np.testing.assert_array_equal(x.numpy(), [False, True])
+
+
+def test_lu_unpack_batched():
+    import scipy.linalg as sla
+
+    rng = np.random.RandomState(7)
+    A = rng.randn(3, 4, 4).astype(np.float32)
+    lus, pivs = [], []
+    for i in range(3):
+        lu, piv = sla.lu_factor(A[i])
+        lus.append(lu)
+        pivs.append(piv + 1)
+    P, L, U = pt.lu_unpack(pt.to_tensor(np.stack(lus).astype(np.float32)),
+                           pt.to_tensor(np.stack(pivs).astype(np.int32)))
+    rec = P.numpy() @ L.numpy() @ U.numpy()
+    np.testing.assert_allclose(rec, A, atol=1e-4)
+
+
+def test_take_raise_validates():
+    import pytest
+
+    a = _r(3, 4)
+    with pytest.raises(IndexError):
+        pt.take(pt.to_tensor(a), pt.to_tensor(np.array([12], np.int32)))
+    with pytest.raises(IndexError):
+        pt.take(pt.to_tensor(a), pt.to_tensor(np.array([-13], np.int32)))
