@@ -197,6 +197,11 @@ class PipelineParallel(_MetaParallelBase):
     startup/steady/cooldown phases; p2p via SendRecvMeta handshake,
     pp_utils/p2p_communication.py:52)."""
 
+    # solitary-p2p schedules with endpoint-asymmetric per-pair op order
+    # (interleaved VPP) set this to route p2p through the backend's
+    # buffered transport instead of the paired device programs
+    _p2p_buffered = False
+
     def __init__(self, layers, hcg, strategy=None):
         if not isinstance(layers, PipelineLayer):
             raise TypeError("PipelineParallel expects a PipelineLayer")
@@ -213,6 +218,7 @@ class PipelineParallel(_MetaParallelBase):
         self.next_rank = hcg.get_p2p_next_rank()
         self.is_first = hcg.is_first_stage()
         self.is_last = hcg.is_last_stage()
+        self.global_rank = hcg.get_global_rank()
         cfg = (strategy.pipeline_configs if strategy is not None else
                {"accumulate_steps": 1})
         self.accumulate_steps = cfg.get("accumulate_steps", 1)
@@ -220,6 +226,13 @@ class PipelineParallel(_MetaParallelBase):
         # grads are distinct channels (reference pp_utils SendRecvMeta)
         self._send_meta_known = {}
         self._recv_meta = {}
+        # per-process construction counter scopes the store meta keys so a
+        # second pipeline over the same group doesn't read the first one's
+        # stale channel meta (construction order is SPMD-symmetric, like
+        # the schedule itself)
+        cls = PipelineParallel
+        cls._instances = getattr(cls, "_instances", 0) + 1
+        self._meta_nonce = cls._instances
 
     def _prepare_for_model(self):
         hcg = self._hcg
@@ -229,55 +242,102 @@ class PipelineParallel(_MetaParallelBase):
                 hcg.get_data_parallel_group_src_rank())
 
     # ---------------------------------------------------------------- p2p
-    def _send_tensor(self, t: Tensor, dst, tag: str = "fwd"):
-        """SendRecvMeta protocol (reference pp_utils SendRecvMeta): every
-        tensor is preceded by an 8-int64 header; header[0] > 0 means a
-        meta blob follows (shape/dtype changed on this channel, e.g. VPP
-        chunks with different boundary shapes), 0 means reuse cached."""
+    # SendRecvMeta protocol (reference pp_utils/p2p_communication.py:52):
+    # shape/dtype are exchanged ONCE per channel through the TCPStore (the
+    # control path), then every transfer is a bare fixed-shape tensor
+    # send/recv — on the XLA backend that is a cached compiled
+    # collective_permute with ZERO store traffic and zero host syncs in
+    # steady state (the reference's has_send_meta/has_recv_meta caching;
+    # steady-state PP is fixed-shape per SURVEY §3.5). A shape change on an
+    # established channel is an error: use a distinct tag per boundary
+    # shape (VPP tags carry the virtual-stage id for exactly this reason).
+    def _meta_store(self):
+        pg = self.pp_group.process_group
+        return getattr(pg, "_store", None)
+
+    def _meta_key(self, src, dst, tag):
+        return (f"ppmeta/g{self.pp_group.id}/i{self._meta_nonce}/"
+                f"{src}->{dst}/{tag}")
+
+    def _ensure_send_meta(self, t: Tensor, peer, tag: str):
+        """Publish this channel's (shape, dtype) to the store once; reject
+        shape changes on an established channel (fixed-shape channels keep
+        steady-state PP on the compiled device path — use a distinct tag
+        per boundary shape)."""
         import pickle
 
         cur = (tuple(t.shape), str(t._data.dtype))
-        if self._send_meta_known.get((dst, tag)) != cur:
-            meta = pickle.dumps(cur)
-            meta_arr = np.frombuffer(meta, dtype=np.uint8)
-            hdr = np.zeros(8, dtype=np.int64)
-            hdr[0] = meta_arr.size
-            dist.send(Tensor(hdr), dst, group=self.pp_group)
-            pad = np.zeros(4096, dtype=np.uint8)
-            pad[:meta_arr.size] = meta_arr
-            dist.send(Tensor(pad), dst, group=self.pp_group)
-            self._send_meta_known[(dst, tag)] = cur
-        else:
-            dist.send(Tensor(np.zeros(8, dtype=np.int64)), dst,
-                      group=self.pp_group)
-        dist.send(t, dst, group=self.pp_group)
+        known = self._send_meta_known.get((peer, tag))
+        if known is None:
+            store = self._meta_store()
+            if store is not None:
+                store.set(self._meta_key(self.global_rank, peer, tag),
+                          pickle.dumps(cur))
+            self._send_meta_known[(peer, tag)] = cur
+        elif known != cur:
+            raise ValueError(
+                f"pipeline p2p channel ({peer}, {tag!r}) was established "
+                f"with meta {known} but is now asked to carry {cur}; "
+                "fixed-shape channels keep steady-state PP on the "
+                "compiled device path — use a distinct tag per boundary "
+                "shape")
 
-    def _recv_tensor(self, src, tag: str = "fwd") -> Tensor:
+    def _ensure_recv_meta(self, peer, tag: str):
+        """Blocking one-time fetch of the channel meta the sender
+        published; returns (shape, dtype)."""
         import pickle
 
-        hdr = Tensor(np.zeros(8, dtype=np.int64))
-        dist.recv(hdr, src, group=self.pp_group)
-        n = int(hdr.numpy()[0])
-        if n > 0:
-            pad = Tensor(np.zeros(4096, dtype=np.uint8))
-            dist.recv(pad, src, group=self.pp_group)
-            self._recv_meta[(src, tag)] = pickle.loads(
-                pad.numpy()[:n].tobytes())
-        shape, dtype = self._recv_meta[(src, tag)]
-        buf = Tensor(np.zeros(shape, dtype=np.dtype(dtype)
-                              if dtype != "bfloat16" else np.float32))
-        dist.recv(buf, src, group=self.pp_group)
+        if (peer, tag) not in self._recv_meta:
+            store = self._meta_store()
+            if store is None:
+                raise RuntimeError("pipeline p2p needs a store-backed "
+                                   "process group for the meta handshake")
+            # store.get blocks until the sender publishes (one-time)
+            self._recv_meta[(peer, tag)] = pickle.loads(
+                store.get(self._meta_key(peer, self.global_rank, tag)))
+        return self._recv_meta[(peer, tag)]
+
+    def _send_tensor(self, t: Tensor, dst, tag: str = "fwd"):
+        self._ensure_send_meta(t, dst, tag)
+        pg = self.pp_group.process_group
+        if self._p2p_buffered and hasattr(pg, "send_buffered"):
+            pg.send_buffered(t, dst)
+        else:
+            dist.send(t, dst, group=self.pp_group)
+
+    def _recv_tensor(self, src, tag: str = "fwd") -> Tensor:
+        import jax.numpy as jnp
+
+        shape, dtype = self._ensure_recv_meta(src, tag)
+        buf = Tensor(jnp.zeros(shape, dtype=jnp.dtype(dtype)))
+        pg = self.pp_group.process_group
+        if self._p2p_buffered and hasattr(pg, "recv_buffered"):
+            pg.recv_buffered(buf, src)
+        else:
+            dist.recv(buf, src, group=self.pp_group)
+        buf.stop_gradient = False
+        return buf
+
+    def _sendrecv_tensor(self, t: Tensor, peer, send_tag: str,
+                         recv_tag: str) -> Tensor:
+        """Combined send+recv with one peer — the
+        send_forward_recv_backward / send_backward_recv_forward analog
+        (reference pp_utils/p2p_communication.py:573). On the XLA backend
+        this is ONE bidirectional compiled program, which keeps the
+        per-pair program order identical on both endpoints (solitary
+        send+recv in opposite orders would deadlock the device queues)."""
+        import jax.numpy as jnp
+
+        self._ensure_send_meta(t, peer, send_tag)
+        shape, dtype = self._ensure_recv_meta(peer, recv_tag)
+        buf = Tensor(jnp.zeros(shape, dtype=jnp.dtype(dtype)))
+        self.pp_group.process_group.sendrecv(t, buf, peer)
         buf.stop_gradient = False
         return buf
 
     # ---------------------------------------------------------- schedule
-    def _forward_micro(self, i, micro_inputs, losses, scaler, num_micro):
-        """Shared fwd step: recv -> forward -> (loss|send). Returns
-        (stage_input, stage_output)."""
-        if self.is_first:
-            x = micro_inputs[i][0] if micro_inputs else None
-        else:
-            x = self._recv_tensor(self.prev_rank)
+    def _compute_fwd(self, i, x, micro_inputs, losses, scaler, num_micro):
+        """Forward compute for one micro-batch (no communication)."""
         out = self._layers.forward(x)
         if self.is_last:
             loss_fn = self._layers._loss_fn
@@ -287,9 +347,21 @@ class PipelineParallel(_MetaParallelBase):
                 out = scaler.scale(out)
             out = out / num_micro
             losses.append(out)
-        else:
-            self._send_tensor(out.detach(), self.next_rank)
-        return x, out
+        return out
+
+    def _first_input(self, i, micro_inputs):
+        return micro_inputs[i][0] if micro_inputs else None
+
+    def _input_grad(self, x):
+        """Grad to ship upstream; zeros keep the p2p pairing intact when a
+        stage input happens not to receive a gradient."""
+        if self.is_first or x is None:
+            return None
+        if x.grad is None:
+            import jax.numpy as jnp
+
+            return Tensor(jnp.zeros_like(x._data))
+        return x.grad
 
     def _sum_losses(self, losses):
         if self.is_last and losses:
@@ -299,48 +371,92 @@ class PipelineParallel(_MetaParallelBase):
             return total.detach()
         return None
 
-    def forward_backward_pipeline(self, data, scaler=None):
-        """1F1B (reference: pipeline_parallel.py:575)."""
+    def _run_1f1b(self, micro_inputs, fwd, bwd, post_slot=None):
+        """Shared warmup/steady/cooldown comm driver for the 1F1B-family
+        schedules (reference: pipeline_parallel.py:575, steady loop :649).
+
+        ``fwd(i, x) -> out`` and ``bwd(i, grad) -> upstream grad|None``
+        are compute-only callbacks; ``post_slot(n_bwd_done)`` is an
+        optional per-steady-slot hook (the ZB deferred-W slot).
+
+        Warmup/cooldown use solitary send/recv; the steady phase uses the
+        COMBINED send_forward_recv_backward / send_backward_recv_forward
+        ops (reference pp_utils p2p batched isend/irecv) — on the XLA
+        backend each combined op is one bidirectional compiled program, so
+        the per-pair program queues pair up in the same order on both
+        endpoints (solitary ops in 1F1B's naturally opposite orders would
+        deadlock the device queues)."""
         num_micro = self.accumulate_steps
         num_warmup = min(self.num_stages - self.stage_id - 1, num_micro)
         num_steady = num_micro - num_warmup
 
+        fwd_i = bwd_i = 0
+        for _ in range(num_warmup):
+            x = self._first_input(fwd_i, micro_inputs) if self.is_first \
+                else self._recv_tensor(self.prev_rank, tag="fwd")
+            out = fwd(fwd_i, x)
+            if not self.is_last:
+                self._send_tensor(out.detach(), self.next_rank, tag="fwd")
+            fwd_i += 1
+
+        x = None
+        if num_steady > 0:
+            x = self._first_input(fwd_i, micro_inputs) if self.is_first \
+                else self._recv_tensor(self.prev_rank, tag="fwd")
+        for k in range(num_steady):
+            out = fwd(fwd_i, x)
+            fwd_i += 1
+            grad = None if self.is_last else self._sendrecv_tensor(
+                out.detach(), self.next_rank, send_tag="fwd",
+                recv_tag="bwd")
+            gx = bwd(bwd_i, grad)
+            bwd_i += 1
+            last_iter = k == num_steady - 1
+            if self.is_first:
+                x = None if last_iter \
+                    else self._first_input(fwd_i, micro_inputs)
+            elif last_iter:
+                self._send_tensor(gx, self.prev_rank, tag="bwd")
+            else:
+                x = self._sendrecv_tensor(gx, self.prev_rank,
+                                          send_tag="bwd", recv_tag="fwd")
+            if post_slot is not None:
+                post_slot(bwd_i)
+        while bwd_i < num_micro:
+            grad = None if self.is_last else \
+                self._recv_tensor(self.next_rank, tag="bwd")
+            gx = bwd(bwd_i, grad)
+            bwd_i += 1
+            if gx is not None:
+                self._send_tensor(gx, self.prev_rank, tag="bwd")
+
+    def forward_backward_pipeline(self, data, scaler=None):
+        """1F1B over the shared comm driver."""
+        num_micro = self.accumulate_steps
         micro_inputs = self._split_micro(data, num_micro)
         input_buffers: List[Optional[Tensor]] = []
         output_buffers: List[Optional[Tensor]] = []
         losses = []
 
-        def fwd_step(i):
-            x, out = self._forward_micro(i, micro_inputs, losses, scaler,
-                                         num_micro)
-            output_buffers.append(out)
+        def fwd(i, x):
+            out = self._compute_fwd(i, x, micro_inputs, losses, scaler,
+                                    num_micro)
             input_buffers.append(x)
+            output_buffers.append(out)
+            return out
 
-        def bwd_step(i):
+        def bwd(i, grad):
             out = output_buffers[i]
             if self.is_last:
                 out.backward()
             else:
-                grad = self._recv_tensor(self.next_rank)
                 out.backward(grad)
-            x = input_buffers[i]
-            if not self.is_first and x is not None and x.grad is not None:
-                self._send_tensor(x.grad, self.prev_rank)
+            output_buffers[i] = None
+            gx = self._input_grad(input_buffers[i])
+            input_buffers[i] = None  # cap live activations to the window
+            return gx
 
-        fwd_i = 0
-        bwd_i = 0
-        for _ in range(num_warmup):
-            fwd_step(fwd_i)
-            fwd_i += 1
-        for _ in range(num_steady):
-            fwd_step(fwd_i)
-            fwd_i += 1
-            bwd_step(bwd_i)
-            bwd_i += 1
-        while bwd_i < num_micro:
-            bwd_step(bwd_i)
-            bwd_i += 1
-
+        self._run_1f1b(micro_inputs, fwd, bwd)
         return self._sum_losses(losses)
 
     def _split_micro(self, data, num_micro):
@@ -404,6 +520,9 @@ class PipelineParallelWithInterleave(PipelineParallel):
     For the zero-bubble B/W-split schedule see PipelineParallelZeroBubble.
     """
 
+    # VPP's solitary op order is endpoint-asymmetric; see _p2p_buffered
+    _p2p_buffered = True
+
     def __init__(self, layers, hcg, strategy=None):
         super().__init__(layers, hcg, strategy)
         self.num_chunks = layers.get_num_virtual_stages()
@@ -446,10 +565,14 @@ class PipelineParallelWithInterleave(PipelineParallel):
 
         def fwd_step(k):
             chunk, micro = self._virt(k)
+            vs = chunk * p + self.stage_id  # virtual stage id
             if is_first_vs(chunk):
                 x = micro_inputs[micro][0] if micro_inputs else None
             else:
-                x = self._recv_tensor(self._ring_prev(), tag="fwd")
+                # channel = the virtual edge (vs-1 -> vs); per-edge tags
+                # keep every channel fixed-shape even when chunk
+                # boundaries differ (device-path p2p requires it)
+                x = self._recv_tensor(self._ring_prev(), tag=f"fwd{vs - 1}")
             out = self._layers.forward_chunk(x, chunk)
             if is_last_vs(chunk):
                 loss_fn = self._layers._loss_fn
@@ -460,7 +583,8 @@ class PipelineParallelWithInterleave(PipelineParallel):
                 out = out / num_micro
                 losses.append(out)
             else:
-                self._send_tensor(out.detach(), self._ring_next(), tag="fwd")
+                self._send_tensor(out.detach(), self._ring_next(),
+                                  tag=f"fwd{vs}")
             inputs[chunk][micro] = x
             outputs[chunk][micro] = out
 
@@ -468,16 +592,18 @@ class PipelineParallelWithInterleave(PipelineParallel):
             # backward visits virtual stages in reverse chunk order
             chunk, micro = self._virt(k)
             chunk = v - 1 - chunk
+            vs = chunk * p + self.stage_id
             out = outputs[chunk][micro]
             if is_last_vs(chunk):
                 out.backward()
             else:
-                grad = self._recv_tensor(self._ring_next(), tag="bwd")
+                grad = self._recv_tensor(self._ring_next(),
+                                         tag=f"bwd{vs + 1}")
                 out.backward(grad)
             x = inputs[chunk][micro]
             if not is_first_vs(chunk) and x is not None \
                     and x.grad is not None:
-                self._send_tensor(x.grad, self._ring_prev(), tag="bwd")
+                self._send_tensor(x.grad, self._ring_prev(), tag=f"bwd{vs}")
 
         warmup = min((p - self.stage_id - 1) * 2 + (v - 1) * p, total)
         fwd_k = bwd_k = 0
@@ -519,9 +645,6 @@ class PipelineParallelZeroBubble(PipelineParallel):
         from ...core.autograd import grad as _tape_grad
 
         num_micro = self.accumulate_steps
-        num_warmup = min(self.num_stages - self.stage_id - 1, num_micro)
-        num_steady = num_micro - num_warmup
-
         micro_inputs = self._split_micro(data, num_micro)
         inputs: List[Optional[Tensor]] = []
         outputs: List[Optional[Tensor]] = []
@@ -530,32 +653,34 @@ class PipelineParallelZeroBubble(PipelineParallel):
         params = [p for p in self._layers.parameters()
                   if not p.stop_gradient]
 
-        def fwd_step(i):
-            x, out = self._forward_micro(i, micro_inputs, losses, scaler,
-                                         num_micro)
+        def fwd(i, x):
+            out = self._compute_fwd(i, x, micro_inputs, losses, scaler,
+                                    num_micro)
             inputs.append(x)
             outputs.append(out)
+            return out
 
-        def b_step(i):
-            """One backward walk; the INPUT grad is shipped upstream
-            immediately (the inter-stage dependency), the weight grads are
+        def b_walk(i, g_out):
+            """One backward walk; returns the INPUT grad (the inter-stage
+            dependency, shipped upstream by the caller); weight grads are
             stashed for the deferred W slot (accumulation + hooks)."""
             out = outputs[i]
-            if self.is_last:
-                g_out = None
-            else:
-                g_out = self._recv_tensor(self.next_rank)
             x = inputs[i]
             targets = ([x] if not self.is_first and x is not None
                        else []) + params
             grads = _tape_grad([out], targets, grad_outputs=g_out,
                                retain_graph=False, allow_unused=True)
+            gx = None
             if not self.is_first and x is not None:
                 gx, grads = grads[0], grads[1:]
-                if gx is not None:
-                    self._send_tensor(gx, self.prev_rank)
+                if gx is None:
+                    import jax.numpy as jnp
+
+                    gx = Tensor(jnp.zeros_like(x._data))
             pending_w.append(list(grads))
             outputs[i] = None  # graph freed by the walk
+            inputs[i] = None   # cap live activations to the window
+            return gx
 
         def w_step(i):
             """Deferred weight-grad accumulation for micro i; fires grad
@@ -575,24 +700,17 @@ class PipelineParallelZeroBubble(PipelineParallel):
                         p._grad = res
             pending_w[i] = None
 
-        fwd_i = b_i = w_i = 0
-        for _ in range(num_warmup):
-            fwd_step(fwd_i)
-            fwd_i += 1
-        for _ in range(num_steady):
-            fwd_step(fwd_i)
-            fwd_i += 1
-            b_step(b_i)
-            b_i += 1
+        w_state = {"w": 0}
+
+        def post_slot(b_done):
             # ZB-H1: one deferred W per steady slot keeps memory flat
-            if b_i - w_i > self.num_stages - self.stage_id:
-                w_step(w_i)
-                w_i += 1
-        while b_i < num_micro:
-            b_step(b_i)
-            b_i += 1
-        while w_i < num_micro:  # W fills the cooldown bubble
-            w_step(w_i)
-            w_i += 1
+            if b_done - w_state["w"] > self.num_stages - self.stage_id:
+                w_step(w_state["w"])
+                w_state["w"] += 1
+
+        self._run_1f1b(micro_inputs, fwd, b_walk, post_slot=post_slot)
+        while w_state["w"] < num_micro:  # W fills the cooldown bubble
+            w_step(w_state["w"])
+            w_state["w"] += 1
 
         return self._sum_losses(losses)

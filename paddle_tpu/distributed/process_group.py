@@ -74,6 +74,7 @@ class ProcessGroup:
         self._world_size = world_size
         self._gid = gid
         self._group_ranks = group_ranks or list(range(world_size))
+        self._coalescing = None  # list of (tensor, op) while coalescing
 
     def _g2l(self, r: int) -> int:
         """Translate a GLOBAL peer rank (the public-API convention,
@@ -100,109 +101,162 @@ class ProcessGroup:
 
         return watchdog.watch(op_name, self._gid)
 
-    # -- collective API: subclasses implement the _impl methods on numpy ----
-    def all_reduce(self, tensor: Tensor, op=ReduceOp.SUM, sync_op=True):
-        with self._watched("all_reduce"):
-            out = self._all_reduce_impl(tensor.numpy(), op)
+    # -- buffer access hooks: backends choose host (numpy) or device (jax)
+    # residency. The CPU/store backend moves numpy; ProcessGroupXLA
+    # overrides these to keep arrays on device end to end.
+    def _get_local(self, tensor: Tensor):
+        return tensor.numpy()
+
+    def _put_local(self, tensor: Tensor, out):
         tensor._data = _to_jax(out, tensor)
+
+    # -- collective API: subclasses implement the _impl methods -------------
+    def all_reduce(self, tensor: Tensor, op=ReduceOp.SUM, sync_op=True):
+        if self._coalescing is not None:
+            self._coalescing.append((tensor, op))
+            return Task()
+        with self._watched("all_reduce"):
+            out = self._all_reduce_impl(self._get_local(tensor), op)
+        self._put_local(tensor, out)
         return Task()
 
     def broadcast(self, tensor: Tensor, src: int, sync_op=True):
         src = self._g2l(src)
         with self._watched("broadcast"):
-            out = self._broadcast_impl(tensor.numpy(), src)
-        tensor._data = _to_jax(out, tensor)
+            out = self._broadcast_impl(self._get_local(tensor), src)
+        self._put_local(tensor, out)
         return Task()
 
     def all_gather(self, tensor_list: List[Tensor], tensor: Tensor,
                    sync_op=True):
         with self._watched("all_gather"):
-            outs = self._all_gather_impl(tensor.numpy())
+            outs = self._all_gather_impl(self._get_local(tensor))
         if tensor_list is not None:
             if len(tensor_list) == 0:
                 tensor_list.extend(Tensor(o) for o in outs)
             else:
                 for t, o in zip(tensor_list, outs):
-                    t._data = _to_jax(o, t)
+                    self._put_local(t, o)
         return Task()
 
     def reduce(self, tensor: Tensor, dst: int, op=ReduceOp.SUM, sync_op=True):
         dst = self._g2l(dst)
         with self._watched("reduce"):
-            out = self._reduce_impl(tensor.numpy(), dst, op)
+            out = self._reduce_impl(self._get_local(tensor), dst, op)
         if self._rank == dst:
-            tensor._data = _to_jax(out, tensor)
+            self._put_local(tensor, out)
         return Task()
 
     def reduce_scatter(self, tensor: Tensor, tensor_list: List[Tensor],
                        op=ReduceOp.SUM, sync_op=True):
-        ins = [t.numpy() for t in tensor_list]
+        ins = [self._get_local(t) for t in tensor_list]
         with self._watched("reduce_scatter"):
             out = self._reduce_scatter_impl(ins, op)
-        tensor._data = _to_jax(out, tensor)
+        self._put_local(tensor, out)
         return Task()
 
     def scatter(self, tensor: Tensor, tensor_list: List[Tensor], src: int,
                 sync_op=True):
         src = self._g2l(src)
-        ins = [t.numpy() for t in tensor_list] if self._rank == src else None
+        ins = [self._get_local(t) for t in tensor_list] \
+            if self._rank == src else None
+        buf = self._get_local(tensor)
         with self._watched("scatter"):
-            out = self._scatter_impl(ins, src,
-                                     shape=tensor.numpy().shape,
-                                     dtype=tensor.numpy().dtype)
-        tensor._data = _to_jax(out, tensor)
+            out = self._scatter_impl(ins, src, shape=buf.shape,
+                                     dtype=buf.dtype)
+        self._put_local(tensor, out)
         return Task()
 
     def gather(self, tensor: Tensor, gather_list: Optional[List[Tensor]],
                dst: int, sync_op=True):
         dst = self._g2l(dst)
         with self._watched("gather"):
-            outs = self._gather_impl(tensor.numpy(), dst)
+            outs = self._gather_impl(self._get_local(tensor), dst)
         if self._rank == dst and gather_list is not None:
             if len(gather_list) == 0:
                 gather_list.extend(Tensor(o) for o in outs)
             else:
                 for t, o in zip(gather_list, outs):
-                    t._data = _to_jax(o, t)
+                    self._put_local(t, o)
         return Task()
 
     def all_to_all(self, out_tensor_list: List[Tensor],
                    in_tensor_list: List[Tensor], sync_op=True):
         with self._watched("all_to_all"):
             outs = self._all_to_all_impl(
-                [t.numpy() for t in in_tensor_list])
+                [self._get_local(t) for t in in_tensor_list])
         if len(out_tensor_list) == 0:
             out_tensor_list.extend(Tensor(o) for o in outs)
         else:
             for t, o in zip(out_tensor_list, outs):
-                t._data = _to_jax(o, t)
+                self._put_local(t, o)
         return Task()
 
     def send(self, tensor: Tensor, dst: int, sync_op=True):
         dst = self._g2l(dst)
         with self._watched("send"):
-            self._send_impl(tensor.numpy(), dst)
+            self._send_impl(self._get_local(tensor), dst)
         return Task()
 
     def recv(self, tensor: Tensor, src: int, sync_op=True):
         src = self._g2l(src)
+        buf = self._get_local(tensor)
         with self._watched("recv"):
-            out = self._recv_impl(src, tensor.numpy().shape,
-                                  tensor.numpy().dtype)
-        tensor._data = _to_jax(out, tensor)
+            out = self._recv_impl(src, buf.shape, buf.dtype)
+        self._put_local(tensor, out)
         return Task()
+
+    def sendrecv(self, send_tensor: Tensor, recv_tensor: Tensor, peer: int,
+                 sync_op=True):
+        """Combined send+recv with the SAME peer (the batched-isend/irecv
+        role of reference pp_utils send_forward_recv_backward). Backends
+        with paired device p2p (XLA) launch it as ONE bidirectional
+        program so per-pair launch order matches on both endpoints; the
+        buffered store backend just sequences the two ops."""
+        p = self._g2l(peer)
+        buf = self._get_local(recv_tensor)
+        with self._watched("sendrecv"):
+            out = self._sendrecv_impl(self._get_local(send_tensor), p,
+                                      buf.shape, buf.dtype)
+        self._put_local(recv_tensor, out)
+        return Task()
+
+    def _sendrecv_impl(self, send_arr, peer, shape, dtype):
+        self._send_impl(send_arr, peer)
+        return self._recv_impl(peer, shape, dtype)
 
     def barrier(self, device_id: Optional[int] = None):
         with self._watched("barrier"):
             self._barrier_impl()
         return Task()
 
-    # -- coalescing (reference: process_group.h:119-121) --------------------
+    # -- coalescing (reference: process_group.h:119-121; NCCL semantics
+    # process_group_nccl.cc:972-976 — buffer the collectives, launch as a
+    # batch on end). all_reduce between start/end is deferred; end flushes
+    # through _coalesced_all_reduce_impl (one compiled program on XLA).
     def start_coalescing(self):
-        pass
+        if self._coalescing is not None:
+            raise RuntimeError(
+                "start_coalescing while a coalescing window is already "
+                "open; call end_coalescing first (use try/finally around "
+                "the window so an exception cannot leave deferred "
+                "all_reduces pending forever)")
+        self._coalescing = []
 
     def end_coalescing(self):
-        pass
+        items, self._coalescing = self._coalescing, None
+        if not items:
+            return Task()
+        with self._watched("coalesced_all_reduce"):
+            outs = self._coalesced_all_reduce_impl(
+                [self._get_local(t) for t, _ in items],
+                [op for _, op in items])
+        for (t, _), o in zip(items, outs):
+            self._put_local(t, o)
+        return Task()
+
+    def _coalesced_all_reduce_impl(self, arrs, ops):
+        return [self._all_reduce_impl(a, op) for a, op in zip(arrs, ops)]
 
 
 def _to_jax(arr: np.ndarray, like: Tensor):
