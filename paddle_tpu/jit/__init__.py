@@ -31,7 +31,8 @@ from ..core.tensor import Tensor
 from ..nn.layer.layers import Layer
 
 __all__ = ["to_static", "not_to_static", "save", "load", "ignore_module",
-           "enable_to_static", "TranslatedLayer", "InputSpec", "TrainStep"]
+           "enable_to_static", "TranslatedLayer", "InputSpec", "TrainStep",
+           "ChunkPrefetcher"]
 
 _to_static_enabled = True
 
@@ -314,4 +315,4 @@ def load(path, **configs):
 
     return TranslatedLayer(payload.get("state_dict", {}),
                            payload.get("config", {}), forward_fn=forward_fn)
-from .train_step import TrainStep  # noqa: F401,E402
+from .train_step import ChunkPrefetcher, TrainStep  # noqa: F401,E402

@@ -27,7 +27,100 @@ from ..distributed.auto_parallel.constraint import filtered_spec, param_spec
 from ..nn.layer.layers import Layer
 from ..optimizer.optimizer import Optimizer
 
-__all__ = ["TrainStep"]
+__all__ = ["TrainStep", "ChunkPrefetcher"]
+
+
+class ChunkPrefetcher:
+    """Assembles ``[n, ...]`` stacked chunks from a batch iterator on a
+    background thread while the device runs the current chunk (the
+    DataLoader-feeding-every-step analog of reference
+    python/paddle/io/reader.py:262 + fluid/framework/data_feed.cc).
+
+    ``source`` yields per-step batches (tuples/lists of arrays or
+    Tensors); each chunk stacks ``n`` of them along a new leading axis,
+    ready for ``TrainStep.run_steps_stream``. A trailing partial group
+    (fewer than ``n`` batches) is dropped, like drop_last.
+    """
+
+    _SENTINEL = object()
+
+    def __init__(self, source, n: int, depth: int = 2):
+        import queue
+        import threading
+
+        if n <= 0:
+            raise ValueError(f"chunk size must be >= 1, got {n}")
+        self._n = n
+        self._q = queue.Queue(maxsize=max(depth, 1))
+        self._stop = threading.Event()
+        self._terminal = None  # StopIteration / surfaced error, sticky
+        self._thread = threading.Thread(
+            target=self._fill, args=(iter(source),), daemon=True)
+        self._thread.start()
+
+    def _put(self, item) -> bool:
+        """Bounded put that aborts when close() poisons the feeder."""
+        import queue
+
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _fill(self, it):
+        import numpy as np
+
+        try:
+            while not self._stop.is_set():
+                group = []
+                for _ in range(self._n):
+                    try:
+                        group.append(next(it))
+                    except StopIteration:
+                        self._put(self._SENTINEL)
+                        return
+                group = [b if isinstance(b, (tuple, list)) else (b,)
+                         for b in group]
+                chunk = tuple(
+                    np.stack([np.asarray(
+                        b[i]._data if isinstance(b[i], Tensor) else b[i])
+                        for b in group])
+                    for i in range(len(group[0])))
+                if not self._put(chunk):
+                    return
+        except BaseException as e:  # surfaced on the consumer side
+            self._put(e)
+
+    def close(self):
+        """Stop the fill thread and release buffered chunks (call when
+        abandoning iteration early)."""
+        import queue
+
+        self._stop.set()
+        self._terminal = StopIteration()
+        while True:
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._terminal is not None:
+            raise self._terminal
+        item = self._q.get()
+        if item is self._SENTINEL:
+            self._terminal = StopIteration()
+            raise self._terminal
+        if isinstance(item, BaseException):
+            self._terminal = item
+            raise item
+        return item
 
 
 def _tree_map_specs(state, like_specs, mesh):
@@ -250,21 +343,37 @@ class TrainStep:
         self.sync_params_to_model()
         return Tensor(loss)
 
-    def _prepare_batch(self, batch):
+    def _prepare_batch(self, batch, leading_steps: Optional[int] = None):
+        """Convert/validate/shard a batch. With ``leading_steps=n`` the
+        arrays are stacked per-step chunks [n, batch, ...]: the leading
+        axis must equal n, the divisibility check applies to the INNER
+        batch dim, and shardings gain a replicated leading axis."""
         arrays = tuple(b._data if isinstance(b, Tensor) else jnp.asarray(b)
                        for b in batch)
+        bdim = 0 if leading_steps is None else 1
+        if leading_steps is not None:
+            for a in arrays:
+                if not a.ndim or a.shape[0] != leading_steps:
+                    raise ValueError(
+                        f"run_steps_stream({leading_steps}): stacked "
+                        f"arrays need leading dim {leading_steps}, "
+                        f"got {a.shape}")
         if self.accumulate_steps > 1:
             for a in arrays:
-                if a.ndim and a.shape[0] % self.accumulate_steps:
+                if a.ndim > bdim and a.shape[bdim] % self.accumulate_steps:
                     raise ValueError(
-                        f"gradient merge: batch dim {a.shape[0]} is not "
+                        f"gradient merge: batch dim {a.shape[bdim]} is not "
                         f"divisible by accumulate_steps="
                         f"{self.accumulate_steps}")
         if self._mesh is not None and self._batch_specs is not None:
-            arrays = tuple(
-                jax.device_put(a, NamedSharding(
-                    self._mesh, filtered_spec(s, self._mesh)))
-                for a, s in zip(arrays, self._batch_specs))
+            def shard(s):
+                spec = filtered_spec(s, self._mesh)
+                if leading_steps is not None:
+                    spec = PartitionSpec(None, *spec)
+                return NamedSharding(self._mesh, spec)
+
+            arrays = tuple(jax.device_put(a, shard(s))
+                           for a, s in zip(arrays, self._batch_specs))
         return arrays
 
     def run_steps(self, n: int, *batch):
@@ -304,6 +413,90 @@ class TrainStep:
         lr = jnp.asarray(self.optimizer.get_lr(), dtype=jnp.float32)
         loss, self.param_arrays, self.opt_state = self._multi_jitted[n](
             keys, lr, tuple(self.param_arrays), self.opt_state, *arrays)
+        self._step_count += n
+        self.sync_params_to_model()
+        return Tensor(loss)
+
+    def _chunk_lrs(self, n: int):
+        """Per-step learning rates for an n-step chunk; advances a host
+        LRScheduler by n so chunked training matches the step-by-step
+        schedule (fixes the frozen-LR caveat of run_steps)."""
+        from ..optimizer.lr import LRScheduler
+
+        lr = self.optimizer._learning_rate
+        if isinstance(lr, LRScheduler):
+            vals = []
+            for _ in range(n):
+                vals.append(float(lr()))
+                lr.step()
+            return jnp.asarray(vals, jnp.float32)
+        return jnp.full((n,), float(lr), jnp.float32)
+
+    def run_steps_stream(self, n: int, *stacked, lrs=None):
+        """``n`` chained optimizer steps in ONE dispatch, each step
+        consuming its OWN batch slice from ``stacked`` arrays of shape
+        ``[n, batch, ...]`` and its own learning rate — genuine training
+        on fresh data per step, not the same-batch replay of
+        ``run_steps`` (reference analog: the DataLoader feeding every
+        executor step, python/paddle/io/reader.py:262).
+
+        ``lrs`` is an optional ``[n]`` float32 array; by default it is
+        generated from the optimizer's scheduler (advancing it n steps).
+        Pair with ``ChunkPrefetcher`` to assemble the next chunk on the
+        host while the device runs the current one.
+        """
+        if n <= 0:
+            raise ValueError(f"run_steps_stream needs n >= 1, got {n}")
+        cache_key = ("stream", n)
+        if cache_key not in self._multi_jitted:
+            pure = self._pure_step
+
+            def multi(keys, lrs, params, state, *stacked_arrays):
+                def body(carry, xs):
+                    params, state = carry
+                    key, lr = xs[0], xs[1]
+                    mb = xs[2:]
+                    loss, params, state = pure(key, lr, params, state, *mb)
+                    return (params, state), loss
+
+                (params, state), losses = jax.lax.scan(
+                    body, (params, state), (keys, lrs) + stacked_arrays)
+                return losses[-1], params, state
+
+            kwargs = dict(self._jit_kwargs)
+            if "in_shardings" in kwargs:
+                repl, _, pspecs, state_specs = kwargs["in_shardings"][:4]
+                stream_specs = tuple(
+                    NamedSharding(self._mesh, PartitionSpec(
+                        None, *filtered_spec(b, self._mesh)))
+                    for b in self._batch_specs)
+                kwargs["in_shardings"] = (repl, repl, pspecs, state_specs,
+                                          *stream_specs)
+            self._multi_jitted[cache_key] = jax.jit(multi, **kwargs)
+        arrays = self._prepare_batch(stacked, leading_steps=n)
+        if lrs is not None:
+            lrs = jnp.asarray(lrs, jnp.float32)
+            if lrs.shape != (n,):
+                raise ValueError(f"lrs must have shape ({n},), "
+                                 f"got {lrs.shape}")
+        # snapshot the scheduler so a trace-time failure doesn't leave the
+        # host LR schedule advanced past the steps that never ran
+        from ..optimizer.lr import LRScheduler
+
+        sched = self.optimizer._learning_rate
+        snapshot = sched.state_dict() if (
+            lrs is None and isinstance(sched, LRScheduler)) else None
+        if lrs is None:
+            lrs = self._chunk_lrs(n)
+        keys = jnp.stack([_rng.next_key() for _ in range(n)])
+        try:
+            loss, self.param_arrays, self.opt_state = self._multi_jitted[
+                cache_key](keys, lrs, tuple(self.param_arrays),
+                           self.opt_state, *arrays)
+        except Exception:
+            if snapshot is not None:
+                sched.set_state_dict(snapshot)
+            raise
         self._step_count += n
         self.sync_params_to_model()
         return Tensor(loss)

@@ -1,0 +1,171 @@
+"""Real-data compiled training: run_steps_stream consumes one fresh batch
+slice per scanned step with per-step LR, and ChunkPrefetcher assembles
+chunks on a background thread (VERDICT r2 next #4; reference analog: the
+DataLoader feeding every executor step, python/paddle/io/reader.py:262 +
+fluid/framework/data_feed.cc)."""
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu.jit import ChunkPrefetcher, TrainStep
+
+
+def _mlp(seed=0):
+    pt.seed(seed)
+    return pt.nn.Sequential(pt.nn.Linear(6, 16), pt.nn.Tanh(),
+                            pt.nn.Linear(16, 1))
+
+
+def _loss_fn(model, x, y):
+    return ((model(x) - y) ** 2).mean()
+
+
+def _batches(k, n=8):
+    rng = np.random.RandomState(42)
+    return [(rng.randn(n, 6).astype(np.float32),
+             rng.randn(n, 1).astype(np.float32)) for _ in range(k)]
+
+
+def test_stream_matches_stepwise():
+    """run_steps_stream over stacked per-step batches == the same batches
+    fed one __call__ at a time (same LR, no dropout)."""
+    data = _batches(6)
+
+    m1 = _mlp()
+    o1 = pt.optimizer.AdamW(learning_rate=1e-2, parameters=m1.parameters())
+    s1 = TrainStep(m1, o1, loss_fn=_loss_fn)
+    for x, y in data:
+        last1 = s1(x, y)
+
+    m2 = _mlp()
+    o2 = pt.optimizer.AdamW(learning_rate=1e-2, parameters=m2.parameters())
+    s2 = TrainStep(m2, o2, loss_fn=_loss_fn)
+    xs = np.stack([x for x, _ in data])
+    ys = np.stack([y for _, y in data])
+    last2 = s2.run_steps_stream(len(data), xs, ys)
+
+    np.testing.assert_allclose(float(last1), float(last2), rtol=1e-5)
+    for p1, p2 in zip(m1.parameters(), m2.parameters()):
+        np.testing.assert_allclose(np.asarray(p1._data),
+                                   np.asarray(p2._data), rtol=1e-5,
+                                   atol=1e-6)
+
+
+def test_stream_per_step_lr_scheduler():
+    """The chunk consumes one scheduler LR per step and advances the host
+    scheduler, matching a step-by-step loop with scheduler.step()."""
+    data = _batches(4)
+    sched_kwargs = dict(learning_rate=0.05, step_size=2, gamma=0.1)
+
+    m1 = _mlp(1)
+    sch1 = pt.optimizer.lr.StepDecay(**sched_kwargs)
+    o1 = pt.optimizer.SGD(learning_rate=sch1, parameters=m1.parameters())
+    s1 = TrainStep(m1, o1, loss_fn=_loss_fn)
+    for x, y in data:
+        s1(x, y)
+        sch1.step()
+
+    m2 = _mlp(1)
+    sch2 = pt.optimizer.lr.StepDecay(**sched_kwargs)
+    o2 = pt.optimizer.SGD(learning_rate=sch2, parameters=m2.parameters())
+    s2 = TrainStep(m2, o2, loss_fn=_loss_fn)
+    xs = np.stack([x for x, _ in data])
+    ys = np.stack([y for _, y in data])
+    s2.run_steps_stream(len(data), xs, ys)
+
+    # host scheduler advanced by the chunk length
+    assert abs(float(sch2()) - float(sch1())) < 1e-12
+    for p1, p2 in zip(m1.parameters(), m2.parameters()):
+        np.testing.assert_allclose(np.asarray(p1._data),
+                                   np.asarray(p2._data), rtol=1e-5,
+                                   atol=1e-6)
+
+
+def test_chunk_prefetcher_chunks_and_order():
+    data = _batches(7, n=4)
+    chunks = list(ChunkPrefetcher(iter(data), n=3))
+    assert len(chunks) == 2  # trailing partial group dropped
+    for ci, chunk in enumerate(chunks):
+        xs, ys = chunk
+        assert xs.shape == (3, 4, 6) and ys.shape == (3, 4, 1)
+        for j in range(3):
+            np.testing.assert_array_equal(xs[j], data[ci * 3 + j][0])
+
+
+def test_stream_with_prefetcher_trains():
+    rng = np.random.RandomState(0)
+    W = rng.randn(6, 1).astype(np.float32)
+
+    def gen():
+        r = np.random.RandomState(1)
+        for _ in range(12):
+            x = r.randn(16, 6).astype(np.float32)
+            yield x, x @ W
+
+    m = _mlp(2)
+    o = pt.optimizer.AdamW(learning_rate=1e-2, parameters=m.parameters())
+    s = TrainStep(m, o, loss_fn=_loss_fn)
+    losses = []
+    for xs, ys in ChunkPrefetcher(gen(), n=4):
+        losses.append(float(s.run_steps_stream(4, xs, ys)))
+    assert len(losses) == 3
+    assert losses[-1] < losses[0]
+
+
+def test_stream_rejects_bad_shapes():
+    import pytest
+
+    m = _mlp(3)
+    o = pt.optimizer.SGD(learning_rate=0.1, parameters=m.parameters())
+    s = TrainStep(m, o, loss_fn=_loss_fn)
+    xs = np.zeros((2, 4, 6), np.float32)
+    ys = np.zeros((2, 4, 1), np.float32)
+    with pytest.raises(ValueError):
+        s.run_steps_stream(3, xs, ys)
+    with pytest.raises(ValueError):
+        s.run_steps_stream(2, xs, ys, lrs=np.zeros((3,), np.float32))
+
+
+def test_stream_sharded_mesh():
+    """run_steps_stream under a dp x mp mesh: the stacked batch keeps a
+    replicated leading step axis while inner dims follow batch_specs."""
+    import jax
+    import pytest
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    from paddle_tpu.distributed import ProcessMesh
+    from paddle_tpu.models import GPTForCausalLM, gpt_tiny
+
+    mesh = ProcessMesh(np.arange(8).reshape(2, 2, 2),
+                       dim_names=["dp", "sp", "mp"])
+    pt.seed(4)
+    cfg = gpt_tiny()
+    model = GPTForCausalLM(cfg)
+    opt = pt.optimizer.AdamW(learning_rate=1e-3,
+                             parameters=model.parameters())
+    step = TrainStep(model, opt, mesh=mesh, grad_clip_norm=1.0,
+                     batch_specs=[("dp", "sp"), ("dp", "sp")])
+    rng = np.random.RandomState(3)
+    n = 3
+    ids = rng.randint(0, cfg.vocab_size, (n, 4, 16)).astype(np.int32)
+    first = float(step(ids[0], ids[0]))
+    loss = step.run_steps_stream(n, ids, ids)
+    assert np.isfinite(float(loss))
+
+
+def test_chunk_prefetcher_terminal_and_close():
+    data = _batches(6, n=2)
+    pf = ChunkPrefetcher(iter(data), n=3)
+    assert len(list(pf)) == 2
+    import pytest
+
+    with pytest.raises(StopIteration):
+        next(pf)  # sticky terminal, no deadlock
+
+    pf2 = ChunkPrefetcher(iter(_batches(50, n=2)), n=2, depth=1)
+    next(pf2)
+    pf2.close()  # abandoning early releases the fill thread
+    pf2._thread.join(5)
+    assert not pf2._thread.is_alive()
+    with pytest.raises(StopIteration):
+        next(pf2)
