@@ -123,14 +123,21 @@ class ChunkPrefetcher:
         return item
 
 
-def _tree_map_specs(state, like_specs, mesh):
+def _tree_map_specs(state, like_specs, mesh, like_shapes=None):
     """Optimizer state entries shaped like a param inherit its sharding;
-    scalars are replicated. State is {"m": [per-param], ...} by convention:
-    any list matching len(params) inherits param specs."""
+    scalars (and entries whose shapes don't match, e.g. 8-bit quantized
+    moment codes/scales) are replicated. State is {"m": [per-param], ...}
+    by convention: any list matching len(params) inherits param specs."""
     out = {}
     for k, v in state.items():
         if isinstance(v, (list, tuple)) and len(v) == len(like_specs):
-            out[k] = [NamedSharding(mesh, s) for s in like_specs]
+            if like_shapes is None:
+                out[k] = [NamedSharding(mesh, s) for s in like_specs]
+            else:
+                out[k] = [
+                    NamedSharding(mesh, s) if tuple(e.shape) == tuple(sh)
+                    else NamedSharding(mesh, PartitionSpec())
+                    for e, s, sh in zip(v, like_specs, like_shapes)]
         else:
             out[k] = NamedSharding(mesh, PartitionSpec())
     return out
@@ -312,8 +319,22 @@ class TrainStep:
         if self._mesh is not None:
             mesh = self._mesh
             pspecs = tuple(NamedSharding(mesh, s) for s in self._param_specs)
-            state_specs = _tree_map_specs(self.opt_state, self._param_specs,
-                                          mesh)
+            state_specs = _tree_map_specs(
+                self.opt_state, self._param_specs, mesh,
+                like_shapes=[tuple(a.shape) for a in self.param_arrays])
+            # align the actual state arrays with the declared in_shardings
+            # (derived state, e.g. quantized moment codes, inherits
+            # computed shardings from the params it was built from; jit
+            # with explicit in_shardings rejects the mismatch)
+            placed = {}
+            for k, v in self.opt_state.items():
+                sp = state_specs[k]
+                if isinstance(v, (list, tuple)):
+                    placed[k] = [jax.device_put(e, s)
+                                 for e, s in zip(v, sp)]
+                else:
+                    placed[k] = jax.device_put(v, sp)
+            self.opt_state = placed
             repl = NamedSharding(mesh, PartitionSpec())
             bspecs = self._batch_specs
             if bspecs is not None:
