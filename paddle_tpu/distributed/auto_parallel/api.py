@@ -15,6 +15,7 @@ over the partial mesh axis; reshard materializes the reduction.
 """
 from __future__ import annotations
 
+import functools
 from typing import List, Optional, Sequence
 
 import numpy as np
@@ -101,6 +102,7 @@ def dtensor_from_local(local_tensor, mesh: ProcessMesh, placements) -> Tensor:
     from this process's local shard."""
     t = local_tensor if isinstance(local_tensor, Tensor) \
         else Tensor(jnp.asarray(local_tensor))
+    multiproc = jax.process_count() > 1
     partial_axes = [i for i, p in enumerate(placements)
                     if isinstance(p, Partial)]
     if partial_axes:
@@ -108,12 +110,46 @@ def dtensor_from_local(local_tensor, mesh: ProcessMesh, placements) -> Tensor:
         # axis sharded over the partial mesh axis
         axis = partial_axes[0]
         n = mesh.shape[axis]
+        if multiproc:
+            # every rank contributes ITS unreduced value along its slots
+            # of the hidden axis (the true SPMD semantics of Partial).
+            # A process owning D devices on the partial axis provides D
+            # slots of t/D so the global sum is still sum_p(t_p).
+            from jax.experimental import multihost_utils
+
+            jmesh = mesh.get_jax_mesh()
+            spec = _partial_hidden_spec(mesh, placements, t.ndim + 1)
+            me = jax.process_index()
+            axdevs = np.moveaxis(jmesh.devices, axis, 0)
+            own = [i for i in range(axdevs.shape[0])
+                   if any(d.process_index == me
+                          for d in np.ravel(axdevs[i]))]
+            d_local = max(len(own), 1)
+            local = jnp.broadcast_to(t._data[None] / d_local,
+                                     (d_local,) + tuple(t.shape))
+            garr = multihost_utils.host_local_array_to_global_array(
+                local, jmesh, spec)
+            out = Tensor(garr, stop_gradient=t.stop_gradient)
+            out._dist_attr = DistAttr(mesh, placements)
+            out._dist_attr._partial_hidden = True
+            return out
         stacked = jnp.broadcast_to(t._data[None] / n,
                                    (n,) + tuple(t.shape))
         return _place_partial_hidden(stacked, mesh, placements,
                                      t.stop_gradient)
     jmesh = mesh.get_jax_mesh()
     spec = _spec_for(placements, mesh, t.ndim)
+    if multiproc:
+        # true multi-process SPMD: the global array is assembled from the
+        # per-rank shards (reference semantics of dtensor_from_local,
+        # auto_parallel/api.py:631) — NOT by treating local as global
+        from jax.experimental import multihost_utils
+
+        garr = multihost_utils.host_local_array_to_global_array(
+            t._data, jmesh, spec)
+        out = Tensor(garr, stop_gradient=t.stop_gradient)
+        out._dist_attr = DistAttr(mesh, placements)
+        return out
     # local -> global: in single-process mode the "local" value is the shard
     # of a global array; reconstruct by tiling/concatenation semantics.
     # Single-controller: treat local as the global (tests construct global).
@@ -123,23 +159,36 @@ def dtensor_from_local(local_tensor, mesh: ProcessMesh, placements) -> Tensor:
     return out
 
 
+@functools.lru_cache(maxsize=None)
+def _replicated_identity(jmesh):
+    """Cached compiled all-gather-to-replicated over a mesh (fresh
+    lambdas per call would defeat the jit cache)."""
+    return jax.jit(lambda x: x,
+                   out_shardings=NamedSharding(jmesh, PartitionSpec()))
+
+
 def _shift_shard(p, by):
     if isinstance(p, Shard):
         return Shard(p.dim + by)
     return p
 
 
-def _place_partial_hidden(stacked, mesh, placements, stop_gradient):
-    """Shared hidden-pending-sum construction: ``stacked`` is
-    [n, *shape] where slot values sum to the logical tensor; Shard(0) over
-    the (first) partial mesh axis, other placements shifted by one dim."""
+def _partial_hidden_spec(mesh, placements, ndim):
+    """Spec for the hidden-pending-sum layout: Shard(0) over the (first)
+    partial mesh axis, other placements shifted by one dim."""
     axis = next(i for i, p in enumerate(placements)
                 if isinstance(p, Partial))
     eff = [Shard(0) if i == axis else
            (Replicate() if isinstance(p, Partial) else _shift_shard(p, 1))
            for i, p in enumerate(placements)]
+    return _spec_for(eff, mesh, ndim)
+
+
+def _place_partial_hidden(stacked, mesh, placements, stop_gradient):
+    """Shared hidden-pending-sum construction: ``stacked`` is
+    [n, *shape] where slot values sum to the logical tensor."""
     jmesh = mesh.get_jax_mesh()
-    spec = _spec_for(eff, mesh, stacked.ndim)
+    spec = _partial_hidden_spec(mesh, placements, stacked.ndim)
     out = Tensor(jax.device_put(stacked, NamedSharding(jmesh, spec)),
                  stop_gradient=stop_gradient)
     out._dist_attr = DistAttr(mesh, placements)
@@ -189,6 +238,13 @@ def unshard_dtensor(dist_tensor: Tensor) -> Tensor:
     auto_parallel/api.py unshard_dtensor)."""
     data = dist_tensor._data
     attr = dist_tensor._dist_attr
+    if isinstance(data, jax.Array) and not isinstance(
+            data, jax.core.Tracer) and not data.is_fully_addressable:
+        # multi-process: all-gather to replicated via a compiled identity
+        # (device_get cannot read non-addressable shards). The mesh comes
+        # from the array's own sharding so op outputs (attr=None) work.
+        data = _replicated_identity(data.sharding.mesh)(data)
+        data = data.addressable_shards[0].data
     if attr is not None and getattr(attr, "_partial_hidden", False):
         data = jnp.sum(data, axis=0)
     out = Tensor(jax.device_get(data) if not isinstance(
@@ -283,7 +339,19 @@ def local_value(dist_tensor: Tensor) -> Tensor:
     data = dist_tensor._data
     attr = dist_tensor._dist_attr
     if attr is not None and getattr(attr, "_partial_hidden", False):
-        # hidden axis: each slot is one rank's pending-sum contribution
+        # hidden axis: slots are per-device pending-sum contributions;
+        # multi-process, this rank's contribution = the sum of its own
+        # slots (one per local device on the partial axis)
+        if isinstance(data, jax.Array) and not data.is_fully_addressable:
+            first = data.addressable_shards[0]
+            rest = first.index[1:]
+            contribs = [jnp.sum(jnp.asarray(s.data), axis=0)
+                        for s in data.addressable_shards
+                        if s.index[1:] == rest]
+            out = contribs[0]
+            for c in contribs[1:]:
+                out = out + c
+            return Tensor(out)
         return Tensor(jnp.asarray(data[0]))
     try:
         shard = data.addressable_shards[0]
