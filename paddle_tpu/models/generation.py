@@ -1,7 +1,8 @@
 """Fused decode path: whole-generation compiled autoregressive decoding
 (reference: the serving fusion tier paddle/phi/kernels/fusion/gpu/ —
 fused_multi_transformer_kernel.cu, masked_multihead_attention_kernel.cu —
-and PaddleNLP's generate loop).
+and PaddleNLP's generate loop; beam search reconstructs sequences with
+gather_tree exactly like the reference's gather_tree op).
 
 TPU-native design: instead of per-op fused CUDA kernels driven by a host
 loop, the ENTIRE decode runs as one XLA program — prefill fills a
@@ -10,6 +11,11 @@ fixed-size KV cache, then ``lax.scan`` iterates single-token steps with
 Zero host round-trips per token (the 97ms tunnel dispatch would otherwise
 dwarf the ~µs of decode math); XLA fuses ln/rope/proj into the matmuls
 the way fused_multi_transformer does by hand.
+
+The engine is MODEL-GENERIC: each CausalLM exposes ``decode_adapter()``
+returning a DecodeAdapter (weight extraction + pure-array embed / prefill
+/ single-token block step / logits), and this module drives sampling
+(greedy / temperature / top-p) and beam search over any adapter.
 """
 from __future__ import annotations
 
@@ -22,36 +28,8 @@ import numpy as np
 from ..core import random as _rng
 from ..core.tensor import Tensor
 
-__all__ = ["generate"]
-
-
-def _gpt_weights(model):
-    """Flat pytree of decode-relevant arrays for a GPTForCausalLM."""
-    g = model.gpt
-    layers = []
-    for blk in g.h:
-        layers.append({
-            "ln1_w": blk.ln_1.weight._data, "ln1_b": blk.ln_1.bias._data,
-            "qkv_w": blk.attn.qkv_proj.weight._data,
-            "qkv_b": (blk.attn.qkv_proj.bias._data
-                      if blk.attn.qkv_proj.bias is not None else None),
-            "out_w": blk.attn.out_proj.weight._data,
-            "out_b": (blk.attn.out_proj.bias._data
-                      if blk.attn.out_proj.bias is not None else None),
-            "ln2_w": blk.ln_2.weight._data, "ln2_b": blk.ln_2.bias._data,
-            "fc1_w": blk.mlp.fc1.weight._data,
-            "fc1_b": (blk.mlp.fc1.bias._data
-                      if blk.mlp.fc1.bias is not None else None),
-            "fc2_w": blk.mlp.fc2.weight._data,
-            "fc2_b": (blk.mlp.fc2.bias._data
-                      if blk.mlp.fc2.bias is not None else None),
-        })
-    head = None if model.lm_head is None else model.lm_head.weight._data
-    return {
-        "wte": g.wte.weight._data, "wpe": g.wpe.weight._data,
-        "lnf_w": g.ln_f.weight._data, "lnf_b": g.ln_f.bias._data,
-        "layers": layers, "lm_head": head,
-    }
+__all__ = ["generate", "beam_search", "GPTDecodeAdapter",
+           "LlamaDecodeAdapter"]
 
 
 def _ln(x, w, b, eps):
@@ -62,41 +40,261 @@ def _ln(x, w, b, eps):
             + b.astype(jnp.float32)).astype(x.dtype)
 
 
-def _linear(x, w, b):
+def _rms(x, w, eps):
+    x32 = x.astype(jnp.float32)
+    nrm = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, -1, keepdims=True) + eps)
+    return (nrm * w.astype(jnp.float32)).astype(x.dtype)
+
+
+def _linear(x, w, b=None):
     y = x @ w
     return y if b is None else y + b
 
 
-def _block_step(cfg, W, x, ck, cv, pos, t_mask):
-    """One decoder block for a single token x [b, h]; cache [b, T, nh, hd].
-    The masked single-query attention + cache write is the
-    masked_multihead_attention analog."""
-    nh, hd = cfg.num_heads, cfg.head_dim
-    b = x.shape[0]
-    h1 = _ln(x, W["ln1_w"], W["ln1_b"], cfg.layer_norm_eps)
-    qkv = _linear(h1, W["qkv_w"], W["qkv_b"]).reshape(b, 3, nh, hd)
-    q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
-    ck = jax.lax.dynamic_update_slice(ck, k[:, None], (0, pos, 0, 0))
-    cv = jax.lax.dynamic_update_slice(cv, v[:, None], (0, pos, 0, 0))
+def _rope(x, pos, base):
+    """Rotate [..., nh, hd] by absolute positions pos (int array
+    broadcastable to x.shape[:-2])."""
+    hd = x.shape[-1]
+    half = hd // 2
+    freqs = base ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    ang = pos.astype(jnp.float32)[..., None, None] * freqs  # [..., 1, half]
+    cos, sin = jnp.cos(ang), jnp.sin(ang)
+    x1, x2 = x[..., :half], x[..., half:]
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], -1)
+    return out.astype(x.dtype)
+
+
+class DecodeAdapter:
+    """Per-model weight-extraction + pure-array decode callbacks.
+
+    Attributes: num_layers, num_kv_heads, head_dim, dtype, vocab_size,
+    max_positions, weights (flat pytree of jax arrays).
+    Methods (all pure over arrays, jit-safe):
+      prefill(w, ids, total) -> (x [b, plen, h], ck, cv [L, b, total, kvh, hd])
+      step(w, tok [b], pos, ck, cv, t_mask) -> (logits [b, V], ck, cv)
+    """
+
+
+class GPTDecodeAdapter(DecodeAdapter):
+    """Learned-position GPT decoder (gpt.py GPTForCausalLM)."""
+
+    def __init__(self, model):
+        cfg = model.config
+        self.num_layers = cfg.num_layers
+        self.num_heads = cfg.num_heads
+        self.num_kv_heads = cfg.num_heads
+        self.head_dim = cfg.head_dim
+        self.eps = cfg.layer_norm_eps
+        self.vocab_size = cfg.vocab_size
+        self.max_positions = getattr(cfg, "max_position_embeddings", None)
+        g = model.gpt
+        layers = []
+        for blk in g.h:
+            layers.append({
+                "ln1_w": blk.ln_1.weight._data, "ln1_b": blk.ln_1.bias._data,
+                "qkv_w": blk.attn.qkv_proj.weight._data,
+                "qkv_b": (blk.attn.qkv_proj.bias._data
+                          if blk.attn.qkv_proj.bias is not None else None),
+                "out_w": blk.attn.out_proj.weight._data,
+                "out_b": (blk.attn.out_proj.bias._data
+                          if blk.attn.out_proj.bias is not None else None),
+                "ln2_w": blk.ln_2.weight._data, "ln2_b": blk.ln_2.bias._data,
+                "fc1_w": blk.mlp.fc1.weight._data,
+                "fc1_b": (blk.mlp.fc1.bias._data
+                          if blk.mlp.fc1.bias is not None else None),
+                "fc2_w": blk.mlp.fc2.weight._data,
+                "fc2_b": (blk.mlp.fc2.bias._data
+                          if blk.mlp.fc2.bias is not None else None),
+            })
+        head = None if model.lm_head is None else model.lm_head.weight._data
+        self.weights = {
+            "wte": g.wte.weight._data, "wpe": g.wpe.weight._data,
+            "lnf_w": g.ln_f.weight._data, "lnf_b": g.ln_f.bias._data,
+            "layers": layers, "lm_head": head,
+        }
+        self.dtype = self.weights["wte"].dtype
+
+    def logits(self, w, x):
+        x = _ln(x, w["lnf_w"], w["lnf_b"], self.eps)
+        head = w["lm_head"]
+        if head is None:
+            return x @ w["wte"].T
+        return x @ head
+
+    def prefill(self, w, ids, total):
+        b, plen = ids.shape
+        nh, hd, dt = self.num_heads, self.head_dim, self.dtype
+        pos_ids = jnp.arange(plen)[None, :]
+        x = (w["wte"][ids] + w["wpe"][pos_ids]).astype(dt)
+        cks, cvs = [], []
+        causal = jnp.tril(jnp.ones((plen, plen), bool))
+        for W in w["layers"]:
+            h1 = _ln(x, W["ln1_w"], W["ln1_b"], self.eps)
+            qkv = _linear(h1, W["qkv_w"], W["qkv_b"]) \
+                .reshape(b, plen, 3, nh, hd)
+            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+            ck = jnp.zeros((b, total, nh, hd), dt).at[:, :plen].set(k)
+            cv = jnp.zeros((b, total, nh, hd), dt).at[:, :plen].set(v)
+            att = _causal_prefill_attn(q, k, v, causal, hd, dt)
+            x = x + _linear(att, W["out_w"], W["out_b"])
+            h2 = _ln(x, W["ln2_w"], W["ln2_b"], self.eps)
+            m = jax.nn.gelu(_linear(h2, W["fc1_w"], W["fc1_b"]),
+                            approximate=True)
+            x = x + _linear(m, W["fc2_w"], W["fc2_b"])
+            cks.append(ck)
+            cvs.append(cv)
+        return x, jnp.stack(cks), jnp.stack(cvs)
+
+    def step(self, w, tok, pos, ck, cv, t_mask):
+        nh, hd, dt = self.num_heads, self.head_dim, self.dtype
+        b = tok.shape[0]
+        x = (w["wte"][tok] + w["wpe"][pos]).astype(dt)
+        new_ck, new_cv = [], []
+        for i, W in enumerate(w["layers"]):
+            h1 = _ln(x, W["ln1_w"], W["ln1_b"], self.eps)
+            qkv = _linear(h1, W["qkv_w"], W["qkv_b"]).reshape(b, 3, nh, hd)
+            q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+            cki = jax.lax.dynamic_update_slice(ck[i], k[:, None],
+                                               (0, pos, 0, 0))
+            cvi = jax.lax.dynamic_update_slice(cv[i], v[:, None],
+                                               (0, pos, 0, 0))
+            att = _masked_sdpa(q, cki, cvi, t_mask, hd)
+            x = x + _linear(att.reshape(b, nh * hd),
+                            W["out_w"], W["out_b"])
+            h2 = _ln(x, W["ln2_w"], W["ln2_b"], self.eps)
+            m = jax.nn.gelu(_linear(h2, W["fc1_w"], W["fc1_b"]),
+                            approximate=True)
+            x = x + _linear(m, W["fc2_w"], W["fc2_b"])
+            new_ck.append(cki)
+            new_cv.append(cvi)
+        return self.logits(w, x), jnp.stack(new_ck), jnp.stack(new_cv)
+
+
+class LlamaDecodeAdapter(DecodeAdapter):
+    """RMSNorm + rope + GQA + SwiGLU decoder (llama.py LlamaForCausalLM)."""
+
+    def __init__(self, model):
+        cfg = model.config
+        self.num_layers = cfg.num_layers
+        self.num_heads = cfg.num_heads
+        self.num_kv_heads = cfg.num_kv_heads
+        self.head_dim = cfg.head_dim
+        self.eps = cfg.rms_norm_eps
+        self.rope_base = cfg.rope_base
+        self.vocab_size = cfg.vocab_size
+        self.max_positions = getattr(cfg, "max_position_embeddings", None)
+        mdl = model.llama
+        layers = []
+        for blk in mdl.layers:
+            layers.append({
+                "in_ln": blk.input_layernorm.weight._data,
+                "q_w": blk.self_attn.q_proj.weight._data,
+                "k_w": blk.self_attn.k_proj.weight._data,
+                "v_w": blk.self_attn.v_proj.weight._data,
+                "o_w": blk.self_attn.o_proj.weight._data,
+                "post_ln": blk.post_attention_layernorm.weight._data,
+                "gate_w": blk.mlp.gate_proj.weight._data,
+                "up_w": blk.mlp.up_proj.weight._data,
+                "down_w": blk.mlp.down_proj.weight._data,
+            })
+        head = None if model.lm_head is None else model.lm_head.weight._data
+        self.weights = {
+            "wte": mdl.embed_tokens.weight._data,
+            "norm": mdl.norm.weight._data,
+            "layers": layers, "lm_head": head,
+        }
+        self.dtype = self.weights["wte"].dtype
+
+    def logits(self, w, x):
+        x = _rms(x, w["norm"], self.eps)
+        head = w["lm_head"]
+        if head is None:
+            return x @ w["wte"].T
+        return x @ head
+
+    def _qkv(self, W, x, b, s):
+        nh, kvh, hd = self.num_heads, self.num_kv_heads, self.head_dim
+        h1 = _rms(x, W["in_ln"], self.eps)
+        q = _linear(h1, W["q_w"]).reshape(b, s, nh, hd)
+        k = _linear(h1, W["k_w"]).reshape(b, s, kvh, hd)
+        v = _linear(h1, W["v_w"]).reshape(b, s, kvh, hd)
+        return q, k, v
+
+    def prefill(self, w, ids, total):
+        b, plen = ids.shape
+        nh, kvh, hd = self.num_heads, self.num_kv_heads, self.head_dim
+        dt = self.dtype
+        x = w["wte"][ids].astype(dt)
+        pos = jnp.arange(plen)[None, :]
+        cks, cvs = [], []
+        causal = jnp.tril(jnp.ones((plen, plen), bool))
+        rep = nh // kvh
+        for W in w["layers"]:
+            q, k, v = self._qkv(W, x, b, plen)
+            q = _rope(q, pos, self.rope_base)
+            k = _rope(k, pos, self.rope_base)
+            ck = jnp.zeros((b, total, kvh, hd), dt).at[:, :plen].set(k)
+            cv = jnp.zeros((b, total, kvh, hd), dt).at[:, :plen].set(v)
+            kf = jnp.repeat(k, rep, axis=2) if rep > 1 else k
+            vf = jnp.repeat(v, rep, axis=2) if rep > 1 else v
+            att = _causal_prefill_attn(q, kf, vf, causal, hd, dt)
+            x = x + _linear(att, W["o_w"])
+            h2 = _rms(x, W["post_ln"], self.eps)
+            m = jax.nn.silu(_linear(h2, W["gate_w"])) * _linear(h2, W["up_w"])
+            x = x + _linear(m, W["down_w"])
+            cks.append(ck)
+            cvs.append(cv)
+        return x, jnp.stack(cks), jnp.stack(cvs)
+
+    def step(self, w, tok, pos, ck, cv, t_mask):
+        nh, kvh, hd = self.num_heads, self.num_kv_heads, self.head_dim
+        dt = self.dtype
+        b = tok.shape[0]
+        x = w["wte"][tok].astype(dt)
+        rep = nh // kvh
+        pos_b = jnp.broadcast_to(jnp.asarray(pos), (b, 1))
+        new_ck, new_cv = [], []
+        for i, W in enumerate(w["layers"]):
+            q, k, v = self._qkv(W, x[:, None], b, 1)
+            q = _rope(q, pos_b, self.rope_base)[:, 0]
+            k = _rope(k, pos_b, self.rope_base)[:, 0]
+            v = v[:, 0]
+            cki = jax.lax.dynamic_update_slice(ck[i], k[:, None],
+                                               (0, pos, 0, 0))
+            cvi = jax.lax.dynamic_update_slice(cv[i], v[:, None],
+                                               (0, pos, 0, 0))
+            kf = jnp.repeat(cki, rep, axis=2) if rep > 1 else cki
+            vf = jnp.repeat(cvi, rep, axis=2) if rep > 1 else cvi
+            att = _masked_sdpa(q, kf, vf, t_mask, hd)
+            x = x + _linear(att.reshape(b, nh * hd), W["o_w"])
+            h2 = _rms(x, W["post_ln"], self.eps)
+            m = jax.nn.silu(_linear(h2, W["gate_w"])) * _linear(h2, W["up_w"])
+            x = x + _linear(m, W["down_w"])
+            new_ck.append(cki)
+            new_cv.append(cvi)
+        return self.logits(w, x), jnp.stack(new_ck), jnp.stack(new_cv)
+
+
+def _causal_prefill_attn(q, k, v, causal, hd, dt):
+    """Full-prompt causal attention shared by the adapters' prefill."""
+    sc = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                    preferred_element_type=jnp.float32) * (hd ** -0.5)
+    sc = jnp.where(causal, sc, -1e30)
+    wts = jax.nn.softmax(sc, axis=-1).astype(dt)
+    att = jnp.einsum("bhqk,bkhd->bqhd", wts, v)
+    b, plen = q.shape[0], q.shape[1]
+    return att.reshape(b, plen, -1)
+
+
+def _masked_sdpa(q, ck, cv, t_mask, hd):
+    """Masked single-query attention over the cache — the
+    masked_multihead_attention analog. q [b, nh, hd] is attended against
+    the full cache [b, T, nh, hd] with invalid positions masked."""
     scores = jnp.einsum("bhd,bthd->bht", q, ck,
                         preferred_element_type=jnp.float32) * (hd ** -0.5)
     scores = jnp.where(t_mask[None, None, :], scores, -1e30)
-    w = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
-    attn = jnp.einsum("bht,bthd->bhd", w, cv).reshape(b, nh * hd)
-    x = x + _linear(attn, W["out_w"], W["out_b"])
-    h2 = _ln(x, W["ln2_w"], W["ln2_b"], cfg.layer_norm_eps)
-    m = _linear(h2, W["fc1_w"], W["fc1_b"])
-    m = jax.nn.gelu(m, approximate=True)
-    x = x + _linear(m, W["fc2_w"], W["fc2_b"])
-    return x, ck, cv
-
-
-def _logits(cfg, weights, x):
-    x = _ln(x, weights["lnf_w"], weights["lnf_b"], cfg.layer_norm_eps)
-    head = weights["lm_head"]
-    if head is None:
-        return x @ weights["wte"].T
-    return x @ head
+    w = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bht,bthd->bhd", w, cv)
 
 
 def _sample(logits, key, temperature, top_p):
@@ -117,91 +315,65 @@ def _sample(logits, key, temperature, top_p):
     return jax.random.categorical(key, lg, axis=-1).astype(jnp.int32)
 
 
+def _check_window(ad, plen, max_new_tokens):
+    total = plen + max_new_tokens
+    if ad.max_positions is not None and total > ad.max_positions:
+        raise ValueError(
+            f"prompt length {plen} + max_new_tokens {max_new_tokens} = "
+            f"{total} exceeds max_position_embeddings {ad.max_positions}; "
+            "XLA would silently clamp position gathers past the window")
+    return total
+
+
+def _as_ids(input_ids):
+    ids = input_ids._data if isinstance(input_ids, Tensor) else \
+        jnp.asarray(np.asarray(input_ids), jnp.int32)
+    return ids.astype(jnp.int32)
+
+
+def _gen_cache(model):
+    cache = getattr(model, "_gen_cache", None)
+    if cache is None:
+        cache = model._gen_cache = {}
+    return cache
+
+
 def generate(model, input_ids, max_new_tokens: int = 32,
              temperature: float = 0.0, top_p: Optional[float] = None,
              eos_token_id: Optional[int] = None, name=None):
-    """Greedy / temperature / nucleus decoding, fully compiled.
+    """Greedy / temperature / nucleus decoding, fully compiled, for any
+    model exposing ``decode_adapter()``.
 
     Returns the generated token ids [batch, max_new_tokens] (prompt not
     included). ``temperature=0`` = greedy. Tokens after ``eos_token_id``
     are clamped to eos.
     """
-    cfg = model.config
-    ids = input_ids._data if isinstance(input_ids, Tensor) else \
-        jnp.asarray(np.asarray(input_ids), jnp.int32)
-    ids = ids.astype(jnp.int32)
+    ad = model.decode_adapter()
+    ids = _as_ids(input_ids)
     b, plen = ids.shape
-    total = plen + max_new_tokens
-    max_pos = getattr(cfg, "max_position_embeddings", None)
-    if max_pos is not None and total > max_pos:
-        raise ValueError(
-            f"prompt length {plen} + max_new_tokens {max_new_tokens} = "
-            f"{total} exceeds max_position_embeddings {max_pos}; XLA would "
-            "silently clamp position-embedding gathers past the window")
-    weights = _gpt_weights(model)
-    L = cfg.num_layers
-    nh, hd = cfg.num_heads, cfg.head_dim
-    dt = weights["wte"].dtype
+    total = _check_window(ad, plen, max_new_tokens)
+    # detach the weights from the adapter: the jitted fn's closure keeps
+    # the adapter alive in _gen_cache, and pinning a stale copy of every
+    # parameter array there would hold ~model-size HBM after updates
+    w_now, ad.weights = ad.weights, None
 
-    # per-model compile cache (on the instance: dies with the model, and
-    # id-reuse after gc can't serve a stale executable)
-    cache = getattr(model, "_gen_cache", None)
-    if cache is None:
-        cache = model._gen_cache = {}
-    key_cache = (b, plen, max_new_tokens, temperature, top_p,
+    cache = _gen_cache(model)
+    key_cache = ("sample", b, plen, max_new_tokens, temperature, top_p,
                  eos_token_id)
     fn = cache.get(key_cache)
     if fn is None:
 
         def run(weights, ids, key):
-            # ---- prefill: standard causal forward, write caches -------
-            pos_ids = jnp.arange(plen)[None, :]
-            x = weights["wte"][ids] + weights["wpe"][pos_ids]
-            x = x.astype(dt)
-            cks, cvs = [], []
-            causal = jnp.tril(jnp.ones((plen, plen), bool))
-            for W in weights["layers"]:
-                h1 = _ln(x, W["ln1_w"], W["ln1_b"], cfg.layer_norm_eps)
-                qkv = _linear(h1, W["qkv_w"], W["qkv_b"]) \
-                    .reshape(b, plen, 3, nh, hd)
-                q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-                ck = jnp.zeros((b, total, nh, hd), dt).at[:, :plen].set(k)
-                cv = jnp.zeros((b, total, nh, hd), dt).at[:, :plen].set(v)
-                sc = jnp.einsum("bqhd,bkhd->bhqk", q, k,
-                                preferred_element_type=jnp.float32) \
-                    * (hd ** -0.5)
-                sc = jnp.where(causal, sc, -1e30)
-                wts = jax.nn.softmax(sc, axis=-1).astype(dt)
-                att = jnp.einsum("bhqk,bkhd->bqhd", wts, v) \
-                    .reshape(b, plen, nh * hd)
-                x = x + _linear(att, W["out_w"], W["out_b"])
-                h2 = _ln(x, W["ln2_w"], W["ln2_b"], cfg.layer_norm_eps)
-                m = jax.nn.gelu(_linear(h2, W["fc1_w"], W["fc1_b"]),
-                                approximate=True)
-                x = x + _linear(m, W["fc2_w"], W["fc2_b"])
-                cks.append(ck)
-                cvs.append(cv)
-            ck = jnp.stack(cks)            # [L, b, total, nh, hd]
-            cv = jnp.stack(cvs)
-            lg0 = _logits(cfg, weights, x[:, -1])
+            x, ck, cv = ad.prefill(weights, ids, total)
+            lg0 = ad.logits(weights, x[:, -1])
             key, k0 = jax.random.split(key)
             tok0 = _sample(lg0, k0, temperature, top_p)
 
-            # ---- decode: one scan step per new token ------------------
             def step(carry, _):
                 tok, pos, ck, cv, key, alive = carry
                 key, sk = jax.random.split(key)
-                x = (weights["wte"][tok] + weights["wpe"][pos]).astype(dt)
                 t_mask = jnp.arange(total) <= pos
-                new_ck, new_cv = [], []
-                for i, W in enumerate(weights["layers"]):
-                    x, cki, cvi = _block_step(cfg, W, x, ck[i], cv[i],
-                                              pos, t_mask)
-                    new_ck.append(cki)
-                    new_cv.append(cvi)
-                ck = jnp.stack(new_ck)
-                cv = jnp.stack(new_cv)
-                lg = _logits(cfg, weights, x)
+                lg, ck, cv = ad.step(weights, tok, pos, ck, cv, t_mask)
                 nxt = _sample(lg, sk, temperature, top_p)
                 if eos_token_id is not None:
                     nxt = jnp.where(alive, nxt, eos_token_id)
@@ -224,5 +396,115 @@ def generate(model, input_ids, max_new_tokens: int = 32,
         cache[key_cache] = fn
 
     key = _rng.next_key()
-    out = fn(weights, ids, key)
+    out = fn(w_now, ids, key)
     return Tensor(out)
+
+
+def beam_search(model, input_ids, max_new_tokens: int = 32,
+                num_beams: int = 4, length_penalty: float = 0.0,
+                eos_token_id: Optional[int] = None):
+    """Compiled beam search over the fused decode path (reference: the
+    gather_tree op exists exactly for this — beam parent pointers are
+    resolved into sequences at the end, nn/functional/extend.py
+    gather_tree).
+
+    Returns token ids [batch, max_new_tokens] of the best beam.
+    """
+    ad = model.decode_adapter()
+    ids = _as_ids(input_ids)
+    b, plen = ids.shape
+    total = _check_window(ad, plen, max_new_tokens)
+    w_now, ad.weights = ad.weights, None  # see generate()
+    K = num_beams
+    V = ad.vocab_size
+
+    cache = _gen_cache(model)
+    key_cache = ("beam", b, plen, max_new_tokens, K, length_penalty,
+                 eos_token_id)
+    fn = cache.get(key_cache)
+    if fn is None:
+
+        def run(weights, ids):
+            x, ck, cv = ad.prefill(weights, ids, total)
+            lg0 = jax.nn.log_softmax(
+                ad.logits(weights, x[:, -1]).astype(jnp.float32), axis=-1)
+            # seed the beams with the prompt's top-K continuations
+            scores0, tok0 = jax.lax.top_k(lg0, K)      # [b, K]
+            # expand caches to one row per beam: [L, b*K, T, ...]
+            ck = jnp.repeat(ck, K, axis=1)
+            cv = jnp.repeat(cv, K, axis=1)
+            alive0 = jnp.ones((b, K), bool)
+            if eos_token_id is not None:
+                alive0 = tok0 != eos_token_id
+            lens0 = jnp.ones((b, K), jnp.float32)  # seed token counts
+
+            def step(carry, _):
+                tok, pos, ck, cv, scores, alive, lens = carry
+                t_mask = jnp.arange(total) <= pos
+                lg, ck, cv = ad.step(weights, tok.reshape(b * K), pos,
+                                     ck, cv, t_mask)
+                logp = jax.nn.log_softmax(lg.astype(jnp.float32), axis=-1)
+                logp = logp.reshape(b, K, V)
+                # finished beams only extend with EOS at zero cost
+                if eos_token_id is not None:
+                    eos_only = jnp.full((V,), -jnp.inf).at[
+                        eos_token_id].set(0.0)
+                    logp = jnp.where(alive[..., None], logp,
+                                     eos_only[None, None, :])
+                cand = scores[..., None] + logp        # [b, K, V]
+                flat = cand.reshape(b, K * V)
+                new_scores, idx = jax.lax.top_k(flat, K)   # [b, K]
+                parent = (idx // V).astype(jnp.int32)
+                nxt = (idx % V).astype(jnp.int32)
+                # reorder caches by parent beam (per batch row)
+                gidx = (jnp.arange(b)[:, None] * K + parent) \
+                    .reshape(b * K)
+                ck = ck[:, gidx]
+                cv = cv[:, gidx]
+                alive = jnp.take_along_axis(alive, parent, axis=1)
+                lens = jnp.take_along_axis(lens, parent, axis=1)
+                # a live beam grows by its new token (incl. a fresh EOS)
+                lens = lens + alive.astype(jnp.float32)
+                if eos_token_id is not None:
+                    alive = alive & (nxt != eos_token_id)
+                return (nxt, pos + 1, ck, cv, new_scores, alive, lens), \
+                    (nxt, parent)
+
+            carry = (tok0, jnp.int32(plen), ck, cv, scores0, alive0,
+                     lens0)
+            if max_new_tokens > 1:
+                carry, (toks, parents) = jax.lax.scan(
+                    step, carry, None, length=max_new_tokens - 1)
+                final_scores = carry[4]
+                final_lens = carry[6]
+                # [T, b, K] including the seeded first token (parent = own
+                # beam index by construction of the seed)
+                all_toks = jnp.concatenate([tok0[None], toks], axis=0)
+                all_parents = jnp.concatenate(
+                    [jnp.broadcast_to(jnp.arange(K, dtype=jnp.int32),
+                                      (1, b, K)), parents], axis=0)
+            else:
+                final_scores = scores0
+                final_lens = lens0
+                all_toks = tok0[None]
+                all_parents = jnp.broadcast_to(
+                    jnp.arange(K, dtype=jnp.int32), (1, b, K))
+            # resolve parent pointers into sequences (gather_tree)
+            from ..nn.functional.extend import gather_tree
+
+            seqs = gather_tree(Tensor(all_toks),
+                               Tensor(all_parents))._data  # [T, b, K]
+            if length_penalty:
+                # GNMT-style: each beam normalized by ITS OWN finished
+                # length (frozen at EOS), not a shared constant
+                final_scores = final_scores / (
+                    final_lens ** length_penalty)
+            best = jnp.argmax(final_scores, axis=1)      # [b]
+            out = jnp.take_along_axis(
+                seqs, best[None, :, None], axis=2)[..., 0]  # [T, b]
+            return jnp.swapaxes(out, 0, 1)               # [b, T]
+
+        fn = jax.jit(run)
+        cache[key_cache] = fn
+
+    return Tensor(fn(w_now, ids))

@@ -274,3 +274,30 @@ class LlamaForCausalLM(nn.Layer):
         return [(zeros([batch_size, 0, cfg.num_kv_heads, cfg.head_dim]),
                  zeros([batch_size, 0, cfg.num_kv_heads, cfg.head_dim]))
                 for _ in range(cfg.num_layers)]
+
+    def generate(self, input_ids, max_new_tokens=32, temperature=0.0,
+                 top_p=None, eos_token_id=None):
+        """Fully-compiled autoregressive decoding via the model-generic
+        fused decode engine (models/generation.py)."""
+        from .generation import generate as _gen
+
+        return _gen(self, input_ids, max_new_tokens=max_new_tokens,
+                    temperature=temperature, top_p=top_p,
+                    eos_token_id=eos_token_id)
+
+    def beam_search(self, input_ids, max_new_tokens=32, num_beams=4,
+                    length_penalty=0.0, eos_token_id=None):
+        """Compiled beam search over the fused decode path (gather_tree
+        backtrace). Returns the best beam's ids [b, max_new_tokens]."""
+        from .generation import beam_search as _beam
+
+        return _beam(self, input_ids, max_new_tokens=max_new_tokens,
+                     num_beams=num_beams, length_penalty=length_penalty,
+                     eos_token_id=eos_token_id)
+
+    def decode_adapter(self):
+        """Weight-extraction protocol for the model-generic fused decode
+        engine (models/generation.py)."""
+        from .generation import LlamaDecodeAdapter
+
+        return LlamaDecodeAdapter(self)

@@ -99,3 +99,95 @@ def test_generate_rejects_overlong():
     ids = np.zeros((1, cfg.max_position_embeddings - 2), np.int32)
     with pytest.raises(ValueError):
         m.generate(pt.to_tensor(ids), max_new_tokens=8)
+
+
+def _llama_model(seed=13):
+    pt.seed(seed)
+    cfg = pt.models.llama_tiny()
+    m = pt.models.LlamaForCausalLM(cfg)
+    m.eval()
+    return m, cfg
+
+
+def test_llama_generate_matches_eager_cached_decode():
+    """Greedy fused Llama generate == step-by-step eager decode (GQA +
+    rope + RMSNorm adapter; VERDICT r2 next #7)."""
+    m, cfg = _llama_model()
+    rng = np.random.RandomState(1)
+    ids = rng.randint(0, cfg.vocab_size, (2, 7)).astype(np.int32)
+    n_new = 6
+
+    got = m.generate(pt.to_tensor(ids), max_new_tokens=n_new).numpy()
+
+    with pt.no_grad():
+        caches = m.init_caches(2)
+        logits, caches = m(pt.to_tensor(ids), caches=caches)
+        ref = []
+        tok = logits.numpy()[:, -1].argmax(-1).astype(np.int32)
+        ref.append(tok)
+        for _ in range(n_new - 1):
+            logits, caches = m(pt.to_tensor(tok[:, None]), caches=caches)
+            tok = logits.numpy()[:, -1].argmax(-1).astype(np.int32)
+            ref.append(tok)
+    ref = np.stack(ref, axis=1)
+    np.testing.assert_array_equal(got, ref)
+
+
+def _brute_force_beams(m, ids, n_new, K, vocab):
+    """Exhaustive beam search over the eager forward as reference."""
+    import itertools
+
+    with pt.no_grad():
+        best = {}
+        for b in range(ids.shape[0]):
+            beams = [((), 0.0)]
+            for t in range(n_new):
+                cand = []
+                for seq, sc in beams:
+                    full = np.concatenate(
+                        [ids[b], np.array(seq, np.int32)])[None]
+                    lg = m(pt.to_tensor(full.astype(np.int32))).numpy()
+                    lp = lg[0, -1].astype(np.float64)
+                    lp = lp - lp.max()
+                    lp = lp - np.log(np.exp(lp).sum())
+                    for v in range(vocab):
+                        cand.append((seq + (v,), sc + lp[v]))
+                cand.sort(key=lambda x: -x[1])
+                beams = cand[:K]
+            best[b] = beams[0][0]
+    return np.stack([np.array(best[b], np.int32)
+                     for b in range(ids.shape[0])])
+
+
+def test_beam_search_matches_brute_force():
+    """beam-width-4 compiled beam search == exhaustive reference on a
+    tiny vocab (VERDICT r2 next #7 done-criterion)."""
+    from paddle_tpu.models.gpt import GPTConfig
+
+    pt.seed(21)
+    cfg = GPTConfig(vocab_size=32, hidden_size=64, num_layers=2,
+                    num_heads=4, max_position_embeddings=64, dropout=0.0,
+                    attention_dropout=0.0)
+    m = pt.models.GPTForCausalLM(cfg)
+    m.eval()
+    rng = np.random.RandomState(2)
+    ids = rng.randint(0, cfg.vocab_size, (2, 5)).astype(np.int32)
+    got = m.beam_search(pt.to_tensor(ids), max_new_tokens=3,
+                        num_beams=4).numpy()
+    ref = _brute_force_beams(m, ids, 3, 4, cfg.vocab_size)
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_llama_beam_search_runs():
+    m, cfg = _llama_model()
+    rng = np.random.RandomState(3)
+    ids = rng.randint(0, cfg.vocab_size, (2, 6)).astype(np.int32)
+    out = m.beam_search(pt.to_tensor(ids), max_new_tokens=5,
+                        num_beams=4).numpy()
+    assert out.shape == (2, 5)
+    assert (out >= 0).all() and (out < cfg.vocab_size).all()
+    # beam-1 greedy beam search == greedy generate
+    b1 = m.beam_search(pt.to_tensor(ids), max_new_tokens=5,
+                       num_beams=1).numpy()
+    g = m.generate(pt.to_tensor(ids), max_new_tokens=5).numpy()
+    np.testing.assert_array_equal(b1, g)

@@ -17,14 +17,28 @@ def main():
 
     on_tpu = jax.devices()[0].platform == "tpu"
 
-    for name, cfg_fn, b in (("gpt3_125m", pt.models.gpt3_125M, 8),
-                            ("gpt3_1p3b", pt.models.gpt3_1p3B, 8)):
+    def llama_1b(**kw):
+        from paddle_tpu.models.llama import LlamaConfig
+
+        return LlamaConfig(vocab_size=32000, hidden_size=2048,
+                           num_layers=22, num_heads=16, num_kv_heads=4,
+                           intermediate_size=5632, **kw)
+
+    cases = (("gpt3_125m", pt.models.gpt3_125M,
+              pt.models.GPTForCausalLM, 8),
+             ("gpt3_1p3b", pt.models.gpt3_1p3B,
+              pt.models.GPTForCausalLM, 8),
+             ("llama_1p1b", llama_1b, pt.models.LlamaForCausalLM, 8))
+    for name, cfg_fn, model_cls, b in cases:
         if not on_tpu and name != "gpt3_125m":
             continue
-        cfg = cfg_fn(dropout=0.0, attention_dropout=0.0)
+        cfg = cfg_fn()
+        for f in ("dropout", "attention_dropout"):
+            if hasattr(cfg, f):
+                setattr(cfg, f, 0.0)
         pt.set_default_dtype("bfloat16" if on_tpu else "float32")
         try:
-            model = pt.models.GPTForCausalLM(cfg)
+            model = model_cls(cfg)
         finally:
             pt.set_default_dtype("float32")
         model.eval()
