@@ -386,6 +386,13 @@ def backward(tensors, grad_tensors=None, retain_graph: bool = False):
         t = leaves[tid]
         if t._grad is None:
             t._grad = Tensor(garr, stop_gradient=True)
+        elif not isinstance(t._grad, Tensor):
+            # a row-sparse (SelectedRows) grad already accumulated here;
+            # mixing in a dense tape grad is order-dependent wrt hooks
+            raise RuntimeError(
+                "parameter holds a row-sparse (SelectedRows) gradient "
+                "and also received a dense gradient; set sparse=False "
+                "on the Embedding for this usage")
         else:
             t._grad = Tensor(t._grad._data + garr, stop_gradient=True)
         for hook in t._grad_hooks:
@@ -415,6 +422,15 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=False, create_graph=Fa
     results = []
     for t in inputs:
         if id(t) not in leaf_grads:
+            if getattr(t, "_sparse_grad_path", False):
+                # a sparse Embedding forward routed this weight's grad
+                # through the SelectedRows hook, which functional grad()
+                # cannot observe — a silent None would be wrong
+                raise RuntimeError(
+                    "paddle.grad() cannot return the gradient of a "
+                    "sparse=True Embedding weight (it flows as a "
+                    "SelectedRows side effect of backward()); use "
+                    "loss.backward() + weight.grad, or sparse=False")
             if not allow_unused:
                 raise RuntimeError("an input tensor is unused in the graph")
             results.append(None)

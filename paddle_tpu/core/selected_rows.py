@@ -1,0 +1,111 @@
+"""SelectedRows: row-sparse gradient representation (reference:
+paddle/phi/core/selected_rows.h + the selected_rows optimizer kernels,
+phi/kernels/selected_rows/).
+
+A sparse-embedding backward produces (rows, values) instead of a dense
+[vocab, dim] array; optimizers update only the touched rows, so the
+update cost scales with the number of looked-up ids rather than the
+vocabulary size. TPU-native: rows/values are jax arrays and the
+scatter-style ops lower to XLA scatter/gather.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["SelectedRows"]
+
+
+class SelectedRows:
+    """rows: int32 [k]; values: [k, *tail]; shape: the dense shape."""
+
+    def __init__(self, rows, values, shape):
+        self.rows = jnp.asarray(rows, jnp.int32)
+        self.values = jnp.asarray(values)
+        self.shape = tuple(int(s) for s in shape)
+        self._merged_cache = None
+        self._is_merged = False
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    # Consumers that only understand dense gradients reach for `_data` or
+    # do arithmetic; fail with guidance instead of AttributeError from
+    # deep inside an optimizer/clip/scaler.
+    _UNSUPPORTED = (
+        "this consumer does not support row-sparse (SelectedRows) "
+        "gradients; supported: SGD / Adam / AdamW updates, "
+        "ClipGradByGlobalNorm, DataParallel sync. Use sparse=False on "
+        "the Embedding for other optimizers/clips/scalers, or call "
+        ".to_dense() explicitly")
+
+    @property
+    def _data(self):
+        raise RuntimeError(self._UNSUPPORTED)
+
+    def __add__(self, other):
+        raise RuntimeError(self._UNSUPPORTED)
+
+    __radd__ = __add__
+    __mul__ = __add__
+    __rmul__ = __add__
+
+    def concat(self, other: "SelectedRows") -> "SelectedRows":
+        assert self.shape == other.shape
+        return SelectedRows(jnp.concatenate([self.rows, other.rows]),
+                            jnp.concatenate([self.values, other.values]),
+                            self.shape)
+
+    def merged(self) -> "SelectedRows":
+        """Combine duplicate rows by summation (reference:
+        MergeAdd in phi/kernels/funcs/selected_rows_functor.h) — the form
+        optimizers consume so a scatter .set is well-defined. Memoized:
+        clip + DP sync + the optimizer all merge the same gradient.
+
+        The unique-row count is PADDED to the next power of two with an
+        out-of-range sentinel row (= dense row count) carrying zero
+        values, so downstream compiled scatters see only O(log k)
+        distinct shapes instead of recompiling for every batch's unique
+        count. Consumers scatter with mode="drop" (the sentinel row is
+        discarded); gathers clamp harmlessly because the sentinel's
+        values are zero."""
+        if self._is_merged:
+            return self
+        if self._merged_cache is None:
+            rows_np = np.asarray(self.rows)
+            uniq, inv = np.unique(rows_np, return_inverse=True)
+            k = len(uniq)
+            kp = 1 << max(k - 1, 0).bit_length()
+            rows_p = np.full((kp,), self.shape[0], np.int32)
+            rows_p[:k] = uniq
+            vals = jnp.zeros((kp,) + tuple(self.values.shape[1:]),
+                             self.values.dtype)
+            vals = vals.at[jnp.asarray(inv)].add(self.values)
+            out = SelectedRows(jnp.asarray(rows_p), vals, self.shape)
+            out._is_merged = True
+            self._merged_cache = out
+        return self._merged_cache
+
+    def to_dense(self):
+        out = jnp.zeros(self.shape, self.values.dtype)
+        return out.at[self.rows].add(self.values, mode="drop")
+
+    def scale(self, factor) -> "SelectedRows":
+        """Multiply in promoted precision, cast back (matches the dense
+        clip path); merged-ness is preserved — scaling cannot un-merge."""
+        out = SelectedRows(self.rows,
+                           (self.values * factor).astype(self.values.dtype),
+                           self.shape)
+        out._is_merged = self._is_merged
+        return out
+
+    def sq_l2norm(self):
+        """Sum of squares of the (duplicate-merged) dense gradient."""
+        m = self.merged()
+        v32 = m.values.astype(jnp.float32)
+        return jnp.sum(v32 * v32)
+
+    def __repr__(self):
+        return (f"SelectedRows(rows={self.rows.shape[0]}, "
+                f"shape={self.shape}, dtype={self.dtype})")

@@ -42,6 +42,7 @@ class Tensor:
         "_dist_attr",
         "dist_spec",
         "_sym_node",
+        "_sparse_grad_path",
         "__weakref__",
     )
 
@@ -142,7 +143,7 @@ class Tensor:
         self._grad = None
 
     def clear_gradient(self, set_to_zero: bool = False):
-        if set_to_zero and self._grad is not None:
+        if set_to_zero and isinstance(self._grad, Tensor):
             self._grad = Tensor(jnp.zeros_like(self._grad._data))
         else:
             self._grad = None

@@ -8,6 +8,8 @@ from __future__ import annotations
 
 from typing import List, Optional
 
+import numpy as np
+
 from .. import nn
 from ..core.tensor import Tensor
 from . import collective as dist
@@ -62,9 +64,30 @@ class _Reducer:
     def _flush(self, bi):
         import jax.numpy as jnp
 
+        from ..core.selected_rows import SelectedRows
+
         if self._nranks <= 1:
             return
         bucket = [p for p in self._buckets[bi] if p._grad is not None]
+        if not bucket:
+            return
+        # sparse (SelectedRows) grads sync by allgathering rows+values —
+        # the reference EagerReducer's sparse allreduce path. Like the
+        # dense flush, this requires grad PRESENCE to agree across ranks
+        # (rank-divergent control flow needs find_unused_parameters-style
+        # handling, same contract as the reference reducer)
+        sparse = [p for p in bucket if isinstance(p._grad, SelectedRows)]
+        for p in sparse:
+            sr = p._grad.merged()
+            gathered = []
+            dist.all_gather_object(
+                gathered, (np.asarray(sr.rows), np.asarray(sr.values)),
+                group=self._group)
+            rows = jnp.concatenate([jnp.asarray(r) for r, _ in gathered])
+            vals = jnp.concatenate([jnp.asarray(v) for _, v in gathered])
+            p._grad = SelectedRows(rows, vals / self._nranks,
+                                   sr.shape).merged()
+        bucket = [p for p in bucket if not isinstance(p._grad, SelectedRows)]
         if not bucket:
             return
         flat = jnp.concatenate([p._grad._data.reshape(-1).astype(jnp.float32)

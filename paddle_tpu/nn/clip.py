@@ -55,14 +55,21 @@ class ClipGradByGlobalNorm(ClipGradBase):
         self.group_name = group_name
 
     def global_norm_sq(self, params_grads):
+        from ..core.selected_rows import SelectedRows
+
         total = jnp.zeros((), jnp.float32)
         for _, g in params_grads:
             if g is None:
                 continue
-            total = total + jnp.sum(g._data.astype(jnp.float32) ** 2)
+            if isinstance(g, SelectedRows):
+                total = total + g.sq_l2norm()
+            else:
+                total = total + jnp.sum(g._data.astype(jnp.float32) ** 2)
         return total
 
     def _clip(self, params_grads, extra_norm_sq=None):
+        from ..core.selected_rows import SelectedRows
+
         total = self.global_norm_sq(params_grads)
         if extra_norm_sq is not None:
             total = total + extra_norm_sq
@@ -72,6 +79,9 @@ class ClipGradByGlobalNorm(ClipGradBase):
         for p, g in params_grads:
             if g is None:
                 out.append((p, g))
+                continue
+            if isinstance(g, SelectedRows):
+                out.append((p, g.merged().scale(scale)))
                 continue
             out.append((p, Tensor((g._data * scale).astype(g._data.dtype))))
         return out

@@ -53,6 +53,7 @@ class Embedding(Layer):
         self._num_embeddings = num_embeddings
         self._embedding_dim = embedding_dim
         self._padding_idx = padding_idx
+        self._sparse = sparse
         self.weight = self.create_parameter(
             [num_embeddings, embedding_dim], attr=weight_attr,
             default_initializer=Normal(0.0, 1.0))
@@ -60,7 +61,71 @@ class Embedding(Layer):
             self.weight._data = self.weight._data.at[padding_idx].set(0.0)
 
     def forward(self, x):
+        if self._sparse and self.training and not self.weight.stop_gradient:
+            out = self._sparse_forward(x)
+            if out is not None:
+                return out
         return F.embedding(x, self.weight, padding_idx=self._padding_idx)
+
+    def _sparse_forward(self, x):
+        """sparse=True: backward produces a row-sparse SelectedRows grad
+        (reference: nn.Embedding sparse=True -> SelectedRows gradient,
+        phi/core/selected_rows.h) so optimizer updates touch only the
+        looked-up rows. Eager only — under a jit trace (TrainStep) the
+        dense tape path is used."""
+        import jax
+
+        from ...core.selected_rows import SelectedRows
+        from ...core.tensor import Tensor
+
+        W = self.weight
+        xt = x if isinstance(x, Tensor) else Tensor(x)
+        idv = xt._data
+        if isinstance(idv, jax.core.Tracer) or \
+                isinstance(W._data, jax.core.Tracer):
+            return None  # tracing: fall back to the dense tape path
+        from ...core import autograd as _ag
+
+        if not _ag.is_grad_enabled():
+            return None
+        import jax.numpy as jnp
+
+        pad = self._padding_idx
+        data = W._data[idv]
+        if pad is not None:
+            # match the dense path: padding positions emit zeros
+            data = data * (idv != pad)[..., None].astype(data.dtype)
+        out = Tensor(data, stop_gradient=False)
+        dim = int(W.shape[1])
+
+        def hook(gt):
+            g = gt._data.reshape(-1, dim)
+            rows = idv.reshape(-1).astype(jnp.int32)
+            if pad is not None:
+                g = g * (rows != pad)[:, None].astype(g.dtype)
+            sr = SelectedRows(rows, g, W.shape)
+            if W._grad is None:
+                W._grad = sr
+            elif isinstance(W._grad, SelectedRows):
+                W._grad = W._grad.concat(sr)
+            else:
+                # a dense tape grad for the same weight in this backward
+                # would double-fire grad hooks (DP bucket flush) with
+                # order-dependent results — fail fast with guidance
+                raise RuntimeError(
+                    "sparse embedding weight also received a DENSE "
+                    "gradient in this backward (e.g. weight tying or a "
+                    "direct use of the weight); set sparse=False for "
+                    "this usage")
+            for h in W._grad_hooks:
+                r = h(W._grad)
+                if r is not None:
+                    W._grad = r
+            return None
+
+        W._sparse_grad_path = True  # grad() guards on this (autograd.py)
+        out.register_hook(hook)
+        return out
 
     def extra_repr(self):
         return f"{self._num_embeddings}, {self._embedding_dim}"

@@ -15,6 +15,23 @@ __all__ = ["SGD", "Momentum", "Adam", "AdamW", "Adagrad", "Adadelta",
 
 class SGD(Optimizer):
     def _append_optimize_op(self, p, grad):
+        from ..core.selected_rows import SelectedRows
+
+        if isinstance(grad, SelectedRows):
+            if self._weight_decay:
+                # dense SGD decays EVERY row each step; a rows-only decay
+                # would silently diverge — densify to keep equivalence
+                grad = grad.to_dense() + self._weight_decay * p._data
+                p._data = (p._data - self._param_lr(p) * grad).astype(
+                    p._data.dtype)
+                return
+            # row-sparse update: touch only the looked-up rows (reference:
+            # phi/kernels/selected_rows/ sgd kernel)
+            sr = grad.merged()
+            p._data = _sgd_sparse_apply(
+                p._data, sr.rows, sr.values,
+                jnp.float32(self._param_lr(p)))
+            return
         grad = self._decayed(p, grad)
         p._data = (p._data - self._param_lr(p) * grad).astype(p._data.dtype)
 
@@ -62,6 +79,35 @@ class Momentum(Optimizer):
             newv.append(v)
             newp.append(p - lr * upd)
         return newp, {"velocity": newv}
+
+
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _sgd_sparse_apply(p, rows, vals, lr):
+    """In-place (donated) row-sparse SGD: O(touched rows) — eager .at[]
+    without donation would copy the whole table per step."""
+    upd = lr * vals.astype(jnp.float32)
+    return p.at[rows].add((-upd).astype(p.dtype), mode="drop")
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+def _adam_sparse_apply(p, m, v, rows, g32, t, lr, b1, b2, eps, wd_c, wd_d):
+    """In-place (donated) lazy sparse Adam over the touched rows."""
+    g32 = g32 + wd_c * p[rows].astype(jnp.float32)
+    mr = b1 * m[rows] + (1 - b1) * g32
+    vr = b2 * v[rows] + (1 - b2) * (g32 * g32)
+    mhat = mr / (1 - b1 ** t)
+    vhat = vr / (1 - b2 ** t)
+    pr = p[rows].astype(jnp.float32)
+    pr = pr * (1 - lr * wd_d)
+    pr = pr - lr * mhat / (jnp.sqrt(vhat) + eps)
+    return (p.at[rows].set(pr.astype(p.dtype), mode="drop"),
+            m.at[rows].set(mr, mode="drop"),
+            v.at[rows].set(vr, mode="drop"))
 
 
 _QBLOCK = 256  # blockwise-quantization block size (8-bit moments)
@@ -117,6 +163,10 @@ class Adam(Optimizer):
         self._beta1 = beta1
         self._beta2 = beta2
         self._epsilon = epsilon
+        # lazy_mode: sparse (SelectedRows) grads update moments/params
+        # only at touched rows (reference Adam lazy_mode semantics);
+        # default False = dense-equivalent math
+        self._lazy_mode = bool(lazy_mode)
         # moment storage dtype applies to the FIRST moment only: bf16's
         # ~0.4% ulp cannot represent a beta2=0.999 decay step (0.1%), so a
         # bf16 second moment would ratchet up after gradient spikes and
@@ -138,10 +188,26 @@ class Adam(Optimizer):
             raise ValueError("factored_v and moment_quant are exclusive")
 
     def _append_optimize_op(self, p, grad):
+        from ..core.selected_rows import SelectedRows
+
+        if isinstance(grad, SelectedRows):
+            # dispatch BEFORE _decayed (dense arithmetic); coupled decay
+            # folds into the sparse/dense update paths
+            return self._adam_update(p, grad)
         grad = self._decayed(p, grad)
         self._adam_update(p, grad)
 
     def _adam_update(self, p, grad, decoupled_wd=0.0):
+        from ..core.selected_rows import SelectedRows
+
+        if isinstance(grad, SelectedRows):
+            if getattr(self, "_lazy_mode", False):
+                return self._adam_update_sparse(p, grad, decoupled_wd)
+            # non-lazy (reference default): moments of ALL rows decay
+            # every step — mathematically the dense update
+            grad = grad.to_dense()
+            if self._weight_decay:
+                grad = grad + self._weight_decay * p._data
         f32 = jnp.float32
         m = self._get_accumulator("moment1", p,
                                   jnp.zeros_like(p._data, dtype=f32))
@@ -162,6 +228,30 @@ class Adam(Optimizer):
         self._set_accumulator("moment2", p, v)
         self._set_accumulator("step", p, t)
         p._data = p32.astype(p._data.dtype)
+
+    def _adam_update_sparse(self, p, grad, decoupled_wd=0.0):
+        """Lazy sparse Adam (reference: Adam lazy_mode + the
+        selected_rows adam kernel): moments and the parameter are updated
+        ONLY at the touched rows — update cost scales with the number of
+        looked-up ids, not the vocabulary."""
+        f32 = jnp.float32
+        sr = grad.merged()
+        m = self._get_accumulator("moment1", p,
+                                  jnp.zeros_like(p._data, dtype=f32))
+        v = self._get_accumulator("moment2", p,
+                                  jnp.zeros_like(p._data, dtype=f32))
+        t = self._get_accumulator("step", p, jnp.zeros((), f32)) + 1
+        new_p, new_m, new_v = _adam_sparse_apply(
+            p._data, m, v, sr.rows, sr.values.astype(f32), t,
+            jnp.float32(self._param_lr(p)),
+            jnp.float32(self._beta1), jnp.float32(self._beta2),
+            jnp.float32(self._epsilon),
+            jnp.float32(self._weight_decay or 0.0),
+            jnp.float32(decoupled_wd))
+        self._set_accumulator("moment1", p, new_m)
+        self._set_accumulator("moment2", p, new_v)
+        self._set_accumulator("step", p, t)
+        p._data = new_p
 
     def init_state(self, params):
         md = getattr(self, "_moment_dtype", jnp.float32)
