@@ -113,12 +113,23 @@ def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
                 (k[i] - 1 - pad[i][0], k[i] - 1 - pad[i][1] + opad[i])
                 for i in range(n)
             ]
+        # transpose conv = fractionally-strided conv with the kernel
+        # spatially flipped; the "IO" rhs spec already contracts over the
+        # weight's IN dim (jax removed conv_general_dilated's
+        # transpose_kernel flag)
+        wf = jnp.flip(w, axis=tuple(range(2, 2 + n)))
+        if groups > 1:
+            # one grouped conv call (not a per-group unroll): rearrange
+            # [G*cin_g, out_g, *k] -> [cin_g, G*out_g, *k] so
+            # feature_group_count blocks line up with the input channels
+            cin_g = wf.shape[0] // groups
+            wf = wf.reshape((groups, cin_g) + wf.shape[1:])
+            wf = jnp.moveaxis(wf, 0, 1)  # [cin_g, G, out_g, *k]
+            wf = wf.reshape((cin_g, groups * w.shape[1]) + w.shape[2:])
         out = lax.conv_general_dilated(
-            a, w, window_strides=(1,) * n, padding=padding_cfg,
+            a, wf, window_strides=(1,) * n, padding=padding_cfg,
             lhs_dilation=stride, rhs_dilation=dilation,
-            dimension_numbers=dn, feature_group_count=groups,
-            transpose_kernel=True,
-        )
+            dimension_numbers=dn, feature_group_count=groups)
         if b:
             bias_shape = (1, -1) + (1,) * n if not channel_last \
                 else (1,) * (n + 1) + (-1,)

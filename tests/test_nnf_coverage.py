@@ -1,0 +1,469 @@
+"""nn.functional coverage sweep — the functional-surface counterpart of
+test_op_coverage.py (VERDICT r2 next #6). Every public F.* fn must be
+accounted for: usage-scan, a numeric case here, inplace derivation, or
+the explicit skip list; test_nnf_manifest_complete fails otherwise.
+"""
+import glob
+import inspect
+import os
+
+import numpy as np
+import pytest
+from scipy import special as sps
+
+import paddle_tpu as pt
+import paddle_tpu.nn.functional as F
+
+
+def _public():
+    return {n: o for n, o in vars(F).items()
+            if not n.startswith("_") and inspect.isfunction(o)}
+
+
+def _usage():
+    here = os.path.dirname(__file__)
+    me = {"test_nnf_coverage.py", "test_op_coverage.py"}
+    text = "".join(open(f).read()
+                   for f in glob.glob(os.path.join(here, "*.py"))
+                   if os.path.basename(f) not in me)
+    import re
+
+    out = set()
+    for n in _public():
+        esc = re.escape(n)
+        pat = (rf"F\.{esc}\(|"
+               rf"(?<!np)(?<!py)(?<!ps)(?<!ax)\.{esc}\(")
+        if re.search(pat, text):
+            out.add(n)
+    return out
+
+
+def _r(*s, seed=0):
+    return np.random.RandomState(seed).randn(*s).astype(np.float32)
+
+
+def _softmax(a, ax=-1):
+    e = np.exp(a - a.max(ax, keepdims=True))
+    return e / e.sum(ax, keepdims=True)
+
+
+_X = _r(3, 5)
+_Y = _r(3, 5, seed=1)
+_IMG = _r(2, 4, 8, 8, seed=2)
+_LBL = np.random.RandomState(3).randint(0, 5, (3,)).astype(np.int64)
+_P01 = (np.random.RandomState(4).uniform(0.1, 0.9, (3, 5))
+        .astype(np.float32))
+
+
+def _np_pool2(a, fn):  # 2x2 pool of [n,c,8,8]
+    return fn(a.reshape(2, 4, 4, 2, 4, 2), axis=(3, 5))
+
+
+# name -> (run, numpy_ref)
+CASES = {
+    # ----------------------------------------------------- activations
+    "celu": (lambda: F.celu(pt.to_tensor(_X), alpha=1.2),
+             lambda: np.maximum(_X, 0) +
+             np.minimum(0, 1.2 * np.expm1(_X / 1.2))),
+    "elu": (lambda: F.elu(pt.to_tensor(_X), alpha=0.9),
+            lambda: np.where(_X > 0, _X, 0.9 * np.expm1(_X))),
+    "gelu": (lambda: F.gelu(pt.to_tensor(_X)),
+             lambda: _X * 0.5 * (1 + sps.erf(_X / np.sqrt(2)))),
+    "glu": (lambda: F.glu(pt.to_tensor(_r(3, 6))),
+            lambda: _r(3, 6)[:, :3] * sps.expit(_r(3, 6)[:, 3:])),
+    "hardshrink": (lambda: F.hardshrink(pt.to_tensor(_X), 0.4),
+                   lambda: np.where(np.abs(_X) > 0.4, _X, 0)),
+    "hardsigmoid": (lambda: F.hardsigmoid(pt.to_tensor(_X)),
+                    lambda: np.clip(_X / 6 + 0.5, 0, 1)),
+    "hardswish": (lambda: F.hardswish(pt.to_tensor(_X)),
+                  lambda: _X * np.clip(_X + 3, 0, 6) / 6),
+    "hardtanh": (lambda: F.hardtanh(pt.to_tensor(_X)),
+                 lambda: np.clip(_X, -1, 1)),
+    "leaky_relu": (lambda: F.leaky_relu(pt.to_tensor(_X), 0.1),
+                   lambda: np.where(_X > 0, _X, 0.1 * _X)),
+    "log_sigmoid": (lambda: F.log_sigmoid(pt.to_tensor(_X)),
+                    lambda: np.log(sps.expit(_X))),
+    "log_softmax": (lambda: F.log_softmax(pt.to_tensor(_X)),
+                    lambda: np.log(_softmax(_X))),
+    "mish": (lambda: F.mish(pt.to_tensor(_X)),
+             lambda: _X * np.tanh(np.log1p(np.exp(_X)))),
+    "prelu": (lambda: F.prelu(pt.to_tensor(_X),
+                              pt.to_tensor(np.array([0.2], np.float32))),
+              lambda: np.where(_X > 0, _X, 0.2 * _X)),
+    "relu6": (lambda: F.relu6(pt.to_tensor(_X * 10)),
+              lambda: np.clip(_X * 10, 0, 6)),
+    "selu": (lambda: F.selu(pt.to_tensor(_X)),
+             lambda: 1.0507009873554805 * np.where(
+                 _X > 0, _X, 1.6732632423543772 * np.expm1(_X))),
+    "sigmoid": (lambda: F.sigmoid(pt.to_tensor(_X)),
+                lambda: sps.expit(_X)),
+    "silu": (lambda: F.silu(pt.to_tensor(_X)),
+             lambda: _X * sps.expit(_X)),
+    "softplus": (lambda: F.softplus(pt.to_tensor(_X)),
+                 lambda: np.log1p(np.exp(_X))),
+    "softshrink": (lambda: F.softshrink(pt.to_tensor(_X), 0.3),
+                   lambda: np.sign(_X) * np.maximum(np.abs(_X) - 0.3, 0)),
+    "softsign": (lambda: F.softsign(pt.to_tensor(_X)),
+                 lambda: _X / (1 + np.abs(_X))),
+    "swish": (lambda: F.swish(pt.to_tensor(_X)),
+              lambda: _X * sps.expit(_X)),
+    "tanhshrink": (lambda: F.tanhshrink(pt.to_tensor(_X)),
+                   lambda: _X - np.tanh(_X)),
+    "thresholded_relu": (lambda: F.thresholded_relu(pt.to_tensor(_X),
+                                                    0.5),
+                         lambda: np.where(_X > 0.5, _X, 0)),
+    "maxout": (lambda: F.maxout(pt.to_tensor(_r(2, 4, 3, 3)), groups=2),
+               lambda: _r(2, 4, 3, 3).reshape(2, 2, 2, 3, 3).max(2)),
+    "swiglu": (lambda: F.swiglu(pt.to_tensor(_X), pt.to_tensor(_Y)),
+               lambda: _X * sps.expit(_X) * _Y),
+    # ---------------------------------------------------------- losses
+    "l1_loss": (lambda: F.l1_loss(pt.to_tensor(_X), pt.to_tensor(_Y)),
+                lambda: np.abs(_X - _Y).mean()),
+    "mse_loss": (lambda: F.mse_loss(pt.to_tensor(_X), pt.to_tensor(_Y)),
+                 lambda: ((_X - _Y) ** 2).mean()),
+    "log_loss": (lambda: F.log_loss(pt.to_tensor(_P01),
+                                    pt.to_tensor((_P01 > 0.5)
+                                                 .astype(np.float32))),
+                 lambda: -((_P01 > 0.5) * np.log(_P01 + 1e-4) +
+                           (1 - (_P01 > 0.5)) * np.log(1 - _P01 + 1e-4))),
+    "kl_div": (lambda: F.kl_div(pt.to_tensor(np.log(_P01)),
+                                pt.to_tensor(_softmax(_Y)),
+                                reduction="sum"),
+               lambda: (_softmax(_Y) * (np.log(_softmax(_Y)) -
+                                        np.log(_P01))).sum()),
+    "nll_loss": (lambda: F.nll_loss(pt.to_tensor(np.log(_softmax(_X))),
+                                    pt.to_tensor(_LBL)),
+                 lambda: -np.log(_softmax(_X))[np.arange(3), _LBL].mean()),
+    "binary_cross_entropy_with_logits": (
+        lambda: F.binary_cross_entropy_with_logits(
+            pt.to_tensor(_X), pt.to_tensor((_Y > 0).astype(np.float32))),
+        lambda: (np.maximum(_X, 0) - _X * (_Y > 0) +
+                 np.log1p(np.exp(-np.abs(_X)))).mean()),
+    "smooth_l1_loss": (lambda: F.smooth_l1_loss(pt.to_tensor(_X),
+                                                pt.to_tensor(_Y)),
+                       lambda: np.where(
+                           np.abs(_X - _Y) < 1,
+                           0.5 * (_X - _Y) ** 2,
+                           np.abs(_X - _Y) - 0.5).mean()),
+    "soft_margin_loss": (lambda: F.soft_margin_loss(
+        pt.to_tensor(_X), pt.to_tensor(np.sign(_Y))),
+        lambda: np.log1p(np.exp(-np.sign(_Y) * _X)).mean()),
+    "multi_label_soft_margin_loss": (
+        lambda: F.multi_label_soft_margin_loss(
+            pt.to_tensor(_X), pt.to_tensor((_Y > 0).astype(np.float32))),
+        lambda: -(((_Y > 0) * np.log(sps.expit(_X)) +
+                   (1 - (_Y > 0)) * np.log(1 - sps.expit(_X)))
+                  .mean(-1)).mean()),
+    "cosine_embedding_loss": (
+        lambda: F.cosine_embedding_loss(
+            pt.to_tensor(_X), pt.to_tensor(_Y),
+            pt.to_tensor(np.ones((3,), np.float32))),
+        lambda: (1 - (np.sum(_X * _Y, -1) /
+                      (np.linalg.norm(_X, axis=-1) *
+                       np.linalg.norm(_Y, axis=-1)))).mean()),
+    "hinge_embedding_loss": (
+        lambda: F.hinge_embedding_loss(
+            pt.to_tensor(_X), pt.to_tensor(np.ones((3, 5), np.float32))),
+        lambda: _X.mean()),
+    "margin_ranking_loss": (
+        lambda: F.margin_ranking_loss(
+            pt.to_tensor(_X), pt.to_tensor(_Y),
+            pt.to_tensor(np.ones((3, 5), np.float32))),
+        lambda: np.maximum(0, -( _X - _Y)).mean()),
+    "triplet_margin_loss": (
+        lambda: F.triplet_margin_loss(
+            pt.to_tensor(_X), pt.to_tensor(_Y),
+            pt.to_tensor(_r(3, 5, seed=9))),
+        lambda: np.maximum(
+            np.linalg.norm(_X - _Y, axis=-1) -
+            np.linalg.norm(_X - _r(3, 5, seed=9), axis=-1) + 1.0,
+            0).mean()),
+    "poisson_nll_loss": (
+        lambda: F.poisson_nll_loss(pt.to_tensor(_X),
+                                   pt.to_tensor(np.abs(_Y))),
+        lambda: (np.exp(_X) - np.abs(_Y) * _X).mean()),
+    "gaussian_nll_loss": (
+        lambda: F.gaussian_nll_loss(
+            pt.to_tensor(_X), pt.to_tensor(_Y),
+            pt.to_tensor(np.full((3, 5), 0.5, np.float32))),
+        lambda: (0.5 * (np.log(np.maximum(0.5, 1e-6)) +
+                        (_X - _Y) ** 2 / 0.5)).mean()),
+    "sigmoid_focal_loss": (
+        lambda: F.sigmoid_focal_loss(
+            pt.to_tensor(_X), pt.to_tensor((_Y > 0).astype(np.float32)),
+            reduction="mean"),
+        lambda: _focal_ref()),
+    "dice_loss": (
+        lambda: F.dice_loss(pt.to_tensor(_softmax(_r(3, 4, seed=6))),
+                            pt.to_tensor(np.random.RandomState(7)
+                                         .randint(0, 4, (3, 1))
+                                         .astype(np.int64))),
+        lambda: _dice_ref()),
+    "square_error_cost": (lambda: F.square_error_cost(
+        pt.to_tensor(_X), pt.to_tensor(_Y)),
+        lambda: (_X - _Y) ** 2),
+    "softmax_with_cross_entropy": (
+        lambda: F.softmax_with_cross_entropy(
+            pt.to_tensor(_X), pt.to_tensor(_LBL[:, None])),
+        lambda: -np.log(_softmax(_X))[np.arange(3), _LBL][:, None]),
+    "label_smooth": (lambda: F.label_smooth(
+        pt.to_tensor(np.eye(4, dtype=np.float32)), epsilon=0.1),
+        lambda: np.eye(4) * 0.9 + 0.1 / 4),
+    "ctc_loss": (lambda: F.ctc_loss(
+        pt.to_tensor(_r(6, 2, 5, seed=8)),
+        pt.to_tensor(np.array([[1, 2], [2, 3]], np.int32)),
+        pt.to_tensor(np.array([6, 6], np.int64)),
+        pt.to_tensor(np.array([2, 2], np.int64))).shape,
+        lambda: []),
+    # --------------------------------------------------- linear/embed/norm
+    "linear": (lambda: F.linear(pt.to_tensor(_X),
+                                pt.to_tensor(_r(5, 2, seed=10)),
+                                pt.to_tensor(_r(2, seed=11))),
+               lambda: _X @ _r(5, 2, seed=10) + _r(2, seed=11)),
+    "embedding": (lambda: F.embedding(
+        pt.to_tensor(np.array([0, 2], np.int64)),
+        pt.to_tensor(_r(4, 3, seed=12))),
+        lambda: _r(4, 3, seed=12)[[0, 2]]),
+    "bilinear": (lambda: F.bilinear(
+        pt.to_tensor(_X), pt.to_tensor(_Y),
+        pt.to_tensor(_r(2, 5, 5, seed=13))).shape,
+        lambda: [3, 2]),
+    "normalize": (lambda: F.normalize(pt.to_tensor(_X)),
+                  lambda: _X / np.linalg.norm(_X, axis=-1,
+                                              keepdims=True)),
+    "cosine_similarity": (lambda: F.cosine_similarity(
+        pt.to_tensor(_X), pt.to_tensor(_Y)),
+        lambda: np.sum(_X * _Y, -1) /
+        (np.linalg.norm(_X, axis=-1) * np.linalg.norm(_Y, axis=-1))),
+    "pairwise_distance": (lambda: F.pairwise_distance(
+        pt.to_tensor(_X), pt.to_tensor(_Y)),
+        lambda: np.linalg.norm(_X - _Y, axis=-1)),
+    "batch_norm": (lambda: F.batch_norm(
+        pt.to_tensor(_IMG), pt.to_tensor(np.zeros(4, np.float32)),
+        pt.to_tensor(np.ones(4, np.float32)), training=True),
+        lambda: (_IMG - _IMG.mean((0, 2, 3), keepdims=True)) /
+        np.sqrt(_IMG.var((0, 2, 3), keepdims=True) + 1e-5)),
+    "instance_norm": (lambda: F.instance_norm(pt.to_tensor(_IMG)),
+                      lambda: (_IMG - _IMG.mean((2, 3), keepdims=True)) /
+                      np.sqrt(_IMG.var((2, 3), keepdims=True) + 1e-5)),
+    "group_norm": (lambda: F.group_norm(pt.to_tensor(_IMG), 2),
+                   lambda: _group_norm_ref()),
+    "local_response_norm": (lambda: F.local_response_norm(
+        pt.to_tensor(_IMG), size=3).shape,
+        lambda: [2, 4, 8, 8]),
+    # ------------------------------------------------------ pool/conv/etc
+    "avg_pool1d": (lambda: F.avg_pool1d(pt.to_tensor(_r(2, 3, 8)), 2, 2),
+                   lambda: _r(2, 3, 8).reshape(2, 3, 4, 2).mean(-1)),
+    "max_pool1d": (lambda: F.max_pool1d(pt.to_tensor(_r(2, 3, 8)), 2, 2),
+                   lambda: _r(2, 3, 8).reshape(2, 3, 4, 2).max(-1)),
+    "avg_pool3d": (lambda: F.avg_pool3d(
+        pt.to_tensor(_r(1, 2, 4, 4, 4)), 2, 2),
+        lambda: _r(1, 2, 4, 4, 4).reshape(1, 2, 2, 2, 2, 2, 2, 2)
+        .mean((3, 5, 7))),
+    "max_pool3d": (lambda: F.max_pool3d(
+        pt.to_tensor(_r(1, 2, 4, 4, 4)), 2, 2),
+        lambda: _r(1, 2, 4, 4, 4).reshape(1, 2, 2, 2, 2, 2, 2, 2)
+        .max((3, 5, 7))),
+    "lp_pool1d": (lambda: F.lp_pool1d(
+        pt.to_tensor(np.abs(_r(2, 3, 8))), 2.0, 2, 2),
+        lambda: (np.abs(_r(2, 3, 8)).reshape(2, 3, 4, 2) ** 2)
+        .sum(-1) ** 0.5),
+    "adaptive_avg_pool1d": (lambda: F.adaptive_avg_pool1d(
+        pt.to_tensor(_r(2, 3, 8)), 4),
+        lambda: _r(2, 3, 8).reshape(2, 3, 4, 2).mean(-1)),
+    "adaptive_max_pool1d": (lambda: F.adaptive_max_pool1d(
+        pt.to_tensor(_r(2, 3, 8)), 4),
+        lambda: _r(2, 3, 8).reshape(2, 3, 4, 2).max(-1)),
+    "adaptive_avg_pool3d": (lambda: F.adaptive_avg_pool3d(
+        pt.to_tensor(_r(1, 2, 4, 4, 4)), 2),
+        lambda: _r(1, 2, 4, 4, 4).reshape(1, 2, 2, 2, 2, 2, 2, 2)
+        .mean((3, 5, 7))),
+    "adaptive_max_pool2d": (lambda: F.adaptive_max_pool2d(
+        pt.to_tensor(_IMG), 4),
+        lambda: _np_pool2(_IMG, np.max)),
+    "adaptive_max_pool3d": (lambda: F.adaptive_max_pool3d(
+        pt.to_tensor(_r(1, 2, 4, 4, 4)), 2),
+        lambda: _r(1, 2, 4, 4, 4).reshape(1, 2, 2, 2, 2, 2, 2, 2)
+        .max((3, 5, 7))),
+    "conv1d": (lambda: F.conv1d(
+        pt.to_tensor(_r(1, 1, 6)), pt.to_tensor(_r(1, 1, 3, seed=14))),
+        lambda: np.correlate(_r(1, 1, 6)[0, 0],
+                             _r(1, 1, 3, seed=14)[0, 0],
+                             "valid")[None, None]),
+    "conv3d": (lambda: F.conv3d(
+        pt.to_tensor(np.ones((1, 1, 3, 3, 3), np.float32)),
+        pt.to_tensor(np.ones((1, 1, 2, 2, 2), np.float32))),
+        lambda: np.full((1, 1, 2, 2, 2), 8.0)),
+    "conv1d_transpose": (lambda: F.conv1d_transpose(
+        pt.to_tensor(np.ones((1, 1, 3), np.float32)),
+        pt.to_tensor(np.ones((1, 1, 2), np.float32))),
+        lambda: np.array([[[1, 2, 2, 1]]], np.float32)),
+    "conv2d_transpose": (lambda: F.conv2d_transpose(
+        pt.to_tensor(np.ones((1, 1, 2, 2), np.float32)),
+        pt.to_tensor(np.ones((1, 1, 2, 2), np.float32))),
+        lambda: np.array([[[[1, 2, 1], [2, 4, 2], [1, 2, 1]]]],
+                         np.float32)),
+    "conv3d_transpose": (lambda: F.conv3d_transpose(
+        pt.to_tensor(np.ones((1, 1, 2, 2, 2), np.float32)),
+        pt.to_tensor(np.ones((1, 1, 2, 2, 2), np.float32))).shape,
+        lambda: [1, 1, 3, 3, 3]),
+    "pixel_shuffle": (lambda: F.pixel_shuffle(
+        pt.to_tensor(_IMG), 2).shape, lambda: [2, 1, 16, 16]),
+    "pixel_unshuffle": (lambda: F.pixel_unshuffle(
+        pt.to_tensor(_IMG), 2).shape, lambda: [2, 16, 4, 4]),
+    "channel_shuffle": (lambda: F.channel_shuffle(
+        pt.to_tensor(_IMG), 2),
+        lambda: _IMG.reshape(2, 2, 2, 8, 8).transpose(0, 2, 1, 3, 4)
+        .reshape(2, 4, 8, 8)),
+    "fold": (lambda: F.fold(
+        pt.to_tensor(np.ones((1, 4, 4), np.float32)),
+        output_sizes=[4, 4], kernel_sizes=[2, 2], strides=2).shape,
+        lambda: [1, 1, 4, 4]),
+    "interpolate": (lambda: F.interpolate(
+        pt.to_tensor(_IMG), scale_factor=2, mode="nearest"),
+        lambda: _IMG.repeat(2, 2).repeat(2, 3)),
+    "upsample": (lambda: F.upsample(
+        pt.to_tensor(_IMG), scale_factor=2, mode="nearest"),
+        lambda: _IMG.repeat(2, 2).repeat(2, 3)),
+    "scaled_dot_product_attention": (
+        lambda: F.scaled_dot_product_attention(
+            pt.to_tensor(_r(1, 4, 2, 8, seed=15)),
+            pt.to_tensor(_r(1, 4, 2, 8, seed=16)),
+            pt.to_tensor(_r(1, 4, 2, 8, seed=17))),
+        lambda: _sdpa_ref()),
+    "flash_attn_unpadded": (
+        lambda: F.flash_attn_unpadded(
+            pt.to_tensor(_r(4, 2, 8, seed=15)),
+            pt.to_tensor(_r(4, 2, 8, seed=16)),
+            pt.to_tensor(_r(4, 2, 8, seed=17)),
+            pt.to_tensor(np.array([0, 4], np.int32)),
+            pt.to_tensor(np.array([0, 4], np.int32)),
+            4, 4, scale=8 ** -0.5)[0].shape,
+        lambda: [4, 2, 8]),
+    # --------------------------------------------------------- dropout
+    "one_hot": (lambda: F.one_hot(pt.to_tensor(
+        np.array([0, 2], np.int64)), 4),
+        lambda: np.eye(4, dtype=np.float32)[[0, 2]]),
+    "max_unpool1d": (lambda: _unpool1d_run(),
+                     lambda: _unpool1d_ref()),
+    "max_unpool3d": (lambda: F.max_unpool3d(
+        pt.to_tensor(np.ones((1, 1, 2, 2, 2), np.float32)),
+        pt.to_tensor(np.arange(0, 64, 8).reshape(1, 1, 2, 2, 2)
+                     .astype(np.int32)), 2).shape,
+        lambda: [1, 1, 4, 4, 4]),
+    "dropout": (lambda: F.dropout(pt.to_tensor(_X), p=0.0,
+                                  training=True),
+                lambda: _X),
+    "dropout2d": (lambda: F.dropout2d(pt.to_tensor(_IMG), p=0.0,
+                                      training=True),
+                  lambda: _IMG),
+    "dropout3d": (lambda: F.dropout3d(
+        pt.to_tensor(_r(1, 2, 4, 4, 4)), p=0.0, training=True),
+        lambda: _r(1, 2, 4, 4, 4)),
+    "alpha_dropout": (lambda: F.alpha_dropout(pt.to_tensor(_X), p=0.0,
+                                              training=True),
+                      lambda: _X),
+    "rrelu": (lambda: F.rrelu(pt.to_tensor(_X), training=False),
+              lambda: np.where(_X > 0, _X, _X * (1 / 8 + 1 / 3) / 2)),
+    "gumbel_softmax": (lambda: F.gumbel_softmax(
+        pt.to_tensor(_X)).shape, lambda: [3, 5]),
+}
+
+INPLACE = {"elu_", "hardtanh_", "relu_", "thresholded_relu_"}
+
+
+def _unpool1d_run():
+    return F.max_unpool1d(
+        pt.to_tensor(np.array([[[5.0, 7.0]]], np.float32)),
+        pt.to_tensor(np.array([[[1, 2]]], np.int32)), 2)
+
+
+def _unpool1d_ref():
+    out = np.zeros((1, 1, 4), np.float32)
+    out[0, 0, 1] = 5.0
+    out[0, 0, 2] = 7.0
+    return out
+
+
+def _focal_ref():
+    t = (_Y > 0).astype(np.float32)
+    p = sps.expit(_X)
+    ce = np.maximum(_X, 0) - _X * t + np.log1p(np.exp(-np.abs(_X)))
+    pt_ = p * t + (1 - p) * (1 - t)
+    alpha = 0.25
+    w = alpha * t + (1 - alpha) * (1 - t)
+    return (w * ((1 - pt_) ** 2) * ce).mean()
+
+
+def _dice_ref():
+    pred = _softmax(_r(3, 4, seed=6))
+    lbl = np.random.RandomState(7).randint(0, 4, (3, 1))
+    oh = np.eye(4)[lbl[:, 0]]
+    inter = (pred * oh).sum(-1)
+    return (1 - (2 * inter + 1e-5) /
+            (pred.sum(-1) + oh.sum(-1) + 1e-5)).mean()
+
+
+def _group_norm_ref():
+    x = _IMG.reshape(2, 2, 2, 8, 8)
+    mu = x.mean((2, 3, 4), keepdims=True)
+    var = x.var((2, 3, 4), keepdims=True)
+    return ((x - mu) / np.sqrt(var + 1e-5)).reshape(2, 4, 8, 8)
+
+
+def _sdpa_ref():
+    q = _r(1, 4, 2, 8, seed=15).transpose(0, 2, 1, 3)
+    k = _r(1, 4, 2, 8, seed=16).transpose(0, 2, 1, 3)
+    v = _r(1, 4, 2, 8, seed=17).transpose(0, 2, 1, 3)
+    sc = (q @ k.transpose(0, 1, 3, 2)) / np.sqrt(8)
+    return (_softmax(sc) @ v).transpose(0, 2, 1, 3)
+
+
+def test_nnf_manifest_complete():
+    pub = _public()
+    used = _usage()
+    missing = []
+    for n in sorted(pub):
+        if n in CASES or n in used:
+            continue
+        if n in INPLACE and (n[:-1] in CASES or n[:-1] in used):
+            continue
+        missing.append(n)
+    assert not missing, (
+        f"{len(missing)} nn.functional fns unaccounted: {missing}")
+
+
+def _cmp(got, expected):
+    from paddle_tpu.core.tensor import Tensor
+
+    if isinstance(expected, list):
+        assert list(got) == list(expected), (got, expected)
+        return
+    g = np.asarray(got.numpy() if isinstance(got, Tensor) else got,
+                   np.float64)
+    np.testing.assert_allclose(g, np.asarray(expected, np.float64),
+                               rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("name", sorted(CASES))
+def test_nnf_case(name):
+    run, ref = CASES[name]
+    _cmp(run(), ref())
+
+
+def test_conv2d_transpose_grouped_matches_per_group():
+    """Single grouped conv call == per-group groups=1 calls (the weight
+    [G*cin_g, out_g, *k] -> [cin_g, G*out_g, *k] rearrangement)."""
+    rng = np.random.RandomState(0)
+    for g, cin, cout in ((2, 4, 6), (3, 6, 9)):
+        x = rng.randn(2, cin, 5, 5).astype(np.float32)
+        w = rng.randn(cin, cout // g, 3, 3).astype(np.float32)
+        got = F.conv2d_transpose(pt.to_tensor(x), pt.to_tensor(w),
+                                 stride=2, groups=g).numpy()
+        cg = cin // g
+        ref = np.concatenate(
+            [F.conv2d_transpose(pt.to_tensor(x[:, i * cg:(i + 1) * cg]),
+                                pt.to_tensor(w[i * cg:(i + 1) * cg]),
+                                stride=2, groups=1).numpy()
+             for i in range(g)], axis=1)
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)

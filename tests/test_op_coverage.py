@@ -1,0 +1,755 @@
+"""Per-op coverage sweep + manifest (VERDICT r2 next #6; reference:
+test/legacy_test/op_test.py:418 and the per-op suites under
+test/legacy_test/).
+
+Every PUBLIC top-level op must be accounted for by exactly one of:
+  - usage in an existing dedicated test file (scanned mechanically),
+  - the numpy-mapped UNARY/BINARY sweeps below (eager + jit vs numpy,
+    numeric grad for a differentiable subset),
+  - a curated CASES entry (eager [+ jit] vs numpy),
+  - the RANDOM smoke sweep (shape/dtype/range),
+  - INPLACE derivation (name ends '_', base op covered, family rebind
+    tested in test_ops_more.py),
+  - the explicit SKIP list with a reason.
+test_manifest_complete fails listing any op that slips through, so new
+ops cannot land untested. Set PADDLE_TPU_WRITE_MANIFEST=1 to regenerate
+tests/op_coverage_manifest.json.
+"""
+import glob
+import inspect
+import re
+import json
+import os
+
+import numpy as np
+import pytest
+from scipy import special as sps
+
+import paddle_tpu as pt
+
+# --------------------------------------------------------------- inventory
+
+
+def _public_ops():
+    out = {}
+    for n in dir(pt):
+        if n.startswith("_"):
+            continue
+        o = getattr(pt, n)
+        if inspect.isfunction(o):
+            out[n] = o
+    return out
+
+
+def _usage_covered():
+    """Ops exercised by an existing dedicated test file."""
+    hits = {}
+    here = os.path.dirname(__file__)
+    for f in sorted(glob.glob(os.path.join(here, "*.py"))):
+        if os.path.basename(f) == "test_op_coverage.py":
+            continue
+        text = open(f).read()
+        for name in _public_ops():
+            if name in hits:
+                continue
+            esc = re.escape(name)
+            # pt./paddle. calls, or Tensor-METHOD calls (which dispatch to
+            # the same op) — but not numpy/scipy/jax attribute lookups
+            pat = (rf"(?:pt|paddle)\.{esc}\(|"
+                   rf"(?<!np)(?<!py)(?<!ps)(?<!ax)\.{esc}\(")
+            if re.search(pat, text):
+                hits[name] = os.path.basename(f)
+    return hits
+
+
+def _pos(shape, seed=0):
+    return np.abs(np.random.RandomState(seed).randn(*shape)) \
+        .astype(np.float32) + 0.1
+
+
+def _std(shape, seed=0):
+    return (np.random.RandomState(seed).uniform(-0.9, 0.9, shape)) \
+        .astype(np.float32)
+
+
+def _ints(shape, lo=0, hi=8, seed=0):
+    return np.random.RandomState(seed).randint(lo, hi, shape) \
+        .astype(np.int32)
+
+
+S = (3, 4)
+
+# op -> (numpy_fn, input_builder, grad_checkable)
+UNARY = {
+    "abs": (np.abs, _std, False),
+    "exp": (np.exp, _std, True),
+    "log": (np.log, _pos, True),
+    "sin": (np.sin, _std, True),
+    "sqrt": (np.sqrt, _pos, True),
+    "isfinite": (np.isfinite, _std, False),
+    "acos": (np.arccos, _std, True),
+    "acosh": (np.arccosh, lambda s: _pos(s) + 1.0, True),
+    "asin": (np.arcsin, _std, True),
+    "asinh": (np.arcsinh, _std, True),
+    "atan": (np.arctan, _std, True),
+    "atanh": (np.arctanh, _std, True),
+    "ceil": (np.ceil, _std, False),
+    "cos": (np.cos, _std, True),
+    "cosh": (np.cosh, _std, True),
+    "deg2rad": (np.deg2rad, _std, False),
+    "digamma": (sps.digamma, _pos, False),
+    "erf": (sps.erf, _std, True),
+    "expm1": (np.expm1, _std, True),
+    "floor": (np.floor, _std, False),
+    "frac": (lambda a: a - np.trunc(a), _std, False),
+    "i0e": (sps.i0e, _std, False),
+    "i1": (sps.i1, _std, False),
+    "i1e": (sps.i1e, _std, False),
+    "imag": (np.imag, _std, False),
+    "isinf": (np.isinf, _std, False),
+    "isnan": (np.isnan, _std, False),
+    "isreal": (np.isreal, _std, False),
+    "lgamma": (sps.gammaln, _pos, False),
+    "log10": (np.log10, _pos, True),
+    "log1p": (np.log1p, _pos, True),
+    "log2": (np.log2, _pos, True),
+    "logit": (sps.logit, lambda s: _std(s) * 0.4 + 0.5, False),
+    "nan_to_num": (np.nan_to_num, _std, False),
+    "neg": (np.negative, _std, True),
+    "positive": (np.positive, _std, False),
+    "rad2deg": (np.rad2deg, _std, False),
+    "real": (np.real, _std, False),
+    "reciprocal": (np.reciprocal, _pos, True),
+    "rsqrt": (lambda a: 1 / np.sqrt(a), _pos, True),
+    "sgn": (np.sign, _std, False),
+    "sigmoid": (sps.expit, _std, True),
+    "sign": (np.sign, _std, False),
+    "sinh": (np.sinh, _std, True),
+    "square": (np.square, _std, True),
+    "stanh": (lambda a: np.tanh(a * 0.67) * 1.7159, _std, False),
+    "tan": (np.tan, _std, True),
+    "trunc": (np.trunc, _std, False),
+    "angle": (np.angle, _std, False),
+    "conj": (np.conj, _std, False),
+}
+
+# op -> (numpy_fn, lhs builder, rhs builder)
+BINARY = {
+    "maximum": (np.maximum, _std, lambda s: _std(s, 1)),
+    "isclose": (np.isclose, _std, lambda s: _std(s, 1)),
+    "atan2": (np.arctan2, _std, lambda s: _std(s, 1)),
+    "copysign": (np.copysign, _std, lambda s: _std(s, 1)),
+    "divide": (np.divide, _std, lambda s: _pos(s, 1)),
+    "equal": (np.equal, lambda s: _ints(s), lambda s: _ints(s, seed=1)),
+    "not_equal": (np.not_equal, lambda s: _ints(s),
+                  lambda s: _ints(s, seed=1)),
+    "greater_equal": (np.greater_equal, lambda s: _ints(s),
+                      lambda s: _ints(s, seed=1)),
+    "greater_than": (np.greater, lambda s: _ints(s),
+                     lambda s: _ints(s, seed=1)),
+    "less_equal": (np.less_equal, lambda s: _ints(s),
+                   lambda s: _ints(s, seed=1)),
+    "less_than": (np.less, lambda s: _ints(s), lambda s: _ints(s, seed=1)),
+    "floor_divide": (np.floor_divide, lambda s: _ints(s, 1, 9),
+                     lambda s: _ints(s, 1, 5, seed=1)),
+    "fmax": (np.fmax, _std, lambda s: _std(s, 1)),
+    "fmin": (np.fmin, _std, lambda s: _std(s, 1)),
+    "gcd": (np.gcd, lambda s: _ints(s, 1, 30),
+            lambda s: _ints(s, 1, 30, seed=1)),
+    "lcm": (np.lcm, lambda s: _ints(s, 1, 12),
+            lambda s: _ints(s, 1, 12, seed=1)),
+    "heaviside": (np.heaviside, _std, lambda s: _std(s, 1)),
+    "hypot": (np.hypot, _std, lambda s: _std(s, 1)),
+    "logaddexp": (np.logaddexp, _std, lambda s: _std(s, 1)),
+    "logical_and": (np.logical_and, lambda s: _ints(s, 0, 2),
+                    lambda s: _ints(s, 0, 2, seed=1)),
+    "logical_or": (np.logical_or, lambda s: _ints(s, 0, 2),
+                   lambda s: _ints(s, 0, 2, seed=1)),
+    "logical_xor": (np.logical_xor, lambda s: _ints(s, 0, 2),
+                    lambda s: _ints(s, 0, 2, seed=1)),
+    "minimum": (np.minimum, _std, lambda s: _std(s, 1)),
+    "mod": (np.mod, lambda s: _ints(s, 1, 9),
+            lambda s: _ints(s, 1, 5, seed=1)),
+    "remainder": (np.mod, lambda s: _ints(s, 1, 9),
+                  lambda s: _ints(s, 1, 5, seed=1)),
+    "multiply": (np.multiply, _std, lambda s: _std(s, 1)),
+    "nextafter": (np.nextafter, _std, lambda s: _std(s, 1)),
+    "subtract": (np.subtract, _std, lambda s: _std(s, 1)),
+    "bitwise_and": (np.bitwise_and, lambda s: _ints(s),
+                    lambda s: _ints(s, seed=1)),
+    "bitwise_or": (np.bitwise_or, lambda s: _ints(s),
+                   lambda s: _ints(s, seed=1)),
+    "bitwise_xor": (np.bitwise_xor, lambda s: _ints(s),
+                    lambda s: _ints(s, seed=1)),
+    "bitwise_left_shift": (np.left_shift, lambda s: _ints(s),
+                           lambda s: _ints(s, 0, 4, seed=1)),
+    "bitwise_right_shift": (np.right_shift, lambda s: _ints(s, 0, 64),
+                            lambda s: _ints(s, 0, 4, seed=1)),
+}
+
+# op -> (run(pt) -> np-comparable, numpy reference value builder).
+# Curated cases check the EAGER path (the unary/binary sweeps cover jit
+# parity; list expectations mean "compare shapes").
+_A = _std(S, 3)
+_B = _std(S, 4)
+_SQ = (np.random.RandomState(5).randn(4, 4) / 2 +
+       2 * np.eye(4)).astype(np.float32)
+_SPD = (_SQ @ _SQ.T + np.eye(4)).astype(np.float32)
+_I8 = _ints((6,), 0, 50, seed=6)
+
+CASES = {
+    "assign": (lambda: pt.assign(pt.to_tensor(_A)), lambda: _A),
+    "allclose": (lambda: pt.allclose(pt.to_tensor(_A),
+                                     pt.to_tensor(_A.copy())),
+                 lambda: True),
+    "arange": (lambda: pt.arange(2, 10, 2), lambda: np.arange(2, 10, 2)),
+    "argsort": (lambda: pt.argsort(pt.to_tensor(_std((6,)))),
+                lambda: np.argsort(_std((6,)), kind="stable")),
+    "bincount": (lambda: pt.bincount(pt.to_tensor(_ints((8,), 0, 5))),
+                 lambda: np.bincount(_ints((8,), 0, 5))),
+    "clip": (lambda: pt.clip(pt.to_tensor(_A), -0.3, 0.3),
+             lambda: np.clip(_A, -0.3, 0.3)),
+    "diag": (lambda: pt.diag(pt.to_tensor(_SQ)), lambda: np.diag(_SQ)),
+    "eye": (lambda: pt.eye(3, 4), lambda: np.eye(3, 4)),
+    "full": (lambda: pt.full([2, 3], 7.0), lambda: np.full((2, 3), 7.0)),
+    "linspace": (lambda: pt.linspace(0, 1, 5), lambda: np.linspace(0, 1, 5)),
+    "stack": (lambda: pt.stack([pt.to_tensor(_A), pt.to_tensor(_B)]),
+              lambda: np.stack([_A, _B])),
+    "swapaxes": (lambda: pt.swapaxes(pt.to_tensor(_std((2, 3, 4))), 0, 2),
+                 lambda: np.swapaxes(_std((2, 3, 4)), 0, 2)),
+    "take_along_axis": (lambda: pt.take_along_axis(
+        pt.to_tensor(_A), pt.to_tensor(_ints((3, 2), 0, 4)), 1),
+        lambda: np.take_along_axis(_A, _ints((3, 2), 0, 4), 1)),
+    "tril": (lambda: pt.tril(pt.to_tensor(_A)), lambda: np.tril(_A)),
+    "unique": (lambda: pt.unique(pt.to_tensor(
+        np.array([3, 1, 2, 1, 3], np.int32))),
+        lambda: np.array([1, 2, 3])),
+    "trace": (lambda: pt.trace(pt.to_tensor(_SQ)),
+              lambda: np.trace(_SQ)),
+    "fill_diagonal_tensor": (lambda: pt.fill_diagonal_tensor(
+        pt.to_tensor(_SQ), pt.to_tensor(_std((4,), 2))),
+        lambda: _fill_diag_ref()),
+    "logical_not": (lambda: pt.logical_not(pt.to_tensor(_ints(S, 0, 2))),
+                    lambda: np.logical_not(_ints(S, 0, 2))),
+    "bitwise_not": (lambda: pt.bitwise_not(pt.to_tensor(_ints(S))),
+                    lambda: np.invert(_ints(S))),
+    "bitwise_invert": (lambda: pt.bitwise_invert(pt.to_tensor(_ints(S))),
+                       lambda: np.invert(_ints(S))),
+    "addmm": (lambda: pt.addmm(pt.to_tensor(_std((3, 3), 1)),
+                               pt.to_tensor(_std((3, 4), 2)),
+                               pt.to_tensor(_std((4, 3), 3)),
+                               beta=0.5, alpha=2.0),
+              lambda: 0.5 * _std((3, 3), 1) +
+              2.0 * _std((3, 4), 2) @ _std((4, 3), 3)),
+    "bmm": (lambda: pt.bmm(pt.to_tensor(_std((2, 3, 4))),
+                           pt.to_tensor(_std((2, 4, 5), 1))),
+            lambda: _std((2, 3, 4)) @ _std((2, 4, 5), 1)),
+    "mm": (lambda: pt.mm(pt.to_tensor(_A), pt.to_tensor(_B.T.copy())),
+           lambda: _A @ _B.T),
+    "mv": (lambda: pt.mv(pt.to_tensor(_A), pt.to_tensor(_std((4,), 1))),
+           lambda: _A @ _std((4,), 1)),
+    "inner": (lambda: pt.inner(pt.to_tensor(_A), pt.to_tensor(_B)),
+              lambda: np.inner(_A, _B)),
+    "outer": (lambda: pt.outer(pt.to_tensor(_std((3,))),
+                               pt.to_tensor(_std((4,), 1))),
+              lambda: np.outer(_std((3,)), _std((4,), 1))),
+    "dot": (lambda: pt.dot(pt.to_tensor(_std((5,))),
+                           pt.to_tensor(_std((5,), 1))),
+            lambda: np.dot(_std((5,)), _std((5,), 1))),
+    "kron": (lambda: pt.kron(pt.to_tensor(_std((2, 2))),
+                             pt.to_tensor(_std((2, 3), 1))),
+             lambda: np.kron(_std((2, 2)), _std((2, 3), 1))),
+    "cross": (lambda: pt.cross(pt.to_tensor(_std((2, 3))),
+                               pt.to_tensor(_std((2, 3), 1))),
+              lambda: np.cross(_std((2, 3)), _std((2, 3), 1))),
+    "multi_dot": (lambda: pt.multi_dot([pt.to_tensor(_std((2, 3))),
+                                        pt.to_tensor(_std((3, 4), 1)),
+                                        pt.to_tensor(_std((4, 2), 2))]),
+                  lambda: _std((2, 3)) @ _std((3, 4), 1) @ _std((4, 2), 2)),
+    "matrix_power": (lambda: pt.matrix_power(pt.to_tensor(_SQ), 3),
+                     lambda: np.linalg.matrix_power(_SQ, 3)),
+    "matrix_transpose": (lambda: pt.matrix_transpose(
+        pt.to_tensor(_std((2, 3, 4)))),
+        lambda: np.swapaxes(_std((2, 3, 4)), -1, -2)),
+    "matrix_rank": (lambda: pt.matrix_rank(pt.to_tensor(_SPD)),
+                    lambda: np.linalg.matrix_rank(_SPD)),
+    "det": (lambda: pt.det(pt.to_tensor(_SQ)),
+            lambda: np.linalg.det(_SQ)),
+    "slogdet": (lambda: pt.slogdet(pt.to_tensor(_SPD)),
+                lambda: tuple(np.linalg.slogdet(_SPD))),
+    "inverse": (lambda: pt.inverse(pt.to_tensor(_SQ)),
+                lambda: np.linalg.inv(_SQ)),
+    "pinv": (lambda: pt.pinv(pt.to_tensor(_A)),
+             lambda: np.linalg.pinv(_A)),
+    "solve": (lambda: pt.solve(pt.to_tensor(_SQ),
+                               pt.to_tensor(_std((4, 2)))),
+              lambda: np.linalg.solve(_SQ, _std((4, 2)))),
+    "triangular_solve": (
+        lambda: pt.triangular_solve(
+            pt.to_tensor(np.triu(_SPD)), pt.to_tensor(_std((4, 2))),
+            upper=True),
+        lambda: np.linalg.solve(np.triu(_SPD), _std((4, 2)))),
+    "cholesky_solve": (
+        lambda: pt.cholesky_solve(
+            pt.to_tensor(_std((4, 2))),
+            pt.to_tensor(np.linalg.cholesky(_SPD).astype(np.float32)),
+            upper=False),
+        lambda: np.linalg.solve(_SPD, _std((4, 2)))),
+    "eigh": (lambda: pt.eigh(pt.to_tensor(_SPD))[0],
+             lambda: np.linalg.eigh(_SPD)[0]),
+    "eigvalsh": (lambda: pt.eigvalsh(pt.to_tensor(_SPD)),
+                 lambda: np.linalg.eigvalsh(_SPD)),
+    "eigvals": (lambda: pt.sort(pt.real(pt.eigvals(pt.to_tensor(_SPD)))),
+                lambda: np.sort(np.real(np.linalg.eigvals(_SPD)))),
+    "eig": (lambda: pt.sort(pt.real(pt.eig(pt.to_tensor(_SPD))[0])),
+            lambda: np.sort(np.real(np.linalg.eig(_SPD)[0]))),
+    "lstsq": (lambda: pt.lstsq(pt.to_tensor(_A),
+                               pt.to_tensor(_std((3, 2), 1)))[0],
+              lambda: np.linalg.lstsq(_A, _std((3, 2), 1), rcond=None)[0]),
+    "lu": (lambda: pt.lu(pt.to_tensor(_SQ))[0].shape,
+           lambda: [4, 4]),
+    "householder_product": (
+        lambda: pt.householder_product(
+            pt.to_tensor(np.linalg.qr(_SQ)[0].astype(np.float32) * 0.1),
+            pt.to_tensor(_std((4,), 2))).shape,
+        lambda: [4, 4]),
+    "cdist": (lambda: pt.cdist(pt.to_tensor(_std((3, 4))),
+                               pt.to_tensor(_std((5, 4), 1))),
+              lambda: np.sqrt((((_std((3, 4))[:, None] -
+                                 _std((5, 4), 1)[None]) ** 2)
+                               .sum(-1)).clip(0))),
+    "dist": (lambda: pt.dist(pt.to_tensor(_A), pt.to_tensor(_B), p=2),
+             lambda: np.linalg.norm((_A - _B).reshape(-1))),
+    "cov": (lambda: pt.cov(pt.to_tensor(_A)), lambda: np.cov(_A)),
+    "corrcoef": (lambda: pt.corrcoef(pt.to_tensor(_A)),
+                 lambda: np.corrcoef(_A)),
+    "matrix_exp": (lambda: pt.matrix_exp(pt.to_tensor(_SQ * 0.1)),
+                   lambda: sps.expm1(0) + __import__(
+                       "scipy.linalg", fromlist=["expm"]).expm(_SQ * 0.1)),
+    "vander": (lambda: pt.vander(pt.to_tensor(_std((4,))), n=3),
+               lambda: np.vander(_std((4,)), 3, increasing=False)),
+    "tensordot": (lambda: pt.tensordot(pt.to_tensor(_std((3, 4))),
+                                       pt.to_tensor(_std((4, 5), 1)),
+                                       axes=1),
+                  lambda: np.tensordot(_std((3, 4)), _std((4, 5), 1), 1)),
+    # ------------------------------------------------ shape/index/creation
+    "broadcast_to": (lambda: pt.broadcast_to(pt.to_tensor(_std((1, 4))),
+                                             (3, 4)),
+                     lambda: np.broadcast_to(_std((1, 4)), (3, 4))),
+    "broadcast_tensors": (
+        lambda: pt.broadcast_tensors([pt.to_tensor(_std((1, 4))),
+                                      pt.to_tensor(_std((3, 1), 1))])[0],
+        lambda: np.broadcast_arrays(_std((1, 4)), _std((3, 1), 1))[0]),
+    "expand": (lambda: pt.expand(pt.to_tensor(_std((1, 4))), (3, 4)),
+               lambda: np.broadcast_to(_std((1, 4)), (3, 4))),
+    "expand_as": (lambda: pt.expand_as(pt.to_tensor(_std((1, 4))),
+                                       pt.to_tensor(_std((3, 4), 1))),
+                  lambda: np.broadcast_to(_std((1, 4)), (3, 4))),
+    "cast": (lambda: pt.cast(pt.to_tensor(_A), "int32"),
+             lambda: _A.astype(np.int32)),
+    "chunk": (lambda: pt.chunk(pt.to_tensor(_std((6, 4))), 3)[1],
+              lambda: np.split(_std((6, 4)), 3)[1]),
+    "crop": (lambda: pt.crop(pt.to_tensor(_std((4, 5))), shape=[2, 3],
+                             offsets=[1, 1]),
+             lambda: _std((4, 5))[1:3, 1:4]),
+    "diagflat": (lambda: pt.diagflat(pt.to_tensor(_std((3,)))),
+                 lambda: np.diagflat(_std((3,)))),
+    "diff": (lambda: pt.diff(pt.to_tensor(_A)),
+             lambda: np.diff(_A)),
+    "flatten": (lambda: pt.flatten(pt.to_tensor(_std((2, 3, 4)))),
+                lambda: _std((2, 3, 4)).reshape(-1)),
+    "flip": (lambda: pt.flip(pt.to_tensor(_A), axis=1),
+             lambda: np.flip(_A, 1)),
+    "roll": (lambda: pt.roll(pt.to_tensor(_A), 2, axis=1),
+             lambda: np.roll(_A, 2, 1)),
+    "rot90": (lambda: pt.rot90(pt.to_tensor(_A)),
+              lambda: np.rot90(_A)),
+    "moveaxis": (lambda: pt.moveaxis(pt.to_tensor(_std((2, 3, 4))), 0, 2),
+                 lambda: np.moveaxis(_std((2, 3, 4)), 0, 2)),
+    "t": (lambda: pt.t(pt.to_tensor(_A)), lambda: _A.T),
+    "squeeze": (lambda: pt.squeeze(pt.to_tensor(_std((3, 1, 4)))),
+                lambda: _std((3, 1, 4)).squeeze(1)),
+    "unsqueeze": (lambda: pt.unsqueeze(pt.to_tensor(_A), 1),
+                  lambda: _A[:, None]),
+    "unbind": (lambda: pt.unbind(pt.to_tensor(_A))[1],
+               lambda: _A[1]),
+    "unstack": (lambda: pt.unstack(pt.to_tensor(_A))[2],
+                lambda: _A[2]),
+    "meshgrid": (lambda: pt.meshgrid(pt.to_tensor(_std((3,))),
+                                     pt.to_tensor(_std((4,), 1)))[0],
+                 lambda: np.meshgrid(_std((3,)), _std((4,), 1),
+                                     indexing="ij")[0]),
+    "gather_nd": (lambda: pt.gather_nd(
+        pt.to_tensor(_A), pt.to_tensor(np.array([[0, 1], [2, 3]],
+                                                np.int32))),
+        lambda: _A[[0, 2], [1, 3]]),
+    "scatter_nd": (lambda: pt.scatter_nd(
+        pt.to_tensor(np.array([[1], [3]], np.int32)),
+        pt.to_tensor(_std((2, 4))), [5, 4]),
+        lambda: _scatter_nd_ref()),
+    "scatter_nd_add": (lambda: pt.scatter_nd_add(
+        pt.to_tensor(np.zeros((5, 4), np.float32)),
+        pt.to_tensor(np.array([[1], [3]], np.int32)),
+        pt.to_tensor(_std((2, 4)))),
+        lambda: _scatter_nd_ref()),
+    "index_select": (lambda: pt.index_select(
+        pt.to_tensor(_A), pt.to_tensor(np.array([0, 2], np.int32))),
+        lambda: _A[[0, 2]]),
+    "index_sample": (lambda: pt.index_sample(
+        pt.to_tensor(_A), pt.to_tensor(_ints((3, 2), 0, 4))),
+        lambda: np.take_along_axis(_A, _ints((3, 2), 0, 4), axis=1)),
+    "index_add": (lambda: pt.index_add(
+        pt.to_tensor(_A), pt.to_tensor(np.array([0, 2], np.int32)), 0,
+        pt.to_tensor(_std((2, 4), 1))),
+        lambda: _index_add_ref()),
+    "index_put": (lambda: pt.index_put(
+        pt.to_tensor(_A), (pt.to_tensor(np.array([0, 2], np.int32)),),
+        pt.to_tensor(_std((2, 4), 1))),
+        lambda: _index_put_ref()),
+    "put_along_axis": (lambda: pt.put_along_axis(
+        pt.to_tensor(_A), pt.to_tensor(np.array([[1], [2], [0]],
+                                                np.int32)),
+        9.0, 1),
+        lambda: _put_along_ref()),
+    "masked_select": (lambda: pt.masked_select(
+        pt.to_tensor(_A), pt.to_tensor(_A > 0)),
+        lambda: _A[_A > 0]),
+    "nonzero": (lambda: pt.nonzero(pt.to_tensor(
+        np.array([0, 1, 0, 2], np.float32))),
+        lambda: np.array([[1], [3]])),
+    "multiplex": (lambda: pt.multiplex(
+        [pt.to_tensor(_A), pt.to_tensor(_B)],
+        pt.to_tensor(np.array([[0], [1], [0]], np.int32))),
+        lambda: np.stack([_A[0], _B[1], _A[2]])),
+    "one_hot": (lambda: pt.one_hot(pt.to_tensor(
+        np.array([0, 2], np.int64)), 4),
+        lambda: np.eye(4, dtype=np.float32)[[0, 2]]),
+    "repeat_interleave": (lambda: pt.repeat_interleave(
+        pt.to_tensor(_A), 2, axis=0),
+        lambda: np.repeat(_A, 2, 0)),
+    "searchsorted": (lambda: pt.searchsorted(
+        pt.to_tensor(np.array([1.0, 3.0, 5.0], np.float32)),
+        pt.to_tensor(np.array([2.0, 4.0], np.float32))),
+        lambda: np.searchsorted([1.0, 3.0, 5.0], [2.0, 4.0])),
+    "bucketize": (lambda: pt.bucketize(
+        pt.to_tensor(np.array([2.0, 4.0], np.float32)),
+        pt.to_tensor(np.array([1.0, 3.0, 5.0], np.float32))),
+        lambda: np.searchsorted([1.0, 3.0, 5.0], [2.0, 4.0])),
+    "shard_index": (lambda: pt.shard_index(
+        pt.to_tensor(np.array([[1], [6]], np.int64)), 8, 2, 0, -1),
+        lambda: np.array([[1], [-1]])),
+    "slice": (lambda: pt.slice(pt.to_tensor(_A), [0, 1], [0, 1], [2, 3]),
+              lambda: _A[0:2, 1:3]),
+    "strided_slice": (lambda: pt.strided_slice(
+        pt.to_tensor(_A), [1], [0], [4], [2]),
+        lambda: _A[:, 0:4:2]),
+    "as_strided": (lambda: pt.as_strided(
+        pt.to_tensor(_std((12,))), [3, 4], [4, 1]),
+        lambda: np.lib.stride_tricks.as_strided(
+            _std((12,)), (3, 4), (16, 4))),
+    "view": (lambda: pt.view(pt.to_tensor(_A), [4, 3]),
+             lambda: _A.reshape(4, 3)),
+    "view_as": (lambda: pt.view_as(pt.to_tensor(_A),
+                                   pt.to_tensor(_std((4, 3), 1))),
+                lambda: _A.reshape(4, 3)),
+    "atleast_1d": (lambda: pt.atleast_1d(pt.to_tensor(
+        np.float32(3.0))), lambda: np.atleast_1d(np.float32(3.0))),
+    "atleast_2d": (lambda: pt.atleast_2d(pt.to_tensor(_std((3,)))),
+                   lambda: np.atleast_2d(_std((3,)))),
+    "atleast_3d": (lambda: pt.atleast_3d(pt.to_tensor(_A)),
+                   lambda: np.atleast_3d(_A)),
+    "tril_indices": (lambda: pt.tril_indices(3, 3, 0),
+                     lambda: np.stack(np.tril_indices(3, 0, 3))),
+    "triu_indices": (lambda: pt.triu_indices(3, 3, 0),
+                     lambda: np.stack(np.triu_indices(3, 0, 3))),
+    "triu": (lambda: pt.triu(pt.to_tensor(_A)), lambda: np.triu(_A)),
+    "unique_consecutive": (lambda: pt.unique_consecutive(
+        pt.to_tensor(np.array([1, 1, 2, 2, 3, 1], np.int32))),
+        lambda: np.array([1, 2, 3, 1])),
+    "ones_like": (lambda: pt.ones_like(pt.to_tensor(_A)),
+                  lambda: np.ones_like(_A)),
+    "full_like": (lambda: pt.full_like(pt.to_tensor(_A), 7.0),
+                  lambda: np.full_like(_A, 7.0)),
+    "empty_like": (lambda: pt.empty_like(pt.to_tensor(_A)).shape,
+                   lambda: list(S)),
+    "empty": (lambda: pt.empty([2, 3]).shape, lambda: [2, 3]),
+    "create_tensor": (lambda: pt.create_tensor("float32").shape,
+                      lambda: []),
+    "logspace": (lambda: pt.logspace(0, 2, 3),
+                 lambda: np.logspace(0, 2, 3)),
+    # ---------------------------------------------------------- reductions
+    "amax": (lambda: pt.amax(pt.to_tensor(_A), axis=1),
+             lambda: np.amax(_A, 1)),
+    "amin": (lambda: pt.amin(pt.to_tensor(_A), axis=1),
+             lambda: np.amin(_A, 1)),
+    "argmin": (lambda: pt.argmin(pt.to_tensor(_A), axis=1),
+               lambda: np.argmin(_A, 1)),
+    "min": (lambda: pt.min(pt.to_tensor(_A)), lambda: np.min(_A)),
+    "prod": (lambda: pt.prod(pt.to_tensor(_A), axis=1),
+             lambda: np.prod(_A, 1)),
+    "median": (lambda: pt.median(pt.to_tensor(_std((3, 5)))),
+               lambda: np.median(_std((3, 5)))),
+    "nanmean": (lambda: pt.nanmean(pt.to_tensor(_nan_arr())),
+                lambda: np.nanmean(_nan_arr())),
+    "nansum": (lambda: pt.nansum(pt.to_tensor(_nan_arr())),
+               lambda: np.nansum(_nan_arr())),
+    "nanmedian": (lambda: pt.nanmedian(pt.to_tensor(_nan_arr())),
+                  lambda: np.nanmedian(_nan_arr())),
+    "nanquantile": (lambda: pt.nanquantile(pt.to_tensor(_nan_arr()), 0.5),
+                    lambda: np.nanquantile(_nan_arr(), 0.5)),
+    "count_nonzero": (lambda: pt.count_nonzero(pt.to_tensor(
+        np.array([0, 1, 2, 0], np.float32))),
+        lambda: 2),
+    "cummin": (lambda: pt.cummin(pt.to_tensor(_A), axis=1)[0],
+               lambda: np.minimum.accumulate(_A, 1)),
+    "cumulative_trapezoid": (lambda: pt.cumulative_trapezoid(
+        pt.to_tensor(_A), axis=1),
+        lambda: _cumtrapz_ref()),
+    "histogram": (lambda: pt.histogram(pt.to_tensor(_A), bins=4,
+                                       min=-1.0, max=1.0),
+                  lambda: np.histogram(_A, 4, (-1.0, 1.0))[0]),
+    "histogramdd": (lambda: pt.histogramdd(
+        pt.to_tensor(_std((6, 2))), bins=[2, 2],
+        ranges=[(-1.0, 1.0), (-1.0, 1.0)])[0],
+        lambda: np.histogramdd(_std((6, 2)),
+                               bins=[2, 2],
+                               range=[(-1, 1), (-1, 1)])[0]),
+    "equal_all": (lambda: pt.equal_all(pt.to_tensor(_A),
+                                       pt.to_tensor(_A.copy())),
+                  lambda: True),
+    "is_empty": (lambda: pt.is_empty(pt.to_tensor(
+        np.zeros((0,), np.float32))), lambda: True),
+    "numel": (lambda: pt.numel(pt.to_tensor(_A)), lambda: 12),
+    "increment": (lambda: pt.increment(pt.to_tensor(
+        np.array([1.5], np.float32))), lambda: np.array([2.5])),
+    "accuracy": (lambda: pt.accuracy(
+        pt.to_tensor(np.array([[0.1, 0.9], [0.8, 0.2]], np.float32)),
+        pt.to_tensor(np.array([[1], [0]], np.int64))),
+        lambda: 1.0),
+    "lerp": (lambda: pt.lerp(pt.to_tensor(_A), pt.to_tensor(_B), 0.25),
+             lambda: _A + 0.25 * (_B - _A)),
+    "scale": (lambda: pt.scale(pt.to_tensor(_A), 2.0, bias=1.0),
+              lambda: 2.0 * _A + 1.0),
+    "complex": (lambda: pt.abs(pt.complex(pt.to_tensor(_A),
+                                          pt.to_tensor(_B))),
+                lambda: np.abs(_A + 1j * _B)),
+    "polygamma": (lambda: pt.polygamma(pt.to_tensor(_pos(S)), 1),
+                  lambda: sps.polygamma(1, _pos(S))),
+    "gammainc": (lambda: pt.gammainc(pt.to_tensor(_pos(S)),
+                                     pt.to_tensor(_pos(S, 1))),
+                 lambda: sps.gammainc(_pos(S), _pos(S, 1))),
+    "gammaincc": (lambda: pt.gammaincc(pt.to_tensor(_pos(S)),
+                                       pt.to_tensor(_pos(S, 1))),
+                  lambda: sps.gammaincc(_pos(S), _pos(S, 1))),
+}
+
+
+def _nan_arr():
+    a = _std((3, 4), 7).copy()
+    a[0, 0] = np.nan
+    return a
+
+
+def _scatter_nd_ref():
+    out = np.zeros((5, 4), np.float32)
+    np.add.at(out, [1, 3], _std((2, 4)))
+    return out
+
+
+def _index_add_ref():
+    out = _A.copy()
+    out[[0, 2]] += _std((2, 4), 1)
+    return out
+
+
+def _index_put_ref():
+    out = _A.copy()
+    out[[0, 2]] = _std((2, 4), 1)
+    return out
+
+
+def _put_along_ref():
+    out = _A.copy()
+    np.put_along_axis(out, np.array([[1], [2], [0]]), 9.0, 1)
+    return out
+
+
+def _fill_diag_ref():
+    out = _SQ.copy()
+    np.fill_diagonal(out, _std((4,), 2))
+    return out
+
+
+def _cumtrapz_ref():
+    from scipy import integrate
+
+    return integrate.cumulative_trapezoid(_A, axis=1)
+
+
+# random ops: smoke shape/dtype/range only
+RANDOM = {
+    "bernoulli": lambda: pt.bernoulli(pt.to_tensor(
+        np.full(S, 0.5, np.float32))),
+    "binomial": lambda: pt.binomial(pt.to_tensor(
+        np.full(S, 10.0, np.float32)), pt.to_tensor(
+        np.full(S, 0.5, np.float32))),
+    "multinomial": lambda: pt.multinomial(pt.to_tensor(
+        np.full((4,), 0.25, np.float32)), 3),
+    "normal": lambda: pt.normal(0.0, 1.0, S),
+    "standard_normal": lambda: pt.standard_normal(S),
+    "uniform": lambda: pt.uniform(S),
+    "poisson": lambda: pt.poisson(pt.to_tensor(
+        np.full(S, 3.0, np.float32))),
+    "rand_like": lambda: pt.rand_like(pt.to_tensor(_A)),
+    "randn_like": lambda: pt.randn_like(pt.to_tensor(_A)),
+    "randint_like": lambda: pt.randint_like(pt.to_tensor(_A), 0, 5),
+    "randperm": lambda: pt.randperm(8),
+    "log_normal": lambda: pt.log_normal(shape=S),
+    "cauchy_": lambda: pt.cauchy_(pt.to_tensor(_A.copy())),
+    "exponential_": lambda: pt.exponential_(pt.to_tensor(_A.copy())),
+    "pca_lowrank": lambda: pt.pca_lowrank(pt.to_tensor(
+        _std((6, 4))), q=2)[0],
+}
+
+# framework/config/state fns: no numeric semantics to sweep
+SKIP = {
+    "dtype": "dtype constructor, exercised everywhere implicitly",
+    "finfo": "dtype metadata query",
+    "iinfo": "dtype metadata query",
+    "get_cudnn_version": "compat shim, returns None on TPU",
+    "get_default_dtype": "framework state, used by bench/models",
+    "set_default_dtype": "framework state, used by bench/models",
+    "get_device": "device query, covered by device tests",
+    "set_device": "device state",
+    "get_rng_state": "RNG state plumbing, covered via seed()",
+    "set_rng_state": "RNG state plumbing, covered via seed()",
+    "enable_grad": "autograd context mgr, covered in test_autograd",
+    "set_grad_enabled": "autograd context mgr, covered in test_autograd",
+    "is_grad_enabled": "autograd query, covered in test_autograd",
+    "in_dynamic_mode": "mode query, covered by static tests",
+    "is_compiled_with_cinn": "compat query, constant",
+    "is_tensor": "type query, trivially covered by any test",
+    "shape": "static-graph shape op, covered by test_static usage",
+}
+
+
+def _account():
+    """op -> (category, detail) for every public top-level fn."""
+    ops = _public_ops()
+    usage = _usage_covered()
+    manifest = {}
+    for name in sorted(ops):
+        if name in UNARY:
+            manifest[name] = ("numeric-unary", "test_op_coverage.py")
+        elif name in BINARY:
+            manifest[name] = ("numeric-binary", "test_op_coverage.py")
+        elif name in CASES:
+            manifest[name] = ("numeric-case", "test_op_coverage.py")
+        elif name in RANDOM:
+            manifest[name] = ("random-smoke", "test_op_coverage.py")
+        elif name in SKIP:
+            manifest[name] = ("skip", SKIP[name])
+        elif name.endswith("_") and (
+                name[:-1] in manifest or name[:-1] in usage or
+                name[:-1] in UNARY or name[:-1] in BINARY or
+                name[:-1] in CASES or name[:-1] in RANDOM):
+            manifest[name] = ("inplace-family",
+                              "rebind wrapper over covered base "
+                              "(family mechanics: test_ops_more.py)")
+        elif name in usage:
+            manifest[name] = ("tested-in", usage[name])
+        else:
+            manifest[name] = ("MISSING", "")
+    return manifest
+
+
+def test_manifest_complete():
+    manifest = _account()
+    missing = [n for n, (cat, _) in manifest.items() if cat == "MISSING"]
+    assert not missing, (
+        f"{len(missing)} public ops have no test coverage entry: "
+        f"{missing}")
+    if os.environ.get("PADDLE_TPU_WRITE_MANIFEST"):
+        out = os.path.join(os.path.dirname(__file__),
+                           "op_coverage_manifest.json")
+        with open(out, "w") as f:
+            json.dump({n: {"category": c, "where": w}
+                       for n, (c, w) in manifest.items()}, f, indent=1,
+                      sort_keys=True)
+
+
+# ------------------------------------------------------------- numeric sweep
+
+
+def _cmp(got, expected, rtol=2e-4, atol=2e-5):
+    from paddle_tpu.core.tensor import Tensor
+
+    if isinstance(expected, list):
+        # shape-like expectation (lists are reserved for shapes)
+        assert list(got) == list(expected), (got, expected)
+        return
+    if isinstance(expected, tuple):
+        for g, e in zip(got, expected):
+            _cmp(g, e, rtol, atol)
+        return
+    g = np.asarray(got.numpy() if isinstance(got, Tensor) else got)
+    e = np.asarray(expected)
+    if e.dtype == bool or g.dtype == bool:
+        np.testing.assert_array_equal(g.astype(bool), e.astype(bool))
+    else:
+        np.testing.assert_allclose(g.astype(np.float64),
+                                   e.astype(np.float64),
+                                   rtol=rtol, atol=atol)
+
+
+@pytest.mark.parametrize("name", sorted(UNARY))
+def test_unary_op(name):
+    np_fn, builder, grad_ok = UNARY[name]
+    a = builder(S)
+    op = getattr(pt, name)
+    _cmp(op(pt.to_tensor(a)), np_fn(a))
+    # jit parity
+    import jax
+
+    from paddle_tpu.core.tensor import Tensor
+
+    out = jax.jit(lambda x: op(Tensor(x))._data)(a)
+    _cmp(out, np_fn(a))
+    if grad_ok:
+        x = pt.to_tensor(a)
+        x.stop_gradient = False
+        op(x).sum().backward()
+        eps = 1e-3
+        num = (np_fn(a + eps) - np_fn(a - eps)) / (2 * eps)
+        np.testing.assert_allclose(np.asarray(x.grad.numpy(), np.float64),
+                                   num, rtol=5e-2, atol=5e-3)
+
+
+@pytest.mark.parametrize("name", sorted(BINARY))
+def test_binary_op(name):
+    np_fn, mk_a, mk_b = BINARY[name]
+    a, b = mk_a(S), mk_b(S)
+    op = getattr(pt, name)
+    _cmp(op(pt.to_tensor(a), pt.to_tensor(b)), np_fn(a, b))
+    import jax
+
+    from paddle_tpu.core.tensor import Tensor
+
+    out = jax.jit(lambda x, y: op(Tensor(x), Tensor(y))._data)(a, b)
+    _cmp(out, np_fn(a, b))
+
+
+@pytest.mark.parametrize("name", sorted(CASES))
+def test_case_op(name):
+    entry = CASES[name]
+    run, ref = entry[0], entry[1]
+    _cmp(run(), ref())
+
+
+@pytest.mark.parametrize("name", sorted(RANDOM))
+def test_random_op_smoke(name):
+    pt.seed(11)
+    out = RANDOM[name]()
+    arr = np.asarray(out.numpy())
+    assert arr.size > 0
+    assert np.isfinite(arr.astype(np.float64)).all()
