@@ -13,6 +13,10 @@ from .version import full_version as __version__  # noqa: E402
 
 from .core.dtype import (  # noqa: F401
     bfloat16,
+    float8_e4m3fn,
+    float8_e5m2,
+    pstring,
+    raw,
     bool_,
     complex128,
     complex64,
@@ -66,6 +70,8 @@ from . import sparse  # noqa: F401,E402
 from . import quantization  # noqa: F401,E402
 from . import text  # noqa: F401,E402
 from . import inference  # noqa: F401,E402
+from . import signal  # noqa: F401,E402
+from .framework.param_attr import ParamAttr  # noqa: F401,E402
 from . import fft  # noqa: F401,E402
 from . import linalg  # noqa: F401,E402
 from . import audio  # noqa: F401,E402

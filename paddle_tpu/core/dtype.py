@@ -23,6 +23,10 @@ __all__ = [
     "int64",
     "float16",
     "bfloat16",
+    "float8_e4m3fn",
+    "float8_e5m2",
+    "pstring",
+    "raw",
     "float32",
     "float64",
     "complex64",
@@ -62,7 +66,8 @@ class DType:
 
     @property
     def is_floating_point(self) -> bool:
-        return self.name in ("float16", "bfloat16", "float32", "float64")
+        return self.name in ("float16", "bfloat16", "float32", "float64",
+                             "float8_e4m3fn", "float8_e5m2")
 
     @property
     def is_integer(self) -> bool:
@@ -89,6 +94,12 @@ float32 = DType("float32", np.float32)
 float64 = DType("float64", np.float64)
 complex64 = DType("complex64", np.complex64)
 complex128 = DType("complex128", np.complex128)
+# opaque reference dtypes kept for API parity (no numeric ops)
+pstring = DType("pstring", np.object_)
+raw = DType("raw", np.void)
+# fp8 training dtypes (reference: paddle.float8_e4m3fn / float8_e5m2)
+float8_e4m3fn = DType("float8_e4m3fn", jnp.float8_e4m3fn)
+float8_e5m2 = DType("float8_e5m2", jnp.float8_e5m2)
 
 _ALL = {
     d.name: d
@@ -105,6 +116,8 @@ _ALL = {
         float64,
         complex64,
         complex128,
+        float8_e4m3fn,
+        float8_e5m2,
     )
 }
 _ALL["bool"] = bool_

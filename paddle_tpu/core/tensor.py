@@ -317,8 +317,8 @@ class Tensor:
     def __abs__(self):
         return _ag.run_op(jnp.abs, [self], name="abs")
 
-    def __invert__(self):
-        return _ag.run_op(jnp.logical_not, [self], name="logical_not")
+    # __invert__ (bitwise_not, matching paddle's ~) is installed by
+    # core/tensor_methods.py alongside the other bitwise dunders
 
     # comparisons -> bool tensors (no grad)
     def _cmp(self, other, fn):

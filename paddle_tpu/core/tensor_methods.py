@@ -62,6 +62,19 @@ def install():
 
         setattr(Tensor, name, make(fn))
 
+    # bitwise/logical operator dunders (reference: tensor/__init__.py
+    # magic-method table — __and__/__or__/__xor__/__invert__/shifts)
+    Tensor.__and__ = lambda self, o: ops.bitwise_and(self, o)
+    Tensor.__rand__ = lambda self, o: ops.bitwise_and(self, o)
+    Tensor.__or__ = lambda self, o: ops.bitwise_or(self, o)
+    Tensor.__ror__ = lambda self, o: ops.bitwise_or(self, o)
+    Tensor.__xor__ = lambda self, o: ops.bitwise_xor(self, o)
+    Tensor.__rxor__ = lambda self, o: ops.bitwise_xor(self, o)
+    Tensor.__invert__ = lambda self: ops.bitwise_not(self)
+    Tensor.__lshift__ = lambda self, o: ops.bitwise_left_shift(self, o)
+    Tensor.__rshift__ = lambda self, o: ops.bitwise_right_shift(self, o)
+    Tensor.__pos__ = lambda self: self
+
     # aliases with paddle names
     Tensor.add_n = lambda self, others: functools.reduce(
         lambda a, b: a + b, [self] + list(others)

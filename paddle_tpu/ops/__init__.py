@@ -15,9 +15,10 @@ from .random_ops import *  # noqa: F401,F403
 from .extras import *  # noqa: F401,F403
 from .more import *  # noqa: F401,F403
 from .inplace import *  # noqa: F401,F403
+from .compat import *  # noqa: F401,F403
 
-from . import (creation, extras, inplace, linalg, logic,  # noqa: F401
-               manipulation, math, more, random_ops)
+from . import (compat, creation, extras, inplace, linalg,  # noqa: F401
+               logic, manipulation, math, more, random_ops)
 
 __all__ = (
     creation.__all__
@@ -29,4 +30,5 @@ __all__ = (
     + extras.__all__
     + more.__all__
     + inplace.__all__
+    + compat.__all__
 )

@@ -1,0 +1,197 @@
+"""Remaining reference top-level surface: aliases, dlpack interop,
+CUDA-compat shims, printing/flops utilities (reference:
+python/paddle/__init__.py public list; utils/dlpack.py; flops at
+hapi/dynamic_flops.py; device compat paddle/device/cuda).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ._helpers import as_tensor, run_op, unwrap
+
+__all__ = [
+    "floor_mod", "less", "reverse", "pdist", "batch",
+    "to_dlpack", "from_dlpack", "flops", "set_printoptions",
+    "create_parameter", "check_shape", "disable_signal_handler",
+    "CUDAPlace", "CUDAPinnedPlace", "get_cuda_rng_state",
+    "set_cuda_rng_state", "LazyGuard",
+]
+
+
+def floor_mod(x, y, name=None):
+    """Alias of mod (reference: math.py floor_mod = mod)."""
+    from .math import mod
+
+    return mod(x, y, name=name)
+
+
+def less(x, y, name=None):
+    """Alias of less_than (reference: logic.py less)."""
+    from .logic import less_than
+
+    return less_than(x, y, name=name)
+
+
+def reverse(x, axis, name=None):
+    """Alias of flip (reference BC name)."""
+    from .manipulation import flip
+
+    return flip(x, axis=axis, name=name)
+
+
+def pdist(x, p=2.0, name=None):
+    """Pairwise distances between rows, condensed form (reference:
+    linalg.py pdist)."""
+
+    def fn(a):
+        n = a.shape[0]
+        d = a[:, None, :] - a[None, :, :]
+        if p == 2.0:
+            full = jnp.sqrt(jnp.maximum((d * d).sum(-1), 0.0))
+        else:
+            full = (jnp.abs(d) ** p).sum(-1) ** (1.0 / p)
+        iu = jnp.triu_indices(n, k=1)
+        return full[iu]
+
+    return run_op(fn, [as_tensor(x)], name="pdist")
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Legacy reader decorator (reference: python/paddle/reader): groups
+    an item reader into batches."""
+
+    def batched():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batched
+
+
+def to_dlpack(x):
+    """reference: utils/dlpack.py to_dlpack — hand out the jax array
+    itself, which carries __dlpack__/__dlpack_device__ (the modern
+    capsule-provider protocol consumers expect)."""
+    return unwrap(as_tensor(x))
+
+
+def from_dlpack(ext):
+    """reference: utils/dlpack.py from_dlpack — accepts any object with
+    the __dlpack__ protocol (torch/np/jax arrays, to_dlpack results)."""
+    return Tensor(jnp.from_dlpack(ext))
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """Rough FLOPs count for a Layer (reference: hapi/dynamic_flops.py):
+    2*m*n*k per Linear/matmul-style layer + conv kernel products, counted
+    from a traced forward's parameters. A per-layer estimate, not an HLO
+    cost model."""
+    total = 0
+    spatial = int(np.prod(input_size[2:])) if input_size is not None \
+        and len(input_size) > 2 else 1
+    for _, layer in net.named_sublayers(include_self=True):
+        w = getattr(layer, "weight", None)
+        if w is None or not hasattr(w, "shape"):
+            continue
+        shp = tuple(w.shape)
+        if len(shp) == 2:           # linear: 2*m*n
+            total += 2 * int(np.prod(shp))
+        elif len(shp) >= 3:         # conv: 2*O*I*k... per output position
+            total += 2 * int(np.prod(shp)) * spatial
+    mult = int(np.prod(input_size[:1])) if input_size else 1
+    return total * max(mult, 1)
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """reference: tensor/to_string.py set_printoptions — Tensor repr uses
+    numpy formatting, so delegate."""
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    np.set_printoptions(**kw)
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    """reference: tensor/creation.py create_parameter."""
+    from ..nn.initializer import Constant, XavierNormal
+    from ..nn.layer.layers import Parameter
+
+    init = default_initializer or (Constant(0.0) if is_bias
+                                   else XavierNormal())
+    data = init(shape, dtype)
+    p = Parameter(data if isinstance(data, jnp.ndarray) else
+                  jnp.asarray(data))
+    p.name = name
+    return p
+
+
+def check_shape(x):
+    """reference: static nn.control_flow check utility — no-op shape
+    assert helper kept for API parity."""
+    return as_tensor(x).shape
+
+
+def disable_signal_handler():
+    """reference: pybind disable_signal_handler — jax installs no
+    conflicting handlers; kept for API parity."""
+
+
+class CUDAPlace:
+    """Compat shim: CUDA places map to the TPU/host device space
+    (reference paddle.CUDAPlace). Construction is allowed so configs
+    parse; device selection routes through paddle_tpu.device."""
+
+    def __init__(self, device_id=0):
+        self.device_id = device_id
+
+    def __repr__(self):
+        return f"CUDAPlace({self.device_id})"
+
+
+class CUDAPinnedPlace:
+    def __repr__(self):
+        return "CUDAPinnedPlace()"
+
+
+def get_cuda_rng_state():
+    """Compat: the framework RNG state (reference
+    get_cuda_rng_state)."""
+    from ..core import random as _rng
+
+    return _rng.get_rng_state()
+
+
+def set_cuda_rng_state(state):
+    from ..core import random as _rng
+
+    return _rng.set_rng_state(state)
+
+
+class LazyGuard:
+    """reference: base/framework LazyGuard — lazy parameter init context.
+    Eager jax init is cheap; the guard is a no-op context manager kept
+    for API parity."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False

@@ -14,6 +14,7 @@ from ..core.tensor import Tensor
 from ._helpers import as_tensor, shape_arg, unwrap
 
 __all__ = [
+    "bernoulli_", "log_normal_", "geometric_",
     "rand", "randn", "randint", "randint_like", "randperm", "uniform",
     "uniform_", "normal", "normal_", "standard_normal", "poisson",
     "bernoulli", "multinomial", "exponential_", "rand_like", "randn_like",
@@ -126,6 +127,33 @@ def multinomial(x, num_samples=1, replacement=False, name=None):
 def exponential_(x, lam=1.0, name=None):
     x._data = (jax.random.exponential(_rng.next_key(), tuple(x.shape)) / lam
                ).astype(x._data.dtype)
+    x._grad_node = None
+    return x
+
+
+def bernoulli_(x, p=0.5, name=None):
+    """In-place Bernoulli fill (reference: tensor/random.py bernoulli_)."""
+    x._data = jax.random.bernoulli(
+        _rng.next_key(), p, tuple(x.shape)).astype(x._data.dtype)
+    x._grad_node = None
+    return x
+
+
+def log_normal_(x, mean=1.0, std=2.0, name=None):
+    """In-place log-normal fill (reference: tensor/random.py)."""
+    x._data = jnp.exp(mean + std * jax.random.normal(
+        _rng.next_key(), tuple(x.shape))).astype(x._data.dtype)
+    x._grad_node = None
+    return x
+
+
+def geometric_(x, probs=0.5, name=None):
+    """In-place geometric fill (reference: tensor/random.py geometric_):
+    number of Bernoulli(p) trials until the first success."""
+    u = jax.random.uniform(_rng.next_key(), tuple(x.shape),
+                           minval=1e-7, maxval=1.0)
+    x._data = jnp.ceil(jnp.log(u) / jnp.log1p(-probs)).astype(
+        x._data.dtype)
     x._grad_node = None
     return x
 

@@ -1,0 +1,149 @@
+"""paddle.signal parity: frame / overlap_add / stft / istft
+(reference: python/paddle/signal.py — frame:42, overlap_add:167,
+stft:272, istft:449). All pure jnp; the FFTs lower to XLA's native FFT.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .ops._helpers import as_tensor, run_op, unwrap
+
+__all__ = ["frame", "overlap_add", "stft", "istft"]
+
+
+def _frame_idx(n, frame_length, hop_length):
+    """[num_frames, frame_length] gather indices — the single framing
+    definition shared by frame() and stft()."""
+    num = 1 + (n - frame_length) // hop_length
+    starts = jnp.arange(num) * hop_length
+    return starts[:, None] + jnp.arange(frame_length)[None, :]
+
+
+def _frame_core(a, frame_length, hop_length, axis):
+    """Frame along ``axis`` with the reference layout —
+    [frame_length, num_frames, ...] for axis=0 and
+    [..., frame_length, num_frames] for axis=-1 (frame_length always
+    precedes num_frames)."""
+    ax = axis % a.ndim
+    idx = _frame_idx(a.shape[ax], frame_length, hop_length)
+    fr = jnp.take(a, idx.reshape(-1), axis=ax)
+    new_shape = (a.shape[:ax] + idx.shape + a.shape[ax + 1:])
+    fr = fr.reshape(new_shape)      # [..., num, frame_length, ...]
+    # reference layout puts frame_length first in both conventions
+    return jnp.swapaxes(fr, ax, ax + 1)
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """Slice ``x`` into overlapping frames along ``axis`` (reference:
+    signal.py frame — [frame_length, num_frames, ...] for axis=0,
+    [..., frame_length, num_frames] for axis=-1)."""
+
+    def fn(a):
+        return _frame_core(a, frame_length, hop_length, axis)
+
+    return run_op(fn, [as_tensor(x)], name="frame")
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """Inverse of frame: overlap-add [..., frame_length, num_frames]
+    (axis=-1) back to a signal."""
+
+    def fn(a):
+        if axis in (-1, a.ndim - 1):
+            frames = jnp.swapaxes(a, -1, -2)  # [..., num, fl]
+        else:
+            # reference axis=0 layout [fl, num, ...] -> [..., num, fl]
+            frames = jnp.moveaxis(a, (1, 0), (-2, -1))
+        out = _ola_core(frames, hop_length)
+        if axis not in (-1, a.ndim - 1):
+            out = jnp.moveaxis(out, -1, 0)
+        return out
+
+    return run_op(fn, [as_tensor(x)], name="overlap_add")
+
+
+def _ola_core(frames, hop_length):
+    """Overlap-add [..., num, fl] -> [..., out_len] — the single OLA
+    definition shared by overlap_add() and istft()."""
+    num, fl = frames.shape[-2], frames.shape[-1]
+    out_len = (num - 1) * hop_length + fl
+    lead = frames.shape[:-2]
+    out = jnp.zeros(lead + (out_len,), frames.dtype)
+    idx = (jnp.arange(num) * hop_length)[:, None] + \
+        jnp.arange(fl)[None, :]
+    return out.at[..., idx.reshape(-1)].add(
+        frames.reshape(lead + (num * fl,)))
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False,
+         onesided=True, name=None):
+    """Short-time Fourier transform (reference: signal.py:272). Returns
+    [..., n_fft//2+1 (onesided) | n_fft, num_frames] complex."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    win = None if window is None else unwrap(as_tensor(window))
+
+    def fn(a, *w):
+        x = a
+        if center:
+            pad = [(0, 0)] * (x.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+            x = jnp.pad(x, pad, mode=pad_mode)
+        idx = _frame_idx(x.shape[-1], n_fft, hop_length)
+        frames = x[..., idx]                     # [..., num, n_fft]
+        if w:
+            wv = w[0]
+            if win_length < n_fft:
+                lp = (n_fft - win_length) // 2
+                wv = jnp.zeros((n_fft,), wv.dtype).at[
+                    lp:lp + win_length].set(wv)
+            frames = frames * wv
+        spec = jnp.fft.rfft(frames, n=n_fft) if onesided \
+            else jnp.fft.fft(frames, n=n_fft)
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        return jnp.swapaxes(spec, -1, -2)        # [..., freq, num]
+
+    ts = [as_tensor(x)] + ([as_tensor(window)] if win is not None else [])
+    return run_op(fn, ts, name="stft")
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    """Inverse STFT with window-square OLA normalization (reference:
+    signal.py:449)."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+
+    def fn(a, *w):
+        spec = jnp.swapaxes(a, -1, -2)           # [..., num, freq]
+        if normalized:
+            spec = spec * jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        frames = jnp.fft.irfft(spec, n=n_fft) if onesided \
+            else jnp.fft.ifft(spec, n=n_fft)
+        if not return_complex:
+            frames = jnp.real(frames)
+        if w:
+            wv = w[0]
+            if win_length < n_fft:
+                lp = (n_fft - win_length) // 2
+                wv = jnp.zeros((n_fft,), wv.dtype).at[
+                    lp:lp + win_length].set(wv)
+        else:
+            wv = jnp.ones((n_fft,), frames.dtype)
+        num = frames.shape[-2]
+        out_len = (num - 1) * hop_length + n_fft
+        sig = _ola_core(frames * wv, hop_length)
+        den = _ola_core(jnp.broadcast_to(
+            (wv * wv).astype(jnp.float32), (num, n_fft)), hop_length)
+        sig = sig / jnp.maximum(den, 1e-10)
+        if center:
+            sig = sig[..., n_fft // 2:out_len - n_fft // 2]
+        if length is not None:
+            sig = sig[..., :length]
+        return sig
+
+    win = None if window is None else unwrap(as_tensor(window))
+    ts = [as_tensor(x)] + ([as_tensor(window)] if win is not None else [])
+    return run_op(fn, ts, name="istft")

@@ -1,0 +1,695 @@
+"""Detection / vision ops (reference: python/paddle/vision/ops.py —
+nms, matrix_nms, roi_align, roi_pool, psroi_pool, box_coder, prior_box,
+yolo_box, yolo_loss, deform_conv2d, distribute_fpn_proposals,
+generate_proposals, read_file, decode_jpeg).
+
+TPU-native: geometry ops are pure jnp (vectorized IoU matrices, bilinear
+gathers) rather than per-box CUDA kernels; NMS uses a lax.fori suppression
+sweep over score-sorted boxes — fixed shapes, jit-safe.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+from ..ops._helpers import as_tensor, run_op, unwrap
+
+__all__ = [
+    "nms", "matrix_nms", "roi_align", "roi_pool", "psroi_pool",
+    "box_coder", "prior_box", "yolo_box", "yolo_loss", "deform_conv2d",
+    "DeformConv2D", "RoIAlign", "RoIPool", "PSRoIPool",
+    "distribute_fpn_proposals", "generate_proposals", "read_file",
+    "decode_jpeg",
+]
+
+
+def _iou_matrix(boxes):
+    """Pairwise IoU of [n, 4] boxes (x1, y1, x2, y2)."""
+    x1, y1, x2, y2 = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
+    area = jnp.maximum(x2 - x1, 0) * jnp.maximum(y2 - y1, 0)
+    ix1 = jnp.maximum(x1[:, None], x1[None, :])
+    iy1 = jnp.maximum(y1[:, None], y1[None, :])
+    ix2 = jnp.minimum(x2[:, None], x2[None, :])
+    iy2 = jnp.minimum(y2[:, None], y2[None, :])
+    inter = jnp.maximum(ix2 - ix1, 0) * jnp.maximum(iy2 - iy1, 0)
+    union = area[:, None] + area[None, :] - inter
+    return inter / jnp.maximum(union, 1e-10)
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None, name=None):
+    """Greedy IoU suppression (reference: vision/ops.py nms). Returns the
+    KEPT indices sorted by descending score. With category_idxs, boxes of
+    different categories never suppress each other."""
+    b = unwrap(as_tensor(boxes))
+    n = b.shape[0]
+    s = jnp.arange(n, 0, -1).astype(jnp.float32) if scores is None \
+        else unwrap(as_tensor(scores))
+    order = jnp.argsort(-s)
+    bs = b[order]
+    iou = _iou_matrix(bs)
+    if category_idxs is not None:
+        cat = unwrap(as_tensor(category_idxs))[order]
+        same = cat[:, None] == cat[None, :]
+        iou = jnp.where(same, iou, 0.0)
+
+    pos = jnp.arange(n)
+
+    def body(i, keep):
+        # suppress i if any higher-scored KEPT box overlaps it
+        over = (iou[i] > iou_threshold) & keep & (pos < i)
+        return keep.at[i].set(jnp.logical_not(over.any()))
+
+    keep = jax.lax.fori_loop(0, n, body,
+                             jnp.ones((n,), bool)) if n else \
+        jnp.ones((0,), bool)
+    kept = order[np.where(np.asarray(keep))[0]]
+    if top_k is not None:
+        kept = kept[:top_k]
+    return Tensor(jnp.asarray(kept, jnp.int64))
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
+               nms_top_k=400, keep_top_k=200, use_gaussian=False,
+               gaussian_sigma=2.0, background_label=0, normalized=True,
+               return_index=False, return_rois_num=True, name=None):
+    """Matrix NMS (SOLOv2): decay each box's score by its overlap with
+    higher-scored same-class boxes — one matrix op, no sequential sweep
+    (reference: vision/ops.py matrix_nms)."""
+    bb = unwrap(as_tensor(bboxes))      # [N, M, 4]
+    sc = unwrap(as_tensor(scores))      # [N, C, M]
+    outs, idxs, nums = [], [], []
+    for n in range(bb.shape[0]):
+        per = []
+        per_idx = []
+        for c in range(sc.shape[1]):
+            if c == background_label:
+                continue
+            s = sc[n, c]
+            valid = s > score_threshold
+            ord_ = jnp.argsort(-s)
+            ord_ = ord_[:nms_top_k]
+            s_k = s[ord_]
+            b_k = bb[n][ord_]
+            iou = _iou_matrix(b_k)
+            iou = jnp.triu(iou, k=1)
+            comp = iou.max(axis=0)              # max overlap w/ higher
+            # decay_j = min_i (1-iou_ij)/(1-comp_i): the suppressor's
+            # own compensation sits in the DENOMINATOR per row i
+            if use_gaussian:
+                decay = jnp.exp(-(iou ** 2 - comp[:, None] ** 2)
+                                / gaussian_sigma).min(axis=0)
+            else:
+                decay = ((1 - iou) / jnp.maximum(1 - comp[:, None],
+                                                 1e-10)).min(axis=0)
+            decay = jnp.minimum(decay, 1.0)
+            s_new = s_k * decay * valid[ord_]
+            per.append(jnp.concatenate(
+                [jnp.full((s_new.shape[0], 1), c, s_new.dtype),
+                 s_new[:, None], b_k], axis=1))
+            per_idx.append(ord_)
+        allc = jnp.concatenate(per, axis=0)
+        alli = jnp.concatenate(per_idx, axis=0)
+        mask = np.asarray(allc[:, 1] > post_threshold)
+        sel = np.where(mask)[0]
+        sel = sel[np.argsort(-np.asarray(allc[sel, 1]))][:keep_top_k]
+        outs.append(allc[sel])
+        idxs.append(alli[sel])
+        nums.append(len(sel))
+    out = Tensor(jnp.concatenate(outs, axis=0))
+    res = [out]
+    if return_index:
+        res.append(Tensor(jnp.concatenate(idxs, axis=0)))
+    if return_rois_num:
+        res.append(Tensor(jnp.asarray(nums, jnp.int32)))
+    return tuple(res) if len(res) > 1 else out
+
+
+def _bilinear(feat, y, x):
+    """Sample feat [C, H, W] at float coords (y, x) arrays."""
+    H, W = feat.shape[1], feat.shape[2]
+    y0 = jnp.clip(jnp.floor(y), 0, H - 1)
+    x0 = jnp.clip(jnp.floor(x), 0, W - 1)
+    y1 = jnp.clip(y0 + 1, 0, H - 1)
+    x1 = jnp.clip(x0 + 1, 0, W - 1)
+    wy = jnp.clip(y - y0, 0, 1)
+    wx = jnp.clip(x - x0, 0, 1)
+    y0i, y1i, x0i, x1i = (a.astype(jnp.int32) for a in (y0, y1, x0, x1))
+    v00 = feat[:, y0i, x0i]
+    v01 = feat[:, y0i, x1i]
+    v10 = feat[:, y1i, x0i]
+    v11 = feat[:, y1i, x1i]
+    return (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx +
+            v10 * wy * (1 - wx) + v11 * wy * wx)
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """RoIAlign (reference: vision/ops.py roi_align): bilinear sampling
+    on a regular grid inside each box, average-pooled per bin."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    xt = unwrap(as_tensor(x))           # [N, C, H, W]
+    bx = unwrap(as_tensor(boxes))       # [R, 4]
+    bn = np.asarray(unwrap(as_tensor(boxes_num)))
+    ratio = 2 if sampling_ratio <= 0 else sampling_ratio
+    off = 0.5 if aligned else 0.0
+    outs = []
+    img_of_roi = np.repeat(np.arange(len(bn)), bn)
+    for r in range(bx.shape[0]):
+        img = int(img_of_roi[r])
+        x1, y1, x2, y2 = [bx[r, i] * spatial_scale for i in range(4)]
+        x1, y1 = x1 - off, y1 - off
+        x2, y2 = x2 - off, y2 - off
+        rw = jnp.maximum(x2 - x1, 1e-3 if aligned else 1.0)
+        rh = jnp.maximum(y2 - y1, 1e-3 if aligned else 1.0)
+        bh, bw = rh / ph, rw / pw
+        gy = (y1 + bh * (jnp.arange(ph)[:, None, None, None] +
+                         (jnp.arange(ratio)[None, :, None, None] + 0.5)
+                         / ratio))
+        gx = (x1 + bw * (jnp.arange(pw)[None, None, :, None] +
+                         (jnp.arange(ratio)[None, None, None, :] + 0.5)
+                         / ratio))
+        yy = jnp.broadcast_to(gy, (ph, ratio, pw, ratio)).reshape(-1)
+        xx = jnp.broadcast_to(gx, (ph, ratio, pw, ratio)).reshape(-1)
+        vals = _bilinear(xt[img], yy, xx)       # [C, ph*ratio*pw*ratio]
+        vals = vals.reshape(xt.shape[1], ph, ratio, pw, ratio)
+        outs.append(vals.mean(axis=(2, 4)))
+    out = jnp.stack(outs) if outs else \
+        jnp.zeros((0, xt.shape[1], ph, pw), xt.dtype)
+    return Tensor(out)
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+             name=None):
+    """Quantized max-pool RoI pooling (reference: roi_pool)."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    xt = unwrap(as_tensor(x))
+    bx = np.asarray(unwrap(as_tensor(boxes)))
+    bn = np.asarray(unwrap(as_tensor(boxes_num)))
+    H, W = xt.shape[2], xt.shape[3]
+    img_of_roi = np.repeat(np.arange(len(bn)), bn)
+    outs = []
+    for r in range(bx.shape[0]):
+        img = int(img_of_roi[r])
+        x1 = int(round(float(bx[r, 0]) * spatial_scale))
+        y1 = int(round(float(bx[r, 1]) * spatial_scale))
+        x2 = int(round(float(bx[r, 2]) * spatial_scale))
+        y2 = int(round(float(bx[r, 3]) * spatial_scale))
+        rh = max(y2 - y1 + 1, 1)
+        rw = max(x2 - x1 + 1, 1)
+        bins = jnp.full((ph, pw, xt.shape[1]), -jnp.inf, xt.dtype)
+        for i in range(ph):
+            for j in range(pw):
+                ys = y1 + int(np.floor(i * rh / ph))
+                ye = y1 + int(np.ceil((i + 1) * rh / ph))
+                xs = x1 + int(np.floor(j * rw / pw))
+                xe = x1 + int(np.ceil((j + 1) * rw / pw))
+                ys, ye = max(ys, 0), min(ye, H)
+                xs, xe = max(xs, 0), min(xe, W)
+                if ye > ys and xe > xs:
+                    bins = bins.at[i, j].set(
+                        xt[img, :, ys:ye, xs:xe].max(axis=(1, 2)))
+        outs.append(jnp.where(jnp.isfinite(bins), bins, 0.0)
+                    .transpose(2, 0, 1))
+    out = jnp.stack(outs) if outs else \
+        jnp.zeros((0, xt.shape[1], ph, pw), xt.dtype)
+    return Tensor(out)
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    """Position-sensitive RoI average pooling (reference: psroi_pool):
+    bin (i, j) pools its own channel group."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    xt = unwrap(as_tensor(x))
+    C = xt.shape[1]
+    oc = C // (ph * pw)
+    bx = np.asarray(unwrap(as_tensor(boxes)))
+    bn = np.asarray(unwrap(as_tensor(boxes_num)))
+    H, W = xt.shape[2], xt.shape[3]
+    img_of_roi = np.repeat(np.arange(len(bn)), bn)
+    outs = []
+    for r in range(bx.shape[0]):
+        img = int(img_of_roi[r])
+        x1 = float(bx[r, 0]) * spatial_scale
+        y1 = float(bx[r, 1]) * spatial_scale
+        x2 = float(bx[r, 2]) * spatial_scale
+        y2 = float(bx[r, 3]) * spatial_scale
+        rh, rw = max(y2 - y1, 0.1), max(x2 - x1, 0.1)
+        bins = jnp.zeros((oc, ph, pw), xt.dtype)
+        for i in range(ph):
+            for j in range(pw):
+                ys = int(np.floor(y1 + i * rh / ph))
+                ye = int(np.ceil(y1 + (i + 1) * rh / ph))
+                xs = int(np.floor(x1 + j * rw / pw))
+                xe = int(np.ceil(x1 + (j + 1) * rw / pw))
+                ys, ye = max(ys, 0), min(ye, H)
+                xs, xe = max(xs, 0), min(xe, W)
+                grp = slice((i * pw + j) * oc, (i * pw + j + 1) * oc)
+                if ye > ys and xe > xs:
+                    bins = bins.at[:, i, j].set(
+                        xt[img, grp, ys:ye, xs:xe].mean(axis=(1, 2)))
+        outs.append(bins)
+    out = jnp.stack(outs) if outs else jnp.zeros((0, oc, ph, pw), xt.dtype)
+    return Tensor(out)
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              axis=0, name=None):
+    """Encode/decode boxes against priors (reference: box_coder)."""
+    pb = unwrap(as_tensor(prior_box))
+    tb = unwrap(as_tensor(target_box))
+    if prior_box_var is None:
+        pv = jnp.ones((4,), pb.dtype)
+    elif isinstance(prior_box_var, (list, tuple)):
+        pv = jnp.asarray(prior_box_var, pb.dtype)
+    else:
+        pv = unwrap(as_tensor(prior_box_var))
+    norm = 0.0 if box_normalized else 1.0
+    pw = pb[:, 2] - pb[:, 0] + norm
+    phh = pb[:, 3] - pb[:, 1] + norm
+    pcx = pb[:, 0] + pw * 0.5
+    pcy = pb[:, 1] + phh * 0.5
+    if code_type == "encode_center_size":
+        tw = tb[:, 2] - tb[:, 0] + norm
+        th = tb[:, 3] - tb[:, 1] + norm
+        tcx = tb[:, 0] + tw * 0.5
+        tcy = tb[:, 1] + th * 0.5
+        pv2 = pv if pv.ndim == 2 else pv[None, :]
+        out = jnp.stack([
+            (tcx[:, None] - pcx[None, :]) / pw[None, :],
+            (tcy[:, None] - pcy[None, :]) / phh[None, :],
+            jnp.log(tw[:, None] / pw[None, :]),
+            jnp.log(th[:, None] / phh[None, :]),
+        ], axis=-1) / pv2[None, :, :] if pv.ndim == 2 else jnp.stack([
+            (tcx[:, None] - pcx[None, :]) / pw[None, :] / pv[0],
+            (tcy[:, None] - pcy[None, :]) / phh[None, :] / pv[1],
+            jnp.log(tw[:, None] / pw[None, :]) / pv[2],
+            jnp.log(th[:, None] / phh[None, :]) / pv[3],
+        ], axis=-1)
+        return Tensor(out)
+    # decode_center_size: target [N, M, 4] deltas against priors
+    if tb.ndim == 2:
+        tb = tb[:, None, :]
+    pv_b = pv if pv.ndim == 1 else pv
+    if axis == 0:
+        pw_, ph_, pcx_, pcy_ = (a[None, :] for a in (pw, phh, pcx, pcy))
+    else:
+        pw_, ph_, pcx_, pcy_ = (a[:, None] for a in (pw, phh, pcx, pcy))
+    if pv.ndim == 1:
+        dx, dy, dw, dh = (tb[..., i] * pv_b[i] for i in range(4))
+    else:
+        dx, dy, dw, dh = (tb[..., i] * pv[:, i][None, :]
+                          for i in range(4))
+    cx = dx * pw_ + pcx_
+    cy = dy * ph_ + pcy_
+    w = jnp.exp(dw) * pw_
+    h = jnp.exp(dh) * ph_
+    out = jnp.stack([cx - w * 0.5, cy - h * 0.5,
+                     cx + w * 0.5 - norm, cy + h * 0.5 - norm], axis=-1)
+    return Tensor(out)
+
+
+def prior_box(input, image, min_sizes, max_sizes=None,
+              aspect_ratios=(1.0,), variance=(0.1, 0.1, 0.2, 0.2),
+              flip=False, clip=False, steps=(0.0, 0.0), offset=0.5,
+              min_max_aspect_ratios_order=False, name=None):
+    """SSD prior (anchor) generation (reference: prior_box)."""
+    feat = as_tensor(input)
+    img = as_tensor(image)
+    fh, fw = int(feat.shape[2]), int(feat.shape[3])
+    ih, iw = int(img.shape[2]), int(img.shape[3])
+    step_w = steps[0] or iw / fw
+    step_h = steps[1] or ih / fh
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if all(abs(ar - a) > 1e-6 for a in ars):
+            ars.append(ar)
+            if flip:
+                ars.append(1.0 / ar)
+    boxes = []
+    for s in min_sizes:
+        boxes.append((s, s))
+        if max_sizes:
+            for ms in max_sizes:
+                d = float(np.sqrt(s * ms))
+                boxes.append((d, d))
+        for ar in ars:
+            if abs(ar - 1.0) < 1e-6:
+                continue
+            boxes.append((s * float(np.sqrt(ar)),
+                          s / float(np.sqrt(ar))))
+    cy = (np.arange(fh) + offset) * step_h
+    cx = (np.arange(fw) + offset) * step_w
+    cyy, cxx = np.meshgrid(cy, cx, indexing="ij")
+    out = np.zeros((fh, fw, len(boxes), 4), np.float32)
+    for k, (bw, bh) in enumerate(boxes):
+        out[:, :, k, 0] = (cxx - bw / 2) / iw
+        out[:, :, k, 1] = (cyy - bh / 2) / ih
+        out[:, :, k, 2] = (cxx + bw / 2) / iw
+        out[:, :, k, 3] = (cyy + bh / 2) / ih
+    if clip:
+        out = np.clip(out, 0, 1)
+    var = np.broadcast_to(np.asarray(variance, np.float32),
+                          out.shape).copy()
+    return Tensor(jnp.asarray(out)), Tensor(jnp.asarray(var))
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio, clip_bbox=True, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5, name=None):
+    """Decode YOLOv3 head predictions into boxes+scores (reference:
+    yolo_box)."""
+    xv = unwrap(as_tensor(x))
+    imgs = unwrap(as_tensor(img_size))
+    an = np.asarray(anchors, np.float32).reshape(-1, 2)
+    na = an.shape[0]
+    N, _, H, W = xv.shape
+    xv = xv.reshape(N, na, 5 + class_num, H, W)
+    gx = jnp.arange(W, dtype=jnp.float32)[None, None, None, :]
+    gy = jnp.arange(H, dtype=jnp.float32)[None, None, :, None]
+    sx = jax.nn.sigmoid(xv[:, :, 0]) * scale_x_y - (scale_x_y - 1) / 2
+    sy = jax.nn.sigmoid(xv[:, :, 1]) * scale_x_y - (scale_x_y - 1) / 2
+    bx = (sx + gx) / W
+    by = (sy + gy) / H
+    bw = jnp.exp(xv[:, :, 2]) * an[None, :, 0, None, None] / \
+        (W * downsample_ratio)
+    bh = jnp.exp(xv[:, :, 3]) * an[None, :, 1, None, None] / \
+        (H * downsample_ratio)
+    conf = jax.nn.sigmoid(xv[:, :, 4])
+    probs = jax.nn.sigmoid(xv[:, :, 5:])
+    score = conf[:, :, None] * probs
+    ih = imgs[:, 0].astype(jnp.float32)[:, None, None, None]
+    iw = imgs[:, 1].astype(jnp.float32)[:, None, None, None]
+    x1 = (bx - bw / 2) * iw
+    y1 = (by - bh / 2) * ih
+    x2 = (bx + bw / 2) * iw
+    y2 = (by + bh / 2) * ih
+    if clip_bbox:
+        x1 = jnp.clip(x1, 0, iw - 1)
+        y1 = jnp.clip(y1, 0, ih - 1)
+        x2 = jnp.clip(x2, 0, iw - 1)
+        y2 = jnp.clip(y2, 0, ih - 1)
+    boxes = jnp.stack([x1, y1, x2, y2], axis=-1).reshape(N, -1, 4)
+    scores = score.transpose(0, 1, 3, 4, 2).reshape(N, -1, class_num)
+    mask = (conf.reshape(N, -1) > conf_thresh)[..., None]
+    return Tensor(boxes * mask), Tensor(scores * mask)
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, scale_x_y=1.0, name=None):
+    """Compact YOLOv3 loss (reference: yolo_loss): best-anchor target
+    assignment + coord/size/objectness/class terms. Per-image python
+    assignment (host), compiled math."""
+    xv = unwrap(as_tensor(x))
+    gb = np.asarray(unwrap(as_tensor(gt_box)))       # [N, B, 4] cx cy w h
+    gl = np.asarray(unwrap(as_tensor(gt_label)))     # [N, B]
+    an_all = np.asarray(anchors, np.float32).reshape(-1, 2)
+    amask = list(anchor_mask)
+    na = len(amask)
+    N, _, H, W = xv.shape
+    xv = xv.reshape(N, na, 5 + class_num, H, W)
+    inp = W * downsample_ratio
+    tgt = np.zeros((N, na, 5 + class_num, H, W), np.float32)
+    obj = np.zeros((N, na, H, W), np.float32)
+    for n in range(N):
+        for b in range(gb.shape[1]):
+            cx, cy, w, h = gb[n, b]
+            if w <= 0 or h <= 0:
+                continue
+            gi = min(int(cx * W), W - 1)
+            gj = min(int(cy * H), H - 1)
+            ious = []
+            for a in range(an_all.shape[0]):
+                aw, ah = an_all[a] / inp
+                inter = min(w, aw) * min(h, ah)
+                ious.append(inter / (w * h + aw * ah - inter))
+            best = int(np.argmax(ious))
+            if best not in amask:
+                continue
+            k = amask.index(best)
+            tgt[n, k, 0, gj, gi] = cx * W - gi
+            tgt[n, k, 1, gj, gi] = cy * H - gj
+            tgt[n, k, 2, gj, gi] = np.log(max(
+                w * inp / an_all[best, 0], 1e-9))
+            tgt[n, k, 3, gj, gi] = np.log(max(
+                h * inp / an_all[best, 1], 1e-9))
+            tgt[n, k, 4, gj, gi] = 1.0
+            tgt[n, k, 5 + int(gl[n, b]), gj, gi] = 1.0
+            obj[n, k, gj, gi] = 1.0
+
+    t = jnp.asarray(tgt)
+    om = jnp.asarray(obj)
+
+    def fn(xr):
+        xr = xr.reshape(N, na, 5 + class_num, H, W)
+        bce = lambda lg, y: jnp.maximum(lg, 0) - lg * y + \
+            jnp.log1p(jnp.exp(-jnp.abs(lg)))
+        lxy = (bce(xr[:, :, 0], t[:, :, 0]) +
+               bce(xr[:, :, 1], t[:, :, 1])) * om
+        lwh = (jnp.abs(xr[:, :, 2] - t[:, :, 2]) +
+               jnp.abs(xr[:, :, 3] - t[:, :, 3])) * om
+        lobj = bce(xr[:, :, 4], om)
+        lcls = (bce(xr[:, :, 5:], t[:, :, 5:]) * om[:, :, None]).sum(2)
+        return (lxy + lwh + lobj + lcls).sum(axis=(1, 2, 3))
+
+    return run_op(fn, [as_tensor(x)], name="yolo_loss")
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Deformable conv v1/v2 (reference: deform_conv2d): bilinear-sample
+    input at offset kernel taps, then contract with the weight."""
+    sx = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    px = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    dx = (dilation, dilation) if isinstance(dilation, int) \
+        else tuple(dilation)
+    ts = [as_tensor(x), as_tensor(offset), as_tensor(weight)]
+    if mask is not None:
+        ts.append(as_tensor(mask))
+    if bias is not None:
+        ts.append(as_tensor(bias))
+    has_mask = mask is not None
+    has_bias = bias is not None
+
+    def fn(a, off, w, *rest):
+        m = rest[0] if has_mask else None
+        bb = rest[-1] if has_bias else None
+        N, C, H, W = a.shape
+        Cout, Cin_g, kh, kw = w.shape
+        oh = (H + 2 * px[0] - dx[0] * (kh - 1) - 1) // sx[0] + 1
+        ow = (W + 2 * px[1] - dx[1] * (kw - 1) - 1) // sx[1] + 1
+        ap = jnp.pad(a, ((0, 0), (0, 0), (px[0], px[0]), (px[1], px[1])))
+        dg = deformable_groups
+        cpd = C // dg                       # channels per deform group
+        off = off.reshape(N, dg, kh, kw, 2, oh, ow)
+        cols = []
+        for n in range(N):
+            per_dg = []
+            for d in range(dg):
+                oy = off[n, d, :, :, 0]
+                ox = off[n, d, :, :, 1]
+                # sample positions [kh, kw, oh, ow]
+                posy = (jnp.arange(oh)[None, None, :, None] * sx[0] +
+                        jnp.arange(kh)[:, None, None, None] * dx[0] + oy)
+                posx = (jnp.arange(ow)[None, None, None, :] * sx[1] +
+                        jnp.arange(kw)[None, :, None, None] * dx[1] + ox)
+                v = _bilinear(ap[n, d * cpd:(d + 1) * cpd],
+                              posy.reshape(-1), posx.reshape(-1))
+                v = v.reshape(cpd, kh, kw, oh, ow)
+                if m is not None:
+                    mm = m[n].reshape(dg, kh, kw, oh, ow)[d]
+                    v = v * mm[None]
+                per_dg.append(v)
+            cols.append(jnp.concatenate(per_dg, axis=0))
+        col = jnp.stack(cols)                # [N, C, kh, kw, oh, ow]
+        # grouped contraction: weight [Cout, C/groups, kh, kw]
+        og = Cout // groups
+        outs = []
+        for g in range(groups):
+            cg = col[:, g * Cin_g:(g + 1) * Cin_g]
+            wg = w[g * og:(g + 1) * og]
+            outs.append(jnp.einsum("ncklhw,ockl->nohw", cg, wg))
+        out = jnp.concatenate(outs, axis=1)
+        if bb is not None:
+            out = out + bb.reshape(1, -1, 1, 1)
+        return out
+
+    return run_op(fn, ts, name="deform_conv2d")
+
+
+class DeformConv2D(Layer):
+    """reference: vision/ops.py DeformConv2D layer."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        ks = (kernel_size, kernel_size) if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        self._stride = stride
+        self._padding = padding
+        self._dilation = dilation
+        self._groups = groups
+        self.weight = self.create_parameter(
+            [out_channels, in_channels // groups, *ks], attr=weight_attr)
+        self.bias = None if bias_attr is False else \
+            self.create_parameter([out_channels], attr=bias_attr,
+                                  is_bias=True)
+
+    def forward(self, x, offset, mask=None):
+        return deform_conv2d(x, offset, self.weight, self.bias,
+                             stride=self._stride, padding=self._padding,
+                             dilation=self._dilation,
+                             groups=self._groups, mask=mask)
+
+
+class RoIAlign(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_align(x, boxes, boxes_num, self._output_size,
+                         self._spatial_scale)
+
+
+class RoIPool(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self._output_size,
+                        self._spatial_scale)
+
+
+class PSRoIPool(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self._output_size,
+                          self._spatial_scale)
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False,
+                             rois_num=None, name=None):
+    """Assign RoIs to FPN levels by scale (reference:
+    distribute_fpn_proposals)."""
+    rois = np.asarray(unwrap(as_tensor(fpn_rois)))
+    off = 1.0 if pixel_offset else 0.0
+    w = rois[:, 2] - rois[:, 0] + off
+    h = rois[:, 3] - rois[:, 1] + off
+    scale = np.sqrt(np.maximum(w * h, 0))
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-8)) + refer_level
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    outs, idxs, nums = [], [], []
+    for l in range(min_level, max_level + 1):
+        sel = np.where(lvl == l)[0]
+        outs.append(Tensor(jnp.asarray(rois[sel])))
+        nums.append(len(sel))
+        idxs.append(sel)
+    restore = np.argsort(np.concatenate(idxs)) if idxs else np.zeros(0)
+    res_nums = [Tensor(jnp.asarray([n], jnp.int32)) for n in nums] \
+        if rois_num is not None else None
+    return outs, Tensor(jnp.asarray(restore, jnp.int32).reshape(-1, 1)), \
+        res_nums
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=False,
+                       name=None):
+    """RPN proposal generation (reference: generate_proposals): decode
+    anchors by deltas, clip, filter small, NMS, top-k."""
+    sc = np.asarray(unwrap(as_tensor(scores)))       # [N, A, H, W]
+    bd = np.asarray(unwrap(as_tensor(bbox_deltas)))  # [N, 4A, H, W]
+    ims = np.asarray(unwrap(as_tensor(img_size)))
+    an = np.asarray(unwrap(as_tensor(anchors))).reshape(-1, 4)
+    var = np.asarray(unwrap(as_tensor(variances))).reshape(-1, 4)
+    N = sc.shape[0]
+    rois_out, scores_out, nums = [], [], []
+    for n in range(N):
+        s = sc[n].transpose(1, 2, 0).reshape(-1)
+        d = bd[n].reshape(-1, 4, sc.shape[2], sc.shape[3]) \
+            .transpose(2, 3, 0, 1).reshape(-1, 4)
+        order = np.argsort(-s)[:pre_nms_top_n]
+        s, d, a, v = s[order], d[order], an[order % an.shape[0]], \
+            var[order % var.shape[0]]
+        aw = a[:, 2] - a[:, 0]
+        ah = a[:, 3] - a[:, 1]
+        acx = a[:, 0] + aw / 2
+        acy = a[:, 1] + ah / 2
+        cx = v[:, 0] * d[:, 0] * aw + acx
+        cy = v[:, 1] * d[:, 1] * ah + acy
+        w = np.exp(np.minimum(v[:, 2] * d[:, 2], 10)) * aw
+        h = np.exp(np.minimum(v[:, 3] * d[:, 3], 10)) * ah
+        boxes = np.stack([cx - w / 2, cy - h / 2,
+                          cx + w / 2, cy + h / 2], axis=1)
+        H, W = ims[n, 0], ims[n, 1]
+        boxes[:, 0::2] = np.clip(boxes[:, 0::2], 0, W)
+        boxes[:, 1::2] = np.clip(boxes[:, 1::2], 0, H)
+        keep = np.where((boxes[:, 2] - boxes[:, 0] >= min_size) &
+                        (boxes[:, 3] - boxes[:, 1] >= min_size))[0]
+        boxes, s = boxes[keep], s[keep]
+        kept = np.asarray(nms(Tensor(jnp.asarray(boxes)),
+                              iou_threshold=nms_thresh,
+                              scores=Tensor(jnp.asarray(s))).numpy())
+        kept = kept[:post_nms_top_n]
+        rois_out.append(boxes[kept])
+        scores_out.append(s[kept])
+        nums.append(len(kept))
+    rois = Tensor(jnp.asarray(np.concatenate(rois_out, axis=0)
+                              if rois_out else np.zeros((0, 4))))
+    scores_t = Tensor(jnp.asarray(np.concatenate(scores_out)
+                                  if scores_out else np.zeros(0)))
+    if return_rois_num:
+        return rois, scores_t, Tensor(jnp.asarray(nums, jnp.int32))
+    return rois, scores_t
+
+
+def read_file(path, name=None):
+    """reference: vision/ops.py read_file — raw bytes as uint8."""
+    with open(path, "rb") as f:
+        data = np.frombuffer(f.read(), dtype=np.uint8)
+    return Tensor(jnp.asarray(data))
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """reference: vision/ops.py decode_jpeg — decode via PIL to [C,H,W]
+    uint8."""
+    import io
+
+    from PIL import Image
+
+    raw = bytes(np.asarray(unwrap(as_tensor(x)), np.uint8))
+    img = Image.open(io.BytesIO(raw))
+    if mode.lower() in ("gray", "grayscale", "l"):
+        img = img.convert("L")
+    elif mode.lower() == "rgb":
+        img = img.convert("RGB")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(jnp.asarray(arr))

@@ -199,6 +199,28 @@ _I8 = _ints((6,), 0, 50, seed=6)
 
 CASES = {
     "assign": (lambda: pt.assign(pt.to_tensor(_A)), lambda: _A),
+    "floor_mod": (lambda: pt.floor_mod(
+        pt.to_tensor(_ints(S, 1, 9)), pt.to_tensor(_ints(S, 1, 5, seed=1))),
+        lambda: np.mod(_ints(S, 1, 9), _ints(S, 1, 5, seed=1))),
+    "less": (lambda: pt.less(pt.to_tensor(_ints(S)),
+                             pt.to_tensor(_ints(S, seed=1))),
+             lambda: np.less(_ints(S), _ints(S, seed=1))),
+    "reverse": (lambda: pt.reverse(pt.to_tensor(_A), 1),
+                lambda: np.flip(_A, 1)),
+    "pdist": (lambda: pt.pdist(pt.to_tensor(_std((4, 3)))),
+              lambda: __import__("scipy.spatial", fromlist=["distance"])
+              .distance.pdist(_std((4, 3))).astype(np.float32)),
+    "to_dlpack": (lambda: pt.from_dlpack(pt.to_dlpack(pt.to_tensor(_A))),
+                  lambda: _A),
+    "from_dlpack": (lambda: pt.from_dlpack(pt.to_tensor(_A)._data),
+                    lambda: _A),
+    "batch": (lambda: list(pt.batch(
+        lambda: iter(range(5)), 2, drop_last=True)()),
+        lambda: [[0, 1], [2, 3]]),
+    "flops": (lambda: pt.flops(pt.nn.Linear(4, 8), [1, 4]) > 0,
+              lambda: True),
+    "check_shape": (lambda: pt.check_shape(pt.to_tensor(_A)),
+                    lambda: [3, 4]),
     "allclose": (lambda: pt.allclose(pt.to_tensor(_A),
                                      pt.to_tensor(_A.copy())),
                  lambda: True),
@@ -606,6 +628,7 @@ RANDOM = {
     "randperm": lambda: pt.randperm(8),
     "log_normal": lambda: pt.log_normal(shape=S),
     "cauchy_": lambda: pt.cauchy_(pt.to_tensor(_A.copy())),
+    "geometric_": lambda: pt.geometric_(pt.to_tensor(_A.copy())),
     "exponential_": lambda: pt.exponential_(pt.to_tensor(_A.copy())),
     "pca_lowrank": lambda: pt.pca_lowrank(pt.to_tensor(
         _std((6, 4))), q=2)[0],
@@ -630,6 +653,10 @@ SKIP = {
     "is_compiled_with_cinn": "compat query, constant",
     "is_tensor": "type query, trivially covered by any test",
     "shape": "static-graph shape op, covered by test_static usage",
+    "set_printoptions": "numpy print-format passthrough",
+    "disable_signal_handler": "no-op parity shim",
+    "get_cuda_rng_state": "compat alias of get_rng_state",
+    "set_cuda_rng_state": "compat alias of set_rng_state",
 }
 
 

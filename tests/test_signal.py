@@ -1,0 +1,56 @@
+"""paddle.signal parity: frame / overlap_add / stft / istft (reference:
+python/paddle/signal.py — part of the round-3 op-surface expansion)."""
+import numpy as np
+
+import paddle_tpu as pt
+
+
+def test_frame_overlap_add_roundtrip():
+    x = np.random.RandomState(0).randn(2, 256).astype(np.float32)
+    fr = pt.signal.frame(pt.to_tensor(x), 64, 64)  # non-overlapping
+    assert list(fr.shape) == [2, 64, 4]
+    back = pt.signal.overlap_add(fr, 64)
+    np.testing.assert_allclose(back.numpy(), x, rtol=1e-6)
+
+
+def test_frame_matches_manual():
+    x = np.arange(10, dtype=np.float32)
+    fr = pt.signal.frame(pt.to_tensor(x), 4, 2).numpy()  # [4, num]
+    ref = np.stack([x[i:i + 4] for i in range(0, 7, 2)], axis=1)
+    np.testing.assert_array_equal(fr, ref)
+
+
+def test_stft_matches_scipy():
+    from scipy import signal as ssig
+
+    x = np.random.RandomState(1).randn(400).astype(np.float32)
+    win = np.hanning(128).astype(np.float32)
+    got = pt.signal.stft(pt.to_tensor(x), n_fft=128, hop_length=32,
+                         window=pt.to_tensor(win), center=False).numpy()
+    # scipy ShortTimeFFT with identical framing
+    num = 1 + (400 - 128) // 32
+    ref = np.stack([np.fft.rfft(x[i * 32:i * 32 + 128] * win)
+                    for i in range(num)], axis=1)
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_stft_istft_roundtrip():
+    x = np.random.RandomState(2).randn(2, 512).astype(np.float32)
+    win = np.hanning(128).astype(np.float32)
+    S = pt.signal.stft(pt.to_tensor(x), n_fft=128, hop_length=32,
+                       window=pt.to_tensor(win))
+    y = pt.signal.istft(S, n_fft=128, hop_length=32,
+                        window=pt.to_tensor(win), length=512).numpy()
+    np.testing.assert_allclose(y, x, atol=1e-4)
+
+
+def test_stft_normalized_and_twosided():
+    x = np.random.RandomState(3).randn(256).astype(np.float32)
+    S1 = pt.signal.stft(pt.to_tensor(x), n_fft=64, hop_length=16,
+                        normalized=True).numpy()
+    S2 = pt.signal.stft(pt.to_tensor(x), n_fft=64, hop_length=16,
+                        normalized=False).numpy()
+    np.testing.assert_allclose(S1 * np.sqrt(64), S2, rtol=1e-4)
+    S3 = pt.signal.stft(pt.to_tensor(x), n_fft=64, hop_length=16,
+                        onesided=False).numpy()
+    assert S3.shape[0] == 64

@@ -1,0 +1,256 @@
+"""Detection/vision ops (reference: python/paddle/vision/ops.py) —
+round-3 op-surface expansion: nms/matrix_nms, roi_align/pool,
+box_coder, prior_box, yolo_box/loss, deform_conv2d, FPN utilities."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.vision import ops as V
+
+
+def _np_nms(boxes, scores, thr):
+    order = np.argsort(-scores)
+    keep = []
+    while order.size:
+        i = order[0]
+        keep.append(i)
+        if order.size == 1:
+            break
+        xx1 = np.maximum(boxes[i, 0], boxes[order[1:], 0])
+        yy1 = np.maximum(boxes[i, 1], boxes[order[1:], 1])
+        xx2 = np.minimum(boxes[i, 2], boxes[order[1:], 2])
+        yy2 = np.minimum(boxes[i, 3], boxes[order[1:], 3])
+        inter = np.maximum(xx2 - xx1, 0) * np.maximum(yy2 - yy1, 0)
+        a1 = (boxes[i, 2] - boxes[i, 0]) * (boxes[i, 3] - boxes[i, 1])
+        a2 = (boxes[order[1:], 2] - boxes[order[1:], 0]) * \
+            (boxes[order[1:], 3] - boxes[order[1:], 1])
+        iou = inter / (a1 + a2 - inter)
+        order = order[1:][iou <= thr]
+    return np.array(keep)
+
+
+def test_nms_matches_numpy_reference():
+    rng = np.random.RandomState(0)
+    base = rng.uniform(0, 80, (30, 2))
+    wh = rng.uniform(10, 30, (30, 2))
+    boxes = np.concatenate([base, base + wh], axis=1).astype(np.float32)
+    scores = rng.rand(30).astype(np.float32)
+    got = V.nms(pt.to_tensor(boxes), 0.4,
+                scores=pt.to_tensor(scores)).numpy()
+    ref = _np_nms(boxes, scores, 0.4)
+    np.testing.assert_array_equal(np.sort(got), np.sort(ref))
+
+
+def test_nms_categories_dont_suppress_each_other():
+    boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11]], np.float32)
+    scores = np.array([0.9, 0.8], np.float32)
+    cats = np.array([0, 1], np.int32)
+    got = V.nms(pt.to_tensor(boxes), 0.3, scores=pt.to_tensor(scores),
+                category_idxs=pt.to_tensor(cats),
+                categories=[0, 1]).numpy()
+    assert len(got) == 2
+
+
+def test_roi_align_uniform_feature():
+    """On a constant feature map every RoI bin equals the constant."""
+    x = np.full((1, 3, 16, 16), 5.0, np.float32)
+    boxes = np.array([[2, 2, 10, 10], [0, 0, 15, 15]], np.float32)
+    out = V.roi_align(pt.to_tensor(x), pt.to_tensor(boxes),
+                      pt.to_tensor(np.array([2], np.int32)), 4).numpy()
+    assert out.shape == (2, 3, 4, 4)
+    np.testing.assert_allclose(out, 5.0, rtol=1e-5)
+
+
+def test_roi_pool_max_semantics():
+    x = np.zeros((1, 1, 8, 8), np.float32)
+    x[0, 0, 2, 2] = 7.0
+    out = V.roi_pool(pt.to_tensor(x),
+                     pt.to_tensor(np.array([[0, 0, 7, 7]], np.float32)),
+                     pt.to_tensor(np.array([1], np.int32)), 2).numpy()
+    assert out.max() == 7.0 and out.shape == (1, 1, 2, 2)
+
+
+def test_psroi_pool_shapes():
+    x = np.random.RandomState(0).randn(1, 8, 8, 8).astype(np.float32)
+    out = V.psroi_pool(pt.to_tensor(x),
+                       pt.to_tensor(np.array([[0, 0, 7, 7]], np.float32)),
+                       pt.to_tensor(np.array([1], np.int32)), 2).numpy()
+    assert out.shape == (1, 2, 2, 2)  # 8 channels / (2*2) bins
+
+
+def test_box_coder_decode_identity():
+    """Zero deltas decode back to the prior centers/sizes."""
+    priors = np.array([[0, 0, 10, 10], [5, 5, 15, 25]], np.float32)
+    deltas = np.zeros((2, 2, 4), np.float32)
+    out = V.box_coder(pt.to_tensor(priors), [1., 1., 1., 1.],
+                      pt.to_tensor(deltas),
+                      code_type="decode_center_size").numpy()
+    np.testing.assert_allclose(out[0], priors, atol=1e-5)
+
+
+def test_box_coder_encode_then_decode_roundtrip():
+    priors = np.array([[0, 0, 10, 10], [5, 5, 15, 25]], np.float32)
+    targets = np.array([[1, 1, 9, 9]], np.float32)
+    enc = V.box_coder(pt.to_tensor(priors), [1., 1., 1., 1.],
+                      pt.to_tensor(targets),
+                      code_type="encode_center_size").numpy()
+    dec = V.box_coder(pt.to_tensor(priors), [1., 1., 1., 1.],
+                      pt.to_tensor(enc.astype(np.float32)),
+                      code_type="decode_center_size").numpy()
+    for m in range(2):
+        np.testing.assert_allclose(dec[0, m], targets[0], atol=1e-4)
+
+
+def test_prior_box_shapes_and_range():
+    feat = pt.to_tensor(np.zeros((1, 8, 4, 4), np.float32))
+    img = pt.to_tensor(np.zeros((1, 3, 32, 32), np.float32))
+    boxes, var = V.prior_box(feat, img, min_sizes=[8.0], max_sizes=[16.0],
+                             aspect_ratios=[2.0], clip=True)
+    assert boxes.shape[0:2] == [4, 4] and boxes.shape[3] == 4
+    b = boxes.numpy()
+    assert (b >= 0).all() and (b <= 1).all()
+    assert var.shape == boxes.shape
+
+
+def test_yolo_box_decode():
+    rng = np.random.RandomState(0)
+    x = rng.randn(1, 2 * 7, 4, 4).astype(np.float32)  # 2 anchors, 2 cls
+    boxes, scores = V.yolo_box(pt.to_tensor(x),
+                               pt.to_tensor(np.array([[64, 64]],
+                                                     np.int32)),
+                               anchors=[10, 13, 16, 30], class_num=2,
+                               conf_thresh=0.0, downsample_ratio=16)
+    assert boxes.shape == [1, 32, 4] and scores.shape == [1, 32, 2]
+    b = boxes.numpy()
+    assert (b >= 0).all() and (b <= 64).all()
+
+
+def test_yolo_loss_decreases_on_matching_prediction():
+    rng = np.random.RandomState(0)
+    gt_box = np.array([[[0.5, 0.5, 0.25, 0.25]]], np.float32)
+    gt_label = np.array([[1]], np.int64)
+    kw = dict(anchors=[10, 13, 16, 30, 33, 23],
+              anchor_mask=[0, 1, 2], class_num=3, ignore_thresh=0.5,
+              downsample_ratio=8)
+    x_bad = pt.to_tensor(rng.randn(1, 3 * 8, 4, 4).astype(np.float32))
+    l_bad = V.yolo_loss(x_bad, pt.to_tensor(gt_box),
+                        pt.to_tensor(gt_label), **kw)
+    assert np.isfinite(float(l_bad.numpy().sum()))
+    # gradient flows
+    xb = pt.to_tensor(rng.randn(1, 3 * 8, 4, 4).astype(np.float32))
+    xb.stop_gradient = False
+    V.yolo_loss(xb, pt.to_tensor(gt_box), pt.to_tensor(gt_label),
+                **kw).sum().backward()
+    assert xb.grad is not None
+
+
+def test_deform_conv2d_zero_offset_equals_conv2d():
+    rng = np.random.RandomState(0)
+    x = rng.randn(1, 2, 6, 6).astype(np.float32)
+    w = rng.randn(3, 2, 3, 3).astype(np.float32)
+    off = np.zeros((1, 2 * 9, 4, 4), np.float32)
+    got = V.deform_conv2d(pt.to_tensor(x), pt.to_tensor(off),
+                          pt.to_tensor(w)).numpy()
+    ref = pt.nn.functional.conv2d(pt.to_tensor(x), pt.to_tensor(w)).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_matrix_nms_decays_overlaps():
+    boxes = np.array([[[0, 0, 10, 10], [1, 1, 11, 11],
+                       [50, 50, 60, 60]]], np.float32)
+    scores = np.array([[[0.0, 0.0, 0.0],
+                        [0.9, 0.85, 0.8]]], np.float32)
+    out, nums = V.matrix_nms(pt.to_tensor(boxes), pt.to_tensor(scores),
+                             score_threshold=0.1, post_threshold=0.0,
+                             background_label=0)
+    o = out.numpy()
+    assert int(nums.numpy()[0]) == 3
+    # the overlapping second box's score is decayed below its raw 0.85
+    assert o[:, 1].max() <= 0.9 + 1e-6
+    decayed = sorted(o[:, 1])[::-1]
+    assert decayed[1] < 0.85
+
+
+def test_distribute_fpn_proposals():
+    rois = np.array([[0, 0, 10, 10],        # small -> low level
+                     [0, 0, 200, 200]], np.float32)  # large -> high
+    outs, restore, _ = V.distribute_fpn_proposals(
+        pt.to_tensor(rois), 2, 5, 4, 224)
+    sizes = [o.numpy().shape[0] for o in outs]
+    assert sum(sizes) == 2
+    assert sizes[0] == 1  # the small one at min level
+    r = restore.numpy().reshape(-1)
+    assert sorted(r.tolist()) == [0, 1]
+
+
+def test_generate_proposals_runs():
+    rng = np.random.RandomState(0)
+    scores = rng.rand(1, 3, 4, 4).astype(np.float32)
+    deltas = rng.randn(1, 12, 4, 4).astype(np.float32) * 0.1
+    anchors = rng.uniform(0, 32, (4 * 4 * 3, 4)).astype(np.float32)
+    anchors[:, 2:] = anchors[:, :2] + 8
+    var = np.full((4 * 4 * 3, 4), 1.0, np.float32)
+    rois, s, num = V.generate_proposals(
+        pt.to_tensor(scores), pt.to_tensor(deltas),
+        pt.to_tensor(np.array([[32, 32]], np.float32)),
+        pt.to_tensor(anchors), pt.to_tensor(var), return_rois_num=True)
+    assert rois.numpy().shape[1] == 4
+    assert int(num.numpy()[0]) == rois.numpy().shape[0]
+
+
+def test_read_file_decode_jpeg_roundtrip(tmp_path):
+    from PIL import Image
+
+    gy, gx = np.meshgrid(np.arange(16), np.arange(16), indexing="ij")
+    img = np.stack([gy * 16, gx * 16, (gy + gx) * 8], -1).astype(np.uint8)
+    p = tmp_path / "t.jpg"
+    Image.fromarray(img).save(p, quality=95)
+    raw = V.read_file(str(p))
+    dec = V.decode_jpeg(raw, mode="rgb").numpy()
+    assert dec.shape == (3, 16, 16)
+    assert np.abs(dec.astype(np.int32).transpose(1, 2, 0) -
+                  img.astype(np.int32)).mean() < 20  # lossy jpeg
+
+
+def test_deform_conv2d_groups_and_dgroups():
+    """groups>1 contracts per channel group; deformable_groups>1 uses
+    per-group offsets (zero offsets == grouped regular conv)."""
+    rng = np.random.RandomState(1)
+    x = rng.randn(1, 4, 6, 6).astype(np.float32)
+    w = rng.randn(4, 2, 3, 3).astype(np.float32)  # groups=2
+    off = np.zeros((1, 2 * 2 * 9, 4, 4), np.float32)  # dg=2
+    got = V.deform_conv2d(pt.to_tensor(x), pt.to_tensor(off),
+                          pt.to_tensor(w), groups=2,
+                          deformable_groups=2).numpy()
+    ref = pt.nn.functional.conv2d(pt.to_tensor(x), pt.to_tensor(w),
+                                  groups=2).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_generate_proposals_scores_are_real():
+    rng = np.random.RandomState(0)
+    scores = rng.rand(1, 3, 4, 4).astype(np.float32)
+    deltas = rng.randn(1, 12, 4, 4).astype(np.float32) * 0.1
+    anchors = rng.uniform(0, 32, (4 * 4 * 3, 4)).astype(np.float32)
+    anchors[:, 2:] = anchors[:, :2] + 8
+    var = np.full((4 * 4 * 3, 4), 1.0, np.float32)
+    rois, s, num = V.generate_proposals(
+        pt.to_tensor(scores), pt.to_tensor(deltas),
+        pt.to_tensor(np.array([[32, 32]], np.float32)),
+        pt.to_tensor(anchors), pt.to_tensor(var), return_rois_num=True)
+    sv = s.numpy()
+    assert sv.shape[0] == rois.numpy().shape[0]
+    assert sv.max() > 0  # real objectness scores, not zeros
+    assert (np.diff(sv) <= 1e-6).all()  # descending by score
+
+
+def test_frame_axis0_reference_layout():
+    import paddle_tpu.signal as sig
+
+    x = np.arange(20, dtype=np.float32).reshape(10, 2)
+    fr = sig.frame(pt.to_tensor(x), 4, 3, axis=0).numpy()
+    assert fr.shape == (4, 3, 2)  # [frame_length, num_frames, ...]
+    np.testing.assert_array_equal(fr[:, 0, 0], x[0:4, 0])
+    np.testing.assert_array_equal(fr[:, 1, 1], x[3:7, 1])
+    back = sig.overlap_add(pt.to_tensor(fr), 3, axis=0).numpy()
+    assert back.shape == (10, 2)
