@@ -9,6 +9,7 @@ bfloat16 is first-class here (it is the MXU-native matmul dtype).
 """
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -147,18 +148,77 @@ def convert_dtype(d) -> DType:
     raise ValueError(f"unsupported dtype: {d!r}")
 
 
+# 64-bit dtype policy (documented narrowing).
+#
+# TPU-native stance: XLA on TPU has no fast 64-bit path, and jax disables
+# x64 by default.  Rather than letting jax emit a truncation UserWarning on
+# every int64/float64 request, we narrow EXPLICITLY here:
+#   * default          — int64→int32, uint64→uint32, float64→float32,
+#                        complex128→complex64, silently (this table).
+#   * FLAGS_strict_dtype64=True — raise TypeError instead of narrowing,
+#                        for users who must not lose width silently.
+#   * jax_enable_x64   — flip jax's global x64 switch (or JAX_ENABLE_X64=1)
+#                        and 64-bit dtypes pass through un-narrowed.
+# Reference semantics keep real int64/fp64 (python/paddle/tensor/creation.py);
+# on TPU the narrow-by-default trade is deliberate and visible in t.dtype,
+# which always reports the TRUE payload dtype.
+_NARROW_64 = {
+    "int64": np.int32,
+    "uint64": np.uint32,
+    "float64": np.float32,
+    "complex128": np.complex64,
+}
+
+
 def to_jax_dtype(d):
-    """DType (or anything dtype-like) -> jnp dtype object."""
+    """DType (or anything dtype-like) -> jnp dtype object.
+
+    Applies the documented 64-bit narrowing policy above when x64 is
+    disabled, so jax never sees (and never warns about) a 64-bit request
+    it cannot honor.
+    """
     dt = convert_dtype(d)
     if dt is None:
         return None
     if dt.name == "bfloat16":
         return jnp.bfloat16
+    if dt.name in _NARROW_64 and not jax.config.jax_enable_x64:
+        from ..framework import get_flags
+        if get_flags(["FLAGS_strict_dtype64"]).get("FLAGS_strict_dtype64"):
+            raise TypeError(
+                f"dtype {dt.name} requested but 64-bit types are disabled "
+                "on this TPU build (FLAGS_strict_dtype64=True). Enable "
+                "jax_enable_x64 for true 64-bit, or drop the strict flag "
+                "to accept documented narrowing to 32-bit.")
+        return _NARROW_64[dt.name]
     return dt.np_dtype
 
 
 def dtype(d) -> DType:  # paddle.dtype-like callable
     return convert_dtype(d)
+
+
+def index_dtype(d="int64"):
+    """Resolve an index-typed ``dtype=`` parameter (argmax/argsort/randperm
+    default to ``"int64"`` per the reference signatures). 64-bit requests
+    narrow via the policy table WITHOUT consulting FLAGS_strict_dtype64 —
+    strict mode guards explicit tensor creation/casting, and must not make
+    ops with untouched int64 defaults unusable."""
+    dt = convert_dtype(d)
+    if dt is None:
+        return None
+    if dt.name in _NARROW_64 and not jax.config.jax_enable_x64:
+        return _NARROW_64[dt.name]
+    return to_jax_dtype(dt)
+
+
+def int64_canonical():
+    """jnp dtype for outputs the reference types as int64 (indices, counts).
+
+    Internal call sites use this instead of a literal ``jnp.int64`` so the
+    narrowing policy applies silently (no jax truncation warning) and true
+    int64 comes back automatically under ``jax_enable_x64``."""
+    return np.int64 if jax.config.jax_enable_x64 else np.int32
 
 
 def from_jax_dtype(jd) -> DType:

@@ -20,7 +20,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import autograd as _ag
-from .dtype import DType, convert_dtype, from_jax_dtype, to_jax_dtype
+from .dtype import (DType, convert_dtype, from_jax_dtype, int64_canonical,
+                    to_jax_dtype)
 
 __all__ = ["Tensor", "to_tensor", "is_tensor"]
 
@@ -59,7 +60,7 @@ class Tensor:
                 if isinstance(data, bool):
                     jdt = jnp.bool_
                 elif isinstance(data, int):
-                    jdt = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+                    jdt = int64_canonical()
                 elif isinstance(data, float):
                     jdt = jnp.float32
             data = jnp.asarray(data, dtype=jdt)

@@ -45,6 +45,9 @@ _FLAGS = {
     "FLAGS_log_memory_stats": False,
     "FLAGS_benchmark": False,
     "FLAGS_eager_delete_tensor_gb": 0.0,
+    # 64-bit dtype policy (core/dtype.py): False = documented narrowing
+    # int64->int32 / float64->float32; True = raise instead of narrowing.
+    "FLAGS_strict_dtype64": False,
 }
 
 # The remainder of the reference's exported-flag surface

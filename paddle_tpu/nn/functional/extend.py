@@ -24,11 +24,11 @@ __all__ = [
 
 def sequence_mask(x, maxlen=None, dtype="int64", name=None):
     """reference: nn/functional/extension.py sequence_mask."""
-    from ...core.dtype import to_jax_dtype
+    from ...core.dtype import index_dtype
 
     lengths = unwrap(as_tensor(x))
     m = int(maxlen) if maxlen is not None else int(lengths.max())
-    jdt = to_jax_dtype(dtype)
+    jdt = index_dtype(dtype)
     out = (jnp.arange(m)[None, :] <
            lengths.reshape(lengths.shape + (1,))).astype(jdt)
     return Tensor(out)

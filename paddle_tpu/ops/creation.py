@@ -3,7 +3,7 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from ..core.dtype import to_jax_dtype
+from ..core.dtype import int64_canonical, to_jax_dtype
 from ..core.tensor import Tensor, to_tensor
 from ._helpers import as_tensor, shape_arg, unwrap
 
@@ -157,7 +157,7 @@ def clone(x, name=None):
 
 
 def numel(x, name=None):
-    return Tensor(jnp.asarray(as_tensor(x).size, dtype=jnp.int64))
+    return Tensor(jnp.asarray(as_tensor(x).size, dtype=int64_canonical()))
 
 
 def tolist(x):

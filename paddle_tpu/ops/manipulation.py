@@ -6,7 +6,7 @@ import builtins
 
 import jax.numpy as jnp
 
-from ..core.dtype import to_jax_dtype
+from ..core.dtype import index_dtype, int64_canonical, to_jax_dtype
 from ..core.tensor import Tensor
 from ._helpers import as_tensor, axis_arg, run_op, shape_arg, unary, unwrap
 
@@ -34,9 +34,8 @@ def reshape(x, shape, name=None):
 
 
 def reshape_(x, shape, name=None):
-    x._data = x._data.reshape(shape_arg(shape))
-    x._grad_node = None
-    return x
+    from .inplace import inplace_rebind
+    return inplace_rebind(x, lambda alias: reshape(alias, shape))
 
 
 def transpose(x, perm=None, name=None):
@@ -143,10 +142,8 @@ def flatten(x, start_axis=0, stop_axis=-1, name=None):
 
 
 def flatten_(x, start_axis=0, stop_axis=-1, name=None):
-    out = flatten(x, start_axis, stop_axis)
-    x._data = out._data
-    x._grad_node = None
-    return x
+    from .inplace import inplace_rebind
+    return inplace_rebind(x, lambda alias: flatten(alias, start_axis, stop_axis))
 
 
 def flip(x, axis, name=None):
@@ -370,17 +367,14 @@ def repeat_interleave(x, repeats, axis=None, name=None):
 def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
     x = as_tensor(x)
     kk = int(unwrap(k)) if isinstance(k, Tensor) else int(k)
-
-    def fn(a):
-        src = a if largest else -a
-        idx = jnp.argsort(-src, axis=axis)
-        idx = jnp.take(idx, jnp.arange(kk), axis=axis)
-        vals = jnp.take_along_axis(a, idx, axis=axis)
-        return vals, idx.astype(jnp.int64)
-
-    vals = run_op(lambda a: fn(a)[0], [x], name="topk")
-    idx = Tensor(fn(x._data)[1])
-    return vals, idx
+    # one argsort; vals gathers through the tape (grad scatters to the
+    # selected positions), idx stays off-tape as integer output
+    src = x._data if largest else -x._data
+    idx_arr = jnp.take(jnp.argsort(-src, axis=axis), jnp.arange(kk),
+                       axis=axis)
+    vals = run_op(lambda a: jnp.take_along_axis(a, idx_arr, axis=axis),
+                  [x], name="topk")
+    return vals, Tensor(idx_arr.astype(int64_canonical()))
 
 
 def sort(x, axis=-1, descending=False, stable=False, name=None):
@@ -396,21 +390,21 @@ def argsort(x, axis=-1, descending=False, stable=False, name=None):
     s = jnp.argsort(x._data, axis=axis, stable=stable)
     if descending:
         s = jnp.flip(s, axis=axis)
-    return Tensor(s.astype(jnp.int64))
+    return Tensor(s.astype(int64_canonical()))
 
 
 def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
     x = as_tensor(x)
     ax = axis_arg(axis)
     out = jnp.argmax(x._data, axis=ax, keepdims=keepdim if ax is not None else False)
-    return Tensor(out.astype(to_jax_dtype(dtype)))
+    return Tensor(out.astype(index_dtype(dtype)))
 
 
 def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
     x = as_tensor(x)
     ax = axis_arg(axis)
     out = jnp.argmin(x._data, axis=ax, keepdims=keepdim if ax is not None else False)
-    return Tensor(out.astype(to_jax_dtype(dtype)))
+    return Tensor(out.astype(index_dtype(dtype)))
 
 
 def unique(x, return_index=False, return_inverse=False, return_counts=False,
@@ -525,7 +519,7 @@ def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=Non
         out = jax.vmap(lambda s, q: jnp.searchsorted(s, q, side=side))(
             ss.reshape(-1, ss.shape[-1]), v.reshape(-1, v.shape[-1])
         ).reshape(v.shape)
-    return Tensor(out.astype(jnp.int32 if out_int32 else jnp.int64))
+    return Tensor(out.astype(jnp.int32 if out_int32 else int64_canonical()))
 
 
 def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
@@ -582,13 +576,13 @@ def crop(x, shape=None, offsets=None, name=None):
 def tril_indices(row, col=None, offset=0, dtype="int64"):
     col = col if col is not None else row
     r, c = jnp.tril_indices(row, k=offset, m=col)
-    return Tensor(jnp.stack([r, c]).astype(to_jax_dtype(dtype)))
+    return Tensor(jnp.stack([r, c]).astype(index_dtype(dtype)))
 
 
 def triu_indices(row, col=None, offset=0, dtype="int64"):
     col = col if col is not None else row
     r, c = jnp.triu_indices(row, k=offset, m=col)
-    return Tensor(jnp.stack([r, c]).astype(to_jax_dtype(dtype)))
+    return Tensor(jnp.stack([r, c]).astype(index_dtype(dtype)))
 
 
 def bincount(x, weights=None, minlength=0, name=None):

@@ -2,6 +2,8 @@
 from __future__ import annotations
 
 import jax.numpy as jnp
+
+from ..core.dtype import int64_canonical
 import jax.scipy.special as jsp
 
 from ._helpers import as_tensor, axis_arg, binary, run_op, unary, unwrap
@@ -253,7 +255,7 @@ def _cum_extreme(x, axis, is_max, name):
         idx0 = jnp.broadcast_to(
             jnp.arange(n, dtype=jnp.int32).reshape(idx_shape), a.shape)
         vals, idx = lax.associative_scan(combine, (a, idx0), axis=ax0)
-        return vals, idx.astype(jnp.int64)
+        return vals, idx.astype(int64_canonical())
 
     out, idx = run_op(fn, [xx], name=name)
     return out, idx.detach()

@@ -9,7 +9,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core import random as _rng
-from ..core.dtype import to_jax_dtype
+from ..core.dtype import int64_canonical, to_jax_dtype
 from ..core.tensor import Tensor
 from ._helpers import as_tensor, shape_arg, unwrap
 
@@ -22,7 +22,12 @@ __all__ = [
 ]
 
 
-def _dt(dtype, default="float32"):
+def _dt(dtype, default="float32", index=False):
+    if index:
+        # index-typed param (randint/randperm/randint_like): narrow without
+        # consulting the strict flag — see core/dtype.py index_dtype
+        from ..core.dtype import index_dtype
+        return index_dtype(dtype if dtype is not None else default)
     return to_jax_dtype(dtype if dtype is not None else default)
 
 
@@ -44,21 +49,21 @@ def randint(low=0, high=None, shape=(1,), dtype=None, name=None):
         low, high = 0, low
     return Tensor(jax.random.randint(_rng.next_key(), shape_arg(shape),
                                      int(low), int(high),
-                                     dtype=_dt(dtype, "int64")))
+                                     dtype=_dt(dtype, "int64", index=True)))
 
 
 def randint_like(x, low=0, high=None, dtype=None, name=None):
     x = as_tensor(x)
     if high is None:
         low, high = 0, low
-    dt = _dt(dtype, None) or x._data.dtype
+    dt = _dt(dtype, None, index=True) or x._data.dtype
     return Tensor(jax.random.randint(_rng.next_key(), tuple(x.shape),
                                      int(low), int(high)).astype(dt))
 
 
 def randperm(n, dtype="int64", name=None):
     return Tensor(jax.random.permutation(_rng.next_key(), int(n))
-                  .astype(_dt(dtype, "int64")))
+                  .astype(_dt(dtype, "int64", index=True)))
 
 
 def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
@@ -105,7 +110,7 @@ def bernoulli(x, name=None):
 def binomial(count, prob, name=None):
     n = unwrap(as_tensor(count))
     p = unwrap(as_tensor(prob))
-    return Tensor(jax.random.binomial(_rng.next_key(), n, p).astype(jnp.int64))
+    return Tensor(jax.random.binomial(_rng.next_key(), n, p).astype(int64_canonical()))
 
 
 def multinomial(x, num_samples=1, replacement=False, name=None):
@@ -121,7 +126,7 @@ def multinomial(x, num_samples=1, replacement=False, name=None):
         g = jax.random.gumbel(key, logits.shape)
         out = jnp.argsort(-(logits + g), axis=-1)
         out = out[..., :num_samples]
-    return Tensor(out.astype(jnp.int64))
+    return Tensor(out.astype(int64_canonical()))
 
 
 def exponential_(x, lam=1.0, name=None):

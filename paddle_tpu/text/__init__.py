@@ -11,8 +11,10 @@ from typing import List, Optional
 
 import jax
 import jax.numpy as jnp
+
 import numpy as np
 
+from ..core.dtype import int64_canonical
 from ..core.tensor import Tensor
 from ..io import Dataset
 
@@ -80,7 +82,7 @@ def viterbi_decode(potentials, transition_params, lengths,
     _, rev_path = jax.lax.scan(back, best_last, (history[::-1], ts))
     paths = jnp.concatenate(
         [jnp.flip(rev_path, 0), best_last[None, :]], axis=0).T
-    return Tensor(scores), Tensor(paths.astype(jnp.int64))
+    return Tensor(scores), Tensor(paths.astype(int64_canonical()))
 
 
 class ViterbiDecoder:

@@ -3,9 +3,10 @@ loading, deprecated-decorator, install checks)."""
 from __future__ import annotations
 
 from . import cpp_extension  # noqa: F401
-from .custom_op import register_op  # noqa: F401
+from .custom_op import deregister_op, register_op, registered_ops  # noqa: F401
 
-__all__ = ["register_op", "cpp_extension", "run_check"]
+__all__ = ["register_op", "deregister_op", "registered_ops", "cpp_extension",
+           "run_check"]
 
 
 def run_check():

@@ -13,9 +13,47 @@ from __future__ import annotations
 
 from typing import Callable, Optional, Sequence
 
-__all__ = ["register_op"]
+__all__ = ["register_op", "deregister_op", "registered_ops"]
 
 _registry = {}
+_shadowed = {}  # name -> {"pt"/"ops"/"tensor": original attr} for restore
+
+
+def registered_ops():
+    """Names of currently registered custom ops (used by the op-coverage
+    gate to exclude runtime-registered ops from the static sweep)."""
+    return set(_registry)
+
+
+def deregister_op(name: str) -> None:
+    """Remove a custom op registered with :func:`register_op` — unmounts it
+    from ``paddle_tpu``, ``paddle_tpu.ops`` and ``Tensor``, restoring any
+    builtin the registration shadowed. Tests register throwaway ops and must
+    clean up so suite-wide sweeps stay deterministic."""
+    if name not in _registry:
+        raise KeyError(f"custom op '{name}' is not registered")
+    shadowed = _shadowed.pop(name, {})
+    del _registry[name]
+
+    from ..core.tensor import Tensor
+    import paddle_tpu as _pt
+    from .. import ops as _ops
+
+    for key, host in (("pt", _pt), ("ops", _ops)):
+        if key in shadowed:
+            setattr(host, name, shadowed[key])
+        else:
+            try:
+                delattr(host, name)
+            except AttributeError:
+                pass
+    if shadowed.get("appended_all") and name in _ops.__all__:
+        _ops.__all__.remove(name)
+    if shadowed.get("set_tensor_method"):
+        if "tensor" in shadowed:
+            setattr(Tensor, name, shadowed["tensor"])
+        elif name in getattr(Tensor, "__dict__", {}):
+            delattr(Tensor, name)
 
 
 def register_op(name: str, fn: Optional[Callable] = None, *,
@@ -87,18 +125,32 @@ def register_op(name: str, fn: Optional[Callable] = None, *,
 
     from .. import ops as _ops
 
-    setattr(_ops, name, op)
-    if name not in _ops.__all__:
-        _ops.__all__.append(name)
+    # remember exactly what we touch so deregister_op can undo it: any
+    # shadowed attrs, whether we appended to ops.__all__, and whether we
+    # mounted a Tensor method at all
+    shadowed = {"set_tensor_method": tensor_method,
+                "appended_all": name not in _ops.__all__}
     import paddle_tpu as _pt
+
+    if hasattr(_ops, name):
+        shadowed["ops"] = getattr(_ops, name)
+    if hasattr(_pt, name):
+        shadowed["pt"] = getattr(_pt, name)
+    setattr(_ops, name, op)
+    if shadowed["appended_all"]:
+        _ops.__all__.append(name)
 
     setattr(_pt, name, op)
     if tensor_method:
+        if name in Tensor.__dict__:
+            shadowed["tensor"] = Tensor.__dict__[name]
+
         def method(self, *a, **kw):
             return op(self, *a, **kw)
 
         method.__name__ = name
         setattr(Tensor, name, method)
+    _shadowed[name] = shadowed
     return op
 
 

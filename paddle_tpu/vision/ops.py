@@ -13,6 +13,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core.dtype import int64_canonical
 from ..core.tensor import Tensor
 from ..nn.layer.layers import Layer
 from ..ops._helpers import as_tensor, run_op, unwrap
@@ -69,7 +70,7 @@ def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
     kept = order[np.where(np.asarray(keep))[0]]
     if top_k is not None:
         kept = kept[:top_k]
-    return Tensor(jnp.asarray(kept, jnp.int64))
+    return Tensor(jnp.asarray(kept, int64_canonical()))
 
 
 def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,

@@ -160,3 +160,65 @@ class TestDoubleGrad:
         loss.backward()
         assert lin.weight.grad is not None
         assert np.isfinite(lin.weight.grad.numpy()).all()
+
+
+def test_reshape_inplace_keeps_tape():
+    """reshape_/flatten_ must rebind like the rest of the inplace family
+    (reference: python/paddle/tensor/manipulation.py reshape_), not sever
+    the tape."""
+    x = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]], stop_gradient=False)
+    y = x * 2
+    out = y.reshape_([4])
+    assert out is y and tuple(y.shape) == (4,)
+    (y * y).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [[8.0, 16.0], [24.0, 32.0]])
+
+
+def test_flatten_inplace_keeps_tape():
+    x = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]], stop_gradient=False)
+    y = x + 1
+    y.flatten_()
+    assert tuple(y.shape) == (4,)
+    (y * 3).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), np.full((2, 2), 3.0))
+
+
+def test_int64_narrowing_policy():
+    """Documented 64-bit narrowing (core/dtype.py): silent int64->int32 by
+    default, TypeError under FLAGS_strict_dtype64."""
+    import warnings
+
+    import paddle_tpu.framework as fw
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # the jax truncation spray must be gone
+        t = paddle.to_tensor([1, 2], dtype="int64")
+        assert t.dtype == paddle.int32 or str(t.dtype) == "int32"
+        idx = paddle.argsort(paddle.to_tensor([3.0, 1.0, 2.0]))
+        assert "int" in str(idx.dtype)
+
+    fw.set_flags({"FLAGS_strict_dtype64": True})
+    try:
+        import pytest
+        with pytest.raises(TypeError):
+            paddle.to_tensor([1], dtype="float64")
+    finally:
+        fw.set_flags({"FLAGS_strict_dtype64": False})
+
+
+def test_inplace_on_grad_leaf_raises():
+    """Reference eager inplace check: a grad-requiring leaf cannot use the
+    inplace strategy while grad is recorded; under no_grad it may, and its
+    trainability flag must survive."""
+    import pytest
+
+    w = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]], stop_gradient=False)
+    with pytest.raises(ValueError, match="inplace"):
+        w.reshape_([4])
+    with pytest.raises(ValueError, match="inplace"):
+        w.tanh_()
+    with paddle.no_grad():
+        w.reshape_([4])
+    assert tuple(w.shape) == (4,) and w.stop_gradient is False
+    (w * w).sum().backward()
+    np.testing.assert_allclose(w.grad.numpy(), [2.0, 4.0, 6.0, 8.0])

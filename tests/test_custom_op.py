@@ -4,10 +4,20 @@ import numpy as np
 import pytest
 
 import paddle_tpu as pt
-from paddle_tpu.utils import register_op
+from paddle_tpu.utils import deregister_op, register_op
 
 
-def test_register_op_default_grad():
+@pytest.fixture
+def clean_ops():
+    """Deregister any ops a test mounts so suite-wide sweeps
+    (test_op_coverage.py) stay order-independent."""
+    before = set(pt.utils.registered_ops())
+    yield
+    for name in set(pt.utils.registered_ops()) - before:
+        deregister_op(name)
+
+
+def test_register_op_default_grad(clean_ops):
     import jax.numpy as jnp
 
     @register_op("fancy_relu_t")
@@ -26,7 +36,7 @@ def test_register_op_default_grad():
     np.testing.assert_allclose(sf(x).numpy(), np.maximum(a * 2, 0) * 1.5)
 
 
-def test_register_op_custom_backward():
+def test_register_op_custom_backward(clean_ops):
     import jax.numpy as jnp
 
     def bwd(res, cot):
@@ -46,7 +56,7 @@ def test_register_op_custom_backward():
     np.testing.assert_allclose(x.grad.numpy(), [0.0, 7.0])
 
 
-def test_register_op_pallas_kernel():
+def test_register_op_pallas_kernel(clean_ops):
     """A hand-written Pallas kernel registers like any custom op (the
     custom-device-plugin analog: out-of-tree kernels via a stable API)."""
     import jax
@@ -68,9 +78,10 @@ def test_register_op_pallas_kernel():
     np.testing.assert_allclose(out.numpy(), a * 2 + 1)
 
 
-def test_register_op_duplicate_rejected():
+def test_register_op_duplicate_rejected(clean_ops):
+    register_op("dup_op_t", lambda x: x)
     with pytest.raises(ValueError, match="already registered"):
-        register_op("fancy_relu_t", lambda x: x)
+        register_op("dup_op_t", lambda x: x)
 
 
 def test_cpp_extension_guidance():
