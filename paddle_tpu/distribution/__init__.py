@@ -12,6 +12,12 @@ from .exponential import Exponential  # noqa: F401
 from .laplace import Laplace  # noqa: F401
 from .beta import Beta, Dirichlet, Gamma  # noqa: F401
 from .multinomial import Multinomial  # noqa: F401
+from .exponential_family import ExponentialFamily  # noqa: F401
+from .discrete import Binomial, Geometric, Poisson  # noqa: F401
+from .heavy_tail import Cauchy, Chi2, Gumbel, StudentT  # noqa: F401
+from .continuous_bernoulli import ContinuousBernoulli  # noqa: F401
+from .multivariate_normal import MultivariateNormal  # noqa: F401
+from .lkj_cholesky import LKJCholesky  # noqa: F401
 from .kl import kl_divergence, register_kl  # noqa: F401
 from .transform import (AbsTransform, AffineTransform,  # noqa: F401
                         ChainTransform, ExpTransform, SigmoidTransform,
@@ -21,7 +27,10 @@ from .transformed_distribution import (  # noqa: F401
 
 __all__ = ["Distribution", "Normal", "LogNormal", "Uniform", "Categorical",
            "Bernoulli", "Exponential", "Laplace", "Beta", "Dirichlet",
-           "Gamma", "Multinomial", "kl_divergence", "register_kl",
+           "Gamma", "Multinomial", "ExponentialFamily", "Poisson",
+           "Geometric", "Binomial", "Gumbel", "Cauchy", "StudentT", "Chi2",
+           "ContinuousBernoulli", "MultivariateNormal", "LKJCholesky",
+           "kl_divergence", "register_kl",
            "Transform", "AffineTransform", "ExpTransform",
            "SigmoidTransform", "AbsTransform", "ChainTransform",
            "TransformedDistribution", "Independent"]

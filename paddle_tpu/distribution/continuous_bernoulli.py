@@ -1,0 +1,118 @@
+"""ContinuousBernoulli (reference: python/paddle/distribution/
+continuous_bernoulli.py; Loaiza-Ganem & Cunningham 2019).
+
+Density on [0,1]: p(x|λ) = C(λ) λ^x (1-λ)^(1-x), with the normalizer
+C(λ) = 2 atanh(1-2λ)/(1-2λ) for λ≠1/2 and 2 at λ=1/2. Near λ=1/2 the
+closed form is numerically singular; like the reference we switch to a
+Taylor expansion inside ``lims``.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _as_t, _op
+
+__all__ = ["ContinuousBernoulli"]
+
+
+def _outside(p, lims):
+    return (p < lims[0]) | (p > lims[1])
+
+
+def _safe_p(p, lims):
+    """probs clamped away from 1/2 for the singular branch."""
+    return jnp.where(_outside(p, lims), p, lims[0])
+
+
+def _log_norm(p, lims):
+    """log C(λ), Taylor-expanded around 1/2 inside lims."""
+    ps = _safe_p(p, lims)
+    exact = jnp.log(2.0 * jnp.abs(jnp.arctanh(1.0 - 2.0 * ps))) \
+        - jnp.log(jnp.abs(1.0 - 2.0 * ps))
+    x = p - 0.5
+    taylor = jnp.log(2.0) + (4.0 / 3.0 + 104.0 / 45.0 * x ** 2) * x ** 2
+    return jnp.where(_outside(p, lims), exact, taylor)
+
+
+class ContinuousBernoulli(Distribution):
+    def __init__(self, probs, lims=(0.499, 0.501)):
+        self.probs = _as_t(probs)
+        self.lims = tuple(lims)
+        super().__init__(batch_shape=tuple(self.probs.shape))
+
+    @property
+    def mean(self):
+        lims = self.lims
+
+        def fn(p):
+            ps = _safe_p(p, lims)
+            exact = ps / (2.0 * ps - 1.0) \
+                + 1.0 / (2.0 * jnp.arctanh(1.0 - 2.0 * ps))
+            x = p - 0.5
+            taylor = 0.5 + (1.0 / 3.0 + 16.0 / 45.0 * x ** 2) * x
+            return jnp.where(_outside(p, lims), exact, taylor)
+
+        return _op(fn, [self.probs], "mean")
+
+    @property
+    def variance(self):
+        lims = self.lims
+
+        def fn(p):
+            ps = _safe_p(p, lims)
+            exact = ps * (ps - 1.0) / (1.0 - 2.0 * ps) ** 2 \
+                + 1.0 / (2.0 * jnp.arctanh(1.0 - 2.0 * ps)) ** 2
+            x = p - 0.5
+            taylor = 1.0 / 12.0 - (1.0 / 15.0 - 128.0 / 945.0 * x ** 2) \
+                * x ** 2
+            return jnp.where(_outside(p, lims), exact, taylor)
+
+        return _op(fn, [self.probs], "variance")
+
+    def sample(self, shape=()):
+        return self.rsample(shape).detach()
+
+    def rsample(self, shape=()):
+        out_shape = tuple(shape) + self.batch_shape
+        u = jax.random.uniform(self._key(), out_shape, minval=1e-6,
+                               maxval=1.0 - 1e-6)
+        lims = self.lims
+
+        def icdf(p):
+            # invert F(x) = (λ^x (1-λ)^(1-x) + λ - 1) / (2λ - 1):
+            # (λ/(1-λ))^x = (u(2λ-1)+1-λ)/(1-λ)  =>  x = log w / logit(λ)
+            ps = _safe_p(p, lims)
+            w = (u * (2.0 * ps - 1.0) + 1.0 - ps) / (1.0 - ps)
+            exact = jnp.log(w) / (jnp.log(ps) - jnp.log1p(-ps))
+            return jnp.where(_outside(p, lims), exact, u)
+
+        return _op(icdf, [self.probs], "cb_rsample")
+
+    def log_prob(self, value):
+        lims = self.lims
+        return _op(
+            lambda p, v: (_log_norm(p, lims)
+                          + v * jnp.log(p) + (1.0 - v) * jnp.log1p(-p)),
+            [self.probs, _as_t(value)], "cb_log_prob")
+
+    def cdf(self, value):
+        lims = self.lims
+        return _op(
+            lambda p, v: jnp.clip(jnp.where(
+                _outside(p, lims),
+                (jnp.power(_safe_p(p, lims), v)
+                 * jnp.power(1.0 - _safe_p(p, lims), 1.0 - v)
+                 + _safe_p(p, lims) - 1.0)
+                / (2.0 * _safe_p(p, lims) - 1.0),
+                v), 0.0, 1.0),
+            [self.probs, _as_t(value)], "cb_cdf")
+
+    def entropy(self):
+        lims = self.lims
+
+        def fn(p, m):
+            return -(_log_norm(p, lims) + m * jnp.log(p)
+                     + (1.0 - m) * jnp.log1p(-p))
+
+        return _op(fn, [self.probs, self.mean], "cb_entropy")

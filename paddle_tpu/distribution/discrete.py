@@ -1,0 +1,163 @@
+"""Discrete count distributions: Poisson, Geometric, Binomial
+(reference: python/paddle/distribution/{poisson,geometric,binomial}.py).
+
+Sampling uses jax.random's native samplers; entropies that the reference
+computes by summing over the support do the same here with a concrete
+(eager) support bound, which keeps shapes static per call.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.special import gammaln, xlog1py, xlogy
+
+from ..core.tensor import Tensor
+from .distribution import Distribution, _as_t, _op
+from .exponential_family import ExponentialFamily
+
+__all__ = ["Poisson", "Geometric", "Binomial"]
+
+
+class Poisson(ExponentialFamily):
+    """Poisson(rate): P(X=k) = e^-λ λ^k / k! (reference poisson.py:25)."""
+
+    def __init__(self, rate):
+        self.rate = _as_t(rate)
+        super().__init__(batch_shape=tuple(self.rate.shape))
+
+    @property
+    def mean(self):
+        return self.rate
+
+    @property
+    def variance(self):
+        return self.rate
+
+    @property
+    def _natural_parameters(self):
+        return (_op(jnp.log, [self.rate], "log"),)
+
+    def _log_normalizer(self, eta):
+        return jnp.exp(eta)
+
+    _mean_carrier_measure = None  # E[-log k!] has no closed form
+
+    def sample(self, shape=()):
+        out_shape = tuple(shape) + self.batch_shape
+        return Tensor(jax.random.poisson(
+            self._key(), self.rate._data, shape=out_shape).astype(
+                jnp.float32))
+
+    def log_prob(self, value):
+        return _op(
+            lambda r, v: xlogy(v, r) - r - gammaln(v + 1),
+            [self.rate, _as_t(value)], "poisson_log_prob")
+
+    def entropy(self):
+        # truncated-support sum like the reference (poisson.py entropy):
+        # bound is concrete in eager mode
+        r = self.rate._data
+        upper = int(jnp.max(r) + 10.0 * jnp.sqrt(jnp.max(r)) + 20.0)
+        ks = jnp.arange(upper, dtype=jnp.float32)
+
+        def fn(rate):
+            lp = (xlogy(ks, rate[..., None]) - rate[..., None]
+                  - gammaln(ks + 1))
+            return -jnp.sum(jnp.exp(lp) * lp, axis=-1)
+
+        return _op(fn, [self.rate], "poisson_entropy")
+
+
+class Geometric(Distribution):
+    """Geometric(probs) over k ∈ {0,1,2,…} failures before first success:
+    P(X=k) = (1-p)^k p (reference geometric.py:30, mean = 1/p − 1)."""
+
+    def __init__(self, probs):
+        self.probs = _as_t(probs)
+        super().__init__(batch_shape=tuple(self.probs.shape))
+
+    @property
+    def mean(self):
+        return _op(lambda p: 1.0 / p - 1.0, [self.probs], "mean")
+
+    @property
+    def variance(self):
+        return _op(lambda p: (1.0 - p) / p ** 2, [self.probs], "variance")
+
+    @property
+    def stddev(self):
+        return _op(lambda p: jnp.sqrt((1.0 - p) / p ** 2), [self.probs],
+                   "stddev")
+
+    def sample(self, shape=()):
+        out_shape = tuple(shape) + self.batch_shape
+        u = jax.random.uniform(self._key(), out_shape, minval=1e-7)
+        return Tensor(jnp.floor(
+            jnp.log(u) / jnp.log1p(-self.probs._data)))
+
+    def log_prob(self, value):
+        return _op(lambda p, v: xlog1py(v, -p) + jnp.log(p),
+                   [self.probs, _as_t(value)], "geometric_log_prob")
+
+    def pmf(self, value):
+        return _op(jnp.exp, [self.log_prob(value)], "exp")
+
+    def cdf(self, value):
+        return _op(lambda p, v: 1.0 - jnp.power(1.0 - p, v + 1.0),
+                   [self.probs, _as_t(value)], "geometric_cdf")
+
+    def entropy(self):
+        return _op(
+            lambda p: (-(1.0 - p) * jnp.log1p(-p) - p * jnp.log(p)) / p,
+            [self.probs], "geometric_entropy")
+
+
+class Binomial(Distribution):
+    """Binomial(total_count, probs) (reference binomial.py:26)."""
+
+    def __init__(self, total_count, probs):
+        self.total_count = _as_t(total_count)
+        self.probs = _as_t(probs)
+        shape = jnp.broadcast_shapes(tuple(self.total_count.shape),
+                                     tuple(self.probs.shape))
+        super().__init__(batch_shape=shape)
+
+    @property
+    def mean(self):
+        return _op(lambda n, p: n * p, [self.total_count, self.probs],
+                   "mean")
+
+    @property
+    def variance(self):
+        return _op(lambda n, p: n * p * (1 - p),
+                   [self.total_count, self.probs], "variance")
+
+    def sample(self, shape=()):
+        out_shape = tuple(shape) + self.batch_shape
+        return Tensor(jax.random.binomial(
+            self._key(), self.total_count._data, self.probs._data,
+            shape=out_shape).astype(jnp.float32))
+
+    def log_prob(self, value):
+        return _op(
+            lambda n, p, v: (gammaln(n + 1) - gammaln(v + 1)
+                             - gammaln(n - v + 1)
+                             + xlogy(v, p) + xlog1py(n - v, -p)),
+            [self.total_count, self.probs, _as_t(value)],
+            "binomial_log_prob")
+
+    def entropy(self):
+        # support sum with a concrete bound (reference binomial.py entropy)
+        n_max = int(jnp.max(self.total_count._data))
+        ks = jnp.arange(n_max + 1, dtype=jnp.float32)
+
+        def fn(n, p):
+            lp = (gammaln(n[..., None] + 1) - gammaln(ks + 1)
+                  - gammaln(n[..., None] - ks + 1)
+                  + xlogy(ks, p[..., None])
+                  + xlog1py(n[..., None] - ks, -p[..., None]))
+            lp = jnp.where(ks <= n[..., None], lp, -jnp.inf)
+            return -jnp.sum(jnp.where(jnp.isfinite(lp),
+                                      jnp.exp(lp) * lp, 0.0), axis=-1)
+
+        return _op(fn, [self.total_count, self.probs], "binomial_entropy")

@@ -1,0 +1,74 @@
+"""ExponentialFamily base (reference: python/paddle/distribution/
+exponential_family.py).
+
+TPU-native: the generic entropy/KL use the Bregman-divergence identity on
+the log-normalizer A(η) — its gradients come from ``jax.grad`` instead of
+the reference's double-backward graph, so any subclass only supplies its
+natural parameters and ``_log_normalizer``.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from .distribution import Distribution, _op
+
+
+class ExponentialFamily(Distribution):
+    """Base for p(x) = h(x) exp(η·T(x) − A(η)).
+
+    Subclasses define ``_natural_parameters`` (tuple of Tensors) and
+    ``_log_normalizer(*natural_params) -> array``; ``_mean_carrier_measure``
+    is E[log h(x)] (0 for most families of interest).
+    """
+
+    _mean_carrier_measure = 0.0
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    def entropy(self):
+        """H = A(η) − η·∇A(η) + E[T(x)]·… via the Bregman identity:
+        H(p) = A(η) − <η, ∇A(η)> − E[log h(x)]."""
+        nat = self._natural_parameters
+
+        def fn(*arrs):
+            a, grads = jax.value_and_grad(
+                lambda params: jnp.sum(self._log_normalizer(*params)),
+            )(arrs)
+            ent = self._log_normalizer(*arrs) - self._mean_carrier_measure
+            for eta, g in zip(arrs, grads):
+                ent = ent - eta * g
+            return ent
+
+        return _op(fn, list(nat), "expfamily_entropy")
+
+
+def bregman_kl(p: ExponentialFamily, q: ExponentialFamily) -> Tensor:
+    """Generic same-family KL via the Bregman divergence of A(η):
+    KL(p||q) = A(η_q) − A(η_p) − <η_q − η_p, ∇A(η_p)> (reference kl.py
+    _kl_expfamily_expfamily)."""
+    if type(p) is not type(q):
+        raise NotImplementedError(
+            "Bregman KL requires two distributions of the same "
+            f"exponential family, got {type(p).__name__} vs "
+            f"{type(q).__name__}")
+    p_nat = list(p._natural_parameters)
+    q_nat = list(q._natural_parameters)
+
+    def fn(*arrs):
+        k = len(arrs) // 2
+        pp, qq = arrs[:k], arrs[k:]
+        grads = jax.grad(
+            lambda params: jnp.sum(p._log_normalizer(*params)))(pp)
+        kl = q._log_normalizer(*qq) - p._log_normalizer(*pp)
+        for pe, qe, g in zip(pp, qq, grads):
+            kl = kl - (qe - pe) * g
+        return kl
+
+    return _op(fn, p_nat + q_nat, "kl_expfamily")

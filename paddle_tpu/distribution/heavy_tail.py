@@ -1,0 +1,182 @@
+"""Location-scale families with special tails: Gumbel, Cauchy, StudentT,
+Chi2 (reference: python/paddle/distribution/{gumbel,cauchy,student_t,
+chi2}.py).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.special import gammaln
+
+from ..core.tensor import Tensor
+from .beta import Gamma
+from .distribution import Distribution, _as_t, _op
+
+__all__ = ["Gumbel", "Cauchy", "StudentT", "Chi2"]
+
+_EULER = 0.57721566490153286060  # Euler–Mascheroni
+
+
+class Gumbel(Distribution):
+    """Gumbel(loc, scale) (reference gumbel.py:30; the reference builds it
+    as TransformedDistribution(Uniform) — here the closed forms are direct
+    and rsample reparameterizes through -log(-log U))."""
+
+    def __init__(self, loc, scale):
+        self.loc = _as_t(loc)
+        self.scale = _as_t(scale)
+        shape = jnp.broadcast_shapes(tuple(self.loc.shape),
+                                     tuple(self.scale.shape))
+        super().__init__(batch_shape=shape)
+
+    @property
+    def mean(self):
+        return _op(lambda l, s: l + _EULER * s, [self.loc, self.scale],
+                   "mean")
+
+    @property
+    def variance(self):
+        return _op(lambda s: (math.pi ** 2 / 6.0) * s ** 2, [self.scale],
+                   "variance")
+
+    @property
+    def stddev(self):
+        return _op(lambda s: (math.pi / math.sqrt(6.0)) * s, [self.scale],
+                   "stddev")
+
+    def sample(self, shape=()):
+        return self.rsample(shape).detach()
+
+    def rsample(self, shape=()):
+        out_shape = tuple(shape) + self.batch_shape
+        g = jax.random.gumbel(self._key(), out_shape)
+        return _op(lambda l, s: l + s * g, [self.loc, self.scale],
+                   "gumbel_rsample")
+
+    def log_prob(self, value):
+        return _op(
+            lambda l, s, v: -((v - l) / s) - jnp.exp(-(v - l) / s)
+            - jnp.log(s),
+            [self.loc, self.scale, _as_t(value)], "gumbel_log_prob")
+
+    def cdf(self, value):
+        return _op(lambda l, s, v: jnp.exp(-jnp.exp(-(v - l) / s)),
+                   [self.loc, self.scale, _as_t(value)], "gumbel_cdf")
+
+    def entropy(self):
+        bs = self.batch_shape
+        return _op(lambda s: jnp.broadcast_to(jnp.log(s) + 1.0 + _EULER,
+                                              bs),
+                   [self.scale], "gumbel_entropy")
+
+
+class Cauchy(Distribution):
+    """Cauchy(loc, scale) (reference cauchy.py:26). mean/variance are
+    undefined and raise, matching the reference."""
+
+    def __init__(self, loc, scale):
+        self.loc = _as_t(loc)
+        self.scale = _as_t(scale)
+        shape = jnp.broadcast_shapes(tuple(self.loc.shape),
+                                     tuple(self.scale.shape))
+        super().__init__(batch_shape=shape)
+
+    @property
+    def mean(self):
+        raise ValueError("Cauchy distribution has no mean.")
+
+    @property
+    def variance(self):
+        raise ValueError("Cauchy distribution has no variance.")
+
+    @property
+    def stddev(self):
+        raise ValueError("Cauchy distribution has no stddev.")
+
+    def sample(self, shape=()):
+        return self.rsample(shape).detach()
+
+    def rsample(self, shape=()):
+        out_shape = tuple(shape) + self.batch_shape
+        c = jax.random.cauchy(self._key(), out_shape)
+        return _op(lambda l, s: l + s * c, [self.loc, self.scale],
+                   "cauchy_rsample")
+
+    def log_prob(self, value):
+        return _op(
+            lambda l, s, v: -math.log(math.pi) - jnp.log(s)
+            - jnp.log1p(((v - l) / s) ** 2),
+            [self.loc, self.scale, _as_t(value)], "cauchy_log_prob")
+
+    def cdf(self, value):
+        return _op(
+            lambda l, s, v: jnp.arctan((v - l) / s) / math.pi + 0.5,
+            [self.loc, self.scale, _as_t(value)], "cauchy_cdf")
+
+    def entropy(self):
+        bs = self.batch_shape
+        return _op(lambda s: jnp.broadcast_to(
+            jnp.log(4 * math.pi * s), bs), [self.scale], "cauchy_entropy")
+
+
+class StudentT(Distribution):
+    """StudentT(df, loc, scale) (reference student_t.py:29)."""
+
+    def __init__(self, df, loc=0.0, scale=1.0, name=None):
+        self.df = _as_t(df)
+        self.loc = _as_t(loc)
+        self.scale = _as_t(scale)
+        shape = jnp.broadcast_shapes(tuple(self.df.shape),
+                                     tuple(self.loc.shape),
+                                     tuple(self.scale.shape))
+        super().__init__(batch_shape=shape)
+
+    @property
+    def mean(self):
+        return _op(lambda d, l: jnp.where(d > 1, l, jnp.nan),
+                   [self.df, self.loc], "mean")
+
+    @property
+    def variance(self):
+        return _op(
+            lambda d, s: jnp.where(
+                d > 2, s ** 2 * d / (d - 2),
+                jnp.where(d > 1, jnp.inf, jnp.nan)),
+            [self.df, self.scale], "variance")
+
+    def sample(self, shape=()):
+        out_shape = tuple(shape) + self.batch_shape
+        t = jax.random.t(self._key(), self.df._data, shape=out_shape)
+        return Tensor(self.loc._data + self.scale._data * t)
+
+    def log_prob(self, value):
+        return _op(
+            lambda d, l, s, v: (
+                gammaln((d + 1) / 2) - gammaln(d / 2)
+                - 0.5 * jnp.log(d * math.pi) - jnp.log(s)
+                - (d + 1) / 2 * jnp.log1p(((v - l) / s) ** 2 / d)),
+            [self.df, self.loc, self.scale, _as_t(value)],
+            "student_t_log_prob")
+
+    def entropy(self):
+        from jax.scipy.special import digamma
+
+        return _op(
+            lambda d, s: (
+                (d + 1) / 2 * (digamma((d + 1) / 2) - digamma(d / 2))
+                + 0.5 * jnp.log(d) + jnp.log(s)
+                + gammaln(d / 2) + 0.5 * math.log(math.pi)
+                - gammaln((d + 1) / 2)),
+            [self.df, self.scale], "student_t_entropy")
+
+
+class Chi2(Gamma):
+    """Chi2(df) = Gamma(df/2, rate=1/2) (reference chi2.py:22)."""
+
+    def __init__(self, df):
+        df_t = _as_t(df)
+        half = _op(lambda d: d / 2.0, [df_t], "div")
+        super().__init__(half, 0.5)
+        self.df = df_t

@@ -28,12 +28,20 @@ def register_kl(p_cls, q_cls):
 
 
 def kl_divergence(p: Distribution, q: Distribution) -> Tensor:
-    for (pc, qc), fn in _REGISTRY.items():
-        if isinstance(p, pc) and isinstance(q, qc):
-            return fn(p, q)
-    raise NotImplementedError(
-        f"kl_divergence not registered for ({type(p).__name__}, "
-        f"{type(q).__name__})")
+    # most-specific match wins (reference kl.py dispatch): the generic
+    # (ExponentialFamily, ExponentialFamily) fallback must not shadow a
+    # closed-form rule for a concrete pair
+    matches = [(pc, qc, fn) for (pc, qc), fn in _REGISTRY.items()
+               if isinstance(p, pc) and isinstance(q, qc)]
+    if not matches:
+        raise NotImplementedError(
+            f"kl_divergence not registered for ({type(p).__name__}, "
+            f"{type(q).__name__})")
+    best = matches[0]
+    for m in matches[1:]:
+        if issubclass(m[0], best[0]) and issubclass(m[1], best[1]):
+            best = m
+    return best[2](p, q)
 
 
 @register_kl(Normal, Normal)
@@ -120,3 +128,100 @@ def _kl_gamma(p, q):
         - gammaln(pc) + gammaln(qc)
         + qc * (jnp.log(pr) - jnp.log(qr)) + pc * (qr / pr - 1.0),
         [p.concentration, p.rate, q.concentration, q.rate], "kl_gamma")
+
+
+# --- round-4 families (reference kl.py: binomial/cauchy/cb/mvn/geometric/
+# lognormal/poisson pairs + the ExponentialFamily Bregman fallback) -------
+
+from .continuous_bernoulli import ContinuousBernoulli  # noqa: E402
+from .discrete import Binomial, Geometric, Poisson  # noqa: E402
+from .exponential_family import ExponentialFamily, bregman_kl  # noqa: E402
+from .heavy_tail import Cauchy  # noqa: E402
+from .multivariate_normal import MultivariateNormal  # noqa: E402
+from .normal import LogNormal  # noqa: E402
+
+
+@register_kl(Poisson, Poisson)
+def _kl_poisson(p, q):
+    return _op(
+        lambda pr, qr: pr * (jnp.log(pr) - jnp.log(qr)) - pr + qr,
+        [p.rate, q.rate], "kl_poisson")
+
+
+@register_kl(Geometric, Geometric)
+def _kl_geometric(p, q):
+    # KL = log(p_p/p_q) + E[k]·log((1-p_p)/(1-p_q)), E[k] = (1-p_p)/p_p
+    return _op(
+        lambda pp, qp: (jnp.log(pp) - jnp.log(qp))
+        + (1.0 - pp) / pp * (jnp.log1p(-pp) - jnp.log1p(-qp)),
+        [p.probs, q.probs], "kl_geometric")
+
+
+@register_kl(Cauchy, Cauchy)
+def _kl_cauchy(p, q):
+    # closed form (Chyzak & Nielsen 2019)
+    return _op(
+        lambda pl, ps, ql, qs: jnp.log(
+            ((ps + qs) ** 2 + (pl - ql) ** 2) / (4.0 * ps * qs)),
+        [p.loc, p.scale, q.loc, q.scale], "kl_cauchy")
+
+
+@register_kl(Binomial, Binomial)
+def _kl_binomial(p, q):
+    return _op(
+        lambda n, pp, qn, qp: jnp.where(
+            n == qn,
+            n * (pp * (jnp.log(pp) - jnp.log(qp))
+                 + (1.0 - pp) * (jnp.log1p(-pp) - jnp.log1p(-qp))),
+            jnp.inf),
+        [p.total_count, p.probs, q.total_count, q.probs], "kl_binomial")
+
+
+@register_kl(LogNormal, LogNormal)
+def _kl_lognormal(p, q):
+    # KL between the underlying normals (the exp transform cancels)
+    return _kl_normal(p, q)
+
+
+@register_kl(ContinuousBernoulli, ContinuousBernoulli)
+def _kl_cb(p, q):
+    from .continuous_bernoulli import _log_norm
+
+    def fn(pp, qp, pm):
+        return (pm * (jnp.log(pp) - jnp.log(qp))
+                + (1.0 - pm) * (jnp.log1p(-pp) - jnp.log1p(-qp))
+                + _log_norm(pp, p.lims) - _log_norm(qp, q.lims))
+
+    return _op(fn, [p.probs, q.probs, p.mean], "kl_cb")
+
+
+@register_kl(MultivariateNormal, MultivariateNormal)
+def _kl_mvn(p, q):
+    def fn(pl, pL, ql, qL):
+        import jax
+
+        d = pl.shape[-1]
+        diff = ql - pl
+        batch = jnp.broadcast_shapes(diff.shape[:-1], pL.shape[:-2],
+                                     qL.shape[:-2])
+        solve = lambda L, y: jax.scipy.linalg.solve_triangular(
+            L, y, lower=True)
+        qLb = jnp.broadcast_to(qL, batch + qL.shape[-2:])
+        pLb = jnp.broadcast_to(pL, batch + pL.shape[-2:])
+        diff = jnp.broadcast_to(diff, batch + diff.shape[-1:])
+        m = solve(qLb, diff[..., None])[..., 0]
+        a = solve(qLb, pLb)
+        half_logdet_p = jnp.sum(
+            jnp.log(jnp.diagonal(pLb, axis1=-2, axis2=-1)), -1)
+        half_logdet_q = jnp.sum(
+            jnp.log(jnp.diagonal(qLb, axis1=-2, axis2=-1)), -1)
+        tr = jnp.sum(a ** 2, axis=(-2, -1))
+        return (half_logdet_q - half_logdet_p
+                + 0.5 * (tr + jnp.sum(m ** 2, -1) - d))
+
+    return _op(fn, [p.loc, p.scale_tril, q.loc, q.scale_tril], "kl_mvn")
+
+
+@register_kl(ExponentialFamily, ExponentialFamily)
+def _kl_expfamily(p, q):
+    return bregman_kl(p, q)

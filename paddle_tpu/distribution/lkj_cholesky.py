@@ -1,0 +1,108 @@
+"""LKJCholesky (reference: python/paddle/distribution/lkj_cholesky.py;
+Lewandowski, Kurowicka & Joe 2009).
+
+Distribution over Cholesky factors L of correlation matrices with density
+p(L|η) ∝ Π_i L_ii^{D - i - 1 + 2(η-1)} (row index i from 2..D). Both the
+reference's sampling methods are provided: "onion" (default) and "cvine".
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.special import gammaln
+
+from ..core.tensor import Tensor
+from .distribution import Distribution, _as_t, _op
+
+__all__ = ["LKJCholesky"]
+
+
+def _mvlgamma(a, p):
+    """Multivariate log-gamma log Γ_p(a)."""
+    i = jnp.arange(p, dtype=jnp.float32)
+    return (p * (p - 1) / 4.0 * math.log(math.pi)
+            + jnp.sum(gammaln(a[..., None] - i / 2.0), axis=-1))
+
+
+class LKJCholesky(Distribution):
+    def __init__(self, dim=2, concentration=1.0, sample_method="onion"):
+        if dim < 2:
+            raise ValueError("dim must be >= 2")
+        if sample_method not in ("onion", "cvine"):
+            raise ValueError("sample_method must be 'onion' or 'cvine'")
+        self.dim = int(dim)
+        self.concentration = _as_t(concentration)
+        self.sample_method = sample_method
+        super().__init__(batch_shape=tuple(self.concentration.shape),
+                         event_shape=(dim, dim))
+
+    # ------------------------------------------------------------- sampling
+    def _beta_sample(self, a, b, shape):
+        ga = jax.random.gamma(self._key(), jnp.broadcast_to(a, shape))
+        gb = jax.random.gamma(self._key(), jnp.broadcast_to(b, shape))
+        return ga / (ga + gb)
+
+    def _sample_onion(self, sample_shape):
+        d = self.dim
+        eta = self.concentration._data
+        bs = tuple(sample_shape) + tuple(self.batch_shape)
+        L = jnp.zeros(bs + (d, d), dtype=jnp.float32)
+        L = L.at[..., 0, 0].set(1.0)
+        beta = eta + (d - 2.0) / 2.0
+        for i in range(1, d):
+            # norm^2 of row i ~ Beta(i/2, beta), direction uniform on sphere
+            y = self._beta_sample(i / 2.0, beta, bs)
+            u = jax.random.normal(self._key(), bs + (i,))
+            u = u / jnp.linalg.norm(u, axis=-1, keepdims=True)
+            w = jnp.sqrt(y)[..., None] * u
+            L = L.at[..., i, :i].set(w)
+            L = L.at[..., i, i].set(jnp.sqrt(jnp.clip(1.0 - y, 1e-12)))
+            beta = beta - 0.5
+        return L
+
+    def _sample_cvine(self, sample_shape):
+        d = self.dim
+        eta = self.concentration._data
+        bs = tuple(sample_shape) + tuple(self.batch_shape)
+        # partial correlations: p_ij ~ 2*Beta(a_i, a_i)-1 per row
+        P = jnp.zeros(bs + (d, d), dtype=jnp.float32)
+        for i in range(1, d):
+            a = eta + (d - 1.0 - i) / 2.0
+            p_row = 2.0 * self._beta_sample(a, a, bs + (i,)) - 1.0
+            P = P.at[..., i, :i].set(p_row)
+        # convert partial correlations to cholesky rows
+        L = jnp.zeros_like(P)
+        L = L.at[..., 0, 0].set(1.0)
+        for i in range(1, d):
+            rem = jnp.ones(bs, dtype=jnp.float32)
+            for j in range(i):
+                L = L.at[..., i, j].set(P[..., i, j] * jnp.sqrt(rem))
+                rem = rem * (1.0 - P[..., i, j] ** 2)
+            L = L.at[..., i, i].set(jnp.sqrt(jnp.clip(rem, 1e-12)))
+        return L
+
+    def sample(self, sample_shape=()):
+        if self.sample_method == "onion":
+            return Tensor(self._sample_onion(sample_shape))
+        return Tensor(self._sample_cvine(sample_shape))
+
+    # ------------------------------------------------------------- density
+    def log_prob(self, value):
+        d = self.dim
+
+        def fn(eta, L):
+            diag = jnp.diagonal(L, axis1=-2, axis2=-1)[..., 1:]
+            order = jnp.arange(2, d + 1, dtype=jnp.float32)
+            order = 2.0 * (eta[..., None] - 1.0) + d - order
+            unnorm = jnp.sum(order * jnp.log(diag), axis=-1)
+            # normalizer (LKJ 2009, p.1999), as in the reference
+            dm1 = d - 1
+            alpha = eta + 0.5 * dm1
+            logz = (0.5 * dm1 * math.log(math.pi)
+                    + _mvlgamma(alpha - 0.5, dm1) - dm1 * gammaln(alpha))
+            return unnorm - logz
+
+        return _op(fn, [self.concentration, _as_t(value)],
+                   "lkj_log_prob")

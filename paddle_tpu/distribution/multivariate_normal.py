@@ -1,0 +1,103 @@
+"""MultivariateNormal (reference: python/paddle/distribution/
+multivariate_normal.py).
+
+TPU-native: everything is expressed through the Cholesky factor L of the
+covariance (one `cholesky` at construction, then triangular solves) so
+log_prob / rsample / entropy / KL are all batched matmul-shaped work that
+XLA maps onto the MXU.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _as_t, _op
+
+__all__ = ["MultivariateNormal"]
+
+
+def _tril_solve(L, y):
+    return jax.scipy.linalg.solve_triangular(L, y, lower=True)
+
+
+class MultivariateNormal(Distribution):
+    def __init__(self, loc, covariance_matrix=None, precision_matrix=None,
+                 scale_tril=None):
+        self.loc = _as_t(loc)
+        given = sum(x is not None for x in
+                    (covariance_matrix, precision_matrix, scale_tril))
+        if given != 1:
+            raise ValueError(
+                "Exactly one of covariance_matrix, precision_matrix, "
+                "scale_tril must be specified.")
+        if scale_tril is not None:
+            self.scale_tril = _as_t(scale_tril)
+        elif covariance_matrix is not None:
+            self.covariance_matrix = _as_t(covariance_matrix)
+            self.scale_tril = _op(jnp.linalg.cholesky,
+                                  [self.covariance_matrix], "cholesky")
+        else:
+            self.precision_matrix = _as_t(precision_matrix)
+            # cov = P^-1; chol(P^-1) via inverse of chol(P) transpose-flip
+            self.scale_tril = _op(
+                lambda p: jnp.linalg.cholesky(jnp.linalg.inv(p)),
+                [self.precision_matrix], "cholesky_inv")
+        d = self.loc.shape[-1]
+        batch = jnp.broadcast_shapes(tuple(self.loc.shape[:-1]),
+                                     tuple(self.scale_tril.shape[:-2]))
+        super().__init__(batch_shape=batch, event_shape=(d,))
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        bs = self.batch_shape + self.event_shape
+        return _op(lambda L: jnp.broadcast_to(
+            jnp.sum(L ** 2, axis=-1), bs), [self.scale_tril], "variance")
+
+    @property
+    def stddev(self):
+        return _op(lambda v: jnp.sqrt(v), [self.variance], "sqrt")
+
+    def sample(self, shape=()):
+        return self.rsample(shape).detach()
+
+    def rsample(self, shape=()):
+        out_shape = tuple(shape) + self.batch_shape + self.event_shape
+        eps = jax.random.normal(self._key(), out_shape)
+        return _op(
+            lambda l, L: l + jnp.einsum("...ij,...j->...i", L, eps),
+            [self.loc, self.scale_tril], "mvn_rsample")
+
+    def log_prob(self, value):
+        d = self.event_shape[0]
+
+        def fn(l, L, v):
+            diff = v - l
+            batch = jnp.broadcast_shapes(diff.shape[:-1], L.shape[:-2])
+            Lb = jnp.broadcast_to(L, batch + L.shape[-2:])
+            diff = jnp.broadcast_to(diff, batch + diff.shape[-1:])
+            z = _tril_solve(Lb, diff[..., None])[..., 0]
+            half_logdet = jnp.sum(
+                jnp.log(jnp.diagonal(L, axis1=-2, axis2=-1)), axis=-1)
+            return (-0.5 * jnp.sum(z ** 2, axis=-1) - half_logdet
+                    - 0.5 * d * math.log(2 * math.pi))
+
+        return _op(fn, [self.loc, self.scale_tril, _as_t(value)],
+                   "mvn_log_prob")
+
+    def entropy(self):
+        d = self.event_shape[0]
+        bs = self.batch_shape
+
+        def fn(L):
+            half_logdet = jnp.sum(
+                jnp.log(jnp.diagonal(L, axis1=-2, axis2=-1)), axis=-1)
+            return jnp.broadcast_to(
+                0.5 * d * (1 + math.log(2 * math.pi)) + half_logdet, bs)
+
+        return _op(fn, [self.scale_tril], "mvn_entropy")
