@@ -136,3 +136,20 @@ def decorate(models, optimizers=None, level="O1", dtype="bfloat16",
 
 
 from . import debugging  # noqa: F401,E402
+
+
+def is_bfloat16_supported(device=None):
+    """reference: python/paddle/amp/__init__.py is_bfloat16_supported.
+    bf16 is the MXU-native matmul dtype — always true on TPU (and jax's
+    CPU backend emulates it for tests)."""
+    return True
+
+
+def is_float16_supported(device=None):
+    """reference: python/paddle/amp/__init__.py is_float16_supported."""
+    import jax
+
+    return jax.default_backend() in ("tpu", "gpu", "cpu")
+
+
+__all__ += ["is_bfloat16_supported", "is_float16_supported"]

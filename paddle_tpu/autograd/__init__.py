@@ -7,4 +7,37 @@ from .functional import hessian, jacobian, jvp, vjp  # noqa: F401
 
 __all__ = ["backward", "grad", "no_grad", "is_grad_enabled",
            "set_grad_enabled", "PyLayer", "PyLayerContext", "jacobian",
-           "hessian", "jvp", "vjp"]
+           "hessian", "jvp", "vjp", "saved_tensors_hooks"]
+
+_hooks_stack = []
+
+
+def _current_saved_tensors_hooks():
+    if _hooks_stack:
+        return _hooks_stack[-1]
+    ident = lambda t: t
+    return ident, ident
+
+
+class saved_tensors_hooks:
+    """Context manager installing pack/unpack hooks on tensors saved for
+    backward (reference: python/paddle/autograd/saved_tensors_hooks.py).
+
+    On this tape the hook applies at the PyLayer ``save_for_backward`` /
+    ``saved_tensor`` boundary — the jnp-op residuals live inside jax.vjp
+    closures, which XLA already rematerializes/spills optimally, so the
+    reference's main use case (offloading custom-op activations) maps to
+    exactly this surface.
+    """
+
+    def __init__(self, pack_hook, unpack_hook):
+        self.pack_hook = pack_hook
+        self.unpack_hook = unpack_hook
+
+    def __enter__(self):
+        _hooks_stack.append((self.pack_hook, self.unpack_hook))
+        return self
+
+    def __exit__(self, *exc):
+        _hooks_stack.pop()
+        return False

@@ -18,11 +18,17 @@ class PyLayerContext:
         self.container = None
 
     def save_for_backward(self, *tensors):
-        self._saved = [t.detach() if isinstance(t, Tensor) else t
+        from . import _current_saved_tensors_hooks
+
+        # the unpack hook is captured at PACK time (reference semantics:
+        # saved_tensors_hooks.py — backward may run after the context exits)
+        pack, self._unpack = _current_saved_tensors_hooks()
+        self._saved = [pack(t.detach()) if isinstance(t, Tensor) else t
                        for t in tensors]
 
     def saved_tensor(self):
-        return list(self._saved)
+        unpack = getattr(self, "_unpack", lambda t: t)
+        return [unpack(t) for t in self._saved]
 
     # paddle also exposes mark_not_inplace etc.; no-ops here
     def mark_not_inplace(self, *args):

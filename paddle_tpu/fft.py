@@ -9,7 +9,8 @@ from .core.tensor import Tensor
 from .ops._helpers import as_tensor, run_op
 
 __all__ = ["fft", "ifft", "rfft", "irfft", "hfft", "ihfft", "fft2", "ifft2",
-           "rfft2", "irfft2", "fftn", "ifftn", "rfftn", "irfftn",
+           "rfft2", "irfft2", "hfft2", "ihfft2", "fftn", "ifftn", "rfftn",
+           "irfftn", "hfftn", "ihfftn",
            "fftshift", "ifftshift", "fftfreq", "rfftfreq"]
 
 
@@ -54,6 +55,56 @@ fftn = _wrapn(jnp.fft.fftn, "fftn")
 ifftn = _wrapn(jnp.fft.ifftn, "ifftn")
 rfftn = _wrapn(jnp.fft.rfftn, "rfftn")
 irfftn = _wrapn(jnp.fft.irfftn, "irfftn")
+
+
+def _hfftn_arr(a, s=None, axes=None, norm="backward"):
+    """n-dim FFT of a signal Hermitian-symmetric over the LAST axis:
+    complex fftn over the leading axes, then hfft on the last (reference:
+    python/paddle/fft.py hfftn). Output is real."""
+    if axes is None:
+        axes = tuple(range(a.ndim)) if s is None else tuple(
+            range(a.ndim - len(s), a.ndim))
+    axes = tuple(axes)
+    lead, last = axes[:-1], axes[-1]
+    n_last = None if s is None else s[-1]
+    if lead:
+        a = jnp.fft.fftn(a, s=None if s is None else s[:-1], axes=lead,
+                         norm=norm)
+    return jnp.fft.hfft(a, n=n_last, axis=last, norm=norm)
+
+
+def _ihfftn_arr(a, s=None, axes=None, norm="backward"):
+    if axes is None:
+        axes = tuple(range(a.ndim)) if s is None else tuple(
+            range(a.ndim - len(s), a.ndim))
+    axes = tuple(axes)
+    lead, last = axes[:-1], axes[-1]
+    n_last = None if s is None else s[-1]
+    a = jnp.fft.ihfft(a, n=n_last, axis=last, norm=norm)
+    if lead:
+        a = jnp.fft.ifftn(a, s=None if s is None else s[:-1], axes=lead,
+                          norm=norm)
+    return a
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return run_op(lambda a: _hfftn_arr(a, s, tuple(axes), norm),
+                  [as_tensor(x)], name="hfft2")
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return run_op(lambda a: _ihfftn_arr(a, s, tuple(axes), norm),
+                  [as_tensor(x)], name="ihfft2")
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    return run_op(lambda a: _hfftn_arr(a, s, axes, norm),
+                  [as_tensor(x)], name="hfftn")
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    return run_op(lambda a: _ihfftn_arr(a, s, axes, norm),
+                  [as_tensor(x)], name="ihfftn")
 
 
 def fftshift(x, axes=None, name=None):

@@ -1,5 +1,17 @@
 """paddle_tpu.incubate (reference: python/paddle/incubate/)."""
 from . import nn  # noqa: F401
+from .ops import (graph_khop_sampler, graph_reindex,  # noqa: F401
+                  graph_sample_neighbors, graph_send_recv, identity_loss,
+                  segment_max, segment_mean, segment_min, segment_sum,
+                  softmax_mask_fuse, softmax_mask_fuse_upper_triangle)
+from .optimizer import LookAhead, ModelAverage  # noqa: F401
+from .inference import inference  # noqa: F401
+
+__all__ = ["LookAhead", "ModelAverage", "graph_khop_sampler",
+           "graph_reindex", "graph_sample_neighbors", "graph_send_recv",
+           "identity_loss", "segment_max", "segment_mean", "segment_min",
+           "segment_sum", "softmax_mask_fuse",
+           "softmax_mask_fuse_upper_triangle", "inference"]
 
 _LAZY = ("distributed", "asp")
 

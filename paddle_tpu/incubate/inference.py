@@ -1,0 +1,51 @@
+"""paddle.incubate.jit.inference decorator (reference:
+python/paddle/incubate/jit/inference_decorator.py — converts a Layer /
+function into a cached optimized predictor).
+
+TPU-native: the "predictor" is a jit-compiled, no-grad forward with a
+shape/dtype-keyed compile cache — XLA plays the role of the Paddle
+Inference pass pipeline."""
+from __future__ import annotations
+
+from typing import Callable
+
+__all__ = ["inference"]
+
+
+def inference(function=None, cache_static_model=False, save_model_dir=None,
+              memory_pool_init_size_mb=1000, precision_mode="float32",
+              switch_ir_optim=True, switch_ir_debug=False,
+              enable_cinn=False, with_trt=False, trt_precision_mode=None,
+              trt_use_static=False, collect_shape=False,
+              skip_prune_program=False):
+    """Decorator: compile ``function`` (or a Layer's forward) for
+    inference. Extra knobs are accepted for reference-script compatibility;
+    on TPU they map to the single XLA pipeline."""
+    from ..jit import to_static
+    from ..core.autograd import no_grad
+    from ..nn.layer.layers import Layer
+
+    def wrap(fn):
+        if isinstance(fn, Layer):
+            layer = fn
+            compiled = to_static(layer.forward)
+
+            def innermost_decorator(*args, **kwargs):
+                with no_grad():
+                    return compiled(*args, **kwargs)
+
+            layer.forward = innermost_decorator
+            return layer
+        compiled = to_static(fn)
+
+        def innermost_decorator(*args, **kwargs):
+            with no_grad():
+                return compiled(*args, **kwargs)
+
+        innermost_decorator.__name__ = getattr(fn, "__name__",
+                                               "inference_fn")
+        return innermost_decorator
+
+    if function is not None:
+        return wrap(function)
+    return wrap

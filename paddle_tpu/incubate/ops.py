@@ -1,0 +1,223 @@
+"""paddle.incubate op surface (reference: python/paddle/incubate/
+__init__.py — segment ops tensor/math.py:segment_*, graph ops
+operators/graph_*.py, identity_loss, softmax_mask_fuse*).
+
+TPU-native: segment reductions are jax.ops.segment_* (one XLA scatter),
+graph sampling runs on host (dynamic shapes are eager-only, like the
+reference's CPU fallback path), and the mask-fuse ops are plain fused
+elementwise+softmax XLA programs.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dtype import int64_canonical
+from ..core.tensor import Tensor
+from ..ops._helpers import as_tensor, run_op, unwrap
+
+__all__ = [
+    "segment_sum", "segment_mean", "segment_max", "segment_min",
+    "graph_send_recv", "graph_khop_sampler", "graph_reindex",
+    "graph_sample_neighbors", "identity_loss", "softmax_mask_fuse",
+    "softmax_mask_fuse_upper_triangle",
+]
+
+
+def _segment(op_name, jfn, data, segment_ids, fill=0.0):
+    ids = unwrap(as_tensor(segment_ids)).astype(jnp.int32)
+    n = int(jnp.max(ids)) + 1 if ids.size else 0
+
+    def fn(a):
+        out = jfn(a, ids, num_segments=n)
+        return out
+
+    return run_op(fn, [as_tensor(data)], name=op_name)
+
+
+def segment_sum(data, segment_ids, name=None):
+    return _segment("segment_sum", jax.ops.segment_sum, data, segment_ids)
+
+
+def segment_mean(data, segment_ids, name=None):
+    ids = unwrap(as_tensor(segment_ids)).astype(jnp.int32)
+    n = int(jnp.max(ids)) + 1 if ids.size else 0
+
+    def fn(a):
+        s = jax.ops.segment_sum(a, ids, num_segments=n)
+        cnt = jax.ops.segment_sum(jnp.ones((a.shape[0],), a.dtype), ids,
+                                  num_segments=n)
+        cnt = jnp.maximum(cnt, 1.0)
+        return s / cnt.reshape((n,) + (1,) * (a.ndim - 1))
+
+    return run_op(fn, [as_tensor(data)], name="segment_mean")
+
+
+def segment_max(data, segment_ids, name=None):
+    return _segment("segment_max", jax.ops.segment_max, data, segment_ids)
+
+
+def segment_min(data, segment_ids, name=None):
+    return _segment("segment_min", jax.ops.segment_min, data, segment_ids)
+
+
+def graph_send_recv(x, src_index, dst_index, pool_type="sum",
+                    out_size=None, name=None):
+    """Gather x rows at src_index, scatter-reduce onto dst_index
+    (reference: incubate/operators/graph_send_recv.py)."""
+    src = unwrap(as_tensor(src_index)).astype(jnp.int32)
+    dst = unwrap(as_tensor(dst_index)).astype(jnp.int32)
+    x_t = as_tensor(x)
+    n = int(out_size) if out_size is not None else x_t.shape[0]
+    red = {"sum": jax.ops.segment_sum, "mean": None,
+           "max": jax.ops.segment_max, "min": jax.ops.segment_min}
+    if pool_type not in red:
+        raise ValueError(f"pool_type must be one of {list(red)}")
+
+    def fn(a):
+        msgs = a[src]
+        if pool_type == "mean":
+            s = jax.ops.segment_sum(msgs, dst, num_segments=n)
+            cnt = jax.ops.segment_sum(
+                jnp.ones((msgs.shape[0],), a.dtype), dst, num_segments=n)
+            return s / jnp.maximum(cnt, 1.0).reshape(
+                (n,) + (1,) * (a.ndim - 1))
+        out = red[pool_type](msgs, dst, num_segments=n)
+        if pool_type in ("max", "min"):
+            # empty segments come back ±inf; reference fills 0
+            out = jnp.where(jnp.isfinite(out), out, 0.0)
+        return out
+
+    return run_op(fn, [x_t], name="graph_send_recv")
+
+
+def graph_sample_neighbors(row, colptr, input_nodes, sample_size=-1,
+                           eids=None, return_eids=False, perm_buffer=None,
+                           flag_perm_buffer=False, name=None):
+    """Uniform neighbor sampling on a CSC graph (reference:
+    incubate/operators/graph_sample_neighbors.py). Host-side: output is
+    data-dependent-shaped, an eager-only op by design."""
+    rowv = np.asarray(unwrap(as_tensor(row)))
+    colptrv = np.asarray(unwrap(as_tensor(colptr)))
+    nodes = np.asarray(unwrap(as_tensor(input_nodes))).reshape(-1)
+    eidv = np.asarray(unwrap(as_tensor(eids))) if eids is not None else None
+    rng = np.random.default_rng()
+    out_neighbors, out_count, out_eids = [], [], []
+    for nd in nodes:
+        lo, hi = int(colptrv[nd]), int(colptrv[nd + 1])
+        deg = hi - lo
+        if sample_size < 0 or deg <= sample_size:
+            sel = np.arange(lo, hi)
+        else:
+            sel = lo + rng.choice(deg, size=sample_size, replace=False)
+        out_neighbors.append(rowv[sel])
+        out_count.append(len(sel))
+        if return_eids and eidv is not None:
+            out_eids.append(eidv[sel])
+    neigh = (np.concatenate(out_neighbors) if out_neighbors
+             else np.zeros((0,), rowv.dtype))
+    cnt = np.asarray(out_count, np.int32)
+    res = (Tensor(jnp.asarray(neigh.astype(np.int32))),
+           Tensor(jnp.asarray(cnt)))
+    if return_eids:
+        e = (np.concatenate(out_eids) if out_eids
+             else np.zeros((0,), np.int32))
+        return res + (Tensor(jnp.asarray(e.astype(np.int32))),)
+    return res
+
+
+def graph_reindex(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  flag_buffer_hashtable=False, name=None):
+    """Compact global node ids to local ids (reference:
+    incubate/operators/graph_reindex.py). Host-side, eager-only."""
+    xs = np.asarray(unwrap(as_tensor(x))).reshape(-1)
+    nb = np.asarray(unwrap(as_tensor(neighbors))).reshape(-1)
+    cnt = np.asarray(unwrap(as_tensor(count))).reshape(-1)
+    mapping = {}
+    for nd in xs.tolist():
+        if nd not in mapping:
+            mapping[nd] = len(mapping)
+    for nd in nb.tolist():
+        if nd not in mapping:
+            mapping[nd] = len(mapping)
+    reindex_src = np.asarray([mapping[v] for v in nb.tolist()], np.int32)
+    # dst of edge j is the input node owning that neighbor block
+    dst = np.repeat(np.arange(len(xs), dtype=np.int32), cnt)
+    nodes = np.asarray(list(mapping.keys()),
+                       dtype=np.asarray(xs).dtype)
+    return (Tensor(jnp.asarray(reindex_src)),
+            Tensor(jnp.asarray(dst)),
+            Tensor(jnp.asarray(nodes.astype(np.int32))))
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    """Multi-hop sampling + reindex (reference:
+    incubate/operators/graph_khop_sampler.py)."""
+    cur = as_tensor(input_nodes)
+    all_src, all_cnt = [], []
+    frontier = cur
+    for size in sample_sizes:
+        neigh, cnt = graph_sample_neighbors(row, colptr, frontier,
+                                            sample_size=size)
+        all_src.append(np.asarray(unwrap(neigh)))
+        all_cnt.append(np.asarray(unwrap(cnt)))
+        frontier = neigh
+    neighbors = np.concatenate(all_src) if all_src else np.zeros(0, np.int32)
+    counts = np.concatenate(all_cnt) if all_cnt else np.zeros(0, np.int32)
+    # counts from hops beyond the first attach to sampled frontier nodes;
+    # reindex against the original seeds + every frontier
+    seeds = np.asarray(unwrap(cur)).reshape(-1)
+    seed_list = seeds
+    for hop_src, _ in zip(all_src, all_cnt):
+        seed_list = np.concatenate([seed_list, hop_src])
+    mapping = {}
+    for nd in seed_list.tolist():
+        if nd not in mapping:
+            mapping[nd] = len(mapping)
+    reindex_src = np.asarray([mapping[v] for v in neighbors.tolist()],
+                             np.int32)
+    dst_nodes = []
+    base = seeds
+    for hop_src, hop_cnt in zip(all_src, all_cnt):
+        dst_nodes.append(np.repeat(base[:len(hop_cnt)], hop_cnt))
+        base = hop_src
+    dst = (np.concatenate(dst_nodes) if dst_nodes
+           else np.zeros(0, seeds.dtype))
+    reindex_dst = np.asarray([mapping[v] for v in dst.tolist()], np.int32)
+    nodes = np.asarray(list(mapping.keys()), np.int32)
+    out = (Tensor(jnp.asarray(reindex_src)),
+           Tensor(jnp.asarray(reindex_dst)),
+           Tensor(jnp.asarray(counts.astype(np.int32))),
+           Tensor(jnp.asarray(nodes)))
+    return out
+
+
+def identity_loss(x, reduction="none", name=None):
+    """reference: incubate/operators/identity_loss — marks x as the loss;
+    reduction in {none, sum, mean}."""
+    x = as_tensor(x)
+    if reduction in (0, "sum"):
+        return run_op(jnp.sum, [x], name="identity_loss")
+    if reduction in (1, "mean"):
+        return run_op(jnp.mean, [x], name="identity_loss")
+    return run_op(lambda a: a, [x], name="identity_loss")
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """reference: incubate/operators/softmax_mask_fuse.py — fused
+    (x + mask) softmax on the last axis; XLA fuses this into one kernel."""
+    return run_op(lambda a, m: jax.nn.softmax(a + m, axis=-1),
+                  [as_tensor(x), as_tensor(mask)], name="softmax_mask_fuse")
+
+
+def softmax_mask_fuse_upper_triangle(x, name=None):
+    """reference: incubate/operators/softmax_mask_fuse_upper_triangle.py —
+    causal-masked softmax (scores masked above the diagonal)."""
+    def fn(a):
+        q, k = a.shape[-2], a.shape[-1]
+        causal = jnp.tril(jnp.ones((q, k), bool))
+        return jax.nn.softmax(jnp.where(causal, a, -1e9), axis=-1)
+
+    return run_op(fn, [as_tensor(x)], name="softmax_mask_fuse_ut")

@@ -1,0 +1,131 @@
+"""Incubate optimizer wrappers: LookAhead, ModelAverage (reference:
+python/paddle/incubate/optimizer/{lookahead,modelaverage}.py).
+
+Both wrap an inner optimizer and keep per-parameter shadow state as raw
+jax arrays (device-resident, no tape)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.autograd import no_grad
+from ..optimizer.optimizer import Optimizer
+
+__all__ = ["LookAhead", "ModelAverage"]
+
+
+class LookAhead(Optimizer):
+    """k steps forward, 1 step back (Zhang et al. 2019). Every ``k`` inner
+    steps: slow += alpha * (fast - slow); fast = slow."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        if inner_optimizer is None:
+            raise ValueError("inner_optimizer can not be None")
+        if not 0.0 <= alpha <= 1.0:
+            raise ValueError("alpha should be in [0, 1]")
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        params = inner_optimizer._parameter_list
+        super().__init__(learning_rate=alpha, parameters=params)
+        self._slow = {}
+
+    @no_grad()
+    def step(self):
+        self.inner_optimizer.step()
+        self._global_step += 1
+        if self._global_step % self.k:
+            return
+        for p in self._parameter_list:
+            pid = id(p)
+            if pid not in self._slow:
+                self._slow[pid] = p._data
+            slow = self._slow[pid] + self.alpha * (p._data
+                                                   - self._slow[pid])
+            self._slow[pid] = slow
+            p._data = slow
+
+    def clear_grad(self, set_to_zero=False):
+        self.inner_optimizer.clear_grad(set_to_zero)
+
+    def state_dict(self):
+        sd = self.inner_optimizer.state_dict()
+        sd["lookahead_step"] = self._global_step
+        return sd
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+
+class ModelAverage(Optimizer):
+    """Running average of parameters for eval (Polyak averaging with a
+    windowed restart schedule, reference modelaverage.py). ``apply()``
+    swaps averaged weights in (optionally restoring on exit)."""
+
+    def __init__(self, average_window_rate, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        super().__init__(learning_rate=0.0, parameters=parameters)
+        self.avg_rate = float(average_window_rate)
+        self.min_avg_window = int(min_average_window)
+        self.max_avg_window = int(max_average_window)
+        self._sum = {}
+        self._num_updates = 0
+        self._backup = None
+
+    @no_grad()
+    def step(self):
+        self._num_updates += 1
+        window = max(self.min_avg_window,
+                     min(self.max_avg_window,
+                         int(self._num_updates * self.avg_rate)))
+        for p in self._parameter_list:
+            pid = id(p)
+            if pid not in self._sum:
+                self._sum[pid] = (p._data, 1)
+                continue
+            acc, n = self._sum[pid]
+            if n >= window:
+                # restart the window keeping the current average
+                acc = acc / n
+                n = 1
+            self._sum[pid] = (acc + p._data, n + 1)
+
+    def _averaged(self, p):
+        acc, n = self._sum.get(id(p), (p._data, 1))
+        return acc / n
+
+    @no_grad()
+    def apply(self, executor=None, need_restore=True):
+        """Swap averaged parameters in. Usable as a context manager when
+        need_restore=True (the reference's with-apply pattern)."""
+        self._backup = {id(p): p._data for p in self._parameter_list}
+        for p in self._parameter_list:
+            p._data = self._averaged(p)
+        mgr = self
+
+        class _Ctx:
+            def __enter__(self):
+                return mgr
+
+            def __exit__(self, *exc):
+                if need_restore:
+                    mgr.restore()
+                return False
+
+        return _Ctx()
+
+    @no_grad()
+    def restore(self, executor=None):
+        if self._backup is None:
+            return
+        for p in self._parameter_list:
+            if id(p) in self._backup:
+                p._data = self._backup[id(p)]
+        self._backup = None
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        self.step()

@@ -316,3 +316,25 @@ def load(path, **configs):
     return TranslatedLayer(payload.get("state_dict", {}),
                            payload.get("config", {}), forward_fn=forward_fn)
 from .train_step import ChunkPrefetcher, TrainStep  # noqa: F401,E402
+
+
+# ---- debug verbosity knobs (reference: python/paddle/jit/sot + dy2static
+# logging_utils set_verbosity/set_code_level) --------------------------------
+_verbosity = 0
+_code_level = 0
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    """Verbosity of the dynamic-to-static transcription logs."""
+    global _verbosity
+    _verbosity = int(level)
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """How many transformed-code stages to dump (on XLA this maps to
+    printing the captured jaxpr/StableHLO when level > 0)."""
+    global _code_level
+    _code_level = int(level)
+
+
+__all__ += ["set_verbosity", "set_code_level"]

@@ -17,6 +17,8 @@ __all__ = [
     "lstsq", "eig", "eigh", "eigvals", "eigvalsh", "det", "slogdet",
     "matrix_rank", "lu", "cholesky_solve", "matrix_transpose", "cdist",
     "householder_product", "pca_lowrank", "vander", "cond",
+    "vector_norm", "matrix_norm", "cholesky_inverse", "matrix_exp",
+    "lu_unpack", "ormqr", "svd_lowrank", "fp8_fp8_half_gemm_fused",
 ]
 
 
@@ -310,3 +312,200 @@ def cond(x, p=None, name=None):
             jnp.linalg.norm(jnp.linalg.inv(a), ord=p, axis=(-2, -1))
 
     return run_op(fn, [as_tensor(x)], name="cond")
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    """reference: python/paddle/tensor/linalg.py vector_norm — always
+    treats the input as (a batch of) vectors, flattening when axis=None."""
+    ax = axis_arg(axis)
+
+    def fn(a):
+        v = a.reshape(-1) if ax is None else a
+        axx = None if ax is None else ax
+        if p == float("inf"):
+            return jnp.max(jnp.abs(v), axis=axx, keepdims=keepdim)
+        if p == float("-inf"):
+            return jnp.min(jnp.abs(v), axis=axx, keepdims=keepdim)
+        if p == 0:
+            return jnp.sum((v != 0).astype(a.dtype), axis=axx,
+                           keepdims=keepdim)
+        return jnp.sum(jnp.abs(v) ** p, axis=axx,
+                       keepdims=keepdim) ** (1.0 / p)
+
+    return unary(fn, x, "vector_norm")
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    """reference: python/paddle/tensor/linalg.py matrix_norm — operator
+    norms over the trailing matrix axes (fro/nuc/±1/±2/±inf)."""
+    ax = tuple(axis)
+
+    def fn(a):
+        mv_ = jnp.moveaxis(a, ax, (-2, -1))
+        if p == "fro":
+            out = jnp.sqrt(jnp.sum(mv_ * mv_, axis=(-2, -1)))
+        elif p == "nuc":
+            out = jnp.sum(jnp.linalg.svd(mv_, compute_uv=False), axis=-1)
+        elif p in (2, 2.0):
+            out = jnp.max(jnp.linalg.svd(mv_, compute_uv=False), axis=-1)
+        elif p in (-2, -2.0):
+            out = jnp.min(jnp.linalg.svd(mv_, compute_uv=False), axis=-1)
+        elif p in (1, 1.0):
+            out = jnp.max(jnp.sum(jnp.abs(mv_), axis=-2), axis=-1)
+        elif p in (-1, -1.0):
+            out = jnp.min(jnp.sum(jnp.abs(mv_), axis=-2), axis=-1)
+        elif p == float("inf"):
+            out = jnp.max(jnp.sum(jnp.abs(mv_), axis=-1), axis=-1)
+        elif p == float("-inf"):
+            out = jnp.min(jnp.sum(jnp.abs(mv_), axis=-1), axis=-1)
+        else:
+            raise ValueError(f"matrix_norm: unsupported p={p!r}")
+        if keepdim:
+            out = jnp.expand_dims(out, ax)
+        return out
+
+    return unary(fn, x, "matrix_norm")
+
+
+def cholesky_inverse(x, upper=False, name=None):
+    """reference: python/paddle/tensor/linalg.py cholesky_inverse —
+    inverse of A given its Cholesky factor, via two triangular solves."""
+    def fn(L):
+        import jax.scipy.linalg as jsl
+
+        eye = jnp.eye(L.shape[-1], dtype=L.dtype)
+        li = jsl.solve_triangular(L, eye, lower=not upper)
+        return (jnp.swapaxes(li, -1, -2) @ li if not upper
+                else li @ jnp.swapaxes(li, -1, -2))
+
+    return unary(fn, x, "cholesky_inverse")
+
+
+def matrix_exp(x, name=None):
+    """reference: python/paddle/tensor/linalg.py matrix_exp:5205."""
+    import jax.scipy.linalg as jsl
+
+    return unary(jsl.expm, x, "matrix_exp")
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    """reference: python/paddle/tensor/linalg.py lu_unpack — split packed
+    LU into P (from 1-based pivot swaps), unit-lower L and upper U."""
+    x = as_tensor(x)
+    yv = as_tensor(y)
+
+    def fn(lu_, piv):
+        m, n = lu_.shape[-2], lu_.shape[-1]
+        k = min(m, n)
+        L = jnp.tril(lu_[..., :, :k], -1) + jnp.eye(m, k, dtype=lu_.dtype)
+        U = jnp.triu(lu_[..., :k, :])
+        # pivots (1-based sequential swaps) -> permutation matrix
+        perm = jnp.arange(m)
+        piv0 = piv.astype(jnp.int32) - 1
+
+        def body(i, pm):
+            j = piv0[..., i]
+            pi, pj = pm[i], pm[j]
+            pm = pm.at[i].set(pj)
+            return pm.at[j].set(pi)
+
+        import jax.lax as lax
+
+        perm = lax.fori_loop(0, piv0.shape[-1], body, perm)
+        P = jnp.eye(m, dtype=lu_.dtype)[perm].T
+        return P, L, U
+
+    P, L, U = fn(x._data, yv._data)
+    return Tensor(P), Tensor(L), Tensor(U)
+
+
+def ormqr(x, tau, other, left=True, transpose=False, name=None):
+    """reference: python/paddle/tensor/linalg.py ormqr — multiply `other`
+    by Q from the householder factors WITHOUT forming Q: apply each
+    reflector H_i = I - tau_i v_i v_i^T in sequence (rank-1 updates)."""
+    def fn(a, t_, c):
+        m = a.shape[-2]
+        k = t_.shape[-1]
+        idxs = range(k)
+        # Q = H_0 H_1 ... H_{k-1}. Left-apply Q  -> reflectors in reverse;
+        # left-apply Q^T -> forward; right-apply mirrors that.
+        order = idxs if (left and transpose) or (not left and not transpose) \
+            else reversed(idxs)
+        for i in order:
+            v = a[..., :, i]
+            v = jnp.where(jnp.arange(m) < i, 0.0, v)
+            v = v.at[..., i].set(1.0)
+            ti = t_[..., i]
+            if left:
+                c = c - ti * v[..., :, None] * jnp.einsum(
+                    "...m,...mk->...k", v, c)[..., None, :]
+            else:
+                c = c - ti * jnp.einsum(
+                    "...km,...m->...k", c, v)[..., :, None] * v[..., None, :]
+        return c
+
+    return run_op(fn, [as_tensor(x), as_tensor(tau), as_tensor(other)],
+                  name="ormqr")
+
+
+def svd_lowrank(x, q=6, niter=2, M=None, name=None):
+    """reference: python/paddle/tensor/linalg.py svd_lowrank — randomized
+    low-rank SVD (Halko et al. 2011): power-iterated range finder + small
+    exact SVD. Matmul-dominated => MXU-friendly."""
+    from ..core import random as _rng
+    import jax
+
+    x = as_tensor(x)
+
+    def fn(a):
+        m, n = a.shape[-2], a.shape[-1]
+        qq = min(q, m, n)
+        ar = a if M is None else a - M
+        omega = jax.random.normal(_rng.next_key(), a.shape[:-2] + (n, qq),
+                                  dtype=a.dtype)
+        y = ar @ omega
+        for _ in range(niter):
+            y = ar @ (jnp.swapaxes(ar, -1, -2) @ y)
+        Q, _ = jnp.linalg.qr(y)
+        b = jnp.swapaxes(Q, -1, -2) @ ar
+        u, s, vh = jnp.linalg.svd(b, full_matrices=False)
+        return Q @ u, s, jnp.swapaxes(vh, -1, -2)
+
+    u, s, v = fn(x._data)
+    return Tensor(u), Tensor(s), Tensor(v)
+
+
+def fp8_fp8_half_gemm_fused(x, y, transpose_x=False, transpose_y=False,
+                            bias=None, scale=1.0, output_dtype="bfloat16",
+                            activation_type="identity", name=None):
+    """reference: python/paddle/linalg.py fp8_fp8_half_gemm_fused (CUDA
+    cublasLt fp8 gemm). TPU-native: cast to float8_e4m3fn and let XLA emit
+    the native low-precision matmul, accumulating in the requested half
+    dtype; bias/activation fuse into the epilogue."""
+    from ..core.dtype import to_jax_dtype
+
+    out_dt = to_jax_dtype(output_dtype)
+
+    def fn(a, b, *rest):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2)
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2)
+        a8 = a.astype(jnp.float8_e4m3fn)
+        b8 = b.astype(jnp.float8_e4m3fn)
+        out = jnp.matmul(a8, b8, preferred_element_type=jnp.float32)
+        out = (out * scale).astype(out_dt)
+        if rest:
+            out = out + rest[0].astype(out_dt)
+        if activation_type in ("gelu",):
+            import jax.nn as jnn
+
+            out = jnn.gelu(out)
+        elif activation_type in ("relu",):
+            out = jnp.maximum(out, 0)
+        return out
+
+    args = [as_tensor(x), as_tensor(y)]
+    if bias is not None:
+        args.append(as_tensor(bias))
+    return run_op(fn, args, name="fp8_gemm")

@@ -233,3 +233,169 @@ def pow(x, factor):
 def transpose(x, perm):
     return SparseCooTensor(jsparse.bcoo_transpose(
         _as_bcoo(x), permutation=tuple(perm)))
+
+
+asin = _unary(jnp.arcsin)
+asinh = _unary(jnp.arcsinh)
+atan = _unary(jnp.arctan)
+atanh = _unary(jnp.arctanh)
+sinh = _unary(jnp.sinh)
+tan = _unary(jnp.tan)
+expm1 = _unary(jnp.expm1)
+log1p = _unary(jnp.log1p)
+square = _unary(jnp.square)
+deg2rad = _unary(jnp.deg2rad)
+rad2deg = _unary(jnp.rad2deg)
+isnan = _unary(jnp.isnan)
+
+
+def cast(x, index_dtype=None, value_dtype=None):
+    """reference: sparse/unary.py cast — cast indices and/or values."""
+    from ..core.dtype import to_jax_dtype
+
+    bc = _as_bcoo(x)
+    idx = bc.indices
+    if index_dtype is not None:
+        idx = idx.astype(to_jax_dtype(index_dtype))
+    data = bc.data
+    if value_dtype is not None:
+        data = data.astype(to_jax_dtype(value_dtype))
+    out = SparseCooTensor(jsparse.BCOO((data, idx), shape=bc.shape))
+    return out if isinstance(x, SparseCooTensor) else out.to_sparse_csr()
+
+
+def coalesce(x):
+    """reference: sparse/unary.py coalesce — merge duplicate indices."""
+    return SparseCooTensor(jsparse.bcoo_sum_duplicates(_as_bcoo(x)))
+
+
+def subtract(x, y):
+    """reference: sparse/binary.py subtract."""
+    return add(x, neg(y) if isinstance(
+        y, (SparseCooTensor, SparseCsrTensor)) else Tensor(-y._data))
+
+
+def divide(x, y):
+    """sparse / dense (or scalar) elementwise (reference binary.py)."""
+    bc = _as_bcoo(x)
+    if isinstance(y, Tensor):
+        gathered = y._data[tuple(bc.indices[:, i]
+                                 for i in range(bc.indices.shape[1]))]
+        return SparseCooTensor(jsparse.BCOO((bc.data / gathered,
+                                             bc.indices), shape=bc.shape))
+    return SparseCooTensor(jsparse.BCOO((bc.data / y, bc.indices),
+                                        shape=bc.shape))
+
+
+def sum(x, axis=None, dtype=None, keepdim=False):
+    """reference: sparse/unary.py sum — dense output like the reference
+    (sum destroys sparsity along the reduced axes)."""
+    dense = _as_bcoo(x).todense()
+    out = jnp.sum(dense, axis=axis, keepdims=keepdim)
+    if dtype is not None:
+        from ..core.dtype import to_jax_dtype
+
+        out = out.astype(to_jax_dtype(dtype))
+    return Tensor(out)
+
+
+def mv(x, vec):
+    """sparse matrix @ dense vector (reference: sparse/matmul.py mv)."""
+    v = vec._data if isinstance(vec, Tensor) else jnp.asarray(vec)
+    return Tensor(_as_bcoo(x) @ v)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0):
+    """beta*input + alpha*(x@y) with sparse x (reference: matmul.py
+    addmm)."""
+    prod = matmul(x, y)
+    inp = input.to_dense() if isinstance(
+        input, (SparseCooTensor, SparseCsrTensor)) else input
+    return Tensor(beta * inp._data + alpha * prod._data)
+
+
+def mask_as(x, mask):
+    """Sample dense x at mask's sparsity pattern (reference:
+    sparse/unary.py mask_as)."""
+    bc = _as_bcoo(mask)
+    xd = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    vals = xd[tuple(bc.indices[:, i] for i in range(bc.indices.shape[1]))]
+    out = SparseCooTensor(jsparse.BCOO((vals, bc.indices), shape=bc.shape))
+    return out if isinstance(mask, SparseCooTensor) else out.to_sparse_csr()
+
+
+def reshape(x, shape):
+    """reference: sparse/unary.py reshape — COO reshape via linearized
+    index remap (pure integer arithmetic, stays sparse)."""
+    bc = _as_bcoo(x)
+    old_shape = bc.shape
+    new_shape = []
+    inferred = -1
+    total = int(np.prod(old_shape))
+    for i, s in enumerate(shape):
+        if s == -1:
+            inferred = i
+            new_shape.append(1)
+        else:
+            new_shape.append(int(s))
+    if inferred >= 0:
+        new_shape[inferred] = total // int(np.prod(new_shape))
+    lin = jnp.zeros(bc.indices.shape[0], dtype=bc.indices.dtype)
+    for i, s in enumerate(old_shape):
+        lin = lin * s + bc.indices[:, i]
+    new_idx = []
+    rem = lin
+    for s in reversed(new_shape):
+        new_idx.append(rem % s)
+        rem = rem // s
+    idx = jnp.stack(list(reversed(new_idx)), axis=1)
+    out = SparseCooTensor(jsparse.BCOO((bc.data, idx),
+                                       shape=tuple(new_shape)))
+    return out if isinstance(x, SparseCooTensor) else out.to_sparse_csr()
+
+
+def slice(x, axes, starts, ends):
+    """reference: sparse/unary.py slice:1017 — slice a sparse tensor,
+    keeping it sparse (index filter + shift)."""
+    bc = jsparse.bcoo_sum_duplicates(_as_bcoo(x))
+    shape = list(bc.shape)
+    sel = jnp.ones(bc.indices.shape[0], dtype=bool)
+    shifts = [0] * len(shape)
+    for ax, st, en in zip(axes, starts, ends):
+        ax = int(ax) % len(shape)
+        st = int(st) if st >= 0 else int(st) + shape[ax]
+        en = min(int(en) if en >= 0 else int(en) + shape[ax], shape[ax])
+        sel = sel & (bc.indices[:, ax] >= st) & (bc.indices[:, ax] < en)
+        shifts[ax] = st
+        shape[ax] = en - st
+    # dynamic nnz -> host filter (eager-only op, like reference CPU path)
+    keep = np.nonzero(np.asarray(sel))[0]
+    idx = np.asarray(bc.indices)[keep] - np.asarray(shifts, np.int32)
+    data = np.asarray(bc.data)[keep]
+    out = SparseCooTensor(jsparse.BCOO(
+        (jnp.asarray(data), jnp.asarray(idx)), shape=tuple(shape)))
+    return out if isinstance(x, SparseCooTensor) else out.to_sparse_csr()
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """Randomized PCA on a sparse matrix (reference: sparse linalg
+    pca_lowrank) — densify through matmuls only."""
+    from ..ops.linalg import svd_lowrank
+
+    dense = _as_bcoo(x).todense()
+    m, n = dense.shape[-2], dense.shape[-1]
+    qq = q if q is not None else min(6, m, n)
+    t = Tensor(dense)
+    if center:
+        mean = jnp.mean(dense, axis=-2, keepdims=True)
+        t = Tensor(dense - mean)
+    u, s, v = svd_lowrank(t, q=qq, niter=niter)
+    return u, s, v
+
+
+__all__ += ["asin", "asinh", "atan", "atanh", "sinh", "tan", "expm1",
+            "log1p", "square", "deg2rad", "rad2deg", "isnan", "cast",
+            "coalesce", "subtract", "divide", "sum", "mv", "addmm",
+            "mask_as", "reshape", "slice", "pca_lowrank"]
+
+from . import nn  # noqa: F401,E402
