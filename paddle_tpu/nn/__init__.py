@@ -5,6 +5,7 @@ from .layer.layers import Layer, Parameter  # noqa: F401
 from .layer.container import (  # noqa: F401
     LayerDict,
     LayerList,
+    ParameterDict,
     ParameterList,
     Sequential,
 )
@@ -17,6 +18,7 @@ from .layer.loss import *  # noqa: F401,F403
 from .layer.transformer import *  # noqa: F401,F403
 from .layer.rnn import *  # noqa: F401,F403
 from .layer.extend import *  # noqa: F401,F403
+from .decode import BeamSearchDecoder, Decoder, dynamic_decode  # noqa: F401
 
 from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401
 

@@ -1,0 +1,176 @@
+"""Decoding API: BeamSearchDecoder + dynamic_decode (reference:
+python/paddle/nn/decode.py:161,1238).
+
+Eager host-driven loop — the API-parity tier for seq2seq models built on
+RNN cells. (The compiled whole-generation beam search for transformer
+serving lives in models/generation.py; this module mirrors the reference
+decoder protocol: initialize/step/finalize over a wrapped cell.)
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..ops._helpers import as_tensor, unwrap
+
+__all__ = ["Decoder", "BeamSearchDecoder", "dynamic_decode"]
+
+
+class Decoder:
+    """Abstract decoder protocol (reference decode.py Decoder)."""
+
+    def initialize(self, inits):
+        raise NotImplementedError
+
+    def step(self, time, inputs, states, **kwargs):
+        raise NotImplementedError
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        raise NotImplementedError
+
+    @property
+    def tracks_own_finished(self):
+        return False
+
+
+def _map_structure(fn, obj):
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_map_structure(fn, o) for o in obj)
+    return fn(obj)
+
+
+class BeamSearchDecoder(Decoder):
+    """Beam-search decoding over a wrapped cell (reference
+    decode.py:161). States and inputs are tiled to [batch*beam, ...]."""
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    @staticmethod
+    def tile_beam_merge_with_batch(x, beam_size):
+        """[B, ...] -> [B*beam, ...] by repeat (reference decode.py:256)."""
+        x = as_tensor(x)
+        a = unwrap(x)
+        tiled = jnp.repeat(a[:, None], beam_size, axis=1)
+        return Tensor(tiled.reshape((-1,) + a.shape[1:]))
+
+    def initialize(self, initial_cell_states):
+        states = _map_structure(
+            lambda s: self.tile_beam_merge_with_batch(s, self.beam_size),
+            initial_cell_states)
+        sample = states[0] if isinstance(states, (list, tuple)) else states
+        bk = sample.shape[0]
+        batch = bk // self.beam_size
+        ids = jnp.full((bk,), self.start_token, jnp.int32)
+        # beam 0 live, the rest -inf so step 1 expands a single beam
+        log_probs = jnp.tile(
+            jnp.asarray([0.0] + [-1e9] * (self.beam_size - 1),
+                        jnp.float32), (batch,))
+        init = {"ids": Tensor(ids), "log_probs": Tensor(log_probs),
+                "finished": Tensor(jnp.zeros((bk,), bool)),
+                "lengths": Tensor(jnp.zeros((bk,), jnp.int32))}
+        return Tensor(ids), (states, init), Tensor(
+            jnp.zeros((bk,), bool))
+
+    def step(self, time, inputs, states, **kwargs):
+        cell_states, beam = states
+        x = inputs
+        if self.embedding_fn is not None:
+            x = self.embedding_fn(inputs)
+        cell_out, next_cell_states = self.cell(x, cell_states, **kwargs)
+        if self.output_fn is not None:
+            cell_out = self.output_fn(cell_out)
+        logits = unwrap(as_tensor(cell_out))           # [B*K, V]
+        bk, vocab = logits.shape
+        k = self.beam_size
+        batch = bk // k
+        logp = logits - jnp.log(jnp.sum(jnp.exp(logits), -1,
+                                        keepdims=True))
+        prev_lp = unwrap(beam["log_probs"]).reshape(batch, k)
+        finished = unwrap(beam["finished"]).reshape(batch, k)
+        lengths = unwrap(beam["lengths"]).reshape(batch, k)
+        # finished beams only extend with end_token at zero cost
+        mask = jnp.full((vocab,), -1e9).at[self.end_token].set(0.0)
+        step_lp = jnp.where(finished[..., None],
+                            mask[None, None, :],
+                            logp.reshape(batch, k, vocab))
+        total = prev_lp[..., None] + step_lp          # [B, K, V]
+        flat = total.reshape(batch, k * vocab)
+        top_idx = jnp.argsort(-flat, -1)[:, :k]
+        top_lp = jnp.take_along_axis(flat, top_idx, -1)
+        parent = top_idx // vocab                      # [B, K]
+        token = (top_idx % vocab).astype(jnp.int32)
+        gather = (jnp.arange(batch)[:, None] * k + parent).reshape(-1)
+        new_finished = (jnp.take(finished.reshape(-1), gather)
+                        | (token.reshape(-1) == self.end_token))
+        new_lengths = jnp.take(lengths.reshape(-1), gather) + jnp.where(
+            jnp.take(finished.reshape(-1), gather), 0, 1)
+        next_cell_states = _map_structure(
+            lambda s: Tensor(jnp.take(unwrap(as_tensor(s)), gather,
+                                      axis=0)),
+            next_cell_states)
+        beam_out = {"ids": Tensor(token.reshape(-1)),
+                    "parents": Tensor(parent.reshape(-1).astype(jnp.int32)),
+                    "log_probs": Tensor(top_lp.reshape(-1)),
+                    "finished": Tensor(new_finished),
+                    "lengths": Tensor(new_lengths)}
+        next_states = (next_cell_states, beam_out)
+        next_inputs = Tensor(token.reshape(-1))
+        return beam_out, next_states, next_inputs, Tensor(new_finished)
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        """Backtrace parent pointers into token sequences (the reference's
+        gather_tree op)."""
+        ids = np.stack([np.asarray(unwrap(o["ids"])) for o in outputs])
+        parents = np.stack([np.asarray(unwrap(o["parents"]))
+                            for o in outputs])           # [T, B*K]
+        t_max, bk = ids.shape
+        k = self.beam_size
+        batch = bk // k
+        ids = ids.reshape(t_max, batch, k)
+        parents = parents.reshape(t_max, batch, k)
+        out = np.zeros_like(ids)
+        beam = np.tile(np.arange(k), (batch, 1))
+        for t in range(t_max - 1, -1, -1):
+            out[t] = np.take_along_axis(ids[t], beam, -1)
+            beam = np.take_along_axis(parents[t], beam, -1)
+        # [T, B, K] -> [B, T, K] like the reference
+        return Tensor(jnp.asarray(out.transpose(1, 0, 2))), final_states
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=None,
+                   output_time_major=False, impute_finished=False,
+                   is_test=False, return_length=False, **kwargs):
+    """Run decoder.step until every sequence finishes or max_step_num
+    (reference decode.py:1238)."""
+    inputs, states, finished = decoder.initialize(inits)
+    outputs = []
+    step = 0
+    limit = max_step_num if max_step_num is not None else 256
+    while step < limit:
+        out, states, inputs, finished = decoder.step(step, inputs, states,
+                                                     **kwargs)
+        outputs.append(out)
+        step += 1
+        if bool(np.asarray(unwrap(finished)).all()):
+            break
+    if isinstance(states, tuple) and isinstance(states[-1], dict):
+        lengths = states[-1]["lengths"]
+    else:
+        lengths = Tensor(jnp.full((unwrap(finished).shape[0],), step,
+                                  jnp.int32))
+    final_outputs, final_states = decoder.finalize(outputs, states,
+                                                   lengths)
+    if output_time_major:
+        final_outputs = Tensor(jnp.moveaxis(unwrap(final_outputs), 0, 1))
+    if return_length:
+        return final_outputs, final_states, lengths
+    return final_outputs, final_states

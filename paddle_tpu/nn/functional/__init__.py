@@ -13,6 +13,11 @@ from ...incubate.nn.functional.flash_attention import (  # noqa: F401
     flash_attention,
     flash_attn_unpadded,
 )
+from .attention_ext import (  # noqa: F401
+    flash_attn_varlen_qkvpacked,
+    flashmask_attention,
+    sparse_attention,
+)
 
 __all__ = (
     activation.__all__
@@ -21,5 +26,6 @@ __all__ = (
     + pooling.__all__
     + norm.__all__
     + loss.__all__
-    + ["flash_attention", "flash_attn_unpadded"]
+    + ["flash_attention", "flash_attn_unpadded", "sparse_attention",
+       "flashmask_attention", "flash_attn_varlen_qkvpacked"]
 )

@@ -7,7 +7,7 @@ import jax.numpy as jnp
 from ...ops._helpers import as_tensor, run_op, unary, unwrap
 
 __all__ = [
-    "relu", "relu_", "relu6", "elu", "selu", "celu", "gelu", "silu", "swish",
+    "relu", "relu_", "tanh_", "relu6", "elu", "selu", "celu", "gelu", "silu", "swish",
     "sigmoid", "hardsigmoid", "hardswish", "hardtanh", "hardshrink",
     "softshrink", "tanhshrink", "leaky_relu", "prelu", "rrelu", "log_sigmoid",
     "maxout", "softplus", "softsign", "tanh", "mish", "softmax", "log_softmax",
@@ -20,9 +20,17 @@ def relu(x, name=None):
 
 
 def relu_(x, name=None):
-    x._data = jax.nn.relu(x._data)
-    x._grad_node = None
-    return x
+    from ...ops.inplace import inplace_rebind
+
+    return inplace_rebind(x, lambda alias: relu(alias))
+
+
+def tanh_(x, name=None):
+    """Inplace tanh (reference: nn/functional/activation.py tanh_)."""
+    from ...ops.inplace import inplace_rebind
+    from ...ops.math import tanh as _tanh
+
+    return inplace_rebind(x, lambda alias: _tanh(alias))
 
 
 def relu6(x, name=None):

@@ -1,0 +1,176 @@
+"""Extended attention functionals: sparse_attention, flashmask_attention,
+flash_attn_varlen_qkvpacked (reference:
+python/paddle/nn/functional/sparse_attention.py,
+flash_attention.py flashmask_attention:1099 / flash_attn_varlen_qkvpacked).
+
+TPU-native stance: all three lower to ONE fused XLA attention program —
+the mask construction is integer bookkeeping; XLA fuses mask+softmax+
+matmul. (The reference's CUDA kernels exist to avoid materializing the
+mask in HBM on Ampere; on TPU, seq-len-bounded masks live in registers/
+VMEM after fusion for these API-tier shapes, while the long-seq serving
+path uses the Pallas flash kernel in incubate/nn/pallas.)
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Tensor
+from ...ops._helpers import as_tensor, run_op, unwrap
+
+__all__ = ["sparse_attention", "flashmask_attention",
+           "flash_attn_varlen_qkvpacked"]
+
+
+def sparse_attention(query, key, value, sparse_csr_offset,
+                     sparse_csr_columns, key_padding_mask=None,
+                     attn_mask=None, name=None):
+    """Attention restricted to a per-row CSR sparsity pattern.
+
+    q/k/v: [B, H, M, D]; offset: [B, H, M+1]; columns: [B, H, nnz].
+    """
+    off = np.asarray(unwrap(as_tensor(sparse_csr_offset))).astype(np.int64)
+    cols = np.asarray(unwrap(as_tensor(sparse_csr_columns))).astype(
+        np.int64)
+    q = as_tensor(query)
+    b, h, m, d = q.shape
+    n = as_tensor(key).shape[2]
+    allow = np.zeros((b, h, m, n), bool)
+    for bi in range(b):
+        for hi in range(h):
+            o = off[bi, hi]
+            for i in range(m):
+                allow[bi, hi, i, cols[bi, hi, o[i]:o[i + 1]]] = True
+    allow_j = jnp.asarray(allow)
+    args = [q, as_tensor(key), as_tensor(value)]
+    kpm = key_padding_mask is not None
+    am = attn_mask is not None
+    if kpm:
+        args.append(as_tensor(key_padding_mask))
+    if am:
+        args.append(as_tensor(attn_mask))
+
+    def fn(qa, ka, va, *rest):
+        scores = jnp.einsum("bhmd,bhnd->bhmn", qa, ka) * (d ** -0.5)
+        i = 0
+        if kpm:
+            scores = scores + rest[i][:, None, None, :]
+            i += 1
+        if am:
+            scores = scores + rest[i][None, None]
+        scores = jnp.where(allow_j, scores, -1e9)
+        p = jax.nn.softmax(scores, axis=-1)
+        p = jnp.where(allow_j, p, 0.0)
+        return jnp.einsum("bhmn,bhnd->bhmd", p, va)
+
+    return run_op(fn, args, name="sparse_attention")
+
+
+def _flashmask_dense(idx, sq, sk, causal):
+    """startend_row_indices [B, KH, Sk, {1,2,4}] -> boolean allow-mask
+    [B, KH, Sq, Sk] per the reference's column-wise row-range semantics."""
+    rows = jnp.arange(sq)[:, None]        # r, broadcasts over [Sq, Sk]
+    colsr = jnp.arange(sk)[None, :]       # j
+    k = idx.shape[-1]
+
+    def per_col(sel):
+        # idx[..., sel]: [B, KH, Sk] -> [B, KH, 1, Sk] for row comparison
+        return idx[..., sel][:, :, None, :]
+
+    if causal:
+        base = rows >= colsr              # lower triangle (incl diag)
+        if k == 1:
+            masked = rows >= per_col(0)
+        elif k == 2:
+            masked = (rows >= per_col(0)) & (rows < per_col(1))
+        else:
+            raise ValueError("causal flashmask takes last dim 1 or 2")
+        return base[None, None] & ~masked
+    if k == 2:
+        lt_masked = (rows > colsr)[None, None] & (rows >= per_col(0))
+        ut_masked = (rows < colsr)[None, None] & (rows < per_col(1))
+    elif k == 4:
+        lt_masked = ((rows > colsr)[None, None]
+                     & (rows >= per_col(0)) & (rows < per_col(1)))
+        ut_masked = ((rows < colsr)[None, None]
+                     & (rows >= per_col(2)) & (rows < per_col(3)))
+    else:
+        raise ValueError("bidirectional flashmask takes last dim 2 or 4")
+    return ~(lt_masked | ut_masked)
+
+
+def flashmask_attention(query, key, value, startend_row_indices=None,
+                        dropout=0.0, causal=False, window_size=None,
+                        return_softmax_lse=False, return_seed_offset=False,
+                        fixed_seed_offset=None, rng_name="", training=True,
+                        name=None):
+    """FlashMask (arXiv:2410.01359): column-wise sparse row-range masks.
+
+    q: [B, Sq, H, D]; k/v: [B, Sk, KH, D];
+    startend_row_indices: [B, KH|1, Sk, {1,2,4}] int32.
+    """
+    q, k, v = as_tensor(query), as_tensor(key), as_tensor(value)
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    if startend_row_indices is not None:
+        idx = unwrap(as_tensor(startend_row_indices)).astype(jnp.int32)
+        allow = _flashmask_dense(idx, sq, sk, causal)   # [B, KH, Sq, Sk]
+        if allow.shape[1] == 1:
+            allow = jnp.broadcast_to(allow, (b, h, sq, sk))
+    elif causal:
+        allow = jnp.broadcast_to(
+            (jnp.arange(sq)[:, None] >= jnp.arange(sk)[None, :])[None,
+                                                                 None],
+            (b, h, sq, sk))
+    else:
+        allow = jnp.ones((b, h, sq, sk), bool)
+    if window_size is not None:
+        w = (window_size, window_size) if isinstance(window_size, int) \
+            else tuple(window_size)
+        rows = jnp.arange(sq)[:, None]
+        colsr = jnp.arange(sk)[None, :]
+        win = (colsr >= rows - w[0]) & (colsr <= rows + (0 if causal
+                                                         else w[1]))
+        allow = allow & win[None, None]
+
+    def fn(qa, ka, va):
+        kh = ka.shape[2]
+        if kh != h:  # GQA broadcast
+            rep = h // kh
+            ka2 = jnp.repeat(ka, rep, axis=2)
+            va2 = jnp.repeat(va, rep, axis=2)
+        else:
+            ka2, va2 = ka, va
+        scores = jnp.einsum("bqhd,bkhd->bhqk", qa, ka2) * (d ** -0.5)
+        scores = jnp.where(allow, scores, -1e9)
+        lse = jax.nn.logsumexp(scores, axis=-1)
+        p = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("bhqk,bkhd->bqhd", p, va2)
+        return (out, lse) if return_softmax_lse else out
+
+    out = run_op(fn, [q, k, v], name="flashmask_attention")
+    return out
+
+
+def flash_attn_varlen_qkvpacked(qkv, cu_seqlens_q, cu_seqlens_k,
+                                max_seqlen_q, max_seqlen_k, scale=None,
+                                dropout=0.0, causal=False,
+                                return_softmax=False, training=True,
+                                name=None):
+    """Varlen flash attention on packed qkv (reference:
+    flash_attention.py flash_attn_varlen_qkvpacked).
+
+    qkv: [total_tokens, 3, num_heads, head_dim] (packed ragged batch).
+    Unpacks and dispatches to the segment-masked varlen kernel.
+    """
+    from ...incubate.nn.functional.flash_attention import \
+        flash_attn_unpadded
+
+    qkv = as_tensor(qkv)
+    a = unwrap(qkv)
+    q, k, v = (Tensor(a[:, 0]), Tensor(a[:, 1]), Tensor(a[:, 2]))
+    return flash_attn_unpadded(q, k, v, cu_seqlens_q, cu_seqlens_k,
+                               max_seqlen_q, max_seqlen_k, scale=scale,
+                               dropout=dropout, causal=causal,
+                               return_softmax=return_softmax)

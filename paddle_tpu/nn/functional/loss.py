@@ -400,3 +400,76 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
         return _reduce(nll, reduction)
 
     return run_op(fn, [lp], name="ctc_loss")
+
+
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.001, reduction="mean", name=None):
+    """RNN-Transducer loss (reference: python/paddle/nn/functional/loss.py
+    rnnt_loss over phi warprnnt kernels; Graves 2012).
+
+    input: [B, T, U+1, V] logits (T acoustic frames, U label positions),
+    label: [B, U] int, lengths per batch. Forward-variable DP in log space:
+    alpha[t, u] = logaddexp(alpha[t-1, u] + blank(t-1, u),
+                            alpha[t, u-1] + y(t, u-1)); lax.scan over t
+    with an inner scan over u — static-shape, TPU-compilable.
+
+    fastemit_lambda is accepted for signature compatibility: in the
+    reference's warprnnt kernel it shapes only the backward (emission-path
+    gradient scaling), not the returned cost.
+    """
+    import jax
+    import jax.lax as lax
+
+    x = as_tensor(input)
+    lab = unwrap(as_tensor(label)).astype(jnp.int32)
+    t_lens = unwrap(as_tensor(input_lengths)).astype(jnp.int32)
+    u_lens = unwrap(as_tensor(label_lengths)).astype(jnp.int32)
+
+    def one(logits, labels, t_len, u_len):
+        # logits [T, U1, V]; labels [U]
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        T, U1, _ = logp.shape
+        blank_lp = logp[..., blank]                      # [T, U1]
+        lab_lp = jnp.take_along_axis(
+            logp[:, :-1, :], labels[None, :, None], axis=-1)[..., 0]  # [T,U]
+        neg_inf = jnp.asarray(-1e30, logp.dtype)
+
+        def row(alpha_prev, t):
+            # alpha_prev: alpha[t-1, :] ([U1]); compute alpha[t, :]
+            from_blank = jnp.where(t == 0,
+                                   jnp.where(jnp.arange(U1) == 0, 0.0,
+                                             neg_inf),
+                                   alpha_prev + blank_lp[t - 1])
+
+            def cell(carry, u):
+                from_label = jnp.where(u == 0, neg_inf,
+                                       carry + lab_lp[t, u - 1])
+                a = jnp.where(t == 0,
+                              jnp.where(u == 0, 0.0, from_label),
+                              jnp.logaddexp(from_blank[u], from_label))
+                return a, a
+
+            _, alpha_t = lax.scan(cell, neg_inf, jnp.arange(U1))
+            return alpha_t, alpha_t
+
+        _, alphas = lax.scan(row, jnp.full((U1,), neg_inf),
+                             jnp.arange(T))                    # [T, U1]
+        final = alphas[t_len - 1, u_len] + blank_lp[t_len - 1, u_len]
+        return -final
+
+    def fn(a):
+        return jax.vmap(one)(a, lab, t_lens, u_lens)
+
+    losses = run_op(fn, [x], name="rnnt_loss")
+    if reduction == "mean":
+        from ...ops.math import mean as _mean
+
+        return _mean(losses)
+    if reduction == "sum":
+        from ...ops.math import sum as _sum
+
+        return _sum(losses)
+    return losses
+
+
+__all__ += ["rnnt_loss"]

@@ -6,7 +6,7 @@ import jax.lax as lax
 import jax.numpy as jnp
 
 from ...core.tensor import Tensor
-from ...ops._helpers import as_tensor, run_op, unary
+from ...ops._helpers import as_tensor, run_op, unary, unwrap
 
 __all__ = [
     "max_pool1d", "max_pool2d", "max_pool3d", "avg_pool1d", "avg_pool2d",
@@ -201,3 +201,105 @@ def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
 
 def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
     return _adaptive(x, output_size, 3, False, True, "adaptive_max_pool3d")
+
+
+def _fractional_bounds(in_size, out_size, u, pool_size=0):
+    """Start/end index sequences for fractional pooling (reference:
+    phi/kernels/funcs/pooling.h FractionalStartIndex/FractionalEndIndex)."""
+    import math as _math
+
+    alpha = in_size / out_size
+    base = int(u * alpha)
+    starts, ends = [], []
+    for i in range(out_size):
+        s = int((i + u) * alpha) - base
+        e = (s + pool_size if pool_size > 0
+             else int((i + 1 + u) * alpha) - base)
+        starts.append(max(0, min(s, in_size - 1)))
+        ends.append(max(1, min(e, in_size)))
+    return starts, ends
+
+
+def _fractional_max_pool(x, output_size, kernel_size, random_u, return_mask,
+                         ndim, op_name):
+    from ...core import random as _rng
+    import jax
+
+    x = as_tensor(x)
+    spatial = x.shape[2:]
+    out_sizes = _norm(output_size, ndim)
+    ksizes = _norm(kernel_size, ndim) if kernel_size is not None \
+        else (0,) * ndim
+    if random_u is None:
+        u = float(jax.random.uniform(_rng.next_key(), ()))
+        u = min(max(u, 1e-3), 1 - 1e-3)
+    else:
+        u = float(random_u)
+        if not 0.0 < u < 1.0:
+            raise ValueError("random_u must be in (0, 1)")
+    bounds = [_fractional_bounds(spatial[d], out_sizes[d], u, ksizes[d])
+              for d in range(ndim)]
+
+    def fn(a):
+        # gather each pooled window with static slices (windows vary in
+        # size; out sizes are static, so this unrolls to out_size slices
+        # per axis — fine for the small output grids fractional pooling
+        # targets)
+        import itertools
+
+        out = jnp.zeros(a.shape[:2] + tuple(out_sizes), a.dtype)
+        for idx in itertools.product(*[range(o) for o in out_sizes]):
+            slices = (slice(None), slice(None)) + tuple(
+                slice(bounds[d][0][idx[d]], bounds[d][1][idx[d]])
+                for d in range(ndim))
+            win = a[slices]
+            red = win.max(axis=tuple(range(2, 2 + ndim)))
+            out = out.at[(slice(None), slice(None)) + idx].set(red)
+        return out
+
+    out = run_op(fn, [x], name=op_name)
+    if not return_mask:
+        return out
+    # mask: flat input-space index of each max (host-side argmax per window)
+    import numpy as np
+
+    a = np.asarray(unwrap(x))
+    mask = np.zeros(a.shape[:2] + tuple(out_sizes), np.int32)
+    import itertools
+
+    for idx in itertools.product(*[range(o) for o in out_sizes]):
+        slices = (slice(None), slice(None)) + tuple(
+            slice(bounds[d][0][idx[d]], bounds[d][1][idx[d]])
+            for d in range(ndim))
+        win = a[slices]
+        flat = win.reshape(win.shape[0], win.shape[1], -1)
+        am = flat.argmax(-1)
+        wshape = win.shape[2:]
+        coords = np.unravel_index(am, wshape)
+        flat_idx = np.zeros_like(am)
+        for d in range(ndim):
+            flat_idx = flat_idx * a.shape[2 + d] + (
+                coords[d] + bounds[d][0][idx[d]])
+        mask[(slice(None), slice(None)) + idx] = flat_idx
+    from ...core.tensor import Tensor
+
+    return out, Tensor(jnp.asarray(mask))
+
+
+def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    """reference: python/paddle/nn/functional/pooling.py:2087 — Graham
+    2014 fractional max pooling with the pseudo-random index sequence."""
+    return _fractional_max_pool(x, output_size, kernel_size, random_u,
+                                return_mask, 2, "fractional_max_pool2d")
+
+
+def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    """reference: python/paddle/nn/functional/pooling.py
+    fractional_max_pool3d."""
+    return _fractional_max_pool(x, output_size, kernel_size, random_u,
+                                return_mask, 3, "fractional_max_pool3d")
+
+
+__all__ += ["fractional_max_pool2d", "fractional_max_pool3d"]

@@ -192,3 +192,19 @@ class ThresholdedReLU(Layer):
 
     def forward(self, x):
         return F.thresholded_relu(x, self.threshold, self.value)
+
+
+class Softmax2D(Layer):
+    """Softmax over the channel axis of NCHW input (reference:
+    python/paddle/nn/layer/activation.py Softmax2D — axis=-3)."""
+
+    def __init__(self, name=None):
+        super().__init__()
+
+    def forward(self, x):
+        assert len(x.shape) in (3, 4), (
+            f"Softmax2D requires 3D/4D input, got {len(x.shape)}D")
+        return F.softmax(x, axis=-3)
+
+
+__all__ += ["Softmax2D"]

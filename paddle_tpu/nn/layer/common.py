@@ -391,3 +391,20 @@ class FeatureAlphaDropout(Layer):
     def forward(self, x):
         return F.alpha_dropout(x, p=self.p, training=self.training,
                                mask_ndim=2)
+
+
+class ZeroPad1D(Pad1D):
+    """reference: python/paddle/nn/layer/common.py ZeroPad1D."""
+
+    def __init__(self, padding, data_format="NCL", name=None):
+        super().__init__(padding, "constant", 0.0, data_format)
+
+
+class ZeroPad3D(Pad3D):
+    """reference: python/paddle/nn/layer/common.py ZeroPad3D."""
+
+    def __init__(self, padding, data_format="NCDHW", name=None):
+        super().__init__(padding, "constant", 0.0, data_format)
+
+
+__all__ += ["ZeroPad1D", "ZeroPad3D"]

@@ -205,3 +205,21 @@ class SoftMarginLoss(Layer):
 
     def forward(self, input, label):
         return F.soft_margin_loss(input, label, self.reduction)
+
+
+class RNNTLoss(Layer):
+    """reference: python/paddle/nn/layer/loss.py RNNTLoss."""
+
+    def __init__(self, blank=0, fastemit_lambda=0.001, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.blank = blank
+        self.fastemit_lambda = fastemit_lambda
+        self.reduction = reduction
+
+    def forward(self, input, label, input_lengths, label_lengths):
+        return F.rnnt_loss(input, label, input_lengths, label_lengths,
+                           self.blank, self.fastemit_lambda, self.reduction)
+
+
+__all__ += ["RNNTLoss"]

@@ -91,3 +91,40 @@ class AdaptiveMaxPool2D(_AdaptivePoolNd):
 class AdaptiveMaxPool3D(_AdaptivePoolNd):
     def forward(self, x):
         return F.adaptive_max_pool3d(x, self.output_size)
+
+
+class FractionalMaxPool2D(Layer):
+    """reference: python/paddle/nn/layer/pooling.py FractionalMaxPool2D."""
+
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        self.output_size = output_size
+        self.kernel_size = kernel_size
+        self.random_u = random_u
+        self.return_mask = return_mask
+
+    def forward(self, x):
+        return F.fractional_max_pool2d(x, self.output_size,
+                                       self.kernel_size, self.random_u,
+                                       self.return_mask)
+
+
+class FractionalMaxPool3D(Layer):
+    """reference: python/paddle/nn/layer/pooling.py FractionalMaxPool3D."""
+
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        self.output_size = output_size
+        self.kernel_size = kernel_size
+        self.random_u = random_u
+        self.return_mask = return_mask
+
+    def forward(self, x):
+        return F.fractional_max_pool3d(x, self.output_size,
+                                       self.kernel_size, self.random_u,
+                                       self.return_mask)
+
+
+__all__ += ["FractionalMaxPool2D", "FractionalMaxPool3D"]

@@ -390,3 +390,31 @@ class GRU(_MultiLayerRNN):
 
     def _zero_state(self, cell, batch):
         return cell.get_initial_states(batch, cell.hidden_size)
+
+
+class BiRNN(Layer):
+    """Bidirectional wrapper over two cells (reference:
+    python/paddle/nn/layer/rnn.py BiRNN): forward + backward passes,
+    outputs concatenated on the feature axis."""
+
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.cell_fw = cell_fw
+        self.cell_bw = cell_bw
+        self.time_major = time_major
+        self.rnn_fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.rnn_bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...ops.manipulation import concat
+
+        if initial_states is None:
+            states_fw = states_bw = None
+        else:
+            states_fw, states_bw = initial_states
+        out_fw, st_fw = self.rnn_fw(inputs, states_fw, sequence_length)
+        out_bw, st_bw = self.rnn_bw(inputs, states_bw, sequence_length)
+        return concat([out_fw, out_bw], axis=-1), (st_fw, st_bw)
+
+
+__all__ += ["RNNCellBase", "BiRNN"]

@@ -528,9 +528,14 @@ def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
 
 def pad(x, pad, mode="constant", value=0.0, data_format="NCDHW", name=None):
     x = as_tensor(x)
-    p = shape_arg(pad) if not isinstance(pad, (list, tuple)) else [
-        int(unwrap(v)) for v in pad
-    ]
+    if isinstance(pad, int):
+        # int padding pads every spatial dim on both sides (reference
+        # nn/functional/common.py pad)
+        p = [pad] * (2 * builtins.max(x.ndim - 2, 1))
+    else:
+        p = shape_arg(pad) if not isinstance(pad, (list, tuple)) else [
+            int(unwrap(v)) for v in pad
+        ]
 
     def fn(a):
         nd = a.ndim

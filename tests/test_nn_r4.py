@@ -1,0 +1,249 @@
+"""Round-4 nn / nn.functional parity additions (VERDICT r3 missing #1;
+reference: python/paddle/nn/functional/pooling.py:2087 fractional pooling,
+loss.py rnnt_loss, sparse_attention.py, flash_attention.py flashmask/
+varlen-qkvpacked, nn/decode.py BeamSearchDecoder:161/dynamic_decode:1238,
+layer/rnn.py BiRNN, container.py ParameterDict)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+F = nn.functional
+
+
+class TestFractionalMaxPool:
+    def test_2d_shapes_and_mask(self):
+        x = paddle.to_tensor(
+            np.arange(2 * 3 * 7 * 7, dtype=np.float32).reshape(2, 3, 7, 7))
+        out = F.fractional_max_pool2d(x, output_size=5, random_u=0.3)
+        assert tuple(out.shape) == (2, 3, 5, 5)
+        out2, mask = F.fractional_max_pool2d(x, 5, kernel_size=2,
+                                             random_u=0.3, return_mask=True)
+        flat = x.numpy().reshape(2, 3, -1)
+        np.testing.assert_allclose(
+            np.take_along_axis(flat, mask.numpy().reshape(2, 3, -1),
+                               -1).reshape(2, 3, 5, 5), out2.numpy())
+
+    def test_3d_and_grad(self):
+        x3 = paddle.to_tensor(
+            np.random.RandomState(0).randn(1, 2, 6, 7, 8).astype(np.float32))
+        o3 = F.fractional_max_pool3d(x3, output_size=(3, 4, 5), random_u=0.5)
+        assert tuple(o3.shape) == (1, 2, 3, 4, 5)
+        xx = paddle.to_tensor(
+            np.random.RandomState(1).randn(1, 1, 6, 6).astype(np.float32),
+            stop_gradient=False)
+        F.fractional_max_pool2d(xx, 3, random_u=0.4).sum().backward()
+        assert xx.grad.numpy().sum() == 9.0  # one max per output cell
+
+    def test_layers(self):
+        x = paddle.to_tensor(np.random.randn(1, 2, 8, 8).astype(np.float32))
+        assert tuple(nn.FractionalMaxPool2D(4, random_u=0.7)(x).shape) \
+            == (1, 2, 4, 4)
+        x3 = paddle.to_tensor(
+            np.random.randn(1, 2, 8, 8, 8).astype(np.float32))
+        assert tuple(nn.FractionalMaxPool3D(4, random_u=0.7)(x3).shape) \
+            == (1, 2, 4, 4, 4)
+
+
+def _brute_rnnt(logits, labels, blank=0):
+    """Exact RNNT loss by recursive lattice enumeration."""
+    from functools import lru_cache
+
+    T, U1, _ = logits.shape
+    U = U1 - 1
+    lp = logits - np.log(np.exp(logits).sum(-1, keepdims=True))
+
+    @lru_cache(None)
+    def go(t, u):
+        if t == T - 1 and u == U:
+            return lp[t, u, blank]
+        opts = []
+        if t < T - 1:
+            opts.append(lp[t, u, blank] + go(t + 1, u))
+        if u < U:
+            opts.append(lp[t, u, labels[u]] + go(t, u + 1))
+        return np.logaddexp.reduce(opts)
+
+    return -go(0, 0)
+
+
+class TestRNNTLoss:
+    def test_vs_brute_force(self):
+        rng = np.random.RandomState(0)
+        B, T, U, V = 3, 4, 3, 5
+        logits = rng.randn(B, T, U + 1, V).astype(np.float32)
+        labels = rng.randint(1, V, (B, U)).astype(np.int32)
+        tl = np.array([4, 3, 2], np.int32)
+        ul = np.array([3, 2, 1], np.int32)
+        got = F.rnnt_loss(paddle.to_tensor(logits), paddle.to_tensor(labels),
+                          paddle.to_tensor(tl), paddle.to_tensor(ul),
+                          reduction="none").numpy()
+        want = np.array([
+            _brute_rnnt(logits[b][:tl[b], :ul[b] + 1], tuple(labels[b][:ul[b]]))
+            for b in range(B)])
+        np.testing.assert_allclose(got, want, rtol=1e-4)
+
+    def test_layer_and_grad(self):
+        rng = np.random.RandomState(1)
+        logits = paddle.to_tensor(rng.randn(2, 3, 3, 4).astype(np.float32),
+                                  stop_gradient=False)
+        labels = paddle.to_tensor(rng.randint(1, 4, (2, 2)).astype(np.int32))
+        loss = nn.RNNTLoss()(logits, labels,
+                             paddle.to_tensor(np.full(2, 3, np.int32)),
+                             paddle.to_tensor(np.full(2, 2, np.int32)))
+        loss.backward()
+        assert np.isfinite(logits.grad.numpy()).all()
+
+
+class TestSparseAttention:
+    def test_banded_pattern_vs_dense(self):
+        rng = np.random.RandomState(0)
+        B, H, M, D = 1, 2, 4, 8
+        q, k, v = [rng.randn(B, H, M, D).astype(np.float32)
+                   for _ in range(3)]
+        offs, colsl = [0], []
+        for i in range(M):
+            cs = [max(0, i - 1), i] if i > 0 else [0]
+            colsl += cs
+            offs.append(len(colsl))
+        offset = np.tile(np.array(offs)[None, None], (B, H, 1)).astype(
+            np.int32)
+        cols = np.tile(np.array(colsl)[None, None], (B, H, 1)).astype(
+            np.int32)
+        out = F.sparse_attention(paddle.to_tensor(q), paddle.to_tensor(k),
+                                 paddle.to_tensor(v),
+                                 paddle.to_tensor(offset),
+                                 paddle.to_tensor(cols)).numpy()
+        s = np.einsum("bhmd,bhnd->bhmn", q, k) / np.sqrt(D)
+        mask = np.zeros((M, M), bool)
+        for i in range(M):
+            mask[i, max(0, i - 1):i + 1] = True
+        s = np.where(mask, s, -1e9)
+        p = np.exp(s) / np.exp(s).sum(-1, keepdims=True)
+        np.testing.assert_allclose(
+            out, np.einsum("bhmn,bhnd->bhmd", p * mask, v),
+            rtol=1e-4, atol=1e-5)
+
+
+class TestFlashmaskAttention:
+    def test_causal_column_mask(self):
+        rng = np.random.RandomState(0)
+        Sq = Sk = 6
+        q, k, v = [rng.randn(1, Sq, 2, 4).astype(np.float32)
+                   for _ in range(3)]
+        idx = np.full((1, 1, Sk, 1), Sq, np.int32)
+        idx[0, 0, 2, 0] = 4  # column 2: rows >= 4 masked
+        o = F.flashmask_attention(paddle.to_tensor(q), paddle.to_tensor(k),
+                                  paddle.to_tensor(v), paddle.to_tensor(idx),
+                                  causal=True).numpy()
+        sc = np.einsum("bqhd,bkhd->bhqk", q, k) / 2.0
+        allow = np.tril(np.ones((Sq, Sk), bool))
+        allow[4:, 2] = False
+        sc = np.where(allow, sc, -1e9)
+        pr = np.exp(sc) / np.exp(sc).sum(-1, keepdims=True)
+        np.testing.assert_allclose(
+            o, np.einsum("bhqk,bkhd->bqhd", pr, v), rtol=1e-4, atol=1e-5)
+
+    def test_bidirectional_matches_plain_when_unmasked(self):
+        rng = np.random.RandomState(1)
+        q, k, v = [rng.randn(1, 5, 2, 4).astype(np.float32)
+                   for _ in range(3)]
+        # lt start = Sq (nothing masked below), ut end = 0 (nothing above)
+        idx = np.zeros((1, 1, 5, 2), np.int32)
+        idx[..., 0] = 5
+        idx[..., 1] = 0
+        o = F.flashmask_attention(paddle.to_tensor(q), paddle.to_tensor(k),
+                                  paddle.to_tensor(v),
+                                  paddle.to_tensor(idx)).numpy()
+        sc = np.einsum("bqhd,bkhd->bhqk", q, k) / 2.0
+        pr = np.exp(sc) / np.exp(sc).sum(-1, keepdims=True)
+        np.testing.assert_allclose(
+            o, np.einsum("bhqk,bkhd->bqhd", pr, v), rtol=1e-4, atol=1e-5)
+
+
+def test_flash_attn_varlen_qkvpacked():
+    rng = np.random.RandomState(0)
+    qkv = rng.randn(10, 3, 2, 4).astype(np.float32)
+    cu = np.array([0, 4, 10], np.int32)
+    out, _ = F.flash_attn_varlen_qkvpacked(
+        paddle.to_tensor(qkv), paddle.to_tensor(cu), paddle.to_tensor(cu),
+        6, 6)
+    assert tuple(out.shape) == (10, 2, 4)
+    # first segment must equal attention over its own tokens only
+    q, k, v = qkv[:4, 0], qkv[:4, 1], qkv[:4, 2]
+    s = np.einsum("qhd,khd->hqk", q, k) / 2.0
+    p = np.exp(s) / np.exp(s).sum(-1, keepdims=True)
+    np.testing.assert_allclose(out.numpy()[:4],
+                               np.einsum("hqk,khd->qhd", p, v),
+                               rtol=1e-3, atol=1e-4)
+
+
+class TestDecode:
+    def _toy(self):
+        import jax.numpy as jnp
+
+        class ToyCell(nn.Layer):
+            vocab = 6
+
+            def forward(self, ids, states):
+                step = states._data
+                tgt = jnp.where(step[0] >= 3, 5, (step[0] + 1) % self.vocab)
+                logits = jnp.full((ids.shape[0], self.vocab), -5.0)
+                logits = logits.at[:, tgt].set(5.0)
+                return paddle.to_tensor(logits), paddle.to_tensor(step + 1)
+
+        return ToyCell()
+
+    def test_beam_search_decodes_greedy_path(self):
+        dec = nn.BeamSearchDecoder(self._toy(), start_token=0, end_token=5,
+                                   beam_size=3)
+        out_ids, _, lens = nn.dynamic_decode(
+            dec, inits=paddle.to_tensor(np.zeros((2,), np.int32)),
+            max_step_num=8, return_length=True)
+        seq = out_ids.numpy()
+        assert (seq[:, :4, 0] == np.array([[1, 2, 3, 5]] * 2)).all()
+
+    def test_tile_beam_merge(self):
+        x = paddle.to_tensor(np.array([[1.0, 2.0], [3.0, 4.0]], np.float32))
+        t = nn.BeamSearchDecoder.tile_beam_merge_with_batch(x, 3)
+        assert tuple(t.shape) == (6, 2)
+        np.testing.assert_allclose(t.numpy()[:3], [[1, 2]] * 3)
+
+
+def test_birnn():
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 5, 4).astype(np.float32)
+    bi = nn.BiRNN(nn.SimpleRNNCell(4, 8), nn.SimpleRNNCell(4, 8))
+    out, (st_f, st_b) = bi(paddle.to_tensor(x))
+    assert tuple(out.shape) == (2, 5, 16)
+    # forward half equals the plain forward RNN over the same cell
+    fwd_out, _ = nn.RNN(bi.cell_fw)(paddle.to_tensor(x))
+    np.testing.assert_allclose(out.numpy()[..., :8], fwd_out.numpy(),
+                               rtol=1e-5)
+
+
+def test_small_layers():
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(2, 3, 4, 5).astype(np.float32))
+    np.testing.assert_allclose(nn.Softmax2D()(x).numpy().sum(1), 1.0,
+                               atol=1e-5)
+    assert tuple(nn.ZeroPad1D(2)(paddle.to_tensor(
+        rng.randn(1, 2, 5).astype(np.float32))).shape) == (1, 2, 9)
+    assert tuple(nn.ZeroPad3D(1)(paddle.to_tensor(
+        rng.randn(1, 2, 3, 4, 5).astype(np.float32))).shape) == (1, 2, 5, 6, 7)
+    pd = nn.ParameterDict({"w": nn.Parameter(paddle.to_tensor([1.0])._data)})
+    assert "w" in pd and len(pd) == 1
+    for k in pd:
+        assert k == "w"
+    del pd["w"]
+    assert len(pd) == 0
+
+
+def test_functional_tanh_inplace():
+    x = paddle.to_tensor([0.5, -0.5], stop_gradient=False)
+    y = x * 1.0
+    F.tanh_(y)
+    np.testing.assert_allclose(y.numpy(), np.tanh([0.5, -0.5]), rtol=1e-5)
+    y.sum().backward()
+    assert np.isfinite(x.grad.numpy()).all()
