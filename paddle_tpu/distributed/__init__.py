@@ -81,3 +81,28 @@ from .parallel import DataParallel  # noqa: F401
 from .sharding import group_sharded_parallel, save_group_sharded_model  # noqa: F401
 from . import launch  # noqa: F401
 from .spawn import spawn  # noqa: F401
+
+# ---- round-4 parity exports (reference distributed/__init__.py __all__) ----
+from . import io  # noqa: F401
+from .extras import (  # noqa: F401
+    CountFilterEntry,
+    EntryAttr,
+    ProbabilityEntry,
+    ReduceType,
+    ShowClickEntry,
+    alltoall_single,
+    gloo_barrier,
+    gloo_init_parallel_env,
+    gloo_release,
+    is_available,
+    shard_scaler,
+    split,
+)
+from .fleet_dataset import InMemoryDataset, QueueDataset  # noqa: F401
+from .fleet.topology import ParallelMode  # noqa: F401
+from .auto_parallel.api import (  # noqa: F401
+    ShardingStage1,
+    ShardingStage2,
+    ShardingStage3,
+)
+from .checkpoint import load_state_dict, save_state_dict  # noqa: F401

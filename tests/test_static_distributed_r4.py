@@ -1,0 +1,196 @@
+"""Round-4 static + distributed API completions (reference:
+python/paddle/static/__init__.py, base/backward.py append_backward/
+gradients, static/ema.py, nn/metric.py, distributed/__init__.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+
+
+@pytest.fixture
+def static_mode():
+    paddle.enable_static()
+    prog = static.Program()
+    with static.program_guard(prog):
+        yield prog
+    paddle.disable_static()
+
+
+class TestGradients:
+    def test_gradients_wrt_feed(self, static_mode):
+        x = static.data("x", [3], "float32")
+        y = (x * x).sum()
+        (gx,) = static.gradients([y], [x])
+        exe = static.Executor()
+        out = exe.run(feed={"x": np.array([1.0, 2.0, 3.0], np.float32)},
+                      fetch_list=[y, gx])
+        np.testing.assert_allclose(out[0], 14.0, rtol=1e-6)
+        np.testing.assert_allclose(out[1], [2.0, 4.0, 6.0], rtol=1e-6)
+
+    def test_append_backward(self, static_mode):
+        from paddle_tpu import nn
+
+        x = static.data("x", [2, 4], "float32")
+        lin = nn.Linear(4, 1)
+        loss = (lin(x) ** 2).mean()
+        pairs = static.append_backward(loss)
+        assert len(pairs) == 2  # weight + bias
+        exe = static.Executor()
+        feed = {"x": np.ones((2, 4), np.float32)}
+        fetch = [loss] + [g for _, g in pairs]
+        outs = exe.run(feed=feed, fetch_list=fetch)
+        # numeric check vs eager grad
+        xe = paddle.to_tensor(feed["x"])
+        le = (lin(xe) ** 2).mean()
+        le.backward()
+        np.testing.assert_allclose(outs[1], lin.weight.grad.numpy(),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(outs[2], lin.bias.grad.numpy(),
+                                   rtol=1e-5)
+
+
+class TestStaticMisc:
+    def test_accuracy_auc(self, static_mode):
+        pred = static.data("pred", [4, 3], "float32")
+        p = np.array([[0.8, 0.1, 0.1], [0.2, 0.7, 0.1],
+                      [0.1, 0.2, 0.7], [0.6, 0.3, 0.1]], np.float32)
+        lab = np.array([0, 1, 0, 1], np.int32)
+        acc = static.accuracy(pred, paddle.to_tensor(lab.reshape(-1, 1)))
+        exe = static.Executor()
+        out = exe.run(feed={"pred": p}, fetch_list=[acc])
+        np.testing.assert_allclose(out[0], 0.5)
+        # auc on binary scores
+        prog2 = static.Program()
+        with static.program_guard(prog2):
+            s = static.data("s", [4], "float32")
+            a, _, _ = static.auc(s, paddle.to_tensor(
+                np.array([1, 0, 1, 0], np.int32)))
+            sc = np.array([0.9, 0.3, 0.8, 0.4], np.float32)
+            got = static.Executor().run(feed={"s": sc}, fetch_list=[a])[0]
+        np.testing.assert_allclose(got, 1.0)  # perfectly separated
+
+    def test_scope_and_guards(self):
+        sc = static.Scope() if hasattr(static, "Scope") else None
+        g = static.global_scope()
+        v = g.var("w")
+        v.set(np.ones(3))
+        assert static.global_scope().find_var("w") is not None
+        with static.name_scope("blk"):
+            pass
+        with static.device_guard("cpu"):
+            pass
+        assert static.cpu_places()
+
+    def test_program_state_roundtrip(self, static_mode, tmp_path):
+        from paddle_tpu import nn
+
+        x = static.data("x", [2, 3], "float32")
+        lin = nn.Linear(3, 2)
+        loss = lin(x).mean()
+        opt = paddle.optimizer.SGD(learning_rate=0.1)
+        opt.minimize(loss)
+        exe = static.Executor()
+        exe.run(feed={"x": np.ones((2, 3), np.float32)},
+                fetch_list=[loss])
+        prog = static.default_main_program()
+        path = str(tmp_path / "model")
+        static.save(prog, path)
+        w0 = lin.weight.numpy().copy()
+        lin.weight._data = lin.weight._data * 0
+        static.load(prog, path)
+        np.testing.assert_allclose(lin.weight.numpy(), w0)
+        state = static.load_program_state(path)
+        assert any(np.asarray(v).size for v in state.values())
+
+    def test_serialize_program_roundtrip(self, static_mode):
+        static.data("inp", [4, 4], "float32")
+        blob = static.serialize_program()
+        prog2 = static.deserialize_program(blob)
+        assert "inp" in prog2._feed_leaves
+
+    def test_py_func(self, static_mode):
+        x = static.data("x", [3], "float32")
+
+        def double(a):
+            return a * 2
+
+        def double_bwd(a, g):
+            return g * 2
+
+        out_spec = paddle.to_tensor(np.zeros(3, np.float32))
+        y = static.py_func(double, x, out_spec, backward_func=double_bwd)
+        (gx,) = static.gradients([y.sum()], [x])
+        outs = static.Executor().run(
+            feed={"x": np.array([1.0, 2.0, 3.0], np.float32)},
+            fetch_list=[y, gx])
+        np.testing.assert_allclose(outs[0], [2.0, 4.0, 6.0])
+        np.testing.assert_allclose(outs[1], [2.0, 2.0, 2.0])
+
+    def test_ema(self):
+        from paddle_tpu import nn
+
+        lin = nn.Linear(2, 2)
+        ema = static.ExponentialMovingAverage(0.5)
+        w0 = lin.weight.numpy().copy()
+        ema.update(lin.parameters())
+        lin.weight._data = lin.weight._data + 1.0
+        ema.update()
+        with ema.apply():
+            # shadow = 0.5*w0 + 0.5*(w0+1)
+            np.testing.assert_allclose(lin.weight.numpy(), w0 + 0.5,
+                                       rtol=1e-5)
+        np.testing.assert_allclose(lin.weight.numpy(), w0 + 1.0, rtol=1e-5)
+
+    def test_ipu_stubs_raise(self):
+        with pytest.raises(RuntimeError, match="IPU"):
+            static.IpuStrategy()
+        with pytest.raises(RuntimeError, match="IPU"):
+            static.ipu_shard_guard()
+
+    def test_print_identity(self, static_mode):
+        x = static.data("x", [2], "float32")
+        y = static.Print(x, message="dbg")
+        out = static.Executor().run(
+            feed={"x": np.array([1.0, 2.0], np.float32)}, fetch_list=[y])
+        np.testing.assert_allclose(out[0], [1.0, 2.0])
+
+
+class TestDistributedExtras:
+    def test_reduce_type_and_entries(self):
+        d = paddle.distributed
+        assert d.ReduceType.kRedSum == 0 and d.is_available()
+        assert d.ProbabilityEntry(0.5)._to_attr() == "probability_entry:0.5"
+        assert d.CountFilterEntry(3)._to_attr() == "count_filter_entry:3"
+        assert d.ShowClickEntry("s", "c")._to_attr() == \
+            "show_click_entry:s:c"
+        with pytest.raises(ValueError):
+            d.ProbabilityEntry(2.0)
+
+    def test_datasets(self, tmp_path):
+        f = tmp_path / "part-0.txt"
+        f.write_text("1 2 3\n4 5 6\n7 8 9\n")
+        ds = paddle.distributed.InMemoryDataset()
+        ds.init(batch_size=2)
+        ds.set_filelist([str(f)])
+        ds.load_into_memory()
+        assert ds.get_memory_data_size() == 3
+        ds.local_shuffle()
+        batches = list(ds)
+        assert sum(b.shape[0] for b in batches) == 3
+        qs = paddle.distributed.QueueDataset()
+        qs.init(batch_size=2)
+        qs.set_filelist([str(f)])
+        assert sum(b.shape[0] for b in qs) == 3
+        ds.release_memory()
+        assert ds.get_memory_data_size() == 0
+
+    def test_checkpoint_reexports(self):
+        assert paddle.distributed.save_state_dict is not None
+        assert paddle.distributed.load_state_dict is not None
+        assert paddle.distributed.ShardingStage2 is not None
+        assert paddle.distributed.ParallelMode.TENSOR_PARALLEL == 1
+
+    def test_io_module(self):
+        assert paddle.distributed.io.is_persistable(
+            type("V", (), {"persistable": True})())
