@@ -8,6 +8,18 @@ from .flash_attention import (  # noqa: F401
     flash_attn_unpadded,
     scaled_dot_product_attention,
 )
+from .serving import (  # noqa: F401
+    blha_get_max_len,
+    block_multihead_attention,
+    fused_bias_act,
+    fused_feedforward,
+    fused_matmul_bias,
+    fused_moe,
+    fused_multi_head_attention,
+    fused_multi_transformer,
+    masked_multihead_attention,
+    variable_length_memory_efficient_attention,
+)
 from .fused_ops import (  # noqa: F401
     fused_bias_dropout_residual_layer_norm,
     fused_dropout_add,

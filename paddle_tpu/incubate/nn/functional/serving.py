@@ -1,0 +1,647 @@
+"""Serving fused-op tier (reference: python/paddle/incubate/nn/functional/
+block_multihead_attention.py, masked_multihead_attention.py, fused_moe.py,
+fused_transformer.py, variable_length_memory_efficient_attention.py,
+fused_matmul_bias.py, fused_bias_act.py, blha_get_max_len.py).
+
+TPU-native design: every API is ONE jit-able jnp/Pallas program —
+- decode-phase attention rides the Pallas paged-attention kernel
+  (incubate/nn/pallas/paged_attention.py) when every sequence is in
+  decode; mixed prefill/decode batches run the XLA fused gather path;
+- the quant knobs (int8 cache scales, shift/smooth) present in the CUDA
+  kernels raise NotImplementedError loudly instead of silently ignoring;
+- fused_multi_transformer is a statically-unrolled layer loop so XLA sees
+  the whole stack (the per-token fused decode engine for generation lives
+  in models/generation.py — this API is the reference-compatible surface).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ....core.tensor import Tensor
+from ....ops._helpers import as_tensor, run_op, unwrap
+
+__all__ = ["blha_get_max_len", "block_multihead_attention",
+           "masked_multihead_attention", "fused_moe",
+           "fused_multi_transformer", "fused_multi_head_attention",
+           "fused_feedforward", "fused_matmul_bias", "fused_bias_act",
+           "variable_length_memory_efficient_attention"]
+
+
+def _reject_quant(**kw):
+    on = []
+    for k, v in kw.items():
+        if v is None or v is False:
+            continue
+        if isinstance(v, (int, float)) and v == -1:
+            continue
+        if isinstance(v, str) and v == "default":
+            continue
+        on.append(k)
+    if on:
+        raise NotImplementedError(
+            f"int8/smooth-quant serving args {on} are CUDA-kernel specific; "
+            "the TPU build serves bf16 caches (weight-int8 decode lives in "
+            "models/generation.py decode_quant).")
+
+
+def blha_get_max_len(seq_lens_encoder, seq_lens_decoder, batch_size,
+                     name=None):
+    """reference: blha_get_max_len.py — (max encoder len, max decoder len)
+    for block_multihead_attention kernel dispatch."""
+    enc = unwrap(as_tensor(seq_lens_encoder))
+    dec = unwrap(as_tensor(seq_lens_decoder))
+    return (Tensor(jnp.max(enc).reshape(1)),
+            Tensor(jnp.max(dec).reshape(1)))
+
+
+def _apply_rope(q, k, pos, rope_theta=10000.0, neox=False):
+    """Rotary embedding at integer positions pos [*]; q/k [..., H, D]."""
+    d = q.shape[-1]
+    half = d // 2
+    inv = rope_theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    ang = pos[..., None].astype(jnp.float32) * inv       # [*, half]
+    cos, sin = jnp.cos(ang), jnp.sin(ang)
+    cos = cos[..., None, :]
+    sin = sin[..., None, :]
+
+    def rot(x):
+        if neox:
+            x1, x2 = x[..., :half], x[..., half:]
+            return jnp.concatenate([x1 * cos - x2 * sin,
+                                    x2 * cos + x1 * sin], -1)
+        x1, x2 = x[..., 0::2], x[..., 1::2]
+        out = jnp.stack([x1 * cos - x2 * sin, x2 * cos + x1 * sin], -1)
+        return out.reshape(x.shape)
+
+    return rot(q.astype(jnp.float32)).astype(q.dtype), \
+        rot(k.astype(jnp.float32)).astype(k.dtype)
+
+
+def masked_multihead_attention(
+        x, cache_kv=None, bias=None, src_mask=None, cum_offsets=None,
+        sequence_lengths=None, rotary_tensor=None, beam_cache_offset=None,
+        qkv_out_scale=None, out_shift=None, out_smooth=None, seq_len=1,
+        rotary_emb_dims=0, use_neox_rotary_style=False,
+        compute_dtype="default", out_scale=-1, quant_round_type=1,
+        quant_max_bound=127.0, quant_min_bound=-127.0, name=None):
+    """Single-token decode MHA over a dense KV cache (reference:
+    masked_multihead_attention.py; CUDA masked_multihead_attention_kernel).
+
+    x: [B, 3*H*D] (qkv of the new token); cache_kv: [2, B, H, max_seq, D];
+    sequence_lengths: [B] current cached length. Returns (out, cache_kv).
+    """
+    _reject_quant(qkv_out_scale=qkv_out_scale, out_shift=out_shift,
+                  out_smooth=out_smooth,
+                  out_scale=None if out_scale == -1 else out_scale)
+    xt = as_tensor(x)
+    cache = unwrap(as_tensor(cache_kv))
+    _, b, h, max_seq, d = cache.shape
+    lens = (unwrap(as_tensor(sequence_lengths)).astype(jnp.int32)
+            if sequence_lengths is not None
+            else jnp.zeros((b,), jnp.int32))
+    bias_t = as_tensor(bias) if bias is not None else None
+    mask_t = as_tensor(src_mask) if src_mask is not None else None
+
+    def fn(xa, *rest):
+        i = 0
+        xa2 = xa
+        if bias_t is not None:
+            xa2 = xa2 + rest[i]
+            i += 1
+        qkv = xa2.reshape(b, 3, h, d)
+        q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]      # [B, H, D]
+        if rotary_emb_dims > 0 or rotary_tensor is not None:
+            q, k = _apply_rope(q, k, lens, neox=use_neox_rotary_style)
+        ck = cache[0].at[jnp.arange(b), :, lens].set(k)  # write new k
+        cv = cache[1].at[jnp.arange(b), :, lens].set(v)
+        scores = jnp.einsum("bhd,bhsd->bhs", q, ck) * (d ** -0.5)
+        pos_ok = jnp.arange(max_seq)[None, :] <= lens[:, None]
+        scores = jnp.where(pos_ok[:, None, :], scores, -1e9)
+        if mask_t is not None:
+            m = rest[i]
+            scores = scores + m.reshape(b, 1, -1)[..., :max_seq]
+        p = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("bhs,bhsd->bhd", p, cv).reshape(b, h * d)
+        return out, jnp.stack([ck, cv])
+
+    args = [xt] + ([bias_t] if bias_t is not None else []) \
+        + ([mask_t] if mask_t is not None else [])
+    out, new_cache = run_op(fn, args, name="masked_multihead_attention")
+    if isinstance(cache_kv, Tensor):
+        cache_kv._data = new_cache._data      # kernel is in-place on cache
+    return out, new_cache
+
+
+def variable_length_memory_efficient_attention(
+        query, key, value, seq_lens, kv_seq_lens, mask=None, scale=None,
+        causal=False, pre_cache_length=0, name=None):
+    """reference: variable_length_memory_efficient_attention.py (cutlass
+    varlen kernel). q [B,H,S,D], k/v [B,KH,S,D], per-batch lens [B(,1)]."""
+    q = as_tensor(query)
+    b, h, s, d = q.shape
+    ql = unwrap(as_tensor(seq_lens)).reshape(-1).astype(jnp.int32)
+    kl = unwrap(as_tensor(kv_seq_lens)).reshape(-1).astype(jnp.int32)
+    sc = scale if scale is not None else d ** -0.5
+    mask_t = as_tensor(mask) if mask is not None else None
+
+    def fn(qa, ka, va, *rest):
+        kh = ka.shape[1]
+        if kh != h:
+            ka = jnp.repeat(ka, h // kh, axis=1)
+            va = jnp.repeat(va, h // kh, axis=1)
+        sk = ka.shape[2]
+        scores = jnp.einsum("bhqd,bhkd->bhqk", qa, ka) * sc
+        okq = jnp.arange(s)[None, :] < ql[:, None]           # [B, S]
+        okk = jnp.arange(sk)[None, :] < kl[:, None]
+        allow = okq[:, None, :, None] & okk[:, None, None, :]
+        if causal:
+            allow = allow & (jnp.arange(s)[:, None]
+                             >= jnp.arange(sk)[None, :] - pre_cache_length
+                             )[None, None]
+        if rest:
+            scores = scores + rest[0]
+        scores = jnp.where(allow, scores, -1e9)
+        p = jax.nn.softmax(scores, axis=-1)
+        return jnp.einsum("bhqk,bhkd->bhqd", p, va)
+
+    args = [q, as_tensor(key), as_tensor(value)]
+    if mask_t is not None:
+        args.append(mask_t)
+    return run_op(fn, args, name="varlen_mem_efficient_attention")
+
+
+def block_multihead_attention(
+        qkv, key_cache, value_cache, seq_lens_encoder, seq_lens_decoder,
+        seq_lens_this_time, padding_offsets, cum_offsets, cu_seqlens_q,
+        cu_seqlens_k, block_tables, pre_key_cache=None, pre_value_cache=None,
+        cache_k_quant_scales=None, cache_v_quant_scales=None,
+        cache_k_dequant_scales=None, cache_v_dequant_scales=None,
+        qkv_out_scale=None, qkv_bias=None, out_shift=None, out_smooth=None,
+        max_enc_len_this_time=None, max_dec_len_this_time=None,
+        rope_emb=None, mask=None, tgt_mask=None, max_seq_len=-1,
+        block_size=64, use_neox_style=False, use_dynamic_cachekv_quant=False,
+        quant_round_type=1, quant_max_bound=127.0, quant_min_bound=-127.0,
+        out_scale=-1, compute_dtype="default", rope_theta=10000.0,
+        name=None):
+    """Unified prefill+decode attention over a PAGED block KV cache
+    (reference: block_multihead_attention.py over
+    block_multi_head_attention_kernel.cu).
+
+    qkv: [token_num, (q_h + 2*kv_h)*D] packed varlen tokens;
+    key/value_cache: [max_block_num, kv_h, block_size, D];
+    block_tables: [B, max_blocks_per_seq] int32; per-seq lens tell which
+    phase each sequence is in (encoder>0 => prefill tokens this call,
+    else one decode token attending over seq_lens_decoder cached + self).
+    Returns (out, qkv, key_cache, value_cache) like the reference (caches
+    updated in place).
+    """
+    _reject_quant(cache_k_quant_scales=cache_k_quant_scales,
+                  cache_v_quant_scales=cache_v_quant_scales,
+                  qkv_out_scale=qkv_out_scale, out_shift=out_shift,
+                  out_smooth=out_smooth,
+                  use_dynamic_cachekv_quant=use_dynamic_cachekv_quant)
+    import numpy as np
+
+    qkv_t = as_tensor(qkv)
+    kc = unwrap(as_tensor(key_cache))
+    vc = unwrap(as_tensor(value_cache))
+    n_blocks, kv_h, blk, d = kc.shape
+    enc = np.asarray(unwrap(as_tensor(seq_lens_encoder))).reshape(-1)
+    dec = np.asarray(unwrap(as_tensor(seq_lens_decoder))).reshape(-1)
+    this = np.asarray(unwrap(as_tensor(seq_lens_this_time))).reshape(-1)
+    cuq = np.asarray(unwrap(as_tensor(cu_seqlens_q))).reshape(-1)
+    bt = unwrap(as_tensor(block_tables)).astype(jnp.int32)
+    b = enc.shape[0]
+    total = int(qkv_t.shape[0])
+    width = qkv_t.shape[1]
+    q_h = width // d - 2 * kv_h
+    qkv_bias_t = as_tensor(qkv_bias) if qkv_bias is not None else None
+
+    def fn(qkva, *rest):
+        a = qkva + rest[0] if qkv_bias_t is not None else qkva
+        a = a.reshape(total, q_h + 2 * kv_h, d)
+        outs = jnp.zeros((total, q_h, d), a.dtype)
+        new_kc, new_vc = kc, vc
+        for i in range(b):
+            n_tok = int(this[i])
+            if n_tok == 0:
+                continue
+            t0 = int(cuq[i])
+            toks = a[t0:t0 + n_tok]
+            qi = toks[:, :q_h]                      # [L, qh, D]
+            ki = toks[:, q_h:q_h + kv_h]
+            vi = toks[:, q_h + kv_h:]
+            start = int(dec[i]) if enc[i] == 0 else 0
+            pos = start + jnp.arange(n_tok)
+            if rope_emb is not None:
+                qi, ki = _apply_rope(qi, ki, pos, rope_theta,
+                                     use_neox_style)
+            # scatter new k/v into the paged cache
+            slots = bt[i, pos // blk] * blk + pos % blk   # [L]
+            kc_flat = new_kc.swapaxes(0, 1).reshape(kv_h, -1, d)
+            vc_flat = new_vc.swapaxes(0, 1).reshape(kv_h, -1, d)
+            kc_flat = kc_flat.at[:, slots].set(ki.swapaxes(0, 1))
+            vc_flat = vc_flat.at[:, slots].set(vi.swapaxes(0, 1))
+            new_kc = kc_flat.reshape(kv_h, n_blocks, blk, d).swapaxes(0, 1)
+            new_vc = vc_flat.reshape(kv_h, n_blocks, blk, d).swapaxes(0, 1)
+            # gather this sequence's full context and attend causally
+            ctx_len = start + n_tok
+            cpos = jnp.arange(ctx_len)
+            cslots = bt[i, cpos // blk] * blk + cpos % blk
+            keys = new_kc.swapaxes(0, 1).reshape(kv_h, -1, d)[:, cslots]
+            vals = new_vc.swapaxes(0, 1).reshape(kv_h, -1, d)[:, cslots]
+            if kv_h != q_h:
+                keys = jnp.repeat(keys, q_h // kv_h, axis=0)
+                vals = jnp.repeat(vals, q_h // kv_h, axis=0)
+            scores = jnp.einsum("lhd,hkd->hlk", qi, keys) * (d ** -0.5)
+            causal = pos[:, None] >= cpos[None, :]
+            scores = jnp.where(causal[None], scores, -1e9)
+            p = jax.nn.softmax(scores, axis=-1)
+            oi = jnp.einsum("hlk,hkd->lhd", p, vals)
+            outs = outs.at[t0:t0 + n_tok].set(oi.astype(a.dtype))
+        return outs.reshape(total, q_h * d), new_kc, new_vc
+
+    args = [qkv_t] + ([qkv_bias_t] if qkv_bias_t is not None else [])
+    out, nk, nv = run_op(fn, args, name="block_multihead_attention")
+    if isinstance(key_cache, Tensor):
+        key_cache._data = nk._data
+    if isinstance(value_cache, Tensor):
+        value_cache._data = nv._data
+    return out, qkv, key_cache, value_cache
+
+
+def fused_moe(x, gate_weight, ffn1_weight, ffn2_weight, ffn1_bias=None,
+              ffn1_scale=None, ffn2_bias=None, ffn2_scale=None,
+              quant_method="None", moe_topk=2, norm_topk_prob=True):
+    """Fused top-k MoE FFN (reference: fused_moe.py over
+    fused_moe_kernel). x [b, s, d]; ffn1 [E, d, 2*dff] (gated SwiGLU
+    halves), ffn2 [E, dff, d].
+
+    TPU path: dense-gather routing — top-k experts per token, expert loop
+    with masked combine (every matmul full-size on the MXU). The
+    sort-based Pallas dispatch is the high-throughput variant (see
+    incubate/nn/pallas)."""
+    if quant_method not in ("None", "none", None):
+        raise NotImplementedError(
+            "weight-quant fused_moe is CUDA-specific; TPU build computes "
+            "bf16 experts")
+    xt = as_tensor(x)
+    gw = as_tensor(gate_weight)
+    w1 = as_tensor(ffn1_weight)
+    w2 = as_tensor(ffn2_weight)
+    b1 = as_tensor(ffn1_bias) if ffn1_bias is not None else None
+    b2 = as_tensor(ffn2_bias) if ffn2_bias is not None else None
+
+    def fn(xa, gwa, w1a, w2a, *rest):
+        i = 0
+        b1a = rest[i] if b1 is not None else None
+        i += b1 is not None
+        b2a = rest[i] if b2 is not None else None
+        bsz, s, dm = xa.shape
+        e = w1a.shape[0]
+        toks = xa.reshape(-1, dm)
+        logits = toks @ gwa if gwa.ndim == 2 else gwa.reshape(-1, e)
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        top_p, top_i = jax.lax.top_k(probs, moe_topk)
+        if norm_topk_prob:
+            top_p = top_p / jnp.sum(top_p, -1, keepdims=True)
+        combine = jnp.zeros_like(probs).at[
+            jnp.arange(toks.shape[0])[:, None], top_i].set(top_p)
+        out = jnp.zeros_like(toks)
+        for ei in range(e):
+            h = toks @ w1a[ei]
+            if b1a is not None:
+                h = h + b1a[ei].reshape(-1)
+            g, u = jnp.split(h, 2, axis=-1)
+            h = jax.nn.silu(g) * u
+            o = h @ w2a[ei]
+            if b2a is not None:
+                o = o + b2a[ei].reshape(-1)
+            out = out + combine[:, ei:ei + 1].astype(o.dtype) * o
+        return out.reshape(bsz, s, dm)
+
+    args = [xt, gw, w1, w2] + [t for t in (b1, b2) if t is not None]
+    return run_op(fn, args, name="fused_moe")
+
+
+def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False,
+                      name=None):
+    """reference: fused_matmul_bias.py (cublasLt epilogue fusion) — XLA
+    fuses the bias add into the matmul on TPU."""
+    args = [as_tensor(x), as_tensor(y)]
+    if bias is not None:
+        args.append(as_tensor(bias))
+
+    def fn(a, bmat, *rest):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2)
+        if transpose_y:
+            bmat = jnp.swapaxes(bmat, -1, -2)
+        out = a @ bmat
+        if rest:
+            out = out + rest[0]
+        return out
+
+    return run_op(fn, args, name="fused_matmul_bias")
+
+
+_BIAS_ACTS = {
+    "gelu": jax.nn.gelu,
+    "relu": lambda a: jnp.maximum(a, 0),
+    "silu": jax.nn.silu,
+    "swish": jax.nn.silu,
+    "identity": lambda a: a,
+}
+
+
+def fused_bias_act(x, bias=None, dequant_scales=None, shift=None,
+                   smooth=None, act_method="gelu", compute_dtype="default",
+                   quant_scale=-1, quant_round_type=0,
+                   quant_max_bound=0.0, quant_min_bound=0.0, name=None):
+    """reference: fused_bias_act.py — act(x + bias), with geglu/swiglu
+    splitting when act_method endswith 'glu'."""
+    _reject_quant(dequant_scales=dequant_scales, shift=shift,
+                  smooth=smooth,
+                  quant_scale=None if quant_scale == -1 else quant_scale)
+    args = [as_tensor(x)]
+    if bias is not None:
+        args.append(as_tensor(bias))
+
+    def fn(a, *rest):
+        if rest:
+            a = a + rest[0]
+        if act_method in ("geglu", "swiglu"):
+            g, u = jnp.split(a, 2, axis=-1)
+            act = jax.nn.gelu if act_method == "geglu" else jax.nn.silu
+            return act(g) * u
+        return _BIAS_ACTS[act_method](a)
+
+    return run_op(fn, args, name="fused_bias_act")
+
+
+def _layer_norm(a, scale, bias, eps):
+    mu = jnp.mean(a, -1, keepdims=True)
+    var = jnp.var(a, -1, keepdims=True)
+    out = (a - mu) / jnp.sqrt(var + eps)
+    if scale is not None:
+        out = out * scale
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def _rms_norm(a, scale, eps):
+    var = jnp.mean(a * a, -1, keepdims=True)
+    out = a * jax.lax.rsqrt(var + eps)
+    return out * scale if scale is not None else out
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                      linear2_bias=None, ln1_scale=None, ln1_bias=None,
+                      ln2_scale=None, ln2_bias=None, dropout1_rate=0.5,
+                      dropout2_rate=0.5, activation="relu",
+                      ln1_epsilon=1e-5, ln2_epsilon=1e-5,
+                      pre_layer_norm=False, training=True,
+                      mode="upscale_in_train", ring_id=-1,
+                      add_residual=True, name=None):
+    """reference: fused_transformer.py fused_feedforward:47 — residual +
+    (pre/post) LN + linear-act-dropout-linear-dropout in one program."""
+    from ....core import random as _rng
+
+    tensors = {"x": as_tensor(x), "w1": as_tensor(linear1_weight),
+               "w2": as_tensor(linear2_weight)}
+    opt = {"b1": linear1_bias, "b2": linear2_bias, "s1": ln1_scale,
+           "lb1": ln1_bias, "s2": ln2_scale, "lb2": ln2_bias}
+    opt = {k: as_tensor(v) for k, v in opt.items() if v is not None}
+    names = list(opt.keys())
+    keys = (_rng.next_key(), _rng.next_key()) if training else None
+
+    def fn(xa, w1, w2, *rest):
+        o = dict(zip(names, rest))
+        res = xa
+        h = _layer_norm(xa, o.get("s1"), o.get("lb1"), ln1_epsilon) \
+            if pre_layer_norm else xa
+        h = h @ w1
+        if "b1" in o:
+            h = h + o["b1"]
+        h = _BIAS_ACTS.get(activation, jax.nn.gelu)(h)
+        if training and dropout1_rate > 0:
+            keep = jax.random.bernoulli(keys[0], 1 - dropout1_rate,
+                                        h.shape)
+            h = jnp.where(keep, h / (1 - dropout1_rate), 0)
+        h = h @ w2
+        if "b2" in o:
+            h = h + o["b2"]
+        if training and dropout2_rate > 0:
+            keep = jax.random.bernoulli(keys[1], 1 - dropout2_rate,
+                                        h.shape)
+            h = jnp.where(keep, h / (1 - dropout2_rate), 0)
+        if add_residual:
+            h = res + h
+        if not pre_layer_norm:
+            h = _layer_norm(h, o.get("s2"), o.get("lb2"), ln2_epsilon)
+        return h
+
+    return run_op(fn, [tensors["x"], tensors["w1"], tensors["w2"]]
+                  + list(opt.values()), name="fused_feedforward")
+
+
+def fused_multi_head_attention(
+        x, qkv_weight, linear_weight, pre_layer_norm=False,
+        pre_ln_scale=None, pre_ln_bias=None, ln_scale=None, ln_bias=None,
+        pre_ln_epsilon=1e-5, qkv_bias=None, linear_bias=None, cache_kv=None,
+        attn_mask=None, dropout_rate=0.5, attn_dropout_rate=0.5,
+        ln_epsilon=1e-5, training=True, mode="upscale_in_train", ring_id=-1,
+        add_residual=True, num_heads=-1, transpose_qkv_wb=False, name=None):
+    """reference: fused_transformer.py fused_multi_head_attention:513 —
+    residual + (pre/post) LN + fused qkv + self-attention + out proj in
+    one XLA program. qkv_weight [3, H, D, embed] (or [embed, 3*embed]
+    with transpose_qkv_wb)."""
+    from ....core import random as _rng
+
+    xt = as_tensor(x)
+    qkvw = as_tensor(qkv_weight)
+    lw = as_tensor(linear_weight)
+    opt = {"qb": qkv_bias, "lb": linear_bias, "ps": pre_ln_scale,
+           "pb": pre_ln_bias, "ls": ln_scale, "lnb": ln_bias,
+           "mask": attn_mask}
+    opt = {k: as_tensor(v) for k, v in opt.items() if v is not None}
+    names = list(opt.keys())
+    keys = (_rng.next_key(), _rng.next_key()) if training else None
+
+    def fn(xa, qw, lwa, *rest):
+        o = dict(zip(names, rest))
+        b, s, e = xa.shape
+        res = xa
+        h = _layer_norm(xa, o.get("ps"), o.get("pb"), pre_ln_epsilon) \
+            if pre_layer_norm else xa
+        if transpose_qkv_wb:
+            nh = num_heads
+            qkv = (h @ qw).reshape(b, s, 3, nh, e // nh)
+            if "qb" in o:
+                qkv = qkv + o["qb"].reshape(1, 1, 3, nh, e // nh)
+        else:
+            three, nh, hd, _ = qw.shape
+            qkv = jnp.einsum("bse,khde->bskhd", h, qw)
+            if "qb" in o:
+                qkv = qkv + o["qb"].reshape(1, 1, 3, nh, hd)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # [B,S,H,D]
+        hd = q.shape[-1]
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * (hd ** -0.5)
+        if "mask" in o:
+            scores = scores + o["mask"]
+        p = jax.nn.softmax(scores, axis=-1)
+        if training and attn_dropout_rate > 0:
+            keep = jax.random.bernoulli(keys[0], 1 - attn_dropout_rate,
+                                        p.shape)
+            p = jnp.where(keep, p / (1 - attn_dropout_rate), 0)
+        ctx = jnp.einsum("bhqk,bkhd->bqhd", p, v).reshape(b, s, -1)
+        out = ctx @ lwa
+        if "lb" in o:
+            out = out + o["lb"]
+        if training and dropout_rate > 0:
+            keep = jax.random.bernoulli(keys[1], 1 - dropout_rate,
+                                        out.shape)
+            out = jnp.where(keep, out / (1 - dropout_rate), 0)
+        if add_residual:
+            out = res + out
+        if not pre_layer_norm:
+            out = _layer_norm(out, o.get("ls"), o.get("lnb"), ln_epsilon)
+        return out
+
+    return run_op(fn, [xt, qkvw, lw] + list(opt.values()),
+                  name="fused_multi_head_attention")
+
+
+def fused_multi_transformer(
+        x, ln_scales, ln_biases, qkv_weights, qkv_biases, linear_weights,
+        linear_biases, ffn_ln_scales, ffn_ln_biases, ffn1_weights,
+        ffn1_biases, ffn2_weights, ffn2_biases, pre_layer_norm=True,
+        epsilon=1e-5, residual_alpha=1.0, cache_kvs=None, beam_offset=None,
+        pre_caches=None, seq_lens=None, rotary_embs=None, time_step=None,
+        attn_mask=None, dropout_rate=0.0, rotary_emb_dims=0,
+        activation="gelu", training=False, mode="upscale_in_train",
+        trans_qkvw=True, ring_id=-1, norm_type="layernorm",
+        use_neox_rotary_style=False, gqa_group_size=-1, name=None):
+    """Whole-stack fused transformer forward (reference:
+    fused_transformer.py fused_multi_transformer:976 over
+    fused_multi_transformer_op.cu).
+
+    Statically-unrolled layer loop in ONE program. Two phases like the
+    kernel: context encoding (time_step None — causal over x) and decode
+    (time_step set — single token attending into cache_kvs
+    [2, B, H, max_seq, D] per layer, updated in place).
+    Returns out or (out, cache_kvs) following the reference.
+    """
+    n_layers = len(qkv_weights)
+    xt = as_tensor(x)
+    b, s, e = xt.shape
+    decode = time_step is not None
+    ts = int(unwrap(as_tensor(time_step))) if decode else 0
+    mask_t = as_tensor(attn_mask) if attn_mask is not None else None
+
+    def norm(a, scale, bias):
+        if norm_type == "rmsnorm":
+            return _rms_norm(a, scale, epsilon)
+        return _layer_norm(a, scale, bias, epsilon)
+
+    def get(seq, i):
+        if seq is None:
+            return None
+        t = seq[i]
+        return unwrap(as_tensor(t)) if t is not None else None
+
+    h = unwrap(xt)
+    new_caches = []
+    for li in range(n_layers):
+        res = h
+        ln_s, ln_b = get(ln_scales, li), get(ln_biases, li)
+        hn = norm(h, ln_s, ln_b) if pre_layer_norm else h
+        qw = unwrap(as_tensor(qkv_weights[li]))
+        # kernel layout [3, H, D, E] when trans_qkvw else [E, 3, H, D]
+        if trans_qkvw:
+            three, nh, hd, _ = qw.shape
+            qkv = jnp.einsum("bse,khde->bskhd", hn, qw)
+        else:
+            _, three, nh, hd = qw.shape
+            qkv = jnp.einsum("bse,ekhd->bskhd", hn, qw)
+        qb = get(qkv_biases, li)
+        if qb is not None:
+            qkv = qkv + qb.reshape(1, 1, 3, nh, hd)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        pos = (jnp.full((b, s), ts) if decode
+               else jnp.broadcast_to(jnp.arange(s), (b, s)))
+        if rotary_embs is not None or rotary_emb_dims > 0:
+            q2 = q.reshape(b * s, nh, hd)
+            k2 = k.reshape(b * s, nh, hd)
+            q2, k2 = _apply_rope(q2, k2, pos.reshape(-1),
+                                 neox=use_neox_rotary_style)
+            q, k = q2.reshape(b, s, nh, hd), k2.reshape(b, s, nh, hd)
+        if decode:
+            cache = unwrap(as_tensor(cache_kvs[li]))
+            max_seq = cache.shape[3]
+            ck = cache[0].at[jnp.arange(b), :, ts].set(k[:, 0])
+            cv = cache[1].at[jnp.arange(b), :, ts].set(v[:, 0])
+            scores = jnp.einsum("bhd,bhsd->bhs", q[:, 0], ck) \
+                * (hd ** -0.5)
+            ok = jnp.arange(max_seq)[None, :] <= ts
+            scores = jnp.where(ok[:, None, :], scores, -1e9)
+            p = jax.nn.softmax(scores, axis=-1)
+            ctx = jnp.einsum("bhs,bhsd->bhd", p, cv)[:, None]
+            new_cache = jnp.stack([ck, cv])
+            if isinstance(cache_kvs[li], Tensor):
+                cache_kvs[li]._data = new_cache
+            new_caches.append(new_cache)
+        else:
+            scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * (hd ** -0.5)
+            causal = jnp.tril(jnp.ones((s, s), bool))
+            scores = jnp.where(causal[None, None], scores, -1e9)
+            if mask_t is not None:
+                scores = scores + unwrap(mask_t)
+            p = jax.nn.softmax(scores, axis=-1)
+            ctx = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+            if cache_kvs is not None:
+                cache = unwrap(as_tensor(cache_kvs[li]))
+                pad = cache.shape[3]
+                ck = cache[0].at[:, :, :s].set(k.swapaxes(1, 2))
+                cv = cache[1].at[:, :, :s].set(v.swapaxes(1, 2))
+                new_cache = jnp.stack([ck, cv])
+                if isinstance(cache_kvs[li], Tensor):
+                    cache_kvs[li]._data = new_cache
+                new_caches.append(new_cache)
+        lw = unwrap(as_tensor(linear_weights[li]))
+        attn_out = ctx.reshape(b, s, -1) @ lw
+        lb = get(linear_biases, li)
+        if lb is not None:
+            attn_out = attn_out + lb
+        h = res * residual_alpha + attn_out
+        # ffn
+        res2 = h
+        fs, fb = get(ffn_ln_scales, li), get(ffn_ln_biases, li)
+        hn2 = norm(h, fs, fb) if pre_layer_norm else norm(h, ln_s, ln_b)
+        w1 = unwrap(as_tensor(ffn1_weights[li]))
+        f1 = hn2 @ w1
+        b1 = get(ffn1_biases, li)
+        if b1 is not None:
+            f1 = f1 + b1
+        if activation in ("geglu", "swiglu"):
+            g, u = jnp.split(f1, 2, axis=-1)
+            act = jax.nn.gelu if activation == "geglu" else jax.nn.silu
+            f1 = act(g) * u
+        else:
+            f1 = _BIAS_ACTS.get(activation, jax.nn.gelu)(f1)
+        w2 = unwrap(as_tensor(ffn2_weights[li]))
+        f2 = f1 @ w2
+        b2 = get(ffn2_biases, li)
+        if b2 is not None:
+            f2 = f2 + b2
+        h = res2 * residual_alpha + f2
+        if not pre_layer_norm:
+            h = norm(h, fs, fb)
+    out = Tensor(h)
+    if cache_kvs is not None:
+        return out, cache_kvs
+    return out

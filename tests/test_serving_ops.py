@@ -1,0 +1,287 @@
+"""Serving fused-op tier (VERDICT r3 missing #2; reference:
+python/paddle/incubate/nn/functional/{block_multihead_attention,
+masked_multihead_attention,fused_moe,fused_transformer,
+variable_length_memory_efficient_attention,fused_matmul_bias,
+fused_bias_act,blha_get_max_len}.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+F = paddle.incubate.nn.functional
+
+
+def _softmax(s, axis=-1):
+    e = np.exp(s - s.max(axis=axis, keepdims=True))
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+def test_blha_get_max_len():
+    enc = paddle.to_tensor(np.array([5, 0, 3], np.int32))
+    dec = paddle.to_tensor(np.array([0, 7, 0], np.int32))
+    me, md = F.blha_get_max_len(enc, dec, paddle.to_tensor(3))
+    assert int(me) == 5 and int(md) == 7
+
+
+class TestMaskedMHA:
+    def test_decode_step_matches_dense(self):
+        rng = np.random.RandomState(0)
+        B, H, D, MAX = 2, 3, 8, 16
+        past = 4
+        cache = np.zeros((2, B, H, MAX, D), np.float32)
+        cache[:, :, :, :past] = rng.randn(2, B, H, past, D)
+        x = rng.randn(B, 3 * H * D).astype(np.float32)
+        lens = np.full(B, past, np.int32)
+        cache_t = paddle.to_tensor(cache)
+        out, new_cache = F.masked_multihead_attention(
+            paddle.to_tensor(x), cache_kv=cache_t,
+            sequence_lengths=paddle.to_tensor(lens))
+        qkv = x.reshape(B, 3, H, D)
+        q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+        ks = np.concatenate([cache[0][:, :, :past], k[:, :, None]], 2)
+        vs = np.concatenate([cache[1][:, :, :past], v[:, :, None]], 2)
+        s = np.einsum("bhd,bhsd->bhs", q, ks) / np.sqrt(D)
+        ref = np.einsum("bhs,bhsd->bhd", _softmax(s), vs).reshape(B, -1)
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+        # cache updated in place at position `past`
+        np.testing.assert_allclose(cache_t.numpy()[0][:, :, past], k,
+                                   rtol=1e-6)
+
+    def test_quant_args_rejected(self):
+        with pytest.raises(NotImplementedError, match="quant"):
+            F.masked_multihead_attention(
+                paddle.to_tensor(np.zeros((1, 24), np.float32)),
+                cache_kv=paddle.to_tensor(np.zeros((2, 1, 1, 4, 8),
+                                                   np.float32)),
+                qkv_out_scale=paddle.to_tensor(np.ones(1, np.float32)))
+
+
+class TestVarlenMemEfficientAttention:
+    def test_masks_respect_lengths(self):
+        rng = np.random.RandomState(1)
+        B, H, S, D = 2, 2, 6, 4
+        q, k, v = [rng.randn(B, H, S, D).astype(np.float32)
+                   for _ in range(3)]
+        lens = np.array([[4], [6]], np.int32)
+        out = F.variable_length_memory_efficient_attention(
+            paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+            paddle.to_tensor(lens), paddle.to_tensor(lens)).numpy()
+        # batch 0: valid queries attend over the first 4 keys only
+        s = np.einsum("hqd,hkd->hqk", q[0][:, :4],
+                      k[0][:, :4]) / np.sqrt(D)
+        ref0 = np.einsum("hqk,hkd->hqd", _softmax(s), v[0][:, :4])
+        np.testing.assert_allclose(out[0][:, :4], ref0, rtol=1e-4,
+                                   atol=1e-5)
+
+
+class TestBlockMHA:
+    def _setup(self, rng, B, QH, KVH, D, blk, n_blocks):
+        kc = np.zeros((n_blocks, KVH, blk, D), np.float32)
+        vc = np.zeros((n_blocks, KVH, blk, D), np.float32)
+        bt = np.arange(B * 4, dtype=np.int32).reshape(B, 4)
+        return kc, vc, bt
+
+    def test_prefill_then_decode_matches_dense(self):
+        rng = np.random.RandomState(0)
+        B, QH, KVH, D, blk = 1, 4, 2, 8, 4
+        L = 6
+        kc, vc, bt = self._setup(rng, B, QH, KVH, D, blk, 8)
+        width = (QH + 2 * KVH) * D
+        qkv_prefill = rng.randn(L, width).astype(np.float32)
+        kct, vct = paddle.to_tensor(kc), paddle.to_tensor(vc)
+        common = dict(
+            padding_offsets=paddle.to_tensor(np.zeros(L, np.int32)),
+            cum_offsets=paddle.to_tensor(np.zeros(B, np.int32)),
+            cu_seqlens_k=paddle.to_tensor(np.array([0, L], np.int32)),
+            block_tables=paddle.to_tensor(bt), block_size=blk)
+        out, _, _, _ = F.block_multihead_attention(
+            paddle.to_tensor(qkv_prefill), kct, vct,
+            seq_lens_encoder=paddle.to_tensor(np.array([L], np.int32)),
+            seq_lens_decoder=paddle.to_tensor(np.array([0], np.int32)),
+            seq_lens_this_time=paddle.to_tensor(np.array([L], np.int32)),
+            cu_seqlens_q=paddle.to_tensor(np.array([0, L], np.int32)),
+            **common)
+        # dense causal GQA reference
+        a = qkv_prefill.reshape(L, QH + 2 * KVH, D)
+        q, k, v = a[:, :QH], a[:, QH:QH + KVH], a[:, QH + KVH:]
+        kk = np.repeat(k, QH // KVH, 1)
+        vv = np.repeat(v, QH // KVH, 1)
+        s = np.einsum("lhd,khd->hlk", q, kk) / np.sqrt(D)
+        s = np.where(np.tril(np.ones((L, L), bool))[None], s, -1e9)
+        ref = np.einsum("hlk,khd->lhd", _softmax(s), vv).reshape(L, -1)
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-3, atol=1e-4)
+
+        # decode one more token against the updated paged cache
+        qkv_dec = rng.randn(1, width).astype(np.float32)
+        out2, _, _, _ = F.block_multihead_attention(
+            paddle.to_tensor(qkv_dec), kct, vct,
+            seq_lens_encoder=paddle.to_tensor(np.array([0], np.int32)),
+            seq_lens_decoder=paddle.to_tensor(np.array([L], np.int32)),
+            seq_lens_this_time=paddle.to_tensor(np.array([1], np.int32)),
+            cu_seqlens_q=paddle.to_tensor(np.array([0, 1], np.int32)),
+            **common)
+        a2 = qkv_dec.reshape(1, QH + 2 * KVH, D)
+        q2 = a2[:, :QH]
+        k_all = np.concatenate([k, a2[:, QH:QH + KVH]], 0)
+        v_all = np.concatenate([v, a2[:, QH + KVH:]], 0)
+        kk = np.repeat(k_all, QH // KVH, 1)
+        vv = np.repeat(v_all, QH // KVH, 1)
+        s2 = np.einsum("lhd,khd->hlk", q2, kk) / np.sqrt(D)
+        ref2 = np.einsum("hlk,khd->lhd", _softmax(s2), vv).reshape(1, -1)
+        np.testing.assert_allclose(out2.numpy(), ref2, rtol=1e-3,
+                                   atol=1e-4)
+
+
+class TestFusedMoE:
+    def test_matches_manual_topk_routing(self):
+        rng = np.random.RandomState(0)
+        B, S, DM, DFF, E, K = 2, 3, 8, 16, 4, 2
+        x = rng.randn(B, S, DM).astype(np.float32)
+        gw = rng.randn(DM, E).astype(np.float32)
+        w1 = rng.randn(E, DM, 2 * DFF).astype(np.float32)
+        w2 = rng.randn(E, DFF, DM).astype(np.float32)
+        out = F.fused_moe(paddle.to_tensor(x), paddle.to_tensor(gw),
+                          paddle.to_tensor(w1), paddle.to_tensor(w2),
+                          moe_topk=K).numpy()
+        toks = x.reshape(-1, DM)
+        probs = _softmax(toks @ gw)
+        ref = np.zeros_like(toks)
+        for t in range(toks.shape[0]):
+            top = np.argsort(-probs[t])[:K]
+            pw = probs[t][top] / probs[t][top].sum()
+            for p_, e_ in zip(pw, top):
+                h = toks[t] @ w1[e_]
+                g, u = h[:DFF], h[DFF:]
+                h = (g / (1 + np.exp(-g))) * u
+                ref[t] += p_ * (h @ w2[e_])
+        np.testing.assert_allclose(out.reshape(-1, DM), ref, rtol=1e-3,
+                                   atol=1e-4)
+
+
+class TestFusedMatmulBiasAct:
+    def test_fused_matmul_bias(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(3, 4).astype(np.float32)
+        y = rng.randn(4, 5).astype(np.float32)
+        b = rng.randn(5).astype(np.float32)
+        out = F.fused_matmul_bias(paddle.to_tensor(x), paddle.to_tensor(y),
+                                  paddle.to_tensor(b)).numpy()
+        np.testing.assert_allclose(out, x @ y + b, rtol=1e-5)
+        out_t = F.fused_matmul_bias(paddle.to_tensor(x),
+                                    paddle.to_tensor(y.T),
+                                    transpose_y=True).numpy()
+        np.testing.assert_allclose(out_t, x @ y, rtol=1e-5)
+
+    def test_fused_bias_act(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(4, 8).astype(np.float32)
+        b = rng.randn(8).astype(np.float32)
+        got = F.fused_bias_act(paddle.to_tensor(x), paddle.to_tensor(b),
+                               act_method="relu").numpy()
+        np.testing.assert_allclose(got, np.maximum(x + b, 0), rtol=1e-6)
+        sw = F.fused_bias_act(paddle.to_tensor(x),
+                              act_method="swiglu").numpy()
+        g, u = x[:, :4], x[:, 4:]
+        np.testing.assert_allclose(sw, (g / (1 + np.exp(-g))) * u,
+                                   rtol=1e-4)
+
+
+class TestFusedFeedforwardMHA:
+    def test_fused_feedforward_pre_ln(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 3, 8).astype(np.float32)
+        w1 = rng.randn(8, 16).astype(np.float32)
+        w2 = rng.randn(16, 8).astype(np.float32)
+        s1 = np.ones(8, np.float32)
+        out = F.fused_feedforward(
+            paddle.to_tensor(x), paddle.to_tensor(w1), paddle.to_tensor(w2),
+            ln1_scale=paddle.to_tensor(s1), pre_layer_norm=True,
+            dropout1_rate=0.0, dropout2_rate=0.0, training=False).numpy()
+        mu = x.mean(-1, keepdims=True)
+        var = x.var(-1, keepdims=True)
+        h = (x - mu) / np.sqrt(var + 1e-5)
+        ref = x + np.maximum(h @ w1, 0) @ w2
+        np.testing.assert_allclose(out, ref, rtol=1e-3, atol=1e-4)
+
+    def test_fused_mha_matches_composition(self):
+        rng = np.random.RandomState(0)
+        B, S, E, H = 2, 4, 8, 2
+        hd = E // H
+        x = rng.randn(B, S, E).astype(np.float32)
+        qkvw = rng.randn(3, H, hd, E).astype(np.float32)
+        lw = rng.randn(E, E).astype(np.float32)
+        out = F.fused_multi_head_attention(
+            paddle.to_tensor(x), paddle.to_tensor(qkvw),
+            paddle.to_tensor(lw), dropout_rate=0.0, attn_dropout_rate=0.0,
+            training=False).numpy()
+        qkv = np.einsum("bse,khde->bskhd", x, qkvw)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        s = np.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(hd)
+        ctx = np.einsum("bhqk,bkhd->bqhd", _softmax(s), v).reshape(B, S, E)
+        ref = ctx @ lw
+        ref = x + ref
+        mu = ref.mean(-1, keepdims=True)
+        ref = (ref - mu) / np.sqrt(ref.var(-1, keepdims=True) + 1e-5)
+        np.testing.assert_allclose(out, ref, rtol=1e-3, atol=1e-4)
+
+
+class TestFusedMultiTransformer:
+    def test_context_then_decode_consistent(self):
+        """Encode a prompt with the context phase, then decode one token;
+        compare against encoding prompt+token in one context pass."""
+        rng = np.random.RandomState(0)
+        B, S, E, H, DFF, LYR = 1, 4, 8, 2, 16, 2
+        hd = E // H
+        MAX = 8
+
+        def mk(shape):
+            return paddle.to_tensor(rng.randn(*shape).astype(np.float32)
+                                    * 0.3)
+
+        args = dict(
+            ln_scales=[mk((E,)) for _ in range(LYR)],
+            ln_biases=[mk((E,)) for _ in range(LYR)],
+            qkv_weights=[mk((3, H, hd, E)) for _ in range(LYR)],
+            qkv_biases=[mk((3 * E,)) for _ in range(LYR)],
+            linear_weights=[mk((E, E)) for _ in range(LYR)],
+            linear_biases=[mk((E,)) for _ in range(LYR)],
+            ffn_ln_scales=[mk((E,)) for _ in range(LYR)],
+            ffn_ln_biases=[mk((E,)) for _ in range(LYR)],
+            ffn1_weights=[mk((E, DFF)) for _ in range(LYR)],
+            ffn1_biases=[mk((DFF,)) for _ in range(LYR)],
+            ffn2_weights=[mk((DFF, E)) for _ in range(LYR)],
+            ffn2_biases=[mk((E,)) for _ in range(LYR)],
+        )
+        x_full = rng.randn(B, S + 1, E).astype(np.float32)
+
+        # one-shot context pass over S+1 tokens
+        ref = F.fused_multi_transformer(
+            paddle.to_tensor(x_full), **args)
+        ref_last = ref.numpy()[:, -1]
+
+        # context over S tokens, then decode token S against the cache
+        caches = [paddle.to_tensor(np.zeros((2, B, H, MAX, hd),
+                                            np.float32))
+                  for _ in range(LYR)]
+        out_ctx, caches = F.fused_multi_transformer(
+            paddle.to_tensor(x_full[:, :S]), cache_kvs=caches, **args)
+        out_dec, _ = F.fused_multi_transformer(
+            paddle.to_tensor(x_full[:, S:]), cache_kvs=caches,
+            time_step=paddle.to_tensor(S), **args)
+        np.testing.assert_allclose(out_dec.numpy()[:, 0], ref_last,
+                                   rtol=1e-3, atol=1e-4)
+
+
+def test_namespace_now_complete():
+    import ast
+
+    ref = "/root/reference/python/paddle/incubate/nn/functional/__init__.py"
+    tree = ast.parse(open(ref).read())
+    names = None
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) \
+                and getattr(node.targets[0], "id", "") == "__all__":
+            names = ast.literal_eval(node.value)
+    missing = [n for n in names
+               if not hasattr(paddle.incubate.nn.functional, n)]
+    assert not missing, missing
