@@ -46,19 +46,24 @@ def main():
         rng = np.random.default_rng(0)
         ids = pt.to_tensor(rng.integers(0, cfg.vocab_size, (b, plen))
                            .astype(np.int32))
-        out = model.generate(ids, max_new_tokens=new)   # compile+warm
-        _ = out.numpy()
-        t0 = time.perf_counter()
-        out = model.generate(ids, max_new_tokens=new)
-        _ = out.numpy()
-        el = time.perf_counter() - t0
-        print(json.dumps({
-            "metric": f"{name}_decode_tokens_per_sec_chip",
-            "value": round(b * new / el, 1),
-            "unit": "tokens/s",
-            "extra": {"batch": b, "prompt": plen, "new_tokens": new,
-                      "ms_per_token_step": round(el / new * 1000, 2)},
-        }), flush=True)
+        for quant in (None, "int8"):
+            out = model.generate(ids, max_new_tokens=new,
+                                 weight_quant=quant)   # compile+warm
+            _ = out.numpy()
+            t0 = time.perf_counter()
+            out = model.generate(ids, max_new_tokens=new,
+                                 weight_quant=quant)
+            _ = out.numpy()
+            el = time.perf_counter() - t0
+            tag = "" if quant is None else f"_{quant}"
+            print(json.dumps({
+                "metric": f"{name}{tag}_decode_tokens_per_sec_chip",
+                "value": round(b * new / el, 1),
+                "unit": "tokens/s",
+                "extra": {"batch": b, "prompt": plen, "new_tokens": new,
+                          "weight_quant": quant,
+                          "ms_per_token_step": round(el / new * 1000, 2)},
+            }), flush=True)
         del model
 
 
