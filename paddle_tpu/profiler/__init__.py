@@ -18,10 +18,11 @@ from typing import Callable, Iterable, Optional
 
 from .timer import benchmark  # noqa: F401
 from .utils import RecordEvent, load_profiler_result  # noqa: F401
+from .profiler_statistic import SortedKeys  # noqa: F401
 
 __all__ = ["Profiler", "ProfilerState", "ProfilerTarget", "make_scheduler",
            "export_chrome_tracing", "RecordEvent", "benchmark",
-           "load_profiler_result"]
+           "load_profiler_result", "SortedKeys"]
 
 
 class ProfilerState(enum.Enum):
@@ -274,8 +275,11 @@ class Profiler:
 
     def summary(self, sorted_by=None, op_detail: bool = True,
                 thread_sep: bool = False, time_unit: str = "ms"):
-        """Aggregate per-op-name totals from the last trace window."""
+        """Reference-style statistic tables from the last trace window
+        (reference: profiler/profiler_statistic.py _build_table)."""
         import json
+
+        from .profiler_statistic import SortedKeys, gen_statistic_table
 
         path = self._last_export_path
         if path is None:
@@ -288,15 +292,9 @@ class Profiler:
             events = json.load(open(path))["traceEvents"]
         except Exception:
             return "no profiling data"
-        agg = {}
-        for e in events:
-            name = e.get("name", "?")
-            rec = agg.setdefault(name, [0, 0.0])
-            rec[0] += 1
-            rec[1] += e.get("dur", 0.0)
-        rows = sorted(agg.items(), key=lambda kv: -kv[1][1])
-        lines = [f"{'Name':<40}{'Calls':>8}{'Total(us)':>14}{'Avg(us)':>12}"]
-        for name, (calls, total) in rows[:60]:
-            lines.append(f"{name[:39]:<40}{calls:>8}{total:>14.1f}"
-                         f"{total / max(calls, 1):>12.1f}")
-        return "\n".join(lines)
+        out = gen_statistic_table(
+            events, sorted_by=sorted_by or SortedKeys.CPUTotal,
+            op_detail=op_detail, thread_sep=thread_sep,
+            time_unit=time_unit)
+        print(out)
+        return out

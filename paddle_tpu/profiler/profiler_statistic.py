@@ -1,0 +1,184 @@
+"""Profiler statistics tier (reference:
+python/paddle/profiler/profiler_statistic.py — SortedKeys, StatisticData,
+_build_table overview/operator/userdefined summaries).
+
+Aggregates the chrome-trace events the host tracer (native or python)
+collected into reference-style sorted summary tables. Device time comes
+from the same spans when the op executed under the profiler window —
+on TPU the authoritative per-kernel device timeline lives in the xplane
+trace jax.profiler wrote (PADDLE_TPU_PROFILE_DIR); these tables are the
+host-side op accounting the reference prints."""
+from __future__ import annotations
+
+import enum
+from typing import Dict, List
+
+__all__ = ["SortedKeys", "StatisticData", "gen_statistic_table"]
+
+
+class SortedKeys(enum.Enum):
+    """reference: profiler_statistic.py SortedKeys."""
+
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    CPUMin = 3
+    GPUTotal = 4
+    GPUAvg = 5
+    GPUMax = 6
+    GPUMin = 7
+
+
+class _Item:
+    __slots__ = ("name", "category", "calls", "total", "max", "min")
+
+    def __init__(self, name, category):
+        self.name = name
+        self.category = category
+        self.calls = 0
+        self.total = 0.0
+        self.max = 0.0
+        self.min = float("inf")
+
+    def add(self, dur_us: float):
+        self.calls += 1
+        self.total += dur_us
+        self.max = max(self.max, dur_us)
+        self.min = min(self.min, dur_us)
+
+    @property
+    def avg(self):
+        return self.total / max(self.calls, 1)
+
+
+_CATEGORY_ALIASES = {
+    "op": "Operator",
+    "Operator": "Operator",
+    "dataloader": "Dataloader",
+    "Dataloader": "Dataloader",
+    "UserDefined": "UserDefined",
+    "user_defined": "UserDefined",
+    "ProfileStep": "ProfileStep",
+    "forward": "Forward",
+    "backward": "Backward",
+    "optimizer": "Optimization",
+    "communication": "Communication",
+}
+
+
+class StatisticData:
+    """Parsed event aggregates (reference StatisticData over the node
+    trees; here the host tracer emits flat spans)."""
+
+    def __init__(self, events: List[dict]):
+        self.items: Dict[str, _Item] = {}
+        self.categories: Dict[str, _Item] = {}
+        self.total_us = 0.0
+        t_min, t_max = float("inf"), 0.0
+        for e in events:
+            if e.get("ph") != "X":
+                continue
+            name = e.get("name", "?")
+            cat = _CATEGORY_ALIASES.get(e.get("cat", "UserDefined"),
+                                        "UserDefined")
+            dur = float(e.get("dur", 0.0))
+            ts = float(e.get("ts", 0.0))
+            t_min = min(t_min, ts)
+            t_max = max(t_max, ts + dur)
+            key = f"{cat}::{name}"
+            item = self.items.get(key)
+            if item is None:
+                item = self.items[key] = _Item(name, cat)
+            item.add(dur)
+            citem = self.categories.get(cat)
+            if citem is None:
+                citem = self.categories[cat] = _Item(cat, cat)
+            citem.add(dur)
+        self.window_us = (t_max - t_min) if t_max > t_min else 0.0
+        self.total_us = sum(c.total for c in self.categories.values())
+
+
+_SORT_FN = {
+    SortedKeys.CPUTotal: lambda it: -it.total,
+    SortedKeys.CPUAvg: lambda it: -it.avg,
+    SortedKeys.CPUMax: lambda it: -it.max,
+    SortedKeys.CPUMin: lambda it: it.min,
+    # host tracer: device columns mirror host columns (xplane holds the
+    # true per-kernel device times)
+    SortedKeys.GPUTotal: lambda it: -it.total,
+    SortedKeys.GPUAvg: lambda it: -it.avg,
+    SortedKeys.GPUMax: lambda it: -it.max,
+    SortedKeys.GPUMin: lambda it: it.min,
+}
+
+_UNIT_DIV = {"s": 1e6, "ms": 1e3, "us": 1.0, "ns": 1e-3}
+
+
+def _fmt(us: float, unit: str) -> str:
+    return f"{us / _UNIT_DIV[unit]:.2f}"
+
+
+def _table(title: str, headers: List[str], rows: List[List[str]]) -> str:
+    widths = [max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
+              for i, h in enumerate(headers)]
+    sep = "-" * (sum(widths) + 2 * len(widths))
+    out = [sep, title.center(sum(widths) + 2 * len(widths)), sep,
+           "  ".join(h.ljust(w) for h, w in zip(headers, widths))]
+    for r in rows:
+        out.append("  ".join(c.ljust(w) for c, w in zip(r, widths)))
+    out.append(sep)
+    return "\n".join(out)
+
+
+def gen_statistic_table(events: List[dict],
+                        sorted_by: SortedKeys = SortedKeys.CPUTotal,
+                        op_detail: bool = True, thread_sep: bool = False,
+                        time_unit: str = "ms", row_limit: int = 100) -> str:
+    """Build the reference-style summary string (reference
+    profiler_statistic.py _build_table composition)."""
+    data = StatisticData(events)
+    if not data.items:
+        return "no profiling data"
+    u = time_unit
+    blocks = []
+
+    # ----- overview: per-category totals against the trace window
+    denom = max(data.window_us, 1e-9)
+    rows = []
+    for cat, it in sorted(data.categories.items(),
+                          key=lambda kv: -kv[1].total):
+        rows.append([cat, str(it.calls), _fmt(it.total, u),
+                     f"{100.0 * it.total / denom:.2f}%"])
+    rows.append(["ProfileWindow", "-", _fmt(data.window_us, u), "100.00%"])
+    blocks.append(_table(
+        f"Overview Summary (time unit: {u})",
+        ["Event Type", "Calls", "Total", "Ratio (%)"], rows))
+
+    # ----- operator summary
+    ops = [it for it in data.items.values() if it.category == "Operator"]
+    if ops and op_detail:
+        ops.sort(key=_SORT_FN[sorted_by])
+        op_total = sum(it.total for it in ops) or 1e-9
+        rows = [[it.name, str(it.calls), _fmt(it.total, u),
+                 _fmt(it.avg, u), _fmt(it.max, u),
+                 _fmt(0.0 if it.min == float("inf") else it.min, u),
+                 f"{100.0 * it.total / op_total:.2f}%"]
+                for it in ops[:row_limit]]
+        blocks.append(_table(
+            f"Operator Summary (time unit: {u}, sorted by "
+            f"{sorted_by.name})",
+            ["Name", "Calls", "Total", "Avg", "Max", "Min", "Ratio (%)"],
+            rows))
+
+    # ----- user-defined / other categories
+    others = [it for it in data.items.values()
+              if it.category not in ("Operator",)]
+    if others:
+        others.sort(key=_SORT_FN[sorted_by])
+        rows = [[it.name, it.category, str(it.calls), _fmt(it.total, u),
+                 _fmt(it.avg, u)] for it in others[:row_limit]]
+        blocks.append(_table(
+            f"UserDefined Summary (time unit: {u})",
+            ["Name", "Type", "Calls", "Total", "Avg"], rows))
+
+    return "\n\n".join(blocks)
