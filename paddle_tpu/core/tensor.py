@@ -176,6 +176,18 @@ class Tensor:
 
     # ------------------------------------------------------------- host I/O
     def numpy(self) -> np.ndarray:
+        if getattr(self, "_sym_node", None) is not None \
+                and not isinstance(self._data, (jax.Array, np.ndarray)):
+            # symbolic payload inspected from Python: under SOT capture
+            # this is a graph break — evaluate the prefix subgraph and
+            # guard on the value (jit/sot.py); otherwise it is an error
+            from ..jit.sot import _sot_concretize, in_sot_capture
+
+            if in_sot_capture():
+                return np.asarray(_sot_concretize(self))
+            raise ValueError(
+                "cannot read a symbolic (captured) Tensor from Python "
+                "outside SOT capture; fetch it through the Executor")
         return np.asarray(self._data)
 
     def item(self, *args):
@@ -321,8 +333,16 @@ class Tensor:
     # __invert__ (bitwise_not, matching paddle's ~) is installed by
     # core/tensor_methods.py alongside the other bitwise dunders
 
-    # comparisons -> bool tensors (no grad)
+    # comparisons -> bool tensors (no grad; still recorded so static/SOT
+    # capture can trace a data-dependent condition's producing subgraph)
     def _cmp(self, other, fn):
+        if getattr(self, "_sym_node", None) is not None or (
+                isinstance(other, Tensor)
+                and getattr(other, "_sym_node", None) is not None):
+            if isinstance(other, Tensor):
+                return _ag.run_op(fn, [self, other], name="compare")
+            o = _unwrap(other)
+            return _ag.run_op(lambda x: fn(x, o), [self], name="compare")
         o = _unwrap(other)
         return Tensor(fn(self._data, o))
 

@@ -32,7 +32,7 @@ from ..nn.layer.layers import Layer
 
 __all__ = ["to_static", "not_to_static", "save", "load", "ignore_module",
            "enable_to_static", "TranslatedLayer", "InputSpec", "TrainStep",
-           "ChunkPrefetcher"]
+           "ChunkPrefetcher", "sot"]
 
 _to_static_enabled = True
 
@@ -338,3 +338,6 @@ def set_code_level(level=100, also_to_stdout=False):
 
 
 __all__ += ["set_verbosity", "set_code_level"]
+
+
+from . import sot  # noqa: F401,E402

@@ -1,0 +1,200 @@
+"""SOT-lite: partial-graph capture with guards (reference:
+python/paddle/jit/sot/ — opcode_translator/executor/opcode_executor.py
+bytecode walking, symbolic/statement_ir.py subgraph IR, eval_frame.c).
+
+The reference interposes at the bytecode level: it walks opcodes, builds
+partial graphs, and generates resume functions at graph breaks. This
+build interposes at the TENSOR->PYTHON boundary instead, which is where
+every graph break actually materializes: the function runs ONCE under
+symbolic capture (static/graph.py records each op), and when Python
+inspects a traced value (``bool(t)`` / ``int(t)`` / ``.item()`` /
+``.numpy()`` inside an ``if``), the recorded prefix producing that value
+is evaluated as its own compiled subgraph, the concrete result is handed
+to the branch AND remembered as a GUARD, and capture simply continues
+down the taken side. One dynamic ``if`` therefore yields two compiled
+XLA programs (guard subgraph + remainder) instead of degrading the whole
+function to eager like the retrace fallback in jit/__init__.py.
+
+Guard tree replay: each cached entry is keyed by input types/shapes/
+dtypes (+ repr of non-tensor args). Calls walk the chain of guard
+subgraphs; a novel combination of branch outcomes re-captures just that
+path. Shapes are static per entry exactly as XLA requires, so the guard
+set is {input signature} x {branch outcomes} — the same contract as the
+reference's guard chains (sot/opcode_translator/executor/guard.py).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import static_flags
+from ..core.tensor import Tensor
+from ..static import graph as _g
+
+__all__ = ["symbolic_translate", "sot_capture", "in_sot_capture"]
+
+
+class _CaptureCtx:
+    def __init__(self, feed_values: Dict[str, Any]):
+        self.feed_values = feed_values      # name -> concrete jax array
+        self.guards: List[Tuple[Any, Any]] = []  # (sym_node, value)
+        self.n_subgraphs = 1                # the final output program
+
+    def concretize(self, t: Tensor):
+        """Evaluate the recorded prefix producing ``t`` as a compiled
+        subgraph; record the (node, value) pair as a guard."""
+        node = t._sym_node
+        run, feed_names, params = _g.trace([node])
+        fn = jax.jit(lambda feeds, ps: run(feeds, ps))
+        val = fn({k: self.feed_values[k] for k in feed_names},
+                 [p._data for p in params])[0]
+        val = np.asarray(val)
+        self.guards.append((node, val))
+        self.n_subgraphs += 1
+        return val
+
+
+_active_ctx: Optional[_CaptureCtx] = None
+
+
+def in_sot_capture() -> bool:
+    return _active_ctx is not None
+
+
+def _sot_concretize(t: Tensor):
+    """Called from Tensor host-I/O dunders when the payload is symbolic
+    and a SOT capture is active."""
+    if _active_ctx is None:
+        raise RuntimeError(
+            "symbolic Tensor inspected from Python outside a SOT capture")
+    return _active_ctx.concretize(t)
+
+
+def _sig_of(args, kwargs):
+    parts = []
+    for a in list(args) + sorted(kwargs.items()):
+        if isinstance(a, tuple):
+            a = a[1]
+        if isinstance(a, Tensor):
+            parts.append(("T", tuple(a.shape), str(a._data.dtype)))
+        elif isinstance(a, (np.ndarray, jax.Array)):
+            parts.append(("A", tuple(a.shape), str(a.dtype)))
+        else:
+            parts.append(("P", repr(a)))
+    return tuple(parts)
+
+
+class _PathProgram:
+    """One captured path: its guard chain and the compiled output fn."""
+
+    def __init__(self, guards, out_fn, out_feed_names, out_params,
+                 out_treedef, n_outs, n_subgraphs):
+        self.guards = guards          # [(jitted cond fn, feed names,
+        #                                params, expected value)]
+        self.out_fn = out_fn
+        self.out_feed_names = out_feed_names
+        self.out_params = out_params
+        self.out_treedef = out_treedef
+        self.n_outs = n_outs
+        self.n_subgraphs = n_subgraphs
+
+
+class SOTFunction:
+    """Callable wrapper produced by :func:`symbolic_translate`."""
+
+    def __init__(self, fn):
+        self._fn = fn
+        self._cache: Dict[Any, List[_PathProgram]] = {}
+        self.graph_break_count = 0    # capture-time breaks observed
+        functools.update_wrapper(self, fn)
+
+    # ---------------------------------------------------------- capture
+    def _capture(self, args, kwargs):
+        global _active_ctx
+        feed_values = {}
+        sym_args = []
+        for i, a in enumerate(args):
+            if isinstance(a, Tensor):
+                name = f"sot_arg{i}"
+                aval = jax.ShapeDtypeStruct(tuple(a.shape), a._data.dtype)
+                leaf = _g.FeedLeaf(name, aval)
+                sym_args.append(_g.make_symbolic(leaf, 0, name=name))
+                feed_values[name] = a._data
+            else:
+                sym_args.append(a)
+        ctx = _CaptureCtx(feed_values)
+        prev_ctx, _active_ctx = _active_ctx, ctx
+        prev_static = static_flags.enabled
+        static_flags.enabled = True
+        try:
+            out = self._fn(*sym_args, **kwargs)
+        finally:
+            static_flags.enabled = prev_static
+            _active_ctx = prev_ctx
+        out_leaves, out_treedef = jax.tree_util.tree_flatten(
+            out, is_leaf=lambda x: isinstance(x, Tensor))
+        sym_leaves = [t for t in out_leaves if _g.is_symbolic(t)]
+        const_leaves = [None if _g.is_symbolic(t) else t
+                        for t in out_leaves]
+        run, feed_names, params = _g.trace(
+            [t._sym_node for t in sym_leaves])
+        out_fn = jax.jit(lambda feeds, ps: run(feeds, ps))
+        guard_progs = []
+        for node, val in ctx.guards:
+            grun, gfeeds, gparams = _g.trace([node])
+            gfn = jax.jit(lambda feeds, ps, _r=grun: _r(feeds, ps))
+            guard_progs.append((gfn, gfeeds, gparams, val))
+        self.graph_break_count += len(ctx.guards)
+        prog = _PathProgram(guard_progs, out_fn, feed_names, params,
+                            (out_treedef, const_leaves), len(sym_leaves),
+                            ctx.n_subgraphs)
+        return prog
+
+    # ------------------------------------------------------------- call
+    def __call__(self, *args, **kwargs):
+        sig = _sig_of(args, kwargs)
+        paths = self._cache.setdefault(sig, [])
+        feed_values = {f"sot_arg{i}": a._data
+                       for i, a in enumerate(args)
+                       if isinstance(a, Tensor)}
+
+        def guards_hold(prog):
+            for gfn, gfeeds, gparams, expect in prog.guards:
+                got = np.asarray(gfn(
+                    {k: feed_values[k] for k in gfeeds},
+                    [p._data for p in gparams])[0])
+                if not np.array_equal(got, expect):
+                    return False
+            return True
+
+        prog = next((p for p in paths if guards_hold(p)), None)
+        if prog is None:
+            prog = self._capture(args, kwargs)
+            paths.append(prog)
+        vals = prog.out_fn(
+            {k: feed_values[k] for k in prog.out_feed_names},
+            [p._data for p in prog.out_params])
+        out_treedef, const_leaves = prog.out_treedef
+        leaves, i = [], 0
+        for c in const_leaves:
+            if c is None:
+                leaves.append(Tensor(vals[i]))
+                i += 1
+            else:
+                leaves.append(c)
+        return jax.tree_util.tree_unflatten(out_treedef, leaves)
+
+
+def symbolic_translate(fn=None):
+    """SOT entry point (reference: paddle.jit.sot.symbolic_translate).
+    Wraps ``fn`` in partial-graph capture with guards."""
+    if fn is None:
+        return symbolic_translate
+    return SOTFunction(fn)
+
+
+sot_capture = symbolic_translate

