@@ -22,6 +22,14 @@ passes compose as *program transforms on that trace*:
 
 Everything lands in a single pjit'd program per (shapes, mesh) — the
 executor role of the reference's PirInterpreter is played by XLA.
+
+A program-level pass tier also exists (distributed/passes/: PassManager,
+auto_parallel_amp / auto_parallel_recompute as op-DAG rewrites over the
+captured static Program, and the pipeline_scheduler_pass FThenB / 1F1B
+job-list passes) for reference-style pass-driven workflows over
+paddle.static programs; this Engine keeps the trace-level composition
+because the whole step lives in one jax trace here, which XLA optimizes
+strictly better than sequenced sub-programs.
 """
 from __future__ import annotations
 

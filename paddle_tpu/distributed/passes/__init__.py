@@ -1,0 +1,240 @@
+"""Program-level pass tier (reference: python/paddle/distributed/passes/
+— pass_base.py PassBase/register_pass/new_pass/PassManager, the
+auto_parallel_{amp,recompute}.py program passes and
+pipeline_scheduler_pass/).
+
+TPU-native: a "program" is the captured op-DAG (static/graph.py OpNode
+closures). A pass rewrites that DAG — cloning nodes through a transform
+with memoization — and returns new fetch handles; the Executor then
+compiles the transformed program exactly like the original. This is the
+program-rewrite tier the reference implements over PIR; XLA still does
+instruction-level optimization below it.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+from ...core.tensor import Tensor
+from ...static import graph as _g
+
+__all__ = ["PassBase", "PassContext", "PassManager", "register_pass",
+           "new_pass", "rewrite_program"]
+
+_PASS_REGISTRY: Dict[str, type] = {}
+
+
+def register_pass(name: str):
+    """reference: pass_base.py register_pass decorator."""
+
+    def deco(cls):
+        cls.name = name
+        _PASS_REGISTRY[name] = cls
+        return cls
+
+    return deco
+
+
+def new_pass(name: str, pass_attrs: Optional[dict] = None):
+    """reference: pass_base.py new_pass."""
+    if name not in _PASS_REGISTRY:
+        raise ValueError(
+            f"unknown pass {name!r}; registered: "
+            f"{sorted(_PASS_REGISTRY)}")
+    p = _PASS_REGISTRY[name]()
+    for k, v in (pass_attrs or {}).items():
+        p.set_attr(k, v)
+    return p
+
+
+class PassContext:
+    """reference: pass_base.py PassContext."""
+
+    def __init__(self):
+        self.attrs = {}
+
+
+class PassBase:
+    """A program pass: apply(fetches) -> new fetches over a rewritten
+    DAG (reference pass_base.py PassBase._apply_single_impl)."""
+
+    name = "base"
+
+    def __init__(self):
+        self._attrs = {}
+
+    def set_attr(self, k, v):
+        self._attrs[k] = v
+        return self
+
+    def get_attr(self, k, default=None):
+        return self._attrs.get(k, default)
+
+    def _check_self(self):
+        return True
+
+    def apply(self, fetches: List[Tensor],
+              context: Optional[PassContext] = None) -> List[Tensor]:
+        raise NotImplementedError
+
+
+class PassManager:
+    """reference: pass_base.py PassManager — ordered composition."""
+
+    def __init__(self, passes: List[PassBase]):
+        self.passes = list(passes)
+        self.context = PassContext()
+
+    def apply(self, fetches: List[Tensor]) -> List[Tensor]:
+        for p in self.passes:
+            fetches = p.apply(fetches, self.context)
+        return fetches
+
+    @property
+    def names(self):
+        return [p.name for p in self.passes]
+
+
+# ------------------------------------------------------------ DAG rewrite
+def rewrite_program(fetches: List[Tensor],
+                    node_transform: Callable) -> List[Tensor]:
+    """Clone the op-DAG under ``fetches``, passing every OpNode through
+    ``node_transform(node, new_parents) -> OpNode`` (memoized, so shared
+    subgraphs stay shared). Feed leaves / parameters pass through."""
+    memo: Dict[int, _g.OpNode] = {}
+
+    def clone(node):
+        if not isinstance(node, _g.OpNode):
+            return node
+        if id(node) in memo:
+            return memo[id(node)]
+        new_parents = []
+        for p in node.parents:
+            if isinstance(p, tuple):
+                new_parents.append((clone(p[0]), p[1]))
+            else:
+                new_parents.append(p)
+        new_node = node_transform(node, new_parents)
+        memo[id(node)] = new_node
+        return new_node
+
+    out = []
+    for t in fetches:
+        if not _g.is_symbolic(t):
+            out.append(t)
+            continue
+        node, idx = t._sym_node
+        if isinstance(node, _g.FeedLeaf):
+            out.append(t)
+            continue
+        out.append(_g.make_symbolic(clone(node), idx,
+                                    name=getattr(t, "name", None)))
+    return out
+
+
+def _identity_clone(node, new_parents):
+    return _g.OpNode(node.fn, new_parents, node.out_avals, node.name,
+                     node.single)
+
+
+# --------------------------------------------------------------- amp pass
+# op-name sets mirror amp/__init__.py O1 lists (matmul-family compute in
+# bf16; numerically-sensitive reductions stay f32)
+_AMP_WHITE = {"matmul", "bmm", "mm", "conv1d", "conv2d", "conv3d",
+              "linear", "einsum", "flash_attention"}
+_AMP_BLACK = {"softmax", "log_softmax", "cross_entropy", "layer_norm",
+              "batch_norm", "rms_norm", "logsumexp", "mean", "sum",
+              "exp", "log", "norm", "cumsum"}
+
+
+@register_pass("auto_parallel_amp")
+@register_pass("auto_parallel_fp16")
+class AMPPass(PassBase):
+    """Cast white-list op inputs to the amp dtype at the PROGRAM level
+    (reference: distributed/passes/auto_parallel_amp.py). attrs:
+    dtype ('bfloat16'|'float16')."""
+
+    def apply(self, fetches, context=None):
+        import jax.numpy as jnp
+
+        from ...core.dtype import to_jax_dtype
+
+        amp_dt = to_jax_dtype(self.get_attr("dtype", "bfloat16"))
+
+        def transform(node, new_parents):
+            if node.name not in _AMP_WHITE:
+                return _identity_clone(node, new_parents)
+            fn = node.fn
+
+            def amp_fn(*vals, _fn=fn):
+                cast = [v.astype(amp_dt)
+                        if hasattr(v, "dtype")
+                        and jnp.issubdtype(v.dtype, jnp.floating) else v
+                        for v in vals]
+                out = _fn(*cast)
+                if isinstance(out, tuple):
+                    return tuple(o.astype(jnp.float32) for o in out)
+                return out.astype(jnp.float32)
+
+            # recompute output avals under the cast
+            import jax
+
+            avals_in = _avals_of(new_parents)
+            out = jax.eval_shape(amp_fn, *avals_in)
+            outs = (out,) if not isinstance(out, (tuple, list)) \
+                else tuple(out)
+            return _g.OpNode(amp_fn, new_parents, list(outs), node.name,
+                             node.single)
+
+        return rewrite_program(fetches, transform)
+
+
+# ---------------------------------------------------------- recompute pass
+@register_pass("auto_parallel_recompute")
+class RecomputePass(PassBase):
+    """Mark op families for rematerialization (reference:
+    distributed/passes/auto_parallel_recompute.py): wrapped ops save
+    nothing for backward — jax.checkpoint recomputes them. attrs:
+    op_names (set, default matmul-family + activations)."""
+
+    DEFAULT = {"matmul", "bmm", "mm", "linear", "einsum", "gelu", "relu",
+               "tanh", "softmax", "flash_attention"}
+
+    def apply(self, fetches, context=None):
+        import jax
+
+        names = set(self.get_attr("op_names", self.DEFAULT))
+
+        def transform(node, new_parents):
+            if node.name not in names:
+                return _identity_clone(node, new_parents)
+            fn = jax.checkpoint(node.fn)
+            return _g.OpNode(fn, new_parents, node.out_avals, node.name,
+                             node.single)
+
+        return rewrite_program(fetches, transform)
+
+
+def _avals_of(parents):
+    import jax
+
+    avals = []
+    for p in parents:
+        if isinstance(p, tuple):
+            avals.append(p[0].out_avals[p[1]])
+        elif isinstance(p, _g.FeedLeaf):
+            avals.append(p.aval)
+        elif isinstance(p, Tensor):
+            avals.append(jax.ShapeDtypeStruct(tuple(p._data.shape),
+                                              p._data.dtype))
+        else:
+            avals.append(p)
+    return avals
+
+
+from .pipeline_scheduler_pass import (  # noqa: E402,F401
+    Pipeline1F1BPass,
+    PipelineFThenBPass,
+    StagedProgram,
+)
+
+__all__ += ["StagedProgram", "PipelineFThenBPass", "Pipeline1F1BPass"]

