@@ -106,3 +106,7 @@ from .auto_parallel.api import (  # noqa: F401
     ShardingStage3,
 )
 from .checkpoint import load_state_dict, save_state_dict  # noqa: F401
+from . import fleet_executor  # noqa: F401,E402
+from . import passes  # noqa: F401,E402
+from .auto_parallel import cluster as _cluster  # noqa: F401,E402
+from .auto_parallel import cost_model as _cost_model  # noqa: F401,E402

