@@ -1,7 +1,9 @@
-"""Audio features (reference: python/paddle/audio/)."""
-from . import features, functional  # noqa: F401
+"""Audio features + IO (reference: python/paddle/audio/)."""
+from . import backends, datasets, features, functional  # noqa: F401
+from .backends import info, load, save  # noqa: F401
 from .features import (LogMelSpectrogram, MFCC, MelSpectrogram,  # noqa: F401
                        Spectrogram)
 
-__all__ = ["functional", "features", "Spectrogram", "MelSpectrogram",
+__all__ = ["functional", "features", "backends", "datasets", "info",
+           "load", "save", "Spectrogram", "MelSpectrogram",
            "LogMelSpectrogram", "MFCC"]

@@ -1,0 +1,100 @@
+"""paddle.audio.backends (reference: python/paddle/audio/backends/ —
+init_backend.py get_current_backend/list_available_backends/set_backend
+over the wave backend).
+
+Zero-dependency wave backend: stdlib ``wave`` handles 16-bit PCM WAV —
+the format the reference's bundled backend supports without soundfile.
+"""
+from __future__ import annotations
+
+import wave as _wave
+
+import numpy as np
+
+__all__ = ["get_current_backend", "list_available_backends",
+           "set_backend", "AudioInfo", "info", "load", "save"]
+
+_backend = "wave_backend"
+
+
+def list_available_backends():
+    out = ["wave_backend"]
+    try:  # pragma: no cover - not in this image
+        import soundfile  # noqa: F401
+
+        out.append("soundfile")
+    except ImportError:
+        pass
+    return out
+
+
+def get_current_backend():
+    return _backend
+
+
+def set_backend(backend_name):
+    if backend_name not in list_available_backends():
+        raise NotImplementedError(
+            f"backend {backend_name!r} not available; choose from "
+            f"{list_available_backends()}")
+    global _backend
+    _backend = backend_name
+
+
+class AudioInfo:
+    """reference: audio/backends/backend.py AudioInfo."""
+
+    def __init__(self, sample_rate, num_samples, num_channels,
+                 bits_per_sample, encoding="PCM_S"):
+        self.sample_rate = sample_rate
+        self.num_samples = num_samples
+        self.num_channels = num_channels
+        self.bits_per_sample = bits_per_sample
+        self.encoding = encoding
+
+
+def info(filepath):
+    """reference: paddle.audio.info."""
+    with _wave.open(filepath, "rb") as f:
+        return AudioInfo(f.getframerate(), f.getnframes(),
+                         f.getnchannels(), f.getsampwidth() * 8)
+
+
+def load(filepath, frame_offset=0, num_frames=-1, normalize=True,
+         channels_first=True):
+    """reference: paddle.audio.load -> (Tensor [C, L] float32, sr)."""
+    from ...core.tensor import Tensor
+    import jax.numpy as jnp
+
+    with _wave.open(filepath, "rb") as f:
+        sr = f.getframerate()
+        n = f.getnframes()
+        ch = f.getnchannels()
+        width = f.getsampwidth()
+        f.setpos(frame_offset)
+        count = n - frame_offset if num_frames < 0 else num_frames
+        raw = f.readframes(count)
+    dtype = {1: np.int8, 2: np.int16, 4: np.int32}[width]
+    data = np.frombuffer(raw, dtype=dtype).reshape(-1, ch)
+    if normalize:
+        data = data.astype(np.float32) / float(2 ** (8 * width - 1))
+    arr = data.T if channels_first else data
+    return Tensor(jnp.asarray(np.ascontiguousarray(arr))), sr
+
+
+def save(filepath, src, sample_rate, channels_first=True,
+         encoding="PCM_16", bits_per_sample=16):
+    """reference: paddle.audio.save — 16-bit PCM WAV."""
+    from ...core.tensor import Tensor
+
+    a = np.asarray(src.numpy() if isinstance(src, Tensor) else src)
+    if channels_first:
+        a = a.T
+    if a.dtype.kind == "f":
+        a = np.clip(a, -1.0, 1.0)
+        a = (a * (2 ** 15 - 1)).astype(np.int16)
+    with _wave.open(filepath, "wb") as f:
+        f.setnchannels(a.shape[1] if a.ndim == 2 else 1)
+        f.setsampwidth(2)
+        f.setframerate(int(sample_rate))
+        f.writeframes(np.ascontiguousarray(a).tobytes())

@@ -1,0 +1,125 @@
+"""paddle.audio.datasets (reference: python/paddle/audio/datasets/ —
+TESS, ESC50 over local archives).
+
+Zero-egress: parses local extracted dataset directories when present
+(wav files named per each corpus' convention); synthesizes deterministic
+waveforms otherwise so pipelines run in CI.
+"""
+from __future__ import annotations
+
+import os
+from typing import List
+
+import numpy as np
+
+from ...io import Dataset
+
+__all__ = ["TESS", "ESC50"]
+
+
+class _AudioClassDataset(Dataset):
+    n_classes = 2
+    sample_rate = 16000
+
+    def __init__(self, mode="train", feat_type="raw", archive=None,
+                 **feat_kwargs):
+        self.mode = mode
+        self.feat_type = feat_type
+        self.feat_kwargs = feat_kwargs
+        self._files: List[str] = []
+        self._labels: List[int] = []
+        root = archive or os.path.join(
+            os.environ.get("PADDLE_TPU_DATA_HOME",
+                           os.path.expanduser("~/.cache/paddle_tpu")),
+            self.__class__.__name__.lower())
+        if os.path.isdir(root):
+            self._scan(root)
+        self._synth = len(self._files) == 0
+        self._n = int(os.environ.get("PADDLE_TPU_SYNTH_SAMPLES", 32)) \
+            if self._synth else len(self._files)
+
+    def _scan(self, root):
+        raise NotImplementedError
+
+    def _feature(self, wav):
+        if self.feat_type == "raw":
+            return wav.astype(np.float32)
+        from .. import features as F
+        import paddle_tpu as pt
+
+        x = pt.to_tensor(wav.astype(np.float32)[None])
+        extractor = {
+            "spectrogram": F.Spectrogram,
+            "melspectrogram": F.MelSpectrogram,
+            "logmelspectrogram": F.LogMelSpectrogram,
+            "mfcc": F.MFCC,
+        }[self.feat_type](sr=self.sample_rate, **self.feat_kwargs) \
+            if self.feat_type != "spectrogram" \
+            else F.Spectrogram(**self.feat_kwargs)
+        return extractor(x).numpy()[0]
+
+    def __getitem__(self, idx):
+        if self._synth:
+            rng = np.random.RandomState(idx)
+            label = idx % self.n_classes
+            t = np.arange(self.sample_rate, dtype=np.float32) \
+                / self.sample_rate
+            wav = 0.3 * np.sin(2 * np.pi * (200 + 50 * label) * t) \
+                + 0.05 * rng.randn(self.sample_rate).astype(np.float32)
+        else:
+            from ..backends import load
+
+            sig, _ = load(self._files[idx])
+            wav = sig.numpy()[0]
+            label = self._labels[idx]
+        return self._feature(wav), np.int32(label)
+
+    def __len__(self):
+        return self._n
+
+
+class TESS(_AudioClassDataset):
+    """Toronto emotional speech set (reference audio/datasets/tess.py):
+    7 emotions encoded in the wav filename's last token."""
+
+    n_classes = 7
+    EMOTIONS = ["angry", "disgust", "fear", "happy", "neutral", "ps",
+                "sad"]
+
+    def _scan(self, root):
+        for dirpath, _, files in os.walk(root):
+            for fn in sorted(files):
+                if not fn.lower().endswith(".wav"):
+                    continue
+                emo = fn.rsplit("_", 1)[-1][:-4].lower()
+                if emo in self.EMOTIONS:
+                    self._files.append(os.path.join(dirpath, fn))
+                    self._labels.append(self.EMOTIONS.index(emo))
+
+
+class ESC50(_AudioClassDataset):
+    """ESC-50 environmental sounds (reference audio/datasets/esc50.py):
+    label is the last dash field of the filename, fold the first."""
+
+    n_classes = 50
+    sample_rate = 44100
+
+    def __init__(self, mode="train", split=1, feat_type="raw",
+                 archive=None, **feat_kwargs):
+        self.split = split
+        super().__init__(mode=mode, feat_type=feat_type, archive=archive,
+                         **feat_kwargs)
+
+    def _scan(self, root):
+        for dirpath, _, files in os.walk(root):
+            for fn in sorted(files):
+                if not fn.lower().endswith(".wav"):
+                    continue
+                parts = fn[:-4].split("-")
+                if len(parts) != 4:
+                    continue
+                fold, target = int(parts[0]), int(parts[3])
+                test_fold = fold == self.split
+                if (self.mode == "train") != test_fold:
+                    self._files.append(os.path.join(dirpath, fn))
+                    self._labels.append(target)

@@ -118,3 +118,134 @@ def get_cudnn_version():
 
 def is_compiled_with_cinn() -> bool:
     return False  # XLA plays CINN's role (SURVEY §2.4.9)
+
+
+# ---- round-4 parity surface (reference: python/paddle/device/__init__.py)
+class XPUPlace:
+    def __init__(self, dev_id=0):
+        self.dev_id = dev_id
+
+    def __repr__(self):
+        return f"Place(xpu:{self.dev_id})"
+
+
+class IPUPlace:
+    def __init__(self, dev_id=0):
+        self.dev_id = dev_id
+
+    def __repr__(self):
+        return f"Place(ipu:{self.dev_id})"
+
+
+class Stream:
+    """reference: device/__init__.py Stream. XLA on TPU schedules one
+    compute stream per core; this object carries the API surface
+    (synchronize waits on all dispatched work)."""
+
+    def __init__(self, device=None, priority=2):
+        self.device = device
+        self.priority = priority
+
+    def synchronize(self):
+        import jax
+
+        (jax.device_put(0.0) + 0).block_until_ready()
+
+    def wait_event(self, event):
+        event.synchronize()
+
+    def wait_stream(self, stream):
+        stream.synchronize()
+
+    def record_event(self, event=None):
+        ev = event or Event()
+        ev.record(self)
+        return ev
+
+    def query(self):
+        return True
+
+
+class Event:
+    """reference: device/__init__.py Event."""
+
+    def __init__(self, device=None, enable_timing=False, blocking=False,
+                 interprocess=False):
+        self.device = device
+        self._stream = None
+
+    def record(self, stream=None):
+        self._stream = stream or current_stream()
+
+    def query(self):
+        return True
+
+    def synchronize(self):
+        if self._stream is not None:
+            self._stream.synchronize()
+
+
+_default_stream = Stream()
+_stream_stack = []
+
+
+def current_stream(device=None):
+    return _stream_stack[-1] if _stream_stack else _default_stream
+
+
+def set_stream(stream):
+    prev = current_stream()
+    _stream_stack.append(stream)
+    return prev
+
+
+class stream_guard:
+    """reference: device/__init__.py stream_guard."""
+
+    def __init__(self, stream):
+        self.stream = stream
+
+    def __enter__(self):
+        set_stream(self.stream)
+        return self.stream
+
+    def __exit__(self, *exc):
+        _stream_stack.pop()
+        return False
+
+
+def is_compiled_with_rocm():
+    return False
+
+
+def is_compiled_with_ipu():
+    return False
+
+
+def is_compiled_with_custom_device(device_type=None):
+    return False
+
+
+def is_compiled_with_distribute():
+    return True
+
+
+def get_all_device_type():
+    import jax
+
+    return sorted({d.platform for d in jax.devices()})
+
+
+def get_all_custom_device_type():
+    return []
+
+
+def get_available_custom_device():
+    return []
+
+
+__all__ += ["XPUPlace", "IPUPlace", "Stream", "Event", "current_stream",
+            "set_stream", "stream_guard", "is_compiled_with_rocm",
+            "is_compiled_with_ipu", "is_compiled_with_custom_device",
+            "is_compiled_with_distribute", "get_all_device_type",
+            "get_all_custom_device_type", "get_available_custom_device"]

@@ -12,6 +12,14 @@ from .fleet import (  # noqa: F401
     worker_num,
 )
 from .topology import CommunicateTopology, HybridCommunicateGroup, ParallelMode  # noqa: F401
+from .base_role import (  # noqa: F401
+    MultiSlotDataGenerator,
+    MultiSlotStringDataGenerator,
+    PaddleCloudRoleMaker,
+    Role,
+    UserDefinedRoleMaker,
+    UtilBase,
+)
 from . import meta_parallel  # noqa: F401
 from . import recompute as _recompute_mod  # noqa: F401
 from .recompute import recompute, recompute_sequential  # noqa: F401

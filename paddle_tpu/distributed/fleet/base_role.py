@@ -1,0 +1,201 @@
+"""Fleet role makers + util + data generators (reference:
+python/paddle/distributed/fleet/base/role_maker.py
+PaddleCloudRoleMaker/UserDefinedRoleMaker, base/util_factory.py UtilBase,
+data_generator/data_generator.py MultiSlot*DataGenerator).
+"""
+from __future__ import annotations
+
+import os
+from typing import List
+
+__all__ = ["Role", "PaddleCloudRoleMaker", "UserDefinedRoleMaker",
+           "UtilBase", "MultiSlotDataGenerator",
+           "MultiSlotStringDataGenerator"]
+
+
+class Role:
+    """reference: role_maker.py Role enum."""
+
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
+    COORDINATOR = 5
+
+
+class PaddleCloudRoleMaker:
+    """Role from the launcher environment (reference: role_maker.py
+    PaddleCloudRoleMaker — collective mode reads PADDLE_TRAINER_*)."""
+
+    def __init__(self, is_collective=True, **kwargs):
+        self._is_collective = is_collective
+        self._rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        self._size = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        self._endpoints = eps.split(",") if eps else ["127.0.0.1:0"]
+        self._role = Role.WORKER
+
+    def is_worker(self):
+        return self._role == Role.WORKER
+
+    def is_server(self):
+        return self._role == Role.SERVER
+
+    def is_first_worker(self):
+        return self.is_worker() and self._rank == 0
+
+    def worker_index(self):
+        return self._rank
+
+    def role_id(self):
+        return self._rank
+
+    def worker_num(self):
+        return self._size
+
+    def server_num(self):
+        return 0
+
+    def get_trainer_endpoints(self):
+        return list(self._endpoints)
+
+    def get_pserver_endpoints(self):
+        return []
+
+    def _generate_role(self):
+        pass
+
+
+class UserDefinedRoleMaker(PaddleCloudRoleMaker):
+    """Explicit role assignment (reference: role_maker.py
+    UserDefinedRoleMaker)."""
+
+    def __init__(self, is_collective=False, init_gloo=False, *,
+                 current_id=0, role=Role.WORKER, worker_num=1,
+                 server_endpoints=None, **kwargs):
+        super().__init__(is_collective=is_collective)
+        self._rank = current_id
+        self._role = role
+        self._size = worker_num
+        self._server_endpoints = list(server_endpoints or [])
+
+    def get_pserver_endpoints(self):
+        return list(self._server_endpoints)
+
+
+class UtilBase:
+    """Cross-rank small-object utilities (reference: util_factory.py
+    UtilBase) over the collective API when a group is initialized."""
+
+    def _initialized(self):
+        from ..parallel_env import is_initialized
+
+        return is_initialized()
+
+    def all_reduce(self, input, mode="sum", comm_world="worker"):
+        import numpy as np
+
+        if not self._initialized():
+            return input
+        from .. import collective as C
+        import paddle_tpu as pt
+
+        t = pt.to_tensor(np.asarray(input))
+        op = {"sum": C.ReduceOp.SUM, "max": C.ReduceOp.MAX,
+              "min": C.ReduceOp.MIN}[mode]
+        C.all_reduce(t, op=op)
+        return t.numpy()
+
+    def barrier(self, comm_world="worker"):
+        if self._initialized():
+            from .. import collective as C
+
+            C.barrier()
+
+    def all_gather(self, input, comm_world="worker"):
+        if not self._initialized():
+            return [input]
+        from .. import collective as C
+
+        out = []
+        C.all_gather_object(out, input)
+        return out
+
+    def get_file_shard(self, files: List[str]):
+        """Split a file list over workers (reference UtilBase
+        get_file_shard)."""
+        from ..parallel_env import get_rank, get_world_size
+
+        rank, size = (get_rank(), get_world_size()) \
+            if self._initialized() else (0, 1)
+        n = len(files)
+        base, extra = divmod(n, size)
+        start = rank * base + min(rank, extra)
+        count = base + (1 if rank < extra else 0)
+        return files[start:start + count]
+
+    def print_on_rank(self, message, rank_id=0):
+        from ..parallel_env import get_rank
+
+        if not self._initialized() or get_rank() == rank_id:
+            print(message)
+
+
+class _DataGeneratorBase:
+    """Line -> slots generator protocol (reference:
+    fleet/data_generator/data_generator.py): subclasses implement
+    generate_sample(line) returning an iterator of
+    [(slot_name, values), ...]; run_from_stdin/files format them for the
+    dataset readers."""
+
+    def __init__(self):
+        self._line_limit = None
+
+    def generate_sample(self, line):
+        raise NotImplementedError(
+            "implement generate_sample(line) returning an iterator of "
+            "[(name, values), ...]")
+
+    def set_batch(self, batch_size):
+        self._batch = batch_size
+
+    def _format(self, record):
+        raise NotImplementedError
+
+    def run_from_stdin(self):
+        import sys
+
+        for line in sys.stdin:
+            for rec in self.generate_sample(line)():
+                sys.stdout.write(self._format(rec))
+
+    def run_from_files(self, filelist, output):
+        with open(output, "w") as out:
+            for fname in filelist:
+                with open(fname) as f:
+                    for line in f:
+                        for rec in self.generate_sample(line)():
+                            out.write(self._format(rec))
+
+
+class MultiSlotDataGenerator(_DataGeneratorBase):
+    """Numeric slots: `name:n v1..vn` per slot (reference
+    MultiSlotDataGenerator._gen_str)."""
+
+    def _format(self, record):
+        parts = []
+        for name, values in record:
+            vals = list(values)
+            parts.append(f"{len(vals)} " + " ".join(str(v) for v in vals))
+        return " ".join(parts) + "\n"
+
+
+class MultiSlotStringDataGenerator(_DataGeneratorBase):
+    """String slots (reference MultiSlotStringDataGenerator)."""
+
+    def _format(self, record):
+        parts = []
+        for name, values in record:
+            vals = [str(v) for v in values]
+            parts.append(f"{len(vals)} " + " ".join(vals))
+        return " ".join(parts) + "\n"

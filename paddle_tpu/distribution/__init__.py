@@ -20,8 +20,10 @@ from .multivariate_normal import MultivariateNormal  # noqa: F401
 from .lkj_cholesky import LKJCholesky  # noqa: F401
 from .kl import kl_divergence, register_kl  # noqa: F401
 from .transform import (AbsTransform, AffineTransform,  # noqa: F401
-                        ChainTransform, ExpTransform, SigmoidTransform,
-                        Transform)
+                        ChainTransform, ExpTransform, IndependentTransform,
+                        PowerTransform, ReshapeTransform, SigmoidTransform,
+                        SoftmaxTransform, StackTransform,
+                        StickBreakingTransform, TanhTransform, Transform)
 from .transformed_distribution import (  # noqa: F401
     Independent, TransformedDistribution)
 
@@ -33,4 +35,7 @@ __all__ = ["Distribution", "Normal", "LogNormal", "Uniform", "Categorical",
            "kl_divergence", "register_kl",
            "Transform", "AffineTransform", "ExpTransform",
            "SigmoidTransform", "AbsTransform", "ChainTransform",
+           "PowerTransform", "TanhTransform", "SoftmaxTransform",
+           "StickBreakingTransform", "ReshapeTransform",
+           "IndependentTransform", "StackTransform",
            "TransformedDistribution", "Independent"]

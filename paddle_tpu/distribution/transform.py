@@ -102,3 +102,187 @@ class ChainTransform(Transform):
                 lambda a, b: a + b, [total, j], "add")
             x = t.forward(x)
         return total
+
+
+class PowerTransform(Transform):
+    """y = x^a (reference transform.py PowerTransform)."""
+
+    def __init__(self, power):
+        self.power = _as_t(power)
+
+    def forward(self, x):
+        return _op(lambda a, v: jnp.power(v, a),
+                   [self.power, _as_t(x)], "power_fwd")
+
+    def inverse(self, y):
+        return _op(lambda a, v: jnp.power(v, 1.0 / a),
+                   [self.power, _as_t(y)], "power_inv")
+
+    def forward_log_det_jacobian(self, x):
+        return _op(lambda a, v: jnp.log(jnp.abs(a * jnp.power(v, a - 1))),
+                   [self.power, _as_t(x)], "power_ldj")
+
+
+class TanhTransform(Transform):
+    """y = tanh(x) (reference transform.py TanhTransform)."""
+
+    def forward(self, x):
+        return _op(jnp.tanh, [_as_t(x)], "tanh")
+
+    def inverse(self, y):
+        return _op(jnp.arctanh, [_as_t(y)], "atanh")
+
+    def forward_log_det_jacobian(self, x):
+        # log(1 - tanh(x)^2) = 2(log 2 - x - softplus(-2x))
+        return _op(lambda v: 2.0 * (jnp.log(2.0) - v
+                                    - jax.nn.softplus(-2.0 * v)),
+                   [_as_t(x)], "tanh_ldj")
+
+
+class SoftmaxTransform(Transform):
+    """exp then normalize on the last axis (reference transform.py
+    SoftmaxTransform; not bijective — inverse is log up to an additive
+    constant, matching the reference contract)."""
+
+    def forward(self, x):
+        return _op(lambda v: jax.nn.softmax(v, axis=-1), [_as_t(x)],
+                   "softmax_fwd")
+
+    def inverse(self, y):
+        return _op(jnp.log, [_as_t(y)], "softmax_inv")
+
+
+class StickBreakingTransform(Transform):
+    """Unconstrained R^k -> k+1 simplex via stick breaking (reference
+    transform.py StickBreakingTransform)."""
+
+    def forward(self, x):
+        def fn(v):
+            offset = v.shape[-1] - jnp.arange(v.shape[-1])
+            z = jax.nn.sigmoid(v - jnp.log(offset.astype(v.dtype)))
+            zpad = jnp.concatenate([z, jnp.ones(z.shape[:-1] + (1,),
+                                                z.dtype)], -1)
+            one_minus = jnp.concatenate(
+                [jnp.ones(z.shape[:-1] + (1,), z.dtype),
+                 jnp.cumprod(1 - z, -1)], -1)
+            return zpad * one_minus
+
+        return _op(fn, [_as_t(x)], "stickbreaking_fwd")
+
+    def inverse(self, y):
+        def fn(v):
+            k = v.shape[-1] - 1
+            cum = jnp.concatenate(
+                [jnp.zeros(v.shape[:-1] + (1,), v.dtype),
+                 jnp.cumsum(v[..., :-1], -1)], -1)[..., :k]
+            rest = 1 - cum
+            z = v[..., :k] / rest
+            offset = k - jnp.arange(k)
+            return jnp.log(z / (1 - z)) + jnp.log(
+                offset.astype(v.dtype))
+
+        return _op(fn, [_as_t(y)], "stickbreaking_inv")
+
+    def forward_log_det_jacobian(self, x):
+        def fn(v):
+            offset = v.shape[-1] - jnp.arange(v.shape[-1])
+            u = v - jnp.log(offset.astype(v.dtype))
+            z = jax.nn.sigmoid(u)
+            rest = jnp.concatenate(
+                [jnp.ones(z.shape[:-1] + (1,), z.dtype),
+                 jnp.cumprod(1 - z, -1)[..., :-1]], -1)
+            return jnp.sum(jnp.log(z) + jnp.log1p(-z) + jnp.log(rest),
+                           -1)
+
+        return _op(fn, [_as_t(x)], "stickbreaking_ldj")
+
+
+class ReshapeTransform(Transform):
+    """reference transform.py ReshapeTransform."""
+
+    def __init__(self, in_event_shape, out_event_shape):
+        self.in_event_shape = tuple(in_event_shape)
+        self.out_event_shape = tuple(out_event_shape)
+
+    def forward(self, x):
+        xv = _as_t(x)
+        batch = tuple(xv.shape)[:len(tuple(xv.shape))
+                                - len(self.in_event_shape)]
+        return _op(lambda v: v.reshape(batch + self.out_event_shape),
+                   [xv], "reshape_fwd")
+
+    def inverse(self, y):
+        yv = _as_t(y)
+        batch = tuple(yv.shape)[:len(tuple(yv.shape))
+                                - len(self.out_event_shape)]
+        return _op(lambda v: v.reshape(batch + self.in_event_shape),
+                   [yv], "reshape_inv")
+
+    def forward_log_det_jacobian(self, x):
+        xv = _as_t(x)
+        batch = tuple(xv.shape)[:len(tuple(xv.shape))
+                                - len(self.in_event_shape)]
+        return Tensor(jnp.zeros(batch))
+
+
+class IndependentTransform(Transform):
+    """Reinterpret batch dims of a base transform as event dims
+    (reference transform.py IndependentTransform): the log-det sums over
+    the reinterpreted dims."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+
+    def forward(self, x):
+        return self.base.forward(x)
+
+    def inverse(self, y):
+        return self.base.inverse(y)
+
+    def forward_log_det_jacobian(self, x):
+        ldj = self.base.forward_log_det_jacobian(x)
+        axes = tuple(range(-self.rank, 0))
+        return _op(lambda v: jnp.sum(v, axis=axes), [ldj],
+                   "independent_ldj")
+
+
+class StackTransform(Transform):
+    """Apply a list of transforms along an axis (reference transform.py
+    StackTransform)."""
+
+    def __init__(self, transforms, axis=0):
+        self.transforms = list(transforms)
+        self.axis = int(axis)
+
+    def _split(self, x):
+        from ..ops.manipulation import unstack
+
+        return unstack(_as_t(x), axis=self.axis)
+
+    def _stack(self, parts):
+        from ..ops.manipulation import stack
+
+        return stack(parts, axis=self.axis)
+
+    def forward(self, x):
+        parts = self._split(x)
+        return self._stack([t.forward(p)
+                            for t, p in zip(self.transforms, parts)])
+
+    def inverse(self, y):
+        parts = self._split(y)
+        return self._stack([t.inverse(p)
+                            for t, p in zip(self.transforms, parts)])
+
+    def forward_log_det_jacobian(self, x):
+        parts = self._split(x)
+        return self._stack([t.forward_log_det_jacobian(p)
+                            for t, p in zip(self.transforms, parts)])
+
+
+__all__ = ["Transform", "AffineTransform", "ExpTransform",
+           "SigmoidTransform", "AbsTransform", "ChainTransform",
+           "PowerTransform", "TanhTransform", "SoftmaxTransform",
+           "StickBreakingTransform", "ReshapeTransform",
+           "IndependentTransform", "StackTransform"]

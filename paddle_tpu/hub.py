@@ -1,0 +1,58 @@
+"""paddle.hub (reference: python/paddle/hub.py — help/list/load over
+hubconf.py repos).
+
+Zero-egress build: only ``source="local"`` repos load (a directory with
+a hubconf.py declaring entrypoint functions); github/gitee sources raise
+with guidance.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+__all__ = ["list", "help", "load"]
+
+_builtin_list = list
+
+
+def _load_hubconf(repo_dir):
+    path = os.path.join(repo_dir, "hubconf.py")
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"no hubconf.py under {repo_dir}")
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("paddle_tpu_hubconf",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["paddle_tpu_hubconf"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _check_source(source):
+    if source != "local":
+        raise NotImplementedError(
+            f"hub source {source!r} needs network egress; this build "
+            "loads source='local' repo directories only")
+
+
+def list(repo_dir, source="local", force_reload=False):
+    """Entrypoint names exported by the repo's hubconf."""
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    return [n for n in dir(mod)
+            if callable(getattr(mod, n)) and not n.startswith("_")]
+
+
+def help(repo_dir, model, source="local", force_reload=False):
+    """Docstring of one entrypoint."""
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    return getattr(mod, model).__doc__
+
+
+def load(repo_dir, model, source="local", force_reload=False, **kwargs):
+    """Instantiate an entrypoint."""
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    return getattr(mod, model)(**kwargs)

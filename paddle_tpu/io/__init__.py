@@ -478,3 +478,24 @@ class DataLoader:
                 return self._iter_processes()
             return self._iter_workers()
         return self._iter_single()
+
+
+class SubsetRandomSampler(Sampler):
+    """Sample a fixed index subset in random order (reference:
+    python/paddle/io/sampler.py SubsetRandomSampler)."""
+
+    def __init__(self, indices):
+        self.indices = list(indices)
+
+    def __iter__(self):
+        import random
+
+        order = list(self.indices)
+        random.shuffle(order)
+        return iter(order)
+
+    def __len__(self):
+        return len(self.indices)
+
+
+__all__.append("SubsetRandomSampler")

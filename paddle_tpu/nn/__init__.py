@@ -36,6 +36,9 @@ from .layer import (  # noqa: F401
 )
 
 
+from . import utils  # noqa: E402
+
+
 def utils_clip_grad_norm_(parameters, max_norm, norm_type=2.0,
                           error_if_nonfinite=False):
     from .utils.clip_grad import clip_grad_norm_

@@ -208,3 +208,30 @@ class Dirac(Initializer):
                 idx = (g * (out_c // self.groups) + i, i) + centers
                 w = w.at[idx].set(1.0)
         return w
+
+
+class Bilinear(Initializer):
+    """Bilinear-interpolation kernel init for transposed-conv upsampling
+    (reference: python/paddle/nn/initializer/Bilinear)."""
+
+    def __call__(self, shape, dtype=jnp.float32):
+        shape = tuple(shape)
+        if len(shape) != 4:
+            raise ValueError("Bilinear initializer expects a 4-D weight")
+        import numpy as np
+
+        _, _, kh, kw = shape
+        fh, fw = (kh + 1) // 2, (kw + 1) // 2
+        ch = (2 * fh - 1 - fh % 2) / (2.0 * fh)
+        cw = (2 * fw - 1 - fw % 2) / (2.0 * fw)
+        og = np.ogrid[:kh, :kw]
+        filt = ((1 - abs(og[0] / fh - ch))
+                * (1 - abs(og[1] / fw - cw))).astype(np.float32)
+        w = np.zeros(shape, np.float32)
+        for i in range(shape[0]):
+            for j in range(shape[1]):
+                w[i, j] = filt
+        return jnp.asarray(w, dtype=dtype)
+
+
+__all__.append("Bilinear")

@@ -1,0 +1,132 @@
+"""paddle.nn.utils (reference: python/paddle/nn/utils/__init__.py —
+weight_norm, spectral_norm hooks, parameter flattening, grad clipping).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Tensor
+from .clip_grad import clip_grad_norm_, clip_grad_value_  # noqa: F401
+
+__all__ = ["weight_norm", "remove_weight_norm", "spectral_norm",
+           "parameters_to_vector", "vector_to_parameters",
+           "clip_grad_norm_", "clip_grad_value_"]
+
+
+def _norm_along(w, dim):
+    if dim is None:
+        return jnp.sqrt(jnp.sum(w * w))
+    axes = tuple(i for i in range(w.ndim) if i != dim)
+    return jnp.sqrt(jnp.sum(w * w, axis=axes, keepdims=True))
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Reparameterize layer.<name> as g * v/||v|| (reference:
+    nn/utils/weight_norm_hook.py). The decomposition is recomputed in a
+    pre-forward hook, so optimizers train weight_g / weight_v."""
+    w = getattr(layer, name)
+    wd = w._data
+    g0 = _norm_along(wd, dim)
+    from ..layer.layers import Parameter
+
+    weight_g = Parameter(g0)
+    weight_v = Parameter(wd)
+    layer.add_parameter(name + "_g", weight_g)
+    layer.add_parameter(name + "_v", weight_v)
+    # the original weight becomes derived state, not a parameter
+    if name in layer._parameters:
+        del layer._parameters[name]
+
+    def _recompute(lyr, *args):
+        v = getattr(lyr, name + "_v")._data
+        g = getattr(lyr, name + "_g")._data
+        norm = _norm_along(v, dim)
+        new_w = Tensor(v / jnp.maximum(norm, 1e-12) * g)
+        object.__setattr__(lyr, name, new_w)
+
+    handle = layer.register_forward_pre_hook(_recompute) \
+        if hasattr(layer, "register_forward_pre_hook") else None
+    layer._weight_norm_state = (name, dim, handle)
+    _recompute(layer)
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    """reference: nn/utils/weight_norm_hook.py remove_weight_norm."""
+    state = getattr(layer, "_weight_norm_state", None)
+    if state is None:
+        raise ValueError(f"weight_norm not applied to {layer}")
+    nm, dim, handle = state
+    v = getattr(layer, nm + "_v")._data
+    g = getattr(layer, nm + "_g")._data
+    w = v / jnp.maximum(_norm_along(v, dim), 1e-12) * g
+    from ..layer.layers import Parameter
+
+    layer.add_parameter(nm, Parameter(w))
+    del layer._parameters[nm + "_g"]
+    del layer._parameters[nm + "_v"]
+    if handle is not None:
+        handle.remove()
+    del layer._weight_norm_state
+    return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=None):
+    """Spectral normalization via power iteration (reference:
+    nn/utils/spectral_norm_hook.py). State (u, v) persists on the layer;
+    the weight is renormalized in a pre-forward hook."""
+    w = getattr(layer, name)._data
+    if dim is None:
+        dim = 0
+    wm = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+    rng = np.random.RandomState(0)
+    u = jnp.asarray(rng.randn(wm.shape[0]), w.dtype)
+    u = u / jnp.maximum(jnp.linalg.norm(u), eps)
+    from ..layer.layers import Parameter
+
+    orig = Parameter(w)
+    layer.add_parameter(name + "_orig", orig)
+    if name in layer._parameters:
+        del layer._parameters[name]
+    layer._spectral_state = {"u": u, "name": name, "dim": dim}
+
+    def _recompute(lyr, *args):
+        st = lyr._spectral_state
+        wv = getattr(lyr, st["name"] + "_orig")._data
+        wmat = jnp.moveaxis(wv, st["dim"], 0).reshape(wv.shape[st["dim"]],
+                                                      -1)
+        uu = st["u"]
+        for _ in range(n_power_iterations):
+            vv = wmat.T @ uu
+            vv = vv / jnp.maximum(jnp.linalg.norm(vv), eps)
+            uu = wmat @ vv
+            uu = uu / jnp.maximum(jnp.linalg.norm(uu), eps)
+        st["u"] = uu
+        sigma = uu @ wmat @ vv
+        object.__setattr__(lyr, st["name"], Tensor(wv / sigma))
+
+    handle = layer.register_forward_pre_hook(_recompute) \
+        if hasattr(layer, "register_forward_pre_hook") else None
+    layer._spectral_state["handle"] = handle
+    _recompute(layer)
+    return layer
+
+
+def parameters_to_vector(parameters, name=None):
+    """reference: nn/utils/transform_parameters.py."""
+    return Tensor(jnp.concatenate(
+        [p._data.reshape(-1) for p in parameters]))
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    """reference: nn/utils/transform_parameters.py."""
+    v = vec._data if isinstance(vec, Tensor) else jnp.asarray(vec)
+    offset = 0
+    for p in parameters:
+        n = int(np.prod(p.shape))
+        p._data = v[offset:offset + n].reshape(tuple(p.shape)).astype(
+            p._data.dtype)
+        offset += n
+    return parameters

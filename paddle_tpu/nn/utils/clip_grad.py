@@ -1,0 +1,24 @@
+"""Gradient clipping utilities (reference: python/paddle/nn/utils/
+clip_grad_norm_.py, clip_grad_value_.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["clip_grad_norm_", "clip_grad_value_"]
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    from ..clip import clip_grad_norm_ as _impl
+
+    return _impl(parameters, max_norm, norm_type, error_if_nonfinite)
+
+
+def clip_grad_value_(parameters, clip_value):
+    """reference: clip_grad_value_.py — clamp each grad elementwise."""
+    params = [parameters] if not isinstance(parameters, (list, tuple)) \
+        else list(parameters)
+    cv = float(clip_value)
+    for p in params:
+        if p.grad is not None:
+            p.grad._data = jnp.clip(p.grad._data, -cv, cv)

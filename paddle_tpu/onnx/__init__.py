@@ -1,0 +1,22 @@
+"""paddle.onnx (reference: python/paddle/onnx/__init__.py — export via
+paddle2onnx).
+
+TPU-native interchange is StableHLO (jit.save / jax.export), which every
+XLA/PJRT runtime loads directly — that is what ``export`` writes here.
+Emitting the ONNX protobuf itself would require the paddle2onnx
+converter stack targeting the ONNX runtime rather than XLA; with no such
+converter in this image, the portable StableHLO artifact is the
+supported interchange format.
+"""
+from __future__ import annotations
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    """Export ``layer`` for interchange: parameters + (when input_spec is
+    given) the serialized StableHLO forward program."""
+    from .. import jit as _jit
+
+    _jit.save(layer, path, input_spec=input_spec)
+    return path

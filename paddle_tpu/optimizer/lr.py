@@ -329,3 +329,26 @@ class CosineAnnealingWarmRestarts(LRScheduler):
             T_i *= self.T_mult
         return (self.eta_min + (self.base_lr - self.eta_min)
                 * (1 + math.cos(math.pi * t / T_i)) / 2)
+
+
+class MultiplicativeDecay(LRScheduler):
+    """lr_{t} = lr_{t-1} * lr_lambda(t) (reference:
+    python/paddle/optimizer/lr.py MultiplicativeDecay)."""
+
+    def __init__(self, learning_rate, lr_lambda, last_epoch=-1,
+                 verbose=False):
+        self.lr_lambda = lr_lambda
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def get_lr(self):
+        cur = self.base_lr
+        for e in range(1, self.last_epoch + 1):
+            cur = cur * self.lr_lambda(e)
+        return cur
+
+    def state_dict(self):
+        return {k: v for k, v in super().state_dict().items()
+                if k != "lr_lambda"}
+
+
+__all__.append("MultiplicativeDecay")

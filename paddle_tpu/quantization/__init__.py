@@ -11,3 +11,61 @@ from .functional import fake_quant_dequant, quantize, dequantize  # noqa: F401
 __all__ = ["QuantConfig", "QAT", "PTQ", "AbsmaxObserver",
            "MovingAverageAbsmaxObserver", "FakeQuantLinear", "QuantedLinear",
            "fake_quant_dequant", "quantize", "dequantize"]
+
+
+class BaseObserver:
+    """Abstract observer (reference: python/paddle/quantization/
+    base_observer.py) — collects statistics during calibration and
+    yields quant params."""
+
+    def observe(self, x):
+        raise NotImplementedError
+
+    def cal_thresholds(self):
+        raise NotImplementedError
+
+    def scales(self):
+        raise NotImplementedError
+
+    def zero_points(self):
+        return 0
+
+    __call__ = lambda self, x: self.observe(x)
+
+
+class BaseQuanter:
+    """Abstract fake-quanter (reference: base_quanter.py) — simulates
+    quantization in forward (QAT) with straight-through gradients."""
+
+    def forward(self, x):
+        raise NotImplementedError
+
+    def scales(self):
+        raise NotImplementedError
+
+    def zero_points(self):
+        return 0
+
+    def bit_length(self):
+        return 8
+
+    __call__ = lambda self, x: self.forward(x)
+
+
+def quanter(name):
+    """Class decorator registering a custom quanter under ``name``
+    (reference: python/paddle/quantization/factory.py quanter): the
+    QuantConfig can then reference it symbolically."""
+    registry = getattr(quanter, "_registry", None)
+    if registry is None:
+        registry = quanter._registry = {}
+
+    def deco(cls):
+        registry[name] = cls
+        cls._quanter_name = name
+        return cls
+
+    return deco
+
+
+__all__ += ["BaseObserver", "BaseQuanter", "quanter"]

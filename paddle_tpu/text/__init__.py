@@ -145,3 +145,128 @@ class Imdb(Dataset):
 
     def __len__(self):
         return len(self.labels)
+
+
+class Conll05st(Dataset):
+    """reference: text/datasets/conll05.py — SRL dataset. Local-file
+    based: accepts a pre-tokenized .npz with object arrays per field
+    (word_ids, predicate_ids, label_ids); no network egress."""
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 **kwargs):
+        if data_file is None:
+            raise ValueError(
+                "Conll05st needs data_file= (.npz with word_ids/"
+                "predicate_ids/label_ids; no network egress)")
+        blob = np.load(data_file, allow_pickle=True)
+        self.words = blob["word_ids"]
+        self.preds = blob["predicate_ids"]
+        self.labels = blob["label_ids"]
+
+    def __getitem__(self, i):
+        return (np.asarray(self.words[i], np.int64),
+                np.asarray(self.preds[i], np.int64),
+                np.asarray(self.labels[i], np.int64))
+
+    def __len__(self):
+        return len(self.words)
+
+
+class Imikolov(Dataset):
+    """reference: text/datasets/imikolov.py — PTB n-gram LM dataset from
+    a local tokenized text file (one sentence per line)."""
+
+    def __init__(self, data_file: Optional[str] = None, data_type="NGRAM",
+                 window_size=5, mode="train", min_word_freq=50):
+        if data_file is None:
+            raise ValueError("Imikolov needs data_file= (no egress)")
+        sents = []
+        freq = {}
+        with open(data_file) as f:
+            for line in f:
+                toks = ["<s>"] + line.split() + ["<e>"]
+                sents.append(toks)
+                for t in toks:
+                    freq[t] = freq.get(t, 0) + 1
+        vocab = {w for w, c in freq.items() if c >= min_word_freq}
+        self.word_idx = {w: i for i, w in enumerate(sorted(vocab))}
+        unk = len(self.word_idx)
+        self.word_idx["<unk>"] = unk
+        self.samples = []
+        for toks in sents:
+            ids = [self.word_idx.get(t, unk) for t in toks]
+            if data_type.upper() == "NGRAM":
+                for j in range(window_size, len(ids) + 1):
+                    self.samples.append(
+                        np.asarray(ids[j - window_size:j], np.int64))
+            else:  # SEQ
+                self.samples.append(np.asarray(ids, np.int64))
+
+    def __getitem__(self, i):
+        return self.samples[i]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class Movielens(Dataset):
+    """reference: text/datasets/movielens.py — rating rows from a local
+    ml-1m style ratings file (`user::movie::rating::ts`)."""
+
+    def __init__(self, data_file: Optional[str] = None, mode="train",
+                 test_ratio=0.1, rand_seed=0):
+        if data_file is None:
+            raise ValueError("Movielens needs data_file= (no egress)")
+        rows = []
+        with open(data_file) as f:
+            for line in f:
+                parts = line.strip().split("::")
+                if len(parts) >= 3:
+                    rows.append((int(parts[0]), int(parts[1]),
+                                 float(parts[2])))
+        rng = np.random.RandomState(rand_seed)
+        order = rng.permutation(len(rows))
+        n_test = int(len(rows) * test_ratio)
+        pick = order[:n_test] if mode == "test" else order[n_test:]
+        self.rows = [rows[i] for i in pick]
+
+    def __getitem__(self, i):
+        u, m, r = self.rows[i]
+        return (np.int64(u), np.int64(m), np.float32(r))
+
+    def __len__(self):
+        return len(self.rows)
+
+
+class _WMTBase(Dataset):
+    """Shared WMT loader: local .npz with object arrays src_ids/trg_ids
+    (tokenized id lists per sentence pair)."""
+
+    def __init__(self, data_file: Optional[str] = None, mode="train",
+                 **kwargs):
+        if data_file is None:
+            raise ValueError(
+                f"{type(self).__name__} needs data_file= (.npz with "
+                "src_ids/trg_ids; no network egress)")
+        blob = np.load(data_file, allow_pickle=True)
+        self.src = blob["src_ids"]
+        self.trg = blob["trg_ids"]
+
+    def __getitem__(self, i):
+        s = np.asarray(self.src[i], np.int64)
+        t = np.asarray(self.trg[i], np.int64)
+        return s, t[:-1], t[1:]
+
+    def __len__(self):
+        return len(self.src)
+
+
+class WMT14(_WMTBase):
+    """reference: text/datasets/wmt14.py."""
+
+
+class WMT16(_WMTBase):
+    """reference: text/datasets/wmt16.py."""
+
+
+__all__ += ["Conll05st", "Imikolov", "Movielens", "WMT14", "WMT16"]

@@ -21,3 +21,57 @@ def run_check():
     assert float(y.numpy()) == 8.0
     dev = jax.devices()[0]
     print(f"paddle_tpu is installed successfully! device: {dev}")
+
+
+def deprecated(update_to="", since="", reason="", level=0):
+    """reference: python/paddle/utils/deprecated.py decorator."""
+    import functools
+    import warnings
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            msg = f"API {fn.__name__} is deprecated since {since}"
+            if update_to:
+                msg += f", use {update_to} instead"
+            if reason:
+                msg += f": {reason}"
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*args, **kwargs)
+
+        return wrapper
+
+    return deco
+
+
+def try_import(module_name, err_msg=None):
+    """reference: python/paddle/utils/lazy_import.py try_import."""
+    import importlib
+
+    try:
+        return importlib.import_module(module_name)
+    except ImportError:
+        raise ImportError(
+            err_msg or f"{module_name} is required but not installed")
+
+
+def require_version(min_version, max_version=None):
+    """reference: python/paddle/utils/__init__.py require_version —
+    checks the installed framework version."""
+    from ..version import full_version
+
+    def parse(v):
+        return tuple(int(x) for x in str(v).split(".")[:3]
+                     if x.isdigit())
+
+    cur = parse(full_version)
+    if parse(min_version) > cur:
+        raise Exception(
+            f"installed version {full_version} < required {min_version}")
+    if max_version is not None and parse(max_version) < cur:
+        raise Exception(
+            f"installed version {full_version} > allowed {max_version}")
+    return True
+
+
+__all__ += ["deprecated", "try_import", "require_version"]

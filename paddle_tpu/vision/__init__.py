@@ -3,3 +3,44 @@ from . import datasets  # noqa: F401
 from . import models  # noqa: F401
 from . import transforms  # noqa: F401
 from . import ops  # noqa: F401
+
+# image backend knobs (reference: python/paddle/vision/image.py)
+_image_backend = "cv2"
+
+
+def set_image_backend(backend):
+    if backend not in ("pil", "cv2", "tensor"):
+        raise ValueError(
+            f"image backend must be pil/cv2/tensor, got {backend!r}")
+    global _image_backend
+    _image_backend = backend
+
+
+def get_image_backend():
+    return _image_backend
+
+
+def image_load(path, backend=None):
+    """Load an image file as HWC uint8 (reference vision/image.py
+    image_load). Decodes jpeg/png via the io ops; other formats need a
+    pil/cv2 install."""
+    import numpy as np
+
+    from .ops import read_file, decode_jpeg
+
+    b = backend or _image_backend
+    try:
+        return decode_jpeg(read_file(path))
+    except Exception:
+        try:
+            from PIL import Image  # noqa
+
+            return np.asarray(Image.open(path))
+        except ImportError:
+            raise RuntimeError(
+                f"cannot decode {path!r}: not a jpeg and no PIL in this "
+                "image")
+
+
+__all__ = ["datasets", "models", "transforms", "ops",
+           "set_image_backend", "get_image_backend", "image_load"]

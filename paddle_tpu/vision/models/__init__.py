@@ -8,7 +8,12 @@ from .resnet import (  # noqa: F401
     resnet101,
     resnet152,
     resnext50_32x4d,
+    resnext50_64x4d,
+    resnext101_32x4d,
     resnext101_32x8d,
+    resnext101_64x4d,
+    resnext152_32x4d,
+    resnext152_64x4d,
     wide_resnet50_2,
     wide_resnet101_2,
 )
@@ -31,12 +36,15 @@ from .densenet import (  # noqa: F401
     densenet201,
     densenet264,
 )
+from .inceptionv3 import InceptionV3, inception_v3  # noqa: F401
 from .shufflenetv2 import (  # noqa: F401
     ShuffleNetV2,
     shufflenet_v2_x0_25,
+    shufflenet_v2_x0_33,
     shufflenet_v2_x0_5,
     shufflenet_v2_x1_0,
     shufflenet_v2_x1_5,
     shufflenet_v2_x2_0,
+    shufflenet_v2_swish,
 )
 from .googlenet import GoogLeNet, googlenet  # noqa: F401

@@ -1,0 +1,162 @@
+"""InceptionV3 (reference: python/paddle/vision/models/inceptionv3.py —
+Szegedy et al. 2015, the A/B/C/D/E mixed blocks)."""
+from __future__ import annotations
+
+from ... import nn
+
+__all__ = ["InceptionV3", "inception_v3"]
+
+
+class _ConvBN(nn.Layer):
+    def __init__(self, cin, cout, kernel, stride=1, padding=0):
+        super().__init__()
+        self.conv = nn.Conv2D(cin, cout, kernel, stride=stride,
+                              padding=padding, bias_attr=False)
+        self.bn = nn.BatchNorm2D(cout)
+        self.act = nn.ReLU()
+
+    def forward(self, x):
+        return self.act(self.bn(self.conv(x)))
+
+
+def _cat(tensors):
+    from ...ops.manipulation import concat
+
+    return concat(tensors, axis=1)
+
+
+class _InceptionA(nn.Layer):
+    def __init__(self, cin, pool_features):
+        super().__init__()
+        self.b1 = _ConvBN(cin, 64, 1)
+        self.b5 = nn.Sequential(_ConvBN(cin, 48, 1),
+                                _ConvBN(48, 64, 5, padding=2))
+        self.b3 = nn.Sequential(_ConvBN(cin, 64, 1),
+                                _ConvBN(64, 96, 3, padding=1),
+                                _ConvBN(96, 96, 3, padding=1))
+        self.pool = nn.AvgPool2D(3, stride=1, padding=1)
+        self.bp = _ConvBN(cin, pool_features, 1)
+
+    def forward(self, x):
+        return _cat([self.b1(x), self.b5(x), self.b3(x),
+                     self.bp(self.pool(x))])
+
+
+class _InceptionB(nn.Layer):
+    def __init__(self, cin):
+        super().__init__()
+        self.b3 = _ConvBN(cin, 384, 3, stride=2)
+        self.b3d = nn.Sequential(_ConvBN(cin, 64, 1),
+                                 _ConvBN(64, 96, 3, padding=1),
+                                 _ConvBN(96, 96, 3, stride=2))
+        self.pool = nn.MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return _cat([self.b3(x), self.b3d(x), self.pool(x)])
+
+
+class _InceptionC(nn.Layer):
+    def __init__(self, cin, c7):
+        super().__init__()
+        self.b1 = _ConvBN(cin, 192, 1)
+        self.b7 = nn.Sequential(
+            _ConvBN(cin, c7, 1),
+            _ConvBN(c7, c7, (1, 7), padding=(0, 3)),
+            _ConvBN(c7, 192, (7, 1), padding=(3, 0)))
+        self.b7d = nn.Sequential(
+            _ConvBN(cin, c7, 1),
+            _ConvBN(c7, c7, (7, 1), padding=(3, 0)),
+            _ConvBN(c7, c7, (1, 7), padding=(0, 3)),
+            _ConvBN(c7, c7, (7, 1), padding=(3, 0)),
+            _ConvBN(c7, 192, (1, 7), padding=(0, 3)))
+        self.pool = nn.AvgPool2D(3, stride=1, padding=1)
+        self.bp = _ConvBN(cin, 192, 1)
+
+    def forward(self, x):
+        return _cat([self.b1(x), self.b7(x), self.b7d(x),
+                     self.bp(self.pool(x))])
+
+
+class _InceptionD(nn.Layer):
+    def __init__(self, cin):
+        super().__init__()
+        self.b3 = nn.Sequential(_ConvBN(cin, 192, 1),
+                                _ConvBN(192, 320, 3, stride=2))
+        self.b7 = nn.Sequential(
+            _ConvBN(cin, 192, 1),
+            _ConvBN(192, 192, (1, 7), padding=(0, 3)),
+            _ConvBN(192, 192, (7, 1), padding=(3, 0)),
+            _ConvBN(192, 192, 3, stride=2))
+        self.pool = nn.MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return _cat([self.b3(x), self.b7(x), self.pool(x)])
+
+
+class _InceptionE(nn.Layer):
+    def __init__(self, cin):
+        super().__init__()
+        self.b1 = _ConvBN(cin, 320, 1)
+        self.b3_stem = _ConvBN(cin, 384, 1)
+        self.b3_a = _ConvBN(384, 384, (1, 3), padding=(0, 1))
+        self.b3_b = _ConvBN(384, 384, (3, 1), padding=(1, 0))
+        self.b3d_stem = nn.Sequential(_ConvBN(cin, 448, 1),
+                                      _ConvBN(448, 384, 3, padding=1))
+        self.b3d_a = _ConvBN(384, 384, (1, 3), padding=(0, 1))
+        self.b3d_b = _ConvBN(384, 384, (3, 1), padding=(1, 0))
+        self.pool = nn.AvgPool2D(3, stride=1, padding=1)
+        self.bp = _ConvBN(cin, 192, 1)
+
+    def forward(self, x):
+        s = self.b3_stem(x)
+        d = self.b3d_stem(x)
+        return _cat([self.b1(x),
+                     _cat([self.b3_a(s), self.b3_b(s)]),
+                     _cat([self.b3d_a(d), self.b3d_b(d)]),
+                     self.bp(self.pool(x))])
+
+
+class InceptionV3(nn.Layer):
+    """reference: vision/models/inceptionv3.py InceptionV3."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(
+            _ConvBN(3, 32, 3, stride=2),
+            _ConvBN(32, 32, 3),
+            _ConvBN(32, 64, 3, padding=1),
+            nn.MaxPool2D(3, stride=2),
+            _ConvBN(64, 80, 1),
+            _ConvBN(80, 192, 3),
+            nn.MaxPool2D(3, stride=2))
+        self.blocks = nn.Sequential(
+            _InceptionA(192, 32), _InceptionA(256, 64),
+            _InceptionA(288, 64),
+            _InceptionB(288),
+            _InceptionC(768, 128), _InceptionC(768, 160),
+            _InceptionC(768, 160), _InceptionC(768, 192),
+            _InceptionD(768),
+            _InceptionE(1280), _InceptionE(2048))
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.dropout = nn.Dropout(0.5)
+            self.fc = nn.Linear(2048, num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.stem(x))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = self.fc(self.dropout(x.flatten(1)))
+        return x
+
+
+def inception_v3(pretrained=False, **kwargs):
+    if pretrained:
+        raise ValueError(
+            "pretrained weights need network egress; load a local "
+            "state_dict with set_state_dict instead")
+    return InceptionV3(**kwargs)

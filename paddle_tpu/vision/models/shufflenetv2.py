@@ -9,6 +9,7 @@ __all__ = ["ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x0_5",
 
 _OUT = {
     0.25: (24, 24, 48, 96, 512),
+    0.33: (24, 32, 64, 128, 512),
     0.5: (24, 48, 96, 192, 1024),
     1.0: (24, 116, 232, 464, 1024),
     1.5: (24, 176, 352, 704, 1024),
@@ -25,8 +26,12 @@ def _channel_shuffle(x, groups):
     return reshape(x, [b, c, h, w])
 
 
+def _act(name):
+    return nn.Swish() if name == "swish" else nn.ReLU()
+
+
 class _ShuffleUnit(nn.Layer):
-    def __init__(self, inp, out, stride):
+    def __init__(self, inp, out, stride, act="relu"):
         super().__init__()
         self.stride = stride
         branch = out // 2
@@ -36,19 +41,19 @@ class _ShuffleUnit(nn.Layer):
                           bias_attr=False),
                 nn.BatchNorm2D(inp),
                 nn.Conv2D(inp, branch, 1, bias_attr=False),
-                nn.BatchNorm2D(branch), nn.ReLU())
+                nn.BatchNorm2D(branch), _act(act))
             in2 = inp
         else:
             self.branch1 = None
             in2 = inp // 2
         self.branch2 = nn.Sequential(
             nn.Conv2D(in2, branch, 1, bias_attr=False),
-            nn.BatchNorm2D(branch), nn.ReLU(),
+            nn.BatchNorm2D(branch), _act(act),
             nn.Conv2D(branch, branch, 3, stride=stride, padding=1,
                       groups=branch, bias_attr=False),
             nn.BatchNorm2D(branch),
             nn.Conv2D(branch, branch, 1, bias_attr=False),
-            nn.BatchNorm2D(branch), nn.ReLU())
+            nn.BatchNorm2D(branch), _act(act))
 
     def forward(self, x):
         from ...ops.manipulation import concat, split
@@ -70,19 +75,20 @@ class ShuffleNetV2(nn.Layer):
         self.with_pool = with_pool
         self.conv1 = nn.Sequential(
             nn.Conv2D(3, c0, 3, stride=2, padding=1, bias_attr=False),
-            nn.BatchNorm2D(c0), nn.ReLU())
+            nn.BatchNorm2D(c0), _act(act))
         self.maxpool = nn.MaxPool2D(3, stride=2, padding=1)
         stages = []
         inp = c0
         for out, reps in ((c1, 4), (c2, 8), (c3, 4)):
-            units = [_ShuffleUnit(inp, out, 2)]
-            units += [_ShuffleUnit(out, out, 1) for _ in range(reps - 1)]
+            units = [_ShuffleUnit(inp, out, 2, act)]
+            units += [_ShuffleUnit(out, out, 1, act)
+                      for _ in range(reps - 1)]
             stages.append(nn.Sequential(*units))
             inp = out
         self.stages = nn.Sequential(*stages)
         self.conv_last = nn.Sequential(
             nn.Conv2D(c3, c_last, 1, bias_attr=False),
-            nn.BatchNorm2D(c_last), nn.ReLU())
+            nn.BatchNorm2D(c_last), _act(act))
         if with_pool:
             self.pool = nn.AdaptiveAvgPool2D(1)
         if num_classes > 0:
@@ -119,3 +125,16 @@ def shufflenet_v2_x1_5(pretrained=False, **kw):
 
 def shufflenet_v2_x2_0(pretrained=False, **kw):
     return _sn(2.0, **kw)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kw):
+    return _sn(0.33, **kw)
+
+
+def shufflenet_v2_swish(pretrained=False, **kw):
+    """reference: shufflenetv2.py shufflenet_v2_swish — the 1.0x network
+    with swish activations (the act knob swaps every ReLU)."""
+    return _sn(1.0, act="swish", **kw)
+
+
+__all__ += ["shufflenet_v2_x0_33", "shufflenet_v2_swish"]

@@ -394,3 +394,400 @@ class ColorJitter:
         if np.issubdtype(in_dtype, np.integer):
             out = np.round(out)
         return out.astype(in_dtype)
+
+
+# ---- round-4 parity additions (reference: python/paddle/vision/
+# transforms/{functional,transforms}.py) -----------------------------------
+
+def to_grayscale(img, num_output_channels=1):
+    """reference: transforms/functional.py to_grayscale (ITU-R 601-2)."""
+    arr = _to_numpy(img).astype(np.float32)
+    gray = (0.299 * arr[..., 0] + 0.587 * arr[..., 1]
+            + 0.114 * arr[..., 2])
+    out = np.repeat(gray[..., None], num_output_channels, -1)
+    if _to_numpy(img).dtype == np.uint8:
+        out = np.clip(out, 0, 255).astype(np.uint8)
+    return out
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    """reference: transforms/functional.py pad — HWC image padding."""
+    if isinstance(padding, int):
+        l = r = t = b = padding
+    elif len(padding) == 2:
+        l = r = padding[0]
+        t = b = padding[1]
+    else:
+        l, t, r, b = padding
+    arr = _to_numpy(img)
+    width = [(t, b), (l, r)] + [(0, 0)] * (arr.ndim - 2)
+    mode = {"constant": "constant", "edge": "edge",
+            "reflect": "reflect", "symmetric": "symmetric"}[padding_mode]
+    if mode == "constant":
+        return np.pad(arr, width, mode=mode, constant_values=fill)
+    return np.pad(arr, width, mode=mode)
+
+
+def adjust_brightness(img, brightness_factor):
+    """reference: functional.py adjust_brightness — scale pixel values."""
+    arr = _to_numpy(img)
+    out = arr.astype(np.float32) * float(brightness_factor)
+    if arr.dtype == np.uint8:
+        return np.clip(out, 0, 255).astype(np.uint8)
+    return np.clip(out, 0.0, None) if arr.min() >= 0 else out
+
+
+def adjust_contrast(img, contrast_factor):
+    """reference: functional.py adjust_contrast — blend with the gray
+    mean."""
+    arr = _to_numpy(img).astype(np.float32)
+    mean = to_grayscale(arr).mean()
+    out = mean + float(contrast_factor) * (arr - mean)
+    if _to_numpy(img).dtype == np.uint8:
+        return np.clip(out, 0, 255).astype(np.uint8)
+    return out
+
+
+def _rgb_to_hsv(arr):
+    mx = arr.max(-1)
+    mn = arr.min(-1)
+    diff = mx - mn + 1e-12
+    r, g, b = arr[..., 0], arr[..., 1], arr[..., 2]
+    h = np.where(mx == r, (g - b) / diff % 6,
+                 np.where(mx == g, (b - r) / diff + 2,
+                          (r - g) / diff + 4)) / 6.0
+    s = np.where(mx > 0, diff / (mx + 1e-12), 0.0)
+    return np.stack([h % 1.0, s, mx], -1)
+
+
+def _hsv_to_rgb(hsv):
+    h, s, v = hsv[..., 0] * 6.0, hsv[..., 1], hsv[..., 2]
+    i = np.floor(h).astype(int) % 6
+    f = h - np.floor(h)
+    p = v * (1 - s)
+    q = v * (1 - f * s)
+    t = v * (1 - (1 - f) * s)
+    table = np.stack([
+        np.stack([v, t, p], -1), np.stack([q, v, p], -1),
+        np.stack([p, v, t], -1), np.stack([p, q, v], -1),
+        np.stack([t, p, v], -1), np.stack([v, p, q], -1)], 0)
+    return np.take_along_axis(table, i[None, ..., None],
+                              axis=0)[0]
+
+
+def adjust_hue(img, hue_factor):
+    """reference: functional.py adjust_hue — rotate hue by
+    hue_factor in [-0.5, 0.5]."""
+    if not -0.5 <= hue_factor <= 0.5:
+        raise ValueError("hue_factor must be in [-0.5, 0.5]")
+    arr = _to_numpy(img)
+    was_uint8 = arr.dtype == np.uint8
+    f = arr.astype(np.float32) / (255.0 if was_uint8 else 1.0)
+    hsv = _rgb_to_hsv(f)
+    hsv[..., 0] = (hsv[..., 0] + hue_factor) % 1.0
+    out = _hsv_to_rgb(hsv)
+    if was_uint8:
+        return np.clip(out * 255.0, 0, 255).astype(np.uint8)
+    return out
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    """reference: functional.py erase — fill a region with v."""
+    if isinstance(img, Tensor):
+        arr = np.asarray(img._data).copy()
+        arr[..., i:i + h, j:j + w] = v   # CHW tensor layout
+        return Tensor(arr)
+    arr = _to_numpy(img) if inplace else _to_numpy(img).copy()
+    arr[i:i + h, j:j + w] = v
+    return arr
+
+
+def _affine_grid_sample(arr, matrix, out_hw=None, fill=0):
+    """Inverse-map bilinear warp with a 2x3 affine matrix (output->input
+    coordinates), HWC numpy."""
+    h, w = arr.shape[:2]
+    oh, ow = out_hw or (h, w)
+    ys, xs = np.meshgrid(np.arange(oh), np.arange(ow), indexing="ij")
+    m = np.asarray(matrix, np.float32).reshape(2, 3)
+    sx = m[0, 0] * xs + m[0, 1] * ys + m[0, 2]
+    sy = m[1, 0] * xs + m[1, 1] * ys + m[1, 2]
+    x0 = np.floor(sx).astype(int)
+    y0 = np.floor(sy).astype(int)
+    eps = 1e-3  # lstsq/fp noise at the exact border must not void pixels
+    valid = ((sx >= -eps) & (sx <= w - 1 + eps)
+             & (sy >= -eps) & (sy <= h - 1 + eps))
+    x0c = np.clip(x0, 0, w - 2)
+    y0c = np.clip(y0, 0, h - 2)
+    wx = (sx - x0c)[..., None] if arr.ndim == 3 else sx - x0c
+    wy = (sy - y0c)[..., None] if arr.ndim == 3 else sy - y0c
+    f = arr.astype(np.float32)
+    out = (f[y0c, x0c] * (1 - wy) * (1 - wx)
+           + f[y0c, x0c + 1] * (1 - wy) * wx
+           + f[y0c + 1, x0c] * wy * (1 - wx)
+           + f[y0c + 1, x0c + 1] * wy * wx)
+    mask = valid[..., None] if arr.ndim == 3 else valid
+    out = np.where(mask, out, np.float32(fill))
+    if arr.dtype == np.uint8:
+        return np.clip(out, 0, 255).astype(np.uint8)
+    return out.astype(arr.dtype)
+
+
+def _inv_affine(angle, translate, scale, shear, center):
+    """Inverse affine matrix (output->input) like the reference's
+    get_affine_matrix (torchvision convention: rotate about center, then
+    shear, scale, translate)."""
+    rot = np.deg2rad(angle)
+    sx, sy = np.deg2rad(shear[0]), np.deg2rad(shear[1])
+    cx, cy = center
+    tx, ty = translate
+    # forward: M = T(center) R S Shear T(-center) + translate
+    a = np.cos(rot - sy) / np.cos(sy)
+    b = -np.cos(rot - sy) * np.tan(sx) / np.cos(sy) - np.sin(rot)
+    c = np.sin(rot - sy) / np.cos(sy)
+    d = -np.sin(rot - sy) * np.tan(sx) / np.cos(sy) + np.cos(rot)
+    fwd = np.array([[scale * a, scale * b, 0.0],
+                    [scale * c, scale * d, 0.0],
+                    [0.0, 0.0, 1.0]], np.float32)
+    fwd[0, 2] = cx + tx - fwd[0, 0] * cx - fwd[0, 1] * cy
+    fwd[1, 2] = cy + ty - fwd[1, 0] * cx - fwd[1, 1] * cy
+    return np.linalg.inv(fwd)[:2]
+
+
+def affine(img, angle, translate, scale, shear, interpolation="bilinear",
+           fill=0, center=None):
+    """reference: functional.py affine."""
+    arr = _to_numpy(img)
+    h, w = arr.shape[:2]
+    if center is None:
+        center = ((w - 1) * 0.5, (h - 1) * 0.5)
+    if isinstance(shear, numbers.Number):
+        shear = (shear, 0.0)
+    inv = _inv_affine(angle, translate, scale, shear, center)
+    return _affine_grid_sample(arr, inv, fill=fill)
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    """reference: functional.py rotate."""
+    return affine(img, angle, (0, 0), 1.0, (0.0, 0.0), fill=fill,
+                  center=center)
+
+
+def perspective(img, startpoints, endpoints, interpolation="bilinear",
+                fill=0):
+    """reference: functional.py perspective — warp mapping endpoints back
+    onto startpoints (homography solved least-squares)."""
+    arr = _to_numpy(img)
+    a, bvec = [], []
+    for (sx, sy), (ex, ey) in zip(startpoints, endpoints):
+        a.append([ex, ey, 1, 0, 0, 0, -sx * ex, -sx * ey])
+        a.append([0, 0, 0, ex, ey, 1, -sy * ex, -sy * ey])
+        bvec += [sx, sy]
+    hvec = np.linalg.lstsq(np.asarray(a, np.float32),
+                           np.asarray(bvec, np.float32), rcond=None)[0]
+    hm = np.append(hvec, 1.0).reshape(3, 3)
+    h, w = arr.shape[:2]
+    ys, xs = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+    denom = hm[2, 0] * xs + hm[2, 1] * ys + hm[2, 2]
+    sx = (hm[0, 0] * xs + hm[0, 1] * ys + hm[0, 2]) / denom
+    sy = (hm[1, 0] * xs + hm[1, 1] * ys + hm[1, 2]) / denom
+    x0 = np.clip(np.floor(sx).astype(int), 0, w - 2)
+    y0 = np.clip(np.floor(sy).astype(int), 0, h - 2)
+    eps = 1e-3
+    valid = ((sx >= -eps) & (sx <= w - 1 + eps)
+             & (sy >= -eps) & (sy <= h - 1 + eps))
+    wx = (sx - x0)[..., None] if arr.ndim == 3 else sx - x0
+    wy = (sy - y0)[..., None] if arr.ndim == 3 else sy - y0
+    f = arr.astype(np.float32)
+    out = (f[y0, x0] * (1 - wy) * (1 - wx) + f[y0, x0 + 1] * (1 - wy) * wx
+           + f[y0 + 1, x0] * wy * (1 - wx) + f[y0 + 1, x0 + 1] * wy * wx)
+    mask = valid[..., None] if arr.ndim == 3 else valid
+    out = np.where(mask, out, np.float32(fill))
+    if arr.dtype == np.uint8:
+        return np.clip(out, 0, 255).astype(np.uint8)
+    return out.astype(arr.dtype)
+
+
+class BaseTransform:
+    """reference: transforms/transforms.py BaseTransform — keys routing
+    so transforms apply to (image, label, ...) structures."""
+
+    def __init__(self, keys=None):
+        self.keys = keys or ("image",)
+
+    def _apply_image(self, image):
+        raise NotImplementedError
+
+    def __call__(self, inputs):
+        if not isinstance(inputs, (list, tuple)):
+            return self._apply_image(inputs)
+        outputs = []
+        for key, data in zip(self.keys, inputs):
+            fn = getattr(self, f"_apply_{key}", None)
+            outputs.append(fn(data) if fn is not None else data)
+        outputs.extend(inputs[len(self.keys):])
+        return tuple(outputs)
+
+
+class ContrastTransform(BaseTransform):
+    """reference: transforms.py ContrastTransform."""
+
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        if value < 0:
+            raise ValueError("contrast value should be non-negative")
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        import random
+
+        f = random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return adjust_contrast(img, f)
+
+
+class SaturationTransform(BaseTransform):
+    """reference: transforms.py SaturationTransform."""
+
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        import random
+
+        f = random.uniform(max(0, 1 - self.value), 1 + self.value)
+        arr = _to_numpy(img).astype(np.float32)
+        gray = to_grayscale(arr)
+        out = gray + f * (arr - gray)
+        if _to_numpy(img).dtype == np.uint8:
+            return np.clip(out, 0, 255).astype(np.uint8)
+        return out
+
+
+class HueTransform(BaseTransform):
+    """reference: transforms.py HueTransform."""
+
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        if not 0 <= value <= 0.5:
+            raise ValueError("hue value should be in [0, 0.5]")
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        import random
+
+        return adjust_hue(img, random.uniform(-self.value, self.value))
+
+
+class RandomAffine(BaseTransform):
+    """reference: transforms.py RandomAffine."""
+
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None, keys=None):
+        super().__init__(keys)
+        self.degrees = (-degrees, degrees) \
+            if isinstance(degrees, numbers.Number) else tuple(degrees)
+        self.translate = translate
+        self.scale = scale
+        self.shear = shear
+        self.fill = fill
+        self.center = center
+
+    def _apply_image(self, img):
+        import random
+
+        arr = _to_numpy(img)
+        h, w = arr.shape[:2]
+        angle = random.uniform(*self.degrees)
+        tx = ty = 0.0
+        if self.translate is not None:
+            tx = random.uniform(-self.translate[0], self.translate[0]) * w
+            ty = random.uniform(-self.translate[1], self.translate[1]) * h
+        sc = random.uniform(*self.scale) if self.scale else 1.0
+        sh = (random.uniform(*self.shear[:2]), 0.0) if self.shear \
+            else (0.0, 0.0)
+        return affine(img, angle, (tx, ty), sc, sh, fill=self.fill,
+                      center=self.center)
+
+
+class RandomPerspective(BaseTransform):
+    """reference: transforms.py RandomPerspective."""
+
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="bilinear", fill=0, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+        self.distortion_scale = distortion_scale
+        self.fill = fill
+
+    def _apply_image(self, img):
+        import random
+
+        if random.random() > self.prob:
+            return img
+        arr = _to_numpy(img)
+        h, w = arr.shape[:2]
+        d = self.distortion_scale
+        half_h, half_w = int(h * d / 2), int(w * d / 2)
+        tl = (random.randint(0, half_w), random.randint(0, half_h))
+        tr = (w - 1 - random.randint(0, half_w),
+              random.randint(0, half_h))
+        br = (w - 1 - random.randint(0, half_w),
+              h - 1 - random.randint(0, half_h))
+        bl = (random.randint(0, half_w), h - 1 - random.randint(0, half_h))
+        start = [(0, 0), (w - 1, 0), (w - 1, h - 1), (0, h - 1)]
+        return perspective(img, start, [tl, tr, br, bl], fill=self.fill)
+
+
+class RandomErasing(BaseTransform):
+    """reference: transforms.py RandomErasing (Zhong et al. 2017)."""
+
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+        self.scale = scale
+        self.ratio = ratio
+        self.value = value
+
+    def _apply_image(self, img):
+        import math
+        import random
+
+        if random.random() > self.prob:
+            return img
+        arr = _to_numpy(img)
+        chw = isinstance(img, Tensor) or arr.shape[0] in (1, 3)
+        h, w = (arr.shape[1], arr.shape[2]) if chw and arr.ndim == 3 \
+            else arr.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = random.uniform(*self.scale) * area
+            ar = math.exp(random.uniform(math.log(self.ratio[0]),
+                                         math.log(self.ratio[1])))
+            eh = int(round(math.sqrt(target * ar)))
+            ew = int(round(math.sqrt(target / ar)))
+            if eh < h and ew < w:
+                i = random.randint(0, h - eh)
+                j = random.randint(0, w - ew)
+                if isinstance(img, Tensor):
+                    return erase(img, i, j, eh, ew, self.value)
+                if chw and arr.ndim == 3:
+                    out = arr.copy()
+                    out[:, i:i + eh, j:j + ew] = self.value
+                    return out
+                return erase(arr, i, j, eh, ew, self.value)
+        return img
+
+
+__all__ += ["BaseTransform", "ContrastTransform", "SaturationTransform",
+            "HueTransform", "RandomAffine", "RandomErasing",
+            "RandomPerspective", "to_grayscale", "pad",
+            "adjust_brightness", "adjust_contrast", "adjust_hue",
+            "affine", "rotate", "perspective", "erase"]

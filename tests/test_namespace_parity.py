@@ -28,6 +28,27 @@ NAMESPACES = {
     "static": f"{REF}/static/__init__.py",
     "vision.ops": f"{REF}/vision/ops.py",
     "incubate": f"{REF}/incubate/__init__.py",
+    "io": f"{REF}/io/__init__.py",
+    "optimizer": f"{REF}/optimizer/__init__.py",
+    "optimizer.lr": f"{REF}/optimizer/lr.py",
+    "metric": f"{REF}/metric/__init__.py",
+    "text": f"{REF}/text/__init__.py",
+    "audio": f"{REF}/audio/__init__.py",
+    "audio.functional": f"{REF}/audio/functional/__init__.py",
+    "audio.features": f"{REF}/audio/features/__init__.py",
+    "vision": f"{REF}/vision/__init__.py",
+    "vision.transforms": f"{REF}/vision/transforms/__init__.py",
+    "vision.models": f"{REF}/vision/models/__init__.py",
+    "vision.datasets": f"{REF}/vision/datasets/__init__.py",
+    "quantization": f"{REF}/quantization/__init__.py",
+    "distributed.fleet": f"{REF}/distributed/fleet/__init__.py",
+    "nn.initializer": f"{REF}/nn/initializer/__init__.py",
+    "nn.utils": f"{REF}/nn/utils/__init__.py",
+    "onnx": f"{REF}/onnx/__init__.py",
+    "utils": f"{REF}/utils/__init__.py",
+    "device": f"{REF}/device/__init__.py",
+    "hub": f"{REF}/hub.py",
+    "distribution.transform": f"{REF}/distribution/transform.py",
 }
 
 
