@@ -1,0 +1,345 @@
+"""Round-4 long-tail namespace additions (reference:
+python/paddle/nn/utils/, audio/backends+datasets, text/datasets,
+vision/transforms+models+datasets folder, distributed/fleet/base/
+role_maker.py, device streams, hub.py, distribution/transform.py,
+quantization bases, utils helpers, io sampler, optimizer/lr.py)."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+class TestNNUtils:
+    def test_weight_norm_roundtrip(self):
+        lin = nn.Linear(4, 3)
+        w0 = lin.weight.numpy().copy()
+        nn.utils.weight_norm(lin, dim=0)
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        np.testing.assert_allclose(
+            lin(x).numpy(), np.ones((2, 4)) @ w0 + lin.bias.numpy(),
+            rtol=1e-5)
+        assert any(n.endswith("weight_g")
+                   for n, _ in lin.named_parameters())
+        nn.utils.remove_weight_norm(lin)
+        np.testing.assert_allclose(lin.weight.numpy(), w0, rtol=1e-5)
+
+    def test_spectral_norm_unit_sigma(self):
+        lin = nn.Linear(16, 16)
+        nn.utils.spectral_norm(lin)
+        for _ in range(20):
+            lin(paddle.to_tensor(np.ones((1, 16), np.float32)))
+        sv = np.linalg.svd(lin.weight.numpy(), compute_uv=False)[0]
+        assert abs(sv - 1.0) < 0.05
+
+    def test_vector_roundtrip_and_clip_value(self):
+        lin = nn.Linear(3, 2)
+        w0 = lin.weight.numpy().copy()
+        vec = nn.utils.parameters_to_vector(lin.parameters())
+        nn.utils.vector_to_parameters(vec * 2, lin.parameters())
+        np.testing.assert_allclose(lin.weight.numpy(), w0 * 2, rtol=1e-5)
+        (lin(paddle.to_tensor(np.ones((1, 3), np.float32)))
+         * 100).sum().backward()
+        nn.utils.clip_grad_value_(lin.parameters(), 0.5)
+        assert abs(lin.weight.grad.numpy()).max() <= 0.5
+
+
+class TestAudio:
+    def test_wav_roundtrip_info(self, tmp_path):
+        sig = np.sin(np.linspace(0, 100, 16000)).astype(np.float32)[None]
+        path = str(tmp_path / "a.wav")
+        paddle.audio.save(path, paddle.to_tensor(sig), 16000)
+        inf = paddle.audio.info(path)
+        assert (inf.sample_rate, inf.num_channels,
+                inf.num_samples) == (16000, 1, 16000)
+        back, sr = paddle.audio.load(path)
+        assert sr == 16000
+        np.testing.assert_allclose(back.numpy(), sig, atol=1e-3)
+
+    def test_backends_and_datasets(self, monkeypatch):
+        assert paddle.audio.backends.get_current_backend() \
+            == "wave_backend"
+        with pytest.raises(NotImplementedError):
+            paddle.audio.backends.set_backend("nope")
+        monkeypatch.setenv("PADDLE_TPU_SYNTH_SAMPLES", "6")
+        ds = paddle.audio.datasets.TESS(feat_type="raw")
+        w, lab = ds[1]
+        assert w.shape == (16000,) and 0 <= int(lab) < 7 and len(ds) == 6
+        esc = paddle.audio.datasets.ESC50(feat_type="raw")
+        assert len(esc) == 6
+
+    def test_tess_real_files(self, tmp_path):
+        d = tmp_path / "corpus"
+        d.mkdir()
+        sig = np.zeros((1, 800), np.float32)
+        paddle.audio.save(str(d / "OAF_word_happy.wav"),
+                          paddle.to_tensor(sig), 8000)
+        paddle.audio.save(str(d / "OAF_word_sad.wav"),
+                          paddle.to_tensor(sig), 8000)
+        ds = paddle.audio.datasets.TESS(archive=str(tmp_path / "corpus"))
+        assert len(ds) == 2
+        labels = sorted(int(ds[i][1]) for i in range(2))
+        assert labels == [ds.EMOTIONS.index("happy"),
+                          ds.EMOTIONS.index("sad")]
+
+
+class TestTextDatasets:
+    def test_imikolov_and_movielens(self, tmp_path):
+        p = tmp_path / "ptb.txt"
+        p.write_text("a b c d e f\n" * 60)
+        ds = paddle.text.Imikolov(str(p), window_size=3, min_word_freq=1)
+        assert len(ds) > 0 and ds[0].shape == (3,)
+        p2 = tmp_path / "ratings.dat"
+        p2.write_text("\n".join(
+            f"{i % 7}::{i % 13}::{(i % 5) + 1}::0" for i in range(50)))
+        assert len(paddle.text.Movielens(str(p2), mode="train")) == 45
+        assert len(paddle.text.Movielens(str(p2), mode="test")) == 5
+
+    def test_wmt_and_conll(self, tmp_path):
+        p3 = tmp_path / "wmt.npz"
+        np.savez(p3, src_ids=np.array([[1, 2, 3], [4, 5]], object),
+                 trg_ids=np.array([[1, 2, 4], [7, 8, 9]], object))
+        wm = paddle.text.WMT14(str(p3))
+        s, tin, tout = wm[0]
+        assert list(tin) == [1, 2] and list(tout) == [2, 4]
+        p4 = tmp_path / "conll.npz"
+        np.savez(p4, word_ids=np.array([[1, 2]], object),
+                 predicate_ids=np.array([[0, 1]], object),
+                 label_ids=np.array([[3, 4]], object))
+        assert len(paddle.text.Conll05st(str(p4))) == 1
+
+
+class TestVisionTransforms:
+    img = np.random.RandomState(0).randint(0, 255, (16, 20, 3), np.uint8)
+
+    def test_functional_geometry(self):
+        T = paddle.vision.transforms
+        h, w = self.img.shape[:2]
+        np.testing.assert_allclose(
+            T.rotate(self.img, 0).astype(int), self.img.astype(int),
+            atol=1)
+        np.testing.assert_allclose(
+            T.affine(self.img, 0, (0, 0), 1.0, (0, 0)).astype(int),
+            self.img.astype(int), atol=1)
+        at = T.affine(self.img.astype(np.float32), 0, (2, 0), 1.0, (0, 0))
+        np.testing.assert_allclose(at[:, 5],
+                                   self.img.astype(np.float32)[:, 3],
+                                   atol=1e-2)
+        corners = [(0, 0), (w - 1, 0), (w - 1, h - 1), (0, h - 1)]
+        np.testing.assert_allclose(
+            T.perspective(self.img, corners, corners).astype(int),
+            self.img.astype(int), atol=1)
+
+    def test_functional_color(self):
+        T = paddle.vision.transforms
+        assert T.to_grayscale(self.img).shape == (16, 20, 1)
+        assert T.adjust_brightness(self.img, 1.5).dtype == np.uint8
+        np.testing.assert_allclose(
+            T.adjust_hue(self.img, 0.0).astype(int),
+            self.img.astype(int), atol=2)
+        assert T.pad(self.img, (1, 2, 3, 4)).shape == (22, 24, 3)
+        e = T.erase(self.img, 2, 3, 4, 5, 7)
+        assert (e[2:6, 3:8] == 7).all()
+
+    def test_transform_classes(self):
+        T = paddle.vision.transforms
+        for cls in [T.ContrastTransform(0.4), T.SaturationTransform(0.4),
+                    T.HueTransform(0.2),
+                    T.RandomAffine(10, translate=(0.1, 0.1)),
+                    T.RandomPerspective(1.0), T.RandomErasing(1.0)]:
+            assert np.asarray(cls(self.img)).shape[-1] == 3
+        # keys routing leaves labels alone
+        out = T.ContrastTransform(0.4, keys=("image", "label"))(
+            (self.img, 3))
+        assert out[1] == 3
+
+
+class TestVisionModelsAndFolders:
+    def test_new_model_variants_forward(self):
+        m = paddle.vision.models.shufflenet_v2_x0_33(num_classes=4)
+        m.eval()
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(1, 3, 64, 64).astype(
+                np.float32))
+        assert tuple(m(x).shape) == (1, 4)
+        sw = paddle.vision.models.shufflenet_v2_swish(num_classes=3)
+        sw.eval()
+        assert tuple(sw(x).shape) == (1, 3)
+        assert paddle.vision.models.resnext101_64x4d(num_classes=2)
+
+    def test_inception_v3_forward(self):
+        m = paddle.vision.models.inception_v3(num_classes=5)
+        m.eval()
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(1, 3, 299, 299).astype(
+                np.float32))
+        assert tuple(m(x).shape) == (1, 5)
+
+    def test_dataset_folder(self, tmp_path):
+        for cls in ("cat", "dog"):
+            os.makedirs(tmp_path / cls)
+            for i in range(3):
+                np.save(str(tmp_path / cls / f"{i}.npy"),
+                        np.ones((4, 4, 3)))
+        df = paddle.vision.datasets.DatasetFolder(str(tmp_path))
+        assert len(df) == 6 and df.classes == ["cat", "dog"]
+        x, y = df[0]
+        assert x.shape == (4, 4, 3) and y == 0
+        imf = paddle.vision.datasets.ImageFolder(str(tmp_path))
+        assert len(imf) == 6
+
+
+class TestFleetRoles:
+    def test_role_makers(self, monkeypatch):
+        fl = paddle.distributed.fleet
+        monkeypatch.setenv("PADDLE_TRAINER_ID", "0")
+        rm = fl.PaddleCloudRoleMaker(is_collective=True)
+        assert rm.is_worker() and rm.is_first_worker()
+        u = fl.UserDefinedRoleMaker(current_id=2, role=fl.Role.WORKER,
+                                    worker_num=4)
+        assert u.worker_index() == 2 and u.worker_num() == 4
+
+    def test_util_and_generators(self, tmp_path):
+        fl = paddle.distributed.fleet
+        shard = fl.UtilBase().get_file_shard([f"f{i}" for i in range(10)])
+        assert shard == [f"f{i}" for i in range(10)]  # single process
+
+        class Gen(fl.MultiSlotDataGenerator):
+            def generate_sample(self, line):
+                def it():
+                    yield [("ids", [int(t) for t in line.split()]),
+                           ("label", [1])]
+
+                return it
+
+        src = tmp_path / "in.txt"
+        src.write_text("1 2 3\n4 5\n")
+        out = tmp_path / "out.txt"
+        Gen().run_from_files([str(src)], str(out))
+        assert out.read_text().splitlines()[0] == "3 1 2 3 1 1"
+
+
+class TestMiscSurface:
+    def test_hub_local(self, tmp_path):
+        (tmp_path / "hubconf.py").write_text(
+            "def toy(k=2):\n"
+            "    'build a toy'\n"
+            "    return {'k': k}\n")
+        assert paddle.hub.list(str(tmp_path)) == ["toy"]
+        assert "toy" in paddle.hub.help(str(tmp_path), "toy")
+        assert paddle.hub.load(str(tmp_path), "toy", k=5) == {"k": 5}
+        with pytest.raises(NotImplementedError):
+            paddle.hub.list("x/y", source="github")
+
+    def test_device_streams(self):
+        d = paddle.device
+        s = d.Stream()
+        with d.stream_guard(s):
+            assert d.current_stream() is s
+        ev = s.record_event()
+        ev.synchronize()
+        assert not d.is_compiled_with_rocm()
+        assert d.get_all_device_type()
+
+    def test_utils_helpers(self):
+        assert paddle.utils.require_version("0.0.1")
+        with pytest.raises(Exception):
+            paddle.utils.require_version("999.0.0")
+        mod = paddle.utils.try_import("json")
+        assert mod.dumps({}) == "{}"
+
+        @paddle.utils.deprecated(since="0.1", update_to="new_fn")
+        def old_fn():
+            return 1
+
+        import warnings
+
+        with warnings.catch_warnings(record=True) as rec:
+            warnings.simplefilter("always")
+            assert old_fn() == 1
+        assert any("deprecated" in str(r.message) for r in rec)
+
+    def test_quantization_bases(self):
+        q = paddle.quantization
+
+        @q.quanter("MyQ")
+        class MyQ(q.BaseQuanter):
+            def forward(self, x):
+                return x
+
+        assert q.quanter._registry["MyQ"] is MyQ
+
+    def test_io_sampler_lr_init(self):
+        s = paddle.io.SubsetRandomSampler([3, 5, 7])
+        assert sorted(s) == [3, 5, 7] and len(s) == 3
+        sched = paddle.optimizer.lr.MultiplicativeDecay(
+            1.0, lambda e: 0.5)
+        sched.step()
+        sched.step()
+        assert abs(sched() - 0.25) < 1e-6
+        init = paddle.nn.initializer.Bilinear()
+        w = np.asarray(init((2, 2, 4, 4)))
+        assert w.shape == (2, 2, 4, 4) and w.max() <= 1.0
+
+
+class TestDistributionTransforms:
+    def test_tanh_power_roundtrip(self):
+        D = paddle.distribution
+        x = paddle.to_tensor(np.array([0.3, -0.8], np.float32))
+        t = D.TanhTransform()
+        np.testing.assert_allclose(t.inverse(t.forward(x)).numpy(),
+                                   x.numpy(), rtol=1e-5)
+        np.testing.assert_allclose(
+            t.forward_log_det_jacobian(x).numpy(),
+            np.log(1 - np.tanh(x.numpy()) ** 2), rtol=1e-4)
+        pw = D.PowerTransform(2.0)
+        xx = paddle.to_tensor(np.array([2.0, 3.0], np.float32))
+        np.testing.assert_allclose(pw.inverse(pw.forward(xx)).numpy(),
+                                   xx.numpy(), rtol=1e-5)
+
+    def test_stickbreaking_simplex_and_ldj(self):
+        D = paddle.distribution
+        sb = D.StickBreakingTransform()
+        v = paddle.to_tensor(np.array([0.2, -0.5, 1.0], np.float32))
+        smp = sb.forward(v)
+        np.testing.assert_allclose(smp.numpy().sum(), 1.0, rtol=1e-5)
+        np.testing.assert_allclose(sb.inverse(smp).numpy(), v.numpy(),
+                                   rtol=1e-4, atol=1e-5)
+        # ldj vs numeric jacobian of the first 3 simplex coords
+        vn = v.numpy()
+        eps = 1e-4
+
+        def f(u):
+            return np.asarray(
+                sb.forward(paddle.to_tensor(u)).numpy())[:3]
+
+        J = np.zeros((3, 3))
+        for i in range(3):
+            vp = vn.copy()
+            vp[i] += eps
+            J[:, i] = (f(vp) - f(vn)) / eps
+        np.testing.assert_allclose(
+            float(sb.forward_log_det_jacobian(v)),
+            np.log(abs(np.linalg.det(J))), rtol=1e-2)
+
+    def test_stack_independent_reshape(self):
+        D = paddle.distribution
+        st = D.StackTransform([D.ExpTransform(), D.TanhTransform()],
+                              axis=0)
+        sx = paddle.to_tensor(
+            np.array([[0.5, 1.0], [0.2, 0.3]], np.float32))
+        out = st.forward(sx).numpy()
+        np.testing.assert_allclose(out[0], np.exp(sx.numpy()[0]),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(out[1], np.tanh(sx.numpy()[1]),
+                                   rtol=1e-5)
+        it = D.IndependentTransform(D.ExpTransform(), 1)
+        ldj = it.forward_log_det_jacobian(
+            paddle.to_tensor(np.ones((2, 3), np.float32)))
+        assert tuple(ldj.shape) == (2,)
+        rt = D.ReshapeTransform((4,), (2, 2))
+        r = rt.forward(paddle.to_tensor(np.arange(4, dtype=np.float32)))
+        assert tuple(r.shape) == (2, 2)
