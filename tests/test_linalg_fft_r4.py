@@ -1,0 +1,110 @@
+"""Round-4 linalg/fft additions vs scipy/numpy (reference:
+python/paddle/tensor/linalg.py vector_norm/matrix_norm/cholesky_inverse/
+matrix_exp/lu_unpack/ormqr/svd_lowrank, python/paddle/linalg.py
+fp8_fp8_half_gemm_fused, python/paddle/fft.py hfft2/ihfft2/hfftn/ihfftn)."""
+import numpy as np
+import scipy.linalg as sl
+import scipy.linalg.lapack as lap
+
+import paddle_tpu as pt
+
+
+def test_vector_and_matrix_norm():
+    A = np.random.RandomState(0).randn(4, 4).astype(np.float32)
+    x = pt.to_tensor(A)
+    np.testing.assert_allclose(float(pt.linalg.vector_norm(x)),
+                               np.linalg.norm(A.ravel()), rtol=1e-5)
+    np.testing.assert_allclose(
+        pt.linalg.vector_norm(x, p=1, axis=1).numpy(),
+        np.abs(A).sum(1), rtol=1e-5)
+    for p, ref in [(2, np.linalg.norm(A, 2)), (1, np.linalg.norm(A, 1)),
+                   (np.inf, np.linalg.norm(A, np.inf)),
+                   ("fro", np.linalg.norm(A, "fro")),
+                   ("nuc", np.linalg.norm(A, "nuc"))]:
+        np.testing.assert_allclose(float(pt.linalg.matrix_norm(x, p=p)),
+                                   ref, rtol=1e-4)
+
+
+def test_cholesky_inverse_matrix_exp():
+    A = np.random.RandomState(0).randn(4, 4).astype(np.float32)
+    spd = (A @ A.T + 4 * np.eye(4)).astype(np.float32)
+    L = np.linalg.cholesky(spd).astype(np.float32)
+    np.testing.assert_allclose(
+        pt.linalg.cholesky_inverse(pt.to_tensor(L)).numpy(),
+        np.linalg.inv(spd), rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(
+        pt.linalg.matrix_exp(pt.to_tensor(A * 0.1)).numpy(),
+        sl.expm(A * 0.1), rtol=1e-4)
+
+
+def test_lu_unpack_reconstructs():
+    A = np.random.RandomState(0).randn(4, 4).astype(np.float32)
+    lu, piv = pt.linalg.lu(pt.to_tensor(A))
+    P, L, U = pt.linalg.lu_unpack(lu, piv)
+    np.testing.assert_allclose(P.numpy() @ L.numpy() @ U.numpy(), A,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_ormqr_vs_lapack():
+    m = np.random.RandomState(1).randn(5, 3).astype(np.float32)
+    q_np, r_np = np.linalg.qr(m)
+    qr_f, tau, _, _ = lap.sgeqrf(m)
+    got = pt.linalg.ormqr(pt.to_tensor(qr_f), pt.to_tensor(tau),
+                          pt.to_tensor(np.eye(5, dtype=np.float32)))
+    np.testing.assert_allclose(got.numpy()[:, :3], q_np, rtol=1e-3,
+                               atol=1e-4)
+    gt = pt.linalg.ormqr(pt.to_tensor(qr_f), pt.to_tensor(tau),
+                         pt.to_tensor(m), transpose=True)
+    np.testing.assert_allclose(np.abs(gt.numpy()[:3]), np.abs(r_np),
+                               rtol=1e-3, atol=1e-4)
+    gr = pt.linalg.ormqr(pt.to_tensor(qr_f), pt.to_tensor(tau),
+                         pt.to_tensor(np.eye(5, dtype=np.float32)),
+                         left=False)
+    np.testing.assert_allclose(gr.numpy()[:, :3], q_np, rtol=1e-3,
+                               atol=1e-4)
+
+
+def test_svd_lowrank_reconstructs():
+    big = (np.random.RandomState(2).randn(20, 4)
+           @ np.random.RandomState(3).randn(4, 15)).astype(np.float32)
+    u, s, v = pt.linalg.svd_lowrank(pt.to_tensor(big), q=4)
+    np.testing.assert_allclose(
+        u.numpy() @ np.diag(s.numpy()) @ v.numpy().T, big, rtol=1e-3,
+        atol=1e-3)
+
+
+def test_fp8_gemm_close_to_fp32():
+    rng = np.random.RandomState(0)
+    a = rng.randn(8, 16).astype(np.float32) * 0.5
+    b = rng.randn(16, 8).astype(np.float32) * 0.5
+    bias = rng.randn(8).astype(np.float32)
+    out = pt.linalg.fp8_fp8_half_gemm_fused(
+        pt.to_tensor(a), pt.to_tensor(b), bias=pt.to_tensor(bias),
+        output_dtype="float32").numpy()
+    ref = a @ b + bias
+    # fp8 e4m3 has ~2 mantissa-bit precision: loose tolerance
+    assert np.abs(out - ref).max() / np.abs(ref).max() < 0.15
+    out_t = pt.linalg.fp8_fp8_half_gemm_fused(
+        pt.to_tensor(a), pt.to_tensor(b.T), transpose_y=True,
+        output_dtype="float32").numpy()
+    assert np.abs(out_t - a @ b).max() / np.abs(a @ b).max() < 0.15
+
+
+def test_hfft_family():
+    sig = np.random.RandomState(4).randn(6, 8).astype(np.float32)
+    c = (np.random.RandomState(5).randn(4, 5)
+         + 1j * np.random.RandomState(6).randn(4, 5)).astype(np.complex64)
+    out2 = pt.fft.hfft2(pt.to_tensor(c)).numpy()
+    ref2 = np.fft.hfft(np.fft.fftn(c, axes=(-2,)), axis=-1)
+    np.testing.assert_allclose(out2, ref2, rtol=1e-3, atol=1e-3)
+    ref_i = np.fft.ifftn(np.fft.ihfft(sig, axis=-1), axes=(-2,))
+    np.testing.assert_allclose(pt.fft.ihfft2(pt.to_tensor(sig)).numpy(),
+                               ref_i, rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(pt.fft.ihfftn(pt.to_tensor(sig)).numpy(),
+                               ref_i, rtol=1e-3, atol=1e-4)
+    # hfftn/ihfftn roundtrip on a hermitian spectrum
+    freq = np.fft.ihfft(sig, axis=-1)
+    time = pt.fft.hfftn(pt.to_tensor(np.ascontiguousarray(
+        freq.astype(np.complex64))), axes=(-1,)).numpy()
+    np.testing.assert_allclose(time, np.fft.hfft(freq, axis=-1),
+                               rtol=1e-3, atol=1e-3)
