@@ -55,7 +55,9 @@ def test_ormqr_vs_lapack():
                                atol=1e-4)
     gt = pt.linalg.ormqr(pt.to_tensor(qr_f), pt.to_tensor(tau),
                          pt.to_tensor(m), transpose=True)
-    np.testing.assert_allclose(np.abs(gt.numpy()[:3]), np.abs(r_np),
+    # Q^T m = R of the SAME sgeqrf factorization (sign-exact, unlike
+    # comparing against np.linalg.qr's convention)
+    np.testing.assert_allclose(gt.numpy()[:3], np.triu(qr_f[:3]),
                                rtol=1e-3, atol=1e-4)
     gr = pt.linalg.ormqr(pt.to_tensor(qr_f), pt.to_tensor(tau),
                          pt.to_tensor(np.eye(5, dtype=np.float32)),
@@ -108,3 +110,13 @@ def test_hfft_family():
         freq.astype(np.complex64))), axes=(-1,)).numpy()
     np.testing.assert_allclose(time, np.fft.hfft(freq, axis=-1),
                                rtol=1e-3, atol=1e-3)
+
+
+def test_cholesky_op():
+    A = np.random.RandomState(7).randn(4, 4).astype(np.float32)
+    spd = (A @ A.T + 4 * np.eye(4)).astype(np.float32)
+    L = pt.cholesky(pt.to_tensor(spd)).numpy()
+    np.testing.assert_allclose(L @ L.T, spd, rtol=1e-4, atol=1e-4)
+    assert np.allclose(np.triu(L, 1), 0)
+    U = pt.cholesky(pt.to_tensor(spd), upper=True).numpy()
+    np.testing.assert_allclose(U.T @ U, spd, rtol=1e-4, atol=1e-4)

@@ -194,3 +194,12 @@ class TestDistributedExtras:
     def test_io_module(self):
         assert paddle.distributed.io.is_persistable(
             type("V", (), {"persistable": True})())
+
+
+def test_create_parameter_and_global_var():
+    p = paddle.static.create_parameter([3, 4], "float32")
+    assert tuple(p.shape) == (3, 4) and p.trainable
+    g = paddle.static.create_global_var([2], 7.0, "float32",
+                                        persistable=True)
+    np.testing.assert_allclose(g.numpy(), [7.0, 7.0])
+    assert g.persistable
