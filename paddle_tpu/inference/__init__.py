@@ -45,6 +45,12 @@ class Config:
     def switch_ir_optim(self, flag=True):
         pass
 
+    def enable_low_precision_io(self, flag=True):
+        """Serve weight-only int8 (reference: the quant serving configs
+        exp_enable_use_* — here it routes generate() through
+        weight_quant='int8', halving decode weight HBM traffic)."""
+        self._weight_quant = "int8" if flag else None
+
 
 class Tensor:
     """Zero-copy-style IO handle (reference: paddle_infer.Tensor)."""
@@ -72,6 +78,7 @@ class Predictor:
 
     def __init__(self, config: Optional[Config] = None, _model=None):
         self._model = _model
+        self._config = config
         self._output_vals: List[np.ndarray] = []
         self._output_handles: Dict[str, Tensor] = {}
         if _model is not None:
@@ -94,16 +101,20 @@ class Predictor:
         return cls(_model=model)
 
     def generate(self, input_ids, max_new_tokens=32, temperature=0.0,
-                 top_p=None, eos_token_id=None) -> np.ndarray:
+                 top_p=None, eos_token_id=None,
+                 weight_quant=None) -> np.ndarray:
         if self._model is None:
             raise RuntimeError(
                 "generate() needs a model-backed predictor: use "
                 "Predictor.from_model(model); saved-program predictors "
                 "expose run() only")
+        if weight_quant is None:
+            weight_quant = getattr(self._config, "_weight_quant", None) \
+                if self._config is not None else None
         out = self._model.generate(
             input_ids, max_new_tokens=max_new_tokens,
             temperature=temperature, top_p=top_p,
-            eos_token_id=eos_token_id)
+            eos_token_id=eos_token_id, weight_quant=weight_quant)
         return np.asarray(out.numpy())
 
     def get_input_names(self) -> List[str]:
