@@ -227,9 +227,23 @@ class StaticFunction:
 
 def to_static(function=None, input_spec=None, build_strategy=None,
               backend=None, full_graph=True, **kwargs):
-    """Decorator / wrapper (reference: python/paddle/jit/api.py:196)."""
+    """Decorator / wrapper (reference: python/paddle/jit/api.py:196).
+
+    ``full_graph=True`` (default): whole-function trace; a data-dependent
+    python branch degrades that call to eager with guidance.
+    ``full_graph=False``: the SOT path, exactly like the reference —
+    partial-graph capture with guards (jit/sot.py), so one dynamic ``if``
+    runs as two compiled subgraphs instead of falling back to eager.
+    """
 
     def decorate(fn):
+        if not full_graph:
+            from .sot import symbolic_translate
+
+            if isinstance(fn, Layer):
+                fn.forward = symbolic_translate(fn.forward)
+                return fn
+            return symbolic_translate(fn)
         if isinstance(fn, Layer):
             sf = StaticFunction(fn, input_spec)
             fn.forward = sf

@@ -152,3 +152,30 @@ class TestModelParity:
             want = (nn.functional.relu(h) if float(h.mean()) > 0
                     else nn.functional.tanh(h)).numpy()
             np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_to_static_full_graph_false_routes_to_sot():
+    """Reference semantics: to_static(full_graph=False) = SOT capture —
+    no eager fallback on a dynamic branch."""
+    import warnings
+
+    calls = {"n": 0}
+
+    @paddle.jit.to_static(full_graph=False)
+    def f(x):
+        calls["n"] += 1
+        if x.sum() > 0:
+            return x * 2
+        return x * -3
+
+    xp = paddle.to_tensor(np.array([1.0], np.float32))
+    xn = paddle.to_tensor(np.array([-1.0], np.float32))
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # no graph-break warning allowed
+        np.testing.assert_allclose(f(xp).numpy(), [2.0])
+        np.testing.assert_allclose(f(xn).numpy(), [3.0])
+        np.testing.assert_allclose(
+            f(paddle.to_tensor(np.array([5.0], np.float32))).numpy(),
+            [10.0])
+    assert calls["n"] == 2  # replay did not re-enter python
+    assert f.graph_break_count >= 1
