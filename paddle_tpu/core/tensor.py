@@ -448,6 +448,15 @@ def _unwrap_index(idx):
 def to_tensor(data, dtype=None, place=None, stop_gradient: bool = True) -> Tensor:
     """paddle.to_tensor equivalent (reference: python/paddle/tensor/creation.py)."""
     if isinstance(data, Tensor):
+        if getattr(data, "_sym_node", None) is not None \
+                and not isinstance(data._data, (jax.Array, jax.core.Tracer)):
+            # symbolic (captured) tensor: pass through — there is no
+            # concrete payload to copy; dtype changes record a cast op
+            if dtype is not None:
+                from ..ops.manipulation import cast
+
+                return cast(data, dtype)
+            return data
         t = Tensor(data._data, stop_gradient=stop_gradient, dtype=dtype)
         return t
     if isinstance(data, np.ndarray) and data.dtype == np.float64 and dtype is None:

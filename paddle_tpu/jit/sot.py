@@ -112,26 +112,55 @@ class SOTFunction:
         self.graph_break_count = 0    # capture-time breaks observed
         functools.update_wrapper(self, fn)
 
+    def __get__(self, instance, owner):
+        # descriptor binding so @to_static(full_graph=False) works on
+        # methods (mirrors StaticFunction.__get__)
+        if instance is None:
+            return self
+        bound = SOTFunction(self._fn.__get__(instance, owner))
+        setattr(instance, self._fn.__name__, bound)
+        return bound
+
+    # ------------------------------------------------- feed symbolization
+    @staticmethod
+    def _feed_items(args, kwargs):
+        """(name, value) for every array-like input — positional Tensors,
+        raw jax/numpy arrays, and Tensor/array kwargs all become feeds so
+        their VALUES are never baked into the captured program."""
+        items = []
+        for i, a in enumerate(args):
+            if isinstance(a, Tensor):
+                items.append((f"sot_arg{i}", a._data, ("pos", i)))
+            elif isinstance(a, (np.ndarray, jax.Array)):
+                items.append((f"sot_arg{i}", jnp.asarray(a), ("pos", i)))
+        for k in sorted(kwargs):
+            v = kwargs[k]
+            if isinstance(v, Tensor):
+                items.append((f"sot_kw_{k}", v._data, ("kw", k)))
+            elif isinstance(v, (np.ndarray, jax.Array)):
+                items.append((f"sot_kw_{k}", jnp.asarray(v), ("kw", k)))
+        return items
+
     # ---------------------------------------------------------- capture
     def _capture(self, args, kwargs):
         global _active_ctx
         feed_values = {}
-        sym_args = []
-        for i, a in enumerate(args):
-            if isinstance(a, Tensor):
-                name = f"sot_arg{i}"
-                aval = jax.ShapeDtypeStruct(tuple(a.shape), a._data.dtype)
-                leaf = _g.FeedLeaf(name, aval)
-                sym_args.append(_g.make_symbolic(leaf, 0, name=name))
-                feed_values[name] = a._data
+        sym_args = list(args)
+        sym_kwargs = dict(kwargs)
+        for name, val, (kind, key) in self._feed_items(args, kwargs):
+            aval = jax.ShapeDtypeStruct(tuple(val.shape), val.dtype)
+            sym = _g.make_symbolic(_g.FeedLeaf(name, aval), 0, name=name)
+            feed_values[name] = val
+            if kind == "pos":
+                sym_args[key] = sym
             else:
-                sym_args.append(a)
+                sym_kwargs[key] = sym
         ctx = _CaptureCtx(feed_values)
         prev_ctx, _active_ctx = _active_ctx, ctx
         prev_static = static_flags.enabled
         static_flags.enabled = True
         try:
-            out = self._fn(*sym_args, **kwargs)
+            out = self._fn(*sym_args, **sym_kwargs)
         finally:
             static_flags.enabled = prev_static
             _active_ctx = prev_ctx
@@ -156,11 +185,21 @@ class SOTFunction:
 
     # ------------------------------------------------------------- call
     def __call__(self, *args, **kwargs):
+        from . import _to_static_enabled
+
+        if not _to_static_enabled:
+            # the global enable_to_static(False) kill switch applies to
+            # the SOT route too
+            return self._fn(*args, **kwargs)
         sig = _sig_of(args, kwargs)
+        owner = getattr(self._fn, "__self__", None)
+        if owner is not None and hasattr(owner, "training"):
+            # train/eval capture different programs (dropout etc.) — same
+            # invariant StaticFunction keeps via its cache_key
+            sig = sig + (("training", bool(owner.training)),)
         paths = self._cache.setdefault(sig, [])
-        feed_values = {f"sot_arg{i}": a._data
-                       for i, a in enumerate(args)
-                       if isinstance(a, Tensor)}
+        feed_values = {name: val
+                       for name, val, _ in self._feed_items(args, kwargs)}
 
         def guards_hold(prog):
             for gfn, gfeeds, gparams, expect in prog.guards:

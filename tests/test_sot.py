@@ -179,3 +179,78 @@ def test_to_static_full_graph_false_routes_to_sot():
             [10.0])
     assert calls["n"] == 2  # replay did not re-enter python
     assert f.graph_break_count >= 1
+
+
+class TestSOTGuardrails:
+    def test_array_args_are_feeds_not_constants(self):
+        """Raw ndarray args must not be baked into the program."""
+        @symbolic_translate
+        def f(x, arr):
+            return x + paddle.to_tensor(arr * 1.0)
+
+        x = paddle.to_tensor(np.zeros(4, np.float32))
+        a1 = f(x, np.ones(4, np.float32)).numpy()
+        a2 = f(x, np.full(4, 2.0, np.float32)).numpy()
+        np.testing.assert_allclose(a1, np.ones(4))
+        np.testing.assert_allclose(a2, np.full(4, 2.0))
+
+    def test_tensor_kwargs_are_feeds(self):
+        @symbolic_translate
+        def f(x, *, bias=None):
+            return x + bias
+
+        x = paddle.to_tensor(np.zeros(3, np.float32))
+        b1 = f(x, bias=paddle.to_tensor(np.ones(3, np.float32))).numpy()
+        b2 = f(x, bias=paddle.to_tensor(
+            np.full(3, 5.0, np.float32))).numpy()
+        np.testing.assert_allclose(b1, np.ones(3))
+        np.testing.assert_allclose(b2, np.full(3, 5.0))
+
+    def test_train_eval_mode_separates_programs(self):
+        from paddle_tpu import nn
+
+        model = nn.Sequential(nn.Linear(4, 4), nn.Dropout(0.5))
+        sot = symbolic_translate(model.forward)
+        x = paddle.to_tensor(np.ones((64, 4), np.float32))
+        model.train()
+        out_train = sot(x).numpy()
+        model.eval()
+        out_eval = sot(x).numpy()
+        # eval: no dropout zeros; train: ~half the rows zeroed
+        assert (out_eval != 0).all()
+        assert (out_train == 0).mean() > 0.2
+
+    def test_method_decoration_binds_self(self):
+        from paddle_tpu import nn
+
+        class M(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.lin = nn.Linear(2, 2)
+
+            @paddle.jit.to_static(full_graph=False)
+            def forward(self, x):
+                return self.lin(x)
+
+        m = M()
+        m.eval()
+        out = m(paddle.to_tensor(np.ones((1, 2), np.float32)))
+        assert tuple(out.shape) == (1, 2)
+
+    def test_enable_to_static_kill_switch(self):
+        calls = {"n": 0}
+
+        @symbolic_translate
+        def f(x):
+            calls["n"] += 1
+            return x * 2
+
+        x = paddle.to_tensor(np.ones(2, np.float32))
+        f(x)
+        paddle.jit.enable_to_static(False)
+        try:
+            f(x)
+            f(x)
+        finally:
+            paddle.jit.enable_to_static(True)
+        assert calls["n"] == 3  # eager re-entry while disabled
