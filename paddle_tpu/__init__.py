@@ -78,6 +78,7 @@ from . import audio  # noqa: F401,E402
 from . import version  # noqa: F401,E402
 from . import device  # noqa: F401,E402
 from . import hub  # noqa: F401,E402
+from . import decomposition  # noqa: F401,E402
 from . import onnx  # noqa: F401,E402
 from . import models  # noqa: F401,E402
 from . import utils  # noqa: F401,E402

@@ -1,0 +1,117 @@
+"""paddle.decomposition (reference: python/paddle/decomposition/ —
+register.py rule registry, decomp.py decompose(program, ops)).
+
+The reference decomposes composite ops into a primitive set so backends
+without the composite kernel (or the prim-based autodiff) can run them.
+On XLA that role is largely moot — every op here already lowers to HLO
+primitives — so this tier exists for (a) program-level rewrites that
+want to see a smaller op vocabulary (custom passes, export), and (b)
+reference-workflow compatibility. Rules rewrite the captured op-DAG
+(static/graph.py) exactly like distributed/passes does: a registered
+rule maps one recorded op name to a pure-jnp composition of primitive
+ops, and ``decompose`` clones the program with matching nodes rewritten.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..static import graph as _g
+
+__all__ = ["register_decomp", "get_decomp_rule", "decompose"]
+
+_RULES: Dict[str, Callable] = {}
+
+
+def register_decomp(op_name: str):
+    """Register a decomposition rule for a recorded op name (reference:
+    decomposition/register.py register_decomp). The rule is a pure
+    array function replacing the op's fn with primitive jnp ops."""
+
+    def deco(fn):
+        _RULES[op_name] = fn
+        return fn
+
+    return deco
+
+
+def get_decomp_rule(op_name: str) -> Optional[Callable]:
+    return _RULES.get(op_name)
+
+
+def decompose(fetches: List, ops: Optional[List[str]] = None) -> List:
+    """Rewrite the program producing ``fetches`` so every op in ``ops``
+    (default: all ops with registered rules) runs its primitive
+    decomposition (reference: decomposition/decomp.py decompose:194).
+    Returns new fetch handles over the rewritten DAG."""
+    from ..distributed.passes import rewrite_program
+
+    wanted = set(ops) if ops is not None else set(_RULES)
+
+    from ..distributed.passes import _avals_of
+
+    def transform(node, new_parents):
+        rule = _RULES.get(node.name)
+        if rule is None or node.name not in wanted:
+            return _g.OpNode(node.fn, new_parents, node.out_avals,
+                             node.name, node.single)
+        # a rule only applies when it reproduces the recorded op's output
+        # signature — an op instance whose closed-over attrs (axis, ...)
+        # the generic rule doesn't model keeps its original fn
+        try:
+            out = jax.eval_shape(rule, *_avals_of(new_parents))
+            outs = (out,) if not isinstance(out, (tuple, list)) \
+                else tuple(out)
+            ok = len(outs) == len(node.out_avals) and all(
+                tuple(a.shape) == tuple(b.shape) and a.dtype == b.dtype
+                for a, b in zip(outs, node.out_avals))
+        except Exception:
+            ok = False
+        if not ok:
+            return _g.OpNode(node.fn, new_parents, node.out_avals,
+                             node.name, node.single)
+        return _g.OpNode(rule, new_parents, node.out_avals,
+                         f"{node.name}_decomposed", node.single)
+
+    return rewrite_program(fetches, transform)
+
+
+# ---- built-in rules for the classic composite set (reference
+# decomposition/rules.py) ---------------------------------------------------
+
+@register_decomp("softmax")
+def _softmax_decomp(x, *rest):
+    mx = jnp.max(x, axis=-1, keepdims=True)
+    e = jnp.exp(x - mx)
+    return e / jnp.sum(e, axis=-1, keepdims=True)
+
+
+@register_decomp("log_softmax")
+def _log_softmax_decomp(x, *rest):
+    mx = jnp.max(x, axis=-1, keepdims=True)
+    s = x - mx
+    return s - jnp.log(jnp.sum(jnp.exp(s), axis=-1, keepdims=True))
+
+
+@register_decomp("gelu")
+def _gelu_decomp(x, *rest):
+    # erf form (the reference's primitive gelu rule)
+    return 0.5 * x * (1.0 + jax.lax.erf(x / jnp.sqrt(
+        jnp.asarray(2.0, x.dtype))))
+
+
+@register_decomp("silu")
+def _silu_decomp(x, *rest):
+    return x / (1.0 + jnp.exp(-x))
+
+
+@register_decomp("mean")
+def _mean_decomp(x, *rest):
+    return jnp.sum(x) / x.size
+
+
+@register_decomp("rsqrt")
+def _rsqrt_decomp(x, *rest):
+    return 1.0 / jnp.sqrt(x)

@@ -194,3 +194,57 @@ class TestPipelineSchedulePasses:
         np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
         assert grads[0].devices() == {devs[0]}
         assert grads[1].devices() == {devs[1]}
+
+
+class TestDecomposition:
+    def test_decompose_rewrites_and_matches(self, static_mode):
+        import paddle_tpu.decomposition as decomp
+
+        x = static.data("x", [4, 8], "float32")
+        y = paddle.nn.functional.softmax(x * 2)
+        out = (y * y).sum()
+        exe = static.Executor()
+        feed = {"x": np.random.RandomState(0).randn(4, 8)
+                .astype(np.float32)}
+        base = exe.run(feed=feed, fetch_list=[out])[0]
+        (out_d,) = decomp.decompose([out], ops=["softmax"])
+        got = exe.run(feed=feed, fetch_list=[out_d])[0]
+        np.testing.assert_allclose(got, base, rtol=1e-5)
+
+    def test_custom_rule_registration(self, static_mode):
+        import jax.numpy as jnp
+
+        import paddle_tpu.decomposition as decomp
+
+        @decomp.register_decomp("tanh")
+        def tanh_rule(a):
+            e2 = jnp.exp(2 * a)
+            return (e2 - 1) / (e2 + 1)
+
+        try:
+            assert decomp.get_decomp_rule("tanh") is tanh_rule
+            x = static.data("xx", [3], "float32")
+            out = paddle.tanh(x)
+            (out_d,) = decomp.decompose([out], ops=["tanh"])
+            got = static.Executor().run(
+                feed={"xx": np.array([0.1, -0.5, 2.0], np.float32)},
+                fetch_list=[out_d])[0]
+            np.testing.assert_allclose(got, np.tanh([0.1, -0.5, 2.0]),
+                                       rtol=1e-5)
+        finally:
+            decomp._RULES.pop("tanh", None)
+
+    def test_mismatched_rule_falls_back(self, static_mode):
+        """An axis-reduced mean does not match the global-mean rule's
+        signature — the original op must be kept, values unchanged."""
+        import paddle_tpu.decomposition as decomp
+
+        x = static.data("xm", [4, 8], "float32")
+        out = x.mean(axis=1).sum()
+        feed = {"xm": np.random.RandomState(2).randn(4, 8)
+                .astype(np.float32)}
+        exe = static.Executor()
+        base = exe.run(feed=feed, fetch_list=[out])[0]
+        (out_d,) = decomp.decompose([out])
+        got = exe.run(feed=feed, fetch_list=[out_d])[0]
+        np.testing.assert_allclose(got, base, rtol=1e-6)
