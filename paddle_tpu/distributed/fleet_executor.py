@@ -136,7 +136,7 @@ class Interceptor(threading.Thread):
                                            self.node.task_id, d, out,
                                            step))
                 else:  # sink
-                    self.results.append((step, out))
+                    self.results.append((step, self.node.task_id, out))
 
     def stop(self):
         self._stop = True
@@ -209,8 +209,10 @@ class FleetExecutor:
                 _Msg(_Msg.DATA_IS_READY, -1, src.task_id, payload, step))
         # -1 credits: the source treats feeder credit as infinite
         self.carrier.wait(len(feeds) * len(self._sinks), timeout)
-        out = sorted(self.carrier.results)
-        return [o for _, o in out]
+        # key on (step, sink id) — deterministic across thread schedules,
+        # and payloads (jax arrays) never enter the comparison
+        out = sorted(self.carrier.results, key=lambda r: (r[0], r[1]))
+        return [o for _, _, o in out]
 
     def release(self):
         self.carrier.release()

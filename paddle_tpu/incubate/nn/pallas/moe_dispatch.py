@@ -112,14 +112,27 @@ def grouped_matmul(xp, w, block_gid, *, bn=None, impl=None,
         impl = "ragged" if _interpret_default() else "pallas"
     p, kdim = xp.shape
     e, _, n = w.shape
-    if impl == "ragged" or pltpu is None:
+    def _ragged():
         # padded group sizes from the block map (nondecreasing by
         # construction, so rows are expert-contiguous as ragged_dot needs)
         sizes = jnp.bincount(block_gid, length=e) * _BM
         return jax.lax.ragged_dot(xp, w, sizes.astype(jnp.int32))
+
+    if impl == "ragged" or pltpu is None:
+        return _ragged()
     if interpret is None:
         interpret = _interpret_default()
-    bn = bn or min(n, 512)
+    # bn must DIVIDE n: the grid has n // bn column blocks, so a remainder
+    # would leave the last n % bn output columns unwritten (garbage)
+    if bn is not None:
+        if n % bn:
+            raise ValueError(f"bn={bn} does not divide N={n}")
+    elif n <= 512:
+        bn = n
+    else:
+        bn = next((c for c in (512, 384, 256, 128) if n % c == 0), None)
+        if bn is None:  # no MXU-aligned divisor — ragged handles any N
+            return _ragged()
     grid = (p // _BM, n // bn)
     return pl.pallas_call(
         _gmm_kernel,

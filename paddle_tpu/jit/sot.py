@@ -15,12 +15,23 @@ down the taken side. One dynamic ``if`` therefore yields two compiled
 XLA programs (guard subgraph + remainder) instead of degrading the whole
 function to eager like the retrace fallback in jit/__init__.py.
 
-Guard tree replay: each cached entry is keyed by input types/shapes/
-dtypes (+ repr of non-tensor args). Calls walk the chain of guard
-subgraphs; a novel combination of branch outcomes re-captures just that
-path. Shapes are static per entry exactly as XLA requires, so the guard
-set is {input signature} x {branch outcomes} — the same contract as the
-reference's guard chains (sot/opcode_translator/executor/guard.py).
+Guard tree replay: each cached entry is keyed by a STRUCTURAL input
+signature — the pytree treedef of (args, kwargs) plus shape/dtype for
+every array leaf (arrays inside lists/dicts/tuples included) and repr
+for non-array leaves. Every array leaf is a FEED of the captured
+program, never a baked constant, so two calls with the same structure
+but different values share one compiled program. Replay is ONE device
+dispatch per call: each path's guard VALUES are extra fetches of its
+output program, compared on host against expectations produced by the
+first run of that same compiled program (so expected and got can never
+diverge by compiler reassociation), instead of evaluating each guard
+prefix as its own subgraph. Matched paths move to the front (MRU), so
+the common case stays one dispatch; a miss costs that path's full
+program — the price of fusing guards with outputs. A novel
+combination of branch outcomes re-captures just that path. Shapes are
+static per entry exactly as XLA requires, so the guard set is {input
+signature} x {branch outcomes} — the same contract as the reference's
+guard chains (sot/opcode_translator/executor/guard.py).
 """
 from __future__ import annotations
 
@@ -46,7 +57,10 @@ class _CaptureCtx:
 
     def concretize(self, t: Tensor):
         """Evaluate the recorded prefix producing ``t`` as a compiled
-        subgraph; record the (node, value) pair as a guard."""
+        subgraph (the branch needs the concrete value NOW, mid-capture);
+        record the node as a guard. The guard's replay expectation is
+        derived later from the fused replay program itself, not from this
+        prefix run — see SOTFunction._capture."""
         node = t._sym_node
         run, feed_names, params = _g.trace([node])
         fn = jax.jit(lambda feeds, ps: run(feeds, ps))
@@ -74,30 +88,60 @@ def _sot_concretize(t: Tensor):
     return _active_ctx.concretize(t)
 
 
+def _is_tensor_leaf(x):
+    return isinstance(x, Tensor)
+
+
+def _flatten_inputs(args, kwargs):
+    """Flatten (args, kwargs) as one pytree; Tensors are leaves. Arrays
+    nested inside lists/dicts/tuples surface as individual leaves, so
+    they can be fed rather than baked into the captured program."""
+    return jax.tree_util.tree_flatten((args, kwargs),
+                                      is_leaf=_is_tensor_leaf)
+
+
+def _leaf_array(a):
+    """The feedable array value of a leaf, or None if it must be baked
+    (python scalars/strings/objects are static, like the reference)."""
+    if isinstance(a, Tensor):
+        return a._data
+    if isinstance(a, (np.ndarray, jax.Array)):
+        return jnp.asarray(a)
+    return None
+
+
 def _sig_of(args, kwargs):
+    """Structural signature: container structure (treedef covers tuple/
+    list/dict shape and kwarg names) + shape/dtype per array leaf + repr
+    per static leaf. Array VALUES never enter the key — they are feeds."""
+    leaves, treedef = _flatten_inputs(args, kwargs)
     parts = []
-    for a in list(args) + sorted(kwargs.items()):
-        if isinstance(a, tuple):
-            a = a[1]
+    for a in leaves:
         if isinstance(a, Tensor):
             parts.append(("T", tuple(a.shape), str(a._data.dtype)))
         elif isinstance(a, (np.ndarray, jax.Array)):
             parts.append(("A", tuple(a.shape), str(a.dtype)))
         else:
-            parts.append(("P", repr(a)))
-    return tuple(parts)
+            parts.append(("P", type(a).__name__, repr(a)))
+    return (str(treedef), tuple(parts))
 
 
 class _PathProgram:
-    """One captured path: its guard chain and the compiled output fn."""
+    """One captured path: ONE compiled program whose first ``n_guards``
+    fetches are the guard values and whose remaining fetches are the
+    outputs. ``expected`` holds the guard values the replay program
+    itself produced on its first run — comparing replay output against
+    replay output makes the check immune to compiler reassociation
+    between the capture-time prefix subgraphs and the fused program."""
 
-    def __init__(self, guards, out_fn, out_feed_names, out_params,
+    def __init__(self, guards, replay_fn, feed_names, params,
                  out_treedef, n_outs, n_subgraphs):
-        self.guards = guards          # [(jitted cond fn, feed names,
-        #                                params, expected value)]
-        self.out_fn = out_fn
-        self.out_feed_names = out_feed_names
-        self.out_params = out_params
+        self.guards = guards          # [(sym_node, capture-time value)]
+        self.n_guards = len(guards)
+        self.expected: List[np.ndarray] = []  # set on first replay run
+        self.replay_fn = replay_fn
+        self.feed_names = feed_names
+        self.params = params
         self.out_treedef = out_treedef
         self.n_outs = n_outs
         self.n_subgraphs = n_subgraphs
@@ -110,6 +154,7 @@ class SOTFunction:
         self._fn = fn
         self._cache: Dict[Any, List[_PathProgram]] = {}
         self.graph_break_count = 0    # capture-time breaks observed
+        self.last_call_dispatches = 0  # compiled-program runs last call
         functools.update_wrapper(self, fn)
 
     def __get__(self, instance, owner):
@@ -123,38 +168,36 @@ class SOTFunction:
 
     # ------------------------------------------------- feed symbolization
     @staticmethod
-    def _feed_items(args, kwargs):
-        """(name, value) for every array-like input — positional Tensors,
-        raw jax/numpy arrays, and Tensor/array kwargs all become feeds so
-        their VALUES are never baked into the captured program."""
-        items = []
-        for i, a in enumerate(args):
-            if isinstance(a, Tensor):
-                items.append((f"sot_arg{i}", a._data, ("pos", i)))
-            elif isinstance(a, (np.ndarray, jax.Array)):
-                items.append((f"sot_arg{i}", jnp.asarray(a), ("pos", i)))
-        for k in sorted(kwargs):
-            v = kwargs[k]
-            if isinstance(v, Tensor):
-                items.append((f"sot_kw_{k}", v._data, ("kw", k)))
-            elif isinstance(v, (np.ndarray, jax.Array)):
-                items.append((f"sot_kw_{k}", jnp.asarray(v), ("kw", k)))
-        return items
+    def _feed_values(args, kwargs):
+        """name -> concrete array for every array leaf of (args, kwargs),
+        containers included — their VALUES are never baked into the
+        captured program."""
+        leaves, _ = _flatten_inputs(args, kwargs)
+        out = {}
+        for i, a in enumerate(leaves):
+            val = _leaf_array(a)
+            if val is not None:
+                out[f"sot_leaf{i}"] = val
+        return out
 
     # ---------------------------------------------------------- capture
     def _capture(self, args, kwargs):
         global _active_ctx
+        leaves, treedef = _flatten_inputs(args, kwargs)
         feed_values = {}
-        sym_args = list(args)
-        sym_kwargs = dict(kwargs)
-        for name, val, (kind, key) in self._feed_items(args, kwargs):
+        sym_leaves_in = []
+        for i, a in enumerate(leaves):
+            val = _leaf_array(a)
+            if val is None:
+                sym_leaves_in.append(a)   # static python value: baked,
+                continue                  # keyed by repr in the signature
+            name = f"sot_leaf{i}"
             aval = jax.ShapeDtypeStruct(tuple(val.shape), val.dtype)
-            sym = _g.make_symbolic(_g.FeedLeaf(name, aval), 0, name=name)
+            sym_leaves_in.append(
+                _g.make_symbolic(_g.FeedLeaf(name, aval), 0, name=name))
             feed_values[name] = val
-            if kind == "pos":
-                sym_args[key] = sym
-            else:
-                sym_kwargs[key] = sym
+        sym_args, sym_kwargs = jax.tree_util.tree_unflatten(
+            treedef, sym_leaves_in)
         ctx = _CaptureCtx(feed_values)
         prev_ctx, _active_ctx = _active_ctx, ctx
         prev_static = static_flags.enabled
@@ -165,23 +208,26 @@ class SOTFunction:
             static_flags.enabled = prev_static
             _active_ctx = prev_ctx
         out_leaves, out_treedef = jax.tree_util.tree_flatten(
-            out, is_leaf=lambda x: isinstance(x, Tensor))
+            out, is_leaf=_is_tensor_leaf)
         sym_leaves = [t for t in out_leaves if _g.is_symbolic(t)]
         const_leaves = [None if _g.is_symbolic(t) else t
                         for t in out_leaves]
-        run, feed_names, params = _g.trace(
-            [t._sym_node for t in sym_leaves])
-        out_fn = jax.jit(lambda feeds, ps: run(feeds, ps))
-        guard_progs = []
-        for node, val in ctx.guards:
-            grun, gfeeds, gparams = _g.trace([node])
-            gfn = jax.jit(lambda feeds, ps, _r=grun: _r(feeds, ps))
-            guard_progs.append((gfn, gfeeds, gparams, val))
+        # ONE program per path: guard-value fetches first (if any), then
+        # the outputs — replay is a single device dispatch
+        fetch_nodes = [node for node, _ in ctx.guards] \
+            + [t._sym_node for t in sym_leaves]
+        run, feed_names, params = _g.trace(fetch_nodes)
+        replay_fn = jax.jit(lambda feeds, ps: run(feeds, ps))
         self.graph_break_count += len(ctx.guards)
-        prog = _PathProgram(guard_progs, out_fn, feed_names, params,
+        prog = _PathProgram(ctx.guards, replay_fn, feed_names, params,
                             (out_treedef, const_leaves), len(sym_leaves),
                             ctx.n_subgraphs)
-        return prog
+        # first run doubles as the expectation source: the guard values
+        # THIS compiled program computes are what future calls must match
+        vals = replay_fn({k: feed_values[k] for k in feed_names},
+                         [p._data for p in params])
+        prog.expected = [np.asarray(v) for v in vals[:prog.n_guards]]
+        return prog, list(vals[prog.n_guards:])
 
     # ------------------------------------------------------------- call
     def __call__(self, *args, **kwargs):
@@ -198,25 +244,36 @@ class SOTFunction:
             # invariant StaticFunction keeps via its cache_key
             sig = sig + (("training", bool(owner.training)),)
         paths = self._cache.setdefault(sig, [])
-        feed_values = {name: val
-                       for name, val, _ in self._feed_items(args, kwargs)}
+        feed_values = self._feed_values(args, kwargs)
+        self.last_call_dispatches = 0
 
-        def guards_hold(prog):
-            for gfn, gfeeds, gparams, expect in prog.guards:
-                got = np.asarray(gfn(
-                    {k: feed_values[k] for k in gfeeds},
-                    [p._data for p in gparams])[0])
-                if not np.array_equal(got, expect):
-                    return False
-            return True
+        def run_path(prog):
+            """ONE device dispatch: outputs + guard values together.
+            Returns the output values if the guards held, else None."""
+            vals = prog.replay_fn(
+                {k: feed_values[k] for k in prog.feed_names},
+                [p._data for p in prog.params])
+            self.last_call_dispatches += 1
+            for got, expect in zip(vals[:prog.n_guards], prog.expected):
+                if not np.array_equal(np.asarray(got), expect):
+                    return None
+            return vals[prog.n_guards:]
 
-        prog = next((p for p in paths if guards_hold(p)), None)
-        if prog is None:
-            prog = self._capture(args, kwargs)
+        vals = prog = None
+        for cand in paths:
+            vals = run_path(cand)
+            if vals is not None:
+                prog = cand
+                break
+        if vals is None:
+            prog, vals = self._capture(args, kwargs)
+            self.last_call_dispatches += 1
             paths.append(prog)
-        vals = prog.out_fn(
-            {k: feed_values[k] for k in prog.out_feed_names},
-            [p._data for p in prog.out_params])
+        if paths and paths[0] is not prog:
+            # MRU order: a miss re-runs the whole candidate program, so
+            # keep the path most likely to match in front
+            paths.remove(prog)
+            paths.insert(0, prog)
         out_treedef, const_leaves = prog.out_treedef
         leaves, i = [], 0
         for c in const_leaves:

@@ -5,6 +5,7 @@ lowers to jnp/lax dot_general so XLA can tile it onto the systolic array.
 """
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from ..core.tensor import Tensor
@@ -320,8 +321,11 @@ def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
     ax = axis_arg(axis)
 
     def fn(a):
-        v = a.reshape(-1) if ax is None else a
-        axx = None if ax is None else ax
+        # axis=None + keepdim must keep the input rank (all-ones shape),
+        # so reduce over every axis instead of flattening
+        v = a.reshape(-1) if ax is None and not keepdim else a
+        axx = (tuple(range(a.ndim)) if keepdim else None) \
+            if ax is None else ax
         if p == float("inf"):
             return jnp.max(jnp.abs(v), axis=axx, keepdims=keepdim)
         if p == float("-inf"):
@@ -390,33 +394,35 @@ def matrix_exp(x, name=None):
 
 def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
     """reference: python/paddle/tensor/linalg.py lu_unpack — split packed
-    LU into P (from 1-based pivot swaps), unit-lower L and upper U."""
-    x = as_tensor(x)
-    yv = as_tensor(y)
-
+    LU into P (from 1-based pivot swaps), unit-lower L and upper U.
+    Canonical implementation (ops/more.py re-exports it); handles any
+    leading batch dims. Pivots ride run_op as a real input (not a baked
+    closure constant), so static capture feeds them."""
     def fn(lu_, piv):
+        import jax.lax as lax
+
         m, n = lu_.shape[-2], lu_.shape[-1]
         k = min(m, n)
         L = jnp.tril(lu_[..., :, :k], -1) + jnp.eye(m, k, dtype=lu_.dtype)
         U = jnp.triu(lu_[..., :k, :])
-        # pivots (1-based sequential swaps) -> permutation matrix
-        perm = jnp.arange(m)
+        # pivots (1-based sequential swaps) -> permutation, vectorized
+        # over any leading batch dims
         piv0 = piv.astype(jnp.int32) - 1
 
-        def body(i, pm):
-            j = piv0[..., i]
-            pi, pj = pm[i], pm[j]
-            pm = pm.at[i].set(pj)
-            return pm.at[j].set(pi)
+        def perm_of(p0):
+            def body(i, pm):
+                j = p0[i]
+                pi, pj = pm[i], pm[j]
+                pm = pm.at[i].set(pj)
+                return pm.at[j].set(pi)
 
-        import jax.lax as lax
+            return lax.fori_loop(0, p0.shape[0], body, jnp.arange(m))
 
-        perm = lax.fori_loop(0, piv0.shape[-1], body, perm)
-        P = jnp.eye(m, dtype=lu_.dtype)[perm].T
+        perm = jnp.vectorize(perm_of, signature="(k)->(m)")(piv0)
+        P = jnp.swapaxes(jax.nn.one_hot(perm, m, dtype=lu_.dtype), -1, -2)
         return P, L, U
 
-    P, L, U = fn(x._data, yv._data)
-    return Tensor(P), Tensor(L), Tensor(U)
+    return run_op(fn, [as_tensor(x), as_tensor(y)], name="lu_unpack")
 
 
 def ormqr(x, tau, other, left=True, transpose=False, name=None):

@@ -390,16 +390,9 @@ def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
     return run_op(fn, [as_tensor(x)], name="matrix_norm")
 
 
-def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
-    def fn(a):
-        ax = axis if axis is None or isinstance(axis, (int, tuple)) \
-            else tuple(axis)
-        if ax is None:
-            a = a.reshape(-1)
-            ax = 0
-        return jnp.linalg.norm(a, ord=p, axis=ax, keepdims=keepdim)
-
-    return run_op(fn, [as_tensor(x)], name="vector_norm")
+# canonical implementation lives in ops/linalg.py (single copy — the
+# star-import order makes this module's binding win at top level)
+from .linalg import vector_norm  # noqa: F401,E402
 
 
 def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):
@@ -420,34 +413,8 @@ def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):
     return run_op(fn, [as_tensor(input)], name="diag_embed")
 
 
-def lu_unpack(lu_data, lu_pivots, unpack_ludata=True, unpack_pivots=True,
-              name=None):
-    """reference: linalg.py lu_unpack."""
-    piv = unwrap(as_tensor(lu_pivots)).astype(jnp.int32)
-
-    def fn(a):
-        m, n = a.shape[-2], a.shape[-1]
-        k = min(m, n)
-        L = jnp.tril(a[..., :, :k], -1) + jnp.eye(m, k, dtype=a.dtype)
-        U = jnp.triu(a[..., :k, :])
-
-        def perm_from_piv(p1):
-            perm = jnp.arange(m)
-            for i in range(p1.shape[0]):
-                j = p1[i] - 1
-                pi = perm[i]
-                perm = perm.at[i].set(perm[j])
-                perm = perm.at[j].set(pi)
-            return perm
-
-        # batched pivot→permutation reconstruction over leading dims
-        pv = piv.reshape((-1, piv.shape[-1]))
-        perms = jax.vmap(perm_from_piv)(pv)
-        P = jnp.swapaxes(jnp.eye(m, dtype=a.dtype)[perms], -1, -2)
-        P = P.reshape(a.shape[:-2] + (m, m))
-        return P, L, U
-
-    return run_op(fn, [as_tensor(lu_data)], name="lu_unpack")
+# canonical implementation lives in ops/linalg.py (single copy)
+from .linalg import lu_unpack  # noqa: F401,E402
 
 
 def svd_lowrank(x, q=6, niter=2, M=None, name=None):

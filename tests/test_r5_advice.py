@@ -1,0 +1,94 @@
+"""Regression tests for the round-4 advisor findings (ADVICE.md r4).
+
+One test per finding:
+  1. moe_dispatch grouped_matmul must cover ALL output columns when N is
+     not a multiple of 512 (the pallas grid used to silently drop the
+     last N % bn columns).
+  2. jit.sot signature must distinguish tuple-valued positional args
+     (f(x, (3, 5)) vs f(x, (4, 5)) used to collide).
+  3. FleetExecutor.run with two sinks must not compare jax-array
+     payloads while sorting results.
+  4. lu_unpack must handle batched LU factors.
+  5. vector_norm(axis=None, keepdim=True) keeps the input rank.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_grouped_matmul_non_multiple_of_512_columns():
+    from paddle_tpu.incubate.nn.pallas.moe_dispatch import (
+        _BM, grouped_matmul)
+
+    rng = np.random.default_rng(0)
+    e, kdim, n = 4, 64, 768  # 768 % 512 != 0 — the reported breakage
+    p = e * _BM
+    xp = rng.standard_normal((p, kdim)).astype(np.float32)
+    w = rng.standard_normal((e, kdim, n)).astype(np.float32)
+    block_gid = np.repeat(np.arange(e, dtype=np.int32), 1)
+    out = np.asarray(grouped_matmul(xp, w, block_gid, impl="pallas",
+                                    interpret=True))
+    ref = np.concatenate(
+        [xp[i * _BM:(i + 1) * _BM] @ w[g]
+         for i, g in enumerate(block_gid)], axis=0)
+    np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-4)
+    # explicit non-divisor bn must be rejected, not silently wrong
+    with pytest.raises(ValueError):
+        grouped_matmul(xp, w, block_gid, bn=512, impl="pallas",
+                       interpret=True)
+
+
+def test_sot_tuple_positional_args_not_collapsed():
+    from paddle_tpu.jit.sot import symbolic_translate
+
+    @symbolic_translate
+    def f(x, lohi):
+        return x * lohi[0] + lohi[1]
+
+    x = paddle.to_tensor(np.ones(4, np.float32))
+    a = f(x, (3.0, 5.0)).numpy()
+    b = f(x, (4.0, 5.0)).numpy()
+    np.testing.assert_allclose(a, np.full(4, 8.0))
+    np.testing.assert_allclose(b, np.full(4, 9.0))
+
+
+def test_fleet_executor_two_sinks_sortable():
+    from paddle_tpu.distributed.fleet_executor import (
+        FleetExecutor, TaskNode)
+    import jax.numpy as jnp
+
+    src = TaskNode(0, fn=lambda x: jnp.asarray(x) + 1)
+    a = TaskNode(1, fn=lambda x: x * 2)
+    b = TaskNode(2, fn=lambda x: x * 3)
+    src.add_downstream_task(1)
+    src.add_downstream_task(2)
+    ex = FleetExecutor([src, a, b])
+    try:
+        out = ex.run([np.float32(1.0), np.float32(2.0)])
+    finally:
+        ex.release()
+    # 2 feeds x 2 sinks, ordered by step; same-step order is stable
+    assert len(out) == 4
+    vals = sorted(float(v) for v in out)
+    assert vals == [4.0, 6.0, 6.0, 9.0]
+
+
+def test_lu_unpack_batched():
+    rng = np.random.default_rng(1)
+    a = rng.standard_normal((3, 5, 5)).astype(np.float32)
+    lu, piv = paddle.linalg.lu(paddle.to_tensor(a))
+    P, L, U = paddle.linalg.lu_unpack(lu, piv)
+    recon = np.einsum("bij,bjk,bkl->bil", P.numpy(), L.numpy(), U.numpy())
+    np.testing.assert_allclose(recon, a, rtol=1e-4, atol=1e-4)
+
+
+def test_vector_norm_keepdim_rank():
+    x = paddle.to_tensor(np.arange(24, dtype=np.float32).reshape(2, 3, 4))
+    out = paddle.linalg.vector_norm(x, axis=None, keepdim=True)
+    assert tuple(out.shape) == (1, 1, 1)
+    np.testing.assert_allclose(
+        float(out.numpy().ravel()[0]),
+        np.linalg.norm(np.arange(24, dtype=np.float32)), rtol=1e-5)
+    out2 = paddle.linalg.vector_norm(x, axis=None, keepdim=False)
+    assert tuple(out2.shape) == ()
