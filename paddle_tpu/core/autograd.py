@@ -127,7 +127,8 @@ class GradNode:
 _profiler_mod = None  # bound on first run_op call (avoids init-order cycle)
 
 
-def run_op(fn: Callable, tensors: Sequence, name: str = "op", n_outputs: Optional[int] = None):
+def run_op(fn: Callable, tensors: Sequence, name: str = "op", n_outputs: Optional[int] = None,
+           attrs: Optional[dict] = None):
     """Execute pure jax function ``fn`` over Tensor inputs, recording the tape.
 
     ``fn(*arrays) -> array | tuple[array]``. Returns Tensor or tuple of Tensors.
@@ -147,20 +148,21 @@ def run_op(fn: Callable, tensors: Sequence, name: str = "op", n_outputs: Optiona
 
         _t0 = _time.perf_counter_ns()
         try:
-            return _run_op_impl(fn, tensors, name)
+            return _run_op_impl(fn, tensors, name, attrs)
         finally:
             _col.record(name, "op", _t0, _time.perf_counter_ns() - _t0)
-    return _run_op_impl(fn, tensors, name)
+    return _run_op_impl(fn, tensors, name, attrs)
 
 
-def _run_op_impl(fn: Callable, tensors: Sequence, name: str = "op"):
+def _run_op_impl(fn: Callable, tensors: Sequence, name: str = "op",
+                 attrs: Optional[dict] = None):
     from .tensor import Tensor
 
     if static_flags.enabled:
         from ..static import graph as _graph
 
         if any(_graph.is_symbolic(t) for t in tensors):
-            return _graph.record_op(fn, tensors, name)
+            return _graph.record_op(fn, tensors, name, attrs=attrs)
 
     arrays = [t._data if isinstance(t, Tensor) else t for t in tensors]
 

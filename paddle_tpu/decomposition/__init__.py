@@ -1,5 +1,6 @@
 """paddle.decomposition (reference: python/paddle/decomposition/ —
-register.py rule registry, decomp.py decompose(program, ops)).
+register.py rule registry, decomp.py decompose(program, ops); rule
+bodies: paddle/fluid/primitive/decomp_rule/decomp_rule/composite.h).
 
 The reference decomposes composite ops into a primitive set so backends
 without the composite kernel (or the prim-based autodiff) can run them.
@@ -7,12 +8,29 @@ On XLA that role is largely moot — every op here already lowers to HLO
 primitives — so this tier exists for (a) program-level rewrites that
 want to see a smaller op vocabulary (custom passes, export), and (b)
 reference-workflow compatibility. Rules rewrite the captured op-DAG
-(static/graph.py) exactly like distributed/passes does: a registered
-rule maps one recorded op name to a pure-jnp composition of primitive
-ops, and ``decompose`` clones the program with matching nodes rewritten.
+(static/graph.py) exactly like distributed/passes does.
+
+Attr-aware rules (round 5, fixes the r4 soundness bug): ops record
+their attributes (axis, epsilon, approximate, ...) on the OpNode
+(`run_op(..., attrs={...})`), and every rule receives them as
+keyword-only parameters — mirroring the reference's rule signature
+(composite.h:337 `softmax_decomp(const Tensor& x, const int& axis)`).
+Applicability is SOUND, not shape-coincident:
+
+  * an op instance carrying an attr the rule does not accept keeps its
+    original fn (the rule cannot model it);
+  * an attr-dependent rule never fires on a node recorded without
+    attrs (no guessing defaults);
+  * the output avals must still match exactly (belt and braces).
+
+Because a decomposed node is an ordinary pure-jnp OpNode, jax.vjp
+differentiates straight through it — grad-through-decomposition needs
+no separate VJP-rule tier (the reference needs
+fluid/primitive/vjp_interface/ only because its primitives live in C++).
 """
 from __future__ import annotations
 
+import inspect
 from typing import Callable, Dict, List, Optional
 
 import jax
@@ -23,15 +41,39 @@ from ..static import graph as _g
 __all__ = ["register_decomp", "get_decomp_rule", "decompose"]
 
 _RULES: Dict[str, Callable] = {}
+_RULE_SIGS: Dict[str, tuple] = {}   # name -> (accepted, required, has_varkw)
+
+
+def _rule_sig(name: str, rule: Callable):
+    cached = _RULE_SIGS.get(name)
+    if cached is not None:
+        return cached
+    sig = inspect.signature(rule)
+    accepted = set()
+    required = set()
+    has_varkw = False
+    for k, p in sig.parameters.items():
+        if p.kind == p.KEYWORD_ONLY:
+            accepted.add(k)
+            if p.default is p.empty:
+                required.add(k)
+        elif p.kind == p.VAR_KEYWORD:
+            has_varkw = True
+    out = (accepted, required, has_varkw)
+    _RULE_SIGS[name] = out
+    return out
 
 
 def register_decomp(op_name: str):
     """Register a decomposition rule for a recorded op name (reference:
-    decomposition/register.py register_decomp). The rule is a pure
-    array function replacing the op's fn with primitive jnp ops."""
+    decomposition/register.py register_decomp). The rule is a pure array
+    function ``rule(*arrays, **attrs)`` — op attributes arrive as
+    keyword-only parameters and MUST be declared by the rule; undeclared
+    attrs make the rule inapplicable to that op instance."""
 
     def deco(fn):
         _RULES[op_name] = fn
+        _RULE_SIGS.pop(op_name, None)
         return fn
 
     return deco
@@ -46,22 +88,38 @@ def decompose(fetches: List, ops: Optional[List[str]] = None) -> List:
     (default: all ops with registered rules) runs its primitive
     decomposition (reference: decomposition/decomp.py decompose:194).
     Returns new fetch handles over the rewritten DAG."""
-    from ..distributed.passes import rewrite_program
+    from ..distributed.passes import _avals_of, rewrite_program
 
     wanted = set(ops) if ops is not None else set(_RULES)
 
-    from ..distributed.passes import _avals_of
+    def keep(node, new_parents):
+        return _g.OpNode(node.fn, new_parents, node.out_avals,
+                         node.name, node.single, attrs=node.attrs)
 
     def transform(node, new_parents):
         rule = _RULES.get(node.name)
         if rule is None or node.name not in wanted:
-            return _g.OpNode(node.fn, new_parents, node.out_avals,
-                             node.name, node.single)
-        # a rule only applies when it reproduces the recorded op's output
-        # signature — an op instance whose closed-over attrs (axis, ...)
-        # the generic rule doesn't model keeps its original fn
+            return keep(node, new_parents)
+        accepted, required, has_varkw = _rule_sig(node.name, rule)
+        attrs = node.attrs
+        if attrs is None:
+            # attrs=None means the op did NOT declare its attributes —
+            # its closure may carry anything (threshold, axis, ...), so
+            # no rule may fire. Attr-free ops declare attrs={} (the r4
+            # bug was firing rules on exactly these undeclared nodes).
+            return keep(node, new_parents)
+        keys = set(attrs)
+        if (not has_varkw and not keys <= accepted) \
+                or not required <= keys:
+            return keep(node, new_parents)
+        call_attrs = dict(attrs)
+
+        def fn(*arrays, _rule=rule, _attrs=call_attrs):
+            return _rule(*arrays, **_attrs)
+
+        # the rule must reproduce the op's exact output signature
         try:
-            out = jax.eval_shape(rule, *_avals_of(new_parents))
+            out = jax.eval_shape(fn, *_avals_of(new_parents))
             outs = (out,) if not isinstance(out, (tuple, list)) \
                 else tuple(out)
             ok = len(outs) == len(node.out_avals) and all(
@@ -70,48 +128,355 @@ def decompose(fetches: List, ops: Optional[List[str]] = None) -> List:
         except Exception:
             ok = False
         if not ok:
-            return _g.OpNode(node.fn, new_parents, node.out_avals,
-                             node.name, node.single)
-        return _g.OpNode(rule, new_parents, node.out_avals,
-                         f"{node.name}_decomposed", node.single)
+            return keep(node, new_parents)
+        return _g.OpNode(fn, new_parents, node.out_avals,
+                         f"{node.name}_decomposed", node.single,
+                         attrs=node.attrs)
 
     return rewrite_program(fetches, transform)
 
 
-# ---- built-in rules for the classic composite set (reference
-# decomposition/rules.py) ---------------------------------------------------
+# ---------------------------------------------------------------------------
+# Built-in rules — the transformer-vocabulary slice of the reference
+# composite set (composite.h). Each rule re-expresses the op in jnp/lax
+# primitives and mirrors the recorded fn's numerics exactly (same op
+# order, same f32 upcasts), so decompose() is value-preserving even in
+# bf16. Attr params are keyword-only, matching how op sites record them.
+# ---------------------------------------------------------------------------
+
+def _logistic(a):
+    return jax.lax.logistic(a)
+
 
 @register_decomp("softmax")
-def _softmax_decomp(x, *rest):
-    mx = jnp.max(x, axis=-1, keepdims=True)
+def _softmax_decomp(x, *, axis=-1, dtype=None):
+    # composite.h softmax_decomp(x, axis): x - max -> exp -> normalize
+    if dtype is not None:
+        x = x.astype(dtype)
+    mx = jax.lax.stop_gradient(jnp.max(x, axis=axis, keepdims=True))
     e = jnp.exp(x - mx)
-    return e / jnp.sum(e, axis=-1, keepdims=True)
+    return e / jnp.sum(e, axis=axis, keepdims=True)
 
 
 @register_decomp("log_softmax")
-def _log_softmax_decomp(x, *rest):
-    mx = jnp.max(x, axis=-1, keepdims=True)
-    s = x - mx
-    return s - jnp.log(jnp.sum(jnp.exp(s), axis=-1, keepdims=True))
+def _log_softmax_decomp(x, *, axis=-1, dtype=None):
+    if dtype is not None:
+        x = x.astype(dtype)
+    shifted = x - jax.lax.stop_gradient(
+        jnp.max(x, axis=axis, keepdims=True))
+    return shifted - jnp.log(
+        jnp.sum(jnp.exp(shifted), axis=axis, keepdims=True))
 
 
 @register_decomp("gelu")
-def _gelu_decomp(x, *rest):
-    # erf form (the reference's primitive gelu rule)
-    return 0.5 * x * (1.0 + jax.lax.erf(x / jnp.sqrt(
-        jnp.asarray(2.0, x.dtype))))
+def _gelu_decomp(x, *, approximate=False):
+    # composite.h gelu_decomp carries the approximate flag; erf and tanh
+    # forms are DIFFERENT functions — r4's rule silently swapped them.
+    # Term order/factoring mirrors jax.nn.gelu exactly for bit equality.
+    import numpy as _np
+
+    if approximate:
+        sqrt_2_over_pi = _np.sqrt(2 / _np.pi).astype(x.dtype)
+        cdf = 0.5 * (1.0 + jnp.tanh(sqrt_2_over_pi
+                                    * (x + 0.044715 * (x ** 3))))
+        return x * cdf
+    sqrt_half = _np.sqrt(0.5).astype(x.dtype)
+    return jnp.asarray(0.5 * x * jax.lax.erfc(-x * sqrt_half),
+                       dtype=x.dtype)
 
 
 @register_decomp("silu")
-def _silu_decomp(x, *rest):
-    return x / (1.0 + jnp.exp(-x))
+def _silu_decomp(x):
+    return x * _logistic(x)
 
 
-@register_decomp("mean")
-def _mean_decomp(x, *rest):
-    return jnp.sum(x) / x.size
+@register_decomp("swish")
+def _swish_decomp(x):
+    return x * _logistic(x)
+
+
+@register_decomp("sigmoid")
+def _sigmoid_decomp(x):
+    return _logistic(x)
+
+
+@register_decomp("relu")
+def _relu_decomp(x):
+    return jnp.maximum(x, 0)
+
+
+@register_decomp("relu6")
+def _relu6_decomp(x):
+    return jnp.minimum(jnp.maximum(x, 0), 6.0)
+
+
+@register_decomp("leaky_relu")
+def _leaky_relu_decomp(x, *, negative_slope=0.01):
+    return jnp.where(x >= 0, x, negative_slope * x)
+
+
+@register_decomp("elu")
+def _elu_decomp(x, *, alpha=1.0):
+    safe = jnp.where(x > 0, 0.0, x)
+    return jnp.where(x > 0, x, alpha * jnp.expm1(safe))
+
+
+@register_decomp("celu")
+def _celu_decomp(x, *, alpha=1.0):
+    return jnp.where(x > 0, x, alpha * jnp.expm1(x / alpha))
+
+
+@register_decomp("selu")
+def _selu_decomp(x, *, scale=1.0507009873554805,
+                 alpha=1.6732632423543772):
+    return scale * jnp.where(x > 0, x, alpha * jnp.expm1(x))
+
+
+@register_decomp("hardsigmoid")
+def _hardsigmoid_decomp(x, *, slope=0.1666667, offset=0.5):
+    return jnp.clip(slope * x + offset, 0.0, 1.0)
+
+
+@register_decomp("hardswish")
+def _hardswish_decomp(x):
+    return x * jnp.clip(x + 3.0, 0.0, 6.0) / 6.0
+
+
+@register_decomp("hardtanh")
+def _hardtanh_decomp(x, *, min=-1.0, max=1.0):
+    return jnp.clip(x, min, max)
+
+
+@register_decomp("softplus")
+def _softplus_decomp(x, *, beta=1.0, threshold=20.0):
+    return jnp.where(beta * x > threshold, x,
+                     jnp.log1p(jnp.exp(beta * x)) / beta)
+
+
+@register_decomp("log_sigmoid")
+def _log_sigmoid_decomp(x):
+    return -jnp.logaddexp(0.0, -x)
+
+
+@register_decomp("mish")
+def _mish_decomp(x):
+    return x * jnp.tanh(jnp.logaddexp(x, 0.0))
+
+
+@register_decomp("thresholded_relu")
+def _thresholded_relu_decomp(x, *, threshold=1.0, value=0.0):
+    return jnp.where(x > threshold, x, value)
+
+
+@register_decomp("glu")
+def _glu_decomp(x, *, axis=-1):
+    a1, a2 = jnp.split(x, 2, axis=axis)
+    return a1 * _logistic(a2)
+
+
+@register_decomp("swiglu")
+def _swiglu_decomp(x, y=None):
+    if y is None:
+        x, y = jnp.split(x, 2, axis=-1)
+    return x * _logistic(x) * y
 
 
 @register_decomp("rsqrt")
-def _rsqrt_decomp(x, *rest):
+def _rsqrt_decomp(x):
     return 1.0 / jnp.sqrt(x)
+
+
+@register_decomp("reciprocal")
+def _reciprocal_decomp(x):
+    return 1.0 / x
+
+
+@register_decomp("layer_norm")
+def _layer_norm_decomp(x, *wb, axes, epsilon=1e-5, has_weight=False,
+                       has_bias=False):
+    # composite.h layer_norm_decomp: f32 compute, rsqrt(var + eps)
+    af = x.astype(jnp.float32)
+    mean = jnp.mean(af, axis=axes, keepdims=True)
+    var = jnp.var(af, axis=axes, keepdims=True)
+    out = (af - mean) / jnp.sqrt(var + epsilon)
+    i = 0
+    if has_weight:
+        out = out * wb[i].astype(jnp.float32)
+        i += 1
+    if has_bias:
+        out = out + wb[i].astype(jnp.float32)
+    return out.astype(x.dtype)
+
+
+@register_decomp("rms_norm")
+def _rms_norm_decomp(x, *wb, axes, epsilon=1e-6, has_weight=False,
+                     has_bias=False):
+    af = x.astype(jnp.float32)
+    ms = jnp.mean(af * af, axis=axes, keepdims=True)
+    out = af * (1.0 / jnp.sqrt(ms + epsilon))
+    i = 0
+    if has_weight:
+        out = out * wb[i].astype(jnp.float32)
+        i += 1
+    if has_bias:
+        out = out + wb[i].astype(jnp.float32)
+    return out.astype(x.dtype)
+
+
+@register_decomp("dropout")
+def _dropout_decomp(x, *, p, axis=None, mode="upscale_in_train", key=None):
+    # composite.h dropout_decomp; the recorded rng key rides the attrs so
+    # the decomposed program reproduces the SAME mask bit-for-bit
+    if key is None:
+        raise ValueError("dropout decomposition requires the recorded key")
+    if axis is None:
+        shape = x.shape
+    else:
+        axes = (axis,) if isinstance(axis, int) else tuple(axis)
+        shape = tuple(s if i in axes else 1 for i, s in enumerate(x.shape))
+    keep = jax.random.bernoulli(key, 1.0 - p, shape)
+    if mode == "upscale_in_train":
+        return jnp.where(keep, x / (1.0 - p), 0.0).astype(x.dtype)
+    return jnp.where(keep, x, 0.0).astype(x.dtype)
+
+
+@register_decomp("mean")
+def _mean_decomp(x, *, axis=None, keepdim=False):
+    # composite.h mean_decomp: sum / numel-along-axes
+    if axis is None:
+        n = x.size
+    else:
+        axes = (axis,) if isinstance(axis, int) else tuple(axis)
+        n = 1
+        for a in axes:
+            n *= x.shape[a]
+    return jnp.sum(x, axis=axis, keepdims=keepdim) / jnp.asarray(
+        n, x.dtype if jnp.issubdtype(x.dtype, jnp.floating)
+        else jnp.float32)
+
+
+@register_decomp("var")
+def _var_decomp(x, *, axis=None, ddof=0, keepdim=False):
+    if axis is None:
+        n = x.size
+    else:
+        axes = (axis,) if isinstance(axis, int) else tuple(axis)
+        n = 1
+        for a in axes:
+            n *= x.shape[a]
+    mu = jnp.mean(x, axis=axis, keepdims=True)
+    sq = (x - mu) * (x - mu)
+    return jnp.sum(sq, axis=axis, keepdims=keepdim) / jnp.asarray(
+        n - ddof, sq.dtype)
+
+
+@register_decomp("std")
+def _std_decomp(x, *, axis=None, ddof=0, keepdim=False):
+    return jnp.sqrt(_var_decomp(x, axis=axis, ddof=ddof, keepdim=keepdim))
+
+
+@register_decomp("stack")
+def _stack_decomp(*xs, axis=0):
+    # composite.h stack via unsqueeze + concat
+    return jnp.concatenate([jnp.expand_dims(a, axis) for a in xs],
+                           axis=axis)
+
+
+@register_decomp("concat")
+def _concat_decomp(*xs, axis=0):
+    return jnp.concatenate(xs, axis=axis)
+
+
+@register_decomp("squeeze")
+def _squeeze_decomp(x, *, axis=None):
+    if axis is None:
+        return x.reshape(tuple(s for s in x.shape if s != 1))
+    real = tuple(i for i in axis
+                 if x.shape[i if i >= 0 else x.ndim + i] == 1)
+    if not real:
+        return x
+    drop = {i if i >= 0 else x.ndim + i for i in real}
+    return x.reshape(tuple(s for i, s in enumerate(x.shape)
+                           if i not in drop))
+
+
+@register_decomp("unsqueeze")
+def _unsqueeze_decomp(x, *, axis):
+    out_nd = x.ndim + len(axis)
+    norm = sorted(a if a >= 0 else out_nd + a for a in axis)
+    shape = list(x.shape)
+    for a in norm:
+        shape.insert(a, 1)
+    return x.reshape(tuple(shape))
+
+
+@register_decomp("flatten")
+def _flatten_decomp(x, *, start, stop):
+    return x.reshape(x.shape[:start] + (-1,) + x.shape[stop + 1:])
+
+
+@register_decomp("one_hot")
+def _one_hot_decomp(x, *, num_classes):
+    # composite.h one_hot via eq(unsqueeze(x), iota)
+    classes = jnp.arange(num_classes, dtype=x.dtype if jnp.issubdtype(
+        x.dtype, jnp.integer) else jnp.int32)
+    return (x[..., None] == classes).astype(jnp.float32)
+
+
+@register_decomp("clip")
+def _clip_decomp(x, *, min=None, max=None):
+    out = x
+    if min is not None:
+        out = jnp.maximum(out, min)
+    if max is not None:
+        out = jnp.minimum(out, max)
+    return out
+
+
+@register_decomp("scale")
+def _scale_decomp(x, *, scale=1.0, bias=0.0, bias_after_scale=True):
+    if bias_after_scale:
+        return x * scale + bias
+    return (x + bias) * scale
+
+
+def _reduce_rule(out, reduction):
+    if reduction == "mean":
+        return jnp.mean(out)
+    if reduction == "sum":
+        return jnp.sum(out)
+    return out
+
+
+@register_decomp("binary_cross_entropy")
+def _bce_decomp(x, label, *w, reduction="mean", has_weight=False):
+    a = jnp.clip(x, 1e-12, 1.0 - 1e-12)
+    out = -(label * jnp.log(a) + (1 - label) * jnp.log(1 - a))
+    if has_weight:
+        out = out * w[0]
+    return _reduce_rule(out, reduction)
+
+
+@register_decomp("bce_with_logits")
+def _bce_logits_decomp(x, label, *rest, reduction="mean",
+                       has_weight=False, has_pos_weight=False):
+    i = 0
+    w = rest[i] if has_weight else None
+    if has_weight:
+        i += 1
+    pw = rest[i] if has_pos_weight else None
+    max_val = jnp.maximum(-x, 0)
+    if pw is not None:
+        log_w = (pw - 1) * label + 1
+        out = (1 - label) * x + log_w * (
+            jnp.log1p(jnp.exp(-jnp.abs(x))) + max_val)
+    else:
+        out = (1 - label) * x + jnp.log1p(jnp.exp(-jnp.abs(x))) + max_val
+    if w is not None:
+        out = out * w
+    return _reduce_rule(out, reduction)
+
+
+@register_decomp("mse_loss")
+def _mse_decomp(x, label, *, reduction="mean"):
+    return _reduce_rule((x - label) ** 2, reduction)

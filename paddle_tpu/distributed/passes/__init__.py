@@ -133,7 +133,7 @@ def rewrite_program(fetches: List[Tensor],
 
 def _identity_clone(node, new_parents):
     return _g.OpNode(node.fn, new_parents, node.out_avals, node.name,
-                     node.single)
+                     node.single, attrs=node.attrs)
 
 
 # --------------------------------------------------------------- amp pass
@@ -183,7 +183,7 @@ class AMPPass(PassBase):
             outs = (out,) if not isinstance(out, (tuple, list)) \
                 else tuple(out)
             return _g.OpNode(amp_fn, new_parents, list(outs), node.name,
-                             node.single)
+                             node.single, attrs=node.attrs)
 
         return rewrite_program(fetches, transform)
 
@@ -209,7 +209,7 @@ class RecomputePass(PassBase):
                 return _identity_clone(node, new_parents)
             fn = jax.checkpoint(node.fn)
             return _g.OpNode(fn, new_parents, node.out_avals, node.name,
-                             node.single)
+                             node.single, attrs=node.attrs)
 
         return rewrite_program(fetches, transform)
 

@@ -16,7 +16,7 @@ __all__ = [
 
 
 def relu(x, name=None):
-    return unary(jax.nn.relu, x, "relu")
+    return unary(jax.nn.relu, x, "relu", attrs={})
 
 
 def relu_(x, name=None):
@@ -34,49 +34,54 @@ def tanh_(x, name=None):
 
 
 def relu6(x, name=None):
-    return unary(jax.nn.relu6, x, "relu6")
+    return unary(jax.nn.relu6, x, "relu6", attrs={})
 
 
 def elu(x, alpha=1.0, name=None):
-    return unary(lambda a: jax.nn.elu(a, alpha), x, "elu")
+    return unary(lambda a: jax.nn.elu(a, alpha), x, "elu",
+                 attrs={"alpha": alpha})
 
 
 def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
     return unary(lambda a: scale * jnp.where(a > 0, a, alpha * jnp.expm1(a)),
-                 x, "selu")
+                 x, "selu", attrs={"scale": scale, "alpha": alpha})
 
 
 def celu(x, alpha=1.0, name=None):
-    return unary(lambda a: jax.nn.celu(a, alpha), x, "celu")
+    return unary(lambda a: jax.nn.celu(a, alpha), x, "celu",
+                 attrs={"alpha": alpha})
 
 
 def gelu(x, approximate=False, name=None):
-    return unary(lambda a: jax.nn.gelu(a, approximate=approximate), x, "gelu")
+    return unary(lambda a: jax.nn.gelu(a, approximate=approximate), x,
+                 "gelu", attrs={"approximate": bool(approximate)})
 
 
 def silu(x, name=None):
-    return unary(jax.nn.silu, x, "silu")
+    return unary(jax.nn.silu, x, "silu", attrs={})
 
 
 def swish(x, name=None):
-    return unary(jax.nn.silu, x, "swish")
+    return unary(jax.nn.silu, x, "swish", attrs={})
 
 
 def sigmoid(x, name=None):
-    return unary(jax.nn.sigmoid, x, "sigmoid")
+    return unary(jax.nn.sigmoid, x, "sigmoid", attrs={})
 
 
 def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
     return unary(lambda a: jnp.clip(slope * a + offset, 0.0, 1.0), x,
-                 "hardsigmoid")
+                 "hardsigmoid", attrs={"slope": slope, "offset": offset})
 
 
 def hardswish(x, name=None):
-    return unary(lambda a: a * jnp.clip(a + 3.0, 0.0, 6.0) / 6.0, x, "hardswish")
+    return unary(lambda a: a * jnp.clip(a + 3.0, 0.0, 6.0) / 6.0, x,
+                 "hardswish", attrs={})
 
 
 def hardtanh(x, min=-1.0, max=1.0, name=None):
-    return unary(lambda a: jnp.clip(a, min, max), x, "hardtanh")
+    return unary(lambda a: jnp.clip(a, min, max), x, "hardtanh",
+                 attrs={"min": min, "max": max})
 
 
 def hardshrink(x, threshold=0.5, name=None):
@@ -96,7 +101,8 @@ def tanhshrink(x, name=None):
 
 
 def leaky_relu(x, negative_slope=0.01, name=None):
-    return unary(lambda a: jax.nn.leaky_relu(a, negative_slope), x, "leaky_relu")
+    return unary(lambda a: jax.nn.leaky_relu(a, negative_slope), x,
+                 "leaky_relu", attrs={"negative_slope": negative_slope})
 
 
 def prelu(x, weight, data_format="NCHW", name=None):
@@ -132,7 +138,7 @@ def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=True, name=None):
 
 
 def log_sigmoid(x, name=None):
-    return unary(jax.nn.log_sigmoid, x, "log_sigmoid")
+    return unary(jax.nn.log_sigmoid, x, "log_sigmoid", attrs={})
 
 
 def maxout(x, groups, axis=1, name=None):
@@ -149,7 +155,7 @@ def softplus(x, beta=1.0, threshold=20.0, name=None):
     return unary(
         lambda a: jnp.where(beta * a > threshold, a,
                             jnp.log1p(jnp.exp(beta * a)) / beta),
-        x, "softplus")
+        x, "softplus", attrs={"beta": beta, "threshold": threshold})
 
 
 def softsign(x, name=None):
@@ -161,7 +167,8 @@ def tanh(x, name=None):
 
 
 def mish(x, name=None):
-    return unary(lambda a: a * jnp.tanh(jax.nn.softplus(a)), x, "mish")
+    return unary(lambda a: a * jnp.tanh(jax.nn.softplus(a)), x, "mish",
+                 attrs={})
 
 
 def softmax(x, axis=-1, dtype=None, name=None):
@@ -174,7 +181,9 @@ def softmax(x, axis=-1, dtype=None, name=None):
             a = a.astype(jdt)
         return jax.nn.softmax(a, axis=axis)
 
-    return unary(fn, x, "softmax")
+    return unary(fn, x, "softmax",
+                 attrs={"axis": axis, "dtype": None if jdt is None
+                        else str(jdt)})
 
 
 def log_softmax(x, axis=-1, dtype=None, name=None):
@@ -187,7 +196,9 @@ def log_softmax(x, axis=-1, dtype=None, name=None):
             a = a.astype(jdt)
         return jax.nn.log_softmax(a, axis=axis)
 
-    return unary(fn, x, "log_softmax")
+    return unary(fn, x, "log_softmax",
+                 attrs={"axis": axis, "dtype": None if jdt is None
+                        else str(jdt)})
 
 
 def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
@@ -214,7 +225,7 @@ def glu(x, axis=-1, name=None):
         a1, a2 = jnp.split(a, 2, axis=axis)
         return a1 * jax.nn.sigmoid(a2)
 
-    return unary(fn, x, "glu")
+    return unary(fn, x, "glu", attrs={"axis": axis})
 
 
 def swiglu(x, y=None, name=None):
@@ -225,11 +236,12 @@ def swiglu(x, y=None, name=None):
             a1, a2 = jnp.split(a, 2, axis=-1)
             return jax.nn.silu(a1) * a2
 
-        return unary(fn, x, "swiglu")
+        return unary(fn, x, "swiglu", attrs={})
     return run_op(lambda a, b: jax.nn.silu(a) * b,
-                  [as_tensor(x), as_tensor(y)], name="swiglu")
+                  [as_tensor(x), as_tensor(y)], name="swiglu", attrs={})
 
 
 def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
     return unary(lambda a: jnp.where(a > threshold, a, value), x,
-                 "thresholded_relu")
+                 "thresholded_relu",
+                 attrs={"threshold": threshold, "value": value})

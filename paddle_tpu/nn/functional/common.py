@@ -45,7 +45,8 @@ def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
             return jnp.where(keep, a / (1.0 - p), 0.0).astype(a.dtype)
         return jnp.where(keep, a, 0.0).astype(a.dtype)
 
-    return unary(fn, x, "dropout")
+    return unary(fn, x, "dropout",
+                 attrs={"p": p, "axis": axis, "mode": mode, "key": key})
 
 
 def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
@@ -95,7 +96,7 @@ def embedding(x, weight, padding_idx=None, sparse=False, name=None):
 
 def one_hot(x, num_classes, name=None):
     return unary(lambda a: jax.nn.one_hot(a, num_classes, dtype=jnp.float32),
-                 as_tensor(x), "one_hot")
+                 as_tensor(x), "one_hot", attrs={"num_classes": num_classes})
 
 
 def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):

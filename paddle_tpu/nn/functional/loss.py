@@ -119,7 +119,8 @@ def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
 
 def mse_loss(input, label, reduction="mean", name=None):
     return run_op(lambda a, b: _reduce((a - b) ** 2, reduction),
-                  [as_tensor(input), as_tensor(label)], name="mse_loss")
+                  [as_tensor(input), as_tensor(label)], name="mse_loss",
+                  attrs={"reduction": reduction})
 
 
 def l1_loss(input, label, reduction="mean", name=None):
@@ -153,7 +154,8 @@ def binary_cross_entropy(input, label, weight=None, reduction="mean",
             out = out * w[0]
         return _reduce(out, reduction)
 
-    return run_op(fn, ts, name="binary_cross_entropy")
+    return run_op(fn, ts, name="binary_cross_entropy",
+                  attrs={"reduction": reduction, "has_weight": has_w})
 
 
 def binary_cross_entropy_with_logits(logit, label, weight=None,
@@ -184,7 +186,9 @@ def binary_cross_entropy_with_logits(logit, label, weight=None,
             out = out * w
         return _reduce(out, reduction)
 
-    return run_op(fn, ts, name="bce_with_logits")
+    return run_op(fn, ts, name="bce_with_logits",
+                  attrs={"reduction": reduction, "has_weight": has_w,
+                         "has_pos_weight": has_pw})
 
 
 def kl_div(input, label, reduction="mean", log_target=False, name=None):

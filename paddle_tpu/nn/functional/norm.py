@@ -102,7 +102,9 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
             out = out + wb[i].astype(jnp.float32)
         return out.astype(a.dtype)
 
-    return run_op(fn, ts, name="layer_norm")
+    return run_op(fn, ts, name="layer_norm",
+                  attrs={"axes": axes, "epsilon": epsilon,
+                         "has_weight": has_w, "has_bias": has_b})
 
 
 def rms_norm(x, weight=None, bias=None, epsilon=1e-6, begin_norm_axis=-1,
@@ -131,7 +133,9 @@ def rms_norm(x, weight=None, bias=None, epsilon=1e-6, begin_norm_axis=-1,
             out = out + wb[i].astype(jnp.float32)
         return out.astype(a.dtype)
 
-    return run_op(fn, ts, name="rms_norm")
+    return run_op(fn, ts, name="rms_norm",
+                  attrs={"axes": axes, "epsilon": epsilon,
+                         "has_weight": has_w, "has_bias": has_b})
 
 
 def instance_norm(x, running_mean=None, running_var=None, weight=None,

@@ -38,12 +38,12 @@ def shape_arg(shape):
     return (int(shape),)
 
 
-def unary(fn, x, name):
-    return run_op(fn, [as_tensor(x)], name=name)
+def unary(fn, x, name, attrs=None):
+    return run_op(fn, [as_tensor(x)], name=name, attrs=attrs)
 
 
-def binary(fn, x, y, name):
-    return run_op(fn, [as_tensor(x), as_tensor(y)], name=name)
+def binary(fn, x, y, name, attrs=None):
+    return run_op(fn, [as_tensor(x), as_tensor(y)], name=name, attrs=attrs)
 
 
 __all__ = ["as_tensor", "unwrap", "axis_arg", "shape_arg", "unary", "binary",

@@ -55,7 +55,8 @@ def swapaxes(x, axis0, axis1, name=None):
 def concat(x, axis=0, name=None):
     ts = [as_tensor(t) for t in x]
     ax = int(unwrap(axis)) if isinstance(axis, Tensor) else int(axis)
-    return run_op(lambda *arrs: jnp.concatenate(arrs, axis=ax), ts, name="concat")
+    return run_op(lambda *arrs: jnp.concatenate(arrs, axis=ax), ts,
+                  name="concat", attrs={"axis": ax})
 
 
 def split(x, num_or_sections, axis=0, name=None):
@@ -93,7 +94,8 @@ def chunk(x, chunks, axis=0, name=None):
 
 def stack(x, axis=0, name=None):
     ts = [as_tensor(t) for t in x]
-    return run_op(lambda *arrs: jnp.stack(arrs, axis=axis), ts, name="stack")
+    return run_op(lambda *arrs: jnp.stack(arrs, axis=axis), ts,
+                  name="stack", attrs={"axis": axis})
 
 
 def unstack(x, axis=0, num=None, name=None):
@@ -118,14 +120,15 @@ def squeeze(x, axis=None, name=None):
         real_ax = tuple(i for i in ax if a.shape[i if i >= 0 else a.ndim + i] == 1)
         return jnp.squeeze(a, axis=real_ax) if real_ax else a
 
-    return unary(fn, x, "squeeze")
+    return unary(fn, x, "squeeze", attrs={"axis": ax})
 
 
 def unsqueeze(x, axis, name=None):
     ax = axis_arg(axis)
     if isinstance(ax, int):
         ax = (ax,)
-    return unary(lambda a: jnp.expand_dims(a, ax), x, "unsqueeze")
+    return unary(lambda a: jnp.expand_dims(a, ax), x, "unsqueeze",
+                 attrs={"axis": ax})
 
 
 def flatten(x, start_axis=0, stop_axis=-1, name=None):
@@ -138,7 +141,7 @@ def flatten(x, start_axis=0, stop_axis=-1, name=None):
         shp = a.shape[:s] + (-1,) + a.shape[e + 1:]
         return a.reshape(shp)
 
-    return unary(fn, x, "flatten")
+    return unary(fn, x, "flatten", attrs={"start": s, "stop": e})
 
 
 def flatten_(x, start_axis=0, stop_axis=-1, name=None):

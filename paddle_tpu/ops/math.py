@@ -33,8 +33,11 @@ __all__ = [
 
 
 def _u(fn, op_name):
+    # attrs={} marks these as attr-FREE by construction, which is what
+    # lets attr-free decomposition rules fire on them (attrs=None means
+    # "unknown closure attrs" and blocks decomposition)
     def op(x, name=None):
-        return unary(fn, x, op_name)
+        return unary(fn, x, op_name, attrs={})
 
     op.__name__ = op_name
     return op
@@ -113,7 +116,8 @@ lcm = _b(jnp.lcm, "lcm")
 def clip(x, min=None, max=None, name=None):
     mn = unwrap(min) if min is not None else None
     mx = unwrap(max) if max is not None else None
-    return unary(lambda a: jnp.clip(a, mn, mx), x, "clip")
+    return unary(lambda a: jnp.clip(a, mn, mx), x, "clip",
+                 attrs={"min": mn, "max": mx})
 
 
 def lerp(x, y, weight, name=None):
@@ -131,10 +135,12 @@ def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
 
 def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
     s, b = unwrap(scale), unwrap(bias)
+    sc_attrs = {"scale": s, "bias": b,
+                "bias_after_scale": bias_after_scale}
     if bias_after_scale:
-        out = unary(lambda a: a * s + b, x, "scale")
+        out = unary(lambda a: a * s + b, x, "scale", attrs=sc_attrs)
     else:
-        out = unary(lambda a: (a + b) * s, x, "scale")
+        out = unary(lambda a: (a + b) * s, x, "scale", attrs=sc_attrs)
     return out
 
 
@@ -161,7 +167,8 @@ def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
 def _red(fn, op_name, bool_out=False):
     def op(x, axis=None, keepdim=False, name=None):
         ax = axis_arg(axis)
-        return unary(lambda a: fn(a, axis=ax, keepdims=keepdim), x, op_name)
+        return unary(lambda a: fn(a, axis=ax, keepdims=keepdim), x, op_name,
+                     attrs={"axis": ax, "keepdim": keepdim})
 
     op.__name__ = op_name
     return op
@@ -189,13 +196,17 @@ def min(x, axis=None, keepdim=False, name=None):
 def std(x, axis=None, unbiased=True, keepdim=False, name=None):
     ddof = 1 if unbiased else 0
     return unary(lambda a: jnp.std(a, axis=axis_arg(axis), ddof=ddof,
-                                   keepdims=keepdim), x, "std")
+                                   keepdims=keepdim), x, "std",
+                 attrs={"axis": axis_arg(axis), "ddof": ddof,
+                        "keepdim": keepdim})
 
 
 def var(x, axis=None, unbiased=True, keepdim=False, name=None):
     ddof = 1 if unbiased else 0
     return unary(lambda a: jnp.var(a, axis=axis_arg(axis), ddof=ddof,
-                                   keepdims=keepdim), x, "var")
+                                   keepdims=keepdim), x, "var",
+                 attrs={"axis": axis_arg(axis), "ddof": ddof,
+                        "keepdim": keepdim})
 
 
 def median(x, axis=None, keepdim=False, mode="avg", name=None):

@@ -42,12 +42,16 @@ class OpNode:
       - raw array/scalar       literal constant
     """
 
-    def __init__(self, fn, parents, out_avals, name: str, single: bool):
+    def __init__(self, fn, parents, out_avals, name: str, single: bool,
+                 attrs: Optional[dict] = None):
         self.fn = fn
         self.parents = parents
         self.out_avals = out_avals
         self.name = name
         self.single = single
+        # declared attributes of this op instance (axis, epsilon, ...) —
+        # consumed by attr-aware decomposition rules (decomposition/)
+        self.attrs = attrs
 
 
 def is_symbolic(t) -> bool:
@@ -79,7 +83,7 @@ def make_symbolic(aval_or_node, out_index: int = 0,
     return t
 
 
-def record_op(fn, tensors, name: str):
+def record_op(fn, tensors, name: str, attrs: Optional[dict] = None):
     """Called from run_op when static capture is on and an input is
     symbolic: infer shapes with jax.eval_shape, return symbolic outputs."""
     parents: List[Any] = []
@@ -100,7 +104,7 @@ def record_op(fn, tensors, name: str):
     out = jax.eval_shape(fn, *avals_in)
     single = not isinstance(out, (tuple, list))
     outs = (out,) if single else tuple(out)
-    node = OpNode(fn, parents, list(outs), name, single)
+    node = OpNode(fn, parents, list(outs), name, single, attrs=attrs)
     wrapped = tuple(make_symbolic(node, i) for i in range(len(outs)))
     return wrapped[0] if single else wrapped
 
