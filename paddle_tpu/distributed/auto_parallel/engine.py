@@ -56,8 +56,85 @@ class Strategy:
         self.recompute = _SubConfig()
         self.sharding = _SubConfig(stage=1, degree=-1)
         self.gradient_merge = _SubConfig(k_steps=1, avg=True)
+        # pp_degree > 1 partitions the model into a StagedProgram and
+        # drives the schedule passes (FThenB / 1F1B / VPP / ZBH1);
+        # pp_degree <= 1 keeps accumulate_steps as gradient accumulation
         self.pipeline = _SubConfig(schedule_mode="1F1B",
-                                   accumulate_steps=1)
+                                   accumulate_steps=1, pp_degree=1,
+                                   vpp_degree=1)
+
+
+class _StagedTrainStep:
+    """Train step driven by a pipeline schedule pass over a StagedProgram
+    (the executor role of the reference's standalone_executor running a
+    job-list plan, fleet_executor_utils.py). Splits each batch into
+    micro-batches, runs the schedule for loss+grads, applies the
+    optimizer's pure functional update, and writes the new parameter
+    arrays back into both the StagedProgram and the source layers."""
+
+    def __init__(self, staged, sched, optimizer, micro: int):
+        self.staged = staged
+        self.sched = sched
+        self.optimizer = optimizer
+        self.micro = micro
+        self._sizes = [len(p) for p in staged.params]
+        flat = [a for stage in staged.params for a in stage]
+        self.opt_state = optimizer.init_state(flat)
+        self.last_jobs = None
+
+    def _split(self, arr, m):
+        import numpy as np
+
+        from ...core.tensor import Tensor
+
+        a = arr._data if isinstance(arr, Tensor) else np.asarray(arr)
+        n = a.shape[0]
+        if n % m:
+            raise ValueError(f"batch {n} not divisible by {m} micro-batches")
+        k = n // m
+        return [a[i * k:(i + 1) * k] for i in range(m)]
+
+    def __call__(self, *batch):
+        import jax
+
+        from ...core.tensor import Tensor
+
+        *inputs, labels = batch
+        if len(inputs) != 1:
+            raise ValueError(
+                "pipeline Engine expects (input, labels) batches")
+        micros_x = self._split(inputs[0], self.micro)
+        micros_y = self._split(labels, self.micro)
+        loss, grads, jobs = self.sched.apply(self.staged, micros_x,
+                                             micros_y)
+        self.last_jobs = jobs
+        flat_p = [a for stage in self.staged.params for a in stage]
+        flat_g = []
+        for s, g in enumerate(grads):
+            if g is None:
+                g = [jax.numpy.zeros_like(a)
+                     for a in self.staged.params[s]]
+            flat_g.extend(list(g))
+        new_p, self.opt_state = self.optimizer.update(
+            flat_p, flat_g, self.opt_state)
+        # write back: StagedProgram params + the source nn.Layer params
+        i = 0
+        seg_params = getattr(self.staged, "segment_params", None)
+        for s, n in enumerate(self._sizes):
+            stage_new = new_p[i:i + n]
+            if self.staged.devices is not None:
+                stage_new = [jax.device_put(a, self.staged.devices[s])
+                             for a in stage_new]
+            self.staged.params[s] = list(stage_new)
+            if seg_params is not None:
+                for p, a in zip(seg_params[s], stage_new):
+                    p._data = a
+            i += n
+        return Tensor(loss)
+
+    def sync_params_to_model(self):
+        """Parameters are written back every step; kept for TrainStep API
+        compatibility."""
 
 
 class Engine:
@@ -83,6 +160,13 @@ class Engine:
 
         return get_mesh()
 
+    @staticmethod
+    def _jax_mesh(mesh):
+        if mesh is None:
+            return None
+        return mesh.get_jax_mesh() if hasattr(mesh, "get_jax_mesh") \
+            else mesh
+
     def _apply_recompute_pass(self):
         """Recompute pass: models expose cfg.recompute (per-block
         jax.checkpoint in their forward); generic layers fall back
@@ -103,6 +187,14 @@ class Engine:
         st = self.strategy
         if st.recompute.enable:
             self._apply_recompute_pass()
+
+        if st.pipeline.enable and int(getattr(
+                st.pipeline, "pp_degree", 1)) > 1:
+            # static pipeline parallelism: partition -> schedule pass
+            # (reference: engine.py:655 _parallel_pir composing
+            # pipeline_scheduler_pass into the plan)
+            self._step = self._build_pipeline(sample_batch)
+            return self._step
 
         mesh = self._resolve_mesh()
         loss_layer = self.loss
@@ -128,11 +220,9 @@ class Engine:
         fsdp_axis = None
         if st.sharding.enable and int(st.sharding.stage) >= 2:
             # sharding pass stage>=2: ZeRO param sharding over dp
-            if mesh is not None:
-                jm = mesh.get_jax_mesh() if hasattr(mesh, "get_jax_mesh") \
-                    else mesh
-                if "dp" in jm.axis_names:
-                    fsdp_axis = "dp"
+            jm = self._jax_mesh(mesh)
+            if jm is not None and "dp" in jm.axis_names:
+                fsdp_axis = "dp"
 
         accumulate = 1
         if st.gradient_merge.enable:
@@ -145,8 +235,7 @@ class Engine:
         if mesh is not None:
             from jax.sharding import PartitionSpec as P
 
-            jm = mesh.get_jax_mesh() if hasattr(mesh, "get_jax_mesh") \
-                else mesh
+            jm = self._jax_mesh(mesh)
             dp = "dp" if "dp" in jm.axis_names else None
             batch_specs = [P(dp) for _ in sample_batch]
 
@@ -155,6 +244,59 @@ class Engine:
             batch_specs=batch_specs, fsdp_axis=fsdp_axis,
             accumulate_steps=accumulate)
         return self._step
+
+    def _build_pipeline(self, sample_batch):
+        """Partition the model into a StagedProgram and pick the schedule
+        pass (reference: pipeline_scheduler_pass/__init__.py
+        apply_pass dispatch on schedule_mode)."""
+        from ..passes.pipeline_partition import stage_program_from_layers
+        from ..passes.pipeline_scheduler_pass import (
+            Pipeline1F1BPass, PipelineFThenBPass, PipelineVPPPass,
+            PipelineZeroBubblePass)
+
+        st = self.strategy
+        pp = int(st.pipeline.pp_degree)
+        vpp = max(int(getattr(st.pipeline, "vpp_degree", 1)), 1)
+        mode = getattr(st.pipeline, "schedule_mode", "1F1B")
+        micro = max(int(st.pipeline.accumulate_steps), 1)
+
+        devices = None
+        jm = self._jax_mesh(self._resolve_mesh())
+        if jm is not None:
+            if "pp" in jm.axis_names:
+                axis = jm.axis_names.index("pp")
+                import numpy as _np
+
+                dev_grid = _np.asarray(jm.devices)
+                # one representative device per pp slice
+                sel = _np.moveaxis(dev_grid, axis, 0).reshape(
+                    jm.shape["pp"], -1)[:, 0]
+                if len(sel) >= pp:
+                    # virtual stage sv lives on physical sv % pp
+                    devices = [sel[s % pp] for s in range(pp * vpp)]
+
+        loss_layer = self.loss
+
+        def loss_fn(y, label):
+            if loss_layer is not None:
+                return loss_layer(y, label)
+            raise ValueError("Engine pipeline mode needs a loss layer")
+
+        staged = stage_program_from_layers(
+            self.model, pp * vpp, loss_fn, devices=devices)
+        if mode in ("ZBH1", "ZeroBubble"):
+            if vpp > 1:
+                raise ValueError(
+                    "zero-bubble + virtual pipeline is not implemented; "
+                    "use vpp_degree=1 with ZBH1 or schedule_mode='VPP'")
+            sched = PipelineZeroBubblePass()
+        elif mode == "FThenB":
+            sched = PipelineFThenBPass()
+        elif mode == "VPP" or vpp > 1:
+            sched = PipelineVPPPass(pp, vpp)
+        else:
+            sched = Pipeline1F1BPass()
+        return _StagedTrainStep(staged, sched, self.optimizer, micro)
 
     # -------------------------------------------------------------- fit
     def fit(self, train_data, epochs=1, batch_size=None,

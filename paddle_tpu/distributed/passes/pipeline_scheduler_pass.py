@@ -1,6 +1,6 @@
 """Static pipeline schedule passes (reference: python/paddle/distributed/
-passes/pipeline_scheduler_pass/{pipeline_fthenb,pipeline_1f1b}.py over
-pipeline_pass_base.py).
+passes/pipeline_scheduler_pass/{pipeline_fthenb,pipeline_1f1b,
+pipeline_vpp,pipeline_zero_bubble}.py over pipeline_pass_base.py).
 
 The reference pass reorders a stage-partitioned static program's jobs
 into an execution plan ("job list") the executor then runs. Here the
@@ -20,7 +20,8 @@ from typing import Callable, List, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 
-__all__ = ["StagedProgram", "PipelineFThenBPass", "Pipeline1F1BPass"]
+__all__ = ["StagedProgram", "PipelineFThenBPass", "Pipeline1F1BPass",
+           "PipelineVPPPass", "PipelineZeroBubblePass"]
 
 
 class StagedProgram:
@@ -31,14 +32,22 @@ class StagedProgram:
     loss_fn: ``loss_fn(y_last, label_mb) -> scalar`` (mean over the
              micro-batch; grads are averaged over micro-batches);
     devices: optional per-stage jax devices — stage params/compute pinned
-             there (the multi-chip placement the schedule models).
+             there (the multi-chip placement the schedule models);
+    last_takes_label: the final stage computes the loss itself as
+             ``stage_fn(params, x, label) -> scalar`` (used when the
+             program partitioner folds a parameterized loss tail into
+             the last stage so its params receive grads).
     """
 
     def __init__(self, stages: Sequence[Callable], params: Sequence,
-                 loss_fn: Callable, devices: Optional[Sequence] = None):
+                 loss_fn: Optional[Callable], devices: Optional[Sequence]
+                 = None, last_takes_label: bool = False):
         assert len(stages) == len(params)
         self.stages = list(stages)
         self.loss_fn = loss_fn
+        self.last_takes_label = last_takes_label
+        if not last_takes_label:
+            assert loss_fn is not None
         self.devices = list(devices) if devices is not None else None
         if self.devices is not None:
             assert len(self.devices) == len(self.stages)
@@ -56,6 +65,7 @@ class _PipelineSchedulePassBase:
     pipeline_pass_base.py _create_job_list)."""
 
     name = "pipeline_scheduler_base"
+    emits_w = False   # ZB-style passes split backward into B + W jobs
 
     def _job_list(self, n_stages: int, n_micro: int) \
             -> List[Tuple[str, int, int]]:
@@ -67,13 +77,14 @@ class _PipelineSchedulePassBase:
         S = program.num_stages
         M = len(micro_batches)
         jobs = self._job_list(S, M)
-        self._validate(jobs, S, M)
+        self._validate(jobs, S, M, with_w=self.emits_w)
 
         acts = {}       # (stage, mb) -> stage input
         vjps = {}       # (stage, mb) -> vjp closure
         outs = {}       # (stage, mb) -> stage output
         grads = [None] * S
         cots = {}       # (stage, mb) -> cotangent flowing into stage
+        pending_w = {}  # (stage, mb) -> deferred weight grads (ZB)
         losses = []
 
         def put(stage, x):
@@ -81,10 +92,23 @@ class _PipelineSchedulePassBase:
                 return jax.device_put(x, program.devices[stage])
             return x
 
+        def accum(s, g_param):
+            grads[s] = g_param if grads[s] is None else jax.tree.map(
+                jnp.add, grads[s], g_param)
+
         for kind, s, m in jobs:
             if kind == "F":
                 x = put(s, micro_batches[m] if s == 0 else outs[(s - 1, m)])
                 acts[(s, m)] = x
+                if s == S - 1 and program.last_takes_label:
+                    loss, vjp = jax.vjp(
+                        lambda pp, xx: program.stages[s](pp, xx,
+                                                         labels[m]),
+                        program.params[s], x)
+                    vjps[(s, m)] = vjp
+                    losses.append(loss)
+                    cots[(s, m)] = jnp.ones_like(loss) / M
+                    continue
                 y, vjp = jax.vjp(program.stages[s], program.params[s], x)
                 vjps[(s, m)] = vjp
                 outs[(s, m)] = y
@@ -94,33 +118,45 @@ class _PipelineSchedulePassBase:
                     losses.append(loss)
                     (cot,) = lvjp(jnp.ones_like(loss) / M)
                     cots[(s, m)] = cot
-            else:  # "B"
+            elif kind == "B":
                 cot = put(s, cots.pop((s, m)))
                 g_param, g_x = vjps.pop((s, m))(cot)
-                grads[s] = g_param if grads[s] is None else jax.tree.map(
-                    jnp.add, grads[s], g_param)
+                if self.emits_w:
+                    # ZB: the input grad ships upstream NOW; the weight
+                    # grad waits for this micro's W job (filling the
+                    # bubble), mirroring PipelineParallelZeroBubble
+                    pending_w[(s, m)] = g_param
+                else:
+                    accum(s, g_param)
                 if s > 0:
                     cots[(s - 1, m)] = g_x
                 # activations for this (stage, mb) are now dead — the
                 # point of 1F1B's early drains
                 acts.pop((s, m), None)
                 outs.pop((s, m), None)
+            else:  # "W": deferred weight-grad accumulation
+                accum(s, pending_w.pop((s, m)))
+        assert not pending_w, "W jobs missed pending weight grads"
         mean_loss = sum(losses) / M
         return mean_loss, grads, jobs
 
     @staticmethod
-    def _validate(jobs, S, M):
+    def _validate(jobs, S, M, with_w=False):
         seen = set()
         for kind, s, m in jobs:
             if kind == "F":
                 assert s == 0 or ("F", s - 1, m) in seen, \
                     f"F{s},{m} before its upstream forward"
-            else:
+            elif kind == "B":
                 assert ("F", s, m) in seen, f"B{s},{m} before F{s},{m}"
                 assert s == S - 1 or ("B", s + 1, m) in seen, \
                     f"B{s},{m} before its downstream backward"
+            else:
+                assert with_w, "W job from a non-ZB schedule"
+                assert ("B", s, m) in seen, f"W{s},{m} before B{s},{m}"
             seen.add((kind, s, m))
-        assert len(seen) == 2 * S * M, "schedule missed jobs"
+        kinds = 3 if with_w else 2
+        assert len(seen) == kinds * S * M, "schedule missed jobs"
 
 
 class PipelineFThenBPass(_PipelineSchedulePassBase):
@@ -145,7 +181,11 @@ class Pipeline1F1BPass(_PipelineSchedulePassBase):
 
     name = "pipeline_scheduler_1F1B"
 
-    def _job_list(self, S, M):
+    def _job_list(self, S, M):  # noqa: C901
+        return self._one_f_one_b(S, M)
+
+    @staticmethod
+    def _one_f_one_b(S, M):
         # simulate the classic per-stage 1F1B clock: at every tick each
         # stage runs its next job; ordering jobs by completion tick gives
         # a valid global order with the 1F1B interleaving property.
@@ -177,4 +217,115 @@ class Pipeline1F1BPass(_PipelineSchedulePassBase):
                         bwd_ready[s].add(m)
                     progressed = True
             assert progressed, "1F1B schedule deadlocked"
+        return jobs
+
+
+class PipelineVPPPass(_PipelineSchedulePassBase):
+    """Interleaved virtual pipeline (VPP, reference:
+    pipeline_scheduler_pass/pipeline_vpp.py; Megatron interleaved
+    schedule). The StagedProgram holds ``num_stages * num_virtual``
+    VIRTUAL stages; virtual stage ``sv`` lives on physical stage
+    ``sv % num_stages`` (the interleaved chunk assignment of
+    pp_layers.py _interleave). Each physical rank runs the Megatron
+    per-rank order (deep warmup covering every chunk, then 1F1B, then
+    drain); the global job list is their dependency-respecting merge.
+    """
+
+    name = "pipeline_scheduler_VPP"
+
+    def __init__(self, num_stages: int, num_virtual: int):
+        self.num_stages = int(num_stages)
+        self.num_virtual = int(num_virtual)
+
+    def _job_list(self, S, M):
+        P, v = self.num_stages, self.num_virtual
+        assert S == P * v, \
+            f"StagedProgram has {S} virtual stages, want {P}*{v}"
+        assert M % P == 0, "VPP needs micro-batches divisible by pp degree"
+
+        def fwd_seq(rank):
+            # i-th forward this rank runs: cycle chunks in groups of P
+            # micro-batches (Megatron get_model_chunk_id)
+            seq = []
+            for i in range(M * v):
+                group, within = divmod(i, P * v)
+                chunk, pos = divmod(within, P)
+                seq.append((chunk * P + rank, group * P + pos))
+            return seq
+
+        def bwd_seq(rank):
+            seq = []
+            for i in range(M * v):
+                group, within = divmod(i, P * v)
+                chunk, pos = divmod(within, P)
+                seq.append(((v - 1 - chunk) * P + rank, group * P + pos))
+            return seq
+
+        local = []
+        for r in range(P):
+            warmup = min((P - r - 1) * 2 + (v - 1) * P, M * v)
+            f, b = fwd_seq(r), bwd_seq(r)
+            seq = [("F",) + f[i] for i in range(warmup)]
+            fi, bi = warmup, 0
+            while fi < len(f):
+                seq.append(("F",) + f[fi])
+                fi += 1
+                seq.append(("B",) + b[bi])
+                bi += 1
+            while bi < len(b):
+                seq.append(("B",) + b[bi])
+                bi += 1
+            local.append(seq)
+
+        # dependency-respecting merge of the per-rank orders
+        jobs, issued = [], set()
+        ptr = [0] * P
+        while any(ptr[r] < len(local[r]) for r in range(P)):
+            progressed = False
+            for r in range(P):
+                while ptr[r] < len(local[r]):
+                    kind, sv, m = local[r][ptr[r]]
+                    if kind == "F":
+                        ready = sv == 0 or ("F", sv - 1, m) in issued
+                    else:
+                        ready = ("F", sv, m) in issued and (
+                            sv == S - 1 or ("B", sv + 1, m) in issued)
+                    if not ready:
+                        break
+                    jobs.append((kind, sv, m))
+                    issued.add((kind, sv, m))
+                    ptr[r] += 1
+                    progressed = True
+            assert progressed, "VPP merge deadlocked"
+        return jobs
+
+
+class PipelineZeroBubblePass(Pipeline1F1BPass):
+    """ZB-H1 (reference: pipeline_scheduler_pass/pipeline_zero_bubble.py:62).
+    The 1F1B order, with each micro's weight-grad accumulation split out
+    as a W job deferred into the cooldown bubble — identical job-order
+    policy to the dygraph PipelineParallelZeroBubble (W fires once a
+    stage is more than ``S - stage`` backwards ahead of its W count,
+    remaining W fill the drain)."""
+
+    name = "pipeline_scheduler_ZBH1"
+    emits_w = True
+
+    def _job_list(self, S, M):
+        base = self._one_f_one_b(S, M)
+        jobs = []
+        done_b = [0] * S
+        done_w = [0] * S
+        for j in base:
+            jobs.append(j)
+            if j[0] == "B":
+                s = j[1]
+                done_b[s] += 1
+                while done_b[s] - done_w[s] > S - s:
+                    jobs.append(("W", s, done_w[s]))
+                    done_w[s] += 1
+        for s in range(S):
+            while done_w[s] < M:
+                jobs.append(("W", s, done_w[s]))
+                done_w[s] += 1
         return jobs

@@ -136,8 +136,10 @@ class _PathProgram:
 
     def __init__(self, guards, replay_fn, feed_names, params,
                  out_treedef, n_outs, n_subgraphs):
-        self.guards = guards          # [(sym_node, capture-time value)]
-        self.n_guards = len(guards)
+        # capture-time guard VALUES only (nodes stay alive inside
+        # replay_fn's closure anyway; keeping them here too is waste)
+        self.guards = [v for _, v in guards]
+        self.n_guards = len(self.guards)
         self.expected: List[np.ndarray] = []  # set on first replay run
         self.replay_fn = replay_fn
         self.feed_names = feed_names
