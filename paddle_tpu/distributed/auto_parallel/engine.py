@@ -192,7 +192,19 @@ class Engine:
                 st.pipeline, "pp_degree", 1)) > 1:
             # static pipeline parallelism: partition -> schedule pass
             # (reference: engine.py:655 _parallel_pir composing
-            # pipeline_scheduler_pass into the plan)
+            # pipeline_scheduler_pass into the plan). The staged path
+            # doesn't compose with the trace-level passes yet — refuse
+            # loudly rather than silently dropping an enabled pass.
+            dropped = [name for name, c in
+                       [("amp", st.amp), ("sharding", st.sharding),
+                        ("gradient_merge", st.gradient_merge)]
+                       if c.enable]
+            if dropped:
+                raise ValueError(
+                    f"strategy.pipeline with pp_degree>1 does not yet "
+                    f"compose with enabled pass(es) {dropped}; disable "
+                    "them or use pipeline.accumulate_steps without "
+                    "pp_degree (gradient accumulation path)")
             self._step = self._build_pipeline(sample_batch)
             return self._step
 

@@ -16,8 +16,15 @@ compute a TaskNode runs is a jitted callable (the per-stage XLA program)
 instead of a sub-Program, so the heavy work still happens in single XLA
 dispatches; the actor layer contributes exactly what the reference's
 does — dataflow sequencing and backpressure for multi-stage streaming
-inference/training on one host. Cross-rank delivery plugs into the rpc
-agent (distributed/rpc.py) when a group is initialized.
+inference/training on one host.
+
+Cross-rank delivery (r5): when ``init_rpc`` has run, a TaskNode whose
+``rank`` differs from the executor's rank is hosted remotely —
+``MessageBus.send`` routes DATA_IS_READY / DATA_IS_USELESS / STOP for
+non-local tasks through the rpc agent (distributed/rpc.py), the analog
+of the reference's brpc MessageBus (fleet_executor/message_bus.h).
+Credit backpressure crosses ranks the same way: the downstream rank's
+DATA_IS_USELESS rides rpc back to the upstream rank's interceptor.
 """
 from __future__ import annotations
 
@@ -27,6 +34,30 @@ from typing import Any, Callable, Dict, List, Optional
 
 __all__ = ["TaskNode", "Interceptor", "Carrier", "MessageBus",
            "FleetExecutor"]
+
+# executor_id -> live MessageBus on THIS process (rpc delivery target);
+# messages landing before the bus exists buffer in _PENDING
+_ACTIVE_BUSES: Dict[str, "MessageBus"] = {}
+_PENDING: Dict[str, List["_Msg"]] = {}
+_REGISTRY_LOCK = threading.Lock()
+
+
+def _remote_deliver(executor_id: str, kind: str, src: int, dst: int,
+                    payload, step: int):
+    """rpc entry point on the receiving rank (reference: message_bus.cc
+    DispatchMsgToCarrier)."""
+    import numpy as np
+
+    if payload is not None and not isinstance(payload, (int, float)):
+        payload = np.asarray(payload)
+    msg = _Msg(kind, src, dst, payload, step)
+    with _REGISTRY_LOCK:
+        bus = _ACTIVE_BUSES.get(executor_id)
+        if bus is None or dst not in bus._boxes:
+            _PENDING.setdefault(executor_id, []).append(msg)
+            return True
+    bus._boxes[dst].put(msg)
+    return True
 
 
 class _Msg:
@@ -65,23 +96,80 @@ class TaskNode:
 
 
 class MessageBus:
-    """In-process message router (reference message_bus.h). Cross-rank
-    messages ride the rpc agent when one is initialized."""
+    """Message router (reference message_bus.h): in-process queues for
+    local interceptors, the rpc agent for tasks hosted on other ranks."""
 
-    def __init__(self):
+    def __init__(self, rank: int = 0, executor_id: str = "default",
+                 task_ranks: Optional[Dict[int, int]] = None):
+        self.rank = rank
+        self.executor_id = executor_id
+        self.task_ranks = task_ranks or {}
         self._boxes: Dict[int, "queue.Queue[_Msg]"] = {}
+        with _REGISTRY_LOCK:
+            _ACTIVE_BUSES[executor_id] = self
 
     def register(self, task_id: int) -> "queue.Queue[_Msg]":
         q = queue.Queue()
-        self._boxes[task_id] = q
+        # drain any rpc deliveries that raced ahead of this executor's
+        # construction (the peer rank may start streaming immediately);
+        # box insertion and backlog drain share the registry lock with
+        # _remote_deliver so no message can fall between them
+        with _REGISTRY_LOCK:
+            backlog = _PENDING.get(self.executor_id, [])
+            still = []
+            for m in backlog:
+                if m.dst == task_id:
+                    q.put(m)
+                else:
+                    still.append(m)
+            if still:
+                _PENDING[self.executor_id] = still
+            else:
+                _PENDING.pop(self.executor_id, None)
+            self._boxes[task_id] = q
         return q
+
+    def close(self):
+        """Unregister from the delivery registry (released executors must
+        not silently swallow late rpc messages)."""
+        with _REGISTRY_LOCK:
+            if _ACTIVE_BUSES.get(self.executor_id) is self:
+                _ACTIVE_BUSES.pop(self.executor_id, None)
 
     def send(self, msg: _Msg):
         box = self._boxes.get(msg.dst)
-        if box is None:
+        if box is not None:
+            box.put(msg)
+            return
+        dst_rank = self.task_ranks.get(msg.dst)
+        if dst_rank is None or dst_rank == self.rank:
             raise KeyError(f"no interceptor registered for task "
                            f"{msg.dst}")
-        box.put(msg)
+        # cross-rank: ship through the rpc agent (brpc analog); payload
+        # travels as numpy, fire-and-forget like the reference's
+        # async brpc Send
+        import numpy as np
+
+        from . import rpc as _rpc
+
+        agent = _rpc._agent
+        if agent is None:
+            if msg.kind == _Msg.STOP:
+                return  # teardown after rpc shutdown: best-effort only
+            raise RuntimeError(
+                f"task {msg.dst} lives on rank {dst_rank} but rpc is not "
+                "initialized — call paddle.distributed.rpc.init_rpc")
+        by_rank = getattr(self, "_by_rank", None)
+        if by_rank is None or self._by_rank_agent is not agent:
+            by_rank = {w.rank: w.name for w in agent.workers.values()}
+            self._by_rank = by_rank
+            self._by_rank_agent = agent
+        payload = msg.payload
+        if payload is not None and not isinstance(payload, (int, float)):
+            payload = np.asarray(payload)
+        _rpc.rpc_async(by_rank[dst_rank], _remote_deliver,
+                       args=(self.executor_id, msg.kind, msg.src,
+                             msg.dst, payload, msg.step))
 
 
 class Interceptor(threading.Thread):
@@ -108,9 +196,14 @@ class Interceptor(threading.Thread):
         while not self._stop:
             msg = self.box.get()
             if msg.kind == _Msg.STOP:
-                # propagate to downstream actors once per edge
+                # propagate to downstream actors once per edge;
+                # best-effort — a peer rank may already be torn down
                 for d in self.node.downstream:
-                    self.bus.send(_Msg(_Msg.STOP, self.node.task_id, d))
+                    try:
+                        self.bus.send(_Msg(_Msg.STOP, self.node.task_id,
+                                           d))
+                    except Exception:
+                        pass
                 return
             if msg.kind == _Msg.DATA_IS_USELESS:
                 self._credits[msg.src] += 1
@@ -146,9 +239,10 @@ class Interceptor(threading.Thread):
 class Carrier:
     """Hosts this rank's interceptors (reference carrier.h:50)."""
 
-    def __init__(self, rank: int = 0):
+    def __init__(self, rank: int = 0, executor_id: str = "default",
+                 task_ranks: Optional[Dict[int, int]] = None):
         self.rank = rank
-        self.bus = MessageBus()
+        self.bus = MessageBus(rank, executor_id, task_ranks)
         self.interceptors: Dict[int, Interceptor] = {}
         self.results: list = []
 
@@ -175,40 +269,57 @@ class Carrier:
     def release(self):
         for ic in self.interceptors.values():
             ic.stop()
+        self.bus.close()
 
 
 class FleetExecutor:
     """reference fleet_executor.h:36 — build the task graph, run N
     micro-batches through the actor pipeline, collect sink outputs."""
 
-    def __init__(self, task_nodes: List[TaskNode], rank: int = 0):
+    def __init__(self, task_nodes: List[TaskNode], rank: int = 0,
+                 executor_id: str = "default"):
         self.nodes = {n.task_id: n for n in task_nodes}
-        self.carrier = Carrier(rank)
+        self.rank = rank
+        task_ranks = {n.task_id: n.rank for n in task_nodes}
+        self.carrier = Carrier(rank, executor_id, task_ranks)
         # wire upstream lists from downstream declarations
         for n in task_nodes:
             for d in n.downstream:
                 if n.task_id not in self.nodes[d].upstream:
                     self.nodes[d].upstream.append(n.task_id)
+        # host only THIS rank's interceptors; other ranks run their own
+        # FleetExecutor over the same graph (reference: each rank's
+        # Carrier holds its TaskNodes, the bus crosses ranks)
         for n in task_nodes:
-            self.carrier.create_interceptor(n)
-        self._sources = [n for n in task_nodes if not n.upstream]
-        self._sinks = [n for n in task_nodes if not n.downstream]
+            if n.rank == rank:
+                self.carrier.create_interceptor(n)
+        self._sources = [n for n in task_nodes
+                         if not n.upstream and n.rank == rank]
+        self._sinks = [n for n in task_nodes
+                       if not n.downstream and n.rank == rank]
         self._started = False
 
-    def run(self, feeds: List[Any], timeout: float = 60.0) -> List[Any]:
+    def run(self, feeds: List[Any], timeout: float = 60.0,
+            n_results: Optional[int] = None) -> List[Any]:
         """Stream ``feeds`` (one per micro-batch) through the graph;
-        returns sink outputs in micro-batch order."""
+        returns LOCAL sink outputs in micro-batch order (a rank hosting
+        no sink returns [] immediately — its interceptors keep serving
+        the pipeline in the background)."""
         if not self._started:
             self.carrier.start()
             self._started = True
         self.carrier.results.clear()
-        src = self._sources[0]
         # feed with backpressure honoring the source's declared depth
-        for step, payload in enumerate(feeds):
-            self.carrier.bus.send(
-                _Msg(_Msg.DATA_IS_READY, -1, src.task_id, payload, step))
+        if self._sources:
+            src = self._sources[0]
+            for step, payload in enumerate(feeds):
+                self.carrier.bus.send(
+                    _Msg(_Msg.DATA_IS_READY, -1, src.task_id, payload,
+                         step))
         # -1 credits: the source treats feeder credit as infinite
-        self.carrier.wait(len(feeds) * len(self._sinks), timeout)
+        if n_results is None:
+            n_results = len(feeds) * len(self._sinks)
+        self.carrier.wait(n_results, timeout)
         # key on (step, sink id) — deterministic across thread schedules,
         # and payloads (jax arrays) never enter the comparison
         out = sorted(self.carrier.results, key=lambda r: (r[0], r[1]))

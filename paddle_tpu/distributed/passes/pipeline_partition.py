@@ -177,49 +177,49 @@ def partition_program(loss_fetch, input_name: str, label_name: str,
     order = _topo_order(node0)
     pos = {id(n): i for i, n in enumerate(order)}
 
-    # consumers count per node & which prefix nodes a suffix references
-    consumers = {}
-    for n in order:
+    # feeds: only input_name/label_name are representable — stage fns
+    # have a (params, x[, label]) signature, so any other feed would
+    # KeyError at schedule time; reject it here with a clear message
+    feed_names = set()
+    feed_use = {}      # name -> (first consumer pos, last consumer pos)
+    max_cons = {}      # producer id -> last consumer position
+    for i, n in enumerate(order):
         for p in n.parents:
             if isinstance(p, tuple):
-                consumers.setdefault(id(p[0]), []).append(n)
+                prev = max_cons.get(id(p[0]), -1)
+                max_cons[id(p[0])] = max(prev, i)
+            elif isinstance(p, _g.FeedLeaf):
+                feed_names.add(p.name)
+                lo, hi = feed_use.get(p.name, (i, i))
+                feed_use[p.name] = (min(lo, i), max(hi, i))
+    extra = feed_names - {input_name, label_name}
+    if extra:
+        raise ValueError(
+            f"partition_program supports exactly two feeds "
+            f"({input_name!r}, {label_name!r}); program also feeds "
+            f"{sorted(extra)}")
+    last_input_use = feed_use.get(input_name, (0, -1))[1]
+    first_label_use = feed_use.get(label_name, (len(order), len(order)))[0]
 
-    def crossing(i):
-        """Values produced at positions <= i consumed at positions > i."""
-        crossed = set()
-        for j in range(i + 1):
-            n = order[j]
-            for c in consumers.get(id(n), []):
-                if pos[id(c)] > i:
-                    crossed.add(id(n))
-        return crossed
-
-    # label feed positions: nodes (transitively) fed by label_name only
-    # matter for validation of the final segment
-    def feeds_of(n):
-        out = set()
-        for p in n.parents:
-            if isinstance(p, _g.FeedLeaf):
-                out.add(p.name)
-        return out
-
+    # single forward sweep: 'open' producers whose value is still needed
+    # past position i; a valid cut at i is open == {order[i]} (O(n+e))
+    by_close = {}
+    for nid, last in max_cons.items():
+        by_close.setdefault(last, []).append(nid)
+    open_ids = set()
     cut_positions = []
     for i, n in enumerate(order[:-1]):
-        if not n.single:
+        for nid in by_close.get(i, ()):   # fully consumed AT i
+            open_ids.discard(nid)
+        if max_cons.get(id(n), -1) > i:
+            open_ids.add(id(n))
+        if not n.single or open_ids != {id(n)}:
             continue
-        cr = crossing(i)
-        if cr == {id(n)}:
-            # label must not be consumed before the cut (it belongs to
-            # the loss tail), input not after (it belongs to stage 0)
-            pre_feeds = set()
-            for j in range(i + 1):
-                pre_feeds |= feeds_of(order[j])
-            post_feeds = set()
-            for j in range(i + 1, len(order)):
-                post_feeds |= feeds_of(order[j])
-            if label_name in pre_feeds or input_name in post_feeds:
-                continue
-            cut_positions.append(i)
+        # label must not be consumed before the cut (it belongs to the
+        # loss tail), input not after (it belongs to stage 0)
+        if i < last_input_use or i >= first_label_use:
+            continue
+        cut_positions.append(i)
     if len(cut_positions) < n_stages - 1:
         raise ValueError(
             f"program has {len(cut_positions)} articulation points; "

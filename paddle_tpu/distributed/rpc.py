@@ -274,7 +274,14 @@ def shutdown(graceful: bool = True):
     global _agent
     if _agent is not None:
         if graceful:
-            _agent.store.barrier(f"{_agent._ns}_shutdown",
-                                 _agent.world_size, _agent.rank)
+            # POLLING barrier, not store.barrier: the blocking wait()
+            # would hold the store client's mutex until every rank
+            # arrives, starving this agent's own dispatcher threads —
+            # a peer still streaming rpc work through us (e.g. a
+            # FleetExecutor pipeline draining) would deadlock the job
+            key = f"{_agent._ns}_shutdown/count"
+            _agent.store.add(key, 1)
+            while _agent.store.add(key, 0) < _agent.world_size:
+                time.sleep(0.02)
         _agent.stop()
         _agent = None
