@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import warnings
 from typing import Any, Callable, Dict, List, Optional
 
 __all__ = ["TaskNode", "Interceptor", "Carrier", "MessageBus",
@@ -106,6 +107,15 @@ class MessageBus:
         self.task_ranks = task_ranks or {}
         self._boxes: Dict[int, "queue.Queue[_Msg]"] = {}
         with _REGISTRY_LOCK:
+            live = _ACTIVE_BUSES.get(executor_id)
+            if live is not None:
+                # a silent replacement would steal the live executor's
+                # in-flight rpc traffic — fail loudly instead (release
+                # the previous FleetExecutor, or pick a distinct id)
+                raise RuntimeError(
+                    f"MessageBus executor_id {executor_id!r} is already "
+                    "active on this process; release() the previous "
+                    "FleetExecutor or use a unique executor_id per run")
             _ACTIVE_BUSES[executor_id] = self
 
     def register(self, task_id: int) -> "queue.Queue[_Msg]":
@@ -131,10 +141,24 @@ class MessageBus:
 
     def close(self):
         """Unregister from the delivery registry (released executors must
-        not silently swallow late rpc messages)."""
+        not silently swallow late rpc messages). Pending messages for
+        this executor id are dropped: they belong to THIS generation's
+        run, and leaving them would replay stale traffic into a future
+        executor reusing the id.
+
+        Contract for REUSING an executor_id across runs: cross-rank
+        traffic still in flight at close() time can land after it and
+        buffer for the next generation (messages carry no generation
+        tag, matching the reference brpc bus). Callers must call
+        rpc.shutdown() between runs before re-creating an executor under
+        the same id — it both barriers the ranks AND kills the rpc
+        dispatchers, so no queued fire-and-forget delivery can replay
+        into the next generation (a plain store barrier would not drain
+        those). The in-tree tests do exactly this."""
         with _REGISTRY_LOCK:
             if _ACTIVE_BUSES.get(self.executor_id) is self:
                 _ACTIVE_BUSES.pop(self.executor_id, None)
+                _PENDING.pop(self.executor_id, None)
 
     def send(self, msg: _Msg):
         box = self._boxes.get(msg.dst)
@@ -206,8 +230,17 @@ class Interceptor(threading.Thread):
                         pass
                 return
             if msg.kind == _Msg.DATA_IS_USELESS:
-                self._credits[msg.src] += 1
+                if msg.src in self._credits:
+                    self._credits[msg.src] += 1
             elif msg.kind == _Msg.DATA_IS_READY:
+                if msg.src not in ready:
+                    # stale/misrouted traffic must not kill the actor
+                    # thread (the pipeline would hang instead of erroring
+                    # at the timeout with a diagnosable state)
+                    warnings.warn(
+                        f"interceptor {self.node.task_id}: dropping "
+                        f"message from unknown upstream {msg.src}")
+                    continue
                 ready[msg.src].append(msg)
             # fire when every upstream has a ready item and every
             # downstream has a credit slot
@@ -280,13 +313,19 @@ class FleetExecutor:
                  executor_id: str = "default"):
         self.nodes = {n.task_id: n for n in task_nodes}
         self.rank = rank
-        task_ranks = {n.task_id: n.rank for n in task_nodes}
-        self.carrier = Carrier(rank, executor_id, task_ranks)
-        # wire upstream lists from downstream declarations
+        # validate + wire upstream lists BEFORE registering the message
+        # bus: a constructor failure after registration would leak the
+        # executor_id (release() is unreachable on a half-built object)
         for n in task_nodes:
             for d in n.downstream:
+                if d not in self.nodes:
+                    raise KeyError(
+                        f"task {n.task_id} declares downstream {d} "
+                        "which is not in the task graph")
                 if n.task_id not in self.nodes[d].upstream:
                     self.nodes[d].upstream.append(n.task_id)
+        task_ranks = {n.task_id: n.rank for n in task_nodes}
+        self.carrier = Carrier(rank, executor_id, task_ranks)
         # host only THIS rank's interceptors; other ranks run their own
         # FleetExecutor over the same graph (reference: each rank's
         # Carrier holds its TaskNodes, the bus crosses ranks)

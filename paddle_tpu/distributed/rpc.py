@@ -270,7 +270,7 @@ def get_all_worker_infos():
     return list(_require_agent().workers.values())
 
 
-def shutdown(graceful: bool = True):
+def shutdown(graceful: bool = True, timeout: float = 120.0):
     global _agent
     if _agent is not None:
         if graceful:
@@ -278,10 +278,21 @@ def shutdown(graceful: bool = True):
             # would hold the store client's mutex until every rank
             # arrives, starving this agent's own dispatcher threads —
             # a peer still streaming rpc work through us (e.g. a
-            # FleetExecutor pipeline draining) would deadlock the job
+            # FleetExecutor pipeline draining) would deadlock the job.
+            # Bounded: a crashed peer must fail the barrier loudly, not
+            # hang every surviving rank forever.
             key = f"{_agent._ns}_shutdown/count"
+            world = _agent.world_size
             _agent.store.add(key, 1)
-            while _agent.store.add(key, 0) < _agent.world_size:
+            deadline = time.monotonic() + timeout
+            while _agent.store.add(key, 0) < world:
+                if time.monotonic() > deadline:
+                    _agent.stop()
+                    _agent = None
+                    raise TimeoutError(
+                        f"rpc.shutdown barrier: not all {world} ranks "
+                        f"arrived within {timeout}s (a peer likely "
+                        "crashed)")
                 time.sleep(0.02)
         _agent.stop()
         _agent = None

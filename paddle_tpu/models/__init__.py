@@ -36,3 +36,8 @@ from .bert import (  # noqa: F401
     bert_large,
     bert_tiny,
 )
+from .generation import (  # noqa: F401
+    beam_search,
+    generate,
+    speculative_generate,
+)

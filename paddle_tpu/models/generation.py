@@ -28,8 +28,8 @@ import numpy as np
 from ..core import random as _rng
 from ..core.tensor import Tensor
 
-__all__ = ["generate", "beam_search", "GPTDecodeAdapter",
-           "LlamaDecodeAdapter"]
+__all__ = ["generate", "beam_search", "speculative_generate",
+           "GPTDecodeAdapter", "LlamaDecodeAdapter"]
 
 
 def _ln(x, w, b, eps):
@@ -70,6 +70,19 @@ def _quantize_w(w):
 _QUANT_SKIP = {"wte", "wpe"}  # embedding gathers stay full precision
 
 
+def _quantized_weights(model, w_now):
+    """Per-model cached int8 weight tree (shared by generate /
+    speculative target / speculative draft). Re-quantize after a weight
+    update by clearing ``model._gen_quant_w``."""
+    qw = getattr(model, "_gen_quant_w", None)
+    if qw is None:
+        if w_now.get("lm_head") is None:
+            w_now = dict(w_now)
+            w_now["lm_head"] = w_now["wte"].T
+        qw = model._gen_quant_w = _quantize_tree(w_now)
+    return qw
+
+
 def _quantize_tree(w, min_dim=256):
     """Walk an adapter weight pytree, replacing big 2D matmul weights with
     int8 quant dicts (reference analog: weight_only_linear /
@@ -90,6 +103,67 @@ def _quantize_tree(w, min_dim=256):
     if isinstance(w, list):
         return [_quantize_tree(v, min_dim) for v in w]
     return w
+
+
+def _quantize_kv(k):
+    """Per-(position, head) symmetric int8 for a [..., nh, hd] K or V
+    slab (reference analog: the cache_k_quant_scales /
+    cache_v_quant_scales surface of
+    python/paddle/incubate/nn/functional/masked_multihead_attention.py —
+    there the scales are host-computed calibration inputs; here they are
+    computed on the fly per written row, which is exact for the
+    read side because each row's scale rides with it)."""
+    s = jnp.max(jnp.abs(k.astype(jnp.float32)), axis=-1) / 127.0
+    s = jnp.maximum(s, 1e-8)                        # [..., nh]
+    q = jnp.clip(jnp.round(k.astype(jnp.float32) / s[..., None]),
+                 -127, 127).astype(jnp.int8)
+    return {"q8": q, "s": s}
+
+
+def _kv_prefill_store(k, b, total, plen, dt, quant):
+    """Build a [b, total, nh, hd] cache holding the prefill rows."""
+    nh, hd = k.shape[-2], k.shape[-1]
+    if not quant:
+        return jnp.zeros((b, total, nh, hd), dt).at[:, :plen].set(k)
+    qk = _quantize_kv(k)
+    return {"q8": jnp.zeros((b, total, nh, hd), jnp.int8)
+            .at[:, :plen].set(qk["q8"]),
+            "s": jnp.zeros((b, total, nh), jnp.float32)
+            .at[:, :plen].set(qk["s"])}
+
+
+def _kv_write(cache, k, pos):
+    """Write one decode row k [b, nh, hd] at position pos."""
+    if not isinstance(cache, dict):
+        return jax.lax.dynamic_update_slice(cache, k[:, None],
+                                            (0, pos, 0, 0))
+    qk = _quantize_kv(k)
+    return {"q8": jax.lax.dynamic_update_slice(
+                cache["q8"], qk["q8"][:, None], (0, pos, 0, 0)),
+            "s": jax.lax.dynamic_update_slice(
+                cache["s"], qk["s"][:, None], (0, pos, 0))}
+
+
+def _kv_write_rows(cache, k, pos):
+    """Write g rows k [b, g, nh, hd] at per-row positions pos [b, g]
+    (speculative verify writes land at different offsets per sequence).
+    Out-of-window positions (finished rows still looping) are dropped."""
+    bidx = jnp.arange(k.shape[0])[:, None]
+    if not isinstance(cache, dict):
+        return cache.at[bidx, pos].set(k.astype(cache.dtype), mode="drop")
+    qk = _quantize_kv(k)
+    return {"q8": cache["q8"].at[bidx, pos].set(qk["q8"], mode="drop"),
+            "s": cache["s"].at[bidx, pos].set(qk["s"], mode="drop")}
+
+
+def _kv_repeat(cache, rep):
+    """GQA head replication for either cache representation."""
+    if rep <= 1:
+        return cache
+    if not isinstance(cache, dict):
+        return jnp.repeat(cache, rep, axis=2)
+    return {"q8": jnp.repeat(cache["q8"], rep, axis=2),
+            "s": jnp.repeat(cache["s"], rep, axis=2)}
 
 
 def _rope(x, pos, base):
@@ -162,7 +236,7 @@ class GPTDecodeAdapter(DecodeAdapter):
             return x @ w["wte"].T
         return _linear(x, head)
 
-    def prefill(self, w, ids, total):
+    def prefill(self, w, ids, total, kv_quant=False):
         b, plen = ids.shape
         nh, hd, dt = self.num_heads, self.head_dim, self.dtype
         pos_ids = jnp.arange(plen)[None, :]
@@ -174,8 +248,8 @@ class GPTDecodeAdapter(DecodeAdapter):
             qkv = _linear(h1, W["qkv_w"], W["qkv_b"]) \
                 .reshape(b, plen, 3, nh, hd)
             q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-            ck = jnp.zeros((b, total, nh, hd), dt).at[:, :plen].set(k)
-            cv = jnp.zeros((b, total, nh, hd), dt).at[:, :plen].set(v)
+            ck = _kv_prefill_store(k, b, total, plen, dt, kv_quant)
+            cv = _kv_prefill_store(v, b, total, plen, dt, kv_quant)
             att = _causal_prefill_attn(q, k, v, causal, hd, dt)
             x = x + _linear(att, W["out_w"], W["out_b"])
             h2 = _ln(x, W["ln2_w"], W["ln2_b"], self.eps)
@@ -195,12 +269,38 @@ class GPTDecodeAdapter(DecodeAdapter):
             h1 = _ln(x, W["ln1_w"], W["ln1_b"], self.eps)
             qkv = _linear(h1, W["qkv_w"], W["qkv_b"]).reshape(b, 3, nh, hd)
             q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
-            cki = jax.lax.dynamic_update_slice(ck[i], k[:, None],
-                                               (0, pos, 0, 0))
-            cvi = jax.lax.dynamic_update_slice(cv[i], v[:, None],
-                                               (0, pos, 0, 0))
+            cki = _kv_write(ck[i], k, pos)
+            cvi = _kv_write(cv[i], v, pos)
             att = _masked_sdpa(q, cki, cvi, t_mask, hd)
             x = x + _linear(att.reshape(b, nh * hd),
+                            W["out_w"], W["out_b"])
+            h2 = _ln(x, W["ln2_w"], W["ln2_b"], self.eps)
+            m = jax.nn.gelu(_linear(h2, W["fc1_w"], W["fc1_b"]),
+                            approximate=True)
+            x = x + _linear(m, W["fc2_w"], W["fc2_b"])
+            new_ck.append(cki)
+            new_cv.append(cvi)
+        return self.logits(w, x), tuple(new_ck), tuple(new_cv)
+
+    def chunk_step(self, w, toks, pos, ck, cv):
+        """g tokens at per-row positions in one pass (speculative-decode
+        draft/verify; the draft_model surface of the reference's
+        fused_speculate_* serving ops). toks, pos [b, g]; returns
+        logits [b, g, V] where slot j reflects the prefix through
+        toks[:, j]."""
+        nh, hd, dt = self.num_heads, self.head_dim, self.dtype
+        b, g = toks.shape
+        x = (w["wte"][toks] + w["wpe"][pos]).astype(dt)
+        new_ck, new_cv = [], []
+        for i, W in enumerate(w["layers"]):
+            h1 = _ln(x, W["ln1_w"], W["ln1_b"], self.eps)
+            qkv = _linear(h1, W["qkv_w"], W["qkv_b"]) \
+                .reshape(b, g, 3, nh, hd)
+            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+            cki = _kv_write_rows(ck[i], k, pos)
+            cvi = _kv_write_rows(cv[i], v, pos)
+            att = _chunk_sdpa(q, cki, cvi, pos, hd)
+            x = x + _linear(att.reshape(b, g, nh * hd),
                             W["out_w"], W["out_b"])
             h2 = _ln(x, W["ln2_w"], W["ln2_b"], self.eps)
             m = jax.nn.gelu(_linear(h2, W["fc1_w"], W["fc1_b"]),
@@ -261,7 +361,7 @@ class LlamaDecodeAdapter(DecodeAdapter):
         v = _linear(h1, W["v_w"]).reshape(b, s, kvh, hd)
         return q, k, v
 
-    def prefill(self, w, ids, total):
+    def prefill(self, w, ids, total, kv_quant=False):
         b, plen = ids.shape
         nh, kvh, hd = self.num_heads, self.num_kv_heads, self.head_dim
         dt = self.dtype
@@ -274,8 +374,8 @@ class LlamaDecodeAdapter(DecodeAdapter):
             q, k, v = self._qkv(W, x, b, plen)
             q = _rope(q, pos, self.rope_base)
             k = _rope(k, pos, self.rope_base)
-            ck = jnp.zeros((b, total, kvh, hd), dt).at[:, :plen].set(k)
-            cv = jnp.zeros((b, total, kvh, hd), dt).at[:, :plen].set(v)
+            ck = _kv_prefill_store(k, b, total, plen, dt, kv_quant)
+            cv = _kv_prefill_store(v, b, total, plen, dt, kv_quant)
             kf = jnp.repeat(k, rep, axis=2) if rep > 1 else k
             vf = jnp.repeat(v, rep, axis=2) if rep > 1 else v
             att = _causal_prefill_attn(q, kf, vf, causal, hd, dt)
@@ -300,12 +400,10 @@ class LlamaDecodeAdapter(DecodeAdapter):
             q = _rope(q, pos_b, self.rope_base)[:, 0]
             k = _rope(k, pos_b, self.rope_base)[:, 0]
             v = v[:, 0]
-            cki = jax.lax.dynamic_update_slice(ck[i], k[:, None],
-                                               (0, pos, 0, 0))
-            cvi = jax.lax.dynamic_update_slice(cv[i], v[:, None],
-                                               (0, pos, 0, 0))
-            kf = jnp.repeat(cki, rep, axis=2) if rep > 1 else cki
-            vf = jnp.repeat(cvi, rep, axis=2) if rep > 1 else cvi
+            cki = _kv_write(ck[i], k, pos)
+            cvi = _kv_write(cv[i], v, pos)
+            kf = _kv_repeat(cki, rep)
+            vf = _kv_repeat(cvi, rep)
             att = _masked_sdpa(q, kf, vf, t_mask, hd)
             x = x + _linear(att.reshape(b, nh * hd), W["o_w"])
             h2 = _rms(x, W["post_ln"], self.eps)
@@ -314,6 +412,56 @@ class LlamaDecodeAdapter(DecodeAdapter):
             new_ck.append(cki)
             new_cv.append(cvi)
         return self.logits(w, x), tuple(new_ck), tuple(new_cv)
+
+    def chunk_step(self, w, toks, pos, ck, cv):
+        """g tokens at per-row positions in one pass (speculative
+        draft/verify). toks, pos [b, g]; logits [b, g, V]."""
+        nh, kvh, hd = self.num_heads, self.num_kv_heads, self.head_dim
+        dt = self.dtype
+        b, g = toks.shape
+        x = w["wte"][toks].astype(dt)
+        rep = nh // kvh
+        new_ck, new_cv = [], []
+        for i, W in enumerate(w["layers"]):
+            q, k, v = self._qkv(W, x, b, g)
+            q = _rope(q, pos, self.rope_base)
+            k = _rope(k, pos, self.rope_base)
+            cki = _kv_write_rows(ck[i], k, pos)
+            cvi = _kv_write_rows(cv[i], v, pos)
+            att = _chunk_sdpa(q, _kv_repeat(cki, rep),
+                              _kv_repeat(cvi, rep), pos, hd)
+            x = x + _linear(att.reshape(b, g, nh * hd), W["o_w"])
+            h2 = _rms(x, W["post_ln"], self.eps)
+            m = jax.nn.silu(_linear(h2, W["gate_w"])) * _linear(h2, W["up_w"])
+            x = x + _linear(m, W["down_w"])
+            new_ck.append(cki)
+            new_cv.append(cvi)
+        return self.logits(w, x), tuple(new_ck), tuple(new_cv)
+
+
+def _chunk_sdpa(q, ck, cv, pos, hd):
+    """Chunked causal attention over the cache for speculative verify:
+    q [b, g, nh, hd] at per-row positions pos [b, g] attends to every
+    cache slot t <= pos[b, g] (the chunk's own k/v were written before
+    this call, so within-chunk causality falls out of the position
+    mask). Handles bf16 and int8 cache representations like
+    _masked_sdpa."""
+    T = ck["q8"].shape[1] if isinstance(ck, dict) else ck.shape[1]
+    mask = (jnp.arange(T)[None, None, :] <= pos[:, :, None])[:, None]
+    if isinstance(ck, dict):
+        sc = jnp.einsum("bghd,bthd->bhgt", q, ck["q8"].astype(q.dtype),
+                        preferred_element_type=jnp.float32)
+        sc = sc * jnp.swapaxes(ck["s"], 1, 2)[:, :, None, :] * (hd ** -0.5)
+        sc = jnp.where(mask, sc, -1e30)
+        w = jax.nn.softmax(sc, axis=-1)
+        wv = (w * jnp.swapaxes(cv["s"], 1, 2)[:, :, None, :]) \
+            .astype(q.dtype)
+        return jnp.einsum("bhgt,bthd->bghd", wv, cv["q8"].astype(q.dtype))
+    sc = jnp.einsum("bghd,bthd->bhgt", q, ck,
+                    preferred_element_type=jnp.float32) * (hd ** -0.5)
+    sc = jnp.where(mask, sc, -1e30)
+    w = jax.nn.softmax(sc, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhgt,bthd->bghd", w, cv)
 
 
 def _causal_prefill_attn(q, k, v, causal, hd, dt):
@@ -330,7 +478,22 @@ def _causal_prefill_attn(q, k, v, causal, hd, dt):
 def _masked_sdpa(q, ck, cv, t_mask, hd):
     """Masked single-query attention over the cache — the
     masked_multihead_attention analog. q [b, nh, hd] is attended against
-    the full cache [b, T, nh, hd] with invalid positions masked."""
+    the full cache [b, T, nh, hd] with invalid positions masked.
+
+    int8 caches arrive as {"q8": [b,T,nh,hd] int8, "s": [b,T,nh] f32}.
+    The dequant NEVER materializes a bf16 cache in HBM: the int8->bf16
+    convert fuses into the dot operand read (same trick as the int8
+    weight path), and the per-row scales — constant over the head dim —
+    are applied on the score side (exact: scores_bht = s_bth * <q, q8>)
+    and folded into the softmax weights for the V contraction."""
+    if isinstance(ck, dict):
+        scores = jnp.einsum("bhd,bthd->bht", q, ck["q8"].astype(q.dtype),
+                            preferred_element_type=jnp.float32)
+        scores = scores * jnp.swapaxes(ck["s"], 1, 2) * (hd ** -0.5)
+        scores = jnp.where(t_mask[None, None, :], scores, -1e30)
+        w = jax.nn.softmax(scores, axis=-1)
+        wv = (w * jnp.swapaxes(cv["s"], 1, 2)).astype(q.dtype)
+        return jnp.einsum("bht,bthd->bhd", wv, cv["q8"].astype(q.dtype))
     scores = jnp.einsum("bhd,bthd->bht", q, ck,
                         preferred_element_type=jnp.float32) * (hd ** -0.5)
     scores = jnp.where(t_mask[None, None, :], scores, -1e30)
@@ -382,7 +545,7 @@ def _gen_cache(model):
 def generate(model, input_ids, max_new_tokens: int = 32,
              temperature: float = 0.0, top_p: Optional[float] = None,
              eos_token_id: Optional[int] = None, weight_quant=None,
-             name=None):
+             kv_cache_quant=None, name=None):
     """Greedy / temperature / nucleus decoding, fully compiled, for any
     model exposing ``decode_adapter()``.
 
@@ -392,7 +555,13 @@ def generate(model, input_ids, max_new_tokens: int = 32,
     weights (half the HBM reads of the weight-bandwidth-bound decode;
     quantized copies are cached on the model — re-quantize by clearing
     ``model._gen_quant_w`` after a weight update).
+    ``kv_cache_quant="int8"`` stores the KV cache as int8 with
+    per-(position, head) scales computed at write time; the dequant is
+    fused into the attention read (reference surface:
+    masked_multihead_attention's cache_k/v_quant_scales args).
     """
+    if kv_cache_quant not in (None, "int8"):
+        raise ValueError("kv_cache_quant must be None or 'int8'")
     ad = model.decode_adapter()
     ids = _as_ids(input_ids)
     b, plen = ids.shape
@@ -402,25 +571,20 @@ def generate(model, input_ids, max_new_tokens: int = 32,
     # parameter array there would hold ~model-size HBM after updates
     w_now, ad.weights = ad.weights, None
     if weight_quant == "int8":
-        qw = getattr(model, "_gen_quant_w", None)
-        if qw is None:
-            if w_now.get("lm_head") is None:
-                w_now = dict(w_now)
-                w_now["lm_head"] = w_now["wte"].T
-            qw = model._gen_quant_w = jax.tree.map(
-                lambda a: a, _quantize_tree(w_now))
-        w_now = qw
+        w_now = _quantized_weights(model, w_now)
     elif weight_quant is not None:
         raise ValueError("weight_quant must be None or 'int8'")
 
     cache = _gen_cache(model)
     key_cache = ("sample", b, plen, max_new_tokens, temperature, top_p,
-                 eos_token_id, weight_quant)
+                 eos_token_id, weight_quant, kv_cache_quant)
     fn = cache.get(key_cache)
     if fn is None:
+        kv_quant = kv_cache_quant == "int8"
 
         def run(weights, ids, key):
-            x, ck, cv = ad.prefill(weights, ids, total)
+            x, ck, cv = ad.prefill(weights, ids, total,
+                                   kv_quant=kv_quant)
             lg0 = ad.logits(weights, x[:, -1])
             key, k0 = jax.random.split(key)
             tok0 = _sample(lg0, k0, temperature, top_p)
@@ -454,6 +618,175 @@ def generate(model, input_ids, max_new_tokens: int = 32,
     key = _rng.next_key()
     out = fn(w_now, ids, key)
     return Tensor(out)
+
+
+def speculative_generate(model, input_ids, max_new_tokens: int = 32,
+                         gamma: int = 4, draft_model=None,
+                         draft_layers: Optional[int] = None,
+                         eos_token_id: Optional[int] = None,
+                         weight_quant=None, kv_cache_quant=None,
+                         return_stats: bool = False):
+    """Speculative greedy decoding, fully compiled (reference analog:
+    the speculative serving tier — PaddleNLP's speculate_decoding and
+    the fused_speculate_* ops feeding masked_multihead_attention with
+    draft token chunks).
+
+    A cheap draft proposes ``gamma`` tokens autoregressively; the target
+    verifies all of them in ONE chunked forward pass (one weight read
+    for up to gamma+1 emitted tokens — the weight-bandwidth win).
+    Greedy acceptance makes the output IDENTICAL to ``generate(...,
+    temperature=0)``: a proposal is accepted iff it equals the target's
+    argmax given the accepted prefix, and the first mismatch is replaced
+    by the target's own token. Acceptance is tracked PER ROW — batch
+    rows advance at their own rate via per-row cache/output pointers.
+
+    Draft choices: ``draft_model`` (a smaller CausalLM sharing the
+    vocab) or ``draft_layers=k`` (self-speculative early exit: the
+    target's first k blocks + its final norm/head, zero extra weights).
+
+    TPU-native structure: the whole loop is one ``lax.while_loop`` on
+    device — no host round-trip per iteration; out-of-window writes from
+    finished rows are dropped by scatter mode="drop".
+    """
+    if (draft_model is None) == (draft_layers is None):
+        raise ValueError("pass exactly one of draft_model / draft_layers")
+    if gamma < 1:
+        raise ValueError("gamma must be >= 1")
+    kv_quant = kv_cache_quant == "int8"
+    if kv_cache_quant not in (None, "int8"):
+        raise ValueError("kv_cache_quant must be None or 'int8'")
+
+    ad = model.decode_adapter()
+    ids = _as_ids(input_ids)
+    b, plen = ids.shape
+    # window slack: verify writes run up to gamma past the last commit
+    total = _check_window(ad, plen, max_new_tokens + 2 * gamma + 2)
+
+    w_now, ad.weights = ad.weights, None
+    if weight_quant == "int8":
+        w_now = _quantized_weights(model, w_now)
+    elif weight_quant is not None:
+        raise ValueError("weight_quant must be None or 'int8'")
+
+    if draft_model is not None:
+        dad = draft_model.decode_adapter()
+        if dad.vocab_size != ad.vocab_size:
+            raise ValueError("draft vocab must match the target's")
+        # the draft decodes over the same window — a shorter draft
+        # position range would silently clamp wpe/rope gathers and
+        # quietly zero the acceptance rate
+        _check_window(dad, plen, max_new_tokens + 2 * gamma + 2)
+        dw_now, dad.weights = dad.weights, None
+        if weight_quant == "int8":
+            dw_now = _quantized_weights(draft_model, dw_now)
+        # structural key: the cached fn closes over dad's static config,
+        # so two drafts may share it ONLY if every field the traced code
+        # reads is identical (weights themselves are traced args)
+        draft_key = ("model", type(dad).__name__, dad.num_layers,
+                     dad.num_heads, dad.num_kv_heads, dad.head_dim,
+                     dad.vocab_size, getattr(dad, "eps", None),
+                     getattr(dad, "rope_base", None))
+    else:
+        if not 0 < draft_layers < ad.num_layers:
+            raise ValueError("draft_layers must be in (0, num_layers)")
+        dad = ad
+        dw_now = dict(w_now)
+        dw_now["layers"] = list(w_now["layers"])[:draft_layers]
+        draft_key = ("self", draft_layers)
+
+    cache = _gen_cache(model)
+    key_cache = ("spec", b, plen, max_new_tokens, gamma, eos_token_id,
+                 weight_quant, kv_cache_quant, draft_key)
+    fn = cache.get(key_cache)
+    if fn is None:
+        W_out = max_new_tokens + gamma + 1
+
+        def run(weights, dweights, ids):
+            x, ck, cv = ad.prefill(weights, ids, total,
+                                   kv_quant=kv_quant)
+            _, dck, dcv = dad.prefill(dweights, ids, total,
+                                      kv_quant=kv_quant)
+            cur = jnp.argmax(ad.logits(weights, x[:, -1]),
+                             axis=-1).astype(jnp.int32)       # [b]
+            ptr = jnp.zeros((b,), jnp.int32)     # tokens committed to out
+            ln = jnp.full((b,), plen, jnp.int32)  # committed cache length
+            out = jnp.zeros((b, W_out), jnp.int32)
+            n_iter = jnp.int32(0)
+            n_acc = jnp.int32(0)
+
+            def cond(carry):
+                return jnp.min(carry[1]) < max_new_tokens
+
+            def body(carry):
+                out, ptr, cur, ln, ck, cv, dck, dcv, n_iter, n_acc = carry
+
+                # -- draft proposes gamma tokens (one-token chunk steps)
+                def dstep(c, j):
+                    tok, dck, dcv = c
+                    lg, dck, dcv = dad.chunk_step(
+                        dweights, tok[:, None], (ln + j)[:, None],
+                        dck, dcv)
+                    nxt = jnp.argmax(lg[:, 0], -1).astype(jnp.int32)
+                    return (nxt, dck, dcv), nxt
+
+                (last_d, dck, dcv), props = jax.lax.scan(
+                    dstep, (cur, dck, dcv), jnp.arange(gamma))
+                props = jnp.swapaxes(props, 0, 1)        # [b, gamma]
+                # write the final proposal's kv so the draft cache stays
+                # complete when every proposal is accepted
+                _, dck, dcv = dad.chunk_step(
+                    dweights, last_d[:, None], (ln + gamma)[:, None],
+                    dck, dcv)
+
+                # -- target verifies the whole chunk in one pass
+                chunk = jnp.concatenate([cur[:, None], props], 1)
+                pos = ln[:, None] + jnp.arange(gamma + 1)[None, :]
+                lg, ck, cv = ad.chunk_step(weights, chunk, pos, ck, cv)
+                tgt = jnp.argmax(lg, -1).astype(jnp.int32)  # [b, g+1]
+
+                # longest accepted prefix: props[:, j] must equal the
+                # target token after chunk[:, :j+1]
+                match = (props == tgt[:, :gamma]).astype(jnp.int32)
+                acc = jnp.cumprod(match, axis=1).sum(axis=1)  # [b]
+
+                # commit [cur, accepted...] — unaccepted tail slots get
+                # overwritten next iteration (ptr only advances 1+acc)
+                bidx = jnp.arange(b)[:, None]
+                out = out.at[bidx, ptr[:, None]
+                             + jnp.arange(gamma + 1)[None, :]].set(
+                    chunk, mode="drop")
+                new_cur = tgt[jnp.arange(b), acc]
+                # stats only count rows still producing real tokens —
+                # finished rows loop on a frozen cache (writes dropped)
+                # and their phantom acceptances would skew the mean
+                active = (ptr < max_new_tokens).astype(jnp.int32)
+                return (out, ptr + 1 + acc, new_cur, ln + 1 + acc,
+                        ck, cv, dck, dcv, n_iter + active.sum(),
+                        n_acc + (acc * active).sum())
+
+            carry = (out, ptr, cur, ln, ck, cv, dck, dcv, n_iter, n_acc)
+            out, ptr, _, _, _, _, _, _, n_iter, n_acc = \
+                jax.lax.while_loop(cond, body, carry)
+            toks = out[:, :max_new_tokens]
+            if eos_token_id is not None:
+                seen = jnp.cumsum(toks == eos_token_id, 1) \
+                    - (toks == eos_token_id)
+                toks = jnp.where(seen > 0, eos_token_id, toks)
+            return toks, n_iter, n_acc
+
+        fn = jax.jit(run)
+        cache[key_cache] = fn
+
+    toks, n_iter, n_acc = fn(w_now, dw_now, ids)
+    if return_stats:
+        # n_iter = active (row, iteration) pairs; n_acc = accepted
+        # proposals summed over those pairs
+        it = max(int(n_iter), 1)
+        stats = {"iterations": int(n_iter),
+                 "mean_accepted": float(n_acc) / it,
+                 "tokens_per_target_pass": 1.0 + float(n_acc) / it}
+        return Tensor(toks), stats
+    return Tensor(toks)
 
 
 def beam_search(model, input_ids, max_new_tokens: int = 32,

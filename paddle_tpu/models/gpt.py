@@ -469,7 +469,8 @@ class GPTForCausalLM(nn.Layer):
         return run_op(fn, [x, w], name="fused_lm_ce")
 
     def generate(self, input_ids, max_new_tokens=32, temperature=0.0,
-                 top_p=None, eos_token_id=None, weight_quant=None):
+                 top_p=None, eos_token_id=None, weight_quant=None,
+                 kv_cache_quant=None):
         """Fully-compiled autoregressive decoding (fused decode path,
         models/generation.py — the fused_multi_transformer/masked-MHA
         serving analog). Returns new token ids [b, max_new_tokens]."""
@@ -477,7 +478,8 @@ class GPTForCausalLM(nn.Layer):
 
         return _gen(self, input_ids, max_new_tokens=max_new_tokens,
                     temperature=temperature, top_p=top_p,
-                    eos_token_id=eos_token_id, weight_quant=weight_quant)
+                    eos_token_id=eos_token_id, weight_quant=weight_quant,
+                    kv_cache_quant=kv_cache_quant)
 
     def beam_search(self, input_ids, max_new_tokens=32, num_beams=4,
                     length_penalty=0.0, eos_token_id=None):

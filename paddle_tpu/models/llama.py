@@ -276,14 +276,16 @@ class LlamaForCausalLM(nn.Layer):
                 for _ in range(cfg.num_layers)]
 
     def generate(self, input_ids, max_new_tokens=32, temperature=0.0,
-                 top_p=None, eos_token_id=None, weight_quant=None):
+                 top_p=None, eos_token_id=None, weight_quant=None,
+                 kv_cache_quant=None):
         """Fully-compiled autoregressive decoding via the model-generic
         fused decode engine (models/generation.py)."""
         from .generation import generate as _gen
 
         return _gen(self, input_ids, max_new_tokens=max_new_tokens,
                     temperature=temperature, top_p=top_p,
-                    eos_token_id=eos_token_id, weight_quant=weight_quant)
+                    eos_token_id=eos_token_id, weight_quant=weight_quant,
+                    kv_cache_quant=kv_cache_quant)
 
     def beam_search(self, input_ids, max_new_tokens=32, num_beams=4,
                     length_penalty=0.0, eos_token_id=None):
