@@ -88,6 +88,115 @@ def _measure(step, ids, labels, iters):
     return time.perf_counter() - t0, loss
 
 
+def _bench_decode(pt, cfg):
+    """Serving decode tok/s: whole-generation compiled path, int8/int4
+    weights + int8 KV (models/generation.py; reference surfaces:
+    weight_only_linear int8/int4, masked_multihead_attention
+    cache-quant args). Also one speculative-decode datapoint with its
+    measured acceptance — on this RANDOM-INIT model acceptance is low,
+    so the number is the mechanism's floor, not its trained-model
+    value."""
+    import numpy as np
+
+    pt.set_default_dtype("bfloat16")
+    try:
+        model = pt.models.GPTForCausalLM(cfg)
+    finally:
+        pt.set_default_dtype("float32")
+    model.eval()
+    b, plen = 8, 128
+    rng = np.random.default_rng(2)
+    ids = pt.to_tensor(rng.integers(0, cfg.vocab_size, (b, plen))
+                       .astype(np.int32))
+
+    def timed_gen(new, **kw):
+        out = model.generate(ids, max_new_tokens=new, **kw)
+        _ = out.numpy()
+        t0 = time.perf_counter()
+        out = model.generate(ids, max_new_tokens=new, **kw)
+        _ = out.numpy()
+        return time.perf_counter() - t0
+
+    res = {"batch": b, "prompt": plen}
+    for tag, kw in (
+            ("int8_kv8", {"weight_quant": "int8",
+                          "kv_cache_quant": "int8"}),
+            ("int4_kv8", {"weight_quant": "int4",
+                          "kv_cache_quant": "int8"})):
+        t1 = timed_gen(64, **kw)
+        t2 = timed_gen(192, **kw)
+        per_step = (t2 - t1) / 128
+        res[tag] = {"device_tokens_per_s": round(b / per_step, 1),
+                    "ms_per_step": round(per_step * 1e3, 3)}
+
+    # speculative decode: one raw datapoint + measured acceptance
+    from paddle_tpu.models import speculative_generate
+
+    kw = dict(weight_quant="int8", kv_cache_quant="int8", gamma=4,
+              draft_layers=6, return_stats=True)
+    out, _ = speculative_generate(model, ids, max_new_tokens=128, **kw)
+    _ = out.numpy()
+    t0 = time.perf_counter()
+    out, st = speculative_generate(model, ids, max_new_tokens=128, **kw)
+    _ = out.numpy()
+    el = time.perf_counter() - t0
+    res["speculative_int8"] = {
+        "tokens_per_s_raw": round(b * 128 / el, 1),
+        "mean_accepted": round(st["mean_accepted"], 3),
+        "note": "random-init model: acceptance is the floor; exact-"
+                "greedy contract is test-enforced",
+    }
+    del model
+    return res
+
+
+def _bench_moe():
+    """Sorted-dispatch MoE FFN step (incubate/nn/pallas/moe_dispatch.py)
+    on the chip — the driver-visible MoE entry (VERDICT r4 #5)."""
+    import functools
+
+    import jax
+    import jax.lax as lax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from paddle_tpu.incubate.nn.pallas.moe_dispatch import moe_ffn_sorted
+
+    S, M, DFF, E, K = 8192, 2048, 2816, 8, 2
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(S, M), jnp.bfloat16)
+    probs = jax.nn.softmax(jnp.asarray(rng.randn(S, E), jnp.float32), -1)
+    w1 = jnp.asarray(rng.randn(E, M, 2 * DFF) * 0.02, jnp.bfloat16)
+    w2 = jnp.asarray(rng.randn(E, DFF, M) * 0.02, jnp.bfloat16)
+
+    # weights ride as jit ARGS — closure constants would be inlined
+    # into the HLO upload (the tunnel rejects multi-MB compile bodies)
+    @functools.partial(jax.jit, static_argnames="n")
+    def chained(xx, pp, a, b2, n):
+        def body(c, _):
+            return moe_ffn_sorted(c, pp, a, b2, k=K).astype(c.dtype), \
+                None
+
+        out, _ = lax.scan(body, xx, None, length=n)
+        return out
+
+    def run(n):
+        out = chained(x, probs, w1, w2, n=n)
+        _ = np.asarray(out[:1, :1])
+        t0 = time.perf_counter()
+        out = chained(x, probs, w1, w2, n=n)
+        _ = np.asarray(out[:1, :1])
+        return time.perf_counter() - t0
+
+    t1 = run(8)
+    t3 = run(24)
+    step = max(t3 - t1, 1e-9) / 16
+    flops = 2 * S * K * M * 2 * DFF + 2 * S * K * DFF * M
+    return {"tokens": S, "experts": E, "topk": K,
+            "step_ms": round(step * 1e3, 3),
+            "tflops": round(flops / step / 1e12, 2)}
+
+
 def main():
     import jax
 
@@ -175,6 +284,14 @@ def main():
             "batch": 4, "tokens_per_s": round(tps2, 1),
             "mfu": round(tps2 * fpt2 / peak, 4),
         }
+
+        # ---- decode (serving) bench, driver-visible (VERDICT r4 #5):
+        # GPT-1.3B b8 plen128, quantized weights + int8 KV cache.
+        # Two-point (64 vs 192 new tokens) differencing cancels the
+        # fixed tunnel dispatch+read overhead, leaving device step time.
+        del m2, step2, ids2, labels2
+        extra["decode"] = _bench_decode(pt, cfg2)
+        extra["moe"] = _bench_moe()
 
     print(json.dumps({
         "metric": metric,

@@ -59,14 +59,22 @@ def get_amp_dtype():
     return _state.dtype if _state.enabled else "float32"
 
 
+def effective_lists(custom_white=(), custom_black=()):
+    """The one place the 'custom white wins over black' composition rule
+    lives — shared by the eager auto_cast path and the program-level
+    AMPPass (distributed/passes) so the two tiers cannot diverge."""
+    wl = WHITE_LIST | set(custom_white)
+    bl = (BLACK_LIST | set(custom_black)) - set(custom_white)
+    return wl, bl
+
+
 def amp_cast_inputs(op_name: str, arrays):
     """Called by the op layer under auto_cast: cast inputs per white/black
     list (the analog of the reference's AmpAutoCasts in generated AD funcs,
     fluid/eager/amp_auto_cast.h)."""
     if not _state.enabled:
         return arrays
-    wl = WHITE_LIST | _state.custom_white
-    bl = (BLACK_LIST | _state.custom_black) - _state.custom_white
+    wl, bl = effective_lists(_state.custom_white, _state.custom_black)
     target = None
     if op_name in wl:
         target = to_jax_dtype(_state.dtype)

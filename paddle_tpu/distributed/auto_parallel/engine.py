@@ -150,6 +150,7 @@ class Engine:
         self.strategy = strategy or Strategy()
         self._mesh = mesh
         self._step = None
+        self.pass_manager = None   # built by _build from the strategy
         self.history = {"loss": []}
 
     # ------------------------------------------------------------ build
@@ -185,7 +186,32 @@ class Engine:
         from ...amp import auto_cast
 
         st = self.strategy
+
+        # strategy -> registered program passes, composed through the
+        # PassManager (reference: engine.py _parallel_pir applying the
+        # strategy's pass list through apply_pass). Each pass OWNS the
+        # interpretation of its strategy knob; the Engine only reads the
+        # configured context when assembling the step. Built before the
+        # pipeline branch so recompute composes with staged PP too.
+        from ..passes import PassManager, new_pass
+
+        pass_list = []
+        if st.amp.enable:
+            pass_list.append(new_pass("auto_parallel_amp", {
+                "dtype": getattr(st.amp, "dtype", "bfloat16"),
+                "level": getattr(st.amp, "level", "O2")}))
+        if st.sharding.enable:
+            pass_list.append(new_pass("auto_parallel_sharding", {
+                "stage": int(st.sharding.stage)}))
+        if st.gradient_merge.enable:
+            pass_list.append(new_pass("auto_parallel_gradient_merge", {
+                "k_steps": int(st.gradient_merge.k_steps),
+                "avg": bool(getattr(st.gradient_merge, "avg", True))}))
         if st.recompute.enable:
+            pass_list.append(new_pass("auto_parallel_recompute"))
+        self.pass_manager = PassManager(pass_list)
+        ctx = self.pass_manager.configure().attrs
+        if ctx.get("recompute"):
             self._apply_recompute_pass()
 
         if st.pipeline.enable and int(getattr(
@@ -211,9 +237,10 @@ class Engine:
         mesh = self._resolve_mesh()
         loss_layer = self.loss
 
-        amp_enabled = st.amp.enable
-        amp_dtype = getattr(st.amp, "dtype", "bfloat16")
-        amp_level = getattr(st.amp, "level", "O2")
+        amp_cfg = ctx.get("amp", {"enable": False})
+        amp_enabled = amp_cfg.get("enable", False)
+        amp_dtype = amp_cfg.get("dtype", "bfloat16")
+        amp_level = amp_cfg.get("level", "O2")
 
         def loss_fn(model, *batch):
             def run():
@@ -230,15 +257,13 @@ class Engine:
             return run()
 
         fsdp_axis = None
-        if st.sharding.enable and int(st.sharding.stage) >= 2:
+        if ctx.get("fsdp_axis"):
             # sharding pass stage>=2: ZeRO param sharding over dp
             jm = self._jax_mesh(mesh)
-            if jm is not None and "dp" in jm.axis_names:
-                fsdp_axis = "dp"
+            if jm is not None and ctx["fsdp_axis"] in jm.axis_names:
+                fsdp_axis = ctx["fsdp_axis"]
 
-        accumulate = 1
-        if st.gradient_merge.enable:
-            accumulate = max(int(st.gradient_merge.k_steps), 1)
+        accumulate = ctx.get("accumulate_steps", 1)
         if st.pipeline.enable:
             accumulate = max(accumulate,
                              int(st.pipeline.accumulate_steps))

@@ -72,6 +72,13 @@ class PassBase:
     def _check_self(self):
         return True
 
+    def configure(self, context: PassContext) -> None:
+        """Record this pass's strategy interpretation in the context.
+        The Engine composes its TrainStep from the configured context —
+        the pass, not the Engine, owns what a strategy knob means
+        (reference analog: passes writing program attrs / dist_attrs
+        that the executor later consumes)."""
+
     def apply(self, fetches: List[Tensor],
               context: Optional[PassContext] = None) -> List[Tensor]:
         raise NotImplementedError
@@ -88,6 +95,13 @@ class PassManager:
         for p in self.passes:
             fetches = p.apply(fetches, self.context)
         return fetches
+
+    def configure(self) -> PassContext:
+        """Run every pass's configure() in order; returns the context
+        the step builder consumes."""
+        for p in self.passes:
+            p.configure(self.context)
+        return self.context
 
     @property
     def names(self):
@@ -137,21 +151,33 @@ def _identity_clone(node, new_parents):
 
 
 # --------------------------------------------------------------- amp pass
-# op-name sets mirror amp/__init__.py O1 lists (matmul-family compute in
-# bf16; numerically-sensitive reductions stay f32)
-_AMP_WHITE = {"matmul", "bmm", "mm", "conv1d", "conv2d", "conv3d",
-              "linear", "einsum", "flash_attention"}
-_AMP_BLACK = {"softmax", "log_softmax", "cross_entropy", "layer_norm",
-              "batch_norm", "rms_norm", "logsumexp", "mean", "sum",
-              "exp", "log", "norm", "cumsum"}
-
-
 @register_pass("auto_parallel_amp")
 @register_pass("auto_parallel_fp16")
 class AMPPass(PassBase):
     """Cast white-list op inputs to the amp dtype at the PROGRAM level
-    (reference: distributed/passes/auto_parallel_amp.py). attrs:
-    dtype ('bfloat16'|'float16')."""
+    (reference: distributed/passes/auto_parallel_amp.py). The op lists
+    are the SAME objects the eager auto_cast tier uses
+    (amp/__init__.py WHITE_LIST/BLACK_LIST mirroring
+    python/paddle/amp/amp_lists.py) — a program gets exactly the amp
+    treatment its eager twin would. attrs: dtype
+    ('bfloat16'|'float16'), custom_white_list, custom_black_list."""
+
+    def _lists(self):
+        from ...amp import effective_lists
+
+        return effective_lists(self.get_attr("custom_white_list", ()),
+                               self.get_attr("custom_black_list", ()))
+
+    def configure(self, context):
+        context.attrs["amp"] = {
+            "enable": True,
+            "dtype": self.get_attr("dtype", "bfloat16"),
+            "level": self.get_attr("level", "O2"),
+            "custom_white_list": set(
+                self.get_attr("custom_white_list", ())),
+            "custom_black_list": set(
+                self.get_attr("custom_black_list", ())),
+        }
 
     def apply(self, fetches, context=None):
         import jax.numpy as jnp
@@ -159,14 +185,19 @@ class AMPPass(PassBase):
         from ...core.dtype import to_jax_dtype
 
         amp_dt = to_jax_dtype(self.get_attr("dtype", "bfloat16"))
+        white, black = self._lists()
 
         def transform(node, new_parents):
-            if node.name not in _AMP_WHITE:
+            if node.name not in white and node.name not in black:
                 return _identity_clone(node, new_parents)
             fn = node.fn
+            # white ops compute in the amp dtype; black ops are forced
+            # UP to f32 (same contract as eager auto_cast O1 — e.g. a
+            # softmax fed bf16 activations runs its reduction in f32)
+            in_dt = amp_dt if node.name in white else jnp.float32
 
-            def amp_fn(*vals, _fn=fn):
-                cast = [v.astype(amp_dt)
+            def amp_fn(*vals, _fn=fn, _dt=in_dt):
+                cast = [v.astype(_dt)
                         if hasattr(v, "dtype")
                         and jnp.issubdtype(v.dtype, jnp.floating) else v
                         for v in vals]
@@ -199,6 +230,9 @@ class RecomputePass(PassBase):
     DEFAULT = {"matmul", "bmm", "mm", "linear", "einsum", "gelu", "relu",
                "tanh", "softmax", "flash_attention"}
 
+    def configure(self, context):
+        context.attrs["recompute"] = True
+
     def apply(self, fetches, context=None):
         import jax
 
@@ -212,6 +246,96 @@ class RecomputePass(PassBase):
                              node.single, attrs=node.attrs)
 
         return rewrite_program(fetches, transform)
+
+
+# ----------------------------------------------------------- sharding pass
+@register_pass("auto_parallel_sharding")
+class ShardingPass(PassBase):
+    """ZeRO-style sharding as a program pass (reference:
+    distributed/passes/auto_parallel_sharding.py — there the pass
+    rewrites the program to slice optimizer states/params across dp;
+    here the program-level half annotates every PARAMETER leaf with a
+    sharding constraint so GSPMD lays it out sharded, and configure()
+    records the stage/axis the TrainStep builder uses for optimizer-
+    state placement). attrs: stage (1|2|3), axis ('dp'), mesh (a
+    jax Mesh for the DAG rewrite; without one apply() is the identity
+    since a constraint needs a mesh to bind to)."""
+
+    def configure(self, context):
+        stage = int(self.get_attr("stage", 1))
+        context.attrs["sharding_stage"] = stage
+        context.attrs["sharding_axis"] = self.get_attr("axis", "dp")
+        if stage >= 2:
+            context.attrs["fsdp_axis"] = self.get_attr("axis", "dp")
+
+    def apply(self, fetches, context=None):
+        import jax
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        mesh = self.get_attr("mesh")
+        axis = self.get_attr("axis", "dp")
+        if mesh is None or axis not in getattr(mesh, "axis_names", ()):
+            return fetches
+        nshard = mesh.shape[axis]
+
+        def shard_spec(aval):
+            # first dim divisible by the axis size gets the shard; a
+            # param with no divisible dim stays replicated (exactly what
+            # GSPMD would do with an unsatisfiable annotation, minus the
+            # warning noise)
+            for i, d in enumerate(aval.shape):
+                if d % nshard == 0 and d >= nshard:
+                    return P(*([None] * i + [axis]))
+            return None
+
+        def transform(node, new_parents):
+            wrapped = []
+            changed = False
+            for p in new_parents:
+                if isinstance(p, Tensor) and getattr(p, "trainable",
+                                                     False):
+                    spec = shard_spec(p._data)
+                    if spec is not None:
+                        sh = NamedSharding(mesh, spec)
+                        leaf = _g.OpNode(
+                            (lambda v, _s=sh:
+                             jax.lax.with_sharding_constraint(v, _s)),
+                            [p],
+                            [jax.ShapeDtypeStruct(tuple(p._data.shape),
+                                                  p._data.dtype)],
+                            "shard_param", True)
+                        wrapped.append((leaf, 0))
+                        changed = True
+                        continue
+                wrapped.append(p)
+            if not changed:
+                return _identity_clone(node, new_parents)
+            return _g.OpNode(node.fn, wrapped, node.out_avals, node.name,
+                             node.single, attrs=node.attrs)
+
+        return rewrite_program(fetches, transform)
+
+
+# ------------------------------------------------------ gradient merge pass
+@register_pass("auto_parallel_gradient_merge")
+class GradientMergePass(PassBase):
+    """Gradient accumulation over k micro-steps (reference:
+    distributed/passes/auto_parallel_gradient_merge.py — there the pass
+    inserts gradient buffers + a mod-k conditional optimizer update into
+    the program; here the captured forward DAG is untouched and
+    configure() hands k to the TrainStep builder, whose lax.scan over
+    micro-batches IS the merged update — one compiled region instead of
+    program-inserted buffer ops). attrs: k_steps, avg."""
+
+    def configure(self, context):
+        context.attrs["accumulate_steps"] = max(
+            int(self.get_attr("k_steps", 1)), 1)
+        context.attrs["gradient_merge_avg"] = bool(
+            self.get_attr("avg", True))
+
+    def apply(self, fetches, context=None):
+        return fetches
 
 
 def _avals_of(parents):
@@ -237,4 +361,6 @@ from .pipeline_scheduler_pass import (  # noqa: E402,F401
     StagedProgram,
 )
 
-__all__ += ["StagedProgram", "PipelineFThenBPass", "Pipeline1F1BPass"]
+__all__ += ["StagedProgram", "PipelineFThenBPass", "Pipeline1F1BPass",
+            "AMPPass", "RecomputePass", "ShardingPass",
+            "GradientMergePass"]

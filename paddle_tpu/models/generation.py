@@ -47,7 +47,20 @@ def _rms(x, w, eps):
 
 
 def _linear(x, w, b=None):
-    if isinstance(w, dict) and "q8" in w:
+    if isinstance(w, dict) and "q4" in w:
+        # weight-only int4, group-wise scales (reference:
+        # nn/quant/quantized_linear.py weight_only_linear
+        # weight_dtype='int4'): w is {"q4": [G, gs, out] int4,
+        # "s4": [G, out]}. The int4->bf16 convert fuses into the
+        # grouped-dot operand read; the per-group scale contraction is
+        # a [*, G, out] x [G, out] reduce — tiny next to the weight
+        # stream, which drops to a QUARTER of bf16.
+        G, gs, out_dim = w["q4"].shape
+        xg = x.reshape(x.shape[:-1] + (G, gs))
+        z = jnp.einsum("...gi,gio->...go", xg,
+                       w["q4"].astype(x.dtype))
+        y = jnp.einsum("...go,go->...o", z, w["s4"].astype(x.dtype))
+    elif isinstance(w, dict) and "q8" in w:
         # weight-only int8: XLA fuses the int8->bf16 convert into the
         # matmul operand read, so HBM traffic halves vs bf16 weights —
         # decode is weight-bandwidth-bound, so this is ~2x tokens/s
@@ -67,41 +80,91 @@ def _quantize_w(w):
     return {"q8": q, "s": s}
 
 
+def _quantize_w4(w, group=128):
+    """Group-wise symmetric int4 for a [in, out] matmul weight: scales
+    per (input-group, out-channel), the standard weight-only-int4 recipe
+    (reference: nn/quant/quantized_linear.py weight_only_linear,
+    group_size arg). The nibbles are STORED as int8 ("q4i8") and
+    converted to jnp.int4 on device inside the compiled program
+    (_activate_q4): int4 arrays cannot cross the jit boundary on every
+    platform plugin, but a convert placed inside the program
+    materializes the packed copy once per dispatch, and the decode scan
+    then streams the QUARTER-width weights from HBM every step."""
+    din, dout = w.shape
+    if din % group != 0:
+        return _quantize_w(w)       # ragged in-dim: fall back to int8
+    wg = w.astype(jnp.float32).reshape(din // group, group, dout)
+    s = jnp.max(jnp.abs(wg), axis=1) / 7.0           # [G, out]
+    s = jnp.maximum(s, 1e-8)
+    q = jnp.clip(jnp.round(wg / s[:, None, :]), -7, 7).astype(jnp.int8)
+    return {"q4i8": q, "s4": s.astype(jnp.bfloat16)}
+
+
+def _activate_q4(w):
+    """Inside-jit tree walk converting stored q4i8 nibbles to jnp.int4
+    (values already in [-7, 7], so the convert is exact)."""
+    if isinstance(w, dict):
+        if "q4i8" in w:
+            return {"q4": w["q4i8"].astype(jnp.int4), "s4": w["s4"]}
+        return {k: _activate_q4(v) for k, v in w.items()}
+    if isinstance(w, list):
+        return [_activate_q4(v) for v in w]
+    return w
+
+
 _QUANT_SKIP = {"wte", "wpe"}  # embedding gathers stay full precision
 
 
-def _quantized_weights(model, w_now):
-    """Per-model cached int8 weight tree (shared by generate /
+def _quantized_weights(model, w_now, bits=8):
+    """Per-model cached quantized weight tree (shared by generate /
     speculative target / speculative draft). Re-quantize after a weight
-    update by clearing ``model._gen_quant_w``."""
-    qw = getattr(model, "_gen_quant_w", None)
+    update by clearing ``model._gen_quant_w`` / ``_gen_quant_w4``."""
+    attr = "_gen_quant_w" if bits == 8 else "_gen_quant_w4"
+    qw = getattr(model, attr, None)
     if qw is None:
         if w_now.get("lm_head") is None:
             w_now = dict(w_now)
             w_now["lm_head"] = w_now["wte"].T
-        qw = model._gen_quant_w = _quantize_tree(w_now)
+        qw = _quantize_tree(w_now, bits=bits)
+        setattr(model, attr, qw)
     return qw
 
 
-def _quantize_tree(w, min_dim=256):
+def _resolve_weight_quant(model, w_now, weight_quant):
+    if weight_quant is None:
+        return w_now
+    if weight_quant == "int8":
+        return _quantized_weights(model, w_now, bits=8)
+    if weight_quant == "int4":
+        return _quantized_weights(model, w_now, bits=4)
+    raise ValueError("weight_quant must be None, 'int8' or 'int4'")
+
+
+def _quantize_tree(w, min_dim=256, bits=8):
     """Walk an adapter weight pytree, replacing big 2D matmul weights with
-    int8 quant dicts (reference analog: weight_only_linear /
-    llm.int8 serving paths, phi/kernels/fusion/gpu/fused_weight_only_*)."""
+    int8 (or group-wise int4) quant dicts (reference analog:
+    weight_only_linear / llm.int8 serving paths,
+    phi/kernels/fusion/gpu/fused_weight_only_*). In int4 mode the
+    lm_head stays int8: the argmax over the vocab is the single most
+    quantization-sensitive matmul in the decode."""
     if isinstance(w, dict):
         out = {}
         for k, v in w.items():
             if k in _QUANT_SKIP:
                 out[k] = v
             elif isinstance(v, (dict, list)):
-                out[k] = _quantize_tree(v, min_dim)
+                out[k] = _quantize_tree(v, min_dim, bits)
             elif (hasattr(v, "ndim") and v is not None and v.ndim == 2
                     and min(v.shape) >= min_dim):
-                out[k] = _quantize_w(v)
+                if bits == 4 and k != "lm_head":
+                    out[k] = _quantize_w4(v)
+                else:
+                    out[k] = _quantize_w(v)
             else:
                 out[k] = v
         return out
     if isinstance(w, list):
-        return [_quantize_tree(v, min_dim) for v in w]
+        return [_quantize_tree(v, min_dim, bits) for v in w]
     return w
 
 
@@ -120,40 +183,51 @@ def _quantize_kv(k):
     return {"q8": q, "s": s}
 
 
+# Cache layout is HEAD-MAJOR [b, nh, T, hd] (scales [b, nh, T]): the
+# per-step attention then lowers to batched matmuls over (b, h) with a
+# contiguous [T, hd] panel per head — the MXU-friendly orientation —
+# instead of strided gathers over a [b, T, nh, hd] slab.
+
 def _kv_prefill_store(k, b, total, plen, dt, quant):
-    """Build a [b, total, nh, hd] cache holding the prefill rows."""
+    """Build a [b, nh, total, hd] cache from prefill rows
+    k [b, plen, nh, hd]."""
     nh, hd = k.shape[-2], k.shape[-1]
+    k = jnp.swapaxes(k, 1, 2)                       # [b, nh, plen, hd]
     if not quant:
-        return jnp.zeros((b, total, nh, hd), dt).at[:, :plen].set(k)
+        return jnp.zeros((b, nh, total, hd), dt).at[:, :, :plen].set(k)
     qk = _quantize_kv(k)
-    return {"q8": jnp.zeros((b, total, nh, hd), jnp.int8)
-            .at[:, :plen].set(qk["q8"]),
-            "s": jnp.zeros((b, total, nh), jnp.float32)
-            .at[:, :plen].set(qk["s"])}
+    return {"q8": jnp.zeros((b, nh, total, hd), jnp.int8)
+            .at[:, :, :plen].set(qk["q8"]),
+            "s": jnp.zeros((b, nh, total), jnp.float32)
+            .at[:, :, :plen].set(qk["s"])}
 
 
 def _kv_write(cache, k, pos):
     """Write one decode row k [b, nh, hd] at position pos."""
     if not isinstance(cache, dict):
-        return jax.lax.dynamic_update_slice(cache, k[:, None],
-                                            (0, pos, 0, 0))
+        return jax.lax.dynamic_update_slice(cache, k[:, :, None],
+                                            (0, 0, pos, 0))
     qk = _quantize_kv(k)
     return {"q8": jax.lax.dynamic_update_slice(
-                cache["q8"], qk["q8"][:, None], (0, pos, 0, 0)),
+                cache["q8"], qk["q8"][:, :, None], (0, 0, pos, 0)),
             "s": jax.lax.dynamic_update_slice(
-                cache["s"], qk["s"][:, None], (0, pos, 0))}
+                cache["s"], qk["s"][:, :, None], (0, 0, pos))}
 
 
 def _kv_write_rows(cache, k, pos):
     """Write g rows k [b, g, nh, hd] at per-row positions pos [b, g]
     (speculative verify writes land at different offsets per sequence).
-    Out-of-window positions (finished rows still looping) are dropped."""
+    Out-of-window positions (finished rows still looping) are dropped.
+    Advanced indices on axes 0 and 2 around the head slice produce
+    [b, g, nh, hd] update slots — matching k's natural layout."""
     bidx = jnp.arange(k.shape[0])[:, None]
     if not isinstance(cache, dict):
-        return cache.at[bidx, pos].set(k.astype(cache.dtype), mode="drop")
+        return cache.at[bidx, :, pos].set(k.astype(cache.dtype),
+                                          mode="drop")
     qk = _quantize_kv(k)
-    return {"q8": cache["q8"].at[bidx, pos].set(qk["q8"], mode="drop"),
-            "s": cache["s"].at[bidx, pos].set(qk["s"], mode="drop")}
+    return {"q8": cache["q8"].at[bidx, :, pos].set(qk["q8"],
+                                                   mode="drop"),
+            "s": cache["s"].at[bidx, :, pos].set(qk["s"], mode="drop")}
 
 
 def _kv_repeat(cache, rep):
@@ -161,9 +235,9 @@ def _kv_repeat(cache, rep):
     if rep <= 1:
         return cache
     if not isinstance(cache, dict):
-        return jnp.repeat(cache, rep, axis=2)
-    return {"q8": jnp.repeat(cache["q8"], rep, axis=2),
-            "s": jnp.repeat(cache["s"], rep, axis=2)}
+        return jnp.repeat(cache, rep, axis=1)
+    return {"q8": jnp.repeat(cache["q8"], rep, axis=1),
+            "s": jnp.repeat(cache["s"], rep, axis=1)}
 
 
 def _rope(x, pos, base):
@@ -446,22 +520,21 @@ def _chunk_sdpa(q, ck, cv, pos, hd):
     this call, so within-chunk causality falls out of the position
     mask). Handles bf16 and int8 cache representations like
     _masked_sdpa."""
-    T = ck["q8"].shape[1] if isinstance(ck, dict) else ck.shape[1]
+    T = ck["q8"].shape[2] if isinstance(ck, dict) else ck.shape[2]
     mask = (jnp.arange(T)[None, None, :] <= pos[:, :, None])[:, None]
     if isinstance(ck, dict):
-        sc = jnp.einsum("bghd,bthd->bhgt", q, ck["q8"].astype(q.dtype),
+        sc = jnp.einsum("bghd,bhtd->bhgt", q, ck["q8"].astype(q.dtype),
                         preferred_element_type=jnp.float32)
-        sc = sc * jnp.swapaxes(ck["s"], 1, 2)[:, :, None, :] * (hd ** -0.5)
+        sc = sc * ck["s"][:, :, None, :] * (hd ** -0.5)
         sc = jnp.where(mask, sc, -1e30)
         w = jax.nn.softmax(sc, axis=-1)
-        wv = (w * jnp.swapaxes(cv["s"], 1, 2)[:, :, None, :]) \
-            .astype(q.dtype)
-        return jnp.einsum("bhgt,bthd->bghd", wv, cv["q8"].astype(q.dtype))
-    sc = jnp.einsum("bghd,bthd->bhgt", q, ck,
+        wv = (w * cv["s"][:, :, None, :]).astype(q.dtype)
+        return jnp.einsum("bhgt,bhtd->bghd", wv, cv["q8"].astype(q.dtype))
+    sc = jnp.einsum("bghd,bhtd->bhgt", q, ck,
                     preferred_element_type=jnp.float32) * (hd ** -0.5)
     sc = jnp.where(mask, sc, -1e30)
     w = jax.nn.softmax(sc, axis=-1).astype(q.dtype)
-    return jnp.einsum("bhgt,bthd->bghd", w, cv)
+    return jnp.einsum("bhgt,bhtd->bghd", w, cv)
 
 
 def _causal_prefill_attn(q, k, v, causal, hd, dt):
@@ -478,27 +551,27 @@ def _causal_prefill_attn(q, k, v, causal, hd, dt):
 def _masked_sdpa(q, ck, cv, t_mask, hd):
     """Masked single-query attention over the cache — the
     masked_multihead_attention analog. q [b, nh, hd] is attended against
-    the full cache [b, T, nh, hd] with invalid positions masked.
+    the full cache [b, nh, T, hd] with invalid positions masked.
 
-    int8 caches arrive as {"q8": [b,T,nh,hd] int8, "s": [b,T,nh] f32}.
+    int8 caches arrive as {"q8": [b,nh,T,hd] int8, "s": [b,nh,T] f32}.
     The dequant NEVER materializes a bf16 cache in HBM: the int8->bf16
     convert fuses into the dot operand read (same trick as the int8
     weight path), and the per-row scales — constant over the head dim —
-    are applied on the score side (exact: scores_bht = s_bth * <q, q8>)
+    are applied on the score side (exact: scores_bht = s_bht * <q, q8>)
     and folded into the softmax weights for the V contraction."""
     if isinstance(ck, dict):
-        scores = jnp.einsum("bhd,bthd->bht", q, ck["q8"].astype(q.dtype),
+        scores = jnp.einsum("bhd,bhtd->bht", q, ck["q8"].astype(q.dtype),
                             preferred_element_type=jnp.float32)
-        scores = scores * jnp.swapaxes(ck["s"], 1, 2) * (hd ** -0.5)
+        scores = scores * ck["s"] * (hd ** -0.5)
         scores = jnp.where(t_mask[None, None, :], scores, -1e30)
         w = jax.nn.softmax(scores, axis=-1)
-        wv = (w * jnp.swapaxes(cv["s"], 1, 2)).astype(q.dtype)
-        return jnp.einsum("bht,bthd->bhd", wv, cv["q8"].astype(q.dtype))
-    scores = jnp.einsum("bhd,bthd->bht", q, ck,
+        wv = (w * cv["s"]).astype(q.dtype)
+        return jnp.einsum("bht,bhtd->bhd", wv, cv["q8"].astype(q.dtype))
+    scores = jnp.einsum("bhd,bhtd->bht", q, ck,
                         preferred_element_type=jnp.float32) * (hd ** -0.5)
     scores = jnp.where(t_mask[None, None, :], scores, -1e30)
     w = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
-    return jnp.einsum("bht,bthd->bhd", w, cv)
+    return jnp.einsum("bht,bhtd->bhd", w, cv)
 
 
 def _sample(logits, key, temperature, top_p):
@@ -552,9 +625,12 @@ def generate(model, input_ids, max_new_tokens: int = 32,
     Returns the generated token ids [batch, max_new_tokens] (prompt not
     included). ``temperature=0`` = greedy. Tokens after ``eos_token_id``
     are clamped to eos. ``weight_quant="int8"`` serves per-channel int8
-    weights (half the HBM reads of the weight-bandwidth-bound decode;
-    quantized copies are cached on the model — re-quantize by clearing
-    ``model._gen_quant_w`` after a weight update).
+    weights (half the HBM reads of the weight-bandwidth-bound decode);
+    ``"int4"`` serves group-wise int4 blocks with an int8 lm_head
+    (quarter-width weight stream — reference surface:
+    nn/quant/quantized_linear.py weight_only_linear). Quantized copies
+    are cached on the model — re-quantize by clearing
+    ``model._gen_quant_w`` / ``_gen_quant_w4`` after a weight update.
     ``kv_cache_quant="int8"`` stores the KV cache as int8 with
     per-(position, head) scales computed at write time; the dequant is
     fused into the attention read (reference surface:
@@ -570,10 +646,7 @@ def generate(model, input_ids, max_new_tokens: int = 32,
     # the adapter alive in _gen_cache, and pinning a stale copy of every
     # parameter array there would hold ~model-size HBM after updates
     w_now, ad.weights = ad.weights, None
-    if weight_quant == "int8":
-        w_now = _quantized_weights(model, w_now)
-    elif weight_quant is not None:
-        raise ValueError("weight_quant must be None or 'int8'")
+    w_now = _resolve_weight_quant(model, w_now, weight_quant)
 
     cache = _gen_cache(model)
     key_cache = ("sample", b, plen, max_new_tokens, temperature, top_p,
@@ -583,6 +656,7 @@ def generate(model, input_ids, max_new_tokens: int = 32,
         kv_quant = kv_cache_quant == "int8"
 
         def run(weights, ids, key):
+            weights = _activate_q4(weights)
             x, ck, cv = ad.prefill(weights, ids, total,
                                    kv_quant=kv_quant)
             lg0 = ad.logits(weights, x[:, -1])
@@ -663,10 +737,7 @@ def speculative_generate(model, input_ids, max_new_tokens: int = 32,
     total = _check_window(ad, plen, max_new_tokens + 2 * gamma + 2)
 
     w_now, ad.weights = ad.weights, None
-    if weight_quant == "int8":
-        w_now = _quantized_weights(model, w_now)
-    elif weight_quant is not None:
-        raise ValueError("weight_quant must be None or 'int8'")
+    w_now = _resolve_weight_quant(model, w_now, weight_quant)
 
     if draft_model is not None:
         dad = draft_model.decode_adapter()
@@ -677,8 +748,7 @@ def speculative_generate(model, input_ids, max_new_tokens: int = 32,
         # quietly zero the acceptance rate
         _check_window(dad, plen, max_new_tokens + 2 * gamma + 2)
         dw_now, dad.weights = dad.weights, None
-        if weight_quant == "int8":
-            dw_now = _quantized_weights(draft_model, dw_now)
+        dw_now = _resolve_weight_quant(draft_model, dw_now, weight_quant)
         # structural key: the cached fn closes over dad's static config,
         # so two drafts may share it ONLY if every field the traced code
         # reads is identical (weights themselves are traced args)
@@ -702,6 +772,8 @@ def speculative_generate(model, input_ids, max_new_tokens: int = 32,
         W_out = max_new_tokens + gamma + 1
 
         def run(weights, dweights, ids):
+            weights = _activate_q4(weights)
+            dweights = _activate_q4(dweights)
             x, ck, cv = ad.prefill(weights, ids, total,
                                    kv_quant=kv_quant)
             _, dck, dcv = dad.prefill(dweights, ids, total,

@@ -248,12 +248,13 @@ def test_int8_kv_cache_decode():
     _, ck, _ = ad.prefill(ad.weights, jnp.asarray(ids.numpy()), 16,
                           kv_quant=True)
     assert ck[0]["q8"].dtype == jnp.int8
+    # head-major layout [b, nh, T, hd]; scales [b, nh, T]
     assert ck[0]["s"].shape == ck[0]["q8"].shape[:-1]
     # dequant error of the written rows is within int8 resolution
     _, ck_fp, _ = ad.prefill(ad.weights, jnp.asarray(ids.numpy()), 16)
     deq = ck[0]["q8"].astype(np.float32) * ck[0]["s"][..., None]
-    err = np.abs(deq - np.asarray(ck_fp[0], np.float32))[:, :8]
-    scale = np.abs(np.asarray(ck_fp[0], np.float32))[:, :8].max()
+    err = np.abs(deq - np.asarray(ck_fp[0], np.float32))[:, :, :8]
+    scale = np.abs(np.asarray(ck_fp[0], np.float32))[:, :, :8].max()
     assert err.max() <= scale / 127.0 + 1e-6
 
 
@@ -348,3 +349,52 @@ def test_speculative_generate_arg_validation():
         pt.models.speculative_generate(m, ids, draft_layers=99)
     with pytest.raises(ValueError):
         pt.models.speculative_generate(m, ids, draft_layers=1, gamma=0)
+
+
+def test_int4_weight_quant_decode():
+    """Weight-only int4 with group-wise scales (reference:
+    nn/quant/quantized_linear.py weight_only_linear weight_dtype='int4'):
+    logits track fp closely at the adapter level; lm_head stays int8;
+    nibbles are stored as int8 and activated to jnp.int4 inside the
+    compiled program."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.models import generation as G
+    from paddle_tpu.models.gpt import GPTConfig
+
+    pt.seed(21)
+    cfg = GPTConfig(vocab_size=256, hidden_size=256, num_layers=2,
+                    num_heads=4, max_position_embeddings=64)
+    m = pt.models.GPTForCausalLM(cfg)
+    m.eval()
+    ad = m.decode_adapter()
+    w = dict(ad.weights)
+    w["lm_head"] = w["wte"].T
+    qw = G._quantize_tree(w, bits=4)
+    assert "q4i8" in qw["layers"][0]["qkv_w"]
+    assert "q8" in qw["lm_head"]          # head stays int8
+    ids = jnp.asarray(np.random.RandomState(0).randint(0, 256, (2, 8)),
+                      jnp.int32)
+    x, _, _ = ad.prefill(w, ids, 16)
+    lg_fp = np.asarray(ad.logits(w, x[:, -1]))
+    aq = G._activate_q4(qw)
+    assert aq["layers"][0]["qkv_w"]["q4"].dtype == jnp.int4
+    x2, _, _ = ad.prefill(aq, ids, 16)
+    lg_q = np.asarray(ad.logits(aq, x2[:, -1]))
+    corr = np.corrcoef(lg_fp.ravel(), lg_q.ravel())[0, 1]
+    assert corr > 0.95, corr
+    # whole path runs + deterministic; spec decode matches its greedy
+    out1 = m.generate(pt.to_tensor(np.asarray(ids)), max_new_tokens=6,
+                      weight_quant="int4", kv_cache_quant="int8")
+    out2 = m.generate(pt.to_tensor(np.asarray(ids)), max_new_tokens=6,
+                      weight_quant="int4", kv_cache_quant="int8")
+    np.testing.assert_array_equal(out1.numpy(), out2.numpy())
+    ref4 = m.generate(pt.to_tensor(np.asarray(ids)), max_new_tokens=6,
+                      weight_quant="int4").numpy()
+    sp4 = pt.models.speculative_generate(
+        m, pt.to_tensor(np.asarray(ids)), max_new_tokens=6, gamma=2,
+        draft_layers=1, weight_quant="int4").numpy()
+    np.testing.assert_array_equal(sp4, ref4)
+    with pytest.raises(ValueError):
+        m.generate(pt.to_tensor(np.asarray(ids)), max_new_tokens=4,
+                   weight_quant="int2")

@@ -248,3 +248,120 @@ class TestDecomposition:
         (out_d,) = decomp.decompose([out])
         got = exe.run(feed=feed, fetch_list=[out_d])[0]
         np.testing.assert_allclose(got, base, rtol=1e-6)
+
+
+class TestStrategyPassComposition:
+    """VERDICT r4 next #7: sharding + gradient-merge in the program-pass
+    tier; AMP pass lists generated from the eager amp lists; Engine
+    composes strategy passes through PassManager."""
+
+    def test_amp_pass_lists_match_eager(self):
+        from paddle_tpu import amp as amp_mod
+        from paddle_tpu.distributed.passes import AMPPass
+
+        white, black = AMPPass()._lists()
+        assert white == amp_mod.WHITE_LIST
+        assert black == amp_mod.BLACK_LIST - amp_mod.WHITE_LIST
+        # custom lists compose exactly like eager auto_cast
+        p = AMPPass().set_attr("custom_white_list", {"softmax"})
+        w2, b2 = p._lists()
+        assert "softmax" in w2 and "softmax" not in b2
+
+    def test_amp_custom_white_changes_numerics(self, static_mode):
+        from paddle_tpu import nn
+
+        x = static.data("x", [4, 8], "float32")
+        lin = nn.Linear(8, 8)
+        out = paddle.nn.functional.softmax(lin(x) * 37.0).sum()
+        feed = {"x": np.random.RandomState(2).randn(4, 8)
+                .astype(np.float32)}
+        exe = static.Executor()
+        base = exe.run(feed=feed, fetch_list=[out])[0]
+        (o1,) = PassManager([new_pass("auto_parallel_amp")]).apply([out])
+        got1 = exe.run(feed=feed, fetch_list=[o1])[0]
+        (o2,) = PassManager([new_pass(
+            "auto_parallel_amp",
+            {"custom_white_list": {"softmax"}})]).apply([out])
+        got2 = exe.run(feed=feed, fetch_list=[o2])[0]
+        np.testing.assert_allclose(got1, base, rtol=5e-2)
+        np.testing.assert_allclose(got2, base, rtol=5e-2)
+        # softmax whitelisted -> computed in bf16 -> different rounding
+        assert not np.array_equal(got1, got2)
+
+    def test_sharding_pass_annotates_params(self, static_mode):
+        import jax
+        from jax.sharding import Mesh
+
+        from paddle_tpu import nn
+
+        if len(jax.devices()) < 8:
+            pytest.skip("needs 8 virtual devices")
+        mesh = Mesh(np.array(jax.devices()[:8]), ("dp",))
+        x = static.data("x", [8, 16], "float32")
+        lin = nn.Linear(16, 16)
+        out = (lin(x) ** 2).sum()
+        feed = {"x": np.random.RandomState(3).randn(8, 16)
+                .astype(np.float32)}
+        exe = static.Executor()
+        base = exe.run(feed=feed, fetch_list=[out])[0]
+        (o_sh,) = PassManager([new_pass(
+            "auto_parallel_sharding",
+            {"stage": 3, "mesh": mesh})]).apply([out])
+        got = exe.run(feed=feed, fetch_list=[o_sh])[0]
+        np.testing.assert_allclose(got, base, rtol=1e-6)
+        # the rewritten DAG contains shard_param constraint nodes
+        names = set()
+
+        def walk(node, seen):
+            if id(node) in seen:
+                return
+            seen.add(id(node))
+            from paddle_tpu.static import graph as G
+            if isinstance(node, G.OpNode):
+                names.add(node.name)
+                for p in node.parents:
+                    walk(p[0] if isinstance(p, tuple) else p, seen)
+
+        walk(o_sh._sym_node[0], set())
+        assert "shard_param" in names
+
+    def test_configure_context(self):
+        from paddle_tpu.distributed.passes import PassManager, new_pass
+
+        pm = PassManager([
+            new_pass("auto_parallel_amp", {"dtype": "bfloat16"}),
+            new_pass("auto_parallel_sharding", {"stage": 2}),
+            new_pass("auto_parallel_gradient_merge", {"k_steps": 4}),
+        ])
+        ctx = pm.configure().attrs
+        assert ctx["amp"]["enable"] and ctx["amp"]["dtype"] == "bfloat16"
+        assert ctx["fsdp_axis"] == "dp" and ctx["sharding_stage"] == 2
+        assert ctx["accumulate_steps"] == 4
+
+    def test_engine_composes_through_pass_manager(self):
+        import paddle_tpu.distributed as dist
+        from paddle_tpu import nn, optimizer
+        from paddle_tpu.distributed.auto_parallel.engine import (Engine,
+                                                                 Strategy)
+
+        st = Strategy()
+        st.amp.enable = True
+        st.gradient_merge.enable = True
+        st.gradient_merge.k_steps = 2
+        st.sharding.enable = True
+        st.sharding.stage = 2
+        model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(),
+                              nn.Linear(16, 4))
+        eng = Engine(model=model, loss=nn.CrossEntropyLoss(),
+                     optimizer=optimizer.SGD(
+                         learning_rate=0.1,
+                         parameters=model.parameters()),
+                     strategy=st)
+        xs = np.random.RandomState(0).randn(4, 8).astype(np.float32)
+        ys = np.random.RandomState(1).randint(0, 4, (4, 1))
+        eng.fit([(xs, ys)], epochs=1)
+        assert eng.pass_manager is not None
+        assert eng.pass_manager.names == [
+            "auto_parallel_amp", "auto_parallel_sharding",
+            "auto_parallel_gradient_merge"]
+        assert len(eng.history["loss"]) >= 1
