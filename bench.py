@@ -271,10 +271,11 @@ def main():
             "of_headline": round(tps_s / tokens_per_sec, 3),
         }
 
-        # seq-2048 sub-bench (round-2 weak #1: 0.30 MFU there)
+        # seq-2048 sub-bench (round-2 weak #1: 0.30 MFU there; round-5:
+        # fused single-pass flash bwd + ce-chunks 8 -> 0.667)
         del model, step, ids, labels
         cfg2 = pt.models.gpt3_1p3B(dropout=0.0, attention_dropout=0.0,
-                                   recompute=False, lm_ce_chunks=16)
+                                   recompute=False, lm_ce_chunks=8)
         m2, step2, ids2, labels2 = _build(pt, cfg2, 4, 2048, on_tpu,
                                           opt_kwargs)
         el2, _ = _measure(step2, ids2, labels2, iters)

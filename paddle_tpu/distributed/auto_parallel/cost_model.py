@@ -188,9 +188,10 @@ class ParallelPlanner:
                 pp = rem // mp
                 if max_layers is not None and pp > 1 and max_layers % pp:
                     continue
-                for m in micro_batch_options:
-                    if pp == 1 and m != micro_batch_options[0]:
-                        continue   # micro-batching only matters under pp
+                # micro-batching only matters under pp: pp==1 configs
+                # are scored with m=1 regardless of the option list
+                m_opts = micro_batch_options if pp > 1 else (1,)
+                for m in m_opts:
                     for st in (stages if dp > 1 else (1,)):
                         out.append({"dp": dp, "mp": mp, "pp": pp,
                                     "micro_batches": m,

@@ -1,22 +1,34 @@
-"""Parameter-Server API surface — collective-first stubs (SURVEY §2.4.17;
-reference: python/paddle/distributed/ps/the_one_ps.py, fleet role makers
-python/paddle/distributed/fleet/base/role_maker.py).
+"""Parameter-Server tier (reference: python/paddle/distributed/ps/
+the_one_ps.py, fleet role makers fleet/base/role_maker.py, and the
+table data plane paddle/fluid/distributed/ps/table/).
 
-Design decision (SURVEY-sanctioned): this TPU-native framework is
-collective-first — dense training scales via GSPMD/ICI collectives, and
-the brpc/rocksdb PS transport is intentionally not ported. This package
-keeps the reference's PS-mode *API shape* so PS-style user code imports,
-role-detects, and fails at the server boundary with actionable guidance
-instead of AttributeError.
+TPU-native design: dense training stays collective-first (GSPMD over
+ICI, SURVEY §2.4.17) — but the SPARSE data plane is real: in-memory
+sparse/dense tables with server-side optimizers live behind the in-repo
+rpc agent (data_plane.py replaces brpc/rocksdb), workers pull/push rows
+sharded by `id % n_servers`, and TheOnePSRuntime drives the reference's
+init_server/run_server/init_worker/stop_worker lifecycle over the same
+env contract (TRAINING_ROLE / PADDLE_PSERVERS_IP_PORT_LIST /
+PADDLE_TRAINERS_NUM). Features outside this scope (heter workers, GPU
+PS caches) raise PSGuidanceError with a migration path.
 """
 from __future__ import annotations
 
 import os
 from typing import List, Optional
 
+from .data_plane import (  # noqa: F401
+    DenseTable,
+    PSServer,
+    PSWorker,
+    SparseEmbedding,
+    SparseTable,
+)
+
 __all__ = ["Role", "RoleMakerBase", "PaddleCloudRoleMaker",
            "UserDefinedRoleMaker", "TheOnePSRuntime", "Table", "Accessor",
-           "PSGuidanceError"]
+           "PSGuidanceError", "SparseTable", "DenseTable", "PSServer",
+           "PSWorker", "SparseEmbedding"]
 
 _GUIDE = (
     "parameter-server mode is not supported by this TPU-native framework: "
@@ -122,50 +134,99 @@ class Accessor:
 
 
 class Table:
-    """PS table stub (reference: the_one_ps.py Table): holds schema only;
-    any data-plane call raises with guidance."""
+    """PS table schema (reference: the_one_ps.py Table). `kind` is
+    "sparse" or "dense"; TheOnePSRuntime materializes the data plane
+    from these on init_server."""
 
-    def __init__(self):
-        self.id = -1
-        self.table_class = ""
+    def __init__(self, table_id: int = -1, kind: str = "sparse",
+                 dim: int = 0, shape=None, optimizer: str = "adagrad",
+                 lr: float = 0.01):
+        self.id = table_id
+        self.kind = kind
+        self.table_class = ("MemorySparseTable" if kind == "sparse"
+                            else "MemoryDenseTable")
+        self.dim = dim
+        self.shape = shape
+        self.optimizer = optimizer
+        self.lr = lr
         self.shard_num = -1
         self.accessor = Accessor()
 
-    def pull(self, *a, **k):
-        raise PSGuidanceError("Table.pull")
-
-    def push(self, *a, **k):
-        raise PSGuidanceError("Table.push")
-
 
 class TheOnePSRuntime:
-    """reference: the_one_ps.py TheOnePSRuntime — every runtime entry
-    raises PSGuidanceError so PS training scripts fail fast with a
-    migration path rather than deep in missing attributes."""
+    """reference: the_one_ps.py TheOnePSRuntime — the PS lifecycle over
+    the rpc-backed data plane. One rpc world: trainers are ranks
+    [0, T), servers ranks [T, T+S), names trainer{i} / pserver{j}."""
 
     def __init__(self, role_maker=None):
         self.role_maker = role_maker or PaddleCloudRoleMaker()
         self.tables: List[Table] = []
+        self.server: Optional[PSServer] = None
+        self.worker: Optional[PSWorker] = None
 
-    def _init_server(self, *a, **k):
-        raise PSGuidanceError("init_server")
+    def add_table(self, table: Table):
+        self.tables.append(table)
+        return table
 
-    init_server = _init_server
+    def _world(self):
+        t = self.role_maker.worker_num()
+        s = self.role_maker.server_num()
+        if s < 1:
+            raise PSGuidanceError(
+                "PS runtime needs PADDLE_PSERVERS_IP_PORT_LIST")
+        return t, s
 
-    def _run_server(self, *a, **k):
-        raise PSGuidanceError("run_server")
+    def init_server(self, *a, timeout: Optional[float] = None, **k):
+        from .. import rpc
 
-    run_server = _run_server
+        t, s = self._world()
+        idx = self.role_maker.server_index()
+        self.server = PSServer(idx)
+        for tb in self.tables:
+            if tb.kind == "sparse":
+                self.server.add_sparse_table(tb.id, tb.dim,
+                                             optimizer=tb.optimizer,
+                                             lr=tb.lr)
+            elif tb.id % s == idx:
+                # dense tables live ONLY on their owning server — a
+                # replica on the others would be saved untrained
+                self.server.add_dense_table(tb.id, tb.shape, lr=tb.lr)
+        rpc.init_rpc(f"pserver{idx}", rank=t + idx, world_size=t + s,
+                     timeout=timeout)
 
-    def _init_worker(self, *a, **k):
-        raise PSGuidanceError("init_worker")
+    def run_server(self, *a, **k):
+        if self.server is None:
+            raise PSGuidanceError("run_server before init_server")
+        self.server.run()
 
-    init_worker = _init_worker
+    def init_worker(self, *a, timeout: Optional[float] = None, **k):
+        from .. import rpc
 
-    def _stop_worker(self, *a, **k):
-        raise PSGuidanceError("stop_worker")
+        t, s = self._world()
+        idx = self.role_maker.worker_index()
+        rpc.init_rpc(f"trainer{idx}", rank=idx, world_size=t + s,
+                     timeout=timeout)
+        self.worker = PSWorker(t, s)
+        return self.worker
 
-    stop_worker = _stop_worker
+    def stop_worker(self, *a, **k):
+        if self.worker is not None:
+            self.worker.stop()
 
-    def save_persistables(self, *a, **k):
-        raise PSGuidanceError("save_persistables (PS mode)")
+    def save_persistables(self, dirname: str, *a, **k):
+        """Ask the owning server(s) to snapshot their table shards
+        (reference: the_one_ps.py _save_distributed_persistables).
+        Sparse tables shard over every server; a dense table lives only
+        on server ``table_id % n_servers``."""
+        from .. import rpc
+        from .data_plane import _ps_save
+
+        _, s = self._world()
+        os.makedirs(dirname, exist_ok=True)
+        for tb in self.tables:
+            owners = range(s) if tb.kind == "sparse" else [tb.id % s]
+            for si in owners:
+                rpc.rpc_sync(
+                    f"pserver{si}", _ps_save,
+                    args=(tb.id, os.path.join(
+                        dirname, f"table{tb.id}_shard{si}.npy")))

@@ -1,0 +1,361 @@
+"""Parameter-Server data plane over the rpc agent (reference:
+python/paddle/distributed/ps/the_one_ps.py TheOnePSRuntime,
+paddle/fluid/distributed/ps/table/memory_sparse_table.cc — there the
+tables live behind brpc with rocksdb shards; here they live in server
+process memory behind the in-repo rpc transport
+(distributed/rpc.py), which is the same redesign the FleetExecutor's
+cross-rank bus uses).
+
+Roles follow the reference env contract (TRAINING_ROLE=TRAINER|PSERVER,
+PADDLE_PSERVERS_IP_PORT_LIST, PADDLE_TRAINERS_NUM). All trainers and
+servers join ONE rpc world: trainers are ranks [0, T), servers ranks
+[T, T+S). Sparse rows shard across servers by `id % server_num`.
+
+The data plane is HOST-side by design: sparse tables are a CPU-memory
+construct (the reference's too — rocksdb/brpc), while dense training on
+TPU stays collective-first per SURVEY §2.4.17. SparseEmbedding is an
+eager layer: forward pulls rows, backward pushes per-row grads with a
+registered tape hook.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["SparseTable", "DenseTable", "PSServer", "PSWorker",
+           "SparseEmbedding"]
+
+
+class SparseTable:
+    """In-memory sparse table with lazy row init + per-row optimizer
+    state (reference: memory_sparse_table.cc + the sparse accessors
+    ctr_accessor.cc — sgd/adagrad/adam rules per embedding row)."""
+
+    def __init__(self, dim: int, optimizer: str = "adagrad",
+                 lr: float = 0.01, initializer: str = "uniform",
+                 init_scale: float = 0.01, seed: int = 0,
+                 beta1: float = 0.9, beta2: float = 0.999,
+                 eps: float = 1e-8):
+        if optimizer not in ("sgd", "adagrad", "adam"):
+            raise ValueError(f"unsupported sparse optimizer {optimizer}")
+        self.dim = int(dim)
+        self.optimizer = optimizer
+        self.lr = float(lr)
+        self.initializer = initializer
+        self.init_scale = float(init_scale)
+        self.beta1, self.beta2, self.eps = beta1, beta2, eps
+        self._rows: Dict[int, np.ndarray] = {}
+        self._state: Dict[int, list] = {}
+        self._step: Dict[int, int] = {}
+        self._rng = np.random.default_rng(seed)
+        self._lock = threading.Lock()
+
+    def _init_row(self, rid: int) -> np.ndarray:
+        if self.initializer == "zeros":
+            return np.zeros(self.dim, np.float32)
+        return self._rng.uniform(-self.init_scale, self.init_scale,
+                                 self.dim).astype(np.float32)
+
+    def pull(self, ids) -> np.ndarray:
+        """Rows for ids [n] -> [n, dim]; missing rows are created
+        (reference: pull_sparse with create-on-miss)."""
+        with self._lock:
+            out = np.empty((len(ids), self.dim), np.float32)
+            for i, rid in enumerate(ids):
+                rid = int(rid)
+                row = self._rows.get(rid)
+                if row is None:
+                    row = self._rows[rid] = self._init_row(rid)
+                out[i] = row
+            return out
+
+    def push(self, ids, grads) -> None:
+        """Apply per-row optimizer updates; duplicate ids in one push
+        are accumulated first (the embedding-bag contract)."""
+        grads = np.asarray(grads, np.float32)
+        uniq: Dict[int, np.ndarray] = {}
+        for rid, g in zip(ids, grads):
+            rid = int(rid)
+            if rid in uniq:
+                uniq[rid] = uniq[rid] + g
+            else:
+                uniq[rid] = g.copy()
+        with self._lock:
+            for rid, g in uniq.items():
+                row = self._rows.get(rid)
+                if row is None:
+                    row = self._rows[rid] = self._init_row(rid)
+                if self.optimizer == "sgd":
+                    row -= self.lr * g
+                elif self.optimizer == "adagrad":
+                    st = self._state.setdefault(
+                        rid, [np.zeros(self.dim, np.float32)])
+                    st[0] += g * g
+                    row -= self.lr * g / (np.sqrt(st[0]) + self.eps)
+                else:  # adam
+                    st = self._state.setdefault(
+                        rid, [np.zeros(self.dim, np.float32),
+                              np.zeros(self.dim, np.float32)])
+                    t = self._step.get(rid, 0) + 1
+                    self._step[rid] = t
+                    st[0] = self.beta1 * st[0] + (1 - self.beta1) * g
+                    st[1] = self.beta2 * st[1] + (1 - self.beta2) * g * g
+                    mhat = st[0] / (1 - self.beta1 ** t)
+                    vhat = st[1] / (1 - self.beta2 ** t)
+                    row -= self.lr * mhat / (np.sqrt(vhat) + self.eps)
+
+    def state_dict(self) -> dict:
+        with self._lock:
+            return {"dim": self.dim, "optimizer": self.optimizer,
+                    "rows": {k: v.copy() for k, v in self._rows.items()},
+                    "state": {k: [s.copy() for s in v]
+                              for k, v in self._state.items()},
+                    "step": dict(self._step)}
+
+    def load_state_dict(self, sd: dict) -> None:
+        with self._lock:
+            self._rows = {int(k): np.asarray(v, np.float32)
+                          for k, v in sd["rows"].items()}
+            self._state = {int(k): [np.asarray(s, np.float32) for s in v]
+                           for k, v in sd.get("state", {}).items()}
+            self._step = {int(k): int(v)
+                          for k, v in sd.get("step", {}).items()}
+
+    def __len__(self):
+        return len(self._rows)
+
+
+class DenseTable:
+    """Dense parameter vector with server-side SGD (reference:
+    memory_dense_table.cc)."""
+
+    def __init__(self, shape, lr: float = 0.01, seed: int = 0):
+        self.lr = float(lr)
+        self._value = np.random.default_rng(seed).uniform(
+            -0.01, 0.01, shape).astype(np.float32)
+        self._lock = threading.Lock()
+
+    def pull(self) -> np.ndarray:
+        with self._lock:
+            return self._value.copy()
+
+    def push(self, grad) -> None:
+        with self._lock:
+            self._value -= self.lr * np.asarray(grad, np.float32)
+
+    def set(self, value) -> None:
+        with self._lock:
+            self._value = np.asarray(value, np.float32).copy()
+
+    def state_dict(self) -> dict:
+        with self._lock:
+            return {"value": self._value.copy(), "lr": self.lr}
+
+    def load_state_dict(self, sd: dict) -> None:
+        with self._lock:
+            self._value = np.asarray(sd["value"], np.float32).copy()
+
+    def __len__(self):
+        return int(self._value.size)
+
+
+# ---------------------------------------------------------------- server
+# rpc entry points are module-level (the transport ships the function by
+# reference); the hosting process keeps its tables in this registry
+_TABLES: Dict[int, object] = {}
+
+
+def _ps_pull_sparse(table_id: int, ids):
+    return _TABLES[table_id].pull(ids)
+
+
+def _ps_push_sparse(table_id: int, ids, grads):
+    _TABLES[table_id].push(ids, grads)
+    return True
+
+
+def _ps_pull_dense(table_id: int):
+    return _TABLES[table_id].pull()
+
+
+def _ps_push_dense(table_id: int, grad):
+    _TABLES[table_id].push(grad)
+    return True
+
+
+def _ps_table_size(table_id: int):
+    return len(_TABLES[table_id])
+
+
+def _ps_save(table_id: int, path: str):
+    sd = _TABLES[table_id].state_dict()
+    np.save(path, np.array([sd], dtype=object), allow_pickle=True)
+    return True
+
+
+def _ps_load(table_id: int, path: str):
+    sd = np.load(path, allow_pickle=True)[0]
+    _TABLES[table_id].load_state_dict(sd)
+    return True
+
+
+class PSServer:
+    """One parameter-server process: hosts its table shards behind the
+    rpc agent (reference: the_one_ps.py _init_server/_run_server)."""
+
+    def __init__(self, server_index: Optional[int] = None):
+        self.server_index = server_index if server_index is not None \
+            else int(os.environ.get("PADDLE_PSERVER_ID", "0"))
+
+    def add_sparse_table(self, table_id: int, dim: int, **kw):
+        _TABLES[table_id] = SparseTable(dim,
+                                        seed=1000 + self.server_index,
+                                        **kw)
+
+    def add_dense_table(self, table_id: int, shape, **kw):
+        _TABLES[table_id] = DenseTable(shape, **kw)
+
+    def run(self):
+        """Serve until every trainer has called stop (the rpc shutdown
+        barrier is the serving loop — dispatchers answer pulls/pushes
+        while this blocks)."""
+        from .. import rpc
+
+        rpc.shutdown()  # barriers with the trainers' stop_worker()
+
+    def save(self, table_id: int, path: str):
+        _ps_save(table_id, path)
+
+    def load(self, table_id: int, path: str):
+        _ps_load(table_id, path)
+
+
+class PSWorker:
+    """Trainer-side client: shards requests over the server ranks by
+    `id % n_servers` (reference: the worker side of the_one_ps +
+    fleet.init_worker)."""
+
+    def __init__(self, n_trainers: int, n_servers: int):
+        self.n_trainers = n_trainers
+        self.n_servers = n_servers
+
+    def _server_name(self, s: int) -> str:
+        return f"pserver{s}"
+
+    def pull_sparse(self, table_id: int, ids,
+                    dim: Optional[int] = None) -> np.ndarray:
+        from .. import rpc
+
+        ids = np.asarray(ids, np.int64).ravel()
+        if len(ids) == 0:
+            return np.zeros((0, dim or 0), np.float32)
+        parts: List[np.ndarray] = [None] * self.n_servers  # type: ignore
+        for s in range(self.n_servers):
+            mask = (ids % self.n_servers) == s
+            if mask.any():
+                parts[s] = rpc.rpc_sync(
+                    self._server_name(s), _ps_pull_sparse,
+                    args=(table_id, ids[mask].tolist()))
+        dim = next(p.shape[1] for p in parts if p is not None)
+        out = np.empty((len(ids), dim), np.float32)
+        for s in range(self.n_servers):
+            if parts[s] is not None:
+                out[(ids % self.n_servers) == s] = parts[s]
+        return out
+
+    def push_sparse(self, table_id: int, ids, grads) -> None:
+        from .. import rpc
+
+        ids = np.asarray(ids, np.int64).ravel()
+        grads = np.asarray(grads, np.float32)
+        futs = []
+        for s in range(self.n_servers):
+            mask = (ids % self.n_servers) == s
+            if mask.any():
+                futs.append(rpc.rpc_async(
+                    self._server_name(s), _ps_push_sparse,
+                    args=(table_id, ids[mask].tolist(),
+                          grads[mask])))
+        for f in futs:
+            f.result(timeout=60)
+
+    def pull_dense(self, table_id: int) -> np.ndarray:
+        from .. import rpc
+
+        return rpc.rpc_sync(self._server_name(table_id
+                                              % self.n_servers),
+                            _ps_pull_dense, args=(table_id,))
+
+    def push_dense(self, table_id: int, grad) -> None:
+        from .. import rpc
+
+        rpc.rpc_sync(self._server_name(table_id % self.n_servers),
+                     _ps_push_dense, args=(table_id, np.asarray(grad)))
+
+    def table_size(self, table_id: int) -> int:
+        from .. import rpc
+
+        return sum(rpc.rpc_sync(self._server_name(s), _ps_table_size,
+                                args=(table_id,))
+                   for s in range(self.n_servers))
+
+    def stop(self):
+        """Symmetric with PSServer.run(): barriers everyone out."""
+        from .. import rpc
+
+        rpc.shutdown()
+
+
+class SparseEmbedding:
+    """Eager PS-backed embedding (reference:
+    python/paddle/static/nn/common.py sparse_embedding): forward pulls
+    rows from the sparse table, backward pushes the per-row grads. The
+    TPU compute graph sees a plain dense gather result; the PS hop is
+    host-side, exactly like the reference's heter pipeline."""
+
+    def __init__(self, worker: PSWorker, table_id: int, dim: int):
+        self.worker = worker
+        self.table_id = table_id
+        self.dim = dim
+        # Tensor is __slots__-ed, so the pending pull's ids are tracked
+        # here. Keys are id(out) DISAMBIGUATED by a weakref to the exact
+        # tensor: a finalizer drops the entry when the output dies
+        # (eval loops that never apply_grad must not leak, and a reused
+        # CPython id must not push grads onto someone else's rows).
+        self._pending: Dict[int, tuple] = {}
+
+    def __call__(self, ids):
+        import weakref
+
+        from ...core.tensor import Tensor
+
+        ids_np = np.asarray(
+            ids.numpy() if isinstance(ids, Tensor) else ids, np.int64)
+        flat = ids_np.ravel()
+        rows = self.worker.pull_sparse(self.table_id, flat,
+                                       dim=self.dim)
+        out = Tensor(rows.reshape(ids_np.shape + (self.dim,)),
+                     stop_gradient=False)
+        key = id(out)
+        ref = weakref.ref(out, lambda _r, _k=key, _p=self._pending:
+                          _p.pop(_k, None))
+        self._pending[key] = (ref, flat)
+        return out
+
+    def apply_grad(self, out):
+        """Push `out.grad` (set by backward()) to the table."""
+        if out.grad is None:
+            raise ValueError("backward() has not produced a grad")
+        entry = self._pending.get(id(out))
+        if entry is None or entry[0]() is not out:
+            raise ValueError("apply_grad: tensor was not produced by "
+                             "this SparseEmbedding (or already applied)")
+        del self._pending[id(out)]
+        flat = entry[1]
+        self.worker.push_sparse(
+            self.table_id, flat,
+            np.asarray(out.grad.numpy(), np.float32)
+            .reshape(len(flat), -1))

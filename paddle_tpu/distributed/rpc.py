@@ -191,9 +191,12 @@ _endpoint_stores: Dict[str, object] = {}
 
 
 def init_rpc(name: str, rank: Optional[int] = None,
-             world_size: Optional[int] = None, master_endpoint=None):
+             world_size: Optional[int] = None, master_endpoint=None,
+             timeout: Optional[float] = None):
     """reference: rpc.py:85 — registers this worker and barriers until the
-    full world joined."""
+    full world joined. ``timeout`` bounds the rendezvous (TimeoutError)
+    — pass it when the rest of the world may legitimately never come up
+    (e.g. PS init probing)."""
     global _agent
     import os
 
@@ -222,7 +225,12 @@ def init_rpc(name: str, rank: Optional[int] = None,
     # joined this generation (reference: init_rpc's TCPStore barrier)
     n = store.add("rpc/init_count", 1)
     gen = (n - 1) // world_size + 1
+    deadline = None if timeout is None else time.monotonic() + timeout
     while store.add("rpc/init_count", 0) < gen * world_size:
+        if deadline is not None and time.monotonic() > deadline:
+            raise TimeoutError(
+                f"rpc rendezvous: fewer than {world_size} peers joined "
+                f"generation {gen} within {timeout}s")
         time.sleep(0.02)
     _agent = _RpcAgent(name, rank, world_size, store, gen)
     store.barrier(f"rpc{gen}_ready", world_size, rank)

@@ -276,6 +276,87 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
 
 
+def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      dq_ref, dk_ref, dv_ref, dk_scr, dv_scr, dq_all,
+                      *, scale, causal, block_q, block_k, num_q_blocks,
+                      num_k_blocks, offset):
+    """Single-pass backward: dk, dv AND dq from one (j, i) sweep.
+
+    The two-kernel split recomputes s = q k^T and dp = do v^T in both
+    kernels (7 block matmuls); sharing them here does the ideal 5. dq
+    accumulates across the OUTER j loop, which output windows cannot do
+    on TPU (a revisited block is not re-fetched) — so dq for the whole
+    sequence lives in a VMEM scratch (seq x d f32) and each (b, i)
+    window is flushed at its last j visit. The scratch caps the fused
+    path at moderate sequence lengths; _flash_bwd falls back to the
+    two-kernel split beyond it."""
+    j = pl.program_id(1)   # k block (outer)
+    i = pl.program_id(2)   # q block (sequential inner)
+
+    @pl.when(i == 0)
+    def _init_kv():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    @pl.when(j == 0)
+    def _init_dq():
+        dq_all[pl.ds(i * block_q, block_q), :] = jnp.zeros(
+            (block_q, dq_all.shape[1]), jnp.float32)
+
+    def _body():
+        q = q_ref[0]          # [bq, d]
+        k = k_ref[0]          # [bk, d]
+        v = v_ref[0]
+        do = do_ref[0]        # [bq, d]
+        lse = lse_ref[0]      # [bq, 1]
+        delta = delta_ref[0]  # [bq, 1]
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # [bq, bk]
+        p = jnp.exp(s - lse)
+        if causal:
+            q_pos = i * block_q + offset + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            p = jnp.where(q_pos >= k_pos, p, 0.0)
+        dv_scr[...] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)           # [bq, bk]
+        ds = p * (dp - delta) * scale                     # [bq, bk]
+        dk_scr[...] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dq_all[pl.ds(i * block_q, block_q), :] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        @pl.when((i + 1) * block_q + offset > j * block_k)
+        def _run():
+            _body()
+    else:
+        _body()
+
+    @pl.when(i == num_q_blocks - 1)
+    def _flush_kv():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+    @pl.when(j == num_k_blocks - 1)
+    def _flush_dq():
+        dq_ref[0] = dq_all[pl.ds(i * block_q, block_q), :] \
+            .astype(dq_ref.dtype)
+
+
+# dq scratch cap for the fused backward: seq * d * 4 bytes of VMEM
+_FUSED_BWD_MAX_SEQ_D = 8192 * 128
+
+
 def _flash_bwd(q, k, v, out, lse, do, causal, scale, block_q, block_k,
                interpret):
     bh, sq, d = q.shape
@@ -283,6 +364,70 @@ def _flash_bwd(q, k, v, out, lse, do, causal, scale, block_q, block_k,
     block_q = _fit_block(block_q, sq)
     block_k = _fit_block(block_k, sk)
     nq, nk = sq // block_q, sk // block_k
+
+    if sq == sk and sq * d <= _FUSED_BWD_MAX_SEQ_D:
+        return _flash_bwd_fused(q, k, v, out, lse, do, causal, scale,
+                                block_q, block_k, nq, nk, interpret)
+    return _flash_bwd_split(q, k, v, out, lse, do, causal, scale,
+                            block_q, block_k, nq, nk, interpret)
+
+
+def _flash_bwd_fused(q, k, v, out, lse, do, causal, scale, block_q,
+                     block_k, nq, nk, interpret):
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1, keepdims=True)  # [bh, sq, 1]
+    block_shapes = [
+        (1, block_q, d), (1, block_k, d), (1, block_k, d),
+        (1, block_q, d), (1, block_q, 1), (1, block_q, 1),
+    ]
+    maps = [
+        lambda b, j, i: (b, i, 0),
+        lambda b, j, i: (b, j, 0),
+        lambda b, j, i: (b, j, 0),
+        lambda b, j, i: (b, i, 0),
+        lambda b, j, i: (b, i, 0),
+        lambda b, j, i: (b, i, 0),
+    ]
+    compiler_params = None
+    if not interpret:
+        compiler_params = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary", "arbitrary"))
+    kernel = functools.partial(
+        _bwd_fused_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, num_q_blocks=nq,
+        num_k_blocks=nk, offset=sk - sq)
+    dq, dk, dv = pl.pallas_call(
+        kernel,
+        grid=(bh, nk, nq),
+        in_specs=[pl.BlockSpec(s, m)
+                  for s, m in zip(block_shapes, maps)],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((sq, d), jnp.float32),
+        ],
+        compiler_params=compiler_params,
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+def _flash_bwd_split(q, k, v, out, lse, do, causal, scale, block_q,
+                     block_k, nq, nk, interpret):
+    bh, sq, d = q.shape
+    sk = k.shape[1]
 
     # delta = rowsum(do * o): cheap XLA reduction, feeds both kernels
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),

@@ -1,0 +1,176 @@
+"""Parameter-Server data plane (VERDICT r4 missing #6; reference:
+python/paddle/distributed/ps/the_one_ps.py + the table tier
+paddle/fluid/distributed/ps/table/memory_sparse_table.cc — here
+re-based on the in-repo rpc agent instead of brpc/rocksdb)."""
+import os
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.ps import (DenseTable, SparseEmbedding,
+                                       SparseTable)
+
+
+class TestSparseTable:
+    def test_lazy_init_and_pull(self):
+        t = SparseTable(dim=4, optimizer="sgd", lr=0.5, seed=0)
+        rows = t.pull([7, 7, 3])
+        assert rows.shape == (3, 4)
+        np.testing.assert_array_equal(rows[0], rows[1])
+        assert len(t) == 2
+        # pulls are stable until a push
+        again = t.pull([3])
+        np.testing.assert_array_equal(again[0], rows[2])
+
+    def test_sgd_push_moves_rows(self):
+        t = SparseTable(dim=3, optimizer="sgd", lr=0.1,
+                        initializer="zeros")
+        g = np.ones((1, 3), np.float32)
+        t.push([5], g)
+        np.testing.assert_allclose(t.pull([5])[0], -0.1 * np.ones(3),
+                                   rtol=1e-6)
+
+    def test_duplicate_ids_accumulate(self):
+        """The embedding-bag contract: two grads for one id in a push
+        apply as their SUM (reference: push_sparse merge)."""
+        t = SparseTable(dim=2, optimizer="sgd", lr=1.0,
+                        initializer="zeros")
+        t.push([9, 9], np.array([[1., 0.], [0., 1.]], np.float32))
+        np.testing.assert_allclose(t.pull([9])[0], [-1.0, -1.0])
+
+    def test_adagrad_scales_by_accumulator(self):
+        t = SparseTable(dim=1, optimizer="adagrad", lr=1.0,
+                        initializer="zeros", eps=0.0)
+        t.push([1], np.array([[2.0]], np.float32))
+        # acc = 4 -> update = 2/sqrt(4) = 1
+        np.testing.assert_allclose(t.pull([1])[0], [-1.0], rtol=1e-5)
+        t.push([1], np.array([[2.0]], np.float32))
+        # acc = 8 -> update = 2/sqrt(8)
+        np.testing.assert_allclose(t.pull([1])[0],
+                                   [-1.0 - 2.0 / np.sqrt(8.0)],
+                                   rtol=1e-5)
+
+    def test_adam_state_and_roundtrip(self, tmp_path):
+        t = SparseTable(dim=2, optimizer="adam", lr=0.01)
+        rng = np.random.default_rng(0)
+        for _ in range(3):
+            t.push([2, 4], rng.normal(size=(2, 2)).astype(np.float32))
+        sd = t.state_dict()
+        t2 = SparseTable(dim=2, optimizer="adam", lr=0.01)
+        t2.load_state_dict(sd)
+        np.testing.assert_array_equal(t.pull([2, 4]), t2.pull([2, 4]))
+        # optimizer state carried over: same push -> same result
+        g = np.ones((1, 2), np.float32)
+        t.push([2], g)
+        t2.push([2], g)
+        np.testing.assert_allclose(t.pull([2]), t2.pull([2]), rtol=1e-6)
+
+    def test_dense_table(self):
+        d = DenseTable((3,), lr=0.5)
+        v0 = d.pull()
+        d.push(np.ones(3, np.float32))
+        np.testing.assert_allclose(d.pull(), v0 - 0.5, rtol=1e-6)
+
+
+class _LocalWorker:
+    """PSWorker shim over a local table (no rpc) for the layer test."""
+
+    def __init__(self, table):
+        self.table = table
+
+    def pull_sparse(self, table_id, ids, dim=None):
+        return self.table.pull(np.asarray(ids).ravel())
+
+    def push_sparse(self, table_id, ids, grads):
+        self.table.push(np.asarray(ids).ravel(), grads)
+
+
+class TestSparseEmbeddingLayer:
+    def test_embedding_regression_learns(self):
+        """Eager PS embedding: pull -> dense loss -> backward -> push;
+        the table rows move to fit the targets."""
+        import paddle_tpu as pt
+
+        table = SparseTable(dim=4, optimizer="adagrad", lr=0.5, seed=3)
+        emb = SparseEmbedding(_LocalWorker(table), table_id=0, dim=4)
+        ids = np.array([[0, 1], [2, 3]], np.int64)
+        target = np.full((2, 2, 4), 0.5, np.float32)
+        losses = []
+        for _ in range(30):
+            out = emb(ids)
+            loss = ((out - pt.to_tensor(target)) ** 2).mean()
+            loss.backward()
+            emb.apply_grad(out)
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < 0.05 * losses[0], (losses[0], losses[-1])
+
+
+def _ps_two_proc_worker():
+    import os
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from paddle_tpu.distributed.ps import (PaddleCloudRoleMaker, Table,
+                                           TheOnePSRuntime)
+
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    os.environ["PADDLE_TRAINERS_NUM"] = "1"
+    os.environ["PADDLE_PSERVERS_IP_PORT_LIST"] = "127.0.0.1:0"
+    if rank == 1:
+        os.environ["TRAINING_ROLE"] = "PSERVER"
+        os.environ["PADDLE_PSERVER_ID"] = "0"
+    else:
+        os.environ["TRAINING_ROLE"] = "TRAINER"
+
+    rt = TheOnePSRuntime(PaddleCloudRoleMaker())
+    rt.add_table(Table(table_id=0, kind="sparse", dim=3,
+                       optimizer="sgd", lr=0.1))
+    rt.add_table(Table(table_id=1, kind="dense", shape=(4,), lr=0.5))
+
+    if rank == 1:
+        rt.init_server()
+        rt.run_server()           # serves until the trainer stops
+        return
+
+    w = rt.init_worker()
+    rows = w.pull_sparse(0, [11, 42])
+    assert rows.shape == (2, 3)
+    w.push_sparse(0, [11], np.ones((1, 3), np.float32))
+    after = w.pull_sparse(0, [11, 42])
+    np.testing.assert_allclose(after[0], rows[0] - 0.1, rtol=1e-5)
+    np.testing.assert_allclose(after[1], rows[1], rtol=1e-6)
+    assert w.table_size(0) == 2
+
+    d0 = w.pull_dense(1)
+    w.push_dense(1, np.ones(4, np.float32))
+    np.testing.assert_allclose(w.pull_dense(1), d0 - 0.5, rtol=1e-5)
+
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        rt.save_persistables(td)
+        assert os.path.exists(os.path.join(td, "table0_shard0.npy"))
+    rt.stop_worker()
+
+
+def test_ps_runtime_two_procs():
+    """1 trainer + 1 pserver over the rpc agent: pull/push sparse +
+    dense, sharded table size, save_persistables, clean lifecycle
+    (reference: the_one_ps.py init/run_server + init/stop_worker)."""
+    from paddle_tpu.distributed.spawn import spawn
+
+    spawn(_ps_two_proc_worker, nprocs=2)
+
+
+def test_ps_guidance_still_raised_for_missing_servers():
+    from paddle_tpu.distributed.ps import (PSGuidanceError,
+                                           TheOnePSRuntime,
+                                           UserDefinedRoleMaker)
+
+    rt = TheOnePSRuntime(UserDefinedRoleMaker(worker_num=1,
+                                              server_endpoints=[]))
+    with pytest.raises(PSGuidanceError):
+        rt.init_worker()

@@ -1,13 +1,17 @@
-"""PS-mode API stubs: PS user code imports, role-detects, and fails at the
-runtime boundary with migration guidance (VERDICT r1 next #9; SURVEY
-§2.4.17 collective-first decision; reference the_one_ps.py)."""
+"""PS-mode surface: role detection, fleet wiring, and the failure
+contract now that the data plane is REAL (r5; reference the_one_ps.py).
+PS user code imports, role-detects, and — when the PS world cannot come
+up — fails BOUNDED and loudly instead of hanging (the r1-era guidance
+stubs raised immediately; the real runtime probes the rendezvous with a
+timeout)."""
 import os
 
 import pytest
 
 from paddle_tpu.distributed import fleet
 from paddle_tpu.distributed.ps import (PaddleCloudRoleMaker, PSGuidanceError,
-                                       Role, Table, UserDefinedRoleMaker)
+                                       Role, SparseTable, Table,
+                                       UserDefinedRoleMaker)
 
 
 def test_role_maker_env_detection(monkeypatch):
@@ -24,26 +28,40 @@ def test_role_maker_env_detection(monkeypatch):
     assert rm.is_worker()
 
 
-def test_ps_fleet_init_and_guided_failure():
+def test_ps_fleet_init_wires_runtime_and_bounds_rendezvous():
+    """fleet.init(is_collective=False) builds the PS runtime; a worker
+    whose PS world never comes up times out loudly instead of hanging
+    (the real-runtime analog of the old guided failure)."""
     rm = UserDefinedRoleMaker(current_id=0, role=Role.WORKER, worker_num=2,
                               server_endpoints=["h1:80"])
     f = fleet.Fleet()
     f.init(role_maker=rm, is_collective=False)
     assert f.is_worker() and not f.is_server()
-    with pytest.raises(PSGuidanceError, match="collective-first"):
-        f.init_worker()
-    with pytest.raises(PSGuidanceError, match="sharding"):
-        f.init_server()
-    with pytest.raises(PSGuidanceError):
-        f.run_server()
-    with pytest.raises(PSGuidanceError):
-        f.stop_worker()
+    with pytest.raises(TimeoutError, match="rendezvous"):
+        f.init_worker(timeout=1.5)
 
 
-def test_table_data_plane_guided():
-    t = Table()
-    t.table_class = "MemorySparseTable"
+def test_ps_missing_servers_still_guided():
+    """No server endpoints configured -> immediate guidance, not a
+    rendezvous attempt."""
+    from paddle_tpu.distributed.ps import TheOnePSRuntime
+
+    rt = TheOnePSRuntime(UserDefinedRoleMaker(worker_num=1,
+                                              server_endpoints=[]))
+    with pytest.raises(PSGuidanceError, match="PSERVERS"):
+        rt.init_worker()
     with pytest.raises(PSGuidanceError):
-        t.pull([1, 2, 3])
-    with pytest.raises(PSGuidanceError):
-        t.push([1, 2, 3], None)
+        rt.run_server()
+
+
+def test_table_schema_materializes_data_plane():
+    """Table is the schema; the data plane behind it is real (r4 verdict
+    missing #6): a sparse table built from the schema pulls/pushes."""
+    import numpy as np
+
+    t = Table(table_id=3, kind="sparse", dim=4, optimizer="sgd", lr=1.0)
+    assert t.table_class == "MemorySparseTable"
+    tab = SparseTable(t.dim, optimizer=t.optimizer, lr=t.lr,
+                      initializer="zeros")
+    tab.push([7], np.ones((1, 4), np.float32))
+    np.testing.assert_allclose(tab.pull([7])[0], -np.ones(4), rtol=1e-6)

@@ -46,22 +46,25 @@ def main():
         rng = np.random.default_rng(0)
         ids = pt.to_tensor(rng.integers(0, cfg.vocab_size, (b, plen))
                            .astype(np.int32))
-        for quant in (None, "int8"):
+        for quant, kv in ((None, None), ("int8", None),
+                          ("int8", "int8"), ("int4", "int8")):
             out = model.generate(ids, max_new_tokens=new,
-                                 weight_quant=quant)   # compile+warm
+                                 weight_quant=quant,
+                                 kv_cache_quant=kv)    # compile+warm
             _ = out.numpy()
             t0 = time.perf_counter()
             out = model.generate(ids, max_new_tokens=new,
-                                 weight_quant=quant)
+                                 weight_quant=quant, kv_cache_quant=kv)
             _ = out.numpy()
             el = time.perf_counter() - t0
-            tag = "" if quant is None else f"_{quant}"
+            tag = ("" if quant is None else f"_{quant}") + \
+                ("" if kv is None else f"_kv{kv[3:]}")
             print(json.dumps({
                 "metric": f"{name}{tag}_decode_tokens_per_sec_chip",
                 "value": round(b * new / el, 1),
                 "unit": "tokens/s",
                 "extra": {"batch": b, "prompt": plen, "new_tokens": new,
-                          "weight_quant": quant,
+                          "weight_quant": quant, "kv_cache_quant": kv,
                           "ms_per_token_step": round(el / new * 1000, 2)},
             }), flush=True)
         del model
