@@ -228,6 +228,10 @@ def init_rpc(name: str, rank: Optional[int] = None,
     deadline = None if timeout is None else time.monotonic() + timeout
     while store.add("rpc/init_count", 0) < gen * world_size:
         if deadline is not None and time.monotonic() > deadline:
+            # withdraw our join or the generation arithmetic is poisoned
+            # for every later init against this store (a late peer would
+            # see the count satisfied and hang in the ready barrier)
+            store.add("rpc/init_count", -1)
             raise TimeoutError(
                 f"rpc rendezvous: fewer than {world_size} peers joined "
                 f"generation {gen} within {timeout}s")

@@ -355,3 +355,7 @@ __all__ += ["set_verbosity", "set_code_level"]
 
 
 from . import sot  # noqa: F401,E402
+
+from . import psdb  # noqa: F401,E402  (reference: paddle.jit.sot.psdb)
+
+__all__ += ["psdb"]

@@ -1,0 +1,133 @@
+"""SOT debugging/escape-hatch helpers (reference:
+python/paddle/jit/sot/psdb.py — assert_true / print / breakgraph /
+fallback / check_no_breakgraph / check_no_fallback / in_sot).
+
+Semantics mapped onto the tensor-boundary SOT design (jit/sot.py):
+
+- ``in_sot()`` — True while a capture trace is running.
+- ``assert_true(cond)`` — a symbolic cond is concretized AND GUARDED:
+  replay re-checks the value on device every call, so the assertion
+  genuinely holds for every replayed execution (stronger than a
+  capture-time-only check).
+- ``print(...)`` — concretizes symbolic Tensor args (un-guarded: the
+  printed value must not pin the compiled path) and prints them. Runs
+  at CAPTURE time; replay never re-enters Python by design, so use it
+  to inspect a trace, not as a per-call logger.
+- ``breakgraph()`` — counts a break on the active capture (the
+  observable the reference's tests assert on). The tensor-boundary
+  design has no bytecode resume point, so no split happens unless a
+  tensor is inspected — documented divergence.
+- ``fallback()`` — aborts the capture: the call (and every future call
+  with the same input signature) runs EAGERLY. This is the escape
+  hatch for impure functions — side effects (random, time, IO) that
+  never touch a tensor dunder are invisible to capture and would be
+  baked into the replayed program; marking the function keeps it
+  correct at eager speed.
+- ``check_no_breakgraph(fn)`` / ``check_no_fallback(fn)`` — decorators
+  asserting the wrapped SOT function captured cleanly.
+"""
+from __future__ import annotations
+
+import builtins
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["assert_true", "print", "breakpoint", "breakgraph",
+           "fallback", "check_no_breakgraph", "check_no_fallback",
+           "in_sot"]
+
+
+class FallbackSignal(Exception):
+    """Raised by fallback() and caught by SOTFunction._capture."""
+
+
+def in_sot() -> bool:
+    from .sot import in_sot_capture
+
+    return in_sot_capture()
+
+
+def assert_true(cond) -> None:
+    from .sot import _active_ctx
+
+    if isinstance(cond, Tensor):
+        from ..static import graph as _g
+
+        if _g.is_symbolic(cond) and _active_ctx is not None:
+            val = _active_ctx.concretize(cond)   # guarded on replay
+        else:
+            val = cond.numpy()
+        cond = bool(np.asarray(val).all())
+    assert cond, "psdb.assert_true failed"
+
+
+def print(*args, **kwargs):  # noqa: A001 - mirrors the reference name
+    from ..static import graph as _g
+    from .sot import _active_ctx
+
+    shown = []
+    for a in args:
+        if isinstance(a, Tensor) and _g.is_symbolic(a) \
+                and _active_ctx is not None:
+            shown.append(_active_ctx.concretize(a, guard=False))
+        elif isinstance(a, Tensor):
+            shown.append(a.numpy())
+        else:
+            shown.append(a)
+    builtins.print(*shown, **kwargs)
+
+
+def breakpoint():
+    builtins.breakpoint()
+
+
+def breakgraph() -> None:
+    from .sot import _active_ctx
+
+    if _active_ctx is not None:
+        _active_ctx.n_subgraphs += 1
+        _active_ctx.forced_breaks += 1
+
+
+def fallback() -> None:
+    from .sot import _active_ctx
+
+    if _active_ctx is not None:
+        raise FallbackSignal()
+
+
+def check_no_breakgraph(fn):
+    """Decorator: fn must capture as ONE graph (no tensor-boundary
+    concretizations, no forced breaks)."""
+    from .sot import SOTFunction
+
+    wrapped = fn if isinstance(fn, SOTFunction) else SOTFunction(fn)
+
+    def checked(*args, **kwargs):
+        before = wrapped.graph_break_count
+        out = wrapped(*args, **kwargs)
+        if wrapped.graph_break_count != before and \
+                wrapped.last_call_dispatches:
+            raise AssertionError(
+                f"{getattr(fn, '__name__', fn)} broke the graph "
+                f"({wrapped.graph_break_count - before} break(s))")
+        return out
+
+    return checked
+
+
+def check_no_fallback(fn):
+    from .sot import SOTFunction
+
+    wrapped = fn if isinstance(fn, SOTFunction) else SOTFunction(fn)
+
+    def checked(*args, **kwargs):
+        out = wrapped(*args, **kwargs)
+        if wrapped.fell_back:
+            raise AssertionError(
+                f"{getattr(fn, '__name__', fn)} fell back to eager")
+        return out
+
+    return checked

@@ -45,6 +45,7 @@ import numpy as np
 from ..core import static_flags
 from ..core.tensor import Tensor
 from ..static import graph as _g
+from .psdb import FallbackSignal as _FallbackSignal
 
 __all__ = ["symbolic_translate", "sot_capture", "in_sot_capture"]
 
@@ -54,20 +55,23 @@ class _CaptureCtx:
         self.feed_values = feed_values      # name -> concrete jax array
         self.guards: List[Tuple[Any, Any]] = []  # (sym_node, value)
         self.n_subgraphs = 1                # the final output program
+        self.forced_breaks = 0              # psdb.breakgraph() count
 
-    def concretize(self, t: Tensor):
+    def concretize(self, t: Tensor, guard: bool = True):
         """Evaluate the recorded prefix producing ``t`` as a compiled
         subgraph (the branch needs the concrete value NOW, mid-capture);
         record the node as a guard. The guard's replay expectation is
         derived later from the fused replay program itself, not from this
-        prefix run — see SOTFunction._capture."""
+        prefix run — see SOTFunction._capture. ``guard=False`` (psdb
+        inspection) evaluates without pinning the path to the value."""
         node = t._sym_node
         run, feed_names, params = _g.trace([node])
         fn = jax.jit(lambda feeds, ps: run(feeds, ps))
         val = fn({k: self.feed_values[k] for k in feed_names},
                  [p._data for p in params])[0]
         val = np.asarray(val)
-        self.guards.append((node, val))
+        if guard:
+            self.guards.append((node, val))
         self.n_subgraphs += 1
         return val
 
@@ -155,8 +159,10 @@ class SOTFunction:
     def __init__(self, fn):
         self._fn = fn
         self._cache: Dict[Any, List[_PathProgram]] = {}
+        self._fallback_sigs: set = set()   # psdb.fallback() signatures
         self.graph_break_count = 0    # capture-time breaks observed
         self.last_call_dispatches = 0  # compiled-program runs last call
+        self.fell_back = False        # last call ran eagerly
         functools.update_wrapper(self, fn)
 
     def __get__(self, instance, owner):
@@ -206,6 +212,8 @@ class SOTFunction:
         static_flags.enabled = True
         try:
             out = self._fn(*sym_args, **sym_kwargs)
+        except _FallbackSignal:
+            return None, None    # psdb.fallback(): caller runs eagerly
         finally:
             static_flags.enabled = prev_static
             _active_ctx = prev_ctx
@@ -220,7 +228,7 @@ class SOTFunction:
             + [t._sym_node for t in sym_leaves]
         run, feed_names, params = _g.trace(fetch_nodes)
         replay_fn = jax.jit(lambda feeds, ps: run(feeds, ps))
-        self.graph_break_count += len(ctx.guards)
+        self.graph_break_count += len(ctx.guards) + ctx.forced_breaks
         prog = _PathProgram(ctx.guards, replay_fn, feed_names, params,
                             (out_treedef, const_leaves), len(sym_leaves),
                             ctx.n_subgraphs)
@@ -239,12 +247,17 @@ class SOTFunction:
             # the global enable_to_static(False) kill switch applies to
             # the SOT route too
             return self._fn(*args, **kwargs)
+        self.fell_back = False
         sig = _sig_of(args, kwargs)
         owner = getattr(self._fn, "__self__", None)
         if owner is not None and hasattr(owner, "training"):
             # train/eval capture different programs (dropout etc.) — same
             # invariant StaticFunction keeps via its cache_key
             sig = sig + (("training", bool(owner.training)),)
+        if sig in self._fallback_sigs:
+            # psdb.fallback() escape hatch: impure functions run eagerly
+            self.fell_back = True
+            return self._fn(*args, **kwargs)
         paths = self._cache.setdefault(sig, [])
         feed_values = self._feed_values(args, kwargs)
         self.last_call_dispatches = 0
@@ -269,6 +282,10 @@ class SOTFunction:
                 break
         if vals is None:
             prog, vals = self._capture(args, kwargs)
+            if prog is None:     # capture aborted via psdb.fallback()
+                self._fallback_sigs.add(sig)
+                self.fell_back = True
+                return self._fn(*args, **kwargs)
             self.last_call_dispatches += 1
             paths.append(prog)
         if paths and paths[0] is not prog:

@@ -338,3 +338,102 @@ class TestSOTHardeningR5:
         np.testing.assert_allclose(
             f(x, {"b": b1, "w": w2}).numpy(), np.full(3, 6.0))
         assert len(f._cache) == 1       # key order doesn't split cache
+
+
+class TestPsdb:
+    """psdb helpers (reference python/paddle/jit/sot/psdb.py) mapped
+    onto the tensor-boundary SOT design."""
+
+    def test_in_sot_and_assert_true_guarded(self):
+        from paddle_tpu.jit import psdb
+        from paddle_tpu.jit.sot import symbolic_translate
+
+        seen = []
+
+        @symbolic_translate
+        def fn(x):
+            seen.append(psdb.in_sot())
+            psdb.assert_true((x >= 0).all())
+            return x * 2
+
+        x = paddle.to_tensor(np.arange(4, dtype=np.float32))
+        out = fn(x)
+        np.testing.assert_allclose(out.numpy(), np.arange(4) * 2)
+        assert seen == [True]
+        assert psdb.in_sot() is False
+        # the assertion became a GUARD: replay re-validates on device
+        assert fn.graph_break_count >= 1
+        out2 = fn(paddle.to_tensor(np.arange(4, dtype=np.float32) + 1))
+        np.testing.assert_allclose(out2.numpy(), (np.arange(4) + 1) * 2)
+
+    def test_fallback_runs_eagerly_every_call(self):
+        """The impure-function escape hatch: side effects that never
+        touch a tensor dunder happen on EVERY call after fallback()."""
+        from paddle_tpu.jit import psdb
+        from paddle_tpu.jit.sot import symbolic_translate
+
+        calls = []
+
+        @symbolic_translate
+        def fn(x):
+            psdb.fallback()
+            calls.append(1)       # impure: must run per call
+            return x + len(calls)
+
+        x = paddle.to_tensor(np.zeros(2, np.float32))
+        a = fn(x)
+        b = fn(x)
+        assert fn.fell_back
+        assert len(calls) == 2
+        assert float(a.numpy()[0]) == 1.0
+        assert float(b.numpy()[0]) == 2.0
+
+    def test_check_no_breakgraph(self):
+        from paddle_tpu.jit import psdb
+
+        @psdb.check_no_breakgraph
+        def clean(x):
+            return x * 3
+
+        x = paddle.to_tensor(np.ones(3, np.float32))
+        np.testing.assert_allclose(clean(x).numpy(), 3 * np.ones(3))
+
+        @psdb.check_no_breakgraph
+        def breaks(x):
+            if float((x.sum())) > 0:     # tensor->python boundary
+                return x * 2
+            return x
+
+        with pytest.raises(AssertionError, match="broke the graph"):
+            breaks(x)
+
+    def test_check_no_fallback(self):
+        from paddle_tpu.jit import psdb
+
+        @psdb.check_no_fallback
+        def falls(x):
+            psdb.fallback()
+            return x
+
+        with pytest.raises(AssertionError, match="fell back"):
+            falls(paddle.to_tensor(np.ones(2, np.float32)))
+
+    def test_psdb_print_does_not_guard(self, capsys):
+        from paddle_tpu.jit import psdb
+        from paddle_tpu.jit.sot import symbolic_translate
+
+        @symbolic_translate
+        def fn(x):
+            y = x * 2
+            psdb.print("y:", y)
+            return y + 1
+
+        x = paddle.to_tensor(np.ones(2, np.float32))
+        out = fn(x)
+        assert "y:" in capsys.readouterr().out
+        np.testing.assert_allclose(out.numpy(), 3 * np.ones(2))
+        # un-guarded: a different VALUE with the same structure replays
+        # the same program (no value pin, no re-capture)
+        out2 = fn(paddle.to_tensor(np.full(2, 5.0, np.float32)))
+        np.testing.assert_allclose(out2.numpy(), 11 * np.ones(2))
+        assert fn.last_call_dispatches == 1
