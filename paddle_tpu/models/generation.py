@@ -861,39 +861,57 @@ def speculative_generate(model, input_ids, max_new_tokens: int = 32,
     return Tensor(toks)
 
 
+def _kv_rows(cache, idx_or_reps, gather):
+    """Beam bookkeeping on either cache representation (plain array or
+    quant dict — every leaf is batch-major): batch-axis gather
+    (parent-beam reorder) or repeat (beam expansion)."""
+    if gather:
+        return jax.tree.map(lambda a: a[idx_or_reps], cache)
+    return jax.tree.map(lambda a: jnp.repeat(a, idx_or_reps, axis=0),
+                        cache)
+
+
 def beam_search(model, input_ids, max_new_tokens: int = 32,
                 num_beams: int = 4, length_penalty: float = 0.0,
-                eos_token_id: Optional[int] = None):
+                eos_token_id: Optional[int] = None, weight_quant=None,
+                kv_cache_quant=None):
     """Compiled beam search over the fused decode path (reference: the
     gather_tree op exists exactly for this — beam parent pointers are
     resolved into sequences at the end, nn/functional/extend.py
-    gather_tree).
+    gather_tree). Supports the same serving quant tiers as generate()
+    (weight_quant int8/int4, kv_cache_quant int8).
 
     Returns token ids [batch, max_new_tokens] of the best beam.
     """
+    if kv_cache_quant not in (None, "int8"):
+        raise ValueError("kv_cache_quant must be None or 'int8'")
+    kv_quant = kv_cache_quant == "int8"
     ad = model.decode_adapter()
     ids = _as_ids(input_ids)
     b, plen = ids.shape
     total = _check_window(ad, plen, max_new_tokens)
     w_now, ad.weights = ad.weights, None  # see generate()
+    w_now = _resolve_weight_quant(model, w_now, weight_quant)
     K = num_beams
     V = ad.vocab_size
 
     cache = _gen_cache(model)
     key_cache = ("beam", b, plen, max_new_tokens, K, length_penalty,
-                 eos_token_id)
+                 eos_token_id, weight_quant, kv_cache_quant)
     fn = cache.get(key_cache)
     if fn is None:
 
         def run(weights, ids):
-            x, ck, cv = ad.prefill(weights, ids, total)
+            weights = _activate_q4(weights)
+            x, ck, cv = ad.prefill(weights, ids, total,
+                                   kv_quant=kv_quant)
             lg0 = jax.nn.log_softmax(
                 ad.logits(weights, x[:, -1]).astype(jnp.float32), axis=-1)
             # seed the beams with the prompt's top-K continuations
             scores0, tok0 = jax.lax.top_k(lg0, K)      # [b, K]
             # expand caches to one row per beam: [L, b*K, T, ...]
-            ck = tuple(jnp.repeat(c, K, axis=0) for c in ck)
-            cv = tuple(jnp.repeat(c, K, axis=0) for c in cv)
+            ck = tuple(_kv_rows(c, K, gather=False) for c in ck)
+            cv = tuple(_kv_rows(c, K, gather=False) for c in cv)
             alive0 = jnp.ones((b, K), bool)
             if eos_token_id is not None:
                 alive0 = tok0 != eos_token_id
@@ -920,8 +938,8 @@ def beam_search(model, input_ids, max_new_tokens: int = 32,
                 # reorder caches by parent beam (per batch row)
                 gidx = (jnp.arange(b)[:, None] * K + parent) \
                     .reshape(b * K)
-                ck = tuple(c[gidx] for c in ck)
-                cv = tuple(c[gidx] for c in cv)
+                ck = tuple(_kv_rows(c, gidx, gather=True) for c in ck)
+                cv = tuple(_kv_rows(c, gidx, gather=True) for c in cv)
                 alive = jnp.take_along_axis(alive, parent, axis=1)
                 lens = jnp.take_along_axis(lens, parent, axis=1)
                 # a live beam grows by its new token (incl. a fresh EOS)

@@ -288,14 +288,16 @@ class LlamaForCausalLM(nn.Layer):
                     kv_cache_quant=kv_cache_quant)
 
     def beam_search(self, input_ids, max_new_tokens=32, num_beams=4,
-                    length_penalty=0.0, eos_token_id=None):
+                    length_penalty=0.0, eos_token_id=None,
+                    weight_quant=None, kv_cache_quant=None):
         """Compiled beam search over the fused decode path (gather_tree
         backtrace). Returns the best beam's ids [b, max_new_tokens]."""
         from .generation import beam_search as _beam
 
         return _beam(self, input_ids, max_new_tokens=max_new_tokens,
                      num_beams=num_beams, length_penalty=length_penalty,
-                     eos_token_id=eos_token_id)
+                     eos_token_id=eos_token_id, weight_quant=weight_quant,
+                     kv_cache_quant=kv_cache_quant)
 
     def decode_adapter(self):
         """Weight-extraction protocol for the model-generic fused decode

@@ -398,3 +398,26 @@ def test_int4_weight_quant_decode():
     with pytest.raises(ValueError):
         m.generate(pt.to_tensor(np.asarray(ids)), max_new_tokens=4,
                    weight_quant="int2")
+
+
+def test_beam_search_quant_tiers():
+    """Beam search rides the same serving quant tiers as generate
+    (weight int8/int4 + int8 KV): results stay close to the fp beam
+    and the quant caches survive the parent-beam reorder gathers."""
+    m, cfg = _model(seed=17)
+    rng = np.random.RandomState(11)
+    ids = pt.to_tensor(rng.randint(0, cfg.vocab_size, (2, 6))
+                       .astype(np.int32))
+    ref = m.beam_search(ids, max_new_tokens=8, num_beams=3).numpy()
+    for wq in ("int8", "int4"):
+        q = m.beam_search(ids, max_new_tokens=8, num_beams=3,
+                          weight_quant=wq,
+                          kv_cache_quant="int8").numpy()
+        assert q.shape == ref.shape
+        assert (q == ref).mean() > 0.6, (wq, q, ref)
+    # beam-1 quant beam search == quant greedy generate (exact contract)
+    b1 = m.beam_search(ids, max_new_tokens=8, num_beams=1,
+                       weight_quant="int8", kv_cache_quant="int8").numpy()
+    g = m.generate(ids, max_new_tokens=8, weight_quant="int8",
+                   kv_cache_quant="int8").numpy()
+    np.testing.assert_array_equal(b1, g)
