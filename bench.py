@@ -123,6 +123,10 @@ def _bench_decode(pt, cfg):
                           "kv_cache_quant": "int8"}),
             ("int4_kv8", {"weight_quant": "int4",
                           "kv_cache_quant": "int8"})):
+        # two-point window 64 vs 192 new tokens: the delta isolates the
+        # 128 decode steps at context 192..320 (per-step cost grows
+        # with context, so both points must share the workload shape —
+        # a wider second point would silently measure a heavier regime)
         t1 = timed_gen(64, **kw)
         t2 = timed_gen(192, **kw)
         per_step = (t2 - t1) / 128
