@@ -29,9 +29,10 @@ from __future__ import annotations
 import json
 import os
 import sys
-import time
 
 import numpy as np
+
+from paddle_tpu.observability import stopwatch as _stopwatch
 
 
 def _peak_flops(device):
@@ -82,10 +83,13 @@ def _measure(step, ids, labels, iters):
     # a device->host scalar read (float()) is the only honest barrier.
     loss = step.run_steps(iters, ids, labels)   # warmup/compile
     float(loss)
-    t0 = time.perf_counter()
-    loss = step.run_steps(iters, ids, labels)
-    float(loss)                                 # d2h barrier
-    return time.perf_counter() - t0, loss
+    # telemetry stopwatch: identical perf_counter window (elapsed is
+    # always measured); the observation lands in the registry only when
+    # telemetry is enabled
+    with _stopwatch("bench.train_window") as sw:
+        loss = step.run_steps(iters, ids, labels)
+        float(loss)                             # d2h barrier
+    return sw.elapsed, loss
 
 
 def _bench_decode(pt, cfg):
@@ -112,10 +116,10 @@ def _bench_decode(pt, cfg):
     def timed_gen(new, **kw):
         out = model.generate(ids, max_new_tokens=new, **kw)
         _ = out.numpy()
-        t0 = time.perf_counter()
-        out = model.generate(ids, max_new_tokens=new, **kw)
-        _ = out.numpy()
-        return time.perf_counter() - t0
+        with _stopwatch("bench.decode_window") as sw:
+            out = model.generate(ids, max_new_tokens=new, **kw)
+            _ = out.numpy()
+        return sw.elapsed
 
     res = {"batch": b, "prompt": plen}
     for tag, kw in (
@@ -140,10 +144,11 @@ def _bench_decode(pt, cfg):
               draft_layers=6, return_stats=True)
     out, _ = speculative_generate(model, ids, max_new_tokens=128, **kw)
     _ = out.numpy()
-    t0 = time.perf_counter()
-    out, st = speculative_generate(model, ids, max_new_tokens=128, **kw)
-    _ = out.numpy()
-    el = time.perf_counter() - t0
+    with _stopwatch("bench.decode_window") as sw:
+        out, st = speculative_generate(model, ids, max_new_tokens=128,
+                                       **kw)
+        _ = out.numpy()
+    el = sw.elapsed
     res["speculative_int8"] = {
         "tokens_per_s_raw": round(b * 128 / el, 1),
         "mean_accepted": round(st["mean_accepted"], 3),
@@ -187,10 +192,10 @@ def _bench_moe():
     def run(n):
         out = chained(x, probs, w1, w2, n=n)
         _ = np.asarray(out[:1, :1])
-        t0 = time.perf_counter()
-        out = chained(x, probs, w1, w2, n=n)
-        _ = np.asarray(out[:1, :1])
-        return time.perf_counter() - t0
+        with _stopwatch("bench.moe_window") as sw:
+            out = chained(x, probs, w1, w2, n=n)
+            _ = np.asarray(out[:1, :1])
+        return sw.elapsed
 
     t1 = run(8)
     t3 = run(24)
@@ -265,9 +270,9 @@ def main():
         float(loss_s)
         xs2 = rng.integers(0, cfg.vocab_size, (iters, batch, seq))
         s_ids2 = pt.to_tensor(xs2, dtype="int64")
-        t0 = time.perf_counter()
-        float(step.run_steps_stream(iters, s_ids2, s_ids2))
-        el_s = time.perf_counter() - t0
+        with _stopwatch("bench.train_window") as sw:
+            float(step.run_steps_stream(iters, s_ids2, s_ids2))
+        el_s = sw.elapsed
         tps_s = batch * seq * iters / el_s
         extra["stream_fresh_data"] = {
             "tokens_per_s": round(tps_s, 1),

@@ -61,6 +61,7 @@ from . import vision  # noqa: F401,E402
 from . import distributed  # noqa: F401,E402
 from . import incubate  # noqa: F401,E402
 from . import metric  # noqa: F401,E402
+from . import observability  # noqa: F401,E402
 from . import profiler  # noqa: F401,E402
 from . import hapi  # noqa: F401,E402
 from .hapi import Model, summary  # noqa: F401,E402

@@ -336,10 +336,51 @@ class Engine:
         return _StagedTrainStep(staged, sched, self.optimizer, micro)
 
     # -------------------------------------------------------------- fit
+    def _record_build_telemetry(self, batch):
+        """Per-compilation accounting (observability/xla_cost.py): AOT
+        cost_analysis of the freshly built train step, keyed by
+        executable, plus the schedule-analytic pipeline bubble when
+        pp>1. Telemetry-enabled path only."""
+        from ... import observability as _obs
+
+        st = self.strategy
+        pp = int(getattr(st.pipeline, "pp_degree", 1))
+        if st.pipeline.enable and pp > 1:
+            vpp = max(int(getattr(st.pipeline, "vpp_degree", 1)), 1)
+            micro = max(int(st.pipeline.accumulate_steps), 1)
+            mode = getattr(st.pipeline, "schedule_mode", "1F1B")
+            bubble = 0.0 if mode in ("ZBH1", "ZeroBubble") else \
+                (pp - 1) / (micro * vpp + pp - 1)
+            _obs.registry.gauge("engine.pp_bubble_fraction").set(bubble)
+        if hasattr(self._step, "lower"):
+            try:
+                # Lowered.cost_analysis() runs XLA's HLO cost model
+                # without building a second executable, so this never
+                # duplicates the train-step compilation.
+                _obs.record_cost_analysis(
+                    "engine.train_step", self._step.lower(*batch))
+            except Exception:
+                pass  # cost model unavailable on this backend
+
+    @staticmethod
+    def _batch_tokens(batch) -> int:
+        """Tokens per step for throughput: [b, s] inputs count b*s
+        elements, anything else counts batch rows."""
+        lead = batch[0]
+        shape = getattr(lead, "shape", None)
+        if shape is None or not len(shape):
+            return 1
+        n = int(shape[0])
+        if len(shape) >= 2:
+            n *= int(shape[1])
+        return n
+
     def fit(self, train_data, epochs=1, batch_size=None,
             steps_per_epoch=None, log_freq=10, verbose=0):
         """reference: engine.py:1529. train_data: DataLoader-like iterable
         of (inputs..., labels) batches."""
+        from ... import observability as _obs
+
         for _ in range(epochs):
             for i, batch in enumerate(train_data):
                 if steps_per_epoch is not None and i >= steps_per_epoch:
@@ -348,8 +389,28 @@ class Engine:
                     (batch,)
                 if self._step is None:
                     self._build(batch)
+                    if _obs.enabled():
+                        self._record_build_telemetry(batch)
+                if not _obs.enabled():
+                    loss = self._step(*batch)
+                    self.history["loss"].append(
+                        float(np.asarray(loss._data)))
+                    continue
+                import time as _time
+
+                t0 = _time.perf_counter()
                 loss = self._step(*batch)
-                self.history["loss"].append(float(np.asarray(loss._data)))
+                loss_f = float(np.asarray(loss._data))  # d2h barrier
+                dt = _time.perf_counter() - t0
+                self.history["loss"].append(loss_f)
+                reg = _obs.registry
+                reg.histogram("engine.step_time").observe(dt)
+                reg.counter("engine.steps").inc()
+                if dt > 0:
+                    reg.gauge("engine.tokens_per_s").set(
+                        self._batch_tokens(batch) / dt)
+                reg.gauge("engine.loss").set(loss_f)
+                _obs.sample_device_memory()
         return self.history
 
     def evaluate(self, eval_data, steps=None):

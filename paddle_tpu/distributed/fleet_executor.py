@@ -30,8 +30,11 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 import warnings
 from typing import Any, Callable, Dict, List, Optional
+
+from .. import observability as _obs
 
 __all__ = ["TaskNode", "Interceptor", "Carrier", "MessageBus",
            "FleetExecutor"]
@@ -161,6 +164,9 @@ class MessageBus:
                 _PENDING.pop(self.executor_id, None)
 
     def send(self, msg: _Msg):
+        if _obs.enabled():
+            _obs.registry.counter(
+                "fleet.messages", tags={"kind": msg.kind}).inc()
         box = self._boxes.get(msg.dst)
         if box is not None:
             box.put(msg)
@@ -217,6 +223,7 @@ class Interceptor(threading.Thread):
         # a source node's "upstream" is the external feeder (id -1)
         ups = list(self.node.upstream) or [-1]
         ready: Dict[int, list] = {u: [] for u in ups}
+        stall_since = None  # inputs ready, downstream credit exhausted
         while not self._stop:
             msg = self.box.get()
             if msg.kind == _Msg.STOP:
@@ -246,6 +253,10 @@ class Interceptor(threading.Thread):
             # downstream has a credit slot
             while ups and all(ready[u] for u in ups) and all(
                     c > 0 for c in self._credits.values()):
+                if stall_since is not None:
+                    _obs.registry.counter("fleet.credit_stall_s").inc(
+                        time.perf_counter() - stall_since)
+                    stall_since = None
                 ins = [ready[u].pop(0) for u in ups]
                 step = ins[0].step
                 out = self.node.fn(*[m.payload for m in ins]) \
@@ -263,6 +274,12 @@ class Interceptor(threading.Thread):
                                            step))
                 else:  # sink
                     self.results.append((step, self.node.task_id, out))
+            if _obs.enabled() and stall_since is None and ups and \
+                    all(ready[u] for u in ups) and any(
+                        c <= 0 for c in self._credits.values()):
+                # ready to fire but blocked on downstream credit — the
+                # pipeline-backpressure time the bubble metric can't see
+                stall_since = time.perf_counter()
 
     def stop(self):
         self._stop = True

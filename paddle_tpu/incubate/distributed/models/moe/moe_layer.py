@@ -29,6 +29,7 @@ import jax.numpy as jnp
 import jax
 
 from paddle_tpu import nn
+from paddle_tpu import observability as _obs
 from paddle_tpu.autograd import PyLayer
 from paddle_tpu.core.autograd import run_op
 from paddle_tpu.core.tensor import Tensor
@@ -147,6 +148,8 @@ class MoELayer(nn.Layer):
             return self._forward_naive(x, orig_shape)
 
         cw, dm = self.gate(x, training=self.training)  # [S, E, C] each
+        if _obs.enabled() and not isinstance(dm._data, jax.core.Tracer):
+            self._record_dispatch_telemetry(x, dm)
         # dispatch: [E, C, M]
         xe = run_op(lambda m_, a: jnp.einsum("sec,sm->ecm", m_, a), [dm, x],
                     name="moe_dispatch")
@@ -157,6 +160,26 @@ class MoELayer(nn.Layer):
                    name="moe_combine")
         return run_op(lambda a: a.reshape(orig_shape), [y],
                       name="moe_reshape_out")
+
+    def _record_dispatch_telemetry(self, x, dm):
+        """Host-side gate telemetry (eager path only — under jit the
+        dispatch mask is a tracer with nothing concrete to read). Load
+        imbalance = max/mean per-expert routed tokens; capacity drops =
+        top-k assignments the [S, E, C] mask had no slot for."""
+        import numpy as np
+
+        mask = np.asarray(dm._data)
+        per_expert = mask.sum(axis=(0, 2))           # [E]
+        routed = float(per_expert.sum())
+        reg = _obs.registry
+        reg.counter("moe.tokens_routed").inc(routed)
+        topk = int(getattr(self.gate, "top_k", self.top_k))
+        reg.counter("moe.capacity_dropped_tokens").inc(
+            max(int(x.shape[0]) * topk - routed, 0.0))
+        mean = float(per_expert.mean())
+        if mean > 0:
+            reg.gauge("moe.expert_load_imbalance").set(
+                float(per_expert.max()) / mean)
 
     # ------------------------------------------------------------------
     def _forward_naive(self, x: Tensor, orig_shape) -> Tensor:

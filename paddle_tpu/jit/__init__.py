@@ -25,6 +25,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from .. import observability as _obs
 from ..core import random as _rng
 from ..core.autograd import no_grad, run_op
 from ..core.tensor import Tensor
@@ -158,6 +159,9 @@ class StaticFunction:
 
             self._fallback_eager = True
             self._fallback_reason = str(e).split("\n", 1)[0]
+            if _obs.enabled():
+                _obs.registry.counter(
+                    "jit.graph_break", tags={"site": "to_static"}).inc()
             warnings.warn(
                 "paddle.jit.to_static: graph break — falling back to eager "
                 f"for {getattr(self._fn, '__qualname__', self._fn)}: "
@@ -181,7 +185,19 @@ class StaticFunction:
                                               key=lambda kv: kv[0])), training)
         try:
             pure = self._pure_cache[cache_key]
+            if _obs.enabled():
+                _obs.registry.counter(
+                    "jit.cache_hit", tags={"site": "to_static"}).inc()
         except (KeyError, TypeError):
+            if _obs.enabled():
+                reg = _obs.registry
+                reg.counter("jit.cache_miss",
+                            tags={"site": "to_static"}).inc()
+                cause = "new_signature" if self._pure_cache \
+                    else "first_call"
+                reg.counter("jit.recompile",
+                            tags={"site": "to_static",
+                                  "cause": cause}).inc()
             pure = self._make_pure(len(params), len(buffers),
                                    len(tensor_inputs), in_treedef,
                                    static_kwargs, training)

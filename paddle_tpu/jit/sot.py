@@ -42,6 +42,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import observability as _obs
 from ..core import static_flags
 from ..core.tensor import Tensor
 from ..static import graph as _g
@@ -281,13 +282,26 @@ class SOTFunction:
                 prog = cand
                 break
         if vals is None:
+            if _obs.enabled():
+                reg = _obs.registry
+                reg.counter("jit.cache_miss", tags={"site": "sot"}).inc()
+                reg.counter("jit.recompile", tags={
+                    "site": "sot",
+                    "cause": "guard_miss" if paths else "new_signature",
+                }).inc()
             prog, vals = self._capture(args, kwargs)
             if prog is None:     # capture aborted via psdb.fallback()
                 self._fallback_sigs.add(sig)
                 self.fell_back = True
+                if _obs.enabled():
+                    _obs.registry.counter(
+                        "jit.graph_break", tags={"site": "sot"}).inc()
                 return self._fn(*args, **kwargs)
             self.last_call_dispatches += 1
             paths.append(prog)
+        elif _obs.enabled():
+            _obs.registry.counter(
+                "jit.cache_hit", tags={"site": "sot"}).inc()
         if paths and paths[0] is not prog:
             # MRU order: a miss re-runs the whole candidate program, so
             # keep the path most likely to match in front

@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec
 
+from .. import observability as _obs
 from ..core import random as _rng
 from ..core.autograd import grad as _autograd_grad
 from ..core.tensor import Tensor
@@ -28,6 +29,21 @@ from ..nn.layer.layers import Layer
 from ..optimizer.optimizer import Optimizer
 
 __all__ = ["TrainStep", "ChunkPrefetcher"]
+
+
+def _count_jit(miss: bool, cause: str = "first_call"):
+    """TrainStep program-cache telemetry (site=train_step): __call__
+    reuses the jitted step (hit); a fresh _build or an unseen run_steps
+    chunk size traces a new program (miss + recompile cause)."""
+    if not _obs.enabled():
+        return
+    reg = _obs.registry
+    if miss:
+        reg.counter("jit.cache_miss", tags={"site": "train_step"}).inc()
+        reg.counter("jit.recompile",
+                    tags={"site": "train_step", "cause": cause}).inc()
+    else:
+        reg.counter("jit.cache_hit", tags={"site": "train_step"}).inc()
 
 
 class ChunkPrefetcher:
@@ -348,10 +364,12 @@ class TrainStep:
         self._pure_step = pure_step
         self._jit_kwargs = dict(kwargs)
         self._multi_jitted = {}
+        _count_jit(miss=True, cause="first_call")
         return jax.jit(pure_step, **kwargs)
 
     # ------------------------------------------------------------------- run
     def __call__(self, *batch):
+        _count_jit(miss=False)
         arrays = self._prepare_batch(batch)
         key = _rng.next_key()
         lr = jnp.asarray(self.optimizer.get_lr(), dtype=jnp.float32)
@@ -413,6 +431,7 @@ class TrainStep:
             return self(*batch)
         if n <= 0:
             raise ValueError(f"run_steps needs n >= 1, got {n}")
+        _count_jit(miss=n not in self._multi_jitted, cause="chunk_size")
         if n not in self._multi_jitted:
             pure = self._pure_step
 
@@ -469,6 +488,8 @@ class TrainStep:
         if n <= 0:
             raise ValueError(f"run_steps_stream needs n >= 1, got {n}")
         cache_key = ("stream", n)
+        _count_jit(miss=cache_key not in self._multi_jitted,
+                   cause="chunk_size")
         if cache_key not in self._multi_jitted:
             pure = self._pure_step
 
@@ -526,11 +547,15 @@ class TrainStep:
         for p, a in zip(self._params, self.param_arrays):
             p._data = a
 
-    def compile(self, *batch):
-        """AOT-lower for inspection/warmup without running."""
+    def lower(self, *batch):
+        """AOT-lower for inspection (cost_analysis) without compiling."""
         arrays = tuple(b._data if isinstance(b, Tensor) else jnp.asarray(b)
                        for b in batch)
         key = _rng.next_key()
         lr = jnp.asarray(self.optimizer.get_lr(), dtype=jnp.float32)
         return self._jitted.lower(key, lr, tuple(self.param_arrays),
-                                  self.opt_state, *arrays).compile()
+                                  self.opt_state, *arrays)
+
+    def compile(self, *batch):
+        """AOT-lower for inspection/warmup without running."""
+        return self.lower(*batch).compile()

@@ -19,12 +19,14 @@ returning a DecodeAdapter (weight extraction + pure-array embed / prefill
 """
 from __future__ import annotations
 
+import time
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import observability as _obs
 from ..core import random as _rng
 from ..core.tensor import Tensor
 
@@ -615,6 +617,14 @@ def _gen_cache(model):
     return cache
 
 
+def _count_cache_lookup(miss: bool):
+    """decode fn-cache hit/miss telemetry (generate / spec / beam share
+    the counters — a miss is a fresh trace + XLA compile)."""
+    if _obs.enabled():
+        _obs.registry.counter(
+            "decode.cache_miss" if miss else "decode.cache_hit").inc()
+
+
 def generate(model, input_ids, max_new_tokens: int = 32,
              temperature: float = 0.0, top_p: Optional[float] = None,
              eos_token_id: Optional[int] = None, weight_quant=None,
@@ -648,20 +658,26 @@ def generate(model, input_ids, max_new_tokens: int = 32,
     w_now, ad.weights = ad.weights, None
     w_now = _resolve_weight_quant(model, w_now, weight_quant)
 
-    cache = _gen_cache(model)
-    key_cache = ("sample", b, plen, max_new_tokens, temperature, top_p,
-                 eos_token_id, weight_quant, kv_cache_quant)
-    fn = cache.get(key_cache)
-    if fn is None:
-        kv_quant = kv_cache_quant == "int8"
+    kv_quant = kv_cache_quant == "int8"
+    telemetry = _obs.enabled()
 
-        def run(weights, ids, key):
+    def make_prefill():
+        def run_prefill(weights, ids, key):
             weights = _activate_q4(weights)
             x, ck, cv = ad.prefill(weights, ids, total,
                                    kv_quant=kv_quant)
             lg0 = ad.logits(weights, x[:, -1])
             key, k0 = jax.random.split(key)
             tok0 = _sample(lg0, k0, temperature, top_p)
+            alive = jnp.ones((b,), bool)
+            if eos_token_id is not None:
+                alive = alive & (tok0 != eos_token_id)
+            return tok0, ck, cv, key, alive
+        return run_prefill
+
+    def make_decode():
+        def run_decode(weights, tok0, ck, cv, key, alive):
+            weights = _activate_q4(weights)
 
             def step(carry, _):
                 tok, pos, ck, cv, key, alive = carry
@@ -674,9 +690,6 @@ def generate(model, input_ids, max_new_tokens: int = 32,
                     alive = alive & (nxt != eos_token_id)
                 return (nxt, pos + 1, ck, cv, key, alive), nxt
 
-            alive = jnp.ones((b,), bool)
-            if eos_token_id is not None:
-                alive = alive & (tok0 != eos_token_id)
             carry = (tok0, jnp.int32(plen), ck, cv, key, alive)
             if max_new_tokens > 1:
                 _, rest = jax.lax.scan(step, carry, None,
@@ -685,12 +698,60 @@ def generate(model, input_ids, max_new_tokens: int = 32,
             else:
                 toks = tok0[None]
             return jnp.swapaxes(toks, 0, 1)   # [b, max_new]
+        return run_decode
 
-        fn = jax.jit(run)
-        cache[key_cache] = fn
+    cache = _gen_cache(model)
+    # the telemetry flag is part of the key: the split two-dispatch path
+    # and the fused one-dispatch path are distinct programs
+    key_cache = ("sample", b, plen, max_new_tokens, temperature, top_p,
+                 eos_token_id, weight_quant, kv_cache_quant, telemetry)
+    entry = cache.get(key_cache)
+    _count_cache_lookup(miss=entry is None)
 
+    if not telemetry:
+        # fused path: the WHOLE generation is one compiled dispatch
+        if entry is None:
+            run_prefill, run_decode = make_prefill(), make_decode()
+
+            def run(weights, ids, key):
+                tok0, ck, cv, key, alive = run_prefill(weights, ids, key)
+                return run_decode(weights, tok0, ck, cv, key, alive)
+
+            entry = cache[key_cache] = jax.jit(run)
+        return Tensor(entry(w_now, ids, _rng.next_key()))
+
+    # telemetry path: prefill and decode compile as SEPARATE dispatches
+    # so the prefill/decode time split is an honest device-time split
+    # (one extra host round-trip per generate call — accepted while
+    # telemetry is on). AOT lower().compile() doubles as the
+    # cost_analysis() source without compiling anything twice.
     key = _rng.next_key()
-    out = fn(w_now, ids, key)
+    if entry is None:
+        pf = jax.jit(make_prefill()).lower(w_now, ids, key).compile()
+        _obs.record_cost_analysis("decode.prefill", pf)
+    else:
+        pf = entry[0]
+    t0 = time.perf_counter()
+    res = jax.block_until_ready(pf(w_now, ids, key))
+    t_prefill = time.perf_counter() - t0
+    if entry is None:
+        df = jax.jit(make_decode()).lower(w_now, *res).compile()
+        _obs.record_cost_analysis("decode.steps", df)
+        cache[key_cache] = (pf, df)
+    else:
+        df = entry[1]
+    t0 = time.perf_counter()
+    out = jax.block_until_ready(df(w_now, *res))
+    t_decode = time.perf_counter() - t0
+
+    reg = _obs.registry
+    reg.histogram("decode.prefill_time").observe(t_prefill)
+    reg.histogram("decode.decode_time").observe(t_decode)
+    reg.histogram("decode.token_latency").observe(
+        t_decode / max_new_tokens)
+    reg.counter("decode.prefill_tokens").inc(b * plen)
+    reg.counter("decode.decode_tokens").inc(b * max_new_tokens)
+    _obs.sample_device_memory()
     return Tensor(out)
 
 
@@ -768,6 +829,7 @@ def speculative_generate(model, input_ids, max_new_tokens: int = 32,
     key_cache = ("spec", b, plen, max_new_tokens, gamma, eos_token_id,
                  weight_quant, kv_cache_quant, draft_key)
     fn = cache.get(key_cache)
+    _count_cache_lookup(miss=fn is None)
     if fn is None:
         W_out = max_new_tokens + gamma + 1
 
@@ -850,6 +912,14 @@ def speculative_generate(model, input_ids, max_new_tokens: int = 32,
         cache[key_cache] = fn
 
     toks, n_iter, n_acc = fn(w_now, dw_now, ids)
+    if _obs.enabled():
+        it = max(int(n_iter), 1)
+        reg = _obs.registry
+        reg.gauge("decode.spec_acceptance_rate").set(
+            float(n_acc) / (it * gamma))
+        reg.gauge("decode.spec_tokens_per_pass").set(
+            1.0 + float(n_acc) / it)
+        reg.counter("decode.decode_tokens").inc(b * max_new_tokens)
     if return_stats:
         # n_iter = active (row, iteration) pairs; n_acc = accepted
         # proposals summed over those pairs
@@ -899,6 +969,7 @@ def beam_search(model, input_ids, max_new_tokens: int = 32,
     key_cache = ("beam", b, plen, max_new_tokens, K, length_penalty,
                  eos_token_id, weight_quant, kv_cache_quant)
     fn = cache.get(key_cache)
+    _count_cache_lookup(miss=fn is None)
     if fn is None:
 
         def run(weights, ids):

@@ -1,0 +1,129 @@
+"""Telemetry exporters: JSON snapshot dump, Prometheus-style text
+exposition, and counter annotations merged into chrome-trace files
+(profiler.export_chrome_tracing output gains ``"ph": "C"`` counter
+events, so the trace viewer shows metrics next to host spans)."""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Optional
+
+from . import memory as _memory
+from .registry import Counter, Gauge, enabled, registry
+
+__all__ = ["snapshot", "dump_json", "prometheus_text",
+           "merge_counters_into_trace"]
+
+
+def snapshot(sample_memory: bool = True) -> dict:
+    """Point-in-time dict of every metric (see registry.snapshot for the
+    shape). Samples device memory first so the snapshot always carries a
+    fresh peak when telemetry is enabled."""
+    if sample_memory and enabled():
+        _memory.sample_device_memory()
+    return registry.snapshot()
+
+
+def dump_json(path: str, sample_memory: bool = True) -> dict:
+    snap = snapshot(sample_memory=sample_memory)
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(snap, f, indent=1, sort_keys=True)
+    return snap
+
+
+# --------------------------------------------------------- prometheus
+def _prom_name(name: str) -> str:
+    return "paddle_tpu_" + name.replace(".", "_").replace("-", "_")
+
+
+def _prom_labels(tags, extra: Optional[dict] = None) -> str:
+    items = list(tags) + sorted((extra or {}).items())
+    if not items:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in items)
+    return "{" + inner + "}"
+
+
+def prometheus_text() -> str:
+    """Prometheus text exposition (format 0.0.4) of the whole registry.
+    Histograms emit cumulative ``_bucket{le=...}`` series plus ``_sum``
+    and ``_count``, counters a ``_total`` series — scrape-ready."""
+    from . import metrics_schema as _schema
+
+    lines = []
+    seen_headers = set()
+
+    def header(metric_name, prom, kind):
+        if prom in seen_headers:
+            return
+        seen_headers.add(prom)
+        sp = _schema.spec(metric_name)
+        if sp:
+            lines.append(f"# HELP {prom} {sp.desc} (unit: {sp.unit})")
+        lines.append(f"# TYPE {prom} {kind}")
+
+    for m in sorted(registry.metrics(), key=lambda m: (m.name, m.tags)):
+        if isinstance(m, Counter):
+            prom = _prom_name(m.name) + "_total"
+            header(m.name, prom, "counter")
+            lines.append(f"{prom}{_prom_labels(m.tags)} {m.value}")
+        elif isinstance(m, Gauge):
+            prom = _prom_name(m.name)
+            header(m.name, prom, "gauge")
+            lines.append(f"{prom}{_prom_labels(m.tags)} {m.value}")
+        else:  # Histogram
+            prom = _prom_name(m.name)
+            header(m.name, prom, "histogram")
+            st = m.state()
+            cum = 0
+            for b in m.boundaries:
+                cum = st["buckets"][f"le_{b:g}"]
+                lines.append(
+                    f"{prom}_bucket"
+                    f"{_prom_labels(m.tags, {'le': f'{b:g}'})} {cum}")
+            lines.append(
+                f"{prom}_bucket"
+                f"{_prom_labels(m.tags, {'le': '+Inf'})} "
+                f"{st['buckets']['le_inf']}")
+            lines.append(f"{prom}_sum{_prom_labels(m.tags)} {st['sum']}")
+            lines.append(
+                f"{prom}_count{_prom_labels(m.tags)} {st['count']}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+# -------------------------------------------------- chrome-trace merge
+def merge_counters_into_trace(path: str) -> bool:
+    """Append the registry's counters/gauges as chrome-trace counter
+    events (``"ph": "C"``) to an exported ``.paddle_trace.json`` file, so
+    chrome://tracing / Perfetto render metric tracks under the host
+    spans. Histograms contribute their count and sum. No-op (False) when
+    telemetry is disabled or the file is unreadable."""
+    if not enabled():
+        return False
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except Exception:
+        return False
+    events = doc.get("traceEvents")
+    if events is None:
+        return False
+    ts = time.time() * 1e6  # chrome trace ts is µs
+    pid = os.getpid()
+    snap = registry.snapshot()
+    for section in ("counters", "gauges"):
+        for full, val in sorted(snap[section].items()):
+            events.append({"ph": "C", "name": full, "pid": pid, "tid": 0,
+                           "ts": ts, "cat": "telemetry",
+                           "args": {"value": val}})
+    for full, st in sorted(snap["histograms"].items()):
+        events.append({"ph": "C", "name": full, "pid": pid, "tid": 0,
+                       "ts": ts, "cat": "telemetry",
+                       "args": {"count": st["count"], "sum": st["sum"]}})
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return True

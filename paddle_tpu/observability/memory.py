@@ -1,0 +1,51 @@
+"""Device memory telemetry: PJRT ``jax.Device.memory_stats()`` with peak
+tracking, falling back to the native allocator counters
+(native/alloc_stats.cc — the analog of phi/core/memory/stats.h) on
+backends that expose no PJRT memory stats (e.g. CPU)."""
+from __future__ import annotations
+
+from typing import Optional
+
+from .registry import enabled, registry
+
+__all__ = ["sample_device_memory"]
+
+
+def _pjrt_stats() -> Optional[dict]:
+    try:
+        import jax
+
+        stats = jax.devices()[0].memory_stats()
+    except Exception:
+        return None
+    if not stats or "bytes_in_use" not in stats:
+        return None
+    in_use = int(stats.get("bytes_in_use", 0))
+    return {"bytes_in_use": in_use,
+            "peak_bytes_in_use": int(
+                stats.get("peak_bytes_in_use", in_use))}
+
+
+def _native_stats() -> dict:
+    try:
+        from ..core import native
+
+        return {"bytes_in_use": int(native.stats_allocated(0)),
+                "peak_bytes_in_use": int(native.stats_peak(0))}
+    except Exception:
+        return {"bytes_in_use": 0, "peak_bytes_in_use": 0}
+
+
+def sample_device_memory() -> Optional[dict]:
+    """Record current/peak device memory into the registry and return the
+    sample (None when telemetry is disabled). The peak gauge is
+    max-tracked over samples, so it survives allocator peak resets
+    between samples as long as one sample saw the high-water mark."""
+    if not enabled():
+        return None
+    stats = _pjrt_stats() or _native_stats()
+    registry.gauge("device.memory_in_use_bytes").set(
+        stats["bytes_in_use"])
+    registry.gauge("device.memory_peak_bytes").set_max(
+        stats["peak_bytes_in_use"])
+    return stats

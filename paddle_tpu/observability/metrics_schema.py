@@ -1,0 +1,129 @@
+"""Central metric-name schema: every metric the framework emits is
+declared HERE, once, with its kind and unit (the analog of the reference's
+fixed stats registry phi/core/memory/stats.h — stat names are compile-time
+identifiers there; here `tools/check_metric_names.py` lints every
+``registry.counter/gauge/histogram("...")`` call site against this table,
+and the README observability section is generated from the same rows).
+
+Adding a metric = add a row here + instrument the call site; the lint run
+in tier-1 (tests/test_metric_names.py) fails on undeclared names, so the
+table cannot rot.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+
+class MetricSpec(NamedTuple):
+    kind: str                      # "counter" | "gauge" | "histogram"
+    unit: str
+    desc: str
+    buckets: Optional[Tuple[float, ...]] = None  # histograms only
+    tags: Tuple[str, ...] = ()     # allowed tag keys
+
+
+# fixed bucket boundaries (seconds) — histograms never grow buckets at
+# runtime, so exposition stays O(1) and mergeable across snapshots
+TIME_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+                0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+                60.0)
+TOKEN_LATENCY_BUCKETS = (1e-5, 2.5e-5, 5e-5, 1e-4, 2.5e-4, 5e-4, 1e-3,
+                         2.5e-3, 5e-3, 0.01, 0.025, 0.05, 0.1, 0.5, 1.0)
+
+METRICS = {
+    # ---- Engine.fit (distributed/auto_parallel/engine.py)
+    "engine.step_time": MetricSpec(
+        "histogram", "s", "wall time per Engine.fit step incl. the "
+        "device->host loss sync", TIME_BUCKETS),
+    "engine.steps": MetricSpec(
+        "counter", "steps", "optimizer steps run by Engine.fit"),
+    "engine.tokens_per_s": MetricSpec(
+        "gauge", "tokens/s", "last-step training throughput (batch "
+        "elements x seq when the input is [b, s], else batch elements)"),
+    "engine.loss": MetricSpec(
+        "gauge", "loss", "last training loss seen by Engine.fit"),
+    "engine.pp_bubble_fraction": MetricSpec(
+        "gauge", "fraction", "schedule-analytic pipeline bubble fraction "
+        "(pp-1)/(m*vpp+pp-1) when pp_degree>1; 0 for zero-bubble"),
+    # ---- fused decode (models/generation.py)
+    "decode.prefill_time": MetricSpec(
+        "histogram", "s", "prefill dispatch wall time per generate() call "
+        "(telemetry-enabled two-phase path)", TIME_BUCKETS),
+    "decode.decode_time": MetricSpec(
+        "histogram", "s", "decode-scan dispatch wall time per generate() "
+        "call", TIME_BUCKETS),
+    "decode.token_latency": MetricSpec(
+        "histogram", "s/token", "per-token decode latency "
+        "(decode_time / decoded tokens)", TOKEN_LATENCY_BUCKETS),
+    "decode.prefill_tokens": MetricSpec(
+        "counter", "tokens", "prompt tokens prefilled"),
+    "decode.decode_tokens": MetricSpec(
+        "counter", "tokens", "tokens produced by the decode scan"),
+    "decode.cache_hit": MetricSpec(
+        "counter", "calls", "generate()/beam/speculative compiled-fn "
+        "cache hits"),
+    "decode.cache_miss": MetricSpec(
+        "counter", "compiles", "generate()/beam/speculative compiled-fn "
+        "cache misses (fresh trace+compile)"),
+    "decode.spec_acceptance_rate": MetricSpec(
+        "gauge", "tokens/iter", "speculative decoding: mean accepted "
+        "draft tokens per verify pass"),
+    "decode.spec_tokens_per_pass": MetricSpec(
+        "gauge", "tokens", "speculative decoding: emitted tokens per "
+        "target forward pass (1 + acceptance)"),
+    # ---- jit caches (jit/__init__.py, jit/sot.py, jit/train_step.py)
+    "jit.cache_hit": MetricSpec(
+        "counter", "calls", "compiled-program cache hits",
+        tags=("site",)),
+    "jit.cache_miss": MetricSpec(
+        "counter", "compiles", "compiled-program cache misses",
+        tags=("site",)),
+    "jit.recompile": MetricSpec(
+        "counter", "compiles", "fresh trace+compile with its cause",
+        tags=("site", "cause")),
+    "jit.graph_break": MetricSpec(
+        "counter", "breaks", "graph breaks (to_static eager fallback / "
+        "SOT guard subgraph splits)", tags=("site",)),
+    # ---- MoE dispatch (incubate moe_layer.py, pallas/moe_dispatch.py)
+    "moe.tokens_routed": MetricSpec(
+        "counter", "tokens", "(token, expert) pairs routed through MoE "
+        "dispatch"),
+    "moe.capacity_dropped_tokens": MetricSpec(
+        "counter", "tokens", "dispatches dropped by capacity limits"),
+    "moe.expert_load_imbalance": MetricSpec(
+        "gauge", "ratio", "max/mean per-expert token load of the last "
+        "dispatch (1.0 = perfectly balanced)"),
+    # ---- FleetExecutor MessageBus (distributed/fleet_executor.py)
+    "fleet.messages": MetricSpec(
+        "counter", "messages", "MessageBus messages sent",
+        tags=("kind",)),
+    "fleet.credit_stall_s": MetricSpec(
+        "counter", "s", "time interceptors spent data-ready but blocked "
+        "on downstream credit"),
+    # ---- device memory (observability/memory.py)
+    "device.memory_in_use_bytes": MetricSpec(
+        "gauge", "bytes", "device bytes in use at last sample "
+        "(jax.Device.memory_stats, native alloc_stats fallback)"),
+    "device.memory_peak_bytes": MetricSpec(
+        "gauge", "bytes", "peak device bytes in use (max over samples)"),
+    # ---- per-compilation XLA cost accounting (observability/xla_cost.py)
+    "xla.flops": MetricSpec(
+        "gauge", "flops", "XLA cost_analysis FLOPs per execution of the "
+        "tagged executable", tags=("executable",)),
+    "xla.bytes_accessed": MetricSpec(
+        "gauge", "bytes", "XLA cost_analysis bytes accessed per "
+        "execution of the tagged executable", tags=("executable",)),
+    # ---- bench harness windows (bench.py, tools/bench_*.py)
+    "bench.train_window": MetricSpec(
+        "histogram", "s", "bench.py timed training window (N chained "
+        "steps, d2h barrier included)", TIME_BUCKETS),
+    "bench.decode_window": MetricSpec(
+        "histogram", "s", "decode bench timed generation window",
+        TIME_BUCKETS),
+    "bench.moe_window": MetricSpec(
+        "histogram", "s", "MoE bench timed window", TIME_BUCKETS),
+}
+
+
+def spec(name: str) -> Optional[MetricSpec]:
+    return METRICS.get(name)

@@ -1,0 +1,299 @@
+"""Process-wide thread-safe metrics registry (counters, gauges,
+histograms with fixed bucket boundaries) addressable by dotted names.
+
+Design contract (the ISSUE's zero-cost-when-disabled rule):
+
+- the module-level ``_enabled`` flag is THE gate. Hot paths check
+  ``observability.enabled()`` (one global read) before touching the
+  registry, so a disabled build does no dict work, no string formatting,
+  no lock acquisition on any hot path;
+- when disabled, the registry hands back a shared no-op instrument, so
+  un-guarded call sites are still safe — just not free;
+- instruments are created on first use and live for the process; a
+  (name, tags) pair always resolves to the same object, so ``inc`` /
+  ``set`` / ``observe`` after the first call are lock-per-instrument
+  (never the registry lock).
+
+Reference analog: phi/core/memory/stats.h keeps fixed-name stat slots
+updated from hot allocator paths; the host tracer keeps spans. This
+registry is the metrics half of that pair for the TPU build.
+"""
+from __future__ import annotations
+
+import bisect
+import os
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+from . import metrics_schema as _schema
+
+__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
+           "registry", "enable", "disable", "enabled", "Stopwatch",
+           "stopwatch"]
+
+_enabled = os.environ.get("PADDLE_TPU_TELEMETRY", "").strip() \
+    not in ("", "0", "false", "False", "off")
+
+
+def enable() -> None:
+    """Turn telemetry on (same effect as PADDLE_TPU_TELEMETRY=1)."""
+    global _enabled
+    _enabled = True
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+_DEFAULT_BUCKETS = _schema.TIME_BUCKETS
+
+
+class Counter:
+    """Monotonic float counter."""
+
+    __slots__ = ("name", "tags", "_value", "_lock")
+
+    def __init__(self, name: str, tags=()):
+        self.name = name
+        self.tags = tags
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, n: float = 1.0) -> None:
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def state(self):
+        return self._value
+
+
+class Gauge:
+    """Last-write-wins value; ``set_max`` keeps a running peak."""
+
+    __slots__ = ("name", "tags", "_value", "_lock")
+
+    def __init__(self, name: str, tags=()):
+        self.name = name
+        self.tags = tags
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = float(v)
+
+    def set_max(self, v: float) -> None:
+        with self._lock:
+            if float(v) > self._value:
+                self._value = float(v)
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def state(self):
+        return self._value
+
+
+class Histogram:
+    """Fixed-boundary histogram (boundaries frozen at creation from the
+    schema — exposition size is constant and snapshots merge)."""
+
+    __slots__ = ("name", "tags", "boundaries", "_counts", "_sum",
+                 "_count", "_min", "_max", "_lock")
+
+    def __init__(self, name: str, tags=(), buckets=None):
+        self.name = name
+        self.tags = tags
+        if buckets is None:
+            sp = _schema.spec(name)
+            buckets = sp.buckets if sp and sp.buckets else _DEFAULT_BUCKETS
+        self.boundaries = tuple(sorted(float(b) for b in buckets))
+        self._counts = [0] * (len(self.boundaries) + 1)  # +inf tail
+        self._sum = 0.0
+        self._count = 0
+        self._min = float("inf")
+        self._max = float("-inf")
+        self._lock = threading.Lock()
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        i = bisect.bisect_left(self.boundaries, v)
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += v
+            self._count += 1
+            if v < self._min:
+                self._min = v
+            if v > self._max:
+                self._max = v
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    @property
+    def avg(self) -> float:
+        return self._sum / self._count if self._count else 0.0
+
+    def state(self):
+        with self._lock:
+            cum, buckets = 0, {}
+            for b, c in zip(self.boundaries, self._counts):
+                cum += c
+                buckets[f"le_{b:g}"] = cum
+            buckets["le_inf"] = cum + self._counts[-1]
+            return {"count": self._count, "sum": self._sum,
+                    "avg": self.avg,
+                    "min": self._min if self._count else 0.0,
+                    "max": self._max if self._count else 0.0,
+                    "buckets": buckets}
+
+
+class _Noop:
+    """Shared instrument handed out while telemetry is disabled."""
+
+    __slots__ = ()
+
+    def inc(self, n: float = 1.0) -> None:
+        pass
+
+    def set(self, v: float) -> None:
+        pass
+
+    def set_max(self, v: float) -> None:
+        pass
+
+    def observe(self, v: float) -> None:
+        pass
+
+    value = 0.0
+    count = 0
+    sum = 0.0
+
+
+_NOOP = _Noop()
+
+_KINDS = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}
+
+
+class MetricsRegistry:
+    """Dotted-name -> instrument map. ``tags`` (a small dict of str->str)
+    key distinct series of the same metric, e.g.
+    ``registry.counter("jit.cache_hit", tags={"site": "sot"})``."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[Tuple[str, Tuple], object] = {}
+
+    @staticmethod
+    def _key(name: str, tags: Optional[dict]) -> Tuple[str, Tuple]:
+        if not tags:
+            return name, ()
+        return name, tuple(sorted((str(k), str(v))
+                                  for k, v in tags.items()))
+
+    def _get_or_create(self, kind: str, name: str, tags, buckets=None):
+        if not _enabled:
+            return _NOOP
+        key = self._key(name, tags)
+        m = self._metrics.get(key)
+        if m is None:
+            with self._lock:
+                m = self._metrics.get(key)
+                if m is None:
+                    cls = _KINDS[kind]
+                    m = cls(name, key[1], buckets) \
+                        if kind == "histogram" else cls(name, key[1])
+                    self._metrics[key] = m
+        return m
+
+    def counter(self, name: str, tags: Optional[dict] = None) -> Counter:
+        return self._get_or_create("counter", name, tags)
+
+    def gauge(self, name: str, tags: Optional[dict] = None) -> Gauge:
+        return self._get_or_create("gauge", name, tags)
+
+    def histogram(self, name: str, tags: Optional[dict] = None,
+                  buckets=None) -> Histogram:
+        return self._get_or_create("histogram", name, tags, buckets)
+
+    def get(self, name: str, tags: Optional[dict] = None):
+        """Existing instrument or None — never creates (read side)."""
+        return self._metrics.get(self._key(name, tags))
+
+    def metrics(self):
+        with self._lock:
+            return list(self._metrics.values())
+
+    def reset(self) -> None:
+        with self._lock:
+            self._metrics.clear()
+
+    # --------------------------------------------------------- snapshot
+    def snapshot(self) -> dict:
+        out = {"telemetry_enabled": _enabled,
+               "unix_time": time.time(),
+               "counters": {}, "gauges": {}, "histograms": {}}
+        for m in self.metrics():
+            full = m.name
+            if m.tags:
+                inner = ",".join(f"{k}={v}" for k, v in m.tags)
+                full = f"{m.name}{{{inner}}}"
+            if isinstance(m, Counter):
+                out["counters"][full] = m.state()
+            elif isinstance(m, Gauge):
+                out["gauges"][full] = m.state()
+            else:
+                out["histograms"][full] = m.state()
+        return out
+
+
+registry = MetricsRegistry()
+
+
+class Stopwatch:
+    """Wall-time window that ALWAYS measures (benches need the elapsed
+    value whether or not telemetry is on) and records into the named
+    histogram only when telemetry is enabled::
+
+        sw = stopwatch("bench.train_window")
+        with sw:
+            run()
+        elapsed = sw.elapsed
+    """
+
+    __slots__ = ("name", "tags", "elapsed", "_t0")
+
+    def __init__(self, name: str, tags: Optional[dict] = None):
+        self.name = name
+        self.tags = tags
+        self.elapsed = 0.0
+        self._t0 = 0.0
+
+    def __enter__(self) -> "Stopwatch":
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.elapsed = time.perf_counter() - self._t0
+        if _enabled and exc[0] is None:
+            registry.histogram(self.name, self.tags).observe(self.elapsed)
+
+
+def stopwatch(name: str, tags: Optional[dict] = None) -> Stopwatch:
+    return Stopwatch(name, tags)

@@ -1,0 +1,70 @@
+"""Per-compilation accounting from XLA ``cost_analysis()`` (FLOPs, bytes
+accessed) keyed by executable name — MFU becomes DERIVABLE from telemetry
+(flops * steps/s / peak_flops) instead of hand-computed in each bench.
+
+``record_cost_analysis`` accepts a ``jax.stages.Compiled`` (what
+``jit(f).lower(...).compile()`` and ``TrainStep.compile()`` return) or
+anything else exposing ``cost_analysis()``."""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+from .registry import enabled, registry
+
+__all__ = ["record_cost_analysis", "compiled_costs", "derive_mfu"]
+
+_lock = threading.Lock()
+_costs: Dict[str, dict] = {}
+
+
+def _flatten(ca):
+    # jax has returned both a dict and a one-element list of dicts
+    # across versions
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    return ca if isinstance(ca, dict) else {}
+
+
+def record_cost_analysis(name: str, compiled) -> Optional[dict]:
+    """Record FLOPs / bytes-accessed of one executable under ``name``.
+    Accepts anything with a ``cost_analysis()`` method — a
+    ``jax.stages.Compiled`` or a ``jax.stages.Lowered`` (the latter runs
+    the HLO cost model without building an executable). Safe to call
+    repeatedly (re-records). Returns the recorded entry, or None if
+    disabled or the backend reports no cost model."""
+    if not enabled():
+        return None
+    try:
+        ca = _flatten(compiled.cost_analysis())
+    except Exception:
+        return None
+    if not ca:
+        return None
+    entry = {"flops": float(ca.get("flops", 0.0)),
+             "bytes_accessed": float(ca.get("bytes accessed", 0.0))}
+    registry.gauge("xla.flops",
+                   tags={"executable": name}).set(entry["flops"])
+    registry.gauge("xla.bytes_accessed",
+                   tags={"executable": name}).set(entry["bytes_accessed"])
+    with _lock:
+        _costs[name] = entry
+    return entry
+
+
+def compiled_costs() -> Dict[str, dict]:
+    """All recorded per-executable costs (copy)."""
+    with _lock:
+        return {k: dict(v) for k, v in _costs.items()}
+
+
+def derive_mfu(name: str, executions_per_s: float,
+               peak_flops: float) -> Optional[float]:
+    """MFU of executable ``name`` at the given execution rate against a
+    peak FLOP/s — the derivable-not-hand-computed path the cost
+    accounting exists for."""
+    with _lock:
+        entry = _costs.get(name)
+    if entry is None or peak_flops <= 0:
+        return None
+    return entry["flops"] * executions_per_s / peak_flops

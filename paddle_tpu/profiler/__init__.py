@@ -269,6 +269,12 @@ class Profiler:
 
     def _export(self, path: str):
         self._collector.dump(path)
+        # unified export: telemetry counters ride along as chrome-trace
+        # counter tracks ("ph": "C") when the registry is live
+        from .. import observability as _obs
+
+        if _obs.enabled():
+            _obs.merge_counters_into_trace(path)
 
     def export(self, path: str, format: str = "json"):
         self._export(path)

@@ -40,9 +40,15 @@ class Benchmark:
         self._step_start = time.perf_counter()
 
     def step(self, num_samples: Optional[int] = None):
-        if not self._running or self._step_start is None:
-            return
         now = time.perf_counter()
+        if self._step_start is None:
+            # step() before begin(): treat this call as the window start
+            # instead of silently reporting zero stats forever
+            self._running = True
+            self._step_start = now
+            return
+        if not self._running:
+            return
         dt = now - self._step_start
         self.step_cost.update(dt)
         if num_samples is not None and dt > 0:
@@ -51,6 +57,9 @@ class Benchmark:
 
     def end(self):
         self._running = False
+        # a stale window start must not leak into the next begin-less
+        # step() sequence as one giant bogus interval
+        self._step_start = None
 
     def step_info(self, unit=None) -> str:
         msg = (f"avg_step_cost: {self.step_cost.avg * 1000:.2f} ms, "

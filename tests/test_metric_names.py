@@ -1,0 +1,53 @@
+"""Tier-1 wiring for tools/check_metric_names.py: every telemetry call
+site in the tree must use a name declared in metrics_schema.METRICS."""
+import importlib.util
+import os
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_lint():
+    path = os.path.join(ROOT, "tools", "check_metric_names.py")
+    spec = importlib.util.spec_from_file_location("check_metric_names",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_all_call_sites_declared():
+    lint = _load_lint()
+    errors = lint.run(ROOT)
+    assert errors == [], "\n".join(errors)
+
+
+def test_lint_catches_undeclared_name(tmp_path):
+    lint = _load_lint()
+    bad = tmp_path / "bad.py"
+    bad.write_text('registry.counter("not.a.declared.metric").inc()\n')
+    errors = []
+    lint.check_file(str(bad), lint._load_schema(ROOT), errors)
+    assert len(errors) == 1
+    assert "not.a.declared.metric" in errors[0]
+
+
+def test_lint_catches_kind_mismatch(tmp_path):
+    lint = _load_lint()
+    bad = tmp_path / "bad.py"
+    # engine.steps is declared as a counter, not a gauge
+    bad.write_text('registry.gauge("engine.steps").set(1)\n')
+    errors = []
+    lint.check_file(str(bad), lint._load_schema(ROOT), errors)
+    assert len(errors) == 1
+    assert "declared as a counter" in errors[0]
+
+
+def test_lint_catches_undeclared_tag_key(tmp_path):
+    lint = _load_lint()
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        'registry.counter("jit.cache_hit", tags={"nope": "x"}).inc()\n')
+    errors = []
+    lint.check_file(str(bad), lint._load_schema(ROOT), errors)
+    assert len(errors) == 1
+    assert "nope" in errors[0]

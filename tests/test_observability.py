@@ -1,0 +1,250 @@
+"""Telemetry registry + instrumented hot paths (observability/)."""
+import json
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import observability as obs
+
+
+@pytest.fixture
+def telemetry():
+    """Enabled, empty registry; leaves telemetry off and empty after."""
+    obs.registry.reset()
+    obs.enable()
+    yield obs.registry
+    obs.disable()
+    obs.registry.reset()
+
+
+# ------------------------------------------------------------ registry
+class TestRegistry:
+    def test_counter(self, telemetry):
+        c = telemetry.counter("engine.steps")
+        c.inc()
+        c.inc(2.5)
+        assert c.value == 3.5
+        # same (name, tags) resolves to the same instrument
+        assert telemetry.counter("engine.steps") is c
+
+    def test_counter_tags_key_distinct_series(self, telemetry):
+        a = telemetry.counter("jit.cache_hit", tags={"site": "sot"})
+        b = telemetry.counter("jit.cache_hit", tags={"site": "to_static"})
+        assert a is not b
+        a.inc()
+        snap = telemetry.snapshot()
+        assert snap["counters"]["jit.cache_hit{site=sot}"] == 1.0
+        assert snap["counters"]["jit.cache_hit{site=to_static}"] == 0.0
+
+    def test_gauge_set_and_set_max(self, telemetry):
+        g = telemetry.gauge("device.memory_peak_bytes")
+        g.set_max(100)
+        g.set_max(50)      # peak keeps the high-water mark
+        assert g.value == 100.0
+        g2 = telemetry.gauge("engine.loss")
+        g2.set(5.0)
+        g2.set(2.0)        # plain set is last-write-wins
+        assert g2.value == 2.0
+
+    def test_histogram_buckets(self, telemetry):
+        h = telemetry.histogram("engine.step_time")
+        # schema-declared boundaries, frozen at creation
+        assert h.boundaries == tuple(obs.metrics_schema.TIME_BUCKETS)
+        for v in (0.0002, 0.0002, 0.3, 100.0):
+            h.observe(v)
+        st = h.state()
+        assert st["count"] == 4
+        assert st["sum"] == pytest.approx(100.3004)
+        assert st["min"] == pytest.approx(0.0002)
+        assert st["max"] == 100.0
+        assert st["buckets"]["le_0.00025"] == 2
+        assert st["buckets"]["le_0.5"] == 3
+        # +inf bucket is cumulative over everything
+        assert st["buckets"]["le_inf"] == 4
+
+    def test_thread_safety_smoke(self, telemetry):
+        c = telemetry.counter("engine.steps")
+        h = telemetry.histogram("engine.step_time")
+
+        def work():
+            for _ in range(1000):
+                c.inc()
+                h.observe(0.001)
+
+        threads = [threading.Thread(target=work) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert c.value == 8000.0
+        assert h.count == 8000
+
+    def test_disabled_is_noop(self):
+        obs.registry.reset()
+        obs.disable()
+        c = obs.registry.counter("engine.steps")
+        c.inc()                       # swallowed by the shared no-op
+        g = obs.registry.gauge("engine.loss")
+        g.set(1.0)
+        assert c is g                 # ONE shared no-op instrument
+        assert obs.registry.get("engine.steps") is None  # nothing created
+        snap = obs.registry.snapshot()
+        assert snap["telemetry_enabled"] is False
+        assert snap["counters"] == {}
+
+    def test_stopwatch_measures_even_when_disabled(self):
+        obs.registry.reset()
+        obs.disable()
+        with obs.stopwatch("bench.train_window") as sw:
+            pass
+        assert sw.elapsed >= 0.0      # benches rely on the elapsed value
+        assert obs.registry.get("bench.train_window") is None
+
+    def test_stopwatch_records_when_enabled(self, telemetry):
+        with obs.stopwatch("bench.train_window") as sw:
+            pass
+        assert sw.elapsed >= 0.0
+        assert telemetry.get("bench.train_window").count == 1
+
+
+# ----------------------------------------------------------- exporters
+class TestExporters:
+    def test_json_snapshot_dump(self, telemetry, tmp_path):
+        telemetry.counter("engine.steps").inc(3)
+        telemetry.histogram("engine.step_time").observe(0.01)
+        path = tmp_path / "telemetry.json"
+        snap = obs.dump_json(str(path))
+        assert snap["counters"]["engine.steps"] == 3.0
+        on_disk = json.loads(path.read_text())
+        assert on_disk["counters"]["engine.steps"] == 3.0
+        assert on_disk["histograms"]["engine.step_time"]["count"] == 1
+        # snapshot always carries a device-memory sample when enabled
+        assert "device.memory_peak_bytes" in on_disk["gauges"]
+
+    def test_prometheus_text(self, telemetry):
+        telemetry.counter("jit.cache_hit", tags={"site": "sot"}).inc(2)
+        telemetry.histogram("engine.step_time").observe(0.01)
+        text = obs.prometheus_text()
+        assert 'paddle_tpu_jit_cache_hit_total{site="sot"} 2.0' in text
+        assert "# TYPE paddle_tpu_engine_step_time histogram" in text
+        assert 'paddle_tpu_engine_step_time_bucket{le="+Inf"} 1' in text
+        assert "paddle_tpu_engine_step_time_count 1" in text
+
+    def test_merge_counters_into_trace(self, telemetry, tmp_path):
+        telemetry.counter("engine.steps").inc(5)
+        trace = tmp_path / "x.paddle_trace.json"
+        trace.write_text(json.dumps({"traceEvents": [
+            {"ph": "X", "name": "span", "ts": 0, "dur": 1}]}))
+        assert obs.merge_counters_into_trace(str(trace))
+        doc = json.loads(trace.read_text())
+        counters = [e for e in doc["traceEvents"] if e["ph"] == "C"]
+        assert any(e["name"] == "engine.steps"
+                   and e["args"]["value"] == 5.0 for e in counters)
+        # original span events survive the merge
+        assert any(e["ph"] == "X" for e in doc["traceEvents"])
+
+    def test_merge_noop_when_disabled(self, tmp_path):
+        obs.disable()
+        trace = tmp_path / "x.json"
+        trace.write_text(json.dumps({"traceEvents": []}))
+        assert obs.merge_counters_into_trace(str(trace)) is False
+
+
+# --------------------------------------------------- hot-path integration
+def _tiny_gpt(train=False):
+    cfg = pt.models.gpt_tiny(dropout=0.0, attention_dropout=0.0)
+    model = pt.models.GPTForCausalLM(cfg)
+    if not train:
+        model.eval()
+    return cfg, model
+
+
+class TestHotPaths:
+    def test_engine_fit_populates_step_metrics(self, telemetry):
+        from paddle_tpu.distributed.auto_parallel.engine import Engine
+
+        cfg, model = _tiny_gpt(train=True)
+        opt = pt.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters())
+        eng = Engine(model=model, optimizer=opt)
+        rng = np.random.default_rng(0)
+        batches = [
+            (pt.to_tensor(rng.integers(0, cfg.vocab_size, (2, 16)),
+                          dtype="int64"),
+             pt.to_tensor(rng.integers(0, cfg.vocab_size, (2, 16)),
+                          dtype="int64"))
+            for _ in range(3)]
+        eng.fit(batches)
+        snap = obs.snapshot()
+        assert snap["histograms"]["engine.step_time"]["count"] == 3
+        assert snap["counters"]["engine.steps"] == 3.0
+        assert snap["gauges"]["engine.tokens_per_s"] > 0
+        assert "engine.loss" in snap["gauges"]
+        # per-compilation cost accounting keyed by executable
+        assert snap["gauges"][
+            "xla.flops{executable=engine.train_step}"] > 0
+        costs = obs.compiled_costs()
+        assert costs["engine.train_step"]["flops"] > 0
+
+    def test_decode_split_and_cache_counters(self, telemetry):
+        cfg, model = _tiny_gpt()
+        rng = np.random.default_rng(1)
+        ids = pt.to_tensor(
+            rng.integers(0, cfg.vocab_size, (2, 8)).astype(np.int32))
+        out = model.generate(ids, max_new_tokens=16)
+        assert tuple(out.shape) == (2, 16)
+        snap1 = obs.snapshot()
+        assert snap1["counters"]["decode.cache_miss"] == 1.0
+        out2 = model.generate(ids, max_new_tokens=16)  # cached program
+        snap2 = obs.snapshot()
+        assert snap2["counters"]["decode.cache_hit"] == 1.0
+        assert snap2["counters"]["decode.cache_miss"] == 1.0
+        # honest prefill/decode split: one observation per generate call
+        assert snap2["histograms"]["decode.prefill_time"]["count"] == 2
+        assert snap2["histograms"]["decode.decode_time"]["count"] == 2
+        assert snap2["histograms"]["decode.token_latency"]["count"] == 2
+        assert snap2["counters"]["decode.prefill_tokens"] == 2 * 8 * 2
+        assert snap2["counters"]["decode.decode_tokens"] == 2 * 16 * 2
+        # the two-phase telemetry programs carry cost accounting
+        assert snap2["gauges"]["xla.flops{executable=decode.prefill}"] > 0
+        np.testing.assert_array_equal(out.numpy(), out2.numpy())
+
+    def test_decode_disabled_path_untouched(self):
+        obs.registry.reset()
+        obs.disable()
+        cfg, model = _tiny_gpt()
+        rng = np.random.default_rng(1)
+        ids = pt.to_tensor(
+            rng.integers(0, cfg.vocab_size, (2, 8)).astype(np.int32))
+        out = model.generate(ids, max_new_tokens=4)
+        assert tuple(out.shape) == (2, 4)
+        assert obs.registry.snapshot()["counters"] == {}
+
+
+# -------------------------------------------- profiler timer fix (ips)
+class TestBenchmarkTimer:
+    def test_step_before_begin_reports_stats(self):
+        from paddle_tpu.profiler.timer import Benchmark
+
+        bm = Benchmark()
+        # reference bug: step() before begin() silently returned forever
+        bm.step(num_samples=4)      # first call opens the window
+        bm.step(num_samples=4)
+        assert bm.step_cost.count == 1
+        assert bm.ips_stat.count == 1
+        assert bm.ips_stat.last > 0
+
+    def test_end_resets_window_start(self):
+        from paddle_tpu.profiler.timer import Benchmark
+
+        bm = Benchmark()
+        bm.begin()
+        bm.step()
+        bm.end()
+        assert bm._step_start is None
+        # next begin-less sequence starts a fresh window instead of one
+        # giant interval spanning the gap
+        bm.step()
+        assert bm.step_cost.count == 1

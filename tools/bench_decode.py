@@ -5,7 +5,6 @@ alongside BENCH')."""
 from __future__ import annotations
 
 import json
-import time
 
 import numpy as np
 
@@ -48,15 +47,20 @@ def main():
                            .astype(np.int32))
         for quant, kv in ((None, None), ("int8", None),
                           ("int8", "int8"), ("int4", "int8")):
+            from paddle_tpu.observability import stopwatch
+
             out = model.generate(ids, max_new_tokens=new,
                                  weight_quant=quant,
                                  kv_cache_quant=kv)    # compile+warm
             _ = out.numpy()
-            t0 = time.perf_counter()
-            out = model.generate(ids, max_new_tokens=new,
-                                 weight_quant=quant, kv_cache_quant=kv)
-            _ = out.numpy()
-            el = time.perf_counter() - t0
+            # same perf_counter window as before; the elapsed value also
+            # lands in the telemetry registry when it is enabled
+            with stopwatch("bench.decode_window") as sw:
+                out = model.generate(ids, max_new_tokens=new,
+                                     weight_quant=quant,
+                                     kv_cache_quant=kv)
+                _ = out.numpy()
+            el = sw.elapsed
             tag = ("" if quant is None else f"_{quant}") + \
                 ("" if kv is None else f"_kv{kv[3:]}")
             print(json.dumps({

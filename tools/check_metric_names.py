@@ -1,0 +1,138 @@
+#!/usr/bin/env python
+"""Metric-name lint: every telemetry call site must use a name declared
+in ``paddle_tpu/observability/metrics_schema.py``.
+
+Walks the source tree (paddle_tpu/, tools/, tests/, bench.py) with
+``ast`` and checks every ``<obj>.counter("...")`` / ``.gauge("...")`` /
+``.histogram("...")`` / ``stopwatch("...")`` call whose first argument
+is a dotted string literal:
+
+  * the name must be a key of ``metrics_schema.METRICS``;
+  * the instrument kind must match the declared kind (a ``stopwatch``
+    records into a histogram);
+  * literal ``tags={...}`` keys must be declared for that metric.
+
+Names built at runtime (non-literal first args) are out of scope — the
+registry itself stays schema-agnostic by design; this lint keeps the
+IN-TREE instrumentation and the README metric table honest. Wired into
+tier-1 via tests/test_metric_names.py.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+# attribute-call spellings -> the schema kind they record into
+_KIND = {"counter": "counter", "gauge": "gauge", "histogram": "histogram",
+         "stopwatch": "histogram", "Stopwatch": "histogram"}
+
+_SKIP_DIRS = {".git", "__pycache__", "build", "dist", ".eggs",
+              "node_modules"}
+
+
+def _iter_py_files(root: str):
+    roots = [os.path.join(root, "paddle_tpu"), os.path.join(root, "tools"),
+             os.path.join(root, "tests")]
+    for r in roots:
+        for dirpath, dirnames, files in os.walk(r):
+            dirnames[:] = [d for d in dirnames if d not in _SKIP_DIRS]
+            for f in sorted(files):
+                if f.endswith(".py"):
+                    yield os.path.join(dirpath, f)
+    bench = os.path.join(root, "bench.py")
+    if os.path.exists(bench):
+        yield bench
+
+
+def _call_kind(func) -> str:
+    if isinstance(func, ast.Attribute) and func.attr in _KIND:
+        return _KIND[func.attr]
+    if isinstance(func, ast.Name) and func.id in ("stopwatch",
+                                                  "Stopwatch"):
+        return "histogram"
+    return ""
+
+
+def _literal_str(node) -> str:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return ""
+
+
+def check_file(path: str, metrics, errors: list):
+    try:
+        with open(path) as f:
+            tree = ast.parse(f.read(), filename=path)
+    except SyntaxError as e:
+        errors.append(f"{path}: unparseable ({e})")
+        return
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        kind = _call_kind(node.func)
+        if not kind:
+            continue
+        name = _literal_str(node.args[0])
+        if "." not in name:
+            # runtime-built or non-metric string: out of lint scope
+            continue
+        spec = metrics.get(name)
+        where = f"{path}:{node.args[0].lineno}"
+        if spec is None:
+            errors.append(
+                f"{where}: metric {name!r} is not declared in "
+                "paddle_tpu/observability/metrics_schema.py")
+            continue
+        if spec.kind != kind:
+            errors.append(
+                f"{where}: metric {name!r} is declared as a {spec.kind} "
+                f"but recorded as a {kind}")
+        for kw in node.keywords:
+            if kw.arg != "tags" or not isinstance(kw.value, ast.Dict):
+                continue
+            for k in kw.value.keys:
+                key = _literal_str(k)
+                if key and key not in spec.tags:
+                    errors.append(
+                        f"{where}: metric {name!r} has no declared tag "
+                        f"key {key!r} (allowed: {spec.tags})")
+
+
+def _load_schema(root: str):
+    # load metrics_schema.py standalone (it only needs the stdlib) so
+    # the lint never drags in jax / the full framework import
+    import importlib.util
+
+    path = os.path.join(root, "paddle_tpu", "observability",
+                        "metrics_schema.py")
+    spec = importlib.util.spec_from_file_location("_pt_metrics_schema",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.METRICS
+
+
+def run(root: str) -> list:
+    metrics = _load_schema(root)
+    errors: list = []
+    for path in _iter_py_files(root):
+        check_file(path, metrics, errors)
+    return errors
+
+
+def main() -> int:
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    errors = run(root)
+    for e in errors:
+        print(e, file=sys.stderr)
+    if errors:
+        print(f"check_metric_names: {len(errors)} undeclared/mismatched "
+              "metric call site(s)", file=sys.stderr)
+        return 1
+    print("check_metric_names: all telemetry call sites match the schema")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
