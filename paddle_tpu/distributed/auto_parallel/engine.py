@@ -380,7 +380,9 @@ class Engine:
         """reference: engine.py:1529. train_data: DataLoader-like iterable
         of (inputs..., labels) batches."""
         from ... import observability as _obs
+        from ...observability import health as _health
 
+        global_step = 0
         for _ in range(epochs):
             for i, batch in enumerate(train_data):
                 if steps_per_epoch is not None and i >= steps_per_epoch:
@@ -388,19 +390,31 @@ class Engine:
                 batch = batch if isinstance(batch, (list, tuple)) else \
                     (batch,)
                 if self._step is None:
-                    self._build(batch)
+                    with _obs.span("engine.build"):
+                        self._build(batch)
                     if _obs.enabled():
                         self._record_build_telemetry(batch)
+                # TrainStep carries its own fused grad-norm health when
+                # the policy was on at build; the staged-pipeline step
+                # has none, so the Engine checks the loss scalar there
+                check_loss = _health.enabled() and not getattr(
+                    self._step, "_health_on", False)
                 if not _obs.enabled():
                     loss = self._step(*batch)
-                    self.history["loss"].append(
-                        float(np.asarray(loss._data)))
+                    loss_f = float(np.asarray(loss._data))
+                    self.history["loss"].append(loss_f)
+                    if check_loss:
+                        _health.record_step(loss_f, source="loss",
+                                            step=global_step)
+                    global_step += 1
                     continue
                 import time as _time
 
                 t0 = _time.perf_counter()
-                loss = self._step(*batch)
-                loss_f = float(np.asarray(loss._data))  # d2h barrier
+                with _obs.span("engine.step",
+                               args={"step": global_step}):
+                    loss = self._step(*batch)
+                    loss_f = float(np.asarray(loss._data))  # d2h barrier
                 dt = _time.perf_counter() - t0
                 self.history["loss"].append(loss_f)
                 reg = _obs.registry
@@ -410,7 +424,14 @@ class Engine:
                     reg.gauge("engine.tokens_per_s").set(
                         self._batch_tokens(batch) / dt)
                 reg.gauge("engine.loss").set(loss_f)
+                _obs.flight_recorder.record("engine.step",
+                                            step=global_step,
+                                            loss=loss_f, dur_s=dt)
                 _obs.sample_device_memory()
+                if check_loss:
+                    _health.record_step(loss_f, source="loss",
+                                        step=global_step)
+                global_step += 1
         return self.history
 
     def evaluate(self, eval_data, steps=None):

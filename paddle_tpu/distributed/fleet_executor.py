@@ -47,14 +47,14 @@ _REGISTRY_LOCK = threading.Lock()
 
 
 def _remote_deliver(executor_id: str, kind: str, src: int, dst: int,
-                    payload, step: int):
+                    payload, step: int, ctx=None):
     """rpc entry point on the receiving rank (reference: message_bus.cc
     DispatchMsgToCarrier)."""
     import numpy as np
 
     if payload is not None and not isinstance(payload, (int, float)):
         payload = np.asarray(payload)
-    msg = _Msg(kind, src, dst, payload, step)
+    msg = _Msg(kind, src, dst, payload, step, ctx)
     with _REGISTRY_LOCK:
         bus = _ACTIVE_BUSES.get(executor_id)
         if bus is None or dst not in bus._boxes:
@@ -69,12 +69,15 @@ class _Msg:
     DATA_IS_USELESS = "DATA_IS_USELESS"
     STOP = "STOP"
 
-    def __init__(self, kind, src, dst, payload=None, step=0):
+    def __init__(self, kind, src, dst, payload=None, step=0, ctx=None):
         self.kind = kind
         self.src = src
         self.dst = dst
         self.payload = payload
         self.step = step
+        # trace context {trace_id, span_id} stamped by MessageBus.send;
+        # rides rpc to the peer rank so its spans join the same trace
+        self.ctx = ctx
 
 
 class TaskNode:
@@ -167,6 +170,11 @@ class MessageBus:
         if _obs.enabled():
             _obs.registry.counter(
                 "fleet.messages", tags={"kind": msg.kind}).inc()
+            if msg.ctx is None:
+                msg.ctx = _obs.current_context()
+            _obs.flight_recorder.record(
+                "fleet.send", msg_kind=msg.kind, src=msg.src,
+                dst=msg.dst, step=msg.step)
         box = self._boxes.get(msg.dst)
         if box is not None:
             box.put(msg)
@@ -199,7 +207,7 @@ class MessageBus:
             payload = np.asarray(payload)
         _rpc.rpc_async(by_rank[dst_rank], _remote_deliver,
                        args=(self.executor_id, msg.kind, msg.src,
-                             msg.dst, payload, msg.step))
+                             msg.dst, payload, msg.step, msg.ctx))
 
 
 class Interceptor(threading.Thread):
@@ -259,21 +267,31 @@ class Interceptor(threading.Thread):
                     stall_since = None
                 ins = [ready[u].pop(0) for u in ups]
                 step = ins[0].step
-                out = self.node.fn(*[m.payload for m in ins]) \
-                    if self.node.fn else ins[0].payload
-                self.steps_run += 1
-                for m in ins:  # return credit upstream (not the feeder)
-                    if m.src >= 0:
-                        self.bus.send(_Msg(_Msg.DATA_IS_USELESS,
-                                           self.node.task_id, m.src))
-                if self.node.downstream:
-                    for d in self.node.downstream:
-                        self._credits[d] -= 1
-                        self.bus.send(_Msg(_Msg.DATA_IS_READY,
-                                           self.node.task_id, d, out,
-                                           step))
-                else:  # sink
-                    self.results.append((step, self.node.task_id, out))
+                # adopt the upstream's trace context: this node's span
+                # (and every message it emits) joins the trace the feed
+                # started, across ranks — the Perfetto stitch point
+                with _obs.activate_context(ins[0].ctx):
+                    with _obs.span("fleet.node", cat="fleet",
+                                   args={"task": self.node.task_id,
+                                         "step": step}):
+                        out = self.node.fn(*[m.payload for m in ins]) \
+                            if self.node.fn else ins[0].payload
+                        self.steps_run += 1
+                        for m in ins:  # return credit upstream (not
+                            if m.src >= 0:  # the feeder)
+                                self.bus.send(
+                                    _Msg(_Msg.DATA_IS_USELESS,
+                                         self.node.task_id, m.src))
+                        if self.node.downstream:
+                            for d in self.node.downstream:
+                                self._credits[d] -= 1
+                                self.bus.send(
+                                    _Msg(_Msg.DATA_IS_READY,
+                                         self.node.task_id, d, out,
+                                         step))
+                        else:  # sink
+                            self.results.append(
+                                (step, self.node.task_id, out))
             if _obs.enabled() and stall_since is None and ups and \
                     all(ready[u] for u in ups) and any(
                         c <= 0 for c in self._credits.values()):
@@ -365,17 +383,22 @@ class FleetExecutor:
             self.carrier.start()
             self._started = True
         self.carrier.results.clear()
-        # feed with backpressure honoring the source's declared depth
-        if self._sources:
-            src = self._sources[0]
-            for step, payload in enumerate(feeds):
-                self.carrier.bus.send(
-                    _Msg(_Msg.DATA_IS_READY, -1, src.task_id, payload,
-                         step))
-        # -1 credits: the source treats feeder credit as infinite
-        if n_results is None:
-            n_results = len(feeds) * len(self._sinks)
-        self.carrier.wait(n_results, timeout)
+        with _obs.span("fleet.run", cat="fleet",
+                       args={"rank": self.rank, "feeds": len(feeds)}):
+            # feed with backpressure honoring the source's declared
+            # depth; sends stamp the fleet.run span's trace context, so
+            # every downstream fire (local or cross-rank) stitches into
+            # one trace per run
+            if self._sources:
+                src = self._sources[0]
+                for step, payload in enumerate(feeds):
+                    self.carrier.bus.send(
+                        _Msg(_Msg.DATA_IS_READY, -1, src.task_id,
+                             payload, step))
+            # -1 credits: the source treats feeder credit as infinite
+            if n_results is None:
+                n_results = len(feeds) * len(self._sinks)
+            self.carrier.wait(n_results, timeout)
         # key on (step, sink id) — deterministic across thread schedules,
         # and payloads (jax arrays) never enter the comparison
         out = sorted(self.carrier.results, key=lambda r: (r[0], r[1]))

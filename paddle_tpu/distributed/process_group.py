@@ -23,11 +23,48 @@ from typing import List, Optional
 
 import numpy as np
 
+from .. import observability as _obs
 from ..core.tensor import Tensor
 from .store import TCPStore
 
 __all__ = ["ReduceOp", "ProcessGroup", "ProcessGroupSingle",
            "ProcessGroupCPU", "Task", "new_process_group_impl"]
+
+
+class _CollectiveWindow:
+    """Watchdog registration + (telemetry-on) tracing span + flight
+    recorder start/finish events around ONE collective. The watchdog
+    half always runs (hang detection is not a metrics feature); the
+    telemetry half is one enabled() check when off."""
+
+    __slots__ = ("op", "gid", "_watch", "_span")
+
+    def __init__(self, op_name: str, gid: int):
+        from . import watchdog
+
+        self.op = op_name
+        self.gid = gid
+        self._watch = watchdog.watch(op_name, gid)
+        self._span = None
+
+    def __enter__(self):
+        self._watch.__enter__()
+        if _obs.enabled():
+            _obs.flight_recorder.record("pg.collective.start",
+                                        op=self.op, group=self.gid)
+            self._span = _obs.span("pg.collective", cat="comm",
+                                   args={"op": self.op,
+                                         "group": self.gid})
+            self._span.__enter__()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if self._span is not None:
+            self._span.__exit__(exc_type, exc, tb)
+            _obs.flight_recorder.record("pg.collective.finish",
+                                        op=self.op, group=self.gid,
+                                        ok=exc_type is None)
+        self._watch.__exit__(exc_type, exc, tb)
 
 
 class ReduceOp:
@@ -96,10 +133,9 @@ class ProcessGroup:
         return f"pg_{self._gid}"
 
     def _watched(self, op_name: str):
-        # comm watchdog span (reference: CommTaskManager watchdog)
-        from . import watchdog
-
-        return watchdog.watch(op_name, self._gid)
+        # comm watchdog + tracing span + flight-recorder window
+        # (reference: CommTaskManager watchdog)
+        return _CollectiveWindow(op_name, self._gid)
 
     # -- buffer access hooks: backends choose host (numpy) or device (jax)
     # residency. The CPU/store backend moves numpy; ProcessGroupXLA

@@ -90,9 +90,19 @@ class _RpcAgent:
                     if msg.get("kind") != "call":
                         continue
                     try:
+                        from .. import observability as _obs
+
                         fn = pickle.loads(msg["fn"])
-                        result = fn(*msg.get("args", ()),
-                                    **msg.get("kwargs", {}))
+                        # adopt the caller's trace context so the
+                        # server-side span joins the caller's trace
+                        with _obs.activate_context(msg.get("ctx")):
+                            with _obs.span(
+                                    "rpc.handle", cat="rpc",
+                                    args={"fn": getattr(
+                                        fn, "__name__", "?"),
+                                        "src": r}):
+                                result = fn(*msg.get("args", ()),
+                                            **msg.get("kwargs", {}))
                         reply = {"ok": True, "value": result}
                     except Exception as e:  # ship the error back
                         reply = {"ok": False,
@@ -169,17 +179,29 @@ class _RpcAgent:
     _call_counter = 0
 
     def call(self, to: str, fn, args, kwargs) -> Future:
+        from .. import observability as _obs
+
         info = self.workers[to]
         with self._lock:
             _RpcAgent._call_counter += 1
             call_id = f"{self.rank}_{_RpcAgent._call_counter}"
             fut: Future = Future()
             self._futures[call_id] = fut
-        self._post(info.rank, {
+        payload = {
             "kind": "call", "call_id": call_id,
             "fn": pickle.dumps(fn, protocol=4),
             "args": args, "kwargs": kwargs,
-        })
+        }
+        if _obs.enabled():
+            # stamp the caller's trace context; the peer's dispatcher
+            # adopts it, stitching client and server spans
+            payload["ctx"] = _obs.current_context()
+            with _obs.span("rpc.call", cat="rpc",
+                           args={"to": to, "fn": getattr(
+                               fn, "__name__", "?")}):
+                self._post(info.rank, payload)
+        else:
+            self._post(info.rank, payload)
         return fut
 
     def stop(self):

@@ -92,8 +92,32 @@ class CommTaskManager:
         import sys
 
         print(f"[comm-watchdog] TIMEOUT: {task}", file=sys.stderr)
-        for t in self.in_flight():
+        in_flight = self.in_flight()
+        for t in in_flight:
             print(f"[comm-watchdog]   in-flight: {t}", file=sys.stderr)
+        # full post-mortem BEFORE the abort callback (default os._exit
+        # would otherwise take every diagnostic with it): metrics
+        # snapshot + flight-recorder ring + span trace + the in-flight
+        # CommTask table land under $PADDLE_TPU_DUMP_DIR
+        try:
+            from ..observability import flight_recorder
+
+            d = flight_recorder.default_dump_dir()
+            if d:
+                rank = os.environ.get("PADDLE_TRAINER_ID", "0")
+                bundle = os.path.join(
+                    d, f"watchdog_rank{rank}_pid{os.getpid()}")
+                out = flight_recorder.dump_debug_bundle(
+                    bundle, reason=f"comm watchdog timeout: {task!r}",
+                    extra={"timed_out": repr(task),
+                           "in_flight": [repr(t) for t in in_flight]})
+                if out:
+                    print(f"[comm-watchdog] debug bundle: {out}",
+                          file=sys.stderr)
+        except Exception:
+            import traceback
+
+            traceback.print_exc()
 
     def _default_abort(self, task: CommTask):
         # reference AbortComm: tear the process down so the launcher's

@@ -162,6 +162,9 @@ class StaticFunction:
             if _obs.enabled():
                 _obs.registry.counter(
                     "jit.graph_break", tags={"site": "to_static"}).inc()
+                _obs.flight_recorder.record(
+                    "jit.graph_break", site="to_static",
+                    reason=self._fallback_reason)
             warnings.warn(
                 "paddle.jit.to_static: graph break — falling back to eager "
                 f"for {getattr(self._fn, '__qualname__', self._fn)}: "
@@ -198,6 +201,8 @@ class StaticFunction:
                 reg.counter("jit.recompile",
                             tags={"site": "to_static",
                                   "cause": cause}).inc()
+                _obs.flight_recorder.record(
+                    "jit.cache_miss", site="to_static", cause=cause)
             pure = self._make_pure(len(params), len(buffers),
                                    len(tensor_inputs), in_treedef,
                                    static_kwargs, training)

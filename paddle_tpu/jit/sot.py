@@ -289,6 +289,9 @@ class SOTFunction:
                     "site": "sot",
                     "cause": "guard_miss" if paths else "new_signature",
                 }).inc()
+                _obs.flight_recorder.record(
+                    "jit.cache_miss", site="sot",
+                    cause="guard_miss" if paths else "new_signature")
             prog, vals = self._capture(args, kwargs)
             if prog is None:     # capture aborted via psdb.fallback()
                 self._fallback_sigs.add(sig)

@@ -22,6 +22,7 @@ from jax.sharding import NamedSharding, PartitionSpec
 
 from .. import observability as _obs
 from ..core import random as _rng
+from ..observability import health as _health
 from ..core.autograd import grad as _autograd_grad
 from ..core.tensor import Tensor
 from ..distributed.auto_parallel.constraint import filtered_spec, param_spec
@@ -42,6 +43,8 @@ def _count_jit(miss: bool, cause: str = "first_call"):
         reg.counter("jit.cache_miss", tags={"site": "train_step"}).inc()
         reg.counter("jit.recompile",
                     tags={"site": "train_step", "cause": cause}).inc()
+        _obs.flight_recorder.record("jit.cache_miss", site="train_step",
+                                    cause=cause)
     else:
         reg.counter("jit.cache_hit", tags={"site": "train_step"}).inc()
 
@@ -256,6 +259,10 @@ class TrainStep:
         process_mesh = self._process_mesh
 
         accumulate = self.accumulate_steps
+        # health policy is compiled INTO the program (loss-scaler
+        # found_inf analog): capture it at build time so the traced step
+        # is deterministic regardless of later env changes
+        health_on = self._health_on = _health.enabled()
 
         def fwd_bwd(key, param_arrays, *batch):
             from ..distributed.auto_parallel.process_mesh import get_mesh, set_mesh
@@ -317,9 +324,12 @@ class TrainStep:
                 loss_val = (l_sum / accumulate).astype(jnp.float32)
             else:
                 loss_val, grad_arrays = fwd_bwd(key, param_arrays, *batch)
+            gnorm = None
+            if clip is not None or health_on:
+                # ONE fused whole-model reduction, shared by clipping and
+                # the health monitor — no per-tensor host syncs
+                gnorm = _health.grad_health(grad_arrays)
             if clip is not None:
-                gnorm = jnp.sqrt(sum(jnp.sum(
-                    jnp.square(g.astype(jnp.float32))) for g in grad_arrays))
                 scale = jnp.minimum(1.0, clip / (gnorm + 1e-6))
                 grad_arrays = [g * scale.astype(g.dtype) for g in grad_arrays]
             new_params, new_state = optimizer.update(
@@ -327,6 +337,15 @@ class TrainStep:
             # frozen params pass through unchanged
             new_params = [np_ if t else a for np_, a, t in
                           zip(new_params, param_arrays, trainable)]
+            if health_on:
+                # skip policy: non-finite grads keep the old params/state
+                # (compiled select, no host round-trip)
+                new_params, new_state = _health.apply_policy_in_step(
+                    gnorm, new_params, list(param_arrays),
+                    new_state, opt_state)
+                # (loss, gnorm) under one replicated out_shardings leaf:
+                # a pytree-prefix leaf broadcasts over the tuple
+                return (loss_val, gnorm), tuple(new_params), new_state
             return loss_val, tuple(new_params), new_state
 
         kwargs = {}
@@ -373,14 +392,20 @@ class TrainStep:
         arrays = self._prepare_batch(batch)
         key = _rng.next_key()
         lr = jnp.asarray(self.optimizer.get_lr(), dtype=jnp.float32)
-        loss, self.param_arrays, self.opt_state = self._jitted(
-            key, lr, tuple(self.param_arrays), self.opt_state, *arrays)
+        with _obs.span("train.step", args={"n": 1}):
+            out, self.param_arrays, self.opt_state = self._jitted(
+                key, lr, tuple(self.param_arrays), self.opt_state, *arrays)
+        base = self._step_count
         self._step_count += 1
         # rebind model params to the fresh arrays: the old ones were donated
         # to XLA (deleted on TPU), and eager use of the model must keep
         # working between steps. This is a pointer swap, not a copy.
         self.sync_params_to_model()
-        return Tensor(loss)
+        if self._health_on:
+            loss, gnorm = out
+            _health.record_step(float(gnorm), source="grad", step=base)
+            return Tensor(loss)
+        return Tensor(out)
 
     def _prepare_batch(self, batch, leading_steps: Optional[int] = None):
         """Convert/validate/shard a batch. With ``leading_steps=n`` the
@@ -434,6 +459,7 @@ class TrainStep:
         _count_jit(miss=n not in self._multi_jitted, cause="chunk_size")
         if n not in self._multi_jitted:
             pure = self._pure_step
+            health_on = self._health_on
 
             def multi(keys, lr, params, state, *arrays):
                 # lax.scan: one compiled step body regardless of n
@@ -443,19 +469,25 @@ class TrainStep:
                                                *arrays)
                     return (params, state), loss
 
-                (params, state), losses = jax.lax.scan(
+                (params, state), ys = jax.lax.scan(
                     body, (params, state), keys)
-                return losses[-1], params, state
+                if health_on:
+                    # ys = (losses[n], gnorms[n]): last loss, ALL gnorms
+                    # so the host can attribute non-finite steps
+                    return (ys[0][-1], ys[1]), params, state
+                return ys[-1], params, state
 
             self._multi_jitted[n] = jax.jit(multi, **self._jit_kwargs)
         arrays = self._prepare_batch(batch)
         keys = jnp.stack([_rng.next_key() for _ in range(n)])
         lr = jnp.asarray(self.optimizer.get_lr(), dtype=jnp.float32)
-        loss, self.param_arrays, self.opt_state = self._multi_jitted[n](
-            keys, lr, tuple(self.param_arrays), self.opt_state, *arrays)
+        with _obs.span("train.step", args={"n": n}):
+            out, self.param_arrays, self.opt_state = self._multi_jitted[n](
+                keys, lr, tuple(self.param_arrays), self.opt_state, *arrays)
+        base = self._step_count
         self._step_count += n
         self.sync_params_to_model()
-        return Tensor(loss)
+        return Tensor(self._record_chunk_health(out, base))
 
     def _chunk_lrs(self, n: int):
         """Per-step learning rates for an n-step chunk; advances a host
@@ -492,6 +524,7 @@ class TrainStep:
                    cause="chunk_size")
         if cache_key not in self._multi_jitted:
             pure = self._pure_step
+            health_on = self._health_on
 
             def multi(keys, lrs, params, state, *stacked_arrays):
                 def body(carry, xs):
@@ -501,9 +534,11 @@ class TrainStep:
                     loss, params, state = pure(key, lr, params, state, *mb)
                     return (params, state), loss
 
-                (params, state), losses = jax.lax.scan(
+                (params, state), ys = jax.lax.scan(
                     body, (params, state), (keys, lrs) + stacked_arrays)
-                return losses[-1], params, state
+                if health_on:
+                    return (ys[0][-1], ys[1]), params, state
+                return ys[-1], params, state
 
             kwargs = dict(self._jit_kwargs)
             if "in_shardings" in kwargs:
@@ -532,16 +567,31 @@ class TrainStep:
             lrs = self._chunk_lrs(n)
         keys = jnp.stack([_rng.next_key() for _ in range(n)])
         try:
-            loss, self.param_arrays, self.opt_state = self._multi_jitted[
-                cache_key](keys, lrs, tuple(self.param_arrays),
-                           self.opt_state, *arrays)
+            with _obs.span("train.step", args={"n": n, "stream": True}):
+                out, self.param_arrays, self.opt_state = self._multi_jitted[
+                    cache_key](keys, lrs, tuple(self.param_arrays),
+                               self.opt_state, *arrays)
         except Exception:
             if snapshot is not None:
                 sched.set_state_dict(snapshot)
             raise
+        base = self._step_count
         self._step_count += n
         self.sync_params_to_model()
-        return Tensor(loss)
+        return Tensor(self._record_chunk_health(out, base))
+
+    def _record_chunk_health(self, out, base: int):
+        """Unpack a chunk result; with health on, record every step's
+        grad norm from ONE device->host transfer of the [n] gnorm
+        vector. Returns the last-step loss array."""
+        if not self._health_on:
+            return out
+        import numpy as np
+
+        loss, gnorms = out
+        for i, g in enumerate(np.asarray(gnorms)):
+            _health.record_step(float(g), source="grad", step=base + i)
+        return loss
 
     def sync_params_to_model(self):
         for p, a in zip(self._params, self.param_arrays):

@@ -623,6 +623,8 @@ def _count_cache_lookup(miss: bool):
     if _obs.enabled():
         _obs.registry.counter(
             "decode.cache_miss" if miss else "decode.cache_hit").inc()
+        if miss:
+            _obs.flight_recorder.record("jit.cache_miss", site="decode")
 
 
 def generate(model, input_ids, max_new_tokens: int = 32,
@@ -726,23 +728,35 @@ def generate(model, input_ids, max_new_tokens: int = 32,
     # telemetry is on). AOT lower().compile() doubles as the
     # cost_analysis() source without compiling anything twice.
     key = _rng.next_key()
-    if entry is None:
-        pf = jax.jit(make_prefill()).lower(w_now, ids, key).compile()
-        _obs.record_cost_analysis("decode.prefill", pf)
-    else:
-        pf = entry[0]
-    t0 = time.perf_counter()
-    res = jax.block_until_ready(pf(w_now, ids, key))
-    t_prefill = time.perf_counter() - t0
-    if entry is None:
-        df = jax.jit(make_decode()).lower(w_now, *res).compile()
-        _obs.record_cost_analysis("decode.steps", df)
-        cache[key_cache] = (pf, df)
-    else:
-        df = entry[1]
-    t0 = time.perf_counter()
-    out = jax.block_until_ready(df(w_now, *res))
-    t_decode = time.perf_counter() - t0
+    with _obs.span("decode.generate", cat="decode",
+                   args={"batch": b, "prompt": plen,
+                         "max_new": max_new_tokens}):
+        if entry is None:
+            with _obs.span("jit.compile", cat="jit",
+                           args={"site": "decode.prefill"}):
+                pf = jax.jit(make_prefill()).lower(
+                    w_now, ids, key).compile()
+            _obs.record_cost_analysis("decode.prefill", pf)
+        else:
+            pf = entry[0]
+        t0 = time.perf_counter()
+        with _obs.span("decode.prefill", cat="decode",
+                       args={"tokens": b * plen}):
+            res = jax.block_until_ready(pf(w_now, ids, key))
+        t_prefill = time.perf_counter() - t0
+        if entry is None:
+            with _obs.span("jit.compile", cat="jit",
+                           args={"site": "decode.decode"}):
+                df = jax.jit(make_decode()).lower(w_now, *res).compile()
+            _obs.record_cost_analysis("decode.steps", df)
+            cache[key_cache] = (pf, df)
+        else:
+            df = entry[1]
+        t0 = time.perf_counter()
+        with _obs.span("decode.decode", cat="decode",
+                       args={"tokens": b * max_new_tokens}):
+            out = jax.block_until_ready(df(w_now, *res))
+        t_decode = time.perf_counter() - t0
 
     reg = _obs.registry
     reg.histogram("decode.prefill_time").observe(t_prefill)

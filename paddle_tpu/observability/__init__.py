@@ -49,6 +49,20 @@ from .exporters import (  # noqa: F401
     snapshot,
 )
 from .memory import sample_device_memory  # noqa: F401
+from . import tracing  # noqa: F401
+from .tracing import (  # noqa: F401
+    Span,
+    Tracer,
+    activate_context,
+    current_context,
+    export_chrome_trace,
+    merge_chrome_traces,
+    span,
+    tracer,
+)
+from . import flight_recorder  # noqa: F401
+from .flight_recorder import dump_debug_bundle, install_excepthook  # noqa: F401
+from . import health  # noqa: F401
 from .xla_cost import (  # noqa: F401
     compiled_costs,
     derive_mfu,
@@ -64,4 +78,9 @@ __all__ = [
     "merge_counters_into_trace", "sample_device_memory",
     "record_cost_analysis", "compiled_costs", "derive_mfu",
     "METRICS", "MetricSpec", "metrics_schema",
+    "Span", "Tracer", "tracer", "span", "tracing",
+    "current_context", "activate_context", "export_chrome_trace",
+    "merge_chrome_traces",
+    "flight_recorder", "dump_debug_bundle", "install_excepthook",
+    "health",
 ]

@@ -30,8 +30,10 @@ def dump_json(path: str, sample_memory: bool = True) -> dict:
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
-    with open(path, "w") as f:
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
         json.dump(snap, f, indent=1, sort_keys=True)
+    os.replace(tmp, path)
     return snap
 
 
@@ -124,6 +126,18 @@ def merge_counters_into_trace(path: str) -> bool:
         events.append({"ph": "C", "name": full, "pid": pid, "tid": 0,
                        "ts": ts, "cat": "telemetry",
                        "args": {"count": st["count"], "sum": st["sum"]}})
-    with open(path, "w") as f:
-        json.dump(doc, f)
+    # atomic replace: a crash mid-serialization must not corrupt the
+    # existing trace file (the temp lives in the same dir so os.replace
+    # stays a same-filesystem rename)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, path)
+    except Exception:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
     return True

@@ -1,0 +1,184 @@
+"""Flight recorder: a bounded in-memory ring of structured events
+(step boundaries, collective start/finish, message-bus sends, jit cache
+misses) that survives until the moment a job dies — and a
+``dump_debug_bundle`` that writes everything a post-mortem needs in one
+directory: the ring, a metrics snapshot, a device-memory sample, the
+span trace, the in-flight CommTask table, and the env/config.
+
+The watchdog timeout path calls ``dump_debug_bundle`` BEFORE its abort
+callback (the reference's ``AbortComm`` analog used to take every
+diagnostic with it via ``os._exit``); ``install_excepthook`` opts an
+unhandled crash into the same dump.
+
+Recording shares the telemetry gate (zero-cost disabled); DUMPING does
+not — a hang diagnosis must never be refused because telemetry was off,
+so the bundle is written with whatever is available (possibly an empty
+ring).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from collections import deque
+from typing import List, Optional
+
+from .registry import enabled as _enabled
+
+__all__ = ["record", "events", "reset", "dump_debug_bundle",
+           "install_excepthook", "default_dump_dir"]
+
+_DEFAULT_CAPACITY = int(os.environ.get("PADDLE_TPU_FLIGHT_CAPACITY",
+                                       "4096"))
+
+# deque(maxlen) appends are atomic under the GIL — no lock on the
+# record path; list(...) snapshots are consistent enough for dumps
+_ring: deque = deque(maxlen=max(_DEFAULT_CAPACITY, 1))
+_seq = 0
+
+
+def record(kind: str, **fields) -> None:
+    """Append one structured event to the ring (dropped silently when
+    telemetry is disabled — same contract as every instrument)."""
+    global _seq
+    if not _enabled():
+        return
+    _seq += 1
+    _ring.append({"seq": _seq, "t": time.time(), "kind": kind, **fields})
+
+
+def events() -> List[dict]:
+    return list(_ring)
+
+
+def reset() -> None:
+    global _seq
+    _ring.clear()
+    _seq = 0
+
+
+def default_dump_dir() -> Optional[str]:
+    return os.environ.get("PADDLE_TPU_DUMP_DIR") or None
+
+
+def _comm_task_table() -> List[dict]:
+    """In-flight CommTask table without instantiating a watchdog that
+    was never started (instance() would spawn the poll thread)."""
+    try:
+        from ..distributed.watchdog import CommTaskManager
+    except Exception:
+        return []
+    mgr = CommTaskManager._instance
+    if mgr is None:
+        return []
+    now = time.monotonic()
+    return [{"op": t.op_name, "group": t.group_id,
+             "age_s": round(now - t.start, 3), "timeout_s": t.timeout,
+             "done": t.done} for t in mgr.in_flight()]
+
+
+def _env_snapshot(reason: Optional[str]) -> dict:
+    keep_prefixes = ("PADDLE_", "JAX_", "XLA_", "TPU_", "LIBTPU_")
+    env = {k: v for k, v in sorted(os.environ.items())
+           if k.startswith(keep_prefixes)}
+    info = {"reason": reason, "unix_time": time.time(), "pid": os.getpid(),
+            "argv": list(sys.argv), "python": sys.version.split()[0],
+            "env": env}
+    try:
+        import jax
+
+        info["jax_version"] = jax.__version__
+        info["backend"] = jax.default_backend()
+        info["device_count"] = jax.device_count()
+    except Exception:
+        pass
+    try:
+        from .. import version
+
+        info["paddle_tpu_version"] = getattr(version, "full_version",
+                                             None)
+    except Exception:
+        pass
+    return info
+
+
+def _write_json(path: str, obj) -> None:
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(obj, f, indent=1, sort_keys=True, default=str)
+    os.replace(tmp, path)
+
+
+def dump_debug_bundle(dir_path: Optional[str] = None,
+                      reason: Optional[str] = None,
+                      extra: Optional[dict] = None) -> Optional[str]:
+    """Write the full post-mortem bundle into ``dir_path`` (defaults to
+    $PADDLE_TPU_DUMP_DIR; None when neither is set). Files:
+
+    - ``flight_recorder.jsonl`` — the event ring, oldest first
+    - ``metrics.json``          — registry snapshot (+ memory sample)
+    - ``trace.json``            — chrome trace of finished spans
+    - ``comm_tasks.json``       — in-flight CommTask table
+    - ``env.json``              — env vars / versions / argv / reason
+
+    Every section is written best-effort: one broken exporter must not
+    cost the rest of the bundle. Returns the bundle directory."""
+    d = dir_path or default_dump_dir()
+    if not d:
+        return None
+    os.makedirs(d, exist_ok=True)
+    try:
+        with open(os.path.join(d, "flight_recorder.jsonl"), "w") as f:
+            for ev in events():
+                f.write(json.dumps(ev, default=str) + "\n")
+    except Exception:
+        pass
+    try:
+        from . import exporters
+
+        snap = exporters.snapshot()
+        if extra:
+            snap["extra"] = extra
+        _write_json(os.path.join(d, "metrics.json"), snap)
+    except Exception:
+        pass
+    try:
+        from . import tracing
+
+        tracing.export_chrome_trace(os.path.join(d, "trace.json"))
+    except Exception:
+        pass
+    try:
+        _write_json(os.path.join(d, "comm_tasks.json"),
+                    _comm_task_table())
+    except Exception:
+        pass
+    try:
+        _write_json(os.path.join(d, "env.json"), _env_snapshot(reason))
+    except Exception:
+        pass
+    return d
+
+
+_prev_excepthook = None
+
+
+def install_excepthook(dir_path: Optional[str] = None) -> None:
+    """Opt-in: dump a debug bundle on any unhandled exception, then
+    chain to the previous hook. Idempotent."""
+    global _prev_excepthook
+    if _prev_excepthook is not None:
+        return
+    _prev_excepthook = sys.excepthook
+
+    def _hook(exc_type, exc, tb):
+        try:
+            dump_debug_bundle(dir_path,
+                              reason=f"unhandled {exc_type.__name__}: "
+                                     f"{exc}")
+        except Exception:
+            pass
+        _prev_excepthook(exc_type, exc, tb)
+
+    sys.excepthook = _hook

@@ -1,0 +1,131 @@
+"""Training health monitor: per-step global grad-norm + non-finite
+detection with a configurable policy, designed for the TPU cost model —
+ONE fused reduction inside the compiled step (the squared-sum tree is
+part of the same XLA program as the backward) and at most one extra
+scalar device->host sync per step on the host side. Never a per-tensor
+host sync.
+
+Enable with ``PADDLE_TPU_HEALTH=warn|skip|raise`` or
+``health.configure("skip")`` BEFORE building the train step:
+
+- ``warn``  — count + warn on non-finite steps, keep the update;
+- ``skip``  — the compiled program itself discards the update (params
+  and optimizer state keep their old values) on a non-finite step, the
+  bf16 analog of reference dygraph loss-scaler's found_inf skip
+  (fluid/dygraph/amp/loss_scaler.py);
+- ``raise`` — raise ``NonFiniteError`` on the host after the sync.
+
+Telemetry (``train.grad_norm`` gauge, ``train.nonfinite_steps``
+counter, flight-recorder events) records only when telemetry is
+enabled; the POLICY works regardless — health is a training-correctness
+feature, not a metrics feature.
+"""
+from __future__ import annotations
+
+import os
+import warnings
+from typing import Optional
+
+from .registry import enabled as _telemetry_enabled, registry
+
+__all__ = ["NonFiniteError", "configure", "enabled", "get_policy",
+           "grad_health", "apply_policy_in_step", "record_step"]
+
+_POLICIES = ("off", "warn", "skip", "raise")
+
+
+def _env_policy() -> str:
+    v = os.environ.get("PADDLE_TPU_HEALTH", "").strip().lower()
+    return v if v in _POLICIES else "off"
+
+
+_policy = _env_policy()
+
+
+class NonFiniteError(RuntimeError):
+    """A training step produced a non-finite global grad norm (or loss)
+    under the ``raise`` policy."""
+
+
+def configure(policy: str) -> None:
+    """Set the health policy ("off" disables). Takes effect for steps
+    BUILT afterwards — the skip guard is compiled into the program."""
+    global _policy
+    if policy not in _POLICIES:
+        raise ValueError(
+            f"health policy must be one of {_POLICIES}, got {policy!r}")
+    _policy = policy
+
+
+def enabled() -> bool:
+    return _policy != "off"
+
+
+def get_policy() -> str:
+    return _policy
+
+
+# ------------------------------------------------------- inside-jit math
+def grad_health(grad_arrays):
+    """Fused global grad norm: one squared-sum reduction over every
+    gradient, sqrt'd once. sqrt(NaN/Inf) stays non-finite, so
+    ``isfinite(gnorm)`` is THE single whole-model health bit — no
+    per-tensor checks, no host syncs (runs under trace)."""
+    import jax.numpy as jnp
+
+    sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+             for g in grad_arrays)
+    return jnp.sqrt(sq)
+
+
+def apply_policy_in_step(gnorm, new_params, old_params, new_state,
+                         old_state):
+    """Compiled-side half of the ``skip`` policy: when ``gnorm`` is
+    non-finite, the update is discarded — params and optimizer state
+    keep their previous values (a ``where`` on each leaf, fused into the
+    step program). Other policies pass the update through; the host
+    side reacts after the sync."""
+    if _policy != "skip":
+        return new_params, new_state
+    import jax
+    import jax.numpy as jnp
+
+    ok = jnp.isfinite(gnorm)
+    guarded = [jnp.where(ok, n, o)
+               for n, o in zip(new_params, old_params)]
+    guarded_state = jax.tree_util.tree_map(
+        lambda n, o: jnp.where(ok, n, o), new_state, old_state)
+    return guarded, guarded_state
+
+
+# ------------------------------------------------------------- host side
+def record_step(gnorm: float, source: str = "grad",
+                step: Optional[int] = None) -> bool:
+    """Record one step's health scalar (already on host) and apply the
+    warn/raise policy. Returns True when the step was finite."""
+    import math
+
+    finite = math.isfinite(gnorm)
+    if _telemetry_enabled():
+        if finite:
+            if source == "grad":
+                registry.gauge("train.grad_norm").set(gnorm)
+        else:
+            registry.counter("train.nonfinite_steps").inc()
+            from . import flight_recorder
+
+            flight_recorder.record("train.nonfinite_step",
+                                   source=source, step=step,
+                                   value=repr(gnorm))
+    if finite:
+        return True
+    if _policy == "raise":
+        raise NonFiniteError(
+            f"non-finite {source} at step {step}: {gnorm!r}")
+    if _policy in ("warn", "skip"):
+        warnings.warn(
+            f"paddle_tpu.health: non-finite {source} at step {step} "
+            f"({gnorm!r}); policy={_policy}"
+            + (" — update discarded" if _policy == "skip" else ""),
+            stacklevel=2)
+    return False

@@ -113,6 +113,13 @@ METRICS = {
     "xla.bytes_accessed": MetricSpec(
         "gauge", "bytes", "XLA cost_analysis bytes accessed per "
         "execution of the tagged executable", tags=("executable",)),
+    # ---- training health (observability/health.py, jit/train_step.py)
+    "train.grad_norm": MetricSpec(
+        "gauge", "norm", "last finite fused global gradient norm (one "
+        "whole-model reduction inside the compiled step)"),
+    "train.nonfinite_steps": MetricSpec(
+        "counter", "steps", "training steps whose global grad norm (or "
+        "loss) was NaN/Inf; the health policy decides warn/skip/raise"),
     # ---- bench harness windows (bench.py, tools/bench_*.py)
     "bench.train_window": MetricSpec(
         "histogram", "s", "bench.py timed training window (N chained "
@@ -127,3 +134,29 @@ METRICS = {
 
 def spec(name: str) -> Optional[MetricSpec]:
     return METRICS.get(name)
+
+
+# ---------------------------------------------------------------- spans
+# Span-name schema (observability/tracing.py): every IN-TREE
+# ``span("...")`` call site with a literal dotted name must use a name
+# declared here — tools/check_metric_names.py lints span call sites
+# against this table exactly like metric call sites. Names built at
+# runtime (f-strings, variables) are out of lint scope by design.
+SPANS = {
+    "engine.step": "one Engine.fit optimizer step (dispatch + loss d2h)",
+    "engine.build": "Engine._build: pass pipeline + train-step trace",
+    "train.step": "TrainStep dispatch (single or chained chunk)",
+    "decode.generate": "whole generate() call",
+    "decode.prefill": "prefill dispatch (telemetry two-phase path)",
+    "decode.decode": "decode-scan dispatch",
+    "jit.compile": "fresh trace+compile of a jitted program",
+    "fleet.run": "FleetExecutor.run window (feed -> sink drain)",
+    "fleet.node": "one interceptor fire (TaskNode fn on its actor)",
+    "rpc.call": "outgoing rpc (client side, until posted)",
+    "rpc.handle": "incoming rpc execution (server side)",
+    "pg.collective": "ProcessGroup collective (op/group in args)",
+}
+
+
+def span_spec(name: str) -> Optional[str]:
+    return SPANS.get(name)

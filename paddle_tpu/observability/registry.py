@@ -291,8 +291,15 @@ class Stopwatch:
 
     def __exit__(self, *exc) -> None:
         self.elapsed = time.perf_counter() - self._t0
-        if _enabled and exc[0] is None:
+        if not _enabled:
+            return
+        if exc[0] is None:
             registry.histogram(self.name, self.tags).observe(self.elapsed)
+        else:
+            # a raising body must stay visible: the timing is suspect
+            # (the window died partway), so don't pollute the histogram
+            # — bump the error-marker counter instead
+            registry.counter(self.name + ".errors", self.tags).inc()
 
 
 def stopwatch(name: str, tags: Optional[dict] = None) -> Stopwatch:

@@ -1,5 +1,6 @@
 """Tier-1 wiring for tools/check_metric_names.py: every telemetry call
-site in the tree must use a name declared in metrics_schema.METRICS."""
+site in the tree must use a name declared in metrics_schema.METRICS
+(and every literal dotted span name one declared in SPANS)."""
 import importlib.util
 import os
 
@@ -26,7 +27,8 @@ def test_lint_catches_undeclared_name(tmp_path):
     bad = tmp_path / "bad.py"
     bad.write_text('registry.counter("not.a.declared.metric").inc()\n')
     errors = []
-    lint.check_file(str(bad), lint._load_schema(ROOT), errors)
+    metrics, _ = lint._load_schema(ROOT)
+    lint.check_file(str(bad), metrics, errors)
     assert len(errors) == 1
     assert "not.a.declared.metric" in errors[0]
 
@@ -37,7 +39,8 @@ def test_lint_catches_kind_mismatch(tmp_path):
     # engine.steps is declared as a counter, not a gauge
     bad.write_text('registry.gauge("engine.steps").set(1)\n')
     errors = []
-    lint.check_file(str(bad), lint._load_schema(ROOT), errors)
+    metrics, _ = lint._load_schema(ROOT)
+    lint.check_file(str(bad), metrics, errors)
     assert len(errors) == 1
     assert "declared as a counter" in errors[0]
 
@@ -48,6 +51,28 @@ def test_lint_catches_undeclared_tag_key(tmp_path):
     bad.write_text(
         'registry.counter("jit.cache_hit", tags={"nope": "x"}).inc()\n')
     errors = []
-    lint.check_file(str(bad), lint._load_schema(ROOT), errors)
+    metrics, _ = lint._load_schema(ROOT)
+    lint.check_file(str(bad), metrics, errors)
     assert len(errors) == 1
     assert "nope" in errors[0]
+
+
+def test_lint_catches_undeclared_span(tmp_path):
+    lint = _load_lint()
+    bad = tmp_path / "bad.py"
+    bad.write_text('with _obs.span("not.a.span"):\n    pass\n')
+    errors = []
+    metrics, spans = lint._load_schema(ROOT)
+    lint.check_file(str(bad), metrics, errors, spans=spans)
+    assert len(errors) == 1
+    assert "not.a.span" in errors[0]
+
+
+def test_lint_accepts_declared_span(tmp_path):
+    lint = _load_lint()
+    ok = tmp_path / "ok.py"
+    ok.write_text('with _obs.span("engine.step"):\n    pass\n')
+    errors = []
+    metrics, spans = lint._load_schema(ROOT)
+    lint.check_file(str(ok), metrics, errors, spans=spans)
+    assert errors == []

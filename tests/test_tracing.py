@@ -1,0 +1,500 @@
+"""Span tracing, flight recorder, debug bundles, and training health
+(PR: distributed tracing + flight recorder + health monitor).
+
+Covers: nested/threaded span parentage, the disabled-path no-op
+contract, Stopwatch error accounting, cross-rank trace-id propagation
+through a 2-process FleetExecutor pipeline, the watchdog-timeout debug
+bundle, and non-finite step detection in a tiny train loop.
+"""
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import observability as obs
+from paddle_tpu.observability import flight_recorder, health, tracing
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    """Every test starts and ends with telemetry off and empty rings."""
+    obs.disable()
+    obs.registry.reset()
+    tracing.reset()
+    flight_recorder.reset()
+    health.configure("off")
+    yield
+    obs.disable()
+    obs.registry.reset()
+    tracing.reset()
+    flight_recorder.reset()
+    health.configure("off")
+
+
+# ------------------------------------------------------------------ spans
+def test_nested_spans_parent_child_ids():
+    obs.enable()
+    with obs.span("engine.step", args={"step": 7}) as outer:
+        with obs.span("train.step") as inner:
+            pass
+    assert inner.trace_id == outer.trace_id
+    assert inner.parent_id == outer.span_id
+    assert outer.parent_id == ""
+    assert inner.span_id != outer.span_id
+    done = tracing.finished_spans()
+    assert [s.name for s in done] == ["train.step", "engine.step"]
+    assert outer.dur >= inner.dur >= 0
+
+
+def test_span_error_annotation_and_duration():
+    obs.enable()
+    with pytest.raises(ValueError):
+        with obs.span("engine.step") as sp:
+            raise ValueError("boom")
+    assert sp.args["error"] == "ValueError"
+    assert sp.dur >= 0
+    assert tracing.finished_spans()[-1] is sp
+
+
+def test_chrome_export_roundtrip(tmp_path):
+    obs.enable()
+    tracing.set_rank(3)
+    try:
+        with obs.span("engine.step", args={"step": 1}):
+            pass
+        path = str(tmp_path / "trace.json")
+        doc = obs.export_chrome_trace(path)
+        with open(path) as f:
+            on_disk = json.load(f)
+        assert on_disk == doc
+        evs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        assert len(evs) == 1
+        ev = evs[0]
+        assert ev["name"] == "engine.step"
+        assert ev["pid"] == 3                      # pid = rank
+        assert ev["args"]["step"] == 1
+        assert ev["args"]["trace_id"]
+        meta = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+        assert meta and "rank3" in meta[0]["args"]["name"]
+    finally:
+        tracing._rank = None
+
+
+def test_disabled_spans_are_shared_noop():
+    assert not obs.enabled()
+    a = obs.span("engine.step")
+    b = obs.span("train.step", args={"n": 1})
+    assert a is b                                  # ONE shared object
+    with a:
+        a.set_arg("x", 1)                          # must not raise
+        assert obs.current_context() is None
+    assert tracing.finished_spans() == []
+    with obs.activate_context({"trace_id": "ff", "span_id": "aa"}):
+        # disabled: adoption is a no-op, nothing recorded
+        with obs.span("engine.step"):
+            pass
+    assert tracing.finished_spans() == []
+
+
+def test_threaded_spans_isolated_stacks_and_adoption():
+    obs.enable()
+    ctx_holder = {}
+    with obs.span("engine.step") as root:
+        ctx_holder["ctx"] = obs.current_context()
+
+        def worker(adopt):
+            if adopt:
+                with obs.activate_context(ctx_holder["ctx"]):
+                    with obs.span("train.step", args={"who": "adopted"}):
+                        pass
+            else:
+                with obs.span("train.step", args={"who": "fresh"}):
+                    pass
+
+        t1 = threading.Thread(target=worker, args=(True,))
+        t2 = threading.Thread(target=worker, args=(False,))
+        t1.start(); t2.start(); t1.join(); t2.join()
+    spans = {s.args.get("who"): s for s in tracing.finished_spans()
+             if s.name == "train.step"}
+    adopted, fresh = spans["adopted"], spans["fresh"]
+    # adopting thread joins the root trace, parented on the root span
+    assert adopted.trace_id == root.trace_id
+    assert adopted.parent_id == root.span_id
+    # non-adopting thread starts its own trace (isolated stack)
+    assert fresh.trace_id != root.trace_id
+    assert fresh.parent_id == ""
+    assert adopted.tid != fresh.tid or adopted.tid != root.tid
+
+
+def test_context_roundtrip_same_thread():
+    obs.enable()
+    with obs.span("engine.step") as sp:
+        ctx = obs.current_context()
+    assert ctx == {"trace_id": sp.trace_id, "span_id": sp.span_id}
+    with obs.activate_context(ctx):
+        with obs.span("rpc.handle") as child:
+            pass
+    assert child.trace_id == sp.trace_id
+    assert child.parent_id == sp.span_id
+    # scope closed: back to fresh traces
+    with obs.span("rpc.handle") as lone:
+        pass
+    assert lone.trace_id != sp.trace_id
+
+
+def test_merge_chrome_traces_skips_unreadable(tmp_path):
+    obs.enable()
+    with obs.span("engine.step"):
+        pass
+    p0 = str(tmp_path / "r0.json")
+    obs.export_chrome_trace(p0)
+    bad = tmp_path / "r1.json"
+    bad.write_text("{not json")
+    out = str(tmp_path / "merged.json")
+    merged = obs.merge_chrome_traces([p0, str(bad), "/nope/missing"], out)
+    assert os.path.exists(out)
+    assert any(e.get("ph") == "X" for e in merged["traceEvents"])
+
+
+# -------------------------------------------------------------- stopwatch
+def test_stopwatch_records_error_counter_not_histogram():
+    obs.enable()
+    with pytest.raises(RuntimeError):
+        with obs.stopwatch("engine.step_time") as sw:
+            raise RuntimeError("body failed")
+    # elapsed is still measured for the caller...
+    assert sw.elapsed >= 0
+    snap = obs.snapshot()
+    # ...but the failed window must NOT pollute the latency histogram
+    assert "engine.step_time" not in snap["histograms"]
+    errs = [k for k in snap["counters"] if "engine.step_time.errors" in k]
+    assert errs, snap["counters"]
+
+
+# -------------------------------------------------------- flight recorder
+def test_flight_recorder_ring_bounded_and_gated():
+    flight_recorder.record("x", a=1)              # telemetry off: dropped
+    assert flight_recorder.events() == []
+    obs.enable()
+    cap = flight_recorder._ring.maxlen
+    for i in range(cap + 10):
+        flight_recorder.record("tick", i=i)
+    evs = flight_recorder.events()
+    assert len(evs) == cap                        # bounded
+    assert evs[0]["i"] == 10                      # oldest dropped first
+    assert evs[-1]["kind"] == "tick"
+    seqs = [e["seq"] for e in evs]
+    assert seqs == sorted(seqs)
+
+
+def test_dump_debug_bundle_files(tmp_path):
+    obs.enable()
+    with obs.span("engine.step"):
+        flight_recorder.record("engine.step", step=0, loss=1.0)
+    d = str(tmp_path / "bundle")
+    out = flight_recorder.dump_debug_bundle(
+        d, reason="unit test", extra={"note": "hi"})
+    assert out == d
+    for fname in ("flight_recorder.jsonl", "metrics.json", "trace.json",
+                  "comm_tasks.json", "env.json"):
+        assert os.path.exists(os.path.join(d, fname)), fname
+    with open(os.path.join(d, "env.json")) as f:
+        env = json.load(f)
+    assert env["reason"] == "unit test"
+    with open(os.path.join(d, "metrics.json")) as f:
+        snap = json.load(f)
+    assert snap["extra"] == {"note": "hi"}
+    lines = open(os.path.join(d, "flight_recorder.jsonl")).read()
+    assert "engine.step" in lines
+    with open(os.path.join(d, "trace.json")) as f:
+        trace = json.load(f)
+    assert any(e.get("name") == "engine.step"
+               for e in trace["traceEvents"])
+
+
+def test_dump_debug_bundle_works_with_telemetry_off(tmp_path):
+    # dumping must never be refused because telemetry was off
+    d = str(tmp_path / "bundle")
+    out = flight_recorder.dump_debug_bundle(d, reason="off")
+    assert out == d
+    assert os.path.exists(os.path.join(d, "env.json"))
+
+
+def test_dump_debug_bundle_no_dir_returns_none(monkeypatch):
+    monkeypatch.delenv("PADDLE_TPU_DUMP_DIR", raising=False)
+    assert flight_recorder.dump_debug_bundle() is None
+
+
+def test_diagnose_tool_reads_bundle(tmp_path, capsys):
+    obs.enable()
+    flight_recorder.record("engine.step", step=0, loss=0.5)
+    d = str(tmp_path / "bundle")
+    flight_recorder.dump_debug_bundle(d, reason="diagnose test")
+    import importlib.util
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "pt_diagnose", os.path.join(root, "tools", "diagnose.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    # accepts the parent dir too (picks the newest bundle inside)
+    assert mod.main(["diagnose", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "diagnose test" in out
+    assert "engine.step" in out
+
+
+# ------------------------------------------------- watchdog debug bundle
+def test_watchdog_timeout_dumps_bundle(tmp_path, monkeypatch):
+    """A simulated hang (a registered collective that never completes)
+    must leave a complete debug bundle BEFORE the abort callback."""
+    from paddle_tpu.distributed import watchdog
+
+    monkeypatch.setenv("PADDLE_TPU_DUMP_DIR", str(tmp_path))
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "0")
+    obs.enable()
+    flight_recorder.record("pg.collective.start", op="all_reduce")
+
+    fired = threading.Event()
+    timed_out = {}
+
+    def on_timeout(task):
+        timed_out["task"] = task
+        fired.set()                                # instead of os._exit
+
+    mgr = watchdog.CommTaskManager(poll_interval=0.05)
+    monkeypatch.setattr(watchdog.CommTaskManager, "_instance", mgr)
+    mgr.on_timeout = on_timeout
+    try:
+        mgr.register("all_reduce", 0, timeout=0.1)   # never completed
+        assert fired.wait(timeout=10), "watchdog never fired"
+    finally:
+        mgr.shutdown()
+    assert timed_out["task"].op_name == "all_reduce"
+    bundles = [p for p in os.listdir(str(tmp_path))
+               if p.startswith("watchdog_rank0_")]
+    assert bundles, os.listdir(str(tmp_path))
+    b = os.path.join(str(tmp_path), bundles[0])
+    for fname in ("flight_recorder.jsonl", "metrics.json", "trace.json",
+                  "comm_tasks.json", "env.json"):
+        assert os.path.exists(os.path.join(b, fname)), fname
+    with open(os.path.join(b, "env.json")) as f:
+        env = json.load(f)
+    assert "comm watchdog timeout" in env["reason"]
+    assert "all_reduce" in env["reason"]
+    with open(os.path.join(b, "metrics.json")) as f:
+        snap = json.load(f)
+    assert "timed_out" in snap["extra"]
+
+
+def test_excepthook_dumps_bundle(tmp_path):
+    import sys
+
+    prev_hook = sys.excepthook
+    prev_state = flight_recorder._prev_excepthook
+    flight_recorder._prev_excepthook = None
+    try:
+        flight_recorder.install_excepthook(str(tmp_path / "crash"))
+        hook = sys.excepthook
+        assert hook is not prev_hook
+        try:
+            raise KeyError("kaboom")
+        except KeyError:
+            hook(*sys.exc_info())
+        with open(str(tmp_path / "crash" / "env.json")) as f:
+            env = json.load(f)
+        assert "KeyError" in env["reason"]
+    finally:
+        sys.excepthook = prev_hook
+        flight_recorder._prev_excepthook = prev_state
+
+
+# ------------------------------------------------------- training health
+def _toy_step(policy, clip=None):
+    health.configure(policy)
+    from paddle_tpu.jit.train_step import TrainStep
+
+    pt.seed(0)
+    m = pt.nn.Sequential(pt.nn.Linear(4, 8), pt.nn.Tanh(),
+                         pt.nn.Linear(8, 1))
+    o = pt.optimizer.AdamW(learning_rate=1e-2, parameters=m.parameters())
+    step = TrainStep(m, o, grad_clip_norm=clip,
+                     loss_fn=lambda mm, x, y: ((mm(x) - y) ** 2).mean())
+    rng = np.random.RandomState(0)
+    x = rng.randn(8, 4).astype(np.float32)
+    y = rng.randn(8, 1).astype(np.float32)
+    return step, x, y
+
+
+def test_health_skip_policy_discards_nan_update():
+    step, x, y = _toy_step("skip", clip=1.0)
+    assert step._health_on
+    float(step(x, y))                               # healthy step
+    before = [np.asarray(a).copy() for a in step.param_arrays]
+    state_before = [np.asarray(a).copy() for a in step.opt_state["m"]]
+    xn = x.copy()
+    xn[0, 0] = np.nan
+    with pytest.warns(UserWarning, match="non-finite grad"):
+        loss = float(step(xn, y))
+    assert not np.isfinite(loss)
+    after = [np.asarray(a) for a in step.param_arrays]
+    state_after = [np.asarray(a) for a in step.opt_state["m"]]
+    # the compiled where kept params AND optimizer state untouched
+    assert all(np.array_equal(a, b) for a, b in zip(before, after))
+    assert all(np.array_equal(a, b)
+               for a, b in zip(state_before, state_after))
+    # and a healthy step afterwards still trains
+    l2 = float(step(x, y))
+    assert np.isfinite(l2)
+    assert not all(np.array_equal(a, np.asarray(b))
+                   for a, b in zip(after, step.param_arrays))
+
+
+def test_health_raise_policy():
+    step, x, y = _toy_step("raise")
+    xn = x.copy()
+    xn[0, 0] = np.inf
+    with pytest.raises(health.NonFiniteError, match="step 0"):
+        step(xn, y)
+
+
+def test_health_counts_nonfinite_and_gauges_grad_norm():
+    step, x, y = _toy_step("warn")
+    obs.enable()
+    float(step(x, y))
+    snap = obs.snapshot()
+    assert snap["gauges"]["train.grad_norm"] > 0
+    assert "train.nonfinite_steps" not in snap["counters"]
+    xn = x.copy()
+    xn[0, 0] = np.nan
+    with pytest.warns(UserWarning):
+        float(step(xn, y))
+    snap = obs.snapshot()
+    assert snap["counters"]["train.nonfinite_steps"] == 1.0
+    kinds = [e["kind"] for e in flight_recorder.events()]
+    assert "train.nonfinite_step" in kinds
+
+
+def test_health_chunked_steps_record_each_gnorm():
+    step, x, y = _toy_step("warn")
+    obs.enable()
+    float(step.run_steps(3, x, y))
+    snap = obs.snapshot()
+    assert "train.nonfinite_steps" not in snap["counters"]
+    assert snap["gauges"]["train.grad_norm"] > 0
+    # streamed chunk with one poisoned slice: exactly one bad step
+    xs = np.stack([x, x.copy()])
+    xs[1, 0, 0] = np.nan
+    ys = np.stack([y, y])
+    with pytest.warns(UserWarning):
+        float(step.run_steps_stream(2, xs, ys))
+    snap = obs.snapshot()
+    assert snap["counters"]["train.nonfinite_steps"] == 1.0
+
+
+def test_health_off_keeps_plain_signature():
+    step, x, y = _toy_step("off")
+    assert not step._health_on
+    loss = step(x, y)
+    assert np.isfinite(float(loss))
+
+
+def test_engine_fit_checks_loss_when_no_fused_health():
+    """The Engine-side loss check covers steps without fused health
+    (the staged-pipeline analog) — simulate with a plain-loss step."""
+    from paddle_tpu.distributed.auto_parallel.engine import Engine
+
+    health.configure("raise")
+    pt.seed(0)
+    m = pt.nn.Sequential(pt.nn.Linear(4, 4), pt.nn.Linear(4, 1))
+
+    class _NanStep:
+        # no _health_on attr -> Engine must do the loss check
+        def __call__(self, *batch):
+            from paddle_tpu.core.tensor import Tensor
+            import jax.numpy as jnp
+
+            return Tensor(jnp.float32(np.nan))
+
+    eng = Engine(model=m, optimizer=pt.optimizer.AdamW(
+        learning_rate=1e-2, parameters=m.parameters()))
+    eng._step = _NanStep()
+    rng = np.random.RandomState(0)
+    data = [(rng.randn(4, 4).astype(np.float32),
+             rng.randn(4, 1).astype(np.float32))]
+    with pytest.raises(health.NonFiniteError):
+        eng.fit(data, epochs=1)
+
+
+# --------------------------------------------- cross-rank trace stitching
+def _traced_fleet_worker(tmpdir):
+    import os
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from paddle_tpu import observability as obs
+    from paddle_tpu.observability import tracing
+    from paddle_tpu.distributed import rpc
+    from paddle_tpu.distributed.fleet_executor import (
+        FleetExecutor, TaskNode)
+
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    obs.enable()
+    tracing.set_rank(rank)
+    rpc.init_rpc(f"worker{rank}")
+
+    t0 = TaskNode(0, fn=lambda x: np.asarray(x) + 1.0, rank=0,
+                  max_run_times=2)
+    t1 = TaskNode(1, fn=lambda x: np.asarray(x) * 2.0, rank=1,
+                  max_run_times=2)
+    t0.add_downstream_task(1)
+    ex = FleetExecutor([t0, t1], rank=rank,
+                       executor_id="trace_xrank_test")
+    feeds = [np.float32(i) for i in range(4)]
+    try:
+        if rank == 0:
+            out = ex.run(feeds)
+            assert out == []
+        else:
+            out = ex.run([], n_results=4, timeout=60)
+            got = sorted(float(v) for v in out)
+            assert got == [(i + 1.0) * 2.0 for i in range(4)], got
+        obs.export_chrome_trace(
+            os.path.join(tmpdir, f"trace_rank{rank}.json"))
+        rpc.shutdown()
+    finally:
+        ex.release()
+
+
+def test_cross_rank_trace_stitches_one_timeline(tmp_path):
+    """2-process FleetExecutor pipeline: rank 1's node spans must join
+    the trace rank 0 started, and the merged chrome trace must show
+    both ranks as distinct pids."""
+    from paddle_tpu.distributed.spawn import spawn
+
+    d = str(tmp_path)
+    spawn(_traced_fleet_worker, args=(d,), nprocs=2)
+    p0, p1 = (os.path.join(d, f"trace_rank{r}.json") for r in (0, 1))
+    assert os.path.exists(p0) and os.path.exists(p1)
+    merged = obs.merge_chrome_traces(
+        [p0, p1], os.path.join(d, "merged.json"))
+    evs = [e for e in merged["traceEvents"] if e.get("ph") == "X"]
+    pids = {e["pid"] for e in evs}
+    assert pids == {0, 1}                       # one process row per rank
+    nodes1 = [e for e in evs
+              if e["pid"] == 1 and e["name"] == "fleet.node"]
+    assert nodes1, [e["name"] for e in evs if e["pid"] == 1]
+    run0 = [e for e in evs
+            if e["pid"] == 0 and e["name"] == "fleet.run"]
+    assert run0
+    # THE stitch: rank 1 node fires carry the trace id born on rank 0
+    root_trace = run0[0]["args"]["trace_id"]
+    assert all(e["args"]["trace_id"] == root_trace for e in nodes1)
+    # parentage chains back to a rank-0 span, not a fresh root
+    assert all(e["args"].get("parent_span_id") for e in nodes1)

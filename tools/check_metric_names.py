@@ -12,6 +12,10 @@ is a dotted string literal:
     records into a histogram);
   * literal ``tags={...}`` keys must be declared for that metric.
 
+Span call sites are linted the same way: every ``<obj>.span("...")`` /
+``span("...")`` whose first argument is a dotted string literal must
+name a key of ``metrics_schema.SPANS``.
+
 Names built at runtime (non-literal first args) are out of scope — the
 registry itself stays schema-agnostic by design; this lint keeps the
 IN-TREE instrumentation and the README metric table honest. Wired into
@@ -54,13 +58,21 @@ def _call_kind(func) -> str:
     return ""
 
 
+def _is_span_call(func) -> bool:
+    if isinstance(func, ast.Attribute):
+        return func.attr == "span"
+    if isinstance(func, ast.Name):
+        return func.id == "span"
+    return False
+
+
 def _literal_str(node) -> str:
     if isinstance(node, ast.Constant) and isinstance(node.value, str):
         return node.value
     return ""
 
 
-def check_file(path: str, metrics, errors: list):
+def check_file(path: str, metrics, errors: list, spans=None):
     try:
         with open(path) as f:
             tree = ast.parse(f.read(), filename=path)
@@ -69,6 +81,14 @@ def check_file(path: str, metrics, errors: list):
         return
     for node in ast.walk(tree):
         if not isinstance(node, ast.Call) or not node.args:
+            continue
+        if spans is not None and _is_span_call(node.func):
+            sname = _literal_str(node.args[0])
+            if "." in sname and sname not in spans:
+                errors.append(
+                    f"{path}:{node.args[0].lineno}: span {sname!r} is "
+                    "not declared in paddle_tpu/observability/"
+                    "metrics_schema.py SPANS")
             continue
         kind = _call_kind(node.func)
         if not kind:
@@ -110,14 +130,14 @@ def _load_schema(root: str):
                                                   path)
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
-    return mod.METRICS
+    return mod.METRICS, getattr(mod, "SPANS", {})
 
 
 def run(root: str) -> list:
-    metrics = _load_schema(root)
+    metrics, spans = _load_schema(root)
     errors: list = []
     for path in _iter_py_files(root):
-        check_file(path, metrics, errors)
+        check_file(path, metrics, errors, spans=spans)
     return errors
 
 

@@ -1,0 +1,197 @@
+#!/usr/bin/env python
+"""Summarize a paddle_tpu debug bundle on the terminal.
+
+A bundle is the directory written by
+``paddle_tpu.observability.flight_recorder.dump_debug_bundle`` — the
+comm watchdog writes one to ``$PADDLE_TPU_DUMP_DIR`` before aborting a
+hung job, and ``install_excepthook()`` writes one on an unhandled
+exception. This tool is the first-response reader: it needs ONLY the
+stdlib (no jax, no framework import), so it runs anywhere the bundle
+was copied to.
+
+Usage::
+
+    python tools/diagnose.py /path/to/bundle_dir
+    python tools/diagnose.py /path/to/dumps   # picks the newest bundle
+
+Sections printed (each only if its file exists in the bundle):
+  * why        — reason + timestamp + argv from env.json
+  * comm       — the in-flight / timed-out collectives (comm_tasks.json)
+  * flight     — the LAST events of the flight-recorder ring, the
+                 closest thing to a black-box readout of what the
+                 process was doing when it died
+  * metrics    — headline counters/gauges (steps, losses, cache misses,
+                 nonfinite steps, device memory)
+  * trace      — span counts by name from trace.json (open the file
+                 itself in https://ui.perfetto.dev for the timeline)
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+BUNDLE_FILES = ("env.json", "flight_recorder.jsonl", "metrics.json",
+                "comm_tasks.json", "trace.json")
+
+
+def _load_json(path):
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except Exception:
+        return None
+
+
+def _find_bundle(path: str) -> str:
+    """Accept either a bundle dir or a parent of bundle dirs."""
+    if any(os.path.exists(os.path.join(path, f)) for f in BUNDLE_FILES):
+        return path
+    candidates = []
+    try:
+        for name in os.listdir(path):
+            d = os.path.join(path, name)
+            if os.path.isdir(d) and any(
+                    os.path.exists(os.path.join(d, f))
+                    for f in BUNDLE_FILES):
+                candidates.append((os.path.getmtime(d), d))
+    except OSError:
+        pass
+    if not candidates:
+        raise SystemExit(f"diagnose: no debug bundle under {path!r}")
+    return max(candidates)[1]
+
+
+def _section(title: str):
+    print(f"\n== {title} " + "=" * max(1, 64 - len(title)))
+
+
+def _show_env(d: str):
+    env = _load_json(os.path.join(d, "env.json"))
+    if env is None:
+        return
+    _section("why")
+    if env.get("reason"):
+        print(f"reason : {env['reason']}")
+    if env.get("time"):
+        print(f"time   : {env['time']}")
+    if env.get("argv"):
+        print(f"argv   : {' '.join(env['argv'])}")
+    versions = env.get("versions") or {}
+    if versions:
+        print("stack  : " + ", ".join(
+            f"{k} {v}" for k, v in sorted(versions.items())))
+    flags = {k: v for k, v in (env.get("env") or {}).items()
+             if k.startswith("PADDLE_")}
+    if flags:
+        print("env    : " + ", ".join(
+            f"{k}={v}" for k, v in sorted(flags.items())))
+
+
+def _show_comm(d: str):
+    tasks = _load_json(os.path.join(d, "comm_tasks.json"))
+    if not tasks:
+        return
+    _section("comm (in-flight collectives at dump time)")
+    for t in tasks:
+        print(f"  {t}")
+
+
+def _show_flight(d: str, last: int = 20):
+    path = os.path.join(d, "flight_recorder.jsonl")
+    if not os.path.exists(path):
+        return
+    events = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    try:
+                        events.append(json.loads(line))
+                    except json.JSONDecodeError:
+                        pass
+    except OSError:
+        return
+    _section(f"flight recorder (last {min(last, len(events))} of "
+             f"{len(events)} events)")
+    kinds = {}
+    for e in events:
+        kinds[e.get("kind", "?")] = kinds.get(e.get("kind", "?"), 0) + 1
+    print("  by kind: " + ", ".join(
+        f"{k} x{n}" for k, n in sorted(kinds.items(),
+                                       key=lambda kv: -kv[1])))
+    for e in events[-last:]:
+        fields = {k: v for k, v in e.items()
+                  if k not in ("seq", "t", "kind")}
+        extra = " ".join(f"{k}={v}" for k, v in fields.items())
+        print(f"  #{e.get('seq', '?'):>6} t={e.get('t', 0):.3f} "
+              f"{e.get('kind', '?'):<24} {extra}")
+
+
+_HEADLINES = ("engine.steps", "engine.loss", "engine.tokens_per_s",
+              "train.nonfinite_steps", "train.grad_norm",
+              "jit.cache_miss", "decode.cache_miss",
+              "fleet.messages", "device.memory_in_use_bytes",
+              "device.memory_peak_bytes")
+
+
+def _show_metrics(d: str):
+    snap = _load_json(os.path.join(d, "metrics.json"))
+    if not snap:
+        return
+    _section("metrics snapshot (headline)")
+    shown = 0
+    for group in ("counters", "gauges"):
+        for name, val in sorted((snap.get(group) or {}).items()):
+            base = name.split("{", 1)[0]
+            if base in _HEADLINES:
+                print(f"  {name:<44} {val}")
+                shown += 1
+    hists = snap.get("histograms") or {}
+    for name in ("engine.step_time", "decode.decode_time"):
+        h = hists.get(name)
+        if isinstance(h, dict) and h.get("count"):
+            mean = h.get("sum", 0.0) / h["count"]
+            print(f"  {name:<44} count={h['count']} mean={mean:.4f}s")
+            shown += 1
+    if not shown:
+        print("  (no headline metrics recorded)")
+
+
+def _show_trace(d: str):
+    trace = _load_json(os.path.join(d, "trace.json"))
+    if not trace:
+        return
+    events = trace.get("traceEvents", trace) or []
+    spans = {}
+    for e in events:
+        if isinstance(e, dict) and e.get("ph") == "X":
+            spans[e.get("name", "?")] = spans.get(e.get("name", "?"), 0) + 1
+    if not spans:
+        return
+    _section("trace.json spans (open in ui.perfetto.dev)")
+    for name, n in sorted(spans.items(), key=lambda kv: -kv[1]):
+        print(f"  {name:<32} x{n}")
+
+
+def main(argv) -> int:
+    if len(argv) != 2 or argv[1] in ("-h", "--help"):
+        print(__doc__)
+        return 0 if len(argv) == 2 else 1
+    bundle = _find_bundle(argv[1])
+    print(f"debug bundle: {bundle}")
+    present = [f for f in BUNDLE_FILES
+               if os.path.exists(os.path.join(bundle, f))]
+    print(f"files       : {', '.join(present)}")
+    _show_env(bundle)
+    _show_comm(bundle)
+    _show_flight(bundle)
+    _show_metrics(bundle)
+    _show_trace(bundle)
+    print()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
