@@ -69,6 +69,7 @@ from .auto_parallel.placement_type import (  # noqa: F401
 )
 from . import fleet  # noqa: F401
 from . import checkpoint  # noqa: F401
+from . import resilience  # noqa: F401
 from . import sequence_parallel  # noqa: F401
 from . import rpc  # noqa: F401
 from .sequence_parallel import (  # noqa: F401

@@ -136,6 +136,29 @@ class _StagedTrainStep:
         """Parameters are written back every step; kept for TrainStep API
         compatibility."""
 
+    def restore_state(self, opt_state=None):
+        """Resume path: re-adopt the source layers' (just-loaded)
+        parameter arrays into the StagedProgram and optionally replace
+        the optimizer state."""
+        import jax
+        import jax.numpy as jnp
+
+        seg = getattr(self.staged, "segment_params", None)
+        if seg is not None:
+            for s in range(len(self.staged.params)):
+                stage_new = [jnp.asarray(p._data) for p in seg[s]]
+                if self.staged.devices is not None:
+                    stage_new = [jax.device_put(a, self.staged.devices[s])
+                                 for a in stage_new]
+                self.staged.params[s] = stage_new
+                for p, a in zip(seg[s], stage_new):
+                    p._data = a
+        if opt_state is not None:
+            self.opt_state = {
+                k: [jnp.asarray(e) for e in v]
+                if isinstance(v, (list, tuple)) else jnp.asarray(v)
+                for k, v in opt_state.items()}
+
 
 class Engine:
     """reference: auto_parallel/static/engine.py:98. fit/evaluate/predict
@@ -376,63 +399,195 @@ class Engine:
         return n
 
     def fit(self, train_data, epochs=1, batch_size=None,
-            steps_per_epoch=None, log_freq=10, verbose=0):
+            steps_per_epoch=None, log_freq=10, verbose=0,
+            save_dir=None, save_freq=None, resume=False,
+            keep_last=3, save_async=True):
         """reference: engine.py:1529. train_data: DataLoader-like iterable
-        of (inputs..., labels) batches."""
+        of (inputs..., labels) batches.
+
+        Fault tolerance: with ``save_dir`` set, a CheckpointManager
+        writes CRC-manifested checkpoints every ``save_freq`` steps
+        (async unless ``save_async=False``), keeps the newest
+        ``keep_last`` and registers an emergency synchronous save for
+        the watchdog-timeout and non-finite-loss failure paths.
+        ``resume=True`` restores params, optimizer state, step counter,
+        RNG and LR schedule from the newest VALID checkpoint (corrupt
+        or partial ones are skipped) and replays the loader past the
+        restored step so the trajectory matches an uninterrupted run."""
+        from ... import observability as _obs
+        from ...observability import health as _health
+        from ..resilience import faults as _faults
+
+        mgr = None
+        hook_token = None
+        start_step = 0
+        self._last_step = 0
+        if save_dir is not None:
+            from ..resilience import CheckpointManager, emergency
+
+            mgr = CheckpointManager(save_dir, keep_last=keep_last)
+            hook_token = emergency.register(
+                lambda reason: mgr.emergency_save(
+                    self._collect_state(self._last_step),
+                    self._last_step, reason))
+        restored = not (resume and mgr is not None)
+        global_step = 0
+        try:
+            for _ in range(epochs):
+                for i, batch in enumerate(train_data):
+                    if steps_per_epoch is not None \
+                            and i >= steps_per_epoch:
+                        break
+                    batch = batch if isinstance(batch, (list, tuple)) \
+                        else (batch,)
+                    if self._step is None:
+                        with _obs.span("engine.build"):
+                            self._build(batch)
+                        if _obs.enabled():
+                            self._record_build_telemetry(batch)
+                    if not restored:
+                        restored = True
+                        start_step = self._restore_from(mgr)
+                    if global_step < start_step:
+                        # deterministic replay: skip already-trained
+                        # batches without consuming the restored RNG
+                        global_step += 1
+                        continue
+                    if _faults.active():
+                        act = _faults.check("engine.step")
+                        if act is not None:
+                            _faults.apply(act)
+                    self._last_step = global_step
+                    # TrainStep carries its own fused grad-norm health
+                    # when the policy was on at build; the staged-
+                    # pipeline step has none, so the Engine checks the
+                    # loss scalar there
+                    check_loss = _health.enabled() and not getattr(
+                        self._step, "_health_on", False)
+                    try:
+                        self._run_step(batch, global_step, check_loss)
+                    except _health.NonFiniteError:
+                        if mgr is not None:
+                            mgr.emergency_save(
+                                self._collect_state(global_step),
+                                global_step,
+                                reason="non-finite training signal")
+                        raise
+                    global_step += 1
+                    self._last_step = global_step
+                    if mgr is not None and save_freq \
+                            and global_step % int(save_freq) == 0:
+                        mgr.save(self._collect_state(global_step),
+                                 global_step, blocking=not save_async)
+        finally:
+            if hook_token is not None:
+                from ..resilience import emergency
+
+                emergency.unregister(hook_token)
+            if mgr is not None:
+                mgr.wait()
+        return self.history
+
+    def _run_step(self, batch, global_step: int, check_loss: bool):
+        """One training step + history/telemetry bookkeeping."""
         from ... import observability as _obs
         from ...observability import health as _health
 
-        global_step = 0
-        for _ in range(epochs):
-            for i, batch in enumerate(train_data):
-                if steps_per_epoch is not None and i >= steps_per_epoch:
-                    break
-                batch = batch if isinstance(batch, (list, tuple)) else \
-                    (batch,)
-                if self._step is None:
-                    with _obs.span("engine.build"):
-                        self._build(batch)
-                    if _obs.enabled():
-                        self._record_build_telemetry(batch)
-                # TrainStep carries its own fused grad-norm health when
-                # the policy was on at build; the staged-pipeline step
-                # has none, so the Engine checks the loss scalar there
-                check_loss = _health.enabled() and not getattr(
-                    self._step, "_health_on", False)
-                if not _obs.enabled():
-                    loss = self._step(*batch)
-                    loss_f = float(np.asarray(loss._data))
-                    self.history["loss"].append(loss_f)
-                    if check_loss:
-                        _health.record_step(loss_f, source="loss",
-                                            step=global_step)
-                    global_step += 1
-                    continue
-                import time as _time
+        if not _obs.enabled():
+            loss = self._step(*batch)
+            loss_f = float(np.asarray(loss._data))
+            self.history["loss"].append(loss_f)
+            if check_loss:
+                _health.record_step(loss_f, source="loss",
+                                    step=global_step)
+            return
+        import time as _time
 
-                t0 = _time.perf_counter()
-                with _obs.span("engine.step",
-                               args={"step": global_step}):
-                    loss = self._step(*batch)
-                    loss_f = float(np.asarray(loss._data))  # d2h barrier
-                dt = _time.perf_counter() - t0
-                self.history["loss"].append(loss_f)
-                reg = _obs.registry
-                reg.histogram("engine.step_time").observe(dt)
-                reg.counter("engine.steps").inc()
-                if dt > 0:
-                    reg.gauge("engine.tokens_per_s").set(
-                        self._batch_tokens(batch) / dt)
-                reg.gauge("engine.loss").set(loss_f)
-                _obs.flight_recorder.record("engine.step",
-                                            step=global_step,
-                                            loss=loss_f, dur_s=dt)
-                _obs.sample_device_memory()
-                if check_loss:
-                    _health.record_step(loss_f, source="loss",
-                                        step=global_step)
-                global_step += 1
-        return self.history
+        t0 = _time.perf_counter()
+        with _obs.span("engine.step",
+                       args={"step": global_step}):
+            loss = self._step(*batch)
+            loss_f = float(np.asarray(loss._data))  # d2h barrier
+        dt = _time.perf_counter() - t0
+        self.history["loss"].append(loss_f)
+        reg = _obs.registry
+        reg.histogram("engine.step_time").observe(dt)
+        reg.counter("engine.steps").inc()
+        if dt > 0:
+            reg.gauge("engine.tokens_per_s").set(
+                self._batch_tokens(batch) / dt)
+        reg.gauge("engine.loss").set(loss_f)
+        _obs.flight_recorder.record("engine.step",
+                                    step=global_step,
+                                    loss=loss_f, dur_s=dt)
+        _obs.sample_device_memory()
+        if check_loss:
+            _health.record_step(loss_f, source="loss",
+                                step=global_step)
+
+    # ------------------------------------------------- checkpoint/resume
+    def _collect_state(self, step: int):
+        """Assemble the checkpointable training state: model params
+        (sharded tensor save path) plus a ``__train_state__`` object
+        blob carrying the step counter, host RNG key, optimizer state
+        and LR schedule — everything a bit-deterministic resume needs."""
+        from ...core import random as _rng
+
+        state = dict(self.model.state_dict())
+        train = {"step": int(step),
+                 "rng": np.asarray(_rng.get_rng_state())}
+        opt_state = getattr(self._step, "opt_state", None)
+        if opt_state is not None:
+            train["optimizer"] = {
+                k: [np.asarray(e) for e in v]
+                if isinstance(v, (list, tuple)) else np.asarray(v)
+                for k, v in opt_state.items()}
+        from ...optimizer.lr import LRScheduler
+
+        lr = getattr(self.optimizer, "_learning_rate", None)
+        if isinstance(lr, LRScheduler):
+            train["lr_sched"] = lr.state_dict()
+        state["__train_state__"] = train
+        return state
+
+    def _restore_from(self, mgr) -> int:
+        """Restore params/optimizer/RNG/step from the newest valid
+        checkpoint; returns the global step to resume from (0 when no
+        valid checkpoint exists)."""
+        import sys
+
+        from ... import observability as _obs
+
+        found = mgr.latest_valid()
+        if found is None:
+            return 0
+        step, path = found
+        state = dict(self.model.state_dict())
+        state["__train_state__"] = None  # filled by load_state_dict
+        mgr.load(state, path)
+        train = state.get("__train_state__") or {}
+        if hasattr(self._step, "restore_state"):
+            self._step.restore_state(opt_state=train.get("optimizer"))
+        if train.get("rng") is not None:
+            import jax.numpy as jnp
+
+            from ...core import random as _rng
+
+            _rng.set_rng_state(jnp.asarray(train["rng"]))
+        if train.get("lr_sched"):
+            from ...optimizer.lr import LRScheduler
+
+            lr = getattr(self.optimizer, "_learning_rate", None)
+            if isinstance(lr, LRScheduler):
+                lr.set_state_dict(train["lr_sched"])
+        start = int(train.get("step", step))
+        print(f"[resilience] resuming from {path} (step {start})",
+              file=sys.stderr)
+        if _obs.enabled():
+            _obs.registry.counter("resilience.resumes").inc()
+            _obs.flight_recorder.record("resilience.resume", path=path,
+                                        step=start)
+        return start
 
     def evaluate(self, eval_data, steps=None):
         from ...core.autograd import no_grad

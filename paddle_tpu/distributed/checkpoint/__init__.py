@@ -6,6 +6,7 @@ from __future__ import annotations
 import os
 import pickle
 import threading
+import zlib
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
@@ -14,7 +15,7 @@ import numpy as np
 from ...core.tensor import Tensor
 
 __all__ = ["save_state_dict", "load_state_dict", "wait_async_save",
-           "LocalTensorMetadata", "Metadata"]
+           "LocalTensorMetadata", "Metadata", "SaveTicket"]
 
 
 @dataclass
@@ -56,24 +57,88 @@ def _local_view(t: Tensor):
 _async_lock = threading.Lock()
 _async_threads: List[threading.Thread] = []
 
+# in-flight async saves are joined on clean interpreter exit so a
+# checkpoint started near the end of a run is never silently lost
 import atexit as _atexit
 
 _atexit.register(lambda: wait_async_save())
 
 
+class SaveTicket:
+    """Handle returned by :func:`save_state_dict`: ``report`` maps each
+    written filename to its intended ``{"crc32", "size"}`` (computed
+    from the in-memory bytes BEFORE they hit disk, so later on-disk
+    corruption — torn writes, bit rot, injected faults — is detectable
+    against it). For async saves the report fills in on the writer
+    thread; ``wait()`` blocks until it is complete."""
+
+    def __init__(self):
+        self.report: Dict[str, Dict[str, int]] = {}
+        self.error: Optional[BaseException] = None
+        self._done = threading.Event()
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def wait(self, timeout: Optional[float] = None):
+        self._done.wait(timeout)
+        if self.error is not None:
+            raise self.error
+        return self.report
+
+
+def _corrupt_file(fname, act):
+    """Apply an injected ``ckpt.write`` fault to the FINAL file (after
+    the atomic rename): models damage the manifest CRC must catch."""
+    size = os.path.getsize(fname)
+    if act.kind == "truncate":
+        with open(fname, "r+b") as f:
+            f.truncate(max(size // 2, 1))
+    elif act.kind == "bitflip" and size:
+        with open(fname, "r+b") as f:
+            f.seek(size // 3)
+            b = f.read(1)
+            f.seek(size // 3)
+            f.write(bytes([b[0] ^ 0x40]))
+
+
 def _atomic_dump(obj, fname):
     # write-to-temp + rename so a crash/exit mid-write never leaves a
     # truncated file visible under the final name
+    blob = pickle.dumps(obj, protocol=4)
+    crc = zlib.crc32(blob) & 0xFFFFFFFF
     tmp = fname + ".tmp"
     with open(tmp, "wb") as f:
-        pickle.dump(obj, f, protocol=4)
+        f.write(blob)
     os.replace(tmp, fname)
+    from ..resilience import faults as _faults
+
+    act = _faults.check("ckpt.write")
+    if act is not None:
+        if act.kind in ("truncate", "bitflip"):
+            _corrupt_file(fname, act)
+        else:
+            _faults.apply(act)
+    return {"crc32": crc, "size": len(blob)}
 
 
-def _flush_payload(path, fname, shards_payload, meta, is_coordinator):
-    _atomic_dump(shards_payload, fname)
-    if is_coordinator:
-        _atomic_dump(meta, os.path.join(path, "0.metadata"))
+def _flush_payload(path, fname, shards_payload, meta, is_coordinator,
+                   ticket: Optional[SaveTicket] = None):
+    try:
+        report = {os.path.basename(fname):
+                  _atomic_dump(shards_payload, fname)}
+        if is_coordinator:
+            report["0.metadata"] = _atomic_dump(
+                meta, os.path.join(path, "0.metadata"))
+        if ticket is not None:
+            ticket.report.update(report)
+    except BaseException as e:
+        if ticket is None:
+            raise
+        ticket.error = e
+    finally:
+        if ticket is not None:
+            ticket._done.set()
 
 
 def wait_async_save():
@@ -91,7 +156,9 @@ def save_state_dict(state_dict, path, process_group=None,
     """reference: save_state_dict.py:145 (dedup_tensor :117 — only the
     owner rank writes each shard; async queue :46 — ``async_save=True``
     snapshots to host then writes on a background thread; call
-    ``wait_async_save()`` before exiting)."""
+    ``wait_async_save()`` before exiting). Returns a :class:`SaveTicket`
+    whose ``report`` carries per-file CRC32/size (complete immediately
+    for sync saves, after the writer thread finishes for async)."""
     from ..parallel_env import get_rank
 
     os.makedirs(path, exist_ok=True)
@@ -116,6 +183,7 @@ def save_state_dict(state_dict, path, process_group=None,
         meta.storage_metadata[key] = f"{rank}_0.distcp"
     fname = os.path.join(path, f"{rank}_0.distcp")
     is_coord = rank == coordinator_rank
+    ticket = SaveTicket()
     if async_save:
         # tensor shards are already host numpy snapshots (_local_view);
         # deep-copy objects/metadata so caller mutations after return
@@ -128,12 +196,15 @@ def save_state_dict(state_dict, path, process_group=None,
         meta = copy.deepcopy(meta)
         t = threading.Thread(target=_flush_payload,
                              args=(path, fname, shards_payload, meta,
-                                   is_coord), daemon=True)
+                                   is_coord, ticket), daemon=True)
         t.start()
         with _async_lock:
             _async_threads.append(t)
-        return
-    _flush_payload(path, fname, shards_payload, meta, is_coord)
+        return ticket
+    _flush_payload(path, fname, shards_payload, meta, is_coord, ticket)
+    if ticket.error is not None:
+        raise ticket.error
+    return ticket
 
 
 def load_state_dict(state_dict, path, process_group=None,

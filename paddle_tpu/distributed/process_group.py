@@ -49,6 +49,14 @@ class _CollectiveWindow:
 
     def __enter__(self):
         self._watch.__enter__()
+        from .resilience import faults as _faults
+
+        if _faults.active():
+            act = _faults.check("pg.collective")
+            if act is not None:
+                # after _watch.__enter__ so an injected delay lands
+                # INSIDE the watchdog window and can trip the timeout
+                _faults.apply(act)
         if _obs.enabled():
             _obs.flight_recorder.record("pg.collective.start",
                                         op=self.op, group=self.gid)

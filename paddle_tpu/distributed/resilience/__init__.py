@@ -1,0 +1,50 @@
+"""paddle_tpu.distributed.resilience — fault tolerance as a subsystem.
+
+The reference stack survives hung collectives (CommTaskManager/AbortComm),
+dropped store/rpc connections, and partially written checkpoints natively;
+this package gives the reproduction the same reflexes:
+
+- :mod:`retry` — a shared exponential-backoff + jitter + deadline policy
+  applied to TCPStore client ops, rpc posting, and process-group
+  bootstrap barriers.
+- :mod:`faults` — a seeded, deterministic fault-injection harness
+  (``PADDLE_TPU_FAULT_PLAN``) that drops store sockets, loses rpc
+  messages, delays collectives past the watchdog timeout, truncates or
+  bit-flips checkpoint writes, and kills the process mid-run — so every
+  recovery path is *tested*, not hoped for.
+- :mod:`checkpoint_manager` — periodic async checkpoints with per-shard
+  CRC32 manifests, retention, ``latest_valid()`` corruption skipping,
+  and emergency best-effort synchronous saves.
+- :mod:`emergency` — the registry the watchdog timeout path and the
+  health-monitor ``raise`` policy use to trigger an emergency save
+  without depending on the training loop.
+
+``CheckpointManager`` is exposed lazily so importing the light retry /
+fault layers from transport modules never drags in the tensor stack.
+"""
+from __future__ import annotations
+
+from . import faults  # noqa: F401
+from . import retry  # noqa: F401
+from . import emergency  # noqa: F401
+from .retry import RetryPolicy, call_with_retry, default_policy  # noqa: F401
+
+__all__ = ["faults", "retry", "emergency", "RetryPolicy",
+           "call_with_retry", "default_policy", "CheckpointManager",
+           "checkpoint_manager"]
+
+
+def __getattr__(name):
+    # lazy: checkpoint_manager imports distributed.checkpoint (numpy /
+    # core.tensor); transport modules importing resilience.retry must
+    # not pay for it
+    if name in ("CheckpointManager", "checkpoint_manager"):
+        # importlib (not ``from . import``): the fromlist lookup would
+        # re-enter this __getattr__ while the submodule is mid-import
+        import importlib
+
+        mod = importlib.import_module(".checkpoint_manager", __name__)
+        if name == "checkpoint_manager":
+            return mod
+        return mod.CheckpointManager
+    raise AttributeError(name)

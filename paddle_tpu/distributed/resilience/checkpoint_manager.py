@@ -1,0 +1,273 @@
+"""Checkpoint lifecycle on top of the sharded ``distributed/checkpoint``
+module: periodic async saves, per-shard CRC32 manifests, retention,
+``latest_valid()`` corruption skipping, and emergency synchronous saves.
+
+Layout under ``root``::
+
+    step_00000004/
+        0_0.distcp          # per-rank shard payload (sharded save)
+        0.metadata          # coordinator's global metadata
+        MANIFEST_0.json     # per-rank manifest: files + CRC32 + sizes
+    emergency_step_00000007/
+        ...
+
+A checkpoint directory is *valid* iff every rank 0..world_size-1 of the
+save wrote a manifest and every file each manifest lists exists with
+the recorded size and CRC32. The manifest is written only AFTER the
+payload flush completes, so a crash mid-save leaves a manifest-less
+(= invisible) directory, and a torn/corrupted shard fails the CRC —
+``latest_valid()`` skips both and falls back to the previous step.
+
+Async saves snapshot tensors to host synchronously (inside
+``save_state_dict``) and overlap the disk write + manifest finalize
+with subsequent training steps (T3-style compute/IO overlap); ``wait``
+drains them and is registered via ``atexit`` so a clean interpreter
+exit never loses an in-flight save.
+"""
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import re
+import shutil
+import threading
+import time
+import zlib
+from typing import Dict, List, Optional, Tuple
+
+from ..checkpoint import load_state_dict, save_state_dict
+
+__all__ = ["CheckpointManager", "validate_checkpoint_dir"]
+
+_MANIFEST_RE = re.compile(r"^MANIFEST_(\d+)\.json$")
+_STEP_RE = re.compile(r"^(emergency_)?step_(\d+)$")
+
+
+def _crc32_file(path: str, chunk: int = 1 << 20) -> int:
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            b = f.read(chunk)
+            if not b:
+                break
+            crc = zlib.crc32(b, crc)
+    return crc & 0xFFFFFFFF
+
+
+def validate_checkpoint_dir(path: str) -> Tuple[bool, str]:
+    """CRC-validate one checkpoint directory. Returns (ok, detail).
+    Mirrored by the stdlib-only ``tools/verify_checkpoint.py`` so CI can
+    validate checkpoints without importing the framework."""
+    if not os.path.isdir(path):
+        return False, "not a directory"
+    manifests: Dict[int, dict] = {}
+    for fn in os.listdir(path):
+        m = _MANIFEST_RE.match(fn)
+        if not m:
+            continue
+        try:
+            with open(os.path.join(path, fn)) as f:
+                manifests[int(m.group(1))] = json.load(f)
+        except (OSError, ValueError) as e:
+            return False, f"unreadable manifest {fn}: {e}"
+    if not manifests:
+        return False, "no manifest"
+    worlds = {int(man.get("world_size", 1)) for man in manifests.values()}
+    if len(worlds) != 1:
+        return False, f"inconsistent world_size across manifests: {worlds}"
+    world = worlds.pop()
+    missing = sorted(set(range(world)) - set(manifests))
+    if missing:
+        return False, f"missing manifest for rank(s) {missing}"
+    for rank, man in sorted(manifests.items()):
+        for fname, info in man.get("files", {}).items():
+            fpath = os.path.join(path, fname)
+            if not os.path.exists(fpath):
+                return False, f"missing file {fname} (rank {rank})"
+            size = os.path.getsize(fpath)
+            if size != int(info["size"]):
+                return False, (f"size mismatch {fname}: "
+                               f"{size} != {info['size']}")
+            crc = _crc32_file(fpath)
+            if crc != int(info["crc32"]):
+                return False, (f"crc mismatch {fname}: "
+                               f"{crc:#010x} != {int(info['crc32']):#010x}")
+    return True, "ok"
+
+
+class CheckpointManager:
+    """Periodic + emergency checkpoints with CRC manifests, retention
+    (``keep_last``) and corrupt-skip resume."""
+
+    def __init__(self, root: str, keep_last: int = 3,
+                 rank: Optional[int] = None,
+                 world_size: Optional[int] = None):
+        from ..parallel_env import get_rank, get_world_size
+
+        self.root = root
+        self.keep_last = max(int(keep_last), 1)
+        self._rank = get_rank() if rank is None else int(rank)
+        self._world = get_world_size() if world_size is None \
+            else int(world_size)
+        os.makedirs(root, exist_ok=True)
+        self._lock = threading.Lock()
+        self._pending: List[threading.Thread] = []
+        # a clean exit must not lose the last in-flight async save
+        atexit.register(self.wait)
+
+    # ---------------------------------------------------------------- paths
+    def step_dir(self, step: int, emergency: bool = False) -> str:
+        tag = "emergency_step_" if emergency else "step_"
+        return os.path.join(self.root, f"{tag}{int(step):08d}")
+
+    def checkpoints(self) -> List[Tuple[int, str]]:
+        """All checkpoint dirs (valid or not), newest step first; at the
+        same step a regular save sorts before its emergency sibling."""
+        out = []
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return []
+        for fn in names:
+            m = _STEP_RE.match(fn)
+            if m:
+                out.append((int(m.group(2)), m.group(1) is None, fn))
+        out.sort(reverse=True)
+        return [(step, os.path.join(self.root, fn))
+                for step, _, fn in out]
+
+    # ----------------------------------------------------------------- save
+    def save(self, state_dict, step: int, blocking: bool = False,
+             emergency: bool = False) -> str:
+        """Checkpoint ``state_dict`` for ``step``. Non-blocking saves
+        snapshot to host now and finalize (flush + CRC manifest +
+        retention) on a background thread."""
+        from ... import observability as _obs
+
+        path = self.step_dir(step, emergency)
+        os.makedirs(path, exist_ok=True)
+        with _obs.span("ckpt.save", args={"step": int(step),
+                                          "blocking": bool(blocking)}):
+            ticket = save_state_dict(state_dict, path,
+                                     async_save=not blocking)
+            if blocking:
+                self._finalize(path, step, ticket, emergency)
+            else:
+                t = threading.Thread(
+                    target=self._finalize_bg,
+                    args=(path, step, ticket, emergency), daemon=True)
+                t.start()
+                with self._lock:
+                    self._pending.append(t)
+        return path
+
+    def emergency_save(self, state_dict, step: int,
+                       reason: str = "") -> Optional[str]:
+        """Best-effort synchronous save (watchdog timeout / health
+        ``raise`` path). Never raises — the original failure must keep
+        propagating."""
+        import sys
+
+        try:
+            path = self.save(state_dict, step, blocking=True,
+                             emergency=True)
+            print(f"[resilience] emergency checkpoint (step {step}): "
+                  f"{path}" + (f" — {reason}" if reason else ""),
+                  file=sys.stderr)
+            return path
+        except Exception:
+            import traceback
+
+            traceback.print_exc()
+            return None
+
+    def _finalize_bg(self, path, step, ticket, emergency):
+        try:
+            ticket.wait()
+        except BaseException:
+            import traceback
+
+            traceback.print_exc()
+            return  # no manifest: the directory stays invisible
+        self._finalize(path, step, ticket, emergency)
+
+    def _finalize(self, path, step, ticket, emergency):
+        if not ticket.done():
+            ticket.wait()
+        manifest = {
+            "format": 1,
+            "step": int(step),
+            "rank": self._rank,
+            "world_size": self._world,
+            "emergency": bool(emergency),
+            "unix_time": time.time(),
+            "files": ticket.report,
+        }
+        mpath = os.path.join(path, f"MANIFEST_{self._rank}.json")
+        tmp = f"{mpath}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(manifest, f, indent=1, sort_keys=True)
+        os.replace(tmp, mpath)
+        try:
+            from ... import observability as _obs
+
+            if _obs.enabled():
+                _obs.registry.counter(
+                    "resilience.emergency_saves" if emergency
+                    else "resilience.checkpoint_saves").inc()
+                _obs.flight_recorder.record(
+                    "resilience.checkpoint_saved", step=int(step),
+                    path=path, emergency=bool(emergency))
+        except Exception:
+            pass
+        if self._rank == 0 and not emergency:
+            self._retain()
+
+    def _retain(self):
+        """Drop the oldest VALID regular checkpoints beyond keep_last
+        (invalid/in-progress dirs are never deleted here: an in-flight
+        async save looks invalid until its manifest lands)."""
+        valid = [(step, p) for step, p in self.checkpoints()
+                 if os.path.basename(p).startswith("step_")
+                 and validate_checkpoint_dir(p)[0]]
+        for _, p in valid[self.keep_last:]:
+            shutil.rmtree(p, ignore_errors=True)
+
+    def wait(self) -> None:
+        """Drain pending async finalizes (also runs via ``atexit``)."""
+        with self._lock:
+            pending, self._pending = self._pending, []
+        for t in pending:
+            t.join()
+
+    # --------------------------------------------------------------- resume
+    def latest_valid(self) -> Optional[Tuple[int, str]]:
+        """Newest checkpoint that passes CRC validation, skipping (and
+        counting) corrupt or partially written ones."""
+        for step, path in self.checkpoints():
+            ok, detail = validate_checkpoint_dir(path)
+            if ok:
+                return step, path
+            import sys
+
+            print(f"[resilience] skipping invalid checkpoint {path}: "
+                  f"{detail}", file=sys.stderr)
+            try:
+                from ... import observability as _obs
+
+                if _obs.enabled():
+                    _obs.registry.counter(
+                        "resilience.corrupt_checkpoints").inc()
+                    _obs.flight_recorder.record(
+                        "resilience.checkpoint_skipped", path=path,
+                        detail=detail)
+            except Exception:
+                pass
+        return None
+
+    def load(self, state_dict, path: str) -> None:
+        from ... import observability as _obs
+
+        with _obs.span("ckpt.restore", args={"path": path}):
+            load_state_dict(state_dict, path)

@@ -1,0 +1,61 @@
+"""Emergency-checkpoint hook registry.
+
+The components that *detect* a dying job (the collective watchdog's
+timeout path, the health monitor's ``raise`` policy) know nothing about
+the training loop; the component that can *save* it (the Engine's
+CheckpointManager) knows nothing about watchdogs. This tiny stdlib-only
+registry connects them: the Engine registers a best-effort synchronous
+save hook for the duration of ``fit``, and the failure paths call
+:func:`trigger` right before the debug bundle / abort.
+
+Hooks must be fast and must never raise (failures are swallowed —
+an emergency save must not mask the original failure).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional
+
+__all__ = ["register", "unregister", "trigger", "hook_count"]
+
+_lock = threading.Lock()
+_hooks: Dict[int, Callable[[str], Optional[str]]] = {}
+_next_id = 0
+
+
+def register(hook: Callable[[str], Optional[str]]) -> int:
+    """Register ``hook(reason) -> saved_path_or_None``; returns a token
+    for :func:`unregister`."""
+    global _next_id
+    with _lock:
+        _next_id += 1
+        _hooks[_next_id] = hook
+        return _next_id
+
+
+def unregister(token: int) -> None:
+    with _lock:
+        _hooks.pop(token, None)
+
+
+def hook_count() -> int:
+    with _lock:
+        return len(_hooks)
+
+
+def trigger(reason: str) -> List[str]:
+    """Run every registered hook; return the paths of successful saves.
+    Never raises."""
+    with _lock:
+        hooks = list(_hooks.values())
+    saved: List[str] = []
+    for hook in hooks:
+        try:
+            out = hook(reason)
+            if out:
+                saved.append(str(out))
+        except Exception:
+            import traceback
+
+            traceback.print_exc()
+    return saved

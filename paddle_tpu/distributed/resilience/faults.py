@@ -1,0 +1,197 @@
+"""Deterministic fault-injection harness (``PADDLE_TPU_FAULT_PLAN``).
+
+A *plan* is a ``;``-separated list of rules::
+
+    site:kind[=value]@spec
+
+- ``site`` — an instrumented injection point. In-tree sites:
+  ``store.op`` (TCPStore client frame exchange), ``rpc.post`` (rpc
+  message send), ``pg.collective`` (inside the watchdog window of every
+  collective), ``ckpt.write`` (checkpoint shard/metadata write, AFTER
+  the atomic rename), ``engine.step`` (top of every Engine.fit step).
+- ``kind`` — what to inject: ``drop`` (close + fail the store socket),
+  ``loss`` (silently discard an rpc message), ``delay=<s>`` (sleep,
+  e.g. past the watchdog timeout), ``truncate`` / ``bitflip``
+  (corrupt the just-written checkpoint file), ``kill[=<code>]``
+  (``os._exit``, a hard crash), ``raise`` (ConnectionError).
+- ``spec`` — WHEN: ``@2`` the 2nd invocation of that site, ``@2,5``
+  the 2nd and 5th, ``@p0.05`` each invocation with probability 0.05
+  drawn from a ``random.Random(PADDLE_TPU_FAULT_SEED)`` — seeded, so a
+  given (plan, seed) replays the exact same fault schedule.
+
+Example::
+
+    PADDLE_TPU_FAULT_PLAN="store.op:drop@3;engine.step:kill=31@7"
+
+Sites call :func:`check` (cheap: one bool when no plan is active) and
+handle site-specific kinds themselves; :func:`apply` executes the
+generic kinds (delay / kill / raise). Every injection is counted
+(``resilience.injected_faults``), flight-recorded, and appended to the
+in-process :func:`injected` log so tests can assert the schedule fired.
+"""
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["FaultAction", "configure", "reset", "active", "check",
+           "apply", "injected", "plan_text"]
+
+
+class FaultAction:
+    __slots__ = ("site", "kind", "value", "invocation")
+
+    def __init__(self, site: str, kind: str, value: Optional[str],
+                 invocation: int):
+        self.site = site
+        self.kind = kind
+        self.value = value
+        self.invocation = invocation
+
+    def __repr__(self):
+        v = f"={self.value}" if self.value is not None else ""
+        return (f"FaultAction({self.site}:{self.kind}{v}"
+                f"@{self.invocation})")
+
+
+class _Rule:
+    __slots__ = ("kind", "value", "at", "prob")
+
+    def __init__(self, kind: str, value: Optional[str],
+                 at: Tuple[int, ...], prob: Optional[float]):
+        self.kind = kind
+        self.value = value
+        self.at = at
+        self.prob = prob
+
+
+_lock = threading.Lock()
+_rules: Dict[str, List[_Rule]] = {}
+_counters: Dict[str, int] = {}
+_rng = random.Random(0)
+_log: List[FaultAction] = []
+_plan_text: Optional[str] = None
+_env_loaded = False
+
+
+def _parse(plan: str) -> Dict[str, List[_Rule]]:
+    rules: Dict[str, List[_Rule]] = {}
+    for entry in plan.split(";"):
+        entry = entry.strip()
+        if not entry:
+            continue
+        try:
+            site, rest = entry.split(":", 1)
+            action, spec = rest.rsplit("@", 1)
+            value = None
+            if "=" in action:
+                action, value = action.split("=", 1)
+            spec = spec.strip()
+            if spec.startswith("p"):
+                at, prob = (), float(spec[1:])
+            else:
+                at = tuple(int(x) for x in spec.split(",") if x.strip())
+                prob = None
+        except ValueError as e:
+            raise ValueError(
+                f"bad PADDLE_TPU_FAULT_PLAN entry {entry!r} "
+                f"(want site:kind[=value]@n[,n...]|@p<prob>)") from e
+        rules.setdefault(site.strip(), []).append(
+            _Rule(action.strip(), value, at, prob))
+    return rules
+
+
+def configure(plan: Optional[str], seed: Optional[int] = None) -> None:
+    """Install a plan (None/'' clears). Resets invocation counters and
+    the injection log; the probability stream restarts from ``seed``."""
+    global _rules, _counters, _rng, _log, _plan_text, _env_loaded
+    with _lock:
+        _env_loaded = True
+        _plan_text = plan or None
+        _rules = _parse(plan) if plan else {}
+        _counters = {}
+        _log = []
+        if seed is None:
+            seed = int(os.environ.get("PADDLE_TPU_FAULT_SEED", "0"))
+        _rng = random.Random(seed)
+
+
+def reset() -> None:
+    configure(None)
+
+
+def _ensure_env_loaded() -> None:
+    global _env_loaded
+    if not _env_loaded:
+        configure(os.environ.get("PADDLE_TPU_FAULT_PLAN"))
+
+
+def active() -> bool:
+    _ensure_env_loaded()
+    return bool(_rules)
+
+
+def plan_text() -> Optional[str]:
+    _ensure_env_loaded()
+    return _plan_text
+
+
+def injected() -> List[FaultAction]:
+    with _lock:
+        return list(_log)
+
+
+def _record(act: FaultAction) -> None:
+    try:
+        from ... import observability as _obs
+
+        if _obs.enabled():
+            _obs.registry.counter(
+                "resilience.injected_faults",
+                tags={"site": act.site, "kind": act.kind}).inc()
+            _obs.flight_recorder.record(
+                "resilience.fault_injected", site=act.site,
+                kind=act.kind, value=act.value,
+                invocation=act.invocation)
+    except Exception:
+        pass
+    import sys
+
+    print(f"[fault-injection] {act!r}", file=sys.stderr)
+
+
+def check(site: str) -> Optional[FaultAction]:
+    """Count one invocation of ``site``; return the action to inject at
+    this invocation, or None. At most one rule fires per invocation."""
+    _ensure_env_loaded()
+    if not _rules:
+        return None
+    with _lock:
+        n = _counters.get(site, 0) + 1
+        _counters[site] = n
+        for rule in _rules.get(site, ()):
+            hit = (n in rule.at) if rule.prob is None else \
+                (_rng.random() < rule.prob)
+            if hit:
+                act = FaultAction(site, rule.kind, rule.value, n)
+                _log.append(act)
+                break
+        else:
+            return None
+    _record(act)
+    return act
+
+
+def apply(act: FaultAction) -> None:
+    """Execute the generic kinds. Site-specific kinds (drop / loss /
+    truncate / bitflip) are handled at the call site and ignored here."""
+    if act.kind == "delay":
+        time.sleep(float(act.value if act.value is not None else 1.0))
+    elif act.kind == "kill":
+        os._exit(int(act.value if act.value is not None else 17))
+    elif act.kind == "raise":
+        raise ConnectionError(f"fault-injected error at {act.site} "
+                              f"(invocation {act.invocation})")

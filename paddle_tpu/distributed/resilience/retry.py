@@ -1,0 +1,147 @@
+"""Shared retry policy: exponential backoff + deterministic jitter +
+deadline (reference analog: the reconnect/retry loops inside the C++
+TCPStore client and brpc agent, here factored into ONE policy object so
+every distributed I/O path — store ops, rpc posting, process-group
+bootstrap — backs off the same way).
+
+Env knobs (read once per :func:`default_policy` call):
+
+- ``PADDLE_TPU_RETRY_MAX_ATTEMPTS`` (default 5) — total attempts
+- ``PADDLE_TPU_RETRY_BASE_DELAY``   (default 0.05 s) — first backoff
+- ``PADDLE_TPU_RETRY_MAX_DELAY``    (default 2.0 s) — backoff ceiling
+- ``PADDLE_TPU_RETRY_SEED``         (default 0) — jitter seed
+
+Jitter is drawn from a ``random.Random`` seeded per call site, so a
+given (seed, site) produces the same delay sequence on every run — the
+fault-injection tests rely on that determinism.
+
+Telemetry: each retried attempt increments ``resilience.retries``
+(tagged by site) and records a flight-recorder event when telemetry is
+enabled; the RETRY itself works regardless — recovery is a correctness
+feature, not a metrics feature.
+"""
+from __future__ import annotations
+
+import os
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional, Tuple, Type
+
+__all__ = ["RetryPolicy", "default_policy", "call_with_retry", "retry"]
+
+# TimeoutError is an OSError subclass since 3.10, listed explicitly for
+# readers; ConnectionError covers reset/refused/aborted.
+_DEFAULT_RETRY_ON: Tuple[Type[BaseException], ...] = (
+    ConnectionError, TimeoutError, OSError)
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    max_attempts: int = 5
+    base_delay: float = 0.05
+    max_delay: float = 2.0
+    multiplier: float = 2.0
+    jitter: float = 0.25            # +[0, jitter) fraction of the delay
+    deadline: Optional[float] = None  # overall budget in seconds
+    retry_on: Tuple[Type[BaseException], ...] = field(
+        default=_DEFAULT_RETRY_ON)
+
+    def delay(self, attempt: int, rng: random.Random) -> float:
+        """Backoff before retry number ``attempt`` (1-based)."""
+        d = min(self.max_delay,
+                self.base_delay * self.multiplier ** (attempt - 1))
+        return d * (1.0 + self.jitter * rng.random())
+
+    def with_deadline(self, deadline: Optional[float]) -> "RetryPolicy":
+        if deadline is None:
+            return self
+        return RetryPolicy(self.max_attempts, self.base_delay,
+                           self.max_delay, self.multiplier, self.jitter,
+                           deadline, self.retry_on)
+
+
+def default_policy(deadline: Optional[float] = None,
+                   **overrides) -> RetryPolicy:
+    """Policy from the ``PADDLE_TPU_RETRY_*`` env knobs."""
+    kw = dict(
+        max_attempts=int(os.environ.get(
+            "PADDLE_TPU_RETRY_MAX_ATTEMPTS", "5")),
+        base_delay=float(os.environ.get(
+            "PADDLE_TPU_RETRY_BASE_DELAY", "0.05")),
+        max_delay=float(os.environ.get(
+            "PADDLE_TPU_RETRY_MAX_DELAY", "2.0")),
+        deadline=deadline,
+    )
+    kw.update(overrides)
+    return RetryPolicy(**kw)
+
+
+def _jitter_rng(site: str) -> random.Random:
+    seed = int(os.environ.get("PADDLE_TPU_RETRY_SEED", "0"))
+    # stable per (seed, site): zlib.crc32 is deterministic across runs,
+    # unlike hash() under PYTHONHASHSEED randomization
+    import zlib
+
+    return random.Random(seed ^ zlib.crc32(site.encode()))
+
+
+def _record_retry(site: str, attempt: int, err: BaseException) -> None:
+    try:
+        from ... import observability as _obs
+
+        if _obs.enabled():
+            _obs.registry.counter("resilience.retries",
+                                  tags={"site": site}).inc()
+            _obs.flight_recorder.record("resilience.retry", site=site,
+                                        attempt=attempt,
+                                        error=type(err).__name__)
+    except Exception:
+        pass
+
+
+def call_with_retry(fn: Callable, policy: Optional[RetryPolicy] = None,
+                    site: str = "retry",
+                    on_retry: Optional[Callable] = None,
+                    sleep: Callable[[float], None] = time.sleep):
+    """Run ``fn()`` under ``policy``; between attempts call
+    ``on_retry(error)`` (e.g. a socket reconnect) and back off. The
+    deadline bounds the WHOLE call: a retry whose backoff would cross
+    it re-raises the last error instead of sleeping past the budget."""
+    policy = policy or default_policy()
+    rng = _jitter_rng(site)
+    start = time.monotonic()
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except policy.retry_on as e:
+            attempt += 1
+            if attempt >= policy.max_attempts:
+                raise
+            d = policy.delay(attempt, rng)
+            if policy.deadline is not None and \
+                    time.monotonic() + d - start > policy.deadline:
+                raise
+            _record_retry(site, attempt, e)
+            if on_retry is not None:
+                try:
+                    on_retry(e)
+                except Exception:
+                    pass  # reconnect failure surfaces on the next attempt
+            sleep(d)
+
+
+def retry(policy: Optional[RetryPolicy] = None, site: Optional[str] = None):
+    """Decorator form of :func:`call_with_retry`."""
+    def deco(fn):
+        import functools
+
+        s = site or getattr(fn, "__qualname__", "retry")
+
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs):
+            return call_with_retry(lambda: fn(*args, **kwargs),
+                                   policy=policy, site=s)
+        return wrapped
+    return deco

@@ -10,10 +10,12 @@ the collective path.
 """
 from __future__ import annotations
 
+import os
 import pickle
 import threading
 import time
 import traceback
+from collections import deque
 from concurrent.futures import Future
 from dataclasses import dataclass
 from typing import Dict, Optional
@@ -43,6 +45,12 @@ class _RpcAgent:
         self._send_seq: Dict[str, int] = {}
         self._futures: Dict[str, Future] = {}
         self._orphans: Dict[str, float] = {}  # call_id -> give-up deadline
+        # retransmit state per in-flight call (at-least-once delivery:
+        # a lost request is re-posted on a backoff schedule; the server
+        # dedups by call_id so duplicates never re-execute)
+        self._call_meta: Dict[str, dict] = {}
+        self._handled: set = set()
+        self._handled_order: deque = deque()
         self._lock = threading.Lock()
         self._stop = False
         # registry: name -> rank
@@ -58,6 +66,13 @@ class _RpcAgent:
 
     # ------------------------------------------------------------ transport
     def _post(self, to_rank: int, payload: dict):
+        from .resilience import faults as _faults
+
+        act = _faults.check("rpc.post")
+        if act is not None:
+            if act.kind in ("loss", "drop"):
+                return  # message silently lost in transit
+            _faults.apply(act)
         key = f"{self._ns}/mbox/{to_rank}"
         with self._lock:
             seq = self._send_seq.get(key, 0)
@@ -89,6 +104,18 @@ class _RpcAgent:
                     msg = pickle.loads(raw)
                     if msg.get("kind") != "call":
                         continue
+                    # at-least-once dedup: a retransmitted request whose
+                    # original was delivered must not re-execute (the
+                    # reply is still in / was already read from the
+                    # store, keyed by call_id)
+                    cid = msg.get("call_id")
+                    if cid in self._handled:
+                        continue
+                    if len(self._handled_order) >= 8192:
+                        self._handled.discard(
+                            self._handled_order.popleft())
+                    self._handled.add(cid)
+                    self._handled_order.append(cid)
                     try:
                         from .. import observability as _obs
 
@@ -127,9 +154,59 @@ class _RpcAgent:
             if not progressed:
                 time.sleep(0.01)
 
+    def _deadlines_and_resends(self):
+        """Expire calls past their deadline (TimeoutError on the future)
+        and re-post calls whose retransmit backoff elapsed."""
+        now = time.monotonic()
+        expired, resend = [], []
+        with self._lock:
+            for cid, meta in list(self._call_meta.items()):
+                fut = self._futures.get(cid)
+                if fut is None:                    # resolved or dropped
+                    self._call_meta.pop(cid, None)
+                    continue
+                if meta["deadline"] is not None and now > meta["deadline"]:
+                    self._futures.pop(cid, None)
+                    self._call_meta.pop(cid, None)
+                    # watch for the late reply for 10 min, then give up
+                    self._orphans[cid] = now + 600.0
+                    expired.append((cid, fut, meta))
+                    continue
+                if meta["resend_at"] is not None and now >= meta["resend_at"]:
+                    meta["attempt"] += 1
+                    policy = meta["policy"]
+                    if meta["attempt"] >= policy.max_attempts - 1:
+                        meta["resend_at"] = None   # out of retransmits
+                    else:
+                        meta["resend_at"] = now + policy.delay(
+                            meta["attempt"] + 1, meta["rng"])
+                    resend.append((cid, meta))
+        for cid, fut, meta in expired:
+            fut.set_exception(TimeoutError(
+                f"rpc call {cid} got no reply within "
+                f"{meta['timeout']}s ({meta['attempt']} retransmits)"))
+        for cid, meta in resend:
+            try:
+                from .. import observability as _obs
+
+                if _obs.enabled():
+                    _obs.registry.counter(
+                        "resilience.retries",
+                        tags={"site": "rpc.resend"}).inc()
+                    _obs.flight_recorder.record(
+                        "resilience.retry", site="rpc.resend",
+                        call_id=cid, attempt=meta["attempt"])
+            except Exception:
+                pass
+            try:
+                self._post(meta["to"], meta["payload"])
+            except Exception:
+                pass  # next backoff (or the deadline) handles it
+
     def _collect(self):
         """Resolve futures as replies land."""
         while not self._stop:
+            self._deadlines_and_resends()
             done = []
             with self._lock:
                 items = list(self._futures.items())
@@ -178,8 +255,11 @@ class _RpcAgent:
     # ------------------------------------------------------------ calls
     _call_counter = 0
 
-    def call(self, to: str, fn, args, kwargs) -> Future:
+    def call(self, to: str, fn, args, kwargs,
+             timeout: Optional[float] = None,
+             retry_policy=None) -> Future:
         from .. import observability as _obs
+        from .resilience import retry as _retry
 
         info = self.workers[to]
         with self._lock:
@@ -192,6 +272,26 @@ class _RpcAgent:
             "fn": pickle.dumps(fn, protocol=4),
             "args": args, "kwargs": kwargs,
         }
+        # retransmit schedule: the rpc timeout becomes the DEADLINE of
+        # the retry policy; until it expires, a silently lost request is
+        # re-posted on exponential backoff (server dedups by call_id)
+        policy = retry_policy or _retry.default_policy(
+            deadline=timeout,
+            max_attempts=int(os.environ.get("PADDLE_TPU_RPC_RETRIES",
+                                            "4")),
+            base_delay=float(os.environ.get(
+                "PADDLE_TPU_RPC_RETRY_BASE_DELAY", "0.25")),
+            max_delay=4.0)
+        now = time.monotonic()
+        rng = _retry._jitter_rng(f"rpc.resend/{call_id}")
+        with self._lock:
+            self._call_meta[call_id] = {
+                "to": info.rank, "payload": payload, "attempt": 0,
+                "timeout": timeout, "policy": policy, "rng": rng,
+                "deadline": None if timeout is None else now + timeout,
+                "resend_at": (now + policy.delay(1, rng)
+                              if policy.max_attempts > 1 else None),
+            }
         if _obs.enabled():
             # stamp the caller's trace context; the peer's dispatcher
             # adopts it, stitching client and server spans
@@ -270,10 +370,13 @@ def _require_agent() -> _RpcAgent:
 
 
 def rpc_sync(to: str, fn, args=(), kwargs=None, timeout: float = 60.0):
-    """reference: rpc.py:160."""
-    fut = rpc_async(to, fn, args, kwargs)
+    """reference: rpc.py:160. ``timeout`` is both the result deadline
+    and the retransmit budget (see :func:`rpc_async`)."""
+    fut = rpc_async(to, fn, args, kwargs, timeout=timeout)
     try:
-        return fut.result(timeout=timeout)
+        # the agent's deadline sweep fails the future at ~timeout; the
+        # small slack keeps the two timers from racing
+        return fut.result(timeout=timeout + 5.0)
     except Exception:
         # drop the orphaned future; remember the call_id so _collect
         # deletes the late reply instead of leaking it in the store
@@ -287,10 +390,19 @@ def rpc_sync(to: str, fn, args=(), kwargs=None, timeout: float = 60.0):
         raise
 
 
-def rpc_async(to: str, fn, args=(), kwargs=None) -> Future:
+def rpc_async(to: str, fn, args=(), kwargs=None, timeout=None,
+              retry_policy=None) -> Future:
     """reference: rpc.py:206. Returns a concurrent.futures.Future with
-    .result()/.wait() semantics (the reference FutureWrapper analog)."""
-    return _require_agent().call(to, fn, args, kwargs or {})
+    .result()/.wait() semantics (the reference FutureWrapper analog).
+
+    ``timeout`` (seconds) is propagated as the DEADLINE of the retry
+    policy governing retransmits: unacknowledged calls are re-posted on
+    exponential backoff until the deadline, after which the future fails
+    with TimeoutError. Without it, resends stop after
+    PADDLE_TPU_RPC_RETRIES attempts and the future waits indefinitely."""
+    return _require_agent().call(to, fn, args, kwargs or {},
+                                 timeout=timeout,
+                                 retry_policy=retry_policy)
 
 
 def get_worker_info(name: Optional[str] = None) -> WorkerInfo:

@@ -175,46 +175,99 @@ class TCPStore:
     def port(self):
         return self._port
 
+    # ------------------------------------------------------------ transport
+    def _reconnect(self):
+        """Replace a dead client socket (daemon restarts keep the KV, so
+        reconnect-and-retry makes every op survive a dropped socket)."""
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        self._sock = socket.create_connection(
+            (self._host, self._port), timeout=5)
+        self._sock.settimeout(self._timeout)
+
+    def _roundtrip(self, op: int, key: bytes, value: bytes):
+        """One frame exchange under the shared retry policy: a
+        mid-operation ``ConnectionError``/``OSError`` (peer reset,
+        closed socket, injected drop) reconnects and retries instead of
+        failing the collective bootstrap outright. Ops are idempotent
+        enough for at-least-once delivery (set/get/wait/check are pure;
+        ADD may double-apply only when the reply itself is lost)."""
+        from .resilience import faults as _faults, retry as _retry
+
+        def attempt():
+            with self._lock:
+                act = _faults.check("store.op")
+                if act is not None:
+                    if act.kind == "drop":
+                        try:
+                            self._sock.close()
+                        except OSError:
+                            pass
+                        raise ConnectionError(
+                            "fault-injected store socket drop")
+                    _faults.apply(act)
+                try:
+                    _send_frame(self._sock, op, key, value)
+                    return _recv_frame(self._sock)
+                except (ConnectionError, OSError):
+                    # reconnect NOW (under the lock) so the next attempt
+                    # starts on a fresh socket; a failed reconnect
+                    # becomes this attempt's error and is retried
+                    self._reconnect()
+                    raise
+
+        return _retry.call_with_retry(attempt, site="store.op")
+
+    def _native_op(self, fn, *args):
+        from .resilience import faults as _faults, retry as _retry
+
+        def attempt():
+            act = _faults.check("store.op")
+            if act is not None and act.kind != "drop":
+                _faults.apply(act)
+            return fn(*args)
+
+        return _retry.call_with_retry(attempt, site="store.op")
+
     def set(self, key: str, value) -> None:
         if isinstance(value, str):
             value = value.encode()
         if self._native:
-            self._client.set(key.encode(), bytes(value))
+            self._native_op(self._client.set, key.encode(), bytes(value))
             return
-        with self._lock:
-            _send_frame(self._sock, _OP_SET, key.encode(), bytes(value))
-            _recv_frame(self._sock)
+        self._roundtrip(_OP_SET, key.encode(), bytes(value))
 
     def get(self, key: str) -> bytes:
         self.wait([key])
         if self._native:
-            return self._client.get(key.encode())
-        with self._lock:
-            _send_frame(self._sock, _OP_GET, key.encode(), b"")
-            _, _, v = _recv_frame(self._sock)
+            return self._native_op(self._client.get, key.encode())
+        _, _, v = self._roundtrip(_OP_GET, key.encode(), b"")
         return v
 
     def add(self, key: str, delta: int) -> int:
         if self._native:
-            return self._client.add(key.encode(), delta)
-        with self._lock:
-            _send_frame(self._sock, _OP_ADD, key.encode(),
-                        struct.pack(">q", delta))
-            _, _, v = _recv_frame(self._sock)
+            return self._native_op(self._client.add, key.encode(), delta)
+        _, _, v = self._roundtrip(_OP_ADD, key.encode(),
+                                  struct.pack(">q", delta))
         return struct.unpack(">q", v)[0]
 
     def wait(self, keys, timeout: Optional[float] = None) -> None:
         timeout = timeout if timeout is not None else self._timeout
         for key in keys:
             if self._native:
-                if not self._client.wait(key.encode(), int(timeout * 1000)):
+                ok = self._native_op(self._client.wait, key.encode(),
+                                     int(timeout * 1000))
+                if not ok:
                     raise TimeoutError(
                         f"TCPStore wait timed out on key {key!r}")
                 continue
-            with self._lock:
-                _send_frame(self._sock, _OP_WAIT, key.encode(),
-                            struct.pack(">q", int(timeout * 1000)))
-                _, _, v = _recv_frame(self._sock)
+            # only the frame exchange is retried; the server answering
+            # "not set within the timeout" is an application timeout and
+            # must surface immediately, not be retried
+            _, _, v = self._roundtrip(_OP_WAIT, key.encode(),
+                                      struct.pack(">q", int(timeout * 1000)))
             if v != b"1":
                 raise TimeoutError(f"TCPStore wait timed out on key {key!r}")
 
@@ -222,18 +275,14 @@ class TCPStore:
         """Remove a key (protocol op 5); True if it existed. Long-lived
         control planes (rpc) use this to reclaim consumed mailbox keys."""
         if self._native:
-            return self._client.delete(key.encode())
-        with self._lock:
-            _send_frame(self._sock, _OP_DEL, key.encode(), b"")
-            _, _, v = _recv_frame(self._sock)
+            return self._native_op(self._client.delete, key.encode())
+        _, _, v = self._roundtrip(_OP_DEL, key.encode(), b"")
         return v == b"1"
 
     def check(self, key: str) -> bool:
         if self._native:
-            return self._client.check(key.encode())
-        with self._lock:
-            _send_frame(self._sock, _OP_CHECK, key.encode(), b"")
-            _, _, v = _recv_frame(self._sock)
+            return self._native_op(self._client.check, key.encode())
+        _, _, v = self._roundtrip(_OP_CHECK, key.encode(), b"")
         return v == b"1"
 
     def barrier(self, prefix: str, world_size: int, rank: int):

@@ -118,6 +118,19 @@ class CommTaskManager:
             import traceback
 
             traceback.print_exc()
+        # best-effort emergency checkpoint next to the debug bundle —
+        # the Engine registers a synchronous save hook during fit()
+        try:
+            from .resilience import emergency
+
+            saved = emergency.trigger(f"comm watchdog timeout: {task!r}")
+            for p in saved:
+                print(f"[comm-watchdog] emergency checkpoint: {p}",
+                      file=sys.stderr)
+        except Exception:
+            import traceback
+
+            traceback.print_exc()
 
     def _default_abort(self, task: CommTask):
         # reference AbortComm: tear the process down so the launcher's

@@ -597,6 +597,38 @@ class TrainStep:
         for p, a in zip(self._params, self.param_arrays):
             p._data = a
 
+    def restore_state(self, opt_state=None):
+        """Re-adopt the model's current parameter arrays (after an
+        in-place ``load_state_dict``) and optionally replace the
+        optimizer state — the checkpoint-resume path. Re-applies the
+        mesh placement so restored host arrays match the compiled
+        step's declared in_shardings."""
+        arrays = [jnp.asarray(p._data) for p in self._params]
+        if self._mesh is not None:
+            arrays = [jax.device_put(a, NamedSharding(self._mesh, s))
+                      for a, s in zip(arrays, self._param_specs)]
+        self.param_arrays = arrays
+        self.sync_params_to_model()
+        if opt_state is None:
+            return
+        state = {k: [jnp.asarray(e) for e in v]
+                 if isinstance(v, (list, tuple)) else jnp.asarray(v)
+                 for k, v in opt_state.items()}
+        if self._mesh is not None:
+            specs = _tree_map_specs(
+                state, self._param_specs, self._mesh,
+                like_shapes=[tuple(a.shape) for a in self.param_arrays])
+            placed = {}
+            for k, v in state.items():
+                sp = specs[k]
+                if isinstance(v, (list, tuple)):
+                    placed[k] = [jax.device_put(e, s)
+                                 for e, s in zip(v, sp)]
+                else:
+                    placed[k] = jax.device_put(v, sp)
+            state = placed
+        self.opt_state = state
+
     def lower(self, *batch):
         """AOT-lower for inspection (cost_analysis) without compiling."""
         arrays = tuple(b._data if isinstance(b, Tensor) else jnp.asarray(b)

@@ -120,6 +120,27 @@ METRICS = {
     "train.nonfinite_steps": MetricSpec(
         "counter", "steps", "training steps whose global grad norm (or "
         "loss) was NaN/Inf; the health policy decides warn/skip/raise"),
+    # ---- fault tolerance (distributed/resilience/)
+    "resilience.retries": MetricSpec(
+        "counter", "retries", "retried distributed I/O attempts "
+        "(store ops, rpc posts/resends, pg init) under the shared "
+        "backoff policy", tags=("site",)),
+    "resilience.resumes": MetricSpec(
+        "counter", "resumes", "Engine.fit resumes from a valid "
+        "checkpoint (resume=True restore path)"),
+    "resilience.checkpoint_saves": MetricSpec(
+        "counter", "saves", "periodic checkpoints finalized "
+        "(CRC manifest written) by the CheckpointManager"),
+    "resilience.emergency_saves": MetricSpec(
+        "counter", "saves", "best-effort synchronous emergency "
+        "checkpoints (watchdog timeout / non-finite raise paths)"),
+    "resilience.corrupt_checkpoints": MetricSpec(
+        "counter", "checkpoints", "checkpoint directories skipped by "
+        "latest_valid() for failing CRC/manifest validation"),
+    "resilience.injected_faults": MetricSpec(
+        "counter", "faults", "faults fired by the deterministic "
+        "injection harness (PADDLE_TPU_FAULT_PLAN)",
+        tags=("site", "kind")),
     # ---- bench harness windows (bench.py, tools/bench_*.py)
     "bench.train_window": MetricSpec(
         "histogram", "s", "bench.py timed training window (N chained "
@@ -155,6 +176,8 @@ SPANS = {
     "rpc.call": "outgoing rpc (client side, until posted)",
     "rpc.handle": "incoming rpc execution (server side)",
     "pg.collective": "ProcessGroup collective (op/group in args)",
+    "ckpt.save": "CheckpointManager.save (snapshot + flush + manifest)",
+    "ckpt.restore": "CheckpointManager.load (read + reshard + adopt)",
 }
 
 

@@ -1,0 +1,142 @@
+"""End-to-end fault-tolerance acceptance (PR: fault-tolerant training).
+
+Three 2-process runs of the same seeded training job:
+
+1. *baseline* — uninterrupted; per-rank losses recorded.
+2. *crash* — fault plan injects a store socket drop during rendezvous
+   AND kills both workers (os._exit) mid-epoch at step 7, after the
+   step-6 checkpoint landed. The parent then truncates one shard of the
+   newest checkpoint (step 6), modeling a torn write.
+3. *resume* — ``Engine.fit(resume=True)`` must skip the corrupt step-6
+   checkpoint, restore from step 4, and reproduce the baseline loss
+   trajectory exactly (bit-deterministic resume: params + optimizer +
+   RNG + step counter all restored).
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+STEPS = 10
+KILL_CODE = 31
+
+
+def _ft_worker(save_root, out_dir, mode):
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import json
+    import os
+
+    import numpy as np
+
+    os.environ["PADDLE_TPU_PURE_PY_STORE"] = "1"
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.distributed.auto_parallel.engine import Engine
+    from paddle_tpu.distributed.resilience import faults
+    from paddle_tpu.distributed.store import create_or_get_global_tcp_store
+
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    world = int(os.environ["PADDLE_TRAINERS_NUM"])
+
+    if mode == "crash":
+        # drop the store socket mid-rendezvous AND hard-kill at step 7
+        faults.configure(
+            f"store.op:drop@2;engine.step:kill={KILL_CODE}@7")
+
+    # rendezvous over the TCPStore: the injected drop must be survived
+    # by reconnect-and-retry or the barrier (and this test) fails
+    store = create_or_get_global_tcp_store()
+    store.barrier(f"ft_{mode}", world, rank)
+
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 1))
+    opt = optimizer.Adam(parameters=model.parameters(),
+                         learning_rate=1e-2)
+    engine = Engine(model, loss=nn.MSELoss(), optimizer=opt)
+
+    rng = np.random.RandomState(0)
+    data = [(rng.randn(4, 8).astype(np.float32),
+             rng.randn(4, 1).astype(np.float32)) for _ in range(STEPS)]
+
+    if mode == "baseline":
+        hist = engine.fit(data, epochs=1)
+    else:
+        # blocking saves: a kill must never race an in-flight async
+        # write (the manifest-after-flush ordering is what we test).
+        # keep_last=5: rank 0's retention must not delete the restore
+        # point out from under a slower rank 1 mid-restore
+        hist = engine.fit(data, epochs=1, save_dir=save_root,
+                          save_freq=2, save_async=False, keep_last=5,
+                          resume=(mode == "resume"))
+    with open(os.path.join(out_dir, f"{mode}_rank{rank}.json"),
+              "w") as f:
+        json.dump(hist["loss"], f)
+    if mode == "crash":
+        # unreachable: the kill fires at step 7
+        raise AssertionError("fault plan did not kill the worker")
+
+
+@pytest.mark.timeout(600)
+def test_crash_truncate_resume_matches_baseline(tmp_path):
+    from paddle_tpu.distributed.spawn import spawn
+
+    save_root = str(tmp_path / "ckpts")
+    out_dir = str(tmp_path / "losses")
+    os.makedirs(out_dir)
+
+    # 1. uninterrupted baseline
+    spawn(_ft_worker, args=(save_root, out_dir, "baseline"), nprocs=2)
+    base = {}
+    for r in (0, 1):
+        with open(os.path.join(out_dir, f"baseline_rank{r}.json")) as f:
+            base[r] = json.load(f)
+        assert len(base[r]) == STEPS
+
+    # 2. fault-injected run: store drop + kill at step 7
+    with pytest.raises(RuntimeError, match=str(KILL_CODE)):
+        spawn(_ft_worker, args=(save_root, out_dir, "crash"), nprocs=2)
+    # checkpoints at steps 2/4/6 were finalized before the kill
+    from paddle_tpu.distributed.resilience.checkpoint_manager import (
+        validate_checkpoint_dir)
+
+    steps_on_disk = sorted(os.listdir(save_root))
+    assert steps_on_disk == [
+        "step_00000002", "step_00000004", "step_00000006"], steps_on_disk
+    for d in steps_on_disk:
+        ok, detail = validate_checkpoint_dir(os.path.join(save_root, d))
+        assert ok, (d, detail)
+
+    # 3. torn write: truncate one shard of the NEWEST checkpoint
+    shard = os.path.join(save_root, "step_00000006", "1_0.distcp")
+    with open(shard, "r+b") as f:
+        f.truncate(os.path.getsize(shard) // 2)
+    ok, detail = validate_checkpoint_dir(
+        os.path.join(save_root, "step_00000006"))
+    assert not ok and "size mismatch" in detail
+
+    # 4. resume: must skip corrupt step 6, restore step 4, and land on
+    # the exact baseline trajectory for steps 5..10
+    spawn(_ft_worker, args=(save_root, out_dir, "resume"), nprocs=2)
+    for r in (0, 1):
+        with open(os.path.join(out_dir, f"resume_rank{r}.json")) as f:
+            resumed = json.load(f)
+        np.testing.assert_array_equal(resumed, base[r][4:])
+
+    # the resume run's own saves repaired step 6 and added 8/10; the
+    # stdlib verifier confirms the whole tree is healthy again
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "verify_checkpoint",
+        os.path.join(os.path.dirname(__file__), os.pardir, "tools",
+                     "verify_checkpoint.py"))
+    tool = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(tool)
+    assert sorted(os.listdir(save_root)) == [
+        "step_00000002", "step_00000004", "step_00000006",
+        "step_00000008", "step_00000010"]
+    assert tool.main(["--run-root", save_root, "-q"]) == 0

@@ -1,0 +1,551 @@
+"""Fault tolerance (PR: checkpoint lifecycle + auto-resume + retry/backoff
++ deterministic fault injection).
+
+Covers: retry policy backoff determinism and deadlines, the
+PADDLE_TPU_FAULT_PLAN grammar and seeded schedules, TCPStore client
+reconnect-and-retry through an injected socket drop, rpc retransmit
+through injected message loss and the rpc_async timeout deadline,
+CheckpointManager save/validate/retention/corrupt-skip, the stdlib
+verify_checkpoint tool, Engine save/resume trajectory equality, and the
+emergency-save paths (non-finite raise, watchdog timeout)."""
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.distributed.resilience import (
+    RetryPolicy, call_with_retry, emergency, faults, retry as retry_mod)
+from paddle_tpu.distributed.resilience.checkpoint_manager import (
+    CheckpointManager, validate_checkpoint_dir)
+
+
+@pytest.fixture(autouse=True)
+def _clean_resilience():
+    """Every test starts and ends with no fault plan and no hooks."""
+    faults.reset()
+    yield
+    faults.reset()
+    with emergency._lock:
+        emergency._hooks.clear()
+
+
+# ------------------------------------------------------------------ retry
+class TestRetryPolicy:
+    def test_succeeds_after_transient_failures(self):
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise ConnectionError("transient")
+            return "ok"
+
+        slept = []
+        out = call_with_retry(
+            flaky, RetryPolicy(max_attempts=5, base_delay=0.01),
+            site="t.flaky", sleep=slept.append)
+        assert out == "ok"
+        assert calls["n"] == 3
+        assert len(slept) == 2
+
+    def test_exhausted_attempts_reraise(self):
+        def dead():
+            raise ConnectionError("permanent")
+
+        with pytest.raises(ConnectionError):
+            call_with_retry(
+                dead, RetryPolicy(max_attempts=3, base_delay=0.001),
+                site="t.dead", sleep=lambda d: None)
+
+    def test_non_retryable_error_passes_through(self):
+        def boom():
+            raise ValueError("logic bug")
+
+        with pytest.raises(ValueError):
+            call_with_retry(boom, RetryPolicy(max_attempts=5),
+                            site="t.boom", sleep=lambda d: None)
+
+    def test_backoff_is_exponential_and_deterministic(self):
+        pol = RetryPolicy(max_attempts=6, base_delay=0.1, max_delay=10.0,
+                          multiplier=2.0, jitter=0.25)
+
+        def seq():
+            rng = retry_mod._jitter_rng("t.site")
+            return [pol.delay(a, rng) for a in range(1, 5)]
+
+        a, b = seq(), seq()
+        assert a == b                       # same (seed, site) -> same jitter
+        for i, d in enumerate(a):
+            base = 0.1 * 2 ** i
+            assert base <= d <= base * 1.25
+
+    def test_deadline_bounds_whole_call(self):
+        def dead():
+            raise ConnectionError("down")
+
+        t0 = time.monotonic()
+        with pytest.raises(ConnectionError):
+            call_with_retry(
+                dead, RetryPolicy(max_attempts=100, base_delay=0.2,
+                                  deadline=0.3), site="t.deadline")
+        assert time.monotonic() - t0 < 2.0
+
+    def test_decorator_form(self):
+        calls = {"n": 0}
+
+        @retry_mod.retry(RetryPolicy(max_attempts=3, base_delay=0.001))
+        def sometimes():
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise OSError("once")
+            return 7
+
+        assert sometimes() == 7
+
+
+# ------------------------------------------------------------- fault plan
+class TestFaultPlan:
+    def test_parse_and_fire_at_invocations(self):
+        faults.configure("x.site:raise@2,4")
+        assert faults.active()
+        hits = [faults.check("x.site") for _ in range(5)]
+        assert [h is not None for h in hits] == [
+            False, True, False, True, False]
+        assert hits[1].kind == "raise" and hits[1].invocation == 2
+        assert len(faults.injected()) == 2
+
+    def test_value_and_multiple_sites(self):
+        faults.configure("a:delay=0.5@1;b:kill=31@2")
+        act = faults.check("a")
+        assert act.kind == "delay" and act.value == "0.5"
+        assert faults.check("b") is None
+        act2 = faults.check("b")
+        assert act2.kind == "kill" and act2.value == "31"
+
+    def test_probabilistic_schedule_is_seeded(self):
+        def run(seed):
+            faults.configure("p.site:raise@p0.3", seed=seed)
+            return [faults.check("p.site") is not None
+                    for _ in range(50)]
+
+        assert run(7) == run(7)
+        assert run(7) != run(8)
+
+    def test_bad_plan_rejected(self):
+        with pytest.raises(ValueError):
+            faults.configure("no-spec-here")
+
+    def test_apply_raise_and_delay(self):
+        faults.configure("r:raise@1;d:delay=0.05@1")
+        with pytest.raises(ConnectionError):
+            faults.apply(faults.check("r"))
+        t0 = time.monotonic()
+        faults.apply(faults.check("d"))
+        assert time.monotonic() - t0 >= 0.05
+
+    def test_reset_clears(self):
+        faults.configure("x:raise@1")
+        faults.reset()
+        assert not faults.active()
+        assert faults.check("x") is None
+
+
+# ------------------------------------------------------- store reconnect
+def test_store_reconnects_through_injected_drop(monkeypatch):
+    """A mid-operation socket drop must reconnect-and-retry, not fail
+    the op (satellite: TCPStore client hardening)."""
+    monkeypatch.setenv("PADDLE_TPU_PURE_PY_STORE", "1")
+    monkeypatch.setenv("PADDLE_TPU_RETRY_BASE_DELAY", "0.01")
+    from paddle_tpu.distributed.store import TCPStore
+
+    store = TCPStore("127.0.0.1", 0, is_master=True, world_size=1)
+    try:
+        store.set("a", "1")                      # store.op invocation 1
+        faults.configure("store.op:drop@2")
+        # invocation 2 = the wait inside get(): socket is closed and the
+        # frame exchange fails; the retry reconnects and re-sends
+        assert store.get("a") == b"1"
+        acts = faults.injected()
+        assert [a.kind for a in acts] == ["drop"]
+        faults.reset()
+        store.set("b", "2")                      # connection stays usable
+        assert store.get("b") == b"2"
+    finally:
+        store._daemon.stop()
+
+
+def test_store_wait_timeout_not_retried(monkeypatch):
+    """The server answering 'key never set' is an APPLICATION timeout:
+    it must surface immediately, not burn retry attempts."""
+    monkeypatch.setenv("PADDLE_TPU_PURE_PY_STORE", "1")
+    from paddle_tpu.distributed.store import TCPStore
+
+    store = TCPStore("127.0.0.1", 0, is_master=True, world_size=1)
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(TimeoutError):
+            store.wait(["never_set"], timeout=0.2)
+        assert time.monotonic() - t0 < 2.0
+    finally:
+        store._daemon.stop()
+
+
+# --------------------------------------------------------- rpc retransmit
+def _rpc_double(x):
+    return 2 * x
+
+
+def test_rpc_retransmits_through_message_loss(monkeypatch):
+    """An injected lost request is re-posted on backoff; the server
+    dedups by call_id so at-least-once delivery stays exactly-once
+    execution."""
+    monkeypatch.setenv("PADDLE_TPU_RPC_RETRY_BASE_DELAY", "0.1")
+    from paddle_tpu.distributed import rpc
+
+    from tests.test_launch_cli import _free_port
+
+    rpc.init_rpc("solo0", rank=0, world_size=1,
+                 master_endpoint=f"127.0.0.1:{_free_port()}")
+    try:
+        faults.configure("rpc.post:loss@1")
+        out = rpc.rpc_sync("solo0", _rpc_double, args=(21,), timeout=30.0)
+        assert out == 42
+        assert [a.kind for a in faults.injected()] == ["loss"]
+        faults.reset()
+        # agent still healthy for ordinary traffic
+        assert rpc.rpc_sync("solo0", _rpc_double, args=(5,)) == 10
+    finally:
+        faults.reset()
+        rpc.shutdown()
+
+
+def test_rpc_async_timeout_fails_future(monkeypatch):
+    """satellite: rpc_async(timeout=...) becomes the retransmit deadline;
+    when every post is lost the future fails with TimeoutError instead
+    of hanging forever."""
+    monkeypatch.setenv("PADDLE_TPU_RPC_RETRY_BASE_DELAY", "0.1")
+    from paddle_tpu.distributed import rpc
+
+    from tests.test_launch_cli import _free_port
+
+    rpc.init_rpc("solo1", rank=0, world_size=1,
+                 master_endpoint=f"127.0.0.1:{_free_port()}")
+    try:
+        faults.configure("rpc.post:loss@p1.0")   # lose EVERY message
+        fut = rpc.rpc_async("solo1", _rpc_double, args=(1,), timeout=1.0)
+        with pytest.raises(TimeoutError):
+            fut.result(timeout=15)
+        faults.reset()
+        assert rpc.rpc_sync("solo1", _rpc_double, args=(4,)) == 8
+    finally:
+        faults.reset()
+        rpc.shutdown()
+
+
+# ------------------------------------------------------ checkpoint manager
+def _state(val: float):
+    return {"w": paddle.to_tensor(
+        np.full((4, 3), val, dtype=np.float32)),
+        "meta": {"val": val}}
+
+
+class TestCheckpointManager:
+    def test_save_validate_latest(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path), rank=0, world_size=1)
+        p1 = mgr.save(_state(1.0), step=1, blocking=True)
+        p2 = mgr.save(_state(2.0), step=2, blocking=True)
+        assert validate_checkpoint_dir(p1) == (True, "ok")
+        assert validate_checkpoint_dir(p2) == (True, "ok")
+        assert mgr.latest_valid() == (2, p2)
+        got = _state(0.0)
+        mgr.load(got, p2)
+        np.testing.assert_allclose(np.asarray(got["w"]._data), 2.0)
+        assert got["meta"]["val"] == 2.0
+
+    def test_async_save_finalizes_on_wait(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path), rank=0, world_size=1)
+        p = mgr.save(_state(3.0), step=3, blocking=False)
+        mgr.wait()
+        assert os.path.exists(os.path.join(p, "MANIFEST_0.json"))
+        assert mgr.latest_valid() == (3, p)
+
+    def test_truncated_shard_detected_and_skipped(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path), rank=0, world_size=1)
+        p1 = mgr.save(_state(1.0), step=1, blocking=True)
+        p2 = mgr.save(_state(2.0), step=2, blocking=True)
+        shard = os.path.join(p2, "0_0.distcp")
+        with open(shard, "r+b") as f:
+            f.truncate(os.path.getsize(shard) // 2)
+        ok, detail = validate_checkpoint_dir(p2)
+        assert not ok and "size mismatch" in detail
+        assert mgr.latest_valid() == (1, p1)
+
+    def test_bitflip_detected_by_crc(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path), rank=0, world_size=1)
+        p = mgr.save(_state(1.0), step=1, blocking=True)
+        shard = os.path.join(p, "0_0.distcp")
+        size = os.path.getsize(shard)
+        with open(shard, "r+b") as f:     # same size, one flipped bit
+            f.seek(size // 2)
+            b = f.read(1)
+            f.seek(size // 2)
+            f.write(bytes([b[0] ^ 0x01]))
+        ok, detail = validate_checkpoint_dir(p)
+        assert not ok and "crc mismatch" in detail
+        assert mgr.latest_valid() is None
+
+    def test_corrupt_manifest_detected(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path), rank=0, world_size=1)
+        p = mgr.save(_state(1.0), step=1, blocking=True)
+        with open(os.path.join(p, "MANIFEST_0.json"), "w") as f:
+            f.write("{not json")
+        ok, detail = validate_checkpoint_dir(p)
+        assert not ok and "manifest" in detail
+
+    def test_missing_manifest_is_invisible(self, tmp_path):
+        """A crash mid-save leaves payload without manifest: invalid."""
+        mgr = CheckpointManager(str(tmp_path), rank=0, world_size=1)
+        p = mgr.save(_state(1.0), step=1, blocking=True)
+        os.remove(os.path.join(p, "MANIFEST_0.json"))
+        assert validate_checkpoint_dir(p) == (False, "no manifest")
+        assert mgr.latest_valid() is None
+
+    def test_retention_keeps_newest_valid(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path), keep_last=2,
+                                rank=0, world_size=1)
+        for s in (1, 2, 3):
+            mgr.save(_state(float(s)), step=s, blocking=True)
+        steps = [s for s, _ in mgr.checkpoints()]
+        assert steps == [3, 2]
+
+    def test_emergency_save_separate_namespace(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path), keep_last=1,
+                                rank=0, world_size=1)
+        mgr.save(_state(1.0), step=1, blocking=True)
+        p = mgr.emergency_save(_state(2.0), step=1, reason="test")
+        assert os.path.basename(p) == "emergency_step_00000001"
+        assert validate_checkpoint_dir(p)[0]
+        # regular save at the same step sorts first; retention never
+        # deletes emergency checkpoints
+        assert [os.path.basename(q) for _, q in mgr.checkpoints()] == [
+            "step_00000001", "emergency_step_00000001"]
+
+    def test_injected_write_fault_caught_by_manifest(self, tmp_path):
+        """ckpt.write truncation fires AFTER the CRC was computed from
+        the in-memory bytes, so the manifest convicts the file."""
+        mgr = CheckpointManager(str(tmp_path), rank=0, world_size=1)
+        p1 = mgr.save(_state(1.0), step=1, blocking=True)
+        faults.configure("ckpt.write:truncate@1")
+        p2 = mgr.save(_state(2.0), step=2, blocking=True)
+        faults.reset()
+        assert not validate_checkpoint_dir(p2)[0]
+        assert mgr.latest_valid() == (1, p1)
+
+
+def test_verify_checkpoint_tool(tmp_path):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "verify_checkpoint",
+        os.path.join(os.path.dirname(__file__), os.pardir, "tools",
+                     "verify_checkpoint.py"))
+    tool = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(tool)
+
+    mgr = CheckpointManager(str(tmp_path), rank=0, world_size=1)
+    p1 = mgr.save(_state(1.0), step=1, blocking=True)
+    p2 = mgr.save(_state(2.0), step=2, blocking=True)
+    assert tool.main([p1, p2]) == 0
+    assert tool.main(["--run-root", str(tmp_path)]) == 0
+    shard = os.path.join(p2, "0_0.distcp")
+    with open(shard, "r+b") as f:
+        f.truncate(os.path.getsize(shard) // 2)
+    assert tool.main([p2]) == 1
+    assert tool.main(["--run-root", str(tmp_path)]) == 1
+    # the framework validator agrees with the stdlib one
+    assert validate_checkpoint_dir(p2)[0] is False
+    assert validate_checkpoint_dir(p1)[0] is True
+
+
+# ------------------------------------------------------- engine integration
+def _make_engine(hidden=16):
+    from paddle_tpu.distributed.auto_parallel.engine import Engine
+
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(8, hidden), nn.ReLU(),
+                          nn.Linear(hidden, 1))
+    opt = optimizer.Adam(parameters=model.parameters(),
+                         learning_rate=1e-2)
+    return Engine(model, loss=nn.MSELoss(), optimizer=opt)
+
+
+def _make_data(n=10, seed=0):
+    rng = np.random.RandomState(seed)
+    return [(rng.randn(4, 8).astype(np.float32),
+             rng.randn(4, 1).astype(np.float32)) for _ in range(n)]
+
+
+class TestEngineResume:
+    def test_resume_matches_uninterrupted_trajectory(self, tmp_path):
+        data = _make_data()
+        base = _make_engine().fit(data, epochs=1)["loss"]
+
+        # partial run with periodic checkpoints
+        h1 = _make_engine().fit(data[:6], epochs=1,
+                                save_dir=str(tmp_path), save_freq=2,
+                                save_async=False)
+        np.testing.assert_array_equal(h1["loss"], base[:6])
+
+        # fresh process-state analog: new model/optimizer, resume=True
+        h2 = _make_engine().fit(data, epochs=1, save_dir=str(tmp_path),
+                                save_freq=2, resume=True)
+        np.testing.assert_array_equal(h2["loss"], base[6:])
+
+    def test_resume_skips_corrupt_checkpoint(self, tmp_path):
+        data = _make_data()
+        base = _make_engine().fit(data, epochs=1)["loss"]
+        _make_engine().fit(data[:6], epochs=1, save_dir=str(tmp_path),
+                           save_freq=2, save_async=False)
+        # newest checkpoint (step 6) gets torn: resume must fall back to
+        # step 4 and still reproduce the uninterrupted trajectory
+        shard = os.path.join(str(tmp_path), "step_00000006",
+                             "0_0.distcp")
+        with open(shard, "r+b") as f:
+            f.truncate(os.path.getsize(shard) // 2)
+        h2 = _make_engine().fit(data, epochs=1, save_dir=str(tmp_path),
+                                save_freq=2, resume=True)
+        np.testing.assert_array_equal(h2["loss"], base[4:])
+
+    def test_resume_without_checkpoint_trains_from_scratch(self, tmp_path):
+        data = _make_data(4)
+        base = _make_engine().fit(data, epochs=1)["loss"]
+        h = _make_engine().fit(data, epochs=1, save_dir=str(tmp_path),
+                               resume=True)
+        np.testing.assert_array_equal(h["loss"], base)
+
+    def test_engine_step_fault_site_raises(self, tmp_path):
+        data = _make_data(6)
+        faults.configure("engine.step:raise@3")
+        with pytest.raises(ConnectionError):
+            _make_engine().fit(data, epochs=1)
+
+    def test_nonfinite_loss_triggers_emergency_save(self, tmp_path):
+        from paddle_tpu.observability import health
+
+        data = _make_data(6)
+        bad = (data[3][0],
+               np.full_like(data[3][1], np.nan))
+        data[3] = bad
+        health.configure("raise")
+        try:
+            with pytest.raises(health.NonFiniteError):
+                _make_engine().fit(data, epochs=1,
+                                   save_dir=str(tmp_path))
+        finally:
+            health.configure("off")
+        dirs = sorted(os.listdir(str(tmp_path)))
+        assert "emergency_step_00000003" in dirs, dirs
+        p = os.path.join(str(tmp_path), "emergency_step_00000003")
+        assert validate_checkpoint_dir(p)[0], validate_checkpoint_dir(p)
+
+
+# ------------------------------------------------------- emergency + watchdog
+def test_emergency_registry_runs_hooks_and_never_raises():
+    got = []
+    t1 = emergency.register(lambda reason: got.append(reason) or "/p1")
+    t2 = emergency.register(lambda reason: 1 / 0)   # must be swallowed
+    try:
+        saved = emergency.trigger("unit test")
+        assert saved == ["/p1"]
+        assert got == ["unit test"]
+    finally:
+        emergency.unregister(t1)
+        emergency.unregister(t2)
+    assert emergency.hook_count() == 0
+    assert emergency.trigger("no hooks") == []
+
+
+def test_watchdog_timeout_triggers_emergency_hook(monkeypatch):
+    """The watchdog timeout path fires the emergency registry (the
+    Engine's save hook in real runs) before the abort callback."""
+    from paddle_tpu.distributed import watchdog
+
+    fired = threading.Event()
+    reasons = []
+    token = emergency.register(
+        lambda reason: reasons.append(reason) or "/saved")
+
+    mgr = watchdog.CommTaskManager(poll_interval=0.05)
+    monkeypatch.setattr(watchdog.CommTaskManager, "_instance", mgr)
+    mgr.on_timeout = lambda task: fired.set()      # instead of os._exit
+    try:
+        mgr.register("all_reduce", 0, timeout=0.1)  # never completed
+        assert fired.wait(timeout=10), "watchdog never fired"
+    finally:
+        mgr.shutdown()
+        emergency.unregister(token)
+    assert reasons and "watchdog timeout" in reasons[0]
+    assert "all_reduce" in reasons[0]
+
+
+def test_injected_collective_delay_trips_watchdog(monkeypatch):
+    """pg.collective:delay=... past the watchdog timeout must be seen as
+    a hang (the fault lands inside the watchdog window)."""
+    from paddle_tpu.distributed import watchdog
+    from paddle_tpu.distributed.process_group import _CollectiveWindow
+
+    fired = threading.Event()
+    mgr = watchdog.CommTaskManager(poll_interval=0.05)
+    monkeypatch.setattr(watchdog.CommTaskManager, "_instance", mgr)
+    mgr.on_timeout = lambda task: fired.set()
+    watchdog.enable(0.15)
+    faults.configure("pg.collective:delay=0.7@1")
+    try:
+        with _CollectiveWindow("all_reduce", 0):
+            pass                    # the injected delay IS the hang
+        assert fired.wait(timeout=10), "watchdog missed the delay"
+    finally:
+        watchdog._timeout = watchdog._UNSET   # back to env-var control
+        mgr.shutdown()
+
+
+# ----------------------------------------------------------------- metrics
+def test_resilience_metrics_schema_declared():
+    from paddle_tpu.observability import metrics_schema as ms
+
+    for name in ("resilience.retries", "resilience.resumes",
+                 "resilience.checkpoint_saves",
+                 "resilience.emergency_saves",
+                 "resilience.corrupt_checkpoints",
+                 "resilience.injected_faults"):
+        assert ms.spec(name) is not None, name
+    assert "ckpt.save" in ms.SPANS and "ckpt.restore" in ms.SPANS
+
+
+def test_retry_telemetry_counts_by_site(monkeypatch):
+    from paddle_tpu import observability as obs
+
+    obs.enable()
+    try:
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise ConnectionError("x")
+            return 1
+
+        call_with_retry(flaky,
+                        RetryPolicy(max_attempts=5, base_delay=0.001),
+                        site="unit.test")
+        snap = obs.registry.snapshot()
+        assert snap["counters"].get(
+            "resilience.retries{site=unit.test}") == 2
+    finally:
+        obs.disable()
+        obs.registry.reset()

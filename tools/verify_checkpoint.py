@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""Validate paddle_tpu checkpoint directories against their CRC manifests.
+
+Stdlib-only on purpose: CI / ops can verify a checkpoint tree without
+installing jax or importing the framework. Mirrors
+``paddle_tpu.distributed.resilience.checkpoint_manager.validate_checkpoint_dir``
+(same manifest format, same pass/fail rules).
+
+Usage::
+
+    python tools/verify_checkpoint.py CKPT_DIR [CKPT_DIR ...]
+    python tools/verify_checkpoint.py --run-root SAVE_DIR   # every step_*/
+
+Exit code 0 when every checked directory validates, 1 otherwise.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+import zlib
+from typing import Dict, Tuple
+
+_MANIFEST_RE = re.compile(r"^MANIFEST_(\d+)\.json$")
+_STEP_RE = re.compile(r"^(emergency_)?step_(\d+)$")
+
+
+def _crc32_file(path: str, chunk: int = 1 << 20) -> int:
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            b = f.read(chunk)
+            if not b:
+                break
+            crc = zlib.crc32(b, crc)
+    return crc & 0xFFFFFFFF
+
+
+def validate_checkpoint_dir(path: str) -> Tuple[bool, str]:
+    """(ok, detail) for one checkpoint directory."""
+    if not os.path.isdir(path):
+        return False, "not a directory"
+    manifests: Dict[int, dict] = {}
+    for fn in os.listdir(path):
+        m = _MANIFEST_RE.match(fn)
+        if not m:
+            continue
+        try:
+            with open(os.path.join(path, fn)) as f:
+                manifests[int(m.group(1))] = json.load(f)
+        except (OSError, ValueError) as e:
+            return False, f"unreadable manifest {fn}: {e}"
+    if not manifests:
+        return False, "no manifest"
+    worlds = {int(man.get("world_size", 1)) for man in manifests.values()}
+    if len(worlds) != 1:
+        return False, f"inconsistent world_size across manifests: {worlds}"
+    world = worlds.pop()
+    missing = sorted(set(range(world)) - set(manifests))
+    if missing:
+        return False, f"missing manifest for rank(s) {missing}"
+    for rank, man in sorted(manifests.items()):
+        for fname, info in man.get("files", {}).items():
+            fpath = os.path.join(path, fname)
+            if not os.path.exists(fpath):
+                return False, f"missing file {fname} (rank {rank})"
+            size = os.path.getsize(fpath)
+            if size != int(info["size"]):
+                return False, (f"size mismatch {fname}: "
+                               f"{size} != {info['size']}")
+            crc = _crc32_file(fpath)
+            if crc != int(info["crc32"]):
+                return False, (f"crc mismatch {fname}: "
+                               f"{crc:#010x} != {int(info['crc32']):#010x}")
+    return True, "ok"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("dirs", nargs="*", help="checkpoint directories")
+    ap.add_argument("--run-root", default=None,
+                    help="validate every step_*/emergency_step_* under "
+                         "this save root")
+    ap.add_argument("-q", "--quiet", action="store_true",
+                    help="only print failures")
+    args = ap.parse_args(argv)
+
+    dirs = list(args.dirs)
+    if args.run_root:
+        try:
+            names = sorted(os.listdir(args.run_root))
+        except OSError as e:
+            print(f"FAIL {args.run_root}: {e}", file=sys.stderr)
+            return 1
+        dirs += [os.path.join(args.run_root, n) for n in names
+                 if _STEP_RE.match(n)]
+    if not dirs:
+        ap.error("no checkpoint directories given "
+                 "(pass paths or --run-root)")
+
+    bad = 0
+    for d in dirs:
+        ok, detail = validate_checkpoint_dir(d)
+        if ok:
+            if not args.quiet:
+                print(f"OK   {d}")
+        else:
+            bad += 1
+            print(f"FAIL {d}: {detail}")
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
