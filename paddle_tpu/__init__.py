@@ -82,6 +82,7 @@ from . import hub  # noqa: F401,E402
 from . import decomposition  # noqa: F401,E402
 from . import onnx  # noqa: F401,E402
 from . import models  # noqa: F401,E402
+from . import serving  # noqa: F401,E402
 from . import utils  # noqa: F401,E402
 from .framework.io_utils import load, save  # noqa: F401,E402
 from .framework import (  # noqa: F401,E402

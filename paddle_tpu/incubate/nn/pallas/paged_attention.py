@@ -32,7 +32,8 @@ try:
 except ImportError:  # pragma: no cover
     pltpu = None
 
-__all__ = ["paged_attention", "paged_kv_write"]
+__all__ = ["paged_attention", "paged_kv_write", "paged_kv_write_chunk",
+           "quantize_kv_pages"]
 
 
 def _interpret_default() -> bool:
@@ -85,24 +86,52 @@ def _decode_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
 
 def _xla_paged_attention(q, k_pages, v_pages, block_tables, context_lens,
                          scale):
-    """Reference composition: gather pages then masked attention."""
+    """Reference composition: gather pages then masked attention.
+
+    Handles EMPTY slots (``context_lens == 0``): freshly-joined or
+    inactive continuous-batching slots carry arbitrary block-table
+    entries over uninitialized pages, so their rows are forced to zero
+    instead of softmax(all -inf) = NaN over garbage gathers. Pools may
+    be plain arrays or int8 dicts ``{"q8": [kv, pages, page, d] int8,
+    "s": [kv, pages, page] f32}`` — the dequant is applied on the score
+    side / folded into the V weights exactly like the dense int8 cache
+    path in models/generation.py, so no bf16 copy of the pool is ever
+    materialized."""
     bsz, n_heads, d = q.shape
-    n_kv, total_pages, page, _ = k_pages.shape
+    quant = isinstance(k_pages, dict)
+    kp = k_pages["q8"] if quant else k_pages
+    n_kv, total_pages, page, _ = kp.shape
     group = n_heads // n_kv
     pages_per_seq = block_tables.shape[1]
     max_len = pages_per_seq * page
+    bt = jnp.clip(block_tables, 0, total_pages - 1)
 
-    # [b, n_kv, pages_per_seq, page, d]
-    kg = jnp.take(k_pages, block_tables, axis=1)   # [n_kv, b, pp, page, d]
-    vg = jnp.take(v_pages, block_tables, axis=1)
-    kg = jnp.moveaxis(kg, 1, 0).reshape(bsz, n_kv, max_len, d)
-    vg = jnp.moveaxis(vg, 1, 0).reshape(bsz, n_kv, max_len, d)
+    def gather(pages):                 # [n_kv, b, pp, page, ...]
+        g = jnp.take(pages, bt, axis=1)
+        return jnp.moveaxis(g, 1, 0).reshape(
+            (bsz, n_kv, max_len) + pages.shape[3:])
+
     qg = q.reshape(bsz, n_kv, group, d).astype(jnp.float32)
-    s = jnp.einsum("bkgd,bktd->bkgt", qg, kg.astype(jnp.float32)) * scale
+    if quant:
+        kg = gather(k_pages["q8"])
+        ks = gather(k_pages["s"])               # [b, n_kv, max_len]
+        s = jnp.einsum("bkgd,bktd->bkgt", qg, kg.astype(jnp.float32))
+        s = s * ks[:, :, None, :] * scale
+    else:
+        kg = gather(k_pages)
+        s = jnp.einsum("bkgd,bktd->bkgt", qg,
+                       kg.astype(jnp.float32)) * scale
     mask = jnp.arange(max_len)[None, None, None, :] \
         < context_lens[:, None, None, None]
     s = jnp.where(mask, s, -jnp.inf)
-    w = jax.nn.softmax(s, axis=-1)
+    # empty slot: all positions masked -> softmax would be 0/0 = NaN
+    w = jnp.where(mask, jax.nn.softmax(s, axis=-1), 0.0)
+    if quant:
+        vg = gather(v_pages["q8"])
+        vs = gather(v_pages["s"])
+        w = w * vs[:, :, None, :]
+    else:
+        vg = gather(v_pages)
     out = jnp.einsum("bkgt,bktd->bkgd", w, vg.astype(jnp.float32))
     return out.reshape(bsz, n_heads, d).astype(q.dtype)
 
@@ -111,8 +140,21 @@ def _xla_paged_attention(q, k_pages, v_pages, block_tables, context_lens,
                                              "use_kernel"))
 def paged_attention(q, k_pages, v_pages, block_tables, context_lens,
                     scale=None, interpret=None, use_kernel=None):
-    """Decode-step attention over a paged KV cache. See module docstring."""
+    """Decode-step attention over a paged KV cache. See module docstring.
+
+    Slots with ``context_lens == 0`` (inactive / freshly-joined
+    continuous-batching slots) return ZEROS: their block-table rows may
+    reference uninitialized pages, so the gather indices are clamped
+    into range and the fully-masked softmax short-circuits to zero
+    weight instead of NaN. int8 pools (``{"q8", "s"}`` dicts from
+    :func:`quantize_kv_pages` / :func:`paged_kv_write_chunk`) take the
+    XLA dequant-fused gather path."""
     bsz, n_heads, d = q.shape
+    if isinstance(k_pages, dict):      # int8 pool: XLA dequant path
+        if scale is None:
+            scale = d ** -0.5
+        return _xla_paged_attention(q, k_pages, v_pages, block_tables,
+                                    context_lens, scale)
     n_kv, total_pages, page, _ = k_pages.shape
     assert n_heads % n_kv == 0
     group = n_heads // n_kv
@@ -129,6 +171,10 @@ def paged_attention(q, k_pages, v_pages, block_tables, context_lens,
         return _xla_paged_attention(q, k_pages, v_pages, block_tables,
                                     context_lens, scale)
 
+    # empty-slot safety: the scalar-prefetched index_map DMAs page
+    # bt[b, p] unconditionally — garbage ids from inactive rows must be
+    # clamped into the pool before they pick the DMA source
+    block_tables = jnp.clip(block_tables, 0, total_pages - 1)
     qg = q.reshape(bsz, n_kv, group, d)
     grid = (bsz, n_kv, pages_per_seq)
 
@@ -192,5 +238,72 @@ def paged_kv_write(k_pages, v_pages, k_new, v_new, block_tables,
             return pages.at[:, page_idx[b], slot[b], :].set(val)
 
         return jax.lax.fori_loop(0, bsz, lambda b, p: one(p, b), pages)
+
+    return write(k_pages, k_new), write(v_pages, v_new)
+
+
+def _quantize_rows(x):
+    """Per-(row, head) symmetric int8 for [..., n_kv, d] K/V rows (the
+    paged analog of models/generation.py _quantize_kv: each written row
+    carries its own scale, so the read side is exact)."""
+    s = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1) / 127.0
+    s = jnp.maximum(s, 1e-8)
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / s[..., None]),
+                 -127, 127).astype(jnp.int8)
+    return q, s
+
+
+def quantize_kv_pages(pages):
+    """Quantize a bf16/f32 pool [n_kv, pages, page, d] into the int8
+    pool representation ``{"q8": int8 same shape, "s": [n_kv, pages,
+    page] f32}`` consumed by :func:`paged_attention` and
+    :func:`paged_kv_write_chunk`."""
+    q, s = _quantize_rows(pages)
+    return {"q8": q, "s": s}
+
+
+@jax.jit
+def paged_kv_write_chunk(k_pages, v_pages, k_new, v_new, block_tables,
+                         pos):
+    """Scatter a CHUNK of per-row-position k/v rows into paged pools.
+
+    k/v_new: [b, g, n_kv, d] — g tokens per row at positions
+    ``pos [b, g]``; block_tables: [b, pages_per_seq]. Rows with
+    ``pos < 0`` or past the block-table window are DROPPED (inactive
+    continuous-batching slots / prefill-chunk padding). Pools may be
+    plain arrays or int8 ``{"q8", "s"}`` dicts (rows are quantized at
+    write time, per-row scales ride in ``"s"``). Functional — returns
+    the updated (k_pages, v_pages).
+    """
+    quant = isinstance(k_pages, dict)
+    kp = k_pages["q8"] if quant else k_pages
+    n_kv, total_pages, page, d = kp.shape
+    b, g = pos.shape
+    pages_per_seq = block_tables.shape[1]
+    window = page * pages_per_seq
+    valid = (pos >= 0) & (pos < window)
+    safe = jnp.clip(pos, 0, window - 1)
+    page_id = jnp.take_along_axis(
+        jnp.clip(block_tables, 0, total_pages - 1),
+        safe // page, axis=1)                       # [b, g]
+    flat_slot = page_id * page + safe % page
+    # invalid rows get an out-of-range slot; scatter mode="drop" skips
+    flat_slot = jnp.where(valid, flat_slot, total_pages * page)
+    idx = flat_slot.reshape(b * g)
+
+    def write(pages, new):
+        rows = new.reshape(b * g, n_kv, -1).swapaxes(0, 1)  # [kv, M, d]
+        if not quant:
+            flat = pages.reshape(n_kv, total_pages * page, d)
+            flat = flat.at[:, idx].set(rows.astype(flat.dtype),
+                                       mode="drop")
+            return flat.reshape(n_kv, total_pages, page, d)
+        q8, s = _quantize_rows(rows)
+        qflat = pages["q8"].reshape(n_kv, total_pages * page, d)
+        sflat = pages["s"].reshape(n_kv, total_pages * page)
+        qflat = qflat.at[:, idx].set(q8, mode="drop")
+        sflat = sflat.at[:, idx].set(s, mode="drop")
+        return {"q8": qflat.reshape(n_kv, total_pages, page, d),
+                "s": sflat.reshape(n_kv, total_pages, page)}
 
     return write(k_pages, k_new), write(v_pages, v_new)

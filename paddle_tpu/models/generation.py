@@ -386,6 +386,38 @@ class GPTDecodeAdapter(DecodeAdapter):
             new_cv.append(cvi)
         return self.logits(w, x), tuple(new_ck), tuple(new_cv)
 
+    def paged_chunk(self, w, toks, pos, kpages, vpages, block_tables):
+        """g tokens at per-row positions over PAGED KV pools (the
+        continuous-batching step of serving/engine.py). toks/pos
+        [b, g]; kpages/vpages: per-layer tuples of [n_kv, pages, page,
+        d] pools (bf16 or int8 dicts); block_tables [b, P]. ``pos < 0``
+        rows are inactive: their writes are dropped and their attention
+        is zero. Returns (logits [b, g, V], kpages, vpages)."""
+        from ..incubate.nn.pallas.paged_attention import \
+            paged_kv_write_chunk
+
+        nh, hd, dt = self.num_heads, self.head_dim, self.dtype
+        b, g = toks.shape
+        x = (w["wte"][toks] + w["wpe"][jnp.maximum(pos, 0)]).astype(dt)
+        new_kp, new_vp = [], []
+        for i, W in enumerate(w["layers"]):
+            h1 = _ln(x, W["ln1_w"], W["ln1_b"], self.eps)
+            qkv = _linear(h1, W["qkv_w"], W["qkv_b"]) \
+                .reshape(b, g, 3, nh, hd)
+            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+            kpi, vpi = paged_kv_write_chunk(kpages[i], vpages[i], k, v,
+                                            block_tables, pos)
+            att = _paged_attn_chunk(q, kpi, vpi, block_tables, pos, hd)
+            x = x + _linear(att.reshape(b, g, nh * hd),
+                            W["out_w"], W["out_b"])
+            h2 = _ln(x, W["ln2_w"], W["ln2_b"], self.eps)
+            m = jax.nn.gelu(_linear(h2, W["fc1_w"], W["fc1_b"]),
+                            approximate=True)
+            x = x + _linear(m, W["fc2_w"], W["fc2_b"])
+            new_kp.append(kpi)
+            new_vp.append(vpi)
+        return self.logits(w, x), tuple(new_kp), tuple(new_vp)
+
 
 class LlamaDecodeAdapter(DecodeAdapter):
     """RMSNorm + rope + GQA + SwiGLU decoder (llama.py LlamaForCausalLM)."""
@@ -514,6 +546,58 @@ class LlamaDecodeAdapter(DecodeAdapter):
             new_cv.append(cvi)
         return self.logits(w, x), tuple(new_ck), tuple(new_cv)
 
+    def paged_chunk(self, w, toks, pos, kpages, vpages, block_tables):
+        """Paged-pool analog of chunk_step for the serving engine —
+        see GPTDecodeAdapter.paged_chunk. GQA pools carry num_kv_heads
+        head panels; rope rotates by the per-row positions."""
+        from ..incubate.nn.pallas.paged_attention import \
+            paged_kv_write_chunk
+
+        nh, kvh, hd = self.num_heads, self.num_kv_heads, self.head_dim
+        dt = self.dtype
+        b, g = toks.shape
+        x = w["wte"][toks].astype(dt)
+        safe_pos = jnp.maximum(pos, 0)
+        new_kp, new_vp = [], []
+        for i, W in enumerate(w["layers"]):
+            q, k, v = self._qkv(W, x, b, g)
+            q = _rope(q, safe_pos, self.rope_base)
+            k = _rope(k, safe_pos, self.rope_base)
+            kpi, vpi = paged_kv_write_chunk(kpages[i], vpages[i], k, v,
+                                            block_tables, pos)
+            att = _paged_attn_chunk(q, kpi, vpi, block_tables, pos, hd)
+            x = x + _linear(att.reshape(b, g, nh * hd), W["o_w"])
+            h2 = _rms(x, W["post_ln"], self.eps)
+            m = jax.nn.silu(_linear(h2, W["gate_w"])) \
+                * _linear(h2, W["up_w"])
+            x = x + _linear(m, W["down_w"])
+            new_kp.append(kpi)
+            new_vp.append(vpi)
+        return self.logits(w, x), tuple(new_kp), tuple(new_vp)
+
+
+def _paged_attn_chunk(q, kpages, vpages, block_tables, pos, hd):
+    """Chunked causal attention over PAGED pools for the serving
+    engine: q [b, g, nh, hd] at per-row positions pos [b, g] attends to
+    page slots 0..pos (the chunk's own rows were written before this
+    call, so within-chunk causality falls out of the per-query length).
+    ``pos < 0`` rows (inactive slots / prefill padding) come back as
+    zeros. Pools may be bf16 arrays or int8 {"q8","s"} dicts."""
+    from ..incubate.nn.pallas.paged_attention import paged_attention
+
+    b, g, nh, _ = q.shape
+    pp = block_tables.shape[1]
+    lens = jnp.maximum(pos + 1, 0).reshape(b * g)
+    bt = jnp.broadcast_to(block_tables[:, None],
+                          (b, g, pp)).reshape(b * g, pp)
+    # off-TPU the Pallas kernel would run INTERPRETED per decode step —
+    # force the XLA gather path there; on TPU let the wrapper pick
+    on_tpu = jax.default_backend() == "tpu"
+    out = paged_attention(q.reshape(b * g, nh, hd), kpages, vpages, bt,
+                          lens, scale=hd ** -0.5, interpret=False,
+                          use_kernel=None if on_tpu else False)
+    return out.reshape(b, g, nh, hd)
+
 
 def _chunk_sdpa(q, ck, cv, pos, hd):
     """Chunked causal attention over the cache for speculative verify:
@@ -577,21 +661,45 @@ def _masked_sdpa(q, ck, cv, t_mask, hd):
 
 
 def _sample(logits, key, temperature, top_p):
-    if temperature == 0.0:
+    """Greedy / temperature / nucleus sampling over [b, V] logits.
+
+    ``temperature`` and ``top_p`` accept Python scalars (whole-batch —
+    the original path, kept bit-identical) OR per-row arrays [b] for
+    mixed-request serving batches (serving/engine.py): each row scales
+    by its own temperature, filters by its own nucleus (``top_p >= 1``
+    keeps the full distribution), and rows with ``temperature == 0``
+    take the greedy lane through a ``where`` select.
+    """
+    per_row_t = not isinstance(temperature, (int, float))
+    per_row_p = top_p is not None and not isinstance(top_p, (int, float))
+    if not per_row_t and temperature == 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    lg = logits.astype(jnp.float32) / max(temperature, 1e-6)
+    if per_row_t:
+        t = jnp.maximum(jnp.asarray(temperature, jnp.float32), 1e-6)
+        lg = logits.astype(jnp.float32) / t[..., None]
+    else:
+        lg = logits.astype(jnp.float32) / max(temperature, 1e-6)
     if top_p is not None:
         probs = jax.nn.softmax(lg, axis=-1)
         sort_idx = jnp.argsort(-probs, axis=-1)
         sorted_p = jnp.take_along_axis(probs, sort_idx, axis=-1)
         cum = jnp.cumsum(sorted_p, axis=-1)
-        keep = (cum - sorted_p) < top_p
+        tp = jnp.asarray(top_p, jnp.float32)[..., None] if per_row_p \
+            else top_p
+        keep = (cum - sorted_p) < tp
         filt = jnp.where(keep, sorted_p, 0.0)
         draw = jax.random.categorical(
             key, jnp.log(jnp.maximum(filt, 1e-30)), axis=-1)
-        return jnp.take_along_axis(sort_idx, draw[..., None],
-                                   axis=-1)[..., 0].astype(jnp.int32)
-    return jax.random.categorical(key, lg, axis=-1).astype(jnp.int32)
+        sampled = jnp.take_along_axis(sort_idx, draw[..., None],
+                                      axis=-1)[..., 0].astype(jnp.int32)
+    else:
+        sampled = jax.random.categorical(key, lg, axis=-1) \
+            .astype(jnp.int32)
+    if per_row_t:
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return jnp.where(jnp.asarray(temperature) == 0.0, greedy,
+                         sampled)
+    return sampled
 
 
 def _check_window(ad, plen, max_new_tokens):

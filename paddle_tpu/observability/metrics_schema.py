@@ -141,6 +141,45 @@ METRICS = {
         "counter", "faults", "faults fired by the deterministic "
         "injection harness (PADDLE_TPU_FAULT_PLAN)",
         tags=("site", "kind")),
+    # ---- continuous-batching serving engine (serving/engine.py)
+    "serving.queue_depth": MetricSpec(
+        "gauge", "requests", "requests waiting for a decode slot "
+        "(sampled after each engine step)"),
+    "serving.slot_occupancy": MetricSpec(
+        "gauge", "slots", "decode slots holding a request (prefilling "
+        "or running) after the last engine step"),
+    "serving.prefill_tokens": MetricSpec(
+        "counter", "tokens", "prompt tokens prefilled by the serving "
+        "engine (chunked; prefix-cache hits are NOT recomputed so "
+        "they don't count here)"),
+    "serving.decode_tokens": MetricSpec(
+        "counter", "tokens", "tokens emitted by serving decode steps"),
+    "serving.prefix_hit_tokens": MetricSpec(
+        "counter", "tokens", "prompt tokens restored from the paged "
+        "prefix cache at admission (prefill skipped)"),
+    "serving.preemptions": MetricSpec(
+        "counter", "requests", "running requests evicted to reclaim KV "
+        "blocks (evict-and-recompute)"),
+    "serving.deadline_cancels": MetricSpec(
+        "counter", "requests", "requests cancelled for exceeding their "
+        "per-request deadline"),
+    "serving.requests": MetricSpec(
+        "counter", "requests", "request stream terminations by outcome "
+        "(eos/length/cancelled/deadline/shutdown)",
+        tags=("outcome",)),
+    "serving.ttft": MetricSpec(
+        "histogram", "s", "time to first token: request arrival to the "
+        "prefill-completion sample", TIME_BUCKETS),
+    "serving.token_latency": MetricSpec(
+        "histogram", "s/token", "gap between consecutive streamed "
+        "tokens of one request", TOKEN_LATENCY_BUCKETS),
+    "serving.step_time": MetricSpec(
+        "histogram", "s", "wall time of one engine step (admission + "
+        "one prefill chunk + one decode batch)", TIME_BUCKETS),
+    "serving.decode_compiles": MetricSpec(
+        "counter", "compiles", "traces of the fixed-shape decode step; "
+        "MUST stay at 1 per engine — joins/leaves are mask flips, "
+        "never recompiles"),
     # ---- bench harness windows (bench.py, tools/bench_*.py)
     "bench.train_window": MetricSpec(
         "histogram", "s", "bench.py timed training window (N chained "
@@ -150,6 +189,9 @@ METRICS = {
         TIME_BUCKETS),
     "bench.moe_window": MetricSpec(
         "histogram", "s", "MoE bench timed window", TIME_BUCKETS),
+    "bench.serving_window": MetricSpec(
+        "histogram", "s", "serving bench window (Poisson arrivals "
+        "through ServingEngine, warmup excluded)", TIME_BUCKETS),
 }
 
 
@@ -178,6 +220,9 @@ SPANS = {
     "pg.collective": "ProcessGroup collective (op/group in args)",
     "ckpt.save": "CheckpointManager.save (snapshot + flush + manifest)",
     "ckpt.restore": "CheckpointManager.load (read + reshard + adopt)",
+    "serving.step": "one ServingEngine step (admit + prefill + decode)",
+    "serving.prefill": "one chunked-prefill dispatch (rid/n in args)",
+    "serving.decode": "one fixed-shape decode-batch dispatch",
 }
 
 

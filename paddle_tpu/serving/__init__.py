@@ -1,0 +1,19 @@
+"""Continuous-batching serving engine (paged KV + slot scheduler).
+
+Quick start::
+
+    import paddle_tpu as pt
+    eng = pt.serving.ServingEngine(model, max_slots=4, block_size=16)
+    eng.start()
+    rid = eng.submit(prompt_ids, max_new_tokens=32)
+    for tok in eng.stream(rid):
+        ...
+    eng.shutdown()
+"""
+from .block_manager import BlockManager, hash_block_tokens  # noqa: F401
+from .engine import EngineConfig, RequestError, ServingEngine  # noqa: F401
+from .scheduler import (CANCELLED, FINISHED, PREFILL, RUNNING,  # noqa: F401
+                        WAITING, PrefillChunk, Request, Scheduler)
+
+__all__ = ["ServingEngine", "EngineConfig", "RequestError",
+           "BlockManager", "Scheduler", "Request", "PrefillChunk"]

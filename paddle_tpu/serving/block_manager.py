@@ -1,0 +1,231 @@
+"""Paged KV block pool: the allocator under the continuous-batching
+engine.
+
+The physical KV cache is a fixed pool of ``num_blocks`` pages of
+``block_size`` token slots each (one shared index space across every
+layer's pool array — block ``i`` refers to page ``i`` of every layer).
+This module owns only the *index* bookkeeping; the tensors themselves
+live in :mod:`paddle_tpu.serving.engine` (fp KV or the int8
+``{"q8","s"}`` quantized pools — the allocator is deliberately
+dtype-agnostic, so int8 pages need no extra allocator state).
+
+Three mechanisms, mirroring the vLLM/"Ragged Paged Attention" design:
+
+* **Refcounted blocks** — ``allocate`` / ``fork`` (share, +1 ref) /
+  ``free`` (-1 ref).  A block returns to the free list only at ref 0.
+* **Prefix caching** — completed requests ``register_prefix`` their
+  full prompt blocks under a rolling hash chain; a later
+  ``match_prefix`` on a request with the same prompt head re-uses those
+  pages (KV already resident) and skips recomputing the prefill.
+  Cached blocks at ref 0 park in an *evictable* LRU rather than the
+  free list; allocation evicts them only when the free list runs dry.
+* **Copy-on-write** — ``cow`` gives a writer its own page when the
+  block is shared (ref > 1).  The engine's sharing policy only ever
+  shares *full, immutable* prompt blocks, so its writes never need COW;
+  the primitive is here (and property-tested) for schedulers that share
+  partially-filled tails.
+
+A ``watermark`` fraction of the pool is held back from *new-request*
+admission (``can_allocate``) so in-flight requests can still grow
+during decode without immediately triggering preemption.
+"""
+from __future__ import annotations
+
+import collections
+from typing import Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["BlockManager", "hash_block_tokens"]
+
+
+def hash_block_tokens(prev_hash: Optional[int],
+                      tokens: Sequence[int]) -> int:
+    """Rolling hash for one full block of prompt tokens, chained on the
+    hash of the previous block so equal blocks at different depths never
+    collide into the same cache entry."""
+    return hash((prev_hash, tuple(int(t) for t in tokens)))
+
+
+class BlockManager:
+    """Refcounted paged-KV allocator with prefix caching and COW."""
+
+    def __init__(self, num_blocks: int, block_size: int,
+                 watermark: float = 0.01,
+                 enable_prefix_cache: bool = True):
+        if num_blocks <= 0 or block_size <= 0:
+            raise ValueError("num_blocks and block_size must be > 0")
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        self.watermark_blocks = max(0, int(watermark * num_blocks))
+        self.enable_prefix_cache = bool(enable_prefix_cache)
+        self._free: collections.deque[int] = collections.deque(
+            range(self.num_blocks))
+        self._ref: Dict[int, int] = {}
+        # prefix cache: chain hash -> block id holding that block's KV
+        self._hash_to_block: Dict[int, int] = {}
+        self._block_hash: Dict[int, int] = {}
+        # ref-0 blocks whose KV is still valid (LRU order, oldest first)
+        self._evictable: "collections.OrderedDict[int, None]" = \
+            collections.OrderedDict()
+
+    # ------------------------------------------------------------ sizing
+    def num_free(self) -> int:
+        """Blocks obtainable right now (free list + evictable cache)."""
+        return len(self._free) + len(self._evictable)
+
+    def num_in_use(self) -> int:
+        return len(self._ref)
+
+    def blocks_for_tokens(self, n_tokens: int) -> int:
+        return -(-max(0, n_tokens) // self.block_size)
+
+    def can_allocate(self, n_blocks: int) -> bool:
+        """Admission check for NEW requests: leaves the watermark slack
+        so running requests can keep appending decode blocks."""
+        return self.num_free() - self.watermark_blocks >= n_blocks
+
+    # -------------------------------------------------------- allocation
+    def allocate(self, n_blocks: int = 1) -> List[int]:
+        """Take ``n_blocks`` fresh blocks (ref 1 each); evicts LRU
+        cached blocks if the free list alone can't cover it.  Raises
+        ``RuntimeError`` when the pool genuinely runs dry — callers
+        (the scheduler) are expected to check ``num_free`` / preempt."""
+        if n_blocks > self.num_free():
+            raise RuntimeError(
+                "KV pool exhausted: need %d blocks, have %d"
+                % (n_blocks, self.num_free()))
+        out: List[int] = []
+        for _ in range(n_blocks):
+            if self._free:
+                bid = self._free.popleft()
+            else:
+                bid, _ = self._evictable.popitem(last=False)
+                self._forget_hash(bid)
+            self._ref[bid] = 1
+            out.append(bid)
+        return out
+
+    def fork(self, block_ids: Sequence[int]) -> None:
+        """Add one reference to each block (prefix sharing)."""
+        for bid in block_ids:
+            self._ref[bid] += 1
+
+    def free(self, block_ids: Sequence[int]) -> None:
+        """Drop one reference per block; ref-0 blocks go back to the
+        free list, except prefix-cached ones which park in the
+        evictable LRU with their KV intact."""
+        for bid in block_ids:
+            r = self._ref[bid] - 1
+            if r > 0:
+                self._ref[bid] = r
+                continue
+            del self._ref[bid]
+            if bid in self._block_hash:
+                self._evictable[bid] = None
+                self._evictable.move_to_end(bid)
+            else:
+                self._free.append(bid)
+
+    def cow(self, block_id: int) -> Tuple[int, bool]:
+        """Copy-on-write: returns ``(block_id, False)`` when the caller
+        is the sole owner (write in place), else drops one ref and
+        returns ``(fresh_block, True)`` — the caller must copy the page
+        payload before writing."""
+        if self._ref[block_id] == 1:
+            return block_id, False
+        self._ref[block_id] -= 1
+        (new_bid,) = self.allocate(1)
+        return new_bid, True
+
+    # ------------------------------------------------------ prefix cache
+    def match_prefix(self, token_ids: Sequence[int]) -> \
+            Tuple[List[int], int]:
+        """Longest cached prefix of ``token_ids`` in whole blocks.
+        Returns ``(blocks, n_tokens)`` with one ref taken on each
+        returned block.  At most ``(len-1)//block_size`` blocks match so
+        at least one prompt token is always left to prefill (its logits
+        seed the first generated token)."""
+        if not self.enable_prefix_cache or not token_ids:
+            return [], 0
+        limit = (len(token_ids) - 1) // self.block_size
+        blocks: List[int] = []
+        h: Optional[int] = None
+        for i in range(limit):
+            chunk = token_ids[i * self.block_size:
+                              (i + 1) * self.block_size]
+            h = hash_block_tokens(h, chunk)
+            bid = self._hash_to_block.get(h)
+            if bid is None:
+                break
+            blocks.append(bid)
+        # take the refs only once the walk is done
+        for bid in blocks:
+            if bid in self._ref:
+                self._ref[bid] += 1
+            else:                       # revive from the evictable LRU
+                self._evictable.pop(bid, None)
+                self._ref[bid] = 1
+        return blocks, len(blocks) * self.block_size
+
+    def register_prefix(self, token_ids: Sequence[int],
+                        block_ids: Sequence[int]) -> int:
+        """Publish the full-block prefix of a finished request into the
+        cache.  Only whole blocks are hashed (a partial tail block may
+        already hold decode KV).  Returns the number of blocks
+        registered."""
+        if not self.enable_prefix_cache:
+            return 0
+        n_full = len(token_ids) // self.block_size
+        h: Optional[int] = None
+        registered = 0
+        for i in range(min(n_full, len(block_ids))):
+            chunk = token_ids[i * self.block_size:
+                              (i + 1) * self.block_size]
+            h = hash_block_tokens(h, chunk)
+            bid = block_ids[i]
+            prev = self._hash_to_block.get(h)
+            if prev is not None and prev != bid:
+                continue                # first writer wins
+            if self._block_hash.get(bid, h) != h:
+                continue                # block already cached elsewhere
+            self._hash_to_block[h] = bid
+            self._block_hash[bid] = h
+            registered += 1
+        return registered
+
+    def _forget_hash(self, bid: int) -> None:
+        h = self._block_hash.pop(bid, None)
+        if h is not None and self._hash_to_block.get(h) == bid:
+            del self._hash_to_block[h]
+
+    def clear_prefix_cache(self) -> None:
+        """Drop every cached prefix; evictable blocks rejoin the free
+        list."""
+        for bid in list(self._evictable):
+            self._forget_hash(bid)
+            self._free.append(bid)
+        self._evictable.clear()
+        self._hash_to_block.clear()
+        self._block_hash.clear()
+
+    # -------------------------------------------------------- invariants
+    def assert_no_leaks(self) -> None:
+        """Every block is either free, evictable-cached, or referenced;
+        the three sets are disjoint and cover the pool.  Called from
+        ``ServingEngine.shutdown`` and the property tests."""
+        free = set(self._free)
+        evict = set(self._evictable)
+        held = set(self._ref)
+        assert not (free & evict), "block in free AND evictable"
+        assert not (free & held), "block in free AND referenced"
+        assert not (evict & held), "block evictable AND referenced"
+        total = len(free) + len(evict) + len(held)
+        assert total == self.num_blocks, (
+            "block leak: %d tracked of %d" % (total, self.num_blocks))
+        for bid, r in self._ref.items():
+            assert r > 0, "non-positive refcount on block %d" % bid
+
+    def assert_all_free(self) -> None:
+        """Stronger shutdown check: no request holds any block."""
+        self.assert_no_leaks()
+        assert not self._ref, (
+            "blocks still referenced at shutdown: %r" % (self._ref,))

@@ -1,0 +1,379 @@
+"""ServingEngine: continuous-batching inference over paged KV pools.
+
+The engine owns the physical KV pools (per layer,
+``[n_kv, num_blocks, block_size, head_dim]``, fp or int8 ``{"q8","s"}``
+pages), a :class:`BlockManager` for the page index space, a
+:class:`Scheduler` for slots, and exactly TWO jitted programs:
+
+* one fixed-shape decode step over ``max_slots`` rows — requests join
+  and leave by mask (position ``-1`` = empty slot), so the step
+  compiles once and never again (``decode_compiles`` asserts this);
+* one fixed-shape prefill-chunk step (``[1, prefill_chunk]``) that
+  streams a prompt into its pages chunk-by-chunk, interleaved with
+  decode steps so running requests keep emitting while a long prompt
+  loads.
+
+Both programs are pure — pools in, pools out — which makes the
+dispatch safely retryable: the step body runs under
+``resilience.call_with_retry`` (site ``serving.step``) with the retry
+deadline derived from the nearest per-request deadline, and
+``resilience.faults.check("serving.step")`` is consulted inside the
+retried body so injected ``ConnectionError`` faults exercise the same
+recovery path real transport errors would.
+
+Requests stream tokens through per-request queues:
+``rid = engine.submit(prompt)``, ``for tok in engine.stream(rid)``.
+``engine.start()`` runs the step loop on a background thread;
+tests may instead call ``engine.step()`` directly for determinism.
+"""
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import time
+from typing import Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .. import observability as _obs
+from ..distributed.resilience import faults
+from ..distributed.resilience.retry import call_with_retry, default_policy
+from ..incubate.nn.pallas.paged_attention import quantize_kv_pages
+from ..models.generation import _sample
+from ..observability.tracing import span
+from .block_manager import BlockManager
+from .scheduler import RUNNING, PrefillChunk, Request, Scheduler
+
+__all__ = ["ServingEngine", "RequestError", "EngineConfig"]
+
+
+class RequestError(RuntimeError):
+    """A stream ended abnormally (cancelled / deadline / shutdown)."""
+
+    def __init__(self, reason: str):
+        super().__init__(reason)
+        self.reason = reason
+
+
+def _env_int(name: str, default: int) -> int:
+    v = os.environ.get(name)
+    return int(v) if v else default
+
+
+class EngineConfig:
+    """Resolved engine knobs (ctor args win over env vars)."""
+
+    def __init__(self, max_slots=None, block_size=None, num_blocks=None,
+                 prefill_chunk=None, max_seq_len=None, kv_quant=None,
+                 watermark=0.01, enable_prefix_cache=True, seed=0):
+        self.max_slots = max_slots or _env_int(
+            "PADDLE_TPU_SERVE_SLOTS", 8)
+        self.block_size = block_size or _env_int(
+            "PADDLE_TPU_SERVE_BLOCK_SIZE", 16)
+        self.num_blocks = num_blocks or _env_int(
+            "PADDLE_TPU_SERVE_NUM_BLOCKS", 512)
+        self.prefill_chunk = prefill_chunk or _env_int(
+            "PADDLE_TPU_SERVE_PREFILL_CHUNK", 32)
+        self.max_seq_len = max_seq_len
+        self.kv_quant = kv_quant        # None | "int8"
+        self.watermark = watermark
+        self.enable_prefix_cache = enable_prefix_cache
+        self.seed = seed
+        if self.kv_quant not in (None, "int8"):
+            raise ValueError("kv_quant must be None or 'int8'")
+
+
+class ServingEngine:
+    def __init__(self, model, **knobs):
+        cfg = EngineConfig(**knobs)
+        self.config = cfg
+        ad = model.decode_adapter()
+        # detach the weights: the jitted steps take them as an argument,
+        # so the adapter methods stay pure over arrays
+        self._w, ad.weights = ad.weights, None
+        self._ad = ad
+        model_max = getattr(getattr(model, "config", None),
+                            "max_position_embeddings", 2048)
+        self.max_seq_len = min(cfg.max_seq_len or model_max, model_max)
+        self.pages_per_seq = -(-self.max_seq_len // cfg.block_size)
+
+        self.manager = BlockManager(
+            cfg.num_blocks, cfg.block_size, watermark=cfg.watermark,
+            enable_prefix_cache=cfg.enable_prefix_cache)
+        self.scheduler = Scheduler(self.manager, cfg.max_slots,
+                                   cfg.prefill_chunk, self.max_seq_len)
+
+        kvd = self._w["wte"].dtype
+        shape = (ad.num_kv_heads, cfg.num_blocks, cfg.block_size,
+                 ad.head_dim)
+        if cfg.kv_quant == "int8":
+            mk = lambda: quantize_kv_pages(jnp.zeros(shape, kvd))  # noqa: E731
+        else:
+            mk = lambda: jnp.zeros(shape, kvd)                     # noqa: E731
+        self._kp = tuple(mk() for _ in range(ad.num_layers))
+        self._vp = tuple(mk() for _ in range(ad.num_layers))
+
+        self._key = jax.random.PRNGKey(cfg.seed)
+        self.decode_compiles = 0
+        self.prefill_compiles = 0
+        self._decode_fn = jax.jit(self._decode_step)
+        self._prefill_fn = jax.jit(self._prefill_step)
+
+        self._lock = threading.RLock()
+        self._wakeup = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._requests: Dict[int, Request] = {}
+        self._streams: Dict[int, "queue.Queue"] = {}
+        self._last_emit: Dict[int, float] = {}
+
+    # ----------------------------------------------------- jitted bodies
+    def _decode_step(self, w, toks, pos, kp, vp, bt, temp, top_p, key):
+        # trace-time side effect: proves the zero-recompile claim
+        self.decode_compiles += 1
+        if _obs.enabled():
+            _obs.registry.counter("serving.decode_compiles").inc()
+        lg, kp, vp = self._ad.paged_chunk(
+            w, toks[:, None], pos[:, None], kp, vp, bt)
+        nxt = _sample(lg[:, 0], key, temp, top_p)
+        return nxt, kp, vp
+
+    def _prefill_step(self, w, toks, pos, kp, vp, bt_row, last_idx,
+                      temp, top_p, key):
+        self.prefill_compiles += 1
+        lg, kp, vp = self._ad.paged_chunk(w, toks, pos, kp, vp, bt_row)
+        row = jnp.take(lg[0], last_idx, axis=0)
+        nxt = _sample(row[None], key, temp[None], top_p[None])[0]
+        return nxt, kp, vp
+
+    # ----------------------------------------------------- public intake
+    def submit(self, prompt: Sequence[int], max_new_tokens: int = 16,
+               temperature: float = 0.0, top_p: float = 1.0,
+               eos_id: Optional[int] = None,
+               deadline_s: Optional[float] = None) -> int:
+        """Queue a request; returns its rid for stream()/cancel()."""
+        prompt = [int(t) for t in prompt]
+        if len(prompt) + max_new_tokens > self.max_seq_len:
+            raise ValueError(
+                "prompt %d + max_new_tokens %d exceeds max_seq_len %d"
+                % (len(prompt), max_new_tokens, self.max_seq_len))
+        now = time.monotonic()
+        req = Request(prompt=prompt, max_new_tokens=max_new_tokens,
+                      temperature=float(temperature), top_p=float(top_p),
+                      eos_id=eos_id, arrival=now,
+                      deadline=None if deadline_s is None
+                      else now + deadline_s)
+        with self._lock:
+            self._requests[req.rid] = req
+            self._streams[req.rid] = queue.Queue()
+            self.scheduler.add(req)
+        self._wakeup.set()
+        return req.rid
+
+    def stream(self, rid: int) -> Iterator[int]:
+        """Per-token iterator; raises RequestError on abnormal end."""
+        q = self._streams[rid]
+        while True:
+            kind, val = q.get()
+            if kind == "tok":
+                yield val
+            elif val in ("eos", "length"):
+                return
+            else:
+                raise RequestError(val)
+
+    def cancel(self, rid: int, reason: str = "cancelled") -> None:
+        with self._lock:
+            req = self._requests.get(rid)
+            if req is None:
+                return
+            self.scheduler.cancel(req, reason)
+            self._end_stream(req, reason)
+
+    def result(self, rid: int) -> List[int]:
+        """Convenience: drain the whole stream into a list."""
+        return list(self.stream(rid))
+
+    # ------------------------------------------------------- step engine
+    def step(self) -> bool:
+        """One scheduler round: admit, one prefill chunk, one decode
+        batch.  Returns False when there was nothing to do."""
+        t0 = time.monotonic()
+        with self._lock, span("serving.step"):
+            self._expire_deadlines()
+            admitted = self.scheduler.admit()
+            for req in admitted:
+                if req.num_cached and _obs.enabled():
+                    _obs.registry.counter(
+                        "serving.prefix_hit_tokens").inc(req.num_cached)
+            chunk = self.scheduler.next_prefill()
+            if chunk is not None:
+                self._run_prefill(chunk)
+            preempted = self.scheduler.ensure_decode_blocks()
+            running = self.scheduler.running()
+            if running:
+                self._run_decode(running)
+            if _obs.enabled():
+                if preempted:
+                    _obs.registry.counter("serving.preemptions").inc(
+                        len(preempted))
+                _obs.registry.gauge("serving.queue_depth").set(
+                    len(self.scheduler.waiting))
+                _obs.registry.gauge("serving.slot_occupancy").set(
+                    self.scheduler.num_active())
+                _obs.registry.histogram("serving.step_time").observe(
+                    time.monotonic() - t0)
+            return bool(admitted or chunk is not None or running)
+
+    def _dispatch(self, fn):
+        """Run one jitted step under the resilience machinery: injected
+        or real ConnectionError/TimeoutError gets retried with backoff,
+        bounded by the nearest per-request deadline."""
+        nearest = None
+        now = time.monotonic()
+        for req in self.scheduler.slots.values():
+            if req.deadline is not None:
+                left = max(0.0, req.deadline - now)
+                nearest = left if nearest is None else min(nearest, left)
+
+        def body():
+            act = faults.check("serving.step")
+            if act is not None:
+                faults.apply(act)
+            return fn()
+
+        return call_with_retry(body, default_policy(deadline=nearest),
+                               site="serving.step")
+
+    def _run_prefill(self, chunk: PrefillChunk) -> None:
+        req, cfg = chunk.req, self.config
+        n = len(chunk.tokens)
+        toks = np.zeros((1, cfg.prefill_chunk), np.int32)
+        pos = np.full((1, cfg.prefill_chunk), -1, np.int32)
+        toks[0, :n] = chunk.tokens
+        pos[0, :n] = np.arange(chunk.start, chunk.start + n)
+        bt = np.zeros((1, self.pages_per_seq), np.int32)
+        bt[0, :len(req.blocks)] = req.blocks
+        self._key, sub = jax.random.split(self._key)
+        with span("serving.prefill", args={"rid": req.rid, "n": n}):
+            nxt, self._kp, self._vp = self._dispatch(
+                lambda: self._prefill_fn(
+                    self._w, jnp.asarray(toks), jnp.asarray(pos),
+                    self._kp, self._vp, jnp.asarray(bt),
+                    jnp.int32(n - 1), jnp.float32(req.temperature),
+                    jnp.float32(req.top_p), sub))
+        req.prefilled = chunk.start + n
+        if _obs.enabled():
+            _obs.registry.counter("serving.prefill_tokens").inc(n)
+        if chunk.last:
+            req.state = RUNNING
+            req.first_token_at = time.monotonic()
+            if _obs.enabled():
+                _obs.registry.histogram("serving.ttft").observe(
+                    req.first_token_at - req.arrival)
+            self._emit(req, int(nxt))
+
+    def _run_decode(self, running: List[Request]) -> None:
+        cfg = self.config
+        S = cfg.max_slots
+        toks = np.zeros(S, np.int32)
+        pos = np.full(S, -1, np.int32)
+        temp = np.zeros(S, np.float32)
+        top_p = np.ones(S, np.float32)
+        bt = np.zeros((S, self.pages_per_seq), np.int32)
+        for req in running:
+            s = req.slot
+            toks[s] = req.generated[-1]
+            pos[s] = req.decode_pos()
+            temp[s] = req.temperature
+            top_p[s] = req.top_p
+            bt[s, :len(req.blocks)] = req.blocks
+        self._key, sub = jax.random.split(self._key)
+        with span("serving.decode", args={"n": len(running)}):
+            nxt, self._kp, self._vp = self._dispatch(
+                lambda: self._decode_fn(
+                    self._w, jnp.asarray(toks), jnp.asarray(pos),
+                    self._kp, self._vp, jnp.asarray(bt),
+                    jnp.asarray(temp), jnp.asarray(top_p), sub))
+        out = np.asarray(nxt)
+        if _obs.enabled():
+            _obs.registry.counter("serving.decode_tokens").inc(
+                len(running))
+        for req in running:
+            if req.state == RUNNING:     # not cancelled mid-dispatch
+                self._emit(req, int(out[req.slot]))
+
+    def _emit(self, req: Request, tok: int) -> None:
+        req.generated.append(tok)
+        req.remaining -= 1
+        now = time.monotonic()
+        last = self._last_emit.get(req.rid)
+        if last is not None and _obs.enabled():
+            _obs.registry.histogram("serving.token_latency").observe(
+                now - last)
+        self._last_emit[req.rid] = now
+        q = self._streams.get(req.rid)
+        if q is not None:
+            q.put(("tok", tok))
+        if req.eos_id is not None and tok == req.eos_id:
+            self.scheduler.finish(req, "eos")
+            self._end_stream(req, "eos")
+        elif req.remaining <= 0:
+            self.scheduler.finish(req, "length")
+            self._end_stream(req, "length")
+
+    def _end_stream(self, req: Request, reason: str) -> None:
+        q = self._streams.get(req.rid)
+        if q is not None:
+            q.put(("end", reason))
+        self._last_emit.pop(req.rid, None)
+        if _obs.enabled():
+            _obs.registry.counter("serving.requests",
+                                  tags={"outcome": reason}).inc()
+
+    def _expire_deadlines(self) -> None:
+        now = time.monotonic()
+        for req in list(self._requests.values()):
+            if req.deadline is not None and now > req.deadline and \
+                    req.state not in ("finished", "cancelled"):
+                self.scheduler.cancel(req, "deadline")
+                self._end_stream(req, "deadline")
+                if _obs.enabled():
+                    _obs.registry.counter(
+                        "serving.deadline_cancels").inc()
+
+    # -------------------------------------------------- lifecycle/thread
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.is_set():
+                if not self.step():
+                    self._wakeup.wait(timeout=0.01)
+                    self._wakeup.clear()
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="serving-engine")
+        self._thread.start()
+
+    def shutdown(self, check_leaks: bool = True) -> None:
+        """Stop the loop, cancel outstanding requests, and verify the
+        block pool drained (every page free or prefix-cached)."""
+        self._stop.set()
+        self._wakeup.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+        with self._lock:
+            for req in list(self._requests.values()):
+                if req.state not in ("finished", "cancelled"):
+                    self.scheduler.cancel(req, "shutdown")
+                    self._end_stream(req, "shutdown")
+            if check_leaks:
+                self.manager.assert_all_free()

@@ -125,3 +125,128 @@ class TestPagedKVWrite:
         ref = _np_reference(q, kp2, vp2, bt, lens2, 64 ** -0.5)
         np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-4,
                                    atol=2e-4)
+
+
+class TestEmptySlots:
+    """Regression: a slot with ``context_lens == 0`` (inactive or
+    freshly-joined in the serving engine) must return exact zeros, not
+    whatever the uninitialized pages its stale block table points at
+    contain — and never NaN (the all-masked softmax)."""
+
+    def _empty_setup(self):
+        q, kp, vp, bt, lens = _setup(bsz=2, n_heads=4, n_kv=2, d=32,
+                                     page=16, pages_per_seq=2, seed=7)
+        # slot 1 is empty but its block table is garbage, including ids
+        # beyond the pool (the engine never sanitizes dead rows)
+        bt = bt.copy()
+        bt[1] = [9999, -3]
+        lens = np.array([19, 0], dtype=np.int32)
+        # poison the pool so any leak through the mask is visible
+        kp = kp + 100.0
+        vp = vp + 100.0
+        return q, kp, vp, bt, lens
+
+    def test_kernel_empty_slot_zeros(self):
+        q, kp, vp, bt, lens = self._empty_setup()
+        out = np.asarray(paged_attention(
+            jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+            jnp.asarray(bt), jnp.asarray(lens), interpret=True,
+            use_kernel=True))
+        assert np.isfinite(out).all()
+        np.testing.assert_array_equal(out[1], np.zeros_like(out[1]))
+        # the live row is still computed correctly next to the dead one
+        ref = _np_reference(q[:1], kp, vp, bt[:1], lens[:1],
+                            q.shape[-1] ** -0.5)
+        np.testing.assert_allclose(out[0], ref[0], rtol=2e-4, atol=2e-4)
+
+    def test_xla_empty_slot_zeros(self):
+        q, kp, vp, bt, lens = self._empty_setup()
+        out = np.asarray(paged_attention(
+            jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+            jnp.asarray(bt), jnp.asarray(lens), use_kernel=False))
+        assert np.isfinite(out).all()
+        np.testing.assert_array_equal(out[1], np.zeros_like(out[1]))
+
+    def test_all_slots_empty(self):
+        q, kp, vp, bt, lens = self._empty_setup()
+        lens = np.zeros(2, dtype=np.int32)
+        for kern in (True, False):
+            out = np.asarray(paged_attention(
+                jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+                jnp.asarray(bt), jnp.asarray(lens), interpret=True,
+                use_kernel=kern))
+            np.testing.assert_array_equal(out, np.zeros_like(out))
+
+
+class TestPagedKVWriteChunk:
+    def test_chunk_write_matches_scalar_writes(self):
+        from paddle_tpu.incubate.nn.pallas.paged_attention import \
+            paged_kv_write_chunk
+        rng = np.random.RandomState(4)
+        n_kv, pages, page, d = 2, 6, 8, 16
+        kp = np.zeros((n_kv, pages, page, d), np.float32)
+        vp = np.zeros((n_kv, pages, page, d), np.float32)
+        k_new = rng.randn(1, 5, n_kv, d).astype(np.float32)
+        v_new = rng.randn(1, 5, n_kv, d).astype(np.float32)
+        bt = np.array([[2, 4, 0]], np.int32)
+        pos = np.array([[6, 7, 8, 9, 10]], np.int32)  # spans 2 pages
+        kp2, vp2 = paged_kv_write_chunk(
+            jnp.asarray(kp), jnp.asarray(vp), jnp.asarray(k_new),
+            jnp.asarray(v_new), jnp.asarray(bt), jnp.asarray(pos))
+        kp2, vp2 = np.asarray(kp2), np.asarray(vp2)
+        for g in range(5):
+            p = int(pos[0, g])
+            pid = int(bt[0, p // page])
+            np.testing.assert_array_equal(kp2[:, pid, p % page],
+                                          k_new[0, g])
+            np.testing.assert_array_equal(vp2[:, pid, p % page],
+                                          v_new[0, g])
+        # untouched slots stay zero
+        assert np.abs(kp2).sum() == pytest.approx(
+            np.abs(k_new).sum(), rel=1e-6)
+
+    def test_negative_positions_are_dropped(self):
+        from paddle_tpu.incubate.nn.pallas.paged_attention import \
+            paged_kv_write_chunk
+        rng = np.random.RandomState(5)
+        n_kv, pages, page, d = 1, 3, 4, 8
+        kp = np.zeros((n_kv, pages, page, d), np.float32)
+        vp = np.zeros((n_kv, pages, page, d), np.float32)
+        k_new = rng.randn(2, 1, n_kv, d).astype(np.float32)
+        v_new = rng.randn(2, 1, n_kv, d).astype(np.float32)
+        bt = np.array([[1, 2], [2, 0]], np.int32)
+        pos = np.array([[-1], [3]], np.int32)     # row 0 inactive
+        kp2, vp2 = paged_kv_write_chunk(
+            jnp.asarray(kp), jnp.asarray(vp), jnp.asarray(k_new),
+            jnp.asarray(v_new), jnp.asarray(bt), jnp.asarray(pos))
+        kp2 = np.asarray(kp2)
+        np.testing.assert_array_equal(kp2[:, 1], 0.0)  # dropped write
+        np.testing.assert_array_equal(kp2[0, 2, 3], k_new[1, 0, 0])
+
+
+class TestInt8Pages:
+    def test_quantized_pool_attention_close(self):
+        from paddle_tpu.incubate.nn.pallas.paged_attention import \
+            quantize_kv_pages
+        q, kp, vp, bt, lens = _setup(n_heads=4, n_kv=2, d=32, page=16,
+                                     pages_per_seq=2, seed=11)
+        qkp = quantize_kv_pages(jnp.asarray(kp))
+        qvp = quantize_kv_pages(jnp.asarray(vp))
+        out = np.asarray(paged_attention(
+            jnp.asarray(q), qkp, qvp, jnp.asarray(bt),
+            jnp.asarray(lens)))
+        ref = _np_reference(q, kp, vp, bt, lens, q.shape[-1] ** -0.5)
+        np.testing.assert_allclose(out, ref, rtol=0.15, atol=0.15)
+
+    def test_quantized_empty_slot_zeros(self):
+        from paddle_tpu.incubate.nn.pallas.paged_attention import \
+            quantize_kv_pages
+        q, kp, vp, bt, lens = _setup(bsz=2, n_kv=2, d=32, page=16,
+                                     pages_per_seq=2, seed=12)
+        lens = np.array([10, 0], dtype=np.int32)
+        out = np.asarray(paged_attention(
+            jnp.asarray(q), quantize_kv_pages(jnp.asarray(kp)),
+            quantize_kv_pages(jnp.asarray(vp)), jnp.asarray(bt),
+            jnp.asarray(lens)))
+        assert np.isfinite(out).all()
+        np.testing.assert_array_equal(out[1], np.zeros_like(out[1]))

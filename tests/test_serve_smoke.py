@@ -1,0 +1,12 @@
+"""Tier-1 wiring for tools/serve_smoke.py: the serving engine's
+parity/compile/leak smoke runs inside the suite."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir,
+                                "tools"))
+import serve_smoke  # noqa: E402
+
+
+def test_serve_smoke_passes():
+    assert serve_smoke.main() == 0

@@ -1,0 +1,419 @@
+"""Continuous-batching serving engine: end-to-end parity vs
+``generate()``, zero-recompile decode, prefix caching, preemption,
+deadlines/faults, and block-manager/scheduler property tests."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu.distributed.resilience import faults
+from paddle_tpu.models.generation import _sample
+from paddle_tpu.serving import (BlockManager, Request, RequestError,
+                                Scheduler, ServingEngine)
+from paddle_tpu.serving.scheduler import RUNNING, WAITING
+
+
+@pytest.fixture(scope="module")
+def model():
+    pt.seed(11)
+    cfg = pt.models.gpt_tiny(dropout=0.0, attention_dropout=0.0)
+    m = pt.models.GPTForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def _ref(m, prompt, max_new):
+    out = m.generate(pt.to_tensor(np.asarray([prompt], np.int64)),
+                     max_new_tokens=max_new).numpy()
+    return out[0].tolist()
+
+
+def _drain(eng, cap=500):
+    n = 0
+    while eng.step() and n < cap:
+        n += 1
+    assert n < cap, "engine failed to drain"
+
+
+# ---------------------------------------------------------------- sampling
+class TestSamplePerRow:
+    """Satellite: per-row temperature/top_p arrays, scalar path
+    bit-identical."""
+
+    def _logits(self, rows=4, vocab=64, seed=0):
+        rng = np.random.RandomState(seed)
+        return jnp.asarray(rng.randn(rows, vocab), jnp.float32)
+
+    def test_array_of_zeros_matches_scalar_greedy(self):
+        lg, key = self._logits(), jax.random.PRNGKey(7)
+        a = _sample(lg, key, 0.0, 1.0)
+        b = _sample(lg, key, jnp.zeros(4), jnp.ones(4))
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    @pytest.mark.parametrize("t,p", [(1.0, 1.0), (0.7, 0.9), (1.3, 0.5)])
+    def test_uniform_array_matches_scalar(self, t, p):
+        lg, key = self._logits(seed=3), jax.random.PRNGKey(11)
+        s = _sample(lg, key, t, p)
+        v = _sample(lg, key, jnp.full(4, t), jnp.full(4, p))
+        np.testing.assert_array_equal(np.asarray(s), np.asarray(v))
+
+    def test_mixed_rows_greedy_where_zero(self):
+        lg, key = self._logits(seed=5), jax.random.PRNGKey(3)
+        out = _sample(lg, key, jnp.asarray([0.0, 1.0, 0.0, 1.3]),
+                      jnp.asarray([1.0, 0.9, 0.5, 1.0]))
+        greedy = np.argmax(np.asarray(lg), axis=-1)
+        assert int(out[0]) == greedy[0]
+        assert int(out[2]) == greedy[2]
+
+
+# ------------------------------------------------------------ block manager
+class TestBlockManager:
+    def test_allocate_free_roundtrip(self):
+        bm = BlockManager(8, 4, watermark=0.0)
+        a = bm.allocate(3)
+        assert bm.num_free() == 5
+        bm.free(a)
+        assert bm.num_free() == 8
+        bm.assert_no_leaks()
+
+    def test_fork_refcount(self):
+        bm = BlockManager(4, 4, watermark=0.0)
+        a = bm.allocate(2)
+        bm.fork(a)                       # ref 2
+        bm.free(a)                       # ref 1: still held
+        assert bm.num_free() == 2
+        bm.free(a)
+        assert bm.num_free() == 4
+        bm.assert_no_leaks()
+
+    def test_cow_sole_owner_in_place(self):
+        bm = BlockManager(4, 4, watermark=0.0)
+        (b,) = bm.allocate(1)
+        nb, copied = bm.cow(b)
+        assert nb == b and not copied
+
+    def test_cow_shared_copies(self):
+        bm = BlockManager(4, 4, watermark=0.0)
+        (b,) = bm.allocate(1)
+        bm.fork([b])
+        nb, copied = bm.cow(b)
+        assert nb != b and copied
+        bm.free([b])
+        bm.free([nb])
+        bm.assert_no_leaks()
+
+    def test_prefix_register_and_match(self):
+        bm = BlockManager(8, 4, watermark=0.0)
+        toks = list(range(10))           # 2 full blocks + tail of 2
+        blocks = bm.allocate(3)
+        assert bm.register_prefix(toks, blocks) == 2
+        bm.free(blocks)                  # hashed blocks park evictable
+        got, n = bm.match_prefix(toks)
+        assert got == blocks[:2] and n == 8
+        bm.free(got)
+        bm.assert_no_leaks()
+
+    def test_match_leaves_one_token_to_prefill(self):
+        bm = BlockManager(8, 4, watermark=0.0)
+        toks = list(range(8))            # exactly 2 blocks
+        blocks = bm.allocate(2)
+        bm.register_prefix(toks, blocks)
+        bm.free(blocks)
+        got, n = bm.match_prefix(toks)
+        # only 1 block may match: the last prompt token must be
+        # prefilled so its logits can seed generation
+        assert n == 4 and len(got) == 1
+        bm.free(got)
+
+    def test_eviction_reclaims_lru_cached_block(self):
+        bm = BlockManager(2, 4, watermark=0.0)
+        blocks = bm.allocate(2)
+        bm.register_prefix(list(range(8)), blocks)
+        bm.free(blocks)
+        assert bm.num_free() == 2        # both evictable
+        fresh = bm.allocate(2)           # evicts both, hashes dropped
+        got, n = bm.match_prefix(list(range(8)))
+        assert got == [] and n == 0
+        bm.free(fresh)
+        bm.assert_no_leaks()
+
+    def test_watermark_gates_admission_only(self):
+        bm = BlockManager(10, 4, watermark=0.2)
+        assert bm.can_allocate(8)
+        assert not bm.can_allocate(9)    # watermark holds 2 back
+        a = bm.allocate(9)               # hard allocate still works
+        bm.free(a)
+
+    def test_property_randomized_ops(self):
+        rng = np.random.RandomState(0)
+        bm = BlockManager(16, 4, watermark=0.0)
+        held = []                        # [(blocks, tokens)]
+        for it in range(400):
+            op = rng.randint(4)
+            if op == 0 and bm.num_free() >= 3:
+                toks = rng.randint(0, 50, 12).tolist()
+                cached, n = bm.match_prefix(toks)
+                need = 3 - len(cached)
+                blocks = cached + (bm.allocate(need) if need else [])
+                held.append((blocks, toks))
+            elif op == 1 and held:
+                blocks, toks = held.pop(rng.randint(len(held)))
+                bm.register_prefix(toks, blocks)
+                bm.free(blocks)
+            elif op == 2 and held:
+                blocks, _ = held[rng.randint(len(held))]
+                bm.fork(blocks)
+                bm.free(blocks)          # balanced share/unshare
+            elif op == 3 and held:
+                blocks, toks = held[rng.randint(len(held))]
+                nb, copied = bm.cow(blocks[-1])
+                blocks[-1] = nb
+            bm.assert_no_leaks()
+        for blocks, _ in held:
+            bm.free(blocks)
+        bm.assert_no_leaks()
+
+
+# --------------------------------------------------------------- scheduler
+def _mk_req(rng, arrival, max_len=40):
+    plen = int(rng.randint(1, 12))
+    return Request(prompt=rng.randint(0, 99, plen).tolist(),
+                   max_new_tokens=int(rng.randint(1, 8)),
+                   arrival=arrival)
+
+
+class TestSchedulerProperties:
+    def _simulate(self, seed, num_blocks=12, max_slots=3):
+        """Randomized admit/prefill/decode/cancel/finish churn; the
+        scheduler+manager invariants must hold at every step and the
+        pool must drain to zero at the end."""
+        rng = np.random.RandomState(seed)
+        bm = BlockManager(num_blocks, 4, watermark=0.0,
+                          enable_prefix_cache=bool(seed % 2))
+        sch = Scheduler(bm, max_slots, prefill_chunk=4, max_seq_len=40)
+        live = []
+        t = 0.0
+        for it in range(300):
+            t += 1.0
+            op = rng.randint(5)
+            if op == 0:
+                r = _mk_req(rng, t)
+                sch.add(r)
+                live.append(r)
+            elif op == 1:
+                chunk = sch.next_prefill()
+                if chunk is not None:
+                    chunk.req.prefilled = chunk.start + len(chunk.tokens)
+                    if chunk.last:
+                        chunk.req.state = RUNNING
+                        chunk.req.generated.append(
+                            int(rng.randint(99)))
+                        chunk.req.remaining -= 1
+            elif op == 2:
+                sch.ensure_decode_blocks()
+                for r in sch.running():
+                    if r.remaining <= 0:
+                        sch.finish(r, "length")
+                        continue
+                    r.generated.append(int(rng.randint(99)))
+                    r.remaining -= 1
+            elif op == 3 and live:
+                sch.cancel(live[rng.randint(len(live))])
+            else:
+                sch.admit()
+            sch.assert_consistent()
+            bm.assert_no_leaks()
+        for r in live:
+            sch.cancel(r)
+        sch.assert_consistent()
+        bm.assert_no_leaks()
+        bm.clear_prefix_cache()
+        assert bm.num_in_use() == 0
+        assert bm.num_free() == num_blocks
+
+    @pytest.mark.parametrize("seed", [0, 1, 2, 3])
+    def test_randomized_churn_no_leaks(self, seed):
+        self._simulate(seed)
+
+    def test_preemption_requeues_fcfs(self):
+        bm = BlockManager(2, 4, watermark=0.0,
+                          enable_prefix_cache=False)
+        sch = Scheduler(bm, 2, prefill_chunk=4, max_seq_len=40)
+        a = Request(prompt=[1, 2, 3], max_new_tokens=8, arrival=1.0)
+        b = Request(prompt=[4, 5, 6], max_new_tokens=8, arrival=2.0)
+        sch.add(a)
+        sch.add(b)
+        sch.admit()
+        assert a.state != WAITING and b.state != WAITING
+        for r in (a, b):
+            r.state = RUNNING
+            r.prefilled = 3
+            r.generated = [7]
+        # grow a past its block: pool is dry -> b (youngest) evicted
+        a.generated += [8, 9]            # decode_pos 5 -> needs block 2
+        preempted = sch.ensure_decode_blocks()
+        assert preempted == [b]
+        assert b.state == WAITING and b.prompt == [4, 5, 6, 7]
+        assert not b.blocks and b.slot == -1
+        assert len(a.blocks) == 2
+        sch.cancel(a)
+        sch.cancel(b)
+        bm.assert_no_leaks()
+
+
+# ------------------------------------------------------------- engine e2e
+class TestServingEngineE2E:
+    def test_concurrent_ragged_parity_one_compile(self, model):
+        rng = np.random.RandomState(0)
+        V = model.config.vocab_size
+        prompts = [rng.randint(0, V, n).tolist() for n in (7, 13, 3, 21)]
+        maxnew = [6, 9, 4, 5]
+        refs = [_ref(model, p, mn) for p, mn in zip(prompts, maxnew)]
+        eng = ServingEngine(model, max_slots=4, block_size=8,
+                            num_blocks=64, prefill_chunk=8)
+        rids = [eng.submit(p, max_new_tokens=mn)
+                for p, mn in zip(prompts, maxnew)]
+        _drain(eng)
+        outs = [eng.result(r) for r in rids]
+        assert outs == refs
+        # requests joined and left slots at different times, yet the
+        # fixed-shape decode step traced exactly once
+        assert eng.decode_compiles == 1
+        assert eng.prefill_compiles == 1
+        eng.shutdown()                   # asserts zero block leaks
+
+    def test_prefix_cache_skips_prefill(self, model):
+        rng = np.random.RandomState(1)
+        V = model.config.vocab_size
+        prompt = rng.randint(0, V, 21).tolist()
+        ref = _ref(model, prompt, 5)
+        eng = ServingEngine(model, max_slots=2, block_size=8,
+                            num_blocks=32, prefill_chunk=8)
+        r1 = eng.submit(prompt, max_new_tokens=5)
+        _drain(eng)
+        assert eng.result(r1) == ref
+        first = eng._requests[r1]
+        assert first.num_cached == 0
+        # same prompt again: two full blocks (16 tokens) come from the
+        # prefix cache, so only the 5-token tail is prefilled
+        r2 = eng.submit(prompt, max_new_tokens=5)
+        req2 = eng._requests[r2]
+        _drain(eng)
+        assert eng.result(r2) == ref
+        assert req2.num_cached == 16
+        assert eng.decode_compiles == 1
+        eng.shutdown()
+
+    def test_preemption_evict_and_recompute_parity(self, model):
+        rng = np.random.RandomState(3)
+        V = model.config.vocab_size
+        prompts = [rng.randint(0, V, 4).tolist() for _ in range(2)]
+        refs = [_ref(model, p, 12) for p in prompts]
+        # 4 blocks of 4: both admit, growth exhausts the pool and the
+        # younger request is evicted, recomputed, and still matches
+        eng = ServingEngine(model, max_slots=2, block_size=4,
+                            num_blocks=4, prefill_chunk=4,
+                            enable_prefix_cache=False, watermark=0.0)
+        rids = [eng.submit(p, max_new_tokens=12) for p in prompts]
+        _drain(eng)
+        outs = [eng.result(r) for r in rids]
+        assert outs == refs
+        assert eng.scheduler.preemptions >= 1
+        assert eng.decode_compiles == 1
+        eng.shutdown()
+
+    def test_eos_ends_stream(self, model):
+        rng = np.random.RandomState(5)
+        V = model.config.vocab_size
+        prompt = rng.randint(0, V, 6).tolist()
+        ref = _ref(model, prompt, 8)
+        eos = ref[3]
+        eng = ServingEngine(model, max_slots=2, block_size=8,
+                            num_blocks=32, prefill_chunk=8)
+        rid = eng.submit(prompt, max_new_tokens=8, eos_id=eos)
+        _drain(eng)
+        out = eng.result(rid)
+        cut = ref.index(eos) + 1
+        assert out == ref[:cut]          # eos included, then stop
+        eng.shutdown()
+
+    def test_deadline_cancels_request(self, model):
+        eng = ServingEngine(model, max_slots=2, block_size=8,
+                            num_blocks=32, prefill_chunk=8)
+        rid = eng.submit([1, 2, 3], max_new_tokens=4, deadline_s=0.0)
+        eng.step()
+        with pytest.raises(RequestError) as ei:
+            eng.result(rid)
+        assert ei.value.reason == "deadline"
+        eng.shutdown()
+
+    def test_cancel_mid_flight_releases_blocks(self, model):
+        rng = np.random.RandomState(6)
+        V = model.config.vocab_size
+        eng = ServingEngine(model, max_slots=2, block_size=8,
+                            num_blocks=32, prefill_chunk=8,
+                            enable_prefix_cache=False)
+        rid = eng.submit(rng.randint(0, V, 10).tolist(),
+                         max_new_tokens=50)
+        for _ in range(4):
+            eng.step()
+        eng.cancel(rid)
+        with pytest.raises(RequestError):
+            eng.result(rid)
+        eng.shutdown()                   # leak check: all pages back
+
+    def test_injected_fault_is_retried(self, model):
+        rng = np.random.RandomState(7)
+        V = model.config.vocab_size
+        prompt = rng.randint(0, V, 5).tolist()
+        ref = _ref(model, prompt, 4)
+        faults.configure("serving.step:raise@2,4", seed=0)
+        try:
+            eng = ServingEngine(model, max_slots=2, block_size=8,
+                                num_blocks=32, prefill_chunk=8)
+            rid = eng.submit(prompt, max_new_tokens=4)
+            _drain(eng)
+            assert eng.result(rid) == ref
+            eng.shutdown()
+        finally:
+            faults.configure(None)
+
+    def test_streaming_background_thread(self, model):
+        rng = np.random.RandomState(8)
+        V = model.config.vocab_size
+        prompts = [rng.randint(0, V, n).tolist() for n in (5, 9)]
+        refs = [_ref(model, p, 6) for p in prompts]
+        eng = ServingEngine(model, max_slots=2, block_size=8,
+                            num_blocks=32, prefill_chunk=8)
+        eng.start()
+        try:
+            rids = [eng.submit(p, max_new_tokens=6) for p in prompts]
+            outs = [list(eng.stream(r)) for r in rids]
+            assert outs == refs
+        finally:
+            eng.shutdown()
+
+    def test_int8_kv_pages(self, model):
+        rng = np.random.RandomState(9)
+        V = model.config.vocab_size
+        prompt = rng.randint(0, V, 12).tolist()
+        eng = ServingEngine(model, max_slots=2, block_size=8,
+                            num_blocks=32, prefill_chunk=8,
+                            kv_quant="int8")
+        rid = eng.submit(prompt, max_new_tokens=6)
+        _drain(eng)
+        out = eng.result(rid)
+        assert len(out) == 6
+        assert all(0 <= t < V for t in out)
+        assert eng.decode_compiles == 1
+        eng.shutdown()
+
+    def test_submit_rejects_oversized_prompt(self, model):
+        eng = ServingEngine(model, max_slots=2, block_size=8,
+                            num_blocks=32, prefill_chunk=8,
+                            max_seq_len=32)
+        with pytest.raises(ValueError):
+            eng.submit(list(range(30)), max_new_tokens=8)
+        eng.shutdown()

@@ -285,3 +285,85 @@ def test_namespace_now_complete():
     missing = [n for n in names
                if not hasattr(paddle.incubate.nn.functional, n)]
     assert not missing, missing
+
+
+class TestBlockMHARagged:
+    """Satellite: ragged-length mixed-phase batches (a sequence
+    prefilling next to sequences decoding next to an idle slot) must
+    match a dense causal reference per sequence."""
+
+    def _dense_ref(self, seqs_k, seqs_v, i, qi, pos0):
+        # causal attention of qi rows (absolute pos pos0..) over the
+        # full per-sequence dense mirror
+        QH = qi.shape[1]
+        K = np.stack(seqs_k[i])                 # [ctx, KVH, D]
+        V = np.stack(seqs_v[i])
+        KVH, D = K.shape[1], K.shape[2]
+        kk = np.repeat(K, QH // KVH, 1)
+        vv = np.repeat(V, QH // KVH, 1)
+        s = np.einsum("lhd,khd->hlk", qi, kk) / np.sqrt(D)
+        pos = pos0 + np.arange(qi.shape[0])
+        causal = pos[:, None] >= np.arange(K.shape[0])[None, :]
+        s = np.where(causal[None], s, -1e9)
+        return np.einsum("hlk,khd->lhd", _softmax(s), vv) \
+            .reshape(qi.shape[0], -1)
+
+    def test_ragged_mixed_phase_matches_dense(self):
+        rng = np.random.RandomState(7)
+        B, QH, KVH, D, blk = 3, 4, 2, 8, 4
+        width = (QH + 2 * KVH) * D
+        kct = paddle.to_tensor(np.zeros((12, KVH, blk, D), np.float32))
+        vct = paddle.to_tensor(np.zeros((12, KVH, blk, D), np.float32))
+        bt = np.arange(12, dtype=np.int32).reshape(B, 4)
+        seqs_k = [[] for _ in range(B)]
+        seqs_v = [[] for _ in range(B)]
+
+        def call(enc, dec, this, qkv):
+            cuq = np.concatenate(
+                [[0], np.cumsum(this)]).astype(np.int32)
+            out, _, _, _ = F.block_multihead_attention(
+                paddle.to_tensor(qkv), kct, vct,
+                seq_lens_encoder=paddle.to_tensor(
+                    np.asarray(enc, np.int32)),
+                seq_lens_decoder=paddle.to_tensor(
+                    np.asarray(dec, np.int32)),
+                seq_lens_this_time=paddle.to_tensor(
+                    np.asarray(this, np.int32)),
+                padding_offsets=paddle.to_tensor(
+                    np.zeros(int(sum(this)), np.int32)),
+                cum_offsets=paddle.to_tensor(np.zeros(B, np.int32)),
+                cu_seqlens_q=paddle.to_tensor(cuq),
+                cu_seqlens_k=paddle.to_tensor(cuq),
+                block_tables=paddle.to_tensor(bt), block_size=blk)
+            return out.numpy(), cuq
+
+        def check(enc, dec, this):
+            qkv = rng.randn(int(sum(this)), width).astype(np.float32)
+            out, cuq = call(enc, dec, this, qkv)
+            for i in range(B):
+                n = this[i]
+                if n == 0:
+                    continue
+                rows = qkv[cuq[i]:cuq[i] + n].reshape(
+                    n, QH + 2 * KVH, D)
+                qi, ki, vi = (rows[:, :QH], rows[:, QH:QH + KVH],
+                              rows[:, QH + KVH:])
+                pos0 = dec[i] if enc[i] == 0 else 0
+                del seqs_k[i][pos0:], seqs_v[i][pos0:]
+                seqs_k[i].extend(ki)
+                seqs_v[i].extend(vi)
+                ref = self._dense_ref(seqs_k, seqs_v, i, qi, pos0)
+                np.testing.assert_allclose(
+                    out[cuq[i]:cuq[i] + n], ref, rtol=1e-3, atol=1e-4,
+                    err_msg="seq %d enc=%s dec=%s this=%s"
+                            % (i, enc, dec, this))
+
+        # ragged prefill: three different prompt lengths in one call
+        check(enc=[5, 3, 7], dec=[0, 0, 0], this=[5, 3, 7])
+        # mixed: seq0+seq2 decode one token while seq1 re-prefills a
+        # longer prompt (recompute path); slot widths stay ragged
+        check(enc=[0, 6, 0], dec=[5, 0, 7], this=[1, 6, 1])
+        # idle slot: seq1 contributes zero tokens this call
+        check(enc=[0, 0, 0], dec=[6, 6, 8], this=[1, 0, 1])
+        # decode crossing a block boundary (seq2 reaches len 9 > 2*blk)
+        check(enc=[0, 0, 0], dec=[7, 6, 9], this=[1, 1, 1])

@@ -20,6 +20,11 @@ Names built at runtime (non-literal first args) are out of scope — the
 registry itself stays schema-agnostic by design; this lint keeps the
 IN-TREE instrumentation and the README metric table honest. Wired into
 tier-1 via tests/test_metric_names.py.
+
+For namespaces listed in ``_REQUIRE_USED`` the lint also runs in
+reverse: every declared metric/span of that namespace must appear at
+some literal call site, so the schema can't accumulate dead rows while
+the subsystem silently drops its instrumentation.
 """
 from __future__ import annotations
 
@@ -33,6 +38,9 @@ _KIND = {"counter": "counter", "gauge": "gauge", "histogram": "histogram",
 
 _SKIP_DIRS = {".git", "__pycache__", "build", "dist", ".eggs",
               "node_modules"}
+
+# namespaces whose declared names must all be instrumented somewhere
+_REQUIRE_USED = ("serving.",)
 
 
 def _iter_py_files(root: str):
@@ -72,7 +80,8 @@ def _literal_str(node) -> str:
     return ""
 
 
-def check_file(path: str, metrics, errors: list, spans=None):
+def check_file(path: str, metrics, errors: list, spans=None,
+               used=None):
     try:
         with open(path) as f:
             tree = ast.parse(f.read(), filename=path)
@@ -84,6 +93,8 @@ def check_file(path: str, metrics, errors: list, spans=None):
             continue
         if spans is not None and _is_span_call(node.func):
             sname = _literal_str(node.args[0])
+            if used is not None and sname:
+                used.add(sname)
             if "." in sname and sname not in spans:
                 errors.append(
                     f"{path}:{node.args[0].lineno}: span {sname!r} is "
@@ -97,6 +108,8 @@ def check_file(path: str, metrics, errors: list, spans=None):
         if "." not in name:
             # runtime-built or non-metric string: out of lint scope
             continue
+        if used is not None:
+            used.add(name)
         spec = metrics.get(name)
         where = f"{path}:{node.args[0].lineno}"
         if spec is None:
@@ -136,8 +149,20 @@ def _load_schema(root: str):
 def run(root: str) -> list:
     metrics, spans = _load_schema(root)
     errors: list = []
+    used: set = set()
     for path in _iter_py_files(root):
-        check_file(path, metrics, errors, spans=spans)
+        check_file(path, metrics, errors, spans=spans, used=used)
+    # reverse check: no dead schema rows in the opted-in namespaces
+    for name in sorted(metrics):
+        if name.startswith(_REQUIRE_USED) and name not in used:
+            errors.append(
+                f"metrics_schema.py: metric {name!r} is declared but "
+                "never recorded at any literal call site")
+    for name in sorted(spans):
+        if name.startswith(_REQUIRE_USED) and name not in used:
+            errors.append(
+                f"metrics_schema.py: span {name!r} is declared but "
+                "never opened at any literal call site")
     return errors
 
 
