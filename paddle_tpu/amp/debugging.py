@@ -10,6 +10,7 @@ import contextlib
 import threading
 from enum import Enum
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -63,17 +64,22 @@ def check_numerics_enabled():
 
 def check_numerics(tensor, op_name="op"):
     arr = tensor._data if isinstance(tensor, Tensor) else tensor
+    if isinstance(arr, jax.core.Tracer):
+        # Reachable from the tape's run_op under jax.jit: a tracer has
+        # no values to scan, and np.asarray(tracer) raises. The checker
+        # is an eager-mode facility — skip silently under a trace.
+        return
     if not jnp.issubdtype(arr.dtype, jnp.floating):
         return
-    a = np.asarray(arr)
-    n_nan = int(np.isnan(a).sum())
-    n_inf = int(np.isinf(a).sum())
+    a = np.asarray(arr)          # ptlint: disable=jit-purity  (concrete: tracer-guarded above)
+    n_nan = int(np.isnan(a).sum())  # ptlint: disable=jit-purity
+    n_inf = int(np.isinf(a).sum())  # ptlint: disable=jit-purity
     if n_nan or n_inf:
         msg = f"[check_nan_inf] op={op_name} num_nan={n_nan} num_inf={n_inf}"
         cfg = _state.config
         if cfg is None or cfg.debug_mode == DebugMode.CHECK_NAN_INF_AND_ABORT:
             raise FloatingPointError(msg)
-        print(msg)
+        print(msg)  # ptlint: disable=jit-purity  (eager-only path)
 
 
 def enable_operator_stats_collection():

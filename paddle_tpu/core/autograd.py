@@ -137,7 +137,9 @@ def run_op(fn: Callable, tensors: Sequence, name: str = "op", n_outputs: Optiona
     # host-tracer span per op when a profiler window is recording (analog of
     # the RecordEvent emitted by every generated AD func, eager_gen.py:1312);
     # the hot no-profiler path costs one global read + None check
-    global _profiler_mod
+    # lazy-import memoization, not per-step state — writing it at trace
+    # time is exactly as correct as writing it eagerly
+    global _profiler_mod  # ptlint: disable=jit-purity
     if _profiler_mod is None:
         import paddle_tpu.profiler
 

@@ -102,7 +102,10 @@ class ProcessMesh:
 
 
 def set_mesh(mesh: ProcessMesh):
-    global _global_mesh
+    # the mesh context is MEANT to be installed at trace time — traced
+    # bodies (train_step._build) call this so sharding constraints
+    # resolve against the right mesh while tracing
+    global _global_mesh  # ptlint: disable=jit-purity
     _global_mesh = mesh
 
 

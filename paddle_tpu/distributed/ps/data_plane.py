@@ -47,9 +47,9 @@ class SparseTable:
         self.initializer = initializer
         self.init_scale = float(init_scale)
         self.beta1, self.beta2, self.eps = beta1, beta2, eps
-        self._rows: Dict[int, np.ndarray] = {}
-        self._state: Dict[int, list] = {}
-        self._step: Dict[int, int] = {}
+        self._rows: Dict[int, np.ndarray] = {}  # guarded by: _lock
+        self._state: Dict[int, list] = {}  # guarded by: _lock
+        self._step: Dict[int, int] = {}  # guarded by: _lock
         self._rng = np.random.default_rng(seed)
         self._lock = threading.Lock()
 
@@ -125,7 +125,8 @@ class SparseTable:
                           for k, v in sd.get("step", {}).items()}
 
     def __len__(self):
-        return len(self._rows)
+        with self._lock:
+            return len(self._rows)
 
 
 class DenseTable:
@@ -134,7 +135,7 @@ class DenseTable:
 
     def __init__(self, shape, lr: float = 0.01, seed: int = 0):
         self.lr = float(lr)
-        self._value = np.random.default_rng(seed).uniform(
+        self._value = np.random.default_rng(seed).uniform(  # guarded by: _lock
             -0.01, 0.01, shape).astype(np.float32)
         self._lock = threading.Lock()
 
@@ -159,7 +160,8 @@ class DenseTable:
             self._value = np.asarray(sd["value"], np.float32).copy()
 
     def __len__(self):
-        return int(self._value.size)
+        with self._lock:
+            return int(self._value.size)
 
 
 # ---------------------------------------------------------------- server

@@ -43,9 +43,9 @@ class CommTaskManager:
     _instance: Optional["CommTaskManager"] = None
 
     def __init__(self, poll_interval: float = 1.0):
-        self._tasks: Dict[int, CommTask] = {}
+        self._tasks: Dict[int, CommTask] = {}  # guarded by: _lock
         self._lock = threading.Lock()
-        self._next_id = 0
+        self._next_id = 0  # guarded by: _lock
         self._poll = poll_interval
         self._stop = False
         self.on_timeout: Callable[[CommTask], None] = self._default_abort

@@ -447,7 +447,7 @@ class GPTForCausalLM(nn.Layer):
 
         def fn(a, wa):
             h = a.shape[-1]
-            t = int(np.prod(a.shape[:-1]))
+            t = math.prod(a.shape[:-1])
             xc = a.reshape(chunks, t // chunks, h)
             lc = lab.astype(jnp.int32).reshape(chunks, t // chunks)
 

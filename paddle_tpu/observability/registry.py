@@ -198,7 +198,7 @@ class MetricsRegistry:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._metrics: Dict[Tuple[str, Tuple], object] = {}
+        self._metrics: Dict[Tuple[str, Tuple], object] = {}  # guarded by: _lock
 
     @staticmethod
     def _key(name: str, tags: Optional[dict]) -> Tuple[str, Tuple]:
@@ -211,7 +211,9 @@ class MetricsRegistry:
         if not _enabled:
             return _NOOP
         key = self._key(name, tags)
-        m = self._metrics.get(key)
+        # double-checked locking: the unlocked read is the hot-path fast
+        # path; a miss re-reads under the lock before creating
+        m = self._metrics.get(key)  # ptlint: disable=lock-discipline
         if m is None:
             with self._lock:
                 m = self._metrics.get(key)
@@ -234,7 +236,8 @@ class MetricsRegistry:
 
     def get(self, name: str, tags: Optional[dict] = None):
         """Existing instrument or None — never creates (read side)."""
-        return self._metrics.get(self._key(name, tags))
+        with self._lock:
+            return self._metrics.get(self._key(name, tags))
 
     def metrics(self):
         with self._lock:

@@ -58,14 +58,14 @@ class BlockManager:
         self.watermark_blocks = max(0, int(watermark * num_blocks))
         self.enable_prefix_cache = bool(enable_prefix_cache)
         self._free: collections.deque[int] = collections.deque(
-            range(self.num_blocks))
-        self._ref: Dict[int, int] = {}
+            range(self.num_blocks))  # guarded by: caller (ServingEngine._lock)
+        self._ref: Dict[int, int] = {}  # guarded by: caller (ServingEngine._lock)
         # prefix cache: chain hash -> block id holding that block's KV
-        self._hash_to_block: Dict[int, int] = {}
-        self._block_hash: Dict[int, int] = {}
+        self._hash_to_block: Dict[int, int] = {}  # guarded by: caller (ServingEngine._lock)
+        self._block_hash: Dict[int, int] = {}  # guarded by: caller (ServingEngine._lock)
         # ref-0 blocks whose KV is still valid (LRU order, oldest first)
         self._evictable: "collections.OrderedDict[int, None]" = \
-            collections.OrderedDict()
+            collections.OrderedDict()  # guarded by: caller (ServingEngine._lock)
 
     # ------------------------------------------------------------ sizing
     def num_free(self) -> int:

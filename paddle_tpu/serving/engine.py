@@ -127,14 +127,15 @@ class ServingEngine:
         self._wakeup = threading.Event()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        self._requests: Dict[int, Request] = {}
-        self._streams: Dict[int, "queue.Queue"] = {}
-        self._last_emit: Dict[int, float] = {}
+        self._requests: Dict[int, Request] = {}  # guarded by: _lock
+        self._streams: Dict[int, "queue.Queue"] = {}  # guarded by: _lock
+        self._last_emit: Dict[int, float] = {}  # guarded by: _lock
 
     # ----------------------------------------------------- jitted bodies
     def _decode_step(self, w, toks, pos, kp, vp, bt, temp, top_p, key):
-        # trace-time side effect: proves the zero-recompile claim
-        self.decode_compiles += 1
+        # trace-time side effect BY DESIGN: increments once per compile,
+        # which is what lets tests assert decode_compiles == 1
+        self.decode_compiles += 1  # ptlint: disable=jit-purity
         if _obs.enabled():
             _obs.registry.counter("serving.decode_compiles").inc()
         lg, kp, vp = self._ad.paged_chunk(
@@ -144,7 +145,7 @@ class ServingEngine:
 
     def _prefill_step(self, w, toks, pos, kp, vp, bt_row, last_idx,
                       temp, top_p, key):
-        self.prefill_compiles += 1
+        self.prefill_compiles += 1  # ptlint: disable=jit-purity  (trace-time compile counter)
         lg, kp, vp = self._ad.paged_chunk(w, toks, pos, kp, vp, bt_row)
         row = jnp.take(lg[0], last_idx, axis=0)
         nxt = _sample(row[None], key, temp[None], top_p[None])[0]
@@ -176,7 +177,8 @@ class ServingEngine:
 
     def stream(self, rid: int) -> Iterator[int]:
         """Per-token iterator; raises RequestError on abnormal end."""
-        q = self._streams[rid]
+        with self._lock:
+            q = self._streams[rid]
         while True:
             kind, val = q.get()
             if kind == "tok":
@@ -307,7 +309,7 @@ class ServingEngine:
             if req.state == RUNNING:     # not cancelled mid-dispatch
                 self._emit(req, int(out[req.slot]))
 
-    def _emit(self, req: Request, tok: int) -> None:
+    def _emit(self, req: Request, tok: int) -> None:  # ptlint: holds=_lock
         req.generated.append(tok)
         req.remaining -= 1
         now = time.monotonic()
@@ -326,7 +328,7 @@ class ServingEngine:
             self.scheduler.finish(req, "length")
             self._end_stream(req, "length")
 
-    def _end_stream(self, req: Request, reason: str) -> None:
+    def _end_stream(self, req: Request, reason: str) -> None:  # ptlint: holds=_lock
         q = self._streams.get(req.rid)
         if q is not None:
             q.put(("end", reason))
@@ -335,7 +337,7 @@ class ServingEngine:
             _obs.registry.counter("serving.requests",
                                   tags={"outcome": reason}).inc()
 
-    def _expire_deadlines(self) -> None:
+    def _expire_deadlines(self) -> None:  # ptlint: holds=_lock
         now = time.monotonic()
         for req in list(self._requests.values()):
             if req.deadline is not None and now > req.deadline and \

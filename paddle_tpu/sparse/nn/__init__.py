@@ -142,9 +142,12 @@ class SyncBatchNorm(BatchNorm):
             except Exception:
                 axis_env = None
             # inside a collective context, all-reduce the statistics
+            # single-device fallback: NameError ("unbound axis name") is
+            # raised at TRACE time on every rank identically when there
+            # is no sync_bn axis, so ranks cannot diverge here
             try:
-                mean = jax.lax.pmean(mean, axis_name="sync_bn")
-                var = jax.lax.pmean(var, axis_name="sync_bn")
+                mean = jax.lax.pmean(mean, axis_name="sync_bn")  # ptlint: disable=collective-consistency
+                var = jax.lax.pmean(var, axis_name="sync_bn")  # ptlint: disable=collective-consistency
             except NameError:
                 pass
         else:

@@ -1,0 +1,42 @@
+"""Tier-1 wiring for ptlint: the shipped tree must be clean.
+
+Runs every pass over the canonical targets (paddle_tpu/, tools/,
+bench.py) and fails on any finding that is neither suppressed inline
+nor grandfathered in tools/ptlint/baseline.json. The slow self-check
+additionally fails on stale baseline entries, so the baseline only
+ever shrinks.
+"""
+import os
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+
+from tools.ptlint import DEFAULT_BASELINE, DEFAULT_TARGETS, lint  # noqa: E402
+
+TARGETS = [os.path.join(ROOT, t) for t in DEFAULT_TARGETS]
+
+
+def test_codebase_is_lint_clean():
+    new, _baselined, _stale = lint(TARGETS, root=ROOT,
+                                   baseline_path=DEFAULT_BASELINE)
+    assert new == [], (
+        "%d non-baselined ptlint finding(s) — fix them, suppress with "
+        "a justified `# ptlint: disable=<rule>`, or (for pre-existing "
+        "debt only) add to tools/ptlint/baseline.json:\n%s"
+        % (len(new), "\n".join(str(f) for f in new)))
+
+
+@pytest.mark.slow
+def test_baseline_has_no_stale_entries():
+    _new, _baselined, stale = lint(TARGETS, root=ROOT,
+                                   baseline_path=DEFAULT_BASELINE)
+    assert stale == [], (
+        "%d stale baseline entr%s — the underlying findings are fixed; "
+        "delete the entries from tools/ptlint/baseline.json:\n%s"
+        % (len(stale), "y" if len(stale) == 1 else "ies",
+           "\n".join("[%s] %s: %s" % (e["rule"], e["path"], e["message"])
+                     for e in stale)))

@@ -1,0 +1,454 @@
+"""Unit tests for tools/ptlint: one known-bad and one known-good
+fixture per rule, plus suppression comments, baseline filtering/stale
+detection, and CLI exit codes.
+
+Fixtures are written under tmp_path and linted with ``root=tmp_path``,
+so findings carry clean relative paths and the repo's own baseline
+never interferes.
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+
+from tools.ptlint import lint  # noqa: E402
+from tools.ptlint.engine import (Finding, apply_baseline,  # noqa: E402
+                                 collect_files, run_passes)
+
+# minimal stand-in for paddle_tpu/observability/metrics_schema.py --
+# the metric-names pass importlib-loads this file from the lint root
+_SCHEMA_SRC = textwrap.dedent("""\
+    from typing import NamedTuple, Optional, Tuple
+
+    class MetricSpec(NamedTuple):
+        kind: str
+        unit: str
+        desc: str
+        buckets: Optional[Tuple[float, ...]] = None
+        tags: Tuple[str, ...] = ()
+
+    METRICS = {
+        "train.steps": MetricSpec("counter", "steps", "steps run"),
+    }
+    SPANS = {"train.step": "one step"}
+    """)
+
+
+def _lint(tmp_path, files, select=None, with_schema=False):
+    """Write ``files`` (relpath -> source) under tmp_path and return
+    the new findings of the selected rules."""
+    if with_schema:
+        files = dict(files)
+        files.setdefault("paddle_tpu/observability/metrics_schema.py",
+                         _SCHEMA_SRC)
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    new, _, _ = lint([str(tmp_path)], root=str(tmp_path),
+                     select=select, baseline_path=None)
+    return new
+
+
+def _rules(findings):
+    return sorted({f.rule for f in findings})
+
+
+# ------------------------------------------------------------ jit-purity
+BAD_JIT_PURITY = """\
+    import jax
+
+    @jax.jit
+    def step(x):
+        print("stepping", x)
+        return x * 2
+    """
+
+GOOD_JIT_PURITY = """\
+    import jax
+
+    @jax.jit
+    def step(x):
+        return x * 2
+
+    def host_loop(xs):
+        for x in xs:
+            print("host-side logging is fine", x)
+    """
+
+
+def test_jit_purity_bad(tmp_path):
+    new = _lint(tmp_path, {"mod.py": BAD_JIT_PURITY},
+                select=["jit-purity"])
+    assert _rules(new) == ["jit-purity"]
+    assert any("print" in f.message for f in new)
+
+
+def test_jit_purity_good(tmp_path):
+    assert _lint(tmp_path, {"mod.py": GOOD_JIT_PURITY},
+                 select=["jit-purity"]) == []
+
+
+def test_jit_purity_transitive_callee(tmp_path):
+    # the side effect sits in a helper only REACHABLE from a jit root
+    src = """\
+        import jax
+
+        def helper(x):
+            print("traced transitively")
+            return x
+
+        @jax.jit
+        def step(x):
+            return helper(x)
+        """
+    new = _lint(tmp_path, {"mod.py": src}, select=["jit-purity"])
+    assert any("helper" in f.message and "print" in f.message
+               for f in new)
+
+
+# ------------------------------------------------------ recompile-hazard
+BAD_RECOMPILE = """\
+    import jax
+
+    def f(x):
+        return x
+
+    def run(xs):
+        for x in xs:
+            y = jax.jit(f)(x)
+        return y
+    """
+
+GOOD_RECOMPILE = """\
+    import jax
+
+    def f(x):
+        return x
+
+    jitted = jax.jit(f)
+
+    def run(xs):
+        for x in xs:
+            y = jitted(x)
+        return y
+    """
+
+
+def test_recompile_hazard_bad(tmp_path):
+    new = _lint(tmp_path, {"mod.py": BAD_RECOMPILE},
+                select=["recompile-hazard"])
+    assert _rules(new) == ["recompile-hazard"]
+    assert any("inside a loop" in f.message for f in new)
+
+
+def test_recompile_hazard_good(tmp_path):
+    assert _lint(tmp_path, {"mod.py": GOOD_RECOMPILE},
+                 select=["recompile-hazard"]) == []
+
+
+def test_recompile_hazard_unhashable_static(tmp_path):
+    src = """\
+        import jax
+
+        def f(x, cfg):
+            return x
+
+        g = jax.jit(f, static_argnums=(1,))
+        y = g(1, [1, 2, 3])
+        """
+    new = _lint(tmp_path, {"mod.py": src}, select=["recompile-hazard"])
+    assert any("unhashable static argument" in f.message for f in new)
+
+
+def test_recompile_hazard_shape_branch(tmp_path):
+    src = """\
+        import jax
+
+        @jax.jit
+        def f(x):
+            if x.shape[0] > 1:
+                return x * 2
+            return x
+        """
+    new = _lint(tmp_path, {"mod.py": src}, select=["recompile-hazard"])
+    assert any("branch on `.shape`" in f.message for f in new)
+
+
+# ------------------------------------------- collective-consistency
+BAD_COLLECTIVE = """\
+    def sync(pg, x, rank):
+        if rank == 0:
+            pg.all_reduce(x)
+        return x
+    """
+
+GOOD_COLLECTIVE = """\
+    def sync(pg, x, rank):
+        pg.all_reduce(x)
+        if rank == 0:
+            pg.broadcast(x, src=0)
+        else:
+            pg.broadcast(x, src=0)
+        return x
+    """
+
+
+def test_collective_consistency_bad(tmp_path):
+    new = _lint(tmp_path, {"mod.py": BAD_COLLECTIVE},
+                select=["collective-consistency"])
+    assert _rules(new) == ["collective-consistency"]
+    assert any("rank-dependent" in f.message for f in new)
+
+
+def test_collective_consistency_good(tmp_path):
+    # unconditional + balanced both-branch collectives: consistent
+    assert _lint(tmp_path, {"mod.py": GOOD_COLLECTIVE},
+                 select=["collective-consistency"]) == []
+
+
+def test_collective_swallowing_except(tmp_path):
+    src = """\
+        def sync(pg, x):
+            try:
+                pg.all_reduce(x)
+            except Exception:
+                pass
+            return x
+        """
+    new = _lint(tmp_path, {"mod.py": src},
+                select=["collective-consistency"])
+    assert any("swallowing except" in f.message for f in new)
+
+
+# --------------------------------------------------------- lock-discipline
+BAD_LOCK = """\
+    import threading
+
+    class Box:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._items = {}  # guarded by: _lock
+
+        def get(self, k):
+            return self._items.get(k)
+    """
+
+GOOD_LOCK = """\
+    import threading
+
+    class Box:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._items = {}  # guarded by: _lock
+
+        def get(self, k):
+            with self._lock:
+                return self._items.get(k)
+
+        def flush(self):  # ptlint: holds=_lock
+            self._items.clear()
+    """
+
+
+def test_lock_discipline_bad(tmp_path):
+    new = _lint(tmp_path, {"mod.py": BAD_LOCK},
+                select=["lock-discipline"])
+    assert _rules(new) == ["lock-discipline"]
+    assert any("outside `with self._lock`" in f.message for f in new)
+
+
+def test_lock_discipline_good(tmp_path):
+    # locked access + holds= helper are both clean
+    assert _lint(tmp_path, {"mod.py": GOOD_LOCK},
+                 select=["lock-discipline"]) == []
+
+
+def test_lock_discipline_external_poke(tmp_path):
+    files = {
+        "owner.py": """\
+            class Manager:
+                def __init__(self):
+                    self._free = []  # guarded by: caller (Engine._lock)
+            """,
+        "poker.py": """\
+            def steal(manager):
+                return manager._free.pop()
+            """,
+    }
+    new = _lint(tmp_path, files, select=["lock-discipline"])
+    assert any(f.path == "poker.py" and "Manager" in f.message
+               for f in new)
+
+
+# ------------------------------------------------------------ metric-names
+def test_metric_names_bad(tmp_path):
+    src = 'registry.counter("train.bogus").inc()\n'
+    new = _lint(tmp_path, {"paddle_tpu/mod.py": src},
+                select=["metric-names"], with_schema=True)
+    assert any("train.bogus" in f.message and f.rule == "metric-names"
+               for f in new)
+
+
+def test_metric_names_good(tmp_path):
+    src = ('registry.counter("train.steps").inc()\n'
+           'with span("train.step"):\n    pass\n')
+    new = _lint(tmp_path, {"paddle_tpu/mod.py": src},
+                select=["metric-names"], with_schema=True)
+    assert new == []
+
+
+def test_metric_names_kind_mismatch(tmp_path):
+    src = 'registry.gauge("train.steps").set(1)\n'
+    new = _lint(tmp_path, {"paddle_tpu/mod.py": src},
+                select=["metric-names"], with_schema=True)
+    assert any("declared as a counter" in f.message for f in new)
+
+
+# ------------------------------------------------------------- suppression
+def test_line_suppression(tmp_path):
+    src = """\
+        import jax
+
+        @jax.jit
+        def step(x):
+            print(x)  # ptlint: disable=jit-purity
+            return x
+        """
+    assert _lint(tmp_path, {"mod.py": src}, select=["jit-purity"]) == []
+
+
+def test_file_suppression(tmp_path):
+    src = "# ptlint: disable-file=jit-purity\n" + textwrap.dedent(
+        BAD_JIT_PURITY)
+    assert _lint(tmp_path, {"mod.py": src}, select=["jit-purity"]) == []
+
+
+def test_suppression_is_per_rule(tmp_path):
+    # disabling an unrelated rule must NOT silence the finding
+    src = """\
+        import jax
+
+        @jax.jit
+        def step(x):
+            print(x)  # ptlint: disable=recompile-hazard
+            return x
+        """
+    new = _lint(tmp_path, {"mod.py": src}, select=["jit-purity"])
+    assert _rules(new) == ["jit-purity"]
+
+
+# ---------------------------------------------------------------- baseline
+def _write_and_collect(tmp_path, src):
+    (tmp_path / "mod.py").write_text(textwrap.dedent(src))
+    files = collect_files([str(tmp_path)], str(tmp_path))
+    return run_passes(files, str(tmp_path), ["jit-purity"])
+
+
+def test_baseline_filters_known_findings(tmp_path):
+    findings = _write_and_collect(tmp_path, BAD_JIT_PURITY)
+    assert findings
+    entries = [{"rule": f.rule, "path": f.path, "message": f.message}
+               for f in findings]
+    new, baselined, stale = apply_baseline(findings, entries)
+    assert new == []
+    assert len(baselined) == len(findings)
+    assert stale == []
+
+
+def test_baseline_survives_line_moves(tmp_path):
+    # identity is (rule, path, message): adding lines above the finding
+    # must not un-baseline it
+    findings = _write_and_collect(tmp_path, BAD_JIT_PURITY)
+    entries = [{"rule": f.rule, "path": f.path, "message": f.message}
+               for f in findings]
+    moved = _write_and_collect(tmp_path, "x = 1\ny = 2\n\n"
+                               + textwrap.dedent(BAD_JIT_PURITY))
+    assert any(f.line != findings[0].line for f in moved)
+    new, baselined, _ = apply_baseline(moved, entries)
+    assert new == []
+    assert len(baselined) == len(moved)
+
+
+def test_baseline_stale_entry_detected(tmp_path):
+    findings = _write_and_collect(tmp_path, GOOD_JIT_PURITY)
+    assert findings == []
+    ghost = [{"rule": "jit-purity", "path": "mod.py",
+              "message": "long-since-fixed finding"}]
+    new, baselined, stale = apply_baseline(findings, ghost)
+    assert (new, baselined) == ([], [])
+    assert stale == ghost
+
+
+def test_baseline_file_roundtrip(tmp_path):
+    findings = _write_and_collect(tmp_path, BAD_JIT_PURITY)
+    bl = tmp_path / "baseline.json"
+    from tools.ptlint.engine import load_baseline, write_baseline
+
+    write_baseline(str(bl), findings)
+    entries = load_baseline(str(bl))
+    new, baselined, stale = apply_baseline(findings, entries)
+    assert new == [] and stale == []
+    assert len(baselined) == len(findings)
+
+
+# --------------------------------------------------------------- CLI
+def _run_cli(args):
+    return subprocess.run(
+        [sys.executable, "-m", "tools.ptlint"] + args,
+        cwd=ROOT, capture_output=True, text=True)
+
+
+def test_cli_exit_zero_on_clean_fixture(tmp_path):
+    p = tmp_path / "good.py"
+    p.write_text(textwrap.dedent(GOOD_JIT_PURITY))
+    r = _run_cli([str(p), "--no-baseline"])
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_cli_exit_one_on_findings(tmp_path):
+    p = tmp_path / "bad.py"
+    p.write_text(textwrap.dedent(BAD_JIT_PURITY))
+    r = _run_cli([str(p), "--no-baseline"])
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "[jit-purity]" in r.stdout
+
+
+def test_cli_exit_two_on_bad_usage(tmp_path):
+    r = _run_cli([str(tmp_path / "no_such_file.py")])
+    assert r.returncode == 2
+    r = _run_cli(["--select", "not-a-rule"])
+    assert r.returncode == 2
+
+
+def test_cli_json_report(tmp_path):
+    p = tmp_path / "bad.py"
+    p.write_text(textwrap.dedent(BAD_JIT_PURITY))
+    r = _run_cli([str(p), "--no-baseline", "--json"])
+    assert r.returncode == 1
+    data = json.loads(r.stdout)
+    assert data["findings"] and data["files_checked"] == 1
+    assert data["findings"][0]["rule"] == "jit-purity"
+
+
+def test_cli_list_rules():
+    r = _run_cli(["--list-rules"])
+    assert r.returncode == 0
+    for rule in ("jit-purity", "recompile-hazard",
+                 "collective-consistency", "lock-discipline",
+                 "metric-names"):
+        assert rule in r.stdout
+
+
+def test_parse_error_is_reported(tmp_path):
+    p = tmp_path / "broken.py"
+    p.write_text("def f(:\n")
+    new, _, _ = lint([str(p)], root=str(tmp_path), baseline_path=None)
+    assert any(f.rule == "parse-error" for f in new)

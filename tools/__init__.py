@@ -1,0 +1,2 @@
+# tools/ is a package so `python -m tools.ptlint` works from the repo
+# root; the scripts in here still run standalone (`python tools/x.py`).

@@ -1,30 +1,12 @@
 #!/usr/bin/env python
-"""Metric-name lint: every telemetry call site must use a name declared
-in ``paddle_tpu/observability/metrics_schema.py``.
+"""Metric-name lint — compatibility shim.
 
-Walks the source tree (paddle_tpu/, tools/, tests/, bench.py) with
-``ast`` and checks every ``<obj>.counter("...")`` / ``.gauge("...")`` /
-``.histogram("...")`` / ``stopwatch("...")`` call whose first argument
-is a dotted string literal:
-
-  * the name must be a key of ``metrics_schema.METRICS``;
-  * the instrument kind must match the declared kind (a ``stopwatch``
-    records into a histogram);
-  * literal ``tags={...}`` keys must be declared for that metric.
-
-Span call sites are linted the same way: every ``<obj>.span("...")`` /
-``span("...")`` whose first argument is a dotted string literal must
-name a key of ``metrics_schema.SPANS``.
-
-Names built at runtime (non-literal first args) are out of scope — the
-registry itself stays schema-agnostic by design; this lint keeps the
-IN-TREE instrumentation and the README metric table honest. Wired into
-tier-1 via tests/test_metric_names.py.
-
-For namespaces listed in ``_REQUIRE_USED`` the lint also runs in
-reverse: every declared metric/span of that namespace must appear at
-some literal call site, so the schema can't accumulate dead rows while
-the subsystem silently drops its instrumentation.
+The actual checker now lives in ``tools/ptlint/passes/metric_names.py``
+as the ptlint ``metric-names`` pass (run it via
+``python -m tools.ptlint``).  This module keeps the original standalone
+CLI and the string-based API (``run``, ``check_file``, ``_load_schema``)
+that tests/test_metric_names.py and older tooling call, delegating all
+logic to the pass.
 """
 from __future__ import annotations
 
@@ -32,118 +14,37 @@ import ast
 import os
 import sys
 
-# attribute-call spellings -> the schema kind they record into
-_KIND = {"counter": "counter", "gauge": "gauge", "histogram": "histogram",
-         "stopwatch": "histogram", "Stopwatch": "histogram"}
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    # the test suite loads this file standalone via importlib, so the
+    # package import needs the repo root on sys.path explicitly
+    sys.path.insert(0, _REPO_ROOT)
 
-_SKIP_DIRS = {".git", "__pycache__", "build", "dist", ".eggs",
-              "node_modules"}
+from tools.ptlint.passes import metric_names as _impl  # noqa: E402
 
-# namespaces whose declared names must all be instrumented somewhere
-_REQUIRE_USED = ("serving.",)
-
-
-def _iter_py_files(root: str):
-    roots = [os.path.join(root, "paddle_tpu"), os.path.join(root, "tools"),
-             os.path.join(root, "tests")]
-    for r in roots:
-        for dirpath, dirnames, files in os.walk(r):
-            dirnames[:] = [d for d in dirnames if d not in _SKIP_DIRS]
-            for f in sorted(files):
-                if f.endswith(".py"):
-                    yield os.path.join(dirpath, f)
-    bench = os.path.join(root, "bench.py")
-    if os.path.exists(bench):
-        yield bench
-
-
-def _call_kind(func) -> str:
-    if isinstance(func, ast.Attribute) and func.attr in _KIND:
-        return _KIND[func.attr]
-    if isinstance(func, ast.Name) and func.id in ("stopwatch",
-                                                  "Stopwatch"):
-        return "histogram"
-    return ""
-
-
-def _is_span_call(func) -> bool:
-    if isinstance(func, ast.Attribute):
-        return func.attr == "span"
-    if isinstance(func, ast.Name):
-        return func.id == "span"
-    return False
-
-
-def _literal_str(node) -> str:
-    if isinstance(node, ast.Constant) and isinstance(node.value, str):
-        return node.value
-    return ""
+# legacy names, re-exported for callers that reached into the module
+_KIND = _impl._KIND
+_SKIP_DIRS = _impl._SKIP_DIRS
+_REQUIRE_USED = _impl.REQUIRE_USED
+_iter_py_files = _impl.iter_canonical_files
+_call_kind = _impl._call_kind
+_is_span_call = _impl._is_span_call
+_literal_str = _impl._literal_str
+_load_schema = _impl.load_schema
 
 
 def check_file(path: str, metrics, errors: list, spans=None,
                used=None):
+    """Append ``path:line: message`` strings for one file (legacy API)."""
     try:
-        with open(path) as f:
+        with open(path, encoding="utf-8") as f:
             tree = ast.parse(f.read(), filename=path)
     except SyntaxError as e:
         errors.append(f"{path}: unparseable ({e})")
         return
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call) or not node.args:
-            continue
-        if spans is not None and _is_span_call(node.func):
-            sname = _literal_str(node.args[0])
-            if used is not None and sname:
-                used.add(sname)
-            if "." in sname and sname not in spans:
-                errors.append(
-                    f"{path}:{node.args[0].lineno}: span {sname!r} is "
-                    "not declared in paddle_tpu/observability/"
-                    "metrics_schema.py SPANS")
-            continue
-        kind = _call_kind(node.func)
-        if not kind:
-            continue
-        name = _literal_str(node.args[0])
-        if "." not in name:
-            # runtime-built or non-metric string: out of lint scope
-            continue
-        if used is not None:
-            used.add(name)
-        spec = metrics.get(name)
-        where = f"{path}:{node.args[0].lineno}"
-        if spec is None:
-            errors.append(
-                f"{where}: metric {name!r} is not declared in "
-                "paddle_tpu/observability/metrics_schema.py")
-            continue
-        if spec.kind != kind:
-            errors.append(
-                f"{where}: metric {name!r} is declared as a {spec.kind} "
-                f"but recorded as a {kind}")
-        for kw in node.keywords:
-            if kw.arg != "tags" or not isinstance(kw.value, ast.Dict):
-                continue
-            for k in kw.value.keys:
-                key = _literal_str(k)
-                if key and key not in spec.tags:
-                    errors.append(
-                        f"{where}: metric {name!r} has no declared tag "
-                        f"key {key!r} (allowed: {spec.tags})")
-
-
-def _load_schema(root: str):
-    # load metrics_schema.py standalone (it only needs the stdlib) so
-    # the lint never drags in jax / the full framework import
-    import importlib.util
-
-    path = os.path.join(root, "paddle_tpu", "observability",
-                        "metrics_schema.py")
-    spec = importlib.util.spec_from_file_location("_pt_metrics_schema",
-                                                  path)
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-    return mod.METRICS, getattr(mod, "SPANS", {})
+    for lineno, msg in _impl.check_tree(tree, metrics, spans=spans,
+                                        used=used):
+        errors.append(f"{path}:{lineno}: {msg}")
 
 
 def run(root: str) -> list:
@@ -152,23 +53,13 @@ def run(root: str) -> list:
     used: set = set()
     for path in _iter_py_files(root):
         check_file(path, metrics, errors, spans=spans, used=used)
-    # reverse check: no dead schema rows in the opted-in namespaces
-    for name in sorted(metrics):
-        if name.startswith(_REQUIRE_USED) and name not in used:
-            errors.append(
-                f"metrics_schema.py: metric {name!r} is declared but "
-                "never recorded at any literal call site")
-    for name in sorted(spans):
-        if name.startswith(_REQUIRE_USED) and name not in used:
-            errors.append(
-                f"metrics_schema.py: span {name!r} is declared but "
-                "never opened at any literal call site")
+    for _kind, msg in _impl.reverse_findings(root, metrics, spans, used):
+        errors.append(f"metrics_schema.py: {msg}")
     return errors
 
 
 def main() -> int:
-    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    errors = run(root)
+    errors = run(_REPO_ROOT)
     for e in errors:
         print(e, file=sys.stderr)
     if errors:

@@ -1,0 +1,63 @@
+#!/usr/bin/env python
+"""One-shot lint driver: every ptlint pass over the canonical tree.
+
+Equivalent to ``python -m tools.ptlint`` with the default targets, plus
+a stale-baseline sweep, so CI and humans need exactly one command::
+
+    python tools/lint_all.py [--json]
+
+Exit codes follow ptlint: 0 clean, 1 findings or stale baseline
+entries, 2 usage/internal error.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+from tools.ptlint import (DEFAULT_BASELINE, DEFAULT_TARGETS,  # noqa: E402
+                          REPO_ROOT, lint)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python tools/lint_all.py",
+        description="run every ptlint pass over %s"
+                    % " ".join(DEFAULT_TARGETS))
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable JSON report on stdout")
+    args = ap.parse_args(argv)
+
+    targets = [os.path.join(REPO_ROOT, t) for t in DEFAULT_TARGETS]
+    try:
+        new, baselined, stale = lint(targets, root=REPO_ROOT,
+                                     baseline_path=DEFAULT_BASELINE)
+    except Exception as e:  # UsageError / unreadable baseline
+        print(f"lint_all: error: {e}", file=sys.stderr)
+        return 2
+
+    if args.json:
+        print(json.dumps({
+            "findings": [f.to_dict() for f in new],
+            "baselined": [f.to_dict() for f in baselined],
+            "stale_baseline": stale}, indent=1))
+    else:
+        for f in new:
+            print(str(f))
+        for e in stale:
+            print("stale baseline entry (no longer found): "
+                  f"[{e['rule']}] {e['path']}: {e['message']}")
+        print(f"lint_all: {len(new)} finding(s), {len(baselined)} "
+              f"baselined, {len(stale)} stale baseline entr"
+              f"{'y' if len(stale) == 1 else 'ies'}",
+              file=sys.stderr if (new or stale) else sys.stdout)
+    return 1 if (new or stale) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,23 @@
+"""ptlint: multi-pass TPU-correctness static analyzer.
+
+Five rules over the in-tree sources (see README "Static analysis"):
+
+* ``jit-purity``             — host side effects / tracer leaks in
+                               jit-traced bodies
+* ``recompile-hazard``       — jit-in-loop, unhashable static args,
+                               mutable closures, shape branches
+* ``collective-consistency`` — collectives not all ranks provably reach
+* ``lock-discipline``        — ``# guarded by:`` attrs touched outside
+                               their lock
+* ``metric-names``           — telemetry call sites vs metrics_schema
+
+Run: ``python -m tools.ptlint paddle_tpu/ tools/ bench.py``
+"""
+from .engine import (DEFAULT_BASELINE, DEFAULT_TARGETS, REPO_ROOT,
+                     Finding, Pass, SourceFile, apply_baseline,
+                     collect_files, lint, load_baseline, main,
+                     run_passes)
+
+__all__ = ["Finding", "Pass", "SourceFile", "collect_files",
+           "run_passes", "load_baseline", "apply_baseline", "lint",
+           "main", "REPO_ROOT", "DEFAULT_BASELINE", "DEFAULT_TARGETS"]

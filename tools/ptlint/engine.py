@@ -1,0 +1,347 @@
+"""ptlint engine: the reusable AST static-analysis core.
+
+The reference framework catches this repo's worst bug class (silent
+recompiles, rank-divergent collectives, racy shared state) with C++
+sanitizers and PIR verifier passes; the jax_graft equivalent is this
+AST-level analyzer. The engine owns everything rule-agnostic:
+
+* **SourceFile** — parsed file + per-line suppression table
+  (``# ptlint: disable=<rule>[,<rule>...]`` silences findings reported
+  on that physical line; ``# ptlint: disable-file=<rule>`` anywhere in
+  the file silences the whole file for that rule);
+* **Finding** — one diagnostic; its baseline identity is
+  ``(rule, path, message)`` — line numbers are deliberately excluded so
+  unrelated edits above a grandfathered finding don't un-baseline it;
+* **baseline** — ``tools/ptlint/baseline.json`` holds grandfathered
+  findings; anything it matches is reported as baselined (not a
+  failure), and entries that no longer match anything are *stale* (the
+  ``--check-baseline`` mode / the slow self-check fails on those);
+* **reporters** — human text and ``--json`` machine output;
+* **exit codes** — 0 clean, 1 findings (or stale baseline under
+  ``--check-baseline``), 2 usage/internal error.
+
+Rules live in :mod:`tools.ptlint.passes`; each pass gets the full file
+list (cross-file rules like lock ownership and jit reachability need
+global visibility) and returns ``Finding`` objects. Run everything
+with::
+
+    python -m tools.ptlint paddle_tpu/ tools/ bench.py
+"""
+from __future__ import annotations
+
+import argparse
+import ast
+import json
+import os
+import re
+import sys
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+__all__ = ["Finding", "SourceFile", "Pass", "collect_files",
+           "run_passes", "load_baseline", "apply_baseline", "lint",
+           "main", "REPO_ROOT", "DEFAULT_BASELINE", "DEFAULT_TARGETS"]
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "baseline.json")
+# what tier-1 lints when no explicit paths are given
+DEFAULT_TARGETS = ("paddle_tpu", "tools", "bench.py")
+
+_SKIP_DIRS = {".git", "__pycache__", "build", "dist", ".eggs",
+              "node_modules", ".pytest_cache"}
+
+_DISABLE_RE = re.compile(r"#\s*ptlint:\s*disable=([\w\-, ]+)")
+_DISABLE_FILE_RE = re.compile(r"#\s*ptlint:\s*disable-file=([\w\-, ]+)")
+
+
+class UsageError(Exception):
+    """Bad CLI input (unknown path / rule); maps to exit code 2."""
+
+
+class Finding:
+    """One diagnostic. ``key()`` is the baseline identity — no line
+    number, so baselined findings survive edits elsewhere in the file."""
+
+    __slots__ = ("rule", "path", "line", "message")
+
+    def __init__(self, rule: str, path: str, line: int, message: str):
+        self.rule = rule
+        self.path = path          # '/'-separated, relative to repo root
+        self.line = int(line)
+        self.message = message
+
+    def key(self) -> Tuple[str, str, str]:
+        return (self.rule, self.path, self.message)
+
+    def to_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "message": self.message}
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+    def __repr__(self) -> str:
+        return f"Finding({self!s})"
+
+
+class SourceFile:
+    """A parsed source file plus its suppression table."""
+
+    def __init__(self, path: str, relpath: str, text: str):
+        self.path = path
+        self.relpath = relpath
+        self.text = text
+        self.lines = text.splitlines()
+        self.parse_error: Optional[SyntaxError] = None
+        try:
+            self.tree: Optional[ast.AST] = ast.parse(text, filename=path)
+        except SyntaxError as e:
+            self.tree = None
+            self.parse_error = e
+        self.file_disabled: Set[str] = set()
+        self.line_disabled: Dict[int, Set[str]] = {}
+        for i, ln in enumerate(self.lines, 1):
+            m = _DISABLE_FILE_RE.search(ln)
+            if m:
+                self.file_disabled |= _rules_of(m.group(1))
+                continue
+            m = _DISABLE_RE.search(ln)
+            if m:
+                self.line_disabled.setdefault(i, set()).update(
+                    _rules_of(m.group(1)))
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        if rule in self.file_disabled:
+            return True
+        return rule in self.line_disabled.get(line, ())
+
+
+def _rules_of(raw: str) -> Set[str]:
+    return {r.strip() for r in raw.split(",") if r.strip()}
+
+
+class Pass:
+    """Base class for one analysis rule. ``run`` receives EVERY file of
+    the invocation so cross-file rules (lock ownership, jit
+    reachability, schema reverse checks) can see the whole world."""
+
+    name = ""
+    description = ""
+
+    def run(self, files: Sequence[SourceFile],
+            root: str) -> List[Finding]:  # pragma: no cover - interface
+        raise NotImplementedError
+
+
+# ----------------------------------------------------------- file intake
+def to_relpath(path: str, root: str) -> str:
+    return os.path.relpath(os.path.abspath(path), root).replace(os.sep, "/")
+
+
+def collect_files(paths: Sequence[str], root: str) -> List[SourceFile]:
+    """Expand dirs (recursively, ``*.py``) and files into SourceFiles,
+    deduplicated and sorted by relpath."""
+    found: Dict[str, str] = {}
+    for p in paths:
+        ap = os.path.abspath(p)
+        if os.path.isdir(ap):
+            for dirpath, dirnames, files in os.walk(ap):
+                dirnames[:] = sorted(d for d in dirnames
+                                     if d not in _SKIP_DIRS)
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        fp = os.path.join(dirpath, f)
+                        found[to_relpath(fp, root)] = fp
+        elif os.path.isfile(ap):
+            if ap.endswith(".py"):
+                found[to_relpath(ap, root)] = ap
+        else:
+            raise UsageError(f"no such file or directory: {p}")
+    out = []
+    for rel in sorted(found):
+        with open(found[rel], encoding="utf-8") as fh:
+            out.append(SourceFile(found[rel], rel, fh.read()))
+    return out
+
+
+# ------------------------------------------------------------ pass logic
+def get_passes(select: Optional[Sequence[str]] = None) -> List[Pass]:
+    from .passes import ALL_PASSES
+
+    passes = [cls() for cls in ALL_PASSES]
+    if select is None:
+        return passes
+    known = {p.name for p in passes}
+    bad = [s for s in select if s not in known]
+    if bad:
+        raise UsageError("unknown rule(s): %s (known: %s)"
+                         % (", ".join(bad), ", ".join(sorted(known))))
+    return [p for p in passes if p.name in select]
+
+
+def run_passes(files: Sequence[SourceFile], root: str,
+               select: Optional[Sequence[str]] = None) -> List[Finding]:
+    """All findings from all (selected) passes, suppressions applied,
+    sorted by (path, line, rule)."""
+    findings: List[Finding] = []
+    for sf in files:
+        if sf.parse_error is not None:
+            findings.append(Finding(
+                "parse-error", sf.relpath,
+                sf.parse_error.lineno or 1,
+                f"unparseable: {sf.parse_error.msg}"))
+    for p in get_passes(select):
+        findings.extend(p.run(files, root))
+    by_rel = {sf.relpath: sf for sf in files}
+    kept = []
+    for f in findings:
+        sf = by_rel.get(f.path)
+        if sf is not None and f.rule != "parse-error" and \
+                sf.suppressed(f.rule, f.line):
+            continue
+        kept.append(f)
+    kept.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+    return kept
+
+
+# -------------------------------------------------------------- baseline
+def load_baseline(path: str) -> List[dict]:
+    if not os.path.exists(path):
+        return []
+    with open(path, encoding="utf-8") as fh:
+        data = json.load(fh)
+    entries = data.get("findings", []) if isinstance(data, dict) else data
+    out = []
+    for e in entries:
+        if not all(k in e for k in ("rule", "path", "message")):
+            raise UsageError(f"malformed baseline entry in {path}: {e!r}")
+        out.append({"rule": e["rule"], "path": e["path"],
+                    "message": e["message"]})
+    return out
+
+
+def apply_baseline(findings: Sequence[Finding],
+                   entries: Sequence[dict]) -> Tuple[List[Finding],
+                                                     List[Finding],
+                                                     List[dict]]:
+    """Split into (new, baselined, stale_entries). An entry may match
+    any number of findings; entries matching none are stale."""
+    keys = {(e["rule"], e["path"], e["message"]) for e in entries}
+    hit: Set[Tuple[str, str, str]] = set()
+    new, old = [], []
+    for f in findings:
+        if f.key() in keys:
+            hit.add(f.key())
+            old.append(f)
+        else:
+            new.append(f)
+    stale = [e for e in entries
+             if (e["rule"], e["path"], e["message"]) not in hit]
+    return new, old, stale
+
+
+def write_baseline(path: str, findings: Sequence[Finding]) -> None:
+    data = {"version": 1,
+            "comment": "grandfathered ptlint findings; regenerate with "
+                       "`python -m tools.ptlint --update-baseline`",
+            "findings": [{"rule": f.rule, "path": f.path,
+                          "message": f.message} for f in findings]}
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(data, fh, indent=1, sort_keys=False)
+        fh.write("\n")
+
+
+# ------------------------------------------------------------ entrypoint
+def lint(paths: Sequence[str], root: str = REPO_ROOT,
+         select: Optional[Sequence[str]] = None,
+         baseline_path: Optional[str] = DEFAULT_BASELINE):
+    """Programmatic API used by the tier-1 tests: returns
+    ``(new_findings, baselined_findings, stale_entries)``."""
+    files = collect_files(paths, root)
+    findings = run_passes(files, root, select)
+    entries = load_baseline(baseline_path) if baseline_path else []
+    return apply_baseline(findings, entries)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.ptlint",
+        description="TPU-correctness static analyzer "
+                    "(jit purity, recompile hazards, collective "
+                    "consistency, lock discipline, metric names)")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to lint (default: %s)"
+                         % " ".join(DEFAULT_TARGETS))
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable JSON report on stdout")
+    ap.add_argument("--select", default=None,
+                    help="comma-separated rule names to run")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline file (default: tools/ptlint/"
+                         "baseline.json)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline file")
+    ap.add_argument("--check-baseline", action="store_true",
+                    help="fail if the baseline has stale (already "
+                         "fixed) entries instead of failing on findings")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline to grandfather every "
+                         "current finding")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    args = ap.parse_args(argv)
+
+    try:
+        if args.list_rules:
+            for p in get_passes():
+                print(f"{p.name:24s} {p.description}")
+            return 0
+        root = REPO_ROOT
+        paths = args.paths or [os.path.join(root, t)
+                               for t in DEFAULT_TARGETS]
+        select = args.select.split(",") if args.select else None
+        files = collect_files(paths, root)
+        findings = run_passes(files, root, select)
+        bl_path = None if args.no_baseline else args.baseline
+        entries = load_baseline(bl_path) if bl_path else []
+        new, old, stale = apply_baseline(findings, entries)
+    except UsageError as e:
+        print(f"ptlint: error: {e}", file=sys.stderr)
+        return 2
+
+    if args.update_baseline:
+        write_baseline(args.baseline, findings)
+        print(f"ptlint: baseline updated with {len(findings)} "
+              f"finding(s) -> {args.baseline}")
+        return 0
+
+    if args.check_baseline:
+        if args.json:
+            print(json.dumps({"stale_baseline": stale}, indent=1))
+        else:
+            for e in stale:
+                print("stale baseline entry (no longer found): "
+                      f"[{e['rule']}] {e['path']}: {e['message']}")
+        if stale:
+            print(f"ptlint: {len(stale)} stale baseline entr"
+                  f"{'y' if len(stale) == 1 else 'ies'} — they are "
+                  "fixed; remove them from the baseline",
+                  file=sys.stderr)
+            return 1
+        print("ptlint: baseline is tight (no stale entries)")
+        return 0
+
+    if args.json:
+        print(json.dumps({
+            "findings": [f.to_dict() for f in new],
+            "baselined": [f.to_dict() for f in old],
+            "stale_baseline": stale,
+            "files_checked": len(files)}, indent=1))
+    else:
+        for f in new:
+            print(str(f))
+        summary = (f"ptlint: {len(new)} finding(s), {len(old)} "
+                   f"baselined, {len(files)} file(s) checked")
+        print(summary, file=sys.stderr if new else sys.stdout)
+    return 1 if new else 0

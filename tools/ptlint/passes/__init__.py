@@ -1,0 +1,17 @@
+"""ptlint rule registry. Adding a pass = subclass
+:class:`tools.ptlint.engine.Pass`, implement ``run(files, root)``, and
+append the class here; the driver, suppression comments, baseline and
+both reporters pick it up with no further wiring."""
+from .collective_consistency import CollectiveConsistencyPass
+from .jit_purity import JitPurityPass
+from .lock_discipline import LockDisciplinePass
+from .metric_names import MetricNamesPass
+from .recompile_hazard import RecompileHazardPass
+
+ALL_PASSES = [JitPurityPass, RecompileHazardPass,
+              CollectiveConsistencyPass, LockDisciplinePass,
+              MetricNamesPass]
+
+__all__ = ["ALL_PASSES", "JitPurityPass", "RecompileHazardPass",
+           "CollectiveConsistencyPass", "LockDisciplinePass",
+           "MetricNamesPass"]

@@ -1,0 +1,160 @@
+"""collective-consistency: collectives that not every rank executes.
+
+A collective (``pg.all_reduce``, ``lax.psum``, ``store.barrier``, ...)
+is a *rendezvous*: every rank of the group must reach it, in the same
+order, or the job deadlocks — and only at scale, never under a
+single-process pytest. The T3 paper (arXiv:2401.16677) tracks
+collectives transparently at runtime; this pass applies the same spirit
+at lint time. Flagged:
+
+* **rank-conditional collective** — a collective call nested under an
+  ``if`` whose test depends on the rank (``rank``, ``local_rank``,
+  ``trainer_id``, ``get_rank()``, ...) with no collective in the
+  matching ``else``: ranks that skip the branch leave the others
+  blocked. (When both branches issue collectives — the classic
+  ``if rank == src: broadcast-send else: broadcast-recv`` pairing —
+  the shape is consistent and not flagged.)
+* **swallowed collective failure** — a collective inside a ``try``
+  whose handler does not re-raise: the excepting rank silently leaves
+  the rendezvous while the others wait. Re-raise, or abort the group.
+
+Point-to-point ops (``send`` / ``recv``) are intentionally rank-paired
+and therefore out of scope.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Sequence
+
+from .._jitreach import dotted
+from ..engine import Finding, Pass
+
+_COLLECTIVE_ATTRS = {"all_reduce", "allreduce", "all_gather",
+                     "allgather", "all_gather_object", "broadcast",
+                     "broadcast_object_list", "reduce",
+                     "reduce_scatter", "all_to_all", "alltoall",
+                     "barrier", "psum", "pmean", "pmax", "pmin",
+                     "ppermute", "pswapaxes", "coalesced_all_reduce"}
+# bare-name spellings (from jax.lax import psum, ...)
+_COLLECTIVE_NAMES = {"psum", "pmean", "pmax", "pmin", "ppermute",
+                     "barrier", "all_reduce", "all_gather"}
+_RANK_TOKENS = {"rank", "local_rank", "global_rank", "rank_id",
+                "trainer_id", "server_index", "worker_index",
+                "node_rank", "cur_rank"}
+_RANK_CALLS = {"get_rank", "get_local_rank", "local_rank",
+               "process_index", "get_trainer_id"}
+
+
+def _is_collective(call: ast.Call) -> Optional[str]:
+    f = call.func
+    if isinstance(f, ast.Attribute) and f.attr in _COLLECTIVE_ATTRS:
+        return f.attr
+    if isinstance(f, ast.Name) and f.id in _COLLECTIVE_NAMES:
+        return f.id
+    return None
+
+
+def _rank_dependent(test: ast.AST) -> Optional[str]:
+    """The rank-ish token the test depends on, or None."""
+    for node in ast.walk(test):
+        if isinstance(node, ast.Name) and node.id in _RANK_TOKENS:
+            return node.id
+        if isinstance(node, ast.Attribute) and \
+                node.attr in _RANK_TOKENS:
+            return node.attr
+        if isinstance(node, ast.Call):
+            d = dotted(node.func)
+            if d and d.rsplit(".", 1)[-1] in _RANK_CALLS:
+                return d
+    return None
+
+
+def _contains_collective(nodes: Sequence[ast.AST]) -> bool:
+    for n in nodes:
+        for sub in ast.walk(n):
+            if isinstance(sub, ast.Call) and _is_collective(sub):
+                return True
+    return False
+
+
+class CollectiveConsistencyPass(Pass):
+    name = "collective-consistency"
+    description = ("collectives under rank-conditional branches or "
+                   "swallowing try/except — deadlocks only at scale")
+
+    def run(self, files: Sequence, root: str) -> List[Finding]:
+        out: List[Finding] = []
+        for sf in files:
+            if sf.tree is None:
+                continue
+            self._check(sf, out)
+        return out
+
+    def _check(self, sf, out: List[Finding]) -> None:
+        pass_name = self.name
+
+        class V(ast.NodeVisitor):
+            def __init__(self):
+                # rank-conditional If frames: (token, balanced, branch)
+                self.if_stack: List[tuple] = []
+                # Try frames whose handlers swallow
+                self.try_stack: List[ast.Try] = []
+
+            def visit_If(self, node):
+                token = _rank_dependent(node.test)
+                if token is None:
+                    self.generic_visit(node)
+                    return
+                # "balanced": the complementary branch also reaches a
+                # collective, so every rank does SOME collective here
+                body_has = _contains_collective(node.body)
+                else_has = _contains_collective(node.orelse)
+                balanced = body_has and else_has
+                for field, branch in (("body", node.body),
+                                      ("orelse", node.orelse)):
+                    self.if_stack.append((token, balanced))
+                    for child in branch:
+                        self.visit(child)
+                    self.if_stack.pop()
+                # test expression itself can hold calls
+                self.visit(node.test)
+
+            def visit_Try(self, node):
+                swallows = any(
+                    not any(isinstance(s, ast.Raise)
+                            for s in ast.walk(h))
+                    for h in node.handlers)
+                if swallows:
+                    self.try_stack.append(node)
+                for child in node.body:
+                    self.visit(child)
+                if swallows:
+                    self.try_stack.pop()
+                for part in (node.handlers, node.orelse,
+                             node.finalbody):
+                    for child in part:
+                        self.visit(child)
+
+            def visit_Call(self, node):
+                op = _is_collective(node)
+                if op:
+                    unbalanced = [t for t, bal in self.if_stack
+                                  if not bal]
+                    if unbalanced:
+                        out.append(Finding(
+                            pass_name, sf.relpath, node.lineno,
+                            f"collective `{op}` under rank-dependent "
+                            f"branch on `{unbalanced[-1]}` with no "
+                            "collective in the other branch — ranks "
+                            "that skip it deadlock the group"))
+                    elif self.try_stack:
+                        out.append(Finding(
+                            pass_name, sf.relpath, node.lineno,
+                            f"collective `{op}` inside try with a "
+                            "swallowing except — a failing rank "
+                            "silently leaves the rendezvous while "
+                            "the others block; re-raise or abort "
+                            "the group"))
+                self.generic_visit(node)
+
+        V().visit(sf.tree)

@@ -1,0 +1,167 @@
+"""jit-purity: host side effects and tracer leaks in traced bodies.
+
+Anything reachable from ``jax.jit`` / ``pjit`` / ``pallas_call`` (see
+:mod:`tools.ptlint._jitreach`) runs ONCE at trace time; Python-level
+side effects in those bodies silently freeze (a ``time.time()`` stamps
+the compile, not the step), leak host syncs (``.item()``), or crash at
+runtime (``float(tracer)``). Flagged:
+
+* host side effects: ``print`` / ``input`` / ``breakpoint`` / ``open``
+* host clocks: ``time.time`` / ``perf_counter`` / ``monotonic`` / ...
+* host RNG: ``np.random.*`` (and stdlib ``random.*`` when the file
+  does ``import random``)
+* NumPy compute (``np.*`` calls, dtype constructors exempt): either
+  constant-folds at trace time or explodes on a tracer — use ``jnp``
+* ``.item()`` — device sync / tracer leak
+* ``float()`` / ``int()`` / ``bool()`` applied to a traced function's
+  parameter (or an expression rooted at one) — ConcretizationTypeError
+* mutation of ``self.<attr>`` / ``global`` — the write happens once at
+  trace time, not per step (intentional trace-counters get a
+  ``# ptlint: disable=jit-purity``)
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Sequence, Set
+
+from .._jitreach import dotted, fn_params, traced_functions
+from ..engine import Finding, Pass
+
+_HOST_CALLS = {"print", "input", "breakpoint", "open"}
+_CLOCKS = {"time.time", "time.perf_counter", "time.monotonic",
+           "time.process_time", "time.sleep", "time.time_ns",
+           "time.monotonic_ns", "time.perf_counter_ns"}
+# np attributes that are legitimate at trace time (dtypes / constants /
+# shape introspection of concrete python values)
+_NP_OK = {"float16", "float32", "float64", "int8", "int16", "int32",
+          "int64", "uint8", "uint16", "uint32", "uint64", "bool_",
+          "dtype", "ndarray", "generic", "isscalar", "ndim", "shape",
+          "issubdtype", "floating", "integer", "can_cast",
+          "result_type", "promote_types", "iinfo", "finfo"}
+_CASTS = {"float", "int", "bool"}
+
+
+def _root_name(node: ast.AST) -> str:
+    """Leftmost Name of an expression chain (x.a[0].b() -> 'x')."""
+    while True:
+        if isinstance(node, ast.Attribute):
+            node = node.value
+        elif isinstance(node, ast.Subscript):
+            node = node.value
+        elif isinstance(node, ast.Call):
+            node = node.func
+        elif isinstance(node, ast.Name):
+            return node.id
+        else:
+            return ""
+
+
+def _has_plain_random_import(tree: ast.AST) -> bool:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "random" and (a.asname or a.name) == "random":
+                    return True
+    return False
+
+
+class JitPurityPass(Pass):
+    name = "jit-purity"
+    description = ("host side effects / tracer leaks inside "
+                   "jit-traced function bodies")
+
+    def run(self, files: Sequence, root: str) -> List[Finding]:
+        traced = traced_functions(files)
+        out: List[Finding] = []
+        for sf in files:
+            fns = traced.get(sf.relpath)
+            if not fns:
+                continue
+            stdlib_random = _has_plain_random_import(sf.tree)
+            for fn in fns:
+                self._check_fn(sf, fn, stdlib_random, out)
+        return out
+
+    # ------------------------------------------------------------ per-fn
+    def _check_fn(self, sf, fn, stdlib_random: bool,
+                  out: List[Finding]) -> None:
+        params = fn_params(fn)
+        name = fn.name
+        nested = {n for n in ast.walk(fn)
+                  if isinstance(n, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)) and n is not fn}
+        skip: Set[ast.AST] = set()
+        for n in nested:           # nested defs are checked on their own
+            skip.update(ast.walk(n))
+            skip.discard(n)
+
+        def emit(node, msg):
+            out.append(Finding(self.name, sf.relpath, node.lineno,
+                               f"in jit-traced `{name}`: {msg}"))
+
+        for node in ast.walk(fn):
+            if node in skip:
+                continue
+            if isinstance(node, ast.Call):
+                self._check_call(node, params, stdlib_random, emit)
+            elif isinstance(node, (ast.Assign, ast.AugAssign,
+                                   ast.AnnAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    for el in (t.elts if isinstance(
+                            t, (ast.Tuple, ast.List)) else [t]):
+                        tgt = el
+                        if isinstance(tgt, ast.Subscript):
+                            tgt = tgt.value
+                        if isinstance(tgt, ast.Attribute) and \
+                                isinstance(tgt.value, ast.Name) and \
+                                tgt.value.id == "self":
+                            emit(node,
+                                 f"mutation of `self.{tgt.attr}` — the "
+                                 "write happens once at trace time, "
+                                 "not on every step")
+            elif isinstance(node, ast.Global):
+                emit(node, "`global` statement — trace-time host "
+                           "state mutation")
+
+    def _check_call(self, node: ast.Call, params: Set[str],
+                    stdlib_random: bool, emit) -> None:
+        d = dotted(node.func)
+        if d in _HOST_CALLS:
+            emit(node, f"host side effect `{d}(...)` — runs at trace "
+                       "time only (or not at all under a cached trace)")
+            return
+        if d in _CLOCKS:
+            emit(node, f"host clock `{d}()` — the value freezes at "
+                       "trace time; pass times in as arguments")
+            return
+        if d and (d.startswith("np.random.") or
+                  d.startswith("numpy.random.")):
+            emit(node, f"host RNG `{d}(...)` — traces to a constant; "
+                       "use jax.random with an explicit key")
+            return
+        if d and stdlib_random and d.startswith("random."):
+            emit(node, f"host RNG `{d}(...)` — traces to a constant; "
+                       "use jax.random with an explicit key")
+            return
+        if d and (d.startswith("np.") or d.startswith("numpy.")):
+            attr = d.split(".", 1)[1]
+            if attr.split(".")[0] == "random":
+                pass  # handled above
+            elif attr not in _NP_OK:
+                emit(node, f"NumPy call `{d}(...)` — constant-folds at "
+                           "trace time (or fails on a tracer); use jnp")
+                return
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "item" and not node.args:
+            emit(node, "`.item()` — forces a device sync / leaks the "
+                       "tracer to host")
+            return
+        if isinstance(node.func, ast.Name) and \
+                node.func.id in _CASTS and node.args:
+            rn = _root_name(node.args[0])
+            if rn and rn in params:
+                emit(node, f"`{node.func.id}()` on traced argument "
+                           f"`{rn}` — ConcretizationTypeError under "
+                           "jit; use jnp casts or keep it on device")

@@ -1,0 +1,212 @@
+"""lock-discipline: annotated shared state must be touched under its
+lock.
+
+Threaded subsystems (serving engine, metrics registry, comm watchdog,
+PS tables) guard shared attributes with ad-hoc ``threading.Lock``s; a
+missed acquisition is a data race pytest will essentially never catch.
+The protocol is declarative:
+
+* annotate the attribute where it is created::
+
+      self._tasks = {}        # guarded by: _lock
+
+  Every other ``self._tasks`` load/store in the class must then sit
+  lexically inside ``with self._lock:`` (multi-item withs count).
+
+* helper methods that run with the lock already held declare it on
+  their ``def`` line::
+
+      def _emit(self, req, tok):   # ptlint: holds=_lock
+
+* attributes guarded by an *external* lock (e.g. BlockManager fields,
+  serialized by the owning ServingEngine's lock) use a non-identifier
+  annotation::
+
+      self._free = deque()    # guarded by: caller (ServingEngine._lock)
+
+  Inside the class nothing is checked (there is no lock to see), but
+  any ``<expr>._free`` access from OUTSIDE the class — anywhere in the
+  linted tree — is flagged: external state must go through the owning
+  class's methods, where the caller-holds-lock contract lives.
+
+``__init__`` is exempt (construction happens-before sharing).
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from ..engine import Finding, Pass
+
+_GUARD_RE = re.compile(r"#\s*guarded\s+by:\s*(.+?)\s*$")
+_HOLDS_RE = re.compile(r"#\s*ptlint:\s*holds=([\w,\s]+)")
+_IDENT_RE = re.compile(r"^[A-Za-z_]\w*$")
+_DEFS = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+class _ClassGuards:
+    def __init__(self, cls_name: str, relpath: str):
+        self.cls_name = cls_name
+        self.relpath = relpath
+        self.internal: Dict[str, str] = {}   # attr -> lock attr name
+        self.external: Dict[str, str] = {}   # attr -> prose lock desc
+
+
+def _annotation_on(sf, lineno: int) -> Optional[str]:
+    if 1 <= lineno <= len(sf.lines):
+        m = _GUARD_RE.search(sf.lines[lineno - 1])
+        if m:
+            return m.group(1)
+    return None
+
+
+def _collect_guards(sf) -> List[Tuple[ast.ClassDef, _ClassGuards]]:
+    out = []
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        g = _ClassGuards(node.name, sf.relpath)
+        for sub in ast.walk(node):
+            targets = []
+            if isinstance(sub, ast.Assign):
+                targets = sub.targets
+            elif isinstance(sub, (ast.AnnAssign, ast.AugAssign)):
+                targets = [sub.target]
+            for t in targets:
+                if isinstance(t, ast.Attribute) and \
+                        isinstance(t.value, ast.Name) and \
+                        t.value.id == "self":
+                    # annotation may sit on any line of the statement
+                    for ln in range(t.lineno,
+                                    (sub.end_lineno or t.lineno) + 1):
+                        lock = _annotation_on(sf, ln)
+                        if lock:
+                            if _IDENT_RE.match(lock):
+                                g.internal[t.attr] = lock
+                            else:
+                                g.external[t.attr] = lock
+                            break
+        if g.internal or g.external:
+            out.append((node, g))
+    return out
+
+
+def _held_locks(sf, fn) -> Set[str]:
+    """Locks declared held via `# ptlint: holds=<lock>` on the def."""
+    held: Set[str] = set()
+    body_start = fn.body[0].lineno if fn.body else fn.lineno
+    for ln in range(fn.lineno, body_start + 1):
+        if 1 <= ln <= len(sf.lines):
+            m = _HOLDS_RE.search(sf.lines[ln - 1])
+            if m:
+                held |= {s.strip() for s in m.group(1).split(",")
+                         if s.strip()}
+    return held
+
+
+def _with_locks(items) -> Set[str]:
+    """Lock attr names acquired by one With statement's items."""
+    locks: Set[str] = set()
+    for item in items:
+        e = item.context_expr
+        if isinstance(e, ast.Call):         # with self._lock.acquire()? no
+            e = e.func if isinstance(e.func, ast.Attribute) else e
+        if isinstance(e, ast.Attribute) and \
+                isinstance(e.value, ast.Name) and e.value.id == "self":
+            locks.add(e.attr)
+    return locks
+
+
+class LockDisciplinePass(Pass):
+    name = "lock-discipline"
+    description = ("`# guarded by: <lock>` attributes accessed outside "
+                   "`with self.<lock>`")
+
+    def run(self, files: Sequence, root: str) -> List[Finding]:
+        out: List[Finding] = []
+        # (attr, owning class) pairs guarded by an external lock
+        external: Dict[str, Tuple[str, str]] = {}
+        per_file: List[Tuple[object, ast.ClassDef, _ClassGuards]] = []
+        for sf in files:
+            if sf.tree is None:
+                continue
+            for cls, g in _collect_guards(sf):
+                per_file.append((sf, cls, g))
+                for attr, desc in g.external.items():
+                    external[attr] = (g.cls_name, desc)
+        for sf, cls, g in per_file:
+            if g.internal:
+                self._check_class(sf, cls, g, out)
+        if external:
+            for sf in files:
+                if sf.tree is not None:
+                    self._check_external(sf, external, out)
+        return out
+
+    # --------------------------------------------------- internal locks
+    def _check_class(self, sf, cls: ast.ClassDef, g: _ClassGuards,
+                     out: List[Finding]) -> None:
+        pass_name = self.name
+        methods = [n for n in cls.body if isinstance(n, _DEFS)]
+        for m in methods:
+            if m.name == "__init__":
+                continue
+            held = _held_locks(sf, m)
+
+            class V(ast.NodeVisitor):
+                def __init__(self):
+                    self.locks: List[Set[str]] = [set(held)]
+
+                def visit_With(self, node):
+                    self.locks.append(self.locks[-1] |
+                                      _with_locks(node.items))
+                    self.generic_visit(node)
+                    self.locks.pop()
+
+                visit_AsyncWith = visit_With
+
+                def visit_Attribute(self, node):
+                    if isinstance(node.value, ast.Name) and \
+                            node.value.id == "self" and \
+                            node.attr in g.internal:
+                        lock = g.internal[node.attr]
+                        if lock not in self.locks[-1]:
+                            out.append(Finding(
+                                pass_name, sf.relpath, node.lineno,
+                                f"`self.{node.attr}` is guarded by "
+                                f"`self.{lock}` but "
+                                f"`{g.cls_name}.{m.name}` touches it "
+                                f"outside `with self.{lock}` (or mark "
+                                f"the def `# ptlint: holds={lock}`)"))
+                    self.generic_visit(node)
+
+            V().visit(m)
+
+    # --------------------------------------------------- external locks
+    def _check_external(self, sf, external: Dict[str, Tuple[str, str]],
+                        out: List[Finding]) -> None:
+        """`<expr>.attr` pokes at caller-guarded state from outside the
+        owning class's own methods."""
+        pass_name = self.name
+
+        class V(ast.NodeVisitor):
+            def visit_Attribute(self, node):
+                attr = node.attr
+                if attr in external:
+                    owner, desc = external[attr]
+                    # self.<attr> is the owning (or at least *a*) class
+                    # touching its own state — out of scope here; the
+                    # hazard is reaching through an object reference
+                    # (engine.manager._free) from outside
+                    is_self = isinstance(node.value, ast.Name) and \
+                        node.value.id == "self"
+                    if not is_self:
+                        out.append(Finding(
+                            pass_name, sf.relpath, node.lineno,
+                            f"`.{attr}` is {owner} state guarded by "
+                            f"{desc}; access it through {owner} "
+                            "methods, not by poking the field"))
+                self.generic_visit(node)
+
+        V().visit(sf.tree)

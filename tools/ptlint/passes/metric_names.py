@@ -1,0 +1,195 @@
+"""metric-names: every telemetry call site matches the schema.
+
+Migrated from the standalone ``tools/check_metric_names.py`` (PR 1)
+into a ptlint pass; the old module remains as a thin CLI/API shim over
+this one. Rules (unchanged):
+
+* every ``<obj>.counter("a.b")`` / ``.gauge`` / ``.histogram`` /
+  ``stopwatch("a.b")`` with a dotted string-literal first argument must
+  name a key of ``metrics_schema.METRICS``, with the matching kind
+  (a stopwatch records into a histogram) and only declared tag keys;
+* every literal dotted ``span("a.b")`` must name a key of ``SPANS``;
+* reverse check for the namespaces in ``REQUIRE_USED``: every declared
+  metric/span must be recorded at SOME literal call site in the
+  canonical tree (paddle_tpu/, tools/, tests/, bench.py) — the schema
+  cannot accumulate dead rows. The reverse sweep always walks the
+  canonical tree even when ptlint is pointed at a subset, so partial
+  invocations don't fabricate "never recorded" findings.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from ..engine import Finding, Pass
+
+# attribute-call spellings -> the schema kind they record into
+_KIND = {"counter": "counter", "gauge": "gauge",
+         "histogram": "histogram", "stopwatch": "histogram",
+         "Stopwatch": "histogram"}
+
+_SKIP_DIRS = {".git", "__pycache__", "build", "dist", ".eggs",
+              "node_modules"}
+
+# namespaces whose declared names must all be instrumented somewhere
+REQUIRE_USED = ("serving.",)
+
+_SCHEMA_RELPATH = "paddle_tpu/observability/metrics_schema.py"
+
+
+def iter_canonical_files(root: str):
+    """The tree the metric lint has always covered: paddle_tpu/,
+    tools/, tests/, bench.py."""
+    roots = [os.path.join(root, "paddle_tpu"),
+             os.path.join(root, "tools"), os.path.join(root, "tests")]
+    for r in roots:
+        for dirpath, dirnames, files in os.walk(r):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d not in _SKIP_DIRS)
+            for f in sorted(files):
+                if f.endswith(".py"):
+                    yield os.path.join(dirpath, f)
+    bench = os.path.join(root, "bench.py")
+    if os.path.exists(bench):
+        yield bench
+
+
+def load_schema(root: str):
+    """metrics_schema.py standalone (stdlib-only module) so the lint
+    never drags in jax / the full framework import."""
+    import importlib.util
+
+    path = os.path.join(root, _SCHEMA_RELPATH)
+    spec = importlib.util.spec_from_file_location("_pt_metrics_schema",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.METRICS, getattr(mod, "SPANS", {})
+
+
+def _call_kind(func) -> str:
+    if isinstance(func, ast.Attribute) and func.attr in _KIND:
+        return _KIND[func.attr]
+    if isinstance(func, ast.Name) and func.id in ("stopwatch",
+                                                  "Stopwatch"):
+        return "histogram"
+    return ""
+
+
+def _is_span_call(func) -> bool:
+    if isinstance(func, ast.Attribute):
+        return func.attr == "span"
+    if isinstance(func, ast.Name):
+        return func.id == "span"
+    return False
+
+
+def _literal_str(node) -> str:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return ""
+
+
+def check_tree(tree, metrics, spans=None,
+               used: Optional[Set[str]] = None) -> List[Tuple[int, str]]:
+    """(lineno, message) per violation in one parsed module."""
+    out: List[Tuple[int, str]] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        if spans is not None and _is_span_call(node.func):
+            sname = _literal_str(node.args[0])
+            if used is not None and sname:
+                used.add(sname)
+            if "." in sname and sname not in spans:
+                out.append((node.args[0].lineno,
+                            f"span {sname!r} is not declared in "
+                            "paddle_tpu/observability/"
+                            "metrics_schema.py SPANS"))
+            continue
+        kind = _call_kind(node.func)
+        if not kind:
+            continue
+        name = _literal_str(node.args[0])
+        if "." not in name:
+            # runtime-built or non-metric string: out of lint scope
+            continue
+        if used is not None:
+            used.add(name)
+        spec = metrics.get(name)
+        if spec is None:
+            out.append((node.args[0].lineno,
+                        f"metric {name!r} is not declared in "
+                        "paddle_tpu/observability/metrics_schema.py"))
+            continue
+        if spec.kind != kind:
+            out.append((node.args[0].lineno,
+                        f"metric {name!r} is declared as a {spec.kind} "
+                        f"but recorded as a {kind}"))
+        for kw in node.keywords:
+            if kw.arg != "tags" or not isinstance(kw.value, ast.Dict):
+                continue
+            for k in kw.value.keys:
+                key = _literal_str(k)
+                if key and key not in spec.tags:
+                    out.append((node.args[0].lineno,
+                                f"metric {name!r} has no declared tag "
+                                f"key {key!r} (allowed: {spec.tags})"))
+    return out
+
+
+def reverse_findings(root: str, metrics, spans,
+                     used: Set[str]) -> List[Tuple[str, str]]:
+    """(kind, message) rows for declared-but-never-recorded names."""
+    out = []
+    for name in sorted(metrics):
+        if name.startswith(REQUIRE_USED) and name not in used:
+            out.append(("metric", f"metric {name!r} is declared but "
+                                  "never recorded at any literal call "
+                                  "site"))
+    for name in sorted(spans):
+        if name.startswith(REQUIRE_USED) and name not in used:
+            out.append(("span", f"span {name!r} is declared but never "
+                                "opened at any literal call site"))
+    return out
+
+
+def collect_used(root: str, metrics, spans) -> Set[str]:
+    """Literal call-site names across the canonical tree."""
+    used: Set[str] = set()
+    for path in iter_canonical_files(root):
+        try:
+            with open(path, encoding="utf-8") as f:
+                tree = ast.parse(f.read(), filename=path)
+        except SyntaxError:
+            continue    # surfaced as a parse error by the engine/shim
+        check_tree(tree, metrics, spans=spans, used=used)
+    return used
+
+
+class MetricNamesPass(Pass):
+    name = "metric-names"
+    description = ("telemetry call sites must use names/kinds/tags "
+                   "declared in metrics_schema (plus dead-row reverse "
+                   "check)")
+
+    def run(self, files: Sequence, root: str) -> List[Finding]:
+        if not os.path.exists(os.path.join(root, _SCHEMA_RELPATH)):
+            return []           # tree without a schema: nothing to do
+        metrics, spans = load_schema(root)
+        out: List[Finding] = []
+        linted = set()
+        for sf in files:
+            if sf.tree is None:
+                continue
+            linted.add(sf.relpath)
+            for lineno, msg in check_tree(sf.tree, metrics,
+                                          spans=spans):
+                out.append(Finding(self.name, sf.relpath, lineno, msg))
+        # reverse check over the canonical tree (not just `files`) so a
+        # subset invocation can't fabricate "never recorded" rows
+        used = collect_used(root, metrics, spans)
+        for _kind, msg in reverse_findings(root, metrics, spans, used):
+            out.append(Finding(self.name, _SCHEMA_RELPATH, 1, msg))
+        return out

@@ -1,0 +1,306 @@
+"""recompile-hazard: patterns that silently retrace/recompile per step.
+
+ROADMAP's "fast as the hardware allows" dies first by
+death-of-a-thousand-recompiles — each one a full XLA compile on the hot
+path that no pytest assertion sees. Flagged:
+
+* **jit-in-loop** — ``jax.jit(...)`` / ``pjit`` / ``pallas_call``
+  invoked inside a ``for``/``while`` body: every iteration builds a new
+  wrapper with a fresh (cold) cache;
+* **unhashable static args** — a function wrapped with
+  ``static_argnums``/``static_argnames`` called with a list / dict /
+  set / comprehension at a static position: raises at best, and a
+  freshly-built tuple at worst retraces every call;
+* **mutable-closure capture** — a traced function reading a list /
+  dict / set built in an enclosing function *that the enclosing scope
+  keeps mutating after the traced def*: the container is baked into
+  the trace as a constant, so those later mutations are silently
+  invisible. (Build-fully-then-close — the ubiquitous params-list
+  pattern — is safe and not flagged.);
+* **shape-branch** — ``if``/``while`` on ``.shape`` / ``.ndim`` /
+  ``len(...)`` inside a traced body: legal (shapes are static) but one
+  full recompile per distinct shape — on a serving hot path that is the
+  recompile-storm pattern; suppress where specialization is the point.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .._jitreach import (_JIT_LAST, _last, dotted, traced_functions)
+from ..engine import Finding, Pass
+
+_MUTABLE_LITERALS = (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                     ast.DictComp, ast.SetComp)
+_MUTABLE_CTORS = {"list", "dict", "set", "bytearray", "defaultdict",
+                  "OrderedDict", "deque"}
+
+
+def _is_mutable_expr(node: ast.AST) -> bool:
+    if isinstance(node, _MUTABLE_LITERALS):
+        return True
+    if isinstance(node, ast.Call) and \
+            _last(dotted(node.func)) in _MUTABLE_CTORS:
+        return True
+    return False
+
+
+def _static_spec(call: ast.Call) -> Optional[Tuple[Set[int], Set[str]]]:
+    """(static positions, static names) of a jit wrapper call, or None
+    when it declares no static arguments."""
+    nums: Set[int] = set()
+    names: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            v = kw.value
+            elts = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+            for e in elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                    nums.add(e.value)
+        elif kw.arg == "static_argnames":
+            v = kw.value
+            elts = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+            for e in elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                    names.add(e.value)
+    return (nums, names) if (nums or names) else None
+
+
+class RecompileHazardPass(Pass):
+    name = "recompile-hazard"
+    description = ("jit-in-loop, unhashable/mutable static args, "
+                   "mutable closures, shape-dependent branches in "
+                   "traced bodies")
+
+    def run(self, files: Sequence, root: str) -> List[Finding]:
+        traced = traced_functions(files)
+        out: List[Finding] = []
+        for sf in files:
+            if sf.tree is None:
+                continue
+            self._check_jit_sites(sf, out)
+            for fn in traced.get(sf.relpath, ()):
+                self._check_closures(sf, fn, out)
+                self._check_shape_branches(sf, fn, out)
+        return out
+
+    # ------------------------------------------- jit call-site hazards
+    def _check_jit_sites(self, sf, out: List[Finding]) -> None:
+        # name (or "self.name") -> (static spec, wrapped-name, lineno)
+        wrapped: Dict[str, Tuple[Set[int], Set[str]]] = {}
+        pass_self = self
+
+        class V(ast.NodeVisitor):
+            def __init__(self):
+                self.loop_depth = 0
+
+            def visit_For(self, node):
+                self._loop(node)
+
+            def visit_AsyncFor(self, node):
+                self._loop(node)
+
+            def visit_While(self, node):
+                self._loop(node)
+
+            def _loop(self, node):
+                self.loop_depth += 1
+                self.generic_visit(node)
+                self.loop_depth -= 1
+
+            def visit_Assign(self, node):
+                # F = jax.jit(f, static_argnums=...) / self._fn = ...
+                if isinstance(node.value, ast.Call) and \
+                        _last(dotted(node.value.func)) in _JIT_LAST:
+                    spec = _static_spec(node.value)
+                    if spec is not None:
+                        for t in node.targets:
+                            d = dotted(t)
+                            if d:
+                                wrapped[d] = spec
+                self.generic_visit(node)
+
+            def visit_Call(self, node):
+                d = dotted(node.func)
+                last = _last(d)
+                if last in _JIT_LAST:
+                    if self.loop_depth:
+                        out.append(Finding(
+                            pass_self.name, sf.relpath, node.lineno,
+                            f"`{d or last}(...)` called inside a loop — "
+                            "every iteration builds a fresh wrapper "
+                            "with a cold trace cache; hoist the jit "
+                            "out of the loop"))
+                    # immediate call: jax.jit(f, static_argnums=..)(x, [..])
+                    spec = _static_spec(node)
+                else:
+                    spec = wrapped.get(d) if d else None
+                if spec is not None and d and last not in _JIT_LAST:
+                    pass_self._check_static_args(sf, node, d, spec, out)
+                self.generic_visit(node)
+
+        V().visit(sf.tree)
+        # second sweep for calls of wrapped names that were assigned
+        # AFTER first use order doesn't matter: wrapped was filled above
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Call):
+                d = dotted(node.func)
+                if d and d in wrapped and \
+                        _last(d) not in _JIT_LAST:
+                    pass  # already checked in visitor sweep
+
+    def _check_static_args(self, sf, call: ast.Call, fname: str,
+                           spec: Tuple[Set[int], Set[str]],
+                           out: List[Finding]) -> None:
+        nums, names = spec
+        for i, a in enumerate(call.args):
+            if i in nums and _is_mutable_expr(a):
+                out.append(Finding(
+                    self.name, sf.relpath, a.lineno,
+                    f"unhashable static argument at position {i} of "
+                    f"jitted `{fname}` — static_argnums values must be "
+                    "hashable AND stable (tuple, not list/dict/set) or "
+                    "every call retraces"))
+        for kw in call.keywords:
+            if kw.arg in names and _is_mutable_expr(kw.value):
+                out.append(Finding(
+                    self.name, sf.relpath, kw.value.lineno,
+                    f"unhashable static argument `{kw.arg}` of jitted "
+                    f"`{fname}` — static_argnames values must be "
+                    "hashable AND stable (tuple, not list/dict/set) or "
+                    "every call retraces"))
+
+    # ----------------------------------------------- mutable closures
+    _MUTATORS = {"append", "extend", "insert", "update", "setdefault",
+                 "pop", "popitem", "remove", "discard", "clear", "add"}
+
+    def _check_closures(self, sf, fn, out: List[Finding]) -> None:
+        """Traced fn reading an enclosing function's mutable container
+        that keeps being mutated after the traced def (the baked-in
+        constant goes stale)."""
+        enclosing = self._enclosing_chain(sf.tree, fn)
+        if not enclosing:
+            return
+        fn_end = fn.end_lineno or fn.lineno
+        mutable_env: Dict[str, int] = {}
+        mutated_after: Dict[str, int] = {}
+        for outer in enclosing:
+            for node in ast.walk(outer):
+                if isinstance(node, ast.Assign) and \
+                        _is_mutable_expr(node.value):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            mutable_env[t.id] = node.lineno
+                # mutation sites AFTER the traced def (outside its body)
+                name = self._mutated_name(node)
+                if name and node.lineno > fn_end:
+                    mutated_after.setdefault(name, node.lineno)
+        hazard = {n: (mutable_env[n], mutated_after[n])
+                  for n in mutable_env if n in mutated_after}
+        if not hazard:
+            return
+        local: Set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.Assign, ast.AugAssign,
+                                 ast.AnnAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    if isinstance(t, ast.Name):
+                        local.add(t.id)
+        from .._jitreach import fn_params
+
+        local |= fn_params(fn)
+        seen: Set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Name) and \
+                    isinstance(node.ctx, ast.Load) and \
+                    node.id in hazard and node.id not in local and \
+                    node.id not in seen:
+                seen.add(node.id)
+                built, mut = hazard[node.id]
+                out.append(Finding(
+                    self.name, sf.relpath, node.lineno,
+                    f"traced `{fn.name}` closes over mutable container "
+                    f"`{node.id}` (built at line {built}) which the "
+                    f"enclosing scope mutates after the def (line "
+                    f"{mut}) — the trace baked in a constant; those "
+                    "mutations are silently ignored"))
+
+    def _mutated_name(self, node: ast.AST) -> str:
+        """Name a statement-ish node mutates in place, if any:
+        x.append(...), x[k] = v, x += [...], del x[k]."""
+        if isinstance(node, ast.Expr) and isinstance(node.value, ast.Call):
+            f = node.value.func
+            if isinstance(f, ast.Attribute) and \
+                    f.attr in self._MUTATORS and \
+                    isinstance(f.value, ast.Name):
+                return f.value.id
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Subscript) and \
+                        isinstance(t.value, ast.Name):
+                    return t.value.id
+        if isinstance(node, ast.AugAssign) and \
+                isinstance(node.target, (ast.Name, ast.Subscript)):
+            t = node.target
+            if isinstance(t, ast.Subscript):
+                t = t.value
+            if isinstance(t, ast.Name):
+                return t.id
+        if isinstance(node, ast.Delete):
+            for t in node.targets:
+                if isinstance(t, ast.Subscript) and \
+                        isinstance(t.value, ast.Name):
+                    return t.value.id
+        return ""
+
+    @staticmethod
+    def _enclosing_chain(tree, fn) -> List[ast.AST]:
+        """Function defs lexically enclosing ``fn`` (innermost last)."""
+        chain: List[ast.AST] = []
+
+        def walk(node, stack):
+            for child in ast.iter_child_nodes(node):
+                if child is fn:
+                    chain.extend(stack)
+                    return True
+                sub = stack + [child] if isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    else stack
+                if walk(child, sub):
+                    return True
+            return False
+
+        walk(tree, [])
+        return chain
+
+    # ------------------------------------------------- shape branches
+    def _check_shape_branches(self, sf, fn, out: List[Finding]) -> None:
+        nested = {n for n in ast.walk(fn)
+                  if isinstance(n, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)) and n is not fn}
+        skip: Set[ast.AST] = set()
+        for n in nested:
+            skip.update(ast.walk(n))
+        for node in ast.walk(fn):
+            if node in skip or not isinstance(node, (ast.If, ast.While)):
+                continue
+            reason = self._shape_test(node.test)
+            if reason:
+                out.append(Finding(
+                    self.name, sf.relpath, node.test.lineno,
+                    f"in jit-traced `{fn.name}`: Python branch on "
+                    f"{reason} — one full recompile per distinct "
+                    "shape; make the shape fixed (pad/mask) or use "
+                    "lax.cond if this specialization is not intended"))
+
+    @staticmethod
+    def _shape_test(test: ast.AST) -> str:
+        # .shape / .ndim only: len(...) on python tuples is a common and
+        # legitimate static arity check, so it stays out of the rule
+        for node in ast.walk(test):
+            if isinstance(node, ast.Attribute) and \
+                    node.attr in ("shape", "ndim"):
+                return f"`.{node.attr}`"
+        return ""
