@@ -344,6 +344,26 @@ class Engine:
 
         staged = stage_program_from_layers(
             self.model, pp * vpp, loss_fn, devices=devices)
+        if mode == "1F1B" and vpp <= 1:
+            from ..pipeline.transport import transport_mode
+
+            if transport_mode() == "device":
+                # opt-in fully-compiled path: the whole 1F1B schedule is
+                # one jit with ring collective-permute stage transfers
+                # (requires a uniform staged program; host-driven
+                # schedule otherwise)
+                from ..pipeline.schedule import CompiledStagedTrainStep
+
+                try:
+                    return CompiledStagedTrainStep(
+                        staged, self.optimizer, micro, devices=devices)
+                except ValueError as e:
+                    import warnings
+
+                    warnings.warn(
+                        f"PADDLE_TPU_PP_TRANSPORT=device requested but "
+                        f"the compiled pipeline is unavailable ({e}); "
+                        "falling back to the host-driven schedule")
         if mode in ("ZBH1", "ZeroBubble"):
             if vpp > 1:
                 raise ValueError(

@@ -3,6 +3,8 @@ python/paddle/distributed/fleet/utils/hybrid_parallel_util.py —
 fused_allreduce_gradients:249, param broadcast :287)."""
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 from ...core.tensor import Tensor
@@ -16,12 +18,24 @@ _FUSE_BYTES = 128 * 1024 * 1024  # bucket size for fused all-reduce
 
 
 def fused_allreduce_gradients_with_group(params, group, scale=None,
-                                         bucket_bytes=_FUSE_BYTES):
+                                         bucket_bytes=None):
     """Bucketed gradient all-reduce: flatten grads into contiguous buffers
     per dtype up to bucket_bytes, one all-reduce per bucket (the eager
-    reducer algorithm, reference: collective/reducer.cc FusedAllReduce)."""
+    reducer algorithm, reference: collective/reducer.cc FusedAllReduce).
+
+    The default bucket size follows ``PADDLE_TPU_PP_BUCKET_MB`` when set
+    (the pipeline comm/compute-overlap knob — smaller buckets let each
+    all-reduce dispatch overlap the remaining host-side work) and falls
+    back to the classic 128 MB fuse budget otherwise.
+    """
     import jax.numpy as jnp
 
+    from ... import observability as _obs
+    from ..pipeline.transport import overlap_bucket_bytes
+
+    if bucket_bytes is None:
+        bucket_bytes = overlap_bucket_bytes() \
+            if "PADDLE_TPU_PP_BUCKET_MB" in os.environ else _FUSE_BYTES
     nranks = group.nranks if group is not None else 1
     if nranks <= 1:
         return
@@ -32,6 +46,7 @@ def fused_allreduce_gradients_with_group(params, group, scale=None,
     for p, g in grads:
         key = str(g._data.dtype)
         buckets.setdefault(key, []).append((p, g))
+    n_buckets = 0
     for key, items in buckets.items():
         cur, cur_bytes = [], 0
         flush_list = []
@@ -45,18 +60,24 @@ def fused_allreduce_gradients_with_group(params, group, scale=None,
         if cur:
             flush_list.append(cur)
         for bucket in flush_list:
-            flat = jnp.concatenate(
-                [b[1]._data.reshape(-1) for b in bucket])
-            t = Tensor(flat)
-            dist.all_reduce(t, group=group)
-            inv = 1.0 / nranks
-            out = t._data * inv
-            off = 0
-            for p, g in bucket:
-                n = g.size
-                g._data = out[off:off + n].reshape(g._data.shape).astype(
-                    g._data.dtype)
-                off += n
+            nbytes = sum(b[1].size * b[1].dtype.itemsize for b in bucket)
+            with _obs.span("pp.bucket_reduce", cat="pipeline",
+                           args={"bucket": n_buckets, "bytes": nbytes}):
+                flat = jnp.concatenate(
+                    [b[1]._data.reshape(-1) for b in bucket])
+                t = Tensor(flat)
+                dist.all_reduce(t, group=group)
+                inv = 1.0 / nranks
+                out = t._data * inv
+                off = 0
+                for p, g in bucket:
+                    n = g.size
+                    g._data = out[off:off + n].reshape(
+                        g._data.shape).astype(g._data.dtype)
+                    off += n
+            n_buckets += 1
+    if _obs.enabled():
+        _obs.registry.gauge("pipeline.overlap_buckets").set(n_buckets)
 
 
 def fused_allreduce_gradients(parameter_list, hcg):

@@ -10,6 +10,7 @@ from typing import List, Optional
 import numpy as np
 
 from ... import nn
+from ... import observability as _obs
 from ...core.tensor import Tensor
 from .. import collective as dist
 
@@ -297,13 +298,39 @@ class PipelineParallel(_MetaParallelBase):
                 store.get(self._meta_key(peer, self.global_rank, tag)))
         return self._recv_meta[(peer, tag)]
 
+    def _p2p_use_buffered(self, pg) -> bool:
+        """Host store path when the class demands it (VPP's asymmetric op
+        order) or ``PADDLE_TPU_PP_TRANSPORT=host`` forces the fallback;
+        device collectives otherwise (auto/device on a capable group)."""
+        from ..pipeline.transport import transport_mode
+
+        forced_host = transport_mode() == "host"
+        return (self._p2p_buffered or forced_host) and \
+            hasattr(pg, "send_buffered")
+
+    @staticmethod
+    def _p2p_obs(t: Tensor, transport: str) -> None:
+        if _obs.enabled():
+            arr = t._data
+            _obs.registry.counter(
+                "pipeline.p2p_bytes", {"transport": transport}).inc(
+                    int(arr.size) * arr.dtype.itemsize)
+            _obs.registry.counter(
+                "pipeline.p2p_messages", {"transport": transport}).inc()
+
     def _send_tensor(self, t: Tensor, dst, tag: str = "fwd"):
         self._ensure_send_meta(t, dst, tag)
         pg = self.pp_group.process_group
-        if self._p2p_buffered and hasattr(pg, "send_buffered"):
-            pg.send_buffered(t, dst)
+        if self._p2p_use_buffered(pg):
+            with _obs.span("pp.send", cat="pipeline",
+                           args={"transport": "host", "dst": dst}):
+                pg.send_buffered(t, dst)
+            self._p2p_obs(t, "host")
         else:
-            dist.send(t, dst, group=self.pp_group)
+            with _obs.span("pp.send", cat="pipeline",
+                           args={"transport": "device", "dst": dst}):
+                dist.send(t, dst, group=self.pp_group)
+            self._p2p_obs(t, "device")
 
     def _recv_tensor(self, src, tag: str = "fwd") -> Tensor:
         import jax.numpy as jnp
@@ -311,10 +338,16 @@ class PipelineParallel(_MetaParallelBase):
         shape, dtype = self._ensure_recv_meta(src, tag)
         buf = Tensor(jnp.zeros(shape, dtype=jnp.dtype(dtype)))
         pg = self.pp_group.process_group
-        if self._p2p_buffered and hasattr(pg, "recv_buffered"):
-            pg.recv_buffered(buf, src)
+        if self._p2p_use_buffered(pg):
+            with _obs.span("pp.recv", cat="pipeline",
+                           args={"transport": "host", "src": src}):
+                pg.recv_buffered(buf, src)
+            self._p2p_obs(buf, "host")
         else:
-            dist.recv(buf, src, group=self.pp_group)
+            with _obs.span("pp.recv", cat="pipeline",
+                           args={"transport": "device", "src": src}):
+                dist.recv(buf, src, group=self.pp_group)
+            self._p2p_obs(buf, "device")
         buf.stop_gradient = False
         return buf
 
@@ -325,13 +358,28 @@ class PipelineParallel(_MetaParallelBase):
         (reference pp_utils/p2p_communication.py:573). On the XLA backend
         this is ONE bidirectional compiled program, which keeps the
         per-pair program order identical on both endpoints (solitary
-        send+recv in opposite orders would deadlock the device queues)."""
+        send+recv in opposite orders would deadlock the device queues).
+        Under the forced host transport both directions ride the store
+        (order-insensitive), so a sequential pair is safe there."""
         import jax.numpy as jnp
 
         self._ensure_send_meta(t, peer, send_tag)
         shape, dtype = self._ensure_recv_meta(peer, recv_tag)
         buf = Tensor(jnp.zeros(shape, dtype=jnp.dtype(dtype)))
-        self.pp_group.process_group.sendrecv(t, buf, peer)
+        pg = self.pp_group.process_group
+        if self._p2p_use_buffered(pg):
+            with _obs.span("pp.send", cat="pipeline",
+                           args={"transport": "host", "dst": peer}):
+                pg.send_buffered(t, peer)
+            with _obs.span("pp.recv", cat="pipeline",
+                           args={"transport": "host", "src": peer}):
+                pg.recv_buffered(buf, peer)
+            self._p2p_obs(t, "host")
+            self._p2p_obs(buf, "host")
+        else:
+            pg.sendrecv(t, buf, peer)
+            self._p2p_obs(t, "device")
+            self._p2p_obs(buf, "device")
         buf.stop_gradient = False
         return buf
 

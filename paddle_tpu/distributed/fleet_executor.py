@@ -52,7 +52,24 @@ def _remote_deliver(executor_id: str, kind: str, src: int, dst: int,
     DispatchMsgToCarrier)."""
     import numpy as np
 
-    if payload is not None and not isinstance(payload, (int, float)):
+    from .pipeline.transport import get_fleet_transport, \
+        is_payload_descriptor
+
+    if is_payload_descriptor(payload):
+        # device-native transport: the control message carried only a
+        # shape/dtype/seq descriptor — the tensor arrives via the
+        # ProcessGroup p2p collective and never touches the host. The
+        # recv MUST happen here (before any buffering) because the
+        # sender has already launched its half of the collective.
+        transport = get_fleet_transport()
+        if transport is None:
+            raise RuntimeError(
+                "received a device-payload descriptor but no pipeline "
+                "transport is registered on this rank — set "
+                "PADDLE_TPU_PP_TRANSPORT consistently on every rank")
+        with _obs.activate_context(ctx):
+            payload = transport.recv(payload)
+    elif payload is not None and not isinstance(payload, (int, float)):
         payload = np.asarray(payload)
     msg = _Msg(kind, src, dst, payload, step, ctx)
     with _REGISTRY_LOCK:
@@ -204,6 +221,24 @@ class MessageBus:
             self._by_rank_agent = agent
         payload = msg.payload
         if payload is not None and not isinstance(payload, (int, float)):
+            from .pipeline.transport import get_fleet_transport, \
+                transport_mode
+
+            transport = get_fleet_transport()
+            if transport is not None and transport_mode() != "host" \
+                    and hasattr(payload, "shape") \
+                    and hasattr(payload, "dtype"):
+                # device-native transport: launch the p2p collective and
+                # post the descriptor control message under the SAME
+                # per-destination lock, so the receiver's rpc dispatcher
+                # sees descriptors in collective launch order
+                transport.send(
+                    payload, dst_rank,
+                    post=lambda desc: _rpc.rpc_async(
+                        by_rank[dst_rank], _remote_deliver,
+                        args=(self.executor_id, msg.kind, msg.src,
+                              msg.dst, desc, msg.step, msg.ctx)))
+                return
             payload = np.asarray(payload)
         _rpc.rpc_async(by_rank[dst_rank], _remote_deliver,
                        args=(self.executor_id, msg.kind, msg.src,
@@ -360,6 +395,14 @@ class FleetExecutor:
                 if n.task_id not in self.nodes[d].upstream:
                     self.nodes[d].upstream.append(n.task_id)
         task_ranks = {n.task_id: n.rank for n in task_nodes}
+        if any(n.rank != rank for n in task_nodes):
+            # cross-rank graph: register the device payload transport up
+            # front (when a collective group exists and the knob allows)
+            # so array payloads ride ProcessGroup p2p — the store/rpc
+            # bus keeps only control messages + descriptors
+            from .pipeline.transport import ensure_fleet_transport
+
+            ensure_fleet_transport()
         self.carrier = Carrier(rank, executor_id, task_ranks)
         # host only THIS rank's interceptors; other ranks run their own
         # FleetExecutor over the same graph (reference: each rank's

@@ -1,0 +1,99 @@
+"""Backend log hygiene for multi-process runs.
+
+jaxlib's CPU collective backend prints ``[Gloo] Rank N is connected to
+M peer ranks...`` straight to file descriptor 2 from C++, so neither
+the ``logging`` module nor ``sys.stderr`` monkey-patching can catch it
+— every spawned worker pollutes bench/test output with one line per
+rank per process-group init. :func:`install_stderr_filter` reroutes
+fd 2 through a pipe and demotes matching lines to the framework logger
+at DEBUG, passing everything else through byte-for-byte.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import sys
+import threading
+from typing import Iterable, Sequence, Tuple
+
+logger = logging.getLogger("paddle_tpu.distributed")
+
+_DEFAULT_PATTERNS: Tuple[str, ...] = ("[Gloo]",)
+_installed = False
+_install_lock = threading.Lock()
+
+
+def matches_backend_noise(line: str,
+                          patterns: Sequence[str] = _DEFAULT_PATTERNS
+                          ) -> bool:
+    return any(p in line for p in patterns)
+
+
+def filter_noise_lines(lines: Iterable[str],
+                       patterns: Sequence[str] = _DEFAULT_PATTERNS):
+    """Drop backend-noise lines from an iterable of text lines (the
+    bench runner uses this on child-process output)."""
+    return [ln for ln in lines if not matches_backend_noise(ln, patterns)]
+
+
+def install_stderr_filter(patterns: Sequence[str] = _DEFAULT_PATTERNS
+                          ) -> bool:
+    """Filter fd-2 writes that match ``patterns`` (idempotent).
+
+    Matching lines are logged at DEBUG on the framework logger; all
+    other bytes pass through to the original stderr unchanged. Runs a
+    daemon pump thread for the life of the process — meant for spawned
+    workers and bench children, where the alternative is C++ log spam
+    interleaved with structured output.
+    """
+    global _installed
+    with _install_lock:
+        if _installed:
+            return False
+        try:
+            real_fd = os.dup(2)
+            rd, wr = os.pipe()
+            os.dup2(wr, 2)
+            os.close(wr)
+        except OSError:
+            return False
+        _installed = True
+
+    def pump():
+        buf = b""
+        while True:
+            try:
+                chunk = os.read(rd, 4096)
+            except OSError:
+                break
+            if not chunk:
+                break
+            buf += chunk
+            while b"\n" in buf:
+                line, buf = buf.split(b"\n", 1)
+                _emit(line + b"\n", real_fd, patterns)
+        if buf:
+            _emit(buf, real_fd, patterns)
+
+    threading.Thread(target=pump, daemon=True,
+                     name="stderr-noise-filter").start()
+    # line-buffer the python-side stderr so interleaving stays sane
+    try:
+        sys.stderr.reconfigure(line_buffering=True)
+    except Exception:
+        pass
+    return True
+
+
+def _emit(raw: bytes, real_fd: int, patterns: Sequence[str]) -> None:
+    try:
+        text = raw.decode("utf-8", "replace")
+    except Exception:
+        text = ""
+    if text and matches_backend_noise(text, patterns):
+        logger.debug("backend: %s", text.rstrip("\n"))
+        return
+    try:
+        os.write(real_fd, raw)
+    except OSError:
+        pass

@@ -1,0 +1,115 @@
+"""Comm/compute overlap: per-layer-bucket gradient synchronisation.
+
+Two mechanisms, one knob (``PADDLE_TPU_PP_BUCKET_MB``):
+
+* **In-jit bucket taps** (:func:`bucket_taps`) for the compiled pipeline
+  step: identity in the forward pass, but each bucket's VJP issues one
+  ``lax.psum`` over the data-parallel axis the moment that bucket's
+  cotangents materialise — so gradient reduction is interleaved with the
+  remaining backward compute by XLA's latency-hiding scheduler instead
+  of trailing it. Only valid where no implicit reduction applies, i.e.
+  when gradients are computed by AD *inside* the ``shard_map`` body:
+  differentiating *through* ``shard_map`` already inserts the psum for
+  replicated-in params (verified: taps there double-count by exactly the
+  axis size).
+* **Eager bucketed all-reduce** (:func:`bucketed_allreduce`) for the
+  1F1B fleet path: issues one fused all-reduce per bucket (each dispatch
+  is async, so earlier buckets overlap the remaining cooldown
+  sends/recvs) instead of one whole-model trailing barrier.
+"""
+from __future__ import annotations
+
+import functools
+from typing import List, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from ... import observability as _obs
+from .transport import overlap_bucket_bytes
+
+__all__ = ["make_buckets", "bucket_taps", "bucketed_allreduce"]
+
+Axes = Union[str, Tuple[str, ...]]
+
+
+def make_buckets(leaves: Sequence, bucket_bytes: int = None
+                 ) -> List[List[int]]:
+    """Group leaf indices into contiguous buckets of ~bucket_bytes.
+
+    Leaves keep their order (bucket boundaries respect layer order, so a
+    bucket's grads are complete as soon as backward passes its layers).
+    """
+    if bucket_bytes is None:
+        bucket_bytes = overlap_bucket_bytes()
+    buckets: List[List[int]] = []
+    cur: List[int] = []
+    cur_bytes = 0
+    for i, leaf in enumerate(leaves):
+        nbytes = int(leaf.size) * jnp.dtype(leaf.dtype).itemsize
+        cur.append(i)
+        cur_bytes += nbytes
+        if cur_bytes >= bucket_bytes:
+            buckets.append(cur)
+            cur, cur_bytes = [], 0
+    if cur:
+        buckets.append(cur)
+    return buckets
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _bucket_sync(axes: Axes, *xs):
+    return xs
+
+
+def _bucket_sync_fwd(axes: Axes, *xs):
+    return xs, None
+
+
+def _bucket_sync_bwd(axes: Axes, _, gs):
+    return tuple(jax.lax.psum(g, axes) for g in gs)
+
+
+_bucket_sync.defvjp(_bucket_sync_fwd, _bucket_sync_bwd)
+
+
+def bucket_taps(leaves: Sequence, axes: Axes,
+                bucket_bytes: int = None) -> List:
+    """Thread param leaves through per-bucket psum taps (see module doc).
+
+    Returns the leaves unchanged numerically; in the backward pass each
+    bucket's gradients are ``psum``-reduced over ``axes`` as a group.
+    Call inside a traced ``shard_map`` body on the params of a function
+    whose gradients are computed by in-body AD.
+    """
+    buckets = make_buckets(leaves, bucket_bytes)
+    out = list(leaves)
+    for idx in buckets:
+        synced = _bucket_sync(axes, *[out[i] for i in idx])
+        for j, i in enumerate(idx):
+            out[i] = synced[j]
+    return out
+
+
+def bucketed_allreduce(params, group, bucket_bytes: int = None,
+                       scale=None) -> None:
+    """Eager per-bucket gradient all-reduce over ``group``.
+
+    Thin entry point over the fleet fused reducer with the pipeline
+    bucket knob applied; each bucket dispatch carries a
+    ``pp.bucket_reduce`` span. Imported lazily to keep this package
+    free of an eager-fleet import cycle.
+    """
+    from ..fleet.hybrid_parallel_util import \
+        fused_allreduce_gradients_with_group
+
+    if bucket_bytes is None:
+        bucket_bytes = overlap_bucket_bytes()
+    fused_allreduce_gradients_with_group(params, group, scale=scale,
+                                         bucket_bytes=bucket_bytes)
+
+
+def record_bucket_gauge(n: int) -> None:
+    """Report how many overlap buckets a compiled step was built with."""
+    if _obs.enabled():
+        _obs.registry.gauge("pipeline.overlap_buckets").set(n)

@@ -23,6 +23,12 @@ def _worker(func, rank, nprocs, master, backend, args):
     os.environ["PADDLE_LOCAL_RANK"] = str(rank)
     if backend:
         os.environ["PADDLE_DIST_BACKEND"] = backend
+    if os.environ.get("PADDLE_TPU_KEEP_BACKEND_LOGS", "") != "1":
+        # demote jaxlib's C++ "[Gloo] Rank N is connected..." fd-2 spam
+        # to the framework logger at DEBUG before anything inits jax
+        from .log_utils import install_stderr_filter
+
+        install_stderr_filter()
     func(*args)
 
 

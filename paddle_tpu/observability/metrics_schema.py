@@ -180,6 +180,22 @@ METRICS = {
         "counter", "compiles", "traces of the fixed-shape decode step; "
         "MUST stay at 1 per engine — joins/leaves are mask flips, "
         "never recompiles"),
+    # ---- device-native pipeline transport (distributed/pipeline/)
+    "pipeline.p2p_bytes": MetricSpec(
+        "counter", "bytes", "stage-boundary payload bytes moved by the "
+        "pipeline transport", tags=("transport",)),
+    "pipeline.p2p_messages": MetricSpec(
+        "counter", "messages", "stage-boundary tensors moved by the "
+        "pipeline transport", tags=("transport",)),
+    "pipeline.compiles": MetricSpec(
+        "counter", "compiles", "traces of the compiled 1F1B pipeline "
+        "step; MUST stay at 1 per CompiledPipeline — steady-state "
+        "micro-batch steps never recompile"),
+    "pipeline.steps": MetricSpec(
+        "counter", "steps", "compiled pipeline train steps dispatched"),
+    "pipeline.overlap_buckets": MetricSpec(
+        "gauge", "buckets", "gradient-sync buckets formed for "
+        "comm/compute overlap (PADDLE_TPU_PP_BUCKET_MB)"),
     # ---- bench harness windows (bench.py, tools/bench_*.py)
     "bench.train_window": MetricSpec(
         "histogram", "s", "bench.py timed training window (N chained "
@@ -192,6 +208,9 @@ METRICS = {
     "bench.serving_window": MetricSpec(
         "histogram", "s", "serving bench window (Poisson arrivals "
         "through ServingEngine, warmup excluded)", TIME_BUCKETS),
+    "bench.multichip_window": MetricSpec(
+        "histogram", "s", "multichip pipeline bench timed window "
+        "(N chained steps, d2h barrier included)", TIME_BUCKETS),
 }
 
 
@@ -223,6 +242,12 @@ SPANS = {
     "serving.step": "one ServingEngine step (admit + prefill + decode)",
     "serving.prefill": "one chunked-prefill dispatch (rid/n in args)",
     "serving.decode": "one fixed-shape decode-batch dispatch",
+    "pp.send": "pipeline stage-boundary send (device collective or "
+               "host-buffered, transport in args)",
+    "pp.recv": "pipeline stage-boundary recv (transport in args)",
+    "pp.bucket_reduce": "one bucketed gradient all-reduce issued during "
+                        "backward/cooldown (bucket index + bytes in args)",
+    "pipeline.step": "one compiled 1F1B pipeline train-step dispatch",
 }
 
 

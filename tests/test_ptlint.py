@@ -312,6 +312,81 @@ def test_metric_names_kind_mismatch(tmp_path):
     assert any("declared as a counter" in f.message for f in new)
 
 
+# ----------------------------------------------------------- host-transfer
+BAD_HOST_TRANSFER = """\
+    import numpy as np
+
+    import jax
+
+    def stage(params, x):
+        x = np.asarray(x)            # host copy of the boundary tensor
+        scale = x.max().item()       # host sync
+        return jax.device_get(x) * scale
+
+    pipe = CompiledPipeline(stage, [], lambda e, h, y: h.sum(),
+                            num_stages=2, num_micro=4)
+    """
+
+GOOD_HOST_TRANSFER = """\
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    def stage(params, x):
+        return jnp.tanh(x @ params[0])
+
+    def host_driver(batch):
+        # orchestration code may touch host freely: not a stage body
+        return np.asarray(batch).item()
+
+    pipe = CompiledPipeline(stage, [], lambda e, h, y: h.sum(),
+                            num_stages=2, num_micro=4)
+    """
+
+
+def test_host_transfer_bad(tmp_path):
+    new = _lint(tmp_path, {"mod.py": BAD_HOST_TRANSFER},
+                select=["host-transfer"])
+    assert _rules(new) == ["host-transfer"]
+    msgs = " ".join(f.message for f in new)
+    assert "np.asarray" in msgs and ".item()" in msgs \
+        and "jax.device_get" in msgs
+
+
+def test_host_transfer_good(tmp_path):
+    assert _lint(tmp_path, {"mod.py": GOOD_HOST_TRANSFER},
+                 select=["host-transfer"]) == []
+
+
+def test_host_transfer_transitive_callee(tmp_path):
+    src = """\
+        import numpy as np
+
+        def _helper(x):
+            return np.asarray(x)
+
+        def stage(params, x):
+            return _helper(x) * 2
+
+        prog = StagedProgram([stage], [[]], None)
+        """
+    new = _lint(tmp_path, {"mod.py": src}, select=["host-transfer"])
+    assert any("_helper" in f.message and "np.asarray" in f.message
+               for f in new)
+
+
+def test_host_transfer_rpc_payload(tmp_path):
+    src = """\
+        def stage_fn(params, x):
+            rpc_async("peer", deliver, args=(x,))
+            return x
+
+        pipe = CompiledPipeline(stage_fn=stage_fn, stages=2)
+        """
+    new = _lint(tmp_path, {"mod.py": src}, select=["host-transfer"])
+    assert any("rpc" in f.message for f in new)
+
+
 # ------------------------------------------------------------- suppression
 def test_line_suppression(tmp_path):
     src = """\

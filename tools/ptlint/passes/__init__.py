@@ -3,6 +3,7 @@
 append the class here; the driver, suppression comments, baseline and
 both reporters pick it up with no further wiring."""
 from .collective_consistency import CollectiveConsistencyPass
+from .host_transfer import HostTransferPass
 from .jit_purity import JitPurityPass
 from .lock_discipline import LockDisciplinePass
 from .metric_names import MetricNamesPass
@@ -10,8 +11,8 @@ from .recompile_hazard import RecompileHazardPass
 
 ALL_PASSES = [JitPurityPass, RecompileHazardPass,
               CollectiveConsistencyPass, LockDisciplinePass,
-              MetricNamesPass]
+              MetricNamesPass, HostTransferPass]
 
 __all__ = ["ALL_PASSES", "JitPurityPass", "RecompileHazardPass",
            "CollectiveConsistencyPass", "LockDisciplinePass",
-           "MetricNamesPass"]
+           "MetricNamesPass", "HostTransferPass"]

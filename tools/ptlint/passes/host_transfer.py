@@ -1,0 +1,141 @@
+"""host-transfer: device->host round-trips inside pipeline stage bodies.
+
+The device-native pipeline transport only pays off if stage bodies stay
+on device: one ``np.asarray`` / ``.item()`` / ``jax.device_get`` in a
+stage function (or anything it calls) inserts a device->host->device
+round-trip per micro-batch per step — exactly the store/rpc cost the
+compiled ring transfers removed. Likewise shipping an array payload
+through the store/rpc message bus (``rpc_async`` / ``store.set`` /
+``send_buffered``) from inside a stage body reintroduces the host hop.
+
+Scope: functions passed as stage callables to the pipeline drivers —
+positional / keyword (``stage_fn=``, ``pre_fn=``, ``loss_fn=``) args
+and ``stages=[...]`` list elements of ``CompiledPipeline(...)`` and
+``StagedProgram(...)`` call sites — plus everything they transitively
+call (same resolution rules as jit reachability). Host round-trips in
+host-side orchestration code are fine and not flagged.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Sequence, Set, Tuple
+
+from .._jitreach import (_call_edges, _last, _scan_file, dotted)
+from ..engine import Finding, Pass
+
+# constructors whose callable args are pipeline stage bodies
+_PIPELINE_CTORS = {"CompiledPipeline", "StagedProgram"}
+# keyword args of those ctors that carry stage callables
+_CTOR_FN_KWARGS = {"stage_fn", "pre_fn", "loss_fn"}
+# calls that force a device->host transfer of array data
+_TRANSFER_CALLS = {"np.asarray", "np.array", "numpy.asarray",
+                   "numpy.array", "jax.device_get"}
+_TRANSFER_METHODS = {"item", "numpy", "tolist"}
+# store/rpc surfaces: an array payload through any of these leaves HBM
+_RPC_LAST = {"rpc_async", "rpc_sync"}
+_STORE_METHODS = {"set", "send_buffered", "recv_buffered"}
+
+
+def _callable_nodes(call: ast.Call) -> List[ast.AST]:
+    """Arg expressions of a pipeline-ctor call that may name stage fns."""
+    out: List[ast.AST] = []
+    for a in call.args:
+        if isinstance(a, (ast.Name, ast.Attribute)):
+            out.append(a)
+        elif isinstance(a, (ast.List, ast.Tuple)):
+            out.extend(e for e in a.elts
+                       if isinstance(e, (ast.Name, ast.Attribute)))
+    for kw in call.keywords:
+        v = kw.value
+        if kw.arg in _CTOR_FN_KWARGS or kw.arg == "stages":
+            if isinstance(v, (ast.Name, ast.Attribute)):
+                out.append(v)
+            elif isinstance(v, (ast.List, ast.Tuple)):
+                out.extend(e for e in v.elts
+                           if isinstance(e, (ast.Name, ast.Attribute)))
+    return out
+
+
+class HostTransferPass(Pass):
+    name = "host-transfer"
+    description = ("device->host round-trips (np.asarray / .item() / "
+                   "device_get / store+rpc payloads) inside pipeline "
+                   "stage bodies")
+
+    def run(self, files: Sequence, root: str) -> List[Finding]:
+        known = {f.relpath for f in files if f.tree is not None}
+        infos = {f.relpath: _scan_file(f.relpath, f.tree, known)
+                 for f in files if f.tree is not None}
+
+        # seed: defs passed as stage callables at pipeline-ctor sites
+        work: List[Tuple[str, ast.AST]] = []
+        for rel, info in infos.items():
+            for node in ast.walk(info.tree):
+                if not (isinstance(node, ast.Call) and
+                        _last(dotted(node.func)) in _PIPELINE_CTORS):
+                    continue
+                for arg in _callable_nodes(node):
+                    if isinstance(arg, ast.Name):
+                        work.extend((rel, fn)
+                                    for fn in info.funcs.get(arg.id, ()))
+                    elif isinstance(arg, ast.Attribute) and \
+                            isinstance(arg.value, ast.Name) and \
+                            arg.value.id == "self":
+                        work.extend((rel, fn)
+                                    for fn in info.funcs.get(arg.attr, ()))
+
+        # transitive closure over the same call edges jit-reach uses
+        stage_bodies: Dict[str, Set[ast.AST]] = {r: set() for r in infos}
+        while work:
+            rel, fn = work.pop()
+            if fn in stage_bodies[rel]:
+                continue
+            stage_bodies[rel].add(fn)
+            info = infos[rel]
+            for child in info.children.get(fn, ()):
+                work.append((rel, child))
+            work.extend(_call_edges(info, fn, infos))
+
+        out: List[Finding] = []
+        by_rel = {f.relpath: f for f in files}
+        for rel, fns in stage_bodies.items():
+            for fn in sorted(fns, key=lambda n: n.lineno):
+                self._check_fn(by_rel[rel], fn, out)
+        return out
+
+    # ------------------------------------------------------------ per-fn
+    def _check_fn(self, sf, fn, out: List[Finding]) -> None:
+        nested = {n for n in ast.walk(fn)
+                  if isinstance(n, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)) and n is not fn}
+        skip: Set[ast.AST] = set()
+        for n in nested:            # nested defs are visited on their own
+            skip.update(ast.walk(n))
+            skip.discard(n)
+
+        def emit(node, msg):
+            out.append(Finding(self.name, sf.relpath, node.lineno,
+                               f"in pipeline stage body `{fn.name}`: "
+                               f"{msg}"))
+
+        for node in ast.walk(fn):
+            if node in skip or not isinstance(node, ast.Call):
+                continue
+            dot = dotted(node.func)
+            last = _last(dot)
+            if dot in _TRANSFER_CALLS:
+                emit(node, f"`{dot}` forces a device->host copy of the "
+                           "boundary tensor; keep stage data in jnp")
+            elif last in _RPC_LAST:
+                emit(node, f"`{last}` ships the payload over the host "
+                           "rpc bus; use the device transport for "
+                           "arrays (descriptors only on rpc)")
+            elif isinstance(node.func, ast.Attribute):
+                attr = node.func.attr
+                base = dotted(node.func.value) or ""
+                if attr in _TRANSFER_METHODS and not node.args:
+                    emit(node, f"`.{attr}()` syncs the value to host "
+                               "inside the stage body")
+                elif attr in _STORE_METHODS and "store" in base.lower():
+                    emit(node, f"`{base}.{attr}` routes array bytes "
+                               "through the host store")
