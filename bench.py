@@ -206,6 +206,89 @@ def _bench_moe():
             "tflops": round(flops / step / 1e12, 2)}
 
 
+def _bench_fusion(pt, on_tpu):
+    """Operator-fusion sub-bench (paddle_tpu/fusion/): eager
+    fused-vs-unfused step_ms per epilogue (one run_op region vs the
+    op-by-op composition — same math, so the delta is dispatch count +
+    intermediate HBM traffic), quantized-matmul on/off delta, and a
+    tiny-GPT train-step fused-vs-``PADDLE_TPU_FUSION=off`` delta (the
+    headline number above is the fused-on large-scale datapoint)."""
+    import numpy as np
+
+    import paddle_tpu.nn.functional as PF
+    from paddle_tpu import fusion
+
+    rng = np.random.default_rng(3)
+    if on_tpu:
+        B, D, H, reps = 4096, 2048, 8192, 20
+    else:
+        B, D, H, reps = 256, 256, 1024, 5
+
+    def t(a):
+        return pt.to_tensor(np.asarray(a, dtype=np.float32))
+
+    x = t(rng.standard_normal((B, D)) * 0.1)
+    w1 = t(rng.standard_normal((D, H)) * 0.02)
+    b1 = t(np.zeros(H))
+    wu = t(rng.standard_normal((D, H)) * 0.02)
+    wn = t(np.ones(D))
+    y = t(rng.standard_normal((B, D)) * 0.1)
+    res_in = t(rng.standard_normal((B, D)) * 0.1)
+
+    def timed(fn):
+        fn().numpy()                     # warmup: compile eager kernels
+        with _stopwatch("bench.fusion_window") as sw:
+            out = None
+            for _ in range(reps):
+                out = fn()
+            out.numpy()                  # d2h barrier
+        return sw.elapsed / reps * 1e3
+
+    pairs = {
+        "bias_gelu": (
+            lambda: fusion.linear_gelu(x, w1, b1),
+            lambda: PF.gelu(PF.linear(x, w1, b1), approximate=True)),
+        "swiglu": (
+            lambda: fusion.swiglu_linear(x, w1, wu),
+            lambda: PF.silu(pt.matmul(x, w1)) * pt.matmul(x, wu)),
+        "add_rms_norm": (
+            lambda: fusion.add_rms_norm(y, res_in, wn)[0],
+            lambda: PF.rms_norm(res_in + y, weight=wn)),
+        "dropout_add": (
+            lambda: fusion.dropout_add(y, res_in, p=0.1, training=True),
+            lambda: res_in + PF.dropout(y, p=0.1, training=True)),
+    }
+    out = {"mode": fusion.mode(), "mm_quant": fusion.mm_quant()}
+    for name, (fused, unfused) in pairs.items():
+        f_ms, u_ms = timed(fused), timed(unfused)
+        out[name] = {"fused_ms": round(f_ms, 3),
+                     "unfused_ms": round(u_ms, 3),
+                     "speedup": round(u_ms / f_ms, 3) if f_ms else 0.0}
+
+    dense_ms = timed(lambda: PF.linear(x, w1))
+    quant = {"dense_ms": round(dense_ms, 3)}
+    modes = ["int8"] + (["fp8"] if fusion.quant.fp8_supported() else [])
+    for qm in modes:
+        q_ms = timed(lambda qm=qm: fusion.quantized_linear(x, w1, mode=qm))
+        quant[f"{qm}_ms"] = round(q_ms, 3)
+        quant[f"{qm}_speedup"] = round(dense_ms / q_ms, 3) if q_ms else 0.0
+    out["quant_matmul"] = quant
+
+    # train-level fused-vs-off delta at tiny scale (bounded bench time)
+    cfg = pt.models.gpt_tiny(dropout=0.0, attention_dropout=0.0)
+    train = {}
+    for tag, mode in (("fused", "on"), ("unfused", "off")):
+        with fusion.override(fusion=mode, quant_mode="off"):
+            _, stp, ids, labels = _build(pt, cfg, 2, 128, on_tpu, {})
+            el, _ = _measure(stp, ids, labels, 2)
+        train[f"{tag}_step_ms"] = round(el / 2 * 1e3, 2)
+    train["speedup"] = round(
+        train["unfused_step_ms"] / train["fused_step_ms"], 3) \
+        if train["fused_step_ms"] else 0.0
+    out["train_tiny"] = train
+    return out
+
+
 def _bench_serving():
     """Continuous-batching serving bench: seeded Poisson arrivals
     streamed through ServingEngine. Emits tokens/s plus p50/p99
@@ -601,6 +684,7 @@ def main():
     }
     if not peak_known:
         extra["peak_flops_assumed_v5e"] = True
+    extra["fusion"] = _bench_fusion(pt, on_tpu)
 
     if on_tpu and not small:
         # streaming variant: fresh per-step batches via run_steps_stream

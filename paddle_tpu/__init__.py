@@ -81,6 +81,7 @@ from . import device  # noqa: F401,E402
 from . import hub  # noqa: F401,E402
 from . import decomposition  # noqa: F401,E402
 from . import onnx  # noqa: F401,E402
+from . import fusion  # noqa: F401,E402
 from . import models  # noqa: F401,E402
 from . import serving  # noqa: F401,E402
 from . import utils  # noqa: F401,E402

@@ -265,6 +265,21 @@ class Engine:
         amp_dtype = amp_cfg.get("dtype", "bfloat16")
         amp_level = amp_cfg.get("level", "O2")
 
+        # fusion pass: the rewrite-layer mode and quantized-matmul mode are
+        # captured ONCE at build time (like the amp/health knobs) and pinned
+        # for every trace of this step — a mid-run env flip cannot split the
+        # compiled program between fused and fallback call sites
+        from ... import fusion as _fusion
+        from ...observability import registry as _obs_reg
+        from ...observability.registry import enabled as _obs_on
+
+        fusion_mode = _fusion.mode()
+        quant_mode = _fusion.mm_quant()
+        if _obs_on():
+            _obs_reg.counter("fusion.builds",
+                             tags={"mode": fusion_mode,
+                                   "quant": quant_mode}).inc()
+
         def loss_fn(model, *batch):
             def run():
                 if loss_layer is not None:
@@ -273,11 +288,13 @@ class Engine:
                     return loss_layer(out, labels)
                 return model(*batch[:-1], labels=batch[-1])
 
-            if amp_enabled:
-                # amp pass: the whole step traces under autocast
-                with auto_cast(True, level=amp_level, dtype=amp_dtype):
-                    return run()
-            return run()
+            with _fusion.override(fusion=fusion_mode,
+                                  quant_mode=quant_mode):
+                if amp_enabled:
+                    # amp pass: the whole step traces under autocast
+                    with auto_cast(True, level=amp_level, dtype=amp_dtype):
+                        return run()
+                return run()
 
         fsdp_axis = None
         if ctx.get("fsdp_axis"):

@@ -1,0 +1,89 @@
+"""Chunked-epilogue treatment, generalized from models/gpt.py lm_ce_chunks.
+
+``chunked_epilogue`` is the reusable shape: a per-token epilogue whose
+intermediate (e.g. the [tokens, vocab] logits of an LM loss head) must
+never be materialized in full runs under ``jax.lax.map(jax.checkpoint(.))``
+over equal token chunks — the forward keeps only per-token outputs, the
+backward rematerializes one chunk at a time.
+
+``lm_head_chunked_ce`` is the LM-loss instantiation shared by the GPT and
+Llama heads: it mirrors ``F.cross_entropy(logits, labels, "mean")`` op for
+op (same ``log_softmax`` / take-along-axis / masked-mean arithmetic), so
+the per-token NLLs — and therefore the loss — are invariant to the chunk
+count, including the unchunked full-logits path.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..core.autograd import run_op
+from ..ops._helpers import as_tensor, unwrap
+
+__all__ = ["chunked_epilogue", "lm_head_chunked_ce"]
+
+
+def chunked_epilogue(fn, arrays, chunks, checkpoint=True):
+    """Apply per-token ``fn(*arrays)`` in ``chunks`` equal token chunks.
+
+    Raw-jax helper for use inside traced regions. ``arrays`` share a
+    leading token dim T divisible by ``chunks``; ``fn`` maps chunk slices
+    to a pytree of per-token outputs (leading dim = chunk length), which
+    are re-flattened to the full token dim. ``chunks <= 1`` calls ``fn``
+    once over the full arrays — the unchunked reference the chunked paths
+    are property-tested against.
+    """
+    arrays = tuple(arrays)
+    if chunks <= 1:
+        return fn(*arrays)
+    t = arrays[0].shape[0]
+    if t % chunks:
+        raise ValueError(f"token dim {t} not divisible by chunks={chunks}")
+    split = tuple(a.reshape((chunks, t // chunks) + a.shape[1:])
+                  for a in arrays)
+    body = (lambda xs: fn(*xs))
+    if checkpoint:
+        body = jax.checkpoint(body)
+    outs = jax.lax.map(body, split)
+    return jax.tree_util.tree_map(
+        lambda o: o.reshape((o.shape[0] * o.shape[1],) + o.shape[2:]), outs)
+
+
+def lm_head_chunked_ce(x, weight, labels, chunks, transpose_weight,
+                       ignore_index=-100):
+    """Fused lm_head + softmax-CE over token chunks (Tensor-level).
+
+    ``x``: hidden states [..., h]; ``weight``: lm-head weight, used as
+    ``x @ W.T`` when ``transpose_weight`` (tied embeddings, [vocab, h])
+    else ``x @ W`` ([h, vocab]). Loss = mean NLL over non-ignored tokens,
+    with one canonical global reduction so the value is independent of the
+    chunk count.
+    """
+    x = as_tensor(x)
+    lab = unwrap(as_tensor(labels)).reshape(-1)
+
+    def fn(a, wa):
+        h = a.shape[-1]
+        t = math.prod(a.shape[:-1])
+        xt = a.reshape(t, h)
+        lc = lab.astype(jnp.int32)
+
+        def per_token(xi, li):
+            logits = (xi @ (wa.T if transpose_weight else wa)).astype(
+                jnp.float32)
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            valid = li != ignore_index
+            safe = jnp.where(valid, li, 0)
+            picked = jnp.take_along_axis(
+                logp, jnp.expand_dims(safe, -1), axis=-1).squeeze(-1)
+            nll = -jnp.where(valid, picked, 0.0)
+            return nll, valid
+
+        nll, valid = chunked_epilogue(per_token, (xt, lc), chunks)
+        denom = jnp.sum(valid.astype(nll.dtype))
+        return jnp.sum(nll) / jnp.maximum(denom, 1.0)
+
+    return run_op(fn, [x, as_tensor(weight)], name="fused_lm_ce",
+                  attrs={"chunks": int(chunks)})

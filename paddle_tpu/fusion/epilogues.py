@@ -1,0 +1,135 @@
+"""Fused epilogue regions: bias+gelu(+dropout), residual-add+RMSNorm,
+SwiGLU gate.
+
+Each helper collapses what the fallback expresses as several ``run_op``
+calls (matmul, add, activation, dropout, norm — each its own tape node)
+into ONE traced region, so XLA's fusion pass sees the producing matmul and
+its memory-bound epilogue together and the tape records one node instead of
+three to five.
+
+Exactness contract: every region composes exactly the same jax primitives
+in the same order as the fallback composition it replaces (same key for
+dropout, same fp32 upcast discipline for the norm via
+``nn.functional.norm.rms_norm_ref``), so fused == fallback bit-for-bit.
+tests/test_fusion.py enforces this per epilogue.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding
+
+from ..core import random as _rng
+from ..core.autograd import run_op
+from ..distributed.auto_parallel.constraint import (_active_jax_mesh,
+                                                    filtered_spec)
+from ..ops._helpers import as_tensor
+from .quant import qmm
+
+__all__ = ["linear_gelu", "dropout_add", "add_rms_norm", "swiglu_linear"]
+
+
+def _shard_in_region(h, mesh, axes):
+    """with_sharding_constraint inside a fused region — same placement the
+    fallback gets from shard_activation() between its run_ops."""
+    if mesh is None or axes is None:
+        return h
+    return jax.lax.with_sharding_constraint(
+        h, NamedSharding(mesh, filtered_spec(axes, mesh)))
+
+
+def linear_gelu(x, weight, bias=None, approximate=True, shard_axes=None,
+                quant_mode="off"):
+    """Fused y = gelu(x @ W (+ b)): the fc1 epilogue of a transformer MLP.
+
+    Fallback composition this mirrors bitwise (quant_mode == "off"):
+    ``F.gelu(shard_activation(F.linear(x, W, b), shard_axes))``.
+    """
+    mesh = _active_jax_mesh()
+    ts = [as_tensor(x), as_tensor(weight)]
+    has_bias = bias is not None
+    if has_bias:
+        ts.append(as_tensor(bias))
+
+    def fn(a, w, *b):
+        if quant_mode != "off":
+            h = qmm(a, w, quant_mode)
+        else:
+            h = jnp.matmul(a, w)
+        if has_bias:
+            h = h + b[0]
+        h = _shard_in_region(h, mesh, shard_axes)
+        return jax.nn.gelu(h, approximate=approximate)
+
+    return run_op(fn, ts, name="fused_linear_gelu",
+                  attrs={"approximate": bool(approximate),
+                         "quant": quant_mode})
+
+
+def dropout_add(y, residual, p=0.0, training=True):
+    """Fused residual + dropout(y) — the block-output epilogue.
+
+    Mirrors ``residual + F.dropout(y, p, training=training)`` bitwise: same
+    ``_rng.next_key()`` draw at the same sequence position, same bernoulli
+    mask and upscale arithmetic, same add operand order.
+    """
+    y, residual = as_tensor(y), as_tensor(residual)
+    if not training or p == 0.0:
+        return run_op(lambda a, r: r + a, [y, residual],
+                      name="fused_dropout_add", attrs={"p": 0.0})
+    key = _rng.next_key()
+
+    def fn(a, r):
+        keep = jax.random.bernoulli(key, 1.0 - p, a.shape)
+        dropped = jnp.where(keep, a / (1.0 - p), 0.0).astype(a.dtype)
+        return r + dropped
+
+    return run_op(fn, [y, residual], name="fused_dropout_add",
+                  attrs={"p": p, "key": key})
+
+
+def add_rms_norm(y, residual, weight, epsilon=1e-6):
+    """Fused (residual + y) -> RMSNorm: returns (normed, new_residual).
+
+    One region computes the residual stream update and its normalization
+    with the canonical dtype contract (``rms_norm_ref``): the add happens
+    in the residual dtype, the norm upcasts to fp32 ONCE, applies the
+    scale in fp32, and downcasts ONCE. Mirrors
+    ``F.rms_norm(residual + y, weight, epsilon=epsilon)`` bitwise.
+    """
+    from ..nn.functional.norm import rms_norm_ref
+
+    ts = [as_tensor(y), as_tensor(residual), as_tensor(weight)]
+
+    def fn(a, r, w):
+        res = r + a
+        normed = rms_norm_ref(res, weight=w, epsilon=epsilon,
+                              axes=(res.ndim - 1,))
+        return normed, res
+
+    return run_op(fn, ts, name="fused_add_rms_norm",
+                  attrs={"epsilon": epsilon})
+
+
+def swiglu_linear(x, gate_weight, up_weight, shard_axes=None,
+                  quant_mode="off"):
+    """Fused SwiGLU gate: silu(x @ Wg) * (x @ Wu) in one region.
+
+    Fallback composition this mirrors bitwise (quant_mode == "off"):
+    ``F.silu(shard_activation(F.linear(x, Wg), shard_axes)) *
+    F.linear(x, Wu)``.
+    """
+    mesh = _active_jax_mesh()
+    ts = [as_tensor(x), as_tensor(gate_weight), as_tensor(up_weight)]
+
+    def fn(a, wg, wu):
+        if quant_mode != "off":
+            g = qmm(a, wg, quant_mode)
+            u = qmm(a, wu, quant_mode)
+        else:
+            g = jnp.matmul(a, wg)
+            u = jnp.matmul(a, wu)
+        g = _shard_in_region(g, mesh, shard_axes)
+        return jax.nn.silu(g) * u
+
+    return run_op(fn, ts, name="fused_swiglu", attrs={"quant": quant_mode})

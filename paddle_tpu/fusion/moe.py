@@ -1,0 +1,94 @@
+"""Fused MoE dispatch/combine: gather -> expert matmul -> scatter as one
+traced region, with no materialized one-hot dispatch tensors.
+
+The fallback (models/gpt.py GPTMoEMLP) builds [S, E, C] combine/dispatch
+one-hots and moves tokens with two einsums — O(S*E*C*M) memory traffic for
+what is really a permutation. This region keeps the identical GShard top-2
+routing arithmetic (same gates/argmax/cumsum-position/capacity math on
+[S, E] tensors only), then dispatches by scatter-add into a dense
+[E*cap, M] slot buffer and combines by two gathers. Dropped tokens route
+to a trash row past the buffer (scatter) / a zero row (gather).
+
+Every kept slot is written exactly once (positions are unique per expert
+and second-choice positions start after the first-choice count), so the
+dispatched expert inputs are bit-identical to the fallback's (its dispatch
+einsum reduces one nonzero term against exact zeros). The combine is
+tolerance-exact, not bitwise: the fallback's combine einsum accumulates
+its two nonzero products through a fused-multiply-add chain (one rounding)
+while the gather path rounds each product separately — a 1-ulp
+difference. tests/test_fusion.py pins expert inputs and the aux
+load-balance loss (same expression verbatim) bit-exact and the output
+within float32 ulp tolerance.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.autograd import run_op
+from ..ops._helpers import as_tensor
+
+__all__ = ["fused_moe_mlp"]
+
+
+def fused_moe_mlp(x, gate_weight, w1, b1, w2, b2, num_experts, capacity):
+    """Fused top-2 GShard MoE FFN over [b, s, d] tokens.
+
+    Returns ``(y, aux_loss)`` matching GPTMoEMLP's fallback region.
+    """
+    E, cap = int(num_experts), int(capacity)
+    x = as_tensor(x)
+    b, s, d = x.shape[0], x.shape[1], x.shape[2]
+
+    def fn(xa, gw, w1a, b1a, w2a, b2a):
+        S = b * s
+        xf = xa.reshape(S, d)
+        # --- routing: identical arithmetic to the fallback ([S, E] only)
+        gates = jax.nn.softmax((xf @ gw).astype(jnp.float32), axis=-1)
+        idx1 = jnp.argmax(gates, -1)
+        m1 = jax.nn.one_hot(idx1, E, dtype=jnp.float32)
+        g1 = jnp.sum(gates * m1, -1)
+        gates2 = gates * (1.0 - m1)
+        idx2 = jnp.argmax(gates2, -1)
+        m2 = jax.nn.one_hot(idx2, E, dtype=jnp.float32)
+        g2 = jnp.sum(gates2 * m2, -1)
+        aux = jnp.sum(jnp.mean(m1, 0) * jnp.mean(gates, 0)) * E
+
+        pos1 = jnp.cumsum(m1, 0) * m1 - m1
+        pos2 = (jnp.cumsum(m2, 0) - 1.0 + jnp.sum(m1, 0)[None]) * m2
+        m1 = m1 * (pos1 < cap)
+        m2 = m2 * (pos2 < cap)
+        p1 = jnp.sum(pos1, -1).astype(jnp.int32)
+        p2 = jnp.sum(pos2, -1).astype(jnp.int32)
+        g1 = g1 * jnp.sum(m1, -1)
+        g2 = g2 * jnp.sum(m2, -1)
+        denom = jnp.where(g1 + g2 > 0, g1 + g2, 1.0)
+        g1, g2 = g1 / denom, g2 / denom
+
+        # --- dispatch: scatter tokens into [E*cap (+1 trash), d] slots
+        keep1 = jnp.sum(m1, -1) > 0
+        keep2 = jnp.sum(m2, -1) > 0
+        slot1 = jnp.where(keep1, idx1.astype(jnp.int32) * cap + p1, E * cap)
+        slot2 = jnp.where(keep2, idx2.astype(jnp.int32) * cap + p2, E * cap)
+        buf = jnp.zeros((E * cap + 1, d), xf.dtype)
+        buf = buf.at[slot1].add(jnp.where(keep1[:, None], xf, 0))
+        buf = buf.at[slot2].add(jnp.where(keep2[:, None], xf, 0))
+        xe = buf[:E * cap].reshape(E, cap, d)
+
+        # --- expert FFN: same grouped einsums as the fallback
+        h1 = jax.nn.gelu(
+            jnp.einsum("ecm,emh->ech", xe, w1a) + b1a[:, None, :],
+            approximate=True)
+        ye = jnp.einsum("ech,ehm->ecm", h1, w2a) + b2a[:, None, :]
+
+        # --- combine: gather each token's two slots, weight, add
+        yf = jnp.concatenate(
+            [ye.reshape(E * cap, d), jnp.zeros((1, d), ye.dtype)], axis=0)
+        c1 = g1.astype(xf.dtype)[:, None] * yf[slot1]
+        c2 = g2.astype(xf.dtype)[:, None] * yf[slot2]
+        y = c1 + c2
+        return y.reshape(b, s, d), aux.astype(jnp.float32)
+
+    return run_op(fn, [x, as_tensor(gate_weight), as_tensor(w1),
+                       as_tensor(b1), as_tensor(w2), as_tensor(b2)],
+                  name="fused_moe_mlp")

@@ -1,0 +1,118 @@
+"""Quantized matmul hot path for the MLP blocks (PADDLE_TPU_MM_QUANT).
+
+int8: symmetric per-token activation scales (absmax over the contraction
+dim) and per-output-channel weight scales, int8 x int8 -> int32 MXU
+accumulation via ``lax.dot_general(preferred_element_type=int32)``, one
+fused rescale epilogue. fp8 (where ``jnp.float8_e4m3fn`` exists): same
+scale scheme mapped to the e4m3 range with fp32 accumulation.
+
+Gradients are straight-through: the backward of ``qmm`` is the vjp of the
+full-precision matmul (the same STE scheme as quantization/functional.py
+``fake_quant_dequant``), so training sees quantization error only in the
+forward values — the loss-drift bound in tests/test_fusion.py is enforced
+against this contract.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.autograd import run_op
+from ..ops._helpers import as_tensor
+
+__all__ = ["qmm", "quantized_linear", "fp8_supported",
+           "int8_matmul", "fp8_matmul"]
+
+_FP8 = getattr(jnp, "float8_e4m3fn", None)
+_FP8_MAX = 448.0  # e4m3fn finite max
+
+
+def fp8_supported() -> bool:
+    return _FP8 is not None
+
+
+def _row_scale(a, limit):
+    amax = jnp.max(jnp.abs(a), axis=-1, keepdims=True)
+    return jnp.maximum(amax, 1e-8).astype(jnp.float32) / limit
+
+
+def _col_scale(w, limit):
+    amax = jnp.max(jnp.abs(w), axis=0, keepdims=True)
+    return jnp.maximum(amax, 1e-8).astype(jnp.float32) / limit
+
+
+def int8_matmul(a, w):
+    """[..., K] @ [K, N] with dynamic per-token / per-channel int8 scales."""
+    sa = _row_scale(a, 127.0)
+    sw = _col_scale(w, 127.0)
+    qa = jnp.clip(jnp.round(a.astype(jnp.float32) / sa), -127, 127) \
+        .astype(jnp.int8)
+    qw = jnp.clip(jnp.round(w.astype(jnp.float32) / sw), -127, 127) \
+        .astype(jnp.int8)
+    acc = jax.lax.dot_general(
+        qa, qw, (((qa.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    return (acc.astype(jnp.float32) * (sa * sw)).astype(a.dtype)
+
+
+def fp8_matmul(a, w):
+    """[..., K] @ [K, N] through e4m3 with per-token / per-channel scales."""
+    sa = _row_scale(a, _FP8_MAX)
+    sw = _col_scale(w, _FP8_MAX)
+    qa = (a.astype(jnp.float32) / sa).astype(_FP8)
+    qw = (w.astype(jnp.float32) / sw).astype(_FP8)
+    acc = jax.lax.dot_general(
+        qa, qw, (((qa.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    return (acc * (sa * sw)).astype(a.dtype)
+
+
+@jax.custom_vjp
+def _qmm_int8(a, w):
+    return int8_matmul(a, w)
+
+
+@jax.custom_vjp
+def _qmm_fp8(a, w):
+    return fp8_matmul(a, w)
+
+
+def _qmm_fwd_int8(a, w):
+    return int8_matmul(a, w), (a, w)
+
+
+def _qmm_fwd_fp8(a, w):
+    return fp8_matmul(a, w), (a, w)
+
+
+def _qmm_bwd(res, g):
+    # straight-through: gradients of the full-precision matmul
+    a, w = res
+    _, vjp = jax.vjp(lambda x, y: jnp.matmul(x, y), a, w)
+    return vjp(g.astype(a.dtype))
+
+
+_qmm_int8.defvjp(_qmm_fwd_int8, _qmm_bwd)
+_qmm_fp8.defvjp(_qmm_fwd_fp8, _qmm_bwd)
+
+
+def qmm(a, w, mode="int8"):
+    """Raw-array quantized matmul dispatch (usable inside fused regions)."""
+    if mode == "fp8":
+        if not fp8_supported():
+            raise RuntimeError("fp8 dtypes unavailable in this jax build")
+        return _qmm_fp8(a, w)
+    if mode == "int8":
+        return _qmm_int8(a, w)
+    raise ValueError(f"unknown quantized-matmul mode {mode!r}")
+
+
+def quantized_linear(x, weight, bias=None, mode="int8"):
+    """Tensor-level y = qmm(x, W) (+ b). Weight layout [in, out]."""
+    ts = [as_tensor(x), as_tensor(weight)]
+    if bias is not None:
+        ts.append(as_tensor(bias))
+        return run_op(lambda a, w, b: qmm(a, w, mode) + b, ts,
+                      name="quant_linear", attrs={"mode": mode})
+    return run_op(lambda a, w: qmm(a, w, mode), ts,
+                  name="quant_linear", attrs={"mode": mode})

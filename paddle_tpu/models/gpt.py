@@ -194,6 +194,20 @@ class GPTMLP(nn.Layer):
             annotate_param(self.fc2.bias, (None,))
 
     def forward(self, x):
+        from .. import fusion
+
+        if fusion.route("bias_gelu"):
+            # fc1 + bias + gelu as one traced region (one tape node, one
+            # XLA fusion candidate); quantized matmuls when requested
+            qm = fusion.quant_route("gpt_mlp")
+            h = fusion.linear_gelu(x, self.fc1.weight, self.fc1.bias,
+                                   approximate=True,
+                                   shard_axes=("dp", "sp", "mp"),
+                                   quant_mode=qm)
+            if qm != "off":
+                return fusion.quantized_linear(h, self.fc2.weight,
+                                               self.fc2.bias, mode=qm)
+            return self.fc2(h)
         x = self.fc1(x)
         x = shard_activation(x, ("dp", "sp", "mp"))
         x = F.gelu(x, approximate=True)
@@ -233,10 +247,19 @@ class GPTMoEMLP(nn.Layer):
         self.last_aux_loss = None
 
     def forward(self, x):
+        from .. import fusion
+
         cfg = self.config
         b, s, d = x.shape[0], x.shape[1], x.shape[2]
         E = self.num_experts
         cap = max(4, int(cfg.moe_capacity_factor * b * s * 2 / E))
+
+        if fusion.route("moe_dispatch"):
+            # scatter/gather dispatch — no [S, E, C] one-hot tensors
+            y, aux = fusion.fused_moe_mlp(x, self.gate_weight, self.w1,
+                                          self.b1, self.w2, self.b2, E, cap)
+            self.last_aux_loss = aux
+            return y
 
         def fn(xa, gw, w1, b1, w2, b2):
             S = b * s
@@ -303,12 +326,19 @@ class GPTBlock(nn.Layer):
         self._recompute = config.recompute and remat_this
 
     def _body(self, x, cache=None):
+        from .. import fusion
+
+        fused = cache is None and fusion.route("dropout_add")
         if cache is None:
-            x = x + self.dropout(self.attn(self.ln_1(x)))
+            a = self.attn(self.ln_1(x))
+            x = fusion.dropout_add(a, x, self.dropout.p, self.training) \
+                if fused else x + self.dropout(a)
         else:
             a, cache = self.attn(self.ln_1(x), cache=cache)
             x = x + self.dropout(a)
-        x = x + self.dropout(self.mlp(self.ln_2(x)))
+        m = self.mlp(self.ln_2(x))
+        x = fusion.dropout_add(m, x, self.dropout.p, self.training) \
+            if fused else x + self.dropout(m)
         x = shard_activation(x, ("dp", "sp", None))
         return x if cache is None else (x, cache)
 
@@ -441,8 +471,17 @@ class GPTForCausalLM(nn.Layer):
         never hit HBM."""
         import jax
 
+        from .. import fusion
+
         tied = self.lm_head is None
         w = self.gpt.wte.weight if tied else self.lm_head.weight
+        if fusion.route("lm_ce"):
+            # shared chunked-epilogue path (fusion/chunked.py), also used
+            # by the Llama head; mirrors F.cross_entropy op for op so the
+            # loss is invariant to the chunk count
+            return fusion.lm_head_chunked_ce(x, w, labels, chunks,
+                                             transpose_weight=tied,
+                                             ignore_index=ignore_index)
         lab = unwrap(as_tensor(labels)).reshape(-1)
 
         def fn(a, wa):

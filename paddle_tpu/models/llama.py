@@ -40,6 +40,10 @@ class LlamaConfig:
     initializer_range: float = 0.02
     tie_word_embeddings: bool = False
     recompute: bool = False
+    # fused chunked lm_head+CE (same treatment as GPTConfig.lm_ce_chunks,
+    # via paddle_tpu.fusion.chunked): >0 computes the training loss in
+    # this many token chunks without materializing [tokens, vocab] logits
+    lm_ce_chunks: int = 0
     # "gspmd" | "ring" | "ulysses" (see models/_sp_attention.py)
     sequence_parallel_mode: str = "gspmd"
 
@@ -150,6 +154,20 @@ class LlamaMLP(nn.Layer):
         annotate_param(self.down_proj.weight, ("mp", None))
 
     def forward(self, x):
+        from .. import fusion
+
+        if fusion.route("swiglu"):
+            # gate/up projections + silu gate as one traced region;
+            # quantized matmuls when requested
+            qm = fusion.quant_route("llama_mlp")
+            h = fusion.swiglu_linear(x, self.gate_proj.weight,
+                                     self.up_proj.weight,
+                                     shard_axes=("dp", "sp", "mp"),
+                                     quant_mode=qm)
+            if qm != "off":
+                return fusion.quantized_linear(h, self.down_proj.weight,
+                                               mode=qm)
+            return self.down_proj(h)
         g = self.gate_proj(x)
         u = self.up_proj(x)
         g = shard_activation(g, ("dp", "sp", "mp"))
@@ -168,6 +186,19 @@ class LlamaBlock(nn.Layer):
         self._recompute = config.recompute
 
     def _body(self, x, position_ids=None, cache=None):
+        from .. import fusion
+
+        if cache is None and fusion.route("add_rms_norm"):
+            a = self.self_attn(self.input_layernorm(x),
+                               position_ids=position_ids)
+            # residual add + post-attention RMSNorm as one region; the
+            # residual stream and the normed branch come out of the same
+            # fp32 compute scope (one upcast, one downcast)
+            ln = self.post_attention_layernorm
+            h, x = fusion.add_rms_norm(a, x, ln.weight, ln._epsilon)
+            x = x + self.mlp(h)
+            x = shard_activation(x, ("dp", "sp", None))
+            return x
         if cache is None:
             x = x + self.self_attn(self.input_layernorm(x),
                                    position_ids=position_ids)
@@ -251,6 +282,17 @@ class LlamaForCausalLM(nn.Layer):
             x, new_caches = self.llama(input_ids, position_ids, caches=caches)
         else:
             x = self.llama(input_ids, position_ids)
+        chunks = int(getattr(self.config, "lm_ce_chunks", 0) or 0)
+        if labels is not None and chunks > 1 \
+                and math.prod(x.shape[:-1]) % chunks == 0:
+            from .. import fusion
+
+            if fusion.route("lm_ce"):
+                tied = self.lm_head is None
+                w = self.llama.embed_tokens.weight if tied \
+                    else self.lm_head.weight
+                return fusion.lm_head_chunked_ce(x, w, labels, chunks,
+                                                 transpose_weight=tied)
         if self.lm_head is not None:
             logits = self.lm_head(x)
         else:

@@ -11,7 +11,29 @@ from ...core.tensor import Tensor
 from ...ops._helpers import as_tensor, run_op, unary, unwrap
 
 __all__ = ["batch_norm", "layer_norm", "instance_norm", "group_norm",
-           "local_response_norm", "rms_norm"]
+           "local_response_norm", "rms_norm", "NORM_COMPUTE_DTYPE"]
+
+# Canonical norm dtype contract: normalization math runs in fp32 with ONE
+# upcast of the input and ONE downcast back to the input dtype; the scale
+# (and bias) are applied inside the fp32 region. Both the functional
+# fallback below and the fused residual-add path
+# (paddle_tpu.fusion.epilogues.add_rms_norm) go through rms_norm_ref, so
+# the two sides are bit-identical by construction — asserted in
+# tests/test_fusion.py.
+NORM_COMPUTE_DTYPE = jnp.float32
+
+
+def rms_norm_ref(a, weight=None, bias=None, epsilon=1e-6, axes=(-1,)):
+    """Raw-array RMSNorm reference implementing the canonical dtype
+    contract. Shared by F.rms_norm and the fused epilogues."""
+    af = a.astype(NORM_COMPUTE_DTYPE)
+    ms = jnp.mean(af * af, axis=axes, keepdims=True)
+    out = af * (1.0 / jnp.sqrt(ms + epsilon))
+    if weight is not None:
+        out = out * weight.astype(NORM_COMPUTE_DTYPE)
+    if bias is not None:
+        out = out + bias.astype(NORM_COMPUTE_DTYPE)
+    return out.astype(a.dtype)
 
 
 def batch_norm(x, running_mean, running_var, weight=None, bias=None,
@@ -122,16 +144,9 @@ def rms_norm(x, weight=None, bias=None, epsilon=1e-6, begin_norm_axis=-1,
         ts.append(as_tensor(bias))
 
     def fn(a, *wb):
-        af = a.astype(jnp.float32)
-        ms = jnp.mean(af * af, axis=axes, keepdims=True)
-        out = af * (1.0 / jnp.sqrt(ms + epsilon))
-        i = 0
-        if has_w:
-            out = out * wb[i].astype(jnp.float32)
-            i += 1
-        if has_b:
-            out = out + wb[i].astype(jnp.float32)
-        return out.astype(a.dtype)
+        return rms_norm_ref(a, weight=wb[0] if has_w else None,
+                            bias=wb[1 if has_w else 0] if has_b else None,
+                            epsilon=epsilon, axes=axes)
 
     return run_op(fn, ts, name="rms_norm",
                   attrs={"axes": axes, "epsilon": epsilon,

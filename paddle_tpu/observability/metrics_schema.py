@@ -196,6 +196,20 @@ METRICS = {
     "pipeline.overlap_buckets": MetricSpec(
         "gauge", "buckets", "gradient-sync buckets formed for "
         "comm/compute overlap (PADDLE_TPU_PP_BUCKET_MB)"),
+    # ---- fusion rewrite layer (paddle_tpu/fusion/)
+    "fusion.fused_calls": MetricSpec(
+        "counter", "calls", "call sites routed through a fused region "
+        "(trace-time decisions, not per-device-step)", tags=("op",)),
+    "fusion.fallback_calls": MetricSpec(
+        "counter", "calls", "call sites routed through the unfused "
+        "fallback composition (PADDLE_TPU_FUSION=off or cached path)",
+        tags=("op",)),
+    "fusion.quantized_matmuls": MetricSpec(
+        "counter", "calls", "MLP matmul sites dispatched to the "
+        "quantized hot path (PADDLE_TPU_MM_QUANT)", tags=("mode", "op")),
+    "fusion.builds": MetricSpec(
+        "counter", "builds", "train-step builds with the fusion/quant "
+        "modes captured for the trace", tags=("mode", "quant")),
     # ---- bench harness windows (bench.py, tools/bench_*.py)
     "bench.train_window": MetricSpec(
         "histogram", "s", "bench.py timed training window (N chained "
@@ -211,6 +225,9 @@ METRICS = {
     "bench.multichip_window": MetricSpec(
         "histogram", "s", "multichip pipeline bench timed window "
         "(N chained steps, d2h barrier included)", TIME_BUCKETS),
+    "bench.fusion_window": MetricSpec(
+        "histogram", "s", "fusion sub-bench timed window (fused vs "
+        "unfused epilogue / quantized matmul arms)", TIME_BUCKETS),
 }
 
 

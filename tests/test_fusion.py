@@ -1,0 +1,264 @@
+"""Tier-1 parity gate for paddle_tpu/fusion (the fusion-aware epilogue
+rewrite layer + quantized matmul hot path).
+
+Contracts enforced here:
+
+* fused epilogues == fallback composition BIT-exact (loss and every
+  grad) for GPT and Llama — ``PADDLE_TPU_FUSION=off`` keeps the
+  verbatim pre-fusion code, so this simultaneously proves the off
+  switch restores pre-PR numerics byte-for-byte;
+* the chunked LM-CE is chunk-count invariant: loss bit-identical
+  across chunks in {0, 1, 4, 8} (grads bit-identical too, except the
+  tied embedding, whose grad accumulates across chunks in a different
+  association order — pinned by a tight allclose);
+* quantized matmul stays within test-enforced drift bounds, forward
+  and across a short training run;
+* fused MoE dispatch/combine: dispatch is bit-exact, combine is
+  FMA-rounding tolerance (see fusion/moe.py);
+* one canonical RMSNorm dtype contract (f32 compute, input-dtype out)
+  shared by the fused and fallback paths;
+* a fused TrainStep traces exactly once over repeated steps.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu import fusion
+from paddle_tpu.jit import TrainStep
+
+
+def _batch(vocab, b=2, s=16, seed=0):
+    rng = np.random.default_rng(seed)
+    ids = pt.to_tensor(rng.integers(0, vocab, (b, s)), dtype="int64")
+    labels = pt.to_tensor(rng.integers(0, vocab, (b, s)), dtype="int64")
+    return ids, labels
+
+
+def _loss_and_grads(make_model, mode, ids, labels, quant="off",
+                    fwd_seed=None):
+    pt.seed(0)
+    m = make_model()
+    if fwd_seed is not None:
+        pt.seed(fwd_seed)
+    with fusion.override(fusion=mode, quant_mode=quant):
+        loss = m(ids, labels=labels)
+        loss.backward()
+    grads = {n: np.asarray(p.grad._data)
+             for n, p in m.named_parameters() if p.grad is not None}
+    return np.asarray(loss._data), grads
+
+
+def _assert_bitwise(res_a, res_b):
+    loss_a, grads_a = res_a
+    loss_b, grads_b = res_b
+    assert np.array_equal(loss_a, loss_b), (loss_a, loss_b)
+    assert grads_a.keys() == grads_b.keys()
+    for n in grads_a:
+        assert np.array_equal(grads_a[n], grads_b[n]), n
+
+
+# --------------------------------------------------- fused == fallback
+def test_gpt_fused_matches_fallback_bitwise():
+    ids, labels = _batch(1024)
+    mk = lambda: pt.models.GPTForCausalLM(  # noqa: E731
+        pt.models.gpt_tiny(dropout=0.0, attention_dropout=0.0))
+    _assert_bitwise(_loss_and_grads(mk, "on", ids, labels),
+                    _loss_and_grads(mk, "off", ids, labels))
+
+
+def test_gpt_fused_dropout_parity():
+    """fused dropout_add consumes the same rng-key sequence position as
+    the fallback x + dropout(a): bitwise-equal under the same seed."""
+    ids, labels = _batch(1024)
+    mk = lambda: pt.models.GPTForCausalLM(  # noqa: E731
+        pt.models.gpt_tiny(dropout=0.1, attention_dropout=0.0))
+    _assert_bitwise(_loss_and_grads(mk, "on", ids, labels, fwd_seed=3),
+                    _loss_and_grads(mk, "off", ids, labels, fwd_seed=3))
+
+
+def test_llama_fused_matches_fallback_bitwise():
+    ids, labels = _batch(1024)
+    mk = lambda: pt.models.LlamaForCausalLM(  # noqa: E731
+        pt.models.llama_tiny())
+    _assert_bitwise(_loss_and_grads(mk, "on", ids, labels),
+                    _loss_and_grads(mk, "off", ids, labels))
+
+
+def test_fusion_env_knob(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_FUSION", "off")
+    assert fusion.mode() == "off" and not fusion.enabled()
+    monkeypatch.setenv("PADDLE_TPU_FUSION", "auto")
+    assert fusion.mode() == "on"
+    monkeypatch.setenv("PADDLE_TPU_FUSION", "sideways")
+    with pytest.raises(ValueError):
+        fusion.mode()
+    monkeypatch.setenv("PADDLE_TPU_MM_QUANT", "int7")
+    with pytest.raises(ValueError):
+        fusion.mm_quant()
+    # override beats the env for the scope of the trace
+    monkeypatch.setenv("PADDLE_TPU_FUSION", "off")
+    with fusion.override(fusion="on"):
+        assert fusion.enabled()
+    assert not fusion.enabled()
+
+
+# ------------------------------------------------------------ quantized
+def test_quant_matmul_forward_tolerance():
+    rng = np.random.default_rng(5)
+    a = jnp.asarray(rng.standard_normal((64, 128)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((128, 96)) * 0.05, jnp.float32)
+    ref = np.asarray(a @ w)
+    scale = np.linalg.norm(ref)
+    for mode, bound in (("int8", 2e-2), ("fp8", 6e-2)):
+        if mode == "fp8" and not fusion.quant.fp8_supported():
+            continue
+        got = np.asarray(fusion.quant.qmm(a, w, mode))
+        assert np.linalg.norm(got - ref) / scale < bound, mode
+
+
+def test_quant_train_loss_drift_bound():
+    """int8 MLP matmuls with straight-through grads: after a short
+    training run the loss tracks the full-precision run within 2%."""
+    ids, labels = _batch(1024, seed=7)
+
+    def run(quant):
+        pt.seed(0)
+        cfg = pt.models.gpt_tiny(dropout=0.0, attention_dropout=0.0)
+        m = pt.models.GPTForCausalLM(cfg)
+        opt = pt.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=m.parameters())
+        step = TrainStep(m, opt, grad_clip_norm=1.0)
+        with fusion.override(fusion="on", quant_mode=quant):
+            for _ in range(5):
+                loss = float(step(ids, labels))
+        return loss
+
+    full, q8 = run("off"), run("int8")
+    assert q8 < np.log(1024)            # it actually trains
+    assert abs(q8 - full) / full < 0.02, (full, q8)
+
+
+# ----------------------------------------------------------- chunked CE
+def test_gpt_lm_ce_chunk_count_invariance():
+    """loss is bit-identical across chunk counts; grads bit-identical
+    except the tied embedding, whose grad sums chunk contributions in a
+    different association order (pinned to float32-ulp scale)."""
+    ids, labels = _batch(1024, b=2, s=64, seed=1)
+    results = {}
+    for chunks in (0, 1, 4, 8):
+        mk = lambda c=chunks: pt.models.GPTForCausalLM(  # noqa: E731
+            pt.models.gpt_tiny(dropout=0.0, attention_dropout=0.0,
+                               lm_ce_chunks=c))
+        results[chunks] = _loss_and_grads(mk, "on", ids, labels)
+    loss0, grads0 = results[0]
+    for chunks in (1, 4, 8):
+        loss, grads = results[chunks]
+        assert np.array_equal(loss0, loss), chunks
+        for n in grads0:
+            if n == "gpt.wte.weight":
+                np.testing.assert_allclose(grads0[n], grads[n],
+                                           rtol=1e-5, atol=1e-7,
+                                           err_msg=f"chunks={chunks}")
+            else:
+                assert np.array_equal(grads0[n], grads[n]), \
+                    (chunks, n)
+
+
+def test_llama_lm_ce_chunks_parity():
+    ids, labels = _batch(1024, b=2, s=64, seed=2)
+    res = {}
+    for chunks in (0, 4):
+        mk = lambda c=chunks: pt.models.LlamaForCausalLM(  # noqa: E731
+            pt.models.llama_tiny(lm_ce_chunks=c))
+        res[chunks] = _loss_and_grads(mk, "on", ids, labels)
+    assert np.array_equal(res[0][0], res[4][0])
+    for n in res[0][1]:
+        np.testing.assert_allclose(res[0][1][n], res[4][1][n],
+                                   rtol=1e-5, atol=1e-7, err_msg=n)
+
+
+def test_chunked_epilogue_property():
+    """chunked_epilogue over any elementwise fn == the unchunked call,
+    bitwise, for every divisor chunk count; non-divisors raise."""
+    rng = np.random.default_rng(9)
+    a = jnp.asarray(rng.standard_normal((24, 8)), jnp.float32)
+    b = jnp.asarray(rng.standard_normal((24, 8)), jnp.float32)
+
+    def fn(x, y):
+        return jnp.tanh(x) + y, x * y
+
+    ref = fusion.chunked_epilogue(fn, [a, b], chunks=1)
+    for chunks in (2, 3, 4, 6, 8, 12, 24):
+        out = fusion.chunked_epilogue(fn, [a, b], chunks=chunks)
+        for r, o in zip(ref, out):
+            assert np.array_equal(np.asarray(r), np.asarray(o)), chunks
+    with pytest.raises(ValueError):
+        fusion.chunked_epilogue(fn, [a, b], chunks=5)
+
+
+# ------------------------------------------------------------------ MoE
+def test_gpt_moe_fused_parity():
+    """fused dispatch/combine vs the one-hot einsum fallback: loss and
+    grads agree to FMA-rounding tolerance (combine accumulates its two
+    products in a different rounding order; see fusion/moe.py)."""
+    ids, labels = _batch(1024, seed=4)
+    mk = lambda: pt.models.GPTForCausalLM(  # noqa: E731
+        pt.models.gpt_tiny(dropout=0.0, attention_dropout=0.0,
+                           moe_num_experts=4))
+    loss_f, grads_f = _loss_and_grads(mk, "on", ids, labels)
+    loss_u, grads_u = _loss_and_grads(mk, "off", ids, labels)
+    np.testing.assert_allclose(loss_f, loss_u, rtol=1e-5)
+    for n in grads_u:
+        np.testing.assert_allclose(grads_f[n], grads_u[n],
+                                   rtol=1e-4, atol=1e-6, err_msg=n)
+
+
+# --------------------------------------------------- RMSNorm dtype law
+def test_rms_norm_dtype_contract():
+    """One canonical contract, shared by F.rms_norm and the fused
+    add_rms_norm: compute in float32, return the input dtype."""
+    from paddle_tpu.nn.functional.norm import NORM_COMPUTE_DTYPE
+
+    assert NORM_COMPUTE_DTYPE == jnp.float32
+    rng = np.random.default_rng(11)
+    y = pt.to_tensor(rng.standard_normal((4, 32)).astype(np.float32)) \
+        .astype("bfloat16")
+    r = pt.to_tensor(rng.standard_normal((4, 32)).astype(np.float32)) \
+        .astype("bfloat16")
+    w = pt.to_tensor(np.ones(32, np.float32)).astype("bfloat16")
+
+    normed, new_res = fusion.add_rms_norm(y, r, w, epsilon=1e-6)
+    fallback = pt.nn.functional.rms_norm(r + y, weight=w, epsilon=1e-6)
+    assert "bfloat16" in str(normed.dtype)
+    assert "bfloat16" in str(new_res.dtype)
+    assert "bfloat16" in str(fallback.dtype)
+    assert bool(jnp.array_equal(normed._data, fallback._data))
+    assert bool(jnp.array_equal(new_res._data, (r + y)._data))
+
+
+# -------------------------------------------------------- zero-retrace
+def test_fused_train_step_zero_recompile(monkeypatch):
+    """The fused path must not introduce retraces: fusion.route runs at
+    trace time only, so repeated steps add zero new route calls."""
+    pt.seed(0)
+    cfg = pt.models.gpt_tiny(dropout=0.0, attention_dropout=0.0,
+                             lm_ce_chunks=4)
+    m = pt.models.GPTForCausalLM(cfg)
+    opt = pt.optimizer.AdamW(learning_rate=1e-3,
+                             parameters=m.parameters())
+    step = TrainStep(m, opt, grad_clip_norm=1.0)
+    ids, labels = _batch(cfg.vocab_size, b=2, s=64)
+
+    calls = []
+    orig = fusion.route
+    monkeypatch.setattr(
+        fusion, "route", lambda op: (calls.append(op), orig(op))[1])
+    with fusion.override(fusion="on", quant_mode="off"):
+        float(step(ids, labels))
+        n_after_first = len(calls)
+        assert n_after_first > 0          # fused sites actually routed
+        for _ in range(2):
+            float(step(ids, labels))
+    assert len(calls) == n_after_first    # zero retraces

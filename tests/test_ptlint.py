@@ -387,6 +387,78 @@ def test_host_transfer_rpc_payload(tmp_path):
     assert any("rpc" in f.message for f in new)
 
 
+# ------------------------------------------------------------ unfused-chain
+BAD_UNFUSED_CHAIN = """\
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def mlp(x, w, b, mask, scale):
+        # 4-op inline epilogue: where + gelu + add + mul
+        return jnp.where(mask, jax.nn.gelu(x @ w + b), 0.0) * scale
+
+    @jax.jit
+    def swiglu(x, wg, wu, r):
+        # 3-op inline epilogue: silu + mul + add
+        return jax.nn.silu(x @ wg) * (x @ wu) + r
+    """
+
+GOOD_UNFUSED_CHAIN = """\
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def mlp(x, w, b):
+        h = x @ w + b          # one elementwise op per statement
+        return jax.nn.gelu(h)  # 2-op composition: under threshold
+
+    @jax.jit
+    def gate(x, wg, wu):
+        return jax.nn.silu(x @ wg) * (x @ wu)  # the fused helper's own 2-op core
+
+    def host_metrics(x, mask, scale):
+        # not jit-traced: host-side chains are out of scope
+        return jnp.where(mask, jax.nn.gelu(x + 1.0), 0.0) * scale
+    """
+
+
+def test_unfused_chain_bad(tmp_path):
+    new = _lint(tmp_path, {"mod.py": BAD_UNFUSED_CHAIN},
+                select=["unfused-chain"])
+    assert _rules(new) == ["unfused-chain"]
+    assert len(new) == 2
+    msgs = " ".join(f.message for f in new)
+    assert "linear_gelu" in msgs and "swiglu_linear" in msgs
+
+
+def test_unfused_chain_good(tmp_path):
+    assert _lint(tmp_path, {"mod.py": GOOD_UNFUSED_CHAIN},
+                 select=["unfused-chain"]) == []
+
+
+def test_unfused_chain_transitive_callee(tmp_path):
+    src = """\
+        import jax
+        import jax.numpy as jnp
+
+        def _epilogue(h, mask, scale):
+            return jnp.where(mask, jax.nn.gelu(h + 1.0), 0.0) * scale
+
+        @jax.jit
+        def step(x, mask, scale):
+            return _epilogue(x, mask, scale)
+        """
+    new = _lint(tmp_path, {"mod.py": src}, select=["unfused-chain"])
+    assert any("_epilogue" in f.message for f in new)
+
+
+def test_unfused_chain_fusion_package_exempt(tmp_path):
+    # the fused implementations compose these ops by design
+    assert _lint(tmp_path,
+                 {"paddle_tpu/fusion/epilogues.py": BAD_UNFUSED_CHAIN},
+                 select=["unfused-chain"]) == []
+
+
 # ------------------------------------------------------------- suppression
 def test_line_suppression(tmp_path):
     src = """\

@@ -8,11 +8,12 @@ from .jit_purity import JitPurityPass
 from .lock_discipline import LockDisciplinePass
 from .metric_names import MetricNamesPass
 from .recompile_hazard import RecompileHazardPass
+from .unfused_chain import UnfusedChainPass
 
 ALL_PASSES = [JitPurityPass, RecompileHazardPass,
               CollectiveConsistencyPass, LockDisciplinePass,
-              MetricNamesPass, HostTransferPass]
+              MetricNamesPass, HostTransferPass, UnfusedChainPass]
 
 __all__ = ["ALL_PASSES", "JitPurityPass", "RecompileHazardPass",
            "CollectiveConsistencyPass", "LockDisciplinePass",
-           "MetricNamesPass", "HostTransferPass"]
+           "MetricNamesPass", "HostTransferPass", "UnfusedChainPass"]

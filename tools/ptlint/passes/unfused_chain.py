@@ -1,0 +1,106 @@
+"""unfused-chain: long inline elementwise epilogues in traced bodies.
+
+A chain of three or more elementwise ops written inline in a jit-traced
+body — e.g. ``jnp.where(mask, jax.nn.gelu(h + b), 0.0) * scale`` — is
+exactly the memory-bound epilogue traffic ``paddle_tpu.fusion`` exists
+to absorb: the fused helpers (``linear_gelu``, ``swiglu_linear``,
+``dropout_add``, ``add_rms_norm``) hand XLA the producing matmul and its
+epilogue as one fusion region and keep the fallback bit-exact.
+
+Scope is deliberately narrow so tier-1 can fail hard on every finding:
+a statement is flagged only when its expression contains at least THREE
+elementwise ops (arithmetic ``+ - * /``, ``where``/``clip``/
+``maximum``/``minimum`` calls, activation calls) AND at least one of
+them is a ``gelu``/``silu`` activation — the two activations every
+fused epilogue here is built around. Two-op compositions (``gelu(h +
+b)``, ``silu(g) * u``) are the fused helpers' own internals and stay
+clean. Files under ``paddle_tpu/fusion/`` are the fused
+implementations themselves and are skipped.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Sequence, Set, Tuple
+
+from .._jitreach import _last, dotted, traced_functions
+from ..engine import Finding, Pass
+
+# activations the fusion package provides a fused epilogue for; a chain
+# must contain one of these to be flagged
+_ACT_LAST = {"gelu", "silu"}
+# other elementwise calls that extend a chain
+_ELEMWISE_LAST = {"where", "clip", "maximum", "minimum", "tanh",
+                  "sigmoid", "relu"}
+_ARITH = (ast.Add, ast.Sub, ast.Mult, ast.Div)
+_THRESHOLD = 3
+
+_SUGGEST = {
+    "gelu": "paddle_tpu.fusion.linear_gelu (bias+gelu epilogue) or "
+            "fusion.dropout_add (residual epilogue)",
+    "silu": "paddle_tpu.fusion.swiglu_linear (silu-gate epilogue)",
+}
+
+# statement kinds whose value expression forms one candidate chain
+_STMTS = (ast.Assign, ast.AnnAssign, ast.AugAssign, ast.Return, ast.Expr)
+
+
+def _chain_stats(expr: ast.AST) -> Tuple[int, Set[str]]:
+    """(#elementwise ops, activation names) in one expression tree."""
+    ops = 0
+    acts: Set[str] = set()
+    for node in ast.walk(expr):
+        if isinstance(node, ast.BinOp) and isinstance(node.op, _ARITH):
+            ops += 1
+        elif isinstance(node, ast.Call):
+            last = _last(dotted(node.func))
+            if last in _ACT_LAST:
+                ops += 1
+                acts.add(last)
+            elif last in _ELEMWISE_LAST:
+                ops += 1
+    return ops, acts
+
+
+class UnfusedChainPass(Pass):
+    name = "unfused-chain"
+    description = (">=3-op inline elementwise chains around gelu/silu in "
+                   "jit-traced bodies that have a fused equivalent in "
+                   "paddle_tpu/fusion")
+
+    def run(self, files: Sequence, root: str) -> List[Finding]:
+        traced = traced_functions(files)
+        out: List[Finding] = []
+        for sf in files:
+            if sf.tree is None or \
+                    sf.relpath.startswith("paddle_tpu/fusion/"):
+                continue
+            for fn in sorted(traced.get(sf.relpath, ()),
+                             key=lambda n: n.lineno):
+                self._check_fn(sf, fn, out)
+        return out
+
+    # ------------------------------------------------------------ per-fn
+    def _check_fn(self, sf, fn, out: List[Finding]) -> None:
+        nested = {n for n in ast.walk(fn)
+                  if isinstance(n, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)) and n is not fn}
+        skip: Set[ast.AST] = set()
+        for n in nested:            # nested defs are traced on their own
+            skip.update(ast.walk(n))
+            skip.discard(n)
+
+        for node in ast.walk(fn):
+            if node in skip or not isinstance(node, _STMTS):
+                continue
+            value = getattr(node, "value", None)
+            if value is None:
+                continue
+            ops, acts = _chain_stats(value)
+            if ops >= _THRESHOLD and acts:
+                hints = "; ".join(_SUGGEST[a] for a in sorted(acts))
+                out.append(Finding(
+                    self.name, sf.relpath, node.lineno,
+                    f"in traced body `{fn.name}`: {ops}-op inline "
+                    f"elementwise chain around `{'/'.join(sorted(acts))}` "
+                    f"— rewrite through {hints} so XLA fuses the "
+                    f"producing matmul with its epilogue"))
