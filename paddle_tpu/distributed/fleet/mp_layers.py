@@ -9,11 +9,14 @@ GSPMD place the collectives on ICI.
 """
 from __future__ import annotations
 
+import math
+
 import jax.numpy as jnp
 
 from ... import nn
 from ...autograd import PyLayer
 from ...core.tensor import Tensor
+from ...fusion import overlap_mm
 from ...nn import functional as F
 from .. import collective as dist
 
@@ -139,9 +142,16 @@ class ColumnParallelLinear(nn.Layer):
             self.bias = None
 
     def forward(self, x):
-        if self.world_size > 1:
-            x = _IdentityInBackwardAllReduce.apply(x, self.group)
-        out = F.linear(x, self.weight, self.bias)
+        if self.world_size > 1 and overlap_mm.route("mp_column_linear"):
+            # decomposed path: chunked bwd all-reduce rides the GEMM loop
+            from ..tp_overlap import column_parallel_linear
+
+            out = column_parallel_linear(x, self.weight, self.bias,
+                                         self.group)
+        else:
+            if self.world_size > 1:
+                x = _IdentityInBackwardAllReduce.apply(x, self.group)
+            out = F.linear(x, self.weight, self.bias)
         if self.gather_output and self.world_size > 1:
             out = _GatherConcat.apply(out, self.group)
         return out
@@ -181,8 +191,14 @@ class RowParallelLinear(nn.Layer):
             from ...ops.manipulation import split
 
             x = split(x, self.world_size, axis=-1)[self.rank]
-        out = F.linear(x, self.weight, None)
-        out = _AllReduceInForward.apply(out, self.group)
+        if overlap_mm.route("mp_row_linear"):
+            # decomposed path: per-chunk fwd all-reduce rides the GEMM loop
+            from ..tp_overlap import row_parallel_linear
+
+            out = row_parallel_linear(x, self.weight, self.group)
+        else:
+            out = F.linear(x, self.weight, None)
+            out = _AllReduceInForward.apply(out, self.group)
         if self.bias is not None:
             out = out + self.bias
         return out
@@ -190,9 +206,17 @@ class RowParallelLinear(nn.Layer):
 
 class ParallelCrossEntropy(nn.Layer):
     """CE over vocab-split logits (mp_layers.py:744): max/subtract, local
-    exp-sum, all-reduce sums, local pick of target logit."""
+    exp-sum, all-reduce sums, local pick of target logit.
 
-    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+    The per-token epilogues (exp-sum, target pick) run through
+    ``fusion.chunked.chunked_epilogue`` over ``loss_chunks`` token chunks
+    so the [tokens, vocab/mp] exp intermediate is never materialized in
+    full — per-token math is chunk-count invariant, so the loss is bitwise
+    identical at any chunk count (the same contract lm_head_chunked_ce
+    carries)."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100,
+                 loss_chunks=4):
         super().__init__()
         from .fleet import get_hybrid_communicate_group
 
@@ -202,6 +226,7 @@ class ParallelCrossEntropy(nn.Layer):
         self.world_size = self.group.nranks if self.group else 1
         self.rank = self.group.rank if self.group else 0
         self.ignore_index = ignore_index
+        self.loss_chunks = max(1, int(loss_chunks))
 
     def forward(self, input, label):
         if self.world_size <= 1:
@@ -219,8 +244,20 @@ class ParallelCrossEntropy(nn.Layer):
         dist.all_reduce(local_max, op=dist.ReduceOp.MAX, group=self.group)
         gmax = local_max._data
 
+        from ...fusion.chunked import chunked_epilogue
+
+        tokens = math.prod(x.shape[:-1])
+        # chunk count clamped to a divisor of the token dim so chunking
+        # never changes shapes, only splits them
+        chunks = max(1, math.gcd(tokens, self.loss_chunks))
+
         def sumexp_fn(a):
-            return jnp.sum(jnp.exp(a - gmax[..., None]), axis=-1)
+            a2 = a.reshape((tokens, vocab_per))
+            g2 = gmax.reshape((tokens,))
+            out = chunked_epilogue(
+                lambda ac, gc: jnp.sum(jnp.exp(ac - gc[..., None]), axis=-1),
+                (a2, g2), chunks)
+            return out.reshape(a.shape[:-1])
 
         sumexp = run_op(sumexp_fn, [x], name="pce_sumexp")
         sumexp = _AllReduceInForward.apply(sumexp, self.group)
@@ -229,11 +266,19 @@ class ParallelCrossEntropy(nn.Layer):
             li = lab
             if li.ndim == a.ndim:
                 li = jnp.squeeze(li, -1)
-            inrange = (li >= start) & (li < start + vocab_per)
-            safe = jnp.where(inrange, li - start, 0)
-            picked = jnp.take_along_axis(
-                a, safe[..., None], axis=-1)[..., 0]
-            return jnp.where(inrange, picked - gmax, 0.0)
+            a2 = a.reshape((tokens, vocab_per))
+            l2 = li.reshape((tokens,))
+            g2 = gmax.reshape((tokens,))
+
+            def body(ac, lc, gc):
+                inrange = (lc >= start) & (lc < start + vocab_per)
+                safe = jnp.where(inrange, lc - start, 0)
+                picked = jnp.take_along_axis(
+                    ac, safe[..., None], axis=-1)[..., 0]
+                return jnp.where(inrange, picked - gc, 0.0)
+
+            out = chunked_epilogue(body, (a2, l2, g2), chunks)
+            return out.reshape(a.shape[:-1])
 
         picked = run_op(pick_fn, [x], name="pce_pick")
         picked = _AllReduceInForward.apply(picked, self.group)

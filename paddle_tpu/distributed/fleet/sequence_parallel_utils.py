@@ -219,8 +219,18 @@ class ColumnSequenceParallelLinear(nn.Layer):
             self.bias = None
 
     def forward(self, x):
-        if self.world_size > 1:
-            x = AllGatherOp.apply(x, group=self.group)
+        if self.world_size <= 1:
+            return F.linear(x, self.weight, self.bias)
+        from ...fusion import overlap_mm
+
+        if overlap_mm.route("sp_column_linear"):
+            # decomposed all-gather-matmul: each seq chunk's gather rides
+            # the previous chunk's GEMM (bitwise == the serial pair below)
+            from ..tp_overlap import all_gather_matmul_eager
+
+            return all_gather_matmul_eager(x, self.weight, self.bias,
+                                           self.group)
+        x = AllGatherOp.apply(x, group=self.group)
         return F.linear(x, self.weight, self.bias)
 
 
@@ -254,8 +264,17 @@ class RowSequenceParallelLinear(nn.Layer):
     def forward(self, x):
         if self.world_size <= 1:
             return F.linear(x, self.weight, self.bias)
-        out = F.linear(x, self.weight, None)
-        out = ReduceScatterOp.apply(out, group=self.group)
+        from ...fusion import overlap_mm
+
+        if overlap_mm.route("sp_row_linear"):
+            # decomposed matmul-reduce-scatter: per-chunk reduce-scatter
+            # rides the next chunk's GEMM (bitwise == the serial pair)
+            from ..tp_overlap import matmul_reduce_scatter_eager
+
+            out = matmul_reduce_scatter_eager(x, self.weight, self.group)
+        else:
+            out = F.linear(x, self.weight, None)
+            out = ReduceScatterOp.apply(out, group=self.group)
         if self.bias is not None:
             out = out + self.bias
         return out

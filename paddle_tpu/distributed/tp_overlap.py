@@ -1,0 +1,248 @@
+"""Eager chunked computation–collective overlap for the Fleet TP layers.
+
+The compiled/SPMD side of PADDLE_TPU_TP_OVERLAP lives in
+:mod:`paddle_tpu.fusion.overlap_mm` (ring ``ppermute`` chunks inside
+``shard_map``). This module is the imperative collective-API formulation
+for the eager ``fleet`` layers: the same matmuls decomposed into token
+chunks so each chunk's collective is dispatched while the next chunk's
+GEMM runs, instead of one monolithic collective after the full matmul.
+
+Numerics: chunking a matmul by output rows and a collective by the same
+rows is bitwise-exact — each token row's dot product / elementwise sum is
+independent of how the rows are batched — so every PyLayer here equals
+its serial mp_layers / sequence_parallel_utils counterpart byte-for-byte
+(tests/test_tp_overlap.py asserts this in a 2-process spawn run).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .. import observability as _obs
+from ..autograd import PyLayer
+from ..core.tensor import Tensor
+from ..fusion import overlap_mm
+from . import collective as dist
+
+__all__ = [
+    "column_parallel_linear", "row_parallel_linear",
+    "all_gather_matmul_eager", "matmul_reduce_scatter_eager",
+]
+
+
+def _chunks_for(t: int) -> int:
+    return overlap_mm._clamp_chunks(t, overlap_mm.default_chunks())
+
+
+def _split_rows(arr, chunks):
+    # flatten leading dims to tokens; chunk over tokens
+    lead, k = arr.shape[:-1], arr.shape[-1]
+    return jnp.split(arr.reshape(-1, k), chunks, axis=0), lead
+
+
+class _ColumnParallelOverlap(PyLayer):
+    """Column-parallel linear, overlap formulation: local fwd GEMM
+    (input is replicated over mp); the backward's input-grad all-reduce is
+    chunked so each chunk's collective overlaps the next chunk's GEMM.
+    Serial counterpart: ``_IdentityInBackwardAllReduce`` + ``F.linear``.
+    """
+
+    @staticmethod
+    def forward(ctx, x, w, b, group):
+        ctx.group = group
+        ctx.save = (x._data, w._data)
+        out = jnp.matmul(x._data, w._data)
+        if b is not None:
+            out = out + b._data
+        ctx.has_bias = b is not None
+        return Tensor(out)
+
+    @staticmethod
+    def backward(ctx, dy):
+        group = ctx.group
+        x, w = ctx.save
+        g = dy._data
+        chunks = _chunks_for(int(g.reshape(-1, g.shape[-1]).shape[0]))
+        with _obs.span("tp.overlap_window", cat="collective",
+                       args={"op": "mp_column_bwd", "chunks": chunks}):
+            gs, lead = _split_rows(g, chunks)
+            outs = []
+            for gc in gs:
+                dxc = Tensor(jnp.matmul(gc, w.T))
+                dist.all_reduce(dxc, group=group)
+                outs.append(dxc._data)
+            dx = jnp.concatenate(outs, axis=0).reshape(lead + (w.shape[0],))
+        k, n = x.shape[-1], g.shape[-1]
+        dw = jnp.matmul(x.reshape(-1, k).T, g.reshape(-1, n))
+        grads = [Tensor(dx), Tensor(dw)]
+        if ctx.has_bias:
+            grads.append(Tensor(jnp.sum(g, axis=tuple(range(g.ndim - 1)))))
+        return tuple(grads)
+
+
+class _RowParallelOverlap(PyLayer):
+    """Row-parallel linear, overlap formulation: the forward's partial-sum
+    all-reduce is chunked over token rows so each chunk's collective rides
+    the next chunk's GEMM. Serial counterpart: ``F.linear`` +
+    ``_AllReduceInForward`` (bias added by the caller, as there).
+    """
+
+    @staticmethod
+    def forward(ctx, x, w, group):
+        ctx.save = (x._data, w._data)
+        xd, wd = x._data, w._data
+        chunks = _chunks_for(
+            int(xd.reshape(-1, xd.shape[-1]).shape[0]))
+        with _obs.span("tp.overlap_window", cat="collective",
+                       args={"op": "mp_row_fwd", "chunks": chunks}):
+            xs, lead = _split_rows(xd, chunks)
+            outs = []
+            for xc in xs:
+                oc = Tensor(jnp.matmul(xc, wd))
+                dist.all_reduce(oc, group=group)
+                outs.append(oc._data)
+            out = jnp.concatenate(outs, axis=0).reshape(
+                lead + (wd.shape[-1],))
+        return Tensor(out)
+
+    @staticmethod
+    def backward(ctx, dy):
+        x, w = ctx.save
+        g = dy._data
+        dx = jnp.matmul(g, w.T)
+        k, n = x.shape[-1], g.shape[-1]
+        dw = jnp.matmul(x.reshape(-1, k).T, g.reshape(-1, n))
+        return Tensor(dx), Tensor(dw)
+
+
+class _AllGatherMatmulEager(PyLayer):
+    """Sequence-parallel column linear as a decomposed all-gather-matmul:
+    the sequence all-gather is chunked so each chunk's gather overlaps the
+    previous chunk's GEMM, and the backward reduce-scatters the input
+    cotangent chunk by chunk. Serial counterpart: ``AllGatherOp`` +
+    ``F.linear`` (sequence axis 0, reference layout ``[s, b, h]``).
+    """
+
+    @staticmethod
+    def forward(ctx, x, w, b, group):
+        ctx.group = group
+        nranks = group.nranks
+        xd, wd = x._data, w._data
+        s_local = xd.shape[0]
+        chunks = _chunks_for(s_local)
+        ctx.chunks = chunks
+        gathered = [None] * (nranks * chunks)
+        parts = [None] * (nranks * chunks)
+        with _obs.span("tp.overlap_window", cat="collective",
+                       args={"op": "sp_column_fwd", "chunks": chunks}):
+            for j, xc in enumerate(jnp.split(xd, chunks, axis=0)):
+                outs = []
+                dist.all_gather(outs, Tensor(xc), group=group)
+                for r, o in enumerate(outs):
+                    gathered[r * chunks + j] = o._data
+                    parts[r * chunks + j] = jnp.matmul(o._data, wd)
+        xg = jnp.concatenate(gathered, axis=0)
+        out = jnp.concatenate(parts, axis=0)
+        if b is not None:
+            out = out + b._data
+        ctx.has_bias = b is not None
+        ctx.save = (xg, wd)
+        return Tensor(out)
+
+    @staticmethod
+    def backward(ctx, dy):
+        group, chunks = ctx.group, ctx.chunks
+        nranks = group.nranks
+        xg, w = ctx.save
+        g = dy._data
+        # dx: reduce-scatter of g @ w.T over the sequence, chunk by chunk
+        dxg_blocks = jnp.split(g, nranks, axis=0)
+        dx_chunks = []
+        with _obs.span("tp.overlap_window", cat="collective",
+                       args={"op": "sp_column_bwd", "chunks": chunks}):
+            for j in range(chunks):
+                contrib = [Tensor(jnp.matmul(
+                    jnp.split(blk, chunks, axis=0)[j], w.T))
+                    for blk in dxg_blocks]
+                out = Tensor(jnp.zeros_like(contrib[0]._data))
+                dist.reduce_scatter(out, contrib, group=group)
+                dx_chunks.append(out._data)
+        dx = jnp.concatenate(dx_chunks, axis=0)
+        k, n = xg.shape[-1], g.shape[-1]
+        dw = jnp.matmul(xg.reshape(-1, k).T, g.reshape(-1, n))
+        grads = [Tensor(dx), Tensor(dw)]
+        if ctx.has_bias:
+            grads.append(Tensor(jnp.sum(g, axis=tuple(range(g.ndim - 1)))))
+        return tuple(grads)
+
+
+class _MatmulReduceScatterEager(PyLayer):
+    """Sequence-parallel row linear as a decomposed matmul-reduce-scatter:
+    each sequence sub-chunk's partial product is reduce-scattered while
+    the next sub-chunk's GEMM runs; backward all-gathers the output
+    cotangent chunk by chunk. Serial counterpart: ``F.linear`` +
+    ``ReduceScatterOp`` (bias added by the caller, as there).
+    """
+
+    @staticmethod
+    def forward(ctx, x, w, group):
+        ctx.group = group
+        nranks = group.nranks
+        xd, wd = x._data, w._data
+        s_full = xd.shape[0]
+        s_local = s_full // nranks
+        chunks = _chunks_for(s_local)
+        ctx.chunks = chunks
+        blocks = jnp.split(xd, nranks, axis=0)
+        out_chunks = []
+        with _obs.span("tp.overlap_window", cat="collective",
+                       args={"op": "sp_row_fwd", "chunks": chunks}):
+            for j in range(chunks):
+                contrib = [Tensor(jnp.matmul(
+                    jnp.split(blk, chunks, axis=0)[j], wd))
+                    for blk in blocks]
+                out = Tensor(jnp.zeros_like(contrib[0]._data))
+                dist.reduce_scatter(out, contrib, group=group)
+                out_chunks.append(out._data)
+        ctx.save = (xd, wd)
+        return Tensor(jnp.concatenate(out_chunks, axis=0))
+
+    @staticmethod
+    def backward(ctx, dy):
+        group, chunks = ctx.group, ctx.chunks
+        nranks = group.nranks
+        x, w = ctx.save
+        g = dy._data
+        gathered = [None] * (nranks * chunks)
+        with _obs.span("tp.overlap_window", cat="collective",
+                       args={"op": "sp_row_bwd", "chunks": chunks}):
+            for j, gc in enumerate(jnp.split(g, chunks, axis=0)):
+                outs = []
+                dist.all_gather(outs, Tensor(gc), group=group)
+                for r, o in enumerate(outs):
+                    gathered[r * chunks + j] = o._data
+        gg = jnp.concatenate(gathered, axis=0)
+        dx = jnp.matmul(gg, w.T)
+        k, n = x.shape[-1], gg.shape[-1]
+        dw = jnp.matmul(x.reshape(-1, k).T, gg.reshape(-1, n))
+        return Tensor(dx), Tensor(dw)
+
+
+# ------------------------------------------------------------- entrypoints
+def column_parallel_linear(x, weight, bias, group):
+    """Overlap path for ``ColumnParallelLinear`` (pre-gather output)."""
+    return _ColumnParallelOverlap.apply(x, weight, bias, group)
+
+
+def row_parallel_linear(x, weight, group):
+    """Overlap path for ``RowParallelLinear`` (bias added by caller)."""
+    return _RowParallelOverlap.apply(x, weight, group)
+
+
+def all_gather_matmul_eager(x, weight, bias, group):
+    """Overlap path for ``ColumnSequenceParallelLinear``."""
+    return _AllGatherMatmulEager.apply(x, weight, bias, group)
+
+
+def matmul_reduce_scatter_eager(x, weight, group):
+    """Overlap path for ``RowSequenceParallelLinear`` (bias by caller)."""
+    return _MatmulReduceScatterEager.apply(x, weight, group)

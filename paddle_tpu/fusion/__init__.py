@@ -18,6 +18,10 @@ Knobs (read at trace time, captured per train-step build):
     blocks (per-channel weight scales, per-token activation scales,
     straight-through full-precision gradients). Only consulted when
     fusion is enabled; never applied to attention or the LM head.
+  - ``PADDLE_TPU_TP_OVERLAP=auto|on|pallas|off`` — decomposed
+    computation–collective overlap for sharded matmuls (see
+    :mod:`.overlap_mm`); its chunk count rides on
+    ``PADDLE_TPU_TP_OVERLAP_CHUNKS``.
 
 Bit-exactness contract: every fused epilogue in ``epilogues`` and the
 chunked LM-CE path compose exactly the same jax ops in the same order as
@@ -31,10 +35,12 @@ import contextlib
 import contextvars
 import os
 
-from . import chunked, epilogues, moe, quant  # noqa: F401  (re-exports)
+from . import chunked, epilogues, moe, overlap_mm, quant  # noqa: F401
 from .chunked import chunked_epilogue, lm_head_chunked_ce
 from .epilogues import add_rms_norm, dropout_add, linear_gelu, swiglu_linear
 from .moe import fused_moe_mlp
+from .overlap_mm import (all_gather_matmul, matmul_reduce_scatter,
+                         overlap_linear)
 from .quant import quantized_linear
 
 __all__ = [
@@ -42,6 +48,7 @@ __all__ = [
     "chunked_epilogue", "lm_head_chunked_ce",
     "add_rms_norm", "dropout_add", "linear_gelu", "swiglu_linear",
     "fused_moe_mlp", "quantized_linear",
+    "all_gather_matmul", "matmul_reduce_scatter", "overlap_linear",
 ]
 
 _FUSION_MODES = ("auto", "on", "off")

@@ -24,7 +24,7 @@ from ..core.autograd import run_op
 from ..distributed.auto_parallel.constraint import (_active_jax_mesh,
                                                     filtered_spec)
 from ..ops._helpers import as_tensor
-from .quant import qmm
+from .overlap_mm import region_mm
 
 __all__ = ["linear_gelu", "dropout_add", "add_rms_norm", "swiglu_linear"]
 
@@ -52,10 +52,9 @@ def linear_gelu(x, weight, bias=None, approximate=True, shard_axes=None,
         ts.append(as_tensor(bias))
 
     def fn(a, w, *b):
-        if quant_mode != "off":
-            h = qmm(a, w, quant_mode)
-        else:
-            h = jnp.matmul(a, w)
+        # overlap-aware producing GEMM (decomposed chunks when routed —
+        # bitwise equal to the plain matmul/qmm either way)
+        h = region_mm(a, w, quant_mode, op="linear_gelu")
         if has_bias:
             h = h + b[0]
         h = _shard_in_region(h, mesh, shard_axes)
@@ -123,12 +122,9 @@ def swiglu_linear(x, gate_weight, up_weight, shard_axes=None,
     ts = [as_tensor(x), as_tensor(gate_weight), as_tensor(up_weight)]
 
     def fn(a, wg, wu):
-        if quant_mode != "off":
-            g = qmm(a, wg, quant_mode)
-            u = qmm(a, wu, quant_mode)
-        else:
-            g = jnp.matmul(a, wg)
-            u = jnp.matmul(a, wu)
+        # overlap-aware producing GEMMs (bitwise equal either way)
+        g = region_mm(a, wg, quant_mode, op="swiglu")
+        u = region_mm(a, wu, quant_mode, op="swiglu")
         g = _shard_in_region(g, mesh, shard_axes)
         return jax.nn.silu(g) * u
 

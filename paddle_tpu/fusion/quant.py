@@ -108,11 +108,19 @@ def qmm(a, w, mode="int8"):
 
 
 def quantized_linear(x, weight, bias=None, mode="int8"):
-    """Tensor-level y = qmm(x, W) (+ b). Weight layout [in, out]."""
+    """Tensor-level y = qmm(x, W) (+ b). Weight layout [in, out].
+
+    The GEMM goes through the overlap-aware dispatch
+    (:func:`..overlap_mm.region_mm`): per-token/per-channel scales are
+    chunk-independent, so the decomposed int8/fp8 matmul is bitwise equal
+    to the monolithic one while its collectives ride the chunk loop."""
+    from .overlap_mm import region_mm
+
     ts = [as_tensor(x), as_tensor(weight)]
     if bias is not None:
         ts.append(as_tensor(bias))
-        return run_op(lambda a, w, b: qmm(a, w, mode) + b, ts,
-                      name="quant_linear", attrs={"mode": mode})
-    return run_op(lambda a, w: qmm(a, w, mode), ts,
-                  name="quant_linear", attrs={"mode": mode})
+        return run_op(lambda a, w, b: region_mm(a, w, mode,
+                                                op="quant_linear") + b,
+                      ts, name="quant_linear", attrs={"mode": mode})
+    return run_op(lambda a, w: region_mm(a, w, mode, op="quant_linear"),
+                  ts, name="quant_linear", attrs={"mode": mode})

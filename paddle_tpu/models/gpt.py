@@ -140,9 +140,16 @@ class GPTAttention(nn.Layer):
             annotate_param(self.out_proj.bias, (None,))
 
     def forward(self, x, cache=None):
+        from .. import fusion
+
         cfg = self.config
         b, s = x.shape[0], x.shape[1]
-        qkv = self.qkv_proj(x)  # [b, s, 3h]
+        # column-parallel projection: decomposed chunks let the bwd
+        # input-grad psum ride inside the GEMM loop (overlap off -> None)
+        qkv = fusion.overlap_linear(x, self.qkv_proj.weight,
+                                    self.qkv_proj.bias, op="gpt_qkv")
+        if qkv is None:
+            qkv = self.qkv_proj(x)  # [b, s, 3h]
         qkv = qkv.reshape([b, s, 3, cfg.num_heads, cfg.head_dim])
         q, k, v = (qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2])
         past = 0
@@ -170,7 +177,11 @@ class GPTAttention(nn.Layer):
                 dropout_p=dropout_p,
                 training=self.training)  # [b, s, heads, head_dim]
         out = out.reshape([b, s, cfg.num_heads * cfg.head_dim])
-        out = self.out_proj(out)
+        # row-parallel projection: per-chunk partial-sum collectives ride
+        # the GEMM loop instead of one psum after it
+        proj = fusion.overlap_linear(out, self.out_proj.weight,
+                                     self.out_proj.bias, op="gpt_out_proj")
+        out = proj if proj is not None else self.out_proj(out)
         if cache is not None:
             return out, cache
         return out
@@ -204,6 +215,10 @@ class GPTMLP(nn.Layer):
                                    approximate=True,
                                    shard_axes=("dp", "sp", "mp"),
                                    quant_mode=qm)
+            out = fusion.overlap_linear(h, self.fc2.weight, self.fc2.bias,
+                                        op="gpt_fc2", quant_mode=qm)
+            if out is not None:
+                return out
             if qm != "off":
                 return fusion.quantized_linear(h, self.fc2.weight,
                                                self.fc2.bias, mode=qm)

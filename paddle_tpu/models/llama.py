@@ -91,11 +91,22 @@ class LlamaAttention(nn.Layer):
         annotate_param(self.o_proj.weight, ("mp", None))
 
     def forward(self, x, position_ids=None, cache=None):
+        from .. import fusion
+
         cfg = self.config
         b, s = x.shape[0], x.shape[1]
-        q = self.q_proj(x).reshape([b, s, cfg.num_heads, cfg.head_dim])
-        k = self.k_proj(x).reshape([b, s, cfg.num_kv_heads, cfg.head_dim])
-        v = self.v_proj(x).reshape([b, s, cfg.num_kv_heads, cfg.head_dim])
+
+        def _proj(lin, op, heads):
+            # column-parallel projection through the decomposed-overlap
+            # path when routed (overlap off -> verbatim serial linear)
+            out = fusion.overlap_linear(x, lin.weight, lin.bias, op=op)
+            if out is None:
+                out = lin(x)
+            return out.reshape([b, s, heads, cfg.head_dim])
+
+        q = _proj(self.q_proj, "llama_q", cfg.num_heads)
+        k = _proj(self.k_proj, "llama_k", cfg.num_kv_heads)
+        v = _proj(self.v_proj, "llama_v", cfg.num_kv_heads)
         past = cache[0].shape[1] if cache is not None else 0
         if position_ids is None and past:
             # incremental decode: rotate by absolute position, not 0
@@ -130,7 +141,11 @@ class LlamaAttention(nn.Layer):
                 attn_mask=_offset_causal_mask(s, past),
                 training=self.training)
         out = out.reshape([b, s, cfg.num_heads * cfg.head_dim])
-        out = self.o_proj(out)
+        # row-parallel projection: per-chunk partial-sum collectives ride
+        # the GEMM loop instead of one psum after it
+        proj = fusion.overlap_linear(out, self.o_proj.weight,
+                                     self.o_proj.bias, op="llama_o_proj")
+        out = proj if proj is not None else self.o_proj(out)
         if cache is not None:
             return out, cache
         return out
@@ -164,6 +179,10 @@ class LlamaMLP(nn.Layer):
                                      self.up_proj.weight,
                                      shard_axes=("dp", "sp", "mp"),
                                      quant_mode=qm)
+            out = fusion.overlap_linear(h, self.down_proj.weight,
+                                        op="llama_down_proj", quant_mode=qm)
+            if out is not None:
+                return out
             if qm != "off":
                 return fusion.quantized_linear(h, self.down_proj.weight,
                                                mode=qm)

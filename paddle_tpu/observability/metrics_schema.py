@@ -210,6 +210,15 @@ METRICS = {
     "fusion.builds": MetricSpec(
         "counter", "builds", "train-step builds with the fusion/quant "
         "modes captured for the trace", tags=("mode", "quant")),
+    # ---- TP/DP computation-collective overlap (fusion/overlap_mm.py)
+    "tp.overlap_calls": MetricSpec(
+        "counter", "calls", "sharded-matmul call sites routed through "
+        "the decomposed-overlap path, by resolved PADDLE_TPU_TP_OVERLAP "
+        "mode (trace-time decisions)", tags=("op", "mode")),
+    "tp.overlap_chunks": MetricSpec(
+        "gauge", "chunks", "row chunks per ring step in effect for the "
+        "decomposed sharded matmuls (PADDLE_TPU_TP_OVERLAP_CHUNKS, "
+        "clamped to a divisor of the token dim)"),
     # ---- bench harness windows (bench.py, tools/bench_*.py)
     "bench.train_window": MetricSpec(
         "histogram", "s", "bench.py timed training window (N chained "
@@ -228,6 +237,9 @@ METRICS = {
     "bench.fusion_window": MetricSpec(
         "histogram", "s", "fusion sub-bench timed window (fused vs "
         "unfused epilogue / quantized matmul arms)", TIME_BUCKETS),
+    "bench.tp_overlap_window": MetricSpec(
+        "histogram", "s", "tp_overlap sub-bench timed window (serial "
+        "gather-then-GEMM vs decomposed ring arms)", TIME_BUCKETS),
 }
 
 
@@ -265,6 +277,9 @@ SPANS = {
     "pp.bucket_reduce": "one bucketed gradient all-reduce issued during "
                         "backward/cooldown (bucket index + bytes in args)",
     "pipeline.step": "one compiled 1F1B pipeline train-step dispatch",
+    "tp.overlap_window": "one chunked computation-collective overlap "
+                         "region (eager TP/SP linear fwd/bwd; op + chunk "
+                         "count in args)",
 }
 
 

@@ -459,6 +459,66 @@ def test_unfused_chain_fusion_package_exempt(tmp_path):
                  select=["unfused-chain"]) == []
 
 
+# ------------------------------------------------------- serial-collective
+BAD_SERIAL_COLLECTIVE = """\
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def row_parallel(x, w):
+        # literal matmul nested in the collective call
+        return jax.lax.psum(jnp.matmul(x, w), "mp")
+
+    @jax.jit
+    def scatter_out(x, w):
+        # matmul bound by the immediately preceding statement
+        h = jnp.matmul(x, w)
+        return jax.lax.psum_scatter(h, "mp", scatter_dimension=0,
+                                    tiled=True)
+    """
+
+GOOD_SERIAL_COLLECTIVE = """\
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def with_work_between(x, w, b):
+        h = jnp.matmul(x, w)
+        h = jax.nn.gelu(h + b)     # real work hides the collective
+        return jax.lax.psum(h, "mp")
+
+    @jax.jit
+    def gather_input(x, w):
+        # collective feeds the matmul, not the other way around
+        return jnp.matmul(jax.lax.all_gather(x, "mp", tiled=True), w)
+
+    def host_side(x, w):
+        # not jit-traced: out of scope
+        return jax.lax.psum(jnp.matmul(x, w), "mp")
+    """
+
+
+def test_serial_collective_bad(tmp_path):
+    new = _lint(tmp_path, {"mod.py": BAD_SERIAL_COLLECTIVE},
+                select=["serial-collective"])
+    assert _rules(new) == ["serial-collective"]
+    assert len(new) == 2
+    msgs = " ".join(f.message for f in new)
+    assert "overlap_mm" in msgs and "matmul_reduce_scatter" in msgs
+
+
+def test_serial_collective_good(tmp_path):
+    assert _lint(tmp_path, {"mod.py": GOOD_SERIAL_COLLECTIVE},
+                 select=["serial-collective"]) == []
+
+
+def test_serial_collective_fusion_package_exempt(tmp_path):
+    # the decomposed implementations are allowed their own ring steps
+    assert _lint(tmp_path,
+                 {"paddle_tpu/fusion/overlap_mm.py": BAD_SERIAL_COLLECTIVE},
+                 select=["serial-collective"]) == []
+
+
 # ------------------------------------------------------------- suppression
 def test_line_suppression(tmp_path):
     src = """\

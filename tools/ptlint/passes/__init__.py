@@ -8,12 +8,15 @@ from .jit_purity import JitPurityPass
 from .lock_discipline import LockDisciplinePass
 from .metric_names import MetricNamesPass
 from .recompile_hazard import RecompileHazardPass
+from .serial_collective import SerialCollectivePass
 from .unfused_chain import UnfusedChainPass
 
 ALL_PASSES = [JitPurityPass, RecompileHazardPass,
               CollectiveConsistencyPass, LockDisciplinePass,
-              MetricNamesPass, HostTransferPass, UnfusedChainPass]
+              MetricNamesPass, HostTransferPass, UnfusedChainPass,
+              SerialCollectivePass]
 
 __all__ = ["ALL_PASSES", "JitPurityPass", "RecompileHazardPass",
            "CollectiveConsistencyPass", "LockDisciplinePass",
-           "MetricNamesPass", "HostTransferPass", "UnfusedChainPass"]
+           "MetricNamesPass", "HostTransferPass", "UnfusedChainPass",
+           "SerialCollectivePass"]

@@ -1,0 +1,128 @@
+"""serial-collective: matmul-then-collective chains in traced bodies.
+
+A matmul whose result immediately feeds ``psum`` / ``all_gather`` /
+``psum_scatter`` in a jit-traced body is the serial TP/DP pattern
+``paddle_tpu.fusion.overlap_mm`` exists to decompose: the collective waits
+for the whole GEMM and the GEMM for the whole collective, so the chip
+idles for full collective latency per layer. The overlap primitives
+(``all_gather_matmul``, ``matmul_reduce_scatter``, ``chunked_mm``) split
+the pair into ring/chunk steps whose communication rides inside the
+computation — bitwise-equal numerics, hidden latency.
+
+Scope is deliberately narrow so tier-1 can fail hard on every finding: a
+statement is flagged only when a collective call's ARGUMENT is either a
+literal matmul call (``lax.psum(jnp.matmul(x, w), ...)``) or a name bound
+by the IMMEDIATELY preceding statement to a matmul result — the
+adjacency that proves nothing overlaps the collective. Matmuls feeding a
+collective through intervening computation have real work to hide behind
+and stay clean. Files under ``paddle_tpu/fusion/`` are the decomposed
+implementations themselves and are skipped.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Sequence, Set
+
+from .._jitreach import _last, dotted, traced_functions
+from ..engine import Finding, Pass
+
+# GEMM producers (by dotted-name tail)
+_MATMUL_LAST = {"matmul", "dot", "dot_general", "einsum", "qmm"}
+# serial collectives a GEMM result must not feed directly
+_COLLECTIVE_LAST = {"psum", "all_gather", "psum_scatter"}
+
+_STMTS = (ast.Assign, ast.AnnAssign, ast.AugAssign, ast.Return, ast.Expr)
+
+
+def _is_matmul_call(node: ast.AST) -> bool:
+    return isinstance(node, ast.Call) and \
+        _last(dotted(node.func)) in _MATMUL_LAST
+
+
+def _matmul_target(stmt: ast.AST) -> Optional[str]:
+    """Name a statement binds to a matmul-producing expression, if any."""
+    value = getattr(stmt, "value", None)
+    if value is None or not _is_matmul_call(value):
+        return None
+    if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 and \
+            isinstance(stmt.targets[0], ast.Name):
+        return stmt.targets[0].id
+    if isinstance(stmt, ast.AnnAssign) and isinstance(stmt.target, ast.Name):
+        return stmt.target.id
+    return None
+
+
+def _collective_hit(expr: ast.AST, hot_name: Optional[str]) -> Optional[str]:
+    """Collective call fed by a matmul (literal or hot name); returns the
+    collective's dotted-name tail."""
+    for node in ast.walk(expr):
+        if not isinstance(node, ast.Call):
+            continue
+        last = _last(dotted(node.func))
+        if last not in _COLLECTIVE_LAST:
+            continue
+        for arg in node.args[:1]:  # the reduced/gathered operand
+            if _is_matmul_call(arg):
+                return last
+            if hot_name is not None and isinstance(arg, ast.Name) and \
+                    arg.id == hot_name:
+                return last
+    return None
+
+
+class SerialCollectivePass(Pass):
+    name = "serial-collective"
+    description = ("matmul immediately feeding psum/all_gather/"
+                   "psum_scatter in jit-traced bodies — decompose via "
+                   "paddle_tpu/fusion/overlap_mm so the collective rides "
+                   "the GEMM loop")
+
+    def run(self, files: Sequence, root: str) -> List[Finding]:
+        traced = traced_functions(files)
+        out: List[Finding] = []
+        for sf in files:
+            if sf.tree is None or \
+                    sf.relpath.startswith("paddle_tpu/fusion/"):
+                continue
+            for fn in sorted(traced.get(sf.relpath, ()),
+                             key=lambda n: n.lineno):
+                self._check_fn(sf, fn, out)
+        return out
+
+    # ------------------------------------------------------------ per-fn
+    def _check_fn(self, sf, fn, out: List[Finding]) -> None:
+        nested = {n for n in ast.walk(fn)
+                  if isinstance(n, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)) and n is not fn}
+        skip: Set[ast.AST] = set()
+        for n in nested:            # nested defs are traced on their own
+            skip.update(ast.walk(n))
+            skip.discard(n)
+
+        # walk every statement list so "immediately preceding" is judged
+        # within one suite (bodies of the fn, ifs, loops, withs)
+        for parent in ast.walk(fn):
+            if parent in skip and parent not in nested:
+                continue
+            for field in ("body", "orelse", "finalbody"):
+                stmts = getattr(parent, field, None)
+                if not isinstance(stmts, list):
+                    continue
+                hot: Optional[str] = None
+                for stmt in stmts:
+                    if stmt in skip or not isinstance(stmt, _STMTS):
+                        hot = None
+                        continue
+                    value = getattr(stmt, "value", None)
+                    if value is not None:
+                        coll = _collective_hit(value, hot)
+                        if coll is not None:
+                            out.append(Finding(
+                                self.name, sf.relpath, stmt.lineno,
+                                f"in traced body `{fn.name}`: matmul "
+                                f"result feeds `{coll}` with nothing to "
+                                f"overlap — use fusion.overlap_mm."
+                                f"{'all_gather_matmul' if coll == 'all_gather' else 'matmul_reduce_scatter'}"
+                                f" (or chunked_mm at GSPMD sites) so the "
+                                f"collective rides the GEMM chunk loop"))
+                    hot = _matmul_target(stmt)
