@@ -8,7 +8,11 @@ A *plan* is a ``;``-separated list of rules::
   ``store.op`` (TCPStore client frame exchange), ``rpc.post`` (rpc
   message send), ``pg.collective`` (inside the watchdog window of every
   collective), ``ckpt.write`` (checkpoint shard/metadata write, AFTER
-  the atomic rename), ``engine.step`` (top of every Engine.fit step).
+  the atomic rename), ``engine.step`` (top of every Engine.fit step),
+  ``serving.step`` (inside the serving engine's retried dispatch),
+  ``cluster.replica`` (top of every cluster replica step; ``kill`` /
+  ``raise`` / ``drop`` there simulate a replica crash in-process —
+  drain + replay — rather than ``os._exit``).
 - ``kind`` — what to inject: ``drop`` (close + fail the store socket),
   ``loss`` (silently discard an rpc message), ``delay=<s>`` (sleep,
   e.g. past the watchdog timeout), ``truncate`` / ``bitflip``

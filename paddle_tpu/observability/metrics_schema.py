@@ -180,6 +180,37 @@ METRICS = {
         "counter", "compiles", "traces of the fixed-shape decode step; "
         "MUST stay at 1 per engine — joins/leaves are mask flips, "
         "never recompiles"),
+    # ---- multi-replica serving cluster (serving/cluster/)
+    "cluster.submitted": MetricSpec(
+        "counter", "requests", "requests admitted by the cluster "
+        "router, by routing decision (affinity / least_loaded)",
+        tags=("route",)),
+    "cluster.shed": MetricSpec(
+        "counter", "requests", "requests shed by admission control "
+        "(every alive replica past its queue bound or free-list "
+        "watermark); clients get a typed Overloaded, never a hang"),
+    "cluster.affinity_hits": MetricSpec(
+        "counter", "requests", "requests routed to the replica whose "
+        "prefix cache holds their deepest known block-hash chain"),
+    "cluster.replica_deaths": MetricSpec(
+        "counter", "replicas", "replica crashes observed (injected via "
+        "fault site cluster.replica or real)"),
+    "cluster.replays": MetricSpec(
+        "counter", "requests", "in-flight requests drained from a dead "
+        "replica and replayed on a survivor (prompt+generated "
+        "resubmitted; greedy decoding makes the continuation exact)"),
+    "cluster.handoffs": MetricSpec(
+        "counter", "requests", "disaggregated prefill->decode KV-page "
+        "handoffs adopted by a decode replica"),
+    "cluster.replicas_alive": MetricSpec(
+        "gauge", "replicas", "alive replicas after the last router "
+        "step"),
+    "cluster.queue_depth": MetricSpec(
+        "gauge", "requests", "sum of per-replica admission queues "
+        "after the last router step"),
+    "cluster.step_time": MetricSpec(
+        "histogram", "s", "wall time of one synchronous router step "
+        "(round-robin replica steps + disagg pump)", TIME_BUCKETS),
     # ---- device-native pipeline transport (distributed/pipeline/)
     "pipeline.p2p_bytes": MetricSpec(
         "counter", "bytes", "stage-boundary payload bytes moved by the "
@@ -240,6 +271,10 @@ METRICS = {
     "bench.tp_overlap_window": MetricSpec(
         "histogram", "s", "tp_overlap sub-bench timed window (serial "
         "gather-then-GEMM vs decomposed ring arms)", TIME_BUCKETS),
+    "bench.cluster_window": MetricSpec(
+        "histogram", "s", "cluster bench timed window (one Poisson "
+        "arrival-rate sweep point through the replica router)",
+        TIME_BUCKETS),
 }
 
 
@@ -271,6 +306,12 @@ SPANS = {
     "serving.step": "one ServingEngine step (admit + prefill + decode)",
     "serving.prefill": "one chunked-prefill dispatch (rid/n in args)",
     "serving.decode": "one fixed-shape decode-batch dispatch",
+    "cluster.route": "one router admission decision (affinity lookup + "
+                     "health snapshots + submit)",
+    "cluster.handoff": "one disaggregated prefill->decode KV-page "
+                       "handoff (blocks/bytes in args)",
+    "cluster.replay": "one drained descriptor replayed on a survivor "
+                      "after a replica death",
     "pp.send": "pipeline stage-boundary send (device collective or "
                "host-buffered, transport in args)",
     "pp.recv": "pipeline stage-boundary recv (transport in args)",

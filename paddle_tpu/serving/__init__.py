@@ -11,9 +11,13 @@ Quick start::
     eng.shutdown()
 """
 from .block_manager import BlockManager, hash_block_tokens  # noqa: F401
-from .engine import EngineConfig, RequestError, ServingEngine  # noqa: F401
-from .scheduler import (CANCELLED, FINISHED, PREFILL, RUNNING,  # noqa: F401
-                        WAITING, PrefillChunk, Request, Scheduler)
+from .engine import (EngineConfig, EngineStats, KVHandoff,  # noqa: F401
+                     RequestDescriptor, RequestError, ServingEngine)
+from .scheduler import (CANCELLED, FINISHED, HANDOFF, PREFILL,  # noqa: F401
+                        RUNNING, WAITING, PrefillChunk, Request,
+                        Scheduler)
+from . import cluster  # noqa: E402,F401  (after engine: cluster uses it)
 
 __all__ = ["ServingEngine", "EngineConfig", "RequestError",
-           "BlockManager", "Scheduler", "Request", "PrefillChunk"]
+           "BlockManager", "Scheduler", "Request", "PrefillChunk",
+           "EngineStats", "RequestDescriptor", "KVHandoff", "cluster"]

@@ -55,7 +55,12 @@ class BlockManager:
             raise ValueError("num_blocks and block_size must be > 0")
         self.num_blocks = int(num_blocks)
         self.block_size = int(block_size)
-        self.watermark_blocks = max(0, int(watermark * num_blocks))
+        # clamp to num_blocks-1: a watermark that withholds the WHOLE
+        # pool would make can_allocate(1) false forever and deadlock
+        # admission on tiny pools (num_blocks * watermark rounding up to
+        # the pool size); at least one block must remain admissible
+        self.watermark_blocks = min(max(0, int(watermark * num_blocks)),
+                                    max(0, self.num_blocks - 1))
         self.enable_prefix_cache = bool(enable_prefix_cache)
         self._free: collections.deque[int] = collections.deque(
             range(self.num_blocks))  # guarded by: caller (ServingEngine._lock)

@@ -1,0 +1,34 @@
+"""Multi-replica serving cluster: prefix-affinity router, disaggregated
+prefill/decode, drain-and-replay resilience.
+
+Quick start::
+
+    import paddle_tpu as pt
+    from paddle_tpu.serving.cluster import Replica, ClusterRouter
+
+    reps = [Replica("r%d" % i, model, max_slots=4) for i in range(2)]
+    for r in reps:
+        r.warmup()                       # pre-trace both jits
+    router = ClusterRouter(reps)
+    crid = router.submit(prompt_ids, max_new_tokens=32)
+    while router.step():                 # or router.start() for threads
+        pass
+    tokens = router.result(crid)
+    router.shutdown()
+
+Disaggregated prefill/decode::
+
+    from paddle_tpu.serving.cluster import DisaggPolicy
+    router = ClusterRouter(reps, disagg=DisaggPolicy.split(reps))
+
+``PADDLE_TPU_CLUSTER_REPLICAS`` / ``PADDLE_TPU_CLUSTER_MAX_QUEUE``
+size the default topology in ``bench.py --cluster`` and
+``tools/serve_smoke.py --cluster``; the seeded kill used by the
+resilience tests is ``PADDLE_TPU_FAULT_PLAN="cluster.replica:kill@N"``.
+"""
+from .disagg import DisaggPolicy  # noqa: F401
+from .replica import FAULT_SITE, Replica  # noqa: F401
+from .router import ClusterRouter, Overloaded  # noqa: F401
+
+__all__ = ["Replica", "ClusterRouter", "Overloaded", "DisaggPolicy",
+           "FAULT_SITE"]

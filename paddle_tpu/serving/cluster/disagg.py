@@ -1,0 +1,123 @@
+"""Disaggregated prefill/decode: split the replica set into a
+prefill tier and a decode tier.
+
+Prefill replicas run chunked prefill only (requests submitted with
+``handoff=True`` stop after the prompt KV is resident and the first
+token sampled); the pump then moves each finished prompt to a decode
+replica as a :class:`KVHandoff` — the KV pages travel in the engines'
+native pool layout, which for ``kv_quant="int8"`` is the existing
+``quantize_kv_pages`` ``{"q8","s"}`` serialization, i.e. the quantized
+path IS the wire format (4x smaller than fp32 pages). The decode
+replica seats the payload straight into a RUNNING slot
+(:meth:`ServingEngine.adopt_handoff`) and decodes from position
+``len(prompt)`` — the prompt is never recomputed.
+
+Why bother: prefill batches are compute-bound and bursty, decode
+batches are memory-bound and steady; splitting the tiers isolates the
+mixed-phase interference (a long prompt no longer stalls every decode
+stream behind one chunk) and is the batch shape the ragged
+paged-attention kernel work targets.
+
+The pump is crash-aware in both directions: a payload already exported
+from a prefill replica survives that replica's death (it is host data),
+and if every decode replica is dead the pump falls back to resubmitting
+the request from scratch on any alive replica.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+from ... import observability as _obs
+from ...observability.tracing import span
+from ..engine import KVHandoff, RequestError
+from .replica import Replica
+
+__all__ = ["DisaggPolicy"]
+
+
+class DisaggPolicy:
+    """Prefill/decode split + the handoff pump between the tiers."""
+
+    def __init__(self, prefill: Sequence[Replica],
+                 decode: Sequence[Replica]):
+        if not prefill or not decode:
+            raise ValueError("need >=1 prefill and >=1 decode replica")
+        self.prefill = list(prefill)
+        self.decode = list(decode)
+        # payloads exported but not yet adopted (decode side busy);
+        # entries are (source replica, payload)
+        self._pending: List[Tuple[Replica, KVHandoff]] = []
+
+    @classmethod
+    def split(cls, replicas: Sequence[Replica],
+              n_prefill: Optional[int] = None) -> "DisaggPolicy":
+        """Default split: first ``n_prefill`` (half, rounded down, min
+        1) replicas prefill, the rest decode."""
+        if len(replicas) < 2:
+            raise ValueError("disagg needs >= 2 replicas")
+        n = n_prefill if n_prefill is not None else \
+            max(1, len(replicas) // 2)
+        if not 1 <= n < len(replicas):
+            raise ValueError("n_prefill out of range")
+        return cls(replicas[:n], replicas[n:])
+
+    def _least_loaded_decode(self) -> Optional[Replica]:
+        alive = [r for r in self.decode if r.alive]
+        if not alive:
+            return None
+        st = {r: r.stats() for r in alive}
+        return min(alive, key=lambda r: (st[r].active_slots +
+                                         st[r].queue_depth))
+
+    def pump(self, router) -> int:
+        """Move every ready payload prefill -> decode; returns how many
+        were adopted this pass. Payloads a busy decode tier rejects stay
+        pending and are re-offered next pump."""
+        for p in self.prefill:
+            if not p.alive:
+                continue
+            while True:
+                pay = p.take_handoff()
+                if pay is None:
+                    break
+                self._pending.append((p, pay))
+        moved = 0
+        still: List[Tuple[Replica, KVHandoff]] = []
+        for src, pay in self._pending:
+            with span("cluster.handoff",
+                      args={"blocks": pay.num_blocks,
+                            "bytes": pay.nbytes()}):
+                target = self._least_loaded_decode()
+                rid = target.adopt_handoff(pay) if target is not None \
+                    else None
+            if rid is not None:
+                router.retarget_handoff(src, pay.src_rid, target, rid,
+                                        inject=[pay.first_token])
+                if _obs.enabled():
+                    _obs.registry.counter("cluster.handoffs").inc()
+                moved += 1
+            elif target is None:
+                # whole decode tier is dead: restart from the prompt on
+                # any alive replica (the client saw zero tokens so a
+                # fresh full stream is seamless)
+                self._resubmit(router, src, pay)
+            else:
+                still.append((src, pay))
+        self._pending = still
+        return moved
+
+    def _resubmit(self, router, src: Replica, pay: KVHandoff) -> None:
+        for r in router.replicas:
+            if not r.alive:
+                continue
+            try:
+                rid = r.submit(list(pay.prompt),
+                               max_new_tokens=pay.max_new_tokens,
+                               temperature=pay.temperature,
+                               top_p=pay.top_p, eos_id=pay.eos_id)
+                router.retarget_handoff(src, pay.src_rid, r, rid,
+                                        inject=[])
+                return
+            except RequestError:
+                continue
+        # nobody alive: let the stream's replay timeout fail it

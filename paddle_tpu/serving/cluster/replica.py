@@ -1,0 +1,123 @@
+"""Replica: one :class:`ServingEngine` behind a liveness boundary.
+
+A replica is the unit the cluster router schedules over: it owns one
+engine (thread-hosted in-process; nothing here assumes shared memory
+beyond the engine handle, so a subprocess host only needs to proxy
+these same calls), exposes the engine's thread-safe
+:meth:`~paddle_tpu.serving.engine.ServingEngine.stats` health snapshot,
+and mediates EVERY engine step through the deterministic fault harness
+(:mod:`paddle_tpu.distributed.resilience.faults`, site
+``cluster.replica``).
+
+Death is simulated, never real: the fault kinds ``kill`` / ``raise`` /
+``drop`` at this site are intercepted *before* :func:`faults.apply`
+would ``os._exit`` the whole test process — the replica instead calls
+:meth:`ServingEngine.fail_all`, which atomically captures a replayable
+descriptor of every in-flight request, ends their streams with
+``replica_dead``, and releases all KV pages. The descriptors flow to
+the router's ``on_death`` callback, which replays them on survivors.
+Generic kinds (``delay``) still go through ``faults.apply``.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional, Sequence, Tuple
+
+from ... import observability as _obs
+from ...distributed.resilience import faults
+from ..engine import (EngineStats, KVHandoff, RequestDescriptor,
+                      ServingEngine)
+
+__all__ = ["Replica", "FAULT_SITE"]
+
+# the in-tree injection point for seeded replica kills:
+#   PADDLE_TPU_FAULT_PLAN="cluster.replica:kill@7"
+# fires on the 7th replica step ACROSS the cluster (the counter is per
+# site, not per replica), so single-threaded round-robin stepping makes
+# the victim deterministic.
+FAULT_SITE = "cluster.replica"
+
+_DEATH_KINDS = ("kill", "raise", "drop")
+
+
+class Replica:
+    """One engine + liveness; the router's scheduling unit."""
+
+    def __init__(self, name: str, model, fault_site: str = FAULT_SITE,
+                 **engine_knobs):
+        self.name = str(name)
+        self.fault_site = fault_site
+        self.engine = ServingEngine(model, **engine_knobs)
+        # router hook: called as on_death(replica, descriptors) from the
+        # thread that observed the death, BEFORE step() returns
+        self.on_death: Optional[
+            Callable[["Replica", Tuple[RequestDescriptor, ...]],
+                     None]] = None
+        self._lock = threading.Lock()
+        self._alive = True  # guarded by: _lock
+
+    # ------------------------------------------------------------ health
+    @property
+    def alive(self) -> bool:
+        with self._lock:
+            return self._alive
+
+    def stats(self) -> EngineStats:
+        """Thread-safe engine health snapshot (lock-held on the engine
+        side, so the router never sees a torn read)."""
+        return self.engine.stats()
+
+    def warmup(self) -> None:
+        """AOT warmup: pre-trace the decode and prefill-chunk jits so
+        this replica's first real token pays no cold compile."""
+        self.engine.warmup()
+
+    # ----------------------------------------------------- engine facade
+    def submit(self, prompt: Sequence[int], **kw) -> int:
+        return self.engine.submit(prompt, **kw)
+
+    def events(self, rid: int):
+        return self.engine.events(rid)
+
+    def cancel(self, rid: int, reason: str = "cancelled") -> None:
+        self.engine.cancel(rid, reason)
+
+    def take_handoff(self) -> Optional[KVHandoff]:
+        return self.engine.take_handoff()
+
+    def adopt_handoff(self, payload: KVHandoff) -> Optional[int]:
+        return self.engine.adopt_handoff(payload)
+
+    # ----------------------------------------------------------- driving
+    def step(self) -> bool:
+        """One engine step, gated on the fault harness. Returns False
+        when dead or idle. A death fault makes this replica drain
+        in-flight work into descriptors and hand them to ``on_death``
+        synchronously — by the time step() returns, the router has
+        already replayed them."""
+        if not self.alive:
+            return False
+        act = faults.check(self.fault_site)
+        if act is not None:
+            if act.kind in _DEATH_KINDS:
+                self.die()
+                return False
+            faults.apply(act)
+        return self.engine.step()
+
+    def die(self) -> Tuple[RequestDescriptor, ...]:
+        """Simulate a crash of this replica (idempotent)."""
+        with self._lock:
+            if not self._alive:
+                return ()
+            self._alive = False
+        descs = self.engine.fail_all("replica_dead")
+        if _obs.enabled():
+            _obs.registry.counter("cluster.replica_deaths").inc()
+        cb = self.on_death
+        if cb is not None:
+            cb(self, descs)
+        return descs
+
+    def shutdown(self, check_leaks: bool = True) -> None:
+        self.engine.shutdown(check_leaks=check_leaks)

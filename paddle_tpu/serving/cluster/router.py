@@ -1,0 +1,395 @@
+"""ClusterRouter: the front-end over N serving replicas.
+
+Submit/stream/cancel parity with :class:`ServingEngine`, plus the three
+cluster-only behaviours:
+
+* **Prefix-affinity routing** — the router keys each prompt by the same
+  rolling block-hash chain the engines' prefix caches use
+  (:func:`block_manager.hash_block_tokens` over whole
+  ``block_size``-token blocks) and remembers which replica last served
+  each chain hash. A new prompt routes to the replica holding its
+  deepest known prefix — that replica's paged prefix cache then skips
+  recomputing those blocks (``serving.prefix_hit_tokens`` proves the
+  hit). Least-loaded fallback otherwise.
+
+* **Admission control / load shedding** — before accepting, the router
+  checks the candidate's health snapshot: per-replica queue depth below
+  ``max_queue`` AND enough free blocks above the engine's free-list
+  watermark for the prompt (+1 decode block). When no alive replica
+  admits, submit raises the typed :class:`Overloaded` immediately —
+  clients get a signal to back off, never a hang or an unbounded queue.
+
+* **Drain-and-replay resilience** — replica death hands the router the
+  dead engine's in-flight :class:`RequestDescriptor`s. Greedy decoding
+  is deterministic, so replaying ``prompt + generated`` with
+  ``remaining`` new tokens on a survivor continues each stream exactly
+  where it stopped. Client streams are *segmented*: every emitted token
+  survives in the dead engine's queue, so the client-facing generator
+  drains segment N fully (tokens, then the ``replica_dead`` marker)
+  before crossing into the replayed segment N+1 — no token is lost or
+  duplicated. Replays bypass admission control on purpose: shedding is
+  for new work, not for work the cluster already accepted.
+
+Driving: ``router.step()`` runs one synchronous round-robin pass over
+all replicas (deterministic — this is what tests and the fault plans
+use, since the ``cluster.replica`` fault counter is per-site);
+``router.start()`` instead hosts one stepping thread per replica (plus
+a disagg pump thread) for throughput runs.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+from ... import observability as _obs
+from ...observability.tracing import span
+from ..block_manager import hash_block_tokens
+from ..engine import RequestDescriptor, RequestError
+from .replica import Replica
+
+__all__ = ["ClusterRouter", "Overloaded"]
+
+
+class Overloaded(RequestError):
+    """Typed load-shed result: every alive replica is beyond its queue
+    bound or free-list watermark. Back off and resubmit."""
+
+    def __init__(self, detail: str = ""):
+        super().__init__("overloaded")
+        self.detail = detail
+
+
+def _env_int(name: str, default: int) -> int:
+    v = os.environ.get(name)
+    return int(v) if v else default
+
+
+class _ClientReq:
+    """Router-side record of one client request. ``segments`` is the
+    ordered list of (replica, engine_rid, inject_tokens) hops the
+    request has made — one entry at submit, +1 per replay or disagg
+    handoff. All fields are guarded by the router condition lock."""
+
+    __slots__ = ("crid", "segments", "failed")
+
+    def __init__(self, crid: int,
+                 segments: List[Tuple[Replica, int, List[int]]]):
+        self.crid = crid
+        self.segments = segments
+        self.failed = False
+
+
+class ClusterRouter:
+    # stream() waits at most this long for a dead/handoff segment to be
+    # retargeted before declaring the request failed — the "never a
+    # hang" contract extends to replays, not just admission
+    REPLAY_TIMEOUT_S = 60.0
+
+    def __init__(self, replicas: Sequence[Replica],
+                 max_queue: Optional[int] = None,
+                 disagg: Optional[object] = None):
+        if not replicas:
+            raise ValueError("need at least one replica")
+        self.replicas = list(replicas)
+        self.max_queue = max_queue if max_queue is not None else \
+            _env_int("PADDLE_TPU_CLUSTER_MAX_QUEUE", 32)
+        self.disagg = disagg            # DisaggPolicy or None
+        self.block_size = \
+            self.replicas[0].engine.manager.block_size
+        for r in self.replicas:
+            if r.engine.manager.block_size != self.block_size:
+                raise ValueError("replicas disagree on block_size")
+            r.on_death = self._on_death
+        self._cond = threading.Condition()
+        self._crid = 0  # guarded by: _cond
+        self._recs: Dict[int, _ClientReq] = {}  # guarded by: _cond
+        # (replica name, engine rid) -> crid, for the CURRENT segment
+        self._by_engine: Dict[Tuple[str, int], int] = {}  # guarded by: _cond
+        # prefix chain hash -> replica that last served it
+        self._affinity: Dict[int, Replica] = {}  # guarded by: _cond
+        self._threads: List[threading.Thread] = []
+        self._stop = threading.Event()
+
+    # ---------------------------------------------------------- routing
+    def _chain(self, prompt: Sequence[int]) -> List[int]:
+        bs = self.block_size
+        h: Optional[int] = None
+        out: List[int] = []
+        for i in range(len(prompt) // bs):
+            h = hash_block_tokens(h, prompt[i * bs:(i + 1) * bs])
+            out.append(h)
+        return out
+
+    def _submit_pool(self) -> List[Replica]:
+        pool = self.disagg.prefill if self.disagg is not None \
+            else self.replicas
+        return [r for r in pool if r.alive]
+
+    def _replay_pool(self) -> List[Replica]:
+        if self.disagg is not None:
+            dec = [r for r in self.disagg.decode if r.alive]
+            if dec:
+                return dec
+        return [r for r in self.replicas if r.alive]
+
+    def _route(self, prompt: List[int]) -> Tuple[Replica, str]:
+        """Pick a replica for a NEW prompt or raise :class:`Overloaded`.
+        Order: deepest-affinity replica first, then alive replicas by
+        load; the first one passing admission wins."""
+        alive = self._submit_pool()
+        if not alive:
+            raise RequestError("no_replicas")
+        chain = self._chain(prompt)
+        aff: Optional[Replica] = None
+        with self._cond:
+            for h in reversed(chain):
+                r = self._affinity.get(h)
+                if r is not None and r.alive and r in alive:
+                    aff = r
+                    break
+        st = {r: r.stats() for r in alive}
+        order = sorted(alive, key=lambda r: (st[r].queue_depth +
+                                             st[r].active_slots))
+        if aff is not None:
+            order = [aff] + [r for r in order if r is not aff]
+        need = -(-(len(prompt) + 1) // self.block_size)
+        for r in order:
+            if st[r].queue_depth < self.max_queue and \
+                    st[r].can_admit(need):
+                route = "affinity" if r is aff else "least_loaded"
+                with self._cond:
+                    for h in chain:
+                        self._affinity[h] = r
+                if _obs.enabled():
+                    _obs.registry.counter(
+                        "cluster.submitted", tags={"route": route}).inc()
+                    if route == "affinity":
+                        _obs.registry.counter(
+                            "cluster.affinity_hits").inc()
+                return r, route
+        if _obs.enabled():
+            _obs.registry.counter("cluster.shed").inc()
+        raise Overloaded(
+            "all %d alive replicas at queue/watermark limits"
+            % len(alive))
+
+    # ----------------------------------------------------------- intake
+    def submit(self, prompt: Sequence[int], max_new_tokens: int = 16,
+               temperature: float = 0.0, top_p: float = 1.0,
+               eos_id: Optional[int] = None,
+               deadline_s: Optional[float] = None) -> int:
+        """Route and queue one request; returns a cluster-level rid.
+        Raises :class:`Overloaded` when admission control sheds it."""
+        prompt = [int(t) for t in prompt]
+        handoff = self.disagg is not None
+        with span("cluster.route"):
+            for _ in range(len(self.replicas) + 1):
+                rep, _route = self._route(prompt)
+                try:
+                    rid = rep.submit(
+                        prompt, max_new_tokens=max_new_tokens,
+                        temperature=temperature, top_p=top_p,
+                        eos_id=eos_id, deadline_s=deadline_s,
+                        handoff=handoff)
+                    break
+                except RequestError:
+                    continue            # died between stats and submit
+            else:
+                raise RequestError("no_replicas")
+        with self._cond:
+            self._crid += 1
+            crid = self._crid
+            self._recs[crid] = _ClientReq(crid, [(rep, rid, [])])
+            self._by_engine[(rep.name, rid)] = crid
+        return crid
+
+    def cancel(self, crid: int, reason: str = "cancelled") -> None:
+        with self._cond:
+            rec = self._recs.get(crid)
+            if rec is None:
+                return
+            rep, rid, _ = rec.segments[-1]
+        rep.cancel(rid, reason)
+
+    # --------------------------------------------------------- streaming
+    def stream(self, crid: int) -> Iterator[int]:
+        """Per-token iterator with :class:`ServingEngine.stream` parity;
+        replays and disagg handoffs are invisible joins."""
+        for kind, val in self._events(crid):
+            if kind == "tok":
+                yield val
+            elif val in ("eos", "length"):
+                return
+            else:
+                raise RequestError(val)
+
+    def result(self, crid: int) -> List[int]:
+        return list(self.stream(crid))
+
+    def _events(self, crid: int) -> Iterator[Tuple[str, object]]:
+        with self._cond:
+            rec = self._recs[crid]
+        i = 0
+        while True:
+            with self._cond:
+                deadline = time.monotonic() + self.REPLAY_TIMEOUT_S
+                while len(rec.segments) <= i and not rec.failed:
+                    left = deadline - time.monotonic()
+                    if left <= 0 or not self._cond.wait(timeout=left):
+                        rec.failed = True
+                if rec.failed and len(rec.segments) <= i:
+                    yield ("end", "replica_dead")
+                    return
+                rep, rid, inject = rec.segments[i]
+            for t in inject:
+                yield ("tok", t)
+            ended: Optional[str] = None
+            for kind, val in rep.events(rid):
+                if kind == "tok":
+                    yield ("tok", val)
+                else:
+                    ended = str(val)
+            if ended in ("replica_dead", "handoff"):
+                i += 1                   # wait for the next segment
+                continue
+            yield ("end", ended)
+            return
+
+    # --------------------------------------------------------- resilience
+    def _on_death(self, replica: Replica,
+                  descs: Tuple[RequestDescriptor, ...]) -> None:
+        """Replica death callback: replay every drained descriptor on a
+        survivor. Runs on the thread that observed the death, before its
+        step() returns."""
+        for d in descs:
+            with self._cond:
+                crid = self._by_engine.pop((replica.name, d.rid), None)
+            if crid is None:
+                continue                 # not one of ours (warmup etc.)
+            self._replay(crid, d)
+
+    def _replay(self, crid: int, d: RequestDescriptor) -> None:
+        with span("cluster.replay"):
+            survivors = self._replay_pool()
+            rep: Optional[Replica] = None
+            rid: Optional[int] = None
+            if survivors:
+                st = {r: r.stats() for r in survivors}
+                order = sorted(survivors,
+                               key=lambda r: (st[r].queue_depth +
+                                              st[r].active_slots))
+                prompt = list(d.prompt) + list(d.generated)
+                deadline_s = None if d.deadline is None else \
+                    max(0.0, d.deadline - time.monotonic())
+                for r in order:          # no shedding for replays
+                    try:
+                        rid = r.submit(prompt,
+                                       max_new_tokens=d.remaining,
+                                       temperature=d.temperature,
+                                       top_p=d.top_p, eos_id=d.eos_id,
+                                       deadline_s=deadline_s)
+                        rep = r
+                        break
+                    except RequestError:
+                        continue
+            with self._cond:
+                rec = self._recs.get(crid)
+                if rec is None:
+                    if rep is not None:
+                        rep.cancel(rid)
+                    return
+                if rep is None:
+                    rec.failed = True
+                else:
+                    rec.segments.append((rep, rid, []))
+                    self._by_engine[(rep.name, rid)] = crid
+                    if _obs.enabled():
+                        _obs.registry.counter("cluster.replays").inc()
+                self._cond.notify_all()
+
+    def retarget_handoff(self, src: Replica, src_rid: int,
+                         target: Replica, rid: int,
+                         inject: List[int]) -> None:
+        """Disagg pump callback: the request that prefilled as
+        ``src_rid`` on ``src`` now decodes as ``rid`` on ``target``;
+        ``inject`` carries the prefill-sampled first token the decode
+        engine will not re-emit."""
+        with self._cond:
+            crid = self._by_engine.pop((src.name, src_rid), None)
+            if crid is None:
+                return
+            rec = self._recs.get(crid)
+            if rec is None:
+                return
+            rec.segments.append((target, rid, list(inject)))
+            self._by_engine[(target.name, rid)] = crid
+            self._cond.notify_all()
+
+    # ----------------------------------------------------------- driving
+    def num_alive(self) -> int:
+        return sum(1 for r in self.replicas if r.alive)
+
+    def step(self) -> bool:
+        """One synchronous round: step every alive replica round-robin,
+        pump disagg handoffs, publish cluster gauges. Deterministic —
+        the test/fault-plan driver."""
+        t0 = time.monotonic()
+        did = False
+        for rep in self.replicas:
+            if rep.alive:
+                did = rep.step() or did
+        if self.disagg is not None:
+            did = (self.disagg.pump(self) > 0) or did
+        if _obs.enabled():
+            _obs.registry.gauge("cluster.replicas_alive").set(
+                self.num_alive())
+            _obs.registry.gauge("cluster.queue_depth").set(
+                sum(r.stats().queue_depth
+                    for r in self.replicas if r.alive))
+            _obs.registry.histogram("cluster.step_time").observe(
+                time.monotonic() - t0)
+        return did
+
+    def start(self) -> None:
+        """Threaded mode: one stepping thread per replica (XLA releases
+        the GIL during compute, so replicas overlap on CPU too) plus a
+        handoff pump thread when disaggregated."""
+        if self._threads:
+            return
+        self._stop.clear()
+
+        def rep_loop(rep: Replica) -> None:
+            while not self._stop.is_set():
+                if not (rep.alive and rep.step()):
+                    time.sleep(0.001)
+
+        for rep in self.replicas:
+            t = threading.Thread(target=rep_loop, args=(rep,),
+                                 daemon=True,
+                                 name="cluster-%s" % rep.name)
+            t.start()
+            self._threads.append(t)
+        if self.disagg is not None:
+            def pump_loop() -> None:
+                while not self._stop.is_set():
+                    if self.disagg.pump(self) == 0:
+                        time.sleep(0.001)
+
+            t = threading.Thread(target=pump_loop, daemon=True,
+                                 name="cluster-disagg-pump")
+            t.start()
+            self._threads.append(t)
+
+    def shutdown(self, check_leaks: bool = True) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=10.0)
+        self._threads = []
+        with self._cond:
+            for rec in self._recs.values():
+                rec.failed = True        # unblock any waiting streams
+            self._cond.notify_all()
+        for rep in self.replicas:
+            rep.shutdown(check_leaks=check_leaks and rep.alive)

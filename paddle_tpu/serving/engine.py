@@ -28,11 +28,12 @@ tests may instead call ``engine.step()`` directly for determinism.
 """
 from __future__ import annotations
 
+import dataclasses
 import os
 import queue
 import threading
 import time
-from typing import Dict, Iterator, List, Optional, Sequence
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -46,9 +47,80 @@ from ..incubate.nn.pallas.paged_attention import quantize_kv_pages
 from ..models.generation import _sample
 from ..observability.tracing import span
 from .block_manager import BlockManager
-from .scheduler import RUNNING, PrefillChunk, Request, Scheduler
+from .scheduler import (CANCELLED, FINISHED, HANDOFF, PREFILL, RUNNING,
+                        PrefillChunk, Request, Scheduler)
 
-__all__ = ["ServingEngine", "RequestError", "EngineConfig"]
+__all__ = ["ServingEngine", "RequestError", "EngineConfig",
+           "RequestDescriptor", "EngineStats", "KVHandoff"]
+
+
+@dataclasses.dataclass(frozen=True)
+class RequestDescriptor:
+    """Replayable snapshot of one in-flight request. Greedy decoding is
+    deterministic, so ``prompt + generated`` resubmitted with
+    ``remaining`` new tokens on ANY engine holding the same weights
+    continues the exact same stream — this is what the cluster router
+    replays after a replica death."""
+    rid: int
+    prompt: Tuple[int, ...]
+    generated: Tuple[int, ...]
+    remaining: int
+    temperature: float
+    top_p: float
+    eos_id: Optional[int]
+    deadline: Optional[float]          # absolute time.monotonic()
+    state: str
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineStats:
+    """Lock-held health snapshot for routers/monitors (see
+    :meth:`ServingEngine.stats`)."""
+    free_blocks: int
+    total_blocks: int
+    watermark_blocks: int
+    block_size: int
+    queue_depth: int                   # waiting for a slot
+    prefilling: int
+    running: int
+    active_slots: int
+    max_slots: int
+    decode_compiles: int
+    inflight: Tuple[RequestDescriptor, ...]
+
+    def can_admit(self, n_blocks: int) -> bool:
+        """Mirror of ``BlockManager.can_allocate`` over the snapshot."""
+        return self.free_blocks - self.watermark_blocks >= n_blocks
+
+
+@dataclasses.dataclass(frozen=True)
+class KVHandoff:
+    """One prefilled request leaving a prefill replica: prompt KV pages
+    (native pool layout — fp arrays or int8 ``{"q8","s"}`` dicts, one
+    per layer) plus everything a decode replica needs to seat it
+    directly into a RUNNING slot."""
+    src_rid: int                       # rid on the PREFILL engine
+    prompt: Tuple[int, ...]
+    first_token: int
+    max_new_tokens: int
+    temperature: float
+    top_p: float
+    eos_id: Optional[int]
+    deadline: Optional[float]          # absolute time.monotonic()
+    block_size: int
+    kv_quant: Optional[str]
+    num_blocks: int                    # pages carried per layer
+    k_pages: Tuple[object, ...]        # per layer: [n_kv, nb, page, d]
+    v_pages: Tuple[object, ...]
+
+    def nbytes(self) -> int:
+        total = 0
+        for pages in self.k_pages + self.v_pages:
+            if isinstance(pages, dict):
+                total += pages["q8"].nbytes + pages["s"].nbytes
+            else:
+                total += pages.nbytes
+        return total
 
 
 class RequestError(RuntimeError):
@@ -130,6 +202,8 @@ class ServingEngine:
         self._requests: Dict[int, Request] = {}  # guarded by: _lock
         self._streams: Dict[int, "queue.Queue"] = {}  # guarded by: _lock
         self._last_emit: Dict[int, float] = {}  # guarded by: _lock
+        self._handoff_ready: List[Request] = []  # guarded by: _lock
+        self._dead = False  # guarded by: _lock (fail_all called)
 
     # ----------------------------------------------------- jitted bodies
     def _decode_step(self, w, toks, pos, kp, vp, bt, temp, top_p, key):
@@ -155,8 +229,13 @@ class ServingEngine:
     def submit(self, prompt: Sequence[int], max_new_tokens: int = 16,
                temperature: float = 0.0, top_p: float = 1.0,
                eos_id: Optional[int] = None,
-               deadline_s: Optional[float] = None) -> int:
-        """Queue a request; returns its rid for stream()/cancel()."""
+               deadline_s: Optional[float] = None,
+               handoff: bool = False) -> int:
+        """Queue a request; returns its rid for stream()/cancel().
+        ``handoff=True`` (disaggregated prefill) stops after the prompt
+        is prefilled and the first token sampled — the request then
+        waits in the handoff queue for :meth:`take_handoff` instead of
+        decoding here."""
         prompt = [int(t) for t in prompt]
         if len(prompt) + max_new_tokens > self.max_seq_len:
             raise ValueError(
@@ -167,8 +246,11 @@ class ServingEngine:
                       temperature=float(temperature), top_p=float(top_p),
                       eos_id=eos_id, arrival=now,
                       deadline=None if deadline_s is None
-                      else now + deadline_s)
+                      else now + deadline_s,
+                      handoff=bool(handoff))
         with self._lock:
+            if self._dead:
+                raise RequestError("replica_dead")
             self._requests[req.rid] = req
             self._streams[req.rid] = queue.Queue()
             self.scheduler.add(req)
@@ -200,12 +282,210 @@ class ServingEngine:
         """Convenience: drain the whole stream into a list."""
         return list(self.stream(rid))
 
+    def events(self, rid: int) -> Iterator[Tuple[str, object]]:
+        """Raw per-request event iterator: ``("tok", t)`` items followed
+        by one ``("end", reason)``. Unlike :meth:`stream` this exposes
+        the termination reason, which the cluster router needs to tell
+        a normal end (eos/length) from a replica death it must replay."""
+        with self._lock:
+            q = self._streams[rid]
+        while True:
+            kind, val = q.get()
+            yield kind, val
+            if kind != "tok":
+                return
+
+    # ----------------------------------------------------- health/stats
+    def _descriptor(self, req: Request) -> RequestDescriptor:  # ptlint: holds=_lock
+        return RequestDescriptor(
+            rid=req.rid, prompt=tuple(req.prompt),
+            generated=tuple(req.generated), remaining=req.remaining,
+            temperature=req.temperature, top_p=req.top_p,
+            eos_id=req.eos_id, deadline=req.deadline, state=req.state)
+
+    def stats(self) -> EngineStats:
+        """Thread-safe health snapshot: free/watermark blocks, slot and
+        queue occupancy, and replayable descriptors of every in-flight
+        request. The whole snapshot is built under ``_lock`` (the fields
+        read here are `# guarded by: _lock` / caller-guarded state) so
+        it is internally consistent — a router sees matching queue depth
+        and descriptor list, never a torn read."""
+        with self._lock:
+            prefilling = running = 0
+            for r in self.scheduler.slots.values():
+                if r.state == RUNNING:
+                    running += 1
+                elif r.state in (PREFILL, HANDOFF):
+                    prefilling += 1
+            inflight = tuple(
+                self._descriptor(r) for r in self._requests.values()
+                if r.state not in (FINISHED, CANCELLED))
+            return EngineStats(
+                free_blocks=self.manager.num_free(),
+                total_blocks=self.manager.num_blocks,
+                watermark_blocks=self.manager.watermark_blocks,
+                block_size=self.manager.block_size,
+                queue_depth=len(self.scheduler.waiting),
+                prefilling=prefilling,
+                running=running,
+                active_slots=self.scheduler.num_active(),
+                max_slots=self.config.max_slots,
+                decode_compiles=self.decode_compiles,
+                inflight=inflight)
+
+    @property
+    def dead(self) -> bool:
+        with self._lock:
+            return self._dead
+
+    def fail_all(self, reason: str = "replica_dead") \
+            -> Tuple[RequestDescriptor, ...]:
+        """Simulated replica crash: atomically capture a replayable
+        descriptor for every live request, cancel them all (streams end
+        with ``reason``), release every page, and refuse further work.
+        The returned descriptors are the router's drain list."""
+        with self._lock:
+            self._dead = True
+            descs = []
+            for req in list(self._requests.values()):
+                if req.state in (FINISHED, CANCELLED):
+                    continue
+                descs.append(self._descriptor(req))
+                self.scheduler.cancel(req, reason)
+                self._end_stream(req, reason)
+            self._handoff_ready.clear()
+            return tuple(descs)
+
+    # ------------------------------------------------------- AOT warmup
+    def warmup(self, token: int = 0) -> None:
+        """AOT warmup: run one tiny request through the engine so BOTH
+        jitted programs (prefill-chunk and fixed-shape decode) are
+        traced and compiled before real traffic arrives — a fresh
+        replica serves its first token without a cold compile. The
+        1-token prompt registers nothing in the prefix cache (only full
+        blocks are hashed) and the pool drains back to empty."""
+        if self._thread is not None:
+            raise RuntimeError("warmup() must run before start()")
+        rid = self.submit([int(token)], max_new_tokens=2)
+        steps = 0
+        while self.step():
+            steps += 1
+            if steps > 64:
+                raise RuntimeError("warmup failed to drain")
+        list(self.stream(rid))          # queue already holds the end
+        with self._lock:
+            self._requests.pop(rid, None)
+            self._streams.pop(rid, None)
+
+    # ------------------------------------------- disaggregated handoff
+    def _export_pages(self, blocks: List[int]):  # ptlint: holds=_lock
+        """Materialize the KV pages of ``blocks`` (host copies, native
+        pool layout: fp arrays or int8 ``{"q8","s"}`` dicts)."""
+        idx = np.asarray(blocks, np.int32)
+
+        def take(pool):
+            if isinstance(pool, dict):
+                return {"q8": np.asarray(pool["q8"][:, idx]),
+                        "s": np.asarray(pool["s"][:, idx])}
+            return np.asarray(pool[:, idx])
+
+        k = tuple(take(p) for p in self._kp)
+        v = tuple(take(p) for p in self._vp)
+        return k, v
+
+    @staticmethod
+    def _import_pages(pool, blocks, pages):
+        """Write exported pages into this engine's pool at ``blocks``."""
+        idx = np.asarray(blocks, np.int32)
+        if isinstance(pool, dict):
+            if not isinstance(pages, dict):
+                raise ValueError("fp pages offered to an int8 pool")
+            return {"q8": pool["q8"].at[:, idx].set(
+                        jnp.asarray(pages["q8"])),
+                    "s": pool["s"].at[:, idx].set(
+                        jnp.asarray(pages["s"]))}
+        if isinstance(pages, dict):
+            # int8 wire payload into an fp pool: dequantize rows
+            deq = pages["q8"].astype(np.float32) * \
+                pages["s"][..., None].astype(np.float32)
+            return pool.at[:, idx].set(jnp.asarray(deq, pool.dtype))
+        return pool.at[:, idx].set(jnp.asarray(pages, pool.dtype))
+
+    def take_handoff(self) -> Optional[KVHandoff]:
+        """Pop one prefilled request off the handoff queue as a
+        :class:`KVHandoff` payload; its pages are exported (host
+        copies) and then released here — full prompt blocks go to the
+        prefix cache exactly like a normal completion, so repeated
+        prefixes still hit on this prefill replica."""
+        with self._lock:
+            while self._handoff_ready:
+                req = self._handoff_ready.pop(0)
+                if req.state != HANDOFF:
+                    continue            # cancelled while parked
+                k, v = self._export_pages(req.blocks)
+                payload = KVHandoff(
+                    src_rid=req.rid,
+                    prompt=tuple(req.prompt),
+                    first_token=int(req.handoff_token),
+                    max_new_tokens=req.max_new_tokens,
+                    temperature=req.temperature, top_p=req.top_p,
+                    eos_id=req.eos_id, deadline=req.deadline,
+                    block_size=self.manager.block_size,
+                    kv_quant=self.config.kv_quant,
+                    num_blocks=len(req.blocks), k_pages=k, v_pages=v)
+                self.scheduler.finish(req, "handoff")
+                self._end_stream(req, "handoff")
+                return payload
+            return None
+
+    def adopt_handoff(self, payload: KVHandoff) -> Optional[int]:
+        """Seat a :class:`KVHandoff` from a prefill replica straight
+        into a RUNNING decode slot: allocate pages, import the KV, and
+        decode from position ``len(prompt)`` on. Returns the local rid,
+        or ``None`` when this engine has no free slot / pages right now
+        (the caller re-offers later). The first token was already
+        sampled by the prefill replica and is NOT re-emitted here."""
+        if payload.block_size != self.manager.block_size:
+            raise ValueError(
+                "handoff block_size %d != engine block_size %d"
+                % (payload.block_size, self.manager.block_size))
+        with self._lock:
+            if self._dead:
+                return None
+            need = payload.num_blocks
+            if not self.scheduler._free_slots or \
+                    not self.manager.can_allocate(need):
+                return None
+            blocks = self.manager.allocate(need)
+            self._kp = tuple(
+                self._import_pages(p, blocks, pg)
+                for p, pg in zip(self._kp, payload.k_pages))
+            self._vp = tuple(
+                self._import_pages(p, blocks, pg)
+                for p, pg in zip(self._vp, payload.v_pages))
+            req = Request(prompt=list(payload.prompt),
+                          max_new_tokens=payload.max_new_tokens,
+                          temperature=payload.temperature,
+                          top_p=payload.top_p, eos_id=payload.eos_id,
+                          deadline=payload.deadline,
+                          arrival=time.monotonic())
+            req.generated = [payload.first_token]
+            req.remaining = payload.max_new_tokens - 1
+            req.first_token_at = req.arrival
+            self.scheduler.place_running(req, blocks)
+            self._requests[req.rid] = req
+            self._streams[req.rid] = queue.Queue()
+        self._wakeup.set()
+        return req.rid
+
     # ------------------------------------------------------- step engine
     def step(self) -> bool:
         """One scheduler round: admit, one prefill chunk, one decode
         batch.  Returns False when there was nothing to do."""
         t0 = time.monotonic()
         with self._lock, span("serving.step"):
+            if self._dead:
+                return False
             self._expire_deadlines()
             admitted = self.scheduler.admit()
             for req in admitted:
@@ -251,7 +531,7 @@ class ServingEngine:
         return call_with_retry(body, default_policy(deadline=nearest),
                                site="serving.step")
 
-    def _run_prefill(self, chunk: PrefillChunk) -> None:
+    def _run_prefill(self, chunk: PrefillChunk) -> None:  # ptlint: holds=_lock
         req, cfg = chunk.req, self.config
         n = len(chunk.tokens)
         toks = np.zeros((1, cfg.prefill_chunk), np.int32)
@@ -272,12 +552,19 @@ class ServingEngine:
         if _obs.enabled():
             _obs.registry.counter("serving.prefill_tokens").inc(n)
         if chunk.last:
-            req.state = RUNNING
             req.first_token_at = time.monotonic()
             if _obs.enabled():
                 _obs.registry.histogram("serving.ttft").observe(
                     req.first_token_at - req.arrival)
-            self._emit(req, int(nxt))
+            if req.handoff:
+                # disaggregated prefill: park for take_handoff() — the
+                # pages stay resident until the payload is exported
+                req.state = HANDOFF
+                req.handoff_token = int(nxt)
+                self._handoff_ready.append(req)
+            else:
+                req.state = RUNNING
+                self._emit(req, int(nxt))
 
     def _run_decode(self, running: List[Request]) -> None:
         cfg = self.config

@@ -25,12 +25,17 @@ from typing import Deque, Dict, List, Optional, Tuple
 from .block_manager import BlockManager
 
 __all__ = ["Request", "Scheduler", "PrefillChunk",
-           "WAITING", "PREFILL", "RUNNING", "FINISHED", "CANCELLED"]
+           "WAITING", "PREFILL", "RUNNING", "HANDOFF", "FINISHED",
+           "CANCELLED"]
 
-# request lifecycle states; preemption maps RUNNING/PREFILL -> WAITING
+# request lifecycle states; preemption maps RUNNING/PREFILL -> WAITING.
+# HANDOFF is the disaggregated-prefill terminal-on-this-engine state: the
+# prompt KV is resident and the first token sampled, but decode happens
+# on ANOTHER engine after the cluster layer exports the pages.
 WAITING = "waiting"
 PREFILL = "prefill"
 RUNNING = "running"
+HANDOFF = "handoff"
 FINISHED = "finished"
 CANCELLED = "cancelled"
 
@@ -59,6 +64,8 @@ class Request:
     preemptions: int = 0
     first_token_at: Optional[float] = None
     finish_reason: Optional[str] = None
+    handoff: bool = False        # disagg: stop after prefill + 1st token
+    handoff_token: Optional[int] = None  # the sampled 1st token
 
     def __post_init__(self) -> None:
         if not self.prompt:
@@ -176,6 +183,18 @@ class Scheduler:
             admitted.append(req)
         return admitted
 
+    def place_running(self, req: Request, blocks: List[int]) -> None:
+        """Seat an externally-prefilled request (disaggregated handoff)
+        straight into a decode slot: its KV pages were imported by the
+        engine, so it skips WAITING/PREFILL entirely."""
+        if not self._free_slots:
+            raise RuntimeError("no free slot for adopted request")
+        req.blocks = list(blocks)
+        req.prefilled = len(req.prompt)
+        req.slot = self._free_slots.pop()
+        self.slots[req.slot] = req
+        req.state = RUNNING
+
     def next_prefill(self) -> Optional[PrefillChunk]:
         """The oldest slot still prefilling gets one chunk this step."""
         cands = [r for r in self.slots.values() if r.state == PREFILL]
@@ -247,7 +266,7 @@ class Scheduler:
             set(range(self.max_slots))
         for s, r in self.slots.items():
             assert r.slot == s
-            assert r.state in (PREFILL, RUNNING)
+            assert r.state in (PREFILL, RUNNING, HANDOFF)
         for r in self.waiting:
             assert r.state == WAITING
             assert not r.blocks and r.slot == -1

@@ -1,5 +1,6 @@
 """Tier-1 wiring for tools/serve_smoke.py: the serving engine's
-parity/compile/leak smoke runs inside the suite."""
+parity/compile/leak smoke AND the cluster arm (2 replicas, seeded
+replica kill, replay parity) run inside the suite."""
 import os
 import sys
 
@@ -10,3 +11,7 @@ import serve_smoke  # noqa: E402
 
 def test_serve_smoke_passes():
     assert serve_smoke.main() == 0
+
+
+def test_serve_smoke_cluster_passes():
+    assert serve_smoke.main_cluster() == 0

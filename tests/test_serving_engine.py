@@ -12,7 +12,7 @@ from paddle_tpu.distributed.resilience import faults
 from paddle_tpu.models.generation import _sample
 from paddle_tpu.serving import (BlockManager, Request, RequestError,
                                 Scheduler, ServingEngine)
-from paddle_tpu.serving.scheduler import RUNNING, WAITING
+from paddle_tpu.serving.scheduler import FINISHED, RUNNING, WAITING
 
 
 @pytest.fixture(scope="module")
@@ -261,6 +261,86 @@ class TestSchedulerProperties:
         sch.cancel(a)
         sch.cancel(b)
         bm.assert_no_leaks()
+
+
+class TestWatermarkProgress:
+    """Satellite: watermark admission can never deadlock. The ctor
+    clamp keeps ``watermark_blocks <= num_blocks - 1`` on tiny pools
+    (where ``int(w * nb)`` rounding could otherwise reserve the whole
+    pool), and admission + youngest-first preemption always let at
+    least one running request progress — so every accepted request
+    finishes."""
+
+    def test_tiny_pool_clamp_keeps_one_block_allocatable(self):
+        for nb in range(1, 7):
+            for wm in (0.0, 0.05, 0.3, 0.5, 0.9, 1.0, 1.5):
+                bm = BlockManager(nb, 4, watermark=wm)
+                assert bm.watermark_blocks <= nb - 1, (nb, wm)
+                assert bm.can_allocate(1), (nb, wm)
+
+    @pytest.mark.parametrize("seed", list(range(8)))
+    def test_admitted_requests_always_finish(self, seed):
+        """Array-free drive loop over random tiny pools and request
+        mixes: anything the watermark admits must drain within a
+        generous step bound, with invariants held at every step."""
+        rng = np.random.RandomState(seed)
+        bs = 4
+        nb = int(rng.randint(2, 10))
+        wm = float(rng.choice([0.05, 0.2, 0.5, 0.9]))
+        bm = BlockManager(nb, bs, watermark=wm,
+                          enable_prefix_cache=False)
+        sch = Scheduler(bm, max_slots=int(rng.randint(1, 4)),
+                        prefill_chunk=8, max_seq_len=nb * bs)
+        # only generate requests the pool can EVER admit: a preemption
+        # folds generated tokens into the prompt, so re-admission needs
+        # blocks for the FULL final length above the watermark
+        cap = nb - bm.watermark_blocks
+        reqs = []
+        t = 0.0
+        for _ in range(8):
+            for _try in range(30):
+                plen = int(rng.randint(1, nb * bs))
+                mnew = int(rng.randint(1, 8))
+                if bm.blocks_for_tokens(plen + mnew) <= cap:
+                    break
+            else:
+                continue
+            t += 1.0
+            r = Request(prompt=rng.randint(0, 99, plen).tolist(),
+                        max_new_tokens=mnew, arrival=t)
+            sch.add(r)
+            reqs.append(r)
+        assert reqs, "seed produced no admissible requests"
+        steps = 0
+        while any(r.state != FINISHED for r in reqs):
+            steps += 1
+            assert steps < 2000, \
+                "watermark admission deadlocked: %r" % (
+                    [(r.state, len(r.prompt), r.remaining)
+                     for r in reqs],)
+            sch.admit()
+            chunk = sch.next_prefill()
+            if chunk is not None:
+                chunk.req.prefilled = chunk.start + len(chunk.tokens)
+                if chunk.last:
+                    chunk.req.state = RUNNING
+                    chunk.req.generated.append(int(rng.randint(99)))
+                    chunk.req.remaining -= 1
+            sch.ensure_decode_blocks()
+            for r in sch.running():
+                if r.remaining <= 0:
+                    sch.finish(r, "length")
+                    continue
+                r.generated.append(int(rng.randint(99)))
+                r.remaining -= 1
+            for r in sch.running():
+                if r.remaining <= 0:
+                    sch.finish(r, "length")
+            sch.assert_consistent()
+            bm.assert_no_leaks()
+        assert all(r.finish_reason == "length" for r in reqs)
+        bm.assert_no_leaks()
+        assert bm.num_free() == nb
 
 
 # ------------------------------------------------------------- engine e2e

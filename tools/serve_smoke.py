@@ -3,17 +3,24 @@
 exactly reproduce per-request ``generate()`` greedy streams with one
 decode-step compile and a fully drained block pool.
 
-Importable (``main()`` returns 0/raises) so tests/test_serve_smoke.py
-runs it inside the tier-1 suite; also runnable standalone:
+``--cluster`` runs the multi-replica arm instead: two in-process
+replicas behind the prefix-affinity router, a seeded fault-plan kill of
+one replica mid-flight (``cluster.replica:kill@N``), and asserts the
+drained-and-replayed streams still match the single-engine references
+token for token.
 
-    JAX_PLATFORMS=cpu python tools/serve_smoke.py
+Importable (``main()`` returns 0/raises) so tests/test_serve_smoke.py
+runs both arms inside the tier-1 suite; also runnable standalone:
+
+    JAX_PLATFORMS=cpu python tools/serve_smoke.py [--cluster]
 """
 from __future__ import annotations
 
+import os
 import sys
 
 
-def main() -> int:
+def _build(n_prompts=2):
     import numpy as np
 
     import paddle_tpu as pt
@@ -24,10 +31,15 @@ def main() -> int:
     model.eval()
     rng = np.random.RandomState(0)
     prompts = [rng.randint(0, cfg.vocab_size, n).tolist()
-               for n in (5, 11)]
+               for n in (5, 11, 7, 9)[:n_prompts]]
     refs = [model.generate(pt.to_tensor(np.asarray([p], np.int64)),
                            max_new_tokens=6).numpy()[0].tolist()
             for p in prompts]
+    return pt, model, prompts, refs
+
+
+def main() -> int:
+    pt, model, prompts, refs = _build()
 
     eng = pt.serving.ServingEngine(model, max_slots=2, block_size=8,
                                    num_blocks=32, prefill_chunk=8)
@@ -47,5 +59,44 @@ def main() -> int:
     return 0
 
 
+def main_cluster() -> int:
+    from paddle_tpu.distributed.resilience import faults
+    from paddle_tpu.serving.cluster import ClusterRouter, Replica
+
+    pt, model, prompts, refs = _build(n_prompts=4)
+    reps = [Replica("r%d" % i, model, max_slots=2, block_size=8,
+                    num_blocks=32, prefill_chunk=8) for i in range(2)]
+    for r in reps:
+        r.warmup()                       # both jits traced pre-traffic
+    router = ClusterRouter(reps)
+
+    # the 5th replica step across the cluster kills whichever replica
+    # the round-robin lands on, mid-flight — seeded + deterministic
+    faults.configure("cluster.replica:kill@5", seed=0)
+    try:
+        crids = [router.submit(p, max_new_tokens=6) for p in prompts]
+        steps = 0
+        while router.step():
+            steps += 1
+            assert steps < 400, "router failed to drain"
+        outs = [router.result(c) for c in crids]
+    finally:
+        faults.reset()
+    assert router.num_alive() == 1, "seeded kill did not land"
+    assert outs == refs, \
+        "replayed streams != generate(): %r vs %r" % (outs, refs)
+    for r in reps:
+        assert r.engine.decode_compiles == 1, \
+            "replica %s compiled decode %d times" \
+            % (r.name, r.engine.decode_compiles)
+    router.shutdown()                    # raises on survivor block leak
+    print("serve_smoke --cluster: %d requests, %d steps, 1 replica "
+          "killed, replay parity OK, 1 decode compile/replica"
+          % (len(prompts), steps))
+    return 0
+
+
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), os.pardir))
+    sys.exit(main_cluster() if "--cluster" in sys.argv else main())
