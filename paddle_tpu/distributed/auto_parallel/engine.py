@@ -33,6 +33,7 @@ strictly better than sequenced sub-programs.
 """
 from __future__ import annotations
 
+import os
 from typing import Callable, Optional, Sequence
 
 import numpy as np
@@ -438,7 +439,7 @@ class Engine:
     def fit(self, train_data, epochs=1, batch_size=None,
             steps_per_epoch=None, log_freq=10, verbose=0,
             save_dir=None, save_freq=None, resume=False,
-            keep_last=3, save_async=True):
+            keep_last=3, save_async=True, elastic=None):
         """reference: engine.py:1529. train_data: DataLoader-like iterable
         of (inputs..., labels) batches.
 
@@ -450,7 +451,18 @@ class Engine:
         ``resume=True`` restores params, optimizer state, step counter,
         RNG and LR schedule from the newest VALID checkpoint (corrupt
         or partial ones are skipped) and replays the loader past the
-        restored step so the trajectory matches an uninterrupted run."""
+        restored step so the trajectory matches an uninterrupted run.
+
+        Elastic mode: pass an ``ElasticContext`` (or set
+        ``PADDLE_TPU_ELASTIC=1`` in a multi-rank launch) and each step
+        heartbeats the rank's membership lease and peer-replicates the
+        full train state every ``PADDLE_TPU_ELASTIC_SNAP_FREQ`` steps;
+        a membership change surfaces as a typed ``EpochChanged`` at the
+        step boundary and the Engine re-joins, re-adopts the newest
+        in-memory snapshot (disk manifest as the fallback tier when
+        ``save_dir`` is set) and retries the interrupted batch.
+        Composes with ``resume=``: the disk restore runs first, then
+        elastic snapshots start from the restored step."""
         from ... import observability as _obs
         from ...observability import health as _health
         from ..resilience import faults as _faults
@@ -467,6 +479,23 @@ class Engine:
                 lambda reason: mgr.emergency_save(
                     self._collect_state(self._last_step),
                     self._last_step, reason))
+        ectx = None
+        if elastic is not None and elastic is not False:
+            from ..elastic import ElasticContext
+
+            ectx = elastic if isinstance(elastic, ElasticContext) \
+                else ElasticContext.from_env()
+        elif os.environ.get("PADDLE_TPU_ELASTIC") == "1" and \
+                int(os.environ.get("PADDLE_TRAINERS_NUM", "1")) > 1:
+            from ..elastic import ElasticContext
+
+            ectx = ElasticContext.from_env()
+        if ectx is not None:
+            from ..elastic import EpochChanged as _EpochChanged
+
+            ectx.bind(
+                lambda: self._collect_state(self._last_step),
+                self._adopt_state)
         restored = not (resume and mgr is not None)
         global_step = 0
         try:
@@ -502,7 +531,36 @@ class Engine:
                     check_loss = _health.enabled() and not getattr(
                         self._step, "_health_on", False)
                     try:
-                        self._run_step(batch, global_step, check_loss)
+                        if ectx is None:
+                            self._run_step(batch, global_step,
+                                           check_loss)
+                        else:
+                            import time as _time
+
+                            while True:
+                                # membership changes surface here, at
+                                # the step boundary — re-join, re-adopt
+                                # the newest snapshot, retry this batch
+                                try:
+                                    ectx.step_begin(global_step)
+                                except _EpochChanged as e:
+                                    adopted = ectx.handle_epoch_change(
+                                        e, disk_restore=(
+                                            (lambda: self.
+                                             _restore_from(mgr))
+                                            if mgr is not None
+                                            else None))
+                                    if adopted is not None:
+                                        self._last_step = int(adopted)
+                                    continue
+                                break
+                            t_step = _time.perf_counter()
+                            self._run_step(batch, global_step,
+                                           check_loss)
+                            ectx.step_end(
+                                global_step,
+                                (_time.perf_counter() - t_step)
+                                * 1000.0)
                     except _health.NonFiniteError:
                         if mgr is not None:
                             mgr.emergency_save(
@@ -517,6 +575,8 @@ class Engine:
                         mgr.save(self._collect_state(global_step),
                                  global_step, blocking=not save_async)
         finally:
+            if ectx is not None:
+                ectx.stop()
             if hook_token is not None:
                 from ..resilience import emergency
 
@@ -586,6 +646,44 @@ class Engine:
             train["lr_sched"] = lr.state_dict()
         state["__train_state__"] = train
         return state
+
+    def _adopt_state(self, state) -> int:
+        """Install an in-memory snapshot produced by
+        :meth:`_collect_state` (numpy-valued after the elastic
+        transport's host conversion): params written into the live
+        tensors preserving dtype/sharding, then optimizer state, RNG
+        and LR schedule exactly as the disk restore does. Returns the
+        step the snapshot was taken at."""
+        import jax
+        import jax.numpy as jnp
+
+        train = state.get("__train_state__") or {}
+        live = dict(self.model.state_dict())
+        for k, v in state.items():
+            if k == "__train_state__":
+                continue
+            t = live.get(k)
+            if t is None:
+                continue
+            new = jnp.asarray(np.asarray(v)).astype(t._data.dtype)
+            if isinstance(t._data, jax.Array) \
+                    and hasattr(t._data, "sharding") \
+                    and len(t._data.devices()) > 1:
+                new = jax.device_put(new, t._data.sharding)
+            t._data = new
+        if hasattr(self._step, "restore_state"):
+            self._step.restore_state(opt_state=train.get("optimizer"))
+        if train.get("rng") is not None:
+            from ...core import random as _rng
+
+            _rng.set_rng_state(jnp.asarray(train["rng"]))
+        if train.get("lr_sched"):
+            from ...optimizer.lr import LRScheduler
+
+            lr = getattr(self.optimizer, "_learning_rate", None)
+            if isinstance(lr, LRScheduler):
+                lr.set_state_dict(train["lr_sched"])
+        return int(train.get("step", 0))
 
     def _restore_from(self, mgr) -> int:
         """Restore params/optimizer/RNG/step from the newest valid

@@ -1,0 +1,42 @@
+"""Elastic self-healing training (reference layer: the fleet elastic
+controller, python/paddle/distributed/fleet/elastic/ — rebuilt
+TPU-native over the job's own TCPStore).
+
+Four pieces, composable and individually testable:
+
+- :mod:`.membership` — generation-numbered group epochs: heartbeat
+  leases, missed-beat / hang detection, barrier-with-deadline epoch
+  commits, the typed :class:`EpochChanged` escape for in-flight work;
+- :mod:`.snapshots` — CRC-tagged peer-replicated in-memory
+  checkpoints over the store mailbox transport;
+- :mod:`.resharding` — deterministic param->rank remap for
+  shrink/expand (contiguous interval partition + intersection plan,
+  the 1-D form of the distributed/checkpoint shard math);
+- :mod:`.straggler` — rolling p50 step-time policy.
+
+:class:`ElasticDataParallel` composes them into a ZeRO-1 elastic
+trainer (the chaos-drill subject); :class:`ElasticContext` attaches
+the same membership + snapshot tiers to ``Engine.fit``.
+
+Env knobs: ``PADDLE_TPU_ELASTIC`` (Engine.fit opt-in),
+``PADDLE_TPU_ELASTIC_TIMEOUT`` (failure->recovery budget),
+``PADDLE_TPU_ELASTIC_SNAP_FREQ``, ``PADDLE_TPU_ELASTIC_BEAT``,
+``PADDLE_TPU_ELASTIC_STRAGGLER_FACTOR`` / ``_POLICY``,
+``PADDLE_TPU_ELASTIC_MAX_NODES``.
+"""
+from .context import ElasticContext
+from .data_parallel import ElasticDataParallel
+from .membership import ElasticConfig, EpochChanged, \
+    MembershipCoordinator
+from .resharding import merge_opt_shards, partition_ranges, \
+    plan_remap, range_for_rank, shard_opt_state
+from .snapshots import PeerReplicator, SnapshotCorrupt
+from .straggler import StragglerDetector
+
+__all__ = [
+    "ElasticConfig", "ElasticContext", "ElasticDataParallel",
+    "EpochChanged", "MembershipCoordinator", "PeerReplicator",
+    "SnapshotCorrupt", "StragglerDetector", "merge_opt_shards",
+    "partition_ranges", "plan_remap", "range_for_rank",
+    "shard_opt_state",
+]

@@ -1,0 +1,181 @@
+"""Engine.fit elastic mode: membership + peer snapshots around the
+existing training loop.
+
+``Engine.fit(elastic=...)`` (or ``PADDLE_TPU_ELASTIC=1`` with a
+multi-rank launch) attaches an :class:`ElasticContext`: each step
+heartbeats the rank's lease with its step time, pushes a CRC-tagged
+in-memory snapshot of the full per-rank train state every
+``PADDLE_TPU_ELASTIC_SNAP_FREQ`` steps, and observes membership
+changes at step boundaries as the typed ``EpochChanged`` — which the
+Engine handles by re-joining the group and re-adopting the newest
+snapshot (peer mailbox, falling back to the fit ``save_dir`` disk
+manifest when replication is insufficient).
+
+The Engine path replicates *full per-rank state* (its optimizer state
+is already per-rank); the shard-remapped ZeRO recovery lives in
+:mod:`.data_parallel`. ``resume=`` interaction: a disk resume
+(``Engine.fit(resume=True)``) restores first, then elastic snapshots
+start from the restored step — the two tiers compose, they don't
+compete.
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+from .membership import ElasticConfig, EpochChanged, \
+    MembershipCoordinator
+from .snapshots import PeerReplicator, SnapshotCorrupt, fetch_best
+
+__all__ = ["ElasticContext"]
+
+
+def _obs():
+    try:
+        from ... import observability as obs
+
+        return obs if obs.enabled() else None
+    except Exception:
+        return None
+
+
+def _to_host(state: Dict) -> Dict:
+    """Tensor-valued state dicts -> plain numpy for pickling."""
+    out = {}
+    for k, v in state.items():
+        if hasattr(v, "_data"):
+            out[k] = np.asarray(v._data)
+        else:
+            out[k] = v
+    return out
+
+
+class ElasticContext:
+    """Bound to one ``fit`` call via :meth:`bind`; the Engine drives
+    :meth:`step_begin` / :meth:`step_end` and routes ``EpochChanged``
+    to :meth:`handle_epoch_change`."""
+
+    def __init__(self, store, rank: int, world: int,
+                 config: Optional[ElasticConfig] = None,
+                 namespace: str = "elastic",
+                 watchdog_hook: bool = True):
+        self.cfg = config or ElasticConfig()
+        self.rank = int(rank)
+        self.coord = MembershipCoordinator(
+            store, self.rank, int(world), config=self.cfg,
+            namespace=namespace)
+        self.replicator = PeerReplicator(
+            store, self.rank, namespace=namespace,
+            snap_freq=self.cfg.snap_freq)
+        self._watchdog_hook = bool(watchdog_hook)
+        self._collect: Optional[Callable[[], Dict]] = None
+        self._adopt: Optional[Callable[[Dict], int]] = None
+        self._started = False
+
+    @classmethod
+    def from_env(cls) -> "ElasticContext":
+        import os
+
+        from ..store import create_or_get_global_tcp_store
+
+        return cls(create_or_get_global_tcp_store(),
+                   int(os.environ.get("PADDLE_TRAINER_ID", "0")),
+                   int(os.environ.get("PADDLE_TRAINERS_NUM", "1")))
+
+    # ----------------------------------------------------------- wiring
+    def bind(self, collect: Callable[[], Dict],
+             adopt: Callable[[Dict], int]) -> None:
+        """``collect() -> state_dict`` snapshots the live train state;
+        ``adopt(state_dict) -> step`` installs one."""
+        self._collect = collect
+        self._adopt = adopt
+
+    def start(self) -> None:
+        if self._started:
+            return
+        self.coord.register()
+        if self._watchdog_hook:
+            self.coord.install_watchdog_hook()
+        self.coord.form_initial()
+        self._started = True
+
+    def stop(self) -> None:
+        if self._started:
+            self.coord.deregister()
+            self._started = False
+
+    # ------------------------------------------------------------ steps
+    def step_begin(self, step: int) -> None:
+        if not self._started:
+            self.start()
+        self.coord.refresh_pending()
+        self.coord.poll()
+
+    def step_end(self, step: int, step_ms: float) -> None:
+        self.coord.heartbeat(step, step_ms)
+        self.maybe_snapshot(step)
+        # step-synchronous scan: joiners are admitted here (not by the
+        # timer thread) so expansions land on a gate-determined step
+        self.coord.watch_once()
+
+    # --------------------------------------------------------- recovery
+    def handle_epoch_change(self, exc: EpochChanged,
+                            disk_restore: Optional[Callable[[], int]]
+                            = None) -> Optional[int]:
+        """Re-join the group and re-adopt the newest snapshot of THIS
+        rank (own mailbox push; ``disk_restore()`` — e.g. the Engine's
+        manifest restore — as the fallback tier). Returns the step to
+        resume from, or None when no snapshot had to be re-adopted."""
+        t0 = time.monotonic()
+        while True:
+            rec = self.coord.join()
+            if self.rank in rec["members"]:
+                break
+            self.coord.clear_hang()
+            self.coord.request_join()
+            time.sleep(0.05)
+        source, step = "none", None
+        prev_rec = None
+        try:
+            prev_rec = self.coord.read_epoch(int(rec.get("prev", 0)))
+        except Exception:
+            prev_rec = None
+        if prev_rec is not None and \
+                self.rank in prev_rec.get("members", ()):
+            # continuing member of the previous epoch: the live train
+            # state is NEWER than any snapshot — the epoch change only
+            # re-scoped the group around this rank (a peer died or
+            # left). Rewinding here would replay steps for nothing.
+            source = "live"
+        elif self._adopt is not None:
+            try:
+                snap = fetch_best(self.coord.store, self.coord.ns,
+                                  self.rank, self.cfg.max_nodes)
+                if snap is not None:
+                    step = self._adopt(snap["state"])
+                    source = "peer"
+            except SnapshotCorrupt:
+                snap = None
+            if step is None and disk_restore is not None:
+                step = disk_restore()
+                source = "disk"
+        o = _obs()
+        if o:
+            o.registry.counter("elastic.recoveries",
+                               tags={"source": source}).inc()
+            o.registry.histogram("elastic.recovery_ms").observe(
+                (time.monotonic() - t0) * 1000.0)
+        return step
+
+    def snapshot_now(self, step: int) -> None:
+        if self._collect is not None:
+            self.replicator.push(step, self.coord.members,
+                                 {"state": _to_host(self._collect())})
+
+    def maybe_snapshot(self, step: int) -> None:
+        if self._collect is not None:
+            self.replicator.maybe_push(
+                step, self.coord.members,
+                lambda: {"state": _to_host(self._collect())})

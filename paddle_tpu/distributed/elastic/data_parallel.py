@@ -1,0 +1,447 @@
+"""Elastic ZeRO-1 data-parallel trainer: the training-tier analog of
+the serving cluster's drain-and-replay (PR 12).
+
+Params are replicated; the functional optimizer state is partitioned
+across the current epoch's members by the deterministic
+``resharding.partition_ranges`` layout. Each step is two
+store-transported collectives (gradient gather, updated-param
+all-gather), both **barrier-with-deadline**: every wait polls the
+membership coordinator and raises the typed :class:`EpochChanged`
+instead of hanging when a peer dies mid-step. Recovery is a pure
+function of the store: survivors (and rejoiners) restore the latest
+common peer-replicated snapshot, remap optimizer shards onto the new
+world via ``plan_remap``, and replay forward — so a shrink resumes the
+very next step, and a rejoin restores the original layout.
+
+Gradient exactness across world sizes: ``grad_fn`` returns the SUM of
+per-row losses/grads over its contiguous row shard, and the combined
+gradient divides the member-ordered total by the fixed global batch
+size — the full-batch gradient is the same mathematical quantity at
+any world size, which is what makes shrink/expand trajectories
+reproducible and drill-checkable.
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..resilience import faults as _faults
+from .membership import ElasticConfig, EpochChanged, \
+    MembershipCoordinator, try_get
+from .resharding import partition_ranges, plan_remap, range_for_rank, \
+    shard_opt_state
+from .snapshots import PeerReplicator, SnapshotCorrupt, encode, decode, \
+    fetch_best
+
+__all__ = ["ElasticDataParallel"]
+
+
+def _obs():
+    try:
+        from ... import observability as obs
+
+        return obs if obs.enabled() else None
+    except Exception:
+        return None
+
+
+class ElasticDataParallel:
+    """One instance per rank.
+
+    Parameters
+    ----------
+    store : TCPStore-like (set/get/add/check/delete)
+    rank, world_hint : this rank and the initial world size
+    params : list of np.ndarray, identical on every rank at step 0
+    grad_fn : ``(params, X, Y) -> (loss_sum, [grad_sum, ...])`` over a
+        row shard (sums, not means — see module docstring)
+    data_fn : ``step -> (X, Y)`` the full deterministic global batch
+    optimizer : functional optimizer (``init_state`` / ``update``)
+    ckpt_mgr : optional CheckpointManager for the disk fallback
+    rejoin : True when this process replaces a dead rank mid-job
+    expand_at : admit joiners only once the group has reached this step
+        (pins the expansion point so trajectories replay exactly)
+    """
+
+    def __init__(self, store, rank: int, world_hint: int,
+                 params: Sequence[np.ndarray],
+                 grad_fn: Callable, data_fn: Callable, optimizer,
+                 lr: Optional[float] = None,
+                 config: Optional[ElasticConfig] = None,
+                 ckpt_mgr=None, rejoin: bool = False,
+                 expand_at: Optional[int] = None,
+                 namespace: str = "elastic",
+                 watchdog_hook: bool = False):
+        self.store = store
+        self.rank = int(rank)
+        self.cfg = config or ElasticConfig()
+        self.params: List[np.ndarray] = [np.asarray(p) for p in params]
+        self.grad_fn = grad_fn
+        self.data_fn = data_fn
+        self.optimizer = optimizer
+        self.lr = lr
+        self.ckpt_mgr = ckpt_mgr
+        self.rejoin = bool(rejoin)
+        self.ns = namespace
+        self._watchdog_hook = bool(watchdog_hook)
+        self.coord = MembershipCoordinator(
+            store, self.rank, world_hint, config=self.cfg,
+            namespace=namespace)
+        self.replicator = PeerReplicator(
+            store, self.rank, namespace=namespace,
+            snap_freq=self.cfg.snap_freq)
+        if expand_at is not None:
+            self.coord.set_expand_gate(int(expand_at))
+        self.opt_shard: Optional[Dict] = None
+        self.steps_done = 0
+        self.history: List[float] = []
+        self.epoch_log: List[Dict] = []     # committed epoch timeline
+        self.recoveries: List[Dict] = []    # source/step/latency rows
+        self._booted = False
+
+    # ---------------------------------------------------------- keys
+    def _xkey(self, epoch: int, tag: str, step: int, rank: int) -> str:
+        return f"{self.ns}/x/{epoch}/{tag}/{step}/{rank}"
+
+    # ------------------------------------------------------ bootstrap
+    def _sizes(self) -> List[int]:
+        return [int(p.size) for p in self.params]
+
+    def _my_range(self):
+        return range_for_rank(self._sizes(), self.coord.members,
+                              self.rank)
+
+    def _snapshot_payload(self) -> Dict:
+        lo, hi = self._my_range()
+        return {"params": [np.asarray(p) for p in self.params],
+                "range": (lo, hi),
+                "opt_shard": {
+                    k: [np.asarray(e) for e in v]
+                    if isinstance(v, (list, tuple)) else np.asarray(v)
+                    for k, v in (self.opt_shard or {}).items()}}
+
+    def _log_epoch(self, rec: Dict) -> None:
+        self.epoch_log.append({"epoch": rec["epoch"],
+                               "members": list(rec["members"]),
+                               "from_step": self.steps_done + 1,
+                               "reason": rec.get("reason", "")})
+
+    def _bootstrap(self) -> None:
+        self.coord.register()
+        if self._watchdog_hook:
+            self.coord.install_watchdog_hook()
+        if self.rejoin:
+            self.coord.request_join()
+            while True:
+                rec = self.coord.join()
+                if self.rank in rec["members"]:
+                    break
+                time.sleep(0.05)
+            self._adopt(rec)
+        else:
+            rec = self.coord.form_initial()
+            if self.rank not in rec["members"]:
+                raise RuntimeError(
+                    f"rank {self.rank} excluded from initial epoch "
+                    f"{rec}")
+            lo, hi = self._my_range()
+            full = self.optimizer.init_state(
+                [np.asarray(p) for p in self.params])
+            self.opt_shard = shard_opt_state(full, lo, hi,
+                                             len(self.params))
+            self._log_epoch(rec)
+            # seed the replica ring before the first step so a kill at
+            # step 1 is already recoverable from peer memory
+            self.replicator.push(0, self.coord.members,
+                                 self._snapshot_payload())
+        self._booted = True
+
+    # ----------------------------------------------------- collectives
+    def _gather(self, tag: str, step: int, payload: bytes
+                ) -> Dict[int, Dict]:
+        """Post mine, collect everyone's — deadline-bounded, epoch-aware
+        (the typed-escape path the watchdog can only approximate for
+        opaque device collectives)."""
+        epoch = self.coord.epoch
+        members = list(self.coord.members)
+        self.store.set(self._xkey(epoch, tag, step, self.rank), payload)
+        deadline = time.monotonic() + self.cfg.collective_deadline
+        out: Dict[int, Dict] = {}
+        lease_checked = 0.0
+        for r in members:
+            key = self._xkey(epoch, tag, step, r)
+            raw = None
+            while raw is None:
+                raw = try_get(self.store, key)
+                if raw is not None:
+                    break
+                # hang_only: a pending proposal must not tear the step
+                # mid-collective — a dead peer is caught by the lease
+                # probe below or, worst case, the deadline
+                self.coord.poll(hang_only=True)
+                now = time.monotonic()
+                if r != self.rank and now - lease_checked > 0.1:
+                    lease_checked = now
+                    if not self.coord.lease_fresh(r):
+                        self.coord.suspect(r, f"{tag}@{step}")
+                        raise EpochChanged(
+                            self.coord.refresh_pending(),
+                            f"peer {r} lease expired during "
+                            f"{tag}@{step}")
+                if now > deadline:
+                    self.coord.suspect(r, f"{tag}@{step}")
+                    raise EpochChanged(
+                        self.coord.refresh_pending(),
+                        f"peer {r} missed {tag}@{step} within "
+                        f"{self.cfg.collective_deadline}s")
+                time.sleep(0.005)
+            out[r] = decode(raw)
+            if "__epoch_abort__" in out[r]:
+                # the peer bailed out at its step boundary for an epoch
+                # change and left this marker so we escape NOW instead
+                # of sitting out the collective deadline
+                raise EpochChanged(
+                    self.coord.refresh_pending(),
+                    f"peer {r} aborted {tag}@{step} for epoch change")
+        # everyone has read step-1 keys once they posted step: reclaim
+        if step > 1:
+            try:
+                self.store.delete(
+                    self._xkey(epoch, tag, step - 1, self.rank))
+            except Exception:
+                pass
+        return out
+
+    # ------------------------------------------------------- training
+    def _train_one(self, step: int) -> float:
+        members = sorted(self.coord.members)
+        X, Y = self.data_fn(step)
+        batch = int(len(X))
+        rows = partition_ranges([1] * batch, len(members))
+        rlo, rhi = rows[members.index(self.rank)]
+        loss_sum, grad_sums = self.grad_fn(self.params, X[rlo:rhi],
+                                           Y[rlo:rhi])
+        blob = encode({"loss": float(loss_sum),
+                       "grads": [np.asarray(g, np.float32)
+                                 for g in grad_sums]})
+        got = self._gather("g", step, blob)
+        loss = sum(got[r]["loss"] for r in members) / batch
+        grads: List[np.ndarray] = []
+        for j in range(len(self.params)):
+            tot = got[members[0]]["grads"][j].astype(np.float32).copy()
+            for r in members[1:]:
+                tot += got[r]["grads"][j]
+            grads.append(tot / batch)
+        lo, hi = self._my_range()
+        new_slice, self.opt_shard = self.optimizer.update(
+            [np.asarray(self.params[k], np.float32)
+             for k in range(lo, hi)],
+            grads[lo:hi], self.opt_shard, lr=self.lr)
+        pblob = encode({"range": (lo, hi),
+                        "params": [np.asarray(p, np.float32)
+                                   for p in new_slice]})
+        pg = self._gather("p", step, pblob)
+        for r in members:
+            plo, phi = pg[r]["range"]
+            for k, arr in zip(range(plo, phi), pg[r]["params"]):
+                self.params[k] = arr
+        return float(loss)
+
+    def run(self, total_steps: int) -> List[float]:
+        while self.steps_done < int(total_steps):
+            try:
+                if not self._booted:
+                    self._bootstrap()
+                    continue
+                step = self.steps_done + 1
+                self.coord.refresh_pending()
+                self.coord.poll()
+                act = _faults.check("engine.step")
+                if act is not None:
+                    _faults.apply(act)
+                t0 = time.perf_counter()
+                loss = self._train_one(step)
+                step_ms = (time.perf_counter() - t0) * 1000.0
+                self.steps_done = step
+                self.history.append(loss)
+                self.coord.heartbeat(step, step_ms)
+                self.replicator.maybe_push(step, self.coord.members,
+                                           self._snapshot_payload)
+                # step-synchronous membership scan: joiners are folded
+                # in HERE (not by the timer thread), so the expansion
+                # step is pinned by the gate alone
+                self.coord.watch_once()
+            except EpochChanged as e:
+                self._post_abort_marker()
+                self._recover(e)
+        return self.history
+
+    # ------------------------------------------------------- recovery
+    def _post_abort_marker(self) -> None:
+        """Before recovering, leave a tombstone in the next step's
+        gather slot (only if no real payload is there): a peer already
+        waiting inside that collective reads it and escapes immediately
+        and at the SAME step, instead of burning the full deadline."""
+        key = self._xkey(self.coord.epoch, "g", self.steps_done + 1,
+                         self.rank)
+        try:
+            if not self.store.check(key):
+                self.store.set(key, encode({"__epoch_abort__": True}))
+        except Exception:
+            pass
+
+    def _recover(self, exc: EpochChanged) -> None:
+        t0 = time.monotonic()
+        while True:
+            rec = self.coord.join()
+            if self.rank in rec["members"]:
+                break
+            # excluded (hang/demotion): drop state, rejoin as fresh
+            self.coord.clear_hang()
+            self.coord.request_join()
+            time.sleep(0.05)
+        source = self._adopt(rec)
+        dt_ms = (time.monotonic() - t0) * 1000.0
+        self.recoveries.append({"epoch": rec["epoch"],
+                                "source": source,
+                                "resume_step": self.steps_done + 1,
+                                "latency_ms": dt_ms,
+                                "reason": str(exc)})
+        o = _obs()
+        if o:
+            o.registry.counter("elastic.recoveries",
+                               tags={"source": source}).inc()
+            o.registry.histogram("elastic.recovery_ms").observe(dt_ms)
+
+    def _adopt(self, rec: Dict) -> str:
+        """Restore params + resharded optimizer state for the committed
+        epoch ``rec``; returns the recovery source ("peer" or "disk")."""
+        o = _obs()
+        span = o.span("elastic.reshard",
+                      args={"epoch": rec["epoch"]}) if o else None
+        if span:
+            span.__enter__()
+        try:
+            prev = self.coord.read_epoch(int(rec.get("prev") or 0))
+            old_members = sorted(prev["members"]) if prev else \
+                sorted(rec["members"])
+            try:
+                source = self._adopt_from_peers(rec, old_members)
+            except (SnapshotCorrupt, KeyError, ValueError) as e:
+                import sys
+
+                print(f"[elastic] peer recovery unavailable ({e}); "
+                      "falling back to disk", file=sys.stderr)
+                source = self._adopt_from_disk(rec)
+            self._log_epoch(rec)
+            # re-seed the ring under the new membership immediately so
+            # a second failure before the next snap stays recoverable
+            self.replicator.push(self.steps_done, rec["members"],
+                                 self._snapshot_payload())
+            return source
+        finally:
+            if span:
+                span.__exit__(None, None, None)
+
+    def _adopt_from_peers(self, rec: Dict,
+                          old_members: List[int]) -> str:
+        snaps: Dict[int, Dict] = {}
+        for src in old_members:
+            got = fetch_best(self.store, self.ns, src,
+                             self.cfg.max_nodes)
+            if got is None:
+                raise KeyError(f"no peer snapshot for old rank {src}")
+            snaps[src] = got
+        steps = {s["step"] for s in snaps.values()}
+        if len(steps) != 1:
+            raise ValueError(
+                f"peer snapshots disagree on step: {sorted(steps)}")
+        step = steps.pop()
+        self._adopt_payloads(rec, old_members, snaps)
+        self.steps_done = int(step)
+        self.history = self.history[:int(step)]
+        return "peer"
+
+    def _adopt_payloads(self, rec: Dict, old_members: List[int],
+                        snaps: Dict[int, Dict]) -> None:
+        self.params = [np.asarray(p) for p in
+                       snaps[min(old_members)]["params"]]
+        sizes = self._sizes()
+        n = len(self.params)
+        old_parts = [tuple(snaps[src]["range"]) for src in old_members]
+        new_members = sorted(rec["members"])
+        new_parts = partition_ranges(sizes, len(new_members))
+        plan = plan_remap(old_parts, new_parts)
+        pieces = plan[new_members.index(self.rank)]
+        shard: Dict = {}
+        for oi, lo, hi in pieces:
+            src = old_members[oi]
+            olo, _ = old_parts[oi]
+            part = shard_opt_state(snaps[src]["opt_shard"],
+                                   lo - olo, hi - olo,
+                                   old_parts[oi][1] - olo)
+            for k, v in part.items():
+                if isinstance(v, list):
+                    shard.setdefault(k, []).extend(v)
+                else:
+                    shard[k] = v
+        if not pieces:
+            # empty new range: scalars from any old shard, empty lists
+            any_shard = snaps[min(old_members)]["opt_shard"]
+            shard = {k: ([] if isinstance(v, (list, tuple)) else v)
+                     for k, v in any_shard.items()}
+        self.opt_shard = shard
+
+    def _adopt_from_disk(self, rec: Dict) -> str:
+        if self.ckpt_mgr is None:
+            raise RuntimeError(
+                "peer replication insufficient and no CheckpointManager "
+                "configured for disk fallback")
+        found = self.ckpt_mgr.latest_valid()
+        if found is None:
+            raise RuntimeError(
+                "peer replication insufficient and no valid disk "
+                "checkpoint to fall back to")
+        _, path = found
+        state = {"__elastic_state__": None}
+        self.ckpt_mgr.load(state, path)
+        payload = state["__elastic_state__"]
+        self.params = [np.asarray(p) for p in payload["params"]]
+        lo, hi = range_for_rank(self._sizes(), rec["members"],
+                                self.rank)
+        self.opt_shard = shard_opt_state(payload["opt"], lo, hi,
+                                         len(self.params))
+        self.steps_done = int(payload["step"])
+        self.history = self.history[:self.steps_done]
+        return "disk"
+
+    # ----------------------------------------------------- disk saves
+    def save_disk(self, step: int) -> None:
+        """Gather the full optimizer state and have the lowest member
+        write one CRC-manifested disk checkpoint — the PR 3 fallback
+        tier under the in-memory replication."""
+        if self.ckpt_mgr is None:
+            return
+        members = sorted(self.coord.members)
+        lo, hi = self._my_range()
+        blob = encode({"range": (lo, hi),
+                       "opt_shard": self._snapshot_payload()
+                       ["opt_shard"]})
+        got = self._gather("opt", step, blob)
+        if self.rank != min(members):
+            return
+        from .resharding import merge_opt_shards
+
+        full = merge_opt_shards(
+            [(tuple(got[r]["range"]), got[r]["opt_shard"])
+             for r in members], len(self.params))
+        self.ckpt_mgr.save(
+            {"__elastic_state__": {
+                "params": [np.asarray(p) for p in self.params],
+                "opt": full, "step": int(step)}},
+            step, blocking=True)
+
+    def shutdown(self) -> None:
+        self.coord.deregister()

@@ -1,0 +1,674 @@
+"""Membership coordinator: generation-numbered group epochs over the
+TCPStore (reference: python/paddle/distributed/fleet/elastic/manager.py
+ElasticManager etcd registry + CollectiveElasticController, rebuilt
+TPU-native on the job's own KV store — no etcd dependency).
+
+Protocol (all keys under one namespace, default ``elastic``):
+
+- every member heartbeats a *lease* (``beat/{rank}`` = JSON ``{t, step,
+  step_ms}``) every ``ElasticConfig.beat_interval`` seconds; a lease
+  older than ``ElasticConfig.timeout`` is expired;
+- the **acting coordinator** is the lowest-ranked member with a fresh
+  lease — when it dies, the next-lowest member's scan takes over
+  automatically (deputy failover, no election round needed);
+- membership changes are **epochs**: the coordinator allocates a
+  monotone epoch number from the ``seq`` counter (store ADD — the same
+  primitive the restart-generation channel uses), publishes the member
+  list at ``epoch/{n}`` and advertises it at ``propose``; members ack
+  (``epoch/{n}/ack/{rank}``), the lowest member of the NEW list commits
+  (``epoch/{n}/commit`` + ``cur``) once every ack has landed. Each wait
+  in that handshake carries a deadline, so a member that dies mid-join
+  shrinks the proposal instead of wedging it;
+- in-flight training work observes a pending epoch through
+  :meth:`MembershipCoordinator.poll`, which raises the typed
+  :class:`EpochChanged` — collectives built on the store poll it inside
+  their wait loops, so a membership change surfaces as a catchable
+  error, never a hang;
+- a watchdog-reported hang (``hang/{rank}``, fed by the
+  ``emergency.abort`` interceptor installed by
+  :meth:`install_watchdog_hook`) and a straggler demotion
+  (``demote/{rank}``) are treated like missed beats at the next scan.
+
+Fault sites: ``elastic.heartbeat`` (``drop`` skips one beat) and
+``elastic.epoch_commit`` (``delay`` holds the commit past a member's
+deadline) make membership races injectable and deterministic.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from ..resilience import faults as _faults
+from .straggler import StragglerDetector
+
+__all__ = ["ElasticConfig", "EpochChanged", "MembershipCoordinator",
+           "read_beat", "scan_beats", "try_get"]
+
+
+class EpochChanged(RuntimeError):
+    """The group membership changed while work was in flight. Carries
+    the highest epoch proposal seen; callers re-join via
+    :meth:`MembershipCoordinator.join` and resume under the new epoch.
+    """
+
+    def __init__(self, epoch: int, reason: str = ""):
+        super().__init__(
+            f"group epoch changed (epoch={epoch}): {reason}")
+        self.epoch = epoch
+        self.reason = reason
+
+
+class ElasticConfig:
+    """Env-tunable knobs (``PADDLE_TPU_ELASTIC_*``)."""
+
+    def __init__(self, beat_interval: Optional[float] = None,
+                 timeout: Optional[float] = None,
+                 snap_freq: Optional[int] = None,
+                 straggler_factor: Optional[float] = None,
+                 straggler_policy: Optional[str] = None,
+                 max_nodes: Optional[int] = None):
+        env = os.environ.get
+        self.beat_interval = float(
+            beat_interval if beat_interval is not None
+            else env("PADDLE_TPU_ELASTIC_BEAT", "0.5"))
+        # the whole failure->recovery budget. Derived deadlines nest
+        # inside it: leases expire at 0.5x (so the coordinator can
+        # already propose by the time a collective gives up at 0.75x),
+        # join-barrier waits get the full budget.
+        self.timeout = float(
+            timeout if timeout is not None
+            else env("PADDLE_TPU_ELASTIC_TIMEOUT", "10.0"))
+        self.snap_freq = int(
+            snap_freq if snap_freq is not None
+            else env("PADDLE_TPU_ELASTIC_SNAP_FREQ", "10"))
+        self.straggler_factor = float(
+            straggler_factor if straggler_factor is not None
+            else env("PADDLE_TPU_ELASTIC_STRAGGLER_FACTOR", "3.0"))
+        # "flag" records telemetry only; "demote" drops flagged ranks
+        # from the next epoch
+        self.straggler_policy = (
+            straggler_policy if straggler_policy is not None
+            else env("PADDLE_TPU_ELASTIC_STRAGGLER_POLICY", "flag"))
+        self.max_nodes = int(
+            max_nodes if max_nodes is not None
+            else env("PADDLE_TPU_ELASTIC_MAX_NODES", "16"))
+
+    @property
+    def lease_timeout(self) -> float:
+        return 0.5 * self.timeout
+
+    @property
+    def collective_deadline(self) -> float:
+        return 0.75 * self.timeout
+
+
+def _obs():
+    try:
+        from ... import observability as obs
+
+        return obs if obs.enabled() else None
+    except Exception:
+        return None
+
+
+def try_get(store, key: str) -> Optional[bytes]:
+    """Atomic get-or-None through the store's ``try_get`` when it has
+    one (``TCPStore``/``PrefixStore``); check-then-get otherwise (fake
+    stores in tests). Deletable keys — leases, registries, mailboxes —
+    MUST be read this way: check-then-get races a concurrent delete and
+    the blocking ``get`` then stalls for the full store timeout."""
+    fn = getattr(store, "try_get", None)
+    if fn is not None:
+        return fn(key)
+    if not store.check(key):
+        return None
+    return store.get(key)
+
+
+def read_beat(store, ns: str, rank: int) -> Optional[dict]:
+    """Decode one rank's lease, or None (never set / undecodable)."""
+    try:
+        raw = try_get(store, f"{ns}/beat/{rank}")
+        if raw is None:
+            return None
+        return json.loads(raw.decode())
+    except Exception:
+        return None
+
+
+def scan_beats(store, ns: str, ranks, now: float,
+               timeout: float) -> Dict[int, Optional[dict]]:
+    """``{rank: beat_or_None}`` where expired leases map to None."""
+    out: Dict[int, Optional[dict]] = {}
+    for r in ranks:
+        b = read_beat(store, ns, r)
+        if b is not None and now - float(b.get("t", 0.0)) > timeout:
+            b = None
+        out[r] = b
+    return out
+
+
+class MembershipCoordinator:
+    """One per rank. Every rank runs the same scan logic; acting as THE
+    coordinator is a property of the current lease table (lowest fresh
+    rank), not a fixed role — that is what makes failover free."""
+
+    def __init__(self, store, rank: int, world_hint: int,
+                 config: Optional[ElasticConfig] = None,
+                 clock: Callable[[], float] = time.time,
+                 namespace: str = "elastic"):
+        self.store = store
+        self.rank = int(rank)
+        self.world_hint = int(world_hint)
+        self.cfg = config or ElasticConfig()
+        self.clock = clock
+        self.ns = namespace
+        self.epoch = 0
+        self.members: List[int] = []
+        self.on_fault: Optional[Callable[[List[int]], None]] = None
+        self.on_straggler: Optional[Callable[[List[int]], None]] = None
+        self.detector = StragglerDetector(
+            factor=self.cfg.straggler_factor)
+        self._pending = 0           # highest proposal number seen
+        self._hang: Optional[str] = None
+        self._last_step = 0
+        self._last_step_ms: Optional[float] = None
+        self._expand_gate = 0       # joiners admitted once step >= gate
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._abort_token: Optional[int] = None
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------ keys
+    def _k(self, *parts) -> str:
+        return "/".join([self.ns] + [str(p) for p in parts])
+
+    # ----------------------------------------------------------- lease
+    def register(self, start_threads: bool = True) -> None:
+        try:
+            # returning after a clean leave: clear the departure marker
+            self.store.delete(self._k("left", self.rank))
+        except Exception:
+            pass
+        self.store.set(self._k("nodes", self.rank),
+                       json.dumps({"pid": os.getpid(),
+                                   "t": self.clock()}).encode())
+        self.beat()
+        if start_threads:
+            for fn in (self._beat_loop, self._watch_loop):
+                t = threading.Thread(target=fn, daemon=True)
+                t.start()
+                self._threads.append(t)
+
+    def deregister(self) -> None:
+        """Stop the background threads (joined with a timeout) and
+        delete this rank's registry + lease keys so a clean exit is not
+        reported as a fault. A ``left`` marker tells the survivors this
+        was a planned departure: they shrink immediately with reason
+        ``left`` instead of waiting out the lease and calling it a
+        missed beat."""
+        try:
+            self.store.set(self._k("left", self.rank),
+                           json.dumps({"t": self.clock()}).encode())
+        except Exception:
+            pass
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=2 * self.cfg.beat_interval + 1.0)
+        self._threads = []
+        if self._abort_token is not None:
+            from ..resilience import emergency
+
+            emergency.unregister_abort(self._abort_token)
+            self._abort_token = None
+        for key in (self._k("nodes", self.rank),
+                    self._k("beat", self.rank)):
+            try:
+                self.store.delete(key)
+            except Exception:
+                pass
+
+    def beat(self) -> None:
+        """Write one lease beat. Fault site ``elastic.heartbeat``:
+        ``drop`` skips the write (a lost beat on the wire)."""
+        act = _faults.check("elastic.heartbeat")
+        if act is not None:
+            if act.kind == "drop":
+                return
+            _faults.apply(act)
+        with self._lock:
+            payload = {"t": self.clock(), "step": self._last_step,
+                       "step_ms": self._last_step_ms}
+        self.store.set(self._k("beat", self.rank),
+                       json.dumps(payload).encode())
+        o = _obs()
+        if o:
+            o.registry.counter("elastic.heartbeats").inc()
+
+    def heartbeat(self, step: int,
+                  step_ms: Optional[float] = None) -> None:
+        """Training-loop beat: records progress + step-time telemetry
+        on top of the background lease."""
+        with self._lock:
+            self._last_step = int(step)
+            self._last_step_ms = step_ms
+        if step_ms is not None:
+            o = _obs()
+            if o:
+                o.registry.histogram("elastic.step_ms").observe(
+                    float(step_ms))
+        self.beat()
+
+    def _beat_loop(self):
+        while not self._stop.wait(self.cfg.beat_interval):
+            try:
+                self.beat()
+            except Exception:
+                pass    # a store blip must not kill the lease thread
+
+    # ----------------------------------------------------------- watch
+    def _registered(self) -> List[int]:
+        out = []
+        for r in range(self.cfg.max_nodes):
+            try:
+                if self.store.check(self._k("nodes", r)):
+                    out.append(r)
+            except Exception:
+                pass
+        return out
+
+    def _candidates(self) -> List[int]:
+        return self.members if self.epoch > 0 else self._registered()
+
+    def i_am_acting(self, now: Optional[float] = None) -> bool:
+        """True when this rank is the lowest candidate with a fresh
+        lease (or no candidate at all has one — then the lowest rank
+        overall acts, so a fully-stale table can still make progress)."""
+        now = self.clock() if now is None else now
+        cands = self._candidates()
+        if self.rank not in cands:
+            cands = sorted(set(cands) | {self.rank})
+        beats = scan_beats(self.store, self.ns, cands, now,
+                           self.cfg.lease_timeout)
+        alive = [r for r in cands if beats[r] is not None
+                 or r == self.rank]
+        return self.rank == min(alive) if alive else True
+
+    def lease_fresh(self, rank: int, now: Optional[float] = None) -> bool:
+        """True while ``rank`` holds an unexpired heartbeat lease. The
+        safe early-escape test for collective waits: a stale lease means
+        the peer cannot post its key, so every waiter escapes on the
+        same evidence — unlike a pending proposal, which a live group
+        may drain past at different times."""
+        now = self.clock() if now is None else now
+        beat = read_beat(self.store, self.ns, rank)
+        return beat is not None and \
+            now - float(beat.get("t", 0.0)) <= self.cfg.lease_timeout
+
+    def refresh_pending(self) -> int:
+        try:
+            raw = try_get(self.store, self._k("propose"))
+            if raw is not None:
+                n = int(raw.decode())
+                with self._lock:
+                    if n > self._pending:
+                        self._pending = n
+        except Exception:
+            pass
+        return self._pending
+
+    def poll(self, hang_only: bool = False) -> None:
+        """Raise :class:`EpochChanged` if a newer epoch than the one we
+        joined has been proposed, or if this rank's own watchdog
+        reported a hang. Cheap (reads cached state maintained by the
+        watch thread). Call with ``hang_only=False`` only at STEP
+        BOUNDARIES: reacting to a merely-pending proposal mid-collective
+        would tear the step on some ranks but not others (whoever drains
+        their gather first never polls again) and desynchronise the
+        snapshot ring. Inside collective wait loops pass
+        ``hang_only=True`` — a live group drains regardless of pending
+        proposals, and a dead peer is escaped by the collective
+        deadline, not by this check."""
+        with self._lock:
+            hang, pending = self._hang, self._pending
+        if hang is not None:
+            raise EpochChanged(pending, f"hang reported: {hang}")
+        if not hang_only and pending > self.epoch:
+            raise EpochChanged(pending, "newer epoch proposed")
+
+    def suspect(self, rank: int, why: str = "") -> None:
+        """A peer looked dead from this rank's side (e.g. a collective
+        deadline expired waiting on it). Recorded for the coordinator;
+        the lease table stays the ground truth."""
+        try:
+            self.store.set(self._k("suspect", rank),
+                           json.dumps({"from": self.rank, "t":
+                                       self.clock(), "why": why}).encode())
+        except Exception:
+            pass
+
+    def report_hang(self, reason: str) -> None:
+        """Watchdog abort interceptor target: mark this rank hung so
+        the coordinator excludes it at the next scan, and make the next
+        :meth:`poll` raise instead of letting the process be killed."""
+        with self._lock:
+            self._hang = reason
+        try:
+            self.store.set(self._k("hang", self.rank), reason.encode())
+        except Exception:
+            pass
+        o = _obs()
+        if o:
+            o.registry.counter("elastic.hangs").inc()
+
+    def install_watchdog_hook(self) -> None:
+        """Route ``watchdog`` aborts into membership: instead of
+        ``os._exit`` the process reports the hang, survives, and rejoins
+        at the next epoch."""
+        from ..resilience import emergency
+
+        if self._abort_token is None:
+            self._abort_token = emergency.register_abort(
+                lambda reason: (self.report_hang(reason), True)[1])
+
+    def clear_hang(self) -> None:
+        with self._lock:
+            self._hang = None
+        try:
+            self.store.delete(self._k("hang", self.rank))
+        except Exception:
+            pass
+
+    def set_expand_gate(self, step: int) -> None:
+        """Joiners are folded into a new epoch only once the local step
+        has reached ``step`` — pins the expansion point so recovery
+        trajectories are replayable."""
+        self._expand_gate = int(step)
+
+    def _flagged_keys(self, kind: str, ranks) -> List[int]:
+        out = []
+        for r in ranks:
+            try:
+                if self.store.check(self._k(kind, r)):
+                    out.append(r)
+            except Exception:
+                pass
+        return out
+
+    def watch_once(self, now: Optional[float] = None,
+                   admit_joins: bool = True) -> Optional[int]:
+        """One scan: refresh the pending proposal; when acting
+        coordinator, detect missed beats / hangs / demotions / join
+        requests and propose a new epoch. Returns the proposal number
+        when one was made (None otherwise). Pure with respect to time —
+        tests drive it with a fake clock.
+
+        ``admit_joins=False`` (the background watch thread) restricts
+        the scan to failure handling: folding joiners in is left to the
+        step-synchronous scan the trainer runs at step boundaries, so
+        WHICH step an expansion lands on is a function of the expand
+        gate, not of timer jitter — that is what keeps two drill runs'
+        membership schedules identical."""
+        now = self.clock() if now is None else now
+        self.refresh_pending()
+        if not self.i_am_acting(now):
+            return None
+        members = sorted(self._candidates())
+        if not members:
+            return None
+        beats = scan_beats(self.store, self.ns, members, now,
+                           self.cfg.lease_timeout)
+        # planned departures (deregister marker): shrink right away,
+        # and never report a clean leave as a missed beat
+        left = self._flagged_keys(
+            "left", [r for r in members if r != self.rank])
+        dead = [r for r in members
+                if r != self.rank and beats[r] is None
+                and r not in left]
+        hung = self._flagged_keys("hang",
+                                  [r for r in members if r != self.rank])
+        o = _obs()
+        if dead:
+            if o:
+                o.registry.counter("elastic.missed_beats").inc(len(dead))
+            if self.on_fault is not None:
+                try:
+                    self.on_fault(list(dead))
+                except Exception:
+                    pass
+        # straggler telemetry from the lease payloads
+        for r in members:
+            b = beats.get(r)
+            if b and b.get("step_ms") is not None:
+                self.detector.record(r, float(b["step_ms"]))
+        flagged = [r for r in self.detector.flagged() if r != self.rank]
+        if o:
+            o.registry.gauge("elastic.stragglers").set(len(flagged))
+        if flagged and self.on_straggler is not None:
+            try:
+                self.on_straggler(list(flagged))
+            except Exception:
+                pass
+        demoted = self._flagged_keys(
+            "demote", [r for r in members if r != self.rank])
+        if self.cfg.straggler_policy == "demote":
+            demoted = sorted(set(demoted) | set(flagged))
+        gone = set(dead) | set(hung) | set(demoted) | set(left)
+        with self._lock:
+            if self._hang is not None:
+                # a hung coordinator proposes its own exclusion; the
+                # lowest SURVIVOR commits and the hung rank rejoins
+                gone.add(self.rank)
+        joins = []
+        if admit_joins and self._last_step >= self._expand_gate:
+            joins = [r for r in self._flagged_keys(
+                "join", range(self.cfg.max_nodes))
+                if r not in members and r not in gone]
+        survivors = [r for r in members if r not in gone]
+        new_members = sorted(set(survivors) | set(joins))
+        if self.epoch > 0 and new_members == members:
+            return None
+        with self._lock:
+            pending = self._pending
+        if pending > self.epoch:
+            # an uncommitted proposal for this same change is already
+            # out — don't burn another epoch on it
+            pend = self.read_epoch(pending)
+            if pend and sorted(pend["members"]) == new_members:
+                return None
+        if self.epoch == 0 and not (gone or joins):
+            return None     # initial formation is form_initial()'s job
+        if not new_members:
+            return None
+        reason = []
+        if dead:
+            reason.append(f"missed beats: {dead}")
+        if left:
+            reason.append(f"left: {sorted(left)}")
+        if hung:
+            reason.append(f"hangs: {hung}")
+        if demoted:
+            reason.append(f"demoted: {demoted}")
+        if joins:
+            reason.append(f"joins: {joins}")
+        n = self.propose(new_members, "; ".join(reason) or "scan")
+        for r in joins:
+            try:
+                self.store.delete(self._k("join", r))
+            except Exception:
+                pass
+        for r in demoted:
+            try:
+                self.store.delete(self._k("demote", r))
+            except Exception:
+                pass
+        return n
+
+    def _watch_loop(self):
+        while not self._stop.wait(self.cfg.beat_interval):
+            try:
+                self.watch_once(admit_joins=False)
+            except Exception:
+                pass
+
+    # ----------------------------------------------------------- epoch
+    def propose(self, members: List[int], reason: str) -> int:
+        """Allocate the next epoch number and publish its member list.
+        Monotone by construction: the number comes from a store ADD."""
+        n = self.store.add(self._k("seq"), 1)
+        rec = {"epoch": n, "members": sorted(int(m) for m in members),
+               "reason": reason, "proposer": self.rank,
+               "prev": self.epoch}
+        self.store.set(self._k("epoch", n), json.dumps(rec).encode())
+        self.store.set(self._k("propose"), str(n).encode())
+        with self._lock:
+            if n > self._pending:
+                self._pending = n
+        return n
+
+    def read_epoch(self, n: int) -> Optional[dict]:
+        try:
+            raw = try_get(self.store, self._k("epoch", n))
+            return None if raw is None else json.loads(raw.decode())
+        except Exception:
+            return None
+
+    def current_commit(self) -> Optional[dict]:
+        """The last committed epoch record published at ``cur`` (what a
+        cold-started joiner reads to find the group)."""
+        try:
+            raw = try_get(self.store, self._k("cur"))
+            return None if raw is None else \
+                self.read_epoch(int(raw.decode()))
+        except Exception:
+            return None
+
+    def request_join(self) -> None:
+        self.store.set(self._k("join", self.rank),
+                       json.dumps({"t": self.clock()}).encode())
+
+    def form_initial(self) -> dict:
+        """Rendezvous of the first epoch: rank 0 (or the lowest rank
+        that showed up within the deadline) proposes every registered
+        rank; everyone joins. Elastic from step one — a rank that never
+        registers is simply left out."""
+        deadline = time.monotonic() + self.cfg.timeout
+        while time.monotonic() < deadline:
+            regs = self._registered()
+            if len(regs) >= self.world_hint:
+                break
+            time.sleep(0.02)
+        regs = sorted(self._registered())
+        if regs and self.rank == min(regs):
+            self.propose(regs, "initial formation")
+        return self.join()
+
+    def join(self) -> dict:
+        """Barrier-with-deadline: converge on the newest proposal,
+        ack it, and wait for the commit. Every wait is bounded by
+        ``cfg.timeout``; a member that fails to ack in time is shrunk
+        out of a follow-up proposal instead of wedging the group.
+        Returns the committed epoch record (the caller must check
+        whether it is still a member)."""
+        o = _obs()
+        span = o.span("elastic.epoch", args={"rank": self.rank}) if o \
+            else None
+        try:
+            if span:
+                span.__enter__()
+            return self._join_inner()
+        finally:
+            if span:
+                span.__exit__(None, None, None)
+
+    def _join_inner(self) -> dict:
+        overall = time.monotonic() + 10 * self.cfg.timeout
+        acked: set = set()
+        while True:
+            if time.monotonic() > overall:
+                raise TimeoutError(
+                    f"elastic join did not converge within "
+                    f"{10 * self.cfg.timeout:.1f}s (rank {self.rank})")
+            n = self.refresh_pending()
+            if n <= self.epoch:
+                # entered join() with no proposal out yet (e.g. via a
+                # collective deadline): the acting coordinator builds
+                # one from the lease table as soon as a change is
+                # visible; everyone else waits for it
+                now = self.clock()
+                if self.i_am_acting(now):
+                    made = self.watch_once(now)
+                    if made is None:
+                        time.sleep(min(0.05, self.cfg.beat_interval))
+                        continue
+                    n = made
+                else:
+                    time.sleep(min(0.05, self.cfg.beat_interval))
+                    continue
+            rec = self.read_epoch(n)
+            if rec is None:
+                time.sleep(0.01)
+                continue
+            members = rec["members"]
+            if self.rank not in members:
+                return rec      # demoted/excluded: caller rejoins
+            if n not in acked:
+                self.store.set(self._k("epoch", n, "ack", self.rank),
+                               b"1")
+                acked.add(n)
+            committer = min(members)
+            if committer == self.rank:
+                done = self._commit_as_leader(n, members)
+                if not done:
+                    continue    # shrunk proposal published; next round
+            else:
+                if not self._await_commit(n):
+                    continue    # deadline or superseded; next round
+            self.epoch = n
+            self.members = list(members)
+            self.clear_hang()
+            o = _obs()
+            if o:
+                o.registry.counter("elastic.epochs").inc()
+                o.registry.gauge("elastic.members").set(len(members))
+                o.flight_recorder.record(
+                    "elastic.epoch_commit", epoch=n, members=members,
+                    reason=rec.get("reason"))
+            return rec
+
+    def _commit_as_leader(self, n: int, members: List[int]) -> bool:
+        deadline = time.monotonic() + self.cfg.timeout
+        missing = [r for r in members if r != self.rank]
+        while missing and time.monotonic() < deadline:
+            missing = [r for r in missing if not self.store.check(
+                self._k("epoch", n, "ack", r))]
+            if missing:
+                if self.refresh_pending() > n:
+                    return False
+                time.sleep(0.01)
+        if missing:
+            self.propose([r for r in members if r not in missing],
+                         f"ack deadline: dropped {missing}")
+            return False
+        act = _faults.check("elastic.epoch_commit")
+        if act is not None:
+            _faults.apply(act)
+        self.store.set(self._k("epoch", n, "commit"), b"1")
+        self.store.set(self._k("cur"), str(n).encode())
+        return True
+
+    def _await_commit(self, n: int) -> bool:
+        deadline = time.monotonic() + self.cfg.timeout
+        key = self._k("epoch", n, "commit")
+        while time.monotonic() < deadline:
+            if self.store.check(key):
+                return True
+            if self.refresh_pending() > n:
+                return False
+            time.sleep(0.01)
+        # committer missed its deadline: it is either dead (the next
+        # scan will shrink it out) or slow — re-enter the loop either way
+        return False

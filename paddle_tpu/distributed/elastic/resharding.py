@@ -1,0 +1,114 @@
+"""Shrink/expand resharding: deterministic param->rank remap.
+
+The data-parallel optimizer state is sharded ZeRO-1 style: params are
+replicated, optimizer moments are partitioned by *param index* into
+contiguous, element-count-balanced ranges — the 1-D analog of the
+``distributed/checkpoint`` shard math, where every shard is a
+(global_offset, local_shape) interval and a load is the intersection
+of saved and wanted intervals. On a world-size change the new
+partition is recomputed from the same pure function, so the remap
+(which old rank holds each piece a new rank needs) is a deterministic
+function of (sizes, old_world, new_world): a 4->3 shrink and the
+3->4 rejoin both land on the layouts those worlds always had.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+__all__ = ["partition_ranges", "range_for_rank", "plan_remap",
+           "shard_opt_state", "merge_opt_shards"]
+
+
+def partition_ranges(sizes: Sequence[int],
+                     world: int) -> List[Tuple[int, int]]:
+    """Split params ``0..len(sizes)`` into ``world`` contiguous
+    half-open index ranges, balanced by element count (each boundary is
+    placed where the cumulative size first reaches its quota). Pure and
+    deterministic: the same (sizes, world) always yields the same
+    layout, which is what makes shrink->rejoin restore the original
+    partition exactly."""
+    if world <= 0:
+        raise ValueError(f"world must be positive, got {world}")
+    total = sum(int(s) for s in sizes)
+    bounds = [0]
+    cum = 0
+    i = 0
+    n = len(sizes)
+    for w in range(1, world):
+        quota = total * w / world
+        while i < n and cum + int(sizes[i]) <= quota:
+            cum += int(sizes[i])
+            i += 1
+        bounds.append(i)
+    bounds.append(n)
+    return [(bounds[k], bounds[k + 1]) for k in range(world)]
+
+
+def range_for_rank(sizes: Sequence[int], members: Sequence[int],
+                   rank: int) -> Tuple[int, int]:
+    """The param-index range ``rank`` owns under the partition for the
+    (sorted) member list."""
+    ms = sorted(members)
+    return partition_ranges(sizes, len(ms))[ms.index(rank)]
+
+
+def plan_remap(old_parts: Sequence[Tuple[int, int]],
+               new_parts: Sequence[Tuple[int, int]]
+               ) -> List[List[Tuple[int, int, int]]]:
+    """For each new shard, the ``(old_index, lo, hi)`` interval
+    intersections that assemble it — which old holder to read, and
+    which slice of its range. Every new element maps to exactly one
+    old interval (both partitions cover the same index space)."""
+    plan: List[List[Tuple[int, int, int]]] = []
+    for nlo, nhi in new_parts:
+        pieces = []
+        for oi, (olo, ohi) in enumerate(old_parts):
+            lo, hi = max(nlo, olo), min(nhi, ohi)
+            if lo < hi:
+                pieces.append((oi, lo, hi))
+        plan.append(pieces)
+    return plan
+
+
+def shard_opt_state(state: Dict, lo: int, hi: int,
+                    n_params: int) -> Dict:
+    """Slice one rank's shard out of a functional optimizer state:
+    any list/tuple entry of length ``n_params`` (per-param moments) is
+    sliced to ``[lo:hi]``; scalar entries (step counters) replicate."""
+    out = {}
+    for k, v in state.items():
+        if isinstance(v, (list, tuple)) and len(v) == n_params:
+            out[k] = list(v[lo:hi])
+        else:
+            out[k] = v
+    return out
+
+
+def merge_opt_shards(shards: Sequence[Tuple[Tuple[int, int], Dict]],
+                     n_params: int) -> Dict:
+    """Reassemble a full optimizer state from ``((lo, hi), shard)``
+    pieces covering ``0..n_params``. Scalar entries must agree across
+    shards (they are per-step, not per-param)."""
+    pieces = sorted(shards, key=lambda x: x[0][0])
+    covered = 0
+    for (lo, hi), _ in pieces:
+        if lo != covered:
+            raise ValueError(
+                f"opt shard gap: expected lo={covered}, got {lo}")
+        covered = hi
+    if covered != n_params:
+        raise ValueError(
+            f"opt shards cover {covered} of {n_params} params")
+    out: Dict = {}
+    for (lo, hi), shard in pieces:
+        for k, v in shard.items():
+            if isinstance(v, (list, tuple)) and len(v) == hi - lo:
+                out.setdefault(k, []).extend(v)
+            else:
+                prev = out.get(k, v)
+                out[k] = v
+                if isinstance(prev, (int, float)) and prev != v:
+                    raise ValueError(
+                        f"opt shards disagree on scalar {k!r}: "
+                        f"{prev} != {v}")
+    return out

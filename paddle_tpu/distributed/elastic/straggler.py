@@ -1,0 +1,69 @@
+"""Straggler detection from per-rank step-time telemetry.
+
+Every lease beat carries the rank's last step wall time
+(``elastic.step_ms``); the acting coordinator feeds those samples into
+one :class:`StragglerDetector` and flags ranks whose rolling median
+exceeds the group's rolling p50 by a configurable factor
+(``PADDLE_TPU_ELASTIC_STRAGGLER_FACTOR``). The policy hook decides what
+a flag means: ``flag`` (default) is telemetry-only
+(``elastic.stragglers`` gauge + ``on_straggler`` callback), ``demote``
+drops the rank from the next membership epoch — the elastic analog of
+the reference's slow-node blacklisting.
+"""
+from __future__ import annotations
+
+from collections import deque
+from typing import Deque, Dict, List
+
+__all__ = ["StragglerDetector"]
+
+
+def _median(xs: List[float]) -> float:
+    s = sorted(xs)
+    n = len(s)
+    mid = n // 2
+    return s[mid] if n % 2 else 0.5 * (s[mid - 1] + s[mid])
+
+
+class StragglerDetector:
+    """Rolling per-rank step-time windows; pure (no clock, no store) so
+    the policy is unit-testable with synthetic samples."""
+
+    def __init__(self, factor: float = 3.0, window: int = 8,
+                 min_samples: int = 3):
+        self.factor = float(factor)
+        self.window = int(window)
+        self.min_samples = int(min_samples)
+        self._samples: Dict[int, Deque[float]] = {}
+
+    def record(self, rank: int, step_ms: float) -> None:
+        q = self._samples.setdefault(
+            int(rank), deque(maxlen=self.window))
+        q.append(float(step_ms))
+
+    def forget(self, rank: int) -> None:
+        self._samples.pop(int(rank), None)
+
+    def medians(self) -> Dict[int, float]:
+        return {r: _median(list(q)) for r, q in self._samples.items()
+                if len(q) >= self.min_samples}
+
+    def p50(self) -> float:
+        meds = list(self.medians().values())
+        return _median(meds) if meds else 0.0
+
+    def flagged(self) -> List[int]:
+        """Ranks whose rolling median exceeds ``factor`` x the group
+        p50. A factor <= 0 disables detection. Needs at least two
+        ranks with full windows — a lone rank cannot straggle behind
+        itself."""
+        if self.factor <= 0:
+            return []
+        meds = self.medians()
+        if len(meds) < 2:
+            return []
+        p50 = _median(list(meds.values()))
+        if p50 <= 0:
+            return []
+        return sorted(r for r, m in meds.items()
+                      if m > self.factor * p50)

@@ -1,14 +1,23 @@
-"""Elastic training manager-lite (reference:
+"""Elastic training manager (reference:
 python/paddle/distributed/fleet/elastic/manager.py:125 ElasticManager —
 etcd node registry + heartbeat lease :254, fault watch :457).
 
-TPU-native: the registry lives in the job's TCPStore (no etcd dependency);
-each node heartbeats a lease key, the master watches for missing beats and
-invokes the fault callback (restart is the launcher's job, as in the
-reference --max_restart policy).
+TPU-native: the registry lives in the job's TCPStore (no etcd
+dependency). This manager is the launcher-facing tier — string node
+ids, a fault callback, and the ``launch/{job}/restart`` relaunch
+channel. The full in-process self-healing tier (group epochs,
+shrink/expand resharding, peer-replicated snapshots) lives in
+:mod:`paddle_tpu.distributed.elastic`; this module shares its JSON
+lease format so one watch loop can read either producer's beats.
+
+Lease lifecycle: ``stop()`` *deregisters* — it deletes the node's
+``elastic/nodes/*`` and ``elastic/beat/*`` keys and joins the
+background threads with a timeout, so a cleanly-exiting node is never
+reported as a fault by the survivors' watch.
 """
 from __future__ import annotations
 
+import json
 import threading
 import time
 from typing import Callable, Dict, List, Optional
@@ -29,23 +38,49 @@ class ElasticManager:
         self.interval = heartbeat_interval
         self.timeout = timeout or self.ELASTIC_TIMEOUT
         self.on_fault = on_fault
-        self._stop = False
+        self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
 
     # ------------------------------------------------------------ lease
     def register(self):
         """Join the registry and start the heartbeat lease thread
         (reference: manager.py:254)."""
-        self._store.set(f"elastic/nodes/{self.node_id}", b"1")
+        self._store.set(f"elastic/nodes/{self.node_id}",
+                        json.dumps({"t": time.time()}).encode())
+        self._beat()
         t = threading.Thread(target=self._beat_loop, daemon=True)
         t.start()
         self._threads.append(t)
 
+    def _beat(self):
+        # JSON lease payload, shared with distributed/elastic
+        # membership beats (extra fields are carried, not required)
+        self._store.set(f"elastic/beat/{self.node_id}",
+                        json.dumps({"t": time.time()}).encode())
+
     def _beat_loop(self):
-        while not self._stop:
-            self._store.set(f"elastic/beat/{self.node_id}",
-                            str(time.time()).encode())
-            time.sleep(self.interval)
+        while not self._stop.wait(self.interval):
+            try:
+                self._beat()
+            except Exception:
+                pass  # a store blip must not kill the lease thread
+
+    def _try_get(self, key: str):
+        fn = getattr(self._store, "try_get", None)
+        if fn is not None:
+            return fn(key)
+        if not self._store.check(key):
+            return None
+        return self._store.get(key)
+
+    @staticmethod
+    def _beat_time(raw: bytes) -> float:
+        """Beat timestamp from either the JSON lease payload or the
+        legacy bare-float format."""
+        try:
+            return float(json.loads(raw.decode())["t"])
+        except (ValueError, KeyError, TypeError):
+            return float(raw.decode())
 
     # ------------------------------------------------------------ watch
     def watch(self, node_ids: List[str]):
@@ -59,17 +94,36 @@ class ElasticManager:
     def _watch_loop(self, node_ids):
         watch_start = time.time()
         reported = set()
+        registered = set()
+        left = set()
         last_beats: Dict[str, float] = {}
-        while not self._stop:
-            time.sleep(self.interval)
+        while not self._stop.wait(self.interval):
             now = time.time()
             dead = []
             for nid in node_ids:
                 try:
-                    # check() first — get() would block on a missing key
-                    if self._store.check(f"elastic/beat/{nid}"):
-                        raw = self._store.get(f"elastic/beat/{nid}")
-                        last = float(raw.decode())
+                    # a node whose registry key we SAW and which then
+                    # deleted it deregistered cleanly: not a fault —
+                    # and stays exempt until it re-registers. A node
+                    # that never registered stays under beat-based
+                    # detection (watched-but-silent == dead).
+                    if self._store.check(f"elastic/nodes/{nid}"):
+                        registered.add(nid)
+                        left.discard(nid)
+                    elif nid in registered:
+                        registered.discard(nid)
+                        left.add(nid)
+                        last_beats.pop(nid, None)
+                        reported.discard(nid)
+                        continue
+                    elif nid in left:
+                        continue
+                    # atomic get-or-None — check-then-get races a
+                    # concurrent deregistration's delete, and get()
+                    # would then block on the missing key
+                    raw = self._try_get(f"elastic/beat/{nid}")
+                    if raw is not None:
+                        last = self._beat_time(raw)
                         last_beats[nid] = last
                     else:
                         # never heartbeat at all: dead once the grace
@@ -120,4 +174,16 @@ class ElasticManager:
         return self.request_relaunch(job_id)
 
     def stop(self):
-        self._stop = True
+        """Deregister: stop + join the background threads (bounded by a
+        timeout, never hangs a clean shutdown) and delete this node's
+        registry and lease keys so the watch reports no phantom fault."""
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=2 * self.interval + 1.0)
+        self._threads = []
+        for key in (f"elastic/nodes/{self.node_id}",
+                    f"elastic/beat/{self.node_id}"):
+            try:
+                self._store.delete(key)
+            except Exception:
+                pass

@@ -1,4 +1,4 @@
-"""Emergency-checkpoint hook registry.
+"""Emergency-checkpoint hook registry + the shared process-abort path.
 
 The components that *detect* a dying job (the collective watchdog's
 timeout path, the health monitor's ``raise`` policy) know nothing about
@@ -10,16 +10,28 @@ save hook for the duration of ``fit``, and the failure paths call
 
 Hooks must be fast and must never raise (failures are swallowed —
 an emergency save must not mask the original failure).
+
+:func:`abort_process` is the one door out of the process for every
+"this job is wedged" path (the watchdog's AbortComm analog): it runs
+the registered **abort interceptors** first — the elastic membership
+coordinator claims the abort and converts the hang into a typed
+``EpochChanged`` rejoin instead of a death — and only when nobody
+claims it does it leave the forensic trail (flight-recorder debug
+bundle + emergency checkpoint) and ``os._exit``. A hang and a crash
+leave the same evidence either way.
 """
 from __future__ import annotations
 
 import threading
 from typing import Callable, Dict, List, Optional
 
-__all__ = ["register", "unregister", "trigger", "hook_count"]
+__all__ = ["register", "unregister", "trigger", "hook_count",
+           "register_abort", "unregister_abort", "abort_hook_count",
+           "abort_process"]
 
 _lock = threading.Lock()
 _hooks: Dict[int, Callable[[str], Optional[str]]] = {}
+_abort_hooks: Dict[int, Callable[[str], bool]] = {}
 _next_id = 0
 
 
@@ -59,3 +71,73 @@ def trigger(reason: str) -> List[str]:
 
             traceback.print_exc()
     return saved
+
+
+# ------------------------------------------------------------- aborts
+def register_abort(hook: Callable[[str], bool]) -> int:
+    """Register an abort interceptor: ``hook(reason) -> True`` claims
+    the abort (the process survives and recovers through its own path,
+    e.g. an elastic epoch change); ``False`` declines. Returns a token
+    for :func:`unregister_abort`."""
+    global _next_id
+    with _lock:
+        _next_id += 1
+        _abort_hooks[_next_id] = hook
+        return _next_id
+
+
+def unregister_abort(token: int) -> None:
+    with _lock:
+        _abort_hooks.pop(token, None)
+
+
+def abort_hook_count() -> int:
+    with _lock:
+        return len(_abort_hooks)
+
+
+def abort_process(reason: str, exit_code: int = 1,
+                  extra: Optional[dict] = None,
+                  forensics_done: bool = False) -> bool:
+    """The shared death path. Interceptors run first; a claimed abort
+    returns False without exiting. Otherwise the forensic trail is laid
+    (debug bundle + emergency-checkpoint hooks, unless the caller
+    already did both, as the watchdog's dump does) and the process
+    exits hard via ``os._exit(exit_code)``. Never raises on the way
+    down."""
+    with _lock:
+        interceptors = list(_abort_hooks.values())
+    for hook in interceptors:
+        try:
+            if hook(reason):
+                import sys
+
+                print(f"[emergency] abort claimed by interceptor: "
+                      f"{reason}", file=sys.stderr)
+                return False
+        except Exception:
+            import traceback
+
+            traceback.print_exc()
+    if not forensics_done:
+        try:
+            import os as _os
+
+            from ...observability import flight_recorder
+
+            d = flight_recorder.default_dump_dir()
+            if d:
+                rank = _os.environ.get("PADDLE_TRAINER_ID", "0")
+                flight_recorder.dump_debug_bundle(
+                    _os.path.join(
+                        d, f"abort_rank{rank}_pid{_os.getpid()}"),
+                    reason=reason, extra=extra or {})
+        except Exception:
+            import traceback
+
+            traceback.print_exc()
+        trigger(reason)
+    import os as _os
+
+    _os._exit(exit_code)
+    return True  # unreachable; keeps the signature honest

@@ -12,7 +12,13 @@ A *plan* is a ``;``-separated list of rules::
   ``serving.step`` (inside the serving engine's retried dispatch),
   ``cluster.replica`` (top of every cluster replica step; ``kill`` /
   ``raise`` / ``drop`` there simulate a replica crash in-process —
-  drain + replay — rather than ``os._exit``).
+  drain + replay — rather than ``os._exit``),
+  ``elastic.heartbeat`` (a rank's lease beat; ``drop`` skips the beat
+  so peers see a missed-beat lease expiry), ``elastic.epoch_commit``
+  (the coordinator's commit write; ``delay=<s>`` holds the epoch ack
+  window open), ``elastic.reshard`` (a peer-snapshot fetch during
+  shrink/expand adoption; ``truncate`` / ``bitflip`` corrupt the
+  fetched CRC-tagged blob, forcing the disk-manifest fallback tier).
 - ``kind`` — what to inject: ``drop`` (close + fail the store socket),
   ``loss`` (silently discard an rpc message), ``delay=<s>`` (sleep,
   e.g. past the watchdog timeout), ``truncate`` / ``bitflip``

@@ -271,6 +271,22 @@ class TCPStore:
             if v != b"1":
                 raise TimeoutError(f"TCPStore wait timed out on key {key!r}")
 
+    def try_get(self, key: str) -> Optional[bytes]:
+        """Atomic get-or-None: a raw GET answered from the server's
+        current table in one round trip — never blocks on a missing key
+        (the GET op returns an empty frame for one). The check-then-get
+        idiom is racy against a concurrent ``delete`` — the key can
+        vanish between the two round trips and ``get`` then blocks for
+        the full store timeout — so pollers of deletable keys (heartbeat
+        leases, consumed mailboxes) must use this instead. Caveat: a
+        deliberately-stored empty value is indistinguishable from a
+        missing key."""
+        if self._native:
+            v = self._native_op(self._client.get, key.encode())
+        else:
+            _, _, v = self._roundtrip(_OP_GET, key.encode(), b"")
+        return v if v else None
+
     def delete(self, key: str) -> bool:
         """Remove a key (protocol op 5); True if it existed. Long-lived
         control planes (rpc) use this to reclaim consumed mailbox keys."""
@@ -285,11 +301,16 @@ class TCPStore:
         _, _, v = self._roundtrip(_OP_CHECK, key.encode(), b"")
         return v == b"1"
 
-    def barrier(self, prefix: str, world_size: int, rank: int):
+    def barrier(self, prefix: str, world_size: int, rank: int,
+                timeout: Optional[float] = None):
+        """Barrier-with-deadline: ``timeout`` bounds the wait for the
+        last arrival (TimeoutError on expiry — a dead peer must surface
+        as a typed failure, never a hang); None uses the store default.
+        """
         n = self.add(f"{prefix}/barrier", 1)
         if n == world_size:
             self.set(f"{prefix}/barrier_done", b"1")
-        self.wait([f"{prefix}/barrier_done"])
+        self.wait([f"{prefix}/barrier_done"], timeout)
 
 
 class PrefixStore:
@@ -318,14 +339,18 @@ class PrefixStore:
     def wait(self, keys, timeout=None):
         return self._s.wait([self._k(k) for k in keys], timeout)
 
+    def try_get(self, key):
+        return self._s.try_get(self._k(key))
+
     def delete(self, key):
         return self._s.delete(self._k(key))
 
     def check(self, key):
         return self._s.check(self._k(key))
 
-    def barrier(self, prefix, world_size, rank):
-        return self._s.barrier(self._k(prefix), world_size, rank)
+    def barrier(self, prefix, world_size, rank, timeout=None):
+        return self._s.barrier(self._k(prefix), world_size, rank,
+                               timeout)
 
 
 _global_store: Optional[TCPStore] = None

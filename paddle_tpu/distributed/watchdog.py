@@ -6,8 +6,12 @@ Enable with ``PADDLE_TPU_COMM_TIMEOUT=<seconds>`` or ``enable(timeout)``:
 every ProcessGroup collective is registered as a CommTask; a daemon thread
 flags tasks that exceed the timeout, dumps the in-flight trace (op name,
 group, start time — the FLAGS_enable_async_trace analog) and calls the
-abort callback (default: os._exit, like the reference's AbortComm
-process teardown so a hung ring cannot wedge the job silently).
+abort callback. The default abort routes through
+``resilience.emergency.abort_process`` — abort interceptors (the
+elastic membership coordinator's hang report) may claim it and keep
+the process alive for an epoch-change rejoin; unclaimed aborts exit
+124 like the reference's AbortComm teardown, so a hung ring cannot
+wedge the job silently either way.
 """
 from __future__ import annotations
 
@@ -133,9 +137,18 @@ class CommTaskManager:
             traceback.print_exc()
 
     def _default_abort(self, task: CommTask):
-        # reference AbortComm: tear the process down so the launcher's
-        # restart policy can recover the job
-        os._exit(124)
+        # reference AbortComm — but routed through the shared abort
+        # path instead of a bare os._exit: an elastic membership
+        # coordinator (or any registered interceptor) can claim the
+        # abort and convert the hang into an epoch change; otherwise
+        # the process exits 124 as before. _dump_trace already laid the
+        # forensic trail (debug bundle + emergency checkpoint), so the
+        # abort path must not duplicate it.
+        from .resilience import emergency
+
+        emergency.abort_process(
+            f"comm watchdog timeout: {task!r}", exit_code=124,
+            forensics_done=True)
 
     def shutdown(self):
         self._stop = True

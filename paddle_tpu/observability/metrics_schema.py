@@ -29,6 +29,13 @@ TIME_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
                 60.0)
 TOKEN_LATENCY_BUCKETS = (1e-5, 2.5e-5, 5e-5, 1e-4, 2.5e-4, 5e-4, 1e-3,
                          2.5e-3, 5e-3, 0.01, 0.025, 0.05, 0.1, 0.5, 1.0)
+# millisecond-scale boundaries for elastic step/recovery latencies —
+# a recovery budget is PADDLE_TPU_ELASTIC_TIMEOUT seconds, so the tail
+# buckets must resolve multi-second waits without losing the sub-ms
+# fast path
+ELASTIC_MS_BUCKETS = (0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0,
+                      100.0, 250.0, 500.0, 1000.0, 2500.0, 5000.0,
+                      10000.0, 30000.0, 60000.0)
 
 METRICS = {
     # ---- Engine.fit (distributed/auto_parallel/engine.py)
@@ -211,6 +218,45 @@ METRICS = {
     "cluster.step_time": MetricSpec(
         "histogram", "s", "wall time of one synchronous router step "
         "(round-robin replica steps + disagg pump)", TIME_BUCKETS),
+    # ---- elastic self-healing training (distributed/elastic/)
+    "elastic.heartbeats": MetricSpec(
+        "counter", "beats", "membership lease beats written by this "
+        "rank (dropped-beat injections via fault site elastic.heartbeat "
+        "do NOT count)"),
+    "elastic.missed_beats": MetricSpec(
+        "counter", "leases", "peer leases seen expired by this rank's "
+        "membership watch (each expiry observation counts once per "
+        "proposal it feeds)"),
+    "elastic.epochs": MetricSpec(
+        "counter", "epochs", "group epochs this rank committed into "
+        "(initial formation + every shrink/expand)"),
+    "elastic.members": MetricSpec(
+        "gauge", "ranks", "member count of the current group epoch"),
+    "elastic.step_ms": MetricSpec(
+        "histogram", "ms", "per-rank train step time as reported on the "
+        "heartbeat lease (the straggler-policy input)",
+        ELASTIC_MS_BUCKETS),
+    "elastic.stragglers": MetricSpec(
+        "gauge", "ranks", "ranks currently flagged by the rolling-p50 "
+        "straggler policy (median step time > factor x group p50)"),
+    "elastic.hangs": MetricSpec(
+        "counter", "hangs", "watchdog-reported collective hangs claimed "
+        "by the membership coordinator's abort interceptor (converted "
+        "to epoch changes instead of process death)"),
+    "elastic.snapshots": MetricSpec(
+        "counter", "snapshots", "peer-replicated in-memory checkpoints "
+        "pushed to the left-neighbor mailbox"),
+    "elastic.snapshot_bytes": MetricSpec(
+        "gauge", "bytes", "encoded size of the last peer-replicated "
+        "snapshot (CRC header included)"),
+    "elastic.recoveries": MetricSpec(
+        "counter", "recoveries", "epoch-change recoveries completed, by "
+        "state source (peer mailbox / disk manifest / none)",
+        tags=("source",)),
+    "elastic.recovery_ms": MetricSpec(
+        "histogram", "ms", "epoch-change recovery latency: EpochChanged "
+        "raised -> new epoch joined + state adopted",
+        ELASTIC_MS_BUCKETS),
     # ---- device-native pipeline transport (distributed/pipeline/)
     "pipeline.p2p_bytes": MetricSpec(
         "counter", "bytes", "stage-boundary payload bytes moved by the "
@@ -275,6 +321,9 @@ METRICS = {
         "histogram", "s", "cluster bench timed window (one Poisson "
         "arrival-rate sweep point through the replica router)",
         TIME_BUCKETS),
+    "bench.elastic_window": MetricSpec(
+        "histogram", "s", "elastic bench timed window (kill->recovery "
+        "arm and snapshot-overhead arms)", TIME_BUCKETS),
 }
 
 
@@ -312,6 +361,10 @@ SPANS = {
                        "handoff (blocks/bytes in args)",
     "cluster.replay": "one drained descriptor replayed on a survivor "
                       "after a replica death",
+    "elastic.epoch": "one epoch join: propose/ack/commit barrier-with-"
+                     "deadline (epoch + members in args)",
+    "elastic.reshard": "shrink/expand state adoption: peer-snapshot "
+                       "fetch + shard remap (or disk fallback)",
     "pp.send": "pipeline stage-boundary send (device collective or "
                "host-buffered, transport in args)",
     "pp.recv": "pipeline stage-boundary recv (transport in args)",

@@ -1,0 +1,548 @@
+"""Unit tests for the elastic-training subsystem
+(paddle_tpu/distributed/elastic): membership leases with a fake clock,
+epoch monotonicity, coordinator failover, expand gating, snapshot CRC,
+deterministic resharding, fault sites, and the watchdog->membership
+abort interception. The 3-process chaos e2e lives in
+tests/test_elastic_drill.py."""
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.elastic import (
+    ElasticConfig, EpochChanged, MembershipCoordinator, PeerReplicator,
+    SnapshotCorrupt, StragglerDetector, merge_opt_shards,
+    partition_ranges, plan_remap, range_for_rank, shard_opt_state)
+from paddle_tpu.distributed.elastic import snapshots as snap_mod
+from paddle_tpu.distributed.elastic.membership import (
+    read_beat, scan_beats, try_get)
+from paddle_tpu.distributed.resilience import emergency, faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+class FakeStore:
+    """In-memory store-like WITHOUT try_get: exercises the helper's
+    check-then-get fallback path."""
+
+    def __init__(self):
+        self.kv = {}
+        self.lock = threading.Lock()
+
+    def set(self, key, value):
+        if isinstance(value, str):
+            value = value.encode()
+        with self.lock:
+            self.kv[key] = bytes(value)
+
+    def get(self, key):
+        with self.lock:
+            return self.kv[key]
+
+    def add(self, key, delta):
+        with self.lock:
+            cur = int(self.kv.get(key, b"0")) + delta
+            self.kv[key] = str(cur).encode()
+            return cur
+
+    def check(self, key):
+        with self.lock:
+            return key in self.kv
+
+    def delete(self, key):
+        with self.lock:
+            return self.kv.pop(key, None) is not None
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _coord(store, rank, world, clock, **cfg):
+    cfg.setdefault("timeout", 10.0)
+    cfg.setdefault("beat_interval", 0.1)
+    c = MembershipCoordinator(store, rank, world,
+                              config=ElasticConfig(**cfg), clock=clock)
+    c.register(start_threads=False)
+    return c
+
+
+class TestLeases:
+    def test_beat_and_lease_expiry_fake_clock(self):
+        store, clock = FakeStore(), FakeClock()
+        c = _coord(store, 0, 1, clock)
+        b = read_beat(store, "elastic", 0)
+        assert b is not None and b["t"] == clock.t
+        # fresh within lease_timeout (= 0.5 * timeout = 5s)
+        assert c.lease_fresh(0)
+        clock.advance(4.9)
+        assert c.lease_fresh(0)
+        clock.advance(0.2)
+        assert not c.lease_fresh(0)
+        c.beat()
+        assert c.lease_fresh(0)
+
+    def test_scan_beats_marks_expired_none(self):
+        store, clock = FakeStore(), FakeClock()
+        _coord(store, 0, 2, clock)
+        _coord(store, 1, 2, clock)
+        clock.advance(3.0)
+        store.set("elastic/beat/0",
+                  json.dumps({"t": clock.t}).encode())  # 0 re-beats
+        beats = scan_beats(store, "elastic", [0, 1, 2], clock.t, 2.0)
+        assert beats[0] is not None
+        assert beats[1] is None        # expired
+        assert beats[2] is None        # never beat
+
+    def test_clean_leave_shrinks_immediately_with_left_reason(self):
+        store, clock = FakeStore(), FakeClock()
+        c0 = _coord(store, 0, 2, clock)
+        c1 = _coord(store, 1, 2, clock)
+        t = threading.Thread(target=c1.join)
+        t.start()
+        rec = c0.form_initial()
+        t.join(timeout=10)
+        assert rec["members"] == [0, 1]
+        # rank 1 deregisters cleanly: the departure marker makes rank 0
+        # shrink on the very next scan — no lease-expiry wait, and the
+        # reason is an honest "left", never "missed beats"
+        c1.deregister()
+        n = c0.watch_once(clock.t)
+        assert n is not None
+        prop = c0.read_epoch(n)
+        assert prop["members"] == [0]
+        assert "left: [1]" in prop["reason"]
+        assert "missed beats" not in prop["reason"]
+        # returning clears the marker: rank 1 is a live peer again
+        c1.register(start_threads=False)
+        assert try_get(store, "elastic/left/1") is None
+
+    def test_missed_beat_detection_proposes_shrink(self):
+        store, clock = FakeStore(), FakeClock()
+        c0 = _coord(store, 0, 2, clock)
+        c1 = _coord(store, 1, 2, clock)
+        t = threading.Thread(target=c1.join)
+        t.start()
+        rec = c0.form_initial()
+        t.join(timeout=10)
+        assert not t.is_alive()
+        assert rec["members"] == [0, 1]
+        # both healthy: no proposal
+        assert c0.watch_once(clock.t) is None
+        # rank 1 stops beating past the lease; rank 0 must propose
+        clock.advance(c0.cfg.lease_timeout + 0.1)
+        c0.beat()
+        n = c0.watch_once(clock.t)
+        assert n is not None and n > rec["epoch"]
+        assert c0.read_epoch(n)["members"] == [0]
+        # duplicate scan while the proposal is uncommitted: deduped
+        assert c0.watch_once(clock.t) is None
+
+
+class TestEpochs:
+    def test_epoch_numbers_monotone_via_store_add(self):
+        store, clock = FakeStore(), FakeClock()
+        c = _coord(store, 0, 1, clock)
+        a = c.propose([0], "one")
+        b = c.propose([0], "two")
+        assert b > a > 0
+        assert c.refresh_pending() == b
+
+    def test_commit_flow_and_cur_pointer(self):
+        store, clock = FakeStore(), FakeClock()
+        c0 = _coord(store, 0, 2, clock)
+        c1 = _coord(store, 1, 2, clock)
+        done = {}
+        t = threading.Thread(
+            target=lambda: done.setdefault("rec", c1.join()))
+        t.start()
+        rec = c0.form_initial()
+        t.join(timeout=10)
+        assert not t.is_alive()
+        assert done["rec"]["epoch"] == rec["epoch"]
+        assert c0.current_commit()["members"] == [0, 1]
+        assert c0.epoch == c1.epoch == rec["epoch"]
+
+    def test_poll_raises_on_pending_not_in_hang_only(self):
+        store, clock = FakeStore(), FakeClock()
+        c = _coord(store, 0, 1, clock)
+        c.form_initial()
+        c.propose([0], "new round")
+        c.refresh_pending()
+        with pytest.raises(EpochChanged):
+            c.poll()
+        # mid-collective polls must NOT tear the step on a proposal
+        c.poll(hang_only=True)
+
+    def test_coordinator_failover_to_next_fresh_lease(self):
+        store, clock = FakeStore(), FakeClock()
+        c0 = _coord(store, 0, 3, clock)
+        c1 = _coord(store, 1, 3, clock)
+        c2 = _coord(store, 2, 3, clock)
+        recs = {}
+        ts = [threading.Thread(
+            target=lambda c=c, r=r: recs.setdefault(r, c.join()))
+            for r, c in ((1, c1), (2, c2))]
+        for t in ts:
+            t.start()
+        c0.form_initial()
+        for t in ts:
+            t.join(timeout=10)
+        # rank 0 goes silent -> rank 1 holds the freshest lowest lease
+        # and acts as coordinator (deputy failover is automatic)
+        clock.advance(c1.cfg.lease_timeout + 0.1)
+        c1.beat()
+        c2.beat()
+        assert not c0.lease_fresh(0)
+        assert c1.i_am_acting(clock.t)
+        assert not c2.i_am_acting(clock.t)
+        n = c1.watch_once(clock.t)
+        assert n is not None and c1.read_epoch(n)["members"] == [1, 2]
+
+    def test_expand_gate_blocks_joins_until_step(self):
+        store, clock = FakeStore(), FakeClock()
+        c0 = _coord(store, 0, 1, clock)
+        c0.form_initial()
+        c0.set_expand_gate(10)
+        joiner = _coord(store, 1, 1, clock)
+        joiner.request_join()
+        c0.heartbeat(5)
+        assert c0.watch_once(clock.t) is None      # gated
+        # the background watch thread never admits joiners at all
+        c0.heartbeat(10)
+        assert c0.watch_once(clock.t, admit_joins=False) is None
+        n = c0.watch_once(clock.t)                 # boundary scan does
+        assert n is not None
+        assert c0.read_epoch(n)["members"] == [0, 1]
+
+
+class TestWatchdogBridge:
+    def test_report_hang_makes_poll_raise_and_excludes_self(self):
+        store, clock = FakeStore(), FakeClock()
+        c0 = _coord(store, 0, 2, clock)
+        c1 = _coord(store, 1, 2, clock)
+        t = threading.Thread(target=c1.join)
+        t.start()
+        c0.form_initial()
+        t.join(timeout=10)
+        c0.report_hang("comm watchdog timeout: allreduce")
+        with pytest.raises(EpochChanged, match="hang"):
+            c0.poll()
+        with pytest.raises(EpochChanged):
+            c0.poll(hang_only=True)    # hangs escape even mid-collective
+        # a hung coordinator proposes its own exclusion
+        n = c0.watch_once(clock.t)
+        assert n is not None and c0.read_epoch(n)["members"] == [1]
+
+    def test_watchdog_abort_is_intercepted_not_fatal(self):
+        store, clock = FakeStore(), FakeClock()
+        c = _coord(store, 0, 1, clock)
+        c.form_initial()
+        before = emergency.abort_hook_count()
+        c.install_watchdog_hook()
+        assert emergency.abort_hook_count() == before + 1
+        try:
+            # the watchdog's abort path: with the hook installed the
+            # process survives and the hang is routed into membership
+            emergency.abort_process("comm watchdog timeout: 'x'",
+                                    exit_code=124, forensics_done=True)
+            with pytest.raises(EpochChanged, match="hang"):
+                c.poll()
+        finally:
+            c.deregister()   # also unregisters the abort hook
+        assert emergency.abort_hook_count() == before
+
+    def test_deregister_deletes_lease_and_registry(self):
+        store, clock = FakeStore(), FakeClock()
+        c = _coord(store, 0, 1, clock)
+        assert store.check("elastic/nodes/0")
+        assert store.check("elastic/beat/0")
+        c.deregister()
+        assert not store.check("elastic/nodes/0")
+        assert not store.check("elastic/beat/0")
+
+
+class TestEngineContext:
+    def test_survivor_keeps_live_state_on_epoch_change(self):
+        """A continuing member must NOT rewind to its last snapshot
+        when a peer leaves — its live state is newer than any
+        replica."""
+        from paddle_tpu.distributed.elastic import ElasticContext
+
+        store = FakeStore()
+        cfg = ElasticConfig(timeout=10.0, beat_interval=0.1)
+        ctx0 = ElasticContext(store, 0, 2, config=cfg,
+                              watchdog_hook=False)
+        ctx1 = ElasticContext(store, 1, 2, config=cfg,
+                              watchdog_hook=False)
+        adopted = []
+        ctx0.bind(lambda: {"w": np.ones(4, np.float32)},
+                  lambda state: adopted.append(state) or 5)
+        t = threading.Thread(target=ctx1.start)
+        t.start()
+        ctx0.start()
+        t.join(timeout=10)
+        assert not t.is_alive()
+        try:
+            ctx0.snapshot_now(3)
+            # rank 1 leaves cleanly; rank 0 sees the change at the
+            # next step boundary
+            ctx1.stop()
+            assert ctx0.coord.watch_once() is not None
+            ctx0.coord.refresh_pending()
+            with pytest.raises(EpochChanged) as ei:
+                ctx0.coord.poll()
+            step = ctx0.handle_epoch_change(ei.value)
+            assert step is None
+            assert adopted == []           # no rewind, state kept live
+            assert ctx0.coord.members == [0]
+        finally:
+            ctx0.stop()
+
+
+class TestFleetManagerLease:
+    def test_stop_deregisters_and_joins_threads(self):
+        from paddle_tpu.distributed.fleet.elastic import ElasticManager
+        from paddle_tpu.distributed.store import TCPStore
+
+        store = TCPStore("127.0.0.1", 0, is_master=True)
+        mgr = ElasticManager(store, "nodeA", 1, heartbeat_interval=0.05,
+                             timeout=1.0)
+        mgr.register()
+        assert store.check("elastic/nodes/nodeA")
+        assert store.check("elastic/beat/nodeA")
+        threads = list(mgr._threads)
+        mgr.stop()
+        assert not store.check("elastic/nodes/nodeA")
+        assert not store.check("elastic/beat/nodeA")
+        assert all(not t.is_alive() for t in threads)
+        assert mgr._threads == []
+
+    def test_clean_stop_is_not_reported_as_fault(self):
+        from paddle_tpu.distributed.fleet.elastic import ElasticManager
+        from paddle_tpu.distributed.store import TCPStore
+
+        store = TCPStore("127.0.0.1", 0, is_master=True)
+        leaver = ElasticManager(store, "L", 2, heartbeat_interval=0.05)
+        leaver.register()
+        dead = []
+        watcher = ElasticManager(store, "W", 2,
+                                 heartbeat_interval=0.05, timeout=0.3,
+                                 on_fault=lambda d: dead.extend(d))
+        watcher.register()
+        watcher.watch(["W", "L"])
+        time.sleep(0.2)
+        leaver.stop()          # clean deregistration, not a death
+        time.sleep(0.8)
+        watcher.stop()
+        assert "L" not in dead
+
+
+class TestTryGet:
+    def test_try_get_fallback_and_missing(self):
+        store = FakeStore()
+        assert try_get(store, "nope") is None
+        store.set("k", b"v")
+        assert try_get(store, "k") == b"v"
+
+    def test_tcpstore_try_get_atomic_after_delete(self):
+        from paddle_tpu.distributed.store import PrefixStore, TCPStore
+
+        store = TCPStore("127.0.0.1", 0, is_master=True)
+        store.set("a", b"1")
+        assert store.try_get("a") == b"1"
+        store.delete("a")
+        t0 = time.monotonic()
+        assert store.try_get("a") is None   # no blocking wait
+        assert time.monotonic() - t0 < 1.0
+        ps = PrefixStore("p/", store)
+        ps.set("b", b"2")
+        assert ps.try_get("b") == b"2"
+        assert ps.try_get("missing") is None
+
+
+class TestSnapshots:
+    def _payload(self):
+        return {"params": [np.arange(6, dtype=np.float32)],
+                "range": (0, 1),
+                "opt_shard": {"m": [np.zeros(6, np.float32)],
+                              "t": 3}}
+
+    def test_crc_roundtrip(self):
+        blob = snap_mod.encode({"step": 7, "x": np.arange(4)})
+        out = snap_mod.decode(blob)
+        assert out["step"] == 7
+        assert np.array_equal(out["x"], np.arange(4))
+
+    def test_truncate_and_bitflip_raise_snapshot_corrupt(self):
+        blob = snap_mod.encode({"step": 1})
+        for kind in ("truncate", "bitflip"):
+            with pytest.raises(SnapshotCorrupt):
+                snap_mod.decode(snap_mod._corrupt(blob, kind))
+
+    def test_ring_push_and_fetch_best(self):
+        store = FakeStore()
+        rep = PeerReplicator(store, rank=0, namespace="elastic",
+                             snap_freq=1)
+        assert rep.neighbor([0, 1, 2]) == 1
+        assert rep.neighbor([0]) == 0   # singleton ring: own mailbox
+        rep.push(3, [0, 1, 2], self._payload())
+        rep.push(9, [0, 1, 2], self._payload())
+        best = snap_mod.fetch_best(store, "elastic", 0)
+        assert best["step"] == 9
+
+    def test_maybe_push_respects_snap_freq(self):
+        store = FakeStore()
+        rep = PeerReplicator(store, rank=0, namespace="elastic",
+                             snap_freq=5)
+        calls = []
+
+        def make():
+            calls.append(1)
+            return self._payload()
+
+        for step in range(1, 11):
+            rep.maybe_push(step, [0, 1], make)
+        assert len(calls) == 2          # steps 5 and 10 only
+
+    def test_reshard_fault_site_corrupts_fetch(self):
+        store = FakeStore()
+        rep = PeerReplicator(store, rank=0, namespace="elastic",
+                             snap_freq=1)
+        rep.push(4, [0, 1], self._payload())
+        faults.configure("elastic.reshard:truncate@1")
+        with pytest.raises(SnapshotCorrupt):
+            snap_mod.fetch(store, "elastic", 0, 1)
+        faults.reset()
+        assert snap_mod.fetch(store, "elastic", 0, 1)["step"] == 4
+
+
+class TestResharding:
+    def test_partition_ranges_balanced_and_deterministic(self):
+        sizes = [24, 4, 8, 2]
+        a = partition_ranges(sizes, 3)
+        b = partition_ranges(sizes, 3)
+        assert a == b
+        # contiguous, full coverage of param indices
+        assert a[0][0] == 0 and a[-1][1] == len(sizes)
+        for (l1, h1), (l2, _) in zip(a, a[1:]):
+            assert h1 == l2 and l1 <= h1
+
+    def test_plan_remap_covers_every_new_range(self):
+        sizes = [10, 10, 10, 10]
+        old = partition_ranges(sizes, 4)
+        new = partition_ranges(sizes, 3)
+        plan = plan_remap(old, new)
+        for (lo, hi), pieces in zip(new, plan):
+            covered = sorted((plo, phi) for _, plo, phi in pieces)
+            cur = lo
+            for plo, phi in covered:
+                assert plo == cur
+                cur = phi
+            assert cur == hi
+
+    def test_shard_merge_roundtrip_synthetic_adam(self):
+        n = 5
+        state = {"m": [np.full(3, i, np.float32) for i in range(n)],
+                 "v": [np.full(3, 10 + i, np.float32)
+                       for i in range(n)],
+                 "t": 7}
+        for world in (1, 2, 3, 4):
+            parts = partition_ranges([3] * n, world)
+            shards = [(rng, shard_opt_state(state, rng[0], rng[1], n))
+                      for rng in parts]
+            merged = merge_opt_shards(shards, n)
+            assert merged["t"] == 7
+            for k in ("m", "v"):
+                assert len(merged[k]) == n
+                for i in range(n):
+                    assert np.array_equal(merged[k][i], state[k][i])
+
+    def test_merge_rejects_gaps(self):
+        n = 3
+        state = {"m": [np.zeros(2)] * n, "t": 1}
+        parts = partition_ranges([2] * n, 3)
+        shards = [(rng, shard_opt_state(state, rng[0], rng[1], n))
+                  for rng in parts]
+        with pytest.raises(ValueError):
+            merge_opt_shards(shards[:-1], n)
+
+    def test_range_for_rank_matches_partition(self):
+        sizes = [4, 4, 4]
+        members = [2, 5, 9]
+        parts = partition_ranges(sizes, 3)
+        for i, m in enumerate(members):
+            assert range_for_rank(sizes, members, m) == parts[i]
+
+
+class TestFaultSites:
+    def test_heartbeat_drop_skips_beat_write(self):
+        store, clock = FakeStore(), FakeClock()
+        c = _coord(store, 0, 1, clock)
+        store.delete("elastic/beat/0")
+        faults.configure("elastic.heartbeat:drop@1")
+        c.beat()                       # dropped on the wire
+        assert not store.check("elastic/beat/0")
+        c.beat()                       # plan exhausted: goes through
+        assert store.check("elastic/beat/0")
+
+    def test_epoch_commit_delay_holds_commit_but_completes(self):
+        store, clock = FakeStore(), FakeClock()
+        c = _coord(store, 0, 1, clock)
+        faults.configure("elastic.epoch_commit:delay=0.2@1")
+        t0 = time.monotonic()
+        rec = c.form_initial()
+        assert time.monotonic() - t0 >= 0.2
+        assert rec["members"] == [0]
+        assert c.current_commit()["epoch"] == rec["epoch"]
+
+
+class TestStraggler:
+    def test_flags_rank_over_factor_times_p50(self):
+        det = StragglerDetector(factor=3.0, window=8, min_samples=3)
+        for _ in range(5):
+            det.record(0, 10.0)
+            det.record(1, 11.0)
+            det.record(2, 100.0)
+        assert det.flagged() == [2]
+
+    def test_needs_min_samples_and_two_ranks(self):
+        det = StragglerDetector(factor=3.0, min_samples=3)
+        det.record(0, 100.0)
+        det.record(0, 100.0)
+        assert det.flagged() == []     # below min_samples
+        det = StragglerDetector(factor=3.0, min_samples=1)
+        det.record(0, 100.0)
+        assert det.flagged() == []     # a lone rank has no peers
+
+    def test_factor_zero_disables(self):
+        det = StragglerDetector(factor=0.0, min_samples=1)
+        for _ in range(5):
+            det.record(0, 1.0)
+            det.record(1, 1000.0)
+        assert det.flagged() == []
+
+    def test_forget_clears_history(self):
+        # two ranks: p50 is the mean of the two medians (105), so 200
+        # clears factor 1.5 x p50 = 157.5
+        det = StragglerDetector(factor=1.5, min_samples=2)
+        for _ in range(4):
+            det.record(0, 10.0)
+            det.record(1, 200.0)
+        assert det.flagged() == [1]
+        det.forget(1)
+        assert det.flagged() == []

@@ -1,0 +1,382 @@
+#!/usr/bin/env python
+"""Elastic-training chaos drill: seeded 3-process kill -> shrink ->
+rejoin -> re-expand through ``distributed/elastic``.
+
+The parent hosts the store daemon and spawns 3 workers running the
+same seeded :class:`ElasticDataParallel` job. A fault plan
+(``engine.step:kill=31@K``) hard-kills rank 2 at the top of step K;
+the survivors must detect the missed lease, commit a shrink epoch and
+resume the very next step from peer-replicated in-memory snapshots —
+no disk restore, no collective hang. The parent relaunches rank 2 as a
+rejoiner; the expand gate pins re-expansion to a fixed step so the
+whole trajectory is a pure function of the seed. The final losses must
+match a single-process reference replaying the RECORDED membership
+schedule (world size per step) exactly.
+
+Importable (``main()`` returns a result dict / raises) so
+tests/test_elastic_drill.py runs it in tier-1 and bench.py reuses the
+machinery; also runnable standalone:
+
+    JAX_PLATFORMS=cpu python tools/elastic_drill.py
+    JAX_PLATFORMS=cpu python tools/elastic_drill.py --determinism
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+KILL_CODE = 31
+KILL_AT = 5          # rank 2 dies at the top of step 5
+EXPAND_AT = 12       # joiners admitted once the group reached step 12
+TOTAL = 15
+PACE_S = 0.35        # per-step sleep: lets membership events interleave
+TIMEOUT_S = 3.0      # PADDLE_TPU_ELASTIC_TIMEOUT for the drill
+
+
+# --------------------------------------------------------- the job
+# Tiny 2-layer linear net, shared verbatim by workers and the parent's
+# reference replay. grad_fn returns SUMS over its row shard so the
+# combined full-batch gradient is identical at any world size.
+
+def _init_params():
+    import numpy as np
+
+    rng = np.random.default_rng(7)
+    return [rng.normal(size=(6, 4)).astype(np.float32),
+            rng.normal(size=(4,)).astype(np.float32),
+            rng.normal(size=(4, 2)).astype(np.float32)]
+
+
+def _make_data_fn(pace_s):
+    import numpy as np
+
+    rng = np.random.default_rng(7)
+    w = rng.normal(size=(6, 2)).astype(np.float32)
+
+    def data_fn(step):
+        if pace_s:
+            time.sleep(pace_s)
+        r = np.random.default_rng(40_000 + step)
+        x = r.normal(size=(12, 6)).astype(np.float32)
+        y = (x @ w).astype(np.float32)
+        return x, y
+
+    return data_fn
+
+
+def _grad_fn(params, x, y):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    def loss_sum(ps, xx, yy):
+        h = jnp.tanh(xx @ ps[0] + ps[1])
+        return jnp.sum((h @ ps[2] - yy) ** 2)
+
+    val, grads = jax.value_and_grad(loss_sum)(
+        [jnp.asarray(p) for p in params], jnp.asarray(x),
+        jnp.asarray(y))
+    return float(val), [np.asarray(g) for g in grads]
+
+
+def _reference(total, epoch_log, lr=0.01):
+    """Single-process replay of the recorded membership schedule: the
+    exact partition of every step's batch, summed in member order."""
+    import numpy as np
+
+    from paddle_tpu.distributed.elastic.resharding import \
+        partition_ranges
+    from paddle_tpu.optimizer.optimizers import Adam
+
+    data_fn = _make_data_fn(0.0)
+    params = _init_params()
+    opt = Adam(learning_rate=lr)
+    state = opt.init_state([np.asarray(p) for p in params])
+    spans = sorted(epoch_log, key=lambda e: e["from_step"])
+
+    def world_at(step):
+        w = None
+        for e in spans:
+            if step >= e["from_step"]:
+                w = len(e["members"])
+        if w is None:
+            raise ValueError(f"no epoch covers step {step}")
+        return w
+
+    hist = []
+    for step in range(1, total + 1):
+        x, y = data_fn(step)
+        batch = len(x)
+        rows = partition_ranges([1] * batch, world_at(step))
+        tot_l, tot_g = 0.0, None
+        for lo, hi in rows:
+            l, g = _grad_fn(params, x[lo:hi], y[lo:hi])
+            tot_l += l
+            tot_g = g if tot_g is None else \
+                [a + b for a, b in zip(tot_g, g)]
+        grads = [np.asarray(g, np.float32) / batch for g in tot_g]
+        params, state = opt.update(
+            [np.asarray(p, np.float32) for p in params], grads, state)
+        params = [np.asarray(p) for p in params]
+        hist.append(float(tot_l / batch))
+    return hist
+
+
+# ----------------------------------------------------------- worker
+def _worker_main() -> int:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    os.environ["PADDLE_TPU_PURE_PY_STORE"] = "1"
+
+    from paddle_tpu.distributed.elastic import ElasticDataParallel
+    from paddle_tpu.distributed.store import TCPStore
+    from paddle_tpu.optimizer.optimizers import Adam
+
+    rank = int(os.environ["ELASTIC_DRILL_RANK"])
+    host, port = os.environ["ELASTIC_DRILL_MASTER"].rsplit(":", 1)
+    out = os.environ["ELASTIC_DRILL_OUT"]
+    rejoin = os.environ.get("ELASTIC_DRILL_REJOIN") == "1"
+    total = int(os.environ.get("ELASTIC_DRILL_TOTAL", str(TOTAL)))
+    expand_at = int(os.environ.get("ELASTIC_DRILL_EXPAND_AT",
+                                   str(EXPAND_AT)))
+    pace = float(os.environ.get("ELASTIC_DRILL_PACE", str(PACE_S)))
+
+    store = TCPStore(host, int(port), is_master=False)
+    trainer = ElasticDataParallel(
+        store, rank, 3, _init_params(), _grad_fn, _make_data_fn(pace),
+        Adam(learning_rate=0.01), rejoin=rejoin, expand_at=expand_at)
+    t0 = time.monotonic()
+    step_ends = []
+    orig_train = trainer._train_one
+
+    def timed_train(step):
+        loss = orig_train(step)
+        step_ends.append({"step": step,
+                          "t": time.monotonic() - t0})
+        return loss
+
+    trainer._train_one = timed_train
+    hist = trainer.run(total)
+    digest = [float(np.sum(np.abs(p))) for p in trainer.params]
+    tag = "rejoin" if rejoin else "first"
+    with open(os.path.join(out, f"rank{rank}_{tag}.json"), "w") as f:
+        json.dump({"rank": rank, "rejoin": rejoin, "history": hist,
+                   "epoch_log": trainer.epoch_log,
+                   "recoveries": trainer.recoveries,
+                   "step_ends": step_ends,
+                   "params_digest": digest,
+                   "params": [p.tolist() for p in trainer.params]}, f)
+    trainer.shutdown()
+    return 0
+
+
+# ----------------------------------------------------------- parent
+def _current_members(store):
+    """The committed epoch's member list, read through the parent's
+    own store client (None before the first commit)."""
+    try:
+        raw = store.try_get("elastic/cur")
+        if raw is None:
+            return None
+        rec_raw = store.try_get(f"elastic/epoch/{int(raw.decode())}")
+        if rec_raw is None:
+            return None
+        return sorted(json.loads(rec_raw.decode())["members"])
+    except Exception:
+        return None
+
+
+def _spawn_worker(rank, master, out, *, rejoin=False, fault_plan=None,
+                  snap_freq=1, total=TOTAL, expand_at=EXPAND_AT,
+                  pace=PACE_S, timeout_s=TIMEOUT_S):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + (
+        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "PADDLE_TPU_PURE_PY_STORE": "1",
+        "PADDLE_TPU_ELASTIC": "1",
+        "PADDLE_TPU_ELASTIC_TIMEOUT": str(timeout_s),
+        "PADDLE_TPU_ELASTIC_SNAP_FREQ": str(snap_freq),
+        "PADDLE_TPU_ELASTIC_BEAT": "0.1",
+        "ELASTIC_DRILL_RANK": str(rank),
+        "ELASTIC_DRILL_MASTER": master,
+        "ELASTIC_DRILL_OUT": out,
+        "ELASTIC_DRILL_REJOIN": "1" if rejoin else "0",
+        "ELASTIC_DRILL_TOTAL": str(total),
+        "ELASTIC_DRILL_EXPAND_AT": str(expand_at),
+        "ELASTIC_DRILL_PACE": str(pace),
+    })
+    env.pop("PADDLE_TPU_FAULT_PLAN", None)
+    if fault_plan:
+        env["PADDLE_TPU_FAULT_PLAN"] = fault_plan
+    log = open(os.path.join(
+        out, f"rank{rank}_{'rejoin' if rejoin else 'first'}.log"), "ab")
+    return subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--worker"],
+        env=env, stdout=log, stderr=subprocess.STDOUT)
+
+
+def main(out_dir=None, snap_freq=1, deadline_s=240.0) -> dict:
+    """One full drill. Returns the parsed result dict (also what the
+    bench reuses); raises AssertionError on any acceptance failure."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ["PADDLE_TPU_PURE_PY_STORE"] = "1"
+    import tempfile
+
+    from paddle_tpu.distributed.store import TCPStore
+
+    out = out_dir or tempfile.mkdtemp(prefix="elastic_drill_")
+    os.makedirs(out, exist_ok=True)
+    daemon_store = TCPStore("127.0.0.1", 0, is_master=True)
+    master = f"127.0.0.1:{daemon_store._port}"
+
+    procs = {r: _spawn_worker(r, master, out, snap_freq=snap_freq,
+                              fault_plan=(
+                                  f"engine.step:kill={KILL_CODE}"
+                                  f"@{KILL_AT}" if r == 2 else None))
+             for r in range(3)}
+    deadline = time.time() + deadline_s
+
+    # arm 1: rank 2 must die with the injected kill code
+    while procs[2].poll() is None and time.time() < deadline:
+        time.sleep(0.05)
+    assert procs[2].poll() == KILL_CODE, (
+        f"rank2 exit {procs[2].poll()!r}, wanted {KILL_CODE}")
+    t_kill = time.time()
+    # relaunch only after the survivors committed the shrink epoch: an
+    # instant relaunch would refresh the dead rank's lease before it
+    # expires and mask the very failure the drill injects
+    t_shrink = None
+    while time.time() < deadline:
+        cur = _current_members(daemon_store)
+        if cur == [0, 1]:
+            t_shrink = time.time()
+            break
+        time.sleep(0.05)
+    assert t_shrink is not None, "survivors never committed a shrink"
+    procs["2r"] = _spawn_worker(2, master, out, rejoin=True,
+                                snap_freq=snap_freq)
+
+    for key in (0, 1, "2r"):
+        p = procs[key]
+        while p.poll() is None and time.time() < deadline:
+            time.sleep(0.1)
+        if p.poll() is None:
+            for q in procs.values():
+                q.kill()
+            raise AssertionError(
+                f"worker {key} did not finish within {deadline_s}s "
+                f"(logs in {out})")
+        assert p.poll() == 0, (
+            f"worker {key} exited {p.poll()} (logs in {out})")
+
+    res = {}
+    for key, tag, rank in ((0, "first", 0), (1, "first", 1),
+                           ("2r", "rejoin", 2)):
+        with open(os.path.join(out, f"rank{rank}_{tag}.json")) as f:
+            res[key] = json.load(f)
+
+    # --- acceptance: epoch timeline ------------------------------
+    worlds0 = [(e["members"], e["from_step"])
+               for e in res[0]["epoch_log"]]
+    assert worlds0[0][0] == [0, 1, 2], worlds0
+    assert any(m == [0, 1] for m, _ in worlds0), \
+        f"no shrink epoch: {worlds0}"
+    assert worlds0[-1][0] == [0, 1, 2], \
+        f"no re-expand epoch: {worlds0}"
+    shrink_from = next(s for m, s in worlds0 if m == [0, 1])
+    assert shrink_from == KILL_AT, (
+        f"shrink resumed at step {shrink_from}, wanted {KILL_AT} "
+        "(the very next step after the kill)")
+    assert res[0]["epoch_log"] == res[1]["epoch_log"], "epoch logs differ"
+    assert res["2r"]["epoch_log"][-1] == res[0]["epoch_log"][-1]
+
+    # --- acceptance: peer recovery, bounded latency, no disk -----
+    for key in (0, 1):
+        recs = res[key]["recoveries"]
+        assert recs, f"rank{key} recorded no recovery"
+        for r in recs:
+            assert r["source"] == "peer", \
+                f"rank{key} recovered from {r['source']}, not peers"
+            assert r["latency_ms"] < TIMEOUT_S * 1000.0, r
+
+    # --- acceptance: trajectories --------------------------------
+    assert len(res[0]["history"]) == TOTAL
+    assert res[0]["history"] == res[1]["history"]
+    h2 = res["2r"]["history"]
+    assert h2 and res[0]["history"][-len(h2):] == h2, \
+        "rejoiner's post-expand steps diverge from survivors"
+    assert res[0]["params_digest"] == res[1]["params_digest"] == \
+        res["2r"]["params_digest"], "final params diverge across ranks"
+
+    ref = _reference(TOTAL, res[0]["epoch_log"])
+    got = res[0]["history"]
+    for i, (a, b) in enumerate(zip(ref, got)):
+        assert abs(a - b) <= 1e-4 * max(1.0, abs(a)), (
+            f"step {i + 1}: drill loss {b!r} != reference {a!r}")
+
+    # kill -> first post-shrink step, from the survivor's wall clock.
+    # The recovery step's wall delta contains the abandoned attempt AND
+    # the full retried step; subtracting two median ordinary steps
+    # leaves detection + epoch commit + peer adoption — the part the
+    # elastic timeout budgets.
+    ends0 = {s["step"]: s["t"] for s in res[0]["step_ends"]}
+    deltas = {s: ends0[s] - ends0[s - 1]
+              for s in range(2, TOTAL + 1) if s in ends0}
+    ordinary = sorted(v for s, v in deltas.items() if s != KILL_AT)
+    step_baseline_s = ordinary[len(ordinary) // 2]
+    recovery_wall_s = deltas[KILL_AT] - 2 * step_baseline_s
+    summary = {
+        "out_dir": out,
+        "epoch_log": res[0]["epoch_log"],
+        "loss": got,
+        "reference": ref,
+        "recoveries": res[0]["recoveries"] + res[1]["recoveries"],
+        "recovery_wall_s": recovery_wall_s,
+        "step_baseline_s": step_baseline_s,
+        "t_kill_to_shrink_commit_s": t_shrink - t_kill,
+        "snap_freq": snap_freq,
+    }
+    daemon_store._daemon.stop()
+    assert recovery_wall_s < TIMEOUT_S, (
+        f"recovery took {recovery_wall_s:.2f}s, over the "
+        f"{TIMEOUT_S}s elastic timeout")
+    print(f"elastic_drill: shrink@{KILL_AT} expand@"
+          f"{res[0]['epoch_log'][-1]['from_step']} "
+          f"recovery={recovery_wall_s:.2f}s (budget {TIMEOUT_S}s) "
+          f"loss parity OK over {TOTAL} steps")
+    return summary
+
+
+def main_determinism() -> int:
+    """Slow arm: two full drills (snap_freq=2 exercises off-step
+    snapshots + replayed steps) must produce identical trajectories."""
+    a = main(snap_freq=2)
+    b = main(snap_freq=2)
+    assert a["loss"] == b["loss"], "drill runs diverge"
+    assert a["epoch_log"] == b["epoch_log"], \
+        f"membership schedules diverge: {a['epoch_log']} " \
+        f"vs {b['epoch_log']}"
+    print("elastic_drill determinism: two runs bit-identical "
+          f"({len(a['loss'])} steps, {len(a['epoch_log'])} epochs)")
+    return 0
+
+
+if __name__ == "__main__":
+    if "--worker" in sys.argv:
+        sys.exit(_worker_main())
+    if "--determinism" in sys.argv:
+        sys.exit(main_determinism())
+    main()
+    sys.exit(0)
