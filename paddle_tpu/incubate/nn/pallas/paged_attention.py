@@ -32,12 +32,22 @@ try:
 except ImportError:  # pragma: no cover
     pltpu = None
 
-__all__ = ["paged_attention", "paged_kv_write", "paged_kv_write_chunk",
-           "quantize_kv_pages"]
+__all__ = ["paged_attention", "ragged_paged_attention", "paged_kv_write",
+           "paged_kv_write_chunk", "quantize_kv_pages"]
 
 
 def _interpret_default() -> bool:
     return jax.default_backend() != "tpu"
+
+
+def _dequant(q8, s, dtype=jnp.float32):
+    """The ONE int8-page decode rule: ``value = q8 * s`` with the
+    per-row absmax scale broadcast over the trailing head dim.  Every
+    consumer of ``{"q8","s"}`` pages decodes through this helper — the
+    XLA gather path, the ragged Pallas kernel, and the engine's
+    cross-pool handoff import — so the representation has exactly one
+    reader (the write side is :func:`_quantize_rows`)."""
+    return q8.astype(dtype) * s[..., None].astype(dtype)
 
 
 def _decode_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
@@ -93,10 +103,9 @@ def _xla_paged_attention(q, k_pages, v_pages, block_tables, context_lens,
     entries over uninitialized pages, so their rows are forced to zero
     instead of softmax(all -inf) = NaN over garbage gathers. Pools may
     be plain arrays or int8 dicts ``{"q8": [kv, pages, page, d] int8,
-    "s": [kv, pages, page] f32}`` — the dequant is applied on the score
-    side / folded into the V weights exactly like the dense int8 cache
-    path in models/generation.py, so no bf16 copy of the pool is ever
-    materialized."""
+    "s": [kv, pages, page] f32}`` — gathered rows decode through the
+    shared :func:`_dequant` rule; the elementwise scale feeds straight
+    into the einsum so XLA fuses it (no separate f32 pool copy)."""
     bsz, n_heads, d = q.shape
     quant = isinstance(k_pages, dict)
     kp = k_pages["q8"] if quant else k_pages
@@ -113,26 +122,20 @@ def _xla_paged_attention(q, k_pages, v_pages, block_tables, context_lens,
 
     qg = q.reshape(bsz, n_kv, group, d).astype(jnp.float32)
     if quant:
-        kg = gather(k_pages["q8"])
-        ks = gather(k_pages["s"])               # [b, n_kv, max_len]
-        s = jnp.einsum("bkgd,bktd->bkgt", qg, kg.astype(jnp.float32))
-        s = s * ks[:, :, None, :] * scale
+        kg = _dequant(gather(k_pages["q8"]), gather(k_pages["s"]))
     else:
-        kg = gather(k_pages)
-        s = jnp.einsum("bkgd,bktd->bkgt", qg,
-                       kg.astype(jnp.float32)) * scale
+        kg = gather(k_pages).astype(jnp.float32)
+    s = jnp.einsum("bkgd,bktd->bkgt", qg, kg) * scale
     mask = jnp.arange(max_len)[None, None, None, :] \
         < context_lens[:, None, None, None]
     s = jnp.where(mask, s, -jnp.inf)
     # empty slot: all positions masked -> softmax would be 0/0 = NaN
     w = jnp.where(mask, jax.nn.softmax(s, axis=-1), 0.0)
     if quant:
-        vg = gather(v_pages["q8"])
-        vs = gather(v_pages["s"])
-        w = w * vs[:, :, None, :]
+        vg = _dequant(gather(v_pages["q8"]), gather(v_pages["s"]))
     else:
-        vg = gather(v_pages)
-    out = jnp.einsum("bkgt,bktd->bkgd", w, vg.astype(jnp.float32))
+        vg = gather(v_pages).astype(jnp.float32)
+    out = jnp.einsum("bkgt,bktd->bkgd", w, vg)
     return out.reshape(bsz, n_heads, d).astype(q.dtype)
 
 
@@ -208,6 +211,286 @@ def paged_attention(q, k_pages, v_pages, block_tables, context_lens,
       k_pages.reshape(n_kv, total_pages, page, d),
       v_pages)
     return out.reshape(bsz, n_heads, d)
+
+
+# ---------------------------------------------------------------------------
+# Ragged paged attention: mixed prefill+decode rows in ONE launch.
+#
+# The serving engine used to dispatch two jitted programs per scheduler
+# tick — a [1, prefill_chunk] chunked-prefill step and a [max_slots]
+# decode step. The ragged kernel kills that dispatch seam: the batch is
+# a FLAT token axis [T] packed row-major (row r owns tokens
+# q_starts[r] .. q_starts[r]+query_lens[r]), where a decode row
+# contributes query_lens == 1 token and a prefill row contributes its
+# whole chunk. context_lens[r] is the total KV length of row r AFTER
+# this step's tokens are written, so token j of row r (0-based within
+# the row) attends causally to KV positions < context_lens[r] -
+# query_lens[r] + j + 1. Rows with query_lens == 0 and padding tokens
+# (not owned by any row) produce zeros.
+# ---------------------------------------------------------------------------
+
+
+def _ragged_accumulate(q2, k, v, start, n, ctx, p, m_s, l_s, acc_s, *,
+                       scale, page_size, group):
+    """Online-softmax update of (m, l, acc) scratch for ONE (row, page)
+    visit. ``q2`` is the whole flat token batch [T*group, d] — tokens
+    outside row ``b``'s [start, start+n) span and KV slots beyond the
+    causal limit are masked to -inf, so foreign rows' statistics are
+    untouched (alpha == 1 / pexp == 0 for them). Same guarded math as
+    :func:`_decode_kernel` (fully-masked visits keep m at -inf)."""
+    s = jax.lax.dot_general(q2, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    tok = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) // group
+    kv_pos = page_size * p + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    # causal limit for token j = tok - start of row b: ctx - n + j + 1
+    limit = ctx - n + (tok - start) + 1
+    mask = (tok >= start) & (tok < start + n) & (kv_pos < limit)
+    s = jnp.where(mask, s, -jnp.inf)
+
+    m_prev = m_s[...]
+    m_cur = jnp.max(s, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    safe_m = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    alpha = jnp.where(jnp.isfinite(m_prev), jnp.exp(m_prev - safe_m), 0.0)
+    pexp = jnp.where(jnp.isfinite(s), jnp.exp(s - safe_m), 0.0)
+    l_s[...] = l_s[...] * alpha + jnp.sum(pexp, axis=-1, keepdims=True)
+    acc_s[...] = acc_s[...] * alpha + jax.lax.dot_general(
+        pexp, v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    m_s[...] = m_new
+
+
+def _ragged_kernel(bt_ref, cl_ref, ql_ref, qs_ref, q_ref, k_ref, v_ref,
+                   o_ref, m_s, l_s, acc_s, *, scale, page_size, group):
+    """Grid (n_kv_heads, rows, pages_per_seq). The output block depends
+    only on the head index, so it is revisited consecutively across the
+    (row, page) inner dims — scratch spans the WHOLE flat token axis
+    and is reset once per head, flushed at the last (row, page) step.
+    v1 masking cost: each (row, page) visit computes scores for all T
+    tokens and masks the foreign ones; fine for serving-step T (tens to
+    low hundreds), revisit with per-row q blocking if T grows."""
+    b = pl.program_id(1)
+    p = pl.program_id(2)
+    last = (b == pl.num_programs(1) - 1) & (p == pl.num_programs(2) - 1)
+
+    @pl.when((b == 0) & (p == 0))
+    def _init():
+        m_s[...] = jnp.full_like(m_s, -jnp.inf)
+        l_s[...] = jnp.zeros_like(l_s)
+        acc_s[...] = jnp.zeros_like(acc_s)
+
+    @pl.when((ql_ref[b] > 0) & (page_size * p < cl_ref[b]))
+    def _accum():
+        q = q_ref[:, 0].astype(jnp.float32)       # [T, group, d]
+        t, g, d = q.shape
+        _ragged_accumulate(q.reshape(t * g, d),
+                           k_ref[0, 0].astype(jnp.float32),
+                           v_ref[0, 0].astype(jnp.float32),
+                           qs_ref[b], ql_ref[b], cl_ref[b], p,
+                           m_s, l_s, acc_s, scale=scale,
+                           page_size=page_size, group=group)
+
+    @pl.when(last)
+    def _flush():
+        l = l_s[...]
+        l = jnp.where(l == 0.0, 1.0, l)
+        t = q_ref.shape[0]
+        d = q_ref.shape[3]
+        o_ref[:, 0] = (acc_s[...] / l).reshape(t, group, d) \
+            .astype(o_ref.dtype)
+
+
+def _ragged_kernel_q8(bt_ref, cl_ref, ql_ref, qs_ref, q_ref, k8_ref,
+                      ks_ref, v8_ref, vs_ref, o_ref, m_s, l_s, acc_s, *,
+                      scale, page_size, group):
+    """int8-pool variant of :func:`_ragged_kernel`: K/V page blocks
+    arrive as (q8, per-row scale) pairs and decode in-register through
+    the shared :func:`_dequant` rule."""
+    b = pl.program_id(1)
+    p = pl.program_id(2)
+    last = (b == pl.num_programs(1) - 1) & (p == pl.num_programs(2) - 1)
+
+    @pl.when((b == 0) & (p == 0))
+    def _init():
+        m_s[...] = jnp.full_like(m_s, -jnp.inf)
+        l_s[...] = jnp.zeros_like(l_s)
+        acc_s[...] = jnp.zeros_like(acc_s)
+
+    @pl.when((ql_ref[b] > 0) & (page_size * p < cl_ref[b]))
+    def _accum():
+        q = q_ref[:, 0].astype(jnp.float32)       # [T, group, d]
+        t, g, d = q.shape
+        k = _dequant(k8_ref[0, 0], ks_ref[0, 0])      # [page, d]
+        v = _dequant(v8_ref[0, 0], vs_ref[0, 0])
+        _ragged_accumulate(q.reshape(t * g, d), k, v,
+                           qs_ref[b], ql_ref[b], cl_ref[b], p,
+                           m_s, l_s, acc_s, scale=scale,
+                           page_size=page_size, group=group)
+
+    @pl.when(last)
+    def _flush():
+        l = l_s[...]
+        l = jnp.where(l == 0.0, 1.0, l)
+        t = q_ref.shape[0]
+        d = q_ref.shape[3]
+        o_ref[:, 0] = (acc_s[...] / l).reshape(t, group, d) \
+            .astype(o_ref.dtype)
+
+
+def _token_rows(q_starts, query_lens, n_tokens):
+    """Derive the per-token owning row [T] (-1 for padding tokens) from
+    per-row spans. Used by the XLA fallback when the caller did not
+    pass ``row_of`` explicitly."""
+    t = jnp.arange(n_tokens)
+    in_row = (t[None, :] >= q_starts[:, None]) & \
+        (t[None, :] < (q_starts + query_lens)[:, None])
+    return jnp.where(jnp.any(in_row, axis=0),
+                     jnp.argmax(in_row, axis=0), -1)
+
+
+def _xla_ragged_paged_attention(q, k_pages, v_pages, block_tables,
+                                context_lens, query_lens, q_starts,
+                                row_of, scale):
+    """XLA-composition fallback: expand the ragged batch to per-TOKEN
+    (lens, block-table) views and delegate to the existing batched
+    :func:`_xla_paged_attention` (b == T, one 'sequence' per token with
+    its causal prefix length). Padding tokens get lens == 0 -> zeros."""
+    n_tokens = q.shape[0]
+    n_rows = block_tables.shape[0]
+    if row_of is None:
+        row_of = _token_rows(q_starts, query_lens, n_tokens)
+    r = jnp.clip(row_of, 0, n_rows - 1)
+    j = jnp.arange(n_tokens) - q_starts[r]        # token idx within row
+    lens = context_lens[r] - query_lens[r] + j + 1
+    lens = jnp.where(row_of >= 0, jnp.maximum(lens, 0), 0)
+    bt_tok = jnp.take(block_tables, r, axis=0)    # [T, pages_per_seq]
+    return _xla_paged_attention(q, k_pages, v_pages, bt_tok, lens, scale)
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "interpret",
+                                             "use_kernel"))
+def ragged_paged_attention(q, k_pages, v_pages, block_tables,
+                           context_lens, query_lens, q_starts=None,
+                           row_of=None, scale=None, interpret=None,
+                           use_kernel=None):
+    """Attention for a RAGGED batch mixing prefill and decode rows over
+    one paged KV pool, in one launch (arxiv 2604.15464 style).
+
+    Layouts:
+      q:            [n_tokens, num_heads, head_dim] — flat token axis,
+                    rows packed contiguously (row r owns tokens
+                    q_starts[r] .. q_starts[r] + query_lens[r])
+      k/v_pages:    fp pool [n_kv, pages, page, d] or int8
+                    ``{"q8","s"}`` dict
+      block_tables: [n_rows, pages_per_seq] int32
+      context_lens: [n_rows] — KV length INCLUDING this step's tokens
+      query_lens:   [n_rows] — tokens this row contributes (1 for a
+                    decode row, the chunk length for prefill, 0 for an
+                    idle slot)
+      q_starts:     [n_rows] exclusive prefix of query_lens (derived
+                    when omitted)
+      row_of:       [n_tokens] owning row per token, -1 for padding
+                    (derived from q_starts/query_lens when omitted)
+
+    Token j of row r attends to KV positions
+    ``< context_lens[r] - query_lens[r] + j + 1`` (causal within the
+    chunk, full history before it). Idle rows (query_lens == 0) and
+    padding tokens return zeros. int8 pools decode through the shared
+    :func:`_dequant` rule on both the kernel and XLA paths."""
+    n_tokens, n_heads, d = q.shape
+    quant = isinstance(k_pages, dict)
+    kp = k_pages["q8"] if quant else k_pages
+    n_kv, total_pages, page, _ = kp.shape
+    assert n_heads % n_kv == 0
+    group = n_heads // n_kv
+    n_rows, pages_per_seq = block_tables.shape
+    if scale is None:
+        scale = d ** -0.5
+    if interpret is None:
+        interpret = _interpret_default()
+    if q_starts is None:
+        q_starts = jnp.concatenate(
+            [jnp.zeros((1,), jnp.int32),
+             jnp.cumsum(query_lens.astype(jnp.int32))[:-1]])
+    if use_kernel is None:
+        # same tile constraints as the decode kernel; int8 dicts default
+        # to the XLA composition (matching paged_attention) unless the
+        # caller opts the kernel in explicitly
+        use_kernel = (not quant) and \
+            ((d in (64, 128, 256) and page % 128 == 0) or interpret)
+    if not use_kernel:
+        return _xla_ragged_paged_attention(
+            q, k_pages, v_pages, block_tables, context_lens, query_lens,
+            q_starts, row_of, scale)
+
+    block_tables = jnp.clip(block_tables, 0, total_pages - 1)
+    cl = context_lens.astype(jnp.int32)
+    ql = query_lens.astype(jnp.int32)
+    qs = q_starts.astype(jnp.int32)
+    qr = q.reshape(n_tokens, n_kv, group, d)
+    grid = (n_kv, n_rows, pages_per_seq)
+    scratch = [
+        pltpu.VMEM((n_tokens * group, 1), jnp.float32),
+        pltpu.VMEM((n_tokens * group, 1), jnp.float32),
+        pltpu.VMEM((n_tokens * group, d), jnp.float32),
+    ]
+    out_spec = pl.BlockSpec((n_tokens, 1, group, d),
+                            lambda h, b, p, *_: (0, h, 0, 0))
+    q_spec = pl.BlockSpec((n_tokens, 1, group, d),
+                          lambda h, b, p, *_: (0, h, 0, 0))
+    if quant:
+        kernel = functools.partial(_ragged_kernel_q8, scale=scale,
+                                   page_size=page, group=group)
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=4,      # bt, cl, ql, qs
+            grid=grid,
+            in_specs=[
+                q_spec,
+                pl.BlockSpec((1, 1, page, d),
+                             lambda h, b, p, bt, *_: (h, bt[b, p], 0, 0)),
+                pl.BlockSpec((1, 1, page),
+                             lambda h, b, p, bt, *_: (h, bt[b, p], 0)),
+                pl.BlockSpec((1, 1, page, d),
+                             lambda h, b, p, bt, *_: (h, bt[b, p], 0, 0)),
+                pl.BlockSpec((1, 1, page),
+                             lambda h, b, p, bt, *_: (h, bt[b, p], 0)),
+            ],
+            out_specs=out_spec,
+            scratch_shapes=scratch,
+        )
+        out = pl.pallas_call(
+            kernel,
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((n_tokens, n_kv, group, d),
+                                           q.dtype),
+            interpret=interpret,
+        )(block_tables, cl, ql, qs, qr,
+          k_pages["q8"], k_pages["s"], v_pages["q8"], v_pages["s"])
+        return out.reshape(n_tokens, n_heads, d)
+
+    kernel = functools.partial(_ragged_kernel, scale=scale,
+                               page_size=page, group=group)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,          # bt, cl, ql, qs
+        grid=grid,
+        in_specs=[
+            q_spec,
+            pl.BlockSpec((1, 1, page, d),
+                         lambda h, b, p, bt, *_: (h, bt[b, p], 0, 0)),
+            pl.BlockSpec((1, 1, page, d),
+                         lambda h, b, p, bt, *_: (h, bt[b, p], 0, 0)),
+        ],
+        out_specs=out_spec,
+        scratch_shapes=scratch,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((n_tokens, n_kv, group, d),
+                                       q.dtype),
+        interpret=interpret,
+    )(block_tables, cl, ql, qs, qr, k_pages, v_pages)
+    return out.reshape(n_tokens, n_heads, d)
 
 
 @jax.jit

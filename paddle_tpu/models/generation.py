@@ -418,6 +418,46 @@ class GPTDecodeAdapter(DecodeAdapter):
             new_vp.append(vpi)
         return self.logits(w, x), tuple(new_kp), tuple(new_vp)
 
+    def ragged_chunk(self, w, toks, pos, row_of, q_starts, query_lens,
+                     context_lens, kpages, vpages, block_tables):
+        """ONE ragged mixed prefill+decode step over paged pools (the
+        single-dispatch serving step). Flat token axis [T] packed
+        row-major: row r owns tokens q_starts[r] ..
+        q_starts[r]+query_lens[r], row_of [T] maps each token to its
+        row (-1 = padding). pos [T] is each token's absolute position
+        (< 0 = padding: write dropped, output ignored); block_tables
+        [n_rows, P] is per ROW; context_lens[r] counts the row's KV
+        INCLUDING this step's tokens. Returns (logits [T, V], kpages,
+        vpages)."""
+        from ..incubate.nn.pallas.paged_attention import \
+            paged_kv_write_chunk
+
+        nh, hd, dt = self.num_heads, self.head_dim, self.dtype
+        T = toks.shape[0]
+        n_rows = block_tables.shape[0]
+        bt_tok = jnp.take(block_tables,
+                          jnp.clip(row_of, 0, n_rows - 1), axis=0)
+        x = (w["wte"][toks] + w["wpe"][jnp.maximum(pos, 0)]).astype(dt)
+        new_kp, new_vp = [], []
+        for i, W in enumerate(w["layers"]):
+            h1 = _ln(x, W["ln1_w"], W["ln1_b"], self.eps)
+            qkv = _linear(h1, W["qkv_w"], W["qkv_b"]).reshape(T, 3, nh, hd)
+            q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+            kpi, vpi = paged_kv_write_chunk(kpages[i], vpages[i],
+                                            k[:, None], v[:, None],
+                                            bt_tok, pos[:, None])
+            att = _ragged_attn(q, kpi, vpi, block_tables, context_lens,
+                               query_lens, q_starts, row_of, hd)
+            x = x + _linear(att.reshape(T, nh * hd),
+                            W["out_w"], W["out_b"])
+            h2 = _ln(x, W["ln2_w"], W["ln2_b"], self.eps)
+            m = jax.nn.gelu(_linear(h2, W["fc1_w"], W["fc1_b"]),
+                            approximate=True)
+            x = x + _linear(m, W["fc2_w"], W["fc2_b"])
+            new_kp.append(kpi)
+            new_vp.append(vpi)
+        return self.logits(w, x), tuple(new_kp), tuple(new_vp)
+
 
 class LlamaDecodeAdapter(DecodeAdapter):
     """RMSNorm + rope + GQA + SwiGLU decoder (llama.py LlamaForCausalLM)."""
@@ -574,6 +614,57 @@ class LlamaDecodeAdapter(DecodeAdapter):
             new_kp.append(kpi)
             new_vp.append(vpi)
         return self.logits(w, x), tuple(new_kp), tuple(new_vp)
+
+    def ragged_chunk(self, w, toks, pos, row_of, q_starts, query_lens,
+                     context_lens, kpages, vpages, block_tables):
+        """Ragged single-dispatch serving step — see
+        GPTDecodeAdapter.ragged_chunk. GQA pools carry num_kv_heads
+        panels; rope rotates each token by its absolute position."""
+        from ..incubate.nn.pallas.paged_attention import \
+            paged_kv_write_chunk
+
+        nh, hd = self.num_heads, self.head_dim
+        dt = self.dtype
+        T = toks.shape[0]
+        n_rows = block_tables.shape[0]
+        bt_tok = jnp.take(block_tables,
+                          jnp.clip(row_of, 0, n_rows - 1), axis=0)
+        x = w["wte"][toks].astype(dt)
+        safe_pos = jnp.maximum(pos, 0)[:, None]           # [T, 1]
+        new_kp, new_vp = [], []
+        for i, W in enumerate(w["layers"]):
+            q, k, v = self._qkv(W, x[:, None], T, 1)      # [T, 1, h, d]
+            q = _rope(q, safe_pos, self.rope_base)
+            k = _rope(k, safe_pos, self.rope_base)
+            kpi, vpi = paged_kv_write_chunk(kpages[i], vpages[i], k, v,
+                                            bt_tok, pos[:, None])
+            att = _ragged_attn(q[:, 0], kpi, vpi, block_tables,
+                               context_lens, query_lens, q_starts,
+                               row_of, hd)
+            x = x + _linear(att.reshape(T, nh * hd), W["o_w"])
+            h2 = _rms(x, W["post_ln"], self.eps)
+            m = jax.nn.silu(_linear(h2, W["gate_w"])) \
+                * _linear(h2, W["up_w"])
+            x = x + _linear(m, W["down_w"])
+            new_kp.append(kpi)
+            new_vp.append(vpi)
+        return self.logits(w, x), tuple(new_kp), tuple(new_vp)
+
+
+def _ragged_attn(q, kpages, vpages, block_tables, context_lens,
+                 query_lens, q_starts, row_of, hd):
+    """Ragged mixed prefill+decode attention over PAGED pools for the
+    serving engine: q [T, nh, hd] flat token axis, per-row spans as in
+    ragged_paged_attention. Off-TPU the Pallas kernel would run
+    INTERPRETED per step — force the XLA composition there; on TPU let
+    the wrapper pick."""
+    from ..incubate.nn.pallas.paged_attention import ragged_paged_attention
+
+    on_tpu = jax.default_backend() == "tpu"
+    return ragged_paged_attention(
+        q, kpages, vpages, block_tables, context_lens, query_lens,
+        q_starts=q_starts, row_of=row_of, scale=hd ** -0.5,
+        interpret=False, use_kernel=None if on_tpu else False)
 
 
 def _paged_attn_chunk(q, kpages, vpages, block_tables, pos, hd):

@@ -36,6 +36,10 @@ TOKEN_LATENCY_BUCKETS = (1e-5, 2.5e-5, 5e-5, 1e-4, 2.5e-4, 5e-4, 1e-3,
 ELASTIC_MS_BUCKETS = (0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0,
                       100.0, 250.0, 500.0, 1000.0, 2500.0, 5000.0,
                       10000.0, 30000.0, 60000.0)
+# fill-ratio boundaries (0..1) for utilization histograms — e.g. what
+# fraction of the ragged step's token budget was actually packed
+RATIO_BUCKETS = (0.05, 0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7, 0.8, 0.9,
+                 0.95, 1.0)
 
 METRICS = {
     # ---- Engine.fit (distributed/auto_parallel/engine.py)
@@ -185,8 +189,20 @@ METRICS = {
         "one prefill chunk + one decode batch)", TIME_BUCKETS),
     "serving.decode_compiles": MetricSpec(
         "counter", "compiles", "traces of the fixed-shape decode step; "
-        "MUST stay at 1 per engine — joins/leaves are mask flips, "
-        "never recompiles"),
+        "at most 1 per engine — joins/leaves are mask flips, never "
+        "recompiles (stays 0 when the ragged step serves instead)"),
+    "serving.ragged_steps": MetricSpec(
+        "counter", "steps", "ragged mixed prefill+decode dispatches — "
+        "ONE jitted program per scheduler tick when "
+        "PADDLE_TPU_SERVE_RAGGED is on (the default)"),
+    "serving.ragged_compiles": MetricSpec(
+        "counter", "compiles", "traces of the fixed-shape ragged step; "
+        "MUST stay at 1 per engine — rows join/leave and chunk packing "
+        "varies by mask (query_lens == 0 = idle row), never by shape"),
+    "serving.ragged_fill": MetricSpec(
+        "histogram", "fraction", "fraction of the ragged step's token "
+        "budget actually packed (decode rows + prefill chunk tokens)",
+        RATIO_BUCKETS),
     # ---- multi-replica serving cluster (serving/cluster/)
     "cluster.submitted": MetricSpec(
         "counter", "requests", "requests admitted by the cluster "
@@ -355,6 +371,8 @@ SPANS = {
     "serving.step": "one ServingEngine step (admit + prefill + decode)",
     "serving.prefill": "one chunked-prefill dispatch (rid/n in args)",
     "serving.decode": "one fixed-shape decode-batch dispatch",
+    "serving.ragged_step": "one ragged mixed prefill+decode dispatch "
+                           "(rows/tokens packed in args)",
     "cluster.route": "one router admission decision (affinity lookup + "
                      "health snapshots + submit)",
     "cluster.handoff": "one disaggregated prefill->decode KV-page "

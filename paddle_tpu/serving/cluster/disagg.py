@@ -15,8 +15,10 @@ replica seats the payload straight into a RUNNING slot
 Why bother: prefill batches are compute-bound and bursty, decode
 batches are memory-bound and steady; splitting the tiers isolates the
 mixed-phase interference (a long prompt no longer stalls every decode
-stream behind one chunk) and is the batch shape the ragged
-paged-attention kernel work targets.
+stream behind one chunk). On the decode tier each adopted handoff
+seats as a plain RUNNING slot, i.e. a ``query_lens == 1`` row of the
+ragged mixed-phase batch — the decode tier's ragged step is simply
+all-decode, so adoption needs no special dispatch path.
 
 The pump is crash-aware in both directions: a payload already exported
 from a prefill replica survives that replica's death (it is host data),

@@ -3,17 +3,22 @@
 The engine owns the physical KV pools (per layer,
 ``[n_kv, num_blocks, block_size, head_dim]``, fp or int8 ``{"q8","s"}``
 pages), a :class:`BlockManager` for the page index space, a
-:class:`Scheduler` for slots, and exactly TWO jitted programs:
+:class:`Scheduler` for slots, and — by default — exactly ONE jitted
+program: a fixed-shape RAGGED step (``ragged_paged_attention``) whose
+flat ``[token_budget]`` token axis packs every RUNNING slot's decode
+token next to as many prefill-chunk tokens as fit, so mixed
+prefill+decode traffic costs one dispatch per scheduler tick and
+prefill no longer serializes against decode. Rows join and leave by
+mask (``query_lens == 0`` = idle slot, position ``-1`` = padding), so
+the step compiles once and never again (``ragged_compiles`` asserts
+this).
 
-* one fixed-shape decode step over ``max_slots`` rows — requests join
-  and leave by mask (position ``-1`` = empty slot), so the step
-  compiles once and never again (``decode_compiles`` asserts this);
-* one fixed-shape prefill-chunk step (``[1, prefill_chunk]``) that
-  streams a prompt into its pages chunk-by-chunk, interleaved with
-  decode steps so running requests keep emitting while a long prompt
-  loads.
+``PADDLE_TPU_SERVE_RAGGED=off`` restores the previous TWO-program
+layout byte-for-byte — one ``max_slots``-row decode step plus one
+``[1, prefill_chunk]`` prefill step, interleaved (``decode_compiles`` /
+``prefill_compiles`` assert their once-only traces there).
 
-Both programs are pure — pools in, pools out — which makes the
+All step programs are pure — pools in, pools out — which makes the
 dispatch safely retryable: the step body runs under
 ``resilience.call_with_retry`` (site ``serving.step``) with the retry
 deadline derived from the nearest per-request deadline, and
@@ -43,7 +48,8 @@ import jax.numpy as jnp
 from .. import observability as _obs
 from ..distributed.resilience import faults
 from ..distributed.resilience.retry import call_with_retry, default_policy
-from ..incubate.nn.pallas.paged_attention import quantize_kv_pages
+from ..incubate.nn.pallas.paged_attention import (_dequant,
+                                                  quantize_kv_pages)
 from ..models.generation import _sample
 from ..observability.tracing import span
 from .block_manager import BlockManager
@@ -86,6 +92,7 @@ class EngineStats:
     active_slots: int
     max_slots: int
     decode_compiles: int
+    ragged_compiles: int
     inflight: Tuple[RequestDescriptor, ...]
 
     def can_admit(self, n_blocks: int) -> bool:
@@ -141,7 +148,8 @@ class EngineConfig:
 
     def __init__(self, max_slots=None, block_size=None, num_blocks=None,
                  prefill_chunk=None, max_seq_len=None, kv_quant=None,
-                 watermark=0.01, enable_prefix_cache=True, seed=0):
+                 watermark=0.01, enable_prefix_cache=True, seed=0,
+                 ragged=None, token_budget=None):
         self.max_slots = max_slots or _env_int(
             "PADDLE_TPU_SERVE_SLOTS", 8)
         self.block_size = block_size or _env_int(
@@ -155,8 +163,22 @@ class EngineConfig:
         self.watermark = watermark
         self.enable_prefix_cache = enable_prefix_cache
         self.seed = seed
+        # ragged single-dispatch step: auto (-> on) | on | off.  "off"
+        # restores the two-program decode+prefill layout byte-for-byte.
+        self.ragged = (ragged or os.environ.get(
+            "PADDLE_TPU_SERVE_RAGGED") or "auto").lower()
+        # token axis of the ragged step: decode rows + prefill chunk
+        # tokens packed per step (clamped to >= max_slots in the engine)
+        self.token_budget = token_budget or _env_int(
+            "PADDLE_TPU_SERVE_TOKEN_BUDGET",
+            self.max_slots + self.prefill_chunk)
         if self.kv_quant not in (None, "int8"):
             raise ValueError("kv_quant must be None or 'int8'")
+        if self.ragged not in ("auto", "on", "off"):
+            raise ValueError(
+                "PADDLE_TPU_SERVE_RAGGED must be auto|on|off")
+        if self.token_budget <= 0:
+            raise ValueError("token_budget must be > 0")
 
 
 class ServingEngine:
@@ -192,8 +214,14 @@ class ServingEngine:
         self._key = jax.random.PRNGKey(cfg.seed)
         self.decode_compiles = 0
         self.prefill_compiles = 0
+        self.ragged_compiles = 0
         self._decode_fn = jax.jit(self._decode_step)
         self._prefill_fn = jax.jit(self._prefill_step)
+        self._ragged_fn = jax.jit(self._ragged_step)
+        self._ragged = cfg.ragged != "off"      # auto -> on
+        # the flat token axis must cover the worst-case decode rows
+        # (max_slots - 1 running + 1 prefill slot needing >= 1 token)
+        self._token_budget = max(cfg.token_budget, cfg.max_slots)
 
         self._lock = threading.RLock()
         self._wakeup = threading.Event()
@@ -223,6 +251,21 @@ class ServingEngine:
         lg, kp, vp = self._ad.paged_chunk(w, toks, pos, kp, vp, bt_row)
         row = jnp.take(lg[0], last_idx, axis=0)
         nxt = _sample(row[None], key, temp[None], top_p[None])[0]
+        return nxt, kp, vp
+
+    def _ragged_step(self, w, toks, pos, row_of, qs, ql, cl, kp, vp,
+                     bt, temp, top_p, key):
+        """THE serving step when ragged mode is on: one dispatch covers
+        every decode row and every packed prefill-chunk token. Samples
+        one candidate token per row from its last logit (idle rows
+        sample garbage that the host discards)."""
+        self.ragged_compiles += 1  # ptlint: disable=jit-purity  (trace-time compile counter)
+        if _obs.enabled():
+            _obs.registry.counter("serving.ragged_compiles").inc()
+        lg, kp, vp = self._ad.ragged_chunk(
+            w, toks, pos, row_of, qs, ql, cl, kp, vp, bt)
+        last = jnp.clip(qs + ql - 1, 0, toks.shape[0] - 1)
+        nxt = _sample(jnp.take(lg, last, axis=0), key, temp, top_p)
         return nxt, kp, vp
 
     # ----------------------------------------------------- public intake
@@ -331,6 +374,7 @@ class ServingEngine:
                 active_slots=self.scheduler.num_active(),
                 max_slots=self.config.max_slots,
                 decode_compiles=self.decode_compiles,
+                ragged_compiles=self.ragged_compiles,
                 inflight=inflight)
 
     @property
@@ -358,10 +402,12 @@ class ServingEngine:
 
     # ------------------------------------------------------- AOT warmup
     def warmup(self, token: int = 0) -> None:
-        """AOT warmup: run one tiny request through the engine so BOTH
-        jitted programs (prefill-chunk and fixed-shape decode) are
-        traced and compiled before real traffic arrives — a fresh
-        replica serves its first token without a cold compile. The
+        """AOT warmup: run one tiny request through the engine so the
+        active step program is traced and compiled before real traffic
+        arrives — the single ragged jit by default, or BOTH legacy
+        programs (prefill-chunk and fixed-shape decode) when
+        ``PADDLE_TPU_SERVE_RAGGED=off`` — so a fresh replica serves its
+        first token without a cold compile. The
         1-token prompt registers nothing in the prefix cache (only full
         blocks are hashed) and the pool drains back to empty."""
         if self._thread is not None:
@@ -405,9 +451,9 @@ class ServingEngine:
                     "s": pool["s"].at[:, idx].set(
                         jnp.asarray(pages["s"]))}
         if isinstance(pages, dict):
-            # int8 wire payload into an fp pool: dequantize rows
-            deq = pages["q8"].astype(np.float32) * \
-                pages["s"][..., None].astype(np.float32)
+            # int8 wire payload into an fp pool: decode through the
+            # shared page-codec rule
+            deq = _dequant(pages["q8"], pages["s"])
             return pool.at[:, idx].set(jnp.asarray(deq, pool.dtype))
         return pool.at[:, idx].set(jnp.asarray(pages, pool.dtype))
 
@@ -480,8 +526,10 @@ class ServingEngine:
 
     # ------------------------------------------------------- step engine
     def step(self) -> bool:
-        """One scheduler round: admit, one prefill chunk, one decode
-        batch.  Returns False when there was nothing to do."""
+        """One scheduler round. Ragged mode (the default): admit, then
+        ONE mixed dispatch covering every decode row plus packed
+        prefill chunks. Off mode: admit, one prefill chunk, one decode
+        batch. Returns False when there was nothing to do."""
         t0 = time.monotonic()
         with self._lock, span("serving.step"):
             if self._dead:
@@ -492,13 +540,18 @@ class ServingEngine:
                 if req.num_cached and _obs.enabled():
                     _obs.registry.counter(
                         "serving.prefix_hit_tokens").inc(req.num_cached)
-            chunk = self.scheduler.next_prefill()
-            if chunk is not None:
-                self._run_prefill(chunk)
-            preempted = self.scheduler.ensure_decode_blocks()
-            running = self.scheduler.running()
-            if running:
-                self._run_decode(running)
+            if self._ragged:
+                preempted = self.scheduler.ensure_decode_blocks()
+                worked = self._run_ragged()
+            else:
+                chunk = self.scheduler.next_prefill()
+                if chunk is not None:
+                    self._run_prefill(chunk)
+                preempted = self.scheduler.ensure_decode_blocks()
+                running = self.scheduler.running()
+                if running:
+                    self._run_decode(running)
+                worked = chunk is not None or bool(running)
             if _obs.enabled():
                 if preempted:
                     _obs.registry.counter("serving.preemptions").inc(
@@ -509,7 +562,7 @@ class ServingEngine:
                     self.scheduler.num_active())
                 _obs.registry.histogram("serving.step_time").observe(
                     time.monotonic() - t0)
-            return bool(admitted or chunk is not None or running)
+            return bool(admitted or worked)
 
     def _dispatch(self, fn):
         """Run one jitted step under the resilience machinery: injected
@@ -530,6 +583,106 @@ class ServingEngine:
 
         return call_with_retry(body, default_policy(deadline=nearest),
                                site="serving.step")
+
+    def _run_ragged(self) -> bool:  # ptlint: holds=_lock
+        """Build and dispatch ONE ragged mixed batch: every RUNNING
+        slot contributes its decode token, then PREFILL slots pack
+        prompt chunks into the remaining token budget (oldest first).
+        All arrays are fixed padded shapes — [token_budget] tokens,
+        [max_slots] rows (row index == slot index) — so the single jit
+        traces exactly once for the engine's lifetime."""
+        cfg = self.config
+        R = cfg.max_slots
+        T = self._token_budget
+        running = self.scheduler.running()
+        chunks = self.scheduler.next_prefills(T - len(running))
+        if not running and not chunks:
+            return False
+        toks = np.zeros(T, np.int32)
+        pos = np.full(T, -1, np.int32)
+        row_of = np.full(T, -1, np.int32)
+        qs = np.zeros(R, np.int32)
+        ql = np.zeros(R, np.int32)
+        cl = np.zeros(R, np.int32)
+        temp = np.zeros(R, np.float32)
+        top_p = np.ones(R, np.float32)
+        bt = np.zeros((R, self.pages_per_seq), np.int32)
+        cursor = 0
+        for req in running:
+            s = req.slot
+            qs[s] = cursor
+            ql[s] = 1
+            cl[s] = req.total_len()
+            toks[cursor] = req.generated[-1]
+            pos[cursor] = req.decode_pos()
+            row_of[cursor] = s
+            temp[s] = req.temperature
+            top_p[s] = req.top_p
+            bt[s, :len(req.blocks)] = req.blocks
+            cursor += 1
+        for ch in chunks:
+            req = ch.req
+            s = req.slot
+            n = len(ch.tokens)
+            qs[s] = cursor
+            ql[s] = n
+            cl[s] = ch.start + n
+            toks[cursor:cursor + n] = ch.tokens
+            pos[cursor:cursor + n] = np.arange(ch.start, ch.start + n)
+            row_of[cursor:cursor + n] = s
+            temp[s] = req.temperature
+            top_p[s] = req.top_p
+            bt[s, :len(req.blocks)] = req.blocks
+            cursor += n
+        n_prefill = cursor - len(running)
+        self._key, sub = jax.random.split(self._key)
+        with span("serving.ragged_step",
+                  args={"rows": len(running) + len(chunks),
+                        "tokens": cursor}):
+            nxt, self._kp, self._vp = self._dispatch(
+                lambda: self._ragged_fn(
+                    self._w, jnp.asarray(toks), jnp.asarray(pos),
+                    jnp.asarray(row_of), jnp.asarray(qs),
+                    jnp.asarray(ql), jnp.asarray(cl), self._kp,
+                    self._vp, jnp.asarray(bt), jnp.asarray(temp),
+                    jnp.asarray(top_p), sub))
+        out = np.asarray(nxt)
+        if _obs.enabled():
+            _obs.registry.counter("serving.ragged_steps").inc()
+            if running:
+                _obs.registry.counter("serving.decode_tokens").inc(
+                    len(running))
+            if n_prefill:
+                _obs.registry.counter("serving.prefill_tokens").inc(
+                    n_prefill)
+            _obs.registry.histogram("serving.ragged_fill").observe(
+                cursor / T)
+        for req in running:
+            if req.state == RUNNING:     # not cancelled mid-dispatch
+                self._emit(req, int(out[req.slot]))
+        for ch in chunks:
+            req = ch.req
+            if req.state != PREFILL:     # cancelled mid-dispatch
+                continue
+            req.prefilled = ch.start + len(ch.tokens)
+            if not ch.last:
+                continue
+            # first token emits in the SAME step the final chunk
+            # completes; TTFT is observed once per request (a preempted
+            # request re-prefills but already streamed its first token)
+            if req.first_token_at is None:
+                req.first_token_at = time.monotonic()
+                if _obs.enabled():
+                    _obs.registry.histogram("serving.ttft").observe(
+                        req.first_token_at - req.arrival)
+            if req.handoff:
+                req.state = HANDOFF
+                req.handoff_token = int(out[req.slot])
+                self._handoff_ready.append(req)
+            else:
+                req.state = RUNNING
+                self._emit(req, int(out[req.slot]))
+        return True
 
     def _run_prefill(self, chunk: PrefillChunk) -> None:  # ptlint: holds=_lock
         req, cfg = chunk.req, self.config
@@ -552,10 +705,14 @@ class ServingEngine:
         if _obs.enabled():
             _obs.registry.counter("serving.prefill_tokens").inc(n)
         if chunk.last:
-            req.first_token_at = time.monotonic()
-            if _obs.enabled():
-                _obs.registry.histogram("serving.ttft").observe(
-                    req.first_token_at - req.arrival)
+            # observed once per request: a preempted request re-prefills
+            # (prompt + generated folded) but its first token already
+            # streamed long ago — re-stamping would corrupt serving.ttft
+            if req.first_token_at is None:
+                req.first_token_at = time.monotonic()
+                if _obs.enabled():
+                    _obs.registry.histogram("serving.ttft").observe(
+                        req.first_token_at - req.arrival)
             if req.handoff:
                 # disaggregated prefill: park for take_handoff() — the
                 # pages stay resident until the payload is exported

@@ -207,6 +207,30 @@ class Scheduler:
                             req.prompt[start:start + n],
                             last=start + n == len(req.prompt))
 
+    def next_prefills(self, token_budget: int) -> List[PrefillChunk]:
+        """Ragged-step prefill packing: oldest-first PREFILL slots each
+        take as many prompt tokens as still fit ``token_budget``.  The
+        budget bounds per-step latency globally, so there is no
+        per-request chunk cap — several short prompts can finish their
+        whole prefill in one step, riding alongside the decode rows."""
+        chunks: List[PrefillChunk] = []
+        left = int(token_budget)
+        cands = sorted((r for r in self.slots.values()
+                        if r.state == PREFILL),
+                       key=lambda r: r.arrival)
+        for req in cands:
+            if left <= 0:
+                break
+            start = req.prefilled
+            n = min(left, len(req.prompt) - start)
+            if n <= 0:
+                continue
+            chunks.append(PrefillChunk(req, start,
+                                       req.prompt[start:start + n],
+                                       last=start + n == len(req.prompt)))
+            left -= n
+        return chunks
+
     def ensure_decode_blocks(self) -> List[Request]:
         """Before a decode step, make sure every RUNNING request owns
         the page its next KV write lands in; preempt

@@ -7,7 +7,8 @@ import jax
 import jax.numpy as jnp
 
 from paddle_tpu.incubate.nn.pallas.paged_attention import (
-    _xla_paged_attention, paged_attention, paged_kv_write)
+    _dequant, _xla_paged_attention, paged_attention, paged_kv_write,
+    quantize_kv_pages, ragged_paged_attention)
 
 
 def _np_reference(q, k_pages, v_pages, block_tables, context_lens, scale):
@@ -226,8 +227,6 @@ class TestPagedKVWriteChunk:
 
 class TestInt8Pages:
     def test_quantized_pool_attention_close(self):
-        from paddle_tpu.incubate.nn.pallas.paged_attention import \
-            quantize_kv_pages
         q, kp, vp, bt, lens = _setup(n_heads=4, n_kv=2, d=32, page=16,
                                      pages_per_seq=2, seed=11)
         qkp = quantize_kv_pages(jnp.asarray(kp))
@@ -239,8 +238,6 @@ class TestInt8Pages:
         np.testing.assert_allclose(out, ref, rtol=0.15, atol=0.15)
 
     def test_quantized_empty_slot_zeros(self):
-        from paddle_tpu.incubate.nn.pallas.paged_attention import \
-            quantize_kv_pages
         q, kp, vp, bt, lens = _setup(bsz=2, n_kv=2, d=32, page=16,
                                      pages_per_seq=2, seed=12)
         lens = np.array([10, 0], dtype=np.int32)
@@ -250,3 +247,209 @@ class TestInt8Pages:
             jnp.asarray(lens)))
         assert np.isfinite(out).all()
         np.testing.assert_array_equal(out[1], np.zeros_like(out[1]))
+
+
+class TestQuantizeRoundTrip:
+    """Direct bound on the int8 page codec: symmetric per-(row, head)
+    absmax quantization reconstructs every element within half a
+    quantization step (s = absmax / 127)."""
+
+    def test_round_trip_error_bound(self):
+        rng = np.random.RandomState(21)
+        pages = (rng.randn(2, 5, 8, 16) * 3.0).astype(np.float32)
+        qp = quantize_kv_pages(jnp.asarray(pages))
+        deq = np.asarray(_dequant(qp["q8"], qp["s"]))
+        s_row = np.abs(pages).max(axis=-1) / 127.0
+        bound = 0.5 * s_row[..., None] + 1e-6
+        assert (np.abs(deq - pages) <= bound).all()
+        # scales are the advertised absmax/127 (clamped away from 0)
+        np.testing.assert_allclose(np.asarray(qp["s"]),
+                                   np.maximum(s_row, 1e-8), rtol=1e-6)
+
+    def test_round_trip_tiny_rows(self):
+        # all-zero rows must survive (scale clamp, not 0/0)
+        pages = np.zeros((1, 2, 4, 8), np.float32)
+        qp = quantize_kv_pages(jnp.asarray(pages))
+        deq = np.asarray(_dequant(qp["q8"], qp["s"]))
+        np.testing.assert_array_equal(deq, 0.0)
+
+
+def _np_ragged_reference(q, k_pages, v_pages, block_tables, context_lens,
+                         query_lens, scale):
+    """Loop-based reference: token j of row r attends causally to KV
+    positions < context_lens[r] - query_lens[r] + j + 1. Padding tokens
+    (beyond the packed rows) are zeros."""
+    n_tokens, n_heads, d = q.shape
+    n_kv, _, page, _ = k_pages.shape
+    group = n_heads // n_kv
+    out = np.zeros_like(q, dtype=np.float32)
+    starts = np.concatenate([[0], np.cumsum(query_lens)[:-1]])
+    for r in range(len(query_lens)):
+        for j in range(int(query_lens[r])):
+            t = int(starts[r]) + j
+            L = int(context_lens[r]) - int(query_lens[r]) + j + 1
+            if L <= 0:
+                continue
+            n_pages_used = (L + page - 1) // page
+            for h in range(n_heads):
+                kv_h = h // group
+                rows = [k_pages[kv_h, int(block_tables[r, pi])]
+                        for pi in range(n_pages_used)]
+                K = np.concatenate(rows, axis=0)[:L]
+                rows = [v_pages[kv_h, int(block_tables[r, pi])]
+                        for pi in range(n_pages_used)]
+                V = np.concatenate(rows, axis=0)[:L]
+                s = (q[t, h].astype(np.float32)
+                     @ K.T.astype(np.float32)) * scale
+                w = np.exp(s - s.max())
+                w = w / w.sum()
+                out[t, h] = w @ V.astype(np.float32)
+    return out
+
+
+def _ragged_setup(query_lens, context_lens, n_heads=4, n_kv=2, d=32,
+                  page=16, pages_per_seq=4, n_pad=0, seed=0):
+    rng = np.random.RandomState(seed)
+    n_rows = len(query_lens)
+    total_pages = n_rows * pages_per_seq + 1
+    n_tokens = int(np.sum(query_lens)) + n_pad
+    q = rng.randn(n_tokens, n_heads, d).astype(np.float32)
+    kp = rng.randn(n_kv, total_pages, page, d).astype(np.float32)
+    vp = rng.randn(n_kv, total_pages, page, d).astype(np.float32)
+    bt = (1 + np.arange(n_rows * pages_per_seq)
+          .reshape(n_rows, pages_per_seq)).astype(np.int32)
+    ql = np.asarray(query_lens, np.int32)
+    cl = np.asarray(context_lens, np.int32)
+    return q, kp, vp, bt, cl, ql
+
+
+class TestRaggedPagedAttention:
+    """Tentpole kernel: mixed prefill+decode rows in one launch, across
+    the query_lens mixes the serving engine produces (all-decode,
+    all-prefill, mixed, empty rows with context_lens == 0)."""
+
+    MIXES = {
+        "all_decode": ([1, 1, 1], [9, 33, 17]),
+        "all_prefill": ([7, 20, 5], [7, 20, 5]),
+        "mixed": ([1, 12, 1, 6], [25, 12, 40, 30]),
+        "empty_rows": ([1, 0, 8, 0], [14, 0, 8, 0]),
+    }
+
+    def _run(self, name, **kw):
+        ql, cl = self.MIXES[name]
+        q, kp, vp, bt, cl, ql = _ragged_setup(ql, cl, seed=13)
+        out = np.asarray(ragged_paged_attention(
+            jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+            jnp.asarray(bt), jnp.asarray(cl), jnp.asarray(ql), **kw))
+        ref = _np_ragged_reference(q, kp, vp, bt, cl, ql,
+                                   q.shape[-1] ** -0.5)
+        assert np.isfinite(out).all()
+        np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-4)
+
+    @pytest.mark.parametrize("mix", sorted(MIXES))
+    def test_xla_matches_numpy(self, mix):
+        self._run(mix, use_kernel=False)
+
+    @pytest.mark.parametrize("mix", sorted(MIXES))
+    def test_kernel_matches_numpy(self, mix):
+        self._run(mix, interpret=True, use_kernel=True)
+
+    def test_padding_tokens_are_zero(self):
+        ql, cl = self.MIXES["mixed"]
+        q, kp, vp, bt, cl, ql = _ragged_setup(ql, cl, n_pad=5, seed=14)
+        for kw in ({"use_kernel": False},
+                   {"interpret": True, "use_kernel": True}):
+            out = np.asarray(ragged_paged_attention(
+                jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+                jnp.asarray(bt), jnp.asarray(cl), jnp.asarray(ql), **kw))
+            ref = _np_ragged_reference(q, kp, vp, bt, cl, ql,
+                                       q.shape[-1] ** -0.5)
+            np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-4)
+            np.testing.assert_array_equal(out[int(np.sum(ql)):], 0.0)
+
+    def test_all_decode_matches_decode_kernel(self):
+        # a ragged batch of pure decode rows is exactly the existing
+        # decode attention (row r == batch b, lens == context_lens)
+        ql, cl = self.MIXES["all_decode"]
+        q, kp, vp, bt, cl, ql = _ragged_setup(ql, cl, seed=15)
+        out_r = np.asarray(ragged_paged_attention(
+            jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+            jnp.asarray(bt), jnp.asarray(cl), jnp.asarray(ql),
+            interpret=True, use_kernel=True))
+        out_d = np.asarray(paged_attention(
+            jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+            jnp.asarray(bt), jnp.asarray(cl), interpret=True,
+            use_kernel=True))
+        np.testing.assert_allclose(out_r, out_d, rtol=2e-4, atol=2e-4)
+
+    def test_explicit_row_of_matches_derived(self):
+        ql, cl = self.MIXES["mixed"]
+        q, kp, vp, bt, cl, ql = _ragged_setup(ql, cl, n_pad=3, seed=16)
+        starts = np.concatenate([[0], np.cumsum(ql)[:-1]]).astype(np.int32)
+        row_of = np.full(q.shape[0], -1, np.int32)
+        for r in range(len(ql)):
+            row_of[starts[r]:starts[r] + ql[r]] = r
+        out_a = np.asarray(ragged_paged_attention(
+            jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+            jnp.asarray(bt), jnp.asarray(cl), jnp.asarray(ql),
+            use_kernel=False))
+        out_b = np.asarray(ragged_paged_attention(
+            jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+            jnp.asarray(bt), jnp.asarray(cl), jnp.asarray(ql),
+            q_starts=jnp.asarray(starts), row_of=jnp.asarray(row_of),
+            use_kernel=False))
+        np.testing.assert_array_equal(out_a, out_b)
+
+    def test_gqa_grouping(self):
+        ql = [1, 9, 1]
+        cl = [22, 9, 31]
+        q, kp, vp, bt, cl, ql = _ragged_setup(ql, cl, n_heads=8, n_kv=2,
+                                              seed=17)
+        out = np.asarray(ragged_paged_attention(
+            jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+            jnp.asarray(bt), jnp.asarray(cl), jnp.asarray(ql),
+            interpret=True, use_kernel=True))
+        ref = _np_ragged_reference(q, kp, vp, bt, cl, ql,
+                                   q.shape[-1] ** -0.5)
+        np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-4)
+
+
+class TestRaggedInt8Pages:
+    def test_xla_int8_close_to_fp(self):
+        ql = [1, 10, 1, 4]
+        cl = [18, 10, 27, 33]
+        q, kp, vp, bt, cl, ql = _ragged_setup(ql, cl, seed=18)
+        qkp = quantize_kv_pages(jnp.asarray(kp))
+        qvp = quantize_kv_pages(jnp.asarray(vp))
+        out = np.asarray(ragged_paged_attention(
+            jnp.asarray(q), qkp, qvp, jnp.asarray(bt), jnp.asarray(cl),
+            jnp.asarray(ql)))
+        ref = _np_ragged_reference(q, kp, vp, bt, cl, ql,
+                                   q.shape[-1] ** -0.5)
+        np.testing.assert_allclose(out, ref, rtol=0.15, atol=0.15)
+
+    def test_kernel_int8_matches_xla_int8(self):
+        # kernel and XLA paths share the _dequant rule -> tight agreement
+        ql = [1, 10, 1, 4]
+        cl = [18, 10, 27, 33]
+        q, kp, vp, bt, cl, ql = _ragged_setup(ql, cl, seed=19)
+        qkp = quantize_kv_pages(jnp.asarray(kp))
+        qvp = quantize_kv_pages(jnp.asarray(vp))
+        out_k = np.asarray(ragged_paged_attention(
+            jnp.asarray(q), qkp, qvp, jnp.asarray(bt), jnp.asarray(cl),
+            jnp.asarray(ql), interpret=True, use_kernel=True))
+        out_x = np.asarray(ragged_paged_attention(
+            jnp.asarray(q), qkp, qvp, jnp.asarray(bt), jnp.asarray(cl),
+            jnp.asarray(ql), use_kernel=False))
+        np.testing.assert_allclose(out_k, out_x, rtol=2e-4, atol=2e-4)
+
+    def test_int8_empty_rows_zero(self):
+        ql = [1, 0, 3]
+        cl = [12, 0, 3]
+        q, kp, vp, bt, cl, ql = _ragged_setup(ql, cl, n_pad=2, seed=20)
+        out = np.asarray(ragged_paged_attention(
+            jnp.asarray(q), quantize_kv_pages(jnp.asarray(kp)),
+            quantize_kv_pages(jnp.asarray(vp)), jnp.asarray(bt),
+            jnp.asarray(cl), jnp.asarray(ql)))
+        assert np.isfinite(out).all()
+        np.testing.assert_array_equal(out[int(np.sum(ql)):], 0.0)

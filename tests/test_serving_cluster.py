@@ -88,21 +88,23 @@ class TestReplica:
         assert st2.free_blocks == st2.total_blocks
         rep.shutdown()
 
-    def test_warmup_pretraces_both_jits(self, model):
-        """AOT warmup compiles decode exactly once; real traffic after
-        warmup pays zero cold compiles and keeps stream parity."""
+    def test_warmup_pretraces_ragged_jit(self, model):
+        """AOT warmup compiles the ragged step exactly once; real
+        traffic after warmup pays zero cold compiles and keeps stream
+        parity."""
         rep = Replica("r0", model, max_slots=2, block_size=8,
                       num_blocks=32, prefill_chunk=8)
         rep.warmup()
-        assert rep.engine.decode_compiles == 1
+        assert rep.engine.ragged_compiles == 1
         prompts = _prompts(model, [5, 11])
         refs = [_ref(model, p, 6) for p in prompts]
         rids = [rep.submit(p, max_new_tokens=6) for p in prompts]
         while rep.step():
             pass
         assert [rep.engine.result(r) for r in rids] == refs
-        assert rep.engine.decode_compiles == 1, \
-            "warmup did not pre-trace the decode jit"
+        assert rep.engine.ragged_compiles == 1, \
+            "warmup did not pre-trace the ragged jit"
+        assert rep.engine.decode_compiles == 0
         rep.shutdown()
 
     def test_die_drains_descriptors_and_is_idempotent(self, model):
@@ -326,6 +328,5 @@ class TestClusterTimeline:
             assert json.load(f) == doc
         names = {ev["name"] for ev in doc["traceEvents"]}
         assert {"cluster.route", "cluster.replay",
-                "serving.step", "serving.prefill",
-                "serving.decode"} <= names
+                "serving.step", "serving.ragged_step"} <= names
         router.shutdown()

@@ -359,9 +359,11 @@ class TestServingEngineE2E:
         outs = [eng.result(r) for r in rids]
         assert outs == refs
         # requests joined and left slots at different times, yet the
-        # fixed-shape decode step traced exactly once
-        assert eng.decode_compiles == 1
-        assert eng.prefill_compiles == 1
+        # fixed-shape RAGGED step (the default) traced exactly once and
+        # the legacy two-program jits were never touched
+        assert eng.ragged_compiles == 1
+        assert eng.decode_compiles == 0
+        assert eng.prefill_compiles == 0
         eng.shutdown()                   # asserts zero block leaks
 
     def test_prefix_cache_skips_prefill(self, model):
@@ -383,7 +385,7 @@ class TestServingEngineE2E:
         _drain(eng)
         assert eng.result(r2) == ref
         assert req2.num_cached == 16
-        assert eng.decode_compiles == 1
+        assert eng.ragged_compiles == 1
         eng.shutdown()
 
     def test_preemption_evict_and_recompute_parity(self, model):
@@ -401,7 +403,7 @@ class TestServingEngineE2E:
         outs = [eng.result(r) for r in rids]
         assert outs == refs
         assert eng.scheduler.preemptions >= 1
-        assert eng.decode_compiles == 1
+        assert eng.ragged_compiles == 1
         eng.shutdown()
 
     def test_eos_ends_stream(self, model):
@@ -487,7 +489,7 @@ class TestServingEngineE2E:
         out = eng.result(rid)
         assert len(out) == 6
         assert all(0 <= t < V for t in out)
-        assert eng.decode_compiles == 1
+        assert eng.ragged_compiles == 1
         eng.shutdown()
 
     def test_submit_rejects_oversized_prompt(self, model):
@@ -497,3 +499,165 @@ class TestServingEngineE2E:
         with pytest.raises(ValueError):
             eng.submit(list(range(30)), max_new_tokens=8)
         eng.shutdown()
+
+
+# -------------------------------------------------- ragged vs two-program
+class TestRaggedServing:
+    """Tentpole suite: the single ragged mixed prefill+decode dispatch
+    vs the legacy two-program path — token-exact streams across phase
+    mixes, zero recompiles under churn, same-step first-token emission,
+    and once-only TTFT accounting."""
+
+    KNOBS = dict(max_slots=4, block_size=8, num_blocks=64,
+                 prefill_chunk=8)
+
+    def _run(self, model, prompts, maxnew, **over):
+        knobs = dict(self.KNOBS)
+        knobs.update(over)
+        eng = ServingEngine(model, **knobs)
+        rids = [eng.submit(p, max_new_tokens=mn)
+                for p, mn in zip(prompts, maxnew)]
+        _drain(eng)
+        outs = [eng.result(r) for r in rids]
+        eng.shutdown()
+        return outs, eng
+
+    def test_off_mode_restores_two_program_path(self, model):
+        # the legacy layout still works, still matches generate(), and
+        # never touches the ragged jit
+        rng = np.random.RandomState(20)
+        V = model.config.vocab_size
+        prompts = [rng.randint(0, V, n).tolist() for n in (5, 17, 9)]
+        maxnew = [6, 5, 8]
+        refs = [_ref(model, p, mn) for p, mn in zip(prompts, maxnew)]
+        outs, eng = self._run(model, prompts, maxnew, ragged="off")
+        assert outs == refs
+        assert eng.decode_compiles == 1
+        assert eng.prefill_compiles == 1
+        assert eng.ragged_compiles == 0
+
+    def test_mixed_phase_parity_on_vs_off(self, model):
+        # long multi-chunk prompts land mid-stream while short ones
+        # decode: every step mixes phases, streams must stay bitwise
+        # identical to the two-program path (and to generate())
+        rng = np.random.RandomState(21)
+        V = model.config.vocab_size
+        prompts = [rng.randint(0, V, n).tolist()
+                   for n in (3, 29, 11, 7)]    # 29 spans 4 chunks
+        maxnew = [12, 4, 7, 9]
+        refs = [_ref(model, p, mn) for p, mn in zip(prompts, maxnew)]
+        outs_off, _ = self._run(model, prompts, maxnew, ragged="off")
+        outs_on, eng = self._run(model, prompts, maxnew, ragged="on")
+        assert outs_off == refs
+        assert outs_on == outs_off
+        assert eng.ragged_compiles == 1
+
+    def test_int8_pages_parity_on_vs_off(self, model):
+        # both paths read int8 pages through the same _dequant XLA
+        # composition on CPU -> streams agree token-exactly here too
+        rng = np.random.RandomState(22)
+        V = model.config.vocab_size
+        prompts = [rng.randint(0, V, n).tolist() for n in (6, 19, 10)]
+        maxnew = [8, 6, 5]
+        outs_off, _ = self._run(model, prompts, maxnew, ragged="off",
+                                kv_quant="int8")
+        outs_on, _ = self._run(model, prompts, maxnew, ragged="on",
+                               kv_quant="int8")
+        assert outs_on == outs_off
+
+    def test_zero_recompile_across_three_join_leave_waves(self, model):
+        # slots join and leave across three separate waves (idle gaps
+        # between them) — the ragged jit must trace exactly once
+        rng = np.random.RandomState(23)
+        V = model.config.vocab_size
+        eng = ServingEngine(model, **self.KNOBS)
+        for wave, lens in enumerate([(5, 9), (13,), (3, 7, 11)]):
+            rids = [eng.submit(rng.randint(0, V, n).tolist(),
+                               max_new_tokens=4 + wave) for n in lens]
+            _drain(eng)
+            for r in rids:
+                assert len(eng.result(r)) == 4 + wave
+            assert eng.ragged_compiles == 1, "wave %d recompiled" % wave
+        assert eng.decode_compiles == 0
+        eng.shutdown()
+
+    @pytest.mark.parametrize("mode", ["on", "off"])
+    def test_first_token_emitted_in_final_chunk_step(self, model, mode):
+        # satellite regression pin: a prompt that ends EXACTLY at a
+        # chunk boundary must stream its first token in the same step
+        # that runs the final chunk — no extra tick
+        rng = np.random.RandomState(24)
+        V = model.config.vocab_size
+        chunk = self.KNOBS["prefill_chunk"]
+        prompt = rng.randint(0, V, 2 * chunk).tolist()  # 2 exact chunks
+        eng = ServingEngine(model, ragged=mode, **self.KNOBS)
+        rid = eng.submit(prompt, max_new_tokens=4)
+        req = eng._requests[rid]
+        saw_completion_step = False
+        for _ in range(50):
+            before = req.prefilled
+            if not eng.step():
+                break
+            if before < len(prompt) <= req.prefilled:
+                saw_completion_step = True
+                assert len(req.generated) >= 1, \
+                    "final chunk completed without emitting a token"
+        assert saw_completion_step
+        assert len(eng.result(rid)) == 4
+        eng.shutdown()
+
+    @pytest.mark.parametrize("mode", ["on", "off"])
+    def test_ttft_observed_once_under_preemption(self, model, mode):
+        # a preempted request re-prefills after eviction; its TTFT must
+        # be observed exactly once (at the REAL first token), so the
+        # histogram count equals the number of requests
+        from paddle_tpu import observability as obs
+        rng = np.random.RandomState(25)
+        V = model.config.vocab_size
+        prompts = [rng.randint(0, V, 4).tolist() for _ in range(2)]
+        obs.registry.reset()
+        obs.enable()
+        try:
+            eng = ServingEngine(model, max_slots=2, block_size=4,
+                                num_blocks=4, prefill_chunk=4,
+                                enable_prefix_cache=False,
+                                watermark=0.0, ragged=mode)
+            rids = [eng.submit(p, max_new_tokens=12) for p in prompts]
+            _drain(eng)
+            for r in rids:
+                assert len(eng.result(r)) == 12
+            assert eng.scheduler.preemptions >= 1
+            st = obs.registry.histogram("serving.ttft").state()
+            assert st["count"] == len(prompts), \
+                "ttft observed %d times for %d requests" \
+                % (st["count"], len(prompts))
+            eng.shutdown()
+        finally:
+            obs.disable()
+            obs.registry.reset()
+
+    def test_token_budget_packs_multiple_prefills_per_step(self, model):
+        # two short prompts admitted together finish prefill in ONE
+        # ragged step (the budget packs both chunks); a third long one
+        # takes its share in order
+        rng = np.random.RandomState(26)
+        V = model.config.vocab_size
+        p1 = rng.randint(0, V, 3).tolist()
+        p2 = rng.randint(0, V, 4).tolist()
+        eng = ServingEngine(model, **self.KNOBS)
+        r1 = eng.submit(p1, max_new_tokens=3)
+        r2 = eng.submit(p2, max_new_tokens=3)
+        eng.step()                       # admit + one ragged dispatch
+        q1, q2 = eng._requests[r1], eng._requests[r2]
+        assert q1.prefilled == len(p1) and len(q1.generated) == 1
+        assert q2.prefilled == len(p2) and len(q2.generated) == 1
+        _drain(eng)
+        assert len(eng.result(r1)) == 3
+        assert len(eng.result(r2)) == 3
+        eng.shutdown()
+
+    def test_ragged_config_validation(self, model):
+        with pytest.raises(ValueError):
+            ServingEngine(model, ragged="maybe", **self.KNOBS)
+        with pytest.raises(ValueError):
+            ServingEngine(model, token_budget=-1, **self.KNOBS)

@@ -1,18 +1,25 @@
 #!/usr/bin/env python
-"""Fast serving smoke: two ragged requests through ServingEngine must
-exactly reproduce per-request ``generate()`` greedy streams with one
-decode-step compile and a fully drained block pool.
+"""Fast serving smoke: requests through ServingEngine must exactly
+reproduce per-request ``generate()`` greedy streams with one step
+compile and a fully drained block pool. The default engine serves via
+the single RAGGED mixed prefill+decode jit (``ragged_compiles == 1``,
+the legacy decode/prefill jits never trace).
 
-``--cluster`` runs the multi-replica arm instead: two in-process
-replicas behind the prefix-affinity router, a seeded fault-plan kill of
-one replica mid-flight (``cluster.replica:kill@N``), and asserts the
+``--ragged`` runs the parity arm instead: the SAME prompts through a
+``PADDLE_TPU_SERVE_RAGGED=off`` engine (the legacy two-program path)
+and a ragged-on engine; both streams must match ``generate()`` — and
+each other — token for token.
+
+``--cluster`` runs the multi-replica arm: two in-process replicas
+behind the prefix-affinity router, a seeded fault-plan kill of one
+replica mid-flight (``cluster.replica:kill@N``), and asserts the
 drained-and-replayed streams still match the single-engine references
 token for token.
 
 Importable (``main()`` returns 0/raises) so tests/test_serve_smoke.py
-runs both arms inside the tier-1 suite; also runnable standalone:
+runs all arms inside the tier-1 suite; also runnable standalone:
 
-    JAX_PLATFORMS=cpu python tools/serve_smoke.py [--cluster]
+    JAX_PLATFORMS=cpu python tools/serve_smoke.py [--ragged|--cluster]
 """
 from __future__ import annotations
 
@@ -38,24 +45,63 @@ def _build(n_prompts=2):
     return pt, model, prompts, refs
 
 
+def _drain(eng, rids, cap=200):
+    steps = 0
+    while eng.step():
+        steps += 1
+        assert steps < cap, "engine failed to drain"
+    return [eng.result(r) for r in rids], steps
+
+
 def main() -> int:
     pt, model, prompts, refs = _build()
 
     eng = pt.serving.ServingEngine(model, max_slots=2, block_size=8,
                                    num_blocks=32, prefill_chunk=8)
     rids = [eng.submit(p, max_new_tokens=6) for p in prompts]
-    steps = 0
-    while eng.step():
-        steps += 1
-        assert steps < 200, "engine failed to drain"
-    outs = [eng.result(r) for r in rids]
+    outs, steps = _drain(eng, rids)
     assert outs == refs, "serving stream != generate(): %r vs %r" \
         % (outs, refs)
-    assert eng.decode_compiles == 1, \
-        "decode step compiled %d times" % eng.decode_compiles
+    assert eng.ragged_compiles == 1, \
+        "ragged step compiled %d times" % eng.ragged_compiles
+    assert eng.decode_compiles == 0 and eng.prefill_compiles == 0, \
+        "legacy jits traced under ragged serving"
     eng.shutdown()                       # raises on any block leak
     print("serve_smoke: %d requests, %d steps, parity OK, "
-          "1 decode compile, pool drained" % (len(prompts), steps))
+          "1 ragged compile, pool drained" % (len(prompts), steps))
+    return 0
+
+
+def main_ragged() -> int:
+    """Tier-1 parity arm: PADDLE_TPU_SERVE_RAGGED=off (the legacy
+    two-program path, byte-for-byte the pre-ragged engine) vs the
+    ragged single-dispatch path, token-exact against generate()."""
+    pt, model, prompts, refs = _build(n_prompts=4)
+    knobs = dict(max_slots=2, block_size=8, num_blocks=32,
+                 prefill_chunk=8)
+
+    eng_off = pt.serving.ServingEngine(model, ragged="off", **knobs)
+    rids = [eng_off.submit(p, max_new_tokens=6) for p in prompts]
+    outs_off, _ = _drain(eng_off, rids)
+    assert eng_off.decode_compiles == 1 and \
+        eng_off.prefill_compiles == 1, "off path must trace both jits"
+    assert eng_off.ragged_compiles == 0, \
+        "off path must never trace the ragged jit"
+    eng_off.shutdown()
+
+    eng_on = pt.serving.ServingEngine(model, ragged="on", **knobs)
+    rids = [eng_on.submit(p, max_new_tokens=6) for p in prompts]
+    outs_on, steps = _drain(eng_on, rids)
+    assert eng_on.ragged_compiles == 1, \
+        "ragged step compiled %d times" % eng_on.ragged_compiles
+    eng_on.shutdown()
+
+    assert outs_off == refs, \
+        "off stream != generate(): %r vs %r" % (outs_off, refs)
+    assert outs_on == outs_off, \
+        "ragged stream != off stream: %r vs %r" % (outs_on, outs_off)
+    print("serve_smoke --ragged: %d requests, %d steps, on==off=="
+          "generate() token-exact" % (len(prompts), steps))
     return 0
 
 
@@ -67,7 +113,7 @@ def main_cluster() -> int:
     reps = [Replica("r%d" % i, model, max_slots=2, block_size=8,
                     num_blocks=32, prefill_chunk=8) for i in range(2)]
     for r in reps:
-        r.warmup()                       # both jits traced pre-traffic
+        r.warmup()                       # ragged jit traced pre-traffic
     router = ClusterRouter(reps)
 
     # the 5th replica step across the cluster kills whichever replica
@@ -86,12 +132,12 @@ def main_cluster() -> int:
     assert outs == refs, \
         "replayed streams != generate(): %r vs %r" % (outs, refs)
     for r in reps:
-        assert r.engine.decode_compiles == 1, \
-            "replica %s compiled decode %d times" \
-            % (r.name, r.engine.decode_compiles)
+        assert r.engine.ragged_compiles == 1, \
+            "replica %s compiled ragged %d times" \
+            % (r.name, r.engine.ragged_compiles)
     router.shutdown()                    # raises on survivor block leak
     print("serve_smoke --cluster: %d requests, %d steps, 1 replica "
-          "killed, replay parity OK, 1 decode compile/replica"
+          "killed, replay parity OK, 1 ragged compile/replica"
           % (len(prompts), steps))
     return 0
 
@@ -99,4 +145,8 @@ def main_cluster() -> int:
 if __name__ == "__main__":
     sys.path.insert(0, os.path.join(os.path.dirname(
         os.path.abspath(__file__)), os.pardir))
-    sys.exit(main_cluster() if "--cluster" in sys.argv else main())
+    if "--cluster" in sys.argv:
+        sys.exit(main_cluster())
+    if "--ragged" in sys.argv:
+        sys.exit(main_ragged())
+    sys.exit(main())
