@@ -792,6 +792,119 @@ def _bench_elastic():
     return 0
 
 
+def _bench_ps():
+    """Parameter-server bench, four arms:
+
+    1. failover recovery — the seeded 3-process kill drill
+       (tools/ps_drill.py): kill the primary server mid-epoch, the
+       backup promotes inside the lease budget, and the recommender
+       loop finishes bit-exact; reports kill-step extra latency vs an
+       ordinary step, head-to-head with a cold process restart.
+    2. exactly-once — the in-process lost-ack drill: a ``ps.push``
+       fault after delivery forces a retransmit; requires dedup hits
+       and a bit-equal table digest vs the clean run.
+    3. pull/push throughput — a single-process LocalTransport worker
+       hammering one sparse shard; reports rows/s both ways plus
+       p50/p99 pull latency.
+    4. bounded-capacity eviction — zipfian pushes into a
+       capacity-bounded SparseTable; reports the eviction rate and the
+       resident-row ceiling holding.
+    """
+    import time
+
+    tools_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "tools")
+    if tools_dir not in sys.path:
+        sys.path.insert(0, tools_dir)
+    import ps_drill
+
+    # --- arm 1: kill drill (asserts its own acceptance criteria)
+    with _stopwatch("bench.ps_window"):
+        summary = ps_drill.main()
+    recovery_s = float(summary["recovery_wall_s"])
+    cold_restart_s = float(summary["cold_restart_s"])
+    fo = summary["failovers"][0]
+
+    # --- arm 2: lost-ack retransmit dedup (asserts digest equality)
+    dedup = ps_drill.dedup_drill()
+
+    from paddle_tpu.distributed.ps import (LocalTransport, PSServer,
+                                           PSWorker)
+    from paddle_tpu.distributed.ps.tables import SparseTable
+
+    # --- arm 3: LocalTransport pull/push throughput + pull latency
+    dim, batch, rounds = 32, 2048, 30
+    srv = PSServer(0, n_servers=1)
+    try:
+        srv.add_sparse_table(0, dim, optimizer="adagrad", lr=0.1)
+        w = PSWorker(1, 1, worker_id="bench",
+                     transport=LocalTransport())
+        rng = np.random.default_rng(11)
+        ids = rng.integers(0, 200_000, size=batch)
+        grads = rng.standard_normal((batch, dim)).astype(np.float32)
+        w.pull_sparse(0, ids, dim=dim)           # materialize rows
+        w.push_sparse(0, ids, grads)             # pay one-time costs
+        pull_lat, push_lat = [], []
+        for _ in range(rounds):
+            t0 = time.perf_counter()
+            w.pull_sparse(0, ids, dim=dim)
+            pull_lat.append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            w.push_sparse(0, ids, grads)
+            push_lat.append(time.perf_counter() - t0)
+        pull_rows_per_s = batch * rounds / sum(pull_lat)
+        push_rows_per_s = batch * rounds / sum(push_lat)
+        pull_p50_ms = float(np.percentile(pull_lat, 50)) * 1e3
+        pull_p99_ms = float(np.percentile(pull_lat, 99)) * 1e3
+    finally:
+        srv.shutdown_local()
+
+    # --- arm 4: eviction rate under zipfian skew at bounded capacity
+    cap, evict_rounds = 1024, 20
+    tbl = SparseTable(16, optimizer="sgd", lr=0.1, seed=0,
+                      capacity=cap)
+    zrng = np.random.default_rng(13)
+    pushed = 0
+    for _ in range(evict_rounds):
+        zids = zrng.zipf(1.3, size=512) % 100_000
+        tbl.push(zids, zrng.standard_normal(
+            (512, 16)).astype(np.float32))
+        pushed += 512
+    ev = tbl.counters()
+    assert ev["rows"] <= cap, ev
+
+    print(json.dumps({
+        "metric": "ps_failover_recovery_s_cpu_smoke",
+        "value": round(recovery_s, 3),
+        "unit": "s",
+        "vs_baseline": round(cold_restart_s / recovery_s, 2)
+        if recovery_s > 0 else 0.0,
+        "extra": {
+            "recovery_wall_s": round(recovery_s, 3),
+            "failover_latency_s": round(float(fo["latency_s"]), 3),
+            "failover_budget_s": ps_drill.FAILOVER_S,
+            "step_baseline_s": round(
+                float(summary["step_baseline_s"]), 4),
+            "cold_restart_s": round(cold_restart_s, 3),
+            "beats_cold_restart": recovery_s < cold_restart_s,
+            "drill_steps": summary["total_steps"],
+            "kill_step": summary["kill_step"],
+            "push_dedup_hits": dedup["dedup_hits"],
+            "dedup_bit_equal": True,     # dedup_drill asserts it
+            "pull_rows_per_s": round(pull_rows_per_s, 1),
+            "push_rows_per_s": round(push_rows_per_s, 1),
+            "pull_p50_ms": round(pull_p50_ms, 3),
+            "pull_p99_ms": round(pull_p99_ms, 3),
+            "throughput_batch": batch,
+            "eviction_rate": round(ev["evictions"] / pushed, 4),
+            "evictions": ev["evictions"],
+            "resident_rows": ev["rows"],
+            "capacity": cap,
+        },
+    }))
+    return 0
+
+
 def _tp_overlap_result(on_tpu):
     """tp_overlap sub-bench: decomposed ring all-gather-matmul vs the
     serial gather-then-GEMM pair on a 2-device mp mesh.
@@ -1137,6 +1250,8 @@ def main():
         return _bench_multichip()
     if "--elastic" in sys.argv:
         return _bench_elastic()
+    if "--ps" in sys.argv:
+        return _bench_ps()
 
     import jax
 

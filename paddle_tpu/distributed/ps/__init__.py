@@ -17,10 +17,18 @@ from __future__ import annotations
 import os
 from typing import List, Optional
 
+from .checkpoint import (  # noqa: F401
+    PSCheckpointError,
+    ShardCheckpointManager,
+)
 from .data_plane import (  # noqa: F401
     DenseTable,
+    LocalTransport,
+    PSConfig,
+    PSFailover,
     PSServer,
     PSWorker,
+    RpcTransport,
     SparseEmbedding,
     SparseTable,
 )
@@ -28,7 +36,9 @@ from .data_plane import (  # noqa: F401
 __all__ = ["Role", "RoleMakerBase", "PaddleCloudRoleMaker",
            "UserDefinedRoleMaker", "TheOnePSRuntime", "Table", "Accessor",
            "PSGuidanceError", "SparseTable", "DenseTable", "PSServer",
-           "PSWorker", "SparseEmbedding"]
+           "PSWorker", "SparseEmbedding", "PSConfig", "PSFailover",
+           "RpcTransport", "LocalTransport", "PSCheckpointError",
+           "ShardCheckpointManager"]
 
 _GUIDE = (
     "parameter-server mode is not supported by this TPU-native framework: "
@@ -181,18 +191,24 @@ class TheOnePSRuntime:
 
         t, s = self._world()
         idx = self.role_maker.server_index()
-        self.server = PSServer(idx)
+        cfg = PSConfig()
+        # "auto" replication turns on whenever the job runs >= 2
+        # servers: each shard then has a primary and a backup replica
+        self.server = PSServer(idx, n_servers=s, config=cfg,
+                               replicated=cfg.replicated(s))
         for tb in self.tables:
             if tb.kind == "sparse":
                 self.server.add_sparse_table(tb.id, tb.dim,
                                              optimizer=tb.optimizer,
                                              lr=tb.lr)
-            elif tb.id % s == idx:
-                # dense tables live ONLY on their owning server — a
-                # replica on the others would be saved untrained
+            else:
+                # dense tables live only on the shard `id % s` — the
+                # server hosts it iff it serves (or backs up) that shard
                 self.server.add_dense_table(tb.id, tb.shape, lr=tb.lr)
         rpc.init_rpc(f"pserver{idx}", rank=t + idx, world_size=t + s,
                      timeout=timeout)
+        self.server.start(rpc._agent.store if rpc._agent is not None
+                          else None, world_size=t + s)
 
     def run_server(self, *a, **k):
         if self.server is None:
@@ -217,16 +233,16 @@ class TheOnePSRuntime:
         """Ask the owning server(s) to snapshot their table shards
         (reference: the_one_ps.py _save_distributed_persistables).
         Sparse tables shard over every server; a dense table lives only
-        on server ``table_id % n_servers``."""
-        from .. import rpc
-        from .data_plane import _ps_save
-
+        on shard ``table_id % n_servers``. Each shard is saved by its
+        CURRENT primary (which may be a promoted backup), with an
+        atomic CRC-manifested write (ps/checkpoint.py)."""
+        if self.worker is None:
+            raise PSGuidanceError("save_persistables before init_worker")
         _, s = self._world()
         os.makedirs(dirname, exist_ok=True)
         for tb in self.tables:
-            owners = range(s) if tb.kind == "sparse" else [tb.id % s]
-            for si in owners:
-                rpc.rpc_sync(
-                    f"pserver{si}", _ps_save,
-                    args=(tb.id, os.path.join(
-                        dirname, f"table{tb.id}_shard{si}.npy")))
+            shards = range(s) if tb.kind == "sparse" else [tb.id % s]
+            for si in shards:
+                self.worker.save_table(
+                    si, tb.id,
+                    os.path.join(dirname, f"table{tb.id}_shard{si}.npy"))

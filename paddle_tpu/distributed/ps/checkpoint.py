@@ -1,0 +1,213 @@
+"""Durable PS table checkpoints (reference: the table ``save``/``load``
+RPCs behind the_one_ps.py ``save_persistables`` — there shards write
+rocksdb SST files; here each shard writes one ``.npy`` payload).
+
+Same discipline as ``resilience/checkpoint_manager.py``: payloads go to
+a tmp name then ``os.replace``; a CRC32+size manifest sidecar is
+written (atomically) only AFTER the payload is durable, so the manifest
+is the commit marker; readers verify the CRC and a step-directory scan
+(``ShardCheckpointManager.latest_valid``) skips torn or bit-rotted
+checkpoints instead of restoring garbage.
+"""
+from __future__ import annotations
+
+import json
+import os
+import zlib
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["PSCheckpointError", "write_table", "read_table",
+           "validate_table_file", "ShardCheckpointManager"]
+
+_STEP_FMT = "step_%08d"
+
+
+class PSCheckpointError(RuntimeError):
+    """A table checkpoint failed validation (missing manifest, size or
+    CRC mismatch) — the caller must fall back, not restore it."""
+
+
+def _normalize(path: str) -> str:
+    # np.save appends ".npy" when missing; normalize up front so save
+    # and load agree on the real filename (the historical bug was
+    # save("t0") writing "t0.npy" and load("t0") then failing).
+    return path if path.endswith(".npy") else path + ".npy"
+
+
+def _crc32_file(path: str) -> int:
+    crc = 0
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            crc = zlib.crc32(chunk, crc)
+    return crc
+
+
+def _manifest_path(path: str) -> str:
+    return path + ".manifest.json"
+
+
+def write_table(path: str, sd: dict) -> str:
+    """Atomically write one table state_dict; returns the real path."""
+    path = _normalize(path)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.save(f, np.array([sd], dtype=object), allow_pickle=True)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    manifest = {"size": os.path.getsize(path),
+                "crc32": _crc32_file(path)}
+    mtmp = _manifest_path(path) + ".tmp"
+    with open(mtmp, "w") as f:
+        json.dump(manifest, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(mtmp, _manifest_path(path))
+    return path
+
+
+def validate_table_file(path: str) -> Tuple[bool, str]:
+    path = _normalize(path)
+    if not os.path.exists(path):
+        return False, f"missing payload {path}"
+    mpath = _manifest_path(path)
+    if not os.path.exists(mpath):
+        return False, f"missing manifest {mpath}"
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError) as e:
+        return False, f"unreadable manifest {mpath}: {e}"
+    size = os.path.getsize(path)
+    if size != manifest.get("size"):
+        return False, (f"size mismatch for {path}: "
+                       f"{size} != {manifest.get('size')}")
+    crc = _crc32_file(path)
+    if crc != manifest.get("crc32"):
+        return False, (f"crc mismatch for {path}: "
+                       f"{crc:#x} != {manifest.get('crc32', 0):#x}")
+    return True, "ok"
+
+
+def read_table(path: str, verify: bool = True) -> dict:
+    """Load one table state_dict, verifying the manifest CRC when one
+    exists (pre-manifest checkpoints still load with verify=False)."""
+    path = _normalize(path)
+    if verify and os.path.exists(_manifest_path(path)):
+        ok, detail = validate_table_file(path)
+        if not ok:
+            raise PSCheckpointError(detail)
+    return np.load(path, allow_pickle=True)[0]
+
+
+class ShardCheckpointManager:
+    """Step-directory checkpoints for a set of table shards, with
+    corruption-skipping restore (the PS analog of
+    ``resilience.CheckpointManager.latest_valid``)."""
+
+    def __init__(self, root: str, keep_last: int = 2):
+        self.root = root
+        self.keep_last = int(keep_last)
+        os.makedirs(root, exist_ok=True)
+
+    def _dir(self, step: int) -> str:
+        return os.path.join(self.root, _STEP_FMT % step)
+
+    @staticmethod
+    def _file(table_id: int, shard: int) -> str:
+        return f"table{table_id}_shard{shard}.npy"
+
+    def save(self, step: int,
+             tables: Dict[Tuple[int, int], dict]) -> str:
+        """``tables`` maps (shard, table_id) -> state_dict. The step
+        directory's MANIFEST.json (written last, atomically) is the
+        commit marker listing every member file."""
+        d = self._dir(step)
+        tmp_d = d + ".tmp"
+        os.makedirs(tmp_d, exist_ok=True)
+        files = []
+        for (shard, table_id), sd in sorted(tables.items()):
+            name = self._file(table_id, shard)
+            write_table(os.path.join(tmp_d, name), sd)
+            files.append(name)
+        os.replace(tmp_d, d)
+        manifest = {"step": step, "files": files}
+        mtmp = os.path.join(d, "MANIFEST.json.tmp")
+        with open(mtmp, "w") as f:
+            json.dump(manifest, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(mtmp, os.path.join(d, "MANIFEST.json"))
+        self._gc()
+        return d
+
+    def _steps(self):
+        if not os.path.isdir(self.root):
+            return []
+        out = []
+        for name in os.listdir(self.root):
+            if name.startswith("step_") and not name.endswith(".tmp"):
+                try:
+                    out.append(int(name.split("_", 1)[1]))
+                except ValueError:
+                    continue
+        return sorted(out)
+
+    def validate_dir(self, step: int) -> Tuple[bool, str]:
+        d = self._dir(step)
+        mpath = os.path.join(d, "MANIFEST.json")
+        if not os.path.exists(mpath):
+            return False, f"missing commit marker {mpath}"
+        try:
+            with open(mpath) as f:
+                manifest = json.load(f)
+        except (OSError, ValueError) as e:
+            return False, f"unreadable commit marker: {e}"
+        for name in manifest.get("files", []):
+            ok, detail = validate_table_file(os.path.join(d, name))
+            if not ok:
+                return False, detail
+        return True, "ok"
+
+    def latest_valid(self) -> Optional[Tuple[int, str]]:
+        """Newest step directory that passes full validation; corrupt
+        or torn steps are skipped (and counted) on the way down."""
+        skipped = 0
+        found = None
+        for step in reversed(self._steps()):
+            ok, _detail = self.validate_dir(step)
+            if ok:
+                found = (step, self._dir(step))
+                break
+            skipped += 1
+        if skipped:
+            try:
+                from ... import observability as obs
+
+                if obs.enabled():
+                    obs.registry.counter(
+                        "resilience.corrupt_checkpoints").inc(skipped)
+            except Exception:
+                pass
+        return found
+
+    def load(self, d: str) -> Dict[Tuple[int, int], dict]:
+        with open(os.path.join(d, "MANIFEST.json")) as f:
+            manifest = json.load(f)
+        out: Dict[Tuple[int, int], dict] = {}
+        for name in manifest["files"]:
+            stem = name[:-len(".npy")]
+            table_id = int(stem.split("_")[0][len("table"):])
+            shard = int(stem.split("_shard")[1])
+            out[(shard, table_id)] = read_table(os.path.join(d, name))
+        return out
+
+    def _gc(self) -> None:
+        steps = self._steps()
+        for step in steps[:-self.keep_last]:
+            d = self._dir(step)
+            for name in os.listdir(d):
+                os.unlink(os.path.join(d, name))
+            os.rmdir(d)

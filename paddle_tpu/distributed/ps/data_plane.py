@@ -11,6 +11,32 @@ PADDLE_PSERVERS_IP_PORT_LIST, PADDLE_TRAINERS_NUM). All trainers and
 servers join ONE rpc world: trainers are ranks [0, T), servers ranks
 [T, T+S). Sparse rows shard across servers by `id % server_num`.
 
+Fault tolerance (this file + replication.py + tables.py):
+
+* **Replication.** With >= 2 servers, shard ``s`` is PRIMARY on server
+  ``s`` and BACKUP on server ``(s+1) % S``. The primary applies a push,
+  then forwards the record through the store-backed per-shard
+  replication log and blocks on the backup's ack — an acked push exists
+  on both replicas. Pulls are served by the primary only; pull-created
+  rows are never replicated because row init is a pure function of
+  (table seed, id) (see tables.py).
+* **Failover.** Servers beat heartbeat leases on the job TCPStore
+  (``elastic/membership.py`` discipline). The backup watches its
+  primary's lease; on expiry it drains the log, takes the shard over in
+  the ``ps/primary/{shard}`` map and bumps the map generation. Workers
+  detect the move (typed :class:`PSFailover`), re-resolve, replay their
+  unacked in-flight window and retry.
+* **Exactly-once pushes.** The rpc layer is at-least-once (PR 3
+  retransmit), and failover replays re-send whole batches — so every
+  push carries a per-(worker, shard, table) monotonic sequence number
+  and servers keep a per-worker high-water mark (replicated with the
+  shard): stale seqs are acked without re-applying
+  (``ps.push_dedup_hits``).
+* **Retries + fault injection.** Every worker-side op runs under the
+  shared ``resilience.retry`` policy with a ``PADDLE_TPU_PS_TIMEOUT``
+  whole-op deadline; ``ps.pull``/``ps.push`` (worker) and ``ps.server``
+  (handler entry) are fault-injection sites.
+
 The data plane is HOST-side by design: sparse tables are a CPU-memory
 construct (the reference's too — rocksdb/brpc), while dense training on
 TPU stays collective-first per SURVEY §2.4.17. SparseEmbedding is an
@@ -19,296 +45,881 @@ registered tape hook.
 """
 from __future__ import annotations
 
+import contextlib
 import os
 import threading
-from typing import Dict, List, Optional
+import time
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..resilience import faults as _faults
+from ..resilience.retry import call_with_retry
+from . import checkpoint as ps_ckpt
+from .replication import (PSConfig, PSFailover, ReplicationLog, beat,
+                          lease_fresh, primary_of, set_primary)
+from .tables import DenseTable, SparseTable
+
 __all__ = ["SparseTable", "DenseTable", "PSServer", "PSWorker",
-           "SparseEmbedding"]
+           "SparseEmbedding", "PSConfig", "PSFailover",
+           "RpcTransport", "LocalTransport"]
 
 
-class SparseTable:
-    """In-memory sparse table with lazy row init + per-row optimizer
-    state (reference: memory_sparse_table.cc + the sparse accessors
-    ctr_accessor.cc — sgd/adagrad/adam rules per embedding row)."""
+def _obs():
+    try:
+        from ... import observability as obs
 
-    def __init__(self, dim: int, optimizer: str = "adagrad",
-                 lr: float = 0.01, initializer: str = "uniform",
-                 init_scale: float = 0.01, seed: int = 0,
-                 beta1: float = 0.9, beta2: float = 0.999,
-                 eps: float = 1e-8):
-        if optimizer not in ("sgd", "adagrad", "adam"):
-            raise ValueError(f"unsupported sparse optimizer {optimizer}")
-        self.dim = int(dim)
-        self.optimizer = optimizer
-        self.lr = float(lr)
-        self.initializer = initializer
-        self.init_scale = float(init_scale)
-        self.beta1, self.beta2, self.eps = beta1, beta2, eps
-        self._rows: Dict[int, np.ndarray] = {}  # guarded by: _lock
-        self._state: Dict[int, list] = {}  # guarded by: _lock
-        self._step: Dict[int, int] = {}  # guarded by: _lock
-        self._rng = np.random.default_rng(seed)
-        self._lock = threading.Lock()
-
-    def _init_row(self, rid: int) -> np.ndarray:
-        if self.initializer == "zeros":
-            return np.zeros(self.dim, np.float32)
-        return self._rng.uniform(-self.init_scale, self.init_scale,
-                                 self.dim).astype(np.float32)
-
-    def pull(self, ids) -> np.ndarray:
-        """Rows for ids [n] -> [n, dim]; missing rows are created
-        (reference: pull_sparse with create-on-miss)."""
-        with self._lock:
-            out = np.empty((len(ids), self.dim), np.float32)
-            for i, rid in enumerate(ids):
-                rid = int(rid)
-                row = self._rows.get(rid)
-                if row is None:
-                    row = self._rows[rid] = self._init_row(rid)
-                out[i] = row
-            return out
-
-    def push(self, ids, grads) -> None:
-        """Apply per-row optimizer updates; duplicate ids in one push
-        are accumulated first (the embedding-bag contract)."""
-        grads = np.asarray(grads, np.float32)
-        uniq: Dict[int, np.ndarray] = {}
-        for rid, g in zip(ids, grads):
-            rid = int(rid)
-            if rid in uniq:
-                uniq[rid] = uniq[rid] + g
-            else:
-                uniq[rid] = g.copy()
-        with self._lock:
-            for rid, g in uniq.items():
-                row = self._rows.get(rid)
-                if row is None:
-                    row = self._rows[rid] = self._init_row(rid)
-                if self.optimizer == "sgd":
-                    row -= self.lr * g
-                elif self.optimizer == "adagrad":
-                    st = self._state.setdefault(
-                        rid, [np.zeros(self.dim, np.float32)])
-                    st[0] += g * g
-                    row -= self.lr * g / (np.sqrt(st[0]) + self.eps)
-                else:  # adam
-                    st = self._state.setdefault(
-                        rid, [np.zeros(self.dim, np.float32),
-                              np.zeros(self.dim, np.float32)])
-                    t = self._step.get(rid, 0) + 1
-                    self._step[rid] = t
-                    st[0] = self.beta1 * st[0] + (1 - self.beta1) * g
-                    st[1] = self.beta2 * st[1] + (1 - self.beta2) * g * g
-                    mhat = st[0] / (1 - self.beta1 ** t)
-                    vhat = st[1] / (1 - self.beta2 ** t)
-                    row -= self.lr * mhat / (np.sqrt(vhat) + self.eps)
-
-    def state_dict(self) -> dict:
-        with self._lock:
-            return {"dim": self.dim, "optimizer": self.optimizer,
-                    "rows": {k: v.copy() for k, v in self._rows.items()},
-                    "state": {k: [s.copy() for s in v]
-                              for k, v in self._state.items()},
-                    "step": dict(self._step)}
-
-    def load_state_dict(self, sd: dict) -> None:
-        with self._lock:
-            self._rows = {int(k): np.asarray(v, np.float32)
-                          for k, v in sd["rows"].items()}
-            self._state = {int(k): [np.asarray(s, np.float32) for s in v]
-                           for k, v in sd.get("state", {}).items()}
-            self._step = {int(k): int(v)
-                          for k, v in sd.get("step", {}).items()}
-
-    def __len__(self):
-        with self._lock:
-            return len(self._rows)
+        return obs if obs.enabled() else None
+    except Exception:
+        return None
 
 
-class DenseTable:
-    """Dense parameter vector with server-side SGD (reference:
-    memory_dense_table.cc)."""
-
-    def __init__(self, shape, lr: float = 0.01, seed: int = 0):
-        self.lr = float(lr)
-        self._value = np.random.default_rng(seed).uniform(  # guarded by: _lock
-            -0.01, 0.01, shape).astype(np.float32)
-        self._lock = threading.Lock()
-
-    def pull(self) -> np.ndarray:
-        with self._lock:
-            return self._value.copy()
-
-    def push(self, grad) -> None:
-        with self._lock:
-            self._value -= self.lr * np.asarray(grad, np.float32)
-
-    def set(self, value) -> None:
-        with self._lock:
-            self._value = np.asarray(value, np.float32).copy()
-
-    def state_dict(self) -> dict:
-        with self._lock:
-            return {"value": self._value.copy(), "lr": self.lr}
-
-    def load_state_dict(self, sd: dict) -> None:
-        with self._lock:
-            self._value = np.asarray(sd["value"], np.float32).copy()
-
-    def __len__(self):
-        with self._lock:
-            return int(self._value.size)
+def span(name: str, o, **args):  # name first: ptlint reads args[0]
+    return o.span(name, cat="ps", args=args) if o \
+        else contextlib.nullcontext()
 
 
 # ---------------------------------------------------------------- server
 # rpc entry points are module-level (the transport ships the function by
-# reference); the hosting process keeps its tables in this registry
-_TABLES: Dict[int, object] = {}
+# reference); the hosting process keeps its PSServer instances in this
+# registry keyed by server index, and every handler routes through it —
+# two servers in one process (tests, in-process drills) never share or
+# clobber each other's tables.
+_SERVERS: Dict[int, "PSServer"] = {}
 
 
-def _ps_pull_sparse(table_id: int, ids):
-    return _TABLES[table_id].pull(ids)
+def _server(server_index: int) -> "PSServer":
+    srv = _SERVERS.get(server_index)
+    if srv is None:
+        # unreachable-peer semantics so LocalTransport callers retry /
+        # fail over exactly like an rpc caller with a dead server would
+        raise ConnectionError(
+            f"no PSServer with index {server_index} in this process")
+    return srv
 
 
-def _ps_push_sparse(table_id: int, ids, grads):
-    _TABLES[table_id].push(ids, grads)
-    return True
+def _ps_pull_sparse(server_index: int, shard: int, table_id: int, ids):
+    return _server(server_index).handle_pull_sparse(shard, table_id, ids)
 
 
-def _ps_pull_dense(table_id: int):
-    return _TABLES[table_id].pull()
+def _ps_push_sparse(server_index: int, shard: int, table_id: int, ids,
+                    grads, worker: str = "", seq: int = 0):
+    return _server(server_index).handle_push_sparse(
+        shard, table_id, ids, grads, worker, seq)
 
 
-def _ps_push_dense(table_id: int, grad):
-    _TABLES[table_id].push(grad)
-    return True
+def _ps_pull_dense(server_index: int, shard: int, table_id: int):
+    return _server(server_index).handle_pull_dense(shard, table_id)
 
 
-def _ps_table_size(table_id: int):
-    return len(_TABLES[table_id])
+def _ps_push_dense(server_index: int, shard: int, table_id: int, grad,
+                   worker: str = "", seq: int = 0):
+    return _server(server_index).handle_push_dense(
+        shard, table_id, grad, worker, seq)
 
 
-def _ps_save(table_id: int, path: str):
-    sd = _TABLES[table_id].state_dict()
-    np.save(path, np.array([sd], dtype=object), allow_pickle=True)
-    return True
+def _ps_table_size(server_index: int, shard: int, table_id: int):
+    return _server(server_index).handle_table_size(shard, table_id)
 
 
-def _ps_load(table_id: int, path: str):
-    sd = np.load(path, allow_pickle=True)[0]
-    _TABLES[table_id].load_state_dict(sd)
-    return True
+def _ps_save(server_index: int, shard: int, table_id: int, path: str):
+    return _server(server_index).handle_save(shard, table_id, path)
+
+
+def _ps_load(server_index: int, shard: int, table_id: int, path: str):
+    return _server(server_index).handle_load(shard, table_id, path)
+
+
+def _ps_stats(server_index: int):
+    return _server(server_index).stats()
+
+
+def _ps_digest(server_index: int, shard: int, table_id: int):
+    return _server(server_index).handle_digest(shard, table_id)
+
+
+# ------------------------------------------------------------ transports
+
+class RpcTransport:
+    """Default transport: ships handler calls over the in-repo rpc
+    agent to ``pserver{index}``."""
+
+    def call(self, server_index: int, fn, args,
+             timeout: Optional[float] = None):
+        from .. import rpc
+
+        return rpc.rpc_sync(f"pserver{server_index}", fn,
+                            args=(server_index,) + tuple(args),
+                            timeout=timeout if timeout is not None
+                            else 60.0)
+
+    @property
+    def store(self):
+        from .. import rpc
+
+        return getattr(rpc._agent, "store", None) \
+            if rpc._agent is not None else None
+
+
+class LocalTransport:
+    """In-process transport for tests and bench: dispatches handler
+    functions directly against the PSServer registry — no rpc world
+    needed. A deregistered server raises ConnectionError exactly like a
+    dead rpc peer, so retry/failover paths are exercisable in one
+    process (pass a live ``store`` to enable the shard-map plane)."""
+
+    def __init__(self, servers=None, store=None):
+        self.store = store
+        # servers self-register in _SERVERS at construction; the arg
+        # exists to make ownership explicit at the call site
+        self.servers = list(servers) if servers else None
+
+    def call(self, server_index: int, fn, args,
+             timeout: Optional[float] = None):
+        return fn(server_index, *args)
 
 
 class PSServer:
-    """One parameter-server process: hosts its table shards behind the
-    rpc agent (reference: the_one_ps.py _init_server/_run_server)."""
+    """One parameter-server process: hosts its PRIMARY shard (and, when
+    replication is on, a BACKUP replica of its neighbor's shard) behind
+    the rpc agent (reference: the_one_ps.py _init_server/_run_server +
+    the table replicas brpc keeps per shard)."""
 
-    def __init__(self, server_index: Optional[int] = None):
-        self.server_index = server_index if server_index is not None \
+    def __init__(self, server_index: Optional[int] = None,
+                 n_servers: Optional[int] = None,
+                 config: Optional[PSConfig] = None,
+                 replicated: Optional[bool] = None):
+        self.server_index = int(server_index) if server_index is not None \
             else int(os.environ.get("PADDLE_PSERVER_ID", "0"))
+        if n_servers is None:
+            eps = os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST", "")
+            n_servers = len([e for e in eps.split(",")
+                             if e.strip()]) or 1
+        self.n_servers = int(n_servers)
+        self.cfg = config or PSConfig()
+        if replicated is None:
+            # bare-constructed servers (unit tests, LocalTransport
+            # fleets without a store) replicate only on explicit opt-in;
+            # TheOnePSRuntime resolves the "auto" policy for real jobs
+            replicated = self.cfg.replication == "on"
+        self.replicated = bool(replicated) and self.n_servers >= 2
+        self._lock = threading.RLock()
+        self._tables: Dict[Tuple[int, int], object] = {}  # guarded by: _lock
+        self._hwm: Dict[Tuple[int, int, str], int] = {}  # guarded by: _lock
+        self._counters: Dict[str, int] = {  # guarded by: _lock
+            "pulls": 0, "pushes": 0, "push_dedup_hits": 0,
+            "repl_records": 0, "repl_degraded": 0, "promotions": 0}
+        self._primary_shards = {self.server_index}  # guarded by: _lock
+        self._repl_to: Dict[int, Optional[int]] = {}  # guarded by: _lock
+        self._dead: set = set()  # guarded by: _lock
+        self._logs: Dict[int, ReplicationLog] = {}
+        self.store = None
+        self._world: Optional[int] = None
+        self._grace_end = 0.0
+        self._stop_evt = threading.Event()
+        self._promote_evt = threading.Event()
+        self._threads: List[threading.Thread] = []
+        _SERVERS[self.server_index] = self
+
+    # -------------------------------------------------------- topology
+    @property
+    def backup_shard(self) -> Optional[int]:
+        if not self.replicated:
+            return None
+        return (self.server_index - 1) % self.n_servers
+
+    def hosted_shards(self):
+        shards = {self.server_index}
+        b = self.backup_shard
+        if b is not None:
+            shards.add(b)
+        return shards
 
     def add_sparse_table(self, table_id: int, dim: int, **kw):
-        _TABLES[table_id] = SparseTable(dim,
-                                        seed=1000 + self.server_index,
-                                        **kw)
+        # the seed is per-TABLE (not per-server): every shard and every
+        # replica of a table must initialize row `rid` identically so
+        # sharded == local and primary == backup bit-exactly
+        kw.setdefault("seed", 1000 + int(table_id))
+        with self._lock:
+            for shard in self.hosted_shards():
+                self._tables[(shard, table_id)] = SparseTable(dim, **kw)
 
     def add_dense_table(self, table_id: int, shape, **kw):
-        _TABLES[table_id] = DenseTable(shape, **kw)
+        shard = int(table_id) % self.n_servers
+        with self._lock:
+            if shard in self.hosted_shards():
+                self._tables[(shard, table_id)] = DenseTable(shape, **kw)
 
+    def _table(self, shard: int, table_id: int):
+        with self._lock:
+            tbl = self._tables.get((shard, int(table_id)))
+        if tbl is None:
+            raise KeyError(f"server {self.server_index} hosts no table "
+                           f"{table_id} for shard {shard}")
+        return tbl
+
+    # ---------------------------------------------------- control plane
+    def start(self, store=None, world_size: Optional[int] = None):
+        """Attach the job store and (when replicated) start the beat /
+        applier / watch threads. Call after init_rpc; idempotent."""
+        self.store = store
+        if world_size is not None:
+            self._world = int(world_size)
+        if store is None or not self.replicated:
+            return
+        self._grace_end = time.monotonic() + self.cfg.failover_timeout
+        b = self.backup_shard
+        with self._lock:
+            self._repl_to = {self.server_index:
+                             (self.server_index + 1) % self.n_servers}
+        self._logs = {self.server_index:
+                      ReplicationLog(store, self.server_index),
+                      b: ReplicationLog(store, b)}
+        beat(store, self.server_index)
+        store.set(f"ps/primary/{self.server_index}",
+                  str(self.server_index).encode())
+        for fn in (self._beat_loop, self._applier_loop,
+                   self._watch_loop):
+            t = threading.Thread(target=fn, daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _stale(self, index: int) -> bool:
+        """Dead-peer verdict with a startup grace window: a peer that
+        has never beaten is only 'dead' once the initial failover
+        budget has passed (it may simply still be booting)."""
+        if lease_fresh(self.store, index, self.cfg.lease_timeout):
+            return False
+        from ..elastic.membership import read_beat
+
+        if read_beat(self.store, "ps", index) is None \
+                and time.monotonic() < self._grace_end:
+            return False
+        return True
+
+    def _beat_loop(self):
+        while not self._stop_evt.wait(self.cfg.beat_interval):
+            try:
+                beat(self.store, self.server_index)
+            except Exception:
+                return
+
+    def _applier_loop(self):
+        """Backup side: apply the primary's replication records in
+        order and ack the high-water mark. On promotion request, drain
+        whatever the dead primary managed to post, then take over."""
+        shard = self.backup_shard
+        log = self._logs[shard]
+        while not self._stop_evt.is_set():
+            if self._promote_evt.is_set():
+                self._promote(shard, log)
+                return
+            try:
+                rec = log.take_next()
+            except Exception:
+                if self._stop_evt.is_set():
+                    return
+                self._stop_evt.wait(0.05)
+                continue
+            if rec is None:
+                self._stop_evt.wait(0.004)
+                continue
+            self._apply_record(shard, rec)
+            log.ack()
+
+    def _watch_loop(self):
+        while not self._stop_evt.wait(self.cfg.beat_interval):
+            try:
+                self._watch_once()
+            except Exception:
+                continue
+
+    def _watch_once(self):
+        b = self.backup_shard
+        with self._lock:
+            serving_backup = b in self._primary_shards
+            own_target = self._repl_to.get(self.server_index)
+        if not serving_backup:
+            p = primary_of(self.store, b, b)
+            if p != self.server_index and self._stale(p):
+                self._promote_evt.set()
+        if own_target is not None and self._stale(own_target):
+            self._degrade(self.server_index, own_target)
+
+    def _promote(self, shard: int, log: ReplicationLog):
+        """Runs on the applier thread (it owns the log cursor): drain,
+        then publish ourselves as the shard's primary."""
+        o = _obs()
+        with span("ps.promote", o, shard=shard,
+                  server=self.server_index):
+            while True:
+                rec = log.take_next()
+                if rec is None:
+                    break
+                self._apply_record(shard, rec)
+            log.ack()
+            log.resume_as_primary()
+            old = primary_of(self.store, shard, shard)
+            with self._lock:
+                self._primary_shards.add(shard)
+                if old != self.server_index:
+                    self._dead.add(old)
+                # the shard's natural backup is ourselves now — serve
+                # it unreplicated until a replacement joins
+                self._repl_to[shard] = None
+                self._counters["promotions"] += 1
+            set_primary(self.store, shard, self.server_index)
+        if o:
+            o.registry.counter("ps.promotions").inc()
+
+    def _degrade(self, shard: int, target: int):
+        o = _obs()
+        with self._lock:
+            if self._repl_to.get(shard) != target:
+                return
+            self._repl_to[shard] = None
+            self._dead.add(target)
+            self._counters["repl_degraded"] += 1
+        if o:
+            o.registry.counter("ps.repl_degraded").inc()
+
+    def _replicate(self, shard: int, rec: dict):
+        """Chain step: post the applied record and block on the
+        backup's ack — only then does the worker's push succeed, so an
+        acked push survives this process dying. Degrades (and stops
+        blocking) when the backup's lease goes stale."""
+        with self._lock:
+            target = self._repl_to.get(shard)
+        if target is None or not self._logs:
+            return
+        n = self._logs[shard].post(rec)
+        last_check = [0.0]
+
+        def alive() -> bool:
+            now = time.monotonic()
+            if now - last_check[0] < self.cfg.beat_interval:
+                return True
+            last_check[0] = now
+            return not self._stale(target)
+
+        ok = self._logs[shard].wait_acked(
+            n, self.cfg.failover_timeout, alive=alive)
+        if not ok:
+            self._degrade(shard, target)
+
+    def _apply_record(self, shard: int, rec: dict):
+        key = (shard, int(rec["table"]), rec["worker"])
+        seq = int(rec["seq"])
+        with self._lock:
+            if seq and seq <= self._hwm.get(key, 0):
+                return
+        tbl = self._table(shard, rec["table"])
+        if rec["kind"] == "sparse":
+            tbl.push(rec["ids"], rec["grads"])
+        else:
+            tbl.push(rec["grad"])
+        with self._lock:
+            if seq:
+                self._hwm[key] = seq
+            self._counters["repl_records"] += 1
+        o = _obs()
+        if o:
+            o.registry.counter("ps.repl_records").inc()
+
+    # --------------------------------------------------------- handlers
+    def _fault_gate(self):
+        act = _faults.check("ps.server")
+        if act is None:
+            return
+        if act.kind in ("drop", "loss"):
+            raise ConnectionError(
+                f"fault-injected ps.server {act.kind} "
+                f"(invocation {act.invocation})")
+        _faults.apply(act)  # delay / kill / raise
+
+    def _check_primary(self, shard: int):
+        with self._lock:
+            local = shard in self._primary_shards
+        if not local:
+            raise RuntimeError(
+                f"PSNotPrimary: server {self.server_index} is not "
+                f"primary for shard {shard}")
+        if self.replicated and self.store is not None:
+            p = primary_of(self.store, shard, shard)
+            if p != self.server_index:
+                # fencing: the map moved away from us (we were deposed
+                # while suspected dead) — stop serving the shard so two
+                # primaries can't diverge
+                with self._lock:
+                    self._primary_shards.discard(shard)
+                raise RuntimeError(
+                    f"PSNotPrimary: shard {shard} moved to server {p}")
+
+    def handle_pull_sparse(self, shard: int, table_id: int, ids):
+        self._fault_gate()
+        self._check_primary(shard)
+        rows = self._table(shard, table_id).pull(ids)
+        with self._lock:
+            self._counters["pulls"] += len(ids)
+        o = _obs()
+        if o:
+            o.registry.counter("ps.pulls").inc(len(ids))
+        return rows
+
+    def handle_push_sparse(self, shard: int, table_id: int, ids, grads,
+                           worker: str = "", seq: int = 0):
+        self._fault_gate()
+        self._check_primary(shard)
+        key = (shard, int(table_id), worker)
+        seq = int(seq)
+        with self._lock:
+            dedup = bool(seq) and seq <= self._hwm.get(key, 0)
+            if dedup:
+                self._counters["push_dedup_hits"] += 1
+        o = _obs()
+        if dedup:
+            # at-least-once delivery (rpc retransmit, failover replay,
+            # lost acks) re-sends batches; the high-water mark makes
+            # re-application a no-op instead of a double optimizer step
+            if o:
+                o.registry.counter("ps.push_dedup_hits").inc()
+            return {"ok": True, "dedup": True}
+        self._table(shard, table_id).push(ids, grads)
+        with self._lock:
+            if seq:
+                self._hwm[key] = seq
+            self._counters["pushes"] += len(ids)
+        self._replicate(shard, {"kind": "sparse", "table": int(table_id),
+                                "ids": ids, "grads": grads,
+                                "worker": worker, "seq": seq})
+        if o:
+            o.registry.counter("ps.pushes").inc(len(ids))
+        return {"ok": True, "dedup": False}
+
+    def handle_pull_dense(self, shard: int, table_id: int):
+        self._fault_gate()
+        self._check_primary(shard)
+        value = self._table(shard, table_id).pull()
+        with self._lock:
+            self._counters["pulls"] += 1
+        o = _obs()
+        if o:
+            o.registry.counter("ps.pulls").inc()
+        return value
+
+    def handle_push_dense(self, shard: int, table_id: int, grad,
+                          worker: str = "", seq: int = 0):
+        self._fault_gate()
+        self._check_primary(shard)
+        key = (shard, int(table_id), worker)
+        seq = int(seq)
+        with self._lock:
+            dedup = bool(seq) and seq <= self._hwm.get(key, 0)
+            if dedup:
+                self._counters["push_dedup_hits"] += 1
+        o = _obs()
+        if dedup:
+            if o:
+                o.registry.counter("ps.push_dedup_hits").inc()
+            return {"ok": True, "dedup": True}
+        self._table(shard, table_id).push(grad)
+        with self._lock:
+            if seq:
+                self._hwm[key] = seq
+            self._counters["pushes"] += 1
+        self._replicate(shard, {"kind": "dense", "table": int(table_id),
+                                "grad": np.asarray(grad, np.float32),
+                                "worker": worker, "seq": seq})
+        if o:
+            o.registry.counter("ps.pushes").inc()
+        return {"ok": True, "dedup": False}
+
+    def handle_table_size(self, shard: int, table_id: int) -> int:
+        self._check_primary(shard)
+        return len(self._table(shard, table_id))
+
+    def handle_digest(self, shard: int, table_id: int) -> str:
+        return self._table(shard, table_id).digest()
+
+    def handle_save(self, shard: int, table_id: int, path: str) -> str:
+        tbl = self._table(shard, table_id)
+        with self._lock:
+            hwm = {w: s for (sh, t, w), s in self._hwm.items()
+                   if sh == shard and t == int(table_id)}
+        return ps_ckpt.write_table(
+            path, {"table": tbl.state_dict(), "hwm": hwm})
+
+    def handle_load(self, shard: int, table_id: int, path: str) -> bool:
+        sd = ps_ckpt.read_table(path)
+        if "table" in sd:  # current format: state + dedup high-water marks
+            self._table(shard, table_id).load_state_dict(sd["table"])
+            with self._lock:
+                for w, s in sd.get("hwm", {}).items():
+                    self._hwm[(shard, int(table_id), w)] = int(s)
+        else:  # legacy raw state_dict
+            self._table(shard, table_id).load_state_dict(sd)
+        return True
+
+    def stats(self) -> dict:
+        """Plain-int counter snapshot (drills assert on this without
+        needing the observability registry enabled)."""
+        with self._lock:
+            d = dict(self._counters)
+            d["primary_shards"] = sorted(self._primary_shards)
+            d["dead"] = sorted(self._dead)
+            tables = list(self._tables.items())
+        d["server_index"] = self.server_index
+        d["evictions"] = 0
+        d["admission_denied"] = 0
+        d["rows"] = 0
+        for (_s, _t), tbl in tables:
+            c = getattr(tbl, "counters", None)
+            if c is None:
+                continue
+            tc = c()
+            d["evictions"] += tc["evictions"]
+            d["admission_denied"] += tc["admission_denied"]
+            d["rows"] += tc["rows"]
+        return d
+
+    # ---------------------------------------------------------- serving
     def run(self):
-        """Serve until every trainer has called stop (the rpc shutdown
-        barrier is the serving loop — dispatchers answer pulls/pushes
-        while this blocks)."""
+        """Serve until every live rank has called stop (the rpc
+        shutdown barrier is the serving loop — dispatchers answer
+        pulls/pushes while this blocks). Peers this server observed die
+        are subtracted from the barrier's expected count."""
         from .. import rpc
 
-        rpc.shutdown()  # barriers with the trainers' stop_worker()
+        if self._world is None and rpc._agent is not None:
+            self._world = rpc._agent.world_size
+
+        def dead_ranks() -> set:
+            # server index s is rpc rank (n_trainers + s)
+            if self._world is None:
+                return set()
+            t = self._world - self.n_servers
+            with self._lock:
+                return {t + d for d in self._dead}
+
+        try:
+            rpc.shutdown(dead_ranks=dead_ranks)
+        finally:
+            self.shutdown_local()
+
+    def shutdown_local(self):
+        """Stop control-plane threads and deregister from the handler
+        registry (in-process death for tests/drills)."""
+        self._stop_evt.set()
+        for t in self._threads:
+            t.join(timeout=2.0)
+        self._threads = []
+        if _SERVERS.get(self.server_index) is self:
+            del _SERVERS[self.server_index]
 
     def save(self, table_id: int, path: str):
-        _ps_save(table_id, path)
+        self.handle_save(self.server_index, table_id, path)
 
     def load(self, table_id: int, path: str):
-        _ps_load(table_id, path)
+        self.handle_load(self.server_index, table_id, path)
 
 
 class PSWorker:
     """Trainer-side client: shards requests over the server ranks by
     `id % n_servers` (reference: the worker side of the_one_ps +
-    fleet.init_worker)."""
+    fleet.init_worker), resolving each shard's current PRIMARY through
+    the store map.
 
-    def __init__(self, n_trainers: int, n_servers: int):
+    Every sharded op runs under the shared retry policy with the
+    ``PADDLE_TPU_PS_TIMEOUT`` whole-op deadline. Pushes carry monotonic
+    per-(shard, table) sequence numbers and sit in an in-flight window
+    until acked; on a typed :class:`PSFailover` (shard map moved) the
+    window is replayed against the new primary — server-side seq dedup
+    makes the replay + retry exactly-once. The client is synchronous,
+    so the window holds at most the op currently in flight per shard;
+    the replay path does not depend on that, but ordering does (window
+    entries replay oldest-first before the current op retries)."""
+
+    def __init__(self, n_trainers: int, n_servers: int,
+                 worker_id: Optional[str] = None, transport=None,
+                 config: Optional[PSConfig] = None):
         self.n_trainers = n_trainers
         self.n_servers = n_servers
+        self.cfg = config or PSConfig()
+        self.worker_id = worker_id if worker_id is not None else \
+            f"trainer{os.environ.get('PADDLE_TRAINER_ID', '0')}"
+        self.transport = transport if transport is not None \
+            else RpcTransport()
+        self._lock = threading.Lock()
+        self._seq: Dict[Tuple[int, int], int] = {}  # guarded by: _lock
+        self._window: Dict[int, list] = {}  # guarded by: _lock
+        self._primary: Dict[int, int] = {}  # guarded by: _lock
+        self._dead: set = set()  # guarded by: _lock
+        # observed failover events (the drill asserts on these):
+        # {shard, old, new, latency_s, replayed}
+        self.failovers: List[dict] = []
 
     def _server_name(self, s: int) -> str:
         return f"pserver{s}"
 
+    # ------------------------------------------------------- shard map
+    def primary_for(self, shard: int, refresh: bool = False) -> int:
+        store = getattr(self.transport, "store", None)
+        if store is None or self.n_servers < 2:
+            return shard
+        if not refresh:
+            with self._lock:
+                p = self._primary.get(shard)
+            if p is not None:
+                return p
+        p = primary_of(store, shard, shard)
+        with self._lock:
+            self._primary[shard] = p
+        return p
+
+    def _next_seq(self, shard: int, table_id: int) -> int:
+        with self._lock:
+            n = self._seq.get((shard, table_id), 0) + 1
+            self._seq[(shard, table_id)] = n
+        return n
+
+    def _ack(self, shard: int, rec: dict):
+        with self._lock:
+            w = self._window.get(shard)
+            if w and rec in w:
+                w.remove(rec)
+
+    # ------------------------------------------------------- core call
+    def _shard_call(self, site: str, shard: int, fn, args,
+                    window_rec: Optional[dict] = None):
+        """One sharded op: per-attempt fault injection + shared retry
+        policy inside, typed PSFailover adoption + window replay
+        outside, the whole thing bounded by ``cfg.timeout``."""
+        deadline = time.monotonic() + self.cfg.timeout
+        detect = [None]
+
+        def attempt():
+            p_known = self.primary_for(shard)
+            p_now = self.primary_for(shard, refresh=True)
+            if p_now != p_known:
+                raise PSFailover(shard, p_known, p_now,
+                                 "shard map moved")
+            act = _faults.check(site)
+            call_args = args
+            if act is not None:
+                if act.kind in ("drop", "loss"):
+                    raise ConnectionError(
+                        f"fault-injected {site} {act.kind} "
+                        f"(invocation {act.invocation})")
+                if act.kind == "bitflip":
+                    call_args = _bitflip_args(args)
+                elif act.kind != "raise":  # raise fires AFTER the call
+                    _faults.apply(act)  # delay / kill
+            try:
+                out = self.transport.call(
+                    p_now, fn, (shard,) + tuple(call_args),
+                    timeout=self.cfg.rpc_timeout)
+            except RuntimeError as e:
+                msg = str(e)
+                if isinstance(e, PSFailover):
+                    raise
+                if "PSNotPrimary" in msg or "fault-injected" in msg:
+                    # shipped server-side errors: retryable
+                    raise ConnectionError(msg)
+                raise
+            if act is not None and act.kind == "raise":
+                # lost-ack: the server applied the op but the reply
+                # never arrives; the retried send (same seq) must hit
+                # the server's dedup table, not re-apply
+                raise ConnectionError(
+                    f"fault-injected {site} lost ack "
+                    f"(invocation {act.invocation})")
+            return out
+
+        def on_retry(err):
+            if detect[0] is None:
+                detect[0] = time.monotonic()
+
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise PSFailover(
+                    shard, self.primary_for(shard), None,
+                    f"{site} budget exhausted "
+                    f"(PADDLE_TPU_PS_TIMEOUT={self.cfg.timeout}s)")
+            policy = self.cfg.retry_policy().with_deadline(remaining)
+            try:
+                out = call_with_retry(attempt, policy=policy, site=site,
+                                      on_retry=on_retry)
+            except PSFailover as fo:
+                if fo.new_primary is None:
+                    raise  # budget exhausted (raised above)
+                self._adopt(fo, detect)
+                continue
+            except (ConnectionError, TimeoutError, OSError):
+                # retry budget spent but the op deadline hasn't passed:
+                # keep knocking (the promotion may still be in flight)
+                if detect[0] is None:
+                    detect[0] = time.monotonic()
+                continue
+            if window_rec is not None:
+                self._ack(shard, window_rec)
+            return out
+
+    def _adopt(self, fo: PSFailover, detect):
+        """Adopt a moved shard map: mark the old primary dead, replay
+        the unacked window against the new one, record the event."""
+        o = _obs()
+        new = fo.new_primary
+        with self._lock:
+            if fo.old_primary is not None and fo.old_primary != new:
+                self._dead.add(fo.old_primary)
+            self._primary[fo.shard] = new
+        replayed = self._replay(fo.shard)
+        now = time.monotonic()
+        t0 = detect[0] if detect[0] is not None else now
+        self.failovers.append({
+            "shard": fo.shard, "old": fo.old_primary, "new": new,
+            "latency_s": now - t0, "replayed": replayed})
+        detect[0] = None
+        if o:
+            o.registry.counter("ps.failovers").inc()
+
+    def _replay(self, shard: int) -> int:
+        with self._lock:
+            pending = list(self._window.get(shard, ()))
+        if not pending:
+            return 0
+        o = _obs()
+        with span("ps.replay", o, shard=shard, n=len(pending)):
+            for rec in pending:
+                try:
+                    p = self.primary_for(shard)
+                    self.transport.call(p, rec["fn"], rec["args"],
+                                        timeout=self.cfg.rpc_timeout)
+                    self._ack(shard, rec)
+                except (ConnectionError, TimeoutError, OSError,
+                        RuntimeError):
+                    # still unreachable: the entry stays in the window;
+                    # the op retry loop (same seq -> dedup) covers it
+                    break
+        if o:
+            o.registry.counter("ps.replays").inc(len(pending))
+        return len(pending)
+
+    # ------------------------------------------------------ sparse ops
     def pull_sparse(self, table_id: int, ids,
                     dim: Optional[int] = None) -> np.ndarray:
-        from .. import rpc
-
         ids = np.asarray(ids, np.int64).ravel()
         if len(ids) == 0:
             return np.zeros((0, dim or 0), np.float32)
-        parts: List[np.ndarray] = [None] * self.n_servers  # type: ignore
-        for s in range(self.n_servers):
-            mask = (ids % self.n_servers) == s
-            if mask.any():
-                parts[s] = rpc.rpc_sync(
-                    self._server_name(s), _ps_pull_sparse,
-                    args=(table_id, ids[mask].tolist()))
+        o = _obs()
+        t0 = time.perf_counter()
+        parts: List[Optional[np.ndarray]] = [None] * self.n_servers
+        with span("ps.pull", o, table=int(table_id),
+                  rows=int(len(ids))):
+            for s in range(self.n_servers):
+                mask = (ids % self.n_servers) == s
+                if mask.any():
+                    parts[s] = np.asarray(self._shard_call(
+                        "ps.pull", s, _ps_pull_sparse,
+                        (table_id, ids[mask].tolist())), np.float32)
         dim = next(p.shape[1] for p in parts if p is not None)
         out = np.empty((len(ids), dim), np.float32)
         for s in range(self.n_servers):
             if parts[s] is not None:
                 out[(ids % self.n_servers) == s] = parts[s]
+        if o:
+            o.registry.histogram("ps.pull_time").observe(
+                time.perf_counter() - t0)
         return out
 
     def push_sparse(self, table_id: int, ids, grads) -> None:
-        from .. import rpc
-
         ids = np.asarray(ids, np.int64).ravel()
         grads = np.asarray(grads, np.float32)
-        futs = []
-        for s in range(self.n_servers):
-            mask = (ids % self.n_servers) == s
-            if mask.any():
-                futs.append(rpc.rpc_async(
-                    self._server_name(s), _ps_push_sparse,
-                    args=(table_id, ids[mask].tolist(),
-                          grads[mask])))
-        for f in futs:
-            f.result(timeout=60)
+        o = _obs()
+        t0 = time.perf_counter()
+        with span("ps.push", o, table=int(table_id),
+                  rows=int(len(ids))):
+            for s in range(self.n_servers):
+                mask = (ids % self.n_servers) == s
+                if not mask.any():
+                    continue
+                seq = self._next_seq(s, table_id)
+                call_args = (table_id, ids[mask].tolist(), grads[mask],
+                             self.worker_id, seq)
+                rec = {"fn": _ps_push_sparse,
+                       "args": (s,) + call_args, "seq": seq}
+                with self._lock:
+                    self._window.setdefault(s, []).append(rec)
+                self._shard_call("ps.push", s, _ps_push_sparse,
+                                 call_args, window_rec=rec)
+        if o:
+            o.registry.histogram("ps.push_time").observe(
+                time.perf_counter() - t0)
 
+    # ------------------------------------------------------- dense ops
     def pull_dense(self, table_id: int) -> np.ndarray:
-        from .. import rpc
-
-        return rpc.rpc_sync(self._server_name(table_id
-                                              % self.n_servers),
-                            _ps_pull_dense, args=(table_id,))
+        shard = table_id % self.n_servers
+        return np.asarray(self._shard_call(
+            "ps.pull", shard, _ps_pull_dense, (table_id,)), np.float32)
 
     def push_dense(self, table_id: int, grad) -> None:
-        from .. import rpc
+        shard = table_id % self.n_servers
+        grad = np.asarray(grad, np.float32)
+        seq = self._next_seq(shard, table_id)
+        call_args = (table_id, grad, self.worker_id, seq)
+        rec = {"fn": _ps_push_dense, "args": (shard,) + call_args,
+               "seq": seq}
+        with self._lock:
+            self._window.setdefault(shard, []).append(rec)
+        self._shard_call("ps.push", shard, _ps_push_dense, call_args,
+                         window_rec=rec)
 
-        rpc.rpc_sync(self._server_name(table_id % self.n_servers),
-                     _ps_push_dense, args=(table_id, np.asarray(grad)))
-
+    # ------------------------------------------------------------ misc
     def table_size(self, table_id: int) -> int:
-        from .. import rpc
-
-        return sum(rpc.rpc_sync(self._server_name(s), _ps_table_size,
-                                args=(table_id,))
+        return sum(int(self._shard_call("ps.pull", s, _ps_table_size,
+                                        (table_id,)))
                    for s in range(self.n_servers))
 
-    def stop(self):
-        """Symmetric with PSServer.run(): barriers everyone out."""
+    def server_stats(self, server_index: int) -> dict:
+        return self.transport.call(server_index, _ps_stats, ())
+
+    def save_table(self, shard: int, table_id: int, path: str) -> str:
+        return self._shard_call("ps.push", shard, _ps_save,
+                                (table_id, path))
+
+    def stop(self, timeout: float = 120.0):
+        """Symmetric with PSServer.run(): barriers everyone out (minus
+        the peers this worker observed die)."""
         from .. import rpc
 
-        rpc.shutdown()
+        if not isinstance(self.transport, RpcTransport) \
+                or rpc._agent is None:
+            return
+
+        def dead_ranks() -> set:
+            with self._lock:
+                return {self.n_trainers + d for d in self._dead}
+
+        rpc.shutdown(timeout=timeout, dead_ranks=dead_ranks)
+
+
+def _bitflip_args(args):
+    """Site-specific 'bitflip' payload corruption: flip one mantissa
+    bit of the first float32 ndarray in the op's args (push grads); a
+    pull has none and comes back clean — the corruption there is
+    observable as the wrong gradient landing in the table."""
+    out = []
+    flipped = False
+    for a in args:
+        if not flipped and isinstance(a, np.ndarray) \
+                and a.dtype == np.float32 and a.size:
+            a = a.copy()
+            v = a.view(np.uint32)
+            v.flat[0] ^= np.uint32(1 << 20)
+            flipped = True
+        out.append(a)
+    return tuple(out)
 
 
 class SparseEmbedding:

@@ -1,0 +1,199 @@
+"""PS replication/failover control plane over the job TCPStore.
+
+Same lease discipline as ``elastic/membership.py`` (PR 13): servers
+beat ``ps/beat/{index}`` JSON timestamps; a lease is fresh within
+``0.5 * failover_timeout``. The authoritative shard map lives at
+``ps/primary/{shard}`` with a generation counter at ``ps/gen`` —
+workers cache it and re-resolve when an op fails or the generation
+moves.
+
+Replication itself rides the store, NOT a nested rpc: each rpc agent
+has ONE dispatcher thread, so a push handler that rpc'd its backup
+synchronously would deadlock the moment the backup pushed back (or
+simply saturate under symmetric load). Instead the primary appends
+pickled records to an ordered per-shard log (``ps/repl/{shard}/{n}``)
+and blocks on the backup's ack high-water mark (``ps/replack/{shard}``)
+which the backup's applier thread advances after applying in order.
+An acked push is therefore applied on BOTH replicas before the worker
+sees success — that, plus seq-number dedup, is what makes failover
+bit-exact.
+"""
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import time
+from typing import Callable, Optional
+
+from ..elastic.membership import read_beat, try_get
+from ..resilience.retry import RetryPolicy, default_policy
+
+__all__ = ["PSConfig", "PSFailover", "ReplicationLog", "beat",
+           "lease_fresh", "primary_of", "set_primary", "map_generation"]
+
+
+class PSFailover(RuntimeError):
+    """A shard's primary moved (promotion) or died while an op was in
+    flight. Workers catch this, adopt the new shard map, replay their
+    unacked in-flight window (dedup makes the replay exactly-once) and
+    retry; it escapes to the caller only when the op deadline
+    (``PADDLE_TPU_PS_TIMEOUT``) is exhausted."""
+
+    def __init__(self, shard: int, old_primary: Optional[int] = None,
+                 new_primary: Optional[int] = None, reason: str = ""):
+        self.shard = shard
+        self.old_primary = old_primary
+        self.new_primary = new_primary
+        super().__init__(
+            f"PSFailover(shard={shard}, old={old_primary}, "
+            f"new={new_primary}): {reason}")
+
+
+def _env_f(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+class PSConfig:
+    """PS tier knobs (env-overridable, ctor args win):
+
+    - ``PADDLE_TPU_PS_TIMEOUT`` — whole-op deadline for one sharded
+      pull/push including retries, failover wait and replay (s).
+    - ``PADDLE_TPU_PS_RPC_TIMEOUT`` — per-attempt rpc timeout (s).
+    - ``PADDLE_TPU_PS_BEAT`` — server heartbeat interval (s).
+    - ``PADDLE_TPU_PS_FAILOVER_TIMEOUT`` — budget from primary death to
+      promoted service; the lease expires at half of it (the
+      ``ElasticConfig.lease_timeout`` discipline).
+    - ``PADDLE_TPU_PS_REPLICATION`` — on|off|auto (auto: replicate
+      whenever the job runs >= 2 servers).
+    """
+
+    def __init__(self, timeout: Optional[float] = None,
+                 rpc_timeout: Optional[float] = None,
+                 beat_interval: Optional[float] = None,
+                 failover_timeout: Optional[float] = None,
+                 replication: Optional[str] = None):
+        self.timeout = timeout if timeout is not None else _env_f(
+            "PADDLE_TPU_PS_TIMEOUT", 30.0)
+        self.rpc_timeout = rpc_timeout if rpc_timeout is not None \
+            else _env_f("PADDLE_TPU_PS_RPC_TIMEOUT", 2.0)
+        self.beat_interval = beat_interval if beat_interval is not None \
+            else _env_f("PADDLE_TPU_PS_BEAT", 0.15)
+        self.failover_timeout = failover_timeout \
+            if failover_timeout is not None \
+            else _env_f("PADDLE_TPU_PS_FAILOVER_TIMEOUT", 5.0)
+        self.replication = (replication or os.environ.get(
+            "PADDLE_TPU_PS_REPLICATION", "auto")).lower()
+
+    @property
+    def lease_timeout(self) -> float:
+        return 0.5 * self.failover_timeout
+
+    def retry_policy(self) -> RetryPolicy:
+        """Per-op policy: many cheap attempts under one deadline, so a
+        worker keeps knocking right through the promotion window
+        instead of exhausting 5 attempts before the lease even
+        expires."""
+        return default_policy(deadline=self.timeout, max_attempts=64,
+                              base_delay=0.02, max_delay=0.25)
+
+    def replicated(self, n_servers: int) -> bool:
+        if self.replication == "on":
+            return True
+        if self.replication == "off":
+            return False
+        return n_servers >= 2
+
+
+# ------------------------------------------------------------ store keys
+
+def beat(store, index: int) -> None:
+    store.set(f"ps/beat/{index}",
+              json.dumps({"t": time.time()}).encode())
+
+
+def lease_fresh(store, index: int, lease_timeout: float) -> bool:
+    b = read_beat(store, "ps", index)
+    return b is not None and (time.time() - b.get("t", 0.0)
+                              ) <= lease_timeout
+
+
+def primary_of(store, shard: int, default: int) -> int:
+    raw = try_get(store, f"ps/primary/{shard}")
+    return int(raw) if raw else default
+
+
+def set_primary(store, shard: int, index: int) -> None:
+    store.set(f"ps/primary/{shard}", str(index).encode())
+    store.add("ps/gen", 1)  # workers watch this to re-resolve eagerly
+
+
+def map_generation(store) -> int:
+    return store.add("ps/gen", 0)
+
+
+class ReplicationLog:
+    """Ordered per-shard update log through the store. The primary
+    ``post``s records and ``wait_acked``s; the backup's applier thread
+    ``take_next``s in order and ``ack``s after applying. Handler calls
+    are serialized by the rpc dispatcher, so the sequence number is a
+    plain local counter on each side."""
+
+    def __init__(self, store, shard: int, next_seq: int = 1):
+        self.store = store
+        self.shard = shard
+        self._next_post = next_seq  # primary side
+        self._next_apply = next_seq  # backup side
+
+    def post(self, record: dict) -> int:
+        n = self._next_post
+        self._next_post += 1
+        self.store.set(f"ps/repl/{self.shard}/{n}",
+                       pickle.dumps(record, protocol=4))
+        return n
+
+    def acked(self) -> int:
+        raw = try_get(self.store, f"ps/replack/{self.shard}")
+        return int(raw) if raw else 0
+
+    def wait_acked(self, n: int, deadline_s: float,
+                   alive: Callable[[], bool]) -> bool:
+        """Block until the backup acked record ``n``; gives up (so the
+        primary can degrade to unreplicated) when the backup's lease
+        goes stale or ``deadline_s`` passes."""
+        end = time.monotonic() + deadline_s
+        while time.monotonic() < end:
+            if self.acked() >= n:
+                return True
+            if not alive():
+                return False
+            time.sleep(0.003)
+        return False
+
+    def take_next(self) -> Optional[dict]:
+        key = f"ps/repl/{self.shard}/{self._next_apply}"
+        raw = try_get(self.store, key)
+        if raw is None:
+            return None
+        rec = pickle.loads(raw)
+        try:
+            self.store.delete(key)
+        except Exception:
+            pass
+        self._next_apply += 1
+        return rec
+
+    def ack(self) -> None:
+        self.store.set(f"ps/replack/{self.shard}",
+                       str(self._next_apply - 1).encode())
+
+    def applied_count(self) -> int:
+        return self._next_apply - 1
+
+    def resume_as_primary(self) -> None:
+        """After promotion the drained backup becomes the shard's
+        writer: continue the post counter where the applier stopped."""
+        self._next_post = self._next_apply

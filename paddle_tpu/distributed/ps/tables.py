@@ -1,0 +1,306 @@
+"""Sparse/dense PS tables (reference: memory_sparse_table.cc +
+ctr_accessor.cc — per-row optimizer state, admission filters and
+capacity-bounded eviction behind the accessor's EntryAttr config).
+
+Two properties here carry the whole replication design in
+``replication.py`` / ``data_plane.py``:
+
+* **Per-id deterministic init.** A row's initial value depends only on
+  ``(table seed, row id)`` — NOT on creation order. Every shard of a
+  table and every replica of a shard constructs rows identically, so
+  pull-created rows never need to be replicated and a sharded
+  deployment is bit-identical to one local table.
+* **Push-only mutation of admission/eviction state.** Admission counts
+  and the eviction clock advance only on pushes (which the primary
+  replicates); pulls leave them untouched. A primary that has served
+  pulls a backup never saw still converges to the same pushed-row
+  state, which is what failover promotes.
+"""
+from __future__ import annotations
+
+import struct
+import threading
+import zlib
+from typing import Dict, Optional
+
+import numpy as np
+
+__all__ = ["SparseTable", "DenseTable"]
+
+
+def _obs():
+    try:
+        from ... import observability as obs
+
+        return obs if obs.enabled() else None
+    except Exception:
+        return None
+
+
+def _row_rng(seed: int, rid: int) -> np.random.Generator:
+    return np.random.default_rng([int(seed) & 0xFFFFFFFF,
+                                  int(rid) & 0xFFFFFFFFFFFFFFFF])
+
+
+class SparseTable:
+    """In-memory sparse table with lazy row init + per-row optimizer
+    state (reference: memory_sparse_table.cc + the sparse accessors
+    ctr_accessor.cc — sgd/adagrad/adam rules per embedding row).
+
+    ``entry_attr`` (an ``extras.ProbabilityEntry`` /
+    ``CountFilterEntry``, duck-typed) gates row materialization the way
+    the reference accessor does: with an entry filter configured, pulls
+    of unmaterialized ids return the deterministic init WITHOUT storing
+    a row, and pushes admit the row only once the filter passes (denied
+    gradients are dropped, counted in ``ps.admission_denied``).
+
+    ``capacity`` bounds the number of *pushed* rows: when exceeded, the
+    least-recently-pushed rows are evicted (``ps.evictions``). The
+    push-recency clock is replication-safe — it only moves on pushes.
+    """
+
+    def __init__(self, dim: int, optimizer: str = "adagrad",
+                 lr: float = 0.01, initializer: str = "uniform",
+                 init_scale: float = 0.01, seed: int = 0,
+                 beta1: float = 0.9, beta2: float = 0.999,
+                 eps: float = 1e-8, entry_attr=None,
+                 capacity: Optional[int] = None):
+        if optimizer not in ("sgd", "adagrad", "adam"):
+            raise ValueError(f"unsupported sparse optimizer {optimizer}")
+        self.dim = int(dim)
+        self.optimizer = optimizer
+        self.lr = float(lr)
+        self.initializer = initializer
+        self.init_scale = float(init_scale)
+        self.seed = int(seed)
+        self.beta1, self.beta2, self.eps = beta1, beta2, eps
+        self.capacity = int(capacity) if capacity is not None else None
+        # entry_attr is duck-typed (avoids importing extras, which
+        # pulls in jax): ProbabilityEntry carries _probability,
+        # CountFilterEntry carries _count_filter.
+        self._admit_prob = getattr(entry_attr, "_probability", None)
+        self._admit_count = getattr(entry_attr, "_count_filter", None)
+        self._gated = entry_attr is not None
+        self._rows: Dict[int, np.ndarray] = {}  # guarded by: _lock
+        self._state: Dict[int, list] = {}  # guarded by: _lock
+        self._step: Dict[int, int] = {}  # guarded by: _lock
+        self._counts: Dict[int, int] = {}  # guarded by: _lock
+        self._ticks: Dict[int, int] = {}  # guarded by: _lock
+        self._tick = 0  # guarded by: _lock
+        self.evictions = 0  # guarded by: _lock
+        self.admission_denied = 0  # guarded by: _lock
+        self._lock = threading.Lock()
+
+    def _init_row(self, rid: int) -> np.ndarray:
+        if self.initializer == "zeros":
+            return np.zeros(self.dim, np.float32)
+        return _row_rng(self.seed, rid).uniform(
+            -self.init_scale, self.init_scale,
+            self.dim).astype(np.float32)
+
+    def _admits(self, rid: int, count: int) -> bool:
+        """Deterministic admission decision for an unmaterialized row —
+        identical on every replica (stateless hash for probability,
+        replicated push count for the count filter)."""
+        if self._admit_count is not None:
+            return count >= self._admit_count
+        if self._admit_prob is not None:
+            h = zlib.crc32(struct.pack("<qq", self.seed, int(rid)))
+            return (h / 0x100000000) < self._admit_prob
+        return True
+
+    def pull(self, ids) -> np.ndarray:
+        """Rows for ids [n] -> [n, dim]; missing rows are created
+        (reference: pull_sparse with create-on-miss) — unless an entry
+        filter is configured, in which case unadmitted ids are served
+        their deterministic init value without materializing."""
+        with self._lock:
+            out = np.empty((len(ids), self.dim), np.float32)
+            for i, rid in enumerate(ids):
+                rid = int(rid)
+                row = self._rows.get(rid)
+                if row is None:
+                    if self._gated:
+                        row = self._init_row(rid)
+                    else:
+                        row = self._rows[rid] = self._init_row(rid)
+                out[i] = row
+            return out
+
+    def push(self, ids, grads) -> None:
+        """Apply per-row optimizer updates; duplicate ids in one push
+        are accumulated first (the embedding-bag contract)."""
+        grads = np.asarray(grads, np.float32)
+        uniq: Dict[int, np.ndarray] = {}
+        for rid, g in zip(ids, grads):
+            rid = int(rid)
+            if rid in uniq:
+                uniq[rid] = uniq[rid] + g
+            else:
+                uniq[rid] = g.copy()
+        denied = 0
+        with self._lock:
+            for rid, g in uniq.items():
+                row = self._rows.get(rid)
+                if row is None:
+                    count = self._counts.get(rid, 0) + 1
+                    if self._admit_count is not None:
+                        self._counts[rid] = count
+                    if not self._admits(rid, count):
+                        self.admission_denied += 1
+                        denied += 1
+                        continue
+                    row = self._rows[rid] = self._init_row(rid)
+                self._apply_locked(rid, row, g)
+                self._tick += 1
+                self._ticks[rid] = self._tick
+            evicted = self._evict_locked()
+        o = _obs()
+        if o:
+            if denied:
+                o.registry.counter("ps.admission_denied").inc(denied)
+            if evicted:
+                o.registry.counter("ps.evictions").inc(evicted)
+
+    def _apply_locked(self, rid: int, row: np.ndarray,
+                      g: np.ndarray) -> None:  # ptlint: holds=_lock
+        if self.optimizer == "sgd":
+            row -= self.lr * g
+        elif self.optimizer == "adagrad":
+            st = self._state.setdefault(
+                rid, [np.zeros(self.dim, np.float32)])
+            st[0] += g * g
+            row -= self.lr * g / (np.sqrt(st[0]) + self.eps)
+        else:  # adam
+            st = self._state.setdefault(
+                rid, [np.zeros(self.dim, np.float32),
+                      np.zeros(self.dim, np.float32)])
+            t = self._step.get(rid, 0) + 1
+            self._step[rid] = t
+            st[0] = self.beta1 * st[0] + (1 - self.beta1) * g
+            st[1] = self.beta2 * st[1] + (1 - self.beta2) * g * g
+            mhat = st[0] / (1 - self.beta1 ** t)
+            vhat = st[1] / (1 - self.beta2 ** t)
+            row -= self.lr * mhat / (np.sqrt(vhat) + self.eps)
+
+    def _evict_locked(self) -> int:  # ptlint: holds=_lock
+        """LRU-by-push eviction down to ``capacity`` pushed rows, plus
+        hygiene for pull-created rows once the table is over budget.
+        Dropping a never-pushed row is a semantic no-op (per-id init
+        recreates it bit-identically), so replicas need not agree on
+        which pull-created rows exist."""
+        if self.capacity is None:
+            return 0
+        evicted = 0
+        if len(self._ticks) > self.capacity:
+            overflow = len(self._ticks) - self.capacity
+            for rid, _t in sorted(self._ticks.items(),
+                                  key=lambda kv: kv[1])[:overflow]:
+                self._drop_locked(rid)
+                evicted += 1
+        if len(self._rows) > self.capacity:
+            cold = sorted(r for r in self._rows if r not in self._ticks)
+            for rid in cold[:len(self._rows) - self.capacity]:
+                self._drop_locked(rid)
+                evicted += 1
+        self.evictions += evicted
+        return evicted
+
+    def _drop_locked(self, rid: int) -> None:  # ptlint: holds=_lock
+        self._rows.pop(rid, None)
+        self._state.pop(rid, None)
+        self._step.pop(rid, None)
+        self._ticks.pop(rid, None)
+
+    def counters(self) -> dict:
+        with self._lock:
+            return {"evictions": self.evictions,
+                    "admission_denied": self.admission_denied,
+                    "rows": len(self._rows)}
+
+    def digest(self) -> str:
+        """Order-independent CRC over the full mutable state — two
+        tables with equal digests are bit-identical (rows, optimizer
+        state, step counters, admission counts, eviction clock)."""
+        with self._lock:
+            h = zlib.crc32(struct.pack("<q", self._tick))
+            for rid in sorted(self._rows):
+                b = struct.pack("<q", rid) + self._rows[rid].tobytes()
+                for s in self._state.get(rid, []):
+                    b += s.tobytes()
+                b += struct.pack("<qq", self._step.get(rid, 0),
+                                 self._ticks.get(rid, 0))
+                h = zlib.crc32(b, h)
+            for rid in sorted(self._counts):
+                h = zlib.crc32(struct.pack(
+                    "<qq", rid, self._counts[rid]), h)
+            return f"{h:08x}"
+
+    def state_dict(self) -> dict:
+        with self._lock:
+            return {"dim": self.dim, "optimizer": self.optimizer,
+                    "rows": {k: v.copy() for k, v in self._rows.items()},
+                    "state": {k: [s.copy() for s in v]
+                              for k, v in self._state.items()},
+                    "step": dict(self._step),
+                    "counts": dict(self._counts),
+                    "ticks": dict(self._ticks),
+                    "tick": self._tick}
+
+    def load_state_dict(self, sd: dict) -> None:
+        with self._lock:
+            self._rows = {int(k): np.asarray(v, np.float32)
+                          for k, v in sd["rows"].items()}
+            self._state = {int(k): [np.asarray(s, np.float32) for s in v]
+                           for k, v in sd.get("state", {}).items()}
+            self._step = {int(k): int(v)
+                          for k, v in sd.get("step", {}).items()}
+            self._counts = {int(k): int(v)
+                            for k, v in sd.get("counts", {}).items()}
+            self._ticks = {int(k): int(v)
+                           for k, v in sd.get("ticks", {}).items()}
+            self._tick = int(sd.get("tick", 0))
+
+    def __len__(self):
+        with self._lock:
+            return len(self._rows)
+
+
+class DenseTable:
+    """Dense parameter vector with server-side SGD (reference:
+    memory_dense_table.cc). Init is a pure function of ``seed`` so a
+    replica constructed with the same ctor args starts bit-identical."""
+
+    def __init__(self, shape, lr: float = 0.01, seed: int = 0):
+        self.lr = float(lr)
+        self._value = np.random.default_rng(seed).uniform(  # guarded by: _lock
+            -0.01, 0.01, shape).astype(np.float32)
+        self._lock = threading.Lock()
+
+    def pull(self) -> np.ndarray:
+        with self._lock:
+            return self._value.copy()
+
+    def push(self, grad) -> None:
+        with self._lock:
+            self._value -= self.lr * np.asarray(grad, np.float32)
+
+    def set(self, value) -> None:
+        with self._lock:
+            self._value = np.asarray(value, np.float32).copy()
+
+    def digest(self) -> str:
+        with self._lock:
+            return f"{zlib.crc32(self._value.tobytes()):08x}"
+
+    def state_dict(self) -> dict:
+        with self._lock:
+            return {"value": self._value.copy(), "lr": self.lr}
+
+    def load_state_dict(self, sd: dict) -> None:
+        with self._lock:
+            self._value = np.asarray(sd["value"], np.float32).copy()
+
+    def __len__(self):
+        with self._lock:
+            return int(self._value.size)

@@ -18,7 +18,15 @@ A *plan* is a ``;``-separated list of rules::
   (the coordinator's commit write; ``delay=<s>`` holds the epoch ack
   window open), ``elastic.reshard`` (a peer-snapshot fetch during
   shrink/expand adoption; ``truncate`` / ``bitflip`` corrupt the
-  fetched CRC-tagged blob, forcing the disk-manifest fallback tier).
+  fetched CRC-tagged blob, forcing the disk-manifest fallback tier),
+  ``ps.pull`` / ``ps.push`` (one PSWorker shard-op attempt: ``drop``
+  fails the attempt before the send; ``raise`` fires AFTER the server
+  applied — a lost ack, so the retried send with the same sequence
+  number must hit the server-side push dedup, not re-apply;
+  ``bitflip`` corrupts the first float32 payload array),
+  ``ps.server`` (PS handler entry: ``kill`` is the failover drill's
+  primary death, ``delay`` stalls the reply past the worker's rpc
+  timeout, ``raise``/``drop`` fail the request after delivery).
 - ``kind`` — what to inject: ``drop`` (close + fail the store socket),
   ``loss`` (silently discard an rpc message), ``delay=<s>`` (sleep,
   e.g. past the watchdog timeout), ``truncate`` / ``bitflip``

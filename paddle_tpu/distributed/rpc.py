@@ -416,7 +416,16 @@ def get_all_worker_infos():
     return list(_require_agent().workers.values())
 
 
-def shutdown(graceful: bool = True, timeout: float = 120.0):
+def shutdown(graceful: bool = True, timeout: float = 120.0,
+             dead_ranks=None):
+    """``dead_ranks`` (iterable of rpc ranks, or a zero-arg callable
+    returning one) names peers the caller observed die: the graceful
+    barrier stops waiting for their arrival flags. Re-read on every
+    poll, so a death detected mid-barrier still releases everyone.
+    Flags are per-rank (not a count) because long-lived serving ranks
+    — e.g. a parameter server whose ``run()`` IS this barrier — arrive
+    at startup: a count can't tell a dead peer's early arrival from
+    the live peer everyone is actually waiting on."""
     global _agent
     if _agent is not None:
         if graceful:
@@ -427,16 +436,29 @@ def shutdown(graceful: bool = True, timeout: float = 120.0):
             # FleetExecutor pipeline draining) would deadlock the job.
             # Bounded: a crashed peer must fail the barrier loudly, not
             # hang every surviving rank forever.
-            key = f"{_agent._ns}_shutdown/count"
+            ns = f"{_agent._ns}_shutdown"
             world = _agent.world_size
-            _agent.store.add(key, 1)
+
+            def _dead() -> set:
+                if dead_ranks is None:
+                    return set()
+                d = dead_ranks() if callable(dead_ranks) else dead_ranks
+                return set() if d is None else set(d)
+
+            _agent.store.set(f"{ns}/rank/{_agent.rank}", b"1")
             deadline = time.monotonic() + timeout
-            while _agent.store.add(key, 0) < world:
+            while True:
+                dead = _dead()
+                waiting = [r for r in range(world)
+                           if r not in dead and not _agent.store.check(
+                               f"{ns}/rank/{r}")]
+                if not waiting:
+                    break
                 if time.monotonic() > deadline:
                     _agent.stop()
                     _agent = None
                     raise TimeoutError(
-                        f"rpc.shutdown barrier: not all {world} ranks "
+                        f"rpc.shutdown barrier: ranks {waiting} never "
                         f"arrived within {timeout}s (a peer likely "
                         "crashed)")
                 time.sleep(0.02)

@@ -1,0 +1,172 @@
+"""DLRM-style recommender workload over the sparse PS tier.
+
+The production shape the reference framework's PS layer exists for
+(PAPER.md: the_one_ps.py + memory_sparse_table.cc): zipfian-skewed
+sparse feature ids per slot -> embedding rows pulled from the sparse
+table -> a dense MLP over the concatenated slot embeddings (the dense
+"tower" runs through ONE fixed-shape jit, compiled once) -> per-row
+embedding grads pushed back to the sparse table, MLP grads to a dense
+table with server-side SGD.
+
+Everything is deterministic given ``RecommenderConfig.seed``: ids,
+targets, table init (per-id, see ps/tables.py) and the jitted tower —
+so a run against the sharded fault-tolerant PS tier must be BIT-EXACT
+vs :func:`run_reference` over local tables. The failover drill
+(tools/ps_drill.py) leans on exactly that.
+
+The client protocol is duck-typed: anything with
+``pull_sparse/push_sparse/pull_dense/push_dense`` works — ``PSWorker``
+(rpc or LocalTransport) and :class:`LocalClient` both qualify.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["RecommenderConfig", "Recommender", "LocalClient",
+           "run_reference"]
+
+
+class RecommenderConfig:
+    def __init__(self, seed: int = 123, batch: int = 16, slots: int = 4,
+                 vocab: int = 1000, dim: int = 8, hidden: int = 16,
+                 zipf_a: float = 1.3, optimizer: str = "adagrad",
+                 lr: float = 0.1):
+        self.seed = int(seed)
+        self.batch = int(batch)
+        self.slots = int(slots)
+        self.vocab = int(vocab)
+        self.dim = int(dim)
+        self.hidden = int(hidden)
+        self.zipf_a = float(zipf_a)
+        self.optimizer = optimizer
+        self.lr = float(lr)
+        self.sparse_table_id = 0
+        self.dense_table_id = 1
+
+    @property
+    def dense_size(self) -> int:
+        # W1 [slots*dim, hidden] + b1 [hidden] + w2 [hidden] + b2 [1]
+        return (self.slots * self.dim * self.hidden + self.hidden
+                + self.hidden + 1)
+
+
+# one compiled tower per shape tuple; fixed shapes -> compiled once
+_GRAD_FNS: Dict[Tuple[int, int, int, int], object] = {}
+
+
+def _grad_fn(batch: int, slots: int, dim: int, hidden: int):
+    key = (batch, slots, dim, hidden)
+    fn = _GRAD_FNS.get(key)
+    if fn is not None:
+        return fn
+    import jax
+    import jax.numpy as jnp
+
+    n_w1 = slots * dim * hidden
+
+    def loss_fn(dense, rows, targets):
+        w1 = dense[:n_w1].reshape(slots * dim, hidden)
+        b1 = dense[n_w1:n_w1 + hidden]
+        w2 = dense[n_w1 + hidden:n_w1 + 2 * hidden]
+        b2 = dense[n_w1 + 2 * hidden]
+        x = rows.reshape(batch, slots * dim)
+        h = jnp.tanh(x @ w1 + b1)
+        pred = h @ w2 + b2
+        return jnp.mean((pred - targets) ** 2)
+
+    fn = jax.jit(jax.value_and_grad(loss_fn, argnums=(0, 1)))
+    _GRAD_FNS[key] = fn
+    return fn
+
+
+class Recommender:
+    """Deterministic synthetic CTR-ish regression: the target for a
+    sample is the mean of a frozen per-id teacher value over its slots,
+    so the embeddings + tower genuinely co-train (loss decreases)."""
+
+    def __init__(self, cfg: Optional[RecommenderConfig] = None):
+        self.cfg = cfg or RecommenderConfig()
+
+    def ids(self, step: int) -> np.ndarray:
+        """[batch, slots] int64; zipfian within each slot's disjoint
+        vocab range (slot s owns [s*vocab, (s+1)*vocab))."""
+        c = self.cfg
+        rng = np.random.default_rng([c.seed, 555, int(step)])
+        z = rng.zipf(c.zipf_a, size=(c.batch, c.slots)) % c.vocab
+        return (z + np.arange(c.slots, dtype=np.int64) * c.vocab
+                ).astype(np.int64)
+
+    def _teacher(self, rid: int) -> float:
+        return float(np.random.default_rng(
+            [self.cfg.seed, 777, int(rid)]).standard_normal())
+
+    def targets(self, ids: np.ndarray) -> np.ndarray:
+        t = np.array([[self._teacher(r) for r in row] for row in ids],
+                     np.float32)
+        return t.mean(axis=1)
+
+    def step(self, client, step_idx: int) -> float:
+        """One training step through ``client``; returns the loss."""
+        c = self.cfg
+        ids = self.ids(step_idx)
+        flat = ids.ravel()
+        rows = client.pull_sparse(c.sparse_table_id, flat, dim=c.dim)
+        dense = client.pull_dense(c.dense_table_id)
+        targets = self.targets(ids)
+        loss, (g_dense, g_rows) = _grad_fn(
+            c.batch, c.slots, c.dim, c.hidden)(
+                np.asarray(dense, np.float32),
+                np.asarray(rows, np.float32).reshape(len(flat), c.dim),
+                targets)
+        client.push_sparse(c.sparse_table_id, flat,
+                           np.asarray(g_rows, np.float32))
+        client.push_dense(c.dense_table_id,
+                          np.asarray(g_dense, np.float32))
+        return float(np.asarray(loss, np.float32))
+
+
+class LocalClient:
+    """Reference client over in-process tables, constructed with the
+    SAME seeds/hyperparams TheOnePSRuntime gives the sharded tier —
+    per-id deterministic row init makes the two bit-identical."""
+
+    def __init__(self, cfg: RecommenderConfig, entry_attr=None,
+                 capacity=None):
+        from ..distributed.ps.tables import DenseTable, SparseTable
+
+        self.cfg = cfg
+        self.sparse = SparseTable(
+            cfg.dim, optimizer=cfg.optimizer, lr=cfg.lr,
+            seed=1000 + cfg.sparse_table_id, entry_attr=entry_attr,
+            capacity=capacity)
+        self.dense = DenseTable((cfg.dense_size,), lr=cfg.lr)
+
+    def pull_sparse(self, table_id: int, ids, dim=None) -> np.ndarray:
+        assert table_id == self.cfg.sparse_table_id
+        return self.sparse.pull(np.asarray(ids, np.int64).ravel())
+
+    def push_sparse(self, table_id: int, ids, grads) -> None:
+        assert table_id == self.cfg.sparse_table_id
+        self.sparse.push(np.asarray(ids, np.int64).ravel(),
+                         np.asarray(grads, np.float32))
+
+    def pull_dense(self, table_id: int) -> np.ndarray:
+        assert table_id == self.cfg.dense_table_id
+        return self.dense.pull()
+
+    def push_dense(self, table_id: int, grad) -> None:
+        assert table_id == self.cfg.dense_table_id
+        self.dense.push(np.asarray(grad, np.float32))
+
+
+def run_reference(cfg: RecommenderConfig,
+                  steps: int) -> Tuple[List[float], LocalClient]:
+    """Fault-free single-table reference run: the loss sequence every
+    PS-tier run (sharded, replicated, failed-over) must reproduce
+    bit-exactly."""
+    client = LocalClient(cfg)
+    rec = Recommender(cfg)
+    losses = [rec.step(client, i) for i in range(steps)]
+    return losses, client

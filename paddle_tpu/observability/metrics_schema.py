@@ -340,6 +340,49 @@ METRICS = {
     "bench.elastic_window": MetricSpec(
         "histogram", "s", "elastic bench timed window (kill->recovery "
         "arm and snapshot-overhead arms)", TIME_BUCKETS),
+    "bench.ps_window": MetricSpec(
+        "histogram", "s", "parameter-server bench timed window "
+        "(recommender pull/push arms and the failover drill arm)",
+        TIME_BUCKETS),
+    # ---- parameter-server tier (distributed/ps/)
+    "ps.pulls": MetricSpec(
+        "counter", "rows", "sparse/dense rows served by PS pull "
+        "handlers (primary side)"),
+    "ps.pushes": MetricSpec(
+        "counter", "rows", "gradient rows applied by PS push handlers "
+        "(post-dedup; admission-denied rows included)"),
+    "ps.push_dedup_hits": MetricSpec(
+        "counter", "pushes", "push batches acked WITHOUT re-applying: "
+        "the (worker, shard, table) sequence number was at or below "
+        "the server's high-water mark (rpc retransmit, lost ack, or "
+        "failover replay)"),
+    "ps.evictions": MetricSpec(
+        "counter", "rows", "sparse rows evicted by the capacity-"
+        "bounded LRU-by-push policy (tables.py)"),
+    "ps.admission_denied": MetricSpec(
+        "counter", "rows", "sparse push rows dropped by the EntryAttr "
+        "admission filter before the row materialized"),
+    "ps.repl_records": MetricSpec(
+        "counter", "records", "replication-log records applied by a "
+        "backup's applier thread (or drained during promotion)"),
+    "ps.repl_degraded": MetricSpec(
+        "counter", "shards", "shards that dropped to unreplicated "
+        "service because the backup's lease went stale"),
+    "ps.promotions": MetricSpec(
+        "counter", "promotions", "backup shards promoted to primary "
+        "after the primary's lease expired"),
+    "ps.failovers": MetricSpec(
+        "counter", "failovers", "worker-observed shard-map moves "
+        "(typed PSFailover adopted: re-resolve + window replay)"),
+    "ps.replays": MetricSpec(
+        "counter", "pushes", "in-flight window records a worker "
+        "re-sent against a newly promoted primary"),
+    "ps.pull_time": MetricSpec(
+        "histogram", "s", "whole worker-side pull_sparse latency "
+        "(all shards, retries and failover included)", TIME_BUCKETS),
+    "ps.push_time": MetricSpec(
+        "histogram", "s", "whole worker-side push_sparse latency "
+        "(all shards, retries and failover included)", TIME_BUCKETS),
 }
 
 
@@ -392,6 +435,14 @@ SPANS = {
     "tp.overlap_window": "one chunked computation-collective overlap "
                          "region (eager TP/SP linear fwd/bwd; op + chunk "
                          "count in args)",
+    "ps.pull": "one worker-side sharded pull_sparse (table + rows in "
+               "args; spans retries and failover)",
+    "ps.push": "one worker-side sharded push_sparse (table + rows in "
+               "args; spans retries and failover)",
+    "ps.promote": "backup->primary promotion: replication-log drain + "
+                  "shard-map takeover (shard in args)",
+    "ps.replay": "in-flight window replay against a new primary "
+                 "(shard + record count in args)",
 }
 
 

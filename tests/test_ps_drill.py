@@ -1,0 +1,34 @@
+"""Tier-1 wiring for tools/ps_drill.py: the seeded PS failover drill.
+The fast arms run one full 3-process kill drill (primary killed
+mid-epoch, backup promoted inside the lease budget, post-failover
+recommender losses bit-exact vs the fault-free reference) and the
+in-process lost-ack dedup drill; the slow arm replays the whole kill
+drill twice and requires bit-identical trajectories."""
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir,
+                                "tools"))
+import ps_drill  # noqa: E402
+
+
+def test_ps_drill_kill_promote_bit_exact():
+    summary = ps_drill.main()
+    assert summary["server1_stats"]["promotions"] == 1
+    assert summary["failovers"]
+    fo = summary["failovers"][0]
+    assert fo["shard"] == 0 and fo["new"] == 1
+    assert fo["latency_s"] < ps_drill.FAILOVER_S
+    assert len(summary["losses"]) == ps_drill.TOTAL
+
+
+def test_ps_drill_dedup_lost_ack():
+    res = ps_drill.dedup_drill()
+    assert res["dedup_hits"] >= 1
+
+
+@pytest.mark.slow
+def test_ps_drill_deterministic_across_runs():
+    assert ps_drill.main_determinism() == 0

@@ -1,0 +1,299 @@
+"""PS tier fault tolerance: checkpoint durability, registry scoping,
+sharded-vs-local equivalence, push dedup, admission/eviction, retry
+deadlines, and in-process replication + failover."""
+import os
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.ps import (LocalTransport, PSCheckpointError,
+                                       PSConfig, PSFailover, PSServer,
+                                       PSWorker, ShardCheckpointManager,
+                                       SparseTable)
+from paddle_tpu.distributed.ps import checkpoint as ps_ckpt
+from paddle_tpu.distributed.ps.data_plane import (_SERVERS, _ps_load,
+                                                  _ps_push_sparse,
+                                                  _ps_save,
+                                                  _ps_table_size)
+from paddle_tpu.distributed.resilience import faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_ps_process_state():
+    faults.reset()
+    _SERVERS.clear()
+    yield
+    faults.reset()
+    for s in list(_SERVERS.values()):
+        s._stop_evt.set()
+    _SERVERS.clear()
+
+
+def _worker(n_servers, store=None, cfg=None):
+    return PSWorker(1, n_servers, worker_id="t0",
+                    transport=LocalTransport(store=store), config=cfg)
+
+
+# ------------------------------------------------------- satellite: save
+def test_ps_save_suffixless_path_and_atomicity(tmp_path):
+    """Regression: _ps_save("t") historically wrote "t.npy" and
+    _ps_load("t") then failed; now the suffix is normalized, the write
+    is atomic, and the dedup high-water mark rides along."""
+    srv = PSServer(0, n_servers=1)
+    srv.add_sparse_table(3, 4, optimizer="sgd", lr=0.1)
+    w = _worker(1)
+    w.push_sparse(3, [1, 2, 5], np.ones((3, 4), np.float32))
+    real = _ps_save(0, 0, 3, str(tmp_path / "t3_shard0"))  # no suffix
+    assert real.endswith(".npy") and os.path.exists(real)
+    assert not list(tmp_path.glob("*.tmp"))
+
+    srv.shutdown_local()
+    srv2 = PSServer(0, n_servers=1)
+    srv2.add_sparse_table(3, 4, optimizer="sgd", lr=0.1)
+    _ps_load(0, 0, 3, str(tmp_path / "t3_shard0"))
+    before = srv2._table(0, 3).digest()
+    # the restored HWM must dedup a replay of the already-applied push
+    w2 = _worker(1)
+    w2.push_sparse(3, [1, 2, 5], np.ones((3, 4), np.float32))
+    assert srv2.stats()["push_dedup_hits"] == 1
+    assert srv2._table(0, 3).digest() == before
+
+
+def test_ps_checkpoint_crc_detects_corruption(tmp_path):
+    sd = SparseTable(4, optimizer="sgd", seed=7).state_dict()
+    path = ps_ckpt.write_table(str(tmp_path / "t0_shard0"), sd)
+    with open(path, "r+b") as f:
+        f.seek(max(0, os.path.getsize(path) - 3))
+        f.write(b"\xff")
+    with pytest.raises(PSCheckpointError):
+        ps_ckpt.read_table(path)
+
+
+def test_shard_checkpoint_manager_skips_corrupt(tmp_path):
+    t = SparseTable(4, optimizer="sgd", lr=0.1, seed=7)
+    t.push([1, 2], np.ones((2, 4), np.float32))
+    mgr = ShardCheckpointManager(str(tmp_path), keep_last=5)
+    mgr.save(1, {(0, 0): t.state_dict()})
+    t.push([3], np.ones((1, 4), np.float32))
+    d2 = mgr.save(2, {(0, 0): t.state_dict()})
+    # corrupt the newest payload: latest_valid must fall back to step 1
+    victim = os.path.join(d2, "table0_shard0.npy")
+    with open(victim, "r+b") as f:
+        f.seek(max(0, os.path.getsize(victim) - 3))
+        f.write(b"\x00")
+    step, d = mgr.latest_valid()
+    assert step == 1
+    restored = SparseTable(4, optimizer="sgd", lr=0.1, seed=7)
+    restored.load_state_dict(mgr.load(d)[(0, 0)])
+    assert len(restored) == 2
+
+
+# -------------------------------------------------- satellite: registry
+def test_two_servers_in_one_process_do_not_clobber():
+    """Regression: the old module-global _TABLES meant a second
+    PSServer in the same process silently shared (and clobbered) the
+    first one's tables."""
+    a = PSServer(0, n_servers=2)
+    b = PSServer(1, n_servers=2)
+    for srv in (a, b):
+        srv.add_sparse_table(0, 4, optimizer="sgd", lr=1.0,
+                             initializer="zeros")
+    g = np.ones((1, 4), np.float32)
+    _ps_push_sparse(0, 0, 0, [0], g, "w", 1)
+    _ps_push_sparse(1, 1, 0, [1], 2 * g, "w", 1)
+    assert _ps_table_size(0, 0, 0) == 1
+    assert _ps_table_size(1, 1, 0) == 1
+    np.testing.assert_array_equal(a._table(0, 0).pull([0])[0],
+                                  -np.ones(4, np.float32))
+    np.testing.assert_array_equal(b._table(1, 0).pull([1])[0],
+                                  -2 * np.ones(4, np.float32))
+    # each hosts only its own (unreplicated) shard
+    with pytest.raises(KeyError):
+        a._table(1, 0)
+
+
+# ------------------------------------- satellite: sharded == local
+@pytest.mark.parametrize("opt", ["sgd", "adagrad", "adam"])
+def test_sharded_matches_local_bit_exact(opt):
+    """Randomized property: PSWorker over 3 in-process servers is
+    bit-identical to one local SparseTable (duplicate ids, empty
+    pulls, every optimizer) — the per-id deterministic init contract."""
+    n = 3
+    for i in range(n):
+        PSServer(i, n_servers=n).add_sparse_table(
+            0, 6, optimizer=opt, lr=0.05)
+    w = _worker(n)
+    local = SparseTable(6, optimizer=opt, lr=0.05, seed=1000)
+    rng = np.random.default_rng(7)
+    for _ in range(25):
+        k = int(rng.integers(0, 12))  # k == 0 -> empty pull
+        ids = rng.integers(0, 150, size=k)
+        np.testing.assert_array_equal(w.pull_sparse(0, ids, dim=6),
+                                      local.pull(ids))
+        if k:
+            grads = rng.standard_normal((k, 6)).astype(np.float32)
+            w.push_sparse(0, ids, grads)
+            local.push(ids, grads)
+    assert w.table_size(0) == len(local)
+    probe = np.arange(150, dtype=np.int64)
+    np.testing.assert_array_equal(w.pull_sparse(0, probe, dim=6),
+                                  local.pull(probe))
+
+
+# --------------------------------------------------- dedup under faults
+def test_push_dedup_under_lost_ack_fault():
+    """ps.push:raise fires AFTER the server applied (a lost ack): the
+    worker's retried send carries the same seq and must hit the dedup
+    table, leaving state bit-equal to single delivery."""
+    srv = PSServer(0, n_servers=1)
+    srv.add_sparse_table(0, 4, optimizer="adagrad", lr=0.1)
+    w = _worker(1)
+    faults.configure("ps.push:raise@2")
+    for i in range(4):
+        w.push_sparse(0, [1, 2, 9], np.full((3, 4), 0.5, np.float32))
+    faults.reset()
+    st = srv.stats()
+    assert st["push_dedup_hits"] == 1
+    srv.shutdown_local()
+
+    ref_srv = PSServer(0, n_servers=1)
+    ref_srv.add_sparse_table(0, 4, optimizer="adagrad", lr=0.1)
+    w2 = _worker(1)
+    for i in range(4):
+        w2.push_sparse(0, [1, 2, 9], np.full((3, 4), 0.5, np.float32))
+    assert ref_srv.stats()["push_dedup_hits"] == 0
+    assert srv._table(0, 0).digest() == ref_srv._table(0, 0).digest()
+
+
+# ------------------------------------------------- admission / eviction
+def test_count_filter_admission():
+    from paddle_tpu.distributed.extras import CountFilterEntry
+
+    t = SparseTable(4, optimizer="sgd", lr=1.0, initializer="zeros",
+                    entry_attr=CountFilterEntry(2))
+    g = np.ones((1, 4), np.float32)
+    t.push([7], g)  # 1st sighting: denied, not materialized
+    assert len(t) == 0 and t.counters()["admission_denied"] == 1
+    # gated pulls serve the init value without materializing
+    np.testing.assert_array_equal(t.pull([7]), np.zeros((1, 4)))
+    assert len(t) == 0
+    t.push([7], g)  # 2nd sighting: admitted, this grad applies
+    assert len(t) == 1
+    np.testing.assert_array_equal(t.pull([7])[0],
+                                  -np.ones(4, np.float32))
+
+
+def test_probability_admission_deterministic():
+    from paddle_tpu.distributed.extras import ProbabilityEntry
+
+    g = np.ones((1, 4), np.float32)
+    t_all = SparseTable(4, optimizer="sgd",
+                        entry_attr=ProbabilityEntry(1.0))
+    t_none = SparseTable(4, optimizer="sgd",
+                         entry_attr=ProbabilityEntry(1e-12))
+    for rid in range(20):
+        t_all.push([rid], g)
+        t_none.push([rid], g)
+    assert len(t_all) == 20
+    assert len(t_none) == 0
+    assert t_none.counters()["admission_denied"] == 20
+
+
+def test_capacity_eviction_lru_by_push():
+    t = SparseTable(4, optimizer="sgd", lr=1.0, initializer="zeros",
+                    capacity=2)
+    g = np.ones((1, 4), np.float32)
+    for rid in (1, 2, 3):  # 3rd push evicts the least-recently-pushed
+        t.push([rid], g)
+    assert len(t) == 2 and t.counters()["evictions"] == 1
+    assert set(t._rows) == {2, 3}
+    # re-pulling the evicted id recreates the deterministic init
+    np.testing.assert_array_equal(t.pull([1])[0], np.zeros(4))
+    # pull-created (never-pushed) rows are cleaned once over budget
+    t2 = SparseTable(4, optimizer="sgd", capacity=2)
+    t2.pull([10, 11, 12])
+    assert len(t2) == 3  # pulls alone never evict
+    t2.push([13], g)
+    assert len(t2) == 2 and 13 in t2._rows
+
+
+def test_per_id_init_is_creation_order_independent():
+    a = SparseTable(4, seed=42)
+    b = SparseTable(4, seed=42)
+    a.pull([5])
+    a.pull([3])
+    b.pull([3])
+    b.pull([5])
+    np.testing.assert_array_equal(a.pull([3, 5]), b.pull([3, 5]))
+
+
+# ----------------------------------------------- retry/timeout contract
+class _DeadTransport:
+    store = None
+
+    def call(self, *a, **k):
+        raise ConnectionError("peer down")
+
+
+def test_ps_timeout_env_bounds_ops(monkeypatch):
+    """Satellite: the hardcoded 60 s wait is gone — a dead server fails
+    the op within PADDLE_TPU_PS_TIMEOUT with the typed PSFailover."""
+    monkeypatch.setenv("PADDLE_TPU_PS_TIMEOUT", "0.4")
+    w = PSWorker(1, 1, worker_id="t0", transport=_DeadTransport())
+    assert w.cfg.timeout == 0.4
+    t0 = time.monotonic()
+    with pytest.raises(PSFailover) as ei:
+        w.push_sparse(0, [1], np.ones((1, 4), np.float32))
+    assert time.monotonic() - t0 < 5.0
+    assert ei.value.shard == 0
+
+
+# ------------------------------------- replication + in-process failover
+def test_replicated_failover_promotes_and_preserves_state():
+    """Full failover path in one process: primary applies + chain-acks
+    to the backup, primary dies, the backup's lease watch promotes it,
+    the worker adopts the typed PSFailover, replays, and every acked
+    push survives bit-exactly."""
+    from paddle_tpu.distributed.store import TCPStore
+
+    store = TCPStore("127.0.0.1", 0, is_master=True)
+    cfg = PSConfig(timeout=20.0, rpc_timeout=0.3, beat_interval=0.05,
+                   failover_timeout=1.2)
+    servers = []
+    for i in range(2):
+        s = PSServer(i, n_servers=2, config=cfg, replicated=True)
+        s.add_sparse_table(0, 4, optimizer="adagrad", lr=0.1)
+        servers.append(s)
+    for s in servers:
+        s.start(store)
+    w = _worker(2, store=store, cfg=cfg)
+    local = SparseTable(4, optimizer="adagrad", lr=0.1, seed=1000)
+
+    ids = np.arange(8, dtype=np.int64)  # both shards
+    for i in range(3):
+        g = np.full((8, 4), 0.1 * (i + 1), np.float32)
+        w.push_sparse(0, ids, g)
+        local.push(ids, g)
+
+    servers[0].shutdown_local()  # primary of shard 0 dies
+    g = np.full((8, 4), 0.7, np.float32)
+    w.push_sparse(0, ids, g)  # retries through the promotion window
+    local.push(ids, g)
+
+    assert len(w.failovers) >= 1
+    fo = w.failovers[0]
+    assert fo["shard"] == 0 and fo["new"] == 1
+    assert fo["latency_s"] < cfg.failover_timeout
+    st = servers[1].stats()
+    assert st["promotions"] == 1
+    assert st["primary_shards"] == [0, 1]
+    np.testing.assert_array_equal(w.pull_sparse(0, ids, dim=4),
+                                  local.pull(ids))
+    servers[1].shutdown_local()
+
+
+def test_psfailover_is_typed():
+    e = PSFailover(3, old_primary=1, new_primary=2, reason="x")
+    assert isinstance(e, RuntimeError)
+    assert (e.shard, e.old_primary, e.new_primary) == (3, 1, 2)

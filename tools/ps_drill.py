@@ -1,0 +1,321 @@
+#!/usr/bin/env python
+"""Parameter-server failover drill: seeded primary kill mid-epoch ->
+backup promotion inside the lease budget -> bit-exact recovery.
+
+Arm A (:func:`main`, 3 processes): a DLRM-style recommender trainer
+(models/recommender.py) trains against 2 replicated pservers through
+TheOnePSRuntime. A fault plan (``ps.server:kill=31@K``) hard-kills
+pserver0 — the primary for sparse shard 0 — at the K-th handler call,
+mid-epoch. pserver1 (shard 0's chain-replication backup) must detect
+the stale lease, drain the replication log and promote itself; the
+trainer must adopt the typed PSFailover, replay its unacked push
+window and keep training. The post-failover loss sequence must be
+BIT-EXACT vs a fault-free single-table reference computed in the same
+process — replication + per-id deterministic init + push dedup leave
+no numeric trace of the failure. The drill also saves persistables
+afterwards, proving the promoted primary serves the checkpoint path.
+
+Arm B (:func:`dedup_drill`, in-process): a ``ps.push:raise`` fault
+fires AFTER the server applied a push (a lost ack); the worker's
+retried send carries the same sequence number and must land in the
+server's dedup table (``ps.push_dedup_hits > 0``) with the final table
+digest bit-equal to a single-delivery run.
+
+Importable (tests/test_ps_drill.py runs Arm A+B in tier-1; bench.py
+--ps reuses both) and runnable standalone:
+
+    JAX_PLATFORMS=cpu python tools/ps_drill.py
+    JAX_PLATFORMS=cpu python tools/ps_drill.py --determinism
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+KILL_CODE = 31
+TOTAL = 18           # recommender steps
+KILL_STEP = 13       # pserver0 dies serving this step's shard-0 pull
+# pserver0 sees exactly 2 handler calls per step (shard-0 sparse pull +
+# push; the dense table lives on shard 1), so the K-th call is the
+# KILL_STEP-th step's pull:
+KILL_AT_CALL = 2 * (KILL_STEP - 1) + 1
+BEAT_S = 0.15
+FAILOVER_S = 5.0     # lease budget: promotion must land inside this
+
+
+# ----------------------------------------------------------- children
+def _child_main() -> int:
+    """One drill role, selected by the standard PS env contract
+    (TRAINING_ROLE / PADDLE_PSERVER_ID / PADDLE_TRAINER_ID)."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from paddle_tpu.distributed.ps import (PaddleCloudRoleMaker, Table,
+                                           TheOnePSRuntime)
+    from paddle_tpu.models.recommender import (Recommender,
+                                               RecommenderConfig,
+                                               run_reference)
+
+    t_boot = time.monotonic()
+    out = os.environ["PS_DRILL_OUT"]
+    total = int(os.environ.get("PS_DRILL_TOTAL", str(TOTAL)))
+    cfg = RecommenderConfig(
+        seed=int(os.environ.get("PS_DRILL_SEED", "123")))
+    rt = TheOnePSRuntime(PaddleCloudRoleMaker())
+    rt.add_table(Table(table_id=cfg.sparse_table_id, kind="sparse",
+                       dim=cfg.dim, optimizer=cfg.optimizer, lr=cfg.lr))
+    rt.add_table(Table(table_id=cfg.dense_table_id, kind="dense",
+                       shape=(cfg.dense_size,), lr=cfg.lr))
+
+    if os.environ.get("TRAINING_ROLE", "").upper() == "PSERVER":
+        rt.init_server()
+        print(f"pserver up shards={sorted(rt.server.hosted_shards())} "
+              f"replicated={rt.server.replicated}", flush=True)
+        rt.run_server()     # serves until the trainer stops (or killed)
+        print(f"pserver done stats={rt.server.stats()}", flush=True)
+        return 0
+
+    worker = rt.init_worker()
+    rec = Recommender(cfg)
+    losses, step_ends = [], []
+    t0 = time.monotonic()
+    for i in range(total):
+        losses.append(rec.step(worker, i))
+        step_ends.append(time.monotonic() - t0)
+        print(f"step {i} t={step_ends[-1]:.2f} "
+              f"failovers={worker.failovers}", flush=True)
+    # fault-free single-table reference in the SAME process (same jit
+    # cache, same backend) — the sharded+failed-over run must match it
+    # bit-for-bit
+    ref_losses, _ = run_reference(cfg, total)
+    stats1 = worker.server_stats(1)
+    rt.save_persistables(os.path.join(out, "ckpt"))
+    with open(os.path.join(out, "trainer.json"), "w") as f:
+        json.dump({
+            "losses": losses,
+            "ref_losses": ref_losses,
+            "bit_exact": losses == ref_losses,
+            "failovers": worker.failovers,
+            "server1_stats": stats1,
+            "step_ends": step_ends,
+            "boot_to_first_step_s": (t0 - t_boot) + step_ends[0],
+        }, f)
+    rt.stop_worker()
+    return 0
+
+
+def _spawn(role: str, idx: int, master: str, out: str, *,
+           fault_plan=None, total=TOTAL, seed=123):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + (
+        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+    env.update({
+        "PYTHONUNBUFFERED": "1",
+        "JAX_PLATFORMS": "cpu",
+        "PADDLE_TPU_PURE_PY_STORE": "1",
+        "PADDLE_MASTER": master,
+        "PADDLE_STORE_HOSTED": "1",
+        "PADDLE_TRAINERS_NUM": "1",
+        "PADDLE_PSERVERS_IP_PORT_LIST": "127.0.0.1:0,127.0.0.1:0",
+        "TRAINING_ROLE": role,
+        "PADDLE_TPU_PS_BEAT": str(BEAT_S),
+        "PADDLE_TPU_PS_FAILOVER_TIMEOUT": str(FAILOVER_S),
+        "PADDLE_TPU_PS_RPC_TIMEOUT": "0.8",
+        "PADDLE_TPU_PS_TIMEOUT": "45",
+        "PS_DRILL_OUT": out,
+        "PS_DRILL_TOTAL": str(total),
+        "PS_DRILL_SEED": str(seed),
+    })
+    if role == "PSERVER":
+        env["PADDLE_PSERVER_ID"] = str(idx)
+        tag = f"pserver{idx}"
+    else:
+        env["PADDLE_TRAINER_ID"] = str(idx)
+        tag = f"trainer{idx}"
+    env.pop("PADDLE_TPU_FAULT_PLAN", None)
+    if fault_plan:
+        env["PADDLE_TPU_FAULT_PLAN"] = fault_plan
+    log = open(os.path.join(out, f"{tag}.log"), "ab")
+    return subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--worker"],
+        env=env, stdout=log, stderr=subprocess.STDOUT)
+
+
+# ------------------------------------------------------------- parent
+def main(out_dir=None, total=TOTAL, seed=123,
+         deadline_s=240.0) -> dict:
+    """One full Arm-A drill; returns the summary dict (reused by the
+    bench), raises AssertionError on any acceptance failure."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ["PADDLE_TPU_PURE_PY_STORE"] = "1"
+    import tempfile
+
+    from paddle_tpu.distributed.store import TCPStore
+
+    out = out_dir or tempfile.mkdtemp(prefix="ps_drill_")
+    os.makedirs(out, exist_ok=True)
+    daemon_store = TCPStore("127.0.0.1", 0, is_master=True)
+    master = f"127.0.0.1:{daemon_store._port}"
+
+    procs = {
+        "trainer": _spawn("TRAINER", 0, master, out, total=total,
+                          seed=seed),
+        "pserver0": _spawn(
+            "PSERVER", 0, master, out, total=total, seed=seed,
+            fault_plan=f"ps.server:kill={KILL_CODE}@{KILL_AT_CALL}"),
+        "pserver1": _spawn("PSERVER", 1, master, out, total=total,
+                           seed=seed),
+    }
+    deadline = time.time() + deadline_s
+    try:
+        # the victim must die with the injected code, mid-epoch
+        while procs["pserver0"].poll() is None and \
+                time.time() < deadline:
+            time.sleep(0.05)
+        assert procs["pserver0"].poll() == KILL_CODE, (
+            f"pserver0 exit {procs['pserver0'].poll()!r}, wanted "
+            f"{KILL_CODE} (logs in {out})")
+        for key in ("trainer", "pserver1"):
+            p = procs[key]
+            while p.poll() is None and time.time() < deadline:
+                time.sleep(0.1)
+            if p.poll() is None:
+                raise AssertionError(
+                    f"{key} did not finish within {deadline_s}s "
+                    f"(logs in {out})")
+            assert p.poll() == 0, (
+                f"{key} exited {p.poll()} (logs in {out})")
+    finally:
+        print({k: p.poll() for k, p in procs.items()}, flush=True)
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+        daemon_store._daemon.stop()
+
+    with open(os.path.join(out, "trainer.json")) as f:
+        res = json.load(f)
+
+    # --- acceptance: promotion + typed failover inside the budget ----
+    st1 = res["server1_stats"]
+    assert st1["promotions"] == 1, st1
+    assert st1["primary_shards"] == [0, 1], st1
+    assert res["failovers"], "worker recorded no failover"
+    fo = res["failovers"][0]
+    assert fo["shard"] == 0 and fo["new"] == 1, fo
+    assert fo["latency_s"] < FAILOVER_S, (
+        f"failover took {fo['latency_s']:.2f}s, over the "
+        f"{FAILOVER_S}s budget")
+
+    # --- acceptance: losses bit-exact vs the fault-free reference ----
+    assert len(res["losses"]) == total
+    assert res["bit_exact"], (
+        "post-failover losses diverge from the fault-free reference:\n"
+        f"  got {res['losses']}\n  ref {res['ref_losses']}")
+
+    # --- acceptance: the promoted primary serves checkpoints ---------
+    for fname in ("table0_shard0.npy", "table0_shard1.npy",
+                  "table1_shard1.npy"):
+        assert os.path.exists(os.path.join(out, "ckpt", fname)), fname
+
+    # recovery wall time: the kill step's extra latency over an
+    # ordinary step (step 1 excluded: it contains the jit compile)
+    ends = res["step_ends"]
+    deltas = [b - a for a, b in zip(ends, ends[1:])]
+    ordinary = sorted(d for i, d in enumerate(deltas, start=2)
+                      if i != KILL_STEP)
+    step_baseline_s = ordinary[len(ordinary) // 2]
+    recovery_wall_s = deltas[KILL_STEP - 2] - step_baseline_s
+    summary = {
+        "out_dir": out,
+        "losses": res["losses"],
+        "failovers": res["failovers"],
+        "server1_stats": st1,
+        "recovery_wall_s": recovery_wall_s,
+        "step_baseline_s": step_baseline_s,
+        "cold_restart_s": res["boot_to_first_step_s"],
+        "total_steps": total,
+        "kill_step": KILL_STEP,
+    }
+    print(f"ps_drill: kill@step{KILL_STEP} promotion OK "
+          f"failover={fo['latency_s']:.2f}s (budget {FAILOVER_S}s) "
+          f"recovery={recovery_wall_s:.2f}s "
+          f"cold_restart={res['boot_to_first_step_s']:.2f}s "
+          f"loss parity bit-exact over {total} steps")
+    return summary
+
+
+# ------------------------------------------------- Arm B: dedup drill
+def dedup_drill(pushes: int = 6, fault_at: int = 3) -> dict:
+    """In-process lost-ack drill: run the same push sequence twice —
+    once with a ``ps.push:raise`` after delivery (the worker retries
+    with the same seq), once clean — and require a dedup hit plus
+    bit-equal table digests."""
+    import numpy as np
+
+    from paddle_tpu.distributed.ps import (LocalTransport, PSServer,
+                                           PSWorker)
+    from paddle_tpu.distributed.resilience import faults
+
+    def one_run(plan):
+        srv = PSServer(0, n_servers=1)
+        srv.add_sparse_table(0, 8, optimizer="adagrad", lr=0.1)
+        w = PSWorker(1, 1, worker_id="t0",
+                     transport=LocalTransport())
+        try:
+            faults.configure(plan)
+            for i in range(pushes):
+                rng = np.random.default_rng([9, i])
+                ids = rng.integers(0, 50, size=12)
+                w.push_sparse(0, ids,
+                              rng.standard_normal((12, 8)).astype(
+                                  np.float32))
+            return srv.stats(), srv._table(0, 0).digest()
+        finally:
+            faults.reset()
+            srv.shutdown_local()
+
+    faulted_stats, faulted_digest = one_run(
+        f"ps.push:raise@{fault_at}")
+    clean_stats, clean_digest = one_run(None)
+    assert faulted_stats["push_dedup_hits"] >= 1, faulted_stats
+    assert clean_stats["push_dedup_hits"] == 0, clean_stats
+    assert faulted_digest == clean_digest, (
+        "retransmitted push changed table state: "
+        f"{faulted_digest} != {clean_digest}")
+    return {"dedup_hits": faulted_stats["push_dedup_hits"],
+            "digest": faulted_digest,
+            "pushes": faulted_stats["pushes"]}
+
+
+def main_determinism() -> int:
+    """Slow arm: two full kill drills must produce identical losses
+    and failover shapes — the whole trajectory is a pure function of
+    the seed."""
+    a = main()
+    b = main()
+    assert a["losses"] == b["losses"], "drill runs diverge"
+    assert [f["shard"] for f in a["failovers"]] == \
+        [f["shard"] for f in b["failovers"]]
+    print(f"ps_drill determinism: two runs bit-identical "
+          f"({len(a['losses'])} steps)")
+    return 0
+
+
+if __name__ == "__main__":
+    if "--worker" in sys.argv:
+        sys.exit(_child_main())
+    if "--determinism" in sys.argv:
+        sys.exit(main_determinism())
+    if "--dedup" in sys.argv:
+        print(json.dumps(dedup_drill()))
+        sys.exit(0)
+    main()
+    sys.exit(0)

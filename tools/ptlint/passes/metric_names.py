@@ -33,7 +33,7 @@ _SKIP_DIRS = {".git", "__pycache__", "build", "dist", ".eggs",
               "node_modules"}
 
 # namespaces whose declared names must all be instrumented somewhere
-REQUIRE_USED = ("serving.", "cluster.", "elastic.")
+REQUIRE_USED = ("serving.", "cluster.", "elastic.", "ps.")
 
 _SCHEMA_RELPATH = "paddle_tpu/observability/metrics_schema.py"
 
