@@ -387,14 +387,34 @@ def _bench_serving_ragged(pt, cfg, model, on_tpu):
     return ragged
 
 
+def _slo_verdict(report):
+    """Slim per-objective verdict for the bench JSON, read straight
+    off an SLOEngine report — the SAME rolling windows the dashboard
+    uses, no parallel bespoke math."""
+    return {"state": report["state"],
+            "objectives": {
+                name: {"state": o["state"],
+                       "value": round(o["value_slow"], 4),
+                       "threshold": o["threshold"],
+                       "burn_slow": round(o["burn_slow"], 2),
+                       "samples": o["samples"]}
+                for name, o in report["objectives"].items()}}
+
+
+def _round_attribution(att):
+    return {k: (round(v, 2) if isinstance(v, float) else v)
+            for k, v in att.items()}
+
+
 def _bench_serving():
     """Continuous-batching serving bench: seeded Poisson arrivals
     streamed through ServingEngine. Emits tokens/s plus p50/p99
     per-token latency and TTFT (JSON, same shape as the training
     bench), plus a ``ragged`` sub-object comparing the single ragged
     mixed prefill+decode dispatch against the legacy two-program path
-    on a deterministic burst. Off-TPU runs a tiny config to prove the
-    path."""
+    on a deterministic burst, plus the request-log latency attribution
+    and rolling-window SLO verdicts. Off-TPU runs a tiny config to
+    prove the path."""
     import threading
     import time
 
@@ -402,6 +422,9 @@ def _bench_serving():
 
     import paddle_tpu as pt
 
+    # the serving arms run with telemetry ON: the attribution and SLO
+    # sections below come from the request-scoped windows
+    pt.observability.enable()
     on_tpu = jax.devices()[0].platform == "tpu"
     if on_tpu:
         cfg = pt.models.gpt3_125M(dropout=0.0, attention_dropout=0.0)
@@ -458,6 +481,11 @@ def _bench_serving():
     ragged_compiles = eng.ragged_compiles
     mode = eng.config.ragged
     preempts = eng.scheduler.preemptions
+    attribution = _round_attribution(eng.request_log.attribution())
+    slo = _slo_verdict(eng.slo.evaluate())
+    snap_path = os.environ.get("PADDLE_TPU_OPS_SNAPSHOT")
+    if snap_path:
+        eng.dump_ops_snapshot(snap_path)
     eng.shutdown()
     ragged = _bench_serving_ragged(pt, cfg, model, on_tpu)
     total = n_req * max_new
@@ -482,6 +510,8 @@ def _bench_serving():
             "ragged_mode": mode, "preemptions": preempts,
             "shed": 0,      # single engine, no admission control
             "ragged": ragged,
+            "attribution": attribution,
+            "slo": slo,
         },
     }))
     return 0
@@ -505,6 +535,9 @@ def _bench_cluster():
     from paddle_tpu.serving.cluster import (ClusterRouter, Overloaded,
                                             Replica)
 
+    # telemetry ON: attribution + SLO verdicts read the request-scoped
+    # rolling windows of the long-lived sweep router
+    pt.observability.enable()
     on_tpu = jax.devices()[0].platform == "tpu"
     host_cores = len(os.sched_getaffinity(0)) \
         if hasattr(os, "sched_getaffinity") else (os.cpu_count() or 1)
@@ -626,6 +659,16 @@ def _bench_cluster():
             "shed": shed, "shed_rate": round(shed / n_req, 3),
             "preemptions": pre,
         })
+    # one merged snapshot over router + all replica windows, taken
+    # while the sweep router is still live; optionally dumped for
+    # ptop --snapshot
+    snap = router.ops_snapshot()
+    attribution = _round_attribution(snap["attribution"])
+    slo = _slo_verdict(snap["slo"])
+    snap_path = os.environ.get("PADDLE_TPU_OPS_SNAPSHOT")
+    if snap_path:
+        from paddle_tpu.observability.request_log import write_snapshot
+        write_snapshot(snap, snap_path)
     router.shutdown()
 
     print(json.dumps({
@@ -646,6 +689,8 @@ def _bench_cluster():
             # device and scaling_x is pinned near 1.0 by physics
             "scaling_bound_by_host": host_cores < n_rep and not on_tpu,
             "sweep": sweep,
+            "attribution": attribution,
+            "slo": slo,
         },
     }))
     return 0

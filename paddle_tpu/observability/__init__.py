@@ -70,6 +70,13 @@ from .xla_cost import (  # noqa: F401
 )
 from . import metrics_schema  # noqa: F401
 from .metrics_schema import METRICS, MetricSpec  # noqa: F401
+from . import windows  # noqa: F401
+from .windows import Ewma, ManualClock, RollingCounter  # noqa: F401
+from .windows import RollingHistogram, Windows  # noqa: F401
+from . import slo  # noqa: F401
+from .slo import Objective, SLOEngine  # noqa: F401
+from . import request_log  # noqa: F401
+from .request_log import RequestLog, RequestTimeline  # noqa: F401
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "Stopwatch",
@@ -83,4 +90,8 @@ __all__ = [
     "merge_chrome_traces",
     "flight_recorder", "dump_debug_bundle", "install_excepthook",
     "health",
+    "windows", "ManualClock", "RollingCounter", "RollingHistogram",
+    "Ewma", "Windows",
+    "slo", "Objective", "SLOEngine",
+    "request_log", "RequestLog", "RequestTimeline",
 ]

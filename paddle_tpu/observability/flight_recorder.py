@@ -121,6 +121,8 @@ def dump_debug_bundle(dir_path: Optional[str] = None,
     - ``trace.json``            — chrome trace of finished spans
     - ``comm_tasks.json``       — in-flight CommTask table
     - ``env.json``              — env vars / versions / argv / reason
+    - ``request_log_tail.jsonl``— last closed serving access-log records
+    - ``slo_windows.json``      — rolling-window snapshots + SLO reports
 
     Every section is written best-effort: one broken exporter must not
     cost the rest of the bundle. Returns the bundle directory."""
@@ -156,6 +158,27 @@ def dump_debug_bundle(dir_path: Optional[str] = None,
         pass
     try:
         _write_json(os.path.join(d, "env.json"), _env_snapshot(reason))
+    except Exception:
+        pass
+    try:
+        from . import request_log as _rlog
+
+        recs = _rlog.tail_all(100)
+        if recs:
+            with open(os.path.join(d, "request_log_tail.jsonl"),
+                      "w") as f:
+                for rec in recs:
+                    f.write(json.dumps(rec, default=str) + "\n")
+    except Exception:
+        pass
+    try:
+        from . import slo as _slo
+        from . import windows as _windows
+
+        wins = _windows.snapshot_all()
+        if wins:
+            _write_json(os.path.join(d, "slo_windows.json"),
+                        {"windows": wins, "slo": _slo.reports_all()})
     except Exception:
         pass
     return d

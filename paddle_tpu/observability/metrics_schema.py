@@ -344,6 +344,72 @@ METRICS = {
         "histogram", "s", "parameter-server bench timed window "
         "(recommender pull/push arms and the failover drill arm)",
         TIME_BUCKETS),
+    # ---- request-scoped serving telemetry: ROLLING-WINDOW instruments
+    # (observability/request_log.py + windows.py). Unlike everything
+    # above, rt.* names live in per-engine/per-router Windows
+    # collections (ring-of-buckets, time-windowed) — the lint treats
+    # the call sites identically, so the names stay schema-checked.
+    "rt.submitted": MetricSpec(
+        "counter", "requests", "requests arriving at an engine or "
+        "router (shed arrivals included on the router side)"),
+    "rt.shed": MetricSpec(
+        "counter", "requests", "arrivals refused by router admission "
+        "control (the SLO shed-rate numerator)"),
+    "rt.finished": MetricSpec(
+        "counter", "requests", "access-log records closed (any "
+        "terminal outcome)"),
+    "rt.tokens": MetricSpec(
+        "counter", "tokens", "tokens streamed to clients"),
+    "rt.prefix_hit_tokens": MetricSpec(
+        "counter", "tokens", "prompt tokens restored from the prefix "
+        "cache at admission (windowed twin of "
+        "serving.prefix_hit_tokens)"),
+    "rt.preemptions": MetricSpec(
+        "counter", "requests", "preemption events (evict-and-"
+        "recompute) over the rolling window"),
+    "rt.ttft": MetricSpec(
+        "histogram", "s", "time to first token over the rolling "
+        "window (SLO objective ttft_p99 reads this)", TIME_BUCKETS),
+    "rt.token_gap": MetricSpec(
+        "histogram", "s", "gap between consecutive streamed tokens of "
+        "one request, rolling (SLO objective token_gap_p99)",
+        TOKEN_LATENCY_BUCKETS),
+    "rt.e2e": MetricSpec(
+        "histogram", "s", "end-to-end request latency: arrival to "
+        "terminal outcome", TIME_BUCKETS),
+    "rt.queue_wait": MetricSpec(
+        "histogram", "s", "attribution segment: time waiting for "
+        "first admission", TIME_BUCKETS),
+    "rt.prefill_time": MetricSpec(
+        "histogram", "s", "attribution segment: time in PREFILL "
+        "(re-prefill after preemption included — it is real compute)",
+        TIME_BUCKETS),
+    "rt.decode_time": MetricSpec(
+        "histogram", "s", "attribution segment: time decoding "
+        "(first token to finish, preempt stalls excluded)",
+        TIME_BUCKETS),
+    "rt.preempt_stall": MetricSpec(
+        "histogram", "s", "attribution segment: pure stall between "
+        "eviction and re-admission", TIME_BUCKETS),
+    "rt.slot_util": MetricSpec(
+        "gauge", "fraction", "EWMA of occupied decode slots / "
+        "max_slots (per engine)"),
+    "rt.queue_depth": MetricSpec(
+        "gauge", "requests", "EWMA of the admission queue depth "
+        "(per engine)"),
+    # ---- SLO burn-rate engine (observability/slo.py)
+    "slo.evaluations": MetricSpec(
+        "counter", "evaluations", "SLOEngine.evaluate() passes per "
+        "objective", tags=("objective",)),
+    "slo.state": MetricSpec(
+        "gauge", "state", "objective state after the last evaluation "
+        "(0=OK 1=WARN 2=BURN)", tags=("objective",)),
+    "slo.burn_fast": MetricSpec(
+        "gauge", "x budget", "fast-window error-budget burn rate of "
+        "the objective", tags=("objective",)),
+    "slo.burn_slow": MetricSpec(
+        "gauge", "x budget", "slow-window error-budget burn rate of "
+        "the objective", tags=("objective",)),
     # ---- parameter-server tier (distributed/ps/)
     "ps.pulls": MetricSpec(
         "counter", "rows", "sparse/dense rows served by PS pull "
@@ -443,6 +509,13 @@ SPANS = {
                   "shard-map takeover (shard in args)",
     "ps.replay": "in-flight window replay against a new primary "
                  "(shard + record count in args)",
+    "rt.request": "one request's whole lifecycle, synthesized at close "
+                  "by the access log via tracing.record_complete "
+                  "(outcome + attribution segments in args) — the bar "
+                  "that spans router -> replica -> ragged steps in "
+                  "Perfetto",
+    "slo.evaluate": "one SLOEngine.evaluate() pass over the rolling "
+                    "windows (all objectives)",
 }
 
 

@@ -1,0 +1,263 @@
+"""Declarative SLOs evaluated over rolling windows.
+
+An :class:`Objective` is one service-level statement — "p99 TTFT stays
+under 2 s", "shed rate stays under 5%" — bound to the rolling windows
+of :mod:`windows` rather than to all-time registry totals, because an
+SLO over a cumulative histogram can never recover (one bad minute
+poisons the quantile forever).
+
+State is computed multi-window-burn-rate style (the SRE-workbook
+alerting shape): the *violation fraction* of each objective is read
+over a fast window and a slow window, divided by the objective's error
+budget to get a burn rate, and classified:
+
+* ``BURN`` — fast burn ≥ ``page_burn`` AND slow burn ≥ 1: the budget
+  is burning fast *and* it isn't a single-bucket blip.
+* ``WARN`` — either horizon is burning faster than budget (burn ≥ 1).
+* ``OK``   — otherwise.
+
+:meth:`SLOEngine.load_signals` condenses the same evaluation into the
+scalar feed the ROADMAP's elastic autoscaler will consume (sustained
+shed rate, worst burn, want-scale hint) — the dashboard, the bench
+verdicts, and the future scaling loop all read one math path.
+
+Objectives default from ``PADDLE_TPU_SLO_*`` env knobs; everything is
+pure stdlib and clock-injectable (tests drive it with ManualClock).
+"""
+from __future__ import annotations
+
+import os
+import threading
+import weakref
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Union
+
+from . import windows as _w
+
+__all__ = ["Objective", "SLOEngine", "default_objectives",
+           "reports_all", "OK", "WARN", "BURN"]
+
+OK, WARN, BURN = "OK", "WARN", "BURN"
+_STATE_RANK = {OK: 0, WARN: 1, BURN: 2}
+
+
+def _env_float(name: str, default: float) -> float:
+    v = os.environ.get(name)
+    return float(v) if v else default
+
+
+@dataclass(frozen=True)
+class Objective:
+    """One SLO statement over a windowed metric.
+
+    ``kind``:
+      * ``"quantile"`` — the q-th percentile of histogram ``metric``
+        must stay under ``threshold`` (seconds, usually). Violation
+        fraction = fraction of observations above ``threshold``.
+      * ``"ratio"`` — counter ``metric`` divided by counter ``denom``
+        must stay under ``threshold``. Violation fraction =
+        ``max(0, ratio - threshold) / max(threshold, eps)`` capped at
+        1 — proportional, so barely-over burns slowly.
+    ``budget`` is the allowed violation fraction (error budget); for a
+    p99 objective it is 0.01 by definition.
+    """
+
+    name: str
+    metric: str
+    threshold: float
+    kind: str = "quantile"          # "quantile" | "ratio"
+    q: float = 99.0
+    budget: float = 0.01
+    denom: str = ""                 # ratio kind: denominator counter
+    description: str = ""
+
+    def __post_init__(self):
+        if self.kind not in ("quantile", "ratio"):
+            raise ValueError(f"unknown objective kind {self.kind!r}")
+        if self.kind == "ratio" and not self.denom:
+            raise ValueError("ratio objective needs denom=")
+        if self.budget <= 0:
+            raise ValueError("budget must be > 0")
+
+
+def default_objectives() -> List[Objective]:
+    """The serving SLOs every engine/router evaluates out of the box,
+    thresholds from ``PADDLE_TPU_SLO_*`` (milliseconds for latencies,
+    fraction for shed rate)."""
+    ttft_ms = _env_float("PADDLE_TPU_SLO_TTFT_P99_MS", 2000.0)
+    gap_ms = _env_float("PADDLE_TPU_SLO_TOKEN_GAP_P99_MS", 500.0)
+    shed = _env_float("PADDLE_TPU_SLO_SHED_RATE", 0.05)
+    return [
+        Objective("ttft_p99", "rt.ttft", ttft_ms / 1e3,
+                  kind="quantile", q=99.0, budget=0.01,
+                  description="p99 time-to-first-token"),
+        Objective("token_gap_p99", "rt.token_gap", gap_ms / 1e3,
+                  kind="quantile", q=99.0, budget=0.01,
+                  description="p99 inter-token decode gap"),
+        Objective("shed_rate", "rt.shed", shed, kind="ratio",
+                  denom="rt.submitted", budget=1.0,
+                  description="fraction of requests shed at admission"),
+    ]
+
+
+class SLOEngine:
+    """Evaluates objectives against one or more :class:`~.windows.
+    Windows` collections (several = the cluster case: per-replica
+    windows merge at evaluation time, no central collector thread).
+
+    The fast/slow horizons and the page threshold come from env knobs:
+    ``PADDLE_TPU_SLO_FAST_S`` (default 10), ``PADDLE_TPU_SLO_WINDOW_S``
+    (default: the windows' full span), ``PADDLE_TPU_SLO_PAGE_BURN``
+    (default 4 — the fast window must burn 4x budget to page).
+    """
+
+    def __init__(self, windows: Union[_w.Windows, Sequence[_w.Windows]],
+                 objectives: Optional[Sequence[Objective]] = None,
+                 fast_s: Optional[float] = None,
+                 slow_s: Optional[float] = None,
+                 page_burn: Optional[float] = None):
+        self._windows = list(windows) if isinstance(
+            windows, (list, tuple)) else [windows]
+        self.objectives = list(objectives if objectives is not None
+                               else default_objectives())
+        self.fast_s = fast_s if fast_s is not None else \
+            _env_float("PADDLE_TPU_SLO_FAST_S", 10.0)
+        self.slow_s = slow_s if slow_s is not None else \
+            _env_float("PADDLE_TPU_SLO_WINDOW_S", 0.0) or None
+        self.page_burn = page_burn if page_burn is not None else \
+            _env_float("PADDLE_TPU_SLO_PAGE_BURN", 4.0)
+        self._lock = threading.Lock()
+        self._last: Dict[str, dict] = {}  # guarded by: _lock
+        _live.add(self)
+
+    def add_windows(self, w: _w.Windows) -> None:
+        with self._lock:
+            self._windows.append(w)
+
+    # ------------------------------------------------------ measurement
+    def _hist_state(self, metric: str, window_s) -> dict:
+        return _w.merge_states([
+            w.histogram(metric).state(window_s) for w in self._windows])
+
+    def _counter_total(self, metric: str, window_s) -> float:
+        return sum(w.counter(metric).total(window_s)
+                   for w in self._windows)
+
+    def _violation_fraction(self, obj: Objective, window_s) -> dict:
+        """Measured value + violation fraction over one horizon."""
+        if obj.kind == "quantile":
+            st = self._hist_state(obj.metric, window_s)
+            value = _w.percentile_of_state(st, obj.q)
+            frac = _w.frac_over_state(st, obj.threshold)
+            n = st["count"]
+        else:
+            num = self._counter_total(obj.metric, window_s)
+            den = self._counter_total(obj.denom, window_s)
+            value = num / den if den else 0.0
+            frac = min(1.0, max(0.0, value - obj.threshold)
+                       / max(obj.threshold, 1e-9))
+            n = int(den)
+        return {"value": value, "violation_fraction": frac,
+                "samples": n}
+
+    # ------------------------------------------------------- evaluation
+    def evaluate(self) -> dict:
+        """Full report: per-objective fast/slow burn rates and state,
+        plus the overall (worst) state."""
+        from . import tracing as _tr
+        from .registry import enabled as _enabled
+        from .registry import registry as _registry
+
+        with _tr.tracer.span("slo.evaluate"):
+            report = {"fast_s": self.fast_s, "slow_s": self.slow_s,
+                      "page_burn": self.page_burn, "objectives": {},
+                      "state": OK}
+            for obj in self.objectives:
+                fast = self._violation_fraction(obj, self.fast_s)
+                slow = self._violation_fraction(obj, self.slow_s)
+                burn_fast = fast["violation_fraction"] / obj.budget
+                burn_slow = slow["violation_fraction"] / obj.budget
+                if burn_fast >= self.page_burn and burn_slow >= 1.0:
+                    state = BURN
+                elif burn_fast >= 1.0 or burn_slow >= 1.0:
+                    state = WARN
+                else:
+                    state = OK
+                row = {"state": state, "kind": obj.kind,
+                       "metric": obj.metric,
+                       "threshold": obj.threshold, "budget": obj.budget,
+                       "burn_fast": burn_fast, "burn_slow": burn_slow,
+                       "value_fast": fast["value"],
+                       "value_slow": slow["value"],
+                       "samples": slow["samples"],
+                       "description": obj.description}
+                report["objectives"][obj.name] = row
+                if _STATE_RANK[state] > _STATE_RANK[report["state"]]:
+                    report["state"] = state
+                if _enabled():
+                    tags = {"objective": obj.name}
+                    _registry.counter("slo.evaluations",
+                                      tags=tags).inc()
+                    _registry.gauge("slo.state", tags=tags).set(
+                        _STATE_RANK[state])
+                    _registry.gauge("slo.burn_fast", tags=tags).set(
+                        burn_fast)
+                    _registry.gauge("slo.burn_slow", tags=tags).set(
+                        burn_slow)
+            with self._lock:
+                self._last = report
+            return report
+
+    # ----------------------------------------------------- autoscaler
+    def load_signals(self) -> dict:
+        """The condensed scalar feed for the elastic autoscaler: one
+        dict of floats, no nested report parsing required. Shapes the
+        ROADMAP's "scale on sustained shed rate" loop:
+
+        * ``shed_rate_fast`` / ``shed_rate_slow`` — admission shed
+          fraction over the two horizons,
+        * ``worst_burn_fast`` / ``worst_burn_slow`` — max burn across
+          objectives,
+        * ``state`` — 0/1/2 for OK/WARN/BURN,
+        * ``want_scale_up`` — 1.0 when the slow horizon is burning
+          (sustained, not a blip): the scaler's trigger bit.
+        """
+        rep = self.evaluate()
+        shed_fast = self._ratio("rt.shed", "rt.submitted", self.fast_s)
+        shed_slow = self._ratio("rt.shed", "rt.submitted", self.slow_s)
+        burns_f = [o["burn_fast"] for o in rep["objectives"].values()]
+        burns_s = [o["burn_slow"] for o in rep["objectives"].values()]
+        worst_f = max(burns_f) if burns_f else 0.0
+        worst_s = max(burns_s) if burns_s else 0.0
+        return {"state": float(_STATE_RANK[rep["state"]]),
+                "shed_rate_fast": shed_fast,
+                "shed_rate_slow": shed_slow,
+                "worst_burn_fast": worst_f,
+                "worst_burn_slow": worst_s,
+                "want_scale_up": 1.0 if worst_s >= 1.0 else 0.0}
+
+    def _ratio(self, num: str, den: str, window_s) -> float:
+        n = self._counter_total(num, window_s)
+        d = self._counter_total(den, window_s)
+        return n / d if d else 0.0
+
+    def last_report(self) -> dict:
+        with self._lock:
+            return dict(self._last)
+
+
+# weak registry of live SLO engines so the flight recorder can dump
+# every current report without plumbing handles through layers
+_live: "weakref.WeakSet[SLOEngine]" = weakref.WeakSet()
+
+
+def reports_all() -> List[dict]:
+    """Current report of every live SLO engine (fresh evaluation; the
+    flight-recorder bundle section). Best-effort per engine."""
+    out: List[dict] = []
+    for eng in list(_live):
+        try:
+            out.append(eng.evaluate())
+        except Exception:
+            continue
+    return out

@@ -33,7 +33,7 @@ from .registry import enabled as _enabled
 __all__ = ["Span", "Tracer", "tracer", "span", "current_context",
            "activate_context", "set_rank", "get_rank", "trace_pid",
            "export_chrome_trace", "merge_chrome_traces", "reset",
-           "finished_spans"]
+           "finished_spans", "record_complete"]
 
 # ring capacity: finished spans kept for export (oldest dropped first)
 _DEFAULT_CAPACITY = int(os.environ.get("PADDLE_TPU_TRACE_CAPACITY",
@@ -220,6 +220,31 @@ class Tracer:
             return _NOOP_SPAN
         return Span(self, name, cat, args)
 
+    def record_complete(self, name: str, ts_s: float, dur_s: float,
+                        cat: str = "host",
+                        args: Optional[dict] = None) -> Optional[Span]:
+        """Inject an ALREADY-finished span into the ring — for events
+        whose start/end were measured elsewhere (a request's lifecycle
+        closed by the access log, a remote worker's reported window).
+        ``ts_s`` is wall-clock epoch seconds, ``dur_s`` the duration;
+        chrome-trace convention (µs) is applied here. Parents onto the
+        caller's open span if any, so the synthesized bar lands inside
+        the live trace tree. No-op (returns None) when disabled."""
+        if not _enabled():
+            return None
+        sp = Span(self, name, cat, args)
+        stack = getattr(self._local, "stack", None)
+        if stack:
+            sp.trace_id = stack[-1].trace_id
+            sp.parent_id = stack[-1].span_id
+        else:
+            sp.trace_id = _new_id()
+        sp.tid = self._tid()
+        sp.ts = float(ts_s) * 1e6
+        sp.dur = max(0.0, float(dur_s)) * 1e6
+        self._done.append(sp)
+        return sp
+
     def current_context(self) -> Optional[dict]:
         """The active ``{trace_id, span_id}`` for cross-rank/thread
         propagation; None when disabled or no span is open."""
@@ -286,6 +311,12 @@ def current_context() -> Optional[dict]:
 
 def activate_context(ctx: Optional[dict]) -> _ContextScope:
     return tracer.activate_context(ctx)
+
+
+def record_complete(name: str, ts_s: float, dur_s: float,
+                    cat: str = "host",
+                    args: Optional[dict] = None) -> Optional[Span]:
+    return tracer.record_complete(name, ts_s, dur_s, cat, args)
 
 
 def finished_spans() -> List[Span]:

@@ -1,0 +1,437 @@
+"""Time-windowed telemetry: ring-of-buckets rolling windows.
+
+The PR-1 registry answers "how many, ever" — every counter and
+histogram is all-time cumulative, which is the right shape for
+post-mortems and parity asserts but useless for control decisions:
+"sustained shed rate", "p99 TTFT over the last minute", and every
+autoscaling/SLO question the serving tier needs are *windowed*
+quantities. This module is the time-aware half of the telemetry tier:
+
+* :class:`RollingCounter` — a ring of ``n`` buckets each ``bucket_s``
+  wide; ``total()``/``rate()`` over the whole window or any suffix of
+  it. Old data ages out *exactly* at bucket granularity: a bucket
+  leaves the window the instant the ring rotates past it, never
+  before, never after (property-tested against a reference model).
+* :class:`RollingHistogram` — the same ring discipline over
+  fixed-boundary buckets (shared with :mod:`metrics_schema`), with
+  p50/p99 via linear interpolation inside the containing bucket and
+  snapshot-level :func:`merge_states` so multi-replica windows
+  aggregate without a central collector.
+* :class:`Ewma` — time-decayed exponentially weighted average for
+  utilization-style signals (half-life, not sample-count, based — a
+  stalled engine's utilization decays even when nobody writes).
+* :class:`Windows` — a named collection of the above with one shared
+  clock, mirroring the registry's ``counter/gauge/histogram`` API so
+  the metric-names lint covers window names too (``rt.*`` family).
+
+Every instrument takes an injectable monotonic ``clock`` so the tests
+drive bucket rotation deterministically — zero wall-clock sleeps.
+Thread-safety matches the registry: one small lock per instrument,
+held only around ring mutation.
+"""
+from __future__ import annotations
+
+import bisect
+import os
+import threading
+import time
+import weakref
+from typing import Callable, Dict, List, Optional, Sequence
+
+from . import metrics_schema as _schema
+
+__all__ = ["ManualClock", "RollingCounter", "RollingHistogram", "Ewma",
+           "Windows", "merge_states", "percentile_of_state",
+           "snapshot_all"]
+
+Clock = Callable[[], float]
+
+
+class ManualClock:
+    """Deterministic test clock: ``now()`` returns the set time,
+    ``advance()`` moves it forward. Monotonic by construction."""
+
+    def __init__(self, t: float = 0.0):
+        self._t = float(t)
+
+    def now(self) -> float:
+        return self._t
+
+    def advance(self, dt: float) -> float:
+        if dt < 0:
+            raise ValueError("ManualClock cannot go backwards")
+        self._t += float(dt)
+        return self._t
+
+    def __call__(self) -> float:
+        return self._t
+
+
+def _env_float(name: str, default: float) -> float:
+    v = os.environ.get(name)
+    return float(v) if v else default
+
+
+# window geometry knobs (seconds); 12 buckets keeps suffix queries
+# (the SLO fast window) meaningful without growing state
+DEFAULT_WINDOW_S = _env_float("PADDLE_TPU_WINDOW_S", 60.0)
+DEFAULT_BUCKETS = int(_env_float("PADDLE_TPU_WINDOW_BUCKETS", 12))
+
+
+class _Ring:
+    """Shared rotation bookkeeping: ``_cur`` is the absolute bucket
+    index (``int(now / bucket_s)``) of the newest bucket; slot
+    ``b % n`` holds absolute bucket ``b`` for ``b`` in
+    ``(_cur - n, _cur]``. Rotating zeroes the slots being re-entered,
+    which is exactly how old data ages out."""
+
+    __slots__ = ("bucket_s", "n", "_cur", "_lock", "_clock")
+
+    def __init__(self, window_s: float, n_buckets: int, clock: Clock):
+        if window_s <= 0 or n_buckets <= 0:
+            raise ValueError("window_s and n_buckets must be > 0")
+        self.n = int(n_buckets)
+        self.bucket_s = float(window_s) / self.n
+        self._clock = clock
+        self._cur = int(clock() / self.bucket_s)
+        self._lock = threading.Lock()
+
+    @property
+    def window_s(self) -> float:
+        return self.bucket_s * self.n
+
+    def _live_slots(self, window_s: Optional[float]) -> range:
+        """Suffix of the ring covering the last ``window_s`` seconds
+        (whole window when None), as offsets j: bucket ``_cur - j``."""
+        if window_s is None:
+            k = self.n
+        else:
+            k = min(self.n, max(1, -(-float(window_s) // self.bucket_s)))
+        return range(int(k))
+
+    def _rotate(self, now: float, clear) -> None:  # ptlint: holds=_lock
+        """Advance to ``now``'s bucket, clearing every slot the ring
+        rolls over (gap > n clears everything once around)."""
+        idx = int(now / self.bucket_s)
+        if idx <= self._cur:
+            return
+        step = min(idx - self._cur, self.n)
+        for j in range(step):
+            clear((self._cur + 1 + j) % self.n)
+        self._cur = idx
+
+
+class RollingCounter(_Ring):
+    """Monotonic events over a rolling window."""
+
+    __slots__ = ("name", "_counts")
+
+    def __init__(self, name: str, window_s: float = DEFAULT_WINDOW_S,
+                 n_buckets: int = DEFAULT_BUCKETS,
+                 clock: Clock = time.monotonic):
+        super().__init__(window_s, n_buckets, clock)
+        self.name = name
+        self._counts = [0.0] * self.n  # guarded by: _lock
+
+    def _clear(self, slot: int) -> None:  # ptlint: holds=_lock
+        self._counts[slot] = 0.0
+
+    def inc(self, n: float = 1.0) -> None:
+        now = self._clock()
+        with self._lock:
+            self._rotate(now, self._clear)
+            self._counts[self._cur % self.n] += float(n)
+
+    def total(self, window_s: Optional[float] = None) -> float:
+        with self._lock:
+            self._rotate(self._clock(), self._clear)
+            return sum(self._counts[(self._cur - j) % self.n]
+                       for j in self._live_slots(window_s))
+
+    def rate(self, window_s: Optional[float] = None) -> float:
+        """Events per second over the window suffix (the window span,
+        not elapsed-since-start: a fresh counter reads low, never
+        spikes)."""
+        span = min(self.window_s, window_s) if window_s else self.window_s
+        return self.total(window_s) / span if span > 0 else 0.0
+
+    def state(self, window_s: Optional[float] = None) -> dict:
+        return {"kind": "counter", "total": self.total(window_s),
+                "rate": self.rate(window_s)}
+
+
+class RollingHistogram(_Ring):
+    """Fixed-boundary histogram over a rolling window: per ring slot
+    one bucket-count row plus sum/count/min/max, so percentiles,
+    means, and threshold fractions are all answerable for any window
+    suffix — and two windows merge by adding aligned rows."""
+
+    __slots__ = ("name", "boundaries", "_rows", "_sums", "_cnts",
+                 "_mins", "_maxs")
+
+    def __init__(self, name: str, boundaries: Optional[Sequence[float]]
+                 = None, window_s: float = DEFAULT_WINDOW_S,
+                 n_buckets: int = DEFAULT_BUCKETS,
+                 clock: Clock = time.monotonic):
+        super().__init__(window_s, n_buckets, clock)
+        self.name = name
+        if boundaries is None:
+            sp = _schema.spec(name)
+            boundaries = sp.buckets if sp and sp.buckets \
+                else _schema.TIME_BUCKETS
+        self.boundaries = tuple(sorted(float(b) for b in boundaries))
+        nb = len(self.boundaries) + 1                # +inf tail
+        self._rows = [[0] * nb for _ in range(self.n)]  # guarded by: _lock
+        self._sums = [0.0] * self.n  # guarded by: _lock
+        self._cnts = [0] * self.n  # guarded by: _lock
+        self._mins = [float("inf")] * self.n  # guarded by: _lock
+        self._maxs = [float("-inf")] * self.n  # guarded by: _lock
+
+    def _clear(self, slot: int) -> None:  # ptlint: holds=_lock
+        row = self._rows[slot]
+        for i in range(len(row)):
+            row[i] = 0
+        self._sums[slot] = 0.0
+        self._cnts[slot] = 0
+        self._mins[slot] = float("inf")
+        self._maxs[slot] = float("-inf")
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        i = bisect.bisect_left(self.boundaries, v)
+        now = self._clock()
+        with self._lock:
+            self._rotate(now, self._clear)
+            slot = self._cur % self.n
+            self._rows[slot][i] += 1
+            self._sums[slot] += v
+            self._cnts[slot] += 1
+            if v < self._mins[slot]:
+                self._mins[slot] = v
+            if v > self._maxs[slot]:
+                self._maxs[slot] = v
+
+    # ----------------------------------------------------------- queries
+    def state(self, window_s: Optional[float] = None) -> dict:
+        """Mergeable snapshot of the window suffix (see
+        :func:`merge_states`)."""
+        with self._lock:
+            self._rotate(self._clock(), self._clear)
+            counts = [0] * (len(self.boundaries) + 1)
+            total, s = 0, 0.0
+            mn, mx = float("inf"), float("-inf")
+            for j in self._live_slots(window_s):
+                slot = (self._cur - j) % self.n
+                row = self._rows[slot]
+                for i in range(len(counts)):
+                    counts[i] += row[i]
+                total += self._cnts[slot]
+                s += self._sums[slot]
+                mn = min(mn, self._mins[slot])
+                mx = max(mx, self._maxs[slot])
+        return {"kind": "histogram", "boundaries": list(self.boundaries),
+                "counts": counts, "count": total, "sum": s,
+                "min": mn if total else 0.0, "max": mx if total else 0.0}
+
+    def count(self, window_s: Optional[float] = None) -> int:
+        return self.state(window_s)["count"]
+
+    def mean(self, window_s: Optional[float] = None) -> float:
+        st = self.state(window_s)
+        return st["sum"] / st["count"] if st["count"] else 0.0
+
+    def percentile(self, q: float,
+                   window_s: Optional[float] = None) -> float:
+        return percentile_of_state(self.state(window_s), q)
+
+    def frac_over(self, threshold: float,
+                  window_s: Optional[float] = None) -> float:
+        """Estimated fraction of observations strictly above
+        ``threshold`` (exact when the threshold is a bucket boundary,
+        linearly interpolated inside its bucket otherwise)."""
+        return frac_over_state(self.state(window_s), threshold)
+
+
+def percentile_of_state(state: dict, q: float) -> float:
+    """q-th percentile from a histogram state via cumulative bucket
+    counts + linear interpolation inside the containing bucket. The
+    result is always inside the bucket holding the true percentile, so
+    it is within one bucket width of an exact (numpy) percentile over
+    the same observations."""
+    counts, bounds = state["counts"], state["boundaries"]
+    total = state["count"]
+    if not total:
+        return 0.0
+    target = max(0.0, min(100.0, float(q))) / 100.0 * total
+    cum = 0.0
+    for i, c in enumerate(counts):
+        if cum + c >= target and c > 0:
+            lo = bounds[i - 1] if i > 0 else min(state["min"], bounds[0])
+            hi = bounds[i] if i < len(bounds) else state["max"]
+            if hi <= lo:
+                return hi
+            v = lo + (hi - lo) * (target - cum) / c
+            # the interpolated point is inside the containing bucket by
+            # construction; clamping to the observed extrema tightens
+            # the tail buckets without leaving it
+            return min(max(v, state["min"]), state["max"])
+        cum += c
+    return state["max"]
+
+
+def frac_over_state(state: dict, threshold: float) -> float:
+    counts, bounds = state["counts"], state["boundaries"]
+    total = state["count"]
+    if not total:
+        return 0.0
+    i = bisect.bisect_left(bounds, float(threshold))
+    over = sum(counts[i + 1:])
+    # interpolate the threshold's own bucket
+    c = counts[i]
+    if c:
+        lo = bounds[i - 1] if i > 0 else min(state["min"], bounds[0])
+        hi = bounds[i] if i < len(bounds) else max(state["max"], lo)
+        if hi > lo:
+            over += c * max(0.0, min(1.0, (hi - float(threshold))
+                                     / (hi - lo)))
+    return over / total
+
+
+def merge_states(states: Sequence[dict]) -> dict:
+    """Sum histogram states (same boundaries) into one — the cluster
+    aggregation path: per-replica windows stay local, SLOs evaluate
+    over the merged counts."""
+    states = [s for s in states if s and s.get("kind") == "histogram"]
+    if not states:
+        return {"kind": "histogram", "boundaries": [], "counts": [],
+                "count": 0, "sum": 0.0, "min": 0.0, "max": 0.0}
+    base = states[0]
+    for s in states[1:]:
+        if s["boundaries"] != base["boundaries"]:
+            raise ValueError("cannot merge histograms with different "
+                             "boundaries")
+    counts = [0] * len(base["counts"])
+    total, ssum = 0, 0.0
+    mn, mx = float("inf"), float("-inf")
+    for s in states:
+        for i, c in enumerate(s["counts"]):
+            counts[i] += c
+        total += s["count"]
+        ssum += s["sum"]
+        if s["count"]:
+            mn = min(mn, s["min"])
+            mx = max(mx, s["max"])
+    return {"kind": "histogram", "boundaries": list(base["boundaries"]),
+            "counts": counts, "count": total, "sum": ssum,
+            "min": mn if total else 0.0, "max": mx if total else 0.0}
+
+
+class Ewma:
+    """Time-decayed exponentially weighted moving average. ``set(v)``
+    folds a new sample with weight ``1 - exp(-dt / tau)``; ``value``
+    decays toward the last sample on read, so a signal nobody writes
+    still relaxes (a dead replica's utilization falls to its last
+    reading, not a stale peak)."""
+
+    __slots__ = ("name", "tau_s", "_v", "_t", "_init", "_lock",
+                 "_clock")
+
+    def __init__(self, name: str, tau_s: float = 10.0,
+                 clock: Clock = time.monotonic):
+        self.name = name
+        self.tau_s = float(tau_s)
+        self._clock = clock
+        self._v = 0.0  # guarded by: _lock
+        self._t = clock()  # guarded by: _lock
+        self._init = False  # guarded by: _lock
+        self._lock = threading.Lock()
+
+    def set(self, v: float) -> None:
+        import math
+
+        now = self._clock()
+        with self._lock:
+            if not self._init:
+                self._v, self._init = float(v), True
+            else:
+                dt = max(0.0, now - self._t)
+                a = 1.0 - math.exp(-dt / self.tau_s) if self.tau_s \
+                    else 1.0
+                self._v += a * (float(v) - self._v)
+            self._t = now
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._v
+
+    def state(self, window_s: Optional[float] = None) -> dict:
+        return {"kind": "gauge", "value": self.value}
+
+
+# weak registry of live Windows collections so the flight recorder can
+# dump every window snapshot without plumbing handles through layers
+_live: "weakref.WeakSet[Windows]" = weakref.WeakSet()
+
+
+class Windows:
+    """Named collection of rolling instruments sharing one clock and
+    geometry — the per-engine / per-router window set. The
+    ``counter/gauge/histogram`` spelling intentionally mirrors the
+    registry so the metric-names lint checks window names against the
+    schema too."""
+
+    def __init__(self, name: str = "", window_s: float = None,
+                 n_buckets: int = None, clock: Clock = time.monotonic):
+        self.name = name
+        self.window_s = float(window_s or DEFAULT_WINDOW_S)
+        self.n_buckets = int(n_buckets or DEFAULT_BUCKETS)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._inst: Dict[str, object] = {}  # guarded by: _lock
+        _live.add(self)
+
+    def _get(self, name: str, mk):
+        inst = self._inst.get(name)  # ptlint: disable=lock-discipline  (double-checked create, read is racy-safe)
+        if inst is None:
+            with self._lock:
+                inst = self._inst.get(name)
+                if inst is None:
+                    inst = self._inst[name] = mk()
+        return inst
+
+    def counter(self, name: str) -> RollingCounter:
+        return self._get(name, lambda: RollingCounter(
+            name, self.window_s, self.n_buckets, self._clock))
+
+    def histogram(self, name: str,
+                  boundaries: Optional[Sequence[float]] = None
+                  ) -> RollingHistogram:
+        return self._get(name, lambda: RollingHistogram(
+            name, boundaries, self.window_s, self.n_buckets,
+            self._clock))
+
+    def gauge(self, name: str, tau_s: float = 10.0) -> Ewma:
+        return self._get(name, lambda: Ewma(name, tau_s, self._clock))
+
+    def instruments(self) -> List[object]:
+        with self._lock:
+            return list(self._inst.values())
+
+    def snapshot(self, window_s: Optional[float] = None) -> dict:
+        """{name: state} over the window suffix, ready for ptop /
+        bundles / JSON."""
+        return {i.name: i.state(window_s) for i in self.instruments()}
+
+
+def snapshot_all(window_s: Optional[float] = None) -> dict:
+    """Snapshot every live Windows collection, keyed by its name (the
+    flight-recorder hook). Unnamed collections key by id."""
+    out = {}
+    for w in list(_live):
+        key = w.name or ("windows@%x" % id(w))
+        try:
+            out[key] = w.snapshot(window_s)
+        except Exception:
+            continue
+    return out

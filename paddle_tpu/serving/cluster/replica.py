@@ -47,6 +47,9 @@ class Replica:
                  **engine_knobs):
         self.name = str(name)
         self.fault_site = fault_site
+        # access-log records and window snapshots carry the replica
+        # name as their source (explicit name= knob wins)
+        engine_knobs.setdefault("name", self.name)
         self.engine = ServingEngine(model, **engine_knobs)
         # router hook: called as on_death(replica, descriptors) from the
         # thread that observed the death, BEFORE step() returns

@@ -111,6 +111,90 @@ class ClusterRouter:
         self._affinity: Dict[int, Replica] = {}  # guarded by: _cond
         self._threads: List[threading.Thread] = []
         self._stop = threading.Event()
+        # request-scoped observability (PR 16): the router's own access
+        # log records sheds (arrivals that never reach an engine); the
+        # SLO engine merges it with every replica's windows
+        self._log = None
+        self._slo = None
+
+    # --------------------------------------------- request observability
+    @property
+    def request_log(self):
+        """Router-side access log: records admission sheds (each shed
+        counts as one arrival + one shed in the router's windows, so
+        the merged cluster shed rate is shed / total arrivals)."""
+        if self._log is None:
+            from ...observability.request_log import RequestLog
+            self._log = RequestLog(source="router")
+        return self._log
+
+    @property
+    def slo(self):
+        """Cluster SLO engine: evaluates the default serving
+        objectives over the router's windows MERGED with every
+        replica's — per-replica state stays local, aggregation happens
+        at evaluation time (windows.merge_states)."""
+        if self._slo is None:
+            from ...observability.slo import SLOEngine
+            self._slo = SLOEngine(
+                [self.request_log.windows] +
+                [r.engine.windows for r in self.replicas])
+        return self._slo
+
+    def stats(self) -> dict:
+        """Cluster health snapshot: per-replica liveness, queue depth,
+        slot utilization, and each replica's rolling-window state
+        (utilization / queue-depth EWMAs, prefix-hit and latency
+        windows). JSON-able — this is what monitors poll."""
+        per: Dict[str, dict] = {}
+        for r in self.replicas:
+            entry: dict = {"alive": r.alive}
+            if r.alive:
+                st = r.stats()
+                entry.update(
+                    queue_depth=st.queue_depth,
+                    active_slots=st.active_slots,
+                    max_slots=st.max_slots,
+                    running=st.running, prefilling=st.prefilling,
+                    free_blocks=st.free_blocks,
+                    total_blocks=st.total_blocks)
+            entry["windows"] = r.engine.windows.snapshot()
+            per[r.name] = entry
+        return {"alive": self.num_alive(),
+                "max_queue": self.max_queue,
+                "router_windows": self.request_log.windows.snapshot(),
+                "replicas": per}
+
+    def ops_snapshot(self) -> dict:
+        """The dashboard/bundle payload: :meth:`stats` plus the SLO
+        report, the autoscaler signal feed, merged latency
+        attribution, and the cluster-wide access-log tail. Same shape
+        as :meth:`ServingEngine.ops_snapshot` (more replicas)."""
+        from ...observability.request_log import attribution_of
+
+        st = self.stats()
+        all_windows = [self.request_log.windows] + \
+            [r.engine.windows for r in self.replicas]
+        tails = self.request_log.tail(50)
+        for r in self.replicas:
+            tails.extend(r.engine.request_log.tail(50))
+        tails.sort(key=lambda rec: rec.get("ts", 0.0))
+        return {"kind": "ops_snapshot", "source": "cluster",
+                "ts": time.time(),
+                "replicas": st["replicas"],
+                "router": {"windows": st["router_windows"],
+                           "max_queue": self.max_queue},
+                "slo": self.slo.evaluate(),
+                "signals": self.slo.load_signals(),
+                "attribution": attribution_of(all_windows),
+                "requests": tails[-50:]}
+
+    def dump_ops_snapshot(self, path: str) -> dict:
+        from ...observability.request_log import write_snapshot
+
+        snap = self.ops_snapshot()
+        write_snapshot(snap, path)
+        return snap
 
     # ---------------------------------------------------------- routing
     def _chain(self, prompt: Sequence[int]) -> List[int]:
@@ -171,6 +255,7 @@ class ClusterRouter:
                 return r, route
         if _obs.enabled():
             _obs.registry.counter("cluster.shed").inc()
+            self.request_log.shed(prompt_tokens=len(prompt))
         raise Overloaded(
             "all %d alive replicas at queue/watermark limits"
             % len(alive))

@@ -149,7 +149,10 @@ class EngineConfig:
     def __init__(self, max_slots=None, block_size=None, num_blocks=None,
                  prefill_chunk=None, max_seq_len=None, kv_quant=None,
                  watermark=0.01, enable_prefix_cache=True, seed=0,
-                 ragged=None, token_budget=None):
+                 ragged=None, token_budget=None, name=None):
+        # telemetry source label: access-log records and window
+        # snapshots carry it (a Replica passes its replica name)
+        self.name = str(name) if name else "engine"
         self.max_slots = max_slots or _env_int(
             "PADDLE_TPU_SERVE_SLOTS", 8)
         self.block_size = block_size or _env_int(
@@ -232,6 +235,66 @@ class ServingEngine:
         self._last_emit: Dict[int, float] = {}  # guarded by: _lock
         self._handoff_ready: List[Request] = []  # guarded by: _lock
         self._dead = False  # guarded by: _lock (fail_all called)
+        # request-scoped observability (PR 16): access log + rolling
+        # windows + SLO engine, all built lazily on first touch so a
+        # telemetry-disabled engine allocates none of it
+        self._log = None
+        self._slo = None
+
+    # --------------------------------------------- request observability
+    @property
+    def request_log(self):
+        """This engine's access log (+ rolling ``rt.*`` windows).
+        Created on first access; records accumulate only while
+        telemetry is enabled."""
+        if self._log is None:
+            from ..observability.request_log import RequestLog
+            self._log = RequestLog(source=self.config.name)
+        return self._log
+
+    @property
+    def windows(self):
+        """Rolling-window instruments (``rt.*``) for this engine."""
+        return self.request_log.windows
+
+    @property
+    def slo(self):
+        """SLO engine over this engine's rolling windows."""
+        if self._slo is None:
+            from ..observability.slo import SLOEngine
+            self._slo = SLOEngine(self.windows)
+        return self._slo
+
+    def ops_snapshot(self) -> dict:
+        """One JSON-able dict with everything the ops dashboard
+        renders: per-source window snapshots, the SLO report, the
+        autoscaler signal feed, latency attribution, and the
+        access-log tail. ``tools/ptop.py --snapshot`` reads this shape
+        (the router emits the same shape with more replicas)."""
+        st = self.stats()
+        log = self.request_log
+        return {
+            "kind": "ops_snapshot", "source": self.config.name,
+            "ts": time.time(),
+            "replicas": {self.config.name: {
+                "alive": not self.dead,
+                "queue_depth": st.queue_depth,
+                "active_slots": st.active_slots,
+                "max_slots": st.max_slots,
+                "running": st.running, "prefilling": st.prefilling,
+                "free_blocks": st.free_blocks,
+                "total_blocks": st.total_blocks,
+                "windows": log.windows.snapshot()}},
+            "slo": self.slo.evaluate(),
+            "signals": self.slo.load_signals(),
+            "attribution": log.attribution(),
+            "requests": log.tail(50)}
+
+    def dump_ops_snapshot(self, path: str) -> dict:
+        snap = self.ops_snapshot()
+        from ..observability.request_log import write_snapshot
+        write_snapshot(snap, path)
+        return snap
 
     # ----------------------------------------------------- jitted bodies
     def _decode_step(self, w, toks, pos, kp, vp, bt, temp, top_p, key):
@@ -294,6 +357,9 @@ class ServingEngine:
         with self._lock:
             if self._dead:
                 raise RequestError("replica_dead")
+            if _obs.enabled():
+                req.timeline = self.request_log.open(
+                    req.rid, prompt_tokens=len(prompt))
             self._requests[req.rid] = req
             self._streams[req.rid] = queue.Queue()
             self.scheduler.add(req)
@@ -518,6 +584,15 @@ class ServingEngine:
             req.generated = [payload.first_token]
             req.remaining = payload.max_new_tokens - 1
             req.first_token_at = req.arrival
+            if _obs.enabled():
+                # adopted requests skip queue/prefill here; TTFT is NOT
+                # stamped — the first token streamed on the prefill
+                # replica, a local ~0 would corrupt the window
+                tl = self.request_log.open(
+                    req.rid, prompt_tokens=len(req.prompt))
+                tl.mark_admitted()
+                tl.mark_running(stamp_ttft=False)
+                req.timeline = tl
             self.scheduler.place_running(req, blocks)
             self._requests[req.rid] = req
             self._streams[req.rid] = queue.Queue()
@@ -540,6 +615,8 @@ class ServingEngine:
                 if req.num_cached and _obs.enabled():
                     _obs.registry.counter(
                         "serving.prefix_hit_tokens").inc(req.num_cached)
+                    if req.timeline is not None:
+                        req.timeline.mark_prefix_hit(req.num_cached)
             if self._ragged:
                 preempted = self.scheduler.ensure_decode_blocks()
                 worked = self._run_ragged()
@@ -562,6 +639,11 @@ class ServingEngine:
                     self.scheduler.num_active())
                 _obs.registry.histogram("serving.step_time").observe(
                     time.monotonic() - t0)
+                win = self.request_log.windows
+                win.gauge("rt.queue_depth").set(
+                    len(self.scheduler.waiting))
+                win.gauge("rt.slot_util").set(
+                    self.scheduler.num_active() / self.config.max_slots)
             return bool(admitted or worked)
 
     def _dispatch(self, fn):
@@ -675,6 +757,8 @@ class ServingEngine:
                 if _obs.enabled():
                     _obs.registry.histogram("serving.ttft").observe(
                         req.first_token_at - req.arrival)
+            if req.timeline is not None:
+                req.timeline.mark_running()
             if req.handoff:
                 req.state = HANDOFF
                 req.handoff_token = int(out[req.slot])
@@ -713,6 +797,8 @@ class ServingEngine:
                 if _obs.enabled():
                     _obs.registry.histogram("serving.ttft").observe(
                         req.first_token_at - req.arrival)
+            if req.timeline is not None:
+                req.timeline.mark_running()
             if req.handoff:
                 # disaggregated prefill: park for take_handoff() — the
                 # pages stay resident until the payload is exported
@@ -762,6 +848,8 @@ class ServingEngine:
             _obs.registry.histogram("serving.token_latency").observe(
                 now - last)
         self._last_emit[req.rid] = now
+        if req.timeline is not None:
+            req.timeline.mark_emit()
         q = self._streams.get(req.rid)
         if q is not None:
             q.put(("tok", tok))
@@ -777,6 +865,8 @@ class ServingEngine:
         if q is not None:
             q.put(("end", reason))
         self._last_emit.pop(req.rid, None)
+        if req.timeline is not None:
+            req.timeline.close(reason)
         if _obs.enabled():
             _obs.registry.counter("serving.requests",
                                   tags={"outcome": reason}).inc()

@@ -66,6 +66,11 @@ class Request:
     finish_reason: Optional[str] = None
     handoff: bool = False        # disagg: stop after prefill + 1st token
     handoff_token: Optional[int] = None  # the sampled 1st token
+    # observability.request_log.RequestTimeline, attached by the engine
+    # ONLY when telemetry is enabled — None keeps the scheduler's hot
+    # paths at one attribute read on the disabled path, and the
+    # scheduler stays clock-free (the timeline owns its clock)
+    timeline: Optional[object] = None
 
     def __post_init__(self) -> None:
         if not self.prompt:
@@ -180,6 +185,8 @@ class Scheduler:
             req.slot = self._free_slots.pop()
             self.slots[req.slot] = req
             req.state = PREFILL
+            if req.timeline is not None:
+                req.timeline.mark_admitted()
             admitted.append(req)
         return admitted
 
@@ -272,6 +279,8 @@ class Scheduler:
         req.num_cached = 0
         req.preemptions += 1
         self.preemptions += 1
+        if req.timeline is not None:
+            req.timeline.mark_preempted()
         req.state = WAITING
         # keep the waiting deque sorted by arrival (FCFS overall)
         idx = 0

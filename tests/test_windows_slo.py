@@ -1,0 +1,381 @@
+"""Rolling windows + SLO burn-rate engine (observability/windows.py,
+observability/slo.py) under a fake clock — zero wall-clock sleeps.
+
+The rotation-aging tests check the load-bearing invariant of the ring:
+an observation leaves the window the instant the ring rotates past its
+bucket, never before and never after, property-tested against a
+timestamp-list reference model. The SLO tests walk one engine through
+OK -> WARN -> BURN -> (age out) -> OK purely by advancing the clock.
+"""
+import numpy as np
+import pytest
+
+from paddle_tpu.observability import metrics_schema
+from paddle_tpu.observability import slo as slo_mod
+from paddle_tpu.observability.slo import (BURN, OK, WARN, Objective,
+                                          SLOEngine)
+from paddle_tpu.observability.windows import (Ewma, ManualClock,
+                                              RollingCounter,
+                                              RollingHistogram, Windows,
+                                              frac_over_state,
+                                              merge_states,
+                                              percentile_of_state)
+
+WIN, NB = 12.0, 12      # 1 s buckets: offsets are easy to reason about
+
+
+# ------------------------------------------------------ rolling counter
+class TestRollingCounter:
+    def test_total_and_rate(self):
+        clk = ManualClock(100.0)
+        c = RollingCounter("rt.submitted", WIN, NB, clock=clk)
+        c.inc()
+        c.inc(2.0)
+        assert c.total() == 3.0
+        assert c.rate() == pytest.approx(3.0 / WIN)
+
+    def test_ages_out_exactly_at_bucket_granularity(self):
+        clk = ManualClock(100.0)
+        c = RollingCounter("rt.submitted", WIN, NB, clock=clk)
+        c.inc(5.0)                      # lands in bucket int(100/1)=100
+        # last instant bucket 100 is still inside the 12-bucket window
+        clk.advance(11.999)             # cur bucket 111: 100 in (99,111]
+        assert c.total() == 5.0
+        clk.advance(0.001 + 1e-9)       # cur bucket 112: 100 ages out
+        assert c.total() == 0.0
+
+    def test_suffix_window_counts_only_recent_buckets(self):
+        clk = ManualClock(50.0)
+        c = RollingCounter("rt.submitted", WIN, NB, clock=clk)
+        c.inc(1.0)                      # bucket 50
+        clk.advance(5.0)
+        c.inc(10.0)                     # bucket 55
+        assert c.total() == 11.0
+        # 3-second suffix = buckets {55, 54, 53}: only the second inc
+        assert c.total(3.0) == 10.0
+        assert c.rate(3.0) == pytest.approx(10.0 / 3.0)
+
+    def test_gap_longer_than_ring_clears_everything_once(self):
+        clk = ManualClock(0.0)
+        c = RollingCounter("rt.submitted", WIN, NB, clock=clk)
+        c.inc(7.0)
+        clk.advance(1000.0)             # >> n buckets: one lap, all gone
+        assert c.total() == 0.0
+        c.inc(1.0)                      # ring still functional after gap
+        assert c.total() == 1.0
+
+    def test_aging_matches_reference_model_property(self):
+        """Seeded random inc/advance trace vs a timestamp-list model:
+        total(None) must equal the count of events whose absolute
+        bucket lies in (cur - n, cur] at every probe point."""
+        rng = np.random.default_rng(7)
+        clk = ManualClock(1234.5)
+        c = RollingCounter("rt.submitted", WIN, NB, clock=clk)
+        events = []                     # reference: event timestamps
+        for _ in range(400):
+            step = float(rng.exponential(0.7))
+            clk.advance(step)
+            if rng.random() < 0.6:
+                c.inc()
+                events.append(clk.now())
+            cur = int(clk.now() / c.bucket_s)
+            want = sum(1 for t in events
+                       if cur - c.n < int(t / c.bucket_s) <= cur)
+            assert c.total() == want
+
+
+# ---------------------------------------------------- rolling histogram
+class TestRollingHistogram:
+    def test_schema_boundaries_resolved_by_name(self):
+        h = RollingHistogram("rt.ttft", clock=ManualClock())
+        assert h.boundaries == tuple(
+            metrics_schema.spec("rt.ttft").buckets)
+
+    def test_state_count_sum_min_max(self):
+        clk = ManualClock(10.0)
+        h = RollingHistogram("rt.ttft", window_s=WIN, n_buckets=NB,
+                             clock=clk)
+        for v in (0.02, 0.2, 2.0):
+            h.observe(v)
+        st = h.state()
+        assert st["count"] == 3
+        assert st["sum"] == pytest.approx(2.22)
+        assert st["min"] == pytest.approx(0.02)
+        assert st["max"] == pytest.approx(2.0)
+        assert h.mean() == pytest.approx(2.22 / 3)
+
+    def test_observations_age_out(self):
+        clk = ManualClock(10.0)
+        h = RollingHistogram("rt.ttft", window_s=WIN, n_buckets=NB,
+                             clock=clk)
+        h.observe(1.0)
+        clk.advance(6.0)
+        h.observe(2.0)
+        assert h.count() == 2
+        clk.advance(7.0)                # first obs now out of window
+        st = h.state()
+        assert st["count"] == 1
+        assert st["min"] == st["max"] == pytest.approx(2.0)
+
+    def test_merge_of_split_equals_state_of_whole(self):
+        """Splitting a stream across two histograms and merging their
+        states must reproduce the unsplit histogram's state exactly —
+        the invariant cluster SLO evaluation rests on."""
+        rng = np.random.default_rng(3)
+        clk = ManualClock(5.0)
+        whole = RollingHistogram("rt.ttft", window_s=WIN, n_buckets=NB,
+                                 clock=clk)
+        a = RollingHistogram("rt.ttft", window_s=WIN, n_buckets=NB,
+                             clock=clk)
+        b = RollingHistogram("rt.ttft", window_s=WIN, n_buckets=NB,
+                             clock=clk)
+        for i in range(200):
+            v = float(rng.lognormal(-3.0, 2.0))
+            whole.observe(v)
+            (a if i % 2 else b).observe(v)
+            if i % 17 == 0:
+                clk.advance(0.4)
+        merged = merge_states([a.state(), b.state()])
+        want = whole.state()
+        assert merged["counts"] == want["counts"]
+        assert merged["count"] == want["count"]
+        assert merged["sum"] == pytest.approx(want["sum"])
+        assert merged["min"] == pytest.approx(want["min"])
+        assert merged["max"] == pytest.approx(want["max"])
+        for q in (50, 90, 99):
+            assert percentile_of_state(merged, q) == pytest.approx(
+                percentile_of_state(want, q))
+
+    def test_merge_rejects_mismatched_boundaries(self):
+        clk = ManualClock()
+        a = RollingHistogram("rt.ttft", boundaries=(1.0, 2.0),
+                             clock=clk)
+        b = RollingHistogram("rt.ttft", boundaries=(1.0, 3.0),
+                             clock=clk)
+        a.observe(0.5)
+        b.observe(0.5)
+        with pytest.raises(ValueError):
+            merge_states([a.state(), b.state()])
+
+    def test_merge_of_empty_list_is_empty_state(self):
+        st = merge_states([])
+        assert st["count"] == 0
+        assert percentile_of_state(st, 99) == 0.0
+        assert frac_over_state(st, 1.0) == 0.0
+
+    def test_percentile_within_numpy_bucket_bounds(self):
+        """Interpolated percentile must land inside the bucket holding
+        the true (numpy) percentile, and inside [min, max]."""
+        rng = np.random.default_rng(11)
+        clk = ManualClock(2.0)
+        h = RollingHistogram("rt.ttft", window_s=WIN, n_buckets=NB,
+                             clock=clk)
+        vals = rng.lognormal(-2.5, 1.5, 500).astype(float)
+        for v in vals:
+            h.observe(v)
+        bounds = list(h.boundaries)
+        for q in (50, 90, 95, 99):
+            est = h.percentile(q)
+            exact = float(np.percentile(vals, q))
+            assert vals.min() <= est <= vals.max()
+            # same containing bucket as the exact percentile
+            import bisect
+            assert bisect.bisect_left(bounds, est) == \
+                bisect.bisect_left(bounds, exact), \
+                "q=%d est=%g exact=%g" % (q, est, exact)
+
+    def test_frac_over_exact_at_bucket_boundary(self):
+        clk = ManualClock()
+        h = RollingHistogram("x.y", boundaries=(1.0, 2.0, 4.0),
+                             clock=clk)
+        for v in (0.5, 1.5, 3.0, 5.0):      # one per bucket
+            h.observe(v)
+        assert h.frac_over(2.0) == pytest.approx(0.5)
+        assert h.frac_over(4.0) == pytest.approx(0.25)
+
+
+# ------------------------------------------------------------------ ewma
+class TestEwma:
+    def test_first_set_initializes(self):
+        g = Ewma("rt.slot_util", tau_s=10.0, clock=ManualClock())
+        g.set(0.8)
+        assert g.value == pytest.approx(0.8)
+
+    def test_time_decay_folding(self):
+        clk = ManualClock(0.0)
+        g = Ewma("rt.slot_util", tau_s=10.0, clock=clk)
+        g.set(1.0)
+        clk.advance(10.0)               # one tau: weight 1 - e^-1
+        g.set(0.0)
+        assert g.value == pytest.approx(np.exp(-1.0))
+        # long-idle then a new sample dominates
+        clk.advance(1000.0)
+        g.set(0.5)
+        assert g.value == pytest.approx(0.5, abs=1e-6)
+
+
+# --------------------------------------------------- windows collection
+class TestWindows:
+    def test_same_name_same_instrument(self):
+        w = Windows("t", window_s=WIN, n_buckets=NB,
+                    clock=ManualClock())
+        assert w.counter("rt.submitted") is w.counter("rt.submitted")
+        assert w.histogram("rt.ttft") is w.histogram("rt.ttft")
+        assert w.gauge("rt.slot_util") is w.gauge("rt.slot_util")
+
+    def test_snapshot_shapes(self):
+        clk = ManualClock(1.0)
+        w = Windows("t", window_s=WIN, n_buckets=NB, clock=clk)
+        w.counter("rt.submitted").inc()
+        w.histogram("rt.ttft").observe(0.1)
+        w.gauge("rt.slot_util").set(0.5)
+        snap = w.snapshot()
+        assert snap["rt.submitted"]["kind"] == "counter"
+        assert snap["rt.submitted"]["total"] == 1.0
+        assert snap["rt.ttft"]["kind"] == "histogram"
+        assert snap["rt.ttft"]["count"] == 1
+        assert snap["rt.slot_util"]["kind"] == "gauge"
+        assert snap["rt.slot_util"]["value"] == pytest.approx(0.5)
+
+
+# ------------------------------------------------------------ slo engine
+def _mk_engine(clk, **kw):
+    w = Windows("t", window_s=WIN, n_buckets=NB, clock=clk)
+    obj = [Objective("ttft_p99", "rt.ttft", 1.0, kind="quantile",
+                     q=99.0, budget=0.01),
+           Objective("shed_rate", "rt.shed", 0.10, kind="ratio",
+                     denom="rt.submitted", budget=1.0)]
+    eng = SLOEngine(w, objectives=obj, fast_s=kw.pop("fast_s", 3.0),
+                    slow_s=kw.pop("slow_s", None),
+                    page_burn=kw.pop("page_burn", 4.0))
+    return w, eng
+
+
+class TestSLOEngine:
+    def test_objective_validation(self):
+        with pytest.raises(ValueError):
+            Objective("x", "rt.ttft", 1.0, kind="nope")
+        with pytest.raises(ValueError):
+            Objective("x", "rt.shed", 0.1, kind="ratio")  # no denom
+        with pytest.raises(ValueError):
+            Objective("x", "rt.ttft", 1.0, budget=0.0)
+
+    def test_ok_when_under_threshold(self):
+        clk = ManualClock(100.0)
+        w, eng = _mk_engine(clk)
+        for _ in range(50):
+            w.counter("rt.submitted").inc()
+            w.histogram("rt.ttft").observe(0.05)
+        rep = eng.evaluate()
+        assert rep["state"] == OK
+        assert rep["objectives"]["ttft_p99"]["state"] == OK
+        assert rep["objectives"]["shed_rate"]["state"] == OK
+
+    def test_warn_on_slow_horizon_burn(self):
+        """Violations older than the fast window but inside the slow
+        one: burn_slow >= 1, burn_fast small -> WARN, not BURN."""
+        clk = ManualClock(100.0)
+        w, eng = _mk_engine(clk, fast_s=2.0)
+        h = w.histogram("rt.ttft")
+        for _ in range(96):
+            h.observe(0.05)
+        for _ in range(4):              # ~4% violations, budget 1%
+            h.observe(5.0)
+        clk.advance(5.0)                # violations leave the fast win
+        for _ in range(50):
+            h.observe(0.05)             # fast window clean
+        rep = eng.evaluate()
+        o = rep["objectives"]["ttft_p99"]
+        assert o["burn_slow"] >= 1.0
+        assert o["burn_fast"] < 1.0
+        assert o["state"] == WARN
+        assert rep["state"] == WARN
+
+    def test_burn_needs_both_horizons(self):
+        clk = ManualClock(100.0)
+        w, eng = _mk_engine(clk, fast_s=3.0, page_burn=4.0)
+        h = w.histogram("rt.ttft")
+        for _ in range(10):
+            h.observe(0.05)
+        for _ in range(10):             # 50% violations: burn 50x
+            h.observe(5.0)
+        rep = eng.evaluate()
+        o = rep["objectives"]["ttft_p99"]
+        assert o["burn_fast"] >= 4.0 and o["burn_slow"] >= 1.0
+        assert o["state"] == BURN
+        assert rep["state"] == BURN
+
+    def test_burn_recovers_to_ok_as_window_ages(self):
+        clk = ManualClock(100.0)
+        w, eng = _mk_engine(clk)
+        h = w.histogram("rt.ttft")
+        for _ in range(20):
+            h.observe(5.0)
+        assert eng.evaluate()["state"] == BURN
+        clk.advance(WIN + 1.0)          # everything ages out
+        assert eng.evaluate()["state"] == OK
+        assert eng.last_report()["state"] == OK
+
+    def test_ratio_objective_shed_rate(self):
+        clk = ManualClock(100.0)
+        w, eng = _mk_engine(clk)
+        for _ in range(100):
+            w.counter("rt.submitted").inc()
+        for _ in range(30):             # 30% shed vs 10% threshold
+            w.counter("rt.shed").inc()
+        rep = eng.evaluate()
+        o = rep["objectives"]["shed_rate"]
+        assert o["value_fast"] == pytest.approx(0.30)
+        # proportional burn (0.30-0.10)/0.10 = 2.0, but the violation
+        # fraction caps at 1.0 — burn = 1.0/budget
+        assert o["burn_fast"] == pytest.approx(1.0)
+        assert o["state"] == WARN       # burn >= 1 but < page_burn
+
+    def test_cluster_merge_across_windows(self):
+        """Two replica windows + add_windows: violations on ONE
+        replica must still be visible in the merged evaluation."""
+        clk = ManualClock(100.0)
+        w1 = Windows("r0", window_s=WIN, n_buckets=NB, clock=clk)
+        w2 = Windows("r1", window_s=WIN, n_buckets=NB, clock=clk)
+        obj = [Objective("ttft_p99", "rt.ttft", 1.0, budget=0.01)]
+        eng = SLOEngine([w1], objectives=obj, fast_s=3.0,
+                        page_burn=4.0)
+        eng.add_windows(w2)
+        for _ in range(10):
+            w1.histogram("rt.ttft").observe(0.05)
+            w2.histogram("rt.ttft").observe(5.0)
+        rep = eng.evaluate()
+        assert rep["objectives"]["ttft_p99"]["samples"] == 20
+        assert rep["state"] == BURN
+
+    def test_load_signals_scale_up_hint(self):
+        clk = ManualClock(100.0)
+        w, eng = _mk_engine(clk)
+        sig = eng.load_signals()
+        assert sig["want_scale_up"] == 0.0
+        for _ in range(100):
+            w.counter("rt.submitted").inc()
+        for _ in range(40):
+            w.counter("rt.shed").inc()
+        sig = eng.load_signals()
+        assert sig["shed_rate_fast"] == pytest.approx(0.40)
+        assert sig["worst_burn_slow"] >= 1.0
+        assert sig["want_scale_up"] == 1.0
+
+    def test_reports_all_covers_live_engines(self):
+        clk = ManualClock(100.0)
+        _w, eng = _mk_engine(clk)
+        reports = slo_mod.reports_all()
+        assert any(r is not None and "objectives" in r
+                   for r in reports)
+        assert eng.last_report()        # evaluate() ran via reports_all
+
+
+class TestDefaultObjectives:
+    def test_env_knobs(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_SLO_TTFT_P99_MS", "1500")
+        monkeypatch.setenv("PADDLE_TPU_SLO_SHED_RATE", "0.2")
+        objs = {o.name: o for o in slo_mod.default_objectives()}
+        assert objs["ttft_p99"].threshold == pytest.approx(1.5)
+        assert objs["shed_rate"].threshold == pytest.approx(0.2)
+        assert objs["shed_rate"].denom == "rt.submitted"

@@ -24,6 +24,11 @@ Sections printed (each only if its file exists in the bundle):
                  nonfinite steps, device memory)
   * trace      — span counts by name from trace.json (open the file
                  itself in https://ui.perfetto.dev for the timeline)
+  * requests   — tail of the serving access log
+                 (request_log_tail.jsonl): per-request outcome and
+                 queue/prefill/decode/preempt attribution
+  * slo        — rolling-window SLO report (slo_windows.json):
+                 per-objective state and burn rates at dump time
 """
 from __future__ import annotations
 
@@ -32,7 +37,8 @@ import os
 import sys
 
 BUNDLE_FILES = ("env.json", "flight_recorder.jsonl", "metrics.json",
-                "comm_tasks.json", "trace.json")
+                "comm_tasks.json", "trace.json",
+                "request_log_tail.jsonl", "slo_windows.json")
 
 
 def _load_json(path):
@@ -175,6 +181,78 @@ def _show_trace(d: str):
         print(f"  {name:<32} x{n}")
 
 
+def _ms(v) -> str:
+    try:
+        return "%.0f" % (float(v) * 1e3)
+    except (TypeError, ValueError):
+        return "-"
+
+
+def _show_requests(d: str, last: int = 15):
+    path = os.path.join(d, "request_log_tail.jsonl")
+    if not os.path.exists(path):
+        return
+    recs = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    try:
+                        recs.append(json.loads(line))
+                    except json.JSONDecodeError:
+                        pass
+    except OSError:
+        return
+    if not recs:
+        return
+    _section(f"requests (last {min(last, len(recs))} of {len(recs)} "
+             f"access-log records)")
+    outcomes = {}
+    for r in recs:
+        o = r.get("outcome", "?")
+        outcomes[o] = outcomes.get(o, 0) + 1
+    print("  by outcome: " + ", ".join(
+        f"{k} x{n}" for k, n in sorted(outcomes.items())))
+    print(f"  {'rid':>6} {'source':<10} {'outcome':<9} "
+          f"{'e2e_ms':>8} {'queue':>7} {'prefill':>7} {'decode':>7} "
+          f"{'preempt':>7} {'tok':>5}")
+    for r in recs[-last:]:
+        print(f"  {str(r.get('rid', '?')):>6} "
+              f"{str(r.get('source', '?')):<10.10} "
+              f"{str(r.get('outcome', '?')):<9.9} "
+              f"{_ms(r.get('e2e_s')):>8} {_ms(r.get('queue_s')):>7} "
+              f"{_ms(r.get('prefill_s')):>7} "
+              f"{_ms(r.get('decode_s')):>7} "
+              f"{_ms(r.get('preempt_s')):>7} "
+              f"{int(r.get('tokens', 0) or 0):>5}")
+
+
+def _show_slo(d: str):
+    doc = _load_json(os.path.join(d, "slo_windows.json"))
+    if not doc:
+        return
+    reports = doc.get("slo") or []
+    wins = doc.get("windows") or {}
+    if not reports and not wins:
+        return
+    _section("slo (rolling-window report at dump time)")
+    for rep in reports:
+        print(f"  overall: {rep.get('state', '?')} "
+              f"(fast={rep.get('fast_s')}s "
+              f"slow={rep.get('slow_s') or 'full'} "
+              f"page_burn={rep.get('page_burn')}x)")
+        for name, o in sorted((rep.get("objectives") or {}).items()):
+            print(f"    {name:<16} {o.get('state', '?'):<5} "
+                  f"burn_fast={o.get('burn_fast', 0.0):.2f} "
+                  f"burn_slow={o.get('burn_slow', 0.0):.2f} "
+                  f"value={o.get('value_slow', 0.0):.4f} "
+                  f"thr={o.get('threshold', 0.0):.4f} "
+                  f"n={o.get('samples', 0)}")
+    if wins:
+        print("  window sources: " + ", ".join(sorted(wins)))
+
+
 def main(argv) -> int:
     if len(argv) != 2 or argv[1] in ("-h", "--help"):
         print(__doc__)
@@ -189,6 +267,8 @@ def main(argv) -> int:
     _show_flight(bundle)
     _show_metrics(bundle)
     _show_trace(bundle)
+    _show_requests(bundle)
+    _show_slo(bundle)
     print()
     return 0
 

@@ -33,7 +33,8 @@ _SKIP_DIRS = {".git", "__pycache__", "build", "dist", ".eggs",
               "node_modules"}
 
 # namespaces whose declared names must all be instrumented somewhere
-REQUIRE_USED = ("serving.", "cluster.", "elastic.", "ps.")
+REQUIRE_USED = ("serving.", "cluster.", "elastic.", "ps.", "rt.",
+                "slo.")
 
 _SCHEMA_RELPATH = "paddle_tpu/observability/metrics_schema.py"
 
@@ -78,10 +79,12 @@ def _call_kind(func) -> str:
 
 
 def _is_span_call(func) -> bool:
+    # record_complete("a.b", ...) injects a finished span — same
+    # declared-name contract as opening one with span("a.b")
     if isinstance(func, ast.Attribute):
-        return func.attr == "span"
+        return func.attr in ("span", "record_complete")
     if isinstance(func, ast.Name):
-        return func.id == "span"
+        return func.id in ("span", "record_complete")
     return False
 
 

@@ -1,0 +1,348 @@
+#!/usr/bin/env python
+"""ptop: live serving-ops dashboard over paddle_tpu rolling windows.
+
+Renders the request-scoped observability tier (PR 16) — rolling-window
+rates and percentiles, SLO burn-rate states, per-replica load, latency
+attribution, and the access-log tail — from either:
+
+* a dumped ops snapshot (``ServingEngine.dump_ops_snapshot`` /
+  ``ClusterRouter.dump_ops_snapshot``, or the ``slo_windows.json`` +
+  ``request_log_tail.jsonl`` pair inside a flight-recorder debug
+  bundle), or
+* a RUNNING engine/router in this process, via :func:`live`.
+
+Like ``tools/diagnose.py`` this needs ONLY the stdlib — no jax, no
+framework import — so it runs wherever the snapshot was copied to.
+Percentile math comes from the SAME module the server used
+(``paddle_tpu/observability/windows.py`` is stdlib-only and is loaded
+standalone when the repo is present), so the dashboard can never
+disagree with the SLO engine; a minimal built-in fallback covers a
+lone ``ptop.py`` next to a snapshot file.
+
+Usage::
+
+    python tools/ptop.py --snapshot /tmp/ops.json        # one-shot
+    python tools/ptop.py --snapshot /tmp/bundle_dir      # debug bundle
+    python tools/ptop.py --watch /tmp/ops.json [-n 2.0]  # re-render
+
+In-process (e.g. from a driver script)::
+
+    from tools.ptop import live
+    live(router, interval_s=2.0)         # ctrl-C to stop
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from typing import List, Optional
+
+_REPO = os.path.abspath(os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), os.pardir))
+
+
+# ---------------------------------------------------------- window math
+def _load_windows_module():
+    """Load paddle_tpu/observability/windows.py WITHOUT importing the
+    framework (same trick as ptlint's schema loader, plus a synthetic
+    parent package so its relative metrics_schema import resolves)."""
+    import importlib.util
+    import types
+
+    pkg_dir = os.path.join(_REPO, "paddle_tpu", "observability")
+    if not os.path.exists(os.path.join(pkg_dir, "windows.py")):
+        return None
+    try:
+        pkg = types.ModuleType("_ptop_obs")
+        pkg.__path__ = [pkg_dir]
+        sys.modules.setdefault("_ptop_obs", pkg)
+        for mod in ("metrics_schema", "windows"):
+            name = "_ptop_obs." + mod
+            if name in sys.modules:
+                continue
+            spec = importlib.util.spec_from_file_location(
+                name, os.path.join(pkg_dir, mod + ".py"))
+            m = importlib.util.module_from_spec(spec)
+            sys.modules[name] = m
+            spec.loader.exec_module(m)
+        return sys.modules["_ptop_obs.windows"]
+    except Exception:
+        return None
+
+
+_WIN = _load_windows_module()
+
+
+def _pctl(state: dict, q: float) -> float:
+    """Percentile of a histogram state — the server's own
+    interpolation when windows.py is reachable."""
+    if _WIN is not None:
+        return _WIN.percentile_of_state(state, q)
+    # fallback: lone ptop.py next to a snapshot (display-only)
+    counts, bounds = state.get("counts", []), state.get("boundaries", [])
+    total = state.get("count", 0)
+    if not total:
+        return 0.0
+    target = q / 100.0 * total
+    cum = 0.0
+    for i, c in enumerate(counts):
+        if cum + c >= target and c > 0:
+            hi = bounds[i] if i < len(bounds) else state.get("max", 0.0)
+            return hi
+        cum += c
+    return state.get("max", 0.0)
+
+
+# ------------------------------------------------------------ rendering
+def _fmt_s(v: Optional[float]) -> str:
+    if v is None:
+        return "-"
+    if v >= 1.0:
+        return "%.2fs" % v
+    return "%.0fms" % (v * 1e3)
+
+
+def _bar(frac: float, width: int = 10) -> str:
+    frac = max(0.0, min(1.0, float(frac)))
+    n = int(round(frac * width))
+    return "[" + "#" * n + "." * (width - n) + "]"
+
+
+def render(snap: dict, width: int = 78, n_requests: int = 10) -> str:
+    """Pure snapshot -> text rendering (what the tests assert on)."""
+    lines: List[str] = []
+    src = snap.get("source", "?")
+    ts = snap.get("ts")
+    when = time.strftime("%H:%M:%S", time.localtime(ts)) if ts else "-"
+    lines.append("paddle_tpu ptop — source=%s  ts=%s" % (src, when))
+    lines.append("=" * width)
+
+    slo = snap.get("slo") or {}
+    if slo:
+        lines.append("SLO: %-4s  (fast=%ss slow=%s page_burn=%sx)"
+                     % (slo.get("state", "?"), slo.get("fast_s"),
+                        slo.get("slow_s") or "full",
+                        slo.get("page_burn")))
+        lines.append("  %-16s %-5s %10s %10s %8s %8s %10s"
+                     % ("objective", "state", "fast", "slow",
+                        "burn_f", "burn_s", "threshold"))
+        for name, o in sorted((slo.get("objectives") or {}).items()):
+            if o.get("kind") == "quantile":
+                vf, vs = _fmt_s(o.get("value_fast")), \
+                    _fmt_s(o.get("value_slow"))
+                thr = _fmt_s(o.get("threshold"))
+            else:
+                vf = "%.3f" % o.get("value_fast", 0.0)
+                vs = "%.3f" % o.get("value_slow", 0.0)
+                thr = "%.3f" % o.get("threshold", 0.0)
+            lines.append("  %-16s %-5s %10s %10s %8.2f %8.2f %10s"
+                         % (name, o.get("state", "?"), vf, vs,
+                            o.get("burn_fast", 0.0),
+                            o.get("burn_slow", 0.0), thr))
+
+    sig = snap.get("signals") or {}
+    if sig:
+        lines.append(
+            "signals: shed_fast=%.3f shed_slow=%.3f worst_burn=%.2f "
+            "scale_up=%d"
+            % (sig.get("shed_rate_fast", 0.0),
+               sig.get("shed_rate_slow", 0.0),
+               sig.get("worst_burn_slow", 0.0),
+               int(sig.get("want_scale_up", 0.0))))
+
+    reps = snap.get("replicas") or {}
+    if reps:
+        lines.append("-" * width)
+        lines.append("  %-10s %-5s %12s %6s %8s %9s %9s %9s"
+                     % ("replica", "alive", "util", "queue", "tok/s",
+                        "ttft p99", "gap p99", "blocks"))
+        for name, r in sorted(reps.items()):
+            win = r.get("windows") or {}
+            util = (win.get("rt.slot_util") or {}).get("value", 0.0)
+            qd = (win.get("rt.queue_depth") or {}).get("value", 0.0)
+            toks = (win.get("rt.tokens") or {}).get("rate", 0.0)
+            ttft = win.get("rt.ttft")
+            gap = win.get("rt.token_gap")
+            blocks = "-"
+            if "free_blocks" in r:
+                blocks = "%d/%d" % (r.get("free_blocks", 0),
+                                    r.get("total_blocks", 0))
+            lines.append(
+                "  %-10s %-5s %s %.2f %6.1f %8.1f %9s %9s %9s"
+                % (name, "up" if r.get("alive") else "DOWN",
+                   _bar(util), util, qd, toks,
+                   _fmt_s(_pctl(ttft, 99)) if ttft else "-",
+                   _fmt_s(_pctl(gap, 99)) if gap else "-", blocks))
+
+    att = snap.get("attribution") or {}
+    if att:
+        lines.append("-" * width)
+        lines.append(
+            "attribution (mean ms over window, %d requests): "
+            "queue %.1f | prefill %.1f | decode %.1f | preempt %.1f "
+            "| e2e %.1f"
+            % (att.get("requests", 0), att.get("mean_queue_ms", 0.0),
+               att.get("mean_prefill_ms", 0.0),
+               att.get("mean_decode_ms", 0.0),
+               att.get("mean_preempt_ms", 0.0),
+               att.get("mean_e2e_ms", 0.0)))
+
+    recs = snap.get("requests") or []
+    if recs:
+        lines.append("-" * width)
+        lines.append("recent requests (last %d of %d):"
+                     % (min(n_requests, len(recs)), len(recs)))
+        lines.append("  %-10s %-8s %-10s %-8s %8s %8s %6s %5s"
+                     % ("rid", "source", "outcome", "e2e", "queue",
+                        "prefill", "decode", "tok"))
+        for rec in recs[-n_requests:]:
+            lines.append(
+                "  %-10s %-8s %-10s %-8s %8s %8s %6s %5d"
+                % (str(rec.get("rid", "?"))[:10],
+                   str(rec.get("source", "?"))[:8],
+                   ("%s/%s" % (rec.get("outcome", "?"),
+                               rec.get("reason", "?")))[:10],
+                   _fmt_s(rec.get("e2e_s")),
+                   _fmt_s(rec.get("queue_s")),
+                   _fmt_s(rec.get("prefill_s")),
+                   _fmt_s(rec.get("decode_s")),
+                   int(rec.get("tokens", 0))))
+    lines.append("=" * width)
+    return "\n".join(lines)
+
+
+# --------------------------------------------------------- snapshot I/O
+def load_snapshot(path: str) -> dict:
+    """Accept an ops-snapshot JSON file, or a flight-recorder bundle
+    dir (assembles a pseudo-snapshot from ``slo_windows.json`` +
+    ``request_log_tail.jsonl``)."""
+    if os.path.isdir(path):
+        return _load_bundle(path)
+    with open(path) as f:
+        return json.load(f)
+
+
+def _load_bundle(d: str) -> dict:
+    snap = {"kind": "ops_snapshot", "source": "bundle:%s"
+            % os.path.basename(d.rstrip("/")), "ts": None,
+            "replicas": {}, "requests": []}
+    sw = os.path.join(d, "slo_windows.json")
+    if os.path.exists(sw):
+        try:
+            with open(sw) as f:
+                doc = json.load(f)
+            for name, win in (doc.get("windows") or {}).items():
+                snap["replicas"][name] = {"alive": True, "windows": win}
+            reports = doc.get("slo") or []
+            if reports:
+                snap["slo"] = reports[0]
+        except Exception:
+            pass
+    rl = os.path.join(d, "request_log_tail.jsonl")
+    if os.path.exists(rl):
+        try:
+            with open(rl) as f:
+                for line in f:
+                    line = line.strip()
+                    if line:
+                        snap["requests"].append(json.loads(line))
+        except Exception:
+            pass
+    if not snap["replicas"] and not snap["requests"]:
+        raise SystemExit("ptop: no ops snapshot or bundle sections "
+                         "under %r" % d)
+    return snap
+
+
+# ------------------------------------------------------------- live/TUI
+def live(target, interval_s: float = 2.0,
+         iterations: Optional[int] = None) -> None:
+    """In-process dashboard over anything with ``ops_snapshot()``
+    (ServingEngine or ClusterRouter). Plain-text repaint loop; ctrl-C
+    stops it."""
+    n = 0
+    try:
+        while iterations is None or n < iterations:
+            _repaint(render(target.ops_snapshot()))
+            n += 1
+            if iterations is not None and n >= iterations:
+                break
+            time.sleep(interval_s)
+    except KeyboardInterrupt:
+        pass
+
+
+def _repaint(text: str) -> None:
+    if sys.stdout.isatty():
+        sys.stdout.write("\x1b[2J\x1b[H")
+    sys.stdout.write(text + "\n")
+    sys.stdout.flush()
+
+
+def _watch(path: str, interval_s: float) -> int:
+    """Re-render a snapshot file as it is rewritten. Uses curses when
+    on a real terminal (clean repaint), plain re-print otherwise."""
+    use_curses = sys.stdout.isatty()
+    if use_curses:
+        try:
+            import curses
+        except ImportError:
+            use_curses = False
+    if not use_curses:
+        while True:
+            try:
+                _repaint(render(load_snapshot(path)))
+            except (OSError, json.JSONDecodeError):
+                print("ptop: waiting for %s ..." % path)
+            try:
+                time.sleep(interval_s)
+            except KeyboardInterrupt:
+                return 0
+
+    def loop(scr):
+        curses.curs_set(0)
+        scr.nodelay(True)
+        while True:
+            try:
+                text = render(load_snapshot(path))
+            except (OSError, json.JSONDecodeError):
+                text = "ptop: waiting for %s ..." % path
+            scr.erase()
+            maxy, maxx = scr.getmaxyx()
+            for i, line in enumerate(text.splitlines()[:maxy - 1]):
+                try:
+                    scr.addnstr(i, 0, line, maxx - 1)
+                except curses.error:
+                    pass
+            scr.refresh()
+            for _ in range(max(1, int(interval_s * 10))):
+                if scr.getch() in (ord("q"), 27):
+                    return
+                time.sleep(0.1)
+
+    curses.wrapper(loop)
+    return 0
+
+
+def main(argv) -> int:
+    args = list(argv[1:])
+    interval = 2.0
+    if "-n" in args:
+        i = args.index("-n")
+        interval = float(args[i + 1])
+        del args[i:i + 2]
+    if len(args) == 2 and args[0] == "--snapshot":
+        print(render(load_snapshot(args[1])))
+        return 0
+    if len(args) == 2 and args[0] == "--watch":
+        return _watch(args[1], interval)
+    print(__doc__)
+    return 0 if args in ([], ["-h"], ["--help"]) else 1
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main(sys.argv))
+    except BrokenPipeError:         # e.g. piped into head
+        sys.exit(0)

@@ -55,20 +55,48 @@ def _drain(eng, rids, cap=200):
 
 def main() -> int:
     pt, model, prompts, refs = _build()
+    from paddle_tpu.observability.request_log import OUTCOMES
 
-    eng = pt.serving.ServingEngine(model, max_slots=2, block_size=8,
-                                   num_blocks=32, prefill_chunk=8)
-    rids = [eng.submit(p, max_new_tokens=6) for p in prompts]
-    outs, steps = _drain(eng, rids)
-    assert outs == refs, "serving stream != generate(): %r vs %r" \
-        % (outs, refs)
-    assert eng.ragged_compiles == 1, \
-        "ragged step compiled %d times" % eng.ragged_compiles
-    assert eng.decode_compiles == 0 and eng.prefill_compiles == 0, \
-        "legacy jits traced under ragged serving"
-    eng.shutdown()                       # raises on any block leak
+    # this arm ALSO audits the access log, so it runs telemetry-on
+    # (restored on exit — the other arms prove the disabled path)
+    was_enabled = pt.observability.enabled()
+    pt.observability.enable()
+    try:
+        eng = pt.serving.ServingEngine(model, max_slots=2, block_size=8,
+                                       num_blocks=32, prefill_chunk=8)
+        rids = [eng.submit(p, max_new_tokens=6) for p in prompts]
+        outs, steps = _drain(eng, rids)
+        assert outs == refs, "serving stream != generate(): %r vs %r" \
+            % (outs, refs)
+        assert eng.ragged_compiles == 1, \
+            "ragged step compiled %d times" % eng.ragged_compiles
+        assert eng.decode_compiles == 0 and eng.prefill_compiles == 0, \
+            "legacy jits traced under ragged serving"
+
+        # ---- access-log integrity: exactly one closed record per
+        # submitted request, a legal terminal outcome, and phase
+        # segments that never exceed the end-to-end latency
+        recs = eng.request_log.tail()
+        assert len(recs) == len(rids), \
+            "access log has %d records for %d requests" \
+            % (len(recs), len(rids))
+        assert sorted(r["rid"] for r in recs) == sorted(rids), \
+            "access-log rids do not match submitted rids"
+        for r in recs:
+            assert r["outcome"] in OUTCOMES, \
+                "illegal terminal outcome %r" % r["outcome"]
+            segs = (r["queue_s"] + r["prefill_s"] + r["decode_s"]
+                    + r["preempt_s"])
+            assert segs <= r["e2e_s"] + 1e-6, \
+                "segments %.6fs exceed e2e %.6fs in %r" \
+                % (segs, r["e2e_s"], r)
+        eng.shutdown()                   # raises on any block leak
+    finally:
+        if not was_enabled:
+            pt.observability.disable()
     print("serve_smoke: %d requests, %d steps, parity OK, "
-          "1 ragged compile, pool drained" % (len(prompts), steps))
+          "1 ragged compile, access log intact, pool drained"
+          % (len(prompts), steps))
     return 0
 
 
