@@ -1067,7 +1067,13 @@ def _multichip_result():
         Pipeline1F1BPass, StagedProgram)
     from paddle_tpu.distributed.pipeline import (
         CompiledPipeline, overlap_bucket_bytes, ring_impl)
+    from paddle_tpu.observability import profiler as _prof
 
+    # profiling on for the whole leg (child process, state is ours):
+    # the PP/DP overlap notes fire at trace time during warmup, the TP
+    # note during the tp_overlap sub-bench, and the fenced attribution
+    # step at the end reads them all
+    _prof.enable_profiling("on")
     dev = jax.devices()[0]
     on_tpu = dev.platform == "tpu"
     n_dev = len(jax.devices())
@@ -1218,6 +1224,32 @@ def _multichip_result():
     tps_host = gb * seq * iters / el_host
     peak, peak_known = _peak_flops(dev)
     mfu = tps * fpt / (peak * S) if peak else 0.0
+
+    # TP overlap sub-bench first: it fires the profiler's "tp" ring
+    # note, so the overlap report below covers all three mechanisms
+    tp_overlap = _tp_overlap_result(on_tpu)
+
+    # ---- profiled attribution step: one more compiled step, device-
+    # fenced between dispatch and drain so the profiler attributes wall
+    # time to phases. Runs OUTSIDE the timed windows.
+    _prof.configure(flops_per_step=float(fpt) * gb * seq,
+                    tokens_per_step=gb * seq,
+                    peak_flops=(peak * S) if peak else 0.0)
+    rec = _prof.StepRecord(iters + 1)
+    rec.mark("data_wait")                     # batch already resident
+    loss_prof = pipe.step(ids, labels)
+    rec.mark("dispatch")
+    jax.block_until_ready(loss_prof)
+    rec.mark("device")
+    prof_rep = rec.close(tokens=gb * seq)
+    segs = prof_rep["segments"]
+    wall = prof_rep["wall_s"]
+    # the tentpole invariant, asserted on the smoke arm: phase segments
+    # sum to the measured step time exactly (fp telescoping only)
+    assert abs(sum(segs.values()) - wall) <= 1e-9 + 1e-6 * wall, \
+        f"attribution segments {sum(segs.values())} != wall {wall}"
+    overlap = _prof.overlap_report()
+
     metric = ("multichip_pp_train_tokens_per_s_chip" if on_tpu
               else "multichip_pp_tokens_per_s_cpu_smoke")
     res = {
@@ -1236,13 +1268,24 @@ def _multichip_result():
             "speedup_vs_host": round(el_host / el_dev, 3),
             "pp_bucket_mb": overlap_bucket_bytes() / float(1 << 20),
             "compiles": pipe.trace_count,
-            "tp_overlap": _tp_overlap_result(on_tpu),
+            "tp_overlap": tp_overlap,
+            "attribution": {
+                "step_mfu": round(prof_rep["mfu"], 4),
+                "wall_ms": round(wall * 1e3, 4),
+                "segments_ms": {k: round(v * 1e3, 4)
+                                for k, v in segs.items()},
+            },
+            "overlap_efficiency": {
+                m: round(o["efficiency"], 4)
+                for m, o in sorted(overlap.items())
+            },
         },
     }
     if not peak_known:
         res["extra"]["peak_flops_assumed_v5e"] = True
-    # contract checks: one trace total, and both legs computed the same
-    # first-step loss from identical init params
+    # contract checks: one trace total (the profiled extra step must
+    # NOT have retraced), and both legs computed the same first-step
+    # loss from identical init params
     assert pipe.trace_count == 1, \
         f"compiled pipeline retraced: {pipe.trace_count}"
     assert abs(loss_dev - loss_host) <= 2e-3 * max(1.0, abs(loss_host)), \
@@ -1277,7 +1320,11 @@ def _bench_multichip():
               file=sys.stderr)
         return proc.returncode or 1
     print(lines[-1])
-    return 0
+    try:
+        child_result = json.loads(lines[-1])
+    except json.JSONDecodeError:
+        return 0
+    return _maybe_perfdiff(child_result)
 
 
 def _bench_multichip_child():
@@ -1363,6 +1410,33 @@ def main():
                            "chunks": _ov.default_chunks()}
     extra["fusion"] = _bench_fusion(pt, on_tpu)
 
+    # flops cross-check (the "MFU is never silently wrong" promise):
+    # XLA's own HLO cost model vs the 6N analytic model the headline
+    # MFU divides by. >10% disagreement means one of them is lying —
+    # flagged on stderr, never silent.
+    try:
+        ca = step.lower(ids, labels).cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        xla_flops = float(ca.get("flops", 0.0)) if isinstance(ca, dict) \
+            else 0.0
+    except Exception:
+        xla_flops = 0.0
+    if xla_flops > 0:
+        model_flops = float(flops_per_token) * batch * seq
+        div = abs(xla_flops - model_flops) / model_flops
+        extra["flops_check"] = {
+            "model": model_flops, "xla": xla_flops,
+            "divergence": round(div, 4),
+        }
+        from paddle_tpu.observability import profiler as _prof
+        _prof.flops_divergence(model_flops, xla_flops)
+        if div > 0.10:
+            print(f"bench: WARNING: analytic 6N FLOPs model diverges "
+                  f"{div:.1%} from XLA cost analysis "
+                  f"(model={model_flops:.3e}, xla={xla_flops:.3e}) — "
+                  f"headline MFU is suspect", file=sys.stderr)
+
     if on_tpu and not small:
         # streaming variant: fresh per-step batches via run_steps_stream
         # (genuine-training throughput next to the same-batch headline)
@@ -1406,14 +1480,57 @@ def main():
         extra["decode"] = _bench_decode(pt, cfg2)
         extra["moe"] = _bench_moe()
 
-    print(json.dumps({
+    result = {
         "metric": metric,
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/s",
         # mfu is a fraction (0..1); north star is 0.45 (BASELINE.json)
         "vs_baseline": round(mfu / 0.45, 4) if peak else 0.0,
         "extra": extra,
-    }))
+    }
+    print(json.dumps(result))
+    return _maybe_perfdiff(result)
+
+
+def _maybe_perfdiff(result: dict) -> int:
+    """Optional regression gate: ``--diff BASE.json`` (or env
+    ``PADDLE_TPU_PERFDIFF_BASE``) compares the just-printed result
+    against a baseline via tools/perfdiff.py and makes the bench exit
+    nonzero on a regression beyond the noise bounds."""
+    base = None
+    if "--diff" in sys.argv:
+        i = sys.argv.index("--diff")
+        if i + 1 >= len(sys.argv):
+            print("bench: --diff needs a baseline JSON path",
+                  file=sys.stderr)
+            return 2
+        base = sys.argv[i + 1]
+    base = base or os.environ.get("PADDLE_TPU_PERFDIFF_BASE")
+    if not base:
+        return 0
+    import importlib.util
+
+    pd_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "tools", "perfdiff.py")
+    spec = importlib.util.spec_from_file_location("_perfdiff", pd_path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    try:
+        old = mod.load_doc(base)
+    except ValueError as e:
+        print(f"bench: perfdiff baseline unusable: {e}", file=sys.stderr)
+        return 2
+    regressions, notes = mod.compare(old, result, mod.DEFAULT_NOISE)
+    for n in notes:
+        print(f"perfdiff ok: {n}", file=sys.stderr)
+    for r in regressions:
+        print(f"perfdiff REGRESSION: {r}", file=sys.stderr)
+    if regressions:
+        print(f"bench: {len(regressions)} regression(s) vs {base}",
+              file=sys.stderr)
+        return 1
+    print(f"bench: no regression vs {base}", file=sys.stderr)
+    return 0
 
 
 if __name__ == "__main__":

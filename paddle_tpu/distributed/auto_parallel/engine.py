@@ -401,8 +401,14 @@ class Engine:
         """Per-compilation accounting (observability/xla_cost.py): AOT
         cost_analysis of the freshly built train step, keyed by
         executable, plus the schedule-analytic pipeline bubble when
-        pp>1. Telemetry-enabled path only."""
+        pp>1. When step profiling is on, also installs the profiler's
+        step cost model (FLOPs/tokens/optimizer split from the same
+        lowering), cross-checks the 6N analytic FLOPs model against
+        XLA's count, and stamps the "build" memory-ledger phase.
+        Runs when telemetry OR profiling is enabled."""
         from ... import observability as _obs
+        from ...observability import memory as _memory
+        from ...observability import profiler as _prof
 
         st = self.strategy
         pp = int(getattr(st.pipeline, "pp_degree", 1))
@@ -413,15 +419,40 @@ class Engine:
             bubble = 0.0 if mode in ("ZBH1", "ZeroBubble") else \
                 (pp - 1) / (micro * vpp + pp - 1)
             _obs.registry.gauge("engine.pp_bubble_fraction").set(bubble)
+        xla_flops = None
         if hasattr(self._step, "lower"):
             try:
                 # Lowered.cost_analysis() runs XLA's HLO cost model
                 # without building a second executable, so this never
                 # duplicates the train-step compilation.
-                _obs.record_cost_analysis(
-                    "engine.train_step", self._step.lower(*batch))
+                lowered = self._step.lower(*batch)
+                _obs.record_cost_analysis("engine.train_step", lowered)
+                ca = lowered.cost_analysis()
+                if isinstance(ca, (list, tuple)):
+                    ca = ca[0] if ca else {}
+                if isinstance(ca, dict):
+                    xla_flops = float(ca.get("flops", 0.0)) or None
             except Exception:
                 pass  # cost model unavailable on this backend
+        if not _prof.profiling_enabled():
+            return
+        _memory.note_phase("build")
+        tokens = self._batch_tokens(batch)
+        n_params = 0
+        for arr in getattr(self._step, "param_arrays", ()) or ():
+            sz = getattr(arr, "size", None)
+            if sz:
+                n_params += int(sz)
+        # 6N fwd+bwd FLOPs/token; the optimizer's elementwise update
+        # (~Adam) is a per-param constant, kept as a separate split so
+        # the device segment can sub-attribute it
+        model_flops = 6.0 * n_params * tokens if n_params else None
+        _prof.configure(
+            flops_per_step=xla_flops or model_flops or 0.0,
+            tokens_per_step=tokens,
+            optimizer_flops=18.0 * n_params if n_params else 0.0)
+        if model_flops:
+            _prof.flops_divergence(model_flops, xla_flops)
 
     @staticmethod
     def _batch_tokens(batch) -> int:
@@ -507,9 +538,11 @@ class Engine:
                     batch = batch if isinstance(batch, (list, tuple)) \
                         else (batch,)
                     if self._step is None:
+                        from ...observability import profiler as _prof
+
                         with _obs.span("engine.build"):
                             self._build(batch)
-                        if _obs.enabled():
+                        if _obs.enabled() or _prof.profiling_enabled():
                             self._record_build_telemetry(batch)
                     if not restored:
                         restored = True
@@ -586,11 +619,18 @@ class Engine:
         return self.history
 
     def _run_step(self, batch, global_step: int, check_loss: bool):
-        """One training step + history/telemetry bookkeeping."""
+        """One training step + history/telemetry bookkeeping. On a
+        profiler-sampled step the dispatch and the device drain are
+        fenced separately (``block_until_ready`` between them), so the
+        step record attributes wall time to dispatch vs. device work —
+        the d2h loss read alone cannot tell those apart. Non-sampled
+        steps take the exact pre-profiler paths (zero extra fences)."""
         from ... import observability as _obs
         from ...observability import health as _health
+        from ...observability import profiler as _prof
 
-        if not _obs.enabled():
+        rec = _prof.begin_step(global_step)
+        if not _obs.enabled() and rec is None:
             loss = self._step(*batch)
             loss_f = float(np.asarray(loss._data))
             self.history["loss"].append(loss_f)
@@ -603,21 +643,34 @@ class Engine:
         t0 = _time.perf_counter()
         with _obs.span("engine.step",
                        args={"step": global_step}):
-            loss = self._step(*batch)
-            loss_f = float(np.asarray(loss._data))  # d2h barrier
+            if rec is not None:
+                rec.mark("data_wait")
+                loss = self._step(*batch)
+                rec.mark("dispatch")
+                import jax as _jax
+
+                _jax.block_until_ready(loss._data)  # device fence
+                rec.mark("device")
+                loss_f = float(np.asarray(loss._data))
+            else:
+                loss = self._step(*batch)
+                loss_f = float(np.asarray(loss._data))  # d2h barrier
         dt = _time.perf_counter() - t0
         self.history["loss"].append(loss_f)
-        reg = _obs.registry
-        reg.histogram("engine.step_time").observe(dt)
-        reg.counter("engine.steps").inc()
-        if dt > 0:
-            reg.gauge("engine.tokens_per_s").set(
-                self._batch_tokens(batch) / dt)
-        reg.gauge("engine.loss").set(loss_f)
-        _obs.flight_recorder.record("engine.step",
-                                    step=global_step,
-                                    loss=loss_f, dur_s=dt)
-        _obs.sample_device_memory()
+        if rec is not None:
+            rec.close(tokens=self._batch_tokens(batch))
+        if _obs.enabled():
+            reg = _obs.registry
+            reg.histogram("engine.step_time").observe(dt)
+            reg.counter("engine.steps").inc()
+            if dt > 0:
+                reg.gauge("engine.tokens_per_s").set(
+                    self._batch_tokens(batch) / dt)
+            reg.gauge("engine.loss").set(loss_f)
+            _obs.flight_recorder.record("engine.step",
+                                        step=global_step,
+                                        loss=loss_f, dur_s=dt)
+            _obs.sample_device_memory()
         if check_loss:
             _health.record_step(loss_f, source="loss",
                                 step=global_step)

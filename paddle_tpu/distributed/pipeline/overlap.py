@@ -83,6 +83,14 @@ def bucket_taps(leaves: Sequence, axes: Axes,
     whose gradients are computed by in-body AD.
     """
     buckets = make_buckets(leaves, bucket_bytes)
+    from ...observability import profiler as _profiler
+
+    if _profiler.profiling_enabled():  # ptlint: disable=jit-purity
+        # trace-time geometry note for the DP overlap estimator: every
+        # bucket's psum overlaps the remaining backward except the last
+        total = sum(int(leaf.size) * jnp.dtype(leaf.dtype).itemsize
+                    for leaf in leaves)
+        _profiler.note_bucket_overlap("dp", total, len(buckets))
     out = list(leaves)
     for idx in buckets:
         synced = _bucket_sync(axes, *[out[i] for i in idx])

@@ -159,6 +159,16 @@ class CompiledPipeline:
 
             bspec = jax.eval_shape(
                 embed, jax.ShapeDtypeStruct(xs.shape[1:], xs.dtype))
+            from ...observability import profiler as _profiler
+
+            if _profiler.profiling_enabled():  # ptlint: disable=jit-purity
+                # trace-time geometry note: one boundary activation hops
+                # the ring per tick over M+S-1 ticks; the fill/drain
+                # bubble's S-1 hops are the exposed ones
+                hop = bspec.dtype.itemsize
+                for d in bspec.shape:
+                    hop *= int(d)
+                _profiler.note_pipeline_overlap("pp", hop, M, S)
 
             def tick(carry, t):
                 y_prev, acc = carry

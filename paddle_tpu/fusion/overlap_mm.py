@@ -151,6 +151,24 @@ def _note_chunks(chunks: int) -> None:
         _obs.registry.gauge("tp.overlap_chunks").set(int(chunks))  # ptlint: disable=jit-purity (static chunk count)
 
 
+def _note_ring_geometry(op: str, x, w, size: int) -> None:
+    """Trace-time TP overlap-geometry note for the step profiler: each
+    of the ring's ``size-1`` permute hops moves one x-sized buffer and
+    rides inside one per-block GEMM. Static shapes only — never touches
+    tracer values."""
+    from ..observability import profiler as _profiler
+
+    if not _profiler.profiling_enabled() or size <= 1:  # ptlint: disable=jit-purity (static profiling gate)
+        return
+    elems = 1
+    for d in x.shape:
+        elems *= int(d)
+    hop_bytes = elems * jnp.dtype(x.dtype).itemsize
+    gemm_flops = 2.0 * elems * int(w.shape[-1])  # ptlint: disable=jit-purity (static weight shape)
+    _profiler.note_ring_overlap("tp", hop_bytes, gemm_flops, size - 1,
+                                detail={"op": op})
+
+
 # ------------------------------------------------------- chunked local GEMM
 def _clamp_chunks(t: int, chunks: int) -> int:
     # largest divisor of the token dim not exceeding the requested count —
@@ -268,6 +286,7 @@ def _agmm_impl(x, w, axis_name, size, chunks, quant_mode, use_pallas):
     rides inside a GEMM. Output holds ALL token blocks (gathered) against
     this rank's weight columns."""
     t = x.shape[0]
+    _note_ring_geometry("agmm", x, w, size)
     r = jax.lax.axis_index(axis_name)
     out_dtype = jnp.result_type(x.dtype, w.dtype)
     out = jnp.zeros((t * size,) + x.shape[1:-1] + (w.shape[-1],), out_dtype)
@@ -294,6 +313,7 @@ def _mmrs_impl(x, w, axis_name, size, chunks, quant_mode, use_pallas):
     the same rank order as ``psum_scatter(matmul(x, w))``."""
     big_t = x.shape[0]
     t = big_t // size
+    _note_ring_geometry("mmrs", x, w, size)
     r = jax.lax.axis_index(axis_name)
 
     def partial(block_idx):

@@ -14,6 +14,7 @@ analog of the reference executor's inplace/buffer-reuse passes.
 """
 from __future__ import annotations
 
+import time as _time
 from typing import Callable, Optional, Sequence
 
 import jax
@@ -47,6 +48,38 @@ def _count_jit(miss: bool, cause: str = "first_call"):
                                     cause=cause)
     else:
         reg.counter("jit.cache_hit", tags={"site": "train_step"}).inc()
+
+
+def _ledger_observe(site: str, args):
+    """Compile-ledger call observation (observability/compile_ledger):
+    when step profiling is on, diff this call's argument signature
+    against the site's last one so a cache miss carries its CAUSE
+    (which arg's shape/dtype/static value changed). Returns
+    ``(miss, cause)``; ``(False, None)`` with profiling off — the
+    zero-cost path does no signature work at all."""
+    from ..observability import compile_ledger as _ledger
+    from ..observability import profiler as _profiler
+
+    if not _profiler.profiling_enabled():
+        return False, None
+    return _ledger.observe_call(site, _ledger.signature(args))
+
+
+def _ledger_compile(site: str, duration_s, cause, jit_kwargs=None):
+    """Record one ledger compile. ``duration_s`` is the dispatch wall
+    time of the missing call — on a miss, trace+compile run
+    synchronously before the async dispatch returns, so it is compile
+    time to first order."""
+    from ..observability import compile_ledger as _ledger
+
+    donated = None
+    if jit_kwargs:
+        dn = jit_kwargs.get("donate_argnums")
+        if dn is not None:
+            donated = len(dn) if isinstance(dn, (tuple, list)) else 1
+    _ledger.note_compile(site, duration_s=duration_s,
+                         cause=cause or "first_call",
+                         donated_args=donated)
 
 
 class ChunkPrefetcher:
@@ -390,11 +423,17 @@ class TrainStep:
     def __call__(self, *batch):
         _count_jit(miss=False)
         arrays = self._prepare_batch(batch)
+        miss, cause = _ledger_observe("train_step", arrays)
         key = _rng.next_key()
         lr = jnp.asarray(self.optimizer.get_lr(), dtype=jnp.float32)
         with _obs.span("train.step", args={"n": 1}):
+            t0 = _time.perf_counter() if miss else 0.0
             out, self.param_arrays, self.opt_state = self._jitted(
                 key, lr, tuple(self.param_arrays), self.opt_state, *arrays)
+            if miss:
+                _ledger_compile("train_step",
+                                _time.perf_counter() - t0, cause,
+                                self._jit_kwargs)
         base = self._step_count
         self._step_count += 1
         # rebind model params to the fresh arrays: the old ones were donated
@@ -479,11 +518,18 @@ class TrainStep:
 
             self._multi_jitted[n] = jax.jit(multi, **self._jit_kwargs)
         arrays = self._prepare_batch(batch)
+        miss, cause = _ledger_observe("train_step.run_steps",
+                                      (n,) + arrays)
         keys = jnp.stack([_rng.next_key() for _ in range(n)])
         lr = jnp.asarray(self.optimizer.get_lr(), dtype=jnp.float32)
         with _obs.span("train.step", args={"n": n}):
+            t0 = _time.perf_counter() if miss else 0.0
             out, self.param_arrays, self.opt_state = self._multi_jitted[n](
                 keys, lr, tuple(self.param_arrays), self.opt_state, *arrays)
+            if miss:
+                _ledger_compile("train_step.run_steps",
+                                _time.perf_counter() - t0, cause,
+                                self._jit_kwargs)
         base = self._step_count
         self._step_count += n
         self.sync_params_to_model()
@@ -551,6 +597,8 @@ class TrainStep:
                                           *stream_specs)
             self._multi_jitted[cache_key] = jax.jit(multi, **kwargs)
         arrays = self._prepare_batch(stacked, leading_steps=n)
+        miss, cause = _ledger_observe("train_step.run_steps_stream",
+                                      (n,) + arrays)
         if lrs is not None:
             lrs = jnp.asarray(lrs, jnp.float32)
             if lrs.shape != (n,):
@@ -568,9 +616,14 @@ class TrainStep:
         keys = jnp.stack([_rng.next_key() for _ in range(n)])
         try:
             with _obs.span("train.step", args={"n": n, "stream": True}):
+                t0 = _time.perf_counter() if miss else 0.0
                 out, self.param_arrays, self.opt_state = self._multi_jitted[
                     cache_key](keys, lrs, tuple(self.param_arrays),
                                self.opt_state, *arrays)
+                if miss:
+                    _ledger_compile("train_step.run_steps_stream",
+                                    _time.perf_counter() - t0, cause,
+                                    self._jit_kwargs)
         except Exception:
             if snapshot is not None:
                 sched.set_state_dict(snapshot)
@@ -639,5 +692,34 @@ class TrainStep:
                                   self.opt_state, *arrays)
 
     def compile(self, *batch):
-        """AOT-lower for inspection/warmup without running."""
-        return self.lower(*batch).compile()
+        """AOT-lower for inspection/warmup without running. With step
+        profiling on, the compile lands in the compile ledger with its
+        exact duration (this is the one path where compile time is
+        directly measurable, not inferred from a missing dispatch) and
+        its XLA memory analysis feeds the memory ledger."""
+        from ..observability import profiler as _profiler
+
+        lowered = self.lower(*batch)
+        if not _profiler.profiling_enabled():
+            return lowered.compile()
+        t0 = _time.perf_counter()
+        compiled = lowered.compile()
+        dur = _time.perf_counter() - t0
+        hlo_bytes = None
+        try:
+            ma = compiled.memory_analysis()
+            hlo_bytes = int(
+                getattr(ma, "generated_code_size_in_bytes", 0)) or None
+        except Exception:
+            pass
+        from ..observability import compile_ledger as _ledger
+        from ..observability import xla_cost as _xla_cost
+
+        dn = self._jit_kwargs.get("donate_argnums")
+        _ledger.note_compile(
+            "train_step.aot", duration_s=dur, cause="aot_compile",
+            hlo_bytes=hlo_bytes,
+            donated_args=(len(dn) if isinstance(dn, (tuple, list))
+                          else 1 if dn is not None else None))
+        _xla_cost.record_memory_analysis("train_step.aot", compiled)
+        return compiled

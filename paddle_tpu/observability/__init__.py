@@ -48,6 +48,7 @@ from .exporters import (  # noqa: F401
     prometheus_text,
     snapshot,
 )
+from . import memory  # noqa: F401
 from .memory import sample_device_memory  # noqa: F401
 from . import tracing  # noqa: F401
 from .tracing import (  # noqa: F401
@@ -67,6 +68,7 @@ from .xla_cost import (  # noqa: F401
     compiled_costs,
     derive_mfu,
     record_cost_analysis,
+    record_memory_analysis,
 )
 from . import metrics_schema  # noqa: F401
 from .metrics_schema import METRICS, MetricSpec  # noqa: F401
@@ -77,6 +79,15 @@ from . import slo  # noqa: F401
 from .slo import Objective, SLOEngine  # noqa: F401
 from . import request_log  # noqa: F401
 from .request_log import RequestLog, RequestTimeline  # noqa: F401
+from . import profiler  # noqa: F401
+from .profiler import (  # noqa: F401
+    StepRecord,
+    begin_step,
+    disable_profiling,
+    enable_profiling,
+    profiling_enabled,
+)
+from . import compile_ledger  # noqa: F401
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "Stopwatch",
@@ -94,4 +105,7 @@ __all__ = [
     "Ewma", "Windows",
     "slo", "Objective", "SLOEngine",
     "request_log", "RequestLog", "RequestTimeline",
+    "memory", "record_memory_analysis",
+    "profiler", "StepRecord", "begin_step", "profiling_enabled",
+    "enable_profiling", "disable_profiling", "compile_ledger",
 ]

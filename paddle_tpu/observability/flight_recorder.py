@@ -123,6 +123,11 @@ def dump_debug_bundle(dir_path: Optional[str] = None,
     - ``env.json``              — env vars / versions / argv / reason
     - ``request_log_tail.jsonl``— last closed serving access-log records
     - ``slo_windows.json``      — rolling-window snapshots + SLO reports
+    - ``profiler_report.json``  — sampled-step attribution (incl. the
+      LAST sampled step — a hang bundle's best breadcrumb), overlap
+      estimates, memory phase ledger, flops cross-check
+    - ``compile_ledger.json``   — per-jit-site compile counts/durations
+      with recompile-cause attribution
 
     Every section is written best-effort: one broken exporter must not
     cost the rest of the bundle. Returns the bundle directory."""
@@ -179,6 +184,25 @@ def dump_debug_bundle(dir_path: Optional[str] = None,
         if wins:
             _write_json(os.path.join(d, "slo_windows.json"),
                         {"windows": wins, "slo": _slo.reports_all()})
+    except Exception:
+        pass
+    try:
+        from . import profiler as _profiler
+
+        rep = _profiler.report()
+        # always write the section when profiling ran at least once;
+        # an all-empty off-mode report is noise, not evidence
+        if rep.get("last") or rep.get("overlap") \
+                or rep.get("mode") != "off":
+            _write_json(os.path.join(d, "profiler_report.json"), rep)
+    except Exception:
+        pass
+    try:
+        from . import compile_ledger as _ledger
+
+        led = _ledger.report()
+        if led.get("sites"):
+            _write_json(os.path.join(d, "compile_ledger.json"), led)
     except Exception:
         pass
     return d

@@ -1,14 +1,30 @@
 """Device memory telemetry: PJRT ``jax.Device.memory_stats()`` with peak
 tracking, falling back to the native allocator counters
 (native/alloc_stats.cc — the analog of phi/core/memory/stats.h) on
-backends that expose no PJRT memory stats (e.g. CPU)."""
+backends that expose no PJRT memory stats (e.g. CPU).
+
+The **memory ledger** half (:func:`note_phase` / :func:`phase_report`)
+attributes HBM watermarks to training phases: the profiler and the
+engine call ``note_phase("build")`` / ``note_phase("step_begin")`` at
+phase boundaries, and the ledger keeps per-phase live-bytes plus a
+max-tracked peak, exported as ``prof.mem_phase_bytes`` /
+``prof.mem_peak_bytes`` and the ``memory_phases`` section of the
+profiler bundle report. Phase sampling runs when EITHER telemetry or
+step profiling is on (bundles need the ledger even in metrics-off
+profiling runs)."""
 from __future__ import annotations
 
-from typing import Optional
+import threading
+from typing import Dict, Optional
 
 from .registry import enabled, registry
 
-__all__ = ["sample_device_memory"]
+__all__ = ["sample_device_memory", "note_phase", "phase_report",
+           "reset_phases"]
+
+_phase_lock = threading.Lock()
+# phase -> {"bytes_in_use", "peak_bytes_in_use", "samples"}
+_phases: Dict[str, dict] = {}
 
 
 def _pjrt_stats() -> Optional[dict]:
@@ -49,3 +65,41 @@ def sample_device_memory() -> Optional[dict]:
     registry.gauge("device.memory_peak_bytes").set_max(
         stats["peak_bytes_in_use"])
     return stats
+
+
+def note_phase(phase: str) -> Optional[dict]:
+    """Sample device memory and attribute it to a training phase in the
+    memory ledger. Active when telemetry OR step profiling is enabled
+    (registry gauges additionally respect the telemetry gate); returns
+    the sample or None when both gates are off."""
+    from . import profiler as _profiler
+
+    if not enabled() and not _profiler.profiling_enabled():
+        return None
+    stats = _pjrt_stats() or _native_stats()
+    with _phase_lock:
+        e = _phases.get(phase)
+        if e is None:
+            e = _phases[phase] = {"bytes_in_use": 0,
+                                  "peak_bytes_in_use": 0, "samples": 0}
+        e["bytes_in_use"] = stats["bytes_in_use"]
+        e["peak_bytes_in_use"] = max(e["peak_bytes_in_use"],
+                                     stats["peak_bytes_in_use"])
+        e["samples"] += 1
+    registry.gauge("prof.mem_phase_bytes",
+                   tags={"phase": phase}).set(stats["bytes_in_use"])
+    registry.gauge("prof.mem_peak_bytes").set_max(
+        stats["peak_bytes_in_use"])
+    return stats
+
+
+def phase_report() -> Dict[str, dict]:
+    """Per-phase HBM watermark ledger (copy): live bytes at the last
+    sample, max peak across samples, sample count."""
+    with _phase_lock:
+        return {k: dict(v) for k, v in _phases.items()}
+
+
+def reset_phases() -> None:
+    with _phase_lock:
+        _phases.clear()

@@ -449,6 +449,52 @@ METRICS = {
     "ps.push_time": MetricSpec(
         "histogram", "s", "whole worker-side push_sparse latency "
         "(all shards, retries and failover included)", TIME_BUCKETS),
+    # ---- training step profiler (observability/profiler.py)
+    "prof.steps_sampled": MetricSpec(
+        "counter", "steps", "train steps device-fenced by the sampled "
+        "step profiler (PADDLE_TPU_PROFILE gate)"),
+    "prof.step_time": MetricSpec(
+        "histogram", "s", "wall time of sampled (device-fenced) train "
+        "steps", TIME_BUCKETS),
+    "prof.mfu": MetricSpec(
+        "gauge", "fraction", "rolling model-FLOPs utilization over "
+        "sampled steps (flops_per_step / wall / peak_flops)"),
+    "prof.tokens_per_s": MetricSpec(
+        "gauge", "tokens/s", "rolling token throughput over sampled "
+        "steps"),
+    "prof.phase_frac": MetricSpec(
+        "gauge", "fraction", "share of the last sampled step's wall "
+        "time attributed to the phase (segments sum to 1)",
+        tags=("phase",)),
+    "prof.overlap_efficiency": MetricSpec(
+        "gauge", "fraction", "estimated comm time hidden / total comm "
+        "time for the overlap mechanism (pp ring, tp in-loop ring, dp "
+        "bucket psum)", tags=("mechanism",)),
+    "prof.comm_hidden_s": MetricSpec(
+        "gauge", "s", "estimated per-step communication seconds hidden "
+        "under compute, per mechanism", tags=("mechanism",)),
+    "prof.comm_exposed_s": MetricSpec(
+        "gauge", "s", "estimated per-step communication seconds on the "
+        "critical path (not overlapped), per mechanism",
+        tags=("mechanism",)),
+    "prof.flops_divergence": MetricSpec(
+        "gauge", "fraction", "relative disagreement between the 6N "
+        "analytic FLOPs model and XLA cost analysis "
+        "(|xla - model| / model; bench warns above 0.10)"),
+    "prof.compiles": MetricSpec(
+        "counter", "compiles", "compile-ledger compiles per jit site "
+        "with recompile-cause attribution (which arg's "
+        "shape/dtype/static value changed)", tags=("site", "cause")),
+    "prof.compile_time": MetricSpec(
+        "histogram", "s", "trace+compile duration of compile-ledger "
+        "misses (measured at dispatch for jit, AOT for lowered "
+        "programs)", TIME_BUCKETS),
+    "prof.mem_phase_bytes": MetricSpec(
+        "gauge", "bytes", "device HBM live bytes sampled at the named "
+        "training phase boundary (memory ledger)", tags=("phase",)),
+    "prof.mem_peak_bytes": MetricSpec(
+        "gauge", "bytes", "running peak of device HBM peak_bytes_in_use "
+        "across all memory-ledger samples"),
 }
 
 
@@ -516,6 +562,13 @@ SPANS = {
                   "Perfetto",
     "slo.evaluate": "one SLOEngine.evaluate() pass over the rolling "
                     "windows (all objectives)",
+    "prof.step": "one sampled (device-fenced) train step, synthesized "
+                 "at close by the step profiler via "
+                 "tracing.record_complete (attribution segments + mfu "
+                 "in args)",
+    "prof.phase": "one phase bar inside a sampled step (data_wait / "
+                  "dispatch / device / host_stall) — children of the "
+                  "prof.step bar in Perfetto",
 }
 
 

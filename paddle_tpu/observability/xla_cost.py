@@ -12,7 +12,8 @@ from typing import Dict, Optional
 
 from .registry import enabled, registry
 
-__all__ = ["record_cost_analysis", "compiled_costs", "derive_mfu"]
+__all__ = ["record_cost_analysis", "record_memory_analysis",
+           "compiled_costs", "derive_mfu"]
 
 _lock = threading.Lock()
 _costs: Dict[str, dict] = {}
@@ -50,6 +51,38 @@ def record_cost_analysis(name: str, compiled) -> Optional[dict]:
     with _lock:
         _costs[name] = entry
     return entry
+
+
+def record_memory_analysis(name: str, compiled) -> Optional[dict]:
+    """Fold XLA ``memory_analysis()`` (argument/output/temp/generated
+    code sizes) into the executable's cost entry — the compile-time
+    half of the memory ledger. Best-effort: backends without a memory
+    analysis (CPU, older jaxlibs) return None and the entry is left
+    untouched. Requires a ``jax.stages.Compiled`` (Lowered has no
+    executable to analyze)."""
+    if not enabled():
+        return None
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:
+        return None
+    if ma is None:
+        return None
+    mem = {}
+    for field in ("argument_size_in_bytes", "output_size_in_bytes",
+                  "temp_size_in_bytes", "generated_code_size_in_bytes",
+                  "alias_size_in_bytes"):
+        v = getattr(ma, field, None)
+        if v is not None:
+            mem[field] = int(v)
+    if not mem:
+        return None
+    with _lock:
+        entry = _costs.setdefault(
+            name, {"flops": 0.0, "bytes_accessed": 0.0})
+        entry["memory"] = mem
+        out = dict(entry)
+    return out
 
 
 def compiled_costs() -> Dict[str, dict]:

@@ -24,6 +24,17 @@ def pytest_configure(config):
         "slow: excluded from the tier-1 run (-m 'not slow')")
 
 
+@pytest.hookimpl(trylast=True)
+def pytest_runtest_logreport(report):
+    # CI wraps the suite in a hard timeout; with stdout block-buffered
+    # (pipe/file), a killed run silently drops up to 8 KB of progress
+    # output. Flush after every test so the log reflects actual progress.
+    import sys
+
+    sys.stdout.flush()
+    sys.stderr.flush()
+
+
 @pytest.fixture(autouse=True)
 def _seed():
     import paddle_tpu

@@ -1,0 +1,442 @@
+"""Sampled step profiler + compile ledger + memory ledger
+(observability/profiler.py, compile_ledger.py, memory.py).
+
+The load-bearing properties:
+
+* segments-sum-to-step-time invariant, by construction, including a
+  preempted/retried step (re-marked phases accumulate);
+* recompile-CAUSE attribution — a deliberate shape change at a jit
+  site names the offending argument;
+* overlap-efficiency math on synthetic hidden/exposed schedules;
+* zero-cost-when-disabled, trace-counter-proven: a 3-step train loop
+  under ``PADDLE_TPU_PROFILE=off`` gets zero profiler callbacks and
+  zero extra retraces.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import observability as obs
+from paddle_tpu.observability import compile_ledger, memory, profiler
+from paddle_tpu.observability.windows import ManualClock
+
+
+@pytest.fixture
+def profiling():
+    """Profiling on with clean profiler/ledger state; off + clean after."""
+    profiler.reset()
+    compile_ledger.reset()
+    profiler.enable_profiling("on")
+    yield profiler
+    profiler.disable_profiling()
+    profiler.reset()
+    compile_ledger.reset()
+
+
+@pytest.fixture
+def telemetry():
+    obs.registry.reset()
+    obs.enable()
+    yield obs.registry
+    obs.disable()
+    obs.registry.reset()
+
+
+def _tiny_model():
+    cfg = pt.models.gpt_tiny(dropout=0.0, attention_dropout=0.0)
+    model = pt.models.GPTForCausalLM(cfg)
+    return cfg, model
+
+
+def _batch(cfg, b, s, seed=0):
+    rng = np.random.default_rng(seed)
+    ids = pt.to_tensor(rng.integers(0, cfg.vocab_size, (b, s)),
+                       dtype="int64")
+    return ids, ids
+
+
+# ---------------------------------------------------------- the invariant
+class TestStepRecordInvariant:
+    def test_segments_sum_to_wall_exactly(self, profiling):
+        ck = ManualClock(100.0)
+        rec = profiler.StepRecord(7, clock=ck, epoch=0.0)
+        ck.advance(0.030)
+        rec.mark("data_wait")
+        ck.advance(0.002)
+        rec.mark("dispatch")
+        ck.advance(0.400)
+        rec.mark("device")
+        ck.advance(0.010)          # trailing host work -> host_stall
+        rep = rec.close(tokens=512)
+        segs = rep["segments"]
+        assert set(segs) == set(profiler.PHASES)
+        assert sum(segs.values()) == pytest.approx(rep["wall_s"],
+                                                   abs=1e-12)
+        assert rep["wall_s"] == pytest.approx(0.442)
+        assert segs["data_wait"] == pytest.approx(0.030)
+        assert segs["dispatch"] == pytest.approx(0.002)
+        assert segs["host_stall"] == pytest.approx(0.010)
+        # nothing configured: all device time is compute
+        assert segs["device_compute"] == pytest.approx(0.400)
+        assert segs["collective_exposed"] == 0.0
+        assert segs["optimizer"] == 0.0
+        assert rep["tokens_per_s"] == pytest.approx(512 / 0.442)
+
+    def test_retried_step_accumulates_and_still_sums(self, profiling):
+        # a preempted step re-dispatches: phases are marked TWICE and
+        # accumulate; the invariant must survive the retry
+        ck = ManualClock()
+        rec = profiler.StepRecord(0, clock=ck, epoch=0.0)
+        ck.advance(0.01)
+        rec.mark("data_wait")
+        ck.advance(0.05)
+        rec.mark("dispatch")       # first attempt dies
+        ck.advance(0.02)
+        rec.mark("data_wait")      # refetch
+        ck.advance(0.07)
+        rec.mark("dispatch")       # retry
+        ck.advance(0.30)
+        rec.mark("device")
+        rep = rec.close()
+        segs = rep["segments"]
+        assert segs["data_wait"] == pytest.approx(0.03)
+        assert segs["dispatch"] == pytest.approx(0.12)
+        assert sum(segs.values()) == pytest.approx(rep["wall_s"],
+                                                   abs=1e-12)
+
+    def test_device_subsplit_exposed_and_optimizer(self, profiling):
+        # 100 GFLOP step, 20% of it optimizer; 0.05 s exposed comm noted
+        profiler.configure(flops_per_step=80e9, optimizer_flops=20e9,
+                           tokens_per_step=1024, peak_flops=1e12)
+        profiler.note_overlap("pp", hidden_s=0.0, exposed_s=0.05)
+        ck = ManualClock()
+        rec = profiler.StepRecord(1, clock=ck, epoch=0.0)
+        rec.mark("data_wait")
+        ck.advance(0.01)
+        rec.mark("dispatch")
+        ck.advance(0.50)
+        rec.mark("device")
+        rep = rec.close(tokens=1024)
+        segs = rep["segments"]
+        assert segs["collective_exposed"] == pytest.approx(0.05)
+        # optimizer share of device time via the configured flop split
+        assert segs["optimizer"] == pytest.approx(0.5 * 0.2)
+        assert segs["device_compute"] == pytest.approx(0.5 - 0.05 - 0.1)
+        assert sum(segs.values()) == pytest.approx(rep["wall_s"],
+                                                   abs=1e-12)
+        # mfu from the configured cost model against the fenced wall
+        assert rep["mfu"] == pytest.approx(80e9 / rep["wall_s"] / 1e12)
+
+    def test_exposed_estimate_clamped_to_device_time(self, profiling):
+        profiler.note_overlap("tp", hidden_s=0.0, exposed_s=99.0)
+        ck = ManualClock()
+        rec = profiler.StepRecord(2, clock=ck, epoch=0.0)
+        rec.mark("dispatch")
+        ck.advance(0.1)
+        rec.mark("device")
+        rep = rec.close()
+        segs = rep["segments"]
+        assert segs["collective_exposed"] == pytest.approx(0.1)
+        assert segs["device_compute"] == pytest.approx(0.0, abs=1e-12)
+        assert sum(segs.values()) == pytest.approx(rep["wall_s"],
+                                                   abs=1e-12)
+
+
+# ------------------------------------------------------- sampling & gates
+class TestSamplingGate:
+    def test_off_is_none_and_counts_nothing(self):
+        profiler.reset()
+        profiler.disable_profiling()
+        assert profiler.begin_step(0) is None
+        assert not profiler.should_sample(0)
+        assert profiler.debug_invocations() == 0
+
+    def test_sample_every_n(self, profiling):
+        profiler.enable_profiling("sample:10")
+        assert profiler.profile_mode() == "sample"
+        assert profiler.sample_every() == 10
+        picked = [s for s in range(25) if profiler.should_sample(s)]
+        assert picked == [0, 10, 20]
+        assert profiler.begin_step(3) is None
+        assert profiler.begin_step(10) is not None
+
+    def test_env_parse_shapes(self):
+        assert profiler._parse_mode("off") == ("off", 0)
+        assert profiler._parse_mode("") == ("off", 0)
+        assert profiler._parse_mode("on") == ("on", 1)
+        assert profiler._parse_mode("1") == ("on", 1)
+        assert profiler._parse_mode("sample:50") == ("sample", 50)
+        assert profiler._parse_mode("sample:junk") == ("sample", 100)
+        assert profiler._parse_mode("garbage") == ("off", 0)
+
+
+# ------------------------------------------------------- overlap estimator
+class TestOverlapMath:
+    def test_ring_overlap_fully_hidden(self):
+        # comm 1 ms/step under 3 ms of GEMM: every hop hides
+        hidden, exposed = profiler.ring_overlap(0.001, 0.003, steps=4)
+        assert hidden == pytest.approx(0.004)
+        assert exposed == 0.0
+
+    def test_ring_overlap_partially_exposed(self):
+        # comm 3 ms/step over 1 ms compute: 1 hides, 2 exposed, x2 steps
+        hidden, exposed = profiler.ring_overlap(0.003, 0.001, steps=2)
+        assert hidden == pytest.approx(0.002)
+        assert exposed == pytest.approx(0.004)
+
+    def test_bucket_overlap_last_bucket_exposed(self):
+        hidden, exposed = profiler.bucket_overlap(1.0, 4)
+        assert hidden == pytest.approx(0.75)
+        assert exposed == pytest.approx(0.25)
+        # one bucket: nothing left to hide behind
+        hidden, exposed = profiler.bucket_overlap(1.0, 1)
+        assert hidden == 0.0
+        assert exposed == pytest.approx(1.0)
+
+    def test_pipeline_overlap_bubble_hops_exposed(self):
+        # M=4, S=2: 5 ticks, 1 bubble hop exposed -> efficiency 0.8
+        hidden, exposed = profiler.pipeline_overlap(0.1, 4, 2)
+        assert hidden == pytest.approx(0.4)
+        assert exposed == pytest.approx(0.1)
+        assert hidden / (hidden + exposed) == pytest.approx(0.8)
+
+    def test_note_overlap_report_and_gauges(self, profiling, telemetry):
+        profiler.note_overlap("dp", 0.3, 0.1, detail={"n_buckets": 4})
+        rep = profiler.overlap_report()
+        assert rep["dp"]["efficiency"] == pytest.approx(0.75)
+        assert rep["dp"]["detail"]["n_buckets"] == 4
+        g = telemetry.gauge("prof.overlap_efficiency",
+                            tags={"mechanism": "dp"})
+        assert g.value == pytest.approx(0.75)
+
+    def test_flops_divergence(self, profiling, telemetry):
+        out = profiler.flops_divergence(100e9, 112e9)
+        assert out["divergence"] == pytest.approx(0.12)
+        assert telemetry.gauge("prof.flops_divergence").value == \
+            pytest.approx(0.12)
+        assert profiler.flops_divergence(0.0, 1.0) is None
+        assert profiler.flops_divergence(1.0, None) is None
+
+
+# ---------------------------------------------------------- compile ledger
+class TestCompileLedger:
+    def test_cause_names_the_changing_arg(self):
+        compile_ledger.reset()
+        a = np.zeros((2, 16), np.int64)
+        b = np.zeros((4, 16), np.int64)
+        s1 = compile_ledger.signature([a, a])
+        s2 = compile_ledger.signature([a, b])
+        miss, cause = compile_ledger.observe_call("site", s1)
+        assert (miss, cause) == (True, "first_call")
+        miss, cause = compile_ledger.observe_call("site", s2)
+        assert miss and "arg1 shape" in cause and "(4, 16)" in cause
+        # seen signature again -> hit, no cause
+        assert compile_ledger.observe_call("site", s1) == (False, None)
+        compile_ledger.reset()
+
+    def test_dtype_and_static_causes(self):
+        compile_ledger.reset()
+        f32 = np.zeros((2,), np.float32)
+        f16 = np.zeros((2,), np.float16)
+        compile_ledger.observe_call("s", compile_ledger.signature([f32]))
+        _, cause = compile_ledger.observe_call(
+            "s", compile_ledger.signature([f16]))
+        assert "dtype" in cause
+        compile_ledger.observe_call("t", compile_ledger.signature([3]))
+        _, cause = compile_ledger.observe_call(
+            "t", compile_ledger.signature([4]))
+        assert "static" in cause
+        compile_ledger.reset()
+
+    def test_report_shape(self):
+        compile_ledger.reset()
+        sig = compile_ledger.signature([np.zeros((2, 2))])
+        compile_ledger.observe_call("site", sig)
+        compile_ledger.note_compile("site", duration_s=0.5,
+                                    cause="first_call", donated_args=2)
+        rep = compile_ledger.report()
+        e = rep["sites"]["site"]
+        assert e["compiles"] == 1 and e["calls"] == 1
+        assert e["causes"] == {"first_call": 1}
+        assert e["compile_time_s"]["total"] == pytest.approx(0.5)
+        assert e["donated_args"] == 2
+        assert e["last_signature"] == [["array", (2, 2), "float64"]]
+        compile_ledger.reset()
+
+    def test_trainstep_shape_change_attributed(self, profiling):
+        from paddle_tpu.jit.train_step import TrainStep
+
+        cfg, model = _tiny_model()
+        opt = pt.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters())
+        step = TrainStep(model, opt)
+        step(*_batch(cfg, 2, 16))
+        step(*_batch(cfg, 4, 16))      # deliberate batch-shape change
+        e = compile_ledger.report()["sites"]["train_step"]
+        assert e["compiles"] == 2
+        causes = list(e["causes"])
+        assert any("shape" in c and "(4, 16)" in c for c in causes), \
+            causes
+        assert e["unique_signatures"] == 2
+        # compile durations were measured at the missing dispatches
+        assert e["compile_time_s"]["samples"] == 2
+        assert e["compile_time_s"]["total"] > 0
+
+
+# ------------------------------------------------- zero-cost when disabled
+class TestZeroCostOff:
+    def test_off_adds_no_callbacks_and_no_recompiles(self):
+        profiler.reset()
+        compile_ledger.reset()
+        profiler.disable_profiling()
+        obs.disable()
+        from paddle_tpu.jit.train_step import TrainStep
+
+        cfg, model = _tiny_model()
+        opt = pt.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters())
+        traces = {"n": 0}
+
+        def loss_fn(m, ids, labels):
+            traces["n"] += 1  # ptlint: disable=jit-purity (trace counter)
+            return m(ids, labels=labels)
+
+        step = TrainStep(model, opt, loss_fn=loss_fn)
+        ids, labels = _batch(cfg, 2, 16)
+        for _ in range(3):
+            step(ids, labels)
+        # one trace for the 3-step loop: PROFILE=off added no retraces
+        assert traces["n"] == 1
+        # ...and zero profiler host callbacks
+        assert profiler.debug_invocations() == 0
+        # ...and the compile ledger never even saw the site
+        assert compile_ledger.report()["sites"] == {}
+
+    def test_registry_writes_noop_without_telemetry(self, profiling):
+        # profiling WITHOUT telemetry: reports exist, metrics don't
+        obs.disable()
+        obs.registry.reset()
+        ck = ManualClock()
+        rec = profiler.StepRecord(0, clock=ck, epoch=0.0)
+        ck.advance(0.1)
+        rec.mark("device")
+        rep = rec.close(tokens=10)
+        assert sum(rep["segments"].values()) == pytest.approx(
+            rep["wall_s"], abs=1e-12)
+        assert profiler.last_report()["step"] == 0
+        snap = obs.registry.snapshot()
+        assert "prof.steps_sampled" not in snap["counters"]
+
+
+# ----------------------------------------------------------- memory ledger
+class TestMemoryLedger:
+    def test_note_phase_gated(self):
+        profiler.disable_profiling()
+        obs.disable()
+        memory.reset_phases()
+        assert memory.note_phase("build") is None
+        assert memory.phase_report() == {}
+
+    def test_phase_report_tracks_peak(self, profiling):
+        memory.reset_phases()
+        assert memory.note_phase("build") is not None
+        memory.note_phase("step_begin")
+        memory.note_phase("step_begin")
+        rep = memory.phase_report()
+        assert rep["build"]["samples"] == 1
+        assert rep["step_begin"]["samples"] == 2
+        assert rep["step_begin"]["peak_bytes_in_use"] >= \
+            rep["step_begin"]["bytes_in_use"] >= 0
+        memory.reset_phases()
+
+
+# ------------------------------------------------ engine + bundle plumbing
+class TestEndToEnd:
+    def test_engine_fit_sampled_attribution(self, profiling, telemetry):
+        from paddle_tpu.distributed.auto_parallel.engine import Engine
+
+        cfg, model = _tiny_model()
+        opt = pt.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters())
+        eng = Engine(model=model, optimizer=opt)
+        batches = [_batch(cfg, 2, 16, seed=i) for i in range(3)]
+        eng.fit(batches)
+        # every step sampled in "on" mode; invariant holds on real clocks
+        reps = profiler.reports()
+        assert len(reps) == 3
+        for rep in reps:
+            assert sum(rep["segments"].values()) == pytest.approx(
+                rep["wall_s"], rel=1e-9, abs=1e-9)
+            assert rep["segments"]["host_stall"] >= -1e-9
+        assert reps[-1]["tokens"] == 2 * 16
+        # build telemetry installed the step cost model
+        assert profiler.report()["config"]["tokens_per_step"] == 32
+        snap = telemetry.snapshot()
+        assert snap["counters"]["prof.steps_sampled"] == 3.0
+        # memory ledger saw the build + step_begin phases
+        phases = memory.phase_report()
+        assert "build" in phases and "step_begin" in phases
+
+    def test_bundle_sections_and_diagnose(self, profiling, telemetry,
+                                          tmp_path, capsys):
+        ck = ManualClock()
+        rec = profiler.StepRecord(5, clock=ck, epoch=0.0)
+        ck.advance(0.01)
+        rec.mark("dispatch")
+        ck.advance(0.2)
+        rec.mark("device")
+        rec.close(tokens=64)
+        profiler.note_overlap("pp", 0.08, 0.02)
+        compile_ledger.note_compile("train_step", duration_s=1.5,
+                                    cause="first_call")
+        d = obs.dump_debug_bundle(str(tmp_path), reason="test")
+        prof_p = os.path.join(d, "profiler_report.json")
+        led_p = os.path.join(d, "compile_ledger.json")
+        assert os.path.exists(prof_p) and os.path.exists(led_p)
+        with open(prof_p) as f:
+            rep = json.load(f)
+        assert rep["last"]["step"] == 5
+        assert rep["overlap"]["pp"]["efficiency"] == pytest.approx(0.8)
+        with open(led_p) as f:
+            led = json.load(f)
+        assert led["sites"]["train_step"]["compiles"] == 1
+
+        import importlib.util
+
+        diag_path = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tools", "diagnose.py")
+        spec = importlib.util.spec_from_file_location("_diag", diag_path)
+        diag = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(diag)
+        assert diag.main(["diagnose", str(d)]) == 0
+        out = capsys.readouterr().out
+        assert "last sampled step 5" in out
+        assert "overlap[pp]" in out
+        assert "compile ledger" in out
+        assert "first_call" in out
+
+    def test_perfetto_bars_emitted(self, profiling, telemetry, tmp_path):
+        from paddle_tpu.observability import tracing
+
+        tracing.reset()
+        ck = ManualClock(1000.0)
+        rec = profiler.StepRecord(3, clock=ck, epoch=50.0)
+        ck.advance(0.02)
+        rec.mark("dispatch")
+        ck.advance(0.3)
+        rec.mark("device")
+        rec.close(tokens=32)
+        path = str(tmp_path / "trace.json")
+        obs.export_chrome_trace(path)
+        with open(path) as f:
+            events = json.load(f)["traceEvents"]
+        names = [e.get("name") for e in events if e.get("ph") == "X"]
+        assert "prof.step" in names
+        assert names.count("prof.phase") == 2
+        step_ev = next(e for e in events if e.get("name") == "prof.step")
+        assert step_ev["args"]["step"] == 3
+        assert "device_compute" in step_ev["args"]

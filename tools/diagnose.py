@@ -29,6 +29,11 @@ Sections printed (each only if its file exists in the bundle):
                  queue/prefill/decode/preempt attribution
   * slo        — rolling-window SLO report (slo_windows.json):
                  per-objective state and burn rates at dump time
+  * profiler   — sampled-step attribution (profiler_report.json): the
+                 LAST device-fenced step's phase breakdown, rolling
+                 MFU, per-mechanism overlap efficiency, memory phases
+  * compiles   — compile ledger (compile_ledger.json): per-jit-site
+                 compile counts with recompile-cause attribution
 """
 from __future__ import annotations
 
@@ -38,7 +43,8 @@ import sys
 
 BUNDLE_FILES = ("env.json", "flight_recorder.jsonl", "metrics.json",
                 "comm_tasks.json", "trace.json",
-                "request_log_tail.jsonl", "slo_windows.json")
+                "request_log_tail.jsonl", "slo_windows.json",
+                "profiler_report.json", "compile_ledger.json")
 
 
 def _load_json(path):
@@ -253,6 +259,57 @@ def _show_slo(d: str):
         print("  window sources: " + ", ".join(sorted(wins)))
 
 
+def _show_profiler(d: str):
+    rep = _load_json(os.path.join(d, "profiler_report.json"))
+    if not rep:
+        return
+    _section("profiler (sampled-step attribution)")
+    print(f"  mode: {rep.get('mode', '?')}"
+          + (f" (every {rep['sample_every']})"
+             if rep.get("mode") == "sample" else ""))
+    last = rep.get("last")
+    if last:
+        wall = float(last.get("wall_s") or 0.0)
+        print(f"  last sampled step {last.get('step')}: "
+              f"wall={_ms(wall)}ms mfu={last.get('mfu', 0.0):.3f} "
+              f"tokens/s={last.get('tokens_per_s', 0.0):.0f}")
+        for phase, v in (last.get("segments") or {}).items():
+            frac = v / wall if wall > 0 else 0.0
+            print(f"    {phase:<20} {_ms(v):>8}ms {frac:>6.1%}")
+    overlap = rep.get("overlap") or {}
+    for mech, o in sorted(overlap.items()):
+        print(f"  overlap[{mech}]: efficiency="
+              f"{o.get('efficiency', 0.0):.3f} "
+              f"hidden={_ms(o.get('hidden_s'))}ms "
+              f"exposed={_ms(o.get('exposed_s'))}ms")
+    div = rep.get("flops_check")
+    if div:
+        print(f"  flops model vs xla: divergence="
+              f"{div.get('divergence', 0.0):.2%} "
+              f"(model={div.get('model'):.3e} xla={div.get('xla'):.3e})")
+    phases = rep.get("memory_phases") or {}
+    for phase, m in sorted(phases.items()):
+        print(f"  mem[{phase}]: live={m.get('bytes_in_use', 0)} "
+              f"peak={m.get('peak_bytes_in_use', 0)} "
+              f"samples={m.get('samples', 0)}")
+
+
+def _show_compiles(d: str):
+    led = _load_json(os.path.join(d, "compile_ledger.json"))
+    if not led or not led.get("sites"):
+        return
+    _section("compile ledger (recompile-cause attribution)")
+    for site, e in sorted(led["sites"].items()):
+        ct = e.get("compile_time_s") or {}
+        print(f"  {site:<28} compiles={e.get('compiles', 0)} "
+              f"calls={e.get('calls', 0)} "
+              f"sigs={e.get('unique_signatures', 0)} "
+              f"compile_s={ct.get('total', 0.0)}")
+        for cause, n in sorted((e.get("causes") or {}).items(),
+                               key=lambda kv: -kv[1]):
+            print(f"    x{n:<4} {cause}")
+
+
 def main(argv) -> int:
     if len(argv) != 2 or argv[1] in ("-h", "--help"):
         print(__doc__)
@@ -269,6 +326,8 @@ def main(argv) -> int:
     _show_trace(bundle)
     _show_requests(bundle)
     _show_slo(bundle)
+    _show_profiler(bundle)
+    _show_compiles(bundle)
     print()
     return 0
 

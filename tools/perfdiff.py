@@ -1,0 +1,309 @@
+#!/usr/bin/env python
+"""Perf-regression harness over paddle_tpu bench JSONs. Stdlib-only —
+runs anywhere the bench results were copied to, no framework import.
+
+Two modes:
+
+* **diff** — compare a new bench result against a baseline and exit
+  nonzero when throughput, MFU, or the step attribution regressed
+  beyond the noise bounds::
+
+      python tools/perfdiff.py BASE.json NEW.json
+      python tools/perfdiff.py BASE.json NEW.json --noise 0.15
+
+* **history** — walk the checked-in ``BENCH_r*.json`` round history
+  and report the round-over-round throughput / MFU trajectory
+  (report-only by default; ``--strict`` exits nonzero on any
+  round-over-round regression beyond the noise bound)::
+
+      python tools/perfdiff.py --history 'BENCH_r*.json'
+      python tools/perfdiff.py --history 'BENCH_r*.json' --strict
+
+Accepted document shapes (auto-detected, newest first):
+
+1. round wrapper: ``{"n": N, "rc": .., "tail": .., "parsed": {...}}``
+   (what the growth driver checks in as ``BENCH_rNN.json``);
+2. a raw bench result: ``{"metric", "value", "unit", "extra": {...}}``
+   (one line of ``bench.py`` stdout);
+3. anything with a ``tail`` string whose last JSON line parses as (2).
+
+Checked quantities (each independently, missing-on-either-side skips):
+
+* ``value`` (tokens/s): relative drop beyond ``--noise``
+  (default 0.10, env ``PADDLE_TPU_PERFDIFF_NOISE``);
+* ``extra.mfu``: relative drop beyond ``--mfu-noise`` (defaults to
+  the value noise);
+* ``extra.attribution`` (the profiler's phase breakdown from
+  ``bench.py --multichip``): first the sum-to-step-time INVARIANT on
+  each side (segments must sum to wall within 1%% — a violated
+  invariant is a harness bug, reported as such), then any phase's
+  share of wall time growing by more than ``--attr-noise`` (absolute
+  fraction, default 0.10) — catches "tokens/s held but host stall now
+  eats 20%% of the step" regressions throughput alone hides.
+
+Exit codes: 0 ok, 1 regression (or strict-mode trajectory
+regression / invariant violation), 2 usage or parse error.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import List, Optional, Tuple
+
+DEFAULT_NOISE = float(os.environ.get("PADDLE_TPU_PERFDIFF_NOISE",
+                                     "0.10"))
+# segments must sum to the measured wall within this relative slack
+INVARIANT_TOL = 0.01
+
+
+# ----------------------------------------------------------------- loading
+def _last_json_line(text: str) -> Optional[dict]:
+    for line in reversed([ln for ln in text.splitlines() if ln.strip()]):
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            doc = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(doc, dict):
+            return doc
+    return None
+
+
+def load_doc(path: str) -> dict:
+    """Load one bench document (any accepted shape) -> raw result dict
+    with ``metric``/``value``/``extra``. Raises ValueError."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        raise ValueError(f"{path}: cannot read JSON ({e})")
+    if not isinstance(doc, dict):
+        raise ValueError(f"{path}: expected a JSON object")
+    inner = None
+    if isinstance(doc.get("parsed"), dict):
+        inner = doc["parsed"]
+    elif "value" in doc and "metric" in doc:
+        inner = doc
+    elif isinstance(doc.get("tail"), str):
+        inner = _last_json_line(doc["tail"])
+    if inner is None or "value" not in inner:
+        raise ValueError(f"{path}: no bench result found (keys: "
+                         f"{sorted(doc)[:8]})")
+    out = dict(inner)
+    if "n" in doc:
+        out["round"] = int(doc["n"])
+    return out
+
+
+def _round_of(path: str, doc: dict) -> int:
+    if "round" in doc:
+        return doc["round"]
+    m = re.search(r"r(\d+)", os.path.basename(path))
+    return int(m.group(1)) if m else 0
+
+
+# ------------------------------------------------------------- comparisons
+def check_attribution(att: dict) -> List[str]:
+    """Validate one attribution sub-object's sum-to-step-time
+    invariant. Returns problems (empty = holds)."""
+    problems = []
+    if not isinstance(att, dict):
+        return ["attribution is not an object"]
+    wall = att.get("wall_ms")
+    segs = att.get("segments_ms")
+    if not isinstance(segs, dict) or wall is None:
+        return ["attribution missing wall_ms/segments_ms"]
+    try:
+        total = sum(float(v) for v in segs.values())
+        wall = float(wall)
+    except (TypeError, ValueError):
+        return ["attribution has non-numeric segments"]
+    if wall <= 0:
+        return [f"attribution wall_ms={wall} is not positive"]
+    if abs(total - wall) > INVARIANT_TOL * wall:
+        problems.append(
+            f"segments sum {total:.3f}ms != wall {wall:.3f}ms "
+            f"(off by {abs(total - wall) / wall:.1%}) — "
+            f"sum-to-step-time invariant violated")
+    return problems
+
+
+def _phase_fracs(att: dict) -> dict:
+    segs = att.get("segments_ms") or {}
+    try:
+        wall = float(att.get("wall_ms") or 0.0)
+    except (TypeError, ValueError):
+        return {}
+    if wall <= 0:
+        return {}
+    return {k: float(v) / wall for k, v in segs.items()}
+
+
+def compare(old: dict, new: dict, noise: float,
+            mfu_noise: Optional[float] = None,
+            attr_noise: float = 0.10) -> Tuple[List[str], List[str]]:
+    """(regressions, notes) between two loaded bench docs."""
+    if mfu_noise is None:
+        mfu_noise = noise
+    regressions, notes = [], []
+    om, nm = old.get("metric"), new.get("metric")
+    if om and nm and om != nm:
+        notes.append(f"metric changed {om} -> {nm}; comparing anyway")
+    try:
+        ov, nv = float(old["value"]), float(new["value"])
+    except (KeyError, TypeError, ValueError):
+        return ["missing/non-numeric value field"], notes
+    if ov > 0:
+        delta = (nv - ov) / ov
+        line = (f"value {ov:.1f} -> {nv:.1f} "
+                f"{new.get('unit', '')} ({delta:+.1%})")
+        if delta < -noise:
+            regressions.append(line + f" beyond noise {noise:.0%}")
+        else:
+            notes.append(line)
+    o_extra = old.get("extra") or {}
+    n_extra = new.get("extra") or {}
+    o_mfu, n_mfu = o_extra.get("mfu"), n_extra.get("mfu")
+    if o_mfu and n_mfu is not None:
+        delta = (float(n_mfu) - float(o_mfu)) / float(o_mfu)
+        line = f"mfu {float(o_mfu):.4f} -> {float(n_mfu):.4f} ({delta:+.1%})"
+        if delta < -mfu_noise:
+            regressions.append(line + f" beyond noise {mfu_noise:.0%}")
+        else:
+            notes.append(line)
+    o_att, n_att = o_extra.get("attribution"), n_extra.get("attribution")
+    for side, att in (("baseline", o_att), ("new", n_att)):
+        if att is not None:
+            for p in check_attribution(att):
+                regressions.append(f"{side}: {p}")
+    if isinstance(o_att, dict) and isinstance(n_att, dict):
+        of, nf = _phase_fracs(o_att), _phase_fracs(n_att)
+        for phase in sorted(set(of) | set(nf)):
+            d = nf.get(phase, 0.0) - of.get(phase, 0.0)
+            line = (f"attribution[{phase}] {of.get(phase, 0.0):.1%} "
+                    f"-> {nf.get(phase, 0.0):.1%}")
+            if d > attr_noise:
+                regressions.append(
+                    line + f" grew beyond {attr_noise:.0%} of step time")
+            elif abs(d) > attr_noise / 2:
+                notes.append(line)
+    return regressions, notes
+
+
+# ------------------------------------------------------------------ modes
+def run_diff(base_path: str, new_path: str, noise: float,
+             mfu_noise: Optional[float], attr_noise: float) -> int:
+    old, new = load_doc(base_path), load_doc(new_path)
+    regressions, notes = compare(old, new, noise, mfu_noise, attr_noise)
+    for n in notes:
+        print(f"  ok: {n}")
+    for r in regressions:
+        print(f"  REGRESSION: {r}")
+    if regressions:
+        print(f"perfdiff: {len(regressions)} regression(s) "
+              f"({base_path} -> {new_path})")
+        return 1
+    print(f"perfdiff: no regression ({base_path} -> {new_path})")
+    return 0
+
+
+def run_history(pattern: str, noise: float, strict: bool) -> int:
+    paths = sorted(glob.glob(pattern))
+    if not paths:
+        print(f"perfdiff: no files match {pattern!r}", file=sys.stderr)
+        return 2
+    rounds = []
+    for p in paths:
+        try:
+            doc = load_doc(p)
+        except ValueError as e:
+            print(f"  skip: {e}")
+            continue
+        rounds.append((_round_of(p, doc), p, doc))
+    if not rounds:
+        print("perfdiff: no parseable rounds", file=sys.stderr)
+        return 2
+    rounds.sort(key=lambda t: t[0])
+    print(f"perfdiff history: {len(rounds)} round(s)")
+    print(f"  {'round':>5} {'value':>12} {'unit':<10} {'mfu':>8} metric")
+    bad = 0
+    prev = None
+    for rnd, path, doc in rounds:
+        extra = doc.get("extra") or {}
+        mfu = extra.get("mfu")
+        print(f"  r{rnd:>04d} {float(doc['value']):>12.1f} "
+              f"{str(doc.get('unit', '')):<10} "
+              f"{(f'{float(mfu):.4f}' if mfu is not None else '-'):>8} "
+              f"{doc.get('metric', '?')}")
+        if prev is not None and prev.get("metric") == doc.get("metric"):
+            regs, _ = compare(prev, doc, noise)
+            for r in regs:
+                bad += 1
+                print(f"    r{rnd:>04d}: REGRESSION: {r}")
+        prev = doc
+    # trajectory summary over the best-covered metric (rounds that ran
+    # a different bench config — e.g. a CPU smoke round — are excluded
+    # from the endpoints rather than poisoning the delta)
+    by_metric: dict = {}
+    for t in rounds:
+        by_metric.setdefault(t[2].get("metric"), []).append(t)
+    metric, tail = max(by_metric.items(), key=lambda kv: len(kv[1]))
+    if len(tail) >= 2:
+        first, last = tail[0][2], tail[-1][2]
+        fv, lv = float(first["value"]), float(last["value"])
+        print(f"  trajectory [{metric}] "
+              f"r{tail[0][0]:02d} -> r{tail[-1][0]:02d}: "
+              f"value {fv:.1f} -> {lv:.1f} "
+              f"({(lv - fv) / fv:+.1%} over {len(tail)} rounds)"
+              if fv > 0 else "  trajectory: baseline value is 0")
+        fm = (first.get("extra") or {}).get("mfu")
+        lm = (last.get("extra") or {}).get("mfu")
+        if fm is not None and lm is not None:
+            print(f"  mfu trajectory: {float(fm):.4f} -> {float(lm):.4f}")
+    if bad and strict:
+        print(f"perfdiff: {bad} round-over-round regression(s) (strict)")
+        return 1
+    return 0
+
+
+def main(argv) -> int:
+    ap = argparse.ArgumentParser(
+        prog="perfdiff", description="diff paddle_tpu bench JSONs")
+    ap.add_argument("base", nargs="?", help="baseline bench JSON")
+    ap.add_argument("new", nargs="?", help="new bench JSON")
+    ap.add_argument("--history", metavar="GLOB",
+                    help="walk a BENCH_r*.json round history instead")
+    ap.add_argument("--noise", type=float, default=DEFAULT_NOISE,
+                    help="relative tokens/s noise bound "
+                         f"(default {DEFAULT_NOISE})")
+    ap.add_argument("--mfu-noise", type=float, default=None,
+                    help="relative MFU noise bound (default: --noise)")
+    ap.add_argument("--attr-noise", type=float, default=0.10,
+                    help="absolute phase-fraction growth bound "
+                         "(default 0.10)")
+    ap.add_argument("--strict", action="store_true",
+                    help="history mode: exit 1 on any round-over-round "
+                         "regression")
+    args = ap.parse_args(argv[1:])
+    try:
+        if args.history:
+            if args.base or args.new:
+                ap.error("--history takes no positional files")
+            return run_history(args.history, args.noise, args.strict)
+        if not args.base or not args.new:
+            ap.error("need BASE and NEW files (or --history GLOB)")
+        return run_diff(args.base, args.new, args.noise, args.mfu_noise,
+                        args.attr_noise)
+    except ValueError as e:
+        print(f"perfdiff: {e}", file=sys.stderr)
+        return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
