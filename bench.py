@@ -525,7 +525,12 @@ def _bench_cluster():
     rate, preemptions. Rates auto-scale off a measured capacity probe
     (1 replica vs N), so the curve shape is machine-independent:
     graceful degradation means p99 TTFT stays bounded and shed rate
-    rises smoothly past 1.0x offered load, with no cliff."""
+    rises smoothly past 1.0x offered load, with no cliff.
+
+    A second phase (``extra["ramp"]``) drives the control-plane +
+    Autoscaler loop end to end: a seeded Poisson wave at ~2.5x ONE
+    replica's capacity into a pool that starts at a single replica,
+    with a seeded mid-wave ``hang``. See :func:`_cluster_ramp`."""
     import threading
     import time
 
@@ -671,6 +676,12 @@ def _bench_cluster():
         write_snapshot(snap, snap_path)
     router.shutdown()
 
+    # --- ramp phase: the autoscaled pool under a traffic wave plus a
+    # silent replica hang (lease eviction + token-exact replay)
+    ramp = _cluster_ramp(pt, model, cfg, rng, slots=slots,
+                         blocks=blocks, n_req=n_req, max_new=max_new,
+                         cap1=cap1)
+
     print(json.dumps({
         "metric": metric,
         "value": round(capn, 1),
@@ -691,9 +702,201 @@ def _bench_cluster():
             "sweep": sweep,
             "attribution": attribution,
             "slo": slo,
+            "ramp": ramp,
         },
     }))
     return 0
+
+
+def _cluster_ramp(pt, model, cfg, rng, slots, blocks, n_req, max_new,
+                  cap1):
+    """Autoscale ramp scenario: a seeded Poisson traffic wave offered
+    at ~2.5x ONE replica's measured capacity into a pool that starts
+    at a single replica behind the shared control plane. Exercises the
+    full elastic serving loop on the wall clock:
+
+    * queue pressure, sustained -> scale-out with warm joins (every
+      spawned replica must still show exactly ONE ragged compile),
+    * a seeded mid-wave ``hang`` — the replica goes silent without
+      reporting, so only the missed-lease scan can find it — followed
+      by eviction inside the lease budget and token-exact replay of
+      its in-flight work onto survivors,
+    * the idle tail after the wave -> scale-in back to one replica.
+
+    Token exactness and the recovery bound are asserted (greedy
+    decoding makes both deterministic); latency numbers are recorded,
+    not asserted, so the bench stays machine-independent. Returns the
+    ``extra["ramp"]`` record.
+    """
+    import threading
+    import time
+
+    from paddle_tpu.distributed.resilience import faults
+    from paddle_tpu.observability.slo import BURN
+    from paddle_tpu.serving.cluster import (AutoscaleConfig, Autoscaler,
+                                            ClusterControlPlane,
+                                            ClusterRouter, Replica)
+
+    knobs = dict(max_slots=slots, block_size=16, num_blocks=blocks,
+                 prefill_chunk=32)
+    prompts = [rng.integers(0, cfg.vocab_size,
+                            int(rng.integers(4, 32))).tolist()
+               for _ in range(n_req)]
+
+    # greedy references through a single engine (token-exact vs
+    # generate() by the serve_smoke invariant) — what the wave must
+    # reproduce no matter how the pool scales or fails underneath
+    ref = pt.serving.ServingEngine(model, **knobs)
+    rrids = [ref.submit(p, max_new_tokens=max_new) for p in prompts]
+    while ref.step():
+        pass
+    refs = [ref.result(r) for r in rrids]
+    ref.shutdown()
+
+    lease_s = 1.0
+    cp = ClusterControlPlane(lease_timeout=lease_s)
+    spawned = []
+
+    # warm standbys, compiled BEFORE the wave: in this single-threaded
+    # loop a mid-wave cold compile would stall every replica's beats
+    # past the lease and the scan would evict the whole pool (a real
+    # warm pool keeps joins off the serving threads the same way)
+    standby = [Replica("r%d" % i, model, **knobs) for i in (1, 2, 3)]
+    for r in standby:
+        r.warmup()
+
+    def spawn(name):
+        if standby and standby[0].name == name:
+            rep = standby.pop(0)
+        else:
+            rep = Replica(name, model, **knobs)
+            rep.warmup()
+        spawned.append(rep)
+        return rep
+
+    first = Replica("r0", model, **knobs)
+    first.warmup()
+    spawned.append(first)
+    router = ClusterRouter([first], control_plane=cp)
+    scaler = Autoscaler(router, spawn,
+                        AutoscaleConfig(min_replicas=1, max_replicas=3,
+                                        up_ticks=2, idle_ticks=25,
+                                        cooldown_ticks=10, queue_hwm=2))
+
+    rate = 2.5 * cap1 / max_new             # req/s, 2.5x one replica
+    due = np.cumsum(rng.exponential(1.0 / rate, n_req))
+    hang_i = (2 * n_req) // 3               # arm mid-wave
+
+    ttfts, outs = [], {}
+    lock = threading.Lock()
+    threads, events = [], []
+    state_at_first_up = [None]
+    t_hang, t_evict = [None], [None]
+    peak = 1
+
+    def consume(idx, crid, t_submit):
+        first_tok = True
+        got = []
+        for tok in router.stream(crid):
+            if first_tok:
+                with lock:
+                    ttfts.append(time.monotonic() - t_submit)
+                first_tok = False
+            got.append(tok)
+        with lock:
+            outs[idx] = got
+
+    try:
+        t_start = time.monotonic()
+        i = 0
+        while True:
+            now = time.monotonic() - t_start
+            while i < n_req and float(due[i]) <= now:
+                if i == hang_i:
+                    # the NEXT replica step across the pool goes
+                    # silent: no death report, beats just stop
+                    faults.configure("cluster.replica:hang@1", seed=0)
+                    t_hang[0] = time.monotonic()
+                ts = time.monotonic()
+                crid = router.submit(prompts[i],
+                                     max_new_tokens=max_new)
+                th = threading.Thread(target=consume,
+                                      args=(i, crid, ts))
+                th.start()
+                threads.append(th)
+                i += 1
+            busy = router.step()
+            ev = scaler.tick()
+            if ev is not None:
+                events.append(ev)
+                if ev["kind"] == "scale_up" and \
+                        state_at_first_up[0] is None:
+                    state_at_first_up[0] = \
+                        router.slo.evaluate()["state"]
+            peak = max(peak, router.num_alive())
+            if t_hang[0] is not None and t_evict[0] is None and \
+                    any(r.hung and not r.alive for r in spawned):
+                t_evict[0] = time.monotonic()
+            if not busy:
+                if i >= n_req and \
+                        all(not th.is_alive() for th in threads):
+                    break
+                assert time.monotonic() - t_start < 120.0, \
+                    "ramp failed to drain"
+                time.sleep(0.002)
+        for th in threads:
+            th.join()
+        # idle tail: the scaler must walk the pool back to min
+        deadline = time.monotonic() + 30.0
+        while router.num_alive() > 1 and time.monotonic() < deadline:
+            router.step()
+            scaler.tick()
+            time.sleep(0.001)
+    finally:
+        faults.reset()
+
+    assert [outs[k] for k in range(n_req)] == refs, \
+        "ramp streams diverged from single-engine references"
+    assert len(ttfts) == n_req, \
+        "%d/%d requests never got a first token" % (len(ttfts), n_req)
+    assert peak >= 2, "wave never scaled the pool out"
+    assert t_evict[0] is not None, \
+        "seeded hang was never evicted via the lease"
+    recovery = t_evict[0] - t_hang[0]
+    assert recovery <= lease_s + 2.0, \
+        "hang->eviction took %.2fs (lease %.1fs)" % (recovery, lease_s)
+    assert router.num_alive() == 1, \
+        "idle scale-in left %d replicas" % router.num_alive()
+    for r in spawned:
+        assert r.engine.ragged_compiles == 1, \
+            "replica %s compiled ragged %d times (joins must be warm)" \
+            % (r.name, r.engine.ragged_compiles)
+
+    pct = (lambda q: round(
+        1e3 * float(np.percentile(ttfts, q)), 2)) if ttfts else \
+        (lambda q: None)
+    ramp = {
+        "offered_x_1rep_capacity": 2.5,
+        "arrival_rate_req_per_s": round(rate, 2),
+        "requests": n_req,
+        "ttft_p50_ms": pct(50), "ttft_p99_ms": pct(99),
+        "peak_replicas": peak,
+        "final_replicas": router.num_alive(),
+        "scale_events": [
+            {k: (round(v, 3) if isinstance(v, float) else v)
+             for k, v in e.items() if k != "t"} for e in events],
+        "slo_state_at_first_scale_out": state_at_first_up[0],
+        "scaled_out_before_sustained_burn":
+            state_at_first_up[0] != BURN,
+        "hang_to_eviction_s": round(recovery, 3),
+        "lease_timeout_s": lease_s,
+        "replay_token_exact": True,          # asserted above
+        "warm_joins_one_compile_each": True,  # asserted above
+    }
+    router.shutdown()
+    for r in standby:                        # never-promoted standbys
+        r.shutdown()
+    return ramp
 
 
 def _bench_elastic():

@@ -1,0 +1,79 @@
+"""One control plane: the lease/membership/failover substrate every
+resilience stack in this tree shares.
+
+Before this package, three tiers each carried their own copy of the
+same machinery — elastic DP membership
+(``distributed/elastic/membership.py``), PS shard failover
+(``distributed/ps/replication.py``), and the serving cluster's manual
+``fail_all()`` crash path (``serving/cluster/``). The shared pieces now
+live here, once:
+
+- :mod:`store_util` — the atomic get-or-None ``try_get`` (formerly
+  duplicated) and :class:`LocalStore`, the in-process store for
+  single-host consumers and tests;
+- :mod:`lease` — store-backed heartbeat leases (``{ns}/beat/{member}``)
+  with clean-leave markers and, via :class:`LeaseTable`, generation
+  fencing (stale-generation beats are rejected, not written);
+- :mod:`epochs` — propose/ack/commit membership epochs with monotone
+  numbers from a store ADD, plus the typed :class:`EpochChanged`
+  failover event.
+
+The elastic and PS tiers are thin consumers: same keys, same payloads,
+same write order — their multi-process drills stay bit-exact. The
+serving cluster is the first NEW consumer
+(:class:`paddle_tpu.serving.cluster.ClusterControlPlane`): replicas
+hold leases the router discovers and evicts on, and the autoscaler
+scales the pool through the same epochs.
+
+Fault sites: ``cp.lease`` (``drop`` loses one beat on the wire) and
+``cp.epoch`` (``delay`` holds a commit open) make substrate races
+injectable with the standard ``PADDLE_TPU_FAULT_PLAN`` plans.
+
+:func:`snapshot_all` feeds the flight-recorder debug bundle's
+``control_plane.json`` section: every live lease table, epoch registry,
+and registered plane (e.g. the cluster's), best-effort.
+"""
+from __future__ import annotations
+
+import weakref
+from typing import List
+
+from .epochs import EpochChanged, EpochRegistry  # noqa: F401
+from .lease import (LeaseTable, lease_fresh, read_beat,  # noqa: F401
+                    scan_beats, write_beat)
+from .store_util import LocalStore, try_get  # noqa: F401
+
+__all__ = ["try_get", "LocalStore", "LeaseTable", "EpochRegistry",
+           "EpochChanged", "write_beat", "read_beat", "scan_beats",
+           "lease_fresh", "register_plane", "snapshot_all"]
+
+# weak registry of composite control planes (objects exposing a
+# .snapshot() with epoch+members+leases+transitions, like the serving
+# cluster's) — the bundle's richest section when one is live
+_planes: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def register_plane(plane) -> None:
+    """Register a composite control plane for :func:`snapshot_all`
+    (weakly held — no lifecycle management needed)."""
+    _planes.add(plane)
+
+
+def snapshot_all() -> dict:
+    """Best-effort snapshot of every live substrate object — what
+    ``dump_debug_bundle`` writes as ``control_plane.json``."""
+    from . import epochs as _epochs
+    from . import lease as _lease
+
+    def _collect(objs) -> List[dict]:
+        out: List[dict] = []
+        for obj in list(objs):
+            try:
+                out.append(obj.snapshot())
+            except Exception:
+                continue
+        return out
+
+    return {"planes": _collect(_planes),
+            "leases": _collect(_lease._live),
+            "epochs": _collect(_epochs._live)}

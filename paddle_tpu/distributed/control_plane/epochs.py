@@ -1,0 +1,171 @@
+"""Propose/ack/commit membership epochs over any store.
+
+The epoch protocol the elastic tier built (PR 13), extracted so every
+membership consumer speaks the same keys:
+
+- epoch numbers are monotone by construction — allocated from the
+  ``{ns}/seq`` counter with a store ADD;
+- the proposal record lives at ``{ns}/epoch/{n}`` (``epoch`` /
+  ``members`` / ``reason`` / ``proposer`` / ``prev``) and is advertised
+  at ``{ns}/propose``;
+- members ack at ``{ns}/epoch/{n}/ack/{member}``;
+- the committer publishes ``{ns}/epoch/{n}/commit`` and repoints the
+  ``{ns}/cur`` pointer — what a cold joiner reads to find the group.
+
+WHO proposes, WHO must ack, and WHO commits stay consumer policy (the
+elastic tier elects the lowest fresh rank; the serving cluster's router
+is the sole committer) — this module only owns the key layout and the
+write order, which is what keeps the refactored consumers bit-exact.
+
+:class:`EpochChanged` is the typed failover event raised into in-flight
+work when membership moves; it moved here from ``elastic/membership.py``
+(which re-exports it, so every existing ``except EpochChanged`` keeps
+catching the same class).
+
+Fault site ``cp.epoch``: checked at commit time (``delay`` holds the
+commit past a member's deadline, the classic split-window race).
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+import weakref
+from collections import deque
+from typing import Callable, List, Optional
+
+from ..resilience import faults as _faults
+from .store_util import try_get
+
+__all__ = ["EpochChanged", "EpochRegistry"]
+
+
+class EpochChanged(RuntimeError):
+    """The group membership changed while work was in flight. Carries
+    the highest epoch proposal seen; callers re-join via their
+    coordinator and resume under the new epoch.
+    """
+
+    def __init__(self, epoch: int, reason: str = ""):
+        super().__init__(
+            f"group epoch changed (epoch={epoch}): {reason}")
+        self.epoch = epoch
+        self.reason = reason
+
+
+def _obs():
+    try:
+        from ... import observability as obs
+
+        return obs if obs.enabled() else None
+    except Exception:
+        return None
+
+
+# weak registry of live epoch registries for the flight-recorder bundle
+_live: "weakref.WeakSet[EpochRegistry]" = weakref.WeakSet()
+
+
+class EpochRegistry:
+    """One namespace's epoch log. Stateless with respect to membership
+    policy: it allocates numbers, stores records, and tracks the
+    propose/ack/commit keys."""
+
+    def __init__(self, store, namespace: str,
+                 clock: Callable[[], float] = time.time):
+        self.store = store
+        self.ns = str(namespace)
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._transitions: deque = deque(maxlen=32)  # guarded by: _lock
+        _live.add(self)
+
+    def _k(self, *parts) -> str:
+        return "/".join([self.ns] + [str(p) for p in parts])
+
+    def _note(self, kind: str, n: int, **fields) -> None:
+        with self._lock:
+            self._transitions.append(
+                {"t": self.clock(), "kind": kind, "epoch": n, **fields})
+
+    # ---------------------------------------------------------- propose
+    def propose(self, members: List, reason: str, proposer=None,
+                prev: int = 0) -> int:
+        """Allocate the next epoch number and publish its member list.
+        Monotone by construction: the number comes from a store ADD.
+        ``members`` is stored as given — callers normalize (the elastic
+        tier sorts int ranks; the cluster sorts replica names)."""
+        n = self.store.add(self._k("seq"), 1)
+        rec = {"epoch": n, "members": list(members), "reason": reason,
+               "proposer": proposer, "prev": prev}
+        self.store.set(self._k("epoch", n), json.dumps(rec).encode())
+        self.store.set(self._k("propose"), str(n).encode())
+        self._note("propose", n, members=list(members), reason=reason)
+        return n
+
+    def pending(self) -> int:
+        """Highest advertised proposal number (0 when none)."""
+        try:
+            raw = try_get(self.store, self._k("propose"))
+            return int(raw.decode()) if raw is not None else 0
+        except Exception:
+            return 0
+
+    def read(self, n: int) -> Optional[dict]:
+        try:
+            raw = try_get(self.store, self._k("epoch", n))
+            return None if raw is None else json.loads(raw.decode())
+        except Exception:
+            return None
+
+    # -------------------------------------------------------------- ack
+    def ack(self, n: int, member) -> None:
+        self.store.set(self._k("epoch", n, "ack", member), b"1")
+
+    def acked(self, n: int, member) -> bool:
+        try:
+            return self.store.check(self._k("epoch", n, "ack", member))
+        except Exception:
+            return False
+
+    # ------------------------------------------------------------ commit
+    def commit(self, n: int) -> None:
+        """Publish the commit marker and repoint ``cur``. Fault site
+        ``cp.epoch`` fires here — a delayed commit is how the
+        split-epoch races are injected."""
+        act = _faults.check("cp.epoch")
+        if act is not None:
+            _faults.apply(act)
+        self.store.set(self._k("epoch", n, "commit"), b"1")
+        self.store.set(self._k("cur"), str(n).encode())
+        rec = self.read(n) or {}
+        self._note("commit", n, members=rec.get("members"),
+                   reason=rec.get("reason"))
+        o = _obs()
+        if o:
+            o.registry.counter("cp.epochs").inc()
+            if rec.get("members") is not None:
+                o.registry.gauge("cp.members").set(
+                    len(rec["members"]))
+
+    def committed(self, n: int) -> bool:
+        try:
+            return self.store.check(self._k("epoch", n, "commit"))
+        except Exception:
+            return False
+
+    def current(self) -> Optional[dict]:
+        """The last committed epoch record published at ``cur``."""
+        try:
+            raw = try_get(self.store, self._k("cur"))
+            return None if raw is None else self.read(int(raw.decode()))
+        except Exception:
+            return None
+
+    # --------------------------------------------------------- snapshot
+    def snapshot(self) -> dict:
+        with self._lock:
+            transitions = list(self._transitions)
+        return {"kind": "epoch_registry", "ns": self.ns,
+                "pending": self.pending(), "current": self.current(),
+                "transitions": transitions}

@@ -1,0 +1,243 @@
+"""Store-backed heartbeat leases with generation fencing.
+
+The lease discipline every resilience stack in this tree converged on
+(elastic DP membership, PS shard failover, and now the serving
+cluster's replica pool): each member periodically writes a JSON beat at
+``{ns}/beat/{member}`` carrying at least ``{"t": clock()}``; a beat
+older than the namespace's lease timeout is EXPIRED and the member is
+presumed dead. A member that leaves on purpose writes a ``left`` marker
+first, so survivors can tell a planned departure from a crash — the
+clean-leave vs missed-beat disambiguation the drills assert on.
+
+Module-level primitives (``write_beat`` / ``read_beat`` /
+``scan_beats`` / ``lease_fresh``) operate on any store with the
+TCPStore client surface and keep the exact key/payload layout the
+elastic and PS tiers already speak, so those tiers delegate here
+without changing a byte on the wire.
+
+:class:`LeaseTable` adds **generation fencing** on top: ``grant``
+bumps a per-member monotone counter (store ADD at
+``{ns}/lease_gen/{member}``) and every fenced ``beat`` presents its
+generation — a beat carrying a stale generation (a zombie that was
+already replaced) is REJECTED, never written. That is the same fencing
+idea the PS shard map uses (``ps/gen``), lifted to the lease layer.
+
+Fault site ``cp.lease``: ``drop`` skips one beat write (a lost beat on
+the wire — peers see a missed-beat expiry); generic kinds go through
+``faults.apply``.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+import weakref
+from typing import Callable, Dict, Iterable, List, Optional
+
+from ..resilience import faults as _faults
+from .store_util import try_get
+
+__all__ = ["write_beat", "read_beat", "scan_beats", "lease_fresh",
+           "LeaseTable"]
+
+
+def _obs():
+    try:
+        from ... import observability as obs
+
+        return obs if obs.enabled() else None
+    except Exception:
+        return None
+
+
+def write_beat(store, ns: str, member, payload: dict) -> bool:
+    """Write one lease beat (the caller builds the payload, including
+    ``t``). Returns False when the beat was dropped by fault site
+    ``cp.lease`` — callers that count their own beats (the elastic
+    tier's ``elastic.heartbeats``) must not count a dropped one."""
+    act = _faults.check("cp.lease")
+    if act is not None:
+        if act.kind == "drop":
+            return False
+        _faults.apply(act)
+    store.set(f"{ns}/beat/{member}", json.dumps(payload).encode())
+    o = _obs()
+    if o:
+        o.registry.counter("cp.beats").inc()
+    return True
+
+
+def read_beat(store, ns: str, member) -> Optional[dict]:
+    """Decode one member's lease, or None (never set / undecodable)."""
+    try:
+        raw = try_get(store, f"{ns}/beat/{member}")
+        if raw is None:
+            return None
+        return json.loads(raw.decode())
+    except Exception:
+        return None
+
+
+def scan_beats(store, ns: str, members, now: float,
+               timeout: float) -> Dict:
+    """``{member: beat_or_None}`` where expired leases map to None."""
+    out: Dict = {}
+    for m in members:
+        b = read_beat(store, ns, m)
+        if b is not None and now - float(b.get("t", 0.0)) > timeout:
+            b = None
+        out[m] = b
+    return out
+
+
+def lease_fresh(store, ns: str, member, now: float,
+                timeout: float) -> bool:
+    b = read_beat(store, ns, member)
+    return b is not None and now - float(b.get("t", 0.0)) <= timeout
+
+
+# weak registry of live lease tables so the flight-recorder bundle can
+# dump every namespace's lease view without plumbing handles
+_live: "weakref.WeakSet[LeaseTable]" = weakref.WeakSet()
+
+
+class LeaseTable:
+    """One namespace's lease view with generation fencing. Purely
+    store-backed and clock-injectable: tests drive it with ManualClock
+    and zero sleeps — freshness is a function of (beats, now), never of
+    wall time."""
+
+    def __init__(self, store, namespace: str, timeout: float,
+                 clock: Callable[[], float] = time.time):
+        self.store = store
+        self.ns = str(namespace)
+        self.timeout = float(timeout)
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._seen: List = []       # grant order, guarded by: _lock
+        _live.add(self)
+
+    def _k(self, *parts) -> str:
+        return "/".join([self.ns] + [str(p) for p in parts])
+
+    def _note(self, member) -> None:
+        with self._lock:
+            if member not in self._seen:
+                self._seen.append(member)
+
+    # ------------------------------------------------------------ grant
+    def grant(self, member, **fields) -> int:
+        """Admit ``member``: bump its fencing generation, clear any
+        stale clean-leave marker, and write the first beat. Returns the
+        generation the member must present on every subsequent fenced
+        beat — an older holder of the same name is now a zombie whose
+        writes get rejected."""
+        gen = self.store.add(self._k("lease_gen", member), 1)
+        try:
+            self.store.delete(self._k("left", member))
+        except Exception:
+            pass
+        self._note(member)
+        self.beat(member, gen=gen, **fields)
+        return gen
+
+    def generation(self, member) -> int:
+        return self.store.add(self._k("lease_gen", member), 0)
+
+    # ------------------------------------------------------------- beat
+    def beat(self, member, gen: Optional[int] = None, **fields) -> bool:
+        """One fenced lease beat. A beat presenting a generation older
+        than the member's current one is rejected (returns False,
+        nothing written) — the stale writer was replaced and must not
+        resurrect its lease. ``gen=None`` writes unfenced (the caller
+        manages fencing elsewhere)."""
+        if gen is not None and int(gen) < self.generation(member):
+            o = _obs()
+            if o:
+                o.registry.counter("cp.fenced_rejects").inc()
+            return False
+        self._note(member)
+        payload = {"t": self.clock(), **fields}
+        if gen is not None:
+            payload["gen"] = int(gen)
+        return write_beat(self.store, self.ns, member, payload)
+
+    def read(self, member) -> Optional[dict]:
+        return read_beat(self.store, self.ns, member)
+
+    def fresh(self, member, now: Optional[float] = None) -> bool:
+        now = self.clock() if now is None else now
+        return lease_fresh(self.store, self.ns, member, now,
+                           self.timeout)
+
+    def scan(self, members: Iterable,
+             now: Optional[float] = None) -> Dict:
+        now = self.clock() if now is None else now
+        return scan_beats(self.store, self.ns, members, now,
+                          self.timeout)
+
+    def missed(self, members: Iterable,
+               now: Optional[float] = None) -> List:
+        """Members whose lease EXPIRED without a clean-leave marker —
+        the presumed-dead set. A member that ``leave()``d is never
+        reported here: that is the clean-leave vs missed-beat
+        disambiguation."""
+        beats = self.scan(members, now)
+        return [m for m, b in beats.items()
+                if b is None and not self.left(m)]
+
+    # ------------------------------------------------------------ leave
+    def leave(self, member) -> None:
+        """Planned departure: publish the ``left`` marker FIRST (so a
+        scan between the two writes still sees a clean leave), then
+        drop the beat."""
+        try:
+            self.store.set(self._k("left", member),
+                           json.dumps({"t": self.clock()}).encode())
+        except Exception:
+            pass
+        try:
+            self.store.delete(self._k("beat", member))
+        except Exception:
+            pass
+
+    def left(self, member) -> bool:
+        try:
+            return self.store.check(self._k("left", member))
+        except Exception:
+            return False
+
+    def forget(self, member) -> None:
+        """Drop every key of a member whose departure has been fully
+        processed (evicted or cleanly left) so the namespace does not
+        accumulate tombstones."""
+        for key in (self._k("beat", member), self._k("left", member)):
+            try:
+                self.store.delete(key)
+            except Exception:
+                pass
+        with self._lock:
+            if member in self._seen:
+                self._seen.remove(member)
+
+    # --------------------------------------------------------- snapshot
+    def snapshot(self) -> dict:
+        """JSON-able lease view (the ``control_plane.json`` bundle
+        section): every member this table has seen, with its last beat,
+        freshness, fencing generation, and leave marker."""
+        now = self.clock()
+        with self._lock:
+            seen = list(self._seen)
+        members = {}
+        for m in seen:
+            b = self.read(m)
+            members[str(m)] = {
+                "beat": b,
+                "fresh": b is not None and
+                now - float(b.get("t", 0.0)) <= self.timeout,
+                "generation": self.generation(m),
+                "left": self.left(m),
+            }
+        return {"kind": "lease_table", "ns": self.ns,
+                "timeout": self.timeout, "now": now,
+                "members": members}

@@ -1,0 +1,86 @@
+"""Store primitives shared by every control-plane consumer.
+
+``try_get`` used to live twice — once in ``elastic/membership.py`` and
+once (implicitly, via that import) behind ``ps/replication.py`` — and
+both copies existed for the same reason: deletable keys (leases,
+registries, mailboxes) must be read get-or-None ATOMICALLY, because
+check-then-get races a concurrent delete and the blocking ``get`` then
+stalls for the full store timeout. This module is now the one home of
+that helper; the elastic and PS modules re-export it.
+
+:class:`LocalStore` is the substrate's store for single-process
+consumers — the serving cluster's in-process replica pool, and the
+deterministic control-plane tests. It implements the same client
+surface the lease/epoch layers use on ``TCPStore`` (``set`` / ``get`` /
+``add`` / ``check`` / ``delete`` / ``try_get``), with ``add`` atomic
+under one lock — the monotone-counter primitive generation fencing and
+epoch numbering are built on.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+__all__ = ["try_get", "LocalStore"]
+
+
+def try_get(store, key: str) -> Optional[bytes]:
+    """Atomic get-or-None through the store's ``try_get`` when it has
+    one (``TCPStore``/``PrefixStore``); check-then-get otherwise (fake
+    stores in tests). Deletable keys — leases, registries, mailboxes —
+    MUST be read this way: check-then-get races a concurrent delete and
+    the blocking ``get`` then stalls for the full store timeout."""
+    fn = getattr(store, "try_get", None)
+    if fn is not None:
+        return fn(key)
+    if not store.check(key):
+        return None
+    return store.get(key)
+
+
+class LocalStore:
+    """Thread-safe in-process KV store with the TCPStore client
+    surface. No blocking ``get``-with-timeout semantics: every consumer
+    in this tree reads deletable keys through :func:`try_get`, and a
+    missing key on a plain ``get`` is a programming error (KeyError)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._data: Dict[str, bytes] = {}  # guarded by: _lock
+
+    def set(self, key: str, value: bytes) -> None:
+        with self._lock:
+            self._data[key] = bytes(value)
+
+    def get(self, key: str) -> bytes:
+        with self._lock:
+            return self._data[key]
+
+    def try_get(self, key: str) -> Optional[bytes]:
+        with self._lock:
+            return self._data.get(key)
+
+    def check(self, key: str) -> bool:
+        with self._lock:
+            return key in self._data
+
+    def delete(self, key: str) -> bool:
+        with self._lock:
+            return self._data.pop(key, None) is not None
+
+    def add(self, key: str, n: int) -> int:
+        """Atomic counter bump; returns the new value (``add(k, 0)``
+        reads without bumping — the TCPStore idiom)."""
+        with self._lock:
+            cur = int(self._data.get(key, b"0"))
+            cur += int(n)
+            self._data[key] = str(cur).encode()
+            return cur
+
+    def num_keys(self) -> int:
+        with self._lock:
+            return len(self._data)
+
+    def keys(self, prefix: str = "") -> List[str]:
+        with self._lock:
+            return sorted(k for k in self._data if k.startswith(prefix))

@@ -32,6 +32,14 @@ Protocol (all keys under one namespace, default ``elastic``):
 Fault sites: ``elastic.heartbeat`` (``drop`` skips one beat) and
 ``elastic.epoch_commit`` (``delay`` holds the commit past a member's
 deadline) make membership races injectable and deterministic.
+
+The mechanics — beat writes, the atomic ``try_get``, the
+propose/ack/commit epoch keys, and the typed :class:`EpochChanged` —
+live in :mod:`paddle_tpu.distributed.control_plane` (the substrate the
+PS and serving-cluster tiers share); this module keeps the elastic
+POLICY (who acts, when to propose, the join barrier) and re-exports
+the shared names so existing importers keep working. Keys, payloads,
+and write order are unchanged: the drills stay bit-exact.
 """
 from __future__ import annotations
 
@@ -39,26 +47,16 @@ import json
 import os
 import threading
 import time
-from typing import Callable, Dict, List, Optional
+from typing import Callable, List, Optional
 
+from ..control_plane.epochs import EpochChanged, EpochRegistry
+from ..control_plane.lease import read_beat, scan_beats, write_beat
+from ..control_plane.store_util import try_get
 from ..resilience import faults as _faults
 from .straggler import StragglerDetector
 
 __all__ = ["ElasticConfig", "EpochChanged", "MembershipCoordinator",
            "read_beat", "scan_beats", "try_get"]
-
-
-class EpochChanged(RuntimeError):
-    """The group membership changed while work was in flight. Carries
-    the highest epoch proposal seen; callers re-join via
-    :meth:`MembershipCoordinator.join` and resume under the new epoch.
-    """
-
-    def __init__(self, epoch: int, reason: str = ""):
-        super().__init__(
-            f"group epoch changed (epoch={epoch}): {reason}")
-        self.epoch = epoch
-        self.reason = reason
 
 
 class ElasticConfig:
@@ -114,43 +112,6 @@ def _obs():
         return None
 
 
-def try_get(store, key: str) -> Optional[bytes]:
-    """Atomic get-or-None through the store's ``try_get`` when it has
-    one (``TCPStore``/``PrefixStore``); check-then-get otherwise (fake
-    stores in tests). Deletable keys — leases, registries, mailboxes —
-    MUST be read this way: check-then-get races a concurrent delete and
-    the blocking ``get`` then stalls for the full store timeout."""
-    fn = getattr(store, "try_get", None)
-    if fn is not None:
-        return fn(key)
-    if not store.check(key):
-        return None
-    return store.get(key)
-
-
-def read_beat(store, ns: str, rank: int) -> Optional[dict]:
-    """Decode one rank's lease, or None (never set / undecodable)."""
-    try:
-        raw = try_get(store, f"{ns}/beat/{rank}")
-        if raw is None:
-            return None
-        return json.loads(raw.decode())
-    except Exception:
-        return None
-
-
-def scan_beats(store, ns: str, ranks, now: float,
-               timeout: float) -> Dict[int, Optional[dict]]:
-    """``{rank: beat_or_None}`` where expired leases map to None."""
-    out: Dict[int, Optional[dict]] = {}
-    for r in ranks:
-        b = read_beat(store, ns, r)
-        if b is not None and now - float(b.get("t", 0.0)) > timeout:
-            b = None
-        out[r] = b
-    return out
-
-
 class MembershipCoordinator:
     """One per rank. Every rank runs the same scan logic; acting as THE
     coordinator is a property of the current lease table (lowest fresh
@@ -166,6 +127,7 @@ class MembershipCoordinator:
         self.cfg = config or ElasticConfig()
         self.clock = clock
         self.ns = namespace
+        self._epochs = EpochRegistry(store, namespace, clock)
         self.epoch = 0
         self.members: List[int] = []
         self.on_fault: Optional[Callable[[List[int]], None]] = None
@@ -232,8 +194,10 @@ class MembershipCoordinator:
                 pass
 
     def beat(self) -> None:
-        """Write one lease beat. Fault site ``elastic.heartbeat``:
-        ``drop`` skips the write (a lost beat on the wire)."""
+        """Write one lease beat through the control-plane substrate.
+        Fault site ``elastic.heartbeat``: ``drop`` skips the write (a
+        lost beat on the wire); the substrate's own ``cp.lease`` site
+        can drop it one layer down."""
         act = _faults.check("elastic.heartbeat")
         if act is not None:
             if act.kind == "drop":
@@ -242,8 +206,8 @@ class MembershipCoordinator:
         with self._lock:
             payload = {"t": self.clock(), "step": self._last_step,
                        "step_ms": self._last_step_ms}
-        self.store.set(self._k("beat", self.rank),
-                       json.dumps(payload).encode())
+        if not write_beat(self.store, self.ns, self.rank, payload):
+            return                       # dropped at cp.lease
         o = _obs()
         if o:
             o.registry.counter("elastic.heartbeats").inc()
@@ -309,16 +273,11 @@ class MembershipCoordinator:
             now - float(beat.get("t", 0.0)) <= self.cfg.lease_timeout
 
     def refresh_pending(self) -> int:
-        try:
-            raw = try_get(self.store, self._k("propose"))
-            if raw is not None:
-                n = int(raw.decode())
-                with self._lock:
-                    if n > self._pending:
-                        self._pending = n
-        except Exception:
-            pass
-        return self._pending
+        n = self._epochs.pending()
+        with self._lock:
+            if n > self._pending:
+                self._pending = n
+            return self._pending
 
     def poll(self, hang_only: bool = False) -> None:
         """Raise :class:`EpochChanged` if a newer epoch than the one we
@@ -516,35 +475,24 @@ class MembershipCoordinator:
 
     # ----------------------------------------------------------- epoch
     def propose(self, members: List[int], reason: str) -> int:
-        """Allocate the next epoch number and publish its member list.
-        Monotone by construction: the number comes from a store ADD."""
-        n = self.store.add(self._k("seq"), 1)
-        rec = {"epoch": n, "members": sorted(int(m) for m in members),
-               "reason": reason, "proposer": self.rank,
-               "prev": self.epoch}
-        self.store.set(self._k("epoch", n), json.dumps(rec).encode())
-        self.store.set(self._k("propose"), str(n).encode())
+        """Allocate the next epoch number and publish its member list
+        through the substrate registry. Monotone by construction: the
+        number comes from a store ADD."""
+        n = self._epochs.propose(sorted(int(m) for m in members),
+                                 reason, proposer=self.rank,
+                                 prev=self.epoch)
         with self._lock:
             if n > self._pending:
                 self._pending = n
         return n
 
     def read_epoch(self, n: int) -> Optional[dict]:
-        try:
-            raw = try_get(self.store, self._k("epoch", n))
-            return None if raw is None else json.loads(raw.decode())
-        except Exception:
-            return None
+        return self._epochs.read(n)
 
     def current_commit(self) -> Optional[dict]:
         """The last committed epoch record published at ``cur`` (what a
         cold-started joiner reads to find the group)."""
-        try:
-            raw = try_get(self.store, self._k("cur"))
-            return None if raw is None else \
-                self.read_epoch(int(raw.decode()))
-        except Exception:
-            return None
+        return self._epochs.current()
 
     def request_join(self) -> None:
         self.store.set(self._k("join", self.rank),
@@ -616,8 +564,7 @@ class MembershipCoordinator:
             if self.rank not in members:
                 return rec      # demoted/excluded: caller rejoins
             if n not in acked:
-                self.store.set(self._k("epoch", n, "ack", self.rank),
-                               b"1")
+                self._epochs.ack(n, self.rank)
                 acked.add(n)
             committer = min(members)
             if committer == self.rank:
@@ -643,8 +590,8 @@ class MembershipCoordinator:
         deadline = time.monotonic() + self.cfg.timeout
         missing = [r for r in members if r != self.rank]
         while missing and time.monotonic() < deadline:
-            missing = [r for r in missing if not self.store.check(
-                self._k("epoch", n, "ack", r))]
+            missing = [r for r in missing
+                       if not self._epochs.acked(n, r)]
             if missing:
                 if self.refresh_pending() > n:
                     return False
@@ -656,15 +603,13 @@ class MembershipCoordinator:
         act = _faults.check("elastic.epoch_commit")
         if act is not None:
             _faults.apply(act)
-        self.store.set(self._k("epoch", n, "commit"), b"1")
-        self.store.set(self._k("cur"), str(n).encode())
+        self._epochs.commit(n)
         return True
 
     def _await_commit(self, n: int) -> bool:
         deadline = time.monotonic() + self.cfg.timeout
-        key = self._k("epoch", n, "commit")
         while time.monotonic() < deadline:
-            if self.store.check(key):
+            if self._epochs.committed(n):
                 return True
             if self.refresh_pending() > n:
                 return False

@@ -1,7 +1,9 @@
 """PS replication/failover control plane over the job TCPStore.
 
-Same lease discipline as ``elastic/membership.py`` (PR 13): servers
-beat ``ps/beat/{index}`` JSON timestamps; a lease is fresh within
+Same lease discipline as ``elastic/membership.py`` (PR 13), now spoken
+through the shared substrate (``distributed/control_plane/``): servers
+beat ``ps/beat/{index}`` JSON timestamps via
+``control_plane.lease.write_beat``; a lease is fresh within
 ``0.5 * failover_timeout``. The authoritative shard map lives at
 ``ps/primary/{shard}`` with a generation counter at ``ps/gen`` —
 workers cache it and re-resolve when an op fails or the generation
@@ -20,13 +22,13 @@ bit-exact.
 """
 from __future__ import annotations
 
-import json
 import os
 import pickle
 import time
 from typing import Callable, Optional
 
-from ..elastic.membership import read_beat, try_get
+from ..control_plane.lease import read_beat, write_beat
+from ..control_plane.store_util import try_get
 from ..resilience.retry import RetryPolicy, default_policy
 
 __all__ = ["PSConfig", "PSFailover", "ReplicationLog", "beat",
@@ -111,8 +113,7 @@ class PSConfig:
 # ------------------------------------------------------------ store keys
 
 def beat(store, index: int) -> None:
-    store.set(f"ps/beat/{index}",
-              json.dumps({"t": time.time()}).encode())
+    write_beat(store, "ps", index, {"t": time.time()})
 
 
 def lease_fresh(store, index: int, lease_timeout: float) -> bool:

@@ -12,7 +12,13 @@ A *plan* is a ``;``-separated list of rules::
   ``serving.step`` (inside the serving engine's retried dispatch),
   ``cluster.replica`` (top of every cluster replica step; ``kill`` /
   ``raise`` / ``drop`` there simulate a replica crash in-process —
-  drain + replay — rather than ``os._exit``),
+  drain + replay — rather than ``os._exit``; ``hang`` makes the
+  replica go SILENT instead: it stops stepping and beating but never
+  reports, so only the router's missed-lease scan can find it),
+  ``cp.lease`` (a heartbeat written through the shared control-plane
+  substrate, all namespaces; ``drop`` loses one beat on the wire),
+  ``cp.epoch`` (an epoch commit through the substrate; ``delay=<s>``
+  holds the commit open mid-transition),
   ``elastic.heartbeat`` (a rank's lease beat; ``drop`` skips the beat
   so peers see a missed-beat lease expiry), ``elastic.epoch_commit``
   (the coordinator's commit write; ``delay=<s>`` holds the epoch ack

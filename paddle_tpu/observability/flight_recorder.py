@@ -128,6 +128,9 @@ def dump_debug_bundle(dir_path: Optional[str] = None,
       estimates, memory phase ledger, flops cross-check
     - ``compile_ledger.json``   — per-jit-site compile counts/durations
       with recompile-cause attribution
+    - ``control_plane.json``    — live lease tables, epoch registries,
+      and composite planes (current epoch, members, per-member lease
+      freshness, recent membership transitions)
 
     Every section is written best-effort: one broken exporter must not
     cost the rest of the bundle. Returns the bundle directory."""
@@ -203,6 +206,14 @@ def dump_debug_bundle(dir_path: Optional[str] = None,
         led = _ledger.report()
         if led.get("sites"):
             _write_json(os.path.join(d, "compile_ledger.json"), led)
+    except Exception:
+        pass
+    try:
+        from ..distributed import control_plane as _cp
+
+        cps = _cp.snapshot_all()
+        if any(cps.get(k) for k in ("planes", "leases", "epochs")):
+            _write_json(os.path.join(d, "control_plane.json"), cps)
     except Exception:
         pass
     return d

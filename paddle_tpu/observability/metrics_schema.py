@@ -234,6 +234,33 @@ METRICS = {
     "cluster.step_time": MetricSpec(
         "histogram", "s", "wall time of one synchronous router step "
         "(round-robin replica steps + disagg pump)", TIME_BUCKETS),
+    "cluster.scale_up": MetricSpec(
+        "counter", "replicas", "autoscaler scale-out events: a fresh "
+        "replica warmed up, granted a lease, and committed into the "
+        "pool epoch under sustained pressure"),
+    "cluster.scale_down": MetricSpec(
+        "counter", "replicas", "autoscaler scale-in events: a replica "
+        "drained (clean leave + token-exact replay of in-flight work) "
+        "after sustained idle / want_scale_down"),
+    # ---- shared control-plane substrate (distributed/control_plane/)
+    "cp.beats": MetricSpec(
+        "counter", "beats", "heartbeat lease beats written through the "
+        "shared substrate, all namespaces (beats dropped at fault site "
+        "cp.lease do NOT count)"),
+    "cp.fenced_rejects": MetricSpec(
+        "counter", "beats", "stale-generation lease beats rejected by "
+        "fencing (a zombie writer beating with a superseded lease "
+        "generation)"),
+    "cp.lease_expiries": MetricSpec(
+        "counter", "leases", "members evicted because their lease "
+        "expired WITHOUT a clean-leave marker (missed beats, not "
+        "planned departures or self-reported deaths)"),
+    "cp.epochs": MetricSpec(
+        "counter", "epochs", "membership epochs committed through the "
+        "shared substrate (joins, leaves, evictions)"),
+    "cp.members": MetricSpec(
+        "gauge", "members", "member count of the most recently "
+        "committed epoch"),
     # ---- elastic self-healing training (distributed/elastic/)
     "elastic.heartbeats": MetricSpec(
         "counter", "beats", "membership lease beats written by this "

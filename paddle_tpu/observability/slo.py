@@ -115,7 +115,8 @@ class SLOEngine:
                  objectives: Optional[Sequence[Objective]] = None,
                  fast_s: Optional[float] = None,
                  slow_s: Optional[float] = None,
-                 page_burn: Optional[float] = None):
+                 page_burn: Optional[float] = None,
+                 util_low: Optional[float] = None):
         self._windows = list(windows) if isinstance(
             windows, (list, tuple)) else [windows]
         self.objectives = list(objectives if objectives is not None
@@ -126,6 +127,10 @@ class SLOEngine:
             _env_float("PADDLE_TPU_SLO_WINDOW_S", 0.0) or None
         self.page_burn = page_burn if page_burn is not None else \
             _env_float("PADDLE_TPU_SLO_PAGE_BURN", 4.0)
+        # utilization EWMA below this (while everything is OK) raises
+        # the want_scale_down hint — see load_signals()
+        self.util_low = util_low if util_low is not None else \
+            _env_float("PADDLE_TPU_SLO_UTIL_LOW", 0.25)
         self._lock = threading.Lock()
         self._last: Dict[str, dict] = {}  # guarded by: _lock
         _live.add(self)
@@ -220,7 +225,14 @@ class SLOEngine:
           objectives,
         * ``state`` — 0/1/2 for OK/WARN/BURN,
         * ``want_scale_up`` — 1.0 when the slow horizon is burning
-          (sustained, not a blip): the scaler's trigger bit.
+          (sustained, not a blip): the scaler's trigger bit,
+        * ``util`` — mean ``rt.slot_util`` EWMA across the merged
+          windows (0.0 when no engine has reported one yet),
+        * ``want_scale_down`` — 1.0 when everything is sustained-OK
+          (no burn on either horizon, zero sheds) AND utilization sits
+          below ``util_low`` (``PADDLE_TPU_SLO_UTIL_LOW``, default
+          0.25): the scaler's shrink bit. Time-decayed EWMAs make both
+          hints blip-proof by construction.
         """
         rep = self.evaluate()
         shed_fast = self._ratio("rt.shed", "rt.submitted", self.fast_s)
@@ -229,12 +241,28 @@ class SLOEngine:
         burns_s = [o["burn_slow"] for o in rep["objectives"].values()]
         worst_f = max(burns_f) if burns_f else 0.0
         worst_s = max(burns_s) if burns_s else 0.0
+        util = self._mean_ewma("rt.slot_util")
+        calm = (rep["state"] == OK and worst_f < 1.0 and worst_s < 1.0
+                and shed_fast == 0.0 and shed_slow == 0.0)
         return {"state": float(_STATE_RANK[rep["state"]]),
                 "shed_rate_fast": shed_fast,
                 "shed_rate_slow": shed_slow,
                 "worst_burn_fast": worst_f,
                 "worst_burn_slow": worst_s,
-                "want_scale_up": 1.0 if worst_s >= 1.0 else 0.0}
+                "want_scale_up": 1.0 if worst_s >= 1.0 else 0.0,
+                "util": util,
+                "want_scale_down": 1.0 if calm and util < self.util_low
+                else 0.0}
+
+    def _mean_ewma(self, name: str) -> float:
+        """Mean of one named EWMA across the member windows — reads
+        only instruments that already exist (never creates them, so a
+        router-only window set stays clean)."""
+        with self._lock:
+            windows = list(self._windows)
+        vals = [inst.value for w in windows for inst in w.instruments()
+                if isinstance(inst, _w.Ewma) and inst.name == name]
+        return sum(vals) / len(vals) if vals else 0.0
 
     def _ratio(self, num: str, den: str, window_s) -> float:
         n = self._counter_total(num, window_s)

@@ -1,5 +1,6 @@
 """Multi-replica serving cluster: prefix-affinity router, disaggregated
-prefill/decode, drain-and-replay resilience.
+prefill/decode, drain-and-replay resilience, lease-based liveness, and
+SLO-driven autoscaling.
 
 Quick start::
 
@@ -21,14 +22,40 @@ Disaggregated prefill/decode::
     from paddle_tpu.serving.cluster import DisaggPolicy
     router = ClusterRouter(reps, disagg=DisaggPolicy.split(reps))
 
+Control plane + autoscaling (PR 18)::
+
+    from paddle_tpu.serving.cluster import (Autoscaler, AutoscaleConfig,
+                                            ClusterControlPlane, Replica,
+                                            ClusterRouter)
+
+    cp = ClusterControlPlane()           # leases + epochs (LocalStore)
+    router = ClusterRouter(reps, control_plane=cp)
+    scaler = Autoscaler(router, spawn=lambda name: Replica(name, model),
+                        config=AutoscaleConfig(min_replicas=1,
+                                               max_replicas=4))
+    while router.step():                 # router evicts missed leases
+        scaler.tick()                    # scaler grows/shrinks the pool
+
+Replicas beat generation-fenced leases from their own ``step()``; the
+router discovers silent failures (the ``hang`` fault kind) through
+missed beats and drains them via the same token-exact replay path used
+for crashes. The substrate is shared with the elastic-DP and PS tiers
+(``paddle_tpu.distributed.control_plane``).
+
 ``PADDLE_TPU_CLUSTER_REPLICAS`` / ``PADDLE_TPU_CLUSTER_MAX_QUEUE``
 size the default topology in ``bench.py --cluster`` and
-``tools/serve_smoke.py --cluster``; the seeded kill used by the
-resilience tests is ``PADDLE_TPU_FAULT_PLAN="cluster.replica:kill@N"``.
+``tools/serve_smoke.py --cluster``; ``PADDLE_TPU_CLUSTER_BEAT`` /
+``PADDLE_TPU_CLUSTER_LEASE_TIMEOUT`` shape the liveness budget and
+``PADDLE_TPU_AUTOSCALE_*`` the scaling policy. The seeded kill used by
+the resilience tests is ``PADDLE_TPU_FAULT_PLAN="cluster.replica:kill@N"``
+(``hang@N`` for the silent flavour).
 """
+from .autoscaler import AutoscaleConfig, Autoscaler  # noqa: F401
 from .disagg import DisaggPolicy  # noqa: F401
+from .membership import ClusterControlPlane  # noqa: F401
 from .replica import FAULT_SITE, Replica  # noqa: F401
 from .router import ClusterRouter, Overloaded  # noqa: F401
 
 __all__ = ["Replica", "ClusterRouter", "Overloaded", "DisaggPolicy",
-           "FAULT_SITE"]
+           "FAULT_SITE", "ClusterControlPlane", "Autoscaler",
+           "AutoscaleConfig"]

@@ -1,0 +1,230 @@
+"""SLO-driven elastic replica pool: the Autoscaler.
+
+PR 16 built the sensory organ (:meth:`SLOEngine.load_signals`); this is
+the motor neuron. Each :meth:`Autoscaler.tick` reads one signal frame
+from the router's SLO engine plus the pool's queue/slot state and
+drives the pool between ``min_replicas`` and ``max_replicas``:
+
+* **scale-out** when pressure is SUSTAINED (``up_ticks`` consecutive
+  ticks) — pressure being the slow-horizon burn hint
+  (``want_scale_up``), a nonzero admission shed rate, or aggregate
+  queue depth at/over ``queue_hwm`` per alive replica, gated on
+  CURRENT demand (work queued, slots active, or fresh sheds): stale
+  burn over an idle pool never grows it. The spawned
+  replica ``warmup()``s the ragged+prefill jits BEFORE joining the
+  pool, so its first real token pays zero cold compiles.
+* **scale-in** when the pool is SUSTAINED idle (``idle_ticks``
+  consecutive ticks with empty queues, no active slots, and no sheds)
+  or the SLO engine's ``want_scale_down`` hint fires (sustained all-OK
+  + low utilization EWMA). The victim drains before leaving: clean
+  leave marker on the control plane, in-flight descriptors replayed
+  onto survivors token-exactly (greedy decoding makes the continuation
+  exact — the same replay path replica death uses).
+
+Every scale event sits behind a ``cooldown_ticks`` refractory window so
+one burst cannot slam the pool back and forth.
+
+Env knobs (ctor args win): ``PADDLE_TPU_AUTOSCALE_MIN`` / ``_MAX`` /
+``_UP_TICKS`` / ``_IDLE_TICKS`` / ``_COOLDOWN_TICKS`` / ``_QUEUE_HWM``
+/ ``_SHED_THRESHOLD``.
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Callable, Optional
+
+from ... import observability as _obs
+from .replica import Replica
+
+__all__ = ["Autoscaler", "AutoscaleConfig"]
+
+
+def _env_i(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def _env_f(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+class AutoscaleConfig:
+    """Scaling policy knobs (``PADDLE_TPU_AUTOSCALE_*``)."""
+
+    def __init__(self, min_replicas: Optional[int] = None,
+                 max_replicas: Optional[int] = None,
+                 up_ticks: Optional[int] = None,
+                 idle_ticks: Optional[int] = None,
+                 cooldown_ticks: Optional[int] = None,
+                 queue_hwm: Optional[int] = None,
+                 shed_threshold: Optional[float] = None):
+        self.min_replicas = min_replicas if min_replicas is not None \
+            else _env_i("PADDLE_TPU_AUTOSCALE_MIN", 1)
+        self.max_replicas = max_replicas if max_replicas is not None \
+            else _env_i("PADDLE_TPU_AUTOSCALE_MAX", 4)
+        # consecutive pressured ticks before scale-out
+        self.up_ticks = up_ticks if up_ticks is not None \
+            else _env_i("PADDLE_TPU_AUTOSCALE_UP_TICKS", 3)
+        # consecutive idle ticks before scale-in
+        self.idle_ticks = idle_ticks if idle_ticks is not None \
+            else _env_i("PADDLE_TPU_AUTOSCALE_IDLE_TICKS", 10)
+        # refractory ticks after ANY scale event
+        self.cooldown_ticks = cooldown_ticks \
+            if cooldown_ticks is not None \
+            else _env_i("PADDLE_TPU_AUTOSCALE_COOLDOWN_TICKS", 10)
+        # aggregate queue depth per alive replica that counts as
+        # pressure even before sheds/burn appear
+        self.queue_hwm = queue_hwm if queue_hwm is not None \
+            else _env_i("PADDLE_TPU_AUTOSCALE_QUEUE_HWM", 4)
+        # fast-horizon shed rate above this is pressure
+        self.shed_threshold = shed_threshold \
+            if shed_threshold is not None \
+            else _env_f("PADDLE_TPU_AUTOSCALE_SHED_THRESHOLD", 0.0)
+        if self.min_replicas < 1:
+            raise ValueError("min_replicas must be >= 1")
+        if self.max_replicas < self.min_replicas:
+            raise ValueError("max_replicas < min_replicas")
+
+
+class Autoscaler:
+    """One scaling loop over a :class:`ClusterRouter`. ``spawn(name)``
+    is the replica factory (model + engine knobs live with the caller);
+    the Autoscaler owns WHEN, the router owns HOW (warmup, control-plane
+    join, drain-before-leave)."""
+
+    def __init__(self, router, spawn: Callable[[str], Replica],
+                 config: Optional[AutoscaleConfig] = None,
+                 clock: Callable[[], float] = time.time):
+        self.router = router
+        self.spawn = spawn
+        self.cfg = config or AutoscaleConfig()
+        self.clock = clock
+        self.last_event: Optional[dict] = None
+        self._up = 0
+        self._idle = 0
+        self._cooldown = 0
+        self._ticks = 0
+        self._next_index = len(router.replicas)
+        router.autoscaler = self
+
+    # ------------------------------------------------------------- state
+    def _pool(self):
+        return [r for r in self.router.replicas if r.alive]
+
+    def _fresh_name(self) -> str:
+        taken = {r.name for r in self.router.replicas}
+        while True:
+            name = "r%d" % self._next_index
+            self._next_index += 1
+            if name not in taken:
+                return name
+
+    # -------------------------------------------------------------- tick
+    def tick(self) -> Optional[dict]:
+        """One control decision. Returns the scale event fired this
+        tick (None for the common no-op tick)."""
+        self._ticks += 1
+        # cooldown_ticks=N blocks exactly the N ticks after an event
+        # (streak counters keep accumulating underneath)
+        in_cooldown = self._cooldown > 0
+        if in_cooldown:
+            self._cooldown -= 1
+        sig = self.router.slo.load_signals()
+        pool = self._pool()
+        if not pool:
+            return None
+        stats = [r.stats() for r in pool]
+        queue = sum(s.queue_depth for s in stats)
+        active = sum(s.active_slots for s in stats)
+
+        # burn/shed hints count as pressure only while there is CURRENT
+        # demand: historical burn over an empty idle pool cannot be
+        # fixed by adding replicas (with a full-span slow horizon it
+        # never ages out, and hint-driven scale-out would flap forever
+        # against idle scale-in)
+        demand = queue > 0 or active > 0 \
+            or sig.get("shed_rate_fast", 0.0) > 0.0
+        pressure = demand and (
+            sig.get("want_scale_up", 0.0) >= 1.0
+            or sig.get("shed_rate_fast", 0.0) > self.cfg.shed_threshold
+            or queue >= self.cfg.queue_hwm * len(pool))
+        idle = queue == 0 and active == 0 and \
+            sig.get("shed_rate_fast", 0.0) == 0.0
+        want_down = sig.get("want_scale_down", 0.0) >= 1.0
+
+        self._up = self._up + 1 if pressure else 0
+        self._idle = self._idle + 1 if idle else 0
+
+        if in_cooldown:
+            return None
+        if self._up >= self.cfg.up_ticks and \
+                len(pool) < self.cfg.max_replicas:
+            return self._scale_out(sig, queue)
+        if len(pool) > self.cfg.min_replicas and \
+                (self._idle >= self.cfg.idle_ticks
+                 or (want_down and idle)):
+            return self._scale_in(sig)
+        return None
+
+    # ------------------------------------------------------------ actions
+    def _scale_out(self, sig: dict, queue: int) -> dict:
+        name = self._fresh_name()
+        replica = self.spawn(name)
+        # warm=True: the joining replica pre-traces the ragged+prefill
+        # jits before it is routable — zero cold compiles under traffic
+        self.router.add_replica(replica, warm=True)
+        event = {"kind": "scale_up", "replica": name,
+                 "t": self.clock(), "tick": self._ticks,
+                 "queue": queue,
+                 "want_scale_up": sig.get("want_scale_up", 0.0),
+                 "shed_rate_fast": sig.get("shed_rate_fast", 0.0)}
+        self._after(event)
+        if _obs.enabled():
+            _obs.registry.counter("cluster.scale_up").inc()
+        return event
+
+    def _scale_in(self, sig: dict) -> dict:
+        # victim: the most recently added alive replica — the pool
+        # shrinks in LIFO order, keeping the long-lived replicas (and
+        # their prefix caches) hot
+        victim = next(r for r in reversed(self.router.replicas)
+                      if r.alive)
+        self.router.remove_replica(victim, drain=True)
+        event = {"kind": "scale_down", "replica": victim.name,
+                 "t": self.clock(), "tick": self._ticks,
+                 "idle_ticks": self._idle,
+                 "want_scale_down": sig.get("want_scale_down", 0.0)}
+        self._after(event)
+        if _obs.enabled():
+            _obs.registry.counter("cluster.scale_down").inc()
+        return event
+
+    def _after(self, event: dict) -> None:
+        self.last_event = event
+        self._up = 0
+        self._idle = 0
+        self._cooldown = self.cfg.cooldown_ticks
+        if _obs.enabled():
+            # the event's own "kind" (scale_up/scale_down) must not
+            # shadow the recorder's positional event kind
+            _obs.flight_recorder.record(
+                "cluster.scale",
+                **{k: v for k, v in event.items() if k != "kind"},
+                direction=event["kind"])
+
+    # ----------------------------------------------------------- snapshot
+    def snapshot(self) -> dict:
+        """The ``scale`` section of the router's ops snapshot (what
+        ``tools/ptop.py`` renders)."""
+        return {"replicas": len(self._pool()),
+                "min": self.cfg.min_replicas,
+                "max": self.cfg.max_replicas,
+                "up_ticks": self._up, "idle_ticks": self._idle,
+                "cooldown": self._cooldown, "ticks": self._ticks,
+                "last_event": self.last_event}

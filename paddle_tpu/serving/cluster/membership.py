@@ -1,0 +1,182 @@
+"""ClusterControlPlane: serving replicas as lease-holding members.
+
+The serving cluster is the first NEW consumer of the shared
+control-plane substrate (``distributed/control_plane/``): instead of a
+static replica list with a manual-only ``fail_all()`` crash path, each
+replica holds a generation-fenced heartbeat lease (beaten from its own
+``step()``), membership changes are committed epochs, and the router
+discovers death through **missed beats** — the exact discipline the
+elastic DP and PS tiers run across processes, here over an in-process
+:class:`~paddle_tpu.distributed.control_plane.LocalStore` (any
+TCPStore-surface store works; a multi-host pool would pass the job
+store).
+
+Epoch policy is the single-committer special case: the router is the
+sole proposer and committer, so a join/leave/evict is
+propose -> self-ack -> commit in one call. What stays shared with the
+multi-process tiers is everything that matters for drills — key
+layout, fencing, clean-leave vs missed-beat disambiguation, and the
+``cp.lease`` / ``cp.epoch`` fault sites.
+
+Env knobs: ``PADDLE_TPU_CLUSTER_BEAT`` (replica beat interval hint,
+seconds; the router beats on every replica step, so this mostly feeds
+derived deadlines) and ``PADDLE_TPU_CLUSTER_LEASE_TIMEOUT`` (seconds
+without a beat before a replica is presumed dead — the failure
+budget).
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from typing import Callable, List, Optional
+
+from ... import observability as _obs
+from ...distributed import control_plane as _cp
+from ...distributed.control_plane import (EpochRegistry, LeaseTable,
+                                          LocalStore)
+
+__all__ = ["ClusterControlPlane"]
+
+
+def _env_f(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+class ClusterControlPlane:
+    """Lease + epoch view of one replica pool. Clock-injectable: the
+    autoscale smoke and the control-plane tests drive it with
+    ManualClock, zero sleeps."""
+
+    def __init__(self, namespace: str = "cluster",
+                 beat_interval: Optional[float] = None,
+                 lease_timeout: Optional[float] = None,
+                 clock: Callable[[], float] = time.time,
+                 store=None):
+        self.ns = str(namespace)
+        self.beat_interval = beat_interval if beat_interval is not None \
+            else _env_f("PADDLE_TPU_CLUSTER_BEAT", 0.5)
+        self.lease_timeout = lease_timeout if lease_timeout is not None \
+            else _env_f("PADDLE_TPU_CLUSTER_LEASE_TIMEOUT", 2.0)
+        self.clock = clock
+        self.store = store if store is not None else LocalStore()
+        self.leases = LeaseTable(self.store, self.ns,
+                                 self.lease_timeout, clock)
+        self.epochs = EpochRegistry(self.store, self.ns, clock)
+        self._lock = threading.Lock()
+        self.epoch = 0                    # guarded by: _lock
+        self._members: List[str] = []     # guarded by: _lock
+        self._gens: dict = {}             # guarded by: _lock
+        self._transitions: deque = deque(maxlen=64)  # guarded by: _lock
+        _cp.register_plane(self)
+
+    # ------------------------------------------------------------ state
+    @property
+    def members(self) -> List[str]:
+        with self._lock:
+            return list(self._members)
+
+    def _commit(self, members: List[str], reason: str) -> int:
+        """Single-committer epoch bump: propose, self-ack for every
+        member (the router answers for its in-process replicas), and
+        commit — the substrate's ``cp.epoch`` fault site fires inside
+        ``commit``."""
+        with self._lock:
+            prev = self.epoch
+        n = self.epochs.propose(sorted(members), reason,
+                                proposer="router", prev=prev)
+        for m in members:
+            self.epochs.ack(n, m)
+        self.epochs.commit(n)
+        with self._lock:
+            self.epoch = n
+            self._members = sorted(members)
+            self._transitions.append(
+                {"t": self.clock(), "kind": "epoch", "epoch": n,
+                 "members": sorted(members), "reason": reason})
+        if _obs.enabled():
+            _obs.flight_recorder.record(
+                "cp.epoch_commit", ns=self.ns, epoch=n,
+                members=sorted(members), reason=reason)
+        return n
+
+    # ---------------------------------------------------------- lifecycle
+    def join(self, name: str) -> int:
+        """Grant ``name`` a fenced lease and commit the grown epoch.
+        Returns the lease generation the member's beats must carry."""
+        gen = self.leases.grant(name)
+        with self._lock:
+            self._gens[name] = gen
+            members = sorted(set(self._members) | {name})
+        self._commit(members, f"join {name}")
+        return gen
+
+    def leave(self, name: str) -> None:
+        """Clean departure: publish the leave marker (so the next scan
+        never reports this as a missed beat), then commit the shrunk
+        epoch."""
+        self.leases.leave(name)
+        with self._lock:
+            members = sorted(m for m in self._members if m != name)
+            self._gens.pop(name, None)
+        self._commit(members, f"leave {name}")
+        self.leases.forget(name)
+
+    def beat(self, name: str) -> bool:
+        """One fenced lease beat for ``name`` (False when fenced out or
+        dropped at ``cp.lease``)."""
+        with self._lock:
+            gen = self._gens.get(name)
+        return self.leases.beat(name, gen=gen)
+
+    # ---------------------------------------------------------- liveness
+    def fresh(self, name: str) -> bool:
+        return self.leases.fresh(name)
+
+    def missed(self) -> List[str]:
+        """Members whose lease expired WITHOUT a clean-leave marker —
+        the router's eviction candidates."""
+        return self.leases.missed(self.members)
+
+    def evict(self, name: str, reason: str = "missed_beat") -> None:
+        """Remove a presumed-dead member: epoch shrinks, lease keys are
+        reaped. The caller (router) owns draining the replica itself."""
+        with self._lock:
+            if name not in self._members:
+                return
+            members = sorted(m for m in self._members if m != name)
+            self._gens.pop(name, None)
+        # only genuine lease expiries count; self-reported deaths
+        # arrive here with reason="died"
+        if _obs.enabled() and reason == "missed_beat":
+            _obs.registry.counter("cp.lease_expiries").inc()
+        self._commit(members, f"evict {name}: {reason}")
+        self.leases.forget(name)
+
+    # ---------------------------------------------------------- snapshot
+    def snapshot(self) -> dict:
+        """The ``control_plane.json`` bundle payload for this pool:
+        current epoch, members, per-member lease freshness, and the
+        recent transition ring."""
+        with self._lock:
+            members = list(self._members)
+            epoch = self.epoch
+            transitions = list(self._transitions)
+        now = self.clock()
+        leases = {}
+        for m in members:
+            b = self.leases.read(m)
+            leases[m] = {
+                "beat": b,
+                "fresh": b is not None and
+                now - float(b.get("t", 0.0)) <= self.lease_timeout,
+                "generation": self.leases.generation(m),
+            }
+        return {"kind": "cluster_control_plane", "ns": self.ns,
+                "epoch": epoch, "members": members,
+                "lease_timeout": self.lease_timeout, "now": now,
+                "leases": leases, "transitions": transitions}

@@ -17,6 +17,18 @@ descriptor of every in-flight request, ends their streams with
 ``replica_dead``, and releases all KV pages. The descriptors flow to
 the router's ``on_death`` callback, which replays them on survivors.
 Generic kinds (``delay``) still go through ``faults.apply``.
+
+The ``hang`` kind is the control-plane flavour of death: the replica
+goes silent (stops stepping, therefore stops beating its lease) but
+stays ``alive`` — nobody reports the crash. Detection is the router's
+job: the lease expires, ``missed()`` names the replica, the router
+evicts it and calls :meth:`die` to drain-and-replay. This is the
+failure mode the lease substrate exists for; ``kill`` deaths are
+self-reporting by comparison.
+
+When the router runs a :class:`ClusterControlPlane`, every productive
+step also beats the replica's fenced lease — liveness is a byproduct of
+doing work, exactly like the elastic DP trainers.
 """
 from __future__ import annotations
 
@@ -56,14 +68,23 @@ class Replica:
         self.on_death: Optional[
             Callable[["Replica", Tuple[RequestDescriptor, ...]],
                      None]] = None
+        # set by ClusterRouter.add_replica when a control plane runs;
+        # step() then beats the fenced lease on every productive pass
+        self.control_plane = None
         self._lock = threading.Lock()
         self._alive = True  # guarded by: _lock
+        self._hung = False  # guarded by: _lock
 
     # ------------------------------------------------------------ health
     @property
     def alive(self) -> bool:
         with self._lock:
             return self._alive
+
+    @property
+    def hung(self) -> bool:
+        with self._lock:
+            return self._hung
 
     def stats(self) -> EngineStats:
         """Thread-safe engine health snapshot (lock-held on the engine
@@ -100,14 +121,24 @@ class Replica:
         in-flight work into descriptors and hand them to ``on_death``
         synchronously — by the time step() returns, the router has
         already replayed them."""
-        if not self.alive:
-            return False
+        with self._lock:
+            if not self._alive or self._hung:
+                return False
         act = faults.check(self.fault_site)
         if act is not None:
             if act.kind in _DEATH_KINDS:
                 self.die()
                 return False
+            if act.kind == "hang":
+                # go silent: stop stepping (and therefore beating), but
+                # stay alive — the router must DISCOVER this through the
+                # missed lease, there is no crash report
+                with self._lock:
+                    self._hung = True
+                return False
             faults.apply(act)
+        if self.control_plane is not None:
+            self.control_plane.beat(self.name)
         return self.engine.step()
 
     def die(self) -> Tuple[RequestDescriptor, ...]:
@@ -119,6 +150,22 @@ class Replica:
         descs = self.engine.fail_all("replica_dead")
         if _obs.enabled():
             _obs.registry.counter("cluster.replica_deaths").inc()
+        cb = self.on_death
+        if cb is not None:
+            cb(self, descs)
+        return descs
+
+    def retire(self) -> Tuple[RequestDescriptor, ...]:
+        """Planned departure (autoscaler scale-in): the same atomic
+        drain-and-replay path as :meth:`die` — in-flight work becomes
+        descriptors the router replays token-exactly on survivors — but
+        NOT counted as a death: the control plane published a clean
+        leave, nothing crashed."""
+        with self._lock:
+            if not self._alive:
+                return ()
+            self._alive = False
+        descs = self.engine.fail_all("replica_dead")
         cb = self.on_death
         if cb is not None:
             cb(self, descs)

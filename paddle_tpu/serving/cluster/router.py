@@ -30,6 +30,20 @@ cluster-only behaviours:
   duplicated. Replays bypass admission control on purpose: shedding is
   for new work, not for work the cluster already accepted.
 
+* **Control-plane liveness (PR 18)** — pass a
+  :class:`ClusterControlPlane` and every replica becomes a
+  lease-holding member: it beats a generation-fenced lease from its
+  own ``step()``, joins/leaves through committed epochs, and the
+  router's per-step ``_cp_scan`` EVICTS members whose lease expired
+  without a clean-leave marker (then drains them through the same
+  replay path). That turns silent failures (the ``hang`` fault kind —
+  a replica that stops working without crashing) into bounded-time
+  recoveries; ``fail_all``-style crashes stay self-reporting. The pool
+  is also elastic: :meth:`add_replica` (warmup → lease grant → epoch
+  commit → routable) and :meth:`remove_replica` (clean leave →
+  drain-and-replay → gone) are what the
+  :class:`~paddle_tpu.serving.cluster.autoscaler.Autoscaler` drives.
+
 Driving: ``router.step()`` runs one synchronous round-robin pass over
 all replicas (deterministic — this is what tests and the fault plans
 use, since the ``cluster.replica`` fault counter is per-site);
@@ -89,19 +103,25 @@ class ClusterRouter:
 
     def __init__(self, replicas: Sequence[Replica],
                  max_queue: Optional[int] = None,
-                 disagg: Optional[object] = None):
+                 disagg: Optional[object] = None,
+                 control_plane: Optional[object] = None):
         if not replicas:
             raise ValueError("need at least one replica")
         self.replicas = list(replicas)
         self.max_queue = max_queue if max_queue is not None else \
             _env_int("PADDLE_TPU_CLUSTER_MAX_QUEUE", 32)
         self.disagg = disagg            # DisaggPolicy or None
+        self.control_plane = control_plane  # ClusterControlPlane or None
+        self.autoscaler = None          # set by Autoscaler.__init__
         self.block_size = \
             self.replicas[0].engine.manager.block_size
         for r in self.replicas:
             if r.engine.manager.block_size != self.block_size:
                 raise ValueError("replicas disagree on block_size")
             r.on_death = self._on_death
+            if control_plane is not None:
+                r.control_plane = control_plane
+                control_plane.join(r.name)
         self._cond = threading.Condition()
         self._crid = 0  # guarded by: _cond
         self._recs: Dict[int, _ClientReq] = {}  # guarded by: _cond
@@ -186,6 +206,11 @@ class ClusterRouter:
                            "max_queue": self.max_queue},
                 "slo": self.slo.evaluate(),
                 "signals": self.slo.load_signals(),
+                "control_plane": (self.control_plane.snapshot()
+                                  if self.control_plane is not None
+                                  else None),
+                "scale": (self.autoscaler.snapshot()
+                          if self.autoscaler is not None else None),
                 "attribution": attribution_of(all_windows),
                 "requests": tails[-50:]}
 
@@ -354,6 +379,11 @@ class ClusterRouter:
             if crid is None:
                 continue                 # not one of ours (warmup etc.)
             self._replay(crid, d)
+        # self-reporting deaths (kill/raise/drop) shrink the epoch here;
+        # lease-discovered ones were already evicted by _cp_scan and
+        # clean leaves by remove_replica — evict() is idempotent
+        if self.control_plane is not None:
+            self.control_plane.evict(replica.name, reason="died")
 
     def _replay(self, crid: int, d: RequestDescriptor) -> None:
         with span("cluster.replay"):
@@ -412,17 +442,86 @@ class ClusterRouter:
             self._by_engine[(target.name, rid)] = crid
             self._cond.notify_all()
 
+    # --------------------------------------------------------- elasticity
+    def add_replica(self, replica: Replica, warm: bool = True) -> None:
+        """Grow the pool by one replica: warm it up FIRST (pre-trace the
+        step programs so its first routed token pays zero cold
+        compiles), grant its lease + commit the grown epoch on the
+        control plane, then make it routable. Safe in both driving
+        modes — threaded mode gets a stepping thread on the spot."""
+        if replica.engine.manager.block_size != self.block_size:
+            raise ValueError("replicas disagree on block_size")
+        if warm:
+            replica.warmup()
+        replica.on_death = self._on_death
+        if self.control_plane is not None:
+            replica.control_plane = self.control_plane
+            self.control_plane.join(replica.name)
+        with self._cond:
+            self.replicas.append(replica)
+        if self._slo is not None:
+            self._slo.add_windows(replica.engine.windows)
+        if self._threads and not self._stop.is_set():
+            self._spawn_rep_thread(replica)
+        if _obs.enabled():
+            _obs.flight_recorder.record("cluster.replica_join",
+                                        replica=replica.name,
+                                        warm=bool(warm))
+
+    def remove_replica(self, replica: Replica,
+                       drain: bool = True) -> None:
+        """Shrink the pool by one replica, cleanly: publish the
+        clean-leave marker + commit the shrunk epoch FIRST (so no
+        concurrent scan mistakes the drain for a missed beat), then
+        drain — in-flight requests become descriptors the usual
+        ``on_death`` path replays token-exactly on survivors. Replays
+        bypass admission control: this is work the cluster already
+        accepted."""
+        if self.control_plane is not None:
+            self.control_plane.leave(replica.name)
+        if drain:
+            replica.retire()
+        with self._cond:
+            if replica in self.replicas:
+                self.replicas.remove(replica)
+            stale = [h for h, r in self._affinity.items()
+                     if r is replica]
+            for h in stale:
+                del self._affinity[h]
+        # fail_all released every page, so the leak check must pass
+        replica.shutdown(check_leaks=drain)
+        if _obs.enabled():
+            _obs.flight_recorder.record("cluster.replica_leave",
+                                        replica=replica.name,
+                                        drained=bool(drain))
+
+    def _cp_scan(self) -> None:
+        """Evict members whose lease expired without a clean leave —
+        the discovery path for SILENT failures (``hang``): the epoch
+        shrinks, then :meth:`Replica.die` drains the zombie so its
+        in-flight work replays on survivors."""
+        if self.control_plane is None:
+            return
+        for name in self.control_plane.missed():
+            rep = next((r for r in self.replicas if r.name == name),
+                       None)
+            self.control_plane.evict(name, "missed_beat")
+            if rep is not None and rep.alive:
+                rep.die()
+
     # ----------------------------------------------------------- driving
     def num_alive(self) -> int:
         return sum(1 for r in self.replicas if r.alive)
 
     def step(self) -> bool:
-        """One synchronous round: step every alive replica round-robin,
-        pump disagg handoffs, publish cluster gauges. Deterministic —
-        the test/fault-plan driver."""
+        """One synchronous round: scan the control plane for expired
+        leases, step every alive replica round-robin, pump disagg
+        handoffs, publish cluster gauges. Deterministic — the
+        test/fault-plan driver."""
         t0 = time.monotonic()
+        self._cp_scan()
         did = False
-        for rep in self.replicas:
+        for rep in list(self.replicas):
             if rep.alive:
                 did = rep.step() or did
         if self.disagg is not None:
@@ -437,6 +536,17 @@ class ClusterRouter:
                 time.monotonic() - t0)
         return did
 
+    def _spawn_rep_thread(self, rep: Replica) -> None:
+        def rep_loop() -> None:
+            while not self._stop.is_set():
+                if not (rep.alive and rep.step()):
+                    time.sleep(0.001)
+
+        t = threading.Thread(target=rep_loop, daemon=True,
+                             name="cluster-%s" % rep.name)
+        t.start()
+        self._threads.append(t)
+
     def start(self) -> None:
         """Threaded mode: one stepping thread per replica (XLA releases
         the GIL during compute, so replicas overlap on CPU too) plus a
@@ -444,18 +554,8 @@ class ClusterRouter:
         if self._threads:
             return
         self._stop.clear()
-
-        def rep_loop(rep: Replica) -> None:
-            while not self._stop.is_set():
-                if not (rep.alive and rep.step()):
-                    time.sleep(0.001)
-
         for rep in self.replicas:
-            t = threading.Thread(target=rep_loop, args=(rep,),
-                                 daemon=True,
-                                 name="cluster-%s" % rep.name)
-            t.start()
-            self._threads.append(t)
+            self._spawn_rep_thread(rep)
         if self.disagg is not None:
             def pump_loop() -> None:
                 while not self._stop.is_set():

@@ -475,19 +475,32 @@ class ServingEngine:
         ``PADDLE_TPU_SERVE_RAGGED=off`` — so a fresh replica serves its
         first token without a cold compile. The
         1-token prompt registers nothing in the prefix cache (only full
-        blocks are hashed) and the pool drains back to empty."""
+        blocks are hashed) and the pool drains back to empty.
+
+        The warmup request is synthetic, so it records into a scratch
+        access log that is discarded afterwards: its compile-inflated
+        TTFT must not land in the real ``rt.*`` windows, where one
+        multi-second sample would keep the SLO burn (and with it the
+        autoscaler's ``want_scale_up`` hint) lit for the whole slow
+        horizon."""
         if self._thread is not None:
             raise RuntimeError("warmup() must run before start()")
-        rid = self.submit([int(token)], max_new_tokens=2)
-        steps = 0
-        while self.step():
-            steps += 1
-            if steps > 64:
-                raise RuntimeError("warmup failed to drain")
-        list(self.stream(rid))          # queue already holds the end
-        with self._lock:
-            self._requests.pop(rid, None)
-            self._streams.pop(rid, None)
+        from ..observability.request_log import RequestLog
+        real_log = self._log
+        self._log = RequestLog(source=self.config.name + ".warmup")
+        try:
+            rid = self.submit([int(token)], max_new_tokens=2)
+            steps = 0
+            while self.step():
+                steps += 1
+                if steps > 64:
+                    raise RuntimeError("warmup failed to drain")
+            list(self.stream(rid))      # queue already holds the end
+            with self._lock:
+                self._requests.pop(rid, None)
+                self._streams.pop(rid, None)
+        finally:
+            self._log = real_log
 
     # ------------------------------------------- disaggregated handoff
     def _export_pages(self, blocks: List[int]):  # ptlint: holds=_lock

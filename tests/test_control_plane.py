@@ -1,0 +1,614 @@
+"""Shared control-plane substrate (distributed/control_plane/): the
+LocalStore surface, generation-fenced heartbeat leases, propose/ack/
+commit epochs, the randomized lease/fencing property drill (ManualClock,
+zero sleeps), the serving cluster's composite plane, drain-before-leave
+through the router, and the Autoscaler's tick policy."""
+import json
+import random
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.distributed.control_plane import (EpochChanged,
+                                                  EpochRegistry,
+                                                  LeaseTable, LocalStore,
+                                                  snapshot_all, try_get,
+                                                  write_beat)
+from paddle_tpu.distributed.resilience import faults
+from paddle_tpu.observability.windows import ManualClock
+from paddle_tpu.serving.cluster import (AutoscaleConfig, Autoscaler,
+                                        ClusterControlPlane,
+                                        ClusterRouter, Replica)
+
+
+@pytest.fixture(scope="module")
+def model():
+    pt.seed(11)
+    cfg = pt.models.gpt_tiny(dropout=0.0, attention_dropout=0.0)
+    m = pt.models.GPTForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def _prompts(m, lens, seed=0):
+    rng = np.random.RandomState(seed)
+    v = m.config.vocab_size
+    return [rng.randint(0, v, n).tolist() for n in lens]
+
+
+def _ref(m, prompt, max_new):
+    out = m.generate(pt.to_tensor(np.asarray([prompt], np.int64)),
+                     max_new_tokens=max_new).numpy()
+    return out[0].tolist()
+
+
+# -------------------------------------------------------------- LocalStore
+class TestLocalStore:
+    def test_surface(self):
+        s = LocalStore()
+        s.set("a", b"1")
+        assert s.get("a") == b"1"
+        assert s.check("a") and not s.check("b")
+        assert s.try_get("b") is None
+        with pytest.raises(KeyError):
+            s.get("b")
+        assert s.delete("a") and not s.delete("a")
+        assert s.num_keys() == 0
+
+    def test_add_is_a_monotone_counter(self):
+        s = LocalStore()
+        assert s.add("n", 1) == 1
+        assert s.add("n", 2) == 3
+        assert s.add("n", 0) == 3        # read without bump
+        assert s.get("n") == b"3"        # str-encoded, TCPStore idiom
+
+    def test_keys_prefix(self):
+        s = LocalStore()
+        for k in ("ns/beat/a", "ns/beat/b", "other"):
+            s.set(k, b"x")
+        assert s.keys("ns/beat/") == ["ns/beat/a", "ns/beat/b"]
+
+    def test_try_get_helper_without_native_try_get(self):
+        class Fake:
+            def __init__(self):
+                self.d = {}
+
+            def check(self, k):
+                return k in self.d
+
+            def get(self, k):
+                return self.d[k]
+
+        f = Fake()
+        assert try_get(f, "x") is None
+        f.d["x"] = b"v"
+        assert try_get(f, "x") == b"v"
+
+
+# -------------------------------------------------------------- LeaseTable
+class TestLeaseTable:
+    def test_grant_beat_fresh_expire(self):
+        clk = ManualClock(100.0)
+        lt = LeaseTable(LocalStore(), "t", timeout=1.0, clock=clk)
+        gen = lt.grant("a")
+        assert gen == 1 and lt.fresh("a")
+        clk.advance(0.9)
+        assert lt.fresh("a")             # inside the budget
+        clk.advance(0.2)
+        assert not lt.fresh("a")         # expired, nothing slept
+        assert lt.beat("a", gen=gen)     # a late beat resurrects it
+        assert lt.fresh("a")
+
+    def test_generation_fencing_rejects_zombies(self):
+        clk = ManualClock(0.0)
+        lt = LeaseTable(LocalStore(), "t", timeout=1.0, clock=clk)
+        g1 = lt.grant("a")
+        g2 = lt.grant("a")               # replacement holder
+        assert g2 == g1 + 1
+        clk.advance(2.0)                 # lease expired
+        assert not lt.beat("a", gen=g1)  # zombie: rejected, not written
+        assert not lt.fresh("a")
+        assert lt.beat("a", gen=g2)      # the live holder beats fine
+        assert lt.fresh("a")
+        assert lt.read("a")["gen"] == g2
+
+    def test_clean_leave_vs_missed_beat(self):
+        clk = ManualClock(0.0)
+        lt = LeaseTable(LocalStore(), "t", timeout=1.0, clock=clk)
+        lt.grant("dead")
+        lt.grant("polite")
+        lt.leave("polite")
+        clk.advance(5.0)                 # both leases are gone
+        assert lt.missed(["dead", "polite"]) == ["dead"]
+        assert lt.left("polite") and not lt.left("dead")
+        lt.forget("polite")
+        assert not lt.left("polite")     # tombstones reaped
+
+    def test_grant_clears_stale_leave_marker(self):
+        clk = ManualClock(0.0)
+        lt = LeaseTable(LocalStore(), "t", timeout=1.0, clock=clk)
+        lt.grant("a")
+        lt.leave("a")
+        lt.grant("a")                    # rejoins under a new gen
+        clk.advance(5.0)
+        assert lt.missed(["a"]) == ["a"]  # a real miss again, not left
+
+    def test_beat_payload_fields_and_scan(self):
+        clk = ManualClock(7.0)
+        lt = LeaseTable(LocalStore(), "t", timeout=1.0, clock=clk)
+        lt.grant("a", step=3)
+        b = lt.read("a")
+        assert b["t"] == 7.0 and b["step"] == 3 and b["gen"] == 1
+        beats = lt.scan(["a", "ghost"])
+        assert beats["a"]["t"] == 7.0 and beats["ghost"] is None
+
+    def test_snapshot_shape(self):
+        clk = ManualClock(0.0)
+        lt = LeaseTable(LocalStore(), "t", timeout=1.0, clock=clk)
+        lt.grant("a")
+        snap = lt.snapshot()
+        assert snap["kind"] == "lease_table" and snap["ns"] == "t"
+        assert snap["members"]["a"]["fresh"]
+        assert snap["members"]["a"]["generation"] == 1
+        assert json.dumps(snap)          # bundle-ready
+
+    def test_cp_lease_drop_fault_loses_one_beat(self):
+        clk = ManualClock(0.0)
+        lt = LeaseTable(LocalStore(), "t", timeout=1.0, clock=clk)
+        gen = lt.grant("a")              # the grant's beat (written)
+        clk.advance(0.8)
+        # the fault counter starts at configure: the NEXT beat is @1
+        faults.configure("cp.lease:drop@1", seed=0)
+        try:
+            assert not lt.beat("a", gen=gen)   # dropped on the wire
+            assert lt.read("a")["t"] == 0.0    # old beat still stands
+            clk.advance(0.5)
+            assert not lt.fresh("a")     # the drop cost the lease
+            assert lt.beat("a", gen=gen)       # next beat goes through
+            assert lt.fresh("a")
+        finally:
+            faults.reset()
+
+    def test_write_beat_primitive_layout(self):
+        store = LocalStore()
+        assert write_beat(store, "ns", 3, {"t": 1.5})
+        assert json.loads(store.get("ns/beat/3").decode()) == \
+            {"t": 1.5}
+
+
+# ------------------------------------------- randomized fencing property
+class TestLeaseProperty:
+    """Seeded random schedule of grants / fenced beats / clean leaves /
+    clock advances — ManualClock, zero sleeps. Invariants checked after
+    every event: freshness is exactly (last written beat age <=
+    timeout), grants bump generations monotonically, stale-generation
+    beats never write, and missed() is exactly the expired-and-not-left
+    set."""
+
+    TIMEOUT = 1.0
+
+    def test_random_schedule_invariants(self):
+        rng = random.Random(1234)
+        clk = ManualClock(0.0)
+        lt = LeaseTable(LocalStore(), "p", self.TIMEOUT, clock=clk)
+        members = ["m%d" % i for i in range(4)]
+        gens = {}          # member -> current granted generation
+        last_beat = {}     # member -> t of last WRITTEN beat
+        left = set()
+
+        def check_invariants():
+            now = clk.now()
+            for m in members:
+                expect_fresh = m in last_beat and \
+                    now - last_beat[m] <= self.TIMEOUT
+                assert lt.fresh(m) == expect_fresh, \
+                    "freshness diverged for %s at t=%s" % (m, now)
+            expect_missed = sorted(
+                m for m in members
+                if m not in left and not (
+                    m in last_beat
+                    and now - last_beat[m] <= self.TIMEOUT))
+            assert sorted(lt.missed(members)) == expect_missed
+
+        for _ in range(400):
+            ev = rng.random()
+            m = rng.choice(members)
+            if ev < 0.15:                         # (re)grant
+                gen = lt.grant(m)
+                assert gen > gens.get(m, 0)       # monotone bump
+                gens[m] = gen
+                last_beat[m] = clk.now()
+                left.discard(m)
+            elif ev < 0.55 and m in gens:         # live fenced beat
+                assert lt.beat(m, gen=gens[m])
+                last_beat[m] = clk.now()
+            elif ev < 0.70 and m in gens:         # zombie beat
+                stale = gens[m] - 1
+                if stale >= 1:
+                    before = lt.read(m)
+                    assert not lt.beat(m, gen=stale)
+                    assert lt.read(m) == before   # nothing written
+            elif ev < 0.80 and m in gens and m not in left:
+                lt.leave(m)                       # clean departure
+                left.add(m)
+                last_beat.pop(m, None)
+            else:                                 # time passes
+                clk.advance(rng.choice((0.1, 0.3, 0.7, 1.1)))
+            check_invariants()
+
+    def test_expiry_ordering(self):
+        """Members expire in last-beat order as the clock advances."""
+        clk = ManualClock(0.0)
+        lt = LeaseTable(LocalStore(), "p", 1.0, clock=clk)
+        gens = {m: lt.grant(m) for m in ("a", "b", "c")}
+        clk.advance(0.4)
+        lt.beat("b", gen=gens["b"])
+        clk.advance(0.4)
+        lt.beat("c", gen=gens["c"])      # beats at t=0 / 0.4 / 0.8
+        order = []
+        for _ in range(8):
+            clk.advance(0.25)
+            for m in lt.missed(["a", "b", "c"]):
+                if m not in order:
+                    order.append(m)
+        assert order == ["a", "b", "c"]
+
+
+# ----------------------------------------------------------- EpochRegistry
+class TestEpochRegistry:
+    def test_propose_ack_commit_flow(self):
+        clk = ManualClock(0.0)
+        er = EpochRegistry(LocalStore(), "e", clock=clk)
+        assert er.pending() == 0 and er.current() is None
+        n = er.propose([0, 1, 2], "form", proposer=0, prev=0)
+        assert n == 1 and er.pending() == 1
+        rec = er.read(n)
+        assert rec == {"epoch": 1, "members": [0, 1, 2],
+                       "reason": "form", "proposer": 0, "prev": 0}
+        assert not er.acked(n, 1)
+        for m in (0, 1, 2):
+            er.ack(n, m)
+        assert all(er.acked(n, m) for m in (0, 1, 2))
+        assert not er.committed(n)
+        er.commit(n)
+        assert er.committed(n)
+        assert er.current()["members"] == [0, 1, 2]
+
+    def test_epoch_numbers_are_monotone(self):
+        er = EpochRegistry(LocalStore(), "e")
+        ns = [er.propose([0], "r%d" % i, prev=i) for i in range(5)]
+        assert ns == [1, 2, 3, 4, 5]
+        assert er.pending() == 5
+
+    def test_snapshot_transitions(self):
+        er = EpochRegistry(LocalStore(), "e", clock=ManualClock(1.0))
+        n = er.propose([0, 1], "grow", proposer=0)
+        er.commit(n)
+        snap = er.snapshot()
+        assert snap["current"]["epoch"] == n
+        kinds = [t["kind"] for t in snap["transitions"]]
+        assert kinds == ["propose", "commit"]
+        assert json.dumps(snap)
+
+    def test_epoch_changed_identity(self):
+        # the typed event moved to the substrate; the elastic module
+        # re-exports the SAME class, so existing except clauses hold
+        from paddle_tpu.distributed.elastic.membership import \
+            EpochChanged as ElasticEpochChanged
+        assert ElasticEpochChanged is EpochChanged
+        err = EpochChanged(7, "shrink")
+        assert err.epoch == 7 and "shrink" in str(err)
+
+    def test_cp_epoch_fault_site_fires_on_commit(self):
+        er = EpochRegistry(LocalStore(), "e")
+        n = er.propose([0], "form")
+        faults.configure("cp.epoch:delay=0@1", seed=0)
+        try:
+            er.commit(n)
+            assert [f.site for f in faults.injected()] == ["cp.epoch"]
+        finally:
+            faults.reset()
+        assert er.committed(n)
+
+
+# ---------------------------------------------------- ClusterControlPlane
+class TestClusterControlPlane:
+    def _mk(self, timeout=1.0):
+        clk = ManualClock(0.0)
+        return clk, ClusterControlPlane(lease_timeout=timeout,
+                                        clock=clk)
+
+    def test_join_beat_leave(self):
+        clk, cp = self._mk()
+        g0 = cp.join("r0")
+        g1 = cp.join("r1")
+        assert cp.members == ["r0", "r1"] and cp.epoch == 2
+        assert g0 == 1 and g1 == 1       # per-member generations
+        clk.advance(0.8)
+        assert cp.beat("r0")
+        clk.advance(0.4)                 # r1's grant beat is now stale
+        assert cp.fresh("r0") and not cp.fresh("r1")
+        cp.leave("r1")                   # planned: never a missed beat
+        assert cp.members == ["r0"] and cp.epoch == 3
+        assert cp.missed() == []
+
+    def test_missed_beat_eviction(self):
+        clk, cp = self._mk()
+        cp.join("r0")
+        cp.join("r1")
+        clk.advance(0.9)
+        cp.beat("r1")
+        clk.advance(0.5)                 # r0 expired, r1 fresh
+        assert cp.missed() == ["r0"]
+        cp.evict("r0")
+        assert cp.members == ["r1"] and cp.epoch == 3
+        assert cp.missed() == []
+        cp.evict("r0")                   # idempotent
+        assert cp.epoch == 3
+
+    def test_rejoin_bumps_generation(self):
+        _clk, cp = self._mk()
+        assert cp.join("r0") == 1
+        cp.leave("r0")
+        assert cp.join("r0") == 2        # zombie of gen 1 is fenced out
+
+    def test_snapshot_and_registry(self):
+        clk, cp = self._mk()
+        cp.join("r0")
+        clk.advance(0.2)
+        snap = cp.snapshot()
+        assert snap["kind"] == "cluster_control_plane"
+        assert snap["epoch"] == 1 and snap["members"] == ["r0"]
+        assert snap["leases"]["r0"]["fresh"]
+        assert snap["transitions"][-1]["reason"] == "join r0"
+        assert json.dumps(snap)
+        world = snapshot_all()           # the bundle feed sees it
+        assert any(p.get("ns") == "cluster" for p in world["planes"])
+
+
+# ------------------------------------------------- router drain-and-leave
+class TestRouterElasticity:
+    KNOBS = dict(max_slots=2, block_size=8, num_blocks=32,
+                 prefill_chunk=8)
+
+    def test_remove_replica_drains_in_flight_token_exact(self, model):
+        """Scale-in with requests mid-decode: the victim's in-flight
+        work replays on the survivor and every stream still matches
+        generate() token for token."""
+        clk = ManualClock(0.0)
+        cp = ClusterControlPlane(lease_timeout=1.0, clock=clk)
+        reps = [Replica("r%d" % i, model, **self.KNOBS)
+                for i in range(2)]
+        for r in reps:
+            r.warmup()
+        router = ClusterRouter(reps, control_plane=cp)
+        prompts = _prompts(model, [5, 11, 7, 9])
+        refs = [_ref(model, p, 6) for p in prompts]
+        crids = [router.submit(p, max_new_tokens=6) for p in prompts]
+        for _ in range(3):               # some tokens on both replicas
+            router.step()
+            clk.advance(0.05)
+        busy = [r for r in reps
+                if r.stats().active_slots or r.stats().queue_depth]
+        assert busy, "test needs in-flight work to drain"
+        victim = busy[0]
+        router.remove_replica(victim)
+        assert not victim.alive
+        assert victim.name not in cp.members
+        assert cp.missed() == []         # clean leave, never a miss
+        steps = 0
+        while router.step():
+            steps += 1
+            clk.advance(0.05)
+            assert steps < 400
+        outs = [router.result(c) for c in crids]
+        assert outs == refs
+        assert victim not in router.replicas
+        router.shutdown()
+
+    def test_add_replica_joins_plane_and_routes(self, model):
+        clk = ManualClock(0.0)
+        cp = ClusterControlPlane(lease_timeout=1.0, clock=clk)
+        r0 = Replica("r0", model, **self.KNOBS)
+        r0.warmup()
+        router = ClusterRouter([r0], control_plane=cp)
+        assert cp.members == ["r0"]
+        r1 = Replica("r1", model, **self.KNOBS)
+        router.add_replica(r1)           # warm=True: pre-traced
+        assert r1.engine.ragged_compiles == 1
+        assert cp.members == ["r0", "r1"] and cp.epoch == 2
+        [p] = _prompts(model, [5])
+        crid = router.submit(p, max_new_tokens=4)
+        steps = 0
+        while router.step():
+            steps += 1
+            clk.advance(0.05)
+            assert steps < 200
+        assert router.result(crid) == _ref(model, p, 4)
+        assert r1.engine.ragged_compiles == 1   # no cold compile
+        router.shutdown()
+
+
+# ---------------------------------------------------------- Autoscaler
+class _FakeStats:
+    def __init__(self, queue, active):
+        self.queue_depth = queue
+        self.active_slots = active
+
+
+class _FakeReplica:
+    def __init__(self, name):
+        self.name = name
+        self.alive = True
+        self.queue = 0
+        self.active = 0
+
+    def stats(self):
+        return _FakeStats(self.queue, self.active)
+
+
+class _FakeSLO:
+    def __init__(self):
+        self.sig = {"want_scale_up": 0.0, "shed_rate_fast": 0.0,
+                    "want_scale_down": 0.0}
+
+    def load_signals(self):
+        return dict(self.sig)
+
+
+class _FakeRouter:
+    """Just the surface Autoscaler drives: replicas / slo /
+    add_replica / remove_replica."""
+
+    def __init__(self, n=1):
+        self.replicas = [_FakeReplica("r%d" % i) for i in range(n)]
+        self.slo = _FakeSLO()
+        self.autoscaler = None
+
+    def add_replica(self, rep, warm=True):
+        self.replicas.append(rep)
+
+    def remove_replica(self, rep, drain=True):
+        rep.alive = False
+        self.replicas.remove(rep)
+
+
+class TestAutoscaler:
+    CFG = dict(min_replicas=1, max_replicas=3, up_ticks=2,
+               idle_ticks=3, cooldown_ticks=4, queue_hwm=4)
+
+    def _mk(self, **over):
+        clk = ManualClock(0.0)
+        router = _FakeRouter()
+        cfg = AutoscaleConfig(**{**self.CFG, **over})
+        scaler = Autoscaler(router, spawn=_FakeReplica, config=cfg,
+                            clock=clk)
+        return router, scaler
+
+    def test_pressure_must_be_sustained(self):
+        router, scaler = self._mk()
+        router.replicas[0].active = 1         # current demand
+        router.slo.sig["want_scale_up"] = 1.0
+        assert scaler.tick() is None          # 1 tick: not sustained
+        ev = scaler.tick()                    # 2nd consecutive: fire
+        assert ev["kind"] == "scale_up" and len(router.replicas) == 2
+        assert router.autoscaler is scaler
+
+    def test_pressure_counter_resets_on_calm_tick(self):
+        router, scaler = self._mk()
+        router.replicas[0].active = 1         # busy throughout
+        router.slo.sig["want_scale_up"] = 1.0
+        scaler.tick()
+        router.slo.sig["want_scale_up"] = 0.0
+        scaler.tick()                         # calm: streak broken
+        router.slo.sig["want_scale_up"] = 1.0
+        assert scaler.tick() is None          # must re-sustain
+        assert scaler.tick()["kind"] == "scale_up"
+
+    def test_stale_burn_over_idle_pool_never_scales_out(self):
+        # a full-span slow horizon keeps want_scale_up lit long after
+        # the wave: with zero queued/active work the hint must NOT grow
+        # the pool (it would flap forever against idle scale-in)
+        router, scaler = self._mk(up_ticks=1, cooldown_ticks=0)
+        router.slo.sig["want_scale_up"] = 1.0
+        for _ in range(10):
+            scaler.tick()
+        assert len(router.replicas) == 1 and scaler.last_event is None
+
+    def test_queue_hwm_is_pressure(self):
+        router, scaler = self._mk()
+        router.replicas[0].queue = 4          # hwm * 1 replica
+        scaler.tick()
+        assert scaler.tick()["kind"] == "scale_up"
+
+    def test_cooldown_blocks_flapping(self):
+        router, scaler = self._mk()
+        router.replicas[0].active = 1         # current demand
+        router.slo.sig["want_scale_up"] = 1.0
+        scaler.tick()
+        scaler.tick()                         # scale_up, cooldown=4
+        for _ in range(4):
+            assert scaler.tick() is None      # refractory window
+        assert scaler.tick()["kind"] == "scale_up"
+        assert len(router.replicas) == 3
+
+    def test_max_replicas_caps_growth(self):
+        router, scaler = self._mk(cooldown_ticks=0, up_ticks=1)
+        router.replicas[0].active = 1         # current demand
+        router.slo.sig["want_scale_up"] = 1.0
+        for _ in range(10):
+            scaler.tick()
+        assert len(router.replicas) == 3      # the configured max
+
+    def test_sustained_idle_scales_in_to_min(self):
+        router, scaler = self._mk(cooldown_ticks=0, up_ticks=1)
+        router.replicas[0].active = 1         # demand while growing
+        router.slo.sig["want_scale_up"] = 1.0
+        scaler.tick()                         # grow to 2
+        router.slo.sig["want_scale_up"] = 0.0
+        router.replicas[0].active = 0         # wave over: idle
+        evs = [scaler.tick() for _ in range(6)]
+        downs = [e for e in evs if e]
+        assert [e["kind"] for e in downs] == ["scale_down"]
+        assert len(router.replicas) == 1      # at min: stop shrinking
+        assert scaler.last_event["kind"] == "scale_down"
+        # LIFO victim: the scaled-out replica went first
+        assert router.replicas[0].name == "r0"
+
+    def test_want_scale_down_hint_needs_idle_pool(self):
+        router, scaler = self._mk(cooldown_ticks=0, up_ticks=1,
+                                  idle_ticks=100)
+        router.replicas[0].active = 1         # demand while growing
+        router.slo.sig["want_scale_up"] = 1.0
+        scaler.tick()                         # grow to 2
+        router.slo.sig["want_scale_up"] = 0.0
+        router.slo.sig["want_scale_down"] = 1.0
+        router.replicas[0].active = 1         # still busy: no shrink
+        assert scaler.tick() is None
+        router.replicas[0].active = 0         # idle + hint: shrink now
+        assert scaler.tick()["kind"] == "scale_down"
+
+    def test_snapshot_shape(self):
+        router, scaler = self._mk()
+        scaler.tick()
+        snap = scaler.snapshot()
+        assert snap["replicas"] == 1 and snap["min"] == 1
+        assert snap["max"] == 3 and snap["ticks"] == 1
+        assert snap["last_event"] is None
+        assert json.dumps(snap)
+
+    def test_scale_event_flight_recorded_telemetry_on(self):
+        # the other Autoscaler tests run telemetry-off; this one proves
+        # the observability path (the event's own "kind" key must not
+        # shadow the flight recorder's positional event kind)
+        import paddle_tpu as pt
+        from paddle_tpu.observability import flight_recorder as fr
+        was = pt.observability.enabled()
+        pt.observability.enable()
+        try:
+            router, scaler = self._mk()
+            router.replicas[0].active = 1
+            router.slo.sig["want_scale_up"] = 1.0
+            scaler.tick()
+            ev = scaler.tick()
+            assert ev["kind"] == "scale_up"
+            recs = [e for e in fr.events()
+                    if e["kind"] == "cluster.scale"]
+            assert recs and recs[-1]["direction"] == "scale_up"
+            assert recs[-1]["replica"] == ev["replica"]
+        finally:
+            if not was:
+                pt.observability.disable()
+
+    def test_config_env_and_validation(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_AUTOSCALE_MIN", "2")
+        monkeypatch.setenv("PADDLE_TPU_AUTOSCALE_MAX", "5")
+        monkeypatch.setenv("PADDLE_TPU_AUTOSCALE_UP_TICKS", "7")
+        cfg = AutoscaleConfig()
+        assert cfg.min_replicas == 2 and cfg.max_replicas == 5
+        assert cfg.up_ticks == 7
+        with pytest.raises(ValueError):
+            AutoscaleConfig(min_replicas=3, max_replicas=2)
+        with pytest.raises(ValueError):
+            AutoscaleConfig(min_replicas=0)

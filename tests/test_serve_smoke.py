@@ -21,3 +21,10 @@ def test_serve_smoke_ragged_parity_passes():
 
 def test_serve_smoke_cluster_passes():
     assert serve_smoke.main_cluster() == 0
+
+
+def test_serve_smoke_autoscale_passes():
+    # control-plane arm: SLO/queue-driven scale-out (warm joins, zero
+    # cold compiles), seeded mid-flight hang -> missed-lease eviction
+    # -> token-exact replay, idle scale-in back to one replica
+    assert serve_smoke.main_autoscale() == 0

@@ -361,6 +361,50 @@ class TestSLOEngine:
         assert sig["shed_rate_fast"] == pytest.approx(0.40)
         assert sig["worst_burn_slow"] >= 1.0
         assert sig["want_scale_up"] == 1.0
+        assert sig["want_scale_down"] == 0.0  # shedding != calm
+
+    def test_load_signals_scale_down_hint(self):
+        clk = ManualClock(100.0)
+        w, eng = _mk_engine(clk)
+        for _ in range(50):
+            w.counter("rt.submitted").inc()
+            w.histogram("rt.ttft").observe(0.05)
+        w.gauge("rt.slot_util").set(0.9)
+        sig = eng.load_signals()
+        assert sig["util"] == pytest.approx(0.9)
+        assert sig["want_scale_down"] == 0.0  # healthy but BUSY
+        # traffic stops: utilization samples fall to zero and the EWMA
+        # follows; everything stays OK with zero sheds -> shrink hint
+        for _ in range(20):
+            clk.advance(5.0)
+            w.gauge("rt.slot_util").set(0.0)
+        sig = eng.load_signals()
+        assert sig["util"] < 0.25
+        assert sig["want_scale_down"] == 1.0
+        assert sig["want_scale_up"] == 0.0
+
+    def test_scale_down_suppressed_by_any_shed(self):
+        clk = ManualClock(100.0)
+        w, eng = _mk_engine(clk)
+        w.gauge("rt.slot_util").set(0.0)
+        for _ in range(100):
+            w.counter("rt.submitted").inc()
+        w.counter("rt.shed").inc()      # 1% shed: under budget, but
+        sig = eng.load_signals()        # any shedding vetoes a shrink
+        assert sig["state"] == 0.0
+        assert sig["util"] == 0.0
+        assert sig["want_scale_down"] == 0.0
+
+    def test_scale_down_util_low_knob(self):
+        clk = ManualClock(100.0)
+        w = Windows("t", window_s=WIN, n_buckets=NB, clock=clk)
+        obj = [Objective("shed_rate", "rt.shed", 0.10, kind="ratio",
+                         denom="rt.submitted", budget=1.0)]
+        eng = SLOEngine(w, objectives=obj, fast_s=3.0, util_low=0.6)
+        w.gauge("rt.slot_util").set(0.5)
+        assert eng.load_signals()["want_scale_down"] == 1.0
+        eng2 = SLOEngine(w, objectives=obj, fast_s=3.0, util_low=0.4)
+        assert eng2.load_signals()["want_scale_down"] == 0.0
 
     def test_reports_all_covers_live_engines(self):
         clk = ManualClock(100.0)

@@ -34,6 +34,10 @@ Sections printed (each only if its file exists in the bundle):
                  MFU, per-mechanism overlap efficiency, memory phases
   * compiles   — compile ledger (compile_ledger.json): per-jit-site
                  compile counts with recompile-cause attribution
+  * control    — control-plane state (control_plane.json): current
+                 epoch + members, per-member lease freshness, and the
+                 recent membership transitions (joins, clean leaves,
+                 missed-beat evictions)
 """
 from __future__ import annotations
 
@@ -44,7 +48,8 @@ import sys
 BUNDLE_FILES = ("env.json", "flight_recorder.jsonl", "metrics.json",
                 "comm_tasks.json", "trace.json",
                 "request_log_tail.jsonl", "slo_windows.json",
-                "profiler_report.json", "compile_ledger.json")
+                "profiler_report.json", "compile_ledger.json",
+                "control_plane.json")
 
 
 def _load_json(path):
@@ -310,6 +315,51 @@ def _show_compiles(d: str):
             print(f"    x{n:<4} {cause}")
 
 
+def _show_control_plane(d: str):
+    doc = _load_json(os.path.join(d, "control_plane.json"))
+    if not doc:
+        return
+    planes = doc.get("planes") or []
+    leases = doc.get("leases") or []
+    epochs = doc.get("epochs") or []
+    if not planes and not leases and not epochs:
+        return
+    _section("control plane (leases / epochs at dump time)")
+    for p in planes:
+        print(f"  plane[{p.get('ns', '?')}]: epoch={p.get('epoch', '?')} "
+              f"members={','.join(p.get('members') or []) or '-'} "
+              f"lease_timeout={p.get('lease_timeout', '?')}s")
+        for m, le in sorted((p.get("leases") or {}).items()):
+            beat = le.get("beat") or {}
+            print(f"    {m:<12} fresh={le.get('fresh')} "
+                  f"gen={le.get('generation', '?')} "
+                  f"last_beat_t={beat.get('t', '-')}")
+        trans = p.get("transitions") or []
+        for t in trans[-6:]:
+            print(f"    epoch {t.get('epoch', '?'):>3} "
+                  f"[{','.join(str(m) for m in t.get('members') or [])}]"
+                  f" {t.get('reason', '')}")
+    for lt in leases:
+        # standalone lease tables (not wrapped in a composite plane)
+        if any(p.get("ns") == lt.get("ns") for p in planes):
+            continue
+        members = lt.get("members") or {}
+        left = sorted(m for m, le in members.items() if le.get("left"))
+        fresh = sorted(m for m, le in members.items()
+                       if le.get("fresh"))
+        print(f"  leases[{lt.get('ns', '?')}]: {len(members)} member(s) "
+              f"timeout={lt.get('timeout', '?')}s "
+              f"fresh={','.join(fresh) or '-'} "
+              f"left={','.join(left) or '-'}")
+    for er in epochs:
+        if any(p.get("ns") == er.get("ns") for p in planes):
+            continue
+        print(f"  epochs[{er.get('ns', '?')}]: "
+              f"current={er.get('current', '?')} "
+              f"pending={er.get('pending', '?')} "
+              f"transitions={len(er.get('transitions') or [])}")
+
+
 def main(argv) -> int:
     if len(argv) != 2 or argv[1] in ("-h", "--help"):
         print(__doc__)
@@ -328,6 +378,7 @@ def main(argv) -> int:
     _show_slo(bundle)
     _show_profiler(bundle)
     _show_compiles(bundle)
+    _show_control_plane(bundle)
     print()
     return 0
 

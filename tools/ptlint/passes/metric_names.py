@@ -33,8 +33,8 @@ _SKIP_DIRS = {".git", "__pycache__", "build", "dist", ".eggs",
               "node_modules"}
 
 # namespaces whose declared names must all be instrumented somewhere
-REQUIRE_USED = ("serving.", "cluster.", "elastic.", "ps.", "rt.",
-                "slo.", "prof.")
+REQUIRE_USED = ("serving.", "cluster.", "cp.", "elastic.", "ps.",
+                "rt.", "slo.", "prof.")
 
 _SCHEMA_RELPATH = "paddle_tpu/observability/metrics_schema.py"
 

@@ -145,11 +145,35 @@ def render(snap: dict, width: int = 78, n_requests: int = 10) -> str:
     if sig:
         lines.append(
             "signals: shed_fast=%.3f shed_slow=%.3f worst_burn=%.2f "
-            "scale_up=%d"
+            "util=%.2f scale_up=%d scale_down=%d"
             % (sig.get("shed_rate_fast", 0.0),
                sig.get("shed_rate_slow", 0.0),
                sig.get("worst_burn_slow", 0.0),
-               int(sig.get("want_scale_up", 0.0))))
+               sig.get("util", 0.0),
+               int(sig.get("want_scale_up", 0.0)),
+               int(sig.get("want_scale_down", 0.0))))
+
+    scale = snap.get("scale") or {}
+    if scale:
+        ev = scale.get("last_event") or {}
+        last = "%s %s @tick %s" % (ev.get("kind"), ev.get("replica"),
+                                   ev.get("tick")) if ev else "-"
+        lines.append(
+            "scale: replicas=%d (min=%d max=%d) cooldown=%d "
+            "last=%s"
+            % (scale.get("replicas", 0), scale.get("min", 0),
+               scale.get("max", 0), scale.get("cooldown", 0), last))
+
+    cp = snap.get("control_plane") or {}
+    if cp:
+        leases = cp.get("leases") or {}
+        stale = sorted(m for m, le in leases.items()
+                       if not le.get("fresh"))
+        lines.append(
+            "control plane: epoch=%s members=%s stale=%s"
+            % (cp.get("epoch", "?"),
+               ",".join(cp.get("members") or []) or "-",
+               ",".join(stale) or "-"))
 
     reps = snap.get("replicas") or {}
     if reps:

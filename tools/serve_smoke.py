@@ -16,10 +16,20 @@ replica mid-flight (``cluster.replica:kill@N``), and asserts the
 drained-and-replayed streams still match the single-engine references
 token for token.
 
+``--autoscale`` runs the control-plane arm: one replica behind a
+router wired to a :class:`ClusterControlPlane` (ManualClock — zero
+sleeps), a seeded request ramp that makes the Autoscaler grow the
+pool (joining replicas warm up BEFORE taking traffic: exactly one
+ragged compile each), a mid-flight ``hang`` fault (the replica goes
+SILENT — only the missed-lease scan can find it), eviction inside the
+lease budget with token-exact replay, and scale-in back to one
+replica on sustained idle.
+
 Importable (``main()`` returns 0/raises) so tests/test_serve_smoke.py
 runs all arms inside the tier-1 suite; also runnable standalone:
 
-    JAX_PLATFORMS=cpu python tools/serve_smoke.py [--ragged|--cluster]
+    JAX_PLATFORMS=cpu python tools/serve_smoke.py \
+        [--ragged|--cluster|--autoscale]
 """
 from __future__ import annotations
 
@@ -170,9 +180,110 @@ def main_cluster() -> int:
     return 0
 
 
+def main_autoscale() -> int:
+    from paddle_tpu.distributed.resilience import faults
+    from paddle_tpu.observability.windows import ManualClock
+    from paddle_tpu.serving.cluster import (AutoscaleConfig, Autoscaler,
+                                            ClusterControlPlane,
+                                            ClusterRouter, Replica)
+
+    pt, model, prompts, refs = _build(n_prompts=4)
+    prompts, refs = prompts * 2, refs * 2          # the 8-request ramp
+    knobs = dict(max_slots=2, block_size=8, num_blocks=32,
+                 prefill_chunk=8)
+
+    clk = ManualClock()
+    cp = ClusterControlPlane(lease_timeout=1.0, clock=clk)
+    spawned = []
+
+    def spawn(name):
+        rep = Replica(name, model, **knobs)
+        spawned.append(rep)
+        return rep
+
+    first = spawn("r0")
+    first.warmup()
+    router = ClusterRouter([first], max_queue=8, control_plane=cp)
+    scaler = Autoscaler(
+        router, spawn,
+        AutoscaleConfig(min_replicas=1, max_replicas=3, up_ticks=2,
+                        idle_ticks=3, cooldown_ticks=4, queue_hwm=2),
+        clock=clk)
+
+    def pump(cap=400):
+        steps = 0
+        while router.step():
+            steps += 1
+            scaler.tick()
+            clk.advance(0.05)
+            assert steps < cap, "router failed to drain"
+        return steps
+
+    # the 9th replica step across the cluster hangs whichever replica
+    # round-robin lands on — AFTER the queue-pressure scale-out at
+    # tick 2, so the victim holds in-flight work and survivors exist
+    faults.configure("cluster.replica:hang@9", seed=0)
+    try:
+        crids = [router.submit(p, max_new_tokens=6) for p in prompts]
+        steps = pump()
+        hung = [r for r in router.replicas if r.alive and r.hung]
+        assert hung, "seeded hang did not land"
+        victim = hung[0]
+        assert router.num_alive() >= 2, \
+            "scale-out must precede the hang (pool=%d)" \
+            % router.num_alive()
+
+        # nobody reported the hang: only the lease can find it. Advance
+        # the manual clock through the lease budget; the router's scan
+        # must evict + drain the zombie within it (survivors keep
+        # beating, so ONLY the victim expires).
+        for _ in range(64):
+            clk.advance(0.1)
+            router.step()
+            scaler.tick()
+            if not victim.alive:
+                break
+        assert not victim.alive, "missed-beat eviction never fired"
+        assert victim.name not in cp.members, \
+            "evicted replica still in the epoch"
+        steps += pump()                   # drain the replayed work
+        outs = [router.result(c) for c in crids]
+
+        # sustained idle: the scaler must walk the pool back to min
+        for _ in range(64):
+            router.step()
+            scaler.tick()
+            clk.advance(0.05)
+            if router.num_alive() <= 1:
+                break
+    finally:
+        faults.reset()
+    assert outs == refs, \
+        "post-hang replayed streams != generate(): %r vs %r" \
+        % (outs, refs)
+    assert len(spawned) >= 2, "autoscaler never scaled out"
+    assert router.num_alive() == 1, \
+        "idle scale-in left %d replicas" % router.num_alive()
+    ev = scaler.last_event or {}
+    assert ev.get("kind") == "scale_down", \
+        "last scale event should be the idle shrink, got %r" % (ev,)
+    for r in spawned:
+        assert r.engine.ragged_compiles == 1, \
+            "replica %s compiled ragged %d times (join must be warm)" \
+            % (r.name, r.engine.ragged_compiles)
+    router.shutdown()
+    print("serve_smoke --autoscale: %d requests, %d steps, pool "
+          "1->%d->%d, hang evicted via missed lease, replay parity "
+          "OK, 1 ragged compile/replica"
+          % (len(prompts), steps, len(spawned), router.num_alive()))
+    return 0
+
+
 if __name__ == "__main__":
     sys.path.insert(0, os.path.join(os.path.dirname(
         os.path.abspath(__file__)), os.pardir))
+    if "--autoscale" in sys.argv:
+        sys.exit(main_autoscale())
     if "--cluster" in sys.argv:
         sys.exit(main_cluster())
     if "--ragged" in sys.argv:
