@@ -682,6 +682,11 @@ def _bench_cluster():
                          blocks=blocks, n_req=n_req, max_new=max_new,
                          cap1=cap1)
 
+    # --- cluster-wide KV cache: long-shared-prefix workload, tier-on
+    # vs tier-off (cross-replica index fetch + host-tier restore)
+    kv_store = _cluster_kv(pt, model, cfg, rng, slots=slots,
+                           blocks=blocks, max_new=max_new)
+
     print(json.dumps({
         "metric": metric,
         "value": round(capn, 1),
@@ -703,6 +708,7 @@ def _bench_cluster():
             "attribution": attribution,
             "slo": slo,
             "ramp": ramp,
+            "kv_store": kv_store,
         },
     }))
     return 0
@@ -897,6 +903,143 @@ def _cluster_ramp(pt, model, cfg, rng, slots, blocks, n_req, max_new,
     for r in standby:                        # never-promoted standbys
         r.shutdown()
     return ramp
+
+
+def _cluster_kv(pt, model, cfg, rng, slots, blocks, max_new):
+    """Cluster-wide KV cache workload (``extra["kv_store"]``): a long
+    shared system prompt served tier-ON vs tier-OFF through identical
+    2-replica routers. Three phases per arm:
+
+    * seed — plant the prefix on r0 through normal serving;
+    * cross — saturate r0 (``max_queue=1``) so the next shared-prefix
+      request lands on r1: tier-on imports the prefix pages through
+      the global index instead of recomputing them;
+    * host — force-demote every cached block on both replicas (tier-on
+      spills to host RAM, tier-off discards — the pre-tier behavior),
+      then serve the prefix again: tier-on promotes from host, tier-off
+      recomputes the full prefill.
+
+    Reports prefill tokens saved (the index/host fetches) and the TTFT
+    delta per phase. Token parity vs a single tier-off engine and one
+    ragged compile per replica are asserted; latency is recorded, not
+    asserted, so the bench stays machine-independent."""
+    import threading
+    import time
+
+    from paddle_tpu.serving.cluster import ClusterRouter, Replica
+    from paddle_tpu.serving.kv_store import (ClusterKVStore,
+                                             KVStoreConfig)
+
+    # int8 KV pools: the host spill is the pool layout, so tiered
+    # streams can stay token-exact vs the recompute references
+    knobs = dict(max_slots=slots, block_size=16, num_blocks=blocks,
+                 prefill_chunk=32, kv_quant="int8")
+    max_new = min(int(max_new), 12)
+    shared = rng.integers(0, cfg.vocab_size, 128).tolist()  # 8 blocks
+    tails = [rng.integers(0, cfg.vocab_size, int(n)).tolist()
+             for n in (6, 9, 13)]
+    reqs = [shared + t for t in tails]       # seed / cross / host
+    junk = rng.integers(0, cfg.vocab_size, 24).tolist()
+
+    ref = pt.serving.ServingEngine(model, **knobs)
+    refs = []
+    for p in reqs:
+        rid = ref.submit(list(p), max_new_tokens=max_new)
+        while ref.step():
+            pass
+        refs.append(ref.result(rid))
+    ref.shutdown()
+
+    def run(tier_on):
+        reps = [Replica("r%d" % i, model, **knobs) for i in range(2)]
+        for r in reps:
+            r.warmup()
+        kv = ClusterKVStore(config=KVStoreConfig(
+            tier="host", host_mb=64)) if tier_on else None
+        router = ClusterRouter(reps, max_queue=1, kv_store=kv)
+        outs, ttft = {}, {}
+        lock = threading.Lock()
+
+        def consume(crid, key, t0):
+            got, first = [], True
+            for tok in router.stream(crid):
+                if first:
+                    with lock:
+                        ttft[key] = time.monotonic() - t0
+                    first = False
+                got.append(tok)
+            with lock:
+                outs[key] = got
+
+        def drive(key, prompt, prime=None):
+            jc = router.submit(junk, max_new_tokens=max_new) \
+                if prime else None           # queues on r0, unstepped
+            crid = router.submit(list(prompt),
+                                 max_new_tokens=max_new)
+            th = threading.Thread(target=consume,
+                                  args=(crid, key, time.monotonic()))
+            th.start()
+            while router.step():
+                pass
+            th.join(timeout=60.0)
+            if jc is not None:
+                router.result(jc)
+
+        drive("seed", reqs[0])               # prefix lands on r0
+        c0 = dict(kv.counts) if kv else {}
+        drive("cross", reqs[1], prime=True)  # r0 full -> r1 serves
+        c1 = dict(kv.counts) if kv else {}
+        # forced demotion sweep: tier-on spills through the pump,
+        # tier-off discards (exactly the pre-tier eviction behavior)
+        for r in reps:
+            with r.engine._lock:
+                r.engine.manager.pop_evictable(blocks)
+        if kv is not None:
+            while kv.pump() > 0:
+                pass
+        drive("host", reqs[2])               # restore vs recompute
+        c2 = dict(kv.counts) if kv else {}
+        for r in reps:
+            assert r.engine.ragged_compiles == 1, \
+                "replica %s compiled ragged %d times" \
+                % (r.name, r.engine.ragged_compiles)
+        router.shutdown()
+        return ([outs[k] for k in ("seed", "cross", "host")],
+                {k: round(1e3 * v, 2) for k, v in ttft.items()},
+                (c0, c1, c2))
+
+    outs_off, ttft_off, _ = run(tier_on=False)
+    outs_on, ttft_on, (c0, c1, c2) = run(tier_on=True)
+    assert outs_off == refs, "tier-off streams != references"
+    assert outs_on == refs, "tier-on streams != references"
+    cross_saved = c1["fetch_tokens"] - c0["fetch_tokens"]
+    host_saved = c2["fetch_tokens"] - c1["fetch_tokens"]
+    assert c1["fetches_replica"] > c0["fetches_replica"], \
+        "cross phase never fetched through the global index"
+    assert c2["fetches_host"] > c1["fetches_host"], \
+        "host phase never promoted from the host tier"
+    return {
+        "shared_prefix_tokens": len(shared),
+        "requests": len(reqs),
+        "cross_replica": {
+            "prefill_tokens_saved": cross_saved,
+            "ttft_on_ms": ttft_on.get("cross"),
+            "ttft_off_ms": ttft_off.get("cross"),
+            "ttft_delta_ms": round(ttft_off.get("cross", 0.0)
+                                   - ttft_on.get("cross", 0.0), 2),
+        },
+        "host_restore": {
+            "prefill_tokens_saved": host_saved,
+            "ttft_on_ms": ttft_on.get("host"),
+            "ttft_off_ms": ttft_off.get("host"),
+            "ttft_delta_ms": round(ttft_off.get("host", 0.0)
+                                   - ttft_on.get("host", 0.0), 2),
+        },
+        "demoted_blocks": c2["demotes"],
+        "crc_failures": c2["crc_failures"],
+        "token_parity_vs_tier_off": True,    # asserted above
+        "one_ragged_compile_per_replica": True,
+    }
 
 
 def _bench_elastic():

@@ -242,6 +242,67 @@ METRICS = {
         "counter", "replicas", "autoscaler scale-in events: a replica "
         "drained (clean leave + token-exact replay of in-flight work) "
         "after sustained idle / want_scale_down"),
+    # ---- cluster-wide KV store (serving/kv_store/)
+    "kv.index_hits": MetricSpec(
+        "counter", "lookups", "admission-time global-index lookups "
+        "that found a VALID cached prefix deeper than the target "
+        "replica's own cache (lease-fresh + generation-matched owner "
+        "or host-tier-resident)"),
+    "kv.index_misses": MetricSpec(
+        "counter", "lookups", "admission-time global-index lookups "
+        "with no usable location (nothing registered, everything "
+        "stale, or the target already holds the deepest copy)"),
+    "kv.fetches": MetricSpec(
+        "counter", "fetches", "prefix page fetches completed into the "
+        "routed replica, by source tier (replica = cross-replica "
+        "export/import, host = host-tier promotion)",
+        tags=("source",)),
+    "kv.fetch_tokens": MetricSpec(
+        "counter", "tokens", "prompt tokens made KV-resident by "
+        "cluster fetches — prefill work the target replica skipped, "
+        "by source tier", tags=("source",)),
+    "kv.stale_skips": MetricSpec(
+        "counter", "fetches", "index hits that could not be served "
+        "(owner evicted the blocks between lookup and export, or "
+        "pool-layout mismatch) — the request fell back to recompute"),
+    "kv.promotes": MetricSpec(
+        "counter", "fetches", "host-tier promotions: spilled int8 "
+        "pages restored into a replica's pool instead of recomputing "
+        "the prefix"),
+    "kv.demotes": MetricSpec(
+        "counter", "blocks", "evicted prefix blocks spilled to the "
+        "host tier by the async pump (instead of discarded)"),
+    "kv.host_evictions": MetricSpec(
+        "counter", "blocks", "host-tier entries evicted LRU to fit "
+        "new spills under PADDLE_TPU_KV_HOST_MB"),
+    "kv.crc_failures": MetricSpec(
+        "counter", "blocks", "host-tier round trips failing CRC "
+        "verification: the entry is dropped and the prefix "
+        "recomputed — never served"),
+    "kv.promote_time": MetricSpec(
+        "histogram", "s", "wall time of one host-tier promotion "
+        "(CRC-verified fetch + concat + pool import)", TIME_BUCKETS),
+    "kv.demote_time": MetricSpec(
+        "histogram", "s", "wall time of one block demotion "
+        "(quantize to int8 spill + CRC + host-tier insert)",
+        TIME_BUCKETS),
+    "kv.host_blocks": MetricSpec(
+        "gauge", "blocks", "blocks currently parked in the host-RAM "
+        "tier after the last pump"),
+    "kv.host_bytes": MetricSpec(
+        "gauge", "bytes", "host-RAM tier payload bytes after the "
+        "last pump (bounded by PADDLE_TPU_KV_HOST_MB)"),
+    "kv.index_entries": MetricSpec(
+        "gauge", "hashes", "distinct chain hashes registered in the "
+        "global prefix index after the last pump"),
+    # rolling-window twins (ClusterKVStore.windows, like rt.*): the
+    # ptop KV panel's hit RATE reads these, not the lifetime counters
+    "kv.lookups": MetricSpec(
+        "counter", "lookups", "admission-time index consults over the "
+        "rolling window (hit-rate denominator)"),
+    "kv.hits": MetricSpec(
+        "counter", "fetches", "cluster fetches served (replica or "
+        "host tier) over the rolling window (hit-rate numerator)"),
     # ---- shared control-plane substrate (distributed/control_plane/)
     "cp.beats": MetricSpec(
         "counter", "beats", "heartbeat lease beats written through the "
@@ -561,6 +622,13 @@ SPANS = {
                        "handoff (blocks/bytes in args)",
     "cluster.replay": "one drained descriptor replayed on a survivor "
                       "after a replica death",
+    "kv.fetch": "one admission-time cluster KV consult: global-index "
+                "lookup + (on a hit) cross-replica or host-tier page "
+                "fetch into the routed replica",
+    "kv.promote": "one host-tier promotion: CRC-verified spill fetch "
+                  "+ concat + pool import (blocks in args)",
+    "kv.demote": "one evicted block quantized + CRC-stamped into the "
+                 "host tier by the async pump (hash in args)",
     "elastic.epoch": "one epoch join: propose/ack/commit barrier-with-"
                      "deadline (epoch + members in args)",
     "elastic.reshard": "shrink/expand state adoption: peer-snapshot "

@@ -17,7 +17,10 @@ from .scheduler import (CANCELLED, FINISHED, HANDOFF, PREFILL,  # noqa: F401
                         RUNNING, WAITING, PrefillChunk, Request,
                         Scheduler)
 from . import cluster  # noqa: E402,F401  (after engine: cluster uses it)
+from . import kv_store  # noqa: E402,F401
+from .kv_store import ClusterKVStore, KVStoreConfig  # noqa: F401
 
 __all__ = ["ServingEngine", "EngineConfig", "RequestError",
            "BlockManager", "Scheduler", "Request", "PrefillChunk",
-           "EngineStats", "RequestDescriptor", "KVHandoff", "cluster"]
+           "EngineStats", "RequestDescriptor", "KVHandoff", "cluster",
+           "kv_store", "ClusterKVStore", "KVStoreConfig"]

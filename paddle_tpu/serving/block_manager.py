@@ -71,11 +71,30 @@ class BlockManager:
         # ref-0 blocks whose KV is still valid (LRU order, oldest first)
         self._evictable: "collections.OrderedDict[int, None]" = \
             collections.OrderedDict()  # guarded by: caller (ServingEngine._lock)
+        # demotion/registration hooks (cluster KV tier). on_evict fires
+        # with (block_id, chain_hash) BEFORE the hash is forgotten and
+        # the page reused — the only moment its KV can still be saved;
+        # on_register fires with (block_id, chain_hash) when a prefix
+        # block is published. Both run under the caller's lock.
+        self.on_evict = None  # guarded by: caller (ServingEngine._lock)
+        self.on_register = None  # guarded by: caller (ServingEngine._lock)
+
+    # ------------------------------------------------------------- hooks
+    def set_hooks(self, on_evict=None, on_register=None) -> None:
+        """Install the demotion/registration callbacks (see the
+        attribute docs in ``__init__``)."""
+        self.on_evict = on_evict
+        self.on_register = on_register
 
     # ------------------------------------------------------------ sizing
     def num_free(self) -> int:
         """Blocks obtainable right now (free list + evictable cache)."""
         return len(self._free) + len(self._evictable)
+
+    def free_list_size(self) -> int:
+        """Blocks on the free list alone — obtainable WITHOUT evicting
+        a cached prefix (the KV tier's demotion-pressure signal)."""
+        return len(self._free)
 
     def num_in_use(self) -> int:
         return len(self._ref)
@@ -104,6 +123,10 @@ class BlockManager:
                 bid = self._free.popleft()
             else:
                 bid, _ = self._evictable.popitem(last=False)
+                if self.on_evict is not None:
+                    h = self._block_hash.get(bid)
+                    if h is not None:
+                        self.on_evict(bid, h)
                 self._forget_hash(bid)
             self._ref[bid] = 1
             out.append(bid)
@@ -194,8 +217,48 @@ class BlockManager:
                 continue                # block already cached elsewhere
             self._hash_to_block[h] = bid
             self._block_hash[bid] = h
+            if self.on_register is not None:
+                self.on_register(bid, h)
             registered += 1
         return registered
+
+    def probe_prefix(self, token_ids: Sequence[int]) -> int:
+        """Depth (whole blocks) of the longest cached prefix WITHOUT
+        taking refs — the cluster KV store's pre-fetch check for
+        whether a remote copy is deeper than what's already local."""
+        if not self.enable_prefix_cache or not token_ids:
+            return 0
+        limit = (len(token_ids) - 1) // self.block_size
+        depth = 0
+        h: Optional[int] = None
+        for i in range(limit):
+            h = hash_block_tokens(h, token_ids[i * self.block_size:
+                                               (i + 1) * self.block_size])
+            if self._hash_to_block.get(h) is None:
+                break
+            depth += 1
+        return depth
+
+    def pop_evictable(self, n: int) -> List[Tuple[int, int]]:
+        """Demote up to ``n`` LRU evictable blocks: fires ``on_evict``
+        for each (so the KV tier can spill the pages), forgets the
+        hash, and returns the blocks to the free list.  Returns the
+        ``(block_id, chain_hash)`` pairs demoted.  This is the
+        watermark-driven proactive path — same as eviction-on-allocate
+        but on the pump's schedule instead of under an allocation."""
+        out: List[Tuple[int, int]] = []
+        for _ in range(max(0, n)):
+            if not self._evictable:
+                break
+            bid, _ = self._evictable.popitem(last=False)
+            h = self._block_hash.get(bid)
+            if h is not None and self.on_evict is not None:
+                self.on_evict(bid, h)
+            self._forget_hash(bid)
+            self._free.append(bid)
+            if h is not None:
+                out.append((bid, h))
+        return out
 
     def _forget_hash(self, bid: int) -> None:
         h = self._block_hash.pop(bid, None)

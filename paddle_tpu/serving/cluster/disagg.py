@@ -5,8 +5,10 @@ Prefill replicas run chunked prefill only (requests submitted with
 ``handoff=True`` stop after the prompt KV is resident and the first
 token sampled); the pump then moves each finished prompt to a decode
 replica as a :class:`KVHandoff` — the KV pages travel in the engines'
-native pool layout, which for ``kv_quant="int8"`` is the existing
-``quantize_kv_pages`` ``{"q8","s"}`` serialization, i.e. the quantized
+native pool layout through the shared page codec
+(:mod:`paddle_tpu.serving.kv_store.codec`), which for
+``kv_quant="int8"`` is the existing ``quantize_kv_pages``
+``{"q8","s"}`` serialization, i.e. the quantized
 path IS the wire format (4x smaller than fp32 pages). The decode
 replica seats the payload straight into a RUNNING slot
 (:meth:`ServingEngine.adopt_handoff`) and decodes from position
@@ -32,6 +34,7 @@ from typing import List, Optional, Sequence, Tuple
 from ... import observability as _obs
 from ...observability.tracing import span
 from ..engine import KVHandoff, RequestError
+from ..kv_store import codec as kv_codec
 from .replica import Replica
 
 __all__ = ["DisaggPolicy"]
@@ -88,7 +91,9 @@ class DisaggPolicy:
         for src, pay in self._pending:
             with span("cluster.handoff",
                       args={"blocks": pay.num_blocks,
-                            "bytes": pay.nbytes()}):
+                            "bytes":
+                            kv_codec.pages_nbytes(pay.k_pages) +
+                            kv_codec.pages_nbytes(pay.v_pages)}):
                 target = self._least_loaded_decode()
                 rid = target.adopt_handoff(pay) if target is not None \
                     else None

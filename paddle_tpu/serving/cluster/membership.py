@@ -137,6 +137,13 @@ class ClusterControlPlane:
     def fresh(self, name: str) -> bool:
         return self.leases.fresh(name)
 
+    def generation(self, name: str) -> int:
+        """Current lease generation of ``name`` (store-authoritative).
+        Generations survive rejoin (``forget`` keeps the counter), so
+        state fenced with an old generation — e.g. cluster KV index
+        entries from a previous incarnation — verifiably goes stale."""
+        return self.leases.generation(name)
+
     def missed(self) -> List[str]:
         """Members whose lease expired WITHOUT a clean-leave marker —
         the router's eviction candidates."""

@@ -104,7 +104,8 @@ class ClusterRouter:
     def __init__(self, replicas: Sequence[Replica],
                  max_queue: Optional[int] = None,
                  disagg: Optional[object] = None,
-                 control_plane: Optional[object] = None):
+                 control_plane: Optional[object] = None,
+                 kv_store: Optional[object] = None):
         if not replicas:
             raise ValueError("need at least one replica")
         self.replicas = list(replicas)
@@ -112,6 +113,15 @@ class ClusterRouter:
             _env_int("PADDLE_TPU_CLUSTER_MAX_QUEUE", 32)
         self.disagg = disagg            # DisaggPolicy or None
         self.control_plane = control_plane  # ClusterControlPlane or None
+        # cluster KV tier (ClusterKVStore or None): pass one explicitly,
+        # or set PADDLE_TPU_KV_TIER=host and the router builds it on the
+        # control plane's store. Default off — zero behavior change.
+        if kv_store is None and \
+                os.environ.get("PADDLE_TPU_KV_TIER", "").lower() == \
+                "host":
+            from ..kv_store import ClusterKVStore
+            kv_store = ClusterKVStore(control_plane=control_plane)
+        self.kv_store = kv_store
         self.autoscaler = None          # set by Autoscaler.__init__
         self.block_size = \
             self.replicas[0].engine.manager.block_size
@@ -122,6 +132,11 @@ class ClusterRouter:
             if control_plane is not None:
                 r.control_plane = control_plane
                 control_plane.join(r.name)
+        if self.kv_store is not None:
+            # after join: replica registrations fence with the lease
+            # generation they hold NOW
+            for r in self.replicas:
+                self.kv_store.attach(r)
         self._cond = threading.Condition()
         self._crid = 0  # guarded by: _cond
         self._recs: Dict[int, _ClientReq] = {}  # guarded by: _cond
@@ -209,6 +224,8 @@ class ClusterRouter:
                 "control_plane": (self.control_plane.snapshot()
                                   if self.control_plane is not None
                                   else None),
+                "kv": (self.kv_store.snapshot()
+                       if self.kv_store is not None else None),
                 "scale": (self.autoscaler.snapshot()
                           if self.autoscaler is not None else None),
                 "attribution": attribution_of(all_windows),
@@ -297,6 +314,12 @@ class ClusterRouter:
         with span("cluster.route"):
             for _ in range(len(self.replicas) + 1):
                 rep, _route = self._route(prompt)
+                if self.kv_store is not None:
+                    # pull the deepest cluster-cached prefix into the
+                    # target BEFORE it queues: admission then sees the
+                    # pages locally resident (miss/stale/CRC failure all
+                    # degrade to recompute inside prefetch)
+                    self.kv_store.prefetch(rep, prompt)
                 try:
                     rid = rep.submit(
                         prompt, max_new_tokens=max_new_tokens,
@@ -384,6 +407,10 @@ class ClusterRouter:
         # clean leaves by remove_replica — evict() is idempotent
         if self.control_plane is not None:
             self.control_plane.evict(replica.name, reason="died")
+        if self.kv_store is not None:
+            # optional hygiene: the dead replica's index entries already
+            # fail lease/generation validation
+            self.kv_store.on_replica_dead(replica.name)
 
     def _replay(self, crid: int, d: RequestDescriptor) -> None:
         with span("cluster.replay"):
@@ -457,6 +484,8 @@ class ClusterRouter:
         if self.control_plane is not None:
             replica.control_plane = self.control_plane
             self.control_plane.join(replica.name)
+        if self.kv_store is not None:
+            self.kv_store.attach(replica)
         with self._cond:
             self.replicas.append(replica)
         if self._slo is not None:
@@ -479,6 +508,8 @@ class ClusterRouter:
         accepted."""
         if self.control_plane is not None:
             self.control_plane.leave(replica.name)
+        if self.kv_store is not None:
+            self.kv_store.detach(replica)
         if drain:
             replica.retire()
         with self._cond:
@@ -526,6 +557,8 @@ class ClusterRouter:
                 did = rep.step() or did
         if self.disagg is not None:
             did = (self.disagg.pump(self) > 0) or did
+        if self.kv_store is not None:
+            did = (self.kv_store.pump() > 0) or did
         if _obs.enabled():
             _obs.registry.gauge("cluster.replicas_alive").set(
                 self.num_alive())
@@ -566,9 +599,13 @@ class ClusterRouter:
                                  name="cluster-disagg-pump")
             t.start()
             self._threads.append(t)
+        if self.kv_store is not None:
+            self.kv_store.start()
 
     def shutdown(self, check_leaks: bool = True) -> None:
         self._stop.set()
+        if self.kv_store is not None:
+            self.kv_store.stop()
         for t in self._threads:
             t.join(timeout=10.0)
         self._threads = []

@@ -48,11 +48,11 @@ import jax.numpy as jnp
 from .. import observability as _obs
 from ..distributed.resilience import faults
 from ..distributed.resilience.retry import call_with_retry, default_policy
-from ..incubate.nn.pallas.paged_attention import (_dequant,
-                                                  quantize_kv_pages)
+from ..incubate.nn.pallas.paged_attention import quantize_kv_pages
 from ..models.generation import _sample
 from ..observability.tracing import span
 from .block_manager import BlockManager
+from .kv_store import codec as kv_codec
 from .scheduler import (CANCELLED, FINISHED, HANDOFF, PREFILL, RUNNING,
                         PrefillChunk, Request, Scheduler)
 
@@ -121,13 +121,8 @@ class KVHandoff:
     v_pages: Tuple[object, ...]
 
     def nbytes(self) -> int:
-        total = 0
-        for pages in self.k_pages + self.v_pages:
-            if isinstance(pages, dict):
-                total += pages["q8"].nbytes + pages["s"].nbytes
-            else:
-                total += pages.nbytes
-        return total
+        return kv_codec.pages_nbytes(self.k_pages) + \
+            kv_codec.pages_nbytes(self.v_pages)
 
 
 class RequestError(RuntimeError):
@@ -235,6 +230,12 @@ class ServingEngine:
         self._last_emit: Dict[int, float] = {}  # guarded by: _lock
         self._handoff_ready: List[Request] = []  # guarded by: _lock
         self._dead = False  # guarded by: _lock (fail_all called)
+        # cluster KV tier hooks (set_kv_hooks): registration/eviction
+        # of prefix-cached blocks flows to the cluster store when wired
+        self._kv_register = None  # guarded by: _lock
+        self._kv_evict = None  # guarded by: _lock
+        self.manager.set_hooks(on_evict=self._on_block_evicted,
+                               on_register=self._on_block_registered)
         # request-scoped observability (PR 16): access log + rolling
         # windows + SLO engine, all built lazily on first touch so a
         # telemetry-disabled engine allocates none of it
@@ -505,36 +506,15 @@ class ServingEngine:
     # ------------------------------------------- disaggregated handoff
     def _export_pages(self, blocks: List[int]):  # ptlint: holds=_lock
         """Materialize the KV pages of ``blocks`` (host copies, native
-        pool layout: fp arrays or int8 ``{"q8","s"}`` dicts)."""
-        idx = np.asarray(blocks, np.int32)
-
-        def take(pool):
-            if isinstance(pool, dict):
-                return {"q8": np.asarray(pool["q8"][:, idx]),
-                        "s": np.asarray(pool["s"][:, idx])}
-            return np.asarray(pool[:, idx])
-
-        k = tuple(take(p) for p in self._kp)
-        v = tuple(take(p) for p in self._vp)
-        return k, v
+        pool layout) through the shared :mod:`kv_store.codec`."""
+        return (kv_codec.take_pages(self._kp, blocks),
+                kv_codec.take_pages(self._vp, blocks))
 
     @staticmethod
     def _import_pages(pool, blocks, pages):
-        """Write exported pages into this engine's pool at ``blocks``."""
-        idx = np.asarray(blocks, np.int32)
-        if isinstance(pool, dict):
-            if not isinstance(pages, dict):
-                raise ValueError("fp pages offered to an int8 pool")
-            return {"q8": pool["q8"].at[:, idx].set(
-                        jnp.asarray(pages["q8"])),
-                    "s": pool["s"].at[:, idx].set(
-                        jnp.asarray(pages["s"]))}
-        if isinstance(pages, dict):
-            # int8 wire payload into an fp pool: decode through the
-            # shared page-codec rule
-            deq = _dequant(pages["q8"], pages["s"])
-            return pool.at[:, idx].set(jnp.asarray(deq, pool.dtype))
-        return pool.at[:, idx].set(jnp.asarray(pages, pool.dtype))
+        """Write exported pages into this engine's pool at ``blocks``
+        (shared :mod:`kv_store.codec` — the one int8<->fp decode rule)."""
+        return kv_codec.put_pages(pool, blocks, pages)
 
     def take_handoff(self) -> Optional[KVHandoff]:
         """Pop one prefilled request off the handoff queue as a
@@ -611,6 +591,117 @@ class ServingEngine:
             self._streams[req.rid] = queue.Queue()
         self._wakeup.set()
         return req.rid
+
+    # ------------------------------------------------ cluster KV tier
+    def set_kv_hooks(self, on_register=None, on_evict=None) -> None:
+        """Wire this engine into a cluster KV store.  ``on_register(h)``
+        fires when a prefix block is published under chain hash ``h``;
+        ``on_evict(h, k_pages, v_pages)`` fires when a cached block is
+        about to be reused, with its pages already exported (host
+        copies) so the tier can spill instead of discard.  Both run
+        under the engine lock — hooks must not call back into the
+        engine (enqueue and return)."""
+        with self._lock:
+            self._kv_register = on_register
+            self._kv_evict = on_evict
+
+    def _on_block_registered(self, bid: int, h: int) -> None:  # ptlint: holds=_lock
+        # BlockManager hook; runs under _lock (manager is only mutated
+        # under it), may re-enter via the RLock
+        cb = self._kv_register
+        if cb is not None:
+            cb(h)
+
+    def _on_block_evicted(self, bid: int, h: int) -> None:  # ptlint: holds=_lock
+        # fires BEFORE the page is reused/forgotten: the one moment the
+        # block's KV can still be saved. Export is a single-block host
+        # copy — synchronous by necessity (the page is overwritten the
+        # instant this returns); quantize/spill happen on the pump.
+        cb = self._kv_evict
+        if cb is None:
+            return
+        k, v = self._export_pages([bid])
+        cb(h, k, v)
+
+    def probe_prefix(self, prompt: Sequence[int]) -> int:
+        """Local prefix-cache depth (whole blocks) without taking refs."""
+        with self._lock:
+            return self.manager.probe_prefix(list(prompt))
+
+    def export_prefix(self, prompt: Sequence[int]):
+        """Export the pages of this engine's longest cached prefix of
+        ``prompt`` (host copies, native pool layout).  Returns
+        ``(k_pages, v_pages, n_blocks)`` or None when nothing matches.
+        The blocks are revived+freed around the copy, so they stay
+        MRU in the evictable cache — serving a cross-replica fetch
+        refreshes the prefix here too."""
+        with self._lock:
+            if self._dead:
+                return None
+            blocks, _ = self.manager.match_prefix(list(prompt))
+            if not blocks:
+                return None
+            k, v = self._export_pages(blocks)
+            self.manager.free(blocks)
+            return k, v, len(blocks)
+
+    def import_prefix(self, prompt: Sequence[int], n_blocks: int,
+                      k_pages, v_pages) -> int:
+        """Seat a fetched prefix into this engine's prefix cache:
+        allocate pages, import the KV through the shared codec, publish
+        the blocks under the prompt's chain hashes, and park them in
+        the evictable LRU — the scheduler's normal ``match_prefix``
+        then hits them at admission.  Returns tokens made resident (0
+        when the local cache is already at least as deep, the pool
+        can't take the pages, or prefix caching is off).  Raises
+        ``ValueError`` for fp pages offered to an int8 pool (the codec
+        refuses lossy requantization)."""
+        bs = self.manager.block_size
+        with self._lock:
+            if self._dead or not self.manager.enable_prefix_cache:
+                return 0
+            n = min(int(n_blocks), (len(prompt) - 1) // bs)
+            if n <= 0 or self.manager.probe_prefix(prompt) >= n:
+                return 0
+            if not self.manager.can_allocate(n):
+                return 0
+
+            def clip(pg):
+                if n == n_blocks:
+                    return pg
+                if isinstance(pg, dict):
+                    return {"q8": pg["q8"][:, :n], "s": pg["s"][:, :n]}
+                return pg[:, :n]
+
+            blocks = self.manager.allocate(n)
+            self._kp = tuple(
+                kv_codec.put_pages(p, blocks, clip(pg))
+                for p, pg in zip(self._kp, k_pages))
+            self._vp = tuple(
+                kv_codec.put_pages(p, blocks, clip(pg))
+                for p, pg in zip(self._vp, v_pages))
+            # first-writer-wins: blocks whose chain hash is already
+            # cached here stay unregistered and fall back to the free
+            # list on free() — no leak, no double-mapping
+            self.manager.register_prefix(list(prompt)[:n * bs], blocks)
+            self.manager.free(blocks)
+            return n * bs
+
+    def demote_evictable(self, n: int) -> int:
+        """Watermark-driven proactive demotion: when the free list has
+        drained to the admission watermark, hand up to ``n`` LRU
+        evictable blocks to the KV tier (via the eviction hook) and
+        return them to the free list.  No-op while free blocks are
+        plentiful or no tier is wired."""
+        with self._lock:
+            if self._dead or self._kv_evict is None:
+                return 0
+            # pressure signal: the DIRECTLY usable free list (not
+            # counting evictable pages) is at/below the watermark
+            if self.manager.free_list_size() > \
+                    self.manager.watermark_blocks:
+                return 0
+            return len(self.manager.pop_evictable(n))
 
     # ------------------------------------------------------- step engine
     def step(self) -> bool:

@@ -1,0 +1,20 @@
+"""Cluster-wide KV store: global prefix index + host-RAM tier.
+
+The tier between replica block pools and recompute:
+
+* :mod:`~paddle_tpu.serving.kv_store.codec` — the ONE int8 page
+  wire/spill codec (extracted from the engine/disagg duplicates).
+* :mod:`~paddle_tpu.serving.kv_store.index` — cluster-global prefix
+  index on the control-plane store, generation-fenced registration.
+* :mod:`~paddle_tpu.serving.kv_store.host_tier` — capacity-bounded
+  host-RAM spill tier with CRC-checked round trips.
+* :mod:`~paddle_tpu.serving.kv_store.fetch` — router/engine glue:
+  admission-time prefetch, async promote/demote pump.
+"""
+from . import codec
+from .fetch import ClusterKVStore, KVStoreConfig
+from .host_tier import HostEntry, HostTier
+from .index import HOST_OWNER, GlobalPrefixIndex
+
+__all__ = ["ClusterKVStore", "KVStoreConfig", "GlobalPrefixIndex",
+           "HOST_OWNER", "HostTier", "HostEntry", "codec"]

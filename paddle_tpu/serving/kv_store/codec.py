@@ -1,0 +1,114 @@
+"""The ONE page wire/spill codec for paged KV.
+
+Until this module, the int8 ``{"q8","s"}`` page layout had two private
+encoders: :meth:`ServingEngine._export_pages` /
+:meth:`ServingEngine._import_pages` (the disagg handoff's take/put) and
+:meth:`KVHandoff.nbytes` (the disagg pump's byte accounting). Both are
+now thin wrappers over this module, and the cluster KV store
+(``kv_store/host_tier.py``) reuses the exact same layout as its spill
+format — pages quantized once (`quantize_kv_pages`), decoded through
+the one ``_dequant`` rule, CRC-checked on every host-tier round trip.
+
+Layout (per layer):
+
+* fp pages: ``np.ndarray [n_kv, nb, page, d]`` in the pool dtype;
+* int8 pages: ``{"q8": int8 [n_kv, nb, page, d],
+  "s": f32 [n_kv, nb, page]}`` — the PR 12 handoff serialization.
+
+``take_pages`` always returns HOST copies (np.asarray), so a payload
+survives the source pool being overwritten or its replica dying.
+"""
+from __future__ import annotations
+
+import zlib
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ...incubate.nn.pallas.paged_attention import (_dequant,
+                                                   quantize_kv_pages)
+
+__all__ = ["take_pages", "put_pages", "pages_nbytes", "to_spill",
+           "spill_crc"]
+
+
+def take_pages(pools: Sequence[object], blocks: Sequence[int]) -> Tuple:
+    """Materialize the KV pages of ``blocks`` out of per-layer pools
+    (host copies, native pool layout: fp arrays or int8 ``{"q8","s"}``
+    dicts). This is the export half of every page move in the tree —
+    disagg handoffs, cross-replica prefix fetches, host-tier spills."""
+    idx = np.asarray(blocks, np.int32)
+
+    def take(pool):
+        if isinstance(pool, dict):
+            return {"q8": np.asarray(pool["q8"][:, idx]),
+                    "s": np.asarray(pool["s"][:, idx])}
+        return np.asarray(pool[:, idx])
+
+    return tuple(take(p) for p in pools)
+
+
+def put_pages(pool, blocks: Sequence[int], pages):
+    """Write exported pages into a pool at ``blocks`` (returns the new
+    pool). int8 payloads land in an fp pool through the shared
+    ``_dequant`` rule; fp payloads cannot be requantized losslessly, so
+    offering them to an int8 pool raises."""
+    idx = np.asarray(blocks, np.int32)
+    if isinstance(pool, dict):
+        if not isinstance(pages, dict):
+            raise ValueError("fp pages offered to an int8 pool")
+        return {"q8": pool["q8"].at[:, idx].set(
+                    jnp.asarray(pages["q8"])),
+                "s": pool["s"].at[:, idx].set(
+                    jnp.asarray(pages["s"]))}
+    if isinstance(pages, dict):
+        # int8 wire payload into an fp pool: decode through the
+        # shared page-codec rule
+        deq = _dequant(pages["q8"], pages["s"])
+        return pool.at[:, idx].set(jnp.asarray(deq, pool.dtype))
+    return pool.at[:, idx].set(jnp.asarray(pages, pool.dtype))
+
+
+def pages_nbytes(pages: Sequence[object]) -> int:
+    """Payload bytes of a per-layer page sequence (fp arrays or int8
+    dicts) — the disagg pump's span accounting."""
+    total = 0
+    for p in pages:
+        if isinstance(p, dict):
+            total += p["q8"].nbytes + p["s"].nbytes
+        else:
+            total += p.nbytes
+    return total
+
+
+def to_spill(pages: Sequence[object]) -> Tuple:
+    """Normalize per-layer pages to the universal int8 spill layout
+    (host copies). Already-quantized dicts pass through; fp pages are
+    quantized with the same ``quantize_kv_pages`` the int8 pools use.
+    NOTE: fp -> int8 is lossy; a host-tier restore into an fp pool is
+    only TOKEN-exact when the serving pools are int8 themselves (the
+    spill then round-trips bit-exact)."""
+    out: List[dict] = []
+    for p in pages:
+        if isinstance(p, dict):
+            out.append({"q8": np.asarray(p["q8"]),
+                        "s": np.asarray(p["s"])})
+        else:
+            q = quantize_kv_pages(jnp.asarray(p))
+            out.append({"q8": np.asarray(q["q8"]),
+                        "s": np.asarray(q["s"])})
+    return tuple(out)
+
+
+def spill_crc(k_spill: Sequence[dict], v_spill: Sequence[dict]) -> int:
+    """CRC32 over every spill byte (q8 payloads + scales, k then v,
+    layer order) — what the host tier verifies on every round trip so
+    a corrupted page is a recompute, never wrong attention."""
+    crc = 0
+    for layer in tuple(k_spill) + tuple(v_spill):
+        crc = zlib.crc32(np.ascontiguousarray(layer["q8"]), crc)
+        crc = zlib.crc32(np.ascontiguousarray(
+            layer["s"], dtype=np.float32), crc)
+    return crc

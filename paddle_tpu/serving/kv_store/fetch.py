@@ -1,0 +1,448 @@
+"""ClusterKVStore: the cluster-wide KV cache tier between replicas
+and recompute.
+
+Wires the three kv_store pieces into the serving cluster:
+
+* On admission (``ClusterRouter.submit``, after routing picks a
+  target), :meth:`prefetch` consults the
+  :class:`~paddle_tpu.serving.kv_store.index.GlobalPrefixIndex` for
+  the prompt's deepest VALID cached prefix anywhere in the cluster.
+  A hit on another replica exports the pages there
+  (:meth:`ServingEngine.export_prefix`) and imports them into the
+  target (:meth:`ServingEngine.import_prefix` — the
+  ``adopt_handoff``-style page move, int8->fp dequant included); a hit
+  on the host tier promotes the int8 spill back into the target pool.
+  Either way the target's OWN prefix cache then matches the blocks at
+  admission (``serving.prefix_hit_tokens`` counts the saved prefill).
+  Any miss, stale entry, or CRC failure falls back to recompute —
+  the tier can only ever save work, never corrupt a stream.
+
+* On eviction, the :class:`BlockManager` demotion hook hands each
+  evicted prefix block's pages to :meth:`_on_evict` instead of
+  discarding them; the **async pump** (:meth:`pump`, driven from
+  ``router.step()`` or the threaded :meth:`start` loop) quantizes them
+  to the universal int8 spill layout, CRC-stamps them into the
+  :class:`~paddle_tpu.serving.kv_store.host_tier.HostTier`, and
+  registers the host location in the global index. The pump also runs
+  **watermark-driven demotion**: replicas whose free list dropped to
+  the admission watermark proactively spill their LRU evictable
+  blocks (``ServingEngine.demote_evictable``) so pool pressure turns
+  into host-tier capacity instead of silent discards.
+
+Activation: pass ``kv_store=ClusterKVStore(...)`` to
+:class:`ClusterRouter`, or set ``PADDLE_TPU_KV_TIER=host`` and the
+router builds one on the control plane's store automatically. Default
+is off — zero behavior change.
+
+Exactness: cross-replica fetches move pages in the native pool layout
+(bit-exact). Host-tier restores are bit-exact when the serving pools
+are int8 (``kv_quant="int8"`` — the spill IS the pool layout); with fp
+pools the spill quantization is lossy, so deploy the host tier with
+int8 pools when token-exact parity with recompute matters (the bench
+and smoke arms assert exactly this).
+"""
+from __future__ import annotations
+
+import collections
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ... import observability as _obs
+from ...distributed.control_plane import LocalStore
+from ...observability.tracing import span
+from ...observability.windows import Windows
+from ..block_manager import hash_block_tokens
+from . import codec
+from .host_tier import HostTier
+from .index import HOST_OWNER, GlobalPrefixIndex
+
+__all__ = ["ClusterKVStore", "KVStoreConfig"]
+
+
+def _env_f(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+class KVStoreConfig:
+    """Resolved cluster-KV knobs (ctor args win over env vars)."""
+
+    def __init__(self, tier: Optional[str] = None,
+                 host_mb: Optional[float] = None,
+                 pump_interval_s: Optional[float] = None,
+                 demote_batch: int = 8,
+                 max_demote_queue: int = 256):
+        # "off" = global index only (cross-replica fetches still work);
+        # "host" adds the host-RAM spill tier
+        self.tier = (tier or os.environ.get("PADDLE_TPU_KV_TIER")
+                     or "host").lower()
+        self.host_mb = host_mb if host_mb is not None else \
+            _env_f("PADDLE_TPU_KV_HOST_MB", 64.0)
+        self.pump_interval_s = pump_interval_s \
+            if pump_interval_s is not None \
+            else _env_f("PADDLE_TPU_KV_PUMP_S", 0.02)
+        self.demote_batch = int(demote_batch)
+        self.max_demote_queue = int(max_demote_queue)
+        if self.tier not in ("off", "host"):
+            raise ValueError("PADDLE_TPU_KV_TIER must be off|host")
+        if self.demote_batch <= 0 or self.max_demote_queue <= 0:
+            raise ValueError(
+                "demote_batch and max_demote_queue must be > 0")
+
+
+# plain-int counter keys (always maintained, telemetry on or off, so
+# smokes/benches can assert behavior without enabling observability)
+_COUNTS = ("lookups", "index_hits", "index_misses", "fetches_replica",
+           "fetches_host", "fetch_tokens", "stale_skips", "promotes",
+           "demotes", "host_evictions", "crc_failures", "queue_drops")
+
+
+class ClusterKVStore:
+    """Global prefix index + host tier + promote/demote pump."""
+
+    def __init__(self, control_plane=None,
+                 config: Optional[KVStoreConfig] = None,
+                 store=None, namespace: str = "kv"):
+        self.config = config or KVStoreConfig()
+        self.control_plane = control_plane
+        if store is None:
+            store = control_plane.store if control_plane is not None \
+                else LocalStore()
+        self.index = GlobalPrefixIndex(store, namespace)
+        self.host = HostTier(self.config.host_mb) \
+            if self.config.tier == "host" else None
+        self._lock = threading.Lock()
+        self._replicas: Dict[str, object] = {}  # guarded by: _lock
+        self._gens: Dict[str, Optional[int]] = {}  # guarded by: _lock
+        # evicted blocks awaiting quantize+spill: (hash, k, v, tokens).
+        # Bounded: overflow drops the OLDEST (it was the coldest), which
+        # degrades to the pre-tier discard behavior, never blocks.
+        self._queue: "collections.deque" = collections.deque(
+            maxlen=self.config.max_demote_queue)  # guarded by: _lock
+        self._counts = {k: 0 for k in _COUNTS}  # guarded by: _lock
+        # rolling hit-rate windows for ptop / SLO-style dashboards
+        self.windows = Windows("kv")
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # -------------------------------------------------------- accounting
+    def _count(self, key: str, n: int = 1) -> None:
+        with self._lock:
+            self._counts[key] += n
+
+    @property
+    def counts(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._counts)
+
+    # --------------------------------------------------- replica wiring
+    def attach(self, replica) -> None:
+        """Hook one replica's engine into the tier: prefix
+        registrations flow to the global index (generation-fenced with
+        the replica's current lease generation) and LRU evictions flow
+        to the demote queue instead of being discarded."""
+        name = replica.name
+        gen = None
+        if self.control_plane is not None:
+            gen = self.control_plane.generation(name)
+        with self._lock:
+            self._replicas[name] = replica
+            self._gens[name] = gen
+        replica.engine.set_kv_hooks(
+            on_register=lambda h, _n=name: self._on_register(_n, h),
+            on_evict=lambda h, k, v, _n=name:
+                self._on_evict(_n, h, k, v))
+
+    def detach(self, replica) -> None:
+        with self._lock:
+            self._replicas.pop(replica.name, None)
+            self._gens.pop(replica.name, None)
+        replica.engine.set_kv_hooks(on_register=None, on_evict=None)
+        self.index.purge_owner(replica.name)
+
+    def on_replica_dead(self, name: str) -> None:
+        """Death/eviction cleanup. Optional for correctness — a dead
+        replica's entries already fail lease/generation validation —
+        but keeps the index lean."""
+        with self._lock:
+            self._replicas.pop(name, None)
+            self._gens.pop(name, None)
+        self.index.purge_owner(name)
+
+    # ------------------------------------------------------ engine hooks
+    def _on_register(self, name: str, h: int) -> None:
+        with self._lock:
+            gen = self._gens.get(name)
+        self.index.register(h, name, gen=gen)
+
+    def _on_evict(self, name: str, h: int, k_pages, v_pages) -> None:
+        """BlockManager demotion hook (fires under the engine lock):
+        the replica no longer holds ``h``; queue its pages for the
+        async spill instead of discarding them."""
+        self.index.unregister(h, name)
+        if self.host is None:
+            return
+        with self._lock:
+            if len(self._queue) == self._queue.maxlen:
+                self._counts["queue_drops"] += 1
+            self._queue.append((int(h), k_pages, v_pages))
+
+    # ----------------------------------------------------------- lookup
+    def _chain(self, prompt: Sequence[int], bs: int) -> List[int]:
+        # same limit as BlockManager.match_prefix: at least one prompt
+        # token always prefills, so only (len-1)//bs blocks can help
+        h: Optional[int] = None
+        out: List[int] = []
+        for i in range((len(prompt) - 1) // bs):
+            h = hash_block_tokens(h, prompt[i * bs:(i + 1) * bs])
+            out.append(h)
+        return out
+
+    def _valid(self, h: int, owner: str, entry: dict) -> bool:
+        """Lookup-time liveness: host entries must be present in the
+        tier; replica entries need an attached, alive replica whose
+        lease is fresh AND whose current generation matches the one
+        fenced into the entry — a dead replica's registrations are
+        invalidated by its lease expiry, no cleanup write needed."""
+        if entry.get("tier") == "host":
+            return self.host is not None and owner == HOST_OWNER \
+                and h in self.host
+        with self._lock:
+            rep = self._replicas.get(owner)
+        if rep is None or not rep.alive:
+            return False
+        cp = self.control_plane
+        if cp is not None:
+            if not cp.fresh(owner):
+                return False
+            gen = entry.get("gen")
+            if gen is None or int(gen) != cp.generation(owner):
+                return False
+        return True
+
+    # ------------------------------------------------------------ fetch
+    def prefetch(self, rep, prompt: Sequence[int]) -> int:
+        """Admission-time fetch: pull the prompt's deepest valid cached
+        prefix into ``rep`` so the scheduler's normal ``match_prefix``
+        hits it. Returns tokens imported (0 = recompute, the only
+        fallback). Never raises past a stale owner or CRC failure."""
+        bs = rep.engine.manager.block_size
+        chain = self._chain(prompt, bs)
+        if not chain:
+            return 0
+        with span("kv.fetch"):
+            self._count("lookups")
+            if _obs.enabled():
+                self.windows.counter("kv.lookups").inc()
+            local = rep.engine.probe_prefix(prompt)
+            hit = self.index.lookup(
+                chain, lambda h, o, e: self._valid(h, o, e))
+            if hit is None or hit[0] <= local or hit[1] == rep.name:
+                # nothing anywhere, or the target already holds it
+                self._count("index_misses")
+                if _obs.enabled():
+                    _obs.registry.counter("kv.index_misses").inc()
+                return 0
+            depth, owner, tier = hit
+            self._count("index_hits")
+            if _obs.enabled():
+                _obs.registry.counter("kv.index_hits").inc()
+            if tier == "replica":
+                imported = self._fetch_replica(owner, rep, prompt,
+                                               depth, bs)
+                source = "replica"
+            else:
+                t0 = time.monotonic()
+                with span("kv.promote", args={"blocks": depth}):
+                    imported = self._fetch_host(rep, prompt, chain,
+                                                depth, bs)
+                if imported and _obs.enabled():
+                    _obs.registry.histogram(
+                        "kv.promote_time").observe(
+                            time.monotonic() - t0)
+                source = "host"
+            if imported:
+                self._count("fetches_%s" % source)
+                self._count("fetch_tokens", imported)
+                if _obs.enabled():
+                    _obs.registry.counter(
+                        "kv.fetches", tags={"source": source}).inc()
+                    _obs.registry.counter(
+                        "kv.fetch_tokens",
+                        tags={"source": source}).inc(imported)
+                    self.windows.counter("kv.hits").inc()
+            else:
+                self._count("stale_skips")
+                if _obs.enabled():
+                    _obs.registry.counter("kv.stale_skips").inc()
+            return imported
+
+    def _fetch_replica(self, owner: str, rep, prompt, depth: int,
+                       bs: int) -> int:
+        with self._lock:
+            src = self._replicas.get(owner)
+        if src is None or not src.alive:
+            return 0
+        # full prompt, not prompt[:depth*bs] — match_prefix's
+        # (len-1)//bs limit would shave the deepest block off a
+        # truncated prompt
+        out = src.engine.export_prefix(list(prompt))
+        if out is None:
+            return 0                    # evicted between lookup & now
+        k_pages, v_pages, n = out
+        n = min(n, depth)
+        try:
+            return rep.engine.import_prefix(prompt, n, k_pages,
+                                            v_pages)
+        except ValueError:
+            # heterogeneous pools (fp export into int8 target): the
+            # codec refuses lossy requantization — recompute instead
+            return 0
+
+    def _fetch_host(self, rep, prompt, chain, depth: int,
+                    bs: int) -> int:
+        """Promote the longest contiguous run of spilled blocks from
+        block 0; any gap or CRC failure truncates the run (the rest is
+        recomputed)."""
+        if self.host is None:
+            return 0
+        entries = []
+        for i in range(depth):
+            crc0 = self.host.crc_failures
+            ent = self.host.get(chain[i])
+            if ent is None:
+                failed = self.host.crc_failures - crc0
+                if failed:
+                    self._count("crc_failures", failed)
+                    self.index.unregister(chain[i], HOST_OWNER)
+                    if _obs.enabled():
+                        _obs.registry.counter(
+                            "kv.crc_failures").inc(failed)
+                break
+            entries.append(ent)
+        if not entries:
+            return 0
+        n = len(entries)
+        nl = len(entries[0].k_spill)
+
+        def cat(spills):
+            return tuple(
+                {"q8": np.concatenate([s[i]["q8"] for s in spills],
+                                      axis=1),
+                 "s": np.concatenate([s[i]["s"] for s in spills],
+                                     axis=1)} for i in range(nl))
+
+        k_pages = cat([e.k_spill for e in entries])
+        v_pages = cat([e.v_spill for e in entries])
+        try:
+            imported = rep.engine.import_prefix(prompt, n, k_pages,
+                                                v_pages)
+        except ValueError:
+            return 0
+        if imported:
+            self._count("promotes")
+            if _obs.enabled():
+                _obs.registry.counter("kv.promotes").inc()
+        return imported
+
+    # ------------------------------------------------------------- pump
+    def pump(self, max_items: Optional[int] = None) -> int:
+        """One async promote/demote pass (called from ``router.step()``
+        or the threaded loop): proactively demote LRU evictable blocks
+        on watermark-pressured replicas, then quantize + CRC + store
+        every queued eviction into the host tier and publish the host
+        locations in the index. Returns blocks spilled this pass."""
+        if self.host is None:
+            return 0
+        with self._lock:
+            reps = list(self._replicas.values())
+        for rep in reps:
+            if rep.alive:
+                # fires the demotion hook under the engine lock, which
+                # enqueues onto self._queue — drained just below
+                rep.engine.demote_evictable(self.config.demote_batch)
+        budget = max_items if max_items is not None else \
+            max(self.config.demote_batch * 4, 1)
+        moved = 0
+        while moved < budget:
+            with self._lock:
+                if not self._queue:
+                    break
+                h, k_pages, v_pages = self._queue.popleft()
+            t0 = time.monotonic()
+            with span("kv.demote", args={"hash": h}):
+                k_spill = codec.to_spill(k_pages)
+                v_spill = codec.to_spill(v_pages)
+                crc = codec.spill_crc(k_spill, v_spill)
+                evicted = self.host.put(h, k_spill, v_spill, crc)
+            if h in evicted:
+                continue                # bigger than the whole budget
+            for ev in evicted:
+                self.index.unregister(ev, HOST_OWNER)
+            if evicted:
+                self._count("host_evictions", len(evicted))
+            self.index.register_host(h)
+            self._count("demotes")
+            moved += 1
+            if _obs.enabled():
+                _obs.registry.counter("kv.demotes").inc()
+                if evicted:
+                    _obs.registry.counter(
+                        "kv.host_evictions").inc(len(evicted))
+                _obs.registry.histogram("kv.demote_time").observe(
+                    time.monotonic() - t0)
+        if _obs.enabled():
+            snap = self.host.snapshot()
+            _obs.registry.gauge("kv.host_blocks").set(snap["blocks"])
+            _obs.registry.gauge("kv.host_bytes").set(snap["bytes"])
+            _obs.registry.gauge("kv.index_entries").set(
+                self.index.num_entries())
+        return moved
+
+    # --------------------------------------------------------- lifecycle
+    def start(self) -> None:
+        """Threaded pump for ``router.start()`` mode; cadence is
+        ``PADDLE_TPU_KV_PUMP_S``. The synchronous ``router.step()``
+        driver calls :meth:`pump` directly instead."""
+        if self._thread is not None:
+            return
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.is_set():
+                if self.pump() == 0:
+                    time.sleep(self.config.pump_interval_s)
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="kv-store-pump")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+
+    # ---------------------------------------------------------- snapshot
+    def snapshot(self) -> dict:
+        """The ``kv`` section of the cluster ops snapshot (ptop's KV
+        tier panel + diagnose's bundle view read this shape)."""
+        counts = self.counts
+        looked = counts["lookups"]
+        served = counts["fetches_replica"] + counts["fetches_host"]
+        with self._lock:
+            qlen = len(self._queue)
+        return {"kind": "kv_store", "tier": self.config.tier,
+                "counts": counts,
+                "hit_rate": (served / looked) if looked else 0.0,
+                "demote_queue": qlen,
+                "host": self.host.snapshot()
+                if self.host is not None else None,
+                "index": self.index.snapshot(),
+                "windows": self.windows.snapshot()}

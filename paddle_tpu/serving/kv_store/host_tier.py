@@ -1,0 +1,161 @@
+"""Host-RAM KV tier: capacity-bounded int8 page spill storage.
+
+The second rung of the cluster cache hierarchy: when a replica's paged
+prefix cache evicts an LRU block (pool pressure), the block's pages are
+no longer discarded — they are quantized to the universal int8 spill
+layout (:func:`~paddle_tpu.serving.kv_store.codec.to_spill`, the PR 12
+handoff serialization) and parked here, keyed by the SAME rolling
+chain hash the prefix caches and the global index use. A later request
+anywhere in the cluster promotes them back into a device pool instead
+of recomputing the prefill.
+
+Properties:
+
+* **Capacity-bounded** — ``PADDLE_TPU_KV_HOST_MB`` (ctor arg wins)
+  caps payload bytes; inserting past the cap evicts LRU entries first
+  (the evicted hashes are returned so the caller can unregister them
+  from the global index).
+* **CRC-checked round trips** — every entry stores the CRC32 of its
+  spill bytes at insert; :meth:`get` re-computes and verifies, and a
+  mismatch DROPS the entry and returns None — a corrupted page is a
+  recompute upstream, never wrong attention.
+* **Engine-agnostic** — entries are plain host numpy; nothing here
+  imports jax, so the tier (and its tests) stay cheap.
+
+One entry = one block's per-layer k/v spill pages. Promotion of a
+multi-block prefix is the caller walking the chain shallow-to-deep and
+concatenating contiguous hits (:meth:`ClusterKVStore._fetch_host`).
+"""
+from __future__ import annotations
+
+import collections
+import os
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from . import codec
+
+__all__ = ["HostTier", "HostEntry"]
+
+
+def _env_f(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+class HostEntry:
+    """One spilled block: per-layer int8 k/v pages + integrity CRC."""
+
+    __slots__ = ("h", "k_spill", "v_spill", "crc", "nbytes", "tokens")
+
+    def __init__(self, h: int, k_spill: Tuple, v_spill: Tuple,
+                 crc: int, tokens: int):
+        self.h = int(h)
+        self.k_spill = k_spill
+        self.v_spill = v_spill
+        self.crc = int(crc)
+        self.nbytes = codec.pages_nbytes(k_spill) + \
+            codec.pages_nbytes(v_spill)
+        self.tokens = int(tokens)
+
+
+class HostTier:
+    """LRU dict of spilled blocks under a byte budget (thread-safe)."""
+
+    def __init__(self, capacity_mb: Optional[float] = None):
+        mb = capacity_mb if capacity_mb is not None else \
+            _env_f("PADDLE_TPU_KV_HOST_MB", 64.0)
+        self.capacity_bytes = int(max(0.0, float(mb)) * 1024 * 1024)
+        self._lock = threading.Lock()
+        self._entries: "collections.OrderedDict[int, HostEntry]" = \
+            collections.OrderedDict()  # guarded by: _lock (LRU order)
+        self._bytes = 0  # guarded by: _lock
+        self._crc_failures = 0  # guarded by: _lock
+
+    # ---------------------------------------------------------- mutation
+    def put(self, h: int, k_spill: Sequence[dict],
+            v_spill: Sequence[dict], crc: Optional[int] = None,
+            tokens: int = 0) -> List[int]:
+        """Insert (or refresh) one block's spill under chain hash
+        ``h``; evicts LRU entries to fit. Returns the evicted hashes
+        (so the caller can unregister them from the global index). An
+        entry larger than the whole budget is refused (returned as its
+        own "eviction")."""
+        if crc is None:
+            crc = codec.spill_crc(k_spill, v_spill)
+        ent = HostEntry(h, tuple(k_spill), tuple(v_spill), crc, tokens)
+        evicted: List[int] = []
+        with self._lock:
+            old = self._entries.pop(ent.h, None)
+            if old is not None:
+                self._bytes -= old.nbytes
+            if ent.nbytes > self.capacity_bytes:
+                return [ent.h]          # refused: caller must not index
+            while self._bytes + ent.nbytes > self.capacity_bytes \
+                    and self._entries:
+                ev_h, ev = self._entries.popitem(last=False)
+                self._bytes -= ev.nbytes
+                evicted.append(ev_h)
+            self._entries[ent.h] = ent
+            self._bytes += ent.nbytes
+        return evicted
+
+    def get(self, h: int) -> Optional[HostEntry]:
+        """Fetch one block's spill (refreshes LRU). Verifies the CRC
+        over the stored bytes; a mismatch drops the entry and returns
+        None — the caller falls back to recompute."""
+        with self._lock:
+            ent = self._entries.get(int(h))
+            if ent is None:
+                return None
+            self._entries.move_to_end(int(h))
+        if codec.spill_crc(ent.k_spill, ent.v_spill) != ent.crc:
+            with self._lock:
+                cur = self._entries.pop(int(h), None)
+                if cur is not None:
+                    self._bytes -= cur.nbytes
+                self._crc_failures += 1
+            return None
+        return ent
+
+    def drop(self, h: int) -> bool:
+        with self._lock:
+            ent = self._entries.pop(int(h), None)
+            if ent is None:
+                return False
+            self._bytes -= ent.nbytes
+            return True
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._bytes = 0
+
+    # ------------------------------------------------------------ health
+    def __contains__(self, h: int) -> bool:
+        with self._lock:
+            return int(h) in self._entries
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    @property
+    def bytes_used(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    @property
+    def crc_failures(self) -> int:
+        with self._lock:
+            return self._crc_failures
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            return {"kind": "kv_host_tier",
+                    "blocks": len(self._entries),
+                    "bytes": self._bytes,
+                    "capacity_bytes": self.capacity_bytes,
+                    "crc_failures": self._crc_failures}

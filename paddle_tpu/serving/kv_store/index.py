@@ -1,0 +1,171 @@
+"""Cluster-global prefix index: block-hash chains -> page locations.
+
+The router already keys prefix affinity by the rolling
+:func:`~paddle_tpu.serving.block_manager.hash_block_tokens` chain; this
+index makes the SAME keys cluster-global state. Each chain hash maps to
+the set of owners currently holding that block's KV pages:
+
+* ``{replica_name: {"tier": "replica", "gen": lease_generation}}`` —
+  the replica's paged prefix cache holds the block. Registration is
+  **generation-fenced** exactly like the control-plane leases: the
+  entry carries the lease generation the replica held when it
+  registered, and a lookup only trusts it while the replica's lease is
+  fresh AND its current generation still matches. A dead replica's
+  entries are therefore invalidated by its lease expiry with NO
+  cleanup write needed (``purge_owner`` is an optimization, not a
+  correctness requirement).
+* ``{"host": {"tier": "host"}}`` — the host-RAM tier
+  (:class:`~paddle_tpu.serving.kv_store.host_tier.HostTier`) holds the
+  block's int8 spill. Validity is presence in the tier (checked by the
+  caller's validator), so a capacity eviction needs no fencing.
+
+Entries live on the control plane's store (one JSON doc per chain hash
+at ``{ns}/kvidx/{hash}``) through the TCPStore client surface only —
+``set/try_get/delete`` — so a multi-host pool can move to the job
+store unchanged. The read-modify-write on one doc is best-effort by
+design: losing an entry in a write race is a cache miss (recompute),
+never a correctness problem, because every lookup is re-validated and
+every fetch falls back to recompute.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from ...distributed.control_plane import LocalStore, try_get
+
+__all__ = ["GlobalPrefixIndex", "HOST_OWNER"]
+
+# the reserved owner key for host-tier entries (replica names are
+# cluster replica names like "r0"; none of them may shadow this)
+HOST_OWNER = "host"
+
+
+class GlobalPrefixIndex:
+    """Store-backed chain-hash -> owner map with fenced registration."""
+
+    def __init__(self, store=None, namespace: str = "cluster"):
+        self.store = store if store is not None else LocalStore()
+        self.ns = str(namespace)
+        self._lock = threading.Lock()
+        # owner -> registered hashes, for purge without store listing
+        # (TCPStore has no key scan); purely an eviction accelerator
+        self._by_owner: Dict[str, set] = {}  # guarded by: _lock
+
+    def _k(self, h: int) -> str:
+        return "%s/kvidx/%d" % (self.ns, int(h))
+
+    # ------------------------------------------------------------- doc IO
+    def _read(self, h: int) -> Dict[str, dict]:
+        raw = try_get(self.store, self._k(h))
+        if raw is None:
+            return {}
+        try:
+            doc = json.loads(raw.decode())
+            return doc if isinstance(doc, dict) else {}
+        except Exception:
+            return {}
+
+    def _write(self, h: int, doc: Dict[str, dict]) -> None:
+        if doc:
+            self.store.set(self._k(h), json.dumps(doc).encode())
+        else:
+            try:
+                self.store.delete(self._k(h))
+            except Exception:
+                pass
+
+    # --------------------------------------------------------- mutation
+    def register(self, h: int, owner: str,
+                 gen: Optional[int] = None) -> None:
+        """Record that ``owner`` holds the pages of chain hash ``h``.
+        Replica owners pass their current lease generation; host-tier
+        registration uses :meth:`register_host`."""
+        doc = self._read(h)
+        entry: dict = {"tier": "replica"}
+        if gen is not None:
+            entry["gen"] = int(gen)
+        doc[str(owner)] = entry
+        self._write(h, doc)
+        with self._lock:
+            self._by_owner.setdefault(str(owner), set()).add(int(h))
+
+    def register_host(self, h: int) -> None:
+        doc = self._read(h)
+        doc[HOST_OWNER] = {"tier": "host"}
+        self._write(h, doc)
+        with self._lock:
+            self._by_owner.setdefault(HOST_OWNER, set()).add(int(h))
+
+    def unregister(self, h: int, owner: str) -> None:
+        doc = self._read(h)
+        if str(owner) in doc:
+            del doc[str(owner)]
+            self._write(h, doc)
+        with self._lock:
+            hs = self._by_owner.get(str(owner))
+            if hs is not None:
+                hs.discard(int(h))
+
+    def purge_owner(self, owner: str) -> int:
+        """Drop every entry ``owner`` registered (replica death/leave,
+        host-tier teardown). Lookups were already safe without this —
+        a dead replica's entries fail lease/generation validation — so
+        this only keeps the index from accumulating tombstones."""
+        with self._lock:
+            hs = sorted(self._by_owner.pop(str(owner), ()))
+        for h in hs:
+            doc = self._read(h)
+            if str(owner) in doc:
+                del doc[str(owner)]
+                self._write(h, doc)
+        return len(hs)
+
+    # ----------------------------------------------------------- lookup
+    def owners(self, h: int) -> Dict[str, dict]:
+        """Raw (unvalidated) owner entries of one chain hash."""
+        return self._read(h)
+
+    def lookup(self, chain: Sequence[int],
+               valid: Callable[[int, str, dict], bool]) \
+            -> Optional[Tuple[int, str, str]]:
+        """Deepest chain position with a VALID owner. ``chain`` is the
+        rolling hash chain of a prompt (``chain[i]`` covers blocks
+        ``0..i``); ``valid(h, owner, entry)`` is the caller's liveness
+        check (lease freshness + generation fencing for replicas,
+        tier presence for the host). Returns ``(depth_blocks, owner,
+        tier)`` — depth in whole blocks, 1-based — or None.
+
+        Replica owners win ties at equal depth (their pages are
+        already device-resident); the walk is deepest-first so one
+        valid hit ends it."""
+        for i in range(len(chain) - 1, -1, -1):
+            doc = self._read(chain[i])
+            if not doc:
+                continue
+            best: Optional[Tuple[str, str]] = None
+            for owner, entry in sorted(doc.items()):
+                if not valid(chain[i], owner, entry):
+                    continue
+                tier = str(entry.get("tier", "replica"))
+                if tier == "replica":
+                    best = (owner, tier)
+                    break               # device-resident beats host
+                if best is None:
+                    best = (owner, tier)
+            if best is not None:
+                return i + 1, best[0], best[1]
+        return None
+
+    # --------------------------------------------------------- snapshot
+    def num_entries(self) -> int:
+        with self._lock:
+            return len(set().union(*self._by_owner.values())
+                       if self._by_owner else ())
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            per = {o: len(hs) for o, hs in sorted(self._by_owner.items())}
+        return {"kind": "kv_prefix_index", "ns": self.ns,
+                "entries": self.num_entries(), "by_owner": per}

@@ -1,0 +1,487 @@
+"""Cluster-wide KV store: hash-chain properties, the shared page
+codec, BlockManager demotion hooks, the host-RAM tier, the global
+prefix index (incl. randomized cross-replica consistency under
+ManualClock), and engine-to-engine prefix transfer."""
+import random
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu.observability.windows import ManualClock
+from paddle_tpu.serving import BlockManager, hash_block_tokens
+from paddle_tpu.serving.cluster import ClusterControlPlane
+from paddle_tpu.serving.kv_store import (HOST_OWNER, ClusterKVStore,
+                                         GlobalPrefixIndex, HostTier,
+                                         KVStoreConfig, codec)
+
+
+def _chain(tokens, bs):
+    h, out = None, []
+    for i in range(len(tokens) // bs):
+        h = hash_block_tokens(h, tokens[i * bs:(i + 1) * bs])
+        out.append(h)
+    return out
+
+
+# ------------------------------------------------------------ hash chain
+class TestHashChainProperties:
+    """Satellite: the rolling chain the prefix caches, the router
+    affinity map, and the global index all key by."""
+
+    def test_prefix_extension_monotonicity(self):
+        # extending the prompt never rewrites earlier chain links:
+        # chain(p)[:k] == chain(p + tail)[:k] for every k
+        rng = np.random.RandomState(0)
+        for bs in (4, 8, 16):
+            base = rng.randint(0, 1000, 5 * bs).tolist()
+            tail = rng.randint(0, 1000, 3 * bs).tolist()
+            short, long = _chain(base, bs), _chain(base + tail, bs)
+            assert long[:len(short)] == short
+            assert len(long) == len(short) + 3
+
+    def test_chunk_boundary_invariance(self):
+        # the chain depends only on (block_size, token content) — how
+        # the caller sliced/typed the tokens is irrelevant
+        toks = list(range(32))
+        a = _chain(toks, 8)
+        b = _chain(np.asarray(toks, np.int64), 8)
+        c = _chain([np.int32(t) for t in toks], 8)
+        assert a == b == c
+
+    def test_depth_disambiguates_equal_blocks(self):
+        # the same 8 tokens at block 0 and block 1 hash differently
+        # (chained on prev), so caches never alias across depths
+        blk = list(range(8))
+        chain = _chain(blk + blk, 8)
+        assert chain[0] != chain[1]
+
+    def test_content_change_cascades(self):
+        toks = list(range(24))
+        a = _chain(toks, 8)
+        mod = list(toks)
+        mod[8] += 1                      # flip one token in block 1
+        b = _chain(mod, 8)
+        assert a[0] == b[0]
+        assert a[1] != b[1] and a[2] != b[2]
+
+    def test_cross_manager_agreement(self):
+        # two independent managers agree: register on one, match on
+        # the other after replaying the same registration
+        m1 = BlockManager(16, 4)
+        m2 = BlockManager(16, 4)
+        toks = list(range(13))
+        b1 = m1.allocate(4)
+        b2 = m2.allocate(4)
+        assert m1.register_prefix(toks, b1) == \
+            m2.register_prefix(toks, b2) == 3
+        m1.free(b1), m2.free(b2)
+        blocks, n = m2.match_prefix(toks)
+        assert n == 12
+        m2.free(blocks)
+
+
+# ----------------------------------------------------------------- codec
+class TestCodec:
+    def _int8_pool(self, nb=6, seed=0):
+        rng = np.random.RandomState(seed)
+        return {"q8": jnp.asarray(
+                    rng.randint(-127, 128, (2, nb, 4, 8)), jnp.int8),
+                "s": jnp.asarray(rng.rand(2, nb, 4), jnp.float32)}
+
+    def test_int8_take_put_roundtrip_bit_exact(self):
+        pool = self._int8_pool()
+        (pages,) = codec.take_pages([pool], [1, 3, 4])
+        dst = {"q8": jnp.zeros_like(pool["q8"]),
+               "s": jnp.zeros_like(pool["s"])}
+        dst = codec.put_pages(dst, [1, 3, 4], pages)
+        for f in ("q8", "s"):
+            np.testing.assert_array_equal(
+                np.asarray(dst[f][:, [1, 3, 4]]),
+                np.asarray(pool[f][:, [1, 3, 4]]))
+
+    def test_fp_take_put_roundtrip_bit_exact(self):
+        rng = np.random.RandomState(1)
+        pool = jnp.asarray(rng.randn(2, 6, 4, 8), jnp.float32)
+        (pages,) = codec.take_pages([pool], [0, 5])
+        dst = codec.put_pages(jnp.zeros_like(pool), [0, 5], pages)
+        np.testing.assert_array_equal(np.asarray(dst[:, [0, 5]]),
+                                      np.asarray(pool[:, [0, 5]]))
+
+    def test_take_returns_host_copies(self):
+        pool = self._int8_pool()
+        (pages,) = codec.take_pages([pool], [2])
+        assert isinstance(pages["q8"], np.ndarray)
+        assert isinstance(pages["s"], np.ndarray)
+
+    def test_fp_pages_into_int8_pool_refused(self):
+        pool = self._int8_pool()
+        with pytest.raises(ValueError):
+            codec.put_pages(pool, [0], np.zeros((2, 1, 4, 8),
+                                                np.float32))
+
+    def test_int8_spill_passthrough_bit_exact(self):
+        pool = self._int8_pool()
+        (pages,) = codec.take_pages([pool], [1, 2])
+        (spill,) = codec.to_spill([pages])
+        for f in ("q8", "s"):
+            np.testing.assert_array_equal(spill[f], pages[f])
+
+    def test_nbytes_counts_both_layouts(self):
+        q8 = {"q8": np.zeros((2, 3, 4, 8), np.int8),
+              "s": np.zeros((2, 3, 4), np.float32)}
+        fp = np.zeros((2, 3, 4, 8), np.float32)
+        assert codec.pages_nbytes([q8]) == q8["q8"].nbytes + \
+            q8["s"].nbytes
+        assert codec.pages_nbytes([fp]) == fp.nbytes
+        assert codec.pages_nbytes([q8, fp]) == \
+            codec.pages_nbytes([q8]) + codec.pages_nbytes([fp])
+
+    def test_spill_crc_detects_corruption(self):
+        pool = self._int8_pool(seed=3)
+        spill = codec.to_spill(codec.take_pages([pool], [0, 1]))
+        crc = codec.spill_crc(spill, spill)
+        bad = [{"q8": s["q8"].copy(), "s": s["s"]} for s in spill]
+        bad[0]["q8"][0, 0, 0, 0] ^= 1
+        assert codec.spill_crc(bad, spill) != crc
+        badscale = [{"q8": s["q8"],
+                     "s": s["s"] + np.float32(1e-3)} for s in spill]
+        assert codec.spill_crc(badscale, spill) != crc
+
+
+# ------------------------------------------------- block-manager hooks
+class TestBlockManagerDemotionHook:
+    def test_on_evict_fires_before_hash_forgotten(self):
+        m = BlockManager(4, 4, watermark=0.0)
+        seen = []
+        m.set_hooks(on_evict=lambda bid, h: seen.append((bid, h)))
+        toks = list(range(8))
+        blocks = m.allocate(2)
+        m.register_prefix(toks, blocks)
+        m.free(blocks)                   # both park evictable
+        chain = _chain(toks, 4)
+        m.allocate(4)                    # forces both evictions
+        assert [h for _, h in seen] == chain
+        assert set(b for b, _ in seen) == set(blocks)
+
+    def test_pop_evictable_lru_order_and_no_leak(self):
+        m = BlockManager(8, 4, watermark=0.0)
+        seen = []
+        m.set_hooks(on_evict=lambda bid, h: seen.append(h))
+        t1, t2 = list(range(4)), list(range(10, 14))
+        b1 = m.allocate(1)
+        m.register_prefix(t1, b1)
+        m.free(b1)
+        b2 = m.allocate(1)
+        m.register_prefix(t2, b2)
+        m.free(b2)
+        out = m.pop_evictable(1)          # oldest (t1) first
+        assert out == [(b1[0], _chain(t1, 4)[0])]
+        assert seen == [_chain(t1, 4)[0]]
+        assert m.pop_evictable(5) == [(b2[0], _chain(t2, 4)[0])]
+        assert m.pop_evictable(1) == []
+        # demoted blocks are genuinely gone from the cache
+        blocks, n = m.match_prefix(t1 + [99])
+        assert n == 0 and not blocks
+        m.assert_no_leaks()
+        assert m.free_list_size() == 8
+
+    def test_probe_prefix_takes_no_refs(self):
+        m = BlockManager(8, 4)
+        toks = list(range(9))
+        b = m.allocate(2)
+        m.register_prefix(toks, b)
+        m.free(b)
+        assert m.probe_prefix(toks) == 2
+        assert m.num_in_use() == 0       # probe must not revive/ref
+        blocks, n = m.match_prefix(toks)
+        assert n == 8
+        m.free(blocks)
+
+    def test_watermark_clamp_unchanged(self):
+        # the clamp the hook must not disturb: a full-pool watermark
+        # still leaves one admissible block
+        m = BlockManager(4, 4, watermark=1.0)
+        assert m.watermark_blocks == 3
+        assert m.can_allocate(1)
+
+
+# ------------------------------------------------------------- host tier
+def _spill(nb=1, seed=0, layers=2):
+    rng = np.random.RandomState(seed)
+    return tuple({"q8": rng.randint(-127, 128, (2, nb, 4, 8))
+                  .astype(np.int8),
+                  "s": rng.rand(2, nb, 4).astype(np.float32)}
+                 for _ in range(layers))
+
+
+class TestHostTier:
+    def test_roundtrip_bit_exact(self):
+        tier = HostTier(capacity_mb=1)
+        k, v = _spill(seed=1), _spill(seed=2)
+        assert tier.put(7, k, v, tokens=4) == []
+        ent = tier.get(7)
+        assert ent is not None and ent.tokens == 4
+        for a, b in zip(ent.k_spill, k):
+            np.testing.assert_array_equal(a["q8"], b["q8"])
+            np.testing.assert_array_equal(a["s"], b["s"])
+
+    def test_lru_eviction_under_capacity(self):
+        one = _spill()
+        per = codec.pages_nbytes(one) * 2
+        tier = HostTier(capacity_mb=3.5 * per / (1024 * 1024))
+        for h in (1, 2, 3):
+            assert tier.put(h, _spill(seed=h), _spill(seed=h)) == []
+        assert tier.put(4, _spill(seed=4), _spill(seed=4)) == [1]
+        assert 1 not in tier and 4 in tier
+        tier.get(2)                      # refresh 2 -> 3 becomes LRU
+        assert tier.put(5, _spill(seed=5), _spill(seed=5)) == [3]
+        assert 2 in tier
+
+    def test_oversize_entry_refused(self):
+        tier = HostTier(capacity_mb=0.0001)
+        k, v = _spill(), _spill()
+        assert tier.put(9, k, v) == [9]
+        assert 9 not in tier
+
+    def test_crc_failure_drops_entry(self):
+        tier = HostTier(capacity_mb=1)
+        k, v = _spill(seed=5), _spill(seed=6)
+        tier.put(3, k, v)
+        k[0]["q8"][0, 0, 0, 0] ^= 1      # corrupt stored bytes in place
+        assert tier.get(3) is None
+        assert tier.crc_failures == 1
+        assert 3 not in tier and len(tier) == 0
+
+
+# ------------------------------------------------------------ prefix index
+class _FakeEngine:
+    def set_kv_hooks(self, on_register=None, on_evict=None):
+        self.hooks = (on_register, on_evict)
+
+
+class _FakeRep:
+    def __init__(self, name):
+        self.name = name
+        self.alive = True
+        self.engine = _FakeEngine()
+
+
+class TestGlobalPrefixIndex:
+    def test_deepest_valid_wins_and_replica_beats_host(self):
+        ix = GlobalPrefixIndex()
+        chain = _chain(list(range(16)), 4)
+        ix.register(chain[0], "r0", gen=1)
+        ix.register_host(chain[0])
+        ix.register_host(chain[2])
+        hit = ix.lookup(chain, lambda h, o, e: True)
+        assert hit == (3, HOST_OWNER, "host")
+        hit = ix.lookup(chain[:1], lambda h, o, e: True)
+        assert hit == (1, "r0", "replica")     # device beats host
+
+    def test_invalid_owners_skipped(self):
+        ix = GlobalPrefixIndex()
+        chain = _chain(list(range(8)), 4)
+        ix.register(chain[1], "dead", gen=1)
+        ix.register(chain[0], "r1", gen=2)
+        hit = ix.lookup(chain, lambda h, o, e: o != "dead")
+        assert hit == (1, "r1", "replica")
+        assert ix.lookup(chain, lambda h, o, e: False) is None
+
+    def test_unregister_and_purge(self):
+        ix = GlobalPrefixIndex()
+        ix.register(11, "r0", gen=1)
+        ix.register(11, "r1", gen=1)
+        ix.register(22, "r0", gen=1)
+        ix.unregister(11, "r0")
+        assert set(ix.owners(11)) == {"r1"}
+        assert ix.purge_owner("r0") == 1
+        assert ix.owners(22) == {}
+        assert ix.num_entries() == 1
+
+
+class TestIndexConsistencyUnderManualClock:
+    """Satellite: randomized register / evict / lease-expiry
+    interleavings never serve a stale location through the real
+    validator (lease freshness + generation fencing)."""
+
+    def _mk(self):
+        clk = ManualClock()
+        cp = ClusterControlPlane(namespace="t", lease_timeout=1.0,
+                                 clock=clk, store=None)
+        kv = ClusterKVStore(control_plane=cp,
+                            config=KVStoreConfig(tier="off"))
+        return clk, cp, kv
+
+    def test_lease_expiry_invalidates_without_cleanup(self):
+        clk, cp, kv = self._mk()
+        rep = _FakeRep("r0")
+        cp.join("r0")
+        kv.attach(rep)
+        kv._on_register("r0", 77)
+        ok = kv.index.lookup([77], kv._valid)
+        assert ok == (1, "r0", "replica")
+        clk.advance(2.0)                 # lease expires, NO cleanup
+        assert kv.index.lookup([77], kv._valid) is None
+        assert kv.index.owners(77)       # the stale doc still exists
+
+    def test_rejoin_generation_fences_old_entries(self):
+        clk, cp, kv = self._mk()
+        rep = _FakeRep("r0")
+        cp.join("r0")
+        kv.attach(rep)
+        kv._on_register("r0", 88)
+        clk.advance(2.0)
+        cp.evict("r0", "missed_beat")
+        # rejoin: new incarnation, generation bumped past the old one
+        cp.join("r0")
+        kv.attach(rep)
+        cp.beat("r0")
+        # the OLD registration carries the previous generation: the
+        # lease is fresh again but the entry must stay dead
+        assert kv.index.lookup([88], kv._valid) is None
+        kv._on_register("r0", 88)        # re-register under new gen
+        assert kv.index.lookup([88], kv._valid) == \
+            (1, "r0", "replica")
+
+    @pytest.mark.parametrize("seed", range(5))
+    def test_randomized_interleavings_never_serve_stale(self, seed):
+        rng = random.Random(seed)
+        clk, cp, kv = self._mk()
+        reps = {}
+        # model state: what a correct index may serve. An owner is
+        # servable iff attached+alive AND lease fresh AND the entry
+        # was registered under its CURRENT generation.
+        reg_gen = {}                     # (hash, owner) -> gen at reg
+        for step in range(120):
+            op = rng.randrange(6)
+            name = "r%d" % rng.randrange(3)
+            if op == 0 and name not in reps:
+                rep = _FakeRep(name)
+                cp.join(name)
+                kv.attach(rep)
+                reps[name] = rep
+            elif op == 1 and name in reps:
+                h = rng.randrange(8)
+                kv._on_register(name, h)
+                reg_gen[(h, name)] = cp.generation(name)
+            elif op == 2 and name in reps and rng.random() < 0.7:
+                cp.beat(name)
+            elif op == 3:
+                clk.advance(rng.choice([0.2, 0.6, 1.5]))
+            elif op == 4 and name in reps and rng.random() < 0.3:
+                # silent death: object stays attached (a zombie), only
+                # the missed lease can out it
+                cp.evict(name, "missed_beat")
+                reps[name].alive = rng.random() < 0.5
+                if not reps[name].alive:
+                    del reps[name]
+            elif op == 5 and name in reps:
+                h = rng.randrange(8)
+                kv.index.unregister(h, name)
+                reg_gen.pop((h, name), None)
+            # invariant sweep: every lookup answer must be servable
+            for h in range(8):
+                hit = kv.index.lookup([h], kv._valid)
+                if hit is None:
+                    continue
+                _, owner, tier = hit
+                assert tier == "replica"
+                rep = reps.get(owner)
+                assert rep is not None and rep.alive, \
+                    "served dead owner %s at step %d" % (owner, step)
+                assert cp.fresh(owner), \
+                    "served expired lease %s at step %d" % (owner, step)
+                assert reg_gen.get((h, owner)) == \
+                    cp.generation(owner), \
+                    "served stale generation %s at step %d" \
+                    % (owner, step)
+
+
+# -------------------------------------------- engine prefix transfer
+@pytest.fixture(scope="module")
+def model():
+    pt.seed(11)
+    cfg = pt.models.gpt_tiny(dropout=0.0, attention_dropout=0.0)
+    m = pt.models.GPTForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def _drain(eng, cap=300):
+    n = 0
+    while eng.step() and n < cap:
+        n += 1
+    assert n < cap, "engine failed to drain"
+
+
+class TestEnginePrefixTransfer:
+    KNOBS = dict(max_slots=2, block_size=8, num_blocks=24,
+                 prefill_chunk=8, kv_quant="int8")
+
+    def _serve(self, eng, prompt, max_new=4):
+        rid = eng.submit(list(prompt), max_new_tokens=max_new)
+        _drain(eng)
+        return eng.result(rid)
+
+    def test_export_import_token_exact(self, model):
+        rng = np.random.RandomState(0)
+        shared = rng.randint(0, 200, 17).tolist()
+        src = pt.serving.ServingEngine(model, **self.KNOBS)
+        dst = pt.serving.ServingEngine(model, **self.KNOBS)
+        ref = self._serve(src, shared + [5, 6, 7])
+        out = src.export_prefix(shared + [5, 6, 7])
+        assert out is not None
+        k, v, n = out
+        assert n == 2
+        assert dst.import_prefix(shared + [5, 6, 7], n, k, v) == 16
+        assert dst.probe_prefix(shared + [5, 6, 7]) == 2
+        got = self._serve(dst, shared + [5, 6, 7])
+        assert got == ref, "imported prefix changed the stream"
+        src.shutdown(), dst.shutdown()
+
+    def test_import_respects_existing_depth_and_capacity(self, model):
+        eng = pt.serving.ServingEngine(model, **self.KNOBS)
+        rng = np.random.RandomState(1)
+        prompt = rng.randint(0, 200, 20).tolist()
+        self._serve(eng, prompt)
+        out = eng.export_prefix(prompt)
+        k, v, n = out
+        # already resident at the same depth: no-op
+        assert eng.import_prefix(prompt, n, k, v) == 0
+        eng.shutdown()
+
+    def test_demote_roundtrip_bit_exact_through_host_tier(self, model):
+        eng = pt.serving.ServingEngine(model, **self.KNOBS)
+        rng = np.random.RandomState(2)
+        prompt = rng.randint(0, 200, 17).tolist()
+        ref = self._serve(eng, prompt + [9])
+        spilled = {}
+
+        def on_evict(h, k, v):
+            spilled[h] = (codec.to_spill(k), codec.to_spill(v))
+
+        eng.set_kv_hooks(on_evict=on_evict)
+        with eng._lock:
+            pairs = eng.manager.pop_evictable(50)
+        assert len(pairs) == 2 and len(spilled) == 2
+        assert eng.probe_prefix(prompt + [9]) == 0
+        # restore: int8 pools -> the spill IS the pool layout, so the
+        # round trip is bit-exact and the stream identical
+        chain = _chain(prompt[:16], 8)
+        k = tuple({"q8": np.concatenate(
+                       [spilled[h][0][i]["q8"] for h in chain], axis=1),
+                   "s": np.concatenate(
+                       [spilled[h][0][i]["s"] for h in chain], axis=1)}
+                  for i in range(len(spilled[chain[0]][0])))
+        v = tuple({"q8": np.concatenate(
+                       [spilled[h][1][i]["q8"] for h in chain], axis=1),
+                   "s": np.concatenate(
+                       [spilled[h][1][i]["s"] for h in chain], axis=1)}
+                  for i in range(len(spilled[chain[0]][1])))
+        assert eng.import_prefix(prompt + [9], 2, k, v) == 16
+        got = self._serve(eng, prompt + [9])
+        assert got == ref, "host-tier restore changed the stream"
+        eng.shutdown()

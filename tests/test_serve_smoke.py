@@ -28,3 +28,10 @@ def test_serve_smoke_autoscale_passes():
     # cold compiles), seeded mid-flight hang -> missed-lease eviction
     # -> token-exact replay, idle scale-in back to one replica
     assert serve_smoke.main_autoscale() == 0
+
+
+def test_serve_smoke_kvtier_passes():
+    # cluster-wide KV cache arm: cross-replica prefix fetch through
+    # the global index, forced demotion sweep, host-tier restore —
+    # every stream token-exact vs a tier-off recompute engine
+    assert serve_smoke.main_kvtier() == 0

@@ -360,6 +360,49 @@ def _show_control_plane(d: str):
               f"transitions={len(er.get('transitions') or [])}")
 
 
+def _show_kv(d: str):
+    """Cluster KV tier health from the metrics snapshot: index hit
+    rate, promote/demote traffic, host-RAM occupancy, CRC failures."""
+    snap = _load_json(os.path.join(d, "metrics.json"))
+    if not snap:
+        return
+    counters = snap.get("counters") or {}
+    gauges = snap.get("gauges") or {}
+
+    def _c(name):
+        # tagged counters serialize as "name{tag=v}": fold them up
+        return int(sum(v for k, v in counters.items()
+                       if k.split("{", 1)[0] == name))
+
+    hits, misses = _c("kv.index_hits"), _c("kv.index_misses")
+    if not any((hits, misses, _c("kv.promotes"), _c("kv.demotes"))):
+        return
+    _section("cluster kv tier")
+    looked = hits + misses
+    rate = hits / looked if looked else 0.0
+    print(f"  index lookups={looked} hits={hits} (rate={rate:.2f}) "
+          f"entries={int(gauges.get('kv.index_entries', 0))}")
+    by_src = {}
+    for k, v in counters.items():
+        base, _, rest = k.partition("{")
+        if base == "kv.fetches":
+            src = rest.rstrip("}").partition("=")[2] or "?"
+            by_src[src] = by_src.get(src, 0) + int(v)
+    srcs = ", ".join(f"{s}={n}" for s, n in sorted(by_src.items())) \
+        or "-"
+    print(f"  fetches: {srcs}  tokens={_c('kv.fetch_tokens')} "
+          f"stale_skips={_c('kv.stale_skips')}")
+    print(f"  promote={_c('kv.promotes')} demote={_c('kv.demotes')} "
+          f"host_evictions={_c('kv.host_evictions')} "
+          f"crc_failures={_c('kv.crc_failures')}")
+    blocks = int(gauges.get("kv.host_blocks", 0))
+    by = gauges.get("kv.host_bytes", 0)
+    print(f"  host ram: {blocks} blocks, {by / 1e6:.1f} MB resident")
+    if _c("kv.crc_failures"):
+        print("  !! CRC failures: host-tier pages corrupted in "
+              "transit — those blocks were recomputed, check RAM")
+
+
 def main(argv) -> int:
     if len(argv) != 2 or argv[1] in ("-h", "--help"):
         print(__doc__)
@@ -379,6 +422,7 @@ def main(argv) -> int:
     _show_profiler(bundle)
     _show_compiles(bundle)
     _show_control_plane(bundle)
+    _show_kv(bundle)
     print()
     return 0
 

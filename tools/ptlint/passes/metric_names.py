@@ -34,7 +34,7 @@ _SKIP_DIRS = {".git", "__pycache__", "build", "dist", ".eggs",
 
 # namespaces whose declared names must all be instrumented somewhere
 REQUIRE_USED = ("serving.", "cluster.", "cp.", "elastic.", "ps.",
-                "rt.", "slo.", "prof.")
+                "rt.", "slo.", "prof.", "kv.")
 
 _SCHEMA_RELPATH = "paddle_tpu/observability/metrics_schema.py"
 

@@ -175,6 +175,32 @@ def render(snap: dict, width: int = 78, n_requests: int = 10) -> str:
                ",".join(cp.get("members") or []) or "-",
                ",".join(stale) or "-"))
 
+    kv = snap.get("kv") or {}
+    if kv:
+        counts = kv.get("counts") or {}
+        host = kv.get("host") or {}
+        index = kv.get("index") or {}
+        cap = host.get("capacity_bytes") or 0
+        used = host.get("bytes") or 0
+        occ = (used / cap) if cap else 0.0
+        lines.append(
+            "kv tier: %s  hit=%.2f  index=%d  fetch(rep=%d host=%d) "
+            "promote=%d demote=%d stale=%d crc=%d"
+            % (kv.get("tier", "off"), kv.get("hit_rate", 0.0),
+               index.get("entries", 0),
+               counts.get("fetches_replica", 0),
+               counts.get("fetches_host", 0),
+               counts.get("promotes", 0), counts.get("demotes", 0),
+               counts.get("stale_skips", 0),
+               counts.get("crc_failures", 0)))
+        if host:
+            lines.append(
+                "  host ram: %s %.2f  %d blocks  %.1f/%.1f MB  "
+                "queue=%d evictions=%d"
+                % (_bar(occ), occ, host.get("blocks", 0),
+                   used / 1e6, cap / 1e6, kv.get("demote_queue", 0),
+                   counts.get("host_evictions", 0)))
+
     reps = snap.get("replicas") or {}
     if reps:
         lines.append("-" * width)

@@ -25,11 +25,20 @@ SILENT — only the missed-lease scan can find it), eviction inside the
 lease budget with token-exact replay, and scale-in back to one
 replica on sustained idle.
 
+``--kvtier`` runs the cluster-wide KV cache arm: two ``int8``-KV
+replicas behind a router wired to a :class:`ClusterKVStore`. A shared
+system prompt served on one replica must be fetched **cross-replica**
+through the global prefix index when admission pushes a later request
+onto the other replica; after a forced demotion sweep empties both
+device caches, a third request must restore the prefix from the
+**host-RAM tier** — and every stream stays token-exact against a
+tier-off recompute engine.
+
 Importable (``main()`` returns 0/raises) so tests/test_serve_smoke.py
 runs all arms inside the tier-1 suite; also runnable standalone:
 
     JAX_PLATFORMS=cpu python tools/serve_smoke.py \
-        [--ragged|--cluster|--autoscale]
+        [--ragged|--cluster|--autoscale|--kvtier]
 """
 from __future__ import annotations
 
@@ -279,9 +288,115 @@ def main_autoscale() -> int:
     return 0
 
 
+def main_kvtier() -> int:
+    """Tier-1 cluster-KV arm: cross-replica prefix fetch through the
+    global index, then a host-tier restore after forced demotion, both
+    token-exact vs tier-off recompute. Runs telemetry-OFF on purpose:
+    the ``ClusterKVStore.counts`` dict must tell the story anyway."""
+    import numpy as np
+
+    from paddle_tpu.serving.cluster import ClusterRouter, Replica
+    from paddle_tpu.serving.kv_store import (ClusterKVStore,
+                                             KVStoreConfig)
+
+    pt, model, _, _ = _build()
+    # int8 KV pools: the host spill IS the pool layout, so demote ->
+    # promote round trips are bit-exact and streams stay token-exact
+    knobs = dict(max_slots=2, block_size=8, num_blocks=24,
+                 prefill_chunk=8, kv_quant="int8")
+    rng = np.random.RandomState(7)
+    shared = rng.randint(0, 200, 32).tolist()   # 4 full blocks
+    reqs = [shared + rng.randint(0, 200, n).tolist() for n in (7, 9, 11)]
+    junk = rng.randint(0, 200, 20).tolist()
+
+    # tier-off recompute references (same int8 numerics, no cluster)
+    ref_eng = pt.serving.ServingEngine(model, **knobs)
+    refs = []
+    for p in reqs:
+        rid = ref_eng.submit(list(p), max_new_tokens=6)
+        (out,), _ = _drain(ref_eng, [rid])
+        refs.append(out)
+    ref_eng.shutdown()
+
+    reps = [Replica("r%d" % i, model, **knobs) for i in range(2)]
+    for r in reps:
+        r.warmup()
+    kv = ClusterKVStore(config=KVStoreConfig(tier="host", host_mb=8))
+    router = ClusterRouter(reps, max_queue=1, kv_store=kv)
+
+    def pump(cap=400):
+        steps = 0
+        while router.step():
+            steps += 1
+            assert steps < cap, "router failed to drain"
+        return steps
+
+    # ---- phase 1: request A plants the shared prefix on r0 and the
+    # global index learns the chain
+    c0 = router.submit(reqs[0], max_new_tokens=6)
+    steps = pump()
+    out0 = router.result(c0)
+
+    # ---- phase 2: cross-replica fetch. Saturate r0 (max_queue=1) so
+    # the affinity route FAILS admission and request B lands on r1 —
+    # whose prefetch must then import the prefix pages from r0
+    cj = router.submit(junk, max_new_tokens=6)       # queues on r0
+    c1 = router.submit(reqs[1], max_new_tokens=6)    # sheds to r1
+    steps += pump()
+    out1 = router.result(c1)
+    router.result(cj)                                # drain, discard
+    c = kv.counts
+    assert c["fetches_replica"] >= 1, \
+        "no cross-replica prefix fetch happened: %r" % (c,)
+    assert c["fetch_tokens"] >= len(shared), \
+        "cross-replica fetch moved %d tokens, wanted >= %d" \
+        % (c["fetch_tokens"], len(shared))
+
+    # ---- phase 3: forced demotion sweep — every evictable block on
+    # both replicas spills through the pump into the host tier; the
+    # device caches must come back EMPTY
+    for r in reps:
+        with r.engine._lock:
+            r.engine.manager.pop_evictable(knobs["num_blocks"])
+    while kv.pump() > 0:
+        pass
+    for r in reps:
+        assert r.engine.probe_prefix(reqs[2]) == 0, \
+            "%s still holds the prefix after demotion" % r.name
+    assert kv.counts["demotes"] > 0, "demotion pump spilled nothing"
+    assert len(kv.host) > 0, "host tier is empty after the sweep"
+
+    # ---- phase 4: host-tier restore — request C's prefetch promotes
+    # the shared prefix back to a device from host RAM
+    c2 = router.submit(reqs[2], max_new_tokens=6)
+    steps += pump()
+    out2 = router.result(c2)
+    c = kv.counts
+    assert c["fetches_host"] >= 1 and c["promotes"] >= 1, \
+        "no host-tier promote happened: %r" % (c,)
+    assert c["crc_failures"] == 0, "CRC failures during the smoke"
+
+    assert [out0, out1, out2] == refs, \
+        "tiered streams != tier-off recompute: %r vs %r" \
+        % ([out0, out1, out2], refs)
+    for r in reps:
+        assert r.engine.ragged_compiles == 1, \
+            "replica %s compiled ragged %d times" \
+            % (r.name, r.engine.ragged_compiles)
+    router.shutdown()                    # raises on any block leak
+    print("serve_smoke --kvtier: %d requests, %d steps, %d tokens "
+          "fetched (replica=%d host=%d), %d blocks demoted to host, "
+          "token-exact vs recompute, 1 ragged compile/replica"
+          % (len(reqs), steps, c["fetch_tokens"],
+             c["fetches_replica"], c["fetches_host"], c["demotes"]))
+    return 0
+
+
 if __name__ == "__main__":
     sys.path.insert(0, os.path.join(os.path.dirname(
         os.path.abspath(__file__)), os.pardir))
+    if "--kvtier" in sys.argv:
+        sys.exit(main_kvtier())
     if "--autoscale" in sys.argv:
         sys.exit(main_autoscale())
     if "--cluster" in sys.argv:
