@@ -32,6 +32,7 @@ import sys
 
 import numpy as np
 
+from paddle_tpu.config import knobs as _knobs
 from paddle_tpu.observability import stopwatch as _stopwatch
 
 
@@ -483,7 +484,7 @@ def _bench_serving():
     preempts = eng.scheduler.preemptions
     attribution = _round_attribution(eng.request_log.attribution())
     slo = _slo_verdict(eng.slo.evaluate())
-    snap_path = os.environ.get("PADDLE_TPU_OPS_SNAPSHOT")
+    snap_path = _knobs.get_str("PADDLE_TPU_OPS_SNAPSHOT")
     if snap_path:
         eng.dump_ops_snapshot(snap_path)
     eng.shutdown()
@@ -546,7 +547,7 @@ def _bench_cluster():
     on_tpu = jax.devices()[0].platform == "tpu"
     host_cores = len(os.sched_getaffinity(0)) \
         if hasattr(os, "sched_getaffinity") else (os.cpu_count() or 1)
-    n_rep = int(os.environ.get("PADDLE_TPU_CLUSTER_REPLICAS", "2"))
+    n_rep = _knobs.get_int("PADDLE_TPU_CLUSTER_REPLICAS")
     if on_tpu:
         cfg = pt.models.gpt3_125M(dropout=0.0, attention_dropout=0.0)
         n_req, max_new = 48, 64
@@ -670,7 +671,7 @@ def _bench_cluster():
     snap = router.ops_snapshot()
     attribution = _round_attribution(snap["attribution"])
     slo = _slo_verdict(snap["slo"])
-    snap_path = os.environ.get("PADDLE_TPU_OPS_SNAPSHOT")
+    snap_path = _knobs.get_str("PADDLE_TPU_OPS_SNAPSHOT")
     if snap_path:
         from paddle_tpu.observability.request_log import write_snapshot
         write_snapshot(snap, snap_path)
@@ -1702,7 +1703,7 @@ def main():
 
     dev = jax.devices()[0]
     on_tpu = dev.platform == "tpu"
-    small = os.environ.get("PADDLE_TPU_BENCH", "").lower() == "125m"
+    small = (_knobs.get_str("PADDLE_TPU_BENCH") or "").lower() == "125m"
 
     if not on_tpu:
         # off-TPU smoke (no MFU meaning): tiny config, just prove the path
@@ -1851,7 +1852,7 @@ def _maybe_perfdiff(result: dict) -> int:
                   file=sys.stderr)
             return 2
         base = sys.argv[i + 1]
-    base = base or os.environ.get("PADDLE_TPU_PERFDIFF_BASE")
+    base = base or _knobs.get_str("PADDLE_TPU_PERFDIFF_BASE")
     if not base:
         return 0
     import importlib.util

@@ -12,6 +12,7 @@ from typing import List
 
 import numpy as np
 
+from ...config import knobs
 from ...io import Dataset
 
 __all__ = ["TESS", "ESC50"]
@@ -29,13 +30,12 @@ class _AudioClassDataset(Dataset):
         self._files: List[str] = []
         self._labels: List[int] = []
         root = archive or os.path.join(
-            os.environ.get("PADDLE_TPU_DATA_HOME",
-                           os.path.expanduser("~/.cache/paddle_tpu")),
+            os.path.expanduser(knobs.get_str("PADDLE_TPU_DATA_HOME")),
             self.__class__.__name__.lower())
         if os.path.isdir(root):
             self._scan(root)
         self._synth = len(self._files) == 0
-        self._n = int(os.environ.get("PADDLE_TPU_SYNTH_SAMPLES", 32)) \
+        self._n = knobs.get_int("PADDLE_TPU_SYNTH_SAMPLES") \
             if self._synth else len(self._files)
 
     def _scan(self, root):

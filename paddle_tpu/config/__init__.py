@@ -1,0 +1,9 @@
+"""Typed configuration layer: the ``PADDLE_TPU_*`` env-knob registry.
+
+``knobs`` is stdlib-only and import-cycle-free — every subsystem
+(including observability modules that read knobs at import time) may
+``from ..config import knobs`` safely.
+"""
+from . import knobs
+
+__all__ = ["knobs"]

@@ -38,6 +38,8 @@ from typing import Callable, Optional, Sequence
 
 import numpy as np
 
+from ...config import knobs
+
 __all__ = ["Strategy", "Engine", "DistModel"]
 
 
@@ -516,7 +518,7 @@ class Engine:
 
             ectx = elastic if isinstance(elastic, ElasticContext) \
                 else ElasticContext.from_env()
-        elif os.environ.get("PADDLE_TPU_ELASTIC") == "1" and \
+        elif knobs.get_bool("PADDLE_TPU_ELASTIC") and \
                 int(os.environ.get("PADDLE_TRAINERS_NUM", "1")) > 1:
             from ..elastic import ElasticContext
 

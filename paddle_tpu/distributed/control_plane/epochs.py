@@ -35,6 +35,7 @@ from collections import deque
 from typing import Callable, List, Optional
 
 from ..resilience import faults as _faults
+from . import keyspace as _ks
 from .store_util import try_get
 
 __all__ = ["EpochChanged", "EpochRegistry"]
@@ -80,9 +81,6 @@ class EpochRegistry:
         self._transitions: deque = deque(maxlen=32)  # guarded by: _lock
         _live.add(self)
 
-    def _k(self, *parts) -> str:
-        return "/".join([self.ns] + [str(p) for p in parts])
-
     def _note(self, kind: str, n: int, **fields) -> None:
         with self._lock:
             self._transitions.append(
@@ -95,36 +93,36 @@ class EpochRegistry:
         Monotone by construction: the number comes from a store ADD.
         ``members`` is stored as given — callers normalize (the elastic
         tier sorts int ranks; the cluster sorts replica names)."""
-        n = self.store.add(self._k("seq"), 1)
+        n = self.store.add(_ks.epoch_seq(self.ns), 1)
         rec = {"epoch": n, "members": list(members), "reason": reason,
                "proposer": proposer, "prev": prev}
-        self.store.set(self._k("epoch", n), json.dumps(rec).encode())
-        self.store.set(self._k("propose"), str(n).encode())
+        self.store.set(_ks.epoch(self.ns, n), json.dumps(rec).encode())
+        self.store.set(_ks.propose(self.ns), str(n).encode())
         self._note("propose", n, members=list(members), reason=reason)
         return n
 
     def pending(self) -> int:
         """Highest advertised proposal number (0 when none)."""
         try:
-            raw = try_get(self.store, self._k("propose"))
+            raw = try_get(self.store, _ks.propose(self.ns))
             return int(raw.decode()) if raw is not None else 0
         except Exception:
             return 0
 
     def read(self, n: int) -> Optional[dict]:
         try:
-            raw = try_get(self.store, self._k("epoch", n))
+            raw = try_get(self.store, _ks.epoch(self.ns, n))
             return None if raw is None else json.loads(raw.decode())
         except Exception:
             return None
 
     # -------------------------------------------------------------- ack
     def ack(self, n: int, member) -> None:
-        self.store.set(self._k("epoch", n, "ack", member), b"1")
+        self.store.set(_ks.epoch_ack(self.ns, n, member), b"1")
 
     def acked(self, n: int, member) -> bool:
         try:
-            return self.store.check(self._k("epoch", n, "ack", member))
+            return self.store.check(_ks.epoch_ack(self.ns, n, member))
         except Exception:
             return False
 
@@ -136,8 +134,8 @@ class EpochRegistry:
         act = _faults.check("cp.epoch")
         if act is not None:
             _faults.apply(act)
-        self.store.set(self._k("epoch", n, "commit"), b"1")
-        self.store.set(self._k("cur"), str(n).encode())
+        self.store.set(_ks.epoch_commit(self.ns, n), b"1")
+        self.store.set(_ks.epoch_cur(self.ns), str(n).encode())
         rec = self.read(n) or {}
         self._note("commit", n, members=rec.get("members"),
                    reason=rec.get("reason"))
@@ -150,14 +148,14 @@ class EpochRegistry:
 
     def committed(self, n: int) -> bool:
         try:
-            return self.store.check(self._k("epoch", n, "commit"))
+            return self.store.check(_ks.epoch_commit(self.ns, n))
         except Exception:
             return False
 
     def current(self) -> Optional[dict]:
         """The last committed epoch record published at ``cur``."""
         try:
-            raw = try_get(self.store, self._k("cur"))
+            raw = try_get(self.store, _ks.epoch_cur(self.ns))
             return None if raw is None else self.read(int(raw.decode()))
         except Exception:
             return None

@@ -1,0 +1,250 @@
+"""The declared keyspace of the control-plane store.
+
+Every key written to or read from the control-plane store (leases,
+epochs, elastic membership, PS failover/replication, the cluster KV
+index) MUST be built by one of the helpers below — ptlint's
+``store-keys`` pass rejects inline f-strings/concats at store call
+sites in the protocol tiers, and :func:`check_collisions` proves no
+two namespaces can ever produce the same key string.
+
+stdlib-only and import-cycle-free: loaded standalone by ptlint via
+``importlib.util.spec_from_file_location``.
+
+Scope note: rendezvous/bootstrap keys (``distributed/rpc.py``,
+``process_group.py``, ``launch/``, ``fleet/``) are deliberately NOT in
+this registry — they live on the per-job init store, are written once
+before any failover machinery starts, and are never subject to the
+lease/epoch delete races this keyspace exists to police.
+
+Each namespace declares two protocol flags the ``fence-discipline``
+pass enforces:
+
+* ``deletable`` — keys in this namespace may be absent or concurrently
+  deleted; reads must go through ``try_get`` (never raw ``store.get``,
+  the PR 13 check-then-get race class).
+* ``fenced`` — written payloads must carry the writer's lease
+  generation (obtained from ``LeaseTable.grant``/``generation()``) so
+  stale owners are rejected by readers, not trusted.
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, NamedTuple, Tuple
+
+__all__ = [
+    "KeyNamespace", "NAMESPACES", "HELPERS", "check_collisions",
+    "beat", "lease_gen", "left",
+    "epoch_seq", "epoch", "propose", "epoch_ack", "epoch_commit",
+    "epoch_cur",
+    "node", "member_flag", "xchg", "snap",
+    "ps_primary", "ps_gen", "ps_repl", "ps_replack",
+    "kvidx",
+]
+
+# placeholder marker inside a pattern; literals are plain strings.
+# "<ns>" is the caller's namespace prefix (e.g. "cluster", "elastic");
+# every other placeholder is a single key segment (no "/").
+_P = "<ns>"
+
+
+class KeyNamespace(NamedTuple):
+    name: str               # registry id == the helper function name
+    pattern: Tuple[str, ...]  # key segments; "<...>" = placeholder
+    deletable: bool         # reads must use try_get
+    fenced: bool            # written payloads must carry a lease gen
+    doc: str
+
+
+_N = KeyNamespace
+
+NAMESPACES: Tuple[KeyNamespace, ...] = (
+    # ---------------------------------------------------------- lease
+    _N("beat", (_P, "beat", "<member>"), True, True,
+       "Heartbeat lease doc {t, gen}; expiry = death, so deletable; "
+       "gen-fenced so a stale owner's beat is rejected."),
+    _N("lease_gen", (_P, "lease_gen", "<member>"), False, False,
+       "Monotone lease generation counter (store ADD only)."),
+    _N("left", (_P, "left", "<member>"), True, False,
+       "Clean-leave marker; deleted on re-grant."),
+    # --------------------------------------------------------- epochs
+    _N("epoch_seq", (_P, "seq"), False, False,
+       "Monotone epoch number source (store ADD only)."),
+    _N("epoch", (_P, "epoch", "<n>"), True, False,
+       "Immutable epoch record; absent until proposed."),
+    _N("propose", (_P, "propose"), True, False,
+       "Latest proposed epoch number; absent before first proposal."),
+    _N("epoch_ack", (_P, "epoch", "<n>", "ack", "<member>"), False,
+       False, "Per-member epoch ack flag (check/set only)."),
+    _N("epoch_commit", (_P, "epoch", "<n>", "commit"), False, False,
+       "Epoch commit flag (check/set only)."),
+    _N("epoch_cur", (_P, "cur"), True, False,
+       "Latest committed epoch number; absent before first commit."),
+    # ---------------------------------------------- elastic membership
+    _N("node", (_P, "nodes", "<rank>"), True, False,
+       "Elastic member registration doc; deleted on leave."),
+    _N("member_flag", (_P, "<kind>", "<rank>"), True, False,
+       "Member condition flags: suspect|hang|join|demote; set and "
+       "deleted by the watch loops."),
+    _N("xchg", (_P, "x", "<epoch>", "<tag>", "<step>", "<rank>"),
+       True, False,
+       "Epoch-scoped payload exchange slots (peer snapshots, CRCs)."),
+    _N("snap", (_P, "snap", "<src>", "<dst>"), True, False,
+       "Ring-neighbor peer snapshot blobs."),
+    # ------------------------------------------------------------- ps
+    _N("ps_primary", ("ps", "primary", "<shard>"), True, False,
+       "Current primary server index of one PS shard."),
+    _N("ps_gen", ("ps", "gen"), False, False,
+       "PS primary-map generation counter (store ADD only)."),
+    _N("ps_repl", ("ps", "repl", "<shard>", "<n>"), True, False,
+       "Ordered replication log record n of one shard."),
+    _N("ps_replack", ("ps", "replack", "<shard>"), True, False,
+       "Backup ack high-water mark of one shard."),
+    # ------------------------------------------------------- kv index
+    _N("kvidx", (_P, "kvidx", "<hash>"), True, True,
+       "Cluster KV prefix-index doc per chain hash; entries carry the "
+       "registering replica's lease gen; deleted when empty."),
+)
+
+_BY_NAME: Dict[str, KeyNamespace] = {n.name: n for n in NAMESPACES}
+assert len(_BY_NAME) == len(NAMESPACES), "duplicate namespace"
+
+# the helper names the store-keys pass accepts at store call sites
+HELPERS = frozenset(_BY_NAME)
+
+# member_flag's <kind> placeholder is constrained — an open kind would
+# collide with sibling namespaces (beat, nodes, ...)
+FLAG_KINDS = ("suspect", "hang", "join", "demote")
+
+
+def _seg(v) -> str:
+    s = str(v)
+    if "/" in s or not s:
+        raise ValueError("bad key segment %r (empty or contains '/')"
+                         % (s,))
+    return s
+
+
+def _join(ns: str, *parts) -> str:
+    return "/".join([_seg(ns)] + [_seg(p) for p in parts])
+
+
+# ------------------------------------------------------------- lease
+def beat(ns: str, member) -> str:
+    return _join(ns, "beat", member)
+
+
+def lease_gen(ns: str, member) -> str:
+    return _join(ns, "lease_gen", member)
+
+
+def left(ns: str, member) -> str:
+    return _join(ns, "left", member)
+
+
+# ------------------------------------------------------------ epochs
+def epoch_seq(ns: str) -> str:
+    return _join(ns, "seq")
+
+
+def epoch(ns: str, n) -> str:
+    return _join(ns, "epoch", int(n))
+
+
+def propose(ns: str) -> str:
+    return _join(ns, "propose")
+
+
+def epoch_ack(ns: str, n, member) -> str:
+    return _join(ns, "epoch", int(n), "ack", member)
+
+
+def epoch_commit(ns: str, n) -> str:
+    return _join(ns, "epoch", int(n), "commit")
+
+
+def epoch_cur(ns: str) -> str:
+    return _join(ns, "cur")
+
+
+# ------------------------------------------------ elastic membership
+def node(ns: str, rank) -> str:
+    return _join(ns, "nodes", rank)
+
+
+def member_flag(ns: str, kind: str, rank) -> str:
+    if kind not in FLAG_KINDS:
+        raise ValueError("unknown member flag kind %r (want one of %r)"
+                         % (kind, FLAG_KINDS))
+    return _join(ns, kind, rank)
+
+
+def xchg(ns: str, epoch_n, tag, step, rank) -> str:
+    return _join(ns, "x", epoch_n, tag, step, rank)
+
+
+def snap(ns: str, src, dst) -> str:
+    return _join(ns, "snap", src, dst)
+
+
+# ---------------------------------------------------------------- ps
+def ps_primary(shard) -> str:
+    return _join("ps", "primary", shard)
+
+
+def ps_gen() -> str:
+    return _join("ps", "gen")
+
+
+def ps_repl(shard, n) -> str:
+    return _join("ps", "repl", shard, n)
+
+
+def ps_replack(shard) -> str:
+    return _join("ps", "replack", shard)
+
+
+# ---------------------------------------------------------- kv index
+def kvidx(ns: str, h) -> str:
+    return _join(ns, "kvidx", int(h))
+
+
+# ------------------------------------------------ collision analysis
+def _expand(n: KeyNamespace) -> Iterable[Tuple[str, ...]]:
+    """Concrete pattern variants: member_flag's <kind> is a closed
+    enum, so expand it — collision math then treats every remaining
+    placeholder as matching any single segment."""
+    if n.name != "member_flag":
+        yield n.pattern
+        return
+    for kind in FLAG_KINDS:
+        yield tuple(kind if s == "<kind>" else s for s in n.pattern)
+
+
+def _may_collide(a: Tuple[str, ...], b: Tuple[str, ...]) -> bool:
+    if len(a) != len(b):
+        return False            # segments never contain "/" (_seg)
+    for sa, sb in zip(a, b):
+        wild_a = sa.startswith("<")
+        wild_b = sb.startswith("<")
+        if not wild_a and not wild_b and sa != sb:
+            return False
+    return True
+
+
+def check_collisions() -> List[str]:
+    """Pairs of namespaces that could produce the same key string.
+    Empty list == the keyspace is collision-free (asserted by ptlint
+    and the unit tests)."""
+    problems: List[str] = []
+    names = sorted(_BY_NAME)
+    for i, na in enumerate(names):
+        for nb in names[i + 1:]:
+            for pa in _expand(_BY_NAME[na]):
+                for pb in _expand(_BY_NAME[nb]):
+                    if _may_collide(pa, pb):
+                        problems.append(
+                            "%s (%s) may collide with %s (%s)"
+                            % (na, "/".join(pa), nb, "/".join(pb)))
+    return problems
+
+
+assert not check_collisions(), check_collisions()

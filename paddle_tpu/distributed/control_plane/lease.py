@@ -35,6 +35,7 @@ import weakref
 from typing import Callable, Dict, Iterable, List, Optional
 
 from ..resilience import faults as _faults
+from . import keyspace as _ks
 from .store_util import try_get
 
 __all__ = ["write_beat", "read_beat", "scan_beats", "lease_fresh",
@@ -60,7 +61,11 @@ def write_beat(store, ns: str, member, payload: dict) -> bool:
         if act.kind == "drop":
             return False
         _faults.apply(act)
-    store.set(f"{ns}/beat/{member}", json.dumps(payload).encode())
+    # blessed low-level writer: the payload is assembled (and gen-
+    # fenced) one hop up in LeaseTable.beat; this function is the one
+    # wire-format point for unfenced module-level callers too
+    store.set(_ks.beat(ns, member),  # ptlint: disable=fence-discipline
+              json.dumps(payload).encode())
     o = _obs()
     if o:
         o.registry.counter("cp.beats").inc()
@@ -70,7 +75,7 @@ def write_beat(store, ns: str, member, payload: dict) -> bool:
 def read_beat(store, ns: str, member) -> Optional[dict]:
     """Decode one member's lease, or None (never set / undecodable)."""
     try:
-        raw = try_get(store, f"{ns}/beat/{member}")
+        raw = try_get(store, _ks.beat(ns, member))
         if raw is None:
             return None
         return json.loads(raw.decode())
@@ -117,9 +122,6 @@ class LeaseTable:
         self._seen: List = []       # grant order, guarded by: _lock
         _live.add(self)
 
-    def _k(self, *parts) -> str:
-        return "/".join([self.ns] + [str(p) for p in parts])
-
     def _note(self, member) -> None:
         with self._lock:
             if member not in self._seen:
@@ -132,9 +134,9 @@ class LeaseTable:
         generation the member must present on every subsequent fenced
         beat — an older holder of the same name is now a zombie whose
         writes get rejected."""
-        gen = self.store.add(self._k("lease_gen", member), 1)
+        gen = self.store.add(_ks.lease_gen(self.ns, member), 1)
         try:
-            self.store.delete(self._k("left", member))
+            self.store.delete(_ks.left(self.ns, member))
         except Exception:
             pass
         self._note(member)
@@ -142,7 +144,7 @@ class LeaseTable:
         return gen
 
     def generation(self, member) -> int:
-        return self.store.add(self._k("lease_gen", member), 0)
+        return self.store.add(_ks.lease_gen(self.ns, member), 0)
 
     # ------------------------------------------------------------- beat
     def beat(self, member, gen: Optional[int] = None, **fields) -> bool:
@@ -192,18 +194,18 @@ class LeaseTable:
         scan between the two writes still sees a clean leave), then
         drop the beat."""
         try:
-            self.store.set(self._k("left", member),
+            self.store.set(_ks.left(self.ns, member),
                            json.dumps({"t": self.clock()}).encode())
         except Exception:
             pass
         try:
-            self.store.delete(self._k("beat", member))
+            self.store.delete(_ks.beat(self.ns, member))
         except Exception:
             pass
 
     def left(self, member) -> bool:
         try:
-            return self.store.check(self._k("left", member))
+            return self.store.check(_ks.left(self.ns, member))
         except Exception:
             return False
 
@@ -211,7 +213,8 @@ class LeaseTable:
         """Drop every key of a member whose departure has been fully
         processed (evicted or cleanly left) so the namespace does not
         accumulate tombstones."""
-        for key in (self._k("beat", member), self._k("left", member)):
+        for key in (_ks.beat(self.ns, member),
+                    _ks.left(self.ns, member)):
             try:
                 self.store.delete(key)
             except Exception:

@@ -27,6 +27,7 @@ from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ..control_plane import keyspace as _ks
 from ..resilience import faults as _faults
 from .membership import ElasticConfig, EpochChanged, \
     MembershipCoordinator, try_get
@@ -103,7 +104,7 @@ class ElasticDataParallel:
 
     # ---------------------------------------------------------- keys
     def _xkey(self, epoch: int, tag: str, step: int, rank: int) -> str:
-        return f"{self.ns}/x/{epoch}/{tag}/{step}/{rank}"
+        return _ks.xchg(self.ns, epoch, tag, step, rank)
 
     # ------------------------------------------------------ bootstrap
     def _sizes(self) -> List[int]:

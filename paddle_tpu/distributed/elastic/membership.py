@@ -49,9 +49,11 @@ import threading
 import time
 from typing import Callable, List, Optional
 
+from ..control_plane import keyspace as _ks
 from ..control_plane.epochs import EpochChanged, EpochRegistry
 from ..control_plane.lease import read_beat, scan_beats, write_beat
 from ..control_plane.store_util import try_get
+from ...config import knobs
 from ..resilience import faults as _faults
 from .straggler import StragglerDetector
 
@@ -68,31 +70,30 @@ class ElasticConfig:
                  straggler_factor: Optional[float] = None,
                  straggler_policy: Optional[str] = None,
                  max_nodes: Optional[int] = None):
-        env = os.environ.get
-        self.beat_interval = float(
-            beat_interval if beat_interval is not None
-            else env("PADDLE_TPU_ELASTIC_BEAT", "0.5"))
+        self.beat_interval = (
+            float(beat_interval) if beat_interval is not None
+            else knobs.get_float("PADDLE_TPU_ELASTIC_BEAT"))
         # the whole failure->recovery budget. Derived deadlines nest
         # inside it: leases expire at 0.5x (so the coordinator can
         # already propose by the time a collective gives up at 0.75x),
         # join-barrier waits get the full budget.
-        self.timeout = float(
-            timeout if timeout is not None
-            else env("PADDLE_TPU_ELASTIC_TIMEOUT", "10.0"))
-        self.snap_freq = int(
-            snap_freq if snap_freq is not None
-            else env("PADDLE_TPU_ELASTIC_SNAP_FREQ", "10"))
-        self.straggler_factor = float(
-            straggler_factor if straggler_factor is not None
-            else env("PADDLE_TPU_ELASTIC_STRAGGLER_FACTOR", "3.0"))
+        self.timeout = (
+            float(timeout) if timeout is not None
+            else knobs.get_float("PADDLE_TPU_ELASTIC_TIMEOUT"))
+        self.snap_freq = (
+            int(snap_freq) if snap_freq is not None
+            else knobs.get_int("PADDLE_TPU_ELASTIC_SNAP_FREQ"))
+        self.straggler_factor = (
+            float(straggler_factor) if straggler_factor is not None
+            else knobs.get_float("PADDLE_TPU_ELASTIC_STRAGGLER_FACTOR"))
         # "flag" records telemetry only; "demote" drops flagged ranks
         # from the next epoch
         self.straggler_policy = (
             straggler_policy if straggler_policy is not None
-            else env("PADDLE_TPU_ELASTIC_STRAGGLER_POLICY", "flag"))
-        self.max_nodes = int(
-            max_nodes if max_nodes is not None
-            else env("PADDLE_TPU_ELASTIC_MAX_NODES", "16"))
+            else knobs.get_str("PADDLE_TPU_ELASTIC_STRAGGLER_POLICY"))
+        self.max_nodes = (
+            int(max_nodes) if max_nodes is not None
+            else knobs.get_int("PADDLE_TPU_ELASTIC_MAX_NODES"))
 
     @property
     def lease_timeout(self) -> float:
@@ -144,18 +145,14 @@ class MembershipCoordinator:
         self._abort_token: Optional[int] = None
         self._lock = threading.Lock()
 
-    # ------------------------------------------------------------ keys
-    def _k(self, *parts) -> str:
-        return "/".join([self.ns] + [str(p) for p in parts])
-
     # ----------------------------------------------------------- lease
     def register(self, start_threads: bool = True) -> None:
         try:
             # returning after a clean leave: clear the departure marker
-            self.store.delete(self._k("left", self.rank))
+            self.store.delete(_ks.left(self.ns, self.rank))
         except Exception:
             pass
-        self.store.set(self._k("nodes", self.rank),
+        self.store.set(_ks.node(self.ns, self.rank),
                        json.dumps({"pid": os.getpid(),
                                    "t": self.clock()}).encode())
         self.beat()
@@ -173,7 +170,7 @@ class MembershipCoordinator:
         ``left`` instead of waiting out the lease and calling it a
         missed beat."""
         try:
-            self.store.set(self._k("left", self.rank),
+            self.store.set(_ks.left(self.ns, self.rank),
                            json.dumps({"t": self.clock()}).encode())
         except Exception:
             pass
@@ -186,8 +183,8 @@ class MembershipCoordinator:
 
             emergency.unregister_abort(self._abort_token)
             self._abort_token = None
-        for key in (self._k("nodes", self.rank),
-                    self._k("beat", self.rank)):
+        for key in (_ks.node(self.ns, self.rank),
+                    _ks.beat(self.ns, self.rank)):
             try:
                 self.store.delete(key)
             except Exception:
@@ -238,7 +235,7 @@ class MembershipCoordinator:
         out = []
         for r in range(self.cfg.max_nodes):
             try:
-                if self.store.check(self._k("nodes", r)):
+                if self.store.check(_ks.node(self.ns, r)):
                     out.append(r)
             except Exception:
                 pass
@@ -303,7 +300,7 @@ class MembershipCoordinator:
         deadline expired waiting on it). Recorded for the coordinator;
         the lease table stays the ground truth."""
         try:
-            self.store.set(self._k("suspect", rank),
+            self.store.set(_ks.member_flag(self.ns, "suspect", rank),
                            json.dumps({"from": self.rank, "t":
                                        self.clock(), "why": why}).encode())
         except Exception:
@@ -316,7 +313,8 @@ class MembershipCoordinator:
         with self._lock:
             self._hang = reason
         try:
-            self.store.set(self._k("hang", self.rank), reason.encode())
+            self.store.set(_ks.member_flag(self.ns, "hang", self.rank),
+                           reason.encode())
         except Exception:
             pass
         o = _obs()
@@ -337,7 +335,7 @@ class MembershipCoordinator:
         with self._lock:
             self._hang = None
         try:
-            self.store.delete(self._k("hang", self.rank))
+            self.store.delete(_ks.member_flag(self.ns, "hang", self.rank))
         except Exception:
             pass
 
@@ -350,8 +348,10 @@ class MembershipCoordinator:
     def _flagged_keys(self, kind: str, ranks) -> List[int]:
         out = []
         for r in ranks:
+            key = _ks.left(self.ns, r) if kind == "left" \
+                else _ks.member_flag(self.ns, kind, r)
             try:
-                if self.store.check(self._k(kind, r)):
+                if self.store.check(key):
                     out.append(r)
             except Exception:
                 pass
@@ -456,12 +456,12 @@ class MembershipCoordinator:
         n = self.propose(new_members, "; ".join(reason) or "scan")
         for r in joins:
             try:
-                self.store.delete(self._k("join", r))
+                self.store.delete(_ks.member_flag(self.ns, "join", r))
             except Exception:
                 pass
         for r in demoted:
             try:
-                self.store.delete(self._k("demote", r))
+                self.store.delete(_ks.member_flag(self.ns, "demote", r))
             except Exception:
                 pass
         return n
@@ -495,7 +495,7 @@ class MembershipCoordinator:
         return self._epochs.current()
 
     def request_join(self) -> None:
-        self.store.set(self._k("join", self.rank),
+        self.store.set(_ks.member_flag(self.ns, "join", self.rank),
                        json.dumps({"t": self.clock()}).encode())
 
     def form_initial(self) -> dict:

@@ -21,6 +21,7 @@ import struct
 import zlib
 from typing import Dict, List, Optional
 
+from ..control_plane import keyspace as _ks
 from ..resilience import faults as _faults
 
 __all__ = ["SnapshotCorrupt", "encode", "decode", "PeerReplicator",
@@ -57,7 +58,7 @@ def decode(blob: bytes):
 
 
 def mailbox_key(ns: str, src: int, dst: int) -> str:
-    return f"{ns}/snap/{src}/{dst}"
+    return _ks.snap(ns, src, dst)
 
 
 def _corrupt(blob: bytes, kind: str) -> bytes:

@@ -32,12 +32,16 @@ class ElasticManager:
                  heartbeat_interval: float = 2.0,
                  timeout: Optional[float] = None,
                  on_fault: Optional[Callable[[List[str]], None]] = None):
-        self._store = store
+        # store clients are internally synchronized (LocalStore locks
+        # every op; TCPStore is one request per call) — the .add/.set
+        # calls below are not unguarded shared-state mutation
+        self._store = store  # ptlint: disable=thread-escape
         self.node_id = node_id
         self.num_nodes = num_nodes
         self.interval = heartbeat_interval
         self.timeout = timeout or self.ELASTIC_TIMEOUT
-        self.on_fault = on_fault
+        self.on_fault = on_fault  # guarded by: _cb_lock
+        self._cb_lock = threading.Lock()
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
 
@@ -139,8 +143,10 @@ class ElasticManager:
                     reported.discard(nid)  # recovered: re-arm reporting
             fresh = [n for n in dead if n not in reported]
             reported.update(fresh)
-            if fresh and self.on_fault is not None:
-                self.on_fault(fresh)
+            with self._cb_lock:
+                cb = self.on_fault
+            if fresh and cb is not None:
+                cb(fresh)
 
     # ------------------------------------------------------- relaunch
     def enable_relaunch(self, job_id: str = "default"):
@@ -150,14 +156,15 @@ class ElasticManager:
         pods and re-rendezvous under the new generation (reference:
         manager.py:457-530 scale-in/relaunch; here the launcher owns the
         process lifecycle, the manager owns detection)."""
-        prev = self.on_fault
+        with self._cb_lock:
+            prev = self.on_fault
 
-        def _fault(dead):
-            if prev is not None:
-                prev(dead)
-            self.request_relaunch(job_id)
+            def _fault(dead):
+                if prev is not None:
+                    prev(dead)
+                self.request_relaunch(job_id)
 
-        self.on_fault = _fault
+            self.on_fault = _fault
 
     def request_relaunch(self, job_id: str = "default") -> int:
         """Bump the restart generation all launchers poll. Returns the new

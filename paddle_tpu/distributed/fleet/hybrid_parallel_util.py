@@ -33,9 +33,11 @@ def fused_allreduce_gradients_with_group(params, group, scale=None,
     from ... import observability as _obs
     from ..pipeline.transport import overlap_bucket_bytes
 
+    from ...config import knobs
+
     if bucket_bytes is None:
         bucket_bytes = overlap_bucket_bytes() \
-            if "PADDLE_TPU_PP_BUCKET_MB" in os.environ else _FUSE_BYTES
+            if knobs.is_set("PADDLE_TPU_PP_BUCKET_MB") else _FUSE_BYTES
     nranks = group.nranks if group is not None else 1
     if nranks <= 1:
         return

@@ -193,8 +193,12 @@ class GroupShardedStage3:
             for p in self._params:
                 dist.broadcast(p, self._group.ranks[0], group=self._group)
         self._full_shapes = {id(p): tuple(p.shape) for p in self._params}
-        self._sharded_ids: set = set()
-        self._sharded = False
+        # stage-3 shard state runs entirely on the training thread:
+        # optimizer.step is REBOUND to self.step (same-thread routing,
+        # not a callback escaping to another thread), and forward hooks
+        # fire synchronously inside the caller's forward
+        self._sharded_ids: set = set()  # ptlint: disable=thread-escape
+        self._sharded = False  # ptlint: disable=thread-escape
         if self._nranks > 1:
             self._shard_all()
             self._register_hooks()

@@ -26,13 +26,13 @@ Transport selection (``PADDLE_TPU_PP_TRANSPORT``):
 """
 from __future__ import annotations
 
-import os
 import threading
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 
+from ...config import knobs
 from ...core.tensor import Tensor
 from ... import observability as _obs
 
@@ -48,22 +48,19 @@ _PAYLOAD_KEY = "__pp_payload__"
 # ------------------------------------------------------------------ knobs
 def transport_mode() -> str:
     """``PADDLE_TPU_PP_TRANSPORT``: ``auto`` | ``device`` | ``host``."""
-    mode = os.environ.get("PADDLE_TPU_PP_TRANSPORT", "auto").strip().lower()
+    mode = knobs.get_str("PADDLE_TPU_PP_TRANSPORT").strip().lower()
     return mode if mode in ("auto", "device", "host") else "auto"
 
 
 def ring_impl() -> str:
     """``PADDLE_TPU_PP_RING``: ``ppermute`` (default) | ``pallas``."""
-    impl = os.environ.get("PADDLE_TPU_PP_RING", "ppermute").strip().lower()
+    impl = knobs.get_str("PADDLE_TPU_PP_RING").strip().lower()
     return impl if impl in ("ppermute", "pallas") else "ppermute"
 
 
 def overlap_bucket_bytes() -> int:
     """Gradient-sync bucket size from ``PADDLE_TPU_PP_BUCKET_MB`` (MB)."""
-    try:
-        mb = float(os.environ.get("PADDLE_TPU_PP_BUCKET_MB", "") or 4.0)
-    except ValueError:
-        mb = 4.0
+    mb = knobs.get_float("PADDLE_TPU_PP_BUCKET_MB")
     return max(1, int(mb * (1 << 20)))
 
 

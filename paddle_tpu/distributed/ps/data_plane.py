@@ -53,6 +53,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..control_plane import keyspace as _ks
 from ..resilience import faults as _faults
 from ..resilience.retry import call_with_retry
 from . import checkpoint as ps_ckpt
@@ -276,7 +277,7 @@ class PSServer:
                       ReplicationLog(store, self.server_index),
                       b: ReplicationLog(store, b)}
         beat(store, self.server_index)
-        store.set(f"ps/primary/{self.server_index}",
+        store.set(_ks.ps_primary(self.server_index),
                   str(self.server_index).encode())
         for fn in (self._beat_loop, self._applier_loop,
                    self._watch_loop):

@@ -27,6 +27,9 @@ import pickle
 import time
 from typing import Callable, Optional
 
+from ...config import knobs
+
+from ..control_plane import keyspace as _ks
 from ..control_plane.lease import read_beat, write_beat
 from ..control_plane.store_util import try_get
 from ..resilience.retry import RetryPolicy, default_policy
@@ -52,13 +55,6 @@ class PSFailover(RuntimeError):
             f"new={new_primary}): {reason}")
 
 
-def _env_f(name: str, default: float) -> float:
-    try:
-        return float(os.environ.get(name, default))
-    except ValueError:
-        return default
-
-
 class PSConfig:
     """PS tier knobs (env-overridable, ctor args win):
 
@@ -78,17 +74,17 @@ class PSConfig:
                  beat_interval: Optional[float] = None,
                  failover_timeout: Optional[float] = None,
                  replication: Optional[str] = None):
-        self.timeout = timeout if timeout is not None else _env_f(
-            "PADDLE_TPU_PS_TIMEOUT", 30.0)
+        self.timeout = timeout if timeout is not None \
+            else knobs.get_float("PADDLE_TPU_PS_TIMEOUT")
         self.rpc_timeout = rpc_timeout if rpc_timeout is not None \
-            else _env_f("PADDLE_TPU_PS_RPC_TIMEOUT", 2.0)
+            else knobs.get_float("PADDLE_TPU_PS_RPC_TIMEOUT")
         self.beat_interval = beat_interval if beat_interval is not None \
-            else _env_f("PADDLE_TPU_PS_BEAT", 0.15)
+            else knobs.get_float("PADDLE_TPU_PS_BEAT")
         self.failover_timeout = failover_timeout \
             if failover_timeout is not None \
-            else _env_f("PADDLE_TPU_PS_FAILOVER_TIMEOUT", 5.0)
-        self.replication = (replication or os.environ.get(
-            "PADDLE_TPU_PS_REPLICATION", "auto")).lower()
+            else knobs.get_float("PADDLE_TPU_PS_FAILOVER_TIMEOUT")
+        self.replication = (replication or knobs.get_str(
+            "PADDLE_TPU_PS_REPLICATION")).lower()
 
     @property
     def lease_timeout(self) -> float:
@@ -123,17 +119,17 @@ def lease_fresh(store, index: int, lease_timeout: float) -> bool:
 
 
 def primary_of(store, shard: int, default: int) -> int:
-    raw = try_get(store, f"ps/primary/{shard}")
+    raw = try_get(store, _ks.ps_primary(shard))
     return int(raw) if raw else default
 
 
 def set_primary(store, shard: int, index: int) -> None:
-    store.set(f"ps/primary/{shard}", str(index).encode())
-    store.add("ps/gen", 1)  # workers watch this to re-resolve eagerly
+    store.set(_ks.ps_primary(shard), str(index).encode())
+    store.add(_ks.ps_gen(), 1)  # workers watch this to re-resolve eagerly
 
 
 def map_generation(store) -> int:
-    return store.add("ps/gen", 0)
+    return store.add(_ks.ps_gen(), 0)
 
 
 class ReplicationLog:
@@ -152,12 +148,12 @@ class ReplicationLog:
     def post(self, record: dict) -> int:
         n = self._next_post
         self._next_post += 1
-        self.store.set(f"ps/repl/{self.shard}/{n}",
+        self.store.set(_ks.ps_repl(self.shard, n),
                        pickle.dumps(record, protocol=4))
         return n
 
     def acked(self) -> int:
-        raw = try_get(self.store, f"ps/replack/{self.shard}")
+        raw = try_get(self.store, _ks.ps_replack(self.shard))
         return int(raw) if raw else 0
 
     def wait_acked(self, n: int, deadline_s: float,
@@ -175,7 +171,7 @@ class ReplicationLog:
         return False
 
     def take_next(self) -> Optional[dict]:
-        key = f"ps/repl/{self.shard}/{self._next_apply}"
+        key = _ks.ps_repl(self.shard, self._next_apply)
         raw = try_get(self.store, key)
         if raw is None:
             return None
@@ -188,7 +184,7 @@ class ReplicationLog:
         return rec
 
     def ack(self) -> None:
-        self.store.set(f"ps/replack/{self.shard}",
+        self.store.set(_ks.ps_replack(self.shard),
                        str(self._next_apply - 1).encode())
 
     def applied_count(self) -> int:

@@ -1,0 +1,85 @@
+"""The declared registry of fault-injection and retry site names.
+
+Every site string passed to ``faults.check("<site>")``,
+``call_with_retry(..., site="<site>")`` or the ``retry(site=...)``
+decorator MUST be declared here, and every declared site must be
+exercised by at least one test — ptlint's ``fault-sites`` pass checks
+both directions (REQUIRE_USED style), so a typo'd plan spec like
+``PADDLE_TPU_FAULT_PLAN=cp.laese:drop@1`` can't silently inject
+nothing, and no site rots untested.
+
+stdlib-only and import-cycle-free: loaded standalone by ptlint via
+``importlib.util.spec_from_file_location``.
+"""
+from __future__ import annotations
+
+from typing import Dict, NamedTuple, Tuple
+
+__all__ = ["Site", "SITES", "is_declared", "validate"]
+
+
+class Site(NamedTuple):
+    name: str
+    subsystem: str
+    doc: str
+
+
+_S = Site
+
+_ALL: Tuple[Site, ...] = (
+    # ----------------------------------------------------- substrate
+    _S("store.op", "distributed",
+       "One TCPStore client op (set/get/add/check/delete); retried "
+       "on the default policy."),
+    _S("rpc.post", "distributed",
+       "One rpc request post on the wire."),
+    _S("rpc.resend", "distributed",
+       "The rpc retransmit schedule for a silently lost request "
+       "(server dedups by call_id)."),
+    _S("pg.collective", "distributed",
+       "One process-group collective launch."),
+    _S("ckpt.write", "distributed",
+       "One checkpoint shard write (atomic rename on success)."),
+    # ------------------------------------------------- control plane
+    _S("cp.lease", "control_plane",
+       "One heartbeat lease write; drop loses the beat on the wire."),
+    _S("cp.epoch", "control_plane",
+       "One epoch commit; delay holds the commit window open."),
+    # ------------------------------------------------------ training
+    _S("engine.step", "training",
+       "One training engine optimizer step."),
+    _S("elastic.heartbeat", "elastic",
+       "One elastic membership heartbeat."),
+    _S("elastic.epoch_commit", "elastic",
+       "One elastic group-epoch commit."),
+    _S("elastic.reshard", "elastic",
+       "One deterministic reshard / peer-snapshot restore."),
+    # ------------------------------------------------------------ ps
+    _S("ps.pull", "ps",
+       "One worker-side sharded pull (sparse or dense)."),
+    _S("ps.push", "ps",
+       "One worker-side sharded push (sparse, dense, or save)."),
+    _S("ps.server", "ps",
+       "PS server handler entry (crash/hang the serving shard)."),
+    # ------------------------------------------------------- serving
+    _S("serving.step", "serving",
+       "One ServingEngine step (admit + prefill + decode)."),
+    _S("cluster.replica", "serving",
+       "One cluster replica step (kill/drop a whole replica)."),
+)
+
+SITES: Dict[str, Site] = {s.name: s for s in _ALL}
+assert len(SITES) == len(_ALL), "duplicate fault site"
+
+
+def is_declared(name: str) -> bool:
+    return name in SITES
+
+
+def validate() -> None:
+    for s in _ALL:
+        assert s.name and s.subsystem and s.doc, s
+        assert s.name == s.name.strip().lower(), s.name
+
+
+validate()

@@ -61,6 +61,8 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from ...config import knobs
+
 __all__ = ["FaultAction", "configure", "reset", "active", "check",
            "apply", "injected", "plan_text"]
 
@@ -139,7 +141,7 @@ def configure(plan: Optional[str], seed: Optional[int] = None) -> None:
         _counters = {}
         _log = []
         if seed is None:
-            seed = int(os.environ.get("PADDLE_TPU_FAULT_SEED", "0"))
+            seed = knobs.get_int("PADDLE_TPU_FAULT_SEED")
         _rng = random.Random(seed)
 
 
@@ -150,7 +152,7 @@ def reset() -> None:
 def _ensure_env_loaded() -> None:
     global _env_loaded
     if not _env_loaded:
-        configure(os.environ.get("PADDLE_TPU_FAULT_PLAN"))
+        configure(knobs.get_str("PADDLE_TPU_FAULT_PLAN"))
 
 
 def active() -> bool:

@@ -28,6 +28,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Optional, Tuple, Type
 
+from ...config import knobs
+
 __all__ = ["RetryPolicy", "default_policy", "call_with_retry", "retry"]
 
 # TimeoutError is an OSError subclass since 3.10, listed explicitly for
@@ -65,12 +67,9 @@ def default_policy(deadline: Optional[float] = None,
                    **overrides) -> RetryPolicy:
     """Policy from the ``PADDLE_TPU_RETRY_*`` env knobs."""
     kw = dict(
-        max_attempts=int(os.environ.get(
-            "PADDLE_TPU_RETRY_MAX_ATTEMPTS", "5")),
-        base_delay=float(os.environ.get(
-            "PADDLE_TPU_RETRY_BASE_DELAY", "0.05")),
-        max_delay=float(os.environ.get(
-            "PADDLE_TPU_RETRY_MAX_DELAY", "2.0")),
+        max_attempts=knobs.get_int("PADDLE_TPU_RETRY_MAX_ATTEMPTS"),
+        base_delay=knobs.get_float("PADDLE_TPU_RETRY_BASE_DELAY"),
+        max_delay=knobs.get_float("PADDLE_TPU_RETRY_MAX_DELAY"),
         deadline=deadline,
     )
     kw.update(overrides)
@@ -78,7 +77,7 @@ def default_policy(deadline: Optional[float] = None,
 
 
 def _jitter_rng(site: str) -> random.Random:
-    seed = int(os.environ.get("PADDLE_TPU_RETRY_SEED", "0"))
+    seed = knobs.get_int("PADDLE_TPU_RETRY_SEED")
     # stable per (seed, site): zlib.crc32 is deterministic across runs,
     # unlike hash() under PYTHONHASHSEED randomization
     import zlib

@@ -20,6 +20,8 @@ from concurrent.futures import Future
 from dataclasses import dataclass
 from typing import Dict, Optional
 
+from ..config import knobs
+
 __all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown",
            "get_worker_info", "get_all_worker_infos", "WorkerInfo"]
 
@@ -52,7 +54,7 @@ class _RpcAgent:
         self._handled: set = set()
         self._handled_order: deque = deque()
         self._lock = threading.Lock()
-        self._stop = False
+        self._stop = threading.Event()
         # registry: name -> rank
         store.set(f"{self._ns}/worker/{rank}", name.encode())
         self.workers: Dict[str, WorkerInfo] = {}
@@ -83,7 +85,7 @@ class _RpcAgent:
     def _serve(self):
         """Execute incoming requests."""
         seqs = {r: 0 for r in range(self.world_size)}
-        while not self._stop:
+        while not self._stop.is_set():
             progressed = False
             for r in range(self.world_size):
                 key = f"{self._ns}/mbox/{self.rank}"
@@ -92,7 +94,7 @@ class _RpcAgent:
                         continue
                     raw = self.store.get(f"{key}/{r}/{seqs[r]}")
                 except Exception:
-                    if self._stop:
+                    if self._stop.is_set():
                         return
                     continue
                 consumed_key = f"{key}/{r}/{seqs[r]}"
@@ -205,7 +207,7 @@ class _RpcAgent:
 
     def _collect(self):
         """Resolve futures as replies land."""
-        while not self._stop:
+        while not self._stop.is_set():
             self._deadlines_and_resends()
             done = []
             with self._lock:
@@ -244,7 +246,7 @@ class _RpcAgent:
                         except Exception:
                             pass
                 except Exception:
-                    if self._stop:
+                    if self._stop.is_set():
                         return
             with self._lock:
                 for c in done:
@@ -277,10 +279,9 @@ class _RpcAgent:
         # re-posted on exponential backoff (server dedups by call_id)
         policy = retry_policy or _retry.default_policy(
             deadline=timeout,
-            max_attempts=int(os.environ.get("PADDLE_TPU_RPC_RETRIES",
-                                            "4")),
-            base_delay=float(os.environ.get(
-                "PADDLE_TPU_RPC_RETRY_BASE_DELAY", "0.25")),
+            max_attempts=knobs.get_int("PADDLE_TPU_RPC_RETRIES"),
+            base_delay=knobs.get_float(
+                "PADDLE_TPU_RPC_RETRY_BASE_DELAY"),
             max_delay=4.0)
         now = time.monotonic()
         rng = _retry._jitter_rng(f"rpc.resend/{call_id}")
@@ -305,7 +306,7 @@ class _RpcAgent:
         return fut
 
     def stop(self):
-        self._stop = True
+        self._stop.set()
 
 
 _agent: Optional[_RpcAgent] = None
@@ -449,9 +450,18 @@ def shutdown(graceful: bool = True, timeout: float = 120.0,
             deadline = time.monotonic() + timeout
             while True:
                 dead = _dead()
-                waiting = [r for r in range(world)
-                           if r not in dead and not _agent.store.check(
-                               f"{ns}/rank/{r}")]
+                try:
+                    waiting = [r for r in range(world)
+                               if r not in dead
+                               and not _agent.store.check(
+                                   f"{ns}/rank/{r}")]
+                except (ConnectionError, OSError):
+                    # the master store died mid-poll. Its host rank only
+                    # exits after seeing EVERY arrival flag (ours
+                    # included), so losing the store here proves the
+                    # barrier completed — finish shutting down instead
+                    # of crashing the tail rank.
+                    break
                 if not waiting:
                     break
                 if time.monotonic() > deadline:

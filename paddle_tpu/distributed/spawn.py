@@ -5,6 +5,8 @@ import multiprocessing as mp
 import os
 import socket
 
+from ..config import knobs
+
 __all__ = ["spawn"]
 
 
@@ -23,7 +25,7 @@ def _worker(func, rank, nprocs, master, backend, args):
     os.environ["PADDLE_LOCAL_RANK"] = str(rank)
     if backend:
         os.environ["PADDLE_DIST_BACKEND"] = backend
-    if os.environ.get("PADDLE_TPU_KEEP_BACKEND_LOGS", "") != "1":
+    if not knobs.get_bool("PADDLE_TPU_KEEP_BACKEND_LOGS"):
         # demote jaxlib's C++ "[Gloo] Rank N is connected..." fd-2 spam
         # to the framework logger at DEBUG before anything inits jax
         from .log_utils import install_stderr_filter

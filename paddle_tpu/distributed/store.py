@@ -16,6 +16,8 @@ import threading
 import time
 from typing import Dict, Optional
 
+from ..config import knobs
+
 __all__ = ["TCPStore", "MasterDaemon", "PrefixStore",
            "create_or_get_global_tcp_store"]
 
@@ -141,7 +143,7 @@ class TCPStore:
         from ..core import native as _native
 
         self._native = (_native.available()
-                        and not os.environ.get("PADDLE_TPU_PURE_PY_STORE"))
+                        and not knobs.get_bool("PADDLE_TPU_PURE_PY_STORE"))
         self._daemon = None
         if is_master:
             if self._native:

@@ -20,6 +20,8 @@ import threading
 import time
 from typing import Callable, Dict, Optional
 
+from ..config import knobs
+
 __all__ = ["CommTask", "CommTaskManager", "enable", "disable", "watch"]
 
 
@@ -51,7 +53,7 @@ class CommTaskManager:
         self._lock = threading.Lock()
         self._next_id = 0  # guarded by: _lock
         self._poll = poll_interval
-        self._stop = False
+        self._stop = threading.Event()
         self.on_timeout: Callable[[CommTask], None] = self._default_abort
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
@@ -80,8 +82,7 @@ class CommTaskManager:
             return list(self._tasks.values())
 
     def _loop(self):
-        while not self._stop:
-            time.sleep(self._poll)
+        while not self._stop.wait(self._poll):
             with self._lock:
                 expired = [(tid, t) for tid, t in self._tasks.items()
                            if t.is_timeout()]
@@ -151,7 +152,7 @@ class CommTaskManager:
             forensics_done=True)
 
     def shutdown(self):
-        self._stop = True
+        self._stop.set()
 
 
 _UNSET = object()
@@ -159,8 +160,7 @@ _timeout = _UNSET  # _UNSET: follow env var; None: explicitly disabled
 
 
 def _env_timeout() -> Optional[float]:
-    v = os.environ.get("PADDLE_TPU_COMM_TIMEOUT")
-    return float(v) if v else None
+    return knobs.get_float("PADDLE_TPU_COMM_TIMEOUT")
 
 
 def enable(timeout: float, on_timeout=None):

@@ -33,8 +33,8 @@ from __future__ import annotations
 
 import contextlib
 import contextvars
-import os
 
+from ..config import knobs
 from . import chunked, epilogues, moe, overlap_mm, quant  # noqa: F401
 from .chunked import chunked_epilogue, lm_head_chunked_ce
 from .epilogues import add_rms_norm, dropout_add, linear_gelu, swiglu_linear
@@ -66,7 +66,7 @@ def mode() -> str:
     forced = _forced.get()[0]
     if forced is not None:
         return "on" if forced == "auto" else forced
-    raw = os.environ.get("PADDLE_TPU_FUSION", "auto").strip().lower()
+    raw = knobs.get_str("PADDLE_TPU_FUSION").strip().lower()
     if raw not in _FUSION_MODES:
         raise ValueError(
             f"PADDLE_TPU_FUSION={raw!r}: expected one of {_FUSION_MODES}")
@@ -81,7 +81,7 @@ def mm_quant() -> str:
     """Resolved quantized-matmul mode: "off", "int8" or "fp8"."""
     forced = _forced.get()[1]
     raw = forced if forced is not None else \
-        os.environ.get("PADDLE_TPU_MM_QUANT", "off").strip().lower()
+        knobs.get_str("PADDLE_TPU_MM_QUANT").strip().lower()
     if raw not in _QUANT_MODES:
         raise ValueError(
             f"PADDLE_TPU_MM_QUANT={raw!r}: expected one of {_QUANT_MODES}")

@@ -53,11 +53,11 @@ from __future__ import annotations
 import contextlib
 import contextvars
 import math
-import os
 
 import jax
 import jax.numpy as jnp
 
+from ..config import knobs
 from .quant import qmm
 
 __all__ = [
@@ -80,7 +80,7 @@ def mode() -> str:
     """Resolved overlap mode: "on", "pallas" or "off" ("auto" -> "on")."""
     forced = _forced.get()[0]
     raw = forced if forced is not None else \
-        os.environ.get("PADDLE_TPU_TP_OVERLAP", "auto").strip().lower()
+        knobs.get_str("PADDLE_TPU_TP_OVERLAP").strip().lower()
     if raw not in _MODES:
         raise ValueError(
             f"PADDLE_TPU_TP_OVERLAP={raw!r}: expected one of {_MODES}")
@@ -95,7 +95,7 @@ def _raw_mode() -> str:
     """Unresolved mode: distinguishes explicit "on"/"pallas" from "auto"."""
     forced = _forced.get()[0]
     raw = forced if forced is not None else \
-        os.environ.get("PADDLE_TPU_TP_OVERLAP", "auto").strip().lower()
+        knobs.get_str("PADDLE_TPU_TP_OVERLAP").strip().lower()
     return raw if raw in _MODES else "auto"
 
 
@@ -111,11 +111,7 @@ def default_chunks() -> int:
     forced = _forced.get()[1]
     if forced is not None:
         return max(1, int(forced))
-    try:
-        v = int(os.environ.get("PADDLE_TPU_TP_OVERLAP_CHUNKS", "") or 2)
-    except ValueError:
-        v = 2
-    return max(1, v)
+    return max(1, knobs.get_int("PADDLE_TPU_TP_OVERLAP_CHUNKS"))
 
 
 @contextlib.contextmanager

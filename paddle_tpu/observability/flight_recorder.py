@@ -24,13 +24,13 @@ import time
 from collections import deque
 from typing import List, Optional
 
+from ..config import knobs
 from .registry import enabled as _enabled
 
 __all__ = ["record", "events", "reset", "dump_debug_bundle",
            "install_excepthook", "default_dump_dir"]
 
-_DEFAULT_CAPACITY = int(os.environ.get("PADDLE_TPU_FLIGHT_CAPACITY",
-                                       "4096"))
+_DEFAULT_CAPACITY = knobs.get_int("PADDLE_TPU_FLIGHT_CAPACITY")
 
 # deque(maxlen) appends are atomic under the GIL — no lock on the
 # record path; list(...) snapshots are consistent enough for dumps
@@ -59,7 +59,7 @@ def reset() -> None:
 
 
 def default_dump_dir() -> Optional[str]:
-    return os.environ.get("PADDLE_TPU_DUMP_DIR") or None
+    return knobs.get_str("PADDLE_TPU_DUMP_DIR") or None
 
 
 def _comm_task_table() -> List[dict]:
@@ -216,7 +216,31 @@ def dump_debug_bundle(dir_path: Optional[str] = None,
             _write_json(os.path.join(d, "control_plane.json"), cps)
     except Exception:
         pass
+    try:
+        fp = _protocol_lint_fingerprint()
+        if fp:
+            _write_json(os.path.join(d, "protocol_lint.json"), fp)
+    except Exception:
+        pass
     return d
+
+
+def _protocol_lint_fingerprint() -> Optional[dict]:
+    """The lint fingerprint of the running tree (rule catalog + hashes
+    of the protocol registries) — lets a crash bundle be matched to the
+    exact contract its tree was linted against. Only available when
+    running from a source checkout (tools/ must be importable); an
+    installed package skips the section rather than guessing."""
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    if not os.path.exists(os.path.join(root, "tools", "ptlint",
+                                       "engine.py")):
+        return None
+    if root not in sys.path:
+        sys.path.insert(0, root)
+    from tools.ptlint import protocol_fingerprint
+
+    return protocol_fingerprint(root)
 
 
 _prev_excepthook = None

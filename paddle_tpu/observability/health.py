@@ -22,10 +22,10 @@ feature, not a metrics feature.
 """
 from __future__ import annotations
 
-import os
 import warnings
 from typing import Optional
 
+from ..config import knobs
 from .registry import enabled as _telemetry_enabled, registry
 
 __all__ = ["NonFiniteError", "configure", "enabled", "get_policy",
@@ -35,7 +35,7 @@ _POLICIES = ("off", "warn", "skip", "raise")
 
 
 def _env_policy() -> str:
-    v = os.environ.get("PADDLE_TPU_HEALTH", "").strip().lower()
+    v = knobs.get_str("PADDLE_TPU_HEALTH").strip().lower()
     return v if v in _POLICIES else "off"
 
 

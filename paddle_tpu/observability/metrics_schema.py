@@ -22,6 +22,65 @@ class MetricSpec(NamedTuple):
     tags: Tuple[str, ...] = ()     # allowed tag keys
 
 
+class NamespaceSpec(NamedTuple):
+    doc: str
+    # True: ptlint's metric-names reverse sweep requires every declared
+    # name in the namespace to be recorded at some literal call site
+    # (the schema cannot hold dead rows). False: declaration-only lint
+    # (names recorded conditionally / from runtime-built strings).
+    require_used: bool = True
+
+
+# every first dotted segment of a METRICS/SPANS key must be declared
+# here — the metric-names pass derives its REQUIRE_USED sweep from the
+# require_used flags instead of a hand-grown prefix list, and fails on
+# keys whose namespace is missing (a typo'd namespace can't slip in as
+# a fresh one)
+NAMESPACES = {
+    "bench":      NamespaceSpec("bench.py harness self-metrics",
+                                require_used=False),
+    "ckpt":       NamespaceSpec("checkpoint save/restore",
+                                require_used=False),
+    "cluster":    NamespaceSpec("serving cluster router/replicas"),
+    "cp":         NamespaceSpec("control plane: leases + epochs"),
+    "decode":     NamespaceSpec("fused single-model decode",
+                                require_used=False),
+    "device":     NamespaceSpec("device memory/occupancy samples",
+                                require_used=False),
+    "elastic":    NamespaceSpec("elastic membership + reshard"),
+    "engine":     NamespaceSpec("Engine.fit training loop",
+                                require_used=False),
+    "fleet":      NamespaceSpec("fleet executor actors",
+                                require_used=False),
+    "fusion":     NamespaceSpec("operator-fusion routing",
+                                require_used=False),
+    "jit":        NamespaceSpec("jit compile/recompile tracking",
+                                require_used=False),
+    "kv":         NamespaceSpec("cluster KV store: index + host tier"),
+    "moe":        NamespaceSpec("mixture-of-experts dispatch",
+                                require_used=False),
+    "pg":         NamespaceSpec("process-group collectives",
+                                require_used=False),
+    "pipeline":   NamespaceSpec("pipeline schedules", require_used=False),
+    "pp":         NamespaceSpec("pipeline transport + grad sync",
+                                require_used=False),
+    "prof":       NamespaceSpec("sampled step profiler"),
+    "ps":         NamespaceSpec("parameter-server tier"),
+    "resilience": NamespaceSpec("retry/fault-injection substrate",
+                                require_used=False),
+    "rpc":        NamespaceSpec("rpc transport", require_used=False),
+    "rt":         NamespaceSpec("request-scoped serving telemetry"),
+    "serving":    NamespaceSpec("single-replica serving engine"),
+    "slo":        NamespaceSpec("rolling-window SLO engine"),
+    "tp":         NamespaceSpec("tensor-parallel overlap",
+                                require_used=False),
+    "train":      NamespaceSpec("training health/grad-norm",
+                                require_used=False),
+    "xla":        NamespaceSpec("XLA compile/memory ledgers",
+                                require_used=False),
+}
+
+
 # fixed bucket boundaries (seconds) — histograms never grow buckets at
 # runtime, so exposition stays O(1) and mergeable across snapshots
 TIME_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,

@@ -52,6 +52,7 @@ import time
 from collections import deque
 from typing import Callable, Dict, List, Optional
 
+from ..config import knobs
 from . import tracing as _tracing
 from . import windows as _windows
 from .registry import registry as _registry
@@ -89,7 +90,7 @@ def _parse_mode(raw: str):
     return "off", 0
 
 
-_mode, _every = _parse_mode(os.environ.get("PADDLE_TPU_PROFILE", ""))
+_mode, _every = _parse_mode(knobs.get_str("PADDLE_TPU_PROFILE"))
 # THE gate: a single module-global bool read on every hot-path check
 _active = _mode != "off"
 
@@ -158,12 +159,9 @@ def peak_flops(default_tpu: float = 197e12,
     """Per-chip peak FLOP/s for MFU math: PADDLE_TPU_PROF_PEAK_FLOPS,
     else the configured value, else a backend default (v5e for TPU; 0
     elsewhere — MFU reads 0 rather than a made-up CPU number)."""
-    env = os.environ.get("PADDLE_TPU_PROF_PEAK_FLOPS")
+    env = knobs.get_float("PADDLE_TPU_PROF_PEAK_FLOPS")
     if env:
-        try:
-            return float(env)
-        except ValueError:
-            pass
+        return env
     if _config["peak_flops"] > 0:
         return _config["peak_flops"]
     try:
@@ -180,12 +178,9 @@ def link_bandwidth() -> float:
     """Inter-chip link bandwidth (bytes/s) for the overlap estimator:
     PADDLE_TPU_PROF_LINK_GBPS else ~ICI-class 90 GB/s on TPU, a
     loopback-class 10 GB/s elsewhere (CPU smoke)."""
-    env = os.environ.get("PADDLE_TPU_PROF_LINK_GBPS")
+    env = knobs.get_float("PADDLE_TPU_PROF_LINK_GBPS")
     if env:
-        try:
-            return float(env) * 1e9
-        except ValueError:
-            pass
+        return env * 1e9
     try:
         import jax
 

@@ -21,19 +21,18 @@ registry is the metrics half of that pair for the TPU build.
 from __future__ import annotations
 
 import bisect
-import os
 import threading
 import time
 from typing import Dict, Optional, Tuple
 
+from ..config import knobs
 from . import metrics_schema as _schema
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
            "registry", "enable", "disable", "enabled", "Stopwatch",
            "stopwatch"]
 
-_enabled = os.environ.get("PADDLE_TPU_TELEMETRY", "").strip() \
-    not in ("", "0", "false", "False", "off")
+_enabled = knobs.get_bool("PADDLE_TPU_TELEMETRY")
 
 
 def enable() -> None:

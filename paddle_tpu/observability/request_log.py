@@ -42,6 +42,7 @@ import weakref
 from collections import deque
 from typing import Callable, Dict, List, Optional
 
+from ..config import knobs
 from . import tracing as _tracing
 from . import windows as _w
 
@@ -190,7 +191,7 @@ class RequestLog:
         self.windows = windows if windows is not None \
             else _w.Windows(source or "rt", clock=clock)
         self.path = path if path is not None \
-            else os.environ.get("PADDLE_TPU_ACCESS_LOG") or None
+            else knobs.get_str("PADDLE_TPU_ACCESS_LOG") or None
         self._tail: deque = deque(maxlen=max(int(tail), 1))
         self._lock = threading.Lock()
         self._file = None  # guarded by: _lock

@@ -26,12 +26,12 @@ pure stdlib and clock-injectable (tests drive it with ManualClock).
 """
 from __future__ import annotations
 
-import os
 import threading
 import weakref
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Union
 
+from ..config import knobs
 from . import windows as _w
 
 __all__ = ["Objective", "SLOEngine", "default_objectives",
@@ -39,11 +39,6 @@ __all__ = ["Objective", "SLOEngine", "default_objectives",
 
 OK, WARN, BURN = "OK", "WARN", "BURN"
 _STATE_RANK = {OK: 0, WARN: 1, BURN: 2}
-
-
-def _env_float(name: str, default: float) -> float:
-    v = os.environ.get(name)
-    return float(v) if v else default
 
 
 @dataclass(frozen=True)
@@ -84,9 +79,9 @@ def default_objectives() -> List[Objective]:
     """The serving SLOs every engine/router evaluates out of the box,
     thresholds from ``PADDLE_TPU_SLO_*`` (milliseconds for latencies,
     fraction for shed rate)."""
-    ttft_ms = _env_float("PADDLE_TPU_SLO_TTFT_P99_MS", 2000.0)
-    gap_ms = _env_float("PADDLE_TPU_SLO_TOKEN_GAP_P99_MS", 500.0)
-    shed = _env_float("PADDLE_TPU_SLO_SHED_RATE", 0.05)
+    ttft_ms = knobs.get_float("PADDLE_TPU_SLO_TTFT_P99_MS")
+    gap_ms = knobs.get_float("PADDLE_TPU_SLO_TOKEN_GAP_P99_MS")
+    shed = knobs.get_float("PADDLE_TPU_SLO_SHED_RATE")
     return [
         Objective("ttft_p99", "rt.ttft", ttft_ms / 1e3,
                   kind="quantile", q=99.0, budget=0.01,
@@ -122,15 +117,15 @@ class SLOEngine:
         self.objectives = list(objectives if objectives is not None
                                else default_objectives())
         self.fast_s = fast_s if fast_s is not None else \
-            _env_float("PADDLE_TPU_SLO_FAST_S", 10.0)
+            knobs.get_float("PADDLE_TPU_SLO_FAST_S")
         self.slow_s = slow_s if slow_s is not None else \
-            _env_float("PADDLE_TPU_SLO_WINDOW_S", 0.0) or None
+            knobs.get_float("PADDLE_TPU_SLO_WINDOW_S") or None
         self.page_burn = page_burn if page_burn is not None else \
-            _env_float("PADDLE_TPU_SLO_PAGE_BURN", 4.0)
+            knobs.get_float("PADDLE_TPU_SLO_PAGE_BURN")
         # utilization EWMA below this (while everything is OK) raises
         # the want_scale_down hint — see load_signals()
         self.util_low = util_low if util_low is not None else \
-            _env_float("PADDLE_TPU_SLO_UTIL_LOW", 0.25)
+            knobs.get_float("PADDLE_TPU_SLO_UTIL_LOW")
         self._lock = threading.Lock()
         self._last: Dict[str, dict] = {}  # guarded by: _lock
         _live.add(self)

@@ -28,6 +28,7 @@ import time
 from collections import deque
 from typing import Dict, List, Optional
 
+from ..config import knobs
 from .registry import enabled as _enabled
 
 __all__ = ["Span", "Tracer", "tracer", "span", "current_context",
@@ -36,8 +37,7 @@ __all__ = ["Span", "Tracer", "tracer", "span", "current_context",
            "finished_spans", "record_complete"]
 
 # ring capacity: finished spans kept for export (oldest dropped first)
-_DEFAULT_CAPACITY = int(os.environ.get("PADDLE_TPU_TRACE_CAPACITY",
-                                       "65536"))
+_DEFAULT_CAPACITY = knobs.get_int("PADDLE_TPU_TRACE_CAPACITY")
 
 _rank: Optional[int] = None
 
@@ -173,7 +173,11 @@ class Tracer:
 
     def __init__(self, capacity: int = _DEFAULT_CAPACITY):
         self._local = threading.local()
-        self._done: deque = deque(maxlen=max(int(capacity), 1))
+        # lock-free by design: deque.append / snapshot-copy are atomic
+        # under the GIL (deque is documented thread-safe for these), so
+        # the finished-span ring needs no lock on the hot span-exit path
+        self._done: deque = deque(  # ptlint: disable=thread-escape
+            maxlen=max(int(capacity), 1))
         self._lock = threading.Lock()
         self._tids: Dict[int, int] = {}
 

@@ -32,12 +32,12 @@ held only around ring mutation.
 from __future__ import annotations
 
 import bisect
-import os
 import threading
 import time
 import weakref
 from typing import Callable, Dict, List, Optional, Sequence
 
+from ..config import knobs
 from . import metrics_schema as _schema
 
 __all__ = ["ManualClock", "RollingCounter", "RollingHistogram", "Ewma",
@@ -67,15 +67,10 @@ class ManualClock:
         return self._t
 
 
-def _env_float(name: str, default: float) -> float:
-    v = os.environ.get(name)
-    return float(v) if v else default
-
-
 # window geometry knobs (seconds); 12 buckets keeps suffix queries
 # (the SLO fast window) meaningful without growing state
-DEFAULT_WINDOW_S = _env_float("PADDLE_TPU_WINDOW_S", 60.0)
-DEFAULT_BUCKETS = int(_env_float("PADDLE_TPU_WINDOW_BUCKETS", 12))
+DEFAULT_WINDOW_S = knobs.get_float("PADDLE_TPU_WINDOW_S")
+DEFAULT_BUCKETS = knobs.get_int("PADDLE_TPU_WINDOW_BUCKETS")
 
 
 class _Ring:

@@ -16,6 +16,8 @@ import threading
 import time
 from typing import Callable, Iterable, Optional
 
+from ..config import knobs
+
 from .timer import benchmark  # noqa: F401
 from .utils import RecordEvent, load_profiler_result  # noqa: F401
 from .profiler_statistic import SortedKeys  # noqa: F401
@@ -248,8 +250,8 @@ class Profiler:
                 import jax
 
                 if jax.default_backend() == "tpu":
-                    logdir = os.environ.get("PADDLE_TPU_PROFILE_DIR",
-                                            "/tmp/paddle_tpu_profile")
+                    logdir = knobs.get_str(
+                        "PADDLE_TPU_PROFILE_DIR")
                     os.makedirs(logdir, exist_ok=True)
                     jax.profiler.start_trace(logdir)
                     self._device_tracing = True

@@ -30,28 +30,14 @@ Env knobs (ctor args win): ``PADDLE_TPU_AUTOSCALE_MIN`` / ``_MAX`` /
 """
 from __future__ import annotations
 
-import os
 import time
 from typing import Callable, Optional
 
 from ... import observability as _obs
+from ...config import knobs
 from .replica import Replica
 
 __all__ = ["Autoscaler", "AutoscaleConfig"]
-
-
-def _env_i(name: str, default: int) -> int:
-    try:
-        return int(os.environ.get(name, default))
-    except ValueError:
-        return default
-
-
-def _env_f(name: str, default: float) -> float:
-    try:
-        return float(os.environ.get(name, default))
-    except ValueError:
-        return default
 
 
 class AutoscaleConfig:
@@ -65,27 +51,27 @@ class AutoscaleConfig:
                  queue_hwm: Optional[int] = None,
                  shed_threshold: Optional[float] = None):
         self.min_replicas = min_replicas if min_replicas is not None \
-            else _env_i("PADDLE_TPU_AUTOSCALE_MIN", 1)
+            else knobs.get_int("PADDLE_TPU_AUTOSCALE_MIN")
         self.max_replicas = max_replicas if max_replicas is not None \
-            else _env_i("PADDLE_TPU_AUTOSCALE_MAX", 4)
+            else knobs.get_int("PADDLE_TPU_AUTOSCALE_MAX")
         # consecutive pressured ticks before scale-out
         self.up_ticks = up_ticks if up_ticks is not None \
-            else _env_i("PADDLE_TPU_AUTOSCALE_UP_TICKS", 3)
+            else knobs.get_int("PADDLE_TPU_AUTOSCALE_UP_TICKS")
         # consecutive idle ticks before scale-in
         self.idle_ticks = idle_ticks if idle_ticks is not None \
-            else _env_i("PADDLE_TPU_AUTOSCALE_IDLE_TICKS", 10)
+            else knobs.get_int("PADDLE_TPU_AUTOSCALE_IDLE_TICKS")
         # refractory ticks after ANY scale event
         self.cooldown_ticks = cooldown_ticks \
             if cooldown_ticks is not None \
-            else _env_i("PADDLE_TPU_AUTOSCALE_COOLDOWN_TICKS", 10)
+            else knobs.get_int("PADDLE_TPU_AUTOSCALE_COOLDOWN_TICKS")
         # aggregate queue depth per alive replica that counts as
         # pressure even before sheds/burn appear
         self.queue_hwm = queue_hwm if queue_hwm is not None \
-            else _env_i("PADDLE_TPU_AUTOSCALE_QUEUE_HWM", 4)
+            else knobs.get_int("PADDLE_TPU_AUTOSCALE_QUEUE_HWM")
         # fast-horizon shed rate above this is pressure
         self.shed_threshold = shed_threshold \
             if shed_threshold is not None \
-            else _env_f("PADDLE_TPU_AUTOSCALE_SHED_THRESHOLD", 0.0)
+            else knobs.get_float("PADDLE_TPU_AUTOSCALE_SHED_THRESHOLD")
         if self.min_replicas < 1:
             raise ValueError("min_replicas must be >= 1")
         if self.max_replicas < self.min_replicas:

@@ -26,25 +26,18 @@ budget).
 """
 from __future__ import annotations
 
-import os
 import threading
 import time
 from collections import deque
 from typing import Callable, List, Optional
 
 from ... import observability as _obs
+from ...config import knobs
 from ...distributed import control_plane as _cp
 from ...distributed.control_plane import (EpochRegistry, LeaseTable,
                                           LocalStore)
 
 __all__ = ["ClusterControlPlane"]
-
-
-def _env_f(name: str, default: float) -> float:
-    try:
-        return float(os.environ.get(name, default))
-    except ValueError:
-        return default
 
 
 class ClusterControlPlane:
@@ -59,9 +52,9 @@ class ClusterControlPlane:
                  store=None):
         self.ns = str(namespace)
         self.beat_interval = beat_interval if beat_interval is not None \
-            else _env_f("PADDLE_TPU_CLUSTER_BEAT", 0.5)
+            else knobs.get_float("PADDLE_TPU_CLUSTER_BEAT")
         self.lease_timeout = lease_timeout if lease_timeout is not None \
-            else _env_f("PADDLE_TPU_CLUSTER_LEASE_TIMEOUT", 2.0)
+            else knobs.get_float("PADDLE_TPU_CLUSTER_LEASE_TIMEOUT")
         self.clock = clock
         self.store = store if store is not None else LocalStore()
         self.leases = LeaseTable(self.store, self.ns,

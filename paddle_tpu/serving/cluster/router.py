@@ -52,12 +52,12 @@ a disagg pump thread) for throughput runs.
 """
 from __future__ import annotations
 
-import os
 import threading
 import time
 from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 from ... import observability as _obs
+from ...config import knobs
 from ...observability.tracing import span
 from ..block_manager import hash_block_tokens
 from ..engine import RequestDescriptor, RequestError
@@ -73,11 +73,6 @@ class Overloaded(RequestError):
     def __init__(self, detail: str = ""):
         super().__init__("overloaded")
         self.detail = detail
-
-
-def _env_int(name: str, default: int) -> int:
-    v = os.environ.get(name)
-    return int(v) if v else default
 
 
 class _ClientReq:
@@ -110,15 +105,15 @@ class ClusterRouter:
             raise ValueError("need at least one replica")
         self.replicas = list(replicas)
         self.max_queue = max_queue if max_queue is not None else \
-            _env_int("PADDLE_TPU_CLUSTER_MAX_QUEUE", 32)
+            knobs.get_int("PADDLE_TPU_CLUSTER_MAX_QUEUE")
         self.disagg = disagg            # DisaggPolicy or None
         self.control_plane = control_plane  # ClusterControlPlane or None
         # cluster KV tier (ClusterKVStore or None): pass one explicitly,
         # or set PADDLE_TPU_KV_TIER=host and the router builds it on the
         # control plane's store. Default off — zero behavior change.
         if kv_store is None and \
-                os.environ.get("PADDLE_TPU_KV_TIER", "").lower() == \
-                "host":
+                knobs.is_set("PADDLE_TPU_KV_TIER") and \
+                knobs.get_str("PADDLE_TPU_KV_TIER").lower() == "host":
             from ..kv_store import ClusterKVStore
             kv_store = ClusterKVStore(control_plane=control_plane)
         self.kv_store = kv_store
@@ -249,8 +244,11 @@ class ClusterRouter:
         return out
 
     def _submit_pool(self) -> List[Replica]:
-        pool = self.disagg.prefill if self.disagg is not None \
-            else self.replicas
+        if self.disagg is not None:
+            pool = self.disagg.prefill
+        else:
+            with self._cond:
+                pool = list(self.replicas)
         return [r for r in pool if r.alive]
 
     def _replay_pool(self) -> List[Replica]:
@@ -258,7 +256,9 @@ class ClusterRouter:
             dec = [r for r in self.disagg.decode if r.alive]
             if dec:
                 return dec
-        return [r for r in self.replicas if r.alive]
+        with self._cond:
+            pool = list(self.replicas)
+        return [r for r in pool if r.alive]
 
     def _route(self, prompt: List[int]) -> Tuple[Replica, str]:
         """Pick a replica for a NEW prompt or raise :class:`Overloaded`.

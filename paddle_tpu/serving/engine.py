@@ -46,6 +46,7 @@ import jax
 import jax.numpy as jnp
 
 from .. import observability as _obs
+from ..config import knobs as _knobs
 from ..distributed.resilience import faults
 from ..distributed.resilience.retry import call_with_retry, default_policy
 from ..incubate.nn.pallas.paged_attention import quantize_kv_pages
@@ -133,11 +134,6 @@ class RequestError(RuntimeError):
         self.reason = reason
 
 
-def _env_int(name: str, default: int) -> int:
-    v = os.environ.get(name)
-    return int(v) if v else default
-
-
 class EngineConfig:
     """Resolved engine knobs (ctor args win over env vars)."""
 
@@ -148,14 +144,14 @@ class EngineConfig:
         # telemetry source label: access-log records and window
         # snapshots carry it (a Replica passes its replica name)
         self.name = str(name) if name else "engine"
-        self.max_slots = max_slots or _env_int(
-            "PADDLE_TPU_SERVE_SLOTS", 8)
-        self.block_size = block_size or _env_int(
-            "PADDLE_TPU_SERVE_BLOCK_SIZE", 16)
-        self.num_blocks = num_blocks or _env_int(
-            "PADDLE_TPU_SERVE_NUM_BLOCKS", 512)
-        self.prefill_chunk = prefill_chunk or _env_int(
-            "PADDLE_TPU_SERVE_PREFILL_CHUNK", 32)
+        self.max_slots = max_slots or _knobs.get_int(
+            "PADDLE_TPU_SERVE_SLOTS")
+        self.block_size = block_size or _knobs.get_int(
+            "PADDLE_TPU_SERVE_BLOCK_SIZE")
+        self.num_blocks = num_blocks or _knobs.get_int(
+            "PADDLE_TPU_SERVE_NUM_BLOCKS")
+        self.prefill_chunk = prefill_chunk or _knobs.get_int(
+            "PADDLE_TPU_SERVE_PREFILL_CHUNK")
         self.max_seq_len = max_seq_len
         self.kv_quant = kv_quant        # None | "int8"
         self.watermark = watermark
@@ -163,13 +159,13 @@ class EngineConfig:
         self.seed = seed
         # ragged single-dispatch step: auto (-> on) | on | off.  "off"
         # restores the two-program decode+prefill layout byte-for-byte.
-        self.ragged = (ragged or os.environ.get(
-            "PADDLE_TPU_SERVE_RAGGED") or "auto").lower()
+        self.ragged = (ragged or _knobs.get_str(
+            "PADDLE_TPU_SERVE_RAGGED")).lower()
         # token axis of the ragged step: decode rows + prefill chunk
         # tokens packed per step (clamped to >= max_slots in the engine)
-        self.token_budget = token_budget or _env_int(
+        self.token_budget = token_budget or _knobs.get_int(
             "PADDLE_TPU_SERVE_TOKEN_BUDGET",
-            self.max_slots + self.prefill_chunk)
+            default=self.max_slots + self.prefill_chunk)
         if self.kv_quant not in (None, "int8"):
             raise ValueError("kv_quant must be None or 'int8'")
         if self.ragged not in ("auto", "on", "off"):
@@ -750,7 +746,7 @@ class ServingEngine:
                     self.scheduler.num_active() / self.config.max_slots)
             return bool(admitted or worked)
 
-    def _dispatch(self, fn):
+    def _dispatch(self, fn):  # ptlint: holds=_lock
         """Run one jitted step under the resilience machinery: injected
         or real ConnectionError/TimeoutError gets retried with backoff,
         bounded by the nearest per-request deadline."""
@@ -913,7 +909,7 @@ class ServingEngine:
                 req.state = RUNNING
                 self._emit(req, int(nxt))
 
-    def _run_decode(self, running: List[Request]) -> None:
+    def _run_decode(self, running: List[Request]) -> None:  # ptlint: holds=_lock
         cfg = self.config
         S = cfg.max_slots
         toks = np.zeros(S, np.int32)

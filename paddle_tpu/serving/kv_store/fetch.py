@@ -53,6 +53,7 @@ import numpy as np
 
 from ... import observability as _obs
 from ...distributed.control_plane import LocalStore
+from ...config import knobs
 from ...observability.tracing import span
 from ...observability.windows import Windows
 from ..block_manager import hash_block_tokens
@@ -61,13 +62,6 @@ from .host_tier import HostTier
 from .index import HOST_OWNER, GlobalPrefixIndex
 
 __all__ = ["ClusterKVStore", "KVStoreConfig"]
-
-
-def _env_f(name: str, default: float) -> float:
-    try:
-        return float(os.environ.get(name, default))
-    except ValueError:
-        return default
 
 
 class KVStoreConfig:
@@ -80,13 +74,13 @@ class KVStoreConfig:
                  max_demote_queue: int = 256):
         # "off" = global index only (cross-replica fetches still work);
         # "host" adds the host-RAM spill tier
-        self.tier = (tier or os.environ.get("PADDLE_TPU_KV_TIER")
-                     or "host").lower()
+        self.tier = (tier or knobs.get_str("PADDLE_TPU_KV_TIER")
+                     ).lower()
         self.host_mb = host_mb if host_mb is not None else \
-            _env_f("PADDLE_TPU_KV_HOST_MB", 64.0)
+            knobs.get_float("PADDLE_TPU_KV_HOST_MB")
         self.pump_interval_s = pump_interval_s \
             if pump_interval_s is not None \
-            else _env_f("PADDLE_TPU_KV_PUMP_S", 0.02)
+            else knobs.get_float("PADDLE_TPU_KV_PUMP_S")
         self.demote_batch = int(demote_batch)
         self.max_demote_queue = int(max_demote_queue)
         if self.tier not in ("off", "host"):
@@ -115,8 +109,10 @@ class ClusterKVStore:
             store = control_plane.store if control_plane is not None \
                 else LocalStore()
         self.index = GlobalPrefixIndex(store, namespace)
-        self.host = HostTier(self.config.host_mb) \
-            if self.config.tier == "host" else None
+        # HostTier serializes put/get/drop behind its own lock — the
+        # pump thread's put vs the fetch path's get is its contract
+        self.host = HostTier(  # ptlint: disable=thread-escape
+            self.config.host_mb) if self.config.tier == "host" else None
         self._lock = threading.Lock()
         self._replicas: Dict[str, object] = {}  # guarded by: _lock
         self._gens: Dict[str, Optional[int]] = {}  # guarded by: _lock

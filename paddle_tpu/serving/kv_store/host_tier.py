@@ -35,14 +35,9 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 from . import codec
 
+from ...config import knobs
+
 __all__ = ["HostTier", "HostEntry"]
-
-
-def _env_f(name: str, default: float) -> float:
-    try:
-        return float(os.environ.get(name, default))
-    except ValueError:
-        return default
 
 
 class HostEntry:
@@ -66,7 +61,7 @@ class HostTier:
 
     def __init__(self, capacity_mb: Optional[float] = None):
         mb = capacity_mb if capacity_mb is not None else \
-            _env_f("PADDLE_TPU_KV_HOST_MB", 64.0)
+            knobs.get_float("PADDLE_TPU_KV_HOST_MB")
         self.capacity_bytes = int(max(0.0, float(mb)) * 1024 * 1024)
         self._lock = threading.Lock()
         self._entries: "collections.OrderedDict[int, HostEntry]" = \

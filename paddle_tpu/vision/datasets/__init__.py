@@ -16,6 +16,7 @@ import tarfile
 
 import numpy as np
 
+from ...config import knobs
 from ...io import Dataset
 
 __all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "Flowers", "VOC2012"]
@@ -72,8 +73,8 @@ class MNIST(Dataset):
         self.transform = transform
         self._images = None
         self._labels = None
-        data_dir = os.environ.get("PADDLE_TPU_DATA_HOME",
-                                  os.path.expanduser("~/.cache/paddle_tpu"))
+        data_dir = os.path.expanduser(
+            knobs.get_str("PADDLE_TPU_DATA_HOME"))
         prefix = "train" if mode == "train" else "t10k"
         image_path = image_path or os.path.join(
             data_dir, "mnist", f"{prefix}-images-idx3-ubyte.gz")
@@ -84,7 +85,7 @@ class MNIST(Dataset):
             self._labels = self._parse_labels(label_path)
         else:
             n = 60000 if mode == "train" else 10000
-            n = int(os.environ.get("PADDLE_TPU_SYNTH_SAMPLES", n))
+            n = knobs.get_int("PADDLE_TPU_SYNTH_SAMPLES", default=n)
             self._synth = _SyntheticImageDataset(
                 n, (1, 28, 28), 10, transform=None,
                 seed=0 if mode == "train" else 1)
@@ -137,15 +138,15 @@ class Cifar10(Dataset):
         self.mode = mode
         self.transform = transform
         self._data = None
-        data_dir = os.environ.get("PADDLE_TPU_DATA_HOME",
-                                  os.path.expanduser("~/.cache/paddle_tpu"))
+        data_dir = os.path.expanduser(
+            knobs.get_str("PADDLE_TPU_DATA_HOME"))
         data_file = data_file or os.path.join(data_dir,
                                               "cifar-10-python.tar.gz")
         if os.path.exists(data_file):
             self._load(data_file)
         else:
             n = 50000 if mode == "train" else 10000
-            n = int(os.environ.get("PADDLE_TPU_SYNTH_SAMPLES", n))
+            n = knobs.get_int("PADDLE_TPU_SYNTH_SAMPLES", default=n)
             self._synth = _SyntheticImageDataset(
                 n, (3, 32, 32), self.NUM_CLASSES, seed=2)
 
@@ -189,14 +190,14 @@ class Flowers(_SyntheticImageDataset):
     def __init__(self, data_file=None, label_file=None, setid_file=None,
                  mode="train", transform=None, download=True, backend=None):
         n = 6149 if mode == "train" else 1020
-        n = int(os.environ.get("PADDLE_TPU_SYNTH_SAMPLES", n))
+        n = knobs.get_int("PADDLE_TPU_SYNTH_SAMPLES", default=n)
         super().__init__(n, (3, 224, 224), 102, transform=transform, seed=3)
 
 
 class VOC2012(_SyntheticImageDataset):
     def __init__(self, data_file=None, mode="train", transform=None,
                  download=True, backend=None):
-        n = int(os.environ.get("PADDLE_TPU_SYNTH_SAMPLES", 2913))
+        n = knobs.get_int("PADDLE_TPU_SYNTH_SAMPLES", default=2913)
         super().__init__(n, (3, 224, 224), 21, transform=transform, seed=4)
 
 

@@ -8,6 +8,7 @@ ever shrinks.
 """
 import os
 import sys
+import time
 
 import pytest
 
@@ -20,14 +21,30 @@ from tools.ptlint import DEFAULT_BASELINE, DEFAULT_TARGETS, lint  # noqa: E402
 TARGETS = [os.path.join(ROOT, t) for t in DEFAULT_TARGETS]
 
 
-def test_codebase_is_lint_clean():
+# the full clean-tree run takes ~20s on a dev box; the budget is the
+# backstop against a pass going quadratic (cross-module inference over
+# N files × M passes), not a benchmark — it must hold on slow CI too
+LINT_TIME_BUDGET_S = 120.0
+
+
+def test_codebase_is_lint_clean_within_budget():
+    t0 = time.perf_counter()
+    timings = {}
     new, _baselined, _stale = lint(TARGETS, root=ROOT,
-                                   baseline_path=DEFAULT_BASELINE)
+                                   baseline_path=DEFAULT_BASELINE,
+                                   timings=timings)
+    elapsed = time.perf_counter() - t0
     assert new == [], (
         "%d non-baselined ptlint finding(s) — fix them, suppress with "
         "a justified `# ptlint: disable=<rule>`, or (for pre-existing "
         "debt only) add to tools/ptlint/baseline.json:\n%s"
         % (len(new), "\n".join(str(f) for f in new)))
+    assert elapsed < LINT_TIME_BUDGET_S, (
+        "full clean-tree lint took %.1fs (budget %.0fs) — a pass "
+        "regressed; per-pass wall-time:\n%s"
+        % (elapsed, LINT_TIME_BUDGET_S,
+           "\n".join("  %-24s %7.3fs" % (k, v) for k, v in
+                     sorted(timings.items(), key=lambda kv: -kv[1]))))
 
 
 @pytest.mark.slow

@@ -719,7 +719,8 @@ def test_manifest_complete():
     assert not missing, (
         f"{len(missing)} public ops have no test coverage entry: "
         f"{missing}")
-    if os.environ.get("PADDLE_TPU_WRITE_MANIFEST"):
+    from paddle_tpu.config import knobs as _knobs
+    if _knobs.get_bool("PADDLE_TPU_WRITE_MANIFEST"):
         out = os.path.join(os.path.dirname(__file__),
                            "op_coverage_manifest.json")
         with open(out, "w") as f:

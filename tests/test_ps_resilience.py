@@ -297,3 +297,36 @@ def test_psfailover_is_typed():
     e = PSFailover(3, old_primary=1, new_primary=2, reason="x")
     assert isinstance(e, RuntimeError)
     assert (e.shard, e.old_primary, e.new_primary) == (3, 1, 2)
+
+
+# ----------------------------------------- fault-site registry drills
+def test_pull_retry_under_transient_drop_fault():
+    """``ps.pull`` drill: the first worker-side sharded pull attempt is
+    dropped on the wire, the shared retry policy re-sends, and the
+    result is bit-equal to the fault-free pull."""
+    srv = PSServer(0, n_servers=1)
+    srv.add_sparse_table(0, 4, optimizer="sgd", lr=0.1)
+    w = _worker(1)
+    w.push_sparse(0, [1, 2], np.ones((2, 4), np.float32))
+    clean = w.pull_sparse(0, [1, 2], dim=4)
+    faults.configure("ps.pull:drop@1")
+    out = w.pull_sparse(0, [1, 2], dim=4)
+    assert len(faults.injected()) == 1
+    np.testing.assert_array_equal(out, clean)
+    srv.shutdown_local()
+
+
+def test_server_handler_drop_is_retried():
+    """``ps.server`` drill: the handler-entry gate drops the first
+    request (the serving shard looks momentarily dead), the worker's
+    retry re-sends, and the second attempt serves normally."""
+    srv = PSServer(0, n_servers=1)
+    srv.add_sparse_table(0, 4, optimizer="sgd", lr=0.1)
+    w = _worker(1)
+    w.push_sparse(0, [3], np.ones((1, 4), np.float32))
+    clean = w.pull_sparse(0, [3], dim=4)
+    faults.configure("ps.server:drop@1")
+    out = w.pull_sparse(0, [3], dim=4)
+    assert len(faults.injected()) == 1
+    np.testing.assert_array_equal(out, clean)
+    srv.shutdown_local()

@@ -659,3 +659,477 @@ def test_parse_error_is_reported(tmp_path):
     p.write_text("def f(:\n")
     new, _, _ = lint([str(p)], root=str(tmp_path), baseline_path=None)
     assert any(f.rule == "parse-error" for f in new)
+
+
+# =====================================================================
+# protocol passes (PR 20): per-rule good/bad fixtures
+# =====================================================================
+
+# minimal stand-ins for the stdlib-only registries the protocol passes
+# importlib-load from the lint root
+_KEYSPACE_SRC = """\
+    from typing import NamedTuple, Tuple
+
+    class KeyNamespace(NamedTuple):
+        name: str
+        pattern: Tuple[str, ...]
+        deletable: bool
+        fenced: bool
+        doc: str
+
+    NAMESPACES = (
+        KeyNamespace("beat", ("<ns>", "beat", "<member>"), True, True,
+                     "heartbeat doc"),
+        KeyNamespace("left", ("<ns>", "left", "<member>"), True, False,
+                     "clean-leave marker"),
+    )
+    HELPERS = frozenset(n.name for n in NAMESPACES)
+
+    def beat(ns, member):
+        return "%s/beat/%s" % (ns, member)
+
+    def left(ns, member):
+        return "%s/left/%s" % (ns, member)
+
+    def check_collisions():
+        return []
+    """
+
+_FAULT_SITES_SRC = """\
+    from typing import NamedTuple
+
+    class Site(NamedTuple):
+        name: str
+        subsystem: str
+        doc: str
+
+    SITES = {"cp.lease": Site("cp.lease", "cp", "one lease write")}
+    """
+
+_KNOBS_SRC = """\
+    from typing import Any, NamedTuple
+
+    class Knob(NamedTuple):
+        name: str
+        type: str
+        default: Any
+        subsystem: str
+        doc: str
+
+    KNOBS = (Knob("PADDLE_TPU_FOO", "int", 1, "test", "a knob"),)
+
+    def iter_knobs():
+        return KNOBS
+    """
+
+_KEYSPACE_REL = "paddle_tpu/distributed/control_plane/keyspace.py"
+_FAULT_SITES_REL = "paddle_tpu/distributed/resilience/fault_sites.py"
+_KNOBS_REL = "paddle_tpu/config/knobs.py"
+
+
+# --------------------------------------------------------- thread-escape
+BAD_THREAD_ESCAPE = """\
+    import threading
+
+    class Pump:
+        def __init__(self):
+            self.items = []
+            self._t = threading.Thread(target=self._loop, daemon=True)
+            self._t.start()
+
+        def _loop(self):
+            while True:
+                self.items.append(1)
+
+        def drain(self):
+            out = list(self.items)
+            self.items.clear()
+            return out
+    """
+
+GOOD_THREAD_ESCAPE = """\
+    import threading
+
+    class Pump:
+        def __init__(self):
+            self.items = []
+            self._lock = threading.Lock()
+            self._t = threading.Thread(target=self._loop, daemon=True)
+            self._t.start()
+
+        def _loop(self):
+            while True:
+                with self._lock:
+                    self.items.append(1)
+
+        def drain(self):
+            with self._lock:
+                out = list(self.items)
+                self.items.clear()
+            return out
+    """
+
+
+def test_thread_escape_bad(tmp_path):
+    new = _lint(tmp_path, {"mod.py": BAD_THREAD_ESCAPE},
+                select=["thread-escape"])
+    assert _rules(new) == ["thread-escape"]
+    assert any("items" in f.message for f in new)
+
+
+def test_thread_escape_good(tmp_path):
+    assert _lint(tmp_path, {"mod.py": GOOD_THREAD_ESCAPE},
+                 select=["thread-escape"]) == []
+
+
+# ----------------------------------------------------------- store-keys
+BAD_STORE_KEYS = """\
+    class Membership:
+        def __init__(self, store, ns):
+            self.store = store
+            self.ns = ns
+
+        def beat_key(self, rank):
+            return f"{self.ns}/beat/{rank}"
+
+        def mark_left(self, rank):
+            self.store.set(f"{self.ns}/left/{rank}", b"1")
+    """
+
+GOOD_STORE_KEYS = """\
+    from ..control_plane import keyspace as ks
+
+    class Membership:
+        def __init__(self, store, ns):
+            self.store = store
+            self.ns = ns
+
+        def mark_left(self, rank):
+            self.store.set(ks.left(self.ns, rank), b"1")
+    """
+
+
+def test_store_keys_bad(tmp_path):
+    new = _lint(
+        tmp_path,
+        {_KEYSPACE_REL: _KEYSPACE_SRC,
+         "paddle_tpu/distributed/elastic/member.py": BAD_STORE_KEYS},
+        select=["store-keys"])
+    assert _rules(new) == ["store-keys"]
+    # both the inline key at the store op and the shadow builder
+    assert len(new) >= 2
+
+
+def test_store_keys_good(tmp_path):
+    assert _lint(
+        tmp_path,
+        {_KEYSPACE_REL: _KEYSPACE_SRC,
+         "paddle_tpu/distributed/elastic/member.py": GOOD_STORE_KEYS},
+        select=["store-keys"]) == []
+
+
+def test_store_keys_out_of_scope_file_ignored(tmp_path):
+    # rendezvous/bootstrap tiers are deliberately out of scope
+    assert _lint(
+        tmp_path,
+        {_KEYSPACE_REL: _KEYSPACE_SRC,
+         "paddle_tpu/distributed/rendezvous.py": BAD_STORE_KEYS},
+        select=["store-keys"]) == []
+
+
+# ----------------------------------------------------- fence-discipline
+BAD_FENCE = """\
+    import json
+    from . import keyspace as ks
+
+    class LeaseTable:
+        def __init__(self, store, ns):
+            self.store = store
+            self.ns = ns
+
+        def write_beat(self, member):
+            payload = {"t": 1.0}
+            self.store.set(ks.beat(self.ns, member),
+                           json.dumps(payload).encode())
+
+        def read_left(self, member):
+            return self.store.get(ks.left(self.ns, member))
+    """
+
+GOOD_FENCE = """\
+    import json
+    from . import keyspace as ks
+    from .store_util import try_get
+
+    class LeaseTable:
+        def __init__(self, store, ns):
+            self.store = store
+            self.ns = ns
+
+        def write_beat(self, member, gen):
+            payload = {"t": 1.0, "gen": gen}
+            self.store.set(ks.beat(self.ns, member),
+                           json.dumps(payload).encode())
+
+        def read_left(self, member):
+            return try_get(self.store, ks.left(self.ns, member))
+    """
+
+
+def test_fence_discipline_bad(tmp_path):
+    new = _lint(
+        tmp_path,
+        {_KEYSPACE_REL: _KEYSPACE_SRC,
+         "paddle_tpu/distributed/control_plane/lease.py": BAD_FENCE},
+        select=["fence-discipline"])
+    assert _rules(new) == ["fence-discipline"]
+    msgs = " ".join(f.message for f in new)
+    assert "gen" in msgs          # unfenced write on the beat namespace
+    assert "try_get" in msgs      # raw get on a deletable namespace
+
+
+def test_fence_discipline_good(tmp_path):
+    assert _lint(
+        tmp_path,
+        {_KEYSPACE_REL: _KEYSPACE_SRC,
+         "paddle_tpu/distributed/control_plane/lease.py": GOOD_FENCE},
+        select=["fence-discipline"]) == []
+
+
+# ---------------------------------------------------------- fault-sites
+BAD_FAULT_SITES = """\
+    from ..resilience import faults
+
+    def lease_write(store, key, doc):
+        act = faults.check("cp.laese")
+        if act is not None:
+            faults.apply(act)
+        store.set(key, doc)
+    """
+
+GOOD_FAULT_SITES = """\
+    from ..resilience import faults
+
+    def lease_write(store, key, doc):
+        act = faults.check("cp.lease")
+        if act is not None:
+            faults.apply(act)
+        store.set(key, doc)
+    """
+
+_DRILL_TEST_SRC = """\
+    def test_lease_drop_drill():
+        # exercises the cp.lease site: "cp.lease:drop@1"
+        pass
+    """
+
+
+def test_fault_sites_bad_typo(tmp_path):
+    new = _lint(
+        tmp_path,
+        {_FAULT_SITES_REL: _FAULT_SITES_SRC,
+         "tests/test_drill.py": _DRILL_TEST_SRC,
+         "paddle_tpu/distributed/control_plane/lease.py":
+             BAD_FAULT_SITES},
+        select=["fault-sites"])
+    assert any("cp.laese" in f.message for f in new)
+
+
+def test_fault_sites_bad_untested_site(tmp_path):
+    # declared site, no tests/ reference -> dead registry row
+    new = _lint(
+        tmp_path,
+        {_FAULT_SITES_REL: _FAULT_SITES_SRC,
+         "paddle_tpu/distributed/control_plane/lease.py":
+             GOOD_FAULT_SITES},
+        select=["fault-sites"])
+    assert any("referenced by no test" in f.message for f in new)
+
+
+def test_fault_sites_good(tmp_path):
+    assert _lint(
+        tmp_path,
+        {_FAULT_SITES_REL: _FAULT_SITES_SRC,
+         "tests/test_drill.py": _DRILL_TEST_SRC,
+         "paddle_tpu/distributed/control_plane/lease.py":
+             GOOD_FAULT_SITES},
+        select=["fault-sites"]) == []
+
+
+# ------------------------------------------------------------ env-knobs
+BAD_ENV_KNOBS = """\
+    import os
+    from ..config import knobs
+
+    def tier():
+        raw = os.environ.get(
+            "PADDLE_TPU_FOO")
+        typo = knobs.get_int("PADDLE_TPU_TYPO")
+        return raw, typo
+    """
+
+GOOD_ENV_KNOBS = """\
+    from ..config import knobs
+
+    def tier():
+        return knobs.get_int("PADDLE_TPU_FOO")
+    """
+
+
+def test_env_knobs_bad(tmp_path):
+    new = _lint(
+        tmp_path,
+        {_KNOBS_REL: _KNOBS_SRC,
+         "paddle_tpu/serving/tiers.py": BAD_ENV_KNOBS},
+        select=["env-knobs"])
+    msgs = " ".join(f.message for f in new)
+    assert "raw environment read" in msgs
+    assert "PADDLE_TPU_TYPO" in msgs
+
+
+def test_env_knobs_good(tmp_path):
+    assert _lint(
+        tmp_path,
+        {_KNOBS_REL: _KNOBS_SRC,
+         "paddle_tpu/serving/tiers.py": GOOD_ENV_KNOBS},
+        select=["env-knobs"]) == []
+
+
+def test_env_knobs_dead_row(tmp_path):
+    # declared but never read anywhere -> finding on the registry
+    new = _lint(
+        tmp_path,
+        {_KNOBS_REL: _KNOBS_SRC,
+         "paddle_tpu/serving/tiers.py": "X = 1\n"},
+        select=["env-knobs"])
+    assert any("never read" in f.message for f in new)
+
+
+# ------------------------------- metric-names: schema-derived namespaces
+_SCHEMA_NS_SRC = """\
+    from typing import NamedTuple, Optional, Tuple
+
+    class MetricSpec(NamedTuple):
+        kind: str
+        unit: str
+        desc: str
+        buckets: Optional[Tuple[float, ...]] = None
+        tags: Tuple[str, ...] = ()
+
+    class NamespaceSpec(NamedTuple):
+        doc: str
+        require_used: bool = True
+
+    NAMESPACES = {
+        "train": NamespaceSpec("training", require_used=False),
+        "serving": NamespaceSpec("serving"),
+    }
+    METRICS = {
+        "train.steps": MetricSpec("counter", "steps", "steps run"),
+        "serving.requests": MetricSpec("counter", "reqs", "requests"),
+        "typo.rows": MetricSpec("counter", "rows", "bad namespace"),
+    }
+    SPANS = {}
+    """
+
+
+def test_metric_names_namespace_table(tmp_path):
+    new = _lint(
+        tmp_path,
+        {"paddle_tpu/observability/metrics_schema.py": _SCHEMA_NS_SRC,
+         "mod.py": "X = 1\n"},
+        select=["metric-names"])
+    msgs = " ".join(f.message for f in new)
+    # require_used namespace with a dead row -> finding; the
+    # require_used=False namespace is declaration-only
+    assert "serving.requests" in msgs
+    assert "train.steps" not in msgs
+    # a key whose namespace is missing from NAMESPACES -> finding
+    assert "typo" in msgs
+
+
+# ------------------------- lock-discipline: stale-annotation detection
+STALE_GUARDED_BY = """\
+    import threading
+
+    class T:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._tasks = {}  # guarded by: _mu
+
+        def get(self, k):
+            with self._lock:
+                return self._tasks.get(k)
+    """
+
+STALE_HOLDS = """\
+    import threading
+
+    class T:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._tasks = {}  # guarded by: _lock
+
+        def _emit(self, k):  # ptlint: holds=_mu
+            return self._tasks.get(k)
+    """
+
+
+def test_lock_discipline_stale_guarded_by(tmp_path):
+    new = _lint(tmp_path, {"mod.py": STALE_GUARDED_BY},
+                select=["lock-discipline"])
+    assert any("stale" in f.message and "_mu" in f.message
+               for f in new)
+
+
+def test_lock_discipline_stale_holds(tmp_path):
+    new = _lint(tmp_path, {"mod.py": STALE_HOLDS},
+                select=["lock-discipline"])
+    assert any("stale holds" in f.message for f in new)
+
+
+# -------------------- property: holds= chains never false-positive
+def test_holds_chains_never_flag_thread_escape(tmp_path):
+    """Randomized property: a field only ever touched under the lock —
+    lexically in the thread entry, via `# ptlint: holds=` declarations
+    down arbitrary helper chains on the unthreaded side — must never
+    be a thread-escape finding, whatever the chain shape."""
+    import random
+
+    rng = random.Random(0xA11CE)
+    for trial in range(25):
+        depth = rng.randint(1, 5)
+        n_fields = rng.randint(1, 3)
+        fields = [f"f{i}" for i in range(n_fields)]
+        lines = ["import threading", "", "class C:",
+                 "    def __init__(self):"]
+        for f in fields:
+            lines.append(f"        self.{f} = []")
+        lines += ["        self._lock = threading.Lock()",
+                  "        self._t = threading.Thread("
+                  "target=self._loop, daemon=True)",
+                  "        self._t.start()",
+                  "",
+                  "    def _loop(self):",
+                  "        while True:",
+                  "            with self._lock:"]
+        for f in fields:
+            lines.append(f"                self.{f}.append(1)")
+        # unthreaded side: public() takes the lock, then a chain of
+        # helpers each declaring holds=_lock; the deepest one mutates
+        lines += ["", "    def public(self):",
+                  "        with self._lock:",
+                  "            self._h0()"]
+        for d in range(depth):
+            call = (f"self._h{d + 1}()" if d + 1 < depth else
+                    "; ".join(f"self.{f}.append(2)" for f in fields))
+            lines += ["", f"    def _h{d}(self):  "
+                          "# ptlint: holds=_lock",
+                      f"        {call}"]
+        src = "\n".join(lines) + "\n"
+        new = _lint(tmp_path / f"t{trial}", {"mod.py": src},
+                    select=["thread-escape"])
+        assert new == [], (
+            f"trial {trial} (depth={depth}, fields={n_fields}) "
+            "produced false positives:\n"
+            + "\n".join(str(f) for f in new) + "\n---\n" + src)

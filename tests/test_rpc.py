@@ -52,3 +52,34 @@ def test_rpc_two_workers():
     from paddle_tpu.distributed.spawn import spawn
 
     spawn(_rpc_worker, nprocs=2)
+
+
+def _resend_worker():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from paddle_tpu import observability as obs
+    from paddle_tpu.distributed import rpc
+    from paddle_tpu.distributed.resilience import faults
+
+    obs.enable()
+    rpc.init_rpc("worker0")
+    # ``rpc.resend`` drill: the first request post is silently lost in
+    # transit; the retransmit schedule re-posts it on backoff and the
+    # server dedups by call_id, so the call completes exactly once.
+    faults.configure("rpc.post:drop@1")
+    try:
+        assert rpc.rpc_sync("worker0", _sq, args=(5,), timeout=30.0) == 25
+        assert len(faults.injected()) == 1
+        resends = obs.registry.counter(
+            "resilience.retries", tags={"site": "rpc.resend"}).value
+        assert resends >= 1
+    finally:
+        faults.reset()
+        rpc.shutdown()
+
+
+def test_rpc_resend_recovers_lost_request():
+    from paddle_tpu.distributed.spawn import spawn
+
+    spawn(_resend_worker, nprocs=1)

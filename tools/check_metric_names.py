@@ -25,7 +25,8 @@ from tools.ptlint.passes import metric_names as _impl  # noqa: E402
 # legacy names, re-exported for callers that reached into the module
 _KIND = _impl._KIND
 _SKIP_DIRS = _impl._SKIP_DIRS
-_REQUIRE_USED = _impl.REQUIRE_USED
+_REQUIRE_USED = _impl.require_used_prefixes(
+    _impl.load_namespaces(_REPO_ROOT))
 _iter_py_files = _impl.iter_canonical_files
 _call_kind = _impl._call_kind
 _is_span_call = _impl._is_span_call
@@ -53,7 +54,9 @@ def run(root: str) -> list:
     used: set = set()
     for path in _iter_py_files(root):
         check_file(path, metrics, errors, spans=spans, used=used)
-    for _kind, msg in _impl.reverse_findings(root, metrics, spans, used):
+    for _kind, msg in _impl.reverse_findings(
+            root, metrics, spans, used,
+            namespaces=_impl.load_namespaces(root)):
         errors.append(f"metrics_schema.py: {msg}")
     return errors
 

@@ -49,7 +49,7 @@ BUNDLE_FILES = ("env.json", "flight_recorder.jsonl", "metrics.json",
                 "comm_tasks.json", "trace.json",
                 "request_log_tail.jsonl", "slo_windows.json",
                 "profiler_report.json", "compile_ledger.json",
-                "control_plane.json")
+                "control_plane.json", "protocol_lint.json")
 
 
 def _load_json(path):
@@ -403,6 +403,27 @@ def _show_kv(d: str):
               "transit — those blocks were recomputed, check RAM")
 
 
+def _show_protocol_lint(d):
+    fp = _load_json(os.path.join(d, "protocol_lint.json"))
+    if not fp:
+        return
+    _section("protocol lint (contract fingerprint of the crashed tree)")
+    print(f"  fingerprint: {fp.get('fingerprint', '?')}  "
+          f"(baseline: {fp.get('baseline_findings', '?')} "
+          "grandfathered)")
+    regs = fp.get("registries") or {}
+    if regs:
+        print("  registries : "
+              + "  ".join(f"{k}={v}" for k, v in sorted(regs.items())))
+    rules = fp.get("rules") or []
+    if rules:
+        print(f"  rules      : {len(rules)} — {', '.join(rules)}")
+    print("  compare with the current tree: "
+          "python tools/lint_all.py --json | "
+          "python -c \"import json,sys; "
+          "print(json.load(sys.stdin)['protocol_lint'])\"")
+
+
 def main(argv) -> int:
     if len(argv) != 2 or argv[1] in ("-h", "--help"):
         print(__doc__)
@@ -423,6 +444,7 @@ def main(argv) -> int:
     _show_compiles(bundle)
     _show_control_plane(bundle)
     _show_kv(bundle)
+    _show_protocol_lint(bundle)
     print()
     return 0
 

@@ -4,7 +4,13 @@
 Equivalent to ``python -m tools.ptlint`` with the default targets, plus
 a stale-baseline sweep, so CI and humans need exactly one command::
 
-    python tools/lint_all.py [--json]
+    python tools/lint_all.py [--json] [--times] [--changed]
+
+``--changed`` scopes the run to files touched vs git (unstaged, staged,
+and untracked) — the fast pre-commit loop; cross-file rules still see
+only the changed set, so the full run remains the gate of record.
+``--times`` reports per-pass wall-clock so a pass that regresses the
+lint budget is attributable.
 
 Exit codes follow ptlint: 0 clean, 1 findings or stale baseline
 entries, 2 usage/internal error.
@@ -14,6 +20,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -21,7 +28,27 @@ if _REPO_ROOT not in sys.path:
     sys.path.insert(0, _REPO_ROOT)
 
 from tools.ptlint import (DEFAULT_BASELINE, DEFAULT_TARGETS,  # noqa: E402
-                          REPO_ROOT, lint)
+                          REPO_ROOT, lint, protocol_fingerprint)
+
+
+def _changed_files(root: str) -> list:
+    """Repo-relative .py paths touched vs git, restricted to the
+    canonical lint targets."""
+    rels = set()
+    for cmd in (["git", "diff", "--name-only", "HEAD"],
+                ["git", "diff", "--name-only", "--cached"],
+                ["git", "ls-files", "--others", "--exclude-standard"]):
+        out = subprocess.run(cmd, cwd=root, capture_output=True,
+                             text=True, check=True).stdout
+        rels.update(p.strip() for p in out.splitlines() if p.strip())
+
+    def in_targets(rel: str) -> bool:
+        return any(rel == t or rel.startswith(t.rstrip("/") + "/")
+                   for t in DEFAULT_TARGETS)
+
+    return sorted(os.path.join(root, r) for r in rels
+                  if r.endswith(".py") and in_targets(r)
+                  and os.path.exists(os.path.join(root, r)))
 
 
 def main(argv=None) -> int:
@@ -31,30 +58,79 @@ def main(argv=None) -> int:
                     % " ".join(DEFAULT_TARGETS))
     ap.add_argument("--json", action="store_true",
                     help="machine-readable JSON report on stdout")
+    ap.add_argument("--times", action="store_true",
+                    help="report per-pass wall-clock seconds")
+    ap.add_argument("--changed", action="store_true",
+                    help="lint only files changed vs git (unstaged + "
+                         "staged + untracked) inside the default "
+                         "targets")
     args = ap.parse_args(argv)
 
-    targets = [os.path.join(REPO_ROOT, t) for t in DEFAULT_TARGETS]
+    if args.changed:
+        try:
+            targets = _changed_files(REPO_ROOT)
+        except (OSError, subprocess.CalledProcessError) as e:
+            print(f"lint_all: error: git file selection failed: {e}",
+                  file=sys.stderr)
+            return 2
+        if not targets:
+            if args.json:
+                print(json.dumps({"findings": [], "baselined": [],
+                                  "stale_baseline": [], "timings": {},
+                                  "changed_files": [],
+                                  "protocol_lint":
+                                      protocol_fingerprint(REPO_ROOT)},
+                                 indent=1))
+            else:
+                print("lint_all: no changed files under "
+                      + " ".join(DEFAULT_TARGETS))
+            return 0
+    else:
+        targets = [os.path.join(REPO_ROOT, t) for t in DEFAULT_TARGETS]
+
+    timings: dict = {}
     try:
         new, baselined, stale = lint(targets, root=REPO_ROOT,
-                                     baseline_path=DEFAULT_BASELINE)
+                                     baseline_path=DEFAULT_BASELINE,
+                                     timings=timings)
     except Exception as e:  # UsageError / unreadable baseline
         print(f"lint_all: error: {e}", file=sys.stderr)
         return 2
+    # a --changed run sees a subset of the tree: baseline entries for
+    # unlinted files would all look stale, so don't report staleness
+    if args.changed:
+        stale = []
 
     if args.json:
-        print(json.dumps({
+        report = {
             "findings": [f.to_dict() for f in new],
             "baselined": [f.to_dict() for f in baselined],
-            "stale_baseline": stale}, indent=1))
+            "stale_baseline": stale,
+            "timings": {k: round(v, 4)
+                        for k, v in sorted(timings.items())},
+            "protocol_lint": protocol_fingerprint(REPO_ROOT)}
+        if args.changed:
+            report["changed_files"] = [os.path.relpath(t, REPO_ROOT)
+                                       for t in targets]
+        print(json.dumps(report, indent=1))
     else:
         for f in new:
             print(str(f))
         for e in stale:
             print("stale baseline entry (no longer found): "
                   f"[{e['rule']}] {e['path']}: {e['message']}")
+        if args.times:
+            width = max(len(k) for k in timings) if timings else 0
+            for k, v in sorted(timings.items(),
+                               key=lambda kv: -kv[1]):
+                print(f"  {k:<{width}s} {v:8.3f}s")
+            print(f"  {'total':<{width}s} "
+                  f"{sum(timings.values()):8.3f}s")
+        scope = (f"{len(targets)} changed file(s)" if args.changed
+                 else "full tree")
         print(f"lint_all: {len(new)} finding(s), {len(baselined)} "
               f"baselined, {len(stale)} stale baseline entr"
-              f"{'y' if len(stale) == 1 else 'ies'}",
+              f"{'y' if len(stale) == 1 else 'ies'} ({scope})",
               file=sys.stderr if (new or stale) else sys.stdout)
     return 1 if (new or stale) else 0
 

@@ -54,8 +54,24 @@ import re
 import sys
 from typing import List, Optional, Tuple
 
-DEFAULT_NOISE = float(os.environ.get("PADDLE_TPU_PERFDIFF_NOISE",
-                                     "0.10"))
+def _default_noise() -> float:
+    """PADDLE_TPU_PERFDIFF_NOISE via the knob registry, loaded by file
+    path (importing the paddle_tpu package would pull in jax — this
+    tool stays stdlib-only and runs wherever the JSONs were copied)."""
+    import importlib.util
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        os.pardir, "paddle_tpu", "config", "knobs.py")
+    try:
+        spec = importlib.util.spec_from_file_location("_pt_knobs", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod.get_float("PADDLE_TPU_PERFDIFF_NOISE")
+    except Exception:
+        return 0.10
+
+
+DEFAULT_NOISE = _default_noise()
 # segments must sum to the measured wall within this relative slack
 INVARIANT_TOL = 0.01
 

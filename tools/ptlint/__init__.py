@@ -16,8 +16,9 @@ Run: ``python -m tools.ptlint paddle_tpu/ tools/ bench.py``
 from .engine import (DEFAULT_BASELINE, DEFAULT_TARGETS, REPO_ROOT,
                      Finding, Pass, SourceFile, apply_baseline,
                      collect_files, lint, load_baseline, main,
-                     run_passes)
+                     protocol_fingerprint, run_passes)
 
 __all__ = ["Finding", "Pass", "SourceFile", "collect_files",
            "run_passes", "load_baseline", "apply_baseline", "lint",
-           "main", "REPO_ROOT", "DEFAULT_BASELINE", "DEFAULT_TARGETS"]
+           "main", "protocol_fingerprint", "REPO_ROOT",
+           "DEFAULT_BASELINE", "DEFAULT_TARGETS"]

@@ -1,0 +1,42 @@
+"""Standalone loading of the stdlib-only registry modules.
+
+The protocol passes validate against declared schemas
+(``control_plane/keyspace.py``, ``resilience/fault_sites.py``,
+``config/knobs.py``). Like ``metrics_schema``, those modules are
+stdlib-only by contract, so the lint loads them by file path — never
+through ``import paddle_tpu`` (which would drag jax into every lint
+run and into environments that don't have it).
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+
+KEYSPACE_RELPATH = \
+    "paddle_tpu/distributed/control_plane/keyspace.py"
+FAULT_SITES_RELPATH = \
+    "paddle_tpu/distributed/resilience/fault_sites.py"
+KNOBS_RELPATH = "paddle_tpu/config/knobs.py"
+
+
+def load_by_path(root: str, relpath: str, modname: str):
+    """Exec one stdlib-only module standalone; None when absent."""
+    path = os.path.join(root, relpath)
+    if not os.path.exists(path):
+        return None
+    spec = importlib.util.spec_from_file_location(modname, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def load_keyspace(root: str):
+    return load_by_path(root, KEYSPACE_RELPATH, "_pt_keyspace")
+
+
+def load_fault_sites(root: str):
+    return load_by_path(root, FAULT_SITES_RELPATH, "_pt_fault_sites")
+
+
+def load_knobs(root: str):
+    return load_by_path(root, KNOBS_RELPATH, "_pt_knobs")

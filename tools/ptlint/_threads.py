@@ -1,0 +1,179 @@
+"""Cross-module thread-reachability: which defs run OFF the
+constructing thread.
+
+Used by the thread-escape pass (and reusable by future concurrency
+passes). A function/method is considered *threaded* when:
+
+* it is the ``target=`` of a ``threading.Thread(...)`` construction
+  (``Thread(target=self._beat_loop)``, ``Thread(target=loop)``), or
+* it escapes as a callback value — assigned onto another object
+  (``replica.on_death = self._on_death``) or passed to a known
+  registrar call (``set_hooks(on_evict=self._cb)``,
+  ``emergency.register_abort(self._abort)``, ...) whose stored hooks
+  fire from other threads, or
+* it is lexically nested inside a threaded def (thread-loop bodies,
+  closure helpers), or
+* it is called (bare name / ``self.X`` / imported name) from a
+  threaded def, transitively — the same shadowing-aware resolution
+  :mod:`tools.ptlint._jitreach` uses for jit roots.
+
+Everything NOT in the threaded closure is assumed callable from the
+constructing/main thread (public API, test drivers); a def that is in
+the closure but is not itself an entry may ALSO run unthreaded if some
+unthreaded def calls it — :func:`thread_model` exposes both sets so a
+pass can detect dual-context access.
+
+Same caveat as ``_jitreach``: this is a lint heuristic tuned for a
+near-zero false-positive rate, not a soundness proof — dynamic
+dispatch and call-by-value function arguments are invisible.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Sequence, Set, Tuple
+
+from ._jitreach import (_DEFS, _call_edges, _local_bindings,
+                        _resolve_local, _scan_file, dotted)
+
+# constructors whose first-class callable arg runs on a new thread
+_THREAD_LAST = {"Thread", "Timer"}
+# registrar calls whose callable args become hooks fired from other
+# threads (matched on the LAST dotted segment of the call target)
+_REGISTRAR_LAST = {"set_hooks", "set_kv_hooks", "register",
+                   "register_abort", "install_excepthook",
+                   "add_done_callback"}
+
+
+def _last(dot) -> str:
+    return dot.rsplit(".", 1)[-1] if dot else ""
+
+
+class ThreadModel:
+    """Per-file threaded/unthreaded def sets over the analyzed tree."""
+
+    def __init__(self):
+        # relpath -> defs that may run off the constructing thread
+        self.threaded: Dict[str, Set[ast.AST]] = {}
+        # relpath -> defs that may (also) run ON it
+        self.unthreaded: Dict[str, Set[ast.AST]] = {}
+        # def node -> short reason it became a thread entry
+        self.entry_reason: Dict[ast.AST, str] = {}
+
+    def is_threaded(self, relpath: str, fn: ast.AST) -> bool:
+        return fn in self.threaded.get(relpath, ())
+
+    def is_unthreaded(self, relpath: str, fn: ast.AST) -> bool:
+        return fn in self.unthreaded.get(relpath, ())
+
+
+def _thread_entries(info) -> List[Tuple[ast.AST, str]]:
+    """(def node, reason) thread entries declared in one file."""
+    out: List[Tuple[ast.AST, str]] = []
+
+    def visit(node, stack):
+        if isinstance(node, _DEFS):
+            stack = stack + [node]
+        elif isinstance(node, ast.Call):
+            last = _last(dotted(node.func))
+            if last in _THREAD_LAST:
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        for fn in _resolve_target(info, kw.value, stack):
+                            out.append((fn, "threading.%s target" % last))
+            elif last in _REGISTRAR_LAST:
+                for a in list(node.args) + [kw.value
+                                            for kw in node.keywords]:
+                    for fn in _resolve_target(info, a, stack):
+                        out.append((fn, "hook registered via %s()"
+                                    % last))
+        elif isinstance(node, ast.Assign):
+            # obj.hook = self._cb / obj.hook = local_fn — the stored
+            # callable fires from whatever thread drives obj
+            if any(isinstance(t, ast.Attribute) and not (
+                    isinstance(t.value, ast.Name) and
+                    t.value.id == "self")
+                   for t in node.targets):
+                for fn in _resolve_target(info, node.value, stack):
+                    out.append((fn, "callback stored on another object"))
+        for child in ast.iter_child_nodes(node):
+            visit(child, stack)
+
+    visit(info.tree, [])
+    return out
+
+
+def _resolve_target(info, node: ast.AST, stack) -> List[ast.AST]:
+    """Defs a callable-valued expression may name (shadowing-aware)."""
+    if isinstance(node, ast.Name) and any(
+            node.id in _local_bindings(d) for d in stack):
+        # a local variable (param/assignment) shadows any same-named
+        # def — except when it IS one of the enclosing defs' nested
+        # defs (def loop(): ...; Thread(target=loop) binds `loop`
+        # locally too, and that is exactly the case we must catch)
+        for d in stack:
+            for child in ast.walk(d):
+                if child is not d and isinstance(child, _DEFS) and \
+                        child.name == node.id:
+                    return [child]
+        return []
+    return _resolve_local(info, node)
+
+
+def thread_model(files: Sequence) -> ThreadModel:
+    """Build the threaded/unthreaded closure over ptlint SourceFiles."""
+    known = {f.relpath for f in files if f.tree is not None}
+    infos = {}
+    for f in files:
+        if f.tree is not None:
+            infos[f.relpath] = _scan_file(f.relpath, f.tree, known)
+
+    model = ThreadModel()
+    model.threaded = {rel: set() for rel in infos}
+    model.unthreaded = {rel: set() for rel in infos}
+
+    entries: List[Tuple[str, ast.AST]] = []
+    for rel, info in infos.items():
+        for fn, reason in _thread_entries(info):
+            entries.append((rel, fn))
+            model.entry_reason.setdefault(fn, reason)
+
+    # threaded closure: entries + nested defs + transitive callees
+    work = list(entries)
+    while work:
+        rel, fn = work.pop()
+        if fn in model.threaded[rel]:
+            continue
+        model.threaded[rel].add(fn)
+        info = infos[rel]
+        for child in info.children.get(fn, ()):
+            work.append((rel, child))
+        for edge in _call_edges(info, fn, infos):
+            work.append(edge)
+
+    # unthreaded closure: every def that is not a thread ENTRY (and not
+    # nested inside one) may be invoked synchronously; their callees
+    # may too. A helper ONLY called from threaded defs never gets an
+    # unthreaded root pointing at it, so it stays threaded-only.
+    entry_defs = {fn for _, fn in entries}
+    nested_in_entry: Set[ast.AST] = set()
+    for rel, info in infos.items():
+        for fn in entry_defs:
+            for child in info.children.get(fn, ()):
+                nested_in_entry.add(child)
+    work = []
+    for rel, info in infos.items():
+        for defs in info.funcs.values():
+            for fn in defs:
+                if fn not in entry_defs and fn not in nested_in_entry:
+                    work.append((rel, fn))
+    seen: Dict[str, Set[ast.AST]] = {rel: set() for rel in infos}
+    while work:
+        rel, fn = work.pop()
+        if fn in seen[rel]:
+            continue
+        seen[rel].add(fn)
+        info = infos[rel]
+        for edge in _call_edges(info, fn, infos):
+            work.append(edge)
+    model.unthreaded = seen
+    return model

@@ -31,15 +31,18 @@ from __future__ import annotations
 
 import argparse
 import ast
+import hashlib
 import json
 import os
 import re
 import sys
+import time
 from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
 __all__ = ["Finding", "SourceFile", "Pass", "collect_files",
            "run_passes", "load_baseline", "apply_baseline", "lint",
-           "main", "REPO_ROOT", "DEFAULT_BASELINE", "DEFAULT_TARGETS"]
+           "main", "protocol_fingerprint", "REPO_ROOT",
+           "DEFAULT_BASELINE", "DEFAULT_TARGETS"]
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
@@ -181,9 +184,11 @@ def get_passes(select: Optional[Sequence[str]] = None) -> List[Pass]:
 
 
 def run_passes(files: Sequence[SourceFile], root: str,
-               select: Optional[Sequence[str]] = None) -> List[Finding]:
+               select: Optional[Sequence[str]] = None,
+               timings: Optional[Dict[str, float]] = None) -> List[Finding]:
     """All findings from all (selected) passes, suppressions applied,
-    sorted by (path, line, rule)."""
+    sorted by (path, line, rule). Pass a dict as ``timings`` to receive
+    per-pass wall-clock seconds keyed by rule name."""
     findings: List[Finding] = []
     for sf in files:
         if sf.parse_error is not None:
@@ -192,7 +197,11 @@ def run_passes(files: Sequence[SourceFile], root: str,
                 sf.parse_error.lineno or 1,
                 f"unparseable: {sf.parse_error.msg}"))
     for p in get_passes(select):
+        t0 = time.perf_counter()
         findings.extend(p.run(files, root))
+        if timings is not None:
+            timings[p.name] = (timings.get(p.name, 0.0)
+                               + time.perf_counter() - t0)
     by_rel = {sf.relpath: sf for sf in files}
     kept = []
     for f in findings:
@@ -252,14 +261,58 @@ def write_baseline(path: str, findings: Sequence[Finding]) -> None:
         fh.write("\n")
 
 
+# ----------------------------------------------------------- fingerprint
+# the protocol registries whose content defines what the distributed-
+# protocol passes enforce; a crash bundle stamped with their hashes can
+# be matched against the exact contract the running tree was linted to
+_REGISTRY_FILES = {
+    "knobs": os.path.join("paddle_tpu", "config", "knobs.py"),
+    "keyspace": os.path.join("paddle_tpu", "distributed",
+                             "control_plane", "keyspace.py"),
+    "fault_sites": os.path.join("paddle_tpu", "distributed",
+                                "resilience", "fault_sites.py"),
+    "metrics_schema": os.path.join("paddle_tpu", "observability",
+                                   "metrics_schema.py"),
+}
+
+
+def protocol_fingerprint(root: str = REPO_ROOT) -> dict:
+    """Cheap (no lint run) identity of the protocol-lint contract: the
+    rule catalog, the baseline size, and a content hash per registry
+    file, folded into one short fingerprint. Recorded into debug
+    bundles and the ``--json`` report so a crash can be matched to the
+    exact registry/rule state of the tree that produced it."""
+    regs: Dict[str, str] = {}
+    h = hashlib.sha256()
+    for name in sorted(_REGISTRY_FILES):
+        path = os.path.join(root, _REGISTRY_FILES[name])
+        try:
+            with open(path, "rb") as fh:
+                digest = hashlib.sha256(fh.read()).hexdigest()[:12]
+        except OSError:
+            digest = "absent"
+        regs[name] = digest
+        h.update(f"{name}={digest}\n".encode())
+    try:
+        entries = load_baseline(DEFAULT_BASELINE)
+    except Exception:
+        entries = []
+    rules = sorted(p.name for p in get_passes())
+    h.update(",".join(rules).encode())
+    h.update(str(len(entries)).encode())
+    return {"rules": rules, "baseline_findings": len(entries),
+            "registries": regs, "fingerprint": h.hexdigest()[:16]}
+
+
 # ------------------------------------------------------------ entrypoint
 def lint(paths: Sequence[str], root: str = REPO_ROOT,
          select: Optional[Sequence[str]] = None,
-         baseline_path: Optional[str] = DEFAULT_BASELINE):
+         baseline_path: Optional[str] = DEFAULT_BASELINE,
+         timings: Optional[Dict[str, float]] = None):
     """Programmatic API used by the tier-1 tests: returns
     ``(new_findings, baselined_findings, stale_entries)``."""
     files = collect_files(paths, root)
-    findings = run_passes(files, root, select)
+    findings = run_passes(files, root, select, timings=timings)
     entries = load_baseline(baseline_path) if baseline_path else []
     return apply_baseline(findings, entries)
 
@@ -337,7 +390,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             "findings": [f.to_dict() for f in new],
             "baselined": [f.to_dict() for f in old],
             "stale_baseline": stale,
-            "files_checked": len(files)}, indent=1))
+            "files_checked": len(files),
+            "protocol_lint": protocol_fingerprint(root)}, indent=1))
     else:
         for f in new:
             print(str(f))

@@ -3,20 +3,27 @@
 append the class here; the driver, suppression comments, baseline and
 both reporters pick it up with no further wiring."""
 from .collective_consistency import CollectiveConsistencyPass
+from .env_knobs import EnvKnobsPass
+from .fault_sites import FaultSitesPass
+from .fence_discipline import FenceDisciplinePass
 from .host_transfer import HostTransferPass
 from .jit_purity import JitPurityPass
 from .lock_discipline import LockDisciplinePass
 from .metric_names import MetricNamesPass
 from .recompile_hazard import RecompileHazardPass
 from .serial_collective import SerialCollectivePass
+from .store_keys import StoreKeysPass
+from .thread_escape import ThreadEscapePass
 from .unfused_chain import UnfusedChainPass
 
 ALL_PASSES = [JitPurityPass, RecompileHazardPass,
               CollectiveConsistencyPass, LockDisciplinePass,
               MetricNamesPass, HostTransferPass, UnfusedChainPass,
-              SerialCollectivePass]
+              SerialCollectivePass, ThreadEscapePass, StoreKeysPass,
+              FenceDisciplinePass, FaultSitesPass, EnvKnobsPass]
 
 __all__ = ["ALL_PASSES", "JitPurityPass", "RecompileHazardPass",
            "CollectiveConsistencyPass", "LockDisciplinePass",
            "MetricNamesPass", "HostTransferPass", "UnfusedChainPass",
-           "SerialCollectivePass"]
+           "SerialCollectivePass", "ThreadEscapePass", "StoreKeysPass",
+           "FenceDisciplinePass", "FaultSitesPass", "EnvKnobsPass"]

@@ -1,0 +1,204 @@
+"""env-knobs: every ``PADDLE_TPU_*`` environment variable goes through
+the typed knob registry.
+
+``paddle_tpu/config/knobs.py`` declares name, type, default and doc
+for every knob; this pass makes that registry load-bearing:
+
+* **no raw reads** — ``os.environ.get(...)`` with a ``PADDLE_TPU_X``
+  literal, ``os.getenv``, ``os.environ["..."]`` (Load) and
+  ``"..." in os.environ`` with a literal ``PADDLE_TPU_`` name are
+  findings everywhere outside the registry itself. Call sites use
+  ``knobs.get_str/get_int/get_float/get_bool/is_set`` so parse
+  semantics ("" vs "0" vs "off") can never fork per call site. Writes
+  (``os.environ["X"] = ...``, ``monkeypatch.setenv``, ``del``) are
+  deliberately not matched — tests set knobs raw.
+* **declared names only** — a knob accessor called with a literal name
+  not in the registry is a finding (typo'd knobs read defaults
+  forever, silently).
+* **no dead rows** — a declared knob never read at any literal
+  accessor call site in the canonical tree is a finding on the
+  registry.
+* **docs in lockstep** — every ``PADDLE_TPU_*`` token in README.md
+  must be declared (tokens ending in ``_`` are wildcard mentions and
+  exempt), and the generated env-table block must byte-match what
+  ``tools/gen_env_docs.py`` renders from the registry.
+
+The raw-read and dead-row sweeps always walk the canonical tree
+(paddle_tpu/, tools/, tests/, bench.py) plus ``__graft_entry__.py``,
+independent of which files this invocation lints, so partial
+invocations neither miss raw reads in tests nor fabricate "never
+read" rows.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Sequence, Set, Tuple
+
+from ..engine import Finding, Pass
+from .._jitreach import dotted
+from .._schemas import KNOBS_RELPATH, load_by_path, load_knobs
+from .metric_names import iter_canonical_files
+
+_ACCESSORS = {"get_str", "get_int", "get_float", "get_bool",
+              "get_raw", "is_set"}
+
+_ENV_OBJS = {"os.environ", "environ"}
+_GET_FUNCS = {"os.environ.get", "environ.get", "os.getenv", "getenv"}
+
+_TOKEN_RE = re.compile(r"PADDLE_TPU_[A-Z0-9_]+")
+
+_GEN_DOCS_RELPATH = "tools/gen_env_docs.py"
+
+
+def _lit(node) -> str:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return ""
+
+
+def raw_env_reads(tree) -> List[Tuple[int, str]]:
+    """(lineno, var) for every raw read of a literal PADDLE_TPU_*."""
+    out: List[Tuple[int, str]] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and node.args:
+            if dotted(node.func) in _GET_FUNCS:
+                name = _lit(node.args[0])
+                if name.startswith("PADDLE_TPU_"):
+                    out.append((node.lineno, name))
+        elif isinstance(node, ast.Subscript):
+            if isinstance(node.ctx, ast.Load) and \
+                    dotted(node.value) in _ENV_OBJS:
+                name = _lit(node.slice)
+                if name.startswith("PADDLE_TPU_"):
+                    out.append((node.lineno, name))
+        elif isinstance(node, ast.Compare):
+            if len(node.ops) == 1 and \
+                    isinstance(node.ops[0], (ast.In, ast.NotIn)) and \
+                    dotted(node.comparators[0]) in _ENV_OBJS:
+                name = _lit(node.left)
+                if name.startswith("PADDLE_TPU_"):
+                    out.append((node.lineno, name))
+    return out
+
+
+def accessor_calls(tree) -> List[Tuple[int, str, str]]:
+    """(lineno, accessor, literal name) for knob-accessor calls."""
+    out: List[Tuple[int, str, str]] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        f = node.func
+        last = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else "")
+        if last not in _ACCESSORS:
+            continue
+        name = _lit(node.args[0])
+        if name.startswith("PADDLE_TPU_"):
+            out.append((node.lineno, last, name))
+    return out
+
+
+def _sweep_paths(root: str):
+    """Canonical tree plus the runner-injected entry shim."""
+    for path in iter_canonical_files(root):
+        yield path
+    graft = os.path.join(root, "__graft_entry__.py")
+    if os.path.exists(graft):
+        yield graft
+
+
+class EnvKnobsPass(Pass):
+    name = "env-knobs"
+    description = ("PADDLE_TPU_* env vars must be read through the "
+                   "typed knob registry; registry and README must "
+                   "have no dead/undeclared rows")
+
+    def run(self, files: Sequence, root: str) -> List[Finding]:
+        knobs = load_knobs(root)
+        if knobs is None:
+            return []
+        declared: Set[str] = {k.name for k in knobs.iter_knobs()}
+        out: List[Finding] = []
+        used: Set[str] = set()
+        linted: Set[str] = set()
+        for sf in files:
+            if sf.tree is None:
+                continue
+            linted.add(sf.relpath)
+            self._check_tree(sf.relpath, sf.tree, declared, used, out)
+        # the rest of the canonical tree (tests/, the graft shim, ...)
+        # — raw reads there fork env semantics just the same, and
+        # accessor calls there keep registry rows alive
+        for path in _sweep_paths(root):
+            rel = os.path.relpath(path, root).replace(os.sep, "/")
+            if rel in linted:
+                continue
+            try:
+                with open(path, encoding="utf-8") as f:
+                    tree = ast.parse(f.read(), filename=path)
+            except SyntaxError:
+                continue
+            self._check_tree(rel, tree, declared, used, out)
+        for name in sorted(declared - used):
+            out.append(Finding(
+                self.name, KNOBS_RELPATH, 1,
+                f"knob {name!r} is declared but never read at any "
+                "literal accessor call site in the canonical tree"))
+        self._check_readme(root, knobs, declared, out)
+        return out
+
+    def _check_tree(self, relpath: str, tree, declared: Set[str],
+                    used: Set[str], out: List[Finding]) -> None:
+        if relpath == KNOBS_RELPATH:
+            return                  # the registry implements the reads
+        for lineno, name in raw_env_reads(tree):
+            out.append(Finding(
+                self.name, relpath, lineno,
+                f"raw environment read of {name!r}; go through "
+                "paddle_tpu.config.knobs (get_str/get_int/get_float/"
+                "get_bool/is_set) so parse semantics can't fork per "
+                "call site"))
+        for lineno, accessor, name in accessor_calls(tree):
+            used.add(name)
+            if name not in declared:
+                out.append(Finding(
+                    self.name, relpath, lineno,
+                    f"knob {name!r} passed to `{accessor}` is not "
+                    "declared in paddle_tpu/config/knobs.py"))
+
+    def _check_readme(self, root: str, knobs, declared: Set[str],
+                      out: List[Finding]) -> None:
+        readme = os.path.join(root, "README.md")
+        if not os.path.exists(readme):
+            return
+        with open(readme, encoding="utf-8") as f:
+            text = f.read()
+        unknown = sorted({m.group(0) for m in _TOKEN_RE.finditer(text)
+                          if not m.group(0).endswith("_")
+                          and m.group(0) not in declared})
+        for name in unknown:
+            out.append(Finding(
+                self.name, "README.md", 1,
+                f"README.md mentions undeclared knob {name!r}; "
+                "declare it in paddle_tpu/config/knobs.py or fix the "
+                "doc"))
+        gen = load_by_path(root, _GEN_DOCS_RELPATH, "_pt_gen_env_docs")
+        if gen is None:
+            return
+        begin, end = gen.BEGIN_MARK, gen.END_MARK
+        if begin not in text or end not in text:
+            out.append(Finding(
+                self.name, "README.md", 1,
+                "README.md has no generated env-table block; add the "
+                f"{begin!r} / {end!r} markers and run "
+                "`python tools/gen_env_docs.py --write`"))
+            return
+        block = text.split(begin, 1)[1].split(end, 1)[0]
+        if block.strip("\n") != gen.render(knobs).strip("\n"):
+            out.append(Finding(
+                self.name, "README.md", 1,
+                "README.md env tables are stale relative to "
+                "paddle_tpu/config/knobs.py; run "
+                "`python tools/gen_env_docs.py --write`"))

@@ -1,0 +1,115 @@
+"""fault-sites: injection/retry site strings match the declared
+registry, both directions.
+
+Forward: every literal site string at a ``faults.check("<site>")``
+call or a ``call_with_retry(..., site=...)`` / ``retry(site=...)``
+call must be declared in ``resilience/fault_sites.py`` — a typo'd
+``PADDLE_TPU_FAULT_PLAN`` site would otherwise silently inject
+nothing.
+
+Reverse (REQUIRE_USED): every declared site must be referenced by at
+least one file under ``tests/`` — an uninjected site is an untested
+failure mode, and the registry cannot accumulate dead rows. The
+reverse sweep reads the tests tree directly (raw text: plan specs like
+``"cp.lease:drop@1"`` count), independent of which files this
+invocation lints.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Sequence, Set
+
+from ..engine import Finding, Pass
+from .._schemas import FAULT_SITES_RELPATH, load_fault_sites
+
+# call targets (last dotted segment) whose `site=` kwarg is a site
+_RETRY_LAST = {"call_with_retry", "retry"}
+
+
+def _literal(node) -> str:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return ""
+
+
+def _is_faults_check(func: ast.AST) -> bool:
+    return isinstance(func, ast.Attribute) and func.attr == "check" \
+        and isinstance(func.value, ast.Name) \
+        and func.value.id.lstrip("_") == "faults"
+
+
+def _retry_site_kw(call: ast.Call) -> str:
+    f = call.func
+    last = f.attr if isinstance(f, ast.Attribute) else (
+        f.id if isinstance(f, ast.Name) else "")
+    if last not in _RETRY_LAST:
+        return ""
+    for kw in call.keywords:
+        if kw.arg == "site":
+            return _literal(kw.value)
+    return ""
+
+
+def site_refs(tree) -> List:
+    """(lineno, site, how) triples for literal site strings."""
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _is_faults_check(node.func) and node.args:
+            s = _literal(node.args[0])
+            if s:
+                out.append((node.args[0].lineno, s, "faults.check"))
+        s = _retry_site_kw(node)
+        if s:
+            out.append((node.lineno, s, "retry site="))
+    return out
+
+
+def tests_text(root: str) -> str:
+    """Concatenated raw text of tests/ (the reverse-sweep corpus)."""
+    chunks = []
+    tdir = os.path.join(root, "tests")
+    for dirpath, dirnames, files in os.walk(tdir):
+        dirnames[:] = sorted(d for d in dirnames
+                             if d != "__pycache__")
+        for f in sorted(files):
+            if f.endswith(".py"):
+                with open(os.path.join(dirpath, f),
+                          encoding="utf-8") as fh:
+                    chunks.append(fh.read())
+    return "\n".join(chunks)
+
+
+class FaultSitesPass(Pass):
+    name = "fault-sites"
+    description = ("faults.check / retry site strings must be "
+                   "declared in fault_sites.py and every declared "
+                   "site must be referenced by a test")
+
+    def run(self, files: Sequence, root: str) -> List[Finding]:
+        mod = load_fault_sites(root)
+        if mod is None:
+            return []
+        sites: Dict = mod.SITES
+        out: List[Finding] = []
+        for sf in files:
+            if sf.tree is None:
+                continue
+            for lineno, s, how in site_refs(sf.tree):
+                if s not in sites:
+                    out.append(Finding(
+                        self.name, sf.relpath, lineno,
+                        f"{how} site {s!r} is not declared in "
+                        "paddle_tpu/distributed/resilience/"
+                        "fault_sites.py"))
+        corpus = tests_text(root)
+        for name in sorted(sites):
+            if name not in corpus:
+                out.append(Finding(
+                    self.name, FAULT_SITES_RELPATH, 1,
+                    f"fault site {name!r} is declared but referenced "
+                    "by no test under tests/ — add an injection/drill "
+                    "test or drop the site"))
+        return out

@@ -1,0 +1,181 @@
+"""fence-discipline: generation fencing and try_get on the
+control-plane store.
+
+Two protocol invariants, enforced against the keyspace registry's
+``fenced``/``deletable`` flags (see
+``distributed/control_plane/keyspace.py``):
+
+* **fenced writes carry a generation** — a ``store.set`` whose key is
+  built by a *fenced* namespace helper (``beat``, ``kvidx``) must flow
+  a lease generation into the written payload. "Flows" means the
+  payload expression (or a local name feeding it) contains a value
+  obtained from ``LeaseTable.grant(...)``/``.generation(...)``, a
+  ``gen=``/``"gen"``-keyed dict entry, or a ``x["gen"] = ...``
+  assignment in the same function. A writer that can't see the
+  generation (it takes the pre-assembled payload as a parameter) is a
+  *blessed low-level writer*: suppress the finding at the call site
+  with a justification comment — exactly one hop above it must fence.
+
+* **deletable keys are read with try_get** — a raw ``store.get`` on a
+  key built by a *deletable* namespace helper races a concurrent
+  delete/expiry between check and get (the PR 13 race class); those
+  reads must go through ``try_get``.
+
+Scope: the same protocol tiers as the store-keys pass. The rules key
+off keyspace helper calls, so inline-string keys (already a
+store-keys finding) are this pass's blind spot by design — one
+finding per defect.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set
+
+from ..engine import Finding, Pass
+from .._jitreach import _DEFS
+from .._schemas import load_keyspace
+from .store_keys import in_scope
+
+# calls whose result is a lease generation
+_GEN_SOURCES = {"grant", "generation"}
+
+
+def _helper_name(node: ast.AST, helpers: Set[str]) -> Optional[str]:
+    """The keyspace helper a key expression calls, if any — accepts
+    ``keyspace.beat(...)``, ``ks.beat(...)`` and bare ``beat(...)``."""
+    if not isinstance(node, ast.Call):
+        return None
+    f = node.func
+    if isinstance(f, ast.Attribute) and f.attr in helpers:
+        return f.attr
+    if isinstance(f, ast.Name) and f.id in helpers:
+        return f.id
+    return None
+
+
+def _key_bindings(fn: ast.AST, helpers: Set[str]) -> Dict[str, str]:
+    """Local names assigned from a keyspace helper call in ``fn``."""
+    out: Dict[str, str] = {}
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            h = _helper_name(node.value, helpers)
+            if h:
+                out[node.targets[0].id] = h
+    return out
+
+
+def _gen_tainted(fn: ast.AST) -> Set[str]:
+    """Local names that carry a generation value in ``fn``."""
+    tainted: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+            names = [t.id for t in node.targets
+                     if isinstance(t, ast.Name)]
+            if not names:
+                # x["gen"] = ... taints x
+                for t in node.targets:
+                    if isinstance(t, ast.Subscript) and \
+                            isinstance(t.value, ast.Name) and \
+                            isinstance(t.slice, ast.Constant) and \
+                            t.slice.value == "gen":
+                        tainted.add(t.value.id)
+                continue
+            v = node.value
+            if isinstance(v, ast.Call) and \
+                    isinstance(v.func, ast.Attribute) and \
+                    v.func.attr in _GEN_SOURCES:
+                tainted.update(names)
+            elif isinstance(v, ast.Dict) and _dict_has_gen(v):
+                tainted.update(names)
+            elif isinstance(v, ast.Name) and v.id in tainted:
+                tainted.update(names)
+    return tainted
+
+
+def _dict_has_gen(d: ast.Dict) -> bool:
+    return any(isinstance(k, ast.Constant) and k.value == "gen"
+               for k in d.keys)
+
+
+def _payload_fenced(payload: ast.AST, tainted: Set[str]) -> bool:
+    for node in ast.walk(payload):
+        if isinstance(node, ast.Name) and node.id in tainted:
+            return True
+        if isinstance(node, ast.Dict) and _dict_has_gen(node):
+            return True
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr in _GEN_SOURCES:
+                return True
+        if isinstance(node, ast.keyword) and node.arg == "gen":
+            return True
+    return False
+
+
+class FenceDisciplinePass(Pass):
+    name = "fence-discipline"
+    description = ("fenced-namespace store writes must flow a lease "
+                   "generation; deletable-namespace reads must use "
+                   "try_get")
+
+    def run(self, files: Sequence, root: str) -> List[Finding]:
+        ks = load_keyspace(root)
+        if ks is None:
+            return []
+        helpers: Set[str] = set(ks.HELPERS)
+        fenced = {n.name for n in ks.NAMESPACES if n.fenced}
+        deletable = {n.name for n in ks.NAMESPACES if n.deletable}
+        out: List[Finding] = []
+        for sf in files:
+            if sf.tree is None or not in_scope(sf.relpath):
+                continue
+            for fn in (n for n in ast.walk(sf.tree)
+                       if isinstance(n, _DEFS)):
+                self._check_fn(sf, fn, helpers, fenced, deletable, out)
+        return out
+
+    def _check_fn(self, sf, fn, helpers: Set[str], fenced: Set[str],
+                  deletable: Set[str], out: List[Finding]) -> None:
+        bindings = _key_bindings(fn, helpers)
+        tainted: Optional[Set[str]] = None   # computed lazily
+        nested_nodes: Set[ast.AST] = set()
+        for d in ast.walk(fn):
+            if isinstance(d, _DEFS) and d is not fn:
+                nested_nodes.update(ast.walk(d))
+
+        def key_ns(expr: ast.AST) -> Optional[str]:
+            h = _helper_name(expr, helpers)
+            if h is None and isinstance(expr, ast.Name):
+                h = bindings.get(expr.id)
+            return h
+
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call) or node in nested_nodes:
+                continue            # nested defs check themselves
+            f = node.func
+            # ---------------------------------------- raw store.get
+            if isinstance(f, ast.Attribute) and f.attr == "get" and \
+                    node.args:
+                ns = key_ns(node.args[0])
+                if ns in deletable:
+                    out.append(Finding(
+                        self.name, sf.relpath, node.lineno,
+                        f"raw `.get` on deletable keyspace `{ns}` in "
+                        f"`{fn.name}` races a concurrent delete/"
+                        "expiry; use `try_get` (atomic get-or-None)"))
+            # ------------------------------------------ fenced sets
+            if isinstance(f, ast.Attribute) and f.attr == "set" and \
+                    len(node.args) >= 2:
+                ns = key_ns(node.args[0])
+                if ns in fenced:
+                    if tainted is None:
+                        tainted = _gen_tainted(fn)
+                    if not _payload_fenced(node.args[1], tainted):
+                        out.append(Finding(
+                            self.name, sf.relpath, node.lineno,
+                            f"write to fenced keyspace `{ns}` in "
+                            f"`{fn.name}` does not flow a lease "
+                            "generation (LeaseTable.grant/"
+                            "generation()) into the payload; stale "
+                            "owners must be rejectable by readers"))

@@ -51,6 +51,7 @@ class _ClassGuards:
         self.relpath = relpath
         self.internal: Dict[str, str] = {}   # attr -> lock attr name
         self.external: Dict[str, str] = {}   # attr -> prose lock desc
+        self.ann_line: Dict[str, int] = {}   # attr -> annotation lineno
 
 
 def _annotation_on(sf, lineno: int) -> Optional[str]:
@@ -86,10 +87,51 @@ def _collect_guards(sf) -> List[Tuple[ast.ClassDef, _ClassGuards]]:
                                 g.internal[t.attr] = lock
                             else:
                                 g.external[t.attr] = lock
+                            g.ann_line[t.attr] = ln
                             break
         if g.internal or g.external:
             out.append((node, g))
     return out
+
+
+def _class_attrs(cls: ast.ClassDef) -> Set[str]:
+    """Every ``self.<attr>`` assigned anywhere in the class body."""
+    attrs: Set[str] = set()
+    for sub in ast.walk(cls):
+        targets = []
+        if isinstance(sub, ast.Assign):
+            targets = sub.targets
+        elif isinstance(sub, (ast.AnnAssign, ast.AugAssign)):
+            targets = [sub.target]
+        for t in targets:
+            if isinstance(t, ast.Attribute) and \
+                    isinstance(t.value, ast.Name) and t.value.id == "self":
+                attrs.add(t.attr)
+    return attrs
+
+
+def _resolved_attrs(cls: ast.ClassDef, by_name: Dict[str, ast.ClassDef],
+                    _seen: Optional[Set[str]] = None) -> Optional[Set[str]]:
+    """Attrs assigned by the class or its same-file bases; None when a
+    base can't be resolved in this file (conservative: skip the stale
+    check rather than guess what an imported base defines)."""
+    seen = set() if _seen is None else _seen
+    if cls.name in seen:
+        return set()
+    seen.add(cls.name)
+    attrs = _class_attrs(cls)
+    for b in cls.bases:
+        if isinstance(b, ast.Name):
+            base = by_name.get(b.id)
+            if base is None:
+                return None
+            sub = _resolved_attrs(base, by_name, seen)
+            if sub is None:
+                return None
+            attrs |= sub
+        elif not (isinstance(b, ast.Attribute) and b.attr == "object"):
+            return None
+    return attrs
 
 
 def _held_locks(sf, fn) -> Set[str]:
@@ -137,22 +179,54 @@ class LockDisciplinePass(Pass):
                     external[attr] = (g.cls_name, desc)
         for sf, cls, g in per_file:
             if g.internal:
-                self._check_class(sf, cls, g, out)
+                attrs = self._stale_check(sf, cls, g, out)
+                self._check_class(sf, cls, g, out, attrs)
         if external:
             for sf in files:
                 if sf.tree is not None:
                     self._check_external(sf, external, out)
         return out
 
+    # ----------------------------------------------- stale annotations
+    def _stale_check(self, sf, cls: ast.ClassDef, g: _ClassGuards,
+                     out: List[Finding]) -> Optional[Set[str]]:
+        """A `# guarded by: <lock>` (or `holds=<lock>`) naming a lock the
+        class never assigns is a stale annotation — the lock was renamed
+        or split, and the discipline check is silently guarding nothing.
+        Returns the resolved attr set (None = unresolvable bases)."""
+        by_name = {n.name: n for n in ast.walk(sf.tree)
+                   if isinstance(n, ast.ClassDef)}
+        attrs = _resolved_attrs(cls, by_name)
+        if attrs is None:
+            return None
+        for attr, lock in sorted(g.internal.items()):
+            if lock not in attrs:
+                out.append(Finding(
+                    self.name, sf.relpath, g.ann_line.get(attr, 1),
+                    f"`self.{attr}` claims `# guarded by: {lock}` but "
+                    f"`{g.cls_name}` never assigns `self.{lock}` — the "
+                    "annotation is stale (lock renamed or split?); "
+                    "point it at the live lock"))
+        return attrs
+
     # --------------------------------------------------- internal locks
     def _check_class(self, sf, cls: ast.ClassDef, g: _ClassGuards,
-                     out: List[Finding]) -> None:
+                     out: List[Finding],
+                     attrs: Optional[Set[str]] = None) -> None:
         pass_name = self.name
         methods = [n for n in cls.body if isinstance(n, _DEFS)]
         for m in methods:
             if m.name == "__init__":
                 continue
             held = _held_locks(sf, m)
+            if attrs is not None:
+                for lock in sorted(held - attrs):
+                    out.append(Finding(
+                        pass_name, sf.relpath, m.lineno,
+                        f"`{g.cls_name}.{m.name}` declares `# ptlint: "
+                        f"holds={lock}` but the class never assigns "
+                        f"`self.{lock}` — stale holds annotation "
+                        "(lock renamed or split?)"))
 
             class V(ast.NodeVisitor):
                 def __init__(self):

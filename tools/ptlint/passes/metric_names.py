@@ -32,9 +32,12 @@ _KIND = {"counter": "counter", "gauge": "gauge",
 _SKIP_DIRS = {".git", "__pycache__", "build", "dist", ".eggs",
               "node_modules"}
 
-# namespaces whose declared names must all be instrumented somewhere
-REQUIRE_USED = ("serving.", "cluster.", "cp.", "elastic.", "ps.",
-                "rt.", "slo.", "prof.", "kv.")
+# namespaces whose declared names must all be instrumented somewhere —
+# derived from metrics_schema.NAMESPACES require_used flags (this
+# module-level tuple is only the fallback for a tree whose schema
+# predates the namespace table)
+_REQUIRE_USED_FALLBACK = ("serving.", "cluster.", "cp.", "elastic.",
+                          "ps.", "rt.", "slo.", "prof.", "kv.")
 
 _SCHEMA_RELPATH = "paddle_tpu/observability/metrics_schema.py"
 
@@ -67,6 +70,46 @@ def load_schema(root: str):
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     return mod.METRICS, getattr(mod, "SPANS", {})
+
+
+def load_namespaces(root: str):
+    """metrics_schema.NAMESPACES, or None on a tree whose schema
+    predates the namespace table."""
+    import importlib.util
+
+    path = os.path.join(root, _SCHEMA_RELPATH)
+    spec = importlib.util.spec_from_file_location("_pt_metrics_schema",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return getattr(mod, "NAMESPACES", None)
+
+
+def require_used_prefixes(namespaces) -> Tuple[str, ...]:
+    """The reverse-sweep prefix tuple, derived from the schema's
+    NAMESPACES table (hand-grown literal list retired)."""
+    if namespaces is None:
+        return _REQUIRE_USED_FALLBACK
+    return tuple(sorted(ns + "." for ns, spec in namespaces.items()
+                        if getattr(spec, "require_used", True)))
+
+
+def undeclared_namespace_findings(metrics, spans,
+                                  namespaces) -> List[str]:
+    """Every METRICS/SPANS key must live in a declared namespace."""
+    if namespaces is None:
+        return []
+    out = []
+    for label, table in (("metric", metrics), ("span", spans)):
+        for name in sorted(table):
+            ns = name.split(".", 1)[0]
+            if ns not in namespaces:
+                out.append(
+                    f"{label} {name!r} uses namespace {ns!r} which is "
+                    "not declared in metrics_schema.NAMESPACES — add "
+                    "the namespace row (with a require_used decision) "
+                    "or fix the name")
+    return out
 
 
 def _call_kind(func) -> str:
@@ -142,19 +185,22 @@ def check_tree(tree, metrics, spans=None,
     return out
 
 
-def reverse_findings(root: str, metrics, spans,
-                     used: Set[str]) -> List[Tuple[str, str]]:
+def reverse_findings(root: str, metrics, spans, used: Set[str],
+                     namespaces=None) -> List[Tuple[str, str]]:
     """(kind, message) rows for declared-but-never-recorded names."""
+    prefixes = require_used_prefixes(namespaces)
     out = []
     for name in sorted(metrics):
-        if name.startswith(REQUIRE_USED) and name not in used:
+        if name.startswith(prefixes) and name not in used:
             out.append(("metric", f"metric {name!r} is declared but "
                                   "never recorded at any literal call "
                                   "site"))
     for name in sorted(spans):
-        if name.startswith(REQUIRE_USED) and name not in used:
+        if name.startswith(prefixes) and name not in used:
             out.append(("span", f"span {name!r} is declared but never "
                                 "opened at any literal call site"))
+    for msg in undeclared_namespace_findings(metrics, spans, namespaces):
+        out.append(("namespace", msg))
     return out
 
 
@@ -181,6 +227,7 @@ class MetricNamesPass(Pass):
         if not os.path.exists(os.path.join(root, _SCHEMA_RELPATH)):
             return []           # tree without a schema: nothing to do
         metrics, spans = load_schema(root)
+        namespaces = load_namespaces(root)
         out: List[Finding] = []
         linted = set()
         for sf in files:
@@ -193,6 +240,7 @@ class MetricNamesPass(Pass):
         # reverse check over the canonical tree (not just `files`) so a
         # subset invocation can't fabricate "never recorded" rows
         used = collect_used(root, metrics, spans)
-        for _kind, msg in reverse_findings(root, metrics, spans, used):
+        for _kind, msg in reverse_findings(root, metrics, spans, used,
+                                           namespaces=namespaces):
             out.append(Finding(self.name, _SCHEMA_RELPATH, 1, msg))
         return out

@@ -1,0 +1,176 @@
+"""store-keys: control-plane store keys come from the keyspace
+registry, never inline strings.
+
+Scope: the protocol tiers that talk to the control-plane store —
+``distributed/control_plane/``, ``distributed/elastic/``,
+``distributed/ps/``, ``serving/cluster/``, ``serving/kv_store/``.
+(Rendezvous/bootstrap keys in rpc/process_group/launch/fleet are
+deliberately out of scope; see the keyspace module docstring.)
+
+Three rules:
+
+* **call-site shape** — the key argument of a store op
+  (``.set/.get/.add/.check/.delete/.try_get`` and the free
+  ``try_get(store, key)``) must be a variable, an attribute, or a call
+  (normally a ``keyspace`` helper); an inline f-string, string concat,
+  ``%``/``.format``/``.join`` build, or a ``"a/b"`` literal is a
+  finding;
+* **no shadow builders** — an f-string anywhere in scope whose literal
+  text contains a declared namespace's segment signature (``/beat/``,
+  ``ps/primary/``, ...) rebuilds a registered keyspace inline — a
+  finding even off the store call site (this is what catches ``_k``
+  style private builders);
+* **collision-free registry** — ``keyspace.check_collisions()`` must
+  return no pairs; each pair is a finding on the registry itself.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Sequence, Set
+
+from ..engine import Finding, Pass
+from .._schemas import KEYSPACE_RELPATH, load_keyspace
+
+SCOPE_PREFIXES = (
+    "paddle_tpu/distributed/control_plane/",
+    "paddle_tpu/distributed/elastic/",
+    "paddle_tpu/distributed/ps/",
+    "paddle_tpu/serving/cluster/",
+    "paddle_tpu/serving/kv_store/",
+)
+
+_STORE_OPS = {"set", "get", "add", "check", "delete", "try_get"}
+
+
+def in_scope(relpath: str) -> bool:
+    return relpath.startswith(SCOPE_PREFIXES) and \
+        relpath != KEYSPACE_RELPATH
+
+
+def _needles(ks) -> List[str]:
+    """Literal segment signatures of the declared namespaces; an
+    f-string containing one is rebuilding that namespace inline."""
+    out: Set[str] = set()
+    for ns in ks.NAMESPACES:
+        segs = list(ns.pattern)
+        i = 0
+        while i < len(segs):
+            if segs[i].startswith("<"):
+                i += 1
+                continue
+            j = i
+            while j < len(segs) and not segs[j].startswith("<"):
+                j += 1
+            text = "/".join(segs[i:j])
+            # a run at the start shows up as "ps/primary/..."; an
+            # interior run as ".../beat/..."; a trailing run as
+            # ".../seq" with nothing after it
+            tail = "/" if j < len(segs) else ""
+            sig = (text + tail) if i == 0 else ("/" + text + tail)
+            out.add(sig)
+            i = j
+    return sorted(out)
+
+
+def _literal_text(js: ast.JoinedStr) -> str:
+    return "".join(v.value for v in js.values
+                   if isinstance(v, ast.Constant)
+                   and isinstance(v.value, str))
+
+
+def _bad_key_expr(node: ast.AST) -> Optional[str]:
+    """Why a key expression is an inline build (None = acceptable)."""
+    if isinstance(node, ast.JoinedStr):
+        return "an f-string"
+    if isinstance(node, ast.BinOp):
+        return "a string concat/format expression"
+    if isinstance(node, ast.Constant) and isinstance(node.value, str) \
+            and "/" in node.value:
+        return "a hard-coded multi-segment literal"
+    if isinstance(node, ast.Call) and \
+            isinstance(node.func, ast.Attribute) and \
+            node.func.attr in ("format", "join"):
+        return f"a .{node.func.attr}() build"
+    return None
+
+
+class StoreKeysPass(Pass):
+    name = "store-keys"
+    description = ("control-plane store keys must come from the "
+                   "keyspace registry (no inline f-strings) and the "
+                   "registry must be collision-free")
+
+    def run(self, files: Sequence, root: str) -> List[Finding]:
+        ks = load_keyspace(root)
+        if ks is None:
+            return []               # tree without a registry: skip
+        out: List[Finding] = []
+        for problem in ks.check_collisions():
+            out.append(Finding(self.name, KEYSPACE_RELPATH, 1,
+                               f"keyspace collision: {problem}"))
+        needles = _needles(ks)
+        for sf in files:
+            if sf.tree is None or not in_scope(sf.relpath):
+                continue
+            self._check_file(sf, needles, out)
+        return out
+
+    def _check_file(self, sf, needles: List[str],
+                    out: List[Finding]) -> None:
+        seen_binop = set()      # (lineno, needle): nested BinOps once
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Call):
+                key = self._key_arg(node)
+                if key is not None:
+                    why = _bad_key_expr(key)
+                    if why:
+                        op = self._op_name(node)
+                        out.append(Finding(
+                            self.name, sf.relpath, key.lineno,
+                            f"store key of `.{op}(...)` is {why}; "
+                            "build it with a declared helper from "
+                            "distributed/control_plane/keyspace.py"))
+            elif isinstance(node, ast.JoinedStr):
+                text = _literal_text(node)
+                hits = [n for n in needles if n in text]
+                if hits:
+                    out.append(Finding(
+                        self.name, sf.relpath, node.lineno,
+                        f"f-string rebuilds registered keyspace "
+                        f"{hits[0]!r} inline; use the keyspace helper "
+                        "so the namespace registry stays the single "
+                        "source of key shapes"))
+            elif isinstance(node, ast.BinOp):
+                # "%s/kvidx/%d" % (...) and "a" + "/beat/" + b builders
+                # (bare constants are skipped: docstrings/log text may
+                # legitimately describe key shapes)
+                for sub in ast.walk(node):
+                    if not (isinstance(sub, ast.Constant)
+                            and isinstance(sub.value, str)):
+                        continue
+                    hits = [n for n in needles if n in sub.value]
+                    if hits and (node.lineno, hits[0]) not in seen_binop:
+                        seen_binop.add((node.lineno, hits[0]))
+                        out.append(Finding(
+                            self.name, sf.relpath, node.lineno,
+                            f"string expression rebuilds registered "
+                            f"keyspace {hits[0]!r} inline; use the "
+                            "keyspace helper so the namespace registry "
+                            "stays the single source of key shapes"))
+                        break
+
+    @staticmethod
+    def _key_arg(call: ast.Call) -> Optional[ast.AST]:
+        f = call.func
+        if isinstance(f, ast.Attribute) and f.attr in _STORE_OPS:
+            if call.args:
+                return call.args[0]
+        elif isinstance(f, ast.Name) and f.id == "try_get":
+            if len(call.args) >= 2:
+                return call.args[1]
+        return None
+
+    @staticmethod
+    def _op_name(call: ast.Call) -> str:
+        f = call.func
+        return f.attr if isinstance(f, ast.Attribute) else "try_get"

@@ -1,0 +1,281 @@
+"""thread-escape: shared fields reachable from threaded AND unthreaded
+code with no common lock.
+
+The lock-discipline pass checks hand-annotated ``# guarded by:``
+fields; this pass finds the fields nobody annotated. Using the
+:mod:`tools.ptlint._threads` closure (``threading.Thread`` targets,
+registered hooks/callbacks, nested thread-loop bodies, and everything
+they transitively call), a class field is flagged when:
+
+* some method reachable from a thread entry accesses it, AND
+* some method callable from the constructing thread accesses it, AND
+* at least one of the two sides *mutates* it (attribute store/del,
+  ``self.f[k] = v``, ``self.f.append(...)``-style container mutation),
+  AND
+* the two sides share no lock — locks are lexical
+  ``with self.<lock>:`` blocks plus ``# ptlint: holds=<lock>``
+  declarations on the def line.
+
+Refinements that keep the false-positive rate near zero:
+
+* ``# guarded by:`` annotated fields are lock-discipline's job — the
+  annotation acts as this pass's suppression/refinement hook;
+* ``__init__`` is exempt (construction happens-before sharing);
+* fields holding synchronization primitives (``threading.Lock()``,
+  ``Condition``, ``Event``, ``queue.Queue``...) are exempt — their
+  methods are the synchronization;
+* findings anchor to the field's first assignment line, so a line
+  ``# ptlint: disable=thread-escape`` suppression with a justification
+  comment sits exactly where the field is born.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from ..engine import Finding, Pass
+from .._jitreach import _DEFS, dotted
+from .._threads import thread_model
+from .lock_discipline import _collect_guards, _held_locks, _with_locks
+
+# field values of these constructors ARE synchronization/thread-safe
+# state, not data that needs guarding (matched on last dotted segment)
+_SYNC_LAST = {"Lock", "RLock", "Condition", "Event", "Semaphore",
+              "BoundedSemaphore", "Barrier", "local", "Queue",
+              "SimpleQueue", "LifoQueue", "PriorityQueue"}
+
+# method names that mutate their receiver container in place
+_MUTATORS = {"append", "appendleft", "extend", "extendleft", "insert",
+             "pop", "popleft", "popitem", "remove", "discard", "clear",
+             "update", "setdefault", "add", "put", "put_nowait",
+             "sort", "reverse", "move_to_end", "rotate"}
+
+
+class _Site:
+    __slots__ = ("method", "write", "locks")
+
+    def __init__(self, method: str, write: bool, locks: Set[str]):
+        self.method = method
+        self.write = write
+        self.locks = locks
+
+
+def _last(dot: Optional[str]) -> str:
+    return dot.rsplit(".", 1)[-1] if dot else ""
+
+
+def _class_defs(cls: ast.ClassDef) -> List[ast.AST]:
+    """Every def lexically inside the class (methods + nested)."""
+    return [n for n in ast.walk(cls) if isinstance(n, _DEFS)]
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _field_info(cls: ast.ClassDef) -> Tuple[Dict[str, int], Set[str]]:
+    """(field -> first assignment line, sync-primitive fields)."""
+    first_line: Dict[str, int] = {}
+    sync: Set[str] = set()
+    for node in ast.walk(cls):
+        targets, value = [], None
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+            targets, value = [node.target], node.value
+        for t in targets:
+            attr = _self_attr(t)
+            if attr is None:
+                continue
+            if attr not in first_line or node.lineno < first_line[attr]:
+                first_line[attr] = node.lineno
+            if isinstance(value, ast.Call) and \
+                    _last(dotted(value.func)) in _SYNC_LAST:
+                sync.add(attr)
+    return first_line, sync
+
+
+def _collect_sites(sf, fn: ast.AST, fields: Set[str],
+                   sites: Dict[str, List[_Site]]) -> None:
+    """Field access sites of ONE def (nested defs are scanned as their
+    own defs so their threaded status and locksets stay separate)."""
+    held = _held_locks(sf, fn)
+
+    def note(attr: Optional[str], write: bool, locks: Set[str]):
+        if attr in fields:
+            sites.setdefault(attr, []).append(
+                _Site(fn.name, write, set(locks)))
+
+    def mark_target(t: ast.AST, locks: Set[str]):
+        if isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                mark_target(e, locks)
+            return
+        if isinstance(t, ast.Starred):
+            mark_target(t.value, locks)
+            return
+        attr = _self_attr(t)
+        if attr is not None:
+            note(attr, True, locks)
+            return
+        # self.f[k] = v  /  self.f.x = v : container/object mutation
+        if isinstance(t, (ast.Subscript, ast.Attribute)):
+            inner = _self_attr(t.value)
+            if inner is not None:
+                note(inner, True, locks)
+            else:
+                scan(t.value, locks)
+            if isinstance(t, ast.Subscript):
+                scan(t.slice, locks)
+
+    def scan(node: ast.AST, locks: Set[str]):
+        if isinstance(node, _DEFS) and node is not fn:
+            return                          # separate def, own scan
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            inner = locks | _with_locks(node.items)
+            for item in node.items:
+                scan(item.context_expr, locks)
+                if item.optional_vars is not None:
+                    scan(item.optional_vars, inner)
+            for b in node.body:
+                scan(b, inner)
+            return
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                mark_target(t, locks)
+            scan(node.value, locks)
+            return
+        if isinstance(node, ast.AugAssign):
+            mark_target(node.target, locks)
+            # aug also reads; mark_target already records the write,
+            # a read at the same site adds nothing to the race check
+            scan(node.value, locks)
+            return
+        if isinstance(node, ast.AnnAssign):
+            mark_target(node.target, locks)
+            if node.value is not None:
+                scan(node.value, locks)
+            return
+        if isinstance(node, ast.Delete):
+            for t in node.targets:
+                mark_target(t, locks)
+            return
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr in _MUTATORS:
+                inner = _self_attr(f.value)
+                if inner is not None:
+                    note(inner, True, locks)
+                else:
+                    scan(f.value, locks)
+            else:
+                scan(f, locks)
+            for a in node.args:
+                scan(a, locks)
+            for kw in node.keywords:
+                scan(kw.value, locks)
+            return
+        attr = _self_attr(node)
+        if attr is not None:
+            note(attr, False, locks)
+            scan(node.value, locks)  # `self` Name: no-op
+            return
+        for child in ast.iter_child_nodes(node):
+            scan(child, locks)
+
+    for stmt in fn.body:
+        scan(stmt, set(held))
+
+
+class ThreadEscapePass(Pass):
+    name = "thread-escape"
+    description = ("un-annotated fields shared between inferred "
+                   "threaded and unthreaded code paths with no common "
+                   "lock")
+
+    def run(self, files: Sequence, root: str) -> List[Finding]:
+        model = thread_model(files)
+        out: List[Finding] = []
+        for sf in files:
+            if sf.tree is None:
+                continue
+            annotated: Set[str] = set()
+            for _cls, g in _collect_guards(sf):
+                annotated |= set(g.internal) | set(g.external)
+            for node in ast.walk(sf.tree):
+                if isinstance(node, ast.ClassDef):
+                    self._check_class(sf, node, model, annotated, out)
+        return out
+
+    def _check_class(self, sf, cls: ast.ClassDef, model,
+                     annotated: Set[str], out: List[Finding]) -> None:
+        defs = _class_defs(cls)
+        if not any(model.is_threaded(sf.relpath, d) for d in defs):
+            return                  # no threaded code touches this class
+        first_line, sync = _field_info(cls)
+        fields = {f for f in first_line
+                  if f not in annotated and f not in sync}
+        if not fields:
+            return
+        init_defs = {d for d in cls.body
+                     if isinstance(d, _DEFS) and d.name == "__init__"}
+        sites_t: Dict[str, List[_Site]] = {}
+        sites_u: Dict[str, List[_Site]] = {}
+        for d in defs:
+            if d in init_defs:
+                continue
+            per: Dict[str, List[_Site]] = {}
+            _collect_sites(sf, d, fields, per)
+            if model.is_threaded(sf.relpath, d):
+                for attr, ss in per.items():
+                    sites_t.setdefault(attr, []).extend(ss)
+            if model.is_unthreaded(sf.relpath, d):
+                for attr, ss in per.items():
+                    sites_u.setdefault(attr, []).extend(ss)
+        for attr in sorted(fields):
+            race = self._race(sites_t.get(attr, ()),
+                              sites_u.get(attr, ()))
+            if race is None:
+                continue
+            t_site, u_site = race
+            reason = self._entry_reason(sf, cls, model, t_site.method)
+            out.append(Finding(
+                self.name, sf.relpath, first_line[attr],
+                f"`self.{attr}` ({cls.name}) is accessed from both "
+                f"threaded and unthreaded contexts with no common "
+                f"lock: `{t_site.method}` runs off-thread ({reason}) "
+                f"while `{u_site.method}` does not; hold one lock at "
+                f"every access, annotate `# guarded by: <lock>`, or "
+                f"mark lock-holding helpers `# ptlint: holds=<lock>`"))
+
+    @staticmethod
+    def _race(ts: Sequence[_Site],
+              us: Sequence[_Site]) -> Optional[Tuple[_Site, _Site]]:
+        best = None
+        for t in ts:
+            for u in us:
+                if not (t.write or u.write):
+                    continue
+                if t.locks & u.locks:
+                    continue
+                if t.method == u.method and t.locks == u.locks:
+                    # same def in both closures with identical locks:
+                    # a dual-context helper is only a race against a
+                    # DIFFERENT access path, which its own other sites
+                    # (or other methods) will witness
+                    continue
+                key = (t.method, u.method)
+                if best is None or key < (best[0].method,
+                                          best[1].method):
+                    best = (t, u)
+        return best
+
+    @staticmethod
+    def _entry_reason(sf, cls: ast.ClassDef, model, method: str) -> str:
+        for d in _class_defs(cls):
+            if d.name == method and d in model.entry_reason:
+                return model.entry_reason[d]
+        return "reached from a thread entry"
